@@ -1,0 +1,100 @@
+"""Benchmark: ResNet-50 training throughput on the local TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_train_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N, ...}
+
+Baseline semantics (BASELINE.md): the reference platform publishes no
+numbers; the north star is ">=90% of bare-metal jax.distributed ResNet-50
+throughput". The bare-metal reference for one v5e chip is taken as 30% MFU
+of the 197 TFLOP/s bf16 peak over ~3x forward FLOPs per training image
+(fwd 8.18 GFLOP + bwd ~2x), i.e. ~2409 img/s/chip; the target is 90% of
+that. vs_baseline = measured / (0.9 * bare_metal_reference): >= 1.0 meets
+the north star. On non-v5e hardware the ratio is still reported against
+the v5e reference for comparability across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def device_peak_flops(device) -> float:
+    """bf16 peak FLOP/s for the benched chip (fallback: v5e)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v4": 275e12,
+        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v6 lite": 918e12, "v6e": 918e12,
+    }
+    for key, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
+    image_size = int(os.environ.get("KFT_BENCH_IMAGE_SIZE", "224"))
+    steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
+    warmup = 3
+
+    from kubeflow_tpu.models import create_train_state, make_train_step, resnet50
+    from kubeflow_tpu.models.resnet import resnet_flops_per_image
+
+    model = resnet50(num_classes=1000)
+    state = create_train_state(model, jax.random.key(0), (2, image_size, image_size, 3))
+    step = make_train_step(smoothing=0.1)
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "image": jnp.asarray(
+            rng.normal(size=(batch, image_size, image_size, 3)), jnp.float32
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(batch,))),
+    }
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    train_flops_per_img = 3.0 * resnet_flops_per_image("resnet50", image_size)
+    peak = device_peak_flops(jax.devices()[0])
+    mfu = img_s * train_flops_per_img / peak
+
+    bare_metal_ref = 0.30 * 197e12 / (3.0 * resnet_flops_per_image("resnet50"))
+    target = 0.9 * bare_metal_ref
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(img_s, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(img_s / target, 4),
+                "mfu": round(mfu, 4),
+                "batch": batch,
+                "steps": steps,
+                "step_ms": round(1000 * dt / steps, 2),
+                "device": str(jax.devices()[0].device_kind),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""kubeflow_tpu — a TPU-native notebooks platform.
+
+A brand-new implementation of the capability surface of the Kubeflow
+Notebooks platform (reference: kubeflow/kubeflow), redesigned TPU-first:
+
+- ``controllers/`` — Kubernetes reconcilers (Notebook, Tensorboard,
+  PVCViewer, Profile) whose desired-state generation, work queues, and
+  merge engines live in the native C++ core (``native/``), driven here.
+- ``webhook/`` — the PodDefault admission webhook that injects
+  ``TPU_WORKER_ID`` / coordinator env into pods on TPU pod slices.
+- ``crud_backend/`` + ``apps/`` — Flask REST backends for the Jupyter
+  spawner, Volumes, and Tensorboards web apps.
+- ``parallel/`` / ``models/`` / ``ops/`` — the JAX compute stack shipped
+  in the ``jupyter-jax-tpu`` notebook images: device-mesh sharding,
+  ``jax.distributed`` wiring from platform-injected env, ResNet-50 and
+  long-context transformer reference models, and Pallas kernels.
+- ``topology.py`` — TPU accelerator/topology model (v4/v5e/v5p/v6e):
+  chips-per-host math, GKE node selectors, ``google.com/tpu`` resources.
+- ``k8s/`` — a typed Kubernetes API client plus an in-memory fake API
+  server used by the test ladder (the envtest equivalent).
+"""
+
+__version__ = "0.1.0"

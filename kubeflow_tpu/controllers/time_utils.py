@@ -1,0 +1,24 @@
+"""RFC3339 helpers shared by controllers and tests (the annotation time
+format the reference uses throughout its culler —
+reference culling_controller.go:266-272)."""
+
+from __future__ import annotations
+
+import datetime
+
+
+def rfc3339(epoch: int | float) -> str:
+    return datetime.datetime.fromtimestamp(
+        int(epoch), tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_rfc3339(text: str) -> int | None:
+    try:
+        return int(
+            datetime.datetime.strptime(text, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=datetime.timezone.utc)
+            .timestamp()
+        )
+    except (ValueError, TypeError):
+        return None

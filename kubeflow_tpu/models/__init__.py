@@ -1,0 +1,24 @@
+"""Reference models shipped in the jupyter-jax-tpu notebook images.
+
+These are the models the platform's benchmark and conformance harnesses
+run inside spawned notebooks: ResNet-50 (the BASELINE.md north-star
+workload) and a long-context transformer exercising ring attention.
+"""
+
+from kubeflow_tpu.models.resnet import ResNet, resnet50, resnet18
+from kubeflow_tpu.models.train import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+)
+
+__all__ = [
+    "ResNet",
+    "resnet50",
+    "resnet18",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+]

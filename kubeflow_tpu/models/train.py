@@ -1,0 +1,159 @@
+"""Sharded training step for the reference models.
+
+TPU-native training loop structure: one ``jax.jit``-compiled step over a
+named mesh. Batch is sharded over (dp, fsdp); params/opt-state are
+replicated over dp and sharded over fsdp (zero-redundancy) by
+:func:`kubeflow_tpu.parallel.param_sharding`. XLA inserts the gradient
+all-reduce (psum over dp) and just-in-time param all-gathers (fsdp) as ICI
+collectives — no hand-written communication.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel import batch_sharding, param_sharding, replicated
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: core.FrozenDict[str, Any] | dict
+    batch_stats: core.FrozenDict[str, Any] | dict
+    opt_state: optax.OptState
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    apply_fn: Callable = struct.field(pytree_node=False)
+
+
+def cross_entropy(logits, labels, smoothing: float = 0.1):
+    n = logits.shape[-1]
+    soft = jax.nn.one_hot(labels, n) * (1 - smoothing) + smoothing / n
+    return optax.softmax_cross_entropy(logits, soft).mean()
+
+
+def make_optimizer(
+    lr: float = 0.1, momentum: float = 0.9, weight_decay: float = 1e-4
+) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.add_decayed_weights(
+            weight_decay,
+            # No decay on BN scales/biases (1-d leaves) — standard practice.
+            mask=lambda params: jax.tree.map(lambda p: p.ndim > 1, params),
+        ),
+        optax.sgd(lr, momentum=momentum, nesterov=True),
+    )
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    input_shape: tuple[int, ...],
+    tx: optax.GradientTransformation | None = None,
+    mesh: Mesh | None = None,
+) -> TrainState:
+    """Initialise params/opt-state, placed with canonical shardings.
+
+    With a mesh, init runs under ``jax.jit`` with out_shardings computed
+    from the abstract shapes, so large fsdp-sharded params are *born*
+    sharded — no host-side replication spike.
+    """
+    tx = tx or make_optimizer()
+
+    def init_fn(rng):
+        variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            tx=tx,
+            apply_fn=model.apply,
+        )
+
+    if mesh is None:
+        return init_fn(rng)
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: param_sharding(mesh, path, leaf), abstract
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def state_shardings(state_or_abstract, mesh: Mesh):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: param_sharding(
+            mesh, path, jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        ),
+        state_or_abstract,
+    )
+
+
+def make_train_step(mesh: Mesh | None = None, smoothing: float = 0.1):
+    """Build the jitted train step. ``batch = {"image": ..., "label": ...}``."""
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        def loss_fn(params):
+            logits, updates = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch["image"],
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = cross_entropy(logits, batch["label"], smoothing)
+            return loss, (logits, updates["batch_stats"])
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_state = dataclasses.replace(
+            state,
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt_state,
+            batch_stats=new_stats,
+        )
+        metrics = {
+            "loss": loss,
+            "accuracy": (jnp.argmax(logits, -1) == batch["label"]).mean(),
+        }
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    data_sh = batch_sharding(mesh)
+
+    def sharded_step(state, batch):
+        batch = jax.lax.with_sharding_constraint(
+            batch, {"image": data_sh, "label": data_sh}
+        )
+        return step(state, batch)
+
+    return jax.jit(sharded_step, donate_argnums=0)
+
+
+def make_eval_step():
+    def eval_step(state: TrainState, batch) -> dict:
+        logits = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch["image"],
+            train=False,
+        )
+        return {
+            "loss": cross_entropy(logits, batch["label"], 0.0),
+            "accuracy": (jnp.argmax(logits, -1) == batch["label"]).mean(),
+        }
+
+    return jax.jit(eval_step)

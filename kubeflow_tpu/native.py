@@ -1,0 +1,79 @@
+"""ctypes bridge to the native C++ core (native/libkft_native.so).
+
+The reconcilers' desired-state generation, the PodDefault merge engine,
+the culling decision engine, and the drift-repair helpers are native code
+(the role Go plays in the reference — see SURVEY.md §2.2); Python layers
+(controllers' watch loops, web apps, tests) call through here. Protocol:
+one C function ``kft_invoke(fn, json) -> json`` — see native/src/api.cpp.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libkft_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeError(RuntimeError):
+    """Error raised inside the native core (carries its message)."""
+
+
+def ensure_built(force: bool = False) -> str:
+    """Build the native library if missing or stale; returns its path."""
+    with _lock:
+        stale = force or not os.path.exists(_LIB_PATH)
+        if not stale:
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            src_dir = os.path.join(_NATIVE_DIR, "src")
+            for fname in os.listdir(src_dir):
+                if os.path.getmtime(os.path.join(src_dir, fname)) > lib_mtime:
+                    stale = True
+                    break
+        if stale:
+            proc = subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                raise NativeError(
+                    f"native build failed:\n{proc.stdout}\n{proc.stderr}"
+                )
+        return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        path = ensure_built()
+        lib = ctypes.CDLL(path)
+        lib.kft_invoke.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.kft_invoke.restype = ctypes.c_void_p  # manual free
+        lib.kft_free.argtypes = [ctypes.c_void_p]
+        lib.kft_free.restype = None
+        _lib = lib
+    return _lib
+
+
+def invoke(fn: str, payload: dict | None = None) -> dict | list | str | int:
+    """Call a native function; raises NativeError on native-side failure."""
+    lib = _load()
+    raw = lib.kft_invoke(
+        fn.encode(), json.dumps(payload or {}).encode()
+    )
+    try:
+        reply = json.loads(ctypes.string_at(raw).decode())
+    finally:
+        lib.kft_free(raw)
+    if not reply.get("ok"):
+        raise NativeError(reply.get("error", "unknown native error"))
+    return reply["result"]

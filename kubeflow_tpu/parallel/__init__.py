@@ -1,0 +1,33 @@
+"""Device-mesh parallelism for TPU slices.
+
+Scaling is expressed the TPU-native way: a named :class:`jax.sharding.Mesh`
+over the slice's chips, sharding annotations on arrays, and XLA collectives
+over ICI/DCN inserted by the compiler — never hand-written NCCL/MPI calls
+(the reference platform has no collective layer at all; see SURVEY.md §2.3).
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    auto_mesh,
+    batch_sharding,
+    replicated,
+    param_sharding,
+)
+from kubeflow_tpu.parallel.distributed import (
+    DistributedEnv,
+    initialize_from_env,
+    slice_env_for_rank,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "auto_mesh",
+    "batch_sharding",
+    "replicated",
+    "param_sharding",
+    "DistributedEnv",
+    "initialize_from_env",
+    "slice_env_for_rank",
+]

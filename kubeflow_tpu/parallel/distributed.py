@@ -1,0 +1,121 @@
+"""jax.distributed wiring from platform-injected environment.
+
+This is the meeting point of the control plane and the compute stack. The
+platform side (notebook controller + PodDefault webhook) injects these env
+vars into every replica of a multi-host notebook StatefulSet:
+
+- ``TPU_WORKER_ID``        — pod ordinal (rank), 0..N-1
+- ``TPU_WORKER_HOSTNAMES`` — comma-separated stable DNS names of all
+                             replicas (headless Service)
+- ``KFT_COORDINATOR_ADDRESS`` — ``<name>-0.<svc>.<ns>.svc:8476`` (rank 0)
+- ``KFT_NUM_PROCESSES``    — replica count (hosts in the slice)
+
+The reference platform had no distributed backend at all (SURVEY.md §2.3,
+reference notebook-controller hardcodes replicas=1 at
+``controllers/notebook_controller.go:362-365``); here multi-host is
+first-class: user code in the image calls :func:`initialize_from_env` once
+and then sees every chip of the slice via ``jax.devices()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+COORDINATOR_PORT = 8476
+
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_COORDINATOR = "KFT_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KFT_NUM_PROCESSES"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedEnv:
+    """Parsed view of the platform-injected distributed environment."""
+
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: str | None = None
+    worker_hostnames: tuple[str, ...] = ()
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "DistributedEnv":
+        env = os.environ if env is None else env
+        hostnames = tuple(
+            h for h in env.get(ENV_WORKER_HOSTNAMES, "").split(",") if h
+        )
+        num = int(env.get(ENV_NUM_PROCESSES, len(hostnames) or 1))
+        coord = env.get(ENV_COORDINATOR)
+        if coord is None and hostnames:
+            coord = f"{hostnames[0]}:{COORDINATOR_PORT}"
+        return cls(
+            process_id=int(env.get(ENV_WORKER_ID, 0)),
+            num_processes=num,
+            coordinator_address=coord,
+            worker_hostnames=hostnames,
+        )
+
+
+def initialize_from_env(env: dict[str, str] | None = None) -> DistributedEnv:
+    """Initialise ``jax.distributed`` from platform env; no-op single-host.
+
+    Safe to call unconditionally at image startup (the jupyter-jax-tpu
+    images call it from a sitecustomize hook): a single-replica notebook
+    has no hostnames env and skips initialisation, so the same image runs
+    single-host and multi-host (BASELINE.md "TPU_WORKER_ID=0 fallback").
+    """
+    denv = DistributedEnv.from_env(env)
+    if not denv.is_multihost:
+        log.info("single-host notebook: skipping jax.distributed")
+        return denv
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=denv.coordinator_address,
+        num_processes=denv.num_processes,
+        process_id=denv.process_id,
+    )
+    log.info(
+        "jax.distributed up: rank %d/%d coordinator=%s",
+        denv.process_id,
+        denv.num_processes,
+        denv.coordinator_address,
+    )
+    return denv
+
+
+def slice_env_for_rank(
+    name: str,
+    namespace: str,
+    rank: int,
+    num_replicas: int,
+    service: str | None = None,
+) -> dict[str, str]:
+    """The env block the platform injects for replica ``rank``.
+
+    Single source of truth shared by the notebook controller's
+    StatefulSet generator and the PodDefault webhook tests, so the two
+    injection paths can never drift apart.
+    """
+    service = service or name
+    hosts = ",".join(
+        f"{name}-{i}.{service}.{namespace}.svc" for i in range(num_replicas)
+    )
+    env = {
+        ENV_WORKER_ID: str(rank),
+        ENV_NUM_PROCESSES: str(num_replicas),
+    }
+    if num_replicas > 1:
+        env[ENV_WORKER_HOSTNAMES] = hosts
+        env[ENV_COORDINATOR] = (
+            f"{name}-0.{service}.{namespace}.svc:{COORDINATOR_PORT}"
+        )
+    return env

@@ -1,0 +1,111 @@
+"""Named device meshes and canonical shardings.
+
+The platform spawns multi-host notebooks onto one TPU pod slice; inside the
+notebook, user code builds a mesh over all chips of the slice. Axis names
+are fixed platform-wide so models, optimizers, and checkpoints agree:
+
+- ``"dp"``   — data parallel (batch dimension; gradients all-reduced)
+- ``"fsdp"`` — fully-sharded data parallel (params/opt-state sharded,
+               all-gathered just-in-time; rides ICI)
+- ``"tp"``   — tensor parallel (hidden/heads dimension)
+- ``"sp"``   — sequence/context parallel (ring attention over ICI)
+
+A v5e-16 slice (4 hosts x 4 chips) with ``MeshSpec(dp=2, fsdp=4, tp=2)``
+yields a 16-device mesh; XLA lays collectives onto the ICI torus.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout mapped onto the slice's chips.
+
+    Any axis left at 1 is inert (its collectives compile away). ``dp=-1``
+    means "absorb all remaining devices into data parallelism".
+    """
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        fixed = self.fsdp * self.tp * self.sp
+        dp = self.dp
+        if dp == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*tp*sp={fixed}"
+                )
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
+            )
+        return MeshSpec(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a named mesh over ``devices`` (default: all addressable chips).
+
+    Device order follows ``jax.devices()``, which JAX already orders so
+    that adjacent ids are ICI neighbours on TPU; the innermost mesh axes
+    therefore get the tightest interconnect (tp/sp innermost).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    arr = np.asarray(devices).reshape(spec.shape)
+    return Mesh(arr, AXES)
+
+
+def auto_mesh(n_devices: int | None = None) -> Mesh:
+    """Pure data-parallel mesh over all (or the first n) devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return make_mesh(MeshSpec(dp=len(devices)), devices)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over dp+fsdp; replicate the rest."""
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, path: tuple, leaf: jax.ShapeDtypeStruct):
+    """Canonical parameter sharding: shard the largest dim that divides
+    evenly over ``fsdp`` (zero-redundancy style); replicate small leaves.
+
+    Works for any pytree path; models with explicit tp layouts override
+    this per-module instead.
+    """
+    fsdp = mesh.shape["fsdp"]
+    if fsdp == 1 or not leaf.shape or math.prod(leaf.shape) < 2**14:
+        return replicated(mesh)
+    dims = sorted(range(len(leaf.shape)), key=lambda d: -leaf.shape[d])
+    for d in dims:
+        if leaf.shape[d] % fsdp == 0:
+            spec = [None] * len(leaf.shape)
+            spec[d] = "fsdp"
+            return NamedSharding(mesh, P(*spec))
+    return replicated(mesh)

@@ -1,0 +1,166 @@
+"""TPU accelerator / topology model — single source of truth.
+
+The reference platform models accelerators as an opaque GPU vendor+count
+pair injected into container limits (reference
+``crud-web-apps/jupyter/backend/apps/common/form.py:226-250`` and
+``spawner_ui_config.yaml:120-143``). TPU slices need more structure: a
+slice has an accelerator generation, a physical topology (ICI torus
+dims), a chips-per-host machine shape, and — for multi-host slices — a
+replica count that MUST equal the number of hosts. This module owns that
+math for every component:
+
+- notebook controller: replicas, ``google.com/tpu`` limits, GKE selectors
+- PodDefault webhook / spawner: topology validation and presets
+- ResourceQuota (profiles): ``google.com/tpu`` accounting
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class Accelerator:
+    """One TPU generation as GKE exposes it."""
+
+    name: str                 # short name used in CRs ("v5e")
+    gke_accelerator: str      # cloud.google.com/gke-tpu-accelerator value
+    ndims: int                # ICI torus dimensionality (2 or 3)
+    chips_per_host: int       # chips per VM in multi-host slices
+    max_single_host_chips: int  # largest slice that fits one host
+
+
+ACCELERATORS: dict[str, Accelerator] = {
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8),
+}
+
+# Canonical topology string for a chip count (2-D generations).
+_TOPO_2D = {
+    1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8",
+    64: "8x8", 128: "8x16", 256: "16x16",
+}
+# 3-D generations (v4/v5p): chips -> torus dims.
+_TOPO_3D = {
+    4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4",
+    128: "4x4x8", 256: "4x8x8", 512: "8x8x8",
+}
+
+GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """A validated (accelerator, topology) pair, e.g. ("v5e", "4x4")."""
+
+    accelerator: Accelerator
+    topology: str
+
+    @classmethod
+    def parse(cls, accelerator: str, topology: str) -> "TpuSlice":
+        acc = ACCELERATORS.get(accelerator)
+        if acc is None:
+            raise TopologyError(
+                f"unknown accelerator {accelerator!r}; known: {sorted(ACCELERATORS)}"
+            )
+        try:
+            dims = [int(d) for d in topology.split("x")]
+        except ValueError:
+            raise TopologyError(f"malformed topology {topology!r}")
+        if not dims or any(d < 1 for d in dims):
+            raise TopologyError(f"malformed topology {topology!r}")
+        if len(dims) != acc.ndims and not (
+            len(dims) == 2 and math.prod(dims) == 1
+        ):
+            raise TopologyError(
+                f"{accelerator} topologies are {acc.ndims}-D, got {topology!r}"
+            )
+        table = _TOPO_2D if acc.ndims == 2 else _TOPO_3D
+        if topology not in table.values():
+            raise TopologyError(
+                f"{topology!r} is not a valid {accelerator} slice; "
+                f"valid: {sorted(table.values())}"
+            )
+        return cls(acc, topology)
+
+    @classmethod
+    def from_shorthand(cls, shorthand: str) -> "TpuSlice":
+        """Parse "v5e-16" (accelerator-chips) into the canonical slice."""
+        try:
+            name, chips_s = shorthand.rsplit("-", 1)
+            chips = int(chips_s)
+        except ValueError:
+            raise TopologyError(f"malformed shorthand {shorthand!r}")
+        acc = ACCELERATORS.get(name)
+        if acc is None:
+            raise TopologyError(f"unknown accelerator {name!r}")
+        table = _TOPO_2D if acc.ndims == 2 else _TOPO_3D
+        if chips not in table:
+            raise TopologyError(
+                f"no canonical {name} topology for {chips} chips; "
+                f"valid counts: {sorted(table)}"
+            )
+        return cls.parse(name, table[chips])
+
+    @property
+    def chips(self) -> int:
+        return math.prod(int(d) for d in self.topology.split("x"))
+
+    @property
+    def num_hosts(self) -> int:
+        if self.chips <= self.accelerator.max_single_host_chips:
+            return 1
+        return self.chips // self.accelerator.chips_per_host
+
+    @property
+    def chips_per_replica(self) -> int:
+        return self.chips // self.num_hosts
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def shorthand(self) -> str:
+        return f"{self.accelerator.name}-{self.chips}"
+
+    def node_selectors(self) -> dict[str, str]:
+        return {
+            GKE_ACCELERATOR_LABEL: self.accelerator.gke_accelerator,
+            GKE_TOPOLOGY_LABEL: self.topology,
+        }
+
+    def container_resources(self) -> dict[str, str]:
+        """Per-pod (= per-host) TPU resource limits."""
+        return {TPU_RESOURCE: str(self.chips_per_replica)}
+
+
+def spawner_presets(accelerators: list[str] | None = None) -> list[dict]:
+    """Topology options for the spawner UI config (replaces the reference's
+    GPU vendors list, ``spawner_ui_config.yaml:120-143``)."""
+    out = []
+    for name in accelerators or ["v5e", "v6e"]:
+        acc = ACCELERATORS[name]
+        table = _TOPO_2D if acc.ndims == 2 else _TOPO_3D
+        for chips in sorted(table):
+            sl = TpuSlice.parse(name, table[chips])
+            out.append(
+                {
+                    "accelerator": name,
+                    "topology": sl.topology,
+                    "shorthand": sl.shorthand,
+                    "chips": sl.chips,
+                    "hosts": sl.num_hosts,
+                    "multihost": sl.is_multihost,
+                }
+            )
+    return out

@@ -1,0 +1,126 @@
+// C ABI for the kubeflow_tpu native core.
+//
+// Every operation is exposed as kft_invoke(fn_name, json_payload) ->
+// malloc'd JSON string {"ok":true,"result":…} | {"ok":false,"error":…}.
+// Consumers: the Python controller/web-app layer via ctypes
+// (kubeflow_tpu/native.py) and the native test binary.
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <string>
+
+#include "culler.hpp"
+#include "json.hpp"
+#include "notebook.hpp"
+#include "poddefault.hpp"
+#include "profile.hpp"
+#include "reconcile.hpp"
+#include "tensorboard.hpp"
+#include "topology.hpp"
+
+namespace kft {
+namespace {
+
+using Handler = std::function<Json(const Json&)>;
+
+const std::map<std::string, Handler>& handlers() {
+  static const std::map<std::string, Handler> table = {
+      {"parse_tpu_slice",
+       [](const Json& in) {
+         return tpu_slice_to_json(parse_tpu_slice(
+             in.get_string("accelerator"), in.get_string("topology", "1x1")));
+       }},
+      {"notebook_reconcile",
+       [](const Json& in) {
+         return notebook_reconcile(in.at("notebook"),
+                                   in.contains("options") ? in.at("options")
+                                                          : Json::object());
+       }},
+      {"notebook_status",
+       [](const Json& in) {
+         auto get = [&](const char* k) {
+           const Json* v = in.find(k);
+           return v ? *v : Json::object();
+         };
+         return notebook_status(get("notebook"), get("statefulset"),
+                                get("pod"), in.contains("events")
+                                                ? in.at("events")
+                                                : Json::array());
+       }},
+      {"poddefault_mutate",
+       [](const Json& in) {
+         return poddefault_mutate(in.at("pod"), in.at("poddefaults"));
+       }},
+      {"cull_decide",
+       [](const Json& in) {
+         return cull_decide(in.at("notebook"),
+                            in.contains("kernels") ? in.at("kernels")
+                                                   : Json(nullptr),
+                            in.get_int("nowEpoch"),
+                            in.contains("config") ? in.at("config")
+                                                  : Json::object());
+       }},
+      {"copy_owned_fields",
+       [](const Json& in) {
+         return copy_owned_fields(in.get_string("kind"), in.at("existing"),
+                                  in.at("desired"));
+       }},
+      {"profile_reconcile",
+       [](const Json& in) {
+         return profile_reconcile(in.at("profile"),
+                                  in.contains("options") ? in.at("options")
+                                                         : Json::object());
+       }},
+      {"tensorboard_reconcile",
+       [](const Json& in) {
+         return tensorboard_reconcile(in.at("tensorboard"),
+                                      in.contains("options")
+                                          ? in.at("options")
+                                          : Json::object());
+       }},
+      {"pvcviewer_reconcile",
+       [](const Json& in) {
+         return pvcviewer_reconcile(in.at("viewer"),
+                                    in.contains("options") ? in.at("options")
+                                                           : Json::object());
+       }},
+  };
+  return table;
+}
+
+char* dup_string(const std::string& s) {
+  char* out = (char*)std::malloc(s.size() + 1);
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+}  // namespace kft
+
+extern "C" {
+
+char* kft_invoke(const char* fn, const char* payload) {
+  using namespace kft;
+  Json reply = Json::object();
+  try {
+    const auto& table = handlers();
+    auto it = table.find(fn ? fn : "");
+    if (it == table.end())
+      throw std::runtime_error(std::string("unknown function '") +
+                               (fn ? fn : "") + "'");
+    Json in = Json::parse(payload ? payload : "{}");
+    reply["ok"] = Json(true);
+    reply["result"] = it->second(in);
+  } catch (const std::exception& e) {
+    reply = Json::object();
+    reply["ok"] = Json(false);
+    reply["error"] = Json(std::string(e.what()));
+  }
+  return dup_string(reply.dump());
+}
+
+void kft_free(char* p) { std::free(p); }
+
+const char* kft_version() { return "0.1.0"; }
+}

@@ -1,0 +1,122 @@
+#include "culler.hpp"
+
+#include <cstdio>
+#include <ctime>
+
+namespace kft {
+
+namespace {
+
+const char* kStopAnnotation = "kubeflow-resource-stopped";
+const char* kLastActivity = "notebooks.kubeflow.org/last-activity";
+const char* kLastCheck =
+    "notebooks.kubeflow.org/last_activity_check_timestamp";
+
+Json annotations_of(const Json& notebook) {
+  if (const Json* meta = notebook.find("metadata"))
+    if (const Json* ann = meta->find("annotations"))
+      if (ann->is_object()) return *ann;
+  return Json::object();
+}
+
+}  // namespace
+
+int64_t parse_rfc3339(const std::string& ts) {
+  std::tm tm = {};
+  int y, mo, d, h, mi, s;
+  // Accept "YYYY-MM-DDTHH:MM:SSZ" (fractional seconds tolerated via %*).
+  if (std::sscanf(ts.c_str(), "%d-%d-%dT%d:%d:%d", &y, &mo, &d, &h, &mi,
+                  &s) != 6)
+    return -1;
+  tm.tm_year = y - 1900;
+  tm.tm_mon = mo - 1;
+  tm.tm_mday = d;
+  tm.tm_hour = h;
+  tm.tm_min = mi;
+  tm.tm_sec = s;
+  return (int64_t)timegm(&tm);
+}
+
+std::string format_rfc3339(int64_t epoch) {
+  std::time_t t = (std::time_t)epoch;
+  std::tm tm;
+  gmtime_r(&t, &tm);
+  char buf[80];
+  std::snprintf(buf, sizeof buf, "%04d-%02d-%02dT%02d:%02d:%02dZ",
+                tm.tm_year + 1900, tm.tm_mon + 1, tm.tm_mday, tm.tm_hour,
+                tm.tm_min, tm.tm_sec);
+  return buf;
+}
+
+Json cull_decide(const Json& notebook, const Json& kernels, int64_t now_epoch,
+                 const Json& config) {
+  const int64_t idle_min = config.get_int("cullIdleTimeMin", 1440);
+  const int64_t check_min = config.get_int("idlenessCheckPeriodMin", 1);
+
+  Json out = Json::object();
+  Json ann = annotations_of(notebook);
+
+  // Already stopped: nothing to do (reference culling_controller.go:96-104).
+  if (ann.contains(kStopAnnotation)) {
+    out["action"] = Json("none");
+    out["annotations"] = ann;
+    out["requeueAfterSec"] = Json(check_min * 60);
+    return out;
+  }
+
+  // Rate limit: honour last_activity_check_timestamp (reference :134-137).
+  int64_t last_check = parse_rfc3339(ann.get_string(kLastCheck));
+  if (last_check >= 0 && now_epoch - last_check < check_min * 60) {
+    out["action"] = Json("none");
+    out["annotations"] = ann;
+    out["requeueAfterSec"] = Json(check_min * 60 - (now_epoch - last_check));
+    return out;
+  }
+
+  // Derive activity from the kernels probe (reference notebookIsIdle).
+  bool idle;
+  int64_t last_activity;
+  const int64_t prev_activity = parse_rfc3339(ann.get_string(kLastActivity));
+  if (!kernels.is_array()) {
+    // Probe failed (pod starting / network): do not count as idleness
+    // evidence; refresh the check stamp only.
+    idle = false;
+    last_activity = now_epoch;
+  } else if (kernels.size() == 0) {
+    // No kernels: idle since whenever we last saw activity.
+    idle = true;
+    last_activity = prev_activity >= 0 ? prev_activity : now_epoch;
+  } else {
+    idle = true;
+    int64_t max_activity = -1;
+    for (const auto& k : kernels.items()) {
+      if (k.get_string("execution_state") == "busy") idle = false;
+      int64_t t = parse_rfc3339(k.get_string("last_activity"));
+      if (t > max_activity) max_activity = t;
+    }
+    last_activity = idle ? (max_activity >= 0 ? max_activity : now_epoch)
+                         : now_epoch;
+  }
+
+  // TPU-idle gate: a busy slice (XLA programs in flight) is never culled
+  // even when every Jupyter kernel reports idle.
+  if (config.get_bool("tpuBusy", false)) {
+    idle = false;
+    last_activity = now_epoch;
+  }
+
+  ann[kLastActivity] = Json(format_rfc3339(last_activity));
+  ann[kLastCheck] = Json(format_rfc3339(now_epoch));
+
+  if (idle && now_epoch - last_activity >= idle_min * 60) {
+    ann[kStopAnnotation] = Json(format_rfc3339(now_epoch));
+    out["action"] = Json("stop");
+  } else {
+    out["action"] = Json("update-annotations");
+  }
+  out["annotations"] = ann;
+  out["requeueAfterSec"] = Json(check_min * 60);
+  return out;
+}
+
+}  // namespace kft

@@ -1,0 +1,31 @@
+// Idle-notebook culling decision engine.
+//
+// Capability parity with the reference culler (reference
+// components/notebook-controller/controllers/culling_controller.go:
+// Reconcile :78-162, notebookIsIdle :179-200, updateNotebookLastActivity
+// :274-308): the controller probes the notebook's Jupyter
+// /api/kernels endpoint and feeds the response here; this pure function
+// decides annotation updates and scale-to-zero. TPU delta: an optional
+// "tpuIdle" signal (no XLA program dispatched recently, from device
+// metrics) must ALSO be idle before culling a slice — kernels can look
+// idle while a long jax.distributed run is executing.
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// notebook: the CR. kernels: JSON array from /api/kernels, or null if the
+// probe failed. now_epoch: seconds. config: {"cullIdleTimeMin":1440,
+// "idlenessCheckPeriodMin":1, "tpuIdle": bool (optional)}.
+// Returns {"action": "none"|"update-annotations"|"stop",
+//          "annotations": {merged annotation map},
+//          "requeueAfterSec": N}.
+Json cull_decide(const Json& notebook, const Json& kernels, int64_t now_epoch,
+                 const Json& config);
+
+// RFC3339 helpers (exposed for tests).
+int64_t parse_rfc3339(const std::string& ts);  // -1 on parse failure
+std::string format_rfc3339(int64_t epoch);
+
+}  // namespace kft

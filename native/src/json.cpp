@@ -1,0 +1,303 @@
+#include "json.hpp"
+
+#include <cstdio>
+#include <cstring>
+
+namespace kft {
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  [[noreturn]] void fail(const std::string& msg) {
+    throw JsonParseError(msg + " at offset " +
+                         std::to_string((size_t)(p - start)));
+  }
+  const char* start;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  char peek() {
+    if (p >= end) fail("unexpected end of input");
+    return *p;
+  }
+
+  void expect(char c) {
+    if (p >= end || *p != c) fail(std::string("expected '") + c + "'");
+    ++p;
+  }
+
+  bool consume(const char* lit) {
+    size_t n = std::strlen(lit);
+    if ((size_t)(end - p) >= n && std::memcmp(p, lit, n) == 0) {
+      p += n;
+      return true;
+    }
+    return false;
+  }
+
+  Json parse_value() {
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Json(parse_string());
+      case 't':
+        if (consume("true")) return Json(true);
+        fail("bad literal");
+      case 'f':
+        if (consume("false")) return Json(false);
+        fail("bad literal");
+      case 'n':
+        if (consume("null")) return Json(nullptr);
+        fail("bad literal");
+      default: return parse_number();
+    }
+  }
+
+  Json parse_object() {
+    expect('{');
+    Json obj = Json::object();
+    skip_ws();
+    if (peek() == '}') {
+      ++p;
+      return obj;
+    }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      obj.members().emplace_back(std::move(key), parse_value());
+      skip_ws();
+      if (peek() == ',') {
+        ++p;
+        continue;
+      }
+      expect('}');
+      return obj;
+    }
+  }
+
+  Json parse_array() {
+    expect('[');
+    Json arr = Json::array();
+    skip_ws();
+    if (peek() == ']') {
+      ++p;
+      return arr;
+    }
+    while (true) {
+      arr.push_back(parse_value());
+      skip_ws();
+      if (peek() == ',') {
+        ++p;
+        continue;
+      }
+      expect(']');
+      return arr;
+    }
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (p >= end) fail("unterminated string");
+      unsigned char c = (unsigned char)*p++;
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (p >= end) fail("bad escape");
+        char e = *p++;
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned code = parse_hex4();
+            if (code >= 0xD800 && code <= 0xDBFF) {
+              // Surrogate pair.
+              if (!consume("\\u")) fail("lone high surrogate");
+              unsigned low = parse_hex4();
+              if (low < 0xDC00 || low > 0xDFFF) fail("bad low surrogate");
+              code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+            }
+            append_utf8(out, code);
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += (char)c;
+      }
+    }
+  }
+
+  unsigned parse_hex4() {
+    if (end - p < 4) fail("bad \\u escape");
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= (unsigned)(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= (unsigned)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= (unsigned)(c - 'A' + 10);
+      else fail("bad hex digit");
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned code) {
+    if (code < 0x80) {
+      out += (char)code;
+    } else if (code < 0x800) {
+      out += (char)(0xC0 | (code >> 6));
+      out += (char)(0x80 | (code & 0x3F));
+    } else if (code < 0x10000) {
+      out += (char)(0xE0 | (code >> 12));
+      out += (char)(0x80 | ((code >> 6) & 0x3F));
+      out += (char)(0x80 | (code & 0x3F));
+    } else {
+      out += (char)(0xF0 | (code >> 18));
+      out += (char)(0x80 | ((code >> 12) & 0x3F));
+      out += (char)(0x80 | ((code >> 6) & 0x3F));
+      out += (char)(0x80 | (code & 0x3F));
+    }
+  }
+
+  Json parse_number() {
+    const char* begin = p;
+    if (p < end && *p == '-') ++p;
+    bool is_int = true;
+    while (p < end) {
+      char c = *p;
+      if (c >= '0' && c <= '9') {
+        ++p;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+        is_int = false;
+        ++p;
+      } else {
+        break;
+      }
+    }
+    if (p == begin) fail("bad number");
+    std::string text(begin, p);
+    if (is_int) {
+      errno = 0;
+      char* endptr = nullptr;
+      long long v = std::strtoll(text.c_str(), &endptr, 10);
+      if (errno == 0 && endptr && *endptr == '\0') return Json((int64_t)v);
+    }
+    try {
+      return Json(std::stod(text));
+    } catch (...) {
+      fail("bad number");
+    }
+  }
+};
+
+void dump_string(std::string& out, const std::string& s) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+}
+
+}  // namespace
+
+Json Json::parse(const std::string& text) {
+  Parser parser{text.data(), text.data() + text.size(), text.data()};
+  parser.start = text.data();
+  Json v = parser.parse_value();
+  parser.skip_ws();
+  if (parser.p != parser.end) parser.fail("trailing content");
+  return v;
+}
+
+void Json::dump_to(std::string& out, int indent, int depth) const {
+  auto newline = [&](int d) {
+    if (indent >= 0) {
+      out += '\n';
+      out.append((size_t)(indent * d), ' ');
+    }
+  };
+  switch (type_) {
+    case JsonType::Null: out += "null"; break;
+    case JsonType::Bool: out += bool_ ? "true" : "false"; break;
+    case JsonType::Int: out += std::to_string(int_); break;
+    case JsonType::Double: {
+      if (std::isfinite(dbl_) && dbl_ == (double)(int64_t)dbl_ &&
+          std::abs(dbl_) < 1e15) {
+        out += std::to_string((int64_t)dbl_);
+      } else {
+        char buf[32];
+        std::snprintf(buf, sizeof buf, "%.17g", dbl_);
+        out += buf;
+      }
+      break;
+    }
+    case JsonType::String: dump_string(out, str_); break;
+    case JsonType::Array: {
+      if (arr_.empty()) {
+        out += "[]";
+        break;
+      }
+      out += '[';
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out += ',';
+        newline(depth + 1);
+        arr_[i].dump_to(out, indent, depth + 1);
+      }
+      newline(depth);
+      out += ']';
+      break;
+    }
+    case JsonType::Object: {
+      if (members_.empty()) {
+        out += "{}";
+        break;
+      }
+      out += '{';
+      for (size_t i = 0; i < members_.size(); ++i) {
+        if (i) out += ',';
+        newline(depth + 1);
+        dump_string(out, members_[i].first);
+        out += indent >= 0 ? ": " : ":";
+        members_[i].second.dump_to(out, indent, depth + 1);
+      }
+      newline(depth);
+      out += '}';
+      break;
+    }
+  }
+}
+
+}  // namespace kft

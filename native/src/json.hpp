@@ -1,0 +1,178 @@
+// Minimal JSON value type + parser + serializer for the kubeflow_tpu
+// native core. Kubernetes objects flow through the reconcilers as JSON;
+// this keeps the native layer dependency-free (no third-party libs in the
+// image). Objects preserve insertion order so generated manifests and
+// JSONPatches are deterministic and diff-stable.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace kft {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonMember = std::pair<std::string, Json>;
+
+enum class JsonType { Null, Bool, Int, Double, String, Array, Object };
+
+class Json {
+ public:
+  Json() : type_(JsonType::Null) {}
+  Json(std::nullptr_t) : type_(JsonType::Null) {}
+  Json(bool b) : type_(JsonType::Bool), bool_(b) {}
+  Json(int v) : type_(JsonType::Int), int_(v) {}
+  Json(int64_t v) : type_(JsonType::Int), int_(v) {}
+  Json(double v) : type_(JsonType::Double), dbl_(v) {}
+  Json(const char* s) : type_(JsonType::String), str_(s) {}
+  Json(std::string s) : type_(JsonType::String), str_(std::move(s)) {}
+
+  static Json array() {
+    Json j;
+    j.type_ = JsonType::Array;
+    return j;
+  }
+  static Json object() {
+    Json j;
+    j.type_ = JsonType::Object;
+    return j;
+  }
+
+  JsonType type() const { return type_; }
+  bool is_null() const { return type_ == JsonType::Null; }
+  bool is_bool() const { return type_ == JsonType::Bool; }
+  bool is_number() const {
+    return type_ == JsonType::Int || type_ == JsonType::Double;
+  }
+  bool is_string() const { return type_ == JsonType::String; }
+  bool is_array() const { return type_ == JsonType::Array; }
+  bool is_object() const { return type_ == JsonType::Object; }
+
+  bool as_bool() const { return bool_; }
+  int64_t as_int() const {
+    return type_ == JsonType::Double ? (int64_t)dbl_ : int_;
+  }
+  double as_double() const {
+    return type_ == JsonType::Int ? (double)int_ : dbl_;
+  }
+  const std::string& as_string() const { return str_; }
+
+  // Array access.
+  JsonArray& items() { return arr_; }
+  const JsonArray& items() const { return arr_; }
+  void push_back(Json v) { arr_.push_back(std::move(v)); }
+  size_t size() const {
+    return type_ == JsonType::Array ? arr_.size() : members_.size();
+  }
+  Json& operator[](size_t i) { return arr_[i]; }
+  const Json& operator[](size_t i) const { return arr_[i]; }
+
+  // Object access (insertion-ordered).
+  std::vector<JsonMember>& members() { return members_; }
+  const std::vector<JsonMember>& members() const { return members_; }
+
+  bool contains(const std::string& key) const { return find(key) != nullptr; }
+
+  const Json* find(const std::string& key) const {
+    for (const auto& m : members_)
+      if (m.first == key) return &m.second;
+    return nullptr;
+  }
+  Json* find(const std::string& key) {
+    for (auto& m : members_)
+      if (m.first == key) return &m.second;
+    return nullptr;
+  }
+
+  Json& operator[](const std::string& key) {
+    if (type_ == JsonType::Null) type_ = JsonType::Object;
+    if (Json* v = find(key)) return *v;
+    members_.emplace_back(key, Json());
+    return members_.back().second;
+  }
+
+  // Path getters with defaults — the reconciler workhorses.
+  const Json& at(const std::string& key) const {
+    const Json* v = find(key);
+    if (!v) throw std::out_of_range("missing key: " + key);
+    return *v;
+  }
+  std::string get_string(const std::string& key,
+                         const std::string& def = "") const {
+    const Json* v = find(key);
+    return v && v->is_string() ? v->str_ : def;
+  }
+  int64_t get_int(const std::string& key, int64_t def = 0) const {
+    const Json* v = find(key);
+    return v && v->is_number() ? v->as_int() : def;
+  }
+  bool get_bool(const std::string& key, bool def = false) const {
+    const Json* v = find(key);
+    return v && v->is_bool() ? v->bool_ : def;
+  }
+
+  void erase(const std::string& key) {
+    for (auto it = members_.begin(); it != members_.end(); ++it)
+      if (it->first == key) {
+        members_.erase(it);
+        return;
+      }
+  }
+
+  bool operator==(const Json& o) const {
+    if (type_ != o.type_) {
+      if (is_number() && o.is_number()) return as_double() == o.as_double();
+      return false;
+    }
+    switch (type_) {
+      case JsonType::Null: return true;
+      case JsonType::Bool: return bool_ == o.bool_;
+      case JsonType::Int: return int_ == o.int_;
+      case JsonType::Double: return dbl_ == o.dbl_;
+      case JsonType::String: return str_ == o.str_;
+      case JsonType::Array: return arr_ == o.arr_;
+      case JsonType::Object: {
+        // Order-insensitive object equality (K8s semantic compare).
+        if (members_.size() != o.members_.size()) return false;
+        for (const auto& m : members_) {
+          const Json* v = o.find(m.first);
+          if (!v || !(*v == m.second)) return false;
+        }
+        return true;
+      }
+    }
+    return false;
+  }
+  bool operator!=(const Json& o) const { return !(*this == o); }
+
+  std::string dump(int indent = -1) const {
+    std::string out;
+    dump_to(out, indent, 0);
+    return out;
+  }
+
+  static Json parse(const std::string& text);
+
+ private:
+  void dump_to(std::string& out, int indent, int depth) const;
+
+  JsonType type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double dbl_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  std::vector<JsonMember> members_;
+};
+
+struct JsonParseError : std::runtime_error {
+  explicit JsonParseError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+}  // namespace kft

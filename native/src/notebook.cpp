@@ -1,0 +1,381 @@
+#include "notebook.hpp"
+
+#include <stdexcept>
+
+#include "topology.hpp"
+
+namespace kft {
+
+namespace {
+
+const char* kStopAnnotation = "kubeflow-resource-stopped";
+const char* kPodIndexLabel = "apps.kubernetes.io/pod-index";
+const int kNotebookPort = 8888;
+const int kCoordinatorPort = 8476;
+
+std::string meta_string(const Json& obj, const char* field) {
+  const Json* meta = obj.find("metadata");
+  return meta ? meta->get_string(field) : "";
+}
+
+bool has_annotation(const Json& obj, const std::string& key) {
+  const Json* meta = obj.find("metadata");
+  if (!meta) return false;
+  const Json* ann = meta->find("annotations");
+  return ann && ann->is_object() && ann->contains(key);
+}
+
+Json owner_reference(const Json& notebook) {
+  Json ref = Json::object();
+  ref["apiVersion"] = Json("kubeflow.org/v1beta1");
+  ref["kind"] = Json("Notebook");
+  ref["name"] = Json(meta_string(notebook, "name"));
+  const Json* meta = notebook.find("metadata");
+  if (meta && meta->contains("uid")) ref["uid"] = *meta->find("uid");
+  ref["controller"] = Json(true);
+  ref["blockOwnerDeletion"] = Json(true);
+  return ref;
+}
+
+Json make_meta(const std::string& name, const std::string& ns,
+               const Json& notebook) {
+  Json meta = Json::object();
+  meta["name"] = Json(name);
+  meta["namespace"] = Json(ns);
+  Json labels = Json::object();
+  labels["app"] = Json(meta_string(notebook, "name"));
+  labels["notebook-name"] = Json(meta_string(notebook, "name"));
+  meta["labels"] = labels;
+  Json owners = Json::array();
+  owners.push_back(owner_reference(notebook));
+  meta["ownerReferences"] = owners;
+  return meta;
+}
+
+void append_env(Json& container, const std::string& name, Json value_or_src) {
+  Json& env = container["env"];
+  if (!env.is_array()) env = Json::array();
+  // Controller-owned env wins: drop any user-provided duplicate.
+  JsonArray kept;
+  for (auto& e : env.items())
+    if (e.get_string("name") != name) kept.push_back(e);
+  env.items() = std::move(kept);
+  env.push_back(std::move(value_or_src));
+}
+
+Json env_value(const std::string& name, const std::string& value) {
+  Json e = Json::object();
+  e["name"] = Json(name);
+  e["value"] = Json(value);
+  return e;
+}
+
+Json env_pod_index(const std::string& name) {
+  Json e = Json::object();
+  e["name"] = Json(name);
+  Json field = Json::object();
+  field["fieldPath"] = Json(std::string("metadata.labels['") + kPodIndexLabel +
+                            "']");
+  Json src = Json::object();
+  src["fieldRef"] = field;
+  e["valueFrom"] = src;
+  return e;
+}
+
+std::string worker_hostnames(const std::string& name, const std::string& ns,
+                             int replicas) {
+  std::string svc = name + "-hosts";
+  std::string out;
+  for (int i = 0; i < replicas; ++i) {
+    if (i) out += ",";
+    out += name + "-" + std::to_string(i) + "." + svc + "." + ns + ".svc";
+  }
+  return out;
+}
+
+}  // namespace
+
+Json notebook_reconcile(const Json& notebook, const Json& options) {
+  const std::string name = meta_string(notebook, "name");
+  const std::string ns = meta_string(notebook, "namespace");
+  if (name.empty() || ns.empty())
+    throw std::runtime_error("notebook missing metadata.name/namespace");
+
+  const Json* spec = notebook.find("spec");
+  if (!spec) throw std::runtime_error("notebook missing spec");
+  const Json* tmpl = spec->find("template");
+
+  // TPU slice (the capability the reference lacks: replicas>1).
+  TpuSlice slice;
+  bool has_tpu = false;
+  if (const Json* tpu = spec->find("tpu")) {
+    if (tpu->is_object() && tpu->contains("accelerator")) {
+      slice = parse_tpu_slice(tpu->get_string("accelerator"),
+                              tpu->get_string("topology", "1x1"));
+      has_tpu = true;
+    }
+  }
+  const int replicas = has_tpu ? slice.num_hosts : 1;
+  const bool stopped = has_annotation(notebook, kStopAnnotation);
+
+  // ---- StatefulSet ----
+  Json sts = Json::object();
+  sts["apiVersion"] = Json("apps/v1");
+  sts["kind"] = Json("StatefulSet");
+  sts["metadata"] = make_meta(name, ns, notebook);
+
+  Json sts_spec = Json::object();
+  sts_spec["replicas"] = Json((int64_t)(stopped ? 0 : replicas));
+  sts_spec["serviceName"] = Json(name + "-hosts");
+  // Gang start: jax.distributed needs every host up before rank 0's
+  // coordinator barrier completes; OrderedReady would deadlock culled
+  // restarts behind unready peers.
+  sts_spec["podManagementPolicy"] = Json("Parallel");
+  Json selector = Json::object();
+  Json match = Json::object();
+  match["statefulset"] = Json(name);
+  selector["matchLabels"] = match;
+  sts_spec["selector"] = selector;
+
+  Json pod_template =
+      (tmpl && tmpl->is_object()) ? *tmpl : Json::object();
+  Json& ptmeta = pod_template["metadata"];
+  if (!ptmeta.is_object()) ptmeta = Json::object();
+  Json& ptlabels = ptmeta["labels"];
+  if (!ptlabels.is_object()) ptlabels = Json::object();
+  ptlabels["statefulset"] = Json(name);
+  ptlabels["notebook-name"] = Json(name);
+
+  Json& pod_spec = pod_template["spec"];
+  if (!pod_spec.is_object()) pod_spec = Json::object();
+  Json& containers = pod_spec["containers"];
+  if (!containers.is_array() || containers.size() == 0)
+    throw std::runtime_error("notebook template has no containers");
+  Json& nb_container = containers[0];
+
+  // Port 8888 contract (reference image contract: serve on 8888 under
+  // NB_PREFIX — reference example-notebook-servers/jupyter/s6/services.d/
+  // jupyterlab/run:18-29).
+  Json port = Json::object();
+  port["name"] = Json("notebook-port");
+  port["containerPort"] = Json((int64_t)kNotebookPort);
+  port["protocol"] = Json("TCP");
+  Json ports = Json::array();
+  ports.push_back(port);
+  nb_container["ports"] = ports;
+
+  append_env(nb_container, "NB_PREFIX",
+             env_value("NB_PREFIX", "/notebook/" + ns + "/" + name));
+
+  if (has_tpu) {
+    // Per-pod TPU chips; GKE's device plugin hands the pod its chips.
+    Json& res = nb_container["resources"];
+    if (!res.is_object()) res = Json::object();
+    Json& limits = res["limits"];
+    if (!limits.is_object()) limits = Json::object();
+    limits["google.com/tpu"] =
+        Json(std::to_string(slice.chips_per_replica));
+    Json& requests = res["requests"];
+    if (!requests.is_object()) requests = Json::object();
+    requests["google.com/tpu"] =
+        Json(std::to_string(slice.chips_per_replica));
+
+    Json& node_selector = pod_spec["nodeSelector"];
+    if (!node_selector.is_object()) node_selector = Json::object();
+    node_selector["cloud.google.com/gke-tpu-accelerator"] =
+        Json(slice.gke_accelerator);
+    node_selector["cloud.google.com/gke-tpu-topology"] = Json(slice.topology);
+
+    // jax.distributed wiring (kubeflow_tpu/parallel/distributed.py is the
+    // Python-side consumer of exactly these variables).
+    append_env(nb_container, "TPU_WORKER_ID", env_pod_index("TPU_WORKER_ID"));
+    append_env(nb_container, "KFT_NUM_PROCESSES",
+               env_value("KFT_NUM_PROCESSES", std::to_string(replicas)));
+    if (replicas > 1) {
+      append_env(nb_container, "TPU_WORKER_HOSTNAMES",
+                 env_value("TPU_WORKER_HOSTNAMES",
+                           worker_hostnames(name, ns, replicas)));
+      append_env(
+          nb_container, "KFT_COORDINATOR_ADDRESS",
+          env_value("KFT_COORDINATOR_ADDRESS",
+                    name + "-0." + name + "-hosts." + ns + ".svc:" +
+                        std::to_string(kCoordinatorPort)));
+    }
+  }
+
+  // fsGroup so the workspace PVC is writable by the notebook UID
+  // (reference notebook_controller.go:427-434, ADD_FSGROUP).
+  if (options.get_bool("addFsGroup", true)) {
+    Json& sec = pod_spec["securityContext"];
+    if (!sec.is_object()) sec = Json::object();
+    if (!sec.contains("fsGroup")) sec["fsGroup"] = Json((int64_t)100);
+  }
+
+  sts_spec["template"] = pod_template;
+  sts["spec"] = sts_spec;
+
+  // ---- Services ----
+  Json services = Json::array();
+
+  // Headless per-replica DNS for jax.distributed (publishNotReadyAddresses:
+  // the coordinator must resolve before readiness).
+  Json headless = Json::object();
+  headless["apiVersion"] = Json("v1");
+  headless["kind"] = Json("Service");
+  headless["metadata"] = make_meta(name + "-hosts", ns, notebook);
+  {
+    Json svc_spec = Json::object();
+    svc_spec["clusterIP"] = Json("None");
+    svc_spec["publishNotReadyAddresses"] = Json(true);
+    Json sel = Json::object();
+    sel["statefulset"] = Json(name);
+    svc_spec["selector"] = sel;
+    Json p = Json::object();
+    p["name"] = Json("notebook-port");
+    p["port"] = Json((int64_t)kNotebookPort);
+    p["targetPort"] = Json((int64_t)kNotebookPort);
+    Json ps = Json::array();
+    ps.push_back(p);
+    svc_spec["ports"] = ps;
+    headless["spec"] = svc_spec;
+  }
+  services.push_back(headless);
+
+  // HTTP front service; multi-host pins to rank 0 (the Jupyter server the
+  // user talks to) via the pod-index label.
+  Json http_svc = Json::object();
+  http_svc["apiVersion"] = Json("v1");
+  http_svc["kind"] = Json("Service");
+  http_svc["metadata"] = make_meta(name, ns, notebook);
+  {
+    Json svc_spec = Json::object();
+    svc_spec["type"] = Json("ClusterIP");
+    Json sel = Json::object();
+    sel["statefulset"] = Json(name);
+    if (replicas > 1) sel[kPodIndexLabel] = Json("0");
+    svc_spec["selector"] = sel;
+    Json p = Json::object();
+    // Port 80 -> 8888, name prefixed "http-" for Istio protocol selection
+    // (reference notebook_controller.go:453-461).
+    p["name"] = Json("http-" + name);
+    p["port"] = Json((int64_t)80);
+    p["targetPort"] = Json((int64_t)kNotebookPort);
+    p["protocol"] = Json("TCP");
+    Json ps = Json::array();
+    ps.push_back(p);
+    svc_spec["ports"] = ps;
+    http_svc["spec"] = svc_spec;
+  }
+  services.push_back(http_svc);
+
+  Json out = Json::object();
+  out["statefulset"] = sts;
+  out["services"] = services;
+
+  // ---- Istio VirtualService ----
+  if (options.get_bool("useIstio", false)) {
+    const std::string domain =
+        options.get_string("clusterDomain", "cluster.local");
+    const std::string prefix = "/notebook/" + ns + "/" + name + "/";
+    Json vs = Json::object();
+    vs["apiVersion"] = Json("networking.istio.io/v1");
+    vs["kind"] = Json("VirtualService");
+    vs["metadata"] = make_meta("notebook-" + ns + "-" + name, ns, notebook);
+    Json vs_spec = Json::object();
+    Json hosts = Json::array();
+    hosts.push_back(Json(options.get_string("istioHost", "*")));
+    vs_spec["hosts"] = hosts;
+    Json gateways = Json::array();
+    gateways.push_back(
+        Json(options.get_string("istioGateway", "kubeflow/kubeflow-gateway")));
+    vs_spec["gateways"] = gateways;
+
+    Json http = Json::object();
+    Json match = Json::object();
+    Json uri = Json::object();
+    Json pfx = Json::object();
+    pfx["prefix"] = Json(prefix);
+    uri["uri"] = pfx;
+    Json matches = Json::array();
+    matches.push_back(uri);
+    http["match"] = matches;
+    Json rewrite = Json::object();
+    rewrite["uri"] = Json("/notebook/" + ns + "/" + name + "/");
+    http["rewrite"] = rewrite;
+    Json dest = Json::object();
+    Json destination = Json::object();
+    destination["host"] = Json(name + "." + ns + ".svc." + domain);
+    Json dport = Json::object();
+    dport["number"] = Json((int64_t)80);
+    destination["port"] = dport;
+    dest["destination"] = destination;
+    Json route = Json::array();
+    route.push_back(dest);
+    http["route"] = route;
+    // Per-notebook extra request headers (reference reads the
+    // "notebooks.kubeflow.org/http-headers-request-set" annotation,
+    // notebook_controller.go:471-571).
+    if (const Json* meta = notebook.find("metadata")) {
+      if (const Json* ann = meta->find("annotations")) {
+        if (ann->is_object()) {
+          const Json* hdr =
+              ann->find("notebooks.kubeflow.org/http-headers-request-set");
+          if (hdr && hdr->is_string()) {
+            Json set = Json::parse(hdr->as_string());
+            Json request = Json::object();
+            request["set"] = set;
+            Json headers = Json::object();
+            headers["request"] = request;
+            http["headers"] = headers;
+          }
+        }
+      }
+    }
+    Json https = Json::array();
+    https.push_back(http);
+    vs_spec["http"] = https;
+    vs["spec"] = vs_spec;
+    out["virtualService"] = vs;
+  } else {
+    out["virtualService"] = Json(nullptr);
+  }
+  return out;
+}
+
+Json notebook_status(const Json& /*notebook*/, const Json& sts, const Json& pod,
+                     const Json& events) {
+  Json status = Json::object();
+  int64_t ready = 0;
+  if (const Json* s = sts.find("status"))
+    ready = s->get_int("readyReplicas", 0);
+  status["readyReplicas"] = Json(ready);
+
+  // Mirror the first container's state of the rank-0 pod (reference
+  // createNotebookStatus, notebook_controller.go:243-302).
+  Json container_state = Json::object();
+  Json conditions = Json::array();
+  if (const Json* pst = pod.find("status")) {
+    if (const Json* css = pst->find("containerStatuses")) {
+      if (css->is_array() && css->size() > 0) {
+        const Json* state = (*css)[0].find("state");
+        if (state) container_state = *state;
+      }
+    }
+    if (const Json* pconds = pst->find("conditions")) {
+      if (pconds->is_array())
+        for (const auto& c : pconds->items()) conditions.push_back(c);
+    }
+  }
+  status["containerState"] = container_state;
+  status["conditions"] = conditions;
+
+  if (events.is_array()) {
+    Json warnings = Json::array();
+    for (const auto& e : events.items())
+      if (e.get_string("type") == "Warning") warnings.push_back(e);
+    status["warningEvents"] = warnings;
+  }
+  return status;
+}
+
+}  // namespace kft

@@ -1,0 +1,32 @@
+// Notebook reconciler core: desired-state generation + status derivation.
+//
+// Capability parity with the reference notebook-controller
+// (reference components/notebook-controller/controllers/notebook_controller.go:
+// generateStatefulSet :361-436, generateService :438-465,
+// generateVirtualService :471-571, createNotebookStatus :243-302), built
+// TPU-native:
+//   - spec.tpu{accelerator,topology} => replicas = slice hosts (the
+//     reference hardcodes replicas=1), google.com/tpu limits, GKE
+//     topology nodeSelectors, podManagementPolicy=Parallel (gang start
+//     for jax.distributed), TPU_WORKER_ID from the pod-index label, and
+//     coordinator/hostnames env for jax.distributed.initialize().
+//   - a headless "<name>-hosts" Service gives each replica stable DNS; the
+//     ClusterIP "<name>" Service fronts HTTP and pins to pod-index 0
+//     (rank-0-only routing for multi-host).
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// options: {"useIstio", "istioGateway", "istioHost", "clusterDomain",
+//           "addFsGroup"} — mirrors the reference controller's env config.
+// Returns {"statefulset":…, "services":[…], "virtualService":…|null}.
+Json notebook_reconcile(const Json& notebook, const Json& options);
+
+// Derives Notebook status from the owned StatefulSet + rank-0 Pod +
+// warning events: {"readyReplicas", "containerState", "conditions": […]}.
+Json notebook_status(const Json& notebook, const Json& sts, const Json& pod,
+                     const Json& events);
+
+}  // namespace kft

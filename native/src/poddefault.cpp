@@ -1,0 +1,335 @@
+#include "poddefault.hpp"
+
+#include <functional>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace kft {
+
+namespace {
+
+const char* kAnnotationPrefix = "poddefault.admission.kubeflow.org/";
+
+std::string pd_name(const Json& pd) {
+  const Json* meta = pd.find("metadata");
+  return meta ? meta->get_string("name") : "";
+}
+
+// ---- conflict-checked list merges ----------------------------------------
+// Each merger appends `msg` to `conflicts` instead of mutating when
+// check_only; identical duplicates are always tolerated (idempotent
+// re-admission of an already-mutated pod must be a no-op).
+
+void merge_keyed_list(Json& target, const Json& additions,
+                      const std::string& key_field,
+                      const std::string& what, const std::string& source,
+                      std::vector<std::string>& conflicts, bool check_only) {
+  if (!additions.is_array()) return;
+  if (!target.is_array()) target = Json::array();
+  for (const auto& add : additions.items()) {
+    const std::string key = add.get_string(key_field);
+    const Json* existing = nullptr;
+    for (const auto& cur : target.items())
+      if (cur.get_string(key_field) == key) existing = &cur;
+    if (existing) {
+      if (*existing != add)
+        conflicts.push_back("conflict on " + what + " '" + key +
+                            "' from poddefault '" + source + "'");
+      continue;  // identical duplicate: skip
+    }
+    if (!check_only) target.push_back(add);
+  }
+}
+
+void merge_volume_mounts(Json& target, const Json& additions,
+                         const std::string& source,
+                         std::vector<std::string>& conflicts,
+                         bool check_only) {
+  if (!additions.is_array()) return;
+  if (!target.is_array()) target = Json::array();
+  for (const auto& add : additions.items()) {
+    const std::string path = add.get_string("mountPath");
+    const Json* existing = nullptr;
+    for (const auto& cur : target.items())
+      if (cur.get_string("mountPath") == path) existing = &cur;
+    if (existing) {
+      if (*existing != add)
+        conflicts.push_back("conflict on volumeMount path '" + path +
+                            "' from poddefault '" + source + "'");
+      continue;
+    }
+    if (!check_only) target.push_back(add);
+  }
+}
+
+void merge_unkeyed_list(Json& target, const Json& additions, bool check_only) {
+  // tolerations / envFrom / imagePullSecrets: append when not identical to
+  // an existing entry (no key to conflict on).
+  if (!additions.is_array()) return;
+  if (!target.is_array()) target = Json::array();
+  for (const auto& add : additions.items()) {
+    bool present = false;
+    for (const auto& cur : target.items())
+      if (cur == add) present = true;
+    if (!present && !check_only) target.push_back(add);
+  }
+}
+
+void merge_string_map(Json& target, const Json& additions,
+                      const std::string& what, const std::string& source,
+                      std::vector<std::string>& conflicts, bool check_only) {
+  if (!additions.is_object()) return;
+  if (!target.is_object()) target = Json::object();
+  for (const auto& m : additions.members()) {
+    const Json* cur = target.find(m.first);
+    if (cur) {
+      if (*cur != m.second)
+        conflicts.push_back("conflict on " + what + " '" + m.first +
+                            "' from poddefault '" + source + "'");
+      continue;
+    }
+    if (!check_only) target[m.first] = m.second;
+  }
+}
+
+// Applies one PodDefault onto the pod (or only records conflicts).
+void apply_one(Json& pod, const Json& pd, std::vector<std::string>& conflicts,
+               bool check_only) {
+  const std::string source = pd_name(pd);
+  const Json* spec = pd.find("spec");
+  if (!spec || !spec->is_object()) return;
+  Json& pod_spec = pod["spec"];
+  if (!pod_spec.is_object()) pod_spec = Json::object();
+
+  // Per-container merges: env/envFrom/volumeMounts hit every container
+  // (and initContainers), matching the reference webhook.
+  auto merge_into_containers = [&](Json& containers) {
+    if (!containers.is_array()) return;
+    for (auto& c : containers.items()) {
+      if (const Json* env = spec->find("env"))
+        merge_keyed_list(c["env"], *env, "name", "env", source, conflicts,
+                         check_only);
+      if (const Json* env_from = spec->find("envFrom"))
+        merge_unkeyed_list(c["envFrom"], *env_from, check_only);
+      if (const Json* vm = spec->find("volumeMounts"))
+        merge_volume_mounts(c["volumeMounts"], *vm, source, conflicts,
+                            check_only);
+      if (const Json* cmd = spec->find("command")) {
+        if (!c.contains("command") && !check_only) c["command"] = *cmd;
+      }
+      if (const Json* args = spec->find("args")) {
+        if (!c.contains("args") && !check_only) c["args"] = *args;
+      }
+    }
+  };
+  merge_into_containers(pod_spec["containers"]);
+  if (Json* init = pod_spec.find("initContainers"))
+    merge_into_containers(*init);
+
+  if (const Json* vols = spec->find("volumes"))
+    merge_keyed_list(pod_spec["volumes"], *vols, "name", "volume", source,
+                     conflicts, check_only);
+  if (const Json* tols = spec->find("tolerations"))
+    merge_unkeyed_list(pod_spec["tolerations"], *tols, check_only);
+  if (const Json* ips = spec->find("imagePullSecrets"))
+    merge_unkeyed_list(pod_spec["imagePullSecrets"], *ips, check_only);
+  if (const Json* init = spec->find("initContainers"))
+    merge_keyed_list(pod_spec["initContainers"], *init, "name",
+                     "initContainer", source, conflicts, check_only);
+  if (const Json* sidecars = spec->find("sidecars"))
+    merge_keyed_list(pod_spec["containers"], *sidecars, "name", "sidecar",
+                     source, conflicts, check_only);
+
+  if (const Json* sa = spec->find("serviceAccountName")) {
+    if (sa->is_string()) {
+      const std::string cur = pod_spec.get_string("serviceAccountName");
+      if (!cur.empty() && cur != sa->as_string() && cur != "default")
+        conflicts.push_back("conflict on serviceAccountName from poddefault '" +
+                            source + "'");
+      else if (!check_only)
+        pod_spec["serviceAccountName"] = *sa;
+    }
+  }
+  if (const Json* automount = spec->find("automountServiceAccountToken")) {
+    if (!check_only) pod_spec["automountServiceAccountToken"] = *automount;
+  }
+
+  Json& meta = pod["metadata"];
+  if (!meta.is_object()) meta = Json::object();
+  if (const Json* labels = spec->find("labels"))
+    merge_string_map(meta["labels"], *labels, "label", source, conflicts,
+                     check_only);
+  if (const Json* ann = spec->find("annotations"))
+    merge_string_map(meta["annotations"], *ann, "annotation", source,
+                     conflicts, check_only);
+
+  if (!check_only) {
+    // Stamp which PodDefault revision touched this pod (reference
+    // main.go:590-593) — the UI shows it, and idempotency checks use it.
+    Json& anns = meta["annotations"];
+    if (!anns.is_object()) anns = Json::object();
+    std::string rv;
+    if (const Json* pmeta = pd.find("metadata"))
+      rv = pmeta->get_string("resourceVersion", "0");
+    anns[std::string(kAnnotationPrefix) + "poddefault-" + source] = Json(rv);
+  }
+}
+
+}  // namespace
+
+bool selector_matches(const Json& selector, const Json& labels) {
+  if (!selector.is_object()) return false;
+  if (const Json* match = selector.find("matchLabels")) {
+    if (match->is_object()) {
+      for (const auto& m : match->members()) {
+        const Json* v = labels.is_object() ? labels.find(m.first) : nullptr;
+        if (!v || *v != m.second) return false;
+      }
+    }
+  }
+  if (const Json* exprs = selector.find("matchExpressions")) {
+    if (exprs->is_array()) {
+      for (const auto& e : exprs->items()) {
+        const std::string key = e.get_string("key");
+        const std::string op = e.get_string("operator");
+        const Json* v = labels.is_object() ? labels.find(key) : nullptr;
+        std::set<std::string> values;
+        if (const Json* vals = e.find("values"))
+          if (vals->is_array())
+            for (const auto& val : vals->items())
+              if (val.is_string()) values.insert(val.as_string());
+        if (op == "Exists") {
+          if (!v) return false;
+        } else if (op == "DoesNotExist") {
+          if (v) return false;
+        } else if (op == "In") {
+          if (!v || !v->is_string() || !values.count(v->as_string()))
+            return false;
+        } else if (op == "NotIn") {
+          if (v && v->is_string() && values.count(v->as_string()))
+            return false;
+        } else {
+          return false;  // unknown operator: fail closed
+        }
+      }
+    }
+  }
+  return true;
+}
+
+Json json_patch_diff(const Json& original, const Json& mutated) {
+  Json ops = Json::array();
+  std::function<void(const Json&, const Json&, const std::string&)> walk =
+      [&](const Json& a, const Json& b, const std::string& path) {
+        if (a == b) return;
+        if (a.is_object() && b.is_object()) {
+          for (const auto& m : a.members()) {
+            std::string escaped = m.first;
+            // RFC 6901 escaping.
+            std::string out;
+            for (char c : escaped) {
+              if (c == '~') out += "~0";
+              else if (c == '/') out += "~1";
+              else out += c;
+            }
+            const Json* bv = b.find(m.first);
+            if (!bv) {
+              Json op = Json::object();
+              op["op"] = Json("remove");
+              op["path"] = Json(path + "/" + out);
+              ops.push_back(op);
+            } else {
+              walk(m.second, *bv, path + "/" + out);
+            }
+          }
+          for (const auto& m : b.members()) {
+            if (a.find(m.first)) continue;
+            std::string out;
+            for (char c : m.first) {
+              if (c == '~') out += "~0";
+              else if (c == '/') out += "~1";
+              else out += c;
+            }
+            Json op = Json::object();
+            op["op"] = Json("add");
+            op["path"] = Json(path + "/" + out);
+            op["value"] = m.second;
+            ops.push_back(op);
+          }
+          return;
+        }
+        Json op = Json::object();
+        op["op"] = Json("replace");
+        op["path"] = Json(path.empty() ? "" : path);
+        op["value"] = b;
+        ops.push_back(op);
+      };
+  walk(original, mutated, "");
+  return ops;
+}
+
+Json poddefault_mutate(const Json& pod, const Json& poddefaults) {
+  Json result = Json::object();
+  Json matched_names = Json::array();
+  std::vector<const Json*> matched;
+
+  // Exclusion escape hatch (reference main.go:664-673).
+  bool excluded = false;
+  if (const Json* meta = pod.find("metadata")) {
+    if (const Json* ann = meta->find("annotations")) {
+      if (ann->is_object()) {
+        const Json* ex =
+            ann->find(std::string(kAnnotationPrefix) + "exclude");
+        excluded = ex && ((ex->is_string() && ex->as_string() == "true") ||
+                          (ex->is_bool() && ex->as_bool()));
+      }
+    }
+  }
+
+  const Json* labels = nullptr;
+  if (const Json* meta = pod.find("metadata")) labels = meta->find("labels");
+  Json empty_labels = Json::object();
+  if (!labels) labels = &empty_labels;
+
+  if (!excluded && poddefaults.is_array()) {
+    for (const auto& pd : poddefaults.items()) {
+      const Json* spec = pd.find("spec");
+      if (!spec) continue;
+      const Json* selector = spec->find("selector");
+      if (selector && selector_matches(*selector, *labels)) {
+        matched.push_back(&pd);
+        matched_names.push_back(Json(pd_name(pd)));
+      }
+    }
+  }
+
+  result["matched"] = matched_names;
+  std::vector<std::string> conflicts;
+
+  // Pass 1: check-only across ALL matched poddefaults on a scratch copy —
+  // aggregate every conflict before touching anything (reference
+  // safeToApplyPodDefaultsOnPod).
+  Json scratch = pod;
+  for (const Json* pd : matched) apply_one(scratch, *pd, conflicts, false);
+  // (apply for real onto the scratch so cross-poddefault conflicts between
+  // two *new* values are caught; pod itself is still untouched.)
+
+  Json conflict_list = Json::array();
+  for (const auto& c : conflicts) conflict_list.push_back(Json(c));
+  result["conflicts"] = conflict_list;
+
+  if (!conflicts.empty() || matched.empty()) {
+    result["applied"] = Json(false);
+    result["pod"] = pod;
+    result["patch"] = Json::array();
+    return result;
+  }
+
+  result["applied"] = Json(true);
+  result["pod"] = scratch;
+  result["patch"] = json_patch_diff(pod, scratch);
+  return result;
+}
+
+}  // namespace kft

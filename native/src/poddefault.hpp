@@ -1,0 +1,34 @@
+// PodDefault mutation engine (admission webhook core).
+//
+// Capability parity with the reference admission-webhook
+// (reference components/admission-webhook/main.go: filterPodDefaults :72-97,
+// safeToApplyPodDefaultsOnPod :101-150, applyPodDefaultsOnPod :518-594,
+// merge fns :170-513), conflict semantics preserved: every merge runs in
+// check mode across all selected PodDefaults first; any conflict rejects
+// the whole mutation (the pod is created unmodified only if the webhook
+// reports the error — failurePolicy decides).
+//
+// This is the platform's TPU-env injection point: a "tpu-env" PodDefault
+// shipped with the platform injects libtpu mounts and jax.distributed env
+// into every notebook pod selecting it.
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// pod: a v1.Pod; poddefaults: array of PodDefault CRs (already namespaced).
+// Returns {"matched":[names], "applied":bool, "conflicts":[msgs],
+//          "pod": mutated pod, "patch": RFC6902 ops original->mutated}.
+// On conflicts, "pod" is the original and "patch" is empty.
+Json poddefault_mutate(const Json& pod, const Json& poddefaults);
+
+// True when the pod's labels satisfy the PodDefault's spec.selector
+// (matchLabels + matchExpressions In/NotIn/Exists/DoesNotExist).
+bool selector_matches(const Json& selector, const Json& labels);
+
+// RFC 6902 diff (objects descend; arrays replace wholesale — valid and
+// deterministic, which is what admission review needs).
+Json json_patch_diff(const Json& original, const Json& mutated);
+
+}  // namespace kft

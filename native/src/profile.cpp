@@ -1,0 +1,145 @@
+#include "profile.hpp"
+
+#include <stdexcept>
+
+namespace kft {
+
+namespace {
+
+Json owner_ref(const Json& profile) {
+  Json ref = Json::object();
+  ref["apiVersion"] = Json("kubeflow.org/v1");
+  ref["kind"] = Json("Profile");
+  const Json* meta = profile.find("metadata");
+  ref["name"] = Json(meta ? meta->get_string("name") : "");
+  if (meta && meta->contains("uid")) ref["uid"] = *meta->find("uid");
+  ref["controller"] = Json(true);
+  return ref;
+}
+
+Json meta_for(const std::string& name, const std::string& ns,
+              const Json& profile) {
+  Json meta = Json::object();
+  meta["name"] = Json(name);
+  if (!ns.empty()) meta["namespace"] = Json(ns);
+  Json owners = Json::array();
+  owners.push_back(owner_ref(profile));
+  meta["ownerReferences"] = owners;
+  return meta;
+}
+
+}  // namespace
+
+Json profile_reconcile(const Json& profile, const Json& options) {
+  const Json* meta = profile.find("metadata");
+  const std::string name = meta ? meta->get_string("name") : "";
+  if (name.empty()) throw std::runtime_error("profile missing metadata.name");
+  const Json* spec = profile.find("spec");
+  if (!spec) throw std::runtime_error("profile missing spec");
+  const Json* owner = spec->find("owner");
+  const std::string owner_kind =
+      owner ? owner->get_string("kind", "User") : "User";
+  const std::string owner_name = owner ? owner->get_string("name") : "";
+
+  Json out = Json::object();
+
+  // ---- Namespace ----
+  Json ns = Json::object();
+  ns["apiVersion"] = Json("v1");
+  ns["kind"] = Json("Namespace");
+  Json ns_meta = meta_for(name, "", profile);
+  Json labels = Json::object();
+  // Default labels (reference reconciles from a hot-reloaded labels file,
+  // profile_controller.go:370-425; here they come via options).
+  labels["istio-injection"] = Json("enabled");
+  labels["app.kubernetes.io/part-of"] = Json("kubeflow-profile");
+  labels["app.kubernetes.io/metadata.name"] = Json(name);
+  if (const Json* extra = options.find("namespaceLabels")) {
+    if (extra->is_object())
+      for (const auto& m : extra->members()) labels[m.first] = m.second;
+  }
+  ns_meta["labels"] = labels;
+  Json ns_ann = Json::object();
+  ns_ann["owner"] = Json(owner_name);
+  ns_meta["annotations"] = ns_ann;
+  ns["metadata"] = ns_meta;
+  out["namespace"] = ns;
+
+  // ---- ServiceAccounts ----
+  Json sas = Json::array();
+  for (const char* sa_name : {"default-editor", "default-viewer"}) {
+    Json sa = Json::object();
+    sa["apiVersion"] = Json("v1");
+    sa["kind"] = Json("ServiceAccount");
+    sa["metadata"] = meta_for(sa_name, name, profile);
+    sas.push_back(sa);
+  }
+  out["serviceAccounts"] = sas;
+
+  // ---- Owner RoleBinding ----
+  Json rb = Json::object();
+  rb["apiVersion"] = Json("rbac.authorization.k8s.io/v1");
+  rb["kind"] = Json("RoleBinding");
+  Json rb_meta = meta_for("namespaceAdmin", name, profile);
+  Json rb_ann = Json::object();
+  rb_ann["role"] = Json("admin");
+  rb_ann["user"] = Json(owner_name);
+  rb_meta["annotations"] = rb_ann;
+  rb["metadata"] = rb_meta;
+  Json role_ref = Json::object();
+  role_ref["apiGroup"] = Json("rbac.authorization.k8s.io");
+  role_ref["kind"] = Json("ClusterRole");
+  role_ref["name"] = Json("kubeflow-admin");
+  rb["roleRef"] = role_ref;
+  Json subject = Json::object();
+  subject["apiGroup"] = Json("rbac.authorization.k8s.io");
+  subject["kind"] = Json(owner_kind);
+  subject["name"] = Json(owner_name);
+  Json subjects = Json::array();
+  subjects.push_back(subject);
+  rb["subjects"] = subjects;
+  out["roleBinding"] = rb;
+
+  // ---- Istio AuthorizationPolicy (owner access via userid header) ----
+  Json ap = Json::object();
+  ap["apiVersion"] = Json("security.istio.io/v1");
+  ap["kind"] = Json("AuthorizationPolicy");
+  ap["metadata"] = meta_for("ns-owner-access-istio", name, profile);
+  Json ap_spec = Json::object();
+  Json rule = Json::object();
+  Json when = Json::object();
+  when["key"] = Json("request.headers[" +
+                     options.get_string("userIdHeader", "kubeflow-userid") +
+                     "]");
+  Json values = Json::array();
+  values.push_back(
+      Json(options.get_string("userIdPrefix", "") + owner_name));
+  when["values"] = values;
+  Json whens = Json::array();
+  whens.push_back(when);
+  rule["when"] = whens;
+  Json rules = Json::array();
+  rules.push_back(rule);
+  ap_spec["rules"] = rules;
+  ap["spec"] = ap_spec;
+  out["authorizationPolicy"] = ap;
+
+  // ---- ResourceQuota (google.com/tpu-aware) ----
+  if (const Json* quota = spec->find("resourceQuotaSpec")) {
+    if (quota->is_object() && quota->size() > 0) {
+      Json rq = Json::object();
+      rq["apiVersion"] = Json("v1");
+      rq["kind"] = Json("ResourceQuota");
+      rq["metadata"] = meta_for("kf-resource-quota", name, profile);
+      rq["spec"] = *quota;
+      out["resourceQuota"] = rq;
+    } else {
+      out["resourceQuota"] = Json(nullptr);
+    }
+  } else {
+    out["resourceQuota"] = Json(nullptr);
+  }
+  return out;
+}
+
+}  // namespace kft

@@ -1,0 +1,23 @@
+// Profile reconciler core: multi-tenant namespace materialisation.
+//
+// Capability parity with the reference profile-controller (reference
+// components/profile-controller/controllers/profile_controller.go:
+// Reconcile :105-336, updateIstioAuthorizationPolicy :509,
+// updateServiceAccount :592): a cluster-scoped Profile becomes a
+// Namespace (istio-injection + default labels), ServiceAccounts
+// default-editor/default-viewer, the owner RoleBinding, an Istio
+// AuthorizationPolicy, and an optional ResourceQuota. TPU delta: quota
+// speaks google.com/tpu so admins cap chips per tenant.
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// profile: Profile CR {spec:{owner:{kind,name}, resourceQuotaSpec?}}.
+// options: {"userIdHeader","userIdPrefix","namespaceLabels":{...}}.
+// Returns {"namespace":…, "serviceAccounts":[…], "roleBinding":…,
+//          "authorizationPolicy":…, "resourceQuota":…|null}.
+Json profile_reconcile(const Json& profile, const Json& options);
+
+}  // namespace kft

@@ -1,0 +1,97 @@
+#include "reconcile.hpp"
+
+#include <stdexcept>
+#include <vector>
+
+namespace kft {
+
+namespace {
+
+// Copies desired[field-path] over merged[field-path]; returns true when the
+// value actually differed (semantic compare).
+bool copy_field(Json& merged, const Json& desired,
+                const std::vector<std::string>& path) {
+  const Json* want = &desired;
+  for (const auto& key : path) {
+    if (!want->is_object()) return false;
+    want = want->find(key);
+    if (!want) return false;
+  }
+  Json* dst = &merged;
+  for (size_t i = 0; i + 1 < path.size(); ++i)
+    dst = &(*dst)[path[i]];
+  Json& slot = (*dst)[path.back()];
+  if (slot == *want) return false;
+  slot = *want;
+  return true;
+}
+
+bool copy_labels_annotations(Json& merged, const Json& desired) {
+  bool changed = false;
+  changed |= copy_field(merged, desired, {"metadata", "labels"});
+  changed |= copy_field(merged, desired, {"metadata", "annotations"});
+  return changed;
+}
+
+}  // namespace
+
+Json copy_owned_fields(const std::string& kind, const Json& existing,
+                       const Json& desired) {
+  Json merged = existing;
+  bool changed = false;
+
+  if (kind == "StatefulSet" || kind == "Deployment") {
+    changed |= copy_field(merged, desired, {"spec", "replicas"});
+    changed |= copy_field(merged, desired, {"spec", "template"});
+    changed |= copy_labels_annotations(merged, desired);
+  } else if (kind == "Service") {
+    // Never touch clusterIP (immutable, cluster-assigned).
+    changed |= copy_field(merged, desired, {"spec", "ports"});
+    changed |= copy_field(merged, desired, {"spec", "selector"});
+    changed |= copy_field(merged, desired, {"spec", "type"});
+    changed |= copy_labels_annotations(merged, desired);
+  } else if (kind == "VirtualService" || kind == "AuthorizationPolicy") {
+    changed |= copy_field(merged, desired, {"spec"});
+    changed |= copy_labels_annotations(merged, desired);
+  } else if (kind == "Namespace") {
+    // Owned labels/annotations are merged additively: other controllers
+    // (e.g. Istio) also stamp namespaces.
+    const Json* want_meta = desired.find("metadata");
+    if (want_meta) {
+      Json& meta = merged["metadata"];
+      if (!meta.is_object()) meta = Json::object();
+      for (const char* field : {"labels", "annotations"}) {
+        if (const Json* want = want_meta->find(field)) {
+          if (want->is_object()) {
+            Json& dst = meta[field];
+            if (!dst.is_object()) dst = Json::object();
+            for (const auto& m : want->members()) {
+              const Json* cur = dst.find(m.first);
+              if (!cur || *cur != m.second) {
+                dst[m.first] = m.second;
+                changed = true;
+              }
+            }
+          }
+        }
+      }
+    }
+  } else if (kind == "ResourceQuota") {
+    changed |= copy_field(merged, desired, {"spec"});
+  } else if (kind == "RoleBinding") {
+    changed |= copy_field(merged, desired, {"roleRef"});
+    changed |= copy_field(merged, desired, {"subjects"});
+  } else if (kind == "ServiceAccount") {
+    changed |= copy_labels_annotations(merged, desired);
+  } else {
+    throw std::runtime_error("copy_owned_fields: unsupported kind '" + kind +
+                             "'");
+  }
+
+  Json out = Json::object();
+  out["changed"] = Json(changed);
+  out["merged"] = merged;
+  return out;
+}
+
+}  // namespace kft

@@ -1,0 +1,20 @@
+// Create-or-update drift repair: copy controller-owned fields from the
+// desired object onto the live one and report whether an update is needed.
+// Capability parity with the reference common/reconcilehelper
+// (reference components/common/reconcilehelper/util.go:18-101 +
+// CopyStatefulSetFields :105+): level-based reconciliation re-asserts only
+// the owned fields, preserving cluster-managed ones (clusterIP, replicas
+// drift from autoscalers it doesn't own, status, defaulted fields).
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// kind: StatefulSet | Deployment | Service | VirtualService | Namespace |
+// ResourceQuota | RoleBinding | ServiceAccount | AuthorizationPolicy.
+// Returns {"changed": bool, "merged": object-to-write}.
+Json copy_owned_fields(const std::string& kind, const Json& existing,
+                       const Json& desired);
+
+}  // namespace kft

@@ -1,0 +1,348 @@
+#include "tensorboard.hpp"
+
+#include <stdexcept>
+
+namespace kft {
+
+namespace {
+
+std::string meta_string(const Json& obj, const char* field) {
+  const Json* meta = obj.find("metadata");
+  return meta ? meta->get_string(field) : "";
+}
+
+Json owner_ref(const Json& cr, const std::string& api_version,
+               const std::string& kind) {
+  Json ref = Json::object();
+  ref["apiVersion"] = Json(api_version);
+  ref["kind"] = Json(kind);
+  ref["name"] = Json(meta_string(cr, "name"));
+  const Json* meta = cr.find("metadata");
+  if (meta && meta->contains("uid")) ref["uid"] = *meta->find("uid");
+  ref["controller"] = Json(true);
+  return ref;
+}
+
+Json meta_for(const Json& cr, const std::string& api_version,
+              const std::string& kind, const std::string& name,
+              const std::string& ns, const std::string& app_label) {
+  Json meta = Json::object();
+  meta["name"] = Json(name);
+  meta["namespace"] = Json(ns);
+  Json labels = Json::object();
+  labels["app"] = Json(app_label);
+  meta["labels"] = labels;
+  Json owners = Json::array();
+  owners.push_back(owner_ref(cr, api_version, kind));
+  meta["ownerReferences"] = owners;
+  return meta;
+}
+
+Json virtual_service(const Json& cr, const std::string& api_version,
+                     const std::string& kind, const std::string& name,
+                     const std::string& ns, const std::string& prefix,
+                     const std::string& rewrite, int port,
+                     const Json& options) {
+  Json vs = Json::object();
+  vs["apiVersion"] = Json("networking.istio.io/v1");
+  vs["kind"] = Json("VirtualService");
+  vs["metadata"] = meta_for(cr, api_version, kind, kind == "Tensorboard"
+                                ? "tensorboard-" + ns + "-" + name
+                                : name,
+                            ns, name);
+  Json spec = Json::object();
+  Json hosts = Json::array();
+  hosts.push_back(Json(options.get_string("istioHost", "*")));
+  spec["hosts"] = hosts;
+  Json gateways = Json::array();
+  gateways.push_back(
+      Json(options.get_string("istioGateway", "kubeflow/kubeflow-gateway")));
+  spec["gateways"] = gateways;
+  Json http = Json::object();
+  Json uri = Json::object();
+  Json pfx = Json::object();
+  pfx["prefix"] = Json(prefix);
+  uri["uri"] = pfx;
+  Json matches = Json::array();
+  matches.push_back(uri);
+  http["match"] = matches;
+  Json rw = Json::object();
+  rw["uri"] = Json(rewrite);
+  http["rewrite"] = rw;
+  Json destination = Json::object();
+  destination["host"] =
+      Json(name + "." + ns + ".svc." +
+           options.get_string("clusterDomain", "cluster.local"));
+  Json dport = Json::object();
+  dport["number"] = Json((int64_t)port);
+  destination["port"] = dport;
+  Json route_entry = Json::object();
+  route_entry["destination"] = destination;
+  Json route = Json::array();
+  route.push_back(route_entry);
+  http["route"] = route;
+  Json https = Json::array();
+  https.push_back(http);
+  spec["http"] = https;
+  vs["spec"] = spec;
+  return vs;
+}
+
+Json node_affinity_for(const std::string& node) {
+  // Pin onto the node already mounting the RWO PVC (reference
+  // tensorboard_controller.go generateNodeAffinity :428).
+  Json term = Json::object();
+  Json expr = Json::object();
+  expr["key"] = Json("kubernetes.io/hostname");
+  expr["operator"] = Json("In");
+  Json vals = Json::array();
+  vals.push_back(Json(node));
+  expr["values"] = vals;
+  Json exprs = Json::array();
+  exprs.push_back(expr);
+  term["matchExpressions"] = exprs;
+  Json terms = Json::array();
+  terms.push_back(term);
+  Json selector = Json::object();
+  selector["nodeSelectorTerms"] = terms;
+  Json required = Json::object();
+  required["requiredDuringSchedulingIgnoredDuringExecution"] = selector;
+  Json affinity = Json::object();
+  affinity["nodeAffinity"] = required;
+  return affinity;
+}
+
+}  // namespace
+
+Json tensorboard_reconcile(const Json& tensorboard, const Json& options) {
+  const std::string name = meta_string(tensorboard, "name");
+  const std::string ns = meta_string(tensorboard, "namespace");
+  if (name.empty() || ns.empty())
+    throw std::runtime_error("tensorboard missing metadata.name/namespace");
+  const Json* spec = tensorboard.find("spec");
+  const std::string logspath = spec ? spec->get_string("logspath") : "";
+  if (logspath.empty())
+    throw std::runtime_error("tensorboard missing spec.logspath");
+  const std::string api_version = "tensorboard.kubeflow.org/v1alpha1";
+  const std::string prefix = "/tensorboard/" + ns + "/" + name + "/";
+
+  // ---- Deployment ----
+  Json container = Json::object();
+  container["name"] = Json("tensorboard");
+  container["image"] = Json(options.get_string(
+      "tensorboardImage", "tensorflow/tensorflow:2.15.0"));
+  Json args = Json::array();
+  args.push_back(Json("tensorboard"));
+  Json volumes = Json::array();
+  Json volume_mounts = Json::array();
+
+  if (logspath.rfind("pvc://", 0) == 0) {
+    // pvc://<claim>/<subpath> -> mount the claim, logdir inside the mount
+    // (reference logspath schemes :234-249).
+    std::string rest = logspath.substr(6);
+    size_t slash = rest.find('/');
+    std::string claim = slash == std::string::npos ? rest : rest.substr(0, slash);
+    std::string sub = slash == std::string::npos ? "" : rest.substr(slash + 1);
+    Json vol = Json::object();
+    vol["name"] = Json("tb-logs");
+    Json src = Json::object();
+    src["claimName"] = Json(claim);
+    vol["persistentVolumeClaim"] = src;
+    volumes.push_back(vol);
+    Json vm = Json::object();
+    vm["name"] = Json("tb-logs");
+    vm["mountPath"] = Json("/tb-logs");
+    volume_mounts.push_back(vm);
+    args.push_back(Json("--logdir=/tb-logs/" + sub));
+  } else {
+    // gs:// or other remote FS: handed straight to tensorboard.
+    args.push_back(Json("--logdir=" + logspath));
+  }
+  args.push_back(Json("--bind_all"));
+  args.push_back(Json("--path_prefix=" + prefix));
+  container["args"] = args;
+  Json port = Json::object();
+  port["containerPort"] = Json((int64_t)6006);
+  Json ports = Json::array();
+  ports.push_back(port);
+  container["ports"] = ports;
+  if (volume_mounts.size() > 0) container["volumeMounts"] = volume_mounts;
+
+  Json pod_spec = Json::object();
+  Json containers = Json::array();
+  containers.push_back(container);
+  pod_spec["containers"] = containers;
+  if (volumes.size() > 0) pod_spec["volumes"] = volumes;
+  const std::string rwo_node = options.get_string("rwoPvcNode");
+  if (!rwo_node.empty()) pod_spec["affinity"] = node_affinity_for(rwo_node);
+
+  Json pod_meta = Json::object();
+  Json pod_labels = Json::object();
+  pod_labels["app"] = Json(name);
+  pod_meta["labels"] = pod_labels;
+  Json template_ = Json::object();
+  template_["metadata"] = pod_meta;
+  template_["spec"] = pod_spec;
+
+  Json deploy = Json::object();
+  deploy["apiVersion"] = Json("apps/v1");
+  deploy["kind"] = Json("Deployment");
+  deploy["metadata"] =
+      meta_for(tensorboard, api_version, "Tensorboard", name, ns, name);
+  Json dspec = Json::object();
+  dspec["replicas"] = Json((int64_t)1);
+  Json selector = Json::object();
+  Json match = Json::object();
+  match["app"] = Json(name);
+  selector["matchLabels"] = match;
+  dspec["selector"] = selector;
+  dspec["template"] = template_;
+  deploy["spec"] = dspec;
+
+  // ---- Service ----
+  Json svc = Json::object();
+  svc["apiVersion"] = Json("v1");
+  svc["kind"] = Json("Service");
+  svc["metadata"] =
+      meta_for(tensorboard, api_version, "Tensorboard", name, ns, name);
+  Json sspec = Json::object();
+  Json ssel = Json::object();
+  ssel["app"] = Json(name);
+  sspec["selector"] = ssel;
+  Json sport = Json::object();
+  sport["name"] = Json("http-" + name);
+  sport["port"] = Json((int64_t)80);
+  sport["targetPort"] = Json((int64_t)6006);
+  Json sports = Json::array();
+  sports.push_back(sport);
+  sspec["ports"] = sports;
+  svc["spec"] = sspec;
+
+  Json out = Json::object();
+  out["deployment"] = deploy;
+  out["service"] = svc;
+  out["virtualService"] =
+      options.get_bool("useIstio", false)
+          ? virtual_service(tensorboard, api_version, "Tensorboard", name, ns,
+                            prefix, prefix, 80, options)
+          : Json(nullptr);
+  return out;
+}
+
+Json pvcviewer_reconcile(const Json& viewer, const Json& options) {
+  const std::string name = meta_string(viewer, "name");
+  const std::string ns = meta_string(viewer, "namespace");
+  if (name.empty() || ns.empty())
+    throw std::runtime_error("pvcviewer missing metadata.name/namespace");
+  const Json* spec = viewer.find("spec");
+  const std::string pvc = spec ? spec->get_string("pvc") : "";
+  if (pvc.empty()) throw std::runtime_error("pvcviewer missing spec.pvc");
+  const std::string api_version = "kubeflow.org/v1alpha1";
+
+  int target_port = 8080;
+  std::string base_prefix = "/pvcviewer/" + ns + "/" + name;
+  std::string rewrite = "/";
+  if (spec) {
+    if (const Json* net = spec->find("networking")) {
+      target_port = (int)net->get_int("targetPort", 8080);
+      base_prefix = net->get_string("basePrefix", base_prefix);
+      rewrite = net->get_string("rewrite", rewrite);
+    }
+  }
+  const std::string prefix = base_prefix + "/";
+
+  Json container = Json::object();
+  container["name"] = Json("pvcviewer");
+  container["image"] = Json(
+      options.get_string("viewerImage", "filebrowser/filebrowser:v2"));
+  Json env = Json::array();
+  Json e = Json::object();
+  e["name"] = Json("FB_BASEURL");
+  e["value"] = Json(base_prefix);
+  env.push_back(e);
+  Json e2 = Json::object();
+  e2["name"] = Json("FB_PORT");
+  e2["value"] = Json(std::to_string(target_port));
+  env.push_back(e2);
+  container["env"] = env;
+  Json port = Json::object();
+  port["containerPort"] = Json((int64_t)target_port);
+  Json ports = Json::array();
+  ports.push_back(port);
+  container["ports"] = ports;
+  Json vm = Json::object();
+  vm["name"] = Json("viewer-volume");
+  vm["mountPath"] = Json("/srv");
+  Json vms = Json::array();
+  vms.push_back(vm);
+  container["volumeMounts"] = vms;
+
+  Json pod_spec = Json::object();
+  Json containers = Json::array();
+  containers.push_back(container);
+  pod_spec["containers"] = containers;
+  Json vol = Json::object();
+  vol["name"] = Json("viewer-volume");
+  Json src = Json::object();
+  src["claimName"] = Json(pvc);
+  vol["persistentVolumeClaim"] = src;
+  Json vols = Json::array();
+  vols.push_back(vol);
+  pod_spec["volumes"] = vols;
+  const std::string rwo_node = options.get_string("rwoPvcNode");
+  if (!rwo_node.empty() && spec && spec->get_bool("rwoScheduling", true))
+    pod_spec["affinity"] = node_affinity_for(rwo_node);
+
+  Json pod_meta = Json::object();
+  Json pod_labels = Json::object();
+  pod_labels["app"] = Json(name);
+  pod_meta["labels"] = pod_labels;
+  Json template_ = Json::object();
+  template_["metadata"] = pod_meta;
+  template_["spec"] = pod_spec;
+
+  Json deploy = Json::object();
+  deploy["apiVersion"] = Json("apps/v1");
+  deploy["kind"] = Json("Deployment");
+  deploy["metadata"] =
+      meta_for(viewer, api_version, "PVCViewer", name, ns, name);
+  Json dspec = Json::object();
+  dspec["replicas"] = Json((int64_t)1);
+  Json selector = Json::object();
+  Json match = Json::object();
+  match["app"] = Json(name);
+  selector["matchLabels"] = match;
+  dspec["selector"] = selector;
+  dspec["template"] = template_;
+  deploy["spec"] = dspec;
+
+  Json svc = Json::object();
+  svc["apiVersion"] = Json("v1");
+  svc["kind"] = Json("Service");
+  svc["metadata"] = meta_for(viewer, api_version, "PVCViewer", name, ns, name);
+  Json sspec = Json::object();
+  Json ssel = Json::object();
+  ssel["app"] = Json(name);
+  sspec["selector"] = ssel;
+  Json sport = Json::object();
+  sport["name"] = Json("http-" + name);
+  sport["port"] = Json((int64_t)80);
+  sport["targetPort"] = Json((int64_t)target_port);
+  Json sports = Json::array();
+  sports.push_back(sport);
+  sspec["ports"] = sports;
+  svc["spec"] = sspec;
+
+  Json out = Json::object();
+  out["deployment"] = deploy;
+  out["service"] = svc;
+  out["virtualService"] =
+      options.get_bool("useIstio", false)
+          ? virtual_service(viewer, api_version, "PVCViewer", name, ns,
+                            prefix, rewrite, 80, options)
+          : Json(nullptr);
+  out["url"] = Json(base_prefix + "/");
+  return out;
+}
+
+}  // namespace kft

@@ -1,0 +1,33 @@
+// Tensorboard + PVCViewer reconciler cores (the two small workload
+// controllers that reuse the substrate).
+//
+// Tensorboard parity (reference components/tensorboard-controller/
+// controllers/tensorboard_controller.go: Reconcile, deployment gen :172+,
+// logspath schemes :234-249, RWO scheduling :208-232): a Tensorboard
+// {logspath} becomes Deployment+Service+VirtualService. TPU delta: the
+// deployment serves JAX profiler traces (tensorboard-plugin-profile) —
+// the artifact JAX notebooks actually produce — instead of the
+// GCS/TF-events special cases.
+//
+// PVCViewer parity (reference components/pvcviewer-controller/
+// controllers/pvcviewer_controller.go + api/v1alpha1/pvcviewer_webhook.go):
+// a PVCViewer {pvc, networking} becomes a filebrowser
+// Deployment+Service+VirtualService pinned to the PVC's node for RWO.
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// tensorboard: {metadata, spec:{logspath}}.
+// options: {"tensorboardImage", "useIstio", "istioGateway", "istioHost",
+//           "clusterDomain", "rwoPvcNode": node name (optional)}.
+// Returns {"deployment":…, "service":…, "virtualService":…|null}.
+Json tensorboard_reconcile(const Json& tensorboard, const Json& options);
+
+// viewer: {metadata, spec:{pvc, networking:{targetPort, basePrefix,
+//          rewrite}, rwoScheduling}}.
+// Same options shape; returns the same triple plus "url".
+Json pvcviewer_reconcile(const Json& viewer, const Json& options);
+
+}  // namespace kft

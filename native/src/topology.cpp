@@ -1,0 +1,79 @@
+#include "topology.hpp"
+
+#include <map>
+#include <set>
+#include <sstream>
+#include <stdexcept>
+#include <vector>
+
+namespace kft {
+
+namespace {
+
+struct Accel {
+  const char* gke_accelerator;
+  int ndims;
+  int chips_per_host;
+  int max_single_host_chips;
+};
+
+const std::map<std::string, Accel>& accelerators() {
+  static const std::map<std::string, Accel> table = {
+      {"v4", {"tpu-v4-podslice", 3, 4, 4}},
+      {"v5e", {"tpu-v5-lite-podslice", 2, 4, 8}},
+      {"v5p", {"tpu-v5p-slice", 3, 4, 4}},
+      {"v6e", {"tpu-v6e-slice", 2, 4, 8}},
+  };
+  return table;
+}
+
+const std::set<std::string>& valid_topologies(int ndims) {
+  static const std::set<std::string> t2d = {
+      "1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"};
+  static const std::set<std::string> t3d = {
+      "2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4",
+      "4x4x8", "4x8x8", "8x8x8"};
+  return ndims == 2 ? t2d : t3d;
+}
+
+}  // namespace
+
+TpuSlice parse_tpu_slice(const std::string& accelerator,
+                         const std::string& topology) {
+  auto it = accelerators().find(accelerator);
+  if (it == accelerators().end())
+    throw std::runtime_error("unknown accelerator '" + accelerator + "'");
+  const Accel& acc = it->second;
+  if (!valid_topologies(acc.ndims).count(topology))
+    throw std::runtime_error("'" + topology + "' is not a valid " +
+                             accelerator + " slice topology");
+  int chips = 1;
+  std::stringstream ss(topology);
+  std::string dim;
+  while (std::getline(ss, dim, 'x')) chips *= std::stoi(dim);
+
+  TpuSlice s;
+  s.accelerator = accelerator;
+  s.gke_accelerator = acc.gke_accelerator;
+  s.topology = topology;
+  s.chips = chips;
+  s.num_hosts =
+      chips <= acc.max_single_host_chips ? 1 : chips / acc.chips_per_host;
+  s.chips_per_replica = chips / s.num_hosts;
+  s.multihost = s.num_hosts > 1;
+  return s;
+}
+
+Json tpu_slice_to_json(const TpuSlice& s) {
+  Json j = Json::object();
+  j["accelerator"] = Json(s.accelerator);
+  j["gkeAccelerator"] = Json(s.gke_accelerator);
+  j["topology"] = Json(s.topology);
+  j["chips"] = Json((int64_t)s.chips);
+  j["numHosts"] = Json((int64_t)s.num_hosts);
+  j["chipsPerReplica"] = Json((int64_t)s.chips_per_replica);
+  j["multihost"] = Json(s.multihost);
+  return j;
+}
+
+}  // namespace kft

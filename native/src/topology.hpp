@@ -1,0 +1,29 @@
+// TPU accelerator/topology math for the native controllers.
+// Mirrors kubeflow_tpu/topology.py (the Python side is used by the web
+// apps; tests/test_native.py cross-checks the two never drift).
+#pragma once
+
+#include <string>
+
+#include "json.hpp"
+
+namespace kft {
+
+struct TpuSlice {
+  std::string accelerator;      // "v5e"
+  std::string gke_accelerator;  // "tpu-v5-lite-podslice"
+  std::string topology;         // "4x4"
+  int chips = 0;
+  int num_hosts = 1;
+  int chips_per_replica = 0;
+  bool multihost = false;
+};
+
+// Parses {"accelerator": "v5e", "topology": "4x4"}; throws
+// std::runtime_error with a user-facing message on invalid input.
+TpuSlice parse_tpu_slice(const std::string& accelerator,
+                         const std::string& topology);
+
+Json tpu_slice_to_json(const TpuSlice& s);
+
+}  // namespace kft

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding tests run on a
+virtual 8-device CPU mesh, exactly as the driver's multi-chip dryrun does.
+
+The dev image's axon sitecustomize (PYTHONPATH=/root/.axon_site) imports
+jax at interpreter startup with JAX_PLATFORMS=axon (single remote TPU
+tunnel — unusable for concurrent CPU-only tests). Backends are not
+initialised until first use, so flipping ``jax.config.jax_platforms`` and
+XLA_FLAGS here — before any test touches a device — routes everything to
+the 8-device virtual CPU platform.
+"""
+
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG.split("=")[0] not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep subprocesses spawned by tests away from the single-TPU tunnel too.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

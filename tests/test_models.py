@@ -1,0 +1,86 @@
+"""ResNet + sharded train step tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    resnet18,
+    resnet50,
+)
+from kubeflow_tpu.models.resnet import resnet_flops_per_image
+from kubeflow_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+
+
+def tiny_batch(batch=8, size=32, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jnp.asarray(rng.normal(size=(batch, size, size, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, classes, size=(batch,))),
+    }
+
+
+def test_resnet50_forward_shape():
+    model = resnet50(num_classes=10)
+    batch = tiny_batch()
+    variables = model.init(jax.random.key(0), batch["image"], train=False)
+    logits = model.apply(variables, batch["image"], train=False)
+    assert logits.shape == (8, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_train_step_reduces_loss_unsharded():
+    model = resnet18(num_classes=10, width=8)
+    state = create_train_state(model, jax.random.key(0), (2, 32, 32, 3))
+    step = make_train_step()
+    batch = tiny_batch(batch=8)
+    _, m0 = step(state, batch)
+    # Loss finite and accuracy well-formed on a fresh model.
+    assert np.isfinite(float(m0["loss"]))
+    assert 0.0 <= float(m0["accuracy"]) <= 1.0
+
+
+def test_train_step_sharded_matches_metric_shape():
+    mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+    model = resnet18(num_classes=10, width=8)
+    state = create_train_state(model, jax.random.key(0), (2, 32, 32, 3), mesh=mesh)
+    step = make_train_step(mesh=mesh)
+    batch = jax.device_put(tiny_batch(batch=16), batch_sharding(mesh))
+    state, metrics = step(state, batch)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_sharded_step_overfits_tiny_batch():
+    """A few steps on one batch must drive loss down — end-to-end learning
+    signal through the sharded path (the envtest-equivalent for compute)."""
+    mesh = make_mesh(MeshSpec(dp=8))
+    model = resnet18(num_classes=4, width=8)
+    from kubeflow_tpu.models.train import make_optimizer
+
+    state = create_train_state(
+        model, jax.random.key(1), (2, 32, 32, 3),
+        tx=make_optimizer(lr=0.05), mesh=mesh,
+    )
+    step = make_train_step(mesh=mesh, smoothing=0.0)
+    batch = jax.device_put(tiny_batch(batch=16, classes=4), batch_sharding(mesh))
+    first = None
+    for _ in range(6):
+        state, metrics = step(state, batch)
+        first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+def test_eval_step():
+    model = resnet18(num_classes=10, width=8)
+    state = create_train_state(model, jax.random.key(0), (2, 32, 32, 3))
+    metrics = make_eval_step()(state, tiny_batch())
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_flops_estimate():
+    assert resnet_flops_per_image("resnet50") == pytest.approx(8.18e9, rel=0.01)

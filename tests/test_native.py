@@ -1,0 +1,646 @@
+"""Native core tests: topology cross-check, notebook reconcile,
+PodDefault merge matrix, culler decisions, drift repair, profile/TB/viewer.
+
+Modeled on the reference's Go unit-test tier (SURVEY.md §4 tier 1 —
+reference notebook_controller_test.go, main_test.go merge matrix,
+culling_controller_test.go).
+"""
+
+import pytest
+
+from kubeflow_tpu import topology
+from kubeflow_tpu.native import NativeError, invoke
+
+
+def make_notebook(name="nb", ns="user", tpu=None, annotations=None, image="jupyter-jax-tpu:latest"):
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "uid": "uid-1"},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": name,
+                            "image": image,
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            }
+        },
+    }
+    if tpu:
+        nb["spec"]["tpu"] = tpu
+    if annotations:
+        nb["metadata"]["annotations"] = annotations
+    return nb
+
+
+def env_map(container):
+    return {e["name"]: e for e in container.get("env", [])}
+
+
+class TestTopologyNative:
+    def test_cross_check_against_python(self):
+        """The C++ topology table must never drift from topology.py."""
+        for preset in topology.spawner_presets(["v4", "v5e", "v5p", "v6e"]):
+            native = invoke(
+                "parse_tpu_slice",
+                {
+                    "accelerator": preset["accelerator"],
+                    "topology": preset["topology"],
+                },
+            )
+            assert native["chips"] == preset["chips"], preset
+            assert native["numHosts"] == preset["hosts"], preset
+            assert native["multihost"] == preset["multihost"], preset
+
+    def test_invalid_raises(self):
+        with pytest.raises(NativeError):
+            invoke("parse_tpu_slice", {"accelerator": "v5e", "topology": "3x3"})
+
+
+class TestNotebookReconcile:
+    def test_single_pod_defaults(self):
+        out = invoke("notebook_reconcile", {"notebook": make_notebook()})
+        sts = out["statefulset"]
+        assert sts["spec"]["replicas"] == 1
+        assert sts["spec"]["serviceName"] == "nb-hosts"
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        tmpl = sts["spec"]["template"]
+        envs = env_map(tmpl["spec"]["containers"][0])
+        assert envs["NB_PREFIX"]["value"] == "/notebook/user/nb"
+        assert tmpl["spec"]["securityContext"]["fsGroup"] == 100
+        # ownerReferences set for GC.
+        assert sts["metadata"]["ownerReferences"][0]["kind"] == "Notebook"
+
+    def test_v5e16_multihost(self):
+        """North-star config: v5e-16 => 4 replicas, 4 chips each."""
+        out = invoke(
+            "notebook_reconcile",
+            {
+                "notebook": make_notebook(
+                    tpu={"accelerator": "v5e", "topology": "4x4"}
+                )
+            },
+        )
+        sts = out["statefulset"]
+        assert sts["spec"]["replicas"] == 4
+        c = sts["spec"]["template"]["spec"]["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        envs = env_map(c)
+        assert envs["KFT_NUM_PROCESSES"]["value"] == "4"
+        assert (
+            envs["KFT_COORDINATOR_ADDRESS"]["value"]
+            == "nb-0.nb-hosts.user.svc:8476"
+        )
+        assert "nb-3.nb-hosts.user.svc" in envs["TPU_WORKER_HOSTNAMES"]["value"]
+        # TPU_WORKER_ID from the pod-index downward API.
+        assert (
+            envs["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['apps.kubernetes.io/pod-index']"
+        )
+
+    def test_multihost_env_matches_python_contract(self):
+        """The controller env and parallel.distributed must agree."""
+        from kubeflow_tpu.parallel import slice_env_for_rank
+
+        out = invoke(
+            "notebook_reconcile",
+            {
+                "notebook": make_notebook(
+                    tpu={"accelerator": "v5e", "topology": "4x4"}
+                )
+            },
+        )
+        c = out["statefulset"]["spec"]["template"]["spec"]["containers"][0]
+        envs = env_map(c)
+        py_env = slice_env_for_rank("nb", "user", 0, 4, service="nb-hosts")
+        assert envs["TPU_WORKER_HOSTNAMES"]["value"] == py_env["TPU_WORKER_HOSTNAMES"]
+        assert envs["KFT_COORDINATOR_ADDRESS"]["value"] == py_env["KFT_COORDINATOR_ADDRESS"]
+
+    def test_stop_annotation_scales_to_zero(self):
+        out = invoke(
+            "notebook_reconcile",
+            {
+                "notebook": make_notebook(
+                    tpu={"accelerator": "v5e", "topology": "4x4"},
+                    annotations={"kubeflow-resource-stopped": "2026-07-29T00:00:00Z"},
+                )
+            },
+        )
+        assert out["statefulset"]["spec"]["replicas"] == 0
+
+    def test_services(self):
+        out = invoke(
+            "notebook_reconcile",
+            {
+                "notebook": make_notebook(
+                    tpu={"accelerator": "v5e", "topology": "4x4"}
+                )
+            },
+        )
+        headless, http = out["services"]
+        assert headless["metadata"]["name"] == "nb-hosts"
+        assert headless["spec"]["clusterIP"] == "None"
+        assert headless["spec"]["publishNotReadyAddresses"] is True
+        assert http["metadata"]["name"] == "nb"
+        assert http["spec"]["ports"][0]["port"] == 80
+        assert http["spec"]["ports"][0]["targetPort"] == 8888
+        assert http["spec"]["ports"][0]["name"] == "http-nb"
+        # Multi-host: HTTP pinned to rank 0.
+        assert http["spec"]["selector"]["apps.kubernetes.io/pod-index"] == "0"
+
+    def test_virtual_service(self):
+        out = invoke(
+            "notebook_reconcile",
+            {
+                "notebook": make_notebook(),
+                "options": {
+                    "useIstio": True,
+                    "istioGateway": "kubeflow/kubeflow-gateway",
+                    "istioHost": "*",
+                    "clusterDomain": "cluster.local",
+                },
+            },
+        )
+        vs = out["virtualService"]
+        assert vs["metadata"]["name"] == "notebook-user-nb"
+        http = vs["spec"]["http"][0]
+        assert http["match"][0]["uri"]["prefix"] == "/notebook/user/nb/"
+        assert (
+            http["route"][0]["destination"]["host"]
+            == "nb.user.svc.cluster.local"
+        )
+
+    def test_no_istio_no_vs(self):
+        out = invoke("notebook_reconcile", {"notebook": make_notebook()})
+        assert out["virtualService"] is None
+
+    def test_user_env_overridden_by_controller(self):
+        nb = make_notebook()
+        nb["spec"]["template"]["spec"]["containers"][0]["env"] = [
+            {"name": "NB_PREFIX", "value": "/evil"},
+            {"name": "MY_VAR", "value": "keep"},
+        ]
+        out = invoke("notebook_reconcile", {"notebook": nb})
+        envs = env_map(out["statefulset"]["spec"]["template"]["spec"]["containers"][0])
+        assert envs["NB_PREFIX"]["value"] == "/notebook/user/nb"
+        assert envs["MY_VAR"]["value"] == "keep"
+
+    def test_missing_containers_rejected(self):
+        nb = make_notebook()
+        nb["spec"]["template"]["spec"]["containers"] = []
+        with pytest.raises(NativeError):
+            invoke("notebook_reconcile", {"notebook": nb})
+
+
+class TestNotebookStatus:
+    def test_status_mirrors_pod(self):
+        pod = {
+            "status": {
+                "containerStatuses": [
+                    {"state": {"running": {"startedAt": "2026-07-29T00:00:00Z"}}}
+                ],
+                "conditions": [{"type": "Ready", "status": "True"}],
+            }
+        }
+        sts = {"status": {"readyReplicas": 4}}
+        out = invoke(
+            "notebook_status",
+            {"notebook": make_notebook(), "statefulset": sts, "pod": pod,
+             "events": [{"type": "Warning", "reason": "FailedScheduling"}]},
+        )
+        assert out["readyReplicas"] == 4
+        assert "running" in out["containerState"]
+        assert out["conditions"][0]["type"] == "Ready"
+        assert out["warningEvents"][0]["reason"] == "FailedScheduling"
+
+
+class TestCopyOwnedFields:
+    def test_no_drift_no_change(self):
+        desired = {"spec": {"replicas": 2, "template": {"spec": {"x": 1}}}}
+        existing = {
+            "metadata": {"resourceVersion": "42"},
+            "spec": {"replicas": 2, "template": {"spec": {"x": 1}}},
+            "status": {"readyReplicas": 2},
+        }
+        out = invoke(
+            "copy_owned_fields",
+            {"kind": "StatefulSet", "existing": existing, "desired": desired},
+        )
+        assert out["changed"] is False
+
+    def test_replica_drift_repaired_preserving_cluster_fields(self):
+        desired = {"spec": {"replicas": 0}}
+        existing = {
+            "metadata": {"resourceVersion": "42"},
+            "spec": {"replicas": 4, "serviceName": "nb-hosts"},
+            "status": {"readyReplicas": 4},
+        }
+        out = invoke(
+            "copy_owned_fields",
+            {"kind": "StatefulSet", "existing": existing, "desired": desired},
+        )
+        assert out["changed"] is True
+        assert out["merged"]["spec"]["replicas"] == 0
+        assert out["merged"]["spec"]["serviceName"] == "nb-hosts"
+        assert out["merged"]["metadata"]["resourceVersion"] == "42"
+
+    def test_service_cluster_ip_preserved(self):
+        desired = {"spec": {"ports": [{"port": 80}], "selector": {"a": "b"}}}
+        existing = {
+            "spec": {
+                "clusterIP": "10.0.0.7",
+                "ports": [{"port": 8080}],
+                "selector": {"a": "b"},
+            }
+        }
+        out = invoke(
+            "copy_owned_fields",
+            {"kind": "Service", "existing": existing, "desired": desired},
+        )
+        assert out["changed"] is True
+        assert out["merged"]["spec"]["clusterIP"] == "10.0.0.7"
+        assert out["merged"]["spec"]["ports"][0]["port"] == 80
+
+    def test_namespace_labels_merge_additive(self):
+        desired = {"metadata": {"labels": {"istio-injection": "enabled"}}}
+        existing = {"metadata": {"labels": {"other-controller": "present"}}}
+        out = invoke(
+            "copy_owned_fields",
+            {"kind": "Namespace", "existing": existing, "desired": desired},
+        )
+        assert out["changed"] is True
+        merged = out["merged"]["metadata"]["labels"]
+        assert merged == {
+            "other-controller": "present",
+            "istio-injection": "enabled",
+        }
+
+
+def make_poddefault(name, selector_label="notebook", **spec):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": "user", "resourceVersion": "7"},
+        "spec": {
+            "selector": {"matchLabels": {selector_label: "true"}},
+            **spec,
+        },
+    }
+
+
+def make_pod(labels=None, annotations=None, containers=None):
+    return {
+        "metadata": {
+            "name": "nb-0",
+            "namespace": "user",
+            "labels": labels or {"notebook": "true"},
+            **({"annotations": annotations} if annotations else {}),
+        },
+        "spec": {
+            "containers": containers
+            or [{"name": "nb", "image": "img", "env": []}],
+        },
+    }
+
+
+class TestPodDefaultMutate:
+    def test_env_injection(self):
+        pd = make_poddefault(
+            "tpu-env", env=[{"name": "JAX_PLATFORMS", "value": "tpu"}]
+        )
+        out = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        assert out["applied"] is True
+        assert out["matched"] == ["tpu-env"]
+        envs = env_map(out["pod"]["spec"]["containers"][0])
+        assert envs["JAX_PLATFORMS"]["value"] == "tpu"
+        # Revision stamped.
+        anns = out["pod"]["metadata"]["annotations"]
+        assert anns["poddefault.admission.kubeflow.org/poddefault-tpu-env"] == "7"
+        assert len(out["patch"]) > 0
+
+    def test_selector_not_matching_skips(self):
+        pd = make_poddefault("other", selector_label="something-else")
+        out = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        assert out["matched"] == []
+        assert out["applied"] is False
+        assert out["pod"] == make_pod()
+
+    def test_conflicting_env_rejected(self):
+        pd1 = make_poddefault("a", env=[{"name": "X", "value": "1"}])
+        pd2 = make_poddefault("b", env=[{"name": "X", "value": "2"}])
+        out = invoke(
+            "poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd1, pd2]}
+        )
+        assert out["applied"] is False
+        assert any("conflict on env 'X'" in c for c in out["conflicts"])
+        assert out["pod"] == make_pod()  # untouched
+
+    def test_identical_duplicates_tolerated(self):
+        pd1 = make_poddefault("a", env=[{"name": "X", "value": "1"}])
+        pd2 = make_poddefault("b", env=[{"name": "X", "value": "1"}])
+        out = invoke(
+            "poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd1, pd2]}
+        )
+        assert out["applied"] is True
+        assert out["conflicts"] == []
+
+    def test_volume_and_mount_merge(self):
+        pd = make_poddefault(
+            "libtpu",
+            volumes=[{"name": "libtpu", "hostPath": {"path": "/usr/lib/libtpu"}}],
+            volumeMounts=[{"name": "libtpu", "mountPath": "/lib/libtpu"}],
+        )
+        out = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        assert out["applied"] is True
+        pod = out["pod"]
+        assert pod["spec"]["volumes"][0]["name"] == "libtpu"
+        assert (
+            pod["spec"]["containers"][0]["volumeMounts"][0]["mountPath"]
+            == "/lib/libtpu"
+        )
+
+    def test_mountpath_conflict(self):
+        pod = make_pod(
+            containers=[
+                {
+                    "name": "nb",
+                    "volumeMounts": [{"name": "own", "mountPath": "/lib/libtpu"}],
+                }
+            ]
+        )
+        pd = make_poddefault(
+            "libtpu",
+            volumeMounts=[{"name": "libtpu", "mountPath": "/lib/libtpu"}],
+        )
+        out = invoke("poddefault_mutate", {"pod": pod, "poddefaults": [pd]})
+        assert out["applied"] is False
+        assert any("volumeMount path" in c for c in out["conflicts"])
+
+    def test_exclusion_annotation(self):
+        pd = make_poddefault("a", env=[{"name": "X", "value": "1"}])
+        pod = make_pod(
+            annotations={"poddefault.admission.kubeflow.org/exclude": "true"}
+        )
+        out = invoke("poddefault_mutate", {"pod": pod, "poddefaults": [pd]})
+        assert out["matched"] == []
+
+    def test_sidecar_and_init_container(self):
+        pd = make_poddefault(
+            "proxy",
+            sidecars=[{"name": "istio-proxy", "image": "proxy:1"}],
+            initContainers=[{"name": "init-perms", "image": "busybox"}],
+        )
+        out = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        pod = out["pod"]
+        names = [c["name"] for c in pod["spec"]["containers"]]
+        assert names == ["nb", "istio-proxy"]
+        assert pod["spec"]["initContainers"][0]["name"] == "init-perms"
+
+    def test_labels_annotations_tolerations(self):
+        pd = make_poddefault(
+            "extras",
+            labels={"team": "ml"},
+            annotations={"note": "hi"},
+            tolerations=[
+                {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+            ],
+        )
+        out = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        pod = out["pod"]
+        assert pod["metadata"]["labels"]["team"] == "ml"
+        assert pod["metadata"]["annotations"]["note"] == "hi"
+        assert pod["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+
+    def test_match_expressions(self):
+        pd = make_poddefault("expr")
+        pd["spec"]["selector"] = {
+            "matchExpressions": [
+                {"key": "notebook", "operator": "Exists"},
+                {"key": "env", "operator": "In", "values": ["prod", "dev"]},
+            ]
+        }
+        out = invoke(
+            "poddefault_mutate",
+            {
+                "pod": make_pod(labels={"notebook": "x", "env": "dev"}),
+                "poddefaults": [pd],
+            },
+        )
+        assert out["matched"] == ["expr"]
+        out2 = invoke(
+            "poddefault_mutate",
+            {
+                "pod": make_pod(labels={"notebook": "x", "env": "staging"}),
+                "poddefaults": [pd],
+            },
+        )
+        assert out2["matched"] == []
+
+    def test_idempotent_remutation(self):
+        """Applying the same poddefaults to an already-mutated pod is a no-op."""
+        pd = make_poddefault("tpu-env", env=[{"name": "A", "value": "1"}])
+        first = invoke("poddefault_mutate", {"pod": make_pod(), "poddefaults": [pd]})
+        second = invoke(
+            "poddefault_mutate", {"pod": first["pod"], "poddefaults": [pd]}
+        )
+        assert second["applied"] is True
+        assert second["pod"] == first["pod"]
+        assert second["patch"] == []
+
+
+class TestCullDecide:
+    CONFIG = {"cullIdleTimeMin": 1440, "idlenessCheckPeriodMin": 5}
+    NOW = 1_800_000_000
+
+    def test_fresh_activity_updates_annotations(self):
+        out = invoke(
+            "cull_decide",
+            {
+                "notebook": make_notebook(),
+                "kernels": [
+                    {"execution_state": "busy", "last_activity": "2026-07-29T10:00:00Z"}
+                ],
+                "nowEpoch": self.NOW,
+                "config": self.CONFIG,
+            },
+        )
+        assert out["action"] == "update-annotations"
+        assert "kubeflow-resource-stopped" not in out["annotations"]
+
+    def test_idle_past_threshold_stops(self):
+        idle_since = self.NOW - 1441 * 60
+        from kubeflow_tpu.controllers.time_utils import rfc3339
+
+        nb = make_notebook(
+            annotations={
+                "notebooks.kubeflow.org/last-activity": rfc3339(idle_since)
+            }
+        )
+        out = invoke(
+            "cull_decide",
+            {
+                "notebook": nb,
+                "kernels": [],
+                "nowEpoch": self.NOW,
+                "config": self.CONFIG,
+            },
+        )
+        assert out["action"] == "stop"
+        assert "kubeflow-resource-stopped" in out["annotations"]
+
+    def test_tpu_busy_blocks_culling(self):
+        idle_since = self.NOW - 2000 * 60
+        from kubeflow_tpu.controllers.time_utils import rfc3339
+
+        nb = make_notebook(
+            annotations={
+                "notebooks.kubeflow.org/last-activity": rfc3339(idle_since)
+            }
+        )
+        out = invoke(
+            "cull_decide",
+            {
+                "notebook": nb,
+                "kernels": [],
+                "nowEpoch": self.NOW,
+                "config": {**self.CONFIG, "tpuBusy": True},
+            },
+        )
+        assert out["action"] == "update-annotations"
+
+    def test_rate_limited(self):
+        from kubeflow_tpu.controllers.time_utils import rfc3339
+
+        nb = make_notebook(
+            annotations={
+                "notebooks.kubeflow.org/last_activity_check_timestamp": rfc3339(
+                    self.NOW - 60
+                )
+            }
+        )
+        out = invoke(
+            "cull_decide",
+            {"notebook": nb, "kernels": [], "nowEpoch": self.NOW, "config": self.CONFIG},
+        )
+        assert out["action"] == "none"
+        assert out["requeueAfterSec"] == 4 * 60
+
+    def test_already_stopped_noop(self):
+        nb = make_notebook(annotations={"kubeflow-resource-stopped": "x"})
+        out = invoke(
+            "cull_decide",
+            {"notebook": nb, "kernels": [], "nowEpoch": self.NOW, "config": self.CONFIG},
+        )
+        assert out["action"] == "none"
+
+    def test_probe_failure_not_idleness_evidence(self):
+        out = invoke(
+            "cull_decide",
+            {
+                "notebook": make_notebook(),
+                "kernels": None,
+                "nowEpoch": self.NOW,
+                "config": self.CONFIG,
+            },
+        )
+        assert out["action"] == "update-annotations"
+        assert "kubeflow-resource-stopped" not in out["annotations"]
+
+
+class TestProfileReconcile:
+    def test_full_materialisation(self):
+        profile = {
+            "metadata": {"name": "alice", "uid": "u1"},
+            "spec": {
+                "owner": {"kind": "User", "name": "alice@example.com"},
+                "resourceQuotaSpec": {
+                    "hard": {"google.com/tpu": "16", "cpu": "64"}
+                },
+            },
+        }
+        out = invoke("profile_reconcile", {"profile": profile})
+        ns = out["namespace"]
+        assert ns["metadata"]["name"] == "alice"
+        assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+        assert (
+            ns["metadata"]["labels"]["app.kubernetes.io/part-of"]
+            == "kubeflow-profile"
+        )
+        assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+        sa_names = [sa["metadata"]["name"] for sa in out["serviceAccounts"]]
+        assert sa_names == ["default-editor", "default-viewer"]
+        rb = out["roleBinding"]
+        assert rb["roleRef"]["name"] == "kubeflow-admin"
+        assert rb["subjects"][0]["name"] == "alice@example.com"
+        rq = out["resourceQuota"]
+        assert rq["spec"]["hard"]["google.com/tpu"] == "16"
+        ap = out["authorizationPolicy"]
+        assert "kubeflow-userid" in ap["spec"]["rules"][0]["when"][0]["key"]
+
+    def test_no_quota(self):
+        profile = {
+            "metadata": {"name": "bob"},
+            "spec": {"owner": {"kind": "User", "name": "bob@x.com"}},
+        }
+        out = invoke("profile_reconcile", {"profile": profile})
+        assert out["resourceQuota"] is None
+
+
+class TestTensorboardReconcile:
+    def test_pvc_logspath(self):
+        tb = {
+            "metadata": {"name": "tb1", "namespace": "user"},
+            "spec": {"logspath": "pvc://workspace/logs/run1"},
+        }
+        out = invoke("tensorboard_reconcile", {"tensorboard": tb})
+        dep = out["deployment"]
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--logdir=/tb-logs/logs/run1" in c["args"]
+        vols = dep["spec"]["template"]["spec"]["volumes"]
+        assert vols[0]["persistentVolumeClaim"]["claimName"] == "workspace"
+        assert out["service"]["spec"]["ports"][0]["targetPort"] == 6006
+
+    def test_gs_logspath_and_rwo_node(self):
+        tb = {
+            "metadata": {"name": "tb2", "namespace": "user"},
+            "spec": {"logspath": "gs://bucket/logs"},
+        }
+        out = invoke(
+            "tensorboard_reconcile",
+            {"tensorboard": tb, "options": {"rwoPvcNode": "node-7", "useIstio": True}},
+        )
+        c = out["deployment"]["spec"]["template"]["spec"]["containers"][0]
+        assert "--logdir=gs://bucket/logs" in c["args"]
+        aff = out["deployment"]["spec"]["template"]["spec"]["affinity"]
+        terms = aff["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["values"] == ["node-7"]
+        vs = out["virtualService"]
+        assert vs["spec"]["http"][0]["match"][0]["uri"]["prefix"] == "/tensorboard/user/tb2/"
+
+
+class TestPvcViewerReconcile:
+    def test_viewer(self):
+        viewer = {
+            "metadata": {"name": "view1", "namespace": "user"},
+            "spec": {"pvc": "workspace"},
+        }
+        out = invoke(
+            "pvcviewer_reconcile", {"viewer": viewer, "options": {"useIstio": True}}
+        )
+        dep = out["deployment"]
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "workspace"
+        assert out["url"] == "/pvcviewer/user/view1/"
+        assert out["virtualService"] is not None

@@ -1,0 +1,81 @@
+"""Mesh / sharding / distributed-env unit tests (8-device CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import (
+    DistributedEnv,
+    MeshSpec,
+    auto_mesh,
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+    slice_env_for_rank,
+)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_mesh_spec_resolve_auto_dp():
+    spec = MeshSpec(dp=-1, fsdp=2, tp=2).resolve(8)
+    assert spec.shape == (2, 2, 2, 1)
+
+
+def test_mesh_spec_mismatch_raises():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, fsdp=3).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
+    assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+
+
+def test_batch_sharding_shards_leading_dim():
+    mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+    x = jax.device_put(np.zeros((16, 3)), batch_sharding(mesh))
+    # 8-way sharded over the leading dim -> each shard holds 2 rows.
+    assert x.addressable_shards[0].data.shape == (2, 3)
+
+
+def test_param_sharding_small_leaf_replicated():
+    mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+    leaf = jax.ShapeDtypeStruct((64,), np.float32)
+    assert param_sharding(mesh, (), leaf).is_fully_replicated
+
+
+def test_param_sharding_large_leaf_sharded():
+    mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+    leaf = jax.ShapeDtypeStruct((512, 512), np.float32)
+    sh = param_sharding(mesh, (), leaf)
+    assert not sh.is_fully_replicated
+
+
+def test_auto_mesh_all_dp():
+    mesh = auto_mesh()
+    assert mesh.shape["dp"] == 8
+
+
+class TestDistributedEnv:
+    def test_single_host_defaults(self):
+        denv = DistributedEnv.from_env({})
+        assert denv.process_id == 0
+        assert denv.num_processes == 1
+        assert not denv.is_multihost
+
+    def test_multihost_parse(self):
+        env = slice_env_for_rank("nb", "user-ns", rank=2, num_replicas=4)
+        denv = DistributedEnv.from_env(env)
+        assert denv.process_id == 2
+        assert denv.num_processes == 4
+        assert denv.coordinator_address == "nb-0.nb.user-ns.svc:8476"
+        assert denv.worker_hostnames[3] == "nb-3.nb.user-ns.svc"
+
+    def test_single_replica_env_has_no_coordinator(self):
+        env = slice_env_for_rank("nb", "ns", rank=0, num_replicas=1)
+        assert "KFT_COORDINATOR_ADDRESS" not in env
+        assert env["TPU_WORKER_ID"] == "0"

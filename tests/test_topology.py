@@ -1,0 +1,72 @@
+import pytest
+
+from kubeflow_tpu.topology import (
+    ACCELERATORS,
+    TopologyError,
+    TpuSlice,
+    spawner_presets,
+)
+
+
+class TestTpuSlice:
+    def test_v5e_16_north_star(self):
+        """The BASELINE.md north-star config: v5e-16 = 4 hosts x 4 chips."""
+        sl = TpuSlice.from_shorthand("v5e-16")
+        assert sl.topology == "4x4"
+        assert sl.chips == 16
+        assert sl.num_hosts == 4
+        assert sl.chips_per_replica == 4
+        assert sl.is_multihost
+
+    def test_v5e_single_chip(self):
+        sl = TpuSlice.from_shorthand("v5e-1")
+        assert sl.topology == "1x1"
+        assert sl.num_hosts == 1
+        assert not sl.is_multihost
+        assert sl.container_resources() == {"google.com/tpu": "1"}
+
+    def test_v5e_8_single_host(self):
+        # 2x4 fits one ct5lp-hightpu-8t host.
+        sl = TpuSlice.from_shorthand("v5e-8")
+        assert sl.num_hosts == 1
+        assert sl.chips_per_replica == 8
+
+    def test_v4_3d_topology(self):
+        sl = TpuSlice.from_shorthand("v4-32")
+        assert sl.topology == "2x4x4"
+        assert sl.num_hosts == 8
+
+    def test_node_selectors(self):
+        sl = TpuSlice.parse("v5e", "4x4")
+        assert sl.node_selectors() == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+
+    def test_roundtrip_shorthand(self):
+        for name, acc in ACCELERATORS.items():
+            sl = TpuSlice.from_shorthand(f"{name}-4")
+            assert sl.shorthand == f"{name}-4"
+
+    @pytest.mark.parametrize(
+        "bad", ["v5e-3", "v9x-4", "nope", "v5e-"]
+    )
+    def test_bad_shorthand(self, bad):
+        with pytest.raises(TopologyError):
+            TpuSlice.from_shorthand(bad)
+
+    @pytest.mark.parametrize(
+        "acc,topo", [("v5e", "3x3"), ("v5e", "2x2x2"), ("v4", "4x4"), ("v5e", "x4")]
+    )
+    def test_bad_topology(self, acc, topo):
+        with pytest.raises(TopologyError):
+            TpuSlice.parse(acc, topo)
+
+
+def test_spawner_presets_cover_v5e():
+    presets = spawner_presets(["v5e"])
+    shorts = [p["shorthand"] for p in presets]
+    assert "v5e-1" in shorts and "v5e-16" in shorts
+    by_short = {p["shorthand"]: p for p in presets}
+    assert by_short["v5e-16"]["hosts"] == 4
+    assert by_short["v5e-16"]["multihost"]
