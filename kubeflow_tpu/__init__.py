@@ -1,23 +1,20 @@
 """kubeflow_tpu — a TPU-native notebooks platform.
 
 A brand-new implementation of the capability surface of the Kubeflow
-Notebooks platform (reference: kubeflow/kubeflow), redesigned TPU-first:
+Notebooks platform (reference: kubeflow/kubeflow), redesigned TPU-first.
+Current layout (grows as components land; see SURVEY.md §7 build plan):
 
-- ``controllers/`` — Kubernetes reconcilers (Notebook, Tensorboard,
-  PVCViewer, Profile) whose desired-state generation, work queues, and
-  merge engines live in the native C++ core (``native/``), driven here.
-- ``webhook/`` — the PodDefault admission webhook that injects
-  ``TPU_WORKER_ID`` / coordinator env into pods on TPU pod slices.
-- ``crud_backend/`` + ``apps/`` — Flask REST backends for the Jupyter
-  spawner, Volumes, and Tensorboards web apps.
-- ``parallel/`` / ``models/`` / ``ops/`` — the JAX compute stack shipped
-  in the ``jupyter-jax-tpu`` notebook images: device-mesh sharding,
-  ``jax.distributed`` wiring from platform-injected env, ResNet-50 and
-  long-context transformer reference models, and Pallas kernels.
+- ``parallel/`` / ``models/`` — the JAX compute stack shipped in the
+  ``jupyter-jax-tpu`` notebook images: named-mesh sharding,
+  ``jax.distributed`` wiring from platform-injected env, and the
+  ResNet-50 reference model with a sharded train step.
 - ``topology.py`` — TPU accelerator/topology model (v4/v5e/v5p/v6e):
   chips-per-host math, GKE node selectors, ``google.com/tpu`` resources.
-- ``k8s/`` — a typed Kubernetes API client plus an in-memory fake API
-  server used by the test ladder (the envtest equivalent).
+- ``native.py`` — ctypes bridge to the C++ core (``native/``) holding the
+  reconcilers' desired-state generation, the PodDefault merge engine,
+  the culling decision engine, and drift-repair helpers.
+- ``controllers/`` — controller-side Python (watch loops and helpers
+  driving the native core).
 """
 
 __version__ = "0.1.0"

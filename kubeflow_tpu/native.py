@@ -34,10 +34,12 @@ def ensure_built(force: bool = False) -> str:
         if not stale:
             lib_mtime = os.path.getmtime(_LIB_PATH)
             src_dir = os.path.join(_NATIVE_DIR, "src")
-            for fname in os.listdir(src_dir):
-                if os.path.getmtime(os.path.join(src_dir, fname)) > lib_mtime:
-                    stale = True
-                    break
+            # src_dir itself covers deletions (dir mtime bumps on unlink);
+            # the Makefile covers flag changes.
+            candidates = [src_dir, os.path.join(_NATIVE_DIR, "Makefile")] + [
+                os.path.join(src_dir, fname) for fname in os.listdir(src_dir)
+            ]
+            stale = any(os.path.getmtime(p) > lib_mtime for p in candidates)
         if stale:
             proc = subprocess.run(
                 ["make", "-C", _NATIVE_DIR],
@@ -71,7 +73,9 @@ def invoke(fn: str, payload: dict | None = None) -> dict | list | str | int:
         fn.encode(), json.dumps(payload or {}).encode()
     )
     try:
-        reply = json.loads(ctypes.string_at(raw).decode())
+        # errors="replace": a native-side encoding bug must surface as a
+        # parseable error, never a UnicodeDecodeError crash in the bridge.
+        reply = json.loads(ctypes.string_at(raw).decode(errors="replace"))
     finally:
         lib.kft_free(raw)
     if not reply.get("ok"):

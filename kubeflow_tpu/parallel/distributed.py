@@ -103,9 +103,11 @@ def slice_env_for_rank(
 
     Single source of truth shared by the notebook controller's
     StatefulSet generator and the PodDefault webhook tests, so the two
-    injection paths can never drift apart.
+    injection paths can never drift apart. The default ``service`` is the
+    headless per-replica Service the controller creates (``<name>-hosts``,
+    native/src/notebook.cpp) — per-pod DNS only resolves under it.
     """
-    service = service or name
+    service = service or f"{name}-hosts"
     hosts = ",".join(
         f"{name}-{i}.{service}.{namespace}.svc" for i in range(num_replicas)
     )

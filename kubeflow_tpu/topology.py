@@ -78,9 +78,7 @@ class TpuSlice:
             raise TopologyError(f"malformed topology {topology!r}")
         if not dims or any(d < 1 for d in dims):
             raise TopologyError(f"malformed topology {topology!r}")
-        if len(dims) != acc.ndims and not (
-            len(dims) == 2 and math.prod(dims) == 1
-        ):
+        if len(dims) != acc.ndims:
             raise TopologyError(
                 f"{accelerator} topologies are {acc.ndims}-D, got {topology!r}"
             )

@@ -132,6 +132,10 @@ struct Parser {
               unsigned low = parse_hex4();
               if (low < 0xDC00 || low > 0xDFFF) fail("bad low surrogate");
               code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+            } else if (code >= 0xDC00 && code <= 0xDFFF) {
+              // A lone low surrogate would encode as invalid UTF-8 and
+              // break consumers (e.g. Python .decode()); reject it.
+              fail("lone low surrogate");
             }
             append_utf8(out, code);
             break;
@@ -199,11 +203,12 @@ struct Parser {
       long long v = std::strtoll(text.c_str(), &endptr, 10);
       if (errno == 0 && endptr && *endptr == '\0') return Json((int64_t)v);
     }
-    try {
-      return Json(std::stod(text));
-    } catch (...) {
-      fail("bad number");
-    }
+    errno = 0;
+    char* endptr = nullptr;
+    double d = std::strtod(text.c_str(), &endptr);
+    // Whole token must convert: "1.2.3" / "1e" / "1-2" are malformed.
+    if (errno != 0 || !endptr || *endptr != '\0') fail("bad number");
+    return Json(d);
   }
 };
 

@@ -17,14 +17,14 @@ std::string pd_name(const Json& pd) {
 }
 
 // ---- conflict-checked list merges ----------------------------------------
-// Each merger appends `msg` to `conflicts` instead of mutating when
-// check_only; identical duplicates are always tolerated (idempotent
+// Each merger records conflicts for keyed collisions with differing
+// values; identical duplicates are always tolerated (idempotent
 // re-admission of an already-mutated pod must be a no-op).
 
 void merge_keyed_list(Json& target, const Json& additions,
                       const std::string& key_field,
                       const std::string& what, const std::string& source,
-                      std::vector<std::string>& conflicts, bool check_only) {
+                      std::vector<std::string>& conflicts) {
   if (!additions.is_array()) return;
   if (!target.is_array()) target = Json::array();
   for (const auto& add : additions.items()) {
@@ -38,14 +38,13 @@ void merge_keyed_list(Json& target, const Json& additions,
                             "' from poddefault '" + source + "'");
       continue;  // identical duplicate: skip
     }
-    if (!check_only) target.push_back(add);
+    target.push_back(add);
   }
 }
 
 void merge_volume_mounts(Json& target, const Json& additions,
                          const std::string& source,
-                         std::vector<std::string>& conflicts,
-                         bool check_only) {
+                         std::vector<std::string>& conflicts) {
   if (!additions.is_array()) return;
   if (!target.is_array()) target = Json::array();
   for (const auto& add : additions.items()) {
@@ -59,11 +58,11 @@ void merge_volume_mounts(Json& target, const Json& additions,
                             "' from poddefault '" + source + "'");
       continue;
     }
-    if (!check_only) target.push_back(add);
+    target.push_back(add);
   }
 }
 
-void merge_unkeyed_list(Json& target, const Json& additions, bool check_only) {
+void merge_unkeyed_list(Json& target, const Json& additions) {
   // tolerations / envFrom / imagePullSecrets: append when not identical to
   // an existing entry (no key to conflict on).
   if (!additions.is_array()) return;
@@ -72,13 +71,13 @@ void merge_unkeyed_list(Json& target, const Json& additions, bool check_only) {
     bool present = false;
     for (const auto& cur : target.items())
       if (cur == add) present = true;
-    if (!present && !check_only) target.push_back(add);
+    if (!present) target.push_back(add);
   }
 }
 
 void merge_string_map(Json& target, const Json& additions,
                       const std::string& what, const std::string& source,
-                      std::vector<std::string>& conflicts, bool check_only) {
+                      std::vector<std::string>& conflicts) {
   if (!additions.is_object()) return;
   if (!target.is_object()) target = Json::object();
   for (const auto& m : additions.members()) {
@@ -89,13 +88,13 @@ void merge_string_map(Json& target, const Json& additions,
                             "' from poddefault '" + source + "'");
       continue;
     }
-    if (!check_only) target[m.first] = m.second;
+    target[m.first] = m.second;
   }
 }
 
 // Applies one PodDefault onto the pod (or only records conflicts).
-void apply_one(Json& pod, const Json& pd, std::vector<std::string>& conflicts,
-               bool check_only) {
+void apply_one(Json& pod, const Json& pd,
+               std::vector<std::string>& conflicts) {
   const std::string source = pd_name(pd);
   const Json* spec = pd.find("spec");
   if (!spec || !spec->is_object()) return;
@@ -108,18 +107,16 @@ void apply_one(Json& pod, const Json& pd, std::vector<std::string>& conflicts,
     if (!containers.is_array()) return;
     for (auto& c : containers.items()) {
       if (const Json* env = spec->find("env"))
-        merge_keyed_list(c["env"], *env, "name", "env", source, conflicts,
-                         check_only);
+        merge_keyed_list(c["env"], *env, "name", "env", source, conflicts);
       if (const Json* env_from = spec->find("envFrom"))
-        merge_unkeyed_list(c["envFrom"], *env_from, check_only);
+        merge_unkeyed_list(c["envFrom"], *env_from);
       if (const Json* vm = spec->find("volumeMounts"))
-        merge_volume_mounts(c["volumeMounts"], *vm, source, conflicts,
-                            check_only);
+        merge_volume_mounts(c["volumeMounts"], *vm, source, conflicts);
       if (const Json* cmd = spec->find("command")) {
-        if (!c.contains("command") && !check_only) c["command"] = *cmd;
+        if (!c.contains("command")) c["command"] = *cmd;
       }
       if (const Json* args = spec->find("args")) {
-        if (!c.contains("args") && !check_only) c["args"] = *args;
+        if (!c.contains("args")) c["args"] = *args;
       }
     }
   };
@@ -129,17 +126,17 @@ void apply_one(Json& pod, const Json& pd, std::vector<std::string>& conflicts,
 
   if (const Json* vols = spec->find("volumes"))
     merge_keyed_list(pod_spec["volumes"], *vols, "name", "volume", source,
-                     conflicts, check_only);
+                     conflicts);
   if (const Json* tols = spec->find("tolerations"))
-    merge_unkeyed_list(pod_spec["tolerations"], *tols, check_only);
+    merge_unkeyed_list(pod_spec["tolerations"], *tols);
   if (const Json* ips = spec->find("imagePullSecrets"))
-    merge_unkeyed_list(pod_spec["imagePullSecrets"], *ips, check_only);
+    merge_unkeyed_list(pod_spec["imagePullSecrets"], *ips);
   if (const Json* init = spec->find("initContainers"))
     merge_keyed_list(pod_spec["initContainers"], *init, "name",
-                     "initContainer", source, conflicts, check_only);
+                     "initContainer", source, conflicts);
   if (const Json* sidecars = spec->find("sidecars"))
     merge_keyed_list(pod_spec["containers"], *sidecars, "name", "sidecar",
-                     source, conflicts, check_only);
+                     source, conflicts);
 
   if (const Json* sa = spec->find("serviceAccountName")) {
     if (sa->is_string()) {
@@ -147,33 +144,30 @@ void apply_one(Json& pod, const Json& pd, std::vector<std::string>& conflicts,
       if (!cur.empty() && cur != sa->as_string() && cur != "default")
         conflicts.push_back("conflict on serviceAccountName from poddefault '" +
                             source + "'");
-      else if (!check_only)
+      else
         pod_spec["serviceAccountName"] = *sa;
     }
   }
   if (const Json* automount = spec->find("automountServiceAccountToken")) {
-    if (!check_only) pod_spec["automountServiceAccountToken"] = *automount;
+    pod_spec["automountServiceAccountToken"] = *automount;
   }
 
   Json& meta = pod["metadata"];
   if (!meta.is_object()) meta = Json::object();
   if (const Json* labels = spec->find("labels"))
-    merge_string_map(meta["labels"], *labels, "label", source, conflicts,
-                     check_only);
+    merge_string_map(meta["labels"], *labels, "label", source, conflicts);
   if (const Json* ann = spec->find("annotations"))
     merge_string_map(meta["annotations"], *ann, "annotation", source,
-                     conflicts, check_only);
+                     conflicts);
 
-  if (!check_only) {
-    // Stamp which PodDefault revision touched this pod (reference
-    // main.go:590-593) — the UI shows it, and idempotency checks use it.
-    Json& anns = meta["annotations"];
-    if (!anns.is_object()) anns = Json::object();
-    std::string rv;
-    if (const Json* pmeta = pd.find("metadata"))
-      rv = pmeta->get_string("resourceVersion", "0");
-    anns[std::string(kAnnotationPrefix) + "poddefault-" + source] = Json(rv);
-  }
+  // Stamp which PodDefault revision touched this pod (reference
+  // main.go:590-593) — the UI shows it, and idempotency checks use it.
+  Json& anns = meta["annotations"];
+  if (!anns.is_object()) anns = Json::object();
+  std::string rv;
+  if (const Json* pmeta = pd.find("metadata"))
+    rv = pmeta->get_string("resourceVersion", "0");
+  anns[std::string(kAnnotationPrefix) + "poddefault-" + source] = Json(rv);
 }
 
 }  // namespace
@@ -307,13 +301,12 @@ Json poddefault_mutate(const Json& pod, const Json& poddefaults) {
   result["matched"] = matched_names;
   std::vector<std::string> conflicts;
 
-  // Pass 1: check-only across ALL matched poddefaults on a scratch copy —
-  // aggregate every conflict before touching anything (reference
-  // safeToApplyPodDefaultsOnPod).
+  // Apply every matched poddefault onto a scratch copy, aggregating every
+  // conflict (including between two poddefaults' new values) before
+  // deciding; the input pod stays untouched unless all merges are clean
+  // (reference safeToApplyPodDefaultsOnPod semantics in one pass).
   Json scratch = pod;
-  for (const Json* pd : matched) apply_one(scratch, *pd, conflicts, false);
-  // (apply for real onto the scratch so cross-poddefault conflicts between
-  // two *new* values are caught; pod itself is still untouched.)
+  for (const Json* pd : matched) apply_one(scratch, *pd, conflicts);
 
   Json conflict_list = Json::array();
   for (const auto& c : conflicts) conflict_list.push_back(Json(c));

@@ -72,8 +72,9 @@ class TestDistributedEnv:
         denv = DistributedEnv.from_env(env)
         assert denv.process_id == 2
         assert denv.num_processes == 4
-        assert denv.coordinator_address == "nb-0.nb.user-ns.svc:8476"
-        assert denv.worker_hostnames[3] == "nb-3.nb.user-ns.svc"
+        # DNS under the controller's headless "<name>-hosts" Service.
+        assert denv.coordinator_address == "nb-0.nb-hosts.user-ns.svc:8476"
+        assert denv.worker_hostnames[3] == "nb-3.nb-hosts.user-ns.svc"
 
     def test_single_replica_env_has_no_coordinator(self):
         env = slice_env_for_rank("nb", "ns", rank=0, num_replicas=1)
