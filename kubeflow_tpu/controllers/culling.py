@@ -1,0 +1,165 @@
+"""Culling controller: probe idle notebooks, scale them to zero.
+
+Python half of the reference culler (reference
+controllers/culling_controller.go:78-162): periodically probes each
+Notebook's Jupyter ``/api/kernels`` endpoint over the cluster network and
+feeds the result to the native decision engine (native/src/culler.cpp),
+which owns annotation bookkeeping and the stop decision. TPU delta: an
+injectable ``tpu_busy_probe`` (device-metrics signal) vetoes culling a
+slice mid-run even when kernels look idle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable
+
+from kubeflow_tpu import native
+from kubeflow_tpu.controllers.runtime import Controller, Request, WatchSpec
+from kubeflow_tpu.controllers.time_utils import parse_rfc3339
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+# Probe signature: (namespace, name) -> list of kernel dicts, or None when
+# the notebook is unreachable. Production uses HTTP GET
+# http://<name>.<ns>.svc/notebook/<ns>/<name>/api/kernels (reference
+# getNotebookApiKernels, culling_controller.go:202-241); tests inject.
+KernelProbe = Callable[[str, str], list | None]
+
+
+def http_kernel_probe(timeout: float = 5.0) -> KernelProbe:
+    import json
+    import urllib.request
+
+    def probe(namespace: str, name: str):
+        url = (
+            f"http://{name}.{namespace}.svc.cluster.local"
+            f"/notebook/{namespace}/{name}/api/kernels"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:
+            return None
+
+    return probe
+
+
+@dataclasses.dataclass
+class CullingOptions:
+    """ENABLE_CULLING / CULL_IDLE_TIME / IDLENESS_CHECK_PERIOD env parity
+    (reference initGlobalVars, culling_controller.go:405-438)."""
+
+    enabled: bool = False
+    cull_idle_time_min: int = 1440
+    idleness_check_period_min: int = 1
+
+    def to_native(self) -> dict:
+        return {
+            "cullIdleTimeMin": self.cull_idle_time_min,
+            "idlenessCheckPeriodMin": self.idleness_check_period_min,
+        }
+
+
+class CullingReconciler:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        kernel_probe: KernelProbe,
+        options: CullingOptions | None = None,
+        tpu_busy_probe: Callable[[str, str], bool] | None = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.kernel_probe = kernel_probe
+        self.options = options or CullingOptions()
+        self.tpu_busy_probe = tpu_busy_probe
+        self.clock = clock
+
+    def reconcile(self, req: Request) -> float | None:
+        if not self.options.enabled:
+            return None
+        try:
+            notebook = self.api.get(
+                NOTEBOOK_API, "Notebook", req.name, req.namespace
+            )
+        except NotFound:
+            return None
+
+        # Cheap pre-checks BEFORE the (networked) kernel probe — mirrors
+        # the reference's ordering (culling_controller.go:96-137): skip
+        # stopped notebooks and honour the check-timestamp rate limit so
+        # every watch event doesn't cost an HTTP round-trip.
+        annotations = notebook["metadata"].get("annotations") or {}
+        period_sec = 60.0 * self.options.idleness_check_period_min
+        if "kubeflow-resource-stopped" in annotations:
+            return period_sec
+        last_check = parse_rfc3339(
+            annotations.get(
+                "notebooks.kubeflow.org/last_activity_check_timestamp", ""
+            )
+        )
+        now = int(self.clock())
+        if last_check is not None and now - last_check < period_sec:
+            return period_sec - (now - last_check)
+
+        # Pod must exist before idleness accounting starts (reference
+        # culling_controller.go:107-118).
+        try:
+            self.api.get("v1", "Pod", f"{req.name}-0", req.namespace)
+        except NotFound:
+            return period_sec
+
+        kernels = self.kernel_probe(req.namespace, req.name)
+        config = self.options.to_native()
+        if self.tpu_busy_probe is not None:
+            config["tpuBusy"] = bool(self.tpu_busy_probe(req.namespace, req.name))
+
+        decision = native.invoke(
+            "cull_decide",
+            {
+                "notebook": notebook,
+                "kernels": kernels,
+                "nowEpoch": int(self.clock()),
+                "config": config,
+            },
+        )
+        if decision["action"] in ("update-annotations", "stop"):
+            self.api.patch_merge(
+                NOTEBOOK_API,
+                "Notebook",
+                req.name,
+                {"metadata": {"annotations": decision["annotations"]}},
+                req.namespace,
+            )
+            if decision["action"] == "stop":
+                log.info("culled idle notebook %s/%s", req.namespace, req.name)
+        return float(decision["requeueAfterSec"])
+
+
+def make_culling_controller(
+    api: FakeApiServer,
+    kernel_probe: KernelProbe | None = None,
+    options: CullingOptions | None = None,
+    tpu_busy_probe: Callable[[str, str], bool] | None = None,
+    clock: Callable[[], float] = time.time,
+) -> Controller:
+    reconciler = CullingReconciler(
+        api,
+        kernel_probe or http_kernel_probe(),
+        options,
+        tpu_busy_probe,
+        clock,
+    )
+    return Controller(
+        name="culling-controller",
+        api=api,
+        reconciler=reconciler,
+        watches=[WatchSpec(NOTEBOOK_API, "Notebook")],
+        resync_period=60.0,
+    )

@@ -1,0 +1,158 @@
+"""Notebook controller: Notebook CR → StatefulSet + Services + route.
+
+The Python half of the controller: watches and API writes. All policy —
+desired-state generation (TPU replicas, env, selectors), drift repair,
+status derivation — happens in the native core (native/src/notebook.cpp),
+capability parity with the reference notebook-controller
+(reference controllers/notebook_controller.go:89-225 Reconcile).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from kubeflow_tpu import native
+from kubeflow_tpu.controllers.runtime import Controller, Request, WatchSpec
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+@dataclasses.dataclass
+class NotebookOptions:
+    """Mirrors the reference controller's env config (USE_ISTIO,
+    ISTIO_GATEWAY, ISTIO_HOST, CLUSTER_DOMAIN, ADD_FSGROUP —
+    reference notebook_controller.go:202-208,427,489-512)."""
+
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    add_fs_group: bool = True
+
+    def to_native(self) -> dict:
+        return {
+            "useIstio": self.use_istio,
+            "istioGateway": self.istio_gateway,
+            "istioHost": self.istio_host,
+            "clusterDomain": self.cluster_domain,
+            "addFsGroup": self.add_fs_group,
+        }
+
+
+def pod_to_notebook_requests(obj: dict) -> list[Request]:
+    """Map Pod/StatefulSet events back to the owning Notebook via the
+    notebook-name label (reference predNBPodIsLabeled + event mapping,
+    notebook_controller.go:653-664)."""
+    meta = obj.get("metadata", {})
+    name = (meta.get("labels") or {}).get("notebook-name")
+    if not name:
+        return []
+    return [Request(meta.get("namespace", ""), name)]
+
+
+class NotebookReconciler:
+    def __init__(self, api: FakeApiServer, options: NotebookOptions | None = None):
+        self.api = api
+        self.options = options or NotebookOptions()
+
+    # -- create-or-update through the native drift repair ----------------
+    def _ensure(self, desired: dict) -> None:
+        api_version = desired["apiVersion"]
+        kind = desired["kind"]
+        meta = desired["metadata"]
+        try:
+            existing = self.api.get(
+                api_version, kind, meta["name"], meta.get("namespace")
+            )
+        except NotFound:
+            self.api.create(desired)
+            return
+        merged = native.invoke(
+            "copy_owned_fields",
+            {"kind": kind, "existing": existing, "desired": desired},
+        )
+        if merged["changed"]:
+            # A Conflict (stale read) propagates; the queue's rate limiter
+            # retries this key.
+            self.api.update(merged["merged"])
+
+    def reconcile(self, req: Request) -> float | None:
+        try:
+            notebook = self.api.get(
+                NOTEBOOK_API, "Notebook", req.name, req.namespace
+            )
+        except NotFound:
+            # Deleted: children are garbage-collected via ownerReferences.
+            return None
+
+        out = native.invoke(
+            "notebook_reconcile",
+            {"notebook": notebook, "options": self.options.to_native()},
+        )
+        self._ensure(out["statefulset"])
+        for svc in out["services"]:
+            self._ensure(svc)
+        if out["virtualService"] is not None:
+            self._ensure(out["virtualService"])
+
+        self._update_status(notebook)
+        return None
+
+    def _update_status(self, notebook: dict) -> None:
+        name = notebook["metadata"]["name"]
+        ns = notebook["metadata"]["namespace"]
+        try:
+            sts = self.api.get("apps/v1", "StatefulSet", name, ns)
+        except NotFound:
+            sts = {}
+        try:
+            pod = self.api.get("v1", "Pod", f"{name}-0", ns)
+        except NotFound:
+            pod = {}
+        def involves_this_notebook(event: dict) -> bool:
+            # Exact object names only: the STS itself or its replica pods
+            # ("nb", "nb-0"… but not a sibling "nb2-0").
+            obj_name = (event.get("involvedObject") or {}).get("name", "")
+            if obj_name == name:
+                return True
+            prefix, _, suffix = obj_name.rpartition("-")
+            return prefix == name and suffix.isdigit()
+
+        events = [
+            e
+            for e in self.api.list("v1", "Event", namespace=ns)
+            if involves_this_notebook(e)
+        ]
+        status = native.invoke(
+            "notebook_status",
+            {
+                "notebook": notebook,
+                "statefulset": sts,
+                "pod": pod,
+                "events": events,
+            },
+        )
+        if notebook.get("status") != status:
+            self.api.patch_merge(
+                NOTEBOOK_API, "Notebook", name, {"status": status}, ns
+            )
+
+
+def make_notebook_controller(
+    api: FakeApiServer, options: NotebookOptions | None = None
+) -> Controller:
+    reconciler = NotebookReconciler(api, options)
+    return Controller(
+        name="notebook-controller",
+        api=api,
+        reconciler=reconciler,
+        watches=[
+            WatchSpec(NOTEBOOK_API, "Notebook"),
+            WatchSpec("apps/v1", "StatefulSet", pod_to_notebook_requests),
+            WatchSpec("v1", "Pod", pod_to_notebook_requests),
+        ],
+    )

@@ -1,0 +1,19 @@
+"""Kubernetes API access layer.
+
+``FakeApiServer`` is the test ladder's envtest equivalent (SURVEY.md §4):
+an in-memory API server with resourceVersions, label selectors, watches,
+ownerReference garbage collection, and admission-webhook hooks — enough
+to run the real controllers end-to-end in-process without a cluster.
+Controllers program against the small ``ApiClient`` protocol so the same
+code drives the fake in tests and a real apiserver in deployment.
+"""
+
+from kubeflow_tpu.k8s.fake import (
+    ApiError,
+    Conflict,
+    NotFound,
+    FakeApiServer,
+    GVK,
+)
+
+__all__ = ["ApiError", "Conflict", "NotFound", "FakeApiServer", "GVK"]

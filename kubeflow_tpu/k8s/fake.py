@@ -1,0 +1,283 @@
+"""In-memory Kubernetes API server for tests and local dev.
+
+The reference tests controllers against envtest (a real kube-apiserver
+without kubelet — reference notebook-controller/controllers/suite_test.go)
+plus controller-runtime's fake client. This module plays both roles:
+typed-enough storage with optimistic concurrency (resourceVersion),
+label-selector list/watch, ownerReference cascade deletion, and a
+mutating-admission hook point so the PodDefault webhook can run in the
+same process. Deliberately synchronous — watches deliver into queues,
+controllers drain them deterministically in tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, code: int = 400):
+        super().__init__(message)
+        self.code = code
+
+
+class NotFound(ApiError):
+    def __init__(self, message: str):
+        super().__init__(message, 404)
+
+
+class Conflict(ApiError):
+    def __init__(self, message: str):
+        super().__init__(message, 409)
+
+
+@dataclass(frozen=True)
+class GVK:
+    """Group/version/kind triple; keys storage and watches."""
+
+    group: str
+    version: str
+    kind: str
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "GVK":
+        api_version = obj.get("apiVersion", "v1")
+        kind = obj.get("kind")
+        if not kind:
+            raise ApiError("object missing kind")
+        if "/" in api_version:
+            group, version = api_version.split("/", 1)
+        else:
+            group, version = "", api_version
+        return cls(group, version, kind)
+
+
+# Kinds that are cluster-scoped (no namespace key).
+CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "ClusterRoleBinding",
+                  "StorageClass", "Node", "PersistentVolume"}
+
+
+def match_label_selector(labels: dict, selector: str) -> bool:
+    """Equality-based selector string: "a=b,c!=d,e" (exists)."""
+    labels = labels or {}
+    for term in [t.strip() for t in selector.split(",") if t.strip()]:
+        if "!=" in term:
+            key, val = term.split("!=", 1)
+            if labels.get(key.strip()) == val.strip():
+                return False
+        elif "=" in term:
+            key, val = term.split("=", 1)
+            if labels.get(key.strip()) != val.strip():
+                return False
+        else:
+            if term not in labels:
+                return False
+    return True
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[GVK, dict[tuple[str, str], dict]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: dict[GVK, list[queue.Queue]] = {}
+        # Mutating admission hooks: fn(obj) -> mutated obj (or raises
+        # ApiError to reject). Keyed by kind, applied on CREATE.
+        self._admission: dict[str, list[Callable[[dict], dict]]] = {}
+
+    # ---- admission -------------------------------------------------------
+    def register_admission(self, kind: str, hook: Callable[[dict], dict]):
+        self._admission.setdefault(kind, []).append(hook)
+
+    # ---- helpers ---------------------------------------------------------
+    def _key(self, gvk: GVK, namespace: str | None, name: str):
+        ns = "" if gvk.kind in CLUSTER_SCOPED else (namespace or "default")
+        return (ns, name)
+
+    def _bucket(self, gvk: GVK) -> dict:
+        return self._store.setdefault(gvk, {})
+
+    def _notify(self, gvk: GVK, event: WatchEvent):
+        for q in self._watchers.get(gvk, []):
+            q.put(WatchEvent(event.type, copy.deepcopy(event.object)))
+
+    # ---- CRUD ------------------------------------------------------------
+    def create(self, obj: dict, namespace: str | None = None) -> dict:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            gvk = GVK.from_obj(obj)
+            meta = obj.setdefault("metadata", {})
+            name = meta.get("name")
+            if not name:
+                if meta.get("generateName"):
+                    name = meta["generateName"] + uuid.uuid4().hex[:6]
+                    meta["name"] = name
+                else:
+                    raise ApiError("metadata.name required")
+            if gvk.kind not in CLUSTER_SCOPED:
+                meta.setdefault("namespace", namespace or "default")
+            key = self._key(gvk, meta.get("namespace"), name)
+            bucket = self._bucket(gvk)
+            if key in bucket:
+                raise Conflict(f"{gvk.kind} {key} already exists")
+            for hook in self._admission.get(gvk.kind, []):
+                obj = hook(obj)
+                meta = obj["metadata"]
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = str(next(self._rv))
+            meta.setdefault(
+                "creationTimestamp",
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            )
+            bucket[key] = obj
+            self._notify(gvk, WatchEvent("ADDED", obj))
+            return copy.deepcopy(obj)
+
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: str | None = None) -> dict:
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            key = self._key(gvk, namespace, name)
+            obj = self._bucket(gvk).get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(self, api_version: str, kind: str, namespace: str | None = None,
+             label_selector: str | None = None) -> list[dict]:
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            out = []
+            for (ns, _), obj in self._bucket(gvk).items():
+                if namespace and gvk.kind not in CLUSTER_SCOPED and ns != namespace:
+                    continue
+                if label_selector and not match_label_selector(
+                    obj.get("metadata", {}).get("labels", {}), label_selector
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return sorted(
+                out, key=lambda o: (o["metadata"].get("namespace", ""),
+                                    o["metadata"]["name"])
+            )
+
+    def update(self, obj: dict) -> dict:
+        """Full replace with optimistic concurrency (resourceVersion)."""
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            gvk = GVK.from_obj(obj)
+            meta = obj.get("metadata", {})
+            key = self._key(gvk, meta.get("namespace"), meta.get("name"))
+            bucket = self._bucket(gvk)
+            cur = bucket.get(key)
+            if cur is None:
+                raise NotFound(f"{gvk.kind} {key} not found")
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{gvk.kind} {key}: resourceVersion {sent_rv} stale"
+                )
+            meta["uid"] = cur["metadata"]["uid"]
+            meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
+            meta["resourceVersion"] = str(next(self._rv))
+            bucket[key] = obj
+            self._notify(gvk, WatchEvent("MODIFIED", obj))
+            return copy.deepcopy(obj)
+
+    def patch_merge(self, api_version: str, kind: str, name: str,
+                    patch: dict, namespace: str | None = None) -> dict:
+        """RFC 7386 JSON merge patch (what kubectl annotate/label use)."""
+        with self._lock:
+            cur = self.get(api_version, kind, name, namespace)
+
+            def merge(dst, src):
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = copy.deepcopy(v)
+
+            merge(cur, patch)
+            cur["metadata"].pop("resourceVersion", None)
+            gvk = GVK.from_obj(cur)
+            key = self._key(gvk, cur["metadata"].get("namespace"),
+                            cur["metadata"]["name"])
+            bucket = self._bucket(gvk)
+            existing = bucket[key]
+            cur["metadata"]["resourceVersion"] = str(next(self._rv))
+            cur["metadata"]["uid"] = existing["metadata"]["uid"]
+            bucket[key] = cur
+            self._notify(gvk, WatchEvent("MODIFIED", cur))
+            return copy.deepcopy(cur)
+
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: str | None = None) -> None:
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            key = self._key(gvk, namespace, name)
+            bucket = self._bucket(gvk)
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            self._notify(gvk, WatchEvent("DELETED", obj))
+            self._collect_orphans(obj)
+
+    def _collect_orphans(self, owner: dict):
+        """ownerReference cascade: delete dependents of a deleted owner
+        (background GC semantics, synchronously)."""
+        owner_uid = owner.get("metadata", {}).get("uid")
+        if not owner_uid:
+            return
+        to_delete = []
+        for gvk, bucket in self._store.items():
+            for (ns, name), obj in bucket.items():
+                refs = obj.get("metadata", {}).get("ownerReferences", [])
+                if any(r.get("uid") == owner_uid for r in refs):
+                    to_delete.append((gvk, ns, name))
+        for gvk, ns, name in to_delete:
+            try:
+                self.delete(gvk.api_version, gvk.kind, name, ns or None)
+            except NotFound:
+                pass
+
+    # ---- watch -----------------------------------------------------------
+    def watch(self, api_version: str, kind: str) -> queue.Queue:
+        """Subscribe to all events for a kind; returns the event queue."""
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            q: queue.Queue = queue.Queue()
+            self._watchers.setdefault(gvk, []).append(q)
+            return q
+
+    # ---- convenience for tests ------------------------------------------
+    def apply(self, obj: dict) -> dict:
+        """Create-or-update (server-side-apply-lite) for fixtures."""
+        try:
+            return self.create(obj)
+        except Conflict:
+            gvk = GVK.from_obj(obj)
+            meta = obj["metadata"]
+            cur = self.get(gvk.api_version, gvk.kind, meta["name"],
+                           meta.get("namespace"))
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            return self.update(obj)

@@ -1,0 +1,221 @@
+"""Controller integration tests against the fake API server — the
+envtest tier of the ladder (SURVEY.md §4 tier 2): real reconcilers, real
+native core, in-memory apiserver, no kubelet (pods are simulated)."""
+
+import pytest
+
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    make_culling_controller,
+)
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    make_notebook_controller,
+)
+from kubeflow_tpu.controllers.runtime import Request
+from kubeflow_tpu.controllers.time_utils import rfc3339
+from kubeflow_tpu.k8s import FakeApiServer, NotFound
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+def notebook_cr(name="nb", ns="user", tpu=None, annotations=None):
+    nb = {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{"name": name, "image": "jupyter-jax-tpu"}]
+                }
+            }
+        },
+    }
+    if tpu:
+        nb["spec"]["tpu"] = tpu
+    if annotations:
+        nb["metadata"]["annotations"] = annotations
+    return nb
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+class TestNotebookController:
+    def test_creates_children_for_single_pod(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+        assert sts["spec"]["replicas"] == 1
+        assert api.get("v1", "Service", "nb", "user")
+        assert api.get("v1", "Service", "nb-hosts", "user")
+
+    def test_v5e16_multihost_statefulset(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr(tpu={"accelerator": "v5e", "topology": "4x4"}))
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+        assert sts["spec"]["replicas"] == 4
+        c = sts["spec"]["template"]["spec"]["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+
+    def test_istio_virtualservice(self, api):
+        ctrl = make_notebook_controller(api, NotebookOptions(use_istio=True))
+        api.create(notebook_cr())
+        ctrl.run_once()
+        vs = api.get("networking.istio.io/v1", "VirtualService",
+                     "notebook-user-nb", "user")
+        assert vs["spec"]["http"][0]["match"][0]["uri"]["prefix"] == "/notebook/user/nb/"
+
+    def test_stop_annotation_scales_down_existing(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr(tpu={"accelerator": "v5e", "topology": "4x4"}))
+        ctrl.run_once()
+        assert api.get("apps/v1", "StatefulSet", "nb", "user")["spec"]["replicas"] == 4
+        # User presses Stop (JWA PATCH sets the annotation — reference
+        # apps/common/routes/patch.py:18-80).
+        api.patch_merge(
+            NOTEBOOK_API, "Notebook", "nb",
+            {"metadata": {"annotations": {"kubeflow-resource-stopped": "now"}}},
+            "user",
+        )
+        ctrl.run_once()
+        assert api.get("apps/v1", "StatefulSet", "nb", "user")["spec"]["replicas"] == 0
+
+    def test_drift_repair(self, api):
+        """Manual edits to owned fields are reverted (level-based)."""
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+        sts["spec"]["replicas"] = 5
+        api.update(sts)
+        ctrl.run_once()
+        assert api.get("apps/v1", "StatefulSet", "nb", "user")["spec"]["replicas"] == 1
+
+    def test_status_mirrors_pod_and_events(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+        # Simulate kubelet: rank-0 pod running, STS ready.
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "nb-0",
+                    "namespace": "user",
+                    "labels": {"notebook-name": "nb", "statefulset": "nb"},
+                },
+                "status": {
+                    "containerStatuses": [
+                        {"state": {"running": {"startedAt": "2026-07-29T00:00:00Z"}}}
+                    ],
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            }
+        )
+        sts["status"] = {"readyReplicas": 1}
+        api.update(sts)
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        assert nb["status"]["readyReplicas"] == 1
+        assert "running" in nb["status"]["containerState"]
+
+    def test_deleting_notebook_garbage_collects_children(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        api.delete(NOTEBOOK_API, "Notebook", "nb", "user")
+        ctrl.run_once()
+        with pytest.raises(NotFound):
+            api.get("apps/v1", "StatefulSet", "nb", "user")
+        with pytest.raises(NotFound):
+            api.get("v1", "Service", "nb", "user")
+
+    def test_reconcile_idempotent(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        rv1 = api.get("apps/v1", "StatefulSet", "nb", "user")["metadata"]["resourceVersion"]
+        ctrl.queue.add(Request("user", "nb"))
+        ctrl.run_once()
+        rv2 = api.get("apps/v1", "StatefulSet", "nb", "user")["metadata"]["resourceVersion"]
+        assert rv1 == rv2  # no spurious writes
+
+
+class TestCullingController:
+    NOW = 1_800_000_000
+
+    def make(self, api, kernels, now=None, tpu_busy=False, idle_min=60):
+        self.current_time = now or self.NOW
+        ctrl = make_culling_controller(
+            api,
+            kernel_probe=lambda ns, name: kernels,
+            options=CullingOptions(
+                enabled=True,
+                cull_idle_time_min=idle_min,
+                idleness_check_period_min=5,
+            ),
+            tpu_busy_probe=(lambda ns, name: tpu_busy) if tpu_busy else None,
+            clock=lambda: self.current_time,
+        )
+        return ctrl
+
+    def seed(self, api, annotations=None):
+        api.create(notebook_cr(annotations=annotations))
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "nb-0", "namespace": "user",
+                             "labels": {"notebook-name": "nb"}},
+            }
+        )
+
+    def test_active_notebook_annotated_not_stopped(self, api):
+        ctrl = self.make(api, kernels=[
+            {"execution_state": "busy", "last_activity": "2026-07-29T10:00:00Z"}
+        ])
+        self.seed(api)
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        anns = nb["metadata"]["annotations"]
+        assert "notebooks.kubeflow.org/last-activity" in anns
+        assert "kubeflow-resource-stopped" not in anns
+
+    def test_idle_notebook_gets_stopped_and_scaled_down(self, api):
+        idle_since = rfc3339(self.NOW - 120 * 60)
+        nbctrl = make_notebook_controller(api)  # watching before CR exists
+        ctrl = self.make(api, kernels=[])
+        self.seed(api, annotations={"notebooks.kubeflow.org/last-activity": idle_since})
+        nbctrl.run_once()
+        assert api.get("apps/v1", "StatefulSet", "nb", "user")["spec"]["replicas"] == 1
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        assert "kubeflow-resource-stopped" in nb["metadata"]["annotations"]
+        # The notebook controller reacts to the annotation: scale to zero.
+        nbctrl.run_once()
+        assert api.get("apps/v1", "StatefulSet", "nb", "user")["spec"]["replicas"] == 0
+
+    def test_tpu_busy_vetoes_cull(self, api):
+        idle_since = rfc3339(self.NOW - 120 * 60)
+        ctrl = self.make(api, kernels=[], tpu_busy=True)
+        self.seed(api, annotations={"notebooks.kubeflow.org/last-activity": idle_since})
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        assert "kubeflow-resource-stopped" not in nb["metadata"]["annotations"]
+
+    def test_disabled_culler_never_touches(self, api):
+        ctrl = make_culling_controller(
+            api, kernel_probe=lambda ns, name: [], options=CullingOptions(enabled=False)
+        )
+        self.seed(api)
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        assert "annotations" not in nb["metadata"] or not nb["metadata"].get("annotations")

@@ -1,0 +1,120 @@
+"""Fake API server semantics: CRUD, optimistic concurrency, selectors,
+watches, ownerReference GC, admission hooks."""
+
+import pytest
+
+from kubeflow_tpu.k8s import Conflict, FakeApiServer, NotFound
+
+
+def pod(name, ns="default", labels=None, owner_uid=None):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"containers": [{"name": "c", "image": "img"}]},
+    }
+    if labels:
+        obj["metadata"]["labels"] = labels
+    if owner_uid:
+        obj["metadata"]["ownerReferences"] = [
+            {"kind": "StatefulSet", "name": "owner", "uid": owner_uid}
+        ]
+    return obj
+
+
+def test_create_get_roundtrip():
+    api = FakeApiServer()
+    created = api.create(pod("a"))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    got = api.get("v1", "Pod", "a", "default")
+    assert got["spec"]["containers"][0]["image"] == "img"
+
+
+def test_duplicate_create_conflicts():
+    api = FakeApiServer()
+    api.create(pod("a"))
+    with pytest.raises(Conflict):
+        api.create(pod("a"))
+
+
+def test_update_optimistic_concurrency():
+    api = FakeApiServer()
+    created = api.create(pod("a"))
+    stale = dict(created)
+    api.update(created)  # bumps RV
+    with pytest.raises(Conflict):
+        api.update(stale)
+
+
+def test_label_selector_list():
+    api = FakeApiServer()
+    api.create(pod("a", labels={"app": "x", "tier": "web"}))
+    api.create(pod("b", labels={"app": "y"}))
+    assert len(api.list("v1", "Pod", label_selector="app=x")) == 1
+    assert len(api.list("v1", "Pod", label_selector="app!=x")) == 1
+    assert len(api.list("v1", "Pod", label_selector="tier")) == 1
+    assert len(api.list("v1", "Pod", label_selector="app=x,tier=web")) == 1
+
+
+def test_namespace_isolation():
+    api = FakeApiServer()
+    api.create(pod("a", ns="ns1"))
+    api.create(pod("a", ns="ns2"))
+    assert len(api.list("v1", "Pod")) == 2
+    assert len(api.list("v1", "Pod", namespace="ns1")) == 1
+    with pytest.raises(NotFound):
+        api.get("v1", "Pod", "a", "ns3")
+
+
+def test_merge_patch_add_and_remove():
+    api = FakeApiServer()
+    api.create(pod("a", labels={"keep": "1", "drop": "2"}))
+    patched = api.patch_merge(
+        "v1", "Pod", "a",
+        {"metadata": {"labels": {"drop": None, "new": "3"}}},
+        "default",
+    )
+    assert patched["metadata"]["labels"] == {"keep": "1", "new": "3"}
+
+
+def test_owner_reference_cascade_delete():
+    api = FakeApiServer()
+    sts = api.create(
+        {"apiVersion": "apps/v1", "kind": "StatefulSet",
+         "metadata": {"name": "owner", "namespace": "default"}, "spec": {}}
+    )
+    api.create(pod("owner-0", owner_uid=sts["metadata"]["uid"]))
+    api.delete("apps/v1", "StatefulSet", "owner", "default")
+    with pytest.raises(NotFound):
+        api.get("v1", "Pod", "owner-0", "default")
+
+
+def test_watch_delivers_lifecycle():
+    api = FakeApiServer()
+    q = api.watch("v1", "Pod")
+    api.create(pod("a"))
+    api.patch_merge("v1", "Pod", "a", {"metadata": {"labels": {"x": "1"}}}, "default")
+    api.delete("v1", "Pod", "a", "default")
+    types = [q.get_nowait().type for _ in range(3)]
+    assert types == ["ADDED", "MODIFIED", "DELETED"]
+
+
+def test_admission_hook_mutates_on_create():
+    api = FakeApiServer()
+
+    def hook(obj):
+        obj["metadata"].setdefault("labels", {})["mutated"] = "yes"
+        return obj
+
+    api.register_admission("Pod", hook)
+    created = api.create(pod("a"))
+    assert created["metadata"]["labels"]["mutated"] == "yes"
+
+
+def test_cluster_scoped_kinds_ignore_namespace():
+    api = FakeApiServer()
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "team-a"}})
+    got = api.get("v1", "Namespace", "team-a")
+    assert got["metadata"]["name"] == "team-a"
