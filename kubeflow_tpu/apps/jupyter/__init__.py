@@ -1,0 +1,11 @@
+"""Jupyter web app (JWA) backend — the notebook spawner + manager.
+
+REST surface parity with the reference JWA (reference crud-web-apps/
+jupyter/backend/apps/{default,common}/routes/*.py), TPU-first form
+schema. All routes authenticate via the shared crud_backend middleware
+and authorize the end user per-verb against the target namespace.
+"""
+
+from kubeflow_tpu.apps.jupyter.app import create_app
+
+__all__ = ["create_app"]

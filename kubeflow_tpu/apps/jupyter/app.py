@@ -1,0 +1,229 @@
+"""JWA application factory and routes."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import yaml
+
+from kubeflow_tpu.apps.jupyter import form as form_mod
+from kubeflow_tpu.apps.jupyter.status import STOP_ANNOTATION, process_status
+from kubeflow_tpu.controllers.time_utils import rfc3339
+from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.authz import ensure
+from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
+from kubeflow_tpu.topology import spawner_presets
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+PODDEFAULT_API = "kubeflow.org/v1alpha1"
+
+_CONFIG_PATH = os.path.join(
+    os.path.dirname(__file__), "config", "spawner_ui_config.yaml"
+)
+_CONFIG_TTL_SECONDS = 60
+
+
+class _ConfigCache:
+    """TTL-cached admin config (reference apps/common/utils.py:45-55 —
+    the ConfigMap mount refreshes without a restart)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cached: dict | None = None
+        self._loaded_at = 0.0
+
+    def get(self) -> dict:
+        now = time.monotonic()
+        if self._cached is None or now - self._loaded_at > _CONFIG_TTL_SECONDS:
+            with open(self.path) as fh:
+                self._cached = yaml.safe_load(fh) or {}
+            self._loaded_at = now
+        return self._cached
+
+
+def create_app(
+    api,
+    authn: AuthnConfig | None = None,
+    authorizer=None,
+    config_path: str | None = None,
+    secure_cookies: bool = False,
+) -> RestApp:
+    app = RestApp(
+        "jwa",
+        authn=authn,
+        authorizer=authorizer,
+        secure_cookies=secure_cookies,
+    )
+    config_cache = _ConfigCache(config_path or _CONFIG_PATH)
+
+    def notebook_view(nb: dict) -> dict:
+        try:
+            return _notebook_view(nb)
+        except (KeyError, IndexError, TypeError):
+            # One malformed CR (created outside JWA) must not 500 the
+            # whole namespace listing.
+            return {
+                "name": (nb.get("metadata") or {}).get("name", "?"),
+                "namespace": (nb.get("metadata") or {}).get("namespace", "?"),
+                "status": {
+                    "phase": "error",
+                    "message": "Notebook has a malformed spec.",
+                },
+            }
+
+    def _notebook_view(nb: dict) -> dict:
+        tpu = (nb.get("spec") or {}).get("tpu") or {}
+        container = nb["spec"]["template"]["spec"]["containers"][0]
+        return {
+            "name": nb["metadata"]["name"],
+            "namespace": nb["metadata"]["namespace"],
+            "image": container.get("image", ""),
+            "cpu": (container.get("resources", {}).get("requests") or {}).get("cpu"),
+            "memory": (container.get("resources", {}).get("requests") or {}).get("memory"),
+            "tpu": tpu or None,
+            "status": process_status(nb),
+            "age": nb["metadata"].get("creationTimestamp"),
+            "stopped": STOP_ANNOTATION in (nb["metadata"].get("annotations") or {}),
+        }
+
+    # ---- config / discovery --------------------------------------------
+    @app.route("/api/config")
+    def get_config(request):
+        config = config_cache.get()
+        accelerators = (
+            (config.get("spawnerFormDefaults") or {}).get("tpu") or {}
+        ).get("accelerators") or ["v5e"]
+        return {
+            "config": config.get("spawnerFormDefaults", {}),
+            "tpuPresets": spawner_presets(accelerators),
+        }
+
+    @app.route("/api/namespaces")
+    def list_namespaces(request):
+        names = [
+            ns["metadata"]["name"] for ns in api.list("v1", "Namespace")
+        ]
+        return {"namespaces": names}
+
+    # ---- notebooks ------------------------------------------------------
+    @app.route("/api/namespaces/<namespace>/notebooks")
+    def list_notebooks(request, namespace):
+        ensure(app.authorizer, request.user, "list", "kubeflow.org",
+               "notebooks", namespace)
+        notebooks = api.list(NOTEBOOK_API, "Notebook", namespace=namespace)
+        return {"notebooks": [notebook_view(nb) for nb in notebooks]}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>")
+    def get_notebook(request, namespace, name):
+        ensure(app.authorizer, request.user, "get", "kubeflow.org",
+               "notebooks", namespace)
+        try:
+            nb = api.get(NOTEBOOK_API, "Notebook", name, namespace)
+        except NotFound:
+            raise ApiError(f"notebook {name!r} not found", 404)
+        return {"notebook": nb, "processed": notebook_view(nb)}
+
+    @app.route("/api/namespaces/<namespace>/notebooks", methods=["POST"])
+    def post_notebook(request, namespace):
+        ensure(app.authorizer, request.user, "create", "kubeflow.org",
+               "notebooks", namespace)
+        body = request.get_json(silent=True)
+        if not isinstance(body, dict):
+            raise ApiError("request body must be a JSON object")
+        nb, pvcs = form_mod.build_notebook(body, namespace, config_cache.get())
+        # Dry-run everything first so a late conflict can't orphan
+        # freshly-created PVCs (reference post.py:51-57 dry-run ordering).
+        try:
+            api.create(nb, dry_run=True)
+            for pvc in pvcs:
+                ensure(app.authorizer, request.user, "create", "",
+                       "persistentvolumeclaims", namespace)
+                api.create(pvc, dry_run=True)
+        except K8sError as exc:
+            raise ApiError(f"cannot create notebook: {exc}", 409)
+        try:
+            for pvc in pvcs:
+                api.create(pvc)
+            created = api.create(nb)
+        except K8sError as exc:
+            raise ApiError(f"failed to create notebook: {exc}", 409)
+        return {"notebook": notebook_view(created)}
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>", methods=["PATCH"]
+    )
+    def patch_notebook(request, namespace, name):
+        """{"stopped": bool} — the Stop/Start buttons (reference
+        apps/common/routes/patch.py:18-80, stop-annotation protocol)."""
+        ensure(app.authorizer, request.user, "update", "kubeflow.org",
+               "notebooks", namespace)
+        body = request.get_json(silent=True) or {}
+        if "stopped" not in body:
+            raise ApiError("PATCH body must contain 'stopped'")
+        annotation_value = rfc3339(time.time()) if body["stopped"] else None
+        try:
+            api.patch_merge(
+                NOTEBOOK_API,
+                "Notebook",
+                name,
+                {"metadata": {"annotations": {STOP_ANNOTATION: annotation_value}}},
+                namespace,
+            )
+        except NotFound:
+            raise ApiError(f"notebook {name!r} not found", 404)
+        return {}
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>", methods=["DELETE"]
+    )
+    def delete_notebook(request, namespace, name):
+        ensure(app.authorizer, request.user, "delete", "kubeflow.org",
+               "notebooks", namespace)
+        try:
+            api.delete(NOTEBOOK_API, "Notebook", name, namespace)
+        except NotFound:
+            raise ApiError(f"notebook {name!r} not found", 404)
+        return {}
+
+    # ---- supporting resources ------------------------------------------
+    @app.route("/api/namespaces/<namespace>/poddefaults")
+    def list_poddefaults(request, namespace):
+        ensure(app.authorizer, request.user, "list", "kubeflow.org",
+               "poddefaults", namespace)
+        pds = api.list(PODDEFAULT_API, "PodDefault", namespace=namespace)
+        return {
+            "poddefaults": [
+                {
+                    "label": next(
+                        iter(
+                            (pd["spec"].get("selector", {}).get("matchLabels")
+                             or {}).keys()
+                        ),
+                        pd["metadata"]["name"],
+                    ),
+                    "desc": pd["spec"].get("desc", pd["metadata"]["name"]),
+                }
+                for pd in pds
+            ]
+        }
+
+    @app.route("/api/namespaces/<namespace>/pvcs")
+    def list_pvcs(request, namespace):
+        ensure(app.authorizer, request.user, "list", "",
+               "persistentvolumeclaims", namespace)
+        pvcs = api.list("v1", "PersistentVolumeClaim", namespace=namespace)
+        return {
+            "pvcs": [
+                {
+                    "name": pvc["metadata"]["name"],
+                    "size": (pvc["spec"].get("resources", {}).get("requests")
+                             or {}).get("storage"),
+                    "mode": (pvc["spec"].get("accessModes") or [None])[0],
+                }
+                for pvc in pvcs
+            ]
+        }
+
+    return app

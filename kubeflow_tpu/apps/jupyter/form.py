@@ -1,0 +1,252 @@
+"""Spawner form → Notebook CR construction.
+
+The role of the reference's form mutators (reference crud-web-apps/
+jupyter/backend/apps/common/form.py:74-299, applied from
+apps/default/routes/post.py:30-39): each ``set_*`` step reads one form
+section, honours the admin config's readOnly pinning, and mutates the
+Notebook body. GPU vendor/count (form.py:226-250) is replaced by TPU
+accelerator/topology, which also decides multi-host replica shape.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.topology import TopologyError, TpuSlice
+
+NOTEBOOK_TEMPLATE = {
+    "apiVersion": "kubeflow.org/v1beta1",
+    "kind": "Notebook",
+    "metadata": {"name": "", "namespace": "", "labels": {}, "annotations": {}},
+    "spec": {
+        "template": {
+            "metadata": {"labels": {}, "annotations": {}},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "",
+                        "image": "",
+                        "resources": {"requests": {}, "limits": {}},
+                        "env": [],
+                        "volumeMounts": [],
+                    }
+                ],
+                "volumes": [],
+            },
+        }
+    },
+}
+
+NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def field(config: dict, form: dict, key: str, default=None):
+    """Form value unless the admin pinned the field readOnly (reference
+    form.py get_form_value)."""
+    section = (config.get("spawnerFormDefaults") or {}).get(key) or {}
+    if section.get("readOnly"):
+        return section.get("value", default)
+    if key in form:
+        return form[key]
+    return section.get("value", default)
+
+
+def parse_quantity(q) -> float:
+    """K8s quantity → float (Gi/Mi/m suffixes) for limit-factor math."""
+    s = str(q)
+    units = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+             "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+    for suffix, mult in units.items():
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
+def format_memory(value_bytes: float) -> str:
+    return f"{value_bytes / 2**30:.2f}Gi"
+
+
+def build_notebook(form: dict, namespace: str, config: dict) -> tuple[dict, list[dict]]:
+    """Returns (notebook CR, PVCs to create). Raises ApiError on invalid
+    input (the webhook's validating role for spawner-origin requests) —
+    malformed user input must never escape as a 500."""
+    try:
+        return _build_notebook(form, namespace, config)
+    except ApiError:
+        raise
+    except (TypeError, ValueError, KeyError, AttributeError) as exc:
+        raise ApiError(f"invalid form input: {type(exc).__name__}: {exc}")
+
+
+def _build_notebook(form: dict, namespace: str, config: dict) -> tuple[dict, list[dict]]:
+    name = form.get("name", "")
+    if not NAME_RE.match(name or "") or len(name) > 52:
+        raise ApiError(f"invalid notebook name {name!r}")
+
+    nb = copy.deepcopy(NOTEBOOK_TEMPLATE)
+    nb["metadata"]["name"] = name
+    nb["metadata"]["namespace"] = namespace
+    container = nb["spec"]["template"]["spec"]["containers"][0]
+    container["name"] = name
+
+    # -- image (reference form.py:74-92) --
+    custom = form.get("customImage") if form.get("customImageCheck") else None
+    if custom and not (config.get("spawnerFormDefaults") or {}).get(
+        "allowCustomImage", True
+    ):
+        raise ApiError("custom images are disabled by the admin")
+    container["image"] = (custom or field(config, form, "image", "")).strip()
+    if not container["image"]:
+        raise ApiError("no image selected")
+
+    # -- cpu/memory with limit factor (reference form.py:94-176) --
+    cpu = str(field(config, form, "cpu", "0.5"))
+    memory = str(field(config, form, "memory", "1.0Gi"))
+    cpu_section = (config.get("spawnerFormDefaults") or {}).get("cpu") or {}
+    mem_section = (config.get("spawnerFormDefaults") or {}).get("memory") or {}
+    container["resources"]["requests"]["cpu"] = cpu
+    container["resources"]["requests"]["memory"] = memory
+    cpu_factor = form.get("cpuLimit") or cpu_section.get("limitFactor", "none")
+    mem_factor = form.get("memoryLimit") or mem_section.get("limitFactor", "none")
+    if str(cpu_factor) != "none":
+        limit = (float(cpu_factor) * parse_quantity(cpu)
+                 if cpu_factor == cpu_section.get("limitFactor")
+                 else parse_quantity(cpu_factor))
+        container["resources"]["limits"]["cpu"] = f"{limit:g}"
+    if str(mem_factor) != "none":
+        limit = (float(mem_factor) * parse_quantity(memory)
+                 if mem_factor == mem_section.get("limitFactor")
+                 else parse_quantity(mem_factor))
+        container["resources"]["limits"]["memory"] = format_memory(limit)
+
+    # -- TPU slice (replaces reference form.py set_notebook_gpus) --
+    # Through field(): an admin readOnly pin must override the form.
+    tpu = field(config, form, "tpu", "none") or "none"
+    if isinstance(tpu, str):
+        tpu = {"shorthand": tpu}
+    shorthand = tpu.get("shorthand", "none")
+    if shorthand and shorthand != "none":
+        try:
+            sl = TpuSlice.from_shorthand(shorthand)
+        except TopologyError as exc:
+            raise ApiError(str(exc))
+        nb["spec"]["tpu"] = {
+            "accelerator": sl.accelerator.name,
+            "topology": sl.topology,
+        }
+    elif tpu.get("accelerator"):
+        try:
+            sl = TpuSlice.parse(tpu["accelerator"], tpu.get("topology", "1x1"))
+        except TopologyError as exc:
+            raise ApiError(str(exc))
+        nb["spec"]["tpu"] = {
+            "accelerator": sl.accelerator.name,
+            "topology": sl.topology,
+        }
+
+    # -- env (reference form.py set_notebook_environment) --
+    env = field(config, form, "environment", {}) or {}
+    if isinstance(env, dict):
+        container["env"].extend(
+            {"name": k, "value": str(v)} for k, v in env.items()
+        )
+
+    # -- PodDefault selection labels (reference form.py:252-269) --
+    configurations = field(config, form, "configurations", []) or []
+    if not (isinstance(configurations, list)
+            and all(isinstance(c, str) for c in configurations)):
+        raise ApiError("'configurations' must be a list of label strings")
+    for pd_label in configurations:
+        nb["spec"]["template"]["metadata"]["labels"][pd_label] = "true"
+
+    # -- shm (reference form.py set_notebook_shm) --
+    if field(config, form, "shm", True):
+        nb["spec"]["template"]["spec"]["volumes"].append(
+            {"name": "dshm", "emptyDir": {"medium": "Memory"}}
+        )
+        container["volumeMounts"].append(
+            {"name": "dshm", "mountPath": "/dev/shm"}
+        )
+
+    # -- volumes (reference apps/common/volumes.py + form.py:271-299) --
+    pvcs_to_create: list[dict] = []
+
+    def add_volume(vol_form: dict):
+        mount = vol_form.get("mount", "/home/jovyan")
+        if "existingSource" in vol_form:
+            src = vol_form["existingSource"]
+            vol_name = f"existing-{len(container['volumeMounts'])}"
+            nb["spec"]["template"]["spec"]["volumes"].append(
+                {"name": vol_name, **src}
+            )
+        elif "newPvc" in vol_form:
+            pvc = copy.deepcopy(vol_form["newPvc"])
+            if not isinstance(pvc, dict) or not isinstance(
+                pvc.get("metadata"), dict
+            ):
+                raise ApiError("volume 'newPvc' must contain metadata")
+            pvc.setdefault("apiVersion", "v1")
+            pvc.setdefault("kind", "PersistentVolumeClaim")
+            pvc_name = pvc["metadata"].get("name", "")
+            pvc["metadata"]["name"] = pvc_name.replace("{notebook-name}", name)
+            pvc["metadata"]["namespace"] = namespace
+            pvcs_to_create.append(pvc)
+            vol_name = pvc["metadata"]["name"]
+            nb["spec"]["template"]["spec"]["volumes"].append(
+                {
+                    "name": vol_name,
+                    "persistentVolumeClaim": {"claimName": vol_name},
+                }
+            )
+        else:
+            return
+        container["volumeMounts"].append(
+            {"name": vol_name, "mountPath": mount}
+        )
+
+    workspace = field(config, form, "workspaceVolume", None)
+    if workspace:
+        if not isinstance(workspace, dict):
+            raise ApiError("'workspaceVolume' must be an object")
+        add_volume(workspace)
+    data_volumes = field(config, form, "dataVolumes", []) or []
+    if not isinstance(data_volumes, list):
+        raise ApiError("'dataVolumes' must be a list")
+    for data_vol in data_volumes:
+        if not isinstance(data_vol, dict):
+            raise ApiError("each data volume must be an object")
+        add_volume(data_vol)
+
+    # -- tolerations / affinity groups (reference form.py:178-224) --
+    # Admin-defined groups; TPU scheduling itself is controller-owned
+    # (nodeSelector from spec.tpu), so these remain for CPU pools.
+    tol_group = field(config, form, "tolerationGroup", "")
+    if tol_group:
+        options = ((config.get("spawnerFormDefaults") or {})
+                   .get("tolerationGroup") or {}).get("options") or []
+        for option in options:
+            if option.get("groupKey") == tol_group:
+                nb["spec"]["template"]["spec"]["tolerations"] = option.get(
+                    "tolerations", []
+                )
+                break
+        else:
+            raise ApiError(f"unknown toleration group {tol_group!r}")
+    affinity = field(config, form, "affinityConfig", "")
+    if affinity:
+        options = ((config.get("spawnerFormDefaults") or {})
+                   .get("affinityConfig") or {}).get("options") or []
+        for option in options:
+            if option.get("configKey") == affinity:
+                nb["spec"]["template"]["spec"]["affinity"] = option.get(
+                    "affinity", {}
+                )
+                break
+        else:
+            raise ApiError(f"unknown affinity config {affinity!r}")
+
+    return nb, pvcs_to_create

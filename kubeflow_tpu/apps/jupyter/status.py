@@ -1,0 +1,76 @@
+"""Notebook status state machine for the UI.
+
+Maps CR status + annotations to the phase/message pairs the index table
+renders (the role of reference crud-web-apps/jupyter/backend/apps/common/
+status.py:9-59 and its 10s grace window :74-80).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+# UI phases.
+RUNNING = "running"
+WAITING = "waiting"
+WARNING = "warning"
+STOPPED = "stopped"
+ERROR = "error"
+
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+GRACE_SECONDS = 10
+
+_ERROR_REASONS = {"ImagePullBackOff", "ErrImagePull", "CrashLoopBackOff",
+                  "InvalidImageName", "CreateContainerConfigError"}
+
+
+def process_status(notebook: dict, now: datetime.datetime | None = None) -> dict:
+    meta = notebook.get("metadata", {})
+    annotations = meta.get("annotations") or {}
+    status = notebook.get("status") or {}
+
+    if STOP_ANNOTATION in annotations:
+        if int(status.get("readyReplicas", 0)) == 0:
+            return _status(STOPPED, "No Pods are currently running.")
+        return _status(WAITING, "Notebook is stopping.")
+
+    container_state = status.get("containerState") or {}
+    if "running" in container_state:
+        return _status(RUNNING, "Running")
+    if "terminated" in container_state:
+        return _status(
+            ERROR,
+            container_state["terminated"].get("message")
+            or "The Pod has terminated.",
+        )
+    if "waiting" in container_state:
+        reason = container_state["waiting"].get("reason", "")
+        if reason in _ERROR_REASONS:
+            return _status(ERROR, f"Container cannot start: {reason}")
+        return _status(WAITING, f"Starting: {reason or 'initialising'}")
+
+    # No container state yet: within the grace window it's a normal
+    # scheduling delay; past it, surface scheduling warnings.
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    created = meta.get("creationTimestamp")
+    if created:
+        try:
+            age = (
+                now
+                - datetime.datetime.strptime(created, "%Y-%m-%dT%H:%M:%SZ")
+                .replace(tzinfo=datetime.timezone.utc)
+            ).total_seconds()
+        except ValueError:
+            age = GRACE_SECONDS + 1
+        if age < GRACE_SECONDS:
+            return _status(WAITING, "Waiting for StatefulSet to start.")
+
+    for event in status.get("warningEvents") or []:
+        if event.get("reason") == "FailedScheduling":
+            return _status(
+                WARNING, event.get("message") or "Pod cannot be scheduled."
+            )
+    return _status(WAITING, "Waiting for the Pod to become ready.")
+
+
+def _status(phase: str, message: str) -> dict:
+    return {"phase": phase, "message": message}

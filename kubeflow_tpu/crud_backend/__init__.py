@@ -1,0 +1,29 @@
+"""Shared REST backend library for the CRUD web apps.
+
+The role the reference's ``kubeflow.kubeflow.crud_backend`` Flask package
+plays (reference crud-web-apps/common/backend/kubeflow/kubeflow/
+crud_backend/__init__.py:17-39 create_app), rebuilt on werkzeug:
+
+- header-based authentication (``authn.py``)
+- per-request SubjectAccessReview authorization (``authz.py``)
+- CSRF double-submit cookie protection (``csrf.py``)
+- liveness/readiness probes, Prometheus metrics, SPA serving (``app.py``)
+
+Every web app (Jupyter spawner, Volumes, Tensorboards, dashboard) builds
+on :class:`RestApp` so security middleware is uniform across the
+platform.
+"""
+
+from kubeflow_tpu.crud_backend.app import ApiError, RestApp, json_success
+from kubeflow_tpu.crud_backend.authn import AuthnConfig
+from kubeflow_tpu.crud_backend.authz import Authorizer, AllowAll, PolicyAuthorizer
+
+__all__ = [
+    "ApiError",
+    "RestApp",
+    "json_success",
+    "AuthnConfig",
+    "Authorizer",
+    "AllowAll",
+    "PolicyAuthorizer",
+]

@@ -1,0 +1,186 @@
+"""RestApp: the werkzeug application base all web apps share.
+
+Provides routing, the uniform JSON envelope ({"success": bool, "log":
+msg} on errors — the shape the reference frontends consume, reference
+crud_backend/errors.py), authn/CSRF middleware, probes, and Prometheus
+metrics. Apps subclass nothing; they instantiate and register routes:
+
+    app = RestApp("jupyter", authn=AuthnConfig(), authorizer=AllowAll())
+
+    @app.route("/api/namespaces/<namespace>/notebooks", methods=["GET"])
+    def list_notebooks(request, namespace):
+        return {"notebooks": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import traceback
+from typing import Callable
+
+from werkzeug.exceptions import HTTPException, NotFound
+from werkzeug.routing import Map, Rule
+from werkzeug.test import Client
+from werkzeug.wrappers import Request, Response
+
+from prometheus_client import CollectorRegistry, Counter, Histogram, generate_latest
+
+from kubeflow_tpu.crud_backend import csrf
+from kubeflow_tpu.crud_backend.authn import AuthnConfig
+from kubeflow_tpu.crud_backend.authz import Authorizer, AllowAll, Forbidden
+
+log = logging.getLogger(__name__)
+
+
+class ApiError(Exception):
+    """Handler-raised error carried to the JSON envelope."""
+
+    def __init__(self, message: str, code: int = 400):
+        super().__init__(message)
+        self.code = code
+
+
+def json_success(**payload) -> dict:
+    return {"success": True, "status": 200, **payload}
+
+
+class RestApp:
+    # Paths exempt from authn (probes + metrics are mesh-internal).
+    OPEN_PATHS = {"/healthz", "/readyz", "/metrics"}
+
+    def __init__(
+        self,
+        name: str,
+        authn: AuthnConfig | None = None,
+        authorizer: Authorizer | None = None,
+        secure_cookies: bool = True,
+        metrics_registry=None,
+    ):
+        self.name = name
+        self.authn = authn or AuthnConfig(dev_mode=True)
+        self.authorizer = authorizer or AllowAll()
+        self.secure_cookies = secure_cookies
+        self.url_map = Map()
+        self.views: dict[str, Callable] = {}
+        self._index_html: str | None = None
+
+        # Per-app registry: instantiating the same app twice (tests) must
+        # not collide in the process-global default registry.
+        self.registry = metrics_registry or CollectorRegistry()
+        self.m_requests = Counter(
+            f"{name}_http_requests_total",
+            "HTTP requests",
+            ["method", "endpoint", "code"],
+            registry=self.registry,
+        )
+        self.m_latency = Histogram(
+            f"{name}_http_request_duration_seconds",
+            "HTTP request latency",
+            ["endpoint"],
+            registry=self.registry,
+        )
+
+        self.route("/healthz", methods=["GET"])(lambda request: {"status": "ok"})
+        self.route("/readyz", methods=["GET"])(lambda request: {"status": "ok"})
+
+    # ---- routing ---------------------------------------------------------
+    def route(self, rule: str, methods: list[str] | None = None):
+        def decorator(fn):
+            endpoint = f"{fn.__module__}.{fn.__qualname__}.{rule}"
+            self.url_map.add(
+                Rule(rule, endpoint=endpoint, methods=methods or ["GET"])
+            )
+            self.views[endpoint] = fn
+            return fn
+
+        return decorator
+
+    def serve_index(self, html: str):
+        """Registers the SPA index at / (CSRF cookie set on delivery —
+        reference crud_backend/serving.py:18-31)."""
+        self._index_html = html
+
+    # ---- request lifecycle ----------------------------------------------
+    def _authn_user(self, request: Request) -> str | None:
+        return self.authn.user_from_headers(request.headers)
+
+    def dispatch(self, request: Request) -> Response:
+        start = time.monotonic()
+        state = {"endpoint": "unmatched"}
+        response = self._dispatch_inner(request, state)
+        self.m_requests.labels(
+            request.method, state["endpoint"], str(response.status_code)
+        ).inc()
+        self.m_latency.labels(state["endpoint"]).observe(
+            time.monotonic() - start
+        )
+        return response
+
+    def _dispatch_inner(self, request: Request, state: dict) -> Response:
+        try:
+            if request.path == "/metrics":
+                return Response(
+                    generate_latest(self.registry), mimetype="text/plain"
+                )
+            if self._index_html is not None and request.path == "/":
+                resp = Response(self._index_html, mimetype="text/html")
+                csrf.set_cookie(resp, self.secure_cookies)
+                return resp
+
+            adapter = self.url_map.bind_to_environ(request.environ)
+            endpoint, args = adapter.match()
+            state["endpoint"] = endpoint
+
+            user = None
+            if request.path not in self.OPEN_PATHS:
+                user = self._authn_user(request)
+                if user is None:
+                    raise ApiError(
+                        f"No user detected (header "
+                        f"{self.authn.userid_header!r} missing)",
+                        401,
+                    )
+                if not csrf.check(request):
+                    raise ApiError("CSRF token missing or invalid", 403)
+            request.user = user  # type: ignore[attr-defined]
+
+            result = self.views[endpoint](request, **args)
+            if isinstance(result, Response):
+                return result
+            body = json_success(**result) if isinstance(result, dict) else result
+            return Response(
+                json.dumps(body), mimetype="application/json", status=200
+            )
+        except ApiError as exc:
+            return self._error(exc.code, str(exc))
+        except Forbidden as exc:
+            return self._error(403, str(exc))
+        except NotFound:
+            return self._error(404, f"Not found: {request.path}")
+        except HTTPException as exc:
+            return self._error(exc.code or 500, exc.description or "error")
+        except Exception:
+            log.error("unhandled error:\n%s", traceback.format_exc())
+            return self._error(500, "Internal server error")
+
+    def _error(self, code: int, message: str) -> Response:
+        body = {"success": False, "status": code, "log": message}
+        return Response(
+            json.dumps(body), status=code, mimetype="application/json"
+        )
+
+    # ---- WSGI ------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        response = self.dispatch(request)
+        return response(environ, start_response)
+
+    def test_client(self) -> Client:
+        return Client(self)
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000):
+        from werkzeug.serving import run_simple
+
+        run_simple(host, port, self, threaded=True)

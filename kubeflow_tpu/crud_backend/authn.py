@@ -1,0 +1,30 @@
+"""Header-based authentication.
+
+The mesh (Istio + oauth2-proxy) authenticates users and forwards the
+identity in a trusted header; backends only read it (reference
+crud_backend/authn.py:34-67 before_app_request). No header and not in
+dev mode ⇒ 401 with the JSON error shape the frontends expect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class AuthnConfig:
+    userid_header: str = "kubeflow-userid"
+    userid_prefix: str = ""
+    # Dev mode (reference config.py dev/prod split): skip authn and act
+    # as dev_user so the UI works without a mesh in front.
+    dev_mode: bool = False
+    dev_user: str = "dev@local"
+
+    def user_from_headers(self, headers) -> str | None:
+        """Returns the authenticated user, or None when unauthenticated."""
+        raw = headers.get(self.userid_header)
+        if raw is None:
+            return self.dev_user if self.dev_mode else None
+        if self.userid_prefix and raw.startswith(self.userid_prefix):
+            raw = raw[len(self.userid_prefix):]
+        return raw or None

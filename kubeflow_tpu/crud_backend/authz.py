@@ -1,0 +1,55 @@
+"""Per-request authorization via SubjectAccessReview.
+
+Every API handler authorizes the *end user* (not the backend's service
+account) for the exact verb/resource/namespace before touching the
+cluster (reference crud_backend/authz.py:26-132). The Authorizer
+protocol keeps the policy source pluggable:
+
+- production: POST a SubjectAccessReview to the apiserver
+- tests/dev: AllowAll or a PolicyAuthorizer table
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class Forbidden(Exception):
+    def __init__(self, user: str, verb: str, resource: str, namespace: str):
+        super().__init__(
+            f"User {user!r} is not authorized to {verb} {resource} "
+            f"in namespace {namespace!r}"
+        )
+        self.user = user
+
+
+class Authorizer(Protocol):
+    def allowed(self, user: str, verb: str, group: str, resource: str,
+                namespace: str) -> bool: ...
+
+
+class AllowAll:
+    def allowed(self, user, verb, group, resource, namespace) -> bool:
+        return True
+
+
+class PolicyAuthorizer:
+    """Explicit grant table: {(user, namespace): {"*"} | {verbs…}}.
+    The KFAM/profile layer materialises contributor RoleBindings into
+    grants of this shape for tests."""
+
+    def __init__(self, grants: dict[tuple[str, str], set[str]] | None = None):
+        self.grants = grants or {}
+
+    def grant(self, user: str, namespace: str, *verbs: str):
+        self.grants.setdefault((user, namespace), set()).update(verbs or {"*"})
+
+    def allowed(self, user, verb, group, resource, namespace) -> bool:
+        verbs = self.grants.get((user, namespace), set())
+        return "*" in verbs or verb in verbs
+
+
+def ensure(authorizer: Authorizer, user: str, verb: str, group: str,
+           resource: str, namespace: str) -> None:
+    if not authorizer.allowed(user, verb, group, resource, namespace):
+        raise Forbidden(user, verb, resource, namespace)

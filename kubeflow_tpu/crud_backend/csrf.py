@@ -1,0 +1,40 @@
+"""CSRF double-submit cookie protection.
+
+Mutating requests must echo the ``XSRF-TOKEN`` cookie in the
+``X-XSRF-TOKEN`` header (reference crud_backend/csrf.py:50-112). The
+cookie is set when the SPA index is served; same-origin JS can read it,
+a cross-site attacker cannot.
+"""
+
+from __future__ import annotations
+
+import hmac
+import secrets
+
+COOKIE_NAME = "XSRF-TOKEN"
+HEADER_NAME = "X-XSRF-TOKEN"
+SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def new_token() -> str:
+    return secrets.token_urlsafe(32)
+
+
+def check(request) -> bool:
+    """True when the request passes CSRF (safe method or matching pair)."""
+    if request.method in SAFE_METHODS:
+        return True
+    cookie = request.cookies.get(COOKIE_NAME, "")
+    header = request.headers.get(HEADER_NAME, "")
+    return bool(cookie) and hmac.compare_digest(cookie, header)
+
+
+def set_cookie(response, secure: bool) -> None:
+    response.set_cookie(
+        COOKIE_NAME,
+        new_token(),
+        secure=secure,
+        httponly=False,  # double-submit: JS must read it
+        samesite="Strict",
+        path="/",
+    )

@@ -119,7 +119,11 @@ class FakeApiServer:
             q.put(WatchEvent(event.type, copy.deepcopy(event.object)))
 
     # ---- CRUD ------------------------------------------------------------
-    def create(self, obj: dict, namespace: str | None = None) -> dict:
+    def create(self, obj: dict, namespace: str | None = None,
+               dry_run: bool = False) -> dict:
+        """Create; with dry_run, run full validation + admission but
+        persist nothing (server-side dry-run semantics — the reference
+        JWA dry-run-creates before committing, reference post.py:51-57)."""
         with self._lock:
             obj = copy.deepcopy(obj)
             gvk = GVK.from_obj(obj)
@@ -140,6 +144,8 @@ class FakeApiServer:
             for hook in self._admission.get(gvk.kind, []):
                 obj = hook(obj)
                 meta = obj["metadata"]
+            if dry_run:
+                return copy.deepcopy(obj)
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())
             meta["resourceVersion"] = str(next(self._rv))
             meta.setdefault(
@@ -207,6 +213,17 @@ class FakeApiServer:
         with self._lock:
             cur = self.get(api_version, kind, name, namespace)
 
+            def strip_nulls(value):
+                # RFC 7386: null means "delete"; nulls must never be
+                # stored literally, even when the target key was absent.
+                if isinstance(value, dict):
+                    return {
+                        k: strip_nulls(v)
+                        for k, v in value.items()
+                        if v is not None
+                    }
+                return copy.deepcopy(value)
+
             def merge(dst, src):
                 for k, v in src.items():
                     if v is None:
@@ -214,7 +231,7 @@ class FakeApiServer:
                     elif isinstance(v, dict) and isinstance(dst.get(k), dict):
                         merge(dst[k], v)
                     else:
-                        dst[k] = copy.deepcopy(v)
+                        dst[k] = strip_nulls(v)
 
             merge(cur, patch)
             cur["metadata"].pop("resourceVersion", None)

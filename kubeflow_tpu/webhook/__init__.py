@@ -1,0 +1,23 @@
+"""PodDefault admission webhook.
+
+Python process wrapper around the native merge engine
+(native/src/poddefault.cpp). Capability parity with the reference
+admission-webhook (reference components/admission-webhook/main.go:
+serve :748-793, mutatePods :639-744); the TPU-native delta is the
+shipped ``tpu-env`` PodDefault that wires every selecting pod for
+jax.distributed on a slice.
+"""
+
+from kubeflow_tpu.webhook.server import (
+    AdmissionHandler,
+    WebhookServer,
+    register_with_fake,
+    tpu_env_poddefault,
+)
+
+__all__ = [
+    "AdmissionHandler",
+    "WebhookServer",
+    "register_with_fake",
+    "tpu_env_poddefault",
+]

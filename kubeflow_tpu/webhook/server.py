@@ -1,0 +1,206 @@
+"""AdmissionReview v1 handling + HTTPS server for the PodDefault webhook.
+
+Flow (reference admission-webhook/main.go serve :748-793 → mutatePods
+:639-744, rebuilt): decode AdmissionReview, list PodDefaults in the
+pod's namespace, hand both to the native merge engine, return a
+base64 JSONPatch response — or an allowed:false with the aggregated
+conflict message (the apiserver surfaces it to the creating client;
+failurePolicy decides what happens when the webhook itself is down).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.server
+import json
+import logging
+import ssl
+import threading
+import urllib.parse
+from typing import Callable
+
+from kubeflow_tpu import native
+
+log = logging.getLogger(__name__)
+
+PODDEFAULT_API = "kubeflow.org/v1alpha1"
+
+# fn(namespace) -> list of PodDefault dicts.
+PodDefaultLister = Callable[[str], list]
+
+
+class AdmissionHandler:
+    def __init__(self, list_poddefaults: PodDefaultLister):
+        self.list_poddefaults = list_poddefaults
+
+    def review(self, review: dict) -> dict:
+        """AdmissionReview in → AdmissionReview out (always 200-shaped;
+        malformed requests produce allowed:false, never an exception)."""
+        request = review.get("request") or {}
+        uid = request.get("uid", "")
+        response: dict = {"uid": uid, "allowed": True}
+        out = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+        try:
+            if request.get("kind", {}).get("kind") not in (None, "Pod"):
+                return out  # not ours: allow untouched
+            pod = request.get("object")
+            if not isinstance(pod, dict):
+                raise ValueError("admission request has no pod object")
+            namespace = request.get("namespace") or pod.get("metadata", {}).get(
+                "namespace", "default"
+            )
+            poddefaults = self.list_poddefaults(namespace)
+            result = native.invoke(
+                "poddefault_mutate",
+                {"pod": pod, "poddefaults": poddefaults},
+            )
+            if result["conflicts"]:
+                response["allowed"] = False
+                response["status"] = {
+                    "message": "; ".join(result["conflicts"]),
+                    "code": 400,
+                }
+                return out
+            if result["applied"] and result["patch"]:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(
+                    json.dumps(result["patch"]).encode()
+                ).decode()
+            return out
+        except Exception as exc:  # malformed review: reject, don't crash
+            log.exception("admission review failed")
+            response["allowed"] = False
+            response["status"] = {"message": str(exc), "code": 400}
+            return out
+
+
+class WebhookServer:
+    """Threaded HTTPS server exposing /apply-poddefault + /healthz
+    (TLS optional for tests; production mounts cert-manager certs the way
+    the reference's certwatcher does, reference config.go:43-60)."""
+
+    def __init__(
+        self,
+        handler: AdmissionHandler,
+        port: int = 4443,
+        certfile: str | None = None,
+        keyfile: str | None = None,
+    ):
+        self.handler = handler
+        outer = self
+
+        class _HTTPHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("webhook: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    body = b'{"status":"ok"}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                # The apiserver appends query params (?timeout=10s):
+                # match on the path component only.
+                path = urllib.parse.urlsplit(self.path).path
+                if path.rstrip("/") != "/apply-poddefault":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self.send_error(400, "bad JSON")
+                    return
+                reply = json.dumps(outer.handler.review(review)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(reply)))
+                self.end_headers()
+                self.wfile.write(reply)
+
+        self._server = http.server.ThreadingHTTPServer(("", port), _HTTPHandler)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> threading.Thread:
+        thread = threading.Thread(
+            target=self._server.serve_forever, name="poddefault-webhook",
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    def stop(self):
+        self._server.shutdown()
+
+
+def register_with_fake(api) -> None:
+    """Wire the webhook into the fake apiserver's admission chain so pods
+    created in tests/dev traverse the same mutation path the real
+    apiserver would route through the HTTPS endpoint."""
+
+    def lister(namespace: str) -> list:
+        return api.list(PODDEFAULT_API, "PodDefault", namespace=namespace)
+
+    def hook(pod: dict) -> dict:
+        namespace = pod.get("metadata", {}).get("namespace", "default")
+        result = native.invoke(
+            "poddefault_mutate",
+            {"pod": pod, "poddefaults": lister(namespace)},
+        )
+        if result["conflicts"]:
+            from kubeflow_tpu.k8s.fake import ApiError
+
+            raise ApiError("; ".join(result["conflicts"]))
+        return result["pod"]
+
+    api.register_admission("Pod", hook)
+
+
+def tpu_env_poddefault(namespace: str) -> dict:
+    """The platform-shipped PodDefault: selecting pods get slice-ready
+    env (the jupyter-jax-tpu image's sitecustomize then calls
+    kubeflow_tpu.parallel.initialize_from_env) and the TPU toleration.
+    The per-rank env (TPU_WORKER_ID, hostnames, coordinator) comes from
+    the notebook controller; this PodDefault covers what is common to
+    every TPU pod in the namespace."""
+    return {
+        "apiVersion": PODDEFAULT_API,
+        "kind": "PodDefault",
+        "metadata": {"name": "tpu-env", "namespace": namespace},
+        "spec": {
+            "desc": "Configure TPU slice environment (jax.distributed)",
+            "selector": {"matchLabels": {"tpu-env": "true"}},
+            "env": [
+                {"name": "JAX_PLATFORMS", "value": "tpu,cpu"},
+                # Fail fast instead of silently hiding chips when the
+                # device plugin hands us fewer than requested.
+                {"name": "TPU_MIN_LOG_LEVEL", "value": "0"},
+            ],
+            "tolerations": [
+                {
+                    "key": "google.com/tpu",
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                }
+            ],
+        },
+    }

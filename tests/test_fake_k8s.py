@@ -118,3 +118,25 @@ def test_cluster_scoped_kinds_ignore_namespace():
                 "metadata": {"name": "team-a"}})
     got = api.get("v1", "Namespace", "team-a")
     assert got["metadata"]["name"] == "team-a"
+
+
+def test_merge_patch_null_into_absent_key_not_stored():
+    """RFC 7386: null deletes; it must not be stored literally even when
+    the parent key did not exist yet (JWA Start-button path)."""
+    api = FakeApiServer()
+    api.create(pod("a"))
+    out = api.patch_merge(
+        "v1", "Pod", "a", {"metadata": {"annotations": {"x": None}}}, "default"
+    )
+    assert out["metadata"].get("annotations") == {}
+
+
+def test_dry_run_create_validates_without_persisting():
+    api = FakeApiServer()
+    api.create(pod("a"), dry_run=True)
+    with pytest.raises(NotFound):
+        api.get("v1", "Pod", "a", "default")
+    # Conflict detection still fires on dry-run.
+    api.create(pod("a"))
+    with pytest.raises(Conflict):
+        api.create(pod("a"), dry_run=True)

@@ -1,0 +1,242 @@
+"""Webhook tests: AdmissionReview protocol over HTTP, JSONPatch
+application, conflict rejection, fake-apiserver admission integration —
+the process-boundary tier (reference SURVEY.md §3.4 webhook path)."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.k8s import ApiError, FakeApiServer
+from kubeflow_tpu.webhook import (
+    AdmissionHandler,
+    WebhookServer,
+    register_with_fake,
+    tpu_env_poddefault,
+)
+
+
+def make_review(pod, namespace="user", uid="req-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": namespace,
+            "operation": "CREATE",
+            "object": pod,
+        },
+    }
+
+
+def labeled_pod(labels=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "user",
+                     "labels": labels or {"tpu-env": "true"}},
+        "spec": {"containers": [{"name": "nb", "image": "img"}]},
+    }
+
+
+def apply_patch(pod, b64patch):
+    """Minimal RFC6902 applier for asserting patch correctness."""
+    ops = json.loads(base64.b64decode(b64patch))
+    import copy
+
+    doc = copy.deepcopy(pod)
+    for op in ops:
+        path = [p.replace("~1", "/").replace("~0", "~")
+                for p in op["path"].lstrip("/").split("/")]
+        target = doc
+        for key in path[:-1]:
+            target = target[int(key)] if isinstance(target, list) else target[key]
+        key = path[-1]
+        if op["op"] in ("add", "replace"):
+            if isinstance(target, list):
+                target.insert(int(key), op["value"])
+            else:
+                target[key] = op["value"]
+        elif op["op"] == "remove":
+            if isinstance(target, list):
+                del target[int(key)]
+            else:
+                del target[key]
+    return doc
+
+
+class TestAdmissionHandler:
+    def test_patch_roundtrip(self):
+        pds = [tpu_env_poddefault("user")]
+        handler = AdmissionHandler(lambda ns: pds)
+        pod = labeled_pod()
+        out = handler.review(make_review(pod))
+        resp = out["response"]
+        assert resp["allowed"] is True
+        assert resp["patchType"] == "JSONPatch"
+        mutated = apply_patch(pod, resp["patch"])
+        env = {e["name"]: e.get("value")
+               for e in mutated["spec"]["containers"][0]["env"]}
+        assert env["JAX_PLATFORMS"] == "tpu,cpu"
+        assert mutated["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+        anns = mutated["metadata"]["annotations"]
+        assert "poddefault.admission.kubeflow.org/poddefault-tpu-env" in anns
+
+    def test_non_matching_pod_untouched(self):
+        handler = AdmissionHandler(lambda ns: [tpu_env_poddefault("user")])
+        out = handler.review(make_review(labeled_pod(labels={"other": "x"})))
+        assert out["response"]["allowed"] is True
+        assert "patch" not in out["response"]
+
+    def test_conflicts_reject_with_message(self):
+        pd1 = tpu_env_poddefault("user")
+        pd2 = tpu_env_poddefault("user")
+        pd2["metadata"]["name"] = "tpu-env-2"
+        pd2["spec"]["env"] = [{"name": "JAX_PLATFORMS", "value": "cpu"}]
+        handler = AdmissionHandler(lambda ns: [pd1, pd2])
+        out = handler.review(make_review(labeled_pod()))
+        assert out["response"]["allowed"] is False
+        assert "conflict on env 'JAX_PLATFORMS'" in out["response"]["status"]["message"]
+
+    def test_malformed_review_rejected_not_crashed(self):
+        handler = AdmissionHandler(lambda ns: [])
+        out = handler.review({"request": {"uid": "u", "object": "not-a-pod"}})
+        assert out["response"]["allowed"] is False
+        assert out["response"]["uid"] == "u"
+
+    def test_non_pod_kind_allowed_untouched(self):
+        handler = AdmissionHandler(lambda ns: [])
+        review = make_review(labeled_pod())
+        review["request"]["kind"]["kind"] = "Deployment"
+        out = handler.review(review)
+        assert out["response"]["allowed"] is True
+        assert "patch" not in out["response"]
+
+
+class TestWebhookHTTP:
+    @pytest.fixture
+    def server(self):
+        handler = AdmissionHandler(lambda ns: [tpu_env_poddefault(ns)])
+        server = WebhookServer(handler, port=0)
+        server.start()
+        yield server
+        server.stop()
+
+    def _post(self, server, path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_apply_poddefault_over_http(self, server):
+        status, out = self._post(
+            server, "/apply-poddefault", make_review(labeled_pod())
+        )
+        assert status == 200
+        assert out["response"]["allowed"] is True
+        assert out["response"]["patch"]
+
+    def test_healthz(self, server):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._post(server, "/nope", {})
+        assert err.value.code == 404
+
+
+class TestFakeApiIntegration:
+    def test_pod_create_traverses_webhook(self):
+        api = FakeApiServer()
+        register_with_fake(api)
+        api.create(tpu_env_poddefault("user"))
+        created = api.create(labeled_pod())
+        env = {e["name"]: e.get("value")
+               for e in created["spec"]["containers"][0]["env"]}
+        assert env["JAX_PLATFORMS"] == "tpu,cpu"
+
+    def test_conflicting_poddefaults_block_pod_creation(self):
+        api = FakeApiServer()
+        register_with_fake(api)
+        pd1 = tpu_env_poddefault("user")
+        pd2 = tpu_env_poddefault("user")
+        pd2["metadata"]["name"] = "tpu-env-2"
+        pd2["spec"]["env"] = [{"name": "JAX_PLATFORMS", "value": "cpu"}]
+        api.create(pd1)
+        api.create(pd2)
+        with pytest.raises(ApiError):
+            api.create(labeled_pod())
+
+    def test_end_to_end_with_notebook_controller(self):
+        """Spawn path across all three components: webhook + controller +
+        fake kubelet — the §3.1 call stack in-process."""
+        from kubeflow_tpu.controllers.notebook import make_notebook_controller
+
+        api = FakeApiServer()
+        register_with_fake(api)
+        api.create(tpu_env_poddefault("user"))
+        ctrl = make_notebook_controller(api)
+        api.create(
+            {
+                "apiVersion": "kubeflow.org/v1beta1",
+                "kind": "Notebook",
+                "metadata": {"name": "nb", "namespace": "user"},
+                "spec": {
+                    "tpu": {"accelerator": "v5e", "topology": "2x2"},
+                    "template": {
+                        "spec": {
+                            "containers": [{"name": "nb", "image": "jax-tpu"}]
+                        },
+                        "metadata": {"labels": {"tpu-env": "true"}},
+                    },
+                },
+            }
+        )
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+        # Fake kubelet: create the pod from the template; admission fires.
+        pod_template = sts["spec"]["template"]
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "nb-0",
+                "namespace": "user",
+                "labels": pod_template["metadata"]["labels"],
+            },
+            "spec": pod_template["spec"],
+        }
+        created = api.create(pod)
+        env = {e["name"]: e.get("value")
+               for e in created["spec"]["containers"][0]["env"]}
+        # Controller-injected env AND webhook-injected env both present.
+        assert env["NB_PREFIX"] == "/notebook/user/nb"
+        assert env["KFT_NUM_PROCESSES"] == "1"
+        assert env["JAX_PLATFORMS"] == "tpu,cpu"
+        assert created["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+
+
+class TestApiserverQuirks:
+    def test_query_string_on_webhook_path(self):
+        """kube-apiserver appends ?timeout=10s to the webhook URL."""
+        handler = AdmissionHandler(lambda ns: [])
+        server = WebhookServer(handler, port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/apply-poddefault?timeout=10s",
+                data=json.dumps(make_review(labeled_pod())).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            server.stop()
