@@ -1,0 +1,9 @@
+"""Tensorboards web app (TWA) backend — Tensorboard CR CRUD.
+
+REST parity with the reference TWA (reference crud-web-apps/tensorboards/
+backend/apps/default/routes/*.py).
+"""
+
+from kubeflow_tpu.apps.tensorboards.app import create_app
+
+__all__ = ["create_app"]

@@ -1,0 +1,71 @@
+"""TWA application factory and routes."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.authz import ensure
+from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
+
+TENSORBOARD_API = "tensorboard.kubeflow.org/v1alpha1"
+
+
+def create_app(
+    api,
+    authn: AuthnConfig | None = None,
+    authorizer=None,
+    secure_cookies: bool = False,
+) -> RestApp:
+    app = RestApp("twa", authn=authn, authorizer=authorizer,
+                  secure_cookies=secure_cookies)
+
+    def tb_view(tb: dict) -> dict:
+        return {
+            "name": tb["metadata"]["name"],
+            "namespace": tb["metadata"]["namespace"],
+            "logspath": (tb.get("spec") or {}).get("logspath", ""),
+            "ready": bool((tb.get("status") or {}).get("readyReplicas")),
+            "age": tb["metadata"].get("creationTimestamp"),
+        }
+
+    @app.route("/api/namespaces/<namespace>/tensorboards")
+    def list_tensorboards(request, namespace):
+        ensure(app.authorizer, request.user, "list", "tensorboard.kubeflow.org",
+               "tensorboards", namespace)
+        tbs = api.list(TENSORBOARD_API, "Tensorboard", namespace=namespace)
+        return {"tensorboards": [tb_view(tb) for tb in tbs]}
+
+    @app.route("/api/namespaces/<namespace>/tensorboards", methods=["POST"])
+    def post_tensorboard(request, namespace):
+        ensure(app.authorizer, request.user, "create",
+               "tensorboard.kubeflow.org", "tensorboards", namespace)
+        body = request.get_json(silent=True) or {}
+        name = body.get("name", "")
+        logspath = body.get("logspath", "")
+        if not name or not logspath:
+            raise ApiError("tensorboard requires 'name' and 'logspath'")
+        tb = {
+            "apiVersion": TENSORBOARD_API,
+            "kind": "Tensorboard",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"logspath": logspath},
+        }
+        try:
+            api.create(tb)
+        except K8sError as exc:
+            raise ApiError(str(exc), 409)
+        return {}
+
+    @app.route(
+        "/api/namespaces/<namespace>/tensorboards/<name>", methods=["DELETE"]
+    )
+    def delete_tensorboard(request, namespace, name):
+        ensure(app.authorizer, request.user, "delete",
+               "tensorboard.kubeflow.org", "tensorboards", namespace)
+        try:
+            api.delete(TENSORBOARD_API, "Tensorboard", name, namespace)
+        except NotFound:
+            raise ApiError(f"tensorboard {name!r} not found", 404)
+        return {}
+
+    return app

@@ -1,0 +1,9 @@
+"""Volumes web app (VWA) backend — PVC CRUD + PVCViewer launcher.
+
+REST parity with the reference VWA (reference crud-web-apps/volumes/
+backend/apps/default/routes/*.py incl. the viewer launch post.py:11-41).
+"""
+
+from kubeflow_tpu.apps.volumes.app import create_app
+
+__all__ = ["create_app"]

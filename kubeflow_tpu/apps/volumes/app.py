@@ -1,0 +1,137 @@
+"""VWA application factory and routes."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.authz import ensure
+from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
+
+PVCVIEWER_API = "kubeflow.org/v1alpha1"
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+def create_app(
+    api,
+    authn: AuthnConfig | None = None,
+    authorizer=None,
+    secure_cookies: bool = False,
+) -> RestApp:
+    app = RestApp("vwa", authn=authn, authorizer=authorizer,
+                  secure_cookies=secure_cookies)
+
+    def pvc_view(pvc: dict, namespace: str, notebooks: list) -> dict:
+        name = pvc["metadata"]["name"]
+        # Which notebooks mount this claim (drives the UI's "in use by").
+        used_by = []
+        for nb in notebooks:
+            volumes = (((nb.get("spec") or {}).get("template") or {})
+                       .get("spec") or {}).get("volumes") or []
+            for vol in volumes:
+                if (vol.get("persistentVolumeClaim") or {}).get(
+                    "claimName"
+                ) == name:
+                    used_by.append(nb["metadata"]["name"])
+        try:
+            viewer = api.get(PVCVIEWER_API, "PVCViewer", name, namespace)
+            viewer_status = (viewer.get("status") or {})
+        except NotFound:
+            viewer_status = None
+        return {
+            "name": name,
+            "namespace": namespace,
+            "size": ((pvc["spec"].get("resources") or {}).get("requests")
+                     or {}).get("storage"),
+            "mode": (pvc["spec"].get("accessModes") or [None])[0],
+            "class": pvc["spec"].get("storageClassName"),
+            "status": (pvc.get("status") or {}).get("phase", "Pending"),
+            "usedBy": used_by,
+            "viewer": viewer_status,
+        }
+
+    @app.route("/api/namespaces/<namespace>/pvcs")
+    def list_pvcs(request, namespace):
+        ensure(app.authorizer, request.user, "list", "",
+               "persistentvolumeclaims", namespace)
+        pvcs = api.list("v1", "PersistentVolumeClaim", namespace=namespace)
+        # One Notebook LIST for the whole page, not one per PVC.
+        notebooks = api.list(NOTEBOOK_API, "Notebook", namespace=namespace)
+        return {"pvcs": [pvc_view(p, namespace, notebooks) for p in pvcs]}
+
+    @app.route("/api/namespaces/<namespace>/pvcs", methods=["POST"])
+    def post_pvc(request, namespace):
+        ensure(app.authorizer, request.user, "create", "",
+               "persistentvolumeclaims", namespace)
+        body = request.get_json(silent=True) or {}
+        name = body.get("name", "")
+        if not name:
+            raise ApiError("pvc name required")
+        pvc = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "accessModes": [body.get("mode", "ReadWriteOnce")],
+                "resources": {
+                    "requests": {"storage": body.get("size", "10Gi")}
+                },
+            },
+        }
+        if body.get("class") and body["class"] != "{none}":
+            pvc["spec"]["storageClassName"] = body["class"]
+        try:
+            api.create(pvc)
+        except K8sError as exc:
+            raise ApiError(str(exc), 409)
+        return {}
+
+    @app.route("/api/namespaces/<namespace>/pvcs/<name>", methods=["DELETE"])
+    def delete_pvc(request, namespace, name):
+        ensure(app.authorizer, request.user, "delete", "",
+               "persistentvolumeclaims", namespace)
+        # Drop any viewer first (reference VWA deletes the viewer with
+        # the PVC).
+        try:
+            api.delete(PVCVIEWER_API, "PVCViewer", name, namespace)
+        except NotFound:
+            pass
+        try:
+            api.delete("v1", "PersistentVolumeClaim", name, namespace)
+        except NotFound:
+            raise ApiError(f"pvc {name!r} not found", 404)
+        return {}
+
+    # ---- viewers --------------------------------------------------------
+    @app.route("/api/namespaces/<namespace>/viewers", methods=["POST"])
+    def post_viewer(request, namespace):
+        ensure(app.authorizer, request.user, "create", "kubeflow.org",
+               "pvcviewers", namespace)
+        body = request.get_json(silent=True) or {}
+        pvc = body.get("pvc", "")
+        if not pvc:
+            raise ApiError("viewer requires 'pvc'")
+        viewer = {
+            "apiVersion": PVCVIEWER_API,
+            "kind": "PVCViewer",
+            "metadata": {"name": pvc, "namespace": namespace},
+            "spec": {"pvc": pvc, "rwoScheduling": True},
+        }
+        try:
+            api.create(viewer)
+        except K8sError as exc:
+            raise ApiError(str(exc), 409)
+        return {}
+
+    @app.route(
+        "/api/namespaces/<namespace>/viewers/<name>", methods=["DELETE"]
+    )
+    def delete_viewer(request, namespace, name):
+        ensure(app.authorizer, request.user, "delete", "kubeflow.org",
+               "pvcviewers", namespace)
+        try:
+            api.delete(PVCVIEWER_API, "PVCViewer", name, namespace)
+        except NotFound:
+            raise ApiError(f"viewer {name!r} not found", 404)
+        return {}
+
+    return app

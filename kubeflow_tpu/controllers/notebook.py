@@ -13,7 +13,12 @@ import dataclasses
 import logging
 
 from kubeflow_tpu import native
-from kubeflow_tpu.controllers.runtime import Controller, Request, WatchSpec
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    ensure_object,
+)
 from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
 
 log = logging.getLogger(__name__)
@@ -59,26 +64,8 @@ class NotebookReconciler:
         self.api = api
         self.options = options or NotebookOptions()
 
-    # -- create-or-update through the native drift repair ----------------
     def _ensure(self, desired: dict) -> None:
-        api_version = desired["apiVersion"]
-        kind = desired["kind"]
-        meta = desired["metadata"]
-        try:
-            existing = self.api.get(
-                api_version, kind, meta["name"], meta.get("namespace")
-            )
-        except NotFound:
-            self.api.create(desired)
-            return
-        merged = native.invoke(
-            "copy_owned_fields",
-            {"kind": kind, "existing": existing, "desired": desired},
-        )
-        if merged["changed"]:
-            # A Conflict (stale read) propagates; the queue's rate limiter
-            # retries this key.
-            self.api.update(merged["merged"])
+        ensure_object(self.api, desired)
 
     def reconcile(self, req: Request) -> float | None:
         try:

@@ -1,0 +1,182 @@
+"""Profile controller: multi-tenant namespace materialisation + plugins.
+
+Python half of the reference profile-controller (reference
+controllers/profile_controller.go:105-336 Reconcile): desired state comes
+from the native core (native/src/profile.cpp); this layer owns watches,
+writes, the cloud-IAM plugin chain, and finalizer-style revocation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Protocol
+
+from kubeflow_tpu import native
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    ensure_object,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+PROFILE_API = "kubeflow.org/v1"
+FINALIZER = "profile-controller.kubeflow-tpu/cleanup"
+
+
+class ProfilePlugin(Protocol):
+    """Cloud-IAM plugin interface (reference profile_controller.go:78-84:
+    ApplyPlugin/RevokePlugin). Implementations annotate the namespace's
+    ServiceAccounts with cloud identities; Revoke undoes it when the
+    Profile is deleted."""
+
+    name: str
+
+    def apply(self, api, profile: dict, spec: dict) -> None: ...
+    def revoke(self, api, profile: dict, spec: dict) -> None: ...
+
+
+class WorkloadIdentityPlugin:
+    """GKE Workload Identity (reference plugin_workload_identity.go:32-52):
+    binds default-editor to a GCP service account via the SA annotation.
+    The IAM policy call is delegated to an injectable binder so tests and
+    non-GCP clusters run without the cloud API."""
+
+    name = "WorkloadIdentity"
+
+    def __init__(self, iam_binder=None):
+        self.iam_binder = iam_binder  # fn(gsa, member, add: bool)
+
+    def _member(self, profile: dict) -> str:
+        ns = profile["metadata"]["name"]
+        return f"serviceAccount:[{ns}/default-editor]"
+
+    def apply(self, api, profile: dict, spec: dict) -> None:
+        gsa = spec.get("gcpServiceAccount", "")
+        ns = profile["metadata"]["name"]
+        sa = api.get("v1", "ServiceAccount", "default-editor", ns)
+        annotations = sa["metadata"].setdefault("annotations", {})
+        if annotations.get("iam.gke.io/gcp-service-account") != gsa:
+            annotations["iam.gke.io/gcp-service-account"] = gsa
+            api.update(sa)
+        if self.iam_binder:
+            self.iam_binder(gsa, self._member(profile), True)
+
+    def revoke(self, api, profile: dict, spec: dict) -> None:
+        if self.iam_binder:
+            self.iam_binder(
+                spec.get("gcpServiceAccount", ""), self._member(profile), False
+            )
+
+
+@dataclasses.dataclass
+class ProfileOptions:
+    userid_header: str = "kubeflow-userid"
+    userid_prefix: str = ""
+    namespace_labels: dict | None = None
+
+    def to_native(self) -> dict:
+        return {
+            "userIdHeader": self.userid_header,
+            "userIdPrefix": self.userid_prefix,
+            "namespaceLabels": self.namespace_labels or {},
+        }
+
+
+class ProfileReconciler:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        options: ProfileOptions | None = None,
+        plugins: dict[str, ProfilePlugin] | None = None,
+    ):
+        self.api = api
+        self.options = options or ProfileOptions()
+        self.plugins = plugins or {}
+
+    def _ensure(self, desired: dict) -> None:
+        ensure_object(self.api, desired)
+
+    def reconcile(self, req: Request) -> float | None:
+        try:
+            profile = self.api.get(PROFILE_API, "Profile", req.name)
+        except NotFound:
+            return None
+
+        # Deletion: revoke plugins, then drop our finalizer (reference
+        # profile_controller.go:297-331). Only act when OUR finalizer is
+        # present — a foreign finalizer holding the object must not cause
+        # a revoke/patch loop.
+        if profile["metadata"].get("deletionTimestamp"):
+            current = profile["metadata"].get("finalizers", [])
+            if FINALIZER in current:
+                self._revoke_plugins(profile)
+                remaining = [f for f in current if f != FINALIZER]
+                self.api.patch_merge(
+                    PROFILE_API, "Profile", req.name,
+                    {"metadata": {"finalizers": remaining or None}},
+                )
+            return None
+
+        plugin_specs = (profile.get("spec") or {}).get("plugins") or []
+        if plugin_specs and FINALIZER not in profile["metadata"].get(
+            "finalizers", []
+        ):
+            self.api.patch_merge(
+                PROFILE_API, "Profile", req.name,
+                {
+                    "metadata": {
+                        "finalizers": profile["metadata"].get("finalizers", [])
+                        + [FINALIZER]
+                    }
+                },
+            )
+
+        out = native.invoke(
+            "profile_reconcile",
+            {"profile": profile, "options": self.options.to_native()},
+        )
+        self._ensure(out["namespace"])
+        for sa in out["serviceAccounts"]:
+            self._ensure(sa)
+        self._ensure(out["roleBinding"])
+        self._ensure(out["authorizationPolicy"])
+        if out["resourceQuota"] is not None:
+            self._ensure(out["resourceQuota"])
+
+        for spec in plugin_specs:
+            kind = spec.get("kind", "")
+            plugin = self.plugins.get(kind)
+            if plugin is None:
+                log.warning("profile %s: unknown plugin %r", req.name, kind)
+                continue
+            plugin.apply(self.api, profile, spec.get("spec", {}))
+        return None
+
+    def _revoke_plugins(self, profile: dict) -> None:
+        for spec in (profile.get("spec") or {}).get("plugins") or []:
+            plugin = self.plugins.get(spec.get("kind", ""))
+            if plugin is not None:
+                try:
+                    plugin.revoke(self.api, profile, spec.get("spec", {}))
+                except Exception:
+                    log.exception(
+                        "plugin revoke failed for %s",
+                        profile["metadata"]["name"],
+                    )
+
+
+def make_profile_controller(
+    api: FakeApiServer,
+    options: ProfileOptions | None = None,
+    plugins: dict[str, ProfilePlugin] | None = None,
+) -> Controller:
+    return Controller(
+        name="profile-controller",
+        api=api,
+        reconciler=ProfileReconciler(api, options, plugins),
+        watches=[WatchSpec(PROFILE_API, "Profile")],
+    )

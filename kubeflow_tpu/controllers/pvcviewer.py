@@ -1,0 +1,105 @@
+"""PVCViewer controller (reference pvcviewer-controller/controllers/
+pvcviewer_controller.go + api/v1alpha1/pvcviewer_webhook.go): PVCViewer
+CR → filebrowser Deployment + Service + VirtualService with the viewer
+URL in status; defaulting applied controller-side (the reference uses a
+defaulting webhook)."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from kubeflow_tpu import native
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    ensure_object,
+)
+from kubeflow_tpu.controllers.tensorboard import (
+    deployment_to_tensorboard as deployment_to_owner,
+    find_rwo_node,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+PVCVIEWER_API = "kubeflow.org/v1alpha1"
+
+
+@dataclasses.dataclass
+class PvcViewerOptions:
+    viewer_image: str = "filebrowser/filebrowser:v2"
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+
+
+class PvcViewerReconciler:
+    def __init__(self, api: FakeApiServer, options: PvcViewerOptions | None = None):
+        self.api = api
+        self.options = options or PvcViewerOptions()
+
+    def _ensure(self, desired: dict) -> None:
+        ensure_object(self.api, desired)
+
+    def reconcile(self, req: Request) -> float | None:
+        try:
+            viewer = self.api.get(PVCVIEWER_API, "PVCViewer", req.name,
+                                  req.namespace)
+        except NotFound:
+            return None
+
+        options = {
+            "viewerImage": self.options.viewer_image,
+            "useIstio": self.options.use_istio,
+            "istioGateway": self.options.istio_gateway,
+            "istioHost": self.options.istio_host,
+            "clusterDomain": self.options.cluster_domain,
+        }
+        spec = viewer.get("spec") or {}
+        if spec.get("rwoScheduling", True) and spec.get("pvc"):
+            node = find_rwo_node(self.api, req.namespace, spec["pvc"])
+            if node:
+                options["rwoPvcNode"] = node
+
+        out = native.invoke(
+            "pvcviewer_reconcile", {"viewer": viewer, "options": options}
+        )
+        self._ensure(out["deployment"])
+        self._ensure(out["service"])
+        if out["virtualService"] is not None:
+            self._ensure(out["virtualService"])
+
+        try:
+            deployment = self.api.get("apps/v1", "Deployment", req.name,
+                                      req.namespace)
+        except NotFound:
+            deployment = {}
+        status = {
+            "ready": bool((deployment.get("status") or {}).get("readyReplicas")),
+            "url": out["url"],
+        }
+        if viewer.get("status") != status:
+            self.api.patch_merge(
+                PVCVIEWER_API, "PVCViewer", req.name, {"status": status},
+                req.namespace,
+            )
+        return None
+
+
+def make_pvcviewer_controller(
+    api: FakeApiServer, options: PvcViewerOptions | None = None
+) -> Controller:
+    return Controller(
+        name="pvcviewer-controller",
+        api=api,
+        reconciler=PvcViewerReconciler(api, options),
+        watches=[
+            WatchSpec(PVCVIEWER_API, "PVCViewer"),
+            # Deployment readiness must refresh status.ready promptly
+            # (the reference controller Owns() the Deployment).
+            WatchSpec("apps/v1", "Deployment", deployment_to_owner),
+        ],
+    )

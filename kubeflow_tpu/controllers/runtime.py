@@ -94,6 +94,31 @@ class WorkQueue:
             return len(self._pending)
 
 
+def ensure_object(api, desired: dict) -> None:
+    """Create-or-update through the native drift repair: writes only when
+    an owned field differs (shared by every controller)."""
+    from kubeflow_tpu import native
+    from kubeflow_tpu.k8s.fake import NotFound
+
+    meta = desired["metadata"]
+    try:
+        existing = api.get(
+            desired["apiVersion"], desired["kind"], meta["name"],
+            meta.get("namespace"),
+        )
+    except NotFound:
+        api.create(desired)
+        return
+    merged = native.invoke(
+        "copy_owned_fields",
+        {"kind": desired["kind"], "existing": existing, "desired": desired},
+    )
+    if merged["changed"]:
+        # A Conflict (stale read) propagates; the queue's rate limiter
+        # retries the key.
+        api.update(merged["merged"])
+
+
 @dataclass
 class WatchSpec:
     api_version: str

@@ -1,0 +1,127 @@
+"""Tensorboard controller (reference tensorboard-controller/controllers/
+tensorboard_controller.go): Tensorboard CR → Deployment + Service +
+VirtualService, with RWO-PVC node affinity and status from the
+Deployment's conditions. Serves JAX profiler traces in this platform
+(tensorboard-plugin-profile in the image)."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from kubeflow_tpu import native
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    ensure_object,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+TENSORBOARD_API = "tensorboard.kubeflow.org/v1alpha1"
+
+
+@dataclasses.dataclass
+class TensorboardOptions:
+    """TENSORBOARD_IMAGE / RWO_PVC_SCHEDULING env parity (reference
+    tensorboard_controller.go:172,476-486)."""
+
+    tensorboard_image: str = "tensorflow/tensorflow:2.15.0"
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    rwo_pvc_scheduling: bool = True
+
+
+def find_rwo_node(api, namespace: str, claim: str) -> str:
+    """Node already mounting the RWO claim (reference :208-232): the new
+    pod must land there or stay Pending forever."""
+    for pod in api.list("v1", "Pod", namespace=namespace):
+        for vol in (pod.get("spec") or {}).get("volumes") or []:
+            pvc = vol.get("persistentVolumeClaim") or {}
+            if pvc.get("claimName") == claim:
+                node = (pod.get("spec") or {}).get("nodeName", "")
+                if node:
+                    return node
+    return ""
+
+
+class TensorboardReconciler:
+    def __init__(self, api: FakeApiServer, options: TensorboardOptions | None = None):
+        self.api = api
+        self.options = options or TensorboardOptions()
+
+    def _ensure(self, desired: dict) -> None:
+        ensure_object(self.api, desired)
+
+    def reconcile(self, req: Request) -> float | None:
+        try:
+            tb = self.api.get(TENSORBOARD_API, "Tensorboard", req.name,
+                              req.namespace)
+        except NotFound:
+            return None
+
+        options = {
+            "tensorboardImage": self.options.tensorboard_image,
+            "useIstio": self.options.use_istio,
+            "istioGateway": self.options.istio_gateway,
+            "istioHost": self.options.istio_host,
+            "clusterDomain": self.options.cluster_domain,
+        }
+        logspath = (tb.get("spec") or {}).get("logspath", "")
+        if self.options.rwo_pvc_scheduling and logspath.startswith("pvc://"):
+            claim = logspath[6:].split("/", 1)[0]
+            node = find_rwo_node(self.api, req.namespace, claim)
+            if node:
+                options["rwoPvcNode"] = node
+
+        out = native.invoke(
+            "tensorboard_reconcile", {"tensorboard": tb, "options": options}
+        )
+        self._ensure(out["deployment"])
+        self._ensure(out["service"])
+        if out["virtualService"] is not None:
+            self._ensure(out["virtualService"])
+
+        # Status: mirror Deployment readiness.
+        try:
+            deployment = self.api.get("apps/v1", "Deployment", req.name,
+                                      req.namespace)
+        except NotFound:
+            deployment = {}
+        ready = (deployment.get("status") or {}).get("readyReplicas", 0)
+        status = {
+            "readyReplicas": ready,
+            "conditions": (deployment.get("status") or {}).get("conditions", []),
+        }
+        if tb.get("status") != status:
+            self.api.patch_merge(
+                TENSORBOARD_API, "Tensorboard", req.name, {"status": status},
+                req.namespace,
+            )
+        return None
+
+
+def deployment_to_tensorboard(obj: dict):
+    meta = obj.get("metadata", {})
+    name = (meta.get("labels") or {}).get("app")
+    if not name:
+        return []
+    return [Request(meta.get("namespace", ""), name)]
+
+
+def make_tensorboard_controller(
+    api: FakeApiServer, options: TensorboardOptions | None = None
+) -> Controller:
+    return Controller(
+        name="tensorboard-controller",
+        api=api,
+        reconciler=TensorboardReconciler(api, options),
+        watches=[
+            WatchSpec(TENSORBOARD_API, "Tensorboard"),
+            WatchSpec("apps/v1", "Deployment", deployment_to_tensorboard),
+        ],
+    )

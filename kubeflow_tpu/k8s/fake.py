@@ -202,8 +202,12 @@ class FakeApiServer:
                 )
             meta["uid"] = cur["metadata"]["uid"]
             meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
+            if cur["metadata"].get("deletionTimestamp"):
+                meta["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
             meta["resourceVersion"] = str(next(self._rv))
             bucket[key] = obj
+            if self._maybe_finalize(obj):
+                return copy.deepcopy(obj)
             self._notify(gvk, WatchEvent("MODIFIED", obj))
             return copy.deepcopy(obj)
 
@@ -243,6 +247,8 @@ class FakeApiServer:
             cur["metadata"]["resourceVersion"] = str(next(self._rv))
             cur["metadata"]["uid"] = existing["metadata"]["uid"]
             bucket[key] = cur
+            if self._maybe_finalize(cur):
+                return copy.deepcopy(cur)
             self._notify(gvk, WatchEvent("MODIFIED", cur))
             return copy.deepcopy(cur)
 
@@ -252,11 +258,35 @@ class FakeApiServer:
             gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
             key = self._key(gvk, namespace, name)
             bucket = self._bucket(gvk)
-            obj = bucket.pop(key, None)
+            obj = bucket.get(key)
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
+            # Finalizer semantics: mark for deletion, let the controller
+            # clean up and strip its finalizer, THEN remove.
+            if obj["metadata"].get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    )
+                    obj["metadata"]["resourceVersion"] = str(next(self._rv))
+                    self._notify(gvk, WatchEvent("MODIFIED", obj))
+                return
+            bucket.pop(key)
             self._notify(gvk, WatchEvent("DELETED", obj))
             self._collect_orphans(obj)
+
+    def _maybe_finalize(self, obj: dict) -> bool:
+        """Removes an object whose deletionTimestamp is set and whose
+        finalizer list has emptied; returns True when finalised."""
+        meta = obj.get("metadata", {})
+        if not meta.get("deletionTimestamp") or meta.get("finalizers"):
+            return False
+        gvk = GVK.from_obj(obj)
+        key = self._key(gvk, meta.get("namespace"), meta["name"])
+        self._bucket(gvk).pop(key, None)
+        self._notify(gvk, WatchEvent("DELETED", obj))
+        self._collect_orphans(obj)
+        return True
 
     def _collect_orphans(self, owner: dict):
         """ownerReference cascade: delete dependents of a deleted owner

@@ -1,0 +1,12 @@
+"""KFAM — Kubeflow Access Management REST API.
+
+Capability parity with the reference access-management service
+(reference components/access-management/kfam/routers.go:35-88): a REST
+API over Profiles, contributor RoleBindings, and Istio
+AuthorizationPolicies, consumed by the central dashboard's workgroup
+endpoints.
+"""
+
+from kubeflow_tpu.kfam.app import create_app, binding_name, ROLE_MAP
+
+__all__ = ["create_app", "binding_name", "ROLE_MAP"]

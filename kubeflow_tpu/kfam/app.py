@@ -1,0 +1,243 @@
+"""KFAM application: profiles + contributor bindings.
+
+Contributor model (reference kfam/bindings.go:38-120): adding a
+contributor to a namespace materialises (a) a RoleBinding to the mapped
+ClusterRole and (b) an Istio AuthorizationPolicy admitting the user's
+identity header — both named after the escaped user email so deletion
+is addressable.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
+
+PROFILE_API = "kubeflow.org/v1"
+ISTIO_API = "security.istio.io/v1"
+RBAC_API = "rbac.authorization.k8s.io/v1"
+
+# role in the API -> ClusterRole (reference bindings.go role map).
+ROLE_MAP = {
+    "admin": "kubeflow-admin",
+    "edit": "kubeflow-edit",
+    "view": "kubeflow-view",
+}
+
+
+def _escape(user: str) -> str:
+    return re.sub(r"[^a-z0-9]", "-", user.lower())
+
+
+def binding_name(user: str, role: str) -> str:
+    return f"user-{_escape(user)}-clusterrole-{role}"
+
+
+def create_app(
+    api,
+    authn: AuthnConfig | None = None,
+    cluster_admin: str = "admin@kubeflow.org",
+    userid_header: str = "kubeflow-userid",
+    userid_prefix: str = "",
+    secure_cookies: bool = False,
+) -> RestApp:
+    app = RestApp(
+        "kfam",
+        authn=authn or AuthnConfig(userid_header=userid_header,
+                                   userid_prefix=userid_prefix),
+        secure_cookies=secure_cookies,
+    )
+
+    def is_cluster_admin(user: str) -> bool:
+        return user == cluster_admin
+
+    def owns_profile(user: str, profile: dict) -> bool:
+        owner = ((profile.get("spec") or {}).get("owner") or {})
+        return owner.get("name") == user
+
+    def may_manage(user: str, namespace: str) -> bool:
+        if is_cluster_admin(user):
+            return True
+        try:
+            profile = api.get(PROFILE_API, "Profile", namespace)
+        except NotFound:
+            return False
+        return owns_profile(user, profile)
+
+    # ---- profiles -------------------------------------------------------
+    @app.route("/kfam/v1/profiles", methods=["POST"])
+    def create_profile(request):
+        body = request.get_json(silent=True) or {}
+        name = (body.get("metadata") or {}).get("name") or body.get("name")
+        owner = ((body.get("spec") or {}).get("owner") or {}).get(
+            "name"
+        ) or body.get("user") or request.user
+        if not name:
+            raise ApiError("profile name required")
+        # Self-registration creates your own profile; only the cluster
+        # admin creates profiles for others (reference main.go
+        # cluster-admin flag).
+        if owner != request.user and not is_cluster_admin(request.user):
+            raise ApiError("only the cluster admin may create profiles for "
+                           "other users", 403)
+        profile = {
+            "apiVersion": PROFILE_API,
+            "kind": "Profile",
+            "metadata": {"name": name},
+            "spec": {"owner": {"kind": "User", "name": owner}},
+        }
+        if (body.get("spec") or {}).get("resourceQuotaSpec"):
+            profile["spec"]["resourceQuotaSpec"] = body["spec"][
+                "resourceQuotaSpec"
+            ]
+        try:
+            api.create(profile)
+        except K8sError as exc:
+            raise ApiError(str(exc), 409)
+        return {"profile": name}
+
+    @app.route("/kfam/v1/profiles/<name>", methods=["DELETE"])
+    def delete_profile(request, name):
+        if not may_manage(request.user, name):
+            raise ApiError("not authorized to delete this profile", 403)
+        try:
+            api.delete(PROFILE_API, "Profile", name)
+        except NotFound:
+            raise ApiError(f"profile {name!r} not found", 404)
+        return {}
+
+    # ---- cluster admin --------------------------------------------------
+    @app.route("/kfam/v1/clusteradmin")
+    def get_cluster_admin(request):
+        user = request.args.get("user", request.user)
+        return {"clusterAdmin": is_cluster_admin(user)}
+
+    # ---- bindings -------------------------------------------------------
+    @app.route("/kfam/v1/bindings")
+    def list_bindings(request):
+        namespace = request.args.get("namespace")
+        # Same gate as the mutating endpoints: without it, a bare GET
+        # would disclose every contributor cluster-wide.
+        if namespace:
+            if not may_manage(request.user, namespace):
+                raise ApiError("not authorized to list bindings in "
+                               f"{namespace!r}", 403)
+            namespaces = [namespace]
+        elif is_cluster_admin(request.user):
+            namespaces = [None]  # all
+        else:
+            namespaces = [
+                p["metadata"]["name"]
+                for p in api.list(PROFILE_API, "Profile")
+                if owns_profile(request.user, p)
+            ]
+        bindings = []
+        role_bindings = [
+            rb
+            for ns in namespaces
+            for rb in api.list(RBAC_API, "RoleBinding", namespace=ns)
+        ]
+        for rb in role_bindings:
+            annotations = rb["metadata"].get("annotations") or {}
+            if "user" not in annotations or "role" not in annotations:
+                continue  # not a KFAM-managed binding
+            bindings.append(
+                {
+                    "user": {"kind": "User", "name": annotations["user"]},
+                    "referredNamespace": rb["metadata"]["namespace"],
+                    "roleRef": {
+                        "kind": "ClusterRole",
+                        "name": rb["roleRef"]["name"],
+                    },
+                }
+            )
+        return {"bindings": bindings}
+
+    @app.route("/kfam/v1/bindings", methods=["POST"])
+    def create_binding(request):
+        body = request.get_json(silent=True) or {}
+        user, namespace, role = _parse_binding(body)
+        if not may_manage(request.user, namespace):
+            raise ApiError("only the namespace owner or cluster admin may "
+                           "add contributors", 403)
+        name = binding_name(user, role)
+        rb = {
+            "apiVersion": RBAC_API,
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "annotations": {"user": user, "role": role},
+            },
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": ROLE_MAP[role],
+            },
+            "subjects": [
+                {"apiGroup": "rbac.authorization.k8s.io", "kind": "User",
+                 "name": user}
+            ],
+        }
+        policy = {
+            "apiVersion": ISTIO_API,
+            "kind": "AuthorizationPolicy",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "annotations": {"user": user, "role": role},
+            },
+            "spec": {
+                "rules": [
+                    {
+                        "when": [
+                            {
+                                "key": f"request.headers[{userid_header}]",
+                                "values": [userid_prefix + user],
+                            }
+                        ]
+                    }
+                ]
+            },
+        }
+        try:
+            api.create(rb)
+            api.create(policy)
+        except K8sError as exc:
+            raise ApiError(str(exc), 409)
+        return {}
+
+    @app.route("/kfam/v1/bindings", methods=["DELETE"])
+    def delete_binding(request):
+        body = request.get_json(silent=True) or {}
+        user, namespace, role = _parse_binding(body)
+        if not may_manage(request.user, namespace):
+            raise ApiError("only the namespace owner or cluster admin may "
+                           "remove contributors", 403)
+        name = binding_name(user, role)
+        removed = False
+        for api_version, kind in ((RBAC_API, "RoleBinding"),
+                                  (ISTIO_API, "AuthorizationPolicy")):
+            try:
+                api.delete(api_version, kind, name, namespace)
+                removed = True
+            except NotFound:
+                pass
+        if not removed:
+            raise ApiError("binding not found", 404)
+        return {}
+
+    def _parse_binding(body: dict) -> tuple[str, str, str]:
+        user = ((body.get("user") or {}).get("name") or "").strip()
+        namespace = (body.get("referredNamespace") or "").strip()
+        role_ref = (body.get("roleRef") or {}).get("name", "edit")
+        role = role_ref.replace("kubeflow-", "")
+        if not user or not namespace:
+            raise ApiError("binding requires user.name and referredNamespace")
+        if role not in ROLE_MAP:
+            raise ApiError(f"unknown role {role!r}; valid: {sorted(ROLE_MAP)}")
+        return user, namespace, role
+
+    return app

@@ -1,0 +1,247 @@
+"""Profile controller + KFAM tests (reference SURVEY.md §3.3 call stack:
+registration → Profile CR → namespace/RBAC/quota; contributors via
+KFAM bindings)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.controllers.profile import (
+    ProfileOptions,
+    WorkloadIdentityPlugin,
+    make_profile_controller,
+)
+from kubeflow_tpu.crud_backend import AuthnConfig
+from kubeflow_tpu.k8s import FakeApiServer, NotFound
+from kubeflow_tpu.kfam import binding_name, create_app
+
+PROFILE_API = "kubeflow.org/v1"
+
+
+def profile_cr(name="alice", owner="alice@example.com", quota=None, plugins=None):
+    profile = {
+        "apiVersion": PROFILE_API,
+        "kind": "Profile",
+        "metadata": {"name": name},
+        "spec": {"owner": {"kind": "User", "name": owner}},
+    }
+    if quota:
+        profile["spec"]["resourceQuotaSpec"] = quota
+    if plugins:
+        profile["spec"]["plugins"] = plugins
+    return profile
+
+
+class TestProfileController:
+    def test_full_namespace_materialisation(self):
+        api = FakeApiServer()
+        ctrl = make_profile_controller(api)
+        api.create(profile_cr(quota={"hard": {"google.com/tpu": "16"}}))
+        ctrl.run_once()
+        ns = api.get("v1", "Namespace", "alice")
+        assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+        assert api.get("v1", "ServiceAccount", "default-editor", "alice")
+        assert api.get("v1", "ServiceAccount", "default-viewer", "alice")
+        rb = api.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                     "namespaceAdmin", "alice")
+        assert rb["subjects"][0]["name"] == "alice@example.com"
+        rq = api.get("v1", "ResourceQuota", "kf-resource-quota", "alice")
+        assert rq["spec"]["hard"]["google.com/tpu"] == "16"
+        assert api.get("security.istio.io/v1", "AuthorizationPolicy",
+                       "ns-owner-access-istio", "alice")
+
+    def test_namespace_labels_from_options(self):
+        api = FakeApiServer()
+        ctrl = make_profile_controller(
+            api, ProfileOptions(namespace_labels={"team": "research"})
+        )
+        api.create(profile_cr())
+        ctrl.run_once()
+        assert api.get("v1", "Namespace", "alice")["metadata"]["labels"][
+            "team"
+        ] == "research"
+
+    def test_workload_identity_plugin_and_finalizer_revocation(self):
+        api = FakeApiServer()
+        calls = []
+        plugin = WorkloadIdentityPlugin(
+            iam_binder=lambda gsa, member, add: calls.append((gsa, member, add))
+        )
+        ctrl = make_profile_controller(
+            api, plugins={"WorkloadIdentity": plugin}
+        )
+        api.create(
+            profile_cr(
+                plugins=[
+                    {"kind": "WorkloadIdentity",
+                     "spec": {"gcpServiceAccount": "gsa@proj.iam"}}
+                ]
+            )
+        )
+        ctrl.run_once()
+        sa = api.get("v1", "ServiceAccount", "default-editor", "alice")
+        assert sa["metadata"]["annotations"][
+            "iam.gke.io/gcp-service-account"
+        ] == "gsa@proj.iam"
+        # Reconciles are level-based: apply may run more than once, but
+        # always with the same grant.
+        assert set(calls) == {
+            ("gsa@proj.iam", "serviceAccount:[alice/default-editor]", True)
+        }
+        # Deleting the Profile revokes via finalizer, then removes.
+        api.delete(PROFILE_API, "Profile", "alice")
+        ctrl.run_once()
+        assert calls[-1] == ("gsa@proj.iam", "serviceAccount:[alice/default-editor]", False)
+        with pytest.raises(NotFound):
+            api.get(PROFILE_API, "Profile", "alice")
+
+
+USER = {"kubeflow-userid": "alice@example.com"}
+ADMIN = {"kubeflow-userid": "admin@kubeflow.org"}
+
+
+def kfam_client(api):
+    app = create_app(api, authn=AuthnConfig(), secure_cookies=False)
+    return app.test_client()
+
+
+def csrf(headers, client):
+    client.set_cookie("XSRF-TOKEN", "t")
+    return {**headers, "X-XSRF-TOKEN": "t", "Content-Type": "application/json"}
+
+
+class TestKfam:
+    def test_self_registration_creates_profile(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        resp = client.post(
+            "/kfam/v1/profiles",
+            data=json.dumps({"name": "alice"}),
+            headers=csrf(USER, client),
+        )
+        assert resp.status_code == 200
+        profile = api.get(PROFILE_API, "Profile", "alice")
+        assert profile["spec"]["owner"]["name"] == "alice@example.com"
+
+    def test_cannot_create_profile_for_other_user(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        resp = client.post(
+            "/kfam/v1/profiles",
+            data=json.dumps({"name": "bob-ns",
+                             "spec": {"owner": {"name": "bob@x.com"}}}),
+            headers=csrf(USER, client),
+        )
+        assert resp.status_code == 403
+
+    def test_cluster_admin_creates_for_others(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        resp = client.post(
+            "/kfam/v1/profiles",
+            data=json.dumps({"name": "bob-ns",
+                             "spec": {"owner": {"name": "bob@x.com"}}}),
+            headers=csrf(ADMIN, client),
+        )
+        assert resp.status_code == 200
+
+    def test_clusteradmin_endpoint(self):
+        client = kfam_client(FakeApiServer())
+        assert client.get("/kfam/v1/clusteradmin", headers=ADMIN).get_json()[
+            "clusterAdmin"
+        ] is True
+        assert client.get("/kfam/v1/clusteradmin", headers=USER).get_json()[
+            "clusterAdmin"
+        ] is False
+
+    def test_contributor_binding_lifecycle(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        # alice owns her profile.
+        client.post("/kfam/v1/profiles", data=json.dumps({"name": "alice"}),
+                    headers=csrf(USER, client))
+        binding = {
+            "user": {"kind": "User", "name": "bob@x.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        }
+        resp = client.post("/kfam/v1/bindings", data=json.dumps(binding),
+                           headers=csrf(USER, client))
+        assert resp.status_code == 200
+        name = binding_name("bob@x.com", "edit")
+        rb = api.get("rbac.authorization.k8s.io/v1", "RoleBinding", name, "alice")
+        assert rb["roleRef"]["name"] == "kubeflow-edit"
+        assert api.get("security.istio.io/v1", "AuthorizationPolicy", name, "alice")
+        # Listed.
+        data = client.get("/kfam/v1/bindings?namespace=alice",
+                          headers=USER).get_json()
+        assert data["bindings"][0]["user"]["name"] == "bob@x.com"
+        # Removed.
+        resp = client.delete("/kfam/v1/bindings", data=json.dumps(binding),
+                             headers=csrf(USER, client))
+        assert resp.status_code == 200
+        with pytest.raises(NotFound):
+            api.get("rbac.authorization.k8s.io/v1", "RoleBinding", name, "alice")
+
+    def test_non_owner_cannot_add_contributors(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        client.post("/kfam/v1/profiles", data=json.dumps({"name": "alice"}),
+                    headers=csrf(USER, client))
+        mallory = {"kubeflow-userid": "mallory@x.com"}
+        binding = {
+            "user": {"kind": "User", "name": "mallory@x.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"},
+        }
+        resp = client.post("/kfam/v1/bindings", data=json.dumps(binding),
+                           headers=csrf(mallory, client))
+        assert resp.status_code == 403
+
+    def test_unknown_role_rejected(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        client.post("/kfam/v1/profiles", data=json.dumps({"name": "alice"}),
+                    headers=csrf(USER, client))
+        binding = {
+            "user": {"kind": "User", "name": "bob@x.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-godmode"},
+        }
+        resp = client.post("/kfam/v1/bindings", data=json.dumps(binding),
+                           headers=csrf(USER, client))
+        assert resp.status_code == 400
+
+    def test_binding_list_does_not_leak_across_tenants(self):
+        """A non-admin listing without a namespace sees only namespaces
+        they own; a foreign namespace param is 403."""
+        api = FakeApiServer()
+        client = kfam_client(api)
+        client.post("/kfam/v1/profiles", data=json.dumps({"name": "alice"}),
+                    headers=csrf(USER, client))
+        bob = {"kubeflow-userid": "bob@x.com"}
+        client.post("/kfam/v1/profiles", data=json.dumps({"name": "bob"}),
+                    headers=csrf(bob, client))
+        binding = {
+            "user": {"kind": "User", "name": "carol@x.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-view"},
+        }
+        client.post("/kfam/v1/bindings", data=json.dumps(binding),
+                    headers=csrf(USER, client))
+        # bob can't see alice's bindings.
+        assert client.get("/kfam/v1/bindings?namespace=alice",
+                          headers=bob).status_code == 403
+        names = {
+            b["referredNamespace"]
+            for b in client.get("/kfam/v1/bindings",
+                                headers=bob).get_json()["bindings"]
+        }
+        assert names == set() or names == {"bob"}
+        # admin sees everything.
+        names = {
+            b["referredNamespace"]
+            for b in client.get("/kfam/v1/bindings",
+                                headers=ADMIN).get_json()["bindings"]
+        }
+        assert "alice" in names

@@ -1,0 +1,188 @@
+"""Tensorboard + PVCViewer controllers and their web apps (VWA/TWA)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.apps.tensorboards import create_app as create_twa
+from kubeflow_tpu.apps.volumes import create_app as create_vwa
+from kubeflow_tpu.controllers.pvcviewer import make_pvcviewer_controller
+from kubeflow_tpu.controllers.tensorboard import (
+    TensorboardOptions,
+    make_tensorboard_controller,
+)
+from kubeflow_tpu.crud_backend import AuthnConfig
+from kubeflow_tpu.k8s import FakeApiServer, NotFound
+
+TB_API = "tensorboard.kubeflow.org/v1alpha1"
+USER = {"kubeflow-userid": "alice@example.com"}
+
+
+def csrf(client, headers=USER):
+    client.set_cookie("XSRF-TOKEN", "t")
+    return {**headers, "X-XSRF-TOKEN": "t", "Content-Type": "application/json"}
+
+
+class TestTensorboardController:
+    def test_pvc_tensorboard_converges(self):
+        api = FakeApiServer()
+        ctrl = make_tensorboard_controller(
+            api, TensorboardOptions(use_istio=True)
+        )
+        api.create({
+            "apiVersion": TB_API, "kind": "Tensorboard",
+            "metadata": {"name": "tb1", "namespace": "alice"},
+            "spec": {"logspath": "pvc://workspace/logs"},
+        })
+        ctrl.run_once()
+        dep = api.get("apps/v1", "Deployment", "tb1", "alice")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--logdir=/tb-logs/logs" in args
+        assert api.get("v1", "Service", "tb1", "alice")
+        assert api.get("networking.istio.io/v1", "VirtualService",
+                       "tensorboard-alice-tb1", "alice")
+
+    def test_rwo_affinity_follows_mounting_pod(self):
+        api = FakeApiServer()
+        # A notebook pod already mounts the claim on node-3.
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nb-0", "namespace": "alice"},
+            "spec": {
+                "nodeName": "node-3",
+                "volumes": [{"name": "w",
+                             "persistentVolumeClaim": {"claimName": "workspace"}}],
+            },
+        })
+        ctrl = make_tensorboard_controller(api)
+        api.create({
+            "apiVersion": TB_API, "kind": "Tensorboard",
+            "metadata": {"name": "tb1", "namespace": "alice"},
+            "spec": {"logspath": "pvc://workspace/logs"},
+        })
+        ctrl.run_once()
+        dep = api.get("apps/v1", "Deployment", "tb1", "alice")
+        terms = dep["spec"]["template"]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["values"] == ["node-3"]
+
+    def test_status_mirrors_deployment(self):
+        api = FakeApiServer()
+        ctrl = make_tensorboard_controller(api)
+        api.create({
+            "apiVersion": TB_API, "kind": "Tensorboard",
+            "metadata": {"name": "tb1", "namespace": "alice"},
+            "spec": {"logspath": "gs://b/l"},
+        })
+        ctrl.run_once()
+        dep = api.get("apps/v1", "Deployment", "tb1", "alice")
+        dep["status"] = {"readyReplicas": 1}
+        api.update(dep)
+        ctrl.run_once()
+        tb = api.get(TB_API, "Tensorboard", "tb1", "alice")
+        assert tb["status"]["readyReplicas"] == 1
+
+
+class TestPvcViewerController:
+    def test_viewer_converges_with_url(self):
+        api = FakeApiServer()
+        ctrl = make_pvcviewer_controller(api)
+        api.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PVCViewer",
+            "metadata": {"name": "workspace", "namespace": "alice"},
+            "spec": {"pvc": "workspace"},
+        })
+        ctrl.run_once()
+        dep = api.get("apps/v1", "Deployment", "workspace", "alice")
+        vols = dep["spec"]["template"]["spec"]["volumes"]
+        assert vols[0]["persistentVolumeClaim"]["claimName"] == "workspace"
+        viewer = api.get("kubeflow.org/v1alpha1", "PVCViewer", "workspace",
+                         "alice")
+        assert viewer["status"]["url"] == "/pvcviewer/alice/workspace/"
+
+
+class TestVolumesApp:
+    def test_pvc_crud_and_viewer(self):
+        api = FakeApiServer()
+        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        headers = csrf(client)
+        resp = client.post(
+            "/api/namespaces/alice/pvcs",
+            data=json.dumps({"name": "data", "size": "50Gi",
+                             "mode": "ReadWriteOnce", "class": "ssd"}),
+            headers=headers,
+        )
+        assert resp.status_code == 200
+        pvc = api.get("v1", "PersistentVolumeClaim", "data", "alice")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "50Gi"
+        assert pvc["spec"]["storageClassName"] == "ssd"
+        # Launch viewer.
+        resp = client.post(
+            "/api/namespaces/alice/viewers",
+            data=json.dumps({"pvc": "data"}), headers=headers,
+        )
+        assert resp.status_code == 200
+        assert api.get("kubeflow.org/v1alpha1", "PVCViewer", "data", "alice")
+        # Listing shows usage + viewer.
+        data = client.get("/api/namespaces/alice/pvcs", headers=USER).get_json()
+        assert data["pvcs"][0]["name"] == "data"
+        # Delete PVC removes the viewer too.
+        assert client.delete("/api/namespaces/alice/pvcs/data",
+                             headers=headers).status_code == 200
+        with pytest.raises(NotFound):
+            api.get("kubeflow.org/v1alpha1", "PVCViewer", "data", "alice")
+
+    def test_pvc_used_by_notebooks(self):
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": "ws", "namespace": "alice"},
+            "spec": {"accessModes": ["ReadWriteOnce"],
+                     "resources": {"requests": {"storage": "1Gi"}}},
+        })
+        api.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "alice"},
+            "spec": {"template": {"spec": {
+                "containers": [{"name": "nb", "image": "i"}],
+                "volumes": [{"name": "ws",
+                             "persistentVolumeClaim": {"claimName": "ws"}}],
+            }}},
+        })
+        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        data = app.test_client().get("/api/namespaces/alice/pvcs",
+                                     headers=USER).get_json()
+        assert data["pvcs"][0]["usedBy"] == ["nb"]
+
+
+class TestTensorboardsApp:
+    def test_tb_crud(self):
+        api = FakeApiServer()
+        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        headers = csrf(client)
+        resp = client.post(
+            "/api/namespaces/alice/tensorboards",
+            data=json.dumps({"name": "tb1", "logspath": "pvc://ws/logs"}),
+            headers=headers,
+        )
+        assert resp.status_code == 200
+        data = client.get("/api/namespaces/alice/tensorboards",
+                          headers=USER).get_json()
+        assert data["tensorboards"][0]["logspath"] == "pvc://ws/logs"
+        assert client.delete("/api/namespaces/alice/tensorboards/tb1",
+                             headers=headers).status_code == 200
+        assert client.get("/api/namespaces/alice/tensorboards",
+                          headers=USER).get_json()["tensorboards"] == []
+
+    def test_missing_fields_rejected(self):
+        api = FakeApiServer()
+        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        resp = client.post(
+            "/api/namespaces/alice/tensorboards",
+            data=json.dumps({"name": "tb1"}), headers=csrf(client),
+        )
+        assert resp.status_code == 400
