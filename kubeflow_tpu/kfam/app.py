@@ -4,13 +4,14 @@ Contributor model (reference kfam/bindings.go:38-120): adding a
 contributor to a namespace materialises (a) a RoleBinding to the mapped
 ClusterRole and (b) an Istio AuthorizationPolicy admitting the user's
 identity header — both named after the escaped user email so deletion
-is addressable.
+is addressable. Binding desired-state generation is native
+(native/src/kfam.cpp — the role the Go KFAM binary plays in the
+reference); this module is the REST shell around it.
 """
 
 from __future__ import annotations
 
-import re
-
+from kubeflow_tpu import native
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
 from kubeflow_tpu.crud_backend.app import ApiError
 from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
@@ -27,12 +28,13 @@ ROLE_MAP = {
 }
 
 
-def _escape(user: str) -> str:
-    return re.sub(r"[^a-z0-9]", "-", user.lower())
-
-
 def binding_name(user: str, role: str) -> str:
-    return f"user-{_escape(user)}-clusterrole-{role}"
+    """Binding name as the native engine computes it — the single owner of
+    the format, so the POST (create) and DELETE paths can never drift."""
+    out = native.invoke(
+        "kfam_binding", {"user": user, "namespace": "-", "role": role}
+    )
+    return out["name"]
 
 
 def create_app(
@@ -162,49 +164,19 @@ def create_app(
         if not may_manage(request.user, namespace):
             raise ApiError("only the namespace owner or cluster admin may "
                            "add contributors", 403)
-        name = binding_name(user, role)
-        rb = {
-            "apiVersion": RBAC_API,
-            "kind": "RoleBinding",
-            "metadata": {
-                "name": name,
+        out = native.invoke(
+            "kfam_binding",
+            {
+                "user": user,
                 "namespace": namespace,
-                "annotations": {"user": user, "role": role},
+                "role": role,
+                "userIdHeader": userid_header,
+                "userIdPrefix": userid_prefix,
             },
-            "roleRef": {
-                "apiGroup": "rbac.authorization.k8s.io",
-                "kind": "ClusterRole",
-                "name": ROLE_MAP[role],
-            },
-            "subjects": [
-                {"apiGroup": "rbac.authorization.k8s.io", "kind": "User",
-                 "name": user}
-            ],
-        }
-        policy = {
-            "apiVersion": ISTIO_API,
-            "kind": "AuthorizationPolicy",
-            "metadata": {
-                "name": name,
-                "namespace": namespace,
-                "annotations": {"user": user, "role": role},
-            },
-            "spec": {
-                "rules": [
-                    {
-                        "when": [
-                            {
-                                "key": f"request.headers[{userid_header}]",
-                                "values": [userid_prefix + user],
-                            }
-                        ]
-                    }
-                ]
-            },
-        }
+        )
         try:
-            api.create(rb)
-            api.create(policy)
+            api.create(out["roleBinding"])
+            api.create(out["authorizationPolicy"])
         except K8sError as exc:
             raise ApiError(str(exc), 409)
         return {}

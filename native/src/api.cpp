@@ -12,6 +12,7 @@
 
 #include "culler.hpp"
 #include "json.hpp"
+#include "kfam.hpp"
 #include "notebook.hpp"
 #include "poddefault.hpp"
 #include "profile.hpp"
@@ -79,6 +80,7 @@ const std::map<std::string, Handler>& handlers() {
                                           ? in.at("options")
                                           : Json::object());
        }},
+      {"kfam_binding", [](const Json& in) { return kfam_binding(in); }},
       {"pvcviewer_reconcile",
        [](const Json& in) {
          return pvcviewer_reconcile(in.at("viewer"),

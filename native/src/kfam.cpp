@@ -1,0 +1,104 @@
+#include "kfam.hpp"
+
+#include <cctype>
+#include <stdexcept>
+
+namespace kft {
+
+namespace {
+
+// role in the API -> bound ClusterRole (reference kfam/bindings.go role
+// map: admin/edit/view -> kubeflow-*).
+const char* cluster_role_for(const std::string& role) {
+  if (role == "admin") return "kubeflow-admin";
+  if (role == "edit") return "kubeflow-edit";
+  if (role == "view") return "kubeflow-view";
+  throw std::runtime_error("unknown role '" + role +
+                           "'; valid: admin, edit, view");
+}
+
+}  // namespace
+
+std::string kfam_escape_user(const std::string& user) {
+  std::string out;
+  out.reserve(user.size());
+  for (char c : user) {
+    unsigned char uc = (unsigned char)c;
+    if (std::isalnum(uc))
+      out.push_back((char)std::tolower(uc));
+    else
+      out.push_back('-');
+  }
+  return out;
+}
+
+Json kfam_binding(const Json& in) {
+  const std::string user = in.get_string("user");
+  const std::string ns = in.get_string("namespace");
+  const std::string role = in.get_string("role", "edit");
+  if (user.empty() || ns.empty())
+    throw std::runtime_error("binding requires user and namespace");
+  const std::string cluster_role = cluster_role_for(role);
+  const std::string name =
+      "user-" + kfam_escape_user(user) + "-clusterrole-" + role;
+
+  Json ann = Json::object();
+  ann["user"] = Json(user);
+  ann["role"] = Json(role);
+
+  Json rb = Json::object();
+  rb["apiVersion"] = Json("rbac.authorization.k8s.io/v1");
+  rb["kind"] = Json("RoleBinding");
+  Json rb_meta = Json::object();
+  rb_meta["name"] = Json(name);
+  rb_meta["namespace"] = Json(ns);
+  rb_meta["annotations"] = ann;
+  rb["metadata"] = rb_meta;
+  Json role_ref = Json::object();
+  role_ref["apiGroup"] = Json("rbac.authorization.k8s.io");
+  role_ref["kind"] = Json("ClusterRole");
+  role_ref["name"] = Json(cluster_role);
+  rb["roleRef"] = role_ref;
+  Json subject = Json::object();
+  subject["apiGroup"] = Json("rbac.authorization.k8s.io");
+  subject["kind"] = Json("User");
+  subject["name"] = Json(user);
+  Json subjects = Json::array();
+  subjects.push_back(subject);
+  rb["subjects"] = subjects;
+
+  // Istio AuthorizationPolicy admitting the contributor's identity
+  // header (reference bindings.go: per-user policy alongside the RB).
+  Json ap = Json::object();
+  ap["apiVersion"] = Json("security.istio.io/v1");
+  ap["kind"] = Json("AuthorizationPolicy");
+  Json ap_meta = Json::object();
+  ap_meta["name"] = Json(name);
+  ap_meta["namespace"] = Json(ns);
+  ap_meta["annotations"] = ann;
+  ap["metadata"] = ap_meta;
+  Json when = Json::object();
+  when["key"] =
+      Json("request.headers[" +
+           in.get_string("userIdHeader", "kubeflow-userid") + "]");
+  Json values = Json::array();
+  values.push_back(Json(in.get_string("userIdPrefix", "") + user));
+  when["values"] = values;
+  Json whens = Json::array();
+  whens.push_back(when);
+  Json rule = Json::object();
+  rule["when"] = whens;
+  Json rules = Json::array();
+  rules.push_back(rule);
+  Json ap_spec = Json::object();
+  ap_spec["rules"] = rules;
+  ap["spec"] = ap_spec;
+
+  Json out = Json::object();
+  out["name"] = Json(name);
+  out["roleBinding"] = rb;
+  out["authorizationPolicy"] = ap;
+  return out;
+}
+
+}  // namespace kft

@@ -1,0 +1,20 @@
+// KFAM binding engine: contributor -> RoleBinding + AuthorizationPolicy
+// desired state (the role the Go KFAM binary plays in the reference,
+// access-management/kfam/bindings.go:38-120).
+#pragma once
+
+#include "json.hpp"
+
+namespace kft {
+
+// Escapes a user identity into a binding-name-safe token
+// (reference bindings.go: getBindingName).
+std::string kfam_escape_user(const std::string& user);
+
+// Input: {"user": ..., "namespace": ..., "role": "admin|edit|view",
+//         "userIdHeader": ..., "userIdPrefix": ...}
+// Output: {"name": ..., "roleBinding": {...}, "authorizationPolicy": {...}}
+// Throws on unknown role or missing user/namespace.
+Json kfam_binding(const Json& in);
+
+}  // namespace kft

@@ -644,3 +644,49 @@ class TestPvcViewerReconcile:
         assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "workspace"
         assert out["url"] == "/pvcviewer/user/view1/"
         assert out["virtualService"] is not None
+
+
+class TestKfamBinding:
+    def test_binding_pair(self):
+        out = invoke(
+            "kfam_binding",
+            {
+                "user": "Alice@Example.org",
+                "namespace": "team-a",
+                "role": "edit",
+                "userIdHeader": "kubeflow-userid",
+                "userIdPrefix": "accounts:",
+            },
+        )
+        assert out["name"] == "user-alice-example-org-clusterrole-edit"
+        rb = out["roleBinding"]
+        assert rb["roleRef"]["name"] == "kubeflow-edit"
+        assert rb["subjects"][0]["name"] == "Alice@Example.org"
+        assert rb["metadata"]["namespace"] == "team-a"
+        ap = out["authorizationPolicy"]
+        when = ap["spec"]["rules"][0]["when"][0]
+        assert when["key"] == "request.headers[kubeflow-userid]"
+        assert when["values"] == ["accounts:Alice@Example.org"]
+        # Name parity with the Python helper used on the DELETE path.
+        from kubeflow_tpu.kfam.app import binding_name
+
+        assert binding_name("Alice@Example.org", "edit") == out["name"]
+
+    def test_non_ascii_user_create_delete_same_name(self):
+        # Regression: create (native) and delete (binding_name) must agree
+        # on the escaped name for multi-byte identities.
+        from kubeflow_tpu.kfam.app import binding_name
+
+        out = invoke(
+            "kfam_binding",
+            {"user": "José@Example.org", "namespace": "ns", "role": "view"},
+        )
+        assert binding_name("José@Example.org", "view") == out["name"]
+
+    def test_unknown_role_rejected(self):
+        with pytest.raises(NativeError):
+            invoke("kfam_binding", {"user": "a", "namespace": "b", "role": "root"})
+
+    def test_missing_user_rejected(self):
+        with pytest.raises(NativeError):
+            invoke("kfam_binding", {"namespace": "b", "role": "edit"})
