@@ -7,6 +7,6 @@ AuthorizationPolicies, consumed by the central dashboard's workgroup
 endpoints.
 """
 
-from kubeflow_tpu.kfam.app import create_app, binding_name, ROLE_MAP
+from kubeflow_tpu.kfam.app import create_app, binding_objects, ROLES
 
-__all__ = ["create_app", "binding_name", "ROLE_MAP"]
+__all__ = ["create_app", "binding_objects", "ROLES"]

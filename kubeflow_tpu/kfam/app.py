@@ -17,24 +17,32 @@ from kubeflow_tpu.crud_backend.app import ApiError
 from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
 
 PROFILE_API = "kubeflow.org/v1"
-ISTIO_API = "security.istio.io/v1"
-RBAC_API = "rbac.authorization.k8s.io/v1"
+RBAC_API = "rbac.authorization.k8s.io/v1"  # list path only; writes use native
 
-# role in the API -> ClusterRole (reference bindings.go role map).
-ROLE_MAP = {
-    "admin": "kubeflow-admin",
-    "edit": "kubeflow-edit",
-    "view": "kubeflow-view",
-}
+# Roles the API accepts (reference bindings.go role map); the native
+# engine owns the role -> ClusterRole mapping and the name format.
+ROLES = ("admin", "edit", "view")
 
 
-def binding_name(user: str, role: str) -> str:
-    """Binding name as the native engine computes it — the single owner of
-    the format, so the POST (create) and DELETE paths can never drift."""
-    out = native.invoke(
-        "kfam_binding", {"user": user, "namespace": "-", "role": role}
+def binding_objects(
+    user: str, namespace: str, role: str,
+    userid_header: str = "kubeflow-userid", userid_prefix: str = "",
+) -> dict:
+    """Desired state from the native engine — the single owner of the
+    name format, ClusterRole map, and resource apiVersions, so the POST
+    (create) and DELETE paths can never drift."""
+    return native.invoke(
+        "kfam_binding",
+        {
+            "user": user,
+            "namespace": namespace,
+            "role": role,
+            "userIdHeader": userid_header,
+            "userIdPrefix": userid_prefix,
+        },
     )
-    return out["name"]
+
+
 
 
 def create_app(
@@ -164,16 +172,8 @@ def create_app(
         if not may_manage(request.user, namespace):
             raise ApiError("only the namespace owner or cluster admin may "
                            "add contributors", 403)
-        out = native.invoke(
-            "kfam_binding",
-            {
-                "user": user,
-                "namespace": namespace,
-                "role": role,
-                "userIdHeader": userid_header,
-                "userIdPrefix": userid_prefix,
-            },
-        )
+        out = binding_objects(user, namespace, role, userid_header,
+                              userid_prefix)
         try:
             api.create(out["roleBinding"])
             api.create(out["authorizationPolicy"])
@@ -188,12 +188,14 @@ def create_app(
         if not may_manage(request.user, namespace):
             raise ApiError("only the namespace owner or cluster admin may "
                            "remove contributors", 403)
-        name = binding_name(user, role)
+        # Delete exactly what create materialised: same native engine,
+        # same name/apiVersion/kind.
+        out = binding_objects(user, namespace, role)
         removed = False
-        for api_version, kind in ((RBAC_API, "RoleBinding"),
-                                  (ISTIO_API, "AuthorizationPolicy")):
+        for obj in (out["roleBinding"], out["authorizationPolicy"]):
             try:
-                api.delete(api_version, kind, name, namespace)
+                api.delete(obj["apiVersion"], obj["kind"],
+                           obj["metadata"]["name"], namespace)
                 removed = True
             except NotFound:
                 pass
@@ -208,8 +210,8 @@ def create_app(
         role = role_ref.replace("kubeflow-", "")
         if not user or not namespace:
             raise ApiError("binding requires user.name and referredNamespace")
-        if role not in ROLE_MAP:
-            raise ApiError(f"unknown role {role!r}; valid: {sorted(ROLE_MAP)}")
+        if role not in ROLES:
+            raise ApiError(f"unknown role {role!r}; valid: {sorted(ROLES)}")
         return user, namespace, role
 
     return app

@@ -13,6 +13,26 @@ from kubeflow_tpu.models.train import (
     make_eval_step,
 )
 
+# Transformer/LM exports resolve lazily: transformer.py pulls in pallas +
+# the ring-attention stack, which ResNet-only consumers (bench.py, the
+# driver's entry()) shouldn't pay for at import time.
+_LM_EXPORTS = (
+    "LMConfig",
+    "TransformerLM",
+    "build_lm",
+    "create_lm_state",
+    "make_lm_train_step",
+)
+
+
+def __getattr__(name):
+    if name in _LM_EXPORTS:
+        from kubeflow_tpu.models import transformer
+
+        return getattr(transformer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "ResNet",
     "resnet50",
@@ -21,4 +41,9 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "LMConfig",
+    "TransformerLM",
+    "build_lm",
+    "create_lm_state",
+    "make_lm_train_step",
 ]

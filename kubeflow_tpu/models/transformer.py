@@ -1,0 +1,195 @@
+"""Long-context decoder-only transformer LM.
+
+The second reference workload shipped in the ``jupyter-jax-tpu`` images
+(next to ResNet-50): a pre-norm decoder whose attention core is
+pluggable — XLA reference single-chip, the Pallas flash kernel on TPU,
+or ring attention over the mesh's ``sp`` axis for sequences too long
+for one chip's HBM (kubeflow_tpu.ops.ring). Everything else (embedding,
+MLP, norms) stays global-array pjit code: the batch shards over
+(dp, fsdp), the sequence over sp, and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.ops import apply_rope, flash_attention, mha_reference
+from kubeflow_tpu.ops.ring import make_ring_attention
+from kubeflow_tpu.parallel import param_sharding
+
+AttnImpl = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab: int = 32000
+    layers: int = 4
+    dim: int = 256
+    heads: int = 4
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+class RMSNorm(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6
+        )
+        return (norm * scale).astype(x.dtype)
+
+
+class Block(nn.Module):
+    cfg: LMConfig
+    attn_impl: AttnImpl | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        h = RMSNorm()(x)
+        qkv = nn.Dense(3 * cfg.dim, use_bias=False, dtype=cfg.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, dim) -> (B, H, S, head_dim)
+            return t.reshape(b, s, cfg.heads, cfg.head_dim).transpose(
+                0, 2, 1, 3
+            )
+
+        q, k, v = heads(q), heads(k), heads(v)
+        q, k = apply_rope(q), apply_rope(k)
+        attn = self.attn_impl or mha_reference
+        out = attn(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+        x = x + nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                         name="proj")(out)
+
+        h = RMSNorm()(x)
+        h = nn.Dense(cfg.mlp_ratio * cfg.dim, use_bias=False,
+                     dtype=cfg.dtype, name="up")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                         name="down")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    cfg: LMConfig
+    attn_impl: AttnImpl | None = None
+
+    @nn.compact
+    def __call__(self, tokens):  # (B, S) int32 -> (B, S, vocab) f32
+        cfg = self.cfg
+        emb = nn.Embed(cfg.vocab, cfg.dim, dtype=cfg.dtype, name="embed")
+        x = emb(tokens)
+        for i in range(cfg.layers):
+            x = Block(cfg, attn_impl=self.attn_impl, name=f"block_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        return emb.attend(x.astype(jnp.float32))
+
+
+def build_lm(
+    cfg: LMConfig, mesh: Mesh | None = None, use_flash: bool | None = None
+) -> TransformerLM:
+    """Pick the attention core for the execution context: ring attention
+    when the mesh has sp>1, the Pallas kernel on TPU, XLA reference
+    otherwise."""
+    attn: AttnImpl | None = None
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        attn = make_ring_attention(mesh, "sp")
+    elif use_flash or (use_flash is None and jax.default_backend() == "tpu"):
+        attn = lambda q, k, v, causal=True: flash_attention(
+            q, k, v, causal=causal
+        )
+    return TransformerLM(cfg, attn_impl=attn)
+
+
+def lm_loss(logits, tokens):
+    """Next-token cross entropy: predict tokens[:, 1:] from logits[:, :-1]."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    ).mean()
+
+
+def create_lm_state(
+    model: TransformerLM,
+    rng: jax.Array,
+    batch_shape: tuple[int, int],
+    tx: optax.GradientTransformation | None = None,
+    mesh: Mesh | None = None,
+):
+    """TrainState for the LM (no batch_stats; AdamW by default)."""
+    from kubeflow_tpu.models.train import TrainState
+
+    tx = tx or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_fn(rng):
+        tokens = jnp.zeros(batch_shape, jnp.int32)
+        params = model.init(rng, tokens)["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=tx.init(params),
+            tx=tx,
+            apply_fn=model.apply,
+        )
+
+    if mesh is None:
+        return init_fn(rng)
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: param_sharding(mesh, path, leaf), abstract
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_lm_train_step(mesh: Mesh | None = None):
+    """Jitted LM step; batch = {"tokens": (B, S) int32}. With a mesh, the
+    batch dim shards over (dp, fsdp) and the sequence dim over sp."""
+
+    def step(state, batch):
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, batch["tokens"])
+            return lm_loss(logits, batch["tokens"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_state = dataclasses.replace(
+            state,
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss}
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    token_sh = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+    def sharded_step(state, batch):
+        batch = {
+            "tokens": jax.lax.with_sharding_constraint(
+                batch["tokens"], token_sh
+            )
+        }
+        return step(state, batch)
+
+    return jax.jit(sharded_step, donate_argnums=0)

@@ -1,0 +1,24 @@
+"""TPU compute kernels for the notebook image stack.
+
+The reference platform ships CUDA wheels inside its notebook images
+(reference example-notebook-servers/jupyter-pytorch-cuda/Dockerfile:20-31)
+and provides no kernels of its own; the TPU-native stack instead ships
+these Pallas/XLA kernels inside ``jupyter-jax-tpu`` so spawned notebooks
+get a working long-context attention path out of the box (SURVEY.md §2.3:
+long-context/sequence parallelism is first-class here).
+"""
+
+from kubeflow_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+    apply_rope,
+)
+from kubeflow_tpu.ops.ring import ring_attention, make_ring_attention
+
+__all__ = [
+    "flash_attention",
+    "mha_reference",
+    "apply_rope",
+    "ring_attention",
+    "make_ring_attention",
+]

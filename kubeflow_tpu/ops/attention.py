@@ -1,0 +1,207 @@
+"""Flash attention: Pallas TPU kernel + XLA reference + RoPE.
+
+The forward pass is a tiled online-softmax kernel (grid over
+(batch*heads, q-blocks, k-blocks); softmax statistics and the output
+accumulator live in VMEM scratch across the k dimension, so the S x S
+score matrix is never materialised in HBM). The backward pass recomputes
+through the XLA reference implementation — O(S^2) peak memory in the
+bwd, fine at single-chip sequence lengths; long-context training uses
+:mod:`kubeflow_tpu.ops.ring` which scans over sequence shards instead.
+
+Off-TPU (CPU test meshes) the kernel runs in Pallas interpret mode, so
+numerics are identical everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Finite "minus infinity": keeps exp(s - m) NaN-free when a whole row of
+# scores is masked (exp(NEG_INF - m) underflows to 0 instead of NaN).
+NEG_INF = -1e30
+
+
+def _causal_mask(scores, q_offset, k_offset):
+    rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, scores.ndim - 2)
+    cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, scores.ndim - 1)
+    return jnp.where(rows >= cols, scores, NEG_INF)
+
+
+def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
+    """Plain XLA attention. q: (..., Sq, D), k/v: (..., Sk, D).
+
+    ``q_offset``/``k_offset`` place the blocks in a longer global
+    sequence for causal masking (used by the ring-attention tests).
+    """
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    s = jnp.einsum(
+        "...qd,...kd->...qk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+    if causal:
+        s = _causal_mask(s, q_offset, k_offset)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", w, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; skip the
+        # matmuls (the scratch/out writes below still run every step).
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    batch, heads, s_q, d = q.shape
+    s_k = k.shape[2]
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({s_q}, {s_k}) must be multiples of the "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    bh = batch * heads
+    qr = q.reshape(bh, s_q, d)
+    kr = k.reshape(bh, s_k, d)
+    vr = v.reshape(bh, s_k, d)
+    grid = (bh, s_q // block_q, s_k // block_k)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, s_q, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: mha_reference(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal=False, scale=None,
+    block_q=128, block_k=128, interpret=None,
+):
+    """Tiled attention. q/k/v: (batch, heads, seq, head_dim).
+
+    On TPU, ``head_dim`` and the block sizes should be multiples of 128
+    (MXU tiles); sequence lengths must divide by the block sizes. Off
+    TPU the kernel auto-falls-back to interpret mode.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+# ---- rotary position embeddings ----------------------------------------
+
+
+def rope_table(seq_len: int, head_dim: int, base: float = 10000.0, offset: int = 0):
+    """(cos, sin) tables of shape (seq_len, head_dim // 2)."""
+    half = head_dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)[:, None]
+    angles = pos * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, *, offset: int = 0, base: float = 10000.0):
+    """Rotary embedding over the last two dims of (..., seq, head_dim).
+
+    Position is the global sequence index — pass ``offset`` when ``x`` is
+    a shard of a longer sequence (ring attention / sequence parallelism).
+    """
+    half = x.shape[-1] // 2
+    cos, sin = rope_table(x.shape[-2], x.shape[-1], base=base, offset=offset)
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    rotated = jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)

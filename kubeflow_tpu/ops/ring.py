@@ -1,0 +1,91 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The long-context strategy the platform's multi-host notebooks use
+(SURVEY.md §2.3: the reference has no collective layer at all; here it
+is first-class). Sequence is sharded over the mesh's ``sp`` axis; each
+device holds a q/k/v shard, computes blockwise attention against the
+k/v shard it currently holds, folds the block into running online-softmax
+statistics, and rotates k/v to its ring neighbour with
+``jax.lax.ppermute``. After ``sp`` steps every q has attended to every
+k/v while only ever storing one shard per device — memory per device is
+O(S/sp * S/sp) per step instead of O(S^2), and the per-step transfer
+rides one ICI hop, overlapping with the block matmuls under XLA's
+latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import NEG_INF, _causal_mask
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
+    """Attention over a sequence-sharded axis; call inside shard_map.
+
+    q, k, v: local shards of shape (batch, heads, seq_local, head_dim),
+    sharded on dim 2 over ``axis_name``. Returns the local output shard.
+    Differentiable (the scan + ppermute transpose to the reverse ring).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_shard = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    qf = q.astype(jnp.float32)
+    shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        o, m, l, k_t, v_t = carry
+        # After t clockwise rotations this device holds the shard that
+        # originated on device (my_shard - t) mod axis_size.
+        src = (my_shard - t) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_t.astype(jnp.float32)) * scale
+        if causal:
+            s = _causal_mask(s, my_shard * s_local, src * s_local)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32)
+        )
+        # Rotate k/v one ICI hop (the final rotation returns them home —
+        # a wasted hop, but it keeps the scan body uniform).
+        k_next = jax.lax.ppermute(k_t, axis_name, shift)
+        v_next = jax.lax.ppermute(v_t, axis_name, shift)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    stats_shape = (*q.shape[:3], 1)
+    # The accumulators start as constants but become device-varying once
+    # folded with per-device scores; mark them varying up front so the
+    # scan carry type is stable (shard_map VMA checking).
+    init = (
+        jax.lax.pvary(jnp.zeros(qf.shape, jnp.float32), axis_name),
+        jax.lax.pvary(jnp.full(stats_shape, NEG_INF, jnp.float32), axis_name),
+        jax.lax.pvary(jnp.zeros(stats_shape, jnp.float32), axis_name),
+        k,
+        v,
+    )
+    (o, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(axis_size))
+    return (o / l).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+    """Global-array wrapper: shard q/k/v on seq over ``axis_name`` and run
+    the ring inside shard_map. Drop-in for an attention impl taking
+    (q, k, v, causal) as global (batch, heads, seq, head_dim) arrays."""
+    spec = P(None, None, axis_name, None)
+
+    def attend(q, k, v, causal=False):
+        fn = functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal
+        )
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )(q, k, v)
+
+    return attend

@@ -1,6 +1,5 @@
 #include "kfam.hpp"
 
-#include <cctype>
 #include <stdexcept>
 
 namespace kft {
@@ -20,12 +19,18 @@ const char* cluster_role_for(const std::string& role) {
 }  // namespace
 
 std::string kfam_escape_user(const std::string& user) {
+  // Explicit ASCII ranges, not <cctype>: isalnum/tolower are
+  // locale-sensitive, and binding names must be identical across
+  // processes and valid K8s names ([a-z0-9-]).
   std::string out;
   out.reserve(user.size());
   for (char c : user) {
-    unsigned char uc = (unsigned char)c;
-    if (std::isalnum(uc))
-      out.push_back((char)std::tolower(uc));
+    if (c >= 'a' && c <= 'z')
+      out.push_back(c);
+    else if (c >= 'A' && c <= 'Z')
+      out.push_back((char)(c - 'A' + 'a'));
+    else if (c >= '0' && c <= '9')
+      out.push_back(c);
     else
       out.push_back('-');
   }

@@ -667,21 +667,17 @@ class TestKfamBinding:
         when = ap["spec"]["rules"][0]["when"][0]
         assert when["key"] == "request.headers[kubeflow-userid]"
         assert when["values"] == ["accounts:Alice@Example.org"]
-        # Name parity with the Python helper used on the DELETE path.
-        from kubeflow_tpu.kfam.app import binding_name
+        assert rb["metadata"]["name"] == out["name"]
+        assert ap["metadata"]["name"] == out["name"]
 
-        assert binding_name("Alice@Example.org", "edit") == out["name"]
-
-    def test_non_ascii_user_create_delete_same_name(self):
-        # Regression: create (native) and delete (binding_name) must agree
-        # on the escaped name for multi-byte identities.
-        from kubeflow_tpu.kfam.app import binding_name
-
+    def test_non_ascii_user_escapes_to_valid_k8s_name(self):
+        # Multi-byte identities must deterministically map to [a-z0-9-]
+        # regardless of process locale ('é' = 2 UTF-8 bytes -> 2 dashes).
         out = invoke(
             "kfam_binding",
             {"user": "José@Example.org", "namespace": "ns", "role": "view"},
         )
-        assert binding_name("José@Example.org", "view") == out["name"]
+        assert out["name"] == "user-jos---example-org-clusterrole-view"
 
     def test_unknown_role_rejected(self):
         with pytest.raises(NativeError):

@@ -13,7 +13,7 @@ from kubeflow_tpu.controllers.profile import (
 )
 from kubeflow_tpu.crud_backend import AuthnConfig
 from kubeflow_tpu.k8s import FakeApiServer, NotFound
-from kubeflow_tpu.kfam import binding_name, create_app
+from kubeflow_tpu.kfam import binding_objects, create_app
 
 PROFILE_API = "kubeflow.org/v1"
 
@@ -168,7 +168,7 @@ class TestKfam:
         resp = client.post("/kfam/v1/bindings", data=json.dumps(binding),
                            headers=csrf(USER, client))
         assert resp.status_code == 200
-        name = binding_name("bob@x.com", "edit")
+        name = binding_objects("bob@x.com", "alice", "edit")["name"]
         rb = api.get("rbac.authorization.k8s.io/v1", "RoleBinding", name, "alice")
         assert rb["roleRef"]["name"] == "kubeflow-edit"
         assert api.get("security.istio.io/v1", "AuthorizationPolicy", name, "alice")
