@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import json
 import logging
+import mimetypes
+import os
 import time
 import traceback
 from typing import Callable
@@ -65,6 +67,7 @@ class RestApp:
         self.url_map = Map()
         self.views: dict[str, Callable] = {}
         self._index_html: str | None = None
+        self._static_dir: str | None = None
 
         # Per-app registry: instantiating the same app twice (tests) must
         # not collide in the process-global default registry.
@@ -101,6 +104,30 @@ class RestApp:
         """Registers the SPA index at / (CSRF cookie set on delivery —
         reference crud_backend/serving.py:18-31)."""
         self._index_html = html
+
+    def serve_static(self, directory: str, index: str = "index.html"):
+        """Serve a SPA from ``directory``: ``/`` returns the index (with
+        the CSRF cookie), other unmatched GET paths fall through to files
+        under the directory (reference crud_backend/serving.py serves the
+        built frontend the same way)."""
+        self._static_dir = os.path.abspath(directory)
+        with open(os.path.join(self._static_dir, index)) as fh:
+            self.serve_index(fh.read())
+
+    def _static_response(self, path: str) -> Response | None:
+        if self._static_dir is None:
+            return None
+        # Containment check: the resolved file must stay inside the dir.
+        full = os.path.abspath(
+            os.path.join(self._static_dir, path.lstrip("/"))
+        )
+        if not full.startswith(self._static_dir + os.sep):
+            return None
+        if not os.path.isfile(full):
+            return None
+        mime = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as fh:
+            return Response(fh.read(), mimetype=mime)
 
     # ---- request lifecycle ----------------------------------------------
     def _authn_user(self, request: Request) -> str | None:
@@ -158,6 +185,11 @@ class RestApp:
         except Forbidden as exc:
             return self._error(403, str(exc))
         except NotFound:
+            if request.method in ("GET", "HEAD"):
+                static = self._static_response(request.path)
+                if static is not None:
+                    state["endpoint"] = "static"
+                    return static
             return self._error(404, f"Not found: {request.path}")
         except HTTPException as exc:
             return self._error(exc.code or 500, exc.description or "error")

@@ -1,0 +1,16 @@
+"""Central dashboard — the platform's landing page and shell.
+
+Capability parity with the reference centraldashboard (reference
+centraldashboard/app/server.ts:41-112): ``/api/*`` (namespaces,
+activities, metrics, dashboard-links from a ConfigMap) and
+``/api/workgroup/*`` (registration, env-info aggregation, contributor
+management proxied to KFAM), plus the SPA shell that iframes the
+per-resource web apps and broadcasts namespace selection over
+postMessage. TPU delta: the metrics cards report fleet chip
+allocation/utilisation instead of GPU counts.
+"""
+
+from kubeflow_tpu.dashboard.app import create_app, KfamProxy
+from kubeflow_tpu.dashboard.metrics import tpu_fleet_metrics
+
+__all__ = ["create_app", "KfamProxy", "tpu_fleet_metrics"]

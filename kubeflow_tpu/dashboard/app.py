@@ -1,0 +1,330 @@
+"""Dashboard backend: /api + /api/workgroup (reference
+centraldashboard/app/api.ts:30-113 and api_workgroup.ts:255-391).
+
+The workgroup endpoints aggregate KFAM + the K8s API into the env-info
+payload the shell boots from, and proxy contributor management to the
+KFAM service with the caller's identity header — the same
+process-boundary layering as the reference (dashboard → KFAM :8081).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
+from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.dashboard.metrics import (
+    NoMetricsService,
+    tpu_fleet_metrics,
+)
+from kubeflow_tpu.k8s.fake import NotFound
+
+PROFILE_API = "kubeflow.org/v1"
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"type": "item", "link": "/jupyter/", "text": "Notebooks",
+         "icon": "book"},
+        {"type": "item", "link": "/tensorboards/", "text": "TensorBoards",
+         "icon": "assessment"},
+        {"type": "item", "link": "/volumes/", "text": "Volumes",
+         "icon": "device:storage"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"text": "Create a new Notebook", "desc": "Jupyter on TPU",
+         "link": "/jupyter/new"},
+    ],
+    "documentationItems": [],
+}
+
+
+class KfamProxy:
+    """In-process client for the KFAM RestApp, forwarding the caller's
+    identity header (the reference dashboard proxies KFAM over HTTP with
+    the same header — api_workgroup.ts:255-391)."""
+
+    def __init__(self, kfam_app: RestApp):
+        self._app = kfam_app
+        self._header = kfam_app.authn.userid_header
+
+    def _call(self, method: str, path: str, user: str, body=None):
+        client = self._app.test_client()
+        # Server-to-server call: satisfy the CSRF double-submit pair.
+        client.set_cookie("XSRF-TOKEN", "dashboard-proxy")
+        resp = client.open(
+            path,
+            method=method,
+            json=body,
+            headers={self._header: user, "X-XSRF-TOKEN": "dashboard-proxy"},
+        )
+        data = resp.get_json(silent=True) or {}
+        if resp.status_code >= 400:
+            raise ApiError(
+                data.get("log", f"KFAM error {resp.status_code}"),
+                resp.status_code,
+            )
+        return data
+
+    def create_profile(self, user: str, namespace: str):
+        return self._call(
+            "POST", "/kfam/v1/profiles", user,
+            {"name": namespace,
+             "spec": {"owner": {"kind": "User", "name": user}}},
+        )
+
+    def delete_profile(self, user: str, namespace: str):
+        return self._call(
+            "DELETE", f"/kfam/v1/profiles/{namespace}", user
+        )
+
+    def is_cluster_admin(self, user: str) -> bool:
+        return bool(
+            self._call("GET", "/kfam/v1/clusteradmin", user)["clusterAdmin"]
+        )
+
+    def list_bindings(self, user: str, namespace: str | None = None):
+        path = "/kfam/v1/bindings"
+        if namespace:
+            path += f"?namespace={namespace}"
+        return self._call("GET", path, user)["bindings"]
+
+    def add_contributor(self, user: str, namespace: str, contributor: str):
+        return self._call(
+            "POST", "/kfam/v1/bindings", user,
+            {
+                "user": {"kind": "User", "name": contributor},
+                "referredNamespace": namespace,
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            },
+        )
+
+    def remove_contributor(self, user: str, namespace: str, contributor: str):
+        return self._call(
+            "DELETE", "/kfam/v1/bindings", user,
+            {
+                "user": {"kind": "User", "name": contributor},
+                "referredNamespace": namespace,
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            },
+        )
+
+
+def create_app(
+    api,
+    kfam: KfamProxy | None = None,
+    authn: AuthnConfig | None = None,
+    metrics_service=None,
+    registration_flow: bool = True,
+    secure_cookies: bool = False,
+) -> RestApp:
+    app = RestApp(
+        "dashboard",
+        authn=authn,
+        secure_cookies=secure_cookies,
+    )
+    metrics_service = metrics_service or NoMetricsService()
+    if os.path.isdir(_STATIC_DIR):
+        app.serve_static(_STATIC_DIR)
+
+    def owned_profiles(user: str) -> list[dict]:
+        return [
+            p for p in api.list(PROFILE_API, "Profile")
+            if ((p.get("spec") or {}).get("owner") or {}).get("name") == user
+        ]
+
+    def contributed_namespaces(user: str) -> list[str]:
+        out = []
+        for rb in api.list("rbac.authorization.k8s.io/v1", "RoleBinding"):
+            ann = rb["metadata"].get("annotations") or {}
+            if ann.get("user") == user and "role" in ann:
+                out.append(rb["metadata"]["namespace"])
+        return sorted(set(out))
+
+    # ---- /api ----------------------------------------------------------
+    @app.route("/api/dashboard-links")
+    def dashboard_links(request):
+        """Links/settings from the `centraldashboard-config` ConfigMap
+        (reference api.ts:84-113); falls back to built-in defaults."""
+        try:
+            cm = api.get("v1", "ConfigMap", "centraldashboard-config",
+                         "kubeflow")
+            links = json.loads((cm.get("data") or {}).get("links", "{}"))
+            settings = json.loads(
+                (cm.get("data") or {}).get("settings", "{}")
+            )
+        except NotFound:
+            links, settings = DEFAULT_LINKS, {}
+        except (ValueError, TypeError):
+            raise ApiError("malformed centraldashboard-config", 500)
+        return {"links": links or DEFAULT_LINKS, "settings": settings}
+
+    @app.route("/api/namespaces")
+    def list_namespaces(request):
+        return {
+            "namespaces": [
+                ns["metadata"]["name"] for ns in api.list("v1", "Namespace")
+            ]
+        }
+
+    @app.route("/api/activities/<namespace>")
+    def activities(request, namespace):
+        """Recent events, newest first (reference api.ts events path)."""
+        events = api.list("v1", "Event", namespace=namespace)
+        events.sort(
+            key=lambda e: e.get("lastTimestamp")
+            or e["metadata"].get("creationTimestamp") or "",
+            reverse=True,
+        )
+        return {
+            "activities": [
+                {
+                    "type": e.get("type", "Normal"),
+                    "reason": e.get("reason", ""),
+                    "message": e.get("message", ""),
+                    "object": (e.get("involvedObject") or {}).get("name", ""),
+                    "time": e.get("lastTimestamp")
+                    or e["metadata"].get("creationTimestamp"),
+                }
+                for e in events[:50]
+            ]
+        }
+
+    @app.route("/api/metrics/tpu")
+    def metrics_tpu(request):
+        return tpu_fleet_metrics(api)
+
+    @app.route("/api/metrics/<metric>")
+    def metrics_series(request, metric):
+        if metric not in ("node", "podcpu", "podmem"):
+            raise ApiError(f"unknown metric {metric!r}", 404)
+        try:
+            period = int(request.args.get("period", "900"))
+        except ValueError:
+            raise ApiError("'period' must be an integer", 400)
+        try:
+            series = metrics_service.query(metric, period)
+        except LookupError:
+            raise ApiError("no metrics backend configured", 404)
+        return {"metric": metric, "series": series}
+
+    # ---- /api/workgroup -------------------------------------------------
+    @app.route("/api/workgroup/exists")
+    def workgroup_exists(request):
+        user = request.user
+        has_workgroup = bool(owned_profiles(user))
+        return {
+            "user": user,
+            "hasAuth": True,
+            "hasWorkgroup": has_workgroup,
+            "registrationFlowAllowed": registration_flow,
+        }
+
+    @app.route("/api/workgroup/create", methods=["POST"])
+    def workgroup_create(request):
+        if kfam is None:
+            raise ApiError("KFAM is not configured", 503)
+        body = request.get_json(silent=True) or {}
+        namespace = body.get("namespace") or _default_namespace(request.user)
+        kfam.create_profile(request.user, namespace)
+        return {"namespace": namespace}
+
+    @app.route("/api/workgroup/nuke-self", methods=["DELETE"])
+    def workgroup_nuke(request):
+        if kfam is None:
+            raise ApiError("KFAM is not configured", 503)
+        profiles = owned_profiles(request.user)
+        if not profiles:
+            raise ApiError("no workgroup to delete", 404)
+        for profile in profiles:
+            kfam.delete_profile(request.user, profile["metadata"]["name"])
+        return {"deleted": [p["metadata"]["name"] for p in profiles]}
+
+    @app.route("/api/workgroup/env-info")
+    def env_info(request):
+        user = request.user
+        is_admin = kfam.is_cluster_admin(user) if kfam else False
+        namespaces = [
+            {"namespace": p["metadata"]["name"], "role": "owner",
+             "user": user}
+            for p in owned_profiles(user)
+        ]
+        owned = {n["namespace"] for n in namespaces}
+        namespaces.extend(
+            {"namespace": ns, "role": "contributor", "user": user}
+            for ns in contributed_namespaces(user)
+            if ns not in owned
+        )
+        return {
+            "user": user,
+            "isClusterAdmin": is_admin,
+            "namespaces": namespaces,
+            "platform": {"kind": "tpu", "provider": "gke"},
+        }
+
+    @app.route("/api/workgroup/get-all-namespaces")
+    def all_namespaces(request):
+        if kfam is None or not kfam.is_cluster_admin(request.user):
+            raise ApiError("cluster admin only", 403)
+        out = []
+        for p in api.list(PROFILE_API, "Profile"):
+            ns = p["metadata"]["name"]
+            owner = ((p.get("spec") or {}).get("owner") or {}).get("name")
+            contributors = [
+                b["user"]["name"]
+                for b in kfam.list_bindings(request.user, ns)
+            ]
+            out.append(
+                {"namespace": ns, "owner": owner,
+                 "contributors": contributors}
+            )
+        return {"namespaces": out}
+
+    @app.route("/api/workgroup/get-contributors/<namespace>")
+    def get_contributors(request, namespace):
+        if kfam is None:
+            raise ApiError("KFAM is not configured", 503)
+        return {
+            "contributors": [
+                b["user"]["name"]
+                for b in kfam.list_bindings(request.user, namespace)
+            ]
+        }
+
+    @app.route(
+        "/api/workgroup/add-contributor/<namespace>", methods=["POST"]
+    )
+    def add_contributor(request, namespace):
+        if kfam is None:
+            raise ApiError("KFAM is not configured", 503)
+        body = request.get_json(silent=True) or {}
+        contributor = (body.get("contributor") or "").strip()
+        if not contributor:
+            raise ApiError("'contributor' required")
+        kfam.add_contributor(request.user, namespace, contributor)
+        return get_contributors(request, namespace)
+
+    @app.route(
+        "/api/workgroup/remove-contributor/<namespace>", methods=["DELETE"]
+    )
+    def remove_contributor(request, namespace):
+        if kfam is None:
+            raise ApiError("KFAM is not configured", 503)
+        body = request.get_json(silent=True) or {}
+        contributor = (body.get("contributor") or "").strip()
+        if not contributor:
+            raise ApiError("'contributor' required")
+        kfam.remove_contributor(request.user, namespace, contributor)
+        return get_contributors(request, namespace)
+
+    return app
+
+
+def _default_namespace(user: str) -> str:
+    """user@example.org -> kubeflow-user-example-org (the reference's
+    registration default naming)."""
+    import re
+
+    return "kubeflow-" + re.sub(r"[^a-z0-9]+", "-", user.lower()).strip("-")

@@ -1,0 +1,123 @@
+"""Dashboard metrics services.
+
+The reference dashboard reads node/pod cpu+memory series from Prometheus
+or Stackdriver behind a factory (reference
+centraldashboard/app/metrics_service_factory.ts,
+prometheus_metrics_service.ts). The TPU-native dashboard keeps that
+pluggable interface and adds the fleet view that matters on a TPU
+cluster: chips allocatable vs requested per accelerator type, computed
+directly from Node and Pod objects — no Prometheus required for the
+headline cards.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+TPU_RESOURCE = "google.com/tpu"
+ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+class MetricsService(Protocol):
+    """Time-series backend for the resource charts (optional)."""
+
+    def query(self, metric: str, period_s: int) -> list[dict]:
+        """Returns [{"timestamp": ..., "value": ...}, ...]."""
+
+
+class NoMetricsService:
+    """Stands in when no Prometheus is deployed (reference behaviour:
+    metrics endpoints 404 when no service is configured)."""
+
+    def query(self, metric: str, period_s: int) -> list[dict]:
+        raise LookupError("no metrics backend configured")
+
+
+def _parse_quantity(val) -> float:
+    """K8s resource quantity -> float (chips are integers, but cpu/mem
+    styles appear in tests)."""
+    if isinstance(val, (int, float)):
+        return float(val)
+    s = str(val)
+    suffixes = {
+        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+    }
+    for suffix in sorted(suffixes, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * suffixes[suffix]
+    return float(s)
+
+
+def _node_ready(node: dict) -> bool:
+    """Ready unless an explicit Ready!=True condition says otherwise
+    (test fixtures without conditions count as ready)."""
+    for cond in (node.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return True
+
+
+def tpu_fleet_metrics(api) -> dict:
+    """Fleet chip inventory: per accelerator type, chips allocatable on
+    Ready nodes vs chips requested by running pods.
+
+    Replaces the reference's GPU-less node cpu/mem cards with the
+    numbers a TPU platform admin watches (slice capacity and usage).
+    """
+    fleet: dict[str, dict] = {}
+    node_accel: dict[str, str] = {}
+    for node in api.list("v1", "Node"):
+        if not _node_ready(node):
+            continue
+        labels = (node["metadata"].get("labels") or {})
+        accel = labels.get(ACCELERATOR_LABEL)
+        alloc = (node.get("status") or {}).get("allocatable") or {}
+        chips = _parse_quantity(alloc.get(TPU_RESOURCE, 0))
+        if not accel and not chips:
+            continue
+        accel = accel or "unknown"
+        node_accel[node["metadata"]["name"]] = accel
+        entry = fleet.setdefault(
+            accel,
+            {"allocatable": 0, "requested": 0, "nodes": 0, "topologies": set()},
+        )
+        entry["allocatable"] += int(chips)
+        entry["nodes"] += 1
+        if labels.get(TOPOLOGY_LABEL):
+            entry["topologies"].add(labels[TOPOLOGY_LABEL])
+
+    for pod in api.list("v1", "Pod"):
+        phase = (pod.get("status") or {}).get("phase")
+        if phase in ("Succeeded", "Failed"):
+            continue
+        node_name = (pod.get("spec") or {}).get("nodeName")
+        accel = node_accel.get(node_name)
+        for container in (pod.get("spec") or {}).get("containers") or []:
+            limits = (container.get("resources") or {}).get("limits") or {}
+            chips = _parse_quantity(limits.get(TPU_RESOURCE, 0))
+            if not chips:
+                continue
+            key = accel or "unscheduled"
+            entry = fleet.setdefault(
+                key,
+                {"allocatable": 0, "requested": 0, "nodes": 0,
+                 "topologies": set()},
+            )
+            entry["requested"] += int(chips)
+
+    out = {}
+    for accel, entry in sorted(fleet.items()):
+        out[accel] = {
+            "allocatable": entry["allocatable"],
+            "requested": entry["requested"],
+            "free": max(0, entry["allocatable"] - entry["requested"]),
+            "nodes": entry["nodes"],
+            "topologies": sorted(entry["topologies"]),
+        }
+    return {
+        "fleet": out,
+        "totalChips": sum(e["allocatable"] for e in out.values()),
+        "requestedChips": sum(e["requested"] for e in out.values()),
+    }

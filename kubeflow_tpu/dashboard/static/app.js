@@ -1,0 +1,186 @@
+/* Dashboard shell logic (reference centraldashboard/public/components:
+ * main-page.js + namespace-selector.js + iframe-container.js).
+ *
+ * Boot: env-info -> namespace selector; dashboard-links -> sidenav;
+ * metrics/tpu -> fleet cards; hash routes /_/<app>/ load child apps in
+ * the iframe and re-broadcast the selected namespace to them.
+ */
+(function () {
+  'use strict';
+
+  var state = { namespaces: [], namespace: null, links: null, user: null };
+  var frame = document.getElementById('app-frame');
+  var nsSelect = document.getElementById('ns-select');
+
+  function getJson(url) {
+    return fetch(url, { credentials: 'same-origin' }).then(function (r) {
+      return r.json();
+    });
+  }
+
+  function csrfToken() {
+    var m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]*)/);
+    return m ? decodeURIComponent(m[1]) : '';
+  }
+
+  function postJson(url, body, method) {
+    return fetch(url, {
+      method: method || 'POST',
+      credentials: 'same-origin',
+      headers: {
+        'Content-Type': 'application/json',
+        'X-XSRF-TOKEN': csrfToken(),
+      },
+      body: JSON.stringify(body || {}),
+    }).then(function (r) { return r.json(); });
+  }
+
+  // ---- namespace bus (parent side of library.js) ----
+  function broadcastNamespace() {
+    if (frame.contentWindow) {
+      frame.contentWindow.postMessage(
+        { type: 'namespace-selected', value: state.namespace }, '*');
+    }
+  }
+  window.addEventListener('message', function (event) {
+    if ((event.data || {}).type === 'iframe-connected') {
+      broadcastNamespace();
+    }
+  });
+
+  function selectNamespace(ns) {
+    state.namespace = ns;
+    try { localStorage.setItem('selectedNamespace', ns); } catch (e) {}
+    broadcastNamespace();
+    refreshActivities();
+  }
+  nsSelect.addEventListener('change', function () {
+    selectNamespace(nsSelect.value);
+  });
+
+  // ---- routing ----
+  function route() {
+    var hash = location.hash || '#/';
+    var iframeView = document.getElementById('iframe-view');
+    var homeView = document.getElementById('home-view');
+    var match = hash.match(/^#\/_\/(.+)$/);
+    // A leading slash in the suffix would make '//host/...' — a
+    // protocol-relative URL framing an external site in the shell.
+    if (match && match[1].charAt(0) !== '/') {
+      homeView.hidden = true;
+      iframeView.hidden = false;
+      var src = '/' + match[1];
+      if (frame.getAttribute('src') !== src) frame.setAttribute('src', src);
+    } else {
+      iframeView.hidden = true;
+      homeView.hidden = false;
+    }
+  }
+  window.addEventListener('hashchange', route);
+
+  // ---- views ----
+  function renderLinks(links) {
+    var menu = document.getElementById('menu-links');
+    menu.innerHTML = '';
+    (links.menuLinks || []).forEach(function (item) {
+      var a = document.createElement('a');
+      a.className = 'nav-link';
+      a.textContent = item.text;
+      a.href = '#/_' + item.link;
+      menu.appendChild(a);
+    });
+    var quick = document.getElementById('quick-links');
+    quick.innerHTML = '';
+    (links.quickLinks || []).forEach(function (item) {
+      var a = document.createElement('a');
+      a.textContent = item.text;
+      a.href = '#/_' + item.link;
+      a.className = 'quick-link';
+      quick.appendChild(a);
+    });
+  }
+
+  function renderFleet(data) {
+    var cards = document.getElementById('fleet-cards');
+    cards.innerHTML = '';
+    Object.keys(data.fleet || {}).forEach(function (accel) {
+      var f = data.fleet[accel];
+      var div = document.createElement('div');
+      div.className = 'card';
+      div.innerHTML =
+        '<div class="card-title">' + accel + '</div>' +
+        '<div class="card-big">' + f.requested + ' / ' + f.allocatable +
+        ' chips</div>' +
+        '<div class="card-sub">' + f.nodes + ' nodes · ' +
+        (f.topologies.join(', ') || 'no topology label') + '</div>';
+      cards.appendChild(div);
+    });
+    if (!Object.keys(data.fleet || {}).length) {
+      cards.innerHTML = '<div class="card"><div class="card-title">' +
+        'No TPU nodes</div><div class="card-sub">cluster has no ' +
+        'google.com/tpu capacity</div></div>';
+    }
+  }
+
+  function refreshActivities() {
+    if (!state.namespace) return;
+    getJson('/api/activities/' + encodeURIComponent(state.namespace))
+      .then(function (data) {
+        var ul = document.getElementById('activities');
+        ul.innerHTML = '';
+        (data.activities || []).slice(0, 15).forEach(function (ev) {
+          var li = document.createElement('li');
+          li.className = ev.type === 'Warning' ? 'event warning' : 'event';
+          li.textContent =
+            (ev.time || '') + ' — ' + ev.object + ': ' + ev.reason +
+            ' ' + ev.message;
+          ul.appendChild(li);
+        });
+      });
+  }
+
+  function showRegistration() {
+    document.getElementById('home-view').hidden = true;
+    document.getElementById('register-view').hidden = false;
+    document.getElementById('register-btn').addEventListener(
+      'click',
+      function () {
+        var ns = document.getElementById('register-ns').value.trim();
+        postJson('/api/workgroup/create', ns ? { namespace: ns } : {})
+          .then(function () { location.reload(); });
+      });
+  }
+
+  // ---- boot ----
+  getJson('/api/workgroup/exists').then(function (info) {
+    state.user = info.user;
+    document.getElementById('user-chip').textContent = info.user || '';
+    if (!info.hasWorkgroup && info.registrationFlowAllowed) {
+      showRegistration();
+      return;
+    }
+    return getJson('/api/workgroup/env-info').then(function (env) {
+      state.namespaces = env.namespaces.map(function (n) {
+        return n.namespace;
+      });
+      nsSelect.innerHTML = '';
+      state.namespaces.forEach(function (ns) {
+        var opt = document.createElement('option');
+        opt.value = ns;
+        opt.textContent = ns;
+        nsSelect.appendChild(opt);
+      });
+      var saved = null;
+      try { saved = localStorage.getItem('selectedNamespace'); } catch (e) {}
+      var initial = state.namespaces.indexOf(saved) >= 0
+        ? saved : state.namespaces[0];
+      if (initial) { nsSelect.value = initial; selectNamespace(initial); }
+    });
+  });
+  getJson('/api/dashboard-links').then(function (d) {
+    state.links = d.links;
+    renderLinks(d.links);
+  });
+  getJson('/api/metrics/tpu').then(renderFleet);
+  route();
+})();

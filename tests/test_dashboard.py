@@ -1,0 +1,258 @@
+"""Central dashboard tests: env-info aggregation, workgroup lifecycle
+through the KFAM proxy, dashboard-links ConfigMap, activities, TPU fleet
+metrics, and SPA serving (reference test tier: app/*_test.ts under Karma;
+here plain pytest over the werkzeug test client — SURVEY.md §4)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.dashboard import KfamProxy, create_app, tpu_fleet_metrics
+from kubeflow_tpu.k8s import FakeApiServer
+from kubeflow_tpu.kfam import create_app as create_kfam
+
+ADMIN = "admin@kubeflow.org"
+USER = "alice@example.org"
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture
+def dashboard(api):
+    kfam_app = create_kfam(api, secure_cookies=False)
+    return create_app(api, kfam=KfamProxy(kfam_app), secure_cookies=False)
+
+
+def client_for(app):
+    client = app.test_client()
+    client.set_cookie("XSRF-TOKEN", "t")
+    return client
+
+
+def hdr(user=USER):
+    return {"kubeflow-userid": user, "X-XSRF-TOKEN": "t",
+            "Content-Type": "application/json"}
+
+
+def add_profile(api, name, owner):
+    api.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": name},
+        "spec": {"owner": {"kind": "User", "name": owner}},
+    })
+
+
+class TestWorkgroup:
+    def test_exists_and_registration(self, api, dashboard):
+        client = client_for(dashboard)
+        data = client.get("/api/workgroup/exists", headers=hdr()).get_json()
+        assert data["hasWorkgroup"] is False
+        assert data["registrationFlowAllowed"] is True
+
+        resp = client.post(
+            "/api/workgroup/create", data=json.dumps({}), headers=hdr()
+        )
+        assert resp.status_code == 200
+        assert resp.get_json()["namespace"] == "kubeflow-alice-example-org"
+
+        data = client.get("/api/workgroup/exists", headers=hdr()).get_json()
+        assert data["hasWorkgroup"] is True
+
+    def test_env_info_roles(self, api, dashboard):
+        client = client_for(dashboard)
+        add_profile(api, "alice", USER)
+        add_profile(api, "team", "bob@x.org")
+        # alice contributes to team.
+        client_admin = client_for(dashboard)
+        resp = client_admin.post(
+            "/api/workgroup/add-contributor/team",
+            data=json.dumps({"contributor": USER}),
+            headers=hdr("bob@x.org"),
+        )
+        assert resp.status_code == 200
+
+        env = client.get("/api/workgroup/env-info", headers=hdr()).get_json()
+        roles = {n["namespace"]: n["role"] for n in env["namespaces"]}
+        assert roles == {"alice": "owner", "team": "contributor"}
+        assert env["isClusterAdmin"] is False
+        assert env["platform"]["kind"] == "tpu"
+
+    def test_admin_sees_all_namespaces(self, api, dashboard):
+        add_profile(api, "alice", USER)
+        client = client_for(dashboard)
+        resp = client.get(
+            "/api/workgroup/get-all-namespaces", headers=hdr(ADMIN)
+        )
+        assert resp.status_code == 200
+        assert resp.get_json()["namespaces"][0]["namespace"] == "alice"
+        # Non-admin forbidden.
+        assert client.get(
+            "/api/workgroup/get-all-namespaces", headers=hdr()
+        ).status_code == 403
+
+    def test_contributor_roundtrip(self, api, dashboard):
+        add_profile(api, "alice", USER)
+        client = client_for(dashboard)
+        resp = client.post(
+            "/api/workgroup/add-contributor/alice",
+            data=json.dumps({"contributor": "bob@x.org"}),
+            headers=hdr(),
+        )
+        assert resp.get_json()["contributors"] == ["bob@x.org"]
+        resp = client.delete(
+            "/api/workgroup/remove-contributor/alice",
+            data=json.dumps({"contributor": "bob@x.org"}),
+            headers=hdr(),
+        )
+        assert resp.get_json()["contributors"] == []
+
+    def test_nuke_self(self, api, dashboard):
+        add_profile(api, "alice", USER)
+        client = client_for(dashboard)
+        resp = client.delete("/api/workgroup/nuke-self", headers=hdr())
+        assert resp.get_json()["deleted"] == ["alice"]
+        assert api.list("kubeflow.org/v1", "Profile") == []
+
+    def test_foreign_profile_not_nukeable(self, api, dashboard):
+        add_profile(api, "team", "bob@x.org")
+        client = client_for(dashboard)
+        assert client.delete(
+            "/api/workgroup/nuke-self", headers=hdr()
+        ).status_code == 404
+
+
+class TestApi:
+    def test_dashboard_links_default_and_configmap(self, api, dashboard):
+        client = client_for(dashboard)
+        links = client.get(
+            "/api/dashboard-links", headers=hdr()
+        ).get_json()["links"]
+        assert any(l["link"] == "/jupyter/" for l in links["menuLinks"])
+
+        api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "centraldashboard-config",
+                         "namespace": "kubeflow"},
+            "data": {
+                "links": json.dumps(
+                    {"menuLinks": [{"link": "/x/", "text": "X"}]}
+                ),
+                "settings": json.dumps({"DASHBOARD_FORCE_IFRAME": True}),
+            },
+        })
+        data = client.get("/api/dashboard-links", headers=hdr()).get_json()
+        assert data["links"]["menuLinks"][0]["text"] == "X"
+        assert data["settings"]["DASHBOARD_FORCE_IFRAME"] is True
+
+    def test_activities_sorted_newest_first(self, api, dashboard):
+        for i, ts in enumerate(
+            ["2026-07-01T00:00:00Z", "2026-07-03T00:00:00Z",
+             "2026-07-02T00:00:00Z"]
+        ):
+            api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"e{i}", "namespace": "alice"},
+                "type": "Normal", "reason": f"R{i}", "message": "m",
+                "involvedObject": {"name": "nb"},
+                "lastTimestamp": ts,
+            })
+        client = client_for(dashboard)
+        acts = client.get(
+            "/api/activities/alice", headers=hdr()
+        ).get_json()["activities"]
+        assert [a["reason"] for a in acts] == ["R1", "R2", "R0"]
+
+    def test_metrics_series_404_without_backend(self, api, dashboard):
+        client = client_for(dashboard)
+        assert client.get(
+            "/api/metrics/node", headers=hdr()
+        ).status_code == 404
+        assert client.get(
+            "/api/metrics/bogus", headers=hdr()
+        ).status_code == 404
+
+
+class TestTpuFleet:
+    def _node(self, api, name, accel, topo, chips):
+        api.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    "cloud.google.com/gke-tpu-accelerator": accel,
+                    "cloud.google.com/gke-tpu-topology": topo,
+                },
+            },
+            "status": {"allocatable": {"google.com/tpu": str(chips)}},
+        })
+
+    def _pod(self, api, name, node, chips, phase="Running"):
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "alice"},
+            "spec": {
+                "nodeName": node,
+                "containers": [{
+                    "name": "nb",
+                    "resources": {"limits": {"google.com/tpu": str(chips)}},
+                }],
+            },
+            "status": {"phase": phase},
+        })
+
+    def test_fleet_inventory(self, api, dashboard):
+        for i in range(4):
+            self._node(api, f"tpu-{i}", "tpu-v5-lite-podslice", "4x4", 4)
+        self._pod(api, "nb-0", "tpu-0", 4)
+        self._pod(api, "nb-1", "tpu-1", 4)
+        self._pod(api, "done", "tpu-2", 4, phase="Succeeded")
+
+        fleet = tpu_fleet_metrics(api)
+        entry = fleet["fleet"]["tpu-v5-lite-podslice"]
+        assert entry["allocatable"] == 16
+        assert entry["requested"] == 8  # Succeeded pod not counted
+        assert entry["free"] == 8
+        assert entry["nodes"] == 4
+        assert entry["topologies"] == ["4x4"]
+        assert fleet["totalChips"] == 16
+
+        client = client_for(dashboard)
+        data = client.get("/api/metrics/tpu", headers=hdr()).get_json()
+        assert data["fleet"]["tpu-v5-lite-podslice"]["requested"] == 8
+
+    def test_not_ready_node_excluded(self, api):
+        self._node(api, "good", "tpu-v5-lite-podslice", "2x2", 4)
+        self._node(api, "bad", "tpu-v5-lite-podslice", "2x2", 4)
+        api.patch_merge(
+            "v1", "Node", "bad",
+            {"status": {"conditions": [
+                {"type": "Ready", "status": "False"}]}},
+        )
+        fleet = tpu_fleet_metrics(api)
+        assert fleet["fleet"]["tpu-v5-lite-podslice"]["allocatable"] == 4
+        assert fleet["fleet"]["tpu-v5-lite-podslice"]["nodes"] == 1
+
+    def test_empty_cluster(self, api):
+        fleet = tpu_fleet_metrics(api)
+        assert fleet == {"fleet": {}, "totalChips": 0, "requestedChips": 0}
+
+
+class TestServing:
+    def test_index_served_with_csrf_cookie(self, dashboard):
+        client = dashboard.test_client()
+        resp = client.get("/")
+        assert resp.status_code == 200
+        assert b"TPU Notebooks" in resp.data
+        cookies = resp.headers.getlist("Set-Cookie")
+        assert any("XSRF-TOKEN" in c for c in cookies)
+
+    def test_static_assets_and_traversal_guard(self, dashboard):
+        client = dashboard.test_client()
+        assert client.get("/app.js").status_code == 200
+        assert client.get("/library.js").status_code == 200
+        assert b"namespace-selected" in client.get("/library.js").data
+        assert client.get("/../app.py").status_code == 404
+        assert client.get("/%2e%2e/app.py").status_code == 404
