@@ -151,7 +151,11 @@ class RestApp:
                 return Response(
                     generate_latest(self.registry), mimetype="text/plain"
                 )
-            if self._index_html is not None and request.path == "/":
+            if self._index_html is not None and request.path in (
+                "/", "/index.html"
+            ):
+                # Both index routes must carry the CSRF cookie or the SPA
+                # loaded from /index.html cannot complete any POST.
                 resp = Response(self._index_html, mimetype="text/html")
                 csrf.set_cookie(resp, self.secure_cookies)
                 return resp

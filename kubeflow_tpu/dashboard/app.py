@@ -143,6 +143,36 @@ def create_app(
                 out.append(rb["metadata"]["namespace"])
         return sorted(set(out))
 
+    def member_namespaces(user: str) -> set[str]:
+        return {
+            p["metadata"]["name"] for p in owned_profiles(user)
+        } | set(contributed_namespaces(user))
+
+    def ensure_member(user: str, namespace: str) -> None:
+        """Namespaced reads are tenant data: only owners/contributors of
+        the profile namespace (or cluster admins) may see them — the same
+        gate KFAM applies to its binding list. Scoped lookups only; this
+        runs on every poll of a namespaced endpoint."""
+        try:
+            p = api.get(PROFILE_API, "Profile", namespace)
+            owner = ((p.get("spec") or {}).get("owner") or {}).get("name")
+            if owner == user:
+                return
+        except NotFound:
+            pass
+        for rb in api.list(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            namespace=namespace,
+        ):
+            ann = rb["metadata"].get("annotations") or {}
+            if ann.get("user") == user and "role" in ann:
+                return
+        if kfam is not None and kfam.is_cluster_admin(user):
+            return
+        raise ApiError(
+            f"user {user!r} is not a member of namespace {namespace!r}", 403
+        )
+
     # ---- /api ----------------------------------------------------------
     @app.route("/api/dashboard-links")
     def dashboard_links(request):
@@ -172,6 +202,7 @@ def create_app(
     @app.route("/api/activities/<namespace>")
     def activities(request, namespace):
         """Recent events, newest first (reference api.ts events path)."""
+        ensure_member(request.user, namespace)
         events = api.list("v1", "Event", namespace=namespace)
         events.sort(
             key=lambda e: e.get("lastTimestamp")
@@ -214,7 +245,12 @@ def create_app(
     @app.route("/api/workgroup/exists")
     def workgroup_exists(request):
         user = request.user
-        has_workgroup = bool(owned_profiles(user))
+        # Contributor-only users have a workgroup too — routing them to
+        # registration would hide the namespaces shared with them. Same
+        # for cluster admins, who land on the all-namespaces view.
+        has_workgroup = bool(member_namespaces(user)) or (
+            kfam is not None and kfam.is_cluster_admin(user)
+        )
         return {
             "user": user,
             "hasAuth": True,
@@ -268,17 +304,20 @@ def create_app(
     def all_namespaces(request):
         if kfam is None or not kfam.is_cluster_admin(request.user):
             raise ApiError("cluster admin only", 403)
+        # One unfiltered bindings call, grouped by namespace — not one
+        # KFAM round-trip per profile.
+        by_ns: dict[str, list[str]] = {}
+        for b in kfam.list_bindings(request.user):
+            by_ns.setdefault(b["referredNamespace"], []).append(
+                b["user"]["name"]
+            )
         out = []
         for p in api.list(PROFILE_API, "Profile"):
             ns = p["metadata"]["name"]
             owner = ((p.get("spec") or {}).get("owner") or {}).get("name")
-            contributors = [
-                b["user"]["name"]
-                for b in kfam.list_bindings(request.user, ns)
-            ]
             out.append(
                 {"namespace": ns, "owner": owner,
-                 "contributors": contributors}
+                 "contributors": by_ns.get(ns, [])}
             )
         return {"namespaces": out}
 

@@ -69,8 +69,6 @@ def tpu_fleet_metrics(api) -> dict:
     fleet: dict[str, dict] = {}
     node_accel: dict[str, str] = {}
     for node in api.list("v1", "Node"):
-        if not _node_ready(node):
-            continue
         labels = (node["metadata"].get("labels") or {})
         accel = labels.get(ACCELERATOR_LABEL)
         alloc = (node.get("status") or {}).get("allocatable") or {}
@@ -78,7 +76,12 @@ def tpu_fleet_metrics(api) -> dict:
         if not accel and not chips:
             continue
         accel = accel or "unknown"
+        # Pods on a NotReady node still hold their chips against this
+        # accelerator type; only capacity (allocatable/nodes) is limited
+        # to Ready nodes.
         node_accel[node["metadata"]["name"]] = accel
+        if not _node_ready(node):
+            continue
         entry = fleet.setdefault(
             accel,
             {"allocatable": 0, "requested": 0, "nodes": 0, "topologies": set()},

@@ -12,10 +12,45 @@
   var frame = document.getElementById('app-frame');
   var nsSelect = document.getElementById('ns-select');
 
-  function getJson(url) {
-    return fetch(url, { credentials: 'same-origin' }).then(function (r) {
-      return r.json();
+  function parseResponse(r) {
+    return r.json().catch(function () { return {}; }).then(function (d) {
+      if (!r.ok) {
+        var err = new Error(d.log || ('request failed (' + r.status + ')'));
+        err.status = r.status;
+        throw err;
+      }
+      return d;
     });
+  }
+
+  function getJson(url) {
+    return fetch(url, { credentials: 'same-origin' }).then(parseResponse);
+  }
+
+  function showError(message, id, parent) {
+    var el = document.getElementById(id);
+    if (!el) {
+      el = document.createElement('div');
+      el.id = id;
+      el.className = 'error';
+      parent.appendChild(el);
+    }
+    el.textContent = message;
+  }
+
+  function showBanner(message) {
+    // Container with one line per failure so concurrent boot errors
+    // don't overwrite each other.
+    var el = document.getElementById('error-banner');
+    if (!el) {
+      el = document.createElement('div');
+      el.id = 'error-banner';
+      el.className = 'error banner';
+      document.body.insertBefore(el, document.body.firstChild);
+    }
+    var line = document.createElement('div');
+    line.textContent = message;
+    el.appendChild(line);
   }
 
   function csrfToken() {
@@ -32,7 +67,7 @@
         'X-XSRF-TOKEN': csrfToken(),
       },
       body: JSON.stringify(body || {}),
-    }).then(function (r) { return r.json(); });
+    }).then(parseResponse);
   }
 
   // ---- namespace bus (parent side of library.js) ----
@@ -64,9 +99,10 @@
     var iframeView = document.getElementById('iframe-view');
     var homeView = document.getElementById('home-view');
     var match = hash.match(/^#\/_\/(.+)$/);
-    // A leading slash in the suffix would make '//host/...' — a
+    // A leading slash (or backslash — browsers treat '\' as '/' when
+    // parsing URLs) in the suffix would make '//host/...' — a
     // protocol-relative URL framing an external site in the shell.
-    if (match && match[1].charAt(0) !== '/') {
+    if (match && match[1].charAt(0) !== '/' && match[1].charAt(0) !== '\\') {
       homeView.hidden = true;
       iframeView.hidden = false;
       var src = '/' + match[1];
@@ -136,6 +172,14 @@
             ' ' + ev.message;
           ul.appendChild(li);
         });
+      })
+      .catch(function (err) {
+        var ul = document.getElementById('activities');
+        ul.innerHTML = '';
+        var li = document.createElement('li');
+        li.className = 'event warning';
+        li.textContent = 'Could not load activities: ' + err.message;
+        ul.appendChild(li);
       });
   }
 
@@ -147,7 +191,11 @@
       function () {
         var ns = document.getElementById('register-ns').value.trim();
         postJson('/api/workgroup/create', ns ? { namespace: ns } : {})
-          .then(function () { location.reload(); });
+          .then(function () { location.reload(); })
+          .catch(function (err) {
+            showError(err.message, 'register-error',
+              document.getElementById('register-view'));
+          });
       });
   }
 
@@ -160,6 +208,19 @@
       return;
     }
     return getJson('/api/workgroup/env-info').then(function (env) {
+      if (!env.namespaces.length && env.isClusterAdmin) {
+        // Admins own nothing by default; give them every profile
+        // namespace so the dashboard isn't a dead end.
+        return getJson('/api/workgroup/get-all-namespaces')
+          .then(function (all) {
+            env.namespaces = all.namespaces.map(function (n) {
+              return { namespace: n.namespace, role: 'cluster-admin' };
+            });
+            return env;
+          });
+      }
+      return env;
+    }).then(function (env) {
       state.namespaces = env.namespaces.map(function (n) {
         return n.namespace;
       });
@@ -176,11 +237,18 @@
         ? saved : state.namespaces[0];
       if (initial) { nsSelect.value = initial; selectNamespace(initial); }
     });
+  }).catch(function (err) {
+    showBanner('Dashboard failed to load: ' + err.message);
   });
   getJson('/api/dashboard-links').then(function (d) {
     state.links = d.links;
     renderLinks(d.links);
+  }).catch(function (err) {
+    showBanner('Navigation failed to load: ' + err.message);
   });
-  getJson('/api/metrics/tpu').then(renderFleet);
+  getJson('/api/metrics/tpu').then(renderFleet).catch(function (err) {
+    showError('TPU fleet unavailable: ' + err.message, 'fleet-error',
+      document.getElementById('fleet-cards'));
+  });
   route();
 })();

@@ -11,6 +11,8 @@ reference); this module is the REST shell around it.
 
 from __future__ import annotations
 
+import re
+
 from kubeflow_tpu import native
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
 from kubeflow_tpu.crud_backend.app import ApiError
@@ -22,6 +24,14 @@ RBAC_API = "rbac.authorization.k8s.io/v1"  # list path only; writes use native
 # Roles the API accepts (reference bindings.go role map); the native
 # engine owns the role -> ClusterRole mapping and the name format.
 ROLES = ("admin", "edit", "view")
+
+_DNS1123 = re.compile(r"[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?")
+
+# Names self-registration may never claim: profile ownership grants
+# RoleBinding rights inside the namespace.
+RESERVED_NAMESPACES = frozenset(
+    {"default", "kubeflow", "istio-system", "cert-manager", "knative-serving"}
+)
 
 
 def binding_objects(
@@ -92,6 +102,29 @@ def create_app(
         if owner != request.user and not is_cluster_admin(request.user):
             raise ApiError("only the cluster admin may create profiles for "
                            "other users", 403)
+        if not _DNS1123.fullmatch(name):
+            raise ApiError(
+                f"invalid profile name {name!r}: must be a DNS-1123 label "
+                "(lowercase alphanumerics and '-', max 63 chars)"
+            )
+        if not is_cluster_admin(request.user):
+            # Self-registration must not squat system namespaces or
+            # namespaces that exist outside profile management — owning
+            # a Profile grants RoleBinding rights in that namespace.
+            if name in RESERVED_NAMESPACES or name.startswith("kube-"):
+                raise ApiError(f"namespace {name!r} is reserved", 403)
+            try:
+                api.get("v1", "Namespace", name)
+            except NotFound:
+                pass
+            else:
+                try:
+                    api.get(PROFILE_API, "Profile", name)
+                except NotFound:
+                    raise ApiError(
+                        f"namespace {name!r} already exists and is not "
+                        "profile-managed", 403
+                    )
         profile = {
             "apiVersion": PROFILE_API,
             "kind": "Profile",

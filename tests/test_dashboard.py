@@ -148,6 +148,7 @@ class TestApi:
         assert data["settings"]["DASHBOARD_FORCE_IFRAME"] is True
 
     def test_activities_sorted_newest_first(self, api, dashboard):
+        add_profile(api, "alice", USER)
         for i, ts in enumerate(
             ["2026-07-01T00:00:00Z", "2026-07-03T00:00:00Z",
              "2026-07-02T00:00:00Z"]
@@ -164,6 +165,57 @@ class TestApi:
             "/api/activities/alice", headers=hdr()
         ).get_json()["activities"]
         assert [a["reason"] for a in acts] == ["R1", "R2", "R0"]
+
+    def test_activities_forbidden_for_non_members(self, api, dashboard):
+        """Events are tenant data: only namespace members (or cluster
+        admins) may read them."""
+        add_profile(api, "team", "bob@x.org")
+        api.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "e0", "namespace": "team"},
+            "type": "Warning", "reason": "Secret", "message": "m",
+            "involvedObject": {"name": "nb"},
+        })
+        client = client_for(dashboard)
+        assert client.get(
+            "/api/activities/team", headers=hdr()
+        ).status_code == 403
+        # Owner, contributor, and cluster admin can read.
+        assert client.get(
+            "/api/activities/team", headers=hdr("bob@x.org")
+        ).status_code == 200
+        client.post(
+            "/api/workgroup/add-contributor/team",
+            data=json.dumps({"contributor": USER}),
+            headers=hdr("bob@x.org"),
+        )
+        assert client.get(
+            "/api/activities/team", headers=hdr()
+        ).status_code == 200
+        assert client.get(
+            "/api/activities/team", headers=hdr(ADMIN)
+        ).status_code == 200
+
+    def test_cluster_admin_has_workgroup_without_profile(self, api,
+                                                         dashboard):
+        client = client_for(dashboard)
+        data = client.get(
+            "/api/workgroup/exists", headers=hdr(ADMIN)
+        ).get_json()
+        assert data["hasWorkgroup"] is True
+
+    def test_contributor_only_user_has_workgroup(self, api, dashboard):
+        """A user who owns nothing but contributes to a namespace must
+        not be routed to the registration screen."""
+        add_profile(api, "team", "bob@x.org")
+        client = client_for(dashboard)
+        client.post(
+            "/api/workgroup/add-contributor/team",
+            data=json.dumps({"contributor": USER}),
+            headers=hdr("bob@x.org"),
+        )
+        data = client.get("/api/workgroup/exists", headers=hdr()).get_json()
+        assert data["hasWorkgroup"] is True
 
     def test_metrics_series_404_without_backend(self, api, dashboard):
         client = client_for(dashboard)
@@ -223,6 +275,22 @@ class TestTpuFleet:
         data = client.get("/api/metrics/tpu", headers=hdr()).get_json()
         assert data["fleet"]["tpu-v5-lite-podslice"]["requested"] == 8
 
+    def test_pod_on_notready_node_keeps_accel_attribution(self, api):
+        """Chips held by pods on a NotReady node still count against the
+        accelerator type, not a bogus 'unscheduled' bucket."""
+        self._node(api, "good", "tpu-v5-lite-podslice", "2x2", 4)
+        self._node(api, "flaky", "tpu-v5-lite-podslice", "2x2", 4)
+        api.patch_merge(
+            "v1", "Node", "flaky",
+            {"status": {"conditions": [
+                {"type": "Ready", "status": "False"}]}},
+        )
+        self._pod(api, "nb-0", "flaky", 4)
+        fleet = tpu_fleet_metrics(api)
+        entry = fleet["fleet"]["tpu-v5-lite-podslice"]
+        assert entry["requested"] == 4
+        assert "unscheduled" not in fleet["fleet"]
+
     def test_not_ready_node_excluded(self, api):
         self._node(api, "good", "tpu-v5-lite-podslice", "2x2", 4)
         self._node(api, "bad", "tpu-v5-lite-podslice", "2x2", 4)
@@ -243,11 +311,12 @@ class TestTpuFleet:
 class TestServing:
     def test_index_served_with_csrf_cookie(self, dashboard):
         client = dashboard.test_client()
-        resp = client.get("/")
-        assert resp.status_code == 200
-        assert b"TPU Notebooks" in resp.data
-        cookies = resp.headers.getlist("Set-Cookie")
-        assert any("XSRF-TOKEN" in c for c in cookies)
+        for path in ("/", "/index.html"):
+            resp = client.get(path)
+            assert resp.status_code == 200
+            assert b"TPU Notebooks" in resp.data
+            cookies = resp.headers.getlist("Set-Cookie")
+            assert any("XSRF-TOKEN" in c for c in cookies), path
 
     def test_static_assets_and_traversal_guard(self, dashboard):
         client = dashboard.test_client()

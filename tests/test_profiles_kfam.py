@@ -145,6 +145,48 @@ class TestKfam:
         )
         assert resp.status_code == 200
 
+    def test_reserved_and_existing_namespaces_not_squattable(self):
+        """Self-registration must not claim system namespaces or
+        pre-existing non-profile namespaces (profile ownership grants
+        RoleBinding rights inside the namespace)."""
+        api = FakeApiServer()
+        client = kfam_client(api)
+        for name in ("kubeflow", "kube-system", "default", "istio-system"):
+            resp = client.post(
+                "/kfam/v1/profiles",
+                data=json.dumps({"name": name}),
+                headers=csrf(USER, client),
+            )
+            assert resp.status_code == 403, name
+        # An existing namespace without a Profile is off-limits too.
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "legacy"}})
+        resp = client.post(
+            "/kfam/v1/profiles",
+            data=json.dumps({"name": "legacy"}),
+            headers=csrf(USER, client),
+        )
+        assert resp.status_code == 403
+        # The cluster admin may still do both.
+        resp = client.post(
+            "/kfam/v1/profiles",
+            data=json.dumps({"name": "legacy"}),
+            headers=csrf(ADMIN, client),
+        )
+        assert resp.status_code == 200
+
+    def test_profile_name_must_be_dns1123(self):
+        api = FakeApiServer()
+        client = kfam_client(api)
+        for bad in ("UPPER", "has space", "-lead", "trail-", "a" * 64,
+                    "dot.dot"):
+            resp = client.post(
+                "/kfam/v1/profiles",
+                data=json.dumps({"name": bad}),
+                headers=csrf(USER, client),
+            )
+            assert resp.status_code == 400, bad
+
     def test_clusteradmin_endpoint(self):
         client = kfam_client(FakeApiServer())
         assert client.get("/kfam/v1/clusteradmin", headers=ADMIN).get_json()[
