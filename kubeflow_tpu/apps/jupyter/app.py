@@ -11,7 +11,7 @@ from kubeflow_tpu.apps.jupyter import form as form_mod
 from kubeflow_tpu.apps.jupyter.status import STOP_ANNOTATION, process_status
 from kubeflow_tpu.controllers.time_utils import rfc3339
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
-from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.app import ApiError, register_namespaces_route
 from kubeflow_tpu.crud_backend.authz import ensure
 from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
 from kubeflow_tpu.topology import spawner_presets
@@ -23,6 +23,7 @@ _CONFIG_PATH = os.path.join(
     os.path.dirname(__file__), "config", "spawner_ui_config.yaml"
 )
 _CONFIG_TTL_SECONDS = 60
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
 
 
 class _ConfigCache:
@@ -57,6 +58,8 @@ def create_app(
         secure_cookies=secure_cookies,
     )
     config_cache = _ConfigCache(config_path or _CONFIG_PATH)
+    app.serve_frontend(_STATIC_DIR)
+    register_namespaces_route(app, api)
 
     def notebook_view(nb: dict) -> dict:
         try:
@@ -99,13 +102,6 @@ def create_app(
             "config": config.get("spawnerFormDefaults", {}),
             "tpuPresets": spawner_presets(accelerators),
         }
-
-    @app.route("/api/namespaces")
-    def list_namespaces(request):
-        names = [
-            ns["metadata"]["name"] for ns in api.list("v1", "Namespace")
-        ]
-        return {"namespaces": names}
 
     # ---- notebooks ------------------------------------------------------
     @app.route("/api/namespaces/<namespace>/notebooks")

@@ -1,0 +1,333 @@
+/* Jupyter web app logic (role of the reference Angular JWA frontend:
+ * index table, new-notebook form, details page —
+ * crud-web-apps/jupyter/frontend/src/app/pages/). The form is driven by
+ * the admin config from /api/config (value/options/readOnly per field)
+ * and the TPU preset list that replaces the reference's GPU vendors.
+ */
+(function () {
+  'use strict';
+
+  var state = { namespace: null, config: null, presets: [], poller: null };
+
+  var listView = document.getElementById('list-view');
+  var formView = document.getElementById('form-view');
+  var detailsView = document.getElementById('details-view');
+
+  function show(view) {
+    [listView, formView, detailsView].forEach(function (v) {
+      v.hidden = v !== view;
+    });
+  }
+
+  function apiBase() {
+    return 'api/namespaces/' + encodeURIComponent(state.namespace);
+  }
+
+  // ---- list view ----
+  function connectUrl(nb) {
+    return '/notebook/' + encodeURIComponent(nb.namespace) + '/' +
+      encodeURIComponent(nb.name) + '/';
+  }
+
+  function tpuChip(nb) {
+    if (!nb.tpu) return KF.el('span', { 'class': 'kf-help', text: '—' });
+    return KF.el('span', {
+      'class': 'kf-chip',
+      text: nb.tpu.accelerator + ' ' + nb.tpu.topology,
+    });
+  }
+
+  function actions(nb) {
+    var div = KF.el('div', { 'class': 'kf-actions' });
+    var connect = KF.el('a', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Connect',
+      href: connectUrl(nb), target: '_blank',
+    });
+    if (nb.status.phase !== 'running') {
+      connect.setAttribute('style', 'pointer-events:none;opacity:0.4');
+    }
+    div.appendChild(connect);
+    var stopped = nb.stopped;
+    div.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost',
+      text: stopped ? 'Start' : 'Stop',
+      onclick: function () {
+        KF.send('PATCH', apiBase() + '/notebooks/' +
+          encodeURIComponent(nb.name), { stopped: !stopped })
+          .then(refresh)
+          .catch(function (err) { KF.snack(err.message, true); });
+      },
+    }));
+    div.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-danger', text: 'Delete',
+      onclick: function () {
+        KF.confirm('Delete notebook "' + nb.name + '"? Attached PVCs are ' +
+          'kept.', function () {
+          KF.send('DELETE', apiBase() + '/notebooks/' +
+            encodeURIComponent(nb.name))
+            .then(refresh)
+            .catch(function (err) { KF.snack(err.message, true); });
+        });
+      },
+    }));
+    return div;
+  }
+
+  var COLUMNS = [
+    { name: 'Status', render: function (nb) { return KF.statusIcon(nb.status); } },
+    {
+      name: 'Name', render: function (nb) {
+        return KF.el('a', {
+          'class': 'kf-link', text: nb.name,
+          onclick: function () { showDetails(nb.name); },
+        });
+      },
+    },
+    { name: 'Image', render: function (nb) { return KF.shortImage(nb.image); } },
+    { name: 'TPU', render: tpuChip },
+    { name: 'CPU', render: function (nb) { return nb.cpu || ''; } },
+    { name: 'Memory', render: function (nb) { return nb.memory || ''; } },
+    { name: 'Age', render: function (nb) { return KF.age(nb.age); } },
+    { name: '', render: actions },
+  ];
+
+  function refresh() {
+    if (!state.namespace) return;
+    KF.get(apiBase() + '/notebooks').then(function (d) {
+      KF.table(document.getElementById('nb-table'), COLUMNS, d.notebooks,
+        'No notebooks in this namespace. Create one to get started.');
+    }).catch(function (err) {
+      KF.snack('Could not list notebooks: ' + err.message, true);
+    });
+  }
+
+  // ---- details view ----
+  function showDetails(name) {
+    KF.get(apiBase() + '/notebooks/' + encodeURIComponent(name))
+      .then(function (d) {
+        var el = document.getElementById('details');
+        el.innerHTML = '';
+        el.appendChild(KF.el('button', {
+          'class': 'kf-btn kf-btn-ghost', text: '← Back',
+          onclick: function () { show(listView); },
+        }));
+        el.appendChild(KF.el('h2', { text: d.processed.name }));
+        el.appendChild(KF.statusIcon(d.processed.status));
+        var dl = KF.el('dl', { 'class': 'kf-details' });
+        [['Namespace', d.processed.namespace],
+         ['Image', d.processed.image],
+         ['CPU', d.processed.cpu || '—'],
+         ['Memory', d.processed.memory || '—'],
+         ['TPU', d.processed.tpu
+           ? d.processed.tpu.accelerator + ' / ' + d.processed.tpu.topology
+           : 'none'],
+         ['Created', d.processed.age || '—'],
+         ['Message', d.processed.status.message || '—']]
+          .forEach(function (pair) {
+            dl.appendChild(KF.el('dt', { text: pair[0] }));
+            dl.appendChild(KF.el('dd', { text: String(pair[1]) }));
+          });
+        el.appendChild(dl);
+        var pre = KF.el('pre', { 'class': 'kf-yaml' });
+        pre.textContent = JSON.stringify(d.notebook, null, 2);
+        el.appendChild(KF.el('h3', { text: 'Raw resource' }));
+        el.appendChild(pre);
+        show(detailsView);
+      })
+      .catch(function (err) { KF.snack(err.message, true); });
+  }
+
+  // ---- new-notebook form ----
+  function section(key) {
+    return (state.config || {})[key] || {};
+  }
+
+  function buildForm() {
+    var root = document.getElementById('spawner-form');
+    root.innerHTML = '';
+    var f = {};
+
+    root.appendChild(KF.el('h2', { text: 'New Notebook' }));
+
+    root.appendChild(KF.el('label', { text: 'Name' }));
+    f.name = KF.el('input', { type: 'text', placeholder: 'my-notebook' });
+    root.appendChild(f.name);
+
+    // Image: admin options + optional custom.
+    root.appendChild(KF.el('label', { text: 'Image' }));
+    var img = section('image');
+    f.image = KF.el('select', {},
+      (img.options || [img.value]).filter(Boolean).map(function (o) {
+        return KF.el('option', { value: o, text: o });
+      }));
+    if (img.value) f.image.value = img.value;
+    if (img.readOnly) f.image.setAttribute('disabled', '');
+    root.appendChild(f.image);
+    if (state.config.allowCustomImage !== false) {
+      var customRow = KF.el('label', {}, [
+        f.customCheck = KF.el('input', { type: 'checkbox' }),
+        KF.el('span', { text: ' Custom image' }),
+      ]);
+      root.appendChild(customRow);
+      f.customImage = KF.el('input', {
+        type: 'text', placeholder: 'registry/image:tag',
+      });
+      f.customImage.hidden = true;
+      f.customCheck.addEventListener('change', function () {
+        f.customImage.hidden = !f.customCheck.checked;
+      });
+      root.appendChild(f.customImage);
+    }
+
+    // CPU / memory.
+    var row = KF.el('div', { 'class': 'kf-row' });
+    var cpuDiv = KF.el('div', {});
+    cpuDiv.appendChild(KF.el('label', { text: 'CPU' }));
+    f.cpu = KF.el('input', { type: 'text', value: section('cpu').value || '0.5' });
+    if (section('cpu').readOnly) f.cpu.setAttribute('disabled', '');
+    cpuDiv.appendChild(f.cpu);
+    var memDiv = KF.el('div', {});
+    memDiv.appendChild(KF.el('label', { text: 'Memory' }));
+    f.memory = KF.el('input', {
+      type: 'text', value: section('memory').value || '1.0Gi',
+    });
+    if (section('memory').readOnly) f.memory.setAttribute('disabled', '');
+    memDiv.appendChild(f.memory);
+    row.appendChild(cpuDiv);
+    row.appendChild(memDiv);
+    root.appendChild(row);
+
+    // TPU preset picker (replaces the reference's GPU vendor/count).
+    root.appendChild(KF.el('label', { text: 'TPU slice' }));
+    f.tpu = KF.el('select', {}, [
+      KF.el('option', { value: 'none', text: 'None (CPU only)' }),
+    ].concat(state.presets.map(function (p) {
+      var label = p.shorthand + ' — ' + p.chips + ' chip' +
+        (p.chips > 1 ? 's' : '') + ', topology ' + p.topology +
+        (p.multihost ? ', ' + p.hosts + ' hosts (multi-host)' : '');
+      return KF.el('option', { value: p.shorthand, text: label });
+    })));
+    var tpuSection = section('tpu');
+    if (tpuSection.value) f.tpu.value = tpuSection.value;
+    if (tpuSection.readOnly) f.tpu.setAttribute('disabled', '');
+    root.appendChild(f.tpu);
+    var tpuHelp = KF.el('div', { 'class': 'kf-help' });
+    function updateTpuHelp() {
+      var p = state.presets.filter(function (x) {
+        return x.shorthand === f.tpu.value;
+      })[0];
+      tpuHelp.textContent = !p ? '' : (p.multihost
+        ? 'Multi-host slice: the notebook runs ' + p.hosts +
+          ' replicas with jax.distributed pre-wired.'
+        : 'Single-host slice on one node.');
+    }
+    f.tpu.addEventListener('change', updateTpuHelp);
+    updateTpuHelp();
+    root.appendChild(tpuHelp);
+
+    // PodDefault configurations.
+    root.appendChild(KF.el('label', { text: 'Configurations' }));
+    f.pdBox = KF.el('div', {});
+    root.appendChild(f.pdBox);
+    f.pdChecks = [];
+    var defaults = section('configurations').value || [];
+    KF.get(apiBase() + '/poddefaults').then(function (d) {
+      (d.poddefaults || []).forEach(function (pd) {
+        var cb = KF.el('input', { type: 'checkbox', value: pd.label });
+        if (defaults.indexOf(pd.label) >= 0) cb.checked = true;
+        f.pdChecks.push(cb);
+        f.pdBox.appendChild(KF.el('label', {}, [
+          cb, KF.el('span', { text: ' ' + pd.desc + ' (' + pd.label + ')' }),
+        ]));
+      });
+      if (!(d.poddefaults || []).length) {
+        f.pdBox.appendChild(KF.el('span', {
+          'class': 'kf-help', text: 'No PodDefaults in this namespace.',
+        }));
+      }
+    }).catch(function () { /* optional section */ });
+
+    // Workspace volume.
+    var ws = section('workspaceVolume');
+    root.appendChild(KF.el('label', {}, [
+      f.wsCheck = KF.el('input', { type: 'checkbox' }),
+      KF.el('span', { text: ' Create workspace volume' }),
+    ]));
+    if (ws.value) f.wsCheck.checked = true;
+    if (ws.readOnly) f.wsCheck.setAttribute('disabled', '');
+
+    // shm.
+    root.appendChild(KF.el('label', {}, [
+      f.shm = KF.el('input', { type: 'checkbox' }),
+      KF.el('span', { text: ' Shared memory (/dev/shm)' }),
+    ]));
+    if (section('shm').value !== false) f.shm.checked = true;
+    if (section('shm').readOnly) f.shm.setAttribute('disabled', '');
+
+    // Submit / cancel.
+    var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
+    var submit = KF.el('button', {
+      'class': 'kf-btn', text: 'Create',
+      onclick: function () {
+        submit.setAttribute('disabled', '');
+        var body = {
+          name: f.name.value.trim(),
+          image: f.image.value,
+          cpu: f.cpu.value.trim(),
+          memory: f.memory.value.trim(),
+          tpu: f.tpu.value,
+          shm: f.shm.checked,
+          configurations: f.pdChecks.filter(function (cb) {
+            return cb.checked;
+          }).map(function (cb) { return cb.value; }),
+        };
+        if (f.customCheck && f.customCheck.checked) {
+          body.customImageCheck = true;
+          body.customImage = f.customImage.value.trim();
+        }
+        if (!f.wsCheck.checked) body.workspaceVolume = null;
+        KF.send('POST', apiBase() + '/notebooks', body)
+          .then(function () {
+            KF.snack('Notebook "' + body.name + '" created');
+            show(listView);
+            refresh();
+          })
+          .catch(function (err) { KF.snack(err.message, true); })
+          .then(function () { submit.removeAttribute('disabled'); });
+      },
+    });
+    bar.appendChild(submit);
+    bar.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      onclick: function () { show(listView); },
+    }));
+    root.appendChild(bar);
+  }
+
+  document.getElementById('new-btn').addEventListener('click', function () {
+    if (!state.config) {
+      KF.snack('Form config not loaded yet', true);
+      return;
+    }
+    buildForm();
+    show(formView);
+  });
+
+  // ---- boot ----
+  KF.get('api/config').then(function (d) {
+    state.config = d.config;
+    state.presets = d.tpuPresets || [];
+  }).catch(function (err) {
+    KF.snack('Could not load spawner config: ' + err.message, true);
+  });
+
+  KF.namespace(
+    { standaloneMount: document.getElementById('ns-mount') },
+    function (ns) {
+      state.namespace = ns;
+      show(listView);
+      refresh();
+    });
+
+  state.poller = KF.poll(refresh, 10000);
+})();

@@ -2,12 +2,16 @@
 
 from __future__ import annotations
 
+import os
+
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
-from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.app import ApiError, register_namespaces_route
 from kubeflow_tpu.crud_backend.authz import ensure
 from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
 
 TENSORBOARD_API = "tensorboard.kubeflow.org/v1alpha1"
+
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
 
 
 def create_app(
@@ -18,6 +22,8 @@ def create_app(
 ) -> RestApp:
     app = RestApp("twa", authn=authn, authorizer=authorizer,
                   secure_cookies=secure_cookies)
+    app.serve_frontend(_STATIC_DIR)
+    register_namespaces_route(app, api)
 
     def tb_view(tb: dict) -> dict:
         return {

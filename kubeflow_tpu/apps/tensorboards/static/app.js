@@ -1,0 +1,124 @@
+/* TensorBoards web app logic (reference TWA: TB table + create form with
+ * logspath — crud-web-apps/tensorboards/frontend). logspath accepts
+ * pvc://claim/subpath or gs:// (JAX profile traces live on the workspace
+ * volume, so pvc:// is the primary path on the TPU platform).
+ */
+(function () {
+  'use strict';
+
+  var state = { namespace: null };
+  var listView = document.getElementById('list-view');
+  var formView = document.getElementById('form-view');
+
+  function apiBase() {
+    return 'api/namespaces/' + encodeURIComponent(state.namespace);
+  }
+
+  function show(view) {
+    [listView, formView].forEach(function (v) { v.hidden = v !== view; });
+  }
+
+  function connectUrl(tb) {
+    return '/tensorboard/' + encodeURIComponent(tb.namespace) + '/' +
+      encodeURIComponent(tb.name) + '/';
+  }
+
+  var COLUMNS = [
+    {
+      name: 'Status', render: function (tb) {
+        return KF.statusIcon(tb.ready
+          ? { phase: 'running' } : { phase: 'waiting' });
+      },
+    },
+    { name: 'Name', render: function (tb) { return tb.name; } },
+    { name: 'Logs path', render: function (tb) { return tb.logspath; } },
+    { name: 'Age', render: function (tb) { return KF.age(tb.age); } },
+    {
+      name: '', render: function (tb) {
+        var div = KF.el('div', { 'class': 'kf-actions' });
+        var connect = KF.el('a', {
+          'class': 'kf-btn kf-btn-ghost', text: 'Connect',
+          href: connectUrl(tb), target: '_blank',
+        });
+        if (!tb.ready) {
+          connect.setAttribute('style', 'pointer-events:none;opacity:0.4');
+        }
+        div.appendChild(connect);
+        div.appendChild(KF.el('button', {
+          'class': 'kf-btn kf-btn-danger', text: 'Delete',
+          onclick: function () {
+            KF.confirm('Delete TensorBoard "' + tb.name + '"?', function () {
+              KF.send('DELETE', apiBase() + '/tensorboards/' +
+                encodeURIComponent(tb.name))
+                .then(refresh)
+                .catch(function (err) { KF.snack(err.message, true); });
+            });
+          },
+        }));
+        return div;
+      },
+    },
+  ];
+
+  function refresh() {
+    if (!state.namespace) return;
+    KF.get(apiBase() + '/tensorboards').then(function (d) {
+      KF.table(document.getElementById('tb-table'), COLUMNS, d.tensorboards,
+        'No TensorBoards in this namespace.');
+    }).catch(function (err) {
+      KF.snack('Could not list TensorBoards: ' + err.message, true);
+    });
+  }
+
+  function buildForm() {
+    var root = document.getElementById('tb-form');
+    root.innerHTML = '';
+    root.appendChild(KF.el('h2', { text: 'New TensorBoard' }));
+    var name = KF.el('input', { type: 'text', placeholder: 'my-tensorboard' });
+    var logspath = KF.el('input', {
+      type: 'text', placeholder: 'pvc://my-volume/logs or gs://bucket/logs',
+    });
+    root.appendChild(KF.el('label', { text: 'Name' }));
+    root.appendChild(name);
+    root.appendChild(KF.el('label', { text: 'Logs path' }));
+    root.appendChild(logspath);
+    root.appendChild(KF.el('div', {
+      'class': 'kf-help',
+      text: 'pvc://<claim>/<subpath> mounts a volume; JAX profiler traces ' +
+        'written by jax.profiler.start_trace land there.',
+    }));
+    var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
+    bar.appendChild(KF.el('button', {
+      'class': 'kf-btn', text: 'Create',
+      onclick: function () {
+        KF.send('POST', apiBase() + '/tensorboards', {
+          name: name.value.trim(),
+          logspath: logspath.value.trim(),
+        }).then(function () {
+          KF.snack('TensorBoard created');
+          show(listView);
+          refresh();
+        }).catch(function (err) { KF.snack(err.message, true); });
+      },
+    }));
+    bar.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      onclick: function () { show(listView); },
+    }));
+    root.appendChild(bar);
+  }
+
+  document.getElementById('new-btn').addEventListener('click', function () {
+    buildForm();
+    show(formView);
+  });
+
+  KF.namespace(
+    { standaloneMount: document.getElementById('ns-mount') },
+    function (ns) {
+      state.namespace = ns;
+      show(listView);
+      refresh();
+    });
+  KF.poll(refresh, 10000);
+})();

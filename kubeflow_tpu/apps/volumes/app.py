@@ -2,13 +2,17 @@
 
 from __future__ import annotations
 
+import os
+
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
-from kubeflow_tpu.crud_backend.app import ApiError
+from kubeflow_tpu.crud_backend.app import ApiError, register_namespaces_route
 from kubeflow_tpu.crud_backend.authz import ensure
 from kubeflow_tpu.k8s.fake import ApiError as K8sError, NotFound
 
 PVCVIEWER_API = "kubeflow.org/v1alpha1"
 NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
 
 
 def create_app(
@@ -19,6 +23,19 @@ def create_app(
 ) -> RestApp:
     app = RestApp("vwa", authn=authn, authorizer=authorizer,
                   secure_cookies=secure_cookies)
+    app.serve_frontend(_STATIC_DIR)
+    register_namespaces_route(app, api)
+
+    @app.route("/api/namespaces/<namespace>/storageclasses")
+    def list_storageclasses(request, namespace):
+        ensure(app.authorizer, request.user, "list", "storage.k8s.io",
+               "storageclasses", namespace)
+        return {
+            "storageClasses": [
+                sc["metadata"]["name"]
+                for sc in api.list("storage.k8s.io/v1", "StorageClass")
+            ]
+        }
 
     def pvc_view(pvc: dict, namespace: str, notebooks: list) -> dict:
         name = pvc["metadata"]["name"]

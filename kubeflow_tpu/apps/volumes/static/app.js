@@ -1,0 +1,156 @@
+/* Volumes web app logic (reference VWA: PVC table, create form, and
+ * the PVCViewer launcher that opens a file browser on a claim —
+ * crud-web-apps/volumes/frontend + backend/apps/default/routes/post.py).
+ */
+(function () {
+  'use strict';
+
+  var state = { namespace: null };
+  var listView = document.getElementById('list-view');
+  var formView = document.getElementById('form-view');
+
+  function apiBase() {
+    return 'api/namespaces/' + encodeURIComponent(state.namespace);
+  }
+
+  function show(view) {
+    [listView, formView].forEach(function (v) { v.hidden = v !== view; });
+  }
+
+  function viewerCell(pvc) {
+    var viewer = pvc.viewer;
+    if (viewer && viewer.ready && viewer.url) {
+      return KF.el('a', {
+        'class': 'kf-link', text: 'Open browser',
+        href: viewer.url, target: '_blank',
+      });
+    }
+    if (viewer) {
+      return KF.el('span', { 'class': 'kf-help', text: 'viewer starting…' });
+    }
+    return KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Browse',
+      onclick: function () {
+        KF.send('POST', apiBase() + '/viewers', { pvc: pvc.name })
+          .then(refresh)
+          .catch(function (err) { KF.snack(err.message, true); });
+      },
+    });
+  }
+
+  function actions(pvc) {
+    var div = KF.el('div', { 'class': 'kf-actions' });
+    div.appendChild(viewerCell(pvc));
+    var del = KF.el('button', {
+      'class': 'kf-btn kf-btn-danger', text: 'Delete',
+      onclick: function () {
+        KF.confirm('Delete volume "' + pvc.name + '" and its data?',
+          function () {
+            KF.send('DELETE', apiBase() + '/pvcs/' +
+              encodeURIComponent(pvc.name))
+              .then(refresh)
+              .catch(function (err) { KF.snack(err.message, true); });
+          });
+      },
+    });
+    if (pvc.usedBy.length) {
+      del.setAttribute('disabled', '');
+      del.title = 'In use by: ' + pvc.usedBy.join(', ');
+    }
+    div.appendChild(del);
+    return div;
+  }
+
+  var COLUMNS = [
+    {
+      name: 'Status', render: function (pvc) {
+        return KF.statusIcon({
+          phase: pvc.status === 'Bound' ? 'running' : 'waiting',
+          message: pvc.status,
+        });
+      },
+    },
+    { name: 'Name', render: function (pvc) { return pvc.name; } },
+    { name: 'Size', render: function (pvc) { return pvc.size || ''; } },
+    { name: 'Mode', render: function (pvc) { return pvc.mode || ''; } },
+    { name: 'Class', render: function (pvc) { return pvc.class || 'default'; } },
+    {
+      name: 'Used by', render: function (pvc) {
+        return pvc.usedBy.join(', ') || '—';
+      },
+    },
+    { name: '', render: actions },
+  ];
+
+  function refresh() {
+    if (!state.namespace) return;
+    KF.get(apiBase() + '/pvcs').then(function (d) {
+      KF.table(document.getElementById('pvc-table'), COLUMNS, d.pvcs,
+        'No volumes in this namespace.');
+    }).catch(function (err) {
+      KF.snack('Could not list volumes: ' + err.message, true);
+    });
+  }
+
+  function buildForm() {
+    var root = document.getElementById('pvc-form');
+    root.innerHTML = '';
+    root.appendChild(KF.el('h2', { text: 'New Volume' }));
+    var name = KF.el('input', { type: 'text', placeholder: 'my-volume' });
+    var size = KF.el('input', { type: 'text', value: '10Gi' });
+    var mode = KF.el('select', {},
+      ['ReadWriteOnce', 'ReadWriteMany', 'ReadOnlyMany'].map(function (m) {
+        return KF.el('option', { value: m, text: m });
+      }));
+    var cls = KF.el('select', {},
+      [KF.el('option', { value: '{none}', text: 'default' })]);
+    KF.get(apiBase() + '/storageclasses').then(function (d) {
+      (d.storageClasses || []).forEach(function (sc) {
+        cls.appendChild(KF.el('option', { value: sc, text: sc }));
+      });
+    }).catch(function () { /* optional */ });
+    root.appendChild(KF.el('label', { text: 'Name' }));
+    root.appendChild(name);
+    root.appendChild(KF.el('label', { text: 'Size' }));
+    root.appendChild(size);
+    root.appendChild(KF.el('label', { text: 'Access mode' }));
+    root.appendChild(mode);
+    root.appendChild(KF.el('label', { text: 'Storage class' }));
+    root.appendChild(cls);
+    var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
+    bar.appendChild(KF.el('button', {
+      'class': 'kf-btn', text: 'Create',
+      onclick: function () {
+        KF.send('POST', apiBase() + '/pvcs', {
+          name: name.value.trim(),
+          size: size.value.trim(),
+          mode: mode.value,
+          class: cls.value,
+        }).then(function () {
+          KF.snack('Volume created');
+          show(listView);
+          refresh();
+        }).catch(function (err) { KF.snack(err.message, true); });
+      },
+    }));
+    bar.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      onclick: function () { show(listView); },
+    }));
+    root.appendChild(bar);
+  }
+
+  document.getElementById('new-btn').addEventListener('click', function () {
+    buildForm();
+    show(formView);
+  });
+
+  KF.namespace(
+    { standaloneMount: document.getElementById('ns-mount') },
+    function (ns) {
+      state.namespace = ns;
+      show(listView);
+      refresh();
+    });
+  KF.poll(refresh, 10000);
+})();

@@ -35,6 +35,26 @@ from kubeflow_tpu.crud_backend.authz import Authorizer, AllowAll, Forbidden
 
 log = logging.getLogger(__name__)
 
+# The shared frontend kit every CRUD app mounts at /lib/.
+FRONTEND_LIB_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "frontend_lib"
+)
+
+
+def register_namespaces_route(app: "RestApp", api) -> None:
+    """GET /api/namespaces — the namespace dropdown every CRUD app's
+    standalone mode needs (reference crud_backend/api/namespaces.py).
+    Listing namespace *names* needs no per-namespace grant: membership is
+    enforced on every namespaced route."""
+
+    @app.route("/api/namespaces")
+    def list_namespaces(request):
+        return {
+            "namespaces": [
+                ns["metadata"]["name"] for ns in api.list("v1", "Namespace")
+            ]
+        }
+
 
 class ApiError(Exception):
     """Handler-raised error carried to the JSON envelope."""
@@ -68,6 +88,7 @@ class RestApp:
         self.views: dict[str, Callable] = {}
         self._index_html: str | None = None
         self._static_dir: str | None = None
+        self._static_mounts: dict[str, str] = {}
 
         # Per-app registry: instantiating the same app twice (tests) must
         # not collide in the process-global default registry.
@@ -114,20 +135,40 @@ class RestApp:
         with open(os.path.join(self._static_dir, index)) as fh:
             self.serve_index(fh.read())
 
-    def _static_response(self, path: str) -> Response | None:
-        if self._static_dir is None:
-            return None
-        # Containment check: the resolved file must stay inside the dir.
-        full = os.path.abspath(
-            os.path.join(self._static_dir, path.lstrip("/"))
+    def mount_static(self, prefix: str, directory: str):
+        """Additionally serve ``directory`` under ``prefix`` (e.g. the
+        shared frontend lib at /lib/ — the role of kubeflow-common-lib,
+        which every reference CRUD app bundles)."""
+        self._static_mounts[prefix.rstrip("/") + "/"] = os.path.abspath(
+            directory
         )
-        if not full.startswith(self._static_dir + os.sep):
-            return None
-        if not os.path.isfile(full):
+
+    def serve_frontend(self, static_dir: str, lib_dir: str | None = None):
+        """SPA + shared kit in one call: the app's static dir at /, the
+        common frontend lib at /lib/. No-op when the app ships no
+        frontend (headless/test installs)."""
+        if not os.path.isdir(static_dir):
+            return
+        self.serve_static(static_dir)
+        self.mount_static("/lib", lib_dir or FRONTEND_LIB_DIR)
+
+    @staticmethod
+    def _file_response(root: str, rel_path: str) -> Response | None:
+        # Containment check: the resolved file must stay inside the dir.
+        full = os.path.abspath(os.path.join(root, rel_path.lstrip("/")))
+        if not full.startswith(root + os.sep) or not os.path.isfile(full):
             return None
         mime = mimetypes.guess_type(full)[0] or "application/octet-stream"
         with open(full, "rb") as fh:
             return Response(fh.read(), mimetype=mime)
+
+    def _static_response(self, path: str) -> Response | None:
+        for prefix, root in self._static_mounts.items():
+            if path.startswith(prefix):
+                return self._file_response(root, path[len(prefix):])
+        if self._static_dir is None:
+            return None
+        return self._file_response(self._static_dir, path)
 
     # ---- request lifecycle ----------------------------------------------
     def _authn_user(self, request: Request) -> str | None:

@@ -1,0 +1,174 @@
+/* Shared CRUD-app frontend kit (the role of the reference's
+ * kubeflow-common-lib: resource-table, status-icon, namespace-select,
+ * polling service, confirm-dialog, snack-bar —
+ * crud-web-apps/common/frontend/kubeflow-common-lib/projects/kubeflow/
+ * src/lib/). Framework-free ES5 exposed as window.KF; each app mounts
+ * it at /lib/ via RestApp.mount_static.
+ */
+(function (global) {
+  'use strict';
+
+  var KF = {};
+
+  // ---- REST client (CSRF double-submit + error envelope) ----
+  function csrfToken() {
+    var m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]*)/);
+    return m ? decodeURIComponent(m[1]) : '';
+  }
+
+  function parseResponse(r) {
+    return r.json().catch(function () { return {}; }).then(function (d) {
+      if (!r.ok) {
+        var err = new Error(d.log || ('request failed (' + r.status + ')'));
+        err.status = r.status;
+        throw err;
+      }
+      return d;
+    });
+  }
+
+  KF.get = function (url) {
+    return fetch(url, { credentials: 'same-origin' }).then(parseResponse);
+  };
+
+  KF.send = function (method, url, body) {
+    return fetch(url, {
+      method: method,
+      credentials: 'same-origin',
+      headers: {
+        'Content-Type': 'application/json',
+        'X-XSRF-TOKEN': csrfToken(),
+      },
+      body: body === undefined ? undefined : JSON.stringify(body),
+    }).then(parseResponse);
+  };
+
+  // ---- DOM helper ----
+  KF.el = function (tag, attrs, children) {
+    var node = document.createElement(tag);
+    Object.keys(attrs || {}).forEach(function (k) {
+      if (k === 'text') node.textContent = attrs[k];
+      else if (k === 'onclick') node.addEventListener('click', attrs[k]);
+      else if (k === 'onchange') node.addEventListener('change', attrs[k]);
+      else node.setAttribute(k, attrs[k]);
+    });
+    (children || []).forEach(function (c) { node.appendChild(c); });
+    return node;
+  };
+
+  // ---- status icon (reference lib/status-icon) ----
+  // phase: running | waiting | warning | error | stopped | terminating
+  KF.statusIcon = function (status) {
+    var phase = (status || {}).phase || 'waiting';
+    var span = KF.el('span', {
+      'class': 'kf-status kf-status-' + phase,
+      title: (status || {}).message || phase,
+    });
+    span.appendChild(KF.el('span', { 'class': 'kf-status-dot' }));
+    span.appendChild(KF.el('span', { text: phase }));
+    return span;
+  };
+
+  // ---- resource table (reference lib/resource-table) ----
+  // columns: [{name, render(row) -> Node|string}], actions optional.
+  KF.table = function (container, columns, rows, emptyMessage) {
+    container.innerHTML = '';
+    if (!rows.length) {
+      container.appendChild(
+        KF.el('div', { 'class': 'kf-empty', text: emptyMessage || 'Nothing here yet.' }));
+      return;
+    }
+    var thead = KF.el('tr', {}, columns.map(function (c) {
+      return KF.el('th', { text: c.name });
+    }));
+    var body = rows.map(function (row) {
+      return KF.el('tr', {}, columns.map(function (c) {
+        var cell = c.render(row);
+        var td = KF.el('td', {});
+        if (typeof cell === 'string') td.textContent = cell;
+        else if (cell) td.appendChild(cell);
+        return td;
+      }));
+    });
+    container.appendChild(
+      KF.el('table', { 'class': 'kf-table' },
+        [KF.el('thead', {}, [thead]), KF.el('tbody', {}, body)]));
+  };
+
+  // ---- polling with visibility pause (reference lib/poller) ----
+  KF.poll = function (fn, intervalMs) {
+    var timer = null;
+    function tick() {
+      if (!document.hidden) fn();
+      timer = setTimeout(tick, intervalMs);
+    }
+    tick();
+    return { stop: function () { clearTimeout(timer); } };
+  };
+
+  // ---- snackbar + confirm (reference lib/snack-bar, confirm-dialog) ----
+  KF.snack = function (message, isError) {
+    var bar = document.getElementById('kf-snack');
+    if (!bar) {
+      bar = KF.el('div', { id: 'kf-snack' });
+      document.body.appendChild(bar);
+    }
+    bar.textContent = message;
+    bar.className = isError ? 'kf-snack kf-snack-error' : 'kf-snack';
+    bar.classList.add('kf-snack-show');
+    setTimeout(function () { bar.classList.remove('kf-snack-show'); }, 4000);
+  };
+
+  KF.confirm = function (message, onYes) {
+    // Native confirm keeps the lib dependency-free; apps can override.
+    if (global.confirm(message)) onYes();
+  };
+
+  // ---- namespace resolution ----
+  // Inside the dashboard iframe: subscribe to the parent bus
+  // (library.js). Standalone: fetch the app's /api/namespaces and render
+  // a local selector into `standaloneMount`.
+  KF.namespace = function (opts, onChange) {
+    var inIframe = global.parent !== global && global.CentralDashboard;
+    if (inIframe) {
+      global.CentralDashboard.onNamespaceChange(onChange);
+      global.CentralDashboard.init();
+      return;
+    }
+    KF.get(opts.namespacesUrl || 'api/namespaces').then(function (d) {
+      var names = d.namespaces || [];
+      var mount = opts.standaloneMount;
+      if (mount && names.length) {
+        var select = KF.el('select', {
+          'class': 'kf-ns-select',
+          onchange: function () { onChange(select.value); },
+        }, names.map(function (ns) {
+          return KF.el('option', { value: ns, text: ns });
+        }));
+        mount.innerHTML = '';
+        mount.appendChild(select);
+      }
+      if (names.length) onChange(names[0]);
+    }).catch(function (err) {
+      KF.snack('Could not list namespaces: ' + err.message, true);
+    });
+  };
+
+  // ---- misc formatting ----
+  KF.age = function (timestamp) {
+    if (!timestamp) return '';
+    var s = Math.max(0, (Date.now() - new Date(timestamp).getTime()) / 1000);
+    if (s < 120) return Math.floor(s) + 's';
+    if (s < 7200) return Math.floor(s / 60) + 'm';
+    if (s < 172800) return Math.floor(s / 3600) + 'h';
+    return Math.floor(s / 86400) + 'd';
+  };
+
+  KF.shortImage = function (image) {
+    var tagless = (image || '').split(':')[0];
+    var parts = tagless.split('/');
+    return parts[parts.length - 1] || image;
+  };
+
+  global.KF = KF;
+})(window);

@@ -1,0 +1,42 @@
+/* Child-app bus (reference centraldashboard/public/library.js:5-50).
+ *
+ * Per-resource web apps loaded inside the dashboard's iframe import this
+ * script and handshake namespace selection with the parent shell over
+ * postMessage:
+ *
+ *   CentralDashboard.onNamespaceChange(ns => reload(ns));
+ *   CentralDashboard.init();
+ *
+ * Messages: {type: "namespace-selected", value: ns} parent -> child,
+ * {type: "iframe-connected"} child -> parent on init.
+ */
+(function (global) {
+  'use strict';
+
+  var handlers = [];
+  var currentNamespace = null;
+
+  function onMessage(event) {
+    var data = event.data || {};
+    if (data.type === 'namespace-selected') {
+      currentNamespace = data.value;
+      handlers.forEach(function (fn) { fn(data.value); });
+    }
+  }
+
+  var CentralDashboard = {
+    init: function () {
+      global.addEventListener('message', onMessage);
+      if (global.parent !== global) {
+        global.parent.postMessage({ type: 'iframe-connected' }, '*');
+      }
+    },
+    onNamespaceChange: function (fn) {
+      handlers.push(fn);
+      if (currentNamespace !== null) { fn(currentNamespace); }
+    },
+    get namespace() { return currentNamespace; },
+  };
+
+  global.CentralDashboard = CentralDashboard;
+})(window);

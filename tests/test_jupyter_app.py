@@ -46,6 +46,36 @@ def spawn_form(name="nb1", **extra):
     return {"name": name, **extra}
 
 
+class TestFrontendServing:
+    """The SPA + shared lib are served by the backend (reference: the
+    built Angular bundle served via crud_backend/serving.py; the shared
+    kit plays kubeflow-common-lib's role)."""
+
+    def test_index_and_assets(self):
+        client = client_for(FakeApiServer())
+        resp = client.get("/")
+        assert resp.status_code == 200
+        assert b"Notebooks" in resp.data
+        assert any("XSRF-TOKEN" in c
+                   for c in resp.headers.getlist("Set-Cookie"))
+        assert b"spawner-form" in resp.data
+        assert client.get("/app.js").status_code == 200
+        assert client.get("/style.css").status_code == 200
+
+    def test_shared_lib_mounted(self):
+        client = client_for(FakeApiServer())
+        js = client.get("/lib/common.js")
+        assert js.status_code == 200
+        assert b"window.KF" in js.data or b"global.KF" in js.data
+        assert client.get("/lib/common.css").status_code == 200
+        assert b"CentralDashboard" in client.get("/lib/library.js").data
+
+    def test_lib_traversal_guard(self):
+        client = client_for(FakeApiServer())
+        assert client.get("/lib/../jupyter/app.py").status_code == 404
+        assert client.get("/lib/%2e%2e/common.js").status_code == 404
+
+
 class TestMiddleware:
     def test_missing_user_header_401(self):
         client = client_for(FakeApiServer())

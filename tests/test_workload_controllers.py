@@ -157,6 +157,58 @@ class TestVolumesApp:
         assert data["pvcs"][0]["usedBy"] == ["nb"]
 
 
+class TestAppFrontends:
+    """Each CRUD app serves its SPA + the shared lib (role of the
+    reference's built Angular bundles + kubeflow-common-lib)."""
+
+    def test_vwa_frontend_served(self):
+        api = FakeApiServer()
+        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        resp = client.get("/")
+        assert resp.status_code == 200 and b"Volumes" in resp.data
+        assert any("XSRF-TOKEN" in c
+                   for c in resp.headers.getlist("Set-Cookie"))
+        assert client.get("/app.js").status_code == 200
+        assert client.get("/lib/common.js").status_code == 200
+
+    def test_twa_frontend_served(self):
+        api = FakeApiServer()
+        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        resp = client.get("/")
+        assert resp.status_code == 200 and b"TensorBoards" in resp.data
+        assert client.get("/app.js").status_code == 200
+        assert client.get("/lib/common.css").status_code == 200
+
+    def test_vwa_namespaces_and_storageclasses(self):
+        api = FakeApiServer()
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "alice"}})
+        api.create({"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+                    "metadata": {"name": "fast-ssd"}})
+        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        hdr = {"kubeflow-userid": "alice@example.com"}
+        assert client.get(
+            "/api/namespaces", headers=hdr
+        ).get_json()["namespaces"] == ["alice"]
+        assert client.get(
+            "/api/namespaces/alice/storageclasses", headers=hdr
+        ).get_json()["storageClasses"] == ["fast-ssd"]
+
+    def test_twa_namespaces(self):
+        api = FakeApiServer()
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "alice"}})
+        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        client = app.test_client()
+        hdr = {"kubeflow-userid": "alice@example.com"}
+        assert client.get(
+            "/api/namespaces", headers=hdr
+        ).get_json()["namespaces"] == ["alice"]
+
+
 class TestTensorboardsApp:
     def test_tb_crud(self):
         api = FakeApiServer()
