@@ -1,0 +1,12 @@
+# Shared build variables (role of the reference's common.mk).
+REGISTRY ?= ghcr.io/kubeflow-tpu
+TAG      ?= latest
+PLATFORMS ?= linux/amd64
+BUILDER  ?= docker
+
+define build_image
+	$(BUILDER) build \
+		--build-arg REGISTRY=$(REGISTRY) \
+		--build-arg TAG=$(TAG) \
+		-t $(REGISTRY)/$(1):$(TAG) $(1)
+endef

@@ -39,14 +39,8 @@
 
   function actions(nb) {
     var div = KF.el('div', { 'class': 'kf-actions' });
-    var connect = KF.el('a', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Connect',
-      href: connectUrl(nb), target: '_blank',
-    });
-    if (nb.status.phase !== 'running') {
-      connect.setAttribute('style', 'pointer-events:none;opacity:0.4');
-    }
-    div.appendChild(connect);
+    div.appendChild(KF.actionLink(
+      'Connect', connectUrl(nb), nb.status.phase === 'running'));
     var stopped = nb.stopped;
     div.appendChild(KF.el('button', {
       'class': 'kf-btn kf-btn-ghost',
@@ -269,7 +263,6 @@
     var submit = KF.el('button', {
       'class': 'kf-btn', text: 'Create',
       onclick: function () {
-        submit.setAttribute('disabled', '');
         var body = {
           name: f.name.value.trim(),
           image: f.image.value,
@@ -286,14 +279,13 @@
           body.customImage = f.customImage.value.trim();
         }
         if (!f.wsCheck.checked) body.workspaceVolume = null;
-        KF.send('POST', apiBase() + '/notebooks', body)
+        KF.whileBusy(submit, KF.send('POST', apiBase() + '/notebooks', body))
           .then(function () {
             KF.snack('Notebook "' + body.name + '" created');
             show(listView);
             refresh();
           })
-          .catch(function (err) { KF.snack(err.message, true); })
-          .then(function () { submit.removeAttribute('disabled'); });
+          .catch(function (err) { KF.snack(err.message, true); });
       },
     });
     bar.appendChild(submit);

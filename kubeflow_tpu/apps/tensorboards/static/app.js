@@ -36,14 +36,7 @@
     {
       name: '', render: function (tb) {
         var div = KF.el('div', { 'class': 'kf-actions' });
-        var connect = KF.el('a', {
-          'class': 'kf-btn kf-btn-ghost', text: 'Connect',
-          href: connectUrl(tb), target: '_blank',
-        });
-        if (!tb.ready) {
-          connect.setAttribute('style', 'pointer-events:none;opacity:0.4');
-        }
-        div.appendChild(connect);
+        div.appendChild(KF.actionLink('Connect', connectUrl(tb), tb.ready));
         div.appendChild(KF.el('button', {
           'class': 'kf-btn kf-btn-danger', text: 'Delete',
           onclick: function () {
@@ -88,19 +81,20 @@
         'written by jax.profiler.start_trace land there.',
     }));
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
-    bar.appendChild(KF.el('button', {
+    var submit = KF.el('button', {
       'class': 'kf-btn', text: 'Create',
       onclick: function () {
-        KF.send('POST', apiBase() + '/tensorboards', {
+        KF.whileBusy(submit, KF.send('POST', apiBase() + '/tensorboards', {
           name: name.value.trim(),
           logspath: logspath.value.trim(),
-        }).then(function () {
+        })).then(function () {
           KF.snack('TensorBoard created');
           show(listView);
           refresh();
         }).catch(function (err) { KF.snack(err.message, true); });
       },
-    }));
+    });
+    bar.appendChild(submit);
     bar.appendChild(KF.el('button', {
       'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
       onclick: function () { show(listView); },

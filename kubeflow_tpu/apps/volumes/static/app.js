@@ -118,21 +118,22 @@
     root.appendChild(KF.el('label', { text: 'Storage class' }));
     root.appendChild(cls);
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
-    bar.appendChild(KF.el('button', {
+    var submit = KF.el('button', {
       'class': 'kf-btn', text: 'Create',
       onclick: function () {
-        KF.send('POST', apiBase() + '/pvcs', {
+        KF.whileBusy(submit, KF.send('POST', apiBase() + '/pvcs', {
           name: name.value.trim(),
           size: size.value.trim(),
           mode: mode.value,
           class: cls.value,
-        }).then(function () {
+        })).then(function () {
           KF.snack('Volume created');
           show(listView);
           refresh();
         }).catch(function (err) { KF.snack(err.message, true); });
       },
-    }));
+    });
+    bar.appendChild(submit);
     bar.appendChild(KF.el('button', {
       'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
       onclick: function () { show(listView); },

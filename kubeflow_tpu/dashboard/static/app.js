@@ -74,10 +74,12 @@
   function broadcastNamespace() {
     if (frame.contentWindow) {
       frame.contentWindow.postMessage(
-        { type: 'namespace-selected', value: state.namespace }, '*');
+        { type: 'namespace-selected', value: state.namespace },
+        location.origin);
     }
   }
   window.addEventListener('message', function (event) {
+    if (event.origin !== location.origin) { return; }
     if ((event.data || {}).type === 'iframe-connected') {
       broadcastNamespace();
     }

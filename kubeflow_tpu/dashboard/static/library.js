@@ -17,6 +17,9 @@
   var currentNamespace = null;
 
   function onMessage(event) {
+    // The dashboard shell and its child apps share one origin behind the
+    // mesh gateway; anything else is a hostile embedder.
+    if (event.origin !== global.location.origin) { return; }
     var data = event.data || {};
     if (data.type === 'namespace-selected') {
       currentNamespace = data.value;
@@ -28,7 +31,8 @@
     init: function () {
       global.addEventListener('message', onMessage);
       if (global.parent !== global) {
-        global.parent.postMessage({ type: 'iframe-connected' }, '*');
+        global.parent.postMessage(
+          { type: 'iframe-connected' }, global.location.origin);
       }
     },
     onNamespaceChange: function (fn) {

@@ -165,9 +165,36 @@
   };
 
   KF.shortImage = function (image) {
-    var tagless = (image || '').split(':')[0];
-    var parts = tagless.split('/');
-    return parts[parts.length - 1] || image;
+    // Strip the tag from the LAST path segment only — 'registry:5000/x'
+    // must not collapse to the registry host.
+    var parts = (image || '').split('/');
+    var last = parts[parts.length - 1] || '';
+    return last.split(':')[0] || image;
+  };
+
+  // Action link that is a real <a> when enabled and an inert button
+  // otherwise (pointer-events CSS alone still allows keyboard
+  // activation).
+  KF.actionLink = function (text, href, enabled) {
+    if (enabled) {
+      return KF.el('a', {
+        'class': 'kf-btn kf-btn-ghost', text: text,
+        href: href, target: '_blank', rel: 'noopener',
+      });
+    }
+    var span = KF.el('span', {
+      'class': 'kf-btn kf-btn-ghost', text: text,
+      'aria-disabled': 'true', style: 'opacity:0.4;cursor:default',
+    });
+    return span;
+  };
+
+  // Disable a button for the duration of a promise (double-submit guard).
+  KF.whileBusy = function (button, promise) {
+    button.setAttribute('disabled', '');
+    return promise.then(
+      function (v) { button.removeAttribute('disabled'); return v; },
+      function (e) { button.removeAttribute('disabled'); throw e; });
   };
 
   global.KF = KF;

@@ -1,0 +1,177 @@
+"""Image-stack contract tests (reference test tier: the image CI builds;
+here static contract validation + behavioural tests of the boot scripts,
+runnable without a container runtime — SURVEY.md §4 tier 6).
+
+The contract under test (reference example-notebook-servers):
+- DAG consistency: every Makefile target has a directory + Dockerfile,
+  every child's FROM points at its Makefile parent.
+- Runtime contract: port 8888, NB_PREFIX, /home/jovyan, UID 1000/GID 0.
+- TPU delta: the 10-tpu-env script derives TPU_WORKER_ID/coordinator
+  from the StatefulSet ordinal with webhook-env precedence and a clean
+  single-host fallback.
+"""
+
+import os
+import re
+import stat
+import subprocess
+
+import pytest
+
+IMAGES_DIR = os.path.join(os.path.dirname(__file__), "..", "images")
+
+# Mirrors images/Makefile target: prerequisite.
+DAG = {
+    "base": None,
+    "jupyter": "base",
+    "jupyter-scipy": "jupyter",
+    "jupyter-jax-tpu": "jupyter",
+    "jupyter-jax-tpu-full": "jupyter-jax-tpu",
+    "codeserver": "base",
+    "codeserver-jax-tpu": "codeserver",
+    "rstudio": "base",
+}
+
+
+def dockerfile(name: str) -> str:
+    with open(os.path.join(IMAGES_DIR, name, "Dockerfile")) as fh:
+        return fh.read()
+
+
+class TestImageDag:
+    def test_every_image_has_dockerfile(self):
+        for name in DAG:
+            assert os.path.isfile(
+                os.path.join(IMAGES_DIR, name, "Dockerfile")
+            ), name
+
+    def test_makefile_covers_dag(self):
+        with open(os.path.join(IMAGES_DIR, "Makefile")) as fh:
+            mk = fh.read()
+        for name, parent in DAG.items():
+            if parent is None:
+                continue
+            assert re.search(rf"^{name}: {parent}$", mk, re.M), (
+                f"Makefile must build {name} after {parent}"
+            )
+
+    def test_from_lines_match_dag(self):
+        for name, parent in DAG.items():
+            if parent is None:
+                continue
+            m = re.search(r"^FROM \$\{REGISTRY\}/([a-z-]+):\$\{TAG\}$",
+                          dockerfile(name), re.M)
+            assert m, f"{name} must FROM a stack image"
+            assert m.group(1) == parent, (
+                f"{name} builds FROM {m.group(1)}, Makefile says {parent}"
+            )
+
+
+class TestRuntimeContract:
+    def test_base_contract(self):
+        df = dockerfile("base")
+        assert "NB_PREFIX=/" in df
+        assert "NB_UID=1000" in df
+        assert "NB_GID=0" in df
+        assert "HOME=/home/jovyan" in df
+        assert "EXPOSE 8888" in df
+        assert 'ENTRYPOINT ["/init"]' in df  # s6 supervision
+
+    def test_servers_listen_on_contract_port(self):
+        for script, needle in [
+            ("jupyter/s6/services.d/jupyterlab/run", "--ServerApp.port=8888"),
+            ("codeserver/s6/services.d/code-server/run", "0.0.0.0:8888"),
+            ("rstudio/s6/services.d/rstudio/run", "--www-port=8888"),
+        ]:
+            path = os.path.join(IMAGES_DIR, script)
+            with open(path) as fh:
+                content = fh.read()
+            assert needle in content, script
+            assert os.stat(path).st_mode & stat.S_IXUSR, f"{script} not +x"
+
+    def test_prefix_wired_through(self):
+        with open(os.path.join(
+            IMAGES_DIR, "jupyter/s6/services.d/jupyterlab/run"
+        )) as fh:
+            assert 'base_url="${NB_PREFIX}"' in fh.read()
+        with open(os.path.join(
+            IMAGES_DIR, "rstudio/s6/services.d/rstudio/run"
+        )) as fh:
+            assert 'www-root-path="${NB_PREFIX}"' in fh.read()
+
+    def test_scripts_parse(self):
+        for root, _, files in os.walk(IMAGES_DIR):
+            for f in files:
+                if "s6" not in root:
+                    continue
+                path = os.path.join(root, f)
+                subprocess.run(["bash", "-n", path], check=True)
+
+    def test_tpu_images_replace_cuda_variants(self):
+        """The TPU delta: jax[tpu] images exist, no nvidia/cuda remnants."""
+        for name in ("jupyter-jax-tpu", "codeserver-jax-tpu"):
+            df = dockerfile(name)
+            assert "jax[tpu]" in df, name
+            assert "libtpu_releases" in df, name
+        for name in DAG:
+            # Instructions only — comments cite the reference's cuda
+            # variants by name.
+            code = "\n".join(
+                line for line in dockerfile(name).splitlines()
+                if not line.lstrip().startswith("#")
+            ).lower()
+            assert "nvidia" not in code and "cuda" not in code, name
+
+
+class TestTpuEnvScript:
+    """Behavioural tests of 10-tpu-env (the multi-host/single-host
+    wiring, SURVEY.md §7 stage-3 hard part)."""
+
+    SCRIPT = os.path.join(
+        IMAGES_DIR, "jupyter-jax-tpu/s6/cont-init.d/10-tpu-env"
+    )
+
+    def run_script(self, tmp_path, env):
+        envdir = tmp_path / "env"
+        full_env = {
+            "PATH": os.environ["PATH"],
+            "S6_ENVDIR": str(envdir),
+            **env,
+        }
+        subprocess.run(["bash", self.SCRIPT], check=True, env=full_env)
+        return {
+            f: (envdir / f).read_text() for f in os.listdir(envdir)
+        }
+
+    def test_ordinal_derivation(self, tmp_path):
+        out = self.run_script(tmp_path, {
+            "HOSTNAME": "my-notebook-3",
+            "TPU_WORKER_HOSTNAMES":
+                "my-notebook-0.my-notebook,my-notebook-1.my-notebook",
+        })
+        assert out["TPU_WORKER_ID"] == "3"
+        assert out["JAX_COORDINATOR_ADDRESS"] == (
+            "my-notebook-0.my-notebook:8476"
+        )
+
+    def test_webhook_env_takes_precedence(self, tmp_path):
+        out = self.run_script(tmp_path, {
+            "HOSTNAME": "my-notebook-3",
+            "TPU_WORKER_ID": "7",
+            "JAX_COORDINATOR_ADDRESS": "coord.svc:9000",
+        })
+        assert out["TPU_WORKER_ID"] == "7"
+        assert out["JAX_COORDINATOR_ADDRESS"] == "coord.svc:9000"
+
+    def test_single_host_fallback(self, tmp_path):
+        out = self.run_script(tmp_path, {"HOSTNAME": "standalone-pod-x7f"})
+        assert out["TPU_WORKER_ID"] == "0"
+        assert "JAX_COORDINATOR_ADDRESS" not in out
+
+    def test_both_tpu_images_ship_identical_script(self):
+        with open(self.SCRIPT) as fh:
+            jupyter_script = fh.read()
+        with open(os.path.join(
+            IMAGES_DIR, "codeserver-jax-tpu/s6/cont-init.d/10-tpu-env"
+        )) as fh:
+            assert fh.read() == jupyter_script

@@ -1,0 +1,117 @@
+"""Manifest validation (reference test tier: kustomize-build CI in
+*_integration_test.yaml workflows; here structural validation without a
+cluster — every YAML parses, every kustomization resource resolves, and
+the CRDs agree with the API-version constants the code uses)."""
+
+import os
+
+import pytest
+import yaml
+
+MANIFESTS = os.path.join(os.path.dirname(__file__), "..", "manifests")
+
+
+def walk_yaml():
+    for root, _, files in os.walk(MANIFESTS):
+        for f in sorted(files):
+            if f.endswith(".yaml"):
+                yield os.path.join(root, f)
+
+
+class TestYamlValidity:
+    def test_every_manifest_parses(self):
+        count = 0
+        for path in walk_yaml():
+            with open(path) as fh:
+                docs = [d for d in yaml.safe_load_all(fh) if d]
+            assert docs, path
+            for doc in docs:
+                if os.path.basename(path) != "params.env":
+                    assert "apiVersion" in doc and "kind" in doc, path
+            count += len(docs)
+        assert count >= 40
+
+    def test_kustomization_resources_resolve(self):
+        for path in walk_yaml():
+            if os.path.basename(path) != "kustomization.yaml":
+                continue
+            base = os.path.dirname(path)
+            with open(path) as fh:
+                kust = yaml.safe_load(fh)
+            for res in kust.get("resources") or []:
+                assert os.path.exists(os.path.join(base, res)), (
+                    f"{path}: resource {res} missing"
+                )
+            for gen in kust.get("configMapGenerator") or []:
+                for env in gen.get("envs") or []:
+                    assert os.path.exists(os.path.join(base, env)), (
+                        f"{path}: env file {env} missing"
+                    )
+
+
+class TestCrdParity:
+    """CRDs must match the group/version constants used by the apps and
+    controllers — a drifted manifest would install CRDs the platform
+    never serves."""
+
+    def load_crd(self, name):
+        with open(os.path.join(MANIFESTS, "crds", name)) as fh:
+            return yaml.safe_load(fh)
+
+    @pytest.mark.parametrize("crd_file,expected_api,kind", [
+        ("notebook.yaml", "kubeflow.org/v1beta1", "Notebook"),
+        ("profile.yaml", "kubeflow.org/v1", "Profile"),
+        ("poddefault.yaml", "kubeflow.org/v1alpha1", "PodDefault"),
+        ("tensorboard.yaml", "tensorboard.kubeflow.org/v1alpha1",
+         "Tensorboard"),
+        ("pvcviewer.yaml", "kubeflow.org/v1alpha1", "PVCViewer"),
+    ])
+    def test_crd_matches_code_constant(self, crd_file, expected_api, kind):
+        crd = self.load_crd(crd_file)
+        group, version = expected_api.split("/")
+        assert crd["spec"]["group"] == group
+        assert crd["spec"]["names"]["kind"] == kind
+        versions = [v["name"] for v in crd["spec"]["versions"]]
+        assert version in versions
+        stored = [v["name"] for v in crd["spec"]["versions"] if v["storage"]]
+        assert len(stored) == 1
+
+    def test_code_constants_agree(self):
+        from kubeflow_tpu.apps.jupyter.app import (
+            NOTEBOOK_API, PODDEFAULT_API,
+        )
+        from kubeflow_tpu.apps.tensorboards.app import TENSORBOARD_API
+        from kubeflow_tpu.apps.volumes.app import PVCVIEWER_API
+        from kubeflow_tpu.kfam.app import PROFILE_API
+
+        assert NOTEBOOK_API == "kubeflow.org/v1beta1"
+        assert PODDEFAULT_API == "kubeflow.org/v1alpha1"
+        assert TENSORBOARD_API == "tensorboard.kubeflow.org/v1alpha1"
+        assert PVCVIEWER_API == "kubeflow.org/v1alpha1"
+        assert PROFILE_API == "kubeflow.org/v1"
+
+    def test_notebook_crd_has_tpu_block(self):
+        crd = self.load_crd("notebook.yaml")
+        spec_schema = (crd["spec"]["versions"][0]["schema"]
+                       ["openAPIV3Schema"]["properties"]["spec"])
+        tpu = spec_schema["properties"]["tpu"]
+        assert set(tpu["properties"]) == {"accelerator", "topology"}
+        assert tpu["required"] == ["accelerator"]
+
+
+class TestWebhookRegistration:
+    def test_webhook_scoped_to_profile_namespaces(self):
+        """failurePolicy Fail + profile-namespace selector: identical
+        blast-radius decision to the reference (its webhook config
+        :15 fails closed but only inside kubeflow-profile namespaces)."""
+        path = os.path.join(MANIFESTS, "admission-webhook", "base",
+                            "mutating-webhook-configuration.yaml")
+        with open(path) as fh:
+            cfg = yaml.safe_load(fh)
+        hook = cfg["webhooks"][0]
+        assert hook["failurePolicy"] == "Fail"
+        assert hook["namespaceSelector"]["matchLabels"] == {
+            "app.kubernetes.io/part-of": "kubeflow-profile"
+        }
+        assert hook["rules"][0]["operations"] == ["CREATE"]
+        assert hook["rules"][0]["resources"] == ["pods"]
