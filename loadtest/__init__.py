@@ -1,0 +1,1 @@
+"""Load-test harness for the notebook controller (SURVEY.md §2 #23, §6)."""

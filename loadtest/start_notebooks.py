@@ -1,0 +1,334 @@
+"""Notebook-controller load test with spawn->ready timing capture.
+
+Capability parity with the reference load harness
+(reference notebook-controller/loadtest/start_notebooks.py:1-30, which
+applies N templated Notebook+PVC CRs via kubectl and captures no timing),
+extended per SURVEY.md SS6: the reference publishes no performance numbers,
+so this harness *establishes* the spawn->ready baseline — per-notebook
+latency from CR creation to status.readyReplicas == spec replicas, with
+p50/p90/max summary printed as one JSON line.
+
+Two modes:
+
+- ``kubectl``: template Notebook + PVC manifests (TPU-flavoured: the CR
+  carries ``spec.tpu``) and apply/delete them against a real cluster,
+  optionally polling readiness for the timing capture.
+- ``simulate``: run the real notebook controller (Python watch loop +
+  native core) against the in-memory API server with a fake kubelet that
+  marks pods ready after a configurable latency. This exercises the full
+  reconcile pipeline in-process — the scale tier of the test ladder
+  (SURVEY.md SS4 tier 8) with actual latency numbers, no cluster needed.
+
+Usage:
+  python -m loadtest.start_notebooks -l 50 --mode simulate
+  python -m loadtest.start_notebooks -l 10 -n kubeflow --mode kubectl
+  python -m loadtest.start_notebooks -l 10 -n kubeflow -p delete
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import yaml
+
+HERE = Path(__file__).resolve().parent
+
+
+def load_templates() -> tuple[dict, dict]:
+    notebook = yaml.safe_load((HERE / "notebook_template.yaml").read_text())
+    pvc = yaml.safe_load((HERE / "pvc_template.yaml").read_text())
+    return notebook, pvc
+
+
+def render_notebook(template: dict, index: int, namespace: str) -> dict:
+    """Per-index rename of the notebook CR and its PVC claim (reference
+    write_notebook_config, loadtest/start_notebooks.py)."""
+    nb = copy.deepcopy(template)
+    nb["metadata"]["name"] = f"jupyter-test-{index}"
+    nb["metadata"]["namespace"] = namespace
+    spec = nb["spec"]["template"]["spec"]
+    spec["containers"][0]["name"] = f"notebook-{index}"
+    for vol in spec.get("volumes", []):
+        if "persistentVolumeClaim" in vol:
+            vol["persistentVolumeClaim"]["claimName"] = f"test-vol-{index}"
+    return nb
+
+
+def render_pvc(template: dict, index: int, namespace: str) -> dict:
+    pvc = copy.deepcopy(template)
+    pvc["metadata"]["name"] = f"test-vol-{index}"
+    pvc["metadata"]["namespace"] = namespace
+    return pvc
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Linear-interpolated percentile, q in [0, 1]."""
+    if not sorted_values:
+        return 0.0
+    if len(sorted_values) == 1:
+        return sorted_values[0]
+    pos = q * (len(sorted_values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac
+
+
+def summarize(latencies: dict[str, float], mode: str) -> dict:
+    values = sorted(latencies.values())
+    return {
+        "metric": "notebook_spawn_to_ready_seconds",
+        "mode": mode,
+        "count": len(values),
+        "p50": round(percentile(values, 0.50), 4),
+        "p90": round(percentile(values, 0.90), 4),
+        "max": round(max(values), 4) if values else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# kubectl mode (real cluster)
+# ---------------------------------------------------------------------------
+
+
+def kubectl_io(obj: dict, operation: str, namespace: str) -> None:
+    cmd = ["kubectl", operation, "-n", namespace]
+    if operation == "delete":
+        cmd.append("--ignore-not-found")
+    cmd += ["-f", "-"]
+    proc = subprocess.run(
+        cmd, input=yaml.dump(obj).encode(), capture_output=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"kubectl {operation} failed for "
+            f"{obj['kind']}/{obj['metadata']['name']}: "
+            f"{proc.stderr.decode().strip()}"
+        )
+
+
+def ready_notebooks_kubectl(namespace: str) -> set[str]:
+    """One ``kubectl get notebooks -o json`` per poll pass (a per-notebook
+    exec would bias the latencies this harness exists to measure). A CR with
+    no status yet simply doesn't count as ready. Errors are tolerated — a
+    transient apiserver failure should not abort the measurement."""
+    proc = subprocess.run(
+        ["kubectl", "get", "notebooks", "-n", namespace, "-o", "json"],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        print(
+            f"kubectl get notebooks: {proc.stderr.decode().strip()}",
+            file=sys.stderr,
+        )
+        return set()
+    ready = set()
+    for item in json.loads(proc.stdout.decode()).get("items", []):
+        want = max((item["spec"].get("tpu") or {}).get("replicas", 1), 1)
+        if (item.get("status") or {}).get("readyReplicas", 0) >= want:
+            ready.add(item["metadata"]["name"])
+    return ready
+
+
+def run_kubectl(args: argparse.Namespace) -> dict | None:
+    nb_tmpl, pvc_tmpl = load_templates()
+    created_at: dict[str, float] = {}
+    for i in range(args.num_notebooks):
+        nb = render_notebook(nb_tmpl, i, args.namespace)
+        pvc = render_pvc(pvc_tmpl, i, args.namespace)
+        print(f"kubectl {args.operation} notebook/{nb['metadata']['name']} ...")
+        kubectl_io(pvc, args.operation, args.namespace)
+        kubectl_io(nb, args.operation, args.namespace)
+        created_at[nb["metadata"]["name"]] = time.monotonic()
+    if args.operation != "apply" or not args.wait:
+        return None
+    latencies: dict[str, float] = {}
+    deadline = time.monotonic() + args.timeout
+    while len(latencies) < len(created_at) and time.monotonic() < deadline:
+        now = time.monotonic()
+        for name in ready_notebooks_kubectl(args.namespace):
+            if name in created_at and name not in latencies:
+                latencies[name] = now - created_at[name]
+        time.sleep(args.poll_interval)
+    return summarize(latencies, "kubectl")
+
+
+# ---------------------------------------------------------------------------
+# simulate mode (in-process controller + fake kubelet)
+# ---------------------------------------------------------------------------
+
+
+class FakeKubelet:
+    """Plays the kubelet's role against the in-memory API server: for every
+    StatefulSet it sees, after ``pod_latency`` seconds it creates the replica
+    pods with Ready conditions and marks the StatefulSet ready — the signal
+    the controller's status mirror consumes."""
+
+    def __init__(self, api, pod_latency: float = 0.0):
+        self.api = api
+        self.pod_latency = pod_latency
+        self._started: dict[tuple[str, str], float] = {}
+        self._done: set[tuple[str, str]] = set()
+
+    def step(self, now: float) -> int:
+        changed = 0
+        for sts in self.api.list("apps/v1", "StatefulSet"):
+            meta = sts["metadata"]
+            key = (meta["namespace"], meta["name"])
+            if key in self._done:
+                continue
+            self._started.setdefault(key, now)
+            if now - self._started[key] < self.pod_latency:
+                continue
+            replicas = sts["spec"].get("replicas", 1)
+            for ordinal in range(replicas):
+                self.api.apply(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {
+                            "name": f"{meta['name']}-{ordinal}",
+                            "namespace": meta["namespace"],
+                            "labels": dict(
+                                (
+                                    sts["spec"].get("template", {}).get("metadata")
+                                    or {}
+                                ).get("labels", {})
+                            ),
+                        },
+                        "status": {
+                            "phase": "Running",
+                            "containerStatuses": [
+                                {"state": {"running": {"startedAt": "1970-01-01T00:00:00Z"}}}
+                            ],
+                            "conditions": [{"type": "Ready", "status": "True"}],
+                        },
+                    }
+                )
+            fresh = self.api.get(
+                "apps/v1", "StatefulSet", meta["name"], meta["namespace"]
+            )
+            fresh.setdefault("status", {})["readyReplicas"] = replicas
+            self.api.update(fresh)
+            self._done.add(key)
+            changed += 1
+        return changed
+
+
+def run_simulate(
+    num_notebooks: int,
+    namespace: str = "kubeflow",
+    pod_latency: float = 0.0,
+    timeout: float = 60.0,
+) -> dict:
+    from kubeflow_tpu.controllers.notebook import make_notebook_controller
+    from kubeflow_tpu.k8s import FakeApiServer
+
+    api = FakeApiServer()
+    controller = make_notebook_controller(api)
+    kubelet = FakeKubelet(api, pod_latency=pod_latency)
+    nb_tmpl, pvc_tmpl = load_templates()
+
+    created_at: dict[str, float] = {}
+    latencies: dict[str, float] = {}
+    stop = threading.Event()
+
+    def kubelet_loop():
+        while not stop.is_set():
+            kubelet.step(time.monotonic())
+            time.sleep(0.002)
+
+    kubelet_thread = threading.Thread(target=kubelet_loop, daemon=True)
+    kubelet_thread.start()
+    controller_thread = controller.start()
+    try:
+        for i in range(num_notebooks):
+            nb = render_notebook(nb_tmpl, i, namespace)
+            api.create(render_pvc(pvc_tmpl, i, namespace))
+            api.create(nb)
+            created_at[nb["metadata"]["name"]] = time.monotonic()
+        deadline = time.monotonic() + timeout
+        while len(latencies) < num_notebooks and time.monotonic() < deadline:
+            for nb in api.list("kubeflow.org/v1beta1", "Notebook", namespace):
+                name = nb["metadata"]["name"]
+                if name in latencies or name not in created_at:
+                    continue
+                want = max(nb["spec"].get("tpu", {}).get("replicas", 1), 1)
+                if nb.get("status", {}).get("readyReplicas", 0) >= want:
+                    latencies[name] = time.monotonic() - created_at[name]
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        controller.stop()
+        kubelet_thread.join(timeout=1)
+        controller_thread.join(timeout=1)
+    return summarize(latencies, "simulate")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="Load test the notebook controller, capturing "
+        "spawn->ready latency percentiles."
+    )
+    parser.add_argument(
+        "-l", "--load", dest="num_notebooks", type=int, default=3,
+        help="Number of notebooks to spawn. (Default: %(default)s)",
+    )
+    parser.add_argument(
+        "-n", "--namespace", default="kubeflow",
+        help="Namespace for the workload. (Default: %(default)s)",
+    )
+    parser.add_argument(
+        "-p", "--operation", choices=["apply", "delete"], default="apply",
+        help="kubectl operation. (Default: %(default)s)",
+    )
+    parser.add_argument(
+        "--mode", choices=["kubectl", "simulate"], default="kubectl",
+        help="Real cluster via kubectl, or in-process controller simulation.",
+    )
+    parser.add_argument(
+        "--wait", action="store_true",
+        help="kubectl mode: poll readiness and print the latency summary.",
+    )
+    parser.add_argument(
+        "--pod-latency", type=float, default=0.0,
+        help="simulate mode: seconds the fake kubelet waits before pods go "
+        "Ready.",
+    )
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--poll-interval", type=float, default=2.0)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.mode == "simulate":
+        summary = run_simulate(
+            args.num_notebooks,
+            namespace=args.namespace,
+            pod_latency=args.pod_latency,
+            timeout=args.timeout,
+        )
+    else:
+        summary = run_kubectl(args)
+    if summary is not None:
+        print(json.dumps(summary))
+        if summary["count"] < args.num_notebooks:
+            print(
+                f"WARNING: only {summary['count']}/{args.num_notebooks} "
+                "notebooks became ready before the timeout",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

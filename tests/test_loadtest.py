@@ -1,0 +1,74 @@
+"""Load-test harness tests (SURVEY.md §2 #23): template rendering parity
+with the reference loadtest (reference
+notebook-controller/loadtest/start_notebooks.py write_notebook_config /
+write_pvc_config) plus the spawn→ready timing capture SURVEY.md §6 adds."""
+
+import yaml
+
+from loadtest.start_notebooks import (
+    load_templates,
+    percentile,
+    render_notebook,
+    render_pvc,
+    run_simulate,
+    summarize,
+)
+
+
+class TestTemplates:
+    def test_render_notebook_renames_everything(self):
+        nb_tmpl, _ = load_templates()
+        nb = render_notebook(nb_tmpl, 7, "loadns")
+        assert nb["metadata"]["name"] == "jupyter-test-7"
+        assert nb["metadata"]["namespace"] == "loadns"
+        spec = nb["spec"]["template"]["spec"]
+        assert spec["containers"][0]["name"] == "notebook-7"
+        claims = [
+            v["persistentVolumeClaim"]["claimName"]
+            for v in spec["volumes"]
+            if "persistentVolumeClaim" in v
+        ]
+        assert claims == ["test-vol-7"]
+        # The template is TPU-flavoured: spec.tpu drives topology.
+        assert nb["spec"]["tpu"]["topology"] == "2x2"
+
+    def test_render_does_not_mutate_template(self):
+        nb_tmpl, pvc_tmpl = load_templates()
+        before = yaml.dump(nb_tmpl)
+        render_notebook(nb_tmpl, 1, "x")
+        render_pvc(pvc_tmpl, 1, "x")
+        assert yaml.dump(nb_tmpl) == before
+
+    def test_render_pvc(self):
+        _, pvc_tmpl = load_templates()
+        pvc = render_pvc(pvc_tmpl, 3, "loadns")
+        assert pvc["metadata"]["name"] == "test-vol-3"
+        assert pvc["metadata"]["namespace"] == "loadns"
+
+
+class TestStats:
+    def test_percentile_interpolates(self):
+        vals = [1.0, 2.0, 3.0, 4.0]
+        assert percentile(vals, 0.0) == 1.0
+        assert percentile(vals, 1.0) == 4.0
+        assert percentile(vals, 0.5) == 2.5
+
+    def test_percentile_degenerate(self):
+        assert percentile([], 0.5) == 0.0
+        assert percentile([7.0], 0.9) == 7.0
+
+    def test_summarize_shape(self):
+        out = summarize({"a": 0.1, "b": 0.3}, "simulate")
+        assert out["metric"] == "notebook_spawn_to_ready_seconds"
+        assert out["count"] == 2
+        assert out["p50"] > 0
+        assert out["max"] >= out["p90"] >= out["p50"]
+
+
+class TestSimulate:
+    def test_all_notebooks_become_ready_with_latency(self):
+        summary = run_simulate(5, pod_latency=0.05, timeout=30.0)
+        assert summary["count"] == 5
+        # The fake kubelet's pod latency is the floor for every sample.
+        assert summary["p50"] >= 0.05
+        assert summary["mode"] == "simulate"
