@@ -8,6 +8,7 @@ writes, the cloud-IAM plugin chain, and finalizer-style revocation.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
 from typing import Protocol
@@ -70,6 +71,123 @@ class WorkloadIdentityPlugin:
             self.iam_binder(
                 spec.get("gcpServiceAccount", ""), self._member(profile), False
             )
+
+
+AWS_ANNOTATION_KEY = "eks.amazonaws.com/role-arn"
+AWS_DEFAULT_AUDIENCE = "sts.amazonaws.com"
+DEFAULT_SERVICE_ACCOUNT = "default-editor"
+
+
+def role_name_from_arn(arn: str) -> str:
+    """``arn:aws:iam::<acct>:role/<name>`` → ``<name>`` (reference
+    plugin_iam.go getIAMRoleNameFromIAMRoleArn)."""
+    return arn[arn.index("/") + 1:] if "/" in arn else arn
+
+
+def issuer_url_from_provider_arn(arn: str) -> str:
+    """``arn:aws:iam::<acct>:oidc-provider/<issuer>`` → ``<issuer>``
+    (reference plugin_iam.go:257-260)."""
+    return arn[arn.index("/") + 1:] if "/" in arn else arn
+
+
+def _edit_trust_policy(
+    policy: dict, namespace: str, sa: str, add: bool
+) -> tuple[dict, bool]:
+    """Add/remove ``system:serviceaccount:<ns>:<sa>`` in the first
+    statement's ``Condition.StringEquals[<issuer>:sub]`` list (the
+    web-identity statement the reference edits — plugin_iam.go
+    addServiceAccountInAssumeRolePolicy/remove...:141-255). Unlike the
+    reference's full-document rebuild, this is an in-place edit: extra
+    statements, non-StringEquals conditions, and custom aud values are
+    preserved. Returns (new_policy, changed)."""
+    new_policy = copy.deepcopy(policy)
+    statements = new_policy.setdefault("Statement", [{}])
+    if not statements:
+        statements.append({})
+    stmt = statements[0]
+    federated = (stmt.get("Principal") or {}).get("Federated", "")
+    issuer = issuer_url_from_provider_arn(federated)
+    sub_key = f"{issuer}:sub"
+    conditions = stmt.setdefault("Condition", {}).setdefault(
+        "StringEquals", {}
+    )
+    subjects = conditions.get(sub_key, [])
+    if isinstance(subjects, str):
+        subjects = [subjects]
+    identity = f"system:serviceaccount:{namespace}:{sa}"
+    if add:
+        if identity in subjects:
+            return policy, False
+        subjects = subjects + [identity]
+        conditions.setdefault(f"{issuer}:aud", [AWS_DEFAULT_AUDIENCE])
+    else:
+        if identity not in subjects:
+            return policy, False
+        subjects = [s for s in subjects if s != identity]
+    conditions[sub_key] = subjects
+    return new_policy, True
+
+
+class AwsIamForServiceAccountPlugin:
+    """IAM Roles for Service Accounts on EKS (reference plugin_iam.go
+    AwsIAMForServiceAccount:22-118): annotates default-editor with the
+    role ARN and inserts the namespace's service account into the role's
+    web-identity trust policy. The AWS API calls are delegated to an
+    injectable client (``get_assume_role_policy(role_name) -> dict``,
+    ``update_assume_role_policy(role_name, policy: dict)``) so tests and
+    non-AWS clusters run without the cloud SDK."""
+
+    name = "AwsIamForServiceAccount"
+
+    def __init__(self, iam_client=None):
+        self.iam_client = iam_client
+
+    def _annotate(self, api, namespace: str, role_arn: str | None) -> None:
+        sa = api.get("v1", "ServiceAccount", DEFAULT_SERVICE_ACCOUNT, namespace)
+        annotations = sa["metadata"].setdefault("annotations", {})
+        if role_arn is None:
+            if AWS_ANNOTATION_KEY not in annotations:
+                return
+            del annotations[AWS_ANNOTATION_KEY]
+        else:
+            if annotations.get(AWS_ANNOTATION_KEY) == role_arn:
+                return
+            annotations[AWS_ANNOTATION_KEY] = role_arn
+        api.update(sa)
+
+    def _edit_iam(self, spec: dict, namespace: str, add: bool) -> None:
+        if spec.get("annotateOnly") or self.iam_client is None:
+            return
+        role = role_name_from_arn(spec["awsIamRole"])
+        policy = self.iam_client.get_assume_role_policy(role)
+        new_policy, changed = _edit_trust_policy(
+            policy, namespace, DEFAULT_SERVICE_ACCOUNT, add
+        )
+        if changed:
+            self.iam_client.update_assume_role_policy(role, new_policy)
+
+    def apply(self, api, profile: dict, spec: dict) -> None:
+        role_arn = spec.get("awsIamRole", "")
+        if not role_arn:
+            raise ValueError(
+                "failed to setup service account because awsIamRole is empty"
+            )
+        ns = profile["metadata"]["name"]
+        self._annotate(api, ns, role_arn)
+        self._edit_iam(spec, ns, add=True)
+
+    def revoke(self, api, profile: dict, spec: dict) -> None:
+        ns = profile["metadata"]["name"]
+        # IAM cleanup first: if the namespace/SA is already gone (cascade
+        # racing the finalizer) the annotation step is a no-op, but the
+        # trust-policy subject must still be removed — a stale subject
+        # would grant a later re-created namespace of the same name
+        # AssumeRoleWithWebIdentity access.
+        self._edit_iam(spec, ns, add=False)
+        try:
+            self._annotate(api, ns, None)
+        except NotFound:
+            pass
 
 
 @dataclasses.dataclass

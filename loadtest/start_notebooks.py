@@ -144,8 +144,15 @@ def run_kubectl(args: argparse.Namespace) -> dict | None:
         nb = render_notebook(nb_tmpl, i, args.namespace)
         pvc = render_pvc(pvc_tmpl, i, args.namespace)
         print(f"kubectl {args.operation} notebook/{nb['metadata']['name']} ...")
-        kubectl_io(pvc, args.operation, args.namespace)
-        kubectl_io(nb, args.operation, args.namespace)
+        if args.operation == "delete":
+            # Notebook first: kubectl delete waits by default, and the
+            # pvc-protection finalizer holds a PVC that a live notebook
+            # pod still mounts.
+            kubectl_io(nb, args.operation, args.namespace)
+            kubectl_io(pvc, args.operation, args.namespace)
+        else:
+            kubectl_io(pvc, args.operation, args.namespace)
+            kubectl_io(nb, args.operation, args.namespace)
         created_at[nb["metadata"]["name"]] = time.monotonic()
     if args.operation != "apply" or not args.wait:
         return None
@@ -242,7 +249,13 @@ def run_simulate(
 
     def kubelet_loop():
         while not stop.is_set():
-            kubelet.step(time.monotonic())
+            try:
+                kubelet.step(time.monotonic())
+            except Exception:
+                # Conflict from racing the controller's own STS update:
+                # the STS stays un-done and is retried next tick. The
+                # thread must survive, or readiness stalls to timeout.
+                pass
             time.sleep(0.002)
 
     kubelet_thread = threading.Thread(target=kubelet_loop, daemon=True)

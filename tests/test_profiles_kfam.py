@@ -7,10 +7,15 @@ import json
 import pytest
 
 from kubeflow_tpu.controllers.profile import (
+    AwsIamForServiceAccountPlugin,
     ProfileOptions,
     WorkloadIdentityPlugin,
+    _edit_trust_policy,
+    issuer_url_from_provider_arn,
     make_profile_controller,
+    role_name_from_arn,
 )
+from kubeflow_tpu.controllers.runtime import Request
 from kubeflow_tpu.crud_backend import AuthnConfig
 from kubeflow_tpu.k8s import FakeApiServer, NotFound
 from kubeflow_tpu.kfam import binding_objects, create_app
@@ -94,6 +99,182 @@ class TestProfileController:
         assert calls[-1] == ("gsa@proj.iam", "serviceAccount:[alice/default-editor]", False)
         with pytest.raises(NotFound):
             api.get(PROFILE_API, "Profile", "alice")
+
+
+OIDC_ARN = (
+    "arn:aws:iam::34892524:oidc-provider/"
+    "oidc.beta.us-west-2.wesley.amazonaws.com/id/50D94CFC65139194EDC21891B611EF72"
+)
+ISSUER = "oidc.beta.us-west-2.wesley.amazonaws.com/id/50D94CFC65139194EDC21891B611EF72"
+
+
+def trust_policy(subjects):
+    return {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Principal": {"Federated": OIDC_ARN},
+                "Action": "sts:AssumeRoleWithWebIdentity",
+                "Condition": {
+                    "StringEquals": {
+                        f"{ISSUER}:aud": ["sts.amazonaws.com"],
+                        f"{ISSUER}:sub": list(subjects),
+                    }
+                },
+            }
+        ],
+    }
+
+
+class FakeIamClient:
+    def __init__(self, policy):
+        self.policies = dict(policy)
+        self.updates = []
+
+    def get_assume_role_policy(self, role):
+        return self.policies[role]
+
+    def update_assume_role_policy(self, role, policy):
+        self.policies[role] = policy
+        self.updates.append(role)
+
+
+class TestAwsIamPlugin:
+    """Mirrors the reference test matrix (reference
+    profile-controller/controllers/plugin_iam_test.go)."""
+
+    ROLE_ARN = "arn:aws:iam::34892524:role/test-iam-role"
+
+    def test_arn_parsers(self):
+        assert role_name_from_arn(self.ROLE_ARN) == "test-iam-role"
+        assert issuer_url_from_provider_arn(OIDC_ARN) == ISSUER
+
+    def test_add_identity_to_trust_policy(self):
+        iam = FakeIamClient({"test-iam-role": trust_policy([])})
+        api = FakeApiServer()
+        ctrl = make_profile_controller(
+            api,
+            plugins={
+                "AwsIamForServiceAccount": AwsIamForServiceAccountPlugin(iam)
+            },
+        )
+        api.create(
+            profile_cr(
+                plugins=[
+                    {"kind": "AwsIamForServiceAccount",
+                     "spec": {"awsIamRole": self.ROLE_ARN}}
+                ]
+            )
+        )
+        ctrl.run_once()
+        sa = api.get("v1", "ServiceAccount", "default-editor", "alice")
+        assert sa["metadata"]["annotations"][
+            "eks.amazonaws.com/role-arn"
+        ] == self.ROLE_ARN
+        subs = iam.policies["test-iam-role"]["Statement"][0]["Condition"][
+            "StringEquals"
+        ][f"{ISSUER}:sub"]
+        assert subs == ["system:serviceaccount:alice:default-editor"]
+
+        # Level-based reconcile: a second pass is a no-op (reference
+        # ConditionExistError path — no duplicate, no extra update call).
+        updates_before = list(iam.updates)
+        ctrl.reconciler.reconcile(Request("", "alice"))
+        assert iam.updates == updates_before
+
+        # Deletion revokes: annotation gone, subject removed.
+        api.delete(PROFILE_API, "Profile", "alice")
+        ctrl.run_once()
+        subs = iam.policies["test-iam-role"]["Statement"][0]["Condition"][
+            "StringEquals"
+        ][f"{ISSUER}:sub"]
+        assert subs == []
+
+    def test_existing_identities_preserved(self):
+        policy = trust_policy(["system:serviceaccount:other:default-editor"])
+        new_policy, changed = _edit_trust_policy(
+            policy, "alice", "default-editor", add=True
+        )
+        assert changed
+        subs = new_policy["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:sub"
+        ]
+        assert subs == [
+            "system:serviceaccount:other:default-editor",
+            "system:serviceaccount:alice:default-editor",
+        ]
+        # aud is always (re)asserted, as in the reference rebuild.
+        assert new_policy["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:aud"
+        ] == ["sts.amazonaws.com"]
+
+    def test_extra_statements_and_custom_aud_preserved(self):
+        policy = trust_policy([])
+        policy["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:aud"
+        ] = ["custom-audience"]
+        policy["Statement"].append(
+            {"Effect": "Allow", "Principal": {"Service": "ec2.amazonaws.com"},
+             "Action": "sts:AssumeRole"}
+        )
+        new_policy, changed = _edit_trust_policy(
+            policy, "alice", "default-editor", add=True
+        )
+        assert changed
+        # In-place edit, not the reference's destructive rebuild: the EC2
+        # trust statement and the custom audience survive.
+        assert new_policy["Statement"][1]["Principal"] == {
+            "Service": "ec2.amazonaws.com"
+        }
+        assert new_policy["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:aud"
+        ] == ["custom-audience"]
+        # Input is not mutated.
+        assert policy["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:sub"
+        ] == []
+
+    def test_remove_absent_identity_is_noop(self):
+        policy = trust_policy(["system:serviceaccount:other:default-editor"])
+        _, changed = _edit_trust_policy(
+            policy, "alice", "default-editor", add=False
+        )
+        assert not changed
+
+    def test_annotate_only_skips_iam(self):
+        iam = FakeIamClient({"test-iam-role": trust_policy([])})
+        api = FakeApiServer()
+        ctrl = make_profile_controller(
+            api,
+            plugins={
+                "AwsIamForServiceAccount": AwsIamForServiceAccountPlugin(iam)
+            },
+        )
+        api.create(
+            profile_cr(
+                plugins=[
+                    {"kind": "AwsIamForServiceAccount",
+                     "spec": {"awsIamRole": self.ROLE_ARN,
+                              "annotateOnly": True}}
+                ]
+            )
+        )
+        ctrl.run_once()
+        sa = api.get("v1", "ServiceAccount", "default-editor", "alice")
+        assert sa["metadata"]["annotations"][
+            "eks.amazonaws.com/role-arn"
+        ] == self.ROLE_ARN
+        assert iam.updates == []
+
+    def test_empty_role_arn_raises(self):
+        plugin = AwsIamForServiceAccountPlugin()
+        with pytest.raises(ValueError):
+            plugin.apply(
+                FakeApiServer(),
+                {"metadata": {"name": "alice"}},
+                {"awsIamRole": ""},
+            )
 
 
 USER = {"kubeflow-userid": "alice@example.com"}
