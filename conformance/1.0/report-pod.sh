@@ -1,0 +1,15 @@
+#!/bin/sh
+# Runs against a conformance pod ($1). Waits for the done file ($2) to
+# appear, then copies out the test report ($3)
+# (reference conformance/1.7/report-pod.sh).
+
+until kubectl exec "$1" -n kf-conformance -- ls "$2"
+do
+    sleep 30
+    echo "Waiting for $1 to finish ..."
+done
+
+REPORT_PATH=/tmp/kf-conformance/$(basename "$3")
+kubectl cp "kf-conformance/$1:$3" "$REPORT_PATH"
+
+echo "Test report copied to $REPORT_PATH"

@@ -1,0 +1,2 @@
+"""Conformance harness (SURVEY.md §2 #21): in-cluster jobs under
+``conformance/1.0`` and the in-process runner in ``run_local``."""

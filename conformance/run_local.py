@@ -1,0 +1,151 @@
+"""In-process conformance: the cluster jobs' checks, run against the
+in-memory stack.
+
+The reference conformance harness (reference conformance/1.7/Makefile)
+only runs in a live cluster. This runner executes the same certification
+scenario — profile materialisation, TPU notebook spawn to ready, PodDefault
+TPU-env injection — against the real controllers + native core + fake
+apiserver, so `make -C conformance/1.0 local` (and CI) can certify a build
+with no cluster. Each check returns a (name, passed, detail) tuple; the
+process exits non-zero if any check fails.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+SETUP = REPO / "conformance" / "1.0" / "setup.yaml"
+
+
+def check_profile(api, docs) -> tuple[str, bool, str]:
+    """setup.yaml's Profile → namespace + SAs + owner binding + quota
+    (the platform side of reference conformance setup)."""
+    from kubeflow_tpu.controllers.profile import make_profile_controller
+
+    profile = next(d for d in docs if d["kind"] == "Profile")
+    ctrl = make_profile_controller(api)
+    api.create(profile)
+    ctrl.run_once()
+    ns = profile["metadata"]["name"]
+    try:
+        api.get("v1", "Namespace", ns)
+        api.get("v1", "ServiceAccount", "default-editor", ns)
+        api.get("rbac.authorization.k8s.io/v1", "RoleBinding", "namespaceAdmin", ns)
+        quota = api.get("v1", "ResourceQuota", "kf-resource-quota", ns)
+    except Exception as e:  # NotFound
+        return ("profile-conformance", False, str(e))
+    hard = quota["spec"]["hard"]
+    if hard.get("google.com/tpu") != "4":
+        return ("profile-conformance", False, f"TPU quota missing: {hard}")
+    return ("profile-conformance", True, f"namespace {ns} materialised")
+
+
+def check_notebook(api, namespace: str) -> tuple[str, bool, str]:
+    """TPU Notebook CR → ready STS with google.com/tpu limits + GKE
+    topology selectors (the notebook-conformance.yaml job's check)."""
+    from kubeflow_tpu.controllers.notebook import make_notebook_controller
+    from loadtest.start_notebooks import FakeKubelet
+    import time
+
+    ctrl = make_notebook_controller(api)
+    api.create(
+        {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "conformance-nb", "namespace": namespace},
+            "spec": {
+                "tpu": {"accelerator": "v5e", "topology": "4x4", "replicas": 4},
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "conformance-nb",
+                                "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    )
+    ctrl.run_once()
+    kubelet = FakeKubelet(api)
+    kubelet.step(time.monotonic())
+    ctrl.run_once()
+    sts = api.get("apps/v1", "StatefulSet", "conformance-nb", namespace)
+    tmpl = sts["spec"]["template"]["spec"]
+    limits = tmpl["containers"][0].get("resources", {}).get("limits", {})
+    selectors = tmpl.get("nodeSelector", {})
+    nb = api.get("kubeflow.org/v1beta1", "Notebook", "conformance-nb", namespace)
+    env_names = {
+        e["name"] for e in tmpl["containers"][0].get("env", [])
+    }
+    checks = {
+        "replicas=4": sts["spec"]["replicas"] == 4,
+        "tpu-limit": limits.get("google.com/tpu") == "4",
+        "gke-topology": selectors.get("cloud.google.com/gke-tpu-topology") == "4x4",
+        "worker-id-env": "TPU_WORKER_ID" in env_names,
+        "coordinator-env": "KFT_COORDINATOR_ADDRESS" in env_names,
+        "ready": nb.get("status", {}).get("readyReplicas", 0) == 4,
+    }
+    failed = [k for k, ok in checks.items() if not ok]
+    if failed:
+        return ("notebook-conformance", False, f"failed: {failed}")
+    return ("notebook-conformance", True, "v5e-16 notebook spawned to ready")
+
+
+def check_poddefault(api, namespace: str) -> tuple[str, bool, str]:
+    """A pod created in the profile namespace gets the TPU distributed env
+    injected (the tpu-conformance.yaml job relies on this)."""
+    from kubeflow_tpu.webhook.server import register_with_fake, tpu_env_poddefault
+
+    register_with_fake(api)
+    api.create(tpu_env_poddefault(namespace))
+    api.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "tpu-workload",
+                "namespace": namespace,
+                "labels": {"tpu-env": "true"},
+            },
+            "spec": {"containers": [{"name": "main", "image": "x"}]},
+        }
+    )
+    pod = api.get("v1", "Pod", "tpu-workload", namespace)
+    env = {
+        e["name"]: e.get("value")
+        for c in pod["spec"]["containers"]
+        for e in c.get("env", [])
+    }
+    tolerations = pod["spec"].get("tolerations", [])
+    if env.get("JAX_PLATFORMS") != "tpu,cpu":
+        return ("poddefault-conformance", False, f"env injected: {env}")
+    if not any(t.get("key") == "google.com/tpu" for t in tolerations):
+        return ("poddefault-conformance", False, "TPU toleration not injected")
+    return ("poddefault-conformance", True, "TPU env + toleration injected")
+
+
+def main() -> int:
+    from kubeflow_tpu.k8s import FakeApiServer
+
+    docs = [d for d in yaml.safe_load_all(SETUP.read_text()) if d]
+    api = FakeApiServer()
+    results = [check_profile(api, docs)]
+    ns = next(d for d in docs if d["kind"] == "Profile")["metadata"]["name"]
+    results.append(check_notebook(api, ns))
+    results.append(check_poddefault(api, ns))
+    ok = True
+    for name, passed, detail in results:
+        print(f"{'PASS' if passed else 'FAIL'} {name}: {detail}")
+        ok = ok and passed
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,12 +76,18 @@ class WorkloadIdentityPlugin:
 AWS_ANNOTATION_KEY = "eks.amazonaws.com/role-arn"
 AWS_DEFAULT_AUDIENCE = "sts.amazonaws.com"
 DEFAULT_SERVICE_ACCOUNT = "default-editor"
+# Subject that can never appear in a real token (namespace is empty):
+# written when the last trusted subject is revoked.
+NO_TRUST_SENTINEL = "system:serviceaccount::none"
 
 
 def role_name_from_arn(arn: str) -> str:
-    """``arn:aws:iam::<acct>:role/<name>`` → ``<name>`` (reference
-    plugin_iam.go getIAMRoleNameFromIAMRoleArn)."""
-    return arn[arn.index("/") + 1:] if "/" in arn else arn
+    """``arn:aws:iam::<acct>:role/<path>/<name>`` → ``<name>``. IAM's
+    RoleName parameter excludes the path, so take the last segment
+    (deliberate divergence from the reference's first-'/' split,
+    plugin_iam.go getIAMRoleNameFromIAMRoleArn, which breaks on roles
+    created under an IAM path)."""
+    return arn.rsplit("/", 1)[-1]
 
 
 def issuer_url_from_provider_arn(arn: str) -> str:
@@ -101,11 +107,23 @@ def _edit_trust_policy(
     statements, non-StringEquals conditions, and custom aud values are
     preserved. Returns (new_policy, changed)."""
     new_policy = copy.deepcopy(policy)
-    statements = new_policy.setdefault("Statement", [{}])
-    if not statements:
-        statements.append({})
-    stmt = statements[0]
-    federated = (stmt.get("Principal") or {}).get("Federated", "")
+    # The web-identity statement is the one with a Federated principal —
+    # not necessarily Statement[0] (an EC2 trust statement may precede it).
+    stmt = next(
+        (
+            s
+            for s in new_policy.get("Statement", [])
+            if (s.get("Principal") or {}).get("Federated")
+        ),
+        None,
+    )
+    if stmt is None:
+        if not add:
+            return policy, False  # nothing to revoke
+        raise ValueError(
+            "trust policy has no web-identity (Federated) statement to edit"
+        )
+    federated = stmt["Principal"]["Federated"]
     issuer = issuer_url_from_provider_arn(federated)
     sub_key = f"{issuer}:sub"
     conditions = stmt.setdefault("Condition", {}).setdefault(
@@ -118,12 +136,19 @@ def _edit_trust_policy(
     if add:
         if identity in subjects:
             return policy, False
-        subjects = subjects + [identity]
+        subjects = [s for s in subjects if s != NO_TRUST_SENTINEL] + [identity]
         conditions.setdefault(f"{issuer}:aud", [AWS_DEFAULT_AUDIENCE])
     else:
         if identity not in subjects:
             return policy, False
         subjects = [s for s in subjects if s != identity]
+        if not subjects:
+            # IAM rejects empty condition lists (MalformedPolicyDocument),
+            # and dropping the :sub key entirely would leave an aud-only
+            # condition that ANY service account's token could satisfy.
+            # Pin a subject that can never match (namespaces are nonempty
+            # in real tokens) so the statement is a safe deny.
+            subjects = [NO_TRUST_SENTINEL]
     conditions[sub_key] = subjects
     return new_policy, True
 

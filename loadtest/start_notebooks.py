@@ -34,9 +34,12 @@ import subprocess
 import sys
 import threading
 import time
+import traceback
 from pathlib import Path
 
 import yaml
+
+from kubeflow_tpu.k8s.fake import Conflict
 
 HERE = Path(__file__).resolve().parent
 
@@ -247,15 +250,23 @@ def run_simulate(
     latencies: dict[str, float] = {}
     stop = threading.Event()
 
+    logged_errors: set[str] = set()
+
     def kubelet_loop():
         while not stop.is_set():
             try:
                 kubelet.step(time.monotonic())
-            except Exception:
-                # Conflict from racing the controller's own STS update:
-                # the STS stays un-done and is retried next tick. The
-                # thread must survive, or readiness stalls to timeout.
+            except Conflict:
+                # Racing the controller's own STS update: the STS stays
+                # un-done and is retried next tick.
                 pass
+            except Exception:
+                # A real bug must not kill the thread (readiness would
+                # stall to timeout) but must also not be silent.
+                err = traceback.format_exc()
+                if err not in logged_errors:
+                    logged_errors.add(err)
+                    print(f"fake kubelet error:\n{err}", file=sys.stderr)
             time.sleep(0.002)
 
     kubelet_thread = threading.Thread(target=kubelet_loop, daemon=True)

@@ -148,7 +148,61 @@ class TestAwsIamPlugin:
 
     def test_arn_parsers(self):
         assert role_name_from_arn(self.ROLE_ARN) == "test-iam-role"
+        # IAM RoleName excludes the path: last segment, not first-'/' split.
+        assert role_name_from_arn(
+            "arn:aws:iam::1:role/eng/notebook-role"
+        ) == "notebook-role"
         assert issuer_url_from_provider_arn(OIDC_ARN) == ISSUER
+
+    def test_federated_statement_found_when_not_first(self):
+        policy = trust_policy(["system:serviceaccount:bob:default-editor"])
+        policy["Statement"].insert(
+            0,
+            {"Effect": "Allow", "Principal": {"Service": "ec2.amazonaws.com"},
+             "Action": "sts:AssumeRole"},
+        )
+        new_policy, changed = _edit_trust_policy(
+            policy, "alice", "default-editor", add=True
+        )
+        assert changed
+        # The EC2 statement is untouched; the edit landed on the
+        # web-identity statement.
+        assert "Condition" not in new_policy["Statement"][0]
+        subs = new_policy["Statement"][1]["Condition"]["StringEquals"][
+            f"{ISSUER}:sub"
+        ]
+        assert "system:serviceaccount:alice:default-editor" in subs
+
+    def test_no_federated_statement(self):
+        ec2_only = {
+            "Version": "2012-10-17",
+            "Statement": [
+                {"Effect": "Allow",
+                 "Principal": {"Service": "ec2.amazonaws.com"},
+                 "Action": "sts:AssumeRole"}
+            ],
+        }
+        _, changed = _edit_trust_policy(ec2_only, "a", "sa", add=False)
+        assert not changed
+        with pytest.raises(ValueError):
+            _edit_trust_policy(ec2_only, "a", "sa", add=True)
+
+    def test_sentinel_replaced_on_next_add(self):
+        policy = trust_policy(["system:serviceaccount:alice:default-editor"])
+        removed, _ = _edit_trust_policy(
+            policy, "alice", "default-editor", add=False
+        )
+        subs = removed["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:sub"
+        ]
+        assert subs == ["system:serviceaccount::none"]
+        readded, _ = _edit_trust_policy(
+            removed, "bob", "default-editor", add=True
+        )
+        subs = readded["Statement"][0]["Condition"]["StringEquals"][
+            f"{ISSUER}:sub"
+        ]
+        assert subs == ["system:serviceaccount:bob:default-editor"]
 
     def test_add_identity_to_trust_policy(self):
         iam = FakeIamClient({"test-iam-role": trust_policy([])})
@@ -183,13 +237,15 @@ class TestAwsIamPlugin:
         ctrl.reconciler.reconcile(Request("", "alice"))
         assert iam.updates == updates_before
 
-        # Deletion revokes: annotation gone, subject removed.
+        # Deletion revokes: annotation gone, subject removed. The last
+        # revoke pins the never-matching sentinel — IAM rejects empty
+        # condition lists, and an aud-only condition would trust ANY SA.
         api.delete(PROFILE_API, "Profile", "alice")
         ctrl.run_once()
         subs = iam.policies["test-iam-role"]["Statement"][0]["Condition"][
             "StringEquals"
         ][f"{ISSUER}:sub"]
-        assert subs == []
+        assert subs == ["system:serviceaccount::none"]
 
     def test_existing_identities_preserved(self):
         policy = trust_policy(["system:serviceaccount:other:default-editor"])

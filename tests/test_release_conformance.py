@@ -1,0 +1,90 @@
+"""Releasing + conformance harness tests (SURVEY.md §2 #21, #22)."""
+
+import importlib.machinery
+import importlib.util
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def load_updater():
+    # The script has no .py suffix, so name the loader explicitly.
+    loader = importlib.machinery.SourceFileLoader(
+        "update_manifests_images",
+        str(REPO / "releasing" / "update-manifests-images"),
+    )
+    spec = importlib.util.spec_from_loader(loader.name, loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+class TestReleasing:
+    def test_version_file(self):
+        version = (REPO / "releasing" / "version" / "VERSION").read_text().strip()
+        assert version.count(".") == 2
+
+    def test_retag_rewrites_only_registry_images(self):
+        mod = load_updater()
+        text = (
+            "image: ghcr.io/kubeflow-tpu/notebook-controller:latest\n"
+            "other: ghcr.io/elsewhere/thing:latest\n"
+            "value: ghcr.io/kubeflow-tpu/jupyter-jax-tpu:v1.0.0\n"
+        )
+        out, count = mod.retag(text, "ghcr.io/kubeflow-tpu", "v9")
+        assert count == 2
+        assert "notebook-controller:v9" in out
+        assert "jupyter-jax-tpu:v9" in out
+        assert "ghcr.io/elsewhere/thing:latest" in out
+
+    def test_update_tree_on_copy(self, tmp_path):
+        # Copy the real manifests and retag the copy; the originals and
+        # their formatting/comments must be untouched by design.
+        root = tmp_path / "repo"
+        shutil.copytree(REPO / "manifests", root / "manifests")
+        mod = load_updater()
+        changed = mod.update_tree(root, "ghcr.io/kubeflow-tpu", "v2.0.0")
+        assert changed
+        dep = (root / "manifests" / "notebook-controller" / "base" /
+               "deployment.yaml").read_text()
+        assert "ghcr.io/kubeflow-tpu/notebook-controller:v2.0.0" in dep
+
+    def test_cli_exits_nonzero_when_nothing_matches(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "releasing" / "update-manifests-images"),
+             "v1", "--root", str(tmp_path)],
+            capture_output=True,
+        )
+        assert proc.returncode == 1
+
+
+class TestConformance:
+    def test_setup_yaml_parses_and_matches_stack(self):
+        docs = [
+            d for d in yaml.safe_load_all(
+                (REPO / "conformance" / "1.0" / "setup.yaml").read_text()
+            ) if d
+        ]
+        kinds = [d["kind"] for d in docs]
+        assert kinds == ["Profile", "ServiceAccount", "RoleBinding"]
+        profile = docs[0]
+        assert profile["apiVersion"] == "kubeflow.org/v1"
+        assert profile["spec"]["resourceQuotaSpec"]["hard"]["google.com/tpu"] == "4"
+
+    def test_local_conformance_passes(self):
+        from conformance.run_local import main
+
+        assert main() == 0
+
+    def test_job_manifests_parse(self):
+        for name in ["notebook-conformance.yaml", "tpu-conformance.yaml"]:
+            doc = yaml.safe_load(
+                (REPO / "conformance" / "1.0" / name).read_text()
+            )
+            assert doc["kind"] == "Pod"
+            assert doc["metadata"]["namespace"] == "kf-conformance"
