@@ -18,7 +18,12 @@ from pathlib import Path
 import yaml
 
 REPO = Path(__file__).resolve().parent.parent
-SETUP = REPO / "conformance" / "1.0" / "setup.yaml"
+# profile.yaml (the Profile) + setup.yaml (namespaced SA/RoleBinding,
+# applied after the namespace exists in the cluster flow).
+SETUP_DOCS = [
+    REPO / "conformance" / "1.0" / "profile.yaml",
+    REPO / "conformance" / "1.0" / "setup.yaml",
+]
 
 
 def check_profile(api, docs) -> tuple[str, bool, str]:
@@ -134,7 +139,12 @@ def check_poddefault(api, namespace: str) -> tuple[str, bool, str]:
 def main() -> int:
     from kubeflow_tpu.k8s import FakeApiServer
 
-    docs = [d for d in yaml.safe_load_all(SETUP.read_text()) if d]
+    docs = [
+        d
+        for path in SETUP_DOCS
+        for d in yaml.safe_load_all(path.read_text())
+        if d
+    ]
     api = FakeApiServer()
     results = [check_profile(api, docs)]
     ns = next(d for d in docs if d["kind"] == "Profile")["metadata"]["name"]

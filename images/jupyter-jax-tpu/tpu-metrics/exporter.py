@@ -1,0 +1,61 @@
+"""TPU duty-cycle exporter: Prometheus text on :8431/metrics.
+
+The TPU-native replacement for "is anything using the accelerator?"
+signals the reference platform never had (its culler only probes Jupyter
+/api/kernels — reference culling_controller.go:202-241). The platform
+culler scrapes this endpoint via the rank-0 pod's headless-service DNS
+and vetoes culling while the TensorCore is busy
+(kubeflow_tpu/controllers/culling.py http_tpu_busy_probe).
+
+Duty cycle is read from the libtpu monitoring SDK when present
+(libtpu.sdk.tpumonitoring, shipped with jax[tpu]); when the SDK or a TPU
+is absent (CPU dev image, unit tests) the exporter serves 0.0 so the
+kernel-idleness signal alone decides.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+
+
+def read_duty_cycle_pct() -> float:
+    try:
+        from libtpu.sdk import tpumonitoring  # type: ignore
+
+        metric = tpumonitoring.get_metric("duty_cycle_pct")
+        return max((float(v) for v in metric.data), default=0.0)
+    except Exception:
+        return 0.0
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        if self.path != "/metrics":
+            self.send_response(404)
+            self.end_headers()
+            return
+        duty = read_duty_cycle_pct()
+        body = (
+            "# HELP tpu_duty_cycle_percent TensorCore duty cycle over the "
+            "last sample window\n"
+            "# TYPE tpu_duty_cycle_percent gauge\n"
+            f"tpu_duty_cycle_percent {duty}\n"
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def main():
+    port = int(os.environ.get("TPU_METRICS_PORT", "8431"))
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,64 @@ def http_kernel_probe(timeout: float = 5.0) -> KernelProbe:
     return probe
 
 
+def http_tpu_busy_probe(
+    threshold_pct: float = 5.0,
+    port: int = 8431,
+    timeout: float = 5.0,
+    cluster_domain: str = "cluster.local",
+) -> Callable[[str, str], bool]:
+    """TPU-idle signal (SURVEY §7 hard part d): a raw JAX process has no
+    ``/api/kernels``, so the culler also scrapes the duty-cycle exporter
+    the jupyter-jax-tpu image runs on every host
+    (images/jupyter-jax-tpu/s6/services.d/tpu-metrics) via the rank-0
+    pod's stable headless-service DNS. Busy (=veto culling) when the
+    TensorCore duty cycle exceeds ``threshold_pct``; unreachable or
+    unparsable metrics count as not-busy so a wedged exporter cannot pin
+    a slice forever (kernel-idleness still gates the actual stop)."""
+    import urllib.request
+
+    def probe(namespace: str, name: str) -> bool:
+        url = (
+            f"http://{name}-0.{name}-hosts.{namespace}.svc.{cluster_domain}"
+            f":{port}/metrics"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                text = resp.read().decode()
+        except Exception:
+            return False
+        return parse_duty_cycle(text) > threshold_pct
+
+    return probe
+
+
+def parse_duty_cycle(metrics_text: str) -> float:
+    """Max ``tpu_duty_cycle_percent`` sample from Prometheus text
+    exposition (one series per chip). Only that exact metric name is
+    matched (not name-prefix extensions), and the value is the field
+    right after the name+labels — a trailing exposition timestamp is
+    ignored."""
+    best = 0.0
+    for line in metrics_text.splitlines():
+        line = line.strip()
+        name, _, rest = line.partition("{")
+        if rest:  # labelled series: value follows the closing brace
+            rest = rest.partition("}")[2]
+        else:
+            name, _, rest = line.partition(" ")
+        if name.strip() != "tpu_duty_cycle_percent":
+            continue
+        fields = rest.split()
+        if not fields:
+            continue
+        try:
+            value = float(fields[0])
+        except ValueError:
+            continue
+        best = max(best, value)
+    return best
+
+
 @dataclasses.dataclass
 class CullingOptions:
     """ENABLE_CULLING / CULL_IDLE_TIME / IDLENESS_CHECK_PERIOD env parity
@@ -74,12 +132,14 @@ class CullingReconciler:
         options: CullingOptions | None = None,
         tpu_busy_probe: Callable[[str, str], bool] | None = None,
         clock: Callable[[], float] = time.time,
+        prom=None,  # optional ControllerMetrics (metrics.py)
     ):
         self.api = api
         self.kernel_probe = kernel_probe
         self.options = options or CullingOptions()
         self.tpu_busy_probe = tpu_busy_probe
         self.clock = clock
+        self.prom = prom
 
     def reconcile(self, req: Request) -> float | None:
         if not self.options.enabled:
@@ -139,6 +199,15 @@ class CullingReconciler:
             )
             if decision["action"] == "stop":
                 log.info("culled idle notebook %s/%s", req.namespace, req.name)
+                if self.prom is not None:
+                    # Reference NotebookCullingCount + culling-timestamp
+                    # gauge (metrics.go:46-59).
+                    self.prom.notebook_culling_total.labels(
+                        req.namespace, req.name
+                    ).inc()
+                    self.prom.last_culling_timestamp.labels(
+                        req.namespace, req.name
+                    ).set(int(self.clock()))
         return float(decision["requeueAfterSec"])
 
 
@@ -148,6 +217,7 @@ def make_culling_controller(
     options: CullingOptions | None = None,
     tpu_busy_probe: Callable[[str, str], bool] | None = None,
     clock: Callable[[], float] = time.time,
+    prom=None,
 ) -> Controller:
     reconciler = CullingReconciler(
         api,
@@ -155,6 +225,7 @@ def make_culling_controller(
         options,
         tpu_busy_probe,
         clock,
+        prom=prom,
     )
     return Controller(
         name="culling-controller",
@@ -162,4 +233,5 @@ def make_culling_controller(
         reconciler=reconciler,
         watches=[WatchSpec(NOTEBOOK_API, "Notebook")],
         resync_period=60.0,
+        prom=prom,
     )

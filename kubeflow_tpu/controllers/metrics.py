@@ -1,0 +1,205 @@
+"""Controller-manager observability: Prometheus metrics + health endpoints.
+
+Capability parity with the reference controller metrics
+(reference notebook-controller/pkg/metrics/metrics.go:22-99 — the
+`notebook_running` gauge is computed by scraping the StatefulSet list at
+collect time; create/cull counters are event-driven — and
+profile-controller/controllers/monitoring.go:25-60 — request/heartbeat
+counters) plus the manager's healthz/readyz endpoints
+(reference notebook-controller/main.go:124-132).
+
+Everything hangs off one ``ControllerMetrics`` registry that a manager
+process shares across its controllers, exposed by ``ManagerServer`` on
+``/metrics`` (Prometheus text exposition), ``/healthz`` and ``/readyz``.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Callable, Iterable
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    generate_latest,
+)
+from prometheus_client.core import GaugeMetricFamily
+
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+
+class RunningNotebooksCollector:
+    """`notebook_running{namespace}` — recomputed from the live
+    StatefulSet list at every scrape, exactly like the reference's
+    collect-time scrape (metrics.go:82-99): an STS counts as a running
+    notebook when its pod-template label ``notebook-name`` equals its own
+    name."""
+
+    def __init__(self, api: FakeApiServer):
+        self.api = api
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        fam = GaugeMetricFamily(
+            "notebook_running",
+            "Current running notebooks in the cluster",
+            labels=["namespace"],
+        )
+        per_ns: dict[str, int] = {}
+        for sts in self.api.list("apps/v1", "StatefulSet"):
+            labels = (
+                ((sts.get("spec") or {}).get("template") or {})
+                .get("metadata", {})
+                .get("labels", {})
+            ) or {}
+            if labels.get("notebook-name") == sts["metadata"]["name"]:
+                ns = sts["metadata"].get("namespace", "")
+                per_ns[ns] = per_ns.get(ns, 0) + 1
+        for ns, count in sorted(per_ns.items()):
+            fam.add_metric([ns], count)
+        yield fam
+
+
+class QueueDepthCollector:
+    """`workqueue_depth{controller}` over the manager's controllers —
+    the controller-runtime workqueue metric equivalent."""
+
+    def __init__(self, controllers: Iterable):
+        self.controllers = list(controllers)
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        fam = GaugeMetricFamily(
+            "workqueue_depth",
+            "Pending reconcile requests per controller",
+            labels=["controller"],
+        )
+        for ctrl in self.controllers:
+            fam.add_metric([ctrl.name], len(ctrl.queue))
+        yield fam
+
+
+class ControllerMetrics:
+    """The manager-wide registry plus the event-driven counters the
+    reconcilers increment."""
+
+    def __init__(self, api: FakeApiServer | None = None):
+        self.registry = CollectorRegistry()
+        if api is not None:
+            self.registry.register(RunningNotebooksCollector(api))
+        self.notebook_create_total = Counter(
+            "notebook_create",
+            "Total times of creating notebooks",
+            ["namespace"],
+            registry=self.registry,
+        )
+        self.notebook_create_failed_total = Counter(
+            "notebook_create_failed",
+            "Total failure times of creating notebooks",
+            ["namespace"],
+            registry=self.registry,
+        )
+        self.notebook_culling_total = Counter(
+            "notebook_culling",
+            "Total times of culling notebooks",
+            ["namespace", "name"],
+            registry=self.registry,
+        )
+        self.last_culling_timestamp = Gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds",
+            ["namespace", "name"],
+            registry=self.registry,
+        )
+        self.request_total = Counter(
+            "request_kf",
+            "Number of reconcile-driven API requests",
+            ["component", "kind"],
+            registry=self.registry,
+        )
+        self.request_failure_total = Counter(
+            "request_kf_failure",
+            "Number of failed reconcile-driven API requests",
+            ["component", "kind", "severity"],
+            registry=self.registry,
+        )
+        self.service_heartbeat = Counter(
+            "service_heartbeat",
+            "Heartbeat signal indicating the manager is alive",
+            ["component", "severity"],
+            registry=self.registry,
+        )
+        self.reconcile_total = Counter(
+            "controller_reconcile",
+            "Reconcile invocations per controller and result",
+            ["controller", "result"],
+            registry=self.registry,
+        )
+
+    def watch_controllers(self, controllers: Iterable) -> None:
+        self.registry.register(QueueDepthCollector(controllers))
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class ManagerServer:
+    """Threaded HTTP server for /metrics, /healthz, /readyz (reference
+    main.go:124-132 health endpoints + controller-runtime's metrics
+    listener). ``ready`` is the manager's initial-sync signal."""
+
+    def __init__(
+        self,
+        metrics: ControllerMetrics,
+        port: int = 0,
+        ready: Callable[[], bool] | None = None,
+    ):
+        self.metrics = metrics
+        self.ready = ready or (lambda: True)
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = outer.metrics.exposition()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                elif self.path == "/readyz":
+                    ok = outer.ready()
+                    self.send_response(200 if ok else 503)
+                    self.end_headers()
+                    self.wfile.write(b"ok" if ok else b"not ready")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="manager-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

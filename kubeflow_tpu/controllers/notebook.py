@@ -60,12 +60,18 @@ def pod_to_notebook_requests(obj: dict) -> list[Request]:
 
 
 class NotebookReconciler:
-    def __init__(self, api: FakeApiServer, options: NotebookOptions | None = None):
+    def __init__(
+        self,
+        api: FakeApiServer,
+        options: NotebookOptions | None = None,
+        prom=None,  # optional ControllerMetrics (metrics.py)
+    ):
         self.api = api
         self.options = options or NotebookOptions()
+        self.prom = prom
 
-    def _ensure(self, desired: dict) -> None:
-        ensure_object(self.api, desired)
+    def _ensure(self, desired: dict) -> str:
+        return ensure_object(self.api, desired)
 
     def reconcile(self, req: Request) -> float | None:
         try:
@@ -80,7 +86,18 @@ class NotebookReconciler:
             "notebook_reconcile",
             {"notebook": notebook, "options": self.options.to_native()},
         )
-        self._ensure(out["statefulset"])
+        try:
+            sts_result = self._ensure(out["statefulset"])
+        except Exception:
+            if self.prom is not None:
+                self.prom.notebook_create_failed_total.labels(
+                    req.namespace
+                ).inc()
+            raise
+        if sts_result == "created" and self.prom is not None:
+            # Counts new notebook materialisations, like the reference's
+            # NotebookCreation counter on first STS create.
+            self.prom.notebook_create_total.labels(req.namespace).inc()
         for svc in out["services"]:
             self._ensure(svc)
         if out["virtualService"] is not None:
@@ -130,9 +147,11 @@ class NotebookReconciler:
 
 
 def make_notebook_controller(
-    api: FakeApiServer, options: NotebookOptions | None = None
+    api: FakeApiServer,
+    options: NotebookOptions | None = None,
+    prom=None,
 ) -> Controller:
-    reconciler = NotebookReconciler(api, options)
+    reconciler = NotebookReconciler(api, options, prom=prom)
     return Controller(
         name="notebook-controller",
         api=api,
@@ -142,4 +161,5 @@ def make_notebook_controller(
             WatchSpec("apps/v1", "StatefulSet", pod_to_notebook_requests),
             WatchSpec("v1", "Pod", pod_to_notebook_requests),
         ],
+        prom=prom,
     )

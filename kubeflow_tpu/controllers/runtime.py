@@ -94,9 +94,11 @@ class WorkQueue:
             return len(self._pending)
 
 
-def ensure_object(api, desired: dict) -> None:
+def ensure_object(api, desired: dict) -> str:
     """Create-or-update through the native drift repair: writes only when
-    an owned field differs (shared by every controller)."""
+    an owned field differs (shared by every controller). Returns what
+    happened — "created" / "updated" / "unchanged" — so callers can feed
+    the create counters (reference metrics.go NotebookCreation)."""
     from kubeflow_tpu import native
     from kubeflow_tpu.k8s.fake import NotFound
 
@@ -108,7 +110,7 @@ def ensure_object(api, desired: dict) -> None:
         )
     except NotFound:
         api.create(desired)
-        return
+        return "created"
     merged = native.invoke(
         "copy_owned_fields",
         {"kind": desired["kind"], "existing": existing, "desired": desired},
@@ -117,6 +119,8 @@ def ensure_object(api, desired: dict) -> None:
         # A Conflict (stale read) propagates; the queue's rate limiter
         # retries the key.
         api.update(merged["merged"])
+        return "updated"
+    return "unchanged"
 
 
 @dataclass
@@ -138,12 +142,14 @@ class Controller:
         reconciler: Reconciler,
         watches: list[WatchSpec],
         resync_period: float = 300.0,
+        prom=None,  # optional ControllerMetrics for Prometheus exposition
     ):
         self.name = name
         self.api = api
         self.reconciler = reconciler
         self.queue = WorkQueue()
         self.resync_period = resync_period
+        self.prom = prom
         self._watch_queues = []
         for spec in watches:
             q = api.watch(spec.api_version, spec.kind)
@@ -178,8 +184,12 @@ class Controller:
         except Exception:
             log.exception("%s: reconcile %s failed", self.name, req)
             self.metrics["errors"] += 1
+            if self.prom is not None:
+                self.prom.reconcile_total.labels(self.name, "error").inc()
             self.queue.add_rate_limited(req)
             return True
+        if self.prom is not None:
+            self.prom.reconcile_total.labels(self.name, "success").inc()
         self.queue.forget(req)
         if requeue_after is not None:
             self.metrics["requeues"] += 1

@@ -1,0 +1,200 @@
+"""Controller-manager observability tests: Prometheus exposition parity
+with the reference metrics (reference
+notebook-controller/pkg/metrics/metrics.go:22-99 — scrape-time
+notebook_running gauge, create/cull counters;
+profile-controller/controllers/monitoring.go heartbeat) plus the
+manager's /metrics /healthz /readyz endpoints (main.go:124-132) and the
+culler's TPU duty-cycle probe (SURVEY.md §7 hard part d)."""
+
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    make_culling_controller,
+    parse_duty_cycle,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.k8s import FakeApiServer
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+def notebook_cr(name="nb", ns="user"):
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{"name": name, "image": "jupyter-jax-tpu"}]
+                }
+            }
+        },
+    }
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+class TestControllerMetrics:
+    def test_notebook_running_gauge_scrapes_statefulsets(self, api):
+        prom = ControllerMetrics(api)
+        ctrl = make_notebook_controller(api, prom=prom)
+        api.create(notebook_cr("nb1"))
+        api.create(notebook_cr("nb2"))
+        ctrl.run_once()
+        text = prom.exposition().decode()
+        assert 'notebook_running{namespace="user"} 2.0' in text
+
+    def test_create_counter_increments_once_per_notebook(self, api):
+        prom = ControllerMetrics(api)
+        ctrl = make_notebook_controller(api, prom=prom)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        ctrl.resync()
+        ctrl.run_once()  # second pass: STS exists, no new create
+        text = prom.exposition().decode()
+        assert 'notebook_create_total{namespace="user"} 1.0' in text
+        assert 'controller_reconcile_total{controller="notebook-controller",result="success"}' in text
+
+    def test_culling_counter_and_timestamp(self, api):
+        from kubeflow_tpu.controllers.time_utils import rfc3339
+
+        prom = ControllerMetrics(api)
+        now = 1_800_000_000
+        cull = make_culling_controller(
+            api,
+            kernel_probe=lambda ns, name: [],  # no kernels => idle
+            options=CullingOptions(
+                enabled=True, cull_idle_time_min=60, idleness_check_period_min=5
+            ),
+            clock=lambda: now,
+            prom=prom,
+        )
+        nb = notebook_cr()
+        nb["metadata"]["annotations"] = {
+            "notebooks.kubeflow.org/last-activity": rfc3339(now - 120 * 60)
+        }
+        api.create(nb)
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "nb-0", "namespace": "user"},
+            }
+        )
+        cull.run_once()  # idle 120min > 60min => stop
+        text = prom.exposition().decode()
+        assert 'notebook_culling_total{name="nb",namespace="user"} 1.0' in text
+        assert "last_notebook_culling_timestamp_seconds" in text
+
+    def test_manager_server_endpoints(self, api):
+        prom = ControllerMetrics(api)
+        prom.service_heartbeat.labels("notebook-controller", "critical").inc()
+        ready = [False]
+        server = ManagerServer(prom, port=0, ready=lambda: ready[0])
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/healthz") as resp:
+                assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + "/readyz")
+            assert err.value.code == 503
+            ready[0] = True
+            with urllib.request.urlopen(base + "/readyz") as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(base + "/metrics") as resp:
+                text = resp.read().decode()
+            assert "service_heartbeat_total" in text
+        finally:
+            server.stop()
+
+    def test_queue_depth_collector(self, api):
+        prom = ControllerMetrics(api)
+        ctrl = make_notebook_controller(api, prom=prom)
+        prom.watch_controllers([ctrl])
+        text = prom.exposition().decode()
+        assert 'workqueue_depth{controller="notebook-controller"} 0.0' in text
+
+
+class TestTpuDutyCycleSignal:
+    def test_parse_duty_cycle_picks_max_sample(self):
+        text = (
+            "# HELP tpu_duty_cycle_percent ...\n"
+            "# TYPE tpu_duty_cycle_percent gauge\n"
+            'tpu_duty_cycle_percent{chip="0"} 12.5\n'
+            'tpu_duty_cycle_percent{chip="1"} 93.0\n'
+        )
+        assert parse_duty_cycle(text) == 93.0
+
+    def test_parse_duty_cycle_garbage_is_zero(self):
+        assert parse_duty_cycle("not metrics\n") == 0.0
+        assert parse_duty_cycle("tpu_duty_cycle_percent\n") == 0.0
+
+    def test_exporter_serves_prometheus_text(self):
+        # The in-image exporter (images/jupyter-jax-tpu/tpu-metrics) must
+        # serve a scrapeable gauge even with no TPU present.
+        import importlib.util
+        import pathlib
+        import threading
+
+        path = (
+            pathlib.Path(__file__).resolve().parents[1]
+            / "images/jupyter-jax-tpu/tpu-metrics/exporter.py"
+        )
+        spec = importlib.util.spec_from_file_location("tpu_exporter", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        import http.server
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), mod.Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics"
+            ) as resp:
+                text = resp.read().decode()
+            assert parse_duty_cycle(text) == 0.0
+            assert "tpu_duty_cycle_percent" in text
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_busy_probe_vetoes_cull(self, api):
+        # TPU busy (duty cycle high) => no stop even with zero kernels.
+        now = [10_000.0]
+        nb_ctrl = make_notebook_controller(api)
+        cull = make_culling_controller(
+            api,
+            kernel_probe=lambda ns, name: [],
+            options=CullingOptions(
+                enabled=True, cull_idle_time_min=1, idleness_check_period_min=1
+            ),
+            tpu_busy_probe=lambda ns, name: True,
+            clock=lambda: now[0],
+        )
+        api.create(notebook_cr())
+        nb_ctrl.run_once()
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "nb-0", "namespace": "user"},
+            }
+        )
+        for _ in range(4):
+            cull.run_once()
+            now[0] += 120
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        annotations = nb["metadata"].get("annotations") or {}
+        assert "kubeflow-resource-stopped" not in annotations

@@ -65,10 +65,15 @@ class TestReleasing:
 
 class TestConformance:
     def test_setup_yaml_parses_and_matches_stack(self):
+        # profile.yaml applies first (the Makefile waits for the profile
+        # controller to materialise the namespace before setup.yaml).
         docs = [
-            d for d in yaml.safe_load_all(
-                (REPO / "conformance" / "1.0" / "setup.yaml").read_text()
-            ) if d
+            d
+            for path in ("profile.yaml", "setup.yaml")
+            for d in yaml.safe_load_all(
+                (REPO / "conformance" / "1.0" / path).read_text()
+            )
+            if d
         ]
         kinds = [d["kind"] for d in docs]
         assert kinds == ["Profile", "ServiceAccount", "RoleBinding"]
