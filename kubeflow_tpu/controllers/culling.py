@@ -177,18 +177,26 @@ class CullingReconciler:
 
         kernels = self.kernel_probe(req.namespace, req.name)
         config = self.options.to_native()
-        if self.tpu_busy_probe is not None:
-            config["tpuBusy"] = bool(self.tpu_busy_probe(req.namespace, req.name))
 
-        decision = native.invoke(
-            "cull_decide",
-            {
-                "notebook": notebook,
-                "kernels": kernels,
-                "nowEpoch": int(self.clock()),
-                "config": config,
-            },
-        )
+        def decide() -> dict:
+            return native.invoke(
+                "cull_decide",
+                {
+                    "notebook": notebook,
+                    "kernels": kernels,
+                    "nowEpoch": int(self.clock()),
+                    "config": config,
+                },
+            )
+
+        decision = decide()
+        if decision["action"] == "stop" and self.tpu_busy_probe is not None:
+            # Lazy TPU probe: the (networked, possibly slow) duty-cycle
+            # scrape only runs when the kernel signal alone would cull —
+            # N active notebooks cost zero extra HTTP round-trips.
+            if self.tpu_busy_probe(req.namespace, req.name):
+                config["tpuBusy"] = True
+                decision = decide()
         if decision["action"] in ("update-annotations", "stop"):
             self.api.patch_merge(
                 NOTEBOOK_API,

@@ -1,0 +1,175 @@
+"""Lease-based leader election for controller managers.
+
+The role controller-runtime's leader election plays in the reference
+(reference notebook-controller/main.go:66-93, --leader-elect flag wired
+into ctrl.Options.LeaderElection with a per-controller lease id): only
+one replica of a manager reconciles at a time; a crashed leader's lease
+expires and a standby takes over, which is the whole failure-recovery
+story for the control plane (level-based reconciliation re-derives all
+state on takeover).
+
+Implemented against the coordination.k8s.io/v1 Lease API shape with
+optimistic concurrency: acquire/renew is a read-modify-update on one
+Lease object; a Conflict means another candidate won the race and the
+loser backs off. ``clock`` is injectable so expiry is testable without
+sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.controllers.time_utils import parse_rfc3339, rfc3339
+from kubeflow_tpu.k8s.fake import ApiError, FakeApiServer, NotFound
+
+LEASE_API = "coordination.k8s.io/v1"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        lease_name: str,
+        identity: str,
+        namespace: str = "kubeflow",
+        lease_duration_s: float = 15.0,
+        retry_period_s: float = 2.0,
+        clock: Callable[[], float] = time.time,
+        on_started_leading: Callable[[], None] | None = None,
+        on_stopped_leading: Callable[[], None] | None = None,
+    ):
+        self.api = api
+        self.lease_name = lease_name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration_s = lease_duration_s
+        self.retry_period_s = retry_period_s
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._stop = threading.Event()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def _lease_obj(self, transitions: int) -> dict:
+        now = rfc3339(int(self.clock()))
+        return {
+            "apiVersion": LEASE_API,
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration_s),
+                "renewTime": now,
+                "acquireTime": now,
+                "leaseTransitions": transitions,
+            },
+        }
+
+    def _expired(self, lease: dict) -> bool:
+        spec = lease.get("spec") or {}
+        renew = parse_rfc3339(spec.get("renewTime", ""))
+        if renew is None:
+            return True
+        duration = spec.get("leaseDurationSeconds", self.lease_duration_s)
+        return self.clock() - renew > duration
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round. Returns whether this candidate now leads.
+        Called periodically (every retry_period_s when standby, well
+        inside lease_duration_s when leading)."""
+        was_leading = self._leading
+        try:
+            lease = self.api.get(
+                LEASE_API, "Lease", self.lease_name, self.namespace
+            )
+        except NotFound:
+            try:
+                self.api.create(self._lease_obj(transitions=0))
+                self._set_leading(True)
+                return True
+            except ApiError:
+                self._set_leading(False)
+                return False
+
+        holder = (lease.get("spec") or {}).get("holderIdentity")
+        if holder == self.identity or self._expired(lease):
+            transitions = (lease.get("spec") or {}).get("leaseTransitions", 0)
+            if holder != self.identity:
+                transitions += 1
+            desired = self._lease_obj(transitions)
+            if holder == self.identity:
+                # Renewal keeps the original acquireTime.
+                desired["spec"]["acquireTime"] = (lease.get("spec") or {}).get(
+                    "acquireTime", desired["spec"]["acquireTime"]
+                )
+            desired["metadata"]["resourceVersion"] = lease["metadata"][
+                "resourceVersion"
+            ]
+            try:
+                self.api.update(desired)
+                self._set_leading(True)
+                return True
+            except ApiError:
+                # Lost the takeover race, or (when was_leading) our renew
+                # raced a takeover after expiry: step down.
+                self._set_leading(False)
+                return False
+        self._set_leading(False)
+        if was_leading:
+            # Another identity holds an unexpired lease we thought was
+            # ours: clock jumped or we failed to renew in time.
+            pass
+        return False
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading and not self._leading:
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def release(self) -> None:
+        """Voluntary step-down on clean shutdown (controller-runtime's
+        ReleaseOnCancel): zero the renewTime so a standby takes over
+        immediately instead of waiting out the lease."""
+        if not self._leading:
+            return
+        try:
+            lease = self.api.get(
+                LEASE_API, "Lease", self.lease_name, self.namespace
+            )
+            if (lease.get("spec") or {}).get("holderIdentity") == self.identity:
+                lease["spec"]["renewTime"] = rfc3339(
+                    int(self.clock() - self.lease_duration_s - 1)
+                )
+                self.api.update(lease)
+        except ApiError:
+            pass
+        self._set_leading(False)
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            self.try_acquire_or_renew()
+            self._stop.wait(self.retry_period_s)
+        self.release()
+
+    def start(self) -> threading.Thread:
+        thread = threading.Thread(
+            target=self.run_forever,
+            name=f"leader-elect-{self.lease_name}",
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,172 @@
+"""Controller-manager composition: the reference main.go equivalent.
+
+Ties together what reference notebook-controller/main.go:57-147 wires
+with flags + env: the controllers (culler gated by ENABLE_CULLING,
+main.go:110-122), the metrics/health listener (main.go:124-132), and
+optional leader election (main.go:66-93). Standby replicas run the
+elector only; controllers start on acquiring the lease and stop on
+losing it (level-based reconciliation makes takeover safe — the new
+leader's initial LIST re-derives everything).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    make_culling_controller,
+)
+from kubeflow_tpu.controllers.leader import LeaderElector
+from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
+from kubeflow_tpu.controllers.notebook import (
+    NotebookOptions,
+    make_notebook_controller,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() in ("1", "true", "yes")
+
+
+def options_from_env() -> tuple[NotebookOptions, CullingOptions]:
+    """Env parity with the reference kustomize params.env contract
+    (reference notebook-controller/config/manager/params.env:5-7 and
+    culling_controller.go initGlobalVars :405-438)."""
+    nb = NotebookOptions(
+        use_istio=_env_bool("USE_ISTIO"),
+        istio_gateway=os.environ.get(
+            "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
+        ),
+        istio_host=os.environ.get("ISTIO_HOST", "*"),
+        cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"),
+        add_fs_group=_env_bool("ADD_FSGROUP", True),
+    )
+    cull = CullingOptions(
+        enabled=_env_bool("ENABLE_CULLING"),
+        cull_idle_time_min=int(os.environ.get("CULL_IDLE_TIME", "1440")),
+        idleness_check_period_min=int(
+            os.environ.get("IDLENESS_CHECK_PERIOD", "1")
+        ),
+    )
+    return nb, cull
+
+
+class Manager:
+    """Runs a set of controllers behind one metrics/health server and,
+    optionally, one leader-election lease."""
+
+    def __init__(
+        self,
+        api: FakeApiServer,
+        controllers: list,
+        prom: ControllerMetrics | None = None,
+        http_port: int | None = 0,
+        leader_elect: bool = False,
+        lease_name: str = "controller-manager",
+        identity: str | None = None,
+        lease_namespace: str = "kubeflow",
+        clock=None,
+    ):
+        self.api = api
+        self.controllers = controllers
+        self.prom = prom
+        self._threads: list = []
+        self._running = False
+        self.server = None
+        if prom is not None and http_port is not None:
+            prom.watch_controllers(controllers)
+            self.server = ManagerServer(prom, port=http_port, ready=self.ready)
+        self.elector = None
+        if leader_elect:
+            kwargs = {}
+            if clock is not None:
+                kwargs["clock"] = clock
+            self.elector = LeaderElector(
+                api,
+                lease_name,
+                identity or f"manager-{uuid.uuid4().hex[:8]}",
+                namespace=lease_namespace,
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._stop_controllers,
+                **kwargs,
+            )
+
+    def ready(self) -> bool:
+        """Readiness = serving; standbys are ready without leading (they
+        must pass probes to stay in the replica pool)."""
+        return True
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.is_leader if self.elector else self._running
+
+    def _start_controllers(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._threads = [ctrl.start() for ctrl in self.controllers]
+
+    def _stop_controllers(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for ctrl in self.controllers:
+            ctrl.stop()
+        self._threads = []
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+        if self.elector is not None:
+            self.elector.start()
+        else:
+            self._start_controllers()
+
+    def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
+            self.elector.release()
+        self._stop_controllers()
+        if self.server is not None:
+            self.server.stop()
+
+
+def make_notebook_manager(
+    api: FakeApiServer,
+    leader_elect: bool | None = None,
+    http_port: int | None = 0,
+    identity: str | None = None,
+    kernel_probe=None,
+    tpu_busy_probe=None,
+) -> Manager:
+    """The notebook-controller binary: notebook reconciler + culler (+
+    metrics), configured from env exactly like the reference manager."""
+    nb_opts, cull_opts = options_from_env()
+    prom = ControllerMetrics(api)
+    controllers = [make_notebook_controller(api, nb_opts, prom=prom)]
+    controllers.append(
+        make_culling_controller(
+            api,
+            kernel_probe=kernel_probe,
+            options=cull_opts,
+            tpu_busy_probe=tpu_busy_probe,
+            prom=prom,
+        )
+    )
+    if leader_elect is None:
+        leader_elect = _env_bool("LEADER_ELECT")
+    return Manager(
+        api,
+        controllers,
+        prom=prom,
+        http_port=http_port,
+        leader_elect=leader_elect,
+        lease_name="notebook-controller",
+        identity=identity,
+    )

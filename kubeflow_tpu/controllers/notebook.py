@@ -90,9 +90,15 @@ class NotebookReconciler:
             sts_result = self._ensure(out["statefulset"])
         except Exception:
             if self.prom is not None:
-                self.prom.notebook_create_failed_total.labels(
-                    req.namespace
-                ).inc()
+                # Only a failed *creation* counts (reference
+                # NotebookFailCreation); a Conflict while drift-repairing
+                # an existing STS is a routine retry, not a create failure.
+                try:
+                    self.api.get("apps/v1", "StatefulSet", req.name, req.namespace)
+                except NotFound:
+                    self.prom.notebook_create_failed_total.labels(
+                        req.namespace
+                    ).inc()
             raise
         if sts_result == "created" and self.prom is not None:
             # Counts new notebook materialisations, like the reference's

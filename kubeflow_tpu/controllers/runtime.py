@@ -242,6 +242,10 @@ class Controller:
                 self.queue.add(req)
 
     def start(self) -> threading.Thread:
+        # Controllers are restarted across leadership transitions
+        # (manager.py); a stale stop signal from the previous stint must
+        # not kill the new run loop.
+        self._stop.clear()
         thread = threading.Thread(
             target=self.run_forever, name=self.name, daemon=True
         )

@@ -1,0 +1,193 @@
+"""Leader election + manager composition tests (reference
+notebook-controller/main.go:66-93 leader election, :110-132 culler
+gating and health endpoints). Two managers share one fake apiserver —
+exactly one leads; lease expiry and voluntary release hand over."""
+
+import pytest
+
+from kubeflow_tpu.controllers.leader import LEASE_API, LeaderElector
+from kubeflow_tpu.controllers.manager import (
+    Manager,
+    make_notebook_manager,
+    options_from_env,
+)
+from kubeflow_tpu.k8s import FakeApiServer
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+class FakeClock:
+    def __init__(self, start=1_800_000_000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class TestLeaderElector:
+    def test_first_candidate_acquires(self, api):
+        clock = FakeClock()
+        a = LeaderElector(api, "nbc", "pod-a", clock=clock)
+        assert a.try_acquire_or_renew()
+        assert a.is_leader
+        lease = api.get(LEASE_API, "Lease", "nbc", "kubeflow")
+        assert lease["spec"]["holderIdentity"] == "pod-a"
+
+    def test_second_candidate_stays_standby_until_expiry(self, api):
+        clock = FakeClock()
+        a = LeaderElector(api, "nbc", "pod-a", clock=clock)
+        b = LeaderElector(api, "nbc", "pod-b", clock=clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        assert not b.is_leader
+        # a keeps renewing: b never takes over.
+        clock.advance(10)
+        assert a.try_acquire_or_renew()
+        clock.advance(10)
+        assert not b.try_acquire_or_renew()
+        # a dies (stops renewing): lease expires, b takes over.
+        clock.advance(16)
+        assert b.try_acquire_or_renew()
+        assert b.is_leader
+        lease = api.get(LEASE_API, "Lease", "nbc", "kubeflow")
+        assert lease["spec"]["holderIdentity"] == "pod-b"
+        assert lease["spec"]["leaseTransitions"] == 1
+
+    def test_deposed_leader_steps_down(self, api):
+        clock = FakeClock()
+        a = LeaderElector(api, "nbc", "pod-a", clock=clock)
+        b = LeaderElector(api, "nbc", "pod-b", clock=clock)
+        assert a.try_acquire_or_renew()
+        clock.advance(20)  # a missed its renewals
+        assert b.try_acquire_or_renew()
+        assert not a.try_acquire_or_renew()  # sees b's fresh lease
+        assert not a.is_leader
+
+    def test_release_hands_over_immediately(self, api):
+        clock = FakeClock()
+        a = LeaderElector(api, "nbc", "pod-a", clock=clock)
+        b = LeaderElector(api, "nbc", "pod-b", clock=clock)
+        assert a.try_acquire_or_renew()
+        a.release()
+        assert not a.is_leader
+        assert b.try_acquire_or_renew()  # no expiry wait needed
+
+    def test_callbacks_fire_on_transitions(self, api):
+        clock = FakeClock()
+        log = []
+        a = LeaderElector(
+            api, "nbc", "pod-a", clock=clock,
+            on_started_leading=lambda: log.append("start"),
+            on_stopped_leading=lambda: log.append("stop"),
+        )
+        a.try_acquire_or_renew()
+        a.try_acquire_or_renew()  # renewal: no duplicate callback
+        a.release()
+        assert log == ["start", "stop"]
+
+
+def notebook_cr(name="nb", ns="user"):
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{"name": name, "image": "jupyter-jax-tpu"}]
+                }
+            }
+        },
+    }
+
+
+class TestManager:
+    def test_env_options(self, monkeypatch):
+        monkeypatch.setenv("USE_ISTIO", "true")
+        monkeypatch.setenv("ENABLE_CULLING", "true")
+        monkeypatch.setenv("CULL_IDLE_TIME", "30")
+        monkeypatch.setenv("IDLENESS_CHECK_PERIOD", "5")
+        nb, cull = options_from_env()
+        assert nb.use_istio is True
+        assert cull.enabled is True
+        assert cull.cull_idle_time_min == 30
+        assert cull.idleness_check_period_min == 5
+
+    def test_only_leader_reconciles(self, api):
+        import time
+
+        m1 = make_notebook_manager(
+            api, leader_elect=True, http_port=None, identity="m1",
+            kernel_probe=lambda ns, n: [],
+        )
+        m2 = make_notebook_manager(
+            api, leader_elect=True, http_port=None, identity="m2",
+            kernel_probe=lambda ns, n: [],
+        )
+        # Deterministic election round instead of thread timing.
+        m1.elector.try_acquire_or_renew()
+        m2.elector.try_acquire_or_renew()
+        assert m1.is_leader and not m2.is_leader
+        api.create(notebook_cr())
+        deadline = time.time() + 5
+        sts = None
+        while time.time() < deadline:
+            try:
+                sts = api.get("apps/v1", "StatefulSet", "nb", "user")
+                break
+            except Exception:
+                time.sleep(0.02)
+        assert sts is not None, "leader's controllers did not reconcile"
+        m1.stop()
+        m2.stop()
+
+    def test_regained_leadership_restarts_controllers(self, api):
+        # Regression: Controller.stop() must not poison a later start()
+        # (lose lease -> regain lease reuses the same Controller objects).
+        import time
+
+        m = make_notebook_manager(
+            api, leader_elect=False, http_port=None,
+            kernel_probe=lambda ns, n: [],
+        )
+        m.start()
+        m._stop_controllers()
+        m._start_controllers()
+        api.create(notebook_cr("nb-after-restart"))
+        deadline = time.time() + 5
+        sts = None
+        while time.time() < deadline:
+            try:
+                sts = api.get("apps/v1", "StatefulSet", "nb-after-restart", "user")
+                break
+            except Exception:
+                time.sleep(0.02)
+        m.stop()
+        assert sts is not None, "restarted controllers did not reconcile"
+
+    def test_takeover_starts_standby_controllers(self, api):
+        clock = FakeClock()
+        m1 = Manager(
+            api, [], leader_elect=True, identity="m1", http_port=None,
+            clock=clock,
+        )
+        m2 = Manager(
+            api, [], leader_elect=True, identity="m2", http_port=None,
+            clock=clock,
+        )
+        m1.elector.try_acquire_or_renew()
+        m2.elector.try_acquire_or_renew()
+        assert m1.is_leader and not m2.is_leader
+        clock.advance(20)  # m1 stops renewing
+        m2.elector.try_acquire_or_renew()
+        assert m2.is_leader
+        m1.elector.try_acquire_or_renew()
+        assert not m1.is_leader
