@@ -83,7 +83,6 @@ class LeaderElector:
         """One election round. Returns whether this candidate now leads.
         Called periodically (every retry_period_s when standby, well
         inside lease_duration_s when leading)."""
-        was_leading = self._leading
         try:
             lease = self.api.get(
                 LEASE_API, "Lease", self.lease_name, self.namespace
@@ -116,15 +115,11 @@ class LeaderElector:
                 self._set_leading(True)
                 return True
             except ApiError:
-                # Lost the takeover race, or (when was_leading) our renew
+                # Lost the takeover race, or (when we led) our renew
                 # raced a takeover after expiry: step down.
                 self._set_leading(False)
                 return False
         self._set_leading(False)
-        if was_leading:
-            # Another identity holds an unexpired lease we thought was
-            # ours: clock jumped or we failed to renew in time.
-            pass
         return False
 
     def _set_leading(self, leading: bool) -> None:

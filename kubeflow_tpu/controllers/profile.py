@@ -215,6 +215,55 @@ class AwsIamForServiceAccountPlugin:
             pass
 
 
+class NamespaceLabelsFile:
+    """Hot-reloaded default-namespace-labels file (reference
+    profile_controller.go:370-425: fsnotify watch on the labels file;
+    every change re-reconciles all Profiles so running namespaces pick
+    up the new label set). mtime-polled from the controller's loop tick
+    instead of inotify — same behaviour, no platform dependency.
+
+    File format: a YAML map of label -> value (the reference's
+    namespace-labels.yaml ConfigMap format)."""
+
+    def __init__(self, path):
+        import pathlib
+
+        self.path = pathlib.Path(path)
+        self._mtime: float | None = None
+        self.labels: dict = {}
+        self.load()
+
+    def load(self) -> None:
+        import yaml
+
+        try:
+            self._mtime = self.path.stat().st_mtime
+            data = yaml.safe_load(self.path.read_text())
+        except FileNotFoundError:
+            self._mtime = None
+            data = {}
+        except Exception:
+            # Malformed file (invalid YAML, mid-write read): keep the
+            # previous label set rather than killing the controller
+            # loop; _mtime was already advanced above so this is one
+            # attempt per file change, not a retry storm.
+            log.exception("namespace labels file %s unreadable; keeping "
+                          "previous labels", self.path)
+            return
+        if not isinstance(data, dict):
+            log.warning("namespace labels file %s is not a YAML map; "
+                        "treating as empty", self.path)
+            data = {}
+        self.labels = {str(k): str(v) for k, v in data.items() if v is not None}
+
+    def changed(self) -> bool:
+        try:
+            mtime = self.path.stat().st_mtime
+        except FileNotFoundError:
+            mtime = None
+        return mtime != self._mtime
+
+
 @dataclasses.dataclass
 class ProfileOptions:
     userid_header: str = "kubeflow-userid"
@@ -316,10 +365,27 @@ def make_profile_controller(
     api: FakeApiServer,
     options: ProfileOptions | None = None,
     plugins: dict[str, ProfilePlugin] | None = None,
+    labels_file: str | None = None,
 ) -> Controller:
-    return Controller(
+    options = options or ProfileOptions()
+    reconciler = ProfileReconciler(api, options, plugins)
+    controller = Controller(
         name="profile-controller",
         api=api,
-        reconciler=ProfileReconciler(api, options, plugins),
+        reconciler=reconciler,
         watches=[WatchSpec(PROFILE_API, "Profile")],
     )
+    if labels_file is not None:
+        watcher = NamespaceLabelsFile(labels_file)
+        options.namespace_labels = dict(watcher.labels)
+
+        def maybe_reload():
+            if watcher.changed():
+                watcher.load()
+                options.namespace_labels = dict(watcher.labels)
+                # Re-reconcile every Profile under the new label set
+                # (the reference's fsnotify -> reconcile-all).
+                controller.resync()
+
+        controller.tick_hooks.append(maybe_reload)
+    return controller

@@ -155,8 +155,12 @@ class Controller:
             q = api.watch(spec.api_version, spec.kind)
             self._watch_queues.append((spec, q))
         self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
         self._initial_synced = False
         self.metrics = {"reconciles": 0, "errors": 0, "requeues": 0}
+        # Called once per loop tick (config-file watches and other
+        # fsnotify-style side channels hook in here).
+        self.tick_hooks: list[Callable[[], None]] = []
 
     def _default_request(self, obj: dict) -> list[Request]:
         meta = obj.get("metadata", {})
@@ -209,6 +213,8 @@ class Controller:
             self.resync()
             self._initial_synced = True
         processed = 0
+        for hook in self.tick_hooks:
+            hook()
         for _ in range(max_iterations):
             self._drain_watches()
             if not self._process_one():
@@ -224,6 +230,8 @@ class Controller:
             self._initial_synced = True
         last_resync = time.monotonic()
         while not self._stop.is_set():
+            for hook in self.tick_hooks:
+                hook()
             self._drain_watches()
             worked = self._process_one()
             if time.monotonic() - last_resync > self.resync_period:
@@ -243,14 +251,23 @@ class Controller:
 
     def start(self) -> threading.Thread:
         # Controllers are restarted across leadership transitions
-        # (manager.py); a stale stop signal from the previous stint must
-        # not kill the new run loop.
+        # (manager.py). The previous stint's thread must be fully gone
+        # before the stop signal is cleared — clearing early on a fast
+        # lose/regain flap would leave two run loops reconciling the
+        # same keys concurrently.
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            self._thread.join(timeout=10.0)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"{self.name}: previous run loop did not stop"
+                )
         self._stop.clear()
-        thread = threading.Thread(
+        self._thread = threading.Thread(
             target=self.run_forever, name=self.name, daemon=True
         )
-        thread.start()
-        return thread
+        self._thread.start()
+        return self._thread
 
     def stop(self):
         self._stop.set()

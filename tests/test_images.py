@@ -27,9 +27,12 @@ DAG = {
     "jupyter-scipy": "jupyter",
     "jupyter-jax-tpu": "jupyter",
     "jupyter-jax-tpu-full": "jupyter-jax-tpu",
+    "jupyter-torch-tpu": "jupyter",
+    "jupyter-tf-tpu": "jupyter",
     "codeserver": "base",
     "codeserver-jax-tpu": "codeserver",
     "rstudio": "base",
+    "rstudio-tidyverse": "rstudio",
 }
 
 

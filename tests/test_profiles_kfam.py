@@ -66,6 +66,36 @@ class TestProfileController:
             "team"
         ] == "research"
 
+    def test_labels_file_hot_reload_rereconciles_all(self, tmp_path):
+        # Reference profile_controller.go:370-425: fsnotify on the labels
+        # file; a change re-reconciles every Profile with the new labels.
+        labels_file = tmp_path / "namespace-labels.yaml"
+        labels_file.write_text("team: research\n")
+        api = FakeApiServer()
+        ctrl = make_profile_controller(api, labels_file=str(labels_file))
+        api.create(profile_cr())
+        ctrl.run_once()
+        ns = api.get("v1", "Namespace", "alice")
+        assert ns["metadata"]["labels"]["team"] == "research"
+
+        import os
+
+        labels_file.write_text("team: platform\nenv: prod\n")
+        os.utime(labels_file, (1e9, 2e9))  # force a distinct mtime
+        ctrl.run_once()
+        ns = api.get("v1", "Namespace", "alice")
+        assert ns["metadata"]["labels"]["team"] == "platform"
+        assert ns["metadata"]["labels"]["env"] == "prod"
+
+    def test_labels_file_missing_is_empty(self, tmp_path):
+        api = FakeApiServer()
+        ctrl = make_profile_controller(
+            api, labels_file=str(tmp_path / "absent.yaml")
+        )
+        api.create(profile_cr())
+        ctrl.run_once()
+        assert api.get("v1", "Namespace", "alice")
+
     def test_workload_identity_plugin_and_finalizer_revocation(self):
         api = FakeApiServer()
         calls = []
