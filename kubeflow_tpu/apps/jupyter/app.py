@@ -121,6 +121,94 @@ def create_app(
             raise ApiError(f"notebook {name!r} not found", 404)
         return {"notebook": nb, "processed": notebook_view(nb)}
 
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/pod")
+    def get_notebook_pods(request, namespace, name):
+        """Details page: the notebook's pods (reference get.py:68-81 —
+        one pod there; N pods here on a multi-host slice)."""
+        ensure(app.authorizer, request.user, "list", "", "pods", namespace)
+        pods = [
+            p
+            for p in api.list("v1", "Pod", namespace=namespace)
+            if (p["metadata"].get("labels") or {}).get("notebook-name")
+            == name
+        ]
+        return {"pods": pods}
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>/pod/<pod_name>/logs"
+    )
+    def get_pod_logs(request, namespace, name, pod_name):
+        """Details page: per-pod logs (reference get.py:83-90)."""
+        ensure(app.authorizer, request.user, "get", "", "pods", namespace)
+        try:
+            logs = api.read_pod_logs(namespace, pod_name)
+        except NotFound:
+            raise ApiError(f"pod {pod_name!r} not found", 404)
+        return {"logs": logs.splitlines()}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
+    def get_notebook_events(request, namespace, name):
+        """Details page: events on the notebook's STS/pods (reference
+        get.py:92-99 filters by involvedObject)."""
+        ensure(app.authorizer, request.user, "list", "", "events", namespace)
+
+        def involved(ev):
+            ref = ev.get("involvedObject") or {}
+            obj = ref.get("name", "")
+            if obj == name:
+                return True
+            # Replica pods only ("nb-0", "nb-1", …): requiring kind=Pod
+            # keeps a sibling notebook named "<name>-<digits>" (whose
+            # Notebook/STS object matches the name pattern) out.
+            prefix, _, suffix = obj.rpartition("-")
+            return (
+                ref.get("kind", "Pod") == "Pod"
+                and prefix == name
+                and suffix.isdigit()
+            )
+
+        events = [
+            ev
+            for ev in api.list("v1", "Event", namespace=namespace)
+            if involved(ev)
+        ]
+        return {"events": events}
+
+    @app.route("/api/tpus")
+    def get_installed_tpus(request):
+        """TPU equivalent of the reference's /api/gpus installed-vendor
+        check (reference get.py:101-110; frontend form-gpus only offers
+        vendors with cluster capacity): accelerator types present on
+        schedulable nodes, so the form can grey out absent topologies."""
+        types: dict[str, int] = {}
+        for node in api.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            acc = labels.get("cloud.google.com/gke-tpu-accelerator")
+            if not acc:
+                continue
+            spec = node.get("spec") or {}
+            if spec.get("unschedulable"):
+                continue
+            if any(
+                t.get("effect") in ("NoSchedule", "NoExecute")
+                and t.get("key") != "google.com/tpu"
+                for t in spec.get("taints") or []
+            ):
+                # Cordoned/tainted nodes can't host new notebooks; the
+                # standard google.com/tpu taint is tolerated by the
+                # controller's pod template so it doesn't count.
+                continue
+            cap = ((node.get("status") or {}).get("allocatable") or {}).get(
+                "google.com/tpu", 0
+            )
+            try:
+                chips = int(cap)
+            except (TypeError, ValueError):
+                chips = 0
+            if chips > 0:
+                types[acc] = types.get(acc, 0) + chips
+        return {"installed": sorted(types), "chips": types}
+
     @app.route("/api/namespaces/<namespace>/notebooks", methods=["POST"])
     def post_notebook(request, namespace):
         ensure(app.authorizer, request.user, "create", "kubeflow.org",

@@ -125,12 +125,20 @@ class NotebookReconciler:
             pod = {}
         def involves_this_notebook(event: dict) -> bool:
             # Exact object names only: the STS itself or its replica pods
-            # ("nb", "nb-0"… but not a sibling "nb2-0").
-            obj_name = (event.get("involvedObject") or {}).get("name", "")
+            # ("nb", "nb-0"… but not a sibling "nb2-0"). The Pod-kind
+            # check keeps a sibling notebook literally named
+            # "<name>-<digits>" (its Notebook/STS objects match the
+            # ordinal pattern) from leaking in.
+            ref = event.get("involvedObject") or {}
+            obj_name = ref.get("name", "")
             if obj_name == name:
                 return True
             prefix, _, suffix = obj_name.rpartition("-")
-            return prefix == name and suffix.isdigit()
+            return (
+                ref.get("kind", "Pod") == "Pod"
+                and prefix == name
+                and suffix.isdigit()
+            )
 
         events = [
             e

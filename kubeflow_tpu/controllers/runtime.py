@@ -162,6 +162,16 @@ class Controller:
         # fsnotify-style side channels hook in here).
         self.tick_hooks: list[Callable[[], None]] = []
 
+    def _run_tick_hooks(self) -> None:
+        # Hook failures must not kill the run loop (a transient stat()
+        # error on a watched config file is retried next tick, like
+        # reconcile errors are).
+        for hook in self.tick_hooks:
+            try:
+                hook()
+            except Exception:
+                log.exception("%s: tick hook failed", self.name)
+
     def _default_request(self, obj: dict) -> list[Request]:
         meta = obj.get("metadata", {})
         return [Request(meta.get("namespace", ""), meta.get("name", ""))]
@@ -213,8 +223,7 @@ class Controller:
             self.resync()
             self._initial_synced = True
         processed = 0
-        for hook in self.tick_hooks:
-            hook()
+        self._run_tick_hooks()
         for _ in range(max_iterations):
             self._drain_watches()
             if not self._process_one():
@@ -230,8 +239,7 @@ class Controller:
             self._initial_synced = True
         last_resync = time.monotonic()
         while not self._stop.is_set():
-            for hook in self.tick_hooks:
-                hook()
+            self._run_tick_hooks()
             self._drain_watches()
             worked = self._process_one()
             if time.monotonic() - last_resync > self.resync_period:

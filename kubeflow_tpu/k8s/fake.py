@@ -101,6 +101,21 @@ class FakeApiServer:
         # Mutating admission hooks: fn(obj) -> mutated obj (or raises
         # ApiError to reject). Keyed by kind, applied on CREATE.
         self._admission: dict[str, list[Callable[[dict], dict]]] = {}
+        # Pod log streams (the kubelet's side channel: GET .../pods/x/log).
+        self._pod_logs: dict[tuple[str, str], str] = {}
+
+    # ---- pod logs --------------------------------------------------------
+    def set_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        """Test/kubelet-sim hook: record a pod's log stream."""
+        with self._lock:
+            self._pod_logs[(namespace, name)] = text
+
+    def read_pod_logs(self, namespace: str, name: str) -> str:
+        """GET pod logs; the pod must exist (404 parity with the real
+        API server), absent stream reads as empty."""
+        self.get("v1", "Pod", name, namespace)
+        with self._lock:
+            return self._pod_logs.get((namespace, name), "")
 
     # ---- admission -------------------------------------------------------
     def register_admission(self, kind: str, hook: Callable[[dict], dict]):

@@ -30,7 +30,12 @@ class NativeError(RuntimeError):
 def ensure_built(force: bool = False) -> str:
     """Build the native library if missing or stale; returns its path."""
     with _lock:
-        stale = force or not os.path.exists(_LIB_PATH)
+        kft_bin = os.path.join(_NATIVE_DIR, "build", "kft")
+        stale = (
+            force
+            or not os.path.exists(_LIB_PATH)
+            or not os.path.exists(kft_bin)
+        )
         if not stale:
             lib_mtime = os.path.getmtime(_LIB_PATH)
             src_dir = os.path.join(_NATIVE_DIR, "src")

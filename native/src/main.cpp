@@ -1,0 +1,39 @@
+// kft — standalone CLI for the native core (the same surface the Go
+// binaries expose in the reference, here as one multiplexed tool):
+//
+//   kft <fn> < payload.json > result.json
+//
+// <fn> is any kft_invoke operation (notebook_reconcile, cull_decide,
+// mutate_pods, profile_reconcile, kfam_binding, …). Reads the JSON
+// payload on stdin, writes {"ok":true,"result":…} or
+// {"ok":false,"error":…} on stdout; exit status mirrors "ok". Lets the
+// native policy core run with no Python in the loop — sidecar exec
+// probes, debugging, and CI parity checks against the library path.
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+extern "C" char* kft_invoke(const char* fn, const char* payload_json);
+extern "C" void kft_free(char* ptr);
+
+int main(int argc, char** argv) {
+  if (argc != 2 || std::strcmp(argv[1], "--help") == 0) {
+    std::cerr << "usage: kft <fn> < payload.json > result.json\n";
+    return 2;
+  }
+  std::ostringstream buf;
+  buf << std::cin.rdbuf();
+  const std::string payload = buf.str();
+  char* out = kft_invoke(argv[1], payload.empty() ? "{}" : payload.c_str());
+  if (out == nullptr) {
+    std::cerr << "kft: invoke returned null\n";
+    return 1;
+  }
+  std::cout << out << "\n";
+  // "ok":false results exit nonzero so shell pipelines can branch.
+  const bool ok = std::strstr(out, "\"ok\":true") != nullptr ||
+                  std::strstr(out, "\"ok\": true") != nullptr;
+  kft_free(out);
+  return ok ? 0 : 1;
+}

@@ -218,6 +218,86 @@ class TestSpawnFlow:
         assert sts["spec"]["template"]["metadata"]["labels"]["tpu-env"] == "true"
 
 
+class TestDetailsPage:
+    """Pod / logs / events routes backing the details page (reference
+    apps/common/routes/get.py:68-99) and the installed-TPU discovery
+    (the /api/gpus vendor-check equivalent, get.py:101-110)."""
+
+    def seed_notebook_with_pod(self, api, name="nb1", ns="user"):
+        api.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": name, "image": "jupyter-jax-tpu"}]}}},
+        })
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"{name}-0", "namespace": ns,
+                         "labels": {"notebook-name": name}},
+        })
+
+    def test_pods_logs_events(self):
+        api = FakeApiServer()
+        self.seed_notebook_with_pod(api)
+        api.set_pod_logs("user", "nb1-0", "booting\njupyterlab up\n")
+        api.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "ev1", "namespace": "user"},
+            "involvedObject": {"name": "nb1-0"},
+            "reason": "Scheduled", "message": "assigned",
+        })
+        api.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "ev2", "namespace": "user"},
+            "involvedObject": {"name": "other-0"},
+            "reason": "Scheduled", "message": "not ours",
+        })
+        client = client_for(api)
+        pods = client.get(
+            "/api/namespaces/user/notebooks/nb1/pod", headers=USER_HEADERS
+        ).get_json()["pods"]
+        assert [p["metadata"]["name"] for p in pods] == ["nb1-0"]
+        logs = client.get(
+            "/api/namespaces/user/notebooks/nb1/pod/nb1-0/logs",
+            headers=USER_HEADERS,
+        ).get_json()["logs"]
+        assert logs == ["booting", "jupyterlab up"]
+        events = client.get(
+            "/api/namespaces/user/notebooks/nb1/events", headers=USER_HEADERS
+        ).get_json()["events"]
+        assert [e["metadata"]["name"] for e in events] == ["ev1"]
+
+    def test_logs_for_missing_pod_404(self):
+        api = FakeApiServer()
+        self.seed_notebook_with_pod(api)
+        client = client_for(api)
+        resp = client.get(
+            "/api/namespaces/user/notebooks/nb1/pod/ghost-0/logs",
+            headers=USER_HEADERS,
+        )
+        assert resp.status_code == 404
+
+    def test_installed_tpus_from_nodes(self):
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "tpu-node-1", "labels": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            }},
+            "status": {"allocatable": {"google.com/tpu": "4"}},
+        })
+        api.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "cpu-node"},
+            "status": {"allocatable": {"cpu": "8"}},
+        })
+        client = client_for(api)
+        body = client.get("/api/tpus", headers=USER_HEADERS).get_json()
+        assert body["installed"] == ["tpu-v5-lite-podslice"]
+        assert body["chips"]["tpu-v5-lite-podslice"] == 4
+
+
 class TestFormLogic:
     CONFIG = {
         "spawnerFormDefaults": {

@@ -44,6 +44,36 @@ def env_map(container):
     return {e["name"]: e for e in container.get("env", [])}
 
 
+class TestKftCli:
+    """The standalone `kft` binary (native/src/main.cpp): same operation
+    table as the library, runnable with no Python in the loop."""
+
+    def _kft(self, fn, payload):
+        import json as json_mod
+        import os
+        import subprocess
+
+        from kubeflow_tpu.native import ensure_built
+
+        lib = ensure_built()
+        binary = os.path.join(os.path.dirname(lib), "kft")
+        proc = subprocess.run(
+            [binary, fn], input=json_mod.dumps(payload),
+            capture_output=True, text=True,
+        )
+        return proc.returncode, json_mod.loads(proc.stdout)
+
+    def test_roundtrip_matches_library(self):
+        payload = {"accelerator": "v5e", "topology": "4x4"}
+        code, out = self._kft("parse_tpu_slice", payload)
+        assert code == 0 and out["ok"]
+        assert out["result"] == invoke("parse_tpu_slice", payload)
+
+    def test_unknown_fn_nonzero_exit(self):
+        code, out = self._kft("definitely_not_a_fn", {})
+        assert code == 1 and not out["ok"]
+
+
 class TestTopologyNative:
     def test_cross_check_against_python(self):
         """The C++ topology table must never drift from topology.py."""
