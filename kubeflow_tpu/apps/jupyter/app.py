@@ -8,6 +8,7 @@ import time
 import yaml
 
 from kubeflow_tpu.apps.jupyter import form as form_mod
+from kubeflow_tpu.controllers.notebook import event_involves_notebook
 from kubeflow_tpu.apps.jupyter.status import STOP_ANNOTATION, process_status
 from kubeflow_tpu.controllers.time_utils import rfc3339
 from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
@@ -152,25 +153,10 @@ def create_app(
         get.py:92-99 filters by involvedObject)."""
         ensure(app.authorizer, request.user, "list", "", "events", namespace)
 
-        def involved(ev):
-            ref = ev.get("involvedObject") or {}
-            obj = ref.get("name", "")
-            if obj == name:
-                return True
-            # Replica pods only ("nb-0", "nb-1", …): requiring kind=Pod
-            # keeps a sibling notebook named "<name>-<digits>" (whose
-            # Notebook/STS object matches the name pattern) out.
-            prefix, _, suffix = obj.rpartition("-")
-            return (
-                ref.get("kind", "Pod") == "Pod"
-                and prefix == name
-                and suffix.isdigit()
-            )
-
         events = [
             ev
             for ev in api.list("v1", "Event", namespace=namespace)
-            if involved(ev)
+            if event_involves_notebook(ev, name)
         ]
         return {"events": events}
 

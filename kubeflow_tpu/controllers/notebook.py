@@ -48,6 +48,25 @@ class NotebookOptions:
         }
 
 
+def event_involves_notebook(event: dict, name: str) -> bool:
+    """Does this Event belong to notebook ``name``? Matches the object
+    itself (Notebook/STS, exact name) or its replica pods ("nb-0",
+    "nb-1", …). The Pod-kind check keeps a sibling notebook literally
+    named "<name>-<digits>" (its Notebook/STS objects match the ordinal
+    pattern) from leaking in. Shared by the controller's status mirror
+    and the JWA details-page events route."""
+    ref = event.get("involvedObject") or {}
+    obj_name = ref.get("name", "")
+    if obj_name == name:
+        return True
+    prefix, _, suffix = obj_name.rpartition("-")
+    return (
+        ref.get("kind", "Pod") == "Pod"
+        and prefix == name
+        and suffix.isdigit()
+    )
+
+
 def pod_to_notebook_requests(obj: dict) -> list[Request]:
     """Map Pod/StatefulSet events back to the owning Notebook via the
     notebook-name label (reference predNBPodIsLabeled + event mapping,
@@ -123,27 +142,10 @@ class NotebookReconciler:
             pod = self.api.get("v1", "Pod", f"{name}-0", ns)
         except NotFound:
             pod = {}
-        def involves_this_notebook(event: dict) -> bool:
-            # Exact object names only: the STS itself or its replica pods
-            # ("nb", "nb-0"… but not a sibling "nb2-0"). The Pod-kind
-            # check keeps a sibling notebook literally named
-            # "<name>-<digits>" (its Notebook/STS objects match the
-            # ordinal pattern) from leaking in.
-            ref = event.get("involvedObject") or {}
-            obj_name = ref.get("name", "")
-            if obj_name == name:
-                return True
-            prefix, _, suffix = obj_name.rpartition("-")
-            return (
-                ref.get("kind", "Pod") == "Pod"
-                and prefix == name
-                and suffix.isdigit()
-            )
-
         events = [
             e
             for e in self.api.list("v1", "Event", namespace=ns)
-            if involves_this_notebook(e)
+            if event_involves_notebook(e, name)
         ]
         status = native.invoke(
             "notebook_status",

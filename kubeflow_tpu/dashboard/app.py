@@ -229,7 +229,7 @@ def create_app(
 
     @app.route("/api/metrics/<metric>")
     def metrics_series(request, metric):
-        if metric not in ("node", "podcpu", "podmem"):
+        if metric not in ("node", "podcpu", "podmem", "tpu-duty-cycle"):
             raise ApiError(f"unknown metric {metric!r}", 404)
         try:
             period = int(request.args.get("period", "900"))

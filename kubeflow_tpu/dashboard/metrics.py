@@ -34,6 +34,72 @@ class NoMetricsService:
         raise LookupError("no metrics backend configured")
 
 
+class PrometheusMetricsService:
+    """Prometheus range queries for the resource charts (reference
+    centraldashboard/app/prometheus_metrics_service.ts: node cpu/memory
+    and pod cpu/memory rate queries over a window). ``http_get`` is
+    injectable so tests run without a Prometheus."""
+
+    # Keys match the dashboard's /api/metrics/<metric> route names
+    # (reference api.ts:41-72: node / podcpu / podmem), plus the TPU
+    # fleet duty-cycle series aggregated from the in-image exporters.
+    QUERIES = {
+        "node": "sum(rate(node_cpu_seconds_total{mode!='idle'}[5m]))",
+        "podcpu":
+            "sum(rate(container_cpu_usage_seconds_total{container!=''}[5m]))",
+        "podmem": "sum(container_memory_working_set_bytes{container!=''})",
+        "tpu-duty-cycle": "avg(tpu_duty_cycle_percent)",
+    }
+
+    def __init__(self, base_url: str, http_get=None):
+        self.base_url = base_url.rstrip("/")
+        if http_get is None:
+            import json as json_mod
+            import urllib.parse
+            import urllib.request
+
+            def http_get(url, params):
+                full = url + "?" + urllib.parse.urlencode(params)
+                with urllib.request.urlopen(full, timeout=10) as resp:
+                    return json_mod.loads(resp.read().decode())
+
+        self.http_get = http_get
+
+    def query(self, metric: str, period_s: int) -> list[dict]:
+        import time as time_mod
+
+        expr = self.QUERIES.get(metric)
+        if expr is None:
+            raise LookupError(f"unknown metric {metric!r}")
+        end = int(time_mod.time())
+        body = self.http_get(
+            self.base_url + "/api/v1/query_range",
+            {
+                "query": expr,
+                "start": end - period_s,
+                "end": end,
+                "step": max(period_s // 60, 15),
+            },
+        )
+        results = ((body.get("data") or {}).get("result")) or []
+        if not results:
+            return []
+        return [
+            {"timestamp": int(ts), "value": float(val)}
+            for ts, val in results[0].get("values", [])
+        ]
+
+
+def make_metrics_service(prometheus_url: str | None) -> MetricsService:
+    """Factory (reference app/metrics_service_factory.ts): Prometheus
+    when configured, the 404-ing null service otherwise. The reference's
+    Stackdriver variant is GCP-console-specific and intentionally out of
+    scope — Cloud Monitoring scrapes the same Prometheus endpoints."""
+    if prometheus_url:
+        return PrometheusMetricsService(prometheus_url)
+    return NoMetricsService()
+
+
 def _parse_quantity(val) -> float:
     """K8s resource quantity -> float (chips are integers, but cpu/mem
     styles appear in tests)."""

@@ -108,14 +108,15 @@ class FakeApiServer:
     def set_pod_logs(self, namespace: str, name: str, text: str) -> None:
         """Test/kubelet-sim hook: record a pod's log stream."""
         with self._lock:
-            self._pod_logs[(namespace, name)] = text
+            self._pod_logs[(namespace or "", name)] = text
 
     def read_pod_logs(self, namespace: str, name: str) -> str:
         """GET pod logs; the pod must exist (404 parity with the real
-        API server), absent stream reads as empty."""
+        API server), absent stream reads as empty. Logs are per pod
+        *instance*: deletion drops the stream (see delete())."""
         self.get("v1", "Pod", name, namespace)
         with self._lock:
-            return self._pod_logs.get((namespace, name), "")
+            return self._pod_logs.get((namespace or "", name), "")
 
     # ---- admission -------------------------------------------------------
     def register_admission(self, kind: str, hook: Callable[[dict], dict]):
@@ -287,6 +288,10 @@ class FakeApiServer:
                     self._notify(gvk, WatchEvent("MODIFIED", obj))
                 return
             bucket.pop(key)
+            if kind == "Pod":
+                # Logs are per pod instance; a recreated same-name pod
+                # must not inherit its predecessor's stream.
+                self._pod_logs.pop((namespace or "", name), None)
             self._notify(gvk, WatchEvent("DELETED", obj))
             self._collect_orphans(obj)
 

@@ -37,7 +37,12 @@ def ensure_built(force: bool = False) -> str:
             or not os.path.exists(kft_bin)
         )
         if not stale:
-            lib_mtime = os.path.getmtime(_LIB_PATH)
+            # Oldest artifact decides: an edit to main.cpp (CLI-only)
+            # bumps only build/kft, and comparing against the .so alone
+            # would re-run make on every call forever.
+            lib_mtime = min(
+                os.path.getmtime(_LIB_PATH), os.path.getmtime(kft_bin)
+            )
             src_dir = os.path.join(_NATIVE_DIR, "src")
             # src_dir itself covers deletions (dir mtime bumps on unlink);
             # the Makefile covers flag changes.

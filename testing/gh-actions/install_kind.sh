@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Install KinD and create the integration cluster with fake-TPU worker
+# labels (role of the reference testing/gh-actions/install_kind.sh +
+# kind-1-25.yaml: real multi-node without a real cloud).
+set -euo pipefail
+
+KIND_VERSION="${KIND_VERSION:-v0.23.0}"
+CLUSTER_NAME="${CLUSTER_NAME:-kubeflow-tpu}"
+
+if ! command -v kind > /dev/null; then
+  curl -Lo ./kind "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-amd64"
+  chmod +x ./kind
+  sudo mv ./kind /usr/local/bin/kind
+fi
+
+kind create cluster --name "${CLUSTER_NAME}" \
+  --config "$(dirname "$0")/kind-config.yaml" --wait 120s
+kubectl cluster-info

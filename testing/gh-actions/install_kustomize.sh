@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Install kustomize (role of the reference
+# testing/gh-actions/install_kustomize.sh).
+set -euo pipefail
+
+KUSTOMIZE_VERSION="${KUSTOMIZE_VERSION:-v5.4.1}"
+
+if command -v kustomize > /dev/null; then
+  exit 0
+fi
+curl -sL \
+  "https://github.com/kubernetes-sigs/kustomize/releases/download/kustomize%2F${KUSTOMIZE_VERSION}/kustomize_${KUSTOMIZE_VERSION}_linux_amd64.tar.gz" \
+  | tar xz
+chmod +x kustomize
+sudo mv kustomize /usr/local/bin/kustomize

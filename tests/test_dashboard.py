@@ -226,6 +226,49 @@ class TestApi:
             "/api/metrics/bogus", headers=hdr()
         ).status_code == 404
 
+    def test_prometheus_metrics_service_range_query(self, api):
+        # Reference prometheus_metrics_service.ts behaviour: range query
+        # over the window, series of (ts, value) pairs.
+        from kubeflow_tpu.dashboard import create_app
+        from kubeflow_tpu.dashboard.metrics import (
+            PrometheusMetricsService,
+            make_metrics_service,
+        )
+
+        calls = []
+
+        def fake_get(url, params):
+            calls.append((url, params))
+            return {
+                "data": {
+                    "result": [
+                        {"values": [[1000, "0.5"], [1060, "0.75"]]}
+                    ]
+                }
+            }
+
+        svc = PrometheusMetricsService("http://prom:9090", http_get=fake_get)
+        app = create_app(api, metrics_service=svc)
+        client = app.test_client()
+        body = client.get(
+            "/api/metrics/podcpu?period=600", headers=hdr()
+        ).get_json()
+        assert body["series"] == [
+            {"timestamp": 1000, "value": 0.5},
+            {"timestamp": 1060, "value": 0.75},
+        ]
+        url, params = calls[0]
+        assert url == "http://prom:9090/api/v1/query_range"
+        assert "container_cpu_usage_seconds_total" in params["query"]
+
+        # Factory parity: no URL -> the 404-ing null service.
+        from kubeflow_tpu.dashboard.metrics import NoMetricsService
+
+        assert isinstance(make_metrics_service(None), NoMetricsService)
+        assert isinstance(
+            make_metrics_service("http://prom:9090"), PrometheusMetricsService
+        )
+
 
 class TestTpuFleet:
     def _node(self, api, name, accel, topo, chips):

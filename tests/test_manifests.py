@@ -99,6 +99,37 @@ class TestCrdParity:
         assert tpu["required"] == ["accelerator"]
 
 
+class TestCiTier:
+    """CI workflow + KinD installer contract (SURVEY.md §4 tier 5; role
+    of the reference's .github/workflows + testing/gh-actions)."""
+
+    REPO = os.path.join(os.path.dirname(__file__), "..")
+
+    def test_workflows_parse_and_cover_tiers(self):
+        wf_dir = os.path.join(self.REPO, ".github", "workflows")
+        names = sorted(os.listdir(wf_dir))
+        assert {"unit_tests.yaml", "native_build.yaml",
+                "images_build.yaml", "kind_integration.yaml"} <= set(names)
+        for name in names:
+            with open(os.path.join(wf_dir, name)) as fh:
+                doc = yaml.safe_load(fh)
+            assert doc.get("jobs"), name
+
+    def test_kind_scripts_executable_and_fake_tpu_labels(self):
+        gha = os.path.join(self.REPO, "testing", "gh-actions")
+        for script in ("install_kind.sh", "install_kustomize.sh"):
+            assert os.access(os.path.join(gha, script), os.X_OK), script
+        with open(os.path.join(gha, "kind-config.yaml")) as fh:
+            cfg = yaml.safe_load(fh)
+        workers = [n for n in cfg["nodes"] if n["role"] == "worker"]
+        assert workers, "kind config needs fake-TPU workers"
+        for worker in workers:
+            assert (
+                worker["labels"]["cloud.google.com/gke-tpu-accelerator"]
+                == "tpu-v5-lite-podslice"
+            )
+
+
 class TestWebhookRegistration:
     def test_webhook_scoped_to_profile_namespaces(self):
         """failurePolicy Fail + profile-namespace selector: identical
