@@ -14,6 +14,7 @@ import base64
 import http.server
 import json
 import logging
+import os
 import ssl
 import threading
 import urllib.parse
@@ -89,6 +90,7 @@ class WebhookServer:
         port: int = 4443,
         certfile: str | None = None,
         keyfile: str | None = None,
+        cert_watch_period_s: float = 10.0,
     ):
         self.handler = handler
         outer = self
@@ -129,12 +131,68 @@ class WebhookServer:
                 self.wfile.write(reply)
 
         self._server = http.server.ThreadingHTTPServer(("", port), _HTTPHandler)
+        self._ssl_context: ssl.SSLContext | None = None
+        self._cert_watcher: threading.Thread | None = None
+        self._cert_stop = threading.Event()
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile, keyfile)
             self._server.socket = ctx.wrap_socket(
                 self._server.socket, server_side=True
             )
+            self._ssl_context = ctx
+            self._start_cert_watcher(
+                certfile, keyfile, period_s=cert_watch_period_s
+            )
+
+    def _start_cert_watcher(
+        self, certfile: str, keyfile: str | None, period_s: float = 10.0
+    ) -> None:
+        """certwatcher parity (reference config.go:43-60): cert-manager
+        rotates the mounted secret in place; new handshakes must pick up
+        the fresh chain without a restart. mtime-polled; a mid-rotation
+        read (cert/key momentarily mismatched) just retries next tick."""
+
+        def mtimes():
+            out = []
+            for path in (certfile, keyfile):
+                if not path:
+                    continue
+                try:
+                    out.append(os.path.getmtime(path))
+                except OSError:
+                    out.append(None)
+            return out
+
+        last = mtimes()
+
+        def watch():
+            nonlocal last
+            warned_for = None
+            while not self._cert_stop.wait(period_s):
+                current = mtimes()
+                if current == last:
+                    continue
+                try:
+                    self._ssl_context.load_cert_chain(certfile, keyfile)
+                    last = current
+                    warned_for = None
+                    log.info("webhook TLS certificate reloaded")
+                except (ssl.SSLError, OSError):
+                    # One warning per distinct rotation attempt, not per
+                    # tick — a persistently unreadable key would
+                    # otherwise spam identical lines forever.
+                    if current != warned_for:
+                        warned_for = current
+                        log.warning(
+                            "webhook TLS reload failed (rotation in "
+                            "progress?); keeping previous certificate"
+                        )
+
+        self._cert_watcher = threading.Thread(
+            target=watch, name="webhook-certwatcher", daemon=True
+        )
+        self._cert_watcher.start()
 
     @property
     def port(self) -> int:
@@ -149,6 +207,7 @@ class WebhookServer:
         return thread
 
     def stop(self):
+        self._cert_stop.set()
         self._server.shutdown()
 
 
