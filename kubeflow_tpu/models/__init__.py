@@ -13,6 +13,9 @@ from kubeflow_tpu.models.train import (
     make_eval_step,
 )
 
+# Checkpoint helpers resolve lazily too (orbax import is heavy).
+_CKPT_EXPORTS = ("save_checkpoint", "restore_checkpoint", "latest_step")
+
 # Transformer/LM exports resolve lazily: transformer.py pulls in pallas +
 # the ring-attention stack, which ResNet-only consumers (bench.py, the
 # driver's entry()) shouldn't pay for at import time.
@@ -30,6 +33,10 @@ def __getattr__(name):
         from kubeflow_tpu.models import transformer
 
         return getattr(transformer, name)
+    if name in _CKPT_EXPORTS:
+        from kubeflow_tpu.models import checkpoint
+
+        return getattr(checkpoint, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -46,4 +53,7 @@ __all__ = [
     "build_lm",
     "create_lm_state",
     "make_lm_train_step",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
 ]

@@ -1,0 +1,102 @@
+"""Sharding-aware checkpoint save/restore for training state.
+
+The platform's persistence story is PVCs (reference: workspace volume +
+stop/restart semantics, SURVEY.md §5 checkpoint/resume); what runs
+*inside* the notebooks needs model checkpointing that understands
+sharded arrays — save from a dp×fsdp mesh, restore onto a different
+mesh (or a single chip) without materialising the full state on one
+host. Orbax handles the array chunks; this module pins down the
+TrainState round-trip:
+
+- ``tx``/``apply_fn`` are static (pytree_node=False) and never
+  serialised — the caller re-supplies them via the ``like`` template.
+- With a mesh, restore places each leaf with the canonical
+  dp/fsdp sharding (kubeflow_tpu.parallel.param_sharding), so a
+  restored state is immediately usable by the sharded train step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from kubeflow_tpu.models.train import state_shardings
+
+
+def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
+    """Write ``state`` (TrainState or any pytree of arrays) to ``path``.
+    Blocks until durable (the notebook PVC survives pod restarts; a
+    half-written checkpoint must not)."""
+    path = os.path.abspath(os.fspath(path))  # orbax requires absolute
+    with ocp.StandardCheckpointer() as ckptr:
+        target = os.path.join(path, str(step)) if step is not None else path
+        ckptr.save(target, _arrays_only(state))
+    return target if step is not None else path
+
+
+def restore_checkpoint(path: str | os.PathLike, like, mesh=None):
+    """Restore into the shape of ``like`` (a TrainState template from
+    ``create_train_state`` — supplies tx/apply_fn and leaf shapes).
+    With ``mesh``, leaves come back sharded dp×fsdp."""
+    path = os.path.abspath(os.fspath(path))  # orbax requires absolute
+    template = _arrays_only(like)
+    if mesh is not None:
+        shardings = state_shardings(template, mesh)
+        abstract = jax.tree.map(
+            lambda leaf, s: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=s
+            ),
+            template,
+            shardings,
+        )
+    else:
+        # Explicit single-device placement: without it orbax falls back
+        # to the sharding recorded at save time (wrong topology when a
+        # mesh-saved checkpoint restores on one chip, plus a slow path).
+        device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=device
+            ),
+            template,
+        )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    return _merge_static(like, restored)
+
+
+def latest_step(path: str | os.PathLike) -> int | None:
+    """Highest numbered step directory under ``path`` (save_checkpoint
+    with step=N layout), or None when no checkpoint exists."""
+    path = os.path.abspath(os.fspath(path))
+    try:
+        steps = [int(d) for d in os.listdir(path) if d.isdigit()]
+    except FileNotFoundError:
+        return None
+    return max(steps, default=None)
+
+
+def _arrays_only(state):
+    """TrainState -> plain dict of its array fields (static fields like
+    tx/apply_fn are not serialisable and restore from the template)."""
+    if hasattr(state, "params"):
+        return {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+    return state
+
+
+def _merge_static(like, restored):
+    if hasattr(like, "params"):
+        return like.replace(
+            step=restored["step"],
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=restored["opt_state"],
+        )
+    return restored
