@@ -172,6 +172,34 @@ def _build_notebook(form: dict, namespace: str, config: dict) -> tuple[dict, lis
             {"name": "dshm", "mountPath": "/dev/shm"}
         )
 
+    # -- affinity / tolerations groups (reference form.py:178-224:
+    # admin-defined presets picked by key; TPU placement itself comes
+    # from spec.tpu -> controller selectors, so these cover the CPU
+    # pools — dedicated-node affinity, preemptible tolerations, etc.) --
+    def placement_preset(section: str, id_field: str) -> dict | None:
+        """Admin preset picked by key, or None when unset; unknown keys
+        reject so typos can't silently skip placement."""
+        key = field(config, form, section, "") or ""
+        if not key or key == "none":
+            return None
+        defaults = config.get("spawnerFormDefaults") or {}
+        groups = (defaults.get(section) or {}).get("options") or []
+        match = next((g for g in groups if g.get(id_field) == key), None)
+        if match is None:
+            raise ApiError(f"unknown {section} {key!r}")
+        return match
+
+    affinity = placement_preset("affinityConfig", "configKey")
+    if affinity is not None:
+        nb["spec"]["template"]["spec"]["affinity"] = affinity.get(
+            "affinity", {}
+        )
+    tolerations = placement_preset("tolerationGroup", "groupKey")
+    if tolerations is not None:
+        nb["spec"]["template"]["spec"].setdefault("tolerations", []).extend(
+            tolerations.get("tolerations") or []
+        )
+
     # -- volumes (reference apps/common/volumes.py + form.py:271-299) --
     pvcs_to_create: list[dict] = []
 
@@ -220,33 +248,5 @@ def _build_notebook(form: dict, namespace: str, config: dict) -> tuple[dict, lis
         if not isinstance(data_vol, dict):
             raise ApiError("each data volume must be an object")
         add_volume(data_vol)
-
-    # -- tolerations / affinity groups (reference form.py:178-224) --
-    # Admin-defined groups; TPU scheduling itself is controller-owned
-    # (nodeSelector from spec.tpu), so these remain for CPU pools.
-    tol_group = field(config, form, "tolerationGroup", "")
-    if tol_group:
-        options = ((config.get("spawnerFormDefaults") or {})
-                   .get("tolerationGroup") or {}).get("options") or []
-        for option in options:
-            if option.get("groupKey") == tol_group:
-                nb["spec"]["template"]["spec"]["tolerations"] = option.get(
-                    "tolerations", []
-                )
-                break
-        else:
-            raise ApiError(f"unknown toleration group {tol_group!r}")
-    affinity = field(config, form, "affinityConfig", "")
-    if affinity:
-        options = ((config.get("spawnerFormDefaults") or {})
-                   .get("affinityConfig") or {}).get("options") or []
-        for option in options:
-            if option.get("configKey") == affinity:
-                nb["spec"]["template"]["spec"]["affinity"] = option.get(
-                    "affinity", {}
-                )
-                break
-        else:
-            raise ApiError(f"unknown affinity config {affinity!r}")
 
     return nb, pvcs_to_create

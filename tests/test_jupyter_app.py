@@ -347,6 +347,67 @@ class TestFormLogic:
         assert {"name": "dshm", "emptyDir": {"medium": "Memory"}} in vols
 
 
+class TestPlacementGroups:
+    """affinityConfig / tolerationGroup presets (reference
+    form.py:178-224): admin-defined placement for CPU pools, picked by
+    key; unknown keys rejected."""
+
+    def config(self):
+        return {
+            "spawnerFormDefaults": {
+                "image": {"value": "jupyter-jax-tpu:latest"},
+                "affinityConfig": {
+                    "value": "none",
+                    "options": [
+                        {
+                            "configKey": "pool-a",
+                            "affinity": {
+                                "nodeAffinity": {"x": "y"},
+                            },
+                        }
+                    ],
+                },
+                "tolerationGroup": {
+                    "value": "none",
+                    "options": [
+                        {
+                            "groupKey": "preempt",
+                            "tolerations": [
+                                {"key": "t", "operator": "Exists"}
+                            ],
+                        }
+                    ],
+                },
+            }
+        }
+
+    def test_affinity_and_tolerations_applied(self):
+        nb, _ = form_mod.build_notebook(
+            {"name": "nb", "affinityConfig": "pool-a",
+             "tolerationGroup": "preempt"},
+            "user", self.config(),
+        )
+        spec = nb["spec"]["template"]["spec"]
+        assert spec["affinity"] == {"nodeAffinity": {"x": "y"}}
+        assert spec["tolerations"] == [{"key": "t", "operator": "Exists"}]
+
+    def test_none_leaves_spec_clean(self):
+        nb, _ = form_mod.build_notebook({"name": "nb"}, "user", self.config())
+        spec = nb["spec"]["template"]["spec"]
+        assert "affinity" not in spec
+        assert "tolerations" not in spec
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ApiError, match="affinity"):
+            form_mod.build_notebook(
+                {"name": "nb", "affinityConfig": "nope"}, "user", self.config()
+            )
+        with pytest.raises(ApiError, match="toleration"):
+            form_mod.build_notebook(
+                {"name": "nb", "tolerationGroup": "nope"}, "user", self.config()
+            )
+
+
 class TestStatusMachine:
     def make(self, status=None, annotations=None, created=None):
         nb = {"metadata": {"name": "nb", "namespace": "ns"}}
