@@ -9,6 +9,7 @@ over ICI/DCN inserted by the compiler — never hand-written NCCL/MPI calls
 from kubeflow_tpu.parallel.mesh import (
     MeshSpec,
     make_mesh,
+    make_multislice_mesh,
     auto_mesh,
     batch_sharding,
     replicated,
@@ -23,6 +24,7 @@ from kubeflow_tpu.parallel.distributed import (
 __all__ = [
     "MeshSpec",
     "make_mesh",
+    "make_multislice_mesh",
     "auto_mesh",
     "batch_sharding",
     "replicated",

@@ -75,6 +75,57 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+def make_multislice_mesh(
+    spec: MeshSpec | None = None,
+    num_slices: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh spanning multiple TPU slices connected over DCN.
+
+    The scaling-book recipe: only data parallelism crosses the DCN
+    boundary (gradient all-reduce decomposes into a fast intra-slice
+    ICI phase and one inter-slice DCN phase); fsdp/tp/sp stay within a
+    slice on ICI. The dp axis is laid out slice-major so XLA can make
+    that split — dp must be divisible by ``num_slices``.
+
+    Devices are grouped by ``slice_index`` when the runtime exposes it
+    (real multislice via megascale); otherwise contiguous equal chunks
+    stand in (CPU test meshes).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_slices <= 1:
+        return make_mesh(spec, devices)
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by {num_slices} slices"
+        )
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    if spec.dp % num_slices:
+        raise ValueError(
+            f"dp={spec.dp} must be divisible by num_slices={num_slices}: "
+            "only data parallelism may cross the DCN boundary"
+        )
+    try:
+        devices.sort(key=lambda d: (d.slice_index, d.id))
+        groups: dict[int, int] = {}
+        for dev in devices:
+            groups[dev.slice_index] = groups.get(dev.slice_index, 0) + 1
+        per_slice = len(devices) // num_slices
+        if len(groups) != num_slices or set(groups.values()) != {per_slice}:
+            # An uneven grouping (e.g. a subset truncated mid-slice)
+            # would silently put fsdp/tp/sp collectives on DCN — the
+            # exact thing this layout exists to prevent.
+            raise ValueError(
+                f"devices span slices {dict(sorted(groups.items()))}, need "
+                f"exactly {num_slices} slices x {per_slice} devices"
+            )
+    except AttributeError:
+        pass  # no slice topology info: keep given order, chunk evenly
+    # After the slice-major sort, dp (the outermost mesh axis) enumerates
+    # whole slices first, so the plain row-major reshape is the layout.
+    return make_mesh(spec, devices)
+
+
 def auto_mesh(n_devices: int | None = None) -> Mesh:
     """Pure data-parallel mesh over all (or the first n) devices."""
     devices = jax.devices()

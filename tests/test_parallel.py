@@ -60,6 +60,62 @@ def test_auto_mesh_all_dp():
     assert mesh.shape["dp"] == 8
 
 
+class TestMultisliceMesh:
+    """DCN-spanning meshes (SURVEY.md §2.3: ICI intra-slice, DCN
+    multi-slice): only dp crosses the slice boundary, laid out
+    slice-major so the gradient all-reduce splits into ICI + DCN
+    phases."""
+
+    def test_dp_slice_major_layout(self):
+        from kubeflow_tpu.parallel import make_multislice_mesh
+
+        mesh = make_multislice_mesh(
+            MeshSpec(dp=4, fsdp=2), num_slices=2
+        )
+        assert mesh.shape == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1}
+        # dp rows 0-1 must be slice 0's devices (ids 0-3), rows 2-3
+        # slice 1's (ids 4-7): contiguous chunks stand in for
+        # slice_index on the CPU test platform.
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)
+        assert set(ids[:2].flatten()) == {0, 1, 2, 3}
+        assert set(ids[2:].flatten()) == {4, 5, 6, 7}
+
+    def test_non_dp_axis_cannot_cross_dcn(self):
+        from kubeflow_tpu.parallel import make_multislice_mesh
+
+        with pytest.raises(ValueError, match="data parallelism"):
+            make_multislice_mesh(MeshSpec(dp=1, fsdp=8), num_slices=2)
+
+    def test_single_slice_is_plain_mesh(self):
+        from kubeflow_tpu.parallel import make_multislice_mesh
+
+        mesh = make_multislice_mesh(MeshSpec(dp=8), num_slices=1)
+        assert mesh.shape["dp"] == 8
+
+    def test_train_step_runs_on_multislice_mesh(self):
+        from kubeflow_tpu.models import create_train_state, make_train_step, resnet18
+        from kubeflow_tpu.parallel import make_multislice_mesh
+
+        mesh = make_multislice_mesh(MeshSpec(dp=4, fsdp=2), num_slices=2)
+        model = resnet18(num_classes=8, width=8)
+        state = create_train_state(
+            model, jax.random.key(0), (2, 32, 32, 3), mesh=mesh
+        )
+        step = make_train_step(mesh=mesh)
+        rng = np.random.default_rng(0)
+        batch = jax.device_put(
+            {
+                "image": np.asarray(
+                    rng.normal(size=(16, 32, 32, 3)), np.float32
+                ),
+                "label": rng.integers(0, 8, size=(16,)),
+            },
+            batch_sharding(mesh),
+        )
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+
 class TestDistributedEnv:
     def test_single_host_defaults(self):
         denv = DistributedEnv.from_env({})
