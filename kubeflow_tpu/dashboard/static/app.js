@@ -90,6 +90,7 @@
     try { localStorage.setItem('selectedNamespace', ns); } catch (e) {}
     broadcastNamespace();
     refreshActivities();
+    refreshContributors();
   }
   nsSelect.addEventListener('change', function () {
     selectNamespace(nsSelect.value);
@@ -184,6 +185,83 @@
         ul.appendChild(li);
       });
   }
+
+  // ---- contributors (reference manage-users-view.js): list/add/remove
+  // for the selected namespace via the KFAM-backed workgroup API ----
+  function clearContribError() {
+    var el = document.getElementById('contrib-error');
+    if (el) { el.textContent = ''; }
+  }
+
+  function refreshContributors() {
+    // Bind this refresh to the namespace it was issued for: a click on
+    // a list rendered for A must never mutate B, and a late response
+    // for a namespace no longer selected is dropped.
+    var ns = state.namespace;
+    if (!ns) return;
+    getJson('/api/workgroup/get-contributors/' + encodeURIComponent(ns))
+      .then(function (data) {
+        if (ns !== state.namespace) return; // stale response
+        clearContribError();
+        document.getElementById('contrib-panel').hidden = false;
+        var ul = document.getElementById('contributors');
+        ul.innerHTML = '';
+        (data.contributors || []).forEach(function (email) {
+          var li = document.createElement('li');
+          li.className = 'contributor';
+          li.textContent = email + ' ';
+          var btn = document.createElement('button');
+          btn.textContent = 'remove';
+          btn.addEventListener('click', function () {
+            postJson('/api/workgroup/remove-contributor/' +
+                     encodeURIComponent(ns),
+                     { contributor: email }, 'DELETE')
+              .then(function () {
+                clearContribError();
+                refreshContributors();
+              })
+              .catch(function (err) {
+                showError(err.message, 'contrib-error',
+                  document.getElementById('contrib-controls'));
+              });
+          });
+          li.appendChild(btn);
+          ul.appendChild(li);
+        });
+        if (!(data.contributors || []).length) {
+          ul.innerHTML = '<li class="card-sub">Only the owner has ' +
+            'access.</li>';
+        }
+      })
+      .catch(function (err) {
+        if (ns !== state.namespace) return;
+        if (err.status === 503) {
+          // KFAM not deployed: contributor management simply isn't
+          // available — hide the panel rather than shouting.
+          document.getElementById('contrib-panel').hidden = true;
+          return;
+        }
+        document.getElementById('contributors').innerHTML = '';
+        showError('Could not load contributors: ' + err.message,
+          'contrib-error', document.getElementById('contrib-controls'));
+      });
+  }
+  document.getElementById('contrib-add').addEventListener('click',
+    function () {
+      var email = document.getElementById('contrib-email').value.trim();
+      if (!email || !state.namespace) return;
+      postJson('/api/workgroup/add-contributor/' +
+               encodeURIComponent(state.namespace), { contributor: email })
+        .then(function () {
+          document.getElementById('contrib-email').value = '';
+          clearContribError();
+          refreshContributors();
+        })
+        .catch(function (err) {
+          showError(err.message, 'contrib-error',
+            document.getElementById('contrib-controls'));
+        });
+    });
 
   function showRegistration() {
     document.getElementById('home-view').hidden = true;

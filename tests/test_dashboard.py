@@ -368,3 +368,15 @@ class TestServing:
         assert b"namespace-selected" in client.get("/library.js").data
         assert client.get("/../app.py").status_code == 404
         assert client.get("/%2e%2e/app.py").status_code == 404
+
+    def test_contributors_view_wired(self, dashboard):
+        # manage-users parity (reference manage-users-view.js): the SPA
+        # ships the contributors panel bound to the workgroup API.
+        client = dashboard.test_client()
+        index = client.get("/").data
+        assert b'id="contributors"' in index
+        assert b'id="contrib-add"' in index
+        js = client.get("/app.js").data
+        assert b"get-contributors" in js
+        assert b"add-contributor" in js
+        assert b"remove-contributor" in js
