@@ -40,7 +40,79 @@ def device_peak_flops(device) -> float:
     return 197e12
 
 
+def run_timed(step, state, batch_data, warmup: int, steps: int):
+    """Shared measurement harness. Sync via host fetch, not
+    block_until_ready: on the axon remote-TPU relay block_until_ready
+    returns before execution finishes (measured 1.6ms/step "throughput"
+    = 19x chip peak, physically impossible), while device_get forces the
+    full dependency chain to materialise. Returns (state, seconds)."""
+    if steps <= 0:
+        raise SystemExit("KFT_BENCH_STEPS must be >= 1")
+    metrics = None
+    for _ in range(warmup):
+        state, metrics = step(state, batch_data)
+    if metrics is not None:
+        float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+    return state, dt
+
+
+def bench_lm():
+    """Secondary mode (KFT_BENCH_MODE=lm): long-context LM training
+    tokens/s/chip through the Pallas flash-attention path — the
+    workload class the reference platform cannot even express
+    (SURVEY.md §2.3). Still one JSON line."""
+    batch = int(os.environ.get("KFT_BENCH_BATCH", "4"))
+    seq = int(os.environ.get("KFT_BENCH_SEQ", "2048"))
+    steps = int(os.environ.get("KFT_BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("KFT_BENCH_WARMUP", "4"))
+
+    from kubeflow_tpu.models import (
+        LMConfig,
+        build_lm,
+        create_lm_state,
+        make_lm_train_step,
+    )
+
+    cfg = LMConfig(
+        vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16
+    )
+    model = build_lm(cfg)
+    state = create_lm_state(model, jax.random.key(0), (1, seq))
+    step = make_lm_train_step()
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(batch, seq)), jnp.int32
+    )
+    batch_data = {"tokens": tokens}
+    state, dt = run_timed(step, state, batch_data, warmup, steps)
+    tokens_s = batch * seq * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "lm_train_tokens_per_sec_per_chip",
+                "value": round(tokens_s, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,
+                "seq": seq,
+                "batch": batch,
+                "step_ms": round(1000 * dt / steps, 2),
+                "device": str(jax.devices()[0].device_kind),
+            }
+        )
+    )
+
+
 def main():
+    if os.environ.get("KFT_BENCH_MODE") == "lm":
+        bench_lm()
+        return
     batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
     image_size = int(os.environ.get("KFT_BENCH_IMAGE_SIZE", "224"))
     steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
@@ -66,20 +138,7 @@ def main():
         "label": jnp.asarray(rng.integers(0, 1000, size=(batch,))),
     }
 
-    # Sync via host fetch, not block_until_ready: on the axon remote-TPU
-    # relay block_until_ready returns before execution finishes (measured
-    # 1.6ms/step "throughput" = 19x chip peak, physically impossible),
-    # while device_get forces the full dependency chain to materialise.
-    for _ in range(warmup):
-        state, metrics = step(state, batch_data)
-    float(jax.device_get(metrics["loss"]))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch_data)
-    final_loss = float(jax.device_get(metrics["loss"]))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
+    state, dt = run_timed(step, state, batch_data, warmup, steps)
 
     img_s = batch * steps / dt
     train_flops_per_img = 3.0 * resnet_flops_per_image("resnet50", image_size)

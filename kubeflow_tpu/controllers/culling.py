@@ -17,7 +17,12 @@ import time
 from typing import Callable
 
 from kubeflow_tpu import native
-from kubeflow_tpu.controllers.runtime import Controller, Request, WatchSpec
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    record_event,
+)
 from kubeflow_tpu.controllers.time_utils import parse_rfc3339
 from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
 
@@ -207,6 +212,13 @@ class CullingReconciler:
             )
             if decision["action"] == "stop":
                 log.info("culled idle notebook %s/%s", req.namespace, req.name)
+                record_event(
+                    self.api, notebook, "Culled",
+                    f"Notebook {req.name} idle past the threshold; "
+                    "scaled to zero (volumes retained)",
+                    component="notebook-culler",
+                    clock=self.clock,
+                )
                 if self.prom is not None:
                     # Reference NotebookCullingCount + culling-timestamp
                     # gauge (metrics.go:46-59).

@@ -18,6 +18,7 @@ from kubeflow_tpu.controllers.runtime import (
     Request,
     WatchSpec,
     ensure_object,
+    record_event,
 )
 from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
 
@@ -107,7 +108,14 @@ class NotebookReconciler:
         )
         try:
             sts_result = self._ensure(out["statefulset"])
-        except Exception:
+        except Exception as exc:
+            # EventRecorder parity (reference notebook_controller.go:139-169
+            # records create failures onto the CR).
+            record_event(
+                self.api, notebook, "CreateFailed",
+                f"StatefulSet for notebook {req.name} failed: {exc}",
+                event_type="Warning",
+            )
             if self.prom is not None:
                 # Only a failed *creation* counts (reference
                 # NotebookFailCreation); a Conflict while drift-repairing
@@ -119,10 +127,15 @@ class NotebookReconciler:
                         req.namespace
                     ).inc()
             raise
-        if sts_result == "created" and self.prom is not None:
-            # Counts new notebook materialisations, like the reference's
-            # NotebookCreation counter on first STS create.
-            self.prom.notebook_create_total.labels(req.namespace).inc()
+        if sts_result == "created":
+            record_event(
+                self.api, notebook, "Created",
+                f"Created StatefulSet for notebook {req.name}",
+            )
+            if self.prom is not None:
+                # Counts new notebook materialisations, like the
+                # reference's NotebookCreation counter on first create.
+                self.prom.notebook_create_total.labels(req.namespace).inc()
         for svc in out["services"]:
             self._ensure(svc)
         if out["virtualService"] is not None:

@@ -54,6 +54,19 @@ class TestNotebookController:
         assert api.get("v1", "Service", "nb", "user")
         assert api.get("v1", "Service", "nb-hosts", "user")
 
+    def test_create_records_event_once(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        ctrl.run_once()
+        ctrl.resync()
+        ctrl.run_once()  # steady state: no duplicate Created event
+        events = [
+            e for e in api.list("v1", "Event", namespace="user")
+            if e.get("reason") == "Created"
+        ]
+        assert len(events) == 1
+        assert events[0]["involvedObject"]["kind"] == "Notebook"
+
     def test_v5e16_multihost_statefulset(self, api):
         ctrl = make_notebook_controller(api)
         api.create(notebook_cr(tpu={"accelerator": "v5e", "topology": "4x4"}))
@@ -177,6 +190,19 @@ class TestCullingController:
                              "labels": {"notebook-name": "nb"}},
             }
         )
+
+    def test_cull_records_event(self, api):
+        # EventRecorder parity: the stop decision is visible in the
+        # namespace event stream (dashboard activities / kubectl).
+        idle_since = rfc3339(self.NOW - 120 * 60)
+        ctrl = self.make(api, kernels=[])
+        self.seed(api, annotations={
+            "notebooks.kubeflow.org/last-activity": idle_since})
+        ctrl.run_once()
+        events = api.list("v1", "Event", namespace="user")
+        culled = [e for e in events if e.get("reason") == "Culled"]
+        assert culled and culled[0]["involvedObject"]["name"] == "nb"
+        assert culled[0]["source"]["component"] == "notebook-culler"
 
     def test_active_notebook_annotated_not_stopped(self, api):
         ctrl = self.make(api, kernels=[
