@@ -99,6 +99,32 @@ class TestCrdParity:
         assert tpu["required"] == ["accelerator"]
 
 
+class TestAppAuthorizationPolicies:
+    """Per-app Istio AuthorizationPolicies (reference */manifests/base):
+    only ingress-gateway traffic — which carries the authenticated
+    userid header — reaches the web apps."""
+
+    APPS = ["jupyter-web-app", "volumes-web-app", "tensorboards-web-app",
+            "centraldashboard"]
+
+    def test_policy_selector_matches_deployment(self):
+        for app in self.APPS:
+            base = os.path.join(MANIFESTS, app, "base")
+            with open(os.path.join(base, "authorization-policy.yaml")) as fh:
+                policy = yaml.safe_load(fh)
+            with open(os.path.join(base, "deployment.yaml")) as fh:
+                deploy = yaml.safe_load(fh)
+            selector = policy["spec"]["selector"]["matchLabels"]
+            pod_labels = deploy["spec"]["template"]["metadata"]["labels"]
+            assert selector.items() <= pod_labels.items(), app
+            principals = policy["spec"]["rules"][0]["from"][0]["source"][
+                "principals"
+            ]
+            assert any("ingressgateway" in p for p in principals), app
+            with open(os.path.join(base, "kustomization.yaml")) as fh:
+                assert "authorization-policy.yaml" in fh.read(), app
+
+
 class TestCiTier:
     """CI workflow + KinD installer contract (SURVEY.md §4 tier 5; role
     of the reference's .github/workflows + testing/gh-actions)."""
