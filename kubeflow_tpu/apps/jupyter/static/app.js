@@ -219,6 +219,29 @@
     updateTpuHelp();
     root.appendChild(tpuHelp);
 
+    // Placement presets (CPU pools; TPU placement rides the tpu field).
+    function presetSelect(sectionName, idField, labelText) {
+      var cfg = section(sectionName);
+      var options = cfg.options || [];
+      if (!options.length) { return null; }
+      root.appendChild(KF.el('label', { text: labelText }));
+      var sel = KF.el('select', {}, [
+        KF.el('option', { value: 'none', text: 'None' }),
+      ].concat(options.map(function (o) {
+        return KF.el('option', {
+          value: o[idField],
+          text: o.displayName || o[idField],
+        });
+      })));
+      if (cfg.value) sel.value = cfg.value;
+      if (cfg.readOnly) sel.setAttribute('disabled', '');
+      root.appendChild(sel);
+      return sel;
+    }
+    f.affinity = presetSelect('affinityConfig', 'configKey', 'Affinity');
+    f.tolerations = presetSelect(
+      'tolerationGroup', 'groupKey', 'Tolerations');
+
     // PodDefault configurations.
     root.appendChild(KF.el('label', { text: 'Configurations' }));
     f.pdBox = KF.el('div', {});
@@ -274,6 +297,8 @@
             return cb.checked;
           }).map(function (cb) { return cb.value; }),
         };
+        if (f.affinity) { body.affinityConfig = f.affinity.value; }
+        if (f.tolerations) { body.tolerationGroup = f.tolerations.value; }
         if (f.customCheck && f.customCheck.checked) {
           body.customImageCheck = true;
           body.customImage = f.customImage.value.trim();

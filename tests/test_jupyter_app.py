@@ -347,6 +347,33 @@ class TestFormLogic:
         assert {"name": "dshm", "emptyDir": {"medium": "Memory"}} in vols
 
 
+class TestPlacementSpa:
+    def test_spa_ships_placement_selects(self):
+        client = client_for(FakeApiServer())
+        js = client.get("/app.js").data
+        assert b"affinityConfig" in js
+        assert b"tolerationGroup" in js
+
+    def test_spawn_with_default_config_presets(self):
+        # The shipped spawner config's presets work end-to-end.
+        api = FakeApiServer()
+        client = client_for(api)
+        headers = csrf_headers(client)
+        resp = post_json(
+            client, "/api/namespaces/alice/notebooks",
+            spawn_form(affinityConfig="dedicated-cpu-pool",
+                       tolerationGroup="preemptible"),
+            headers,
+        )
+        assert resp.status_code == 200, resp.get_json()
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "nb1", "alice")
+        spec = nb["spec"]["template"]["spec"]
+        assert "nodeAffinity" in spec["affinity"]
+        assert spec["tolerations"][0]["key"] == (
+            "cloud.google.com/gke-preemptible"
+        )
+
+
 class TestPlacementGroups:
     """affinityConfig / tolerationGroup presets (reference
     form.py:178-224): admin-defined placement for CPU pools, picked by
