@@ -35,6 +35,13 @@ class LMConfig:
     heads: int = 4
     mlp_ratio: int = 4
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
+    # MoE: 0 = dense FFN everywhere. With experts > 0, every
+    # ``moe_every``-th block swaps its FFN for a switch-routed expert
+    # layer whose expert dim shards over the mesh's ``ep`` axis.
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -52,9 +59,85 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
+class MoEFFN(nn.Module):
+    """Switch-style top-1 MoE FFN, TPU-native: dense one-hot dispatch
+    (static shapes — no gathers XLA can't tile), experts laid out on the
+    leading dim so the ``ep`` mesh axis shards them and the dispatch
+    einsum lowers to ICI all-to-alls. Over-capacity tokens fall through
+    the residual (standard Switch behaviour); a load-balance aux loss is
+    sowed under intermediates/moe_aux."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):  # (B, S, D) -> (B, S, D)
+        cfg = self.cfg
+        b, s, d = x.shape
+        e = cfg.moe_experts
+        cap = max(1, int(cfg.moe_capacity_factor * s / e))
+        hidden = cfg.mlp_ratio * d
+
+        # Router in f32: softmax over experts must not run in bf16.
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)          # (B, S, E)
+        expert = jnp.argmax(probs, axis=-1)              # (B, S)
+        gate = jnp.max(probs, axis=-1)                   # (B, S)
+
+        # Load-balance aux (Switch eq. 4): fraction of tokens vs fraction
+        # of router mass per expert.
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (B, S, E)
+        frac_tokens = onehot.mean(axis=(0, 1))
+        frac_probs = probs.mean(axis=(0, 1))
+        self.sow(
+            "intermediates", "moe_aux",
+            e * jnp.sum(frac_tokens * frac_probs),
+        )
+
+        # Position of each token within its expert's capacity buffer;
+        # tokens past the cap are dropped (residual carries them).
+        position = jnp.cumsum(onehot, axis=1) * onehot - 1.0   # (B, S, E)
+        keep = (position >= 0) & (position < cap)
+        dispatch = jnp.where(keep, 1.0, 0.0)                   # (B, S, E)
+        pos_onehot = jax.nn.one_hot(
+            jnp.clip(position, 0, cap - 1).astype(jnp.int32), cap,
+            dtype=jnp.float32,
+        )                                                      # (B, S, E, C)
+        dispatch_t = dispatch[..., None] * pos_onehot          # (B, S, E, C)
+        combine_t = dispatch_t * gate[..., None, None]
+
+        # To expert-major layout: with experts sharded on ep this einsum
+        # is the all-to-all.
+        expert_in = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch_t.astype(cfg.dtype),
+            x.astype(cfg.dtype),
+        )                                                      # (E, B, C, D)
+        w_up = self.param(
+            "experts_up", nn.initializers.lecun_normal(),
+            (e, d, hidden), jnp.float32,
+        )
+        w_down = self.param(
+            "experts_down", nn.initializers.lecun_normal(),
+            (e, hidden, d), jnp.float32,
+        )
+        h = jnp.einsum(
+            "ebcd,edh->ebch", expert_in, w_up.astype(cfg.dtype)
+        )
+        h = nn.gelu(h)
+        expert_out = jnp.einsum(
+            "ebch,ehd->ebcd", h, w_down.astype(cfg.dtype)
+        )
+        return jnp.einsum(
+            "bsec,ebcd->bsd", combine_t.astype(cfg.dtype), expert_out
+        )
+
+
 class Block(nn.Module):
     cfg: LMConfig
     attn_impl: AttnImpl | None = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -79,11 +162,14 @@ class Block(nn.Module):
                          name="proj")(out)
 
         h = RMSNorm()(x)
-        h = nn.Dense(cfg.mlp_ratio * cfg.dim, use_bias=False,
-                     dtype=cfg.dtype, name="up")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
-                         name="down")(h)
+        if self.use_moe:
+            x = x + MoEFFN(cfg, name="moe")(h)
+        else:
+            h = nn.Dense(cfg.mlp_ratio * cfg.dim, use_bias=False,
+                         dtype=cfg.dtype, name="up")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
+                             name="down")(h)
         return x
 
 
@@ -97,7 +183,11 @@ class TransformerLM(nn.Module):
         emb = nn.Embed(cfg.vocab, cfg.dim, dtype=cfg.dtype, name="embed")
         x = emb(tokens)
         for i in range(cfg.layers):
-            x = Block(cfg, attn_impl=self.attn_impl, name=f"block_{i}")(x)
+            use_moe = (
+                cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+            )
+            x = Block(cfg, attn_impl=self.attn_impl, use_moe=use_moe,
+                      name=f"block_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
         return emb.attend(x.astype(jnp.float32))
 
@@ -158,14 +248,36 @@ def create_lm_state(
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 
-def make_lm_train_step(mesh: Mesh | None = None):
+def _moe_aux_total(intermediates) -> jax.Array | float:
+    """Sum of sowed ``moe_aux`` values ONLY — other sowed intermediates
+    (diagnostics) must never leak into the loss."""
+    total = 0.0
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        intermediates, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    for path, leaf in flat:
+        names = [
+            str(getattr(p, "key", getattr(p, "name", ""))) for p in path
+        ]
+        if "moe_aux" in names and isinstance(leaf, tuple):
+            total = total + sum(jnp.sum(v) for v in leaf)
+    return total
+
+
+def make_lm_train_step(mesh: Mesh | None = None, moe_aux_weight: float = 0.01):
     """Jitted LM step; batch = {"tokens": (B, S) int32}. With a mesh, the
-    batch dim shards over (dp, fsdp) and the sequence dim over sp."""
+    batch dim shards over (dp, fsdp) and the sequence dim over sp.
+    ``moe_aux_weight`` scales the MoE load-balance loss (inert for dense
+    models — cfg.moe_aux_weight is the config-side source of truth)."""
 
     def step(state, batch):
         def loss_fn(params):
-            logits = state.apply_fn({"params": params}, batch["tokens"])
-            return lm_loss(logits, batch["tokens"])
+            logits, mods = state.apply_fn(
+                {"params": params}, batch["tokens"],
+                mutable=["intermediates"],
+            )
+            aux = _moe_aux_total(mods.get("intermediates", {}))
+            return lm_loss(logits, batch["tokens"]) + moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = state.tx.update(

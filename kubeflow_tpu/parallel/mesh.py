@@ -9,6 +9,8 @@ are fixed platform-wide so models, optimizers, and checkpoints agree:
                all-gathered just-in-time; rides ICI)
 - ``"tp"``   — tensor parallel (hidden/heads dimension)
 - ``"sp"``   — sequence/context parallel (ring attention over ICI)
+- ``"ep"``   — expert parallel (MoE experts sharded; token dispatch
+               rides ICI all-to-alls)
 
 A v5e-16 slice (4 hosts x 4 chips) with ``MeshSpec(dp=2, fsdp=4, tp=2)``
 yields a 16-device mesh; XLA lays collectives onto the ICI torus.
@@ -24,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "tp", "sp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,25 +41,29 @@ class MeshSpec:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
-        fixed = self.fsdp * self.tp * self.sp
+        fixed = self.fsdp * self.tp * self.sp * self.ep
         dp = self.dp
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*tp*sp={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*tp*sp*ep={fixed}"
                 )
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
+                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.ep} "
+                f"!= {n_devices} devices"
             )
-        return MeshSpec(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp)
+        return MeshSpec(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp,
+                        ep=self.ep)
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+        return (self.dp, self.fsdp, self.tp, self.sp, self.ep)
 
 
 def make_mesh(
@@ -143,6 +149,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _is_expert_stack(path: tuple) -> bool:
+    """True for MoE expert weight stacks. The contract with the model
+    layer (models/transformer.py MoEFFN) is the parameter NAME: leaves
+    whose final path key starts with ``experts_`` carry experts on dim 0.
+    Deliberately exact-prefix on the last key only — a module merely
+    named *experts* elsewhere must not trip ep sharding."""
+    if not path:
+        return False
+    entry = path[-1]
+    key = getattr(entry, "key", None) or getattr(entry, "name", None)
+    return bool(key) and str(key).startswith("experts_")
+
+
 def param_sharding(mesh: Mesh, path: tuple, leaf: jax.ShapeDtypeStruct):
     """Canonical parameter sharding: shard the largest dim that divides
     evenly over ``fsdp`` (zero-redundancy style); replicate small leaves.
@@ -150,6 +169,26 @@ def param_sharding(mesh: Mesh, path: tuple, leaf: jax.ShapeDtypeStruct):
     Works for any pytree path; models with explicit tp layouts override
     this per-module instead.
     """
+    # MoE expert stacks shard their leading (expert) dim over ep — the
+    # dispatch einsums then lower to all-to-alls over that axis. The
+    # remaining dims still get fsdp (expert weights are the largest
+    # params in an MoE; replicating them across fsdp would waste exactly
+    # the HBM zero-redundancy exists to save).
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1 and _is_expert_stack(path) and leaf.shape:
+        if leaf.shape[0] % ep == 0:
+            spec = [None] * len(leaf.shape)
+            spec[0] = "ep"
+            fsdp_n = mesh.shape["fsdp"]
+            if fsdp_n > 1:
+                for d in sorted(
+                    range(1, len(leaf.shape)), key=lambda d: -leaf.shape[d]
+                ):
+                    if leaf.shape[d] % fsdp_n == 0:
+                        spec[d] = "fsdp"
+                        break
+            return NamedSharding(mesh, P(*spec))
+
     fsdp = mesh.shape["fsdp"]
     if fsdp == 1 or not leaf.shape or math.prod(leaf.shape) < 2**14:
         return replicated(mesh)

@@ -181,3 +181,80 @@ class TestTransformerLM:
         np.testing.assert_allclose(
             float(m1["loss"]), float(m2["loss"]), atol=1e-4
         )
+
+
+class TestMoE:
+    """Expert-parallel MoE (switch top-1, dense dispatch): experts shard
+    over the ``ep`` mesh axis; dispatch einsums become all-to-alls."""
+
+    def _setup(self, mesh=None, experts=4):
+        from kubeflow_tpu.models.transformer import (
+            LMConfig,
+            build_lm,
+            create_lm_state,
+            make_lm_train_step,
+        )
+
+        cfg = LMConfig(
+            vocab=128, layers=2, dim=64, heads=2,
+            moe_experts=experts, moe_every=2,
+        )
+        model = build_lm(cfg, mesh=mesh)
+        state = create_lm_state(model, jax.random.key(0), (2, 64), mesh=mesh)
+        return model, state, make_lm_train_step(mesh)
+
+    def test_moe_trains_single_chip(self):
+        model, state, step = self._setup()
+        assert "moe" in state.params["block_1"], "block_1 must be MoE"
+        assert "up" in state.params["block_0"], "block_0 stays dense"
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (4, 64)), jnp.int32
+        )
+        prev = None
+        for _ in range(5):
+            state, metrics = step(state, {"tokens": tokens})
+            cur = float(metrics["loss"])
+            assert np.isfinite(cur)
+            prev = cur
+        assert prev < 6.0  # actually learning, aux included
+
+    def test_moe_aux_sowed(self):
+        model, state, _ = self._setup()
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        _, mods = model.apply(
+            {"params": state.params}, tokens, mutable=["intermediates"]
+        )
+        aux = mods["intermediates"]["block_1"]["moe"]["moe_aux"]
+        # Perfectly balanced routing gives aux = 1.0; anything >= 1 is
+        # the Switch lower bound.
+        assert float(aux[0]) >= 1.0 - 1e-6
+
+    def test_experts_shard_over_ep(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=1, tp=1, sp=1, ep=4))
+        model, state, step = self._setup(mesh=mesh)
+        w = state.params["block_1"]["moe"]["experts_up"]
+        assert not w.sharding.is_fully_replicated
+        spec = w.sharding.spec
+        assert spec[0] == "ep"
+        # One full step executes with the expert all-to-all layout.
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (4, 64)), jnp.int32
+        )
+        state, metrics = step(state, {"tokens": tokens})
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_moe_matches_itself_across_layouts(self):
+        # ep-sharded forward (experts genuinely distributed, dispatch
+        # einsums lowered with the all-to-all layout) == unsharded
+        # forward with the same params.
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 128, (2, 32)), jnp.int32
+        )
+        model, state, _ = self._setup(experts=8)
+        mesh = make_mesh(MeshSpec(dp=1, fsdp=1, tp=1, sp=1, ep=8))
+        model_ep, state_ep, _ = self._setup(mesh=mesh, experts=8)
+        w = state_ep.params["block_1"]["moe"]["experts_up"]
+        assert w.sharding.spec[0] == "ep", "experts must actually shard"
+        logits = model.apply({"params": state.params}, tokens)
+        logits_ep = model_ep.apply({"params": state_ep.params}, tokens)
+        np.testing.assert_allclose(logits, logits_ep, atol=1e-4)
