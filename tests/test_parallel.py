@@ -116,6 +116,29 @@ class TestMultisliceMesh:
         assert np.isfinite(float(metrics["loss"]))
 
 
+def test_tp_axis_mesh_trains():
+    # tp>1 meshes execute end-to-end (params replicate over tp until a
+    # model opts into explicit tp layouts; the axis is load-bearing for
+    # the mesh shape and batch sharding).
+    from kubeflow_tpu.models import create_train_state, make_train_step, resnet18
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    model = resnet18(num_classes=8, width=8)
+    state = create_train_state(model, jax.random.key(0), (2, 32, 32, 3),
+                               mesh=mesh)
+    step = make_train_step(mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(
+        {
+            "image": np.asarray(rng.normal(size=(8, 32, 32, 3)), np.float32),
+            "label": rng.integers(0, 8, size=(8,)),
+        },
+        batch_sharding(mesh),
+    )
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 class TestDistributedEnv:
     def test_single_host_defaults(self):
         denv = DistributedEnv.from_env({})
