@@ -26,6 +26,15 @@ from kubeflow_tpu.parallel import param_sharding
 
 AttnImpl = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
+# Megatron tp layout for this model's kernels: column-parallel into the
+# block (q/k/v/up: out dim -> tp), row-parallel out (proj/down: in dim
+# -> tp), so each pair costs one all-reduce, inserted by XLA. Passed to
+# parallel.param_sharding by create_lm_state (tp is opt-in per model).
+LM_TP_RULES = {
+    "q_proj": 1, "k_proj": 1, "v_proj": 1, "up": 1,
+    "proj": 0, "down": 0,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class LMConfig:
@@ -144,9 +153,15 @@ class Block(nn.Module):
         cfg = self.cfg
         b, s, _ = x.shape
         h = RMSNorm()(x)
-        qkv = nn.Dense(3 * cfg.dim, use_bias=False, dtype=cfg.dtype,
-                       name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # Separate q/k/v projections (not a fused 3*dim kernel): each
+        # output dim is head-major, so column-sharding over tp cuts on
+        # whole-head boundaries — the Megatron layout's requirement for
+        # the single post-proj all-reduce (see parallel/mesh.py
+        # _tp_kernel_dim + LM_TP_RULES).
+        proj = lambda name: nn.Dense(
+            cfg.dim, use_bias=False, dtype=cfg.dtype, name=name
+        )(h)
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
 
         def heads(t):  # (B, S, dim) -> (B, H, S, head_dim)
             return t.reshape(b, s, cfg.heads, cfg.head_dim).transpose(
@@ -243,7 +258,10 @@ def create_lm_state(
         return init_fn(rng)
     abstract = jax.eval_shape(init_fn, rng)
     shardings = jax.tree_util.tree_map_with_path(
-        lambda path, leaf: param_sharding(mesh, path, leaf), abstract
+        lambda path, leaf: param_sharding(
+            mesh, path, leaf, tp_rules=LM_TP_RULES
+        ),
+        abstract,
     )
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 

@@ -149,25 +149,46 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _path_key(entry) -> str:
+    """String key of one tree-path entry (DictKey / GetAttrKey / …)."""
+    key = getattr(entry, "key", None)
+    if key is None:
+        key = getattr(entry, "name", None)
+    return "" if key is None else str(key)
+
+
+def _tp_kernel_dim(path: tuple, tp_rules: dict | None) -> int | None:
+    """Which dim of a 2D Dense kernel shards over tp, per the MODEL's
+    explicit rules ({module name -> dim}). Models opt in by passing
+    rules (e.g. the LM's Megatron layout, transformer.py LM_TP_RULES);
+    generic models never get tp sharding by accident."""
+    if not tp_rules or len(path) < 2 or _path_key(path[-1]) != "kernel":
+        return None
+    return tp_rules.get(_path_key(path[-2]))
+
+
 def _is_expert_stack(path: tuple) -> bool:
     """True for MoE expert weight stacks. The contract with the model
     layer (models/transformer.py MoEFFN) is the parameter NAME: leaves
     whose final path key starts with ``experts_`` carry experts on dim 0.
     Deliberately exact-prefix on the last key only — a module merely
     named *experts* elsewhere must not trip ep sharding."""
-    if not path:
-        return False
-    entry = path[-1]
-    key = getattr(entry, "key", None) or getattr(entry, "name", None)
-    return bool(key) and str(key).startswith("experts_")
+    return bool(path) and _path_key(path[-1]).startswith("experts_")
 
 
-def param_sharding(mesh: Mesh, path: tuple, leaf: jax.ShapeDtypeStruct):
+def param_sharding(
+    mesh: Mesh,
+    path: tuple,
+    leaf: jax.ShapeDtypeStruct,
+    tp_rules: dict | None = None,
+):
     """Canonical parameter sharding: shard the largest dim that divides
     evenly over ``fsdp`` (zero-redundancy style); replicate small leaves.
 
-    Works for any pytree path; models with explicit tp layouts override
-    this per-module instead.
+    Works for any pytree path. Tensor parallelism is strictly opt-in:
+    a model passes ``tp_rules`` ({module name -> kernel dim}) to place
+    its projection kernels on the tp axis (the LM's Megatron layout);
+    without rules the tp axis replicates params.
     """
     # MoE expert stacks shard their leading (expert) dim over ep — the
     # dispatch einsums then lower to all-to-alls over that axis. The
@@ -187,6 +208,21 @@ def param_sharding(mesh: Mesh, path: tuple, leaf: jax.ShapeDtypeStruct):
                     if leaf.shape[d] % fsdp_n == 0:
                         spec[d] = "fsdp"
                         break
+            return NamedSharding(mesh, P(*spec))
+
+    # Megatron-style tp for the model's declared projection kernels;
+    # fsdp takes the other dim when it divides.
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and len(leaf.shape) == 2:
+        tp_dim = _tp_kernel_dim(path, tp_rules)
+        if tp_dim is not None and leaf.shape[tp_dim] % tp == 0:
+            spec = [None, None]
+            spec[tp_dim] = "tp"
+            other = 1 - tp_dim
+            if mesh.shape["fsdp"] > 1 and (
+                leaf.shape[other] % mesh.shape["fsdp"] == 0
+            ):
+                spec[other] = "fsdp"
             return NamedSharding(mesh, P(*spec))
 
     fsdp = mesh.shape["fsdp"]
