@@ -181,6 +181,24 @@ class ManagerServer:
                     self.send_response(200)
                     self.end_headers()
                     self.wfile.write(b"ok")
+                elif self.path == "/debug/threads":
+                    # pprof-style live-thread dump (the reference gets
+                    # this from controller-runtime's pprof listener).
+                    import sys
+                    import traceback
+
+                    lines = []
+                    for tid, frame in sys._current_frames().items():
+                        lines.append(f"--- thread {tid} ---")
+                        lines.extend(
+                            line.rstrip()
+                            for line in traceback.format_stack(frame)
+                        )
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/readyz":
                     ok = outer.ready()
                     self.send_response(200 if ok else 503)

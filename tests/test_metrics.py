@@ -113,6 +113,9 @@ class TestControllerMetrics:
             with urllib.request.urlopen(base + "/metrics") as resp:
                 text = resp.read().decode()
             assert "service_heartbeat_total" in text
+            with urllib.request.urlopen(base + "/debug/threads") as resp:
+                dump = resp.read().decode()
+            assert "--- thread" in dump  # pprof-style dump serves
         finally:
             server.stop()
 
