@@ -159,9 +159,14 @@ class ManagerServer:
         metrics: ControllerMetrics,
         port: int = 0,
         ready: Callable[[], bool] | None = None,
+        enable_debug: bool = False,
     ):
         self.metrics = metrics
         self.ready = ready or (lambda: True)
+        # The stack-dump endpoint exposes source paths and execution
+        # state; like controller-runtime's pprof listener it is strictly
+        # opt-in (KFT_ENABLE_DEBUG_ENDPOINTS=true in a manager binary).
+        self.enable_debug = enable_debug
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -181,7 +186,7 @@ class ManagerServer:
                     self.send_response(200)
                     self.end_headers()
                     self.wfile.write(b"ok")
-                elif self.path == "/debug/threads":
+                elif self.path == "/debug/threads" and outer.enable_debug:
                     # pprof-style live-thread dump (the reference gets
                     # this from controller-runtime's pprof listener).
                     import sys

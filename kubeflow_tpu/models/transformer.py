@@ -269,14 +269,14 @@ def create_lm_state(
 def _moe_aux_total(intermediates) -> jax.Array | float:
     """Sum of sowed ``moe_aux`` values ONLY — other sowed intermediates
     (diagnostics) must never leak into the loss."""
-    from kubeflow_tpu.parallel.mesh import _path_key
+    from kubeflow_tpu.parallel.mesh import path_key
 
     total = 0.0
     flat, _ = jax.tree_util.tree_flatten_with_path(
         intermediates, is_leaf=lambda x: isinstance(x, tuple)
     )
     for path, leaf in flat:
-        if any(_path_key(p) == "moe_aux" for p in path) and isinstance(
+        if any(path_key(p) == "moe_aux" for p in path) and isinstance(
             leaf, tuple
         ):
             total = total + sum(jnp.sum(v) for v in leaf)

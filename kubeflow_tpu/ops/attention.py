@@ -1,14 +1,17 @@
-"""Flash attention: Pallas TPU kernel + XLA reference + RoPE.
+"""Flash attention: Pallas TPU kernels (fwd + bwd) + XLA reference + RoPE.
 
 The forward pass is a tiled online-softmax kernel (grid over
 (batch*heads, q-blocks, k-blocks); softmax statistics and the output
-accumulator live in VMEM scratch across the k dimension, so the S x S
-score matrix is never materialised in HBM). The backward pass recomputes
-through the XLA reference implementation — O(S^2) peak memory in the
-bwd, fine at single-chip sequence lengths; long-context training uses
-:mod:`kubeflow_tpu.ops.ring` which scans over sequence shards instead.
+accumulator live in VMEM scratch across the k dimension). The backward
+is the FlashAttention-2 two-kernel scheme: attention probabilities are
+recomputed blockwise from q/k and the saved per-row logsumexp, dq
+accumulates over the k sweep and dk/dv over the q sweep — so neither
+direction ever materialises the S x S score matrix in HBM, and training
+runs at sequence lengths where the XLA reference OOMs. For sequences too
+long for one chip, :mod:`kubeflow_tpu.ops.ring` shards the sequence over
+the mesh instead.
 
-Off-TPU (CPU test meshes) the kernel runs in Pallas interpret mode, so
+Off-TPU (CPU test meshes) the kernels run in Pallas interpret mode, so
 numerics are identical everywhere.
 """
 
@@ -54,9 +57,14 @@ def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k,
+    q_ref, k_ref, v_ref, o_ref, *rest,
+    scale, causal, block_q, block_k,
 ):
+    # rest = (lse_ref?, m_scr, l_scr, acc_scr): the lse output exists
+    # only on the VJP forward — inference forwards skip the extra HBM
+    # store entirely (pallas outputs are opaque to XLA DCE).
+    lse_ref = rest[0] if len(rest) == 4 else None
+    m_scr, l_scr, acc_scr = rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -105,9 +113,16 @@ def _flash_kernel(
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Per-row logsumexp: the only softmax state the backward
+            # needs. Stored (bh, 8, S) — the fixed 8-sublane pad
+            # satisfies the TPU block-tiling rule (last two dims 8x128).
+            lse = (m_scr[:, :1] + jnp.log(l_scr[:, :1])).reshape(1, -1)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   with_lse=False):
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
     if s_q % block_q or s_k % block_k:
@@ -121,7 +136,15 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     vr = v.reshape(bh, s_k, d)
     grid = (bh, s_q // block_q, s_k // block_k)
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+        )
+        out_shape.append(jax.ShapeDtypeStruct((bh, 8, s_q), jnp.float32))
+
+    result = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
@@ -132,8 +155,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum l
@@ -141,7 +164,169 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(batch, heads, s_q, d)
+    if with_lse:
+        out, lse = result
+        # lse: (bh, 8, s_q) sublane-padded row stats
+        return out.reshape(batch, heads, s_q, d), lse
+    return result[0].reshape(batch, heads, s_q, d)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, block_q, block_k,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # (bk, d)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale   # (bq, bk)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # (bk, d)
+
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """Tiled backward (the FlashAttention-2 two-kernel scheme): P is
+    recomputed blockwise from q/k and the saved logsumexp, so the bwd —
+    like the fwd — never materialises the S x S score matrix in HBM."""
+    batch, heads, s_q, d = q.shape
+    s_k = k.shape[2]
+    bh = batch * heads
+    qr = q.reshape(bh, s_q, d)
+    kr = k.reshape(bh, s_k, d)
+    vr = v.reshape(bh, s_k, d)
+    dor = g.reshape(bh, s_q, d)
+    lser = lse  # (bh, 8, s_q) sublane-padded, straight from the fwd
+    # delta_i = rowsum(dO ∘ O) (cheap elementwise + reduce in XLA),
+    # stored in the same 8-sublane layout as lse.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(bh, 1, s_q)
+    delta = jnp.broadcast_to(delta, (bh, 8, s_q))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, s_q // block_q, s_k // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dk/dv accumulate over q blocks: swap the grid's middle axis to the
+    # k blocks so the scratch accumulators live across the q sweep.
+    qT_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowT_spec = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, s_k // block_k, s_q // block_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    shape = (batch, heads, s_q, d)
+    kshape = (batch, heads, s_k, d)
+    return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -150,17 +335,17 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret, with_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: mha_reference(q, k, v, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -149,7 +149,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def _path_key(entry) -> str:
+def path_key(entry) -> str:
     """String key of one tree-path entry (DictKey / GetAttrKey / …)."""
     key = getattr(entry, "key", None)
     if key is None:
@@ -162,9 +162,9 @@ def _tp_kernel_dim(path: tuple, tp_rules: dict | None) -> int | None:
     explicit rules ({module name -> dim}). Models opt in by passing
     rules (e.g. the LM's Megatron layout, transformer.py LM_TP_RULES);
     generic models never get tp sharding by accident."""
-    if not tp_rules or len(path) < 2 or _path_key(path[-1]) != "kernel":
+    if not tp_rules or len(path) < 2 or path_key(path[-1]) != "kernel":
         return None
-    return tp_rules.get(_path_key(path[-2]))
+    return tp_rules.get(path_key(path[-2]))
 
 
 def _is_expert_stack(path: tuple) -> bool:
@@ -173,7 +173,7 @@ def _is_expert_stack(path: tuple) -> bool:
     whose final path key starts with ``experts_`` carry experts on dim 0.
     Deliberately exact-prefix on the last key only — a module merely
     named *experts* elsewhere must not trip ep sharding."""
-    return bool(path) and _path_key(path[-1]).startswith("experts_")
+    return bool(path) and path_key(path[-1]).startswith("experts_")
 
 
 def param_sharding(

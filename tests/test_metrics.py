@@ -113,6 +113,20 @@ class TestControllerMetrics:
             with urllib.request.urlopen(base + "/metrics") as resp:
                 text = resp.read().decode()
             assert "service_heartbeat_total" in text
+            # Debug endpoints are strictly opt-in (stack dumps leak
+            # source layout): 404 by default.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + "/debug/threads")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_debug_threads_opt_in(self, api):
+        prom = ControllerMetrics(api)
+        server = ManagerServer(prom, port=0, enable_debug=True)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
             with urllib.request.urlopen(base + "/debug/threads") as resp:
                 dump = resp.read().decode()
             assert "--- thread" in dump  # pprof-style dump serves
