@@ -35,7 +35,6 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
     my_shard = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     scale = q.shape[-1] ** -0.5 if scale is None else scale
-    qf = q.astype(jnp.float32)
     shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step(carry, t):
@@ -43,7 +42,14 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
         # After t clockwise rotations this device holds the shard that
         # originated on device (my_shard - t) mod axis_size.
         src = (my_shard - t) % axis_size
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_t.astype(jnp.float32)) * scale
+        # Matmuls keep the input dtype (bf16 in production) with f32
+        # accumulation — casting operands to f32 would force the slow
+        # MXU path (same rule as the flash kernel). Softmax statistics
+        # and the output accumulator stay f32.
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_t,
+            preferred_element_type=jnp.float32,
+        ) * scale
         if causal:
             s = _causal_mask(s, my_shard * s_local, src * s_local)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -51,7 +57,8 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
         p = jnp.exp(s - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32)
+            "bhqk,bhkd->bhqd", p.astype(v_t.dtype), v_t,
+            preferred_element_type=jnp.float32,
         )
         # Rotate k/v one ICI hop (the final rotation returns them home —
         # a wasted hop, but it keeps the scan body uniform).
@@ -64,7 +71,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
     # folded with per-device scores; mark them varying up front so the
     # scan carry type is stable (shard_map VMA checking).
     init = (
-        jax.lax.pvary(jnp.zeros(qf.shape, jnp.float32), axis_name),
+        jax.lax.pvary(jnp.zeros(q.shape, jnp.float32), axis_name),
         jax.lax.pvary(jnp.full(stats_shape, NEG_INF, jnp.float32), axis_name),
         jax.lax.pvary(jnp.zeros(stats_shape, jnp.float32), axis_name),
         k,
