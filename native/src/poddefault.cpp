@@ -16,6 +16,42 @@ std::string pd_name(const Json& pd) {
   return meta ? meta->get_string("name") : "";
 }
 
+// K8s resource quantity -> double for magnitude comparison ("500m",
+// "2Gi", "4", plain numbers). Returns -1 when unparsable so the caller
+// can skip the comparison rather than mis-order.
+double parse_resource_quantity(const Json& value) {
+  if (value.is_number()) return value.as_double();
+  if (!value.is_string()) return -1.0;
+  const std::string& s = value.as_string();
+  if (s.empty()) return -1.0;
+  size_t pos = 0;
+  double base;
+  try {
+    base = std::stod(s, &pos);
+  } catch (...) {
+    return -1.0;
+  }
+  const std::string suffix = s.substr(pos);
+  if (suffix.empty()) return base;
+  if (suffix == "n") return base / 1e9;
+  if (suffix == "u") return base / 1e6;
+  if (suffix == "m") return base / 1000.0;
+  if (suffix == "k") return base * 1e3;
+  if (suffix == "M") return base * 1e6;
+  if (suffix == "G") return base * 1e9;
+  if (suffix == "T") return base * 1e12;
+  if (suffix == "P") return base * 1e15;
+  if (suffix == "E") return base * 1e18;
+  const double ki = 1024.0;
+  if (suffix == "Ki") return base * ki;
+  if (suffix == "Mi") return base * ki * ki;
+  if (suffix == "Gi") return base * ki * ki * ki;
+  if (suffix == "Ti") return base * ki * ki * ki * ki;
+  if (suffix == "Pi") return base * ki * ki * ki * ki * ki;
+  if (suffix == "Ei") return base * ki * ki * ki * ki * ki * ki;
+  return -1.0;
+}
+
 // ---- conflict-checked list merges ----------------------------------------
 // Each merger records conflicts for keyed collisions with differing
 // values; identical duplicates are always tolerated (idempotent
@@ -137,6 +173,52 @@ void apply_one(Json& pod, const Json& pd,
   if (const Json* sidecars = spec->find("sidecars"))
     merge_keyed_list(pod_spec["containers"], *sidecars, "name", "sidecar",
                      source, conflicts);
+
+  // Per-container resource defaults (reference mergeResources,
+  // main.go:215-250): absent keys are set; present keys keep the
+  // SMALLER value (defaults act as caps — same outcome as the
+  // reference's Cmp==-1 overwrite). Divergence: the reference writes
+  // request defaults into Limits (a bug); requests here go to requests.
+  if (const Json* res = spec->find("resources")) {
+    // Only sections the PodDefault actually sets are written (touching
+    // cres["limits"] unconditionally would inject JSON nulls into the
+    // admission patch). Like the other per-container merges above,
+    // initContainers are covered too.
+    auto merge_res_map = [&](Json& cres, const char* section) {
+      const Json* defaults = res->find(section);
+      if (defaults == nullptr || !defaults->is_object()) return;
+      Json& target = cres[section];
+      if (!target.is_object()) target = Json::object();
+      for (const auto& member : defaults->members()) {
+        const Json* cur = target.find(member.first);
+        if (cur == nullptr) {
+          target[member.first] = member.second;
+        } else {
+          double cur_q = parse_resource_quantity(*cur);
+          double def_q = parse_resource_quantity(member.second);
+          if (def_q >= 0 && cur_q >= 0 && def_q < cur_q)
+            target[member.first] = member.second;
+        }
+      }
+    };
+    const Json* lim = res->find("limits");
+    const Json* reqs = res->find("requests");
+    const bool has_defaults = (lim != nullptr && lim->is_object()) ||
+                              (reqs != nullptr && reqs->is_object());
+    auto merge_res_containers = [&](Json* containers) {
+      if (containers == nullptr || !containers->is_array()) return;
+      for (auto& c : containers->items()) {
+        Json& cres = c["resources"];
+        if (!cres.is_object()) cres = Json::object();
+        merge_res_map(cres, "limits");
+        merge_res_map(cres, "requests");
+      }
+    };
+    if (has_defaults) {
+      merge_res_containers(pod_spec.find("containers"));
+      merge_res_containers(pod_spec.find("initContainers"));
+    }
+  }
 
   if (const Json* sa = spec->find("serviceAccountName")) {
     if (sa->is_string()) {

@@ -476,6 +476,47 @@ class TestPodDefaultMutate:
         )
         assert out2["matched"] == []
 
+    def test_resources_merge_caps_and_fills(self):
+        """Reference mergeResources (main.go:215-250): absent resource
+        keys are filled from the default; present keys keep the smaller
+        value (defaults act as caps). Divergence from the reference:
+        request defaults land in requests (the reference writes them
+        into Limits — a bug)."""
+        pd = make_poddefault(
+            "caps",
+            resources={
+                "limits": {"memory": "2Gi", "cpu": "500m",
+                           "google.com/tpu": "4"},
+                "requests": {"memory": "1Gi"},
+            },
+        )
+        pod = make_pod(containers=[{
+            "name": "c",
+            "image": "i",
+            "resources": {"limits": {"memory": "8Gi", "cpu": "250m"}},
+        }])
+        out = invoke("poddefault_mutate", {"pod": pod, "poddefaults": [pd]})
+        res = out["pod"]["spec"]["containers"][0]["resources"]
+        assert res["limits"]["memory"] == "2Gi"       # capped down
+        assert res["limits"]["cpu"] == "250m"         # existing smaller kept
+        assert res["limits"]["google.com/tpu"] == "4"  # filled
+        assert res["requests"]["memory"] == "1Gi"      # requests, not limits
+
+    def test_resources_limits_only_leaves_requests_absent(self):
+        # A limits-only default must not inject a null/empty requests
+        # section into the patch; initContainers get the caps too.
+        pd = make_poddefault(
+            "caps", resources={"limits": {"memory": "1Gi"}}
+        )
+        pod = make_pod(containers=[{"name": "c", "image": "i"}])
+        pod["spec"]["initContainers"] = [{"name": "dl", "image": "i"}]
+        out = invoke("poddefault_mutate", {"pod": pod, "poddefaults": [pd]})
+        res = out["pod"]["spec"]["containers"][0]["resources"]
+        assert res["limits"]["memory"] == "1Gi"
+        assert "requests" not in res
+        init_res = out["pod"]["spec"]["initContainers"][0]["resources"]
+        assert init_res["limits"]["memory"] == "1Gi"
+
     def test_idempotent_remutation(self):
         """Applying the same poddefaults to an already-mutated pod is a no-op."""
         pd = make_poddefault("tpu-env", env=[{"name": "A", "value": "1"}])
