@@ -141,8 +141,48 @@ class NotebookReconciler:
         if out["virtualService"] is not None:
             self._ensure(out["virtualService"])
 
+        self._gang_restart(notebook, req)
         self._update_status(notebook)
         return None
+
+    def _gang_restart(self, notebook: dict, req: Request) -> None:
+        """SURVEY §7 hard part (b): a lone rank restart wedges the rest
+        of the slice's jax.distributed — recycle all pods together. The
+        decision (restart-counter bookkeeping) is native policy
+        (native/src/notebook.cpp notebook_gang_restart)."""
+        if not (notebook.get("spec") or {}).get("tpu"):
+            return
+        pods = self.api.list(
+            "v1", "Pod", namespace=req.namespace,
+            label_selector=f"notebook-name={req.name}",
+        )
+        decision = native.invoke(
+            "notebook_gang_restart", {"notebook": notebook, "pods": pods}
+        )
+        if decision["action"] == "none":
+            return
+        if decision["action"] == "restart":
+            record_event(
+                self.api, notebook, "GangRestart",
+                "A replica restarted; recycling all "
+                f"{len(decision['deletePods'])} pods so jax.distributed "
+                "re-forms the slice",
+                event_type="Warning",
+            )
+            # Deletes BEFORE the baseline advance: the deletes are
+            # idempotent, so a crash mid-loop retries the restart on the
+            # next pass — advancing the baseline first would record the
+            # crash as handled while pods are still wedged.
+            for pod_name in decision["deletePods"]:
+                try:
+                    self.api.delete("v1", "Pod", pod_name, req.namespace)
+                except NotFound:
+                    pass
+        self.api.patch_merge(
+            NOTEBOOK_API, "Notebook", req.name,
+            {"metadata": {"annotations": decision["annotations"]}},
+            req.namespace,
+        )
 
     def _update_status(self, notebook: dict) -> None:
         name = notebook["metadata"]["name"]

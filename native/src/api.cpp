@@ -49,6 +49,12 @@ const std::map<std::string, Handler>& handlers() {
                                                 ? in.at("events")
                                                 : Json::array());
        }},
+      {"notebook_gang_restart",
+       [](const Json& in) {
+         return notebook_gang_restart(
+             in.at("notebook"),
+             in.contains("pods") ? in.at("pods") : Json::array());
+       }},
       {"poddefault_mutate",
        [](const Json& in) {
          return poddefault_mutate(in.at("pod"), in.at("poddefaults"));

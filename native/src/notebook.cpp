@@ -342,6 +342,102 @@ Json notebook_reconcile(const Json& notebook, const Json& options) {
   return out;
 }
 
+Json notebook_gang_restart(const Json& notebook, const Json& pods) {
+  const char* kObservedKey = "notebooks.kubeflow-tpu.org/observed-restarts";
+  Json out = Json::object();
+  out["action"] = Json("none");
+  out["deletePods"] = Json::array();
+  out["annotations"] = Json::object();
+
+  // Single-host notebooks: the STS restart is the whole story.
+  const Json* spec = notebook.find("spec");
+  const Json* tpu = spec ? spec->find("tpu") : nullptr;
+  if (tpu == nullptr) return out;
+  TpuSlice slice = parse_tpu_slice(tpu->get_string("accelerator"),
+                                   tpu->get_string("topology", "1x1"));
+  if (slice.num_hosts <= 1) return out;
+
+  // Per-pod restart counters (a single aggregate would let one pod's
+  // counter reset — node replacement — mask another pod's crash in the
+  // same window).
+  Json current = Json::object();
+  if (pods.is_array()) {
+    for (const auto& p : pods.items()) {
+      const Json* pmeta = p.find("metadata");
+      if (pmeta == nullptr) continue;
+      int64_t restarts = 0;
+      if (const Json* pst = p.find("status")) {
+        if (const Json* css = pst->find("containerStatuses")) {
+          if (css->is_array())
+            for (const auto& cs : css->items())
+              restarts += cs.get_int("restartCount", 0);
+        }
+      }
+      current[pmeta->get_string("name")] = Json(restarts);
+    }
+  }
+
+  Json observed = Json::object();
+  bool have_observed = false;
+  if (const Json* meta = notebook.find("metadata")) {
+    if (const Json* anns = meta->find("annotations")) {
+      const std::string raw = anns->get_string(kObservedKey);
+      if (!raw.empty()) {
+        try {
+          observed = Json::parse(raw);
+          have_observed = observed.is_object();
+        } catch (...) {
+          have_observed = false;
+        }
+      }
+    }
+  }
+
+  Json anns = Json::object();
+  anns[kObservedKey] = Json(current.dump());
+  if (!have_observed) {
+    out["action"] = Json("observe");
+    out["annotations"] = anns;
+    return out;
+  }
+
+  // A crash = a pod present in BOTH maps whose counter advanced. New
+  // pods and counter regressions (recreated pods) only re-baseline.
+  bool crashed = false;
+  bool changed = false;
+  for (const auto& member : current.members()) {
+    const Json* prev = observed.find(member.first);
+    if (prev == nullptr) {
+      changed = true;
+      continue;
+    }
+    const int64_t now_n = member.second.as_int();
+    const int64_t prev_n = prev->as_int();
+    if (now_n > prev_n) crashed = true;
+    if (now_n != prev_n) changed = true;
+  }
+  if (observed.members().size() != current.members().size()) changed = true;
+
+  if (crashed) {
+    // Some rank crashed and came back alone — its jax.distributed
+    // peers are wedged. Recycle every pod of the slice; the parallel
+    // StatefulSet brings them back together and the coordinator env
+    // re-forms the slice.
+    out["action"] = Json("restart");
+    Json del = Json::array();
+    if (pods.is_array())
+      for (const auto& p : pods.items())
+        if (const Json* meta = p.find("metadata"))
+          del.push_back(Json(meta->get_string("name")));
+    out["deletePods"] = del;
+    out["annotations"] = anns;
+  } else if (changed) {
+    out["action"] = Json("observe");
+    out["annotations"] = anns;
+  }
+  return out;
+}
+
 Json notebook_status(const Json& /*notebook*/, const Json& sts, const Json& pod,
                      const Json& events) {
   Json status = Json::object();

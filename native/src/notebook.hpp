@@ -24,6 +24,18 @@ namespace kft {
 // Returns {"statefulset":…, "services":[…], "virtualService":…|null}.
 Json notebook_reconcile(const Json& notebook, const Json& options);
 
+// Gang-restart decision for multi-host notebooks (SURVEY.md §7 hard
+// part b): a StatefulSet restarts a crashed rank alone, but
+// jax.distributed needs the whole slice to re-form — so when any
+// replica's restart counter advances, every pod of the slice is
+// recycled together. Tracked per pod via an observed-restarts
+// annotation (JSON map name -> count); counter regressions (pods
+// recreated, counts reset) only re-baseline.
+// Input: {"notebook": ..., "pods": [...]}; output: {"action":
+// "none"|"observe"|"restart", "deletePods": [names...],
+// "annotations": {...}}.
+Json notebook_gang_restart(const Json& notebook, const Json& pods);
+
 // Derives Notebook status from the owned StatefulSet + rank-0 Pod +
 // warning events: {"readyReplicas", "containerState", "conditions": […]}.
 Json notebook_status(const Json& notebook, const Json& sts, const Json& pod,

@@ -183,8 +183,10 @@ void apply_one(Json& pod, const Json& pd,
     // Only sections the PodDefault actually sets are written (touching
     // cres["limits"] unconditionally would inject JSON nulls into the
     // admission patch). Like the other per-container merges above,
-    // initContainers are covered too.
-    auto merge_res_map = [&](Json& cres, const char* section) {
+    // initContainers are covered too. Limits cap (present keys keep the
+    // smaller value); requests only FILL absent keys — lowering a
+    // user's explicit request would under-schedule their workload.
+    auto merge_res_map = [&](Json& cres, const char* section, bool cap) {
       const Json* defaults = res->find(section);
       if (defaults == nullptr || !defaults->is_object()) return;
       Json& target = cres[section];
@@ -193,7 +195,7 @@ void apply_one(Json& pod, const Json& pd,
         const Json* cur = target.find(member.first);
         if (cur == nullptr) {
           target[member.first] = member.second;
-        } else {
+        } else if (cap) {
           double cur_q = parse_resource_quantity(*cur);
           double def_q = parse_resource_quantity(member.second);
           if (def_q >= 0 && cur_q >= 0 && def_q < cur_q)
@@ -210,8 +212,23 @@ void apply_one(Json& pod, const Json& pd,
       for (auto& c : containers->items()) {
         Json& cres = c["resources"];
         if (!cres.is_object()) cres = Json::object();
-        merge_res_map(cres, "limits");
-        merge_res_map(cres, "requests");
+        merge_res_map(cres, "limits", /*cap=*/true);
+        merge_res_map(cres, "requests", /*cap=*/false);
+        // A capped limit must drag any larger request down with it —
+        // request > limit is an invalid pod the apiserver rejects.
+        Json* limits = cres.find("limits");
+        Json* requests = cres.find("requests");
+        if (limits != nullptr && limits->is_object() &&
+            requests != nullptr && requests->is_object()) {
+          for (const auto& member : limits->members()) {
+            Json* req_val = requests->find(member.first);
+            if (req_val == nullptr) continue;
+            double lim_q = parse_resource_quantity(member.second);
+            double req_q = parse_resource_quantity(*req_val);
+            if (lim_q >= 0 && req_q >= 0 && req_q > lim_q)
+              *req_val = member.second;
+          }
+        }
       }
     };
     if (has_defaults) {

@@ -162,6 +162,109 @@ class TestNotebookController:
         assert rv1 == rv2  # no spurious writes
 
 
+class TestGangRestart:
+    """Hard part (b): one rank's crash must recycle the whole slice
+    (jax.distributed cannot re-form around a lone restarted pod)."""
+
+    def seed_multihost(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr(tpu={"accelerator": "v5e", "topology": "4x4"}))
+        ctrl.run_once()
+        for i in range(4):
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"nb-{i}", "namespace": "user",
+                             "labels": {"notebook-name": "nb"}},
+                "status": {"containerStatuses": [{"restartCount": 0}]},
+            })
+        ctrl.run_once()  # observes the baseline
+        return ctrl
+
+    def test_rank_restart_recycles_all_pods(self, api):
+        ctrl = self.seed_multihost(api)
+        import json as json_mod
+
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        observed = json_mod.loads(
+            nb["metadata"]["annotations"][
+                "notebooks.kubeflow-tpu.org/observed-restarts"
+            ]
+        )
+        assert observed == {f"nb-{i}": 0 for i in range(4)}
+        # Rank 2 crashes and restarts alone.
+        api.patch_merge(
+            "v1", "Pod", "nb-2",
+            {"status": {"containerStatuses": [{"restartCount": 1}]}},
+            "user",
+        )
+        ctrl.run_once()
+        remaining = [
+            p["metadata"]["name"]
+            for p in api.list("v1", "Pod", namespace="user")
+        ]
+        assert remaining == []  # whole slice recycled
+        events = [
+            e for e in api.list("v1", "Event", namespace="user")
+            if e.get("reason") == "GangRestart"
+        ]
+        assert events and events[0]["type"] == "Warning"
+
+    def test_recreated_pods_rebaseline_without_restart(self, api):
+        ctrl = self.seed_multihost(api)
+        api.patch_merge(
+            "v1", "Pod", "nb-2",
+            {"status": {"containerStatuses": [{"restartCount": 1}]}},
+            "user",
+        )
+        ctrl.run_once()
+        # Kubelet recreates the pods with fresh counters.
+        for i in range(4):
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"nb-{i}", "namespace": "user",
+                             "labels": {"notebook-name": "nb"}},
+                "status": {"containerStatuses": [{"restartCount": 0}]},
+            })
+        ctrl.run_once()
+        # Recreated pods (fresh counters) re-baseline without a second
+        # restart.
+        assert len(api.list("v1", "Pod", namespace="user")) == 4
+        ctrl.run_once()
+        assert len(api.list("v1", "Pod", namespace="user")) == 4
+
+    def test_reset_cannot_mask_sibling_crash(self, api):
+        # nb-0 is replaced (counter resets) in the same window nb-1
+        # crashes: per-pod tracking still sees nb-1's advance.
+        ctrl = self.seed_multihost(api)
+        api.delete("v1", "Pod", "nb-0", "user")
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nb-0", "namespace": "user",
+                         "labels": {"notebook-name": "nb"}},
+            "status": {"containerStatuses": [{"restartCount": 0}]},
+        })
+        api.patch_merge(
+            "v1", "Pod", "nb-1",
+            {"status": {"containerStatuses": [{"restartCount": 1}]}},
+            "user",
+        )
+        ctrl.run_once()
+        assert api.list("v1", "Pod", namespace="user") == []
+
+    def test_single_host_never_gang_restarts(self, api):
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr(tpu={"accelerator": "v5e", "topology": "1x1"}))
+        ctrl.run_once()
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nb-0", "namespace": "user",
+                         "labels": {"notebook-name": "nb"}},
+            "status": {"containerStatuses": [{"restartCount": 3}]},
+        })
+        ctrl.run_once()
+        assert len(api.list("v1", "Pod", namespace="user")) == 1
+
+
 class TestCullingController:
     NOW = 1_800_000_000
 

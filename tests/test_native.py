@@ -517,6 +517,29 @@ class TestPodDefaultMutate:
         init_res = out["pod"]["spec"]["initContainers"][0]["resources"]
         assert init_res["limits"]["memory"] == "1Gi"
 
+    def test_request_never_lowered_and_follows_capped_limit(self):
+        pd = make_poddefault(
+            "caps",
+            resources={
+                "limits": {"memory": "2Gi"},
+                "requests": {"cpu": "100m"},
+            },
+        )
+        pod = make_pod(containers=[{
+            "name": "c", "image": "i",
+            "resources": {
+                "limits": {"memory": "8Gi"},
+                "requests": {"memory": "4Gi", "cpu": "2"},
+            },
+        }])
+        out = invoke("poddefault_mutate", {"pod": pod, "poddefaults": [pd]})
+        res = out["pod"]["spec"]["containers"][0]["resources"]
+        assert res["limits"]["memory"] == "2Gi"    # capped
+        # The capped limit drags the now-invalid request down with it;
+        # the explicit cpu request is never lowered by a request default.
+        assert res["requests"]["memory"] == "2Gi"
+        assert res["requests"]["cpu"] == "2"
+
     def test_idempotent_remutation(self):
         """Applying the same poddefaults to an already-mutated pod is a no-op."""
         pd = make_poddefault("tpu-env", env=[{"name": "A", "value": "1"}])
