@@ -32,6 +32,7 @@ import copy
 import json
 import subprocess
 import sys
+import sys
 import threading
 import time
 import traceback
@@ -39,7 +40,11 @@ from pathlib import Path
 
 import yaml
 
-from kubeflow_tpu.k8s.fake import Conflict
+# Direct script execution (`python loadtest/start_notebooks.py`) from
+# anywhere: the repo root carries the kubeflow_tpu package.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.k8s.fake import Conflict  # noqa: E402
 
 HERE = Path(__file__).resolve().parent
 
