@@ -51,6 +51,14 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
         self._stop = threading.Event()
+        # Locally-observed renewal tracking (client-go leaderelection
+        # semantics): expiry is measured from when *this* candidate first
+        # saw the current (resourceVersion, renewTime), not from the
+        # holder's clock — tolerates inter-replica clock skew, so a
+        # standby with a fast clock cannot prematurely steal a healthy
+        # leader's lease.
+        self._observed: tuple | None = None
+        self._observed_at: float = 0.0
 
     @property
     def is_leader(self) -> bool:
@@ -71,13 +79,26 @@ class LeaderElector:
             },
         }
 
+    def _observe(self, lease: dict) -> None:
+        """Record when this candidate first saw the lease's current
+        renewal; a changed (holderIdentity, renewTime) restarts the
+        locally-measured expiry window. Spec fields only — keying on
+        resourceVersion would let unrelated metadata writes (kubectl
+        annotate, policy controllers) keep resetting the window and
+        block failover from a wedged leader forever."""
+        spec = lease.get("spec") or {}
+        key = (spec.get("holderIdentity"), spec.get("renewTime"))
+        if key != self._observed:
+            self._observed = key
+            self._observed_at = self.clock()
+
     def _expired(self, lease: dict) -> bool:
         spec = lease.get("spec") or {}
         renew = parse_rfc3339(spec.get("renewTime", ""))
         if renew is None:
             return True
         duration = spec.get("leaseDurationSeconds", self.lease_duration_s)
-        return self.clock() - renew > duration
+        return self.clock() - self._observed_at > duration
 
     def try_acquire_or_renew(self) -> bool:
         """One election round. Returns whether this candidate now leads.
@@ -96,8 +117,11 @@ class LeaderElector:
                 self._set_leading(False)
                 return False
 
+        self._observe(lease)
         holder = (lease.get("spec") or {}).get("holderIdentity")
-        if holder == self.identity or self._expired(lease):
+        # An empty holder marks a voluntarily released lease (see
+        # release()) — acquirable without waiting out observed expiry.
+        if not holder or holder == self.identity or self._expired(lease):
             transitions = (lease.get("spec") or {}).get("leaseTransitions", 0)
             if holder != self.identity:
                 transitions += 1
@@ -143,6 +167,10 @@ class LeaderElector:
                 LEASE_API, "Lease", self.lease_name, self.namespace
             )
             if (lease.get("spec") or {}).get("holderIdentity") == self.identity:
+                # Empty holder = released (client-go convention); expiry
+                # is measured from *observation* locally, so a past
+                # renewTime alone would not signal standbys.
+                lease["spec"]["holderIdentity"] = ""
                 lease["spec"]["renewTime"] = rfc3339(
                     int(self.clock() - self.lease_duration_s - 1)
                 )

@@ -230,23 +230,43 @@ class NamespaceLabelsFile:
 
         self.path = pathlib.Path(path)
         self._mtime: float | None = None
+        self._stat_err: str | None = None
         self.labels: dict = {}
         self.load()
+
+    def _stat(self) -> tuple[float | None, str | None]:
+        """(mtime, error). A transient OSError (e.g. EACCES during a
+        ConfigMap remount) is a distinct observed state, not a crash —
+        changed()/load() treat it like any other state transition so
+        the one-attempt-per-change guard holds."""
+        try:
+            return self.path.stat().st_mtime, None
+        except FileNotFoundError:
+            return None, None
+        except OSError as exc:
+            return None, f"{type(exc).__name__}: {exc}"
 
     def load(self) -> None:
         import yaml
 
+        mtime, err = self._stat()
+        prev_err, self._mtime, self._stat_err = self._stat_err, mtime, err
+        if err is not None:
+            if err != prev_err:
+                log.warning("namespace labels file %s unreadable (%s); "
+                            "keeping previous labels", self.path, err)
+            return
+        if mtime is None:
+            self.labels = {}
+            return
         try:
-            self._mtime = self.path.stat().st_mtime
             data = yaml.safe_load(self.path.read_text())
-        except FileNotFoundError:
-            self._mtime = None
-            data = {}
         except Exception:
-            # Malformed file (invalid YAML, mid-write read): keep the
-            # previous label set rather than killing the controller
-            # loop; _mtime was already advanced above so this is one
-            # attempt per file change, not a retry storm.
+            # Malformed or unreadable content (invalid YAML, mid-write
+            # read, EACCES on open): keep the previous label set rather
+            # than killing the controller loop; _mtime was already
+            # advanced above so this is one attempt per file change,
+            # not a retry storm.
             log.exception("namespace labels file %s unreadable; keeping "
                           "previous labels", self.path)
             return
@@ -257,11 +277,8 @@ class NamespaceLabelsFile:
         self.labels = {str(k): str(v) for k, v in data.items() if v is not None}
 
     def changed(self) -> bool:
-        try:
-            mtime = self.path.stat().st_mtime
-        except FileNotFoundError:
-            mtime = None
-        return mtime != self._mtime
+        mtime, err = self._stat()
+        return (mtime, err) != (self._mtime, self._stat_err)
 
 
 @dataclasses.dataclass

@@ -36,14 +36,28 @@ def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
     return target if step is not None else path
 
 
-def restore_checkpoint(path: str | os.PathLike, like, mesh=None):
+def restore_checkpoint(path: str | os.PathLike, like, mesh=None,
+                       tp_rules: dict | None = None):
     """Restore into the shape of ``like`` (a TrainState template from
     ``create_train_state`` — supplies tx/apply_fn and leaf shapes).
-    With ``mesh``, leaves come back sharded dp×fsdp."""
+    With ``mesh``, leaves come back sharded with the save-time canonical
+    layout: when ``like``'s leaves are committed arrays on ``mesh``
+    (the template from create_train_state/create_lm_state), their actual
+    shardings are reused verbatim — including Megatron tp layouts — and
+    ``tp_rules`` covers abstract templates (pass the model's rules, e.g.
+    transformer.LM_TP_RULES, or tp-sharded kernels restore replicated)."""
     path = os.path.abspath(os.fspath(path))  # orbax requires absolute
     template = _arrays_only(like)
     if mesh is not None:
-        shardings = state_shardings(template, mesh)
+        computed = state_shardings(template, mesh, tp_rules=tp_rules)
+
+        def pick(leaf, fallback):
+            s = getattr(leaf, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding) and s.mesh == mesh:
+                return s
+            return fallback
+
+        shardings = jax.tree.map(pick, template, computed)
         abstract = jax.tree.map(
             lambda leaf, s: jax.ShapeDtypeStruct(
                 leaf.shape, leaf.dtype, sharding=s

@@ -88,10 +88,14 @@ def create_train_state(
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 
-def state_shardings(state_or_abstract, mesh: Mesh):
+def state_shardings(state_or_abstract, mesh: Mesh, tp_rules: dict | None = None):
+    """Canonical sharding per leaf. ``tp_rules`` must match what the
+    model passed at creation time (e.g. transformer.LM_TP_RULES) or a
+    tp-sharded state would come back tp-replicated."""
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: param_sharding(
-            mesh, path, jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+            mesh, path, jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            tp_rules=tp_rules,
         ),
         state_or_abstract,
     )

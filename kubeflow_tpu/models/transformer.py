@@ -283,11 +283,19 @@ def _moe_aux_total(intermediates) -> jax.Array | float:
     return total
 
 
-def make_lm_train_step(mesh: Mesh | None = None, moe_aux_weight: float = 0.01):
+def make_lm_train_step(
+    mesh: Mesh | None = None,
+    moe_aux_weight: float | None = None,
+    cfg: LMConfig | None = None,
+):
     """Jitted LM step; batch = {"tokens": (B, S) int32}. With a mesh, the
     batch dim shards over (dp, fsdp) and the sequence dim over sp.
-    ``moe_aux_weight`` scales the MoE load-balance loss (inert for dense
-    models — cfg.moe_aux_weight is the config-side source of truth)."""
+    The MoE load-balance loss weight comes from ``cfg.moe_aux_weight``
+    when a config is supplied (the config-side source of truth); an
+    explicit ``moe_aux_weight`` overrides it, and with neither the
+    LMConfig default applies (inert for dense models)."""
+    if moe_aux_weight is None:
+        moe_aux_weight = (cfg or LMConfig()).moe_aux_weight
 
     def step(state, batch):
         def loss_fn(params):

@@ -31,9 +31,12 @@ int main(int argc, char** argv) {
     return 1;
   }
   std::cout << out << "\n";
-  // "ok":false results exit nonzero so shell pipelines can branch.
-  const bool ok = std::strstr(out, "\"ok\":true") != nullptr ||
-                  std::strstr(out, "\"ok\": true") != nullptr;
+  // "ok":false results exit nonzero so shell pipelines can branch. The
+  // serializer emits a fixed {"ok":true prefix; checking the *prefix*
+  // (not a substring anywhere in the response) means an error reply
+  // whose escaped payload happens to contain the literal cannot yield
+  // a false exit 0.
+  const bool ok = std::strncmp(out, "{\"ok\":true", 10) == 0;
   kft_free(out);
   return ok ? 0 : 1;
 }

@@ -84,6 +84,57 @@ class TestCheckpoint:
         assert int(new_state.step) == 2
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_restore_reproduces_tp_megatron_layout(self, tmp_path):
+        """An LM state saved from a tp mesh must restore with the
+        Megatron kernel layout (column/row-split projections), not
+        tp-replicated — via the template's actual shardings or, for an
+        abstract template, explicit tp_rules (ADVICE r1 medium)."""
+        from kubeflow_tpu.models import (
+            LMConfig,
+            build_lm,
+            create_lm_state,
+            make_lm_train_step,
+        )
+        from kubeflow_tpu.models.transformer import LM_TP_RULES
+
+        mesh = make_mesh(MeshSpec(dp=-1, tp=2), jax.devices()[:4])
+        cfg = LMConfig(vocab=64, layers=1, dim=32, heads=2)
+        model = build_lm(cfg, mesh=mesh)
+        state = create_lm_state(model, jax.random.key(0), (2, 16), mesh=mesh)
+        step = make_lm_train_step(mesh, cfg=cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, size=(4, 16)), jnp.int32
+        )
+        state, _ = step(state, {"tokens": tokens})
+        save_checkpoint(tmp_path / "lm", state)
+
+        def tp_split_count(params):
+            return sum(
+                1
+                for leaf in jax.tree.leaves(params)
+                if isinstance(
+                    getattr(leaf, "sharding", None), jax.sharding.NamedSharding
+                )
+                and "tp" in tuple(leaf.sharding.spec)
+            )
+
+        want = tp_split_count(state.params)
+        assert want > 0, "fixture LM has no tp-sharded kernels"
+
+        # Template carries real shardings -> reused verbatim.
+        like = create_lm_state(model, jax.random.key(1), (2, 16), mesh=mesh)
+        restored = restore_checkpoint(tmp_path / "lm", like, mesh=mesh)
+        assert tp_split_count(restored.params) == want
+        assert tree_equal(restored.params, state.params)
+
+        # Abstract template (host-side leaves) -> tp_rules restores the
+        # same layout.
+        host_like = jax.tree.map(np.asarray, like)
+        restored2 = restore_checkpoint(
+            tmp_path / "lm", host_like, mesh=mesh, tp_rules=LM_TP_RULES
+        )
+        assert tp_split_count(restored2.params) == want
+
     def test_stepped_layout_and_latest(self, trained_state, tmp_path):
         save_checkpoint(tmp_path / "run", trained_state, step=100)
         save_checkpoint(tmp_path / "run", trained_state, step=250)

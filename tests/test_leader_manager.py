@@ -66,6 +66,10 @@ class TestLeaderElector:
         a = LeaderElector(api, "nbc", "pod-a", clock=clock)
         b = LeaderElector(api, "nbc", "pod-b", clock=clock)
         assert a.try_acquire_or_renew()
+        # b must first *observe* a's lease: expiry is measured from local
+        # observation (client-go semantics), so a lease b has never seen
+        # is never instantly stealable.
+        assert not b.try_acquire_or_renew()
         clock.advance(20)  # a missed its renewals
         assert b.try_acquire_or_renew()
         assert not a.try_acquire_or_renew()  # sees b's fresh lease

@@ -96,6 +96,51 @@ class TestProfileController:
         ctrl.run_once()
         assert api.get("v1", "Namespace", "alice")
 
+    def test_labels_file_stat_oserror_is_one_shot_not_a_storm(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        """A transient stat() OSError (ConfigMap remount) must neither
+        escape changed() into the controller tick nor defeat the
+        one-attempt-per-change guard (ADVICE r1 low)."""
+        import logging
+
+        from kubeflow_tpu.controllers.profile import NamespaceLabelsFile
+
+        labels_file = tmp_path / "namespace-labels.yaml"
+        labels_file.write_text("team: research\n")
+        nlf = NamespaceLabelsFile(labels_file)
+        assert nlf.labels == {"team": "research"}
+
+        import pathlib
+
+        real_stat = pathlib.Path.stat
+
+        def broken_stat(self, **kw):
+            if self == labels_file:
+                raise PermissionError(13, "remount in progress")
+            return real_stat(self, **kw)
+
+        monkeypatch.setattr(pathlib.Path, "stat", broken_stat)
+        # First sight of the error state: changed() flags it once…
+        assert nlf.changed()
+        with caplog.at_level(logging.WARNING):
+            nlf.load()
+        assert nlf.labels == {"team": "research"}  # kept previous
+        warned = [r for r in caplog.records if "unreadable" in r.message]
+        assert len(warned) == 1
+        # …then the unchanged error state is quiescent (no retry storm).
+        caplog.clear()
+        assert not nlf.changed()
+        with caplog.at_level(logging.WARNING):
+            nlf.load()
+        assert not [r for r in caplog.records if "unreadable" in r.message]
+        # Recovery reloads normally.
+        monkeypatch.setattr(pathlib.Path, "stat", real_stat)
+        assert nlf.changed()
+        nlf.load()
+        assert nlf.labels == {"team": "research"}
+        assert not nlf.changed()
+
     def test_workload_identity_plugin_and_finalizer_revocation(self):
         api = FakeApiServer()
         calls = []
