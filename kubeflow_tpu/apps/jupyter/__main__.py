@@ -1,0 +1,3 @@
+from kubeflow_tpu.entrypoints import run_jupyter_web_app
+
+run_jupyter_web_app()

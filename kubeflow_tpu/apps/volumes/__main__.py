@@ -1,0 +1,3 @@
+from kubeflow_tpu.entrypoints import run_volumes_web_app
+
+run_volumes_web_app()

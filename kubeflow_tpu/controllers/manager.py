@@ -170,3 +170,9 @@ def make_notebook_manager(
         lease_name="notebook-controller",
         identity=identity,
     )
+
+
+if __name__ == "__main__":
+    from kubeflow_tpu.entrypoints import run_notebook_controller
+
+    run_notebook_controller()

@@ -16,7 +16,13 @@ platform.
 
 from kubeflow_tpu.crud_backend.app import ApiError, RestApp, json_success
 from kubeflow_tpu.crud_backend.authn import AuthnConfig
-from kubeflow_tpu.crud_backend.authz import Authorizer, AllowAll, PolicyAuthorizer
+from kubeflow_tpu.crud_backend.authz import (
+    AllowAll,
+    Authorizer,
+    DenyAll,
+    PolicyAuthorizer,
+    SubjectAccessReviewAuthorizer,
+)
 
 __all__ = [
     "ApiError",
@@ -25,5 +31,7 @@ __all__ = [
     "AuthnConfig",
     "Authorizer",
     "AllowAll",
+    "DenyAll",
     "PolicyAuthorizer",
+    "SubjectAccessReviewAuthorizer",
 ]

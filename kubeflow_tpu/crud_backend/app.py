@@ -31,7 +31,7 @@ from prometheus_client import CollectorRegistry, Counter, Histogram, generate_la
 
 from kubeflow_tpu.crud_backend import csrf
 from kubeflow_tpu.crud_backend.authn import AuthnConfig
-from kubeflow_tpu.crud_backend.authz import Authorizer, AllowAll, Forbidden
+from kubeflow_tpu.crud_backend.authz import Authorizer, DenyAll, Forbidden
 
 log = logging.getLogger(__name__)
 
@@ -82,7 +82,10 @@ class RestApp:
     ):
         self.name = name
         self.authn = authn or AuthnConfig(dev_mode=True)
-        self.authorizer = authorizer or AllowAll()
+        # Fail closed: routes that ensure() without a configured
+        # authorizer deny. Dev/test callers opt into AllowAll
+        # explicitly; production wires SubjectAccessReviewAuthorizer.
+        self.authorizer = authorizer or DenyAll()
         self.secure_cookies = secure_cookies
         self.url_map = Map()
         self.views: dict[str, Callable] = {}

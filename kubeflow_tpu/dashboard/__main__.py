@@ -1,0 +1,3 @@
+from kubeflow_tpu.entrypoints import run_dashboard
+
+run_dashboard()

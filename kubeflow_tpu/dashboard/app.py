@@ -41,6 +41,92 @@ DEFAULT_LINKS = {
 }
 
 
+class KfamHttpProxy:
+    """Cross-process KFAM client: the deployed layout (reference
+    dashboard → KFAM :8081 over the cluster network,
+    api_workgroup.ts:255-391). Same method surface as KfamProxy, real
+    HTTP with the caller's identity header forwarded."""
+
+    def __init__(self, base_url: str, userid_header: str = "kubeflow-userid",
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.header = userid_header
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, user: str, body=None):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={
+                self.header: user,
+                "Content-Type": "application/json",
+                # Server-to-server: satisfy KFAM's double-submit pair.
+                "Cookie": "XSRF-TOKEN=dashboard-proxy",
+                "X-XSRF-TOKEN": "dashboard-proxy",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as err:
+            try:
+                payload = json.loads(err.read().decode() or "{}")
+            except Exception:
+                payload = {}
+            raise ApiError(
+                payload.get("log", f"KFAM error {err.code}"), err.code
+            )
+        except OSError as err:
+            raise ApiError(f"KFAM unreachable: {err}", 502)
+
+    # Method surface shared with KfamProxy (kept in sync by
+    # tests/test_dashboard.py::test_proxies_share_method_surface).
+    def create_profile(self, user: str, namespace: str):
+        return self._call(
+            "POST", "/kfam/v1/profiles", user,
+            {"name": namespace,
+             "spec": {"owner": {"kind": "User", "name": user}}},
+        )
+
+    def delete_profile(self, user: str, namespace: str):
+        return self._call("DELETE", f"/kfam/v1/profiles/{namespace}", user)
+
+    def is_cluster_admin(self, user: str) -> bool:
+        return bool(
+            self._call("GET", "/kfam/v1/clusteradmin", user)["clusterAdmin"]
+        )
+
+    def list_bindings(self, user: str, namespace: str | None = None):
+        path = "/kfam/v1/bindings"
+        if namespace:
+            path += f"?namespace={namespace}"
+        return self._call("GET", path, user)["bindings"]
+
+    def add_contributor(self, user: str, namespace: str, contributor: str):
+        return self._call(
+            "POST", "/kfam/v1/bindings", user,
+            {
+                "user": {"kind": "User", "name": contributor},
+                "referredNamespace": namespace,
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            },
+        )
+
+    def remove_contributor(self, user: str, namespace: str, contributor: str):
+        return self._call(
+            "DELETE", "/kfam/v1/bindings", user,
+            {
+                "user": {"kind": "User", "name": contributor},
+                "referredNamespace": namespace,
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            },
+        )
+
+
 class KfamProxy:
     """In-process client for the KFAM RestApp, forwarding the caller's
     identity header (the reference dashboard proxies KFAM over HTTP with

@@ -1,0 +1,344 @@
+"""Process entrypoints for every deployable component.
+
+The reference ships one binary per component (reference
+notebook-controller/main.go:57-147, admission-webhook/main.go:795-821,
+access-management/main.go:36-58, …); here every component is one
+``python -m kubeflow_tpu <component>`` away, wired from env:
+
+==============================  =========================================
+component                       serves
+==============================  =========================================
+notebook-controller             reconciler+culler, metrics/healthz :8080
+profile-controller              profile reconciler, metrics :8080
+tensorboard-controller          tensorboard reconciler, metrics :8080
+pvcviewer-controller            pvcviewer reconciler, metrics :8080
+admission-webhook               HTTPS AdmissionReview :4443
+kfam                            KFAM REST API :8081
+centraldashboard                dashboard backend+SPA :8082
+jupyter-web-app                 JWA backend+SPA :5000
+volumes-web-app                 VWA backend+SPA :5000
+tensorboards-web-app            TWA backend+SPA :5000
+apiserver                       dev fake apiserver :8001
+==============================  =========================================
+
+API connection resolution (kubeflow_tpu.k8s.client.connect_from_env):
+in-cluster service account → kubeconfig → KFT_APISERVER override →
+KFT_FAKE_API=1 for a fully in-process dev instance.
+
+Common env: USERID_HEADER / USERID_PREFIX (authn), SECURE_COOKIES,
+PORT / METRICS_PORT, APP_DISABLE_AUTH=1 (dev only: AllowAll instead of
+the SubjectAccessReview authorizer).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() in ("1", "true", "yes")
+
+
+def _setup_logging():
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+
+def _connect():
+    from kubeflow_tpu.k8s.client import connect_from_env
+
+    api = connect_from_env()
+    version = getattr(api, "server_version", None)
+    if callable(version):
+        try:
+            v = version()
+            log.info("connected to apiserver %s", v.get("gitVersion", "?"))
+        except Exception as exc:
+            # Fail fast: a controller that cannot reach the apiserver
+            # should crash-loop visibly, not run against nothing.
+            raise SystemExit(f"cannot reach apiserver: {exc}")
+    return api
+
+
+def _block_until_signal(cleanup=None):
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        log.info("signal %s: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    stop.wait()
+    if cleanup:
+        cleanup()
+
+
+def _authn_from_env():
+    from kubeflow_tpu.crud_backend import AuthnConfig
+
+    return AuthnConfig(
+        userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=os.environ.get("USERID_PREFIX", ""),
+    )
+
+
+def _authorizer_from_env(api):
+    """SubjectAccessReview by default; AllowAll only with the explicit
+    dev flag (reference APP_DISABLE_AUTH, crud_backend/config.py)."""
+    from kubeflow_tpu.crud_backend import AllowAll, SubjectAccessReviewAuthorizer
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+
+    if _env_bool("APP_DISABLE_AUTH"):
+        log.warning("APP_DISABLE_AUTH set: authorization is OFF")
+        return AllowAll()
+    if isinstance(api, FakeApiServer):
+        # The in-process fake has no SAR endpoint; dev mode implies
+        # open access (matches the reference's dev config).
+        return AllowAll()
+    return SubjectAccessReviewAuthorizer(api)
+
+
+def _run_rest_app(app, default_port: int):
+    port = int(os.environ.get("PORT", str(default_port)))
+    host = os.environ.get("BIND_HOST", "0.0.0.0")
+    log.info("%s serving on %s:%d", app.name, host, port)
+    app.run(host=host, port=port)
+
+
+# ---- controllers ---------------------------------------------------------
+
+def run_notebook_controller():
+    """The notebook-controller binary: notebook reconciler + culler +
+    metrics/health listener + optional leader election (reference
+    main.go:57-147)."""
+    from kubeflow_tpu.controllers.manager import make_notebook_manager
+
+    _setup_logging()
+    api = _connect()
+    mgr = make_notebook_manager(
+        api,
+        http_port=int(os.environ.get("METRICS_PORT", "8080")),
+    )
+    mgr.start()
+    log.info("notebook-controller started (leader_elect=%s)",
+             mgr.elector is not None)
+    _block_until_signal(cleanup=mgr.stop)
+
+
+def _run_single_controller(make, name: str, **kwargs):
+    from kubeflow_tpu.controllers.manager import Manager
+    from kubeflow_tpu.controllers.metrics import ControllerMetrics
+
+    _setup_logging()
+    api = _connect()
+    prom = ControllerMetrics(api)
+    ctrl = make(api, prom=prom, **kwargs) if _accepts_prom(make) else make(
+        api, **kwargs
+    )
+    mgr = Manager(
+        api,
+        [ctrl],
+        prom=prom,
+        http_port=int(os.environ.get("METRICS_PORT", "8080")),
+        leader_elect=_env_bool("LEADER_ELECT"),
+        lease_name=name,
+    )
+    mgr.start()
+    log.info("%s started", name)
+    _block_until_signal(cleanup=mgr.stop)
+
+
+def _accepts_prom(fn) -> bool:
+    import inspect
+
+    return "prom" in inspect.signature(fn).parameters
+
+
+def run_profile_controller():
+    from kubeflow_tpu.controllers.profile import (
+        ProfileOptions,
+        make_profile_controller,
+    )
+
+    labels_file = os.environ.get("NAMESPACE_LABELS_PATH")
+    options = ProfileOptions(
+        userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=os.environ.get("USERID_PREFIX", ""),
+    )
+    _run_single_controller(
+        make_profile_controller, "profile-controller",
+        options=options, labels_file=labels_file,
+    )
+
+
+def run_tensorboard_controller():
+    from kubeflow_tpu.controllers.tensorboard import make_tensorboard_controller
+
+    _run_single_controller(make_tensorboard_controller,
+                           "tensorboard-controller")
+
+
+def run_pvcviewer_controller():
+    from kubeflow_tpu.controllers.pvcviewer import make_pvcviewer_controller
+
+    _run_single_controller(make_pvcviewer_controller, "pvcviewer-controller")
+
+
+# ---- webhook -------------------------------------------------------------
+
+def run_admission_webhook():
+    """PodDefault mutating webhook over HTTPS (reference
+    admission-webhook/main.go:795-821; certs mounted by cert-manager,
+    rotated live by the cert watcher)."""
+    from kubeflow_tpu.webhook.server import AdmissionHandler, WebhookServer
+
+    _setup_logging()
+    api = _connect()
+    poddefault_api = "kubeflow.org/v1alpha1"
+
+    def list_poddefaults(namespace: str):
+        return api.list(poddefault_api, "PodDefault", namespace=namespace)
+
+    handler = AdmissionHandler(list_poddefaults)
+    server = WebhookServer(
+        handler,
+        port=int(os.environ.get("WEBHOOK_PORT", "4443")),
+        certfile=os.environ.get("CERT_FILE", "/etc/webhook/certs/tls.crt"),
+        keyfile=os.environ.get("KEY_FILE", "/etc/webhook/certs/tls.key"),
+    )
+    server.start()
+    log.info("admission-webhook serving on :%d", server.port)
+    _block_until_signal(cleanup=server.stop)
+
+
+# ---- REST services -------------------------------------------------------
+
+def run_kfam():
+    from kubeflow_tpu.kfam.app import create_app
+
+    _setup_logging()
+    api = _connect()
+    app = create_app(
+        api,
+        authn=_authn_from_env(),
+        cluster_admin=os.environ.get("CLUSTER_ADMIN", "admin@kubeflow.org"),
+        # Also used in generated Istio AuthorizationPolicies — must match
+        # what the gateway actually sets, not the library default.
+        userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=os.environ.get("USERID_PREFIX", ""),
+        secure_cookies=_env_bool("SECURE_COOKIES", True),
+    )
+    _run_rest_app(app, 8081)
+
+
+def run_dashboard():
+    from kubeflow_tpu.dashboard.app import KfamHttpProxy, create_app
+
+    _setup_logging()
+    api = _connect()
+    kfam_url = os.environ.get(
+        "KFAM_URL", "http://profiles-kfam.kubeflow:8081"
+    )
+    app = create_app(
+        api,
+        kfam=KfamHttpProxy(
+            kfam_url,
+            userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        ),
+        authn=_authn_from_env(),
+        registration_flow=_env_bool("REGISTRATION_FLOW", True),
+        secure_cookies=_env_bool("SECURE_COOKIES", True),
+    )
+    _run_rest_app(app, 8082)
+
+
+def run_jupyter_web_app():
+    from kubeflow_tpu.apps.jupyter import create_app
+
+    _setup_logging()
+    api = _connect()
+    app = create_app(
+        api,
+        authn=_authn_from_env(),
+        authorizer=_authorizer_from_env(api),
+        config_path=os.environ.get("SPAWNER_CONFIG"),
+        secure_cookies=_env_bool("SECURE_COOKIES", True),
+    )
+    _run_rest_app(app, 5000)
+
+
+def run_volumes_web_app():
+    from kubeflow_tpu.apps.volumes import create_app
+
+    _setup_logging()
+    api = _connect()
+    app = create_app(
+        api,
+        authn=_authn_from_env(),
+        authorizer=_authorizer_from_env(api),
+        secure_cookies=_env_bool("SECURE_COOKIES", True),
+    )
+    _run_rest_app(app, 5000)
+
+
+def run_tensorboards_web_app():
+    from kubeflow_tpu.apps.tensorboards import create_app
+
+    _setup_logging()
+    api = _connect()
+    app = create_app(
+        api,
+        authn=_authn_from_env(),
+        authorizer=_authorizer_from_env(api),
+        secure_cookies=_env_bool("SECURE_COOKIES", True),
+    )
+    _run_rest_app(app, 5000)
+
+
+def run_dev_apiserver():
+    from kubeflow_tpu.k8s.httpd import main as httpd_main
+
+    _setup_logging()
+    httpd_main(
+        ["--host", os.environ.get("BIND_HOST", "127.0.0.1"),
+         "--port", os.environ.get("PORT", "8001")]
+    )
+
+
+COMPONENTS = {
+    "notebook-controller": run_notebook_controller,
+    "profile-controller": run_profile_controller,
+    "tensorboard-controller": run_tensorboard_controller,
+    "pvcviewer-controller": run_pvcviewer_controller,
+    "admission-webhook": run_admission_webhook,
+    "kfam": run_kfam,
+    "access-management": run_kfam,  # reference component name alias
+    "centraldashboard": run_dashboard,
+    "jupyter-web-app": run_jupyter_web_app,
+    "volumes-web-app": run_volumes_web_app,
+    "tensorboards-web-app": run_tensorboards_web_app,
+    "apiserver": run_dev_apiserver,
+}
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu",
+        description="Launch a kubeflow_tpu component.",
+    )
+    parser.add_argument("component", choices=sorted(COMPONENTS))
+    args = parser.parse_args(argv)
+    COMPONENTS[args.component]()

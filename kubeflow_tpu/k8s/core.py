@@ -1,0 +1,140 @@
+"""Shared Kubernetes API-machinery types.
+
+Both API surfaces — the in-memory FakeApiServer (tests/dev) and the
+real HTTPS ApiClient (production) — expose the same duck-typed
+interface and raise the same errors, so every controller and web app
+takes either. This module holds the common vocabulary; it has no
+dependencies on either implementation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, code: int = 400):
+        super().__init__(message)
+        self.code = code
+
+
+class NotFound(ApiError):
+    def __init__(self, message: str):
+        super().__init__(message, 404)
+
+
+class Conflict(ApiError):
+    def __init__(self, message: str):
+        super().__init__(message, 409)
+
+
+@dataclass(frozen=True)
+class GVK:
+    """Group/version/kind triple; keys storage, watches and REST paths."""
+
+    group: str
+    version: str
+    kind: str
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "GVK":
+        api_version = obj.get("apiVersion", "v1")
+        kind = obj.get("kind")
+        if not kind:
+            raise ApiError("object missing kind")
+        if "/" in api_version:
+            group, version = api_version.split("/", 1)
+        else:
+            group, version = "", api_version
+        return cls(group, version, kind)
+
+
+# Kinds that are cluster-scoped (no namespace key).
+CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "ClusterRoleBinding",
+                  "StorageClass", "Node", "PersistentVolume",
+                  "CustomResourceDefinition", "MutatingWebhookConfiguration",
+                  "ValidatingWebhookConfiguration", "SubjectAccessReview"}
+
+
+# Kind -> REST resource (lowercase plural). Covers every kind the
+# platform touches; unknown kinds fall back to the heuristic below and,
+# in the real client, to API discovery.
+RESOURCE_NAMES = {
+    "Namespace": "namespaces",
+    "Pod": "pods",
+    "Service": "services",
+    "Endpoints": "endpoints",
+    "Event": "events",
+    "ConfigMap": "configmaps",
+    "Secret": "secrets",
+    "ServiceAccount": "serviceaccounts",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "PersistentVolume": "persistentvolumes",
+    "Node": "nodes",
+    "ResourceQuota": "resourcequotas",
+    "Deployment": "deployments",
+    "StatefulSet": "statefulsets",
+    "ReplicaSet": "replicasets",
+    "DaemonSet": "daemonsets",
+    "Role": "roles",
+    "RoleBinding": "rolebindings",
+    "ClusterRole": "clusterroles",
+    "ClusterRoleBinding": "clusterrolebindings",
+    "StorageClass": "storageclasses",
+    "Lease": "leases",
+    "CustomResourceDefinition": "customresourcedefinitions",
+    "MutatingWebhookConfiguration": "mutatingwebhookconfigurations",
+    "ValidatingWebhookConfiguration": "validatingwebhookconfigurations",
+    "SubjectAccessReview": "subjectaccessreviews",
+    # Platform CRDs
+    "Notebook": "notebooks",
+    "Profile": "profiles",
+    "PodDefault": "poddefaults",
+    "Tensorboard": "tensorboards",
+    "PVCViewer": "pvcviewers",
+    # Istio
+    "VirtualService": "virtualservices",
+    "AuthorizationPolicy": "authorizationpolicies",
+}
+
+
+def resource_name(kind: str) -> str:
+    """REST resource for a kind (static table, then the standard
+    English-plural heuristic the apiserver itself uses for CRDs)."""
+    known = RESOURCE_NAMES.get(kind)
+    if known:
+        return known
+    lower = kind.lower()
+    if lower.endswith(("s", "x", "z", "ch", "sh")):
+        return lower + "es"
+    if lower.endswith("y") and lower[-2] not in "aeiou":
+        return lower[:-1] + "ies"
+    return lower + "s"
+
+
+def match_label_selector(labels: dict, selector: str) -> bool:
+    """Equality-based selector string: "a=b,c!=d,e" (exists)."""
+    labels = labels or {}
+    for term in [t.strip() for t in selector.split(",") if t.strip()]:
+        if "!=" in term:
+            key, val = term.split("!=", 1)
+            if labels.get(key.strip()) == val.strip():
+                return False
+        elif "=" in term:
+            key, val = term.split("=", 1)
+            if labels.get(key.strip()) != val.strip():
+                return False
+        else:
+            if term not in labels:
+                return False
+    return True
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict

@@ -18,78 +18,20 @@ import queue
 import threading
 import time
 import uuid
-from dataclasses import dataclass
+from collections import deque
 from typing import Callable
 
-
-class ApiError(Exception):
-    def __init__(self, message: str, code: int = 400):
-        super().__init__(message)
-        self.code = code
-
-
-class NotFound(ApiError):
-    def __init__(self, message: str):
-        super().__init__(message, 404)
-
-
-class Conflict(ApiError):
-    def __init__(self, message: str):
-        super().__init__(message, 409)
-
-
-@dataclass(frozen=True)
-class GVK:
-    """Group/version/kind triple; keys storage and watches."""
-
-    group: str
-    version: str
-    kind: str
-
-    @property
-    def api_version(self) -> str:
-        return f"{self.group}/{self.version}" if self.group else self.version
-
-    @classmethod
-    def from_obj(cls, obj: dict) -> "GVK":
-        api_version = obj.get("apiVersion", "v1")
-        kind = obj.get("kind")
-        if not kind:
-            raise ApiError("object missing kind")
-        if "/" in api_version:
-            group, version = api_version.split("/", 1)
-        else:
-            group, version = "", api_version
-        return cls(group, version, kind)
-
-
-# Kinds that are cluster-scoped (no namespace key).
-CLUSTER_SCOPED = {"Namespace", "Profile", "ClusterRole", "ClusterRoleBinding",
-                  "StorageClass", "Node", "PersistentVolume"}
-
-
-def match_label_selector(labels: dict, selector: str) -> bool:
-    """Equality-based selector string: "a=b,c!=d,e" (exists)."""
-    labels = labels or {}
-    for term in [t.strip() for t in selector.split(",") if t.strip()]:
-        if "!=" in term:
-            key, val = term.split("!=", 1)
-            if labels.get(key.strip()) == val.strip():
-                return False
-        elif "=" in term:
-            key, val = term.split("=", 1)
-            if labels.get(key.strip()) != val.strip():
-                return False
-        else:
-            if term not in labels:
-                return False
-    return True
-
-
-@dataclass
-class WatchEvent:
-    type: str  # ADDED | MODIFIED | DELETED
-    object: dict
+# Shared API-machinery vocabulary lives in core; re-exported here so
+# `from kubeflow_tpu.k8s.fake import NotFound` keeps working everywhere.
+from kubeflow_tpu.k8s.core import (  # noqa: F401
+    CLUSTER_SCOPED,
+    ApiError,
+    Conflict,
+    GVK,
+    NotFound,
+    WatchEvent,
+    match_label_selector,
+)
 
 
 class FakeApiServer:
@@ -97,6 +39,12 @@ class FakeApiServer:
         self._lock = threading.RLock()
         self._store: dict[GVK, dict[tuple[str, str], dict]] = {}
         self._rv = itertools.count(1)
+        self._last_rv = 0
+        # Bounded change history: lets the HTTP harness replay a watch
+        # from a client-supplied resourceVersion (and answer 410 Gone
+        # when the requested horizon has been compacted away) — the
+        # real apiserver's watch-cache semantics.
+        self._event_log: deque = deque(maxlen=1024)
         self._watchers: dict[GVK, list[queue.Queue]] = {}
         # Mutating admission hooks: fn(obj) -> mutated obj (or raises
         # ApiError to reject). Keyed by kind, applied on CREATE.
@@ -131,8 +79,35 @@ class FakeApiServer:
         return self._store.setdefault(gvk, {})
 
     def _notify(self, gvk: GVK, event: WatchEvent):
+        rv = int(
+            event.object.get("metadata", {}).get("resourceVersion") or 0
+        )
+        self._last_rv = max(self._last_rv, rv)
+        self._event_log.append(
+            (rv, gvk, WatchEvent(event.type, copy.deepcopy(event.object)))
+        )
         for q in self._watchers.get(gvk, []):
             q.put(WatchEvent(event.type, copy.deepcopy(event.object)))
+
+    # ---- change history (HTTP harness watch-resume) ----------------------
+    @property
+    def last_resource_version(self) -> int:
+        with self._lock:
+            return self._last_rv
+
+    def events_since(self, gvk: GVK, rv: int) -> list[WatchEvent] | None:
+        """Events for ``gvk`` with resourceVersion > rv, or None when
+        ``rv`` predates the retained history (the 410 Gone case)."""
+        with self._lock:
+            if self._event_log and len(self._event_log) == self._event_log.maxlen:
+                oldest = self._event_log[0][0]
+                if rv < oldest - 1:
+                    return None
+            return [
+                WatchEvent(ev.type, copy.deepcopy(ev.object))
+                for ev_rv, ev_gvk, ev in self._event_log
+                if ev_gvk == gvk and ev_rv > rv
+            ]
 
     # ---- CRUD ------------------------------------------------------------
     def create(self, obj: dict, namespace: str | None = None,
@@ -292,6 +267,10 @@ class FakeApiServer:
                 # Logs are per pod instance; a recreated same-name pod
                 # must not inherit its predecessor's stream.
                 self._pod_logs.pop((namespace or "", name), None)
+            # The apiserver assigns deletion its own resourceVersion;
+            # replaying a stale pre-delete rv would make watch-resume
+            # (events_since) skip deletions.
+            obj["metadata"]["resourceVersion"] = str(next(self._rv))
             self._notify(gvk, WatchEvent("DELETED", obj))
             self._collect_orphans(obj)
 
@@ -304,6 +283,7 @@ class FakeApiServer:
         gvk = GVK.from_obj(obj)
         key = self._key(gvk, meta.get("namespace"), meta["name"])
         self._bucket(gvk).pop(key, None)
+        meta["resourceVersion"] = str(next(self._rv))  # see delete()
         self._notify(gvk, WatchEvent("DELETED", obj))
         self._collect_orphans(obj)
         return True
@@ -334,6 +314,30 @@ class FakeApiServer:
             q: queue.Queue = queue.Queue()
             self._watchers.setdefault(gvk, []).append(q)
             return q
+
+    def watch_since(
+        self, api_version: str, kind: str, rv: int
+    ) -> tuple[list[WatchEvent] | None, queue.Queue]:
+        """Atomic replay+subscribe for the HTTP harness: the backlog of
+        events after ``rv`` plus a queue for everything later — no gap,
+        no duplicate between the two. Backlog None = rv compacted (the
+        caller answers 410 Gone)."""
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            backlog = self.events_since(gvk, rv)
+            q: queue.Queue = queue.Queue()
+            if backlog is not None:
+                self._watchers.setdefault(gvk, []).append(q)
+            return backlog, q
+
+    def unwatch(self, api_version: str, kind: str, q: queue.Queue) -> None:
+        """Drop a subscription (HTTP watch connections come and go; the
+        in-process controllers keep theirs for the process lifetime)."""
+        with self._lock:
+            gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+            subs = self._watchers.get(gvk, [])
+            if q in subs:
+                subs.remove(q)
 
     # ---- convenience for tests ------------------------------------------
     def apply(self, obj: dict) -> dict:

@@ -1,0 +1,508 @@
+"""FakeApiServer over HTTP(S): the real Kubernetes REST protocol.
+
+Two jobs:
+
+1. **Wire-protocol test harness** — the real ApiClient (client.py) is
+   exercised against this server in-process, covering paths, verbs,
+   selectors, merge-patch content types, chunked ``?watch=true``
+   streams, resourceVersion resume, 410 Gone recovery, bearer auth,
+   TLS, pod logs and SubjectAccessReview — the whole protocol surface,
+   with no cluster. This plays the role envtest plays in the reference
+   (reference notebook-controller/controllers/suite_test.go:51-113: a
+   real apiserver, no kubelet).
+2. **Dev apiserver** — ``python -m kubeflow_tpu.k8s.httpd`` gives every
+   entrypoint a live endpoint (KFT_APISERVER=http://…) so the full
+   stack runs as separate processes on a laptop.
+
+SubjectAccessReviews are answered by evaluating real RBAC objects in
+the store (RoleBindings/ClusterRoleBindings → Roles/ClusterRoles), so
+the KFAM contributor flow is testable end-to-end: add a contributor →
+RoleBinding appears → SAR flips to allowed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import re
+import ssl
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from kubeflow_tpu.k8s.core import (
+    CLUSTER_SCOPED,
+    ApiError,
+    RESOURCE_NAMES,
+    resource_name,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+log = logging.getLogger(__name__)
+
+# resource (plural) -> kind, for URL parsing.
+KIND_BY_RESOURCE = {v: k for k, v in RESOURCE_NAMES.items()}
+
+# api_version -> kinds, for discovery responses.
+DISCOVERY_GROUPS = {
+    "v1": ["Namespace", "Pod", "Service", "Endpoints", "Event", "ConfigMap",
+           "Secret", "ServiceAccount", "PersistentVolumeClaim",
+           "PersistentVolume", "Node", "ResourceQuota"],
+    "apps/v1": ["Deployment", "StatefulSet", "ReplicaSet", "DaemonSet"],
+    "rbac.authorization.k8s.io/v1": ["Role", "RoleBinding", "ClusterRole",
+                                     "ClusterRoleBinding"],
+    "coordination.k8s.io/v1": ["Lease"],
+    "storage.k8s.io/v1": ["StorageClass"],
+    "authorization.k8s.io/v1": ["SubjectAccessReview"],
+    "kubeflow.org/v1beta1": ["Notebook"],
+    "kubeflow.org/v1": ["Profile", "Tensorboard", "PVCViewer"],
+    "kubeflow.org/v1alpha1": ["PodDefault"],
+    "networking.istio.io/v1beta1": ["VirtualService"],
+    "security.istio.io/v1": ["AuthorizationPolicy"],
+}
+
+
+def rbac_allowed(
+    api: FakeApiServer, user: str, verb: str, group: str, resource: str,
+    namespace: str, user_groups: list[str] | None = None,
+) -> tuple[bool, str]:
+    """Evaluate a SAR against RBAC objects in the store — RoleBindings
+    in the namespace and ClusterRoleBindings, resolving Role/ClusterRole
+    rules with * wildcard semantics. Returns (allowed, reason)."""
+    user_groups = set(user_groups or [])
+
+    def subject_matches(subj: dict) -> bool:
+        kind = subj.get("kind")
+        if kind == "User":
+            return subj.get("name") == user
+        if kind == "Group":
+            return subj.get("name") in user_groups
+        return False
+
+    def rule_matches(rule: dict) -> bool:
+        def hit(values, want):
+            return "*" in values or want in values
+
+        return (
+            hit(rule.get("verbs", []), verb)
+            and hit(rule.get("apiGroups", [""]), group)
+            and hit(rule.get("resources", []), resource)
+        )
+
+    def role_rules(role_ref: dict, ns: str | None) -> list[dict]:
+        try:
+            if role_ref.get("kind") == "ClusterRole":
+                role = api.get("rbac.authorization.k8s.io/v1", "ClusterRole",
+                               role_ref.get("name", ""))
+            else:
+                role = api.get("rbac.authorization.k8s.io/v1", "Role",
+                               role_ref.get("name", ""), ns)
+        except ApiError:
+            return []
+        return role.get("rules", [])
+
+    bindings = []
+    if namespace:
+        bindings += [
+            (b, namespace)
+            for b in api.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                              namespace=namespace)
+        ]
+    bindings += [
+        (b, None)
+        for b in api.list("rbac.authorization.k8s.io/v1",
+                          "ClusterRoleBinding")
+    ]
+    for binding, ns in bindings:
+        if not any(subject_matches(s) for s in binding.get("subjects", [])):
+            continue
+        for rule in role_rules(binding.get("roleRef", {}), ns):
+            if rule_matches(rule):
+                return True, (
+                    f"allowed by {binding.get('kind', 'RoleBinding')} "
+                    f"{binding['metadata']['name']}"
+                )
+    return False, "no RBAC binding grants access"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kft-fake-apiserver"
+
+    # ---- plumbing --------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s " + fmt, self.client_address[0], *args)
+
+    @property
+    def fake(self) -> FakeApiServer:
+        return self.server.fake  # type: ignore[attr-defined]
+
+    def _send_json(self, code: int, payload: dict):
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_status(self, code: int, message: str, reason: str = ""):
+        self._send_json(code, {
+            "apiVersion": "v1", "kind": "Status",
+            "status": "Failure" if code >= 400 else "Success",
+            "message": message, "reason": reason, "code": code,
+        })
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _authed(self) -> bool:
+        token = self.server.token  # type: ignore[attr-defined]
+        if not token:
+            return True
+        header = self.headers.get("Authorization", "")
+        if header == f"Bearer {token}":
+            return True
+        self._send_status(401, "Unauthorized")
+        return False
+
+    # ---- URL parsing -----------------------------------------------------
+    PATH_RE = re.compile(
+        r"^(?:/api/(?P<core_v>v1)|/apis/(?P<group>[^/]+)/(?P<ver>[^/]+))"
+        r"(?:/namespaces/(?P<ns>[^/]+))?"
+        r"/(?P<resource>[^/]+)"
+        r"(?:/(?P<name>[^/]+))?"
+        r"(?:/(?P<sub>[^/]+))?$"
+    )
+
+    def _parse(self):
+        url = urlsplit(self.path)
+        query = {k: v[0] for k, v in parse_qs(url.query).items()}
+        path = url.path.rstrip("/")
+        if path == "/version":
+            return ("version", None, query)
+        # Discovery: GET /api/v1 or /apis/{group}/{version} with no
+        # resource component.
+        if path == "/api/v1":
+            return ("discovery", "v1", query)
+        m = re.match(r"^/apis/([^/]+)/([^/]+)$", path)
+        if m:
+            return ("discovery", f"{m.group(1)}/{m.group(2)}", query)
+        m = self.PATH_RE.match(path)
+        if not m:
+            return (None, None, query)
+        group = m.group("group") or ""
+        version = m.group("core_v") or m.group("ver")
+        api_version = f"{group}/{version}" if group else version
+        # "/namespaces/<name>" parses as ns=None resource=namespaces.
+        resource = m.group("resource")
+        kind = KIND_BY_RESOURCE.get(resource)
+        if kind is None:
+            # Heuristic reverse-pluralisation for unknown CRDs.
+            for k in list(CLUSTER_SCOPED) + list(KIND_BY_RESOURCE.values()):
+                if resource_name(k) == resource:
+                    kind = k
+                    break
+        if kind is None:
+            return (None, None, query)
+        return (
+            "resource",
+            {
+                "api_version": api_version,
+                "kind": kind,
+                "namespace": m.group("ns"),
+                "name": m.group("name"),
+                "subresource": m.group("sub"),
+            },
+            query,
+        )
+
+    # ---- verbs -----------------------------------------------------------
+    def do_GET(self):
+        if not self._authed():
+            return
+        what, info, query = self._parse()
+        if what == "version":
+            return self._send_json(200, {"major": "1", "minor": "29",
+                                         "gitVersion": "v1.29.0-kft-fake"})
+        if what == "discovery":
+            return self._discovery(info)
+        if what != "resource":
+            return self._send_status(404, f"unknown path {self.path}")
+        try:
+            if info["name"] and info["subresource"] == "log":
+                text = self.fake.read_pod_logs(info["namespace"],
+                                               info["name"])
+                data = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            if info["name"]:
+                obj = self.fake.get(info["api_version"], info["kind"],
+                                    info["name"], info["namespace"])
+                return self._send_json(200, obj)
+            if query.get("watch") in ("true", "1"):
+                return self._watch(info, query)
+            items = self.fake.list(
+                info["api_version"], info["kind"],
+                namespace=info["namespace"],
+                label_selector=query.get("labelSelector"),
+            )
+            return self._send_json(200, {
+                "apiVersion": info["api_version"],
+                "kind": info["kind"] + "List",
+                "metadata": {
+                    "resourceVersion": str(
+                        self.fake.last_resource_version
+                    ),
+                },
+                "items": items,
+            })
+        except ApiError as exc:
+            return self._send_status(exc.code, str(exc))
+
+    def _discovery(self, api_version: str):
+        kinds = DISCOVERY_GROUPS.get(api_version, [])
+        self._send_json(200, {
+            "kind": "APIResourceList",
+            "groupVersion": api_version,
+            "resources": [
+                {
+                    "name": resource_name(k),
+                    "kind": k,
+                    "namespaced": k not in CLUSTER_SCOPED,
+                    "verbs": ["create", "delete", "get", "list", "patch",
+                              "update", "watch"],
+                }
+                for k in kinds
+            ],
+        })
+
+    def _watch(self, info, query):
+        rv_param = query.get("resourceVersion")
+        if rv_param in (None, ""):
+            # Protocol: no resourceVersion = "start from now", never a
+            # replay (so it cannot 410 regardless of history depth).
+            rv = self.fake.last_resource_version
+        else:
+            try:
+                rv = int(rv_param)
+            except ValueError:
+                return self._send_status(
+                    400, f"invalid resourceVersion {rv_param!r}"
+                )
+        timeout = float(query.get("timeoutSeconds") or 300)
+        backlog, q = self.fake.watch_since(
+            info["api_version"], info["kind"], rv
+        )
+        if backlog is None:
+            return self._send_status(
+                410, f"resourceVersion {rv} is too old", reason="Expired"
+            )
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        deadline = time.monotonic() + timeout
+        try:
+            for ev in backlog:
+                self._write_chunk(self._event_line(ev))
+            while time.monotonic() < deadline:
+                if getattr(self.server, "_shutting_down", False):
+                    break
+                try:
+                    ev = q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                self._write_chunk(self._event_line(ev))
+            self._write_chunk(b"")  # terminating chunk
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.fake.unwatch(info["api_version"], info["kind"], q)
+            self.close_connection = True
+
+    @staticmethod
+    def _event_line(ev) -> bytes:
+        return (json.dumps({"type": ev.type, "object": ev.object}) + "\n").encode()
+
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def do_POST(self):
+        if not self._authed():
+            return
+        what, info, query = self._parse()
+        if what != "resource":
+            return self._send_status(404, f"unknown path {self.path}")
+        body = self._read_body()
+        try:
+            obj = json.loads(body or b"{}")
+        except json.JSONDecodeError as exc:
+            return self._send_status(400, f"invalid JSON: {exc}")
+        if info["kind"] == "SubjectAccessReview":
+            return self._sar(obj)
+        try:
+            obj.setdefault("apiVersion", info["api_version"])
+            obj.setdefault("kind", info["kind"])
+            created = self.fake.create(
+                obj, namespace=info["namespace"],
+                dry_run=query.get("dryRun") == "All",
+            )
+            return self._send_json(201, created)
+        except ApiError as exc:
+            return self._send_status(exc.code, str(exc))
+
+    def _sar(self, sar: dict):
+        spec = sar.get("spec") or {}
+        attrs = spec.get("resourceAttributes") or {}
+        policy = self.server.sar_policy  # type: ignore[attr-defined]
+        if policy is not None:
+            allowed, reason = policy(spec)
+        else:
+            allowed, reason = rbac_allowed(
+                self.fake,
+                spec.get("user", ""),
+                attrs.get("verb", ""),
+                attrs.get("group", ""),
+                attrs.get("resource", ""),
+                attrs.get("namespace", ""),
+                spec.get("groups"),
+            )
+        sar = dict(sar)
+        sar["status"] = {"allowed": allowed, "reason": reason}
+        self._send_json(201, sar)
+
+    def do_PUT(self):
+        if not self._authed():
+            return
+        what, info, query = self._parse()
+        if what != "resource" or not info["name"]:
+            return self._send_status(404, f"unknown path {self.path}")
+        try:
+            obj = json.loads(self._read_body() or b"{}")
+            obj.setdefault("apiVersion", info["api_version"])
+            obj.setdefault("kind", info["kind"])
+            updated = self.fake.update(obj)
+            return self._send_json(200, updated)
+        except ApiError as exc:
+            return self._send_status(exc.code, str(exc))
+        except json.JSONDecodeError as exc:
+            return self._send_status(400, f"invalid JSON: {exc}")
+
+    def do_PATCH(self):
+        if not self._authed():
+            return
+        what, info, query = self._parse()
+        if what != "resource" or not info["name"]:
+            return self._send_status(404, f"unknown path {self.path}")
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype not in ("application/merge-patch+json",
+                         "application/strategic-merge-patch+json"):
+            return self._send_status(
+                415, f"unsupported patch content type {ctype!r}"
+            )
+        try:
+            patch = json.loads(self._read_body() or b"{}")
+            patched = self.fake.patch_merge(
+                info["api_version"], info["kind"], info["name"], patch,
+                info["namespace"],
+            )
+            return self._send_json(200, patched)
+        except ApiError as exc:
+            return self._send_status(exc.code, str(exc))
+        except json.JSONDecodeError as exc:
+            return self._send_status(400, f"invalid JSON: {exc}")
+
+    def do_DELETE(self):
+        if not self._authed():
+            return
+        what, info, query = self._parse()
+        if what != "resource" or not info["name"]:
+            return self._send_status(404, f"unknown path {self.path}")
+        try:
+            self.fake.delete(info["api_version"], info["kind"],
+                             info["name"], info["namespace"])
+            return self._send_status(200, "deleted")
+        except ApiError as exc:
+            return self._send_status(exc.code, str(exc))
+
+
+class FakeApiHttpServer:
+    """Lifecycle wrapper: serve a FakeApiServer over HTTP(S)."""
+
+    def __init__(
+        self,
+        fake: FakeApiServer | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: str | None = None,
+        tls_certfile: str | None = None,
+        tls_keyfile: str | None = None,
+        sar_policy=None,
+    ):
+        self.fake = fake or FakeApiServer()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.fake = self.fake  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self._httpd.sar_policy = sar_policy  # type: ignore[attr-defined]
+        self._tls = bool(tls_certfile)
+        if tls_certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_certfile, tls_keyfile)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-apiserver",
+            daemon=True,
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
+
+    def start(self) -> "FakeApiHttpServer":
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd._shutting_down = True  # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def main(argv=None):
+    """Dev apiserver: python -m kubeflow_tpu.k8s.httpd [--port N]."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--token", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = FakeApiHttpServer(
+        host=args.host, port=args.port, token=args.token
+    )
+    server.start()
+    log.info("fake apiserver at %s", server.url)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

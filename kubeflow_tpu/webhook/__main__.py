@@ -1,0 +1,3 @@
+from kubeflow_tpu.entrypoints import run_admission_webhook
+
+run_admission_webhook()

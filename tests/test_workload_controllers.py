@@ -11,7 +11,7 @@ from kubeflow_tpu.controllers.tensorboard import (
     TensorboardOptions,
     make_tensorboard_controller,
 )
-from kubeflow_tpu.crud_backend import AuthnConfig
+from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
 from kubeflow_tpu.k8s import FakeApiServer, NotFound
 
 TB_API = "tensorboard.kubeflow.org/v1alpha1"
@@ -105,7 +105,7 @@ class TestPvcViewerController:
 class TestVolumesApp:
     def test_pvc_crud_and_viewer(self):
         api = FakeApiServer()
-        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_vwa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         headers = csrf(client)
         resp = client.post(
@@ -151,7 +151,7 @@ class TestVolumesApp:
                              "persistentVolumeClaim": {"claimName": "ws"}}],
             }}},
         })
-        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_vwa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         data = app.test_client().get("/api/namespaces/alice/pvcs",
                                      headers=USER).get_json()
         assert data["pvcs"][0]["usedBy"] == ["nb"]
@@ -163,7 +163,7 @@ class TestAppFrontends:
 
     def test_vwa_frontend_served(self):
         api = FakeApiServer()
-        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_vwa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         resp = client.get("/")
         assert resp.status_code == 200 and b"Volumes" in resp.data
@@ -174,7 +174,7 @@ class TestAppFrontends:
 
     def test_twa_frontend_served(self):
         api = FakeApiServer()
-        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_twa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         resp = client.get("/")
         assert resp.status_code == 200 and b"TensorBoards" in resp.data
@@ -187,7 +187,7 @@ class TestAppFrontends:
                     "metadata": {"name": "alice"}})
         api.create({"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
                     "metadata": {"name": "fast-ssd"}})
-        app = create_vwa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_vwa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         hdr = {"kubeflow-userid": "alice@example.com"}
         assert client.get(
@@ -201,7 +201,7 @@ class TestAppFrontends:
         api = FakeApiServer()
         api.create({"apiVersion": "v1", "kind": "Namespace",
                     "metadata": {"name": "alice"}})
-        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_twa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         hdr = {"kubeflow-userid": "alice@example.com"}
         assert client.get(
@@ -212,7 +212,7 @@ class TestAppFrontends:
 class TestTensorboardsApp:
     def test_tb_crud(self):
         api = FakeApiServer()
-        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_twa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         headers = csrf(client)
         resp = client.post(
@@ -231,7 +231,7 @@ class TestTensorboardsApp:
 
     def test_missing_fields_rejected(self):
         api = FakeApiServer()
-        app = create_twa(api, authn=AuthnConfig(), secure_cookies=False)
+        app = create_twa(api, authn=AuthnConfig(), authorizer=AllowAll(), secure_cookies=False)
         client = app.test_client()
         resp = client.post(
             "/api/namespaces/alice/tensorboards",
