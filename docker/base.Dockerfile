@@ -1,0 +1,33 @@
+# Shared service base for every control-plane image (the role the
+# reference's per-component Dockerfiles play, e.g.
+# components/notebook-controller/Dockerfile — distroless Go binary;
+# here: slim Python + the prebuilt native core, nonroot).
+#
+# Build from the repo root:
+#   docker build -f docker/base.Dockerfile -t ghcr.io/kubeflow-tpu/service-base:latest .
+# then the per-component Dockerfiles in this directory FROM it.
+
+FROM python:3.12-slim AS native-build
+RUN apt-get update \
+ && apt-get install -y --no-install-recommends g++ make \
+ && rm -rf /var/lib/apt/lists/*
+COPY native/ /build/native/
+RUN make -C /build/native \
+ && /build/native/build/kft --help 2>/dev/null; test -f /build/native/build/libkft_native.so
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir \
+      werkzeug \
+      prometheus-client \
+      pyyaml \
+ && useradd --uid 65532 --user-group --no-create-home nonroot
+WORKDIR /app
+COPY kubeflow_tpu/ /app/kubeflow_tpu/
+COPY conformance/ /app/conformance/
+COPY --from=native-build /build/native/build/libkft_native.so /app/native/build/libkft_native.so
+COPY --from=native-build /build/native/build/kft /app/native/build/kft
+ENV PYTHONPATH=/app \
+    PYTHONUNBUFFERED=1 \
+    KFT_NATIVE_LIB=/app/native/build/libkft_native.so
+USER 65532
+ENTRYPOINT ["python", "-m", "kubeflow_tpu"]

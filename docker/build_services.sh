@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Build every control-plane service image: the shared base once, then
+# the ten thin component images the manifests deploy (role of the
+# reference's per-component docker build steps in
+# *_integration_test.yaml:19-35).
+#
+#   docker/build_services.sh [TAG]          # default: latest
+#   IMAGES_ONLY="jupyter-web-app" docker/build_services.sh
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+TAG="${1:-latest}"
+REGISTRY="${REGISTRY:-ghcr.io/kubeflow-tpu}"
+
+COMPONENTS=(
+  notebook-controller
+  profile-controller
+  tensorboard-controller
+  pvcviewer-controller
+  admission-webhook
+  access-management
+  centraldashboard
+  jupyter-web-app
+  volumes-web-app
+  tensorboards-web-app
+)
+
+docker build -f docker/base.Dockerfile \
+  -t "${REGISTRY}/service-base:${TAG}" .
+
+for component in ${IMAGES_ONLY:-"${COMPONENTS[@]}"}; do
+  docker build -f "docker/${component}.Dockerfile" \
+    --build-arg "BASE=${REGISTRY}/service-base:${TAG}" \
+    -t "${REGISTRY}/${component}:${TAG}" .
+done
+
+echo "built: ${REGISTRY}/{service-base,${COMPONENTS[*]// /,}}:${TAG}"

@@ -1,0 +1,6 @@
+# ghcr.io/kubeflow-tpu/centraldashboard — see docker/base.Dockerfile (shared base)
+# and docker/build_services.sh (builds base then all components).
+ARG BASE=ghcr.io/kubeflow-tpu/service-base:latest
+FROM ${BASE}
+EXPOSE 8082
+CMD ["centraldashboard"]

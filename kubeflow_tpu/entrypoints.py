@@ -182,17 +182,52 @@ def run_profile_controller():
     )
 
 
-def run_tensorboard_controller():
-    from kubeflow_tpu.controllers.tensorboard import make_tensorboard_controller
+def _istio_env(defaults) -> dict:
+    """The Istio routing options every workload controller shares
+    (USE_ISTIO / ISTIO_GATEWAY / ISTIO_HOST / CLUSTER_DOMAIN env parity
+    with the reference's params.env)."""
+    return {
+        "use_istio": _env_bool("USE_ISTIO", defaults.use_istio),
+        "istio_gateway": os.environ.get("ISTIO_GATEWAY",
+                                        defaults.istio_gateway),
+        "istio_host": os.environ.get("ISTIO_HOST", defaults.istio_host),
+        "cluster_domain": os.environ.get("CLUSTER_DOMAIN",
+                                         defaults.cluster_domain),
+    }
 
+
+def run_tensorboard_controller():
+    from kubeflow_tpu.controllers.tensorboard import (
+        TensorboardOptions,
+        make_tensorboard_controller,
+    )
+
+    defaults = TensorboardOptions()
+    options = TensorboardOptions(
+        tensorboard_image=os.environ.get(
+            "TENSORBOARD_IMAGE", defaults.tensorboard_image
+        ),
+        rwo_pvc_scheduling=_env_bool("RWO_PVC_SCHEDULING",
+                                     defaults.rwo_pvc_scheduling),
+        **_istio_env(defaults),
+    )
     _run_single_controller(make_tensorboard_controller,
-                           "tensorboard-controller")
+                           "tensorboard-controller", options=options)
 
 
 def run_pvcviewer_controller():
-    from kubeflow_tpu.controllers.pvcviewer import make_pvcviewer_controller
+    from kubeflow_tpu.controllers.pvcviewer import (
+        PvcViewerOptions,
+        make_pvcviewer_controller,
+    )
 
-    _run_single_controller(make_pvcviewer_controller, "pvcviewer-controller")
+    defaults = PvcViewerOptions()
+    options = PvcViewerOptions(
+        viewer_image=os.environ.get("VIEWER_IMAGE", defaults.viewer_image),
+        **_istio_env(defaults),
+    )
+    _run_single_controller(make_pvcviewer_controller, "pvcviewer-controller",
+                           options=options)
 
 
 # ---- webhook -------------------------------------------------------------
@@ -248,7 +283,7 @@ def run_dashboard():
     _setup_logging()
     api = _connect()
     kfam_url = os.environ.get(
-        "KFAM_URL", "http://profiles-kfam.kubeflow:8081"
+        "KFAM_URL", "http://kfam.kubeflow:8081"
     )
     app = create_app(
         api,

@@ -175,6 +175,18 @@ class FakeApiServer:
                                     o["metadata"]["name"])
             )
 
+    def list_with_rv(
+        self, api_version: str, kind: str, namespace: str | None = None,
+        label_selector: str | None = None,
+    ) -> tuple[list[dict], int]:
+        """Item snapshot + the resourceVersion it is consistent with, in
+        ONE lock acquisition — a list envelope whose rv postdates its
+        items would make watch-resume skip the gap (HTTP harness)."""
+        with self._lock:
+            items = self.list(api_version, kind, namespace=namespace,
+                              label_selector=label_selector)
+            return items, self._last_rv
+
     def update(self, obj: dict) -> dict:
         """Full replace with optimistic concurrency (resourceVersion)."""
         with self._lock:

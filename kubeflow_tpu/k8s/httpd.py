@@ -36,6 +36,7 @@ from kubeflow_tpu.k8s.core import (
     CLUSTER_SCOPED,
     ApiError,
     RESOURCE_NAMES,
+    match_label_selector,
     resource_name,
 )
 from kubeflow_tpu.k8s.fake import FakeApiServer
@@ -57,8 +58,9 @@ DISCOVERY_GROUPS = {
     "storage.k8s.io/v1": ["StorageClass"],
     "authorization.k8s.io/v1": ["SubjectAccessReview"],
     "kubeflow.org/v1beta1": ["Notebook"],
-    "kubeflow.org/v1": ["Profile", "Tensorboard", "PVCViewer"],
-    "kubeflow.org/v1alpha1": ["PodDefault"],
+    "kubeflow.org/v1": ["Profile"],
+    "kubeflow.org/v1alpha1": ["PodDefault", "PVCViewer"],
+    "tensorboard.kubeflow.org/v1alpha1": ["Tensorboard"],
     "networking.istio.io/v1beta1": ["VirtualService"],
     "security.istio.io/v1": ["AuthorizationPolicy"],
 }
@@ -248,7 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, obj)
             if query.get("watch") in ("true", "1"):
                 return self._watch(info, query)
-            items = self.fake.list(
+            items, rv = self.fake.list_with_rv(
                 info["api_version"], info["kind"],
                 namespace=info["namespace"],
                 label_selector=query.get("labelSelector"),
@@ -256,11 +258,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, {
                 "apiVersion": info["api_version"],
                 "kind": info["kind"] + "List",
-                "metadata": {
-                    "resourceVersion": str(
-                        self.fake.last_resource_version
-                    ),
-                },
+                "metadata": {"resourceVersion": str(rv)},
                 "items": items,
             })
         except ApiError as exc:
@@ -304,6 +302,22 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_status(
                 410, f"resourceVersion {rv} is too old", reason="Expired"
             )
+
+        namespace = info["namespace"]
+        selector = query.get("labelSelector")
+
+        def matches(ev) -> bool:
+            # A namespaced watch path must not leak other namespaces
+            # (real apiserver scoping); same for label selectors.
+            meta = ev.object.get("metadata", {})
+            if namespace and meta.get("namespace") != namespace:
+                return False
+            if selector and not match_label_selector(
+                meta.get("labels", {}), selector
+            ):
+                return False
+            return True
+
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -311,7 +325,8 @@ class _Handler(BaseHTTPRequestHandler):
         deadline = time.monotonic() + timeout
         try:
             for ev in backlog:
-                self._write_chunk(self._event_line(ev))
+                if matches(ev):
+                    self._write_chunk(self._event_line(ev))
             while time.monotonic() < deadline:
                 if getattr(self.server, "_shutting_down", False):
                     break
@@ -319,7 +334,8 @@ class _Handler(BaseHTTPRequestHandler):
                     ev = q.get(timeout=0.1)
                 except queue.Empty:
                     continue
-                self._write_chunk(self._event_line(ev))
+                if matches(ev):
+                    self._write_chunk(self._event_line(ev))
             self._write_chunk(b"")  # terminating chunk
         except (BrokenPipeError, ConnectionResetError):
             pass

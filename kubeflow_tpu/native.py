@@ -17,7 +17,10 @@ import threading
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libkft_native.so")
+# Containers ship a prebuilt library (docker/base.Dockerfile sets
+# KFT_NATIVE_LIB) and carry no toolchain; the dev tree builds on demand.
+_PREBUILT = os.environ.get("KFT_NATIVE_LIB")
+_LIB_PATH = _PREBUILT or os.path.join(_NATIVE_DIR, "build", "libkft_native.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -28,8 +31,15 @@ class NativeError(RuntimeError):
 
 
 def ensure_built(force: bool = False) -> str:
-    """Build the native library if missing or stale; returns its path."""
+    """Build the native library if missing or stale; returns its path.
+    With KFT_NATIVE_LIB set, the prebuilt library is used as-is."""
     with _lock:
+        if _PREBUILT:
+            if not os.path.exists(_LIB_PATH):
+                raise NativeError(
+                    f"KFT_NATIVE_LIB={_LIB_PATH} does not exist"
+                )
+            return _LIB_PATH
         kft_bin = os.path.join(_NATIVE_DIR, "build", "kft")
         stale = (
             force

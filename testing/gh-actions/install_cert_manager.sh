@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Install cert-manager (role of the reference
+# testing/gh-actions/install_cert_manager.sh): the admission webhook's
+# serving cert + caBundle injection come from a self-signed Issuer.
+set -euo pipefail
+
+CERT_MANAGER_VERSION="${CERT_MANAGER_VERSION:-v1.15.1}"
+
+kubectl apply -f \
+  "https://github.com/cert-manager/cert-manager/releases/download/${CERT_MANAGER_VERSION}/cert-manager.yaml"
+
+for deploy in cert-manager cert-manager-webhook cert-manager-cainjector; do
+  kubectl -n cert-manager wait "deploy/${deploy}" \
+    --for=condition=Available --timeout=300s
+done

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Install Istio into the integration cluster (role of the reference
+# testing/gh-actions/install_istio.sh): istioctl with the demo profile
+# minus egress, then wait for istiod + ingressgateway. The platform's
+# VirtualServices/AuthorizationPolicies need the CRDs and the gateway.
+set -euo pipefail
+
+ISTIO_VERSION="${ISTIO_VERSION:-1.22.3}"
+
+if ! command -v istioctl > /dev/null; then
+  curl -L https://istio.io/downloadIstio | \
+    ISTIO_VERSION="${ISTIO_VERSION}" TARGET_ARCH=x86_64 sh -
+  sudo mv "istio-${ISTIO_VERSION}/bin/istioctl" /usr/local/bin/
+fi
+
+istioctl install -y --set profile=default \
+  --set meshConfig.accessLogFile=/dev/stdout
+
+kubectl -n istio-system wait deploy/istiod \
+  --for=condition=Available --timeout=300s
+kubectl -n istio-system wait deploy/istio-ingressgateway \
+  --for=condition=Available --timeout=300s
+
+# The mesh gateway the manifests' VirtualServices route through.
+kubectl apply -f - <<'EOF'
+apiVersion: networking.istio.io/v1beta1
+kind: Gateway
+metadata:
+  name: kubeflow-gateway
+  namespace: kubeflow
+spec:
+  selector:
+    istio: ingressgateway
+  servers:
+    - port: {number: 80, name: http, protocol: HTTP}
+      hosts: ["*"]
+EOF

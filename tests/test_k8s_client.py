@@ -193,6 +193,24 @@ class TestWatch:
         # initial list surfaces existing objects as ADDED
         self.wait_for(q, "ADDED", "pre")
 
+    def test_namespaced_watch_does_not_leak_other_namespaces(self, server):
+        client = ApiClient(KubeConfig(host=server.url))
+        try:
+            q = client.watch("kubeflow.org/v1beta1", "Notebook",
+                             namespace="alice")
+            time.sleep(0.3)
+            client.create(nb("other", ns="bob"))
+            client.create(nb("mine", ns="alice"))
+            ev = self.wait_for(q, "ADDED", "mine")
+            assert ev.object["metadata"]["namespace"] == "alice"
+            # bob's notebook must never have been streamed.
+            leaked = [e for e in iter(
+                lambda: q.get_nowait() if not q.empty() else None, None
+            ) if e and e.object["metadata"]["namespace"] == "bob"]
+            assert not leaked
+        finally:
+            client.close()
+
     def test_watch_survives_server_side_disconnect(self, server):
         client = ApiClient(KubeConfig(host=server.url))
         try:
