@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Install Istio into the integration cluster (role of the reference
-# testing/gh-actions/install_istio.sh): istioctl with the demo profile
-# minus egress, then wait for istiod + ingressgateway. The platform's
+# testing/gh-actions/install_istio.sh): istioctl with the default
+# profile (istiod + ingressgateway), then wait for both. The platform's
 # VirtualServices/AuthorizationPolicies need the CRDs and the gateway.
 set -euo pipefail
 

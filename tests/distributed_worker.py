@@ -1,0 +1,94 @@
+"""Worker process for the multi-process jax.distributed integration
+test (tests/test_distributed_multiprocess.py). NOT a test module.
+
+Boots exactly the way a multi-host notebook replica does: read the
+platform-injected env (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES /
+KFT_COORDINATOR_ADDRESS / KFT_NUM_PROCESSES), call
+``initialize_from_env``, then prove the world works: a psum across
+every device of every process, and one sharded LM train step over a
+global mesh. Prints machine-readable lines the parent asserts on.
+"""
+
+import os
+import sys
+
+# CPU backend with N virtual devices per process — set before jax init.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.parallel.distributed import initialize_from_env  # noqa: E402
+
+
+def main():
+    denv = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == denv.num_processes, (
+        jax.process_count(), denv.num_processes
+    )
+    assert jax.process_index() == denv.process_id
+
+    world = len(jax.devices())
+    local = len(jax.local_devices())
+    print(f"WORLD {jax.process_index()} devices={world} local={local}",
+          flush=True)
+
+    # ---- collective #1: psum over every device in the slice ----------
+    from jax.experimental.shard_map import shard_map
+
+    from kubeflow_tpu.parallel import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=-1), jax.devices())
+
+    def make_global(values: np.ndarray, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            values.shape, sharding, lambda idx: values[idx]
+        )
+
+    x = make_global(np.arange(world, dtype=np.float32), P("dp"))
+    psum = jax.jit(
+        shard_map(
+            lambda v: jax.lax.psum(jnp.sum(v), "dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P(),
+        )
+    )
+    total = float(jax.device_get(psum(x)))
+    expect = float(sum(range(world)))
+    assert total == expect, (total, expect)
+    print(f"PSUM {jax.process_index()} {total}", flush=True)
+
+    # ---- collective #2: one sharded LM train step --------------------
+    from kubeflow_tpu.models import (
+        LMConfig,
+        build_lm,
+        create_lm_state,
+        make_lm_train_step,
+    )
+
+    lm_mesh = make_mesh(MeshSpec(dp=-1, sp=2), jax.devices())
+    cfg = LMConfig(vocab=64, layers=1, dim=32, heads=2)
+    model = build_lm(cfg, mesh=lm_mesh)
+    state = create_lm_state(model, jax.random.key(0), (2, 16), mesh=lm_mesh)
+    step = make_lm_train_step(lm_mesh, cfg=cfg)
+
+    dp = world // 2  # sp=2
+    rng = np.random.default_rng(0)  # same seed everywhere: global batch
+    tokens_np = rng.integers(0, 64, size=(2 * dp, 32)).astype(np.int32)
+    tokens = make_global(tokens_np, P(("dp", "fsdp"), "sp"))
+    state, metrics = step(state, {"tokens": tokens})
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss)
+    assert int(jax.device_get(state.step)) == 1
+    print(f"STEP {jax.process_index()} loss={loss:.6f}", flush=True)
+    print(f"DONE {jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
