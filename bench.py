@@ -1,17 +1,34 @@
-"""Benchmark: ResNet-50 training throughput on the local TPU chip.
+"""Benchmark: training throughput on the local TPU chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line (driver contract). The primary metric is ResNet-50
+training images/s/chip; the same record carries the LM benchmarks in
+``extra_metrics`` so the Pallas flash-attention path (including the
+S=8192 long-context config a naive XLA attention cannot fit/run well)
+is regression-tracked in BENCH_r*.json every round:
+
   {"metric": "resnet50_train_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec/chip", "vs_baseline": N, ...}
+   "unit": "images/sec/chip", "vs_baseline": N, "mfu": N,
+   "measured_ref_img_s": N, "vs_measured_ref": N,
+   "extra_metrics": [{"metric": "lm_train_tokens_per_sec_per_chip", ...},
+                     {"metric": "lm_long_context_tokens_per_sec_per_chip",
+                      ...}]}
 
 Baseline semantics (BASELINE.md): the reference platform publishes no
-numbers; the north star is ">=90% of bare-metal jax.distributed ResNet-50
-throughput". The bare-metal reference for one v5e chip is taken as 30% MFU
-of the 197 TFLOP/s bf16 peak over ~3x forward FLOPs per training image
-(fwd 8.18 GFLOP + bwd ~2x), i.e. ~2409 img/s/chip; the target is 90% of
-that. vs_baseline = measured / (0.9 * bare_metal_reference): >= 1.0 meets
-the north star. On non-v5e hardware the ratio is still reported against
-the v5e reference for comparability across rounds.
+numbers; the north star is ">=90% of bare-metal jax.distributed
+ResNet-50 throughput". Two baselines are reported:
+
+- ``vs_baseline`` — the fixed cross-round anchor: 30% MFU of the v5e
+  197 TFLOP/s bf16 peak over 3x forward FLOPs (~2409 img/s/chip),
+  target = 90% of it. Fixed so rounds stay comparable.
+- ``vs_measured_ref`` — the round-1 verdict's "measured, not assumed"
+  reference: a minimal plain-jax train step (no platform code: raw
+  model.apply + hand-rolled SGD momentum, jit+donate) measured in the
+  SAME process on the SAME chip; ours / (0.9 * measured). >= 1.0 means
+  the platform's step gives away nothing vs the simplest possible jit
+  program.
+
+Modes: KFT_BENCH_MODE=resnet|lm|long limits the run to one section
+(one JSON line of just that record); default runs all.
 """
 
 from __future__ import annotations
@@ -63,16 +80,17 @@ def run_timed(step, state, batch_data, warmup: int, steps: int):
     return state, dt
 
 
-def bench_lm():
-    """Secondary mode (KFT_BENCH_MODE=lm): long-context LM training
-    tokens/s/chip through the Pallas flash-attention path — the
-    workload class the reference platform cannot even express
-    (SURVEY.md §2.3). Still one JSON line."""
-    batch = int(os.environ.get("KFT_BENCH_BATCH", "4"))
-    seq = int(os.environ.get("KFT_BENCH_SEQ", "2048"))
-    steps = int(os.environ.get("KFT_BENCH_STEPS", "10"))
-    warmup = int(os.environ.get("KFT_BENCH_WARMUP", "4"))
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
 
+
+def bench_lm(seq: int, batch: int, steps: int, warmup: int,
+             metric: str, anchor_tokens_s: float | None):
+    """LM training tokens/s/chip through the Pallas flash-attention
+    fwd+bwd path — the workload class the reference platform cannot
+    even express (SURVEY.md §2.3). ``anchor_tokens_s`` is the fixed
+    cross-round baseline (round-1 measured value), or None for
+    configs first measured this round."""
     from kubeflow_tpu.models import (
         LMConfig,
         build_lm,
@@ -85,46 +103,98 @@ def bench_lm():
     )
     model = build_lm(cfg)
     state = create_lm_state(model, jax.random.key(0), (1, seq))
-    step = make_lm_train_step()
+    step = make_lm_train_step(cfg=cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(
         rng.integers(0, cfg.vocab, size=(batch, seq)), jnp.int32
     )
-    batch_data = {"tokens": tokens}
-    state, dt = run_timed(step, state, batch_data, warmup, steps)
+    state, dt = run_timed(step, state, {"tokens": tokens}, warmup, steps)
     tokens_s = batch * seq * steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": "lm_train_tokens_per_sec_per_chip",
-                "value": round(tokens_s, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": None,
-                "seq": seq,
-                "batch": batch,
-                "step_ms": round(1000 * dt / steps, 2),
-                "device": str(jax.devices()[0].device_kind),
-            }
-        )
+    return {
+        "metric": metric,
+        "value": round(tokens_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": (
+            round(tokens_s / anchor_tokens_s, 4) if anchor_tokens_s else None
+        ),
+        "seq": seq,
+        "batch": batch,
+        "step_ms": round(1000 * dt / steps, 2),
+        "device": str(jax.devices()[0].device_kind),
+    }
+
+
+def _measure_plain_reference(image_size: int, batch: int,
+                             steps: int, warmup: int) -> float:
+    """The 'bare-metal' reference, measured in-process: the simplest
+    possible jit'd ResNet-50 train step — raw model.apply, hand-rolled
+    SGD+momentum over the param pytree, no optax / TrainState / label
+    smoothing / metrics plumbing. What a user would write from scratch
+    in a notebook; the platform step must not be slower than 90% of it.
+    Returns images/sec."""
+    from kubeflow_tpu.models import resnet50
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((2, image_size, image_size, 3)),
+        train=False,
     )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    momentum = jax.tree.map(jnp.zeros_like, params)
+
+    def step(carry, batch):
+        params, batch_stats, momentum = carry
+
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                batch["image"], train=True, mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.take_along_axis(
+                logp, batch["label"][:, None], axis=-1
+            ).mean()
+            return loss, updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        new_momentum = jax.tree.map(
+            lambda m, g: 0.9 * m + g, momentum, grads
+        )
+        new_params = jax.tree.map(
+            lambda p, m: p - 0.1 * m, params, new_momentum
+        )
+        return (new_params, new_stats, new_momentum), {"loss": loss}
+
+    jit_step = jax.jit(step, donate_argnums=0)
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "image": jnp.asarray(
+            rng.normal(size=(batch, image_size, image_size, 3)), jnp.bfloat16
+        ),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(batch,))),
+    }
+    carry = (params, batch_stats, momentum)
+    carry, dt = run_timed(jit_step, carry, batch_data, warmup, steps)
+    return batch * steps / dt
 
 
-def main():
-    if os.environ.get("KFT_BENCH_MODE") == "lm":
-        bench_lm()
-        return
-    batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
-    image_size = int(os.environ.get("KFT_BENCH_IMAGE_SIZE", "224"))
-    steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
+def bench_resnet():
+    batch = _env_int("KFT_BENCH_BATCH", 256)
+    image_size = _env_int("KFT_BENCH_IMAGE_SIZE", 224)
+    steps = _env_int("KFT_BENCH_STEPS", 20)
     # Generous warmup: the remote-relay first execution has multi-second
     # stragglers well past compile (measured on the axon tunnel).
-    warmup = int(os.environ.get("KFT_BENCH_WARMUP", "8"))
+    warmup = _env_int("KFT_BENCH_WARMUP", 8)
 
     from kubeflow_tpu.models import create_train_state, make_train_step, resnet50
     from kubeflow_tpu.models.resnet import resnet_flops_per_image
 
     model = resnet50(num_classes=1000)
-    state = create_train_state(model, jax.random.key(0), (2, image_size, image_size, 3))
+    state = create_train_state(
+        model, jax.random.key(0), (2, image_size, image_size, 3)
+    )
     step = make_train_step(smoothing=0.1)
 
     rng = np.random.default_rng(0)
@@ -148,21 +218,83 @@ def main():
     bare_metal_ref = 0.30 * 197e12 / (3.0 * resnet_flops_per_image("resnet50"))
     target = 0.9 * bare_metal_ref
 
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(img_s, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(img_s / target, 4),
-                "mfu": round(mfu, 4),
-                "batch": batch,
-                "steps": steps,
-                "step_ms": round(1000 * dt / steps, 2),
-                "device": str(jax.devices()[0].device_kind),
-            }
+    record = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / target, 4),
+        "mfu": round(mfu, 4),
+        "batch": batch,
+        "steps": steps,
+        "step_ms": round(1000 * dt / steps, 2),
+        "device": str(jax.devices()[0].device_kind),
+    }
+
+    if os.environ.get("KFT_BENCH_SKIP_MEASURED_REF", "") not in ("1", "true"):
+        ref_img_s = _measure_plain_reference(
+            image_size, batch, steps, warmup
         )
+        record["measured_ref_img_s"] = round(ref_img_s, 2)
+        record["vs_measured_ref"] = round(img_s / (0.9 * ref_img_s), 4)
+    return record
+
+
+def main():
+    mode = os.environ.get("KFT_BENCH_MODE", "all")
+    # Single-mode runs read the generic knobs; the combined run uses
+    # LM_-prefixed ones so each section is tunable independently.
+    lm = "" if mode == "lm" else "LM_"
+    lm_defaults = dict(
+        batch=_env_int(f"KFT_BENCH_{lm}BATCH", 4),
+        seq=_env_int(f"KFT_BENCH_{lm}SEQ", 2048),
+        steps=_env_int(f"KFT_BENCH_{lm}STEPS", 10),
+        warmup=_env_int(f"KFT_BENCH_{lm}WARMUP", 4),
     )
+    # Round-1 measured LM throughput (BASELINE.md): the fixed anchor.
+    lm_anchor = float(os.environ.get("KFT_BENCH_LM_ANCHOR", "111600"))
+
+    if mode == "lm":
+        print(json.dumps(bench_lm(
+            metric="lm_train_tokens_per_sec_per_chip",
+            anchor_tokens_s=lm_anchor, **lm_defaults,
+        )))
+        return
+    if mode == "long":
+        print(json.dumps(bench_lm(
+            metric="lm_long_context_tokens_per_sec_per_chip",
+            anchor_tokens_s=None,
+            batch=_env_int("KFT_BENCH_BATCH", 1),
+            seq=_env_int("KFT_BENCH_SEQ", 8192),
+            steps=_env_int("KFT_BENCH_STEPS", 5),
+            warmup=_env_int("KFT_BENCH_WARMUP", 2),
+        )))
+        return
+    if mode == "resnet":
+        print(json.dumps(bench_resnet()))
+        return
+
+    # Default: the full driver record — ResNet primary + LM extras.
+    record = bench_resnet()
+    extras = []
+    try:
+        extras.append(bench_lm(
+            metric="lm_train_tokens_per_sec_per_chip",
+            anchor_tokens_s=lm_anchor, **lm_defaults,
+        ))
+        extras.append(bench_lm(
+            metric="lm_long_context_tokens_per_sec_per_chip",
+            anchor_tokens_s=None,
+            batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
+            seq=_env_int("KFT_BENCH_LONG_SEQ", 8192),
+            steps=_env_int("KFT_BENCH_LONG_STEPS", 5),
+            warmup=_env_int("KFT_BENCH_LONG_WARMUP", 2),
+        ))
+    except Exception as exc:  # pragma: no cover - defensive
+        # The primary metric must still be reported even if an extra
+        # section fails (e.g. OOM on an unexpected device).
+        extras.append({"metric": "bench_extra_error", "error": str(exc)})
+    record["extra_metrics"] = extras
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

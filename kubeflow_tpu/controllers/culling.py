@@ -37,20 +37,36 @@ NOTEBOOK_API = "kubeflow.org/v1beta1"
 KernelProbe = Callable[[str, str], list | None]
 
 
-def http_kernel_probe(timeout: float = 5.0) -> KernelProbe:
+def http_kernel_probe(
+    timeout: float = 5.0,
+    url_for: Callable[[str, str], str] | None = None,
+) -> KernelProbe:
+    """``url_for`` overrides the target URL (tests point it at a local
+    fixture server; production uses the in-cluster Service DNS)."""
     import json
     import urllib.request
 
-    def probe(namespace: str, name: str):
-        url = (
+    def default_url(namespace: str, name: str) -> str:
+        return (
             f"http://{name}.{namespace}.svc.cluster.local"
             f"/notebook/{namespace}/{name}/api/kernels"
         )
+
+    url_for = url_for or default_url
+
+    def probe(namespace: str, name: str):
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as resp:
-                return json.loads(resp.read().decode())
+            with urllib.request.urlopen(
+                url_for(namespace, name), timeout=timeout
+            ) as resp:
+                body = json.loads(resp.read().decode())
         except Exception:
             return None
+        # The contract is a kernel LIST; any other shape (an error page
+        # that parses as JSON, a dict) counts as unreachable, matching
+        # the reference's unmarshal-failure branch
+        # (culling_controller.go:232-239).
+        return body if isinstance(body, list) else None
 
     return probe
 
@@ -60,6 +76,7 @@ def http_tpu_busy_probe(
     port: int = 8431,
     timeout: float = 5.0,
     cluster_domain: str = "cluster.local",
+    url_for: Callable[[str, str], str] | None = None,
 ) -> Callable[[str, str], bool]:
     """TPU-idle signal (SURVEY §7 hard part d): a raw JAX process has no
     ``/api/kernels``, so the culler also scrapes the duty-cycle exporter
@@ -71,13 +88,19 @@ def http_tpu_busy_probe(
     a slice forever (kernel-idleness still gates the actual stop)."""
     import urllib.request
 
-    def probe(namespace: str, name: str) -> bool:
-        url = (
+    def default_url(namespace: str, name: str) -> str:
+        return (
             f"http://{name}-0.{name}-hosts.{namespace}.svc.{cluster_domain}"
             f":{port}/metrics"
         )
+
+    url_for = url_for or default_url
+
+    def probe(namespace: str, name: str) -> bool:
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as resp:
+            with urllib.request.urlopen(
+                url_for(namespace, name), timeout=timeout
+            ) as resp:
                 text = resp.read().decode()
         except Exception:
             return False

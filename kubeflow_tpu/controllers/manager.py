@@ -81,7 +81,15 @@ class Manager:
         self.server = None
         if prom is not None and http_port is not None:
             prom.watch_controllers(controllers)
-            self.server = ManagerServer(prom, port=http_port, ready=self.ready)
+            self.server = ManagerServer(
+                prom,
+                port=http_port,
+                ready=self.ready,
+                # pprof-role endpoints (/debug/threads, /debug/tracemalloc)
+                # are strictly opt-in, like controller-runtime's pprof
+                # listener.
+                enable_debug=_env_bool("KFT_ENABLE_DEBUG_ENDPOINTS"),
+            )
         self.elector = None
         if leader_elect:
             kwargs = {}

@@ -204,6 +204,30 @@ class ManagerServer:
                     self.send_header("Content-Type", "text/plain")
                     self.end_headers()
                     self.wfile.write(body)
+                elif (
+                    self.path == "/debug/tracemalloc" and outer.enable_debug
+                ):
+                    # pprof heap-profile role: first hit arms
+                    # tracemalloc, later hits report the top allocation
+                    # sites since then.
+                    import tracemalloc
+
+                    if not tracemalloc.is_tracing():
+                        tracemalloc.start()
+                        body = b"tracemalloc started; GET again for stats\n"
+                    else:
+                        snap = tracemalloc.take_snapshot()
+                        stats = snap.statistics("lineno")[:25]
+                        total_kib = sum(s.size for s in stats) / 1024
+                        lines = [
+                            f"top {len(stats)} allocation sites "
+                            f"({total_kib:.0f} KiB shown)"
+                        ] + [str(s) for s in stats]
+                        body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/readyz":
                     ok = outer.ready()
                     self.send_response(200 if ok else 503)

@@ -79,10 +79,64 @@ class AdmissionHandler:
             return out
 
 
+class PvcViewerAdmissionHandler:
+    """Defaulting + validating admission for PVCViewer CRs (role of the
+    reference pvcviewer_webhook.go served from the same webhook binary
+    here — second path next to /apply-poddefault). Invalid CRs are
+    rejected at admission instead of failing late in the reconciler."""
+
+    def review(self, review: dict) -> dict:
+        request = review.get("request") or {}
+        uid = request.get("uid", "")
+        response: dict = {"uid": uid, "allowed": True}
+        out = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+        try:
+            kind = request.get("kind", {}).get("kind")
+            if kind not in (None, "PVCViewer"):
+                return out  # not ours: allow untouched
+            viewer = request.get("object")
+            if not isinstance(viewer, dict):
+                raise ValueError("admission request has no PVCViewer object")
+            result = native.invoke(
+                "pvcviewer_admit",
+                {
+                    "viewer": viewer,
+                    # Fallback identity for generateName creates (object
+                    # metadata.name is still empty at admission time).
+                    "requestName": request.get("name") or "",
+                    "requestNamespace": request.get("namespace") or "",
+                },
+            )
+            if result["errors"]:
+                response["allowed"] = False
+                response["status"] = {
+                    "message": "; ".join(result["errors"]),
+                    "code": 400,
+                }
+                return out
+            if result["patch"]:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(
+                    json.dumps(result["patch"]).encode()
+                ).decode()
+            return out
+        except Exception as exc:  # malformed review: reject, don't crash
+            log.exception("pvcviewer admission failed")
+            response["allowed"] = False
+            response["status"] = {"message": str(exc), "code": 400}
+            return out
+
+
 class WebhookServer:
-    """Threaded HTTPS server exposing /apply-poddefault + /healthz
-    (TLS optional for tests; production mounts cert-manager certs the way
-    the reference's certwatcher does, reference config.go:43-60)."""
+    """Threaded HTTPS server exposing the admission paths
+    (/apply-poddefault for pod mutation, /admit-pvcviewer for PVCViewer
+    defaulting+validation) + /healthz. TLS optional for tests;
+    production mounts cert-manager certs the way the reference's
+    certwatcher does, reference config.go:43-60."""
 
     def __init__(
         self,
@@ -91,8 +145,15 @@ class WebhookServer:
         certfile: str | None = None,
         keyfile: str | None = None,
         cert_watch_period_s: float = 10.0,
+        pvcviewer_handler: "PvcViewerAdmissionHandler | None" = None,
     ):
         self.handler = handler
+        self.routes = {
+            "/apply-poddefault": handler.review,
+            "/admit-pvcviewer": (
+                pvcviewer_handler or PvcViewerAdmissionHandler()
+            ).review,
+        }
         outer = self
 
         class _HTTPHandler(http.server.BaseHTTPRequestHandler):
@@ -114,7 +175,8 @@ class WebhookServer:
                 # The apiserver appends query params (?timeout=10s):
                 # match on the path component only.
                 path = urllib.parse.urlsplit(self.path).path
-                if path.rstrip("/") != "/apply-poddefault":
+                review_fn = outer.routes.get(path.rstrip("/"))
+                if review_fn is None:
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
@@ -123,7 +185,7 @@ class WebhookServer:
                 except json.JSONDecodeError:
                     self.send_error(400, "bad JSON")
                     return
-                reply = json.dumps(outer.handler.review(review)).encode()
+                reply = json.dumps(review_fn(review)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(reply)))
@@ -232,6 +294,16 @@ def register_with_fake(api) -> None:
         return result["pod"]
 
     api.register_admission("Pod", hook)
+
+    def pvcviewer_hook(viewer: dict) -> dict:
+        result = native.invoke("pvcviewer_admit", {"viewer": viewer})
+        if result["errors"]:
+            from kubeflow_tpu.k8s.fake import ApiError
+
+            raise ApiError("; ".join(result["errors"]))
+        return result["viewer"]
+
+    api.register_admission("PVCViewer", pvcviewer_hook)
 
 
 def tpu_env_poddefault(namespace: str) -> dict:

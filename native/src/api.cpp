@@ -93,6 +93,12 @@ const std::map<std::string, Handler>& handlers() {
                                     in.contains("options") ? in.at("options")
                                                            : Json::object());
        }},
+      {"pvcviewer_admit",
+       [](const Json& in) {
+         return pvcviewer_admit(in.at("viewer"),
+                                in.get_string("requestName"),
+                                in.get_string("requestNamespace"));
+       }},
   };
   return table;
 }

@@ -2,6 +2,8 @@
 
 #include <stdexcept>
 
+#include "poddefault.hpp"  // json_patch_diff
+
 namespace kft {
 
 namespace {
@@ -342,6 +344,66 @@ Json pvcviewer_reconcile(const Json& viewer, const Json& options) {
                             prefix, rewrite, 80, options)
           : Json(nullptr);
   out["url"] = Json(base_prefix + "/");
+  return out;
+}
+
+Json pvcviewer_admit(const Json& viewer, const std::string& request_name,
+                     const std::string& request_namespace) {
+  // Mutating admission runs before the apiserver fills a generateName,
+  // so metadata.name may legitimately be empty here; the AdmissionReview
+  // request-level name/namespace are the fallback identity.
+  std::string name = meta_string(viewer, "name");
+  if (name.empty()) name = request_name;
+  std::string ns = meta_string(viewer, "namespace");
+  if (ns.empty()) ns = request_namespace;
+  Json errors = Json::array();
+
+  // Defaulting (reference Default(): fill what the user omitted so the
+  // controller and every reader see one canonical spec). All inserts
+  // into `spec` happen BEFORE binding a reference to `networking`: an
+  // object insert reallocates the member vector and would invalidate
+  // sibling references (use-after-free).
+  Json mutated = viewer;
+  Json& spec = mutated["spec"];
+  if (!spec.is_object()) spec = Json::object();
+  if (spec.find("rwoScheduling") == nullptr)
+    spec["rwoScheduling"] = Json(true);
+  if (spec.find("networking") == nullptr)
+    spec["networking"] = Json::object();
+  Json& net = spec["networking"];
+  if (!net.is_object()) net = Json::object();
+  if (net.find("targetPort") == nullptr)
+    net["targetPort"] = Json((int64_t)8080);
+  if (net.find("basePrefix") == nullptr && !name.empty())
+    // generateName creates have no final name yet; the reconciler
+    // derives the same default from the materialised name instead.
+    net["basePrefix"] = Json("/pvcviewer/" + ns + "/" + name);
+  if (net.find("rewrite") == nullptr) net["rewrite"] = Json("/");
+
+  // Validation (reference validate(): catch what would otherwise fail
+  // deep inside the reconcile, after the CR was accepted).
+  if (spec.get_string("pvc").empty())
+    errors.push_back(Json("spec.pvc: PVC name must be specified"));
+  const int64_t port = net.get_int("targetPort", 8080);
+  if (port < 1 || port > 65535)
+    errors.push_back(
+        Json("spec.networking.targetPort: must be in 1..65535"));
+  if (const Json* bp = net.find("basePrefix")) {
+    const std::string base_prefix =
+        bp->is_string() ? bp->as_string() : "";
+    if (base_prefix.empty() || base_prefix[0] != '/')
+      errors.push_back(
+          Json("spec.networking.basePrefix: must start with '/'"));
+  }
+  const std::string rewrite = net.get_string("rewrite");
+  if (rewrite.empty() || rewrite[0] != '/')
+    errors.push_back(Json("spec.networking.rewrite: must start with '/'"));
+
+  Json out = Json::object();
+  out["errors"] = errors;
+  out["patch"] =
+      errors.size() ? Json::array() : json_patch_diff(viewer, mutated);
+  out["viewer"] = mutated;
   return out;
 }
 

@@ -30,4 +30,14 @@ Json tensorboard_reconcile(const Json& tensorboard, const Json& options);
 // Same options shape; returns the same triple plus "url".
 Json pvcviewer_reconcile(const Json& viewer, const Json& options);
 
+// Admission-time defaulting + validation for PVCViewer CRs (role of the
+// reference's pvcviewer_webhook.go Default():71-147 and validate()
+// :152-177, adapted to this CRD's shape — the podSpec lives in the
+// controller here, so admission owns the declarative fields only).
+// request_name/request_namespace: the AdmissionReview request-level
+// identity (fallback when the object predates generateName fill-in).
+// Returns {"errors": [msg…], "patch": RFC6902 ops, "viewer": defaulted}.
+Json pvcviewer_admit(const Json& viewer, const std::string& request_name,
+                     const std::string& request_namespace);
+
 }  // namespace kft

@@ -215,3 +215,44 @@ class TestTpuDutyCycleSignal:
         nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
         annotations = nb["metadata"].get("annotations") or {}
         assert "kubeflow-resource-stopped" not in annotations
+
+
+class TestDebugEndpoints:
+    def test_tracemalloc_endpoint_opt_in(self):
+        """pprof heap-profile role (SURVEY §5 tracing): /debug/tracemalloc
+        arms tracing on first hit, reports top allocation sites after —
+        and is 404 unless explicitly enabled."""
+        import tracemalloc
+        import urllib.error
+        import urllib.request
+
+        from kubeflow_tpu.controllers.metrics import (
+            ControllerMetrics,
+            ManagerServer,
+        )
+
+        closed = ManagerServer(ControllerMetrics(), port=0)
+        closed.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{closed.port}/debug/tracemalloc",
+                    timeout=5,
+                )
+            assert err.value.code == 404
+        finally:
+            closed.stop()
+
+        server = ManagerServer(ControllerMetrics(), port=0, enable_debug=True)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/tracemalloc"
+            first = urllib.request.urlopen(url, timeout=5).read()
+            list(range(10000))  # some allocations to report
+            second = urllib.request.urlopen(url, timeout=5).read()
+            assert b"started" in first or b"allocation sites" in first
+            assert b"allocation sites" in second
+        finally:
+            server.stop()
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
