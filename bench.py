@@ -274,25 +274,29 @@ def main():
         return
 
     # Default: the full driver record — ResNet primary + LM extras.
+    # Each extra section fails independently: the primary metric AND
+    # every other section must still be reported (e.g. one OOM on an
+    # unexpected device must not drop the long-context record).
     record = bench_resnet()
     extras = []
-    try:
-        extras.append(bench_lm(
+    for section in (
+        lambda: bench_lm(
             metric="lm_train_tokens_per_sec_per_chip",
             anchor_tokens_s=lm_anchor, **lm_defaults,
-        ))
-        extras.append(bench_lm(
+        ),
+        lambda: bench_lm(
             metric="lm_long_context_tokens_per_sec_per_chip",
             anchor_tokens_s=None,
             batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
             seq=_env_int("KFT_BENCH_LONG_SEQ", 8192),
             steps=_env_int("KFT_BENCH_LONG_STEPS", 5),
             warmup=_env_int("KFT_BENCH_LONG_WARMUP", 2),
-        ))
-    except Exception as exc:  # pragma: no cover - defensive
-        # The primary metric must still be reported even if an extra
-        # section fails (e.g. OOM on an unexpected device).
-        extras.append({"metric": "bench_extra_error", "error": str(exc)})
+        ),
+    ):
+        try:
+            extras.append(section())
+        except Exception as exc:  # pragma: no cover - defensive
+            extras.append({"metric": "bench_extra_error", "error": str(exc)})
     record["extra_metrics"] = extras
     print(json.dumps(record))
 

@@ -95,37 +95,122 @@
     });
   }
 
-  // ---- details view ----
+  // ---- details view (reference JWA details page: overview +
+  // conditions-table + event-list + logs-viewer from the common lib) ----
+  var activeLogViewer = null;
+  // Bumped on every navigation; async renders check it so a fetch that
+  // resolves after Back cannot mount a poller against a hidden pane.
+  var detailsSession = 0;
+
+  function nbUrl(name) {
+    return apiBase() + '/notebooks/' + encodeURIComponent(name);
+  }
+
+  function renderOverview(pane, d) {
+    KF.detailsList(pane,
+      [['Namespace', d.processed.namespace],
+       ['Image', d.processed.image],
+       ['CPU', d.processed.cpu || '—'],
+       ['Memory', d.processed.memory || '—'],
+       ['TPU', d.processed.tpu
+         ? d.processed.tpu.accelerator + ' / ' + d.processed.tpu.topology
+         : 'none'],
+       ['Created', d.processed.age || '—'],
+       ['Message', d.processed.status.message || '—']]);
+    var pre = KF.el('pre', { 'class': 'kf-yaml' });
+    pre.textContent = JSON.stringify(d.notebook, null, 2);
+    pane.appendChild(KF.el('h3', { text: 'Raw resource' }));
+    pane.appendChild(pre);
+  }
+
+  function renderConditions(pane, d) {
+    var box = KF.el('div', {});
+    pane.appendChild(box);
+    KF.conditionsTable(box, (d.notebook.status || {}).conditions || []);
+  }
+
+  function renderEvents(pane, name) {
+    var box = KF.el('div', {});
+    pane.appendChild(box);
+    function load() {
+      KF.get(nbUrl(name) + '/events').then(function (d) {
+        KF.eventsTable(box, d.events);
+      }).catch(function (err) { KF.snack(err.message, true); });
+    }
+    pane.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
+      onclick: load,
+    }));
+    load();
+  }
+
+  function renderLogs(pane, name) {
+    var session = detailsSession;
+    KF.get(nbUrl(name) + '/pod').then(function (d) {
+      if (session !== detailsSession) return;  // user navigated away
+      var pods = (d.pods || []).map(function (p) {
+        return p.metadata.name;
+      });
+      if (!pods.length) {
+        pane.appendChild(KF.el('div', {
+          'class': 'kf-empty',
+          text: 'No pods yet — the StatefulSet has not started any.',
+        }));
+        return;
+      }
+      var viewerBox = KF.el('div', {});
+      var select = KF.el('select', {
+        'class': 'kf-ns-select',
+        onchange: function () { mount(select.value); },
+      }, pods.map(function (p) {
+        return KF.el('option', { value: p, text: p });
+      }));
+      // Multi-host slices have one pod per rank; default to rank 0.
+      pane.appendChild(KF.el('label', { text: 'Pod' }));
+      pane.appendChild(select);
+      pane.appendChild(viewerBox);
+      function mount(pod) {
+        if (session !== detailsSession) return;
+        if (activeLogViewer) activeLogViewer.stop();
+        activeLogViewer = KF.logsViewer(viewerBox, {
+          fetch: function () {
+            return KF.get(
+              nbUrl(name) + '/pod/' + encodeURIComponent(pod) + '/logs'
+            ).then(function (d) { return d.logs; });
+          },
+          pollMs: 5000,
+          filename: pod + '.log',
+        });
+      }
+      mount(pods[0]);
+    }).catch(function (err) { KF.snack(err.message, true); });
+  }
+
   function showDetails(name) {
-    KF.get(apiBase() + '/notebooks/' + encodeURIComponent(name))
+    detailsSession++;
+    KF.get(nbUrl(name))
       .then(function (d) {
+        if (activeLogViewer) { activeLogViewer.stop(); activeLogViewer = null; }
         var el = document.getElementById('details');
         el.innerHTML = '';
         el.appendChild(KF.el('button', {
           'class': 'kf-btn kf-btn-ghost', text: '← Back',
-          onclick: function () { show(listView); },
+          onclick: function () {
+            detailsSession++;
+            if (activeLogViewer) { activeLogViewer.stop(); activeLogViewer = null; }
+            show(listView);
+          },
         }));
         el.appendChild(KF.el('h2', { text: d.processed.name }));
         el.appendChild(KF.statusIcon(d.processed.status));
-        var dl = KF.el('dl', { 'class': 'kf-details' });
-        [['Namespace', d.processed.namespace],
-         ['Image', d.processed.image],
-         ['CPU', d.processed.cpu || '—'],
-         ['Memory', d.processed.memory || '—'],
-         ['TPU', d.processed.tpu
-           ? d.processed.tpu.accelerator + ' / ' + d.processed.tpu.topology
-           : 'none'],
-         ['Created', d.processed.age || '—'],
-         ['Message', d.processed.status.message || '—']]
-          .forEach(function (pair) {
-            dl.appendChild(KF.el('dt', { text: pair[0] }));
-            dl.appendChild(KF.el('dd', { text: String(pair[1]) }));
-          });
-        el.appendChild(dl);
-        var pre = KF.el('pre', { 'class': 'kf-yaml' });
-        pre.textContent = JSON.stringify(d.notebook, null, 2);
-        el.appendChild(KF.el('h3', { text: 'Raw resource' }));
-        el.appendChild(pre);
+        var tabBox = KF.el('div', {});
+        el.appendChild(tabBox);
+        KF.tabs(tabBox, [
+          { name: 'Overview', render: function (p) { renderOverview(p, d); } },
+          { name: 'Conditions', render: function (p) { renderConditions(p, d); } },
+          { name: 'Events', render: function (p) { renderEvents(p, name); } },
+          { name: 'Logs', render: function (p) { renderLogs(p, name); } },
+        ]);
         show(detailsView);
       })
       .catch(function (err) { KF.snack(err.message, true); });

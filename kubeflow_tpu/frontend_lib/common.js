@@ -154,6 +154,166 @@
     });
   };
 
+  // ---- tabs (reference lib details-page tab bar) ----
+  // tabs: [{name, render(pane)}]; render runs lazily on first activation.
+  KF.tabs = function (container, tabs) {
+    container.innerHTML = '';
+    var bar = KF.el('div', { 'class': 'kf-tabs', role: 'tablist' });
+    var panes = [];
+    var buttons = [];
+    tabs.forEach(function (tab, i) {
+      var pane = KF.el('div', { 'class': 'kf-tab-pane' });
+      pane.hidden = true;
+      panes.push(pane);
+      var btn = KF.el('button', {
+        'class': 'kf-tab', text: tab.name, role: 'tab',
+        onclick: function () { activate(i); },
+      });
+      buttons.push(btn);
+      bar.appendChild(btn);
+    });
+    var rendered = {};
+    function activate(i) {
+      panes.forEach(function (p, j) { p.hidden = j !== i; });
+      buttons.forEach(function (b, j) {
+        b.classList.toggle('kf-tab-active', j === i);
+      });
+      if (!rendered[i]) {
+        rendered[i] = true;
+        tabs[i].render(panes[i]);
+      }
+    }
+    container.appendChild(bar);
+    panes.forEach(function (p) { container.appendChild(p); });
+    if (tabs.length) activate(0);
+    return { activate: activate };
+  };
+
+  // ---- conditions table (reference lib/conditions-table) ----
+  KF.conditionsTable = function (container, conditions) {
+    KF.table(container, [
+      { name: 'Type', render: function (c) { return c.type || ''; } },
+      { name: 'Status', render: function (c) { return String(c.status || ''); } },
+      { name: 'Reason', render: function (c) { return c.reason || ''; } },
+      { name: 'Message', render: function (c) { return c.message || ''; } },
+      {
+        name: 'Last transition', render: function (c) {
+          return KF.age(c.lastTransitionTime) || '';
+        },
+      },
+    ], conditions || [], 'No conditions reported.');
+  };
+
+  // ---- events table (reference lib event-list on details pages) ----
+  KF.eventsTable = function (container, events) {
+    var rows = (events || []).slice().sort(function (a, b) {
+      return String(b.lastTimestamp || '').localeCompare(
+        String(a.lastTimestamp || ''));
+    });
+    KF.table(container, [
+      {
+        name: 'Type', render: function (ev) {
+          var warn = ev.type === 'Warning';
+          return KF.el('span', {
+            'class': warn ? 'kf-event-warning' : '',
+            text: ev.type || 'Normal',
+          });
+        },
+      },
+      { name: 'Reason', render: function (ev) { return ev.reason || ''; } },
+      {
+        name: 'Object', render: function (ev) {
+          var ref = ev.involvedObject || {};
+          return (ref.kind || '') + '/' + (ref.name || '');
+        },
+      },
+      { name: 'Message', render: function (ev) { return ev.message || ''; } },
+      {
+        name: 'Count', render: function (ev) {
+          return String(ev.count || 1);
+        },
+      },
+      {
+        name: 'Last seen', render: function (ev) {
+          return KF.age(ev.lastTimestamp);
+        },
+      },
+    ], rows, 'No events for this resource.');
+  };
+
+  // ---- logs viewer (reference lib/logs-viewer) ----
+  // opts: {fetch: () -> Promise<string[]>, pollMs (0 = no polling),
+  //        filename (download name)}.
+  KF.logsViewer = function (container, opts) {
+    container.innerHTML = '';
+    var pre = KF.el('pre', { 'class': 'kf-logs' });
+    var follow = KF.el('input', { type: 'checkbox' });
+    follow.checked = true;
+    var lastText = '';
+
+    function render(lines) {
+      lastText = (lines || []).join('\n');
+      pre.textContent = lastText || '(no log output yet)';
+      if (follow.checked) pre.scrollTop = pre.scrollHeight;
+    }
+
+    function load() {
+      return opts.fetch().then(render).catch(function (err) {
+        pre.textContent = 'Could not fetch logs: ' + err.message;
+      });
+    }
+
+    var bar = KF.el('div', { 'class': 'kf-actions kf-logs-bar' }, [
+      KF.el('button', {
+        'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
+        onclick: load,
+      }),
+      KF.el('label', {}, [
+        follow, KF.el('span', { text: ' Follow' }),
+      ]),
+      KF.el('button', {
+        'class': 'kf-btn kf-btn-ghost', text: 'Download',
+        onclick: function () {
+          var blob = new Blob([lastText], { type: 'text/plain' });
+          var a = KF.el('a', {
+            href: URL.createObjectURL(blob),
+            download: opts.filename || 'pod.log',
+          });
+          document.body.appendChild(a);
+          a.click();
+          a.remove();
+        },
+      }),
+    ]);
+    container.appendChild(bar);
+    container.appendChild(pre);
+    // KF.poll runs fn immediately; only load explicitly when there is
+    // no poller (two concurrent fetches could render out of order).
+    var poller;
+    if (opts.pollMs) {
+      poller = KF.poll(load, opts.pollMs);
+    } else {
+      load();
+      poller = { stop: function () {} };
+    }
+    return {
+      refresh: load,
+      stop: function () { poller.stop(); },
+    };
+  };
+
+  // ---- details list (reference lib/details-list) ----
+  // pairs: [[label, value], ...]; values render as text.
+  KF.detailsList = function (container, pairs) {
+    var dl = KF.el('dl', { 'class': 'kf-details' });
+    (pairs || []).forEach(function (pair) {
+      dl.appendChild(KF.el('dt', { text: pair[0] }));
+      dl.appendChild(KF.el('dd', { text: String(pair[1]) }));
+    });
+    container.appendChild(dl);
+    return dl;
+  };
+
   // ---- misc formatting ----
   KF.age = function (timestamp) {
     if (!timestamp) return '';

@@ -384,8 +384,14 @@ Json pvcviewer_admit(const Json& viewer, const std::string& request_name,
   // deep inside the reconcile, after the CR was accepted).
   if (spec.get_string("pvc").empty())
     errors.push_back(Json("spec.pvc: PVC name must be specified"));
-  const int64_t port = net.get_int("targetPort", 8080);
-  if (port < 1 || port > 65535)
+  // targetPort is always present after defaulting; the CRD's
+  // networking block is schemaless (preserve-unknown-fields), so the
+  // type check must happen HERE — get_int's fallback would otherwise
+  // silently accept a string port and fail late in the reconciler.
+  const Json* tp = net.find("targetPort");
+  if (tp == nullptr || !tp->is_number())
+    errors.push_back(Json("spec.networking.targetPort: must be a number"));
+  else if (tp->as_int() < 1 || tp->as_int() > 65535)
     errors.push_back(
         Json("spec.networking.targetPort: must be in 1..65535"));
   if (const Json* bp = net.find("basePrefix")) {

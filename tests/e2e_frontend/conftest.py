@@ -1,0 +1,98 @@
+"""Browser e2e tier (SURVEY §4 tier 4; role of the reference's
+Playwright/Cypress suites, e.g. jupyter/frontend/tests/e2e/
+form-page.spec.ts with route-interception fixtures).
+
+Runs the real Python apps against an in-process FakeApiServer with
+seeded fixtures and drives them with Playwright. Locally the tier
+skips when Playwright isn't installed (this image has no browser);
+.github/workflows/frontend_e2e.yaml installs Chromium and runs it in
+CI.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+playwright_sync = pytest.importorskip(
+    "playwright.sync_api",
+    reason="browser tier needs playwright (installed in CI: "
+           "frontend_e2e.yaml)",
+)
+
+
+@pytest.fixture(scope="session")
+def browser():
+    from playwright.sync_api import sync_playwright
+
+    with sync_playwright() as p:
+        browser = p.chromium.launch()
+        yield browser
+        browser.close()
+
+
+@pytest.fixture()
+def page(browser):
+    page = browser.new_page()
+    yield page
+    page.close()
+
+
+def serve_app(app):
+    """Run a RestApp on a background thread; returns its base URL.
+    Port 0 binds directly (no probe-then-rebind TOCTOU race)."""
+    from werkzeug.serving import make_server
+
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return f"http://127.0.0.1:{server.server_port}", server
+
+
+@pytest.fixture()
+def seeded_jwa():
+    """JWA + fixtures: one running TPU notebook with a pod, logs,
+    events and conditions."""
+    from kubeflow_tpu.apps.jupyter import create_app
+    from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+
+    api = FakeApiServer()
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "alice"}})
+    api.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "demo-nb", "namespace": "alice",
+                     "creationTimestamp": "2026-07-30T06:00:00Z"},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"},
+                 "template": {"spec": {"containers": [{
+                     "name": "demo-nb",
+                     "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest",
+                     "resources": {"requests": {"cpu": "2",
+                                                "memory": "4Gi"}},
+                 }]}}},
+        "status": {"readyReplicas": 1, "conditions": [{
+            "type": "Ready", "status": "True", "reason": "PodsReady",
+            "message": "all replicas ready",
+            "lastTransitionTime": "2026-07-30T06:05:00Z"}]},
+    })
+    api.create({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "demo-nb-0", "namespace": "alice",
+                             "labels": {"notebook-name": "demo-nb"}},
+                "spec": {}, "status": {"phase": "Running"}})
+    api.set_pod_logs("alice", "demo-nb-0",
+                     "jupyterlab listening on 8888\n"
+                     "TPU v5e 2x4 slice initialised\n")
+    api.create({"apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": "demo-ev1", "namespace": "alice"},
+                "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
+                "reason": "Created",
+                "message": "StatefulSet demo-nb created",
+                "type": "Normal", "count": 1,
+                "lastTimestamp": "2026-07-30T06:01:00Z"})
+    app = create_app(api, authn=AuthnConfig(dev_mode=True),
+                     authorizer=AllowAll(), secure_cookies=False)
+    url, server = serve_app(app)
+    yield url, api
+    server.shutdown()

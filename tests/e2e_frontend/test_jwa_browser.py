@@ -1,0 +1,67 @@
+"""JWA browser e2e: list table, details tabs (overview, conditions,
+events, logs viewer), and the new-notebook form flow — the scenarios
+the reference covers with form-page.spec.ts + details-page Cypress
+specs, against the real backend + fake apiserver."""
+
+from __future__ import annotations
+
+
+def test_list_renders_notebook_row(page, seeded_jwa):
+    url, _ = seeded_jwa
+    page.goto(url)
+    row = page.locator("#nb-table tbody tr")
+    row.wait_for(timeout=10_000)
+    assert "demo-nb" in row.inner_text()
+    assert "v5e 2x4" in row.inner_text()
+    # Running notebook gets an enabled Connect link.
+    connect = page.locator("a.kf-btn", has_text="Connect")
+    assert connect.get_attribute("href") == "/notebook/alice/demo-nb/"
+
+
+def test_details_tabs_conditions_events_logs(page, seeded_jwa):
+    url, _ = seeded_jwa
+    page.goto(url)
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    # Overview tab (default).
+    page.locator(".kf-details").wait_for()
+    assert "v5e / 2x4" in page.locator(".kf-details").inner_text()
+    # Conditions tab.
+    page.locator("button.kf-tab", has_text="Conditions").click()
+    assert "PodsReady" in page.locator(
+        ".kf-tab-pane:not([hidden])"
+    ).inner_text()
+    # Events tab.
+    page.locator("button.kf-tab", has_text="Events").click()
+    pane = page.locator(".kf-tab-pane:not([hidden])")
+    pane.locator("table").wait_for()
+    assert "StatefulSet demo-nb created" in pane.inner_text()
+    # Logs tab: pod selector + live viewer.
+    page.locator("button.kf-tab", has_text="Logs").click()
+    logs = page.locator(".kf-logs")
+    logs.wait_for()
+    page.wait_for_function(
+        "document.querySelector('.kf-logs').textContent.includes('TPU v5e')"
+    )
+    assert "jupyterlab listening" in logs.inner_text()
+
+
+def test_new_notebook_form_creates_cr(page, seeded_jwa):
+    url, api = seeded_jwa
+    page.goto(url)
+    page.locator("#new-btn").click()
+    page.locator("#spawner-form input[type=text]").first.fill("from-browser")
+    page.locator("button.kf-btn", has_text="Create").click()
+    page.locator("#kf-snack.kf-snack-show").wait_for()
+    assert api.get("kubeflow.org/v1beta1", "Notebook", "from-browser",
+                   "alice")
+
+
+def test_stop_button_sets_annotation(page, seeded_jwa):
+    url, api = seeded_jwa
+    page.goto(url)
+    page.locator("button.kf-btn", has_text="Stop").click()
+    page.wait_for_function(
+        "document.body.textContent.includes('Start')"
+    )
+    nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+    assert "kubeflow-resource-stopped" in nb["metadata"]["annotations"]

@@ -1,0 +1,204 @@
+"""Frontend asset sanity (local tier of SURVEY §4 tier 4).
+
+No JS runtime ships in this image, so the browser tier proper runs in
+CI (tests/e2e_frontend + .github/workflows/frontend_e2e.yaml,
+Playwright). This local tier catches what it can without executing JS:
+
+- structural validity of every shipped .js (balanced delimiters with a
+  string/comment/regex-aware scanner — catches truncated files, merge
+  damage, unclosed blocks);
+- index.html asset references resolve to real files;
+- the API paths the SPAs fetch exist on the matching backend;
+- the shared-lib components the apps call are actually defined.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "kubeflow_tpu")
+
+JS_FILES = sorted(
+    glob.glob(os.path.join(PKG, "**", "*.js"), recursive=True)
+)
+
+
+def scan_js(source: str) -> dict:
+    """Minimal JS scanner: walks the source skipping strings, template
+    literals, comments and regex literals, tracking bracket depth.
+    Returns {'depth': {'(': n, '[': n, '{': n}} — all must be zero."""
+    depth = {"(": 0, "[": 0, "{": 0}
+    pairs = {")": "(", "]": "[", "}": "{"}
+    i, n = 0, len(source)
+    last_significant = ""
+    while i < n:
+        ch = source[i]
+        nxt = source[i + 1] if i + 1 < n else ""
+        if ch in "'\"`":
+            quote = ch
+            i += 1
+            while i < n:
+                if source[i] == "\\":
+                    i += 2
+                    continue
+                if source[i] == quote:
+                    break
+                i += 1
+            last_significant = quote
+        elif ch == "/" and nxt == "/":
+            i = source.find("\n", i)
+            if i < 0:
+                break
+        elif ch == "/" and nxt == "*":
+            i = source.find("*/", i)
+            if i < 0:
+                break
+            i += 1
+        elif ch == "/" and last_significant in "(,=:[!&|?{;\n" + "":
+            # Regex literal position (standard heuristic: '/' after an
+            # operator or opener can't be division).
+            i += 1
+            in_class = False
+            while i < n:
+                if source[i] == "\\":
+                    i += 2
+                    continue
+                if source[i] == "[":
+                    in_class = True
+                elif source[i] == "]":
+                    in_class = False
+                elif source[i] == "/" and not in_class:
+                    break
+                i += 1
+            last_significant = "/"
+        else:
+            if ch in depth:
+                depth[ch] += 1
+            elif ch in pairs:
+                depth[pairs[ch]] -= 1
+            if not ch.isspace():
+                last_significant = ch
+        i += 1
+    return {"depth": depth}
+
+
+class TestJsStructure:
+    @pytest.mark.parametrize("path", JS_FILES,
+                             ids=[os.path.relpath(p, PKG) for p in JS_FILES])
+    def test_brackets_balance(self, path):
+        with open(path) as fh:
+            result = scan_js(fh.read())
+        assert all(v == 0 for v in result["depth"].values()), (
+            f"{path}: unbalanced delimiters {result['depth']}"
+        )
+
+    @pytest.mark.parametrize("path", JS_FILES,
+                             ids=[os.path.relpath(p, PKG) for p in JS_FILES])
+    def test_iife_strict_mode(self, path):
+        source = open(path).read()
+        assert "'use strict'" in source or '"use strict"' in source, (
+            f"{path}: missing strict mode"
+        )
+
+
+class TestHtmlAssets:
+    def test_referenced_assets_exist(self):
+        for html in glob.glob(os.path.join(PKG, "**", "index.html"),
+                              recursive=True):
+            content = open(html).read()
+            static_dir = os.path.dirname(html)
+            for ref in re.findall(r'(?:src|href)="([^"]+)"', content):
+                if ref.startswith(("http", "#")):
+                    continue
+                # /lib/ (absolute or SPA-relative) is the shared kit
+                # mount (RestApp.mount_static).
+                lib_ref = re.match(r"/?lib/(.+)", ref)
+                if lib_ref:
+                    target = os.path.join(PKG, "frontend_lib",
+                                          lib_ref.group(1))
+                else:
+                    target = os.path.join(static_dir, ref.lstrip("/"))
+                assert os.path.isfile(target), (
+                    f"{html} references missing asset {ref}"
+                )
+
+
+class TestLibUsageContract:
+    """Every KF.<fn> an app calls must exist in the shared lib — the
+    vanilla-JS equivalent of a missing import, which would otherwise
+    only surface as a runtime TypeError in the browser."""
+
+    def lib_exports(self):
+        source = open(os.path.join(PKG, "frontend_lib", "common.js")).read()
+        return set(re.findall(r"KF\.(\w+)\s*=", source))
+
+    def test_app_calls_resolve(self):
+        exports = self.lib_exports()
+        assert {"table", "logsViewer", "eventsTable", "conditionsTable",
+                "tabs", "detailsList"} <= exports
+        for path in JS_FILES:
+            if "frontend_lib" in path:
+                continue
+            source = open(path).read()
+            if "KF." not in source:
+                continue
+            used = set(re.findall(r"KF\.(\w+)\s*\(", source))
+            missing = used - exports
+            assert not missing, f"{path} calls undefined KF.{missing}"
+
+
+class TestApiContract:
+    """Plain 'api/...' URL literals in each SPA must match a route on
+    its backend (catches a renamed endpoint breaking the frontend)."""
+
+    def routes_of(self, app):
+        return [str(rule) for rule in app.url_map.iter_rules()]
+
+    def paths_in(self, js_path):
+        source = open(js_path).read()
+        # Literals only; concatenated URLs are covered by the e2e tier.
+        out = set()
+        for lit in re.findall(r"'(/?api/[^']*)'", source):
+            if lit.endswith("/"):
+                # Concatenation prefix ('api/namespaces/' + ns + …);
+                # the composed URL is covered by the e2e tier.
+                continue
+            out.add("/" + lit.lstrip("/"))
+        return out
+
+    def matches(self, path, routes):
+        for route in routes:
+            pattern = re.sub(r"<[^>]+>", "[^/]+", route) + "$"
+            if re.match(pattern, path):
+                return True
+        return False
+
+    @pytest.mark.parametrize("app_dir,factory", [
+        ("apps/jupyter", "kubeflow_tpu.apps.jupyter"),
+        ("apps/volumes", "kubeflow_tpu.apps.volumes"),
+        ("apps/tensorboards", "kubeflow_tpu.apps.tensorboards"),
+    ])
+    def test_spa_urls_have_backend_routes(self, app_dir, factory):
+        import importlib
+
+        from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+
+        module = importlib.import_module(factory)
+        app = module.create_app(FakeApiServer(), authn=AuthnConfig(),
+                                authorizer=AllowAll(),
+                                secure_cookies=False)
+        routes = self.routes_of(app)
+        js = os.path.join(PKG, app_dir, "static", "app.js")
+        # Apps that build every URL by concatenation contribute no
+        # literals here; the e2e tier covers those.
+        paths = self.paths_in(js)
+        for path in paths:
+            assert self.matches(path, routes), (
+                f"{js} fetches {path} but the backend has no such route"
+            )
