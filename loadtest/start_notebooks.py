@@ -18,9 +18,17 @@ Two modes:
   marks pods ready after a configurable latency. This exercises the full
   reconcile pipeline in-process — the scale tier of the test ladder
   (SURVEY.md SS4 tier 8) with actual latency numbers, no cluster needed.
+- ``processes``: the control-plane path with REAL process boundaries and
+  the REAL wire protocol: a dev apiserver served over HTTP
+  (kubeflow_tpu.k8s.httpd), the notebook controller as a separate OS
+  process (python -m kubeflow_tpu notebook-controller) watching over
+  chunked HTTP streams, and the fake kubelet talking through the
+  production ApiClient. Only the kubelet/scheduler is simulated — the
+  latency measured is the platform's own contribution to spawn->ready.
 
 Usage:
   python -m loadtest.start_notebooks -l 50 --mode simulate
+  python -m loadtest.start_notebooks -l 20 --mode processes
   python -m loadtest.start_notebooks -l 10 -n kubeflow --mode kubectl
   python -m loadtest.start_notebooks -l 10 -n kubeflow -p delete
 """
@@ -237,24 +245,21 @@ class FakeKubelet:
         return changed
 
 
-def run_simulate(
+def _measure_spawn_ready(
+    api,
+    kubelet: FakeKubelet,
     num_notebooks: int,
-    namespace: str = "kubeflow",
-    pod_latency: float = 0.0,
-    timeout: float = 60.0,
-) -> dict:
-    from kubeflow_tpu.controllers.notebook import make_notebook_controller
-    from kubeflow_tpu.k8s import FakeApiServer
-
-    api = FakeApiServer()
-    controller = make_notebook_controller(api)
-    kubelet = FakeKubelet(api, pod_latency=pod_latency)
+    namespace: str,
+    timeout: float,
+    poll_sleep: float,
+) -> dict[str, float]:
+    """Shared measurement core for simulate/processes: run the fake
+    kubelet on a thread, create N notebook+PVC pairs, poll readiness
+    (status.readyReplicas >= wanted replicas), return latencies."""
     nb_tmpl, pvc_tmpl = load_templates()
-
     created_at: dict[str, float] = {}
     latencies: dict[str, float] = {}
     stop = threading.Event()
-
     logged_errors: set[str] = set()
 
     def kubelet_loop():
@@ -272,11 +277,10 @@ def run_simulate(
                 if err not in logged_errors:
                     logged_errors.add(err)
                     print(f"fake kubelet error:\n{err}", file=sys.stderr)
-            time.sleep(0.002)
+            time.sleep(poll_sleep)
 
     kubelet_thread = threading.Thread(target=kubelet_loop, daemon=True)
     kubelet_thread.start()
-    controller_thread = controller.start()
     try:
         for i in range(num_notebooks):
             nb = render_notebook(nb_tmpl, i, namespace)
@@ -285,20 +289,130 @@ def run_simulate(
             created_at[nb["metadata"]["name"]] = time.monotonic()
         deadline = time.monotonic() + timeout
         while len(latencies) < num_notebooks and time.monotonic() < deadline:
-            for nb in api.list("kubeflow.org/v1beta1", "Notebook", namespace):
+            for nb in api.list("kubeflow.org/v1beta1", "Notebook",
+                               namespace=namespace):
                 name = nb["metadata"]["name"]
                 if name in latencies or name not in created_at:
                     continue
-                want = max(nb["spec"].get("tpu", {}).get("replicas", 1), 1)
-                if nb.get("status", {}).get("readyReplicas", 0) >= want:
+                want = max((nb["spec"].get("tpu") or {}).get("replicas", 1),
+                           1)
+                if (nb.get("status") or {}).get("readyReplicas", 0) >= want:
                     latencies[name] = time.monotonic() - created_at[name]
-            time.sleep(0.002)
+            time.sleep(poll_sleep)
     finally:
         stop.set()
-        controller.stop()
         kubelet_thread.join(timeout=1)
+    return latencies
+
+
+def run_simulate(
+    num_notebooks: int,
+    namespace: str = "kubeflow",
+    pod_latency: float = 0.0,
+    timeout: float = 60.0,
+) -> dict:
+    from kubeflow_tpu.controllers.notebook import make_notebook_controller
+    from kubeflow_tpu.k8s import FakeApiServer
+
+    api = FakeApiServer()
+    controller = make_notebook_controller(api)
+    kubelet = FakeKubelet(api, pod_latency=pod_latency)
+    controller_thread = controller.start()
+    try:
+        latencies = _measure_spawn_ready(
+            api, kubelet, num_notebooks, namespace, timeout,
+            poll_sleep=0.002,
+        )
+    finally:
+        controller.stop()
         controller_thread.join(timeout=1)
     return summarize(latencies, "simulate")
+
+
+def run_processes(
+    num_notebooks: int,
+    namespace: str = "kubeflow",
+    pod_latency: float = 0.0,
+    timeout: float = 120.0,
+) -> dict:
+    """simulate-mode measurement across real process boundaries: the
+    controller is an OS process connected over HTTP; the harness and
+    fake kubelet use the production ApiClient."""
+    import os
+    import signal
+    import subprocess
+
+    from kubeflow_tpu.k8s.client import ApiClient, KubeConfig
+    from kubeflow_tpu.k8s.httpd import FakeApiHttpServer
+
+    server = FakeApiHttpServer().start()
+    env = {
+        **os.environ,
+        "KFT_APISERVER": server.url,
+        "METRICS_PORT": "0",
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("KFT_FAKE_API", None)
+    controller = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu", "notebook-controller"],
+        env=env,
+        cwd=str(HERE.parent),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    # Drain controller output on a thread: an undrained PIPE would
+    # deadlock a chatty controller once the OS buffer fills, and its
+    # log is the only diagnostic when a run fails.
+    controller_log: list[str] = []
+    started = threading.Event()
+
+    def drain():
+        for line in controller.stdout:
+            controller_log.append(line)
+            if "notebook-controller started" in line:
+                started.set()
+
+    drain_thread = threading.Thread(target=drain, daemon=True)
+    drain_thread.start()
+
+    api = ApiClient(KubeConfig(host=server.url))
+    kubelet = FakeKubelet(api, pod_latency=pod_latency)
+    try:
+        # Readiness, not a fixed sleep: the controller logs its started
+        # line after wiring watches; a dead process is caught here
+        # instead of burning the whole measurement timeout.
+        boot_deadline = time.monotonic() + 30.0
+        while not started.is_set():
+            if controller.poll() is not None:
+                raise RuntimeError(
+                    "controller exited before starting:\n"
+                    + "".join(controller_log)
+                )
+            if time.monotonic() > boot_deadline:
+                raise RuntimeError(
+                    "controller did not report started within 30s:\n"
+                    + "".join(controller_log)
+                )
+            time.sleep(0.05)
+        latencies = _measure_spawn_ready(
+            api, kubelet, num_notebooks, namespace, timeout,
+            poll_sleep=0.01,
+        )
+    finally:
+        controller.send_signal(signal.SIGTERM)
+        try:
+            controller.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            controller.kill()
+        drain_thread.join(timeout=2)
+        api.close()
+        server.close()
+    summary = summarize(latencies, "processes")
+    if len(latencies) < num_notebooks:
+        print("controller log tail:\n" + "".join(controller_log[-50:]),
+              file=sys.stderr)
+    return summary
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -319,8 +433,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="kubectl operation. (Default: %(default)s)",
     )
     parser.add_argument(
-        "--mode", choices=["kubectl", "simulate"], default="kubectl",
-        help="Real cluster via kubectl, or in-process controller simulation.",
+        "--mode", choices=["kubectl", "simulate", "processes"],
+        default="kubectl",
+        help="Real cluster via kubectl, in-process controller simulation, "
+        "or real process boundaries over the HTTP wire (processes).",
     )
     parser.add_argument(
         "--wait", action="store_true",
@@ -340,6 +456,13 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.mode == "simulate":
         summary = run_simulate(
+            args.num_notebooks,
+            namespace=args.namespace,
+            pod_latency=args.pod_latency,
+            timeout=args.timeout,
+        )
+    elif args.mode == "processes":
+        summary = run_processes(
             args.num_notebooks,
             namespace=args.namespace,
             pod_latency=args.pod_latency,

@@ -3,6 +3,7 @@ with the reference loadtest (reference
 notebook-controller/loadtest/start_notebooks.py write_notebook_config /
 write_pvc_config) plus the spawn→ready timing capture SURVEY.md §6 adds."""
 
+import pytest
 import yaml
 
 from loadtest.start_notebooks import (
@@ -72,3 +73,17 @@ class TestSimulate:
         # The fake kubelet's pod latency is the floor for every sample.
         assert summary["p50"] >= 0.05
         assert summary["mode"] == "simulate"
+
+
+class TestProcesses:
+    @pytest.mark.slow
+    def test_processes_mode_measures_over_the_wire(self):
+        """Real process boundaries: dev apiserver over HTTP, the
+        notebook controller as an OS process, the fake kubelet through
+        the production ApiClient."""
+        from loadtest.start_notebooks import run_processes
+
+        summary = run_processes(3, timeout=60.0)
+        assert summary["mode"] == "processes"
+        assert summary["count"] == 3
+        assert 0 < summary["p50"] < 30.0
