@@ -136,15 +136,281 @@ def check_poddefault(api, namespace: str) -> tuple[str, bool, str]:
     return ("poddefault-conformance", True, "TPU env + toleration injected")
 
 
-def main() -> int:
-    from kubeflow_tpu.k8s import FakeApiServer
-
-    docs = [
+def _load_docs() -> list[dict]:
+    return [
         d
         for path in SETUP_DOCS
         for d in yaml.safe_load_all(path.read_text())
         if d
     ]
+
+
+def _wait_for(fn, timeout: float = 30.0, interval: float = 0.1):
+    """Poll ``fn`` until it returns without raising; returns its value.
+    Re-raises the last error on timeout."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return fn()
+        except Exception:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(interval)
+
+
+def processes_main() -> int:
+    """The same certification against REAL process boundaries: dev
+    apiserver over HTTP, profile/notebook controllers and the admission
+    webhook as OS processes (the deployed topology, minus kubelet) —
+    the closest a machine without a cluster gets to the cluster flow.
+    """
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    from kubeflow_tpu.k8s.client import ApiClient, KubeConfig
+    from kubeflow_tpu.k8s.httpd import FakeApiHttpServer
+    from kubeflow_tpu.webhook.server import register_remote_webhook
+    from loadtest.start_notebooks import FakeKubelet
+
+    docs = _load_docs()
+    profile = next(d for d in docs if d["kind"] == "Profile")
+    ns = profile["metadata"]["name"]
+
+    server = FakeApiHttpServer().start()
+    env = {
+        **os.environ,
+        "KFT_APISERVER": server.url,
+        "METRICS_PORT": "0",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONUNBUFFERED": "1",
+    }
+    env.pop("KFT_FAKE_API", None)
+
+    certdir = tempfile.mkdtemp(prefix="kft-conformance-")
+    cert = os.path.join(certdir, "tls.crt")
+    key = os.path.join(certdir, "tls.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        webhook_port = s.getsockname()[1]
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu", component],
+            env={**env, **extra}, cwd=str(REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for component, extra in [
+            ("profile-controller", {}),
+            ("notebook-controller", {}),
+            ("admission-webhook", {"WEBHOOK_PORT": str(webhook_port),
+                                   "CERT_FILE": cert, "KEY_FILE": key}),
+        ]
+    ]
+    logs = [[] for _ in procs]
+    for i, proc in enumerate(procs):
+        threading.Thread(
+            target=lambda p=proc, buf=logs[i]: buf.extend(p.stdout),
+            daemon=True,
+        ).start()
+
+    api = ApiClient(KubeConfig(host=server.url))
+    results = []
+    kubelet_stop = threading.Event()
+    try:
+        # Wire the apiserver -> webhook-process admission path (what the
+        # MutatingWebhookConfiguration does in a cluster).
+        import ssl as ssl_mod
+        import urllib.request
+
+        ctx = ssl_mod.create_default_context(cafile=cert)
+
+        def webhook_up():
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{webhook_port}/healthz",
+                timeout=2, context=ctx,
+            ):
+                return True
+
+        _wait_for(webhook_up, timeout=30.0)
+        register_remote_webhook(
+            server.fake, f"https://127.0.0.1:{webhook_port}/apply-poddefault",
+            cafile=cert,
+        )
+
+        # ---- profile-conformance ----
+        api.create(profile)
+
+        def profile_ready():
+            api.get("v1", "Namespace", ns)
+            api.get("v1", "ServiceAccount", "default-editor", ns)
+            api.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                    "namespaceAdmin", ns)
+            return api.get("v1", "ResourceQuota", "kf-resource-quota", ns)
+
+        try:
+            quota = _wait_for(profile_ready)
+            hard = quota["spec"]["hard"]
+            ok = hard.get("google.com/tpu") == "4"
+            results.append((
+                "profile-conformance", ok,
+                f"namespace {ns} materialised by the controller process"
+                if ok else f"TPU quota missing: {hard}",
+            ))
+        except Exception as exc:
+            results.append(("profile-conformance", False, str(exc)))
+
+        # ---- notebook-conformance ----
+        kubelet = FakeKubelet(api)
+        kubelet_errors: set[str] = set()
+
+        def kubelet_loop():
+            import traceback
+
+            while not kubelet_stop.is_set():
+                try:
+                    kubelet.step(time.monotonic())
+                except Exception:
+                    # Keep ticking, but a broken kubelet must be
+                    # diagnosable (first traceback per distinct error).
+                    err = traceback.format_exc()
+                    if err not in kubelet_errors:
+                        kubelet_errors.add(err)
+                        print(f"fake kubelet error:\n{err}",
+                              file=sys.stderr)
+                time.sleep(0.05)
+
+        threading.Thread(target=kubelet_loop, daemon=True).start()
+        api.create({
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "conformance-nb", "namespace": ns},
+            "spec": {
+                "tpu": {"accelerator": "v5e", "topology": "4x4",
+                        "replicas": 4},
+                "template": {"spec": {"containers": [{
+                    "name": "conformance-nb",
+                    "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest",
+                }]}},
+            },
+        })
+
+        def notebook_ready():
+            nb = api.get("kubeflow.org/v1beta1", "Notebook",
+                         "conformance-nb", ns)
+            assert nb.get("status", {}).get("readyReplicas", 0) == 4, (
+                nb.get("status")
+            )
+            return nb
+
+        try:
+            _wait_for(notebook_ready, timeout=60.0)
+            sts = api.get("apps/v1", "StatefulSet", "conformance-nb", ns)
+            tmpl = sts["spec"]["template"]["spec"]
+            limits = tmpl["containers"][0].get("resources", {}).get(
+                "limits", {})
+            env_names = {e["name"]
+                         for e in tmpl["containers"][0].get("env", [])}
+            checks = {
+                "replicas=4": sts["spec"]["replicas"] == 4,
+                "tpu-limit": limits.get("google.com/tpu") == "4",
+                "gke-topology": tmpl.get("nodeSelector", {}).get(
+                    "cloud.google.com/gke-tpu-topology") == "4x4",
+                "worker-id-env": "TPU_WORKER_ID" in env_names,
+                "coordinator-env": "KFT_COORDINATOR_ADDRESS" in env_names,
+            }
+            failed = [k for k, ok in checks.items() if not ok]
+            results.append((
+                "notebook-conformance", not failed,
+                "v5e-16 notebook spawned to ready across processes"
+                if not failed else f"failed: {failed}",
+            ))
+        except Exception as exc:
+            results.append(("notebook-conformance", False, str(exc)))
+
+        # ---- poddefault-conformance (through the webhook PROCESS) ----
+        from kubeflow_tpu.webhook.server import tpu_env_poddefault
+
+        try:
+            api.create(tpu_env_poddefault(ns))
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "tpu-workload", "namespace": ns,
+                             "labels": {"tpu-env": "true"}},
+                "spec": {"containers": [{"name": "main", "image": "x"}]},
+            })
+            pod = api.get("v1", "Pod", "tpu-workload", ns)
+            env_map = {
+                e["name"]: e.get("value")
+                for c in pod["spec"]["containers"]
+                for e in c.get("env", [])
+            }
+            tolerations = pod["spec"].get("tolerations", [])
+            ok = env_map.get("JAX_PLATFORMS") == "tpu,cpu" and any(
+                t.get("key") == "google.com/tpu" for t in tolerations
+            )
+            results.append((
+                "poddefault-conformance", ok,
+                "TPU env + toleration injected over HTTPS by the webhook "
+                "process" if ok else
+                f"injection incomplete: env={env_map}",
+            ))
+        except Exception as exc:
+            results.append(("poddefault-conformance", False, str(exc)))
+    finally:
+        kubelet_stop.set()
+        for proc in procs:
+            proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        api.close()
+        server.close()
+
+    ok = True
+    for name, passed, detail in results:
+        print(f"{'PASS' if passed else 'FAIL'} {name}: {detail}")
+        ok = ok and passed
+    if not ok:
+        for i, buf in enumerate(logs):
+            tail = "".join(buf[-30:])
+            if tail:
+                print(f"--- process {i} log tail ---\n{tail}",
+                      file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from kubeflow_tpu.k8s import FakeApiServer
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--mode", choices=["local", "processes"], default="local",
+        help="local: in-process stack; processes: dev apiserver over "
+        "HTTP + controllers/webhook as OS processes.",
+    )
+    args = parser.parse_args(argv)
+    if args.mode == "processes":
+        return processes_main()
+
+    docs = _load_docs()
     api = FakeApiServer()
     results = [check_profile(api, docs)]
     ns = next(d for d in docs if d["kind"] == "Profile")["metadata"]["name"]

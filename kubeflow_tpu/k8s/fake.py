@@ -114,27 +114,35 @@ class FakeApiServer:
                dry_run: bool = False) -> dict:
         """Create; with dry_run, run full validation + admission but
         persist nothing (server-side dry-run semantics — the reference
-        JWA dry-run-creates before committing, reference post.py:51-57)."""
+        JWA dry-run-creates before committing, reference post.py:51-57).
+
+        Admission runs BEFORE the store lock, like the real apiserver
+        runs webhooks before storage. This is a correctness requirement,
+        not a style choice: a remote admission hook (the webhook
+        *process*, register_remote_webhook) lists PodDefaults back
+        through this same apiserver from another thread — invoking it
+        under the store lock would deadlock the two handler threads.
+        generateName is also materialised after admission (webhooks see
+        the empty name, exactly as in a cluster)."""
+        obj = copy.deepcopy(obj)
+        gvk = GVK.from_obj(obj)
+        meta = obj.setdefault("metadata", {})
+        if not meta.get("name") and not meta.get("generateName"):
+            raise ApiError("metadata.name required")
+        if gvk.kind not in CLUSTER_SCOPED:
+            meta.setdefault("namespace", namespace or "default")
+        for hook in self._admission.get(gvk.kind, []):
+            obj = hook(obj)
+            meta = obj["metadata"]
         with self._lock:
-            obj = copy.deepcopy(obj)
-            gvk = GVK.from_obj(obj)
-            meta = obj.setdefault("metadata", {})
             name = meta.get("name")
             if not name:
-                if meta.get("generateName"):
-                    name = meta["generateName"] + uuid.uuid4().hex[:6]
-                    meta["name"] = name
-                else:
-                    raise ApiError("metadata.name required")
-            if gvk.kind not in CLUSTER_SCOPED:
-                meta.setdefault("namespace", namespace or "default")
+                name = meta["generateName"] + uuid.uuid4().hex[:6]
+                meta["name"] = name
             key = self._key(gvk, meta.get("namespace"), name)
             bucket = self._bucket(gvk)
             if key in bucket:
                 raise Conflict(f"{gvk.kind} {key} already exists")
-            for hook in self._admission.get(gvk.kind, []):
-                obj = hook(obj)
-                meta = obj["metadata"]
             if dry_run:
                 return copy.deepcopy(obj)
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())

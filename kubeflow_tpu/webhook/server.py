@@ -310,6 +310,94 @@ def register_with_fake(api) -> None:
     api.register_admission("PVCViewer", pvcviewer_hook)
 
 
+def apply_json_patch(obj: dict, patch: list) -> dict:
+    """Apply exactly the RFC 6902 subset the native diff engine emits
+    (native/src/poddefault.cpp json_patch_diff): add / replace / remove
+    on OBJECT member paths — arrays are always replaced wholesale at
+    their object key, never indexed into. Anything else is rejected
+    loudly rather than half-applied."""
+    import copy
+
+    out = copy.deepcopy(obj)
+    for op in patch:
+        parts = [
+            p.replace("~1", "/").replace("~0", "~")
+            for p in op["path"].lstrip("/").split("/")
+        ]
+        parent = out
+        for part in parts[:-1]:
+            if not isinstance(parent, dict):
+                raise ValueError(
+                    f"unsupported patch path {op['path']!r}: array "
+                    "traversal is outside the engine's emitted subset"
+                )
+            parent = parent.setdefault(part, {})
+        if not isinstance(parent, dict):
+            raise ValueError(
+                f"unsupported patch path {op['path']!r}: array "
+                "indexing is outside the engine's emitted subset"
+            )
+        last = parts[-1]
+        kind = op["op"]
+        if kind in ("add", "replace"):
+            parent[last] = op["value"]
+        elif kind == "remove":
+            parent.pop(last, None)
+        else:
+            raise ValueError(f"unsupported patch op {kind!r}")
+    return out
+
+
+def register_remote_webhook(api, url: str, cafile: str | None = None,
+                            timeout: float = 10.0) -> None:
+    """Play the APISERVER's side of the MutatingWebhookConfiguration:
+    every pod CREATE on the fake is wrapped into an AdmissionReview,
+    POSTed to a real webhook process over HTTPS, and the returned
+    JSONPatch is applied (or the rejection surfaced). This is how the
+    processes-tier conformance exercises the deployed admission path
+    end to end without a cluster."""
+    import ssl
+    import urllib.request
+
+    ctx = ssl.create_default_context(cafile=cafile) if cafile else None
+
+    def hook(pod: dict) -> dict:
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": pod.get("metadata", {}).get("name", "uid"),
+                "kind": {"kind": "Pod"},
+                "namespace": pod.get("metadata", {}).get(
+                    "namespace", "default"
+                ),
+                "operation": "CREATE",
+                "object": pod,
+            },
+        }
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as r:
+            out = json.loads(r.read())
+        response = out.get("response") or {}
+        if not response.get("allowed", False):
+            from kubeflow_tpu.k8s.fake import ApiError
+
+            raise ApiError(
+                (response.get("status") or {}).get("message",
+                                                   "admission denied")
+            )
+        if response.get("patch"):
+            patch = json.loads(base64.b64decode(response["patch"]))
+            return apply_json_patch(pod, patch)
+        return pod
+
+    api.register_admission("Pod", hook)
+
+
 def tpu_env_poddefault(namespace: str) -> dict:
     """The platform-shipped PodDefault: selecting pods get slice-ready
     env (the jupyter-jax-tpu image's sitecustomize then calls

@@ -1,5 +1,6 @@
 """Releasing + conformance harness tests (SURVEY.md §2 #21, #22)."""
 
+import pytest
 import importlib.machinery
 import importlib.util
 import shutil
@@ -84,7 +85,16 @@ class TestConformance:
     def test_local_conformance_passes(self):
         from conformance.run_local import main
 
-        assert main() == 0
+        assert main([]) == 0
+
+    @pytest.mark.slow
+    def test_processes_conformance_passes(self):
+        """The deployed topology minus kubelet: dev apiserver over
+        HTTP, profile/notebook controllers + admission webhook as OS
+        processes, PodDefault mutation over real HTTPS."""
+        from conformance.run_local import processes_main
+
+        assert processes_main() == 0
 
     def test_job_manifests_parse(self):
         for name in ["notebook-conformance.yaml", "tpu-conformance.yaml"]:
