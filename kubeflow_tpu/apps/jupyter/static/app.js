@@ -130,18 +130,11 @@
   }
 
   function renderEvents(pane, name) {
-    var box = KF.el('div', {});
-    pane.appendChild(box);
-    function load() {
-      KF.get(nbUrl(name) + '/events').then(function (d) {
-        KF.eventsTable(box, d.events);
-      }).catch(function (err) { KF.snack(err.message, true); });
-    }
-    pane.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
-      onclick: load,
-    }));
-    load();
+    KF.eventsPane(pane, function () {
+      return KF.get(nbUrl(name) + '/events').then(function (d) {
+        return d.events;
+      });
+    });
   }
 
   function renderLogs(pane, name) {

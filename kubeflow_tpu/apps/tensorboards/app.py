@@ -62,6 +62,20 @@ def create_app(
             raise ApiError(str(exc), 409)
         return {}
 
+    @app.route("/api/namespaces/<namespace>/tensorboards/<name>/events")
+    def get_tensorboard_events(request, namespace, name):
+        """Details drawer: events on the Tensorboard CR and its derived
+        Deployment/pods — pod-level ImagePullBackOff/FailedScheduling
+        is what the drawer exists to surface (reference TWA details
+        page event-list)."""
+        from kubeflow_tpu.crud_backend.events import list_events_for
+
+        ensure(app.authorizer, request.user, "list", "", "events",
+               namespace)
+        return {"events": list_events_for(
+            api, namespace, name, {"Tensorboard"}
+        )}
+
     @app.route(
         "/api/namespaces/<namespace>/tensorboards/<name>", methods=["DELETE"]
     )

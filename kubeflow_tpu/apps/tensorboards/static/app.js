@@ -9,13 +9,64 @@
   var state = { namespace: null };
   var listView = document.getElementById('list-view');
   var formView = document.getElementById('form-view');
+  var detailsView = document.getElementById('details-view');
 
   function apiBase() {
     return 'api/namespaces/' + encodeURIComponent(state.namespace);
   }
 
   function show(view) {
-    [listView, formView].forEach(function (v) { v.hidden = v !== view; });
+    [listView, formView, detailsView].forEach(function (v) {
+      v.hidden = v !== view;
+    });
+  }
+
+  // ---- details drawer (reference TWA details page). Re-fetches on
+  // open so a 'not yet ready' snapshot can't go stale.
+  function showDetails(name) {
+    KF.get(apiBase() + '/tensorboards').then(function (d) {
+      var tb = (d.tensorboards || []).filter(function (t) {
+        return t.name === name;
+      })[0];
+      if (!tb) {
+        KF.snack('TensorBoard "' + name + '" no longer exists', true);
+        return;
+      }
+      renderDetails(tb);
+    }).catch(function (err) { KF.snack(err.message, true); });
+  }
+
+  function renderDetails(tb) {
+    var el = document.getElementById('details');
+    el.innerHTML = '';
+    el.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: '← Back',
+      onclick: function () { show(listView); },
+    }));
+    el.appendChild(KF.el('h2', { text: tb.name }));
+    var tabBox = KF.el('div', {});
+    el.appendChild(tabBox);
+    KF.tabs(tabBox, [
+      {
+        name: 'Overview', render: function (pane) {
+          KF.detailsList(pane,
+            [['Namespace', tb.namespace],
+             ['Logs path', tb.logspath],
+             ['Ready', tb.ready ? 'yes' : 'not yet'],
+             ['Created', tb.age || '—']]);
+        },
+      },
+      {
+        name: 'Events', render: function (pane) {
+          KF.eventsPane(pane, function () {
+            return KF.get(apiBase() + '/tensorboards/' +
+              encodeURIComponent(tb.name) + '/events')
+              .then(function (d) { return d.events; });
+          });
+        },
+      },
+    ]);
+    show(detailsView);
   }
 
   function connectUrl(tb) {
@@ -30,7 +81,14 @@
           ? { phase: 'running' } : { phase: 'waiting' });
       },
     },
-    { name: 'Name', render: function (tb) { return tb.name; } },
+    {
+      name: 'Name', render: function (tb) {
+        return KF.el('a', {
+          'class': 'kf-link', text: tb.name,
+          onclick: function () { showDetails(tb.name); },
+        });
+      },
+    },
     { name: 'Logs path', render: function (tb) { return tb.logspath; } },
     { name: 'Age', render: function (tb) { return KF.age(tb.age); } },
     {

@@ -118,6 +118,19 @@ def create_app(
             raise ApiError(f"pvc {name!r} not found", 404)
         return {}
 
+    @app.route("/api/namespaces/<namespace>/pvcs/<name>/events")
+    def get_pvc_events(request, namespace, name):
+        """Details drawer: events on the PVC, its viewer, and the
+        viewer's derived workload objects (reference VWA details page
+        event-list, crud_backend/api/events.py)."""
+        from kubeflow_tpu.crud_backend.events import list_events_for
+
+        ensure(app.authorizer, request.user, "list", "", "events",
+               namespace)
+        return {"events": list_events_for(
+            api, namespace, name, {"PersistentVolumeClaim", "PVCViewer"}
+        )}
+
     # ---- viewers --------------------------------------------------------
     @app.route("/api/namespaces/<namespace>/viewers", methods=["POST"])
     def post_viewer(request, namespace):

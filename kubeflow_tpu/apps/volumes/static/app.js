@@ -8,13 +8,71 @@
   var state = { namespace: null };
   var listView = document.getElementById('list-view');
   var formView = document.getElementById('form-view');
+  var detailsView = document.getElementById('details-view');
 
   function apiBase() {
     return 'api/namespaces/' + encodeURIComponent(state.namespace);
   }
 
   function show(view) {
-    [listView, formView].forEach(function (v) { v.hidden = v !== view; });
+    [listView, formView, detailsView].forEach(function (v) {
+      v.hidden = v !== view;
+    });
+  }
+
+  // ---- details drawer (reference VWA details page: overview +
+  // event-list from the common lib). Re-fetches on open — the cached
+  // list-row snapshot would freeze 'viewer starting…' forever.
+  function showDetails(name) {
+    KF.get(apiBase() + '/pvcs').then(function (d) {
+      var pvc = (d.pvcs || []).filter(function (p) {
+        return p.name === name;
+      })[0];
+      if (!pvc) {
+        KF.snack('Volume "' + name + '" no longer exists', true);
+        return;
+      }
+      renderDetails(pvc);
+    }).catch(function (err) { KF.snack(err.message, true); });
+  }
+
+  function renderDetails(pvc) {
+    var el = document.getElementById('details');
+    el.innerHTML = '';
+    el.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: '← Back',
+      onclick: function () { show(listView); },
+    }));
+    el.appendChild(KF.el('h2', { text: pvc.name }));
+    var tabBox = KF.el('div', {});
+    el.appendChild(tabBox);
+    KF.tabs(tabBox, [
+      {
+        name: 'Overview', render: function (pane) {
+          KF.detailsList(pane,
+            [['Namespace', state.namespace],
+             ['Status', pvc.status],
+             ['Size', pvc.size || '—'],
+             ['Access mode', pvc.mode || '—'],
+             ['Storage class', pvc.class || 'default'],
+             ['Used by', pvc.usedBy.join(', ') || '—'],
+             ['Viewer', pvc.viewer
+               ? (pvc.viewer.ready ? 'ready at ' + pvc.viewer.url
+                 : 'starting…')
+               : 'none']]);
+        },
+      },
+      {
+        name: 'Events', render: function (pane) {
+          KF.eventsPane(pane, function () {
+            return KF.get(apiBase() + '/pvcs/' +
+              encodeURIComponent(pvc.name) + '/events')
+              .then(function (d) { return d.events; });
+          });
+        },
+      },
+    ]);
+    show(detailsView);
   }
 
   function viewerCell(pvc) {
@@ -70,7 +128,14 @@
         });
       },
     },
-    { name: 'Name', render: function (pvc) { return pvc.name; } },
+    {
+      name: 'Name', render: function (pvc) {
+        return KF.el('a', {
+          'class': 'kf-link', text: pvc.name,
+          onclick: function () { showDetails(pvc.name); },
+        });
+      },
+    },
     { name: 'Size', render: function (pvc) { return pvc.size || ''; } },
     { name: 'Mode', render: function (pvc) { return pvc.mode || ''; } },
     { name: 'Class', render: function (pvc) { return pvc.class || 'default'; } },

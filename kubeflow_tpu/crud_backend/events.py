@@ -1,0 +1,35 @@
+"""Shared event filtering for details pages.
+
+One implementation of "events for resource X" used by every CRUD app
+(reference crud_backend/api/events.py + the per-app filters): exact
+name match on the resource's own kinds, plus events on derived workload
+objects — pods/replicasets carry generated suffixes (``<name>-0``,
+``<name>-6f9c8-xyz``), and those are exactly the events (ImagePullBackOff,
+FailedScheduling) a user opens the details drawer to find.
+"""
+
+from __future__ import annotations
+
+DERIVED_KINDS = ("Pod", "ReplicaSet", "StatefulSet", "Deployment")
+
+
+def list_events_for(
+    api,
+    namespace: str,
+    name: str,
+    kinds: tuple[str, ...] | set[str],
+    derived_kinds: tuple[str, ...] = DERIVED_KINDS,
+) -> list[dict]:
+    out = []
+    prefix = name + "-"
+    for ev in api.list("v1", "Event", namespace=namespace):
+        ref = ev.get("involvedObject") or {}
+        ref_kind = ref.get("kind")
+        ref_name = str(ref.get("name") or "")
+        if ref_kind in kinds and ref_name == name:
+            out.append(ev)
+        elif ref_kind in derived_kinds and (
+            ref_name == name or ref_name.startswith(prefix)
+        ):
+            out.append(ev)
+    return out

@@ -241,6 +241,24 @@
     ], rows, 'No events for this resource.');
   };
 
+  // ---- events pane (the Events details-tab body every app shares:
+  // refresh button + events table fed by a fetch function) ----
+  // fetchEvents: () -> Promise<event[]>.
+  KF.eventsPane = function (pane, fetchEvents) {
+    var box = KF.el('div', {});
+    function load() {
+      fetchEvents().then(function (events) {
+        KF.eventsTable(box, events);
+      }).catch(function (err) { KF.snack(err.message, true); });
+    }
+    pane.appendChild(KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
+      onclick: load,
+    }));
+    pane.appendChild(box);
+    load();
+  };
+
   // ---- logs viewer (reference lib/logs-viewer) ----
   // opts: {fetch: () -> Promise<string[]>, pollMs (0 = no polling),
   //        filename (download name)}.

@@ -238,3 +238,58 @@ class TestTensorboardsApp:
             data=json.dumps({"name": "tb1"}), headers=csrf(client),
         )
         assert resp.status_code == 400
+
+
+class TestDetailsEvents:
+    """Events endpoints behind the VWA/TWA details drawers."""
+
+    def seed_events(self, api, triples):
+        for name, kind in triples:
+            api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"generateName": "ev-", "namespace": "alice"},
+                "involvedObject": {"kind": kind, "name": name},
+                "reason": "R", "message": f"{kind}/{name}",
+                "type": "Normal",
+            })
+
+    def test_pvc_events_include_viewer_and_derived_pods(self):
+        api = FakeApiServer()
+        self.seed_events(api, [
+            ("data", "PersistentVolumeClaim"),
+            ("data", "PVCViewer"),
+            ("data-7f9c-xyz", "Pod"),     # viewer pod: included
+            ("unrelated", "Pod"),          # unrelated: excluded
+            ("other", "PersistentVolumeClaim"),  # wrong name: excluded
+            ("database", "PVCViewer"),     # prefix-similar but distinct
+        ])
+        app = create_vwa(api, authn=AuthnConfig(), authorizer=AllowAll(),
+                         secure_cookies=False)
+        client = app.test_client()
+        resp = client.get("/api/namespaces/alice/pvcs/data/events",
+                          headers={"kubeflow-userid": "u"})
+        assert resp.status_code == 200
+        got = sorted(e["message"] for e in resp.get_json()["events"])
+        assert got == ["PVCViewer/data", "PersistentVolumeClaim/data",
+                       "Pod/data-7f9c-xyz"]
+
+    def test_tensorboard_events_include_derived_workload(self):
+        """Pod-level ImagePullBackOff on the TB's deployment pods is
+        exactly what the drawer must surface (review r2)."""
+        api = FakeApiServer()
+        self.seed_events(api, [
+            ("tb1", "Tensorboard"),
+            ("tb1", "Deployment"),
+            ("tb1-6f9c8-xyz", "Pod"),     # derived pod: included
+            ("tb2", "Tensorboard"),        # other CR: excluded
+            ("tb2-1111-aaa", "Pod"),       # other CR pod: excluded
+        ])
+        app = create_twa(api, authn=AuthnConfig(), authorizer=AllowAll(),
+                         secure_cookies=False)
+        client = app.test_client()
+        resp = client.get("/api/namespaces/alice/tensorboards/tb1/events",
+                          headers={"kubeflow-userid": "u"})
+        assert resp.status_code == 200
+        got = sorted(e["message"] for e in resp.get_json()["events"])
+        assert got == ["Deployment/tb1", "Pod/tb1-6f9c8-xyz",
+                       "Tensorboard/tb1"]
