@@ -67,6 +67,9 @@ class KubeConfig:
     host: str  # e.g. "https://10.0.0.1:443"
     token: str | None = None
     token_file: str | None = None
+    # client-go exec credential plugin (kubeconfig user.exec) — run on
+    # demand and on expiry by ApiClient._auth_headers.
+    exec_spec: dict | None = None
     ca_file: str | None = None
     ca_data: str | None = None  # PEM
     client_cert_file: str | None = None
@@ -159,10 +162,18 @@ def load_kubeconfig(
 
     token = user.get("token")
     token_file = resolve(user.get("tokenFile"))
+    # client-go exec-credential plugins (how real GKE kubeconfigs
+    # authenticate: gke-gcloud-auth-plugin). Static credentials win,
+    # matching client-go precedence; the plugin runs lazily and
+    # re-runs on token expiry (ApiClient._auth_headers).
+    exec_spec = None
+    if not token and not token_file and user.get("exec"):
+        exec_spec = user["exec"]
     return KubeConfig(
         host=cluster["server"],
         token=token,
         token_file=token_file,
+        exec_spec=exec_spec,
         ca_file=ca_file,
         client_cert_file=cert_file,
         client_key_file=key_file,
@@ -171,6 +182,61 @@ def load_kubeconfig(
         user=user.get("username"),
         password=user.get("password"),
     )
+
+
+def _exec_credential_token(spec: dict) -> tuple[str, float | None]:
+    """Run a client-go credential plugin (kubeconfig user.exec) and
+    return (status.token, expiry epoch seconds or None)."""
+    import subprocess
+
+    command = [spec["command"], *spec.get("args", [])]
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        env[pair["name"]] = pair.get("value", "")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": spec.get(
+            "apiVersion", "client.authentication.k8s.io/v1"
+        ),
+        "kind": "ExecCredential",
+        "spec": {"interactive": False},
+    })
+    try:
+        proc = subprocess.run(
+            command, env=env, capture_output=True, timeout=60,
+            # interactive: false means it — a prompting plugin must
+            # fail fast, not eat the controller's stdin (client-go
+            # passes no stdin in non-interactive mode).
+            stdin=subprocess.DEVNULL,
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        raise ApiError(f"exec credential plugin failed: {exc}", 500)
+    if proc.returncode != 0:
+        raise ApiError(
+            "exec credential plugin "
+            f"{spec['command']!r} exited {proc.returncode}: "
+            f"{proc.stderr.decode(errors='replace')[:300]}", 500
+        )
+    try:
+        cred = json.loads(proc.stdout)
+        status = cred.get("status") or {}
+    except json.JSONDecodeError as exc:
+        raise ApiError(
+            f"exec credential plugin output is not JSON: {exc}", 500
+        )
+    token = status.get("token")
+    if not token:
+        raise ApiError(
+            "exec credential plugin returned no status.token (client "
+            "certificate credentials are not supported by this client)",
+            500,
+        )
+    expiry = None
+    stamp = status.get("expirationTimestamp")
+    if stamp:
+        from kubeflow_tpu.controllers.time_utils import parse_rfc3339
+
+        expiry = parse_rfc3339(stamp)
+    return token, expiry
 
 
 _TEMP_FILES: list[str] = []
@@ -217,6 +283,7 @@ class ApiClient:
         self._ssl_ctx = self._build_ssl_context() if self._tls else None
         self._token: str | None = config.token
         self._token_read_at = 0.0
+        self._token_expiry: float | None = None  # exec-plugin tokens
         self._local = threading.local()
         self._watches: list[_WatchState] = []
         self._closed = False
@@ -252,6 +319,18 @@ class ApiClient:
                     self._token_read_at = now
                 except OSError:
                     log.warning("token file %s unreadable", cfg.token_file)
+        elif cfg.exec_spec:
+            # Lazily run the credential plugin; re-run one minute before
+            # the reported expiry so a long-lived out-of-cluster
+            # controller never goes 401 mid-watch.
+            expired = (
+                self._token_expiry is not None
+                and time.time() > self._token_expiry - 60
+            )
+            if self._token is None or expired:
+                self._token, self._token_expiry = _exec_credential_token(
+                    cfg.exec_spec
+                )
         if self._token:
             return {"Authorization": f"Bearer {self._token}"}
         if cfg.user and cfg.password:

@@ -524,6 +524,82 @@ class TestConfigLoading:
         assert cfg.client_key_file == str(tmp_path / "client.key")
         assert cfg.verify is False
 
+    def exec_kubeconfig(self, tmp_path, plugin_body: str) -> str:
+        plugin = tmp_path / "fake-auth-plugin"
+        plugin.write_text(plugin_body)
+        plugin.chmod(0o755)
+        doc = {
+            "current-context": "gke",
+            "contexts": [{"name": "gke", "context": {
+                "cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": "https://1.2.3.4:443",
+                "insecure-skip-tls-verify": True}}],
+            "users": [{"name": "u", "user": {"exec": {
+                "apiVersion": "client.authentication.k8s.io/v1",
+                "command": str(plugin),
+                "args": [],
+            }}}],
+        }
+        (tmp_path / "config").write_text(json.dumps(doc))
+        return str(tmp_path / "config")
+
+    def test_kubeconfig_exec_credential_plugin(self, tmp_path):
+        """client-go exec plugins (the GKE gke-gcloud-auth-plugin path):
+        the plugin runs lazily, its token becomes the bearer token, and
+        it re-runs once the reported expiry approaches."""
+        counter = tmp_path / "calls"
+        path = self.exec_kubeconfig(tmp_path, (
+            "#!/bin/sh\n"
+            'test -n "$KUBERNETES_EXEC_INFO" || exit 3\n'
+            f'echo x >> {counter}\n'
+            'echo \'{"apiVersion":"client.authentication.k8s.io/v1",'
+            '"kind":"ExecCredential",'
+            '"status":{"token":"exec-tok-42",'
+            '"expirationTimestamp":"2099-01-01T00:00:00Z"}}\'\n'
+        ))
+        cfg = load_kubeconfig(path)
+        assert cfg.token is None and cfg.exec_spec  # lazy, not eager
+        client = ApiClient(cfg)
+        try:
+            assert client._auth_headers() == {
+                "Authorization": "Bearer exec-tok-42"
+            }
+            client._auth_headers()  # far-future expiry: no re-run
+            assert counter.read_text().count("x") == 1
+            # Force the expiry window: the plugin must re-run.
+            client._token_expiry = 0.0
+            client._auth_headers()
+            assert counter.read_text().count("x") == 2
+        finally:
+            client.close()
+
+    def test_kubeconfig_exec_plugin_failure_is_loud(self, tmp_path):
+        path = self.exec_kubeconfig(
+            tmp_path, "#!/bin/sh\necho nope >&2\nexit 7\n"
+        )
+        client = ApiClient(load_kubeconfig(path))
+        try:
+            with pytest.raises(ApiError) as err:
+                client._auth_headers()
+            assert "exited 7" in str(err.value)
+        finally:
+            client.close()
+
+    def test_exec_plugin_without_token_is_explicit(self, tmp_path):
+        path = self.exec_kubeconfig(tmp_path, (
+            "#!/bin/sh\n"
+            'echo \'{"kind":"ExecCredential",'
+            '"status":{"clientCertificateData":"PEM"}}\'\n'
+        ))
+        client = ApiClient(load_kubeconfig(path))
+        try:
+            with pytest.raises(ApiError) as err:
+                client._auth_headers()
+            assert "no status.token" in str(err.value)
+        finally:
+            client.close()
+
     def test_connect_from_env_fake(self, monkeypatch):
         monkeypatch.setenv("KFT_FAKE_API", "1")
         api = connect_from_env()
