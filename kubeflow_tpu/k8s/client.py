@@ -233,10 +233,31 @@ def _exec_credential_token(spec: dict) -> tuple[str, float | None]:
     expiry = None
     stamp = status.get("expirationTimestamp")
     if stamp:
-        from kubeflow_tpu.controllers.time_utils import parse_rfc3339
-
-        expiry = parse_rfc3339(stamp)
+        expiry = _parse_expiry(stamp)
+        if expiry is None:
+            # Unparseable must NOT mean "never refresh" (that trades a
+            # format quirk for guaranteed 401s once the real token
+            # expires): treat the token as short-lived instead.
+            log.warning(
+                "exec credential expirationTimestamp %r unparseable; "
+                "treating token as valid for 10 minutes", stamp
+            )
+            expiry = time.time() + 600
     return token, expiry
+
+
+def _parse_expiry(stamp: str) -> float | None:
+    """RFC3339 → epoch seconds; tolerant of 'Z', numeric offsets and
+    fractional seconds (plugins emit all three)."""
+    from datetime import datetime, timezone
+
+    try:
+        dt = datetime.fromisoformat(stamp.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
 
 
 _TEMP_FILES: list[str] = []
@@ -284,6 +305,9 @@ class ApiClient:
         self._token: str | None = config.token
         self._token_read_at = 0.0
         self._token_expiry: float | None = None  # exec-plugin tokens
+        # Watch threads and the request path refresh concurrently; the
+        # exec plugin must run once per expiry, not once per thread.
+        self._token_lock = threading.Lock()
         self._local = threading.local()
         self._watches: list[_WatchState] = []
         self._closed = False
@@ -322,15 +346,21 @@ class ApiClient:
         elif cfg.exec_spec:
             # Lazily run the credential plugin; re-run one minute before
             # the reported expiry so a long-lived out-of-cluster
-            # controller never goes 401 mid-watch.
-            expired = (
-                self._token_expiry is not None
-                and time.time() > self._token_expiry - 60
-            )
-            if self._token is None or expired:
-                self._token, self._token_expiry = _exec_credential_token(
-                    cfg.exec_spec
+            # controller never goes 401 mid-watch. Serialized: N watch
+            # threads crossing the window together must run ONE plugin
+            # invocation, not N (client-go does the same).
+            def stale() -> bool:
+                return self._token is None or (
+                    self._token_expiry is not None
+                    and time.time() > self._token_expiry - 60
                 )
+
+            if stale():
+                with self._token_lock:
+                    if stale():  # re-check under the lock
+                        self._token, self._token_expiry = (
+                            _exec_credential_token(cfg.exec_spec)
+                        )
         if self._token:
             return {"Authorization": f"Bearer {self._token}"}
         if cfg.user and cfg.password:

@@ -51,6 +51,23 @@ def serve_app(app):
 
 
 @pytest.fixture()
+def app_server():
+    """Serve RestApps for a test; shuts them down afterwards. (Specs
+    can't import conftest as a module, so server plumbing is exposed
+    as this fixture.)"""
+    servers = []
+
+    def run(app) -> str:
+        url, server = serve_app(app)
+        servers.append(server)
+        return url
+
+    yield run
+    for server in servers:
+        server.shutdown()
+
+
+@pytest.fixture()
 def seeded_jwa():
     """JWA + fixtures: one running TPU notebook with a pod, logs,
     events and conditions."""

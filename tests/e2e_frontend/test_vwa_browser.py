@@ -1,0 +1,63 @@
+"""VWA browser e2e: PVC list, details drawer (overview + events), and
+viewer launch — against the real backend + seeded fake apiserver."""
+
+from __future__ import annotations
+
+import pytest
+
+
+@pytest.fixture()
+def seeded_vwa(app_server):
+    from kubeflow_tpu.apps.volumes import create_app
+    from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+
+    api = FakeApiServer()
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "alice"}})
+    api.create({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "workspace", "namespace": "alice"},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "10Gi"}}},
+        "status": {"phase": "Bound"},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "alice"},
+        "involvedObject": {"kind": "PersistentVolumeClaim",
+                           "name": "workspace"},
+        "reason": "ProvisioningSucceeded",
+        "message": "volume bound to pv-123",
+        "type": "Normal", "count": 1,
+        "lastTimestamp": "2026-07-30T06:00:00Z",
+    })
+    app = create_app(api, authn=AuthnConfig(dev_mode=True),
+                     authorizer=AllowAll(), secure_cookies=False)
+    yield app_server(app), api
+
+
+def test_pvc_list_and_details_events(page, seeded_vwa):
+    url, _ = seeded_vwa
+    page.goto(url)
+    row = page.locator("#pvc-table tbody tr")
+    row.wait_for(timeout=10_000)
+    assert "workspace" in row.inner_text()
+    page.locator("a.kf-link", has_text="workspace").click()
+    page.locator(".kf-details").wait_for()
+    assert "10Gi" in page.locator(".kf-details").inner_text()
+    page.locator("button.kf-tab", has_text="Events").click()
+    pane = page.locator(".kf-tab-pane:not([hidden])")
+    pane.locator("table").wait_for()
+    assert "volume bound to pv-123" in pane.inner_text()
+
+
+def test_viewer_launch_creates_cr(page, seeded_vwa):
+    url, api = seeded_vwa
+    page.goto(url)
+    page.locator("button.kf-btn", has_text="Browse").click()
+    page.wait_for_function(
+        "document.body.textContent.includes('viewer starting')"
+    )
+    assert api.get("kubeflow.org/v1alpha1", "PVCViewer", "workspace",
+                   "alice")

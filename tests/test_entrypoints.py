@@ -291,3 +291,88 @@ class TestDispatcher:
         )
         assert proc.returncode != 0
         assert b"cannot reach apiserver" in proc.stdout + proc.stderr
+
+
+class TestMultihostOverTheWire:
+    """Flagship multi-host behaviors exercised against the controller
+    as a real OS process over the HTTP wire (not just in-process)."""
+
+    def test_multihost_spawn_and_gang_restart(self, apiserver):
+        metrics_port = free_port()
+        proc = spawn("notebook-controller", apiserver.url,
+                     {"METRICS_PORT": str(metrics_port)})
+        try:
+            wait_http(f"http://127.0.0.1:{metrics_port}/healthz")
+            apiserver.fake.create({
+                "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+                "metadata": {"name": "slice", "namespace": "alice"},
+                "spec": {
+                    "tpu": {"accelerator": "v5e", "topology": "4x4",
+                            "replicas": 4},
+                    "template": {"spec": {"containers": [{
+                        "name": "slice", "image": "img"}]}},
+                },
+            })
+            deadline = time.monotonic() + 20
+            sts = None
+            while time.monotonic() < deadline and sts is None:
+                try:
+                    sts = apiserver.fake.get("apps/v1", "StatefulSet",
+                                             "slice", "alice")
+                except NotFound:
+                    time.sleep(0.2)
+            assert sts is not None
+            assert sts["spec"]["replicas"] == 4
+            # Kubelet-side: the slice's 4 pods come up.
+            for i in range(4):
+                apiserver.fake.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"slice-{i}", "namespace": "alice",
+                                 "labels": {"notebook-name": "slice"}},
+                    "status": {"containerStatuses": [{"restartCount": 0}]},
+                })
+            # Wait for the FULL baseline (all four pods' counters at 0
+            # in the observed-restarts annotation) — a pod patched
+            # before its baseline is recorded would legitimately
+            # rebaseline instead of gang-restarting.
+            want = {f"slice-{i}": 0 for i in range(4)}
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                nb = apiserver.fake.get("kubeflow.org/v1beta1", "Notebook",
+                                        "slice", "alice")
+                ann = nb["metadata"].get("annotations") or {}
+                observed = ann.get(
+                    "notebooks.kubeflow-tpu.org/observed-restarts"
+                )
+                if observed and json.loads(observed) == want:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(
+                    f"full baseline never observed (last: {observed})"
+                )
+            # Rank 2 crashes alone -> the whole slice must recycle.
+            apiserver.fake.patch_merge(
+                "v1", "Pod", "slice-2",
+                {"status": {"containerStatuses": [{"restartCount": 1}]}},
+                "alice",
+            )
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                pods = apiserver.fake.list("v1", "Pod", namespace="alice")
+                if not pods:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(
+                    f"slice not recycled; pods: "
+                    f"{[p['metadata']['name'] for p in pods]}"
+                )
+            events = [
+                e for e in apiserver.fake.list("v1", "Event",
+                                               namespace="alice")
+                if e.get("reason") == "GangRestart"
+            ]
+            assert events and events[0]["type"] == "Warning"
+        finally:
+            terminate(proc)
