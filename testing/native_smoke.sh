@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Native-core smoke: drive every kft_invoke entry point through the CLI
+# with representative payloads (valid + malformed). Pure native — no
+# Python in the loop — so it runs unchanged under sanitizers:
+#
+#   make -C native CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra \
+#     -Werror -fsanitize=address,undefined -fno-sanitize-recover=all"
+#   testing/native_smoke.sh
+#
+# (CI: the sanitize job in native_build.yaml. SURVEY §5 notes the
+# reference runs no race detection/sanitizers at all; this tier is the
+# TPU build's answer for the C++ core.)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+KFT=./native/build/kft
+
+ok() {  # fn payload — must exit 0
+  echo "$2" | $KFT "$1" > /dev/null || {
+    echo "FAIL(ok) $1" >&2; exit 1; }
+}
+
+err() {  # fn payload — must exit nonzero (clean error, no crash)
+  if echo "$2" | $KFT "$1" > /dev/null 2>&1; then
+    echo "FAIL(err) $1 unexpectedly succeeded" >&2; exit 1
+  fi
+}
+
+NB='{"notebook":{"apiVersion":"kubeflow.org/v1beta1","kind":"Notebook","metadata":{"name":"nb","namespace":"ns","uid":"u1"},"spec":{"tpu":{"accelerator":"v5e","topology":"4x4","replicas":4},"template":{"spec":{"containers":[{"name":"nb","image":"img"}]}}}},"options":{}}'
+ok notebook_reconcile "$NB"
+err notebook_reconcile '{"notebook":{"metadata":{}}}'
+err notebook_reconcile '{"notebook":{"apiVersion":"kubeflow.org/v1beta1","kind":"Notebook","metadata":{"name":"nb","namespace":"ns"},"spec":{"tpu":{"accelerator":"bogus","topology":"4x4"}}}}'
+
+ok parse_tpu_slice '{"accelerator":"v5e","topology":"4x4"}'
+err parse_tpu_slice '{"accelerator":"v5e","topology":"4x4x9x9"}'
+
+ok cull_decide '{"notebook":{"metadata":{"name":"nb","namespace":"ns","annotations":{}}},"kernels":[{"execution_state":"idle","last_activity":"2026-01-01T00:00:00Z"}],"nowIso":"2026-07-30T00:00:00Z","options":{}}'
+err cull_decide '{"kernels":[]}'  # missing notebook
+
+ok poddefault_mutate '{"pod":{"metadata":{"name":"p","namespace":"ns","labels":{"tpu-env":"true"}},"spec":{"containers":[{"name":"c","image":"i"}]}},"poddefaults":[{"metadata":{"name":"pd","namespace":"ns"},"spec":{"selector":{"matchLabels":{"tpu-env":"true"}},"env":[{"name":"X","value":"1"}]}}]}'
+ok poddefault_mutate '{"pod":{"metadata":{"name":"p"},"spec":{"containers":[]}},"poddefaults":[]}'
+
+ok profile_reconcile '{"profile":{"apiVersion":"kubeflow.org/v1","kind":"Profile","metadata":{"name":"team","uid":"u2"},"spec":{"owner":{"kind":"User","name":"a@x.io"}}},"options":{}}'
+err profile_reconcile '{"profile":{"metadata":{}}}'
+
+ok kfam_binding '{"user":"bob@x.io","namespace":"team","role":"edit","userIdHeader":"kubeflow-userid","userIdPrefix":""}'
+err kfam_binding '{"user":"","namespace":"team"}'
+
+ok tensorboard_reconcile '{"tensorboard":{"apiVersion":"tensorboard.kubeflow.org/v1alpha1","kind":"Tensorboard","metadata":{"name":"tb","namespace":"ns","uid":"u3"},"spec":{"logspath":"pvc://logs/tb"}},"options":{}}'
+err tensorboard_reconcile '{"tensorboard":{"metadata":{"name":"tb","namespace":"ns"},"spec":{}}}'
+
+ok pvcviewer_reconcile '{"viewer":{"apiVersion":"kubeflow.org/v1alpha1","kind":"PVCViewer","metadata":{"name":"v","namespace":"ns","uid":"u4"},"spec":{"pvc":"data"}},"options":{}}'
+ok pvcviewer_admit '{"viewer":{"metadata":{"name":"v","namespace":"ns"},"spec":{"pvc":"data"}}}'
+ok pvcviewer_admit '{"viewer":{"metadata":{"generateName":"v-"},"spec":{"pvc":"data"}},"requestNamespace":"ns"}'
+# Admission rejections are expressed as result.errors (ok envelope):
+admit_rejects() {
+  out=$(echo "$1" | $KFT pvcviewer_admit)
+  echo "$out" | grep -q '"errors":\["' || {
+    echo "FAIL pvcviewer_admit accepted: $1" >&2; exit 1; }
+}
+admit_rejects '{"viewer":{"metadata":{"name":"v","namespace":"ns"},"spec":{}}}'
+admit_rejects '{"viewer":{"metadata":{"name":"v","namespace":"ns"},"spec":{"pvc":"d","networking":{"targetPort":"str"}}}}'
+admit_rejects '{"viewer":"not-an-object"}'
+
+ok copy_owned_fields '{"kind":"StatefulSet","existing":{"apiVersion":"apps/v1","kind":"StatefulSet","metadata":{"name":"s","namespace":"ns"},"spec":{"replicas":1}},"desired":{"apiVersion":"apps/v1","kind":"StatefulSet","metadata":{"name":"s","namespace":"ns"},"spec":{"replicas":4}}}'
+
+ok notebook_gang_restart '{"notebook":{"metadata":{"name":"nb","namespace":"ns","annotations":{}}},"pods":[{"metadata":{"name":"nb-0"},"status":{"containerStatuses":[{"restartCount":0}]}}]}'
+
+# Malformed envelopes must error cleanly, never crash.
+err notebook_reconcile 'not json at all'
+err notebook_reconcile '{"unterminated": "'
+err no_such_function '{}'
+
+echo "native smoke: all entry points OK"
