@@ -27,12 +27,23 @@ _LM_EXPORTS = (
     "make_lm_train_step",
 )
 
+# Pipeline-parallel LM (pp mesh axis, GPipe schedule) — same lazy rule.
+_PP_EXPORTS = (
+    "PipelinedLM",
+    "create_pp_lm_state",
+    "make_pp_lm_train_step",
+)
+
 
 def __getattr__(name):
     if name in _LM_EXPORTS:
         from kubeflow_tpu.models import transformer
 
         return getattr(transformer, name)
+    if name in _PP_EXPORTS:
+        from kubeflow_tpu.models import pipeline_lm
+
+        return getattr(pipeline_lm, name)
     if name in _CKPT_EXPORTS:
         from kubeflow_tpu.models import checkpoint
 
@@ -53,6 +64,9 @@ __all__ = [
     "build_lm",
     "create_lm_state",
     "make_lm_train_step",
+    "PipelinedLM",
+    "create_pp_lm_state",
+    "make_pp_lm_train_step",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

@@ -1,0 +1,235 @@
+"""Pipeline-parallel transformer LM over the ``pp`` mesh axis.
+
+The homogeneous-middle layout: embedding and the tied head run as
+ordinary global-array pjit code (replicated over pp, sharded over
+whatever the other axes say), while the block stack — where the depth
+lives — is stacked on a leading layer dim, split into ``pp`` contiguous
+stages, and driven by the GPipe schedule
+(:mod:`kubeflow_tpu.parallel.pipeline`). Manual communication exists
+only for pp (ppermute); dp/fsdp/tp stay automatic, so a
+``MeshSpec(dp=2, pp=4)`` step shards the batch over dp AND pipelines
+over pp with no interaction between the two in this file.
+
+Composition limits: the pipelined blocks use the single-chip attention
+cores (XLA reference or Pallas flash) — ring attention's own shard_map
+over sp does not nest inside the pp-manual region, so sp must be 1 on
+a pipelined mesh (enforced in :func:`build_pp_lm`).
+
+No reference counterpart: the reference platform ships no parallelism
+code at all (SURVEY.md §2.3); this is part of the first-class
+distributed backend of the TPU build.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from kubeflow_tpu.models.train import TrainState
+from kubeflow_tpu.models.transformer import (
+    Block,
+    LMConfig,
+    RMSNorm,
+    lm_loss,
+)
+from kubeflow_tpu.ops import flash_attention
+from kubeflow_tpu.parallel import batch_sharding, param_sharding
+from kubeflow_tpu.parallel.mesh import path_key
+from kubeflow_tpu.parallel.pipeline import gpipe, stage_stack
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedLM:
+    """The pipelined model: pure init/apply over a params pytree of
+    ``{"embed", "blocks", "final_norm"}`` where every ``blocks`` leaf is
+    depth-stacked ``(layers, ...)``."""
+
+    cfg: LMConfig
+    mesh: Mesh
+    num_microbatches: int
+    remat: bool = False
+
+    def __post_init__(self):
+        cfg, mesh = self.cfg, self.mesh
+        if mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "pipeline parallelism composes with dp/fsdp/tp, not sp: "
+                "ring attention is its own shard_map and cannot nest "
+                "inside the pp-manual region"
+            )
+        if cfg.layers % mesh.shape["pp"]:
+            raise ValueError(
+                f"layers={cfg.layers} not divisible by "
+                f"pp={mesh.shape['pp']} stages"
+            )
+        if cfg.moe_experts:
+            raise ValueError(
+                "MoE blocks are not pipelined (sow'd aux losses do not "
+                "cross the gpipe boundary); use ep on a non-pp mesh"
+            )
+
+    @property
+    def _embed(self) -> nn.Embed:
+        return nn.Embed(
+            self.cfg.vocab, self.cfg.dim, dtype=self.cfg.dtype, name="embed"
+        )
+
+    @property
+    def _block(self) -> Block:
+        attn = None
+        if jax.default_backend() == "tpu":
+            attn = lambda q, k, v, causal=True: flash_attention(
+                q, k, v, causal=causal
+            )
+        return Block(self.cfg, attn_impl=attn)
+
+    def init(self, rng: jax.Array) -> dict[str, Any]:
+        cfg = self.cfg
+        r_emb, r_blk, r_norm = jax.random.split(rng, 3)
+        dummy_tokens = jnp.zeros((1, 1), jnp.int32)
+        dummy_x = jnp.zeros((1, 8, cfg.dim), cfg.dtype)
+        block = self._block
+        return {
+            "embed": self._embed.init(r_emb, dummy_tokens)["params"],
+            # Depth-stacked block params: vmap'd init over per-layer keys
+            # gives every leaf a leading (layers,) dim — the dim gpipe
+            # stages shard over pp.
+            "blocks": jax.vmap(
+                lambda k: block.init(k, dummy_x)["params"]
+            )(jax.random.split(r_blk, cfg.layers)),
+            "final_norm": RMSNorm().init(r_norm, dummy_x)["params"],
+        }
+
+    def apply(self, variables, tokens: jax.Array) -> jax.Array:
+        """tokens (B, S) int32 -> logits (B, S, vocab) f32. B must be
+        divisible by num_microbatches (times the dp shard count for an
+        even per-device split, as with any dp batch)."""
+        params = variables["params"]
+        cfg, mesh = self.cfg, self.mesh
+        block = self._block
+        embed = self._embed
+
+        x = embed.apply({"params": params["embed"]}, tokens)
+
+        def stage_fn(stage_params, h):
+            # One stage = lax.scan over its layers/pp consecutive blocks.
+            def layer(h, layer_params):
+                return block.apply({"params": layer_params}, h), None
+
+            h, _ = jax.lax.scan(layer, h, stage_params)
+            return h
+
+        run = gpipe(
+            stage_fn,
+            mesh,
+            num_microbatches=self.num_microbatches,
+            remat=self.remat,
+        )
+        x = run(stage_stack(params["blocks"], mesh.shape["pp"]), x)
+        x = RMSNorm().apply({"params": params["final_norm"]}, x)
+        # Tied head: attend against the embedding table in f32.
+        return embed.apply(
+            {"params": params["embed"]},
+            x.astype(jnp.float32),
+            method="attend",
+        )
+
+    def sequential_apply(self, variables, tokens: jax.Array) -> jax.Array:
+        """The same computation with a plain sequential layer loop and no
+        pipeline communication — the numerical reference the gpipe path
+        must match (used by tests; also the single-chip fallback)."""
+        params = variables["params"]
+        block, embed = self._block, self._embed
+        x = embed.apply({"params": params["embed"]}, tokens)
+
+        def layer(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        x, _ = jax.lax.scan(layer, x, params["blocks"])
+        x = RMSNorm().apply({"params": params["final_norm"]}, x)
+        return embed.apply(
+            {"params": params["embed"]},
+            x.astype(jnp.float32),
+            method="attend",
+        )
+
+
+def pp_param_sharding(mesh: Mesh, path: tuple, leaf):
+    """Sharding rule for the pipelined state: depth-stacked ``blocks``
+    leaves put their leading (stage) dim on pp, keep the LM's Megatron
+    tp layout on the stack-shifted kernel dim, and take fsdp on the
+    largest remaining dim — all via the canonical rule's ``stage_axis``
+    mode (one source of truth, parallel/mesh.py). Non-stacked leaves
+    follow the plain canonical rule (pp inert, exactly like dp). tp and
+    fsdp stay *automatic* axes — XLA reads these shardings and inserts
+    the same collectives as in the non-pipelined LM."""
+    from kubeflow_tpu.models.transformer import LM_TP_RULES
+
+    in_blocks = any(path_key(p) == "blocks" for p in path)
+    return param_sharding(
+        mesh, path, leaf,
+        tp_rules=LM_TP_RULES if in_blocks else None,
+        stage_axis="pp" if in_blocks else None,
+    )
+
+
+def create_pp_lm_state(
+    model: PipelinedLM,
+    rng: jax.Array,
+    tx: optax.GradientTransformation | None = None,
+) -> TrainState:
+    """TrainState for the pipelined LM, born sharded: blocks leaves land
+    (pp, fsdp)-sharded out of the jitted init."""
+    tx = tx or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_fn(rng):
+        params = model.init(rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=tx.init(params),
+            tx=tx,
+            apply_fn=model.apply,
+        )
+
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: pp_param_sharding(model.mesh, path, leaf),
+        abstract,
+    )
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_pp_lm_train_step(model: PipelinedLM):
+    """Jitted pipelined train step; batch = {"tokens": (B, S) int32}.
+    The batch shards over (dp, fsdp) exactly like the non-pipelined LM
+    step — pp only touches the block stack inside apply."""
+    token_sh = batch_sharding(model.mesh)
+
+    def step(state: TrainState, batch):
+        tokens = jax.lax.with_sharding_constraint(batch["tokens"], token_sh)
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, tokens)
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_state = dataclasses.replace(
+            state,
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(step, donate_argnums=0)

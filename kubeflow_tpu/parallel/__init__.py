@@ -20,6 +20,7 @@ from kubeflow_tpu.parallel.distributed import (
     initialize_from_env,
     slice_env_for_rank,
 )
+from kubeflow_tpu.parallel.pipeline import gpipe, pipeline_ticks, stage_stack
 
 __all__ = [
     "MeshSpec",
@@ -29,6 +30,9 @@ __all__ = [
     "batch_sharding",
     "replicated",
     "param_sharding",
+    "gpipe",
+    "pipeline_ticks",
+    "stage_stack",
     "DistributedEnv",
     "initialize_from_env",
     "slice_env_for_rank",

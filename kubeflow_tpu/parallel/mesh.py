@@ -5,6 +5,9 @@ notebook, user code builds a mesh over all chips of the slice. Axis names
 are fixed platform-wide so models, optimizers, and checkpoints agree:
 
 - ``"dp"``   — data parallel (batch dimension; gradients all-reduced)
+- ``"pp"``   — pipeline parallel (layer stages; point-to-point ppermute
+               circulation — tolerates the slowest links, so it sits
+               next to dp on the outer/coarser interconnect)
 - ``"fsdp"`` — fully-sharded data parallel (params/opt-state sharded,
                all-gathered just-in-time; rides ICI)
 - ``"tp"``   — tensor parallel (hidden/heads dimension)
@@ -26,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp", "ep")
+AXES = ("dp", "pp", "fsdp", "tp", "sp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,32 +41,33 @@ class MeshSpec:
     """
 
     dp: int = -1
+    pp: int = 1
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
     ep: int = 1
 
     def resolve(self, n_devices: int) -> "MeshSpec":
-        fixed = self.fsdp * self.tp * self.sp * self.ep
+        fixed = self.pp * self.fsdp * self.tp * self.sp * self.ep
         dp = self.dp
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"fsdp*tp*sp*ep={fixed}"
+                    f"pp*fsdp*tp*sp*ep={fixed}"
                 )
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.ep} "
-                f"!= {n_devices} devices"
+                f"mesh {dp}x{self.pp}x{self.fsdp}x{self.tp}x{self.sp}"
+                f"x{self.ep} != {n_devices} devices"
             )
-        return MeshSpec(dp=dp, fsdp=self.fsdp, tp=self.tp, sp=self.sp,
-                        ep=self.ep)
+        return MeshSpec(dp=dp, pp=self.pp, fsdp=self.fsdp, tp=self.tp,
+                        sp=self.sp, ep=self.ep)
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp, self.ep)
+        return (self.dp, self.pp, self.fsdp, self.tp, self.sp, self.ep)
 
 
 def make_mesh(
@@ -181,6 +185,7 @@ def param_sharding(
     path: tuple,
     leaf: jax.ShapeDtypeStruct,
     tp_rules: dict | None = None,
+    stage_axis: str | None = None,
 ):
     """Canonical parameter sharding: shard the largest dim that divides
     evenly over ``fsdp`` (zero-redundancy style); replicate small leaves.
@@ -189,7 +194,31 @@ def param_sharding(
     a model passes ``tp_rules`` ({module name -> kernel dim}) to place
     its projection kernels on the tp axis (the LM's Megatron layout);
     without rules the tp axis replicates params.
+
+    ``stage_axis`` marks a depth-stacked leaf (pipeline stages): dim 0
+    goes on that axis, tp_rules apply at the stack-shifted kernel dim,
+    and fsdp takes the largest remaining dim — the single source of
+    truth for pipelined layouts too (models/pipeline_lm.py).
     """
+    if stage_axis is not None and getattr(leaf, "shape", ()):
+        spec: list = [None] * len(leaf.shape)
+        if leaf.shape[0] % mesh.shape[stage_axis] == 0:
+            spec[0] = stage_axis
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1:
+            tp_dim = _tp_kernel_dim(path, tp_rules)
+            # +1: the stage stack prepends the depth dim to the kernel.
+            if tp_dim is not None and leaf.shape[tp_dim + 1] % tp == 0:
+                spec[tp_dim + 1] = "tp"
+        fsdp_n = mesh.shape["fsdp"]
+        if fsdp_n > 1:
+            for d in sorted(
+                range(1, len(leaf.shape)), key=lambda d: -leaf.shape[d]
+            ):
+                if spec[d] is None and leaf.shape[d] % fsdp_n == 0:
+                    spec[d] = "fsdp"
+                    break
+        return NamedSharding(mesh, P(*spec))
     # MoE expert stacks shard their leading (expert) dim over ep — the
     # dispatch einsums then lower to all-to-alls over that axis. The
     # remaining dims still get fsdp (expert weights are the largest

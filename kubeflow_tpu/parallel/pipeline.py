@@ -1,0 +1,157 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+
+TPU-native pipelining, per the scaling-book recipe: the layer stack is
+split into P identical stages whose parameters shard over the mesh's
+``pp`` axis; activations circulate stage-to-stage with
+``jax.lax.ppermute`` (point-to-point, so pp tolerates the coarsest
+interconnect — it is laid out next to dp, and on a multislice mesh
+never crosses DCN). The schedule runs inside ``jax.shard_map`` manual
+ONLY over ``pp`` (``axis_names={"pp"}``): every other mesh axis (dp,
+fsdp, tp, sp, ep) stays automatic, so batch sharding and Megatron tp
+compose with pipelining without any code here knowing about them.
+
+The reference platform has no pipeline/parallelism layer at all
+(SURVEY.md §2.3: replicas hardcoded to 1, no collective backend); this
+module is part of the first-class distributed backend the TPU build
+adds on top of the injected ``jax.distributed`` world.
+
+Schedule: plain GPipe. M microbatches flow through P stages in
+M + P - 1 ticks; each tick every stage runs once (the first/last P-1
+ticks carry bubbles). The backward schedule is whatever autodiff makes
+of the forward scan — correct, with the standard GPipe bubble fraction
+(P-1)/(M+P-1); raise ``num_microbatches`` to amortise. ``remat=True``
+wraps the stage in ``jax.checkpoint`` so live activation memory is one
+microbatch per tick instead of the whole scan history.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# stage_fn(stage_params, x) -> y with y.shape == x.shape: one pipeline
+# stage (e.g. a lax.scan over its slice of the layer stack).
+StageFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
+    """Ticks for one GPipe pass: M + P - 1."""
+    return num_microbatches + num_stages - 1
+
+
+def gpipe(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+    remat: bool = False,
+):
+    """Wrap ``stage_fn`` into a pipelined pass over the full layer stack.
+
+    Returns ``run(stage_params, x) -> y``:
+
+    - ``stage_params``: pytree whose every leaf is stacked on a leading
+      stage dim of size P = mesh.shape[axis] (leaf shape ``(P, ...)``).
+      The leading dim shards over ``axis``; each device sees only its
+      stage's slice.
+    - ``x``: activations ``(B, ...)`` with B divisible by
+      ``num_microbatches``. Batch may additionally be dp-sharded — dp
+      stays an automatic axis and composes transparently.
+    - ``y``: ``(B, ...)``, the stack's output, replicated over ``axis``
+      (an explicit masked-psum broadcast from the last stage).
+
+    Differentiable end-to-end: ppermute/psum have exact transposes, so
+    ``jax.grad`` through the returned function yields the GPipe backward
+    pass with cotangents flowing stage-to-stage in reverse.
+    """
+    num_stages = mesh.shape[axis]
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=frozenset({axis}),
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run_sharded(stage_params, xm):
+        # Per-device view: leading stage dim is now 1 — this device's
+        # stage. (M, mb, ...) microbatches are replicated over pp.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        n_mb = xm.shape[0]
+        # Open chain, not a ring: the last stage's output would only be
+        # discarded by stage 0, so the wrap-around edge is omitted and
+        # ppermute delivers zeros there — one less (mb, ...) transfer
+        # per tick on the coarsest links.
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # Shift every stage's last output one stage forward; stage 0
+            # feeds microbatch t instead (clipped re-feeds past the end
+            # are bubbles that never get written out).
+            recv = jax.lax.ppermute(state, axis, perm)
+            x_t = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False
+            )
+            out = stage_fn(params, jnp.where(idx == 0, x_t, recv))
+            # The last stage finishes microbatch t-(P-1) at tick t.
+            w = t - (num_stages - 1)
+            w_clip = jnp.clip(w, 0, n_mb - 1)
+            keep = jax.lax.dynamic_index_in_dim(
+                outbuf, w_clip, 0, keepdims=False
+            )
+            write = jnp.logical_and(idx == num_stages - 1, w >= 0)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(write, out, keep), w_clip, 0
+            )
+            return (out, outbuf), None
+
+        init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+        ticks = jnp.arange(pipeline_ticks(n_mb, num_stages))
+        (_, outbuf), _ = jax.lax.scan(tick, init, ticks)
+        # Broadcast the last stage's buffer to every stage (masked psum:
+        # all other stages contribute zeros).
+        return jax.lax.psum(
+            jnp.where(idx == num_stages - 1, outbuf, jnp.zeros_like(outbuf)),
+            axis,
+        )
+
+    def run(stage_params, x):
+        if x.shape[0] % num_microbatches:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        xm = x.reshape(
+            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
+        )
+        ym = run_sharded(stage_params, xm)
+        return ym.reshape(x.shape[0], *ym.shape[2:])
+
+    return run
+
+
+def stage_stack(params, num_stages: int):
+    """Reshape a depth-stacked layer pytree ``(L, ...)`` into the stage
+    layout ``(P, L/P, ...)`` gpipe shards: contiguous groups of L/P
+    consecutive layers per stage (row-major reshape = stage order)."""
+
+    def reshape(leaf):
+        depth = leaf.shape[0]
+        if depth % num_stages:
+            raise ValueError(
+                f"layer stack depth {depth} not divisible by "
+                f"pp={num_stages} stages"
+            )
+        return leaf.reshape(num_stages, depth // num_stages, *leaf.shape[1:])
+
+    return jax.tree.map(reshape, params)

@@ -21,7 +21,7 @@ def test_eight_virtual_devices():
 
 def test_mesh_spec_resolve_auto_dp():
     spec = MeshSpec(dp=-1, fsdp=2, tp=2).resolve(8)
-    assert spec.shape == (2, 2, 2, 1, 1)
+    assert spec.shape == (2, 1, 2, 2, 1, 1)
 
 
 def test_mesh_spec_mismatch_raises():
@@ -31,8 +31,10 @@ def test_mesh_spec_mismatch_raises():
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
-    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp", "ep")
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "ep": 1}
+    assert mesh.axis_names == ("dp", "pp", "fsdp", "tp", "sp", "ep")
+    assert mesh.shape == {
+        "dp": 2, "pp": 1, "fsdp": 2, "tp": 2, "sp": 1, "ep": 1
+    }
 
 
 def test_batch_sharding_shards_leading_dim():
@@ -72,7 +74,7 @@ class TestMultisliceMesh:
         mesh = make_multislice_mesh(
             MeshSpec(dp=4, fsdp=2), num_slices=2
         )
-        assert mesh.shape == {"dp": 4, "fsdp": 2, "tp": 1, "sp": 1, "ep": 1}
+        assert mesh.shape == {"dp": 4, "pp": 1, "fsdp": 2, "tp": 1, "sp": 1, "ep": 1}
         # dp rows 0-1 must be slice 0's devices (ids 0-3), rows 2-3
         # slice 1's (ids 4-7): contiguous chunks stand in for
         # slice_index on the CPU test platform.
