@@ -37,6 +37,7 @@ from kubeflow_tpu.models.transformer import (
     LMConfig,
     RMSNorm,
     lm_loss,
+    tied_head,
 )
 from kubeflow_tpu.ops import flash_attention
 from kubeflow_tpu.parallel import batch_sharding, param_sharding
@@ -89,6 +90,9 @@ class PipelinedLM:
             )
         return Block(self.cfg, attn_impl=attn)
 
+    def _head(self, params, x: jax.Array) -> jax.Array:
+        return tied_head(x, params["embed"]["embedding"], self.cfg.dtype)
+
     def init(self, rng: jax.Array) -> dict[str, Any]:
         cfg = self.cfg
         r_emb, r_blk, r_norm = jax.random.split(rng, 3)
@@ -133,12 +137,7 @@ class PipelinedLM:
         )
         x = run(stage_stack(params["blocks"], mesh.shape["pp"]), x)
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
-        # Tied head: attend against the embedding table in f32.
-        return embed.apply(
-            {"params": params["embed"]},
-            x.astype(jnp.float32),
-            method="attend",
-        )
+        return self._head(params, x)
 
     def sequential_apply(self, variables, tokens: jax.Array) -> jax.Array:
         """The same computation with a plain sequential layer loop and no
@@ -153,11 +152,7 @@ class PipelinedLM:
 
         x, _ = jax.lax.scan(layer, x, params["blocks"])
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
-        return embed.apply(
-            {"params": params["embed"]},
-            x.astype(jnp.float32),
-            method="attend",
-        )
+        return self._head(params, x)
 
 
 def pp_param_sharding(mesh: Mesh, path: tuple, leaf):

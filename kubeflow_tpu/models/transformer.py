@@ -204,7 +204,20 @@ class TransformerLM(nn.Module):
             x = Block(cfg, attn_impl=self.attn_impl, use_moe=use_moe,
                       name=f"block_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
-        return emb.attend(x.astype(jnp.float32))
+        return tied_head(x, emb.embedding, cfg.dtype)
+
+
+def tied_head(x: jax.Array, embedding: jax.Array, dtype) -> jax.Array:
+    """Logits against the tied embedding table, operands in the model
+    dtype with f32 ACCUMULATION — not an f32 cast first: f32 operands
+    would force the D x vocab matmul (the model's largest) onto the
+    ~8x-slower f32 MXU path. Logits come out f32 for the loss. Shared
+    by TransformerLM and PipelinedLM so the head cannot drift between
+    the pipelined model and its numerical reference."""
+    return jnp.einsum(
+        "bsd,vd->bsv", x.astype(dtype), embedding.astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def build_lm(
