@@ -140,3 +140,39 @@ class TestCheckpoint:
         save_checkpoint(tmp_path / "run", trained_state, step=250)
         assert latest_step(tmp_path / "run") == 250
         assert latest_step(tmp_path / "missing") is None
+
+
+class TestPipelinedCheckpoint:
+    def test_pp_state_roundtrip_preserves_stage_sharding(self, tmp_path):
+        """A pipelined state saved from a dp x pp mesh restores with its
+        pp stage sharding intact (restore reuses the template's actual
+        shardings) and steps immediately."""
+        from kubeflow_tpu.models import LMConfig
+        from kubeflow_tpu.models.pipeline_lm import (
+            PipelinedLM,
+            create_pp_lm_state,
+            make_pp_lm_train_step,
+        )
+
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        model = PipelinedLM(
+            LMConfig(vocab=64, layers=4, dim=32, heads=2),
+            mesh, num_microbatches=2,
+        )
+        state = create_pp_lm_state(model, jax.random.key(0))
+        step = make_pp_lm_train_step(model)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, size=(4, 16)),
+            jnp.int32,
+        )
+        state, _ = step(state, {"tokens": tokens})
+        save_checkpoint(tmp_path / "ckpt", state)
+
+        like = create_pp_lm_state(model, jax.random.key(1))
+        restored = restore_checkpoint(tmp_path / "ckpt", like, mesh=mesh)
+        assert int(jax.device_get(restored.step)) == 1
+        spec = restored.params["blocks"]["q_proj"]["kernel"].sharding.spec
+        assert spec[0] == "pp"
+        assert tree_equal(restored.params, state.params)
+        restored, metrics = step(restored, {"tokens": tokens})
+        assert np.isfinite(float(metrics["loss"]))
