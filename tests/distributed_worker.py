@@ -87,6 +87,37 @@ def main():
     assert np.isfinite(loss)
     assert int(jax.device_get(state.step)) == 1
     print(f"STEP {jax.process_index()} loss={loss:.6f}", flush=True)
+
+    # ---- collective #3: pipeline parallelism ACROSS the process
+    # boundary — pp is the second mesh axis, so with dp=1 the two
+    # stages land on different processes and the GPipe ppermute
+    # circulation rides the inter-process transport (the CPU stand-in
+    # for DCN/ICI). ----------------------------------------------------
+    from kubeflow_tpu.models import (
+        PipelinedLM,
+        create_pp_lm_state,
+        make_pp_lm_train_step,
+    )
+
+    pp_mesh = make_mesh(
+        MeshSpec(dp=1, pp=2, fsdp=world // 2), jax.devices()
+    )
+    pp_model = PipelinedLM(
+        LMConfig(vocab=64, layers=2, dim=32, heads=2),
+        pp_mesh, num_microbatches=2,
+    )
+    pp_state = create_pp_lm_state(pp_model, jax.random.key(1))
+    stage_spec = jax.tree.leaves(pp_state.params["blocks"])[0].sharding.spec
+    assert stage_spec[0] == "pp", stage_spec
+    pp_step = make_pp_lm_train_step(pp_model)
+    pp_tokens = make_global(
+        rng.integers(0, 64, size=(4, 16)).astype(np.int32),
+        P(("dp", "fsdp")),
+    )
+    pp_state, pp_metrics = pp_step(pp_state, {"tokens": pp_tokens})
+    pp_loss = float(jax.device_get(pp_metrics["loss"]))
+    assert np.isfinite(pp_loss)
+    print(f"PPSTEP {jax.process_index()} loss={pp_loss:.6f}", flush=True)
     print(f"DONE {jax.process_index()}", flush=True)
 
 

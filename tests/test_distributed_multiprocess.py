@@ -82,9 +82,21 @@ def test_controller_injected_env_forms_a_jax_world():
     # The sharded train step computed the SAME loss on both ranks
     # (replicated output of one global computation — the proof this was
     # one world, not two isolated runs).
-    losses = set()
-    for out in outs:
-        for line in out.splitlines():
-            if line.startswith("STEP"):
-                losses.add(line.split("loss=")[1])
+    def unique_losses(prefix: str) -> set[str]:
+        return {
+            line.split("loss=")[1]
+            for out in outs
+            for line in out.splitlines()
+            if line.startswith(prefix + " ")
+        }
+
+    losses = unique_losses("STEP")
     assert len(losses) == 1, f"ranks computed different losses: {losses}"
+
+    # Same for the pipelined step, whose pp stages live on DIFFERENT
+    # processes (dp=1, pp=2 over 2 procs): the GPipe ppermute circulation
+    # crossed the process boundary and still produced one global loss.
+    pp_losses = unique_losses("PPSTEP")
+    assert len(pp_losses) == 1, (
+        f"ranks computed different pipelined losses: {pp_losses}"
+    )
