@@ -1,0 +1,119 @@
+"""Central-dashboard browser e2e: home view (fleet cards, activities),
+namespace selector, and contributor management through the KFAM proxy —
+against the real backend + seeded fake apiserver (role of the
+reference's centraldashboard Karma/Cypress suites)."""
+
+from __future__ import annotations
+
+import pytest
+
+USER = "dev@local"  # AuthnConfig dev_mode identity the browser gets
+
+
+@pytest.fixture()
+def seeded_dashboard(app_server):
+    from kubeflow_tpu.crud_backend import AuthnConfig
+    from kubeflow_tpu.dashboard import KfamProxy, create_app
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+    from kubeflow_tpu.kfam import create_app as create_kfam
+
+    api = FakeApiServer()
+    api.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": "team-alpha"},
+        "spec": {"owner": {"kind": "User", "name": USER}},
+    })
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "team-alpha"}})
+    # A TPU node + a pod requesting chips: the fleet cards' source data.
+    api.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {
+            "name": "tpu-node-0",
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            },
+        },
+        "status": {"allocatable": {"google.com/tpu": "4"}},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "team-alpha"},
+        "spec": {"nodeName": "tpu-node-0", "containers": [{
+            "name": "nb",
+            "resources": {"limits": {"google.com/tpu": "4"}},
+        }]},
+        "status": {"phase": "Running"},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "team-alpha"},
+        "involvedObject": {"kind": "Notebook", "name": "nb"},
+        "reason": "Created",
+        "message": "StatefulSet nb created",
+        "type": "Normal", "count": 1,
+        "lastTimestamp": "2026-07-30T06:01:00Z",
+    })
+    kfam_app = create_kfam(api, secure_cookies=False)
+    app = create_app(
+        api, kfam=KfamProxy(kfam_app),
+        authn=AuthnConfig(dev_mode=True), secure_cookies=False,
+    )
+    yield app_server(app), api
+
+
+def test_home_fleet_activities_and_user(page, seeded_dashboard):
+    url, _ = seeded_dashboard
+    page.goto(url)
+    # Namespace selector resolves the user's profile namespace.
+    page.wait_for_function(
+        "document.getElementById('ns-select').options.length > 0"
+    )
+    assert page.locator("#ns-select").input_value() == "team-alpha"
+    assert USER in page.locator("#user-chip").inner_text()
+    # Fleet cards computed from Node allocatable vs Pod requests.
+    card = page.locator("#fleet-cards .card").first
+    card.wait_for(timeout=10_000)
+    assert "tpu-v5-lite-podslice" in card.inner_text()
+    # Activities list mirrors the namespace's events.
+    page.wait_for_function(
+        "document.getElementById('activities').textContent"
+        ".includes('StatefulSet nb created')"
+    )
+
+
+def test_contributor_add_and_remove(page, seeded_dashboard):
+    url, api = seeded_dashboard
+    page.goto(url)
+    page.wait_for_function(
+        "document.getElementById('ns-select').options.length > 0"
+    )
+    page.locator("#contrib-email").fill("bob@example.org")
+    page.locator("#contrib-add").click()
+    page.wait_for_function(
+        "document.getElementById('contributors').textContent"
+        ".includes('bob@example.org')"
+    )
+    def bob_bindings():
+        return [
+            rb for rb in api.list(
+                "rbac.authorization.k8s.io/v1", "RoleBinding",
+                namespace="team-alpha",
+            )
+            if (rb["metadata"].get("annotations") or {}).get("user")
+            == "bob@example.org"
+        ]
+
+    # The KFAM proxy materialised the binding in the cluster.
+    assert bob_bindings(), "contributor RoleBinding not created"
+
+    # Remove through the UI: the binding must disappear again.
+    page.locator(
+        "li.contributor", has_text="bob@example.org"
+    ).locator("button").click()
+    page.wait_for_function(
+        "!document.getElementById('contributors').textContent"
+        ".includes('bob@example.org')"
+    )
+    assert not bob_bindings(), "contributor RoleBinding not removed"
