@@ -98,7 +98,12 @@ class Manager:
             self.elector = LeaderElector(
                 api,
                 lease_name,
-                identity or f"manager-{uuid.uuid4().hex[:8]}",
+                # Downward-API convention: with POD_NAME injected (the
+                # controller deployments do), the lease holder is the
+                # pod name — legible in kubectl. Applies to EVERY
+                # manager, not just the notebook controller.
+                identity or os.environ.get("POD_NAME")
+                or f"manager-{uuid.uuid4().hex[:8]}",
                 namespace=lease_namespace,
                 on_started_leading=self._start_controllers,
                 on_stopped_leading=self._stop_controllers,
