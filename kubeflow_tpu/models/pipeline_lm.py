@@ -39,7 +39,7 @@ from kubeflow_tpu.models.transformer import (
     lm_loss,
     tied_head,
 )
-from kubeflow_tpu.ops import flash_attention
+from kubeflow_tpu.ops import flash_attention, mha_reference
 from kubeflow_tpu.parallel import batch_sharding, param_sharding
 from kubeflow_tpu.parallel.mesh import path_key
 from kubeflow_tpu.parallel.pipeline import gpipe, stage_stack
@@ -83,12 +83,19 @@ class PipelinedLM:
 
     @property
     def _block(self) -> Block:
+        cfg = self.cfg
         attn = None
         if jax.default_backend() == "tpu":
             attn = lambda q, k, v, causal=True: flash_attention(
-                q, k, v, causal=causal
+                q, k, v, causal=causal, window=cfg.attn_window
             )
-        return Block(self.cfg, attn_impl=attn)
+        elif cfg.attn_window is not None:
+            # Off-TPU the Block default is plain mha_reference, which
+            # would silently drop the window — pass it explicitly.
+            attn = lambda q, k, v, causal=True: mha_reference(
+                q, k, v, causal=causal, window=cfg.attn_window
+            )
+        return Block(cfg, attn_impl=attn)
 
     def _head(self, params, x: jax.Array) -> jax.Array:
         return tied_head(x, params["embed"]["embedding"], self.cfg.dtype)

@@ -44,6 +44,10 @@ class LMConfig:
     heads: int = 4
     mlp_ratio: int = 4
     dtype: Any = jnp.float32  # activation dtype (bfloat16 on TPU)
+    # Sliding-window (banded causal) attention width; None = full
+    # causal. Compute scales with S*window instead of S² (the flash
+    # kernels skip out-of-band blocks in fwd and bwd).
+    attn_window: int | None = None
     # MoE: 0 = dense FFN everywhere. With experts > 0, every
     # ``moe_every``-th block swaps its FFN for a switch-routed expert
     # layer whose expert dim shards over the mesh's ``ep`` axis.
@@ -228,10 +232,19 @@ def build_lm(
     otherwise."""
     attn: AttnImpl | None = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if cfg.attn_window is not None:
+            raise ValueError(
+                "attn_window is not supported with sequence parallelism "
+                "(ring attention has no banded variant yet)"
+            )
         attn = make_ring_attention(mesh, "sp")
     elif use_flash or (use_flash is None and jax.default_backend() == "tpu"):
         attn = lambda q, k, v, causal=True: flash_attention(
-            q, k, v, causal=causal
+            q, k, v, causal=causal, window=cfg.attn_window
+        )
+    elif cfg.attn_window is not None:
+        attn = lambda q, k, v, causal=True: mha_reference(
+            q, k, v, causal=causal, window=cfg.attn_window
         )
     return TransformerLM(cfg, attn_impl=attn)
 

@@ -30,18 +30,30 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _causal_mask(scores, q_offset, k_offset):
+def _causal_mask(scores, q_offset, k_offset, window=None):
+    """Causal mask, optionally banded: with ``window`` W, row r attends
+    to cols in [r-W+1, r] (W=1 is self-attention only)."""
     rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, scores.ndim - 2)
     cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, scores.ndim - 1)
-    return jnp.where(rows >= cols, scores, NEG_INF)
+    keep = rows >= cols
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows - window)
+    return jnp.where(keep, scores, NEG_INF)
 
 
-def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
+def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
+                  window=None):
     """Plain XLA attention. q: (..., Sq, D), k/v: (..., Sk, D).
 
     ``q_offset``/``k_offset`` place the blocks in a longer global
     sequence for causal masking (used by the ring-attention tests).
+    ``window`` is the sliding-window width (requires causal).
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     s = jnp.einsum(
         "...qd,...kd->...qk",
@@ -49,16 +61,29 @@ def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
         k.astype(jnp.float32),
     ) * scale
     if causal:
-        s = _causal_mask(s, q_offset, k_offset)
+        s = _causal_mask(s, q_offset, k_offset, window)
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", w, v.astype(jnp.float32)).astype(
         q.dtype
     )
 
 
+def _block_live(qi, ki, block_q, block_k, window):
+    """Predicate: does k-block ki intersect q-block qi's causal(/banded)
+    region? Exactly matches the elementwise mask, so skipped blocks are
+    the fully-masked ones (and only those)."""
+    live = (qi + 1) * block_q > ki * block_k  # not strictly above diagonal
+    if window is not None:
+        # Highest col in the k-block >= lowest row's window start.
+        live = jnp.logical_and(
+            live, (ki + 1) * block_k + window > qi * block_q + 1
+        )
+    return live
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest,
-    scale, causal, block_q, block_k,
+    scale, causal, window, block_q, block_k,
 ):
     # rest = (lse_ref?, m_scr, l_scr, acc_scr): the lse output exists
     # only on the VJP forward — inference forwards skip the extra HBM
@@ -87,7 +112,7 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = _causal_mask(s, qi * block_q, ki * block_k)
+            s = _causal_mask(s, qi * block_q, ki * block_k, window)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -102,9 +127,10 @@ def _flash_kernel(
         l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
 
     if causal:
-        # Blocks strictly above the diagonal contribute nothing; skip the
-        # matmuls (the scratch/out writes below still run every step).
-        @pl.when((qi + 1) * block_q > ki * block_k)
+        # Blocks fully outside the causal(/windowed) band contribute
+        # nothing; skip the matmuls (the scratch/out writes below still
+        # run every step).
+        @pl.when(_block_live(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -121,8 +147,8 @@ def _flash_kernel(
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   with_lse=False):
+def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
+                   interpret, with_lse=False):
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
     if s_q % block_q or s_k % block_k:
@@ -147,7 +173,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     result = pl.pallas_call(
         functools.partial(
             _flash_kernel,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k,
         ),
         grid=grid,
         in_specs=[
@@ -173,7 +200,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, block_q, block_k,
+    *, scale, causal, window, block_q, block_k,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -190,7 +217,7 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = _causal_mask(s, qi * block_q, ki * block_k)
+            s = _causal_mask(s, qi * block_q, ki * block_k, window)
         p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -203,7 +230,7 @@ def _dq_kernel(
         )
 
     if causal:
-        @pl.when((qi + 1) * block_q > ki * block_k)
+        @pl.when(_block_live(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -216,7 +243,7 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, block_q, block_k,
+    dk_scr, dv_scr, *, scale, causal, window, block_q, block_k,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -234,7 +261,7 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = _causal_mask(s, qi * block_q, ki * block_k)
+            s = _causal_mask(s, qi * block_q, ki * block_k, window)
         p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
@@ -252,7 +279,7 @@ def _dkv_kernel(
         )                                                  # (bk, d)
 
     if causal:
-        @pl.when((qi + 1) * block_q > ki * block_k)
+        @pl.when(_block_live(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -264,8 +291,8 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
+                    block_k, interpret):
     """Tiled backward (the FlashAttention-2 two-kernel scheme): P is
     recomputed blockwise from q/k and the saved logsumexp, so the bwd —
     like the fwd — never materialises the S x S score matrix in HBM."""
@@ -290,7 +317,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k,
         ),
         grid=(bh, s_q // block_q, s_k // block_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -308,7 +336,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k,
         ),
         grid=(bh, s_k // block_k, s_q // block_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
@@ -329,22 +358,27 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, window, scale, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, causal, window, scale, block_q, block_k, interpret
+    )
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k, interpret):
     out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret, with_lse=True
+        q, k, v, causal, window, scale, block_q, block_k, interpret,
+        with_lse=True,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, window, scale, block_q, block_k, interpret,
+               residuals, g):
     q, k, v, out, lse = residuals
     return _flash_backward(
-        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+        q, k, v, out, lse, g, causal, window, scale, block_q, block_k,
+        interpret
     )
 
 
@@ -367,10 +401,16 @@ def _fit_block(block: int, seq: int) -> int:
 
 
 def flash_attention(
-    q, k, v, *, causal=False, scale=None,
+    q, k, v, *, causal=False, window=None, scale=None,
     block_q=None, block_k=None, interpret=None,
 ):
     """Tiled attention. q/k/v: (batch, heads, seq, head_dim).
+
+    ``window`` enables sliding-window (banded causal) attention: row r
+    attends to columns [r-window+1, r]. Fully out-of-band blocks skip
+    their matmuls in fwd AND bwd, so compute scales with S*window
+    instead of S² — the standard long-context local-attention layout
+    (Mistral-style), composable per layer.
 
     On TPU, ``head_dim`` and the block sizes should be multiples of 128
     (MXU tiles). Blocks are auto-fitted down to a divisor of the
@@ -385,6 +425,11 @@ def flash_attention(
     ~21% slower than 1024/1024 at S=8192. Off TPU the kernel
     auto-falls-back to interpret mode.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = q.shape[-1] ** -0.5 if scale is None else scale
@@ -398,8 +443,10 @@ def flash_attention(
         # length with no 128-multiple divisor (e.g. 100) would fail deep
         # in the compiler. Odd lengths are rare and small in practice —
         # serve them through the XLA reference instead.
-        return mha_reference(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        return mha_reference(q, k, v, causal=causal, scale=scale,
+                             window=window)
+    return _flash(q, k, v, causal, window, scale, block_q, block_k,
+                  interpret)
 
 
 # ---- rotary position embeddings ----------------------------------------

@@ -186,3 +186,27 @@ class TestPipelinedLM:
                    jax.tree_util.DictKey("embedding")), leaf
         )
         assert sharding.spec == jax.sharding.PartitionSpec()  # small: replicated
+
+
+def test_windowed_pipelined_lm_differs_from_full_and_matches_sequential():
+    """attn_window flows into the pipelined blocks: the windowed model
+    must match its own sequential reference AND differ from the
+    full-causal model (proving the window is not silently dropped)."""
+    mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    cfg_full = LMConfig(vocab=64, layers=4, dim=32, heads=2)
+    cfg_win = LMConfig(vocab=64, layers=4, dim=32, heads=2, attn_window=4)
+    win = PipelinedLM(cfg_win, mesh, num_microbatches=2)
+    full = PipelinedLM(cfg_full, mesh, num_microbatches=2)
+    params = win.init(jax.random.key(0))
+    tokens = _tokens(4, 16)
+    out_win = jax.jit(
+        lambda p, t: win.apply({"params": p}, t)
+    )(params, tokens)
+    out_seq = jax.jit(
+        lambda p, t: win.sequential_apply({"params": p}, t)
+    )(params, tokens)
+    out_full = jax.jit(
+        lambda p, t: full.apply({"params": p}, t)
+    )(params, tokens)
+    np.testing.assert_allclose(out_win, out_seq, rtol=1e-4, atol=1e-4)
+    assert float(jnp.max(jnp.abs(out_win - out_full))) > 1e-3
