@@ -48,6 +48,12 @@ class LMConfig:
     # causal. Compute scales with S*window instead of S² (the flash
     # kernels skip out-of-band blocks in fwd and bwd).
     attn_window: int | None = None
+    # Grouped-query attention: number of k/v heads (None = heads, i.e.
+    # full MHA; 1 = MQA). Cuts the K/V projections and — the real win —
+    # KV activation memory by heads/kv_heads; the flash kernels map
+    # query heads onto their kv group via index maps, with no
+    # materialised repetition.
+    kv_heads: int | None = None
     # MoE: 0 = dense FFN everywhere. With experts > 0, every
     # ``moe_every``-th block swaps its FFN for a switch-routed expert
     # layer whose expert dim shards over the mesh's ``ep`` axis.
@@ -56,9 +62,22 @@ class LMConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    def __post_init__(self):
+        if self.kv_heads is not None and (
+            self.kv_heads < 1 or self.heads % self.kv_heads
+        ):
+            raise ValueError(
+                f"kv_heads={self.kv_heads} must be >= 1 and divide "
+                f"heads={self.heads}"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.heads
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.heads if self.kv_heads is None else self.kv_heads
 
 
 class RMSNorm(nn.Module):
@@ -161,18 +180,22 @@ class Block(nn.Module):
         # output dim is head-major, so column-sharding over tp cuts on
         # whole-head boundaries — the Megatron layout's requirement for
         # the single post-proj all-reduce (see parallel/mesh.py
-        # _tp_kernel_dim + LM_TP_RULES).
-        proj = lambda name: nn.Dense(
-            cfg.dim, use_bias=False, dtype=cfg.dtype, name=name
+        # _tp_kernel_dim + LM_TP_RULES). With GQA the k/v projections
+        # are num_kv_heads wide.
+        proj = lambda name, width: nn.Dense(
+            width, use_bias=False, dtype=cfg.dtype, name=name
         )(h)
-        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+        kv_dim = cfg.num_kv_heads * cfg.head_dim
+        q = proj("q_proj", cfg.dim)
+        k = proj("k_proj", kv_dim)
+        v = proj("v_proj", kv_dim)
 
-        def heads(t):  # (B, S, dim) -> (B, H, S, head_dim)
-            return t.reshape(b, s, cfg.heads, cfg.head_dim).transpose(
-                0, 2, 1, 3
-            )
+        def heads(t, n):  # (B, S, n*head_dim) -> (B, n, S, head_dim)
+            return t.reshape(b, s, n, cfg.head_dim).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q = heads(q, cfg.heads)
+        k = heads(k, cfg.num_kv_heads)
+        v = heads(v, cfg.num_kv_heads)
         q, k = apply_rope(q), apply_rope(k)
         attn = self.attn_impl or mha_reference
         out = attn(q, k, v, causal=True)
@@ -230,12 +253,35 @@ def build_lm(
     """Pick the attention core for the execution context: ring attention
     when the mesh has sp>1, the Pallas kernel on TPU, XLA reference
     otherwise."""
+    if (
+        mesh is not None
+        and mesh.shape.get("tp", 1) > 1
+        and cfg.kv_heads is not None
+        and cfg.kv_heads != cfg.heads
+        and cfg.kv_heads % mesh.shape["tp"]
+    ):
+        # With GQA, Megatron column-sharding should cut k/v on whole-
+        # kv-head boundaries; kv_heads < tp would either split a kv
+        # head across devices (extra k/v all-gather before attention)
+        # or silently replicate the k/v kernels while q stays sharded.
+        # (Plain MHA keeps the historical behavior: tp may subdivide
+        # head_dim, which is numerically fine and sometimes wanted on
+        # small-head configs.)
+        raise ValueError(
+            f"kv_heads={cfg.kv_heads} must be divisible by "
+            f"tp={mesh.shape['tp']} for the Megatron layout"
+        )
     attn: AttnImpl | None = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         if cfg.attn_window is not None:
             raise ValueError(
                 "attn_window is not supported with sequence parallelism "
                 "(ring attention has no banded variant yet)"
+            )
+        if cfg.num_kv_heads != cfg.heads:
+            raise ValueError(
+                "kv_heads is not supported with sequence parallelism "
+                "(ring attention has no GQA variant yet)"
             )
         attn = make_ring_attention(mesh, "sp")
     elif use_flash or (use_flash is None and jax.default_backend() == "tpu"):

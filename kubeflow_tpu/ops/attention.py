@@ -54,6 +54,17 @@ def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
             raise ValueError("window requires causal attention")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if q.ndim >= 3 and k.ndim == q.ndim and k.shape[-3] != q.shape[-3]:
+        # GQA reference path: materialise the head repetition (the
+        # kernel does it via index maps instead).
+        if q.shape[-3] % k.shape[-3]:
+            raise ValueError(
+                f"q heads {q.shape[-3]} not a multiple of kv heads "
+                f"{k.shape[-3]}"
+            )
+        group = q.shape[-3] // k.shape[-3]
+        k = jnp.repeat(k, group, axis=-3)
+        v = jnp.repeat(v, group, axis=-3)
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     s = jnp.einsum(
         "...qd,...kd->...qk",
@@ -157,9 +168,13 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
             f"block sizes ({block_q}, {block_k})"
         )
     bh = batch * heads
+    # GQA: with fewer kv heads, flat q index b = bi*H + hi maps to kv
+    # index b // group = bi*Hkv + hi // group — one index-map division,
+    # no materialised head repetition (the whole point: smaller K/V).
+    group = heads // k.shape[1]
     qr = q.reshape(bh, s_q, d)
-    kr = k.reshape(bh, s_k, d)
-    vr = v.reshape(bh, s_k, d)
+    kr = k.reshape(batch * k.shape[1], s_k, d)
+    vr = v.reshape(batch * v.shape[1], s_k, d)
     grid = (bh, s_q // block_q, s_k // block_k)
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
@@ -179,8 +194,10 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -299,9 +316,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
     bh = batch * heads
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
     qr = q.reshape(bh, s_q, d)
-    kr = k.reshape(bh, s_k, d)
-    vr = v.reshape(bh, s_k, d)
+    kr = k.reshape(batch * kv_heads, s_k, d)
+    vr = v.reshape(batch * kv_heads, s_k, d)
     dor = g.reshape(bh, s_q, d)
     lser = lse  # (bh, 8, s_q) sublane-padded, straight from the fwd
     # delta_i = rowsum(dO ∘ O) (cheap elementwise + reduce in XLA),
@@ -312,7 +331,9 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     delta = jnp.broadcast_to(delta, (bh, 8, s_q))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    # GQA: kv inputs indexed by b // group (see _flash_forward).
+    k_spec = pl.BlockSpec((1, block_k, d),
+                          lambda b, i, j: (b // group, j, 0))
     row_spec = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
     dq = pl.pallas_call(
         functools.partial(
@@ -331,6 +352,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     # dk/dv accumulate over q blocks: swap the grid's middle axis to the
     # k blocks so the scratch accumulators live across the q sweep.
     qT_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kvT_in_spec = pl.BlockSpec((1, block_k, d),
+                               lambda b, j, i: (b // group, j, 0))
     kT_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     rowT_spec = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
@@ -340,7 +363,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
             block_q=block_q, block_k=block_k,
         ),
         grid=(bh, s_k // block_k, s_q // block_q),
-        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        in_specs=[qT_spec, kvT_in_spec, kvT_in_spec, qT_spec, rowT_spec,
+                  rowT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
@@ -354,7 +378,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     )(qr, kr, vr, dor, lser, delta)
 
     shape = (batch, heads, s_q, d)
-    kshape = (batch, heads, s_k, d)
+    kshape = (batch, kv_heads, s_k, d)
+    if group > 1:
+        # dk/dv were produced per q-head (grid runs over all H); each kv
+        # head's gradient is the sum over its query group.
+        dk = dk.reshape(batch, kv_heads, group, s_k, d).sum(axis=2)
+        dv = dv.reshape(batch, kv_heads, group, s_k, d).sum(axis=2)
     return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
@@ -430,6 +459,11 @@ def flash_attention(
             raise ValueError("window requires causal attention")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if q.shape[1] % k.shape[1] or k.shape[1:] != v.shape[1:]:
+        raise ValueError(
+            f"q heads {q.shape[1]} must be a multiple of kv heads "
+            f"{k.shape[1]}; k/v must agree (got {k.shape} vs {v.shape})"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = q.shape[-1] ** -0.5 if scale is None else scale
