@@ -36,6 +36,7 @@ from kubeflow_tpu.models.transformer import (
     Block,
     LMConfig,
     RMSNorm,
+    check_tp_layout,
     lm_loss,
     tied_head,
 )
@@ -74,6 +75,7 @@ class PipelinedLM:
                 "MoE blocks are not pipelined (sow'd aux losses do not "
                 "cross the gpipe boundary); use ep on a non-pp mesh"
             )
+        check_tp_layout(cfg, mesh)
 
     @property
     def _embed(self) -> nn.Embed:

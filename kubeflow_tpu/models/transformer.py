@@ -59,6 +59,10 @@ class LMConfig:
     # layer whose expert dim shards over the mesh's ``ep`` axis.
     moe_experts: int = 0
     moe_every: int = 2
+    # Router choices per token: 1 = Switch, 2 = GShard/Mixtral-style
+    # top-2 with renormalised gates and first-choice priority under
+    # capacity pressure.
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
@@ -69,6 +73,13 @@ class LMConfig:
             raise ValueError(
                 f"kv_heads={self.kv_heads} must be >= 1 and divide "
                 f"heads={self.heads}"
+            )
+        if self.moe_experts and not (
+            1 <= self.moe_top_k <= self.moe_experts
+        ):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in "
+                f"[1, moe_experts={self.moe_experts}]"
             )
 
     @property
@@ -92,12 +103,14 @@ class RMSNorm(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Switch-style top-1 MoE FFN, TPU-native: dense one-hot dispatch
-    (static shapes — no gathers XLA can't tile), experts laid out on the
-    leading dim so the ``ep`` mesh axis shards them and the dispatch
-    einsum lowers to ICI all-to-alls. Over-capacity tokens fall through
-    the residual (standard Switch behaviour); a load-balance aux loss is
-    sowed under intermediates/moe_aux."""
+    """Top-k (k=1 Switch, k=2 GShard/Mixtral-style) MoE FFN, TPU-native:
+    dense one-hot dispatch (static shapes — no gathers XLA can't tile),
+    experts laid out on the leading dim so the ``ep`` mesh axis shards
+    them and the dispatch einsum lowers to ICI all-to-alls.
+    Over-capacity tokens fall through the residual (standard Switch
+    behaviour; with k=2, first choices fill capacity before any second
+    choice). A load-balance aux loss is sowed under
+    intermediates/moe_aux."""
 
     cfg: LMConfig
 
@@ -106,7 +119,9 @@ class MoEFFN(nn.Module):
         cfg = self.cfg
         b, s, d = x.shape
         e = cfg.moe_experts
-        cap = max(1, int(cfg.moe_capacity_factor * s / e))
+        k = cfg.moe_top_k
+        # Capacity scales with k: each token makes k assignments.
+        cap = max(1, int(cfg.moe_capacity_factor * k * s / e))
         hidden = cfg.mlp_ratio * d
 
         # Router in f32: softmax over experts must not run in bf16.
@@ -115,30 +130,66 @@ class MoEFFN(nn.Module):
             param_dtype=jnp.float32, name="router",
         )(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)          # (B, S, E)
-        expert = jnp.argmax(probs, axis=-1)              # (B, S)
-        gate = jnp.max(probs, axis=-1)                   # (B, S)
 
-        # Load-balance aux (Switch eq. 4): fraction of tokens vs fraction
-        # of router mass per expert.
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (B, S, E)
-        frac_tokens = onehot.mean(axis=(0, 1))
+        # Per-choice expert assignment: argmax, then re-argmax with the
+        # previous choices masked out (k is tiny and static — the loop
+        # unrolls at trace time).
+        masked = probs
+        onehots, gates = [], []
+        for _ in range(k):
+            expert = jnp.argmax(masked, axis=-1)              # (B, S)
+            oh = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+            onehots.append(oh)
+            gates.append(jnp.sum(masked * oh, axis=-1))       # (B, S)
+            masked = masked * (1.0 - oh)
+        if k > 1:
+            # Mixtral-style renormalisation over the selected gates.
+            denom = sum(gates)
+            gates = [g / (denom + 1e-9) for g in gates]
+
+        # Load-balance aux (Switch eq. 4 over first choices): fraction
+        # of tokens vs fraction of router mass per expert.
+        frac_tokens = onehots[0].mean(axis=(0, 1))
         frac_probs = probs.mean(axis=(0, 1))
         self.sow(
             "intermediates", "moe_aux",
             e * jnp.sum(frac_tokens * frac_probs),
         )
 
-        # Position of each token within its expert's capacity buffer;
-        # tokens past the cap are dropped (residual carries them).
-        position = jnp.cumsum(onehot, axis=1) * onehot - 1.0   # (B, S, E)
-        keep = (position >= 0) & (position < cap)
-        dispatch = jnp.where(keep, 1.0, 0.0)                   # (B, S, E)
-        pos_onehot = jax.nn.one_hot(
-            jnp.clip(position, 0, cap - 1).astype(jnp.int32), cap,
-            dtype=jnp.float32,
-        )                                                      # (B, S, E, C)
-        dispatch_t = dispatch[..., None] * pos_onehot          # (B, S, E, C)
-        combine_t = dispatch_t * gate[..., None, None]
+        # Position of each assignment within its expert's capacity
+        # buffer. Choice order is priority order (GShard): all first
+        # choices claim slots before any second choice, so under
+        # pressure top-1 assignments survive.
+        dispatch_t = jnp.zeros((b, s, e, cap), jnp.float32)
+        combine_t = jnp.zeros((b, s, e, cap), jnp.float32)
+        claimed = jnp.zeros((b, 1, e), jnp.float32)  # slots used so far
+        for oh, gate in zip(onehots, gates):
+            position = (
+                jnp.cumsum(oh, axis=1) + claimed
+            ) * oh - 1.0                                       # (B, S, E)
+            keep = (position >= 0) & (position < cap)
+            dispatch = jnp.where(keep, 1.0, 0.0)               # (B, S, E)
+            pos_onehot = jax.nn.one_hot(
+                jnp.clip(position, 0, cap - 1).astype(jnp.int32), cap,
+                dtype=jnp.float32,
+            )                                                  # (B, S, E, C)
+            dt = dispatch[..., None] * pos_onehot
+            dispatch_t = dispatch_t + dt
+            combine_t = combine_t + dt * gate[..., None, None]
+            claimed = claimed + jnp.sum(oh, axis=1, keepdims=True)
+
+        # Cheap routing diagnostics (and the capacity invariant's test
+        # surface): per-expert dispatched-token counts and the maximum
+        # occupancy of any (batch, expert, slot) — which must be <= 1
+        # (no slot collisions) with per-expert counts <= cap.
+        self.sow(
+            "intermediates", "moe_expert_load",
+            dispatch_t.sum(axis=(0, 1, 3)),
+        )
+        self.sow(
+            "intermediates", "moe_slot_max",
+            jnp.max(dispatch_t.sum(axis=1)),
+        )
 
         # To expert-major layout: with experts sharded on ep this einsum
         # is the all-to-all.
@@ -247,12 +298,17 @@ def tied_head(x: jax.Array, embedding: jax.Array, dtype) -> jax.Array:
     )
 
 
-def build_lm(
-    cfg: LMConfig, mesh: Mesh | None = None, use_flash: bool | None = None
-) -> TransformerLM:
-    """Pick the attention core for the execution context: ring attention
-    when the mesh has sp>1, the Pallas kernel on TPU, XLA reference
-    otherwise."""
+def check_tp_layout(cfg: LMConfig, mesh: Mesh | None) -> None:
+    """Reject GQA configs whose kv heads cannot cut cleanly over tp.
+
+    With explicit GQA, Megatron column-sharding should cut k/v on
+    whole-kv-head boundaries; kv_heads < tp would either split a kv
+    head across devices (extra k/v all-gather before attention) or
+    silently replicate the k/v kernels while q stays sharded. (Plain
+    MHA keeps the historical behavior: tp may subdivide head_dim, which
+    is numerically fine and sometimes wanted on small-head configs.)
+    Shared by every entry point that pairs this config with a tp mesh
+    (build_lm, PipelinedLM)."""
     if (
         mesh is not None
         and mesh.shape.get("tp", 1) > 1
@@ -260,17 +316,19 @@ def build_lm(
         and cfg.kv_heads != cfg.heads
         and cfg.kv_heads % mesh.shape["tp"]
     ):
-        # With GQA, Megatron column-sharding should cut k/v on whole-
-        # kv-head boundaries; kv_heads < tp would either split a kv
-        # head across devices (extra k/v all-gather before attention)
-        # or silently replicate the k/v kernels while q stays sharded.
-        # (Plain MHA keeps the historical behavior: tp may subdivide
-        # head_dim, which is numerically fine and sometimes wanted on
-        # small-head configs.)
         raise ValueError(
             f"kv_heads={cfg.kv_heads} must be divisible by "
             f"tp={mesh.shape['tp']} for the Megatron layout"
         )
+
+
+def build_lm(
+    cfg: LMConfig, mesh: Mesh | None = None, use_flash: bool | None = None
+) -> TransformerLM:
+    """Pick the attention core for the execution context: ring attention
+    when the mesh has sp>1, the Pallas kernel on TPU, XLA reference
+    otherwise."""
+    check_tp_layout(cfg, mesh)
     attn: AttnImpl | None = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         if cfg.attn_window is not None:

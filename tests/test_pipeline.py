@@ -177,6 +177,13 @@ class TestPipelinedLM:
                          moe_experts=2),
                 mesh, num_microbatches=2,
             )
+        mesh_tp = make_mesh(MeshSpec(dp=1, pp=2, tp=4))
+        with pytest.raises(ValueError, match="Megatron"):
+            PipelinedLM(
+                LMConfig(vocab=64, layers=4, dim=512, heads=8,
+                         kv_heads=2),
+                mesh_tp, num_microbatches=2,
+            )
 
     def test_pp_param_sharding_non_block_leaves_canonical(self):
         mesh = make_mesh(MeshSpec(dp=2, pp=4))
