@@ -34,6 +34,9 @@ _PP_EXPORTS = (
     "make_pp_lm_train_step",
 )
 
+# KV-cache decode/generation — same lazy rule.
+_GEN_EXPORTS = ("KVCache", "forward_with_cache", "generate")
+
 
 def __getattr__(name):
     if name in _LM_EXPORTS:
@@ -44,6 +47,10 @@ def __getattr__(name):
         from kubeflow_tpu.models import pipeline_lm
 
         return getattr(pipeline_lm, name)
+    if name in _GEN_EXPORTS:
+        from kubeflow_tpu.models import decoding
+
+        return getattr(decoding, name)
     if name in _CKPT_EXPORTS:
         from kubeflow_tpu.models import checkpoint
 
@@ -67,6 +74,9 @@ __all__ = [
     "PipelinedLM",
     "create_pp_lm_state",
     "make_pp_lm_train_step",
+    "KVCache",
+    "forward_with_cache",
+    "generate",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

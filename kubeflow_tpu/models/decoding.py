@@ -1,0 +1,211 @@
+"""Autoregressive generation with a KV cache for the transformer LM.
+
+Training runs full-sequence through :class:`TransformerLM`; decoding is
+a different execution shape — one token at a time against cached
+K/V — so it gets its own pure functions over the SAME params pytree
+(q_proj/k_proj/v_proj/proj/up/down/embed names are the contract; the
+parity tests hold decode output equal to the full forward at every
+prefix). TPU-native decode structure:
+
+- The cache is a static ``(layers, B, kv_heads, max_len, head_dim)``
+  buffer pair written with ``dynamic_update_slice`` — static shapes
+  throughout, one compiled step re-used for every position
+  (``lax.scan`` over the decode loop).
+- Attention at decode reads the FULL cache with a validity mask
+  (position iota vs current length) — masked lanes cost one VPU
+  compare, not a dynamic shape.
+- GQA: q heads fold into (kv_heads, group) so the cache stays compact;
+  sliding windows band the mask exactly like the training kernels.
+
+MoE decode is not implemented (dense-FFN models only) — the platform's
+MoE story is training-side; raise early rather than silently misroute.
+
+No reference counterpart (the reference platform ships no model code);
+part of the compute stack in the jupyter-jax-tpu images.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.transformer import LMConfig, rms_norm, tied_head
+from kubeflow_tpu.ops import apply_rope
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Per-layer stacked K/V buffers + the filled length."""
+
+    k: jax.Array  # (layers, B, kv_heads, max_len, head_dim)
+    v: jax.Array
+    length: jax.Array  # () int32 — tokens written so far
+
+    @classmethod
+    def init(cls, cfg: LMConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.dtype),
+            v=jnp.zeros(shape, cfg.dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "length"], meta_fields=[]
+)
+
+
+def _cached_attention(cfg, q, ck, cv, pos, t):
+    """q: (B, H, T, hd) at global positions [pos, pos+T); ck/cv: full
+    (B, Hkv, L, hd) cache. Masked dense attention over the whole
+    buffer: valid iff col <= row's global position (causal), col within
+    the filled region, and inside the sliding window if configured."""
+    b, h, _, hd = q.shape
+    group = h // ck.shape[1]
+    qg = q.reshape(b, ck.shape[1], group, t, hd)
+    s = jnp.einsum(
+        "bkgtd,bkld->bkgtl", qg.astype(jnp.float32),
+        ck.astype(jnp.float32),
+    ) * hd ** -0.5
+    rows = pos + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
+    keep = cols <= rows
+    if cfg.attn_window is not None:
+        keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
+    s = jnp.where(keep, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgtl,bkld->bkgtd", w, cv.astype(jnp.float32))
+    return out.reshape(b, h, t, hd).astype(q.dtype)
+
+
+def _block_step(cfg, params, x, ck, cv, pos):
+    """One block over a (B, T, D) chunk at global offset ``pos``,
+    reading/updating this layer's (B, Hkv, max_len, hd) cache slices.
+    Mirrors transformer.Block exactly (same param names/shapes)."""
+    b, t, _ = x.shape
+    h = rms_norm(params["RMSNorm_0"]["scale"], x)
+    proj = lambda name: (h @ params[name]["kernel"].astype(cfg.dtype))
+    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+
+    def heads(tensor, n):
+        return tensor.reshape(b, t, n, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q = heads(q, cfg.heads)
+    k = heads(k, cfg.num_kv_heads)
+    v = heads(v, cfg.num_kv_heads)
+    q = apply_rope(q, offset=pos)
+    k = apply_rope(k, offset=pos)
+
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+
+    out = _cached_attention(cfg, q, ck, cv, pos, t)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
+    x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
+
+    h = rms_norm(params["RMSNorm_1"]["scale"], x)
+    h = jax.nn.gelu(h @ params["up"]["kernel"].astype(cfg.dtype))
+    x = x + h @ params["down"]["kernel"].astype(cfg.dtype)
+    return x, ck, cv
+
+
+def forward_with_cache(
+    cfg: LMConfig, params: dict[str, Any], tokens: jax.Array,
+    cache: KVCache,
+):
+    """Run ``tokens`` (B, T) through the model starting at the cache's
+    current length; returns (logits (B, T, vocab) f32, updated cache).
+    T is the prefill chunk (or 1 during decode).
+
+    Contract: ``cache.length + T`` must not exceed the cache's max_len
+    — ``dynamic_update_slice`` would CLAMP an overflowing write (JAX
+    semantics), silently overwriting the newest K/V. Checked here
+    whenever the length is concrete; under a trace (generate's scan)
+    the caller sizes the cache (generate allocates P + max_new)."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "KV-cache decode supports dense-FFN models only"
+        )
+    pos = cache.length
+    max_len = cache.k.shape[3]
+    try:
+        concrete_pos = int(pos)
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        concrete_pos = None
+    if concrete_pos is not None and (
+        concrete_pos + tokens.shape[1] > max_len
+    ):
+        raise ValueError(
+            f"cache overflow: length {concrete_pos} + {tokens.shape[1]} "
+            f"new tokens > max_len {max_len}"
+        )
+    emb = params["embed"]["embedding"]
+    x = emb[tokens].astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i in range(cfg.layers):
+        x, ck, cv = _block_step(
+            cfg, params[f"block_{i}"], x, cache.k[i], cache.v[i], pos
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+    x = rms_norm(params["final_norm"]["scale"], x)
+    logits = tied_head(x, emb, cfg.dtype)
+    cache = KVCache(
+        k=jnp.stack(new_k), v=jnp.stack(new_v),
+        length=pos + tokens.shape[1],
+    )
+    return logits, cache
+
+
+def generate(
+    cfg: LMConfig,
+    params: dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+):
+    """Greedy (temperature=0) or temperature sampling. ``prompt``
+    (B, P) int32; returns (B, max_new_tokens) int32. Jit-compatible:
+    two compiled shapes total (one prefill, one reused decode step;
+    exactly max_new_tokens - 1 decode steps run — the first token comes
+    free with the prefill logits)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, p = prompt.shape
+    # The last generated token is never fed back, so its K/V slot is
+    # not needed.
+    cache = KVCache.init(cfg, b, p + max_new_tokens - 1)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache)
+    if rng is None:
+        rng = jax.random.key(0)
+    first_key, step_key = jax.random.split(rng)
+
+    def sample(logits_last, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    first = sample(logits[:, -1], first_key)
+    if max_new_tokens == 1:
+        return first[:, None]
+
+    def step(carry, key):
+        token, cache = carry
+        logits, cache = forward_with_cache(
+            cfg, params, token[:, None], cache
+        )
+        nxt = sample(logits[:, -1], key)
+        return (nxt, cache), nxt
+
+    keys = jax.random.split(step_key, max_new_tokens - 1)
+    (_, _), rest = jax.lax.scan(step, (first, cache), keys)
+    return jnp.concatenate([first[:, None], rest.transpose(1, 0)], axis=1)

@@ -91,15 +91,22 @@ class LMConfig:
         return self.heads if self.kv_heads is None else self.kv_heads
 
 
+def rms_norm(scale: jax.Array, x: jax.Array) -> jax.Array:
+    """The normalisation math, shared by the flax module and the
+    KV-cache decode path (models/decoding.py) so eps/cast discipline
+    cannot drift between training and decoding."""
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(
+        jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6
+    )
+    return (norm * scale).astype(x.dtype)
+
+
 class RMSNorm(nn.Module):
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
-        xf = x.astype(jnp.float32)
-        norm = xf * jax.lax.rsqrt(
-            jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6
-        )
-        return (norm * scale).astype(x.dtype)
+        return rms_norm(scale, x)
 
 
 class MoEFFN(nn.Module):

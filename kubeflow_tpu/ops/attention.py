@@ -486,11 +486,15 @@ def flash_attention(
 # ---- rotary position embeddings ----------------------------------------
 
 
-def rope_table(seq_len: int, head_dim: int, base: float = 10000.0, offset: int = 0):
-    """(cos, sin) tables of shape (seq_len, head_dim // 2)."""
+def rope_table(seq_len: int, head_dim: int, base: float = 10000.0, offset=0):
+    """(cos, sin) tables of shape (seq_len, head_dim // 2). ``offset``
+    may be a traced scalar (KV-cache decode inside lax.scan)."""
     half = head_dim // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)[:, None]
+    pos = (
+        jnp.asarray(offset, jnp.float32)
+        + jnp.arange(seq_len, dtype=jnp.float32)
+    )[:, None]
     angles = pos * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)
 
