@@ -67,6 +67,10 @@ class LMConfig:
     moe_aux_weight: float = 0.01
 
     def __post_init__(self):
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(
+                f"attn_window={self.attn_window} must be >= 1"
+            )
         if self.kv_heads is not None and (
             self.kv_heads < 1 or self.heads % self.kv_heads
         ):
@@ -338,17 +342,10 @@ def build_lm(
     check_tp_layout(cfg, mesh)
     attn: AttnImpl | None = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if cfg.attn_window is not None:
-            raise ValueError(
-                "attn_window is not supported with sequence parallelism "
-                "(ring attention has no banded variant yet)"
-            )
-        if cfg.num_kv_heads != cfg.heads:
-            raise ValueError(
-                "kv_heads is not supported with sequence parallelism "
-                "(ring attention has no GQA variant yet)"
-            )
-        attn = make_ring_attention(mesh, "sp")
+        # Ring attention composes with both model-level variants: GQA
+        # shards stay compact on the ring, and windows band each
+        # (q-shard, k-shard) block's mask.
+        attn = make_ring_attention(mesh, "sp", window=cfg.attn_window)
     elif use_flash or (use_flash is None and jax.default_backend() == "tpu"):
         attn = lambda q, k, v, causal=True: flash_attention(
             q, k, v, causal=causal, window=cfg.attn_window

@@ -11,6 +11,11 @@ k/v while only ever storing one shard per device — memory per device is
 O(S/sp * S/sp) per step instead of O(S^2), and the per-step transfer
 rides one ICI hop, overlapping with the block matmuls under XLA's
 latency-hiding scheduler.
+
+Composes with the model-level attention variants: GQA (k/v with fewer
+heads — q folds to (kv_heads, group) so the rotating shards stay
+compact) and sliding windows (the banded mask; out-of-band ring steps
+still rotate but contribute only masked lanes).
 """
 
 from __future__ import annotations
@@ -24,18 +29,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kubeflow_tpu.ops.attention import NEG_INF, _causal_mask
 
 
-def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
+def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
+                   window=None):
     """Attention over a sequence-sharded axis; call inside shard_map.
 
-    q, k, v: local shards of shape (batch, heads, seq_local, head_dim),
-    sharded on dim 2 over ``axis_name``. Returns the local output shard.
-    Differentiable (the scan + ppermute transpose to the reverse ring).
+    q: local shard (batch, heads, seq_local, head_dim); k/v the same
+    with ``kv_heads`` dividing ``heads`` (GQA). All sharded on dim 2
+    over ``axis_name``. ``window`` bands the causal mask exactly like
+    flash_attention. Returns the local output shard. Differentiable
+    (the scan + ppermute transpose to the reverse ring).
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     axis_size = jax.lax.psum(1, axis_name)
     my_shard = jax.lax.axis_index(axis_name)
-    s_local = q.shape[2]
-    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    b, h, s_local, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(
+            f"q heads {h} not a multiple of kv heads {h_kv}"
+        )
+    group = h // h_kv
+    scale = d ** -0.5 if scale is None else scale
     shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    # GQA fold: q gains a (kv_heads, group) split so every einsum runs
+    # against the COMPACT k/v shards — the arrays on the ring never
+    # carry repeated heads.
+    qg = q.reshape(b, h_kv, group, s_local, d)
 
     def step(carry, t):
         o, m, l, k_t, v_t = carry
@@ -47,17 +70,17 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
         # MXU path (same rule as the flash kernel). Softmax statistics
         # and the output accumulator stay f32.
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, k_t,
+            "bngqd,bnkd->bngqk", qg, k_t,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = _causal_mask(s, my_shard * s_local, src * s_local)
+            s = _causal_mask(s, my_shard * s_local, src * s_local, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_t.dtype), v_t,
+            "bngqk,bnkd->bngqd", p.astype(v_t.dtype), v_t,
             preferred_element_type=jnp.float32,
         )
         # Rotate k/v one ICI hop (the final rotation returns them home —
@@ -66,22 +89,24 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None):
         v_next = jax.lax.ppermute(v_t, axis_name, shift)
         return (o_new, m_new, l_new, k_next, v_next), None
 
-    stats_shape = (*q.shape[:3], 1)
+    acc_shape = (b, h_kv, group, s_local, d)
+    stats_shape = (b, h_kv, group, s_local, 1)
     # The accumulators start as constants but become device-varying once
     # folded with per-device scores; mark them varying up front so the
     # scan carry type is stable (shard_map VMA checking).
     init = (
-        jax.lax.pvary(jnp.zeros(q.shape, jnp.float32), axis_name),
+        jax.lax.pvary(jnp.zeros(acc_shape, jnp.float32), axis_name),
         jax.lax.pvary(jnp.full(stats_shape, NEG_INF, jnp.float32), axis_name),
         jax.lax.pvary(jnp.zeros(stats_shape, jnp.float32), axis_name),
         k,
         v,
     )
     (o, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(axis_size))
-    return (o / l).astype(q.dtype)
+    return (o / l).reshape(b, h, s_local, d).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
+                        window: int | None = None):
     """Global-array wrapper: shard q/k/v on seq over ``axis_name`` and run
     the ring inside shard_map. Drop-in for an attention impl taking
     (q, k, v, causal) as global (batch, heads, seq, head_dim) arrays."""
@@ -89,7 +114,8 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
 
     def attend(q, k, v, causal=False):
         fn = functools.partial(
-            ring_attention, axis_name=axis_name, causal=causal
+            ring_attention, axis_name=axis_name, causal=causal,
+            window=window,
         )
         return jax.shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

@@ -395,16 +395,15 @@ class TestSlidingWindow:
         state, metrics = step(state, {"tokens": tokens})
         assert np.isfinite(float(metrics["loss"]))
 
-    def test_window_rejected_with_sequence_parallelism(self):
-        from kubeflow_tpu.models import LMConfig, build_lm
+    def test_window_composes_with_ring_attention(self):
+        from kubeflow_tpu.ops.ring import make_ring_attention
 
-        mesh = make_mesh(MeshSpec(dp=-1, sp=2))
-        with pytest.raises(ValueError, match="sequence parallelism"):
-            build_lm(
-                LMConfig(vocab=64, layers=1, dim=32, heads=2,
-                         attn_window=8),
-                mesh=mesh,
-            )
+        mesh = make_mesh(MeshSpec(dp=2, sp=4))
+        q, k, v = qkv(s=64, d=16)
+        ring = make_ring_attention(mesh, "sp", window=24)
+        out = ring(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True, window=24)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
 class TestGroupedQueryAttention:
@@ -499,15 +498,35 @@ class TestGroupedQueryAttention:
         state, metrics = step(state, {"tokens": tokens})
         assert np.isfinite(float(metrics["loss"]))
 
-    def test_gqa_rejected_with_sequence_parallelism(self):
-        from kubeflow_tpu.models import LMConfig, build_lm
+    def test_gqa_composes_with_ring_attention(self):
+        from kubeflow_tpu.ops.ring import make_ring_attention
+
+        mesh = make_mesh(MeshSpec(dp=2, sp=4))
+        q, k, v = self.gqa_qkv(h=8, h_kv=2, s=64, d=16)
+        ring = make_ring_attention(mesh, "sp")
+        out = ring(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            out, self.dense_gqa(q, k, v, causal=True), atol=2e-5
+        )
+
+    def test_gqa_windowed_lm_trains_on_sp_mesh(self):
+        from kubeflow_tpu.models import (
+            LMConfig, build_lm, create_lm_state, make_lm_train_step,
+        )
 
         mesh = make_mesh(MeshSpec(dp=-1, sp=2))
-        with pytest.raises(ValueError, match="GQA"):
-            build_lm(
-                LMConfig(vocab=64, layers=1, dim=32, heads=4, kv_heads=2),
-                mesh=mesh,
-            )
+        cfg = LMConfig(vocab=64, layers=1, dim=32, heads=4, kv_heads=2,
+                       attn_window=8)
+        model = build_lm(cfg, mesh=mesh)
+        state = create_lm_state(model, jax.random.key(0), (2, 32),
+                                mesh=mesh)
+        step = make_lm_train_step(mesh, cfg=cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, size=(4, 32)),
+            jnp.int32,
+        )
+        state, metrics = step(state, {"tokens": tokens})
+        assert np.isfinite(float(metrics["loss"]))
 
 
 def test_gqa_config_validation():
@@ -667,3 +686,20 @@ class TestMoETopK:
         with pytest.raises(ValueError, match="moe_top_k"):
             LMConfig(moe_experts=2, moe_top_k=0)
         LMConfig(moe_experts=0, moe_top_k=1)  # dense: field inert
+
+
+def test_ring_attention_validation():
+    from kubeflow_tpu.models import LMConfig
+    from kubeflow_tpu.ops.ring import make_ring_attention
+
+    mesh = make_mesh(MeshSpec(dp=2, sp=4))
+    q, k, v = qkv(s=64, d=16)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_ring_attention(mesh, "sp", window=0)(q, k, v, causal=True)
+    with pytest.raises(ValueError, match="causal"):
+        make_ring_attention(mesh, "sp", window=8)(q, k, v, causal=False)
+    q3 = jnp.concatenate([q, q[:, :1]], axis=1)  # 3 q heads vs 2 kv heads
+    with pytest.raises(ValueError, match="multiple"):
+        make_ring_attention(mesh, "sp")(q3, k, v, causal=True)
+    with pytest.raises(ValueError, match="attn_window"):
+        LMConfig(attn_window=0)
