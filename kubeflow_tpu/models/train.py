@@ -11,16 +11,15 @@ collectives — no hand-written communication.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import optax
 from flax import core, struct
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from kubeflow_tpu.parallel import batch_sharding, param_sharding, replicated
+from kubeflow_tpu.parallel import batch_sharding, param_sharding
 
 
 class TrainState(struct.PyTreeNode):

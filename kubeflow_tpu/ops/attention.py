@@ -18,7 +18,6 @@ numerics are identical everywhere.
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp

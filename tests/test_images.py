@@ -16,7 +16,6 @@ import re
 import stat
 import subprocess
 
-import pytest
 
 IMAGES_DIR = os.path.join(os.path.dirname(__file__), "..", "images")
 

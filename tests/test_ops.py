@@ -16,7 +16,6 @@ from kubeflow_tpu.ops import (
     flash_attention,
     make_ring_attention,
     mha_reference,
-    ring_attention,
 )
 from kubeflow_tpu.parallel import MeshSpec, make_mesh
 
@@ -566,7 +565,6 @@ class TestMoETopK:
     pressure; verified against a dense run-all-experts oracle."""
 
     def _moe_apply(self, top_k, capacity_factor=8.0, seed=0):
-        from flax import linen as nn_mod
         from kubeflow_tpu.models.transformer import LMConfig, MoEFFN
 
         cfg = LMConfig(
