@@ -10,10 +10,11 @@ only for pp (ppermute); dp/fsdp/tp stay automatic, so a
 ``MeshSpec(dp=2, pp=4)`` step shards the batch over dp AND pipelines
 over pp with no interaction between the two in this file.
 
-Composition limits: the pipelined blocks use the single-chip attention
-cores (XLA reference or Pallas flash) — ring attention's own shard_map
-over sp does not nest inside the pp-manual region, so sp must be 1 on
-a pipelined mesh (enforced in :func:`build_pp_lm`).
+Sequence parallelism composes too: on a mesh with sp > 1 the blocks
+run ring attention in its raw per-shard form INSIDE gpipe's manual
+region (one shard_map over {pp, sp} — no nesting), activations stay
+sequence-sharded through the pipeline, and RoPE offsets come from the
+sp shard index. dp/fsdp/tp remain automatic throughout.
 
 No reference counterpart: the reference platform ships no parallelism
 code at all (SURVEY.md §2.3); this is part of the first-class
@@ -29,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.models.train import TrainState
 from kubeflow_tpu.models.transformer import (
@@ -40,8 +41,8 @@ from kubeflow_tpu.models.transformer import (
     lm_loss,
     tied_head,
 )
-from kubeflow_tpu.ops import flash_attention, mha_reference
-from kubeflow_tpu.parallel import batch_sharding, param_sharding
+from kubeflow_tpu.ops import flash_attention, mha_reference, ring_attention
+from kubeflow_tpu.parallel import param_sharding, token_sharding
 from kubeflow_tpu.parallel.mesh import path_key
 from kubeflow_tpu.parallel.pipeline import gpipe, stage_stack
 
@@ -59,12 +60,6 @@ class PipelinedLM:
 
     def __post_init__(self):
         cfg, mesh = self.cfg, self.mesh
-        if mesh.shape.get("sp", 1) > 1:
-            raise ValueError(
-                "pipeline parallelism composes with dp/fsdp/tp, not sp: "
-                "ring attention is its own shard_map and cannot nest "
-                "inside the pp-manual region"
-            )
         if cfg.layers % mesh.shape["pp"]:
             raise ValueError(
                 f"layers={cfg.layers} not divisible by "
@@ -84,7 +79,13 @@ class PipelinedLM:
         )
 
     @property
-    def _block(self) -> Block:
+    def _sp(self) -> int:
+        return self.mesh.shape.get("sp", 1)
+
+    @property
+    def _plain_block(self) -> Block:
+        """Whole-sequence block: init (param shapes don't depend on the
+        attention impl) and the sequential reference path."""
         cfg = self.cfg
         attn = None
         if jax.default_backend() == "tpu":
@@ -99,6 +100,21 @@ class PipelinedLM:
             )
         return Block(cfg, attn_impl=attn)
 
+    @property
+    def _block(self) -> Block:
+        cfg = self.cfg
+        if self._sp > 1:
+            # pp x sp: the blocks run INSIDE gpipe's manual region with
+            # the sequence sharded over sp, so attention is the ring
+            # (raw per-shard form — same region, no shard_map nesting)
+            # and RoPE offsets come from the sp shard index.
+            attn = lambda q, k, v, causal=True: ring_attention(
+                q, k, v, axis_name="sp", causal=causal,
+                window=cfg.attn_window,
+            )
+            return Block(cfg, attn_impl=attn, rope_offset_axis="sp")
+        return self._plain_block
+
     def _head(self, params, x: jax.Array) -> jax.Array:
         return tied_head(x, params["embed"]["embedding"], self.cfg.dtype)
 
@@ -107,7 +123,10 @@ class PipelinedLM:
         r_emb, r_blk, r_norm = jax.random.split(rng, 3)
         dummy_tokens = jnp.zeros((1, 1), jnp.int32)
         dummy_x = jnp.zeros((1, 8, cfg.dim), cfg.dtype)
-        block = self._block
+        # Always the whole-sequence block: init runs OUTSIDE the manual
+        # region (an sp-aware block's axis_index would be unbound) and
+        # param shapes are attention-impl independent.
+        block = self._plain_block
         return {
             "embed": self._embed.init(r_emb, dummy_tokens)["params"],
             # Depth-stacked block params: vmap'd init over per-layer keys
@@ -143,6 +162,13 @@ class PipelinedLM:
             mesh,
             num_microbatches=self.num_microbatches,
             remat=self.remat,
+            # pp x sp: microbatched activations (M, mb, S, D) stay
+            # sequence-sharded through the pipeline and sp joins the
+            # manual region for the blocks' ring collectives.
+            activation_spec=(
+                P(None, None, "sp", None) if self._sp > 1 else None
+            ),
+            extra_manual_axes=("sp",) if self._sp > 1 else (),
         )
         x = run(stage_stack(params["blocks"], mesh.shape["pp"]), x)
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
@@ -150,10 +176,11 @@ class PipelinedLM:
 
     def sequential_apply(self, variables, tokens: jax.Array) -> jax.Array:
         """The same computation with a plain sequential layer loop and no
-        pipeline communication — the numerical reference the gpipe path
-        must match (used by tests; also the single-chip fallback)."""
+        pipeline/manual communication — the numerical reference the
+        gpipe path must match (used by tests; also the single-chip
+        fallback). Always the whole-sequence block, even on sp meshes."""
         params = variables["params"]
-        block, embed = self._block, self._embed
+        block, embed = self._plain_block, self._embed
         x = embed.apply({"params": params["embed"]}, tokens)
 
         def layer(h, layer_params):
@@ -213,9 +240,10 @@ def create_pp_lm_state(
 
 def make_pp_lm_train_step(model: PipelinedLM):
     """Jitted pipelined train step; batch = {"tokens": (B, S) int32}.
-    The batch shards over (dp, fsdp) exactly like the non-pipelined LM
-    step — pp only touches the block stack inside apply."""
-    token_sh = batch_sharding(model.mesh)
+    The batch shards over (dp, fsdp) and the sequence over sp, exactly
+    like the non-pipelined LM step — pp only touches the block stack
+    inside apply."""
+    token_sh = token_sharding(model.mesh)
 
     def step(state: TrainState, batch):
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], token_sh)

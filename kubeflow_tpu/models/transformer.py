@@ -18,11 +18,11 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from kubeflow_tpu.ops import apply_rope, flash_attention, mha_reference
 from kubeflow_tpu.ops.ring import make_ring_attention
-from kubeflow_tpu.parallel import param_sharding
+from kubeflow_tpu.parallel import param_sharding, token_sharding
 
 AttnImpl = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
 
@@ -232,6 +232,11 @@ class Block(nn.Module):
     cfg: LMConfig
     attn_impl: AttnImpl | None = None
     use_moe: bool = False
+    # Set when the block runs INSIDE a manual region with the sequence
+    # sharded over this axis (pp x sp pipelining): RoPE positions are
+    # then global (shard_index * local_len + i), matching what the
+    # non-manual paths compute on unsharded sequences.
+    rope_offset_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -258,7 +263,11 @@ class Block(nn.Module):
         q = heads(q, cfg.heads)
         k = heads(k, cfg.num_kv_heads)
         v = heads(v, cfg.num_kv_heads)
-        q, k = apply_rope(q), apply_rope(k)
+        offset = 0
+        if self.rope_offset_axis is not None:
+            offset = jax.lax.axis_index(self.rope_offset_axis) * s
+        q = apply_rope(q, offset=offset)
+        k = apply_rope(k, offset=offset)
         attn = self.attn_impl or mha_reference
         out = attn(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
@@ -455,7 +464,7 @@ def make_lm_train_step(
     if mesh is None:
         return jax.jit(step, donate_argnums=0)
 
-    token_sh = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    token_sh = token_sharding(mesh)
 
     def sharded_step(state, batch):
         batch = {

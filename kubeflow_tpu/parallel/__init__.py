@@ -12,6 +12,7 @@ from kubeflow_tpu.parallel.mesh import (
     make_multislice_mesh,
     auto_mesh,
     batch_sharding,
+    token_sharding,
     replicated,
     param_sharding,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "make_multislice_mesh",
     "auto_mesh",
     "batch_sharding",
+    "token_sharding",
     "replicated",
     "param_sharding",
     "gpipe",

@@ -149,6 +149,13 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("dp", "fsdp")))
 
 
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """Canonical LM token layout: batch over (dp, fsdp), sequence over
+    sp. The single source of truth for every LM train step (standard
+    and pipelined)."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -50,6 +50,8 @@ def gpipe(
     num_microbatches: int,
     axis: str = "pp",
     remat: bool = False,
+    activation_spec: P | None = None,
+    extra_manual_axes: tuple[str, ...] = (),
 ):
     """Wrap ``stage_fn`` into a pipelined pass over the full layer stack.
 
@@ -65,6 +67,15 @@ def gpipe(
     - ``y``: ``(B, ...)``, the stack's output, replicated over ``axis``
       (an explicit masked-psum broadcast from the last stage).
 
+    ``activation_spec``/``extra_manual_axes`` compose pipelining with a
+    second manual-collective dimension in the SAME region (no shard_map
+    nesting): e.g. ring attention over sp inside a pipelined stage —
+    pass ``activation_spec=P(None, None, "sp", None)`` for (M, mb, S, D)
+    microbatches sequence-sharded over sp and
+    ``extra_manual_axes=("sp",)`` so the stage's psum/ppermute over sp
+    resolve. The spec indexes MICROBATCHED activations: dim 0 is the
+    microbatch axis the schedule owns and must stay unsharded.
+
     Differentiable end-to-end: ppermute/psum have exact transposes, so
     ``jax.grad`` through the returned function yields the GPipe backward
     pass with cotangents flowing stage-to-stage in reverse.
@@ -72,13 +83,19 @@ def gpipe(
     num_stages = mesh.shape[axis]
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
+    act_spec = P() if activation_spec is None else activation_spec
+    if act_spec and act_spec[0] is not None:
+        raise ValueError(
+            "activation_spec dim 0 is the microbatch axis and must be "
+            f"unsharded, got {act_spec}"
+        )
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        axis_names=frozenset({axis}),
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        axis_names=frozenset({axis, *extra_manual_axes}),
+        in_specs=(P(axis), act_spec),
+        out_specs=act_spec,
         check_vma=False,
     )
     def run_sharded(stage_params, xm):
