@@ -17,8 +17,13 @@ prefix). TPU-native decode structure:
 - GQA: q heads fold into (kv_heads, group) so the cache stays compact;
   sliding windows band the mask exactly like the training kernels.
 
-MoE decode is not implemented (dense-FFN models only) — the platform's
-MoE story is training-side; raise early rather than silently misroute.
+MoE decode reuses the training layer (transformer.MoEFFN) verbatim —
+the dense dispatch is position-independent. One deliberate semantic
+difference: capacity is per forward chunk, so single-token decode
+steps never drop a token (the correct inference behaviour; training's
+over-capacity drops are a batch-level artifact). Decode therefore
+matches the full training forward exactly whenever capacity is ample,
+which the parity tests pin.
 
 No reference counterpart (the reference platform ships no model code);
 part of the compute stack in the jupyter-jax-tpu images.
@@ -84,7 +89,7 @@ def _cached_attention(cfg, q, ck, cv, pos, t):
     return out.reshape(b, h, t, hd).astype(q.dtype)
 
 
-def _block_step(cfg, params, x, ck, cv, pos):
+def _block_step(cfg, params, x, ck, cv, pos, use_moe=False):
     """One block over a (B, T, D) chunk at global offset ``pos``,
     reading/updating this layer's (B, Hkv, max_len, hd) cache slices.
     Mirrors transformer.Block exactly (same param names/shapes)."""
@@ -110,8 +115,17 @@ def _block_step(cfg, params, x, ck, cv, pos):
     x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
 
     h = rms_norm(params["RMSNorm_1"]["scale"], x)
-    h = jax.nn.gelu(h @ params["up"]["kernel"].astype(cfg.dtype))
-    x = x + h @ params["down"]["kernel"].astype(cfg.dtype)
+    if use_moe:
+        # MoE decode reuses the training layer verbatim: the dense
+        # dispatch is position-independent, so applying it to the
+        # (B, T) chunk routes exactly like training (aux intermediates
+        # are simply not collected — no loss at decode time).
+        from kubeflow_tpu.models.transformer import MoEFFN
+
+        x = x + MoEFFN(cfg).apply({"params": params["moe"]}, h)
+    else:
+        h = jax.nn.gelu(h @ params["up"]["kernel"].astype(cfg.dtype))
+        x = x + h @ params["down"]["kernel"].astype(cfg.dtype)
     return x, ck, cv
 
 
@@ -128,10 +142,6 @@ def forward_with_cache(
     semantics), silently overwriting the newest K/V. Checked here
     whenever the length is concrete; under a trace (generate's scan)
     the caller sizes the cache (generate allocates P + max_new)."""
-    if cfg.moe_experts:
-        raise NotImplementedError(
-            "KV-cache decode supports dense-FFN models only"
-        )
     pos = cache.length
     max_len = cache.k.shape[3]
     try:
@@ -149,8 +159,13 @@ def forward_with_cache(
     x = emb[tokens].astype(cfg.dtype)
     new_k, new_v = [], []
     for i in range(cfg.layers):
+        use_moe = (
+            cfg.moe_experts > 0
+            and i % cfg.moe_every == cfg.moe_every - 1
+        )
         x, ck, cv = _block_step(
-            cfg, params[f"block_{i}"], x, cache.k[i], cache.v[i], pos
+            cfg, params[f"block_{i}"], x, cache.k[i], cache.v[i], pos,
+            use_moe=use_moe,
         )
         new_k.append(ck)
         new_v.append(cv)

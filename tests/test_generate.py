@@ -100,11 +100,36 @@ def test_sampling_is_reproducible_and_in_vocab():
     assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < cfg.vocab))
 
 
-def test_moe_rejected():
-    cfg = LMConfig(vocab=64, layers=2, dim=32, heads=2, moe_experts=2)
-    cache = KVCache.init(cfg, 1, 8)
-    with pytest.raises(NotImplementedError, match="dense"):
-        forward_with_cache(cfg, {}, jnp.zeros((1, 4), jnp.int32), cache)
+def test_moe_decode_matches_full_forward():
+    """MoE decode reuses the training MoEFFN; with ample capacity (no
+    token drops in the full forward either) teacher-forced decode must
+    match the full forward at every position."""
+    cfg = LMConfig(
+        vocab=64, layers=2, dim=32, heads=4,
+        moe_experts=2, moe_every=2, moe_capacity_factor=8.0,
+    )
+    model, params, tokens = _setup(cfg, seq=10)
+    full = model.apply({"params": params}, tokens)
+    cache = KVCache.init(cfg, tokens.shape[0], tokens.shape[1])
+    for t in range(tokens.shape[1]):
+        logits, cache = forward_with_cache(
+            cfg, params, tokens[:, t:t + 1], cache
+        )
+        np.testing.assert_allclose(
+            logits[:, 0], full[:, t], rtol=1e-4, atol=1e-4,
+            err_msg=f"moe position {t}",
+        )
+
+
+def test_moe_generate_runs():
+    cfg = LMConfig(
+        vocab=64, layers=2, dim=32, heads=4,
+        moe_experts=2, moe_every=2, moe_capacity_factor=8.0,
+    )
+    _, params, prompt = _setup(cfg, seq=4)
+    out = generate(cfg, params, prompt, max_new_tokens=4)
+    assert out.shape == (2, 4)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab))
 
 
 def test_cache_overflow_rejected():
