@@ -190,16 +190,26 @@ def generate(
     (B, P) int32; returns (B, max_new_tokens) int32. Jit-compatible:
     two compiled shapes total (one prefill, one reused decode step;
     exactly max_new_tokens - 1 decode steps run — the first token comes
-    free with the prefill logits)."""
+    free with the prefill logits).
+
+    ``rng`` is required when ``temperature > 0``: a silent fixed-seed
+    default would make every sampling call return identical tokens.
+    """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError(
+            "temperature > 0 samples from the categorical distribution; "
+            "pass rng=jax.random.key(...) (a fixed default would return "
+            "identical samples on every call)"
+        )
     b, p = prompt.shape
     # The last generated token is never fed back, so its K/V slot is
     # not needed.
     cache = KVCache.init(cfg, b, p + max_new_tokens - 1)
     logits, cache = forward_with_cache(cfg, params, prompt, cache)
     if rng is None:
-        rng = jax.random.key(0)
+        rng = jax.random.key(0)  # unused on the greedy path below
     first_key, step_key = jax.random.split(rng)
 
     def sample(logits_last, key):

@@ -53,7 +53,10 @@ def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
             raise ValueError("window requires causal attention")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-    if q.ndim >= 3 and k.ndim == q.ndim and k.shape[-3] != q.shape[-3]:
+    if q.ndim == 4 and k.ndim == 4 and k.shape[-3] != q.shape[-3]:
+        # Only the documented (B, H, S, D) layout triggers GQA; for
+        # other ranks an unequal dim -3 is a shape error, not a head
+        # group, and falls through to einsum's own check.
         # GQA reference path: materialise the head repetition (the
         # kernel does it via index maps instead).
         if q.shape[-3] % k.shape[-3]:
@@ -462,6 +465,12 @@ def flash_attention(
         raise ValueError(
             f"q heads {q.shape[1]} must be a multiple of kv heads "
             f"{k.shape[1]}; k/v must agree (got {k.shape} vs {v.shape})"
+        )
+    if (q.shape[0] != k.shape[0] or q.shape[0] != v.shape[0]
+            or q.shape[-1] != k.shape[-1]):
+        raise ValueError(
+            f"q batch/head_dim must match k/v: got q {q.shape}, "
+            f"k {k.shape}, v {v.shape}"
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

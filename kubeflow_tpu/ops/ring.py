@@ -28,6 +28,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import NEG_INF, _causal_mask
 
+if hasattr(jax.lax, "pcast"):
+    def _pvary(x, axis_name):
+        return jax.lax.pcast(x, axis_name, to="varying")
+else:  # pre-pcast JAX releases
+    _pvary = jax.lax.pvary
+
 
 def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
                    window=None):
@@ -95,9 +101,9 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
     # folded with per-device scores; mark them varying up front so the
     # scan carry type is stable (shard_map VMA checking).
     init = (
-        jax.lax.pvary(jnp.zeros(acc_shape, jnp.float32), axis_name),
-        jax.lax.pvary(jnp.full(stats_shape, NEG_INF, jnp.float32), axis_name),
-        jax.lax.pvary(jnp.zeros(stats_shape, jnp.float32), axis_name),
+        _pvary(jnp.zeros(acc_shape, jnp.float32), axis_name),
+        _pvary(jnp.full(stats_shape, NEG_INF, jnp.float32), axis_name),
+        _pvary(jnp.zeros(stats_shape, jnp.float32), axis_name),
         k,
         v,
     )

@@ -152,3 +152,5 @@ def test_generate_one_token_and_validation():
     )
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(cfg, params, prompt, 0)
+    with pytest.raises(ValueError, match="rng"):
+        generate(cfg, params, prompt, 2, temperature=0.7)
