@@ -195,11 +195,28 @@ class NotebookReconciler:
             pod = self.api.get("v1", "Pod", f"{name}-0", ns)
         except NotFound:
             pod = {}
-        events = [
-            e
-            for e in self.api.list("v1", "Event", namespace=ns)
-            if event_involves_notebook(e, name)
-        ]
+        # Field-selected server-side (apiserver supports
+        # involvedObject.name on events): without it this list is
+        # O(all events in the namespace) per reconcile and the status
+        # mirror goes quadratic across N notebooks. Pod events carry
+        # the pod's own name ("nb-0"), so one selected list per replica
+        # joins them — replicas+1 point lists, bounded by slice size,
+        # never by namespace population. The kind check stays
+        # client-side (event_involves_notebook).
+        replicas = max(
+            ((notebook.get("spec") or {}).get("tpu") or {})
+            .get("replicas", 1), 1,
+        )
+        events = []
+        for involved in [name] + [f"{name}-{i}" for i in range(replicas)]:
+            events.extend(
+                e
+                for e in self.api.list(
+                    "v1", "Event", namespace=ns,
+                    field_selector=f"involvedObject.name={involved}",
+                )
+                if event_involves_notebook(e, name)
+            )
         status = native.invoke(
             "notebook_status",
             {

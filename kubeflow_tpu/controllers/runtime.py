@@ -139,60 +139,70 @@ def record_event(
     Like the reference recorder, repeats aggregate: a same
     (object, reason, component) event bumps count/lastTimestamp instead
     of piling up new objects — a persistently failing reconcile retried
-    every minute must not grow the event list without bound. Event
-    writes never fail a reconcile (fire-and-forget). ``clock`` keeps
-    timestamps coherent with callers using an injected clock."""
+    every minute must not grow the event list without bound. The
+    aggregation target is found by a DETERMINISTIC event name
+    (``<object>.<hash of kind|reason|component>``) + get/patch — one
+    point read per write regardless of how many events the namespace
+    holds, where a list-scan would go quadratic exactly during the
+    event storms aggregation exists for. Event writes never fail a
+    reconcile (fire-and-forget). ``clock`` keeps timestamps coherent
+    with callers using an injected clock."""
+    import hashlib
     import time as time_mod
-    import uuid
+
+    from kubeflow_tpu.k8s.core import Conflict, NotFound
 
     meta = involved.get("metadata", {})
     now = clock() if clock is not None else time_mod.time()
     stamp = time_mod.strftime("%Y-%m-%dT%H:%M:%SZ", time_mod.gmtime(now))
     namespace = meta.get("namespace", "default")
-    try:
-        for existing in api.list("v1", "Event", namespace=namespace):
-            ref = existing.get("involvedObject") or {}
-            src = existing.get("source") or {}
-            if (
-                existing.get("reason") == reason
-                and ref.get("name") == meta.get("name", "")
-                and ref.get("kind") == involved.get("kind", "")
-                and src.get("component") == component
-            ):
-                api.patch_merge(
-                    "v1", "Event", existing["metadata"]["name"],
-                    {
-                        "count": existing.get("count", 1) + 1,
-                        "lastTimestamp": stamp,
-                        "message": message,
-                    },
-                    namespace,
-                )
-                return
-        api.create(
+    key = f"{involved.get('kind', '')}|{reason}|{component}"
+    suffix = hashlib.sha1(key.encode()).hexdigest()[:10]
+    ev_name = f"{meta.get('name', 'obj')}.{suffix}"
+
+    def bump(existing: dict) -> None:
+        api.patch_merge(
+            "v1", "Event", ev_name,
             {
-                "apiVersion": "v1",
-                "kind": "Event",
-                "metadata": {
-                    "name": f"{meta.get('name', 'obj')}.{uuid.uuid4().hex[:10]}",
-                    "namespace": namespace,
-                },
-                "involvedObject": {
-                    "apiVersion": involved.get("apiVersion", ""),
-                    "kind": involved.get("kind", ""),
-                    "name": meta.get("name", ""),
-                    "namespace": meta.get("namespace", ""),
-                    "uid": meta.get("uid", ""),
-                },
-                "reason": reason,
-                "message": message,
-                "type": event_type,
-                "source": {"component": component},
-                "firstTimestamp": stamp,
+                "count": existing.get("count", 1) + 1,
                 "lastTimestamp": stamp,
-                "count": 1,
-            }
+                "message": message,
+            },
+            namespace,
         )
+
+    try:
+        try:
+            bump(api.get("v1", "Event", ev_name, namespace))
+            return
+        except NotFound:
+            pass
+        try:
+            api.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "metadata": {"name": ev_name, "namespace": namespace},
+                    "involvedObject": {
+                        "apiVersion": involved.get("apiVersion", ""),
+                        "kind": involved.get("kind", ""),
+                        "name": meta.get("name", ""),
+                        "namespace": meta.get("namespace", ""),
+                        "uid": meta.get("uid", ""),
+                    },
+                    "reason": reason,
+                    "message": message,
+                    "type": event_type,
+                    "source": {"component": component},
+                    "firstTimestamp": stamp,
+                    "lastTimestamp": stamp,
+                    "count": 1,
+                }
+            )
+        except Conflict:
+            # Lost a create race with a concurrent recorder: the event
+            # exists now, fold this occurrence into it.
+            bump(api.get("v1", "Event", ev_name, namespace))
     except Exception:
         log.debug("event write failed for %s/%s %s",
                   meta.get("namespace"), meta.get("name"), reason)

@@ -528,25 +528,42 @@ class ApiClient:
         return self._request("GET", self._path(gvk, namespace, name))
 
     def list(self, api_version: str, kind: str, namespace: str | None = None,
-             label_selector: str | None = None) -> list[dict]:
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
         return self._list_envelope(
-            api_version, kind, namespace, label_selector
+            api_version, kind, namespace, label_selector, field_selector
         ).get("items", [])
 
+    # Chunk size for LIST pagination. client-go's pager uses 500; every
+    # list — including watch re-lists — is chunked so a large cluster
+    # never makes the apiserver serialise one giant envelope.
+    LIST_PAGE_SIZE = 500
+
     def _list_envelope(self, api_version, kind, namespace=None,
-                       label_selector=None) -> dict:
+                       label_selector=None, field_selector=None) -> dict:
         gvk = self._gvk(api_version, kind)
-        query = {}
+        path = self._path(gvk, namespace, all_namespaces=namespace is None)
+        base_query = {"limit": str(self.LIST_PAGE_SIZE)}
         if label_selector:
-            query["labelSelector"] = label_selector
-        env = self._request(
-            "GET",
-            self._path(gvk, namespace, all_namespaces=namespace is None),
-            query=query or None,
-        )
+            base_query["labelSelector"] = label_selector
+        if field_selector:
+            base_query["fieldSelector"] = field_selector
+        items: list[dict] = []
+        env: dict = {}
+        cont = None
+        while True:
+            query = dict(base_query)
+            if cont:
+                query["continue"] = cont
+            env = self._request("GET", path, query=query)
+            items.extend(env.get("items", []))
+            cont = (env.get("metadata") or {}).get("continue")
+            if not cont:
+                break
+        env["items"] = items
         # Items from the wire omit apiVersion/kind; restore them so
         # callers can round-trip objects back into update()/GVK.from_obj.
-        for item in env.get("items", []):
+        for item in items:
             item.setdefault("apiVersion", api_version)
             item.setdefault("kind", kind)
         return env

@@ -116,6 +116,44 @@ def resource_name(kind: str) -> str:
     return lower + "s"
 
 
+def _field_at(obj: dict, path: str):
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def match_field_selector(obj: dict, selector: str) -> bool:
+    """Field selector ("metadata.name=x,status.phase!=Running"). The
+    real apiserver allows a per-resource field allowlist; the fake
+    accepts any dotted path (a strict superset) with =/==/!= operators.
+    A missing field compares as the empty string, matching apiserver
+    semantics for unset fields (set-but-falsy values like 0 and False
+    stringify as themselves)."""
+    def field_str(path: str) -> str:
+        v = _field_at(obj, path.strip())
+        return "" if v is None else str(v)
+
+    for term in [t.strip() for t in selector.split(",") if t.strip()]:
+        if "!=" in term:
+            key, val = term.split("!=", 1)
+            if field_str(key) == val.strip():
+                return False
+        elif "==" in term:
+            key, val = term.split("==", 1)
+            if field_str(key) != val.strip():
+                return False
+        elif "=" in term:
+            key, val = term.split("=", 1)
+            if field_str(key) != val.strip():
+                return False
+        else:
+            raise ApiError(f"invalid field selector term {term!r}")
+    return True
+
+
 def match_label_selector(labels: dict, selector: str) -> bool:
     """Equality-based selector string: "a=b,c!=d,e" (exists)."""
     labels = labels or {}

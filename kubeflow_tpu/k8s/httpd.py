@@ -36,6 +36,7 @@ from kubeflow_tpu.k8s.core import (
     CLUSTER_SCOPED,
     ApiError,
     RESOURCE_NAMES,
+    match_field_selector,
     match_label_selector,
     resource_name,
 )
@@ -253,15 +254,26 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(200, obj)
             if query.get("watch") in ("true", "1"):
                 return self._watch(info, query)
-            items, rv = self.fake.list_with_rv(
+            limit = query.get("limit")
+            try:
+                limit = int(limit) if limit else None
+            except ValueError:
+                return self._send_status(400, f"invalid limit {limit!r}")
+            items, rv, cont = self.fake.list_with_rv(
                 info["api_version"], info["kind"],
                 namespace=info["namespace"],
                 label_selector=query.get("labelSelector"),
+                field_selector=query.get("fieldSelector"),
+                limit=limit,
+                continue_=query.get("continue"),
             )
+            meta = {"resourceVersion": str(rv)}
+            if cont:
+                meta["continue"] = cont
             return self._send_json(200, {
                 "apiVersion": info["api_version"],
                 "kind": info["kind"] + "List",
-                "metadata": {"resourceVersion": str(rv)},
+                "metadata": meta,
                 "items": items,
             })
         except ApiError as exc:
@@ -308,16 +320,19 @@ class _Handler(BaseHTTPRequestHandler):
 
         namespace = info["namespace"]
         selector = query.get("labelSelector")
+        field_sel = query.get("fieldSelector")
 
         def matches(ev) -> bool:
             # A namespaced watch path must not leak other namespaces
-            # (real apiserver scoping); same for label selectors.
+            # (real apiserver scoping); same for label/field selectors.
             meta = ev.object.get("metadata", {})
             if namespace and meta.get("namespace") != namespace:
                 return False
             if selector and not match_label_selector(
                 meta.get("labels", {}), selector
             ):
+                return False
+            if field_sel and not match_field_selector(ev.object, field_sel):
                 return False
             return True
 

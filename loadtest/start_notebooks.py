@@ -38,8 +38,8 @@ from __future__ import annotations
 import argparse
 import copy
 import json
+import queue
 import subprocess
-import sys
 import sys
 import threading
 import time
@@ -52,7 +52,7 @@ import yaml
 # anywhere: the repo root carries the kubeflow_tpu package.
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from kubeflow_tpu.k8s.fake import Conflict  # noqa: E402
+from kubeflow_tpu.k8s.fake import Conflict, NotFound  # noqa: E402
 
 HERE = Path(__file__).resolve().parent
 
@@ -189,60 +189,89 @@ def run_kubectl(args: argparse.Namespace) -> dict | None:
 
 
 class FakeKubelet:
-    """Plays the kubelet's role against the in-memory API server: for every
-    StatefulSet it sees, after ``pod_latency`` seconds it creates the replica
-    pods with Ready conditions and marks the StatefulSet ready — the signal
-    the controller's status mirror consumes."""
+    """Plays the kubelet's role against the API server: for every
+    StatefulSet it sees, after ``pod_latency`` seconds it creates the
+    replica pods with Ready conditions and marks the StatefulSet ready —
+    the signal the controller's status mirror consumes.
+
+    Watch-driven, like the real kubelet: STS arrive over a watch stream
+    (fake queue or production ApiClient chunked watch — same duck type)
+    instead of a full LIST per tick. A list-per-tick kubelet was the
+    harness's own quadratic term at N=200: every poll re-serialised
+    every STS spec in the cluster."""
 
     def __init__(self, api, pod_latency: float = 0.0):
         self.api = api
         self.pod_latency = pod_latency
         self._started: dict[tuple[str, str], float] = {}
+        self._pending: dict[tuple[str, str], dict] = {}
         self._done: set[tuple[str, str]] = set()
+        self._watch = api.watch("apps/v1", "StatefulSet")
 
     def step(self, now: float) -> int:
+        while True:
+            try:
+                ev = self._watch.get_nowait()
+            except queue.Empty:
+                break
+            key = (ev.object["metadata"]["namespace"],
+                   ev.object["metadata"]["name"])
+            if ev.type == "DELETED":
+                self._pending.pop(key, None)
+                self._started.pop(key, None)
+                self._done.discard(key)
+            elif ev.type in ("ADDED", "MODIFIED"):
+                if key not in self._done:
+                    self._pending[key] = ev.object
         changed = 0
-        for sts in self.api.list("apps/v1", "StatefulSet"):
+        for key, sts in list(self._pending.items()):
             meta = sts["metadata"]
-            key = (meta["namespace"], meta["name"])
-            if key in self._done:
-                continue
             self._started.setdefault(key, now)
             if now - self._started[key] < self.pod_latency:
                 continue
-            replicas = sts["spec"].get("replicas", 1)
-            for ordinal in range(replicas):
-                self.api.apply(
-                    {
-                        "apiVersion": "v1",
-                        "kind": "Pod",
-                        "metadata": {
-                            "name": f"{meta['name']}-{ordinal}",
-                            "namespace": meta["namespace"],
-                            "labels": dict(
-                                (
-                                    sts["spec"].get("template", {}).get("metadata")
-                                    or {}
-                                ).get("labels", {})
-                            ),
-                        },
-                        "status": {
-                            "phase": "Running",
-                            "containerStatuses": [
-                                {"state": {"running": {"startedAt": "1970-01-01T00:00:00Z"}}}
-                            ],
-                            "conditions": [{"type": "Ready", "status": "True"}],
-                        },
-                    }
-                )
-            fresh = self.api.get(
-                "apps/v1", "StatefulSet", meta["name"], meta["namespace"]
-            )
-            fresh.setdefault("status", {})["readyReplicas"] = replicas
-            self.api.update(fresh)
-            self._done.add(key)
-            changed += 1
+            try:
+                changed += self._make_ready(key, meta, sts)
+            except NotFound:
+                # STS vanished between the watch event and now; a
+                # DELETED event will (or did) clean up. Never let one
+                # stale entry starve the rest of the pending set.
+                self._pending.pop(key, None)
         return changed
+
+    def _make_ready(self, key, meta, sts) -> int:
+        replicas = sts["spec"].get("replicas", 1)
+        for ordinal in range(replicas):
+            self.api.apply(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"{meta['name']}-{ordinal}",
+                        "namespace": meta["namespace"],
+                        "labels": dict(
+                            (
+                                sts["spec"].get("template", {}).get("metadata")
+                                or {}
+                            ).get("labels", {})
+                        ),
+                    },
+                    "status": {
+                        "phase": "Running",
+                        "containerStatuses": [
+                            {"state": {"running": {"startedAt": "1970-01-01T00:00:00Z"}}}
+                        ],
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                    },
+                }
+            )
+        fresh = self.api.get(
+            "apps/v1", "StatefulSet", meta["name"], meta["namespace"]
+        )
+        fresh.setdefault("status", {})["readyReplicas"] = replicas
+        self.api.update(fresh)
+        self._done.add(key)
+        del self._pending[key]
+        return 1
 
 
 def _measure_spawn_ready(
@@ -254,13 +283,22 @@ def _measure_spawn_ready(
     poll_sleep: float,
 ) -> dict[str, float]:
     """Shared measurement core for simulate/processes: run the fake
-    kubelet on a thread, create N notebook+PVC pairs, poll readiness
-    (status.readyReplicas >= wanted replicas), return latencies."""
+    kubelet on a thread, create N notebook+PVC pairs, record readiness
+    (status.readyReplicas >= wanted replicas) from the Notebook watch
+    stream, return latencies.
+
+    Watch-driven on both sides (kubelet and readiness): a poll loop
+    listing every Notebook per tick was itself a quadratic load source
+    at N=200 — the harness must not be the bottleneck it measures."""
     nb_tmpl, pvc_tmpl = load_templates()
     created_at: dict[str, float] = {}
     latencies: dict[str, float] = {}
     stop = threading.Event()
     logged_errors: set[str] = set()
+    lock = threading.Lock()
+    # Ready can be observed before the create() caller records its
+    # timestamp (the watch thread races the create loop); park those.
+    ready_at: dict[str, float] = {}
 
     def kubelet_loop():
         while not stop.is_set():
@@ -268,7 +306,7 @@ def _measure_spawn_ready(
                 kubelet.step(time.monotonic())
             except Conflict:
                 # Racing the controller's own STS update: the STS stays
-                # un-done and is retried next tick.
+                # pending and is retried next tick.
                 pass
             except Exception:
                 # A real bug must not kill the thread (readiness would
@@ -279,8 +317,43 @@ def _measure_spawn_ready(
                     print(f"fake kubelet error:\n{err}", file=sys.stderr)
             time.sleep(poll_sleep)
 
+    nb_watch = api.watch("kubeflow.org/v1beta1", "Notebook")
+
+    def readiness_loop():
+        while not stop.is_set():
+            try:
+                ev = nb_watch.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                obj = ev.object
+                if ev.type not in ("ADDED", "MODIFIED"):
+                    continue
+                if obj["metadata"].get("namespace") != namespace:
+                    continue
+                name = obj["metadata"]["name"]
+                want = max(
+                    (obj.get("spec", {}).get("tpu") or {})
+                    .get("replicas", 1), 1,
+                )
+                if (obj.get("status") or {}).get("readyReplicas", 0) >= want:
+                    with lock:
+                        if name not in ready_at:
+                            ready_at[name] = time.monotonic()
+            except Exception:
+                # Same contract as kubelet_loop: a malformed event must
+                # not kill the thread (the run would stall to timeout)
+                # but must not be silent either.
+                err = traceback.format_exc()
+                if err not in logged_errors:
+                    logged_errors.add(err)
+                    print(f"readiness watcher error:\n{err}",
+                          file=sys.stderr)
+
     kubelet_thread = threading.Thread(target=kubelet_loop, daemon=True)
+    ready_thread = threading.Thread(target=readiness_loop, daemon=True)
     kubelet_thread.start()
+    ready_thread.start()
     try:
         for i in range(num_notebooks):
             nb = render_notebook(nb_tmpl, i, namespace)
@@ -289,19 +362,16 @@ def _measure_spawn_ready(
             created_at[nb["metadata"]["name"]] = time.monotonic()
         deadline = time.monotonic() + timeout
         while len(latencies) < num_notebooks and time.monotonic() < deadline:
-            for nb in api.list("kubeflow.org/v1beta1", "Notebook",
-                               namespace=namespace):
-                name = nb["metadata"]["name"]
-                if name in latencies or name not in created_at:
-                    continue
-                want = max((nb["spec"].get("tpu") or {}).get("replicas", 1),
-                           1)
-                if (nb.get("status") or {}).get("readyReplicas", 0) >= want:
-                    latencies[name] = time.monotonic() - created_at[name]
-            time.sleep(poll_sleep)
+            with lock:
+                for name, t_ready in ready_at.items():
+                    if name in created_at and name not in latencies:
+                        latencies[name] = max(0.0, t_ready - created_at[name])
+            if len(latencies) < num_notebooks:
+                time.sleep(0.05)
     finally:
         stop.set()
         kubelet_thread.join(timeout=1)
+        ready_thread.join(timeout=1)
     return latencies
 
 

@@ -140,6 +140,29 @@ class TestNotebookController:
         assert nb["status"]["readyReplicas"] == 1
         assert "running" in nb["status"]["containerState"]
 
+    def test_status_mirrors_replica_pod_events(self, api):
+        """Pod-level failures (ImagePullBackOff on nb-0) must reach the
+        notebook's status.warningEvents even though the Event names the
+        POD, not the notebook — the field-selected event fetch has to
+        join per-replica names, not just the CR's own."""
+        ctrl = make_notebook_controller(api)
+        api.create(notebook_cr())
+        for name, kind in [("nb-0", "Pod"), ("other-nb", "Notebook")]:
+            api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"{name}.backoff", "namespace": "user"},
+                "involvedObject": {"kind": kind, "name": name,
+                                   "namespace": "user"},
+                "reason": "BackOff",
+                "message": "Back-off pulling image",
+                "type": "Warning",
+            })
+        ctrl.run_once()
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        warned = [w["involvedObject"]["name"]
+                  for w in nb["status"]["warningEvents"]]
+        assert warned == ["nb-0"]  # the pod's event, not the neighbour's
+
     def test_deleting_notebook_garbage_collects_children(self, api):
         ctrl = make_notebook_controller(api)
         api.create(notebook_cr())
@@ -348,3 +371,70 @@ class TestCullingController:
         ctrl.run_once()
         nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
         assert "annotations" not in nb["metadata"] or not nb["metadata"].get("annotations")
+
+
+class TestEventRecorder:
+    def involved(self):
+        return {
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "user", "uid": "u1"},
+        }
+
+    def test_aggregates_by_point_read_never_lists(self):
+        """The aggregation target is found by deterministic name —
+        O(1) per write even when the namespace holds thousands of
+        unrelated events (the storm case a list-scan goes quadratic
+        in)."""
+        from kubeflow_tpu.controllers.runtime import record_event
+
+        api = FakeApiServer()
+        for i in range(50):
+            api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"noise-{i}", "namespace": "user"},
+                "reason": "Unrelated", "count": 1,
+            })
+        calls = {"list": 0}
+        orig_list = api.list
+
+        def counting_list(*a, **k):
+            calls["list"] += 1
+            return orig_list(*a, **k)
+
+        api.list = counting_list
+        record_event(api, self.involved(), "Culled", "first")
+        record_event(api, self.involved(), "Culled", "second")
+        record_event(api, self.involved(), "Started", "other reason")
+        assert calls["list"] == 0
+        api.list = orig_list
+        mine = [e for e in api.list("v1", "Event", namespace="user")
+                if e.get("involvedObject", {}).get("name") == "nb"]
+        by_reason = {e["reason"]: e for e in mine}
+        assert set(by_reason) == {"Culled", "Started"}
+        assert by_reason["Culled"]["count"] == 2
+        assert by_reason["Culled"]["message"] == "second"
+        assert by_reason["Started"]["count"] == 1
+
+    def test_create_race_folds_into_existing(self):
+        """Losing a create race (409 from a concurrent recorder) bumps
+        the winner instead of dropping the occurrence."""
+        from kubeflow_tpu.k8s.core import Conflict
+        from kubeflow_tpu.controllers.runtime import record_event
+
+        api = FakeApiServer()
+        orig_create = api.create
+
+        def racing_create(obj, **kw):
+            if obj.get("kind") == "Event":
+                # Another recorder wins the race just before us.
+                orig_create(obj, **kw)
+                raise Conflict("simulated lost race")
+            return orig_create(obj, **kw)
+
+        api.create = racing_create
+        record_event(api, self.involved(), "Culled", "racy")
+        api.create = orig_create
+        mine = [e for e in api.list("v1", "Event", namespace="user")
+                if e.get("reason") == "Culled"]
+        assert len(mine) == 1
+        assert mine[0]["count"] == 2  # create (1) + post-race bump

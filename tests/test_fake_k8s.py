@@ -140,3 +140,76 @@ def test_dry_run_create_validates_without_persisting():
     api.create(pod("a"))
     with pytest.raises(Conflict):
         api.create(pod("a"), dry_run=True)
+
+
+def cm(name=None, generate_name=None, ns="default"):
+    meta = {"namespace": ns}
+    if name:
+        meta["name"] = name
+    if generate_name:
+        meta["generateName"] = generate_name
+    return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": meta}
+
+
+def test_field_selector_list():
+    api = FakeApiServer()
+    api.create(pod("a"))
+    api.create(pod("b"))
+    running = api.create(pod("c"))
+    api.patch_merge("v1", "Pod", "c", {"status": {"phase": "Running"}},
+                    "default")
+    assert [o["metadata"]["name"] for o in api.list(
+        "v1", "Pod", "default", field_selector="metadata.name=b")] == ["b"]
+    assert [o["metadata"]["name"] for o in api.list(
+        "v1", "Pod", "default",
+        field_selector="status.phase=Running")] == ["c"]
+    # != on a missing field compares against "" (apiserver semantics).
+    assert len(api.list("v1", "Pod", "default",
+                        field_selector="status.phase!=Running")) == 2
+    del running
+
+
+def test_list_pagination_continue_walks_all_pages():
+    api = FakeApiServer()
+    for i in range(10):
+        api.create(cm(name=f"cm-{i:02d}"))
+    items, rv, cont = api.list_with_rv("v1", "ConfigMap", "default", limit=4)
+    assert [o["metadata"]["name"] for o in items] == [
+        f"cm-{i:02d}" for i in range(4)]
+    assert cont
+    seen = [o["metadata"]["name"] for o in items]
+    while cont:
+        items, rv2, cont = api.list_with_rv(
+            "v1", "ConfigMap", "default", limit=4, continue_=cont)
+        # Every page reports the rv of the snapshot the token was cut at.
+        assert rv2 == rv
+        seen += [o["metadata"]["name"] for o in items]
+    assert seen == [f"cm-{i:02d}" for i in range(10)]
+
+
+def test_list_pagination_bad_continue_token_rejected():
+    from kubeflow_tpu.k8s.core import ApiError
+    api = FakeApiServer()
+    api.create(cm(name="a"))
+    with pytest.raises(ApiError):
+        api.list_with_rv("v1", "ConfigMap", "default", limit=2,
+                         continue_="not-base64-json")
+
+
+def test_generate_name_retries_on_suffix_collision(monkeypatch):
+    api = FakeApiServer()
+    api.create(cm(name="pfx-aaaaaa"))
+
+    class FixedUuid:
+        def __init__(self, hexstr):
+            self.hex = hexstr
+
+        def __str__(self):
+            return self.hex
+
+    seq = iter([FixedUuid("a" * 32), FixedUuid("b" * 32),
+                FixedUuid("c" * 32)])
+    monkeypatch.setattr("kubeflow_tpu.k8s.fake.uuid.uuid4",
+                        lambda: next(seq))
+    out = api.create(cm(generate_name="pfx-"))
+    assert out["metadata"]["name"] == "pfx-" + "b" * 6
