@@ -121,14 +121,40 @@ def _run_rest_app(app, default_port: int):
 def run_notebook_controller():
     """The notebook-controller binary: notebook reconciler + culler +
     metrics/health listener + optional leader election (reference
-    main.go:57-147)."""
+    main.go:57-147).
+
+    KFT_KERNEL_PROBE_URL overrides the culler's kernel-probe target —
+    a template with {namespace}/{name} placeholders. Production uses
+    the in-cluster Service DNS default; the process tier and the KinD
+    cull-cycle E2E point it at a reachable endpoint (NodePort /
+    port-forward / local fixture)."""
+    from kubeflow_tpu.controllers.culling import http_kernel_probe
     from kubeflow_tpu.controllers.manager import make_notebook_manager
 
     _setup_logging()
     api = _connect()
+    kernel_probe = None
+    probe_tmpl = os.environ.get("KFT_KERNEL_PROBE_URL")
+    if probe_tmpl:
+        # Fail fast on a malformed template: inside the probe the
+        # format error would be swallowed as "unreachable" on every
+        # call and culling would silently never fire.
+        try:
+            probe_tmpl.format(namespace="ns", name="name")
+        except (KeyError, IndexError, ValueError) as exc:
+            raise SystemExit(
+                f"KFT_KERNEL_PROBE_URL template invalid: {exc!r} "
+                "(placeholders: {namespace}, {name})"
+            )
+        kernel_probe = http_kernel_probe(
+            url_for=lambda ns, name: probe_tmpl.format(
+                namespace=ns, name=name
+            )
+        )
     mgr = make_notebook_manager(
         api,
         http_port=int(os.environ.get("METRICS_PORT", "8080")),
+        kernel_probe=kernel_probe,
     )
     mgr.start()
     log.info("notebook-controller started (leader_elect=%s)",

@@ -1,0 +1,529 @@
+"""Chaos / fault-injection tier (SURVEY §5 failure detection+recovery).
+
+The platform's recovery story is level-based reconciliation plus
+watch-resume: each mechanism is unit-tested elsewhere; THIS tier proves
+they compose under adversity — the apiserver dying and coming back
+mid-watch (with its watch history compacted, forcing the 410 → re-list
+path), the apiserver flapping repeatedly, leadership churning while
+work arrives, the admission webhook wedging (fail-closed), kernel
+endpoints and pods dying mid-cull-cycle, and a long reconcile soak with
+injected conflicts and server errors.
+
+Process-tier scenarios run real OS processes over the real wire
+protocol (the same ladder as tests/test_entrypoints.py); in-process
+scenarios use the fake apiserver with deterministic fault injection.
+The reference inherits this resilience from controller-runtime +
+client-go; this repo's runtime is its own, so it has to be proven here
+(reference notebook_controller.go:691-739 for the informer contract,
+culling_controller.go:202-241 for the probe semantics).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.controllers.culling import (
+    CullingOptions,
+    http_kernel_probe,
+    make_culling_controller,
+)
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.controllers.runtime import Request
+from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.k8s.httpd import FakeApiHttpServer
+
+from tests.test_entrypoints import (
+    free_port,
+    nb,
+    spawn,
+    terminate,
+    wait_for_sts,
+    wait_http,
+)
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+# ---------------------------------------------------------------------------
+# apiserver outages (process tier)
+# ---------------------------------------------------------------------------
+
+
+class TestApiserverOutage:
+    def test_outage_with_compacted_history_forces_relist(self):
+        """Kill the apiserver mid-watch, mutate the world while it is
+        down, AND age the watch history past the controller's resume
+        horizon — reconnection must take the 410 → full re-list path
+        and still converge."""
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        port = int(server.url.rsplit(":", 1)[1])
+        metrics_port = free_port()
+        proc = spawn("notebook-controller", server.url,
+                     {"METRICS_PORT": str(metrics_port)})
+        try:
+            wait_http(f"http://127.0.0.1:{metrics_port}/healthz")
+            fake.create(nb("pre-outage"))
+            wait_for_sts(fake, "pre-outage")
+
+            # Apiserver dies. The fake's store survives (etcd role);
+            # the HTTP front end is gone, the controller's watch drops.
+            server.close()
+            # While down: new work arrives AND the event history is
+            # flooded past the watch cache horizon (deque maxlen 1024),
+            # so the controller's resume rv answers 410 Gone.
+            fake.create(nb("during-outage"))
+            # Tied to the implementation, not a magic number: flood
+            # past whatever the watch cache actually retains.
+            flood = fake._event_log.maxlen + 76
+            for i in range(flood):
+                fake.create({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": f"noise-{i}",
+                                 "namespace": "default"},
+                })
+
+            server = FakeApiHttpServer(fake=fake, port=port).start()
+            wait_for_sts(fake, "during-outage", timeout=30.0)
+            # And the stream is live again, not just the re-list:
+            fake.create(nb("post-outage"))
+            wait_for_sts(fake, "post-outage")
+        finally:
+            terminate(proc)
+            server.close()
+
+    def test_apiserver_flap_soak(self):
+        """Three consecutive outage/restart cycles with work arriving
+        during every downtime window; the controller process must ride
+        through all of them without a restart."""
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        port = int(server.url.rsplit(":", 1)[1])
+        metrics_port = free_port()
+        proc = spawn("notebook-controller", server.url,
+                     {"METRICS_PORT": str(metrics_port)})
+        try:
+            wait_http(f"http://127.0.0.1:{metrics_port}/healthz")
+            for cycle in range(3):
+                server.close()
+                fake.create(nb(f"flap-{cycle}"))
+                time.sleep(0.3)  # let reconnect attempts hit the dead port
+                server = FakeApiHttpServer(fake=fake, port=port).start()
+                wait_for_sts(fake, f"flap-{cycle}", timeout=30.0)
+            assert proc.poll() is None, "controller died during the flaps"
+        finally:
+            terminate(proc)
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# leadership churn (process tier)
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseFlap:
+    def test_lease_deleted_repeatedly_no_dropped_keys(self):
+        """Delete the Lease out from under the elector while notebooks
+        keep arriving: leadership churns (every deletion forces a
+        NotFound → create race), but no notebook may be dropped, and
+        once converged the children must not churn (level-based
+        reconciles are idempotent — flapping leaders must not fight)."""
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        ports = {"flap-a": free_port(), "flap-b": free_port()}
+        procs = {
+            name: spawn("notebook-controller", server.url,
+                        {"METRICS_PORT": str(port), "LEADER_ELECT": "1",
+                         "POD_NAME": name})
+            for name, port in ports.items()
+        }
+        try:
+            for port in ports.values():
+                wait_http(f"http://127.0.0.1:{port}/healthz")
+
+            total = 8
+            for i in range(total):
+                fake.create(nb(f"churn-{i}"))
+                try:
+                    fake.delete("coordination.k8s.io/v1", "Lease",
+                                "notebook-controller", "kubeflow")
+                except NotFound:
+                    pass  # deleted before anyone re-created it: fine
+                time.sleep(0.25)
+
+            for i in range(total):
+                wait_for_sts(fake, f"churn-{i}", timeout=30.0)
+
+            # Steady state: no write churn. Wait out one more election
+            # round, then the children's resourceVersions must be
+            # stable across a further observation window.
+            def rvs():
+                return {
+                    i: fake.get("apps/v1", "StatefulSet", f"churn-{i}",
+                                "alice")["metadata"]["resourceVersion"]
+                    for i in range(total)
+                }
+
+            time.sleep(3.0)
+            before = rvs()
+            time.sleep(3.0)
+            assert rvs() == before, "steady-state STS churn under flaps"
+        finally:
+            for proc in procs.values():
+                try:
+                    terminate(proc)
+                except AssertionError:
+                    pass
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# admission webhook wedged (fail-closed) — process tier
+# ---------------------------------------------------------------------------
+
+
+class TestWebhookWedge:
+    def test_wedged_webhook_fails_closed_then_recovers(self, tmp_path):
+        """failurePolicy: Fail parity (reference
+        mutating-webhook-configuration.yaml:15): while the webhook
+        process is dead, pod creation through the admission path must
+        be REJECTED, not silently unmutated; after the webhook returns
+        on the same port, creation resumes with mutation applied."""
+        import ssl
+        import subprocess
+
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True,
+        )
+        from kubeflow_tpu.webhook.server import register_remote_webhook
+
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        fake.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+            "metadata": {"name": "tpu-env", "namespace": "alice"},
+            "spec": {"selector": {"matchLabels": {"tpu-env": "true"}},
+                     "env": [{"name": "KFT_FLAG", "value": "on"}]},
+        })
+        port = free_port()
+        url = f"https://127.0.0.1:{port}/apply-poddefault"
+        # The apiserver's MutatingWebhookConfiguration: every pod CREATE
+        # round-trips the real webhook process. Short timeout so the
+        # wedged case fails fast like a webhook with a deadline.
+        register_remote_webhook(fake, url, cafile=str(cert), timeout=3.0)
+
+        def pod(name):
+            return {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "alice",
+                             "labels": {"tpu-env": "true"}},
+                "spec": {"containers": [{"name": "c", "image": "i"}]},
+            }
+
+        def webhook_proc():
+            return spawn("admission-webhook", server.url,
+                         {"WEBHOOK_PORT": str(port),
+                          "CERT_FILE": str(cert), "KEY_FILE": str(key)})
+
+        ctx = ssl.create_default_context(cafile=str(cert))
+        proc = webhook_proc()
+        try:
+            wait_http(f"https://127.0.0.1:{port}/healthz", context=ctx)
+            created = fake.create(pod("while-up"))
+            env = created["spec"]["containers"][0].get("env", [])
+            assert {"name": "KFT_FLAG", "value": "on"} in env
+
+            # Webhook wedges (SIGKILL: no graceful drain).
+            proc.kill()
+            proc.communicate()
+            with pytest.raises(Exception):
+                fake.create(pod("while-down"))
+            with pytest.raises(NotFound):
+                fake.get("v1", "Pod", "while-down", "alice")
+
+            # Webhook returns on the same port: service resumes.
+            proc = webhook_proc()
+            wait_http(f"https://127.0.0.1:{port}/healthz", context=ctx)
+            created = fake.create(pod("after-recovery"))
+            env = created["spec"]["containers"][0].get("env", [])
+            assert {"name": "KFT_FLAG", "value": "on"} in env
+        finally:
+            try:
+                terminate(proc)
+            except AssertionError:
+                pass
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# cull cycle under faults (in-process controller, live HTTP kernel hop)
+# ---------------------------------------------------------------------------
+
+
+class _KernelServer:
+    """Live Jupyter-ish /api/kernels endpoint whose behavior the test
+    script flips: serve kernels, then drop dead, then come back."""
+
+    def __init__(self):
+        self.kernels: list = []
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(srv.kernels).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestCullCycleChaos:
+    IDLE_MIN = 60
+
+    def setup_culler(self, api, url_for, now_ref):
+        return make_culling_controller(
+            api,
+            kernel_probe=http_kernel_probe(timeout=2.0, url_for=url_for),
+            options=CullingOptions(enabled=True,
+                                   cull_idle_time_min=self.IDLE_MIN,
+                                   idleness_check_period_min=1),
+            clock=lambda: now_ref[0],
+        )
+
+    def seed(self, api):
+        api.create({
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": "vict", "namespace": "user"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "vict", "image": "img"}]}}},
+        })
+        api.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "vict-0", "namespace": "user",
+                         "labels": {"notebook-name": "vict"}},
+            "status": {"phase": "Running"},
+        })
+
+    def anns(self, api):
+        return api.get(NOTEBOOK_API, "Notebook", "vict",
+                       "user")["metadata"].get("annotations") or {}
+
+    def test_probe_endpoint_dies_mid_cycle_fail_safe(self):
+        """The kernel endpoint dying must NOT count as idleness
+        evidence: a notebook whose probe is unreachable for longer than
+        the cull window stays up (reference unmarshal-failure branch,
+        culling_controller.go:232-241 — probe failure refreshes, never
+        culls)."""
+        api = FakeApiServer()
+        now = [1_790_000_000.0]  # ~2026-09, past every kernel stamp
+        kernel_srv = _KernelServer()
+        kernel_srv.kernels = [{"execution_state": "busy",
+                               "last_activity": "2026-07-29T00:00:00Z"}]
+        ctrl = self.setup_culler(
+            api, lambda ns, name: f"http://127.0.0.1:{kernel_srv.port}/",
+            now,
+        )
+        self.seed(api)
+        ctrl.run_once()
+        assert "kubeflow-resource-stopped" not in self.anns(api)
+
+        # The kernel server dies mid-cycle. Advance time far past the
+        # cull window, probing every check period: every probe fails,
+        # none of them may produce a stop.
+        kernel_srv.close()
+        for _ in range(self.IDLE_MIN // 10 + 2):
+            now[0] += 10 * 60
+            ctrl.queue.add(Request("user", "vict"))
+            ctrl.run_once()
+        assert "kubeflow-resource-stopped" not in self.anns(api), (
+            "unreachable probe was treated as idleness evidence"
+        )
+
+    def test_pod_killed_mid_cycle_then_idle_cull_completes(self):
+        """Kill the rank-0 pod mid-cull-cycle: accounting pauses (the
+        reference requires the pod before idleness bookkeeping,
+        culling_controller.go:107-118), resumes when the pod returns,
+        and a genuinely idle notebook is then culled through the live
+        HTTP hop."""
+        api = FakeApiServer()
+        now = [1_790_000_000.0]  # ~2026-09, past every kernel stamp
+        kernel_srv = _KernelServer()
+        idle_stamp = "2026-07-28T00:00:00Z"
+        kernel_srv.kernels = [{"execution_state": "idle",
+                               "last_activity": idle_stamp}]
+        try:
+            ctrl = self.setup_culler(
+                api,
+                lambda ns, name: f"http://127.0.0.1:{kernel_srv.port}/",
+                now,
+            )
+            self.seed(api)
+            ctrl.run_once()
+            first = self.anns(api)
+            assert "notebooks.kubeflow.org/last-activity" in first
+
+            # Pod dies mid-cycle: probing must pause, not crash, and
+            # must not advance idleness bookkeeping.
+            api.delete("v1", "Pod", "vict-0", "user")
+            now[0] += 120
+            ctrl.queue.add(Request("user", "vict"))
+            ctrl.run_once()
+            assert self.anns(api).get(
+                "notebooks.kubeflow.org/last_activity_check_timestamp"
+            ) == first.get(
+                "notebooks.kubeflow.org/last_activity_check_timestamp"
+            )
+
+            # Pod comes back; the notebook has been idle since
+            # idle_stamp which is far past the window -> culled.
+            api.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "vict-0", "namespace": "user",
+                             "labels": {"notebook-name": "vict"}},
+                "status": {"phase": "Running"},
+            })
+            now[0] += self.IDLE_MIN * 60 + 120
+            ctrl.queue.add(Request("user", "vict"))
+            ctrl.run_once()
+            assert "kubeflow-resource-stopped" in self.anns(api)
+        finally:
+            kernel_srv.close()
+
+
+# ---------------------------------------------------------------------------
+# reconcile soak with injected faults (in-process)
+# ---------------------------------------------------------------------------
+
+
+class _FaultyApi:
+    """Deterministic fault injector around FakeApiServer: every Nth
+    write raises Conflict (optimistic-concurrency races), every Mth get
+    raises a 500-class ApiError (apiserver hiccups). Counter-based, so
+    runs reproduce exactly."""
+
+    def __init__(self, fake, conflict_every=7, error_every=13):
+        self._fake = fake
+        self._conflict_every = conflict_every
+        self._error_every = error_every
+        self.writes = 0
+        self.gets = 0
+        self.injected = 0
+
+    def __getattr__(self, name):
+        return getattr(self._fake, name)
+
+    def _maybe_conflict(self):
+        self.writes += 1
+        if self.writes % self._conflict_every == 0:
+            self.injected += 1
+            raise Conflict("injected write race")
+
+    def update(self, obj):
+        self._maybe_conflict()
+        return self._fake.update(obj)
+
+    def patch_merge(self, *a, **k):
+        self._maybe_conflict()
+        return self._fake.patch_merge(*a, **k)
+
+    def create(self, *a, **k):
+        self._maybe_conflict()
+        return self._fake.create(*a, **k)
+
+    def get(self, *a, **k):
+        self.gets += 1
+        if self.gets % self._error_every == 0:
+            self.injected += 1
+            raise ApiError("injected apiserver hiccup", 500)
+        return self._fake.get(*a, **k)
+
+
+class TestReconcileSoak:
+    def test_1000_reconciles_with_injected_faults_converge(self):
+        """Soak: 40 notebooks, every 7th write 409s, every 13th get
+        500s, plus periodic full re-lists (the post-410 path). The
+        queue's backoff must retry through all of it; the end state
+        must be fully converged with BOUNDED event growth (aggregation
+        by deterministic name) and an empty queue."""
+        fake = FakeApiServer()
+        api = _FaultyApi(fake)
+        ctrl = make_notebook_controller(api)
+        reconciles = [0]
+        orig = ctrl.reconciler.reconcile
+
+        def counting_reconcile(req):
+            reconciles[0] += 1
+            return orig(req)
+
+        ctrl.reconciler.reconcile = counting_reconcile
+
+        total = 40
+        for i in range(total):
+            fake.create({
+                "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+                "metadata": {"name": f"soak-{i}", "namespace": "user"},
+                "spec": {"template": {"spec": {"containers": [
+                    {"name": "c", "image": "img"}]}}},
+            })
+
+        rounds = 0
+        while reconciles[0] < 1000:
+            rounds += 1
+            ctrl.run_once()
+            # The post-410 role: periodic full re-list re-enqueues
+            # every key (level-based safety net).
+            if rounds % 5 == 0:
+                ctrl.resync()
+            else:
+                # Backoff entries become ready on a 5ms base; make sure
+                # the loop doesn't spin dry while one is pending.
+                time.sleep(0.01)
+            assert rounds < 2000, "soak failed to accumulate reconciles"
+
+        ctrl.resync()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ctrl.run_once()
+            if len(ctrl.queue) == 0:
+                break
+            time.sleep(0.02)
+
+        assert api.injected > 100, "fault injection never fired"
+        for i in range(total):
+            sts = fake.get("apps/v1", "StatefulSet", f"soak-{i}", "user")
+            assert sts["spec"]["replicas"] == 1
+            assert fake.get("v1", "Service", f"soak-{i}", "user")
+        # Bounded events: aggregation caps growth at one Event per
+        # (object, reason), regardless of how many retries fired.
+        events = fake.list("v1", "Event", namespace="user")
+        assert len(events) <= 2 * total, (
+            f"{len(events)} events for {total} notebooks: unbounded growth"
+        )
+        assert len(ctrl.queue) == 0
