@@ -85,12 +85,14 @@ def _env_int(name: str, default: int) -> int:
 
 
 def bench_lm(seq: int, batch: int, steps: int, warmup: int,
-             metric: str, anchor_tokens_s: float | None):
+             metric: str, anchor_tokens_s: float | None,
+             window: int | None = None):
     """LM training tokens/s/chip through the Pallas flash-attention
     fwd+bwd path — the workload class the reference platform cannot
     even express (SURVEY.md §2.3). ``anchor_tokens_s`` is the fixed
-    cross-round baseline (round-1 measured value), or None for
-    configs first measured this round."""
+    cross-round baseline (the round it was first measured), or None for
+    configs first measured this round. ``window`` benches the
+    sliding-window (banded causal) kernels."""
     from kubeflow_tpu.models import (
         LMConfig,
         build_lm,
@@ -99,7 +101,8 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
     )
 
     cfg = LMConfig(
-        vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16
+        vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16,
+        attn_window=window,
     )
     model = build_lm(cfg)
     state = create_lm_state(model, jax.random.key(0), (1, seq))
@@ -119,7 +122,116 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
         ),
         "seq": seq,
         "batch": batch,
+        **({"window": window} if window is not None else {}),
         "step_ms": round(1000 * dt / steps, 2),
+        "device": str(jax.devices()[0].device_kind),
+    }
+
+
+def bench_decode(batch: int, prompt_len: int, new_tokens: int,
+                 prefill_anchor: float | None,
+                 decode_anchor: float | None):
+    """KV-cache inference throughput (models/decoding.py): prefill
+    tokens/s (one full-prompt forward populating the cache) and
+    steady-state decode tokens/s (a single compiled one-token step
+    scanned ``new_tokens`` times inside ONE dispatch — per-dispatch
+    relay latency must not be in the number). 8x1024 GQA config
+    (kv_heads=2: the cache-bandwidth-bound regime decode optimisation
+    targets). Greedy sampling; sync via device_get (run_timed's relay
+    rule)."""
+    from kubeflow_tpu.models import LMConfig, build_lm
+    from kubeflow_tpu.models.decoding import KVCache, forward_with_cache
+
+    cfg = LMConfig(
+        vocab=32768, layers=8, dim=1024, heads=8, kv_heads=2,
+        dtype=jnp.bfloat16,
+    )
+    model = build_lm(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(batch, prompt_len)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+
+    max_len = prompt_len + new_tokens
+    # Amortise the per-dispatch relay floor (~50-60 ms on the axon
+    # tunnel) out of both numbers: prefill is timed as a scan over
+    # PREFILL_REPS independent prompts inside ONE dispatch, decode as
+    # one scan of new_tokens single-token steps.
+    prefill_reps = _env_int("KFT_BENCH_PREFILL_REPS", 8)
+
+    @jax.jit
+    def prefill(params, prompt):
+        cache = KVCache.init(cfg, batch, max_len)
+        logits, cache = forward_with_cache(cfg, params, prompt, cache)
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return first, cache
+
+    @jax.jit
+    def prefill_many(params, prompts):  # (R, B, P)
+        def one(carry, prompt):
+            cache = KVCache.init(cfg, batch, max_len)
+            logits, _ = forward_with_cache(cfg, params, prompt, cache)
+            first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return carry ^ first[0], None
+
+        acc, _ = jax.lax.scan(
+            one, jnp.zeros((), jnp.int32), prompts
+        )
+        return acc
+
+    @jax.jit
+    def decode_chunk(params, token, cache):
+        def step(carry, _):
+            token, cache = carry
+            logits, cache = forward_with_cache(
+                cfg, params, token[:, None], cache
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, cache), nxt
+
+        (last, cache), toks = jax.lax.scan(
+            step, (token, cache), None, length=new_tokens
+        )
+        return last, cache, toks
+
+    prompts = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(prefill_reps, batch, prompt_len)),
+        jnp.int32,
+    )
+    # Warmup (compile all shapes), then timed passes.
+    first, cache = prefill(params, prompt)
+    int(jax.device_get(first)[0])
+    int(jax.device_get(prefill_many(params, prompts)))
+    t0 = time.perf_counter()
+    int(jax.device_get(prefill_many(params, prompts)))
+    prefill_dt = time.perf_counter() - t0
+    prefill_tok_s = prefill_reps * batch * prompt_len / prefill_dt
+
+    last, cache2, _ = decode_chunk(params, first, cache)
+    int(jax.device_get(last)[0])
+    t0 = time.perf_counter()
+    last, _, toks = decode_chunk(params, first, cache)
+    int(jax.device_get(last)[0])
+    decode_dt = time.perf_counter() - t0
+    decode_tok_s = batch * new_tokens / decode_dt
+
+    return {
+        "metric": "lm_decode_tokens_per_sec_per_chip",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": (
+            round(decode_tok_s / decode_anchor, 4) if decode_anchor else None
+        ),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_step_ms": round(1000 * decode_dt / new_tokens, 3),
+        "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "prefill_vs_baseline": (
+            round(prefill_tok_s / prefill_anchor, 4) if prefill_anchor
+            else None
+        ),
         "device": str(jax.devices()[0].device_kind),
     }
 
@@ -250,8 +362,21 @@ def main():
         steps=_env_int(f"KFT_BENCH_{lm}STEPS", 10),
         warmup=_env_int(f"KFT_BENCH_{lm}WARMUP", 4),
     )
-    # Round-1 measured LM throughput (BASELINE.md): the fixed anchor.
-    lm_anchor = float(os.environ.get("KFT_BENCH_LM_ANCHOR", "111600"))
+    # Fixed cross-round anchors: each is the value measured the round
+    # its config was first benched (BASELINE.md). vs_baseline = value /
+    # anchor, so every section regression-tracks — no null baselines.
+    # Setting any anchor env var to 0 disables that ratio (null).
+    def _env_anchor(name: str, default: float) -> float | None:
+        return float(os.environ.get(name, str(default)) or 0) or None
+
+    lm_anchor = _env_anchor("KFT_BENCH_LM_ANCHOR", 111600)
+    long_anchor = _env_anchor("KFT_BENCH_LONG_ANCHOR", 68256)
+    long32k_anchor = _env_anchor("KFT_BENCH_LONG32K_ANCHOR", 37448)
+    window_anchor = _env_anchor("KFT_BENCH_WINDOW_ANCHOR", 89674)
+    decode_anchor = _env_anchor("KFT_BENCH_DECODE_ANCHOR", 1546)
+    decode_b8_anchor = _env_anchor("KFT_BENCH_DECODE_B8_ANCHOR", 7317)
+    prefill_anchor = _env_anchor("KFT_BENCH_PREFILL_ANCHOR", 82690)
+    prefill_b8_anchor = _env_anchor("KFT_BENCH_PREFILL_B8_ANCHOR", 275859)
 
     if mode == "lm":
         print(json.dumps(bench_lm(
@@ -267,6 +392,16 @@ def main():
             seq=_env_int("KFT_BENCH_SEQ", 8192),
             steps=_env_int("KFT_BENCH_STEPS", 5),
             warmup=_env_int("KFT_BENCH_WARMUP", 2),
+            window=_env_int("KFT_BENCH_WINDOW", 0) or None,
+        )))
+        return
+    if mode == "decode":
+        print(json.dumps(bench_decode(
+            batch=_env_int("KFT_BENCH_BATCH", 1),
+            prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            new_tokens=_env_int("KFT_BENCH_NEW_TOKENS", 256),
+            prefill_anchor=prefill_anchor,
+            decode_anchor=decode_anchor,
         )))
         return
     if mode == "resnet":
@@ -279,6 +414,10 @@ def main():
     # unexpected device must not drop the long-context record).
     record = bench_resnet()
     extras = []
+    long_seq = _env_int("KFT_BENCH_LONG_SEQ", 8192)
+    long_steps = _env_int("KFT_BENCH_LONG_STEPS", 5)
+    long_warmup = _env_int("KFT_BENCH_LONG_WARMUP", 2)
+    new_tokens = _env_int("KFT_BENCH_NEW_TOKENS", 256)
     for section in (
         lambda: bench_lm(
             metric="lm_train_tokens_per_sec_per_chip",
@@ -286,11 +425,35 @@ def main():
         ),
         lambda: bench_lm(
             metric="lm_long_context_tokens_per_sec_per_chip",
-            anchor_tokens_s=None,
+            anchor_tokens_s=long_anchor,
             batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
-            seq=_env_int("KFT_BENCH_LONG_SEQ", 8192),
-            steps=_env_int("KFT_BENCH_LONG_STEPS", 5),
-            warmup=_env_int("KFT_BENCH_LONG_WARMUP", 2),
+            seq=long_seq, steps=long_steps, warmup=long_warmup,
+        ),
+        lambda: bench_lm(
+            metric="lm_long_context_32k_tokens_per_sec_per_chip",
+            anchor_tokens_s=long32k_anchor,
+            batch=1,
+            seq=_env_int("KFT_BENCH_LONG32K_SEQ", 32768),
+            steps=_env_int("KFT_BENCH_LONG32K_STEPS", 3),
+            warmup=_env_int("KFT_BENCH_LONG32K_WARMUP", 1),
+        ),
+        lambda: bench_lm(
+            metric="lm_sliding_window_tokens_per_sec_per_chip",
+            anchor_tokens_s=window_anchor,
+            batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
+            seq=long_seq, steps=long_steps, warmup=long_warmup,
+            window=_env_int("KFT_BENCH_WINDOW", 1024),
+        ),
+        lambda: bench_decode(
+            batch=1, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            new_tokens=new_tokens,
+            prefill_anchor=prefill_anchor, decode_anchor=decode_anchor,
+        ),
+        lambda: bench_decode(
+            batch=8, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            new_tokens=new_tokens,
+            prefill_anchor=prefill_b8_anchor,
+            decode_anchor=decode_b8_anchor,
         ),
     ):
         try:

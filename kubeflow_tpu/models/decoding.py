@@ -74,9 +74,13 @@ def _cached_attention(cfg, q, ck, cv, pos, t):
     b, h, _, hd = q.shape
     group = h // ck.shape[1]
     qg = q.reshape(b, ck.shape[1], group, t, hd)
+    # bf16 operands + f32 accumulation: an explicit f32 cast here would
+    # force the ~8x-slower f32 MXU path (same rule as the flash
+    # kernels); softmax stays f32, its weights go back to the compute
+    # dtype for the PV matmul (FlashAttention's own layout).
     s = jnp.einsum(
-        "bkgtd,bkld->bkgtl", qg.astype(jnp.float32),
-        ck.astype(jnp.float32),
+        "bkgtd,bkld->bkgtl", qg, ck,
+        preferred_element_type=jnp.float32,
     ) * hd ** -0.5
     rows = pos + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
@@ -85,7 +89,10 @@ def _cached_attention(cfg, q, ck, cv, pos, t):
         keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
     s = jnp.where(keep, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgtl,bkld->bkgtd", w, cv.astype(jnp.float32))
+    out = jnp.einsum(
+        "bkgtl,bkld->bkgtd", w.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(b, h, t, hd).astype(q.dtype)
 
 

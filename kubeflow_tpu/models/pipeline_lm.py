@@ -44,7 +44,7 @@ from kubeflow_tpu.models.transformer import (
 from kubeflow_tpu.ops import flash_attention, mha_reference, ring_attention
 from kubeflow_tpu.parallel import param_sharding, token_sharding
 from kubeflow_tpu.parallel.mesh import path_key
-from kubeflow_tpu.parallel.pipeline import gpipe, stage_stack
+from kubeflow_tpu.parallel.pipeline import gpipe, one_f_one_b, stage_stack
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,9 +57,23 @@ class PipelinedLM:
     mesh: Mesh
     num_microbatches: int
     remat: bool = False
+    # "gpipe": AD-of-scan backward (O(M) live microbatch state);
+    # "1f1b": PipeDream-flush interleaved backward (O(P), inherent
+    # stage rematerialisation — the schedule for large M).
+    schedule: str = "gpipe"
 
     def __post_init__(self):
         cfg, mesh = self.cfg, self.mesh
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be gpipe|1f1b, got {self.schedule!r}"
+            )
+        if self.schedule == "1f1b" and self.remat:
+            raise ValueError(
+                "remat has no effect under 1f1b (the interleaved "
+                "backward recomputes stage internals inherently); "
+                "drop remat=True"
+            )
         if cfg.layers % mesh.shape["pp"]:
             raise ValueError(
                 f"layers={cfg.layers} not divisible by "
@@ -157,11 +171,8 @@ class PipelinedLM:
             h, _ = jax.lax.scan(layer, h, stage_params)
             return h
 
-        run = gpipe(
-            stage_fn,
-            mesh,
+        common = dict(
             num_microbatches=self.num_microbatches,
-            remat=self.remat,
             # pp x sp: microbatched activations (M, mb, S, D) stay
             # sequence-sharded through the pipeline and sp joins the
             # manual region for the blocks' ring collectives.
@@ -169,7 +180,18 @@ class PipelinedLM:
                 P(None, None, "sp", None) if self._sp > 1 else None
             ),
             extra_manual_axes=("sp",) if self._sp > 1 else (),
+            # Minimal redistribution of the last stage's output AND the
+            # head/loss then run on M/P microbatches per stage.
+            output=(
+                "sharded"
+                if self.num_microbatches % mesh.shape["pp"] == 0
+                else "replicated"
+            ),
         )
+        if self.schedule == "1f1b":
+            run = one_f_one_b(stage_fn, mesh, **common)
+        else:
+            run = gpipe(stage_fn, mesh, remat=self.remat, **common)
         x = run(stage_stack(params["blocks"], mesh.shape["pp"]), x)
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
         return self._head(params, x)

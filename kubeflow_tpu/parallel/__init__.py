@@ -21,7 +21,12 @@ from kubeflow_tpu.parallel.distributed import (
     initialize_from_env,
     slice_env_for_rank,
 )
-from kubeflow_tpu.parallel.pipeline import gpipe, pipeline_ticks, stage_stack
+from kubeflow_tpu.parallel.pipeline import (
+    gpipe,
+    one_f_one_b,
+    pipeline_ticks,
+    stage_stack,
+)
 
 __all__ = [
     "MeshSpec",
@@ -33,6 +38,7 @@ __all__ = [
     "replicated",
     "param_sharding",
     "gpipe",
+    "one_f_one_b",
     "pipeline_ticks",
     "stage_stack",
     "DistributedEnv",

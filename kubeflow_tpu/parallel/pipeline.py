@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+"""Pipeline parallelism: GPipe and 1F1B schedules over the ``pp`` axis.
 
 TPU-native pipelining, per the scaling-book recipe: the layer stack is
 split into P identical stages whose parameters shard over the mesh's
@@ -15,13 +15,28 @@ The reference platform has no pipeline/parallelism layer at all
 module is part of the first-class distributed backend the TPU build
 adds on top of the injected ``jax.distributed`` world.
 
-Schedule: plain GPipe. M microbatches flow through P stages in
-M + P - 1 ticks; each tick every stage runs once (the first/last P-1
-ticks carry bubbles). The backward schedule is whatever autodiff makes
-of the forward scan — correct, with the standard GPipe bubble fraction
-(P-1)/(M+P-1); raise ``num_microbatches`` to amortise. ``remat=True``
-wraps the stage in ``jax.checkpoint`` so live activation memory is one
-microbatch per tick instead of the whole scan history.
+Two schedules, one contract:
+
+- :func:`gpipe` — plain GPipe. M microbatches flow through P stages in
+  M + P - 1 ticks; the backward is whatever autodiff makes of the
+  forward scan — correct, with the standard bubble fraction
+  (P-1)/(M+P-1), but AD saves the per-tick carry chain, so live
+  microbatch state in the backward is O(M). ``remat=True`` wraps the
+  stage in ``jax.checkpoint`` so stage INTERNALS are recomputed.
+- :func:`one_f_one_b` — PipeDream-flush / 1F1B. Same bubble fraction,
+  but the backward is a hand-scheduled interleave (custom_vjp): each
+  slot a stage runs either one forward-recompute or one backward, and
+  stage inputs live in a P-slot circular buffer — O(P) live microbatch
+  state regardless of M, the property that lets microbatch counts grow
+  to amortise the bubble without growing memory.
+
+Output modes (both schedules): ``output="sharded"`` (default for new
+code) hands back the microbatch dim SHARDED over pp via one
+``psum_scatter`` — the minimal redistribution for data that exists
+only on the last stage (a masked psum would all-reduce zeros at ~2x
+the link time), and everything downstream (head, loss) then runs on
+M/P microbatches per stage instead of redundantly on all M.
+``output="replicated"`` keeps the round-2 behavior.
 """
 
 from __future__ import annotations
@@ -31,6 +46,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 # stage_fn(stage_params, x) -> y with y.shape == x.shape: one pipeline
@@ -43,6 +59,69 @@ def pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
     return num_microbatches + num_stages - 1
 
 
+def _out_spec(act_spec: P, axis: str, output: str) -> P:
+    """out_specs for the schedule result: microbatch dim 0 sharded over
+    ``axis`` in sharded mode, act_spec otherwise."""
+    if output == "sharded":
+        rest = tuple(act_spec)[1:] if len(tuple(act_spec)) else ()
+        return P(axis, *rest)
+    return act_spec
+
+
+def _forward_ticks(stage_fn, params, xm, idx, axis, num_stages, output):
+    """The GPipe forward schedule body, shared by both schedules (the
+    1F1B primal IS the GPipe forward; only backwards differ): tick
+    scan with ppermute circulation, last-stage output buffer, and the
+    output-mode emission."""
+    n_mb = xm.shape[0]
+    perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        # Shift every stage's last output one stage forward; stage 0
+        # feeds microbatch t instead (clipped re-feeds past the end
+        # are bubbles that never get written out).
+        recv = jax.lax.ppermute(state, axis, perm)
+        x_t = jax.lax.dynamic_index_in_dim(
+            xm, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False
+        )
+        out = stage_fn(params, jnp.where(idx == 0, x_t, recv))
+        # The last stage finishes microbatch t-(P-1) at tick t.
+        w = t - (num_stages - 1)
+        w_clip = jnp.clip(w, 0, n_mb - 1)
+        keep = jax.lax.dynamic_index_in_dim(
+            outbuf, w_clip, 0, keepdims=False
+        )
+        write = jnp.logical_and(idx == num_stages - 1, w >= 0)
+        outbuf = jax.lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(write, out, keep), w_clip, 0
+        )
+        return (out, outbuf), None
+
+    init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+    ticks = jnp.arange(pipeline_ticks(n_mb, num_stages))
+    (_, outbuf), _ = jax.lax.scan(tick, init, ticks)
+    return _emit_output(outbuf, idx, num_stages, axis, output)
+
+
+def _emit_output(outbuf, idx, num_stages, axis, output):
+    """Deliver the last stage's (M, ...) buffer per the output mode.
+
+    sharded: one ring reduce-scatter moves exactly the data each stage
+    needs (chunk s of the microbatch dim) — wall time ~ buf*(P-1)/P on
+    the ICI ring, the lower bound for a one-source redistribution.
+    replicated: full masked psum broadcast (2x the link time; kept for
+    callers that want the output whole on every stage)."""
+    masked = jnp.where(
+        idx == num_stages - 1, outbuf, jnp.zeros_like(outbuf)
+    )
+    if output == "sharded":
+        return jax.lax.psum_scatter(
+            masked, axis, scatter_dimension=0, tiled=True
+        )
+    return jax.lax.psum(masked, axis)
+
+
 def gpipe(
     stage_fn: StageFn,
     mesh: Mesh,
@@ -52,6 +131,7 @@ def gpipe(
     remat: bool = False,
     activation_spec: P | None = None,
     extra_manual_axes: tuple[str, ...] = (),
+    output: str = "replicated",
 ):
     """Wrap ``stage_fn`` into a pipelined pass over the full layer stack.
 
@@ -89,57 +169,33 @@ def gpipe(
             "activation_spec dim 0 is the microbatch axis and must be "
             f"unsharded, got {act_spec}"
         )
+    if output not in ("replicated", "sharded"):
+        raise ValueError(f"output must be replicated|sharded, got {output}")
+    if output == "sharded" and num_microbatches % num_stages:
+        raise ValueError(
+            f"sharded output needs num_microbatches={num_microbatches} "
+            f"divisible by pp={num_stages}"
+        )
 
     @partial(
         jax.shard_map,
         mesh=mesh,
         axis_names=frozenset({axis, *extra_manual_axes}),
         in_specs=(P(axis), act_spec),
-        out_specs=act_spec,
+        out_specs=_out_spec(act_spec, axis, output),
         check_vma=False,
     )
     def run_sharded(stage_params, xm):
         # Per-device view: leading stage dim is now 1 — this device's
         # stage. (M, mb, ...) microbatches are replicated over pp.
-        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
-        idx = jax.lax.axis_index(axis)
-        n_mb = xm.shape[0]
         # Open chain, not a ring: the last stage's output would only be
         # discarded by stage 0, so the wrap-around edge is omitted and
         # ppermute delivers zeros there — one less (mb, ...) transfer
         # per tick on the coarsest links.
-        perm = [(i, i + 1) for i in range(num_stages - 1)]
-
-        def tick(carry, t):
-            state, outbuf = carry
-            # Shift every stage's last output one stage forward; stage 0
-            # feeds microbatch t instead (clipped re-feeds past the end
-            # are bubbles that never get written out).
-            recv = jax.lax.ppermute(state, axis, perm)
-            x_t = jax.lax.dynamic_index_in_dim(
-                xm, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False
-            )
-            out = stage_fn(params, jnp.where(idx == 0, x_t, recv))
-            # The last stage finishes microbatch t-(P-1) at tick t.
-            w = t - (num_stages - 1)
-            w_clip = jnp.clip(w, 0, n_mb - 1)
-            keep = jax.lax.dynamic_index_in_dim(
-                outbuf, w_clip, 0, keepdims=False
-            )
-            write = jnp.logical_and(idx == num_stages - 1, w >= 0)
-            outbuf = jax.lax.dynamic_update_index_in_dim(
-                outbuf, jnp.where(write, out, keep), w_clip, 0
-            )
-            return (out, outbuf), None
-
-        init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
-        ticks = jnp.arange(pipeline_ticks(n_mb, num_stages))
-        (_, outbuf), _ = jax.lax.scan(tick, init, ticks)
-        # Broadcast the last stage's buffer to every stage (masked psum:
-        # all other stages contribute zeros).
-        return jax.lax.psum(
-            jnp.where(idx == num_stages - 1, outbuf, jnp.zeros_like(outbuf)),
-            axis,
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        return _forward_ticks(
+            stage_fn, params, xm, idx, axis, num_stages, output
         )
 
     def run(stage_params, x):
@@ -152,6 +208,240 @@ def gpipe(
             num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
         )
         ym = run_sharded(stage_params, xm)
+        return ym.reshape(x.shape[0], *ym.shape[2:])
+
+    return run
+
+
+def _1f1b_tables(num_microbatches: int, num_stages: int):
+    """Static slot tables for the PipeDream-flush schedule. Slot = one
+    compute unit (one stage forward OR one stage backward). Derived
+    from the canonical timing (stage s, microbatch m):
+
+      F(s, m) = s + m            for m <= P-1-s   (warmup)
+                2m + s           otherwise        (1F1B steady state)
+      B(s, m) = 2P - 1 + 2m - s                   (B(P-1,m)=F(P-1,m)+1)
+
+    Properties the implementation relies on (each checkable from the
+    formulas): F and B slots are disjoint per stage; the activation for
+    (s, m) is PRODUCED at F(s-1, m) and may wait until F(s, m), but
+    never more than P microbatches are in flight per stage, so a P-slot
+    circular buffer keyed m mod P holds every pending input; the
+    cotangent for (s, m) ARRIVES exactly at B(s, m) (no buffering).
+
+    Returns (F_tbl, B_tbl, R_tbl) of shape (T, P) with -1 = idle,
+    where R_tbl[t, s] is the microbatch whose activation arrives at
+    stage s in slot t (= F_tbl[t-1, s-1]), and T = 2(M + P - 1).
+    """
+    M, Pn = num_microbatches, num_stages
+    T = 2 * (M + Pn - 1)
+    F = np.full((T, Pn), -1, np.int32)
+    B = np.full((T, Pn), -1, np.int32)
+    for s in range(Pn):
+        for m in range(M):
+            tf = s + m if m <= Pn - 1 - s else 2 * m + s
+            F[tf, s] = m
+            B[2 * Pn - 1 + 2 * m - s, s] = m
+    R = np.full((T, Pn), -1, np.int32)
+    R[1:, 1:] = F[:-1, :-1]
+    return jnp.asarray(F), jnp.asarray(B), jnp.asarray(R)
+
+
+def one_f_one_b(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+    activation_spec: P | None = None,
+    extra_manual_axes: tuple[str, ...] = (),
+    output: str = "replicated",
+):
+    """1F1B (PipeDream-flush) pipeline schedule. Same contract and same
+    bubble fraction as :func:`gpipe`; the difference is the BACKWARD.
+
+    GPipe's backward is autodiff of the forward scan: XLA materialises
+    the per-tick carry chain, so the backward holds O(M) microbatch
+    activations. Here the backward is a hand-scheduled interleave
+    (``jax.custom_vjp``): per slot each stage runs either one
+    forward-RECOMPUTE (stage internals are never stored — inherent
+    rematerialisation) or one backward, stage inputs wait in a P-slot
+    circular buffer, and parameter gradients accumulate in-place. Live
+    microbatch state in the backward is O(P) however large M grows —
+    and growing M is exactly how the (P-1)/(M+P-1) bubble is amortised.
+
+    Compute cost is identical to gpipe(remat=True): M forwards +
+    M recompute-backwards per stage (measured on the 8-device CPU mesh
+    and on-chip; see BASELINE.md round-3 pipeline rows).
+    """
+    num_stages = mesh.shape[axis]
+    act_spec = P() if activation_spec is None else activation_spec
+    if act_spec and act_spec[0] is not None:
+        raise ValueError(
+            "activation_spec dim 0 is the microbatch axis and must be "
+            f"unsharded, got {act_spec}"
+        )
+    if output not in ("replicated", "sharded"):
+        raise ValueError(f"output must be replicated|sharded, got {output}")
+    if output == "sharded" and num_microbatches % num_stages:
+        raise ValueError(
+            f"sharded output needs num_microbatches={num_microbatches} "
+            f"divisible by pp={num_stages}"
+        )
+    manual_axes = frozenset({axis, *extra_manual_axes})
+    fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
+    rev_perm = [(i + 1, i) for i in range(num_stages - 1)]
+    F_tbl, B_tbl, R_tbl = _1f1b_tables(num_microbatches, num_stages)
+    n_slots = int(F_tbl.shape[0])
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=manual_axes,
+        in_specs=(P(axis), act_spec),
+        out_specs=_out_spec(act_spec, axis, output),
+        check_vma=False,
+    )
+    def fwd_sharded(stage_params, xm):
+        # The 1F1B primal IS the GPipe forward (schedules only differ
+        # in the backward); custom_vjp owns the residuals.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        return _forward_ticks(
+            stage_fn, params, xm, idx, axis, num_stages, output
+        )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=manual_axes,
+        in_specs=(P(axis), act_spec, _out_spec(act_spec, axis, output)),
+        out_specs=(P(axis), act_spec),
+        check_vma=False,
+    )
+    def bwd_sharded(stage_params, xm, ym_bar):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == num_stages - 1
+        if output == "sharded":
+            # Transpose of the forward's psum_scatter: gather the
+            # sharded cotangent back to (M, ...) (only the last stage
+            # reads it, but all_gather is the ring-optimal move).
+            ym_bar = jax.lax.all_gather(ym_bar, axis, axis=0, tiled=True)
+
+        mb_shape = xm.shape[1:]
+        zero_mb = jnp.zeros(mb_shape, xm.dtype)
+        zero_params = jax.tree.map(jnp.zeros_like, params)
+
+        def slot(carry, t):
+            xbuf, prev_act, prev_cot, dparams, dxm = carry
+            f_mb = F_tbl[t, idx]
+            b_mb = B_tbl[t, idx]
+            r_mb = R_tbl[t, idx]
+            recv_act = jax.lax.ppermute(prev_act, axis, fwd_perm)
+            recv_cot = jax.lax.ppermute(prev_cot, axis, rev_perm)
+
+            # Stage input arrives: from upstream (s > 0) or from xm
+            # (stage 0, at its own F slot). Circular slot = m mod P.
+            slot_r = jnp.where(r_mb >= 0, r_mb % num_stages, 0)
+            keep_r = jax.lax.dynamic_index_in_dim(
+                xbuf, slot_r, 0, keepdims=False
+            )
+            store_r = jnp.logical_and(r_mb >= 0, ~is_first)
+            xbuf = jax.lax.dynamic_update_index_in_dim(
+                xbuf, jnp.where(store_r, recv_act, keep_r), slot_r, 0
+            )
+            slot_f = jnp.where(f_mb >= 0, f_mb % num_stages, 0)
+            x_own = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(f_mb, 0, xm.shape[0] - 1), 0, keepdims=False
+            )
+            keep_f = jax.lax.dynamic_index_in_dim(
+                xbuf, slot_f, 0, keepdims=False
+            )
+            store_f = jnp.logical_and(f_mb >= 0, is_first)
+            xbuf = jax.lax.dynamic_update_index_in_dim(
+                xbuf, jnp.where(store_f, x_own, keep_f), slot_f, 0
+            )
+
+            def f_branch(op):
+                xbuf, _recv_cot = op
+                x_in = jax.lax.dynamic_index_in_dim(
+                    xbuf, slot_f, 0, keepdims=False
+                )
+                y = stage_fn(params, x_in)
+                return y, zero_mb, zero_params, zero_mb
+
+            def b_branch(op):
+                xbuf, recv_cot = op
+                slot_b = jnp.where(b_mb >= 0, b_mb % num_stages, 0)
+                x_in = jax.lax.dynamic_index_in_dim(
+                    xbuf, slot_b, 0, keepdims=False
+                )
+                seed = jax.lax.dynamic_index_in_dim(
+                    ym_bar, jnp.clip(b_mb, 0, ym_bar.shape[0] - 1), 0,
+                    keepdims=False,
+                )
+                cot = jnp.where(is_last, seed, recv_cot)
+                _, vjp_fn = jax.vjp(stage_fn, params, x_in)
+                dp, dx = vjp_fn(cot)
+                return zero_mb, dx, dp, dx
+
+            def idle_branch(op):
+                return zero_mb, zero_mb, zero_params, zero_mb
+
+            action = jnp.where(f_mb >= 0, 1, jnp.where(b_mb >= 0, 2, 0))
+            out_act, out_cot, dp, dx = jax.lax.switch(
+                action, [idle_branch, f_branch, b_branch],
+                (xbuf, recv_cot),
+            )
+            dparams = jax.tree.map(jnp.add, dparams, dp)
+            # Input cotangent: stage 0's backward of mb m yields dxm[m].
+            slot_b = jnp.clip(b_mb, 0, xm.shape[0] - 1)
+            keep_dx = jax.lax.dynamic_index_in_dim(
+                dxm, slot_b, 0, keepdims=False
+            )
+            write_dx = jnp.logical_and(b_mb >= 0, is_first)
+            dxm = jax.lax.dynamic_update_index_in_dim(
+                dxm, jnp.where(write_dx, dx, keep_dx), slot_b, 0
+            )
+            return (xbuf, out_act, out_cot, dparams, dxm), None
+
+        xbuf0 = jnp.zeros((num_stages,) + mb_shape, xm.dtype)
+        init = (xbuf0, zero_mb, zero_mb, zero_params, jnp.zeros_like(xm))
+        (_, _, _, dparams, dxm), _ = jax.lax.scan(
+            slot, init, jnp.arange(n_slots)
+        )
+        # dxm exists on stage 0 only; xm's spec is replicated over pp.
+        dxm = jax.lax.psum(
+            jnp.where(is_first, dxm, jnp.zeros_like(dxm)), axis
+        )
+        dparams = jax.tree.map(lambda g: g[None], dparams)
+        return dparams, dxm
+
+    @jax.custom_vjp
+    def pipeline(stage_params, xm):
+        return fwd_sharded(stage_params, xm)
+
+    def pipeline_fwd(stage_params, xm):
+        return fwd_sharded(stage_params, xm), (stage_params, xm)
+
+    def pipeline_bwd(res, ym_bar):
+        stage_params, xm = res
+        return bwd_sharded(stage_params, xm, ym_bar)
+
+    pipeline.defvjp(pipeline_fwd, pipeline_bwd)
+
+    def run(stage_params, x):
+        if x.shape[0] % num_microbatches:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        xm = x.reshape(
+            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
+        )
+        ym = pipeline(stage_params, xm)
         return ym.reshape(x.shape[0], *ym.shape[2:])
 
     return run
