@@ -262,12 +262,19 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, window, block_q, block_k,
+    dk_scr, dv_scr, *, scale, causal, window, block_q, block_k, num_qblocks,
 ):
+    """dk/dv for ONE kv head: the innermost grid axis sweeps q blocks
+    AND the query group (GQA) — axis length group * num_qblocks, with
+    the q-head index folded in by the BlockSpec index maps. The scratch
+    accumulators therefore integrate the whole query group in VMEM and
+    the kernel emits (batch, kv_heads, S, d) directly: no per-q-head
+    O(B*H*S*d) gradient transient, no group-sum pass over HBM."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    t = pl.program_id(2)
+    qi = t % num_qblocks
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -304,7 +311,7 @@ def _dkv_kernel(
     else:
         compute()
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -351,26 +358,39 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
 
-    # dk/dv accumulate over q blocks: swap the grid's middle axis to the
-    # k blocks so the scratch accumulators live across the q sweep.
-    qT_spec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kvT_in_spec = pl.BlockSpec((1, block_k, d),
-                               lambda b, j, i: (b // group, j, 0))
-    kT_spec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    rowT_spec = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
+    # dk/dv accumulate over q blocks AND the query group: grid runs one
+    # program sequence per (batch, kv head), the innermost axis sweeps
+    # group * num_qblocks, and the index maps pick the q head out of
+    # t // num_qblocks — the group reduction happens in the VMEM
+    # scratch, not as an O(B*H*S*d) HBM transient (the dominant term
+    # at MQA, where the per-q-head layout would be H x the output).
+    nq = s_q // block_q
+
+    def qhead(b, t):
+        # (batch, kv-head, group member) -> row in the (bh, ...) q/do
+        # layout. b indexes batch * kv_heads; t // nq is the member.
+        return (b // kv_heads) * heads + (b % kv_heads) * group + t // nq
+
+    qG_spec = pl.BlockSpec(
+        (1, block_q, d), lambda b, j, t: (qhead(b, t), t % nq, 0)
+    )
+    kvG_spec = pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0))
+    rowG_spec = pl.BlockSpec(
+        (1, 8, block_q), lambda b, j, t: (qhead(b, t), 0, t % nq)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
             scale=scale, causal=causal, window=window,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, num_qblocks=nq,
         ),
-        grid=(bh, s_k // block_k, s_q // block_q),
-        in_specs=[qT_spec, kvT_in_spec, kvT_in_spec, qT_spec, rowT_spec,
-                  rowT_spec],
-        out_specs=[kT_spec, kT_spec],
+        grid=(batch * kv_heads, s_k // block_k, group * nq),
+        in_specs=[qG_spec, kvG_spec, kvG_spec, qG_spec, rowG_spec,
+                  rowG_spec],
+        out_specs=[kvG_spec, kvG_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((batch * kv_heads, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((batch * kv_heads, s_k, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -381,11 +401,6 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
 
     shape = (batch, heads, s_q, d)
     kshape = (batch, kv_heads, s_k, d)
-    if group > 1:
-        # dk/dv were produced per q-head (grid runs over all H); each kv
-        # head's gradient is the sum over its query group.
-        dk = dk.reshape(batch, kv_heads, group, s_k, d).sum(axis=2)
-        dv = dv.reshape(batch, kv_heads, group, s_k, d).sum(axis=2)
     return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 

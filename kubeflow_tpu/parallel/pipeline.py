@@ -59,6 +59,41 @@ def pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
     return num_microbatches + num_stages - 1
 
 
+def _validate(act_spec: P, output: str, num_microbatches: int,
+              num_stages: int) -> None:
+    """Shared public-contract checks for both schedules."""
+    if act_spec and act_spec[0] is not None:
+        raise ValueError(
+            "activation_spec dim 0 is the microbatch axis and must be "
+            f"unsharded, got {act_spec}"
+        )
+    if output not in ("replicated", "sharded"):
+        raise ValueError(f"output must be replicated|sharded, got {output}")
+    if output == "sharded" and num_microbatches % num_stages:
+        raise ValueError(
+            f"sharded output needs num_microbatches={num_microbatches} "
+            f"divisible by pp={num_stages}"
+        )
+
+
+def _microbatched(pipeline_fn, num_microbatches: int):
+    """Shared (B, ...) <-> (M, mb, ...) wrapper for both schedules."""
+
+    def run(stage_params, x):
+        if x.shape[0] % num_microbatches:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        xm = x.reshape(
+            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
+        )
+        ym = pipeline_fn(stage_params, xm)
+        return ym.reshape(x.shape[0], *ym.shape[2:])
+
+    return run
+
+
 def _out_spec(act_spec: P, axis: str, output: str) -> P:
     """out_specs for the schedule result: microbatch dim 0 sharded over
     ``axis`` in sharded mode, act_spec otherwise."""
@@ -144,8 +179,13 @@ def gpipe(
     - ``x``: activations ``(B, ...)`` with B divisible by
       ``num_microbatches``. Batch may additionally be dp-sharded — dp
       stays an automatic axis and composes transparently.
-    - ``y``: ``(B, ...)``, the stack's output, replicated over ``axis``
-      (an explicit masked-psum broadcast from the last stage).
+    - ``y``: ``(B, ...)``, the stack's output. ``output="replicated"``
+      (default) hands it back whole on every stage (masked-psum
+      broadcast, ~2x the link time); ``output="sharded"`` leaves the
+      microbatch dim SHARDED over ``axis`` via one psum_scatter — the
+      minimal redistribution — so downstream global-array code (head,
+      loss) runs on M/P microbatches per stage. Requires
+      num_microbatches divisible by P.
 
     ``activation_spec``/``extra_manual_axes`` compose pipelining with a
     second manual-collective dimension in the SAME region (no shard_map
@@ -164,18 +204,7 @@ def gpipe(
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
     act_spec = P() if activation_spec is None else activation_spec
-    if act_spec and act_spec[0] is not None:
-        raise ValueError(
-            "activation_spec dim 0 is the microbatch axis and must be "
-            f"unsharded, got {act_spec}"
-        )
-    if output not in ("replicated", "sharded"):
-        raise ValueError(f"output must be replicated|sharded, got {output}")
-    if output == "sharded" and num_microbatches % num_stages:
-        raise ValueError(
-            f"sharded output needs num_microbatches={num_microbatches} "
-            f"divisible by pp={num_stages}"
-        )
+    _validate(act_spec, output, num_microbatches, num_stages)
 
     @partial(
         jax.shard_map,
@@ -198,19 +227,7 @@ def gpipe(
             stage_fn, params, xm, idx, axis, num_stages, output
         )
 
-    def run(stage_params, x):
-        if x.shape[0] % num_microbatches:
-            raise ValueError(
-                f"batch {x.shape[0]} not divisible by "
-                f"num_microbatches={num_microbatches}"
-            )
-        xm = x.reshape(
-            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
-        )
-        ym = run_sharded(stage_params, xm)
-        return ym.reshape(x.shape[0], *ym.shape[2:])
-
-    return run
+    return _microbatched(run_sharded, num_microbatches)
 
 
 def _1f1b_tables(num_microbatches: int, num_stages: int):
@@ -276,18 +293,7 @@ def one_f_one_b(
     """
     num_stages = mesh.shape[axis]
     act_spec = P() if activation_spec is None else activation_spec
-    if act_spec and act_spec[0] is not None:
-        raise ValueError(
-            "activation_spec dim 0 is the microbatch axis and must be "
-            f"unsharded, got {act_spec}"
-        )
-    if output not in ("replicated", "sharded"):
-        raise ValueError(f"output must be replicated|sharded, got {output}")
-    if output == "sharded" and num_microbatches % num_stages:
-        raise ValueError(
-            f"sharded output needs num_microbatches={num_microbatches} "
-            f"divisible by pp={num_stages}"
-        )
+    _validate(act_spec, output, num_microbatches, num_stages)
     manual_axes = frozenset({axis, *extra_manual_axes})
     fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
     rev_perm = [(i + 1, i) for i in range(num_stages - 1)]
@@ -431,20 +437,7 @@ def one_f_one_b(
         return bwd_sharded(stage_params, xm, ym_bar)
 
     pipeline.defvjp(pipeline_fwd, pipeline_bwd)
-
-    def run(stage_params, x):
-        if x.shape[0] % num_microbatches:
-            raise ValueError(
-                f"batch {x.shape[0]} not divisible by "
-                f"num_microbatches={num_microbatches}"
-            )
-        xm = x.reshape(
-            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
-        )
-        ym = pipeline(stage_params, xm)
-        return ym.reshape(x.shape[0], *ym.shape[2:])
-
-    return run
+    return _microbatched(pipeline, num_microbatches)
 
 
 def stage_stack(params, num_stages: int):
