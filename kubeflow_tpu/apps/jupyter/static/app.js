@@ -44,7 +44,7 @@
     var stopped = nb.stopped;
     div.appendChild(KF.el('button', {
       'class': 'kf-btn kf-btn-ghost',
-      text: stopped ? 'Start' : 'Stop',
+      text: KF.t(stopped ? 'Start' : 'Stop'),
       onclick: function () {
         KF.send('PATCH', apiBase() + '/notebooks/' +
           encodeURIComponent(nb.name), { stopped: !stopped })
@@ -53,7 +53,7 @@
       },
     }));
     div.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-danger', text: 'Delete',
+      'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
       onclick: function () {
         KF.confirm('Delete notebook "' + nb.name + '"? Attached PVCs are ' +
           'kept.', function () {
@@ -79,9 +79,12 @@
     },
     { name: 'Image', render: function (nb) { return KF.shortImage(nb.image); } },
     { name: 'TPU', render: tpuChip },
-    { name: 'CPU', render: function (nb) { return nb.cpu || ''; } },
-    { name: 'Memory', render: function (nb) { return nb.memory || ''; } },
-    { name: 'Age', render: function (nb) { return KF.age(nb.age); } },
+    { name: 'CPU', value: function (nb) { return KF.quantity(nb.cpu); },
+      render: function (nb) { return nb.cpu || ''; } },
+    { name: 'Memory', value: function (nb) { return KF.quantity(nb.memory); },
+      render: function (nb) { return nb.memory || ''; } },
+    { name: 'Age', value: function (nb) { return KF.ageValue(nb.age); },
+      render: function (nb) { return KF.age(nb.age); } },
     { name: '', render: actions },
   ];
 
@@ -119,7 +122,7 @@
        ['Message', d.processed.status.message || '—']]);
     var pre = KF.el('pre', { 'class': 'kf-yaml' });
     pre.textContent = JSON.stringify(d.notebook, null, 2);
-    pane.appendChild(KF.el('h3', { text: 'Raw resource' }));
+    pane.appendChild(KF.el('h3', { text: KF.t('Raw resource') }));
     pane.appendChild(pre);
   }
 
@@ -147,7 +150,7 @@
       if (!pods.length) {
         pane.appendChild(KF.el('div', {
           'class': 'kf-empty',
-          text: 'No pods yet — the StatefulSet has not started any.',
+          text: KF.t('No pods yet — the StatefulSet has not started any.'),
         }));
         return;
       }
@@ -159,7 +162,7 @@
         return KF.el('option', { value: p, text: p });
       }));
       // Multi-host slices have one pod per rank; default to rank 0.
-      pane.appendChild(KF.el('label', { text: 'Pod' }));
+      pane.appendChild(KF.el('label', { text: KF.t('Pod') }));
       pane.appendChild(select);
       pane.appendChild(viewerBox);
       function mount(pod) {
@@ -187,7 +190,7 @@
         var el = document.getElementById('details');
         el.innerHTML = '';
         el.appendChild(KF.el('button', {
-          'class': 'kf-btn kf-btn-ghost', text: '← Back',
+          'class': 'kf-btn kf-btn-ghost', text: KF.t('← Back'),
           onclick: function () {
             detailsSession++;
             if (activeLogViewer) { activeLogViewer.stop(); activeLogViewer = null; }
@@ -219,14 +222,14 @@
     root.innerHTML = '';
     var f = {};
 
-    root.appendChild(KF.el('h2', { text: 'New Notebook' }));
+    root.appendChild(KF.el('h2', { text: KF.t('New Notebook') }));
 
-    root.appendChild(KF.el('label', { text: 'Name' }));
+    root.appendChild(KF.el('label', { text: KF.t('Name') }));
     f.name = KF.el('input', { type: 'text', placeholder: 'my-notebook' });
     root.appendChild(f.name);
 
     // Image: admin options + optional custom.
-    root.appendChild(KF.el('label', { text: 'Image' }));
+    root.appendChild(KF.el('label', { text: KF.t('Image') }));
     var img = section('image');
     f.image = KF.el('select', {},
       (img.options || [img.value]).filter(Boolean).map(function (o) {
@@ -238,7 +241,7 @@
     if (state.config.allowCustomImage !== false) {
       var customRow = KF.el('label', {}, [
         f.customCheck = KF.el('input', { type: 'checkbox' }),
-        KF.el('span', { text: ' Custom image' }),
+        KF.el('span', { text: KF.t(' Custom image') }),
       ]);
       root.appendChild(customRow);
       f.customImage = KF.el('input', {
@@ -254,12 +257,12 @@
     // CPU / memory.
     var row = KF.el('div', { 'class': 'kf-row' });
     var cpuDiv = KF.el('div', {});
-    cpuDiv.appendChild(KF.el('label', { text: 'CPU' }));
+    cpuDiv.appendChild(KF.el('label', { text: KF.t('CPU') }));
     f.cpu = KF.el('input', { type: 'text', value: section('cpu').value || '0.5' });
     if (section('cpu').readOnly) f.cpu.setAttribute('disabled', '');
     cpuDiv.appendChild(f.cpu);
     var memDiv = KF.el('div', {});
-    memDiv.appendChild(KF.el('label', { text: 'Memory' }));
+    memDiv.appendChild(KF.el('label', { text: KF.t('Memory') }));
     f.memory = KF.el('input', {
       type: 'text', value: section('memory').value || '1.0Gi',
     });
@@ -270,9 +273,9 @@
     root.appendChild(row);
 
     // TPU preset picker (replaces the reference's GPU vendor/count).
-    root.appendChild(KF.el('label', { text: 'TPU slice' }));
+    root.appendChild(KF.el('label', { text: KF.t('TPU slice') }));
     f.tpu = KF.el('select', {}, [
-      KF.el('option', { value: 'none', text: 'None (CPU only)' }),
+      KF.el('option', { value: 'none', text: KF.t('None (CPU only)') }),
     ].concat(state.presets.map(function (p) {
       var label = p.shorthand + ' — ' + p.chips + ' chip' +
         (p.chips > 1 ? 's' : '') + ', topology ' + p.topology +
@@ -304,7 +307,7 @@
       if (!options.length) { return null; }
       root.appendChild(KF.el('label', { text: labelText }));
       var sel = KF.el('select', {}, [
-        KF.el('option', { value: 'none', text: 'None' }),
+        KF.el('option', { value: 'none', text: KF.t('None') }),
       ].concat(options.map(function (o) {
         return KF.el('option', {
           value: o[idField],
@@ -321,7 +324,7 @@
       'tolerationGroup', 'groupKey', 'Tolerations');
 
     // PodDefault configurations.
-    root.appendChild(KF.el('label', { text: 'Configurations' }));
+    root.appendChild(KF.el('label', { text: KF.t('Configurations') }));
     f.pdBox = KF.el('div', {});
     root.appendChild(f.pdBox);
     f.pdChecks = [];
@@ -337,7 +340,7 @@
       });
       if (!(d.poddefaults || []).length) {
         f.pdBox.appendChild(KF.el('span', {
-          'class': 'kf-help', text: 'No PodDefaults in this namespace.',
+          'class': 'kf-help', text: KF.t('No PodDefaults in this namespace.'),
         }));
       }
     }).catch(function () { /* optional section */ });
@@ -362,7 +365,7 @@
     // Submit / cancel.
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
     var submit = KF.el('button', {
-      'class': 'kf-btn', text: 'Create',
+      'class': 'kf-btn', text: KF.t('Create'),
       onclick: function () {
         var body = {
           name: f.name.value.trim(),
@@ -393,7 +396,7 @@
     });
     bar.appendChild(submit);
     bar.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('Cancel'),
       onclick: function () { show(listView); },
     }));
     root.appendChild(bar);

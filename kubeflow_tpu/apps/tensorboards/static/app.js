@@ -40,7 +40,7 @@
     var el = document.getElementById('details');
     el.innerHTML = '';
     el.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: '← Back',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('← Back'),
       onclick: function () { show(listView); },
     }));
     el.appendChild(KF.el('h2', { text: tb.name }));
@@ -90,13 +90,14 @@
       },
     },
     { name: 'Logs path', render: function (tb) { return tb.logspath; } },
-    { name: 'Age', render: function (tb) { return KF.age(tb.age); } },
+    { name: 'Age', value: function (tb) { return KF.ageValue(tb.age); },
+      render: function (tb) { return KF.age(tb.age); } },
     {
       name: '', render: function (tb) {
         var div = KF.el('div', { 'class': 'kf-actions' });
         div.appendChild(KF.actionLink('Connect', connectUrl(tb), tb.ready));
         div.appendChild(KF.el('button', {
-          'class': 'kf-btn kf-btn-danger', text: 'Delete',
+          'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
           onclick: function () {
             KF.confirm('Delete TensorBoard "' + tb.name + '"?', function () {
               KF.send('DELETE', apiBase() + '/tensorboards/' +
@@ -129,9 +130,9 @@
     var logspath = KF.el('input', {
       type: 'text', placeholder: 'pvc://my-volume/logs or gs://bucket/logs',
     });
-    root.appendChild(KF.el('label', { text: 'Name' }));
+    root.appendChild(KF.el('label', { text: KF.t('Name') }));
     root.appendChild(name);
-    root.appendChild(KF.el('label', { text: 'Logs path' }));
+    root.appendChild(KF.el('label', { text: KF.t('Logs path') }));
     root.appendChild(logspath);
     root.appendChild(KF.el('div', {
       'class': 'kf-help',
@@ -140,7 +141,7 @@
     }));
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
     var submit = KF.el('button', {
-      'class': 'kf-btn', text: 'Create',
+      'class': 'kf-btn', text: KF.t('Create'),
       onclick: function () {
         KF.whileBusy(submit, KF.send('POST', apiBase() + '/tensorboards', {
           name: name.value.trim(),
@@ -154,7 +155,7 @@
     });
     bar.appendChild(submit);
     bar.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('Cancel'),
       onclick: function () { show(listView); },
     }));
     root.appendChild(bar);

@@ -40,7 +40,7 @@
     var el = document.getElementById('details');
     el.innerHTML = '';
     el.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: '← Back',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('← Back'),
       onclick: function () { show(listView); },
     }));
     el.appendChild(KF.el('h2', { text: pvc.name }));
@@ -100,7 +100,7 @@
     var div = KF.el('div', { 'class': 'kf-actions' });
     div.appendChild(viewerCell(pvc));
     var del = KF.el('button', {
-      'class': 'kf-btn kf-btn-danger', text: 'Delete',
+      'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
       onclick: function () {
         KF.confirm('Delete volume "' + pvc.name + '" and its data?',
           function () {
@@ -174,9 +174,9 @@
         cls.appendChild(KF.el('option', { value: sc, text: sc }));
       });
     }).catch(function () { /* optional */ });
-    root.appendChild(KF.el('label', { text: 'Name' }));
+    root.appendChild(KF.el('label', { text: KF.t('Name') }));
     root.appendChild(name);
-    root.appendChild(KF.el('label', { text: 'Size' }));
+    root.appendChild(KF.el('label', { text: KF.t('Size') }));
     root.appendChild(size);
     root.appendChild(KF.el('label', { text: 'Access mode' }));
     root.appendChild(mode);
@@ -184,7 +184,7 @@
     root.appendChild(cls);
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
     var submit = KF.el('button', {
-      'class': 'kf-btn', text: 'Create',
+      'class': 'kf-btn', text: KF.t('Create'),
       onclick: function () {
         KF.whileBusy(submit, KF.send('POST', apiBase() + '/pvcs', {
           name: name.value.trim(),
@@ -200,7 +200,7 @@
     });
     bar.appendChild(submit);
     bar.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Cancel',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('Cancel'),
       onclick: function () { show(listView); },
     }));
     root.appendChild(bar);

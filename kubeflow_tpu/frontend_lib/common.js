@@ -10,6 +10,84 @@
 
   var KF = {};
 
+  // ---- i18n (reference ships per-app i18n/ catalogs + messages.xlf;
+  // same model here: English source strings are the catalog keys,
+  // catalogs register per locale, lib components translate their own
+  // chrome so apps get table/tab/button translation for free) ----
+  function detectLocale() {
+    var m = (global.location ? global.location.search : '')
+      .match(/[?&]lang=([A-Za-z-]+)/);
+    if (m) {
+      try { global.localStorage.setItem('kf.locale', m[1]); } catch (e) {}
+      return m[1];
+    }
+    try {
+      var saved = global.localStorage.getItem('kf.locale');
+      if (saved) return saved;
+    } catch (e) {}
+    return ((global.navigator || {}).language || 'en').split('-')[0];
+  }
+
+  KF.i18n = {
+    locale: detectLocale(),
+    catalogs: {},
+    register: function (locale, catalog) {
+      var cat = KF.i18n.catalogs[locale] ||
+        (KF.i18n.catalogs[locale] = {});
+      Object.keys(catalog).forEach(function (k) { cat[k] = catalog[k]; });
+    },
+    // Translate elements marked <el data-i18n> (static HTML shells).
+    apply: function (root) {
+      var nodes = (root || document).querySelectorAll('[data-i18n]');
+      Array.prototype.forEach.call(nodes, function (node) {
+        node.textContent = KF.t(node.textContent.trim());
+      });
+    },
+  };
+
+  // t("Delete {name}?", {name: "nb"}) — English text IS the key;
+  // unknown keys fall through untranslated, so partial catalogs stay
+  // safe and the default locale needs no catalog at all.
+  KF.t = function (msg, params) {
+    var loc = KF.i18n.locale;
+    // Region-qualified tags (fr-CA) fall back to the base language.
+    var cat = KF.i18n.catalogs[loc] ||
+      KF.i18n.catalogs[loc.split('-')[0]] || {};
+    var out = cat[msg] || msg;
+    Object.keys(params || {}).forEach(function (k) {
+      out = out.split('{' + k + '}').join(params[k]);
+    });
+    return out;
+  };
+
+  // Locale picker (en + every registered catalog); persists and
+  // reloads so every component re-renders translated.
+  KF.localePicker = function (mount) {
+    var locales = ['en'].concat(Object.keys(KF.i18n.catalogs));
+    var select = KF.el('select', {
+      'class': 'kf-ns-select', 'aria-label': 'Language',
+      onchange: function () {
+        try { global.localStorage.setItem('kf.locale', select.value); }
+        catch (e) {}
+        var url = global.location.href
+          .replace(/([?&])lang=[A-Za-z-]*(&?)/, function (_, pre, post) {
+            return post ? pre : '';
+          });
+        url += (url.indexOf('?') < 0 ? '?' : '&') + 'lang=' + select.value;
+        global.location.href = url;
+      },
+    }, locales.map(function (loc) {
+      var opt = KF.el('option', { value: loc, text: loc });
+      if (loc === KF.i18n.locale ||
+          loc === KF.i18n.locale.split('-')[0]) {
+        opt.setAttribute('selected', '');
+      }
+      return opt;
+    }));
+    mount.appendChild(select);
+    return select;
+  };
+
   // ---- REST client (CSRF double-submit + error envelope) ----
   function csrfToken() {
     var m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]*)/);
@@ -69,30 +147,148 @@
     return span;
   };
 
-  // ---- resource table (reference lib/resource-table) ----
-  // columns: [{name, render(row) -> Node|string}], actions optional.
-  KF.table = function (container, columns, rows, emptyMessage) {
+  // ---- resource table (reference lib/resource-table with its
+  // sort/filter ergonomics) ----
+  // columns: [{name, render(row) -> Node|string, value(row)?}]. Click a
+  // header to sort (text-aware: numeric when both sides parse); the
+  // filter box matches any cell, case-insensitive. Sort/filter state is
+  // keyed on the container so the pollers' re-renders preserve it, and
+  // the filter input keeps focus/caret across re-render.
+  KF.table = function (container, columns, rows, emptyMessage, opts) {
+    opts = opts || {};
+    var state = container._kfTable ||
+      (container._kfTable = { sortCol: -1, sortDir: 1, query: '' });
+    var hadFocus = container._kfFilter &&
+      document.activeElement === container._kfFilter;
+    var caret = hadFocus ? container._kfFilter.selectionStart : 0;
     container.innerHTML = '';
+
+    // A column takes part in sort/filter when it names itself or
+    // supplies value() — the unnamed actions column ('Connect Stop
+    // Delete…' on every row) must not make every query match.
+    function comparable(c) {
+      return Boolean(c.name || c.value);
+    }
+
+    // Cell texts computed ONCE per render (render() builds real DOM
+    // subtrees; calling it inside an n·log n comparator would allocate
+    // thousands of discarded nodes per keystroke).
+    var texts = rows.map(function (row) {
+      return columns.map(function (c) {
+        if (!comparable(c)) return '';
+        if (c.value !== undefined) return String(c.value(row));
+        var cell = c.render(row);
+        if (typeof cell === 'string') return cell;
+        return cell ? cell.textContent : '';
+      });
+    });
+    var order = rows.map(function (_, i) { return i; });
+
+    // Keep the filter box whenever there is a query to clear — rows
+    // shrinking to one must not strand a stale filter.
+    if (opts.filterable !== false && (rows.length > 1 || state.query)) {
+      var input = KF.el('input', {
+        'class': 'kf-filter', type: 'search',
+        placeholder: KF.t('Filter'),
+        value: state.query,
+      });
+      input.addEventListener('input', function () {
+        state.query = input.value;
+        KF.table(container, columns, rows, emptyMessage, opts);
+      });
+      container.appendChild(input);
+      container._kfFilter = input;
+      if (hadFocus) {
+        input.focus();
+        try { input.setSelectionRange(caret, caret); } catch (e) {}
+      }
+    }
+
+    if (state.query) {
+      var q = state.query.toLowerCase();
+      order = order.filter(function (i) {
+        return texts[i].some(function (t) {
+          return t.toLowerCase().indexOf(q) >= 0;
+        });
+      });
+    }
+    if (state.sortCol >= 0 && state.sortCol < columns.length) {
+      var sc = state.sortCol;
+      order = order.slice().sort(function (a, b) {
+        var ta = texts[a][sc], tb = texts[b][sc];
+        var na = parseFloat(ta), nb = parseFloat(tb);
+        var cmp = (!isNaN(na) && !isNaN(nb) && String(na) === ta &&
+                   String(nb) === tb)
+          ? na - nb : ta.localeCompare(tb);
+        return cmp * state.sortDir;
+      });
+    }
+
     if (!rows.length) {
-      container.appendChild(
-        KF.el('div', { 'class': 'kf-empty', text: emptyMessage || 'Nothing here yet.' }));
+      container.appendChild(KF.el('div', {
+        'class': 'kf-empty',
+        text: KF.t(emptyMessage || 'Nothing here yet.'),
+      }));
       return;
     }
-    var thead = KF.el('tr', {}, columns.map(function (c) {
-      return KF.el('th', { text: c.name });
+
+    var sortable = opts.sortable !== false;
+    var thead = KF.el('tr', {}, columns.map(function (c, i) {
+      var arrow = state.sortCol === i
+        ? (state.sortDir > 0 ? ' ▲' : ' ▼') : '';
+      var th = KF.el('th', { text: KF.t(c.name) + arrow });
+      if (sortable && comparable(c)) {
+        th.setAttribute('class', 'kf-th-sort');
+        th.setAttribute('role', 'button');
+        th.addEventListener('click', function () {
+          if (state.sortCol === i) state.sortDir = -state.sortDir;
+          else { state.sortCol = i; state.sortDir = 1; }
+          KF.table(container, columns, rows, emptyMessage, opts);
+        });
+      }
+      return th;
     }));
-    var body = rows.map(function (row) {
+    var body = order.map(function (i) {
       return KF.el('tr', {}, columns.map(function (c) {
-        var cell = c.render(row);
+        var cell = c.render(rows[i]);
         var td = KF.el('td', {});
         if (typeof cell === 'string') td.textContent = cell;
         else if (cell) td.appendChild(cell);
         return td;
       }));
     });
+    if (!body.length) {
+      container.appendChild(
+        KF.el('table', { 'class': 'kf-table' },
+          [KF.el('thead', {}, [thead])]));
+      container.appendChild(KF.el('div', {
+        'class': 'kf-empty', text: KF.t('No rows match the filter.'),
+      }));
+      return;
+    }
     container.appendChild(
       KF.el('table', { 'class': 'kf-table' },
         [KF.el('thead', {}, [thead]), KF.el('tbody', {}, body)]));
+  };
+
+  // k8s resource.Quantity -> number (for column value() extractors:
+  // '500m' CPU, '2Gi' memory sort numerically, not lexically).
+  KF.quantity = function (q) {
+    var m = String(q || '').match(/^([0-9.]+)\s*([A-Za-z]*)$/);
+    if (!m) return 0;
+    var mult = {
+      m: 1e-3, k: 1e3, K: 1e3, M: 1e6, G: 1e9, T: 1e12, P: 1e15,
+      Ki: 1024, Mi: Math.pow(1024, 2), Gi: Math.pow(1024, 3),
+      Ti: Math.pow(1024, 4), Pi: Math.pow(1024, 5),
+    }[m[2]];
+    return parseFloat(m[1]) * (mult || 1);
+  };
+
+  // Age column value() extractor: epoch seconds sort chronologically
+  // where the rendered '45s/3m/10h/2d' strings would sort lexically.
+  KF.ageValue = function (timestamp) {
+    var t = Date.parse(timestamp || '');
+    return isNaN(t) ? 0 : Math.floor(t / 1000);
   };
 
   // ---- polling with visibility pause (reference lib/poller) ----
@@ -166,7 +362,7 @@
       pane.hidden = true;
       panes.push(pane);
       var btn = KF.el('button', {
-        'class': 'kf-tab', text: tab.name, role: 'tab',
+        'class': 'kf-tab', text: KF.t(tab.name), role: 'tab',
         onclick: function () { activate(i); },
       });
       buttons.push(btn);
@@ -197,7 +393,9 @@
       { name: 'Reason', render: function (c) { return c.reason || ''; } },
       { name: 'Message', render: function (c) { return c.message || ''; } },
       {
-        name: 'Last transition', render: function (c) {
+        name: 'Last transition',
+        value: function (c) { return KF.ageValue(c.lastTransitionTime); },
+        render: function (c) {
           return KF.age(c.lastTransitionTime) || '';
         },
       },
@@ -234,7 +432,9 @@
         },
       },
       {
-        name: 'Last seen', render: function (ev) {
+        name: 'Last seen',
+        value: function (ev) { return KF.ageValue(ev.lastTimestamp); },
+        render: function (ev) {
           return KF.age(ev.lastTimestamp);
         },
       },
@@ -252,7 +452,7 @@
       }).catch(function (err) { KF.snack(err.message, true); });
     }
     pane.appendChild(KF.el('button', {
-      'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('Refresh'),
       onclick: load,
     }));
     pane.appendChild(box);
@@ -271,7 +471,7 @@
 
     function render(lines) {
       lastText = (lines || []).join('\n');
-      pre.textContent = lastText || '(no log output yet)';
+      pre.textContent = lastText || KF.t('(no log output yet)');
       if (follow.checked) pre.scrollTop = pre.scrollHeight;
     }
 
@@ -283,14 +483,14 @@
 
     var bar = KF.el('div', { 'class': 'kf-actions kf-logs-bar' }, [
       KF.el('button', {
-        'class': 'kf-btn kf-btn-ghost', text: 'Refresh',
+        'class': 'kf-btn kf-btn-ghost', text: KF.t('Refresh'),
         onclick: load,
       }),
       KF.el('label', {}, [
-        follow, KF.el('span', { text: ' Follow' }),
+        follow, KF.el('span', { text: ' ' + KF.t('Follow') }),
       ]),
       KF.el('button', {
-        'class': 'kf-btn kf-btn-ghost', text: 'Download',
+        'class': 'kf-btn kf-btn-ghost', text: KF.t('Download'),
         onclick: function () {
           var blob = new Blob([lastText], { type: 'text/plain' });
           var a = KF.el('a', {
@@ -325,7 +525,7 @@
   KF.detailsList = function (container, pairs) {
     var dl = KF.el('dl', { 'class': 'kf-details' });
     (pairs || []).forEach(function (pair) {
-      dl.appendChild(KF.el('dt', { text: pair[0] }));
+      dl.appendChild(KF.el('dt', { text: KF.t(pair[0]) }));
       dl.appendChild(KF.el('dd', { text: String(pair[1]) }));
     });
     container.appendChild(dl);
@@ -354,6 +554,7 @@
   // otherwise (pointer-events CSS alone still allows keyboard
   // activation).
   KF.actionLink = function (text, href, enabled) {
+    text = KF.t(text);
     if (enabled) {
       return KF.el('a', {
         'class': 'kf-btn kf-btn-ghost', text: text,
@@ -374,6 +575,16 @@
       function (v) { button.removeAttribute('disabled'); return v; },
       function (e) { button.removeAttribute('disabled'); throw e; });
   };
+
+  // Translate static HTML shells (<el data-i18n>) once the DOM and
+  // any catalog <script>s have loaded.
+  if (global.document && document.addEventListener) {
+    document.addEventListener('DOMContentLoaded', function () {
+      KF.i18n.apply(document);
+      var lm = document.getElementById('locale-mount');
+      if (lm) KF.localePicker(lm);
+    });
+  }
 
   global.KF = KF;
 })(window);

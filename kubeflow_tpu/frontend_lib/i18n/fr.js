@@ -1,0 +1,73 @@
+/* French catalog — the proof-of-concept locale (the reference ships
+ * crud-web-apps/jupyter/frontend/i18n/fr). English source strings are
+ * the keys (common.js KF.t); missing keys fall through to English, so
+ * the catalog can grow incrementally. */
+(function () {
+  'use strict';
+  window.KF.i18n.register('fr', {
+    // ---- lib chrome (frontend_lib/common.js) ----
+    'Filter': 'Filtrer',
+    'Refresh': 'Actualiser',
+    'Download': 'Télécharger',
+    'Follow': 'Suivre',
+    'Nothing here yet.': 'Rien ici pour le moment.',
+    'No rows match the filter.': 'Aucune ligne ne correspond au filtre.',
+    '(no log output yet)': '(pas encore de journal)',
+    'No conditions reported.': 'Aucune condition signalée.',
+    'No events for this resource.': 'Aucun événement pour cette ressource.',
+    // ---- shared table / details columns ----
+    'Name': 'Nom',
+    'Status': 'État',
+    'Type': 'Type',
+    'Reason': 'Motif',
+    'Message': 'Message',
+    'Last transition': 'Dernière transition',
+    'Object': 'Objet',
+    'Count': 'Nombre',
+    'Last seen': 'Vu pour la dernière fois',
+    'Age': 'Âge',
+    'Image': 'Image',
+    'CPU': 'CPU',
+    'Memory': 'Mémoire',
+    'TPU': 'TPU',
+    'TPU slice': 'Tranche TPU',
+    'Overview': 'Aperçu',
+    'Conditions': 'Conditions',
+    'Events': 'Événements',
+    'Logs': 'Journaux',
+    'Logs path': 'Chemin des journaux',
+    'Size': 'Taille',
+    'Mode': 'Mode',
+    'Class': 'Classe',
+    'Used by': 'Utilisé par',
+    // ---- toolbar shells (data-i18n) ----
+    'Notebooks': 'Notebooks',
+    'Volumes': 'Volumes',
+    'TensorBoards': 'TensorBoards',
+    '+ New Notebook': '+ Nouveau notebook',
+    '+ New Volume': '+ Nouveau volume',
+    '+ New TensorBoard': '+ Nouveau TensorBoard',
+    // ---- actions ----
+    'Connect': 'Se connecter',
+    'Start': 'Démarrer',
+    'Stop': 'Arrêter',
+    'Delete': 'Supprimer',
+    'Create': 'Créer',
+    'Cancel': 'Annuler',
+    'New Notebook': 'Nouveau notebook',
+    '← Back': '← Retour',
+    'Raw resource': 'Ressource brute',
+    'Pod': 'Pod',
+    'Configurations': 'Configurations',
+    'None (CPU only)': 'Aucune (CPU uniquement)',
+    'None': 'Aucun',
+    'Custom image': 'Image personnalisée',
+    ' Custom image': ' Image personnalisée',
+    'Create workspace volume': 'Créer un volume de travail',
+    'Shared memory (/dev/shm)': 'Mémoire partagée (/dev/shm)',
+    'No PodDefaults in this namespace.':
+      'Aucun PodDefault dans cet espace de noms.',
+    'No pods yet — the StatefulSet has not started any.':
+      'Pas encore de pods — le StatefulSet n\'en a démarré aucun.',
+  });
+})();

@@ -65,3 +65,58 @@ def test_stop_button_sets_annotation(page, seeded_jwa):
     )
     nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
     assert "kubeflow-resource-stopped" in nb["metadata"]["annotations"]
+
+
+def test_locale_switch_renders_french(page, seeded_jwa):
+    """The i18n layer (reference ships i18n/fr): ?lang=fr must
+    translate the static shell (data-i18n), the table headers (KF.t in
+    KF.table) and the action links."""
+    url, _ = seeded_jwa
+    page.goto(url + "?lang=fr")
+    page.locator("#nb-table tbody tr").wait_for(timeout=10_000)
+    assert "+ Nouveau notebook" in page.locator("#new-btn").inner_text()
+    headers = page.locator("#nb-table th").all_inner_texts()
+    assert any("Nom" in h for h in headers)
+    assert any("État" in h for h in headers)
+    # Action link translated too.
+    assert page.locator("a.kf-btn", has_text="Se connecter").count() == 1
+    # The locale picker exists and is set to fr.
+    assert page.locator("#locale-mount select").input_value() == "fr"
+
+
+def test_table_sort_and_filter(page, seeded_jwa):
+    """resource-table ergonomics (reference lib resource-table):
+    clicking a header sorts (toggling direction), the filter box
+    narrows rows, and state survives the poller's re-render."""
+    url, api = seeded_jwa
+    # A second notebook so ordering is observable.
+    api.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "aaa-nb", "namespace": "alice",
+                     "creationTimestamp": "2026-07-30T07:00:00Z"},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": "aaa-nb", "image": "img:latest"}]}}},
+        "status": {"readyReplicas": 1},
+    })
+    page.goto(url)
+    rows = page.locator("#nb-table tbody tr")
+    page.wait_for_function(
+        "document.querySelectorAll('#nb-table tbody tr').length >= 2"
+    )
+
+    def first_cell():
+        return rows.first.locator("td").nth(1).inner_text()
+
+    # Sort by Name ascending, then toggle to descending.
+    name_th = page.locator("#nb-table th", has_text="Name")
+    name_th.click()
+    assert first_cell() == "aaa-nb"
+    page.locator("#nb-table th", has_text="Name").click()
+    assert first_cell() == "demo-nb"
+
+    # Filter narrows to the matching row.
+    page.locator("#nb-table .kf-filter").fill("aaa")
+    page.wait_for_function(
+        "document.querySelectorAll('#nb-table tbody tr').length === 1"
+    )
+    assert "aaa-nb" in rows.first.inner_text()
