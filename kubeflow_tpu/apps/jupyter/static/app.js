@@ -55,8 +55,8 @@
     div.appendChild(KF.el('button', {
       'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
       onclick: function () {
-        KF.confirm('Delete notebook "' + nb.name + '"? Attached PVCs are ' +
-          'kept.', function () {
+        KF.confirm(KF.t('Delete notebook "{name}"? Attached PVCs are kept.',
+          { name: nb.name }), function () {
           KF.send('DELETE', apiBase() + '/notebooks/' +
             encodeURIComponent(nb.name))
             .then(refresh)
@@ -241,7 +241,7 @@
     if (state.config.allowCustomImage !== false) {
       var customRow = KF.el('label', {}, [
         f.customCheck = KF.el('input', { type: 'checkbox' }),
-        KF.el('span', { text: KF.t(' Custom image') }),
+        KF.el('span', { text: ' ' + KF.t('Custom image') }),
       ]);
       root.appendChild(customRow);
       f.customImage = KF.el('input', {
@@ -305,7 +305,7 @@
       var cfg = section(sectionName);
       var options = cfg.options || [];
       if (!options.length) { return null; }
-      root.appendChild(KF.el('label', { text: labelText }));
+      root.appendChild(KF.el('label', { text: KF.t(labelText) }));
       var sel = KF.el('select', {}, [
         KF.el('option', { value: 'none', text: KF.t('None') }),
       ].concat(options.map(function (o) {
@@ -349,7 +349,7 @@
     var ws = section('workspaceVolume');
     root.appendChild(KF.el('label', {}, [
       f.wsCheck = KF.el('input', { type: 'checkbox' }),
-      KF.el('span', { text: ' Create workspace volume' }),
+      KF.el('span', { text: ' ' + KF.t('Create workspace volume') }),
     ]));
     if (ws.value) f.wsCheck.checked = true;
     if (ws.readOnly) f.wsCheck.setAttribute('disabled', '');
@@ -357,7 +357,7 @@
     // shm.
     root.appendChild(KF.el('label', {}, [
       f.shm = KF.el('input', { type: 'checkbox' }),
-      KF.el('span', { text: ' Shared memory (/dev/shm)' }),
+      KF.el('span', { text: ' ' + KF.t('Shared memory (/dev/shm)') }),
     ]));
     if (section('shm').value !== false) f.shm.checked = true;
     if (section('shm').readOnly) f.shm.setAttribute('disabled', '');

@@ -99,7 +99,8 @@
         div.appendChild(KF.el('button', {
           'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
           onclick: function () {
-            KF.confirm('Delete TensorBoard "' + tb.name + '"?', function () {
+            KF.confirm(KF.t('Delete TensorBoard "{name}"?',
+              { name: tb.name }), function () {
               KF.send('DELETE', apiBase() + '/tensorboards/' +
                 encodeURIComponent(tb.name))
                 .then(refresh)

@@ -102,8 +102,8 @@
     var del = KF.el('button', {
       'class': 'kf-btn kf-btn-danger', text: KF.t('Delete'),
       onclick: function () {
-        KF.confirm('Delete volume "' + pvc.name + '" and its data?',
-          function () {
+        KF.confirm(KF.t('Delete volume "{name}" and its data?',
+          { name: pvc.name }), function () {
             KF.send('DELETE', apiBase() + '/pvcs/' +
               encodeURIComponent(pvc.name))
               .then(refresh)
@@ -136,7 +136,8 @@
         });
       },
     },
-    { name: 'Size', render: function (pvc) { return pvc.size || ''; } },
+    { name: 'Size', value: function (pvc) { return KF.quantity(pvc.size); },
+      render: function (pvc) { return pvc.size || ''; } },
     { name: 'Mode', render: function (pvc) { return pvc.mode || ''; } },
     { name: 'Class', render: function (pvc) { return pvc.class || 'default'; } },
     {
@@ -178,9 +179,9 @@
     root.appendChild(name);
     root.appendChild(KF.el('label', { text: KF.t('Size') }));
     root.appendChild(size);
-    root.appendChild(KF.el('label', { text: 'Access mode' }));
+    root.appendChild(KF.el('label', { text: KF.t('Access mode') }));
     root.appendChild(mode);
-    root.appendChild(KF.el('label', { text: 'Storage class' }));
+    root.appendChild(KF.el('label', { text: KF.t('Storage class') }));
     root.appendChild(cls);
     var bar = KF.el('div', { 'class': 'kf-actions', style: 'margin-top:18px' });
     var submit = KF.el('button', {

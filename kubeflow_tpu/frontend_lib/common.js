@@ -170,17 +170,29 @@
       return Boolean(c.name || c.value);
     }
 
-    // Cell texts computed ONCE per render (render() builds real DOM
-    // subtrees; calling it inside an n·log n comparator would allocate
-    // thousands of discarded nodes per keystroke).
-    var texts = rows.map(function (row) {
+    // Cell texts computed ONCE per render and only when sort/filter
+    // is active (render() builds real DOM subtrees; calling it inside
+    // an n·log n comparator — or on every idle poller tick — would
+    // allocate thousands of discarded nodes). Filtering matches the
+    // RENDERED text (what the user sees: '2Gi', '3m'); sorting uses
+    // value() when given (epoch seconds, parsed quantities).
+    function renderedText(c, row) {
+      var cell = c.render(row);
+      if (typeof cell === 'string') return cell;
+      return cell ? cell.textContent : '';
+    }
+    var texts = !state.query ? [] : rows.map(function (row) {
       return columns.map(function (c) {
-        if (!comparable(c)) return '';
-        if (c.value !== undefined) return String(c.value(row));
-        var cell = c.render(row);
-        if (typeof cell === 'string') return cell;
-        return cell ? cell.textContent : '';
+        return comparable(c) ? renderedText(c, row) : '';
       });
+    });
+    var sortKeys = state.sortCol < 0 ? [] : rows.map(function (row, i) {
+      var c = columns[state.sortCol];
+      if (c.value !== undefined) return String(c.value(row));
+      // Reuse the filter pass's text when present; else render only
+      // the sort column (never the whole row set).
+      return texts.length ? texts[i][state.sortCol]
+                          : renderedText(c, row);
     });
     var order = rows.map(function (_, i) { return i; });
 
@@ -213,9 +225,8 @@
       });
     }
     if (state.sortCol >= 0 && state.sortCol < columns.length) {
-      var sc = state.sortCol;
       order = order.slice().sort(function (a, b) {
-        var ta = texts[a][sc], tb = texts[b][sc];
+        var ta = sortKeys[a], tb = sortKeys[b];
         var na = parseFloat(ta), nb = parseFloat(tb);
         var cmp = (!isNaN(na) && !isNaN(nb) && String(na) === ta &&
                    String(nb) === tb)

@@ -62,9 +62,28 @@
     'None (CPU only)': 'Aucune (CPU uniquement)',
     'None': 'Aucun',
     'Custom image': 'Image personnalisée',
-    ' Custom image': ' Image personnalisée',
     'Create workspace volume': 'Créer un volume de travail',
     'Shared memory (/dev/shm)': 'Mémoire partagée (/dev/shm)',
+    'Namespace': 'Espace de noms',
+    'Created': 'Créé',
+    'Ready': 'Prêt',
+    'Access mode': 'Mode d\'accès',
+    'Storage class': 'Classe de stockage',
+    'Viewer': 'Visionneuse',
+    'Affinity': 'Affinité',
+    'Tolerations': 'Tolérances',
+    'No notebooks in this namespace. Create one to get started.':
+      'Aucun notebook dans cet espace de noms. Créez-en un pour commencer.',
+    'No volumes in this namespace.':
+      'Aucun volume dans cet espace de noms.',
+    'No TensorBoards in this namespace.':
+      'Aucun TensorBoard dans cet espace de noms.',
+    'Delete notebook "{name}"? Attached PVCs are kept.':
+      'Supprimer le notebook « {name} » ? Les PVC attachés sont conservés.',
+    'Delete TensorBoard "{name}"?':
+      'Supprimer le TensorBoard « {name} » ?',
+    'Delete volume "{name}" and its data?':
+      'Supprimer le volume « {name} » et ses données ?',
     'No PodDefaults in this namespace.':
       'Aucun PodDefault dans cet espace de noms.',
     'No pods yet — the StatefulSet has not started any.':

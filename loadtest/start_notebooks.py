@@ -206,7 +206,14 @@ class FakeKubelet:
         self._started: dict[tuple[str, str], float] = {}
         self._pending: dict[tuple[str, str], dict] = {}
         self._done: set[tuple[str, str]] = set()
+        # Informer semantics (list + watch): subscribe FIRST, then seed
+        # from a full list — STS that predate this kubelet must still
+        # come up, and an event arriving between the two is absorbed by
+        # the idempotent pending dict.
         self._watch = api.watch("apps/v1", "StatefulSet")
+        for sts in api.list("apps/v1", "StatefulSet"):
+            key = (sts["metadata"]["namespace"], sts["metadata"]["name"])
+            self._pending[key] = sts
 
     def step(self, now: float) -> int:
         while True:

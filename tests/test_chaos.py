@@ -527,3 +527,75 @@ class TestReconcileSoak:
             f"{len(events)} events for {total} notebooks: unbounded growth"
         )
         assert len(ctrl.queue) == 0
+
+
+class TestProcessTierCullCycle:
+    def test_full_cull_cycle_over_the_wire(self):
+        """The complete cull loop across REAL process boundaries with a
+        REAL HTTP hop into the workload: dev apiserver over the wire, a
+        notebook-controller OS process with culling enabled and
+        KFT_KERNEL_PROBE_URL routed at a live kernel fixture serving
+        idle kernels whose last_activity predates the idle window — the
+        first idleness check must stop the notebook and scale the STS
+        to zero (reference culling_controller.go:202-241 end to end)."""
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        kernel_srv = _KernelServer()
+        kernel_srv.kernels = [{"execution_state": "idle",
+                               "last_activity": "2026-07-28T00:00:00Z"}]
+        metrics_port = free_port()
+        proc = spawn("notebook-controller", server.url, {
+            "METRICS_PORT": str(metrics_port),
+            "ENABLE_CULLING": "1",
+            "CULL_IDLE_TIME": "60",
+            "IDLENESS_CHECK_PERIOD": "1",
+            "KFT_KERNEL_PROBE_URL":
+                f"http://127.0.0.1:{kernel_srv.port}/"
+                "notebook/{namespace}/{name}/api/kernels",
+        })
+        try:
+            wait_http(f"http://127.0.0.1:{metrics_port}/healthz")
+            # Kubelet role first: idleness accounting requires the
+            # rank-0 pod (culling.py:203) and the culler only watches
+            # Notebooks — a pod arriving after the first reconcile
+            # would push the test onto the 60s requeue cadence.
+            fake.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "cull-e2e-0", "namespace": "alice",
+                             "labels": {"notebook-name": "cull-e2e"}},
+                "status": {"phase": "Running"},
+            })
+            fake.create(nb("cull-e2e"))
+            wait_for_sts(fake, "cull-e2e")
+            deadline = time.monotonic() + 30
+            anns = {}
+            while time.monotonic() < deadline:
+                obj = fake.get("kubeflow.org/v1beta1", "Notebook",
+                               "cull-e2e", "alice")
+                anns = obj["metadata"].get("annotations") or {}
+                if "kubeflow-resource-stopped" in anns:
+                    break
+                time.sleep(0.3)
+            assert "kubeflow-resource-stopped" in anns, (
+                f"culler never stopped the idle notebook (anns: {anns})"
+            )
+            # The probe bookkeeping proves the HTTP hop happened.
+            assert anns.get("notebooks.kubeflow.org/last-activity",
+                            "").startswith("2026-07-28")
+            # And the notebook reconciler closes the loop: STS to zero.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                sts = fake.get("apps/v1", "StatefulSet", "cull-e2e",
+                               "alice")
+                if sts["spec"].get("replicas") == 0:
+                    break
+                time.sleep(0.3)
+            assert sts["spec"]["replicas"] == 0
+            culled = [e for e in fake.list("v1", "Event",
+                                           namespace="alice")
+                      if e.get("reason") == "Culled"]
+            assert culled, "no Culled event recorded"
+        finally:
+            kernel_srv.close()
+            terminate(proc)
+            server.close()

@@ -235,17 +235,54 @@ class TestI18n:
         assert not missing, f"data-i18n strings missing from fr: {missing}"
 
     def test_explicit_t_calls_covered(self):
+        """Every string literal inside a KF.t(...) argument list —
+        including ternaries like KF.t(x ? 'Start' : 'Stop') and
+        fallbacks like KF.t(msg || 'Nothing here yet.') — must be in
+        the catalog."""
         keys = self.catalog_keys()
         missing = []
         for path in JS_FILES:
             if os.sep + "i18n" + os.sep in path:
                 continue
             src = open(path).read()
-            for m in re.finditer(r"KF\.t\('((?:[^'\\]|\\.)*)'[,)]", src):
-                key = m.group(1).replace("\\'", "'")
-                if key not in keys:
-                    missing.append((os.path.basename(path), key))
+            for call in re.finditer(r"KF\.t\(((?:[^()']|'(?:[^'\\]|\\.)*'"
+                                    r"|\([^()]*\))*)\)", src, re.S):
+                for lit in re.finditer(r"'((?:[^'\\]|\\.)*)'",
+                                       call.group(1)):
+                    key = lit.group(1).replace("\\'", "'")
+                    if key and key not in keys:
+                        missing.append((os.path.basename(path), key))
         assert not missing, f"KF.t strings missing from fr: {missing}"
+
+    def test_details_labels_and_empty_messages_covered(self):
+        """detailsList labels (pair[0]) and KF.table empty messages
+        also flow through KF.t inside the lib — they must be in the
+        catalog or the French Overview panes / empty states silently
+        stay English."""
+        keys = self.catalog_keys()
+        missing = []
+        for path in JS_FILES:
+            if "frontend_lib" in path or os.sep + "i18n" + os.sep in path:
+                continue
+            src = open(path).read()
+            # detailsList pairs: ['Label', value] — scanned only inside
+            # KF.detailsList(...) calls (k8s constant arrays elsewhere,
+            # e.g. access modes, are API values, not UI labels).
+            for block in re.finditer(
+                r"KF\.detailsList\((.*?)\]\]\)", src, re.S
+            ):
+                for m in re.finditer(r"\['([A-Z][^']*)',", block.group(1)):
+                    if m.group(1) not in keys:
+                        missing.append(
+                            (os.path.basename(path), m.group(1))
+                        )
+            # Empty messages: the line after KF.table(...) rows arg.
+            for m in re.finditer(
+                r"KF\.table\([^;]*?'(No [^']*)'\)", src, re.S
+            ):
+                if m.group(1) not in keys:
+                    missing.append((os.path.basename(path), m.group(1)))
+        assert not missing, f"labels/messages missing from fr: {missing}"
 
     def test_lib_table_and_tab_names_covered(self):
         """Column/tab names flow through KF.t inside the lib; cover the
