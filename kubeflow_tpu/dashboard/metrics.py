@@ -34,6 +34,17 @@ class NoMetricsService:
         raise LookupError("no metrics backend configured")
 
 
+def _default_http_get(url, params, headers=None):
+    import json as json_mod
+    import urllib.parse
+    import urllib.request
+
+    full = url + "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(full, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json_mod.loads(resp.read().decode())
+
+
 class PrometheusMetricsService:
     """Prometheus range queries for the resource charts (reference
     centraldashboard/app/prometheus_metrics_service.ts: node cpu/memory
@@ -53,17 +64,7 @@ class PrometheusMetricsService:
 
     def __init__(self, base_url: str, http_get=None):
         self.base_url = base_url.rstrip("/")
-        if http_get is None:
-            import json as json_mod
-            import urllib.parse
-            import urllib.request
-
-            def http_get(url, params):
-                full = url + "?" + urllib.parse.urlencode(params)
-                with urllib.request.urlopen(full, timeout=10) as resp:
-                    return json_mod.loads(resp.read().decode())
-
-        self.http_get = http_get
+        self.http_get = http_get or _default_http_get
 
     def query(self, metric: str, period_s: int) -> list[dict]:
         import time as time_mod
@@ -90,13 +91,137 @@ class PrometheusMetricsService:
         ]
 
 
-def make_metrics_service(prometheus_url: str | None) -> MetricsService:
+class StackdriverMetricsService:
+    """Cloud Monitoring (Stackdriver) backend (reference
+    centraldashboard/app/stackdriver_metrics_service.ts:1-204): the
+    same kubernetes.io metric types over the REST v3 timeSeries.list
+    API, ALIGN_MEAN per series over the window like the reference's
+    aggregation block. Auth rides the GKE workload-identity /
+    metadata-server token — no SDK dependency; ``http_get`` and
+    ``token_source`` are injectable so tests run without GCP."""
+
+    _BASE = "kubernetes.io"
+    # (metric type, cross-series reducer). Reducers mirror the
+    # Prometheus expressions so charts agree across backends: sums for
+    # the cluster totals, mean for the duty-cycle gauge (the one series
+    # where Prometheus uses avg()).
+    METRIC_TYPES = {
+        "node": (f"{_BASE}/node/cpu/allocatable_utilization",
+                 "REDUCE_SUM"),
+        "podcpu": (f"{_BASE}/container/cpu/limit_utilization",
+                   "REDUCE_SUM"),
+        "podmem": (f"{_BASE}/container/memory/used_bytes", "REDUCE_SUM"),
+        # Platform-added fleet series (exported by the in-image
+        # duty-cycle exporter via the GMP/Stackdriver adapter).
+        "tpu-duty-cycle": (f"{_BASE}/node/accelerator/duty_cycle",
+                           "REDUCE_MEAN"),
+    }
+    _METADATA_TOKEN_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token"
+    )
+
+    def __init__(self, project_id: str, http_get=None, token_source=None):
+        self.project_id = project_id
+        self._token: tuple[str, float] | None = None  # (token, expiry)
+        if token_source is None:
+            token_source = self._metadata_token
+        self.http_get = http_get or _default_http_get
+        self.token_source = token_source
+
+    def _metadata_token(self) -> str:
+        """Metadata-server token, cached until ~1 min before expiry —
+        tokens live ~1h and a blocking metadata round-trip per chart
+        request would be pure latency."""
+        import json as json_mod
+        import time as time_mod
+        import urllib.request
+
+        now = time_mod.time()
+        if self._token and self._token[1] > now:
+            return self._token[0]
+        req = urllib.request.Request(
+            self._METADATA_TOKEN_URL,
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json_mod.loads(resp.read().decode())
+        self._token = (
+            body["access_token"],
+            now + float(body.get("expires_in", 300)) - 60,
+        )
+        return self._token[0]
+
+    def query(self, metric: str, period_s: int) -> list[dict]:
+        import time as time_mod
+
+        entry = self.METRIC_TYPES.get(metric)
+        if entry is None:
+            raise LookupError(f"unknown metric {metric!r}")
+        metric_type, reducer = entry
+        end = int(time_mod.time())
+        step = max(period_s // 60, 15)
+        body = self.http_get(
+            "https://monitoring.googleapis.com/v3/projects/"
+            f"{self.project_id}/timeSeries",
+            {
+                "filter": f'metric.type="{metric_type}"',
+                "interval.startTime": _rfc3339(end - period_s),
+                "interval.endTime": _rfc3339(end),
+                "aggregation.alignmentPeriod": f"{step}s",
+                "aggregation.perSeriesAligner": "ALIGN_MEAN",
+                "aggregation.crossSeriesReducer": reducer,
+            },
+            {"Authorization": f"Bearer {self.token_source()}"},
+        )
+        series = (body.get("timeSeries") or [])
+        if not series:
+            return []
+        out = []
+        for point in series[0].get("points", []):
+            interval = point.get("interval") or {}
+            value = point.get("value") or {}
+            raw = value.get("doubleValue", value.get("int64Value", 0))
+            out.append({
+                "timestamp": _parse_rfc3339(interval.get("endTime", "")),
+                "value": float(raw),
+            })
+        # Cloud Monitoring returns newest-first; the charts expect
+        # oldest-first like the Prometheus backend.
+        return out[::-1]
+
+
+def _rfc3339(epoch: int) -> str:
+    import time as time_mod
+
+    return time_mod.strftime("%Y-%m-%dT%H:%M:%SZ", time_mod.gmtime(epoch))
+
+
+def _parse_rfc3339(stamp: str) -> int:
+    import calendar
+    import time as time_mod
+
+    try:
+        return calendar.timegm(
+            time_mod.strptime(stamp.split(".")[0].rstrip("Z"),
+                              "%Y-%m-%dT%H:%M:%S")
+        )
+    except ValueError:
+        return 0
+
+
+def make_metrics_service(
+    prometheus_url: str | None,
+    stackdriver_project: str | None = None,
+) -> MetricsService:
     """Factory (reference app/metrics_service_factory.ts): Prometheus
-    when configured, the 404-ing null service otherwise. The reference's
-    Stackdriver variant is GCP-console-specific and intentionally out of
-    scope — Cloud Monitoring scrapes the same Prometheus endpoints."""
+    when configured, Stackdriver when a GCP project is (reference
+    precedence: an explicit Prometheus endpoint wins), the 404-ing
+    null service otherwise."""
     if prometheus_url:
         return PrometheusMetricsService(prometheus_url)
+    if stackdriver_project:
+        return StackdriverMetricsService(stackdriver_project)
     return NoMetricsService()
 
 

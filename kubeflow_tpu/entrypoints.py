@@ -305,6 +305,7 @@ def run_kfam():
 
 def run_dashboard():
     from kubeflow_tpu.dashboard.app import KfamHttpProxy, create_app
+    from kubeflow_tpu.dashboard.metrics import make_metrics_service
 
     _setup_logging()
     api = _connect()
@@ -320,6 +321,14 @@ def run_dashboard():
         authn=_authn_from_env(),
         registration_flow=_env_bool("REGISTRATION_FLOW", True),
         secure_cookies=_env_bool("SECURE_COOKIES", True),
+        # Reference metrics_service_factory.ts precedence: an explicit
+        # Prometheus endpoint wins; else Stackdriver on GCP (project
+        # from env, as the reference takes it from the metadata
+        # server); else the 404-ing null service.
+        metrics_service=make_metrics_service(
+            os.environ.get("PROMETHEUS_URL"),
+            os.environ.get("STACKDRIVER_PROJECT"),
+        ),
     )
     _run_rest_app(app, 8082)
 

@@ -31,6 +31,9 @@ ENV_WORKER_ID = "TPU_WORKER_ID"
 ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_COORDINATOR = "KFT_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "KFT_NUM_PROCESSES"
+# JAX-native name the image's 10-tpu-env script derives for pods booted
+# WITHOUT the webhook (ordinal path); from_env falls back to it.
+ENV_JAX_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,8 +56,12 @@ class DistributedEnv:
             h for h in env.get(ENV_WORKER_HOSTNAMES, "").split(",") if h
         )
         num = int(env.get(ENV_NUM_PROCESSES, len(hostnames) or 1))
-        coord = env.get(ENV_COORDINATOR)
-        if coord is None and hostnames:
+        # Precedence: webhook-injected KFT_COORDINATOR_ADDRESS, then the
+        # JAX-native name the image's 10-tpu-env script derives for pods
+        # spawned WITHOUT the webhook (ordinal-derivation path), then
+        # rank 0 of the hostname list.
+        coord = env.get(ENV_COORDINATOR) or env.get(ENV_JAX_COORDINATOR)
+        if not coord and hostnames:
             coord = f"{hostnames[0]}:{COORDINATOR_PORT}"
         return cls(
             process_id=int(env.get(ENV_WORKER_ID, 0)),

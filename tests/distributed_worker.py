@@ -121,5 +121,53 @@ def main():
     print(f"DONE {jax.process_index()}", flush=True)
 
 
+def main_ring():
+    """KFT_TEST_MODE=ring4: one device per process, sp spanning the
+    WHOLE world — every ring-attention ppermute hop crosses an OS
+    process boundary (the CPU stand-in for a multi-host ICI/DCN ring).
+    This is the long-context layout a 4-host slice actually runs."""
+    denv = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.models import (
+        LMConfig,
+        build_lm,
+        create_lm_state,
+        make_lm_train_step,
+    )
+    from kubeflow_tpu.parallel import MeshSpec, make_mesh
+
+    world = len(jax.devices())
+    assert world == denv.num_processes, (world, denv.num_processes)
+    assert len(jax.local_devices()) == 1
+    print(f"WORLD {jax.process_index()} devices={world} local=1",
+          flush=True)
+
+    mesh = make_mesh(MeshSpec(sp=world), jax.devices())
+    cfg = LMConfig(vocab=64, layers=1, dim=32, heads=2)
+    model = build_lm(cfg, mesh=mesh)
+    state = create_lm_state(model, jax.random.key(0), (2, 8 * world),
+                            mesh=mesh)
+    step = make_lm_train_step(mesh, cfg=cfg)
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(0, 64, size=(2, 8 * world)).astype(np.int32)
+    tokens = jax.make_array_from_callback(
+        tokens_np.shape, NamedSharding(mesh, P(("dp", "fsdp"), "sp")),
+        lambda idx: tokens_np[idx],
+    )
+    state, metrics = step(state, {"tokens": tokens})
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss)
+    print(f"RINGSTEP {jax.process_index()} loss={loss:.6f}", flush=True)
+    print(f"DONE {jax.process_index()}", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("KFT_TEST_MODE") == "ring4":
+        main_ring()
+    else:
+        main()

@@ -269,6 +269,85 @@ class TestApi:
             make_metrics_service("http://prom:9090"), PrometheusMetricsService
         )
 
+    def test_stackdriver_service_queries_cloud_monitoring(self):
+        """The reference's second backend
+        (stackdriver_metrics_service.ts): kubernetes.io metric types
+        over timeSeries.list with ALIGN_MEAN aggregation, bearer auth
+        from the metadata token, oldest-first output like the
+        Prometheus backend."""
+        from kubeflow_tpu.dashboard.metrics import (
+            PrometheusMetricsService,
+            StackdriverMetricsService,
+            make_metrics_service,
+        )
+
+        calls = []
+
+        def fake_get(url, params, headers):
+            calls.append((url, params, headers))
+            return {
+                "timeSeries": [{
+                    "points": [
+                        {"interval": {"endTime": "2026-07-30T10:01:00Z"},
+                         "value": {"doubleValue": 0.75}},
+                        {"interval": {"endTime": "2026-07-30T10:00:00Z"},
+                         "value": {"doubleValue": 0.5}},
+                    ],
+                }],
+            }
+
+        svc = StackdriverMetricsService(
+            "proj-1", http_get=fake_get, token_source=lambda: "tok",
+        )
+        series = svc.query("node", 600)
+        # Newest-first from the API -> oldest-first for the charts.
+        assert [p["value"] for p in series] == [0.5, 0.75]
+        assert series[0]["timestamp"] < series[1]["timestamp"]
+        url, params, headers = calls[0]
+        assert url == ("https://monitoring.googleapis.com/v3/projects/"
+                       "proj-1/timeSeries")
+        assert params["filter"] == (
+            'metric.type="kubernetes.io/node/cpu/allocatable_utilization"'
+        )
+        assert params["aggregation.perSeriesAligner"] == "ALIGN_MEAN"
+        assert headers["Authorization"] == "Bearer tok"
+
+        with pytest.raises(LookupError):
+            svc.query("nope", 60)
+        # Factory precedence: Prometheus wins; Stackdriver when only a
+        # project is configured.
+        assert isinstance(
+            make_metrics_service(None, "proj-1"), StackdriverMetricsService
+        )
+        assert isinstance(
+            make_metrics_service("http://prom:9090", "proj-1"),
+            PrometheusMetricsService,
+        )
+
+    def test_dashboard_serves_series_from_stackdriver(self, api):
+        """The /api/metrics route works identically behind the second
+        backend (duck-typed MetricsService)."""
+        from kubeflow_tpu.dashboard.metrics import StackdriverMetricsService
+
+        from kubeflow_tpu.dashboard import create_app
+
+        svc = StackdriverMetricsService(
+            "proj-1",
+            http_get=lambda url, params, headers: {
+                "timeSeries": [{"points": [
+                    {"interval": {"endTime": "2026-07-30T10:00:00Z"},
+                     "value": {"int64Value": "41"}},
+                ]}],
+            },
+            token_source=lambda: "tok",
+        )
+        app = create_app(api, metrics_service=svc)
+        client = app.test_client()
+        body = client.get(
+            "/api/metrics/podmem", headers=hdr(),
+        ).get_json()
+        assert body["series"][0]["value"] == 41.0
+
 
 class TestTpuFleet:
     def _node(self, api, name, accel, topo, chips):

@@ -100,3 +100,119 @@ def test_controller_injected_env_forms_a_jax_world():
     assert len(pp_losses) == 1, (
         f"ranks computed different pipelined losses: {pp_losses}"
     )
+
+
+@pytest.mark.slow
+def test_gang_restart_reforms_the_world():
+    """The gang-restart contract end to end: a slice's processes are
+    ALL recycled (generation 1 exits, generation 2 starts against the
+    same coordinator address) and the new jax.distributed world must
+    form regardless of restart ordering — generation 2 starts rank 1
+    BEFORE rank 0, the coordinator, which kubelet ordering can and does
+    produce after a gang delete."""
+    import time
+
+    num = 2
+    port = free_port()
+
+    def run_generation(stagger_reverse: bool):
+        procs = {}
+        ranks = list(range(num))
+        if stagger_reverse:
+            ranks = ranks[::-1]
+        for rank in ranks:
+            env_block = slice_env_for_rank("nb", "alice", rank, num)
+            env_block[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+            env = {**os.environ, **env_block,
+                   "JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                   "PYTHONUNBUFFERED": "1"}
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            procs[rank] = subprocess.Popen(
+                [sys.executable, WORKER], env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            if stagger_reverse and rank != 0:
+                time.sleep(2.0)  # rank 1 waits at the rendezvous
+        outs = {}
+        for rank, proc in procs.items():
+            out, _ = proc.communicate(timeout=300)
+            outs[rank] = out.decode(errors="replace")
+            assert proc.returncode == 0, f"rank {rank}:\n{outs[rank]}"
+            assert f"DONE {rank}" in outs[rank]
+        return outs
+
+    run_generation(stagger_reverse=False)   # generation 1: the slice runs
+    # Gang restart: every process recycled; same coordinator endpoint.
+    outs = run_generation(stagger_reverse=True)
+    losses = {
+        line.split("loss=")[1]
+        for out in outs.values()
+        for line in out.splitlines() if line.startswith("STEP ")
+    }
+    assert len(losses) == 1, f"reformed world split-brained: {losses}"
+
+
+@pytest.mark.slow
+def test_image_derived_env_forms_ring_world_of_four():
+    """Four processes, ONE device each, sequence parallelism spanning
+    the whole world: every ring-attention hop crosses an OS process
+    boundary. The per-rank env is derived by RUNNING the actual image
+    boot script (images/jupyter-jax-tpu/s6/cont-init.d/10-tpu-env) down
+    its ordinal path — HOSTNAME + the webhook's hostname list, no
+    pre-injected TPU_WORKER_ID — exactly how a pod spawned without the
+    webhook boots."""
+    import tempfile
+
+    num = 4
+    port = free_port()
+    script = os.path.join(
+        REPO, "images", "jupyter-jax-tpu", "s6", "cont-init.d",
+        "10-tpu-env",
+    )
+    hostnames = ",".join(f"nb-{r}.nb-hosts.alice.svc" for r in range(num))
+    procs = []
+    for rank in range(num):
+        envdir = tempfile.mkdtemp(prefix=f"s6env-{rank}-")
+        subprocess.run(
+            [script],
+            env={"PATH": os.environ["PATH"],
+                 "S6_ENVDIR": envdir,
+                 "HOSTNAME": f"nb-{rank}",
+                 "TPU_WORKER_HOSTNAMES": hostnames},
+            check=True, capture_output=True,
+        )
+        derived = {
+            name: open(os.path.join(envdir, name)).read()
+            for name in os.listdir(envdir)
+        }
+        assert derived["TPU_WORKER_ID"] == str(rank), derived
+        env = {**os.environ,
+               "TPU_WORKER_HOSTNAMES": hostnames,
+               **derived,
+               # DNS only resolves in a cluster; loopback stand-in.
+               "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+               "KFT_TEST_MODE": "ring4",
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+               "PYTHONUNBUFFERED": "1"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("KFT_COORDINATOR_ADDRESS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+
+    outs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        outs.append(out.decode(errors="replace"))
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"WORLD {rank} devices=4 local=1" in out, out
+    losses = {
+        line.split("loss=")[1]
+        for out in outs
+        for line in out.splitlines() if line.startswith("RINGSTEP ")
+    }
+    assert len(losses) == 1, f"ring world split-brained: {losses}"
