@@ -120,9 +120,20 @@ class StackdriverMetricsService:
         "http://metadata.google.internal/computeMetadata/v1/instance/"
         "service-accounts/default/token"
     )
+    _METADATA_CLUSTER_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "attributes/cluster-name"
+    )
 
-    def __init__(self, project_id: str, http_get=None, token_source=None):
+    def __init__(self, project_id: str, cluster_name: str | None = None,
+                 http_get=None, token_source=None):
         self.project_id = project_id
+        # Scope every filter to THIS cluster (reference
+        # stackdriver_metrics_service.ts reads cluster-name from the
+        # metadata server): without it, REDUCE_SUM aggregates every
+        # cluster in the project. None = resolve lazily from metadata;
+        # "" = explicitly unscoped (single-cluster projects).
+        self._cluster = cluster_name
         self._token: tuple[str, float] | None = None  # (token, expiry)
         if token_source is None:
             token_source = self._metadata_token
@@ -152,6 +163,25 @@ class StackdriverMetricsService:
         )
         return self._token[0]
 
+    def _cluster_clause(self) -> str:
+        if self._cluster is None:
+            import urllib.request
+
+            try:
+                req = urllib.request.Request(
+                    self._METADATA_CLUSTER_URL,
+                    headers={"Metadata-Flavor": "Google"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    self._cluster = resp.read().decode().strip()
+            except Exception:
+                self._cluster = ""  # not on GKE: stay unscoped
+        if self._cluster:
+            return (
+                f' AND resource.labels.cluster_name="{self._cluster}"'
+            )
+        return ""
+
     def query(self, metric: str, period_s: int) -> list[dict]:
         import time as time_mod
 
@@ -160,12 +190,15 @@ class StackdriverMetricsService:
             raise LookupError(f"unknown metric {metric!r}")
         metric_type, reducer = entry
         end = int(time_mod.time())
-        step = max(period_s // 60, 15)
+        # Cloud Monitoring's minimum alignment period is 60s (the
+        # Prometheus backend's 15s floor is illegal here).
+        step = max(period_s // 60, 60)
         body = self.http_get(
             "https://monitoring.googleapis.com/v3/projects/"
             f"{self.project_id}/timeSeries",
             {
-                "filter": f'metric.type="{metric_type}"',
+                "filter": (f'metric.type="{metric_type}"'
+                           + self._cluster_clause()),
                 "interval.startTime": _rfc3339(end - period_s),
                 "interval.endTime": _rfc3339(end),
                 "aggregation.alignmentPeriod": f"{step}s",
@@ -213,6 +246,7 @@ def _parse_rfc3339(stamp: str) -> int:
 def make_metrics_service(
     prometheus_url: str | None,
     stackdriver_project: str | None = None,
+    cluster_name: str | None = None,
 ) -> MetricsService:
     """Factory (reference app/metrics_service_factory.ts): Prometheus
     when configured, Stackdriver when a GCP project is (reference
@@ -221,7 +255,9 @@ def make_metrics_service(
     if prometheus_url:
         return PrometheusMetricsService(prometheus_url)
     if stackdriver_project:
-        return StackdriverMetricsService(stackdriver_project)
+        return StackdriverMetricsService(
+            stackdriver_project, cluster_name=cluster_name
+        )
     return NoMetricsService()
 
 

@@ -328,6 +328,7 @@ def run_dashboard():
         metrics_service=make_metrics_service(
             os.environ.get("PROMETHEUS_URL"),
             os.environ.get("STACKDRIVER_PROJECT"),
+            cluster_name=os.environ.get("STACKDRIVER_CLUSTER"),
         ),
     )
     _run_rest_app(app, 8082)

@@ -41,12 +41,13 @@ def _causal_mask(scores, q_offset, k_offset, window=None):
 
 
 def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
-                  window=None):
+                  window=None, segment_ids=None):
     """Plain XLA attention. q: (..., Sq, D), k/v: (..., Sk, D).
 
     ``q_offset``/``k_offset`` place the blocks in a longer global
     sequence for causal masking (used by the ring-attention tests).
-    ``window`` is the sliding-window width (requires causal).
+    ``window`` is the sliding-window width (requires causal);
+    ``segment_ids`` (B, S) the document mask for packed batches.
     """
     if window is not None:
         if not causal:
@@ -75,6 +76,16 @@ def mha_reference(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
     ) * scale
     if causal:
         s = _causal_mask(s, q_offset, k_offset, window)
+    if segment_ids is not None:
+        if q.ndim != 4:
+            raise ValueError(
+                "segment_ids requires the (B, H, S, D) layout, got "
+                f"q.ndim={q.ndim}"
+            )
+        # (B, S) against (B, H, Sq, Sk) scores: broadcast over heads.
+        keep = (segment_ids[:, None, :, None]
+                == segment_ids[:, None, None, :])
+        s = jnp.where(keep, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", w, v.astype(jnp.float32)).astype(
         q.dtype
@@ -94,14 +105,56 @@ def _block_live(qi, ki, block_q, block_k, window):
     return live
 
 
+def _segments_overlap(seg_q, seg_k):
+    """Block-skip predicate for document masks: segment ids are
+    non-decreasing within a packed sequence, so two blocks can only
+    share a document when their [min, max] id ranges overlap. Exactly
+    the fully-masked blocks are skipped."""
+    return jnp.logical_and(
+        jnp.min(seg_q) <= jnp.max(seg_k),
+        jnp.min(seg_k) <= jnp.max(seg_q),
+    )
+
+
+def _segment_mask(s, seg_q, seg_k):
+    """Mask scores where q and k fall in different documents."""
+    keep = seg_q.reshape(-1, 1) == seg_k.reshape(1, -1)
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _run_if_live(compute, qi, ki, block_q, block_k, causal, window,
+                 segq_ref, segk_ref):
+    """Shared block-skip dispatcher for all three kernels: run
+    ``compute`` unless the block is fully masked by the causal band
+    and/or disjoint segment ranges. Python-level True means
+    unconditional (no pl.when) so the unmasked fast path stays
+    branch-free."""
+    live = True
+    if causal:
+        live = _block_live(qi, ki, block_q, block_k, window)
+    if segq_ref is not None:
+        overlap = _segments_overlap(segq_ref[0, 0], segk_ref[0, 0])
+        live = overlap if live is True else jnp.logical_and(live, overlap)
+    if live is True:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, *rest,
-    scale, causal, window, block_q, block_k,
+    q_ref, k_ref, v_ref, *rest,
+    scale, causal, window, block_q, block_k, segmented,
 ):
-    # rest = (lse_ref?, m_scr, l_scr, acc_scr): the lse output exists
-    # only on the VJP forward — inference forwards skip the extra HBM
-    # store entirely (pallas outputs are opaque to XLA DCE).
-    lse_ref = rest[0] if len(rest) == 4 else None
+    # rest = (segq_ref?, segk_ref?, o_ref, lse_ref?, m_scr, l_scr,
+    # acc_scr): seg refs exist only for document-masked (packed)
+    # batches, the lse output only on the VJP forward — inference
+    # forwards skip the extra HBM store entirely (pallas outputs are
+    # opaque to XLA DCE).
+    if segmented:
+        segq_ref, segk_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref = rest[0]
+    lse_ref = rest[1] if len(rest) == 5 else None
     m_scr, l_scr, acc_scr = rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -126,6 +179,8 @@ def _flash_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, window)
+        if segmented:
+            s = _segment_mask(s, segq_ref[0, 0], segk_ref[0, 0])
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -139,29 +194,39 @@ def _flash_kernel(
         m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
 
-    if causal:
-        # Blocks fully outside the causal(/windowed) band contribute
-        # nothing; skip the matmuls (the scratch/out writes below still
-        # run every step).
-        @pl.when(_block_live(qi, ki, block_q, block_k, window))
-        def _():
-            compute()
-    else:
-        compute()
+    # Blocks fully outside the causal(/windowed) band or wholly
+    # cross-document contribute nothing; skip the matmuls (the
+    # scratch/out writes below still run every step). With segments,
+    # compute scales with sum(len(doc)^2), not S^2.
+    _run_if_live(compute, qi, ki, block_q, block_k, causal, window,
+                 segq_ref if segmented else None,
+                 segk_ref if segmented else None)
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        # Fully-masked rows (never touched by any live block) have
+        # l == 0; emit zeros, not NaN — and a safe lse for the bwd.
+        l_safe = jnp.where(l_scr[:, :1] == 0.0, 1.0, l_scr[:, :1])
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         if lse_ref is not None:
             # Per-row logsumexp: the only softmax state the backward
             # needs. Stored (bh, 8, S) — the fixed 8-sublane pad
             # satisfies the TPU block-tiling rule (last two dims 8x128).
-            lse = (m_scr[:, :1] + jnp.log(l_scr[:, :1])).reshape(1, -1)
+            lse = (m_scr[:, :1] + jnp.log(l_safe)).reshape(1, -1)
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
-                   interpret, with_lse=False):
+def _pad_segments(segment_ids):
+    """(B, S) int32 -> (B, 8, S): the fixed 8-sublane pad that
+    satisfies the TPU block-tiling rule (same layout as lse)."""
+    b, s = segment_ids.shape
+    return jnp.broadcast_to(
+        segment_ids.astype(jnp.int32)[:, None, :], (b, 8, s)
+    )
+
+
+def _flash_forward(q, k, v, segment_ids, causal, window, scale, block_q,
+                   block_k, interpret, with_lse=False):
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
     if s_q % block_q or s_k % block_k:
@@ -174,10 +239,31 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
     # index b // group = bi*Hkv + hi // group — one index-map division,
     # no materialised head repetition (the whole point: smaller K/V).
     group = heads // k.shape[1]
+    segmented = segment_ids is not None
     qr = q.reshape(bh, s_q, d)
     kr = k.reshape(batch * k.shape[1], s_k, d)
     vr = v.reshape(batch * v.shape[1], s_k, d)
     grid = (bh, s_q // block_q, s_k // block_k)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (b // group, j, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if segmented:
+        seg = _pad_segments(segment_ids)
+        # Segment ids are per (batch, position): q rows via b // heads,
+        # k columns likewise (self-attention shares one sequence).
+        in_specs.append(pl.BlockSpec(
+            (1, 8, block_q), lambda b, i, j: (b // heads, 0, i)
+        ))
+        in_specs.append(pl.BlockSpec(
+            (1, 8, block_k), lambda b, i, j: (b // heads, 0, j)
+        ))
+        operands += [seg, seg]
 
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)]
@@ -191,16 +277,10 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
         functools.partial(
             _flash_kernel,
             scale=scale, causal=causal, window=window,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, segmented=segmented,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -209,7 +289,7 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*operands)
     if with_lse:
         out, lse = result
         # lse: (bh, 8, s_q) sublane-padded row stats
@@ -218,9 +298,13 @@ def _flash_forward(q, k, v, causal, window, scale, block_q, block_k,
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, window, block_q, block_k,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, window, block_q, block_k, segmented,
 ):
+    if segmented:
+        segq_ref, segk_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -237,6 +321,8 @@ def _dq_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, window)
+        if segmented:
+            s = _segment_mask(s, segq_ref[0, 0], segk_ref[0, 0])
         p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -248,12 +334,9 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        @pl.when(_block_live(qi, ki, block_q, block_k, window))
-        def _():
-            compute()
-    else:
-        compute()
+    _run_if_live(compute, qi, ki, block_q, block_k, causal, window,
+                 segq_ref if segmented else None,
+                 segk_ref if segmented else None)
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
@@ -261,8 +344,8 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, window, block_q, block_k, num_qblocks,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, window, block_q, block_k, num_qblocks, segmented,
 ):
     """dk/dv for ONE kv head: the innermost grid axis sweeps q blocks
     AND the query group (GQA) — axis length group * num_qblocks, with
@@ -270,6 +353,10 @@ def _dkv_kernel(
     accumulators therefore integrate the whole query group in VMEM and
     the kernel emits (batch, kv_heads, S, d) directly: no per-q-head
     O(B*H*S*d) gradient transient, no group-sum pass over HBM."""
+    if segmented:
+        segq_ref, segk_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     t = pl.program_id(2)
     qi = t % num_qblocks
@@ -288,6 +375,8 @@ def _dkv_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, window)
+        if segmented:
+            s = _segment_mask(s, segq_ref[0, 0], segk_ref[0, 0])
         p = jnp.exp(s - lse_ref[0, 0][:, None])            # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
@@ -304,12 +393,9 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )                                                  # (bk, d)
 
-    if causal:
-        @pl.when(_block_live(qi, ki, block_q, block_k, window))
-        def _():
-            compute()
-    else:
-        compute()
+    _run_if_live(compute, qi, ki, block_q, block_k, causal, window,
+                 segq_ref if segmented else None,
+                 segk_ref if segmented else None)
 
     @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
@@ -317,8 +403,8 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
-                    block_k, interpret):
+def _flash_backward(q, k, v, segment_ids, out, lse, g, causal, window,
+                    scale, block_q, block_k, interpret):
     """Tiled backward (the FlashAttention-2 two-kernel scheme): P is
     recomputed blockwise from q/k and the saved logsumexp, so the bwd —
     like the fwd — never materialises the S x S score matrix in HBM."""
@@ -339,24 +425,37 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     ).reshape(bh, 1, s_q)
     delta = jnp.broadcast_to(delta, (bh, 8, s_q))
 
+    segmented = segment_ids is not None
+    seg = _pad_segments(segment_ids) if segmented else None
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     # GQA: kv inputs indexed by b // group (see _flash_forward).
     k_spec = pl.BlockSpec((1, block_k, d),
                           lambda b, i, j: (b // group, j, 0))
     row_spec = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    dq_operands = [qr, kr, vr, dor, lser, delta]
+    if segmented:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, 8, block_q), lambda b, i, j: (b // heads, 0, i)
+        ))
+        dq_in_specs.append(pl.BlockSpec(
+            (1, 8, block_k), lambda b, i, j: (b // heads, 0, j)
+        ))
+        dq_operands += [seg, seg]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel,
             scale=scale, causal=causal, window=window,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, segmented=segmented,
         ),
         grid=(bh, s_q // block_q, s_k // block_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(*dq_operands)
 
     # dk/dv accumulate over q blocks AND the query group: grid runs one
     # program sequence per (batch, kv head), the innermost axis sweeps
@@ -378,15 +477,27 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
     rowG_spec = pl.BlockSpec(
         (1, 8, block_q), lambda b, j, t: (qhead(b, t), 0, t % nq)
     )
+    dkv_in_specs = [qG_spec, kvG_spec, kvG_spec, qG_spec, rowG_spec,
+                    rowG_spec]
+    dkv_operands = [qr, kr, vr, dor, lser, delta]
+    if segmented:
+        # Segment ids index by BATCH: b // kv_heads for this grid.
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, 8, block_q), lambda b, j, t: (b // kv_heads, 0, t % nq)
+        ))
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, 8, block_k), lambda b, j, t: (b // kv_heads, 0, j)
+        ))
+        dkv_operands += [seg, seg]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
             scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_qblocks=nq,
+            segmented=segmented,
         ),
         grid=(batch * kv_heads, s_k // block_k, group * nq),
-        in_specs=[qG_spec, kvG_spec, kvG_spec, qG_spec, rowG_spec,
-                  rowG_spec],
+        in_specs=dkv_in_specs,
         out_specs=[kvG_spec, kvG_spec],
         out_shape=[
             jax.ShapeDtypeStruct((batch * kv_heads, s_k, d), k.dtype),
@@ -397,35 +508,46 @@ def _flash_backward(q, k, v, out, lse, g, causal, window, scale, block_q,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(*dkv_operands)
 
     shape = (batch, heads, s_q, d)
     kshape = (batch, kv_heads, s_k, d)
     return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, window, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, segment_ids, causal, window, scale, block_q, block_k,
+           interpret):
     return _flash_forward(
-        q, k, v, causal, window, scale, block_q, block_k, interpret
+        q, k, v, segment_ids, causal, window, scale, block_q, block_k,
+        interpret
     )
 
 
-def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, segment_ids, causal, window, scale, block_q,
+               block_k, interpret):
     out, lse = _flash_forward(
-        q, k, v, causal, window, scale, block_q, block_k, interpret,
-        with_lse=True,
+        q, k, v, segment_ids, causal, window, scale, block_q, block_k,
+        interpret, with_lse=True,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd(causal, window, scale, block_q, block_k, interpret,
                residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_backward(
-        q, k, v, out, lse, g, causal, window, scale, block_q, block_k,
-        interpret
+    q, k, v, segment_ids, out, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, segment_ids, out, lse, g, causal, window, scale, block_q,
+        block_k, interpret
     )
+    # segment_ids is an int operand: its cotangent is the zero-width
+    # float0 (jax's tangent type for non-differentiable dtypes).
+    dseg = None
+    if segment_ids is not None:
+        import numpy as np
+
+        dseg = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -447,7 +569,7 @@ def _fit_block(block: int, seq: int) -> int:
 
 
 def flash_attention(
-    q, k, v, *, causal=False, window=None, scale=None,
+    q, k, v, *, causal=False, window=None, segment_ids=None, scale=None,
     block_q=None, block_k=None, interpret=None,
 ):
     """Tiled attention. q/k/v: (batch, heads, seq, head_dim).
@@ -457,6 +579,13 @@ def flash_attention(
     their matmuls in fwd AND bwd, so compute scales with S*window
     instead of S² — the standard long-context local-attention layout
     (Mistral-style), composable per layer.
+
+    ``segment_ids`` (batch, seq) int32 enables the document mask for
+    packed batches: tokens attend only within their own segment
+    (sequence packing, the standard long-context data layout). Blocks
+    whose segment-id ranges are disjoint skip their matmuls in fwd AND
+    bwd, so attention compute scales with sum(len(doc)^2) instead of
+    S^2. Composes with causal and window.
 
     On TPU, ``head_dim`` and the block sizes should be multiples of 128
     (MXU tiles). Blocks are auto-fitted down to a divisor of the
@@ -476,6 +605,17 @@ def flash_attention(
             raise ValueError("window requires causal attention")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if segment_ids is not None:
+        if segment_ids.shape != (q.shape[0], q.shape[2]):
+            raise ValueError(
+                f"segment_ids must be (batch, seq) = "
+                f"({q.shape[0]}, {q.shape[2]}), got {segment_ids.shape}"
+            )
+        if k.shape[2] != q.shape[2]:
+            raise ValueError(
+                "segment_ids requires self-attention (q and k share one "
+                f"sequence), got Sq={q.shape[2]} Sk={k.shape[2]}"
+            )
     if q.shape[1] % k.shape[1] or k.shape[1:] != v.shape[1:]:
         raise ValueError(
             f"q heads {q.shape[1]} must be a multiple of kv heads "
@@ -501,9 +641,9 @@ def flash_attention(
         # in the compiler. Odd lengths are rare and small in practice —
         # serve them through the XLA reference instead.
         return mha_reference(q, k, v, causal=causal, scale=scale,
-                             window=window)
-    return _flash(q, k, v, causal, window, scale, block_q, block_k,
-                  interpret)
+                             window=window, segment_ids=segment_ids)
+    return _flash(q, k, v, segment_ids, causal, window, scale, block_q,
+                  block_k, interpret)
 
 
 # ---- rotary position embeddings ----------------------------------------
