@@ -126,7 +126,7 @@ class StackdriverMetricsService:
     )
 
     def __init__(self, project_id: str, cluster_name: str | None = None,
-                 http_get=None, token_source=None):
+                 http_get=None, token_source=None, cluster_source=None):
         self.project_id = project_id
         # Scope every filter to THIS cluster (reference
         # stackdriver_metrics_service.ts reads cluster-name from the
@@ -134,6 +134,7 @@ class StackdriverMetricsService:
         # cluster in the project. None = resolve lazily from metadata;
         # "" = explicitly unscoped (single-cluster projects).
         self._cluster = cluster_name
+        self._cluster_source = cluster_source
         self._token: tuple[str, float] | None = None  # (token, expiry)
         if token_source is None:
             token_source = self._metadata_token
@@ -163,23 +164,34 @@ class StackdriverMetricsService:
         )
         return self._token[0]
 
+    def _metadata_cluster(self) -> str:
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                self._METADATA_CLUSTER_URL,
+                headers={"Metadata-Flavor": "Google"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.read().decode().strip()
+        except Exception:
+            return ""  # not on GKE: stay unscoped
+
     def _cluster_clause(self) -> str:
         if self._cluster is None:
-            import urllib.request
-
-            try:
-                req = urllib.request.Request(
-                    self._METADATA_CLUSTER_URL,
-                    headers={"Metadata-Flavor": "Google"},
-                )
-                with urllib.request.urlopen(req, timeout=5) as resp:
-                    self._cluster = resp.read().decode().strip()
-            except Exception:
-                self._cluster = ""  # not on GKE: stay unscoped
+            # Injectable like the other I/O hooks (tests must stay
+            # hermetic; injected-dependency instances never touch the
+            # metadata server unless asked).
+            source = self._cluster_source or self._metadata_cluster
+            self._cluster = source() or ""
         if self._cluster:
-            return (
-                f' AND resource.labels.cluster_name="{self._cluster}"'
+            # Escape filter-string metacharacters: an operator-supplied
+            # name with a quote would otherwise yield an invalid filter
+            # and silently blank charts.
+            name = self._cluster.replace("\\", "\\\\").replace(
+                '"', '\\"'
             )
+            return f' AND resource.labels.cluster_name="{name}"'
         return ""
 
     def query(self, metric: str, period_s: int) -> list[dict]:

@@ -268,6 +268,14 @@ def make_pp_lm_train_step(model: PipelinedLM):
     token_sh = token_sharding(model.mesh)
 
     def step(state: TrainState, batch):
+        if "segment_ids" in batch:
+            # Same loud guard as the ring path: silently ignoring the
+            # document mask would train across packed boundaries.
+            raise NotImplementedError(
+                "packed batches (segment_ids) are not threaded through "
+                "the pipeline schedules yet; use make_lm_train_step on "
+                "a non-pp mesh"
+            )
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], token_sh)
 
         def loss_fn(params):

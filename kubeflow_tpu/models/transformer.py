@@ -239,7 +239,7 @@ class Block(nn.Module):
     rope_offset_axis: str | None = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None):
         cfg = self.cfg
         b, s, _ = x.shape
         h = RMSNorm()(x)
@@ -269,7 +269,15 @@ class Block(nn.Module):
         q = apply_rope(q, offset=offset)
         k = apply_rope(k, offset=offset)
         attn = self.attn_impl or mha_reference
-        out = attn(q, k, v, causal=True)
+        if segment_ids is not None:
+            # Packed batch: the attention core applies the document
+            # mask (positions stay absolute — the packing convention
+            # this stack uses throughout; RoPE is relative-phase, so
+            # only cross-document attention would notice, and that is
+            # exactly what the mask removes).
+            out = attn(q, k, v, causal=True, segment_ids=segment_ids)
+        else:
+            out = attn(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
         x = x + nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
                          name="proj")(out)
@@ -291,7 +299,9 @@ class TransformerLM(nn.Module):
     attn_impl: AttnImpl | None = None
 
     @nn.compact
-    def __call__(self, tokens):  # (B, S) int32 -> (B, S, vocab) f32
+    def __call__(self, tokens, segment_ids=None):
+        # (B, S) int32 -> (B, S, vocab) f32; ``segment_ids`` (B, S)
+        # enables packed-batch (document-masked) training end to end.
         cfg = self.cfg
         emb = nn.Embed(cfg.vocab, cfg.dim, dtype=cfg.dtype, name="embed")
         x = emb(tokens)
@@ -300,7 +310,7 @@ class TransformerLM(nn.Module):
                 cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
             )
             x = Block(cfg, attn_impl=self.attn_impl, use_moe=use_moe,
-                      name=f"block_{i}")(x)
+                      name=f"block_{i}")(x, segment_ids)
         x = RMSNorm(name="final_norm")(x)
         return tied_head(x, emb.embedding, cfg.dtype)
 
@@ -356,21 +366,33 @@ def build_lm(
         # (q-shard, k-shard) block's mask.
         attn = make_ring_attention(mesh, "sp", window=cfg.attn_window)
     elif use_flash or (use_flash is None and jax.default_backend() == "tpu"):
-        attn = lambda q, k, v, causal=True: flash_attention(
-            q, k, v, causal=causal, window=cfg.attn_window
-        )
+        attn = lambda q, k, v, causal=True, segment_ids=None: \
+            flash_attention(
+                q, k, v, causal=causal, window=cfg.attn_window,
+                segment_ids=segment_ids,
+            )
     elif cfg.attn_window is not None:
-        attn = lambda q, k, v, causal=True: mha_reference(
-            q, k, v, causal=causal, window=cfg.attn_window
-        )
+        attn = lambda q, k, v, causal=True, segment_ids=None: \
+            mha_reference(
+                q, k, v, causal=causal, window=cfg.attn_window,
+                segment_ids=segment_ids,
+            )
     return TransformerLM(cfg, attn_impl=attn)
 
 
-def lm_loss(logits, tokens):
-    """Next-token cross entropy: predict tokens[:, 1:] from logits[:, :-1]."""
-    return optax.softmax_cross_entropy_with_integer_labels(
+def lm_loss(logits, tokens, segment_ids=None):
+    """Next-token cross entropy: predict tokens[:, 1:] from
+    logits[:, :-1]. With ``segment_ids`` (packed batches), positions
+    whose target falls in a DIFFERENT document are excluded — the last
+    token of one document must not be trained to predict the first
+    token of the next."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]
-    ).mean()
+    )
+    if segment_ids is None:
+        return ce.mean()
+    valid = (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(ce.dtype)
+    return (ce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
 
 def create_lm_state(
@@ -441,13 +463,16 @@ def make_lm_train_step(
         moe_aux_weight = (cfg or LMConfig()).moe_aux_weight
 
     def step(state, batch):
+        seg = batch.get("segment_ids")
+
         def loss_fn(params):
             logits, mods = state.apply_fn(
-                {"params": params}, batch["tokens"],
+                {"params": params}, batch["tokens"], seg,
                 mutable=["intermediates"],
             )
             aux = _moe_aux_total(mods.get("intermediates", {}))
-            return lm_loss(logits, batch["tokens"]) + moe_aux_weight * aux
+            return (lm_loss(logits, batch["tokens"], seg)
+                    + moe_aux_weight * aux)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = state.tx.update(
@@ -467,11 +492,15 @@ def make_lm_train_step(
     token_sh = token_sharding(mesh)
 
     def sharded_step(state, batch):
-        batch = {
+        sharded = {
             "tokens": jax.lax.with_sharding_constraint(
                 batch["tokens"], token_sh
             )
         }
-        return step(state, batch)
+        if "segment_ids" in batch:
+            sharded["segment_ids"] = jax.lax.with_sharding_constraint(
+                batch["segment_ids"], token_sh
+            )
+        return step(state, sharded)
 
     return jax.jit(sharded_step, donate_argnums=0)

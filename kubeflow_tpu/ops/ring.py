@@ -118,7 +118,13 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     (q, k, v, causal) as global (batch, heads, seq, head_dim) arrays."""
     spec = P(None, None, axis_name, None)
 
-    def attend(q, k, v, causal=False):
+    def attend(q, k, v, causal=False, segment_ids=None):
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "document masks are not implemented on the ring path "
+                "yet; pack on a non-sp mesh (flash_attention supports "
+                "segment_ids single-chip and under dp/fsdp/tp/pp)"
+            )
         fn = functools.partial(
             ring_attention, axis_name=axis_name, causal=causal,
             window=window,

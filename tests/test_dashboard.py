@@ -297,7 +297,8 @@ class TestApi:
             }
 
         svc = StackdriverMetricsService(
-            "proj-1", http_get=fake_get, token_source=lambda: "tok",
+            "proj-1", cluster_name="", http_get=fake_get,
+            token_source=lambda: "tok",
         )
         series = svc.query("node", 600)
         # Newest-first from the API -> oldest-first for the charts.
@@ -332,7 +333,7 @@ class TestApi:
         from kubeflow_tpu.dashboard import create_app
 
         svc = StackdriverMetricsService(
-            "proj-1",
+            "proj-1", cluster_name="",
             http_get=lambda url, params, headers: {
                 "timeSeries": [{"points": [
                     {"interval": {"endTime": "2026-07-30T10:00:00Z"},

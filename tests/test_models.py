@@ -84,3 +84,89 @@ def test_eval_step():
 
 def test_flops_estimate():
     assert resnet_flops_per_image("resnet50") == pytest.approx(8.18e9, rel=0.01)
+
+
+class TestPackedTraining:
+    """Packed-batch (document-masked) LM training end to end."""
+
+    def _setup(self, s=64):
+        from kubeflow_tpu.models import LMConfig, build_lm
+
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=2)
+        model = build_lm(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 64, size=(2, s)), jnp.int32)
+        seg = jnp.asarray(
+            np.repeat([0, 1], [s // 4, s - s // 4])[None].repeat(2, 0),
+            jnp.int32,
+        )
+        params = model.init(jax.random.key(0), tokens)["params"]
+        return cfg, model, params, tokens, seg
+
+    def test_packed_forward_equals_separate_documents(self):
+        cfg, model, params, tokens, seg = self._setup()
+        cut = 16
+        packed = model.apply({"params": params}, tokens, seg)
+        # Document 0 starts at position 0 in both layouts, so its
+        # packed logits must equal running it standalone (doc 1 sits at
+        # a different absolute offset under the packing convention, so
+        # its standalone run legitimately differs).
+        doc0 = model.apply({"params": params}, tokens[:, :cut])
+        np.testing.assert_allclose(
+            np.asarray(packed[:, :cut]), np.asarray(doc0),
+            rtol=2e-4, atol=2e-4,
+        )
+        # And the whole packed layout must agree across attention
+        # implementations (flash kernels vs XLA reference).
+        from kubeflow_tpu.models import build_lm
+
+        ref_model = build_lm(cfg, use_flash=False)
+        ref = ref_model.apply({"params": params}, tokens, seg)
+        np.testing.assert_allclose(
+            np.asarray(packed), np.asarray(ref), rtol=2e-4, atol=2e-4,
+        )
+
+    def test_loss_masks_document_boundaries(self):
+        from kubeflow_tpu.models.transformer import lm_loss
+
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.normal(size=(1, 8, 16)), jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, 16, size=(1, 8)), jnp.int32)
+        seg = jnp.asarray([[0, 0, 0, 0, 1, 1, 1, 1]], jnp.int32)
+        masked = float(lm_loss(logits, tokens, seg))
+        # Hand-computed: mean CE over the 6 within-document transitions
+        # (position 3 -> 4 crosses the boundary and is excluded).
+        import optax
+
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]
+        )[0]
+        keep = [0, 1, 2, 4, 5, 6]
+        expect = float(np.mean([float(ce[i]) for i in keep]))
+        np.testing.assert_allclose(masked, expect, rtol=1e-6)
+
+    def test_packed_train_step_descends(self):
+        from kubeflow_tpu.models import create_lm_state, make_lm_train_step
+
+        cfg, model, params, tokens, seg = self._setup()
+        state = create_lm_state(model, jax.random.key(1), tokens.shape)
+        step = make_lm_train_step(cfg=cfg)
+        batch = {"tokens": tokens, "segment_ids": seg}
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_ring_path_rejects_segments(self):
+        from kubeflow_tpu.models import LMConfig, build_lm
+        from kubeflow_tpu.parallel import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(dp=-1, sp=2))
+        cfg = LMConfig(vocab=64, layers=1, dim=32, heads=2)
+        model = build_lm(cfg, mesh=mesh)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)["params"]
+        with pytest.raises(NotImplementedError, match="ring"):
+            model.apply({"params": params}, tokens,
+                        jnp.zeros((2, 16), jnp.int32))
