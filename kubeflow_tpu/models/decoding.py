@@ -204,6 +204,12 @@ def generate(
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.moe_experts and cfg.moe_router == "expert_choice":
+        raise NotImplementedError(
+            "expert-choice routing selects tokens ACROSS the sequence "
+            "(experts pick their top-C tokens), which is not causal - "
+            "autoregressive decode requires topk routing"
+        )
     if temperature > 0.0 and rng is None:
         raise ValueError(
             "temperature > 0 samples from the categorical distribution; "

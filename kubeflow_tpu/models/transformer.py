@@ -65,6 +65,12 @@ class LMConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # "topk": tokens choose experts (Switch/GShard above). "expert
+    # choice": experts choose their top-C tokens (Zhou et al. 2022) —
+    # perfectly balanced by construction, no aux loss, but selection
+    # looks across the whole sequence (acceptable for training; not
+    # valid for autoregressive decode, which decoding.py rejects).
+    moe_router: str = "topk"
 
     def __post_init__(self):
         if self.attn_window is not None and self.attn_window < 1:
@@ -84,6 +90,11 @@ class LMConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} must be in "
                 f"[1, moe_experts={self.moe_experts}]"
+            )
+        if self.moe_router not in ("topk", "expert_choice"):
+            raise ValueError(
+                f"moe_router must be topk|expert_choice, got "
+                f"{self.moe_router!r}"
             )
 
     @property
@@ -130,10 +141,11 @@ class MoEFFN(nn.Module):
         cfg = self.cfg
         b, s, d = x.shape
         e = cfg.moe_experts
-        k = cfg.moe_top_k
-        # Capacity scales with k: each token makes k assignments.
-        cap = max(1, int(cfg.moe_capacity_factor * k * s / e))
-        hidden = cfg.mlp_ratio * d
+        # topk: capacity scales with k (each token makes k
+        # assignments). expert_choice: capacity IS the per-expert
+        # token count (factor * S / E), k plays no role.
+        cap_k = cfg.moe_top_k if cfg.moe_router == "topk" else 1
+        cap = max(1, int(cfg.moe_capacity_factor * cap_k * s / e))
 
         # Router in f32: softmax over experts must not run in bf16.
         logits = nn.Dense(
@@ -142,6 +154,20 @@ class MoEFFN(nn.Module):
         )(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)          # (B, S, E)
 
+        if cfg.moe_router == "expert_choice":
+            dispatch_t, combine_t = self._expert_choice_routing(
+                probs, cap
+            )
+            return self._expert_ffn(x, dispatch_t, combine_t)
+        return self._expert_ffn(
+            x, *self._topk_routing(probs, cap)
+        )
+
+    def _topk_routing(self, probs, cap):
+        """Tokens choose experts (Switch k=1 / GShard k=2)."""
+        cfg = self.cfg
+        b, s, e = probs.shape
+        k = cfg.moe_top_k
         # Per-choice expert assignment: argmax, then re-argmax with the
         # previous choices masked out (k is tiny and static — the loop
         # unrolls at trace time).
@@ -201,9 +227,46 @@ class MoEFFN(nn.Module):
             "intermediates", "moe_slot_max",
             jnp.max(dispatch_t.sum(axis=1)),
         )
+        return dispatch_t, combine_t
 
-        # To expert-major layout: with experts sharded on ep this einsum
-        # is the all-to-all.
+    def _expert_choice_routing(self, probs, cap):
+        """Experts choose tokens (Zhou et al. 2022, expert-choice
+        routing): each expert takes its top-``cap`` tokens by router
+        affinity. Perfectly balanced by construction — every expert
+        processes exactly ``cap`` assignments, so there is no aux loss
+        and no over-capacity drop. A token may be picked by several
+        experts (outputs combine additively) or by none (residual
+        passthrough). Selection looks across the sequence, which is
+        fine for training but invalid for autoregressive decode
+        (decoding.py rejects the config)."""
+        b, s, e = probs.shape
+        # (B, E, S) affinity; top-cap token indices per (batch, expert).
+        gates, idx = jax.lax.top_k(
+            probs.transpose(0, 2, 1), min(cap, s)
+        )                                            # both (B, E, C)
+        sel = jax.nn.one_hot(idx, s, dtype=jnp.float32)  # (B, E, C, S)
+        dispatch_t = sel.transpose(0, 3, 1, 2)           # (B, S, E, C)
+        combine_t = (
+            sel * gates[..., None]
+        ).transpose(0, 3, 1, 2)                          # (B, S, E, C)
+        self.sow(
+            "intermediates", "moe_expert_load",
+            dispatch_t.sum(axis=(0, 1, 3)),
+        )
+        self.sow(
+            "intermediates", "moe_slot_max",
+            jnp.max(dispatch_t.sum(axis=1)),
+        )
+        return dispatch_t, combine_t
+
+    def _expert_ffn(self, x, dispatch_t, combine_t):
+        """The shared expert computation: dense dispatch to the
+        expert-major layout (the ICI all-to-all when experts shard over
+        ep), per-expert 2-layer FFN, combine back."""
+        cfg = self.cfg
+        _, _, d = x.shape
+        e = cfg.moe_experts
+        hidden = cfg.mlp_ratio * d
         expert_in = jnp.einsum(
             "bsec,bsd->ebcd", dispatch_t.astype(cfg.dtype),
             x.astype(cfg.dtype),
