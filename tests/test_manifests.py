@@ -4,6 +4,7 @@ cluster — every YAML parses, every kustomization resource resolves, and
 the CRDs agree with the API-version constants the code uses)."""
 
 import os
+import re
 
 import pytest
 import yaml
@@ -236,15 +237,38 @@ class TestDeployability:
 
     def test_kind_workflow_is_load_bearing(self):
         """The integration workflow must not soft-fail the deploy
-        (round-1 verdict weak #2: '|| true' made it assert nothing)."""
+        (round-1 verdict weak #2: '|| true' made it assert nothing).
+
+        Allowed soft-fail forms, which cannot mask a failing step:
+          - log tails (``--tail=N || true``) — diagnostics only;
+          - ``|| true`` INSIDE a ``$(...)`` capture (polling loops read
+            transient state, e.g. a pod uid mid-recreation), provided a
+            hard assertion on the captured variable follows.
+        Any other ``|| true`` is a soft-failed load-bearing step.
+        """
         path = os.path.join(self.REPO, ".github", "workflows",
                             "kind_integration.yaml")
         content = open(path).read()
-        assert "|| true" not in content.replace(
-            "--tail=100 || true", ""
-        ).replace("--tail=200 || true", ""), (
+        stripped = re.sub(r"--tail=\d+ \|\| true", "", content)
+        # A '$( ... || true)' command substitution (no statement-level
+        # '(cmd || true)' subshells — those soft-fail the step itself).
+        capture_uses = re.findall(
+            r"\$\([^()]*\|\| true\)", stripped, re.S
+        )
+        stripped = re.sub(r"\$\([^()]*\|\| true\)", "$()", stripped, flags=re.S)
+        assert "|| true" not in stripped, (
             "soft-failure on a load-bearing step"
         )
+        if capture_uses:
+            # The gang-restart poll captures pod uids with a tolerated
+            # lookup failure; the hard assert AFTER the loop must stay
+            # (on its own line — the in-loop '... && break' copy does
+            # not fail the step when the poll times out).
+            assert re.search(
+                r'^\s*\[ -n "\$\{new0\}" \] '
+                r'&& \[ "\$\{new0\}" != "\$\{uid0\}" \]\s*$',
+                content, re.M,
+            ), "polling capture uses '|| true' without a post-loop hard assert"
         for needle in ["docker/build_services.sh", "kind load docker-image",
                        "--for=condition=Available",
                        "kustomize build manifests/ | kubectl apply -f -"]:
