@@ -199,21 +199,31 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
         rng.integers(0, cfg.vocab, size=(prefill_reps, batch, prompt_len)),
         jnp.int32,
     )
-    # Warmup (compile all shapes), then timed passes.
+    # Warmup (compile all shapes), then timed passes. Median-of-k on the
+    # timed pass: the round-3 record caught batch-1 prefill 21% under its
+    # anchor while a local rerun was 25% over — single-shot timing on the
+    # relay is too noisy to regression-gate on (BENCH_r03.json).
+    reps = _env_int("KFT_BENCH_TIMING_REPS", 3)
     first, cache = prefill(params, prompt)
     int(jax.device_get(first)[0])
     int(jax.device_get(prefill_many(params, prompts)))
-    t0 = time.perf_counter()
-    int(jax.device_get(prefill_many(params, prompts)))
-    prefill_dt = time.perf_counter() - t0
+    prefill_dts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        int(jax.device_get(prefill_many(params, prompts)))
+        prefill_dts.append(time.perf_counter() - t0)
+    prefill_dt = float(np.median(prefill_dts))
     prefill_tok_s = prefill_reps * batch * prompt_len / prefill_dt
 
     last, cache2, _ = decode_chunk(params, first, cache)
     int(jax.device_get(last)[0])
-    t0 = time.perf_counter()
-    last, _, toks = decode_chunk(params, first, cache)
-    int(jax.device_get(last)[0])
-    decode_dt = time.perf_counter() - t0
+    decode_dts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        last, _, toks = decode_chunk(params, first, cache)
+        int(jax.device_get(last)[0])
+        decode_dts.append(time.perf_counter() - t0)
+    decode_dt = float(np.median(decode_dts))
     decode_tok_s = batch * new_tokens / decode_dt
 
     return {
@@ -411,57 +421,90 @@ def main():
     # Default: the full driver record — ResNet primary + LM extras.
     # Each extra section fails independently: the primary metric AND
     # every other section must still be reported (e.g. one OOM on an
-    # unexpected device must not drop the long-context record).
+    # unexpected device must not drop the long-context record). Relay
+    # weather (transient INTERNAL/read-body errors on the axon tunnel)
+    # cost round 3 its flagship seq-2048 LM number: every section now
+    # gets bounded retries, mandatory sections get more, and a section
+    # that still fails is recorded with its metric NAME so the hole is
+    # attributable in BENCH_r*.json.
     record = bench_resnet()
     extras = []
     long_seq = _env_int("KFT_BENCH_LONG_SEQ", 8192)
     long_steps = _env_int("KFT_BENCH_LONG_STEPS", 5)
     long_warmup = _env_int("KFT_BENCH_LONG_WARMUP", 2)
     new_tokens = _env_int("KFT_BENCH_NEW_TOKENS", 256)
-    for section in (
-        lambda: bench_lm(
+    sections = [
+        # (metric-name, mandatory, thunk)
+        ("lm_train_tokens_per_sec_per_chip", True, lambda: bench_lm(
             metric="lm_train_tokens_per_sec_per_chip",
             anchor_tokens_s=lm_anchor, **lm_defaults,
-        ),
-        lambda: bench_lm(
+        )),
+        ("lm_long_context_tokens_per_sec_per_chip", False, lambda: bench_lm(
             metric="lm_long_context_tokens_per_sec_per_chip",
             anchor_tokens_s=long_anchor,
             batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
             seq=long_seq, steps=long_steps, warmup=long_warmup,
-        ),
-        lambda: bench_lm(
+        )),
+        ("lm_long_context_32k_tokens_per_sec_per_chip", False,
+         lambda: bench_lm(
             metric="lm_long_context_32k_tokens_per_sec_per_chip",
             anchor_tokens_s=long32k_anchor,
             batch=1,
             seq=_env_int("KFT_BENCH_LONG32K_SEQ", 32768),
             steps=_env_int("KFT_BENCH_LONG32K_STEPS", 3),
             warmup=_env_int("KFT_BENCH_LONG32K_WARMUP", 1),
-        ),
-        lambda: bench_lm(
+        )),
+        ("lm_sliding_window_tokens_per_sec_per_chip", False,
+         lambda: bench_lm(
             metric="lm_sliding_window_tokens_per_sec_per_chip",
             anchor_tokens_s=window_anchor,
             batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
             seq=long_seq, steps=long_steps, warmup=long_warmup,
             window=_env_int("KFT_BENCH_WINDOW", 1024),
-        ),
-        lambda: bench_decode(
+        )),
+        ("lm_decode_tokens_per_sec_per_chip[b1]", False,
+         lambda: bench_decode(
             batch=1, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
             new_tokens=new_tokens,
             prefill_anchor=prefill_anchor, decode_anchor=decode_anchor,
-        ),
-        lambda: bench_decode(
+        )),
+        ("lm_decode_tokens_per_sec_per_chip[b8]", False,
+         lambda: bench_decode(
             batch=8, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
             new_tokens=new_tokens,
             prefill_anchor=prefill_b8_anchor,
             decode_anchor=decode_b8_anchor,
-        ),
-    ):
-        try:
-            extras.append(section())
-        except Exception as exc:  # pragma: no cover - defensive
-            extras.append({"metric": "bench_extra_error", "error": str(exc)})
+        )),
+    ]
+    for name, mandatory, section in sections:
+        attempts = _env_int(
+            "KFT_BENCH_RETRIES_MANDATORY" if mandatory
+            else "KFT_BENCH_RETRIES", 4 if mandatory else 3,
+        )
+        last_exc = None
+        for attempt in range(attempts):
+            try:
+                extras.append(section())
+                last_exc = None
+                break
+            except Exception as exc:  # pragma: no cover - relay weather
+                last_exc = exc
+                time.sleep(min(10.0, 2.0 * (attempt + 1)))
+        if last_exc is not None:
+            extras.append({
+                "metric": "bench_extra_error", "section": name,
+                "attempts": attempts, "error": str(last_exc),
+            })
     record["extra_metrics"] = extras
     print(json.dumps(record))
+    # A record without the flagship LM section is incomplete: signal the
+    # driver via exit status (the JSON line above is already emitted, so
+    # the partial record is still captured either way).
+    if any(e.get("metric") == "bench_extra_error"
+           and any(m for (m, mand, _) in sections
+                   if mand and m == e.get("section"))
+           for e in extras):
+        raise SystemExit(3)
 
 
 if __name__ == "__main__":
