@@ -36,14 +36,24 @@ else:  # pre-pcast JAX releases
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
-                   window=None):
+                   window=None, segment_ids=None):
     """Attention over a sequence-sharded axis; call inside shard_map.
 
     q: local shard (batch, heads, seq_local, head_dim); k/v the same
     with ``kv_heads`` dividing ``heads`` (GQA). All sharded on dim 2
     over ``axis_name``. ``window`` bands the causal mask exactly like
-    flash_attention. Returns the local output shard. Differentiable
-    (the scan + ppermute transpose to the reverse ring).
+    flash_attention. ``segment_ids`` is the LOCAL (batch, seq_local)
+    shard of a packed batch's document ids; ids must be non-decreasing
+    along the GLOBAL sequence (the packed-batch layout), which makes
+    per-hop [min, max] range overlap an exact skip predicate across
+    shards too. Returns the local output shard. Differentiable (the
+    scan + ppermute transpose to the reverse ring).
+
+    Dead hops are skipped: a (q-shard, k-shard) pair that is entirely
+    above the causal diagonal, outside the window band, or in disjoint
+    documents contributes nothing, so the hop's matmuls run under a
+    ``lax.cond`` and only the ppermute executes — on a causal ring
+    that alone halves the average compute per device.
     """
     if window is not None:
         if not causal:
@@ -61,39 +71,91 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
     group = h // h_kv
     scale = d ** -0.5 if scale is None else scale
     shift = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    segmented = segment_ids is not None
     # GQA fold: q gains a (kv_heads, group) split so every einsum runs
     # against the COMPACT k/v shards — the arrays on the ring never
     # carry repeated heads.
     qg = q.reshape(b, h_kv, group, s_local, d)
+    if segmented:
+        seg_q = segment_ids
+        # Per-batch-row document ranges of the local q shard; the k
+        # shard's ranges rotate with it (two (b,) vectors per hop —
+        # noise next to the k/v payload).
+        q_min = jnp.min(seg_q, axis=1)
+        q_max = jnp.max(seg_q, axis=1)
 
     def step(carry, t):
-        o, m, l, k_t, v_t = carry
+        if segmented:
+            o, m, l, k_t, v_t, seg_t, kmin_t, kmax_t = carry
+        else:
+            o, m, l, k_t, v_t = carry
         # After t clockwise rotations this device holds the shard that
         # originated on device (my_shard - t) mod axis_size.
         src = (my_shard - t) % axis_size
-        # Matmuls keep the input dtype (bf16 in production) with f32
-        # accumulation — casting operands to f32 would force the slow
-        # MXU path (same rule as the flash kernel). Softmax statistics
-        # and the output accumulator stay f32.
-        s = jnp.einsum(
-            "bngqd,bnkd->bngqk", qg, k_t,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            s = _causal_mask(s, my_shard * s_local, src * s_local, window)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * alpha + jnp.einsum(
-            "bngqk,bnkd->bngqd", p.astype(v_t.dtype), v_t,
-            preferred_element_type=jnp.float32,
-        )
+
+        def compute(o, m, l, k_t, v_t):
+            # Matmuls keep the input dtype (bf16 in production) with f32
+            # accumulation — casting operands to f32 would force the
+            # slow MXU path (same rule as the flash kernel). Softmax
+            # statistics and the output accumulator stay f32.
+            s = jnp.einsum(
+                "bngqd,bnkd->bngqk", qg, k_t,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                s = _causal_mask(
+                    s, my_shard * s_local, src * s_local, window
+                )
+            if segmented:
+                keep = (seg_q[:, None, None, :, None]
+                        == seg_t[:, None, None, None, :])
+                s = jnp.where(keep, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            o_new = o * alpha + jnp.einsum(
+                "bngqk,bnkd->bngqd", p.astype(v_t.dtype), v_t,
+                preferred_element_type=jnp.float32,
+            )
+            return o_new, m_new, l_new
+
+        if causal or segmented:
+            # Dead-hop predicate, the shard-level analogue of
+            # attention.py's _block_live/_segments_overlap with
+            # block size = s_local.
+            live = True
+            if causal:
+                live = jnp.logical_and(live, src <= my_shard)
+                if window is not None:
+                    live = jnp.logical_and(
+                        live,
+                        (src + 1) * s_local + window
+                        > my_shard * s_local + 1,
+                    )
+            if segmented:
+                live = jnp.logical_and(
+                    live,
+                    jnp.any(jnp.logical_and(q_min <= kmax_t,
+                                            kmin_t <= q_max)),
+                )
+            o, m, l = jax.lax.cond(
+                live, compute, lambda o, m, l, k_t, v_t: (o, m, l),
+                o, m, l, k_t, v_t,
+            )
+        else:
+            o, m, l = compute(o, m, l, k_t, v_t)
         # Rotate k/v one ICI hop (the final rotation returns them home —
         # a wasted hop, but it keeps the scan body uniform).
         k_next = jax.lax.ppermute(k_t, axis_name, shift)
         v_next = jax.lax.ppermute(v_t, axis_name, shift)
-        return (o_new, m_new, l_new, k_next, v_next), None
+        if segmented:
+            seg_next = jax.lax.ppermute(seg_t, axis_name, shift)
+            kmin_next = jax.lax.ppermute(kmin_t, axis_name, shift)
+            kmax_next = jax.lax.ppermute(kmax_t, axis_name, shift)
+            return (o, m, l, k_next, v_next,
+                    seg_next, kmin_next, kmax_next), None
+        return (o, m, l, k_next, v_next), None
 
     acc_shape = (b, h_kv, group, s_local, d)
     stats_shape = (b, h_kv, group, s_local, 1)
@@ -107,7 +169,13 @@ def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,
         k,
         v,
     )
-    (o, _, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(axis_size))
+    if segmented:
+        init = init + (seg_q, q_min, q_max)
+    out = jax.lax.scan(step, init, jnp.arange(axis_size))[0]
+    o, l = out[0], out[2]
+    # A fully-masked row (can't happen with causal self-inclusion, but
+    # guard the l=0 division) would produce inf; causal rows always see
+    # themselves so l >= exp(0) > 0.
     return (o / l).reshape(b, h, s_local, d).astype(q.dtype)
 
 
@@ -117,18 +185,19 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     the ring inside shard_map. Drop-in for an attention impl taking
     (q, k, v, causal) as global (batch, heads, seq, head_dim) arrays."""
     spec = P(None, None, axis_name, None)
+    seg_spec = P(None, axis_name)
 
     def attend(q, k, v, causal=False, segment_ids=None):
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "document masks are not implemented on the ring path "
-                "yet; pack on a non-sp mesh (flash_attention supports "
-                "segment_ids single-chip and under dp/fsdp/tp/pp)"
-            )
         fn = functools.partial(
             ring_attention, axis_name=axis_name, causal=causal,
             window=window,
         )
+        if segment_ids is not None:
+            return jax.shard_map(
+                lambda q, k, v, seg: fn(q, k, v, segment_ids=seg),
+                mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+                out_specs=spec,
+            )(q, k, v, segment_ids)
         return jax.shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )(q, k, v)
