@@ -103,15 +103,19 @@ class PipelinedLM:
         cfg = self.cfg
         attn = None
         if jax.default_backend() == "tpu":
-            attn = lambda q, k, v, causal=True: flash_attention(
-                q, k, v, causal=causal, window=cfg.attn_window
-            )
+            attn = lambda q, k, v, causal=True, segment_ids=None: \
+                flash_attention(
+                    q, k, v, causal=causal, window=cfg.attn_window,
+                    segment_ids=segment_ids,
+                )
         elif cfg.attn_window is not None:
             # Off-TPU the Block default is plain mha_reference, which
             # would silently drop the window — pass it explicitly.
-            attn = lambda q, k, v, causal=True: mha_reference(
-                q, k, v, causal=causal, window=cfg.attn_window
-            )
+            attn = lambda q, k, v, causal=True, segment_ids=None: \
+                mha_reference(
+                    q, k, v, causal=causal, window=cfg.attn_window,
+                    segment_ids=segment_ids,
+                )
         return Block(cfg, attn_impl=attn)
 
     @property
@@ -122,10 +126,11 @@ class PipelinedLM:
             # the sequence sharded over sp, so attention is the ring
             # (raw per-shard form — same region, no shard_map nesting)
             # and RoPE offsets come from the sp shard index.
-            attn = lambda q, k, v, causal=True: ring_attention(
-                q, k, v, axis_name="sp", causal=causal,
-                window=cfg.attn_window,
-            )
+            attn = lambda q, k, v, causal=True, segment_ids=None: \
+                ring_attention(
+                    q, k, v, axis_name="sp", causal=causal,
+                    window=cfg.attn_window, segment_ids=segment_ids,
+                )
             return Block(cfg, attn_impl=attn, rope_offset_axis="sp")
         return self._plain_block
 
@@ -152,24 +157,40 @@ class PipelinedLM:
             "final_norm": RMSNorm().init(r_norm, dummy_x)["params"],
         }
 
-    def apply(self, variables, tokens: jax.Array) -> jax.Array:
+    def apply(self, variables, tokens: jax.Array,
+              segment_ids: jax.Array | None = None) -> jax.Array:
         """tokens (B, S) int32 -> logits (B, S, vocab) f32. B must be
         divisible by num_microbatches (times the dp shard count for an
-        even per-device split, as with any dp batch)."""
+        even per-device split, as with any dp batch). ``segment_ids``
+        (B, S) enables packed batches: the ids microbatch alongside the
+        tokens and ride the schedules as a per-microbatch side input
+        (indexed at each stage, never circulated)."""
         params = variables["params"]
         cfg, mesh = self.cfg, self.mesh
         block = self._block
         embed = self._embed
 
         x = embed.apply({"params": params["embed"]}, tokens)
+        packed = segment_ids is not None
 
-        def stage_fn(stage_params, h):
-            # One stage = lax.scan over its layers/pp consecutive blocks.
-            def layer(h, layer_params):
-                return block.apply({"params": layer_params}, h), None
+        if packed:
+            def stage_fn(stage_params, h, seg):
+                def layer(h, layer_params):
+                    return block.apply(
+                        {"params": layer_params}, h, seg
+                    ), None
 
-            h, _ = jax.lax.scan(layer, h, stage_params)
-            return h
+                h, _ = jax.lax.scan(layer, h, stage_params)
+                return h
+        else:
+            def stage_fn(stage_params, h):
+                # One stage = lax.scan over its layers/pp consecutive
+                # blocks.
+                def layer(h, layer_params):
+                    return block.apply({"params": layer_params}, h), None
+
+                h, _ = jax.lax.scan(layer, h, stage_params)
+                return h
 
         common = dict(
             num_microbatches=self.num_microbatches,
@@ -178,6 +199,12 @@ class PipelinedLM:
             # manual region for the blocks' ring collectives.
             activation_spec=(
                 P(None, None, "sp", None) if self._sp > 1 else None
+            ),
+            # Segment ids shard over sp with the sequence, like the
+            # activations they mask.
+            extra_spec=(
+                (P(None, None, "sp") if self._sp > 1 else P())
+                if packed else None
             ),
             extra_manual_axes=("sp",) if self._sp > 1 else (),
             # Minimal redistribution of the last stage's output AND the
@@ -192,11 +219,16 @@ class PipelinedLM:
             run = one_f_one_b(stage_fn, mesh, **common)
         else:
             run = gpipe(stage_fn, mesh, remat=self.remat, **common)
-        x = run(stage_stack(params["blocks"], mesh.shape["pp"]), x)
+        stacked = stage_stack(params["blocks"], mesh.shape["pp"])
+        if packed:
+            x = run(stacked, x, segment_ids)
+        else:
+            x = run(stacked, x)
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
         return self._head(params, x)
 
-    def sequential_apply(self, variables, tokens: jax.Array) -> jax.Array:
+    def sequential_apply(self, variables, tokens: jax.Array,
+                         segment_ids: jax.Array | None = None) -> jax.Array:
         """The same computation with a plain sequential layer loop and no
         pipeline/manual communication — the numerical reference the
         gpipe path must match (used by tests; also the single-chip
@@ -206,7 +238,9 @@ class PipelinedLM:
         x = embed.apply({"params": params["embed"]}, tokens)
 
         def layer(h, layer_params):
-            return block.apply({"params": layer_params}, h), None
+            return block.apply(
+                {"params": layer_params}, h, segment_ids
+            ), None
 
         x, _ = jax.lax.scan(layer, x, params["blocks"])
         x = RMSNorm().apply({"params": params["final_norm"]}, x)
@@ -268,19 +302,17 @@ def make_pp_lm_train_step(model: PipelinedLM):
     token_sh = token_sharding(model.mesh)
 
     def step(state: TrainState, batch):
-        if "segment_ids" in batch:
-            # Same loud guard as the ring path: silently ignoring the
-            # document mask would train across packed boundaries.
-            raise NotImplementedError(
-                "packed batches (segment_ids) are not threaded through "
-                "the pipeline schedules yet; use make_lm_train_step on "
-                "a non-pp mesh"
-            )
         tokens = jax.lax.with_sharding_constraint(batch["tokens"], token_sh)
+        seg = batch.get("segment_ids")
+        if seg is not None:
+            # Packed batch: the ids microbatch alongside the tokens,
+            # mask attention inside every stage, and exclude
+            # cross-document targets from the loss.
+            seg = jax.lax.with_sharding_constraint(seg, token_sh)
 
         def loss_fn(params):
-            logits = state.apply_fn({"params": params}, tokens)
-            return lm_loss(logits, tokens)
+            logits = state.apply_fn({"params": params}, tokens, seg)
+            return lm_loss(logits, tokens, seg)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = state.tx.update(

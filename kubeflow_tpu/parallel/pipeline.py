@@ -77,18 +77,24 @@ def _validate(act_spec: P, output: str, num_microbatches: int,
 
 
 def _microbatched(pipeline_fn, num_microbatches: int):
-    """Shared (B, ...) <-> (M, mb, ...) wrapper for both schedules."""
+    """Shared (B, ...) <-> (M, mb, ...) wrapper for both schedules.
+    ``extra`` (e.g. segment ids for packed batches) microbatches the
+    same way and rides NEXT TO the activations — it is indexed per
+    microbatch at each stage, never circulated."""
 
-    def run(stage_params, x):
+    def run(stage_params, x, extra=None):
         if x.shape[0] % num_microbatches:
             raise ValueError(
                 f"batch {x.shape[0]} not divisible by "
                 f"num_microbatches={num_microbatches}"
             )
-        xm = x.reshape(
-            num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:]
-        )
-        ym = pipeline_fn(stage_params, xm)
+        mb = x.shape[0] // num_microbatches
+        xm = x.reshape(num_microbatches, mb, *x.shape[1:])
+        if extra is not None:
+            em = extra.reshape(num_microbatches, mb, *extra.shape[1:])
+            ym = pipeline_fn(stage_params, xm, em)
+        else:
+            ym = pipeline_fn(stage_params, xm)
         return ym.reshape(x.shape[0], *ym.shape[2:])
 
     return run
@@ -103,11 +109,15 @@ def _out_spec(act_spec: P, axis: str, output: str) -> P:
     return act_spec
 
 
-def _forward_ticks(stage_fn, params, xm, idx, axis, num_stages, output):
+def _forward_ticks(stage_fn, params, xm, idx, axis, num_stages, output,
+                   em=None):
     """The GPipe forward schedule body, shared by both schedules (the
     1F1B primal IS the GPipe forward; only backwards differ): tick
     scan with ppermute circulation, last-stage output buffer, and the
-    output-mode emission."""
+    output-mode emission. ``em`` is the optional per-microbatch side
+    input: stage ``idx`` at tick ``t`` runs microbatch ``t - idx``, so
+    it is indexed, not circulated (bubble ticks read a clipped index
+    whose result is discarded)."""
     n_mb = xm.shape[0]
     perm = [(i, i + 1) for i in range(num_stages - 1)]
 
@@ -120,7 +130,14 @@ def _forward_ticks(stage_fn, params, xm, idx, axis, num_stages, output):
         x_t = jax.lax.dynamic_index_in_dim(
             xm, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False
         )
-        out = stage_fn(params, jnp.where(idx == 0, x_t, recv))
+        x_in = jnp.where(idx == 0, x_t, recv)
+        if em is None:
+            out = stage_fn(params, x_in)
+        else:
+            e_in = jax.lax.dynamic_index_in_dim(
+                em, jnp.clip(t - idx, 0, n_mb - 1), 0, keepdims=False
+            )
+            out = stage_fn(params, x_in, e_in)
         # The last stage finishes microbatch t-(P-1) at tick t.
         w = t - (num_stages - 1)
         w_clip = jnp.clip(w, 0, n_mb - 1)
@@ -165,6 +182,7 @@ def gpipe(
     axis: str = "pp",
     remat: bool = False,
     activation_spec: P | None = None,
+    extra_spec: P | None = None,
     extra_manual_axes: tuple[str, ...] = (),
     output: str = "replicated",
 ):
@@ -196,6 +214,11 @@ def gpipe(
     resolve. The spec indexes MICROBATCHED activations: dim 0 is the
     microbatch axis the schedule owns and must stay unsharded.
 
+    ``extra_spec`` enables a per-microbatch SIDE input (``run(params, x,
+    extra)``, e.g. packed-batch segment ids): microbatched like x,
+    replicated over pp, indexed by each stage at the microbatch it is
+    running — never circulated through the ppermute chain.
+
     Differentiable end-to-end: ppermute/psum have exact transposes, so
     ``jax.grad`` through the returned function yields the GPipe backward
     pass with cotangents flowing stage-to-stage in reverse.
@@ -205,16 +228,18 @@ def gpipe(
         stage_fn = jax.checkpoint(stage_fn)
     act_spec = P() if activation_spec is None else activation_spec
     _validate(act_spec, output, num_microbatches, num_stages)
+    has_extra = extra_spec is not None
+    in_specs = (P(axis), act_spec) + ((extra_spec,) if has_extra else ())
 
     @partial(
         jax.shard_map,
         mesh=mesh,
         axis_names=frozenset({axis, *extra_manual_axes}),
-        in_specs=(P(axis), act_spec),
+        in_specs=in_specs,
         out_specs=_out_spec(act_spec, axis, output),
         check_vma=False,
     )
-    def run_sharded(stage_params, xm):
+    def run_sharded(stage_params, xm, *maybe_em):
         # Per-device view: leading stage dim is now 1 — this device's
         # stage. (M, mb, ...) microbatches are replicated over pp.
         # Open chain, not a ring: the last stage's output would only be
@@ -224,7 +249,8 @@ def gpipe(
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
         idx = jax.lax.axis_index(axis)
         return _forward_ticks(
-            stage_fn, params, xm, idx, axis, num_stages, output
+            stage_fn, params, xm, idx, axis, num_stages, output,
+            em=maybe_em[0] if maybe_em else None,
         )
 
     return _microbatched(run_sharded, num_microbatches)
@@ -271,6 +297,7 @@ def one_f_one_b(
     num_microbatches: int,
     axis: str = "pp",
     activation_spec: P | None = None,
+    extra_spec: P | None = None,
     extra_manual_axes: tuple[str, ...] = (),
     output: str = "replicated",
 ):
@@ -299,33 +326,39 @@ def one_f_one_b(
     rev_perm = [(i + 1, i) for i in range(num_stages - 1)]
     F_tbl, B_tbl, R_tbl = _1f1b_tables(num_microbatches, num_stages)
     n_slots = int(F_tbl.shape[0])
+    has_extra = extra_spec is not None
+    extra_in = (extra_spec,) if has_extra else ()
 
     @partial(
         jax.shard_map,
         mesh=mesh,
         axis_names=manual_axes,
-        in_specs=(P(axis), act_spec),
+        in_specs=(P(axis), act_spec) + extra_in,
         out_specs=_out_spec(act_spec, axis, output),
         check_vma=False,
     )
-    def fwd_sharded(stage_params, xm):
+    def fwd_sharded(stage_params, xm, *maybe_em):
         # The 1F1B primal IS the GPipe forward (schedules only differ
         # in the backward); custom_vjp owns the residuals.
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
         idx = jax.lax.axis_index(axis)
         return _forward_ticks(
-            stage_fn, params, xm, idx, axis, num_stages, output
+            stage_fn, params, xm, idx, axis, num_stages, output,
+            em=maybe_em[0] if maybe_em else None,
         )
 
     @partial(
         jax.shard_map,
         mesh=mesh,
         axis_names=manual_axes,
-        in_specs=(P(axis), act_spec, _out_spec(act_spec, axis, output)),
+        in_specs=(P(axis), act_spec) + extra_in
+        + (_out_spec(act_spec, axis, output),),
         out_specs=(P(axis), act_spec),
         check_vma=False,
     )
-    def bwd_sharded(stage_params, xm, ym_bar):
+    def bwd_sharded(stage_params, xm, *em_and_ybar):
+        em = em_and_ybar[0] if has_extra else None
+        ym_bar = em_and_ybar[-1]
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
         idx = jax.lax.axis_index(axis)
         is_first = idx == 0
@@ -370,12 +403,23 @@ def one_f_one_b(
                 xbuf, jnp.where(store_f, x_own, keep_f), slot_f, 0
             )
 
+            def _stage_at(mb_idx):
+                """stage_fn closed over this slot's side input (the
+                microbatch's segment ids); identity when none."""
+                if em is None:
+                    return stage_fn
+                e_in = jax.lax.dynamic_index_in_dim(
+                    em, jnp.clip(mb_idx, 0, em.shape[0] - 1), 0,
+                    keepdims=False,
+                )
+                return lambda p, x: stage_fn(p, x, e_in)
+
             def f_branch(op):
                 xbuf, _recv_cot = op
                 x_in = jax.lax.dynamic_index_in_dim(
                     xbuf, slot_f, 0, keepdims=False
                 )
-                y = stage_fn(params, x_in)
+                y = _stage_at(f_mb)(params, x_in)
                 return y, zero_mb, zero_params, zero_mb
 
             def b_branch(op):
@@ -389,7 +433,7 @@ def one_f_one_b(
                     keepdims=False,
                 )
                 cot = jnp.where(is_last, seed, recv_cot)
-                _, vjp_fn = jax.vjp(stage_fn, params, x_in)
+                _, vjp_fn = jax.vjp(_stage_at(b_mb), params, x_in)
                 dp, dx = vjp_fn(cot)
                 return zero_mb, dx, dp, dx
 
@@ -425,16 +469,35 @@ def one_f_one_b(
         dparams = jax.tree.map(lambda g: g[None], dparams)
         return dparams, dxm
 
-    @jax.custom_vjp
-    def pipeline(stage_params, xm):
-        return fwd_sharded(stage_params, xm)
+    if has_extra:
+        # Segment ids are integer side inputs: their cotangent is the
+        # symbolic-zero float0 array custom_vjp requires for int
+        # primals.
+        @jax.custom_vjp
+        def pipeline(stage_params, xm, em):
+            return fwd_sharded(stage_params, xm, em)
 
-    def pipeline_fwd(stage_params, xm):
-        return fwd_sharded(stage_params, xm), (stage_params, xm)
+        def pipeline_fwd(stage_params, xm, em):
+            return fwd_sharded(stage_params, xm, em), (
+                stage_params, xm, em,
+            )
 
-    def pipeline_bwd(res, ym_bar):
-        stage_params, xm = res
-        return bwd_sharded(stage_params, xm, ym_bar)
+        def pipeline_bwd(res, ym_bar):
+            stage_params, xm, em = res
+            dparams, dxm = bwd_sharded(stage_params, xm, em, ym_bar)
+            dem = np.zeros(em.shape, jax.dtypes.float0)
+            return dparams, dxm, dem
+    else:
+        @jax.custom_vjp
+        def pipeline(stage_params, xm):
+            return fwd_sharded(stage_params, xm)
+
+        def pipeline_fwd(stage_params, xm):
+            return fwd_sharded(stage_params, xm), (stage_params, xm)
+
+        def pipeline_bwd(res, ym_bar):
+            stage_params, xm = res
+            return bwd_sharded(stage_params, xm, ym_bar)
 
     pipeline.defvjp(pipeline_fwd, pipeline_bwd)
     return _microbatched(pipeline, num_microbatches)
