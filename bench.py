@@ -86,13 +86,16 @@ def _env_int(name: str, default: int) -> int:
 
 def bench_lm(seq: int, batch: int, steps: int, warmup: int,
              metric: str, anchor_tokens_s: float | None,
-             window: int | None = None):
+             window: int | None = None, moe_experts: int = 0,
+             moe_router: str = "topk"):
     """LM training tokens/s/chip through the Pallas flash-attention
     fwd+bwd path — the workload class the reference platform cannot
     even express (SURVEY.md §2.3). ``anchor_tokens_s`` is the fixed
     cross-round baseline (the round it was first measured), or None for
     configs first measured this round. ``window`` benches the
-    sliding-window (banded causal) kernels."""
+    sliding-window (banded causal) kernels; ``moe_experts`` swaps every
+    other FFN for a MoE layer (single-chip dense dispatch — the ep-mesh
+    all-to-all layout is covered by the multichip dryrun)."""
     from kubeflow_tpu.models import (
         LMConfig,
         build_lm,
@@ -102,7 +105,9 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
 
     cfg = LMConfig(
         vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16,
-        attn_window=window,
+        attn_window=window, moe_experts=moe_experts,
+        **({"moe_every": 2, "moe_router": moe_router}
+           if moe_experts else {}),
     )
     model = build_lm(cfg)
     state = create_lm_state(model, jax.random.key(0), (1, seq))
@@ -123,6 +128,8 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
         "seq": seq,
         "batch": batch,
         **({"window": window} if window is not None else {}),
+        **({"moe_experts": moe_experts, "moe_router": moe_router}
+           if moe_experts else {}),
         "step_ms": round(1000 * dt / steps, 2),
         "device": str(jax.devices()[0].device_kind),
     }
@@ -130,22 +137,25 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
 
 def bench_decode(batch: int, prompt_len: int, new_tokens: int,
                  prefill_anchor: float | None,
-                 decode_anchor: float | None):
+                 decode_anchor: float | None,
+                 window: int | None = None):
     """KV-cache inference throughput (models/decoding.py): prefill
     tokens/s (one full-prompt forward populating the cache) and
     steady-state decode tokens/s (a single compiled one-token step
     scanned ``new_tokens`` times inside ONE dispatch — per-dispatch
     relay latency must not be in the number). 8x1024 GQA config
     (kv_heads=2: the cache-bandwidth-bound regime decode optimisation
-    targets). Greedy sampling; sync via device_get (run_timed's relay
-    rule)."""
+    targets). ``window`` benches sliding-window decode from the
+    O(window) rolling cache. Greedy sampling; sync via device_get
+    (run_timed's relay rule)."""
     from kubeflow_tpu.models import LMConfig, build_lm
     from kubeflow_tpu.models.decoding import KVCache, forward_with_cache
 
     cfg = LMConfig(
         vocab=32768, layers=8, dim=1024, heads=8, kv_heads=2,
-        dtype=jnp.bfloat16,
+        dtype=jnp.bfloat16, attn_window=window,
     )
+    rolling = window is not None
     model = build_lm(cfg)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
@@ -162,7 +172,7 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
 
     @jax.jit
     def prefill(params, prompt):
-        cache = KVCache.init(cfg, batch, max_len)
+        cache = KVCache.init(cfg, batch, max_len, rolling=rolling)
         logits, cache = forward_with_cache(cfg, params, prompt, cache)
         first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return first, cache
@@ -170,7 +180,7 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
     @jax.jit
     def prefill_many(params, prompts):  # (R, B, P)
         def one(carry, prompt):
-            cache = KVCache.init(cfg, batch, max_len)
+            cache = KVCache.init(cfg, batch, max_len, rolling=rolling)
             logits, _ = forward_with_cache(cfg, params, prompt, cache)
             first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
             return carry ^ first[0], None
@@ -236,6 +246,8 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        **({"window": window, "rolling_cache": True}
+           if window is not None else {}),
         "decode_step_ms": round(1000 * decode_dt / new_tokens, 3),
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "prefill_vs_baseline": (
@@ -474,6 +486,49 @@ def main():
             new_tokens=new_tokens,
             prefill_anchor=prefill_b8_anchor,
             decode_anchor=decode_b8_anchor,
+        )),
+        # MoE LM (round 4): 8 experts every other layer, single-chip
+        # dense dispatch — regression-tracks the routing + expert-FFN
+        # einsum stack (ep-mesh all-to-alls are the dryrun's job).
+        ("lm_moe_tokens_per_sec_per_chip", False, lambda: bench_lm(
+            metric="lm_moe_tokens_per_sec_per_chip",
+            anchor_tokens_s=_env_anchor("KFT_BENCH_MOE_ANCHOR", 57605),
+            moe_experts=8, **lm_defaults,
+        )),
+        ("lm_moe_ec_tokens_per_sec_per_chip", False, lambda: bench_lm(
+            metric="lm_moe_ec_tokens_per_sec_per_chip",
+            anchor_tokens_s=_env_anchor("KFT_BENCH_MOE_EC_ANCHOR",
+                                        55721),
+            moe_experts=8, moe_router="expert_choice", **lm_defaults,
+        )),
+        # Long-prompt decode (round 4): flash-decode sweeps only the
+        # filled cache region, so these are the sections where the
+        # dense-read design used to degrade linearly with max_len.
+        ("lm_decode_tokens_per_sec_per_chip[b1-p8k]", False,
+         lambda: bench_decode(
+            batch=1, prompt_len=8192, new_tokens=128,
+            prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P8K_ANCHOR",
+                                       238379),
+            decode_anchor=_env_anchor("KFT_BENCH_DECODE_P8K_ANCHOR",
+                                      628),
+        )),
+        ("lm_decode_tokens_per_sec_per_chip[b1-p32k]", False,
+         lambda: bench_decode(
+            batch=1, prompt_len=32768, new_tokens=64,
+            prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P32K_ANCHOR",
+                                       165938),
+            decode_anchor=_env_anchor("KFT_BENCH_DECODE_P32K_ANCHOR",
+                                      286),
+        )),
+        # Sliding-window model decoding from the O(window) rolling
+        # cache: per-token cost must not grow with the prompt.
+        ("lm_decode_tokens_per_sec_per_chip[b1-p8k-w1k]", False,
+         lambda: bench_decode(
+            batch=1, prompt_len=8192, new_tokens=128, window=1024,
+            prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_W1K_ANCHOR",
+                                       307296),
+            decode_anchor=_env_anchor("KFT_BENCH_DECODE_W1K_ANCHOR",
+                                      977),
         )),
     ]
     for name, mandatory, section in sections:

@@ -11,11 +11,23 @@ prefix). TPU-native decode structure:
   buffer pair written with ``dynamic_update_slice`` — static shapes
   throughout, one compiled step re-used for every position
   (``lax.scan`` over the decode loop).
-- Attention at decode reads the FULL cache with a validity mask
-  (position iota vs current length) — masked lanes cost one VPU
-  compare, not a dynamic shape.
+- Decode attention defaults to ONE dense masked read of the cache —
+  measured fastest on v5e at every cache size to 32k (decode there is
+  fixed-overhead-bound; see ``_decode_attention``). Two blockwise
+  alternatives ship for longer caches/other hardware: the Pallas
+  flash-decode kernel (``KFT_DECODE_IMPL=kernel``,
+  ops/decode_attention.py) and an XLA ``fori_loop`` reference.
+- Prefill from an empty cache runs the training flash kernel over the
+  chunk itself (causal block-skip on the MXU) instead of a dense
+  masked read of the whole buffer — measured +29% prefill at b8 and
+  ~3x at S=8192, and it makes 32k prefill fit (the dense path's
+  (S, capacity) f32 score tensor OOMs at 32k).
 - GQA: q heads fold into (kv_heads, group) so the cache stays compact;
   sliding windows band the mask exactly like the training kernels.
+- Sliding-window models can decode from a ROLLING cache
+  (``KVCache.init(..., rolling=True)``): a ``window``-sized circular
+  buffer written at ``pos % window`` — memory AND bandwidth O(window)
+  regardless of how long generation runs.
 
 MoE decode reuses the training layer (transformer.MoEFFN) verbatim —
 the dense dispatch is position-independent. One deliberate semantic
@@ -43,34 +55,210 @@ from kubeflow_tpu.ops import apply_rope
 NEG_INF = -1e30
 
 
+# Cache block for the blockwise decode paths; capacity rounds up to a
+# multiple of this. 256 makes the common prompt+new budgets (e.g.
+# 1024+256) land exactly — with the dense read as the production
+# decode path, padding is pure wasted HBM traffic.
+DECODE_BLOCK = 256
+
+
 @dataclasses.dataclass
 class KVCache:
-    """Per-layer stacked K/V buffers + the filled length."""
+    """Per-layer stacked K/V buffers + the filled length.
 
-    k: jax.Array  # (layers, B, kv_heads, max_len, head_dim)
+    ``rolling=True`` (requires ``cfg.attn_window``) allocates a
+    window-sized circular buffer instead: position p lives in slot
+    ``p % capacity``, so memory stays O(window) no matter how far
+    generation runs. Only single-token steps and empty-cache prefill
+    write a rolling cache (exactly `generate`'s access pattern).
+
+    ``empty`` is a STATIC (pytree-meta) flag: True only on the cache
+    ``init`` returns, False on every cache ``forward_with_cache``
+    returns. It lets the prefill path pick the flash kernel at trace
+    time — ``length`` is a tracer under jit, so the dispatch cannot
+    read it.
+    """
+
+    k: jax.Array  # (layers, B, kv_heads, capacity, head_dim)
     v: jax.Array
     length: jax.Array  # () int32 — tokens written so far
+    rolling: bool = False
+    empty: bool = False
 
     @classmethod
-    def init(cls, cfg: LMConfig, batch: int, max_len: int) -> "KVCache":
-        shape = (cfg.layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
+    def init(cls, cfg: LMConfig, batch: int, max_len: int,
+             rolling: bool = False) -> "KVCache":
+        if rolling:
+            if cfg.attn_window is None:
+                raise ValueError(
+                    "rolling cache requires cfg.attn_window (a full-"
+                    "attention model needs every past position)"
+                )
+            capacity = min(cfg.attn_window, max_len)
+        else:
+            # Round up to the decode block so the flash-decode loop's
+            # dynamic_slice never clamps (a clamped final block would
+            # mislabel column positions).
+            capacity = max_len
+            if capacity > DECODE_BLOCK and capacity % DECODE_BLOCK:
+                capacity += DECODE_BLOCK - capacity % DECODE_BLOCK
+        shape = (cfg.layers, batch, cfg.num_kv_heads, capacity,
+                 cfg.head_dim)
         return cls(
             k=jnp.zeros(shape, cfg.dtype),
             v=jnp.zeros(shape, cfg.dtype),
             length=jnp.zeros((), jnp.int32),
+            rolling=rolling,
+            empty=True,
         )
 
 
 jax.tree_util.register_dataclass(
-    KVCache, data_fields=["k", "v", "length"], meta_fields=[]
+    KVCache, data_fields=["k", "v", "length"],
+    meta_fields=["rolling", "empty"],
 )
+
+
+def _prefill_attention(cfg, q, k, v):
+    """Empty-cache prefill: attention of the chunk against ITSELF —
+    the training kernels, not a masked read of the whole cache buffer.
+    On TPU this is the Pallas flash kernel (causal block-skip halves
+    the score FLOPs, large MXU tiles); elsewhere the XLA reference."""
+    if (jax.default_backend() == "tpu" and q.shape[2] >= 256
+            and q.shape[2] % 8 == 0):
+        from kubeflow_tpu.ops import flash_attention
+
+        return flash_attention(q, k, v, causal=True,
+                               window=cfg.attn_window)
+    from kubeflow_tpu.ops import mha_reference
+
+    return mha_reference(q, k, v, causal=True, window=cfg.attn_window)
+
+
+def _decode_attention(cfg, q, ck, cv, pos):
+    """Single-token decode attention dispatch.
+
+    Default is the DENSE masked read: measured on v5e (b1, 8x1024 GQA
+    model) it beats both blockwise alternatives at every cache size up
+    to 32k — decode at these scales is dominated by fixed per-op/launch
+    overheads (~0.5 ms/step base), and one fused XLA stream over the
+    cache (0.35 ms of HBM traffic even at 32k) adds less than the
+    per-grid-step cost of 1000+ tiny Pallas programs (measured 3.87
+    ms/step at 32k) or an unpipelined XLA ``fori_loop`` (~15 µs/iter).
+    For windowed models the ROLLING cache already bounds the read to
+    O(window), which is the real long-generation fix.
+
+    ``KFT_DECODE_IMPL=kernel`` opts into the Pallas flash-decode
+    kernel (ops/decode_attention.py) for re-evaluation on hardware
+    where the launch-overhead balance differs (or much longer caches).
+    """
+    import os
+
+    impl = os.environ.get("KFT_DECODE_IMPL", "dense")
+    capacity = ck.shape[2]
+    if (impl == "kernel" and jax.default_backend() == "tpu"
+            and capacity % DECODE_BLOCK == 0):
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        return decode_attention(
+            q, ck, cv, pos, window=cfg.attn_window, block=DECODE_BLOCK,
+        )
+    return _cached_attention(cfg, q, ck, cv, pos, 1)
+
+
+def _flash_decode_xla(cfg, q, ck, cv, pos):
+    """Blockwise decode attention in pure XLA: sweep only the cache
+    blocks intersecting [window_start, pos] with a data-dependent
+    ``fori_loop`` trip count, folding each block into online-softmax
+    statistics. KEPT AS A REFERENCE ONLY (not reachable from
+    forward_with_cache): TPU ``while`` iterations don't pipeline, and
+    the measured per-iteration overhead (~15 µs x layers x blocks,
+    v5e) makes this SLOWER than the dense read at every tested cache
+    size; the Pallas kernel (ops/decode_attention.py) is the blockwise
+    variant that ships.
+    q: (B, H, 1, hd); ck/cv: (B, Hkv, capacity, hd) with capacity a
+    multiple of the block (KVCache.init guarantees it)."""
+    b, h, t, hd = q.shape
+    hkv, capacity = ck.shape[1], ck.shape[2]
+    group = h // hkv
+    block = min(DECODE_BLOCK, capacity)
+    qg = q.reshape(b, hkv, group * t, hd)
+    scale = hd ** -0.5
+
+    start = jnp.zeros((), jnp.int32)
+    if cfg.attn_window is not None:
+        start = jnp.maximum(pos - cfg.attn_window + 1, 0) // block
+    stop = pos // block + 1
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = jax.lax.dynamic_slice(
+            ck, (0, 0, j * block, 0), (b, hkv, block, hd)
+        )
+        vb = jax.lax.dynamic_slice(
+            cv, (0, 0, j * block, 0), (b, hkv, block, hd)
+        )
+        s = jnp.einsum(
+            "bkgd,bkld->bkgl", qg, kb,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        keep = cols <= pos
+        if cfg.attn_window is not None:
+            keep = jnp.logical_and(keep, cols > pos - cfg.attn_window)
+        s = jnp.where(keep, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bkgl,bkld->bkgd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(
+        start, stop, body,
+        (
+            jnp.zeros((b, hkv, group * t, hd), jnp.float32),
+            jnp.full((b, hkv, group * t, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, hkv, group * t, 1), jnp.float32),
+        ),
+    )
+    return (acc / l).reshape(b, h, t, hd).astype(q.dtype)
+
+
+def _rolling_attention(cfg, q, ck, cv, pos):
+    """Decode attention over a circular window cache: slot j holds the
+    newest global position ≡ j (mod capacity) that is ≤ pos; slots
+    whose mapped position is negative are unwritten. capacity ≤ window,
+    so every written slot is in-band by construction."""
+    b, h, t, hd = q.shape
+    hkv, capacity = ck.shape[1], ck.shape[2]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group * t, hd)
+    s = jnp.einsum(
+        "bkgd,bkld->bkgl", qg, ck,
+        preferred_element_type=jnp.float32,
+    ) * hd ** -0.5
+    slots = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    global_pos = pos - (pos - slots) % capacity
+    s = jnp.where(global_pos >= 0, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bkgl,bkld->bkgd", w.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, h, t, hd).astype(q.dtype)
 
 
 def _cached_attention(cfg, q, ck, cv, pos, t):
     """q: (B, H, T, hd) at global positions [pos, pos+T); ck/cv: full
     (B, Hkv, L, hd) cache. Masked dense attention over the whole
     buffer: valid iff col <= row's global position (causal), col within
-    the filled region, and inside the sliding window if configured."""
+    the filled region, and inside the sliding window if configured.
+    Fallback for mid-sequence (pos > 0) multi-token chunks; empty-cache
+    prefill and single-token decode use the specialised paths above."""
     b, h, _, hd = q.shape
     group = h // ck.shape[1]
     qg = q.reshape(b, ck.shape[1], group, t, hd)
@@ -96,9 +284,30 @@ def _cached_attention(cfg, q, ck, cv, pos, t):
     return out.reshape(b, h, t, hd).astype(q.dtype)
 
 
-def _block_step(cfg, params, x, ck, cv, pos, use_moe=False):
+def _write_rolling_prefill(cache_buf, chunk, capacity):
+    """Scatter the last ``capacity`` positions of an empty-cache prefill
+    chunk into the circular buffer (slot = position % capacity). The
+    chunk length is static and pos == 0, so the split is static too."""
+    t = chunk.shape[2]
+    if t <= capacity:
+        return jax.lax.dynamic_update_slice(
+            cache_buf, chunk, (0, 0, 0, 0)
+        )
+    tail = chunk[:, :, t - capacity:]
+    r0 = t % capacity  # slot of position t - capacity
+    first = capacity - r0
+    cache_buf = jax.lax.dynamic_update_slice(
+        cache_buf, tail[:, :, :first], (0, 0, r0, 0)
+    )
+    return jax.lax.dynamic_update_slice(
+        cache_buf, tail[:, :, first:], (0, 0, 0, 0)
+    )
+
+
+def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
+                use_moe=False):
     """One block over a (B, T, D) chunk at global offset ``pos``,
-    reading/updating this layer's (B, Hkv, max_len, hd) cache slices.
+    reading/updating this layer's (B, Hkv, capacity, hd) cache slices.
     Mirrors transformer.Block exactly (same param names/shapes)."""
     b, t, _ = x.shape
     h = rms_norm(params["RMSNorm_0"]["scale"], x)
@@ -113,11 +322,45 @@ def _block_step(cfg, params, x, ck, cv, pos, use_moe=False):
     v = heads(v, cfg.num_kv_heads)
     q = apply_rope(q, offset=pos)
     k = apply_rope(k, offset=pos)
+    capacity = ck.shape[2]
 
-    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+    if t == 1:
+        slot = pos % capacity if rolling else pos
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, slot, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, slot, 0))
+        if rolling:
+            out = _rolling_attention(cfg, q, ck, cv, pos)
+        else:
+            out = _decode_attention(cfg, q, ck, cv, pos)
+    elif empty:
+        # Empty-cache prefill (pos == 0 by the `empty` contract): the
+        # chunk attends to itself through the training kernels; the
+        # cache write happens on the side. KFT_PREFILL_IMPL=dense
+        # forces the masked full-buffer read (A/B escape hatch).
+        import os
 
-    out = _cached_attention(cfg, q, ck, cv, pos, t)
+        if rolling:
+            out = _prefill_attention(cfg, q, k, v)
+            ck = _write_rolling_prefill(ck, k, capacity)
+            cv = _write_rolling_prefill(cv, v, capacity)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+            if os.environ.get("KFT_PREFILL_IMPL") == "dense":
+                out = _cached_attention(cfg, q, ck, cv, pos, t)
+            else:
+                out = _prefill_attention(cfg, q, k, v)
+    else:
+        # Mid-sequence multi-token chunk (chunked prefill): dense
+        # masked read of the filled buffer.
+        if rolling:
+            raise ValueError(
+                "chunked prefill on a rolling cache is not supported; "
+                "prefill the prompt in one chunk (generate() does)"
+            )
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
+        out = _cached_attention(cfg, q, ck, cv, pos, t)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
     x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
 
@@ -155,9 +398,8 @@ def forward_with_cache(
         concrete_pos = int(pos)
     except (jax.errors.ConcretizationTypeError, TypeError):
         concrete_pos = None
-    if concrete_pos is not None and (
-        concrete_pos + tokens.shape[1] > max_len
-    ):
+    if (not cache.rolling and concrete_pos is not None
+            and concrete_pos + tokens.shape[1] > max_len):
         raise ValueError(
             f"cache overflow: length {concrete_pos} + {tokens.shape[1]} "
             f"new tokens > max_len {max_len}"
@@ -172,7 +414,7 @@ def forward_with_cache(
         )
         x, ck, cv = _block_step(
             cfg, params[f"block_{i}"], x, cache.k[i], cache.v[i], pos,
-            use_moe=use_moe,
+            cache.empty, cache.rolling, use_moe=use_moe,
         )
         new_k.append(ck)
         new_v.append(cv)
@@ -181,6 +423,8 @@ def forward_with_cache(
     cache = KVCache(
         k=jnp.stack(new_k), v=jnp.stack(new_v),
         length=pos + tokens.shape[1],
+        rolling=cache.rolling,
+        empty=False,
     )
     return logits, cache
 
@@ -218,8 +462,12 @@ def generate(
         )
     b, p = prompt.shape
     # The last generated token is never fed back, so its K/V slot is
-    # not needed.
-    cache = KVCache.init(cfg, b, p + max_new_tokens - 1)
+    # not needed. Sliding-window models take the rolling cache when the
+    # window is smaller than the sequence: memory and per-token
+    # bandwidth become O(window) instead of O(prompt + generated).
+    total = p + max_new_tokens - 1
+    rolling = cfg.attn_window is not None and cfg.attn_window < total
+    cache = KVCache.init(cfg, b, total, rolling=rolling)
     logits, cache = forward_with_cache(cfg, params, prompt, cache)
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path below

@@ -1,0 +1,158 @@
+"""Pallas flash-decode: single-token attention over a KV cache.
+
+The decode-time analogue of the training flash kernel
+(:mod:`kubeflow_tpu.ops.attention`): one q row set (the new token's
+heads) against the (B, Hkv, capacity, hd) cache, blockwise over the
+cache length with online-softmax accumulation.
+
+Why a kernel and not XLA: decode is HBM-bandwidth-bound, and the two
+XLA-level structures both waste it —
+
+- a dense masked read touches all ``capacity`` rows every token, even
+  the unfilled/out-of-window ones (O(max_len) traffic per token);
+- a ``fori_loop`` with a data-dependent trip count reads only the live
+  region, but TPU ``while`` iterations cannot be pipelined, and the
+  measured per-iteration overhead (~15 µs x layers x blocks on v5e)
+  dwarfs the savings.
+
+Here the grid is static (every block visited) but the k/v index map
+CLAMPS dead block indices to the live range: consecutive grid steps
+then request the SAME block, and Mosaic's revolving-buffer optimisation
+skips the DMA for an unchanged index — dead blocks cost no HBM traffic
+and no matmuls (``pl.when``), while live blocks stream with normal
+grid pipelining. Traffic per token is O(filled ∧ window) + one block.
+
+The kernel reads the current position from a scalar-prefetch operand
+(``PrefetchScalarGridSpec``) — it must be known before the first index
+map runs, which is exactly what scalar prefetch is for.
+
+No reference counterpart (the reference platform ships no model code;
+SURVEY.md §2.3): this is part of the TPU build's inference stack.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, block, window):
+    j = pl.program_id(1)
+    pos = pos_ref[0]
+    hi = pos // block
+    lo = (
+        jnp.zeros((), jnp.int32) if window is None
+        else jnp.maximum(pos - window + 1, 0) // block
+    )
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(jnp.logical_and(j >= lo, j <= hi))
+    def _compute():
+        q = q_ref[0]  # (rows, hd) — q heads of this kv head, padded
+        k = k_ref[0]  # (block, hd)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        keep = cols <= pos
+        if window is not None:
+            keep = jnp.logical_and(keep, cols > pos - window)
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        # pos >= 0 guarantees at least one live column (the token just
+        # written), so l > 0; the guard only protects padded q rows.
+        l_safe = jnp.where(l_scr[:, :1] == 0.0, 1.0, l_scr[:, :1])
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, pos, *, window=None,
+                     block=512, interpret=None):
+    """q: (B, H, 1, hd) at global position ``pos`` (scalar int32);
+    k/v_cache: (B, Hkv, capacity, hd) with rows [0, pos] filled and
+    capacity a multiple of ``block``. Masking: col <= pos, and
+    col > pos - window when ``window`` is set. Returns (B, H, 1, hd).
+    """
+    b, h, t, hd = q.shape
+    if t != 1:
+        raise ValueError(f"decode_attention takes one token, got t={t}")
+    hkv, capacity = k_cache.shape[1], k_cache.shape[2]
+    if capacity % block:
+        raise ValueError(
+            f"cache capacity {capacity} not a multiple of block {block}"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    group = h // hkv
+    # Pad the per-kv-head q rows to the 8-sublane tile.
+    rows = max(8, -(-group // 8) * 8)
+    qg = q.reshape(b * hkv, group, hd)
+    qp = jnp.zeros((b * hkv, rows, hd), q.dtype).at[:, :group].set(qg)
+    kr = k_cache.reshape(b * hkv, capacity, hd)
+    vr = v_cache.reshape(b * hkv, capacity, hd)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = hd ** -0.5
+
+    def kv_index(bi, j, pos_arr):
+        # Scalar-prefetch operands arrive AFTER the grid indices in
+        # index maps (and before the operand refs in the kernel).
+        hi = pos_arr[0] // block
+        lo = (
+            jnp.zeros((), jnp.int32) if window is None
+            else jnp.maximum(pos_arr[0] - window + 1, 0) // block
+        )
+        return (bi, jnp.clip(j, lo, hi), 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, block=block, window=window,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * hkv, capacity // block),
+            in_specs=[
+                pl.BlockSpec((1, rows, hd),
+                             lambda bi, j, pos_arr: (bi, 0, 0)),
+                pl.BlockSpec((1, block, hd), kv_index),
+                pl.BlockSpec((1, block, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, rows, hd), lambda bi, j, pos_arr: (bi, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),  # running max m
+                pltpu.VMEM((rows, 128), jnp.float32),  # running sum l
+                pltpu.VMEM((rows, hd), jnp.float32),   # output acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, rows, hd), q.dtype),
+        interpret=interpret,
+    )(jnp.reshape(pos, (1,)).astype(jnp.int32), qp, kr, vr)
+    return out[:, :group].reshape(b, h, 1, hd)
