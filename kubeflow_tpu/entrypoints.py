@@ -261,8 +261,16 @@ def run_pvcviewer_controller():
 def run_admission_webhook():
     """PodDefault mutating webhook over HTTPS (reference
     admission-webhook/main.go:795-821; certs mounted by cert-manager,
-    rotated live by the cert watcher)."""
-    from kubeflow_tpu.webhook.server import AdmissionHandler, WebhookServer
+    rotated live by the cert watcher). When a CA file is mounted
+    (CA_FILE, default alongside the serving pair), the in-binary
+    injector also propagates rotations into the
+    MutatingWebhookConfiguration's caBundle — the cert-manager-less
+    replacement for the reference's ca-injector annotation."""
+    from kubeflow_tpu.webhook.server import (
+        AdmissionHandler,
+        CABundleInjector,
+        WebhookServer,
+    )
 
     _setup_logging()
     api = _connect()
@@ -272,15 +280,32 @@ def run_admission_webhook():
         return api.list(poddefault_api, "PodDefault", namespace=namespace)
 
     handler = AdmissionHandler(list_poddefaults)
+    certfile = os.environ.get("CERT_FILE", "/etc/webhook/certs/tls.crt")
     server = WebhookServer(
         handler,
         port=int(os.environ.get("WEBHOOK_PORT", "4443")),
-        certfile=os.environ.get("CERT_FILE", "/etc/webhook/certs/tls.crt"),
+        certfile=certfile,
         keyfile=os.environ.get("KEY_FILE", "/etc/webhook/certs/tls.key"),
+        cert_watch_period_s=float(
+            os.environ.get("CERT_WATCH_PERIOD", "10")
+        ),
     )
+    injector = None
+    ca_file = os.environ.get(
+        "CA_FILE", os.path.join(os.path.dirname(certfile), "ca.crt")
+    )
+    if not _env_bool("DISABLE_CA_INJECTION"):
+        injector = CABundleInjector(
+            api, ca_file,
+            config_name=os.environ.get("WEBHOOK_CONFIG_NAME",
+                                       "admission-webhook"),
+            period_s=float(os.environ.get("KFT_CA_SYNC_PERIOD", "10")),
+        ).start()
     server.start()
     log.info("admission-webhook serving on :%d", server.port)
-    _block_until_signal(cleanup=server.stop)
+    _block_until_signal(cleanup=lambda: (
+        injector.stop() if injector else None, server.stop()
+    ))
 
 
 # ---- REST services -------------------------------------------------------

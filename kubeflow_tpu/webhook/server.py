@@ -348,6 +348,90 @@ def apply_json_patch(obj: dict, patch: list) -> dict:
     return out
 
 
+class CABundleInjector:
+    """cert-manager-less caBundle propagation.
+
+    The reference delegates CA injection to cert-manager's ca-injector
+    (admission-webhook/manifests/overlays/cert-manager/certificate.yaml
+    — the `cert-manager.io/inject-ca-from` annotation); without
+    cert-manager the MutatingWebhookConfiguration's
+    ``clientConfig.caBundle`` is a manifest constant that rotating the
+    CA silently breaks (the apiserver starts rejecting the webhook's
+    serving cert, and with failurePolicy=Fail that blocks pod CREATEs).
+
+    This injector closes the loop from inside the webhook binary: poll
+    the mounted CA file and, whenever its bytes change (and once at
+    startup — level-based, so a restart converges regardless of missed
+    events), patch EVERY webhook entry in the named configuration with
+    the base64 bundle. Update conflicts and transient apiserver errors
+    retry on the next tick, same posture as the serving-cert watcher.
+    """
+
+    def __init__(self, api, ca_file: str,
+                 config_name: str = "admission-webhook",
+                 period_s: float = 10.0):
+        self.api = api
+        self.ca_file = ca_file
+        self.config_name = config_name
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_bundle: bytes | None = None
+
+    def inject_once(self) -> bool:
+        """One level-based pass; returns True if the config was
+        patched. Safe to call directly (tests, pre-serve sync)."""
+        try:
+            with open(self.ca_file, "rb") as fh:
+                ca = fh.read()
+        except OSError:
+            return False  # not mounted (yet): keep previous state
+        if not ca or ca == self._last_bundle:
+            return False
+        bundle = base64.b64encode(ca).decode()
+        try:
+            cfg = self.api.get(
+                "admissionregistration.k8s.io/v1",
+                "MutatingWebhookConfiguration", self.config_name,
+            )
+            changed = False
+            for hook in cfg.get("webhooks", []):
+                client = hook.setdefault("clientConfig", {})
+                if client.get("caBundle") != bundle:
+                    client["caBundle"] = bundle
+                    changed = True
+            if changed:
+                self.api.update(cfg)
+            self._last_bundle = ca
+            if changed:
+                log.info(
+                    "caBundle injected into %s (%d webhooks)",
+                    self.config_name, len(cfg.get("webhooks", [])),
+                )
+            return changed
+        except Exception as exc:  # conflict / outage: retry next tick
+            log.warning("caBundle injection failed (will retry): %s", exc)
+            return False
+
+    def start(self) -> "CABundleInjector":
+        self.inject_once()
+
+        def loop():
+            while not self._stop.wait(self.period_s):
+                self.inject_once()
+
+        self._thread = threading.Thread(
+            target=loop, name="ca-bundle-injector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
 def register_remote_webhook(api, url: str, cafile: str | None = None,
                             timeout: float = 10.0) -> None:
     """Play the APISERVER's side of the MutatingWebhookConfiguration:

@@ -267,6 +267,133 @@ class TestWebhookWedge:
             server.close()
 
 
+class TestCARotationUnderLoad:
+    def test_ca_rotation_propagates_while_admitting(self, tmp_path):
+        """Rotate the webhook's CA + serving pair UNDER LOAD: admission
+        reviews flow continuously against the live process while the
+        mounted cert files are atomically replaced. The in-binary
+        injector must patch the MutatingWebhookConfiguration's
+        caBundle to the new CA (cert-manager-less rotation,
+        reference's ca-injector role), the cert watcher must start
+        serving the new chain, and no review may fail AFTER the files
+        are consistent (mid-swap mismatch reads are allowed to retry
+        per the watcher contract)."""
+        import base64
+        import ssl
+        import subprocess
+
+        def make_pair(tag):
+            cert = tmp_path / f"{tag}.crt"
+            key = tmp_path / f"{tag}.key"
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", str(key), "-out", str(cert),
+                 "-days", "1", "-subj", "/CN=127.0.0.1",
+                 "-addext", "subjectAltName=IP:127.0.0.1"],
+                check=True, capture_output=True,
+            )
+            return cert.read_bytes(), key.read_bytes()
+
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        ca = tmp_path / "ca.crt"
+        pair_a = make_pair("a")
+        pair_b = make_pair("b")
+        cert.write_bytes(pair_a[0])
+        key.write_bytes(pair_a[1])
+        ca.write_bytes(pair_a[0])  # self-signed: CA == serving cert
+
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        fake.create({
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "admission-webhook"},
+            "webhooks": [{
+                "name": "admission-webhook.kubeflow.org",
+                "clientConfig": {"service": {"name": "admission-webhook"}},
+            }],
+        })
+        port = free_port()
+        proc = spawn("admission-webhook", server.url, {
+            "WEBHOOK_PORT": str(port),
+            "CERT_FILE": str(cert), "KEY_FILE": str(key),
+            "CA_FILE": str(ca),
+            "CERT_WATCH_PERIOD": "0.2",
+            "KFT_CA_SYNC_PERIOD": "0.2",
+        })
+
+        def bundle():
+            cfg = fake.get("admissionregistration.k8s.io/v1",
+                           "MutatingWebhookConfiguration",
+                           "admission-webhook")
+            return cfg["webhooks"][0]["clientConfig"].get("caBundle")
+
+        def review_ok(ctx):
+            import json as _json
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{port}/apply-poddefault",
+                data=_json.dumps({"request": {
+                    "uid": "u", "kind": {"kind": "Pod"},
+                    "namespace": "alice", "operation": "CREATE",
+                    "object": {"metadata": {"name": "p"}},
+                }}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5,
+                                        context=ctx) as resp:
+                return _json.loads(resp.read())["response"]["allowed"]
+
+        insecure = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        insecure.check_hostname = False
+        insecure.verify_mode = ssl.CERT_NONE
+        try:
+            wait_http(f"https://127.0.0.1:{port}/healthz",
+                      context=insecure)
+            # Startup injection: bundle == CA A.
+            want_a = base64.b64encode(pair_a[0]).decode()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and bundle() != want_a:
+                time.sleep(0.1)
+            assert bundle() == want_a
+
+            # Load: reviews keep flowing while the pair+CA rotate.
+            assert review_ok(insecure)
+            cert.write_bytes(pair_b[0])
+            key.write_bytes(pair_b[1])
+            ca.write_bytes(pair_b[0])
+            ok_during = 0
+            want_b = base64.b64encode(pair_b[0]).decode()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and bundle() != want_b:
+                assert review_ok(insecure)  # never down during rotation
+                ok_during += 1
+                time.sleep(0.1)
+            assert bundle() == want_b, "caBundle never rotated"
+            assert ok_during >= 1
+
+            # The serving chain converged to CA B: a STRICT client
+            # trusting only B must succeed.
+            strict = ssl.create_default_context(cafile=str(tmp_path / "b.crt"))
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    assert review_ok(strict)
+                    break
+                except ssl.SSLError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+        finally:
+            try:
+                terminate(proc)
+            except AssertionError:
+                pass
+            server.close()
+
+
 # ---------------------------------------------------------------------------
 # cull cycle under faults (in-process controller, live HTTP kernel hop)
 # ---------------------------------------------------------------------------

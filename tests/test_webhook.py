@@ -471,3 +471,99 @@ class TestPvcViewerAdmission:
         assert created["spec"]["rwoScheduling"] is True
         with pytest.raises(ApiError):
             api.create(self.viewer({}))
+
+
+class TestCABundleInjector:
+    """cert-manager-less caBundle propagation: the injector watches the
+    mounted CA file and patches every webhook entry in the
+    MutatingWebhookConfiguration (reference delegates this to
+    cert-manager's ca-injector; here it lives in the webhook binary)."""
+
+    def _config(self):
+        return {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "admission-webhook"},
+            "webhooks": [
+                {"name": "admission-webhook.kubeflow.org",
+                 "clientConfig": {"service": {"name": "admission-webhook"}}},
+                {"name": "pvcviewer.kubeflow.org",
+                 "clientConfig": {"service": {"name": "admission-webhook"}}},
+            ],
+        }
+
+    def test_injects_at_startup_and_on_rotation(self, tmp_path):
+        import base64
+
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu.webhook.server import CABundleInjector
+
+        api = FakeApiServer()
+        api.create(self._config())
+        ca = tmp_path / "ca.crt"
+        ca.write_bytes(b"CA-ONE")
+        injector = CABundleInjector(api, str(ca))
+        assert injector.inject_once() is True
+        cfg = api.get("admissionregistration.k8s.io/v1",
+                      "MutatingWebhookConfiguration", "admission-webhook")
+        want = base64.b64encode(b"CA-ONE").decode()
+        assert [w["clientConfig"]["caBundle"] for w in cfg["webhooks"]] \
+            == [want, want]
+        # Unchanged bytes: level-based no-op (no write churn).
+        rv = cfg["metadata"]["resourceVersion"]
+        assert injector.inject_once() is False
+        cfg = api.get("admissionregistration.k8s.io/v1",
+                      "MutatingWebhookConfiguration", "admission-webhook")
+        assert cfg["metadata"]["resourceVersion"] == rv
+        # Rotation: new bytes propagate to every webhook entry.
+        ca.write_bytes(b"CA-TWO")
+        assert injector.inject_once() is True
+        cfg = api.get("admissionregistration.k8s.io/v1",
+                      "MutatingWebhookConfiguration", "admission-webhook")
+        want2 = base64.b64encode(b"CA-TWO").decode()
+        assert [w["clientConfig"]["caBundle"] for w in cfg["webhooks"]] \
+            == [want2, want2]
+
+    def test_missing_file_and_missing_config_are_tolerated(self, tmp_path):
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu.webhook.server import CABundleInjector
+
+        api = FakeApiServer()
+        injector = CABundleInjector(api, str(tmp_path / "absent.crt"))
+        assert injector.inject_once() is False  # no file: keep waiting
+        ca = tmp_path / "absent.crt"
+        ca.write_bytes(b"CA")
+        # File exists but the config does not: logged, retried later,
+        # and the bundle is NOT latched (the next tick must try again).
+        assert injector.inject_once() is False
+        api.create(self._config())
+        assert injector.inject_once() is True
+
+    def test_background_thread_converges_after_rotation(self, tmp_path):
+        import base64
+        import time
+
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu.webhook.server import CABundleInjector
+
+        api = FakeApiServer()
+        api.create(self._config())
+        ca = tmp_path / "ca.crt"
+        ca.write_bytes(b"CA-A")
+        injector = CABundleInjector(api, str(ca), period_s=0.05).start()
+        try:
+            ca.write_bytes(b"CA-B")
+            want = base64.b64encode(b"CA-B").decode()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                cfg = api.get("admissionregistration.k8s.io/v1",
+                              "MutatingWebhookConfiguration",
+                              "admission-webhook")
+                if all(w["clientConfig"].get("caBundle") == want
+                       for w in cfg["webhooks"]):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("rotation never propagated")
+        finally:
+            injector.stop()
