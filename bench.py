@@ -492,13 +492,13 @@ def main():
         # einsum stack (ep-mesh all-to-alls are the dryrun's job).
         ("lm_moe_tokens_per_sec_per_chip", False, lambda: bench_lm(
             metric="lm_moe_tokens_per_sec_per_chip",
-            anchor_tokens_s=_env_anchor("KFT_BENCH_MOE_ANCHOR", 57605),
+            anchor_tokens_s=_env_anchor("KFT_BENCH_MOE_ANCHOR", 88308),
             moe_experts=8, **lm_defaults,
         )),
         ("lm_moe_ec_tokens_per_sec_per_chip", False, lambda: bench_lm(
             metric="lm_moe_ec_tokens_per_sec_per_chip",
             anchor_tokens_s=_env_anchor("KFT_BENCH_MOE_EC_ANCHOR",
-                                        55721),
+                                        79722),
             moe_experts=8, moe_router="expert_choice", **lm_defaults,
         )),
         # Long-prompt decode (round 4): flash-decode sweeps only the

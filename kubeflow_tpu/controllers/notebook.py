@@ -202,7 +202,12 @@ class NotebookReconciler:
         # the pod's own name ("nb-0"), so one selected list per replica
         # joins them — replicas+1 point lists, bounded by slice size,
         # never by namespace population. The kind check stays
-        # client-side (event_involves_notebook).
+        # client-side (event_involves_notebook). Known trade-off: after
+        # a scale-down (spec 3->1), events for leftover higher-ordinal
+        # pods (nb-2) are no longer mirrored — those pods are being
+        # torn down, and their terminal events age out of the window
+        # anyway; scanning status.replicas too would re-add them if
+        # that ever matters.
         replicas = max(
             ((notebook.get("spec") or {}).get("tpu") or {})
             .get("replicas", 1), 1,

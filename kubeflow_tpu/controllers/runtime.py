@@ -156,9 +156,20 @@ def record_event(
     now = clock() if clock is not None else time_mod.time()
     stamp = time_mod.strftime("%Y-%m-%dT%H:%M:%SZ", time_mod.gmtime(now))
     namespace = meta.get("namespace", "default")
+    obj_name = meta.get("name", "obj")
     key = f"{involved.get('kind', '')}|{reason}|{component}"
     suffix = hashlib.sha1(key.encode()).hexdigest()[:10]
-    ev_name = f"{meta.get('name', 'obj')}.{suffix}"
+    ev_name = f"{obj_name}.{suffix}"
+    if len(ev_name) > 253:
+        # DNS-subdomain cap: truncate the prefix and fold the FULL
+        # object name into the hash so truncated names cannot collide
+        # across objects sharing their first 242 characters (writes
+        # are fire-and-forget — an over-long name would silently fail
+        # forever, losing this object's aggregation entirely).
+        suffix = hashlib.sha1(
+            f"{obj_name}|{key}".encode()
+        ).hexdigest()[:10]
+        ev_name = f"{obj_name[:242]}.{suffix}"
 
     def bump(existing: dict) -> None:
         api.patch_merge(

@@ -555,7 +555,21 @@ class ApiClient:
             query = dict(base_query)
             if cont:
                 query["continue"] = cont
-            env = self._request("GET", path, query=query)
+            try:
+                env = self._request("GET", path, query=query)
+            except ApiError as exc:
+                if cont is None or exc.code != 410:
+                    raise
+                # The continue token expired mid-pagination (history
+                # compacted under churn, HTTP 410 Gone). client-go's
+                # pager falls back to ONE full unchunked re-list;
+                # partial pages are discarded — mixing them with a
+                # fresh list could duplicate or resurrect objects.
+                env = self._request("GET", path, query={
+                    k: v for k, v in base_query.items() if k != "limit"
+                })
+                items = list(env.get("items", []))
+                break
             items.extend(env.get("items", []))
             cont = (env.get("metadata") or {}).get("continue")
             if not cont:

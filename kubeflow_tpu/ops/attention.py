@@ -585,7 +585,12 @@ def flash_attention(
     (sequence packing, the standard long-context data layout). Blocks
     whose segment-id ranges are disjoint skip their matmuls in fwd AND
     bwd, so attention compute scales with sum(len(doc)^2) instead of
-    S^2. Composes with causal and window.
+    S^2. Composes with causal and window. CONTRACT: ids must be
+    non-decreasing along the sequence (the packed layout — documents
+    concatenated in order); the block-skip predicate compares [min,
+    max] ranges and would silently skip LIVE blocks under unsorted
+    ids. Validated when the ids are concrete; under jit the caller
+    owns it. Arbitrary (unsorted) masks belong on ``mha_reference``.
 
     On TPU, ``head_dim`` and the block sizes should be multiples of 128
     (MXU tiles). Blocks are auto-fitted down to a divisor of the
@@ -616,6 +621,20 @@ def flash_attention(
                 "segment_ids requires self-attention (q and k share one "
                 f"sequence), got Sq={q.shape[2]} Sk={k.shape[2]}"
             )
+        if not isinstance(segment_ids, jax.core.Tracer):
+            # The sortedness contract (see docstring) is checkable for
+            # free on concrete ids (eager/test paths); under jit it
+            # would cost a device round-trip per call, and unsorted ids
+            # silently mis-mask — so catch it loudly where we can.
+            if not bool(jnp.all(
+                segment_ids[:, 1:] >= segment_ids[:, :-1]
+            )):
+                raise ValueError(
+                    "segment_ids must be non-decreasing along the "
+                    "sequence (packed-batch layout); unsorted ids "
+                    "would make the block-skip predicate drop live "
+                    "blocks — use mha_reference for arbitrary masks"
+                )
     if q.shape[1] % k.shape[1] or k.shape[1:] != v.shape[1:]:
         raise ValueError(
             f"q heads {q.shape[1]} must be a multiple of kv heads "

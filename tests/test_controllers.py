@@ -438,3 +438,29 @@ class TestEventRecorder:
                 if e.get("reason") == "Culled"]
         assert len(mine) == 1
         assert mine[0]["count"] == 2  # create (1) + post-race bump
+
+    def test_near_limit_object_name_truncates_not_fails(self):
+        """Event names cap at 253 chars (DNS subdomain): an involved
+        object whose name is already near the cap must get a truncated
+        prefix + full-name hash, not a silently failing write (event
+        writes are fire-and-forget, so an invalid name would lose the
+        object's aggregation forever)."""
+        from kubeflow_tpu.controllers.runtime import record_event
+
+        api = FakeApiServer()
+        long_a = "a" * 250
+        long_b = "a" * 245 + "bbbbb"  # same first 242 chars, different name
+        for name in (long_a, long_b):
+            involved = {
+                "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+                "metadata": {"name": name, "namespace": "user",
+                             "uid": "u"},
+            }
+            record_event(api, involved, "Culled", "idle")
+            record_event(api, involved, "Culled", "idle again")
+        events = [e for e in api.list("v1", "Event", namespace="user")
+                  if e.get("reason") == "Culled"]
+        assert len(events) == 2, "truncated names collided or write lost"
+        for e in events:
+            assert len(e["metadata"]["name"]) <= 253
+            assert e["count"] == 2  # aggregation still worked

@@ -705,3 +705,35 @@ class TestPaginationAndFieldSelectors:
             assert seen == {f"nb-{i}" for i in range(7)}
         finally:
             c.close()
+
+    def test_expired_continue_falls_back_to_full_relist(self, server,
+                                                        client):
+        """410 Gone on a continue token (history compacted under churn)
+        must not fail the list: client-go pager semantics — discard
+        partial pages, one full unchunked re-list."""
+        from kubeflow_tpu.k8s.core import ApiError
+
+        client.LIST_PAGE_SIZE = 2
+        for i in range(5):
+            server.fake.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"exp-{i}", "namespace": "default"},
+            })
+        calls = []
+        real = client._request
+
+        def flaky(method, path, query=None, **kw):
+            calls.append(dict(query or {}))
+            if query and "continue" in query:
+                raise ApiError("the continue token has expired", 410)
+            return real(method, path, query=query, **kw)
+
+        client._request = flaky
+        try:
+            names = sorted(o["metadata"]["name"] for o in
+                           client.list("v1", "ConfigMap", "default"))
+        finally:
+            client._request = real
+        assert names == [f"exp-{i}" for i in range(5)]
+        assert any("continue" in c for c in calls)
+        assert "limit" not in calls[-1]  # the fallback is unchunked
