@@ -508,9 +508,9 @@ def main():
          lambda: bench_decode(
             batch=1, prompt_len=8192, new_tokens=128,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P8K_ANCHOR",
-                                       238379),
+                                       264380),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P8K_ANCHOR",
-                                      628),
+                                      789),
         )),
         ("lm_decode_tokens_per_sec_per_chip[b1-p32k]", False,
          lambda: bench_decode(
@@ -526,9 +526,9 @@ def main():
          lambda: bench_decode(
             batch=1, prompt_len=8192, new_tokens=128, window=1024,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_W1K_ANCHOR",
-                                       307296),
+                                       319812),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_W1K_ANCHOR",
-                                      977),
+                                      1100),
         )),
     ]
     for name, mandatory, section in sections:

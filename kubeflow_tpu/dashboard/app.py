@@ -213,7 +213,10 @@ def create_app(
     )
     metrics_service = metrics_service or NoMetricsService()
     if os.path.isdir(_STATIC_DIR):
-        app.serve_static(_STATIC_DIR)
+        # serve_frontend also mounts the shared kit at /lib/ so the
+        # dashboard shell gets KF.i18n (data-i18n marks + catalogs)
+        # like every CRUD SPA.
+        app.serve_frontend(_STATIC_DIR)
 
     def owned_profiles(user: str) -> list[dict]:
         return [

@@ -37,10 +37,13 @@
       Object.keys(catalog).forEach(function (k) { cat[k] = catalog[k]; });
     },
     // Translate elements marked <el data-i18n> (static HTML shells).
+    // Internal whitespace collapses so multi-line markup text matches
+    // its single-line catalog key.
     apply: function (root) {
       var nodes = (root || document).querySelectorAll('[data-i18n]');
       Array.prototype.forEach.call(nodes, function (node) {
-        node.textContent = KF.t(node.textContent.trim());
+        var key = node.textContent.replace(/\s+/g, ' ').trim();
+        node.textContent = KF.t(key);
       });
     },
   };
@@ -407,7 +410,7 @@
         name: 'Last transition',
         value: function (c) { return KF.ageValue(c.lastTransitionTime); },
         render: function (c) {
-          return KF.age(c.lastTransitionTime) || '';
+          return KF.timeCell(c.lastTransitionTime) || '';
         },
       },
     ], conditions || [], 'No conditions reported.');
@@ -446,7 +449,7 @@
         name: 'Last seen',
         value: function (ev) { return KF.ageValue(ev.lastTimestamp); },
         render: function (ev) {
-          return KF.age(ev.lastTimestamp);
+          return KF.timeCell(ev.lastTimestamp);
         },
       },
     ], rows, 'No events for this resource.');
@@ -551,6 +554,62 @@
     if (s < 7200) return Math.floor(s / 60) + 'm';
     if (s < 172800) return Math.floor(s / 3600) + 'h';
     return Math.floor(s / 86400) + 'd';
+  };
+
+  // ---- date-time humanization (reference lib date-time component:
+  // localized "5 minutes ago" with the absolute timestamp on hover).
+  // Intl.RelativeTimeFormat/DateTimeFormat give every locale for free
+  // — the catalog only carries the fallback word order. ----
+  KF.relTime = function (timestamp) {
+    if (!timestamp) return '';
+    var t = new Date(timestamp).getTime();
+    if (isNaN(t)) return String(timestamp);
+    var s = (t - Date.now()) / 1000;  // negative = past
+    var units = [
+      ['year', 31536000], ['month', 2592000], ['week', 604800],
+      ['day', 86400], ['hour', 3600], ['minute', 60], ['second', 1],
+    ];
+    var unit = 'second';
+    var amount = Math.round(s);
+    for (var i = 0; i < units.length; i++) {
+      if (Math.abs(s) >= units[i][1] || units[i][0] === 'second') {
+        unit = units[i][0];
+        amount = Math.round(s / units[i][1]);
+        break;
+      }
+    }
+    try {
+      return new Intl.RelativeTimeFormat(KF.i18n.locale, {
+        numeric: 'auto',
+      }).format(amount, unit);
+    } catch (e) {
+      // No Intl (ancient browser): catalog-driven fallback.
+      return KF.t('{age} ago', { age: KF.age(timestamp) });
+    }
+  };
+
+  KF.absTime = function (timestamp) {
+    if (!timestamp) return '';
+    var t = new Date(timestamp).getTime();
+    if (isNaN(t)) return String(timestamp);
+    try {
+      return new Intl.DateTimeFormat(KF.i18n.locale, {
+        dateStyle: 'medium', timeStyle: 'medium',
+      }).format(t);
+    } catch (e) {
+      return new Date(t).toISOString();
+    }
+  };
+
+  // The cell every timestamp column renders: humanized relative time,
+  // absolute localized timestamp on hover (and for copy/paste).
+  KF.timeCell = function (timestamp) {
+    if (!timestamp) return '';
+    return KF.el('span', {
+      'class': 'kf-reltime',
+      text: KF.relTime(timestamp),
+      title: KF.absTime(timestamp),
+    });
   };
 
   KF.shortImage = function (image) {

@@ -88,5 +88,22 @@
       'Aucun PodDefault dans cet espace de noms.',
     'No pods yet — the StatefulSet has not started any.':
       'Pas encore de pods — le StatefulSet n\'en a démarré aucun.',
+    // ---- date-time humanization fallback (no-Intl browsers) ----
+    '{age} ago': 'il y a {age}',
+    // ---- dashboard shell (centraldashboard static chrome) ----
+    'TPU Notebooks': 'Notebooks TPU',
+    'Namespace': 'Espace de noms',
+    'Home': 'Accueil',
+    'TPU fleet': 'Flotte TPU',
+    'Quick links': 'Liens rapides',
+    'Recent activity': 'Activité récente',
+    'Contributors': 'Contributeurs',
+    'People who can use the selected namespace (reference manage-users view).':
+      'Personnes pouvant utiliser l\'espace de noms sélectionné (vue manage-users de référence).',
+    'Add contributor': 'Ajouter un contributeur',
+    'Welcome': 'Bienvenue',
+    'You don\'t have a namespace yet. Create one to start spawning TPU notebooks.':
+      'Vous n\'avez pas encore d\'espace de noms. Créez-en un pour lancer des notebooks TPU.',
+    'Create namespace': 'Créer un espace de noms',
   });
 })();

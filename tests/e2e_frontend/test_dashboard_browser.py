@@ -117,3 +117,14 @@ def test_contributor_add_and_remove(page, seeded_dashboard):
         ".includes('bob@example.org')"
     )
     assert not bob_bindings(), "contributor RoleBinding not removed"
+
+
+def test_dashboard_shell_renders_french(page, seeded_dashboard):
+    """The dashboard shell now rides the shared kit's i18n: ?lang=fr
+    must translate the static chrome (data-i18n marks + catalog)."""
+    url, _ = seeded_dashboard
+    page.goto(url + "/?lang=fr")
+    page.locator("#fleet-cards .card").first.wait_for(timeout=10_000)
+    assert "Flotte TPU" in page.locator("#home-view h1").inner_text()
+    assert "Activité récente" in page.locator("#home-view").inner_text()
+    assert "Notebooks TPU" in page.locator("#brand").inner_text()

@@ -120,3 +120,55 @@ def test_table_sort_and_filter(page, seeded_jwa):
         "document.querySelectorAll('#nb-table tbody tr').length === 1"
     )
     assert "aaa-nb" in rows.first.inner_text()
+
+
+def test_events_humanized_time_with_absolute_title(page, seeded_jwa):
+    """date-time humanization widget (reference lib date-time
+    component): the events tab's Last seen column renders localized
+    relative time ("N minutes ago") with the absolute localized
+    timestamp on hover (title attr)."""
+    url, api = seeded_jwa
+    import datetime
+
+    recent = (datetime.datetime.now(datetime.timezone.utc)
+              - datetime.timedelta(minutes=5)).strftime(
+                  "%Y-%m-%dT%H:%M:%SZ")
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "demo-nb.recent", "namespace": "alice"},
+        "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
+        "reason": "Tested", "message": "humanized", "type": "Normal",
+        "count": 1, "lastTimestamp": recent,
+    })
+    page.goto(url)
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    page.locator("button.kf-tab", has_text="Events").click()
+    cell = page.locator(".kf-reltime").first
+    cell.wait_for()
+    assert "ago" in cell.inner_text()
+    # Absolute localized timestamp rides the title attribute.
+    assert len(cell.get_attribute("title") or "") > 8
+
+
+def test_events_humanized_time_french(page, seeded_jwa):
+    """Intl-backed humanization localizes for free: the same cell under
+    ?lang=fr reads 'il y a ...'."""
+    url, api = seeded_jwa
+    import datetime
+
+    recent = (datetime.datetime.now(datetime.timezone.utc)
+              - datetime.timedelta(minutes=5)).strftime(
+                  "%Y-%m-%dT%H:%M:%SZ")
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "demo-nb.recent-fr", "namespace": "alice"},
+        "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
+        "reason": "Tested", "message": "humanized", "type": "Normal",
+        "count": 1, "lastTimestamp": recent,
+    })
+    page.goto(url + "?lang=fr")
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    page.locator("button.kf-tab", has_text="Événements").click()
+    cell = page.locator(".kf-reltime").first
+    cell.wait_for()
+    assert "il y a" in cell.inner_text()

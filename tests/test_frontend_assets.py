@@ -299,3 +299,58 @@ class TestI18n:
                     missing.append((os.path.basename(
                         os.path.dirname(os.path.dirname(path))), key))
         assert not missing, f"column/tab names missing from fr: {missing}"
+
+    def test_all_visible_html_text_marked_and_covered(self):
+        """COMPLETENESS over the SPA shells: every visible text node in
+        every served HTML file must be data-i18n-marked AND present in
+        the French catalog (whitespace-collapsed, matching
+        KF.i18n.apply). An unmarked string can never translate; a
+        marked-but-missing one silently stays English."""
+        from html.parser import HTMLParser
+
+        keys = self.catalog_keys()
+        # Non-translatable tokens: punctuation, symbols, brandless
+        # separators.
+        allow = {"—", "+", "·"}
+        problems = []
+
+        class Scan(HTMLParser):
+            def __init__(self):
+                super().__init__()
+                self.stack = []
+                self.found = []
+
+            def handle_starttag(self, tag, attrs):
+                self.stack.append((tag, dict(attrs)))
+
+            def handle_endtag(self, tag):
+                while self.stack and self.stack[-1][0] != tag:
+                    self.stack.pop()
+                if self.stack:
+                    self.stack.pop()
+
+            def handle_data(self, data):
+                text = " ".join(data.split())
+                if not text or text in allow:
+                    return
+                tags = [t for t, _ in self.stack]
+                if any(t in ("script", "style", "title") for t in tags):
+                    return
+                attrs = self.stack[-1][1] if self.stack else {}
+                self.found.append((text, "data-i18n" in attrs))
+
+        seen_any = False
+        for path in glob.glob(os.path.join(PKG, "**", "*.html"),
+                              recursive=True):
+            scan = Scan()
+            scan.feed(open(path).read())
+            for text, marked in scan.found:
+                seen_any = True
+                if not marked:
+                    problems.append((os.path.relpath(path, PKG), text,
+                                     "unmarked"))
+                elif text not in keys:
+                    problems.append((os.path.relpath(path, PKG), text,
+                                     "missing from fr catalog"))
+        assert seen_any
+        assert not problems, f"untranslatable shell strings: {problems}"
