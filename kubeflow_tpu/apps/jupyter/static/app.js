@@ -273,7 +273,12 @@
     root.appendChild(row);
 
     // TPU preset picker (replaces the reference's GPU vendor/count).
-    root.appendChild(KF.el('label', { text: KF.t('TPU slice') }));
+    var tpuLabel = KF.el('label', { text: KF.t('TPU slice') });
+    tpuLabel.appendChild(KF.helpPopover(
+      'Accelerator and topology for the notebook. Multi-host slices ' +
+      'spawn one pod per host with gang semantics: if any rank ' +
+      'crashes, the whole slice restarts together.'));
+    root.appendChild(tpuLabel);
     f.tpu = KF.el('select', {}, [
       KF.el('option', { value: 'none', text: KF.t('None (CPU only)') }),
     ].concat(state.presets.map(function (p) {
@@ -324,7 +329,11 @@
       'tolerationGroup', 'groupKey', 'Tolerations');
 
     // PodDefault configurations.
-    root.appendChild(KF.el('label', { text: KF.t('Configurations') }));
+    var cfgLabel = KF.el('label', { text: KF.t('Configurations') });
+    cfgLabel.appendChild(KF.helpPopover(
+      'PodDefaults applied by the admission webhook at pod creation ' +
+      '(environment, volumes, tolerations).'));
+    root.appendChild(cfgLabel);
     f.pdBox = KF.el('div', {});
     root.appendChild(f.pdBox);
     f.pdChecks = [];

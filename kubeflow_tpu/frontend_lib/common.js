@@ -460,7 +460,14 @@
   // fetchEvents: () -> Promise<event[]>.
   KF.eventsPane = function (pane, fetchEvents) {
     var box = KF.el('div', {});
+    var first = true;
     function load() {
+      if (first) {
+        first = false;
+        return KF.withSpinner(box, fetchEvents, function (c, events) {
+          KF.eventsTable(c, events);
+        }).catch(function () {});
+      }
       fetchEvents().then(function (events) {
         KF.eventsTable(box, events);
       }).catch(function (err) { KF.snack(err.message, true); });
@@ -610,6 +617,69 @@
       text: KF.relTime(timestamp),
       title: KF.absTime(timestamp),
     });
+  };
+
+  // ---- loading spinner (reference lib loading-spinner: shown while
+  // a pane's first fetch is in flight; callers swap it for content) --
+  KF.spinner = function (label) {
+    return KF.el('div', {
+      'class': 'kf-spinner', role: 'status',
+      'aria-label': KF.t(label || 'Loading…'),
+    }, [
+      KF.el('span', { 'class': 'kf-spinner-dot' }),
+      KF.el('span', { 'class': 'kf-spinner-label',
+                      text: KF.t(label || 'Loading…') }),
+    ]);
+  };
+
+  // Run fetchFn with a spinner in ``container`` until it settles, then
+  // hand the container to render(data) (or show the error).
+  KF.withSpinner = function (container, fetchFn, render) {
+    container.innerHTML = '';
+    container.appendChild(KF.spinner());
+    return fetchFn().then(function (data) {
+      container.innerHTML = '';
+      render(container, data);
+      return data;
+    }).catch(function (err) {
+      container.innerHTML = '';
+      container.appendChild(KF.el('p', {
+        'class': 'kf-help', text: err.message,
+      }));
+      throw err;
+    });
+  };
+
+  // ---- help popover (reference lib help-popover: a ? toggle whose
+  // bubble explains a form field; Escape or outside click closes) ----
+  KF.helpPopover = function (text) {
+    var wrap = KF.el('span', { 'class': 'kf-popover-wrap' });
+    var bubble = KF.el('span', {
+      'class': 'kf-popover', role: 'tooltip', text: KF.t(text),
+    });
+    bubble.hidden = true;
+    var btn = KF.el('button', {
+      'class': 'kf-popover-btn', type: 'button', text: '?',
+      'aria-label': KF.t('Help'), 'aria-expanded': 'false',
+      onclick: function (ev) {
+        ev.stopPropagation();
+        bubble.hidden = !bubble.hidden;
+        btn.setAttribute('aria-expanded', String(!bubble.hidden));
+      },
+    });
+    function close() {
+      bubble.hidden = true;
+      btn.setAttribute('aria-expanded', 'false');
+    }
+    document.addEventListener('click', function (ev) {
+      if (!wrap.contains(ev.target)) close();
+    });
+    document.addEventListener('keydown', function (ev) {
+      if (ev.key === 'Escape') close();
+    });
+    wrap.appendChild(btn);
+    wrap.appendChild(bubble);
+    return wrap;
   };
 
   KF.shortImage = function (image) {

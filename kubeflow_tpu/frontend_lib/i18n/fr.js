@@ -105,5 +105,12 @@
     'You don\'t have a namespace yet. Create one to start spawning TPU notebooks.':
       'Vous n\'avez pas encore d\'espace de noms. Créez-en un pour lancer des notebooks TPU.',
     'Create namespace': 'Créer un espace de noms',
+    // ---- widgets (round 4: spinner + help popover) ----
+    'Loading…': 'Chargement…',
+    'Help': 'Aide',
+    'Accelerator and topology for the notebook. Multi-host slices spawn one pod per host with gang semantics: if any rank crashes, the whole slice restarts together.':
+      'Accélérateur et topologie du notebook. Les tranches multi-hôtes lancent un pod par hôte avec une sémantique de gang : si un rang plante, toute la tranche redémarre ensemble.',
+    'PodDefaults applied by the admission webhook at pod creation (environment, volumes, tolerations).':
+      'PodDefaults appliqués par le webhook d\'admission à la création du pod (environnement, volumes, tolérances).',
   });
 })();

@@ -172,3 +172,37 @@ def test_events_humanized_time_french(page, seeded_jwa):
     cell = page.locator(".kf-reltime").first
     cell.wait_for()
     assert "il y a" in cell.inner_text()
+
+
+def test_help_popover_toggles_on_form(page, seeded_jwa):
+    """help-popover widget (reference lib help-popover): the spawner's
+    TPU field has a ? toggle whose bubble opens on click and closes on
+    Escape."""
+    url, _ = seeded_jwa
+    page.goto(url)
+    page.locator("#new-btn").click()
+    btn = page.locator(".kf-popover-btn").first
+    btn.wait_for()
+    bubble = page.locator(".kf-popover").first
+    assert bubble.is_hidden()
+    btn.click()
+    assert bubble.is_visible()
+    assert "gang" in bubble.inner_text()
+    page.keyboard.press("Escape")
+    assert bubble.is_hidden()
+
+
+def test_events_pane_shows_spinner_first(page, seeded_jwa):
+    """loading-spinner widget: the events pane renders the spinner
+    while its first fetch is in flight, then swaps in the table."""
+    url, _ = seeded_jwa
+    # Delay the events API so the spinner is observable.
+    page.route("**/events", lambda route: (
+        page.wait_for_timeout(400), route.continue_())[-1])
+    page.goto(url)
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    page.locator("button.kf-tab", has_text="Events").click()
+    pane = page.locator(".kf-tab-pane:not([hidden])")
+    pane.locator(".kf-spinner").wait_for(state="visible")
+    pane.locator("table").wait_for()
+    assert pane.locator(".kf-spinner").count() == 0

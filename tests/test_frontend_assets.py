@@ -354,3 +354,26 @@ class TestI18n:
                                      "missing from fr catalog"))
         assert seen_any
         assert not problems, f"untranslatable shell strings: {problems}"
+
+    def test_help_popover_texts_covered(self):
+        """KF.helpPopover translates its text internally; the string
+        (often a JS concat across lines) must exist in the catalog as
+        the full joined key."""
+        keys = self.catalog_keys()
+        missing = []
+        for path in JS_FILES:
+            if os.sep + "i18n" + os.sep in path:
+                continue
+            src = open(path).read()
+            for call in re.finditer(
+                r"KF\.helpPopover\(\s*((?:'(?:[^'\\]|\\.)*'|\s|\+)+)\)",
+                src,
+            ):
+                joined = "".join(
+                    m.group(1).replace("\\'", "'")
+                    for m in re.finditer(r"'((?:[^'\\]|\\.)*)'",
+                                         call.group(1))
+                )
+                if joined and joined not in keys:
+                    missing.append((os.path.basename(path), joined[:50]))
+        assert not missing, f"helpPopover texts missing from fr: {missing}"
