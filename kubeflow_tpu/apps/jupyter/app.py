@@ -93,15 +93,57 @@ def create_app(
         }
 
     # ---- config / discovery --------------------------------------------
+    def _deep_merge(base: dict, override: dict) -> dict:
+        """Per-field namespace override: dict values merge recursively,
+        everything else replaces (so a namespace can pin just
+        image.value without restating the option list)."""
+        out = dict(base)
+        for key, val in override.items():
+            if isinstance(val, dict) and isinstance(out.get(key), dict):
+                out[key] = _deep_merge(out[key], val)
+            else:
+                out[key] = val
+        return out
+
+    def _namespace_overrides(namespace: str | None) -> dict:
+        """Per-namespace spawner defaults from the ``notebook-defaults``
+        ConfigMap in the user's namespace (data key
+        ``spawnerFormDefaults``, YAML) — the role of the reference's
+        one-global-ConfigMap config, made namespace-scopable so teams
+        can pin their own images/resources. Absent or malformed maps
+        fall back to the global config (a broken override must not
+        take the spawner down)."""
+        if not namespace:
+            return {}
+        from kubeflow_tpu.k8s.core import ApiError as K8sApiError
+
+        try:
+            cm = api.get("v1", "ConfigMap", "notebook-defaults",
+                         namespace)
+        except K8sApiError:
+            return {}
+        raw = (cm.get("data") or {}).get("spawnerFormDefaults")
+        if not raw:
+            return {}
+        try:
+            parsed = yaml.safe_load(raw)
+        except yaml.YAMLError:
+            return {}
+        return parsed if isinstance(parsed, dict) else {}
+
     @app.route("/api/config")
     def get_config(request):
         config = config_cache.get()
-        accelerators = (
-            (config.get("spawnerFormDefaults") or {}).get("tpu") or {}
-        ).get("accelerators") or ["v5e"]
+        base = config.get("spawnerFormDefaults", {})
+        namespace = request.args.get("ns")
+        overrides = _namespace_overrides(namespace)
+        merged = _deep_merge(base, overrides) if overrides else base
+        accelerators = ((merged.get("tpu") or {})
+                        .get("accelerators") or ["v5e"])
         return {
-            "config": config.get("spawnerFormDefaults", {}),
+            "config": merged,
             "tpuPresets": spawner_presets(accelerators),
+            "namespaced": bool(overrides),
         }
 
     # ---- notebooks ------------------------------------------------------

@@ -421,17 +421,24 @@
   });
 
   // ---- boot ----
-  KF.get('api/config').then(function (d) {
-    state.config = d.config;
-    state.presets = d.tpuPresets || [];
-  }).catch(function (err) {
-    KF.snack('Could not load spawner config: ' + err.message, true);
-  });
+  function loadConfig(ns) {
+    // Per-namespace presets: the backend merges the namespace's
+    // notebook-defaults ConfigMap over the global spawner config.
+    var url = 'api/config' + (ns ? '?ns=' + encodeURIComponent(ns) : '');
+    KF.get(url).then(function (d) {
+      state.config = d.config;
+      state.presets = d.tpuPresets || [];
+    }).catch(function (err) {
+      KF.snack('Could not load spawner config: ' + err.message, true);
+    });
+  }
+  loadConfig(null);
 
   KF.namespace(
     { standaloneMount: document.getElementById('ns-mount') },
     function (ns) {
       state.namespace = ns;
+      loadConfig(ns);
       show(listView);
       refresh();
     });

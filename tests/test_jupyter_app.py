@@ -503,3 +503,57 @@ class TestDefaultDeny:
         resp = client.get("/api/namespaces/alice/notebooks",
                           headers=USER_HEADERS)
         assert resp.status_code == 403
+
+
+class TestNamespacedSpawnerConfig:
+    """Per-namespace spawner presets: a notebook-defaults ConfigMap in
+    the user's namespace deep-merges over the global spawner config —
+    teams pin their own images/resources without an admin redeploy."""
+
+    def test_namespace_overrides_merge_over_global(self):
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-defaults",
+                         "namespace": "alice"},
+            "data": {"spawnerFormDefaults": (
+                "image:\n  value: team/image:pinned\n"
+                "cpu:\n  value: '7'\n"
+            )},
+        })
+        client = client_for(api)
+        plain = client.get("/api/config",
+                           headers=USER_HEADERS).get_json()
+        scoped = client.get("/api/config?ns=alice",
+                            headers=USER_HEADERS).get_json()
+        assert plain["namespaced"] is False
+        assert scoped["namespaced"] is True
+        assert scoped["config"]["image"]["value"] == "team/image:pinned"
+        assert scoped["config"]["cpu"]["value"] == "7"
+        # Non-overridden fields keep the global values (deep merge,
+        # not replacement).
+        for key in plain["config"]:
+            if key not in ("image", "cpu"):
+                assert scoped["config"][key] == plain["config"][key]
+        # image options from the global config survive under the
+        # overridden value.
+        if "options" in plain["config"].get("image", {}):
+            assert scoped["config"]["image"]["options"] == \
+                plain["config"]["image"]["options"]
+
+    def test_missing_or_malformed_configmap_falls_back(self):
+        api = FakeApiServer()
+        client = client_for(api)
+        ok = client.get("/api/config?ns=alice",
+                        headers=USER_HEADERS).get_json()
+        assert ok["namespaced"] is False
+        api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-defaults",
+                         "namespace": "alice"},
+            "data": {"spawnerFormDefaults": ": not yaml ["},
+        })
+        bad = client.get("/api/config?ns=alice",
+                         headers=USER_HEADERS).get_json()
+        assert bad["namespaced"] is False
+        assert bad["config"] == ok["config"]
