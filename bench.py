@@ -138,7 +138,8 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
 def bench_decode(batch: int, prompt_len: int, new_tokens: int,
                  prefill_anchor: float | None,
                  decode_anchor: float | None,
-                 window: int | None = None):
+                 window: int | None = None,
+                 quantized: bool = False):
     """KV-cache inference throughput (models/decoding.py): prefill
     tokens/s (one full-prompt forward populating the cache) and
     steady-state decode tokens/s (a single compiled one-token step
@@ -172,7 +173,8 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
 
     @jax.jit
     def prefill(params, prompt):
-        cache = KVCache.init(cfg, batch, max_len, rolling=rolling)
+        cache = KVCache.init(cfg, batch, max_len, rolling=rolling,
+                             quantized=quantized)
         logits, cache = forward_with_cache(cfg, params, prompt, cache)
         first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return first, cache
@@ -180,7 +182,8 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
     @jax.jit
     def prefill_many(params, prompts):  # (R, B, P)
         def one(carry, prompt):
-            cache = KVCache.init(cfg, batch, max_len, rolling=rolling)
+            cache = KVCache.init(cfg, batch, max_len, rolling=rolling,
+                                 quantized=quantized)
             logits, _ = forward_with_cache(cfg, params, prompt, cache)
             first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
             return carry ^ first[0], None
@@ -248,6 +251,7 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
         "new_tokens": new_tokens,
         **({"window": window, "rolling_cache": True}
            if window is not None else {}),
+        **({"kv_cache": "int8"} if quantized else {}),
         "decode_step_ms": round(1000 * decode_dt / new_tokens, 3),
         "prefill_tokens_per_sec": round(prefill_tok_s, 1),
         "prefill_vs_baseline": (
@@ -519,6 +523,24 @@ def main():
                                        165938),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P32K_ANCHOR",
                                       286),
+        )),
+        # int8 KV cache at the cache-bandwidth-bound config (batch x
+        # long prompt): payload reads halve vs the bf16 rows above.
+        ("lm_decode_tokens_per_sec_per_chip[b8-p8k]", False,
+         lambda: bench_decode(
+            batch=8, prompt_len=8192, new_tokens=64,
+            prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_B8P8K_ANCHOR",
+                                       335471),
+            decode_anchor=_env_anchor("KFT_BENCH_DECODE_B8P8K_ANCHOR",
+                                      2571),
+        )),
+        ("lm_decode_tokens_per_sec_per_chip[b8-p8k-int8]", False,
+         lambda: bench_decode(
+            batch=8, prompt_len=8192, new_tokens=64, quantized=True,
+            prefill_anchor=_env_anchor(
+                "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 332782),
+            decode_anchor=_env_anchor(
+                "KFT_BENCH_DECODE_B8P8K_INT8_ANCHOR", 3477),
         )),
         # Sliding-window model decoding from the O(window) rolling
         # cache: per-token cost must not grow with the prompt.

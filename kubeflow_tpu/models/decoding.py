@@ -77,17 +77,30 @@ class KVCache:
     returns. It lets the prefill path pick the flash kernel at trace
     time — ``length`` is a tracer under jit, so the dispatch cannot
     read it.
+
+    ``quantized=True`` stores K/V as int8 with per-row (position x
+    kv-head) absmax scales: cache memory AND per-token decode reads
+    halve vs bf16 — the lever for long prompts at batch, where decode
+    is cache-bandwidth-bound. Scales factor out of both attention
+    matmuls (per-row scalars), so scores are computed on the int8
+    payload and rescaled, never on a materialised dequantised cache.
     """
 
     k: jax.Array  # (layers, B, kv_heads, capacity, head_dim)
     v: jax.Array
     length: jax.Array  # () int32 — tokens written so far
+    k_scale: jax.Array | None = None  # (layers, B, Hkv, capacity) f32
+    v_scale: jax.Array | None = None
     rolling: bool = False
     empty: bool = False
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
     @classmethod
     def init(cls, cfg: LMConfig, batch: int, max_len: int,
-             rolling: bool = False) -> "KVCache":
+             rolling: bool = False, quantized: bool = False) -> "KVCache":
         if rolling:
             if cfg.attn_window is None:
                 raise ValueError(
@@ -104,19 +117,40 @@ class KVCache:
                 capacity += DECODE_BLOCK - capacity % DECODE_BLOCK
         shape = (cfg.layers, batch, cfg.num_kv_heads, capacity,
                  cfg.head_dim)
+        dtype = jnp.int8 if quantized else cfg.dtype
+        # Trailing singleton so scale buffers share the 4-D position
+        # axis layout (and the write helpers) of the payload.
+        scale_shape = (cfg.layers, batch, cfg.num_kv_heads, capacity, 1)
         return cls(
-            k=jnp.zeros(shape, cfg.dtype),
-            v=jnp.zeros(shape, cfg.dtype),
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
             length=jnp.zeros((), jnp.int32),
+            k_scale=jnp.zeros(scale_shape, jnp.float32) if quantized
+            else None,
+            v_scale=jnp.zeros(scale_shape, jnp.float32) if quantized
+            else None,
             rolling=rolling,
             empty=True,
         )
 
 
 jax.tree_util.register_dataclass(
-    KVCache, data_fields=["k", "v", "length"],
+    KVCache, data_fields=["k", "v", "length", "k_scale", "v_scale"],
     meta_fields=["rolling", "empty"],
 )
+
+
+def _quantize_rows(x):
+    """(B, Hkv, T, hd) -> int8 payload + per-row absmax scale
+    (B, Hkv, T, 1). Symmetric per-row quantisation: row_max/127
+    preserves the attention dot products to ~0.5% per operand."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127,
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def _prefill_attention(cfg, q, k, v):
@@ -135,7 +169,7 @@ def _prefill_attention(cfg, q, k, v):
     return mha_reference(q, k, v, causal=True, window=cfg.attn_window)
 
 
-def _decode_attention(cfg, q, ck, cv, pos):
+def _decode_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
     """Single-token decode attention dispatch.
 
     Default is the DENSE masked read: measured on v5e (b1, 8x1024 GQA
@@ -156,14 +190,17 @@ def _decode_attention(cfg, q, ck, cv, pos):
 
     impl = os.environ.get("KFT_DECODE_IMPL", "dense")
     capacity = ck.shape[2]
-    if (impl == "kernel" and jax.default_backend() == "tpu"
+    if (impl == "kernel" and ks is None
+            and jax.default_backend() == "tpu"
             and capacity % DECODE_BLOCK == 0):
+        # The Pallas kernel reads the bf16 payload only; an int8 cache
+        # always takes the dense path (its rescale fuses there).
         from kubeflow_tpu.ops.decode_attention import decode_attention
 
         return decode_attention(
             q, ck, cv, pos, window=cfg.attn_window, block=DECODE_BLOCK,
         )
-    return _cached_attention(cfg, q, ck, cv, pos, 1)
+    return _cached_attention(cfg, q, ck, cv, pos, 1, ks, vs)
 
 
 def _flash_decode_xla(cfg, q, ck, cv, pos):
@@ -228,48 +265,62 @@ def _flash_decode_xla(cfg, q, ck, cv, pos):
     return (acc / l).reshape(b, h, t, hd).astype(q.dtype)
 
 
-def _rolling_attention(cfg, q, ck, cv, pos):
+def _rolling_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
     """Decode attention over a circular window cache: slot j holds the
     newest global position ≡ j (mod capacity) that is ≤ pos; slots
     whose mapped position is negative are unwritten. capacity ≤ window,
-    so every written slot is in-band by construction."""
+    so every written slot is in-band by construction. ``ks``/``vs``
+    (B, Hkv, capacity, 1) dequantise an int8 cache per row — scales
+    factor out of both matmuls, so the payload is read as int8."""
     b, h, t, hd = q.shape
     hkv, capacity = ck.shape[1], ck.shape[2]
     group = h // hkv
     qg = q.reshape(b, hkv, group * t, hd)
+    compute = q.dtype
     s = jnp.einsum(
-        "bkgd,bkld->bkgl", qg, ck,
+        "bkgd,bkld->bkgl", qg, ck.astype(compute),
         preferred_element_type=jnp.float32,
     ) * hd ** -0.5
+    if ks is not None:
+        s = s * ks[..., 0][:, :, None, :]
     slots = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     global_pos = pos - (pos - slots) % capacity
     s = jnp.where(global_pos >= 0, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        w = w * vs[..., 0][:, :, None, :]
     out = jnp.einsum(
-        "bkgl,bkld->bkgd", w.astype(cv.dtype), cv,
+        "bkgl,bkld->bkgd", w.astype(compute), cv.astype(compute),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, h, t, hd).astype(q.dtype)
 
 
-def _cached_attention(cfg, q, ck, cv, pos, t):
+def _cached_attention(cfg, q, ck, cv, pos, t, ks=None, vs=None):
     """q: (B, H, T, hd) at global positions [pos, pos+T); ck/cv: full
     (B, Hkv, L, hd) cache. Masked dense attention over the whole
     buffer: valid iff col <= row's global position (causal), col within
     the filled region, and inside the sliding window if configured.
     Fallback for mid-sequence (pos > 0) multi-token chunks; empty-cache
-    prefill and single-token decode use the specialised paths above."""
+    prefill and single-token decode use the specialised paths above.
+    ``ks``/``vs`` (B, Hkv, L, 1) dequantise an int8 cache per row."""
     b, h, _, hd = q.shape
     group = h // ck.shape[1]
     qg = q.reshape(b, ck.shape[1], group, t, hd)
     # bf16 operands + f32 accumulation: an explicit f32 cast here would
     # force the ~8x-slower f32 MXU path (same rule as the flash
     # kernels); softmax stays f32, its weights go back to the compute
-    # dtype for the PV matmul (FlashAttention's own layout).
+    # dtype for the PV matmul (FlashAttention's own layout). An int8
+    # cache converts to the compute dtype IN the fused matmul consumer
+    # (the HBM read stays int8 — the bandwidth win) and rescales by the
+    # per-row scalar after the contraction.
+    compute = q.dtype
     s = jnp.einsum(
-        "bkgtd,bkld->bkgtl", qg, ck,
+        "bkgtd,bkld->bkgtl", qg, ck.astype(compute),
         preferred_element_type=jnp.float32,
     ) * hd ** -0.5
+    if ks is not None:
+        s = s * ks[..., 0][:, :, None, None, :]
     rows = pos + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
     keep = cols <= rows
@@ -277,8 +328,10 @@ def _cached_attention(cfg, q, ck, cv, pos, t):
         keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
     s = jnp.where(keep, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        w = w * vs[..., 0][:, :, None, None, :]
     out = jnp.einsum(
-        "bkgtl,bkld->bkgtd", w.astype(cv.dtype), cv,
+        "bkgtl,bkld->bkgtd", w.astype(compute), cv.astype(compute),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, h, t, hd).astype(q.dtype)
@@ -305,11 +358,13 @@ def _write_rolling_prefill(cache_buf, chunk, capacity):
 
 
 def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
-                use_moe=False):
+                ks_buf=None, vs_buf=None, use_moe=False):
     """One block over a (B, T, D) chunk at global offset ``pos``,
-    reading/updating this layer's (B, Hkv, capacity, hd) cache slices.
+    reading/updating this layer's (B, Hkv, capacity, hd) cache slices
+    (plus (B, Hkv, capacity, 1) scale slices for an int8 cache).
     Mirrors transformer.Block exactly (same param names/shapes)."""
     b, t, _ = x.shape
+    quantized = ks_buf is not None
     h = rms_norm(params["RMSNorm_0"]["scale"], x)
     proj = lambda name: (h @ params[name]["kernel"].astype(cfg.dtype))
     q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
@@ -323,30 +378,49 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     q = apply_rope(q, offset=pos)
     k = apply_rope(k, offset=pos)
     capacity = ck.shape[2]
+    if quantized:
+        k_store, k_s = _quantize_rows(k)
+        v_store, v_s = _quantize_rows(v)
+    else:
+        k_store, v_store, k_s, v_s = k, v, None, None
+
+    def write(at):
+        nonlocal ck, cv, ks_buf, vs_buf
+        ck = jax.lax.dynamic_update_slice(ck, k_store, (0, 0, at, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_store, (0, 0, at, 0))
+        if quantized:
+            ks_buf = jax.lax.dynamic_update_slice(
+                ks_buf, k_s, (0, 0, at, 0)
+            )
+            vs_buf = jax.lax.dynamic_update_slice(
+                vs_buf, v_s, (0, 0, at, 0)
+            )
 
     if t == 1:
-        slot = pos % capacity if rolling else pos
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, slot, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, slot, 0))
+        write(pos % capacity if rolling else pos)
         if rolling:
-            out = _rolling_attention(cfg, q, ck, cv, pos)
+            out = _rolling_attention(cfg, q, ck, cv, pos, ks_buf, vs_buf)
         else:
-            out = _decode_attention(cfg, q, ck, cv, pos)
+            out = _decode_attention(cfg, q, ck, cv, pos, ks_buf, vs_buf)
     elif empty:
         # Empty-cache prefill (pos == 0 by the `empty` contract): the
-        # chunk attends to itself through the training kernels; the
-        # cache write happens on the side. KFT_PREFILL_IMPL=dense
-        # forces the masked full-buffer read (A/B escape hatch).
+        # chunk attends to itself through the training kernels on the
+        # UNQUANTISED k/v (full precision where it is free); the cache
+        # write happens on the side. KFT_PREFILL_IMPL=dense forces the
+        # masked full-buffer read (A/B escape hatch).
         import os
 
         if rolling:
             out = _prefill_attention(cfg, q, k, v)
-            ck = _write_rolling_prefill(ck, k, capacity)
-            cv = _write_rolling_prefill(cv, v, capacity)
+            ck = _write_rolling_prefill(ck, k_store, capacity)
+            cv = _write_rolling_prefill(cv, v_store, capacity)
+            if quantized:
+                ks_buf = _write_rolling_prefill(ks_buf, k_s, capacity)
+                vs_buf = _write_rolling_prefill(vs_buf, v_s, capacity)
         else:
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
-            if os.environ.get("KFT_PREFILL_IMPL") == "dense":
+            write(0)
+            if (os.environ.get("KFT_PREFILL_IMPL") == "dense"
+                    and not quantized):
                 out = _cached_attention(cfg, q, ck, cv, pos, t)
             else:
                 out = _prefill_attention(cfg, q, k, v)
@@ -358,9 +432,8 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
                 "chunked prefill on a rolling cache is not supported; "
                 "prefill the prompt in one chunk (generate() does)"
             )
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, pos, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, pos, 0))
-        out = _cached_attention(cfg, q, ck, cv, pos, t)
+        write(pos)
+        out = _cached_attention(cfg, q, ck, cv, pos, t, ks_buf, vs_buf)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
     x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
 
@@ -376,7 +449,7 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     else:
         h = jax.nn.gelu(h @ params["up"]["kernel"].astype(cfg.dtype))
         x = x + h @ params["down"]["kernel"].astype(cfg.dtype)
-    return x, ck, cv
+    return x, ck, cv, ks_buf, vs_buf
 
 
 def forward_with_cache(
@@ -406,23 +479,31 @@ def forward_with_cache(
         )
     emb = params["embed"]["embedding"]
     x = emb[tokens].astype(cfg.dtype)
-    new_k, new_v = [], []
+    quantized = cache.quantized
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(cfg.layers):
         use_moe = (
             cfg.moe_experts > 0
             and i % cfg.moe_every == cfg.moe_every - 1
         )
-        x, ck, cv = _block_step(
+        x, ck, cv, ks, vs = _block_step(
             cfg, params[f"block_{i}"], x, cache.k[i], cache.v[i], pos,
-            cache.empty, cache.rolling, use_moe=use_moe,
+            cache.empty, cache.rolling,
+            ks_buf=cache.k_scale[i] if quantized else None,
+            vs_buf=cache.v_scale[i] if quantized else None,
+            use_moe=use_moe,
         )
         new_k.append(ck)
         new_v.append(cv)
+        new_ks.append(ks)
+        new_vs.append(vs)
     x = rms_norm(params["final_norm"]["scale"], x)
     logits = tied_head(x, emb, cfg.dtype)
     cache = KVCache(
         k=jnp.stack(new_k), v=jnp.stack(new_v),
         length=pos + tokens.shape[1],
+        k_scale=jnp.stack(new_ks) if quantized else None,
+        v_scale=jnp.stack(new_vs) if quantized else None,
         rolling=cache.rolling,
         empty=False,
     )
@@ -436,6 +517,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    quantize_cache: bool = False,
 ):
     """Greedy (temperature=0) or temperature sampling. ``prompt``
     (B, P) int32; returns (B, max_new_tokens) int32. Jit-compatible:
@@ -467,7 +549,8 @@ def generate(
     # bandwidth become O(window) instead of O(prompt + generated).
     total = p + max_new_tokens - 1
     rolling = cfg.attn_window is not None and cfg.attn_window < total
-    cache = KVCache.init(cfg, b, total, rolling=rolling)
+    cache = KVCache.init(cfg, b, total, rolling=rolling,
+                         quantized=quantize_cache)
     logits, cache = forward_with_cache(cfg, params, prompt, cache)
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path below

@@ -303,3 +303,72 @@ class TestDecodeKernel:
                              jnp.zeros((1, 2, 512, 128)),
                              jnp.zeros((1, 2, 512, 128)),
                              jnp.int32(0), interpret=True)
+
+
+class TestQuantizedCache:
+    """int8 KV cache: per-row absmax quantisation halves cache memory
+    and decode reads; logits must stay within quantisation tolerance of
+    the bf16-cache path at every teacher-forced step."""
+
+    def test_roundtrip_error_bound(self):
+        from kubeflow_tpu.models.decoding import _quantize_rows
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 2, 16, 64)) * 3, jnp.float32)
+        q, scale = _quantize_rows(x)
+        assert q.dtype == jnp.int8
+        assert scale.shape == (2, 2, 16, 1)
+        recon = q.astype(jnp.float32) * scale
+        err = np.max(np.abs(np.asarray(recon - x)))
+        # Error is bounded by scale/2 per element.
+        assert err <= float(jnp.max(scale)) * 0.5 + 1e-6
+
+    @pytest.mark.parametrize("name", ["gqa", "windowed"])
+    def test_decode_close_to_fp_cache(self, name):
+        cfg = CONFIGS[name]
+        model, params, tokens = _setup(cfg, seq=12)
+        fp = KVCache.init(cfg, tokens.shape[0], 12)
+        q8 = KVCache.init(cfg, tokens.shape[0], 12, quantized=True)
+        assert q8.k.dtype == jnp.int8
+        _, fp = forward_with_cache(cfg, params, tokens[:, :6], fp)
+        _, q8 = forward_with_cache(cfg, params, tokens[:, :6], q8)
+        for t in range(6, 12):
+            lf, fp = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        fp)
+            lq, q8 = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        q8)
+            # Per-operand quantisation error ~0.5%; logits of the tiny
+            # test model stay within a small absolute band.
+            np.testing.assert_allclose(
+                np.asarray(lq), np.asarray(lf), atol=0.08, rtol=0.05,
+                err_msg=f"{name} position {t}",
+            )
+
+    def test_rolling_quantized_decode(self):
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4, kv_heads=2,
+                       attn_window=5)
+        model, params, tokens = _setup(cfg, seq=14)
+        fp = KVCache.init(cfg, tokens.shape[0], 14, rolling=True)
+        q8 = KVCache.init(cfg, tokens.shape[0], 14, rolling=True,
+                          quantized=True)
+        assert q8.k.shape[3] == 5 and q8.k.dtype == jnp.int8
+        _, fp = forward_with_cache(cfg, params, tokens[:, :8], fp)
+        _, q8 = forward_with_cache(cfg, params, tokens[:, :8], q8)
+        for t in range(8, 14):
+            lf, fp = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        fp)
+            lq, q8 = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        q8)
+            np.testing.assert_allclose(
+                np.asarray(lq), np.asarray(lf), atol=0.08, rtol=0.05,
+                err_msg=f"position {t}",
+            )
+
+    def test_generate_quantized_runs(self):
+        cfg = CONFIGS["gqa"]
+        _, params, prompt = _setup(cfg, seq=6)
+        out = generate(cfg, params, prompt, max_new_tokens=4,
+                       quantize_cache=True)
+        assert out.shape == (2, 4)
+        assert np.all((np.asarray(out) >= 0) &
+                      (np.asarray(out) < cfg.vocab))
