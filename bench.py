@@ -530,17 +530,17 @@ def main():
          lambda: bench_decode(
             batch=8, prompt_len=8192, new_tokens=64,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_B8P8K_ANCHOR",
-                                       335471),
+                                       374034),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_B8P8K_ANCHOR",
-                                      2571),
+                                      1350),
         )),
         ("lm_decode_tokens_per_sec_per_chip[b8-p8k-int8]", False,
          lambda: bench_decode(
             batch=8, prompt_len=8192, new_tokens=64, quantized=True,
             prefill_anchor=_env_anchor(
-                "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 332782),
+                "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 373990),
             decode_anchor=_env_anchor(
-                "KFT_BENCH_DECODE_B8P8K_INT8_ANCHOR", 3477),
+                "KFT_BENCH_DECODE_B8P8K_INT8_ANCHOR", 1979),
         )),
         # Sliding-window model decoding from the O(window) rolling
         # cache: per-token cost must not grow with the prompt.
