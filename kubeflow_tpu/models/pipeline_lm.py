@@ -44,7 +44,13 @@ from kubeflow_tpu.models.transformer import (
 from kubeflow_tpu.ops import flash_attention, mha_reference, ring_attention
 from kubeflow_tpu.parallel import param_sharding, token_sharding
 from kubeflow_tpu.parallel.mesh import path_key
-from kubeflow_tpu.parallel.pipeline import gpipe, one_f_one_b, stage_stack
+from kubeflow_tpu.parallel.pipeline import (
+    gpipe,
+    interleaved_gpipe,
+    one_f_one_b,
+    stage_stack,
+    stage_stack_interleaved,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,14 +65,25 @@ class PipelinedLM:
     remat: bool = False
     # "gpipe": AD-of-scan backward (O(M) live microbatch state);
     # "1f1b": PipeDream-flush interleaved backward (O(P), inherent
-    # stage rematerialisation — the schedule for large M).
+    # stage rematerialisation — the schedule for large M);
+    # "interleaved": virtual-stage (Megatron-interleaved) forward —
+    # each device holds ``virtual_stages`` chunks round-robin, fill
+    # bubble P-1 ticks at V*P depth (AD backward like gpipe).
     schedule: str = "gpipe"
+    # Chunks per device under schedule="interleaved". NOTE: params are
+    # stored depth-stacked (L, ...) with contiguous pp sharding; the
+    # per-step restack to the round-robin layout makes XLA gather the
+    # non-resident chunks — correct everywhere, but a production
+    # multi-chip deployment would store blocks pre-interleaved to keep
+    # weights resident.
+    virtual_stages: int = 1
 
     def __post_init__(self):
         cfg, mesh = self.cfg, self.mesh
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule must be gpipe|1f1b, got {self.schedule!r}"
+                f"schedule must be gpipe|1f1b|interleaved, got "
+                f"{self.schedule!r}"
             )
         if self.schedule == "1f1b" and self.remat:
             raise ValueError(
@@ -74,10 +91,21 @@ class PipelinedLM:
                 "backward recomputes stage internals inherently); "
                 "drop remat=True"
             )
-        if cfg.layers % mesh.shape["pp"]:
+        if self.virtual_stages != 1 and self.schedule != "interleaved":
+            raise ValueError(
+                "virtual_stages applies to schedule='interleaved' only"
+            )
+        chunks = mesh.shape["pp"] * (
+            self.virtual_stages if self.schedule == "interleaved" else 1
+        )
+        if cfg.layers % chunks:
             raise ValueError(
                 f"layers={cfg.layers} not divisible by "
-                f"pp={mesh.shape['pp']} stages"
+                f"{chunks} pipeline chunks "
+                f"(pp={mesh.shape['pp']}"
+                + (f" x virtual={self.virtual_stages}"
+                   if self.schedule == "interleaved" else "")
+                + ")"
             )
         if cfg.moe_experts:
             raise ValueError(
@@ -217,9 +245,19 @@ class PipelinedLM:
         )
         if self.schedule == "1f1b":
             run = one_f_one_b(stage_fn, mesh, **common)
+        elif self.schedule == "interleaved":
+            run = interleaved_gpipe(
+                stage_fn, mesh, remat=self.remat,
+                virtual_stages=self.virtual_stages, **common,
+            )
         else:
             run = gpipe(stage_fn, mesh, remat=self.remat, **common)
-        stacked = stage_stack(params["blocks"], mesh.shape["pp"])
+        if self.schedule == "interleaved":
+            stacked = stage_stack_interleaved(
+                params["blocks"], mesh.shape["pp"], self.virtual_stages
+            )
+        else:
+            stacked = stage_stack(params["blocks"], mesh.shape["pp"])
         if packed:
             x = run(stacked, x, segment_ids)
         else:

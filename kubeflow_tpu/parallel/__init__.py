@@ -23,9 +23,11 @@ from kubeflow_tpu.parallel.distributed import (
 )
 from kubeflow_tpu.parallel.pipeline import (
     gpipe,
+    interleaved_gpipe,
     one_f_one_b,
     pipeline_ticks,
     stage_stack,
+    stage_stack_interleaved,
 )
 
 __all__ = [
@@ -38,9 +40,11 @@ __all__ = [
     "replicated",
     "param_sharding",
     "gpipe",
+    "interleaved_gpipe",
     "one_f_one_b",
     "pipeline_ticks",
     "stage_stack",
+    "stage_stack_interleaved",
     "DistributedEnv",
     "initialize_from_env",
     "slice_env_for_rank",

@@ -515,3 +515,210 @@ class TestPackedPipeline:
             state, metrics = step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
+
+
+class TestInterleavedGpipe:
+    """Virtual-stage (Megatron-interleaved) schedule: device d holds
+    chunks d, d+P, ..., round-robin; numerically the SAME program as
+    the sequential chain, with the fill bubble at P-1 ticks instead of
+    V*P-1."""
+
+    def _setup(self, layers=8, width=8, batch=16, seed=3):
+        from kubeflow_tpu.parallel import make_mesh
+
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        rng = np.random.default_rng(seed)
+        w = jnp.asarray(
+            rng.normal(size=(layers, width, width)), jnp.float32
+        ) * 0.1
+        x = jnp.asarray(rng.normal(size=(batch, width)), jnp.float32)
+        stage = lambda p, h: jnp.tanh(h @ p) if p.ndim == 2 else None
+        # A chunk holds layers/(V*P) consecutive layers: scan them.
+        def chunk(p, h):
+            def layer(h, pw):
+                return jnp.tanh(h @ pw), None
+            h, _ = jax.lax.scan(layer, h, p)
+            return h
+        def seq(x):
+            y = x
+            for i in range(layers):
+                y = jnp.tanh(y @ w[i])
+            return y
+        return mesh, w, x, chunk, seq
+
+    @pytest.mark.parametrize("virtual", [1, 2])
+    @pytest.mark.parametrize("output", ["replicated", "sharded"])
+    def test_forward_matches_sequential(self, virtual, output):
+        from kubeflow_tpu.parallel import (
+            interleaved_gpipe,
+            stage_stack_interleaved,
+        )
+
+        mesh, w, x, chunk, seq = self._setup()
+        run = interleaved_gpipe(
+            chunk, mesh, num_microbatches=8, virtual_stages=virtual,
+            output=output,
+        )
+        stacked = stage_stack_interleaved(w, 4, virtual)
+        assert stacked.shape[:2] == (4, virtual)
+        y = jax.jit(run)(stacked, x)
+        np.testing.assert_allclose(
+            y, seq(x), rtol=1e-5, atol=1e-5,
+            err_msg=f"V={virtual} {output}",
+        )
+
+    def test_chunk_layout_round_robin(self):
+        """Global stage v*P + d must land at [d, v] — consecutive
+        chunks on consecutive devices."""
+        from kubeflow_tpu.parallel import stage_stack_interleaved
+
+        w = jnp.arange(8)[:, None] * jnp.ones((8, 3))
+        stacked = stage_stack_interleaved(w, 4, 2)  # L=8, P=4, V=2, L/C=1
+        # chunk c holds layer c; [d, v] = chunk v*4 + d.
+        for d in range(4):
+            for v in range(2):
+                assert float(stacked[d, v, 0, 0]) == v * 4 + d
+
+    def test_grads_match_sequential(self):
+        from kubeflow_tpu.parallel import (
+            interleaved_gpipe,
+            stage_stack_interleaved,
+        )
+
+        mesh, w, x, chunk, seq = self._setup()
+        run = interleaved_gpipe(
+            chunk, mesh, num_microbatches=8, virtual_stages=2,
+        )
+
+        def loss_pp(w, x):
+            return jnp.sum(
+                run(stage_stack_interleaved(w, 4, 2), x) ** 2
+            )
+
+        def loss_seq(w, x):
+            y = x
+            for i in range(w.shape[0]):
+                y = jnp.tanh(y @ w[i])
+            return jnp.sum(y ** 2)
+
+        g_pp, gx_pp = jax.jit(jax.grad(loss_pp, argnums=(0, 1)))(w, x)
+        g_seq, gx_seq = jax.jit(jax.grad(loss_seq, argnums=(0, 1)))(w, x)
+        np.testing.assert_allclose(g_pp, g_seq, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(gx_pp, gx_seq, rtol=1e-4, atol=1e-5)
+
+    def test_v1_matches_plain_gpipe(self):
+        """virtual_stages=1 degenerates to the plain schedule."""
+        from kubeflow_tpu.parallel import (
+            interleaved_gpipe,
+            stage_stack_interleaved,
+        )
+
+        mesh, w, x, chunk, seq = self._setup()
+        run_i = interleaved_gpipe(
+            chunk, mesh, num_microbatches=8, virtual_stages=1,
+        )
+        run_g = gpipe(chunk, mesh, num_microbatches=8)
+        y_i = jax.jit(run_i)(stage_stack_interleaved(w, 4, 1), x)
+        y_g = jax.jit(run_g)(stage_stack(w, 4), x)
+        np.testing.assert_allclose(y_i, y_g, rtol=1e-6, atol=1e-6)
+
+    def test_validation(self):
+        from kubeflow_tpu.parallel import (
+            interleaved_gpipe,
+            make_mesh,
+            stage_stack_interleaved,
+        )
+
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        chunk = lambda p, h: h
+        with pytest.raises(ValueError, match="divisible by pp"):
+            interleaved_gpipe(chunk, mesh, num_microbatches=6,
+                              virtual_stages=2)
+        with pytest.raises(ValueError, match="virtual_stages"):
+            interleaved_gpipe(chunk, mesh, num_microbatches=8,
+                              virtual_stages=0)
+        with pytest.raises(ValueError, match="chunks"):
+            stage_stack_interleaved(jnp.zeros((6, 2, 2)), 4, 2)
+
+
+class TestInterleavedLM:
+    """PipelinedLM(schedule='interleaved'): the virtual-stage schedule
+    through the full LM — parity with the sequential packed/unpacked
+    model, composing with sp and the train step."""
+
+    CFG = LMConfig(vocab=64, layers=8, dim=32, heads=2)
+
+    def test_forward_and_grads_match_sequential(self):
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        model = PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                            schedule="interleaved", virtual_stages=2)
+        params = model.init(jax.random.key(0))
+        tokens = _tokens(8, 16)
+        logits_pp = jax.jit(
+            lambda p: model.apply({"params": p}, tokens)
+        )(params)
+        logits_seq = jax.jit(
+            lambda p: model.sequential_apply({"params": p}, tokens)
+        )(params)
+        np.testing.assert_allclose(
+            logits_pp, logits_seq, rtol=1e-4, atol=1e-4
+        )
+        g_pp = jax.jit(jax.grad(
+            lambda p: lm_loss(model.apply({"params": p}, tokens), tokens)
+        ))(params)
+        g_seq = jax.jit(jax.grad(
+            lambda p: lm_loss(
+                model.sequential_apply({"params": p}, tokens), tokens
+            )
+        ))(params)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_pp),
+            jax.tree_util.tree_leaves_with_path(g_seq),
+        ):
+            np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+    def test_packed_interleaved_matches_sequential(self):
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        model = PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                            schedule="interleaved", virtual_stages=2)
+        params = model.init(jax.random.key(0))
+        tokens = _tokens(8, 16)
+        rng = np.random.default_rng(9)
+        seg = np.zeros((8, 16), np.int32)
+        for row in range(8):
+            cut = int(rng.integers(3, 13))
+            seg[row, cut:] = 1
+        seg = jnp.asarray(seg)
+        out_pp = jax.jit(
+            lambda p: model.apply({"params": p}, tokens, seg)
+        )(params)
+        out_seq = jax.jit(
+            lambda p: model.sequential_apply({"params": p}, tokens, seg)
+        )(params)
+        np.testing.assert_allclose(out_pp, out_seq, rtol=1e-4, atol=1e-4)
+
+    def test_interleaved_composes_with_sp_and_trains(self):
+        mesh = make_mesh(MeshSpec(pp=4, sp=2))
+        model = PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                            schedule="interleaved", virtual_stages=2)
+        state = create_pp_lm_state(model, jax.random.key(1))
+        step = make_pp_lm_train_step(model)
+        batch = {"tokens": _tokens(8, 16)}
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.all(np.isfinite(losses))
+
+    def test_validation(self):
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        with pytest.raises(ValueError, match="chunks"):
+            PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                        schedule="interleaved", virtual_stages=3)
+        with pytest.raises(ValueError, match="virtual_stages"):
+            PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                        virtual_stages=2)
