@@ -1,0 +1,130 @@
+"""Activity retention for the dashboard's Recent-activity feed.
+
+The feed is sourced from v1 Events, which real apiservers garbage-
+collect aggressively (default ``--event-ttl=1h``): anything older
+vanishes from the reference dashboard too (its api.ts reads events
+directly). This ledger keeps a rolling per-namespace history in a
+ConfigMap (``dashboard-activity-ledger``): every listing merges the
+live events into the stored entries, so activities survive event GC up
+to the entry cap. Writes are throttled (the dashboard polls; the
+ledger must not turn polling into a write storm) and best-effort — a
+missing/forbidden/corrupt ConfigMap degrades to the live-events-only
+behaviour, never to an error.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+LEDGER_NAME = "dashboard-activity-ledger"
+
+
+def _entry(event: dict) -> dict:
+    return {
+        "type": event.get("type", "Normal"),
+        "reason": event.get("reason", ""),
+        "message": event.get("message", ""),
+        "object": (event.get("involvedObject") or {}).get("name", ""),
+        "time": event.get("lastTimestamp")
+        or event["metadata"].get("creationTimestamp"),
+        # Aggregated events bump count; carrying it makes one ledger
+        # entry per (object, reason, time) wave instead of per repeat.
+        "count": event.get("count", 1),
+    }
+
+
+def _key(entry: dict) -> str:
+    return "|".join(
+        str(entry.get(k, "")) for k in ("object", "reason", "time")
+    )
+
+
+class ActivityLedger:
+    """Merge-and-persist activity history, newest first."""
+
+    def __init__(self, api, limit: int = 200,
+                 write_interval_s: float = 60.0,
+                 clock=time.monotonic):
+        self.api = api
+        self.limit = limit
+        self.write_interval_s = write_interval_s
+        self._clock = clock
+        self._last_write: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # ---- ConfigMap IO (best-effort) ---------------------------------
+    def _load(self, namespace: str) -> tuple[dict | None, list[dict]]:
+        try:
+            cm = self.api.get("v1", "ConfigMap", LEDGER_NAME, namespace)
+        except ApiError:
+            return None, []
+        try:
+            entries = json.loads(
+                (cm.get("data") or {}).get("entries", "[]")
+            )
+            if not isinstance(entries, list):
+                entries = []
+        except json.JSONDecodeError:
+            entries = []
+        return cm, entries
+
+    def _store(self, namespace: str, cm: dict | None,
+               entries: list[dict]) -> None:
+        data = {"entries": json.dumps(entries)}
+        try:
+            if cm is None:
+                self.api.create({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": LEDGER_NAME,
+                                 "namespace": namespace},
+                    "data": data,
+                })
+            else:
+                cm = dict(cm)
+                cm["data"] = data
+                self.api.update(cm)
+        except Conflict:
+            pass  # concurrent writer won; their merge includes ours soon
+        except ApiError as exc:
+            log.debug("activity ledger write skipped (%s): %s",
+                      namespace, exc)
+
+    # ---- the one public op ------------------------------------------
+    def record_and_list(self, namespace: str,
+                        events: list[dict]) -> list[dict]:
+        """Merge live ``events`` into the namespace's ledger; return
+        the merged history (newest first, capped). Persists at most
+        once per ``write_interval_s`` per namespace."""
+        cm, stored = self._load(namespace)
+        merged = {_key(e): e for e in stored}
+        fresh = 0
+        for ev in events:
+            entry = _entry(ev)
+            key = _key(entry)
+            if (key not in merged
+                    or merged[key].get("count", 1) != entry["count"]):
+                fresh += 1
+            merged[key] = entry
+        out = sorted(
+            merged.values(), key=lambda e: e.get("time") or "",
+            reverse=True,
+        )[: self.limit]
+        if fresh:
+            with self._lock:
+                now = self._clock()
+                due = (
+                    now - self._last_write.get(namespace, -1e9)
+                    >= self.write_interval_s
+                )
+                if due:
+                    self._last_write[namespace] = now
+            if due:
+                self._store(namespace, cm, out)
+        return out

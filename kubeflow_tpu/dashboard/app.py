@@ -288,27 +288,25 @@ def create_app(
             ]
         }
 
+    from kubeflow_tpu.dashboard.activity import ActivityLedger
+
+    ledger = ActivityLedger(api)
+
     @app.route("/api/activities/<namespace>")
     def activities(request, namespace):
-        """Recent events, newest first (reference api.ts events path)."""
+        """Recent activity, newest first. The reference (api.ts) reads
+        live Events only, so its feed forgets everything past the
+        apiserver's --event-ttl (1 h default); here the events merge
+        into a per-namespace ledger ConfigMap so history survives
+        event GC up to the ledger cap."""
         ensure_member(request.user, namespace)
         events = api.list("v1", "Event", namespace=namespace)
-        events.sort(
-            key=lambda e: e.get("lastTimestamp")
-            or e["metadata"].get("creationTimestamp") or "",
-            reverse=True,
-        )
+        merged = ledger.record_and_list(namespace, events)
         return {
             "activities": [
-                {
-                    "type": e.get("type", "Normal"),
-                    "reason": e.get("reason", ""),
-                    "message": e.get("message", ""),
-                    "object": (e.get("involvedObject") or {}).get("name", ""),
-                    "time": e.get("lastTimestamp")
-                    or e["metadata"].get("creationTimestamp"),
-                }
-                for e in events[:50]
+                {k: e.get(k) for k in
+                 ("type", "reason", "message", "object", "time")}
+                for e in merged[:50]
             ]
         }
 

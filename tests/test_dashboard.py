@@ -460,3 +460,95 @@ class TestServing:
         assert b"get-contributors" in js
         assert b"add-contributor" in js
         assert b"remove-contributor" in js
+
+
+class TestActivityRetention:
+    """The activity ledger: history survives event GC (the reference
+    feed forgets everything past --event-ttl), writes are throttled,
+    corrupt ledgers degrade to live-events-only."""
+
+    def _event(self, i, ts, ns="alice"):
+        return {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": f"led{i}", "namespace": ns},
+            "type": "Normal", "reason": f"L{i}", "message": "m",
+            "involvedObject": {"name": "nb"},
+            "lastTimestamp": ts,
+        }
+
+    def test_history_survives_event_gc(self, api, dashboard):
+        add_profile(api, "alice", USER)
+        api.create(self._event(0, "2026-07-01T00:00:00Z"))
+        client = client_for(dashboard)
+        acts = client.get("/api/activities/alice",
+                          headers=hdr()).get_json()["activities"]
+        assert [a["reason"] for a in acts] == ["L0"]
+        # The apiserver GCs the event (TTL); the feed must still show
+        # it (from the ledger ConfigMap) alongside newer ones.
+        api.delete("v1", "Event", "led0", "alice")
+        api.create(self._event(1, "2026-07-02T00:00:00Z"))
+        acts = client.get("/api/activities/alice",
+                          headers=hdr()).get_json()["activities"]
+        assert [a["reason"] for a in acts] == ["L1", "L0"]
+        cm = api.get("v1", "ConfigMap", "dashboard-activity-ledger",
+                     "alice")
+        assert "L0" in cm["data"]["entries"]
+
+    def test_writes_throttled(self, api):
+        from kubeflow_tpu.dashboard.activity import ActivityLedger
+
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "alice"}})
+        now = [0.0]
+        ledger = ActivityLedger(api, write_interval_s=60.0,
+                                clock=lambda: now[0])
+        writes = {"n": 0}
+        orig_create, orig_update = api.create, api.update
+
+        def counting_create(obj, **kw):
+            if obj.get("kind") == "ConfigMap":
+                writes["n"] += 1
+            return orig_create(obj, **kw)
+
+        def counting_update(obj, **kw):
+            if obj.get("kind") == "ConfigMap":
+                writes["n"] += 1
+            return orig_update(obj, **kw)
+
+        api.create, api.update = counting_create, counting_update
+        try:
+            ledger.record_and_list(
+                "alice", [self._event(0, "2026-07-01T00:00:00Z")])
+            assert writes["n"] == 1
+            # New entry within the interval: merged in the RESPONSE,
+            # not yet persisted.
+            out = ledger.record_and_list(
+                "alice", [self._event(1, "2026-07-02T00:00:00Z")])
+            assert writes["n"] == 1
+            assert len(out) == 2
+            now[0] = 61.0
+            ledger.record_and_list(
+                "alice", [self._event(2, "2026-07-03T00:00:00Z")])
+            assert writes["n"] == 2
+        finally:
+            api.create, api.update = orig_create, orig_update
+
+    def test_cap_and_corrupt_ledger_tolerated(self, api):
+        from kubeflow_tpu.dashboard.activity import ActivityLedger
+
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "alice"}})
+        api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "dashboard-activity-ledger",
+                         "namespace": "alice"},
+            "data": {"entries": "{not json["},
+        })
+        ledger = ActivityLedger(api, limit=5)
+        events = [
+            self._event(i, f"2026-07-0{1 + i % 9}T00:00:0{i % 10}Z")
+            for i in range(12)
+        ]
+        out = ledger.record_and_list("alice", events)
+        assert len(out) == 5  # capped, corrupt stored blob ignored
+        assert out[0]["time"] >= out[-1]["time"]
