@@ -136,6 +136,12 @@ def create_app(
         config = config_cache.get()
         base = config.get("spawnerFormDefaults", {})
         namespace = request.args.get("ns")
+        if namespace:
+            # The overrides live in a tenant ConfigMap read with the
+            # backend's service account: gate on the USER's access to
+            # that namespace like every other namespace-scoped route.
+            ensure(app.authorizer, request.user, "get", "",
+                   "configmaps", namespace)
         overrides = _namespace_overrides(namespace)
         merged = _deep_merge(base, overrides) if overrides else base
         accelerators = ((merged.get("tpu") or {})

@@ -421,18 +421,24 @@
   });
 
   // ---- boot ----
+  var configSeq = 0;
   function loadConfig(ns) {
     // Per-namespace presets: the backend merges the namespace's
     // notebook-defaults ConfigMap over the global spawner config.
+    // Sequenced: a stale response (user switched namespace while a
+    // fetch was in flight) must not clobber the newer config.
+    var seq = ++configSeq;
     var url = 'api/config' + (ns ? '?ns=' + encodeURIComponent(ns) : '');
     KF.get(url).then(function (d) {
+      if (seq !== configSeq) return;
       state.config = d.config;
       state.presets = d.tpuPresets || [];
     }).catch(function (err) {
       KF.snack('Could not load spawner config: ' + err.message, true);
     });
   }
-  loadConfig(null);
+  // No unconditional boot-time load: the namespace callback below
+  // always fires once resolution completes and would race it.
 
   KF.namespace(
     { standaloneMount: document.getElementById('ns-mount') },

@@ -651,7 +651,31 @@
   };
 
   // ---- help popover (reference lib help-popover: a ? toggle whose
-  // bubble explains a form field; Escape or outside click closes) ----
+  // bubble explains a form field; Escape or outside click closes).
+  // Outside-click/Escape handling is DELEGATED: two document
+  // listeners installed once, closing every open popover — per-
+  // instance listeners would leak (and pin detached DOM) every time a
+  // form rebuilds. ----
+  function closePopovers(except) {
+    var open = document.querySelectorAll('.kf-popover:not([hidden])');
+    Array.prototype.forEach.call(open, function (bubble) {
+      var wrap = bubble.parentNode;
+      if (except && wrap && wrap.contains(except)) return;
+      bubble.hidden = true;
+      var btn = wrap && wrap.querySelector('.kf-popover-btn');
+      if (btn) btn.setAttribute('aria-expanded', 'false');
+    });
+  }
+
+  if (global.document) {
+    document.addEventListener('click', function (ev) {
+      closePopovers(ev.target);
+    });
+    document.addEventListener('keydown', function (ev) {
+      if (ev.key === 'Escape') closePopovers(null);
+    });
+  }
+
   KF.helpPopover = function (text) {
     var wrap = KF.el('span', { 'class': 'kf-popover-wrap' });
     var bubble = KF.el('span', {
@@ -663,19 +687,10 @@
       'aria-label': KF.t('Help'), 'aria-expanded': 'false',
       onclick: function (ev) {
         ev.stopPropagation();
+        closePopovers(wrap.firstChild);  // one open bubble at a time
         bubble.hidden = !bubble.hidden;
         btn.setAttribute('aria-expanded', String(!bubble.hidden));
       },
-    });
-    function close() {
-      bubble.hidden = true;
-      btn.setAttribute('aria-expanded', 'false');
-    }
-    document.addEventListener('click', function (ev) {
-      if (!wrap.contains(ev.target)) close();
-    });
-    document.addEventListener('keydown', function (ev) {
-      if (ev.key === 'Escape') close();
     });
     wrap.appendChild(btn);
     wrap.appendChild(bubble);

@@ -376,17 +376,22 @@ class CABundleInjector:
         self.period_s = period_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._last_bundle: bytes | None = None
 
     def inject_once(self) -> bool:
         """One level-based pass; returns True if the config was
-        patched. Safe to call directly (tests, pre-serve sync)."""
+        patched. Safe to call directly (tests, pre-serve sync).
+
+        Truly level-based: the LIVE config is read every tick and
+        repaired whenever any entry's caBundle differs from the
+        mounted CA — so external drift (a manifest re-apply restoring
+        a stale constant, a recreated configuration) heals within one
+        period, not only on the next CA rotation."""
         try:
             with open(self.ca_file, "rb") as fh:
                 ca = fh.read()
         except OSError:
             return False  # not mounted (yet): keep previous state
-        if not ca or ca == self._last_bundle:
+        if not ca:
             return False
         bundle = base64.b64encode(ca).decode()
         try:
@@ -402,8 +407,6 @@ class CABundleInjector:
                     changed = True
             if changed:
                 self.api.update(cfg)
-            self._last_bundle = ca
-            if changed:
                 log.info(
                     "caBundle injected into %s (%d webhooks)",
                     self.config_name, len(cfg.get("webhooks", [])),

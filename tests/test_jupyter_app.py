@@ -557,3 +557,23 @@ class TestNamespacedSpawnerConfig:
                          headers=USER_HEADERS).get_json()
         assert bad["namespaced"] is False
         assert bad["config"] == ok["config"]
+
+    def test_scoped_config_requires_namespace_access(self):
+        """The overrides live in a tenant ConfigMap read with the
+        backend's service account — the USER's access to the namespace
+        gates the read (cross-namespace disclosure otherwise)."""
+        from kubeflow_tpu.crud_backend.authz import DenyAll
+
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "notebook-defaults",
+                         "namespace": "team-b"},
+            "data": {"spawnerFormDefaults": "image:\n  value: secret\n"},
+        })
+        client = client_for(api, authorizer=DenyAll())
+        resp = client.get("/api/config?ns=team-b", headers=USER_HEADERS)
+        assert resp.status_code == 403
+        # The UNSCOPED config stays readable (global, non-tenant data).
+        assert client.get("/api/config",
+                          headers=USER_HEADERS).status_code == 200

@@ -1,0 +1,36 @@
+"""Smoke tier for the hour-scale soak driver (testing/soak.py).
+
+Two full cycles through the REAL stack — dev apiserver over the wire,
+two controller processes with leader election + culling, live kernel
+fixture, gang restart, a leader SIGKILL — so the long-running soak's
+logic cannot rot between the out-of-band hour runs whose logs live
+under testing/. (The hour run itself: `python -m testing.soak`.)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from testing.soak import Soak  # noqa: E402
+
+
+def test_soak_smoke(tmp_path):
+    log = tmp_path / "soak.log"
+    soak = Soak(str(log))
+    try:
+        # duration 0 + min_cycles: exactly two cycles — cycle 1 takes
+        # the gang-restart branch, so spawn/cull/restart, gang recycle,
+        # and the RSS/event accounting all execute.
+        summary = soak.run(0, min_cycles=2)
+    finally:
+        soak.close()
+    assert summary["cycles"] == 2
+    assert summary["failed_cycles"] == 0, summary
+    lines = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [rec.get("cycle") for rec in lines[:2]] == [0, 1]
+    assert lines[1].get("gang") is True
+    assert all(rec["ok"] for rec in lines[:2])
+    assert "summary" in lines[-1]

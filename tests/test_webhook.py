@@ -567,3 +567,32 @@ class TestCABundleInjector:
                 raise AssertionError("rotation never propagated")
         finally:
             injector.stop()
+
+    def test_external_drift_repaired_without_rotation(self, tmp_path):
+        """Level-based means the LIVE config is the source of truth
+        each tick: a manifest re-apply restoring a stale caBundle (no
+        CA change at all) must heal on the next pass."""
+        import base64
+
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu.webhook.server import CABundleInjector
+
+        api = FakeApiServer()
+        api.create(self._config())
+        ca = tmp_path / "ca.crt"
+        ca.write_bytes(b"CA-STABLE")
+        injector = CABundleInjector(api, str(ca))
+        assert injector.inject_once() is True
+        # CI/CD re-applies the manifest: caBundle reverts to a stale
+        # constant while the CA file is UNCHANGED.
+        cfg = api.get("admissionregistration.k8s.io/v1",
+                      "MutatingWebhookConfiguration", "admission-webhook")
+        for hook in cfg["webhooks"]:
+            hook["clientConfig"]["caBundle"] = "c3RhbGU="
+        api.update(cfg)
+        assert injector.inject_once() is True  # drift repaired
+        cfg = api.get("admissionregistration.k8s.io/v1",
+                      "MutatingWebhookConfiguration", "admission-webhook")
+        want = base64.b64encode(b"CA-STABLE").decode()
+        assert all(h["clientConfig"]["caBundle"] == want
+                   for h in cfg["webhooks"])
