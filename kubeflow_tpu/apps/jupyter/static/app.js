@@ -120,10 +120,8 @@
          : 'none'],
        ['Created', d.processed.age || '—'],
        ['Message', d.processed.status.message || '—']]);
-    var pre = KF.el('pre', { 'class': 'kf-yaml' });
-    pre.textContent = JSON.stringify(d.notebook, null, 2);
     pane.appendChild(KF.el('h3', { text: KF.t('Raw resource') }));
-    pane.appendChild(pre);
+    pane.appendChild(KF.yamlPane(d.notebook));
   }
 
   function renderConditions(pane, d) {

@@ -697,6 +697,60 @@
     return wrap;
   };
 
+  // ---- YAML view (reference lib editor component renders resources
+  // as YAML; this is the read-only half: a serialiser for the JSON
+  // subset k8s objects live in, no parsing) ----
+  KF.toYaml = function (value, indent) {
+    indent = indent || '';
+    if (value === null || value === undefined) return 'null';
+    if (typeof value === 'string') {
+      if (value === '' || /[:#\-?{}\[\]&*!|>'"%@`\n]|^\s|\s$/.test(value)
+          || /^(true|false|null|~|yes|no|on|off)$/i.test(value)
+          || /^[\d.+-]/.test(value)) {
+        return JSON.stringify(value);
+      }
+      return value;
+    }
+    if (typeof value !== 'object') return String(value);
+    var next = indent + '  ';
+    if (Array.isArray(value)) {
+      if (!value.length) return '[]';
+      return value.map(function (item) {
+        var body = KF.toYaml(item, next);
+        if (typeof item === 'object' && item !== null
+            && Object.keys(item).length) {
+          // Block item: first line rides the dash.
+          return indent + '- ' + body.replace(/^\s+/, '');
+        }
+        return indent + '- ' + body;
+      }).join('\n');
+    }
+    var keys = Object.keys(value);
+    if (!keys.length) return '{}';
+    return keys.map(function (key) {
+      var item = value[key];
+      var keyText = /^[A-Za-z0-9_.\/-]+$/.test(key)
+        ? key : JSON.stringify(key);
+      if (item !== null && typeof item === 'object'
+          && (Array.isArray(item) ? item.length
+                                  : Object.keys(item).length)) {
+        return indent + keyText + ':\n' + KF.toYaml(item, next)
+          .split('\n').map(function (line) {
+            return line.indexOf(next) === 0 || line.trim() === ''
+              ? line : next + line;
+          }).join('\n');
+      }
+      return indent + keyText + ': ' + KF.toYaml(item, next);
+    }).join('\n');
+  };
+
+  // Read-only YAML pane for details pages (raw-resource view).
+  KF.yamlPane = function (obj) {
+    var pre = KF.el('pre', { 'class': 'kf-yaml' });
+    pre.textContent = KF.toYaml(obj, '');
+    return pre;
+  };
+
   KF.shortImage = function (image) {
     // Strip the tag from the LAST path segment only — 'registry:5000/x'
     // must not collapse to the registry host.

@@ -206,3 +206,19 @@ def test_events_pane_shows_spinner_first(page, seeded_jwa):
     pane.locator(".kf-spinner").wait_for(state="visible")
     pane.locator("table").wait_for()
     assert pane.locator(".kf-spinner").count() == 0
+
+
+def test_details_raw_resource_renders_yaml(page, seeded_jwa):
+    """The raw-resource pane renders YAML (reference editor component's
+    read-only role), not a JSON dump."""
+    url, _ = seeded_jwa
+    page.goto(url)
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    pre = page.locator(".kf-yaml")
+    pre.wait_for()
+    text = pre.inner_text()
+    assert "kind: Notebook" in text
+    assert "name: demo-nb" in text
+    assert "accelerator: v5e" in text
+    assert '"2x4"' in text          # leading digit -> quoted scalar
+    assert '{' not in text.split("\n")[0]  # not JSON

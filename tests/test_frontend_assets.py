@@ -377,3 +377,106 @@ class TestI18n:
                 if joined and joined not in keys:
                     missing.append((os.path.basename(path), joined[:50]))
         assert not missing, f"helpPopover texts missing from fr: {missing}"
+
+
+class TestYamlSerializer:
+    """KF.toYaml (the read-only half of the reference kit's editor):
+    no JS runtime ships in this image, so the ALGORITHM is pinned by a
+    line-for-line Python transliteration validated against PyYAML
+    round-trips (the browser tier exercises the JS itself in CI). Any
+    change to common.js toYaml must be mirrored here."""
+
+    @staticmethod
+    def to_yaml(value, indent=""):
+        import json as _json
+        import re as _re
+
+        if value is None:
+            return "null"
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        if isinstance(value, str):
+            if (value == ""
+                    or _re.search(r"[:#\-?{}\[\]&*!|>'\"%@`\n]|^\s|\s$",
+                                  value)
+                    or _re.match(r"^(true|false|null|~|yes|no|on|off)$",
+                                 value, _re.I)
+                    or _re.match(r"^[\d.+-]", value)):
+                return _json.dumps(value)
+            return value
+        if not isinstance(value, (dict, list)):
+            return str(value)
+        next_i = indent + "  "
+        if isinstance(value, list):
+            if not value:
+                return "[]"
+            out = []
+            for item in value:
+                body = self_to_yaml(item, next_i)
+                if isinstance(item, (dict, list)) and item:
+                    out.append(indent + "- " + body.lstrip())
+                else:
+                    out.append(indent + "- " + body)
+            return "\n".join(out)
+        if not value:
+            return "{}"
+        out = []
+        for key, item in value.items():
+            key_text = (key if _re.match(r"^[A-Za-z0-9_./-]+$", key)
+                        else _json.dumps(key))
+            if isinstance(item, (dict, list)) and item:
+                out.append(indent + key_text + ":\n"
+                           + self_to_yaml(item, next_i))
+            else:
+                out.append(indent + key_text + ": "
+                           + self_to_yaml(item, next_i))
+        return "\n".join(out)
+
+    def test_roundtrips_k8s_shaped_objects(self):
+        import yaml as pyyaml
+
+        global self_to_yaml
+        self_to_yaml = TestYamlSerializer.to_yaml
+        cases = [
+            {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+             "metadata": {
+                 "name": "demo-nb", "namespace": "alice",
+                 "annotations": {"kubeflow-resource-stopped":
+                                 "2026-07-30T00:00:00Z"},
+                 "labels": {}},
+             "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4",
+                              "replicas": 2},
+                      "template": {"spec": {"containers": [
+                          {"name": "nb", "image": "ghcr.io/x/y:latest",
+                           "resources": {"requests": {"cpu": "2",
+                                                      "memory": "4Gi"}},
+                           "env": [{"name": "A", "value": "on"},
+                                   {"name": "B", "value": "-1"}],
+                           "ports": [], "args": None}]}}},
+             "status": {"readyReplicas": 2, "conditions": [
+                 {"type": "Ready", "status": "True",
+                  "message": "all replicas ready: yes"}]}},
+            {"weird keys": {"a:b": 1, "": "empty", "#c": [True, False,
+                                                          None, 0.5]},
+             "multiline": "line1\nline2", "trail ": " lead"},
+            {"nested": [[1, 2], [{"deep": {"deeper": []}}], []]},
+        ]
+        for i, obj in enumerate(cases):
+            text = self_to_yaml(obj, "")
+            parsed = pyyaml.safe_load(text)
+            assert parsed == obj, f"case {i}:\n{text}"
+
+    def test_js_and_python_mirrors_agree_structurally(self):
+        """Guard that the JS implementation still contains the mirrored
+        decision points (regexes + branch markers) — a drift canary,
+        not an execution test."""
+        src = open(os.path.join(PKG, "frontend_lib", "common.js")).read()
+        for needle in [
+            "KF.toYaml = function",
+            "(true|false|null|~|yes|no|on|off)",
+            "^[A-Za-z0-9_.\\/-]+$",
+            "'- '",
+            "return '[]'",
+            "return '{}'",
+        ]:
+            assert needle in src, f"toYaml drift: missing {needle!r}"
