@@ -1,0 +1,116 @@
+/* Spanish catalog — the second locale, proving the i18n machinery is
+ * not shaped around one language (reference ships full per-app
+ * catalogs; same model here: English source strings are the keys,
+ * missing keys fall through to English). Coverage is enforced by the
+ * same guards as fr (tests/test_frontend_assets.py parameterises over
+ * every shipped catalog). */
+(function () {
+  'use strict';
+  window.KF.i18n.register('es', {
+    // ---- lib chrome (frontend_lib/common.js) ----
+    'Filter': 'Filtrar',
+    'Refresh': 'Actualizar',
+    'Download': 'Descargar',
+    'Follow': 'Seguir',
+    'Nothing here yet.': 'Todavía no hay nada aquí.',
+    'No rows match the filter.': 'Ninguna fila coincide con el filtro.',
+    '(no log output yet)': '(todavía sin registros)',
+    'No conditions reported.': 'No se han registrado condiciones.',
+    'No events for this resource.': 'No hay eventos para este recurso.',
+    // ---- shared table / details columns ----
+    'Name': 'Nombre',
+    'Status': 'Estado',
+    'Type': 'Tipo',
+    'Reason': 'Motivo',
+    'Message': 'Mensaje',
+    'Last transition': 'Última transición',
+    'Object': 'Objeto',
+    'Count': 'Recuento',
+    'Last seen': 'Visto por última vez',
+    'Age': 'Antigüedad',
+    'Image': 'Imagen',
+    'CPU': 'CPU',
+    'Memory': 'Memoria',
+    'TPU': 'TPU',
+    'TPU slice': 'Segmento TPU',
+    'Overview': 'Resumen',
+    'Conditions': 'Condiciones',
+    'Events': 'Eventos',
+    'Logs': 'Registros',
+    'Logs path': 'Ruta de registros',
+    'Size': 'Tamaño',
+    'Mode': 'Modo',
+    'Class': 'Clase',
+    'Used by': 'Usado por',
+    // ---- app chrome ----
+    'Notebooks': 'Notebooks',
+    'Volumes': 'Volúmenes',
+    'TensorBoards': 'TensorBoards',
+    '+ New Notebook': '+ Nuevo notebook',
+    '+ New Volume': '+ Nuevo volumen',
+    '+ New TensorBoard': '+ Nuevo TensorBoard',
+    'Connect': 'Conectar',
+    'Start': 'Iniciar',
+    'Stop': 'Detener',
+    'Delete': 'Eliminar',
+    'Create': 'Crear',
+    'Cancel': 'Cancelar',
+    'New Notebook': 'Nuevo notebook',
+    '← Back': '← Volver',
+    'Raw resource': 'Recurso sin procesar',
+    'Pod': 'Pod',
+    'Configurations': 'Configuraciones',
+    'None (CPU only)': 'Ninguno (solo CPU)',
+    'None': 'Ninguno',
+    'Custom image': 'Imagen personalizada',
+    'Create workspace volume': 'Crear volumen de trabajo',
+    'Shared memory (/dev/shm)': 'Memoria compartida (/dev/shm)',
+    'Namespace': 'Espacio de nombres',
+    'Created': 'Creado',
+    'Ready': 'Listo',
+    'Access mode': 'Modo de acceso',
+    'Storage class': 'Clase de almacenamiento',
+    'Viewer': 'Visor',
+    'Affinity': 'Afinidad',
+    'Tolerations': 'Tolerancias',
+    'No notebooks in this namespace. Create one to get started.':
+      'No hay notebooks en este espacio de nombres. Cree uno para empezar.',
+    'No volumes in this namespace.':
+      'No hay volúmenes en este espacio de nombres.',
+    'No TensorBoards in this namespace.':
+      'No hay TensorBoards en este espacio de nombres.',
+    'Delete notebook "{name}"? Attached PVCs are kept.':
+      '¿Eliminar el notebook «{name}»? Los PVC adjuntos se conservan.',
+    'Delete TensorBoard "{name}"?':
+      '¿Eliminar el TensorBoard «{name}»?',
+    'Delete volume "{name}" and its data?':
+      '¿Eliminar el volumen «{name}» y sus datos?',
+    'No PodDefaults in this namespace.':
+      'No hay PodDefaults en este espacio de nombres.',
+    'No pods yet — the StatefulSet has not started any.':
+      'Todavía no hay pods: el StatefulSet no ha iniciado ninguno.',
+    // ---- date-time humanization fallback (no-Intl browsers) ----
+    '{age} ago': 'hace {age}',
+    // ---- dashboard shell (centraldashboard static chrome) ----
+    'TPU Notebooks': 'Notebooks TPU',
+    'Home': 'Inicio',
+    'TPU fleet': 'Flota TPU',
+    'Quick links': 'Enlaces rápidos',
+    'Recent activity': 'Actividad reciente',
+    'Contributors': 'Colaboradores',
+    'People who can use the selected namespace (reference manage-users view).':
+      'Personas que pueden usar el espacio de nombres seleccionado (vista manage-users de referencia).',
+    'Add contributor': 'Añadir colaborador',
+    'Welcome': 'Bienvenido',
+    'You don\'t have a namespace yet. Create one to start spawning TPU notebooks.':
+      'Todavía no tiene un espacio de nombres. Cree uno para empezar a lanzar notebooks TPU.',
+    'Create namespace': 'Crear espacio de nombres',
+    // ---- widgets (spinner + help popover) ----
+    'Loading…': 'Cargando…',
+    'Help': 'Ayuda',
+    'Accelerator and topology for the notebook. Multi-host slices spawn one pod per host with gang semantics: if any rank crashes, the whole slice restarts together.':
+      'Acelerador y topología del notebook. Los segmentos multi-host lanzan un pod por host con semántica de pandilla: si un rango falla, todo el segmento se reinicia junto.',
+    'PodDefaults applied by the admission webhook at pod creation (environment, volumes, tolerations).':
+      'PodDefaults aplicados por el webhook de admisión al crear el pod (entorno, volúmenes, tolerancias).',
+  });
+})();

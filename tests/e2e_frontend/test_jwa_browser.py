@@ -222,3 +222,15 @@ def test_details_raw_resource_renders_yaml(page, seeded_jwa):
     assert "accelerator: v5e" in text
     assert '"2x4"' in text          # leading digit -> quoted scalar
     assert '{' not in text.split("\n")[0]  # not JSON
+
+
+def test_locale_switch_renders_spanish(page, seeded_jwa):
+    """Second locale: the same machinery renders es — proof the i18n
+    layer is not shaped around one catalog."""
+    url, _ = seeded_jwa
+    page.goto(url + "?lang=es")
+    page.locator("#nb-table tbody tr").wait_for(timeout=10_000)
+    assert "+ Nuevo notebook" in page.locator("#new-btn").inner_text()
+    headers = page.locator("#nb-table th").all_inner_texts()
+    assert any("Nombre" in h for h in headers)
+    assert page.locator("#locale-mount select").input_value() == "es"

@@ -211,11 +211,40 @@ class TestI18n:
     back to English, which only a human would notice."""
 
     def catalog_keys(self) -> set:
-        src = open(os.path.join(PKG, "frontend_lib", "i18n", "fr.js")).read()
-        return set(
-            k.replace("\\'", "'")
-            for k in re.findall(r"^\s*'((?:[^'\\]|\\.)*)':", src, re.M)
-        )
+        """Keys present in EVERY shipped catalog (i18n/*.js): coverage
+        guards assert against the intersection, so adding a locale
+        without full coverage fails the same tests that guard fr."""
+        keys = None
+        for path in sorted(glob.glob(
+            os.path.join(PKG, "frontend_lib", "i18n", "*.js")
+        )):
+            src = open(path).read()
+            found = set(
+                k.replace("\\'", "'")
+                for k in re.findall(r"^\s*'((?:[^'\\]|\\.)*)':", src, re.M)
+            )
+            keys = found if keys is None else keys & found
+        return keys or set()
+
+    def test_all_catalogs_share_the_full_key_set(self):
+        """No locale may lag: every shipped catalog carries the union
+        of keys (a key translated in one language but not another
+        silently falls back to English only there)."""
+        per_locale = {}
+        for path in sorted(glob.glob(
+            os.path.join(PKG, "frontend_lib", "i18n", "*.js")
+        )):
+            src = open(path).read()
+            per_locale[os.path.basename(path)] = set(
+                k.replace("\\'", "'")
+                for k in re.findall(r"^\s*'((?:[^'\\]|\\.)*)':", src, re.M)
+            )
+        assert len(per_locale) >= 2  # fr + es shipped
+        union = set().union(*per_locale.values())
+        for name, keys in per_locale.items():
+            assert keys == union, (
+                f"{name} missing: {sorted(union - keys)[:5]}"
+            )
 
     def test_catalog_parses_and_is_nonempty(self):
         keys = self.catalog_keys()
