@@ -13,10 +13,12 @@ prefix). TPU-native decode structure:
   (``lax.scan`` over the decode loop).
 - Decode attention defaults to ONE dense masked read of the cache —
   measured fastest on v5e at every cache size to 32k (decode there is
-  fixed-overhead-bound; see ``_decode_attention``). Two blockwise
-  alternatives ship for longer caches/other hardware: the Pallas
-  flash-decode kernel (``KFT_DECODE_IMPL=kernel``,
-  ops/decode_attention.py) and an XLA ``fori_loop`` reference.
+  fixed-overhead-bound; see ``_decode_attention``). The blockwise
+  Pallas flash-decode kernel ships for longer caches/other hardware
+  (``KFT_DECODE_IMPL=kernel``, ops/decode_attention.py). An XLA
+  ``fori_loop`` variant was measured and rejected (~15 µs/iter of
+  unpipelined ``while`` overhead, slower than the dense read at every
+  tested size).
 - Prefill from an empty cache runs the training flash kernel over the
   chunk itself (causal block-skip on the MXU) instead of a dense
   masked read of the whole buffer — measured +29% prefill at b8 and
@@ -201,68 +203,6 @@ def _decode_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
             q, ck, cv, pos, window=cfg.attn_window, block=DECODE_BLOCK,
         )
     return _cached_attention(cfg, q, ck, cv, pos, 1, ks, vs)
-
-
-def _flash_decode_xla(cfg, q, ck, cv, pos):
-    """Blockwise decode attention in pure XLA: sweep only the cache
-    blocks intersecting [window_start, pos] with a data-dependent
-    ``fori_loop`` trip count, folding each block into online-softmax
-    statistics. KEPT AS A REFERENCE ONLY (not reachable from
-    forward_with_cache): TPU ``while`` iterations don't pipeline, and
-    the measured per-iteration overhead (~15 µs x layers x blocks,
-    v5e) makes this SLOWER than the dense read at every tested cache
-    size; the Pallas kernel (ops/decode_attention.py) is the blockwise
-    variant that ships.
-    q: (B, H, 1, hd); ck/cv: (B, Hkv, capacity, hd) with capacity a
-    multiple of the block (KVCache.init guarantees it)."""
-    b, h, t, hd = q.shape
-    hkv, capacity = ck.shape[1], ck.shape[2]
-    group = h // hkv
-    block = min(DECODE_BLOCK, capacity)
-    qg = q.reshape(b, hkv, group * t, hd)
-    scale = hd ** -0.5
-
-    start = jnp.zeros((), jnp.int32)
-    if cfg.attn_window is not None:
-        start = jnp.maximum(pos - cfg.attn_window + 1, 0) // block
-    stop = pos // block + 1
-
-    def body(j, carry):
-        acc, m, l = carry
-        kb = jax.lax.dynamic_slice(
-            ck, (0, 0, j * block, 0), (b, hkv, block, hd)
-        )
-        vb = jax.lax.dynamic_slice(
-            cv, (0, 0, j * block, 0), (b, hkv, block, hd)
-        )
-        s = jnp.einsum(
-            "bkgd,bkld->bkgl", qg, kb,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
-        keep = cols <= pos
-        if cfg.attn_window is not None:
-            keep = jnp.logical_and(keep, cols > pos - cfg.attn_window)
-        s = jnp.where(keep, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum(
-            "bkgl,bkld->bkgd", p.astype(vb.dtype), vb,
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
-
-    acc, m, l = jax.lax.fori_loop(
-        start, stop, body,
-        (
-            jnp.zeros((b, hkv, group * t, hd), jnp.float32),
-            jnp.full((b, hkv, group * t, 1), NEG_INF, jnp.float32),
-            jnp.zeros((b, hkv, group * t, 1), jnp.float32),
-        ),
-    )
-    return (acc / l).reshape(b, h, t, hd).astype(q.dtype)
 
 
 def _rolling_attention(cfg, q, ck, cv, pos, ks=None, vs=None):

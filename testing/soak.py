@@ -1,0 +1,382 @@
+"""Hour-scale soak over LIVE components (SURVEY §5 failure recovery).
+
+The chaos tier (tests/test_chaos.py) proves each failure mode once;
+this driver proves the system holds up under SUSTAINED load: a dev
+apiserver over the real wire protocol, two notebook-controller OS
+processes with leader election and culling enabled, and a live kernel
+fixture, driven through continuous spawn → cull → restart →
+gang-restart cycles with periodic leader kills (SIGKILL + respawn) and
+lease deletions for the configured duration (default 1 hour).
+
+What it asserts at the end (and per cycle in the JSONL log):
+
+- convergence: every cycle's spawn/cull/restart/gang sequence completes
+  within its timeout, across every induced failure;
+- bounded memory: controller RSS in the final cycles must not exceed
+  1.5x the post-warmup level (leak detection over wall-clock, which the
+  reference inherits from controller-runtime maturity and this runtime
+  must demonstrate);
+- bounded events: deterministic-name event recording must AGGREGATE
+  (bump counts on stable names), so the apiserver's event count stays
+  bounded while cycles repeat over the same object names.
+
+Usage:
+    python -m testing.soak --duration 3600 --log testing/soak_r04.log
+
+The pytest suite smoke-runs 2 cycles of this exact driver
+(tests/test_soak.py) so the soak logic itself cannot rot; the hour run
+is launched out-of-band and its log committed under testing/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from kubeflow_tpu.k8s.core import NotFound  # noqa: E402
+from kubeflow_tpu.k8s.httpd import FakeApiHttpServer  # noqa: E402
+from tests.test_chaos import _KernelServer  # noqa: E402
+from tests.test_entrypoints import (  # noqa: E402
+    free_port,
+    spawn,
+    terminate,
+    wait_http,
+)
+
+NB_API = "kubeflow.org/v1beta1"
+
+
+def rss_kb(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+def _wait(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got is not None:
+            return got
+        time.sleep(0.2)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+class Soak:
+    """One live stack: apiserver + kernel fixture + 2 controllers."""
+
+    IDENTITIES = ("soak-a", "soak-b")
+
+    def __init__(self, log_path: str):
+        self.server = FakeApiHttpServer().start()
+        self.fake = self.server.fake
+        self.kernels = _KernelServer()
+        self._kernels_idle()
+        self.log = open(log_path, "a", buffering=1)
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.ports: dict[str, int] = {}
+        for name in self.IDENTITIES:
+            self._spawn_controller(name)
+        for port in self.ports.values():
+            wait_http(f"http://127.0.0.1:{port}/healthz")
+        self.failed_cycles = 0
+        self.rss_history: list[tuple[int, int]] = []
+
+    def _spawn_controller(self, name: str):
+        self.ports.setdefault(name, free_port())
+        self.procs[name] = spawn("notebook-controller", self.server.url, {
+            "METRICS_PORT": str(self.ports[name]),
+            "LEADER_ELECT": "1",
+            "POD_NAME": name,
+            "ENABLE_CULLING": "1",
+            "CULL_IDLE_TIME": "60",
+            "IDLENESS_CHECK_PERIOD": "1",
+            "KFT_KERNEL_PROBE_URL":
+                f"http://127.0.0.1:{self.kernels.port}/"
+                "notebook/{namespace}/{name}/api/kernels",
+        })
+
+    def _kernels_idle(self):
+        """Probe fixture reports long-idle kernels: eligible to cull."""
+        self.kernels.kernels = [{
+            "execution_state": "idle",
+            "last_activity": "2026-07-28T00:00:00Z",
+        }]
+
+    def _kernels_busy(self):
+        """Probe fixture reports active kernels — notebooks stay up
+        (the culler would otherwise re-cull the restarted notebook and
+        cull the gang-restart slice mid-baseline)."""
+        import datetime
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self.kernels.kernels = [{
+            "execution_state": "busy",
+            "last_activity": now.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        }]
+
+    # ----------------------------------------------------------- cycle
+    def _nb(self, name: str) -> dict:
+        return {
+            "apiVersion": NB_API, "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "alice"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": name, "image": "jupyter-jax-tpu:latest"}
+            ]}}},
+        }
+
+    def _pod(self, nb_name: str, ordinal: int = 0, extra_status=None):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": f"{nb_name}-{ordinal}", "namespace": "alice",
+                "labels": {"notebook-name": nb_name},
+            },
+            "status": {"phase": "Running",
+                       **(extra_status or {})},
+        }
+
+    def _cleanup(self, name: str):
+        for kind, api in (("Notebook", NB_API), ("StatefulSet", "apps/v1"),
+                          ("Service", "v1")):
+            try:
+                self.fake.delete(api, kind, name, "alice")
+            except NotFound:
+                pass
+        for pod in self.fake.list("v1", "Pod", namespace="alice"):
+            if pod["metadata"].get("labels", {}) \
+                    .get("notebook-name") == name:
+                try:
+                    self.fake.delete("v1", "Pod",
+                                     pod["metadata"]["name"], "alice")
+                except NotFound:
+                    pass
+
+    def spawn_cull_restart(self, name: str):
+        """Create → reconcile → cull (live kernel probe) → restart."""
+        self._kernels_idle()
+        self.fake.create(self._pod(name))
+        self.fake.create(self._nb(name))
+        _wait(lambda: self._get_or_none("apps/v1", "StatefulSet", name),
+              30, f"{name} StatefulSet")
+
+        def stopped():
+            obj = self.fake.get(NB_API, "Notebook", name, "alice")
+            anns = obj["metadata"].get("annotations") or {}
+            return True if "kubeflow-resource-stopped" in anns else None
+
+        _wait(stopped, 90, f"{name} culled")
+        _wait(lambda: (
+            self.fake.get("apps/v1", "StatefulSet", name, "alice")
+            ["spec"].get("replicas") == 0 or None
+        ), 30, f"{name} scaled to zero")
+        # Restart: drop the stop annotation; the reconciler must scale
+        # the STS back up. Kernels go busy first or the culler would
+        # immediately re-cull the restarted notebook.
+        self._kernels_busy()
+        self.fake.patch_merge(
+            NB_API, "Notebook", name,
+            {"metadata": {"annotations":
+                          {"kubeflow-resource-stopped": None}}},
+            "alice",
+        )
+        _wait(lambda: (
+            self.fake.get("apps/v1", "StatefulSet", name, "alice")
+            ["spec"].get("replicas") == 1 or None
+        ), 30, f"{name} restarted")
+
+    def gang_restart(self, name: str):
+        """Multi-host slice; rank 1 crashes; ALL pods must recycle."""
+        hosts = 4  # v5e 4x4 = 16 chips = 4 hosts (smallest multi-host)
+        self.fake.create({
+            "apiVersion": NB_API, "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "alice"},
+            "spec": {
+                "tpu": {"accelerator": "v5e", "topology": "4x4",
+                        "replicas": hosts},
+                "template": {"spec": {"containers": [
+                    {"name": name, "image": "img"}]}},
+            },
+        })
+        _wait(lambda: self._get_or_none("apps/v1", "StatefulSet", name),
+              30, f"{name} slice STS")
+        for i in range(hosts):
+            self.fake.create(self._pod(name, i, {
+                "containerStatuses": [{"restartCount": 0}]}))
+        want = {f"{name}-{i}": 0 for i in range(hosts)}
+
+        def baselined():
+            obj = self.fake.get(NB_API, "Notebook", name, "alice")
+            ann = obj["metadata"].get("annotations") or {}
+            observed = ann.get(
+                "notebooks.kubeflow-tpu.org/observed-restarts")
+            return True if (observed
+                            and json.loads(observed) == want) else None
+
+        _wait(baselined, 30, f"{name} restart baseline")
+        self.fake.patch_merge(
+            "v1", "Pod", f"{name}-1",
+            {"status": {"containerStatuses": [{"restartCount": 1}]}},
+            "alice",
+        )
+
+        def recycled():
+            pods = [p for p in self.fake.list("v1", "Pod",
+                                              namespace="alice")
+                    if p["metadata"].get("labels", {})
+                    .get("notebook-name") == name]
+            return True if not pods else None
+
+        _wait(recycled, 30, f"{name} gang recycle")
+
+    def kill_leader(self):
+        """SIGKILL whichever replica holds the lease, then respawn it
+        under the same identity; the survivor must take over."""
+        try:
+            lease = self.fake.get("coordination.k8s.io/v1", "Lease",
+                                  "notebook-controller", "kubeflow")
+            holder = lease["spec"].get("holderIdentity")
+        except NotFound:
+            holder = None
+        victim = holder if holder in self.procs else self.IDENTITIES[0]
+        proc = self.procs[victim]
+        proc.kill()
+        proc.wait()
+        self._spawn_controller(victim)
+        wait_http(
+            f"http://127.0.0.1:{self.ports[victim]}/healthz"
+        )
+
+    def flap_lease(self):
+        try:
+            self.fake.delete("coordination.k8s.io/v1", "Lease",
+                             "notebook-controller", "kubeflow")
+        except NotFound:
+            pass
+
+    def _get_or_none(self, api, kind, name):
+        try:
+            return self.fake.get(api, kind, name, "alice")
+        except NotFound:
+            return None
+
+    def cycle(self, i: int):
+        t0 = time.monotonic()
+        # Names reuse a small pool so event aggregation (deterministic
+        # names) is what bounds the event count, not object turnover.
+        name = f"soak-nb-{i % 10}"
+        record = {"cycle": i, "name": name, "ok": True}
+        try:
+            if i % 7 == 3:
+                self.flap_lease()
+                record["lease_flap"] = True
+            self.spawn_cull_restart(name)
+            if i % 3 == 1:
+                gname = f"soak-slice-{i % 10}"
+                self.gang_restart(gname)
+                self._cleanup(gname)
+                record["gang"] = True
+            if i % 5 == 2:
+                self.kill_leader()
+                record["leader_kill"] = True
+        except Exception as exc:  # log + count, keep soaking
+            self.failed_cycles += 1
+            record["ok"] = False
+            record["error"] = f"{type(exc).__name__}: {exc}"
+        finally:
+            self._cleanup(name)
+        record["dt_s"] = round(time.monotonic() - t0, 2)
+        record["rss_kb"] = {
+            name: rss_kb(proc.pid)
+            for name, proc in self.procs.items()
+        }
+        record["events"] = len(self.fake.list("v1", "Event",
+                                              namespace="alice"))
+        record["objects"] = sum(
+            len(self.fake.list(api, kind, namespace="alice"))
+            for api, kind in (("v1", "Pod"), (NB_API, "Notebook"),
+                              ("apps/v1", "StatefulSet"))
+        )
+        self.rss_history.append(
+            (i, max(record["rss_kb"].values() or [0]))
+        )
+        self.log.write(json.dumps(record) + "\n")
+        return record
+
+    def run(self, duration_s: float, min_cycles: int = 2) -> dict:
+        start = time.monotonic()
+        i = 0
+        last_events = 0
+        while (time.monotonic() - start < duration_s
+               or i < min_cycles):
+            rec = self.cycle(i)
+            last_events = rec["events"]
+            i += 1
+        warm = [r for c, r in self.rss_history if 2 <= c < 7]
+        tail = [r for c, r in self.rss_history[-5:]]
+        summary = {
+            "cycles": i,
+            "failed_cycles": self.failed_cycles,
+            "duration_s": round(time.monotonic() - start, 1),
+            "rss_warmup_kb": max(warm) if warm else None,
+            "rss_tail_kb": max(tail) if tail else None,
+            "events_final": last_events,
+        }
+        self.log.write(json.dumps({"summary": summary}) + "\n")
+        return summary
+
+    def close(self):
+        for proc in self.procs.values():
+            try:
+                terminate(proc)
+            except AssertionError:
+                pass
+        self.kernels.close()
+        self.server.close()
+        self.log.close()
+
+    @staticmethod
+    def check(summary: dict):
+        """The soak's pass/fail contract."""
+        assert summary["failed_cycles"] == 0, summary
+        if summary["rss_warmup_kb"] and summary["cycles"] >= 12:
+            assert (summary["rss_tail_kb"]
+                    <= 1.5 * summary["rss_warmup_kb"]), (
+                f"controller RSS grew {summary['rss_tail_kb']} kB vs "
+                f"post-warmup {summary['rss_warmup_kb']} kB"
+            )
+        # Deterministic-name aggregation: events scale with the name
+        # pool x reasons, not with cycles.
+        assert summary["events_final"] <= 600, summary
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=3600.0)
+    parser.add_argument("--log", default="testing/soak_r04.log")
+    args = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    soak = Soak(args.log)
+    try:
+        summary = soak.run(args.duration)
+    finally:
+        soak.close()
+    print(json.dumps(summary))
+    Soak.check(summary)
+
+
+if __name__ == "__main__":
+    main()
