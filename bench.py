@@ -512,9 +512,9 @@ def main():
          lambda: bench_decode(
             batch=1, prompt_len=8192, new_tokens=128,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P8K_ANCHOR",
-                                       264380),
+                                       238360),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P8K_ANCHOR",
-                                      789),
+                                      642),
         )),
         ("lm_decode_tokens_per_sec_per_chip[b1-p32k]", False,
          lambda: bench_decode(
@@ -530,17 +530,17 @@ def main():
          lambda: bench_decode(
             batch=8, prompt_len=8192, new_tokens=64,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_B8P8K_ANCHOR",
-                                       374034),
+                                       375115),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_B8P8K_ANCHOR",
-                                      1350),
+                                      1366),
         )),
         ("lm_decode_tokens_per_sec_per_chip[b8-p8k-int8]", False,
          lambda: bench_decode(
             batch=8, prompt_len=8192, new_tokens=64, quantized=True,
             prefill_anchor=_env_anchor(
-                "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 373990),
+                "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 371590),
             decode_anchor=_env_anchor(
-                "KFT_BENCH_DECODE_B8P8K_INT8_ANCHOR", 1979),
+                "KFT_BENCH_DECODE_B8P8K_INT8_ANCHOR", 2387),
         )),
         # Sliding-window model decoding from the O(window) rolling
         # cache: per-token cost must not grow with the prompt.
@@ -548,9 +548,9 @@ def main():
          lambda: bench_decode(
             batch=1, prompt_len=8192, new_tokens=128, window=1024,
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_W1K_ANCHOR",
-                                       319812),
+                                       274507),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_W1K_ANCHOR",
-                                      1100),
+                                      828),
         )),
     ]
     for name, mandatory, section in sections:
