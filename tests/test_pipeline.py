@@ -722,3 +722,25 @@ class TestInterleavedLM:
         with pytest.raises(ValueError, match="virtual_stages"):
             PipelinedLM(self.CFG, mesh, num_microbatches=4,
                         virtual_stages=2)
+
+    def test_interleaved_remat_matches(self):
+        mesh = make_mesh(MeshSpec(dp=2, pp=4))
+        plain = PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                            schedule="interleaved", virtual_stages=2)
+        remat = PipelinedLM(self.CFG, mesh, num_microbatches=4,
+                            schedule="interleaved", virtual_stages=2,
+                            remat=True)
+        params = plain.init(jax.random.key(0))
+        tokens = _tokens(8, 16)
+        g = jax.jit(jax.grad(
+            lambda p: lm_loss(plain.apply({"params": p}, tokens), tokens)
+        ))(params)
+        g_remat = jax.jit(jax.grad(
+            lambda p: lm_loss(remat.apply({"params": p}, tokens), tokens)
+        ))(params)
+        worst = max(
+            jax.tree.leaves(jax.tree.map(
+                lambda a, b: float(jnp.max(jnp.abs(a - b))), g, g_remat
+            ))
+        )
+        assert worst < 1e-5
