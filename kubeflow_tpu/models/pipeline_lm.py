@@ -47,6 +47,7 @@ from kubeflow_tpu.parallel.mesh import path_key
 from kubeflow_tpu.parallel.pipeline import (
     gpipe,
     interleaved_gpipe,
+    interleaved_one_f_one_b,
     one_f_one_b,
     stage_stack,
     stage_stack_interleaved,
@@ -64,11 +65,14 @@ class PipelinedLM:
     num_microbatches: int
     remat: bool = False
     # "gpipe": AD-of-scan backward (O(M) live microbatch state);
-    # "1f1b": PipeDream-flush interleaved backward (O(P), inherent
-    # stage rematerialisation — the schedule for large M);
+    # "1f1b": PipeDream-flush interleaved backward (O(P) live state,
+    # inherent stage rematerialisation — the schedule for large M);
     # "interleaved": virtual-stage (Megatron-interleaved) forward —
     # each device holds ``virtual_stages`` chunks round-robin, fill
     # bubble P-1 ticks at V*P depth (AD backward like gpipe).
+    # "1f1b" WITH virtual_stages > 1 combines both: the interleaved
+    # forward under the statically-scheduled PipeDream-flush backward
+    # (O(P*V) live state at V*P depth).
     schedule: str = "gpipe"
     # Chunks per device under schedule="interleaved". NOTE: params are
     # stored depth-stacked (L, ...) with contiguous pp sharding; the
@@ -91,12 +95,25 @@ class PipelinedLM:
                 "backward recomputes stage internals inherently); "
                 "drop remat=True"
             )
-        if self.virtual_stages != 1 and self.schedule != "interleaved":
+        if (self.virtual_stages != 1
+                and self.schedule not in ("interleaved", "1f1b")):
             raise ValueError(
-                "virtual_stages applies to schedule='interleaved' only"
+                "virtual_stages applies to the interleaved and 1f1b "
+                "schedules only"
+            )
+        if (self.schedule == "1f1b" and self.virtual_stages > 1
+                and mesh.shape.get("sp", 1) > 1):
+            raise ValueError(
+                "1f1b x virtual_stages does not compose with sp yet: "
+                "the schedule's backward deadlocks XLA's CPU in-process"
+                " communicator on some pp x sp topologies (see "
+                "interleaved_one_f_one_b docstring); use "
+                "schedule='interleaved' (AD backward) or plain 1f1b "
+                "on sp meshes"
             )
         chunks = mesh.shape["pp"] * (
-            self.virtual_stages if self.schedule == "interleaved" else 1
+            self.virtual_stages
+            if self.schedule in ("interleaved", "1f1b") else 1
         )
         if cfg.layers % chunks:
             raise ValueError(
@@ -243,18 +260,26 @@ class PipelinedLM:
                 else "replicated"
             ),
         )
-        if self.schedule == "1f1b":
+        virtual = (self.virtual_stages
+                   if self.schedule in ("interleaved", "1f1b") else 1)
+        if self.schedule == "1f1b" and virtual > 1:
+            run = interleaved_one_f_one_b(
+                stage_fn, mesh, virtual_stages=virtual, **common,
+            )
+        elif self.schedule == "1f1b":
             run = one_f_one_b(stage_fn, mesh, **common)
         elif self.schedule == "interleaved":
             run = interleaved_gpipe(
                 stage_fn, mesh, remat=self.remat,
-                virtual_stages=self.virtual_stages, **common,
+                virtual_stages=virtual, **common,
             )
         else:
             run = gpipe(stage_fn, mesh, remat=self.remat, **common)
-        if self.schedule == "interleaved":
+        if self.schedule == "interleaved" or virtual > 1:
+            # The chunked engines take the (P, V, L/C, ...) layout
+            # (also at V == 1, where the extra dim is just size 1).
             stacked = stage_stack_interleaved(
-                params["blocks"], mesh.shape["pp"], self.virtual_stages
+                params["blocks"], mesh.shape["pp"], virtual
             )
         else:
             stacked = stage_stack(params["blocks"], mesh.shape["pp"])

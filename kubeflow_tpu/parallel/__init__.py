@@ -24,6 +24,7 @@ from kubeflow_tpu.parallel.distributed import (
 from kubeflow_tpu.parallel.pipeline import (
     gpipe,
     interleaved_gpipe,
+    interleaved_one_f_one_b,
     one_f_one_b,
     pipeline_ticks,
     stage_stack,
@@ -41,6 +42,7 @@ __all__ = [
     "param_sharding",
     "gpipe",
     "interleaved_gpipe",
+    "interleaved_one_f_one_b",
     "one_f_one_b",
     "pipeline_ticks",
     "stage_stack",

@@ -571,64 +571,332 @@ def interleaved_gpipe(
         check_vma=False,
     )
     def run_sharded(stage_params, xm, *maybe_em):
-        em = maybe_em[0] if maybe_em else None
         # Per-device view: (1, V, L/(V*P), ...) -> (V, L/(V*P), ...).
         params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
         idx = jax.lax.axis_index(axis)
-        n_mb = xm.shape[0]
+        return _interleaved_forward_ticks(
+            stage_fn, params, xm, maybe_em[0] if maybe_em else None,
+            idx, axis, num_stages, V, groups, output,
+        )
 
-        def tick(carry, t):
-            state, outbuf = carry
-            recv = jax.lax.ppermute(state, axis, ring)
-            u = t - idx
-            active = u >= 0
-            g = jnp.maximum(u, 0) // cycle
-            w = jnp.maximum(u, 0) % cycle
-            v = w // num_stages
-            j = w % num_stages
-            m = jnp.clip(g * num_stages + j, 0, n_mb - 1)
-            active = jnp.logical_and(active, g < groups)
-            x_t = jax.lax.dynamic_index_in_dim(xm, m, 0, keepdims=False)
-            # Global stage 0 (chunk 0 on DEVICE 0) consumes fresh
-            # microbatches; every other unit consumes the neighbour's
-            # last output (the wrap edge P-1 -> 0 carries chunk
-            # boundaries v -> v+1 back to device 0).
-            fresh = jnp.logical_and(
-                jnp.logical_and(v == 0, idx == 0), active
+    return _microbatched(run_sharded, num_microbatches)
+
+
+def _interleaved_forward_ticks(stage_fn, params, xm, em, idx, axis,
+                               num_stages, V, groups, output):
+    """The interleaved forward tick scan, shared by
+    :func:`interleaved_gpipe` and the interleaved-1F1B primal (which,
+    like plain 1F1B, IS the interleaved forward — only backwards
+    differ). See interleaved_gpipe for the unit-timing derivation."""
+    n_mb = xm.shape[0]
+    cycle = V * num_stages
+    n_ticks = groups * cycle + num_stages - 1
+    ring = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        recv = jax.lax.ppermute(state, axis, ring)
+        u = t - idx
+        active = u >= 0
+        g = jnp.maximum(u, 0) // cycle
+        w = jnp.maximum(u, 0) % cycle
+        v = w // num_stages
+        j = w % num_stages
+        m = jnp.clip(g * num_stages + j, 0, n_mb - 1)
+        active = jnp.logical_and(active, g < groups)
+        x_t = jax.lax.dynamic_index_in_dim(xm, m, 0, keepdims=False)
+        # Global stage 0 (chunk 0 on DEVICE 0) consumes fresh
+        # microbatches; every other unit consumes the neighbour's
+        # last output (the wrap edge P-1 -> 0 carries chunk
+        # boundaries v -> v+1 back to device 0).
+        fresh = jnp.logical_and(
+            jnp.logical_and(v == 0, idx == 0), active
+        )
+        x_in = jnp.where(fresh, x_t, recv)
+        params_v = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, v, 0, keepdims=False
+            ),
+            params,
+        )
+        if em is None:
+            out = stage_fn(params_v, x_in)
+        else:
+            e_in = jax.lax.dynamic_index_in_dim(
+                em, m, 0, keepdims=False
             )
-            x_in = jnp.where(fresh, x_t, recv)
+            out = stage_fn(params_v, x_in, e_in)
+        write = jnp.logical_and(
+            active,
+            jnp.logical_and(idx == num_stages - 1, v == V - 1),
+        )
+        keep = jax.lax.dynamic_index_in_dim(
+            outbuf, m, 0, keepdims=False
+        )
+        outbuf = jax.lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(write, out, keep), m, 0
+        )
+        return (out, outbuf), None
+
+    init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+    (_, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+    return _emit_output(outbuf, idx, num_stages, axis, output)
+
+
+def interleaved_one_f_one_b(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    virtual_stages: int,
+    axis: str = "pp",
+    activation_spec: P | None = None,
+    extra_spec: P | None = None,
+    extra_manual_axes: tuple[str, ...] = (),
+    output: str = "replicated",
+):
+    """Interleaved 1F1B: the virtual-stage forward of
+    :func:`interleaved_gpipe` with a hand-scheduled PipeDream-flush
+    backward — O(P·V) live microbatch state (the static schedule's
+    buffer depth, ~P·(V+1) activations) however large M grows, at
+    V·P pipeline depth with the P-1-tick fill bubble.
+
+    The slot tables come from :mod:`kubeflow_tpu.parallel.schedule1f1b`
+    — SIMULATED under the Megatron discipline (per-device warmup
+    ``2(P-d-1) + (V-1)P`` forwards, then strict 1B1F alternation with
+    idling) and validated by an independent checker; activation and
+    cotangent buffer slots are assigned by static interval colouring,
+    so the executor reads/writes fixed buffer entries per slot with no
+    runtime keying. Both ring directions use the FULL ring: the wrap
+    edges carry chunk boundaries (activations P-1 → 0, cotangents
+    0 → P-1).
+
+    KNOWN LIMITATION (``extra_manual_axes``): composing this backward
+    with a second manual-collective axis (ring attention over sp)
+    deadlocks XLA's CPU in-process communicator on some topologies
+    (pp=2 x sp=2 reproduces 100%; pp=4 x sp=2 passes) — the same
+    stage functions compose fine with :func:`one_f_one_b` and
+    :func:`interleaved_gpipe`, and the non-sp paths here are
+    deterministic-green, so the interaction is between this schedule's
+    branch-divergent collective pattern and the CPU rendezvous
+    runtime, not the tables (checker-validated). Until characterised
+    on real multi-chip hardware, ``PipelinedLM`` refuses
+    1f1b x virtual on sp meshes; use the interleaved forward
+    (AD backward) or plain 1f1b there.
+    """
+    from kubeflow_tpu.parallel.schedule1f1b import (
+        build_schedule,
+        check_schedule,
+    )
+
+    num_stages = mesh.shape[axis]
+    if virtual_stages < 1:
+        raise ValueError(
+            f"virtual_stages must be >= 1, got {virtual_stages}"
+        )
+    act_spec = P() if activation_spec is None else activation_spec
+    _validate(act_spec, output, num_microbatches, num_stages)
+    if num_microbatches % num_stages:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches="
+            f"{num_microbatches} divisible by pp={num_stages}"
+        )
+    has_extra = extra_spec is not None
+    extra_in = (extra_spec,) if has_extra else ()
+    sched = build_schedule(num_microbatches, num_stages, virtual_stages)
+    check_schedule(sched)  # cheap at trace time; guards builder drift
+    T = sched.num_slots
+    kx, kc = sched.xbuf_slots, sched.cbuf_slots
+    tbl = {
+        name: jnp.asarray(getattr(sched, name))
+        for name in ("action", "unit_v", "unit_m", "f_in", "b_in",
+                     "b_cot", "act_store", "cot_store")
+    }
+    ring_f = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    ring_r = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+    manual_axes = frozenset({axis, *extra_manual_axes})
+    groups = num_microbatches // num_stages
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=manual_axes,
+        in_specs=(P(axis), act_spec) + extra_in,
+        out_specs=_out_spec(act_spec, axis, output),
+        check_vma=False,
+    )
+    def fwd_sharded(stage_params, xm, *maybe_em):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        return _interleaved_forward_ticks(
+            stage_fn, params, xm, maybe_em[0] if maybe_em else None,
+            idx, axis, num_stages, virtual_stages, groups, output,
+        )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names=manual_axes,
+        in_specs=(P(axis), act_spec) + extra_in
+        + (_out_spec(act_spec, axis, output),),
+        out_specs=(P(axis), act_spec),
+        check_vma=False,
+    )
+    def bwd_sharded(stage_params, xm, *em_and_ybar):
+        em = em_and_ybar[0] if has_extra else None
+        ym_bar = em_and_ybar[-1]
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), stage_params)
+        idx = jax.lax.axis_index(axis)
+        if output == "sharded":
+            ym_bar = jax.lax.all_gather(ym_bar, axis, axis=0, tiled=True)
+        mb_shape = xm.shape[1:]
+        zero_mb = jnp.zeros(mb_shape, xm.dtype)
+        # Per-chunk zero gradient (the switch branches return one
+        # chunk's worth; accumulation scatters it at the chunk index).
+        zero_pv = jax.tree.map(
+            lambda p: jnp.zeros(p.shape[1:], p.dtype), params
+        )
+
+        def store(buf, value, slot):
+            safe = jnp.clip(slot, 0, buf.shape[0] - 1)
+            keep = jax.lax.dynamic_index_in_dim(
+                buf, safe, 0, keepdims=False
+            )
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(slot >= 0, value, keep), safe, 0
+            )
+
+        def load(buf, slot):
+            return jax.lax.dynamic_index_in_dim(
+                buf, jnp.clip(slot, 0, buf.shape[0] - 1), 0,
+                keepdims=False,
+            )
+
+        def slot_step(carry, t):
+            xbuf, cbuf, prev_act, prev_cot, dparams, dxm = carry
+            recv_act = jax.lax.ppermute(prev_act, axis, ring_f)
+            recv_cot = jax.lax.ppermute(prev_cot, axis, ring_r)
+            xbuf = store(xbuf, recv_act, tbl["act_store"][t, idx])
+            cbuf = store(cbuf, recv_cot, tbl["cot_store"][t, idx])
+            act_code = tbl["action"][t, idx]
+            v = jnp.clip(tbl["unit_v"][t, idx], 0, virtual_stages - 1)
+            m = jnp.clip(tbl["unit_m"][t, idx], 0, xm.shape[0] - 1)
             params_v = jax.tree.map(
                 lambda p: jax.lax.dynamic_index_in_dim(
                     p, v, 0, keepdims=False
                 ),
                 params,
             )
+            x_own = jax.lax.dynamic_index_in_dim(
+                xm, m, 0, keepdims=False
+            )
             if em is None:
-                out = stage_fn(params_v, x_in)
+                run = stage_fn
             else:
                 e_in = jax.lax.dynamic_index_in_dim(
                     em, m, 0, keepdims=False
                 )
-                out = stage_fn(params_v, x_in, e_in)
-            write = jnp.logical_and(
-                active,
-                jnp.logical_and(idx == num_stages - 1, v == V - 1),
-            )
-            keep = jax.lax.dynamic_index_in_dim(
-                outbuf, m, 0, keepdims=False
-            )
-            outbuf = jax.lax.dynamic_update_index_in_dim(
-                outbuf, jnp.where(write, out, keep), m, 0
-            )
-            return (out, outbuf), None
+                run = lambda p, x: stage_fn(p, x, e_in)
 
-        init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
-        (_, outbuf), _ = jax.lax.scan(
-            tick, init, jnp.arange(n_ticks)
+            def f_branch(_):
+                f_slot = tbl["f_in"][t, idx]
+                x_in = jnp.where(
+                    f_slot >= 0, load(xbuf, f_slot), x_own
+                )
+                y = run(params_v, x_in)
+                return y, zero_mb, zero_pv, zero_mb
+
+            def b_branch(_):
+                b_slot = tbl["b_in"][t, idx]
+                x_in = jnp.where(
+                    b_slot >= 0, load(xbuf, b_slot), x_own
+                )
+                c_slot = tbl["b_cot"][t, idx]
+                seed = jax.lax.dynamic_index_in_dim(
+                    ym_bar, jnp.clip(m, 0, ym_bar.shape[0] - 1), 0,
+                    keepdims=False,
+                )
+                cot = jnp.where(
+                    c_slot >= 0, load(cbuf, c_slot), seed
+                )
+                _, vjp_fn = jax.vjp(run, params_v, x_in)
+                dpv, dx = vjp_fn(cot)
+                return zero_mb, dx, dpv, dx
+
+            def idle_branch(_):
+                return zero_mb, zero_mb, zero_pv, zero_mb
+
+            out_act, out_cot, dpv, dx = jax.lax.switch(
+                act_code, [idle_branch, f_branch, b_branch], ()
+            )
+            dparams = jax.tree.map(
+                lambda D, g: jax.lax.dynamic_update_index_in_dim(
+                    D,
+                    jax.lax.dynamic_index_in_dim(
+                        D, v, 0, keepdims=False
+                    ) + g,
+                    v, 0,
+                ),
+                dparams, dpv,
+            )
+            # Stage-0 backwards emit the input cotangent.
+            write_dx = jnp.logical_and(
+                act_code == 2,
+                jnp.logical_and(tbl["unit_v"][t, idx] == 0, idx == 0),
+            )
+            keep_dx = jax.lax.dynamic_index_in_dim(
+                dxm, m, 0, keepdims=False
+            )
+            dxm = jax.lax.dynamic_update_index_in_dim(
+                dxm, jnp.where(write_dx, dx, keep_dx), m, 0
+            )
+            return (xbuf, cbuf, out_act, out_cot, dparams, dxm), None
+
+        init = (
+            jnp.zeros((kx,) + mb_shape, xm.dtype),
+            jnp.zeros((kc,) + mb_shape, xm.dtype),
+            zero_mb, zero_mb,
+            jax.tree.map(jnp.zeros_like, params),
+            jnp.zeros_like(xm),
         )
-        return _emit_output(outbuf, idx, num_stages, axis, output)
+        (_, _, _, _, dparams, dxm), _ = jax.lax.scan(
+            slot_step, init, jnp.arange(T)
+        )
+        dxm = jax.lax.psum(
+            jnp.where(idx == 0, dxm, jnp.zeros_like(dxm)), axis
+        )
+        dparams = jax.tree.map(lambda g: g[None], dparams)
+        return dparams, dxm
 
-    return _microbatched(run_sharded, num_microbatches)
+    if has_extra:
+        @jax.custom_vjp
+        def pipeline(stage_params, xm, em):
+            return fwd_sharded(stage_params, xm, em)
+
+        def pipeline_fwd(stage_params, xm, em):
+            return fwd_sharded(stage_params, xm, em), (
+                stage_params, xm, em,
+            )
+
+        def pipeline_bwd(res, ym_bar):
+            stage_params, xm, em = res
+            dparams, dxm = bwd_sharded(stage_params, xm, em, ym_bar)
+            dem = np.zeros(em.shape, jax.dtypes.float0)
+            return dparams, dxm, dem
+    else:
+        @jax.custom_vjp
+        def pipeline(stage_params, xm):
+            return fwd_sharded(stage_params, xm)
+
+        def pipeline_fwd(stage_params, xm):
+            return fwd_sharded(stage_params, xm), (stage_params, xm)
+
+        def pipeline_bwd(res, ym_bar):
+            stage_params, xm = res
+            return bwd_sharded(stage_params, xm, ym_bar)
+
+    pipeline.defvjp(pipeline_fwd, pipeline_bwd)
+    return _microbatched(pipeline, num_microbatches)
 
 
 def stage_stack_interleaved(params, num_stages: int,
