@@ -672,17 +672,23 @@ def interleaved_one_f_one_b(
     0 → P-1).
 
     KNOWN LIMITATION (``extra_manual_axes``): composing this backward
-    with a second manual-collective axis (ring attention over sp)
-    deadlocks XLA's CPU in-process communicator on some topologies
-    (pp=2 x sp=2 reproduces 100%; pp=4 x sp=2 passes) — the same
-    stage functions compose fine with :func:`one_f_one_b` and
-    :func:`interleaved_gpipe`, and the non-sp paths here are
-    deterministic-green, so the interaction is between this schedule's
-    branch-divergent collective pattern and the CPU rendezvous
-    runtime, not the tables (checker-validated). Until characterised
-    on real multi-chip hardware, ``PipelinedLM`` refuses
-    1f1b x virtual on sp meshes; use the interleaved forward
-    (AD backward) or plain 1f1b there.
+    with a second manual-collective axis (e.g. an sp ppermute ring
+    inside the stage) deadlocks XLA's CPU in-process runtime across
+    every pp x sp chain topology tested (pp∈{2,4,8} x sp∈{2,4},
+    V∈{1,2}; 100% reproducible per config), while the SAME stages
+    compose fine with :func:`one_f_one_b` / :func:`interleaved_gpipe`
+    and all non-sp paths here are deterministic-green. The rendezvous
+    traces show different devices blocked in DIFFERENT collectives of
+    the same run (e.g. one in an 8-device collective-permute, another
+    in a 4-device all-gather): the CPU thunk scheduler executes
+    independent collectives in device-divergent order, and with one
+    thread per device two concurrently-runnable collectives
+    cross-block — a runtime scheduling race, not a table bug (the
+    schedule is checker-validated, and forward-only passes). TPU/GPU
+    runtimes impose a total stream order on collectives, so real
+    hardware is expected to be unaffected — but until that is
+    demonstrated, ``PipelinedLM`` refuses 1f1b x virtual on sp meshes;
+    use the interleaved forward (AD backward) or plain 1f1b there.
     """
     from kubeflow_tpu.parallel.schedule1f1b import (
         build_schedule,
