@@ -57,6 +57,12 @@ class ActivityLedger:
         self.write_interval_s = write_interval_s
         self._clock = clock
         self._last_write: dict[str, float] = {}
+        # Merged-but-unpersisted view per namespace: entries observed
+        # during a throttled tick must survive until the next flush even
+        # if the apiserver GCs the underlying Events in between (the
+        # stored ConfigMap alone would silently drop them). Bounded at
+        # ``limit`` entries per namespace by construction.
+        self._pending: dict[str, list[dict]] = {}
         self._lock = threading.Lock()
 
     # ---- ConfigMap IO (best-effort) ---------------------------------
@@ -76,7 +82,9 @@ class ActivityLedger:
         return cm, entries
 
     def _store(self, namespace: str, cm: dict | None,
-               entries: list[dict]) -> None:
+               entries: list[dict]) -> bool:
+        """Persist; returns False when the write didn't land (the
+        caller keeps the entries pending and retries next interval)."""
         data = {"entries": json.dumps(entries)}
         try:
             if cm is None:
@@ -90,11 +98,15 @@ class ActivityLedger:
                 cm = dict(cm)
                 cm["data"] = data
                 self.api.update(cm)
+            return True
         except Conflict:
-            pass  # concurrent writer won; their merge includes ours soon
+            # A concurrent writer won; ITS merge may not include ours —
+            # keep ours pending so the next flush re-merges them.
+            return False
         except ApiError as exc:
             log.debug("activity ledger write skipped (%s): %s",
                       namespace, exc)
+            return False
 
     # ---- the one public op ------------------------------------------
     def record_and_list(self, namespace: str,
@@ -103,7 +115,14 @@ class ActivityLedger:
         the merged history (newest first, capped). Persists at most
         once per ``write_interval_s`` per namespace."""
         cm, stored = self._load(namespace)
+        with self._lock:
+            pending = list(self._pending.get(namespace, ()))
         merged = {_key(e): e for e in stored}
+        # Replay entries observed during throttled ticks first: they may
+        # already be GC'd from the live Events feed, and the stored
+        # ConfigMap predates them.
+        for entry in pending:
+            merged[_key(entry)] = entry
         fresh = 0
         for ev in events:
             entry = _entry(ev)
@@ -116,15 +135,19 @@ class ActivityLedger:
             merged.values(), key=lambda e: e.get("time") or "",
             reverse=True,
         )[: self.limit]
-        if fresh:
-            with self._lock:
+        flush = False
+        with self._lock:
+            if fresh or pending:
+                self._pending[namespace] = out
                 now = self._clock()
-                due = (
-                    now - self._last_write.get(namespace, -1e9)
-                    >= self.write_interval_s
-                )
-                if due:
+                if (now - self._last_write.get(namespace, -1e9)
+                        >= self.write_interval_s):
                     self._last_write[namespace] = now
-            if due:
-                self._store(namespace, cm, out)
+                    flush = True
+        if flush and self._store(namespace, cm, out):
+            with self._lock:
+                # Clear only what this flush covered; a poll that raced
+                # in meanwhile re-marked the namespace with a superset.
+                if self._pending.get(namespace) is out:
+                    del self._pending[namespace]
         return out

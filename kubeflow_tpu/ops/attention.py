@@ -18,11 +18,18 @@ numerics are identical everywhere.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# Eager-path segment-id sortedness validation (costs one device
+# round-trip per un-jitted call). Read once at import.
+_CHECK_SORTED = os.environ.get(
+    "KFT_CHECK_SEGMENT_SORTED", "1"
+).lower() not in ("0", "false")
 
 # Finite "minus infinity": keeps exp(s - m) NaN-free when a whole row of
 # scores is masked (exp(NEG_INF - m) underflows to 0 instead of NaN).
@@ -621,11 +628,13 @@ def flash_attention(
                 "segment_ids requires self-attention (q and k share one "
                 f"sequence), got Sq={q.shape[2]} Sk={k.shape[2]}"
             )
-        if not isinstance(segment_ids, jax.core.Tracer):
-            # The sortedness contract (see docstring) is checkable for
-            # free on concrete ids (eager/test paths); under jit it
-            # would cost a device round-trip per call, and unsorted ids
-            # silently mis-mask — so catch it loudly where we can.
+        if jax.core.is_concrete(segment_ids) and _CHECK_SORTED:
+            # The sortedness contract (see docstring) is checkable on
+            # concrete ids (eager/test paths) at the cost of a device
+            # round-trip per call; under jit it cannot run at all and
+            # unsorted ids silently mis-mask — so catch it loudly where
+            # we can, and let latency-sensitive eager callers opt out
+            # with KFT_CHECK_SEGMENT_SORTED=0 (read once at import).
             if not bool(jnp.all(
                 segment_ids[:, 1:] >= segment_ids[:, :-1]
             )):

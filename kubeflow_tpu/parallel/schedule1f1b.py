@@ -324,7 +324,23 @@ def check_schedule(sched: Interleaved1F1B) -> None:
             assert f_at[(s - 1, m)] < t, f"B({s},{m}) before its input"
 
     # Buffer discipline: replay the static slots and assert no live
-    # entry is overwritten and every read was written.
+    # entry is overwritten and every read was written. Store ownership
+    # is resolved through reverse maps built once — (arrival slot,
+    # dest device) -> unit — instead of scanning f_at/b_at per store,
+    # which is O(units^2) overall and real time at production scale
+    # (M=512 x P=16 x V=4 is ~65k units).
+    xowner: dict[tuple[int, int], tuple[int, int]] = {}
+    for (s, m), tf in f_at.items():
+        if s + 1 < C:
+            key = (tf + 1, (s + 1) % P)
+            assert key not in xowner, f"two acts arrive at {key}"
+            xowner[key] = (s + 1, m)
+    cowner: dict[tuple[int, int], tuple[int, int]] = {}
+    for (s, m), tb in b_at.items():
+        if s > 0:
+            key = (tb + 1, (s - 1) % P)
+            assert key not in cowner, f"two cots arrive at {key}"
+            cowner[key] = (s - 1, m)
     for d in range(P):
         xlive: dict[int, tuple[int, int]] = {}
         clive: dict[int, tuple[int, int]] = {}
@@ -340,11 +356,7 @@ def check_schedule(sched: Interleaved1F1B) -> None:
                         f"xbuf[{xs}]@dev{d} overwritten live: {prev}"
                     )
                 # Which unit does this arrival belong to?
-                owner = None
-                for (s, m), tf in f_at.items():
-                    if tf == t - 1 and (s + 1) % P == d and s + 1 < C:
-                        owner = (s + 1, m)
-                        break
+                owner = xowner.get((t, d))
                 assert owner is not None, f"orphan act store t={t} d={d}"
                 xlive[xs] = owner
             cs = int(sched.cot_store[t, d])
@@ -355,11 +367,7 @@ def check_schedule(sched: Interleaved1F1B) -> None:
                     assert b_at[prev] < t, (
                         f"cbuf[{cs}]@dev{d} overwritten live: {prev}"
                     )
-                owner = None
-                for (s, m), tb in b_at.items():
-                    if tb == t - 1 and s > 0 and (s - 1) % P == d:
-                        owner = (s - 1, m)
-                        break
+                owner = cowner.get((t, d))
                 assert owner is not None, f"orphan cot store t={t} d={d}"
                 clive[cs] = owner
             a = sched.action[t, d]

@@ -533,6 +533,49 @@ class TestActivityRetention:
         finally:
             api.create, api.update = orig_create, orig_update
 
+    def test_throttled_tick_entries_survive_event_gc(self, api):
+        # An entry observed during a THROTTLED tick whose Event is then
+        # GC'd before the next due write must still reach the ledger:
+        # the pending in-memory merge is replayed and a later poll
+        # flushes even when it sees nothing fresh itself.
+        from kubeflow_tpu.dashboard.activity import ActivityLedger
+
+        api.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "alice"}})
+        now = [0.0]
+        ledger = ActivityLedger(api, write_interval_s=60.0,
+                                clock=lambda: now[0])
+        ledger.record_and_list(
+            "alice", [self._event(0, "2026-07-01T00:00:00Z")])
+        now[0] = 1.0  # throttled window: observed, not persisted
+        ledger.record_and_list(
+            "alice", [self._event(1, "2026-07-02T00:00:00Z")])
+        cm = api.get("v1", "ConfigMap", "dashboard-activity-ledger",
+                     "alice")
+        assert "L1" not in cm["data"]["entries"]
+        # Event GC'd while the write was throttled; quiet poll later.
+        now[0] = 61.0
+        out = ledger.record_and_list("alice", [])
+        assert [e["reason"] for e in out] == ["L1", "L0"]
+        cm = api.get("v1", "ConfigMap", "dashboard-activity-ledger",
+                     "alice")
+        assert "L1" in cm["data"]["entries"]
+        # Flushed pending is cleared: another quiet poll writes nothing.
+        writes = {"n": 0}
+        orig_update = api.update
+
+        def counting_update(obj, **kw):
+            writes["n"] += 1
+            return orig_update(obj, **kw)
+
+        api.update = counting_update
+        try:
+            now[0] = 130.0
+            ledger.record_and_list("alice", [])
+            assert writes["n"] == 0
+        finally:
+            api.update = orig_update
+
     def test_cap_and_corrupt_ledger_tolerated(self, api):
         from kubeflow_tpu.dashboard.activity import ActivityLedger
 
