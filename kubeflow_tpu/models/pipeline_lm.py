@@ -101,16 +101,6 @@ class PipelinedLM:
                 "virtual_stages applies to the interleaved and 1f1b "
                 "schedules only"
             )
-        if (self.schedule == "1f1b" and self.virtual_stages > 1
-                and mesh.shape.get("sp", 1) > 1):
-            raise ValueError(
-                "1f1b x virtual_stages does not compose with sp yet: "
-                "the schedule's backward deadlocks XLA's CPU in-process"
-                " communicator on some pp x sp topologies (see "
-                "interleaved_one_f_one_b docstring); use "
-                "schedule='interleaved' (AD backward) or plain 1f1b "
-                "on sp meshes"
-            )
         chunks = mesh.shape["pp"] * (
             self.virtual_stages
             if self.schedule in ("interleaved", "1f1b") else 1

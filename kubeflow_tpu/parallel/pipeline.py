@@ -100,6 +100,21 @@ def _microbatched(pipeline_fn, num_microbatches: int):
     return run
 
 
+def _collective_seq(x, dep):
+    """Thread a data dependency from ``dep`` into ``x`` so every op
+    consuming ``x`` — in particular any collective inside the stage —
+    is issued AFTER the collective that produced ``dep``, on every
+    device. XLA backends without a total collective stream order (the
+    CPU thunk runtime: one thread per device, independent collectives
+    executed in device-divergent order) otherwise cross-block when two
+    concurrently-runnable collectives get picked in different orders by
+    different devices — the round-4 1f1b x virtual x sp deadlock. An
+    ``optimization_barrier`` is metadata-only on backends that already
+    stream-order collectives (TPU)."""
+    x, _ = jax.lax.optimization_barrier((x, dep))
+    return x
+
+
 def _out_spec(act_spec: P, axis: str, output: str) -> P:
     """out_specs for the schedule result: microbatch dim 0 sharded over
     ``axis`` in sharded mode, act_spec otherwise."""
@@ -287,6 +302,12 @@ def _1f1b_tables(num_microbatches: int, num_stages: int):
             B[2 * Pn - 1 + 2 * m - s, s] = m
     R = np.full((T, Pn), -1, np.int32)
     R[1:, 1:] = F[:-1, :-1]
+    # The uniform tick computes ONE unit per slot, so the schedule must
+    # never put an F and a B on the same (slot, stage) — true of 1F1B
+    # by construction (F and B slots have opposite parity per stage);
+    # pinned here because silently dropping one would be a wrong-grads
+    # bug, not a crash. (numpy domain: the caller may be tracing.)
+    assert not np.any((F >= 0) & (B >= 0))
     return jnp.asarray(F), jnp.asarray(B), jnp.asarray(R)
 
 
@@ -300,6 +321,7 @@ def one_f_one_b(
     extra_spec: P | None = None,
     extra_manual_axes: tuple[str, ...] = (),
     output: str = "replicated",
+    uniform_collectives: bool | None = None,
 ):
     """1F1B (PipeDream-flush) pipeline schedule. Same contract and same
     bubble fraction as :func:`gpipe`; the difference is the BACKWARD.
@@ -317,11 +339,29 @@ def one_f_one_b(
     Compute cost is identical to gpipe(remat=True): M forwards +
     M recompute-backwards per stage (measured on the 8-device CPU mesh
     and on-chip; see BASELINE.md round-3 pipeline rows).
+
+    ``uniform_collectives`` (round 5; default: auto-on when
+    ``extra_manual_axes`` is non-empty): with stage-internal manual
+    collectives (the sp ring), the lax.switch backward makes devices
+    issue DIFFERENT collective sequences in the same tick, and the
+    collective rendezvous keys on (run_id, channel) with one channel
+    reused — devices silently join each other's rendezvous across
+    different ops and exchange the WRONG tensors. Round 5 measured
+    plain 1f1b x sp gradients off by 100-400x relative on the CPU
+    runtime while the loss stayed exact (the forward is uniform
+    already). The uniform tick runs one vjp on every device every
+    tick with select-masked outputs — identical global collective
+    sequence by construction. See interleaved_one_f_one_b for the
+    matching fix at virtual depth.
     """
     num_stages = mesh.shape[axis]
     act_spec = P() if activation_spec is None else activation_spec
     _validate(act_spec, output, num_microbatches, num_stages)
     manual_axes = frozenset({axis, *extra_manual_axes})
+    uniform = (
+        bool(extra_manual_axes) if uniform_collectives is None
+        else uniform_collectives
+    )
     fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
     rev_perm = [(i + 1, i) for i in range(num_stages - 1)]
     F_tbl, B_tbl, R_tbl = _1f1b_tables(num_microbatches, num_stages)
@@ -378,7 +418,10 @@ def one_f_one_b(
             f_mb = F_tbl[t, idx]
             b_mb = B_tbl[t, idx]
             r_mb = R_tbl[t, idx]
+            # Deterministic hop order on order-free backends (see
+            # _collective_seq): act hop -> cot hop -> stage work.
             recv_act = jax.lax.ppermute(prev_act, axis, fwd_perm)
+            prev_cot = _collective_seq(prev_cot, recv_act)
             recv_cot = jax.lax.ppermute(prev_cot, axis, rev_perm)
 
             # Stage input arrives: from upstream (s > 0) or from xm
@@ -414,37 +457,64 @@ def one_f_one_b(
                 )
                 return lambda p, x: stage_fn(p, x, e_in)
 
-            def f_branch(op):
-                xbuf, _recv_cot = op
+            slot_b = jnp.where(b_mb >= 0, b_mb % num_stages, 0)
+            seed = jax.lax.dynamic_index_in_dim(
+                ym_bar, jnp.clip(b_mb, 0, ym_bar.shape[0] - 1), 0,
+                keepdims=False,
+            )
+            if uniform:
+                # Uniform-collective tick (see docstring): one vjp on
+                # every device every tick, outputs masked by selects —
+                # garbage-input vjps may be non-finite, so never
+                # multiply-mask.
+                is_f = f_mb >= 0
+                is_b = b_mb >= 0
                 x_in = jax.lax.dynamic_index_in_dim(
-                    xbuf, slot_f, 0, keepdims=False
-                )
-                y = _stage_at(f_mb)(params, x_in)
-                return y, zero_mb, zero_params, zero_mb
-
-            def b_branch(op):
-                xbuf, recv_cot = op
-                slot_b = jnp.where(b_mb >= 0, b_mb % num_stages, 0)
-                x_in = jax.lax.dynamic_index_in_dim(
-                    xbuf, slot_b, 0, keepdims=False
-                )
-                seed = jax.lax.dynamic_index_in_dim(
-                    ym_bar, jnp.clip(b_mb, 0, ym_bar.shape[0] - 1), 0,
+                    xbuf, jnp.where(is_b, slot_b, slot_f), 0,
                     keepdims=False,
                 )
                 cot = jnp.where(is_last, seed, recv_cot)
-                _, vjp_fn = jax.vjp(_stage_at(b_mb), params, x_in)
-                dp, dx = vjp_fn(cot)
-                return zero_mb, dx, dp, dx
+                x_in = _collective_seq(x_in, recv_cot)
+                cot = _collective_seq(cot, recv_cot)
+                mb = jnp.where(is_b, b_mb, jnp.maximum(f_mb, 0))
+                y, vjp_fn = jax.vjp(_stage_at(mb), params, x_in)
+                dp_raw, dx_raw = vjp_fn(cot)
+                out_act = jnp.where(is_f, y, zero_mb)
+                out_cot = jnp.where(is_b, dx_raw, zero_mb)
+                dx = jnp.where(is_b, dx_raw, zero_mb)
+                dp = jax.tree.map(
+                    lambda g, z: jnp.where(is_b, g, z), dp_raw,
+                    zero_params,
+                )
+            else:
+                def f_branch(op):
+                    xbuf, _recv_cot = op
+                    x_in = jax.lax.dynamic_index_in_dim(
+                        xbuf, slot_f, 0, keepdims=False
+                    )
+                    y = _stage_at(f_mb)(params, x_in)
+                    return y, zero_mb, zero_params, zero_mb
 
-            def idle_branch(op):
-                return zero_mb, zero_mb, zero_params, zero_mb
+                def b_branch(op):
+                    xbuf, recv_cot = op
+                    x_in = jax.lax.dynamic_index_in_dim(
+                        xbuf, slot_b, 0, keepdims=False
+                    )
+                    cot = jnp.where(is_last, seed, recv_cot)
+                    _, vjp_fn = jax.vjp(_stage_at(b_mb), params, x_in)
+                    dp, dx = vjp_fn(cot)
+                    return zero_mb, dx, dp, dx
 
-            action = jnp.where(f_mb >= 0, 1, jnp.where(b_mb >= 0, 2, 0))
-            out_act, out_cot, dp, dx = jax.lax.switch(
-                action, [idle_branch, f_branch, b_branch],
-                (xbuf, recv_cot),
-            )
+                def idle_branch(op):
+                    return zero_mb, zero_mb, zero_params, zero_mb
+
+                action = jnp.where(
+                    f_mb >= 0, 1, jnp.where(b_mb >= 0, 2, 0)
+                )
+                out_act, out_cot, dp, dx = jax.lax.switch(
+                    action, [idle_branch, f_branch, b_branch],
+                    (xbuf, recv_cot),
+                )
             dparams = jax.tree.map(jnp.add, dparams, dp)
             # Input cotangent: stage 0's backward of mb m yields dxm[m].
             slot_b = jnp.clip(b_mb, 0, xm.shape[0] - 1)
@@ -458,7 +528,11 @@ def one_f_one_b(
             return (xbuf, out_act, out_cot, dparams, dxm), None
 
         xbuf0 = jnp.zeros((num_stages,) + mb_shape, xm.dtype)
-        init = (xbuf0, zero_mb, zero_mb, zero_params, jnp.zeros_like(xm))
+        # First hop must not race the ym_bar gather above (see the
+        # interleaved engine's matching note).
+        init = (xbuf0, _collective_seq(zero_mb, ym_bar),
+                _collective_seq(zero_mb, ym_bar), zero_params,
+                jnp.zeros_like(xm))
         (_, _, _, dparams, dxm), _ = jax.lax.scan(
             slot, init, jnp.arange(n_slots)
         )
@@ -466,6 +540,12 @@ def one_f_one_b(
         dxm = jax.lax.psum(
             jnp.where(is_first, dxm, jnp.zeros_like(dxm)), axis
         )
+        # Params are replicated over the extra manual axes: sum the
+        # per-peer shard contributions (see interleaved engine note).
+        for extra_axis in extra_manual_axes:
+            dparams = jax.tree.map(
+                lambda g: jax.lax.psum(g, extra_axis), dparams
+            )
         dparams = jax.tree.map(lambda g: g[None], dparams)
         return dparams, dxm
 
@@ -654,6 +734,7 @@ def interleaved_one_f_one_b(
     extra_spec: P | None = None,
     extra_manual_axes: tuple[str, ...] = (),
     output: str = "replicated",
+    uniform_collectives: bool | None = None,
 ):
     """Interleaved 1F1B: the virtual-stage forward of
     :func:`interleaved_gpipe` with a hand-scheduled PipeDream-flush
@@ -671,24 +752,26 @@ def interleaved_one_f_one_b(
     edges carry chunk boundaries (activations P-1 → 0, cotangents
     0 → P-1).
 
-    KNOWN LIMITATION (``extra_manual_axes``): composing this backward
-    with a second manual-collective axis (e.g. an sp ppermute ring
-    inside the stage) deadlocks XLA's CPU in-process runtime across
-    every pp x sp chain topology tested (pp∈{2,4,8} x sp∈{2,4},
-    V∈{1,2}; 100% reproducible per config), while the SAME stages
-    compose fine with :func:`one_f_one_b` / :func:`interleaved_gpipe`
-    and all non-sp paths here are deterministic-green. The rendezvous
-    traces show different devices blocked in DIFFERENT collectives of
-    the same run (e.g. one in an 8-device collective-permute, another
-    in a 4-device all-gather): the CPU thunk scheduler executes
-    independent collectives in device-divergent order, and with one
-    thread per device two concurrently-runnable collectives
-    cross-block — a runtime scheduling race, not a table bug (the
-    schedule is checker-validated, and forward-only passes). TPU/GPU
-    runtimes impose a total stream order on collectives, so real
-    hardware is expected to be unaffected — but until that is
-    demonstrated, ``PipelinedLM`` refuses 1f1b x virtual on sp meshes;
-    use the interleaved forward (AD backward) or plain 1f1b there.
+    ``uniform_collectives`` (round-5; default: auto-on when
+    ``extra_manual_axes`` is non-empty) resolves the round-4
+    "1f1b x virtual x sp deadlock": with a second manual-collective
+    axis (the sp ring) inside the stage, the old ``lax.switch``
+    backward made devices issue DIFFERENT collective sequences in the
+    same tick (an F device: the stage's forward ring hops; a B device:
+    forward-recompute + transposed hops; an idle device: none). XLA's
+    collective rendezvous keys on (run_id, channel) — and JAX reuses
+    one channel across these ops — so devices joined each other's
+    rendezvous across different ops and cross-blocked 100%
+    reproducibly on the CPU runtime (pp∈{2,4,8} x sp∈{2,4}); the same
+    divergence is undefined behaviour on any keyed-collective backend.
+    The uniform tick runs one vjp on EVERY device EVERY tick with
+    masked (select) outputs, so the global collective sequence is
+    identical on all devices by construction — plus explicit
+    data-dependency chaining (``_collective_seq``) pinning
+    act-hop -> cot-hop -> stage collectives within each tick and the
+    ym_bar gather before the first hop. Cost: the F ticks' unused
+    transpose (~2x backward stage compute); collective-free stages
+    keep the cheap switch path.
     """
     from kubeflow_tpu.parallel.schedule1f1b import (
         build_schedule,
@@ -722,6 +805,10 @@ def interleaved_one_f_one_b(
     ring_r = [(i, (i - 1) % num_stages) for i in range(num_stages)]
     manual_axes = frozenset({axis, *extra_manual_axes})
     groups = num_microbatches // num_stages
+    uniform = (
+        bool(extra_manual_axes) if uniform_collectives is None
+        else uniform_collectives
+    )
 
     @partial(
         jax.shard_map,
@@ -780,7 +867,16 @@ def interleaved_one_f_one_b(
 
         def slot_step(carry, t):
             xbuf, cbuf, prev_act, prev_cot, dparams, dxm = carry
+            # Deterministic global collective order within the tick:
+            # act hop -> cot hop -> stage-internal (sp) collectives.
+            # The hops are data-independent and the stage branches pull
+            # their inputs from buffers that may bypass both, so on
+            # backends with no collective stream order each device
+            # could otherwise issue them in its own order and
+            # cross-block (_collective_seq). The chain below makes the
+            # order a data dependency on every device.
             recv_act = jax.lax.ppermute(prev_act, axis, ring_f)
+            prev_cot = _collective_seq(prev_cot, recv_act)
             recv_cot = jax.lax.ppermute(prev_cot, axis, ring_r)
             xbuf = store(xbuf, recv_act, tbl["act_store"][t, idx])
             cbuf = store(cbuf, recv_cot, tbl["cot_store"][t, idx])
@@ -804,37 +900,81 @@ def interleaved_one_f_one_b(
                 )
                 run = lambda p, x: stage_fn(p, x, e_in)
 
-            def f_branch(_):
-                f_slot = tbl["f_in"][t, idx]
-                x_in = jnp.where(
-                    f_slot >= 0, load(xbuf, f_slot), x_own
-                )
-                y = run(params_v, x_in)
-                return y, zero_mb, zero_pv, zero_mb
+            f_slot = tbl["f_in"][t, idx]
+            b_slot = tbl["b_in"][t, idx]
+            c_slot = tbl["b_cot"][t, idx]
+            seed = jax.lax.dynamic_index_in_dim(
+                ym_bar, jnp.clip(m, 0, ym_bar.shape[0] - 1), 0,
+                keepdims=False,
+            )
 
-            def b_branch(_):
-                b_slot = tbl["b_in"][t, idx]
+            if uniform:
+                # Uniform-collective tick (sp-composed meshes): EVERY
+                # device runs one vjp (forward recompute + transpose)
+                # every tick and masks the outputs with selects, so the
+                # stage's manual collectives (the sp ring, fwd AND
+                # transposed) execute in an identical global sequence
+                # on every device — branch-divergent collective counts
+                # under lax.switch are what cross-blocked the CPU
+                # rendezvous (and are undefined on any keyed-collective
+                # backend). Costs one transpose on F ticks and one
+                # fwd+transpose on idle ticks; idle is the bubble
+                # fraction, so steady-state overhead is the F ticks'
+                # unused transpose (~2x backward compute), bought for a
+                # schedule that is correct by construction.
+                is_f = act_code == 1
+                is_b = act_code == 2
                 x_in = jnp.where(
-                    b_slot >= 0, load(xbuf, b_slot), x_own
-                )
-                c_slot = tbl["b_cot"][t, idx]
-                seed = jax.lax.dynamic_index_in_dim(
-                    ym_bar, jnp.clip(m, 0, ym_bar.shape[0] - 1), 0,
-                    keepdims=False,
+                    is_b,
+                    jnp.where(b_slot >= 0, load(xbuf, b_slot), x_own),
+                    jnp.where(f_slot >= 0, load(xbuf, f_slot), x_own),
                 )
                 cot = jnp.where(
                     c_slot >= 0, load(cbuf, c_slot), seed
                 )
-                _, vjp_fn = jax.vjp(run, params_v, x_in)
-                dpv, dx = vjp_fn(cot)
-                return zero_mb, dx, dpv, dx
+                x_in = _collective_seq(x_in, recv_cot)
+                cot = _collective_seq(cot, recv_cot)
+                y, vjp_fn = jax.vjp(run, params_v, x_in)
+                dpv_raw, dx_raw = vjp_fn(cot)
+                # Selects, not multiplies: garbage-input vjps may
+                # produce non-finite values and 0*inf would leak.
+                out_act = jnp.where(is_f, y, zero_mb)
+                out_cot = jnp.where(is_b, dx_raw, zero_mb)
+                dx = jnp.where(is_b, dx_raw, zero_mb)
+                dpv = jax.tree.map(
+                    lambda g, z: jnp.where(is_b, g, z), dpv_raw,
+                    zero_pv,
+                )
+            else:
+                def f_branch(_):
+                    x_in = jnp.where(
+                        f_slot >= 0, load(xbuf, f_slot), x_own
+                    )
+                    # Stage collectives ride on x_in; pin them after
+                    # both hops even when x_in bypassed the buffers.
+                    x_in = _collective_seq(x_in, recv_cot)
+                    y = run(params_v, x_in)
+                    return y, zero_mb, zero_pv, zero_mb
 
-            def idle_branch(_):
-                return zero_mb, zero_mb, zero_pv, zero_mb
+                def b_branch(_):
+                    x_in = jnp.where(
+                        b_slot >= 0, load(xbuf, b_slot), x_own
+                    )
+                    cot = jnp.where(
+                        c_slot >= 0, load(cbuf, c_slot), seed
+                    )
+                    x_in = _collective_seq(x_in, recv_cot)
+                    cot = _collective_seq(cot, recv_cot)
+                    _, vjp_fn = jax.vjp(run, params_v, x_in)
+                    dpv, dx = vjp_fn(cot)
+                    return zero_mb, dx, dpv, dx
 
-            out_act, out_cot, dpv, dx = jax.lax.switch(
-                act_code, [idle_branch, f_branch, b_branch], ()
-            )
+                def idle_branch(_):
+                    return zero_mb, zero_mb, zero_pv, zero_mb
+
+                out_act, out_cot, dpv, dx = jax.lax.switch(
+                    act_code, [idle_branch, f_branch, b_branch], ()
+                )
             dparams = jax.tree.map(
                 lambda D, g: jax.lax.dynamic_update_index_in_dim(
                     D,
@@ -858,10 +998,17 @@ def interleaved_one_f_one_b(
             )
             return (xbuf, cbuf, out_act, out_cot, dparams, dxm), None
 
+        # The first tick's hop must not race the ym_bar all-gather
+        # above: the scan's init has no data dependency on ym_bar, so
+        # on order-free backends some devices entered the (all-device)
+        # hop while their partners sat in the (sp-group) gather —
+        # observed as the round-4 cross-block. Chain the hop operands'
+        # init on ym_bar so every device gathers first.
         init = (
             jnp.zeros((kx,) + mb_shape, xm.dtype),
             jnp.zeros((kc,) + mb_shape, xm.dtype),
-            zero_mb, zero_mb,
+            _collective_seq(zero_mb, ym_bar),
+            _collective_seq(zero_mb, ym_bar),
             jax.tree.map(jnp.zeros_like, params),
             jnp.zeros_like(xm),
         )
@@ -871,6 +1018,15 @@ def interleaved_one_f_one_b(
         dxm = jax.lax.psum(
             jnp.where(idx == 0, dxm, jnp.zeros_like(dxm)), axis
         )
+        # Stage params are REPLICATED over the extra manual axes (sp):
+        # each peer's vjp holds only its sequence shard's contribution,
+        # and the P(axis) out-spec would silently drop the rest — the
+        # AD engines get this psum inserted by shard_map's transpose
+        # automatically; the hand-scheduled backward must do it itself.
+        for extra_axis in extra_manual_axes:
+            dparams = jax.tree.map(
+                lambda g: jax.lax.psum(g, extra_axis), dparams
+            )
         dparams = jax.tree.map(lambda g: g[None], dparams)
         return dparams, dxm
 

@@ -1,0 +1,52 @@
+"""1f1b x sp characterisation runner (round 5): one (pp, sp, V) config
+per invocation on the virtual CPU mesh — loss parity vs the sequential
+reference + finite grads. The committed matrix record is
+testing/matrix_1f1b_sp_r05.log; full grad parity per-leaf lives in the
+permanent suite tests (tests/test_pipeline.py).
+
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python testing/repro_1f1b_sp.py <pp> <sp> <virtual_stages>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models import LMConfig  # noqa: E402
+from kubeflow_tpu.models.pipeline_lm import PipelinedLM  # noqa: E402
+from kubeflow_tpu.models.transformer import lm_loss  # noqa: E402
+from kubeflow_tpu.parallel import MeshSpec, make_mesh  # noqa: E402
+
+
+def main():
+    pp, sp, v = (int(a) for a in sys.argv[1:4])
+    cfg = LMConfig(vocab=64, layers=pp * v, dim=32, heads=2)
+    mesh = make_mesh(MeshSpec(pp=pp, sp=sp))
+    model = PipelinedLM(cfg, mesh, num_microbatches=pp,
+                        schedule="1f1b", virtual_stages=v)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, 64, size=(2 * pp, 16)), jnp.int32
+    )
+    loss = jax.jit(lambda p: lm_loss(
+        model.apply({"params": p}, tokens), tokens))(params)
+    ref = jax.jit(lambda p: lm_loss(
+        model.sequential_apply({"params": p}, tokens), tokens))(params)
+    np.testing.assert_allclose(loss, ref, rtol=1e-4)
+    g = jax.jit(jax.grad(lambda p: lm_loss(
+        model.apply({"params": p}, tokens), tokens)))(params)
+    assert all(bool(jnp.all(jnp.isfinite(leaf)))
+               for leaf in jax.tree.leaves(g))
+    print(f"OK pp={pp} sp={sp} V={v} loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
