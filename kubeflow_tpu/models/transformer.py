@@ -71,6 +71,21 @@ class LMConfig:
     # looks across the whole sequence (acceptable for training; not
     # valid for autoregressive decode, which decoding.py rejects).
     moe_router: str = "topk"
+    # "fused": the train step computes the loss via the chunked
+    # ops.cross_entropy.fused_ce head — the (B*S, vocab) f32 logits
+    # tensor never exists and both backward head matmuls stay on the
+    # bf16 MXU path. "dense": materialised logits + optax CE (the
+    # numerical reference; also what inference/eval logits use).
+    # "auto" (default): fused for long sequences, dense otherwise —
+    # the round-5 same-process A/B on v5e (testing/ab_ce.py) measured
+    # fused 0.94x at S=2048 (the extra backward recompute matmul
+    # loses) but 1.03x at S=8192 and 1.09x at S=32768 (killing the
+    # gigabyte-scale f32 logits round-trips wins); the crossover sits
+    # between 2k and 8k.
+    loss_impl: str = "auto"
+    # Vocab tile width for the fused loss (divides HBM-resident width;
+    # padded+masked when the vocab is not a multiple).
+    ce_block: int = 4096
 
     def __post_init__(self):
         if self.attn_window is not None and self.attn_window < 1:
@@ -96,6 +111,13 @@ class LMConfig:
                 f"moe_router must be topk|expert_choice, got "
                 f"{self.moe_router!r}"
             )
+        if self.loss_impl not in ("auto", "fused", "dense"):
+            raise ValueError(
+                f"loss_impl must be auto|fused|dense, got "
+                f"{self.loss_impl!r}"
+            )
+        if self.ce_block < 1:
+            raise ValueError(f"ce_block={self.ce_block} must be >= 1")
 
     @property
     def head_dim(self) -> int:
@@ -362,9 +384,12 @@ class TransformerLM(nn.Module):
     attn_impl: AttnImpl | None = None
 
     @nn.compact
-    def __call__(self, tokens, segment_ids=None):
+    def __call__(self, tokens, segment_ids=None, return_hidden=False):
         # (B, S) int32 -> (B, S, vocab) f32; ``segment_ids`` (B, S)
         # enables packed-batch (document-masked) training end to end.
+        # ``return_hidden`` skips the head and returns the post-final-
+        # norm (B, S, dim) states — the fused-CE train step computes
+        # the loss straight from these (the full logits never exist).
         cfg = self.cfg
         emb = nn.Embed(cfg.vocab, cfg.dim, dtype=cfg.dtype, name="embed")
         x = emb(tokens)
@@ -375,6 +400,8 @@ class TransformerLM(nn.Module):
             x = Block(cfg, attn_impl=self.attn_impl, use_moe=use_moe,
                       name=f"block_{i}")(x, segment_ids)
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            return x
         return tied_head(x, emb.embedding, cfg.dtype)
 
 
@@ -527,20 +554,37 @@ def make_lm_train_step(
     when a config is supplied (the config-side source of truth); an
     explicit ``moe_aux_weight`` overrides it, and with neither the
     LMConfig default applies (inert for dense models)."""
+    loss_cfg = cfg or LMConfig()
     if moe_aux_weight is None:
-        moe_aux_weight = (cfg or LMConfig()).moe_aux_weight
+        moe_aux_weight = loss_cfg.moe_aux_weight
+    # The "auto" crossover is the sequence length: the A/B behind the
+    # LMConfig.loss_impl docstring straddles S=2048 (dense wins) and
+    # S=8192 (fused wins). Resolved per batch shape at trace time.
+    AUTO_FUSED_MIN_SEQ = 8192
 
     def step(state, batch):
         seg = batch.get("segment_ids")
+        fused = loss_cfg.loss_impl == "fused" or (
+            loss_cfg.loss_impl == "auto"
+            and batch["tokens"].shape[1] >= AUTO_FUSED_MIN_SEQ
+        )
 
         def loss_fn(params):
-            logits, mods = state.apply_fn(
+            outputs, mods = state.apply_fn(
                 {"params": params}, batch["tokens"], seg,
-                mutable=["intermediates"],
+                return_hidden=fused, mutable=["intermediates"],
             )
             aux = _moe_aux_total(mods.get("intermediates", {}))
-            return (lm_loss(logits, batch["tokens"], seg)
-                    + moe_aux_weight * aux)
+            if fused:
+                from kubeflow_tpu.ops.cross_entropy import fused_lm_loss
+
+                main = fused_lm_loss(
+                    outputs, params["embed"]["embedding"],
+                    batch["tokens"], seg, block=loss_cfg.ce_block,
+                )
+            else:
+                main = lm_loss(outputs, batch["tokens"], seg)
+            return main + moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = state.tx.update(

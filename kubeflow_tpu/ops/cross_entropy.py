@@ -1,0 +1,173 @@
+"""Chunked (vocab-blockwise) softmax cross-entropy with a fused head.
+
+The flagship LM's loss used to materialise the full (B*S, vocab) f32
+logits tensor three-plus times per step (head matmul out, softmax-CE
+read, backward softmax recompute + dlogits), and — the sharper edge —
+the autodiff backward of the bf16 tied-head einsum contracts an f32
+cotangent against bf16 weights, which XLA promotes to the ~4x-slower
+f32 MXU path. BASELINE.md names this stack as the ~55%-MFU residual at
+S=2048 (round-4 verdict Next #4).
+
+``fused_ce`` computes per-position NLL directly from the pre-head
+hidden states: it streams the vocab in tiles with an online logsumexp
+(the flash-attention trick applied over the vocab axis), so no
+(N, vocab) tensor ever exists, and its custom backward recomputes each
+tile's softmax from the saved logsumexp and runs BOTH backward matmuls
+on compute-dtype (bf16) operands with f32 accumulation.
+
+FLOP cost: one extra N x D x V matmul (the backward recompute) — ~7%
+of the step at the flagship shape — traded against gigabytes of f32
+HBM round-trips and the f32-MXU backward. Net measured on v5e: see
+BASELINE.md (round 5).
+
+No reference counterpart (the reference platform ships no model code).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _pad_vocab(emb: jax.Array, block: int) -> jax.Array:
+    """Pad the (V, D) table with zero rows up to a multiple of
+    ``block``; padded columns are masked to -inf in every tile."""
+    v = emb.shape[0]
+    pad = (-v) % block
+    if pad:
+        emb = jnp.pad(emb, ((0, pad), (0, 0)))
+    return emb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_ce(x, emb, targets, block: int = 4096, compute_dtype=None):
+    """Per-position NLL of ``targets`` under logits ``x @ emb.T``.
+
+    x: (N, D) hidden states (any float dtype; matmuls run in
+    ``compute_dtype`` with f32 accumulation — exactly ``tied_head``'s
+    contract; None = x's own dtype, which is the model's activation
+    dtype). emb: (V, D) tied embedding table. targets: (N,) int32.
+    Returns (N,) f32 NLL; callers apply masking/averaging so packed-
+    batch semantics stay outside the op.
+    """
+    nll, _ = _fused_ce_fwd(x, emb, targets, block, compute_dtype)
+    return nll
+
+
+def _tiles(emb, block, compute_dtype):
+    padded = _pad_vocab(emb, block).astype(compute_dtype)
+    n_tiles = padded.shape[0] // block
+    return padded.reshape(n_tiles, block, emb.shape[1]), n_tiles
+
+
+def _fused_ce_fwd(x, emb, targets, block, compute_dtype):
+    if compute_dtype is None:
+        compute_dtype = x.dtype
+    v, _ = emb.shape
+    xc = x.astype(compute_dtype)
+    emb_t, n_tiles = _tiles(emb, block, compute_dtype)
+    tile0 = jnp.arange(n_tiles, dtype=jnp.int32) * block
+    n = x.shape[0]
+
+    def tile_step(carry, xs):
+        m, s, tgt = carry
+        emb_tile, t0 = xs
+        logits = jnp.einsum(
+            "nd,vd->nv", xc, emb_tile,
+            preferred_element_type=jnp.float32,
+        )
+        cols = t0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(cols < v, logits, NEG_INF)
+        tile_max = jnp.max(logits, axis=1)
+        m_new = jnp.maximum(m, tile_max)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1
+        )
+        local = jnp.clip(targets - t0, 0, block - 1)
+        t_log = jnp.take_along_axis(
+            logits, local[:, None], axis=1
+        )[:, 0]
+        in_tile = (targets >= t0) & (targets < t0 + block)
+        tgt = jnp.where(in_tile, t_log, tgt)
+        return (m_new, s, tgt), None
+
+    init = (
+        jnp.full((n,), NEG_INF, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.full((n,), NEG_INF, jnp.float32),
+    )
+    (m, s, tgt), _ = jax.lax.scan(tile_step, init, (emb_t, tile0))
+    lse = m + jnp.log(s)
+    nll = lse - tgt
+    return nll, (x, emb, targets, lse)
+
+
+def _fused_ce_bwd(block, compute_dtype, res, g):
+    """g: (N,) cotangent of the NLL. dlogits = (softmax - onehot) * g,
+    recomputed per tile from the saved logsumexp; both backward matmuls
+    take compute-dtype operands (f32 accumulation) — never the promoted
+    f32 MXU path."""
+    x, emb, targets, lse = res
+    if compute_dtype is None:
+        compute_dtype = x.dtype
+    v, d = emb.shape
+    n = x.shape[0]
+    xc = x.astype(compute_dtype)
+    emb_t, n_tiles = _tiles(emb, block, compute_dtype)
+    tile0 = jnp.arange(n_tiles, dtype=jnp.int32) * block
+
+    def tile_step(dx, xs):
+        emb_tile, t0 = xs
+        logits = jnp.einsum(
+            "nd,vd->nv", xc, emb_tile,
+            preferred_element_type=jnp.float32,
+        )
+        cols = t0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(cols < v, logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])  # padded cols -> exp(-inf)=0
+        onehot = (cols == targets[:, None]).astype(jnp.float32)
+        dlog = ((p - onehot) * g[:, None]).astype(compute_dtype)
+        dx = dx + jnp.einsum(
+            "nv,vd->nd", dlog, emb_tile,
+            preferred_element_type=jnp.float32,
+        )
+        de_tile = jnp.einsum(
+            "nv,nd->vd", dlog, xc,
+            preferred_element_type=jnp.float32,
+        )
+        return dx, de_tile
+
+    dx, de_tiles = jax.lax.scan(
+        tile_step, jnp.zeros((n, d), jnp.float32), (emb_t, tile0)
+    )
+    de = de_tiles.reshape(n_tiles * block, d)[:v]
+    return (
+        dx.astype(x.dtype),
+        de.astype(emb.dtype),
+        jnp.zeros(targets.shape, jax.dtypes.float0),
+    )
+
+
+fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_lm_loss(hidden, embedding, tokens, segment_ids=None,
+                  block: int = 4096, compute_dtype=None):
+    """Next-token CE from PRE-HEAD hidden states (B, S, D): predict
+    tokens[:, 1:] from hidden[:, :-1] without ever materialising the
+    (B, S, vocab) logits. Packed-batch semantics identical to
+    ``transformer.lm_loss``: positions whose target falls in a
+    different document are excluded from the mean."""
+    b, s, d = hidden.shape
+    x = hidden[:, :-1].reshape(b * (s - 1), d)
+    targets = tokens[:, 1:].reshape(b * (s - 1))
+    nll = fused_ce(x, embedding, targets, block, compute_dtype)
+    if segment_ids is None:
+        return nll.mean()
+    valid = (segment_ids[:, 1:] == segment_ids[:, :-1]).reshape(-1)
+    valid = valid.astype(nll.dtype)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)

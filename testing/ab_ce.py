@@ -1,0 +1,77 @@
+"""Same-process LM train A/B: dense logits + optax CE vs the chunked
+fused cross-entropy head (ops/cross_entropy.py). Run on the real chip:
+
+    python -u testing/ab_ce.py
+
+Prints one JSON line per (batch, seq) config with both paths'
+tokens/s and the fused/dense speedup. Same-process comparison only
+(BASELINE.md variance note).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+CONFIGS = [
+    ("b4-s2048", dict(batch=4, seq=2048, steps=10, warmup=4)),
+    ("b1-s8192", dict(batch=1, seq=8192, steps=5, warmup=2)),
+    ("b1-s32768", dict(batch=1, seq=32768, steps=3, warmup=1)),
+]
+
+
+def measure(loss_impl, batch, seq, steps, warmup):
+    from kubeflow_tpu.models import (
+        LMConfig,
+        build_lm,
+        create_lm_state,
+        make_lm_train_step,
+    )
+
+    cfg = LMConfig(
+        vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16,
+        loss_impl=loss_impl,
+    )
+    model = build_lm(cfg)
+    state = create_lm_state(model, jax.random.key(0), (1, seq))
+    step = make_lm_train_step(cfg=cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab, size=(batch, seq)), jnp.int32
+    )
+    state, dt = bench.run_timed(step, state, {"tokens": tokens},
+                                warmup, steps)
+    return batch * seq * steps / dt, 1000 * dt / steps
+
+
+def main():
+    for name, kw in CONFIGS:
+        row = {"config": name}
+        for impl in ("dense", "fused"):
+            try:
+                tok_s, step_ms = measure(impl, **kw)
+                row[impl] = {"tokens_s": round(tok_s, 1),
+                             "step_ms": round(step_ms, 2)}
+            except Exception as exc:  # OOM at 32k dense is plausible
+                row[impl] = {"error": str(exc)[:200]}
+        if "tokens_s" in row.get("dense", {}) and \
+                "tokens_s" in row.get("fused", {}):
+            row["fused_speedup"] = round(
+                row["fused"]["tokens_s"] / row["dense"]["tokens_s"], 4
+            )
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
