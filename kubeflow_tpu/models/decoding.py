@@ -46,6 +46,7 @@ part of the compute stack in the jupyter-jax-tpu images.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any
 
 import jax
@@ -63,6 +64,40 @@ NEG_INF = -1e30
 # decode path, padding is pure wasted HBM traffic.
 DECODE_BLOCK = 256
 
+# Implementation selectors, read ONCE at import. They choose which
+# branch gets TRACED, so reading them lazily inside jitted code made a
+# later same-process env change silently do nothing (the jit cache keys
+# on shapes/dtypes, not env) — a trap for one-process A/Bs. Switching
+# now visibly requires a fresh process (or jax.clear_caches() plus
+# reassigning these module attributes before the next trace).
+#
+# KFT_DECODE_IMPL: "auto" (default) takes the Pallas flash-decode
+# kernel for long bf16 caches and the dense masked read otherwise;
+# "dense"/"kernel" force one path everywhere. The auto threshold and
+# the kernel's cache-block width come from the round-5 same-process
+# A/B on v5e (fat blocks amortise the per-grid-step cost that made the
+# round-4 256-block kernel lose; see BASELINE.md).
+DECODE_IMPL = os.environ.get("KFT_DECODE_IMPL", "auto")
+PREFILL_IMPL = os.environ.get("KFT_PREFILL_IMPL", "flash")
+if DECODE_IMPL not in ("auto", "dense", "kernel"):
+    raise ValueError(
+        f"KFT_DECODE_IMPL={DECODE_IMPL!r} must be auto|dense|kernel "
+        "(a typo here would silently A/B dense against dense)"
+    )
+if PREFILL_IMPL not in ("flash", "dense"):
+    raise ValueError(
+        f"KFT_PREFILL_IMPL={PREFILL_IMPL!r} must be flash|dense"
+    )
+# Round-5 same-process A/B on v5e (testing/ab_decode.py): the dense
+# read wins at p1024 (1345 vs 1204 tok/s) AND p8k (671 vs 649); the
+# 2048-block kernel wins at p32k (295 vs 256, +15%; 1024/4096 blocks
+# do not). Threshold sits between the 8k and 32k capacities.
+DECODE_KERNEL_MIN = int(os.environ.get("KFT_DECODE_KERNEL_MIN",
+                                       "16384"))
+DECODE_KERNEL_BLOCK = int(
+    os.environ.get("KFT_DECODE_KERNEL_BLOCK", "2048")
+)
+
 
 @dataclasses.dataclass
 class KVCache:
@@ -71,8 +106,9 @@ class KVCache:
     ``rolling=True`` (requires ``cfg.attn_window``) allocates a
     window-sized circular buffer instead: position p lives in slot
     ``p % capacity``, so memory stays O(window) no matter how far
-    generation runs. Only single-token steps and empty-cache prefill
-    write a rolling cache (exactly `generate`'s access pattern).
+    generation runs. Single-token steps, empty-cache prefill AND
+    mid-sequence chunks all write it — long prompts can prefill in
+    O(window)-memory chunks (``_rolling_chunk_attention``).
 
     ``empty`` is a STATIC (pytree-meta) flag: True only on the cache
     ``init`` returns, False on every cache ``forward_with_cache``
@@ -142,6 +178,92 @@ jax.tree_util.register_dataclass(
 )
 
 
+@dataclasses.dataclass
+class StackedDecodeParams:
+    """Decode-time view of the params pytree: per-layer weights stacked
+    on a leading layer axis, q/k/v kernels fused into one matmul, and
+    everything matmul-shaped pre-cast to the compute dtype.
+
+    Built to attack the round-4 "~0.5 ms/step fixed overhead" decode
+    diagnosis — and MEASURED SLOWER than the raw-pytree path on v5e in
+    the round-5 same-process A/B (testing/ab_decode.py: 1216 vs 1345
+    tok/s at b1-p1024 unrolled; the lax.scan variant 1143; p8k within
+    noise). XLA already hoists the f32->bf16 weight converts out of the
+    token scan, so pre-cast copies buy nothing, and the fused-qkv /
+    static-slice indirection costs a little. Kept as an OPT-IN
+    alternative execution shape (other chips, much deeper models — the
+    scan variant bounds program size at O(1) layers) rather than the
+    default; ``generate`` uses the raw pytree.
+
+    Build with :func:`stack_decode_params`; pass anywhere
+    ``forward_with_cache`` takes ``params``. Norm scales stay f32 (they
+    multiply an f32 normalised tensor).
+    """
+
+    norm0: jax.Array  # (L, D) f32
+    qkv: jax.Array    # (L, D, (H + 2*Hkv) * hd) compute dtype
+    proj: jax.Array   # (L, H*hd, D)
+    norm1: jax.Array  # (L, D) f32
+    up: jax.Array     # (L, D, F)
+    down: jax.Array   # (L, F, D)
+    embed: jax.Array  # (V, D) compute dtype (tied head reads it too)
+    final_norm: jax.Array  # (D,) f32
+    # Execute layers via lax.scan (one compiled body) or a Python loop
+    # over static slices of the same stacked arrays. Measured on v5e
+    # (same-process A/B, b1-p1024): the scan's ~30 us/layer while-loop
+    # overhead LOSES to the unrolled step at decode (1143 vs 1583
+    # tok/s) and only breaks even at p8k, so unrolled is the default;
+    # scan=True remains for very deep models where program size or
+    # compile time dominates.
+    scan: bool = False
+
+
+jax.tree_util.register_dataclass(
+    StackedDecodeParams,
+    data_fields=["norm0", "qkv", "proj", "norm1", "up", "down",
+                 "embed", "final_norm"],
+    meta_fields=["scan"],
+)
+
+
+def stack_decode_params(cfg: LMConfig, params: dict[str, Any],
+                        scan: bool = False) -> StackedDecodeParams:
+    """One-time restructure of the training params pytree for the
+    fused decode path. Pure jnp — usable inside or outside jit; do it
+    OUTSIDE any decode loop (generate() and bench do)."""
+    if cfg.moe_experts:
+        raise ValueError(
+            "MoE blocks are heterogeneous (dense FFN / MoE alternate); "
+            "the scanned decode path requires uniform layers - pass the "
+            "raw params pytree instead"
+        )
+    dt = cfg.dtype
+    blocks = [params[f"block_{i}"] for i in range(cfg.layers)]
+
+    def stack(name, sub="kernel", dtype=None):
+        arrs = [blk[name][sub] for blk in blocks]
+        out = jnp.stack(arrs)
+        return out.astype(dtype) if dtype is not None else out
+
+    qkv = jnp.stack([
+        jnp.concatenate([
+            blk["q_proj"]["kernel"], blk["k_proj"]["kernel"],
+            blk["v_proj"]["kernel"],
+        ], axis=1)
+        for blk in blocks
+    ]).astype(dt)
+    return StackedDecodeParams(
+        norm0=stack("RMSNorm_0", "scale"),
+        qkv=qkv,
+        proj=stack("proj", dtype=dt),
+        norm1=stack("RMSNorm_1", "scale"),
+        up=stack("up", dtype=dt),
+        down=stack("down", dtype=dt),
+        embed=params["embed"]["embedding"].astype(dt),
+        final_norm=params["final_norm"]["scale"],
+    )
+
+
 def _quantize_rows(x):
     """(B, Hkv, T, hd) -> int8 payload + per-row absmax scale
     (B, Hkv, T, 1). Symmetric per-row quantisation: row_max/127
@@ -184,23 +306,28 @@ def _decode_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
     For windowed models the ROLLING cache already bounds the read to
     O(window), which is the real long-generation fix.
 
-    ``KFT_DECODE_IMPL=kernel`` opts into the Pallas flash-decode
-    kernel (ops/decode_attention.py) for re-evaluation on hardware
-    where the launch-overhead balance differs (or much longer caches).
+    Dispatch (``DECODE_IMPL``, read once at import): "auto" uses the
+    Pallas flash-decode kernel for bf16 caches of capacity >=
+    ``DECODE_KERNEL_MIN`` — with ``DECODE_KERNEL_BLOCK``-wide cache
+    blocks the per-grid-step cost that sank the round-4 256-block
+    kernel amortises away and the kernel's O(filled ∧ window) traffic
+    wins at long caches — and the dense read below that.
+    "dense"/"kernel" force one path for A/B.
     """
-    import os
-
-    impl = os.environ.get("KFT_DECODE_IMPL", "dense")
     capacity = ck.shape[2]
-    if (impl == "kernel" and ks is None
-            and jax.default_backend() == "tpu"
-            and capacity % DECODE_BLOCK == 0):
+    use_kernel = (
+        ks is None and jax.default_backend() == "tpu"
+        and (DECODE_IMPL == "kernel"
+             or (DECODE_IMPL == "auto" and capacity >= DECODE_KERNEL_MIN))
+    )
+    if use_kernel:
         # The Pallas kernel reads the bf16 payload only; an int8 cache
         # always takes the dense path (its rescale fuses there).
         from kubeflow_tpu.ops.decode_attention import decode_attention
 
         return decode_attention(
-            q, ck, cv, pos, window=cfg.attn_window, block=DECODE_BLOCK,
+            q, ck, cv, pos, window=cfg.attn_window,
+            block=min(DECODE_KERNEL_BLOCK, capacity),
         )
     return _cached_attention(cfg, q, ck, cv, pos, 1, ks, vs)
 
@@ -277,6 +404,81 @@ def _cached_attention(cfg, q, ck, cv, pos, t, ks=None, vs=None):
     return out.reshape(b, h, t, hd).astype(q.dtype)
 
 
+def _rolling_chunk_attention(cfg, q, k, v, ck, cv, pos,
+                             ks=None, vs=None):
+    """Mid-sequence multi-token chunk over a ROLLING cache: one softmax
+    spanning both key sources — the circular buffer as it stood BEFORE
+    the chunk (slot j holds the newest position ≡ j (mod capacity)
+    that is < pos) and the chunk itself (causal + window). The write
+    happens after; writing first would evict positions the chunk's
+    earliest queries still need (for t > 1 the evicted range reaches
+    into the window). q: (B, H, T, hd); k/v: (B, Hkv, T, hd) fresh
+    chunk keys (unquantised — full precision where it is free);
+    ck/cv: (B, Hkv, capacity, hd) cache payload with optional per-row
+    int8 scales ks/vs."""
+    b, h, t, hd = q.shape
+    hkv, capacity = ck.shape[1], ck.shape[2]
+    group = h // hkv
+    window = cfg.attn_window
+    compute = q.dtype
+    qg = q.reshape(b, hkv, group, t, hd)
+    scale = hd ** -0.5
+
+    # Cache-side scores: (B, Hkv, G, T, capacity).
+    s_cache = jnp.einsum(
+        "bkgtd,bkld->bkgtl", qg, ck.astype(compute),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if ks is not None:
+        s_cache = s_cache * ks[..., 0][:, :, None, None, :]
+    slots = jax.lax.broadcasted_iota(jnp.int32, s_cache.shape, 4)
+    newest = pos - 1
+    cache_pos = newest - (newest - slots) % capacity
+    rows = pos + jax.lax.broadcasted_iota(jnp.int32, s_cache.shape, 3)
+    keep = jnp.logical_and(cache_pos >= 0, cache_pos > rows - window)
+    s_cache = jnp.where(keep, s_cache, NEG_INF)
+
+    # Chunk-side scores: causal + window within [pos, pos+t).
+    s_self = jnp.einsum(
+        "bkgtd,bkcd->bkgtc", qg, k.astype(compute),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    r = jax.lax.broadcasted_iota(jnp.int32, s_self.shape, 3)
+    c = jax.lax.broadcasted_iota(jnp.int32, s_self.shape, 4)
+    keep = jnp.logical_and(c <= r, c > r - window)
+    s_self = jnp.where(keep, s_self, NEG_INF)
+
+    w = jax.nn.softmax(
+        jnp.concatenate([s_cache, s_self], axis=-1), axis=-1
+    )
+    w_cache, w_self = w[..., :capacity], w[..., capacity:]
+    if vs is not None:
+        w_cache = w_cache * vs[..., 0][:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgtl,bkld->bkgtd", w_cache.astype(compute),
+        cv.astype(compute), preferred_element_type=jnp.float32,
+    ) + jnp.einsum(
+        "bkgtc,bkcd->bkgtd", w_self.astype(compute),
+        v.astype(compute), preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, h, t, hd).astype(q.dtype)
+
+
+def _write_rolling_chunk(cache_buf, chunk, pos, capacity):
+    """Scatter a mid-sequence chunk's tail into the circular buffer:
+    position p -> slot p % capacity, keeping only the last
+    min(t, capacity) positions (the rest are already evicted). ``pos``
+    may be a tracer, so the wrap split is data-dependent — a scatter
+    on computed slot indices handles it (once per chunk; the hot
+    single-token path keeps its dynamic_update_slice)."""
+    t = chunk.shape[2]
+    keep = min(t, capacity)
+    tail = chunk[:, :, t - keep:]
+    p0 = pos + (t - keep)
+    slots = (p0 + jnp.arange(keep, dtype=jnp.int32)) % capacity
+    return cache_buf.at[:, :, slots].set(tail)
+
+
 def _write_rolling_prefill(cache_buf, chunk, capacity):
     """Scatter the last ``capacity`` positions of an empty-cache prefill
     chunk into the circular buffer (slot = position % capacity). The
@@ -297,26 +499,15 @@ def _write_rolling_prefill(cache_buf, chunk, capacity):
     )
 
 
-def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
-                ks_buf=None, vs_buf=None, use_moe=False):
-    """One block over a (B, T, D) chunk at global offset ``pos``,
-    reading/updating this layer's (B, Hkv, capacity, hd) cache slices
-    (plus (B, Hkv, capacity, 1) scale slices for an int8 cache).
-    Mirrors transformer.Block exactly (same param names/shapes)."""
-    b, t, _ = x.shape
+def _attend_and_cache(cfg, q, k, v, ck, cv, pos, empty, rolling,
+                      ks_buf=None, vs_buf=None):
+    """The shared middle of one decode/prefill block: quantise the new
+    K/V if the cache is int8, write them at the right slots, and run
+    the attention variant the (t, empty, rolling) combination calls for
+    — all branches STATIC at trace time. q/k/v are (B, H[kv], T, hd)
+    post-rope. Returns (out (B, H, T, hd), ck, cv, ks_buf, vs_buf)."""
+    t = q.shape[2]
     quantized = ks_buf is not None
-    h = rms_norm(params["RMSNorm_0"]["scale"], x)
-    proj = lambda name: (h @ params[name]["kernel"].astype(cfg.dtype))
-    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
-
-    def heads(tensor, n):
-        return tensor.reshape(b, t, n, cfg.head_dim).transpose(0, 2, 1, 3)
-
-    q = heads(q, cfg.heads)
-    k = heads(k, cfg.num_kv_heads)
-    v = heads(v, cfg.num_kv_heads)
-    q = apply_rope(q, offset=pos)
-    k = apply_rope(k, offset=pos)
     capacity = ck.shape[2]
     if quantized:
         k_store, k_s = _quantize_rows(k)
@@ -346,10 +537,9 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
         # Empty-cache prefill (pos == 0 by the `empty` contract): the
         # chunk attends to itself through the training kernels on the
         # UNQUANTISED k/v (full precision where it is free); the cache
-        # write happens on the side. KFT_PREFILL_IMPL=dense forces the
-        # masked full-buffer read (A/B escape hatch).
-        import os
-
+        # write happens on the side. KFT_PREFILL_IMPL=dense (read once
+        # at import: PREFILL_IMPL) forces the masked full-buffer read
+        # (A/B escape hatch).
         if rolling:
             out = _prefill_attention(cfg, q, k, v)
             ck = _write_rolling_prefill(ck, k_store, capacity)
@@ -359,21 +549,54 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
                 vs_buf = _write_rolling_prefill(vs_buf, v_s, capacity)
         else:
             write(0)
-            if (os.environ.get("KFT_PREFILL_IMPL") == "dense"
-                    and not quantized):
+            if PREFILL_IMPL == "dense" and not quantized:
                 out = _cached_attention(cfg, q, ck, cv, pos, t)
             else:
                 out = _prefill_attention(cfg, q, k, v)
     else:
         # Mid-sequence multi-token chunk (chunked prefill): dense
-        # masked read of the filled buffer.
+        # masked read of the filled buffer; on a rolling cache, one
+        # softmax over (pre-write circular buffer + the chunk itself),
+        # then the chunk's tail scatters into the ring — long prompts
+        # prefill in O(window)-memory chunks (round-4 verdict Next #5).
         if rolling:
-            raise ValueError(
-                "chunked prefill on a rolling cache is not supported; "
-                "prefill the prompt in one chunk (generate() does)"
+            out = _rolling_chunk_attention(
+                cfg, q, k, v, ck, cv, pos, ks_buf, vs_buf
             )
-        write(pos)
-        out = _cached_attention(cfg, q, ck, cv, pos, t, ks_buf, vs_buf)
+            ck = _write_rolling_chunk(ck, k_store, pos, capacity)
+            cv = _write_rolling_chunk(cv, v_store, pos, capacity)
+            if quantized:
+                ks_buf = _write_rolling_chunk(ks_buf, k_s, pos, capacity)
+                vs_buf = _write_rolling_chunk(vs_buf, v_s, pos, capacity)
+        else:
+            write(pos)
+            out = _cached_attention(cfg, q, ck, cv, pos, t, ks_buf,
+                                    vs_buf)
+    return out, ck, cv, ks_buf, vs_buf
+
+
+def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
+                ks_buf=None, vs_buf=None, use_moe=False):
+    """One block over a (B, T, D) chunk at global offset ``pos``,
+    reading/updating this layer's (B, Hkv, capacity, hd) cache slices
+    (plus (B, Hkv, capacity, 1) scale slices for an int8 cache).
+    Mirrors transformer.Block exactly (same param names/shapes)."""
+    b, t, _ = x.shape
+    h = rms_norm(params["RMSNorm_0"]["scale"], x)
+    proj = lambda name: (h @ params[name]["kernel"].astype(cfg.dtype))
+    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+
+    def heads(tensor, n):
+        return tensor.reshape(b, t, n, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q = heads(q, cfg.heads)
+    k = heads(k, cfg.num_kv_heads)
+    v = heads(v, cfg.num_kv_heads)
+    q = apply_rope(q, offset=pos)
+    k = apply_rope(k, offset=pos)
+    out, ck, cv, ks_buf, vs_buf = _attend_and_cache(
+        cfg, q, k, v, ck, cv, pos, empty, rolling, ks_buf, vs_buf
+    )
     out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
     x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
 
@@ -392,13 +615,74 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     return x, ck, cv, ks_buf, vs_buf
 
 
+def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache):
+    """Fused decode forward over stacked params: one qkv matmul per
+    layer, q+k roped in one call, weights pre-cast to the compute
+    dtype. Layers run unrolled by default (sp.scan docs the measured
+    tradeoff) or via lax.scan. Semantics identical to the raw-pytree
+    path — same attention/cache helpers, branch-for-branch (the parity
+    test pins logits and cache equal)."""
+    pos = cache.length
+    b, t = tokens.shape
+    quantized = cache.quantized
+    hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
+    x = sp.embed[tokens]  # already the compute dtype
+
+    def layer(x, xs):
+        if quantized:
+            n0, qkv_k, proj_k, n1, up_k, down_k, ck, cv, ksb, vsb = xs
+        else:
+            n0, qkv_k, proj_k, n1, up_k, down_k, ck, cv = xs
+            ksb = vsb = None
+        h = rms_norm(n0, x)
+        qkv = (h @ qkv_k).reshape(b, t, hq + 2 * hkv, hd)
+        qkv = qkv.transpose(0, 2, 1, 3)  # (B, hq+2*hkv, T, hd)
+        qk = apply_rope(qkv[:, :hq + hkv], offset=pos)
+        q, k = qk[:, :hq], qk[:, hq:]
+        v = qkv[:, hq + hkv:]
+        out, ck, cv, ksb, vsb = _attend_and_cache(
+            cfg, q, k, v, ck, cv, pos, cache.empty, cache.rolling,
+            ksb, vsb,
+        )
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
+        x = x + out @ proj_k
+        h = rms_norm(n1, x)
+        x = x + jax.nn.gelu(h @ up_k) @ down_k
+        return x, (ck, cv, ksb, vsb) if quantized else (ck, cv)
+
+    xs = (sp.norm0, sp.qkv, sp.proj, sp.norm1, sp.up, sp.down,
+          cache.k, cache.v)
+    if quantized:
+        xs += (cache.k_scale, cache.v_scale)
+    if sp.scan:
+        x, ys = jax.lax.scan(layer, x, xs)
+    else:
+        out_layers = []
+        for i in range(cfg.layers):
+            x, y = layer(x, tuple(arr[i] for arr in xs))
+            out_layers.append(y)
+        ys = tuple(jnp.stack(parts) for parts in zip(*out_layers))
+    x = rms_norm(sp.final_norm, x)
+    logits = tied_head(x, sp.embed, cfg.dtype)
+    new_cache = KVCache(
+        k=ys[0], v=ys[1], length=pos + t,
+        k_scale=ys[2] if quantized else None,
+        v_scale=ys[3] if quantized else None,
+        rolling=cache.rolling, empty=False,
+    )
+    return logits, new_cache
+
+
 def forward_with_cache(
-    cfg: LMConfig, params: dict[str, Any], tokens: jax.Array,
-    cache: KVCache,
+    cfg: LMConfig, params: dict[str, Any] | StackedDecodeParams,
+    tokens: jax.Array, cache: KVCache,
 ):
     """Run ``tokens`` (B, T) through the model starting at the cache's
     current length; returns (logits (B, T, vocab) f32, updated cache).
-    T is the prefill chunk (or 1 during decode).
+    T is the prefill chunk (or 1 during decode). ``params`` is either
+    the training pytree (unrolled per-layer loop — the production
+    path) or a :class:`StackedDecodeParams` (opt-in fused/stacked
+    execution shape; see its docstring for the measured tradeoff).
 
     Contract: ``cache.length + T`` must not exceed the cache's max_len
     — ``dynamic_update_slice`` would CLAMP an overflowing write (JAX
@@ -417,6 +701,8 @@ def forward_with_cache(
             f"cache overflow: length {concrete_pos} + {tokens.shape[1]} "
             f"new tokens > max_len {max_len}"
         )
+    if isinstance(params, StackedDecodeParams):
+        return _forward_stacked(cfg, params, tokens, cache)
     emb = params["embed"]["embedding"]
     x = emb[tokens].astype(cfg.dtype)
     quantized = cache.quantized

@@ -43,7 +43,8 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, block, window):
+                   m_scr, l_scr, acc_scr, *, scale, block, window,
+                   capacity):
     j = pl.program_id(1)
     pos = pos_ref[0]
     hi = pos // block
@@ -77,8 +78,18 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur)
         l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0]
+        if capacity % block:
+            # Ragged tail: out-of-bounds v lanes are undefined (NaN in
+            # interpret mode) and 0 * NaN = NaN would poison the PV
+            # matmul even though p is 0 there — zero them explicitly.
+            # Statically skipped when the capacity divides the block.
+            rows_pos = j * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, 1), 0
+            )
+            v = jnp.where(rows_pos < capacity, v, 0.0)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
@@ -95,18 +106,17 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 def decode_attention(q, k_cache, v_cache, pos, *, window=None,
                      block=512, interpret=None):
     """q: (B, H, 1, hd) at global position ``pos`` (scalar int32);
-    k/v_cache: (B, Hkv, capacity, hd) with rows [0, pos] filled and
-    capacity a multiple of ``block``. Masking: col <= pos, and
-    col > pos - window when ``window`` is set. Returns (B, H, 1, hd).
+    k/v_cache: (B, Hkv, capacity, hd) with rows [0, pos] filled.
+    Capacity need not divide ``block``: the grid rounds up and the
+    ragged tail block's out-of-bounds lanes are NEG_INF-masked by the
+    ``col <= pos`` predicate (pos < capacity by the cache contract).
+    Masking: col <= pos, and col > pos - window when ``window`` is
+    set. Returns (B, H, 1, hd).
     """
     b, h, t, hd = q.shape
     if t != 1:
         raise ValueError(f"decode_attention takes one token, got t={t}")
     hkv, capacity = k_cache.shape[1], k_cache.shape[2]
-    if capacity % block:
-        raise ValueError(
-            f"cache capacity {capacity} not a multiple of block {block}"
-        )
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     group = h // hkv
@@ -133,10 +143,11 @@ def decode_attention(q, k_cache, v_cache, pos, *, window=None,
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=scale, block=block, window=window,
+            capacity=capacity,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b * hkv, capacity // block),
+            grid=(b * hkv, -(-capacity // block)),
             in_specs=[
                 pl.BlockSpec((1, rows, hd),
                              lambda bi, j, pos_arr: (bi, 0, 0)),

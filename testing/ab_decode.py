@@ -1,0 +1,84 @@
+"""Same-process decode A/B across implementation arms. Same-process
+comparison is the only trustworthy one on this relay — cross-process b1
+decode swings 15-25% (BASELINE.md variance note). Run on the real chip:
+
+    python -u testing/ab_decode.py [config ...]
+
+Arms (per config, traced fresh per call so module-constant overrides
+take effect):
+  base          round-4 production: raw params pytree + dense read
+  fused         StackedDecodeParams (fused qkv, pre-cast bf16, no scan)
+                + dense read
+  fused-scan    same but lax.scan over layers
+  kernel-<B>    fused + Pallas flash-decode, cache block B
+                (bf16 non-rolling configs only)
+
+Prints one JSON line per config with decode/prefill tok/s per arm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from kubeflow_tpu.models import decoding  # noqa: E402
+
+CONFIGS = {
+    "b1-p1024": dict(batch=1, prompt_len=1024, new_tokens=256),
+    "b1-p8k": dict(batch=1, prompt_len=8192, new_tokens=128),
+    "b1-p8k-w1k": dict(batch=1, prompt_len=8192, new_tokens=128,
+                       window=1024),
+    "b8-p8k": dict(batch=8, prompt_len=8192, new_tokens=64),
+    "b8-p8k-int8": dict(batch=8, prompt_len=8192, new_tokens=64,
+                        quantized=True),
+    "b1-p32k": dict(batch=1, prompt_len=32768, new_tokens=64),
+}
+
+KERNEL_BLOCKS = (1024, 2048, 4096)
+
+
+def run_arm(kw, path, impl, block=None):
+    os.environ["KFT_BENCH_DECODE_PATH"] = path
+    decoding.DECODE_IMPL = impl
+    if block is not None:
+        decoding.DECODE_KERNEL_BLOCK = block
+    r = bench.bench_decode(prefill_anchor=None, decode_anchor=None,
+                           **kw)
+    return {
+        "decode_tok_s": r["value"],
+        "step_ms": r["decode_step_ms"],
+        "prefill_tok_s": r["prefill_tokens_per_sec"],
+    }
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    for name in names:
+        kw = CONFIGS[name]
+        row = {"config": name}
+        row["base"] = run_arm(kw, "unrolled", "dense")
+        row["fused"] = run_arm(kw, "stacked", "dense")
+        kernel_ok = not kw.get("quantized") and not kw.get("window")
+        if kernel_ok:
+            for block in KERNEL_BLOCKS:
+                row[f"kernel-{block}"] = run_arm(
+                    kw, "stacked", "kernel", block
+                )
+        best = max(
+            (k for k in row if k != "config"),
+            key=lambda k: row[k]["decode_tok_s"],
+        )
+        row["best"] = best
+        row["best_speedup"] = round(
+            row[best]["decode_tok_s"] / row["base"]["decode_tok_s"], 4
+        )
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Decompose the b1 decode step's time on the real chip: which part of
+the ~(step - weight-streaming-floor) overhead belongs to what. Arms
+build up from bare weight streaming to the full step, all timed as a
+256-iteration lax.scan inside one dispatch (relay-floor amortised),
+median of 3.
+
+    python -u testing/ab_decode_floor.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.models import LMConfig, build_lm  # noqa: E402
+from kubeflow_tpu.models.decoding import (  # noqa: E402
+    KVCache,
+    forward_with_cache,
+)
+from kubeflow_tpu.models.transformer import rms_norm, tied_head  # noqa: E402
+from kubeflow_tpu.ops import apply_rope  # noqa: E402
+
+STEPS = 256
+REPS = 3
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    float(np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[0])
+    dts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        float(np.asarray(
+            jax.device_get(jax.tree.leaves(out)[0])
+        ).ravel()[0])
+        dts.append(time.perf_counter() - t0)
+    return float(np.median(dts)) / STEPS * 1000  # ms/step
+
+
+def main():
+    cfg = LMConfig(vocab=32768, layers=8, dim=1024, heads=8, kv_heads=2,
+                   dtype=jnp.bfloat16)
+    model = build_lm(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, size=(1, 1024)),
+                         jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+    bf16 = lambda a: a.astype(jnp.bfloat16)
+    blocks = [params[f"block_{i}"] for i in range(cfg.layers)]
+    w = [
+        {k: bf16(blk[k]["kernel"])
+         for k in ("q_proj", "k_proj", "v_proj", "proj", "up", "down")}
+        for blk in blocks
+    ]
+    emb = bf16(params["embed"]["embedding"])
+    x0 = jnp.zeros((1, 1, cfg.dim), jnp.bfloat16)
+
+    @jax.jit
+    def arm_matmuls(w, emb, x0):
+        # Bare weight streaming: the 6 block matmuls x 8 + the head.
+        def step(x, _):
+            for blk in w:
+                q = x @ blk["q_proj"]
+                k = x @ blk["k_proj"]
+                v = x @ blk["v_proj"]
+                x = x + q @ blk["proj"]
+                h = jax.nn.gelu(x @ blk["up"])
+                x = x + h @ blk["down"] + jnp.sum(k) + jnp.sum(v)
+            logits = tied_head(x, emb, jnp.bfloat16)
+            out = x * 0.999 + logits[..., :1, :1024] * 1e-6
+            return out.astype(x.dtype), None
+
+        x, _ = jax.lax.scan(step, x0, None, length=STEPS)
+        return x
+
+    @jax.jit
+    def arm_matmuls_fused_qkv(w, emb, x0):
+        def step(x, _):
+            for blk in w:
+                qkv = x @ jnp.concatenate(
+                    [blk["q_proj"], blk["k_proj"], blk["v_proj"]],
+                    axis=1,
+                )
+                x = x + qkv[..., :1024] @ blk["proj"]
+                h = jax.nn.gelu(x @ blk["up"])
+                x = x + h @ blk["down"] + jnp.sum(qkv[..., 1024:])
+            logits = tied_head(x, emb, jnp.bfloat16)
+            out = x * 0.999 + logits[..., :1, :1024] * 1e-6
+            return out.astype(x.dtype), None
+
+        x, _ = jax.lax.scan(step, x0, None, length=STEPS)
+        return x
+
+    @jax.jit
+    def arm_norms_rope(w, emb, x0):
+        # + norms and rope (no cache, no attention softmax).
+        scales = [
+            (blocks[i]["RMSNorm_0"]["scale"],
+             blocks[i]["RMSNorm_1"]["scale"])
+            for i in range(cfg.layers)
+        ]
+
+        def step(x, _):
+            for blk, (s0, s1) in zip(w, scales):
+                h = rms_norm(s0, x)
+                q = h @ blk["q_proj"]
+                k = h @ blk["k_proj"]
+                qh = q.reshape(1, 1, 8, 128).transpose(0, 2, 1, 3)
+                kh = k.reshape(1, 1, 2, 128).transpose(0, 2, 1, 3)
+                qh = apply_rope(qh, offset=100)
+                kh = apply_rope(kh, offset=100)
+                v = h @ blk["v_proj"]
+                x = x + qh.transpose(0, 2, 1, 3).reshape(1, 1, 1024) \
+                    @ blk["proj"]
+                h2 = rms_norm(s1, x)
+                g = jax.nn.gelu(h2 @ blk["up"])
+                x = x + g @ blk["down"] + jnp.sum(kh) + jnp.sum(v)
+            logits = tied_head(rms_norm(
+                params["final_norm"]["scale"], x), emb, jnp.bfloat16)
+            out = x * 0.999 + logits[..., :1, :1024] * 1e-6
+            return out.astype(x.dtype), None
+
+        x, _ = jax.lax.scan(step, x0, None, length=STEPS)
+        return x
+
+    results = {
+        "matmuls_only_ms": timed(arm_matmuls, w, emb, x0),
+        "matmuls_fused_qkv_ms": timed(arm_matmuls_fused_qkv, w, emb,
+                                      x0),
+        "plus_norms_rope_ms": timed(arm_norms_rope, w, emb, x0),
+    }
+
+    # Full production step at p1024 for reference, same process.
+    cache0 = KVCache.init(cfg, 1, 1024 + STEPS)
+    _, cache = forward_with_cache(cfg, params, prompt, cache0)
+    tok = jnp.zeros((1,), jnp.int32)
+
+    @jax.jit
+    def arm_full(params, tok, cache):
+        def step(carry, _):
+            tok, cache = carry
+            logits, cache = forward_with_cache(
+                cfg, params, tok[:, None], cache
+            )
+            return (jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
+                    cache), None
+
+        (tok, cache), _ = jax.lax.scan(
+            step, (tok, cache), None, length=STEPS
+        )
+        return tok
+
+    results["full_step_p1024_ms"] = timed(arm_full, params, tok, cache)
+    print(json.dumps({k: round(v, 4) for k, v in results.items()}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""The driver captures the TAIL (~2000 chars) of bench.py stdout.
+
+Round 4's full record was 3.5k chars and arrived truncated with
+``parsed: null`` in BENCH_r04.json — the flagship sections fell out of
+the official artifact. The contract now: full record → committed file,
+stdout → one compact line. These tests pin the compact line's size
+budget and completeness against the real (oversized) round-4 record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import bench
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+R04 = os.path.join(HERE, "..", "testing", "bench_quiet_r04.json")
+
+# The ordered section list main() benches (bench.py sections table).
+SECTION_NAMES = [
+    "lm_train_tokens_per_sec_per_chip",
+    "lm_long_context_tokens_per_sec_per_chip",
+    "lm_long_context_32k_tokens_per_sec_per_chip",
+    "lm_sliding_window_tokens_per_sec_per_chip",
+    "lm_decode_tokens_per_sec_per_chip[b1]",
+    "lm_decode_tokens_per_sec_per_chip[b8]",
+    "lm_moe_tokens_per_sec_per_chip",
+    "lm_moe_ec_tokens_per_sec_per_chip",
+    "lm_decode_tokens_per_sec_per_chip[b1-p8k]",
+    "lm_decode_tokens_per_sec_per_chip[b1-p32k]",
+    "lm_decode_tokens_per_sec_per_chip[b8-p8k]",
+    "lm_decode_tokens_per_sec_per_chip[b8-p8k-int8]",
+    "lm_decode_tokens_per_sec_per_chip[b1-p8k-w1k]",
+]
+
+
+def _r04_record():
+    with open(R04) as fh:
+        return json.load(fh)
+
+
+def test_compact_line_fits_driver_window():
+    record = _r04_record()
+    assert len(json.dumps(record)) > 2000  # the problem being solved
+    compact = bench.compact_record(
+        record, SECTION_NAMES, "testing/bench_full.json"
+    )
+    line = json.dumps(compact)
+    # Budget with headroom: the driver window is ~2000; extra future
+    # sections (~45 chars each) must not silently push past it either.
+    assert len(line) < 1700, f"compact line {len(line)} chars: {line}"
+
+
+def test_compact_line_carries_every_section_vs_baseline():
+    compact = bench.compact_record(
+        _r04_record(), SECTION_NAMES, "testing/bench_full.json"
+    )
+    # Primary-metric driver contract keys survive verbatim.
+    assert compact["metric"] == "resnet50_train_images_per_sec_per_chip"
+    assert isinstance(compact["value"], float)
+    assert isinstance(compact["vs_baseline"], float)
+    assert compact["unit"] == "images/sec/chip"
+    assert compact["full_record"] == "testing/bench_full.json"
+    sections = compact["sections"]
+    assert len(sections) == len(SECTION_NAMES)
+    for name in SECTION_NAMES:
+        key = (name.replace("lm_", "", 1)
+                   .replace("_tokens_per_sec_per_chip", ""))
+        row = sections[key]
+        assert row["v"] > 0
+        assert row["vs"] > 0
+        if "decode" in key:
+            assert row["pvs"] > 0
+
+
+def test_compact_line_records_failed_sections_by_name():
+    record = _r04_record()
+    record["extra_metrics"][2] = {
+        "metric": "bench_extra_error",
+        "section": SECTION_NAMES[2],
+        "attempts": 3,
+        "error": "x" * 500,
+    }
+    compact = bench.compact_record(
+        record, SECTION_NAMES, "testing/bench_full.json"
+    )
+    row = compact["sections"]["long_context_32k"]
+    assert row == {"err": "x" * 60}  # bounded, attributable

@@ -197,6 +197,86 @@ def test_rolling_generate_matches_full_cache_generate():
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
 
 
+def test_chunked_prefill_on_rolling_cache_matches_one_shot():
+    """Mid-sequence chunks on the rolling cache: chunk boundaries that
+    cross the ring's wrap point must not change a single logit vs
+    one-shot prefill, and the subsequent decode must match the full
+    forward."""
+    cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4, kv_heads=2,
+                   attn_window=5)
+    model, params, tokens = _setup(cfg, seq=16)
+    full = model.apply({"params": params}, tokens)
+    one = KVCache.init(cfg, tokens.shape[0], 16, rolling=True)
+    lo, one = forward_with_cache(cfg, params, tokens[:, :12], one)
+    for splits in ([4, 12], [4, 7, 12], [2, 3, 12], [6, 11, 12]):
+        chunked = KVCache.init(cfg, tokens.shape[0], 16, rolling=True)
+        prev = 0
+        for end in splits:
+            lc, chunked = forward_with_cache(
+                cfg, params, tokens[:, prev:end], chunked
+            )
+            prev = end
+        np.testing.assert_allclose(
+            np.asarray(lc[:, -1]), np.asarray(lo[:, -1]),
+            rtol=1e-4, atol=1e-4, err_msg=f"splits {splits}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(chunked.k), np.asarray(one.k),
+            rtol=1e-4, atol=1e-4, err_msg=f"splits {splits} cache",
+        )
+        # Decode afterwards stays exact against the full forward.
+        cache = chunked
+        for t in range(12, 16):
+            logits, cache = forward_with_cache(
+                cfg, params, tokens[:, t:t + 1], cache
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"splits {splits} decode pos {t}",
+            )
+
+
+def test_chunked_prefill_rolling_quantized_and_stacked():
+    """The chunked rolling path composes with the int8 cache and the
+    scanned stacked params."""
+    from kubeflow_tpu.models.decoding import stack_decode_params
+
+    cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4, kv_heads=2,
+                   attn_window=5)
+    _, params, tokens = _setup(cfg, seq=12)
+    sp = stack_decode_params(cfg, params)
+    ref = KVCache.init(cfg, tokens.shape[0], 12, rolling=True)
+    lr, ref = forward_with_cache(cfg, params, tokens[:, :10], ref)
+    # Stacked params, chunked.
+    cs = KVCache.init(cfg, tokens.shape[0], 12, rolling=True)
+    _, cs = forward_with_cache(cfg, sp, tokens[:, :4], cs)
+    ls, cs = forward_with_cache(cfg, sp, tokens[:, 4:10], cs)
+    np.testing.assert_allclose(
+        np.asarray(ls[:, -1]), np.asarray(lr[:, -1]),
+        rtol=1e-4, atol=1e-4,
+    )
+    # Quantized rolling cache, chunked vs one-shot (same quantisation
+    # error on both sides, so the comparison stays tight).
+    q1 = KVCache.init(cfg, tokens.shape[0], 12, rolling=True,
+                      quantized=True)
+    lq1, q1 = forward_with_cache(cfg, params, tokens[:, :10], q1)
+    q2 = KVCache.init(cfg, tokens.shape[0], 12, rolling=True,
+                      quantized=True)
+    _, q2 = forward_with_cache(cfg, params, tokens[:, :4], q2)
+    lq2, q2 = forward_with_cache(cfg, params, tokens[:, 4:10], q2)
+    np.testing.assert_allclose(
+        np.asarray(lq2[:, -1]), np.asarray(lq1[:, -1]),
+        rtol=2e-3, atol=2e-3,
+    )
+    # int8 payloads may differ by 1 LSB where the chunk-shaped matmul's
+    # reduction order moves a value across a rounding boundary.
+    np.testing.assert_allclose(
+        np.asarray(q1.k).astype(np.int32),
+        np.asarray(q2.k).astype(np.int32), atol=1,
+    )
+
+
 def test_rolling_cache_requires_window():
     cfg = CONFIGS["dense"]
     with pytest.raises(ValueError, match="attn_window"):
@@ -221,6 +301,91 @@ def test_flash_decode_nonmultiple_capacity():
         np.testing.assert_allclose(
             logits[:, 0], full[:, t], rtol=1e-4, atol=1e-4,
         )
+
+
+class TestStackedDecodeParams:
+    """The scanned fused decode path (stack_decode_params +
+    lax.scan-over-layers) must be branch-for-branch equal to the
+    unrolled per-layer loop: same logits, same cache contents."""
+
+    def _stacked(self, cfg, params):
+        from kubeflow_tpu.models.decoding import stack_decode_params
+
+        return stack_decode_params(cfg, params)
+
+    @pytest.mark.parametrize("name", sorted(CONFIGS))
+    def test_matches_unrolled_path(self, name):
+        cfg = CONFIGS[name]
+        _, params, tokens = _setup(cfg, seq=12)
+        sp = self._stacked(cfg, params)
+        cu = KVCache.init(cfg, tokens.shape[0], 12)
+        cs = KVCache.init(cfg, tokens.shape[0], 12)
+        lu, cu = forward_with_cache(cfg, params, tokens[:, :8], cu)
+        ls, cs = forward_with_cache(cfg, sp, tokens[:, :8], cs)
+        np.testing.assert_allclose(
+            np.asarray(ls), np.asarray(lu), rtol=2e-4, atol=2e-4
+        )
+        for t in range(8, 12):
+            lu, cu = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        cu)
+            ls, cs = forward_with_cache(cfg, sp, tokens[:, t:t + 1], cs)
+            np.testing.assert_allclose(
+                np.asarray(ls), np.asarray(lu), rtol=2e-4, atol=2e-4,
+                err_msg=f"stacked decode position {t}",
+            )
+        np.testing.assert_allclose(
+            np.asarray(cs.k), np.asarray(cu.k), rtol=2e-4, atol=2e-4
+        )
+        assert int(cs.length) == int(cu.length)
+
+    def test_rolling_cache(self):
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4, kv_heads=2,
+                       attn_window=5)
+        _, params, tokens = _setup(cfg, seq=14)
+        sp = self._stacked(cfg, params)
+        cu = KVCache.init(cfg, tokens.shape[0], 14, rolling=True)
+        cs = KVCache.init(cfg, tokens.shape[0], 14, rolling=True)
+        _, cu = forward_with_cache(cfg, params, tokens[:, :6], cu)
+        _, cs = forward_with_cache(cfg, sp, tokens[:, :6], cs)
+        for t in range(6, 14):
+            lu, cu = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        cu)
+            ls, cs = forward_with_cache(cfg, sp, tokens[:, t:t + 1], cs)
+            np.testing.assert_allclose(
+                np.asarray(ls), np.asarray(lu), rtol=2e-4, atol=2e-4,
+                err_msg=f"rolling stacked position {t}",
+            )
+
+    def test_quantized_cache(self):
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4, kv_heads=2)
+        _, params, tokens = _setup(cfg, seq=10)
+        sp = self._stacked(cfg, params)
+        cu = KVCache.init(cfg, tokens.shape[0], 10, quantized=True)
+        cs = KVCache.init(cfg, tokens.shape[0], 10, quantized=True)
+        lu, cu = forward_with_cache(cfg, params, tokens[:, :6], cu)
+        ls, cs = forward_with_cache(cfg, sp, tokens[:, :6], cs)
+        np.testing.assert_allclose(
+            np.asarray(ls), np.asarray(lu), rtol=2e-4, atol=2e-4
+        )
+        for t in range(6, 10):
+            lu, cu = forward_with_cache(cfg, params, tokens[:, t:t + 1],
+                                        cu)
+            ls, cs = forward_with_cache(cfg, sp, tokens[:, t:t + 1], cs)
+            np.testing.assert_allclose(
+                np.asarray(ls), np.asarray(lu), rtol=2e-4, atol=2e-4,
+                err_msg=f"quantized stacked position {t}",
+            )
+        np.testing.assert_array_equal(np.asarray(cs.k),
+                                      np.asarray(cu.k))
+
+    def test_moe_rejected(self):
+        from kubeflow_tpu.models.decoding import stack_decode_params
+
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=4,
+                       moe_experts=4, moe_every=2)
+        _, params, _ = _setup(cfg, seq=8)
+        with pytest.raises(ValueError, match="uniform"):
+            stack_decode_params(cfg, params)
 
 
 def test_cache_overflow_rejected():
@@ -290,14 +455,15 @@ class TestDecodeKernel:
     def test_last_position(self):
         self._case(pos=1023)
 
+    def test_ragged_capacity(self):
+        # Capacity not a multiple of the block: the grid rounds up and
+        # the tail block's out-of-bounds lanes are masked by col<=pos.
+        self._case(capacity=700, pos=650, block=512)
+        self._case(capacity=700, pos=100, block=512)
+
     def test_validation(self):
         from kubeflow_tpu.ops.decode_attention import decode_attention
 
-        q = jnp.zeros((1, 2, 1, 128))
-        kc = jnp.zeros((1, 2, 700, 128))
-        with pytest.raises(ValueError, match="multiple"):
-            decode_attention(q, kc, kc, jnp.int32(0), block=512,
-                             interpret=True)
         with pytest.raises(ValueError, match="one token"):
             decode_attention(jnp.zeros((1, 2, 2, 128)),
                              jnp.zeros((1, 2, 512, 128)),
