@@ -294,6 +294,57 @@ def create_app(
         return {}
 
     @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>/yaml",
+        methods=["PUT"],
+    )
+    def put_notebook_yaml(request, namespace, name):
+        """Editor-widget apply path: full-resource replace with a
+        server-side dry-run option. The client parses the YAML and
+        sends JSON ({"resource": {...}, "dryRun": bool}); the server
+        pins identity (kind/name/namespace cannot be edited into
+        something else) and forwards to the apiserver, whose
+        ``?dryRun=All`` validates + admits without persisting —
+        the guarded half of the edit -> dry-run -> apply flow
+        (reference kit editor module)."""
+        ensure(app.authorizer, request.user, "update", "kubeflow.org",
+               "notebooks", namespace)
+        body = request.get_json(silent=True)
+        if not isinstance(body, dict) or not isinstance(
+                body.get("resource"), dict):
+            raise ApiError("body must be {'resource': {...}}")
+        res = body["resource"]
+        meta = res.get("metadata") or {}
+        if not isinstance(meta, dict):
+            raise ApiError("resource.metadata must be a mapping")
+        if (res.get("kind", "Notebook") != "Notebook"
+                or res.get("apiVersion", NOTEBOOK_API) != NOTEBOOK_API
+                or meta.get("name", name) != name
+                or meta.get("namespace", namespace) != namespace):
+            raise ApiError(
+                "resource identity (apiVersion/kind/name/namespace) "
+                "cannot be changed through the editor"
+            )
+        res.setdefault("apiVersion", NOTEBOOK_API)
+        res.setdefault("kind", "Notebook")
+        # Not setdefault: an explicit `metadata: null` in the edited
+        # YAML would be returned as-is and crash the writes below.
+        res["metadata"] = meta = dict(meta)
+        meta["name"], meta["namespace"] = name, namespace
+        dry = bool(body.get("dryRun"))
+        try:
+            updated = api.update(res, dry_run=dry)
+        except NotFound:
+            raise ApiError(f"notebook {name!r} not found", 404)
+        except K8sError as exc:
+            # Preserve the apiserver's status: 409 is only CONFLICT;
+            # validation (422) and RBAC (403) must not be relabelled.
+            raise ApiError(
+                f"{'dry-run' if dry else 'apply'} rejected: {exc}",
+                getattr(exc, "code", None) or 409,
+            )
+        return {"dryRun": dry, "notebook": notebook_view(updated)}
+
+    @app.route(
         "/api/namespaces/<namespace>/notebooks/<name>", methods=["DELETE"]
     )
     def delete_notebook(request, namespace, name):

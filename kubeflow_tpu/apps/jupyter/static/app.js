@@ -120,8 +120,19 @@
          : 'none'],
        ['Created', d.processed.age || '—'],
        ['Message', d.processed.status.message || '—']]);
-    pane.appendChild(KF.el('h3', { text: KF.t('Raw resource') }));
-    pane.appendChild(KF.yamlPane(d.notebook));
+  }
+
+  function renderYaml(pane, d, name) {
+    // Editable raw resource: parse-on-input validation, then a
+    // guarded apply — server-side dry-run first, real PUT only after
+    // it passes (the backend pins kind/name/namespace).
+    pane.appendChild(KF.yamlEditor(d.notebook, {
+      apply: function (resource, dryRun) {
+        return KF.send('PUT', nbUrl(name) + '/yaml',
+                       { resource: resource, dryRun: dryRun });
+      },
+      onSaved: function () { showDetails(name); },
+    }));
   }
 
   function renderConditions(pane, d) {
@@ -204,6 +215,7 @@
           { name: 'Conditions', render: function (p) { renderConditions(p, d); } },
           { name: 'Events', render: function (p) { renderEvents(p, name); } },
           { name: 'Logs', render: function (p) { renderLogs(p, name); } },
+          { name: 'YAML', render: function (p) { renderYaml(p, d, name); } },
         ]);
         show(detailsView);
       })
@@ -222,9 +234,12 @@
 
     root.appendChild(KF.el('h2', { text: KF.t('New Notebook') }));
 
-    root.appendChild(KF.el('label', { text: KF.t('Name') }));
-    f.name = KF.el('input', { type: 'text', placeholder: 'my-notebook' });
-    root.appendChild(f.name);
+    var V = KF.form.validators;
+    f.name = KF.form.field({
+      label: KF.t('Name'), placeholder: 'my-notebook',
+      validators: [V.required, V.dns1123],
+    });
+    root.appendChild(f.name.root);
 
     // Image: admin options + optional custom.
     root.appendChild(KF.el('label', { text: KF.t('Image') }));
@@ -242,32 +257,36 @@
         KF.el('span', { text: ' ' + KF.t('Custom image') }),
       ]);
       root.appendChild(customRow);
-      f.customImage = KF.el('input', {
-        type: 'text', placeholder: 'registry/image:tag',
+      f.customImage = KF.form.field({
+        placeholder: 'registry/image:tag',
+        validators: [
+          function (v) {
+            return f.customCheck.checked ? V.required(v) : null;
+          },
+          V.image,
+        ],
       });
-      f.customImage.hidden = true;
+      f.customImage.root.hidden = true;
       f.customCheck.addEventListener('change', function () {
-        f.customImage.hidden = !f.customCheck.checked;
+        f.customImage.root.hidden = !f.customCheck.checked;
       });
-      root.appendChild(f.customImage);
+      root.appendChild(f.customImage.root);
     }
 
     // CPU / memory.
     var row = KF.el('div', { 'class': 'kf-row' });
-    var cpuDiv = KF.el('div', {});
-    cpuDiv.appendChild(KF.el('label', { text: KF.t('CPU') }));
-    f.cpu = KF.el('input', { type: 'text', value: section('cpu').value || '0.5' });
-    if (section('cpu').readOnly) f.cpu.setAttribute('disabled', '');
-    cpuDiv.appendChild(f.cpu);
-    var memDiv = KF.el('div', {});
-    memDiv.appendChild(KF.el('label', { text: KF.t('Memory') }));
-    f.memory = KF.el('input', {
-      type: 'text', value: section('memory').value || '1.0Gi',
+    f.cpu = KF.form.field({
+      label: KF.t('CPU'), value: section('cpu').value || '0.5',
+      readOnly: section('cpu').readOnly,
+      validators: [V.required, V.quantity],
     });
-    if (section('memory').readOnly) f.memory.setAttribute('disabled', '');
-    memDiv.appendChild(f.memory);
-    row.appendChild(cpuDiv);
-    row.appendChild(memDiv);
+    row.appendChild(f.cpu.root);
+    f.memory = KF.form.field({
+      label: KF.t('Memory'), value: section('memory').value || '1.0Gi',
+      readOnly: section('memory').readOnly,
+      validators: [V.required, V.quantity],
+    });
+    row.appendChild(f.memory.root);
     root.appendChild(row);
 
     // TPU preset picker (replaces the reference's GPU vendor/count).
@@ -374,11 +393,17 @@
     var submit = KF.el('button', {
       'class': 'kf-btn', text: KF.t('Create'),
       onclick: function () {
+        if (!KF.form.validateAll(
+              [f.name, f.cpu, f.memory,
+               f.customCheck && f.customCheck.checked
+                 ? f.customImage : null])) {
+          return;
+        }
         var body = {
-          name: f.name.value.trim(),
+          name: f.name.value(),
           image: f.image.value,
-          cpu: f.cpu.value.trim(),
-          memory: f.memory.value.trim(),
+          cpu: f.cpu.value(),
+          memory: f.memory.value(),
           tpu: f.tpu.value,
           shm: f.shm.checked,
           configurations: f.pdChecks.filter(function (cb) {
@@ -389,7 +414,7 @@
         if (f.tolerations) { body.tolerationGroup = f.tolerations.value; }
         if (f.customCheck && f.customCheck.checked) {
           body.customImageCheck = true;
-          body.customImage = f.customImage.value.trim();
+          body.customImage = f.customImage.value();
         }
         if (!f.wsCheck.checked) body.workspaceVolume = null;
         KF.whileBusy(submit, KF.send('POST', apiBase() + '/notebooks', body))

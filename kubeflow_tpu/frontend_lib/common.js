@@ -744,11 +744,385 @@
     }).join('\n');
   };
 
-  // Read-only YAML pane for details pages (raw-resource view).
-  KF.yamlPane = function (obj) {
-    var pre = KF.el('pre', { 'class': 'kf-yaml' });
-    pre.textContent = KF.toYaml(obj, '');
-    return pre;
+  // ---- YAML parser (the editable half of the editor widget) ----
+  // Parses the subset KF.toYaml emits plus the common hand-edit /
+  // kubectl styles: block mappings and sequences (nested at +2, and
+  // kubectl's same-indent "key:\n- item" sequences), "- key: value"
+  // items riding the dash, JSON-double-quoted and single-quoted
+  // strings, plain scalars, inline [] and {}. Anchors, aliases, flow
+  // collections, multi-line scalars and multiple documents are
+  // rejected loudly with a line number (mirror:
+  // tests/test_frontend_assets.py TestYamlParser).
+  KF.fromYaml = function (text) {
+    var lines = String(text).split('\n');
+    function fail(msg, ln) {
+      var err = new Error('YAML line ' + (ln + 1) + ': ' + msg);
+      err.line = ln + 1;
+      throw err;
+    }
+    var rows = [];
+    for (var i = 0; i < lines.length; i++) {
+      var raw = lines[i];
+      if (!raw.trim() || /^\s*#/.test(raw)) continue;
+      if (/\t/.test(raw.match(/^\s*/)[0])) fail('tabs in indentation', i);
+      if (/^---|^\.\.\./.test(raw.trim())) {
+        if (rows.length) fail('multiple documents not supported', i);
+        continue;
+      }
+      rows.push({
+        indent: raw.match(/^ */)[0].length,
+        text: raw.trim(),
+        line: i,
+      });
+    }
+    if (!rows.length) return null;
+    var pos = 0;
+
+    function parseScalar(s, ln) {
+      if (s.charAt(0) === '"' || s.charAt(0) === "'") {
+        // Trailing comment after a quoted scalar: strip from the
+        // first whitespace-preceded # OUTSIDE the quotes.
+        var closer = s.charAt(0);
+        var end = -1;
+        for (var q = 1; q < s.length; q++) {
+          if (closer === '"' && s.charAt(q) === '\\') { q++; continue; }
+          if (s.charAt(q) === closer) {
+            if (closer === "'" && s.charAt(q + 1) === "'") { q++; continue; }
+            end = q; break;
+          }
+        }
+        if (end >= 0 && /^\s+#/.test(s.slice(end + 1))) {
+          s = s.slice(0, end + 1);
+        }
+      } else {
+        // YAML comments need a preceding space; "repo#tag" is data.
+        s = s.replace(/\s+#.*$/, '').trim();
+      }
+      if (s === '' || s === 'null' || s === '~') return null;
+      if (s === '[]') return [];
+      if (s === '{}') return {};
+      if (s === 'true') return true;
+      if (s === 'false') return false;
+      if (/^-?\d+$/.test(s)) return parseInt(s, 10);
+      if (/^-?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$/.test(s) &&
+          /[.eE]/.test(s)) {
+        return parseFloat(s);
+      }
+      if (s.charAt(0) === '"') {
+        try {
+          var parsed = JSON.parse(s);
+          if (typeof parsed !== 'string') fail('bad quoted string', ln);
+          return parsed;
+        } catch (e) { fail('unterminated or bad quoted string', ln); }
+      }
+      if (s.charAt(0) === "'") {
+        if (s.length < 2 || s.charAt(s.length - 1) !== "'") {
+          fail('unterminated single-quoted string', ln);
+        }
+        return s.slice(1, -1).replace(/''/g, "'");
+      }
+      if (/^[&*|>{[%@`]/.test(s)) {
+        fail('unsupported YAML feature "' + s.charAt(0) + '"', ln);
+      }
+      return s;
+    }
+
+    // "key: rest" | "key:" split honouring quoted keys; null when the
+    // line is not a mapping entry.
+    function splitKey(s, ln) {
+      if (s.charAt(0) === '"') {
+        var m = s.match(/^("(?:[^"\\]|\\.)*")\s*:(?:\s(.*)|)$/);
+        if (!m) return null;
+        try {
+          return { key: JSON.parse(m[1]), rest: (m[2] || '').trim() };
+        } catch (e) { fail('bad quoted key', ln); }
+      }
+      if (s.charAt(0) === "'") {
+        var sm = s.match(/^'((?:[^']|'')*)'\s*:(?:\s(.*)|)$/);
+        if (!sm) return null;
+        return {
+          key: sm[1].replace(/''/g, "'"),
+          rest: (sm[2] || '').trim(),
+        };
+      }
+      for (var j = 0; j < s.length; j++) {
+        var ch = s.charAt(j);
+        if (ch === ':' && (j === s.length - 1 || s.charAt(j + 1) === ' ')) {
+          if (j === 0) return null;
+          return {
+            key: s.slice(0, j).trim(),
+            rest: s.slice(j + 1).trim(),
+          };
+        }
+        if (ch === '#') return null;
+      }
+      return null;
+    }
+
+    function isSeqRow(r) {
+      return r.text === '-' || r.text.slice(0, 2) === '- ';
+    }
+
+    function parseBlock(indent) {
+      var r = rows[pos];
+      if (r.indent !== indent) fail('bad indentation', r.line);
+      if (isSeqRow(r)) return parseSeq(indent);
+      return parseMap(indent);
+    }
+
+    function parseSeq(indent) {
+      var arr = [];
+      while (pos < rows.length && rows[pos].indent === indent &&
+             isSeqRow(rows[pos])) {
+        var item = rows[pos];
+        var rest = item.text === '-' ? '' : item.text.slice(2).trim();
+        if (!rest) {
+          pos++;
+          if (pos < rows.length && rows[pos].indent > indent) {
+            arr.push(parseBlock(rows[pos].indent));
+          } else {
+            arr.push(null);
+          }
+        } else if (rest === '-' || rest.slice(0, 2) === '- ') {
+          // Nested sequence riding the dash ("- - 1").
+          rows[pos] = {
+            indent: indent + 2, text: rest, line: item.line,
+          };
+          arr.push(parseSeq(indent + 2));
+        } else if (splitKey(rest, item.line)) {
+          // Map entry riding the dash: treat the remainder as the
+          // first row of a map indented past the dash.
+          rows[pos] = {
+            indent: indent + 2, text: rest, line: item.line,
+          };
+          arr.push(parseMap(indent + 2));
+        } else {
+          pos++;
+          arr.push(parseScalar(rest, item.line));
+        }
+      }
+      if (pos < rows.length && rows[pos].indent > indent) {
+        fail('bad indentation', rows[pos].line);
+      }
+      return arr;
+    }
+
+    function parseMap(indent) {
+      var obj = {};
+      while (pos < rows.length && rows[pos].indent === indent &&
+             !isSeqRow(rows[pos])) {
+        var row = rows[pos];
+        var kv = splitKey(row.text, row.line);
+        if (!kv) fail('expected "key: value"', row.line);
+        if (kv.key === '__proto__' || kv.key === 'constructor' ||
+            kv.key === 'prototype') {
+          // Assigning these on a plain object is a silent no-op /
+          // prototype rewire in JS — the entry would vanish from the
+          // parsed resource. Fail loudly instead (the parser's
+          // contract for anything it cannot represent faithfully).
+          fail('unsupported key "' + kv.key + '"', row.line);
+        }
+        if (Object.prototype.hasOwnProperty.call(obj, kv.key)) {
+          fail('duplicate key "' + kv.key + '"', row.line);
+        }
+        pos++;
+        if (kv.rest) {
+          obj[kv.key] = parseScalar(kv.rest, row.line);
+          if (pos < rows.length && rows[pos].indent > indent) {
+            fail('bad indentation', rows[pos].line);
+          }
+        } else if (pos < rows.length && rows[pos].indent > indent) {
+          obj[kv.key] = parseBlock(rows[pos].indent);
+        } else if (pos < rows.length && rows[pos].indent === indent &&
+                   isSeqRow(rows[pos])) {
+          // kubectl style: sequence at the key's own indent.
+          obj[kv.key] = parseSeq(indent);
+        } else {
+          obj[kv.key] = null;
+        }
+      }
+      return obj;
+    }
+
+    var result;
+    if (rows.length === 1 && !isSeqRow(rows[0]) &&
+        !splitKey(rows[0].text, rows[0].line)) {
+      result = parseScalar(rows[0].text, rows[0].line);
+      pos = 1;
+    } else {
+      result = parseBlock(rows[0].indent);
+    }
+    if (pos < rows.length) fail('unexpected content', rows[pos].line);
+    return result;
+  };
+
+  // ---- editable YAML editor (reference kit's editor component) ----
+  // Textarea with parse-on-input validation and a GUARDED apply path:
+  // Apply first round-trips through the server with dryRun (the
+  // apiserver validates + admits without persisting), then applies
+  // for real only if the dry run passed.
+  // opts.apply(resource, dryRun) -> Promise; opts.onSaved(saved).
+  KF.yamlEditor = function (obj, opts) {
+    opts = opts || {};
+    var wrap = KF.el('div', { 'class': 'kf-yaml-editor' });
+    var ta = KF.el('textarea', {
+      'class': 'kf-yaml kf-yaml-input',
+      spellcheck: 'false',
+      rows: String(Math.min(30, KF.toYaml(obj, '').split('\n').length + 2)),
+    });
+    ta.value = KF.toYaml(obj, '');
+    var status = KF.el('div', { 'class': 'kf-help', text: '' });
+    var bar = KF.el('div', { 'class': 'kf-actions' });
+    var applyBtn = KF.el('button', {
+      'class': 'kf-btn', text: KF.t('Dry-run & apply'),
+    });
+    var resetBtn = KF.el('button', {
+      'class': 'kf-btn kf-btn-ghost', text: KF.t('Reset'),
+    });
+    var parsed = obj;
+
+    function check() {
+      try {
+        parsed = KF.fromYaml(ta.value);
+        if (parsed === null || typeof parsed !== 'object' ||
+            Array.isArray(parsed)) {
+          throw new Error(KF.t('document must be a mapping'));
+        }
+        status.textContent = '';
+        status.className = 'kf-help';
+        applyBtn.removeAttribute('disabled');
+        return true;
+      } catch (err) {
+        parsed = null;
+        status.textContent = err.message;
+        status.className = 'kf-help kf-error';
+        applyBtn.setAttribute('disabled', '');
+        return false;
+      }
+    }
+    ta.addEventListener('input', check);
+    resetBtn.addEventListener('click', function () {
+      ta.value = KF.toYaml(obj, '');
+      check();
+    });
+    applyBtn.addEventListener('click', function () {
+      if (!check() || !opts.apply) return;
+      // Snapshot at click time: the textarea stays editable while the
+      // dry-run is in flight, and the real apply must PUT exactly what
+      // the server just validated — not a mid-flight edit.
+      var toApply = parsed;
+      KF.whileBusy(applyBtn, opts.apply(toApply, true).then(function () {
+        return opts.apply(toApply, false);
+      })).then(function (saved) {
+        KF.snack(KF.t('Applied'));
+        if (opts.onSaved) opts.onSaved(saved);
+      }).catch(function (err) {
+        KF.snack(err.message, true);
+      });
+    });
+    bar.appendChild(applyBtn);
+    bar.appendChild(resetBtn);
+    wrap.appendChild(ta);
+    wrap.appendChild(status);
+    wrap.appendChild(bar);
+    check();
+    return wrap;
+  };
+
+  // ---- reusable form controls with validation (reference kit's
+  // form-control library; mirror: TestFormValidators) ----
+  KF.form = {
+    validators: {
+      required: function (v) {
+        return String(v).trim() ? null : KF.t('Required');
+      },
+      // RFC 1123 label — what k8s object names must satisfy.
+      dns1123: function (v) {
+        v = String(v).trim();
+        if (!v) return null;
+        if (v.length > 63) return KF.t('At most 63 characters');
+        return /^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(v) ? null
+          : KF.t('Lowercase letters, digits and "-"; must start and end alphanumeric');
+      },
+      // k8s resource.Quantity: decimal with an optional SI/binary
+      // suffix or exponent (the full apiserver grammar, minus leading
+      // signs — negative resource requests are never valid here).
+      quantity: function (v) {
+        v = String(v).trim();
+        if (!v) return null;
+        return /^\d+(\.\d+)?((Ki|Mi|Gi|Ti|Pi|Ei)|[numkMGTPE]|[eE][+-]?\d+)?$/
+          .test(v)
+          ? null
+          : KF.t('Not a quantity (examples: 0.5, 500m, 1.5Gi)');
+      },
+      // registry[:port]/repo[:tag][@digest] — loose on purpose.
+      image: function (v) {
+        v = String(v).trim();
+        if (!v) return null;
+        return /^[a-z0-9]([\w.-]*[\w])?(:\d+)?(\/[\w][\w.-]*)*(:[\w][\w.-]{0,127})?(@sha256:[a-f0-9]{64})?$/i
+          .test(v) ? null : KF.t('Not a valid image reference');
+      },
+    },
+    // A labelled input with live validation. opts: {label, value,
+    // placeholder, type, validators: [fn...], readOnly}. Returns
+    // {root, input, validate(), value(), error}.
+    field: function (opts) {
+      var root = KF.el('div', { 'class': 'kf-field' });
+      if (opts.label) {
+        root.appendChild(KF.el('label', { text: opts.label }));
+      }
+      var input = KF.el('input', {
+        type: opts.type || 'text',
+        value: opts.value === undefined ? '' : String(opts.value),
+        placeholder: opts.placeholder || '',
+      });
+      if (opts.readOnly) input.setAttribute('disabled', '');
+      var error = KF.el('div', { 'class': 'kf-help kf-error', text: '' });
+      error.hidden = true;
+      var ctl = {
+        root: root,
+        input: input,
+        value: function () { return input.value.trim(); },
+        validate: function () {
+          // Admin-locked fields are authoritative: validating a value
+          // the user cannot edit could block submission with no
+          // recourse (focus would land on a disabled input).
+          if (input.disabled) {
+            error.hidden = true;
+            return null;
+          }
+          var fns = opts.validators || [];
+          for (var i = 0; i < fns.length; i++) {
+            var msg = fns[i](input.value);
+            if (msg) {
+              error.textContent = msg;
+              error.hidden = false;
+              input.setAttribute('aria-invalid', 'true');
+              return msg;
+            }
+          }
+          error.hidden = true;
+          input.removeAttribute('aria-invalid');
+          return null;
+        },
+      };
+      input.addEventListener('input', ctl.validate);
+      root.appendChild(input);
+      root.appendChild(error);
+      return ctl;
+    },
+    // Validate a set of fields; focuses the first invalid one.
+    validateAll: function (fields) {
+      var ok = true;
+      for (var i = 0; i < fields.length; i++) {
+        if (!fields[i]) continue;
+        if (fields[i].validate()) {
+          if (ok) fields[i].input.focus();
+          ok = false;
+        }
+      }
+      return ok;
+    },
   };
 
   KF.shortImage = function (image) {

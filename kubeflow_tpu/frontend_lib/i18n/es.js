@@ -57,7 +57,6 @@
     'Cancel': 'Cancelar',
     'New Notebook': 'Nuevo notebook',
     '← Back': '← Volver',
-    'Raw resource': 'Recurso sin procesar',
     'Pod': 'Pod',
     'Configurations': 'Configuraciones',
     'None (CPU only)': 'Ninguno (solo CPU)',
@@ -112,5 +111,19 @@
       'Acelerador y topología del notebook. Los segmentos multi-host lanzan un pod por host con semántica de pandilla: si un rango falla, todo el segmento se reinicia junto.',
     'PodDefaults applied by the admission webhook at pod creation (environment, volumes, tolerations).':
       'PodDefaults aplicados por el webhook de admisión al crear el pod (entorno, volúmenes, tolerancias).',
+    // ---- editor widget + form controls (round 5) ----
+    'YAML': 'YAML',
+    'Dry-run & apply': 'Simular y aplicar',
+    'Reset': 'Restablecer',
+    'Applied': 'Aplicado',
+    'document must be a mapping': 'el documento debe ser un mapeo',
+    'Required': 'Obligatorio',
+    'At most 63 characters': 'Como máximo 63 caracteres',
+    'Lowercase letters, digits and "-"; must start and end alphanumeric':
+      'Letras minúsculas, dígitos y «-»; debe empezar y terminar con un alfanumérico',
+    'Not a quantity (examples: 0.5, 500m, 1.5Gi)':
+      'No es una cantidad (ejemplos: 0.5, 500m, 1.5Gi)',
+    'Not a valid image reference':
+      'Referencia de imagen no válida',
   });
 })();

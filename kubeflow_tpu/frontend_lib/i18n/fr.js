@@ -56,7 +56,6 @@
     'Cancel': 'Annuler',
     'New Notebook': 'Nouveau notebook',
     '← Back': '← Retour',
-    'Raw resource': 'Ressource brute',
     'Pod': 'Pod',
     'Configurations': 'Configurations',
     'None (CPU only)': 'Aucune (CPU uniquement)',
@@ -112,5 +111,19 @@
       'Accélérateur et topologie du notebook. Les tranches multi-hôtes lancent un pod par hôte avec une sémantique de gang : si un rang plante, toute la tranche redémarre ensemble.',
     'PodDefaults applied by the admission webhook at pod creation (environment, volumes, tolerations).':
       'PodDefaults appliqués par le webhook d\'admission à la création du pod (environnement, volumes, tolérances).',
+    // ---- editor widget + form controls (round 5) ----
+    'YAML': 'YAML',
+    'Dry-run & apply': 'Simuler & appliquer',
+    'Reset': 'Réinitialiser',
+    'Applied': 'Appliqué',
+    'document must be a mapping': 'le document doit être un mapping',
+    'Required': 'Obligatoire',
+    'At most 63 characters': 'Au plus 63 caractères',
+    'Lowercase letters, digits and "-"; must start and end alphanumeric':
+      'Lettres minuscules, chiffres et « - » ; doit commencer et finir par un alphanumérique',
+    'Not a quantity (examples: 0.5, 500m, 1.5Gi)':
+      'Pas une quantité (exemples : 0.5, 500m, 1.5Gi)',
+    'Not a valid image reference':
+      'Référence d\'image non valide',
   });
 })();

@@ -582,13 +582,14 @@ class ApiClient:
             item.setdefault("kind", kind)
         return env
 
-    def update(self, obj: dict) -> dict:
+    def update(self, obj: dict, dry_run: bool = False) -> dict:
         gvk = GVK.from_obj(obj)
         meta = obj.get("metadata", {})
         return self._request(
             "PUT",
             self._path(gvk, meta.get("namespace"), meta.get("name")),
             body=obj,
+            query={"dryRun": "All"} if dry_run else None,
         )
 
     def patch_merge(self, api_version: str, kind: str, name: str,

@@ -257,8 +257,12 @@ class FakeApiServer:
                 }).encode()).decode()
             return items, rv, cont
 
-    def update(self, obj: dict) -> dict:
-        """Full replace with optimistic concurrency (resourceVersion)."""
+    def update(self, obj: dict, dry_run: bool = False) -> dict:
+        """Full replace with optimistic concurrency (resourceVersion).
+        With ``dry_run``, run the same existence/conflict validation
+        and return the object as it WOULD be stored, persisting nothing
+        (apiserver ``?dryRun=All`` semantics — the editor widget's
+        guarded-apply path)."""
         with self._lock:
             obj = _jcopy(obj)
             gvk = GVK.from_obj(obj)
@@ -277,6 +281,12 @@ class FakeApiServer:
             meta["creationTimestamp"] = cur["metadata"]["creationTimestamp"]
             if cur["metadata"].get("deletionTimestamp"):
                 meta["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+            if dry_run:
+                preview = _jcopy(obj)
+                preview["metadata"]["resourceVersion"] = (
+                    cur["metadata"]["resourceVersion"]
+                )
+                return preview
             meta["resourceVersion"] = str(next(self._rv))
             bucket[key] = obj
             if self._maybe_finalize(obj):

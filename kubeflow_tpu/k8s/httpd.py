@@ -423,7 +423,9 @@ class _Handler(BaseHTTPRequestHandler):
             obj = json.loads(self._read_body() or b"{}")
             obj.setdefault("apiVersion", info["api_version"])
             obj.setdefault("kind", info["kind"])
-            updated = self.fake.update(obj)
+            updated = self.fake.update(
+                obj, dry_run=query.get("dryRun") == "All"
+            )
             return self._send_json(200, updated)
         except ApiError as exc:
             return self._send_status(exc.code, str(exc))

@@ -234,3 +234,73 @@ def test_locale_switch_renders_spanish(page, seeded_jwa):
     headers = page.locator("#nb-table th").all_inner_texts()
     assert any("Nombre" in h for h in headers)
     assert page.locator("#locale-mount select").input_value() == "es"
+
+
+def test_yaml_editor_edit_dry_run_apply(page, seeded_jwa):
+    """Round-5 editor widget: the YAML tab's edit -> parse-validate ->
+    dry-run -> apply flow (reference kit editor module). Broken YAML
+    disables Apply with a line-numbered error; a valid edit lands on
+    the apiserver only after the server-side dry-run passed."""
+    url, api = seeded_jwa
+    page.goto(url)
+    page.locator("a.kf-link", has_text="demo-nb").click()
+    page.locator("button.kf-tab", has_text="YAML").click()
+    ta = page.locator(".kf-yaml-input")
+    ta.wait_for()
+    text = ta.input_value()
+    assert "kind: Notebook" in text
+
+    # Invalid YAML: apply disabled, line-numbered error shown.
+    ta.fill(text + "\nbroken: [flow, not, supported]")
+    err = page.locator(".kf-yaml-editor .kf-error")
+    err.wait_for()
+    assert "YAML line" in err.inner_text()
+    apply_btn = page.locator(".kf-yaml-editor button.kf-btn",
+                             has_text="Dry-run")
+    assert apply_btn.is_disabled()
+
+    # Reset restores the resource text and re-enables apply.
+    page.locator(".kf-yaml-editor button", has_text="Reset").click()
+    assert not apply_btn.is_disabled()
+
+    # Edit a label through the textarea and apply.
+    lines = ta.input_value().split("\n")
+    at = lines.index("metadata:")
+    lines[at + 1:at + 1] = ["  labels:", "    from-editor: edited"]
+    ta.fill("\n".join(lines))
+    apply_btn.click()
+    page.locator("#kf-snack.kf-snack-show").wait_for()
+    nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+    assert nb["metadata"]["labels"]["from-editor"] == "edited"
+
+
+def test_form_validation_blocks_bad_input(page, seeded_jwa):
+    """Round-5 KF.form controls: invalid name/cpu never reach the
+    backend; inline errors render next to the fields."""
+    url, api = seeded_jwa
+    page.goto(url)
+    page.locator("#new-btn").click()
+    form = page.locator("#spawner-form")
+    name = form.locator(".kf-field input").first
+    name.fill("Bad Name!")
+    page.locator("button.kf-btn", has_text="Create").click()
+    err = form.locator(".kf-field .kf-error:not([hidden])").first
+    err.wait_for()
+    assert "Lowercase" in err.inner_text()
+    try:
+        api.get("kubeflow.org/v1beta1", "Notebook", "Bad Name!", "alice")
+        raise AssertionError("invalid name must not reach the API")
+    except Exception:
+        pass
+    name.fill("good-name")
+    cpu = form.locator(".kf-row .kf-field input").first
+    cpu.fill("half a core")
+    page.locator("button.kf-btn", has_text="Create").click()
+    err = form.locator(".kf-field .kf-error:not([hidden])").first
+    err.wait_for()
+    assert "quantity" in err.inner_text()
+    cpu.fill("0.5")
+    page.locator("button.kf-btn", has_text="Create").click()
+    page.locator("#kf-snack.kf-snack-show").wait_for()
+    assert api.get("kubeflow.org/v1beta1", "Notebook", "good-name",
+                   "alice")

@@ -509,3 +509,369 @@ class TestYamlSerializer:
             "return '{}'",
         ]:
             assert needle in src, f"toYaml drift: missing {needle!r}"
+
+
+class TestYamlParser:
+    """KF.fromYaml (the editable half of the editor widget): a
+    line-for-line Python transliteration of the JS parser, validated
+    against PyYAML on every accepted input — the mirror must both
+    round-trip KF.toYaml output and agree with a real YAML parser on
+    the supported subset. Any change to common.js fromYaml must be
+    mirrored here (the browser tier exercises the JS itself)."""
+
+    class _Err(Exception):
+        def __init__(self, msg, line):
+            super().__init__(f"YAML line {line + 1}: {msg}")
+            self.line = line + 1
+
+    @classmethod
+    def from_yaml(cls, text):
+        import json as _json
+        import re as _re
+
+        lines = str(text).split("\n")
+
+        def fail(msg, ln):
+            raise cls._Err(msg, ln)
+
+        rows = []
+        for i, raw in enumerate(lines):
+            if not raw.strip() or _re.match(r"^\s*#", raw):
+                continue
+            if "\t" in _re.match(r"^\s*", raw).group(0):
+                fail("tabs in indentation", i)
+            if _re.match(r"^---|^\.\.\.", raw.strip()):
+                if rows:
+                    fail("multiple documents not supported", i)
+                continue
+            rows.append({
+                "indent": len(_re.match(r"^ *", raw).group(0)),
+                "text": raw.strip(), "line": i,
+            })
+        if not rows:
+            return None
+        pos = [0]
+
+        def parse_scalar(s, ln):
+            if s[0:1] in ('"', "'"):
+                closer = s[0]
+                end = -1
+                q = 1
+                while q < len(s):
+                    if closer == '"' and s[q] == "\\":
+                        q += 2
+                        continue
+                    if s[q] == closer:
+                        if closer == "'" and s[q + 1:q + 2] == "'":
+                            q += 2
+                            continue
+                        end = q
+                        break
+                    q += 1
+                if end >= 0 and _re.match(r"^\s+#", s[end + 1:]):
+                    s = s[:end + 1]
+            else:
+                s = _re.sub(r"\s+#.*$", "", s).strip()
+            if s in ("", "null", "~"):
+                return None
+            if s == "[]":
+                return []
+            if s == "{}":
+                return {}
+            if s == "true":
+                return True
+            if s == "false":
+                return False
+            if _re.match(r"^-?\d+$", s):
+                return int(s)
+            if (_re.match(r"^-?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$", s)
+                    and _re.search(r"[.eE]", s)):
+                return float(s)
+            if s[0] == '"':
+                try:
+                    parsed = _json.loads(s)
+                except ValueError:
+                    fail("unterminated or bad quoted string", ln)
+                if not isinstance(parsed, str):
+                    fail("bad quoted string", ln)
+                return parsed
+            if s[0] == "'":
+                if len(s) < 2 or s[-1] != "'":
+                    fail("unterminated single-quoted string", ln)
+                return s[1:-1].replace("''", "'")
+            if _re.match(r"^[&*|>{\[%@`]", s):
+                fail(f'unsupported YAML feature "{s[0]}"', ln)
+            return s
+
+        def split_key(s, ln):
+            if s[0:1] == '"':
+                m = _re.match(r'^("(?:[^"\\]|\\.)*")\s*:(?:\s(.*)|)$', s)
+                if not m:
+                    return None
+                try:
+                    return {"key": _json.loads(m.group(1)),
+                            "rest": (m.group(2) or "").strip()}
+                except ValueError:
+                    fail("bad quoted key", ln)
+            if s[0:1] == "'":
+                sm = _re.match(r"^'((?:[^']|'')*)'\s*:(?:\s(.*)|)$", s)
+                if not sm:
+                    return None
+                return {"key": sm.group(1).replace("''", "'"),
+                        "rest": (sm.group(2) or "").strip()}
+            for j, ch in enumerate(s):
+                if ch == ":" and (j == len(s) - 1 or s[j + 1] == " "):
+                    if j == 0:
+                        return None
+                    return {"key": s[:j].strip(),
+                            "rest": s[j + 1:].strip()}
+                if ch == "#":
+                    return None
+            return None
+
+        def is_seq_row(r):
+            return r["text"] == "-" or r["text"][:2] == "- "
+
+        def parse_block(indent):
+            r = rows[pos[0]]
+            if r["indent"] != indent:
+                fail("bad indentation", r["line"])
+            if is_seq_row(r):
+                return parse_seq(indent)
+            return parse_map(indent)
+
+        def parse_seq(indent):
+            arr = []
+            while (pos[0] < len(rows) and rows[pos[0]]["indent"] == indent
+                   and is_seq_row(rows[pos[0]])):
+                item = rows[pos[0]]
+                rest = ("" if item["text"] == "-"
+                        else item["text"][2:].strip())
+                if not rest:
+                    pos[0] += 1
+                    if (pos[0] < len(rows)
+                            and rows[pos[0]]["indent"] > indent):
+                        arr.append(parse_block(rows[pos[0]]["indent"]))
+                    else:
+                        arr.append(None)
+                elif rest == "-" or rest[:2] == "- ":
+                    rows[pos[0]] = {"indent": indent + 2, "text": rest,
+                                    "line": item["line"]}
+                    arr.append(parse_seq(indent + 2))
+                elif split_key(rest, item["line"]):
+                    rows[pos[0]] = {"indent": indent + 2, "text": rest,
+                                    "line": item["line"]}
+                    arr.append(parse_map(indent + 2))
+                else:
+                    pos[0] += 1
+                    arr.append(parse_scalar(rest, item["line"]))
+            if pos[0] < len(rows) and rows[pos[0]]["indent"] > indent:
+                fail("bad indentation", rows[pos[0]]["line"])
+            return arr
+
+        def parse_map(indent):
+            obj = {}
+            while (pos[0] < len(rows) and rows[pos[0]]["indent"] == indent
+                   and not is_seq_row(rows[pos[0]])):
+                row = rows[pos[0]]
+                kv = split_key(row["text"], row["line"])
+                if not kv:
+                    fail('expected "key: value"', row["line"])
+                if kv["key"] in ("__proto__", "constructor",
+                                 "prototype"):
+                    # JS-side hazard (silent no-op / prototype rewire
+                    # on plain objects); mirrored so both parsers
+                    # reject identically.
+                    fail(f'unsupported key "{kv["key"]}"', row["line"])
+                if kv["key"] in obj:
+                    fail(f'duplicate key "{kv["key"]}"', row["line"])
+                pos[0] += 1
+                if kv["rest"]:
+                    obj[kv["key"]] = parse_scalar(kv["rest"], row["line"])
+                    if (pos[0] < len(rows)
+                            and rows[pos[0]]["indent"] > indent):
+                        fail("bad indentation", rows[pos[0]]["line"])
+                elif (pos[0] < len(rows)
+                        and rows[pos[0]]["indent"] > indent):
+                    obj[kv["key"]] = parse_block(rows[pos[0]]["indent"])
+                elif (pos[0] < len(rows)
+                        and rows[pos[0]]["indent"] == indent
+                        and is_seq_row(rows[pos[0]])):
+                    obj[kv["key"]] = parse_seq(indent)
+                else:
+                    obj[kv["key"]] = None
+            return obj
+
+        if (len(rows) == 1 and not is_seq_row(rows[0])
+                and not split_key(rows[0]["text"], rows[0]["line"])):
+            result = parse_scalar(rows[0]["text"], rows[0]["line"])
+            pos[0] = 1
+        else:
+            result = parse_block(rows[0]["indent"])
+        if pos[0] < len(rows):
+            fail("unexpected content", rows[pos[0]]["line"])
+        return result
+
+    CASES = [
+        {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+         "metadata": {"name": "demo", "namespace": "alice",
+                      "annotations": {"a/b": "2026-07-30T00:00:00Z"},
+                      "labels": {}},
+         "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4",
+                          "replicas": 2},
+                  "containers": [
+                      {"name": "nb", "image": "ghcr.io/x/y:latest",
+                       "resources": {"requests": {"cpu": "2",
+                                                  "memory": "4Gi"}},
+                       "env": [{"name": "A", "value": "on"},
+                               {"name": "B", "value": "-1"}],
+                       "ports": [], "args": None}]},
+         "status": {"ready": True, "fraction": 0.5,
+                    "conditions": [{"type": "Ready",
+                                    "status": "True"}]}},
+        {"weird keys": {"a:b": 1, "": "empty", "#c": [True, False,
+                                                      None, 0.5]},
+         "multiline": "line1\nline2", "trail ": " lead"},
+        {"nested": [[1, 2], [{"deep": {"deeper": []}}], []]},
+    ]
+
+    def test_roundtrips_to_yaml_output(self):
+        global self_to_yaml
+        self_to_yaml = TestYamlSerializer.to_yaml
+        for i, obj in enumerate(self.CASES):
+            text = self_to_yaml(obj, "")
+            assert self.from_yaml(text) == obj, f"case {i}:\n{text}"
+
+    def test_agrees_with_pyyaml_on_accepted_inputs(self):
+        import yaml as pyyaml
+
+        global self_to_yaml
+        self_to_yaml = TestYamlSerializer.to_yaml
+        hand_written = [
+            # kubectl style: sequence at the key's own indent.
+            "kind: Notebook\nspec:\n- a\n- b\n",
+            "a: 1\nb:\n  - x: 1\n    y: 2\n  - z\n",
+            # note: exponent with explicit sign — YAML 1.1 (PyYAML)
+            # only resolves signed exponents as floats; the JS parser
+            # accepts both, so the shared corpus sticks to the subset.
+            "name: 'it''s'\nimage: repo:tag\nnum: 1.5e+3\n",
+            "'app.kubernetes.io/name': web\n'it''s': 1\n",
+            "empty:\nafter: 1\n",
+            "# comment\nkey: value # not stripped\n",
+            "---\nkey: value\n",
+        ]
+        corpus = [TestYamlSerializer.to_yaml(o, "") for o in self.CASES]
+        for text in corpus + hand_written:
+            assert self.from_yaml(text) == pyyaml.safe_load(text), text
+
+    def test_rejects_with_line_numbers(self):
+        import pytest as _pytest
+
+        bad = [
+            ("a: 1\n\tb: 2\n", "tabs"),
+            ("a: 1\n---\nb: 2\n", "documents"),
+            ("a: &anchor 1\n", "unsupported"),
+            ("a: [1, 2]\n", "unsupported"),
+            ("a: 1\na: 2\n", "duplicate"),
+            ("__proto__: x\n", "unsupported key"),
+            ("meta:\n  constructor:\n    a: 1\n", "unsupported key"),
+            ("a:\n    b: 1\n  c: 2\n", "unexpected content"),
+            ("a:\n  - 1\n    - 2\n", "indentation"),
+            ("just text\nmore text\n", 'key: value'),
+            ('a: "unterminated\n', "quoted"),
+        ]
+        for text, needle in bad:
+            with _pytest.raises(self._Err, match=needle) as exc_info:
+                self.from_yaml(text)
+            assert exc_info.value.line >= 1
+
+    def test_js_mirror_drift_canary(self):
+        src = open(os.path.join(PKG, "frontend_lib", "common.js")).read()
+        for needle in [
+            "KF.fromYaml = function",
+            "multiple documents not supported",
+            "tabs in indentation",
+            "duplicate key",
+            "unsupported YAML feature",
+            "bad indentation",
+            "KF.yamlEditor = function",
+            "opts.apply(toApply, true)",
+            "opts.apply(toApply, false)",
+        ]:
+            assert needle in src, f"fromYaml drift: missing {needle!r}"
+
+
+class TestFormValidators:
+    """KF.form.validators mirrors (common.js round 5): the regexes are
+    transliterated and pinned on both accept and reject cases."""
+
+    @staticmethod
+    def dns1123(v):
+        import re as _re
+
+        v = v.strip()
+        if not v:
+            return None
+        if len(v) > 63:
+            return "too long"
+        return (None if _re.match(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$", v)
+                else "bad")
+
+    @staticmethod
+    def quantity(v):
+        import re as _re
+
+        v = v.strip()
+        if not v:
+            return None
+        return (None if _re.match(
+            r"^\d+(\.\d+)?((Ki|Mi|Gi|Ti|Pi|Ei)|[numkMGTPE]"
+            r"|[eE][+-]?\d+)?$", v)
+            else "bad")
+
+    @staticmethod
+    def image(v):
+        import re as _re
+
+        v = v.strip()
+        if not v:
+            return None
+        return (None if _re.match(
+            r"^[a-z0-9]([\w.-]*[\w])?(:\d+)?(\/[\w][\w.-]*)*"
+            r"(:[\w][\w.-]{0,127})?(@sha256:[a-f0-9]{64})?$",
+            v, _re.I) else "bad")
+
+    def test_dns1123(self):
+        ok = ["a", "my-notebook", "nb-01", "a" * 63]
+        bad = ["", "A", "-a", "a-", "a_b", "a.b", "a" * 64]
+        assert all(self.dns1123(v) is None for v in ok)
+        assert all(self.dns1123(v) is not None for v in bad if v)
+
+    def test_quantity(self):
+        # Full resource.Quantity grammar (minus signs): SI + binary
+        # suffixes, small-unit suffixes, exponent forms — an admin
+        # config may legally carry any of these.
+        ok = ["0.5", "2", "500m", "1.5Gi", "4Gi", "100Ki", "1T",
+              "1e3", "2E2", "100e-3", "1Ei", "100n", "250u", "3E"]
+        bad = ["half", "1.5 Gi", "Gi", "-1", "0.5mi", "1e", "2i"]
+        assert all(self.quantity(v) is None for v in ok)
+        assert all(self.quantity(v) is not None for v in bad)
+
+    def test_image(self):
+        ok = ["ubuntu", "ghcr.io/org/app:v1.2", "reg:5000/a/b",
+              "busybox@sha256:" + "a" * 64]
+        bad = ["", " spaced image", "UPPER CASE", "a//b", ":tag"]
+        assert all(self.image(v) is None for v in ok)
+        assert all(self.image(v) is not None for v in bad if v)
+
+    def test_js_mirror_drift_canary(self):
+        src = open(os.path.join(PKG, "frontend_lib", "common.js")).read()
+        for needle in [
+            "KF.form = {",
+            "^[a-z0-9]([-a-z0-9]*[a-z0-9])?$",
+            "(Ki|Mi|Gi|Ti|Pi|Ei)",
+            "validateAll",
+            "aria-invalid",
+            "input.disabled",
+        ]:
+            assert needle in src, f"form drift: missing {needle!r}"

@@ -175,6 +175,78 @@ class TestSpawnFlow:
         nb = api.get("kubeflow.org/v1beta1", "Notebook", "nb1", "alice")
         assert "kubeflow-resource-stopped" not in nb["metadata"]["annotations"]
 
+    def test_yaml_editor_apply_flow(self):
+        """The editor widget's guarded apply (round 5): dry-run
+        validates without persisting; the real PUT persists; resource
+        identity is pinned server-side."""
+        api = FakeApiServer()
+        client = client_for(api)
+        headers = csrf_headers(client)
+        post_json(client, "/api/namespaces/alice/notebooks", spawn_form(),
+                  headers)
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "nb1", "alice")
+        rv_before = nb["metadata"]["resourceVersion"]
+        edited = json.loads(json.dumps(nb))
+        edited["metadata"].setdefault("labels", {})["edited"] = "yes"
+
+        def put(body):
+            return client.put(
+                "/api/namespaces/alice/notebooks/nb1/yaml",
+                data=json.dumps(body), headers=headers,
+                content_type="application/json",
+            )
+
+        # Dry run: accepted, nothing stored.
+        resp = put({"resource": edited, "dryRun": True})
+        assert resp.status_code == 200 and resp.get_json()["dryRun"]
+        stored = api.get("kubeflow.org/v1beta1", "Notebook", "nb1",
+                         "alice")
+        assert "edited" not in (stored["metadata"].get("labels") or {})
+        assert stored["metadata"]["resourceVersion"] == rv_before
+        # Real apply: persists.
+        resp = put({"resource": edited, "dryRun": False})
+        assert resp.status_code == 200
+        stored = api.get("kubeflow.org/v1beta1", "Notebook", "nb1",
+                         "alice")
+        assert stored["metadata"]["labels"]["edited"] == "yes"
+        # Identity cannot be edited into something else.
+        hijack = json.loads(json.dumps(stored))
+        hijack["metadata"]["name"] = "other"
+        resp = put({"resource": hijack})
+        assert resp.status_code == 400
+        assert "identity" in resp.get_json()["log"]
+        # Scalar metadata is a 400, not an unhandled 500.
+        resp = put({"resource": {"kind": "Notebook",
+                                 "metadata": "oops"}})
+        assert resp.status_code == 400
+        assert "mapping" in resp.get_json()["log"]
+        # Explicit `metadata: null` (what the editor sends for a bare
+        # "metadata:" line) must not crash either: identity is
+        # re-pinned server-side, so this round-trips as an update.
+        resp = put({"resource": {"kind": "Notebook", "metadata": None}})
+        assert resp.status_code in (200, 409)
+        # Stale resourceVersion -> conflict surfaces as an apply error.
+        stale = json.loads(json.dumps(edited))
+        stale["metadata"]["resourceVersion"] = rv_before
+        assert put({"resource": stale}).status_code == 409
+
+    def test_yaml_editor_requires_update_authz(self):
+        api = FakeApiServer()
+        authorizer = PolicyAuthorizer()
+        authorizer.grant("alice@example.com", "alice",
+                         "get", "list", "create")  # no update
+        client = client_for(api, authorizer=authorizer)
+        headers = csrf_headers(client)
+        post_json(client, "/api/namespaces/alice/notebooks", spawn_form(),
+                  headers)
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "nb1", "alice")
+        resp = client.put(
+            "/api/namespaces/alice/notebooks/nb1/yaml",
+            data=json.dumps({"resource": nb, "dryRun": True}),
+            headers=headers, content_type="application/json",
+        )
+        assert resp.status_code == 403
+
     def test_delete(self):
         api = FakeApiServer()
         client = client_for(api)
