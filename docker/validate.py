@@ -1,0 +1,206 @@
+#!/usr/bin/env python3
+"""Dockerfile dry validation — the publish tier's in-environment check.
+
+No container runtime ships in the dev image, so `docker build` cannot
+run here (the KinD / publish workflows do it in CI). This validator
+gives the publish tier a runnable in-repo gate anyway: it parses every
+Dockerfile under docker/ and images/ with the real instruction grammar
+and checks the properties a broken build would trip on first —
+
+- instruction vocabulary and order (ARG-before-FROM rules, exactly the
+  instructions Docker accepts, no content before FROM);
+- line continuations and JSON-form ENTRYPOINT/CMD parse;
+- every COPY/ADD source path (non-URL, non --from=stage) exists in the
+  build context (docker/ builds use repo root; images/* use their own
+  directory), respecting .dockerignore-less contexts;
+- COPY --from stages reference a defined build stage;
+- build_services.sh's component list matches the Dockerfiles on disk,
+  and the images/ Makefile DAG matches each Dockerfile's FROM.
+
+Run directly (CI: docker_publish.yaml step 1; locally: the publish-
+tier check in testing/preflight.py):
+
+    python docker/validate.py && echo OK
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+INSTRUCTIONS = {
+    "FROM", "RUN", "CMD", "LABEL", "EXPOSE", "ENV", "ADD", "COPY",
+    "ENTRYPOINT", "VOLUME", "USER", "WORKDIR", "ARG", "ONBUILD",
+    "STOPSIGNAL", "HEALTHCHECK", "SHELL", "MAINTAINER",
+}
+
+
+def logical_lines(text: str):
+    """(instruction, args, lineno) triples with continuations folded
+    and comments stripped — the subset of Docker's parser the repo's
+    Dockerfiles rely on."""
+    out = []
+    buf, start = "", 0
+    for i, raw in enumerate(text.split("\n"), 1):
+        line = raw
+        # Comment and blank lines are skipped even MID-continuation
+        # (Docker's parser does; a comment between continued RUN lines
+        # is legal and must not terminate the statement).
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        if line.rstrip().endswith("\\"):
+            buf += line.rstrip()[:-1] + " "
+            if not start:
+                start = i
+            continue
+        buf += line
+        stmt = buf.strip()
+        buf, lineno = "", start or i
+        start = 0
+        if not stmt:
+            continue
+        m = re.match(r"^(\S+)\s*(.*)$", stmt, re.S)
+        out.append((m.group(1).upper(), m.group(2).strip(), lineno))
+    if buf.strip():
+        out.append(("<DANGLING>", buf.strip(), start))
+    return out
+
+
+def validate_dockerfile(path: str, context: str) -> list[str]:
+    errors: list[str] = []
+    with open(path) as fh:
+        text = fh.read()
+    rel = os.path.relpath(path, REPO)
+    lines = logical_lines(text)
+    if not lines:
+        return [f"{rel}: empty Dockerfile"]
+    stages: list[str] = []
+    seen_from = False
+    for instr, args, ln in lines:
+        if instr == "<DANGLING>":
+            errors.append(f"{rel}:{ln}: dangling line continuation")
+            continue
+        if instr not in INSTRUCTIONS:
+            errors.append(f"{rel}:{ln}: unknown instruction {instr}")
+            continue
+        if not seen_from and instr not in ("FROM", "ARG"):
+            errors.append(f"{rel}:{ln}: {instr} before first FROM")
+        if instr == "FROM":
+            seen_from = True
+            m = re.match(r"^(\S+)(?:\s+AS\s+(\S+))?$", args, re.I)
+            if not m:
+                errors.append(f"{rel}:{ln}: unparseable FROM {args!r}")
+            elif m.group(2):
+                stages.append(m.group(2).lower())
+        if instr in ("ENTRYPOINT", "CMD") and args.startswith("["):
+            try:
+                parsed = json.loads(args)
+                assert isinstance(parsed, list)
+            except (ValueError, AssertionError):
+                errors.append(f"{rel}:{ln}: bad JSON-form {instr}")
+        if instr in ("COPY", "ADD"):
+            toks = args.split()
+            from_stage = None
+            srcs = []
+            for tok in toks[:-1]:
+                if tok.startswith("--from="):
+                    from_stage = tok.split("=", 1)[1].lower()
+                elif tok.startswith("--"):
+                    continue
+                else:
+                    srcs.append(tok)
+            if from_stage is not None:
+                if (from_stage not in stages
+                        and not from_stage.isdigit()
+                        and "/" not in from_stage):
+                    errors.append(
+                        f"{rel}:{ln}: --from={from_stage} is not a "
+                        f"defined stage"
+                    )
+                continue
+            for src in srcs:
+                if re.match(r"^[a-z]+://", src):
+                    continue  # ADD url
+                if "$" in src:
+                    continue  # build-arg path: CI's problem
+                # Globs: at least one match in context.
+                import glob as _glob
+
+                pattern = os.path.join(context, src)
+                if not _glob.glob(pattern):
+                    errors.append(
+                        f"{rel}:{ln}: COPY source {src!r} not in "
+                        f"build context {os.path.relpath(context, REPO)}"
+                        + ("" if "wheel" not in src else
+                           " (built by images/Makefile before the "
+                           "image build)")
+                    )
+    if not seen_from:
+        errors.append(f"{rel}: no FROM instruction")
+    return errors
+
+
+def main() -> int:
+    errors: list[str] = []
+    # Service images: context = repo root (build_services.sh).
+    for name in sorted(os.listdir(os.path.join(REPO, "docker"))):
+        if name.endswith(".Dockerfile"):
+            errors += validate_dockerfile(
+                os.path.join(REPO, "docker", name), REPO
+            )
+    # Notebook images: context = the image directory (images/Makefile).
+    images_dir = os.path.join(REPO, "images")
+    for name in sorted(os.listdir(images_dir)):
+        df = os.path.join(images_dir, name, "Dockerfile")
+        if os.path.isfile(df):
+            errs = validate_dockerfile(df, os.path.join(images_dir, name))
+            # The -full wheel directory is created by the Makefile
+            # right before the build; its absence here is expected.
+            errors += [e for e in errs if "wheel/" not in e]
+    # images/Makefile DAG <-> each Dockerfile's FROM parent.
+    with open(os.path.join(images_dir, "Makefile")) as fh:
+        mk = fh.read()
+    mk_dag = dict(re.findall(r"^([a-z][a-z0-9-]*): ([a-z][a-z0-9-]*)$",
+                             mk, re.M))
+    for name, parent in sorted(mk_dag.items()):
+        df_path = os.path.join(images_dir, name, "Dockerfile")
+        if not os.path.isfile(df_path):
+            errors.append(f"images/Makefile target {name} has no "
+                          f"Dockerfile")
+            continue
+        with open(df_path) as fh:
+            m = re.search(r"^FROM \$\{REGISTRY\}/([a-z-]+):\$\{TAG\}$",
+                          fh.read(), re.M)
+        if not m or m.group(1) != parent:
+            errors.append(
+                f"images/{name}/Dockerfile builds FROM "
+                f"{m.group(1) if m else '?'} but images/Makefile "
+                f"orders it after {parent}"
+            )
+    # build_services.sh component list <-> Dockerfiles on disk.
+    with open(os.path.join(REPO, "docker", "build_services.sh")) as fh:
+        sh = fh.read()
+    listed = set(re.findall(r"^  ([a-z-]+)$", sh, re.M))
+    on_disk = {
+        n[:-len(".Dockerfile")]
+        for n in os.listdir(os.path.join(REPO, "docker"))
+        if n.endswith(".Dockerfile")
+    } - {"base"}
+    if listed != on_disk:
+        errors.append(
+            f"build_services.sh components {sorted(listed)} != "
+            f"docker/*.Dockerfile {sorted(on_disk)}"
+        )
+    for err in errors:
+        print(err, file=sys.stderr)
+    print(f"validated docker/ + images/ Dockerfiles: "
+          f"{'FAIL' if errors else 'ok'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,9 @@ DAG = {
     "jupyter-jax-tpu": "jupyter",
     "jupyter-jax-tpu-full": "jupyter-jax-tpu",
     "jupyter-torch-tpu": "jupyter",
+    "jupyter-torch-tpu-full": "jupyter-torch-tpu",
     "jupyter-tf-tpu": "jupyter",
+    "jupyter-tf-tpu-full": "jupyter-tf-tpu",
     "codeserver": "base",
     "codeserver-jax-tpu": "codeserver",
     "rstudio": "base",
@@ -247,3 +249,127 @@ class TestExamples:
         # The Makefile builds the wheel into the build context before
         # the image build (pyproject.toml at the repo root).
         assert "pip wheel" in mk and "jupyter-jax-tpu-full/wheel" in mk
+
+
+class TestFullTierContract:
+    """Every framework line's -full image (reference Makefile's -full
+    tier, example-notebook-servers/Makefile:2-19): preinstalled extras
+    on top of the framework image, worked notebooks landed via the
+    HOME_TMP boot contract, README in sync."""
+
+    FULL_IMAGES = ["jupyter-jax-tpu-full", "jupyter-torch-tpu-full",
+                   "jupyter-tf-tpu-full"]
+
+    def test_full_tier_covers_every_tpu_framework_line(self):
+        lines = [n for n in DAG
+                 if n.startswith("jupyter-") and n.endswith("-tpu")]
+        assert sorted(f"{n}-full" for n in lines) == \
+            sorted(self.FULL_IMAGES)
+        for name in self.FULL_IMAGES:
+            assert DAG[name] == name[:-len("-full")]
+
+    def test_examples_ship_with_readme_in_sync(self):
+        import json
+
+        for image in self.FULL_IMAGES:
+            ex_dir = os.path.join(IMAGES_DIR, image, "examples")
+            names = sorted(
+                f for f in os.listdir(ex_dir) if f.endswith(".ipynb")
+            )
+            assert len(names) >= 2, image
+            with open(os.path.join(ex_dir, "README.md")) as fh:
+                readme = fh.read()
+            for name in names:
+                assert name in readme, f"{image}: {name} not in README"
+            for name in names:
+                with open(os.path.join(ex_dir, name)) as fh:
+                    nb = json.load(fh)
+                assert nb["nbformat"] == 4, (image, name)
+                assert any(c["cell_type"] == "code"
+                           for c in nb["cells"]), (image, name)
+
+    def test_dockerfiles_install_extras_and_copy_examples(self):
+        for image in self.FULL_IMAGES:
+            df = dockerfile(image)
+            assert "pip install" in df, image
+            assert re.search(
+                r"COPY .*examples/ \$\{HOME_TMP\}/examples/", df
+            ), image
+            # The -full tier layers on its own framework line, not on
+            # the bare jupyter image.
+            assert DAG[image] in df, image
+
+    def test_framework_examples_use_their_framework(self):
+        import json
+
+        expect = {
+            "jupyter-jax-tpu-full": "import jax",
+            "jupyter-torch-tpu-full": "torch_xla",
+            "jupyter-tf-tpu-full": "tensorflow",
+        }
+        for image, needle in expect.items():
+            ex_dir = os.path.join(IMAGES_DIR, image, "examples")
+            srcs = []
+            for name in os.listdir(ex_dir):
+                if not name.endswith(".ipynb"):
+                    continue
+                with open(os.path.join(ex_dir, name)) as fh:
+                    nb = json.load(fh)
+                srcs.append("\n".join(
+                    "".join(c["source"]) for c in nb["cells"]
+                ))
+            assert any(needle in s for s in srcs), (image, needle)
+
+
+class TestDockerfileValidation:
+    """docker/validate.py — the publish tier's runnable in-env gate
+    (no container runtime ships here; `docker build` itself runs in
+    CI). The whole repo must validate, and the validator must actually
+    catch the failure classes it claims to."""
+
+    def test_repo_dockerfiles_validate(self):
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(IMAGES_DIR, "..", "docker", "validate.py")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_validator_catches_broken_dockerfiles(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "docker_validate",
+            os.path.join(IMAGES_DIR, "..", "docker", "validate.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        cases = [
+            ("FRM ubuntu\n", "unknown instruction"),
+            ("RUN echo hi\n", "before first FROM"),
+            ("FROM a\nCOPY missing.txt /x\n", "not in build context"),
+            ("FROM a\nENTRYPOINT [\"/init\"\n", "bad JSON-form"),
+            ("FROM a\nCOPY --from=nope /x /y\n", "not a defined stage"),
+            ("FROM a\nRUN echo \\", "dangling"),
+            ("# only comments\n", "empty Dockerfile"),
+        ]
+        for content, needle in cases:
+            path = tmp_path / "Dockerfile"
+            path.write_text(content)
+            errors = mod.validate_dockerfile(str(path), str(tmp_path))
+            assert any(needle in e for e in errors), (content, errors)
+        # And a correct file passes — including a comment line INSIDE
+        # a continuation (legal per Docker's parser).
+        (tmp_path / "ok.txt").write_text("x")
+        path.write_text(
+            "ARG TAG=latest\nFROM base:${TAG} AS build\n"
+            "RUN apt-get install \\\n"
+            "    # mid-continuation comment\n"
+            "    foo\n"
+            "COPY ok.txt /x\nFROM scratch\n"
+            "COPY --from=build /x /x\nENTRYPOINT [\"/x\"]\n"
+        )
+        assert mod.validate_dockerfile(str(path), str(tmp_path)) == []
