@@ -1,0 +1,193 @@
+"""Serve the three SPAs with the browser-tier fixtures for an in-env
+WebView run (SURVEY §4 tier 4).
+
+This image has no Playwright (and no pip install), so the committed
+browser-tier specs (`tests/e2e_frontend/`) skip locally and run in CI
+(`frontend_e2e.yaml`). To still leave an *in-env* artifact, this script
+serves the same seeded apps the Playwright conftest builds — identical
+fixtures, real HTTP, real backends against the fake apiserver — so an
+external WebView/browser harness can drive the exact spec scenarios and
+record the results (`testing/browser_run_r05.md`).
+
+Usage: python testing/browser_serve.py  (serves until killed)
+  JWA       http://127.0.0.1:7701
+  VWA       http://127.0.0.1:7702
+  Dashboard http://127.0.0.1:7703
+"""
+
+from __future__ import annotations
+
+import threading
+
+from werkzeug.serving import make_server
+
+from kubeflow_tpu.apps.jupyter import create_app as create_jwa
+from kubeflow_tpu.apps.volumes import create_app as create_vwa
+from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+from kubeflow_tpu.dashboard import KfamProxy, create_app as create_dash
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.kfam import create_app as create_kfam
+
+USER = "dev@local"
+
+
+def seeded_jwa_app(extra_fixtures: bool = False):
+    """The browser-tier JWA: real app factory over a seeded fake
+    apiserver. SINGLE SOURCE for these fixtures — the Playwright
+    conftest (tests/e2e_frontend/conftest.py) imports this builder, so
+    CI specs and the in-env wire smoke drive the same seeded state by
+    construction. ``extra_fixtures`` adds the objects the smoke runner
+    needs up front (the Playwright specs create them in-test)."""
+    api = FakeApiServer()
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "alice"}})
+    api.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "demo-nb", "namespace": "alice",
+                     "creationTimestamp": "2026-07-30T06:00:00Z"},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"},
+                 "template": {"spec": {"containers": [{
+                     "name": "demo-nb",
+                     "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest",
+                     "resources": {"requests": {"cpu": "2",
+                                                "memory": "4Gi"}},
+                 }]}}},
+        "status": {"readyReplicas": 1,
+                   "containerState": {"running": {}},
+                   "conditions": [{
+                       "type": "Ready", "status": "True",
+                       "reason": "PodsReady",
+                       "message": "all replicas ready",
+                       "lastTransitionTime": "2026-07-30T06:05:00Z"}]},
+    })
+    api.create({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "demo-nb-0", "namespace": "alice",
+                             "labels": {"notebook-name": "demo-nb"}},
+                "spec": {}, "status": {"phase": "Running"}})
+    api.set_pod_logs("alice", "demo-nb-0",
+                     "jupyterlab listening on 8888\n"
+                     "TPU v5e 2x4 slice initialised\n")
+    api.create({"apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": "demo-ev1", "namespace": "alice"},
+                "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
+                "reason": "Created",
+                "message": "StatefulSet demo-nb created",
+                "type": "Normal", "count": 1,
+                "lastTimestamp": "2026-07-30T06:01:00Z"})
+    if extra_fixtures:
+        # The humanized-time smoke scenario needs a fresh event.
+        import datetime
+        recent = (datetime.datetime.now(datetime.timezone.utc)
+                  - datetime.timedelta(minutes=5)
+                  ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        api.create({
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": "demo-nb.recent", "namespace": "alice"},
+            "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
+            "reason": "Tested", "message": "humanized", "type": "Normal",
+            "count": 1, "lastTimestamp": recent,
+        })
+        # A second notebook so list ordering is observable.
+        api.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": "aaa-nb", "namespace": "alice",
+                         "creationTimestamp": "2026-07-30T07:00:00Z"},
+            "spec": {"template": {"spec": {"containers": [{
+                "name": "aaa-nb", "image": "img:latest"}]}}},
+            "status": {"readyReplicas": 1},
+        })
+    return create_jwa(api, authn=AuthnConfig(dev_mode=True),
+                      authorizer=AllowAll(), secure_cookies=False), api
+
+
+def seeded_vwa_app():
+    """Single source for the VWA browser-tier fixtures (imported by
+    tests/e2e_frontend/test_vwa_browser.py)."""
+    api = FakeApiServer()
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "alice"}})
+    api.create({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "workspace", "namespace": "alice"},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "10Gi"}}},
+        "status": {"phase": "Bound"},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "alice"},
+        "involvedObject": {"kind": "PersistentVolumeClaim",
+                           "name": "workspace"},
+        "reason": "ProvisioningSucceeded",
+        "message": "volume bound to pv-123",
+        "type": "Normal", "count": 1,
+        "lastTimestamp": "2026-07-30T06:00:00Z",
+    })
+    return create_vwa(api, authn=AuthnConfig(dev_mode=True),
+                      authorizer=AllowAll(), secure_cookies=False), api
+
+
+def seeded_dashboard_app():
+    """Single source for the dashboard browser-tier fixtures (imported
+    by tests/e2e_frontend/test_dashboard_browser.py)."""
+    api = FakeApiServer()
+    api.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": "team-alpha"},
+        "spec": {"owner": {"kind": "User", "name": USER}},
+    })
+    api.create({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "team-alpha"}})
+    api.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {
+            "name": "tpu-node-0",
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            },
+        },
+        "status": {"allocatable": {"google.com/tpu": "4"}},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "team-alpha"},
+        "spec": {"nodeName": "tpu-node-0", "containers": [{
+            "name": "nb",
+            "resources": {"limits": {"google.com/tpu": "4"}},
+        }]},
+        "status": {"phase": "Running"},
+    })
+    api.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "team-alpha"},
+        "involvedObject": {"kind": "Notebook", "name": "nb"},
+        "reason": "Created",
+        "message": "StatefulSet nb created",
+        "type": "Normal", "count": 1,
+        "lastTimestamp": "2026-07-30T06:01:00Z",
+    })
+    kfam_app = create_kfam(api, secure_cookies=False)
+    return create_dash(
+        api, kfam=KfamProxy(kfam_app),
+        authn=AuthnConfig(dev_mode=True), secure_cookies=False,
+    ), api
+
+
+def main():
+    servers = []
+    for port, (app, _api), name in [
+            (7701, seeded_jwa_app(extra_fixtures=True), "JWA"),
+            (7702, seeded_vwa_app(), "VWA"),
+            (7703, seeded_dashboard_app(), "Dashboard")]:
+        server = make_server("127.0.0.1", port, app, threaded=True)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        servers.append(server)
+        print(f"{name} http://127.0.0.1:{port}", flush=True)
+    print("READY", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,365 @@
+"""Wire-level run of the browser-tier scenarios (in-env artifact).
+
+This image has no browser binary, no JS runtime, and no pip install —
+the Playwright tier (`tests/e2e_frontend/`) is CI-only here by
+construction. This runner is the honest in-env substitute: it serves
+the SAME seeded apps the Playwright conftest builds (real werkzeug
+HTTP servers, real backends, fake apiserver) and drives every spec
+scenario at the wire level — shell + asset serving, list/details
+payloads, form create, server-side validation, stop annotation, the
+editor's dry-run→apply flow, i18n catalogs, viewer launch, fleet
+cards, contributor lifecycle — asserting both HTTP responses and
+resulting apiserver state. Everything the specs check except DOM
+rendering and client-side JS behaviour (that half runs in CI:
+`.github/workflows/frontend_e2e.yaml`).
+
+Usage: python testing/browser_smoke.py
+Exit 0 iff every scenario passed; prints one line per scenario and a
+trailing JSON summary. Output is committed as
+`testing/browser_smoke_r05.log`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+from werkzeug.serving import make_server
+
+sys.path.insert(0, ".")
+from testing.browser_serve import (  # noqa: E402
+    USER, seeded_dashboard_app, seeded_jwa_app, seeded_vwa_app,
+)
+
+RESULTS: list[tuple[str, str, str]] = []  # (scenario, PASS/FAIL, note)
+
+
+def serve(app) -> tuple[str, object]:
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{server.server_port}", server
+
+
+class Client:
+    """Cookie-jar HTTP client that plays the SPA's CSRF double-submit."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.cookies: dict[str, str] = {}
+
+    def request(self, method: str, path: str, body=None,
+                headers: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.cookies:
+            req.add_header("Cookie", "; ".join(
+                f"{k}={v}" for k, v in self.cookies.items()))
+        if method not in ("GET", "HEAD") and "XSRF-TOKEN" in self.cookies:
+            req.add_header("X-XSRF-TOKEN", self.cookies["XSRF-TOKEN"])
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            resp = urllib.request.urlopen(req, timeout=10)
+            status, raw = resp.status, resp.read()
+            set_cookies = resp.headers.get_all("Set-Cookie") or []
+        except urllib.error.HTTPError as exc:
+            status, raw = exc.code, exc.read()
+            set_cookies = exc.headers.get_all("Set-Cookie") or []
+        for sc in set_cookies:
+            first = sc.split(";", 1)[0]
+            if "=" in first:
+                k, v = first.split("=", 1)
+                self.cookies[k.strip()] = v.strip()
+        return status, raw
+
+    def get(self, path):
+        return self.request("GET", path)
+
+    def get_json(self, path):
+        status, raw = self.get(path)
+        return status, json.loads(raw)
+
+    def post_json(self, path, body):
+        status, raw = self.request("POST", path, body)
+        return status, json.loads(raw)
+
+
+def check(scenario: str, ok: bool, note: str = ""):
+    RESULTS.append((scenario, "PASS" if ok else "FAIL", note))
+    print(f"{'PASS' if ok else 'FAIL'}  {scenario}  {note}", flush=True)
+
+
+def run_scenario(name: str, fn):
+    try:
+        fn()
+    except Exception as exc:  # noqa: BLE001 — record, keep running
+        check(name, False, f"exception: {type(exc).__name__}: {exc}")
+
+
+# ---------------------------------------------------------------- JWA
+
+def jwa_scenarios():
+    app, api = seeded_jwa_app()
+    base, server = serve(app)
+    c = Client(base)
+
+    def shell_and_assets():
+        status, raw = c.get("/")
+        html = raw.decode()
+        ok = status == 200 and 'id="nb-table"' in html
+        # Assets are referenced relative to the app root.
+        srcs = re.findall(r'(?:src|href)="([^"]+\.(?:js|css))"', html)
+        bad = []
+        for s in srcs:
+            st, _ = c.get(s if s.startswith("/") else "/" + s)
+            if st != 200:
+                bad.append((s, st))
+        check("jwa/shell_and_assets",
+              ok and srcs and not bad,
+              f"{len(srcs)} assets served{', bad: ' + repr(bad) if bad else ''}")
+
+    def list_renders_notebook_row():
+        _, d = c.get_json("/api/namespaces/alice/notebooks")
+        nbs = {n["name"]: n for n in d["notebooks"]}
+        demo = nbs.get("demo-nb") or {}
+        tpu = demo.get("tpu") or {}
+        check("jwa/list_renders_notebook_row",
+              "demo-nb" in nbs and tpu.get("accelerator") == "v5e"
+              and tpu.get("topology") == "2x4"
+              and demo.get("status", {}).get("phase") == "running",
+              f"row: tpu={tpu}, phase={demo.get('status', {}).get('phase')}")
+
+    def details_conditions_events_logs():
+        _, d = c.get_json("/api/namespaces/alice/notebooks/demo-nb")
+        conds = d["notebook"].get("status", {}).get("conditions", [])
+        _, ev = c.get_json("/api/namespaces/alice/notebooks/demo-nb/events")
+        msgs = [e.get("message", "") for e in ev["events"]]
+        _, pods = c.get_json("/api/namespaces/alice/notebooks/demo-nb/pod")
+        pod_names = [p["metadata"]["name"] for p in pods["pods"]]
+        _, logs = c.get_json(
+            "/api/namespaces/alice/notebooks/demo-nb/pod/demo-nb-0/logs")
+        check("jwa/details_conditions_events_logs",
+              any(cd.get("reason") == "PodsReady" for cd in conds)
+              and any("StatefulSet demo-nb created" in m for m in msgs)
+              and pod_names == ["demo-nb-0"]
+              and any("jupyterlab listening" in ln for ln in logs["logs"])
+              and any("TPU v5e" in ln for ln in logs["logs"]),
+              f"conds={len(conds)} events={len(msgs)} pods={pod_names}")
+
+    def new_notebook_form_creates_cr():
+        status, d = c.post_json("/api/namespaces/alice/notebooks",
+                                {"name": "from-wire"})
+        cr = api.get("kubeflow.org/v1beta1", "Notebook", "from-wire",
+                     "alice")
+        check("jwa/new_notebook_form_creates_cr",
+              status == 200 and cr["metadata"]["name"] == "from-wire",
+              f"status={status}")
+
+    def form_validation_server_side():
+        s1, d1 = c.post_json("/api/namespaces/alice/notebooks",
+                             {"name": "Bad Name!"})
+        s2, d2 = c.post_json(
+            "/api/namespaces/alice/notebooks",
+            {"name": "good-wire", "cpu": "half a core"})
+        bad_reached = True
+        try:
+            api.get("kubeflow.org/v1beta1", "Notebook", "Bad Name!",
+                    "alice")
+        except Exception:
+            bad_reached = False
+        check("jwa/form_validation_server_side",
+              400 <= s1 < 500 and 400 <= s2 < 500 and not bad_reached,
+              f"bad-name={s1}, bad-cpu={s2}")
+
+    def csrf_required_on_mutation():
+        fresh = Client(base)  # no cookie jar warm-up: no token to echo
+        status, raw = fresh.request("POST",
+                                    "/api/namespaces/alice/notebooks",
+                                    {"name": "no-csrf"})
+        reached = True
+        try:
+            api.get("kubeflow.org/v1beta1", "Notebook", "no-csrf", "alice")
+        except Exception:
+            reached = False
+        check("jwa/csrf_required_on_mutation",
+              status == 403 and not reached, f"status={status}")
+
+    def stop_sets_annotation():
+        status, _ = c.request(
+            "PATCH", "/api/namespaces/alice/notebooks/demo-nb",
+            {"stopped": True})
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+        anns = nb["metadata"].get("annotations") or {}
+        stopped = "kubeflow-resource-stopped" in anns
+        c.request("PATCH", "/api/namespaces/alice/notebooks/demo-nb",
+                  {"stopped": False})
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+        restarted = "kubeflow-resource-stopped" not in (
+            nb["metadata"].get("annotations") or {})
+        check("jwa/stop_sets_annotation", status == 200 and stopped
+              and restarted, f"status={status}")
+
+    def yaml_editor_dry_run_apply():
+        _, d = c.get_json("/api/namespaces/alice/notebooks/demo-nb")
+        res = d["notebook"]
+        res["metadata"].setdefault("labels", {})["from-editor"] = "dry"
+        s1, _ = c.request(
+            "PUT", "/api/namespaces/alice/notebooks/demo-nb/yaml",
+            {"resource": res, "dryRun": True})
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+        dry_persisted = (nb["metadata"].get("labels") or {}).get(
+            "from-editor") == "dry"
+        res["metadata"]["labels"]["from-editor"] = "edited"
+        s2, _ = c.request(
+            "PUT", "/api/namespaces/alice/notebooks/demo-nb/yaml",
+            {"resource": res, "dryRun": False})
+        nb = api.get("kubeflow.org/v1beta1", "Notebook", "demo-nb", "alice")
+        applied = (nb["metadata"].get("labels") or {}).get(
+            "from-editor") == "edited"
+        # Identity pinning: renaming through the editor must 4xx.
+        evil = dict(res, metadata=dict(res["metadata"], name="other"))
+        s3, _ = c.request(
+            "PUT", "/api/namespaces/alice/notebooks/demo-nb/yaml",
+            {"resource": evil, "dryRun": True})
+        check("jwa/yaml_editor_dry_run_apply",
+              s1 == 200 and not dry_persisted and s2 == 200 and applied
+              and 400 <= s3 < 500,
+              f"dry={s1} (persisted={dry_persisted}) apply={s2} "
+              f"rename={s3}")
+
+    def i18n_catalogs():
+        sf, fr = c.get("/lib/i18n/fr.js")
+        se, es = c.get("/lib/i18n/es.js")
+        check("jwa/i18n_catalogs",
+              sf == 200 and "Nouveau notebook" in fr.decode()
+              and se == 200 and "Nuevo notebook" in es.decode(),
+              f"fr={sf} es={se}")
+
+    for fn in (shell_and_assets, list_renders_notebook_row,
+               details_conditions_events_logs,
+               new_notebook_form_creates_cr, form_validation_server_side,
+               csrf_required_on_mutation, stop_sets_annotation,
+               yaml_editor_dry_run_apply, i18n_catalogs):
+        run_scenario(f"jwa/{fn.__name__}", fn)
+    server.shutdown()
+
+
+# ---------------------------------------------------------------- VWA
+
+def vwa_scenarios():
+    app, api = seeded_vwa_app()
+    base, server = serve(app)
+    c = Client(base)
+
+    def pvc_list_details_events():
+        status, raw = c.get("/")
+        html_ok = status == 200 and 'id="pvc-table"' in raw.decode()
+        _, d = c.get_json("/api/namespaces/alice/pvcs")
+        pvcs = {p["name"]: p for p in d["pvcs"]}
+        ws = pvcs.get("workspace") or {}
+        _, ev = c.get_json("/api/namespaces/alice/pvcs/workspace/events")
+        msgs = [e.get("message", "") for e in ev["events"]]
+        check("vwa/pvc_list_details_events",
+              html_ok and ws.get("size") == "10Gi"
+              and ws.get("status") == "Bound"
+              and ws.get("mode") == "ReadWriteOnce"
+              and any("volume bound to pv-123" in m for m in msgs),
+              f"pvc={ws.get('size')}/{ws.get('status')} "
+              f"events={len(msgs)}")
+
+    def viewer_launch_creates_cr():
+        status, _ = c.post_json("/api/namespaces/alice/viewers",
+                                {"pvc": "workspace"})
+        cr = api.get("kubeflow.org/v1alpha1", "PVCViewer", "workspace",
+                     "alice")
+        check("vwa/viewer_launch_creates_cr",
+              status == 200 and cr["spec"]["pvc"] == "workspace",
+              f"status={status}")
+
+    for fn in (pvc_list_details_events, viewer_launch_creates_cr):
+        run_scenario(f"vwa/{fn.__name__}", fn)
+    server.shutdown()
+
+
+# ---------------------------------------------------------- Dashboard
+
+def dashboard_scenarios():
+    app, api = seeded_dashboard_app()
+    base, server = serve(app)
+    c = Client(base)
+
+    def home_fleet_activities_and_user():
+        status, raw = c.get("/")
+        html = raw.decode()
+        _, ns = c.get_json("/api/namespaces")
+        _, fleet = c.get_json("/api/metrics/tpu")
+        _, acts = c.get_json("/api/activities/team-alpha")
+        fleet_txt = json.dumps(fleet)
+        acts_txt = json.dumps(acts)
+        _, env = c.get_json("/api/workgroup/env-info")
+        check("dash/home_fleet_activities_and_user",
+              status == 200 and 'id="fleet-cards"' in html
+              and "team-alpha" in json.dumps(ns)
+              and "tpu-v5-lite-podslice" in fleet_txt
+              and "StatefulSet nb created" in acts_txt
+              and USER in json.dumps(env),
+              f"ns+fleet+activities+user all present")
+
+    def contributor_add_and_remove():
+        s1, d1 = c.post_json("/api/workgroup/add-contributor/team-alpha",
+                             {"contributor": "bob@example.org"})
+
+        def bob_bindings():
+            return [
+                rb for rb in api.list(
+                    "rbac.authorization.k8s.io/v1", "RoleBinding",
+                    namespace="team-alpha")
+                if (rb["metadata"].get("annotations") or {}).get("user")
+                == "bob@example.org"
+            ]
+
+        added = "bob@example.org" in d1.get("contributors", []) \
+            and bool(bob_bindings())
+        s2, raw2 = c.request(
+            "DELETE", "/api/workgroup/remove-contributor/team-alpha",
+            {"contributor": "bob@example.org"})
+        d2 = json.loads(raw2)
+        removed = "bob@example.org" not in d2.get("contributors", []) \
+            and not bob_bindings()
+        check("dash/contributor_add_and_remove",
+              s1 == 200 and added and s2 == 200 and removed,
+              f"add={s1} remove={s2}")
+
+    def i18n_shell_marks():
+        status, raw = c.get("/")
+        html = raw.decode()
+        check("dash/i18n_shell_marks",
+              status == 200 and "data-i18n" in html,
+              "shell carries data-i18n marks (catalog render is "
+              "client-side: CI tier)")
+
+    for fn in (home_fleet_activities_and_user, contributor_add_and_remove,
+               i18n_shell_marks):
+        run_scenario(f"dash/{fn.__name__}", fn)
+    server.shutdown()
+
+
+def main() -> int:
+    jwa_scenarios()
+    vwa_scenarios()
+    dashboard_scenarios()
+    passed = sum(1 for _, st, _ in RESULTS if st == "PASS")
+    failed = len(RESULTS) - passed
+    print(json.dumps({"tier": "browser-wire", "scenarios": len(RESULTS),
+                      "passed": passed, "failed": failed}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,46 +70,13 @@ def app_server():
 @pytest.fixture()
 def seeded_jwa():
     """JWA + fixtures: one running TPU notebook with a pod, logs,
-    events and conditions."""
-    from kubeflow_tpu.apps.jupyter import create_app
-    from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
-    from kubeflow_tpu.k8s.fake import FakeApiServer
+    events and conditions. The seeded state is built by
+    ``testing/browser_serve.py`` — the SAME builder the in-env wire
+    smoke (`testing/browser_smoke.py`) drives, so this tier and the
+    in-env artifact cannot drift apart."""
+    from testing.browser_serve import seeded_jwa_app
 
-    api = FakeApiServer()
-    api.create({"apiVersion": "v1", "kind": "Namespace",
-                "metadata": {"name": "alice"}})
-    api.create({
-        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
-        "metadata": {"name": "demo-nb", "namespace": "alice",
-                     "creationTimestamp": "2026-07-30T06:00:00Z"},
-        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"},
-                 "template": {"spec": {"containers": [{
-                     "name": "demo-nb",
-                     "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest",
-                     "resources": {"requests": {"cpu": "2",
-                                                "memory": "4Gi"}},
-                 }]}}},
-        "status": {"readyReplicas": 1, "conditions": [{
-            "type": "Ready", "status": "True", "reason": "PodsReady",
-            "message": "all replicas ready",
-            "lastTransitionTime": "2026-07-30T06:05:00Z"}]},
-    })
-    api.create({"apiVersion": "v1", "kind": "Pod",
-                "metadata": {"name": "demo-nb-0", "namespace": "alice",
-                             "labels": {"notebook-name": "demo-nb"}},
-                "spec": {}, "status": {"phase": "Running"}})
-    api.set_pod_logs("alice", "demo-nb-0",
-                     "jupyterlab listening on 8888\n"
-                     "TPU v5e 2x4 slice initialised\n")
-    api.create({"apiVersion": "v1", "kind": "Event",
-                "metadata": {"name": "demo-ev1", "namespace": "alice"},
-                "involvedObject": {"kind": "Notebook", "name": "demo-nb"},
-                "reason": "Created",
-                "message": "StatefulSet demo-nb created",
-                "type": "Normal", "count": 1,
-                "lastTimestamp": "2026-07-30T06:01:00Z"})
-    app = create_app(api, authn=AuthnConfig(dev_mode=True),
-                     authorizer=AllowAll(), secure_cookies=False)
+    app, api = seeded_jwa_app()
     url, server = serve_app(app)
     yield url, api
     server.shutdown()

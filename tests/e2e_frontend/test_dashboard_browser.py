@@ -7,59 +7,21 @@ from __future__ import annotations
 
 import pytest
 
-USER = "dev@local"  # AuthnConfig dev_mode identity the browser gets
+# AuthnConfig dev_mode identity the browser gets. Restated as a
+# literal to keep collection playwright-gated; the fixture asserts it
+# matches the shared builder's constant.
+USER = "dev@local"
 
 
 @pytest.fixture()
 def seeded_dashboard(app_server):
-    from kubeflow_tpu.crud_backend import AuthnConfig
-    from kubeflow_tpu.dashboard import KfamProxy, create_app
-    from kubeflow_tpu.k8s.fake import FakeApiServer
-    from kubeflow_tpu.kfam import create_app as create_kfam
+    """Seeded state shared with the in-env wire smoke (single source:
+    testing/browser_serve.py)."""
+    from testing.browser_serve import USER as BUILDER_USER
+    from testing.browser_serve import seeded_dashboard_app
 
-    api = FakeApiServer()
-    api.create({
-        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
-        "metadata": {"name": "team-alpha"},
-        "spec": {"owner": {"kind": "User", "name": USER}},
-    })
-    api.create({"apiVersion": "v1", "kind": "Namespace",
-                "metadata": {"name": "team-alpha"}})
-    # A TPU node + a pod requesting chips: the fleet cards' source data.
-    api.create({
-        "apiVersion": "v1", "kind": "Node",
-        "metadata": {
-            "name": "tpu-node-0",
-            "labels": {
-                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
-                "cloud.google.com/gke-tpu-topology": "2x4",
-            },
-        },
-        "status": {"allocatable": {"google.com/tpu": "4"}},
-    })
-    api.create({
-        "apiVersion": "v1", "kind": "Pod",
-        "metadata": {"name": "nb-0", "namespace": "team-alpha"},
-        "spec": {"nodeName": "tpu-node-0", "containers": [{
-            "name": "nb",
-            "resources": {"limits": {"google.com/tpu": "4"}},
-        }]},
-        "status": {"phase": "Running"},
-    })
-    api.create({
-        "apiVersion": "v1", "kind": "Event",
-        "metadata": {"name": "ev1", "namespace": "team-alpha"},
-        "involvedObject": {"kind": "Notebook", "name": "nb"},
-        "reason": "Created",
-        "message": "StatefulSet nb created",
-        "type": "Normal", "count": 1,
-        "lastTimestamp": "2026-07-30T06:01:00Z",
-    })
-    kfam_app = create_kfam(api, secure_cookies=False)
-    app = create_app(
-        api, kfam=KfamProxy(kfam_app),
-        authn=AuthnConfig(dev_mode=True), secure_cookies=False,
-    )
+    assert USER == BUILDER_USER  # the literal above must track it
+    app, api = seeded_dashboard_app()
     yield app_server(app), api
 
 

@@ -8,32 +8,11 @@ import pytest
 
 @pytest.fixture()
 def seeded_vwa(app_server):
-    from kubeflow_tpu.apps.volumes import create_app
-    from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
-    from kubeflow_tpu.k8s.fake import FakeApiServer
+    """Seeded state shared with the in-env wire smoke (single source:
+    testing/browser_serve.py)."""
+    from testing.browser_serve import seeded_vwa_app
 
-    api = FakeApiServer()
-    api.create({"apiVersion": "v1", "kind": "Namespace",
-                "metadata": {"name": "alice"}})
-    api.create({
-        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
-        "metadata": {"name": "workspace", "namespace": "alice"},
-        "spec": {"accessModes": ["ReadWriteOnce"],
-                 "resources": {"requests": {"storage": "10Gi"}}},
-        "status": {"phase": "Bound"},
-    })
-    api.create({
-        "apiVersion": "v1", "kind": "Event",
-        "metadata": {"name": "ev1", "namespace": "alice"},
-        "involvedObject": {"kind": "PersistentVolumeClaim",
-                           "name": "workspace"},
-        "reason": "ProvisioningSucceeded",
-        "message": "volume bound to pv-123",
-        "type": "Normal", "count": 1,
-        "lastTimestamp": "2026-07-30T06:00:00Z",
-    })
-    app = create_app(api, authn=AuthnConfig(dev_mode=True),
-                     authorizer=AllowAll(), secure_cookies=False)
+    app, api = seeded_vwa_app()
     yield app_server(app), api
 
 
