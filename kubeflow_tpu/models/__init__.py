@@ -35,7 +35,8 @@ _PP_EXPORTS = (
 )
 
 # KV-cache decode/generation — same lazy rule.
-_GEN_EXPORTS = ("KVCache", "forward_with_cache", "generate")
+_GEN_EXPORTS = ("KVCache", "forward_with_cache", "generate",
+                "quantize_decode_params")
 
 
 def __getattr__(name):
@@ -77,6 +78,7 @@ __all__ = [
     "KVCache",
     "forward_with_cache",
     "generate",
+    "quantize_decode_params",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

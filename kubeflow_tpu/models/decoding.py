@@ -97,6 +97,81 @@ DECODE_KERNEL_MIN = int(os.environ.get("KFT_DECODE_KERNEL_MIN",
 DECODE_KERNEL_BLOCK = int(
     os.environ.get("KFT_DECODE_KERNEL_BLOCK", "2048")
 )
+# KFT_DECODE_MM: how decode-step projections multiply. "auto"
+# (default) streams weights through the Pallas GEMV kernel
+# (ops/gemv.py) for thin-row steps on TPU — the round-5 floor A/B
+# measured the XLA matvec chain at ~45% of HBM peak and the tiled
+# kernel 27% faster on the same cycling working set; "dense" forces
+# the plain XLA dots everywhere; "gemv" forces the kernel (interpret
+# mode off-TPU — test use).
+DECODE_MM = os.environ.get("KFT_DECODE_MM", "auto")
+if DECODE_MM not in ("auto", "dense", "gemv"):
+    raise ValueError(
+        f"KFT_DECODE_MM={DECODE_MM!r} must be auto|dense|gemv"
+    )
+
+
+@dataclasses.dataclass
+class Int8Linear:
+    """Weight-only int8 projection: int8 payload + per-output-channel
+    f32 scale (absmax/127 over the contraction axis). Decode streams
+    ~232 MB of weights per token on the flagship — int8 halves that
+    HBM traffic; the upcast rides the VMEM tile (ops/gemv.py) and the
+    rescale is one thin-row multiply after the dot. Built by
+    :func:`quantize_decode_params`; accepted anywhere the decode path
+    multiplies a weight (``_mm``)."""
+
+    w8: jax.Array     # (K, N) int8 — or (N, K) under transpose_w
+    scale: jax.Array  # (N,) f32
+
+
+jax.tree_util.register_dataclass(
+    Int8Linear, data_fields=["w8", "scale"], meta_fields=[])
+
+
+def _quantize_linear(w, axis: int) -> Int8Linear:
+    """Per-output-channel symmetric int8: scale_n = absmax_n / 127
+    over the contraction ``axis``."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=axis)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    w8 = jnp.clip(
+        jnp.round(wf / jnp.expand_dims(scale, axis)), -127, 127
+    ).astype(jnp.int8)
+    return Int8Linear(w8=w8, scale=scale)
+
+
+def _mm(h, kernel, dtype, transpose_w: bool = False):
+    """Decode-step projection ``h (B, T, D) @ kernel`` routed per
+    DECODE_MM. ``kernel`` is an array (cast to ``dtype`` before the
+    dot, like the training path) or an :class:`Int8Linear`.
+    ``transpose_w=True`` contracts kernel's LAST axis ((N, K) layout —
+    the tied embedding) without a transposed copy. Returns f32 (MXU
+    accumulate); callers cast, exactly like a
+    ``preferred_element_type=f32`` dot."""
+    from kubeflow_tpu.ops.gemv import gemv, gemv_fits
+
+    quantized = isinstance(kernel, Int8Linear)
+    w = kernel.w8 if quantized else kernel.astype(dtype)
+    b, t, d = h.shape
+    n = w.shape[0] if transpose_w else w.shape[1]
+    fits = gemv_fits(b * t, d, n)
+    use = fits and (
+        DECODE_MM == "gemv"
+        or (DECODE_MM == "auto" and jax.default_backend() == "tpu")
+    )
+    if use:
+        y = gemv(h.reshape(b * t, d), w,
+                 transpose_w=transpose_w).reshape(b, t, n)
+    else:
+        dims = ((((2,), (1,)), ((), ())) if transpose_w
+                else (((2,), (0,)), ((), ())))
+        # Dense fallback upcasts the int8 tile exactly like the
+        # kernel would (dot in the compute dtype, f32 accumulate).
+        y = jax.lax.dot_general(h, w.astype(dtype) if quantized else w,
+                                dims,
+                                preferred_element_type=jnp.float32)
+    return y * kernel.scale if quantized else y
 
 
 @dataclasses.dataclass
@@ -237,6 +312,12 @@ def stack_decode_params(cfg: LMConfig, params: dict[str, Any],
             "the scanned decode path requires uniform layers - pass the "
             "raw params pytree instead"
         )
+    if isinstance(params["embed"]["embedding"], Int8Linear):
+        raise ValueError(
+            "stack_decode_params takes the raw training pytree; "
+            "int8 decode weights (quantize_decode_params) run the "
+            "unrolled path"
+        )
     dt = cfg.dtype
     blocks = [params[f"block_{i}"] for i in range(cfg.layers)]
 
@@ -262,6 +343,35 @@ def stack_decode_params(cfg: LMConfig, params: dict[str, Any],
         embed=params["embed"]["embedding"].astype(dt),
         final_norm=params["final_norm"]["scale"],
     )
+
+
+def quantize_decode_params(cfg: LMConfig, params: dict[str, Any]
+                           ) -> dict[str, Any]:
+    """Weight-only int8 view of the training pytree for decoding
+    (W8A16: int8 weights, bf16 activations, f32 accumulate). Halves
+    the per-token weight stream that bounds b1 decode (BASELINE.md
+    round-5 floor decomposition). Same nesting as the training pytree
+    — pass the result anywhere ``forward_with_cache``/``generate``
+    take ``params``. Per-output-channel symmetric scales; norms stay
+    f32; MoE expert weights stay unquantized (the MoE FFN runs the
+    training layer verbatim). One-time cost; do it outside the decode
+    loop."""
+    quant = {"q_proj", "k_proj", "v_proj", "proj", "up", "down"}
+    out: dict[str, Any] = {}
+    for key, sub in params.items():
+        if key.startswith("block_"):
+            out[key] = {
+                name: ({"kernel": _quantize_linear(leaf["kernel"],
+                                                   axis=0)}
+                       if name in quant else leaf)
+                for name, leaf in sub.items()
+            }
+        elif key == "embed":
+            out[key] = {"embedding": _quantize_linear(
+                sub["embedding"], axis=1)}
+        else:
+            out[key] = sub
+    return out
 
 
 def _quantize_rows(x):
@@ -583,7 +693,9 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     Mirrors transformer.Block exactly (same param names/shapes)."""
     b, t, _ = x.shape
     h = rms_norm(params["RMSNorm_0"]["scale"], x)
-    proj = lambda name: (h @ params[name]["kernel"].astype(cfg.dtype))
+    proj = lambda name: _mm(
+        h, params[name]["kernel"], cfg.dtype
+    ).astype(cfg.dtype)
     q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
 
     def heads(tensor, n):
@@ -598,7 +710,8 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
         cfg, q, k, v, ck, cv, pos, empty, rolling, ks_buf, vs_buf
     )
     out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
-    x = x + out @ params["proj"]["kernel"].astype(cfg.dtype)
+    x = x + _mm(out, params["proj"]["kernel"], cfg.dtype
+                ).astype(cfg.dtype)
 
     h = rms_norm(params["RMSNorm_1"]["scale"], x)
     if use_moe:
@@ -610,8 +723,10 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
 
         x = x + MoEFFN(cfg).apply({"params": params["moe"]}, h)
     else:
-        h = jax.nn.gelu(h @ params["up"]["kernel"].astype(cfg.dtype))
-        x = x + h @ params["down"]["kernel"].astype(cfg.dtype)
+        h = jax.nn.gelu(
+            _mm(h, params["up"]["kernel"], cfg.dtype).astype(cfg.dtype))
+        x = x + _mm(h, params["down"]["kernel"], cfg.dtype
+                    ).astype(cfg.dtype)
     return x, ck, cv, ks_buf, vs_buf
 
 
@@ -635,6 +750,11 @@ def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache):
             n0, qkv_k, proj_k, n1, up_k, down_k, ck, cv = xs
             ksb = vsb = None
         h = rms_norm(n0, x)
+        # NOTE: the stacked path keeps plain XLA dots — routing these
+        # through the Pallas GEMV measured 1.16 ms/step vs 0.61
+        # unrolled (the per-layer slices of the stacked arrays force a
+        # weight copy ahead of each pallas_call; the unrolled path's
+        # per-layer arrays feed the kernel in place).
         qkv = (h @ qkv_k).reshape(b, t, hq + 2 * hkv, hd)
         qkv = qkv.transpose(0, 2, 1, 3)  # (B, hq+2*hkv, T, hd)
         qk = apply_rope(qkv[:, :hq + hkv], offset=pos)
@@ -704,7 +824,13 @@ def forward_with_cache(
     if isinstance(params, StackedDecodeParams):
         return _forward_stacked(cfg, params, tokens, cache)
     emb = params["embed"]["embedding"]
-    x = emb[tokens].astype(cfg.dtype)
+    if isinstance(emb, Int8Linear):
+        # Quantized tied embedding: int8 gather + the gathered rows'
+        # scales (the (V,) scale vector is per vocab row).
+        x = (emb.w8[tokens].astype(cfg.dtype)
+             * emb.scale[tokens][..., None].astype(cfg.dtype))
+    else:
+        x = emb[tokens].astype(cfg.dtype)
     quantized = cache.quantized
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(cfg.layers):
@@ -724,7 +850,10 @@ def forward_with_cache(
         new_ks.append(ks)
         new_vs.append(vs)
     x = rms_norm(params["final_norm"]["scale"], x)
-    logits = tied_head(x, emb, cfg.dtype)
+    # The tied head is the single largest weight read (vocab x D);
+    # route it through _mm like the block projections (transpose_w:
+    # the embedding stays (vocab, D), no transposed copy).
+    logits = _mm(x.astype(cfg.dtype), emb, cfg.dtype, transpose_w=True)
     cache = KVCache(
         k=jnp.stack(new_k), v=jnp.stack(new_v),
         length=pos + tokens.shape[1],
@@ -744,6 +873,7 @@ def generate(
     temperature: float = 0.0,
     rng: jax.Array | None = None,
     quantize_cache: bool = False,
+    quantize_weights: bool = False,
 ):
     """Greedy (temperature=0) or temperature sampling. ``prompt``
     (B, P) int32; returns (B, max_new_tokens) int32. Jit-compatible:
@@ -753,6 +883,11 @@ def generate(
 
     ``rng`` is required when ``temperature > 0``: a silent fixed-seed
     default would make every sampling call return identical tokens.
+
+    ``quantize_weights`` decodes through a weight-only int8 view of
+    ``params`` (W8A16, :func:`quantize_decode_params`) — half the
+    per-token weight stream; pre-quantized pytrees can equally be
+    passed as ``params`` directly.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -768,6 +903,13 @@ def generate(
             "pass rng=jax.random.key(...) (a fixed default would return "
             "identical samples on every call)"
         )
+    if quantize_weights:
+        if isinstance(params, StackedDecodeParams):
+            raise ValueError(
+                "quantize_weights takes the raw training pytree, not "
+                "StackedDecodeParams"
+            )
+        params = quantize_decode_params(cfg, params)
     b, p = prompt.shape
     # The last generated token is never fed back, so its K/V slot is
     # not needed. Sliding-window models take the rolling cache when the

@@ -1,0 +1,114 @@
+"""Weight-streaming GEMV kernel for single-token decode.
+
+The round-5 floor decomposition (`testing/ab_decode_floor.py`) showed
+b1 decode is bound by the bare (1, K) x (K, N) matmul chain: XLA
+streams the ~232 MB of layer weights at ~45% of v5e HBM peak on thin
+matvecs. This kernel tiles the weight into (K, block_n) VMEM blocks and
+lets the Pallas pipeline double-buffer the HBM reads — measured 27%
+faster than the XLA chain on the same cycling working set (0.59 vs
+0.81 ms/step for the flagship's bare matmuls; see BASELINE.md round-5).
+
+Scope: tiny-row activations (decode steps), weights resident in HBM.
+Not for training/prefill shapes — the MXU path with big M already
+overlaps fine there. Callers dispatch (see models/decoding.py
+``_mm``); :func:`gemv` itself raises on shapes it would serve badly
+rather than silently running slow.
+
+No reference counterpart (the reference platform ships no model code);
+part of the compute stack in the jupyter-jax-tpu images.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Double-buffered (K, block_n) tiles must fit VMEM alongside x/out:
+# cap one tile's payload. 512-wide blocks measured best on v5e for
+# K=1024 (see testing/ab_decode_floor.py arms); the cap mostly
+# matters for K=4096 (the MLP down-projection).
+_TILE_BYTES_CAP = 4 * 1024 * 1024
+MAX_ROWS = 8  # beyond this the MXU M-dim is busy enough for XLA
+
+
+def _pick_block(k: int, n: int, itemsize: int, block_n: int) -> int:
+    """Largest 128-multiple divisor of ``n`` that is <= ``block_n``
+    and whose (k, bn) tile fits the VMEM budget. Plain halving would
+    break Mosaic's lane alignment for non-power-of-two N (384 -> 96);
+    n is 128-aligned by the caller's contract, so 128 always divides
+    it and is the floor (the budget is soft there: a single-column
+    block must ship regardless of K)."""
+    best = 128
+    for bn in range(256, min(block_n, n) + 1, 128):
+        if n % bn == 0 and k * bn * itemsize <= _TILE_BYTES_CAP:
+            best = bn
+    return best
+
+
+def _kernel(x_ref, w_ref, o_ref, *, transpose_w: bool):
+    w = w_ref[:]
+    if w.dtype == jnp.int8:
+        # Weight-only int8: the HBM read is int8 (half the traffic);
+        # the upcast happens on the VMEM tile. The per-output-channel
+        # scale is applied by the caller AFTER the dot (equivalent to
+        # scaling the columns, one multiply on a thin row instead of
+        # K x bn).
+        w = w.astype(x_ref.dtype)
+    if transpose_w:
+        # w tile is (bn, K); contract x's K with w's K.
+        o_ref[:] = jax.lax.dot_general(
+            x_ref[:], w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        o_ref[:] = jnp.dot(x_ref[:], w,
+                           preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("transpose_w", "block_n", "interpret"))
+def gemv(x: jax.Array, w: jax.Array, *, transpose_w: bool = False,
+         block_n: int = 512, interpret: bool | None = None) -> jax.Array:
+    """(R, K) @ (K, N) -> (R, N) f32, streaming ``w`` in VMEM tiles.
+
+    ``transpose_w=True`` takes ``w`` as (N, K) and contracts its last
+    axis — the tied-head layout ((vocab, dim) embedding) without
+    materialising a transposed copy. Inputs should already be the
+    compute dtype (bf16); accumulation and output are f32 (same MXU
+    accumulate-then-round contract as the XLA path, so callers cast
+    the result exactly like a ``preferred_element_type=f32`` dot).
+    """
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(f"gemv wants 2-D x and w, got {x.shape} @ {w.shape}")
+    rows, k = x.shape
+    n, wk = (w.shape if transpose_w else (w.shape[1], w.shape[0]))
+    if wk != k:
+        raise ValueError(f"contraction mismatch: x {x.shape}, w {w.shape} "
+                         f"(transpose_w={transpose_w})")
+    if rows > MAX_ROWS:
+        raise ValueError(f"gemv is a thin-row kernel (rows <= {MAX_ROWS}); "
+                         f"got {rows} — use a plain dot")
+    if k % 128 or n % 128:
+        raise ValueError(f"K and N must be 128-aligned for Mosaic tiling; "
+                         f"got K={k}, N={n}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bn = _pick_block(k, n, w.dtype.itemsize, block_n)
+    w_spec = (pl.BlockSpec((bn, k), lambda i: (i, 0)) if transpose_w
+              else pl.BlockSpec((k, bn), lambda i: (0, i)))
+    return pl.pallas_call(
+        functools.partial(_kernel, transpose_w=transpose_w),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((rows, k), lambda i: (0, 0)), w_spec],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+        interpret=interpret,
+    )(x, w)
+
+
+def gemv_fits(rows: int, k: int, n: int) -> bool:
+    """True when :func:`gemv` accepts these shapes."""
+    return rows <= MAX_ROWS and k % 128 == 0 and n % 128 == 0

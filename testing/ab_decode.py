@@ -6,10 +6,12 @@ decode swings 15-25% (BASELINE.md variance note). Run on the real chip:
 
 Arms (per config, traced fresh per call so module-constant overrides
 take effect):
-  base          round-4 production: raw params pytree + dense read
+  base          round-4 production: raw params pytree + dense read,
+                plain XLA projection dots (KFT_DECODE_MM=dense)
+  gemv          raw pytree + dense read + Pallas weight-streaming
+                projections (ops/gemv.py; round-5 production auto)
   fused         StackedDecodeParams (fused qkv, pre-cast bf16, no scan)
                 + dense read
-  fused-scan    same but lax.scan over layers
   kernel-<B>    fused + Pallas flash-decode, cache block B
                 (bf16 non-rolling configs only)
 
@@ -42,9 +44,10 @@ CONFIGS = {
 KERNEL_BLOCKS = (1024, 2048, 4096)
 
 
-def run_arm(kw, path, impl, block=None):
+def run_arm(kw, path, impl, block=None, mm="dense"):
     os.environ["KFT_BENCH_DECODE_PATH"] = path
     decoding.DECODE_IMPL = impl
+    decoding.DECODE_MM = mm
     if block is not None:
         decoding.DECODE_KERNEL_BLOCK = block
     r = bench.bench_decode(prefill_anchor=None, decode_anchor=None,
@@ -62,6 +65,10 @@ def main():
         kw = CONFIGS[name]
         row = {"config": name}
         row["base"] = run_arm(kw, "unrolled", "dense")
+        row["gemv"] = run_arm(kw, "unrolled", "dense", mm="gemv")
+        if not kw.get("quantized"):
+            row["w8"] = run_arm(dict(kw, weight_int8=True), "unrolled",
+                                "dense", mm="gemv")
         row["fused"] = run_arm(kw, "stacked", "dense")
         kernel_ok = not kw.get("quantized") and not kw.get("window")
         if kernel_ok:

@@ -134,12 +134,49 @@ def main():
         x, _ = jax.lax.scan(step, x0, None, length=STEPS)
         return x
 
+    # Pallas GEMV arm: same bare-matmul chain through the PRODUCTION
+    # kernel (ops/gemv.py) — the A/B must measure the code that ships,
+    # not a local reimplementation whose block picker could diverge.
+    from kubeflow_tpu.ops.gemv import gemv  # noqa: E402
+
+    def pgemv(x, wmat, block_n):
+        k = wmat.shape[0]
+        y = gemv(x.reshape(1, k), wmat, block_n=block_n)
+        return y.reshape(x.shape[:-1] + (wmat.shape[1],))
+
+    def make_arm_pallas(block_n):
+        @jax.jit
+        def arm_matmuls_pallas(w, emb, x0):
+            def step(x, _):
+                for blk in w:
+                    q = pgemv(x, blk["q_proj"], block_n)
+                    k = pgemv(x, blk["k_proj"], block_n)
+                    v = pgemv(x, blk["v_proj"], block_n)
+                    x = x + pgemv(q.astype(jnp.bfloat16), blk["proj"],
+                                  block_n)
+                    h = jax.nn.gelu(pgemv(x, blk["up"], block_n))
+                    x = (x + pgemv(h.astype(jnp.bfloat16), blk["down"],
+                                   block_n)
+                         + jnp.sum(k) + jnp.sum(v)).astype(jnp.bfloat16)
+                logits = pgemv(x, emb.T, block_n)
+                out = x * 0.999 + logits[..., :1, :1024] * 1e-6
+                return out.astype(jnp.bfloat16), None
+
+            x, _ = jax.lax.scan(step, x0, None, length=STEPS)
+            return x
+
+        return arm_matmuls_pallas
+
     results = {
         "matmuls_only_ms": timed(arm_matmuls, w, emb, x0),
         "matmuls_fused_qkv_ms": timed(arm_matmuls_fused_qkv, w, emb,
                                       x0),
         "plus_norms_rope_ms": timed(arm_norms_rope, w, emb, x0),
     }
+    # 4096 is not swept: gemv's VMEM cap clamps it back to 2048.
+    for bn in (512, 1024, 2048):
+        results[f"matmuls_pallas_b{bn}_ms"] = timed(
+            make_arm_pallas(bn), w, emb, x0)
 
     # Full production step at p1024 for reference, same process.
     cache0 = KVCache.init(cfg, 1, 1024 + STEPS)

@@ -87,3 +87,32 @@ def test_compact_line_records_failed_sections_by_name():
     )
     row = compact["sections"]["long_context_32k"]
     assert row == {"err": "x" * 60}  # bounded, attributable
+
+
+R05_SECTION_NAMES = SECTION_NAMES + [
+    "lm_decode_tokens_per_sec_per_chip[b1-p32k-w1k]",
+    "lm_decode_tokens_per_sec_per_chip[b1-w8]",
+    "lm_decode_tokens_per_sec_per_chip[b1-p8k-w8]",
+]
+
+
+def test_compact_line_fits_with_round5_sections():
+    """The round-5 sections table is 16 entries (chunked-rolling row +
+    two weight-int8 rows); the compact line must still clear the
+    driver's ~2000-char tail window with headroom."""
+    record = _r04_record()
+    record["extra_metrics"] = list(record["extra_metrics"]) + [
+        {"metric": "lm_decode_tokens_per_sec_per_chip", "value": 878.0,
+         "vs_baseline": 1.0, "prefill_vs_baseline": 1.0},
+        {"metric": "lm_decode_tokens_per_sec_per_chip", "value": 1330.2,
+         "vs_baseline": 1.0003},
+        {"metric": "lm_decode_tokens_per_sec_per_chip", "value": 800.4,
+         "vs_baseline": 1.0005},
+    ]
+    compact = bench.compact_record(
+        record, R05_SECTION_NAMES, "testing/bench_full.json"
+    )
+    line = json.dumps(compact)
+    assert len(line) < 1900, f"compact line {len(line)} chars"
+    assert compact["sections"]["decode[b1-w8]"]["v"] == 1330.2
+    assert "pvs" not in compact["sections"]["decode[b1-w8]"]

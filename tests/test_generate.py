@@ -538,3 +538,140 @@ class TestQuantizedCache:
         assert out.shape == (2, 4)
         assert np.all((np.asarray(out) >= 0) &
                       (np.asarray(out) < cfg.vocab))
+
+
+def test_decode_mm_gemv_matches_dense():
+    """KFT_DECODE_MM=gemv (the Pallas weight-streaming projections,
+    interpret mode here) must reproduce the dense decode exactly at
+    the token level and closely at the logits level. 128-aligned dims
+    so the projections actually route through the kernel; the k/v
+    projections (N=64) fall back to the dense dot via gemv_fits —
+    the mixed routing is the production "auto" shape."""
+    from kubeflow_tpu.models import decoding
+
+    cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16)
+    model, params, tokens = _setup(cfg, seq=12, batch=1, seed=3)
+    prev = decoding.DECODE_MM
+    out = {}
+    try:
+        for mode in ("dense", "gemv"):
+            decoding.DECODE_MM = mode
+            jax.clear_caches()
+            out[mode] = {}
+            out[mode]["tokens"] = decoding.generate(
+                cfg, params, tokens, 8)
+            cache = KVCache.init(cfg, 1, 32)
+            out[mode]["logits"], _ = forward_with_cache(
+                cfg, params, tokens, cache)
+    finally:
+        decoding.DECODE_MM = prev
+        jax.clear_caches()
+    np.testing.assert_array_equal(np.asarray(out["gemv"]["tokens"]),
+                                  np.asarray(out["dense"]["tokens"]))
+    np.testing.assert_allclose(
+        np.asarray(out["gemv"]["logits"]),
+        np.asarray(out["dense"]["logits"]), rtol=2e-2, atol=2e-2,
+    )
+
+
+class TestInt8Weights:
+    """Weight-only int8 decode (W8A16, quantize_decode_params): half
+    the per-token weight stream. Quantized numerics differ from bf16
+    by construction, so parity is pinned BETWEEN implementations of
+    the quantized path (kernel vs dense fallback), plus a quality
+    bound against the bf16 decode."""
+
+    CFG = LMConfig(vocab=256, layers=2, dim=128, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16)
+
+    def test_quantization_reconstruction(self):
+        from kubeflow_tpu.models.decoding import quantize_decode_params
+
+        cfg = self.CFG
+        _, params, _ = _setup(cfg, seq=12, batch=1)
+        qp = quantize_decode_params(cfg, params)
+        w = np.asarray(params["block_0"]["up"]["kernel"])
+        ql = qp["block_0"]["up"]["kernel"]
+        rec = np.asarray(ql.w8, np.float32) * np.asarray(ql.scale)
+        # Per-channel absmax/127: worst-case error is scale/2 per entry.
+        assert np.abs(rec - w).max() <= np.asarray(ql.scale).max()
+        assert ql.w8.dtype == jnp.int8
+        # Norm scales and the cache-side params are untouched.
+        assert qp["block_0"]["RMSNorm_0"] is params["block_0"]["RMSNorm_0"]
+
+    def test_gemv_matches_dense_fallback(self):
+        """The Pallas int8 tile upcast must equal the dense fallback's
+        upcast-dot bit-for-bit at the token level."""
+        from kubeflow_tpu.models import decoding
+        from kubeflow_tpu.models.decoding import quantize_decode_params
+
+        cfg = self.CFG
+        _, params, tokens = _setup(cfg, seq=12, batch=1, seed=5)
+        qp = quantize_decode_params(cfg, params)
+        prev = decoding.DECODE_MM
+        out = {}
+        try:
+            for mode in ("dense", "gemv"):
+                decoding.DECODE_MM = mode
+                jax.clear_caches()
+                out[mode] = decoding.generate(cfg, qp, tokens, 8)
+        finally:
+            decoding.DECODE_MM = prev
+            jax.clear_caches()
+        np.testing.assert_array_equal(np.asarray(out["dense"]),
+                                      np.asarray(out["gemv"]))
+
+    def test_quality_close_to_bf16(self):
+        from kubeflow_tpu.models.decoding import quantize_decode_params
+
+        cfg = self.CFG
+        _, params, tokens = _setup(cfg, seq=12, batch=1, seed=7)
+        qp = quantize_decode_params(cfg, params)
+        cache = KVCache.init(cfg, 1, 32)
+        lg8, _ = forward_with_cache(cfg, qp, tokens, cache)
+        cache = KVCache.init(cfg, 1, 32)
+        lgf, _ = forward_with_cache(cfg, params, tokens, cache)
+        rel = np.abs(np.asarray(lg8) - np.asarray(lgf)).max() / (
+            np.abs(np.asarray(lgf)).max() + 1e-9)
+        assert rel < 0.05, f"int8 logits drifted {rel:.3f} from bf16"
+
+    def test_generate_flag_equals_prequantized(self):
+        from kubeflow_tpu.models import decoding
+        from kubeflow_tpu.models.decoding import quantize_decode_params
+
+        cfg = self.CFG
+        _, params, tokens = _setup(cfg, seq=12, batch=1, seed=9)
+        t1 = decoding.generate(cfg, params, tokens, 6,
+                               quantize_weights=True)
+        t2 = decoding.generate(
+            cfg, quantize_decode_params(cfg, params), tokens, 6)
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+    def test_composes_with_int8_kv_cache_and_rolling(self):
+        """w8 weights + int8 KV cache, and w8 + rolling window, both
+        decode without error and track their bf16-weight twins."""
+        from kubeflow_tpu.models import decoding
+
+        cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4,
+                       kv_heads=2, dtype=jnp.bfloat16, attn_window=8)
+        _, params, tokens = _setup(cfg, seq=12, batch=1, seed=11)
+        out_w8 = decoding.generate(cfg, params, tokens, 6,
+                                   quantize_cache=True,
+                                   quantize_weights=True)
+        assert out_w8.shape == (1, 6)
+        assert int(out_w8.max()) < cfg.vocab
+
+    def test_stacked_params_rejected(self):
+        from kubeflow_tpu.models import decoding
+        from kubeflow_tpu.models.decoding import (
+            quantize_decode_params, stack_decode_params,
+        )
+
+        cfg = self.CFG
+        _, params, tokens = _setup(cfg, seq=12, batch=1)
+        sp = stack_decode_params(cfg, params)
+        with pytest.raises(ValueError, match="raw training pytree"):
+            decoding.generate(cfg, sp, tokens, 4, quantize_weights=True)
+        with pytest.raises(ValueError, match="unrolled path"):
+            stack_decode_params(cfg, quantize_decode_params(cfg, params))

@@ -1072,3 +1072,86 @@ class TestFusedCE:
             ),
             states["fused"][0].params, states["dense"][0].params,
         )
+
+
+class TestGemv:
+    """ops/gemv.py: the weight-streaming decode GEMV (interpret mode
+    on CPU; the real-chip win is recorded in testing/ab_decode_floor.py
+    and BASELINE.md round-5)."""
+
+    def test_matches_xla_dot(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 256)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((256, 512)), jnp.bfloat16)
+        from kubeflow_tpu.ops.gemv import gemv
+
+        ref = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        for block_n in (128, 256, 512):
+            np.testing.assert_allclose(
+                np.asarray(gemv(x, w, block_n=block_n)),
+                np.asarray(ref), rtol=1e-5, atol=1e-5,
+            )
+
+    def test_transposed_weight_layout(self):
+        """transpose_w contracts w's LAST axis — the (vocab, dim) tied
+        embedding without a transposed copy."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((2, 256)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((512, 256)), jnp.bfloat16)
+        from kubeflow_tpu.ops.gemv import gemv
+
+        ref = jax.lax.dot_general(
+            x, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(gemv(x, w, transpose_w=True, block_n=128)),
+            np.asarray(ref), rtol=1e-5, atol=1e-5,
+        )
+
+    def test_rejects_bad_shapes(self):
+        from kubeflow_tpu.ops.gemv import MAX_ROWS, gemv, gemv_fits
+
+        x = jnp.zeros((1, 256), jnp.bfloat16)
+        with pytest.raises(ValueError, match="contraction mismatch"):
+            gemv(x, jnp.zeros((128, 256), jnp.bfloat16))
+        with pytest.raises(ValueError, match="128-aligned"):
+            gemv(jnp.zeros((1, 100), jnp.bfloat16),
+                 jnp.zeros((100, 256), jnp.bfloat16))
+        with pytest.raises(ValueError, match="thin-row"):
+            gemv(jnp.zeros((MAX_ROWS + 1, 256), jnp.bfloat16),
+                 jnp.zeros((256, 256), jnp.bfloat16))
+        assert gemv_fits(1, 256, 512)
+        assert not gemv_fits(MAX_ROWS + 1, 256, 512)
+        assert not gemv_fits(1, 100, 512)
+
+    def test_vmem_cap_shrinks_block(self):
+        """The block picker halves block_n until a double-buffered tile
+        fits the VMEM budget (the K=4096 down-projection case)."""
+        from kubeflow_tpu.ops.gemv import _TILE_BYTES_CAP, _pick_block
+
+        bn = _pick_block(4096, 1024, 2, 1024)
+        assert 4096 * bn * 2 <= _TILE_BYTES_CAP
+        assert 1024 % bn == 0
+
+    def test_block_stays_lane_aligned_for_non_pow2_n(self):
+        """N=384 (3x128, a GQA kv width) must never yield a 96-wide
+        block — every candidate divides N and is a 128 multiple."""
+        from kubeflow_tpu.ops.gemv import _pick_block, gemv
+
+        for k in (256, 8192):
+            bn = _pick_block(k, 384, 2, 512)
+            assert bn % 128 == 0 and 384 % bn == 0
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((1, 256)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((256, 384)), jnp.bfloat16)
+        ref = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        np.testing.assert_allclose(np.asarray(gemv(x, w)),
+                                   np.asarray(ref), rtol=1e-5,
+                                   atol=1e-5)
