@@ -211,11 +211,13 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
                              quantized=quantized)
         if prefill_chunk is None:
             logits, cache = forward_with_cache(cfg, params, prompt,
-                                               cache)
+                                               cache,
+                                               last_logits_only=True)
             last = logits[:, -1]
         else:
             logits, cache = forward_with_cache(
-                cfg, params, prompt[:, :prefill_chunk], cache
+                cfg, params, prompt[:, :prefill_chunk], cache,
+                last_logits_only=True,
             )
             last = logits[:, -1]
             rest = prompt[:, prefill_chunk:]
@@ -225,8 +227,9 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
                 ).transpose(1, 0, 2)
 
                 def one_chunk(cache, toks):
-                    lg, cache = forward_with_cache(cfg, params, toks,
-                                                   cache)
+                    lg, cache = forward_with_cache(
+                        cfg, params, toks, cache, last_logits_only=True
+                    )
                     return cache, lg[:, -1]
 
                 cache, lasts = jax.lax.scan(one_chunk, cache, chunks)

@@ -730,7 +730,8 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     return x, ck, cv, ks_buf, vs_buf
 
 
-def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache):
+def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache,
+                     last_logits_only=False):
     """Fused decode forward over stacked params: one qkv matmul per
     layer, q+k roped in one call, weights pre-cast to the compute
     dtype. Layers run unrolled by default (sp.scan docs the measured
@@ -783,6 +784,8 @@ def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache):
             out_layers.append(y)
         ys = tuple(jnp.stack(parts) for parts in zip(*out_layers))
     x = rms_norm(sp.final_norm, x)
+    if last_logits_only:
+        x = x[:, -1:]
     logits = tied_head(x, sp.embed, cfg.dtype)
     new_cache = KVCache(
         k=ys[0], v=ys[1], length=pos + t,
@@ -796,6 +799,7 @@ def _forward_stacked(cfg, sp: StackedDecodeParams, tokens, cache):
 def forward_with_cache(
     cfg: LMConfig, params: dict[str, Any] | StackedDecodeParams,
     tokens: jax.Array, cache: KVCache,
+    last_logits_only: bool = False,
 ):
     """Run ``tokens`` (B, T) through the model starting at the cache's
     current length; returns (logits (B, T, vocab) f32, updated cache).
@@ -803,6 +807,13 @@ def forward_with_cache(
     the training pytree (unrolled per-layer loop — the production
     path) or a :class:`StackedDecodeParams` (opt-in fused/stacked
     execution shape; see its docstring for the measured tradeoff).
+
+    ``last_logits_only=True`` computes the head for the FINAL position
+    only (returns (B, 1, vocab)) — what a prefill-then-sample caller
+    needs. The full-positions head materialises a (B, T, vocab) f32
+    tensor, which at a 128k prompt is 17 GB (an outright OOM) and at
+    32k is 4.3 GB of pure waste; teacher-forced scoring keeps the
+    default.
 
     Contract: ``cache.length + T`` must not exceed the cache's max_len
     — ``dynamic_update_slice`` would CLAMP an overflowing write (JAX
@@ -822,7 +833,8 @@ def forward_with_cache(
             f"new tokens > max_len {max_len}"
         )
     if isinstance(params, StackedDecodeParams):
-        return _forward_stacked(cfg, params, tokens, cache)
+        return _forward_stacked(cfg, params, tokens, cache,
+                                last_logits_only)
     emb = params["embed"]["embedding"]
     if isinstance(emb, Int8Linear):
         # Quantized tied embedding: int8 gather + the gathered rows'
@@ -850,6 +862,12 @@ def forward_with_cache(
         new_ks.append(ks)
         new_vs.append(vs)
     x = rms_norm(params["final_norm"]["scale"], x)
+    if last_logits_only:
+        # Prefill callers only consume logits[:, -1]; computing the
+        # head for every position materialises a (B, T, vocab) f32
+        # tensor that OOMs at 128k prompts (17 GB at T=131072) and
+        # costs T x the head FLOPs for nothing.
+        x = x[:, -1:]
     # The tied head is the single largest weight read (vocab x D);
     # route it through _mm like the block projections (transpose_w:
     # the embedding stays (vocab, D), no transposed copy).
@@ -919,7 +937,8 @@ def generate(
     rolling = cfg.attn_window is not None and cfg.attn_window < total
     cache = KVCache.init(cfg, b, total, rolling=rolling,
                          quantized=quantize_cache)
-    logits, cache = forward_with_cache(cfg, params, prompt, cache)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache,
+                                       last_logits_only=True)
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path below
     first_key, step_key = jax.random.split(rng)

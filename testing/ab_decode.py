@@ -39,6 +39,11 @@ CONFIGS = {
     "b8-p8k-int8": dict(batch=8, prompt_len=8192, new_tokens=64,
                         quantized=True),
     "b1-p32k": dict(batch=1, prompt_len=32768, new_tokens=64),
+    # 128k-cache regime (run with KFT_BENCH_PREFILL_REPS=1 — the
+    # default 8 independent 128k prefills per timed pass are pure
+    # warm-up cost at this scale): ~1.07 GB bf16 cache, flash-decode
+    # auto threshold well exceeded.
+    "b1-p128k": dict(batch=1, prompt_len=131072, new_tokens=32),
 }
 
 KERNEL_BLOCKS = (1024, 2048, 4096)

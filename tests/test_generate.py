@@ -675,3 +675,37 @@ class TestInt8Weights:
             decoding.generate(cfg, sp, tokens, 4, quantize_weights=True)
         with pytest.raises(ValueError, match="unrolled path"):
             stack_decode_params(cfg, quantize_decode_params(cfg, params))
+
+
+def test_last_logits_only_matches_full_head():
+    """Prefill with last_logits_only must equal the full head's final
+    position — for the raw pytree, the stacked params, and the int8
+    view — and generate (which now prefills this way) must be
+    unchanged."""
+    from kubeflow_tpu.models import decoding
+    from kubeflow_tpu.models.decoding import (
+        quantize_decode_params, stack_decode_params,
+    )
+
+    cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16)
+    _, params, tokens = _setup(cfg, seq=12, batch=2, seed=13)
+    variants = {
+        "raw": params,
+        "stacked": stack_decode_params(cfg, params),
+        "w8": quantize_decode_params(cfg, params),
+    }
+    for name, p in variants.items():
+        cache = KVCache.init(cfg, 2, 32)
+        full, _ = forward_with_cache(cfg, p, tokens, cache)
+        cache = KVCache.init(cfg, 2, 32)
+        last, cache2 = forward_with_cache(cfg, p, tokens, cache,
+                                          last_logits_only=True)
+        assert last.shape == (2, 1, cfg.vocab), name
+        np.testing.assert_allclose(np.asarray(last[:, 0]),
+                                   np.asarray(full[:, -1]),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=name)
+        assert int(cache2.length) == tokens.shape[1], name
+    out = decoding.generate(cfg, params, tokens, 6)
+    assert out.shape == (2, 6)
