@@ -709,3 +709,25 @@ def test_last_logits_only_matches_full_head():
         assert int(cache2.length) == tokens.shape[1], name
     out = decoding.generate(cfg, params, tokens, 6)
     assert out.shape == (2, 6)
+
+
+def test_int8_weights_moe_quantizes_attention_only():
+    """On a MoE model, quantize_decode_params quantizes the attention
+    projections and the embedding but leaves expert weights (the MoE
+    FFN runs the training layer verbatim); decode stays functional."""
+    from kubeflow_tpu.models import decoding
+    from kubeflow_tpu.models.decoding import (
+        Int8Linear, quantize_decode_params,
+    )
+
+    cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16, moe_experts=4, moe_every=2)
+    _, params, tokens = _setup(cfg, seq=12, batch=1, seed=17)
+    qp = quantize_decode_params(cfg, params)
+    assert isinstance(qp["block_0"]["q_proj"]["kernel"], Int8Linear)
+    assert isinstance(qp["embed"]["embedding"], Int8Linear)
+    moe_blk = qp["block_1"]
+    assert "moe" in moe_blk and moe_blk["moe"] is params["block_1"]["moe"]
+    out = decoding.generate(cfg, qp, tokens, 6)
+    assert out.shape == (1, 6)
+    assert int(out.max()) < cfg.vocab
