@@ -38,6 +38,9 @@ _PP_EXPORTS = (
 _GEN_EXPORTS = ("KVCache", "forward_with_cache", "generate",
                 "quantize_decode_params")
 
+# Continuous-batching serving loop — same lazy rule.
+_SERVING_EXPORTS = ("ContinuousBatcher", "BatchState")
+
 
 def __getattr__(name):
     if name in _LM_EXPORTS:
@@ -56,6 +59,10 @@ def __getattr__(name):
         from kubeflow_tpu.models import checkpoint
 
         return getattr(checkpoint, name)
+    if name in _SERVING_EXPORTS:
+        from kubeflow_tpu.models import serving
+
+        return getattr(serving, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -79,6 +86,8 @@ __all__ = [
     "forward_with_cache",
     "generate",
     "quantize_decode_params",
+    "ContinuousBatcher",
+    "BatchState",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

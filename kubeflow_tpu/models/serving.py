@@ -1,0 +1,367 @@
+"""Continuous-batching decode: many requests share one compiled step.
+
+`generate` (decoding.py) serves ONE request (or a lockstep batch that
+started together). A serving workload is ragged: requests arrive at
+different times, have different prompt lengths, and finish at
+different times. Continuous batching keeps a fixed number of decode
+SLOTS stepping in lockstep while requests flow through them — a slot
+that finishes is refilled from the queue without stopping the others
+(the vLLM/Orca scheduling idea, reduced to its TPU-friendly core:
+static shapes, one compiled step, per-slot cache positions).
+
+Design (TPU-first):
+
+- ``BatchState`` holds a (layers, B, Hkv, capacity, hd) cache pair
+  plus per-slot scalars: ``pos`` (next global position), ``last``
+  (last sampled token), ``active``. All shapes static; B and capacity
+  are fixed at construction, so the decode step compiles ONCE.
+- The decode step is `generate`'s single-token step generalised to
+  per-slot positions: rope offsets via ``vmap(apply_rope)``, cache
+  writes via ``vmap(dynamic_update_slice)`` (per-row start indices),
+  and the dense masked read with a (B,) position vector broadcast
+  into the causal/window mask. Inactive slots compute garbage that is
+  masked out at the state update — no data-dependent shapes.
+- Prefill reuses ``forward_with_cache`` verbatim on a B=1 cache sized
+  to the SAME capacity, then splices that cache into the slot with
+  one ``dynamic_update_slice`` — so prompt processing takes the flash
+  prefill path (and its tests) unchanged. One compile per distinct
+  prompt length (document: pad client-side for stricter bounds).
+- Greedy sampling (serving's common case for now); int8 WEIGHTS work
+  transparently (the step multiplies through ``_mm``); the int8 KV
+  cache and rolling windows are not wired into the batched state yet
+  (loud errors below).
+
+Parity contract (pinned in tests/test_serving.py): every request's
+output equals single-request ``generate(..., temperature=0)`` — slot
+assignment, admission order, and neighbours must not change results.
+
+No reference counterpart (the reference platform ships no model code);
+part of the compute stack in the jupyter-jax-tpu images.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.decoding import (
+    DECODE_BLOCK,
+    KVCache,
+    _mm,
+    forward_with_cache,
+)
+from kubeflow_tpu.models.transformer import LMConfig, rms_norm
+from kubeflow_tpu.ops import apply_rope
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class BatchState:
+    """Per-slot decode state. ``k``/``v``: (L, B, Hkv, capacity, hd);
+    ``pos``: (B,) next global position (= tokens held so far);
+    ``last``: (B,) the token to feed next; ``active``: (B,) bool."""
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+    last: jax.Array
+    active: jax.Array
+
+    @classmethod
+    def init(cls, cfg: LMConfig, max_batch: int, capacity: int):
+        capacity = -(-capacity // DECODE_BLOCK) * DECODE_BLOCK
+        shape = (cfg.layers, max_batch, cfg.num_kv_heads, capacity,
+                 cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.dtype),
+            v=jnp.zeros(shape, cfg.dtype),
+            pos=jnp.zeros((max_batch,), jnp.int32),
+            last=jnp.zeros((max_batch,), jnp.int32),
+            active=jnp.zeros((max_batch,), bool),
+        )
+
+
+jax.tree_util.register_dataclass(
+    BatchState, data_fields=["k", "v", "pos", "last", "active"],
+    meta_fields=[])
+
+
+def _write_row(cache_layer, new, pos):
+    """cache_layer (B, Hkv, cap, hd) <- new (B, Hkv, 1, hd) at
+    per-row position ``pos`` (B,)."""
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0))
+    )(cache_layer, new, pos)
+
+
+def _batched_pos_attention(cfg, q, ck, cv, pos):
+    """Single-token dense masked read with PER-SLOT positions.
+    q (B, H, 1, hd); ck/cv (B, Hkv, cap, hd); pos (B,). Row b attends
+    to cols <= pos[b] (within the window if configured)."""
+    b, h, _, hd = q.shape
+    hkv = ck.shape[1]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group, hd)
+    compute = q.dtype
+    s = jnp.einsum(
+        "bkgd,bkld->bkgl", qg, ck.astype(compute),
+        preferred_element_type=jnp.float32,
+    ) * hd ** -0.5
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    rows = pos[:, None, None, None]
+    keep = cols <= rows
+    if cfg.attn_window is not None:
+        keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
+    s = jnp.where(keep, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bkgl,bkld->bkgd", w.astype(compute), cv.astype(compute),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, h, 1, hd).astype(q.dtype)
+
+
+def decode_step(cfg: LMConfig, params: dict[str, Any],
+                state: BatchState) -> tuple[BatchState, jax.Array]:
+    """One lockstep greedy token for every slot. Returns the new state
+    and the (B,) sampled tokens (garbage on inactive slots — callers
+    gate on ``state.active``). Mirrors decoding._block_step with
+    vectorised positions; parity with `generate` is test-pinned."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "continuous batching currently serves dense-FFN models "
+            "(MoE decode runs through generate())"
+        )
+    # NOTE: this body deliberately restates decoding._block_step's
+    # per-layer math with vectorised positions rather than threading a
+    # (B,) position vector through the single-stream path — the proven
+    # generate() path stays untouched, at the cost of two sites for
+    # the decode math. The parity suite (tests/test_serving.py) pins
+    # them together; unifying on a vector-position _block_step is a
+    # ROADMAP item.
+    b = state.last.shape[0]
+    emb = params["embed"]["embedding"]
+    from kubeflow_tpu.models.decoding import Int8Linear
+
+    if isinstance(emb, Int8Linear):
+        x = (emb.w8[state.last[:, None]].astype(cfg.dtype)
+             * emb.scale[state.last[:, None]][..., None].astype(cfg.dtype))
+    else:
+        x = emb[state.last[:, None]].astype(cfg.dtype)  # (B, 1, D)
+
+    hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
+    rope = jax.vmap(lambda t, o: apply_rope(t, offset=o))
+    new_k, new_v = [], []
+    for i in range(cfg.layers):
+        blk = params[f"block_{i}"]
+        h = rms_norm(blk["RMSNorm_0"]["scale"], x)
+        proj = lambda name: _mm(h, blk[name]["kernel"], cfg.dtype
+                                ).astype(cfg.dtype)
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+        q = q.reshape(b, 1, hq, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
+        q = rope(q, state.pos)
+        k = rope(k, state.pos)
+        ck = _write_row(state.k[i], k, state.pos)
+        cv = _write_row(state.v[i], v, state.pos)
+        new_k.append(ck)
+        new_v.append(cv)
+        out = _batched_pos_attention(cfg, q, ck, cv, state.pos)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.dim)
+        x = x + _mm(out, blk["proj"]["kernel"], cfg.dtype
+                    ).astype(cfg.dtype)
+        h = rms_norm(blk["RMSNorm_1"]["scale"], x)
+        h = jax.nn.gelu(_mm(h, blk["up"]["kernel"], cfg.dtype
+                            ).astype(cfg.dtype))
+        x = x + _mm(h, blk["down"]["kernel"], cfg.dtype
+                    ).astype(cfg.dtype)
+
+    x = rms_norm(params["final_norm"]["scale"], x)
+    logits = _mm(x.astype(cfg.dtype), emb, cfg.dtype, transpose_w=True)
+    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    active = state.active
+    return BatchState(
+        k=jnp.stack(new_k), v=jnp.stack(new_v),
+        pos=state.pos + active.astype(jnp.int32),
+        last=jnp.where(active, nxt, state.last),
+        active=active,
+    ), nxt
+
+
+def decode_chunk(cfg: LMConfig, params: dict[str, Any],
+                 state: BatchState, steps: int
+                 ) -> tuple[BatchState, jax.Array]:
+    """``steps`` lockstep tokens in ONE dispatch (lax.scan) — the
+    per-dispatch host round trip amortises over the chunk (on the
+    tunneled dev chip that floor is ~100 ms; chunking is what makes a
+    serving loop viable there, and it is still the right shape on
+    local chips). Returns (state, (steps, B) tokens). Slots that hit
+    eos/budget mid-chunk keep stepping until the host trims at the
+    boundary — self-contained waste (slots never interact), bounded by
+    the submit() capacity guard."""
+
+    def body(st, _):
+        st, toks = decode_step(cfg, params, st)
+        return st, toks
+
+    return jax.lax.scan(body, state, None, length=steps)
+
+
+def prefill_slot(cfg: LMConfig, params: dict[str, Any],
+                 state: BatchState, slot: jax.Array,
+                 prompt: jax.Array) -> tuple[BatchState, jax.Array]:
+    """Admit ``prompt`` (1, P) into slot ``slot``: run the standard
+    B=1 prefill (flash path, same capacity) and splice its cache into
+    the batched state. Returns (state, first sampled token)."""
+    capacity = state.k.shape[3]
+    cache = KVCache.init(cfg, 1, capacity)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache,
+                                       last_logits_only=True)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[0]
+    k = jax.lax.dynamic_update_slice(
+        state.k, cache.k, (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        state.v, cache.v, (0, slot, 0, 0, 0))
+    p = prompt.shape[1]
+    return BatchState(
+        k=k, v=v,
+        pos=state.pos.at[slot].set(p),
+        last=state.last.at[slot].set(first),
+        active=state.active.at[slot].set(True),
+    ), first
+
+
+class ContinuousBatcher:
+    """Queue + slot manager driving the two jitted functions above.
+
+    >>> batcher = ContinuousBatcher(cfg, params, max_batch=4,
+    ...                             max_len=2048)
+    >>> rid = batcher.submit([1, 2, 3], max_new_tokens=64)
+    >>> results = batcher.run()   # {rid: [tok, ...], ...}
+
+    ``run()`` drains the queue: free slots admit queued prompts
+    (one prefill dispatch each), then all active slots decode in
+    lockstep until one finishes (eos or its token budget) and the
+    cycle repeats. Deterministic: greedy sampling, FIFO admission.
+    """
+
+    def __init__(self, cfg: LMConfig, params: dict[str, Any],
+                 max_batch: int, max_len: int,
+                 eos_token: int | None = None,
+                 step_chunk: int = 8):
+        if cfg.attn_window is not None and cfg.attn_window < max_len:
+            raise NotImplementedError(
+                "the batched state has no rolling-cache layout yet; "
+                "serve windowed models with max_len <= attn_window or "
+                "through generate()"
+            )
+        if cfg.moe_experts:
+            # Fail at construction, not at the first decode trace
+            # after prefill work has already been dispatched.
+            raise NotImplementedError(
+                "continuous batching currently serves dense-FFN "
+                "models (MoE decode runs through generate())"
+            )
+        if step_chunk < 1:
+            raise ValueError("step_chunk must be >= 1")
+        self.cfg, self.params = cfg, params
+        self.eos = eos_token
+        self.step_chunk = step_chunk
+        self.state = BatchState.init(cfg, max_batch, max_len)
+        self.capacity = self.state.k.shape[3]
+        self._queue: deque = deque()
+        self._slots: list[dict | None] = [None] * max_batch
+        self._results: dict[int, list[int]] = {}
+        self._next_id = 0
+        # The state is donated: the (L, B, Hkv, cap, hd) cache pair is
+        # the dominant buffer and every call consumes the old state —
+        # donation lets XLA update it in place instead of copying.
+        self._chunk = jax.jit(
+            lambda params, state: decode_chunk(cfg, params, state,
+                                               step_chunk),
+            donate_argnums=(1,))
+        self._prefill = jax.jit(
+            lambda params, state, slot, prompt: prefill_slot(
+                cfg, params, state, slot, prompt),
+            donate_argnums=(1,))
+
+    def submit(self, prompt, max_new_tokens: int = 128) -> int:
+        prompt = list(map(int, prompt))
+        if not prompt:
+            raise ValueError("empty prompt")
+        # + step_chunk: a slot finishing mid-chunk keeps stepping (and
+        # writing) until the boundary; the buffer must absorb that.
+        if len(prompt) + max_new_tokens + self.step_chunk > self.capacity:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) + step_chunk ({self.step_chunk}) "
+                f"exceeds capacity {self.capacity}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(
+            {"id": rid, "prompt": prompt, "budget": max_new_tokens,
+             "done": False})
+        return rid
+
+    # ---------------------------------------------------- internals
+    def _admit(self):
+        # Keep admitting until the queue or the free slots run out — a
+        # request that finishes AT prefill (budget 1 / instant eos)
+        # frees its slot immediately, and that slot must be offered to
+        # the next queued request in the same pass (a single sweep
+        # would strand the queue with every slot empty).
+        while self._queue:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                return
+            req = self._queue.popleft()
+            prompt = jnp.asarray([req["prompt"]], jnp.int32)
+            self.state, first = self._prefill(
+                self.params, self.state, jnp.int32(free), prompt)
+            first = int(first)
+            self._results[req["id"]] = [first]
+            self._slots[free] = req
+            self._check_done(req, first)
+            if req["done"]:
+                self._free(free)
+
+    def _check_done(self, req: dict, token: int):
+        if (len(self._results[req["id"]]) >= req["budget"]
+                or (self.eos is not None and token == self.eos)):
+            req["done"] = True
+
+    def _free(self, slot: int):
+        self._slots[slot] = None
+        self.state = dataclasses.replace(
+            self.state, active=self.state.active.at[slot].set(False))
+
+    def run(self) -> dict[int, list[int]]:
+        """Drain queue + slots; returns {request id: generated tokens
+        (first token included, eos included if hit)}. Decode runs in
+        ``step_chunk``-token dispatches; finishes and admissions
+        happen at chunk boundaries."""
+        self._admit()
+        while any(s is not None for s in self._slots):
+            self.state, toks = self._chunk(self.params, self.state)
+            toks = jax.device_get(toks)  # (step_chunk, B)
+            for row in toks:
+                for slot, req in enumerate(self._slots):
+                    if req is None or req["done"]:
+                        continue
+                    token = int(row[slot])
+                    self._results[req["id"]].append(token)
+                    self._check_done(req, token)
+            for slot, req in enumerate(self._slots):
+                if req is not None and req["done"]:
+                    self._free(slot)
+            self._admit()
+        return dict(self._results)
