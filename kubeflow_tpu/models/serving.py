@@ -32,8 +32,14 @@ Design (TPU-first):
   (loud errors below).
 
 Parity contract (pinned in tests/test_serving.py): every request's
-output equals single-request ``generate(..., temperature=0)`` — slot
-assignment, admission order, and neighbours must not change results.
+output equals single-request ``generate`` under the same compilation
+mode — slot assignment, admission order, neighbours, chunk size, and
+temperature must not change results. Verified on a real v5e against
+JITTED ``generate`` (greedy and sampled, exact). Caveat measured
+there: EAGER generate can emit different tokens than jitted generate
+on near-tie logits (XLA fusion changes bf16 rounding — a generic TPU
+property unrelated to this module; the batcher sides with the jitted
+path, which is what bench and production callers run).
 
 No reference counterpart (the reference platform ships no model code);
 part of the compute stack in the jupyter-jax-tpu images.
@@ -64,13 +70,15 @@ NEG_INF = -1e30
 class BatchState:
     """Per-slot decode state. ``k``/``v``: (L, B, Hkv, capacity, hd);
     ``pos``: (B,) next global position (= tokens held so far);
-    ``last``: (B,) the token to feed next; ``active``: (B,) bool."""
+    ``last``: (B,) the token to feed next; ``active``: (B,) bool;
+    ``temp``: (B,) f32 per-slot sampling temperature (0 = greedy)."""
 
     k: jax.Array
     v: jax.Array
     pos: jax.Array
     last: jax.Array
     active: jax.Array
+    temp: jax.Array
 
     @classmethod
     def init(cls, cfg: LMConfig, max_batch: int, capacity: int):
@@ -83,12 +91,32 @@ class BatchState:
             pos=jnp.zeros((max_batch,), jnp.int32),
             last=jnp.zeros((max_batch,), jnp.int32),
             active=jnp.zeros((max_batch,), bool),
+            temp=jnp.zeros((max_batch,), jnp.float32),
         )
 
 
 jax.tree_util.register_dataclass(
-    BatchState, data_fields=["k", "v", "pos", "last", "active"],
+    BatchState,
+    data_fields=["k", "v", "pos", "last", "active", "temp"],
     meta_fields=[])
+
+
+def _sample(logits, temp, keys):
+    """(B, vocab) logits -> (B,) tokens: per-slot greedy (temp 0) or
+    categorical at the slot's temperature with the slot's key —
+    generate()'s sampling, vectorised per slot."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if keys is None:
+        return greedy
+    # Only the temp==0 rows need protecting from the division (their
+    # draw is discarded by the where) — clamping BY a floor would
+    # silently change sampling for tiny positive temperatures and
+    # break the bit-for-bit generate() parity.
+    safe = jnp.where(temp > 0.0, temp, 1.0)[:, None]
+    drawn = jax.vmap(
+        lambda k, lg: jax.random.categorical(k, lg)
+    )(keys, logits / safe).astype(jnp.int32)
+    return jnp.where(temp > 0.0, drawn, greedy)
 
 
 def _write_row(cache_layer, new, pos):
@@ -127,11 +155,14 @@ def _batched_pos_attention(cfg, q, ck, cv, pos):
 
 
 def decode_step(cfg: LMConfig, params: dict[str, Any],
-                state: BatchState) -> tuple[BatchState, jax.Array]:
-    """One lockstep greedy token for every slot. Returns the new state
-    and the (B,) sampled tokens (garbage on inactive slots — callers
-    gate on ``state.active``). Mirrors decoding._block_step with
-    vectorised positions; parity with `generate` is test-pinned."""
+                state: BatchState, keys: jax.Array | None = None
+                ) -> tuple[BatchState, jax.Array]:
+    """One lockstep token for every slot — greedy, or per-slot
+    temperature sampling when ``keys`` (B,) PRNG keys are supplied.
+    Returns the new state and the (B,) sampled tokens (garbage on
+    inactive slots — callers gate on ``state.active``). Mirrors
+    decoding._block_step with vectorised positions; parity with
+    `generate` is test-pinned."""
     if cfg.moe_experts:
         raise NotImplementedError(
             "continuous batching currently serves dense-FFN models "
@@ -184,7 +215,7 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
 
     x = rms_norm(params["final_norm"]["scale"], x)
     logits = _mm(x.astype(cfg.dtype), emb, cfg.dtype, transpose_w=True)
-    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    nxt = _sample(logits[:, -1], state.temp, keys)
 
     active = state.active
     return BatchState(
@@ -192,39 +223,43 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
         pos=state.pos + active.astype(jnp.int32),
         last=jnp.where(active, nxt, state.last),
         active=active,
+        temp=state.temp,
     ), nxt
 
 
 def decode_chunk(cfg: LMConfig, params: dict[str, Any],
-                 state: BatchState, steps: int
+                 state: BatchState, keys: jax.Array
                  ) -> tuple[BatchState, jax.Array]:
-    """``steps`` lockstep tokens in ONE dispatch (lax.scan) — the
-    per-dispatch host round trip amortises over the chunk (on the
-    tunneled dev chip that floor is ~100 ms; chunking is what makes a
-    serving loop viable there, and it is still the right shape on
-    local chips). Returns (state, (steps, B) tokens). Slots that hit
-    eos/budget mid-chunk keep stepping until the host trims at the
-    boundary — self-contained waste (slots never interact), bounded by
-    the submit() capacity guard."""
+    """Lockstep tokens in ONE dispatch (lax.scan over the (steps, B)
+    per-slot key rows) — the per-dispatch host round trip amortises
+    over the chunk (on the tunneled dev chip that floor is ~100 ms;
+    chunking is what makes a serving loop viable there, and it is
+    still the right shape on local chips). Returns (state, (steps, B)
+    tokens). Slots that hit eos/budget mid-chunk keep stepping until
+    the host trims at the boundary — self-contained waste (slots never
+    interact), bounded by the submit() capacity guard."""
 
-    def body(st, _):
-        st, toks = decode_step(cfg, params, st)
+    def body(st, krow):
+        st, toks = decode_step(cfg, params, st, krow)
         return st, toks
 
-    return jax.lax.scan(body, state, None, length=steps)
+    return jax.lax.scan(body, state, keys)
 
 
 def prefill_slot(cfg: LMConfig, params: dict[str, Any],
                  state: BatchState, slot: jax.Array,
-                 prompt: jax.Array) -> tuple[BatchState, jax.Array]:
+                 prompt: jax.Array, temp: jax.Array,
+                 first_key: jax.Array) -> tuple[BatchState, jax.Array]:
     """Admit ``prompt`` (1, P) into slot ``slot``: run the standard
     B=1 prefill (flash path, same capacity) and splice its cache into
-    the batched state. Returns (state, first sampled token)."""
+    the batched state. The first token samples at ``temp`` with
+    ``first_key`` (generate()'s first_key role). Returns
+    (state, first token)."""
     capacity = state.k.shape[3]
     cache = KVCache.init(cfg, 1, capacity)
     logits, cache = forward_with_cache(cfg, params, prompt, cache,
                                        last_logits_only=True)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[0]
+    first = _sample(logits[:, -1], temp[None], first_key[None])[0]
     k = jax.lax.dynamic_update_slice(
         state.k, cache.k, (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(
@@ -235,6 +270,7 @@ def prefill_slot(cfg: LMConfig, params: dict[str, Any],
         pos=state.pos.at[slot].set(p),
         last=state.last.at[slot].set(first),
         active=state.active.at[slot].set(True),
+        temp=state.temp.at[slot].set(temp),
     ), first
 
 
@@ -284,15 +320,23 @@ class ContinuousBatcher:
         # the dominant buffer and every call consumes the old state —
         # donation lets XLA update it in place instead of copying.
         self._chunk = jax.jit(
-            lambda params, state: decode_chunk(cfg, params, state,
-                                               step_chunk),
+            lambda params, state, keys: decode_chunk(cfg, params,
+                                                     state, keys),
             donate_argnums=(1,))
         self._prefill = jax.jit(
-            lambda params, state, slot, prompt: prefill_slot(
-                cfg, params, state, slot, prompt),
+            lambda params, state, slot, prompt, temp, key: prefill_slot(
+                cfg, params, state, slot, prompt, temp, key),
             donate_argnums=(1,))
+        self._dummy_key = jax.random.key(0)
 
-    def submit(self, prompt, max_new_tokens: int = 128) -> int:
+    def submit(self, prompt, max_new_tokens: int = 128,
+               temperature: float = 0.0,
+               rng: jax.Array | None = None) -> int:
+        """Queue a request. ``temperature``/``rng`` follow generate's
+        contract (rng required iff temperature > 0); the key schedule
+        is generate's exactly — split(rng) -> first key + pre-split
+        step keys — so a sampled request reproduces
+        ``generate(..., temperature=t, rng=rng)``."""
         prompt = list(map(int, prompt))
         if not prompt:
             raise ValueError("empty prompt")
@@ -304,11 +348,29 @@ class ContinuousBatcher:
                 f"({max_new_tokens}) + step_chunk ({self.step_chunk}) "
                 f"exceeds capacity {self.capacity}"
             )
+        if temperature > 0.0 and rng is None:
+            raise ValueError(
+                "temperature > 0 samples from the categorical "
+                "distribution; pass rng=jax.random.key(...)"
+            )
+        if temperature > 0.0:
+            # Accept legacy uint32 PRNGKeys like generate does — the
+            # key rows stacked in _chunk_keys must all be typed.
+            if not jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+                rng = jax.random.wrap_key_data(jnp.asarray(rng))
+            first_key, step_key = jax.random.split(rng)
+            step_keys = (
+                jax.random.split(step_key, max_new_tokens - 1)
+                if max_new_tokens > 1 else None)
+        else:
+            first_key, step_keys = self._dummy_key, None
         rid = self._next_id
         self._next_id += 1
         self._queue.append(
             {"id": rid, "prompt": prompt, "budget": max_new_tokens,
-             "done": False})
+             "done": False, "temp": float(temperature),
+             "first_key": first_key,
+             "step_keys": step_keys, "kcur": 0})
         return rid
 
     # ---------------------------------------------------- internals
@@ -326,7 +388,8 @@ class ContinuousBatcher:
             req = self._queue.popleft()
             prompt = jnp.asarray([req["prompt"]], jnp.int32)
             self.state, first = self._prefill(
-                self.params, self.state, jnp.int32(free), prompt)
+                self.params, self.state, jnp.int32(free), prompt,
+                jnp.float32(req["temp"]), req["first_key"])
             first = int(first)
             self._results[req["id"]] = [first]
             self._slots[free] = req
@@ -344,6 +407,31 @@ class ContinuousBatcher:
         self.state = dataclasses.replace(
             self.state, active=self.state.active.at[slot].set(False))
 
+    def _chunk_keys(self) -> jax.Array:
+        """(step_chunk, B) per-slot sampling keys for the next chunk:
+        each occupied sampled slot consumes its request's pre-split
+        (n-1,) key array in generate()'s order via a cursor;
+        greedy/empty/exhausted slots get dummy keys (their draw is
+        discarded by temp==0 or the host trim). One slice per slot +
+        one stack per chunk — no per-key device ops."""
+        n = self.step_chunk
+        dummies = jnp.broadcast_to(self._dummy_key, (n,))
+        cols = []
+        for req in self._slots:
+            keys = req["step_keys"] if req is not None else None
+            if keys is None:
+                cols.append(dummies)
+                continue
+            cur = req["kcur"]
+            take = min(n, keys.shape[0] - cur)
+            req["kcur"] = cur + take
+            if take == n:
+                cols.append(jax.lax.dynamic_slice_in_dim(keys, cur, n))
+            else:
+                seg = keys[cur:cur + take] if take > 0 else dummies[:0]
+                cols.append(jnp.concatenate([seg, dummies[:n - take]]))
+        return jnp.stack(cols, axis=1)
+
     def run(self) -> dict[int, list[int]]:
         """Drain queue + slots; returns {request id: generated tokens
         (first token included, eos included if hit)}. Decode runs in
@@ -351,7 +439,9 @@ class ContinuousBatcher:
         happen at chunk boundaries."""
         self._admit()
         while any(s is not None for s in self._slots):
-            self.state, toks = self._chunk(self.params, self.state)
+            keys = self._chunk_keys()
+            self.state, toks = self._chunk(self.params, self.state,
+                                           keys)
             toks = jax.device_get(toks)  # (step_chunk, B)
             for row in toks:
                 for slot, req in enumerate(self._slots):

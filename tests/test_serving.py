@@ -150,3 +150,59 @@ def test_state_capacity_rounds_to_decode_block():
 
     state = BatchState.init(CFG, 2, DECODE_BLOCK + 7)
     assert state.k.shape[3] % DECODE_BLOCK == 0
+
+
+def test_temperature_matches_generate():
+    """A sampled request through the batcher reproduces
+    generate(temperature=t, rng=key) exactly — same key schedule
+    (split(rng) -> first key + pre-split step keys), same logits."""
+    params, rng = _setup(seed=5)
+    prompt = [int(t) for t in rng.integers(0, CFG.vocab, 10)]
+    key = jax.random.key(42)
+    ref = generate(CFG, params, jnp.asarray([prompt], jnp.int32), 8,
+                   temperature=0.8, rng=key)
+    ref = [int(t) for t in np.asarray(ref[0])]
+    batcher = ContinuousBatcher(CFG, params, max_batch=2, max_len=64)
+    rid = batcher.submit(prompt, max_new_tokens=8, temperature=0.8,
+                         rng=key)
+    results = batcher.run()
+    assert results[rid] == ref
+
+
+def test_mixed_greedy_and_sampled_slots():
+    """Greedy and sampled requests share the lockstep batch without
+    affecting each other."""
+    params, rng = _setup(seed=6)
+    p1 = [int(t) for t in rng.integers(0, CFG.vocab, 7)]
+    p2 = [int(t) for t in rng.integers(0, CFG.vocab, 9)]
+    key = jax.random.key(7)
+    batcher = ContinuousBatcher(CFG, params, max_batch=2, max_len=64)
+    r1 = batcher.submit(p1, max_new_tokens=6)
+    r2 = batcher.submit(p2, max_new_tokens=6, temperature=1.2, rng=key)
+    results = batcher.run()
+    assert results[r1] == _reference(CFG, params, p1, 6)
+    ref2 = generate(CFG, params, jnp.asarray([p2], jnp.int32), 6,
+                    temperature=1.2, rng=key)
+    assert results[r2] == [int(t) for t in np.asarray(ref2[0])]
+
+
+def test_temperature_requires_rng():
+    params, _ = _setup()
+    batcher = ContinuousBatcher(CFG, params, max_batch=1, max_len=64)
+    with pytest.raises(ValueError, match="categorical"):
+        batcher.submit([1, 2, 3], temperature=0.5)
+
+
+def test_legacy_prngkey_accepted():
+    """generate accepts legacy uint32 PRNGKeys; submit must too (the
+    key rows stacked per chunk must all be typed keys)."""
+    params, rng = _setup(seed=7)
+    prompt = [int(t) for t in rng.integers(0, CFG.vocab, 6)]
+    legacy = jax.random.PRNGKey(3)
+    batcher = ContinuousBatcher(CFG, params, max_batch=1, max_len=64)
+    rid = batcher.submit(prompt, max_new_tokens=5, temperature=0.9,
+                         rng=legacy)
+    results = batcher.run()
+    ref = generate(CFG, params, jnp.asarray([prompt], jnp.int32), 5,
+                   temperature=0.9, rng=legacy)
+    assert results[rid] == [int(t) for t in np.asarray(ref[0])]
