@@ -26,9 +26,9 @@ def _setup(cfg=CFG, seed=0):
     return state.params, rng
 
 
-def _reference(cfg, params, prompt, n):
-    out = generate(cfg, params,
-                   jnp.asarray([prompt], jnp.int32), n)
+def _reference(cfg, params, prompt, n, temperature=0.0, rng=None):
+    out = generate(cfg, params, jnp.asarray([prompt], jnp.int32), n,
+                   temperature=temperature, rng=rng)
     return [int(t) for t in np.asarray(out[0])]
 
 
@@ -206,3 +206,38 @@ def test_legacy_prngkey_accepted():
     ref = generate(CFG, params, jnp.asarray([prompt], jnp.int32), 5,
                    temperature=0.9, rng=legacy)
     assert results[rid] == [int(t) for t in np.asarray(ref[0])]
+
+
+def test_fuzz_random_workloads_match_references():
+    """Randomised workloads (prompt lengths, budgets, temperatures,
+    slot counts, chunk sizes) — every request must match its
+    single-request reference. Catches scheduling/slot-reuse bugs the
+    structured cases miss."""
+    params, _ = _setup(seed=8)
+    master = np.random.default_rng(123)
+    for trial in range(3):
+        max_batch = int(master.integers(1, 4))
+        step_chunk = int(master.integers(1, 7))
+        batcher = ContinuousBatcher(CFG, params, max_batch=max_batch,
+                                    max_len=64, step_chunk=step_chunk)
+        reqs = []
+        for _ in range(int(master.integers(2, 7))):
+            plen = int(master.integers(1, 14))
+            budget = int(master.integers(1, 10))
+            temp = float(master.choice([0.0, 0.0, 0.9]))
+            prompt = [int(t) for t in master.integers(0, CFG.vocab,
+                                                      plen)]
+            seed = int(master.integers(0, 2**31))
+            rid = batcher.submit(
+                prompt, max_new_tokens=budget, temperature=temp,
+                rng=jax.random.key(seed) if temp > 0 else None)
+            reqs.append((rid, prompt, budget, temp, seed))
+        results = batcher.run()
+        for rid, prompt, budget, temp, seed in reqs:
+            ref = _reference(
+                CFG, params, prompt, budget, temperature=temp,
+                rng=jax.random.key(seed) if temp > 0 else None)
+            assert results[rid] == ref, (
+                f"trial {trial} request {rid} diverged "
+                f"(B={max_batch}, chunk={step_chunk}, temp={temp})"
+            )
