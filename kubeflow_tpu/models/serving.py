@@ -26,10 +26,12 @@ Design (TPU-first):
   one ``dynamic_update_slice`` — so prompt processing takes the flash
   prefill path (and its tests) unchanged. One compile per distinct
   prompt length (document: pad client-side for stricter bounds).
-- Greedy sampling (serving's common case for now); int8 WEIGHTS work
-  transparently (the step multiplies through ``_mm``); the int8 KV
-  cache and rolling windows are not wired into the batched state yet
-  (loud errors below).
+- Per-slot temperatures (greedy and sampled requests mix; sampled
+  slots reproduce ``generate``'s key schedule exactly); int8 WEIGHTS
+  work transparently (the step multiplies through ``_mm``); windowed
+  models with window < max_len serve from ROLLING slots (circular
+  per-slot buffers, O(window) memory per slot). The int8 KV cache is
+  not wired into the batched state (serve it through ``generate``).
 
 Parity contract (pinned in tests/test_serving.py): every request's
 output equals single-request ``generate`` under the same compilation
@@ -81,8 +83,18 @@ class BatchState:
     temp: jax.Array
 
     @classmethod
-    def init(cls, cfg: LMConfig, max_batch: int, capacity: int):
-        capacity = -(-capacity // DECODE_BLOCK) * DECODE_BLOCK
+    def init(cls, cfg: LMConfig, max_batch: int, capacity: int,
+             rolling: bool = False):
+        if rolling:
+            # Circular per-slot buffers: capacity == the window (same
+            # rule as KVCache.init(rolling=True)); positions wrap.
+            if cfg.attn_window is None:
+                raise ValueError(
+                    "rolling slots require cfg.attn_window"
+                )
+            capacity = min(cfg.attn_window, capacity)
+        else:
+            capacity = -(-capacity // DECODE_BLOCK) * DECODE_BLOCK
         shape = (cfg.layers, max_batch, cfg.num_kv_heads, capacity,
                  cfg.head_dim)
         return cls(
@@ -127,10 +139,14 @@ def _write_row(cache_layer, new, pos):
     )(cache_layer, new, pos)
 
 
-def _batched_pos_attention(cfg, q, ck, cv, pos):
-    """Single-token dense masked read with PER-SLOT positions.
-    q (B, H, 1, hd); ck/cv (B, Hkv, cap, hd); pos (B,). Row b attends
-    to cols <= pos[b] (within the window if configured)."""
+def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False):
+    """Single-token masked read with PER-SLOT positions. q
+    (B, H, 1, hd); ck/cv (B, Hkv, cap, hd); pos (B,). Linear layout:
+    row b attends to cols <= pos[b] (within the window). Rolling
+    layout (decoding._rolling_attention with a position vector): slot
+    j holds the newest global position ≡ j (mod capacity) that is
+    <= pos[b]; unwritten slots mask out; capacity <= window keeps
+    every written slot in-band by construction."""
     b, h, _, hd = q.shape
     hkv = ck.shape[1]
     group = h // hkv
@@ -142,9 +158,14 @@ def _batched_pos_attention(cfg, q, ck, cv, pos):
     ) * hd ** -0.5
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     rows = pos[:, None, None, None]
-    keep = cols <= rows
-    if cfg.attn_window is not None:
-        keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
+    if rolling:
+        capacity = ck.shape[2]
+        global_pos = rows - (rows - cols) % capacity
+        keep = global_pos >= 0
+    else:
+        keep = cols <= rows
+        if cfg.attn_window is not None:
+            keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
     s = jnp.where(keep, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
@@ -155,8 +176,8 @@ def _batched_pos_attention(cfg, q, ck, cv, pos):
 
 
 def decode_step(cfg: LMConfig, params: dict[str, Any],
-                state: BatchState, keys: jax.Array | None = None
-                ) -> tuple[BatchState, jax.Array]:
+                state: BatchState, keys: jax.Array | None = None,
+                rolling: bool = False) -> tuple[BatchState, jax.Array]:
     """One lockstep token for every slot — greedy, or per-slot
     temperature sampling when ``keys`` (B,) PRNG keys are supplied.
     Returns the new state and the (B,) sampled tokens (garbage on
@@ -199,11 +220,14 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
         v = v.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
         q = rope(q, state.pos)
         k = rope(k, state.pos)
-        ck = _write_row(state.k[i], k, state.pos)
-        cv = _write_row(state.v[i], v, state.pos)
+        capacity = state.k.shape[3]
+        wpos = state.pos % capacity if rolling else state.pos
+        ck = _write_row(state.k[i], k, wpos)
+        cv = _write_row(state.v[i], v, wpos)
         new_k.append(ck)
         new_v.append(cv)
-        out = _batched_pos_attention(cfg, q, ck, cv, state.pos)
+        out = _batched_pos_attention(cfg, q, ck, cv, state.pos,
+                                     rolling=rolling)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.dim)
         x = x + _mm(out, blk["proj"]["kernel"], cfg.dtype
                     ).astype(cfg.dtype)
@@ -228,8 +252,8 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
 
 
 def decode_chunk(cfg: LMConfig, params: dict[str, Any],
-                 state: BatchState, keys: jax.Array
-                 ) -> tuple[BatchState, jax.Array]:
+                 state: BatchState, keys: jax.Array,
+                 rolling: bool = False) -> tuple[BatchState, jax.Array]:
     """Lockstep tokens in ONE dispatch (lax.scan over the (steps, B)
     per-slot key rows) — the per-dispatch host round trip amortises
     over the chunk (on the tunneled dev chip that floor is ~100 ms;
@@ -240,7 +264,7 @@ def decode_chunk(cfg: LMConfig, params: dict[str, Any],
     interact), bounded by the submit() capacity guard."""
 
     def body(st, krow):
-        st, toks = decode_step(cfg, params, st, krow)
+        st, toks = decode_step(cfg, params, st, krow, rolling=rolling)
         return st, toks
 
     return jax.lax.scan(body, state, keys)
@@ -249,14 +273,16 @@ def decode_chunk(cfg: LMConfig, params: dict[str, Any],
 def prefill_slot(cfg: LMConfig, params: dict[str, Any],
                  state: BatchState, slot: jax.Array,
                  prompt: jax.Array, temp: jax.Array,
-                 first_key: jax.Array) -> tuple[BatchState, jax.Array]:
+                 first_key: jax.Array, rolling: bool = False
+                 ) -> tuple[BatchState, jax.Array]:
     """Admit ``prompt`` (1, P) into slot ``slot``: run the standard
-    B=1 prefill (flash path, same capacity) and splice its cache into
-    the batched state. The first token samples at ``temp`` with
+    B=1 prefill (flash path, same capacity/layout — incl. the rolling
+    circular write for windowed slots) and splice its cache into the
+    batched state. The first token samples at ``temp`` with
     ``first_key`` (generate()'s first_key role). Returns
     (state, first token)."""
     capacity = state.k.shape[3]
-    cache = KVCache.init(cfg, 1, capacity)
+    cache = KVCache.init(cfg, 1, capacity, rolling=rolling)
     logits, cache = forward_with_cache(cfg, params, prompt, cache,
                                        last_logits_only=True)
     first = _sample(logits[:, -1], temp[None], first_key[None])[0]
@@ -292,12 +318,6 @@ class ContinuousBatcher:
                  max_batch: int, max_len: int,
                  eos_token: int | None = None,
                  step_chunk: int = 8):
-        if cfg.attn_window is not None and cfg.attn_window < max_len:
-            raise NotImplementedError(
-                "the batched state has no rolling-cache layout yet; "
-                "serve windowed models with max_len <= attn_window or "
-                "through generate()"
-            )
         if cfg.moe_experts:
             # Fail at construction, not at the first decode trace
             # after prefill work has already been dispatched.
@@ -310,8 +330,16 @@ class ContinuousBatcher:
         self.cfg, self.params = cfg, params
         self.eos = eos_token
         self.step_chunk = step_chunk
-        self.state = BatchState.init(cfg, max_batch, max_len)
+        # Windowed models whose window is smaller than max_len get
+        # ROLLING slots: circular per-slot buffers of the window size
+        # — memory and per-token reads O(window) however long each
+        # request generates (same rule as generate()).
+        self.rolling = (cfg.attn_window is not None
+                        and cfg.attn_window < max_len)
+        self.state = BatchState.init(cfg, max_batch, max_len,
+                                     rolling=self.rolling)
         self.capacity = self.state.k.shape[3]
+        self.max_len = max_len
         self._queue: deque = deque()
         self._slots: list[dict | None] = [None] * max_batch
         self._results: dict[int, list[int]] = {}
@@ -319,13 +347,15 @@ class ContinuousBatcher:
         # The state is donated: the (L, B, Hkv, cap, hd) cache pair is
         # the dominant buffer and every call consumes the old state —
         # donation lets XLA update it in place instead of copying.
+        rolling = self.rolling
         self._chunk = jax.jit(
-            lambda params, state, keys: decode_chunk(cfg, params,
-                                                     state, keys),
+            lambda params, state, keys: decode_chunk(
+                cfg, params, state, keys, rolling=rolling),
             donate_argnums=(1,))
         self._prefill = jax.jit(
             lambda params, state, slot, prompt, temp, key: prefill_slot(
-                cfg, params, state, slot, prompt, temp, key),
+                cfg, params, state, slot, prompt, temp, key,
+                rolling=rolling),
             donate_argnums=(1,))
         self._dummy_key = jax.random.key(0)
 
@@ -341,12 +371,19 @@ class ContinuousBatcher:
         if not prompt:
             raise ValueError("empty prompt")
         # + step_chunk: a slot finishing mid-chunk keeps stepping (and
-        # writing) until the boundary; the buffer must absorb that.
-        if len(prompt) + max_new_tokens + self.step_chunk > self.capacity:
+        # writing) until the boundary; a LINEAR buffer must absorb
+        # that. Rolling slots wrap, so the overshoot is harmless and
+        # their bound is just max_len (the cap the caller sized the
+        # batcher for).
+        slack = 0 if self.rolling else self.step_chunk
+        limit = self.max_len if self.rolling else self.capacity
+        if len(prompt) + max_new_tokens + slack > limit:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) + step_chunk ({self.step_chunk}) "
-                f"exceeds capacity {self.capacity}"
+                f"({max_new_tokens})"
+                + (f" + step_chunk ({self.step_chunk})" if slack else "")
+                + f" exceeds "
+                f"{'max_len' if self.rolling else 'capacity'} {limit}"
             )
         if temperature > 0.0 and rng is None:
             raise ValueError(

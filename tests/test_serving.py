@@ -112,10 +112,6 @@ def test_capacity_and_validation():
         batcher.submit(list(range(1, 200)), max_new_tokens=100)
     with pytest.raises(ValueError, match="empty"):
         batcher.submit([])
-    cfg_win = LMConfig(vocab=128, layers=2, dim=64, heads=4,
-                       kv_heads=2, attn_window=8)
-    with pytest.raises(NotImplementedError, match="rolling"):
-        ContinuousBatcher(cfg_win, params, max_batch=1, max_len=64)
     cfg_moe = LMConfig(vocab=128, layers=2, dim=64, heads=4,
                        kv_heads=2, moe_experts=4)
     # Rejected at construction (not at the first decode trace after
@@ -241,3 +237,51 @@ def test_fuzz_random_workloads_match_references():
                 f"trial {trial} request {rid} diverged "
                 f"(B={max_batch}, chunk={step_chunk}, temp={temp})"
             )
+
+
+class TestRollingSlots:
+    """Windowed models with window < max_len serve from circular
+    per-slot buffers — O(window) memory per slot however long each
+    request runs. Parity vs generate (which picks the rolling cache
+    under the same rule) across the wrap boundary."""
+
+    CFG = LMConfig(vocab=128, layers=2, dim=64, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16, attn_window=8)
+
+    def test_state_is_window_sized(self):
+        batcher = ContinuousBatcher(self.CFG, _setup(self.CFG)[0],
+                                    max_batch=2, max_len=64)
+        assert batcher.rolling
+        assert batcher.state.k.shape[3] == self.CFG.attn_window
+
+    def test_parity_across_wrap(self):
+        """Prompts shorter and LONGER than the window, generations
+        running far past it: every request equals its single-request
+        rolling-generate reference."""
+        params, rng = _setup(self.CFG, seed=21)
+        reqs = [
+            ([int(t) for t in rng.integers(0, self.CFG.vocab, plen)],
+             budget)
+            for plen, budget in [(3, 20), (8, 12), (13, 18), (6, 5)]
+        ]
+        batcher = ContinuousBatcher(self.CFG, params, max_batch=2,
+                                    max_len=64, step_chunk=3)
+        rids = [batcher.submit(p, max_new_tokens=b) for p, b in reqs]
+        results = batcher.run()
+        for rid, (prompt, budget) in zip(rids, reqs):
+            assert results[rid] == _reference(self.CFG, params, prompt,
+                                              budget), (
+                f"rolling request {rid} diverged"
+            )
+
+    def test_sampled_rolling_parity(self):
+        params, rng = _setup(self.CFG, seed=22)
+        prompt = [int(t) for t in rng.integers(0, self.CFG.vocab, 5)]
+        key = jax.random.key(9)
+        batcher = ContinuousBatcher(self.CFG, params, max_batch=1,
+                                    max_len=64)
+        rid = batcher.submit(prompt, max_new_tokens=14,
+                             temperature=0.7, rng=key)
+        results = batcher.run()
+        assert results[rid] == _reference(self.CFG, params, prompt, 14,
+                                          temperature=0.7, rng=key)
