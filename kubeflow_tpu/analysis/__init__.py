@@ -1,0 +1,41 @@
+"""Platform-wide static analysis.
+
+Three rule packs over the repo tree, sharing one findings model and one
+CLI (``python -m kubeflow_tpu.analysis``):
+
+- :mod:`manifest_rules` — YAML manifests and controller-emitted desired
+  state: TPU limits x replicas vs GKE topology selectors (the math in
+  :mod:`kubeflow_tpu.topology`), PodDefault selector/env conflicts the
+  webhook would reject at admission, kustomization reference integrity,
+  webhook failurePolicy sanity.
+- :mod:`mesh_rules` — MeshSpec factorizations in code and docs must
+  divide the declared slice chip counts; 1F1B stage counts must divide
+  microbatch/layer counts where both are declared statically.
+- :mod:`ast_rules` — Python hazards: side effects inside traced
+  (jit/pallas) functions, blocking calls in controller reconcile paths,
+  HTTP requests without an explicit timeout, broad excepts that swallow
+  silently.
+
+Findings carry (rule, severity, file:line, message). Two suppression
+mechanisms keep the gate green without hiding regressions: an inline
+``# analysis: allow[rule-id]`` pragma on (or right above) the flagged
+line, and a repo-level baseline file of accepted findings
+(``.analysis-baseline.json``) for pre-existing debt.
+"""
+
+from kubeflow_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    load_baseline,
+    write_baseline,
+)
+from kubeflow_tpu.analysis.engine import AnalysisConfig, analyze_paths
+
+__all__ = [
+    "AnalysisConfig",
+    "Finding",
+    "Severity",
+    "analyze_paths",
+    "load_baseline",
+    "write_baseline",
+]

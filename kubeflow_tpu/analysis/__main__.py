@@ -1,0 +1,84 @@
+"""CLI: ``python -m kubeflow_tpu.analysis [paths...]``.
+
+Exit code 0 when no new error-severity findings; 1 otherwise. The
+baseline defaults to ``.analysis-baseline.json`` next to the first
+scanned path (repo root in the normal invocation), so CI and the
+pre-push habit are the same bare command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kubeflow_tpu.analysis.engine import (
+    AnalysisConfig,
+    BASELINE_FILENAME,
+    analyze_paths,
+    gate_exit_code,
+    partition_baseline,
+    render_report,
+)
+from kubeflow_tpu.analysis.findings import BaselineError, write_baseline
+
+
+def _default_baseline(paths: list[str]) -> str:
+    first = os.path.abspath(paths[0])
+    base = first if os.path.isdir(first) else os.path.dirname(first)
+    return os.path.join(base, BASELINE_FILENAME)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="Static analysis: manifests, TPU topology math, "
+        "traced-code and controller hazards.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["."],
+        help="files or directories to scan (default: current directory)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"accepted-findings file (default: {BASELINE_FILENAME} "
+        "next to the first path)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept all current findings into the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--no-emitted", action="store_true",
+        help="skip the controller-emitted desired-state probe",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["."]
+    baseline_path = args.baseline or _default_baseline(paths)
+    config = AnalysisConfig(
+        paths=paths,
+        check_emitted=not args.no_emitted,
+    )
+    findings = analyze_paths(config)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+    try:
+        new, baselined = partition_baseline(findings, baseline_path)
+    except BaselineError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    print(render_report(new, baselined, args.format))
+    return gate_exit_code(new)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

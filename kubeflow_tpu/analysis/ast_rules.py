@@ -1,0 +1,262 @@
+"""AST rules over the Python tree.
+
+- ``py-traced-side-effect`` (error): Python side effects inside
+  functions that JAX traces (``@jax.jit`` decorations, ``jax.jit(fn)``
+  wrapping, kernels handed to ``pallas_call``): wall-clock reads,
+  ``np.random``/``random`` draws, sleeps, I/O, and ``global``/
+  ``nonlocal`` mutation of closed-over state. These execute once at
+  trace time and then bake into the compiled program — the classic
+  "my timestamp never changes" / "my noise is identical every step"
+  hazard.
+- ``py-blocking-in-reconcile`` (error): ``time.sleep`` or direct HTTP
+  calls inside a controller ``reconcile`` method. Reconcile workers are
+  shared; one blocked worker stalls every queued key (probes belong in
+  injected callables with timeouts, like culling's ``KernelProbe``).
+- ``py-http-no-timeout`` (error): ``urllib.request.urlopen`` /
+  ``requests.*`` / ``http.client`` connections without an explicit
+  ``timeout=``. The stdlib default is "block forever"; in a controller
+  that means a wedged watch loop, not a failed request.
+- ``py-broad-except`` (warning): ``except Exception``/bare ``except``
+  whose handler neither re-raises nor logs — failures vanish. Narrow
+  the type, add a log call, or annotate intentional swallows with
+  ``# analysis: allow[py-broad-except]``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+
+# Dotted call targets that are side effects under a jit/pallas trace.
+_IMPURE_EXACT = {
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.perf_counter_ns", "time.sleep", "open", "input",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.now",
+    "datetime.utcnow",
+}
+_IMPURE_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+_HTTP_TIMEOUT_REQUIRED = {
+    "urllib.request.urlopen": "urlopen",
+    "requests.get": "requests.get",
+    "requests.post": "requests.post",
+    "requests.put": "requests.put",
+    "requests.delete": "requests.delete",
+    "requests.head": "requests.head",
+    "requests.patch": "requests.patch",
+    "requests.request": "requests.request",
+    "http.client.HTTPConnection": "HTTPConnection",
+    "http.client.HTTPSConnection": "HTTPSConnection",
+}
+
+
+def _dotted(node: ast.AST, aliases: dict[str, str]) -> str:
+    """Flatten a Name/Attribute chain to a dotted string, resolving
+    import aliases at the root (``from urllib.request import urlopen``
+    makes bare ``urlopen`` resolve to ``urllib.request.urlopen``)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return aliases
+
+
+def _is_jit_decorator(dec: ast.AST, aliases: dict[str, str]) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @jax.jit(...)."""
+    if isinstance(dec, ast.Call):
+        target = _dotted(dec.func, aliases)
+        if target.endswith("partial") and dec.args:
+            return _is_jit_decorator(dec.args[0], aliases)
+        dec_name = target
+    else:
+        dec_name = _dotted(dec, aliases)
+    return dec_name in ("jax.jit", "jit") or dec_name.endswith(".jit")
+
+
+def _traced_function_names(tree: ast.AST, aliases: dict[str, str]) -> set[str]:
+    """Functions traced indirectly: ``f2 = jax.jit(f)`` wrapping and
+    kernels passed as the first argument to ``pallas_call``."""
+    traced: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _dotted(node.func, aliases)
+        is_jit = target in ("jax.jit", "jit") or target.endswith(".jit")
+        is_pallas = target.endswith("pallas_call")
+        if (is_jit or is_pallas) and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Name):
+                traced.add(first.id)
+    return traced
+
+
+def _impure_call_reason(call: ast.Call, aliases: dict[str, str]) -> str | None:
+    target = _dotted(call.func, aliases)
+    if not target:
+        return None
+    if target in _IMPURE_EXACT:
+        return target
+    for prefix in _IMPURE_PREFIXES:
+        if target.startswith(prefix):
+            return target
+    return None
+
+
+def _check_traced_body(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    aliases: dict[str, str],
+    path: str,
+    out: list[Finding],
+) -> None:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            reason = _impure_call_reason(node, aliases)
+            if reason is not None:
+                out.append(Finding(
+                    "py-traced-side-effect", Severity.ERROR, path,
+                    node.lineno,
+                    f"call to {reason}() inside traced function "
+                    f"{fn.name!r}: executes once at trace time and is "
+                    "baked into the compiled program",
+                ))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            out.append(Finding(
+                "py-traced-side-effect", Severity.ERROR, path, node.lineno,
+                f"{kind} mutation of {', '.join(node.names)} inside "
+                f"traced function {fn.name!r}: traced code must be pure",
+            ))
+
+
+def _check_reconcile_body(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    aliases: dict[str, str],
+    path: str,
+    out: list[Finding],
+) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _dotted(node.func, aliases)
+        if target == "time.sleep":
+            out.append(Finding(
+                "py-blocking-in-reconcile", Severity.ERROR, path,
+                node.lineno,
+                f"time.sleep in {fn.name!r}: blocks the shared reconcile "
+                "worker; return a requeue-after delay instead",
+            ))
+        elif target in _HTTP_TIMEOUT_REQUIRED or target.startswith(
+            "requests."
+        ):
+            out.append(Finding(
+                "py-blocking-in-reconcile", Severity.ERROR, path,
+                node.lineno,
+                f"direct HTTP call ({target}) in {fn.name!r}: move network "
+                "probes behind an injected callable with a timeout",
+            ))
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    def broad(node: ast.AST | None) -> bool:
+        if node is None:
+            return True  # bare except
+        if isinstance(node, ast.Tuple):
+            return any(broad(e) for e in node.elts)
+        return isinstance(node, ast.Name) and node.id in (
+            "Exception", "BaseException"
+        )
+
+    return broad(handler.type)
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body neither raises nor logs."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            target_parts = []
+            fn = node.func
+            while isinstance(fn, ast.Attribute):
+                target_parts.append(fn.attr)
+                fn = fn.value
+            if isinstance(fn, ast.Name):
+                target_parts.append(fn.id)
+            # log.warning / logging.exception / self.logger.error /
+            # record_event(...) all count as "not silent".
+            if any(
+                "log" in part.lower() for part in target_parts
+            ) or "record_event" in target_parts:
+                return False
+    return True
+
+
+def analyze_python_source(source: str, path: str) -> list[Finding]:
+    """All AST rules over one Python file. ``path`` is only used for
+    finding attribution (repo-relative)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding(
+            "py-syntax", Severity.ERROR, path, exc.lineno or 0,
+            f"file does not parse: {exc.msg}",
+        )]
+    aliases = _import_aliases(tree)
+    traced_names = _traced_function_names(tree, aliases)
+    out: list[Finding] = []
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            is_traced = node.name in traced_names or any(
+                _is_jit_decorator(d, aliases) for d in node.decorator_list
+            )
+            if is_traced:
+                _check_traced_body(node, aliases, path, out)
+            if node.name == "reconcile" or node.name.endswith("_reconcile"):
+                _check_reconcile_body(node, aliases, path, out)
+        elif isinstance(node, ast.Call):
+            target = _dotted(node.func, aliases)
+            display = _HTTP_TIMEOUT_REQUIRED.get(target)
+            if display is None and target.startswith("requests."):
+                tail = target.split(".", 1)[1]
+                if tail in ("get", "post", "put", "delete", "head",
+                            "patch", "request"):
+                    display = target
+            if display is not None and not any(
+                kw.arg == "timeout" for kw in node.keywords
+            ):
+                out.append(Finding(
+                    "py-http-no-timeout", Severity.ERROR, path, node.lineno,
+                    f"{display} without an explicit timeout=: the stdlib "
+                    "default blocks forever",
+                ))
+        elif isinstance(node, ast.ExceptHandler):
+            if _handler_is_broad(node) and _handler_swallows(node):
+                out.append(Finding(
+                    "py-broad-except", Severity.WARNING, path, node.lineno,
+                    "broad except swallows the failure silently: narrow "
+                    "the exception type, log it, or annotate with "
+                    "# analysis: allow[py-broad-except]",
+                ))
+    return out

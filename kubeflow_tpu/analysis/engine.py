@@ -1,0 +1,182 @@
+"""File walking, rule dispatch, pragma/baseline filtering, reporting.
+
+The engine owns everything rule packs shouldn't: which files are
+scanned, how findings are suppressed, and how the result is rendered
+and gated. Rule packs stay pure functions from file content to
+findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from kubeflow_tpu.analysis import ast_rules, manifest_rules, mesh_rules
+from kubeflow_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    is_suppressed,
+    load_baseline,
+)
+
+# Directories never scanned: VCS/caches, vendored frontends, and the
+# seeded-violation fixture tree (scanned only when passed explicitly).
+DEFAULT_EXCLUDE_DIRS = {
+    ".git", "__pycache__", ".pytest_cache", ".mypy_cache", ".ruff_cache",
+    "node_modules", ".venv", "venv", ".claude", "analysis_fixtures",
+}
+
+BASELINE_FILENAME = ".analysis-baseline.json"
+
+
+@dataclasses.dataclass
+class AnalysisConfig:
+    paths: list[str]
+    # The emitted-state probe spins the real controller against the fake
+    # apiserver; CLI flag --no-emitted turns it off for partial trees.
+    check_emitted: bool = True
+    exclude_dirs: set[str] = dataclasses.field(
+        default_factory=lambda: set(DEFAULT_EXCLUDE_DIRS)
+    )
+
+
+def _iter_files(config: AnalysisConfig):
+    seen: set[str] = set()
+    for path in config.paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            if path not in seen:
+                seen.add(path)
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if d not in config.exclude_dirs
+            )
+            for name in sorted(files):
+                full = os.path.join(root, name)
+                if full not in seen:
+                    seen.add(full)
+                    yield full
+
+
+def _rel(path: str, roots: list[str]) -> str:
+    """Repo-relative attribution: relative to the first root containing
+    the file, else the absolute path."""
+    for root in roots:
+        root = os.path.abspath(root)
+        base = root if os.path.isdir(root) else os.path.dirname(root)
+        try:
+            rel = os.path.relpath(path, base)
+        except ValueError:
+            continue
+        if not rel.startswith(".."):
+            return rel
+    return path
+
+
+def analyze_paths(config: AnalysisConfig) -> list[Finding]:
+    """Run every rule pack over the configured paths; returns findings
+    with pragma-suppressed occurrences removed (baseline filtering is
+    the caller's policy — see :func:`partition_baseline`)."""
+    findings: list[Finding] = []
+    manifest_state: dict = {}
+    # Source lines of scanned YAML files, for pragma checks on the
+    # cross-file findings finalized after the walk.
+    yaml_lines: dict[str, list[str]] = {}
+    for path in _iter_files(config):
+        if not path.endswith((".py", ".yaml", ".yml", ".md")):
+            continue  # no rule pack handles it: don't even read it
+        rel = _rel(path, config.paths)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        file_findings: list[Finding] = []
+        if path.endswith(".py"):
+            file_findings += ast_rules.analyze_python_source(text, rel)
+            file_findings += mesh_rules.analyze_python_mesh(text, rel)
+        elif path.endswith((".yaml", ".yml")):
+            # Kustomize reference checks resolve against the real
+            # directory, so the manifest pack gets absolute paths and
+            # findings are re-attributed below.
+            raw = manifest_rules.analyze_yaml_file(text, path, manifest_state)
+            file_findings += [
+                dataclasses.replace(f, path=_rel(f.path, config.paths))
+                for f in raw
+            ]
+            yaml_lines[rel] = text.splitlines()
+        elif path.endswith(".md"):
+            file_findings += mesh_rules.analyze_markdown_mesh(text, rel)
+        if file_findings:
+            lines = text.splitlines()
+            file_findings = [
+                f for f in file_findings if not is_suppressed(f, lines)
+            ]
+        findings += file_findings
+    for finding in manifest_rules.finalize_manifest_state(manifest_state):
+        finding = dataclasses.replace(
+            finding, path=_rel(finding.path, config.paths)
+        )
+        # Cross-file findings honor the same inline pragma as per-file
+        # ones, checked against the file the finding is attributed to.
+        if not is_suppressed(finding, yaml_lines.get(finding.path, [])):
+            findings.append(finding)
+    if config.check_emitted:
+        findings += manifest_rules.emitted_state_findings()
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def partition_baseline(
+    findings: list[Finding], baseline_path: str | None
+) -> tuple[list[Finding], list[Finding]]:
+    """Split into (new, baselined) against the accepted-findings file.
+
+    The baseline is an occurrence BUDGET per key: with one accepted
+    ``py-http-no-timeout`` in foo.py, a second urlopen added to foo.py
+    produces an identical key but exceeds the budget and still gates —
+    identical messages must not merge silently."""
+    budget = dict(load_baseline(baseline_path)) if baseline_path else {}
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for finding in findings:
+        if budget.get(finding.key, 0) > 0:
+            budget[finding.key] -= 1
+            old.append(finding)
+        else:
+            new.append(finding)
+    return new, old
+
+
+def render_report(
+    new: list[Finding], baselined: list[Finding], fmt: str = "text"
+) -> str:
+    if fmt == "json":
+        return json.dumps(
+            {
+                "findings": [dataclasses.asdict(f) | {"severity": str(
+                    f.severity
+                )} for f in new],
+                "baselined": len(baselined),
+            },
+            indent=2,
+        )
+    lines = [f.render() for f in new]
+    errors = sum(1 for f in new if f.severity == Severity.ERROR)
+    warnings = sum(1 for f in new if f.severity == Severity.WARNING)
+    infos = len(new) - errors - warnings
+    summary = (
+        f"{errors} error(s), {warnings} warning(s), {infos} info "
+        f"({len(baselined)} baselined finding(s) suppressed)"
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def gate_exit_code(new: list[Finding]) -> int:
+    """Non-zero exactly when an error-severity finding survived pragma
+    and baseline filtering — warnings inform, errors gate."""
+    return 1 if any(f.severity == Severity.ERROR for f in new) else 0

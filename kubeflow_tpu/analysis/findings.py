@@ -1,0 +1,130 @@
+"""Findings model, inline allow-pragmas, and the accepted-findings
+baseline.
+
+A finding's identity for baseline purposes is ``rule:path:message`` —
+deliberately NOT the line number, so unrelated edits above an accepted
+finding don't resurrect it in CI. The pragma, by contrast, is
+positional: ``# analysis: allow[rule-id]`` on the flagged line or the
+line directly above suppresses exactly that occurrence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import re
+
+
+class Severity(enum.IntEnum):
+    """Ordered so comparisons read naturally (ERROR > WARNING)."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR"
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: Severity
+    path: str  # repo-relative (or a pseudo-path like <emitted:...>)
+    line: int  # 1-based; 0 when the finding has no line anchor
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: stable across line-number drift."""
+        return f"{self.rule}:{self.path}:{self.message}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.severity}: [{self.rule}] {self.message}"
+
+
+# ``# analysis: allow[rule-id]`` — trailing prose after the bracket is
+# fine ("— best-effort close"); ``allow[*]`` suppresses every rule.
+_PRAGMA_RE = re.compile(r"#\s*analysis:\s*allow\[([A-Za-z0-9_*,\- ]+)\]")
+
+
+def pragma_rules(line: str) -> set[str]:
+    """Rule ids allowed by pragmas on this source line (empty if none)."""
+    out: set[str] = set()
+    for match in _PRAGMA_RE.finditer(line):
+        out.update(r.strip() for r in match.group(1).split(",") if r.strip())
+    return out
+
+
+def is_suppressed(finding: Finding, source_lines: list[str]) -> bool:
+    """True when an allow-pragma on the finding's line (or the line
+    above) names this rule or ``*``."""
+    if not finding.line:
+        return False
+    for idx in (finding.line - 1, finding.line - 2):
+        if 0 <= idx < len(source_lines):
+            allowed = pragma_rules(source_lines[idx])
+            if finding.rule in allowed or "*" in allowed:
+                return True
+    return False
+
+
+class BaselineError(ValueError):
+    """The baseline file exists but cannot be parsed — surfaced as a
+    clear message, never a raw traceback from deep inside json."""
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    """Accepted finding keys -> occurrence budget from a baseline JSON
+    file (missing file = empty baseline, so a fresh checkout needs no
+    setup). Keys are counted, not merely present: a SECOND occurrence
+    of an already-accepted finding in the same file is a new finding
+    and still gates."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as exc:
+        raise BaselineError(
+            f"baseline file {path} is not readable JSON ({exc}); fix it "
+            "or regenerate with --write-baseline"
+        ) from exc
+    if isinstance(doc, dict):
+        entries = doc.get("findings", [])
+    else:
+        entries = doc
+    budget: dict[str, int] = {}
+    try:
+        for entry in entries:
+            if isinstance(entry, str):
+                budget[entry] = budget.get(entry, 0) + 1
+            elif isinstance(entry, dict) and "key" in entry:
+                budget[entry["key"]] = budget.get(entry["key"], 0) + int(
+                    entry.get("count", 1)
+                )
+    except (TypeError, ValueError) as exc:
+        raise BaselineError(
+            f"baseline file {path} has a malformed entry ({exc}); fix it "
+            "or regenerate with --write-baseline"
+        ) from exc
+    return budget
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    """Persist current findings as the accepted baseline (sorted for
+    stable diffs; one entry per OCCURRENCE so the budget round-trips)."""
+    doc = {
+        "comment": (
+            "Accepted pre-existing findings for "
+            "python -m kubeflow_tpu.analysis; regenerate with "
+            "--write-baseline. Entries repeat once per occurrence; "
+            "findings beyond the accepted count still gate."
+        ),
+        "findings": sorted(f.key for f in findings),
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")

@@ -1,0 +1,397 @@
+"""Manifest/spec rules: YAML on disk plus controller-emitted state.
+
+- ``manifest-tpu-topology`` (error): anywhere a pod template pins a GKE
+  TPU node (``cloud.google.com/gke-tpu-accelerator`` +
+  ``gke-tpu-topology`` selectors) its ``google.com/tpu`` limits and —
+  for StatefulSets — replica count must agree with the slice math in
+  :mod:`kubeflow_tpu.topology`. A mismatch schedules pods that wedge at
+  ``jax.distributed`` init (too few workers) or never schedule at all
+  (limits exceed the host's chips).
+- ``manifest-poddefault-conflict`` (error): PodDefaults whose selectors
+  can match the same pod must not set the same env var to different
+  values — the webhook rejects such pods at admission, which with
+  ``failurePolicy: Fail`` blocks every CREATE in the namespace.
+- ``manifest-kustomize-ref`` (error): every ``resources``/generator
+  entry in a kustomization.yaml must exist on disk.
+- ``manifest-crd-kind`` (error): kubeflow.org CRs in the tree must have
+  a CRD shipping their kind.
+- ``manifest-webhook-policy`` (error/warning): webhook entries declare
+  ``failurePolicy`` explicitly (and a valid value); a ``Fail`` policy
+  on core-pods rules without a namespaceSelector is flagged — that
+  blast radius blocks kube-system pod CREATEs during webhook outages.
+- ``emitted-tpu-topology`` (error): drives the real notebook controller
+  against the in-memory fake apiserver for each spawner preset and runs
+  the same topology agreement check over the StatefulSets it emits —
+  catching generation bugs before any cluster sees them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.topology import (
+    ACCELERATORS,
+    GKE_ACCELERATOR_LABEL,
+    GKE_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    TopologyError,
+    TpuSlice,
+)
+
+_BY_GKE_NAME = {a.gke_accelerator: a for a in ACCELERATORS.values()}
+
+
+def _yaml_docs_with_lines(text: str):
+    """Parse multi-doc YAML, attaching the 1-based start line of each
+    doc (composer-level, so findings point at the right doc)."""
+    import yaml
+
+    docs = []
+    try:
+        loader = yaml.SafeLoader(text)
+        while loader.check_node():
+            node = loader.get_node()
+            doc = loader.construct_document(node)
+            if doc is not None:
+                docs.append((node.start_mark.line + 1, doc))
+    except yaml.YAMLError as exc:
+        mark = getattr(exc, "problem_mark", None)
+        return None, (mark.line + 1 if mark else 0, str(exc).split("\n")[0])
+    return docs, None
+
+
+def _pod_templates(doc: dict):
+    """Yield (template, replicas-or-None, kind) for workload kinds."""
+    kind = doc.get("kind", "")
+    if kind == "Pod":
+        yield doc, None, kind
+    elif kind in ("Deployment", "StatefulSet", "DaemonSet", "Job"):
+        spec = doc.get("spec") or {}
+        template = spec.get("template")
+        if isinstance(template, dict):
+            replicas = spec.get("replicas")
+            yield template, (replicas if kind in ("Deployment", "StatefulSet")
+                             else None), kind
+
+
+def check_tpu_pod_template(
+    template: dict, replicas, kind: str, path: str, line: int,
+) -> list[Finding]:
+    """The single topology-agreement check shared by the on-disk
+    manifest walk and the emitted-state probe."""
+    out: list[Finding] = []
+    spec = template.get("spec") or {}
+    selectors = spec.get("nodeSelector") or {}
+    acc_label = selectors.get(GKE_ACCELERATOR_LABEL)
+    topo_label = selectors.get(GKE_TOPOLOGY_LABEL)
+    limits_total = 0
+    for container in (spec.get("containers") or []):
+        limits = ((container.get("resources") or {}).get("limits") or {})
+        value = limits.get(TPU_RESOURCE)
+        if value is not None:
+            try:
+                limits_total += int(value)
+            except (TypeError, ValueError):
+                out.append(Finding(
+                    "manifest-tpu-topology", Severity.ERROR, path, line,
+                    f"{TPU_RESOURCE} limit {value!r} is not an integer",
+                ))
+                return out
+    if not (acc_label or topo_label or limits_total):
+        return out  # not a TPU workload
+
+    if bool(acc_label) != bool(topo_label):
+        out.append(Finding(
+            "manifest-tpu-topology", Severity.ERROR, path, line,
+            f"{kind} sets only one of {GKE_ACCELERATOR_LABEL}/"
+            f"{GKE_TOPOLOGY_LABEL}: both selectors are required for the "
+            "scheduler to place the slice",
+        ))
+        return out
+    if not acc_label:
+        # TPU limits with no topology selectors: outside GKE slice
+        # scheduling (e.g. the KinD fake plugin) — nothing to cross-check.
+        return out
+    acc = _BY_GKE_NAME.get(acc_label)
+    if acc is None:
+        out.append(Finding(
+            "manifest-tpu-topology", Severity.ERROR, path, line,
+            f"unknown {GKE_ACCELERATOR_LABEL} value {acc_label!r}; "
+            f"known: {sorted(_BY_GKE_NAME)}",
+        ))
+        return out
+    try:
+        tpu_slice = TpuSlice.parse(acc.name, str(topo_label))
+    except TopologyError as exc:
+        out.append(Finding(
+            "manifest-tpu-topology", Severity.ERROR, path, line, str(exc),
+        ))
+        return out
+    if limits_total != tpu_slice.chips_per_replica:
+        out.append(Finding(
+            "manifest-tpu-topology", Severity.ERROR, path, line,
+            f"{kind} requests {TPU_RESOURCE}={limits_total} per pod but a "
+            f"{tpu_slice.shorthand} slice ({topo_label}) exposes "
+            f"{tpu_slice.chips_per_replica} chips per host",
+        ))
+    if kind == "StatefulSet" and replicas is not None:
+        try:
+            replicas = int(replicas)
+        except (TypeError, ValueError):
+            out.append(Finding(
+                "manifest-tpu-topology", Severity.ERROR, path, line,
+                f"StatefulSet replicas {replicas!r} is not an integer",
+            ))
+            return out
+        if replicas != tpu_slice.num_hosts:
+            out.append(Finding(
+                "manifest-tpu-topology", Severity.ERROR, path, line,
+                f"StatefulSet replicas={replicas} but a "
+                f"{tpu_slice.shorthand} slice spans "
+                f"{tpu_slice.num_hosts} hosts; every host must run "
+                "exactly one worker or jax.distributed hangs at init",
+            ))
+    return out
+
+
+# ---- PodDefault conflicts ------------------------------------------------
+
+def _selectors_overlap(a: dict, b: dict) -> bool:
+    """Two matchLabels selectors can match the same pod unless they pin
+    the same key to different values."""
+    labels_a = (a.get("selector") or {}).get("matchLabels") or {}
+    labels_b = (b.get("selector") or {}).get("matchLabels") or {}
+    return all(
+        labels_a[k] == labels_b[k] for k in labels_a.keys() & labels_b.keys()
+    )
+
+
+def check_poddefault_conflicts(
+    poddefaults: list[tuple[str, int, dict]],
+) -> list[Finding]:
+    """``poddefaults``: (path, line, doc) tuples, already filtered to
+    kind PodDefault. Grouped by namespace (None = namespace decided at
+    kustomize time — PodDefaults shipped together land together)."""
+    out: list[Finding] = []
+    by_ns: dict[str, list[tuple[str, int, dict]]] = {}
+    for path, line, doc in poddefaults:
+        ns = (doc.get("metadata") or {}).get("namespace") or ""
+        by_ns.setdefault(ns, []).append((path, line, doc))
+    for entries in by_ns.values():
+        for i, (path_a, line_a, a) in enumerate(entries):
+            for path_b, line_b, b in entries[i + 1:]:
+                spec_a, spec_b = a.get("spec") or {}, b.get("spec") or {}
+                if not _selectors_overlap(spec_a, spec_b):
+                    continue
+                env_a = {e["name"]: e.get("value")
+                         for e in spec_a.get("env") or [] if "name" in e}
+                env_b = {e["name"]: e.get("value")
+                         for e in spec_b.get("env") or [] if "name" in e}
+                clashes = sorted(
+                    k for k in env_a.keys() & env_b.keys()
+                    if env_a[k] != env_b[k]
+                )
+                if clashes:
+                    name_a = (a.get("metadata") or {}).get("name", "?")
+                    name_b = (b.get("metadata") or {}).get("name", "?")
+                    out.append(Finding(
+                        "manifest-poddefault-conflict", Severity.ERROR,
+                        path_b, line_b,
+                        f"PodDefaults {name_a!r} "
+                        f"({os.path.basename(path_a)}:{line_a}) and "
+                        f"{name_b!r} select overlapping pods but disagree "
+                        f"on env {', '.join(clashes)}: the webhook rejects "
+                        "such pods at admission",
+                    ))
+    return out
+
+
+# ---- kustomize / CRD / webhook sanity ------------------------------------
+
+def check_kustomization(doc: dict, path: str, line: int) -> list[Finding]:
+    out: list[Finding] = []
+    base = os.path.dirname(path)
+    refs = list(doc.get("resources") or [])
+    for gen in doc.get("configMapGenerator") or []:
+        refs.extend(gen.get("envs") or [])
+        refs.extend(gen.get("files") or [])
+    for ref in refs:
+        if not isinstance(ref, str) or "://" in ref:
+            continue
+        if not os.path.exists(os.path.join(base, ref)):
+            out.append(Finding(
+                "manifest-kustomize-ref", Severity.ERROR, path, line,
+                f"kustomization references {ref!r} which does not exist",
+            ))
+    return out
+
+
+def check_crd_coverage(
+    cr_docs: list[tuple[str, int, dict]], crd_kinds: set[str],
+) -> list[Finding]:
+    """kubeflow.org CRs must have a CRD shipping their kind (skipped
+    when the scanned paths include no CRDs at all — a partial tree)."""
+    if not crd_kinds:
+        return []
+    out = []
+    for path, line, doc in cr_docs:
+        kind = doc.get("kind", "")
+        if kind and kind not in crd_kinds:
+            out.append(Finding(
+                "manifest-crd-kind", Severity.ERROR, path, line,
+                f"{doc.get('apiVersion')} {kind} has no CRD in the "
+                "scanned manifests: the apiserver would reject it",
+            ))
+    return out
+
+
+def check_webhook_config(doc: dict, path: str, line: int) -> list[Finding]:
+    out: list[Finding] = []
+    for hook in doc.get("webhooks") or []:
+        name = hook.get("name", "?")
+        policy = hook.get("failurePolicy")
+        if policy is None:
+            out.append(Finding(
+                "manifest-webhook-policy", Severity.ERROR, path, line,
+                f"webhook {name!r} does not declare failurePolicy: the "
+                "default (Fail) silently blocks CREATEs during outages — "
+                "state the choice explicitly",
+            ))
+            continue
+        if policy not in ("Fail", "Ignore"):
+            out.append(Finding(
+                "manifest-webhook-policy", Severity.ERROR, path, line,
+                f"webhook {name!r} has invalid failurePolicy {policy!r} "
+                "(must be Fail or Ignore)",
+            ))
+            continue
+        matches_pods = any(
+            "pods" in (rule.get("resources") or [])
+            and (not rule.get("apiGroups") or "" in rule["apiGroups"])
+            for rule in hook.get("rules") or []
+        )
+        if (policy == "Fail" and matches_pods
+                and not hook.get("namespaceSelector")):
+            out.append(Finding(
+                "manifest-webhook-policy", Severity.WARNING, path, line,
+                f"webhook {name!r} uses failurePolicy: Fail on core pods "
+                "without a namespaceSelector: a webhook outage would "
+                "block every pod CREATE cluster-wide, including "
+                "kube-system",
+            ))
+    return out
+
+
+# ---- file walk entry point -----------------------------------------------
+
+def analyze_yaml_file(text: str, path: str, state: dict) -> list[Finding]:
+    """Per-file manifest rules; cross-file rules (PodDefault conflicts,
+    CRD coverage) accumulate into ``state`` and are finalized by
+    :func:`finalize_manifest_state`."""
+    docs, err = _yaml_docs_with_lines(text)
+    if docs is None:
+        line, msg = err
+        return [Finding(
+            "manifest-yaml-parse", Severity.ERROR, path, line,
+            f"YAML does not parse: {msg}",
+        )]
+    out: list[Finding] = []
+    for line, doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        kind = doc.get("kind", "")
+        api = doc.get("apiVersion", "")
+        if os.path.basename(path) == "kustomization.yaml" or kind == (
+            "Kustomization"
+        ):
+            out.extend(check_kustomization(doc, path, line))
+            continue
+        for template, replicas, tkind in _pod_templates(doc):
+            out.extend(
+                check_tpu_pod_template(template, replicas, tkind, path, line)
+            )
+        if kind == "PodDefault":
+            state.setdefault("poddefaults", []).append((path, line, doc))
+        if kind == "CustomResourceDefinition":
+            names = ((doc.get("spec") or {}).get("names") or {})
+            if names.get("kind"):
+                state.setdefault("crd_kinds", set()).add(names["kind"])
+        elif api.startswith("kubeflow.org/"):
+            state.setdefault("cr_docs", []).append((path, line, doc))
+        if kind in ("MutatingWebhookConfiguration",
+                    "ValidatingWebhookConfiguration"):
+            out.extend(check_webhook_config(doc, path, line))
+    return out
+
+
+def finalize_manifest_state(state: dict) -> list[Finding]:
+    out = check_poddefault_conflicts(state.get("poddefaults", []))
+    out.extend(check_crd_coverage(
+        state.get("cr_docs", []), state.get("crd_kinds", set())
+    ))
+    return out
+
+
+# ---- controller-emitted desired state ------------------------------------
+
+# One preset per accelerator family x host-count regime.
+EMITTED_PRESETS = ("v5e-8", "v5e-16", "v4-8", "v6e-4")
+
+
+def emitted_state_findings() -> list[Finding]:
+    """Drive the real notebook controller against the fake apiserver and
+    topology-check every StatefulSet it emits. Import failures (native
+    core not built in this environment) skip with an info finding rather
+    than failing the gate — the rule is a cross-check, not a build."""
+    try:
+        from kubeflow_tpu.controllers.notebook import make_notebook_controller
+        from kubeflow_tpu.k8s.fake import FakeApiServer
+        from kubeflow_tpu import native
+        native.ensure_built()
+    # analysis: allow[py-broad-except] — converted into an info finding
+    except Exception as exc:
+        return [Finding(
+            "emitted-tpu-topology", Severity.INFO, "<emitted>", 0,
+            f"skipped: controller stack unavailable here ({exc})",
+        )]
+    out: list[Finding] = []
+    for shorthand in EMITTED_PRESETS:
+        tpu_slice = TpuSlice.from_shorthand(shorthand)
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": "probe", "namespace": "analysis"},
+            "spec": {
+                "template": {"spec": {"containers": [
+                    {"name": "probe", "image": "jupyter-jax-tpu"}
+                ]}},
+                "tpu": {
+                    "accelerator": tpu_slice.accelerator.name,
+                    "topology": tpu_slice.topology,
+                },
+            },
+        })
+        pseudo_path = f"<emitted:notebook-controller {shorthand}>"
+        try:
+            make_notebook_controller(api).run_once()
+            sts = api.get("apps/v1", "StatefulSet", "probe", "analysis")
+        # analysis: allow[py-broad-except] — converted into an error finding
+        except Exception as exc:
+            out.append(Finding(
+                "emitted-tpu-topology", Severity.ERROR, pseudo_path, 0,
+                f"controller failed to emit a StatefulSet: {exc}",
+            ))
+            continue
+        findings = check_tpu_pod_template(
+            (sts.get("spec") or {}).get("template") or {},
+            (sts.get("spec") or {}).get("replicas"),
+            "StatefulSet", pseudo_path, 0,
+        )
+        out.extend(
+            dataclasses.replace(f, rule="emitted-tpu-topology")
+            for f in findings
+        )
+    return out

@@ -1,0 +1,325 @@
+"""Topology/mesh factorization rules over code and docs.
+
+- ``mesh-factorization`` (error): a ``MeshSpec(...)`` built from
+  literal axis sizes must divide the TPU slice declared in the same
+  scope (a ``"v5e-16"``-style shorthand literal or a
+  ``TpuSlice.parse("v5e", "4x4")`` call). ``spec.resolve`` would raise
+  the same complaint — but only at runtime, on the slice, after the
+  notebook scheduled; this rule moves that failure to CI. Axis values
+  must also be sane in isolation (positive, with ``dp=-1`` as the only
+  sentinel).
+- ``mesh-doc-factorization`` (error): the same divisibility check for
+  Markdown: a paragraph naming both a slice shorthand and a
+  ``MeshSpec(...)`` with integer axes must be arithmetically consistent
+  — docs that teach impossible layouts produce support tickets.
+- ``mesh-1f1b-schedule`` (error): literal ``build_schedule`` /
+  pipeline-schedule arguments must satisfy the 1F1B precondition
+  ``num_microbatches % num_stages == 0``.
+- ``mesh-stage-layers`` (error): when one scope pins both
+  ``num_layers=L`` (an ``LMConfig``-style literal) and ``pp=P`` (a
+  ``MeshSpec`` literal), P must divide L — stages are contiguous layer
+  chunks.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import re
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.topology import ACCELERATORS, TopologyError, TpuSlice
+
+# Anchored: matches "v5e-16" as a whole string literal, never prose.
+_SHORTHAND_RE = re.compile(
+    r"^(%s)-(\d+)$" % "|".join(sorted(ACCELERATORS))
+)
+# In running text (docs): the same token on word boundaries.
+_SHORTHAND_TEXT_RE = re.compile(
+    r"\b(%s)-(\d+)\b" % "|".join(sorted(ACCELERATORS))
+)
+_MESHSPEC_TEXT_RE = re.compile(r"MeshSpec\(([^()]*)\)")
+_AXES = ("dp", "pp", "fsdp", "tp", "sp", "ep")
+
+
+def _literal_int(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+    return ".".join(reversed(parts))
+
+
+def _slice_chips_in_scope(scope_nodes: list[ast.AST]) -> set[int]:
+    """Chip counts of every slice declared by literals in the scope."""
+    chips: set[int] = set()
+    for node in scope_nodes:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            match = _SHORTHAND_RE.match(node.value)
+            if match:
+                chips.add(int(match.group(2)))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name.endswith("from_shorthand") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    match = _SHORTHAND_RE.match(arg.value)
+                    if match:
+                        chips.add(int(match.group(2)))
+            elif name.endswith("TpuSlice.parse") and len(node.args) == 2:
+                acc, topo = node.args
+                if (isinstance(acc, ast.Constant)
+                        and isinstance(topo, ast.Constant)):
+                    try:
+                        chips.add(
+                            TpuSlice.parse(acc.value, topo.value).chips
+                        )
+                    except (TopologyError, TypeError):
+                        pass  # TpuSlice.parse raises at runtime anyway
+    return chips
+
+
+def _meshspec_axes(call: ast.Call) -> dict[str, int] | None:
+    """Literal axis sizes of a MeshSpec(...) call; None when any axis is
+    non-literal (dynamic specs are out of static reach)."""
+    axes: dict[str, int] = {}
+    for kw in call.keywords:
+        if kw.arg not in _AXES:
+            return None  # **kwargs or unknown axis: bail out
+        value = _literal_int(kw.value)
+        if value is None:
+            return None
+        axes[kw.arg] = value
+    for name, node in zip(_AXES, call.args):
+        value = _literal_int(node)
+        if value is None:
+            return None
+        axes[name] = value
+    return axes
+
+
+def _check_meshspec(
+    call: ast.Call, axes: dict[str, int], chips: set[int],
+    path: str, out: list[Finding],
+) -> None:
+    for name, value in axes.items():
+        if value < 1 and not (name == "dp" and value == -1):
+            out.append(Finding(
+                "mesh-factorization", Severity.ERROR, path, call.lineno,
+                f"MeshSpec axis {name}={value} is invalid (axes are "
+                "positive; only dp may be -1 to absorb the remainder)",
+            ))
+            return
+    fixed = math.prod(
+        axes.get(a, 1) for a in _AXES if a != "dp"
+    )
+    dp = axes.get("dp", -1)
+    if len(chips) != 1:
+        return  # no (or ambiguous) slice declaration in scope
+    n = next(iter(chips))
+    if dp > 0:
+        if dp * fixed != n:
+            out.append(Finding(
+                "mesh-factorization", Severity.ERROR, path, call.lineno,
+                f"MeshSpec dp*pp*fsdp*tp*sp*ep = {dp * fixed} but the "
+                f"slice declared in this scope has {n} chips",
+            ))
+    elif n % fixed:
+        out.append(Finding(
+            "mesh-factorization", Severity.ERROR, path, call.lineno,
+            f"MeshSpec fixed axes product {fixed} does not divide the "
+            f"{n}-chip slice declared in this scope",
+        ))
+
+
+def _check_schedule_call(
+    call: ast.Call, path: str, out: list[Finding],
+) -> None:
+    name = _call_name(call)
+    short = name.rsplit(".", 1)[-1]
+    if short not in ("build_schedule", "one_f_one_b",
+                     "interleaved_one_f_one_b", "gpipe",
+                     "interleaved_gpipe"):
+        return
+    kwargs = {kw.arg: _literal_int(kw.value) for kw in call.keywords
+              if kw.arg}
+    # build_schedule's positional order is (num_microbatches, num_stages).
+    if short == "build_schedule":
+        positional = ("num_microbatches", "num_stages", "virtual_stages")
+        for pname, node in zip(positional, call.args):
+            kwargs.setdefault(pname, _literal_int(node))
+    microbatches = kwargs.get("num_microbatches")
+    stages = kwargs.get("num_stages")
+    if microbatches is None or stages is None or stages == 0:
+        return
+    if microbatches % stages:
+        out.append(Finding(
+            "mesh-1f1b-schedule", Severity.ERROR, path, call.lineno,
+            f"{short}: num_microbatches={microbatches} is not divisible "
+            f"by num_stages={stages}; the 1F1B chunk cycle requires "
+            "M % P == 0",
+        ))
+
+
+def _scope_nodes(fn: ast.AST) -> list[ast.AST]:
+    """All nodes of a function body, nested defs included — a slice
+    declared anywhere in the function anchors its MeshSpecs."""
+    return list(ast.walk(fn))
+
+
+def _expected_failure_nodes(tree: ast.AST) -> set[int]:
+    """Nodes inside ``with pytest.raises(...)`` bodies: deliberately
+    invalid inputs (the repo's own negative tests for the very
+    preconditions these rules check) must not be findings."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if any(
+            isinstance(item.context_expr, ast.Call)
+            and _call_name(item.context_expr).rsplit(".", 1)[-1] == "raises"
+            for item in node.items
+        ):
+            for child in node.body:
+                out.update(id(n) for n in ast.walk(child))
+    return out
+
+
+def analyze_python_mesh(source: str, path: str) -> list[Finding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []  # ast_rules already reports the parse failure
+    out: list[Finding] = []
+    expected_failures = _expected_failure_nodes(tree)
+
+    # Scopes: each top-level function/method, plus the module statements
+    # outside any function (constants next to module-level MeshSpecs).
+    scopes: list[list[ast.AST]] = []
+    fn_nodes: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = _scope_nodes(node)
+            scopes.append(scope)
+            fn_nodes.update(id(n) for n in scope)
+    module_scope = [
+        n for n in ast.walk(tree) if id(n) not in fn_nodes
+    ]
+    scopes.append(module_scope)
+
+    seen_calls: set[int] = set()
+    for scope in scopes:
+        chips = _slice_chips_in_scope(scope)
+        layers: set[int] = set()
+        pp: set[int] = set()
+        meshspec_calls: list[tuple[ast.Call, dict[str, int]]] = []
+        for node in scope:
+            if not isinstance(node, ast.Call) or id(node) in seen_calls:
+                continue
+            if id(node) in expected_failures:
+                seen_calls.add(id(node))
+                continue
+            name = _call_name(node)
+            if name.rsplit(".", 1)[-1] == "MeshSpec":
+                seen_calls.add(id(node))
+                axes = _meshspec_axes(node)
+                if axes is not None:
+                    meshspec_calls.append((node, axes))
+                    if axes.get("pp", 1) > 1:
+                        pp.add(axes["pp"])
+            elif name.rsplit(".", 1)[-1] in ("LMConfig", "TransformerConfig"):
+                seen_calls.add(id(node))
+                for kw in node.keywords:
+                    if kw.arg == "num_layers":
+                        value = _literal_int(kw.value)
+                        if value is not None:
+                            layers.add(value)
+            else:
+                seen_calls.add(id(node))
+                _check_schedule_call(node, path, out)
+        for call, axes in meshspec_calls:
+            _check_meshspec(call, axes, chips, path, out)
+        if len(layers) == 1 and len(pp) == 1:
+            n_layers, n_pp = next(iter(layers)), next(iter(pp))
+            if n_layers % n_pp:
+                # Anchor on the MeshSpec that declared pp.
+                anchor = next(
+                    (c for c, a in meshspec_calls if a.get("pp", 1) > 1),
+                    None,
+                )
+                out.append(Finding(
+                    "mesh-stage-layers", Severity.ERROR, path,
+                    anchor.lineno if anchor is not None else 0,
+                    f"pp={n_pp} pipeline stages cannot evenly split "
+                    f"num_layers={n_layers} declared in the same scope; "
+                    "stages are contiguous layer chunks",
+                ))
+    return out
+
+
+def analyze_markdown_mesh(text: str, path: str) -> list[Finding]:
+    """Docs rule: per paragraph (blank-line separated), a slice
+    shorthand + a literal-int MeshSpec must be consistent."""
+    out: list[Finding] = []
+    line_no = 1
+    for para in text.split("\n\n"):
+        para_start = line_no
+        line_no += para.count("\n") + 2
+        chips = {
+            int(m.group(2)) for m in _SHORTHAND_TEXT_RE.finditer(para)
+        }
+        if len(chips) != 1:
+            continue
+        n = next(iter(chips))
+        for match in _MESHSPEC_TEXT_RE.finditer(para):
+            axes: dict[str, int] = {}
+            parseable = True
+            for part in match.group(1).split(","):
+                if "=" not in part:
+                    parseable = False
+                    break
+                key, _, value = part.partition("=")
+                key = key.strip()
+                try:
+                    axes[key] = int(value.strip())
+                except ValueError:
+                    parseable = False
+                    break
+            if not parseable or not axes or any(
+                k not in _AXES for k in axes
+            ):
+                continue
+            fixed = math.prod(v for k, v in axes.items() if k != "dp")
+            dp = axes.get("dp", -1)
+            offset_line = para_start + para[:match.start()].count("\n")
+            if dp > 0 and dp * fixed != n:
+                out.append(Finding(
+                    "mesh-doc-factorization", Severity.ERROR, path,
+                    offset_line,
+                    f"doc pairs a {n}-chip slice with "
+                    f"MeshSpec({match.group(1)}) = {dp * fixed} devices",
+                ))
+            elif dp <= 0 and n % fixed:
+                out.append(Finding(
+                    "mesh-doc-factorization", Severity.ERROR, path,
+                    offset_line,
+                    f"doc pairs a {n}-chip slice with "
+                    f"MeshSpec({match.group(1)}): fixed product {fixed} "
+                    f"does not divide {n}",
+                ))
+    return out

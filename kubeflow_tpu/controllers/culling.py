@@ -60,7 +60,11 @@ def http_kernel_probe(
                 url_for(namespace, name), timeout=timeout
             ) as resp:
                 body = json.loads(resp.read().decode())
-        except Exception:
+        except Exception as exc:
+            # Unreachable counts as "no signal", not an error — but say
+            # so: a notebook that never becomes probeable would
+            # otherwise look permanently active with zero trace.
+            log.debug("kernel probe %s/%s failed: %s", namespace, name, exc)
             return None
         # The contract is a kernel LIST; any other shape (an error page
         # that parses as JSON, a dict) counts as unreachable, matching
@@ -102,7 +106,10 @@ def http_tpu_busy_probe(
                 url_for(namespace, name), timeout=timeout
             ) as resp:
                 text = resp.read().decode()
-        except Exception:
+        except Exception as exc:
+            # Not-busy by design (a wedged exporter must not pin the
+            # slice), but leave a trace for the operator.
+            log.debug("tpu busy probe %s/%s failed: %s", namespace, name, exc)
             return False
         return parse_duty_cycle(text) > threshold_pct
 

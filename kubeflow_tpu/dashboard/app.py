@@ -69,18 +69,24 @@ class KfamHttpProxy:
                 "X-XSRF-TOKEN": "dashboard-proxy",
             },
         )
+        import http.client
+
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode() or "{}")
         except urllib.error.HTTPError as err:
             try:
                 payload = json.loads(err.read().decode() or "{}")
-            except Exception:
+            # HTTPException: a truncated error body is still just a
+            # non-JSON body (IncompleteRead), not a proxy crash.
+            except (OSError, ValueError, http.client.HTTPException):
+                payload = {}
+            if not isinstance(payload, dict):  # error body was a JSON array
                 payload = {}
             raise ApiError(
                 payload.get("log", f"KFAM error {err.code}"), err.code
             )
-        except OSError as err:
+        except (OSError, http.client.HTTPException) as err:
             raise ApiError(f"KFAM unreachable: {err}", 502)
 
     # Method surface shared with KfamProxy (kept in sync by

@@ -165,6 +165,7 @@ class StackdriverMetricsService:
         return self._token[0]
 
     def _metadata_cluster(self) -> str:
+        import http.client
         import urllib.request
 
         try:
@@ -174,7 +175,9 @@ class StackdriverMetricsService:
             )
             with urllib.request.urlopen(req, timeout=5) as resp:
                 return resp.read().decode().strip()
-        except Exception:
+        # HTTPException: a proxy answering with garbage is still
+        # "not on GKE", not a dashboard crash.
+        except (OSError, ValueError, http.client.HTTPException):
             return ""  # not on GKE: stay unscoped
 
     def _cluster_clause(self) -> str:

@@ -398,7 +398,7 @@ class ApiClient:
         if conn is not None:
             try:
                 conn.close()
-            except Exception:
+            except Exception:  # analysis: allow[py-broad-except] best-effort close
                 pass
             self._local.conn = None
 
@@ -451,7 +451,7 @@ class ApiClient:
         message = ""
         try:
             message = json.loads(data).get("message", "")
-        except Exception:
+        except (ValueError, AttributeError):  # non-JSON / non-Status body
             message = data.decode(errors="replace")[:500]
         if status == 404:
             raise NotFound(message or "not found")
@@ -768,7 +768,7 @@ class ApiClient:
         finally:
             try:
                 conn.close()
-            except Exception:
+            except Exception:  # analysis: allow[py-broad-except] best-effort close
                 pass
 
     # ---- lifecycle -------------------------------------------------------

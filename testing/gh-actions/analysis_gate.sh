@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Static-analysis gate for CI: fail the build on any new error-severity
+# finding (manifest/topology agreement, PodDefault conflicts, traced-code
+# and controller hazards). Pre-existing accepted findings live in
+# .analysis-baseline.json; intentional occurrences carry an inline
+# `# analysis: allow[rule-id]` pragma. The same gate runs inside tier-1
+# pytest as tests/test_analysis_self.py, so environments without CI
+# still enforce it.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+python -m kubeflow_tpu.analysis .

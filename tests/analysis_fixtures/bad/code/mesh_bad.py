@@ -1,0 +1,22 @@
+"""Seeded violations for mesh-factorization, mesh-1f1b-schedule, and
+mesh-stage-layers. Fixture only — never imported."""
+
+from kubeflow_tpu.parallel.mesh import MeshSpec
+from kubeflow_tpu.parallel.schedule1f1b import build_schedule
+from kubeflow_tpu.topology import TpuSlice
+
+
+def bad_factorization():
+    tpu_slice = TpuSlice.from_shorthand("v5e-16")
+    spec = MeshSpec(tp=3)  # seeded: 3 does not divide 16 chips
+    return tpu_slice, spec
+
+
+def bad_schedule():
+    return build_schedule(6, 4, 2)  # seeded: 6 % 4 != 0
+
+
+def bad_stage_split(LMConfig):
+    cfg = LMConfig(num_layers=6)  # seeded: pp=4 cannot split 6 layers
+    spec = MeshSpec(dp=2, pp=4)
+    return cfg, spec

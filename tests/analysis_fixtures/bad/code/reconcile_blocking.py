@@ -1,0 +1,14 @@
+"""Seeded violations for py-blocking-in-reconcile and
+py-http-no-timeout. Fixture only — never imported."""
+
+import time
+import urllib.request
+
+
+class SleepyReconciler:
+    def reconcile(self, req):
+        time.sleep(30)  # seeded: blocks the shared worker
+        with urllib.request.urlopen(  # seeded: direct HTTP, no timeout
+            f"http://{req.name}.svc/api/kernels"
+        ) as resp:
+            return resp.read()

@@ -1,0 +1,10 @@
+"""Seeded violation for py-broad-except. Fixture only — never
+imported."""
+
+
+def load(path):
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except Exception:  # seeded: swallows without logging or raising
+        return None

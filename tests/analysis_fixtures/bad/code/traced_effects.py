@@ -1,0 +1,39 @@
+"""Seeded violations for py-traced-side-effect: wall-clock read,
+numpy RNG draw, global mutation inside jitted functions, and a sleep
+inside a pallas kernel. Fixture only — never imported."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_counter = 0
+
+
+@jax.jit
+def leaky_step(x):
+    stamp = time.time()  # seeded: baked in at trace time
+    noise = np.random.rand()  # seeded: same noise every step
+    return x * stamp + noise
+
+
+@partial(jax.jit, static_argnums=0)
+def bump(n, x):
+    global _counter  # seeded: closed-over mutation under trace
+    _counter += 1
+    return x + n
+
+
+def slow_kernel(x_ref, o_ref):
+    time.sleep(0.1)  # seeded: sleep inside a pallas kernel
+    o_ref[...] = x_ref[...]
+
+
+def run(x):
+    return pl.pallas_call(
+        slow_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(jnp.asarray(x))

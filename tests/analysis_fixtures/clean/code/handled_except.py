@@ -1,0 +1,30 @@
+"""Clean counterpart: narrow excepts, logged broad excepts, and one
+pragma-annotated intentional swallow. Fixture only — never imported."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def load(path):
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except OSError:  # narrow: only the expected failure
+        return None
+
+
+def load_logged(path):
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except Exception:
+        log.exception("load %s failed", path)
+        return None
+
+
+def close_quietly(conn):
+    try:
+        conn.close()
+    except Exception:  # analysis: allow[py-broad-except] best-effort close
+        pass

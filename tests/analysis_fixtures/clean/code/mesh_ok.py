@@ -1,0 +1,22 @@
+"""Clean counterpart: factorizations that divide the declared slice
+and a schedule satisfying M % P == 0. Fixture only — never imported."""
+
+from kubeflow_tpu.parallel.mesh import MeshSpec
+from kubeflow_tpu.parallel.schedule1f1b import build_schedule
+from kubeflow_tpu.topology import TpuSlice
+
+
+def good_factorization():
+    tpu_slice = TpuSlice.from_shorthand("v5e-16")
+    spec = MeshSpec(dp=2, fsdp=4, tp=2)  # 2*4*2 = 16 chips exactly
+    return tpu_slice, spec
+
+
+def good_schedule():
+    return build_schedule(8, 4, 2)
+
+
+def good_stage_split(LMConfig):
+    cfg = LMConfig(num_layers=8)
+    spec = MeshSpec(dp=2, pp=4)  # 4 stages x 2 layers each
+    return cfg, spec

@@ -1,0 +1,24 @@
+"""Clean counterpart: reconcile delegates probing to an injected
+callable (constructed with a timeout) and requeues instead of
+sleeping. Fixture only — never imported."""
+
+import urllib.request
+
+
+def make_probe(timeout=5.0):
+    def probe(url):
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read()
+
+    return probe
+
+
+class PatientReconciler:
+    def __init__(self, probe):
+        self.probe = probe
+
+    def reconcile(self, req):
+        body = self.probe(f"http://{req.name}.svc/api/kernels")
+        if body is None:
+            return 60.0  # requeue instead of blocking
+        return None

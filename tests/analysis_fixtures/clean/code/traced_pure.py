@@ -1,0 +1,19 @@
+"""Clean counterpart: pure jitted code — explicit PRNG keys, no
+wall-clock, no global state. Fixture only — never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_step(x, key):
+    noise = jax.random.normal(key, x.shape)
+    return x + 0.1 * noise
+
+
+def host_side_timing(fn, x):
+    import time
+
+    start = time.perf_counter()  # outside any trace: fine
+    y = fn(x)
+    return y, time.perf_counter() - start

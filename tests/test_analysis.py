@@ -1,0 +1,291 @@
+"""Static analyzer tests: every rule fires exactly where seeded in
+tests/analysis_fixtures/bad, stays quiet on the clean counterparts, and
+the suppression machinery (pragma + baseline) behaves."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.analysis import (
+    AnalysisConfig,
+    Finding,
+    Severity,
+    analyze_paths,
+    load_baseline,
+    write_baseline,
+)
+from kubeflow_tpu.analysis.engine import gate_exit_code, partition_baseline
+from kubeflow_tpu.analysis.findings import is_suppressed, pragma_rules
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bad_findings():
+    return analyze_paths(AnalysisConfig(paths=[BAD], check_emitted=False))
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def at(findings, rule, path_suffix, line=None):
+    return [
+        f for f in findings
+        if f.rule == rule and f.path.endswith(path_suffix)
+        and (line is None or f.line == line)
+    ]
+
+
+class TestSeededViolations:
+    """Each planted violation is found at its seeded location."""
+
+    def test_at_least_twelve_violations(self, bad_findings):
+        assert len(bad_findings) >= 12
+
+    def test_all_three_packs_fire(self, bad_findings):
+        rules = {f.rule for f in bad_findings}
+        assert any(r.startswith("manifest-") for r in rules)
+        assert any(r.startswith("mesh-") for r in rules)
+        assert any(r.startswith("py-") for r in rules)
+
+    # -- manifest pack --
+    def test_kustomize_missing_resource(self, bad_findings):
+        (f,) = by_rule(bad_findings, "manifest-kustomize-ref")
+        assert "missing.yaml" in f.message
+        assert f.severity == Severity.ERROR
+
+    def test_topology_limits_replicas_and_validity(self, bad_findings):
+        found = by_rule(bad_findings, "manifest-tpu-topology")
+        assert len(found) == 3
+        assert all(f.path.endswith("tpu-workloads.yaml") for f in found)
+        messages = " | ".join(f.message for f in found)
+        assert "4 chips per host" in messages  # limits mismatch
+        assert "spans 4 hosts" in messages  # replicas mismatch
+        assert "'3x3' is not a valid v5e slice" in messages
+
+    def test_non_integer_replicas_is_a_finding_not_a_crash(self, tmp_path):
+        from kubeflow_tpu.analysis.manifest_rules import (
+            check_tpu_pod_template,
+        )
+
+        template = {"spec": {
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "4x4",
+            },
+            "containers": [{"resources": {"limits": {"google.com/tpu": 4}}}],
+        }}
+        found = check_tpu_pod_template(
+            template, "${REPLICAS}", "StatefulSet", "x.yaml", 1
+        )
+        assert [f.rule for f in found] == ["manifest-tpu-topology"]
+        assert "not an integer" in found[0].message
+
+    def test_poddefault_env_conflict(self, bad_findings):
+        (f,) = by_rule(bad_findings, "manifest-poddefault-conflict")
+        assert "JAX_PLATFORMS" in f.message
+        assert f.path.endswith("poddefaults.yaml")
+
+    def test_webhook_failure_policy(self, bad_findings):
+        found = by_rule(bad_findings, "manifest-webhook-policy")
+        assert len(found) == 2
+        messages = " | ".join(f.message for f in found)
+        assert "does not declare failurePolicy" in messages
+        assert "invalid failurePolicy 'Failure'" in messages
+
+    # -- mesh pack --
+    def test_mesh_factorization(self, bad_findings):
+        (f,) = by_rule(bad_findings, "mesh-factorization")
+        assert f.path.endswith("mesh_bad.py")
+        assert "3 does not divide the 16-chip slice" in f.message
+
+    def test_1f1b_schedule_divisibility(self, bad_findings):
+        (f,) = by_rule(bad_findings, "mesh-1f1b-schedule")
+        assert "num_microbatches=6" in f.message
+
+    def test_stage_layer_divisibility(self, bad_findings):
+        (f,) = by_rule(bad_findings, "mesh-stage-layers")
+        assert "pp=4" in f.message and "num_layers=6" in f.message
+
+    def test_doc_factorization(self, bad_findings):
+        (f,) = by_rule(bad_findings, "mesh-doc-factorization")
+        assert f.path.endswith("layout.md")
+        assert "16-chip slice" in f.message
+
+    # -- AST pack --
+    def test_traced_side_effects(self, bad_findings):
+        found = by_rule(bad_findings, "py-traced-side-effect")
+        assert len(found) == 4
+        messages = " | ".join(f.message for f in found)
+        assert "time.time()" in messages  # jit wall-clock
+        assert "numpy.random.rand()" in messages  # jit numpy RNG
+        assert "global mutation of _counter" in messages
+        assert "'slow_kernel'" in messages  # pallas kernel sleep
+
+    def test_blocking_in_reconcile(self, bad_findings):
+        found = by_rule(bad_findings, "py-blocking-in-reconcile")
+        assert len(found) == 2
+        messages = " | ".join(f.message for f in found)
+        assert "time.sleep" in messages
+        assert "urllib.request.urlopen" in messages
+
+    def test_http_without_timeout(self, bad_findings):
+        found = by_rule(bad_findings, "py-http-no-timeout")
+        assert len(found) == 1
+        assert found[0].path.endswith("reconcile_blocking.py")
+
+    def test_broad_except_is_warning(self, bad_findings):
+        (f,) = by_rule(bad_findings, "py-broad-except")
+        assert f.severity == Severity.WARNING
+        assert f.path.endswith("silent_except.py")
+
+
+class TestCleanFixtures:
+    def test_clean_tree_is_silent(self):
+        findings = analyze_paths(
+            AnalysisConfig(paths=[CLEAN], check_emitted=False)
+        )
+        assert findings == []
+
+
+class TestSuppression:
+    def test_pragma_parses(self):
+        assert pragma_rules(
+            "    except Exception:  # analysis: allow[py-broad-except] why"
+        ) == {"py-broad-except"}
+        assert pragma_rules("# analysis: allow[a, b]") == {"a", "b"}
+        assert pragma_rules("# just a comment") == set()
+
+    def test_pragma_suppresses_line_and_line_above(self):
+        finding = Finding("r1", Severity.ERROR, "x.py", 2, "m")
+        on_line = ["a", "bad()  # analysis: allow[r1]"]
+        above = ["# analysis: allow[r1]", "bad()"]
+        other = ["# analysis: allow[r2]", "bad()"]
+        assert is_suppressed(finding, on_line)
+        assert is_suppressed(finding, above)
+        assert not is_suppressed(finding, other)
+        assert is_suppressed(finding, ["# analysis: allow[*]", "bad()"])
+
+    def test_baseline_round_trip(self, tmp_path, bad_findings):
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, bad_findings)
+        accepted = load_baseline(path)
+        assert {f.key for f in bad_findings} <= set(accepted)
+        # The baseline is an occurrence budget, not a mere key set.
+        assert sum(accepted.values()) == len(bad_findings)
+        new, old = partition_baseline(bad_findings, path)
+        assert new == [] and len(old) == len(bad_findings)
+        assert gate_exit_code(new) == 0
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+    def test_new_finding_still_gates_with_baseline(
+        self, tmp_path, bad_findings
+    ):
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, bad_findings[:1])
+        new, _ = partition_baseline(bad_findings, path)
+        assert gate_exit_code(new) == 1
+
+    def test_malformed_baseline_is_a_clear_error(self, tmp_path):
+        from kubeflow_tpu.analysis.findings import BaselineError
+
+        path = tmp_path / "baseline.json"
+        path.write_text("{not json")
+        with pytest.raises(BaselineError, match="not readable JSON"):
+            load_baseline(str(path))
+        path.write_text('{"findings": [{"key": "x", "count": "two"}]}')
+        with pytest.raises(BaselineError, match="malformed entry"):
+            load_baseline(str(path))
+
+    def test_pragma_suppresses_cross_file_finding(self, tmp_path):
+        """PodDefault conflicts are finalized after the file walk but
+        still honor an inline pragma above the flagged doc."""
+        conflict = """\
+apiVersion: kubeflow.org/v1alpha1
+kind: PodDefault
+metadata: {{name: a, namespace: ns}}
+spec:
+  selector: {{matchLabels: {{team: ml}}}}
+  env: [{{name: JAX_PLATFORMS, value: tpu}}]
+---
+{pragma}apiVersion: kubeflow.org/v1alpha1
+kind: PodDefault
+metadata: {{name: b, namespace: ns}}
+spec:
+  selector: {{matchLabels: {{team: ml}}}}
+  env: [{{name: JAX_PLATFORMS, value: cpu}}]
+"""
+        plain = tmp_path / "plain"
+        plain.mkdir()
+        (plain / "pd.yaml").write_text(conflict.format(pragma=""))
+        found = analyze_paths(
+            AnalysisConfig(paths=[str(plain)], check_emitted=False)
+        )
+        assert [f.rule for f in found] == ["manifest-poddefault-conflict"]
+
+        allowed = tmp_path / "allowed"
+        allowed.mkdir()
+        (allowed / "pd.yaml").write_text(conflict.format(
+            pragma="# analysis: allow[manifest-poddefault-conflict]\n"
+        ))
+        assert analyze_paths(
+            AnalysisConfig(paths=[str(allowed)], check_emitted=False)
+        ) == []
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+
+    def test_nonzero_on_seeded_tree(self, tmp_path):
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        proc = self.run_cli(
+            BAD, "--no-emitted", "--baseline", str(empty),
+        )
+        assert proc.returncode == 1
+        assert "[manifest-tpu-topology]" in proc.stdout
+        assert "error(s)" in proc.stdout
+
+    def test_zero_on_clean_tree(self):
+        proc = self.run_cli(CLEAN, "--no-emitted")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_json_format(self, tmp_path):
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        proc = self.run_cli(
+            BAD, "--no-emitted", "--baseline", str(empty),
+            "--format", "json",
+        )
+        doc = json.loads(proc.stdout)
+        assert doc["findings"]
+        assert {"rule", "severity", "path", "line", "message"} <= set(
+            doc["findings"][0]
+        )
+
+
+class TestEmittedState:
+    """The notebook controller's emitted StatefulSets satisfy the same
+    topology agreement the manifest rule enforces on disk."""
+
+    def test_emitted_presets_are_clean(self):
+        from kubeflow_tpu.analysis.manifest_rules import (
+            emitted_state_findings,
+        )
+
+        findings = emitted_state_findings()
+        errors = [f for f in findings if f.severity == Severity.ERROR]
+        assert errors == [], [f.render() for f in errors]

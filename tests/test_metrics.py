@@ -102,21 +102,21 @@ class TestControllerMetrics:
         server.start()
         try:
             base = f"http://127.0.0.1:{server.port}"
-            with urllib.request.urlopen(base + "/healthz") as resp:
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
                 assert resp.status == 200
             with pytest.raises(urllib.error.HTTPError) as err:
-                urllib.request.urlopen(base + "/readyz")
+                urllib.request.urlopen(base + "/readyz", timeout=5)
             assert err.value.code == 503
             ready[0] = True
-            with urllib.request.urlopen(base + "/readyz") as resp:
+            with urllib.request.urlopen(base + "/readyz", timeout=5) as resp:
                 assert resp.status == 200
-            with urllib.request.urlopen(base + "/metrics") as resp:
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
                 text = resp.read().decode()
             assert "service_heartbeat_total" in text
             # Debug endpoints are strictly opt-in (stack dumps leak
             # source layout): 404 by default.
             with pytest.raises(urllib.error.HTTPError) as err:
-                urllib.request.urlopen(base + "/debug/threads")
+                urllib.request.urlopen(base + "/debug/threads", timeout=5)
             assert err.value.code == 404
         finally:
             server.stop()
@@ -127,7 +127,7 @@ class TestControllerMetrics:
         server.start()
         try:
             base = f"http://127.0.0.1:{server.port}"
-            with urllib.request.urlopen(base + "/debug/threads") as resp:
+            with urllib.request.urlopen(base + "/debug/threads", timeout=5) as resp:
                 dump = resp.read().decode()
             assert "--- thread" in dump  # pprof-style dump serves
         finally:
@@ -178,7 +178,7 @@ class TestTpuDutyCycleSignal:
         try:
             port = server.server_address[1]
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics"
+                f"http://127.0.0.1:{port}/metrics", timeout=5
             ) as resp:
                 text = resp.read().decode()
             assert parse_duty_cycle(text) == 0.0
