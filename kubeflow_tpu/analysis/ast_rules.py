@@ -20,6 +20,16 @@
   whose handler neither re-raises nor logs — failures vanish. Narrow
   the type, add a log call, or annotate intentional swallows with
   ``# analysis: allow[py-broad-except]``.
+- ``py-retry-no-backoff`` (warning): a ``while`` loop (or an
+  attempt-style ``for attempt in ...`` loop) that retries after
+  catching an exception — ``continue`` in the handler, or a swallowing
+  handler that falls through to the next iteration — with no pacing
+  anywhere in the loop body: no sleep/wait/delay/backoff call, no
+  ``add_rate_limited``, no blocking ``.get(timeout=...)``. Hot retry
+  loops are how one failing dependency becomes a self-inflicted DDoS;
+  use ``k8s.retry.RetryPolicy`` (capped exponential + jitter) or the
+  workqueue's rate-limited re-add. Item-skip ``for`` loops (``except:
+  continue`` over a collection) are not retries and are not flagged.
 """
 
 from __future__ import annotations
@@ -177,6 +187,97 @@ def _check_reconcile_body(
             ))
 
 
+# Call-name fragments that count as backoff inside a retry loop: sleeps
+# (time.sleep, stop.wait, Event.wait, _retry_sleep), computed delays
+# (policy.delay, jittered_backoff), and the workqueue's own rate limiter.
+_BACKOFF_FRAGMENTS = ("sleep", "wait", "delay", "backoff", "jitter",
+                      "pause", "add_rate_limited")
+
+
+def _same_scope(node: ast.AST):
+    """Child nodes of ``node``, not descending into nested loops or
+    function/class definitions — a ``continue`` or a sleep inside a
+    nested loop belongs to that loop's retry story, not this one's."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.While, ast.For, ast.AsyncFor,
+                              ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _is_backoff_call(call: ast.Call, aliases: dict[str, str]) -> bool:
+    target = _dotted(call.func, aliases)
+    last = target.rsplit(".", 1)[-1].lower()
+    if any(frag in last for frag in _BACKOFF_FRAGMENTS):
+        return True
+    # The queue wait-loop idiom: ``q.get(timeout=...)`` blocks the
+    # thread for up to the timeout — that IS the pacing.
+    return last == "get" and any(
+        kw.arg == "timeout" for kw in call.keywords
+    )
+
+
+def _for_loop_is_attempts(loop: ast.For | ast.AsyncFor) -> bool:
+    """Only attempt-style for loops are retry loops: ``for attempt in
+    range(5)``. A ``continue`` while iterating over *items* skips the
+    item — the everyday shape, and not a retry."""
+    if isinstance(loop.target, ast.Name):
+        name = loop.target.id.lower()
+        return any(w in name for w in ("attempt", "retry", "tries"))
+    return False
+
+
+def _retry_handler_reason(
+    loop: ast.While | ast.For | ast.AsyncFor, handler: ast.ExceptHandler
+) -> str | None:
+    """Does this except handler send the loop around again? Either an
+    explicit ``continue``, or — in a ``while`` loop — a handler that
+    swallows the error (no raise/return/break), which falls through to
+    the next iteration."""
+    has_continue = False
+    exits = False
+    for node in _same_scope(handler):
+        if isinstance(node, ast.Continue):
+            has_continue = True
+        elif isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            exits = True
+    if has_continue:
+        return "continue in the except handler"
+    if isinstance(loop, ast.While) and not exits:
+        return "swallowing except handler in a while loop"
+    return None
+
+
+def _check_retry_loop(
+    loop: ast.While | ast.For | ast.AsyncFor,
+    aliases: dict[str, str],
+    path: str,
+    out: list[Finding],
+) -> None:
+    if isinstance(loop, (ast.For, ast.AsyncFor)) and \
+            not _for_loop_is_attempts(loop):
+        return
+    retry_reason = None
+    for node in _same_scope(loop):
+        if isinstance(node, ast.Call) and _is_backoff_call(node, aliases):
+            return  # backed off somewhere in the loop: fine
+        if isinstance(node, ast.ExceptHandler) and retry_reason is None:
+            retry_reason = _retry_handler_reason(loop, node)
+    if retry_reason is not None:
+        out.append(Finding(
+            "py-retry-no-backoff", Severity.WARNING, path, loop.lineno,
+            f"retry loop without backoff ({retry_reason}, no "
+            "sleep/delay/rate-limit call in the loop body): hot retries "
+            "amplify the failure they are retrying against — add capped "
+            "exponential backoff with jitter (k8s.retry.RetryPolicy) or "
+            "re-add via the workqueue's rate limiter",
+        ))
+
+
 def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
     def broad(node: ast.AST | None) -> bool:
         if node is None:
@@ -235,6 +336,8 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
                 _check_traced_body(node, aliases, path, out)
             if node.name == "reconcile" or node.name.endswith("_reconcile"):
                 _check_reconcile_body(node, aliases, path, out)
+        elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):
             target = _dotted(node.func, aliases)
             display = _HTTP_TIMEOUT_REQUIRED.get(target)

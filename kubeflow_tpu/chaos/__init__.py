@@ -1,0 +1,28 @@
+"""Deterministic chaos harness for the control plane.
+
+Jepsen-style fault schedules for the platform's own runtime: a seeded
+``FaultSchedule`` drives a ``ChaosApiServer`` proxy that injects
+apiserver weather (transient 5xx/429 with Retry-After, Conflict storms,
+NotFound flaps, latency spikes, blackouts) and watch-channel damage
+(dropped / duplicated / reordered events, 410-style compaction) into
+any duck-typed API — the in-memory FakeApiServer or the real ApiClient.
+``PreemptionInjector`` kills TPU worker pods the way GKE does (node
+taint + pod delete); ``StatefulSetPodSimulator`` plays the
+kubelet/statefulset-controller role the fake apiserver does not, so pod
+lifecycle chaos runs entirely in process. ``run_to_convergence`` drives
+controllers (plus simulators) to a quiescent state with the periodic
+resync run_forever would provide, bounding the reconcile count.
+
+Everything is seeded and clock-free: the same schedule replays the same
+fault sequence, so tests/test_chaos.py can assert the post-chaos world
+equals the fault-free one, exactly.
+"""
+
+from kubeflow_tpu.chaos.cluster import (  # noqa: F401
+    PREEMPTION_TAINT_KEY,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+)
+from kubeflow_tpu.chaos.harness import run_to_convergence  # noqa: F401
+from kubeflow_tpu.chaos.proxy import ChaosApiServer, ChaosWatchQueue  # noqa: F401
+from kubeflow_tpu.chaos.schedule import Fault, FaultSchedule  # noqa: F401

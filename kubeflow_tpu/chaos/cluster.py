@@ -1,0 +1,186 @@
+"""The cluster-side actors the fake apiserver does not model.
+
+``StatefulSetPodSimulator`` plays the statefulset-controller + kubelet:
+it materialises pods ``<sts>-0..N-1`` from every StatefulSet's template
+(fresh uid per incarnation, one synthetic node per ordinal — the GKE
+multi-host TPU layout, one worker pod per TPU VM) and removes
+higher-ordinal pods after a scale-down. Recreation is *per pod*, like
+the real statefulset controller — which is exactly why slice coherence
+must be enforced by the notebook reconciler, not assumed here.
+
+``PreemptionInjector`` kills TPU workers the way GKE preempts a node
+pool VM: the node is tainted with the impending-termination taint,
+then its pod is deleted out from under the workload. The injector
+talks to the *inner* (un-chaosed) API on purpose: preemption is
+cluster weather, not apiserver weather, and must land even while the
+proxy is injecting request faults.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.k8s.core import NotFound
+
+# The taint GKE places on a node about to lose its capacity
+# (spot/preemptible reclaim and maintenance both surface this way).
+PREEMPTION_TAINT_KEY = "cloud.google.com/impending-node-termination"
+
+
+class StatefulSetPodSimulator:
+    """Materialise StatefulSet pod sets against a fake apiserver."""
+
+    def __init__(self, api, node_prefix: str = "tpu-node"):
+        self.api = api
+        self.node_prefix = node_prefix
+        self.created_total = 0
+        self.deleted_total = 0
+
+    def node_name(self, sts_name: str, ordinal: int) -> str:
+        return f"{self.node_prefix}-{sts_name}-{ordinal}"
+
+    def _pod_for(self, sts: dict, ordinal: int) -> dict:
+        meta = sts["metadata"]
+        template = ((sts.get("spec") or {}).get("template")) or {}
+        labels = dict(
+            (template.get("metadata") or {}).get("labels") or {}
+        )
+        tpl_spec = template.get("spec") or {}
+        containers = [
+            {
+                "name": c.get("name", "main"),
+                "image": c.get("image", ""),
+                "resources": c.get("resources", {}),
+            }
+            for c in tpl_spec.get("containers") or []
+        ] or [{"name": "main", "image": ""}]
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{meta['name']}-{ordinal}",
+                "namespace": meta.get("namespace", "default"),
+                "labels": labels,
+                "ownerReferences": [{
+                    "apiVersion": "apps/v1",
+                    "kind": "StatefulSet",
+                    "name": meta["name"],
+                    "uid": meta.get("uid", ""),
+                }],
+            },
+            "spec": {
+                "nodeName": self.node_name(meta["name"], ordinal),
+                "containers": containers,
+            },
+            "status": {
+                "phase": "Running",
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "containerStatuses": [
+                    {
+                        "name": c["name"],
+                        "ready": True,
+                        "restartCount": 0,
+                        "state": {"running": {}},
+                    }
+                    for c in containers
+                ],
+            },
+        }
+
+    def step(self) -> int:
+        """One control-loop pass: create missing pods, prune pods whose
+        ordinal is past the current replica count. Returns the number
+        of changes made (0 = the pod world is settled)."""
+        changed = 0
+        for sts in self.api.list("apps/v1", "StatefulSet"):
+            meta = sts["metadata"]
+            ns = meta.get("namespace", "default")
+            replicas = (sts.get("spec") or {}).get("replicas")
+            replicas = 1 if replicas is None else int(replicas)
+            for ordinal in range(replicas):
+                name = f"{meta['name']}-{ordinal}"
+                try:
+                    self.api.get("v1", "Pod", name, ns)
+                except NotFound:
+                    self.api.create(self._pod_for(sts, ordinal))
+                    self.created_total += 1
+                    changed += 1
+            # Scale-down: the statefulset controller removes the
+            # highest ordinals first; order is irrelevant to the fake.
+            for pod in self.api.list(
+                "v1", "Pod", namespace=ns,
+                label_selector=None,
+            ):
+                pod_name = pod["metadata"]["name"]
+                prefix, _, suffix = pod_name.rpartition("-")
+                if prefix != meta["name"] or not suffix.isdigit():
+                    continue
+                if int(suffix) >= replicas:
+                    try:
+                        self.api.delete("v1", "Pod", pod_name, ns)
+                        self.deleted_total += 1
+                        changed += 1
+                    except NotFound:
+                        pass
+        return changed
+
+
+class PreemptionInjector:
+    """GKE-shaped TPU preemption: taint the node, delete its pod."""
+
+    def __init__(self, api):
+        self.api = api
+        self.preempted: list[tuple[str, str]] = []  # (namespace, pod)
+
+    def _taint_node(self, node_name: str) -> None:
+        taint = {"key": PREEMPTION_TAINT_KEY, "effect": "NoSchedule"}
+        try:
+            node = self.api.get("v1", "Node", node_name)
+        except NotFound:
+            self.api.create({
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": node_name},
+                "spec": {"taints": [taint]},
+            })
+            return
+        taints = (node.get("spec") or {}).get("taints") or []
+        if not any(t.get("key") == PREEMPTION_TAINT_KEY for t in taints):
+            self.api.patch_merge(
+                "v1", "Node", node_name,
+                {"spec": {"taints": taints + [taint]}},
+            )
+
+    def preempt_pod(self, namespace: str, name: str) -> str | None:
+        """Preempt one pod; returns the tainted node's name (None when
+        the pod was already gone)."""
+        try:
+            pod = self.api.get("v1", "Pod", name, namespace)
+        except NotFound:
+            return None
+        node_name = (pod.get("spec") or {}).get("nodeName") or ""
+        if node_name:
+            self._taint_node(node_name)
+        try:
+            self.api.delete("v1", "Pod", name, namespace)
+        except NotFound:
+            return None
+        self.preempted.append((namespace, name))
+        return node_name or None
+
+    def preempt_worker(self, namespace: str, notebook: str,
+                       ordinal: int) -> str | None:
+        """Preempt TPU worker ``ordinal`` of a notebook's slice."""
+        return self.preempt_pod(namespace, f"{notebook}-{ordinal}")
+
+    def recover_node(self, node_name: str) -> None:
+        """Clear the termination taint (the replacement VM arriving)."""
+        try:
+            node = self.api.get("v1", "Node", node_name)
+        except NotFound:
+            return
+        taints = [
+            t for t in (node.get("spec") or {}).get("taints") or []
+            if t.get("key") != PREEMPTION_TAINT_KEY
+        ]
+        self.api.patch_merge(
+            "v1", "Node", node_name, {"spec": {"taints": taints}}
+        )

@@ -1,0 +1,101 @@
+"""Convergence driver for chaos runs.
+
+``run_once`` drains what is ready *now*; rate-limited retries park keys
+with a future ``not_before``, and dropped watch events or spurious
+NotFound reads are only repaired by the periodic resync ``run_forever``
+would perform. This driver plays run_forever's role deterministically,
+and — unlike a wall-clock loop — it must *prove* convergence, not just
+observe a momentary lull: a reconcile that was lied to (injected 404 on
+the primary get) leaves no pending work behind, so queue emptiness
+alone is a false signal.
+
+Convergence therefore means: ``settle_rounds`` consecutive rounds in
+which (a) every controller's resync LIST succeeded, (b) draining the
+re-enqueued keys changed nothing in the store (resourceVersion stable),
+(c) simulators made no changes, and (d) no retry is parked for later.
+Fault schedules are op-bounded, so the verification rounds themselves
+push the op counter past every fault window — the loop cannot wedge
+inside a storm. The round bound turns "self-healing" into an assertable
+property: convergence within ``max_rounds`` or AssertionError.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def clamp_backoff(controller, base_delay: float = 0.001,
+                  max_delay: float = 0.05) -> None:
+    """Shrink a controller's workqueue backoff so chaos suites retry in
+    milliseconds, not the production 60s cap. Call before the first
+    reconcile — semantics (dedup, earliest-wins, per-key exponential
+    growth) are untouched, only the timescale."""
+    controller.queue._base = base_delay
+    controller.queue._max = max_delay
+
+
+def _store_rv(controllers) -> int:
+    """Monotonic write marker for the backing store. FakeApiServer (and
+    the chaos proxy wrapping it, via passthrough) expose
+    ``last_resource_version``; anything else falls back to 0, which
+    degrades the settled check to queue/resync evidence only."""
+    for ctrl in controllers:
+        rv = getattr(ctrl.api, "last_resource_version", None)
+        if rv is not None:
+            return int(rv)
+    return 0
+
+
+def run_to_convergence(
+    controllers,
+    sims=(),
+    max_rounds: int = 400,
+    settle_rounds: int = 2,
+    resync_every: int = 5,
+    sleep=time.sleep,
+) -> int:
+    """Drive controllers (+ pod simulators) until the world is provably
+    settled for ``settle_rounds`` consecutive rounds. Returns the number
+    of rounds taken — callers assert it against their bound, making
+    reconcile cost under chaos a regression-checked number."""
+    quiet = 0
+    rounds = 0
+    while quiet < settle_rounds:
+        rounds += 1
+        if rounds > max_rounds:
+            raise AssertionError(
+                f"no convergence within {max_rounds} rounds "
+                f"(queues: {[len(c.queue) for c in controllers]})"
+            )
+        rv_before = _store_rv(controllers)
+        sim_changed = 0
+        for sim in sims:
+            sim_changed += sim.step()
+        # Level-based repair: periodically during the run, and on EVERY
+        # candidate-settled round — a round only counts as quiet when a
+        # successful full re-list found nothing to fix.
+        resync_ok = True
+        if quiet > 0 or rounds == 1 or rounds % resync_every == 0:
+            for ctrl in controllers:
+                resync_ok = (ctrl.resync() is not None) and resync_ok
+        for ctrl in controllers:
+            ctrl.run_once()
+        parked = [
+            d for d in (c.queue.next_deadline() for c in controllers)
+            if d is not None
+        ]
+        if parked:
+            # Retries backing off: wait them out (bounded), keep going.
+            wait = min(parked) - time.monotonic()
+            if wait > 0:
+                sleep(min(wait, 0.05))
+        if (
+            sim_changed
+            or parked
+            or not resync_ok
+            or _store_rv(controllers) != rv_before
+        ):
+            quiet = 0
+        else:
+            quiet += 1
+    return rounds

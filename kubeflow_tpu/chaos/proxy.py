@@ -1,0 +1,202 @@
+"""ChaosApiServer: a fault-injecting proxy over any duck-typed API.
+
+Sits where the network sits in a real cluster — between the controllers
+and the apiserver — and injects the failures the network and the
+apiserver actually produce, on the schedule's deterministic script.
+Wraps anything exposing the FakeApiServer interface (the fake itself,
+or a real ApiClient); everything not explicitly intercepted passes
+through untouched, so webhook listers, metrics collectors and fixtures
+keep working against the wrapped handle.
+
+Faults surface as the exceptions the real client raises (ApiError with
+a status code, Conflict, NotFound), so every retry/backoff/watchdog
+layer above sees exactly what it would see in production. Watch queues
+come back wrapped in ``ChaosWatchQueue``, which damages the event
+stream (drop / duplicate / reorder / compact) at delivery time.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import time
+from collections import deque
+
+from kubeflow_tpu.chaos import schedule as sched
+from kubeflow_tpu.chaos.schedule import Fault, FaultSchedule
+from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
+
+
+class ChaosWatchQueue:
+    """Duck-type of the queue.Queue a watch returns, applying the
+    schedule's per-event damage when events are pulled. Only the two
+    methods the controller runtime uses (``empty``/``get_nowait``) plus
+    ``get``/``put`` for harness compatibility are provided."""
+
+    def __init__(self, inner: queue.Queue, schedule: FaultSchedule,
+                 stats: dict):
+        self._inner = inner
+        self._schedule = schedule
+        self._stats = stats
+        self._pending: deque = deque()
+
+    def _pull(self) -> None:
+        while True:
+            try:
+                ev = self._inner.get_nowait()
+            except queue.Empty:
+                return
+            action = self._schedule.next_watch_action()
+            if action == sched.DROP:
+                self._stats["watch_dropped"] += 1
+                continue
+            if action == sched.DUP:
+                self._stats["watch_duplicated"] += 1
+                self._pending.append(ev)
+                self._pending.append(ev)
+                continue
+            if action == sched.REORDER and self._pending:
+                # Deliver this event before its predecessor — the
+                # out-of-order delivery a re-connecting informer can see.
+                self._stats["watch_reordered"] += 1
+                prev = self._pending.pop()
+                self._pending.append(ev)
+                self._pending.append(prev)
+                continue
+            if action == sched.COMPACT:
+                # Watch-cache compaction: the whole pending backlog is
+                # beyond the horizon. Level-based resync is the only
+                # repair, exactly like a 410 Gone without re-list.
+                self._stats["watch_compacted"] += 1
+                self._pending.clear()
+                while True:
+                    try:
+                        self._inner.get_nowait()
+                    except queue.Empty:
+                        break
+                continue
+            self._pending.append(ev)
+
+    def empty(self) -> bool:
+        self._pull()
+        return not self._pending
+
+    def get_nowait(self):
+        self._pull()
+        if not self._pending:
+            raise queue.Empty
+        return self._pending.popleft()
+
+    def get(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get_nowait()
+            except queue.Empty:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.005)
+
+    def put(self, item) -> None:
+        self._inner.put(item)
+
+
+class ChaosApiServer:
+    """Fault-injecting proxy with the FakeApiServer interface.
+
+    ``injected`` counts faults by kind so tests can assert the schedule
+    actually fired (a schedule that never triggers proves nothing).
+    ``sleep`` is injectable so latency faults cost no wall-clock in
+    tests that don't care about it.
+    """
+
+    def __init__(self, inner, schedule: FaultSchedule, sleep=time.sleep):
+        self.inner = inner
+        self.schedule = schedule
+        self._sleep = sleep
+        self._ops = itertools.count()
+        self.injected: dict[str, int] = {
+            sched.ERROR: 0, sched.CONFLICT: 0, sched.NOT_FOUND: 0,
+            sched.LATENCY: 0, sched.BLACKOUT: 0,
+            "watch_dropped": 0, "watch_duplicated": 0,
+            "watch_reordered": 0, "watch_compacted": 0,
+        }
+        self.ops_total = 0
+
+    # ---- fault gate ------------------------------------------------------
+    def _gate(self, verb: str, kind: str) -> None:
+        op = next(self._ops)
+        self.ops_total = op + 1
+        fault = self.schedule.fault_for(op, verb, kind)
+        if fault is None:
+            return
+        self.injected[fault.kind] = self.injected.get(fault.kind, 0) + 1
+        self._raise(fault, verb, kind, op)
+
+    def _raise(self, fault: Fault, verb: str, kind: str, op: int) -> None:
+        where = f"op {op} {verb} {kind}"
+        if fault.kind == sched.LATENCY:
+            self._sleep(fault.latency_s)
+            return
+        if fault.kind == sched.CONFLICT:
+            raise Conflict(f"chaos: injected conflict ({where})")
+        if fault.kind == sched.NOT_FOUND:
+            raise NotFound(f"chaos: injected 404 flap ({where})")
+        if fault.kind == sched.BLACKOUT:
+            raise ApiError(f"chaos: apiserver blackout ({where})", 503)
+        err = ApiError(
+            f"chaos: injected {fault.status} ({where})", fault.status
+        )
+        # Carried the way the real client reads it off the response
+        # headers; informational for assertions on 429 handling.
+        err.retry_after = fault.retry_after
+        raise err
+
+    # ---- intercepted verbs ----------------------------------------------
+    def create(self, obj: dict, namespace: str | None = None,
+               dry_run: bool = False) -> dict:
+        self._gate("create", obj.get("kind", ""))
+        return self.inner.create(obj, namespace=namespace, dry_run=dry_run)
+
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: str | None = None) -> dict:
+        self._gate("get", kind)
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def list(self, api_version: str, kind: str, namespace: str | None = None,
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
+        self._gate("list", kind)
+        return self.inner.list(api_version, kind, namespace=namespace,
+                               label_selector=label_selector,
+                               field_selector=field_selector)
+
+    def update(self, obj: dict, dry_run: bool = False) -> dict:
+        self._gate("update", obj.get("kind", ""))
+        return self.inner.update(obj, dry_run=dry_run)
+
+    def patch_merge(self, api_version: str, kind: str, name: str,
+                    patch: dict, namespace: str | None = None) -> dict:
+        self._gate("patch_merge", kind)
+        return self.inner.patch_merge(api_version, kind, name, patch,
+                                      namespace)
+
+    def delete(self, api_version: str, kind: str, name: str,
+               namespace: str | None = None) -> None:
+        self._gate("delete", kind)
+        return self.inner.delete(api_version, kind, name, namespace)
+
+    def apply(self, obj: dict) -> dict:
+        self._gate("apply", obj.get("kind", ""))
+        return self.inner.apply(obj)
+
+    def watch(self, api_version: str, kind: str, *args, **kwargs):
+        q = self.inner.watch(api_version, kind, *args, **kwargs)
+        return ChaosWatchQueue(q, self.schedule, self.injected)
+
+    # ---- passthrough -----------------------------------------------------
+    def __getattr__(self, name):
+        # Everything else (read_pod_logs, set_pod_logs, register_admission,
+        # list_with_rv, events_since, breaker/request_metrics on a real
+        # client, ...) is the inner API's business.
+        return getattr(self.inner, name)

@@ -1,0 +1,187 @@
+"""Seeded fault schedules: the deterministic script a chaos run follows.
+
+A schedule is a list of windows over an *operation counter* (every API
+call through the ChaosApiServer advances it by one), not wall-clock
+time — controllers in the test ladder run synchronously, so op counts
+are reproducible where timestamps are not. Each window names a fault
+kind, the ops it covers, an injection rate, and optional verb/kind
+filters; rate draws come from one seeded ``random.Random``, so the
+full fault sequence is a pure function of (seed, op sequence).
+
+Watch-channel faults (drop / dup / reorder / compact) are a separate
+per-event stream drawn from the same generator: the proxy's wrapped
+watch queues consult ``next_watch_action`` once per delivered event.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+# API-call fault kinds.
+ERROR = "error"          # transient HTTP error (status, optional Retry-After)
+CONFLICT = "conflict"    # 409 optimistic-concurrency storm
+NOT_FOUND = "not_found"  # spurious 404 flap on reads
+LATENCY = "latency"      # slow round-trip (injected sleep)
+BLACKOUT = "blackout"    # apiserver fully dark: every verb fails
+
+# Watch-event fault kinds.
+DROP = "drop"
+DUP = "dup"
+REORDER = "reorder"
+COMPACT = "compact"      # watch-cache compaction: pending backlog lost
+
+_WRITE_VERBS = frozenset({"create", "update", "patch_merge", "delete"})
+_READ_VERBS = frozenset({"get", "list"})
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One injected fault occurrence, as handed to the proxy."""
+
+    kind: str
+    status: int = 503
+    retry_after: float | None = None
+    latency_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class _Window:
+    kind: str
+    start: int
+    end: int | None  # exclusive; None = forever
+    rate: float
+    verbs: frozenset[str] | None
+    kinds: frozenset[str] | None
+    status: int
+    retry_after: float | None
+    latency_s: float
+
+    def covers(self, op: int, verb: str, obj_kind: str) -> bool:
+        if op < self.start or (self.end is not None and op >= self.end):
+            return False
+        if self.verbs is not None and verb not in self.verbs:
+            return False
+        if self.kinds is not None and obj_kind not in self.kinds:
+            return False
+        return True
+
+
+class FaultSchedule:
+    """Composable, seeded fault script.
+
+    Builder methods return ``self`` so schedules read as one
+    expression::
+
+        FaultSchedule(seed=7).conflict_storm(0, 40).blackout(60, 90)
+
+    Determinism contract: with a fixed seed AND a fixed sequence of
+    (op, verb, kind) queries — which synchronous test runs guarantee —
+    the injected faults are identical on every replay.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._windows: list[_Window] = []
+        self._watch_rates: dict[str, float] = {}
+        self._watch_budget: dict[str, int | None] = {}
+
+    # ---- builders --------------------------------------------------------
+    def add(
+        self,
+        kind: str,
+        start: int = 0,
+        end: int | None = None,
+        rate: float = 1.0,
+        verbs=None,
+        kinds=None,
+        status: int = 503,
+        retry_after: float | None = None,
+        latency_s: float = 0.0,
+    ) -> "FaultSchedule":
+        self._windows.append(_Window(
+            kind=kind, start=start, end=end, rate=rate,
+            verbs=frozenset(verbs) if verbs else None,
+            kinds=frozenset(kinds) if kinds else None,
+            status=status, retry_after=retry_after, latency_s=latency_s,
+        ))
+        return self
+
+    def errors(self, start: int = 0, end: int | None = None,
+               rate: float = 0.3, status: int = 503,
+               retry_after: float | None = None) -> "FaultSchedule":
+        """Transient 5xx/429 on any verb (the retry-policy diet)."""
+        return self.add(ERROR, start, end, rate, status=status,
+                        retry_after=retry_after)
+
+    def conflict_storm(self, start: int = 0, end: int | None = None,
+                       rate: float = 0.5) -> "FaultSchedule":
+        """409s on writes — stale-read storms under churn."""
+        return self.add(CONFLICT, start, end, rate, verbs=_WRITE_VERBS)
+
+    def not_found_flaps(self, start: int = 0, end: int | None = None,
+                        rate: float = 0.2, kinds=None) -> "FaultSchedule":
+        """Spurious 404 on reads (a lagging watch cache's view)."""
+        return self.add(NOT_FOUND, start, end, rate, verbs=_READ_VERBS,
+                        kinds=kinds)
+
+    def latency_spikes(self, start: int = 0, end: int | None = None,
+                       rate: float = 0.2,
+                       latency_s: float = 0.01) -> "FaultSchedule":
+        return self.add(LATENCY, start, end, rate, latency_s=latency_s)
+
+    def blackout(self, start: int, end: int) -> "FaultSchedule":
+        """Full apiserver outage: every call in [start, end) fails."""
+        return self.add(BLACKOUT, start, end, rate=1.0)
+
+    def watch_faults(self, drop: float = 0.0, dup: float = 0.0,
+                     reorder: float = 0.0, compact: float = 0.0,
+                     max_compactions: int | None = 1) -> "FaultSchedule":
+        """Per-delivered-event damage rates for wrapped watch queues.
+        ``max_compactions`` bounds the most destructive fault (each
+        compaction throws away the whole pending backlog)."""
+        for kind, rate in ((DROP, drop), (DUP, dup), (REORDER, reorder),
+                           (COMPACT, compact)):
+            if rate:
+                self._watch_rates[kind] = rate
+        self._watch_budget[COMPACT] = max_compactions
+        return self
+
+    # ---- queries (proxy side) -------------------------------------------
+    def fault_for(self, op: int, verb: str, kind: str) -> Fault | None:
+        """The fault (if any) to inject for API call number ``op``.
+        First matching window that fires wins; BLACKOUT windows always
+        fire regardless of rate draws (an outage is not probabilistic).
+        """
+        for win in self._windows:
+            if not win.covers(op, verb, kind):
+                continue
+            if win.kind != BLACKOUT and self._rng.random() >= win.rate:
+                continue
+            return Fault(win.kind, status=win.status,
+                         retry_after=win.retry_after,
+                         latency_s=win.latency_s)
+        return None
+
+    def next_watch_action(self) -> str | None:
+        """One draw per delivered watch event: None = deliver clean."""
+        for kind, rate in self._watch_rates.items():
+            if self._rng.random() >= rate:
+                continue
+            budget = self._watch_budget.get(kind)
+            if budget is not None:
+                if budget <= 0:
+                    continue
+                self._watch_budget[kind] = budget - 1
+            return kind
+        return None
+
+    def describe(self) -> str:
+        parts = [f"seed={self.seed}"]
+        for w in self._windows:
+            span = f"[{w.start},{'∞' if w.end is None else w.end})"
+            parts.append(f"{w.kind}{span}@{w.rate:g}")
+        for kind, rate in self._watch_rates.items():
+            parts.append(f"watch-{kind}@{rate:g}")
+        return " ".join(parts)

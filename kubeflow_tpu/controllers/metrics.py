@@ -16,6 +16,7 @@ process shares across its controllers, exposed by ``ManagerServer`` on
 from __future__ import annotations
 
 import http.server
+import logging
 import threading
 from typing import Callable, Iterable
 
@@ -25,9 +26,11 @@ from prometheus_client import (
     Gauge,
     generate_latest,
 )
-from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 
 from kubeflow_tpu.k8s.fake import FakeApiServer
+
+log = logging.getLogger(__name__)
 
 
 class RunningNotebooksCollector:
@@ -39,6 +42,7 @@ class RunningNotebooksCollector:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
+        self._last_good: dict[str, int] = {}
 
     def describe(self):
         return []
@@ -49,16 +53,30 @@ class RunningNotebooksCollector:
             "Current running notebooks in the cluster",
             labels=["namespace"],
         )
-        per_ns: dict[str, int] = {}
-        for sts in self.api.list("apps/v1", "StatefulSet"):
-            labels = (
-                ((sts.get("spec") or {}).get("template") or {})
-                .get("metadata", {})
-                .get("labels", {})
-            ) or {}
-            if labels.get("notebook-name") == sts["metadata"]["name"]:
-                ns = sts["metadata"].get("namespace", "")
-                per_ns[ns] = per_ns.get(ns, 0) + 1
+        try:
+            stss = self.api.list("apps/v1", "StatefulSet")
+        except Exception as exc:
+            # The scrape must outlive the apiserver: during an outage
+            # /metrics is exactly where operators look (breaker state,
+            # retry counters), so a failed LIST serves the last good
+            # gauge instead of killing the whole exposition.
+            log.warning("notebook_running scrape: list failed (%s); "
+                        "serving last-known values", exc)
+            stss = None
+        if stss is None:
+            per_ns = self._last_good
+        else:
+            per_ns = {}
+            for sts in stss:
+                labels = (
+                    ((sts.get("spec") or {}).get("template") or {})
+                    .get("metadata", {})
+                    .get("labels", {})
+                ) or {}
+                if labels.get("notebook-name") == sts["metadata"]["name"]:
+                    ns = sts["metadata"].get("namespace", "")
+                    per_ns[ns] = per_ns.get(ns, 0) + 1
+            self._last_good = per_ns
         for ns, count in sorted(per_ns.items()):
             fam.add_metric([ns], count)
         yield fam
@@ -85,6 +103,56 @@ class QueueDepthCollector:
         yield fam
 
 
+class ClientResilienceCollector:
+    """ApiClient retry/circuit-breaker state on ``/metrics``: how hard
+    the client is fighting to reach the apiserver. Read at scrape time
+    from the live client (k8s/retry.py) — the breaker state gauge is
+    the first thing to check when reconciles stall cluster-wide."""
+
+    _STATE_VALUE = {"closed": 0, "half-open": 1, "open": 2}
+
+    def __init__(self, client):
+        self.client = client
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        m = self.client.request_metrics
+        yield CounterMetricFamily(
+            "apiserver_client_request",
+            "Apiserver round-trips attempted by this client",
+            value=m["requests"],
+        )
+        yield CounterMetricFamily(
+            "apiserver_client_retry",
+            "Transient-failure retries issued by this client",
+            value=m["retries"],
+        )
+        budget = self.client.retry_budget
+        yield CounterMetricFamily(
+            "apiserver_client_retry_budget_exhausted",
+            "Retries suppressed because the client retry budget was dry",
+            value=budget.exhausted_total,
+        )
+        breaker = self.client.breaker
+        yield GaugeMetricFamily(
+            "apiserver_client_circuit_breaker_state",
+            "Circuit breaker state: 0 closed, 1 half-open, 2 open",
+            value=self._STATE_VALUE.get(breaker.state, 0),
+        )
+        yield CounterMetricFamily(
+            "apiserver_client_circuit_breaker_open",
+            "Times the circuit breaker tripped open",
+            value=breaker.opens_total,
+        )
+        yield CounterMetricFamily(
+            "apiserver_client_circuit_breaker_fast_fail",
+            "Requests fast-failed while the breaker was open",
+            value=breaker.fast_fail_total,
+        )
+
+
 class ControllerMetrics:
     """The manager-wide registry plus the event-driven counters the
     reconcilers increment."""
@@ -93,6 +161,10 @@ class ControllerMetrics:
         self.registry = CollectorRegistry()
         if api is not None:
             self.registry.register(RunningNotebooksCollector(api))
+            # Real ApiClient (or a chaos wrapper around one): expose its
+            # retry/breaker state next to the controller metrics.
+            if hasattr(api, "breaker") and hasattr(api, "request_metrics"):
+                self.registry.register(ClientResilienceCollector(api))
         self.notebook_create_total = Counter(
             "notebook_create",
             "Total times of creating notebooks",
@@ -139,6 +211,21 @@ class ControllerMetrics:
             "controller_reconcile",
             "Reconcile invocations per controller and result",
             ["controller", "result"],
+            registry=self.registry,
+        )
+        self.reconcile_stuck_total = Counter(
+            "controller_reconcile_stuck",
+            "Reconciles flagged by the stuck-reconcile watchdog "
+            "(mode: failures = consecutive-failure threshold, "
+            "deadline = per-reconcile deadline exceeded)",
+            ["controller", "mode"],
+            registry=self.registry,
+        )
+        self.notebook_preemption_restart_total = Counter(
+            "notebook_preemption_restart",
+            "Coherent full-slice restarts after a TPU worker was "
+            "preempted or evicted",
+            ["namespace"],
             registry=self.registry,
         )
 

@@ -81,7 +81,12 @@ class PvcViewerReconciler:
             "ready": bool((deployment.get("status") or {}).get("readyReplicas")),
             "url": out["url"],
         }
-        if viewer.get("status") != status:
+        # Compare (and patch) only the keys this reconciler owns:
+        # status may also carry foreign keys — e.g. the runtime
+        # watchdog's Degraded condition — and comparing the whole dict
+        # against an exact computed value would rewrite status forever.
+        cur = viewer.get("status") or {}
+        if {k: cur.get(k) for k in status} != status:
             self.api.patch_merge(
                 PVCVIEWER_API, "PVCViewer", req.name, {"status": status},
                 req.namespace,

@@ -14,6 +14,8 @@ background thread + periodic resync used in real deployments.
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import logging
 import threading
 import time
@@ -52,21 +54,35 @@ class WorkQueue:
         self._lock = threading.Lock()
         self._pending: dict[Request, float] = {}  # req -> not_before
         self._failures: dict[Request, int] = {}
+        # Min-heap of (not_before, seq, req) mirroring _pending. Entries
+        # superseded by an earlier re-add stay in the heap and are
+        # skipped lazily in pop_ready (their not_before no longer
+        # matches _pending) — pop is O(log n) amortised instead of the
+        # former O(n log n) full sort per pop.
+        self._heap: list[tuple[float, int, Request]] = []
+        self._seq = itertools.count()
+
+    def _schedule(self, req: Request, not_before: float) -> None:
+        # Lock held. Keep the earliest scheduled time for duplicates:
+        # an item that is already due must never be pushed back.
+        cur = self._pending.get(req)
+        if cur is None or not_before < cur:
+            self._pending[req] = not_before
+            heapq.heappush(self._heap, (not_before, next(self._seq), req))
 
     def add(self, req: Request, delay: float = 0.0) -> None:
         with self._lock:
-            not_before = time.monotonic() + delay
-            cur = self._pending.get(req)
-            # Keep the earliest scheduled time for duplicates.
-            if cur is None or not_before < cur:
-                self._pending[req] = not_before
+            self._schedule(req, time.monotonic() + delay)
 
     def add_rate_limited(self, req: Request) -> None:
         with self._lock:
             failures = self._failures.get(req, 0)
             self._failures[req] = failures + 1
             delay = min(self._base * (2**failures), self._max)
-            self._pending[req] = time.monotonic() + delay
+            # Same earliest-wins rule as add(): a rate-limited re-add
+            # races watch-driven adds, and pushing back an already-due
+            # item would starve it behind every later arrival.
+            self._schedule(req, time.monotonic() + delay)
 
     def forget(self, req: Request) -> None:
         with self._lock:
@@ -75,12 +91,17 @@ class WorkQueue:
     def pop_ready(self) -> Request | None:
         with self._lock:
             now = time.monotonic()
-            for req, not_before in sorted(
-                self._pending.items(), key=lambda kv: kv[1]
-            ):
-                if not_before <= now:
-                    del self._pending[req]
-                    return req
+            while self._heap:
+                not_before, _, req = self._heap[0]
+                cur = self._pending.get(req)
+                if cur is None or cur != not_before:
+                    heapq.heappop(self._heap)  # stale/superseded entry
+                    continue
+                if not_before > now:
+                    return None  # heap min not due: nothing is
+                heapq.heappop(self._heap)
+                del self._pending[req]
+                return req
             return None
 
     def next_deadline(self) -> float | None:
@@ -239,6 +260,9 @@ class Controller:
         watches: list[WatchSpec],
         resync_period: float = 300.0,
         prom=None,  # optional ControllerMetrics for Prometheus exposition
+        reconcile_deadline: float = 30.0,
+        stuck_threshold: int = 10,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.name = name
         self.api = api
@@ -246,6 +270,16 @@ class Controller:
         self.queue = WorkQueue()
         self.resync_period = resync_period
         self.prom = prom
+        # Stuck-reconcile watchdog knobs: a reconcile running past
+        # reconcile_deadline, or a key failing stuck_threshold times in
+        # a row, is surfaced (Degraded condition + Warning Event +
+        # metrics) instead of hot-looping silently. The clock is
+        # injectable so tests drive the deadline deterministically.
+        self.reconcile_deadline = reconcile_deadline
+        self.stuck_threshold = stuck_threshold
+        self.clock = clock
+        self._failure_streak: dict[Request, int] = {}
+        self._degraded: set[Request] = set()
         self._watch_queues = []
         for spec in watches:
             q = api.watch(spec.api_version, spec.kind)
@@ -253,7 +287,10 @@ class Controller:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._initial_synced = False
-        self.metrics = {"reconciles": 0, "errors": 0, "requeues": 0}
+        self.metrics = {
+            "reconciles": 0, "errors": 0, "requeues": 0,
+            "stuck": 0, "deadline_exceeded": 0,
+        }
         # Called once per loop tick (config-file watches and other
         # fsnotify-style side channels hook in here).
         self.tick_hooks: list[Callable[[], None]] = []
@@ -289,6 +326,7 @@ class Controller:
         if req is None:
             return False
         self.metrics["reconciles"] += 1
+        started = self.clock()
         try:
             requeue_after = self.reconciler.reconcile(req)
         except Exception:
@@ -296,15 +334,134 @@ class Controller:
             self.metrics["errors"] += 1
             if self.prom is not None:
                 self.prom.reconcile_total.labels(self.name, "error").inc()
+            streak = self._failure_streak.get(req, 0) + 1
+            self._failure_streak[req] = streak
+            if streak >= self.stuck_threshold and req not in self._degraded:
+                self._mark_degraded(req, streak)
             self.queue.add_rate_limited(req)
             return True
+        elapsed = self.clock() - started
+        if elapsed > self.reconcile_deadline:
+            # Reconciles run on shared workers and cannot be aborted
+            # mid-flight; the watchdog surfaces the overrun so a wedged
+            # probe or API hang is an alert, not a silent stall.
+            self.metrics["deadline_exceeded"] += 1
+            if self.prom is not None:
+                self.prom.reconcile_stuck_total.labels(
+                    self.name, "deadline"
+                ).inc()
+            self._record_watchdog_event(
+                req, "ReconcileDeadlineExceeded",
+                f"reconcile of {req.namespace}/{req.name} took "
+                f"{elapsed:.1f}s (deadline {self.reconcile_deadline:.1f}s)",
+            )
         if self.prom is not None:
             self.prom.reconcile_total.labels(self.name, "success").inc()
+        self._failure_streak.pop(req, None)
+        if req in self._degraded:
+            self._clear_degraded(req)
         self.queue.forget(req)
         if requeue_after is not None:
             self.metrics["requeues"] += 1
             self.queue.add(req, delay=requeue_after)
         return True
+
+    # ---- stuck-reconcile watchdog ---------------------------------------
+    def _primary_object(self, req: Request) -> dict | None:
+        """The CR this controller owns for ``req``, via the primary
+        watch spec; None when unreachable (the apiserver may be the
+        very thing that is failing)."""
+        if not self._watch_queues:
+            return None
+        spec = self._watch_queues[0][0]
+        try:
+            return self.api.get(
+                spec.api_version, spec.kind, req.name,
+                req.namespace or None,
+            )
+        except Exception as exc:
+            log.debug("%s: watchdog could not fetch %s: %s",
+                      self.name, req, exc)
+            return None
+
+    def _record_watchdog_event(
+        self, req: Request, reason: str, message: str,
+        event_type: str = "Warning",
+    ) -> None:
+        obj = self._primary_object(req)
+        if obj is None:
+            return
+        record_event(
+            self.api, obj, reason, message, event_type=event_type,
+            component=self.name,
+        )
+
+    def _patch_degraded_condition(
+        self, req: Request, condition: dict | None
+    ) -> None:
+        """Set (or, with ``condition=None``, remove) the watchdog's
+        Degraded condition on the primary CR. Removal must delete the
+        ``conditions`` key outright when nothing else is left: a CR
+        whose reconciler exact-compares its computed status (pvcviewer,
+        tensorboard) would otherwise see a foreign leftover key and
+        rewrite status forever."""
+        if not self._watch_queues:  # watch-less controller: no CR to mark
+            return
+        spec = self._watch_queues[0][0]
+        obj = self._primary_object(req)
+        if obj is None:
+            return
+        conditions = [
+            c for c in (obj.get("status") or {}).get("conditions") or []
+            if c.get("type") != "Degraded"
+        ]
+        if condition is not None:
+            conditions.append(condition)
+        try:
+            self.api.patch_merge(
+                spec.api_version, spec.kind, req.name,
+                {"status": {"conditions": conditions or None}},
+                req.namespace or None,
+            )
+        except Exception:
+            # Best-effort like event writes: the status patch must not
+            # turn a degraded key into a crashed controller.
+            log.debug("%s: Degraded condition patch failed for %s",
+                      self.name, req)
+
+    def _mark_degraded(self, req: Request, streak: int) -> None:
+        """Consecutive-failure threshold crossed: make the stall
+        visible on the CR (Degraded condition + Warning Event) instead
+        of hot-looping silently. The workqueue's exponential backoff
+        keeps retrying underneath; a later success clears the mark."""
+        self.metrics["stuck"] += 1
+        self._degraded.add(req)
+        if self.prom is not None:
+            self.prom.reconcile_stuck_total.labels(
+                self.name, "failures"
+            ).inc()
+        message = (
+            f"reconcile has failed {streak} consecutive times; "
+            "retrying with exponential backoff"
+        )
+        log.warning("%s: %s/%s %s", self.name, req.namespace, req.name,
+                    message)
+        self._patch_degraded_condition(req, {
+            "type": "Degraded",
+            "status": "True",
+            "reason": "ReconcileStuck",
+            "message": message,
+        })
+        self._record_watchdog_event(req, "ReconcileStuck", message)
+
+    def _clear_degraded(self, req: Request) -> None:
+        self._degraded.discard(req)
+        self._patch_degraded_condition(req, None)
+        self._record_watchdog_event(
+            req, "ReconcileRecovered",
+            f"reconcile of {req.namespace}/{req.name} recovered",
+            event_type="Normal",
+        )
 
     def run_once(self, max_iterations: int = 100) -> int:
         """Drain watches and reconcile until quiescent (tests/dev).
@@ -344,14 +501,42 @@ class Controller:
             if not worked:
                 self._stop.wait(poll_interval)
 
-    def resync(self):
-        """Re-enqueue every primary object (level-based safety net)."""
+    def resync(self) -> int | None:
+        """Re-enqueue every primary object (level-based safety net).
+        A failed LIST (apiserver outage) must not kill the run loop —
+        the next periodic resync retries; until then the watch stream
+        and the queue's own retries keep the controller alive. Returns
+        the number of objects enqueued, or None when the list failed —
+        the chaos harness needs to distinguish "provably nothing to do"
+        from "could not even ask"."""
         spec = self._watch_queues[0][0] if self._watch_queues else None
         if spec is None:
-            return
-        for obj in self.api.list(spec.api_version, spec.kind):
+            return 0
+        try:
+            objs = self.api.list(spec.api_version, spec.kind)
+        except Exception as exc:
+            log.warning("%s: resync list failed (%s); retrying on the "
+                        "next cycle", self.name, exc)
+            return None
+        count = 0
+        for obj in objs:
+            # Restart amnesia repair: the failure streak behind a
+            # Degraded mark lives only in memory, so after a controller
+            # restart the mark would never be cleared. Rebuild the
+            # in-memory set from the observed CR state, and the next
+            # successful reconcile removes the condition as usual.
+            inherited = any(
+                c.get("type") == "Degraded"
+                and c.get("status") == "True"
+                and c.get("reason") == "ReconcileStuck"
+                for c in (obj.get("status") or {}).get("conditions") or []
+            )
             for req in (spec.mapper or self._default_request)(obj):
                 self.queue.add(req)
+                count += 1
+                if inherited:
+                    self._degraded.add(req)
+        return count
 
     def start(self) -> threading.Thread:
         # Controllers are restarted across leadership transitions

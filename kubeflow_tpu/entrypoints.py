@@ -269,6 +269,7 @@ def run_admission_webhook():
     from kubeflow_tpu.webhook.server import (
         AdmissionHandler,
         CABundleInjector,
+        CachedPodDefaultLister,
         WebhookServer,
     )
 
@@ -279,7 +280,12 @@ def run_admission_webhook():
     def list_poddefaults(namespace: str):
         return api.list(poddefault_api, "PodDefault", namespace=namespace)
 
-    handler = AdmissionHandler(list_poddefaults)
+    # Bounded-staleness cache: with failurePolicy Fail, an apiserver
+    # blip must not turn every pod create into a rejection.
+    handler = AdmissionHandler(CachedPodDefaultLister(
+        list_poddefaults,
+        max_stale_s=float(os.environ.get("PODDEFAULT_MAX_STALE", "120")),
+    ))
     certfile = os.environ.get("CERT_FILE", "/etc/webhook/certs/tls.crt")
     server = WebhookServer(
         handler,

@@ -53,6 +53,14 @@ from kubeflow_tpu.k8s.core import (
     WatchEvent,
     resource_name,
 )
+from kubeflow_tpu.k8s.retry import (
+    RETRIABLE_STATUS,
+    RETRIABLE_VERBS,
+    CircuitBreaker,
+    RetryBudget,
+    RetryPolicy,
+    parse_retry_after,
+)
 
 log = logging.getLogger(__name__)
 
@@ -294,9 +302,32 @@ class _WatchState:
 class ApiClient:
     """HTTPS apiserver client with the FakeApiServer interface."""
 
-    def __init__(self, config: KubeConfig, request_timeout: float = 30.0):
+    def __init__(
+        self,
+        config: KubeConfig,
+        request_timeout: float = 30.0,
+        retry_policy: RetryPolicy | None = None,
+        retry_budget: RetryBudget | None = None,
+        breaker: CircuitBreaker | None = None,
+    ):
         self.config = config
         self.request_timeout = request_timeout
+        # Resilience discipline for every apiserver round-trip (see
+        # k8s/retry.py): per-request backoff, client-wide retry budget,
+        # and a circuit breaker that fast-fails while the apiserver is
+        # provably down. All injectable for deterministic chaos tests.
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.retry_budget = retry_budget or RetryBudget()
+        self.breaker = breaker or CircuitBreaker()
+        # Surfaced on /metrics by ClientResilienceCollector
+        # (controllers/metrics.py) next to the breaker's own counters.
+        # Incremented under a lock: the client is shared across watch
+        # threads and the request path, and these are the counters used
+        # to diagnose retry storms — losing increments there defeats
+        # the point.
+        self.request_metrics = {"requests": 0, "retries": 0}
+        self._metrics_lock = threading.Lock()
+        self._retry_sleep = time.sleep  # injectable (chaos tests)
         url = urllib.parse.urlsplit(config.host)
         self._tls = url.scheme == "https"
         self._netloc = url.netloc
@@ -335,14 +366,30 @@ class ApiClient:
     def _auth_headers(self) -> dict:
         cfg = self.config
         if cfg.token_file:
-            now = time.monotonic()
-            if self._token is None or now - self._token_read_at > TOKEN_REFRESH_S:
-                try:
-                    with open(cfg.token_file) as fh:
-                        self._token = fh.read().strip()
-                    self._token_read_at = now
-                except OSError:
-                    log.warning("token file %s unreadable", cfg.token_file)
+            # Same serialization as the exec branch below: watch threads
+            # and the request path share _token/_token_read_at, and an
+            # unlocked read-modify-write can publish a half-refreshed
+            # pair (new stamp, old token) or re-read the file once per
+            # thread crossing the window.
+            def file_stale() -> bool:
+                return (
+                    self._token is None
+                    or time.monotonic() - self._token_read_at
+                    > TOKEN_REFRESH_S
+                )
+
+            if file_stale():
+                with self._token_lock:
+                    if file_stale():  # re-check under the lock
+                        try:
+                            with open(cfg.token_file) as fh:
+                                token = fh.read().strip()
+                            self._token = token
+                            self._token_read_at = time.monotonic()
+                        except OSError:
+                            log.warning(
+                                "token file %s unreadable", cfg.token_file
+                            )
         elif cfg.exec_spec:
             # Lazily run the credential plugin; re-run one minute before
             # the reported expiry so a long-lived out-of-cluster
@@ -402,6 +449,10 @@ class ApiClient:
                 pass
             self._local.conn = None
 
+    def _count(self, key: str) -> None:
+        with self._metrics_lock:
+            self.request_metrics[key] += 1
+
     def _request(
         self,
         method: str,
@@ -412,8 +463,14 @@ class ApiClient:
         raw: bool = False,
     ):
         """One apiserver round-trip on the per-thread keep-alive
-        connection; a stale connection (server closed the keep-alive)
-        gets one retry on a fresh socket for idempotent methods."""
+        connection, under the client's full retry discipline
+        (k8s/retry.py): idempotent verbs retry transient failures —
+        connection errors, 429 (honoring ``Retry-After``) and 5xx —
+        with capped exponential backoff + jitter, each retry charged
+        against the client-wide budget; non-idempotent verbs (POST)
+        never retry. Consecutive hard failures trip the circuit
+        breaker, which fast-fails without touching the socket until a
+        half-open probe succeeds."""
         target = self._base_path + path
         if query:
             target += "?" + urllib.parse.urlencode(query)
@@ -425,22 +482,60 @@ class ApiClient:
         payload = None
         if body is not None:
             payload = body if isinstance(body, (bytes, str)) else json.dumps(body)
-        retriable = method in ("GET", "PUT", "DELETE", "PATCH")
-        for attempt in (0, 1):
+        retriable = method in RETRIABLE_VERBS
+        self._count("requests")
+        attempt = 0
+        while True:
+            if not self.breaker.allow():
+                raise ApiError(
+                    "apiserver circuit breaker open (recent consecutive "
+                    "failures); request fast-failed", 503,
+                )
             try:
                 # Connect happens inside the retry loop: a transient
-                # refusal (apiserver restarting) gets the same one
+                # refusal (apiserver restarting) gets the same
                 # fresh-socket retry as a stale keep-alive.
                 conn = self._pooled(self.request_timeout)
                 conn.request(method, target, body=payload, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
-                break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._drop_pooled()
-                if attempt or not retriable:
+                self.breaker.record_failure()
+                if (
+                    not retriable
+                    or attempt + 1 >= self.retry_policy.max_attempts
+                    or not self.retry_budget.try_spend()
+                ):
                     raise
-        return self._check(resp.status, data, raw=raw)
+                self._count("retries")
+                self._retry_sleep(self.retry_policy.delay(attempt))
+                attempt += 1
+                continue
+            # The server answered: 5xx counts against the breaker (the
+            # apiserver itself is failing); anything else — including
+            # 429, which proves it is alive enough to shed load — is
+            # breaker success.
+            if resp.status >= 500:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+            if (
+                resp.status in RETRIABLE_STATUS
+                and retriable
+                and attempt + 1 < self.retry_policy.max_attempts
+                and self.retry_budget.try_spend()
+            ):
+                retry_after = parse_retry_after(
+                    resp.getheader("Retry-After")
+                )
+                self._count("retries")
+                self._retry_sleep(
+                    self.retry_policy.delay(attempt, retry_after)
+                )
+                attempt += 1
+                continue
+            return self._check(resp.status, data, raw=raw)
 
     @staticmethod
     def _check(status: int, data: bytes, raw: bool = False):

@@ -1,0 +1,212 @@
+"""Client-side resilience primitives: retry policy, retry budget,
+circuit breaker.
+
+The reference inherits all three from client-go: rest.Request retries
+with backoff and honors Retry-After, the shared rate limiter bounds
+total retry volume so a dying apiserver is not DDoS'd by its own
+controllers, and repeated connection failures trip a fast-fail path.
+This platform's ApiClient is its own, so the discipline lives here —
+small, clock-injectable classes that client.py composes in ``_request``
+and tests drive deterministically.
+
+Design notes:
+
+- ``RetryPolicy`` is pure arithmetic (capped exponential backoff with
+  multiplicative jitter; a server ``Retry-After`` overrides upward,
+  never downward past the server's ask).
+- ``RetryBudget`` is a token bucket shared by every request path in one
+  client, watch threads included. Per-request attempt caps bound one
+  call's latency; the budget bounds the client's aggregate retry
+  volume — the difference between "every request retries 3 times into
+  a blackout" and "the client collectively backs off".
+- ``CircuitBreaker`` is the classic closed → open → half-open machine:
+  consecutive failures open it, open fast-fails without touching the
+  socket, one probe is admitted after ``reset_timeout`` and its outcome
+  decides. State is surfaced on ``/metrics`` via
+  ``ClientResilienceCollector`` (controllers/metrics.py).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+# Verbs safe to retry: idempotent by HTTP semantics (a replayed PUT or
+# DELETE converges; a replayed merge-PATCH reapplies the same merge).
+# POST is never retried — a create that actually landed would duplicate
+# (or spuriously 409) on replay.
+RETRIABLE_VERBS = frozenset({"GET", "HEAD", "PUT", "DELETE", "PATCH"})
+
+# Transient status codes worth a retry on idempotent verbs. 409 is NOT
+# here: a Conflict means the caller's world-view is stale — only a
+# re-read fixes that, so it must propagate to the reconcile loop.
+RETRIABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+
+
+def parse_retry_after(value) -> float | None:
+    """``Retry-After`` header → seconds (numeric form only; HTTP-date
+    is legal but no apiserver emits it). None on absent/garbage."""
+    if value is None:
+        return None
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    if seconds < 0:
+        return None
+    return seconds
+
+
+class RetryPolicy:
+    """Capped exponential backoff with multiplicative jitter."""
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        base_delay: float = 0.05,
+        max_delay: float = 2.0,
+        jitter: float = 0.2,
+        retry_after_cap: float = 30.0,
+        rng: random.Random | None = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.retry_after_cap = retry_after_cap
+        # Injectable for deterministic tests (seeded Random); defaults
+        # to a private instance so concurrent clients don't share the
+        # global generator's lock.
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt: int, retry_after: float | None = None) -> float:
+        """Sleep before retry number ``attempt`` (0-based: the delay
+        between the first failure and the second try). A server
+        ``Retry-After`` is a floor — the server knows its own load —
+        but clamped at ``retry_after_cap``: the header is
+        server-controlled, and reconciles share worker threads, so one
+        hostile/buggy ``Retry-After: 3600`` must not park a controller
+        for an hour (client-go caps it at its max backoff the same
+        way)."""
+        base = min(self.base_delay * (2 ** attempt), self.max_delay)
+        jittered = base * (1.0 - self.jitter + 2.0 * self.jitter * self._rng.random())
+        if retry_after is not None:
+            return max(jittered, min(retry_after, self.retry_after_cap))
+        return jittered
+
+
+class RetryBudget:
+    """Token bucket bounding a client's aggregate retry volume.
+
+    Each retry (not each request) spends one token; tokens refill at
+    ``refill_per_s`` up to ``capacity``. Exhausted budget means the
+    original error propagates immediately — under a long apiserver
+    blackout the client converges to ~``refill_per_s`` retries/second
+    instead of multiplying every caller's attempts."""
+
+    def __init__(
+        self,
+        capacity: float = 10.0,
+        refill_per_s: float = 1.0,
+        clock=time.monotonic,
+    ):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._tokens = float(capacity)
+        self._last = clock()
+        self._lock = threading.Lock()
+        self.spent_total = 0
+        self.exhausted_total = 0
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.capacity,
+                self._tokens + (now - self._last) * self.refill_per_s,
+            )
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_total += 1
+                return True
+            self.exhausted_total += 1
+            return False
+
+
+class CircuitBreaker:
+    """closed → open after ``failure_threshold`` consecutive failures;
+    open fast-fails for ``reset_timeout`` seconds; then half-open admits
+    exactly one probe whose outcome closes or re-opens."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_timeout: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.opens_total = 0
+        self.fast_fail_total = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if (
+            self._state == self.OPEN
+            and self._clock() - self._opened_at >= self.reset_timeout
+        ):
+            self._state = self.HALF_OPEN
+            self._probing = False
+        return self._state
+
+    def allow(self) -> bool:
+        """May a request go out now? Half-open admits one in-flight
+        probe; its record_success/record_failure settles the state."""
+        with self._lock:
+            state = self._state_locked()
+            if state == self.CLOSED:
+                return True
+            if state == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            self.fast_fail_total += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            state = self._state_locked()
+            failed_probe = state == self.HALF_OPEN and self._probing
+            if failed_probe or (
+                state == self.CLOSED
+                and self._consecutive >= self.failure_threshold
+            ):
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probing = False
+                self.opens_total += 1

@@ -30,6 +30,49 @@ PODDEFAULT_API = "kubeflow.org/v1alpha1"
 PodDefaultLister = Callable[[str], list]
 
 
+class CachedPodDefaultLister:
+    """Last-known-good PodDefault lister with bounded staleness.
+
+    With ``failurePolicy: Fail``, a webhook that cannot list PodDefaults
+    turns every apiserver blip into a cluster-wide pod-creation outage.
+    This wrapper serves the most recent successful per-namespace list
+    when the live read raises, but only for ``max_stale_s`` — past that
+    the error propagates (reject rather than mutate from an arbitrarily
+    old world). Clock is injectable for deterministic tests."""
+
+    def __init__(self, inner: PodDefaultLister, max_stale_s: float = 120.0,
+                 clock=None):
+        import time as _time
+
+        self.inner = inner
+        self.max_stale_s = max_stale_s
+        self._clock = clock or _time.monotonic
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[float, list]] = {}  # ns -> (at, items)
+        self.stale_serves_total = 0
+
+    def __call__(self, namespace: str) -> list:
+        try:
+            items = self.inner(namespace)
+        except Exception as exc:
+            with self._lock:
+                entry = self._cache.get(namespace)
+                if entry is not None:
+                    at, items = entry
+                    if self._clock() - at <= self.max_stale_s:
+                        self.stale_serves_total += 1
+                        log.warning(
+                            "PodDefault list for %s failed (%s); serving "
+                            "cached list aged %.1fs",
+                            namespace, exc, self._clock() - at,
+                        )
+                        return items
+            raise
+        with self._lock:
+            self._cache[namespace] = (self._clock(), items)
+        return items
+
+
 class AdmissionHandler:
     def __init__(self, list_poddefaults: PodDefaultLister):
         self.list_poddefaults = list_poddefaults

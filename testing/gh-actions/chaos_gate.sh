@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos gate for CI: the seeded fault-injection suite must converge the
+# controllers to the fault-free desired state. The fast subset (every
+# deterministic schedule + a couple of kitchen-sink seeds) runs on every
+# PR inside tier-1; RUN_SLOW=1 adds the full seed matrix and the
+# process-tier outage scenarios marked `slow`.
+#
+# A failure prints the schedule's seed and fault windows
+# (FaultSchedule.describe()); re-running the named test reproduces the
+# exact fault sequence — chaos here is deterministic, never flaky-by-
+# design. See docs/operations.md "Failure modes & recovery".
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  exec python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+fi
+
+exec python -m pytest tests/test_chaos.py tests/test_resilience.py \
+  -q -m 'not slow'

@@ -1,0 +1,27 @@
+"""Seeded violations for py-retry-no-backoff: retry loops that hammer
+a failing dependency with no pacing between attempts."""
+
+
+def fetch_until_up(client):
+    # Violation 1: unbounded while-loop retry; the swallowing handler
+    # falls through to the next iteration with no pacing anywhere.
+    result = None
+    while result is None:
+        try:
+            result = client.fetch()
+        except ConnectionError:
+            pass
+    return result
+
+
+def create_with_attempts(api, obj):
+    # Violation 2: attempt-style for loop, swallowing handler, no
+    # backoff between the attempts.
+    last = None
+    for attempt in range(5):
+        try:
+            return api.create(obj)
+        except RuntimeError as exc:
+            last = exc
+            continue
+    raise last

@@ -1,0 +1,40 @@
+"""Clean counterparts for py-retry-no-backoff: retries that pace
+themselves, wait-loops that block on a timeout, and item-skip loops
+that are not retries at all."""
+
+import queue
+import time
+
+
+def fetch_with_backoff(client, policy):
+    # Retry with a computed delay between attempts: paced.
+    attempt = 0
+    while True:
+        try:
+            return client.fetch()
+        except ConnectionError:
+            time.sleep(policy.delay(attempt))
+            attempt += 1
+
+
+def drain_events(q, stop):
+    # The queue wait-loop idiom: get(timeout=...) blocks the thread,
+    # which IS the pacing.
+    while not stop.is_set():
+        try:
+            ev = q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        yield ev
+
+
+def parse_lines(lines):
+    # Item-skip for loop: continue advances to the NEXT item; there is
+    # nothing being retried here.
+    out = []
+    for line in lines:
+        try:
+            out.append(float(line))
+        except ValueError:
+            continue
+    return out

@@ -146,6 +146,15 @@ class TestSeededViolations:
         assert f.severity == Severity.WARNING
         assert f.path.endswith("silent_except.py")
 
+    def test_retry_without_backoff(self, bad_findings):
+        found = by_rule(bad_findings, "py-retry-no-backoff")
+        assert len(found) == 2
+        assert all(f.severity == Severity.WARNING for f in found)
+        assert all(f.path.endswith("hot_retry.py") for f in found)
+        reasons = " | ".join(f.message for f in found)
+        assert "continue in the except handler" in reasons
+        assert "swallowing except handler" in reasons
+
 
 class TestCleanFixtures:
     def test_clean_tree_is_silent(self):

@@ -27,13 +27,29 @@ import time
 
 import pytest
 
+from kubeflow_tpu.chaos import (
+    ChaosApiServer,
+    FaultSchedule,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+    run_to_convergence,
+)
+from kubeflow_tpu.chaos.harness import clamp_backoff
 from kubeflow_tpu.controllers.culling import (
     CullingOptions,
     http_kernel_probe,
     make_culling_controller,
 )
-from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.controllers.metrics import ControllerMetrics
+from kubeflow_tpu.controllers.notebook import (
+    OBSERVED_MESH_KEY,
+    PREEMPTION_RESTARTS_KEY,
+    RESTART_REASON_KEY,
+    make_notebook_controller,
+)
+from kubeflow_tpu.controllers.pvcviewer import make_pvcviewer_controller
 from kubeflow_tpu.controllers.runtime import Request
+from kubeflow_tpu.controllers.tensorboard import make_tensorboard_controller
 from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
 from kubeflow_tpu.k8s.fake import FakeApiServer
 from kubeflow_tpu.k8s.httpd import FakeApiHttpServer
@@ -726,3 +742,388 @@ class TestProcessTierCullCycle:
             kernel_srv.close()
             terminate(proc)
             server.close()
+
+
+# ---------------------------------------------------------------------------
+# Seeded fault schedules (kubeflow_tpu.chaos): the deterministic tier.
+# Every scenario runs the SAME world twice — once fault-free, once under a
+# seeded schedule — and asserts the converged desired state is identical.
+# ---------------------------------------------------------------------------
+
+TB_API = "tensorboard.kubeflow.org/v1alpha1"
+PVCVIEWER_API = "kubeflow.org/v1alpha1"
+
+# Desired state = the children the controllers emit. Notebook/Tensorboard/
+# PVCViewer CR *status* and Events legitimately differ under chaos (warning
+# mirrors, restart bookkeeping); the emitted workload must not.
+WORKLOAD_KINDS = (
+    ("apps/v1", "StatefulSet"),
+    ("apps/v1", "Deployment"),
+    ("v1", "Service"),
+    ("networking.istio.io/v1", "VirtualService"),
+)
+
+
+def chaos_notebook(name="nb", ns="user", tpu=None):
+    cr = {
+        "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "jupyter-jax-tpu"}]}}},
+    }
+    if tpu:
+        cr["spec"]["tpu"] = tpu
+    return cr
+
+
+def seed_world(api):
+    """A representative small platform: one CPU notebook, one multi-host
+    v5e-16 slice (4 workers), a tensorboard, a pvc viewer."""
+    api.create(chaos_notebook("plain"))
+    api.create(chaos_notebook(
+        "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+    ))
+    api.create({
+        "apiVersion": TB_API, "kind": "Tensorboard",
+        "metadata": {"name": "tb1", "namespace": "user"},
+        "spec": {"logspath": "pvc://workspace/logs"},
+    })
+    api.create({
+        "apiVersion": PVCVIEWER_API, "kind": "PVCViewer",
+        "metadata": {"name": "viewer", "namespace": "user"},
+        "spec": {"pvc": "workspace"},
+    })
+
+
+def build_controllers(api, prom=None):
+    ctrls = [
+        make_notebook_controller(api, prom=prom),
+        make_tensorboard_controller(api),
+        make_pvcviewer_controller(api),
+    ]
+    for ctrl in ctrls:
+        clamp_backoff(ctrl)
+    return ctrls
+
+
+def desired_snapshot(api):
+    """Normalised view of the emitted children: volatile metadata
+    (uid/resourceVersion/creationTimestamp) stripped, identity + spec +
+    labels kept. Pods compare by (name, node) — uids are per-incarnation
+    by design."""
+    snap = {}
+    for api_version, kind in WORKLOAD_KINDS:
+        for obj in api.list(api_version, kind):
+            meta = obj["metadata"]
+            snap[(kind, meta.get("namespace", ""), meta["name"])] = {
+                "labels": meta.get("labels") or {},
+                "spec": obj.get("spec"),
+            }
+    for pod in api.list("v1", "Pod"):
+        meta = pod["metadata"]
+        snap[("Pod", meta.get("namespace", ""), meta["name"])] = {
+            "node": (pod.get("spec") or {}).get("nodeName", ""),
+        }
+    return snap
+
+
+def converge_scenario(schedule=None, max_rounds=400):
+    """Run the standard world to convergence, optionally under a chaos
+    schedule. Returns (store_api, chaos_or_none, rounds)."""
+    fake = FakeApiServer()
+    api = ChaosApiServer(fake, schedule, sleep=lambda s: None) \
+        if schedule is not None else fake
+    seed_world(fake)  # fixtures arrive via the store, like kubectl would
+    ctrls = build_controllers(api)
+    sim = StatefulSetPodSimulator(fake)
+    rounds = run_to_convergence(ctrls, [sim], max_rounds=max_rounds)
+    return fake, (api if schedule is not None else None), rounds
+
+
+class TestSeededSchedules:
+    """Each canonical schedule must converge to the fault-free state."""
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        fake, _, rounds = converge_scenario(None)
+        snap = desired_snapshot(fake)
+        assert snap, "baseline produced no desired state"
+        return snap, rounds
+
+    def _assert_converges(self, schedule, baseline, fired_kinds,
+                          max_rounds=400):
+        snap0, _ = baseline
+        fake, chaos, rounds = converge_scenario(schedule, max_rounds)
+        assert desired_snapshot(fake) == snap0
+        fired = {k for k, v in chaos.injected.items() if v > 0}
+        for kind in fired_kinds:
+            assert kind in fired, (
+                f"schedule never injected {kind!r} "
+                f"({schedule.describe()}: {chaos.injected})"
+            )
+        return rounds
+
+    def test_conflict_storm_converges(self, baseline):
+        rounds = self._assert_converges(
+            FaultSchedule(seed=11).conflict_storm(0, 150, rate=0.5),
+            baseline, {"conflict"},
+        )
+        assert rounds <= 200
+
+    def test_transient_5xx_and_429_converge(self, baseline):
+        self._assert_converges(
+            FaultSchedule(seed=23)
+            .errors(0, 80, rate=0.3, status=503)
+            .errors(80, 140, rate=0.3, status=429, retry_after=0.0)
+            .latency_spikes(0, 140, rate=0.2, latency_s=0.0),
+            baseline, {"error"},
+        )
+
+    def test_not_found_flaps_converge(self, baseline):
+        self._assert_converges(
+            FaultSchedule(seed=31).not_found_flaps(0, 120, rate=0.25),
+            baseline, {"not_found"},
+        )
+
+    def test_apiserver_blackout_converges(self, baseline):
+        rounds = self._assert_converges(
+            FaultSchedule(seed=41).blackout(5, 120),
+            baseline, {"blackout"},
+        )
+        assert rounds <= 200
+
+    def test_watch_compaction_and_damage_converge(self, baseline):
+        self._assert_converges(
+            FaultSchedule(seed=53).watch_faults(
+                drop=0.2, dup=0.15, reorder=0.15, compact=0.1,
+                max_compactions=2,
+            ),
+            baseline, {"watch_dropped"},
+        )
+
+    def test_schedules_are_deterministic(self):
+        """Same seed → byte-identical fault decisions (the replay
+        contract every convergence assertion rests on)."""
+        def trace(seed):
+            sched = FaultSchedule(seed=seed).conflict_storm(
+                0, 50, rate=0.5
+            ).errors(20, 60, rate=0.3).watch_faults(drop=0.3, dup=0.2)
+            ops = [
+                sched.fault_for(i, "update", "StatefulSet")
+                for i in range(60)
+            ]
+            watch = [sched.next_watch_action() for _ in range(40)]
+            return ops, watch
+
+        assert trace(7) == trace(7)
+        assert trace(7) != trace(8)
+
+
+class TestKitchenSinkMatrix:
+    """Everything at once, across a seed matrix. A couple of seeds run
+    in tier-1; the full matrix is the slow chaos gate."""
+
+    def _kitchen_sink(self, seed):
+        return (
+            FaultSchedule(seed=seed)
+            .conflict_storm(0, 120, rate=0.35)
+            .errors(0, 120, rate=0.15, status=503)
+            .errors(40, 100, rate=0.15, status=429, retry_after=0.0)
+            .not_found_flaps(0, 120, rate=0.1)
+            .blackout(130, 170)
+            .watch_faults(drop=0.1, dup=0.1, reorder=0.1, compact=0.05,
+                          max_compactions=1)
+        )
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        fake, _, _ = converge_scenario(None)
+        return desired_snapshot(fake)
+
+    @pytest.mark.parametrize("seed", [3, 17])
+    def test_fast_seeds(self, baseline, seed):
+        fake, chaos, rounds = converge_scenario(
+            self._kitchen_sink(seed), max_rounds=500
+        )
+        assert desired_snapshot(fake) == baseline
+        assert sum(chaos.injected.values()) > 0
+        assert rounds <= 300
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", list(range(100, 112)))
+    def test_full_matrix(self, baseline, seed):
+        fake, chaos, rounds = converge_scenario(
+            self._kitchen_sink(seed), max_rounds=500
+        )
+        assert desired_snapshot(fake) == baseline
+        assert sum(chaos.injected.values()) > 0
+
+
+class TestTpuPreemptionRecovery:
+    """GKE preempting a TPU worker of a 4-host v5e-16 slice: the
+    notebook controller must restart the WHOLE pod set (jax.distributed
+    cannot survive a partial mesh), surface Restarting, and recover."""
+
+    def _setup(self, prom=None):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api, prom=prom)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(api)
+        api.create(chaos_notebook(
+            "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+        ))
+        run_to_convergence([ctrl], [sim])
+        return api, ctrl, sim
+
+    def _pod_uids(self, api):
+        return {
+            p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in api.list("v1", "Pod", namespace="user",
+                              label_selector="notebook-name=mesh")
+        }
+
+    @pytest.mark.parametrize("ordinal", [0, 1, 2, 3])
+    def test_any_worker_preemption_restarts_full_slice(self, ordinal):
+        prom = ControllerMetrics()
+        api, ctrl, sim = self._setup(prom=prom)
+        before = self._pod_uids(api)
+        assert len(before) == 4
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        assert OBSERVED_MESH_KEY in nb_obj["metadata"]["annotations"]
+
+        injector = PreemptionInjector(api)
+        node = injector.preempt_worker("user", "mesh", ordinal)
+        assert node == f"tpu-node-mesh-{ordinal}"
+        taints = api.get("v1", "Node", node)["spec"]["taints"]
+        assert any(
+            t["key"] == "cloud.google.com/impending-node-termination"
+            for t in taints
+        )
+
+        rounds = run_to_convergence([ctrl], [sim])
+        assert rounds <= 100
+
+        after = self._pod_uids(api)
+        assert set(after) == set(before)
+        # Coherent full restart, never a partial mesh: every worker —
+        # including the three survivors — is a fresh incarnation.
+        assert not set(before.values()) & set(after.values())
+
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        anns = nb_obj["metadata"]["annotations"]
+        assert anns.get(PREEMPTION_RESTARTS_KEY) == "1"
+        assert RESTART_REASON_KEY not in anns
+        assert nb_obj["status"].get("phase") != "Restarting"
+        reasons = {e["reason"] for e in api.list("v1", "Event",
+                                                 namespace="user")}
+        assert "TPUWorkerPreempted" in reasons
+        assert "SliceRestarted" in reasons
+        metric = prom.notebook_preemption_restart_total.labels("user")
+        assert metric._value.get() == 1
+
+    def test_restarting_status_visible_mid_recovery(self):
+        api, ctrl, sim = self._setup()
+        injector = PreemptionInjector(api)
+        injector.preempt_worker("user", "mesh", 2)
+        # Controller reacts BEFORE the statefulset controller recreates
+        # anything: survivors must be recycled in the same pass.
+        ctrl.run_once()
+        left = api.list("v1", "Pod", namespace="user",
+                        label_selector="notebook-name=mesh")
+        assert left == [], "survivors left running against a dead peer"
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        assert nb_obj["status"]["phase"] == "Restarting"
+        assert "mesh-2" in nb_obj["status"]["restartReason"]
+        # ...and the marker clears once the slice re-forms.
+        run_to_convergence([ctrl], [sim])
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        assert nb_obj["status"].get("phase") != "Restarting"
+
+    def test_scale_down_then_up_is_not_preemption(self):
+        """Replica-count changes are user actions, not cluster weather:
+        scaling a 4-worker slice to 2 and back must not read as a
+        preemption — survivors keep their identity, no Warning event,
+        no restart counter, and the observed-mesh baseline follows the
+        new shape."""
+        import json as _json
+
+        prom = ControllerMetrics()
+        api, ctrl, sim = self._setup(prom=prom)
+        before = self._pod_uids(api)
+        api.patch_merge(NOTEBOOK_API, "Notebook", "mesh",
+                        {"spec": {"tpu": {"topology": "2x4"}}}, "user")
+        run_to_convergence([ctrl], [sim])
+        assert set(self._pod_uids(api)) == {"mesh-0"}  # 8 chips: 1 host
+        anns = api.get(NOTEBOOK_API, "Notebook", "mesh",
+                       "user")["metadata"]["annotations"]
+        assert OBSERVED_MESH_KEY not in anns  # baseline dropped
+        api.patch_merge(NOTEBOOK_API, "Notebook", "mesh",
+                        {"spec": {"tpu": {"topology": "4x4"}}}, "user")
+        run_to_convergence([ctrl], [sim])
+        after = self._pod_uids(api)
+        assert set(after) == set(before)
+        # The surviving worker was never recycled.
+        assert after["mesh-0"] == before["mesh-0"]
+        reasons = {e["reason"] for e in api.list("v1", "Event",
+                                                 namespace="user")}
+        assert "TPUWorkerPreempted" not in reasons
+        assert metric_value(prom, "user") == 0
+        anns = api.get(NOTEBOOK_API, "Notebook", "mesh",
+                       "user")["metadata"]["annotations"]
+        baseline = _json.loads(anns[OBSERVED_MESH_KEY])
+        assert baseline == after  # pruned on the way down, grown back up
+
+    def test_single_host_preemption_is_not_gang_restarted(self):
+        api = FakeApiServer()
+        prom = ControllerMetrics()
+        ctrl = make_notebook_controller(api, prom=prom)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(api)
+        api.create(chaos_notebook("solo"))
+        run_to_convergence([ctrl], [sim])
+        PreemptionInjector(api).preempt_pod("user", "solo-0")
+        run_to_convergence([ctrl], [sim])
+        # The pod is back (statefulset controller), no restart counted.
+        api.get("v1", "Pod", "solo-0", "user")
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "solo", "user")
+        anns = nb_obj["metadata"].get("annotations") or {}
+        assert PREEMPTION_RESTARTS_KEY not in anns
+        assert metric_value(prom, "user") == 0
+
+    def test_preemption_under_chaos_still_coherent(self):
+        """Preemption DURING apiserver weather: recovery must still be
+        all-or-nothing once the dust settles."""
+        fake = FakeApiServer()
+        schedule = (
+            FaultSchedule(seed=97)
+            .conflict_storm(0, 80, rate=0.3)
+            .errors(0, 80, rate=0.2, status=503)
+        )
+        api = ChaosApiServer(fake, schedule, sleep=lambda s: None)
+        ctrl = make_notebook_controller(api)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(fake)
+        fake.create(chaos_notebook(
+            "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+        ))
+        run_to_convergence([ctrl], [sim])
+        before = {
+            p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in fake.list("v1", "Pod", namespace="user")
+        }
+        PreemptionInjector(fake).preempt_worker("user", "mesh", 1)
+        run_to_convergence([ctrl], [sim], max_rounds=500)
+        after = {
+            p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in fake.list("v1", "Pod", namespace="user")
+        }
+        assert set(after) == set(before)
+        assert not set(before.values()) & set(after.values())
+        nb_obj = fake.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        assert RESTART_REASON_KEY not in nb_obj["metadata"]["annotations"]
+
+
+def metric_value(prom, namespace):
+    return prom.notebook_preemption_restart_total.labels(
+        namespace
+    )._value.get()

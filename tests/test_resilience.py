@@ -1,0 +1,695 @@
+"""Direct unit tests for the control-plane resilience layer.
+
+The chaos suite (tests/test_chaos.py) proves the pieces compose under
+seeded fault schedules; THIS file pins each piece's own contract so a
+regression is attributed to a component, not to "chaos got flaky":
+
+- WorkQueue dedup / earliest-wins / backoff / forget semantics — the
+  rate-limiter discipline every controller leans on;
+- k8s.retry primitives (RetryPolicy arithmetic, RetryBudget token
+  bucket, CircuitBreaker state machine);
+- ApiClient._request retry discipline over a scripted live HTTP server
+  (idempotent-only retries, Retry-After honored, budget charged,
+  breaker fast-fail);
+- the client watch 410-Gone → re-list path over a real socket, with a
+  genuine server restart and a compacted event horizon;
+- the Controller stuck-reconcile watchdog (Degraded condition, Events,
+  counters) and the webhook's bounded-staleness PodDefault lister.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    WorkQueue,
+)
+from kubeflow_tpu.k8s.client import ApiClient, KubeConfig
+from kubeflow_tpu.k8s.core import ApiError, Conflict
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.k8s.httpd import FakeApiHttpServer
+from kubeflow_tpu.k8s.retry import (
+    CircuitBreaker,
+    RetryBudget,
+    RetryPolicy,
+    parse_retry_after,
+)
+from kubeflow_tpu.webhook.server import CachedPodDefaultLister
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# WorkQueue semantics
+# ---------------------------------------------------------------------------
+
+
+class TestWorkQueue:
+    R1 = Request("ns", "a")
+    R2 = Request("ns", "b")
+
+    def patch_clock(self, monkeypatch, clock):
+        import kubeflow_tpu.controllers.runtime as runtime
+
+        monkeypatch.setattr(runtime.time, "monotonic", clock)
+
+    def test_dedup_one_pop_per_key(self):
+        q = WorkQueue()
+        q.add(self.R1)
+        q.add(self.R1)
+        q.add(self.R1)
+        assert len(q) == 1
+        assert q.pop_ready() == self.R1
+        assert q.pop_ready() is None
+
+    def test_add_keeps_earliest_not_before(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+        q.add(self.R1, delay=10.0)
+        assert q.pop_ready() is None
+        q.add(self.R1)  # due now: must win over the parked duplicate
+        assert q.pop_ready() == self.R1
+        assert len(q) == 0
+
+    def test_rate_limited_readd_does_not_push_back_due_item(
+        self, monkeypatch
+    ):
+        """The PR-2 satellite fix: a rate-limited re-add racing a
+        watch-driven add must keep the earliest deadline, not reset an
+        already-due item behind its own backoff."""
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue(base_delay=5.0)
+        q.add(self.R1)  # due immediately
+        q.add_rate_limited(self.R1)  # backoff says now+5 — must NOT win
+        assert q.pop_ready() == self.R1
+
+    def test_backoff_grows_exponentially_and_caps(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue(base_delay=1.0, max_delay=4.0)
+        delays = []
+        for _ in range(4):
+            q.add_rate_limited(self.R1)
+            delays.append(q.next_deadline() - clock())
+            clock.advance(100.0)  # item becomes due; drain it
+            assert q.pop_ready() == self.R1
+        assert delays == [1.0, 2.0, 4.0, 4.0]  # 2^n capped at max
+
+    def test_forget_resets_backoff_history(self, monkeypatch):
+        """forget is the rate-limiter reset (controller-runtime's
+        Forget): it erases the failure streak so the NEXT failure backs
+        off from base again — it does not unqueue a pending item."""
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue(base_delay=1.0, max_delay=60.0)
+        for _ in range(3):
+            q.add_rate_limited(self.R1)
+            clock.advance(100.0)
+            q.pop_ready()
+        q.add_rate_limited(self.R1)
+        assert q.next_deadline() - clock() == 8.0
+        clock.advance(100.0)
+        assert q.pop_ready() == self.R1
+        q.forget(self.R1)
+        q.add_rate_limited(self.R1)  # failure history erased: from base
+        assert q.next_deadline() - clock() == 1.0
+
+    def test_pop_orders_by_deadline(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+        q.add(self.R1, delay=2.0)
+        q.add(self.R2, delay=1.0)
+        assert q.pop_ready() is None  # nothing due yet
+        clock.advance(3.0)
+        assert q.pop_ready() == self.R2
+        assert q.pop_ready() == self.R1
+
+    def test_superseded_heap_entries_are_skipped(self, monkeypatch):
+        """Stale heap entries (earlier re-adds) must neither duplicate
+        pops nor wedge the queue."""
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+        q.add(self.R1, delay=5.0)
+        q.add(self.R1, delay=1.0)
+        q.add(self.R1)  # three heap entries, one pending key
+        assert q.pop_ready() == self.R1
+        assert q.pop_ready() is None
+        clock.advance(10.0)  # the stale entries' deadlines pass
+        assert q.pop_ready() is None
+        q.add(self.R1)
+        assert q.pop_ready() == self.R1
+
+
+# ---------------------------------------------------------------------------
+# k8s.retry primitives
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_exponential_growth_capped_with_jitter_bounds(self):
+        import random
+
+        policy = RetryPolicy(base_delay=0.1, max_delay=0.8, jitter=0.2,
+                             rng=random.Random(7))
+        for attempt, base in enumerate([0.1, 0.2, 0.4, 0.8, 0.8]):
+            d = policy.delay(attempt)
+            assert base * 0.8 <= d <= base * 1.2
+
+    def test_retry_after_is_a_floor(self):
+        import random
+
+        policy = RetryPolicy(base_delay=0.01, jitter=0.0,
+                             rng=random.Random(0))
+        assert policy.delay(0, retry_after=3.0) == 3.0
+        # ...but never drags a LARGER computed delay down.
+        assert policy.delay(9, retry_after=0.001) == policy.delay(9)
+
+    def test_retry_after_is_clamped(self):
+        """The header is server-controlled; an hour-long Retry-After
+        must not park a shared reconcile thread for an hour."""
+        import random
+
+        policy = RetryPolicy(base_delay=0.01, jitter=0.0,
+                             retry_after_cap=30.0, rng=random.Random(0))
+        assert policy.delay(0, retry_after=3600.0) == 30.0
+
+    def test_parse_retry_after(self):
+        assert parse_retry_after("2") == 2.0
+        assert parse_retry_after("0.5") == 0.5
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("Wed, 21 Oct 2026") is None
+        assert parse_retry_after("-3") is None
+
+
+class TestRetryBudget:
+    def test_spend_refill_exhaust(self):
+        clock = FakeClock()
+        budget = RetryBudget(capacity=2, refill_per_s=1.0, clock=clock)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()  # dry
+        assert budget.exhausted_total == 1
+        clock.advance(1.0)
+        assert budget.try_spend()  # one token refilled
+        assert not budget.try_spend()
+        assert budget.spent_total == 3
+
+    def test_refill_caps_at_capacity(self):
+        clock = FakeClock()
+        budget = RetryBudget(capacity=2, refill_per_s=1.0, clock=clock)
+        clock.advance(3600.0)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_fast_fails(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0,
+                           clock=clock)
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == CircuitBreaker.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert not b.allow() and not b.allow()
+        assert b.fast_fail_total == 2 and b.opens_total == 1
+
+    def test_half_open_admits_one_probe_success_closes(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=10.0,
+                           clock=clock)
+        b.record_failure()
+        clock.advance(10.0)
+        assert b.state == CircuitBreaker.HALF_OPEN
+        assert b.allow()      # the single probe
+        assert not b.allow()  # a second concurrent request is rejected
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED and b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=10.0,
+                           clock=clock)
+        b.record_failure()
+        clock.advance(10.0)
+        assert b.allow()
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert b.opens_total == 2
+
+
+# ---------------------------------------------------------------------------
+# ApiClient._request retry discipline (scripted live HTTP server)
+# ---------------------------------------------------------------------------
+
+
+class ScriptedServer:
+    """Serves a script of (status, headers, body) responses in order;
+    after the script runs out, answers 200 {}. Records every request as
+    (method, path)."""
+
+    def __init__(self):
+        self.script: list[tuple[int, dict, bytes]] = []
+        self.requests: list[tuple[str, str]] = []
+        srv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+                srv.requests.append((self.command, self.path))
+                status, headers, body = (
+                    srv.script.pop(0) if srv.script else (200, {}, b"{}")
+                )
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _serve
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def status_body(message: str) -> bytes:
+    return json.dumps({"kind": "Status", "message": message}).encode()
+
+
+@pytest.fixture()
+def scripted():
+    srv = ScriptedServer()
+    yield srv
+    srv.close()
+
+
+def make_client(scripted, **kwargs) -> tuple[ApiClient, list]:
+    """Client against the scripted server with recorded (not slept)
+    retry delays and test-friendly resilience defaults."""
+    client = ApiClient(KubeConfig(host=scripted.url), **kwargs)
+    slept: list[float] = []
+    client._retry_sleep = slept.append
+    return client, slept
+
+
+class TestClientRetryDiscipline:
+    def test_get_retries_transient_503_then_succeeds(self, scripted):
+        scripted.script = [
+            (503, {}, status_body("apiserver restarting")),
+            (503, {}, status_body("apiserver restarting")),
+        ]
+        client, slept = make_client(scripted)
+        assert client.list("v1", "Namespace") == []
+        assert len(slept) == 2
+        assert client.request_metrics["retries"] == 2
+        assert len(scripted.requests) == 3
+
+    def test_retry_delays_grow(self, scripted):
+        scripted.script = [(503, {}, b"")] * 3
+        client, slept = make_client(
+            scripted,
+            retry_policy=RetryPolicy(max_attempts=4, base_delay=0.1,
+                                     jitter=0.0),
+        )
+        client.list("v1", "Namespace")
+        assert slept == [0.1, 0.2, 0.4]
+
+    def test_post_is_never_retried(self, scripted):
+        scripted.script = [(503, {}, status_body("hiccup"))]
+        client, slept = make_client(scripted)
+        with pytest.raises(ApiError):
+            client.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "x", "namespace": "default"},
+            })
+        assert slept == []
+        assert len(scripted.requests) == 1  # one attempt, no replay
+
+    def test_conflict_is_never_retried(self, scripted):
+        """409 means the caller's world-view is stale; only the
+        reconcile loop's re-read fixes that."""
+        scripted.script = [(409, {}, status_body("stale"))]
+        client, slept = make_client(scripted)
+        with pytest.raises(Conflict):
+            client.patch_merge("v1", "ConfigMap", "x", {}, "default")
+        assert slept == []
+        assert len(scripted.requests) == 1
+
+    def test_429_honors_retry_after(self, scripted):
+        scripted.script = [
+            (429, {"Retry-After": "1.5"}, status_body("slow down")),
+        ]
+        client, slept = make_client(
+            scripted,
+            retry_policy=RetryPolicy(base_delay=0.001, jitter=0.0),
+        )
+        client.list("v1", "Namespace")
+        assert slept == [1.5]  # the server's ask floors the backoff
+
+    def test_exhausted_budget_stops_retries(self, scripted):
+        scripted.script = [(503, {}, b"")] * 4
+        budget = RetryBudget(capacity=1, refill_per_s=0.0)
+        client, slept = make_client(scripted, retry_budget=budget)
+        with pytest.raises(ApiError) as err:
+            client.list("v1", "Namespace")
+        assert err.value.code == 503
+        assert len(slept) == 1  # one retry granted, then the budget dry
+        assert budget.exhausted_total == 1
+
+    def test_breaker_opens_on_consecutive_5xx_then_recovers(
+        self, scripted
+    ):
+        clock = FakeClock()
+        breaker = CircuitBreaker(failure_threshold=2, reset_timeout=5.0,
+                                 clock=clock)
+        scripted.script = [(503, {}, b"")] * 2
+        client, _ = make_client(
+            scripted,
+            retry_policy=RetryPolicy(max_attempts=1),
+            breaker=breaker,
+        )
+        for _ in range(2):
+            with pytest.raises(ApiError):
+                client.list("v1", "Namespace")
+        assert breaker.state == CircuitBreaker.OPEN
+        hits = len(scripted.requests)
+        with pytest.raises(ApiError) as err:
+            client.list("v1", "Namespace")
+        assert "circuit breaker" in str(err.value)
+        assert len(scripted.requests) == hits  # fast-fail: no socket
+        clock.advance(5.0)  # half-open: the probe goes through (200)
+        assert client.list("v1", "Namespace") == []
+        assert breaker.state == CircuitBreaker.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# watch 410-Gone → re-list over a real socket
+# ---------------------------------------------------------------------------
+
+
+class TestWatch410Relist:
+    def drain(self, q, want, timeout=30.0):
+        """Pull events until every (type, name) in ``want`` was seen."""
+        seen = []
+        deadline = time.monotonic() + timeout
+        import queue as queue_mod
+        while want - set(seen) and time.monotonic() < deadline:
+            try:
+                ev = q.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            seen.append((ev.type, ev.object["metadata"]["name"]))
+        assert not (want - set(seen)), (
+            f"missing {want - set(seen)} (saw {seen[-10:]})"
+        )
+        return seen
+
+    def nb(self, name):
+        return {
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "alice"},
+            "spec": {},
+        }
+
+    def test_server_restart_with_compacted_history_relists(self):
+        """Kill the apiserver under a live watch, age the event horizon
+        out while it is down, restart it on the same port: the resume
+        rv answers 410 Gone and the client must re-list, re-emitting
+        the full current world as ADDED (level-based catch-up), then
+        keep streaming."""
+        server = FakeApiHttpServer().start()
+        fake = server.fake
+        port = int(server.url.rsplit(":", 1)[1])
+        client = ApiClient(KubeConfig(host=server.url))
+        try:
+            q = client.watch(NOTEBOOK_API, "Notebook")
+            fake.create(self.nb("first"))
+            self.drain(q, {("ADDED", "first")})
+
+            server.close()  # watch socket dies; store (etcd role) lives
+            flood = fake._event_log.maxlen + 50
+            for i in range(flood):
+                fake.create({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": f"noise-{i}",
+                                 "namespace": "default"},
+                })
+            fake.create(self.nb("second"))
+
+            server = FakeApiHttpServer(fake=fake, port=port).start()
+            # Both notebooks arrive as ADDED via the post-410 re-list —
+            # "first" a second time, proving level (not edge) recovery.
+            self.drain(q, {("ADDED", "first"), ("ADDED", "second")})
+            # And the stream is live again, not just the one re-list.
+            fake.create(self.nb("third"))
+            self.drain(q, {("ADDED", "third")})
+        finally:
+            client.close()
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# stuck-reconcile watchdog
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedReconciler:
+    """Fails while ``failures_left`` > 0, then succeeds; optionally
+    burns ``burn_s`` of (fake) clock per reconcile."""
+
+    def __init__(self, clock=None, burn_s=0.0):
+        self.failures_left = 0
+        self.clock = clock
+        self.burn_s = burn_s
+        self.calls = 0
+
+    def reconcile(self, req):
+        self.calls += 1
+        if self.clock is not None and self.burn_s:
+            self.clock.advance(self.burn_s)
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            raise RuntimeError("injected reconcile failure")
+        return None
+
+
+class TestStuckReconcileWatchdog:
+    def make(self, clock=None, **kwargs):
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": "wedged", "namespace": "user"},
+            "spec": {},
+        })
+        rec = _ScriptedReconciler(clock=clock)
+        ctrl = Controller(
+            name="watchdog-test", api=api, reconciler=rec,
+            watches=[WatchSpec(NOTEBOOK_API, "Notebook")],
+            clock=clock or time.monotonic,
+            **kwargs,
+        )
+        ctrl.queue._base = 0.0  # retries immediately due (unit test)
+        return api, ctrl, rec
+
+    def spin(self, ctrl, rounds=40):
+        for _ in range(rounds):
+            ctrl.run_once()
+
+    def conditions(self, api):
+        obj = api.get(NOTEBOOK_API, "Notebook", "wedged", "user")
+        return {
+            c["type"]: c for c in
+            (obj.get("status") or {}).get("conditions") or []
+        }
+
+    def reasons(self, api):
+        return {e.get("reason") for e in
+                api.list("v1", "Event", namespace="user")}
+
+    def test_failure_streak_marks_degraded_then_recovers(self):
+        api, ctrl, rec = self.make(stuck_threshold=3)
+        rec.failures_left = 5
+        self.spin(ctrl)
+        assert rec.calls >= 6
+        assert ctrl.metrics["stuck"] == 1
+        # Recovery already happened within the spin (failures ran out):
+        # the Degraded condition must be gone again and both the stuck
+        # and the recovered markers recorded as Events.
+        assert "Degraded" not in self.conditions(api)
+        assert {"ReconcileStuck", "ReconcileRecovered"} <= \
+            self.reasons(api)
+
+    def test_degraded_condition_visible_while_stuck(self):
+        api, ctrl, rec = self.make(stuck_threshold=3)
+        rec.failures_left = 10 ** 9  # never heals during this test
+        self.spin(ctrl, rounds=6)
+        cond = self.conditions(api)["Degraded"]
+        assert cond["status"] == "True"
+        assert cond["reason"] == "ReconcileStuck"
+        assert "consecutive times" in cond["message"]
+        assert ctrl.metrics["stuck"] == 1  # marked once, not per retry
+
+    def test_below_threshold_is_not_degraded(self):
+        api, ctrl, rec = self.make(stuck_threshold=5)
+        rec.failures_left = 3
+        self.spin(ctrl)
+        assert ctrl.metrics["stuck"] == 0
+        assert "Degraded" not in self.conditions(api)
+        assert "ReconcileStuck" not in self.reasons(api)
+
+    def test_watchless_controller_survives_the_watchdog(self):
+        """A Controller with watches=[] (supported by resync and
+        _primary_object) must not crash when the failure streak crosses
+        the threshold — there is simply no CR to mark."""
+        api = FakeApiServer()
+        rec = _ScriptedReconciler()
+        rec.failures_left = 5
+        ctrl = Controller(name="watchless", api=api, reconciler=rec,
+                          watches=[], stuck_threshold=2)
+        ctrl.queue._base = 0.0
+        ctrl.queue.add(Request("user", "wedged"))
+        for _ in range(10):
+            ctrl.run_once()
+        assert ctrl.metrics["stuck"] == 1  # marked, without a CR, no crash
+        assert rec.failures_left == 0  # retries kept flowing
+
+    def test_inherited_degraded_mark_cleared_after_restart(self):
+        """The failure streak lives only in memory; a controller
+        restarted mid-degradation must still clear the Degraded
+        condition on its first success (resync rebuilds the in-memory
+        set from observed CR state)."""
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": "wedged", "namespace": "user"},
+            "spec": {},
+            "status": {"conditions": [{
+                "type": "Degraded", "status": "True",
+                "reason": "ReconcileStuck",
+                "message": "left behind by a previous incarnation",
+            }]},
+        })
+        ctrl = Controller(
+            name="watchdog-test", api=api,
+            reconciler=_ScriptedReconciler(),  # healthy from the start
+            watches=[WatchSpec(NOTEBOOK_API, "Notebook")],
+        )
+        ctrl.resync()
+        ctrl.run_once()
+        obj = api.get(NOTEBOOK_API, "Notebook", "wedged", "user")
+        conds = (obj.get("status") or {}).get("conditions") or []
+        assert not any(c["type"] == "Degraded" for c in conds)
+        assert "ReconcileRecovered" in {
+            e.get("reason")
+            for e in api.list("v1", "Event", namespace="user")
+        }
+
+    def test_reconcile_deadline_exceeded_is_surfaced(self):
+        clock = FakeClock()
+        api, ctrl, rec = self.make(
+            clock=clock, reconcile_deadline=1.0, stuck_threshold=10 ** 6,
+        )
+        rec.burn_s = 5.0  # every reconcile blows the 1s deadline
+        ctrl.run_once()
+        assert ctrl.metrics["deadline_exceeded"] == 1
+        assert "ReconcileDeadlineExceeded" in self.reasons(api)
+        # A successful-but-slow reconcile is NOT an error or a streak.
+        assert ctrl.metrics["errors"] == 0
+        assert "Degraded" not in self.conditions(api)
+
+
+# ---------------------------------------------------------------------------
+# webhook lister resilience
+# ---------------------------------------------------------------------------
+
+
+class TestCachedPodDefaultLister:
+    def test_serves_last_known_good_within_staleness_bound(self):
+        clock = FakeClock()
+        world = {"fail": False, "items": [{"metadata": {"name": "pd1"}}]}
+
+        def inner(namespace):
+            if world["fail"]:
+                raise ApiError("apiserver down", 503)
+            return list(world["items"])
+
+        lister = CachedPodDefaultLister(inner, max_stale_s=60.0,
+                                        clock=clock)
+        assert lister("user") == [{"metadata": {"name": "pd1"}}]
+        world["fail"] = True
+        clock.advance(30.0)  # inside the bound: stale serve
+        assert lister("user") == [{"metadata": {"name": "pd1"}}]
+        assert lister.stale_serves_total == 1
+        clock.advance(31.0)  # past the bound: reject rather than guess
+        with pytest.raises(ApiError):
+            lister("user")
+
+    def test_success_refreshes_cache_and_age(self):
+        clock = FakeClock()
+        calls = {"n": 0}
+
+        def inner(namespace):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise ApiError("blip", 503)
+            return [{"metadata": {"name": f"pd{calls['n']}"}}]
+
+        lister = CachedPodDefaultLister(inner, max_stale_s=10.0,
+                                        clock=clock)
+        assert lister("a")[0]["metadata"]["name"] == "pd1"
+        clock.advance(5.0)
+        assert lister("a")[0]["metadata"]["name"] == "pd1"  # stale serve
+        assert lister("a")[0]["metadata"]["name"] == "pd3"  # live again
+
+    def test_namespaces_are_cached_independently(self):
+        clock = FakeClock()
+
+        def inner(namespace):
+            if namespace == "b":
+                raise ApiError("down", 503)
+            return [{"metadata": {"name": "pd-a"}}]
+
+        lister = CachedPodDefaultLister(inner, clock=clock)
+        assert lister("a")
+        with pytest.raises(ApiError):
+            lister("b")  # never seen a good list for b: must propagate
