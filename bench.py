@@ -43,26 +43,49 @@ import numpy as np
 
 
 def device_peak_flops(device) -> float:
-    """bf16 peak FLOP/s for the benched chip (fallback: v5e)."""
-    kind = getattr(device, "device_kind", "").lower()
-    table = {
-        "v4": 275e12,
-        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v6 lite": 918e12, "v6e": 918e12,
-    }
-    for key, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return val
-    return 197e12
+    """bf16 peak FLOP/s for the benched chip, from the per-topology
+    tables in kubeflow_tpu.topology (single source of truth shared
+    with obs.StepTelemetry; fallback: v5e)."""
+    from kubeflow_tpu.topology import peak_flops_for_device_kind
+
+    return peak_flops_for_device_kind(
+        getattr(device, "device_kind", ""), default=197e12
+    )
 
 
-def run_timed(step, state, batch_data, warmup: int, steps: int):
+def make_step_telemetry(flops_per_example: float):
+    """The bench's StepTelemetry hook, opt-in via KFT_BENCH_TELEMETRY=1
+    (per-step host syncs would perturb headline numbers, so the meter
+    is off unless asked for). JSONL lands at OBS_JSONL_PATH or
+    testing/step_telemetry.jsonl."""
+    if os.environ.get("KFT_BENCH_TELEMETRY", "").lower() not in (
+        "1", "true", "yes"
+    ):
+        return None
+    from kubeflow_tpu.obs import StepTelemetry
+
+    device = jax.devices()[0]
+    return StepTelemetry(
+        flops_per_example=flops_per_example,
+        peak_flops=device_peak_flops(device),
+        device_kind=str(getattr(device, "device_kind", "")),
+        jsonl_path=os.environ.get("OBS_JSONL_PATH")
+        or "testing/step_telemetry.jsonl",
+    )
+
+
+def run_timed(step, state, batch_data, warmup: int, steps: int,
+              telemetry=None):
     """Shared measurement harness. Sync via host fetch, not
     block_until_ready: on the axon remote-TPU relay block_until_ready
     returns before execution finishes (measured 1.6ms/step "throughput"
     = 19x chip peak, physically impossible), while device_get forces the
-    full dependency chain to materialise. Returns (state, seconds)."""
+    full dependency chain to materialise. Returns (state, seconds).
+
+    With ``telemetry`` (obs.StepTelemetry), every timed step is synced
+    and recorded individually — step_time, examples/sec, MFU — and the
+    returned wall time is the sum of per-step times (the per-step syncs
+    would otherwise pollute the aggregate with dispatch stalls)."""
     if steps <= 0:
         raise SystemExit("KFT_BENCH_STEPS must be >= 1")
     metrics = None
@@ -70,6 +93,19 @@ def run_timed(step, state, batch_data, warmup: int, steps: int):
         state, metrics = step(state, batch_data)
     if metrics is not None:
         float(jax.device_get(metrics["loss"]))
+
+    if telemetry is not None:
+        batch_size = len(next(iter(batch_data.values())))
+        total = 0.0
+        for i in range(steps):
+            t0 = time.perf_counter()
+            state, metrics = step(state, batch_data)
+            final_loss = float(jax.device_get(metrics["loss"]))
+            dt_step = time.perf_counter() - t0
+            total += dt_step
+            telemetry.observe(batch_size, dt_step, step=i)
+        assert np.isfinite(final_loss)
+        return state, total
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -430,10 +466,12 @@ def bench_resnet():
         "label": jnp.asarray(rng.integers(0, 1000, size=(batch,))),
     }
 
-    state, dt = run_timed(step, state, batch_data, warmup, steps)
+    train_flops_per_img = 3.0 * resnet_flops_per_image("resnet50", image_size)
+    telemetry = make_step_telemetry(train_flops_per_img)
+    state, dt = run_timed(step, state, batch_data, warmup, steps,
+                          telemetry=telemetry)
 
     img_s = batch * steps / dt
-    train_flops_per_img = 3.0 * resnet_flops_per_image("resnet50", image_size)
     peak = device_peak_flops(jax.devices()[0])
     mfu = img_s * train_flops_per_img / peak
 
@@ -451,6 +489,8 @@ def bench_resnet():
         "step_ms": round(1000 * dt / steps, 2),
         "device": str(jax.devices()[0].device_kind),
     }
+    if telemetry is not None:
+        record["step_telemetry"] = telemetry.summary()
 
     if os.environ.get("KFT_BENCH_SKIP_MEASURED_REF", "") not in ("1", "true"):
         ref_img_s = _measure_plain_reference(

@@ -20,6 +20,16 @@
   whose handler neither re-raises nor logs — failures vanish. Narrow
   the type, add a log call, or annotate intentional swallows with
   ``# analysis: allow[py-broad-except]``.
+- ``py-print-in-lib`` (warning): bare ``print(`` in library code.
+  Telemetry must go through the structured JSON logger
+  (``kubeflow_tpu.obs.logging``) so records carry the schema + trace
+  ids the obs gate asserts; a print bypasses level filtering, log
+  shipping and trace correlation entirely. Scripts are exempt:
+  ``__main__.py``/``conftest.py``/``setup.py``/``test_*`` files, files
+  under ``tests``/``testing``/``docs`` directories, and any module
+  with a top-level ``if __name__ == "__main__"`` guard (CLIs print
+  their output by design). Deliberate prints escape with
+  ``# analysis: allow[py-print-in-lib]``.
 - ``py-retry-no-backoff`` (warning): a ``while`` loop (or an
   attempt-style ``for attempt in ...`` loop) that retries after
   catching an exception — ``continue`` in the handler, or a swallowing
@@ -35,6 +45,7 @@
 from __future__ import annotations
 
 import ast
+import os
 
 from kubeflow_tpu.analysis.findings import Finding, Severity
 
@@ -278,6 +289,41 @@ def _check_retry_loop(
         ))
 
 
+# File shapes where print() is the intended output channel, not stray
+# telemetry: named script entrypoints and test/doc trees.
+_PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
+_PRINT_EXEMPT_DIRS = {"tests", "testing", "docs", "examples"}
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    """``if __name__ == "__main__":`` (either operand order)."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)):
+        return False
+    operands = [test.left, *test.comparators]
+    names = {o.id for o in operands if isinstance(o, ast.Name)}
+    consts = {
+        o.value for o in operands
+        if isinstance(o, ast.Constant) and isinstance(o.value, str)
+    }
+    return "__name__" in names and "__main__" in consts
+
+
+def _print_rule_exempt(path: str, tree: ast.AST) -> bool:
+    base = os.path.basename(path)
+    if base in _PRINT_EXEMPT_BASENAMES or base.startswith("test_"):
+        return True
+    parts = path.replace("\\", "/").split("/")[:-1]
+    if any(part in _PRINT_EXEMPT_DIRS for part in parts):
+        return True
+    # A module that IS a script (top-level main guard) prints to its
+    # invoker's terminal by design — bench.py, loadtest drivers, CLIs.
+    return any(
+        isinstance(node, ast.If) and _is_main_guard(node.test)
+        for node in getattr(tree, "body", [])
+    )
+
+
 def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
     def broad(node: ast.AST | None) -> bool:
         if node is None:
@@ -326,6 +372,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
     aliases = _import_aliases(tree)
     traced_names = _traced_function_names(tree, aliases)
     out: list[Finding] = []
+    print_exempt = _print_rule_exempt(path, tree)
 
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -340,6 +387,20 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
             _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):
             target = _dotted(node.func, aliases)
+            if (
+                not print_exempt
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                out.append(Finding(
+                    "py-print-in-lib", Severity.WARNING, path, node.lineno,
+                    "print() in library code: telemetry must go through "
+                    "the structured logger "
+                    "(kubeflow_tpu.obs.configure_structured_logging) so "
+                    "records carry the JSON schema and trace ids; use "
+                    "logging, or annotate a deliberate print with "
+                    "# analysis: allow[py-print-in-lib]",
+                ))
             display = _HTTP_TIMEOUT_REQUIRED.get(target)
             if display is None and target.startswith("requests."):
                 tail = target.split(".", 1)[1]

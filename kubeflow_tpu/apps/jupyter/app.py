@@ -7,6 +7,7 @@ import time
 
 import yaml
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.apps.jupyter import form as form_mod
 from kubeflow_tpu.controllers.notebook import event_involves_notebook
 from kubeflow_tpu.apps.jupyter.status import STOP_ANNOTATION, process_status
@@ -251,6 +252,15 @@ def create_app(
         if not isinstance(body, dict):
             raise ApiError("request body must be a JSON object")
         nb, pvcs = form_mod.build_notebook(body, namespace, config_cache.get())
+        # Stamp the request's trace context onto the CR: the controller
+        # runtime parents its reconcile spans on this annotation, so
+        # one trace follows the click from this POST through admission
+        # and reconcile to the running pods (obs/trace.py).
+        span = obs.current_span()
+        if span is not None:
+            nb.setdefault("metadata", {}).setdefault("annotations", {})[
+                obs.TRACE_ANNOTATION
+            ] = obs.format_traceparent(span.context)
         # Dry-run everything first so a late conflict can't orphan
         # freshly-created PVCs (reference post.py:51-57 dry-run ordering).
         try:

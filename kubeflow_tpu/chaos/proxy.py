@@ -17,11 +17,13 @@ stream (drop / duplicate / reorder / compact) at delivery time.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import queue
 import time
 from collections import deque
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.chaos import schedule as sched
 from kubeflow_tpu.chaos.schedule import Fault, FaultSchedule
 from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
@@ -124,17 +126,37 @@ class ChaosApiServer:
         self.ops_total = 0
 
     # ---- fault gate ------------------------------------------------------
-    def _gate(self, verb: str, kind: str) -> None:
+    def _traced(self, verb: str, kind: str):
+        """An ``api <verb>`` child span when a trace is active (the
+        reconcile or http span above this call), else a no-op. The
+        apiserver-call layer of a trace comes from here in chaos runs —
+        injected faults land as events on exactly the call they hit,
+        so a trace reads "503 injected HERE, retried, succeeded"."""
+        if obs.current_span() is None:
+            return contextlib.nullcontext(None)
+        return obs.get_tracer().span(
+            f"api {verb}", attributes={"verb": verb, "kind": kind},
+        )
+
+    def _gate(self, verb: str, kind: str, span=None) -> None:
         op = next(self._ops)
         self.ops_total = op + 1
         fault = self.schedule.fault_for(op, verb, kind)
         if fault is None:
             return
         self.injected[fault.kind] = self.injected.get(fault.kind, 0) + 1
-        self._raise(fault, verb, kind, op)
+        self._raise(fault, verb, kind, op, span)
 
-    def _raise(self, fault: Fault, verb: str, kind: str, op: int) -> None:
+    def _raise(self, fault: Fault, verb: str, kind: str, op: int,
+               span=None) -> None:
         where = f"op {op} {verb} {kind}"
+        if span is not None:
+            span.add_event("chaos.fault", {
+                "fault": fault.kind,
+                "status": fault.status,
+                "op": op,
+                "verb": verb,
+            })
         if fault.kind == sched.LATENCY:
             self._sleep(fault.latency_s)
             return
@@ -155,40 +177,51 @@ class ChaosApiServer:
     # ---- intercepted verbs ----------------------------------------------
     def create(self, obj: dict, namespace: str | None = None,
                dry_run: bool = False) -> dict:
-        self._gate("create", obj.get("kind", ""))
-        return self.inner.create(obj, namespace=namespace, dry_run=dry_run)
+        kind = obj.get("kind", "")
+        with self._traced("create", kind) as span:
+            self._gate("create", kind, span)
+            return self.inner.create(obj, namespace=namespace,
+                                     dry_run=dry_run)
 
     def get(self, api_version: str, kind: str, name: str,
             namespace: str | None = None) -> dict:
-        self._gate("get", kind)
-        return self.inner.get(api_version, kind, name, namespace)
+        with self._traced("get", kind) as span:
+            self._gate("get", kind, span)
+            return self.inner.get(api_version, kind, name, namespace)
 
     def list(self, api_version: str, kind: str, namespace: str | None = None,
              label_selector: str | None = None,
              field_selector: str | None = None) -> list[dict]:
-        self._gate("list", kind)
-        return self.inner.list(api_version, kind, namespace=namespace,
-                               label_selector=label_selector,
-                               field_selector=field_selector)
+        with self._traced("list", kind) as span:
+            self._gate("list", kind, span)
+            return self.inner.list(api_version, kind, namespace=namespace,
+                                   label_selector=label_selector,
+                                   field_selector=field_selector)
 
     def update(self, obj: dict, dry_run: bool = False) -> dict:
-        self._gate("update", obj.get("kind", ""))
-        return self.inner.update(obj, dry_run=dry_run)
+        kind = obj.get("kind", "")
+        with self._traced("update", kind) as span:
+            self._gate("update", kind, span)
+            return self.inner.update(obj, dry_run=dry_run)
 
     def patch_merge(self, api_version: str, kind: str, name: str,
                     patch: dict, namespace: str | None = None) -> dict:
-        self._gate("patch_merge", kind)
-        return self.inner.patch_merge(api_version, kind, name, patch,
-                                      namespace)
+        with self._traced("patch_merge", kind) as span:
+            self._gate("patch_merge", kind, span)
+            return self.inner.patch_merge(api_version, kind, name, patch,
+                                          namespace)
 
     def delete(self, api_version: str, kind: str, name: str,
                namespace: str | None = None) -> None:
-        self._gate("delete", kind)
-        return self.inner.delete(api_version, kind, name, namespace)
+        with self._traced("delete", kind) as span:
+            self._gate("delete", kind, span)
+            return self.inner.delete(api_version, kind, name, namespace)
 
     def apply(self, obj: dict) -> dict:
-        self._gate("apply", obj.get("kind", ""))
-        return self.inner.apply(obj)
+        kind = obj.get("kind", "")
+        with self._traced("apply", kind) as span:
+            self._gate("apply", kind, span)
+            return self.inner.apply(obj)
 
     def watch(self, api_version: str, kind: str, *args, **kwargs):
         q = self.inner.watch(api_version, kind, *args, **kwargs)

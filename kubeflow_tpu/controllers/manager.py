@@ -81,14 +81,18 @@ class Manager:
         self.server = None
         if prom is not None and http_port is not None:
             prom.watch_controllers(controllers)
+            from kubeflow_tpu import obs
+
             self.server = ManagerServer(
                 prom,
                 port=http_port,
                 ready=self.ready,
                 # pprof-role endpoints (/debug/threads, /debug/tracemalloc)
+                # and the trace endpoints (/debug/traces, /debug/timeline)
                 # are strictly opt-in, like controller-runtime's pprof
                 # listener.
                 enable_debug=_env_bool("KFT_ENABLE_DEBUG_ENDPOINTS"),
+                tracer=obs.get_tracer(),
             )
         self.elector = None
         if leader_elect:

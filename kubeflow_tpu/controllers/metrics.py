@@ -24,11 +24,17 @@ from prometheus_client import (
     CollectorRegistry,
     Counter,
     Gauge,
+    Histogram,
     generate_latest,
 )
-from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
 
 from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.obs import export as obs_export
 
 log = logging.getLogger(__name__)
 
@@ -151,6 +157,24 @@ class ClientResilienceCollector:
             "Requests fast-failed while the breaker was open",
             value=breaker.fast_fail_total,
         )
+        # Round-trip latency per verb: the client keeps dependency-free
+        # BucketHistograms (it cannot import prometheus_client); the
+        # snapshot renders as a real histogram family at scrape time.
+        snapshot = getattr(self.client, "duration_snapshot", None)
+        if callable(snapshot):
+            fam = HistogramMetricFamily(
+                "apiserver_client_request_duration_seconds",
+                "Apiserver round-trip wall time per attempt "
+                "(retries observed individually)",
+                labels=["verb"],
+            )
+            for verb, snap in sorted(snapshot().items()):
+                fam.add_metric(
+                    [verb],
+                    buckets=[(le, count) for le, count in snap["buckets"]],
+                    sum_value=snap["sum"],
+                )
+            yield fam
 
 
 class ControllerMetrics:
@@ -189,22 +213,27 @@ class ControllerMetrics:
             ["namespace", "name"],
             registry=self.registry,
         )
+        # Label discipline: object identity is namespace/name, the
+        # emitting controller is "controller" — the canonical schema
+        # (obs.metrics.CANONICAL_LABELS) shared with the dashboard and
+        # CRUD-app registries and asserted by tests/test_obs.py. The
+        # pre-obs "component" spelling is gone.
         self.request_total = Counter(
             "request_kf",
             "Number of reconcile-driven API requests",
-            ["component", "kind"],
+            ["controller", "kind"],
             registry=self.registry,
         )
         self.request_failure_total = Counter(
             "request_kf_failure",
             "Number of failed reconcile-driven API requests",
-            ["component", "kind", "severity"],
+            ["controller", "kind", "severity"],
             registry=self.registry,
         )
         self.service_heartbeat = Counter(
             "service_heartbeat",
             "Heartbeat signal indicating the manager is alive",
-            ["component", "severity"],
+            ["controller", "severity"],
             registry=self.registry,
         )
         self.reconcile_total = Counter(
@@ -228,6 +257,33 @@ class ControllerMetrics:
             ["namespace"],
             registry=self.registry,
         )
+        # The latency dimension (PR 3): counters say a reconcile
+        # happened; these say where the time went. Queue duration is
+        # due→dequeue (controller-runtime's
+        # workqueue_queue_duration_seconds — scheduled requeue delays
+        # and parked backoff excluded), observed by the WorkQueue via
+        # the latency_observer hook the Controller wires up — same
+        # bounds as the queue's own BucketHistogram so the two views
+        # of one distribution cannot diverge.
+        from kubeflow_tpu.obs.metrics import LATENCY_BUCKETS
+
+        _duration_buckets = LATENCY_BUCKETS
+        self.reconcile_duration = Histogram(
+            "controller_reconcile_duration_seconds",
+            "Wall time of one reconcile invocation",
+            ["controller"],
+            registry=self.registry,
+            buckets=_duration_buckets,
+        )
+        self.queue_duration = Histogram(
+            "workqueue_queue_duration_seconds",
+            "Seconds a reconcile request waited in the workqueue after "
+            "becoming due (scheduled requeue delays and parked backoff "
+            "excluded)",
+            ["controller"],
+            registry=self.registry,
+            buckets=_duration_buckets,
+        )
 
     def watch_controllers(self, controllers: Iterable) -> None:
         self.registry.register(QueueDepthCollector(controllers))
@@ -247,13 +303,17 @@ class ManagerServer:
         port: int = 0,
         ready: Callable[[], bool] | None = None,
         enable_debug: bool = False,
+        tracer=None,
     ):
         self.metrics = metrics
         self.ready = ready or (lambda: True)
         # The stack-dump endpoint exposes source paths and execution
         # state; like controller-runtime's pprof listener it is strictly
         # opt-in (KFT_ENABLE_DEBUG_ENDPOINTS=true in a manager binary).
+        # The trace endpoints (/debug/traces, /debug/timeline/<ns>/<n>)
+        # sit behind the same gate and read the tracer's in-memory ring.
         self.enable_debug = enable_debug
+        self.tracer = tracer
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -317,6 +377,47 @@ class ManagerServer:
                     self.send_header("Content-Type", "text/plain")
                     self.end_headers()
                     self.wfile.write(body)
+                elif (
+                    self.path == "/debug/traces"
+                    and outer.enable_debug
+                    and outer.tracer is not None
+                ):
+                    import json
+
+                    body = json.dumps(obs_export.trace_summaries(
+                        outer.tracer.ring.spans()
+                    ), indent=1).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (
+                    self.path.startswith("/debug/timeline/")
+                    and outer.enable_debug
+                    and outer.tracer is not None
+                ):
+                    # /debug/timeline/<namespace>/<name>: the latest
+                    # trace that touched the object, as a span tree.
+                    import json
+
+                    parts = self.path.split("/")
+                    tl = None
+                    if len(parts) == 5 and parts[3] and parts[4]:
+                        tl = obs_export.timeline(
+                            outer.tracer.ring.spans(), parts[3], parts[4]
+                        )
+                    if tl is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b"no trace for that object\n")
+                    else:
+                        body = json.dumps(tl, indent=1).encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
                 elif self.path == "/readyz":
                     ok = outer.ready()
                     self.send_response(200 if ok else 503)

@@ -22,7 +22,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Protocol
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.k8s.fake import FakeApiServer, WatchEvent
+from kubeflow_tpu.obs.metrics import BucketHistogram
 
 log = logging.getLogger(__name__)
 
@@ -61,6 +63,18 @@ class WorkQueue:
         # former O(n log n) full sort per pop.
         self._heap: list[tuple[float, int, Request]] = []
         self._seq = itertools.count()
+        # Queue-duration stamp per pending key: the moment the key
+        # becomes DUE (its earliest not_before), NOT when it was
+        # scheduled — controller-runtime's AddAfter semantics. A
+        # deliberate requeue_after=300 or a parked backoff must read as
+        # ~0 wait on a healthy controller; anything else pins the
+        # workqueue_queue_duration histogram at +Inf and the metric
+        # stops detecting real backlog.
+        self._enqueued_at: dict[Request, float] = {}
+        self.latency = BucketHistogram()
+        # Optional hook (Controller wires the manager's Prometheus
+        # histogram here); called OUTSIDE the queue lock.
+        self.latency_observer = None
 
     def _schedule(self, req: Request, not_before: float) -> None:
         # Lock held. Keep the earliest scheduled time for duplicates:
@@ -69,6 +83,12 @@ class WorkQueue:
         if cur is None or not_before < cur:
             self._pending[req] = not_before
             heapq.heappush(self._heap, (not_before, next(self._seq), req))
+        # Duration stamp: fresh stay takes this due-time; an earlier
+        # re-add of a pending key pulls it forward (the key became due
+        # sooner), a later one never pushes it back.
+        stamp = self._enqueued_at.get(req)
+        if cur is None or stamp is None or not_before < stamp:
+            self._enqueued_at[req] = not_before
 
     def add(self, req: Request, delay: float = 0.0) -> None:
         with self._lock:
@@ -89,6 +109,8 @@ class WorkQueue:
             self._failures.pop(req, None)
 
     def pop_ready(self) -> Request | None:
+        wait: float | None = None
+        popped: Request | None = None
         with self._lock:
             now = time.monotonic()
             while self._heap:
@@ -101,8 +123,32 @@ class WorkQueue:
                     return None  # heap min not due: nothing is
                 heapq.heappop(self._heap)
                 del self._pending[req]
-                return req
+                due_at = self._enqueued_at.pop(req, None)
+                if due_at is not None:
+                    wait = max(0.0, time.monotonic() - due_at)
+                popped = req
+                break
+        if popped is None:
             return None
+        if wait is not None:
+            self.latency.observe(wait)
+            observer = self.latency_observer
+            if observer is not None:
+                try:
+                    observer(wait)
+                except Exception:
+                    log.debug("queue latency observer failed",
+                              exc_info=True)
+        return popped
+
+    def latency_snapshot(self) -> dict:
+        """p50/p99 due→dequeue wait (bucket upper bounds) — the
+        in-process view of the workqueue_queue_duration histogram."""
+        return {
+            "count": self.latency.count,
+            "p50": self.latency.quantile(0.50),
+            "p99": self.latency.quantile(0.99),
+        }
 
     def next_deadline(self) -> float | None:
         with self._lock:
@@ -280,6 +326,15 @@ class Controller:
         self.clock = clock
         self._failure_streak: dict[Request, int] = {}
         self._degraded: set[Request] = set()
+        # Request → traceparent from the object's TRACE_ANNOTATION,
+        # captured off watch events / resync lists so the reconcile
+        # span joins the trace that created the object (spawner POST).
+        # Bounded: churn on annotated objects must not grow it forever.
+        self._trace_parents: dict[Request, str] = {}
+        if prom is not None and hasattr(prom, "queue_duration"):
+            self.queue.latency_observer = (
+                prom.queue_duration.labels(name).observe
+            )
         self._watch_queues = []
         for spec in watches:
             q = api.watch(spec.api_version, spec.kind)
@@ -309,6 +364,27 @@ class Controller:
         meta = obj.get("metadata", {})
         return [Request(meta.get("namespace", ""), meta.get("name", ""))]
 
+    def _remember_trace_parent(self, obj: dict, req: Request) -> None:
+        header = (
+            (obj.get("metadata") or {}).get("annotations") or {}
+        ).get(obs.TRACE_ANNOTATION)
+        if not header:
+            # Only the PRIMARY object may invalidate the link: a
+            # delete-and-recreate without the annotation must not keep
+            # parenting reconciles on the dead predecessor's trace —
+            # but secondary watches (Pods, StatefulSets mapped to the
+            # same request) never carry the annotation and must not
+            # wipe a live link either.
+            if (
+                self._watch_queues
+                and obj.get("kind") == self._watch_queues[0][0].kind
+            ):
+                self._trace_parents.pop(req, None)
+            return
+        if req not in self._trace_parents and len(self._trace_parents) >= 1024:
+            self._trace_parents.pop(next(iter(self._trace_parents)))
+        self._trace_parents[req] = header
+
     def _drain_watches(self) -> int:
         moved = 0
         for spec, q in self._watch_queues:
@@ -317,6 +393,7 @@ class Controller:
                 mapper = spec.mapper or self._default_request
                 for req in mapper(event.object):
                     if req.name:
+                        self._remember_trace_parent(event.object, req)
                         self.queue.add(req)
                         moved += 1
         return moved
@@ -326,45 +403,85 @@ class Controller:
         if req is None:
             return False
         self.metrics["reconciles"] += 1
+        # The reconcile span joins the trace that created the object
+        # when its CR carries the trace annotation (spawner POST → CR →
+        # watch event → here); otherwise it roots a fresh trace. Every
+        # apiserver round-trip the reconciler makes nests underneath
+        # via the contextvar.
+        parent = obs.parse_traceparent(self._trace_parents.get(req))
+        tracer = obs.get_tracer()
         started = self.clock()
-        try:
-            requeue_after = self.reconciler.reconcile(req)
-        except Exception:
-            log.exception("%s: reconcile %s failed", self.name, req)
-            self.metrics["errors"] += 1
+        with tracer.span(
+            "reconcile",
+            parent=parent,
+            attributes={
+                "controller": self.name,
+                "namespace": req.namespace,
+                "name": req.name,
+            },
+        ) as span:
+            try:
+                requeue_after = self.reconciler.reconcile(req)
+            except Exception as exc:
+                elapsed = self.clock() - started
+                self._observe_duration(elapsed)
+                log.exception("%s: reconcile %s failed", self.name, req)
+                self.metrics["errors"] += 1
+                if self.prom is not None:
+                    self.prom.reconcile_total.labels(
+                        self.name, "error"
+                    ).inc()
+                streak = self._failure_streak.get(req, 0) + 1
+                self._failure_streak[req] = streak
+                span.record_exception(exc)
+                span.add_event("requeue_rate_limited",
+                               {"failures": streak})
+                if (streak >= self.stuck_threshold
+                        and req not in self._degraded):
+                    self._mark_degraded(req, streak)
+                self.queue.add_rate_limited(req)
+                return True
+            elapsed = self.clock() - started
+            self._observe_duration(elapsed)
+            if elapsed > self.reconcile_deadline:
+                # Reconciles run on shared workers and cannot be aborted
+                # mid-flight; the watchdog surfaces the overrun so a
+                # wedged probe or API hang is an alert, not a silent
+                # stall.
+                self.metrics["deadline_exceeded"] += 1
+                if self.prom is not None:
+                    self.prom.reconcile_stuck_total.labels(
+                        self.name, "deadline"
+                    ).inc()
+                span.add_event("deadline_exceeded", {
+                    "elapsed_s": round(elapsed, 3),
+                    "deadline_s": self.reconcile_deadline,
+                })
+                self._record_watchdog_event(
+                    req, "ReconcileDeadlineExceeded",
+                    f"reconcile of {req.namespace}/{req.name} took "
+                    f"{elapsed:.1f}s "
+                    f"(deadline {self.reconcile_deadline:.1f}s)",
+                )
             if self.prom is not None:
-                self.prom.reconcile_total.labels(self.name, "error").inc()
-            streak = self._failure_streak.get(req, 0) + 1
-            self._failure_streak[req] = streak
-            if streak >= self.stuck_threshold and req not in self._degraded:
-                self._mark_degraded(req, streak)
-            self.queue.add_rate_limited(req)
-            return True
-        elapsed = self.clock() - started
-        if elapsed > self.reconcile_deadline:
-            # Reconciles run on shared workers and cannot be aborted
-            # mid-flight; the watchdog surfaces the overrun so a wedged
-            # probe or API hang is an alert, not a silent stall.
-            self.metrics["deadline_exceeded"] += 1
-            if self.prom is not None:
-                self.prom.reconcile_stuck_total.labels(
-                    self.name, "deadline"
+                self.prom.reconcile_total.labels(
+                    self.name, "success"
                 ).inc()
-            self._record_watchdog_event(
-                req, "ReconcileDeadlineExceeded",
-                f"reconcile of {req.namespace}/{req.name} took "
-                f"{elapsed:.1f}s (deadline {self.reconcile_deadline:.1f}s)",
-            )
-        if self.prom is not None:
-            self.prom.reconcile_total.labels(self.name, "success").inc()
-        self._failure_streak.pop(req, None)
-        if req in self._degraded:
-            self._clear_degraded(req)
-        self.queue.forget(req)
-        if requeue_after is not None:
-            self.metrics["requeues"] += 1
-            self.queue.add(req, delay=requeue_after)
+            self._failure_streak.pop(req, None)
+            if req in self._degraded:
+                self._clear_degraded(req)
+            self.queue.forget(req)
+            if requeue_after is not None:
+                self.metrics["requeues"] += 1
+                span.add_event("requeue_after",
+                               {"delay_s": requeue_after})
+                self.queue.add(req, delay=requeue_after)
         return True
+
+    def _observe_duration(self, elapsed: float) -> None:
+        if self.prom is not None and hasattr(self.prom,
+                                             "reconcile_duration"):
+            self.prom.reconcile_duration.labels(self.name).observe(elapsed)
 
     # ---- stuck-reconcile watchdog ---------------------------------------
     def _primary_object(self, req: Request) -> dict | None:
@@ -532,6 +649,7 @@ class Controller:
                 for c in (obj.get("status") or {}).get("conditions") or []
             )
             for req in (spec.mapper or self._default_request)(obj):
+                self._remember_trace_parent(obj, req)
                 self.queue.add(req)
                 count += 1
                 if inherited:

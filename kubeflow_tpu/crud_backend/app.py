@@ -14,6 +14,7 @@ metrics. Apps subclass nothing; they instantiate and register routes:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import mimetypes
@@ -29,6 +30,7 @@ from werkzeug.wrappers import Request, Response
 
 from prometheus_client import CollectorRegistry, Counter, Histogram, generate_latest
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.crud_backend import csrf
 from kubeflow_tpu.crud_backend.authn import AuthnConfig
 from kubeflow_tpu.crud_backend.authz import Authorizer, DenyAll, Forbidden
@@ -180,7 +182,42 @@ class RestApp:
     def dispatch(self, request: Request) -> Response:
         start = time.monotonic()
         state = {"endpoint": "unmatched"}
-        response = self._dispatch_inner(request, state)
+        # Extract-or-start a trace per request: an upstream traceparent
+        # (mesh sidecar, another platform app) is continued, otherwise
+        # this request roots a new trace. Handlers see the span via
+        # obs.current_span() — the spawner stamps its context onto the
+        # CRs it creates — and the trace id is echoed on the response
+        # so a user bug report can name its exact trace. Probe/scrape
+        # paths are NOT traced: kubelet + Prometheus would otherwise
+        # drown the ring and grow the JSONL with thousands of
+        # zero-value spans a day.
+        if request.path in self.OPEN_PATHS:
+            cm = contextlib.nullcontext(None)
+        else:
+            cm = obs.get_tracer().span(
+                f"http {request.method}",
+                parent=obs.parse_traceparent(
+                    request.headers.get("traceparent")
+                ),
+                attributes={
+                    "app": self.name,
+                    "method": request.method,
+                    "path": request.path,
+                },
+            )
+        with cm as span:
+            response = self._dispatch_inner(request, state)
+            if span is not None:
+                span.set_attribute("endpoint", state["endpoint"])
+                span.set_attribute("status_code", response.status_code)
+                if response.status_code >= 500:
+                    span.status = "error"
+                # Advertise the trace id only when the trace was
+                # actually recorded — a sampled-out id exists in no
+                # exporter, and handing it to a bug reporter sends the
+                # operator hunting for a trace that never existed.
+                if span.context.sampled:
+                    response.headers["X-Trace-Id"] = span.context.trace_id
         self.m_requests.labels(
             request.method, state["endpoint"], str(response.status_code)
         ).inc()

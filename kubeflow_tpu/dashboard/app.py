@@ -16,6 +16,7 @@ from kubeflow_tpu.crud_backend import AuthnConfig, RestApp
 from kubeflow_tpu.crud_backend.app import ApiError
 from kubeflow_tpu.dashboard.metrics import (
     NoMetricsService,
+    TpuFleetCollector,
     tpu_fleet_metrics,
 )
 from kubeflow_tpu.k8s.fake import NotFound
@@ -218,6 +219,9 @@ def create_app(
         secure_cookies=secure_cookies,
     )
     metrics_service = metrics_service or NoMetricsService()
+    # Fleet gauges on the dashboard's /metrics, from the same registry
+    # the HTTP counters live in — one scrape target, one label schema.
+    app.registry.register(TpuFleetCollector(api))
     if os.path.isdir(_STATIC_DIR):
         # serve_frontend also mounts the shared kit at /lib/ so the
         # dashboard shell gets KF.i18n (data-i18n marks + catalogs)

@@ -12,7 +12,10 @@ headline cards.
 
 from __future__ import annotations
 
+import logging
 from typing import Protocol
+
+log = logging.getLogger(__name__)
 
 TPU_RESOURCE = "google.com/tpu"
 ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
@@ -366,3 +369,59 @@ def tpu_fleet_metrics(api) -> dict:
         "totalChips": sum(e["allocatable"] for e in out.values()),
         "requestedChips": sum(e["requested"] for e in out.values()),
     }
+
+
+class TpuFleetCollector:
+    """The fleet headline cards as Prometheus gauges on the dashboard's
+    own ``/metrics`` — computed from the live Node/Pod objects at
+    scrape time, exactly like the JSON route.
+
+    Label discipline: the accelerator dimension is spelled
+    ``accelerator`` — the canonical schema every platform registry
+    shares (obs.metrics.CANONICAL_LABELS); the dashboard previously
+    exposed nothing scrape-able here, so BENCH dashboards had to parse
+    the JSON API with ad-hoc names."""
+
+    def __init__(self, api):
+        self.api = api
+        self._last_good: dict | None = None
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        try:
+            fleet = tpu_fleet_metrics(self.api)["fleet"]
+            self._last_good = fleet
+        except Exception as exc:
+            # Same posture as the manager's RunningNotebooksCollector:
+            # /metrics is where operators look during an outage, so a
+            # failed LIST serves the last good values.
+            log.warning("tpu fleet scrape: list failed (%s); serving "
+                        "last-known values", exc)
+            fleet = self._last_good
+        if fleet is None:
+            return
+        families = {
+            "allocatable": GaugeMetricFamily(
+                "tpu_fleet_chips_allocatable",
+                "TPU chips allocatable on Ready nodes",
+                labels=["accelerator"],
+            ),
+            "requested": GaugeMetricFamily(
+                "tpu_fleet_chips_requested",
+                "TPU chips requested by non-terminal pods",
+                labels=["accelerator"],
+            ),
+            "nodes": GaugeMetricFamily(
+                "tpu_fleet_nodes",
+                "Ready nodes carrying TPU chips",
+                labels=["accelerator"],
+            ),
+        }
+        for accel, entry in sorted(fleet.items()):
+            for key, fam in families.items():
+                fam.add_metric([accel], entry[key])
+        yield from families.values()

@@ -48,10 +48,20 @@ def _env_bool(name: str, default: bool = False) -> bool:
 
 
 def _setup_logging():
+    level = os.environ.get("LOG_LEVEL", "INFO").upper()
     logging.basicConfig(
-        level=os.environ.get("LOG_LEVEL", "INFO").upper(),
+        level=level,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    # Structured JSON records (trace/span ids stamped) for every
+    # kubeflow_tpu.* logger — the deployed default; KFT_JSON_LOGS=0
+    # falls back to the plain basicConfig lines for local reading.
+    if _env_bool("KFT_JSON_LOGS", True):
+        from kubeflow_tpu.obs import configure_structured_logging
+
+        configure_structured_logging(
+            level=getattr(logging, level, logging.INFO)
+        )
 
 
 def _connect():

@@ -53,6 +53,8 @@ from kubeflow_tpu.k8s.core import (
     WatchEvent,
     resource_name,
 )
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.obs.metrics import REQUEST_BUCKETS, BucketHistogram
 from kubeflow_tpu.k8s.retry import (
     RETRIABLE_STATUS,
     RETRIABLE_VERBS,
@@ -327,6 +329,11 @@ class ApiClient:
         # the point.
         self.request_metrics = {"requests": 0, "retries": 0}
         self._metrics_lock = threading.Lock()
+        # Per-verb round-trip latency (each attempt observed, retries
+        # included) in dependency-free histograms; rendered on /metrics
+        # as apiserver_client_request_duration_seconds by
+        # ClientResilienceCollector via duration_snapshot().
+        self._durations: dict[str, BucketHistogram] = {}
         self._retry_sleep = time.sleep  # injectable (chaos tests)
         url = urllib.parse.urlsplit(config.host)
         self._tls = url.scheme == "https"
@@ -453,6 +460,21 @@ class ApiClient:
         with self._metrics_lock:
             self.request_metrics[key] += 1
 
+    def _observe_duration(self, verb: str, seconds: float) -> None:
+        with self._metrics_lock:
+            hist = self._durations.get(verb)
+            if hist is None:
+                hist = self._durations[verb] = BucketHistogram(
+                    REQUEST_BUCKETS
+                )
+        hist.observe(seconds)
+
+    def duration_snapshot(self) -> dict:
+        """{verb: BucketHistogram snapshot} for the metrics collector."""
+        with self._metrics_lock:
+            hists = dict(self._durations)
+        return {verb: h.snapshot() for verb, h in hists.items()}
+
     def _request(
         self,
         method: str,
@@ -479,6 +501,15 @@ class ApiClient:
             "Content-Type": content_type,
             **self._auth_headers(),
         }
+        # Trace propagation: whatever span is active on this thread
+        # (reconcile, http request, admission) continues server-side on
+        # the W3C header; retries and breaker trips become events on
+        # that span so a trace shows the fight, not just the outcome.
+        span = obs_trace.current_span()
+        if span is not None:
+            headers["traceparent"] = obs_trace.format_traceparent(
+                span.context
+            )
         payload = None
         if body is not None:
             payload = body if isinstance(body, (bytes, str)) else json.dumps(body)
@@ -487,10 +518,14 @@ class ApiClient:
         attempt = 0
         while True:
             if not self.breaker.allow():
+                if span is not None:
+                    span.add_event("circuit_breaker_fast_fail",
+                                   {"verb": method})
                 raise ApiError(
                     "apiserver circuit breaker open (recent consecutive "
                     "failures); request fast-failed", 503,
                 )
+            attempt_started = time.monotonic()
             try:
                 # Connect happens inside the retry loop: a transient
                 # refusal (apiserver restarting) gets the same
@@ -499,9 +534,12 @@ class ApiClient:
                 conn.request(method, target, body=payload, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
-            except (http.client.HTTPException, ConnectionError, OSError):
+            except (http.client.HTTPException, ConnectionError, OSError) as exc:
+                self._observe_duration(
+                    method, time.monotonic() - attempt_started
+                )
                 self._drop_pooled()
-                self.breaker.record_failure()
+                self._breaker_failure(span, method)
                 if (
                     not retriable
                     or attempt + 1 >= self.retry_policy.max_attempts
@@ -509,15 +547,24 @@ class ApiClient:
                 ):
                     raise
                 self._count("retries")
+                if span is not None:
+                    span.add_event("retry", {
+                        "attempt": attempt,
+                        "verb": method,
+                        "error": type(exc).__name__,
+                    })
                 self._retry_sleep(self.retry_policy.delay(attempt))
                 attempt += 1
                 continue
+            self._observe_duration(
+                method, time.monotonic() - attempt_started
+            )
             # The server answered: 5xx counts against the breaker (the
             # apiserver itself is failing); anything else — including
             # 429, which proves it is alive enough to shed load — is
             # breaker success.
             if resp.status >= 500:
-                self.breaker.record_failure()
+                self._breaker_failure(span, method)
             else:
                 self.breaker.record_success()
             if (
@@ -530,12 +577,31 @@ class ApiClient:
                     resp.getheader("Retry-After")
                 )
                 self._count("retries")
+                if span is not None:
+                    span.add_event("retry", {
+                        "attempt": attempt,
+                        "verb": method,
+                        "status": resp.status,
+                    })
                 self._retry_sleep(
                     self.retry_policy.delay(attempt, retry_after)
                 )
                 attempt += 1
                 continue
             return self._check(resp.status, data, raw=raw)
+
+    def _breaker_failure(self, span, method: str) -> None:
+        """Record a breaker failure; a closed→open transition becomes a
+        span event (the moment the client gave up on the apiserver is
+        exactly what an operator reading the trace wants stamped)."""
+        before = self.breaker.state
+        self.breaker.record_failure()
+        if (
+            span is not None
+            and before != "open"
+            and self.breaker.state == "open"
+        ):
+            span.add_event("circuit_breaker_open", {"verb": method})
 
     @staticmethod
     def _check(status: int, data: bytes, raw: bool = False):

@@ -147,6 +147,33 @@ def make_train_step(mesh: Mesh | None = None, smoothing: float = 0.1):
     return jax.jit(sharded_step, donate_argnums=0)
 
 
+def run_steps(step, state, batches, telemetry=None):
+    """Drive a jitted train step over ``batches`` (an iterable of batch
+    dicts), returning ``(state, last_metrics)``.
+
+    With a :class:`kubeflow_tpu.obs.StepTelemetry`, each step is timed
+    host-synced (a scalar ``device_get`` forces the dependency chain —
+    async dispatch would otherwise report enqueue time, not step time)
+    and recorded: wall time, examples/sec, MFU → JSONL + Prometheus
+    gauges. Without telemetry, steps stay fully async — the hook costs
+    nothing unless it is plugged in.
+    """
+    import time
+
+    metrics = None
+    for batch in batches:
+        if telemetry is None:
+            state, metrics = step(state, batch)
+            continue
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch)
+        first = next(iter(metrics.values()))
+        float(jax.device_get(first))
+        batch_size = len(next(iter(batch.values())))
+        telemetry.observe(batch_size, time.perf_counter() - t0)
+    return state, metrics
+
+
 def make_eval_step():
     def eval_step(state: TrainState, batch) -> dict:
         logits = state.apply_fn(

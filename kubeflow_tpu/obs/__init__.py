@@ -1,0 +1,104 @@
+"""Platform observability: spans, structured logs, step telemetry.
+
+One user action on this platform crosses at least five processes —
+spawner POST → apiserver → admission webhook → controller reconcile →
+apiserver again — and the counters on ``/metrics`` can only say that
+each hop happened, not where the 40 seconds went. This package is the
+correlation layer: dependency-free Dapper-style spans propagated on the
+W3C ``traceparent`` header (and, across the async hop through etcd, on
+a CR annotation), exporters (bounded in-memory ring + JSONL), a JSON
+log formatter that stamps trace/span ids on every record, and
+``StepTelemetry`` for the training side (per-step wall time,
+examples/sec, MFU against the per-topology peak-FLOPs tables).
+
+Everything here is stdlib-only so the k8s client, the webhook and the
+controllers can import it without growing their images;
+``telemetry.py`` alone touches prometheus_client, lazily.
+
+Environment:
+
+- ``OBS_TRACE_SAMPLE``  — root-span sample rate in [0, 1] (default 1.0)
+- ``OBS_JSONL_PATH``    — when set, the default tracer also appends
+  every finished span as one JSON line to this file
+- ``OBS_RING_CAPACITY`` — spans retained in memory for ``/debug/traces``
+  (default 512)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from kubeflow_tpu.obs.export import (
+    JsonlExporter,
+    MultiExporter,
+    RingExporter,
+    span_tree,
+    timeline,
+    trace_summaries,
+)
+from kubeflow_tpu.obs.logging import (
+    JsonLogFormatter,
+    configure_structured_logging,
+)
+from kubeflow_tpu.obs.metrics import BucketHistogram, CANONICAL_LABELS
+from kubeflow_tpu.obs.telemetry import StepTelemetry
+from kubeflow_tpu.obs.trace import (
+    TRACE_ANNOTATION,
+    Span,
+    SpanContext,
+    Tracer,
+    current_span,
+    format_traceparent,
+    parse_traceparent,
+)
+
+__all__ = [
+    "BucketHistogram",
+    "CANONICAL_LABELS",
+    "JsonLogFormatter",
+    "JsonlExporter",
+    "MultiExporter",
+    "RingExporter",
+    "Span",
+    "SpanContext",
+    "StepTelemetry",
+    "TRACE_ANNOTATION",
+    "Tracer",
+    "configure_structured_logging",
+    "current_span",
+    "format_traceparent",
+    "get_tracer",
+    "parse_traceparent",
+    "set_tracer",
+    "span_tree",
+    "timeline",
+    "trace_summaries",
+]
+
+_tracer: Tracer | None = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide default tracer, created lazily from the OBS_*
+    environment (every instrumentation point calls this, so swapping
+    the tracer via :func:`set_tracer` re-routes the whole process)."""
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                exporter = None
+                path = os.environ.get("OBS_JSONL_PATH")
+                if path:
+                    exporter = JsonlExporter(path)
+                _tracer = Tracer(exporter=exporter)
+    return _tracer
+
+
+def set_tracer(tracer: Tracer | None) -> None:
+    """Replace (or with ``None`` reset) the process-wide tracer —
+    tests install a private tracer + exporter and restore after."""
+    global _tracer
+    with _tracer_lock:
+        _tracer = tracer

@@ -1,0 +1,182 @@
+"""Span exporters + trace-tree assembly (stdlib only).
+
+Exporters receive one ``span.to_dict()`` per finished sampled span:
+
+- :class:`RingExporter` — bounded in-memory deque; the data source for
+  ``/debug/traces`` and ``/debug/timeline`` on the manager's health
+  server, and for test assertions.
+- :class:`JsonlExporter` — one JSON line per span, append-only; the
+  durable form the chaos harness and bench consume.
+- :class:`MultiExporter` — fan-out.
+
+The assembly helpers turn a flat span list back into the tree an
+operator reads: group by trace id, parent by span id, order by start
+time.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+
+
+class RingExporter:
+    """Last-N finished spans, thread-safe, oldest evicted first."""
+
+    def __init__(self, capacity: int = 512):
+        self._spans: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity))
+        )
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen or 0
+
+    def export(self, span: dict) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+class JsonlExporter:
+    """Append spans to a JSONL file (one line each). Parent directories
+    are created; writes are serialized so concurrent span ends cannot
+    interleave half-lines. The append handle is opened once and flushed
+    per line — spans end on every reconcile and training step, and an
+    open/close syscall pair per record would dominate the export cost."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = None
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+        except OSError:
+            # Telemetry must never take down the traced path: an
+            # unwritable OBS_JSONL_PATH means exports fail later and
+            # are dropped by Tracer._export, not a crashed constructor
+            # inside the first traced request.
+            pass
+
+    def export(self, span: dict) -> None:
+        line = json.dumps(span, default=str)
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+class MultiExporter:
+    def __init__(self, *exporters):
+        self.exporters = list(exporters)
+
+    def export(self, span: dict) -> None:
+        for exporter in self.exporters:
+            exporter.export(span)
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Read a JSONL span file back; skips any torn final line."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+# ---- trace assembly ------------------------------------------------------
+def _by_trace(spans: list[dict]) -> dict[str, list[dict]]:
+    traces: dict[str, list[dict]] = {}
+    for span in spans:
+        traces.setdefault(span.get("trace_id", ""), []).append(span)
+    return traces
+
+
+def span_tree(spans: list[dict]) -> list[dict]:
+    """Spans of ONE trace → forest of ``{**span, "children": [...]}``
+    ordered by start time. A span whose parent is missing (evicted
+    from the ring, or the root) becomes a top-level node — a truncated
+    trace still renders instead of vanishing."""
+    nodes = {
+        s["span_id"]: {**s, "children": []}
+        for s in sorted(spans, key=lambda s: s.get("start", 0.0))
+    }
+    roots: list[dict] = []
+    for node in nodes.values():
+        parent = nodes.get(node.get("parent_id") or "")
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def trace_summaries(spans: list[dict], limit: int = 50) -> list[dict]:
+    """One row per trace, newest first — the ``/debug/traces`` index."""
+    out = []
+    for trace_id, group in _by_trace(spans).items():
+        start = min(s.get("start", 0.0) for s in group)
+        end = max(s.get("end", 0.0) for s in group)
+        root = next(
+            (s for s in group if not s.get("parent_id")),
+            min(group, key=lambda s: s.get("start", 0.0)),
+        )
+        out.append({
+            "trace_id": trace_id,
+            "root": root.get("name", ""),
+            "spans": len(group),
+            "errors": sum(1 for s in group if s.get("status") == "error"),
+            "start": start,
+            "duration_ms": round((end - start) * 1000, 3),
+        })
+    out.sort(key=lambda row: row["start"], reverse=True)
+    return out[:limit]
+
+
+def timeline(spans: list[dict], namespace: str, name: str) -> dict | None:
+    """The most recent trace that touched object (namespace, name) —
+    matched on span attributes — as a span tree. None when no trace
+    knows the object."""
+    touching = [
+        s for s in spans
+        if s.get("attributes", {}).get("namespace") == namespace
+        and s.get("attributes", {}).get("name") == name
+    ]
+    if not touching:
+        return None
+    latest = max(touching, key=lambda s: s.get("start", 0.0))
+    trace_id = latest.get("trace_id", "")
+    group = [s for s in spans if s.get("trace_id") == trace_id]
+    return {
+        "trace_id": trace_id,
+        "namespace": namespace,
+        "name": name,
+        "spans": len(group),
+        "tree": span_tree(group),
+    }

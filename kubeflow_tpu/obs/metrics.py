@@ -1,0 +1,109 @@
+"""Metric primitives + the platform-wide label schema (stdlib only).
+
+``BucketHistogram`` is a thread-safe cumulative-bucket histogram for
+code that must not depend on prometheus_client (the k8s client, the
+workqueue): collectors render its snapshot as a real Prometheus
+histogram family at scrape time.
+
+``CANONICAL_LABELS`` is the single label vocabulary every registry in
+the platform draws from — asserted by tests/test_obs.py across the
+controller-manager, dashboard and CRUD-app registries, so dashboards
+can join series across components without per-exporter relabeling.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# The only label names any platform collector may use. Object identity
+# is always spelled namespace/name/controller (never ns/nb/component);
+# the rest are enumerated per-metric dimensions. "le"/"quantile" are
+# the exposition-format internals histograms/summaries emit.
+CANONICAL_LABELS = frozenset({
+    "namespace", "name", "controller",
+    "accelerator", "verb", "kind", "result", "mode", "severity",
+    "method", "endpoint", "code",
+    "le", "quantile",
+})
+
+# Default bounds. Queue latency and reconcile duration share the
+# controller-runtime-ish spread (sub-ms dedup hits up to parked-retry
+# minutes); apiserver round-trips top out lower.
+LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+REQUEST_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class BucketHistogram:
+    """Fixed-bucket cumulative histogram: observe / snapshot / quantile.
+
+    The snapshot is exposition-shaped — cumulative counts per upper
+    bound, "+Inf" last — so a custom collector can hand it straight to
+    ``HistogramMetricFamily.add_metric``."""
+
+    def __init__(self, buckets: tuple[float, ...] = LATENCY_BUCKETS):
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+        if not self._bounds:
+            raise ValueError("at least one bucket bound required")
+        self._counts = [0] * (len(self._bounds) + 1)  # +1 = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = len(self._bounds)
+        for i, bound in enumerate(self._bounds):
+            if value <= bound:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self) -> dict:
+        """{"count", "sum", "buckets": [("0.005", cum), ..., ("+Inf", n)]}"""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            acc_sum = self._sum
+        buckets: list[tuple[str, int]] = []
+        cumulative = 0
+        for bound, count in zip(self._bounds, counts):
+            cumulative += count
+            buckets.append((repr(bound), cumulative))
+        buckets.append(("+Inf", total))
+        return {"count": total, "sum": acc_sum, "buckets": buckets}
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket containing the q-quantile (the
+        usual histogram-quantile resolution); inf when it landed in
+        the overflow bucket, 0.0 when empty."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        target = max(1, int(q * total + 0.5))
+        cumulative = 0
+        for bound, count in zip(self._bounds, counts):
+            cumulative += count
+            if cumulative >= target:
+                return bound
+        return float("inf")

@@ -1,0 +1,173 @@
+"""StepTelemetry: per-step training telemetry (wall time, examples/sec,
+MFU) from the same pipeline operators scrape.
+
+BENCH numbers and dashboards previously came from disjoint code paths;
+this hook is the single meter: the training loop (models/train.py) or
+the bench harness (bench.py) calls :meth:`observe` once per step, and
+the same record fans out to
+
+- an in-memory list (``records``) the caller aggregates,
+- JSONL (``OBS_JSONL_PATH`` or an explicit path) for offline analysis,
+- Prometheus gauges (lazily imported; absent prometheus_client
+  degrades to the first two sinks).
+
+MFU uses the per-topology peak-FLOPs tables in
+:mod:`kubeflow_tpu.topology` — per-chip peak by default, the
+whole-slice peak when the caller passes ``chips``. Off-TPU (CPU smoke
+runs) the nominal host peak keeps MFU finite; the value is only
+meaningful on the real accelerator.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Callable
+
+
+class StepTelemetry:
+    def __init__(
+        self,
+        flops_per_example: float,
+        peak_flops: float | None = None,
+        device_kind: str = "",
+        chips: int = 1,
+        jsonl_path: str | None = None,
+        registry=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        from kubeflow_tpu import topology
+
+        self.flops_per_example = float(flops_per_example)
+        if peak_flops is None:
+            peak_flops = topology.peak_flops_for_device_kind(device_kind)
+        self.peak_flops = float(peak_flops) * max(1, int(chips))
+        self.device_kind = device_kind
+        self.jsonl_path = (
+            jsonl_path if jsonl_path is not None
+            else os.environ.get("OBS_JSONL_PATH")
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._step = 0
+        self.records: list[dict] = []
+        self._gauges = self._make_gauges(registry)
+        # One JSONL discipline for the whole obs package: the sink IS
+        # a JsonlExporter (guarded makedirs, locked appends); only the
+        # disable-on-OSError posture is telemetry's own.
+        self._jsonl = None
+        if self.jsonl_path:
+            from kubeflow_tpu.obs.export import JsonlExporter
+
+            self._jsonl = JsonlExporter(self.jsonl_path)
+
+    def _make_gauges(self, registry):
+        try:
+            from prometheus_client import CollectorRegistry, Counter, Gauge
+        except ImportError:  # minimal worker images: JSONL-only
+            self.registry = None
+            return None
+        self.registry = registry or CollectorRegistry()
+        return {
+            "step_time": Gauge(
+                "training_step_time_seconds",
+                "Wall time of the most recent training step",
+                registry=self.registry,
+            ),
+            "examples": Gauge(
+                "training_examples_per_sec",
+                "Throughput of the most recent training step",
+                registry=self.registry,
+            ),
+            "mfu": Gauge(
+                "training_mfu",
+                "Model FLOPs utilization of the most recent step "
+                "(achieved / peak bf16 FLOPs)",
+                registry=self.registry,
+            ),
+            "steps": Counter(
+                "training_steps",
+                "Training steps observed by this process",
+                registry=self.registry,
+            ),
+        }
+
+    # ---- recording -------------------------------------------------------
+    def observe(
+        self,
+        batch_size: int,
+        step_time_s: float,
+        step: int | None = None,
+        **extra,
+    ) -> dict:
+        """Record one completed step (host-synced wall time). Returns
+        the record that was emitted."""
+        step_time_s = max(float(step_time_s), 1e-12)
+        examples_per_sec = batch_size / step_time_s
+        mfu = examples_per_sec * self.flops_per_example / self.peak_flops
+        with self._lock:
+            if step is None:
+                step = self._step
+            self._step = step + 1
+        record = {
+            "kind": "step_telemetry",
+            "ts": self._clock(),
+            "step": step,
+            "batch_size": batch_size,
+            "step_time_s": round(step_time_s, 6),
+            "examples_per_sec": round(examples_per_sec, 3),
+            "mfu": round(mfu, 6),
+            "flops_per_example": self.flops_per_example,
+            "peak_flops": self.peak_flops,
+            "device": self.device_kind,
+            **extra,
+        }
+        with self._lock:
+            self.records.append(record)
+        if self._gauges is not None:
+            self._gauges["step_time"].set(step_time_s)
+            self._gauges["examples"].set(examples_per_sec)
+            self._gauges["mfu"].set(mfu)
+            self._gauges["steps"].inc()
+        if self._jsonl is not None:
+            try:
+                self._jsonl.export(record)
+            except OSError:
+                # Telemetry must never fail the step it measures
+                # (read-only checkout, full disk): in-memory and
+                # gauge sinks already carry the record.
+                self._jsonl = None
+                self.jsonl_path = None
+        return record
+
+    @contextlib.contextmanager
+    def timed(self, batch_size: int, **extra):
+        """``with telemetry.timed(batch):`` around one host-synced step."""
+        t0 = time.perf_counter()
+        yield
+        self.observe(batch_size, time.perf_counter() - t0, **extra)
+
+    # ---- aggregation -----------------------------------------------------
+    def summary(self) -> dict:
+        """Median-of-steps aggregate (first step excluded when there is
+        more than one — it carries compile/dispatch warmup)."""
+        with self._lock:
+            records = list(self.records)
+        if not records:
+            return {"steps": 0}
+        steady = records[1:] if len(records) > 1 else records
+        times = sorted(r["step_time_s"] for r in steady)
+        mid = times[len(times) // 2]
+        batch = steady[-1]["batch_size"]
+        examples = batch / mid
+        return {
+            "steps": len(records),
+            "median_step_time_s": round(mid, 6),
+            "examples_per_sec": round(examples, 3),
+            "mfu": round(
+                examples * self.flops_per_example / self.peak_flops, 6
+            ),
+            "device": self.device_kind,
+        }

@@ -1,0 +1,296 @@
+"""Spans and W3C trace-context propagation (stdlib only).
+
+The shape is OpenTelemetry's, cut down to what the platform threads
+through its own processes: a ``Span`` is a named interval with
+attributes, timestamped events and an error status; a ``Tracer`` mints
+spans, tracks the current one on a ``contextvars.ContextVar`` (so
+propagation crosses function boundaries without plumbing arguments),
+samples at the root, and hands finished spans to exporters.
+
+Context crosses process boundaries two ways:
+
+- synchronously, on the W3C ``traceparent`` header
+  (``00-<32 hex trace id>-<16 hex span id>-<2 hex flags>``) — parse
+  with :func:`parse_traceparent`, emit with :func:`format_traceparent`;
+- asynchronously, through etcd: the spawner stamps the same header
+  value into the :data:`TRACE_ANNOTATION` metadata annotation on the
+  CR it creates, and the controller runtime parents its reconcile
+  spans on it — the only way a trace can follow a request across the
+  watch/workqueue gap, where no HTTP headers exist.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import re
+import threading
+import time
+from typing import Callable
+
+# Metadata annotation carrying a traceparent value across the async
+# hop (spawner POST -> CR -> watch event -> reconcile).
+TRACE_ANNOTATION = "obs.kubeflow-tpu.org/traceparent"
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})"
+    r"(?:-[^\s]*)?$"
+)
+
+_CURRENT: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "kubeflow_tpu_obs_current_span", default=None
+)
+
+
+class SpanContext:
+    """The propagated identity of a span: (trace id, span id, sampled).
+
+    Immutable; ``sampled`` rides the traceparent flags byte (bit 0) so
+    a sampling decision made at the edge holds across every process the
+    trace visits."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def __repr__(self):
+        return (f"SpanContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, sampled={self.sampled})")
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """W3C traceparent → SpanContext, or None for anything malformed.
+
+    Per the spec: exactly-sized lowercase hex fields, version ``ff``
+    invalid, all-zero trace or span id invalid. Trailing fields from
+    future versions are tolerated; a malformed header NEVER raises —
+    the caller just starts a fresh trace."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff":
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    try:
+        sampled = bool(int(flags, 16) & 0x01)
+    except ValueError:  # unreachable given the regex, but never raise
+        return None
+    return SpanContext(trace_id, span_id, sampled)
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return (f"00-{ctx.trace_id}-{ctx.span_id}-"
+            f"{'01' if ctx.sampled else '00'}")
+
+
+def current_span() -> "Span | None":
+    """The span active on this thread/context, or None."""
+    return _CURRENT.get()
+
+
+class Span:
+    """One named interval. Mutate only before :meth:`end` (the tracer's
+    context manager ends it); ``to_dict`` is the export form."""
+
+    def __init__(
+        self,
+        name: str,
+        context: SpanContext,
+        parent_id: str | None,
+        clock: Callable[[], float],
+        on_end: Callable[["Span"], None],
+        attributes: dict | None = None,
+    ):
+        self.name = name
+        self.context = context
+        self.parent_id = parent_id
+        self.attributes: dict = dict(attributes or {})
+        self.events: list[dict] = []
+        self.status = "ok"
+        self.start_time = clock()
+        self.end_time: float | None = None
+        self._clock = clock
+        self._on_end = on_end
+        self._ended = False
+
+    # ---- mutation --------------------------------------------------------
+    def set_attribute(self, key: str, value) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def add_event(self, name: str, attributes: dict | None = None) -> "Span":
+        self.events.append({
+            "name": name,
+            "time": self._clock(),
+            "attributes": dict(attributes or {}),
+        })
+        return self
+
+    def record_exception(self, exc: BaseException) -> "Span":
+        self.status = "error"
+        return self.add_event("exception", {
+            "type": type(exc).__name__,
+            "message": str(exc)[:300],
+        })
+
+    def end(self) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        self.end_time = self._clock()
+        self._on_end(self)
+
+    # ---- export ----------------------------------------------------------
+    def to_dict(self) -> dict:
+        end = self.end_time if self.end_time is not None else self._clock()
+        return {
+            "name": self.name,
+            "trace_id": self.context.trace_id,
+            "span_id": self.context.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start_time,
+            "end": end,
+            "duration_ms": round((end - self.start_time) * 1000, 3),
+            "status": self.status,
+            "attributes": dict(self.attributes),
+            "events": list(self.events),
+        }
+
+
+# Distinguishes "no parent passed: inherit the current span" from an
+# explicit parent=None ("start a new root trace").
+_INHERIT = object()
+
+
+class Tracer:
+    """Span factory + context manager + sampling + export fan-out.
+
+    Always keeps a bounded in-memory ring of finished spans (the
+    ``/debug/traces`` data source); an optional extra exporter (JSONL)
+    receives the same stream. Head-based sampling: the decision is
+    drawn once at the root (``OBS_TRACE_SAMPLE``) and inherited by
+    children and remote continuations via the traceparent flags, so a
+    trace is always complete-or-absent, never ragged."""
+
+    def __init__(
+        self,
+        exporter=None,
+        sample_rate: float | None = None,
+        ring_capacity: int | None = None,
+        clock: Callable[[], float] = time.time,
+        rng: random.Random | None = None,
+    ):
+        from kubeflow_tpu.obs.export import RingExporter
+
+        if sample_rate is None:
+            try:
+                sample_rate = float(os.environ.get("OBS_TRACE_SAMPLE", "1"))
+            except ValueError:
+                sample_rate = 1.0
+        if ring_capacity is None:
+            try:
+                ring_capacity = int(
+                    os.environ.get("OBS_RING_CAPACITY", "512")
+                )
+            except ValueError:
+                ring_capacity = 512
+        self.sample_rate = min(max(sample_rate, 0.0), 1.0)
+        self.ring = RingExporter(capacity=ring_capacity)
+        self.exporter = exporter
+        self.clock = clock
+        # Seedable for deterministic sampling tests; lock-protected —
+        # random.Random is not thread-safe and spans start on watch,
+        # server and worker threads concurrently.
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+
+    # ---- ids -------------------------------------------------------------
+    @staticmethod
+    def _new_trace_id() -> str:
+        return os.urandom(16).hex()
+
+    @staticmethod
+    def _new_span_id() -> str:
+        return os.urandom(8).hex()
+
+    def _sampled(self) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < self.sample_rate
+
+    # ---- span lifecycle --------------------------------------------------
+    def start_span(
+        self,
+        name: str,
+        parent: "SpanContext | Span | None" = _INHERIT,
+        attributes: dict | None = None,
+    ) -> Span:
+        """Start (but do not activate) a span. ``parent`` defaults to
+        the current span; pass an explicit SpanContext (remote parent)
+        or None (force a new root)."""
+        if parent is _INHERIT:
+            cur = _CURRENT.get()
+            parent = cur.context if cur is not None else None
+        elif isinstance(parent, Span):
+            parent = parent.context
+        if parent is None:
+            ctx = SpanContext(
+                self._new_trace_id(), self._new_span_id(), self._sampled()
+            )
+            parent_id = None
+        else:
+            ctx = SpanContext(
+                parent.trace_id, self._new_span_id(), parent.sampled
+            )
+            parent_id = parent.span_id
+        return Span(
+            name, ctx, parent_id, clock=self.clock, on_end=self._export,
+            attributes=attributes,
+        )
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        parent: "SpanContext | Span | None" = _INHERIT,
+        attributes: dict | None = None,
+    ):
+        """``with tracer.span("reconcile") as sp:`` — activates the
+        span on the current context, records an uncaught exception as
+        an error status, always ends + exports."""
+        sp = self.start_span(name, parent=parent, attributes=attributes)
+        token = _CURRENT.set(sp)
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.record_exception(exc)
+            raise
+        finally:
+            _CURRENT.reset(token)
+            sp.end()
+
+    def _export(self, span: Span) -> None:
+        if not span.context.sampled:
+            return
+        doc = span.to_dict()
+        self.ring.export(doc)
+        if self.exporter is not None:
+            try:
+                self.exporter.export(doc)
+            except Exception:  # analysis: allow[py-broad-except]
+                # Telemetry must never take down the traced code path:
+                # a full disk under OBS_JSONL_PATH drops spans, not
+                # requests.
+                pass

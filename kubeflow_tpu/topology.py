@@ -29,14 +29,51 @@ class Accelerator:
     ndims: int                # ICI torus dimensionality (2 or 3)
     chips_per_host: int       # chips per VM in multi-host slices
     max_single_host_chips: int  # largest slice that fits one host
+    peak_bf16_flops: float    # per-chip dense bf16 peak, FLOP/s
 
 
 ACCELERATORS: dict[str, Accelerator] = {
-    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4),
-    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8),
-    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4),
-    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8),
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 275e12),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 197e12),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 459e12),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 918e12),
 }
+
+# jax ``device.device_kind`` substrings → accelerator short name.
+# Longest match wins ("v5 lite" must beat "v5"); the spellings are the
+# ones PJRT has actually reported across runtime versions.
+_DEVICE_KIND_PATTERNS: dict[str, str] = {
+    "v5 lite": "v5e", "v5litepod": "v5e", "v5e": "v5e",
+    "v6 lite": "v6e", "v6e": "v6e",
+    "v5p": "v5p", "v5": "v5p",
+    "v4": "v4",
+}
+
+# MFU denominator for non-TPU smoke runs (CPU tier-1, laptops): a
+# nominal finite peak so telemetry stays well-defined — the absolute
+# MFU value is meaningless off-TPU, finiteness is the contract.
+NOMINAL_HOST_PEAK_FLOPS = 197e12
+
+
+def accelerator_for_device_kind(kind: str) -> Accelerator | None:
+    """Map a jax ``device_kind`` string to the accelerator table entry,
+    or None for non-TPU devices."""
+    kind = (kind or "").lower()
+    for pattern, name in sorted(
+        _DEVICE_KIND_PATTERNS.items(), key=lambda kv: -len(kv[0])
+    ):
+        if pattern in kind:
+            return ACCELERATORS[name]
+    return None
+
+
+def peak_flops_for_device_kind(
+    kind: str, default: float = NOMINAL_HOST_PEAK_FLOPS
+) -> float:
+    """Per-chip bf16 peak FLOP/s for a jax device kind — the single
+    MFU denominator shared by bench.py and obs.telemetry."""
+    acc = accelerator_for_device_kind(kind)
+    return acc.peak_bf16_flops if acc is not None else default
 
 # Canonical topology string for a chip count (2-D generations).
 _TOPO_2D = {
@@ -126,6 +163,12 @@ class TpuSlice:
     @property
     def is_multihost(self) -> bool:
         return self.num_hosts > 1
+
+    @property
+    def peak_bf16_flops(self) -> float:
+        """Whole-slice dense bf16 peak — the MFU denominator for a
+        workload spanning every chip in the slice."""
+        return self.chips * self.accelerator.peak_bf16_flops
 
     @property
     def shorthand(self) -> str:

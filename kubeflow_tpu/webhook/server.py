@@ -20,7 +20,7 @@ import threading
 import urllib.parse
 from typing import Callable
 
-from kubeflow_tpu import native
+from kubeflow_tpu import native, obs
 
 log = logging.getLogger(__name__)
 
@@ -232,7 +232,37 @@ class WebhookServer:
                 except json.JSONDecodeError:
                     self.send_error(400, "bad JSON")
                     return
-                reply = json.dumps(review_fn(review)).encode()
+                # Admission sits inside the pod-create critical path:
+                # wrap the review in a span (continuing an upstream
+                # traceparent when the caller sends one) so the
+                # mutate/reject decision and its latency land in the
+                # same trace as the reconcile that triggered it.
+                request = review.get("request") or {}
+                parent = obs.parse_traceparent(
+                    self.headers.get("traceparent")
+                )
+                with obs.get_tracer().span(
+                    f"admission {path.rstrip('/')}",
+                    parent=parent,
+                    attributes={
+                        "namespace": request.get("namespace", ""),
+                        "name": request.get("name", ""),
+                        "kind": (request.get("kind") or {}).get(
+                            "kind", ""
+                        ),
+                    },
+                ) as span:
+                    out = review_fn(review)
+                    response = out.get("response") or {}
+                    span.set_attribute(
+                        "allowed", bool(response.get("allowed"))
+                    )
+                    span.set_attribute(
+                        "patched", bool(response.get("patch"))
+                    )
+                    if not response.get("allowed"):
+                        span.status = "error"
+                reply = json.dumps(out).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(reply)))

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Observability gate for CI (PR 3). Two checks:
+#
+# 1. Exposition integrity: every platform registry (controller-manager,
+#    jupyter CRUD app, dashboard) must parse cleanly with
+#    prometheus_client.parser — no duplicate families, no invalid
+#    lines — and use only the canonical label schema
+#    (kubeflow_tpu.obs.CANONICAL_LABELS).
+#
+# 2. Log discipline: the obs/resilience tier-1 subset runs with
+#    testing/obs_log_plugin.py attached; any kubeflow_tpu.* record
+#    that the structured JSON formatter cannot render with the schema
+#    core (ts/level/logger/msg) fails the gate. Pairs with the
+#    analyzer's py-print-in-lib rule: prints never reach loggers, so
+#    the two checks together cover both escape routes.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== obs gate: /metrics exposition integrity =="
+python - <<'PY'
+from prometheus_client import generate_latest
+from prometheus_client.parser import text_string_to_metric_families
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.apps.jupyter import create_app as create_jwa
+from kubeflow_tpu.controllers.metrics import ControllerMetrics
+from kubeflow_tpu.dashboard import create_app as create_dash
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+failures = []
+api = FakeApiServer()
+registries = {
+    "controller-manager": ControllerMetrics(api=api).registry,
+    "jupyter": create_jwa(api, secure_cookies=False).registry,
+    "dashboard": create_dash(api, secure_cookies=False).registry,
+}
+for origin, registry in registries.items():
+    text = generate_latest(registry).decode()
+    try:
+        families = list(text_string_to_metric_families(text))
+    except ValueError as exc:
+        failures.append(f"{origin}: exposition does not parse: {exc}")
+        continue
+    names = [f.name for f in families]
+    for name in sorted({n for n in names if names.count(n) > 1}):
+        failures.append(f"{origin}: duplicate metric family {name!r}")
+    for family in families:
+        for sample in family.samples:
+            bad = set(sample.labels) - obs.CANONICAL_LABELS
+            if bad:
+                failures.append(
+                    f"{origin}: {sample.name} uses non-canonical "
+                    f"label(s) {sorted(bad)}"
+                )
+    print(f"  {origin}: {len(families)} families ok")
+if failures:
+    print("\n".join(failures))
+    raise SystemExit(1)
+PY
+
+echo "== obs gate: structured-log discipline over tier-1 subset =="
+REPORT="$(mktemp)"
+rm -f "$REPORT"
+KFT_OBS_LOG_REPORT="$REPORT" PYTHONPATH="testing${PYTHONPATH:+:$PYTHONPATH}" \
+  python -m pytest tests/test_obs.py tests/test_resilience.py \
+  -q -m 'not slow' -p obs_log_plugin
+
+if [[ -s "$REPORT" ]]; then
+  echo "unstructured log records from kubeflow_tpu.* loggers:"
+  cat "$REPORT"
+  rm -f "$REPORT"
+  exit 1
+fi
+rm -f "$REPORT"
+echo "obs gate: OK"

@@ -1,0 +1,56 @@
+"""Pytest plugin for the observability gate (obs_gate.sh): every record
+emitted by a ``kubeflow_tpu.*`` logger during the run must render as a
+valid structured JSON object with the schema core (ts/level/logger/msg)
+— i.e. telemetry flows through the structured formatter, not ad-hoc
+formats that log shippers cannot index.
+
+Loaded with ``pytest -p obs_log_plugin`` (PYTHONPATH=testing). Failures
+are appended to the file named by ``KFT_OBS_LOG_REPORT`` (one line per
+offending record); the gate script fails the build when that file is
+non-empty. Reporting via a file keeps the plugin inert under plain
+pytest runs — it observes, the gate enforces.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from kubeflow_tpu.obs.logging import SCHEMA_KEYS, JsonLogFormatter
+
+_violations: list[str] = []
+
+
+class _SchemaCheckHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self._formatter = JsonLogFormatter()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            doc = json.loads(self._formatter.format(record))
+            missing = [k for k in SCHEMA_KEYS if k not in doc]
+            if missing:
+                raise ValueError(f"missing schema keys {missing}")
+        except Exception as exc:  # analysis: allow[py-broad-except]
+            # The whole point of this handler is to RECORD formatter
+            # failures, never to raise from inside logging.
+            _violations.append(
+                f"{record.name} ({record.pathname}:{record.lineno}): "
+                f"unstructured record: {exc}"
+            )
+
+
+def pytest_configure(config):
+    logging.getLogger("kubeflow_tpu").addHandler(_SchemaCheckHandler())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    report = os.environ.get("KFT_OBS_LOG_REPORT")
+    if not report:
+        return
+    if _violations:
+        with open(report, "a", encoding="utf-8") as fh:
+            for line in _violations:
+                fh.write(line + "\n")

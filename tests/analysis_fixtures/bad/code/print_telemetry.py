@@ -1,0 +1,7 @@
+"""Seeded violation: bare print() as telemetry in library code —
+records bypass the structured JSON logger (no schema, no trace ids,
+no level filtering)."""
+
+
+def report_progress(step, loss):
+    print(f"step {step}: loss={loss}")

@@ -155,6 +155,44 @@ class TestSeededViolations:
         assert "continue in the except handler" in reasons
         assert "swallowing except handler" in reasons
 
+    def test_print_in_lib(self, bad_findings):
+        (f,) = by_rule(bad_findings, "py-print-in-lib")
+        assert f.severity == Severity.WARNING
+        assert f.path.endswith("print_telemetry.py")
+        assert "structured logger" in f.message
+
+
+class TestPrintRuleExemptions:
+    """py-print-in-lib fires on library modules only: scripts own
+    their stdout."""
+
+    def _findings(self, source, path):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-print-in-lib"
+        ]
+
+    def test_library_module_fires(self):
+        src = "def f():\n    print('x')\n"
+        assert len(self._findings(src, "kubeflow_tpu/foo.py")) == 1
+
+    def test_main_guard_script_is_exempt(self):
+        src = (
+            "def f():\n    print('x')\n\n"
+            "if __name__ == '__main__':\n    f()\n"
+        )
+        assert self._findings(src, "kubeflow_tpu/tool.py") == []
+
+    def test_dunder_main_is_exempt(self):
+        src = "print('report')\n"
+        assert self._findings(src, "kubeflow_tpu/analysis/__main__.py") == []
+
+    def test_tests_dir_is_exempt(self):
+        src = "print('debug')\n"
+        assert self._findings(src, "tests/distributed_worker.py") == []
+
 
 class TestCleanFixtures:
     def test_clean_tree_is_silent(self):

@@ -1,0 +1,893 @@
+"""Observability tier: spans + W3C propagation, exporters, structured
+logs, latency histograms, debug endpoints, step telemetry — and the
+end-to-end trace contract: one user action (spawner POST) is followable
+through the CR annotation into reconcile and down to the apiserver call
+where a chaos-injected 503 visibly fired and was retried.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import io
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule, run_to_convergence
+from kubeflow_tpu.chaos import schedule as sched
+from kubeflow_tpu.chaos.harness import clamp_backoff
+from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.controllers.runtime import Request, WorkQueue
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.obs.export import load_jsonl
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    """A private tracer (ring + JSONL) installed as the process
+    default, restored after the test."""
+    t = obs.Tracer(
+        exporter=obs.JsonlExporter(str(tmp_path / "spans.jsonl")),
+        ring_capacity=4096,
+        sample_rate=1.0,
+    )
+    obs.set_tracer(t)
+    yield t
+    obs.set_tracer(None)
+
+
+def http_get(url, headers=None, timeout=5.0):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ---------------------------------------------------------------------------
+# traceparent parse / format
+# ---------------------------------------------------------------------------
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        ctx = obs.SpanContext("ab" * 16, "cd" * 8, sampled=True)
+        parsed = obs.parse_traceparent(obs.format_traceparent(ctx))
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+        assert parsed.sampled is True
+
+    def test_unsampled_flag_round_trips(self):
+        ctx = obs.SpanContext("ab" * 16, "cd" * 8, sampled=False)
+        header = obs.format_traceparent(ctx)
+        assert header.endswith("-00")
+        assert obs.parse_traceparent(header).sampled is False
+
+    @pytest.mark.parametrize("header", [
+        None, "", "garbage", "00", "00-abc",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # invalid version
+        "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",  # uppercase hex
+        "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",  # short trace id
+        "00-" + "ab" * 16 + "-" + "cd" * 7 + "-01",  # short span id
+        "00-" + "ab" * 16 + "-" + "cd" * 8,          # missing flags
+        "00_" + "ab" * 16 + "_" + "cd" * 8 + "_01",  # wrong separators
+        "zz-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # non-hex version
+        42, b"00-" + b"ab" * 16,                     # wrong types
+    ])
+    def test_malformed_headers_never_raise(self, header):
+        assert obs.parse_traceparent(header) is None
+
+    def test_future_version_with_extra_fields_accepted(self):
+        header = "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extrastuff"
+        parsed = obs.parse_traceparent(header)
+        assert parsed is not None and parsed.trace_id == "ab" * 16
+
+
+# ---------------------------------------------------------------------------
+# tracer / spans / exporters
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_nesting_and_current_span(self, tracer):
+        with tracer.span("outer") as outer:
+            assert obs.current_span() is outer
+            with tracer.span("inner") as inner:
+                assert obs.current_span() is inner
+                assert inner.context.trace_id == outer.context.trace_id
+                assert inner.parent_id == outer.context.span_id
+            assert obs.current_span() is outer
+        assert obs.current_span() is None
+
+    def test_remote_parent_continues_trace(self, tracer):
+        remote = obs.SpanContext("ab" * 16, "cd" * 8)
+        with tracer.span("reconcile", parent=remote) as sp:
+            assert sp.context.trace_id == remote.trace_id
+            assert sp.parent_id == remote.span_id
+
+    def test_exception_recorded_and_status_error(self, tracer):
+        with pytest.raises(ValueError):
+            with tracer.span("boom"):
+                raise ValueError("bad")
+        (span,) = tracer.ring.spans()
+        assert span["status"] == "error"
+        (event,) = span["events"]
+        assert event["name"] == "exception"
+        assert event["attributes"]["type"] == "ValueError"
+
+    def test_sample_rate_zero_propagates_but_exports_nothing(self):
+        t = obs.Tracer(sample_rate=0.0)
+        with t.span("root") as root:
+            assert root.context.sampled is False
+            with t.span("child") as child:
+                # Context still flows (remote hops see a traceparent
+                # with flags 00) even though nothing is exported.
+                assert child.context.trace_id == root.context.trace_id
+        assert t.ring.spans() == []
+
+    def test_ring_buffer_is_bounded_and_keeps_newest(self):
+        t = obs.Tracer(ring_capacity=8)
+        for i in range(50):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.ring.spans()
+        assert len(spans) == 8
+        assert [s["name"] for s in spans] == [f"s{i}" for i in range(42, 50)]
+
+    def test_jsonl_exporter_round_trips(self, tmp_path, tracer):
+        with tracer.span("a", attributes={"k": "v"}):
+            pass
+        spans = load_jsonl(str(tmp_path / "spans.jsonl"))
+        assert [s["name"] for s in spans] == ["a"]
+        assert spans[0]["attributes"] == {"k": "v"}
+        assert spans[0]["end"] >= spans[0]["start"]
+
+
+# ---------------------------------------------------------------------------
+# structured JSON logging
+# ---------------------------------------------------------------------------
+
+
+class TestJsonLogging:
+    def make_logger(self, name="kubeflow_tpu.obs_test"):
+        stream = io.StringIO()
+        logger = logging.getLogger(name)
+        logger.handlers = [logging.StreamHandler(stream)]
+        logger.handlers[0].setFormatter(obs.JsonLogFormatter())
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+        return logger, stream
+
+    def last_record(self, stream):
+        return json.loads(stream.getvalue().strip().splitlines()[-1])
+
+    def test_schema_keys_present(self):
+        logger, stream = self.make_logger()
+        logger.warning("queue %s is deep", "notebook")
+        doc = self.last_record(stream)
+        assert doc["level"] == "WARNING"
+        assert doc["logger"] == "kubeflow_tpu.obs_test"
+        assert doc["msg"] == "queue notebook is deep"
+        assert "T" in doc["ts"] and doc["ts"].endswith("Z")
+
+    def test_trace_ids_stamped_inside_span(self, tracer):
+        logger, stream = self.make_logger()
+        with tracer.span("op") as span:
+            logger.info("inside")
+        doc = self.last_record(stream)
+        assert doc["trace_id"] == span.context.trace_id
+        assert doc["span_id"] == span.context.span_id
+        logger.info("outside")
+        assert "trace_id" not in self.last_record(stream)
+
+    def test_extra_fields_and_exceptions(self):
+        logger, stream = self.make_logger()
+        try:
+            raise RuntimeError("kaput")
+        except RuntimeError:
+            logger.exception("failed", extra={"namespace": "user"})
+        doc = self.last_record(stream)
+        assert doc["namespace"] == "user"
+        assert "RuntimeError: kaput" in doc["exc"]
+
+    def test_unserializable_extra_degrades_to_repr(self):
+        logger, stream = self.make_logger()
+        logger.info("obj", extra={"thing": object()})
+        doc = self.last_record(stream)
+        assert "object object" in doc["thing"]
+
+    def test_configure_is_idempotent(self):
+        name = "kubeflow_tpu.obs_test_cfg"
+        h1 = obs.configure_structured_logging(logger_name=name)
+        h2 = obs.configure_structured_logging(logger_name=name)
+        assert h1 is h2
+        logging.getLogger(name).handlers = []
+
+
+# ---------------------------------------------------------------------------
+# workqueue latency (satellite: enqueue timestamps)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class TestQueueLatency:
+    R1 = Request("ns", "a")
+
+    def patch_clock(self, monkeypatch, clock):
+        import kubeflow_tpu.controllers.runtime as runtime
+
+        monkeypatch.setattr(runtime.time, "monotonic", clock)
+
+    def test_wait_measured_due_to_dequeue(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+        waits = []
+        q.latency_observer = waits.append
+        q.add(self.R1)
+        clock.advance(0.2)
+        assert q.pop_ready() == self.R1
+        assert waits == [pytest.approx(0.2)]
+        snap = q.latency_snapshot()
+        assert snap["count"] == 1
+        assert snap["p50"] == pytest.approx(0.25)  # bucket upper bound
+        assert snap["p99"] == pytest.approx(0.25)
+
+    def test_earlier_readd_pulls_due_time_forward(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+        q.add(self.R1, delay=10.0)  # scheduled for later
+        q.add(self.R1)              # watch event: due NOW
+        waits = []
+        q.latency_observer = waits.append
+        clock.advance(0.5)
+        assert q.pop_ready() == self.R1
+        # Wait runs from the moment it became due, not the original
+        # not_before 10s out.
+        assert waits == [pytest.approx(0.5)]
+
+    def test_scheduled_delay_and_backoff_excluded_from_wait(
+        self, monkeypatch
+    ):
+        """controller-runtime AddAfter semantics: a deliberate
+        requeue_after or a parked backoff must NOT read as queue
+        latency — only the time past due does, or the histogram pins
+        at +Inf on perfectly healthy periodic reconcilers."""
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue(base_delay=4.0)
+        waits = []
+        q.latency_observer = waits.append
+        q.add(self.R1, delay=300.0)  # periodic requeue_after
+        clock.advance(300.5)
+        assert q.pop_ready() == self.R1
+        q.add_rate_limited(self.R1)  # parked 4s of backoff
+        clock.advance(5.0)
+        assert q.pop_ready() == self.R1
+        assert waits == [pytest.approx(0.5), pytest.approx(1.0)]
+
+    def test_earliest_deadline_semantics_still_hold(self, monkeypatch):
+        """The PR-2 guarantee rides along: a rate-limited re-add must
+        not push back an already-due item, timestamps or not."""
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue(base_delay=5.0)
+        q.add(self.R1)
+        q.add_rate_limited(self.R1)
+        assert q.pop_ready() == self.R1
+
+    def test_observer_failure_does_not_break_pop(self, monkeypatch):
+        clock = FakeClock()
+        self.patch_clock(monkeypatch, clock)
+        q = WorkQueue()
+
+        def bad_observer(wait):
+            raise RuntimeError("observer bug")
+
+        q.latency_observer = bad_observer
+        q.add(self.R1)
+        assert q.pop_ready() == self.R1
+
+
+# ---------------------------------------------------------------------------
+# latency histograms on /metrics
+# ---------------------------------------------------------------------------
+
+
+class _OkReconciler:
+    def reconcile(self, req):
+        return None
+
+
+class TestLatencyMetrics:
+    def make_controller(self, prom):
+        from kubeflow_tpu.controllers.runtime import Controller, WatchSpec
+
+        api = FakeApiServer()
+        ctrl = Controller(
+            "notebook-controller", api, _OkReconciler(),
+            [WatchSpec(NOTEBOOK_API, "Notebook")], prom=prom,
+        )
+        api.create({
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "user"},
+            "spec": {},
+        })
+        return ctrl
+
+    def test_reconcile_and_queue_histograms_exposed(self):
+        prom = ControllerMetrics()
+        ctrl = self.make_controller(prom)
+        assert ctrl.run_once() >= 1
+        text = prom.exposition().decode()
+        assert ('controller_reconcile_duration_seconds_count'
+                '{controller="notebook-controller"}') in text
+        assert ('workqueue_queue_duration_seconds_count'
+                '{controller="notebook-controller"}') in text
+        # The observed count matches the reconciles actually run.
+        assert ctrl.queue.latency_snapshot()["count"] >= 1
+
+    def test_client_request_duration_family(self):
+        """The client's dependency-free histograms render as a real
+        Prometheus histogram family with a verb label."""
+
+        class _Budget:
+            exhausted_total = 0
+
+        class _Breaker:
+            state = "closed"
+            opens_total = 0
+            fast_fail_total = 0
+
+        class _StubClient:
+            request_metrics = {"requests": 3, "retries": 1}
+            retry_budget = _Budget()
+            breaker = _Breaker()
+
+            def __init__(self):
+                from kubeflow_tpu.obs.metrics import BucketHistogram
+
+                self._hist = BucketHistogram((0.01, 0.1, 1.0))
+                self._hist.observe(0.05)
+                self._hist.observe(0.5)
+
+            def duration_snapshot(self):
+                return {"GET": self._hist.snapshot()}
+
+        from kubeflow_tpu.controllers.metrics import (
+            ClientResilienceCollector,
+        )
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        registry = CollectorRegistry()
+        registry.register(ClientResilienceCollector(_StubClient()))
+        text = generate_latest(registry).decode()
+        assert ('apiserver_client_request_duration_seconds_bucket'
+                '{le="0.1",verb="GET"} 1.0') in text
+        assert ('apiserver_client_request_duration_seconds_count'
+                '{verb="GET"} 2.0') in text
+
+
+# ---------------------------------------------------------------------------
+# label schema (satellite: one vocabulary across every registry)
+# ---------------------------------------------------------------------------
+
+
+class TestLabelSchema:
+    def registries(self):
+        from kubeflow_tpu.apps.jupyter import create_app as create_jwa
+        from kubeflow_tpu.dashboard import create_app as create_dash
+
+        api = FakeApiServer()
+        yield "manager", ControllerMetrics(api=api).registry
+        yield "jupyter", create_jwa(api, secure_cookies=False).registry
+        yield "dashboard", create_dash(api, secure_cookies=False).registry
+
+    def test_all_collectors_use_canonical_labels(self):
+        violations = []
+        for origin, registry in self.registries():
+            for family in registry.collect():
+                for sample in family.samples:
+                    for label in sample.labels:
+                        if label not in obs.CANONICAL_LABELS:
+                            violations.append(
+                                f"{origin}: {sample.name}{{{label}}}"
+                            )
+        assert violations == [], violations
+
+    def test_legacy_component_label_is_gone(self):
+        prom = ControllerMetrics()
+        prom.service_heartbeat.labels("notebook-controller", "info").inc()
+        prom.request_total.labels("notebook-controller", "Notebook").inc()
+        text = prom.exposition().decode()
+        assert 'component=' not in text
+        assert ('service_heartbeat_total'
+                '{controller="notebook-controller",severity="info"}') in text
+
+    def test_dashboard_fleet_gauges_in_app_registry(self):
+        from kubeflow_tpu.dashboard import create_app as create_dash
+        from prometheus_client import generate_latest
+
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {
+                "name": "n1",
+                "labels": {
+                    "cloud.google.com/gke-tpu-accelerator":
+                        "tpu-v5-lite-podslice",
+                },
+            },
+            "status": {"allocatable": {"google.com/tpu": "4"}},
+        })
+        app = create_dash(api, secure_cookies=False)
+        text = generate_latest(app.registry).decode()
+        assert ('tpu_fleet_chips_allocatable'
+                '{accelerator="tpu-v5-lite-podslice"} 4.0') in text
+
+
+# ---------------------------------------------------------------------------
+# /debug/traces + /debug/timeline
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def test_traces_and_timeline(self, tracer):
+        with tracer.span("reconcile", attributes={
+            "controller": "notebook-controller",
+            "namespace": "user", "name": "nb1",
+        }):
+            with tracer.span("api get", attributes={"verb": "get"}):
+                pass
+        server = ManagerServer(
+            ControllerMetrics(), enable_debug=True, tracer=tracer
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body = http_get(base + "/debug/traces")
+            assert status == 200
+            (summary,) = json.loads(body)
+            assert summary["root"] == "reconcile"
+            assert summary["spans"] == 2
+
+            status, body = http_get(base + "/debug/timeline/user/nb1")
+            assert status == 200
+            tl = json.loads(body)
+            assert tl["trace_id"] == summary["trace_id"]
+            (root,) = tl["tree"]
+            assert root["name"] == "reconcile"
+            assert [c["name"] for c in root["children"]] == ["api get"]
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                http_get(base + "/debug/timeline/user/ghost")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_gated_behind_enable_debug(self, tracer):
+        server = ManagerServer(ControllerMetrics(), tracer=tracer)
+        server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                http_get(f"http://127.0.0.1:{server.port}/debug/traces")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# StepTelemetry
+# ---------------------------------------------------------------------------
+
+
+class TestStepTelemetry:
+    def test_records_step_time_examples_and_finite_mfu(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        t = obs.StepTelemetry(
+            flops_per_example=1e9, device_kind="cpu", jsonl_path=path,
+        )
+        record = t.observe(batch_size=8, step_time_s=0.1)
+        assert record["examples_per_sec"] == pytest.approx(80.0)
+        assert record["mfu"] > 0
+        assert record["mfu"] == pytest.approx(
+            80.0 * 1e9 / record["peak_flops"], rel=1e-2
+        )
+        (line,) = load_jsonl(path)
+        assert line["kind"] == "step_telemetry"
+        assert line["step"] == 0
+
+    def test_peak_from_topology_table(self):
+        t = obs.StepTelemetry(
+            flops_per_example=1.0, device_kind="TPU v5 lite"
+        )
+        assert t.peak_flops == 197e12
+        sliced = obs.StepTelemetry(
+            flops_per_example=1.0, device_kind="TPU v5 lite", chips=16
+        )
+        assert sliced.peak_flops == 16 * 197e12
+
+    def test_gauges_exposed(self):
+        from prometheus_client import generate_latest
+
+        t = obs.StepTelemetry(flops_per_example=1e6, device_kind="cpu")
+        t.observe(4, 0.01)
+        text = generate_latest(t.registry).decode()
+        assert "training_mfu" in text
+        assert "training_examples_per_sec 400.0" in text
+        assert "training_steps_total 1.0" in text
+
+    def test_summary_excludes_warmup_step(self):
+        t = obs.StepTelemetry(flops_per_example=1e6, device_kind="cpu")
+        t.observe(4, 1.0)   # compile-heavy first step
+        t.observe(4, 0.1)
+        t.observe(4, 0.1)
+        summary = t.summary()
+        assert summary["steps"] == 3
+        assert summary["median_step_time_s"] == pytest.approx(0.1)
+
+    def test_train_loop_hook(self):
+        """models.train.run_steps feeds the hook per executed step."""
+        import numpy as np
+
+        from kubeflow_tpu.models.train import run_steps
+
+        def fake_step(state, batch):
+            return state + 1, {"loss": np.float32(0.5)}
+
+        t = obs.StepTelemetry(flops_per_example=1e6, device_kind="cpu")
+        batches = [{"image": np.zeros((4, 2, 2, 3))} for _ in range(3)]
+        state, metrics = run_steps(fake_step, 0, batches, telemetry=t)
+        assert state == 3
+        assert len(t.records) == 3
+        assert all(r["batch_size"] == 4 for r in t.records)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: spawner POST → CR annotation → reconcile → chaos fault
+# ---------------------------------------------------------------------------
+
+
+def jwa_client():
+    from kubeflow_tpu.apps.jupyter import create_app
+    from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+
+    def build(api):
+        import inspect
+
+        app = create_app(
+            api, authn=AuthnConfig(), authorizer=AllowAll(),
+            secure_cookies=False,
+        )
+        client = app.test_client()
+        # werkzeug <= 2.2 takes (server_name, key, value); >= 2.3
+        # takes (key, value). Detect by parameter name so the
+        # double-submit cookie actually lands either way.
+        params = list(
+            inspect.signature(client.set_cookie).parameters
+        )
+        if params and params[0] == "server_name":
+            client.set_cookie("localhost", "XSRF-TOKEN", "t")
+        else:
+            client.set_cookie("XSRF-TOKEN", "t")
+        headers = {
+            "kubeflow-userid": "alice@example.com",
+            "X-XSRF-TOKEN": "t",
+            "Content-Type": "application/json",
+        }
+        return client, headers
+
+    return build
+
+
+class TestEndToEndTrace:
+    def test_spawner_request_annotates_cr_with_trace(self, tracer):
+        api = FakeApiServer()
+        client, headers = jwa_client()(api)
+        resp = client.post(
+            "/api/namespaces/user/notebooks",
+            data=json.dumps({"name": "nb1"}), headers=headers,
+        )
+        assert resp.status_code == 200, resp.data
+        trace_id = resp.headers["X-Trace-Id"]
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb1", "user")
+        header = nb["metadata"]["annotations"][obs.TRACE_ANNOTATION]
+        ctx = obs.parse_traceparent(header)
+        assert ctx is not None and ctx.trace_id == trace_id
+
+    def test_trace_survives_injected_503_with_fault_on_right_span(
+        self, tracer, tmp_path
+    ):
+        """The acceptance trace: spawner POST → CR annotation →
+        reconcile → apiserver call; the injected 503 is an event on the
+        api span of the FAILING reconcile, the retry is a second
+        reconcile span in the same trace, and the whole tree survives
+        into JSONL."""
+        fake = FakeApiServer()
+        schedule = FaultSchedule(seed=11).add(
+            sched.ERROR, start=0, end=8, rate=1.0,
+            verbs=["get"], kinds=["Notebook"], status=503,
+        )
+        proxy = ChaosApiServer(fake, schedule, sleep=lambda s: None)
+        ctrl = make_notebook_controller(proxy)
+        clamp_backoff(ctrl)
+
+        client, headers = jwa_client()(fake)
+        resp = client.post(
+            "/api/namespaces/user/notebooks",
+            data=json.dumps({"name": "nb1"}), headers=headers,
+        )
+        assert resp.status_code == 200, resp.data
+        trace_id = resp.headers["X-Trace-Id"]
+
+        run_to_convergence([ctrl])
+        assert proxy.injected[sched.ERROR] >= 1
+        fake.get("apps/v1", "StatefulSet", "nb1", "user")  # converged
+
+        spans = load_jsonl(str(tmp_path / "spans.jsonl"))
+        trace = [s for s in spans if s["trace_id"] == trace_id]
+        by_id = {s["span_id"]: s for s in trace}
+
+        # Root: the spawner POST.
+        (root,) = [s for s in trace if s["parent_id"] is None]
+        assert root["name"] == "http POST"
+        assert root["attributes"]["app"] == "jwa"
+
+        # Reconciles parent on the POST span via the CR annotation;
+        # the 503 round produced an error span, the retry a clean one.
+        reconciles = [s for s in trace if s["name"] == "reconcile"]
+        assert len(reconciles) >= 2
+        assert all(
+            s["parent_id"] == root["span_id"] for s in reconciles
+        )
+        errored = [s for s in reconciles if s["status"] == "error"]
+        succeeded = [s for s in reconciles if s["status"] == "ok"]
+        assert errored and succeeded
+        assert any(
+            e["name"] == "requeue_rate_limited"
+            for s in errored for e in s["events"]
+        )
+
+        # The injected fault is an event on the api span UNDER an
+        # errored reconcile — "503 injected here".
+        fault_spans = [
+            s for s in trace
+            if any(e["name"] == "chaos.fault" for e in s["events"])
+        ]
+        assert fault_spans
+        for span in fault_spans:
+            assert span["name"] == "api get"
+            parent = by_id[span["parent_id"]]
+            assert parent["name"] == "reconcile"
+            assert parent["status"] == "error"
+            (fault_event,) = [
+                e for e in span["events"] if e["name"] == "chaos.fault"
+            ]
+            assert fault_event["attributes"]["status"] == 503
+
+        # The successful retry reached the apiserver in-trace too.
+        assert any(
+            by_id[s["parent_id"]]["status"] == "ok"
+            for s in trace
+            if s["name"].startswith("api ")
+            and s["parent_id"] in by_id
+            and by_id[s["parent_id"]]["name"] == "reconcile"
+        )
+
+
+class TestTraceParentLifecycle:
+    def make_controller(self, api):
+        from kubeflow_tpu.controllers.runtime import Controller, WatchSpec
+
+        return Controller(
+            "notebook-controller", api, _OkReconciler(),
+            [WatchSpec(NOTEBOOK_API, "Notebook")],
+        )
+
+    def nb(self, annotations=None):
+        return {
+            "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "user",
+                         "annotations": annotations or {}},
+            "spec": {},
+        }
+
+    def test_recreated_object_does_not_inherit_dead_trace(self, tracer):
+        """Delete-and-recreate without the annotation must NOT keep
+        parenting reconciles on the dead predecessor's trace."""
+        api = FakeApiServer()
+        ctrl = self.make_controller(api)
+        old = obs.SpanContext("ab" * 16, "cd" * 8)
+        api.create(self.nb({
+            obs.TRACE_ANNOTATION: obs.format_traceparent(old),
+        }))
+        ctrl.run_once()
+        assert any(
+            s["trace_id"] == old.trace_id
+            for s in tracer.ring.spans() if s["name"] == "reconcile"
+        )
+        api.delete(NOTEBOOK_API, "Notebook", "nb", "user")
+        api.create(self.nb())  # recreated, no annotation
+        tracer.ring.clear()
+        ctrl.run_once()
+        reconciles = [
+            s for s in tracer.ring.spans() if s["name"] == "reconcile"
+        ]
+        assert reconciles
+        assert all(s["trace_id"] != old.trace_id for s in reconciles)
+
+
+class TestProbePathsNotTraced:
+    def make_app(self):
+        from kubeflow_tpu.apps.jupyter import create_app
+        from kubeflow_tpu.crud_backend import AllowAll, AuthnConfig
+
+        return create_app(
+            FakeApiServer(), authn=AuthnConfig(), authorizer=AllowAll(),
+            secure_cookies=False,
+        )
+
+    def test_healthz_and_metrics_root_no_spans(self, tracer):
+        client = self.make_app().test_client()
+        for path in ("/healthz", "/readyz", "/metrics"):
+            resp = client.get(path)
+            assert resp.status_code == 200
+            assert "X-Trace-Id" not in resp.headers
+        assert tracer.ring.spans() == []
+
+    def test_sampled_out_request_advertises_no_trace_id(self):
+        obs.set_tracer(obs.Tracer(sample_rate=0.0))
+        try:
+            client = self.make_app().test_client()
+            resp = client.get(
+                "/api/namespaces",
+                headers={"kubeflow-userid": "a@b.c"},
+            )
+            assert resp.status_code == 200
+            # The id exists in no exporter; advertising it would send
+            # an operator hunting for a trace that never recorded.
+            assert "X-Trace-Id" not in resp.headers
+        finally:
+            obs.set_tracer(None)
+
+
+# ---------------------------------------------------------------------------
+# client + webhook propagation
+# ---------------------------------------------------------------------------
+
+
+class TestClientPropagation:
+    def test_traceparent_injected_and_retry_events_recorded(self, tracer):
+        import http.server
+        import threading
+
+        from kubeflow_tpu.k8s.client import ApiClient, KubeConfig
+
+        seen = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            script = [503, 200]
+
+            def do_GET(self):
+                seen.append(dict(self.headers))
+                status = self.script.pop(0) if self.script else 200
+                body = b"{}" if status == 200 else b'{"message":"down"}'
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            client = ApiClient(KubeConfig(
+                host=f"http://127.0.0.1:{httpd.server_address[1]}"
+            ))
+            client._retry_sleep = lambda s: None
+            with tracer.span("reconcile") as span:
+                client.get("v1", "ConfigMap", "cm", "ns")
+                retries = [
+                    e for e in span.events if e["name"] == "retry"
+                ]
+            assert len(retries) == 1
+            assert retries[0]["attributes"]["status"] == 503
+            expect = obs.format_traceparent(span.context)
+            assert all(h.get("traceparent") == expect for h in seen)
+            snap = client.duration_snapshot()
+            assert snap["GET"]["count"] == 2  # each attempt observed
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_no_span_no_header(self, tracer):
+        from kubeflow_tpu.k8s.client import ApiClient, KubeConfig
+        import http.server
+        import threading
+
+        seen = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                seen.append(dict(self.headers))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            client = ApiClient(KubeConfig(
+                host=f"http://127.0.0.1:{httpd.server_address[1]}"
+            ))
+            client.get("v1", "ConfigMap", "cm", "ns")
+            assert all("traceparent" not in h for h in seen)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestWebhookSpan:
+    def test_admission_wrapped_in_span(self, tracer):
+        from kubeflow_tpu.webhook.server import (
+            AdmissionHandler,
+            WebhookServer,
+        )
+
+        server = WebhookServer(AdmissionHandler(lambda ns: []), port=0)
+        server.start()
+        try:
+            parent = obs.SpanContext("ab" * 16, "cd" * 8)
+            review = {
+                "request": {
+                    "uid": "u1", "kind": {"kind": "Pod"},
+                    "namespace": "user",
+                    "object": {"metadata": {"name": "p", "namespace":
+                                            "user"}},
+                },
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/apply-poddefault",
+                data=json.dumps(review).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "traceparent": obs.format_traceparent(parent),
+                },
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is True
+            admission = [
+                s for s in tracer.ring.spans()
+                if s["name"] == "admission /apply-poddefault"
+            ]
+            (span,) = admission
+            assert span["trace_id"] == parent.trace_id
+            assert span["parent_id"] == parent.span_id
+            assert span["attributes"]["allowed"] is True
+        finally:
+            server.stop()
