@@ -40,6 +40,16 @@
   use ``k8s.retry.RetryPolicy`` (capped exponential + jitter) or the
   workqueue's rate-limited re-add. Item-skip ``for`` loops (``except:
   continue`` over a collection) are not retries and are not flagged.
+- ``py-nonatomic-write`` (error): ``open(path, "w"/"wb")`` on a
+  checkpoint/state file (the path expression mentions checkpoint /
+  ckpt / manifest / state) in a scope with no ``os.replace`` /
+  ``os.rename`` commit. A crash mid-write leaves a torn file that a
+  later reader happily parses half of; durable state must be written
+  to a temp name and renamed into place (the write-ahead idiom
+  models/checkpoint.py ``_write_bytes`` packages). Writing to an
+  explicitly temp-named path (``tmp``/``.part``) is the first half of
+  that idiom and is not flagged; deliberate exceptions escape with
+  ``# analysis: allow[py-nonatomic-write]``.
 """
 
 from __future__ import annotations
@@ -289,6 +299,117 @@ def _check_retry_loop(
         ))
 
 
+# --- py-nonatomic-write ----------------------------------------------------
+# Path-expression fragments that mark a write as durable state whose
+# torn-write story matters (checkpoint steps, manifests, train state).
+_STATE_FILE_TOKENS = ("checkpoint", "ckpt", "manifest", "state")
+# Fragments that mark the path as the TEMP half of a write-then-rename
+# commit — that write is SUPPOSED to be direct.
+_TMP_PATH_TOKENS = ("tmp", "temp", ".part", "partial")
+
+
+def _expr_text(node: ast.AST) -> str:
+    """Lowercased soup of the identifiers and string constants inside an
+    expression — enough to ask "does this path look like a checkpoint
+    file" without evaluating anything."""
+    parts: list[str] = []
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name):
+            parts.append(child.id)
+        elif isinstance(child, ast.Attribute):
+            parts.append(child.attr)
+        elif isinstance(child, ast.Constant) and isinstance(child.value, str):
+            parts.append(child.value)
+    return " ".join(parts).lower()
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True for ``open(..., "w"/"wb"/"w+")`` (positional or mode=)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value.startswith("w")
+    )
+
+
+def _scope_nodes(scope: ast.AST):
+    """All descendants of a function/module scope, not descending into
+    nested function or class definitions (their writes have their own
+    commit story)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _scope_has_rename_commit(scope: ast.AST, aliases: dict[str, str]) -> bool:
+    for node in _scope_nodes(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _dotted(node.func, aliases)
+        last = target.rsplit(".", 1)[-1]
+        # .rename/.renames/.link have no string-method homonym: any
+        # receiver counts (Path.rename included). ".replace" is also a
+        # str/bytes method, so it only counts on an os/shutil/pathlib
+        # receiver — a stray path.replace('-', '_') must not read as
+        # the commit.
+        if last in ("rename", "renames", "link"):
+            return True
+        if last == "replace":
+            root = target.split(".", 1)[0]
+            if root in ("os", "shutil", "pathlib", "Path"):
+                return True
+    return False
+
+
+def _check_nonatomic_writes(
+    scope: ast.AST,
+    aliases: dict[str, str],
+    path: str,
+    out: list[Finding],
+) -> None:
+    """Flag direct writes of checkpoint/state files in a scope that
+    never renames anything into place. Scope granularity is the
+    enclosing function (or the module for top-level code): the
+    tmp-write and the os.replace of the commit idiom live together."""
+    opens = [
+        node for node in _scope_nodes(scope)
+        if isinstance(node, ast.Call)
+        and _dotted(node.func, aliases) == "open"
+        and node.args
+        and _open_write_mode(node)
+    ]
+    if not opens:
+        return
+    has_commit = _scope_has_rename_commit(scope, aliases)
+    for call in opens:
+        text = _expr_text(call.args[0])
+        if not any(tok in text for tok in _STATE_FILE_TOKENS):
+            continue
+        if any(tok in text for tok in _TMP_PATH_TOKENS):
+            continue  # the temp half of a write-then-rename commit
+        if has_commit:
+            continue
+        out.append(Finding(
+            "py-nonatomic-write", Severity.ERROR, path, call.lineno,
+            "checkpoint/state file opened for writing with no "
+            "tmp+os.replace commit in scope: a crash mid-write leaves "
+            "a torn file that restores garbage — write to a temp name "
+            "and os.replace() it into place (or annotate a deliberate "
+            "direct write with # analysis: allow[py-nonatomic-write])",
+        ))
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -374,6 +495,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
     out: list[Finding] = []
     print_exempt = _print_rule_exempt(path, tree)
 
+    _check_nonatomic_writes(tree, aliases, path, out)  # module scope
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             is_traced = node.name in traced_names or any(
@@ -383,6 +505,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
                 _check_traced_body(node, aliases, path, out)
             if node.name == "reconcile" or node.name.endswith("_reconcile"):
                 _check_reconcile_body(node, aliases, path, out)
+            _check_nonatomic_writes(node, aliases, path, out)
         elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
             _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):

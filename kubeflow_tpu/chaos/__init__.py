@@ -26,3 +26,23 @@ from kubeflow_tpu.chaos.cluster import (  # noqa: F401
 from kubeflow_tpu.chaos.harness import run_to_convergence  # noqa: F401
 from kubeflow_tpu.chaos.proxy import ChaosApiServer, ChaosWatchQueue  # noqa: F401
 from kubeflow_tpu.chaos.schedule import Fault, FaultSchedule  # noqa: F401
+
+# Data-plane checkpoint faults resolve lazily: chaos.ckpt reaches into
+# models.checkpoint (jax + the training stack), which the control-plane
+# tier above must not pay for at import time.
+_CKPT_EXPORTS = (
+    "CheckpointKiller",
+    "SimulatedCrash",
+    "KILL_POINTS",
+    "truncate_shard",
+    "drop_shard",
+    "flip_shard_bytes",
+)
+
+
+def __getattr__(name):
+    if name in _CKPT_EXPORTS:
+        from kubeflow_tpu.chaos import ckpt
+
+        return getattr(ckpt, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,118 @@
+"""Data-plane chaos: kill-mid-save and checkpoint corruption faults.
+
+The control-plane tier injects apiserver weather; this module injects
+the *storage* weather a preempted TPU worker actually produces — a
+SIGKILL landing between shard writes, a shard file truncated by a dying
+kernel, a manifest whose shard vanished from a misbehaving PVC. All of
+it drives :class:`kubeflow_tpu.models.checkpoint.CheckpointManager`'s
+crash-consistency contract: a step is either fully committed and
+digest-clean, or it is skipped by ``restore_latest_valid``.
+
+``CheckpointKiller`` plugs into the manager's ``hook`` parameter and
+raises :class:`SimulatedCrash` at a named save point — the in-process
+equivalent of SIGKILL: the save stops mid-protocol and nothing cleans
+up, leaving exactly the torn on-disk state a real crash leaves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from kubeflow_tpu.models.checkpoint import MANIFEST_NAME
+
+# Save points a CheckpointKiller can target, in protocol order.
+KILL_POINTS = (
+    "shard_written",    # after this process's shard payload is durable
+    "pre_manifest",     # after the commit barrier, before the manifest
+    "manifest_written",  # manifest durable in the tmp dir, before rename
+    "committed",        # after the rename commit (GC never runs)
+)
+
+
+class SimulatedCrash(Exception):
+    """The process died here. Raised by CheckpointKiller so a save
+    abandons the protocol exactly where a SIGKILL would."""
+
+
+class CheckpointKiller:
+    """Raise :class:`SimulatedCrash` the ``occurrence``-th time the
+    manager reaches ``point``. Install via
+    ``CheckpointManager(..., hook=CheckpointKiller("pre_manifest"))``.
+
+    ``seen`` counts every hook event by point so tests can assert the
+    kill actually fired (a killer that never triggers proves nothing —
+    same posture as ``ChaosApiServer.injected``)."""
+
+    def __init__(self, point: str, occurrence: int = 1):
+        if point not in KILL_POINTS:
+            raise ValueError(
+                f"unknown kill point {point!r}; one of {KILL_POINTS}"
+            )
+        self.point = point
+        self.occurrence = int(occurrence)
+        self.fired = False
+        self.seen: dict[str, int] = {}
+
+    def __call__(self, point: str, info: dict) -> None:
+        self.seen[point] = self.seen.get(point, 0) + 1
+        if point == self.point and self.seen[point] == self.occurrence:
+            self.fired = True
+            raise SimulatedCrash(
+                f"simulated SIGKILL at {point} "
+                f"(occurrence {self.occurrence}, info {info})"
+            )
+
+
+# ---------------------------------------------------------------------------
+# post-commit corruption (what a sick PVC / dying kernel leaves behind)
+# ---------------------------------------------------------------------------
+
+
+def _step_dir(directory, step: int) -> str:
+    return os.path.join(os.fspath(directory), str(int(step)))
+
+
+def _shard_files(step_dir: str, suffix: str) -> list[str]:
+    with open(os.path.join(step_dir, MANIFEST_NAME), "rb") as fh:
+        manifest = json.load(fh)
+    return sorted(
+        name for name in manifest.get("files", {}) if name.endswith(suffix)
+    )
+
+
+def truncate_shard(directory, step: int, keep_bytes: int = 8) -> str:
+    """Truncate the first shard payload of a committed step — the torn
+    write a crash mid-flush leaves on a non-atomic filesystem. Returns
+    the damaged file's name."""
+    step_dir = _step_dir(directory, step)
+    name = _shard_files(step_dir, ".bin")[0]
+    path = os.path.join(step_dir, name)
+    size = os.path.getsize(path)
+    with open(path, "rb+") as fh:
+        fh.truncate(min(keep_bytes, size))
+    return name
+
+
+def drop_shard(directory, step: int) -> str:
+    """Delete the first shard payload while keeping the manifest — the
+    manifest-present-but-shard-missing state. Returns the removed
+    file's name."""
+    step_dir = _step_dir(directory, step)
+    name = _shard_files(step_dir, ".bin")[0]
+    os.unlink(os.path.join(step_dir, name))
+    return name
+
+
+def flip_shard_bytes(directory, step: int, offset: int = 0) -> str:
+    """Silently corrupt shard content (bit rot): same length, different
+    bytes — only the content digests can catch it."""
+    step_dir = _step_dir(directory, step)
+    name = _shard_files(step_dir, ".bin")[0]
+    path = os.path.join(step_dir, name)
+    with open(path, "rb+") as fh:
+        fh.seek(offset)
+        byte = fh.read(1)
+        fh.seek(offset)
+        fh.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
+    return name

@@ -10,15 +10,22 @@ must be enforced by the notebook reconciler, not assumed here.
 
 ``PreemptionInjector`` kills TPU workers the way GKE preempts a node
 pool VM: the node is tainted with the impending-termination taint,
-then its pod is deleted out from under the workload. The injector
-talks to the *inner* (un-chaosed) API on purpose: preemption is
-cluster weather, not apiserver weather, and must land even while the
-proxy is injecting request faults.
+then its pod is deleted out from under the workload. Preemption is
+cluster weather, not apiserver weather — the two can and do overlap, so
+the injector must not *lose* a preemption just because its API writes
+landed inside an injected blackout: every call retries through a
+``RetryPolicy`` (GCE's node-termination handler behaves the same way —
+the VM IS going away; the delete eventually lands). Tests that want
+the old overlap-free behavior point the injector at the inner
+(un-chaosed) API.
 """
 
 from __future__ import annotations
 
-from kubeflow_tpu.k8s.core import NotFound
+import time
+
+from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
+from kubeflow_tpu.k8s.retry import RETRIABLE_STATUS, RetryPolicy
 
 # The taint GKE places on a node about to lose its capacity
 # (spot/preemptible reclaim and maintenance both surface this way).
@@ -124,43 +131,95 @@ class StatefulSetPodSimulator:
 
 
 class PreemptionInjector:
-    """GKE-shaped TPU preemption: taint the node, delete its pod."""
+    """GKE-shaped TPU preemption: taint the node, delete its pod.
 
-    def __init__(self, api):
+    ``retry_policy`` paces the API calls through apiserver weather: a
+    preemption decided by the cloud provider is not cancellable, so a
+    503/blackout on the pod delete must be retried until it lands, not
+    dropped (the workload would keep running on a VM that is going
+    away, and the chaos scenario would silently test nothing).
+    ``NotFound`` is still terminal — the pod being gone IS the goal."""
+
+    def __init__(self, api, retry_policy: RetryPolicy | None = None,
+                 sleep=time.sleep):
         self.api = api
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=8, base_delay=0.001, max_delay=0.05
+        )
+        self._sleep = sleep
+        self.retries_total = 0
         self.preempted: list[tuple[str, str]] = []  # (namespace, pod)
 
+    def _retrying(self, fn, *args, **kwargs):
+        """Run one API call through the retry policy. Same doctrine as
+        the client (k8s/retry.py): only transient statuses retry;
+        NotFound is terminal (the pod being gone IS the goal) and
+        Conflict propagates — a stale world-view is only fixed by a
+        re-read, which the caller owns."""
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except (NotFound, Conflict):
+                raise
+            except ApiError as exc:
+                if getattr(exc, "code", None) not in RETRIABLE_STATUS:
+                    raise
+                if attempt + 1 >= self.retry_policy.max_attempts:
+                    raise
+                self._sleep(self.retry_policy.delay(
+                    attempt, getattr(exc, "retry_after", None)
+                ))
+                attempt += 1
+                self.retries_total += 1
+
     def _taint_node(self, node_name: str) -> None:
+        """Best-effort read-modify-write with conflict re-reads: the
+        taint is advisory (the delete is the preemption), so after the
+        attempt budget it is abandoned rather than raised."""
         taint = {"key": PREEMPTION_TAINT_KEY, "effect": "NoSchedule"}
-        try:
-            node = self.api.get("v1", "Node", node_name)
-        except NotFound:
-            self.api.create({
-                "apiVersion": "v1",
-                "kind": "Node",
-                "metadata": {"name": node_name},
-                "spec": {"taints": [taint]},
-            })
-            return
-        taints = (node.get("spec") or {}).get("taints") or []
-        if not any(t.get("key") == PREEMPTION_TAINT_KEY for t in taints):
-            self.api.patch_merge(
-                "v1", "Node", node_name,
-                {"spec": {"taints": taints + [taint]}},
-            )
+        for attempt in range(self.retry_policy.max_attempts):
+            try:
+                node = self._retrying(self.api.get, "v1", "Node",
+                                      node_name)
+            except NotFound:
+                try:
+                    self._retrying(self.api.create, {
+                        "apiVersion": "v1",
+                        "kind": "Node",
+                        "metadata": {"name": node_name},
+                        "spec": {"taints": [taint]},
+                    })
+                    return
+                except Conflict:
+                    # Raced with the node appearing: re-read, re-apply.
+                    self._sleep(self.retry_policy.delay(attempt))
+                    continue
+            taints = (node.get("spec") or {}).get("taints") or []
+            if any(t.get("key") == PREEMPTION_TAINT_KEY for t in taints):
+                return
+            try:
+                self._retrying(
+                    self.api.patch_merge,
+                    "v1", "Node", node_name,
+                    {"spec": {"taints": taints + [taint]}},
+                )
+                return
+            except Conflict:
+                self._sleep(self.retry_policy.delay(attempt))
 
     def preempt_pod(self, namespace: str, name: str) -> str | None:
         """Preempt one pod; returns the tainted node's name (None when
         the pod was already gone)."""
         try:
-            pod = self.api.get("v1", "Pod", name, namespace)
+            pod = self._retrying(self.api.get, "v1", "Pod", name, namespace)
         except NotFound:
             return None
         node_name = (pod.get("spec") or {}).get("nodeName") or ""
         if node_name:
             self._taint_node(node_name)
         try:
-            self.api.delete("v1", "Pod", name, namespace)
+            self._retrying(self.api.delete, "v1", "Pod", name, namespace)
         except NotFound:
             return None
         self.preempted.append((namespace, name))
@@ -172,15 +231,24 @@ class PreemptionInjector:
         return self.preempt_pod(namespace, f"{notebook}-{ordinal}")
 
     def recover_node(self, node_name: str) -> None:
-        """Clear the termination taint (the replacement VM arriving)."""
-        try:
-            node = self.api.get("v1", "Node", node_name)
-        except NotFound:
-            return
-        taints = [
-            t for t in (node.get("spec") or {}).get("taints") or []
-            if t.get("key") != PREEMPTION_TAINT_KEY
-        ]
-        self.api.patch_merge(
-            "v1", "Node", node_name, {"spec": {"taints": taints}}
-        )
+        """Clear the termination taint (the replacement VM arriving).
+        Conflict re-reads like _taint_node; best-effort past the attempt
+        budget."""
+        for attempt in range(self.retry_policy.max_attempts):
+            try:
+                node = self._retrying(self.api.get, "v1", "Node",
+                                      node_name)
+            except NotFound:
+                return
+            taints = [
+                t for t in (node.get("spec") or {}).get("taints") or []
+                if t.get("key") != PREEMPTION_TAINT_KEY
+            ]
+            try:
+                self._retrying(
+                    self.api.patch_merge,
+                    "v1", "Node", node_name, {"spec": {"taints": taints}},
+                )
+                return
+            except Conflict:
+                self._sleep(self.retry_policy.delay(attempt))

@@ -35,6 +35,15 @@ OBSERVED_MESH_KEY = "notebooks.kubeflow-tpu.org/observed-mesh"
 RESTART_REASON_KEY = "notebooks.kubeflow-tpu.org/restart-reason"
 PREEMPTION_RESTARTS_KEY = "notebooks.kubeflow-tpu.org/preemption-restarts"
 
+# Checkpoint/resume handshake with the data plane. CHECKPOINT_STEP is
+# stamped by the training side (models/checkpoint.py manager commits →
+# the in-image reporter mirrors checkpoint_last_committed_step here);
+# on SliceRestarted the reconciler copies it into RESUME_EXPECTED — the
+# step the restarted slice is expected to resume from — and surfaces it
+# as status.resumedFromStep for kubectl/dashboard.
+CHECKPOINT_STEP_KEY = "notebooks.kubeflow-tpu.org/checkpoint-last-step"
+RESUME_EXPECTED_KEY = "notebooks.kubeflow-tpu.org/resume-expected-step"
+
 
 @dataclasses.dataclass
 class NotebookOptions:
@@ -293,10 +302,19 @@ class NotebookReconciler:
             }
             if reason:
                 patch[RESTART_REASON_KEY] = None
+                # Resume handshake: the fresh slice is expected to pick
+                # up from the last checkpoint step the data plane
+                # reported ("0" = no checkpoint known, fresh start).
+                resume_step = anns.get(CHECKPOINT_STEP_KEY, "0")
+                patch[RESUME_EXPECTED_KEY] = resume_step
+                notebook.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                )[RESUME_EXPECTED_KEY] = resume_step
                 record_event(
                     self.api, notebook, "SliceRestarted",
                     f"all {replicas} TPU workers recreated; "
-                    "jax.distributed mesh re-forming",
+                    "jax.distributed mesh re-forming; training resumes "
+                    f"from checkpoint step {resume_step}",
                 )
             self._patch_annotations(req, patch)
             return None
@@ -416,6 +434,20 @@ class NotebookReconciler:
             # look, on top of the native-derived status.
             status["phase"] = "Restarting"
             status["restartReason"] = restart_reason
+        # Resume visibility: once a SliceRestarted stamped the expected
+        # resume step, keep it on status until the next restart
+        # rewrites it — "this notebook last resumed from step N".
+        resume_raw = (
+            (notebook.get("metadata") or {}).get("annotations") or {}
+        ).get(RESUME_EXPECTED_KEY)
+        if resume_raw is not None:
+            try:
+                status["resumedFromStep"] = int(resume_raw)
+            except (TypeError, ValueError):
+                log.warning(
+                    "notebook %s/%s: non-numeric %s annotation %r",
+                    ns, name, RESUME_EXPECTED_KEY, resume_raw,
+                )
         if cur_status != status:
             patch = dict(status)
             if not restart_reason:
@@ -425,6 +457,9 @@ class NotebookReconciler:
                 for key in ("phase", "restartReason"):
                     if key in cur_status:
                         patch[key] = None
+            if "resumedFromStep" not in status and \
+                    "resumedFromStep" in cur_status:
+                patch["resumedFromStep"] = None
             self.api.patch_merge(
                 NOTEBOOK_API, "Notebook", name, {"status": patch}, ns
             )

@@ -7,14 +7,26 @@ workload) and a long-context transformer exercising ring attention.
 
 from kubeflow_tpu.models.resnet import ResNet, resnet50, resnet18
 from kubeflow_tpu.models.train import (
+    RunReport,
     TrainState,
     create_train_state,
     make_train_step,
     make_eval_step,
+    run_with_checkpointing,
 )
 
-# Checkpoint helpers resolve lazily too (orbax import is heavy).
-_CKPT_EXPORTS = ("save_checkpoint", "restore_checkpoint", "latest_step")
+# Checkpoint helpers resolve lazily (the manager pulls in the obs
+# stack; ResNet-only consumers shouldn't pay for it at import time).
+_CKPT_EXPORTS = (
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "CheckpointManager",
+    "CheckpointMetrics",
+    "CheckpointCorrupt",
+    "manager_from_env",
+    "cadence_from_env",
+)
 
 # Transformer/LM exports resolve lazily: transformer.py pulls in pallas +
 # the ring-attention stack, which ResNet-only consumers (bench.py, the
@@ -74,6 +86,16 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "RunReport",
+    "run_with_checkpointing",
+    "CheckpointManager",
+    "CheckpointMetrics",
+    "CheckpointCorrupt",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "manager_from_env",
+    "cadence_from_env",
     "LMConfig",
     "TransformerLM",
     "build_lm",

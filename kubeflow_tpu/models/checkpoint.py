@@ -1,95 +1,228 @@
-"""Sharding-aware checkpoint save/restore for training state.
+"""Crash-consistent, sharding-aware checkpointing for training state.
 
-The platform's persistence story is PVCs (reference: workspace volume +
-stop/restart semantics, SURVEY.md §5 checkpoint/resume); what runs
-*inside* the notebooks needs model checkpointing that understands
-sharded arrays — save from a dp×fsdp mesh, restore onto a different
-mesh (or a single chip) without materialising the full state on one
-host. Orbax handles the array chunks; this module pins down the
-TrainState round-trip:
+The platform's persistence story is PVCs (workspace volume +
+stop/restart semantics, SURVEY.md §5); what runs *inside* the notebooks
+needs model checkpointing that survives the cluster weather the control
+plane injects: a TPU preemption SIGKILLs the worker mid-save, the slice
+restarts, and the training loop must resume from the last *committed*
+step — never a torn one. The design follows Check-N-Run (Eisenman et
+al., FAST'21): decouple the device→host snapshot from the durable
+write, make the commit atomic, and verify content on the way back in.
 
-- ``tx``/``apply_fn`` are static (pytree_node=False) and never
-  serialised — the caller re-supplies them via the ``like`` template.
-- With a mesh, restore places each leaf with the canonical
-  dp/fsdp sharding (kubeflow_tpu.parallel.param_sharding), so a
-  restored state is immediately usable by the sharded train step.
+Commit protocol (one step = one directory):
+
+1. every process writes its shards into ``_tmp.<step>/`` —
+   ``shard-<pid>.bin`` (raw C-order payloads) + ``shard-<pid>.json``
+   (offsets, indices, per-shard sha256) — each fsynced;
+2. all processes reach the commit barrier;
+3. process 0 writes ``MANIFEST.json`` (step, topology fingerprint,
+   per-file sha256) into the tmp dir — tmp-file + ``os.replace``, the
+   manifest is the last thing written;
+4. process 0 renames ``_tmp.<step>`` → ``<step>`` and fsyncs the
+   parent: the rename IS the commit point.
+
+A crash at any point leaves either a dangling ``_tmp.*`` dir (ignored
+by restore, removed by GC) or a fully committed step.
+``restore_latest_valid`` walks committed steps newest-first, verifies
+manifest + file digests + per-shard content digests + slice coverage,
+and falls back to the previous step on any corruption — a readable but
+corrupt checkpoint is never returned.
+
+Sharding: a jax.Array is saved as its ``replica_id == 0`` addressable
+shards (each process writes only what it owns — no host-side gather of
+fsdp-sharded state), and restored by reassembling the global array from
+every process's shard file and placing it with the caller's target
+shardings (``restore_checkpoint`` computes the canonical dp/fsdp/tp
+placement exactly as before; mesh→different-mesh and mesh→single-chip
+both work because assembly is host-side).
+
+``save_checkpoint`` / ``restore_checkpoint`` / ``latest_step`` keep
+their signatures as thin wrappers over the manager.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
+import logging
 import os
+import shutil
+import threading
+import time
 
 import jax
-import orbax.checkpoint as ocp
+import numpy as np
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.models.train import state_shardings
 
+log = logging.getLogger(__name__)
 
-def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
-    """Write ``state`` (TrainState or any pytree of arrays) to ``path``.
-    Blocks until durable (the notebook PVC survives pod restarts; a
-    half-written checkpoint must not)."""
-    path = os.path.abspath(os.fspath(path))  # orbax requires absolute
-    with ocp.StandardCheckpointer() as ckptr:
-        target = os.path.join(path, str(step)) if step is not None else path
-        ckptr.save(target, _arrays_only(state))
-    return target if step is not None else path
+MANIFEST_NAME = "MANIFEST.json"
+TMP_PREFIX = "_tmp."
+MANIFEST_FORMAT = 1
+
+# Env the webhook's PodDefault injects into every TPU pod (see
+# kubeflow_tpu.webhook.server.tpu_env_poddefault) and the train loop
+# reads back (models/train.py run_with_checkpointing callers).
+ENV_CHECKPOINT_DIR = "KFT_CHECKPOINT_DIR"
+ENV_CHECKPOINT_EVERY_STEPS = "KFT_CHECKPOINT_EVERY_STEPS"
+ENV_CHECKPOINT_EVERY_S = "KFT_CHECKPOINT_EVERY_S"
+ENV_CHECKPOINT_KEEP = "KFT_CHECKPOINT_KEEP"
 
 
-def restore_checkpoint(path: str | os.PathLike, like, mesh=None,
-                       tp_rules: dict | None = None):
-    """Restore into the shape of ``like`` (a TrainState template from
-    ``create_train_state`` — supplies tx/apply_fn and leaf shapes).
-    With ``mesh``, leaves come back sharded with the save-time canonical
-    layout: when ``like``'s leaves are committed arrays on ``mesh``
-    (the template from create_train_state/create_lm_state), their actual
-    shardings are reused verbatim — including Megatron tp layouts — and
-    ``tp_rules`` covers abstract templates (pass the model's rules, e.g.
-    transformer.LM_TP_RULES, or tp-sharded kernels restore replicated)."""
-    path = os.path.abspath(os.fspath(path))  # orbax requires absolute
-    template = _arrays_only(like)
-    if mesh is not None:
-        computed = state_shardings(template, mesh, tp_rules=tp_rules)
+class CheckpointCorrupt(Exception):
+    """A step directory failed validation (torn write, digest mismatch,
+    missing shard). ``restore_latest_valid`` treats it as "skip this
+    step and fall back"; direct ``restore`` surfaces it."""
 
-        def pick(leaf, fallback):
-            s = getattr(leaf, "sharding", None)
-            if isinstance(s, jax.sharding.NamedSharding) and s.mesh == mesh:
-                return s
-            return fallback
 
-        shardings = jax.tree.map(pick, template, computed)
-        abstract = jax.tree.map(
-            lambda leaf, s: jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=s
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+class CheckpointMetrics:
+    """Checkpoint observability with the platform's degrade-gracefully
+    posture: plain in-process values always (tests and minimal worker
+    images), prometheus series mirrored when the client is importable.
+
+    - ``checkpoint_save_duration_seconds`` (histogram)
+    - ``checkpoint_last_committed_step`` (gauge)
+    - ``checkpoint_restore_total{outcome}`` (counter; outcomes:
+      ``resumed``, ``skipped_corrupt``, ``none``)
+    """
+
+    def __init__(self, registry=None):
+        self.save_duration = obs.BucketHistogram()
+        self.last_committed_step: int | None = None
+        self.restore_total: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._prom = None
+        try:
+            from prometheus_client import (
+                CollectorRegistry,
+                Counter,
+                Gauge,
+                Histogram,
+            )
+        except ImportError:  # minimal worker image: in-process only
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        self._prom = {
+            "duration": Histogram(
+                "checkpoint_save_duration_seconds",
+                "Wall time of one checkpoint save (snapshot + durable "
+                "write + commit)",
+                registry=self.registry,
             ),
-            template,
-            shardings,
-        )
-    else:
-        # Explicit single-device placement: without it orbax falls back
-        # to the sharding recorded at save time (wrong topology when a
-        # mesh-saved checkpoint restores on one chip, plus a slow path).
-        device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-        abstract = jax.tree.map(
-            lambda leaf: jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=device
+            "last_step": Gauge(
+                "checkpoint_last_committed_step",
+                "Step number of the most recently committed checkpoint",
+                registry=self.registry,
             ),
-            template,
-        )
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract)
-    return _merge_static(like, restored)
+            "restore": Counter(
+                "checkpoint_restore_total",
+                "Checkpoint restore attempts by outcome",
+                ["outcome"],
+                registry=self.registry,
+            ),
+        }
+
+    def observe_save(self, seconds: float, step: int) -> None:
+        with self._lock:
+            self.save_duration.observe(seconds)
+            self.last_committed_step = step
+        if self._prom is not None:
+            self._prom["duration"].observe(seconds)
+            self._prom["last_step"].set(step)
+
+    def observe_restore(self, outcome: str) -> None:
+        with self._lock:
+            self.restore_total[outcome] = (
+                self.restore_total.get(outcome, 0) + 1
+            )
+        if self._prom is not None:
+            self._prom["restore"].labels(outcome).inc()
 
 
-def latest_step(path: str | os.PathLike) -> int | None:
-    """Highest numbered step directory under ``path`` (save_checkpoint
-    with step=N layout), or None when no checkpoint exists."""
-    path = os.path.abspath(os.fspath(path))
+# ---------------------------------------------------------------------------
+# durable-write helpers
+# ---------------------------------------------------------------------------
+
+
+def _write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """tmp-file + os.replace + fsync: the file either has all of
+    ``data`` or does not exist under its final name."""
+    tmp = path + ".part"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        if fsync:
+            os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _fsync_dir(path: str) -> None:
     try:
-        steps = [int(d) for d in os.listdir(path) if d.isdigit()]
-    except FileNotFoundError:
-        return None
-    return max(steps, default=None)
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without dir-fd fsync: degrade silently
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # analysis: allow[py-broad-except]
+    finally:
+        os.close(fd)
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    """Streaming digest: shard payloads can be multi-GB; hashing must
+    not hold a whole file in memory next to the in-flight snapshot."""
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                return digest.hexdigest()
+            digest.update(block)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """numpy dtype by name, falling back to the ml_dtypes extension
+    types (bfloat16, float8_*) numpy cannot resolve from a string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _normalize_index(index, shape) -> list[list[int]]:
+    """Shard index (tuple of slices) → [[start, stop], ...] with Nones
+    resolved against the global shape."""
+    out = []
+    for slc, dim in zip(index, shape):
+        start = 0 if slc.start is None else int(slc.start)
+        stop = dim if slc.stop is None else int(slc.stop)
+        out.append([start, stop])
+    return out
+
+
+def _index_slices(index: list[list[int]]) -> tuple:
+    return tuple(slice(a, b) for a, b in index)
+
+
+# ---------------------------------------------------------------------------
+# host-side snapshot
+# ---------------------------------------------------------------------------
 
 
 def _arrays_only(state):
@@ -114,3 +247,693 @@ def _merge_static(like, restored):
             opt_state=restored["opt_state"],
         )
     return restored
+
+
+@dataclasses.dataclass
+class _HostLeaf:
+    key: str
+    shape: tuple
+    dtype: str
+    # [(normalized index, contiguous np array)] — only the shards THIS
+    # process owns (replica 0), so multi-host saves never gather.
+    shards: list
+
+
+def _flatten_keys(tree) -> list[tuple[str, object]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _snapshot(state, process_id: int) -> list[_HostLeaf]:
+    """Device → host copy of the process-local shards. This is the only
+    part of a save that must happen synchronously with the train loop;
+    everything after it is file I/O on the copied bytes."""
+    out = []
+    for key, leaf in _flatten_keys(_arrays_only(state)):
+        if isinstance(leaf, jax.Array):
+            shape = tuple(leaf.shape)
+            dtype = str(leaf.dtype)
+            # tobytes() always emits C order, so no contiguity coercion
+            # (ascontiguousarray would promote 0-d scalars to 1-d).
+            shards = [
+                (_normalize_index(s.index, shape), np.asarray(s.data))
+                for s in leaf.addressable_shards
+                if s.replica_id == 0
+            ]
+        else:
+            arr = np.asarray(leaf)
+            shape = tuple(arr.shape)
+            dtype = str(arr.dtype)
+            # Host values are identical on every process: one writer.
+            shards = (
+                [(_normalize_index(
+                    tuple(slice(0, d) for d in shape), shape), arr)]
+                if process_id == 0 else []
+            )
+        out.append(_HostLeaf(key, shape, dtype, shards))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Atomic, digest-verified, multi-host-aware checkpoint store.
+
+    Parameters:
+
+    - ``directory``: checkpoint root; committed steps are numbered
+      subdirectories.
+    - ``keep``: committed steps retained by GC (process 0, post-commit).
+    - ``process_id`` / ``process_count``: multi-host identity; process 0
+      is the manifest writer / committer.
+    - ``barrier``: callable run before the manifest write and after the
+      commit; defaults to ``multihost_utils.sync_global_devices`` when
+      ``process_count > 1`` (the jax.distributed world IS the barrier
+      transport) and a no-op for single process.
+    - ``fingerprint``: extra dict merged into the manifest's topology
+      fingerprint (mesh shape, accelerator, ...).
+    - ``hook``: ``fn(point: str, info: dict)`` called at named save
+      points (``shard_written``, ``pre_manifest``, ``manifest_written``,
+      ``committed``) — the chaos tier's kill-injection surface.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        keep: int = 3,
+        process_id: int = 0,
+        process_count: int = 1,
+        barrier=None,
+        fingerprint: dict | None = None,
+        metrics: CheckpointMetrics | None = None,
+        hook=None,
+        fsync: bool = True,
+    ):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.keep = int(keep)
+        self.process_id = int(process_id)
+        self.process_count = int(process_count)
+        self._barrier = barrier
+        self.fingerprint = dict(fingerprint or {})
+        self.metrics = metrics or CheckpointMetrics()
+        self._hook = hook
+        self._fsync = fsync
+        self._inflight: threading.Thread | None = None
+        self._inflight_error: BaseException | None = None
+        self._sync_seq = 0
+        self.last_error: BaseException | None = None
+
+    # ---- small internals -------------------------------------------------
+    def _emit(self, point: str, **info) -> None:
+        if self._hook is not None:
+            self._hook(point, info)
+
+    def _sync(self) -> None:
+        if self._barrier is not None:
+            self._barrier()
+            return
+        if self.process_count <= 1:
+            return
+        self._sync_seq += 1
+        client = None
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+        except (ImportError, AttributeError):
+            client = None
+        if client is not None:
+            # The jax.distributed coordination service: a host-side
+            # barrier with no device computation — works on every
+            # backend (the CPU stand-in included) and is exactly the
+            # rendezvous the commit protocol needs. Sequence-numbered
+            # ids keep repeated saves distinct.
+            client.wait_at_barrier(
+                f"kft-ckpt-{self._sync_seq}", timeout_in_ms=120_000
+            )
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(
+            f"kft-checkpoint-commit-{self._sync_seq}"
+        )
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _tmp_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{TMP_PREFIX}{int(step)}")
+
+    # ---- save ------------------------------------------------------------
+    def save(self, step: int, state) -> str:
+        """Synchronous save: blocks until the step is committed durable
+        (or raises). Returns the committed step directory."""
+        self.wait()
+        host = _snapshot(state, self.process_id)
+        return self._write(int(step), host)
+
+    def save_async(self, step: int, state) -> None:
+        """Double-buffered background save: the device→host snapshot is
+        taken synchronously (so the caller may immediately mutate or
+        donate ``state``), the durable write runs on a worker thread.
+        At most one save is in flight — a second call first waits out
+        the previous write (and surfaces its error, if any)."""
+        self.wait()
+        host = _snapshot(state, self.process_id)
+
+        def _run():
+            try:
+                self._write(int(step), host)
+            except BaseException as exc:
+                # Stashed, then re-raised by the next wait()/save() on
+                # the caller's thread — logged here too so a crash that
+                # never calls wait() still leaves a trace.
+                log.warning("background checkpoint save of step %d "
+                            "failed: %s", step, exc)
+                self._inflight_error = exc
+
+        self._inflight_error = None
+        self._inflight = threading.Thread(
+            target=_run, name=f"ckpt-save-{step}", daemon=True
+        )
+        self._inflight.start()
+
+    def wait(self) -> None:
+        """Join any in-flight background save; re-raise its failure."""
+        thread, self._inflight = self._inflight, None
+        if thread is not None:
+            thread.join()
+        error, self._inflight_error = self._inflight_error, None
+        if error is not None:
+            self.last_error = error
+            raise error
+
+    def _write(self, step: int, host: list[_HostLeaf]) -> str:
+        t0 = time.perf_counter()
+        with obs.get_tracer().span(
+            "checkpoint save",
+            attributes={"step": step, "dir": self.directory,
+                        "process": self.process_id},
+        ) as span:
+            tmp = self._tmp_dir(step)
+            os.makedirs(tmp, exist_ok=True)
+
+            # Per-process shard payload + meta, fsynced before the
+            # barrier: once process 0 commits, every shard it names is
+            # already durable.
+            payload = bytearray()
+            leaves_meta = {}
+            for leaf in host:
+                entries = []
+                for index, data in leaf.shards:
+                    raw = data.tobytes()
+                    entries.append({
+                        "index": index,
+                        "offset": len(payload),
+                        "size": len(raw),
+                        "digest": _sha256(raw),
+                    })
+                    payload.extend(raw)
+                leaves_meta[leaf.key] = {
+                    "shape": list(leaf.shape),
+                    "dtype": leaf.dtype,
+                    "shards": entries,
+                }
+            bin_name = f"shard-{self.process_id:05d}.bin"
+            meta_name = f"shard-{self.process_id:05d}.json"
+            _write_bytes(
+                os.path.join(tmp, bin_name), bytes(payload), self._fsync
+            )
+            self._emit("shard_written", step=step, file=bin_name)
+            meta = {
+                "process": self.process_id,
+                "process_count": self.process_count,
+                "leaves": leaves_meta,
+            }
+            _write_bytes(
+                os.path.join(tmp, meta_name),
+                json.dumps(meta, sort_keys=True).encode(),
+                self._fsync,
+            )
+            if self._fsync:
+                _fsync_dir(tmp)
+
+            self._sync()  # every process's shards are durable past here
+            self._emit("pre_manifest", step=step)
+
+            if self.process_id == 0:
+                self._commit(step, tmp, span)
+            self._sync()  # nobody returns before the commit landed
+        seconds = time.perf_counter() - t0
+        self.metrics.observe_save(seconds, step)
+        return self._step_dir(step)
+
+    def _commit(self, step: int, tmp: str, span) -> None:
+        expected = sorted(
+            f"shard-{pid:05d}.{ext}"
+            for pid in range(self.process_count)
+            for ext in ("bin", "json")
+        )
+        present = sorted(
+            n for n in os.listdir(tmp) if n.startswith("shard-")
+        )
+        missing = set(expected) - set(present)
+        if missing:
+            raise CheckpointCorrupt(
+                f"step {step}: shard files missing at the commit "
+                f"barrier: {sorted(missing)}"
+            )
+        # Stale leftovers in a reused _tmp.<step> (a crashed save with
+        # a DIFFERENT process count — e.g. resharded after preemption)
+        # must not be manifested: drop anything beyond this world.
+        for name in set(present) - set(expected):
+            os.unlink(os.path.join(tmp, name))
+        files = {
+            name: _sha256_file(os.path.join(tmp, name))
+            for name in expected
+        }
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": int(step),
+            "created_at": time.time(),
+            "fingerprint": self._fingerprint(),
+            "files": files,
+        }
+        _write_bytes(
+            os.path.join(tmp, MANIFEST_NAME),
+            json.dumps(manifest, sort_keys=True, indent=1).encode(),
+            self._fsync,
+        )
+        if self._fsync:
+            _fsync_dir(tmp)
+        self._emit("manifest_written", step=step)
+        final = self._step_dir(step)
+        if os.path.isdir(final):  # re-save of the same step: replace
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # THE commit point
+        if self._fsync:
+            _fsync_dir(self.directory)
+        self._emit("committed", step=step)
+        if span is not None:
+            span.add_event("committed", {"step": step})
+        self._gc()
+
+    def _fingerprint(self) -> dict:
+        fp = {"process_count": self.process_count}
+        try:
+            fp["backend"] = jax.default_backend()
+            fp["device_count"] = jax.device_count()
+        except Exception as exc:
+            log.debug("fingerprint backend probe failed: %s", exc)
+        fp.update(self.fingerprint)
+        return fp
+
+    def _gc(self) -> None:
+        """Retention: keep the newest ``keep`` committed steps; drop
+        older ones and every dangling ``_tmp.*`` from interrupted
+        saves. Runs on process 0 only, after a successful commit — a
+        failed save never GCs the good steps it would fall back to."""
+        committed = sorted(self.steps(), reverse=True)
+        for step in committed[self.keep:]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        for name in os.listdir(self.directory):
+            if name.startswith(TMP_PREFIX):
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+
+    # ---- enumeration / validation ---------------------------------------
+    def steps(self) -> list[int]:
+        """Committed steps (numeric directory + manifest present),
+        ascending. Junk entries — files, tmp dirs, non-numeric names,
+        torn dirs without a manifest — are not steps."""
+        try:
+            names = os.listdir(self.directory)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        out = []
+        for name in names:
+            if not name.isdigit():
+                continue
+            full = os.path.join(self.directory, name)
+            if os.path.isdir(full) and os.path.isfile(
+                os.path.join(full, MANIFEST_NAME)
+            ):
+                out.append(int(name))
+        return sorted(out)
+
+    def latest_committed_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def validate(self, step: int) -> list[str]:
+        """Problems with a committed step ([] = valid): manifest
+        readable, every listed file present with a matching sha256."""
+        return _validate_step_dir(self._step_dir(step))
+
+    # ---- restore ---------------------------------------------------------
+    def restore(self, step: int, like, placements=None):
+        """Restore one committed step into the shape of ``like``.
+        Raises :class:`CheckpointCorrupt` on any validation failure."""
+        with obs.get_tracer().span(
+            "checkpoint restore",
+            attributes={"step": int(step), "dir": self.directory},
+        ):
+            return _load_step_dir(self._step_dir(step), like, placements)
+
+    def restore_latest_valid(self, like, placements=None):
+        """(state, step) from the newest step that passes full
+        validation, skipping torn/corrupt ones; None when no valid
+        checkpoint exists. Outcomes land on
+        ``checkpoint_restore_total``: ``resumed`` on success, one
+        ``skipped_corrupt`` per bad step walked over, ``none`` when
+        nothing was restorable."""
+        for step in sorted(self.steps(), reverse=True):
+            # One pass, no pre-validate: the load itself verifies
+            # manifest, presence, per-shard content digests and slice
+            # coverage — pre-hashing every file first would double the
+            # restore I/O on multi-GB checkpoints.
+            try:
+                state = self.restore(step, like, placements)
+                self.metrics.observe_restore("resumed")
+                return state, step
+            except CheckpointCorrupt as exc:
+                self.metrics.observe_restore("skipped_corrupt")
+                log.warning(
+                    "checkpoint step %d is torn/corrupt, falling back "
+                    "(%s)", step, exc,
+                )
+        self.metrics.observe_restore("none")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# step-directory readers (shared by the manager and the thin wrappers)
+# ---------------------------------------------------------------------------
+
+
+def _validate_step_dir(step_dir: str) -> list[str]:
+    problems: list[str] = []
+    manifest_path = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(manifest_path, "rb") as fh:
+            manifest = json.loads(fh.read())
+    except (OSError, ValueError) as exc:
+        return [f"manifest unreadable: {exc}"]
+    files = manifest.get("files")
+    if not isinstance(files, dict) or not files:
+        return ["manifest lists no shard files"]
+    for name, digest in sorted(files.items()):
+        try:
+            actual = _sha256_file(os.path.join(step_dir, name))
+        except OSError as exc:
+            problems.append(f"shard file {name} missing: {exc}")
+            continue
+        if actual != digest:
+            problems.append(f"shard file {name} digest mismatch")
+    return problems
+
+
+def _read_manifest(step_dir: str) -> dict:
+    try:
+        with open(os.path.join(step_dir, MANIFEST_NAME), "rb") as fh:
+            return json.loads(fh.read())
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt(
+            f"{step_dir}: manifest unreadable: {exc}"
+        ) from exc
+
+
+def _load_step_dir(step_dir: str, like, placements=None):
+    """Assemble every leaf from the per-process shard files and place it
+    per ``placements`` (a pytree of shardings matching ``like``'s array
+    fields; None returns host numpy arrays)."""
+    manifest = _read_manifest(step_dir)
+    blobs: dict[str, bytes] = {}
+    metas: list[dict] = []
+    for name in sorted(manifest.get("files") or {}):
+        full = os.path.join(step_dir, name)
+        try:
+            with open(full, "rb") as fh:
+                data = fh.read()
+        except OSError as exc:
+            raise CheckpointCorrupt(
+                f"{step_dir}: shard file {name} missing: {exc}"
+            ) from exc
+        if name.endswith(".json"):
+            try:
+                metas.append(json.loads(data))
+            except ValueError as exc:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: shard meta {name} unreadable: {exc}"
+                ) from exc
+        else:
+            blobs[name] = data
+
+    # leaf key -> merged view across every process's meta.
+    leaves: dict[str, dict] = {}
+    for meta in metas:
+        bin_name = f"shard-{int(meta.get('process', 0)):05d}.bin"
+        for key, info in (meta.get("leaves") or {}).items():
+            slot = leaves.setdefault(key, {
+                "shape": tuple(info["shape"]),
+                "dtype": info["dtype"],
+                "shards": [],
+            })
+            if slot["shape"] != tuple(info["shape"]):
+                raise CheckpointCorrupt(
+                    f"{step_dir}: leaf {key} shape disagrees across "
+                    "process metas"
+                )
+            for entry in info["shards"]:
+                slot["shards"].append((bin_name, entry))
+
+    template = _flatten_keys(_arrays_only(like))
+    placement_leaves = None
+    if placements is not None:
+        placement_leaves = [
+            leaf for _, leaf in _flatten_keys(placements)
+        ]
+        if len(placement_leaves) != len(template):
+            raise ValueError(
+                "placements tree does not match the template's array "
+                f"fields ({len(placement_leaves)} vs {len(template)})"
+            )
+
+    restored_leaves = []
+    for pos, (key, tmpl_leaf) in enumerate(template):
+        info = leaves.get(key)
+        if info is None:
+            raise CheckpointCorrupt(
+                f"{step_dir}: leaf {key} absent from every shard meta"
+            )
+        shape = info["shape"]
+        tmpl_shape = tuple(np.shape(tmpl_leaf))
+        if shape != tmpl_shape:
+            raise ValueError(
+                f"checkpoint leaf {key} has shape {shape}, template "
+                f"expects {tmpl_shape}"
+            )
+        dtype = _resolve_dtype(info["dtype"])
+        full = np.empty(shape, dtype)
+        covered = 0
+        # Dedupe by global index: a leaf replicated per *process* (not
+        # via a global mesh) is written once per process with the same
+        # covering index — identical content, counted once.
+        unique = {
+            tuple(tuple(int(x) for x in pair) for pair in entry["index"]):
+                (bin_name, entry)
+            for bin_name, entry in info["shards"]
+        }
+        for bin_name, entry in unique.values():
+            blob = blobs.get(bin_name)
+            if blob is None:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: payload {bin_name} for leaf {key} "
+                    "missing"
+                )
+            off, size = int(entry["offset"]), int(entry["size"])
+            raw = blob[off:off + size]
+            if len(raw) != size:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: payload {bin_name} truncated "
+                    f"(leaf {key})"
+                )
+            if _sha256(raw) != entry["digest"]:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: content digest mismatch on leaf {key}"
+                )
+            index = [[int(a), int(b)] for a, b in entry["index"]]
+            sub_shape = tuple(b - a for a, b in index)
+            data = np.frombuffer(raw, dtype).reshape(sub_shape)
+            full[_index_slices(index)] = data
+            covered += int(np.prod(sub_shape, dtype=np.int64)) if sub_shape else 1
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if covered != size:
+            raise CheckpointCorrupt(
+                f"{step_dir}: leaf {key} coverage {covered}/{size} "
+                "elements (missing shards)"
+            )
+        tmpl_dtype = getattr(tmpl_leaf, "dtype", None)
+        if tmpl_dtype is not None and np.dtype(tmpl_dtype) != dtype:
+            full = full.astype(tmpl_dtype)
+        if placement_leaves is not None:
+            sharding = placement_leaves[pos]
+            full = jax.make_array_from_callback(
+                shape, sharding, lambda idx, _full=full: _full[idx]
+            )
+        restored_leaves.append(full)
+
+    treedef = jax.tree_util.tree_structure(_arrays_only(like))
+    restored = jax.tree_util.tree_unflatten(treedef, restored_leaves)
+    return _merge_static(like, restored)
+
+
+# ---------------------------------------------------------------------------
+# placement policy (unchanged semantics from the orbax-era restore)
+# ---------------------------------------------------------------------------
+
+
+def _compute_placements(template, mesh, tp_rules: dict | None = None):
+    """Target sharding per leaf. With a mesh: the template's actual
+    shardings are reused verbatim when they live on that mesh (Megatron
+    tp layouts included), the canonical dp/fsdp layout (tp_rules for
+    abstract templates) otherwise. Without: single-device placement —
+    a mesh-saved checkpoint restoring on one chip must not inherit the
+    save-time topology."""
+    if mesh is not None:
+        computed = state_shardings(template, mesh, tp_rules=tp_rules)
+
+        def pick(leaf, fallback):
+            s = getattr(leaf, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding) and s.mesh == mesh:
+                return s
+            return fallback
+
+        return jax.tree.map(pick, template, computed)
+    device = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return jax.tree.map(lambda _leaf: device, template)
+
+
+# ---------------------------------------------------------------------------
+# thin wrappers (pre-manager call sites keep working)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
+    """Write ``state`` (TrainState or any pytree of arrays) under
+    ``path``. Blocks until durable AND atomically committed (the
+    notebook PVC survives pod restarts; a half-written checkpoint must
+    not be restorable). With ``step``, ``path`` is a checkpoint root and
+    the step directory is returned; without, ``path`` itself is the
+    (single) checkpoint."""
+    path = os.path.abspath(os.fspath(path))
+    if step is not None:
+        return CheckpointManager(path).save(step, state)
+    CheckpointManager(path).save(0, state)
+    return path
+
+
+def restore_checkpoint(path: str | os.PathLike, like, mesh=None,
+                       tp_rules: dict | None = None):
+    """Restore into the shape of ``like`` (a TrainState template from
+    ``create_train_state`` — supplies tx/apply_fn and leaf shapes).
+    With ``mesh``, leaves come back sharded with the save-time canonical
+    layout: when ``like``'s leaves are committed arrays on ``mesh``
+    (the template from create_train_state/create_lm_state), their actual
+    shardings are reused verbatim — including Megatron tp layouts — and
+    ``tp_rules`` covers abstract templates (pass the model's rules, e.g.
+    transformer.LM_TP_RULES, or tp-sharded kernels restore replicated).
+
+    ``path`` may be a checkpoint root (the newest valid step is
+    restored), a specific step directory, or a stepless
+    ``save_checkpoint`` target."""
+    path = os.path.abspath(os.fspath(path))
+    template = _arrays_only(like)
+    placements = _compute_placements(template, mesh, tp_rules)
+    if os.path.isfile(os.path.join(path, MANIFEST_NAME)):
+        problems = _validate_step_dir(path)
+        if problems:
+            raise CheckpointCorrupt(f"{path}: " + "; ".join(problems))
+        return _load_step_dir(path, like, placements)
+    manager = CheckpointManager(path)
+    if os.path.isfile(os.path.join(path, "0", MANIFEST_NAME)) and \
+            manager.steps() == [0]:
+        # Stepless save_checkpoint layout: exactly one step, number 0.
+        return manager.restore(0, like, placements)
+    result = manager.restore_latest_valid(like, placements)
+    if result is None:
+        raise FileNotFoundError(
+            f"no valid checkpoint under {path} (torn/corrupt steps are "
+            "skipped; see checkpoint_restore_total)"
+        )
+    state, _step = result
+    return state
+
+
+def latest_step(path: str | os.PathLike) -> int | None:
+    """Highest numbered step directory under ``path`` (save_checkpoint
+    with step=N layout), or None when no checkpoint exists. Junk
+    entries are ignored: non-numeric names, regular files that happen
+    to be named like steps, and dangling ``_tmp.*`` dirs left behind by
+    interrupted saves."""
+    path = os.path.abspath(os.fspath(path))
+    try:
+        names = os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    steps = [
+        int(name) for name in names
+        if name.isdigit() and os.path.isdir(os.path.join(path, name))
+    ]
+    return max(steps, default=None)
+
+
+# ---------------------------------------------------------------------------
+# env plumbing (webhook PodDefault -> training loop)
+# ---------------------------------------------------------------------------
+
+
+def cadence_from_env(env=None) -> tuple[int, float]:
+    """(save_every_steps, save_every_s) from the platform-injected env;
+    0 disables the respective cadence."""
+    env = os.environ if env is None else env
+
+    def _num(key, cast, default):
+        raw = env.get(key, "")
+        try:
+            return cast(raw)
+        except (TypeError, ValueError):
+            return default
+
+    return (
+        _num(ENV_CHECKPOINT_EVERY_STEPS, int, 0),
+        _num(ENV_CHECKPOINT_EVERY_S, float, 0.0),
+    )
+
+
+def manager_from_env(env=None, **overrides) -> CheckpointManager | None:
+    """A manager rooted at ``KFT_CHECKPOINT_DIR`` with the process
+    identity jax.distributed established, or None when the platform did
+    not inject a checkpoint dir (checkpointing disabled)."""
+    env = os.environ if env is None else env
+    directory = env.get(ENV_CHECKPOINT_DIR)
+    if not directory:
+        return None
+    kwargs: dict = {}
+    try:
+        keep = int(env.get(ENV_CHECKPOINT_KEEP, ""))
+        kwargs["keep"] = keep
+    except (TypeError, ValueError):
+        pass  # analysis: allow[py-broad-except] — unset/garbage: default
+    try:
+        kwargs["process_id"] = jax.process_index()
+        kwargs["process_count"] = jax.process_count()
+    except Exception as exc:
+        log.debug("jax process identity unavailable: %s", exc)
+    kwargs.update(overrides)
+    return CheckpointManager(directory, **kwargs)

@@ -11,6 +11,10 @@ collectives — no hand-written communication.
 from __future__ import annotations
 
 import dataclasses
+import logging
+import signal
+import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -20,6 +24,8 @@ from flax import core, struct
 from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import batch_sharding, param_sharding
+
+log = logging.getLogger(__name__)
 
 
 class TrainState(struct.PyTreeNode):
@@ -158,8 +164,6 @@ def run_steps(step, state, batches, telemetry=None):
     gauges. Without telemetry, steps stay fully async — the hook costs
     nothing unless it is plugged in.
     """
-    import time
-
     metrics = None
     for batch in batches:
         if telemetry is None:
@@ -167,11 +171,139 @@ def run_steps(step, state, batches, telemetry=None):
             continue
         t0 = time.perf_counter()
         state, metrics = step(state, batch)
+        _observe_synced(telemetry, metrics, batch, t0)
+    return state, metrics
+
+
+def _observe_synced(telemetry, metrics, batch, t0: float) -> None:
+    """Host-synced step timing shared by run_steps and
+    run_with_checkpointing: a scalar ``device_get`` forces the
+    dependency chain (async dispatch would report enqueue time, not
+    step time) before the wall clock is read."""
+    if metrics:
         first = next(iter(metrics.values()))
         float(jax.device_get(first))
-        batch_size = len(next(iter(batch.values())))
-        telemetry.observe(batch_size, time.perf_counter() - t0)
-    return state, metrics
+    batch_size = len(next(iter(batch.values())))
+    telemetry.observe(batch_size, time.perf_counter() - t0)
+
+
+@dataclasses.dataclass
+class RunReport:
+    """What a checkpointed run actually did — the numbers the chaos
+    tier asserts lost-work bounds against."""
+
+    resumed_from_step: int | None = None
+    start_step: int = 0
+    final_step: int = 0
+    saves: int = 0
+    preempted: bool = False
+
+
+def run_with_checkpointing(
+    step_fn,
+    state,
+    batches,
+    manager,
+    *,
+    save_every_steps: int = 0,
+    save_every_s: float = 0.0,
+    mesh: Mesh | None = None,
+    tp_rules: dict | None = None,
+    telemetry=None,
+    install_signal_handler: bool = True,
+    clock=time.monotonic,
+):
+    """Drive ``step_fn`` over ``batches`` with the preemption-to-resume
+    contract the platform promises (ISSUE 4 / SURVEY §5):
+
+    - **auto-resume**: before the first step, the newest *valid*
+      checkpoint under ``manager`` is restored (torn/corrupt steps are
+      skipped) and training continues from its step; ``state`` doubles
+      as the restore template (tx/apply_fn and target shardings come
+      from it, via the same placement policy as ``restore_checkpoint``).
+    - **cadence**: a background (double-buffered) save every
+      ``save_every_steps`` steps and/or every ``save_every_s`` seconds
+      of wall clock — whichever fires first; 0 disables that trigger.
+    - **preemption**: on SIGTERM (the kubelet's grace-window signal
+      ahead of a TPU preemption) the loop finishes the in-flight step,
+      takes one final *synchronous* checkpoint, and returns with
+      ``report.preempted`` set.
+
+    Returns ``(state, RunReport)``. ``batches`` yields per-step batch
+    dicts; the caller owns data-order alignment with the global step
+    (e.g. seed the iterator from ``report.start_step``— which is why
+    resume happens before the first batch is drawn).
+    """
+    from kubeflow_tpu.models import checkpoint as ckpt
+
+    report = RunReport()
+    placements = ckpt._compute_placements(
+        ckpt._arrays_only(state), mesh, tp_rules
+    ) if (mesh is not None or hasattr(state, "params")) else None
+    resumed = manager.restore_latest_valid(state, placements)
+    if resumed is not None:
+        state, step = resumed
+        report.resumed_from_step = step
+        log.info("resumed from checkpoint step %d", step)
+    else:
+        step = _state_step(state)
+    report.start_step = report.final_step = step
+
+    stop = threading.Event()
+    previous_handler = None
+    if install_signal_handler:
+        def _on_sigterm(signum, frame):
+            stop.set()
+        try:
+            previous_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            previous_handler = None  # not the main thread: caller's job
+
+    last_save_at = clock()
+    try:
+        for batch in batches:
+            if stop.is_set():
+                break
+            t0 = time.perf_counter()
+            state, metrics = step_fn(state, batch)
+            step += 1
+            report.final_step = step
+            if telemetry is not None:
+                _observe_synced(telemetry, metrics, batch, t0)
+            if stop.is_set():
+                break  # final sync save below covers this step
+            due_steps = save_every_steps and step % save_every_steps == 0
+            due_clock = save_every_s and clock() - last_save_at >= save_every_s
+            if due_steps or due_clock:
+                manager.save_async(step, state)
+                report.saves += 1
+                last_save_at = clock()
+        if stop.is_set():
+            # Preemption grace window: one last synchronous checkpoint
+            # (save() first drains the in-flight background save) so at
+            # most the in-flight step is lost, not a whole cadence.
+            report.preempted = True
+            if step > 0 or report.resumed_from_step is not None:
+                manager.save(step, state)
+                report.saves += 1
+        else:
+            manager.wait()
+    finally:
+        if previous_handler is not None:
+            signal.signal(signal.SIGTERM, previous_handler)
+    return state, report
+
+
+def _state_step(state) -> int:
+    step = getattr(state, "step", None)
+    if step is None and isinstance(state, dict):
+        step = state.get("step")
+    if step is None:
+        return 0
+    try:
+        return int(jax.device_get(step))
+    except (TypeError, ValueError):
+        return 0
 
 
 def make_eval_step():

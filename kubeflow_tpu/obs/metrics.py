@@ -22,7 +22,7 @@ import threading
 CANONICAL_LABELS = frozenset({
     "namespace", "name", "controller",
     "accelerator", "verb", "kind", "result", "mode", "severity",
-    "method", "endpoint", "code",
+    "method", "endpoint", "code", "outcome",
     "le", "quantile",
 })
 

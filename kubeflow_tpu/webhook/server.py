@@ -564,7 +564,10 @@ def tpu_env_poddefault(namespace: str) -> dict:
     kubeflow_tpu.parallel.initialize_from_env) and the TPU toleration.
     The per-rank env (TPU_WORKER_ID, hostnames, coordinator) comes from
     the notebook controller; this PodDefault covers what is common to
-    every TPU pod in the namespace."""
+    every TPU pod in the namespace — including the checkpoint/resume
+    contract (models/checkpoint.py manager_from_env reads these): the
+    checkpoint root on the workspace PVC and the save cadence, tuned so
+    a preemption loses at most ~100 steps or 5 minutes of work."""
     return {
         "apiVersion": PODDEFAULT_API,
         "kind": "PodDefault",
@@ -577,6 +580,13 @@ def tpu_env_poddefault(namespace: str) -> dict:
                 # Fail fast instead of silently hiding chips when the
                 # device plugin hands us fewer than requested.
                 {"name": "TPU_MIN_LOG_LEVEL", "value": "0"},
+                # Crash-consistent checkpointing (ISSUE 4): root on the
+                # PVC that survives slice restarts; cadence by steps
+                # AND wall clock, whichever fires first.
+                {"name": "KFT_CHECKPOINT_DIR",
+                 "value": "/home/jovyan/checkpoints"},
+                {"name": "KFT_CHECKPOINT_EVERY_STEPS", "value": "100"},
+                {"name": "KFT_CHECKPOINT_EVERY_S", "value": "300"},
             ],
             "tolerations": [
                 {

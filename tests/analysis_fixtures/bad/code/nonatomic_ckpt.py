@@ -1,0 +1,19 @@
+"""Seeded violations for py-nonatomic-write: durable checkpoint/state
+files written in place — a crash mid-write leaves a torn file the next
+restore happily half-reads."""
+
+import json
+
+
+def save_checkpoint_meta(directory, step, meta):
+    # Violation 1: the checkpoint manifest written directly to its
+    # final name; no tmp + os.replace commit anywhere in this function.
+    with open(f"{directory}/{step}/manifest.json", "w") as fh:
+        json.dump(meta, fh)
+
+
+def persist_state(state_path, blob):
+    # Violation 2: binary train-state payload, same torn-write hazard.
+    fh = open(state_path + ".ckpt", "wb")
+    fh.write(blob)
+    fh.close()

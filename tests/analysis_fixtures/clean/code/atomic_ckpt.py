@@ -1,0 +1,37 @@
+"""Clean counterparts for py-nonatomic-write: the tmp+rename commit
+idiom, readers, non-state writes, and a pragma'd deliberate exception."""
+
+import json
+import os
+
+
+def save_checkpoint_meta(directory, step, meta):
+    # The write-then-rename commit: the direct write targets a temp
+    # name, os.replace makes the final name appear atomically.
+    final = f"{directory}/{step}/manifest.json"
+    tmp = final + ".part"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, final)
+
+
+def read_checkpoint_meta(directory, step):
+    # Reads are never flagged, whatever the path looks like.
+    with open(f"{directory}/{step}/manifest.json") as fh:
+        return json.load(fh)
+
+
+def write_report(path, lines):
+    # Writable, but not a checkpoint/state file: out of scope.
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+def overwrite_scratch_state(path, blob):
+    # Deliberate direct write, annotated: scratch state whose loss is
+    # acceptable by design.
+    # analysis: allow[py-nonatomic-write]
+    with open(path + ".state", "wb") as fh:
+        fh.write(blob)

@@ -166,8 +166,65 @@ def main_ring():
     print(f"DONE {jax.process_index()}", flush=True)
 
 
+def main_ckpt():
+    """KFT_TEST_MODE=ckpt: the multi-host checkpoint commit discipline
+    over a real jax.distributed world — every process writes only the
+    shards it owns into the shared dir (the PVC stand-in), all
+    processes meet the commit barrier, process 0 alone writes the
+    manifest and renames the step into place, and every process then
+    restores the same bit-identical global array."""
+    denv = initialize_from_env()
+
+    import hashlib
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.models.checkpoint import CheckpointManager
+    from kubeflow_tpu.parallel import MeshSpec, make_mesh
+
+    world = len(jax.devices())
+    mesh = make_mesh(MeshSpec(dp=-1), jax.devices())
+    sharding = NamedSharding(mesh, P("dp"))
+    values = np.arange(world * 4, dtype=np.float32)
+    x = jax.make_array_from_callback(
+        values.shape, sharding, lambda idx: values[idx]
+    )
+    step_scalar = jax.make_array_from_callback(
+        (), NamedSharding(mesh, P()), lambda idx: np.int32(7)
+    )
+    state = {"w": x, "step": step_scalar}
+
+    manager = CheckpointManager(
+        os.environ["KFT_CKPT_DIR"],
+        process_id=jax.process_index(),
+        process_count=denv.num_processes,
+    )
+    manager.save(7, state)
+    print(f"SAVED {jax.process_index()} steps={manager.steps()}",
+          flush=True)
+
+    like = {"w": np.zeros_like(values), "step": np.int32(0)}
+    placements = {"w": sharding, "step": NamedSharding(mesh, P())}
+    restored, step = manager.restore_latest_valid(like, placements)
+    assert step == 7, step
+    for shard in restored["w"].addressable_shards:
+        assert np.array_equal(np.asarray(shard.data), values[shard.index])
+    assert int(jax.device_get(restored["step"])) == 7
+    digest = hashlib.sha256(np.asarray(
+        restored["w"].addressable_shards[0].data
+    ).tobytes()).hexdigest()[:12]
+    print(f"CKPT {jax.process_index()} step={step} local={digest}",
+          flush=True)
+    print(f"DONE {jax.process_index()}", flush=True)
+
+
 if __name__ == "__main__":
-    if os.environ.get("KFT_TEST_MODE") == "ring4":
+    mode = os.environ.get("KFT_TEST_MODE")
+    if mode == "ring4":
         main_ring()
+    elif mode == "ckpt":
+        main_ckpt()
     else:
         main()

@@ -194,6 +194,109 @@ class TestPrintRuleExemptions:
         assert self._findings(src, "tests/distributed_worker.py") == []
 
 
+class TestNonatomicWriteRule:
+    """py-nonatomic-write: direct writes of checkpoint/state files gate;
+    the tmp+os.replace commit idiom, readers, non-state writes and
+    pragma'd exceptions stay quiet."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-nonatomic-write", "nonatomic_ckpt.py")
+        assert sorted(f.line for f in hits) == [11, 17]
+        assert all(f.severity == Severity.ERROR for f in hits)
+        assert "os.replace" in hits[0].message
+
+    def _findings(self, source, path="kubeflow_tpu/store.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-nonatomic-write"
+        ]
+
+    def test_rename_commit_in_scope_is_clean(self):
+        src = (
+            "import os\n"
+            "def save(p, b):\n"
+            "    with open(p + '.ckpt.part', 'wb') as fh:\n"
+            "        fh.write(b)\n"
+            "    os.replace(p + '.ckpt.part', p + '.ckpt')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_direct_write_fires_even_with_mode_kwarg(self):
+        src = (
+            "def save(p, b):\n"
+            "    with open(p + '.ckpt', mode='wb') as fh:\n"
+            "        fh.write(b)\n"
+        )
+        assert len(self._findings(src)) == 1
+
+    def test_reads_and_unrelated_writes_are_clean(self):
+        src = (
+            "def load(p):\n"
+            "    with open(p + '.ckpt') as fh:\n"
+            "        return fh.read()\n"
+            "def log(p, line):\n"
+            "    with open(p + '.log', 'w') as fh:\n"
+            "        fh.write(line)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_module_level_write_fires(self):
+        src = "open('checkpoint.json', 'w').write('{}')\n"
+        assert len(self._findings(src)) == 1
+
+    def test_str_replace_is_not_a_commit(self):
+        # path.replace('-', '_') is string munging, not os.replace: the
+        # direct write still gates.
+        src = (
+            "def save(p, b):\n"
+            "    name = p.replace('-', '_')\n"
+            "    with open(name + '.ckpt', 'wb') as fh:\n"
+            "        fh.write(b)\n"
+        )
+        assert len(self._findings(src)) == 1
+
+    def test_nested_function_has_its_own_scope(self):
+        # The os.replace lives in the OUTER function; the nested
+        # function's direct write has no commit of its own.
+        src = (
+            "import os\n"
+            "def outer(p):\n"
+            "    os.replace(p, p)\n"
+            "    def inner(q, b):\n"
+            "        with open(q + '.ckpt', 'wb') as fh:\n"
+            "            fh.write(b)\n"
+            "    return inner\n"
+        )
+        assert len(self._findings(src)) == 1
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        # Pragma filtering is the engine's job: go through analyze_paths.
+        src = (
+            "def save(p, b):\n"
+            "    # analysis: allow[py-nonatomic-write]\n"
+            "    with open(p + '.ckpt', 'wb') as fh:\n"
+            "        fh.write(b)\n"
+        )
+        target = tmp_path / "pragma_ckpt.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings if f.rule == "py-nonatomic-write"] == []
+        # Same file minus the pragma gates.
+        target.write_text(src.replace(
+            "    # analysis: allow[py-nonatomic-write]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len(
+            [f for f in findings if f.rule == "py-nonatomic-write"]
+        ) == 1
+
+
 class TestCleanFixtures:
     def test_clean_tree_is_silent(self):
         findings = analyze_paths(

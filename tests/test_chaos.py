@@ -1127,3 +1127,244 @@ def metric_value(prom, namespace):
     return prom.notebook_preemption_restart_total.labels(
         namespace
     )._value.get()
+
+
+# ---------------------------------------------------------------------------
+# preemption × apiserver weather interplay (injector retry policy)
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionDuringBlackout:
+    """A preemption decided by the cloud provider is not cancellable:
+    the injector firing DURING an injected apiserver blackout must
+    retry its pod delete through the retry policy until it lands, not
+    drop it (the old behavior silently skipped the preemption and the
+    scenario tested nothing)."""
+
+    def _policy(self, attempts=60):
+        from kubeflow_tpu.k8s.retry import RetryPolicy
+
+        return RetryPolicy(max_attempts=attempts, base_delay=0.0,
+                           max_delay=0.0)
+
+    def _world(self):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(api)
+        api.create(chaos_notebook(
+            "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+        ))
+        run_to_convergence([ctrl], [sim])
+        return api, ctrl, sim
+
+    def test_preemption_fired_during_blackout_lands(self):
+        api, ctrl, sim = self._world()
+        before = {
+            p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in api.list("v1", "Pod", namespace="user")
+        }
+        schedule = FaultSchedule(seed=71).blackout(0, 12)
+        chaos = ChaosApiServer(api, schedule, sleep=lambda s: None)
+        injector = PreemptionInjector(
+            chaos, retry_policy=self._policy(), sleep=lambda s: None
+        )
+        node = injector.preempt_worker("user", "mesh", 1)
+        assert node == "tpu-node-mesh-1"
+        assert chaos.injected["blackout"] > 0, "blackout never fired"
+        assert injector.retries_total > 0, "injector never retried"
+        # The delete LANDED despite the blackout window.
+        with pytest.raises(NotFound):
+            api.get("v1", "Pod", "mesh-1", "user")
+        # And recovery proceeds to the usual coherent outcome.
+        run_to_convergence([ctrl], [sim])
+        after = {
+            p["metadata"]["name"]: p["metadata"]["uid"]
+            for p in api.list("v1", "Pod", namespace="user")
+        }
+        assert set(after) == set(before)
+        assert not set(before.values()) & set(after.values())
+
+    def test_attempts_exhausted_surfaces_the_error(self):
+        api, _ctrl, _sim = self._world()
+        schedule = FaultSchedule(seed=72).blackout(0, 500)
+        chaos = ChaosApiServer(api, schedule, sleep=lambda s: None)
+        injector = PreemptionInjector(
+            chaos, retry_policy=self._policy(attempts=5),
+            sleep=lambda s: None,
+        )
+        with pytest.raises(ApiError):
+            injector.preempt_worker("user", "mesh", 1)
+        # Nothing landed, nothing recorded as preempted.
+        assert injector.preempted == []
+        api.get("v1", "Pod", "mesh-1", "user")  # still alive
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume: the data-plane closes the preemption loop
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_imports():
+    from kubeflow_tpu.chaos.ckpt import (
+        CheckpointKiller,
+        SimulatedCrash,
+        drop_shard,
+        truncate_shard,
+    )
+    from kubeflow_tpu.models.checkpoint import (
+        CheckpointManager,
+        CheckpointMetrics,
+    )
+    from kubeflow_tpu.models.train import run_with_checkpointing
+
+    return (CheckpointManager, CheckpointMetrics, run_with_checkpointing,
+            CheckpointKiller, SimulatedCrash, drop_shard, truncate_shard)
+
+
+class TestCheckpointResume:
+    """The acceptance scenario (ISSUE 4): with save cadence N, a seeded
+    preemption mid-training resumes from the last committed step with
+    <= N steps of lost work, restored params bit-identical to the
+    committed checkpoint, and the whole handshake visible on the
+    Notebook CR (resume-expected annotation + status.resumedFromStep).
+    """
+
+    CADENCE = 5
+
+    @staticmethod
+    def _step_fn(state, batch):
+        import numpy as np
+
+        return (
+            {"w": state["w"] + batch["x"], "step": state["step"] + 1},
+            {"loss": np.float32(0.0)},
+        )
+
+    @staticmethod
+    def _state0():
+        import numpy as np
+
+        return {"w": np.zeros(8, np.float32), "step": np.int32(0)}
+
+    @staticmethod
+    def _batches(n):
+        import numpy as np
+
+        return [{"x": np.ones(8, np.float32)} for _ in range(n)]
+
+    def _slice_world(self):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(api)
+        api.create(chaos_notebook(
+            "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+        ))
+        run_to_convergence([ctrl], [sim])
+        return api, ctrl, sim
+
+    def test_preempt_slice_restart_resume_end_to_end(self, tmp_path):
+        import numpy as np
+
+        from kubeflow_tpu.controllers.notebook import (
+            CHECKPOINT_STEP_KEY,
+            RESUME_EXPECTED_KEY,
+        )
+
+        (CheckpointManager, CheckpointMetrics, run_with_checkpointing,
+         *_rest) = _ckpt_imports()
+        api, ctrl, sim = self._slice_world()
+
+        # Generation 1 trains 13 steps with cadence 5: commits 5, 10.
+        mgr = CheckpointManager(tmp_path)
+        _state, report = run_with_checkpointing(
+            self._step_fn, self._state0(), self._batches(13), mgr,
+            save_every_steps=self.CADENCE, install_signal_handler=False,
+        )
+        last = mgr.latest_committed_step()
+        assert last == 10
+        # The in-image reporter mirrors the committed step to the CR.
+        api.patch_merge(
+            NOTEBOOK_API, "Notebook", "mesh",
+            {"metadata": {"annotations": {CHECKPOINT_STEP_KEY: str(last)}}},
+            "user",
+        )
+
+        # Preemption: a worker vanishes; the controller restarts the
+        # whole slice and stamps the resume handshake.
+        PreemptionInjector(api).preempt_worker("user", "mesh", 2)
+        run_to_convergence([ctrl], [sim])
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        anns = nb_obj["metadata"]["annotations"]
+        assert anns.get(RESUME_EXPECTED_KEY) == str(last)
+        assert nb_obj["status"].get("resumedFromStep") == last
+        reasons = {e["reason"] for e in api.list("v1", "Event",
+                                                 namespace="user")}
+        assert "SliceRestarted" in reasons
+
+        # Generation 2 (the restarted slice): auto-resume.
+        metrics = CheckpointMetrics()
+        mgr2 = CheckpointManager(tmp_path, metrics=metrics)
+        state2, report2 = run_with_checkpointing(
+            self._step_fn, self._state0(), self._batches(3), mgr2,
+            save_every_steps=self.CADENCE, install_signal_handler=False,
+        )
+        assert report2.resumed_from_step == last
+        lost = report.final_step - last
+        assert 0 < lost <= self.CADENCE, (
+            f"lost {lost} steps, cadence {self.CADENCE}"
+        )
+        # Bit-identical restored state: w at the committed step is
+        # exactly `last` (integer arithmetic, no tolerance).
+        assert np.array_equal(
+            state2["w"], np.full(8, float(last + 3), np.float32)
+        )
+        assert metrics.restore_total.get("resumed", 0) >= 1
+
+    def test_kill_mid_save_never_yields_corrupt_step(self, tmp_path):
+        import numpy as np
+
+        (CheckpointManager, CheckpointMetrics, run_with_checkpointing,
+         CheckpointKiller, SimulatedCrash, drop_shard,
+         truncate_shard) = _ckpt_imports()
+
+        # Generation 1 commits step 5, then the preemption SIGKILL
+        # lands between shard writes of step 10.
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(5, {"w": np.arange(8, dtype=np.float32), "step": np.int32(5)})
+        killer = CheckpointKiller("shard_written")
+        dying = CheckpointManager(tmp_path, hook=killer)
+        with pytest.raises(SimulatedCrash):
+            dying.save(10, {"w": np.zeros(8), "step": np.int32(10)})
+
+        metrics = CheckpointMetrics()
+        mgr2 = CheckpointManager(tmp_path, metrics=metrics)
+        like = {"w": np.zeros(8, np.float32), "step": np.int32(0)}
+        state, step = mgr2.restore_latest_valid(like)
+        assert step == 5, "torn step was not skipped"
+        assert np.array_equal(state["w"], np.arange(8, dtype=np.float32))
+
+        # Truncated shard and manifest-present-but-shard-missing on a
+        # COMMITTED step: digests catch both, prior step restores.
+        mgr2.save(10, {"w": np.ones(8, np.float32), "step": np.int32(10)})
+        truncate_shard(tmp_path, 10)
+        _state, step = mgr2.restore_latest_valid(like)
+        assert step == 5
+        mgr2.save(15, {"w": np.ones(8, np.float32), "step": np.int32(15)})
+        drop_shard(tmp_path, 15)
+        _state, step = mgr2.restore_latest_valid(like)
+        assert step == 5
+        assert metrics.restore_total["skipped_corrupt"] >= 2
+
+    def test_resume_expected_defaults_to_zero_without_checkpoint(self):
+        from kubeflow_tpu.controllers.notebook import RESUME_EXPECTED_KEY
+
+        api, ctrl, sim = self._slice_world()
+        PreemptionInjector(api).preempt_worker("user", "mesh", 0)
+        run_to_convergence([ctrl], [sim])
+        nb_obj = api.get(NOTEBOOK_API, "Notebook", "mesh", "user")
+        assert nb_obj["metadata"]["annotations"].get(
+            RESUME_EXPECTED_KEY
+        ) == "0"
+        assert nb_obj["status"].get("resumedFromStep") == 0

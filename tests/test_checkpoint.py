@@ -1,18 +1,40 @@
-"""Checkpoint round-trip tests: save sharded, restore sharded (dp×fsdp
-mesh placement) and restore single-device — the in-notebook resume story
-layered over the platform's PVC persistence (SURVEY.md §5)."""
+"""Checkpoint tests: the sharded round-trip story (save sharded,
+restore sharded or single-device) layered over the platform's PVC
+persistence (SURVEY.md §5), and the crash-consistency contract of the
+CheckpointManager (ISSUE 4): atomic commit under injected kill points,
+digest-verified fallback past corrupt steps, retention/GC, the
+multi-host commit barrier over a real jax.distributed world, and the
+train loop's auto-resume + SIGTERM grace-window checkpoint."""
+
+import os
+import signal
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.chaos.ckpt import (
+    CheckpointKiller,
+    SimulatedCrash,
+    drop_shard,
+    flip_shard_bytes,
+    truncate_shard,
+)
 from kubeflow_tpu.models import create_train_state, make_train_step, resnet18
 from kubeflow_tpu.models.checkpoint import (
+    ENV_CHECKPOINT_DIR,
+    ENV_CHECKPOINT_EVERY_S,
+    ENV_CHECKPOINT_EVERY_STEPS,
+    MANIFEST_NAME,
+    CheckpointManager,
+    cadence_from_env,
     latest_step,
+    manager_from_env,
     restore_checkpoint,
     save_checkpoint,
 )
+from kubeflow_tpu.models.train import run_with_checkpointing
 from kubeflow_tpu.parallel import MeshSpec, batch_sharding, make_mesh
 
 
@@ -176,3 +198,437 @@ class TestPipelinedCheckpoint:
         assert tree_equal(restored.params, state.params)
         restored, metrics = step(restored, {"tokens": tokens})
         assert np.isfinite(float(metrics["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: crash consistency, corruption fallback, retention
+# ---------------------------------------------------------------------------
+
+
+def small_state(step: int):
+    return {
+        "w": np.arange(16, dtype=np.float32) + step,
+        "b": np.full((2, 3), float(step), np.float32),
+        "step": np.int32(step),
+    }
+
+
+def small_like():
+    return {
+        "w": np.zeros(16, np.float32),
+        "b": np.zeros((2, 3), np.float32),
+        "step": np.int32(0),
+    }
+
+
+class TestManagerAtomicity:
+    """A save is all-or-nothing: a kill at ANY point of the protocol
+    before the rename commit leaves the previous step as the newest
+    valid one, bit-identical."""
+
+    @pytest.mark.parametrize(
+        "point", ["shard_written", "pre_manifest", "manifest_written"]
+    )
+    def test_kill_before_commit_preserves_previous_step(
+        self, tmp_path, point
+    ):
+        CheckpointManager(tmp_path).save(3, small_state(3))
+        killer = CheckpointKiller(point)
+        mgr = CheckpointManager(tmp_path, hook=killer)
+        with pytest.raises(SimulatedCrash):
+            mgr.save(5, small_state(5))
+        assert killer.fired
+        # The torn save is invisible to enumeration and restore.
+        assert mgr.steps() == [3]
+        state, step = mgr.restore_latest_valid(small_like())
+        assert step == 3
+        assert np.array_equal(state["w"], small_state(3)["w"])
+        # The dangling tmp dir is left behind (crash semantics)…
+        assert any(n.startswith("_tmp.") for n in os.listdir(tmp_path))
+        # …and the next successful save GCs it.
+        mgr2 = CheckpointManager(tmp_path)
+        mgr2.save(6, small_state(6))
+        assert not any(n.startswith("_tmp.") for n in os.listdir(tmp_path))
+
+    def test_stale_tmp_from_bigger_world_does_not_wedge(self, tmp_path):
+        """A crashed multi-process save leaves _tmp.<step> shards from
+        a LARGER world; after the slice restarts resharded to fewer
+        processes and reaches the same step, the commit must drop the
+        stale extras and succeed — not wedge in a permanent
+        crash-loop on a file-count mismatch."""
+        killer = CheckpointKiller("pre_manifest")
+        dead = CheckpointManager(
+            tmp_path, process_id=0, process_count=2,
+            barrier=lambda: None, hook=killer,
+        )
+        with pytest.raises(SimulatedCrash):
+            dead.save(7, small_state(7))
+        # The other process of the dead world had also written.
+        tmp = tmp_path / "_tmp.7"
+        (tmp / "shard-00001.bin").write_bytes(b"stale payload")
+        (tmp / "shard-00001.json").write_text("{}")
+
+        mgr = CheckpointManager(tmp_path)  # restarted, single process
+        mgr.save(7, small_state(7))
+        assert mgr.steps() == [7]
+        state, step = mgr.restore_latest_valid(small_like())
+        assert step == 7
+        assert np.array_equal(state["w"], small_state(7)["w"])
+        # The stale shards were dropped, not manifested.
+        names = sorted(os.listdir(tmp_path / "7"))
+        assert "shard-00001.bin" not in names
+
+    def test_kill_after_commit_is_a_complete_step(self, tmp_path):
+        killer = CheckpointKiller("committed")
+        mgr = CheckpointManager(tmp_path, hook=killer)
+        with pytest.raises(SimulatedCrash):
+            mgr.save(4, small_state(4))
+        mgr2 = CheckpointManager(tmp_path)
+        assert mgr2.steps() == [4]
+        assert mgr2.validate(4) == []
+        state, step = mgr2.restore_latest_valid(small_like())
+        assert step == 4
+        assert np.array_equal(state["w"], small_state(4)["w"])
+
+    def test_async_save_error_surfaces_on_wait(self, tmp_path):
+        killer = CheckpointKiller("pre_manifest")
+        mgr = CheckpointManager(tmp_path, hook=killer)
+        mgr.save_async(2, small_state(2))
+        with pytest.raises(SimulatedCrash):
+            mgr.wait()
+
+    def test_double_buffered_saves_commit_in_order(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+        for step in (1, 2, 3):
+            mgr.save_async(step, small_state(step))
+        mgr.wait()
+        assert mgr.steps() == [1, 2, 3]
+        state, step = mgr.restore_latest_valid(small_like())
+        assert step == 3
+        assert np.array_equal(state["b"], small_state(3)["b"])
+
+
+class TestCorruptionFallback:
+    """Digest verification: a committed-looking but damaged step is
+    never returned — restore falls back to the last good one and the
+    outcome lands on checkpoint_restore_total."""
+
+    def _two_steps(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+        mgr.save(10, small_state(10))
+        mgr.save(20, small_state(20))
+        return mgr
+
+    @pytest.mark.parametrize(
+        "damage", [truncate_shard, drop_shard, flip_shard_bytes]
+    )
+    def test_damaged_newest_step_falls_back(self, tmp_path, damage):
+        mgr = self._two_steps(tmp_path)
+        damage(tmp_path, 20)
+        state, step = mgr.restore_latest_valid(small_like())
+        assert step == 10
+        assert np.array_equal(state["w"], small_state(10)["w"])
+        assert mgr.metrics.restore_total["resumed"] == 1
+        assert mgr.metrics.restore_total["skipped_corrupt"] == 1
+
+    def test_all_steps_corrupt_returns_none(self, tmp_path):
+        mgr = self._two_steps(tmp_path)
+        truncate_shard(tmp_path, 10)
+        drop_shard(tmp_path, 20)
+        assert mgr.restore_latest_valid(small_like()) is None
+        assert mgr.metrics.restore_total["none"] == 1
+        assert mgr.metrics.restore_total["skipped_corrupt"] == 2
+
+    def test_manifest_garbage_is_torn_not_fatal(self, tmp_path):
+        mgr = self._two_steps(tmp_path)
+        with open(tmp_path / "20" / MANIFEST_NAME, "w") as fh:
+            fh.write("{not json")  # analysis: allow[py-nonatomic-write]
+        state, step = mgr.restore_latest_valid(small_like())
+        assert step == 10
+
+    def test_validate_reports_problems(self, tmp_path):
+        mgr = self._two_steps(tmp_path)
+        assert mgr.validate(20) == []
+        drop_shard(tmp_path, 20)
+        problems = mgr.validate(20)
+        assert problems and "missing" in problems[0]
+
+
+class TestRetentionGC:
+    def test_keep_bounds_committed_steps(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=3)
+        for step in range(1, 8):
+            mgr.save(step, small_state(step))
+        assert mgr.steps() == [5, 6, 7]
+
+    def test_failed_save_never_gcs_good_steps(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=2)
+        mgr.save(1, small_state(1))
+        mgr.save(2, small_state(2))
+        killer = CheckpointKiller("pre_manifest")
+        broken = CheckpointManager(tmp_path, keep=2, hook=killer)
+        with pytest.raises(SimulatedCrash):
+            broken.save(3, small_state(3))
+        assert broken.steps() == [1, 2]
+
+    def test_save_metrics_recorded(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(9, small_state(9))
+        assert mgr.metrics.last_committed_step == 9
+        assert mgr.metrics.save_duration.count == 1
+
+
+class TestLatestStepHardening:
+    def test_junk_entries_ignored(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(42, small_state(42))
+        # Junk: dangling tmp dir, digit-named FILE, non-numeric dir.
+        os.makedirs(tmp_path / "_tmp.99")
+        (tmp_path / "777").write_text("not a step dir")
+        os.makedirs(tmp_path / "logs")
+        (tmp_path / "notes.txt").write_text("x")
+        assert latest_step(tmp_path) == 42
+        assert mgr.steps() == [42]
+
+    def test_missing_and_file_paths(self, tmp_path):
+        assert latest_step(tmp_path / "missing") is None
+        target = tmp_path / "afile"
+        target.write_text("x")
+        assert latest_step(target) is None
+
+    def test_torn_numeric_dir_is_not_committed(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(5, small_state(5))
+        os.makedirs(tmp_path / "9")  # numeric dir, no manifest
+        assert mgr.steps() == [5]
+        assert mgr.latest_committed_step() == 5
+        # latest_step (layout-level) still sees the directory; restore
+        # (validity-level) must not trip over it.
+        assert latest_step(tmp_path) == 9
+        _state, step = mgr.restore_latest_valid(small_like())
+        assert step == 5
+
+
+# ---------------------------------------------------------------------------
+# train loop: auto-resume, cadence, SIGTERM grace window
+# ---------------------------------------------------------------------------
+
+
+def counting_step(state, batch):
+    return (
+        {"w": state["w"] + batch["x"], "step": state["step"] + 1},
+        {"loss": np.float32(0.0)},
+    )
+
+
+def ones_batches(n):
+    return [{"x": np.ones(4, np.float32)} for _ in range(n)]
+
+
+def fresh_state():
+    return {"w": np.zeros(4, np.float32), "step": np.int32(0)}
+
+
+class TestRunWithCheckpointing:
+    def test_step_cadence_and_resume(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(12), mgr,
+            save_every_steps=5, install_signal_handler=False,
+        )
+        assert report.final_step == 12 and report.saves == 2
+        assert mgr.steps() == [5, 10]
+
+        # Second incarnation: resumes from 10, loses <= cadence steps.
+        mgr2 = CheckpointManager(tmp_path, keep=10)
+        state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(2), mgr2,
+            save_every_steps=5, install_signal_handler=False,
+        )
+        assert report.resumed_from_step == 10
+        assert report.start_step == 10 and report.final_step == 12
+        assert state["w"][0] == 12.0  # arithmetic continued, not restarted
+        assert mgr2.metrics.restore_total["resumed"] == 1
+
+    def test_wall_clock_cadence(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+        now = [0.0]
+
+        def clock():
+            now[0] += 10.0  # every step "takes" 10s
+            return now[0]
+
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(6), mgr,
+            save_every_s=25.0, clock=clock,
+            install_signal_handler=False,
+        )
+        assert report.saves >= 2
+        assert mgr.latest_committed_step() is not None
+
+    def test_sigterm_takes_final_synchronous_checkpoint(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+
+        def batches():
+            for i in range(1000):
+                if i == 7:  # preemption arrives mid-training
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield {"x": np.ones(4, np.float32)}
+
+        previous = signal.getsignal(signal.SIGTERM)
+        state, report = run_with_checkpointing(
+            counting_step, fresh_state(), batches(), mgr,
+            save_every_steps=100,
+        )
+        assert report.preempted
+        assert report.final_step < 1000, "SIGTERM did not stop the loop"
+        # The grace-window save: the FINAL step is committed, so the
+        # resume loses zero completed steps.
+        assert mgr.latest_committed_step() == report.final_step
+        assert np.array_equal(
+            mgr.restore_latest_valid(fresh_state())[0]["w"],
+            state["w"],
+        )
+        # Handler restored: the loop must not leak signal state.
+        assert signal.getsignal(signal.SIGTERM) == previous
+
+    def test_resume_skips_torn_step(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, keep=10)
+        run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(10), mgr,
+            save_every_steps=5, install_signal_handler=False,
+        )
+        truncate_shard(tmp_path, 10)
+        mgr2 = CheckpointManager(tmp_path, keep=10)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(1), mgr2,
+            save_every_steps=5, install_signal_handler=False,
+        )
+        assert report.resumed_from_step == 5
+
+    def test_trainstate_roundtrip_through_loop(self, tmp_path):
+        """The real TrainState path: a jitted sharded step, cadence
+        saves, then resume into a fresh template."""
+        model = resnet18(num_classes=8, width=8)
+        state = create_train_state(model, jax.random.key(0), (2, 32, 32, 3))
+        step = make_train_step()
+        rng = np.random.default_rng(0)
+
+        def batches(n):
+            return [
+                {
+                    "image": jnp.asarray(
+                        rng.normal(size=(4, 32, 32, 3)), jnp.float32
+                    ),
+                    "label": jnp.asarray(rng.integers(0, 8, size=(4,))),
+                }
+                for _ in range(n)
+            ]
+
+        mgr = CheckpointManager(tmp_path, keep=10)
+        trained, report = run_with_checkpointing(
+            step, state, batches(3), mgr,
+            save_every_steps=1, install_signal_handler=False,
+        )
+        assert report.final_step == 3 and mgr.steps()[-1] == 3
+        like = create_train_state(model, jax.random.key(1), (2, 32, 32, 3))
+        mgr2 = CheckpointManager(tmp_path, keep=10)
+        resumed, report2 = run_with_checkpointing(
+            step, like, [], mgr2, install_signal_handler=False,
+        )
+        assert report2.resumed_from_step == 3
+        assert tree_equal(resumed.params, trained.params)
+        assert tree_equal(resumed.opt_state, trained.opt_state)
+
+
+class TestEnvPlumbing:
+    def test_cadence_from_env(self):
+        env = {ENV_CHECKPOINT_EVERY_STEPS: "50",
+               ENV_CHECKPOINT_EVERY_S: "12.5"}
+        assert cadence_from_env(env) == (50, 12.5)
+        assert cadence_from_env({}) == (0, 0.0)
+        assert cadence_from_env(
+            {ENV_CHECKPOINT_EVERY_STEPS: "garbage"}
+        ) == (0, 0.0)
+
+    def test_manager_from_env(self, tmp_path):
+        assert manager_from_env({}) is None
+        mgr = manager_from_env({ENV_CHECKPOINT_DIR: str(tmp_path)})
+        assert mgr is not None
+        assert mgr.directory == str(tmp_path)
+
+    def test_webhook_poddefault_carries_the_contract(self):
+        """The env names the PodDefault injects are the ones the
+        manager reads — the data-plane/control-plane handshake."""
+        from kubeflow_tpu.webhook.server import tpu_env_poddefault
+
+        env = {
+            e["name"]: e["value"]
+            for e in tpu_env_poddefault("user")["spec"]["env"]
+        }
+        assert ENV_CHECKPOINT_DIR in env
+        assert ENV_CHECKPOINT_EVERY_STEPS in env
+        assert ENV_CHECKPOINT_EVERY_S in env
+        steps, secs = cadence_from_env(env)
+        assert steps > 0 and secs > 0
+
+
+# ---------------------------------------------------------------------------
+# multi-host commit barrier (real jax.distributed processes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_multihost_commit_barrier_process_zero_writes_manifest(tmp_path):
+    """Two real processes over jax.distributed: each writes only its
+    own shards, process 0 alone commits the manifest after the barrier,
+    and both restore bit-identical local shards (KFT_TEST_MODE=ckpt in
+    tests/distributed_worker.py)."""
+    import json
+    import subprocess
+    import sys
+
+    from kubeflow_tpu.parallel.distributed import (
+        ENV_COORDINATOR,
+        slice_env_for_rank,
+    )
+    from tests.test_distributed_multiprocess import REPO, WORKER, free_port
+
+    num = 2
+    port = free_port()
+    ckpt_dir = tmp_path / "shared"
+    procs = []
+    for rank in range(num):
+        env_block = slice_env_for_rank("nb", "alice", rank, num)
+        env_block[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        env = {**os.environ, **env_block,
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+               "KFT_TEST_MODE": "ckpt",
+               "KFT_CKPT_DIR": str(ckpt_dir),
+               "PYTHONUNBUFFERED": "1"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    outs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        outs.append(out.decode(errors="replace"))
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"CKPT {rank} step=7" in out, out
+        assert f"DONE {rank}" in out, out
+
+    step_dir = ckpt_dir / "7"
+    names = sorted(os.listdir(step_dir))
+    # One manifest (process 0's commit), one bin+json pair per process.
+    assert names == [MANIFEST_NAME, "shard-00000.bin", "shard-00000.json",
+                     "shard-00001.bin", "shard-00001.json"]
+    manifest = json.loads((step_dir / MANIFEST_NAME).read_text())
+    assert manifest["step"] == 7
+    assert manifest["fingerprint"]["process_count"] == num
+    assert sorted(manifest["files"]) == names[1:]
+    # No dangling tmp dirs: the commit renamed the only one.
+    assert sorted(os.listdir(ckpt_dir)) == ["7"]
