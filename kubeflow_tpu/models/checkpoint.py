@@ -23,18 +23,24 @@ Commit protocol (one step = one directory):
 
 A crash at any point leaves either a dangling ``_tmp.*`` dir (ignored
 by restore, removed by GC) or a fully committed step.
-``restore_latest_valid`` walks committed steps newest-first, verifies
-manifest + file digests + per-shard content digests + slice coverage,
-and falls back to the previous step on any corruption — a readable but
-corrupt checkpoint is never returned.
+``restore_latest_valid`` verifies manifest + file digests + per-shard
+content digests + slice coverage, and falls back to the previous step
+on any corruption — a readable but corrupt checkpoint is never
+returned. Single-process, it walks committed steps newest-first; in a
+multi-host world process 0 alone walks and validates, then broadcasts
+its pick through the coordination service so every rank restores the
+very same step — a per-rank walk could silently resume different steps
+on different ranks and diverge the train state with no error raised.
 
 Sharding: a jax.Array is saved as its ``replica_id == 0`` addressable
 shards (each process writes only what it owns — no host-side gather of
-fsdp-sharded state), and restored by reassembling the global array from
-every process's shard file and placing it with the caller's target
-shardings (``restore_checkpoint`` computes the canonical dp/fsdp/tp
+fsdp-sharded state). Restore mmaps the shard payloads and assembles
+only the regions the caller's target shardings actually place on this
+process (``restore_checkpoint`` computes the canonical dp/fsdp/tp
 placement exactly as before; mesh→different-mesh and mesh→single-chip
-both work because assembly is host-side).
+both work because assembly is host-side) — an fsdp-sharded state that
+was saved without ever being gathered is likewise never materialized
+whole on one host on the way back in.
 
 ``save_checkpoint`` / ``restore_checkpoint`` / ``latest_step`` keep
 their signatures as thin wrappers over the manager.
@@ -46,6 +52,7 @@ import dataclasses
 import hashlib
 import json
 import logging
+import mmap
 import os
 import shutil
 import threading
@@ -178,8 +185,56 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _sha256(data: bytes) -> str:
+def _sha256(data) -> str:
     return hashlib.sha256(data).hexdigest()
+
+
+def _coordination_client():
+    """The jax.distributed coordination-service client, or None when no
+    multi-process world (or no coordination service) is up."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except (ImportError, AttributeError):
+        return None
+
+
+# Fixed buffer size for the device-collective broadcast fallback (the
+# agreed values are tiny: a step number, "save"/"stop"/"run").
+_BCAST_BYTES = 64
+
+# Every this-many agreements, rendezvous the world and GC consumed kv
+# keys — a run whose consult is armed but that never saves (no cadence,
+# waiting on SIGTERM) must not grow the coordinator's key store with
+# one write-once key per step for days.
+_BCAST_GC_EVERY = 256
+
+# Module-level so that manager instances created per call (the thin
+# wrappers build a fresh CheckpointManager each time) continue their
+# predecessor's numbering: kv keys and barrier ids are write-once in
+# the coordination service, and an instance restarting at 1 would
+# collide with keys an earlier instance already published. Keyed by
+# (directory, process_id[, step]) so tests simulating several ranks in
+# one OS process keep them distinct; in production each rank is its
+# own process and the per-rank counters advance in lockstep because
+# every agreement and save is collective.
+_AGREE_SEQS: dict[tuple, int] = {}
+_SAVE_ATTEMPTS: dict[tuple, int] = {}
+_SHARED_LOCK = threading.Lock()
+
+ENV_COORD_TIMEOUT_MS = "KFT_COORD_TIMEOUT_MS"
+
+
+def _coord_timeout_ms() -> int:
+    """Barrier / kv-agreement timeout. Generous by default: the consult
+    sits on the training hot path, and cross-host skew of minutes is
+    normal while ranks jit-compile with unevenly warm caches — a tight
+    timeout there crashes healthy runs."""
+    try:
+        return int(os.environ[ENV_COORD_TIMEOUT_MS])
+    except (KeyError, ValueError):
+        return 600_000
 
 
 def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
@@ -214,10 +269,6 @@ def _normalize_index(index, shape) -> list[list[int]]:
         stop = dim if slc.stop is None else int(slc.stop)
         out.append([start, stop])
     return out
-
-
-def _index_slices(index: list[list[int]]) -> tuple:
-    return tuple(slice(a, b) for a, b in index)
 
 
 # ---------------------------------------------------------------------------
@@ -273,15 +324,21 @@ def _snapshot(state, process_id: int) -> list[_HostLeaf]:
         if isinstance(leaf, jax.Array):
             shape = tuple(leaf.shape)
             dtype = str(leaf.dtype)
-            # tobytes() always emits C order, so no contiguity coercion
-            # (ascontiguousarray would promote 0-d scalars to 1-d).
+            # copy=True is load-bearing: save_async's contract lets the
+            # caller donate the state the moment it returns (the train
+            # step jits with donate_argnums=0), and on some backends
+            # np.asarray of a shard is a zero-copy view — the next step
+            # would overwrite the buffer while the worker thread is
+            # still serializing it. tobytes() always emits C order, so
+            # no contiguity coercion beyond the copy.
             shards = [
-                (_normalize_index(s.index, shape), np.asarray(s.data))
+                (_normalize_index(s.index, shape),
+                 np.array(s.data, copy=True))
                 for s in leaf.addressable_shards
                 if s.replica_id == 0
             ]
         else:
-            arr = np.asarray(leaf)
+            arr = np.array(leaf, copy=True)
             shape = tuple(arr.shape)
             dtype = str(arr.dtype)
             # Host values are identical on every process: one writer.
@@ -310,9 +367,13 @@ class CheckpointManager:
     - ``process_id`` / ``process_count``: multi-host identity; process 0
       is the manifest writer / committer.
     - ``barrier``: callable run before the manifest write and after the
-      commit; defaults to ``multihost_utils.sync_global_devices`` when
-      ``process_count > 1`` (the jax.distributed world IS the barrier
-      transport) and a no-op for single process.
+      commit; defaults to the jax.distributed coordination service
+      (the world IS the barrier transport) and a no-op for single
+      process.
+    - ``broadcast``: ``fn(key, value) -> value`` overriding the
+      process-0 value-agreement transport of
+      :meth:`broadcast_from_zero`; defaults to the coordination
+      service's kv-store.
     - ``fingerprint``: extra dict merged into the manifest's topology
       fingerprint (mesh shape, accelerator, ...).
     - ``hook``: ``fn(point: str, info: dict)`` called at named save
@@ -328,6 +389,7 @@ class CheckpointManager:
         process_id: int = 0,
         process_count: int = 1,
         barrier=None,
+        broadcast=None,
         fingerprint: dict | None = None,
         metrics: CheckpointMetrics | None = None,
         hook=None,
@@ -342,9 +404,14 @@ class CheckpointManager:
         self.metrics = metrics or CheckpointMetrics()
         self._hook = hook
         self._fsync = fsync
+        self._broadcast = broadcast
         self._inflight: threading.Thread | None = None
         self._inflight_error: BaseException | None = None
-        self._sync_seq = 0
+        self._bcast_keys: list[str] = []
+        self._bcast_lock = threading.Lock()
+        # Two managers over different checkpoint dirs in one world must
+        # not share barrier/kv identities (write-once store).
+        self._ns = hashlib.sha256(self.directory.encode()).hexdigest()[:8]
         self.last_error: BaseException | None = None
 
     # ---- small internals -------------------------------------------------
@@ -352,35 +419,101 @@ class CheckpointManager:
         if self._hook is not None:
             self._hook(point, info)
 
-    def _sync(self) -> None:
+    def _sync(self, name: str) -> None:
+        """Rendezvous every process at a named point. ``name`` derives
+        from shared state (step + per-step attempt), never from a local
+        counter: a process that aborts a save between the two barriers
+        must not desynchronize the barrier identities of every later
+        save — with step-keyed names the next save pairs up again."""
         if self._barrier is not None:
-            self._barrier()
+            self._barrier()  # injected transports own their naming
             return
         if self.process_count <= 1:
             return
-        self._sync_seq += 1
-        client = None
-        try:
-            from jax._src import distributed
-
-            client = distributed.global_state.client
-        except (ImportError, AttributeError):
-            client = None
+        client = _coordination_client()
+        full = f"kft-ckpt-{self._ns}-{name}"
         if client is not None:
             # The jax.distributed coordination service: a host-side
             # barrier with no device computation — works on every
             # backend (the CPU stand-in included) and is exactly the
-            # rendezvous the commit protocol needs. Sequence-numbered
-            # ids keep repeated saves distinct.
-            client.wait_at_barrier(
-                f"kft-ckpt-{self._sync_seq}", timeout_in_ms=120_000
-            )
+            # rendezvous the commit protocol needs.
+            client.wait_at_barrier(full, timeout_in_ms=_coord_timeout_ms())
             return
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(
-            f"kft-checkpoint-commit-{self._sync_seq}"
-        )
+        multihost_utils.sync_global_devices(full)
+
+    def broadcast_from_zero(self, tag: str, value: str) -> str:
+        """Agree on a small string across the world: process 0's
+        ``value`` is published through the jax.distributed kv-store (or
+        the injected ``broadcast`` transport) and every other process
+        blocks for it; everyone returns process 0's value.
+
+        Any per-process decision that steers collective checkpoint
+        behaviour — the wall-clock cadence, the SIGTERM stop, the
+        restore step — must route through here: local clocks and signal
+        delivery skew across hosts, and processes that save or restore
+        different steps tear the step-keyed commit barrier. Calls must
+        be collective (same ``tag`` sequence on every process); a
+        single-process manager returns ``value`` unchanged."""
+        if self.process_count <= 1:
+            return value
+        with _SHARED_LOCK:
+            skey = (self.directory, self.process_id)
+            seq = _AGREE_SEQS.get(skey, 0) + 1
+            _AGREE_SEQS[skey] = seq
+        key = f"{tag}.{seq}"
+        if self._broadcast is not None:
+            return str(self._broadcast(key, value))
+        client = _coordination_client()
+        if client is not None:
+            full = f"kft-bcast-{self._ns}-{key}"
+            if self.process_id == 0:
+                client.key_value_set(full, value)
+                with self._bcast_lock:
+                    self._bcast_keys.append(full)
+                agreed = value
+            else:
+                agreed = client.blocking_key_value_get(
+                    full, _coord_timeout_ms()
+                )
+            if seq % _BCAST_GC_EVERY == 0:
+                # A rank passing this barrier has read every key up to
+                # the current sequence number, so process 0 may delete
+                # them all — the periodic counterpart of the GC that
+                # each save's commit barrier anchors.
+                self._sync(f"bcast-gc-{seq}")
+                self._gc_broadcast_keys(self._take_bcast_keys())
+            return agreed
+        # No kv transport (a world initialized without the coordination
+        # service): device-collective broadcast of the value's bytes.
+        from jax.experimental import multihost_utils
+
+        raw = value.encode()
+        if len(raw) > _BCAST_BYTES:
+            raise ValueError(f"broadcast value too long: {value!r}")
+        buf = np.zeros(_BCAST_BYTES, np.uint8)
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        return out.tobytes().rstrip(b"\0").decode()
+
+    def _gc_broadcast_keys(self, keys) -> None:
+        """Delete agreement keys every rank has provably consumed: the
+        commit barrier just rendezvoused the world, and a rank only
+        reaches it after reading, in order, every agreement published
+        before this save was initiated. Without this the per-step
+        cadence consult would grow the coordination service's
+        write-once key store for the life of the run."""
+        if not keys:
+            return
+        client = _coordination_client()
+        if client is None or not hasattr(client, "key_value_delete"):
+            return
+        for key in keys:
+            try:
+                client.key_value_delete(key)
+            except Exception as exc:
+                log.debug("kv gc of %s failed: %s", key, exc)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, str(int(step)))
@@ -394,7 +527,7 @@ class CheckpointManager:
         (or raises). Returns the committed step directory."""
         self.wait()
         host = _snapshot(state, self.process_id)
-        return self._write(int(step), host)
+        return self._write(int(step), host, self._take_bcast_keys())
 
     def save_async(self, step: int, state) -> None:
         """Double-buffered background save: the device→host snapshot is
@@ -404,10 +537,16 @@ class CheckpointManager:
         the previous write (and surfaces its error, if any)."""
         self.wait()
         host = _snapshot(state, self.process_id)
+        # Snapshot on the CALLER thread: these are exactly the keys
+        # published before this save was initiated, which every rank
+        # consumed before initiating its own (collectively agreed) save
+        # — a worker-thread snapshot could race a later publish in and
+        # delete a key some rank has not read yet.
+        consumed = self._take_bcast_keys()
 
         def _run():
             try:
-                self._write(int(step), host)
+                self._write(int(step), host, consumed)
             except BaseException as exc:
                 # Stashed, then re-raised by the next wait()/save() on
                 # the caller's thread — logged here too so a crash that
@@ -432,13 +571,34 @@ class CheckpointManager:
             self.last_error = error
             raise error
 
-    def _write(self, step: int, host: list[_HostLeaf]) -> str:
+    def _take_bcast_keys(self) -> list[str]:
+        with self._bcast_lock:
+            keys = self._bcast_keys[:]
+            self._bcast_keys.clear()
+        return keys
+
+    def _write(self, step: int, host: list[_HostLeaf],
+               consumed_keys: list[str] = ()) -> str:
         t0 = time.perf_counter()
         with obs.get_tracer().span(
             "checkpoint save",
             attributes={"step": step, "dir": self.directory,
                         "process": self.process_id},
         ) as span:
+            # Barrier names must be unique per rendezvous but identical
+            # across processes; saves are collectively agreed (step
+            # cadence is deterministic, clock/stop decisions broadcast
+            # from process 0), so the per-rank attempt counts advance
+            # in lockstep. A save that fails on ANY rank is fatal for
+            # the whole world (peers time out at the barrier and raise,
+            # the slice restarts, counters reset with the process) —
+            # in-place retry of a torn collective save is not a
+            # supported pattern, which is what keeps these counts
+            # aligned even across failures.
+            with _SHARED_LOCK:
+                akey = (self.directory, self.process_id, step)
+                attempt = _SAVE_ATTEMPTS.get(akey, 0)
+                _SAVE_ATTEMPTS[akey] = attempt + 1
             tmp = self._tmp_dir(step)
             os.makedirs(tmp, exist_ok=True)
 
@@ -482,12 +642,15 @@ class CheckpointManager:
             if self._fsync:
                 _fsync_dir(tmp)
 
-            self._sync()  # every process's shards are durable past here
+            # Every process's shards are durable past this barrier.
+            self._sync(f"{step}.{attempt}-shards")
             self._emit("pre_manifest", step=step)
 
             if self.process_id == 0:
                 self._commit(step, tmp, span)
-            self._sync()  # nobody returns before the commit landed
+            # Nobody returns before the commit landed.
+            self._sync(f"{step}.{attempt}-commit")
+            self._gc_broadcast_keys(consumed_keys)
         seconds = time.perf_counter() - t0
         self.metrics.observe_save(seconds, step)
         return self._step_dir(step)
@@ -610,8 +773,25 @@ class CheckpointManager:
         validation, skipping torn/corrupt ones; None when no valid
         checkpoint exists. Outcomes land on
         ``checkpoint_restore_total``: ``resumed`` on success, one
-        ``skipped_corrupt`` per bad step walked over, ``none`` when
-        nothing was restorable."""
+        ``skipped_corrupt`` per bad step walked over (on the walking
+        process), ``none`` when nothing was restorable.
+
+        Multi-host, the walk happens on process 0 alone and its pick is
+        broadcast through the coordination service; every process then
+        restores exactly that step. A per-process walk would let one
+        rank that hits a transient read error silently fall back to an
+        older step than its peers — diverged train states whose
+        collectives produce garbage with no error raised. A rank that
+        cannot restore the agreed step therefore fails loudly instead
+        of falling back."""
+        if self.process_count > 1:
+            step = self._agree_restore_step()
+            if step is None:
+                self.metrics.observe_restore("none")
+                return None
+            state = self.restore(step, like, placements)  # loud on fail
+            self.metrics.observe_restore("resumed")
+            return state, step
         for step in sorted(self.steps(), reverse=True):
             # One pass, no pre-validate: the load itself verifies
             # manifest, presence, per-shard content digests and slice
@@ -629,6 +809,34 @@ class CheckpointManager:
                 )
         self.metrics.observe_restore("none")
         return None
+
+    def _agree_restore_step(self) -> int | None:
+        """Process 0 walks committed steps newest-first, skips the ones
+        that fail validation, and broadcasts its pick ("" = nothing
+        valid). Validation is digest checks over the shared checkpoint
+        dir, so one validated pick is authoritative for the world.
+
+        The pick deliberately full-hashes the candidate's files on
+        process 0 (streaming, O(1) memory) even though the restore
+        re-verifies lazily per shard: the agreed step has to be
+        content-clean BEFORE the world commits to it, or a bit-rotted
+        step would crash-loop the job — every incarnation picks the
+        same damaged step, some rank raises, the slice restarts. Paid
+        once per incarnation, on one host, not on the training path."""
+        chosen = ""
+        if self.process_id == 0:
+            for step in sorted(self.steps(), reverse=True):
+                problems = self.validate(step)
+                if not problems:
+                    chosen = str(step)
+                    break
+                self.metrics.observe_restore("skipped_corrupt")
+                log.warning(
+                    "checkpoint step %d is torn/corrupt, skipping (%s)",
+                    step, "; ".join(problems),
+                )
+        agreed = self.broadcast_from_zero("restore", chosen)
+        return int(agreed) if agreed else None
 
 
 # ---------------------------------------------------------------------------
@@ -668,31 +876,114 @@ def _read_manifest(step_dir: str) -> dict:
         ) from exc
 
 
-def _load_step_dir(step_dir: str, like, placements=None):
-    """Assemble every leaf from the per-process shard files and place it
-    per ``placements`` (a pytree of shardings matching ``like``'s array
-    fields; None returns host numpy arrays)."""
-    manifest = _read_manifest(step_dir)
-    blobs: dict[str, bytes] = {}
-    metas: list[dict] = []
-    for name in sorted(manifest.get("files") or {}):
-        full = os.path.join(step_dir, name)
-        try:
-            with open(full, "rb") as fh:
-                data = fh.read()
-        except OSError as exc:
-            raise CheckpointCorrupt(
-                f"{step_dir}: shard file {name} missing: {exc}"
-            ) from exc
-        if name.endswith(".json"):
+class _ShardPayloads:
+    """mmap-backed access to a step's shard payload files with lazy,
+    memoized per-shard digest verification. Restore reads (and hashes)
+    only the byte ranges the requested regions actually overlap —
+    never a whole payload file into host RAM at once."""
+
+    def __init__(self, step_dir: str, names):
+        self._step_dir = step_dir
+        self._maps: dict[str, object] = {}
+        self._verified: set[tuple] = set()
+        for name in names:
+            full = os.path.join(step_dir, name)
             try:
-                metas.append(json.loads(data))
+                with open(full, "rb") as fh:
+                    size = os.fstat(fh.fileno()).st_size
+                    self._maps[name] = (
+                        mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+                        if size else b""
+                    )
+            except OSError as exc:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: shard file {name} missing: {exc}"
+                ) from exc
+
+    def view(self, name: str, entry: dict, key: str) -> memoryview:
+        mm = self._maps.get(name)
+        if mm is None:
+            raise CheckpointCorrupt(
+                f"{self._step_dir}: payload {name} for leaf {key} missing"
+            )
+        off, size = int(entry["offset"]), int(entry["size"])
+        if off + size > len(mm):
+            raise CheckpointCorrupt(
+                f"{self._step_dir}: payload {name} truncated (leaf {key})"
+            )
+        view = memoryview(mm)[off:off + size]
+        token = (name, off, size)
+        if token not in self._verified:
+            if _sha256(view) != entry["digest"]:
+                raise CheckpointCorrupt(
+                    f"{self._step_dir}: content digest mismatch on "
+                    f"leaf {key}"
+                )
+            self._verified.add(token)
+        return view
+
+    def close(self) -> None:
+        for mm in self._maps.values():
+            if isinstance(mm, mmap.mmap):
+                try:
+                    mm.close()
+                except BufferError:
+                    pass  # a live numpy view holds the buffer; GC closes
+        self._maps.clear()
+
+
+def _read_region(region, dtype, shards, payloads, key):
+    """Assemble one requested region ([[start, stop], ...] in global
+    coordinates) of a leaf from the shard entries overlapping it.
+    Non-overlapping shards are neither read nor hashed."""
+    out = np.empty(tuple(b - a for a, b in region), dtype)
+    for bin_name, entry in shards:
+        src = [[int(a), int(b)] for a, b in entry["index"]]
+        rel_dst, rel_src = [], []
+        for (da, db), (sa, sb) in zip(region, src):
+            lo, hi = max(da, sa), min(db, sb)
+            if lo >= hi:
+                break
+            rel_dst.append(slice(lo - da, hi - da))
+            rel_src.append(slice(lo - sa, hi - sa))
+        else:
+            view = payloads.view(bin_name, entry, key)
+            sub_shape = tuple(b - a for a, b in src)
+            try:
+                data = np.frombuffer(view, dtype).reshape(sub_shape)
             except ValueError as exc:
                 raise CheckpointCorrupt(
-                    f"{step_dir}: shard meta {name} unreadable: {exc}"
+                    f"payload {bin_name} size disagrees with its index "
+                    f"(leaf {key}): {exc}"
                 ) from exc
-        else:
-            blobs[name] = data
+            out[tuple(rel_dst)] = data[tuple(rel_src)]
+            del data
+            view.release()
+    return out
+
+
+def _load_step_dir(step_dir: str, like, placements=None):
+    """Assemble leaves from the per-process shard files and place them
+    per ``placements`` (a pytree of shardings matching ``like``'s array
+    fields; None returns host numpy arrays). Payloads are mmapped and
+    digest-verified shard-by-shard on first touch; with placements, only
+    the regions the target shardings actually request are assembled —
+    restoring an fsdp-sharded state costs each host its addressable
+    slice of the checkpoint, not the whole of it."""
+    manifest = _read_manifest(step_dir)
+    metas: list[dict] = []
+    bin_names: list[str] = []
+    for name in sorted(manifest.get("files") or {}):
+        if not name.endswith(".json"):
+            bin_names.append(name)
+            continue
+        try:
+            with open(os.path.join(step_dir, name), "rb") as fh:
+                metas.append(json.loads(fh.read()))
+        except (OSError, ValueError) as exc:
+            raise CheckpointCorrupt(
+                f"{step_dir}: shard meta {name} unreadable: {exc}"
+            ) from exc
 
     # leaf key -> merged view across every process's meta.
     leaves: dict[str, dict] = {}
@@ -724,69 +1015,80 @@ def _load_step_dir(step_dir: str, like, placements=None):
                 f"fields ({len(placement_leaves)} vs {len(template)})"
             )
 
-    restored_leaves = []
-    for pos, (key, tmpl_leaf) in enumerate(template):
-        info = leaves.get(key)
-        if info is None:
-            raise CheckpointCorrupt(
-                f"{step_dir}: leaf {key} absent from every shard meta"
-            )
-        shape = info["shape"]
-        tmpl_shape = tuple(np.shape(tmpl_leaf))
-        if shape != tmpl_shape:
-            raise ValueError(
-                f"checkpoint leaf {key} has shape {shape}, template "
-                f"expects {tmpl_shape}"
-            )
-        dtype = _resolve_dtype(info["dtype"])
-        full = np.empty(shape, dtype)
-        covered = 0
-        # Dedupe by global index: a leaf replicated per *process* (not
-        # via a global mesh) is written once per process with the same
-        # covering index — identical content, counted once.
-        unique = {
-            tuple(tuple(int(x) for x in pair) for pair in entry["index"]):
-                (bin_name, entry)
-            for bin_name, entry in info["shards"]
-        }
-        for bin_name, entry in unique.values():
-            blob = blobs.get(bin_name)
-            if blob is None:
+    payloads = _ShardPayloads(step_dir, bin_names)
+    try:
+        restored_leaves = []
+        for pos, (key, tmpl_leaf) in enumerate(template):
+            info = leaves.get(key)
+            if info is None:
                 raise CheckpointCorrupt(
-                    f"{step_dir}: payload {bin_name} for leaf {key} "
-                    "missing"
+                    f"{step_dir}: leaf {key} absent from every shard meta"
                 )
-            off, size = int(entry["offset"]), int(entry["size"])
-            raw = blob[off:off + size]
-            if len(raw) != size:
-                raise CheckpointCorrupt(
-                    f"{step_dir}: payload {bin_name} truncated "
-                    f"(leaf {key})"
+            shape = info["shape"]
+            tmpl_shape = tuple(np.shape(tmpl_leaf))
+            if shape != tmpl_shape:
+                raise ValueError(
+                    f"checkpoint leaf {key} has shape {shape}, template "
+                    f"expects {tmpl_shape}"
                 )
-            if _sha256(raw) != entry["digest"]:
-                raise CheckpointCorrupt(
-                    f"{step_dir}: content digest mismatch on leaf {key}"
-                )
-            index = [[int(a), int(b)] for a, b in entry["index"]]
-            sub_shape = tuple(b - a for a, b in index)
-            data = np.frombuffer(raw, dtype).reshape(sub_shape)
-            full[_index_slices(index)] = data
-            covered += int(np.prod(sub_shape, dtype=np.int64)) if sub_shape else 1
-        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        if covered != size:
-            raise CheckpointCorrupt(
-                f"{step_dir}: leaf {key} coverage {covered}/{size} "
-                "elements (missing shards)"
+            dtype = _resolve_dtype(info["dtype"])
+            # Dedupe by global index: a leaf replicated per *process*
+            # (not via a global mesh) is written once per process with
+            # the same covering index — identical content, counted once.
+            unique = {
+                tuple(tuple(int(x) for x in pair)
+                      for pair in entry["index"]): (bin_name, entry)
+                for bin_name, entry in info["shards"]
+            }
+            shards = list(unique.values())
+            # Coverage is index arithmetic over the metas — no payload
+            # read needed to prove the shards tile the global array.
+            covered = sum(
+                int(np.prod([b - a for a, b in entry["index"]],
+                            dtype=np.int64)) if entry["index"] else 1
+                for _name, entry in shards
             )
-        tmpl_dtype = getattr(tmpl_leaf, "dtype", None)
-        if tmpl_dtype is not None and np.dtype(tmpl_dtype) != dtype:
-            full = full.astype(tmpl_dtype)
-        if placement_leaves is not None:
-            sharding = placement_leaves[pos]
-            full = jax.make_array_from_callback(
-                shape, sharding, lambda idx, _full=full: _full[idx]
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if covered != size:
+                raise CheckpointCorrupt(
+                    f"{step_dir}: leaf {key} coverage {covered}/{size} "
+                    "elements (missing shards)"
+                )
+            tmpl_dtype = getattr(tmpl_leaf, "dtype", None)
+            out_dtype = (
+                np.dtype(tmpl_dtype) if tmpl_dtype is not None else dtype
             )
-        restored_leaves.append(full)
+
+            def read(region, _dtype=dtype, _out=out_dtype,
+                     _shards=shards, _key=key):
+                data = _read_region(region, _dtype, _shards, payloads,
+                                    _key)
+                return data.astype(_out) if _out != _dtype else data
+
+            if placement_leaves is not None:
+                # Devices sharing a slice (replication) hit the cache
+                # instead of re-assembling it.
+                cache: dict = {}
+
+                def cb(idx, _read=read, _shape=shape, _cache=cache):
+                    region = tuple(
+                        tuple(pair)
+                        for pair in _normalize_index(idx, _shape)
+                    )
+                    if region not in _cache:
+                        _cache[region] = _read(
+                            [list(pair) for pair in region]
+                        )
+                    return _cache[region]
+
+                full = jax.make_array_from_callback(
+                    tuple(shape), placement_leaves[pos], cb
+                )
+            else:
+                full = read([[0, d] for d in shape])
+            restored_leaves.append(full)
+    finally:
+        payloads.close()
 
     treedef = jax.tree_util.tree_structure(_arrays_only(like))
     restored = jax.tree_util.tree_unflatten(treedef, restored_leaves)
@@ -824,6 +1126,21 @@ def _compute_placements(template, mesh, tp_rules: dict | None = None):
 # ---------------------------------------------------------------------------
 
 
+def _world_identity() -> dict:
+    """process_id/process_count kwargs from the live jax world, so the
+    thin wrappers keep the manager's multi-host discipline (per-process
+    shards, process-0 commit, agreed restore step) instead of silently
+    downgrading to process_count=1 managers on every rank."""
+    try:
+        return {
+            "process_id": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception as exc:
+        log.debug("jax process identity unavailable: %s", exc)
+        return {}
+
+
 def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
     """Write ``state`` (TrainState or any pytree of arrays) under
     ``path``. Blocks until durable AND atomically committed (the
@@ -832,9 +1149,10 @@ def save_checkpoint(path: str | os.PathLike, state, step: int | None = None):
     the step directory is returned; without, ``path`` itself is the
     (single) checkpoint."""
     path = os.path.abspath(os.fspath(path))
+    manager = CheckpointManager(path, **_world_identity())
     if step is not None:
-        return CheckpointManager(path).save(step, state)
-    CheckpointManager(path).save(0, state)
+        return manager.save(step, state)
+    manager.save(0, state)
     return path
 
 
@@ -860,7 +1178,7 @@ def restore_checkpoint(path: str | os.PathLike, like, mesh=None,
         if problems:
             raise CheckpointCorrupt(f"{path}: " + "; ".join(problems))
         return _load_step_dir(path, like, placements)
-    manager = CheckpointManager(path)
+    manager = CheckpointManager(path, **_world_identity())
     if os.path.isfile(os.path.join(path, "0", MANIFEST_NAME)) and \
             manager.steps() == [0]:
         # Stepless save_checkpoint layout: exactly one step, number 0.
@@ -924,16 +1242,11 @@ def manager_from_env(env=None, **overrides) -> CheckpointManager | None:
     directory = env.get(ENV_CHECKPOINT_DIR)
     if not directory:
         return None
-    kwargs: dict = {}
+    kwargs: dict = dict(_world_identity())
     try:
         keep = int(env.get(ENV_CHECKPOINT_KEEP, ""))
         kwargs["keep"] = keep
     except (TypeError, ValueError):
         pass  # analysis: allow[py-broad-except] — unset/garbage: default
-    try:
-        kwargs["process_id"] = jax.process_index()
-        kwargs["process_count"] = jax.process_count()
-    except Exception as exc:
-        log.debug("jax process identity unavailable: %s", exc)
     kwargs.update(overrides)
     return CheckpointManager(directory, **kwargs)

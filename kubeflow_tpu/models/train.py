@@ -228,11 +228,30 @@ def run_with_checkpointing(
       ahead of a TPU preemption) the loop finishes the in-flight step,
       takes one final *synchronous* checkpoint, and returns with
       ``report.preempted`` set.
+    - **multi-host discipline**: the step cadence is deterministic, but
+      wall clocks and SIGTERM delivery skew across hosts — if each
+      process acted on its local view, ranks would save (or stop) at
+      different steps and tear the step-keyed commit barrier. When
+      ``manager.process_count > 1`` and either trigger is armed, the
+      loop therefore agrees on one decision per step boundary: process
+      0's view is broadcast through the manager's coordination
+      transport and every rank obeys it (one small kv round-trip per
+      step). Process 0's view is authoritative by design: a slice
+      preemption SIGTERMs every pod, so process 0 always sees it; a
+      SIGTERM delivered to a lone non-zero rank is deliberately not
+      acted on (a grace save initiated by one rank can never commit —
+      saves are collective) and costs at most a cadence of lost work
+      when that rank dies.
 
     Returns ``(state, RunReport)``. ``batches`` yields per-step batch
     dicts; the caller owns data-order alignment with the global step
     (e.g. seed the iterator from ``report.start_step``— which is why
-    resume happens before the first batch is drawn).
+    resume happens before the first batch is drawn). Multi-host, every
+    rank's iterator must yield the SAME number of batches — the
+    standard SPMD contract (a rank running an extra step would hang in
+    the step's own device collectives), and with the agreed consult
+    armed a rank that drains early additionally strands its peers at
+    the next boundary agreement.
     """
     from kubeflow_tpu.models import checkpoint as ckpt
 
@@ -259,10 +278,60 @@ def run_with_checkpointing(
         except ValueError:
             previous_handler = None  # not the main thread: caller's job
 
+    # Wall-clock and SIGTERM triggers are per-host observations; in a
+    # multi-host world the agreed token from process 0 replaces them.
+    agree = getattr(manager, "process_count", 1) > 1 and (
+        bool(save_every_s) or install_signal_handler
+    )
+
     last_save_at = clock()
+    last_saved = step
+    preempted = False
+
+    def decide() -> str:
+        """One decision per step boundary — pending SIGTERM, wall-clock
+        cadence — taken BEFORE the next step is paid for, so a pending
+        preemption never buys one more step (or a first-step jit
+        compile) out of the grace window. In a multi-host world the
+        token is process 0's view, broadcast to every rank."""
+        due_clock = (
+            bool(save_every_s)
+            and clock() - last_save_at >= save_every_s
+        )
+        token = "stop" if stop.is_set() else (
+            "save" if due_clock else "run"
+        )
+        if agree:
+            token = manager.broadcast_from_zero(f"cadence-{step}", token)
+        return token
+
+    def cadence_due(token: str) -> bool:
+        # The start step is already durable (fresh run: nothing to
+        # save; resumed: it is the committed step we restored).
+        return step != last_saved and (
+            (save_every_steps and step % save_every_steps == 0)
+            or token == "save"
+        )
+
+    batch_iter = iter(batches)
+    done = object()
     try:
-        for batch in batches:
-            if stop.is_set():
+        while True:
+            # Boundary decision BEFORE the next batch is even pulled: a
+            # stalled data pipeline must not sit between a pending
+            # SIGTERM and the grace-window save, and the previous
+            # step's cadence save must not wait on the fetch either.
+            token = decide()
+            if token == "stop":
+                preempted = True
+                break  # final sync save below covers the last step
+            if cadence_due(token):
+                manager.save_async(step, state)
+                report.saves += 1
+                last_saved = step
+                last_save_at = clock()
+            batch = next(batch_iter, done)
+            if batch is done:
                 break
             t0 = time.perf_counter()
             state, metrics = step_fn(state, batch)
@@ -270,15 +339,7 @@ def run_with_checkpointing(
             report.final_step = step
             if telemetry is not None:
                 _observe_synced(telemetry, metrics, batch, t0)
-            if stop.is_set():
-                break  # final sync save below covers this step
-            due_steps = save_every_steps and step % save_every_steps == 0
-            due_clock = save_every_s and clock() - last_save_at >= save_every_s
-            if due_steps or due_clock:
-                manager.save_async(step, state)
-                report.saves += 1
-                last_save_at = clock()
-        if stop.is_set():
+        if preempted or (stop.is_set() and not agree):
             # Preemption grace window: one last synchronous checkpoint
             # (save() first drains the in-flight background save) so at
             # most the in-flight step is lost, not a whole cadence.

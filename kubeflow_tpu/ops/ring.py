@@ -31,8 +31,11 @@ from kubeflow_tpu.ops.attention import NEG_INF, _causal_mask
 if hasattr(jax.lax, "pcast"):
     def _pvary(x, axis_name):
         return jax.lax.pcast(x, axis_name, to="varying")
-else:  # pre-pcast JAX releases
+elif hasattr(jax.lax, "pvary"):
     _pvary = jax.lax.pvary
+else:  # JAX without varying-axis tracking: nothing to mark
+    def _pvary(x, axis_name):
+        return x
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal=False, scale=None,

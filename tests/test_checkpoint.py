@@ -6,6 +6,7 @@ digest-verified fallback past corrupt steps, retention/GC, the
 multi-host commit barrier over a real jax.distributed world, and the
 train loop's auto-resume + SIGTERM grace-window checkpoint."""
 
+import json
 import os
 import signal
 
@@ -27,6 +28,7 @@ from kubeflow_tpu.models.checkpoint import (
     ENV_CHECKPOINT_EVERY_S,
     ENV_CHECKPOINT_EVERY_STEPS,
     MANIFEST_NAME,
+    CheckpointCorrupt,
     CheckpointManager,
     cadence_from_env,
     latest_step,
@@ -307,6 +309,27 @@ class TestManagerAtomicity:
         assert step == 3
         assert np.array_equal(state["b"], small_state(3)["b"])
 
+    def test_snapshot_survives_caller_mutation_after_save_async(
+        self, tmp_path
+    ):
+        """save_async's contract: the caller may mutate or donate the
+        state the moment the call returns (the train step jits with
+        donate_argnums=0). The host snapshot must be a real copy, not a
+        zero-copy view of the buffer the next step overwrites — a view
+        would produce a corrupted checkpoint whose digests VALIDATE
+        (they hash the corrupted bytes)."""
+        mgr = CheckpointManager(tmp_path, keep=10)
+        state = small_state(1)
+        mgr.save_async(1, state)
+        # The "next train step" reusing the donated buffers.
+        state["w"][:] = -777.0
+        state["b"][:] = -777.0
+        mgr.wait()
+        restored, step = mgr.restore_latest_valid(small_like())
+        assert step == 1
+        assert np.array_equal(restored["w"], small_state(1)["w"])
+        assert np.array_equal(restored["b"], small_state(1)["b"])
+
 
 class TestCorruptionFallback:
     """Digest verification: a committed-looking but damaged step is
@@ -571,6 +594,299 @@ class TestEnvPlumbing:
         assert ENV_CHECKPOINT_EVERY_S in env
         steps, secs = cadence_from_env(env)
         assert steps > 0 and secs > 0
+
+
+# ---------------------------------------------------------------------------
+# multi-host coordination: step-keyed barriers, broadcast agreement
+# ---------------------------------------------------------------------------
+
+
+class RecordingClient:
+    """In-memory stand-in for the jax.distributed coordination client,
+    with the service's semantics: barriers record their ids, kv keys
+    are write-once."""
+
+    def __init__(self):
+        self.barriers = []
+        self.kv = {}
+
+    def wait_at_barrier(self, name, timeout_in_ms=None):
+        self.barriers.append(name)
+
+    def key_value_set(self, key, value):
+        assert key not in self.kv, f"kv key reused: {key}"
+        self.kv[key] = value
+
+    def blocking_key_value_get(self, key, timeout_in_ms):
+        assert key in self.kv, f"kv key never published: {key}"
+        return self.kv[key]
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+
+class PeerForger:
+    """Hook that fabricates process 1's shard files the moment process
+    0's are durable, so a ``process_count=2`` manager can be driven
+    through the full commit protocol by a single test process."""
+
+    def __init__(self, inner=None):
+        self.manager = None
+        self.inner = inner
+
+    def __call__(self, point, info):
+        # pre_manifest = after the shard barrier, before the commit:
+        # process 0's bin+json pair is durable, the manifest is not.
+        if point == "pre_manifest":
+            tmp = self.manager._tmp_dir(info["step"])
+            with open(os.path.join(tmp, "shard-00000.bin"), "rb") as fh:
+                payload = fh.read()
+            with open(os.path.join(tmp, "shard-00001.bin"), "wb") as fh:
+                fh.write(payload)
+            with open(os.path.join(tmp, "shard-00000.json")) as fh:
+                meta = json.load(fh)
+            meta["process"] = 1
+            with open(os.path.join(tmp, "shard-00001.json"), "w") as fh:
+                fh.write(json.dumps(meta))  # analysis: allow[py-nonatomic-write]
+        if self.inner is not None:
+            self.inner(point, info)
+
+
+def forged_world_manager(tmp_path, inner_hook=None, **kwargs):
+    forger = PeerForger(inner_hook)
+    mgr = CheckpointManager(
+        tmp_path, process_id=0, process_count=2, hook=forger, **kwargs
+    )
+    forger.manager = mgr
+    return mgr
+
+
+class TestMultiHostCoordination:
+    def _patch_client(self, monkeypatch):
+        from kubeflow_tpu.models import checkpoint as ckpt
+
+        client = RecordingClient()
+        monkeypatch.setattr(
+            ckpt, "_coordination_client", lambda: client
+        )
+        return client
+
+    def test_barrier_ids_derive_from_step_not_local_counter(
+        self, tmp_path, monkeypatch
+    ):
+        client = self._patch_client(monkeypatch)
+        mgr = forged_world_manager(tmp_path, keep=10)
+        ns = mgr._ns  # checkpoint-dir namespace: two managers over
+        # different dirs in one world must not share barrier ids
+        mgr.save(3, small_state(3))
+        assert client.barriers == [
+            f"kft-ckpt-{ns}-3.0-shards", f"kft-ckpt-{ns}-3.0-commit",
+        ]
+        # Re-save of the same step (cadence save + grace-window save of
+        # one step): distinct attempt, distinct rendezvous.
+        client.barriers.clear()
+        mgr.save(3, small_state(3))
+        assert client.barriers == [
+            f"kft-ckpt-{ns}-3.1-shards", f"kft-ckpt-{ns}-3.1-commit",
+        ]
+
+    def test_aborted_save_does_not_desync_later_barriers(
+        self, tmp_path, monkeypatch
+    ):
+        """A process that dies BETWEEN the two barriers must not shift
+        every later barrier id (a local sequence counter would: the
+        survivor's counter advances twice, the victim's once, and all
+        subsequent saves pair mismatched names until the timeout)."""
+        client = self._patch_client(monkeypatch)
+
+        def die_pre_manifest(point, info):
+            if point == "pre_manifest":
+                raise SimulatedCrash("between the barriers")
+
+        dying = forged_world_manager(
+            tmp_path, inner_hook=die_pre_manifest, keep=10
+        )
+        ns = dying._ns
+        with pytest.raises(SimulatedCrash):
+            dying.save(5, small_state(5))
+        assert client.barriers == [f"kft-ckpt-{ns}-5.0-shards"]
+        # The next agreed save rendezvouses under its own step's ids —
+        # no dependence on how many barriers this process survived.
+        client.barriers.clear()
+        mgr = forged_world_manager(tmp_path, keep=10)
+        mgr.save(6, small_state(6))
+        assert client.barriers == [
+            f"kft-ckpt-{ns}-6.0-shards", f"kft-ckpt-{ns}-6.0-commit",
+        ]
+
+    def test_broadcast_from_zero_kv_roundtrip(self, tmp_path, monkeypatch):
+        client = self._patch_client(monkeypatch)
+        p0 = CheckpointManager(tmp_path, process_id=0, process_count=2)
+        p1 = CheckpointManager(tmp_path, process_id=1, process_count=2)
+        assert p0.broadcast_from_zero("restore", "20") == "20"
+        # Process 1's own value is irrelevant; it gets process 0's.
+        assert p1.broadcast_from_zero("restore", "") == "20"
+        # Sequence-scoped keys: the next agreement is a fresh key.
+        assert p0.broadcast_from_zero("restore", "10") == "10"
+        assert p1.broadcast_from_zero("restore", "ignored") == "10"
+        # Single process: no transport involved.
+        single = CheckpointManager(tmp_path)
+        assert single.broadcast_from_zero("x", "v") == "v"
+
+    def test_restore_step_is_agreed_not_walked_per_process(
+        self, tmp_path, monkeypatch
+    ):
+        """Process 0 picks the step and broadcasts it; other ranks
+        restore exactly that step without walking — and fail loudly if
+        they cannot, instead of silently falling back to an older step
+        than their peers (diverged train state)."""
+        self._patch_client(monkeypatch)
+        CheckpointManager(tmp_path, keep=10).save(10, small_state(10))
+        CheckpointManager(tmp_path, keep=10).save(20, small_state(20))
+        truncate_shard(tmp_path, 20)
+
+        p0 = CheckpointManager(tmp_path, process_id=0, process_count=2)
+        p1 = CheckpointManager(tmp_path, process_id=1, process_count=2)
+        state0, step0 = p0.restore_latest_valid(small_like())
+        state1, step1 = p1.restore_latest_valid(small_like())
+        assert step0 == step1 == 10
+        assert np.array_equal(state1["w"], small_state(10)["w"])
+        # Only the walking process skipped the torn step; rank 1 never
+        # validated step 20 at all.
+        assert p0.metrics.restore_total.get("skipped_corrupt") == 1
+        assert "skipped_corrupt" not in p1.metrics.restore_total
+        assert p1.metrics.restore_total["resumed"] == 1
+
+        # Agreed step going bad between the pick and a peer's read:
+        # loud CheckpointCorrupt on that peer, never a silent fallback.
+        state0b = p0.restore_latest_valid(small_like())
+        assert state0b[1] == 10
+        drop_shard(tmp_path, 10)
+        with pytest.raises(CheckpointCorrupt):
+            p1.restore_latest_valid(small_like())
+
+    def test_restore_none_is_agreed(self, tmp_path, monkeypatch):
+        self._patch_client(monkeypatch)
+        p0 = CheckpointManager(tmp_path, process_id=0, process_count=2)
+        p1 = CheckpointManager(tmp_path, process_id=1, process_count=2)
+        assert p0.restore_latest_valid(small_like()) is None
+        assert p1.restore_latest_valid(small_like()) is None
+        assert p0.metrics.restore_total["none"] == 1
+        assert p1.metrics.restore_total["none"] == 1
+
+    def test_broadcast_keys_gcd_at_save_commit(
+        self, tmp_path, monkeypatch
+    ):
+        """The per-step cadence consult publishes one write-once kv key
+        per step; process 0 deletes the ones every rank has provably
+        consumed (keys issued before a save, dropped after its commit
+        barrier) so the coordination service's key store stays bounded
+        over a long run."""
+        client = self._patch_client(monkeypatch)
+        mgr = forged_world_manager(tmp_path, keep=10)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(4), mgr,
+            save_every_steps=4, save_every_s=1e9,
+            install_signal_handler=False,
+        )
+        assert report.saves == 1 and mgr.steps() == [4]
+        # The save at the step-4 boundary snapshotted (on the caller
+        # thread) every key published before it — the restore agreement
+        # and all five consults — and deleted them after its commit
+        # barrier: nothing accumulates.
+        assert client.kv == {}
+
+    def test_broadcast_keys_gcd_periodically_without_saves(
+        self, tmp_path, monkeypatch
+    ):
+        """A run whose consult is armed but that never saves (no
+        cadence, waiting on SIGTERM) still keeps the coordinator's key
+        store bounded: every _BCAST_GC_EVERY agreements the world
+        rendezvouses and process 0 deletes the consumed keys."""
+        from kubeflow_tpu.models import checkpoint as ckpt
+
+        client = self._patch_client(monkeypatch)
+        monkeypatch.setattr(ckpt, "_BCAST_GC_EVERY", 4)
+        mgr = CheckpointManager(tmp_path, process_id=0, process_count=2)
+        for _ in range(10):
+            mgr.broadcast_from_zero("cadence", "run")
+        # GC fired at seq 4 and 8; only the tail since then remains.
+        assert len(client.kv) <= 4
+        gc_barriers = [b for b in client.barriers if "bcast-gc" in b]
+        assert gc_barriers == [
+            f"kft-ckpt-{mgr._ns}-bcast-gc-4",
+            f"kft-ckpt-{mgr._ns}-bcast-gc-8",
+        ]
+
+
+class TestMultiHostCadence:
+    """run_with_checkpointing in a process_count>1 world: wall-clock
+    saves and the SIGTERM stop are decided by process 0 and broadcast,
+    never acted on from a host-local clock or signal — per-host
+    decisions would save different steps on different ranks and tear
+    the step-keyed commit barrier (the shipped PodDefault arms
+    KFT_CHECKPOINT_EVERY_S by default, so this is the common path)."""
+
+    def _manager(self, tmp_path, transport):
+        return forged_world_manager(
+            tmp_path, keep=10, barrier=lambda: None, broadcast=transport
+        )
+
+    def test_wall_clock_cadence_is_agreed_not_local(self, tmp_path):
+        keys = []
+
+        def transport(key, value):
+            keys.append(key)
+            # Process 0 decided step 3 is a wall-clock save; this
+            # host's local clock (never due) must not matter.
+            return "save" if key.startswith("cadence-3.") else value
+
+        mgr = self._manager(tmp_path, transport)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(5), mgr,
+            save_every_s=1e9, install_signal_handler=False,
+        )
+        assert mgr.steps() == [3]
+        assert report.saves == 1
+        # One restore agreement, one consult per step boundary (before
+        # each of the 5 steps + the post-loop drain boundary).
+        assert keys[0].startswith("restore.")
+        assert [k.split(".")[0] for k in keys[1:]] == [
+            f"cadence-{i}" for i in range(6)
+        ]
+
+    def test_stop_is_agreed_and_final_save_synchronous(self, tmp_path):
+        def transport(key, value):
+            return "stop" if key.startswith("cadence-4.") else value
+
+        mgr = self._manager(tmp_path, transport)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(100), mgr,
+            save_every_s=1e9, install_signal_handler=False,
+        )
+        assert report.preempted
+        assert report.final_step == 4
+        # The agreed stop took the grace-window synchronous save.
+        assert mgr.latest_committed_step() == 4
+
+    def test_sigterm_after_last_consult_still_takes_final_save(
+        self, tmp_path
+    ):
+        """A SIGTERM landing between the last per-step agreement and
+        the iterator draining (or on an empty iterator) must not skip
+        the grace-window save: the loop takes one final agreed decision
+        after the batches end (the cadence-3 boundary of a 3-step run
+        is consulted post-loop)."""
+        def transport(key, value):
+            return "stop" if key.startswith("cadence-3.") else value
+
+        mgr = self._manager(tmp_path, transport)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(), ones_batches(3), mgr,
+            save_every_s=1e9, install_signal_handler=False,
+        )
+        assert report.preempted and report.final_step == 3
+        assert mgr.latest_committed_step() == 3
 
 
 # ---------------------------------------------------------------------------
