@@ -1,6 +1,6 @@
 """Platform-wide static analysis.
 
-Three rule packs over the repo tree, sharing one findings model and one
+Five rule packs over the repo tree, sharing one findings model and one
 CLI (``python -m kubeflow_tpu.analysis``):
 
 - :mod:`manifest_rules` — YAML manifests and controller-emitted desired
@@ -11,10 +11,19 @@ CLI (``python -m kubeflow_tpu.analysis``):
 - :mod:`mesh_rules` — MeshSpec factorizations in code and docs must
   divide the declared slice chip counts; 1F1B stage counts must divide
   microbatch/layer counts where both are declared statically.
-- :mod:`ast_rules` — Python hazards: side effects inside traced
-  (jit/pallas) functions, blocking calls in controller reconcile paths,
-  HTTP requests without an explicit timeout, broad excepts that swallow
-  silently.
+- :mod:`ast_rules` — per-node Python hazards: side effects inside
+  traced (jit/pallas) functions, blocking calls in controller reconcile
+  paths, HTTP requests without an explicit timeout, broad excepts that
+  swallow silently, non-atomic state-file writes.
+- :mod:`spmd_rules` — SPMD coherence via intraprocedural dataflow
+  (:mod:`cfg` + :mod:`dataflow` + one-level :mod:`callgraph`
+  summaries): collectives control-dependent on rank/host-local values,
+  barrier ids/kv keys derived from tainted or per-process-counter
+  values, collectives inside except handlers.
+  ``broadcast_from_zero`` is the registered sanitizer.
+- :mod:`concurrency_rules` — control-plane lock discipline: attributes
+  written both inside and outside a lock scope, ABBA lock-order
+  inversions, blocking calls held under a lock.
 
 Findings carry (rule, severity, file:line, message). Two suppression
 mechanisms keep the gate green without hiding regressions: an inline

@@ -53,7 +53,14 @@ def main(argv: list[str] | None = None) -> int:
         help="skip the controller-emitted desired-state probe",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="sarif emits a SARIF 2.1.0 document for CI PR annotation",
+    )
+    parser.add_argument(
+        "--sarif-out", default=None, metavar="PATH",
+        help="additionally write a SARIF 2.1.0 document to PATH from "
+        "the same scan (the CI gate prints text AND uploads SARIF "
+        "without paying for two analysis runs)",
     )
     args = parser.parse_args(argv)
 
@@ -76,7 +83,25 @@ def main(argv: list[str] | None = None) -> int:
     except BaselineError as exc:
         print(str(exc), file=sys.stderr)
         return 2
+    sarif_failed = False
+    if args.sarif_out:
+        try:
+            with open(args.sarif_out, "w") as fh:
+                fh.write(render_report(new, baselined, "sarif"))
+                fh.write("\n")
+        except OSError as exc:
+            # The scan's findings must not be lost to an artifact-path
+            # typo: report them, then exit 2 (tool error, like a
+            # malformed baseline) so CI fails loudly rather than
+            # uploading nothing while looking green.
+            print(
+                f"could not write SARIF to {args.sarif_out}: {exc}",
+                file=sys.stderr,
+            )
+            sarif_failed = True
     print(render_report(new, baselined, args.format))
+    if sarif_failed:
+        return 2
     return gate_exit_code(new)
 
 

@@ -57,6 +57,7 @@ from __future__ import annotations
 import ast
 import os
 
+from kubeflow_tpu.analysis.dataflow import import_aliases as _import_aliases
 from kubeflow_tpu.analysis.findings import Finding, Severity
 
 # Dotted call targets that are side effects under a jit/pallas trace.
@@ -95,22 +96,6 @@ def _dotted(node: ast.AST, aliases: dict[str, str]) -> str:
     else:
         return ""
     return ".".join(reversed(parts))
-
-
-def _import_aliases(tree: ast.AST) -> dict[str, str]:
-    aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                aliases[alias.asname or alias.name.split(".")[0]] = (
-                    alias.name if alias.asname else alias.name.split(".")[0]
-                )
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            for alias in node.names:
-                aliases[alias.asname or alias.name] = (
-                    f"{node.module}.{alias.name}"
-                )
-    return aliases
 
 
 def _is_jit_decorator(dec: ast.AST, aliases: dict[str, str]) -> bool:

@@ -1,0 +1,247 @@
+"""Module-level symbol table + one-level call summaries.
+
+Intraprocedural dataflow alone would lose taint at every helper
+boundary — ``token = decide()`` in a train loop, where ``decide()``
+reads the host-local wall clock, is precisely the shape PR 4's bug
+took. This module gives the dataflow pass just enough interprocedural
+reach to follow that: every function defined in the module (methods
+and nested functions included) gets a *summary* computed by seeding its
+parameters with placeholder labels and collecting the taint of its
+return expressions:
+
+- ``base``: source labels that reach the return regardless of inputs
+  ("decide() reads time.monotonic()").
+- ``deps``: parameter positions whose taint flows through to the
+  return ("identity-ish helpers keep their argument's taint").
+- a summary of a function whose returns all pass through a sanitizer
+  is naturally clean (empty base, no deps).
+
+Call sites then resolve one level deep: plain names resolve lexically
+(nearest enclosing scope, then module level), ``self.m(...)`` resolves
+to the enclosing class's method. Summaries are themselves computed
+leaf-style (calls inside a summarized function fall back to the
+conservative union), so the precision is exactly "one direct call
+deep", as advertised — deeper chains stay conservative, never silent.
+
+Thread-entry detection also lives here: functions handed to
+``threading.Thread(target=...)`` / ``executor.submit(fn, ...)`` and
+the conventional loop entry points (``run``, ``run_forever``) are
+roots. The concurrency pack names these roots in its unlocked-write
+messages (lock *presence* is its detection signal — the spawn site
+usually lives in another module); ``reachable_from`` computes the
+transitive closure over the same resolved call edges for packs and
+tests that need full reachability.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.dataflow import (
+    FunctionDataflow,
+    TaintRegistry,
+    VarInfo,
+    dotted_name,
+)
+
+_PARAM_PREFIX = "param:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Summary:
+    """Taint behavior of one function's return value."""
+
+    base: frozenset
+    deps: frozenset  # parameter names whose taint flows to the return
+    param_names: tuple[str, ...] = ()
+
+    def apply(self, arg_taints, kwarg_taints) -> frozenset:
+        out = frozenset(self.base)
+        for idx, taint in enumerate(arg_taints):
+            if idx < len(self.param_names) and \
+                    self.param_names[idx] in self.deps:
+                out |= taint
+        for name, taint in (kwarg_taints or {}).items():
+            if name in self.deps:
+                out |= taint
+        return out
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    scope: tuple[str, ...]  # enclosing function qualnames, outer→inner
+    cls: str | None  # enclosing class name, if a method
+    summary: Summary | None = None
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    names += [a.arg for a in args.kwonlyargs]
+    return names
+
+
+class CallGraph:
+    """Symbol table + summaries for one module tree."""
+
+    def __init__(self, tree: ast.AST, registry: TaintRegistry,
+                 aliases: dict[str, str]) -> None:
+        self.registry = registry
+        self.aliases = aliases
+        self.functions: dict[str, FunctionInfo] = {}
+        self._methods: dict[tuple[str, str], FunctionInfo] = {}
+        self._collect(tree, scope=(), cls=None)
+        for info in self.functions.values():
+            info.summary = self._summarize(info)
+
+    # -- symbol table ----------------------------------------------------
+    def _collect(self, node: ast.AST, scope: tuple[str, ...],
+                 cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # scope entries are already fully qualified — only the
+                # innermost one prefixes the child.
+                if scope:
+                    qual = f"{scope[-1]}.{child.name}"
+                elif cls:
+                    qual = f"{cls}.{child.name}"
+                else:
+                    qual = child.name
+                info = FunctionInfo(qual, child, scope, cls)
+                self.functions.setdefault(qual, info)
+                if cls is not None:
+                    self._methods.setdefault((cls, child.name), info)
+                self._collect(child, scope + (qual,), None)
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, scope, cls=child.name)
+            else:
+                self._collect(child, scope, cls)
+
+    def lookup(self, name: str, scope: tuple[str, ...],
+               cls: str | None) -> FunctionInfo | None:
+        """Lexical resolution: innermost enclosing scope's nested defs
+        first, then module level; ``self.name`` resolves via ``cls``."""
+        if name.startswith("self.") or name.startswith("cls."):
+            method = name.split(".", 1)[1]
+            if cls is not None and "." not in method:
+                return self._methods.get((cls, method))
+            return None
+        if "." in name:
+            return self.functions.get(name)
+        for depth in range(len(scope), -1, -1):
+            prefix = scope[depth - 1] if depth else None
+            qual = f"{prefix}.{name}" if prefix else name
+            info = self.functions.get(qual)
+            if info is not None:
+                return info
+        return None
+
+    # -- summaries -------------------------------------------------------
+    def _summarize(self, info: FunctionInfo) -> Summary:
+        params = _param_names(info.node)
+        initial = {
+            name: VarInfo(labels=frozenset([f"{_PARAM_PREFIX}{name}"]))
+            for name in params
+        }
+        flow = FunctionDataflow(
+            cfg_mod.build_cfg(info.node.body),
+            self.registry,
+            self.aliases,
+            initial=initial,
+        )
+        base = frozenset(
+            label for label in flow.return_taint
+            if not label.startswith(_PARAM_PREFIX)
+        )
+        deps = frozenset(
+            label[len(_PARAM_PREFIX):] for label in flow.return_taint
+            if label.startswith(_PARAM_PREFIX)
+        )
+        return Summary(base=base, deps=deps, param_names=tuple(params))
+
+    def resolver(self, scope: tuple[str, ...], cls: str | None):
+        """A ``resolver(dotted, call)`` closure for
+        :class:`FunctionDataflow`, bound to the caller's scope."""
+
+        def resolve(dotted: str, call: ast.Call):
+            info = self.lookup(dotted, scope, cls)
+            return info.summary if info is not None else None
+
+        return resolve
+
+
+# -- thread entry points -------------------------------------------------
+
+# Methods that are, by platform convention, driven from their own
+# thread: controller/webhook/watch loops and stdlib thread protocols.
+_CONVENTIONAL_ENTRY_NAMES = {
+    "run", "run_forever", "serve_forever", "watch_loop", "poll_loop",
+}
+
+
+def thread_entry_names(tree: ast.AST, aliases: dict[str, str]) -> set[str]:
+    """Bare names of callables handed to thread machinery in this
+    module: ``threading.Thread(target=fn)``, ``Thread(target=self.loop)``
+    (yields ``loop``), ``executor.submit(fn, ...)``, plus the
+    conventional loop entry points defined anywhere in the tree."""
+    out: set[str] = set()
+
+    def callable_name(node: ast.AST) -> str | None:
+        dotted = dotted_name(node, {})
+        if not dotted:
+            return None
+        return dotted.rsplit(".", 1)[-1]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node.func, aliases)
+        if dotted.endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = callable_name(kw.value)
+                    if name:
+                        out.add(name)
+        elif dotted.endswith(".submit") and node.args:
+            name = callable_name(node.args[0])
+            if name:
+                out.add(name)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name in _CONVENTIONAL_ENTRY_NAMES:
+            out.add(node.name)
+    return out
+
+
+def reachable_from(graph: CallGraph, roots: set[str]) -> set[str]:
+    """Function qualnames transitively callable from any function whose
+    *bare* name is in ``roots`` (thread targets are usually recorded as
+    bare names). Edges follow the same resolution as taint summaries."""
+    by_bare: dict[str, list[FunctionInfo]] = {}
+    for info in graph.functions.values():
+        by_bare.setdefault(info.node.name, []).append(info)
+    work = [
+        info for name in roots for info in by_bare.get(name, [])
+    ]
+    seen: set[str] = set()
+    while work:
+        info = work.pop()
+        if info.qualname in seen:
+            continue
+        seen.add(info.qualname)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func, graph.aliases)
+            target = graph.lookup(
+                dotted, info.scope + (info.qualname,), info.cls
+            )
+            if target is not None and target.qualname not in seen:
+                work.append(target)
+    return seen

@@ -1,0 +1,321 @@
+"""Control-flow graphs over Python ``ast`` function bodies.
+
+The graph is deliberately structural: blocks are created by recursing
+over the statement tree, and every block carries the stack of
+:class:`Guard` conditions that dominate it — the branch tests, loop
+iterables and except handlers a path must have satisfied to reach the
+block. That guard stack IS the control-dependence information the SPMD
+pack consumes ("this collective only runs when ``time.monotonic() -
+last >= cadence`` was true on *this* host"), so no post-dominator
+computation is needed for structured code.
+
+Early exits are folded into the guards too: after ``if cond: return``,
+the remaining statements of the enclosing sequence are guarded by
+``cond`` *negated* — a rank that took the early return never reaches
+them, which is exactly the divergence story a collective placed there
+needs to answer for.
+
+Blocks link forward (``succs``/``preds``) so a worklist dataflow pass
+(:mod:`kubeflow_tpu.analysis.dataflow`) can iterate to fixpoint; loop
+bodies get back edges to their headers, ``try`` bodies edge into their
+handlers (approximated as handler-entry from both the try entry and the
+try exit), and return/raise/break/continue terminate their block.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Guard:
+    """One control condition dominating a block.
+
+    ``kind`` is one of:
+
+    - ``"if"`` — ``test`` is the branch expression; ``negated`` True
+      for else-branches and for statements following an always-exiting
+      then-branch.
+    - ``"while"`` — ``test`` is the loop condition (body only runs
+      while it held).
+    - ``"for"`` — ``test`` is the *iterable*: a body statement runs a
+      data-dependent number of times.
+    - ``"except"`` — ``test`` is None; ``node`` is the
+      ``ast.ExceptHandler``. Exception delivery is host-local, which is
+      why the SPMD pack treats this guard specially.
+    """
+
+    kind: str
+    test: ast.expr | None
+    node: ast.AST
+    negated: bool = False
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+
+@dataclasses.dataclass
+class Block:
+    id: int
+    guards: tuple[Guard, ...]
+    stmts: list[ast.stmt] = dataclasses.field(default_factory=list)
+    succs: list[int] = dataclasses.field(default_factory=list)
+    preds: list[int] = dataclasses.field(default_factory=list)
+    # Set when the block ends in return/raise/break/continue — no
+    # fallthrough edge is added out of it.
+    terminated: bool = False
+
+
+class CFG:
+    """Blocks + edges for one function (or module) body."""
+
+    def __init__(self) -> None:
+        self.blocks: list[Block] = []
+        self.entry = self._new_block(())
+        # guard -> id of the first block the guard applies to, so the
+        # dataflow pass can evaluate the guard expression against the
+        # taint state that held when the branch was actually taken.
+        self.guard_entry_block: dict[int, int] = {}
+
+    def _new_block(self, guards: tuple[Guard, ...]) -> Block:
+        block = Block(id=len(self.blocks), guards=guards)
+        self.blocks.append(block)
+        return block
+
+    def _edge(self, src: Block, dst: Block) -> None:
+        if dst.id not in src.succs:
+            src.succs.append(dst.id)
+            dst.preds.append(src.id)
+
+    def guard_block(self, guard: Guard) -> int:
+        """Entry block of the region ``guard`` dominates."""
+        return self.guard_entry_block[id(guard)]
+
+
+def _always_exits(stmts: list[ast.stmt]) -> bool:
+    """True when every path through ``stmts`` leaves the enclosing
+    sequence (return/raise/break/continue) — used to negate the guard
+    for the statements that follow."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                             ast.Continue)):
+            return True
+        if isinstance(stmt, ast.If):
+            if (stmt.orelse and _always_exits(stmt.body)
+                    and _always_exits(stmt.orelse)):
+                return True
+    return False
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.cfg = CFG()
+
+    def build(self, body: list[ast.stmt]) -> CFG:
+        self._seq(body, self.cfg.entry, self.cfg.entry.guards)
+        return self.cfg
+
+    # -- helpers ---------------------------------------------------------
+    def _child(self, guards: tuple[Guard, ...], guard: Guard) -> Block:
+        block = self.cfg._new_block(guards + (guard,))
+        self.cfg.guard_entry_block.setdefault(id(guard), block.id)
+        return block
+
+    def _seq(
+        self,
+        stmts: list[ast.stmt],
+        current: Block,
+        guards: tuple[Guard, ...],
+    ) -> Block:
+        """Thread ``stmts`` through the graph starting at ``current``;
+        returns the block control falls out of (possibly terminated)."""
+        for stmt in stmts:
+            if current.terminated:
+                # Unreachable code after an exit: park it in a fresh
+                # disconnected block so its findings still surface.
+                current = self.cfg._new_block(guards)
+            if isinstance(stmt, ast.If):
+                current = self._if(stmt, current, guards)
+            elif isinstance(stmt, ast.While):
+                current = self._while(stmt, current, guards)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                current = self._for(stmt, current, guards)
+            elif isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                current = self._try(stmt, current, guards)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # Context managers don't branch; the items are evaluated
+                # in the current block, the body continues it. Only the
+                # items go into the block (the body statements are
+                # threaded individually — appending the whole With would
+                # double-count them).
+                current.stmts.append(_WithEval(stmt))
+                current = self._seq(stmt.body, current, guards)
+            elif isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                   ast.Continue)):
+                current.stmts.append(stmt)
+                current.terminated = True
+            else:
+                # Simple statement — including nested FunctionDef /
+                # ClassDef, whose bodies get their own CFGs elsewhere.
+                current.stmts.append(stmt)
+        return current
+
+    def _if(self, stmt: ast.If, current: Block,
+            guards: tuple[Guard, ...]) -> Block:
+        current.stmts.append(_CondEval(stmt.test, stmt))
+        then_guard = Guard("if", stmt.test, stmt)
+        then_entry = self._child(guards, then_guard)
+        self.cfg._edge(current, then_entry)
+        then_exit = self._seq(stmt.body, then_entry, then_entry.guards)
+
+        else_guard = Guard("if", stmt.test, stmt, negated=True)
+        if stmt.orelse:
+            else_entry = self._child(guards, else_guard)
+            self.cfg._edge(current, else_entry)
+            else_exit = self._seq(stmt.orelse, else_entry,
+                                  else_entry.guards)
+        else:
+            else_entry = else_exit = None
+
+        # Join. When exactly one branch always exits, falling through
+        # the If means the *other* branch was taken: the join inherits
+        # that branch's guard (the early-return divergence story —
+        # with or without an else clause). Both exiting leaves the
+        # join unreachable; neither exiting leaves it unguarded.
+        body_exits = _always_exits(stmt.body)
+        else_exits = bool(stmt.orelse) and _always_exits(stmt.orelse)
+        join_guards = guards
+        if body_exits and not else_exits:
+            join_guards = guards + (else_guard,)
+        elif else_exits and not body_exits:
+            join_guards = guards + (then_guard,)
+        join = self.cfg._new_block(join_guards)
+        self.cfg.guard_entry_block.setdefault(id(else_guard), join.id)
+        if not then_exit.terminated:
+            self.cfg._edge(then_exit, join)
+        if else_exit is not None:
+            if not else_exit.terminated:
+                self.cfg._edge(else_exit, join)
+        else:
+            self.cfg._edge(current, join)
+        return join
+
+    def _while(self, stmt: ast.While, current: Block,
+               guards: tuple[Guard, ...]) -> Block:
+        header = self.cfg._new_block(guards)
+        self.cfg._edge(current, header)
+        header.stmts.append(_CondEval(stmt.test, stmt))
+        body_guard = Guard("while", stmt.test, stmt)
+        body_entry = self._child(guards, body_guard)
+        self.cfg._edge(header, body_entry)
+        body_exit = self._seq(stmt.body, body_entry, body_entry.guards)
+        if not body_exit.terminated:
+            self.cfg._edge(body_exit, header)  # back edge
+        after = self.cfg._new_block(guards)
+        self.cfg._edge(header, after)
+        if stmt.orelse:
+            after = self._seq(stmt.orelse, after, guards)
+        return after
+
+    def _for(self, stmt: ast.For | ast.AsyncFor, current: Block,
+             guards: tuple[Guard, ...]) -> Block:
+        header = self.cfg._new_block(guards)
+        self.cfg._edge(current, header)
+        header.stmts.append(_IterEval(stmt.target, stmt.iter, stmt))
+        body_guard = Guard("for", stmt.iter, stmt)
+        body_entry = self._child(guards, body_guard)
+        self.cfg._edge(header, body_entry)
+        body_exit = self._seq(stmt.body, body_entry, body_entry.guards)
+        if not body_exit.terminated:
+            self.cfg._edge(body_exit, header)
+        after = self.cfg._new_block(guards)
+        self.cfg._edge(header, after)
+        if stmt.orelse:
+            after = self._seq(stmt.orelse, after, guards)
+        return after
+
+    def _try(self, stmt: ast.Try, current: Block,
+             guards: tuple[Guard, ...]) -> Block:
+        body_entry = self.cfg._new_block(guards)
+        self.cfg._edge(current, body_entry)
+        body_exit = self._seq(stmt.body, body_entry, guards)
+        if stmt.orelse and not body_exit.terminated:
+            body_exit = self._seq(stmt.orelse, body_exit, guards)
+
+        after = self.cfg._new_block(guards)
+        if not body_exit.terminated:
+            self.cfg._edge(body_exit, after)
+        for handler in stmt.handlers:
+            h_guard = Guard("except", None, handler)
+            h_entry = self._child(guards, h_guard)
+            # The exception can fire anywhere in the body: approximate
+            # handler-entry state as "before the try" joined with
+            # "after the try body".
+            self.cfg._edge(current, h_entry)
+            if not body_exit.terminated:
+                self.cfg._edge(body_exit, h_entry)
+            h_exit = self._seq(handler.body, h_entry, h_entry.guards)
+            if not h_exit.terminated:
+                self.cfg._edge(h_exit, after)
+        if stmt.finalbody:
+            after = self._seq(stmt.finalbody, after, guards)
+        return after
+
+
+class _CondEval(ast.stmt):
+    """Synthetic statement marking "this branch/loop test is evaluated
+    here" so the dataflow pass sees the expression in program order."""
+
+    _fields = ("test",)
+
+    def __init__(self, test: ast.expr, origin: ast.stmt) -> None:
+        self.test = test
+        self.origin = origin
+        self.lineno = getattr(origin, "lineno", 0)
+        self.col_offset = getattr(origin, "col_offset", 0)
+
+
+class _WithEval(ast.stmt):
+    """Synthetic statement for ``with`` headers: evaluates each context
+    expression and binds the ``as`` targets; the body statements are
+    threaded into the graph separately."""
+
+    _fields = ("items",)
+
+    def __init__(self, origin: ast.With | ast.AsyncWith) -> None:
+        self.items = origin.items
+        self.origin = origin
+        self.lineno = getattr(origin, "lineno", 0)
+        self.col_offset = getattr(origin, "col_offset", 0)
+
+
+class _IterEval(ast.stmt):
+    """Synthetic statement for a for-loop header: binds ``target`` from
+    ``iter`` once per iteration."""
+
+    _fields = ("target", "iter")
+
+    def __init__(self, target: ast.expr, iter_: ast.expr,
+                 origin: ast.stmt) -> None:
+        self.target = target
+        self.iter = iter_
+        self.origin = origin
+        self.lineno = getattr(origin, "lineno", 0)
+        self.col_offset = getattr(origin, "col_offset", 0)
+
+
+def build_cfg(body: list[ast.stmt]) -> CFG:
+    """CFG for one function (or module) statement list."""
+    return _Builder().build(body)
+
+
+def function_cfgs(tree: ast.AST):
+    """Yield ``(node, cfg)`` for every function in ``tree`` (methods
+    and nested functions included), each body built in isolation —
+    the analysis is intraprocedural; cross-function flow goes through
+    :mod:`kubeflow_tpu.analysis.callgraph` summaries."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, build_cfg(node.body)

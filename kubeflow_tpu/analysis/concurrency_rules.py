@@ -1,0 +1,378 @@
+"""Pack B — control-plane concurrency discipline.
+
+The control plane is threads all the way down: watch pumps, reconcile
+workers, webhook servers, background checkpoint writers. The rules here
+read each class's *lock discipline* and flag the slips review keeps
+catching by hand:
+
+- ``conc-unlocked-shared-write`` (error): an attribute is written both
+  inside a lock scope and outside any lock scope (``__init__`` /
+  ``__new__`` excluded — construction happens-before publication). A
+  class that takes a lock for *some* writes of an attribute has
+  declared it shared; the unlocked write is a torn-read/lost-update
+  window. Presence of a lock attribute on the class is the concurrency
+  signal — deliberately broader than thread-entry reachability, since
+  the spawning ``Thread(target=...)`` usually lives in another module
+  (the manager), and a lock-free class is assumed single-threaded (no
+  findings, ever).
+- ``conc-lock-order-inversion`` (error): somewhere in the module lock A
+  is taken while holding B, and somewhere else B while holding A — the
+  classic ABBA deadlock, needing only two threads and bad timing.
+- ``conc-blocking-under-lock`` (warning): ``time.sleep``, subprocess
+  spawns, or HTTP without ``timeout=`` while holding a lock. Every
+  other thread that needs the lock now waits on the network/scheduler
+  too; the double-checked ``_auth_headers`` refresh exists precisely so
+  the token-file read happens off the hot lock.
+
+Lock scopes are ``with self._lock:`` bodies and ``acquire()`` /
+``release()`` bracketing within a method, matched per lock attribute.
+A method whose name ends in ``_locked`` runs with the caller's lock
+held by contract (``CircuitBreaker._state_locked``): its writes count
+as locked and blocking calls inside it still warn. Test trees are
+exempt (they build deliberate races); the fixture tree seeds both the
+violations and the clean counterparts.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis.callgraph import thread_entry_names
+from kubeflow_tpu.analysis.dataflow import (
+    dotted_name,
+    import_aliases,
+    is_test_path,
+)
+from kubeflow_tpu.analysis.findings import Finding, Severity
+
+_LOCK_FACTORY_SUFFIXES = (
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+)
+
+# Mutating container methods: self.attr.append(...) is a write to attr.
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popitem", "clear", "update", "setdefault",
+}
+
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.Popen": "subprocess.Popen()",
+}
+_HTTP_PREFIXES = ("requests.",)
+_HTTP_EXACT = {"urllib.request.urlopen"}
+
+# Pseudo lock name for ``*_locked`` helper methods (caller holds the
+# real lock); never reported as an acquisition site.
+_CALLER_HELD = "<caller-held>"
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` → ``X`` (one level only)."""
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef, aliases: dict[str, str]) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        dotted = dotted_name(value.func, aliases)
+        if not dotted.split(".")[-1] in _LOCK_FACTORY_SUFFIXES:
+            continue
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr:
+                locks.add(attr)
+    return locks
+
+
+class _Write:
+    __slots__ = ("attr", "method", "line", "lock", "in_init")
+
+    def __init__(self, attr: str, method: str, line: int,
+                 lock: str | None, in_init: bool) -> None:
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.lock = lock
+        self.in_init = in_init
+
+
+class _MethodWalker:
+    """Linear walk of one method body tracking which of the class's
+    locks are held (``with self._lock:`` nesting plus
+    ``acquire``/``release`` bracketing), collecting attribute writes,
+    lock-acquisition order edges, and blocking calls under a lock."""
+
+    def __init__(self, cls_name: str, method: str, locks: set[str],
+                 aliases: dict[str, str]) -> None:
+        self.cls_name = cls_name
+        self.method = method
+        self.locks = locks
+        self.aliases = aliases
+        self.writes: list[_Write] = []
+        self.order_edges: list[tuple[str, str, int]] = []  # held, taken
+        self.blocking: list[tuple[int, str]] = []
+        self._held: list[str] = []
+
+    def walk(self, body: list[ast.stmt]) -> None:
+        self._stmts(body)
+
+    # -- traversal -------------------------------------------------------
+    def _stmts(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _item_lock(self, item: ast.withitem) -> str | None:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            # with self._lock.acquire_timeout(...) style wrappers
+            attr = _self_attr(expr.func.value) if isinstance(
+                expr.func, ast.Attribute
+            ) else None
+        else:
+            attr = _self_attr(expr)
+        return attr if attr in self.locks else None
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # Items evaluate left to right: a lock item starts its
+            # scope before the NEXT item's context expression runs, so
+            # `with self._lock, requests.get(...)` blocks under the
+            # lock and must be scanned like any other expression.
+            taken = []
+            for item in stmt.items:
+                lock = self._item_lock(item)
+                if lock is not None:
+                    self._acquire(lock, stmt.lineno)
+                    taken.append(lock)
+                else:
+                    self._expr(item.context_expr)
+            self._stmts(stmt.body)
+            for lock in reversed(taken):
+                self._release(lock)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs have their own discipline
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._stmts(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        # Simple statements: assignments and expression calls.
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            for target in stmt.targets:
+                self._write_target(target, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            self._write_target(stmt.target, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+                self._write_target(stmt.target, stmt.lineno)
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                self._write_target(target, stmt.lineno)
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.expr):
+                self._expr(node)
+                break
+
+    def _write_target(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._write_target(elt, line)
+            return
+        if isinstance(target, ast.Starred):
+            self._write_target(target.value, line)
+            return
+        if isinstance(target, ast.Subscript):
+            # self.d[k] = v mutates self.d
+            self._write_target_attr_only(target.value, line)
+            return
+        self._write_target_attr_only(target, line)
+
+    def _write_target_attr_only(self, node: ast.AST, line: int) -> None:
+        attr = _self_attr(node)
+        if attr is None or attr in self.locks:
+            return
+        self.writes.append(_Write(
+            attr, self.method, line,
+            self._held[-1] if self._held else None,
+            self.method in ("__init__", "__new__"),
+        ))
+
+    def _expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func, self.aliases)
+            last = dotted.rsplit(".", 1)[-1]
+            receiver_attr = None
+            if isinstance(node.func, ast.Attribute):
+                receiver_attr = _self_attr(node.func.value)
+            if last == "acquire" and receiver_attr in self.locks:
+                self._acquire(receiver_attr, node.lineno)
+            elif last == "release" and receiver_attr in self.locks:
+                self._release(receiver_attr)
+            elif last in _MUTATING_METHODS and receiver_attr is not None:
+                self._write_target_attr_only(node.func.value, node.lineno)
+            elif self._held:
+                blocked = _BLOCKING_EXACT.get(dotted)
+                if blocked is None and (
+                    dotted in _HTTP_EXACT
+                    or any(dotted.startswith(p) for p in _HTTP_PREFIXES)
+                ) and not any(kw.arg == "timeout" for kw in node.keywords):
+                    blocked = f"{dotted}() without timeout="
+                if blocked is not None:
+                    self.blocking.append((node.lineno, blocked))
+
+    def _acquire(self, lock: str, line: int) -> None:
+        for held in self._held:
+            if held != lock and held != _CALLER_HELD:
+                self.order_edges.append((held, lock, line))
+        self._held.append(lock)
+
+    def _release(self, lock: str) -> None:
+        if lock in self._held:
+            self._held.reverse()
+            self._held.remove(lock)
+            self._held.reverse()
+
+
+def analyze_python_concurrency(source: str, path: str) -> list[Finding]:
+    """Pack B over one Python file."""
+    if is_test_path(path):
+        return []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    aliases = import_aliases(tree)
+    # Methods handed to Thread(target=...)/submit() or matching the
+    # conventional loop names: named in unlocked-write messages so the
+    # reader sees the concrete second thread, not just the lock.
+    entry_names = thread_entry_names(tree, aliases)
+    out: list[Finding] = []
+    # (class, held, taken) -> first site line, for inversion detection
+    # across every class in the module (locks are compared per class:
+    # cross-class inversions need alias knowledge we don't have).
+    order_edges: dict[tuple[str, str, str], int] = {}
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        locks = _lock_attrs(cls, aliases)
+        if not locks:
+            continue  # no lock, no declared sharing: single-threaded
+        writes: list[_Write] = []
+        blocking: list[tuple[int, str]] = []
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            walker = _MethodWalker(cls.name, item.name, locks, aliases)
+            if item.name.endswith("_locked"):
+                # Contractually called with the lock held: its writes
+                # are locked writes, and anything blocking inside it
+                # blocks the caller's critical section.
+                walker._held.append(_CALLER_HELD)
+            walker.walk(item.body)
+            writes.extend(walker.writes)
+            blocking.extend(walker.blocking)
+            for held, taken, line in walker.order_edges:
+                order_edges.setdefault((cls.name, held, taken), line)
+
+        by_attr: dict[str, list[_Write]] = {}
+        for write in writes:
+            by_attr.setdefault(write.attr, []).append(write)
+        for attr, attr_writes in sorted(by_attr.items()):
+            locked = [w for w in attr_writes if w.lock is not None]
+            unlocked = [
+                w for w in attr_writes
+                if w.lock is None and not w.in_init
+            ]
+            if not locked or not unlocked:
+                continue
+            lock_names = sorted(
+                {w.lock for w in locked} - {_CALLER_HELD}
+            ) or ["_lock"]
+            for write in unlocked:
+                entry = (
+                    " (a thread entry point)"
+                    if write.method in entry_names else ""
+                )
+                out.append(Finding(
+                    "conc-unlocked-shared-write", Severity.ERROR, path,
+                    write.line,
+                    f"{cls.name}.{attr} is written under "
+                    f"{'/'.join('self.' + n for n in lock_names)} "
+                    f"elsewhere but written here ({write.method}"
+                    f"{entry}) with "
+                    "no lock held: concurrent callers can tear or lose "
+                    "this update — take the same lock (or annotate a "
+                    "provably single-threaded path with # analysis: "
+                    "allow[conc-unlocked-shared-write])",
+                ))
+        for line, what in blocking:
+            out.append(Finding(
+                "conc-blocking-under-lock", Severity.WARNING, path, line,
+                f"{what} while holding a lock in {cls.name}: every "
+                "thread needing the lock now waits on the "
+                "scheduler/network too — move the blocking call off "
+                "the critical section (compute under the lock, block "
+                "outside it)",
+            ))
+
+    seen_pairs: set[tuple[str, str, str]] = set()
+    for (cls_name, held, taken), line in sorted(
+        order_edges.items(), key=lambda kv: kv[1]
+    ):
+        inverse = (cls_name, taken, held)
+        if inverse in order_edges and (cls_name, *sorted((held, taken))) \
+                not in seen_pairs:
+            seen_pairs.add((cls_name, *sorted((held, taken))))
+            out.append(Finding(
+                "conc-lock-order-inversion", Severity.ERROR, path,
+                max(line, order_edges[inverse]),
+                f"lock-order inversion in {cls_name}: self.{taken} is "
+                f"taken while holding self.{held} and self.{held} "
+                f"while holding self.{taken} — two threads interleaving "
+                "these paths deadlock; pick one global order and "
+                "acquire in it everywhere",
+            ))
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out

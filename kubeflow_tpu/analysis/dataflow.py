@@ -1,0 +1,416 @@
+"""Forward dataflow over :mod:`kubeflow_tpu.analysis.cfg` graphs:
+reaching definitions + a taint lattice with a pluggable
+source/sink/sanitizer registry.
+
+The lattice element per variable is a pair ``(labels, def_lines)``:
+``labels`` is the set of taint-source descriptions that may flow into
+the variable ("jax.process_index() (line 12)"), ``def_lines`` the set
+of assignment lines that may have produced its value (classic reaching
+definitions, used for finding messages and tested directly). Join is
+pointwise union; a variable absent from one branch joins as bottom, so
+taint introduced on *any* path survives the merge — exactly the
+pessimism SPMD coherence needs ("process 0 sanitized it, the others
+didn't").
+
+Calls resolve in three steps: sanitizer match (result is clean by
+definition — ``broadcast_from_zero`` returns the same value on every
+rank), source match (result carries the source's label), then an
+optional resolver of local-function summaries
+(:mod:`kubeflow_tpu.analysis.callgraph`) for one-level interprocedural
+flow. Unresolved calls conservatively return the union of receiver and
+argument taints — ``f"{tainted}"``, ``str(tainted)`` and
+``min(tainted, x)`` all stay tainted.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kubeflow_tpu.analysis.cfg import (
+    CFG,
+    Guard,
+    _CondEval,
+    _IterEval,
+    _WithEval,
+)
+
+
+def dotted_name(node: ast.AST, aliases: dict[str, str]) -> str:
+    """Flatten a Name/Attribute chain to a dotted string, resolving
+    import aliases at the root (shared shape with ast_rules._dotted)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Name → dotted-target map from the module's imports, so
+    ``from urllib.request import urlopen`` makes bare ``urlopen``
+    resolve to ``urllib.request.urlopen``. Shared by every Python rule
+    pack — one copy, one drift surface."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return aliases
+
+
+# Trees exempt from the dataflow packs: they seed divergence and races
+# on purpose (the fixture suite pins the rules' behavior instead).
+_EXEMPT_DIRS = frozenset({"tests", "testing", "docs", "examples"})
+_EXEMPT_BASENAMES = frozenset({"conftest.py"})
+
+
+def is_test_path(path: str) -> bool:
+    import os
+
+    base = os.path.basename(path)
+    if base in _EXEMPT_BASENAMES or base.startswith("test_"):
+        return True
+    parts = path.replace("\\", "/").split("/")[:-1]
+    return any(part in _EXEMPT_DIRS for part in parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class CallPattern:
+    """Matches dotted call targets: exact names, trailing suffixes
+    (``.is_set`` matches any receiver), or dotted prefixes
+    (``random.`` matches the whole module)."""
+
+    label: str
+    exact: tuple[str, ...] = ()
+    suffixes: tuple[str, ...] = ()
+    prefixes: tuple[str, ...] = ()
+
+    def matches(self, dotted: str) -> bool:
+        if not dotted:
+            return False
+        if dotted in self.exact:
+            return True
+        if any(dotted.endswith(s) for s in self.suffixes):
+            return True
+        return any(dotted.startswith(p) for p in self.prefixes)
+
+
+@dataclasses.dataclass
+class TaintRegistry:
+    """What taints, what cleans, and what must stay coherent.
+
+    ``sources`` label call results; ``subscript_sources`` label
+    subscript reads of the named dotted bases (``os.environ[...]``);
+    ``sanitizers`` clear taint from a call result; ``seed`` pre-taints
+    variables at function entry (per-process counter attributes).
+    Sinks live in the rule packs — the registry only drives
+    propagation.
+    """
+
+    sources: tuple[CallPattern, ...] = ()
+    subscript_sources: tuple[str, ...] = ()
+    sanitizers: tuple[CallPattern, ...] = ()
+    seed: dict = dataclasses.field(default_factory=dict)
+
+    def source_label(self, dotted: str) -> str | None:
+        for pattern in self.sources:
+            if pattern.matches(dotted):
+                return pattern.label
+        return None
+
+    def is_sanitizer(self, dotted: str) -> bool:
+        return any(p.matches(dotted) for p in self.sanitizers)
+
+
+# A variable's lattice value.
+@dataclasses.dataclass(frozen=True)
+class VarInfo:
+    labels: frozenset = frozenset()
+    def_lines: frozenset = frozenset()
+
+    def join(self, other: "VarInfo") -> "VarInfo":
+        return VarInfo(self.labels | other.labels,
+                       self.def_lines | other.def_lines)
+
+
+_BOTTOM = VarInfo()
+
+State = dict  # var name -> VarInfo
+
+
+def _join_states(a: State, b: State) -> State:
+    out = dict(a)
+    for var, info in b.items():
+        cur = out.get(var)
+        out[var] = info if cur is None else cur.join(info)
+    return out
+
+
+class FunctionDataflow:
+    """Fixpoint taint/reaching-defs facts for one CFG.
+
+    ``resolver(dotted, call) -> summary | None`` supplies local-function
+    summaries; a summary is any object with
+    ``apply(arg_taints, kwarg_taints) -> frozenset``.
+    """
+
+    def __init__(
+        self,
+        cfg: CFG,
+        registry: TaintRegistry,
+        aliases: dict[str, str],
+        initial: State | None = None,
+        resolver=None,
+    ) -> None:
+        self.cfg = cfg
+        self.registry = registry
+        self.aliases = aliases
+        self.resolver = resolver
+        self.return_taint: frozenset = frozenset()
+        entry_state: State = {
+            var: VarInfo(labels=frozenset(labels))
+            for var, labels in registry.seed.items()
+        }
+        if initial:
+            entry_state = _join_states(entry_state, initial)
+        self.in_states: list[State | None] = [None] * len(cfg.blocks)
+        self.in_states[cfg.entry.id] = entry_state
+        self._run()
+
+    # -- worklist --------------------------------------------------------
+    def _run(self) -> None:
+        worklist = [self.cfg.entry.id]
+        # Unreachable blocks (code after return) still get analyzed
+        # from an empty state so their findings surface.
+        for block in self.cfg.blocks:
+            if not block.preds and block.id != self.cfg.entry.id:
+                self.in_states[block.id] = {}
+                worklist.append(block.id)
+        iterations = 0
+        limit = max(64, 16 * len(self.cfg.blocks) ** 2)
+        while worklist and iterations < limit:
+            iterations += 1
+            bid = worklist.pop(0)
+            state = dict(self.in_states[bid] or {})
+            for stmt in self.cfg.blocks[bid].stmts:
+                state = self._transfer(stmt, state)
+            for succ in self.cfg.blocks[bid].succs:
+                cur = self.in_states[succ]
+                new = state if cur is None else _join_states(cur, state)
+                if cur is None or new != cur:
+                    self.in_states[succ] = new
+                    if succ not in worklist:
+                        worklist.append(succ)
+
+    # -- queries ---------------------------------------------------------
+    def iter_statement_states(self):
+        """Yield ``(block, stmt, state_before_stmt)`` in block order —
+        the per-statement replay the rule packs check sinks against."""
+        for block in self.cfg.blocks:
+            state = dict(self.in_states[block.id] or {})
+            for stmt in block.stmts:
+                yield block, stmt, state
+                state = self._transfer(stmt, state)
+
+    def guard_taint(self, guard: Guard) -> frozenset:
+        """Taint of the guard's controlling expression, evaluated in
+        the state that held where the branch was taken."""
+        if guard.test is None:
+            return frozenset()
+        bid = self.cfg.guard_entry_block.get(id(guard))
+        state = self.in_states[bid] if bid is not None else None
+        return self.expr_taint(guard.test, state or {})
+
+    def var_info(self, state: State, name: str) -> VarInfo:
+        return state.get(name, _BOTTOM)
+
+    # -- transfer --------------------------------------------------------
+    def _transfer(self, stmt: ast.stmt, state: State) -> State:
+        state = dict(state)
+        if isinstance(stmt, _CondEval):
+            self.expr_taint(stmt.test, state)
+        elif isinstance(stmt, _IterEval):
+            taint = self.expr_taint(stmt.iter, state)
+            self._bind(stmt.target, VarInfo(taint,
+                                            frozenset([stmt.lineno])), state)
+        elif isinstance(stmt, _WithEval):
+            for item in stmt.items:
+                taint = self.expr_taint(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               VarInfo(taint, frozenset([stmt.lineno])),
+                               state)
+        elif isinstance(stmt, ast.Assign):
+            info = VarInfo(self.expr_taint(stmt.value, state),
+                           frozenset([stmt.lineno]))
+            for target in stmt.targets:
+                self._bind(target, info, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            info = VarInfo(self.expr_taint(stmt.value, state),
+                           frozenset([stmt.lineno]))
+            self._bind(stmt.target, info, state)
+        elif isinstance(stmt, ast.AugAssign):
+            add = self.expr_taint(stmt.value, state)
+            name = self._target_key(stmt.target)
+            if name is not None:
+                old = state.get(name, _BOTTOM)
+                state[name] = VarInfo(old.labels | add,
+                                      old.def_lines
+                                      | frozenset([stmt.lineno]))
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.return_taint |= self.expr_taint(stmt.value, state)
+        elif isinstance(stmt, ast.Expr):
+            self.expr_taint(stmt.value, state)
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                key = self._target_key(target)
+                state.pop(key, None)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            state[stmt.name] = VarInfo(frozenset(),
+                                       frozenset([stmt.lineno]))
+        return state
+
+    def _target_key(self, target: ast.AST) -> str | None:
+        if isinstance(target, ast.Name):
+            return target.id
+        if isinstance(target, ast.Attribute):
+            dotted = dotted_name(target, {})
+            return dotted or None
+        return None
+
+    def _bind(self, target: ast.AST, info: VarInfo, state: State) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, info, state)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind(target.value, info, state)
+            return
+        if isinstance(target, ast.Subscript):
+            # d[k] = tainted makes the container suspect.
+            key = self._target_key(target.value)
+            if key is not None:
+                old = state.get(key, _BOTTOM)
+                state[key] = old.join(info)
+            return
+        key = self._target_key(target)
+        if key is not None:
+            state[key] = info
+
+    # -- expressions -----------------------------------------------------
+    def expr_taint(self, expr: ast.AST, state: State) -> frozenset:
+        if isinstance(expr, ast.Name):
+            return state.get(expr.id, _BOTTOM).labels
+        if isinstance(expr, ast.Attribute):
+            dotted = dotted_name(expr, {})
+            if dotted and dotted in state:
+                return state[dotted].labels
+            return self.expr_taint(expr.value, state)
+        if isinstance(expr, ast.Call):
+            return self._call_taint(expr, state)
+        if isinstance(expr, ast.Subscript):
+            base = dotted_name(expr.value, self.aliases)
+            taint = self.expr_taint(expr.value, state) | self.expr_taint(
+                expr.slice, state
+            )
+            if base in self.registry.subscript_sources:
+                taint = taint | frozenset(
+                    [f"{base}[...] (line {expr.lineno})"]
+                )
+            return taint
+        if isinstance(expr, ast.IfExp):
+            # The chosen value depends on the test: a clean constant
+            # picked by a tainted condition is itself divergent.
+            return (self.expr_taint(expr.test, state)
+                    | self.expr_taint(expr.body, state)
+                    | self.expr_taint(expr.orelse, state))
+        if isinstance(expr, (ast.BoolOp,)):
+            out = frozenset()
+            for value in expr.values:
+                out |= self.expr_taint(value, state)
+            return out
+        if isinstance(expr, ast.BinOp):
+            return (self.expr_taint(expr.left, state)
+                    | self.expr_taint(expr.right, state))
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_taint(expr.operand, state)
+        if isinstance(expr, ast.Compare):
+            out = self.expr_taint(expr.left, state)
+            for comp in expr.comparators:
+                out |= self.expr_taint(comp, state)
+            return out
+        if isinstance(expr, (ast.JoinedStr, ast.Tuple, ast.List, ast.Set)):
+            out = frozenset()
+            for value in getattr(expr, "values", None) or getattr(
+                expr, "elts", ()
+            ):
+                out |= self.expr_taint(value, state)
+            return out
+        if isinstance(expr, ast.FormattedValue):
+            return self.expr_taint(expr.value, state)
+        if isinstance(expr, ast.Dict):
+            out = frozenset()
+            for key in expr.keys:
+                if key is not None:
+                    out |= self.expr_taint(key, state)
+            for value in expr.values:
+                out |= self.expr_taint(value, state)
+            return out
+        if isinstance(expr, ast.Starred):
+            return self.expr_taint(expr.value, state)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            out = frozenset()
+            for gen in expr.generators:
+                out |= self.expr_taint(gen.iter, state)
+            for field in ("elt", "key", "value"):
+                sub = getattr(expr, field, None)
+                if sub is not None:
+                    out |= self.expr_taint(sub, state)
+            return out
+        if isinstance(expr, ast.Await):
+            return self.expr_taint(expr.value, state)
+        return frozenset()
+
+    def _call_taint(self, call: ast.Call, state: State) -> frozenset:
+        dotted = dotted_name(call.func, self.aliases)
+        if self.registry.is_sanitizer(dotted):
+            # Sanitizer result is rank-coherent regardless of inputs —
+            # that is the sanitizer's whole contract.
+            return frozenset()
+        label = self.registry.source_label(dotted)
+        if label is not None:
+            return frozenset([f"{label} (line {call.lineno})"])
+        arg_taints = [self.expr_taint(a, state) for a in call.args]
+        kwarg_taints = {
+            kw.arg: self.expr_taint(kw.value, state)
+            for kw in call.keywords
+        }
+        if self.resolver is not None:
+            summary = self.resolver(dotted, call)
+            if summary is not None:
+                return summary.apply(arg_taints, kwarg_taints)
+        # Unknown callable: conservatively pass taint through from the
+        # receiver and every argument.
+        out = frozenset()
+        if isinstance(call.func, ast.Attribute):
+            out |= self.expr_taint(call.func.value, state)
+        for taint in arg_taints:
+            out |= taint
+        for taint in kwarg_taints.values():
+            out |= taint
+        return out

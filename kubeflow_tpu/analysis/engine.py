@@ -2,8 +2,10 @@
 
 The engine owns everything rule packs shouldn't: which files are
 scanned, how findings are suppressed, and how the result is rendered
-and gated. Rule packs stay pure functions from file content to
-findings.
+and gated (text, json, or SARIF for CI diff annotation). Rule packs
+stay pure functions from file content to findings — including the
+dataflow-backed SPMD and concurrency packs, whose CFG/taint machinery
+lives behind the same per-file interface.
 """
 
 from __future__ import annotations
@@ -12,7 +14,13 @@ import dataclasses
 import json
 import os
 
-from kubeflow_tpu.analysis import ast_rules, manifest_rules, mesh_rules
+from kubeflow_tpu.analysis import (
+    ast_rules,
+    concurrency_rules,
+    manifest_rules,
+    mesh_rules,
+    spmd_rules,
+)
 from kubeflow_tpu.analysis.findings import (
     Finding,
     Severity,
@@ -98,6 +106,10 @@ def analyze_paths(config: AnalysisConfig) -> list[Finding]:
         if path.endswith(".py"):
             file_findings += ast_rules.analyze_python_source(text, rel)
             file_findings += mesh_rules.analyze_python_mesh(text, rel)
+            file_findings += spmd_rules.analyze_python_spmd(text, rel)
+            file_findings += concurrency_rules.analyze_python_concurrency(
+                text, rel
+            )
         elif path.endswith((".yaml", ".yml")):
             # Kustomize reference checks resolve against the real
             # directory, so the manifest pack gets absolute paths and
@@ -154,6 +166,10 @@ def partition_baseline(
 def render_report(
     new: list[Finding], baselined: list[Finding], fmt: str = "text"
 ) -> str:
+    if fmt == "sarif":
+        from kubeflow_tpu.analysis.sarif import render_sarif
+
+        return render_sarif(new, baselined)
     if fmt == "json":
         return json.dumps(
             {

@@ -1,0 +1,72 @@
+"""SARIF 2.1.0 rendering for CI annotation.
+
+Minimal but schema-shaped: one run, one driver, a ``rules`` array
+derived from the findings present (so PR annotation tooling can show
+rule metadata), and one ``result`` per non-baselined finding. Severity
+maps ERROR→``error``, WARNING→``warning``, INFO→``note`` — the GitHub
+code-scanning upload treats ``error`` as gating, matching
+:func:`kubeflow_tpu.analysis.engine.gate_exit_code`.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+_LEVELS = {
+    Severity.ERROR: "error",
+    Severity.WARNING: "warning",
+    Severity.INFO: "note",
+}
+
+
+def _level(severity: Severity) -> str:
+    return _LEVELS.get(severity, "note")
+
+
+def sarif_document(new: list[Finding], baselined: list[Finding]) -> dict:
+    rules = sorted({f.rule for f in new})
+    results = []
+    for finding in new:
+        result = {
+            "ruleId": finding.rule,
+            "level": _level(finding.severity),
+            "message": {"text": finding.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path.replace("\\", "/"),
+                    },
+                    "region": {"startLine": max(1, finding.line)},
+                },
+            }],
+        }
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "kubeflow-tpu-analysis",
+                    "informationUri": (
+                        "https://github.com/kubeflow/kubeflow"
+                    ),
+                    "rules": [{"id": rule} for rule in rules],
+                },
+            },
+            "results": results,
+            "properties": {"baselinedFindings": len(baselined)},
+        }],
+    }
+
+
+def render_sarif(new: list[Finding], baselined: list[Finding]) -> str:
+    return json.dumps(sarif_document(new, baselined), indent=2)

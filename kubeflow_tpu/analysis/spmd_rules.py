@@ -1,0 +1,381 @@
+"""Pack A — SPMD coherence over the dataflow engine.
+
+Every rank of a multi-host slice must reach the same collectives in the
+same order. The three rules here catch the ways host-local state steers
+a rank off that path — mechanically, where PR 4's review cycle needed a
+human:
+
+- ``spmd-divergent-collective`` (error): a collective call site
+  (``broadcast_from_zero``, ``sync_global_devices``/barrier waits,
+  ``make_array_from_callback``) is control-dependent on a *tainted*
+  branch — one whose condition derives from rank/host-local values:
+  ``jax.process_index()``, wall clocks, ``os.environ`` reads,
+  signal/event flags (``.is_set()``), host RNG. Ranks can evaluate the
+  branch differently, so some arrive at the rendezvous and some never
+  do; the survivors hang until the coordination timeout. Loop guards
+  count (a tainted ``while`` condition runs different trip counts per
+  rank), as do tainted early exits (``if local: return`` upstream of a
+  collective). The fix is the platform idiom: agree first —
+  ``token = manager.broadcast_from_zero(tag, local_view)`` — and branch
+  on the agreed value; ``broadcast_from_zero`` is registered as the
+  sanitizer, so code that does this is clean by construction.
+- ``spmd-tainted-barrier-id`` (error): a rendezvous *identity* —
+  barrier tag/name, kv-store key — is built from tainted or
+  per-process-counter values. Write-once stores and barriers match
+  ranks by key; keys that differ per rank (timestamps, pids, a
+  ``self._seq += 1`` no peer agrees on) rendezvous nobody.
+- ``spmd-collective-in-except`` (error): a collective inside an
+  ``except`` handler. Exception delivery is host-local (one rank's
+  filesystem hiccup), so the handler is a branch only some ranks take —
+  with a collective inside, the non-raising ranks hang.
+
+Taint follows assignments, expressions, and one level of direct calls
+(:mod:`kubeflow_tpu.analysis.callgraph` summaries), so the PR 4 shape —
+``token = decide()`` where ``decide`` reads the wall clock — is caught
+across the helper boundary. Test trees (``tests/``, ``testing/``,
+``docs/``, ``conftest.py``, ``test_*``) are exempt: they seed
+divergence on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.callgraph import CallGraph
+from kubeflow_tpu.analysis.dataflow import (
+    CallPattern,
+    FunctionDataflow,
+    TaintRegistry,
+    dotted_name,
+    import_aliases,
+    is_test_path,
+)
+from kubeflow_tpu.analysis.findings import Finding, Severity
+
+# ---- taint sources ------------------------------------------------------
+
+SPMD_SOURCES = (
+    CallPattern(
+        "jax.process_index()",
+        exact=("jax.process_index",),
+        suffixes=(".process_index",),
+    ),
+    CallPattern(
+        "host wall clock",
+        exact=(
+            "time.time", "time.time_ns", "time.monotonic",
+            "time.monotonic_ns", "time.perf_counter",
+            "time.perf_counter_ns",
+            "datetime.datetime.now", "datetime.datetime.utcnow",
+            "datetime.now", "datetime.utcnow",
+        ),
+    ),
+    CallPattern(
+        "os.environ read",
+        exact=("os.getenv", "os.environ.get"),
+    ),
+    CallPattern(
+        "host-local RNG/identity",
+        exact=("os.getpid", "socket.gethostname", "uuid.uuid1",
+               "uuid.uuid4"),
+        prefixes=("random.", "np.random.", "numpy.random."),
+    ),
+    CallPattern(
+        "signal/event flag",
+        suffixes=(".is_set",),
+    ),
+)
+
+SPMD_SUBSCRIPT_SOURCES = ("os.environ",)
+
+SPMD_SANITIZERS = (
+    CallPattern(
+        "broadcast_from_zero",
+        exact=("broadcast_from_zero",),
+        suffixes=(".broadcast_from_zero",),
+    ),
+    CallPattern(
+        "broadcast_one_to_all",
+        exact=("broadcast_one_to_all",),
+        suffixes=(".broadcast_one_to_all",),
+    ),
+)
+
+# ---- sinks --------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSink:
+    """A call every rank must reach (rules 1 and 3)."""
+
+    pattern: CallPattern
+
+
+@dataclasses.dataclass(frozen=True)
+class IdentitySink:
+    """A call whose listed arguments are rendezvous identities
+    (rule 2). ``args=None`` means every argument is identity-bearing
+    (kv put/get: both key and, for puts, the agreed value)."""
+
+    pattern: CallPattern
+    args: tuple[int, ...] | None = (0,)
+    keywords: tuple[str, ...] = ()
+
+
+COLLECTIVE_SINKS = (
+    CollectiveSink(CallPattern(
+        "broadcast_from_zero",
+        exact=("broadcast_from_zero",),
+        suffixes=(".broadcast_from_zero", ".broadcast_one_to_all"),
+    )),
+    CollectiveSink(CallPattern(
+        "global barrier",
+        exact=("sync_global_devices",),
+        suffixes=(".sync_global_devices", ".wait_at_barrier"),
+    )),
+    CollectiveSink(CallPattern(
+        "global array assembly",
+        exact=("make_array_from_callback",),
+        suffixes=(".make_array_from_callback",),
+    )),
+    # Checkpoint saves are collective in this platform: every process
+    # writes its shards and rendezvouses at the commit barrier inside
+    # the manager, so the *call site* must be reached by all ranks.
+    CollectiveSink(CallPattern(
+        "collective checkpoint save",
+        exact=("manager.save",),
+        suffixes=(".save_async", "manager.save"),
+    )),
+)
+
+IDENTITY_SINKS = (
+    # NOTE: broadcast_one_to_all is deliberately NOT an identity sink:
+    # its first argument is the VALUE being agreed (jax's signature is
+    # value-first, tag-less) — broadcasting a host-local value is the
+    # sanctioned purpose of the call, not a divergence hazard.
+    IdentitySink(
+        CallPattern(
+            "barrier id",
+            exact=("broadcast_from_zero", "sync_global_devices"),
+            suffixes=(".broadcast_from_zero", ".sync_global_devices",
+                      ".wait_at_barrier"),
+        ),
+        args=(0,),
+    ),
+    IdentitySink(
+        CallPattern(
+            "kv-store key",
+            suffixes=(".key_value_set", ".key_value_get", ".kv_set",
+                      ".kv_get", ".key_value_try_get",
+                      ".key_value_delete"),
+        ),
+        args=(0,),
+    ),
+    IdentitySink(
+        CallPattern(
+            "sharding choice",
+            exact=("make_array_from_callback",),
+            suffixes=(".make_array_from_callback",),
+        ),
+        args=(1,),
+        keywords=("sharding",),
+    ),
+)
+
+def _per_process_counters(tree: ast.AST) -> dict[str, list[str]]:
+    """Attribute names that are only ever *stepped* (``self._seq += 1``
+    plus at most a numeric-constant init) — per-process sequence
+    counters. Their values drift across ranks the moment any rank skips
+    a step, which is the barrier-desync PR 4's review found. An
+    attribute also assigned from anything computed (a broadcast result,
+    an agreed step) is NOT a counter — the author keeps it coherent
+    some other way — and locals are excluded: a loop's ``step += 1`` is
+    driven by the (shared) step count, not by process-local event
+    order."""
+    stepped: set[str] = set()
+    assigned_computed: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Attribute
+        ):
+            key = dotted_name(node.target, {})
+            if key:
+                stepped.add(key)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            is_const_init = isinstance(value, ast.Constant) and \
+                isinstance(value.value, (int, float))
+            if is_const_init:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Attribute):
+                    key = dotted_name(target, {})
+                    if key:
+                        assigned_computed.add(key)
+    return {
+        key: [f"per-process counter {key}"]
+        for key in stepped - assigned_computed
+    }
+
+
+def build_registry(tree: ast.AST) -> TaintRegistry:
+    return TaintRegistry(
+        sources=SPMD_SOURCES,
+        subscript_sources=SPMD_SUBSCRIPT_SOURCES,
+        sanitizers=SPMD_SANITIZERS,
+        seed=_per_process_counters(tree),
+    )
+
+
+def _calls_in(node: ast.AST):
+    """Call nodes inside ``node``, not descending into nested function
+    or class definitions (they are analyzed as their own CFGs) — the
+    node itself included: a collective that is merely *defined* under a
+    guard is not called there."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    stack = list(ast.iter_child_nodes(node))
+    if isinstance(node, ast.Call):
+        yield node
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        if isinstance(child, ast.Call):
+            yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _source_desc(labels) -> str:
+    """Human form of a taint set, stripped of line anchors so baseline
+    keys survive unrelated edits."""
+    names = sorted({label.split(" (line")[0] for label in labels})
+    return ", ".join(names)
+
+
+class _FunctionScan:
+    def __init__(self, graph: CallGraph, registry: TaintRegistry,
+                 aliases: dict[str, str], path: str,
+                 out: list[Finding]) -> None:
+        self.graph = graph
+        self.registry = registry
+        self.aliases = aliases
+        self.path = path
+        self.out = out
+        self._seen: set[tuple[str, int]] = set()
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if (rule, line) in self._seen:
+            return
+        self._seen.add((rule, line))
+        self.out.append(
+            Finding(rule, Severity.ERROR, self.path, line, message)
+        )
+
+    def scan(self, body: list[ast.stmt], scope: tuple[str, ...],
+             cls: str | None, owner: str) -> None:
+        graph_cfg = cfg_mod.build_cfg(body)
+        flow = FunctionDataflow(
+            graph_cfg, self.registry, self.aliases,
+            resolver=self.graph.resolver(scope, cls),
+        )
+        for block, stmt, state in flow.iter_statement_states():
+            for call in _calls_in(stmt):
+                self._check_call(call, block, state, flow, owner)
+
+    def _check_call(self, call, block, state, flow, owner: str) -> None:
+        dotted = dotted_name(call.func, self.aliases)
+        if not dotted:
+            return
+        display = dotted.rsplit(".", 1)[-1]
+        for sink in COLLECTIVE_SINKS:
+            if not sink.pattern.matches(dotted):
+                continue
+            for guard in block.guards:
+                if guard.kind == "except":
+                    self._emit(
+                        "spmd-collective-in-except", call.lineno,
+                        f"collective {display}() inside an except "
+                        "handler: exception delivery is host-local, so "
+                        "only the raising rank takes this path and its "
+                        "peers hang at the rendezvous — hoist the "
+                        "collective out of the handler (or annotate a "
+                        "provably-global failure path with # analysis: "
+                        "allow[spmd-collective-in-except])",
+                    )
+                    continue
+                taint = flow.guard_taint(guard)
+                if taint:
+                    self._emit(
+                        "spmd-divergent-collective", call.lineno,
+                        f"collective {display}() in {owner} is "
+                        "control-dependent on a host-local value "
+                        f"({_source_desc(taint)}): ranks can take this "
+                        "branch differently and the rendezvous tears — "
+                        "agree first (token = broadcast_from_zero(tag, "
+                        "local_view)) and branch on the agreed value",
+                    )
+                    break
+            else:
+                continue
+            break
+        for sink in IDENTITY_SINKS:
+            if not sink.pattern.matches(dotted):
+                continue
+            tainted = frozenset()
+            if sink.args is None:
+                for arg in call.args:
+                    tainted |= flow.expr_taint(arg, state)
+            else:
+                for idx in sink.args:
+                    if idx < len(call.args):
+                        tainted |= flow.expr_taint(call.args[idx], state)
+            for kw in call.keywords:
+                if kw.arg in sink.keywords:
+                    tainted |= flow.expr_taint(kw.value, state)
+            if tainted:
+                self._emit(
+                    "spmd-tainted-barrier-id", call.lineno,
+                    f"{sink.pattern.label} passed to {display}() "
+                    "derives from a host-local value "
+                    f"({_source_desc(tainted)}): ranks rendezvous by "
+                    "key, and keys that differ per process match "
+                    "nobody — derive barrier ids and kv keys from "
+                    "globally agreed state (the step number, a "
+                    "broadcast value)",
+                )
+            break
+
+
+def analyze_python_spmd(source: str, path: str) -> list[Finding]:
+    """Pack A over one Python file."""
+    if is_test_path(path):
+        return []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []  # ast_rules already reports py-syntax
+    aliases = import_aliases(tree)
+    registry = build_registry(tree)
+    graph = CallGraph(tree, registry, aliases)
+    out: list[Finding] = []
+    scan = _FunctionScan(graph, registry, aliases, path, out)
+    # Module-level statements.
+    scan.scan(
+        [s for s in tree.body], scope=(), cls=None, owner="module scope"
+    )
+    for info in graph.functions.values():
+        scan.scan(
+            info.node.body,
+            scope=info.scope + (info.qualname,),
+            cls=info.cls,
+            owner=f"{info.qualname!r}",
+        )
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out

@@ -76,9 +76,11 @@ class WorkQueue:
         # histogram here); called OUTSIDE the queue lock.
         self.latency_observer = None
 
-    def _schedule(self, req: Request, not_before: float) -> None:
-        # Lock held. Keep the earliest scheduled time for duplicates:
-        # an item that is already due must never be pushed back.
+    def _schedule_locked(self, req: Request, not_before: float) -> None:
+        # Caller holds self._lock (the _locked contract the
+        # concurrency analysis pack enforces). Keep the earliest
+        # scheduled time for duplicates: an item that is already due
+        # must never be pushed back.
         cur = self._pending.get(req)
         if cur is None or not_before < cur:
             self._pending[req] = not_before
@@ -92,7 +94,7 @@ class WorkQueue:
 
     def add(self, req: Request, delay: float = 0.0) -> None:
         with self._lock:
-            self._schedule(req, time.monotonic() + delay)
+            self._schedule_locked(req, time.monotonic() + delay)
 
     def add_rate_limited(self, req: Request) -> None:
         with self._lock:
@@ -102,7 +104,7 @@ class WorkQueue:
             # Same earliest-wins rule as add(): a rate-limited re-add
             # races watch-driven adds, and pushing back an already-due
             # item would starve it behind every later arrival.
-            self._schedule(req, time.monotonic() + delay)
+            self._schedule_locked(req, time.monotonic() + delay)
 
     def forget(self, req: Request) -> None:
         with self._lock:

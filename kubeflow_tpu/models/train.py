@@ -326,6 +326,12 @@ def run_with_checkpointing(
                 preempted = True
                 break  # final sync save below covers the last step
             if cadence_due(token):
+                # With process_count > 1, `token` is the broadcast
+                # agreement from process 0 (sanitized in decide());
+                # the host-local view only survives when agree is
+                # False, i.e. single-process, where divergence is
+                # impossible.
+                # analysis: allow[spmd-divergent-collective]
                 manager.save_async(step, state)
                 report.saves += 1
                 last_saved = step
@@ -345,6 +351,10 @@ def run_with_checkpointing(
             # most the in-flight step is lost, not a whole cadence.
             report.preempted = True
             if step > 0 or report.resumed_from_step is not None:
+                # Multi-host, this path is only entered on the agreed
+                # "stop" token from process 0; the raw stop.is_set()
+                # arm is explicitly single-process (`not agree`).
+                # analysis: allow[spmd-divergent-collective]
                 manager.save(step, state)
                 report.saves += 1
         else:

@@ -1,13 +1,32 @@
 #!/usr/bin/env bash
 # Static-analysis gate for CI: fail the build on any new error-severity
 # finding (manifest/topology agreement, PodDefault conflicts, traced-code
-# and controller hazards). Pre-existing accepted findings live in
-# .analysis-baseline.json; intentional occurrences carry an inline
-# `# analysis: allow[rule-id]` pragma. The same gate runs inside tier-1
-# pytest as tests/test_analysis_self.py, so environments without CI
-# still enforce it.
+# and controller hazards, SPMD coherence, concurrency discipline).
+# Pre-existing accepted findings live in .analysis-baseline.json;
+# intentional occurrences carry an inline `# analysis: allow[rule-id]`
+# pragma. The same gate runs inside tier-1 pytest as
+# tests/test_analysis_self.py, so environments without CI still
+# enforce it.
+#
+# A SARIF 2.1.0 document is always written (even when the gate fails)
+# so CI can upload it for PR diff annotation:
+#   - path: ${ANALYSIS_SARIF:-analysis-results.sarif}
+#   - GitHub: upload with github/codeql-action/upload-sarif or attach
+#     as a build artifact.
 set -euo pipefail
 
 cd "$(dirname "$0")/../.."
 
-python -m kubeflow_tpu.analysis .
+SARIF_OUT="${ANALYSIS_SARIF:-analysis-results.sarif}"
+
+# One scan: text report for the build log, SARIF artifact on the side.
+rc=0
+rm -f "$SARIF_OUT"
+python -m kubeflow_tpu.analysis . --sarif-out "$SARIF_OUT" || rc=$?
+if [ -f "$SARIF_OUT" ]; then
+    echo "SARIF written to $SARIF_OUT"
+else
+    echo "no SARIF produced (analysis aborted before reporting)" >&2
+fi
+
+exit "$rc"

@@ -1,0 +1,15 @@
+"""Seeded: blocking call while holding the lock."""
+
+import threading
+import time
+
+
+class SlowSection:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._token = None
+
+    def refresh_token(self, fetch):
+        with self._lock:
+            time.sleep(0.5)  # every waiter now sleeps too
+            self._token = fetch()

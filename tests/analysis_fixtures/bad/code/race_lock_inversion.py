@@ -1,0 +1,19 @@
+"""Seeded: ABBA lock-order inversion."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._members = threading.Lock()
+        self._stats = threading.Lock()
+
+    def add_member(self, member):
+        with self._members:
+            with self._stats:
+                self.count = member
+
+    def rollup(self):
+        with self._stats:
+            with self._members:
+                self.count = 0

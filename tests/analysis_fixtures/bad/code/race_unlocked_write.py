@@ -1,0 +1,20 @@
+"""Seeded: attribute written under the lock in one method, bare in
+another — the torn-update window review keeps finding by hand."""
+
+import threading
+
+
+class StaleCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._version = 0
+
+    def refresh(self, entries):
+        with self._lock:
+            self._entries = dict(entries)
+            self._version += 1
+
+    def invalidate(self):
+        # No lock: a concurrent refresh() can lose this write.
+        self._version = 0

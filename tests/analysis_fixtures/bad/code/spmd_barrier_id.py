@@ -1,0 +1,22 @@
+"""Seeded: rendezvous identities derived from host-local values."""
+
+import time
+
+
+class SeqBarriers:
+    def __init__(self, client):
+        self.client = client
+        self._sync_seq = 0
+
+    def timestamp_key(self):
+        # Ranks rendezvous by key; a timestamp matches nobody else.
+        self.client.wait_at_barrier(
+            f"save-{time.time()}", timeout_in_ms=1000
+        )
+
+    def counter_key(self, value):
+        # Per-process counter: one skipped call desyncs every later id.
+        self._sync_seq += 1
+        self.client.key_value_set(
+            f"agree-{self._sync_seq}", value
+        )

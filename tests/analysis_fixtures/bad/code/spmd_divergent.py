@@ -1,0 +1,25 @@
+"""Seeded: collectives control-dependent on host-local values."""
+
+import time
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def clock_guarded_commit(last_save, cadence_s):
+    # Wall clocks skew across hosts: some ranks enter, some don't.
+    if time.monotonic() - last_save >= cadence_s:
+        multihost_utils.sync_global_devices("commit")
+
+
+def rank_guarded_broadcast(manager):
+    # Only process 0 reaches a collective every rank must join.
+    if jax.process_index() == 0:
+        manager.broadcast_from_zero("ready", "1")
+
+
+def early_return_divergence(manager, probe):
+    # Ranks whose local env differs return early and strand the rest.
+    if probe.environ_flag or jax.process_index() > 0:
+        return
+    multihost_utils.sync_global_devices("after-early-return")

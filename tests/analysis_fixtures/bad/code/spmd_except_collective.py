@@ -1,0 +1,17 @@
+"""Seeded: collective inside an except handler (host-local path)."""
+
+from jax.experimental import multihost_utils
+
+
+def abort_rendezvous(manager, step_dir):
+    try:
+        validate(step_dir)
+    except ValueError:
+        # Only the rank whose shard is torn raises; its peers never
+        # enter this handler and hang at the barrier.
+        multihost_utils.sync_global_devices("abort")
+
+
+def validate(step_dir):
+    if not step_dir:
+        raise ValueError("empty")

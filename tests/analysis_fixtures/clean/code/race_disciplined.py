@@ -1,0 +1,56 @@
+"""Clean counterparts for the concurrency pack: a single-threaded
+class (no lock — writes are nobody's business), a class whose every
+shared write is under its one lock, and the ``*_locked`` helper
+contract."""
+
+import threading
+
+
+class SingleThreaded:
+    """No lock attribute: assumed single-threaded, writes are free."""
+
+    def __init__(self):
+        self.cursor = 0
+        self.rows = []
+
+    def advance(self):
+        self.cursor += 1
+        self.rows.append(self.cursor)
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._transitions = 0
+
+    def transition(self, to):
+        with self._lock:
+            self._apply_locked(to)
+
+    def _apply_locked(self, to):
+        self._state = to
+        self._transitions += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._state, self._transitions
+
+
+class OrderedLocks:
+    """Always members → stats: no inversion."""
+
+    def __init__(self):
+        self._members = threading.Lock()
+        self._stats = threading.Lock()
+        self._count = 0
+
+    def add_member(self):
+        with self._members:
+            with self._stats:
+                self._count += 1
+
+    def rollup(self):
+        with self._members:
+            with self._stats:
+                self._count = 0

@@ -1,0 +1,47 @@
+"""Clean counterpart: the platform idiom. Host-local views exist, but
+every decision that steers a collective is agreed through
+``broadcast_from_zero`` first, and every rendezvous identity derives
+from globally shared state (the step number)."""
+
+import time
+
+from jax.experimental import multihost_utils
+
+
+def agreed_cadence_loop(manager, batches, step_fn, state, cadence_s):
+    last_save = time.monotonic()
+    step = 0
+    for batch in batches:
+        due = time.monotonic() - last_save >= cadence_s
+        token = manager.broadcast_from_zero(
+            f"cadence-{step}", "save" if due else "run"
+        )
+        if token == "save":
+            multihost_utils.sync_global_devices(f"commit-{step}")
+            last_save = time.monotonic()
+        state = step_fn(state, batch)
+        step += 1
+    return state
+
+
+def step_keyed_barrier(client, step, attempt):
+    client.wait_at_barrier(f"save-{step}.{attempt}", timeout_in_ms=1000)
+
+
+def hoisted_failure_rendezvous(manager, step_dir):
+    # Validation failures are made global before anyone rendezvouses:
+    # the outcome is agreed, then every rank takes the same branch.
+    try:
+        ok = "1"
+        validate(step_dir)
+    except ValueError:
+        ok = "0"
+    agreed = manager.broadcast_from_zero("validate", ok)
+    if agreed == "0":
+        raise RuntimeError("validation failed on some rank")
+    multihost_utils.sync_global_devices("validated")
+
+
+def validate(step_dir):
+    if not step_dir:
+        raise ValueError("empty")

@@ -1,0 +1,795 @@
+"""Dataflow engine + SPMD/concurrency pack tests: CFG construction,
+taint propagation through assignments/calls/sanitizers, one-level call
+summaries, both packs end-to-end on the fixture trees, the PR 4
+train-loop regression shape, and SARIF output."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.analysis import AnalysisConfig, Severity, analyze_paths
+from kubeflow_tpu.analysis.callgraph import (
+    CallGraph,
+    reachable_from,
+    thread_entry_names,
+)
+from kubeflow_tpu.analysis.cfg import build_cfg
+from kubeflow_tpu.analysis.concurrency_rules import (
+    analyze_python_concurrency,
+)
+from kubeflow_tpu.analysis.dataflow import (
+    CallPattern,
+    FunctionDataflow,
+    TaintRegistry,
+)
+from kubeflow_tpu.analysis.sarif import sarif_document
+from kubeflow_tpu.analysis.spmd_rules import (
+    analyze_python_spmd,
+    build_registry,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fn_cfg(source, name=None):
+    tree = ast.parse(source)
+    fns = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+        and (name is None or n.name == name)
+    ]
+    return fns[0], build_cfg(fns[0].body)
+
+
+def _flow(source, registry=None, name=None):
+    fn, cfg = _fn_cfg(source, name)
+    tree = ast.parse(source)
+    registry = registry or build_registry(tree)
+    aliases = {}
+    return cfg, FunctionDataflow(cfg, registry, aliases)
+
+
+class TestCfgConstruction:
+    def test_linear_body_is_one_block(self):
+        _, cfg = _fn_cfg("def f():\n    a = 1\n    b = a\n    return b\n")
+        entry = cfg.entry
+        assert len(entry.stmts) == 3
+        assert entry.terminated  # ends in return
+        assert entry.guards == ()
+
+    def test_if_creates_guarded_branch_and_join(self):
+        src = (
+            "def f(x):\n"
+            "    if x:\n"
+            "        a = 1\n"
+            "    b = 2\n"
+        )
+        _, cfg = _fn_cfg(src)
+        guarded = [b for b in cfg.blocks if b.guards]
+        assert len(guarded) == 1
+        (body,) = guarded
+        assert body.guards[0].kind == "if"
+        assert not body.guards[0].negated
+        # Join block (holding b = 2) is reachable from both the entry
+        # (test false) and the then-branch.
+        join = [
+            b for b in cfg.blocks
+            if any(isinstance(s, ast.Assign) and s.targets[0].id == "b"
+                   for s in b.stmts
+                   if isinstance(s, ast.Assign)
+                   and isinstance(s.targets[0], ast.Name))
+        ][0]
+        assert len(join.preds) == 2
+
+    def test_else_branch_guard_is_negated(self):
+        src = (
+            "def f(x):\n"
+            "    if x:\n"
+            "        a = 1\n"
+            "    else:\n"
+            "        a = 2\n"
+        )
+        _, cfg = _fn_cfg(src)
+        negs = [
+            b.guards[0].negated for b in cfg.blocks if b.guards
+        ]
+        assert sorted(negs) == [False, True]
+
+    def test_early_exit_negates_guard_for_the_rest(self):
+        src = (
+            "def f(x):\n"
+            "    if x:\n"
+            "        return 1\n"
+            "    tail = 2\n"
+        )
+        _, cfg = _fn_cfg(src)
+        tail = [
+            b for b in cfg.blocks
+            if any(isinstance(s, ast.Assign) for s in b.stmts)
+        ][0]
+        assert len(tail.guards) == 1
+        assert tail.guards[0].kind == "if"
+        assert tail.guards[0].negated
+
+    def test_early_exit_with_else_still_guards_the_rest(self):
+        # An else clause doesn't change the story: falling through an
+        # exiting then-branch still implies the test was false.
+        src = (
+            "def f(x):\n"
+            "    if x:\n"
+            "        return 1\n"
+            "    else:\n"
+            "        y = 2\n"
+            "    tail = 3\n"
+        )
+        _, cfg = _fn_cfg(src)
+        tail = [
+            b for b in cfg.blocks
+            if any(isinstance(s, ast.Assign)
+                   and isinstance(s.targets[0], ast.Name)
+                   and s.targets[0].id == "tail" for s in b.stmts)
+        ][0]
+        assert [(g.kind, g.negated) for g in tail.guards] == \
+            [("if", True)]
+
+    def test_exiting_else_guards_the_rest_with_the_test(self):
+        src = (
+            "def f(x):\n"
+            "    if x:\n"
+            "        y = 1\n"
+            "    else:\n"
+            "        return 0\n"
+            "    tail = 3\n"
+        )
+        _, cfg = _fn_cfg(src)
+        tail = [
+            b for b in cfg.blocks
+            if any(isinstance(s, ast.Assign)
+                   and isinstance(s.targets[0], ast.Name)
+                   and s.targets[0].id == "tail" for s in b.stmts)
+        ][0]
+        assert [(g.kind, g.negated) for g in tail.guards] == \
+            [("if", False)]
+
+    def test_while_has_back_edge_and_body_guard(self):
+        src = (
+            "def f(x):\n"
+            "    while x:\n"
+            "        x = step(x)\n"
+            "    return x\n"
+        )
+        _, cfg = _fn_cfg(src)
+        body = [b for b in cfg.blocks if b.guards][0]
+        assert body.guards[0].kind == "while"
+        # Back edge: the body's successor list includes a block that is
+        # also one of its predecessors' ancestors (the header).
+        header = cfg.blocks[body.preds[0]]
+        assert body.succs == [header.id]
+
+    def test_for_body_guard_carries_the_iterable(self):
+        src = (
+            "def f(items):\n"
+            "    for item in items:\n"
+            "        use(item)\n"
+        )
+        _, cfg = _fn_cfg(src)
+        body = [b for b in cfg.blocks if b.guards][0]
+        assert body.guards[0].kind == "for"
+        assert isinstance(body.guards[0].test, ast.Name)
+
+    def test_except_handler_guard(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        risky()\n"
+            "    except ValueError:\n"
+            "        cleanup()\n"
+        )
+        _, cfg = _fn_cfg(src)
+        handler = [b for b in cfg.blocks if b.guards][0]
+        assert handler.guards[0].kind == "except"
+        assert handler.guards[0].test is None
+
+    def test_nested_guards_stack(self):
+        src = (
+            "def f(a, b):\n"
+            "    if a:\n"
+            "        while b:\n"
+            "            body()\n"
+        )
+        _, cfg = _fn_cfg(src)
+        deepest = max(cfg.blocks, key=lambda blk: len(blk.guards))
+        assert [g.kind for g in deepest.guards] == ["if", "while"]
+
+
+_REG = TaintRegistry(
+    sources=(
+        CallPattern("clock", exact=("time.monotonic", "time.time")),
+        CallPattern("rank", exact=("jax.process_index",)),
+    ),
+    subscript_sources=("os.environ",),
+    sanitizers=(
+        CallPattern("bcast", suffixes=(".broadcast_from_zero",)),
+    ),
+)
+
+
+class TestTaintPropagation:
+    def test_assignment_chain(self):
+        src = (
+            "def f():\n"
+            "    t = time.monotonic()\n"
+            "    u = t\n"
+            "    v = u + 1\n"
+            "    return v\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_untainted_stays_clean(self):
+        src = "def f(x):\n    y = x + 1\n    return y\n"
+        _, flow = _flow(src, _REG)
+        assert flow.return_taint == frozenset()
+
+    def test_join_unions_branches(self):
+        src = (
+            "def f(c):\n"
+            "    if c:\n"
+            "        v = time.monotonic()\n"
+            "    else:\n"
+            "        v = 0\n"
+            "    return v\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_sanitizer_clears_taint(self):
+        src = (
+            "def f(manager):\n"
+            "    v = time.monotonic()\n"
+            "    v = manager.broadcast_from_zero('t', v)\n"
+            "    return v\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert flow.return_taint == frozenset()
+
+    def test_partial_sanitization_survives_join(self):
+        # One path sanitizes, the other doesn't: the merge is tainted.
+        src = (
+            "def f(manager, agree):\n"
+            "    v = time.monotonic()\n"
+            "    if agree:\n"
+            "        v = manager.broadcast_from_zero('t', v)\n"
+            "    return v\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_ifexp_test_taints_the_value(self):
+        src = (
+            "def f(stop):\n"
+            "    token = 'stop' if time.monotonic() > 5 else 'run'\n"
+            "    return token\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_fstring_carries_taint(self):
+        src = (
+            "def f():\n"
+            "    r = jax.process_index()\n"
+            "    return f'rank-{r}'\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("rank" in label for label in flow.return_taint)
+
+    def test_environ_subscript_is_a_source(self):
+        src = (
+            "import os\n"
+            "def f():\n"
+            "    return os.environ['NODE_NAME']\n"
+        )
+        tree = ast.parse(src)
+        fn = [n for n in tree.body if isinstance(n, ast.FunctionDef)][0]
+        flow = FunctionDataflow(build_cfg(fn.body), _REG, {"os": "os"})
+        assert any("os.environ" in label for label in flow.return_taint)
+
+    def test_loop_fixpoint_propagates_taint(self):
+        # Taint introduced in iteration N reaches uses in iteration N+1
+        # via the back edge.
+        src = (
+            "def f(items):\n"
+            "    last = 0\n"
+            "    out = []\n"
+            "    for item in items:\n"
+            "        out.append(last)\n"
+            "        last = time.monotonic()\n"
+            "    return last\n"
+        )
+        _, flow = _flow(src, _REG)
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_reaching_definitions_tracked(self):
+        src = (
+            "def f(c):\n"
+            "    v = 1\n"
+            "    if c:\n"
+            "        v = 2\n"
+            "    return v\n"
+        )
+        cfg, flow = _flow(src, _REG)
+        # At the return, both definitions of v reach.
+        for block, stmt, state in flow.iter_statement_states():
+            if isinstance(stmt, ast.Return):
+                assert flow.var_info(state, "v").def_lines == \
+                    frozenset({2, 4})
+                break
+        else:
+            pytest.fail("no return statement seen")
+
+    def test_guard_taint_evaluated_at_branch_point(self):
+        src = (
+            "def f(manager):\n"
+            "    due = time.monotonic() > 5\n"
+            "    if due:\n"
+            "        act()\n"
+        )
+        cfg, flow = _flow(src, _REG)
+        body = [b for b in cfg.blocks if b.guards][0]
+        assert flow.guard_taint(body.guards[0])
+
+    def test_seeded_counter_attribute_taints(self):
+        src = (
+            "class C:\n"
+            "    def bump(self):\n"
+            "        self._seq += 1\n"
+            "        return f'k-{self._seq}'\n"
+        )
+        tree = ast.parse(src)
+        registry = build_registry(tree)
+        fn = tree.body[0].body[0]
+        flow = FunctionDataflow(build_cfg(fn.body), registry, {})
+        assert any("per-process counter" in label
+                   for label in flow.return_taint)
+
+
+class TestCallSummaries:
+    def test_summary_base_taint_flows_to_caller(self):
+        src = (
+            "def decide():\n"
+            "    return 'stop' if time.monotonic() > 5 else 'run'\n"
+            "def loop(manager):\n"
+            "    token = decide()\n"
+            "    return token\n"
+        )
+        tree = ast.parse(src)
+        graph = CallGraph(tree, _REG, {})
+        fn = [n for n in tree.body if n.name == "loop"][0]
+        flow = FunctionDataflow(
+            build_cfg(fn.body), _REG, {},
+            resolver=graph.resolver(("loop",), None),
+        )
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_summary_param_dependency(self):
+        src = (
+            "def ident(x):\n"
+            "    return x\n"
+        )
+        tree = ast.parse(src)
+        graph = CallGraph(tree, _REG, {})
+        summary = graph.functions["ident"].summary
+        assert summary.base == frozenset()
+        assert summary.deps == frozenset({"x"})
+        assert summary.apply([frozenset({"t"})], {}) == frozenset({"t"})
+
+    def test_sanitizing_helper_summary_is_clean(self):
+        src = (
+            "def agree(manager, v):\n"
+            "    return manager.broadcast_from_zero('t', v)\n"
+        )
+        tree = ast.parse(src)
+        graph = CallGraph(tree, _REG, {})
+        summary = graph.functions["agree"].summary
+        assert summary.base == frozenset()
+        assert summary.deps == frozenset()
+
+    def test_nested_function_resolution(self):
+        src = (
+            "def outer(manager):\n"
+            "    def helper():\n"
+            "        return time.monotonic()\n"
+            "    v = helper()\n"
+            "    return v\n"
+        )
+        tree = ast.parse(src)
+        graph = CallGraph(tree, _REG, {})
+        assert "outer.helper" in graph.functions
+        fn = tree.body[0]
+        flow = FunctionDataflow(
+            build_cfg(fn.body), _REG, {},
+            resolver=graph.resolver(("outer",), None),
+        )
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_method_resolution_via_self(self):
+        src = (
+            "class M:\n"
+            "    def local_view(self):\n"
+            "        return time.monotonic()\n"
+            "    def act(self):\n"
+            "        return self.local_view()\n"
+        )
+        tree = ast.parse(src)
+        graph = CallGraph(tree, _REG, {})
+        info = graph.functions["M.act"]
+        flow = FunctionDataflow(
+            build_cfg(info.node.body), _REG, {},
+            resolver=graph.resolver(
+                info.scope + (info.qualname,), info.cls
+            ),
+        )
+        assert any("clock" in label for label in flow.return_taint)
+
+    def test_thread_entry_names_and_reachability(self):
+        src = (
+            "import threading\n"
+            "def loop():\n"
+            "    tick()\n"
+            "def tick():\n"
+            "    pass\n"
+            "def start():\n"
+            "    threading.Thread(target=loop).start()\n"
+        )
+        tree = ast.parse(src)
+        aliases = {"threading": "threading"}
+        roots = thread_entry_names(tree, aliases)
+        assert "loop" in roots
+        graph = CallGraph(tree, _REG, aliases)
+        reach = reachable_from(graph, roots)
+        assert {"loop", "tick"} <= reach
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+@pytest.fixture(scope="module")
+def bad_findings():
+    return analyze_paths(AnalysisConfig(paths=[BAD], check_emitted=False))
+
+
+class TestSpmdPackOnFixtures:
+    def test_divergent_collective_three_seeds(self, bad_findings):
+        found = _by_rule(bad_findings, "spmd-divergent-collective")
+        assert [
+            (f.path, f.line) for f in found
+        ] == [
+            ("code/spmd_divergent.py", 12),
+            ("code/spmd_divergent.py", 18),
+            ("code/spmd_divergent.py", 25),
+        ]
+        assert all(f.severity == Severity.ERROR for f in found)
+        messages = " | ".join(f.message for f in found)
+        assert "host wall clock" in messages
+        assert "jax.process_index()" in messages
+
+    def test_tainted_barrier_id_two_seeds(self, bad_findings):
+        found = _by_rule(bad_findings, "spmd-tainted-barrier-id")
+        assert [(f.path, f.line) for f in found] == [
+            ("code/spmd_barrier_id.py", 13),
+            ("code/spmd_barrier_id.py", 20),
+        ]
+        messages = " | ".join(f.message for f in found)
+        assert "host wall clock" in messages
+        assert "per-process counter self._sync_seq" in messages
+
+    def test_collective_in_except_seed(self, bad_findings):
+        (f,) = _by_rule(bad_findings, "spmd-collective-in-except")
+        assert f.path == "code/spmd_except_collective.py"
+        assert f.severity == Severity.ERROR
+        assert "except handler" in f.message
+
+    def test_pragma_suppresses_spmd_finding(self, tmp_path):
+        src = (
+            "import time\n"
+            "from jax.experimental import multihost_utils\n"
+            "def f(last):\n"
+            "    if time.monotonic() - last > 5:\n"
+            "        # analysis: allow[spmd-divergent-collective]\n"
+            "        multihost_utils.sync_global_devices('x')\n"
+        )
+        target = tmp_path / "mod.py"
+        target.write_text(src)
+        found = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert _by_rule(found, "spmd-divergent-collective") == []
+
+    def test_tainted_early_exit_with_else_fires(self):
+        # The PR 4 shape with an else clause on the early exit — the
+        # collective after the If is still control-dependent on the
+        # host-local test.
+        src = (
+            "from jax.experimental import multihost_utils\n"
+            "def run(stop, state, manager):\n"
+            "    if stop.is_set():\n"
+            "        return state\n"
+            "    else:\n"
+            "        state = state + 1\n"
+            "    manager.save(0, state)\n"
+        )
+        found = analyze_python_spmd(src, "kubeflow_tpu/m.py")
+        assert [f.rule for f in found] == ["spmd-divergent-collective"]
+
+    def test_collective_defined_under_guard_is_not_a_call(self):
+        # A function body merely *defined* under a tainted branch (or
+        # an except handler) runs later, under its own guards — the
+        # definition site must not fire.
+        src = (
+            "from jax.experimental import multihost_utils\n"
+            "def setup(stop):\n"
+            "    if stop.is_set():\n"
+            "        def cb():\n"
+            "            multihost_utils.sync_global_devices('t')\n"
+            "        return cb\n"
+            "try:\n"
+            "    import fastpath\n"
+            "except ImportError:\n"
+            "    def shim(mgr):\n"
+            "        mgr.broadcast_from_zero('v', '1')\n"
+        )
+        assert analyze_python_spmd(src, "kubeflow_tpu/x.py") == []
+
+    def test_broadcast_assigned_attribute_is_not_a_counter(self):
+        # `self.step` is agreed via broadcast in one method; stepping
+        # it in lockstep elsewhere must not seed it as a per-process
+        # counter (only stepped-with-constant-init attributes are).
+        src = (
+            "class M:\n"
+            "    def sync(self, mgr):\n"
+            "        self.step = int(mgr.broadcast_from_zero('s', '0'))\n"
+            "    def tick(self):\n"
+            "        self.step += 1\n"
+            "    def put(self, client, v):\n"
+            "        client.key_value_set(f'ckpt-{self.step}', v)\n"
+        )
+        assert analyze_python_spmd(src, "kubeflow_tpu/y.py") == []
+
+    def test_test_trees_are_exempt(self):
+        src = (
+            "import time\n"
+            "from jax.experimental import multihost_utils\n"
+            "def f(last):\n"
+            "    if time.monotonic() - last > 5:\n"
+            "        multihost_utils.sync_global_devices('x')\n"
+        )
+        assert analyze_python_spmd(src, "tests/helper.py") == []
+        assert analyze_python_spmd(src, "kubeflow_tpu/x.py") != []
+
+
+class TestConcurrencyPackOnFixtures:
+    def test_unlocked_shared_write_seed(self, bad_findings):
+        (f,) = _by_rule(bad_findings, "conc-unlocked-shared-write")
+        assert (f.path, f.line) == ("code/race_unlocked_write.py", 20)
+        assert f.severity == Severity.ERROR
+        assert "StaleCache._version" in f.message
+
+    def test_lock_inversion_seed(self, bad_findings):
+        (f,) = _by_rule(bad_findings, "conc-lock-order-inversion")
+        assert f.path == "code/race_lock_inversion.py"
+        assert f.severity == Severity.ERROR
+        assert "TwoLocks" in f.message
+
+    def test_blocking_under_lock_seed(self, bad_findings):
+        (f,) = _by_rule(bad_findings, "conc-blocking-under-lock")
+        assert (f.path, f.line) == ("code/race_blocking_lock.py", 14)
+        assert f.severity == Severity.WARNING
+        assert "time.sleep" in f.message
+
+    def test_locked_suffix_contract(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._bump_locked()\n"
+            "    def _bump_locked(self):\n"
+            "        self._n += 1\n"
+        )
+        assert analyze_python_concurrency(src, "kubeflow_tpu/c.py") == []
+
+    def test_blocking_call_in_with_header_warns(self):
+        # `with self._lock, requests.get(...):` — the second context
+        # expression evaluates with the lock already held.
+        src = (
+            "import threading\n"
+            "import requests\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._v = 0\n"
+            "    def fetch(self, url):\n"
+            "        with self._lock, requests.get(url) as resp:\n"
+            "            self._v = resp\n"
+        )
+        found = [
+            f for f in analyze_python_concurrency(src, "kubeflow_tpu/c.py")
+            if f.rule == "conc-blocking-under-lock"
+        ]
+        assert len(found) == 1
+
+    def test_http_without_timeout_under_lock_warns(self):
+        src = (
+            "import threading\n"
+            "import urllib.request\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._v = None\n"
+            "    def fetch(self, url):\n"
+            "        with self._lock:\n"
+            "            self._v = urllib.request.urlopen(url)\n"
+            "    def fetch_timed(self, url):\n"
+            "        with self._lock:\n"
+            "            self._v = urllib.request.urlopen(url, timeout=5)\n"
+        )
+        found = [
+            f for f in analyze_python_concurrency(src, "kubeflow_tpu/c.py")
+            if f.rule == "conc-blocking-under-lock"
+        ]
+        assert len(found) == 1
+        assert found[0].line == 9
+
+    def test_clean_counterparts_silent(self):
+        findings = analyze_paths(
+            AnalysisConfig(paths=[CLEAN], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule.startswith(("spmd-", "conc-"))] == []
+
+    def test_each_seed_reported_exactly_once(self, bad_findings):
+        keys = [
+            (f.rule, f.path, f.line) for f in bad_findings
+            if f.rule.startswith(("spmd-", "conc-"))
+        ]
+        assert len(keys) == len(set(keys)) == 9
+
+
+# The PR 4 bug, reduced: a save decision taken from the host-local wall
+# clock and SIGTERM flag, reaching the collective save (and its commit
+# barrier) without broadcast agreement. The fixed shape routes the
+# token through broadcast_from_zero — the registered sanitizer.
+_TRAINLOOP_BUGGY = '''
+import time
+
+def run(step_fn, state, batches, manager, save_every_s, stop):
+    last_save = time.monotonic()
+    step = 0
+    for batch in batches:
+        if stop.is_set():
+            break
+        if time.monotonic() - last_save >= save_every_s:
+            manager.save_async(step, state)
+            last_save = time.monotonic()
+        state = step_fn(state, batch)
+        step += 1
+    manager.save(step, state)
+    return state
+'''
+
+_TRAINLOOP_FIXED = '''
+import time
+
+def run(step_fn, state, batches, manager, save_every_s, stop):
+    last_save = time.monotonic()
+    step = 0
+    for batch in batches:
+        due = time.monotonic() - last_save >= save_every_s
+        local = "stop" if stop.is_set() else ("save" if due else "run")
+        token = manager.broadcast_from_zero(f"cadence-{step}", local)
+        if token == "stop":
+            break
+        if token == "save":
+            manager.save_async(step, state)
+            last_save = time.monotonic()
+        state = step_fn(state, batch)
+        step += 1
+    manager.save(step, state)
+    return state
+'''
+
+
+class TestTrainLoopRegression:
+    """Acceptance: the PR 4 bug shape is demonstrably caught, and the
+    shipped (agreed-token) shape is demonstrably clean."""
+
+    def test_wall_clock_guarded_save_fires(self):
+        found = analyze_python_spmd(
+            _TRAINLOOP_BUGGY, "kubeflow_tpu/models/train_copy.py"
+        )
+        divergent = [
+            f for f in found if f.rule == "spmd-divergent-collective"
+        ]
+        # The cadence save (wall clock) AND the final save downstream
+        # of the SIGTERM-guarded break both fire.
+        assert len(divergent) >= 1
+        messages = " | ".join(f.message for f in divergent)
+        assert "host wall clock" in messages
+        assert any("save_async" in f.message for f in divergent)
+
+    def test_agreed_token_shape_is_clean(self):
+        found = analyze_python_spmd(
+            _TRAINLOOP_FIXED, "kubeflow_tpu/models/train_copy.py"
+        )
+        assert [f for f in found
+                if f.rule == "spmd-divergent-collective"] == []
+
+
+class TestSarifOutput:
+    def test_document_shape(self, bad_findings):
+        new = [f for f in bad_findings
+               if f.rule.startswith(("spmd-", "conc-"))]
+        doc = sarif_document(new, [])
+        assert doc["version"] == "2.1.0"
+        (run,) = doc["runs"]
+        rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert "spmd-divergent-collective" in rules
+        assert len(run["results"]) == len(new)
+        result = run["results"][0]
+        assert result["ruleId"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_level_mapping(self, bad_findings):
+        new = [f for f in bad_findings
+               if f.rule.startswith(("spmd-", "conc-"))]
+        doc = sarif_document(new, [])
+        levels = {
+            r["ruleId"]: r["level"] for r in doc["runs"][0]["results"]
+        }
+        assert levels["spmd-divergent-collective"] == "error"
+        assert levels["conc-blocking-under-lock"] == "warning"
+
+    def test_cli_sarif_format(self, tmp_path):
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", BAD,
+             "--no-emitted", "--baseline", str(empty),
+             "--format", "sarif"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 1  # errors still gate
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["results"]
+        assert doc["runs"][0]["properties"]["baselinedFindings"] == 0
+
+    def test_cli_sarif_out_rides_along_with_text(self, tmp_path):
+        # The CI gate's shape: one scan, text on stdout, SARIF to a
+        # file on the side.
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        sarif_path = tmp_path / "out.sarif"
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis", BAD,
+             "--no-emitted", "--baseline", str(empty),
+             "--sarif-out", str(sarif_path)],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 1
+        assert "error(s)" in proc.stdout  # text report on stdout
+        doc = json.loads(sarif_path.read_text())
+        assert doc["runs"][0]["results"]

@@ -19,6 +19,24 @@ def test_repo_has_no_new_error_findings():
     assert errors == [], "\n".join(f.render() for f in errors)
 
 
+def test_repo_package_is_clean_under_spmd_and_concurrency_packs():
+    """The flagship dataflow packs report NOTHING on kubeflow_tpu/ —
+    not even baselined findings: every hit was either fixed (lock-scope
+    corrections, the _locked helper contract) or carries an inline
+    pragma whose comment justifies why the path is coherent (train.py's
+    agreed-token saves). Catching the next PR 4-shaped bug depends on
+    this staying at zero, so no baseline budget is allowed to absorb
+    one."""
+    findings = analyze_paths(AnalysisConfig(
+        paths=[os.path.join(REPO, "kubeflow_tpu")], check_emitted=False,
+    ))
+    noisy = [
+        f for f in findings
+        if f.rule.startswith(("spmd-", "conc-"))
+    ]
+    assert noisy == [], "\n".join(f.render() for f in noisy)
+
+
 def test_repo_package_has_no_silent_broad_excepts():
     """The satellite audit holds: inside kubeflow_tpu/ every broad
     except either logs, re-raises, was narrowed, or carries an explicit
