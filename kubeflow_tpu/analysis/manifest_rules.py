@@ -394,4 +394,54 @@ def emitted_state_findings() -> list[Finding]:
             dataclasses.replace(f, rule="emitted-tpu-topology")
             for f in findings
         )
+    out.extend(_emitted_inference_findings())
+    return out
+
+
+def _emitted_inference_findings() -> list[Finding]:
+    """Same topology agreement over the InferenceService controller's
+    emitted StatefulSets (PR 6) — pure-Python desired state, so no
+    native gate; any import failure is a real finding."""
+    from kubeflow_tpu.controllers.inference import (
+        INFERENCE_API,
+        make_inference_controller,
+    )
+    from kubeflow_tpu.k8s.fake import FakeApiServer
+
+    out: list[Finding] = []
+    for shorthand in EMITTED_PRESETS:
+        tpu_slice = TpuSlice.from_shorthand(shorthand)
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": INFERENCE_API,
+            "kind": "InferenceService",
+            "metadata": {"name": "probe", "namespace": "analysis"},
+            "spec": {
+                "modelDir": "/ckpts",
+                "tpu": {
+                    "accelerator": tpu_slice.accelerator.name,
+                    "topology": tpu_slice.topology,
+                },
+            },
+        })
+        pseudo_path = f"<emitted:inference-controller {shorthand}>"
+        try:
+            make_inference_controller(api).run_once()
+            sts = api.get("apps/v1", "StatefulSet", "probe", "analysis")
+        # analysis: allow[py-broad-except] — converted into an error finding
+        except Exception as exc:
+            out.append(Finding(
+                "emitted-tpu-topology", Severity.ERROR, pseudo_path, 0,
+                f"controller failed to emit a StatefulSet: {exc}",
+            ))
+            continue
+        findings = check_tpu_pod_template(
+            (sts.get("spec") or {}).get("template") or {},
+            (sts.get("spec") or {}).get("replicas"),
+            "StatefulSet", pseudo_path, 0,
+        )
+        out.extend(
+            dataclasses.replace(f, rule="emitted-tpu-topology")
+            for f in findings
+        )
     return out

@@ -1,0 +1,390 @@
+"""InferenceService controller: CR → StatefulSet + Services + status.
+
+The control plane of the serving stack (kubeflow_tpu/serving/ is the
+data plane): an ``InferenceService`` CR names a model directory and a
+TPU slice; the reconciler emits the same multi-host StatefulSet shape
+the notebook controller emits — TPU topology node selectors and
+per-host chip limits from :mod:`kubeflow_tpu.topology`, jax.distributed
+env, headless per-replica DNS, ``Parallel`` pod management — plus a
+ClusterIP Service fronting the gateway port, and mirrors
+``status.phase`` / ``status.readyReplicas`` / ``status.endpoint`` onto
+the CR. Observed-mesh preemption recovery is the shared state machine
+(:mod:`controllers.slice_recovery`): a partially preempted slice is
+restarted all-or-nothing and surfaces as ``phase=Restarting``.
+
+Desired-state generation is Python (unlike the notebook controller's
+native core): the serving controller is new platform surface, not a
+reference-parity port, and keeping it here keeps the CRD iterable.
+The serving env itself (model dir, max batch, gateway port) is NOT
+stamped by the controller — the admission webhook's
+``inference_env_poddefault`` injects it namespace-wide, alongside the
+checkpoint vars, so per-namespace defaults stay in one place and the
+controller's template cannot conflict with them.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Request,
+    WatchSpec,
+    ensure_object,
+    record_event,
+)
+from kubeflow_tpu.controllers.slice_recovery import (
+    SliceAnnotations,
+    recover_slice,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+from kubeflow_tpu.topology import TopologyError, TpuSlice
+
+log = logging.getLogger(__name__)
+
+INFERENCE_API = "serving.kubeflow.org/v1alpha1"
+
+# Preemption-recovery bookkeeping, the inference CRD's namespace of the
+# notebook controller's annotations (slice_recovery.py holds the state
+# machine).
+OBSERVED_MESH_KEY = "inference.kubeflow-tpu.org/observed-mesh"
+RESTART_REASON_KEY = "inference.kubeflow-tpu.org/restart-reason"
+PREEMPTION_RESTARTS_KEY = "inference.kubeflow-tpu.org/preemption-restarts"
+
+DEFAULT_GATEWAY_PORT = 8800
+DEFAULT_IMAGE = "kubeflow-tpu/inference-gateway:latest"
+POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+COORDINATOR_PORT = 8476  # native/src/notebook.cpp kCoordinatorPort
+
+
+def gateway_port(svc: dict) -> int:
+    return int((svc.get("spec") or {}).get("port")
+               or DEFAULT_GATEWAY_PORT)
+
+
+def _slice_for(svc: dict) -> TpuSlice | None:
+    tpu = (svc.get("spec") or {}).get("tpu") or {}
+    if not tpu.get("accelerator"):
+        return None
+    return TpuSlice.parse(tpu["accelerator"], tpu.get("topology", "1x1"))
+
+
+def _owner_ref(svc: dict) -> dict:
+    meta = svc.get("metadata") or {}
+    return {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "name": meta.get("name", ""),
+        "uid": meta.get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _meta(name: str, svc: dict) -> dict:
+    return {
+        "name": name,
+        "namespace": svc["metadata"]["namespace"],
+        "labels": {"inferenceservice-name": svc["metadata"]["name"]},
+        "ownerReferences": [_owner_ref(svc)],
+    }
+
+
+def desired_statefulset(svc: dict) -> dict:
+    """The serving StatefulSet: notebook-controller multi-host
+    mechanics (topology selectors, per-host chips, jax.distributed
+    env, Parallel management) around the gateway container."""
+    name = svc["metadata"]["name"]
+    ns = svc["metadata"]["namespace"]
+    spec = svc.get("spec") or {}
+    tpu_slice = _slice_for(svc)
+    replicas = tpu_slice.num_hosts if tpu_slice else 1
+    port = gateway_port(svc)
+    container: dict = {
+        "name": "gateway",
+        "image": spec.get("image") or DEFAULT_IMAGE,
+        "ports": [{"name": "http-gateway", "containerPort": port,
+                   "protocol": "TCP"}],
+        # The port is per-CR and the controller owns it end to end
+        # (containerPort, Service, status.endpoint, and the env the
+        # gateway binds): the inference-env PodDefault deliberately
+        # does NOT set KFT_SERVING_PORT, or the conflict-checked merge
+        # would reject pods whenever a CR picked a non-default port.
+        "env": [{"name": "KFT_SERVING_PORT", "value": str(port)}],
+    }
+    pod_spec: dict = {"containers": [container]}
+    if tpu_slice is not None:
+        container["resources"] = {
+            "limits": dict(tpu_slice.container_resources()),
+            "requests": dict(tpu_slice.container_resources()),
+        }
+        pod_spec["nodeSelector"] = dict(tpu_slice.node_selectors())
+        container["env"].append({
+            "name": "TPU_WORKER_ID",
+            "valueFrom": {"fieldRef": {
+                "fieldPath":
+                    f"metadata.labels['{POD_INDEX_LABEL}']"}},
+        })
+        container["env"].append({
+            "name": "KFT_NUM_PROCESSES", "value": str(replicas)})
+        if replicas > 1:
+            hosts = ",".join(
+                f"{name}-{i}.{name}-hosts.{ns}.svc"
+                for i in range(replicas)
+            )
+            container["env"].append({
+                "name": "TPU_WORKER_HOSTNAMES", "value": hosts})
+            container["env"].append({
+                "name": "KFT_COORDINATOR_ADDRESS",
+                "value": f"{name}-0.{name}-hosts.{ns}.svc:"
+                         f"{COORDINATOR_PORT}",
+            })
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": _meta(name, svc),
+        "spec": {
+            "replicas": replicas,
+            "serviceName": f"{name}-hosts",
+            # Gang start: jax.distributed needs every host up before
+            # rank 0's coordinator barrier completes (notebook.cpp).
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"statefulset": name}},
+            "template": {
+                "metadata": {
+                    "labels": {
+                        "statefulset": name,
+                        "inferenceservice-name": name,
+                        # PodDefault selectors: the webhook injects the
+                        # serving env (inference_env_poddefault) and
+                        # the TPU slice env (tpu_env_poddefault).
+                        "inference-env": "true",
+                        "tpu-env": "true",
+                    },
+                },
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def desired_services(svc: dict) -> list[dict]:
+    """Headless per-replica DNS (multi-host coordination) + the
+    gateway front Service. Requests fan to EVERY host's gateway pod —
+    all hosts run the same program and serve the same engine — so the
+    front selector does NOT pin to rank 0 the way the notebook's
+    Jupyter service does; multi-host decode coherence is the data
+    plane's concern."""
+    name = svc["metadata"]["name"]
+    port = gateway_port(svc)
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"{name}-hosts", svc),
+        "spec": {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {"statefulset": name},
+            "ports": [{"name": "http-gateway", "port": port,
+                       "targetPort": port}],
+        },
+    }
+    front = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(name, svc),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"statefulset": name},
+            "ports": [{"name": f"http-{name}", "port": port,
+                       "targetPort": port, "protocol": "TCP"}],
+        },
+    }
+    return [headless, front]
+
+
+def endpoint_for(svc: dict) -> str:
+    name = svc["metadata"]["name"]
+    ns = svc["metadata"]["namespace"]
+    return f"http://{name}.{ns}.svc:{gateway_port(svc)}"
+
+
+def pod_to_inference_requests(obj: dict) -> list[Request]:
+    """Map Pod/StatefulSet events back to the owning InferenceService
+    via the inferenceservice-name label (the notebook controller's
+    mapping discipline)."""
+    meta = obj.get("metadata", {})
+    name = (meta.get("labels") or {}).get("inferenceservice-name")
+    if not name:
+        return []
+    return [Request(meta.get("namespace", ""), name)]
+
+
+class InferenceReconciler:
+    def __init__(self, api: FakeApiServer, prom=None):
+        self.api = api
+        self.prom = prom
+
+    def reconcile(self, req: Request) -> float | None:
+        try:
+            svc = self.api.get(
+                INFERENCE_API, "InferenceService", req.name,
+                req.namespace,
+            )
+        except NotFound:
+            # Deleted: children garbage-collect via ownerReferences.
+            return None
+        try:
+            desired = desired_statefulset(svc)
+        except TopologyError as exc:
+            # Permanent spec error (typo'd accelerator/topology):
+            # retrying cannot fix it, so surface it on the CR and
+            # settle — a spec UPDATE re-triggers reconciliation. The
+            # status write is change-gated or the patch's own watch
+            # event would re-run this forever.
+            message = f"invalid spec.tpu: {exc}"
+            cur = svc.get("status") or {}
+            if (cur.get("phase"), cur.get("message")) != ("Failed",
+                                                          message):
+                record_event(
+                    self.api, svc, "InvalidSpec", message,
+                    event_type="Warning",
+                )
+                self.api.patch_merge(
+                    INFERENCE_API, "InferenceService", req.name,
+                    {"status": {"phase": "Failed",
+                                "message": message}},
+                    req.namespace,
+                )
+            return None
+        try:
+            sts_result = ensure_object(self.api, desired)
+        except Exception as exc:
+            record_event(
+                self.api, svc, "CreateFailed",
+                f"StatefulSet for inference service {req.name} "
+                f"failed: {exc}",
+                event_type="Warning",
+            )
+            raise
+        if sts_result == "created":
+            record_event(
+                self.api, svc, "Created",
+                f"Created StatefulSet for inference service "
+                f"{req.name}",
+            )
+        for child in desired_services(svc):
+            ensure_object(self.api, child)
+        # One STS get + one pod list shared by recovery and the status
+        # mirror — same fetch discipline as the notebook reconciler.
+        try:
+            sts = self.api.get(
+                "apps/v1", "StatefulSet", req.name, req.namespace
+            )
+        except NotFound:
+            sts = None
+        pods = self.api.list(
+            "v1", "Pod", namespace=req.namespace,
+            label_selector=f"inferenceservice-name={req.name}",
+        )
+        restart_reason = self._preemption_recovery(svc, req, sts, pods)
+        self._update_status(svc, restart_reason, sts, pods)
+        return None
+
+    def _preemption_recovery(
+        self, svc: dict, req: Request,
+        sts: dict | None, pods: list | None,
+    ) -> str | None:
+        def on_first_restart():
+            if self.prom is not None:
+                self.prom.inference_preemption_restart_total.labels(
+                    req.namespace
+                ).inc()
+
+        def on_rebaseline(patch: dict, anns: dict, replicas: int):
+            record_event(
+                self.api, svc, "SliceRestarted",
+                f"all {replicas} TPU workers recreated; "
+                "jax.distributed mesh re-forming; the gateway resumes "
+                "serving from the latest valid checkpoint",
+            )
+
+        return recover_slice(
+            self.api, INFERENCE_API, "InferenceService", svc, req,
+            sts, pods,
+            SliceAnnotations(
+                observed_mesh=OBSERVED_MESH_KEY,
+                restart_reason=RESTART_REASON_KEY,
+                preemption_restarts=PREEMPTION_RESTARTS_KEY,
+            ),
+            on_first_restart=on_first_restart,
+            on_rebaseline=on_rebaseline,
+        )
+
+    def _update_status(self, svc: dict, restart_reason: str | None,
+                       sts: dict | None, pods: list) -> None:
+        name = svc["metadata"]["name"]
+        ns = svc["metadata"]["namespace"]
+        replicas = ((sts or {}).get("spec") or {}).get("replicas") or 0
+        expected = {f"{name}-{i}" for i in range(replicas)}
+        ready = 0
+        for pod in pods:
+            if pod["metadata"]["name"] not in expected:
+                continue
+            conditions = (pod.get("status") or {}).get("conditions") or []
+            if any(c.get("type") == "Ready"
+                   and c.get("status") == "True" for c in conditions):
+                ready += 1
+        if restart_reason:
+            phase = "Restarting"
+        elif sts is None or replicas == 0:
+            phase = "Stopped" if sts is not None else "Pending"
+        elif ready == replicas:
+            phase = "Running"
+        else:
+            phase = "Pending"
+        status: dict = {
+            "phase": phase,
+            "readyReplicas": ready,
+            "replicas": replicas,
+            "endpoint": endpoint_for(svc),
+        }
+        if restart_reason:
+            status["restartReason"] = restart_reason
+        cur = svc.get("status") or {}
+        own = {k: cur.get(k) for k in status}
+        if own == status and ("restartReason" in cur) == (
+                "restartReason" in status):
+            return
+        patch = dict(status)
+        if not restart_reason and "restartReason" in cur:
+            # Merge-patch semantics: a completed recovery's marker must
+            # be deleted explicitly or it lingers forever.
+            patch["restartReason"] = None
+        if "message" in cur:
+            # Same rule for a healed InvalidSpec failure's message — a
+            # recovered CR must not read Running + stale error text.
+            patch["message"] = None
+        self.api.patch_merge(
+            INFERENCE_API, "InferenceService", name,
+            {"status": patch}, ns,
+        )
+
+
+def make_inference_controller(
+    api: FakeApiServer,
+    prom=None,
+) -> Controller:
+    reconciler = InferenceReconciler(api, prom=prom)
+    return Controller(
+        name="inference-controller",
+        api=api,
+        reconciler=reconciler,
+        watches=[
+            WatchSpec(INFERENCE_API, "InferenceService"),
+            WatchSpec("apps/v1", "StatefulSet",
+                      pod_to_inference_requests),
+            WatchSpec("v1", "Pod", pod_to_inference_requests),
+        ],
+        prom=prom,
+    )

@@ -257,6 +257,13 @@ class ControllerMetrics:
             ["namespace"],
             registry=self.registry,
         )
+        self.inference_preemption_restart_total = Counter(
+            "inferenceservice_preemption_restart",
+            "Coherent full-slice restarts of an InferenceService "
+            "after a TPU worker was preempted or evicted",
+            ["namespace"],
+            registry=self.registry,
+        )
         # The latency dimension (PR 3): counters say a reconcile
         # happened; these say where the time went. Queue duration is
         # due→dequeue (controller-runtime's

@@ -10,7 +10,6 @@ capability parity with the reference notebook-controller
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 
 from kubeflow_tpu import native
@@ -20,6 +19,10 @@ from kubeflow_tpu.controllers.runtime import (
     WatchSpec,
     ensure_object,
     record_event,
+)
+from kubeflow_tpu.controllers.slice_recovery import (
+    SliceAnnotations,
+    recover_slice,
 )
 from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
 
@@ -217,13 +220,6 @@ class NotebookReconciler:
         )
 
     # ---- TPU preemption recovery ----------------------------------------
-    def _patch_annotations(self, req: Request, annotations: dict) -> None:
-        self.api.patch_merge(
-            NOTEBOOK_API, "Notebook", req.name,
-            {"metadata": {"annotations": annotations}},
-            req.namespace,
-        )
-
     def _preemption_recovery(
         self, notebook: dict, req: Request,
         sts: dict | None, pods: list | None,
@@ -232,146 +228,49 @@ class NotebookReconciler:
 
         The gang-restart path catches a *crashed* container (restartCount
         advance); this one catches a *vanished or replaced* worker pod —
-        what a node-pool preemption looks like: the pod is deleted, the
-        statefulset controller recreates it with a fresh uid, and the
-        survivors' jax.distributed mesh is wedged on the old peer set.
-        Membership is tracked as a pod-name→uid map annotation; when the
-        current set is a MIX of survivors and missing/replaced workers
-        (a partial mesh), every surviving pod is deleted in one pass so
-        the slice re-forms all-or-nothing. An entirely fresh full set
-        re-baselines (that is the coherent outcome, however it arose).
+        what a node-pool preemption looks like. The state machine lives
+        in :func:`controllers.slice_recovery.recover_slice` (shared with
+        the InferenceService controller); the notebook-specific policy —
+        the preemption-restart counter and the checkpoint-resume
+        handshake on re-baseline — rides the hooks.
 
         Returns the restart reason while a recovery is in flight (fed
         into status as phase=Restarting), else None.
         """
-        if pods is None or sts is None:  # non-TPU, or STS not yet created
-            return None
-        replicas = (sts.get("spec") or {}).get("replicas") or 0
-        anns = (notebook.get("metadata") or {}).get("annotations") or {}
-        reason = anns.get(RESTART_REASON_KEY)
-        if replicas <= 1:
-            # Single host (or stopped): the statefulset controller's own
-            # pod recreation is already coherent — no mesh to protect.
-            # Drop any leftover baseline: workers recreated on a later
-            # scale-up must not read as preempted replacements.
-            stale = {k: None for k in (OBSERVED_MESH_KEY,
-                                       RESTART_REASON_KEY) if k in anns}
-            if stale:
-                self._patch_annotations(req, stale)
-            return None
-        expected = {f"{req.name}-{i}" for i in range(replicas)}
-        current = {
-            p["metadata"]["name"]: p["metadata"].get("uid", "")
-            for p in pods
-            if p["metadata"]["name"] in expected
-            and not p["metadata"].get("deletionTimestamp")
-        }
-        observed: dict | None = None
-        raw = anns.get(OBSERVED_MESH_KEY)
-        if raw:
-            try:
-                parsed = json.loads(raw)
-                if isinstance(parsed, dict):
-                    observed = parsed
-            except ValueError:
-                observed = None
-        full = expected <= set(current)
-        if observed is None:
-            # First sight of a complete slice: baseline it. Partial
-            # sets are still forming — baselining one would brand the
-            # late arrivals as "replacements".
-            if full:
-                self._patch_annotations(req, {
-                    OBSERVED_MESH_KEY: json.dumps(current, sort_keys=True),
-                })
-            return reason
-        survivors = {n for n, uid in current.items()
-                     if observed.get(n) == uid}
-        # Only workers the baseline KNEW can be "gone": a missing
-        # ordinal never in the mesh is a scale-up still materialising,
-        # not a preemption.
-        missing = {n for n in expected - set(current) if n in observed}
-        replaced = {n for n, uid in current.items()
-                    if n in observed and observed[n] != uid}
-        if full and not survivors:
-            # Entirely fresh full set: the slice came back together
-            # (post-restart, or a coherent rollout). Re-baseline and
-            # clear the in-flight marker.
-            patch: dict = {
-                OBSERVED_MESH_KEY: json.dumps(current, sort_keys=True),
-            }
-            if reason:
-                patch[RESTART_REASON_KEY] = None
-                # Resume handshake: the fresh slice is expected to pick
-                # up from the last checkpoint step the data plane
-                # reported ("0" = no checkpoint known, fresh start).
-                resume_step = anns.get(CHECKPOINT_STEP_KEY, "0")
-                patch[RESUME_EXPECTED_KEY] = resume_step
-                notebook.setdefault("metadata", {}).setdefault(
-                    "annotations", {}
-                )[RESUME_EXPECTED_KEY] = resume_step
-                record_event(
-                    self.api, notebook, "SliceRestarted",
-                    f"all {replicas} TPU workers recreated; "
-                    "jax.distributed mesh re-forming; training resumes "
-                    f"from checkpoint step {resume_step}",
-                )
-            self._patch_annotations(req, patch)
-            return None
-        if full and not missing and not replaced:
-            # Healthy steady state; clear a stale marker if a previous
-            # recovery pass died between its deletes and this point,
-            # and re-baseline after a replica-count change — stale
-            # ordinals left behind by a scale-down (or fresh ones added
-            # by a scale-up) must not read as preemptions later.
-            patch = {}
-            if reason:
-                patch[RESTART_REASON_KEY] = None
-            if set(observed) != set(current):
-                patch[OBSERVED_MESH_KEY] = json.dumps(
-                    current, sort_keys=True
-                )
-            if patch:
-                self._patch_annotations(req, patch)
-            return None
-        if survivors and (missing or replaced):
-            # Partial mesh: some workers survived while others are gone
-            # or already recreated — jax.distributed cannot survive
-            # that. Recycle every present pod in one pass; deletes come
-            # BEFORE the annotation write so a crash mid-loop retries
-            # the restart instead of recording it as done.
-            gone = sorted(missing | replaced)
-            reason = (
-                f"TPU worker(s) {', '.join(gone)} preempted or evicted; "
-                f"restarting all {replicas} workers (a multi-host slice "
-                "cannot run on a partial mesh)"
-            )
-            record_event(
-                self.api, notebook, "TPUWorkerPreempted", reason,
-                event_type="Warning",
-            )
-            deleted = 0
-            for pod_name in sorted(current):
-                try:
-                    self.api.delete("v1", "Pod", pod_name, req.namespace)
-                    deleted += 1
-                except NotFound:
-                    pass
-            first_pass = anns.get(RESTART_REASON_KEY) is None
-            if deleted and first_pass and self.prom is not None:
+
+        def on_first_restart():
+            if self.prom is not None:
                 self.prom.notebook_preemption_restart_total.labels(
                     req.namespace
                 ).inc()
-            patch = {RESTART_REASON_KEY: reason}
-            if first_pass:
-                patch[PREEMPTION_RESTARTS_KEY] = str(
-                    int(anns.get(PREEMPTION_RESTARTS_KEY, "0") or 0) + 1
-                )
-            self._patch_annotations(req, patch)
-            return reason
-        # Mesh still forming (fresh-but-incomplete, or everything gone):
-        # wait for the statefulset controller; keep the marker visible.
-        return reason
+
+        def on_rebaseline(patch: dict, anns: dict, replicas: int):
+            # Resume handshake: the fresh slice is expected to pick up
+            # from the last checkpoint step the data plane reported
+            # ("0" = no checkpoint known, fresh start).
+            resume_step = anns.get(CHECKPOINT_STEP_KEY, "0")
+            patch[RESUME_EXPECTED_KEY] = resume_step
+            notebook.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )[RESUME_EXPECTED_KEY] = resume_step
+            record_event(
+                self.api, notebook, "SliceRestarted",
+                f"all {replicas} TPU workers recreated; "
+                "jax.distributed mesh re-forming; training resumes "
+                f"from checkpoint step {resume_step}",
+            )
+
+        return recover_slice(
+            self.api, NOTEBOOK_API, "Notebook", notebook, req, sts,
+            pods,
+            SliceAnnotations(
+                observed_mesh=OBSERVED_MESH_KEY,
+                restart_reason=RESTART_REASON_KEY,
+                preemption_restarts=PREEMPTION_RESTARTS_KEY,
+            ),
+            on_first_restart=on_first_restart,
+            on_rebaseline=on_rebaseline,
+        )
 
     def _update_status(self, notebook: dict,
                        restart_reason: str | None = None,

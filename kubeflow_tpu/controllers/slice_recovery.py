@@ -1,0 +1,187 @@
+"""Observed-mesh TPU preemption recovery, shared across controllers.
+
+The notebook controller grew this logic for multi-host slices (PR 2);
+the InferenceService controller needs the identical state machine —
+the failure physics (jax.distributed wedging on a partial mesh) do
+not care which CRD owns the StatefulSet. Extracted here so both
+reconcilers drive ONE implementation, parameterised by the CRD
+coordinates, the annotation keys and two policy hooks:
+
+- ``on_first_restart()`` — fired once per recovery (not per retry
+  pass); the callers bump their preemption-restart counters here.
+- ``on_rebaseline(patch, anns, replicas)`` — fired when an entirely
+  fresh full set re-baselines after a recovery; callers append their
+  resume handshake (the notebook controller stamps the
+  checkpoint-resume annotations and records SliceRestarted here).
+
+Semantics (unchanged from the notebook controller, pinned by
+tests/test_chaos.py): membership is tracked as a pod-name→uid map
+annotation; a MIX of survivors and missing/replaced workers is a
+partial mesh and every surviving pod is deleted in one pass (deletes
+BEFORE the annotation write, so a crash mid-loop retries the restart
+instead of recording it as done); an entirely fresh full set
+re-baselines; replicas <= 1 needs no mesh protection and clears any
+leftover bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+
+from kubeflow_tpu.controllers.runtime import Request, record_event
+from kubeflow_tpu.k8s.fake import NotFound
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceAnnotations:
+    """The per-CRD annotation namespace the recovery state lives in."""
+
+    observed_mesh: str
+    restart_reason: str
+    preemption_restarts: str
+
+
+def recover_slice(
+    api,
+    api_version: str,
+    kind: str,
+    obj: dict,
+    req: Request,
+    sts: dict | None,
+    pods: list | None,
+    keys: SliceAnnotations,
+    on_first_restart=None,
+    on_rebaseline=None,
+) -> str | None:
+    """One recovery pass for ``obj``'s slice. Returns the restart
+    reason while a recovery is in flight (callers surface it as
+    phase=Restarting), else None. ``sts``/``pods`` are the caller's
+    already-fetched StatefulSet and label-selected pod list — this
+    runs on every reconcile, so it must not re-fetch what the caller
+    already has."""
+
+    def patch_annotations(annotations: dict) -> None:
+        api.patch_merge(
+            api_version, kind, req.name,
+            {"metadata": {"annotations": annotations}},
+            req.namespace,
+        )
+
+    if pods is None or sts is None:  # non-TPU, or STS not yet created
+        return None
+    replicas = (sts.get("spec") or {}).get("replicas") or 0
+    anns = (obj.get("metadata") or {}).get("annotations") or {}
+    reason = anns.get(keys.restart_reason)
+    if replicas <= 1:
+        # Single host (or stopped): the statefulset controller's own
+        # pod recreation is already coherent — no mesh to protect.
+        # Drop any leftover baseline: workers recreated on a later
+        # scale-up must not read as preempted replacements.
+        stale = {k: None for k in (keys.observed_mesh,
+                                   keys.restart_reason) if k in anns}
+        if stale:
+            patch_annotations(stale)
+        return None
+    expected = {f"{req.name}-{i}" for i in range(replicas)}
+    current = {
+        p["metadata"]["name"]: p["metadata"].get("uid", "")
+        for p in pods
+        if p["metadata"]["name"] in expected
+        and not p["metadata"].get("deletionTimestamp")
+    }
+    observed: dict | None = None
+    raw = anns.get(keys.observed_mesh)
+    if raw:
+        try:
+            parsed = json.loads(raw)
+            if isinstance(parsed, dict):
+                observed = parsed
+        except ValueError:
+            observed = None
+    full = expected <= set(current)
+    if observed is None:
+        # First sight of a complete slice: baseline it. Partial sets
+        # are still forming — baselining one would brand the late
+        # arrivals as "replacements".
+        if full:
+            patch_annotations({
+                keys.observed_mesh: json.dumps(current, sort_keys=True),
+            })
+        return reason
+    survivors = {n for n, uid in current.items()
+                 if observed.get(n) == uid}
+    # Only workers the baseline KNEW can be "gone": a missing ordinal
+    # never in the mesh is a scale-up still materialising, not a
+    # preemption.
+    missing = {n for n in expected - set(current) if n in observed}
+    replaced = {n for n, uid in current.items()
+                if n in observed and observed[n] != uid}
+    if full and not survivors:
+        # Entirely fresh full set: the slice came back together
+        # (post-restart, or a coherent rollout). Re-baseline and clear
+        # the in-flight marker.
+        patch: dict = {
+            keys.observed_mesh: json.dumps(current, sort_keys=True),
+        }
+        if reason:
+            patch[keys.restart_reason] = None
+            if on_rebaseline is not None:
+                on_rebaseline(patch, anns, replicas)
+        patch_annotations(patch)
+        return None
+    if full and not missing and not replaced:
+        # Healthy steady state; clear a stale marker if a previous
+        # recovery pass died between its deletes and this point, and
+        # re-baseline after a replica-count change — stale ordinals
+        # left behind by a scale-down (or fresh ones added by a
+        # scale-up) must not read as preemptions later.
+        patch = {}
+        if reason:
+            patch[keys.restart_reason] = None
+        if set(observed) != set(current):
+            patch[keys.observed_mesh] = json.dumps(
+                current, sort_keys=True
+            )
+        if patch:
+            patch_annotations(patch)
+        return None
+    if survivors and (missing or replaced):
+        # Partial mesh: some workers survived while others are gone or
+        # already recreated — jax.distributed cannot survive that.
+        # Recycle every present pod in one pass; deletes come BEFORE
+        # the annotation write so a crash mid-loop retries the restart
+        # instead of recording it as done.
+        gone = sorted(missing | replaced)
+        reason = (
+            f"TPU worker(s) {', '.join(gone)} preempted or evicted; "
+            f"restarting all {replicas} workers (a multi-host slice "
+            "cannot run on a partial mesh)"
+        )
+        record_event(
+            api, obj, "TPUWorkerPreempted", reason,
+            event_type="Warning",
+        )
+        deleted = 0
+        for pod_name in sorted(current):
+            try:
+                api.delete("v1", "Pod", pod_name, req.namespace)
+                deleted += 1
+            except NotFound:
+                pass
+        first_pass = anns.get(keys.restart_reason) is None
+        if deleted and first_pass and on_first_restart is not None:
+            on_first_restart()
+        patch = {keys.restart_reason: reason}
+        if first_pass:
+            patch[keys.preemption_restarts] = str(
+                int(anns.get(keys.preemption_restarts, "0") or 0) + 1
+            )
+        patch_annotations(patch)
+        return reason
+    # Mesh still forming (fresh-but-incomplete, or everything gone):
+    # wait for the statefulset controller; keep the marker visible.
+    return reason

@@ -418,6 +418,23 @@ def run_tensorboards_web_app():
     _run_rest_app(app, 5000)
 
 
+def run_inference_controller():
+    from kubeflow_tpu.controllers.inference import (
+        make_inference_controller,
+    )
+
+    _run_single_controller(
+        make_inference_controller, "inference-controller"
+    )
+
+
+def run_inference_gateway():
+    from kubeflow_tpu.serving.__main__ import main as gateway_main
+
+    _setup_logging()
+    gateway_main()
+
+
 def run_dev_apiserver():
     from kubeflow_tpu.k8s.httpd import main as httpd_main
 
@@ -430,6 +447,8 @@ def run_dev_apiserver():
 
 COMPONENTS = {
     "notebook-controller": run_notebook_controller,
+    "inference-controller": run_inference_controller,
+    "inference-gateway": run_inference_gateway,
     "profile-controller": run_profile_controller,
     "tensorboard-controller": run_tensorboard_controller,
     "pvcviewer-controller": run_pvcviewer_controller,

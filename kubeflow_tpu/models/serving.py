@@ -30,8 +30,11 @@ Design (TPU-first):
   slots reproduce ``generate``'s key schedule exactly); int8 WEIGHTS
   work transparently (the step multiplies through ``_mm``); windowed
   models with window < max_len serve from ROLLING slots (circular
-  per-slot buffers, O(window) memory per slot). The int8 KV cache is
-  not wired into the batched state (serve it through ``generate``).
+  per-slot buffers, O(window) memory per slot). The int8 KV cache
+  (``quantize_cache=True``) stores per-slot K/V as int8 with per-row
+  absmax scales — same layout and quantiser as ``KVCache`` — halving
+  slot memory and per-token cache reads; parity with
+  ``generate(..., quantize_cache=True)`` is test-pinned.
 
 Parity contract (pinned in tests/test_serving.py): every request's
 output equals single-request ``generate`` under the same compilation
@@ -73,7 +76,10 @@ class BatchState:
     """Per-slot decode state. ``k``/``v``: (L, B, Hkv, capacity, hd);
     ``pos``: (B,) next global position (= tokens held so far);
     ``last``: (B,) the token to feed next; ``active``: (B,) bool;
-    ``temp``: (B,) f32 per-slot sampling temperature (0 = greedy)."""
+    ``temp``: (B,) f32 per-slot sampling temperature (0 = greedy).
+    ``k_scale``/``v_scale`` (quantized slots only):
+    (L, B, Hkv, capacity, 1) f32 per-row absmax scales over an int8
+    payload — the same layout rule as :class:`KVCache`."""
 
     k: jax.Array
     v: jax.Array
@@ -81,10 +87,16 @@ class BatchState:
     last: jax.Array
     active: jax.Array
     temp: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @classmethod
     def init(cls, cfg: LMConfig, max_batch: int, capacity: int,
-             rolling: bool = False):
+             rolling: bool = False, quantized: bool = False):
         if rolling:
             # Circular per-slot buffers: capacity == the window (same
             # rule as KVCache.init(rolling=True)); positions wrap.
@@ -97,20 +109,49 @@ class BatchState:
             capacity = -(-capacity // DECODE_BLOCK) * DECODE_BLOCK
         shape = (cfg.layers, max_batch, cfg.num_kv_heads, capacity,
                  cfg.head_dim)
+        scale_shape = shape[:-1] + (1,)
         return cls(
-            k=jnp.zeros(shape, cfg.dtype),
-            v=jnp.zeros(shape, cfg.dtype),
+            k=jnp.zeros(shape, jnp.int8 if quantized else cfg.dtype),
+            v=jnp.zeros(shape, jnp.int8 if quantized else cfg.dtype),
             pos=jnp.zeros((max_batch,), jnp.int32),
             last=jnp.zeros((max_batch,), jnp.int32),
             active=jnp.zeros((max_batch,), bool),
             temp=jnp.zeros((max_batch,), jnp.float32),
+            k_scale=(jnp.zeros(scale_shape, jnp.float32)
+                     if quantized else None),
+            v_scale=(jnp.zeros(scale_shape, jnp.float32)
+                     if quantized else None),
         )
 
 
 jax.tree_util.register_dataclass(
     BatchState,
-    data_fields=["k", "v", "pos", "last", "active", "temp"],
+    data_fields=["k", "v", "pos", "last", "active", "temp",
+                 "k_scale", "v_scale"],
     meta_fields=[])
+
+
+def check_request_contract(prompt, max_new_tokens: int,
+                           temperature: float, rng) -> list[int]:
+    """The admission contract every serving engine shares (the
+    batcher here and the serialized-generate fallback in
+    kubeflow_tpu/serving/engine.py): integer tokens, non-empty
+    prompt, a real budget, and generate()'s rng-required-iff-sampling
+    rule. Returns the normalised prompt. Capacity bounds stay
+    engine-specific — slot rounding vs plain max_len."""
+    prompt = list(map(int, prompt))
+    if not prompt:
+        raise ValueError("empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError(
+            "temperature > 0 samples from the categorical "
+            "distribution; pass rng=jax.random.key(...)"
+        )
+    return prompt
 
 
 def _sample(logits, temp, keys):
@@ -131,6 +172,32 @@ def _sample(logits, temp, keys):
     return jnp.where(temp > 0.0, drawn, greedy)
 
 
+def splice_slot(state: BatchState, slot, cache: KVCache, first, temp
+                ) -> BatchState:
+    """Adopt a B=1 cache (payload + scales) into ``slot`` at position
+    ``cache.length``: the shared tail of every prefill variant —
+    :func:`prefill_slot` here and the streaming engine's
+    keep/extend/adopt paths (kubeflow_tpu/serving/engine.py). One
+    implementation, or the batch path and the prefix-cache path would
+    silently diverge on the next BatchState layout change."""
+    return BatchState(
+        k=jax.lax.dynamic_update_slice(
+            state.k, cache.k, (0, slot, 0, 0, 0)),
+        v=jax.lax.dynamic_update_slice(
+            state.v, cache.v, (0, slot, 0, 0, 0)),
+        pos=state.pos.at[slot].set(cache.length),
+        last=state.last.at[slot].set(first),
+        active=state.active.at[slot].set(True),
+        temp=state.temp.at[slot].set(temp),
+        k_scale=jax.lax.dynamic_update_slice(
+            state.k_scale, cache.k_scale, (0, slot, 0, 0, 0))
+        if state.quantized else None,
+        v_scale=jax.lax.dynamic_update_slice(
+            state.v_scale, cache.v_scale, (0, slot, 0, 0, 0))
+        if state.quantized else None,
+    )
+
+
 def _write_row(cache_layer, new, pos):
     """cache_layer (B, Hkv, cap, hd) <- new (B, Hkv, 1, hd) at
     per-row position ``pos`` (B,)."""
@@ -139,14 +206,18 @@ def _write_row(cache_layer, new, pos):
     )(cache_layer, new, pos)
 
 
-def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False):
+def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False,
+                           ks=None, vs=None):
     """Single-token masked read with PER-SLOT positions. q
     (B, H, 1, hd); ck/cv (B, Hkv, cap, hd); pos (B,). Linear layout:
     row b attends to cols <= pos[b] (within the window). Rolling
     layout (decoding._rolling_attention with a position vector): slot
     j holds the newest global position ≡ j (mod capacity) that is
     <= pos[b]; unwritten slots mask out; capacity <= window keeps
-    every written slot in-band by construction."""
+    every written slot in-band by construction. ``ks``/``vs``
+    (B, Hkv, cap, 1) dequantise an int8 cache per row — scales factor
+    out of both matmuls, so the payload is read as int8 (the
+    bandwidth win), exactly like decoding._decode_attention."""
     b, h, _, hd = q.shape
     hkv = ck.shape[1]
     group = h // hkv
@@ -156,6 +227,8 @@ def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False):
         "bkgd,bkld->bkgl", qg, ck.astype(compute),
         preferred_element_type=jnp.float32,
     ) * hd ** -0.5
+    if ks is not None:
+        s = s * ks[..., 0][:, :, None, :]
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     rows = pos[:, None, None, None]
     if rolling:
@@ -168,6 +241,8 @@ def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False):
             keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
     s = jnp.where(keep, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        w = w * vs[..., 0][:, :, None, :]
     out = jnp.einsum(
         "bkgl,bkld->bkgd", w.astype(compute), cv.astype(compute),
         preferred_element_type=jnp.float32,
@@ -208,7 +283,8 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
 
     hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
     rope = jax.vmap(lambda t, o: apply_rope(t, offset=o))
-    new_k, new_v = [], []
+    quantized = state.quantized
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i in range(cfg.layers):
         blk = params[f"block_{i}"]
         h = rms_norm(blk["RMSNorm_0"]["scale"], x)
@@ -222,12 +298,24 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
         k = rope(k, state.pos)
         capacity = state.k.shape[3]
         wpos = state.pos % capacity if rolling else state.pos
-        ck = _write_row(state.k[i], k, wpos)
-        cv = _write_row(state.v[i], v, wpos)
+        if quantized:
+            from kubeflow_tpu.models.decoding import _quantize_rows
+
+            k_store, k_s = _quantize_rows(k)
+            v_store, v_s = _quantize_rows(v)
+            ks_buf = _write_row(state.k_scale[i], k_s, wpos)
+            vs_buf = _write_row(state.v_scale[i], v_s, wpos)
+            new_ks.append(ks_buf)
+            new_vs.append(vs_buf)
+        else:
+            k_store, v_store, ks_buf, vs_buf = k, v, None, None
+        ck = _write_row(state.k[i], k_store, wpos)
+        cv = _write_row(state.v[i], v_store, wpos)
         new_k.append(ck)
         new_v.append(cv)
         out = _batched_pos_attention(cfg, q, ck, cv, state.pos,
-                                     rolling=rolling)
+                                     rolling=rolling,
+                                     ks=ks_buf, vs=vs_buf)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.dim)
         x = x + _mm(out, blk["proj"]["kernel"], cfg.dtype
                     ).astype(cfg.dtype)
@@ -248,6 +336,8 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
         last=jnp.where(active, nxt, state.last),
         active=active,
         temp=state.temp,
+        k_scale=jnp.stack(new_ks) if quantized else None,
+        v_scale=jnp.stack(new_vs) if quantized else None,
     ), nxt
 
 
@@ -279,25 +369,16 @@ def prefill_slot(cfg: LMConfig, params: dict[str, Any],
     B=1 prefill (flash path, same capacity/layout — incl. the rolling
     circular write for windowed slots) and splice its cache into the
     batched state. The first token samples at ``temp`` with
-    ``first_key`` (generate()'s first_key role). Returns
-    (state, first token)."""
+    ``first_key`` (generate()'s first_key role). Quantized slots run
+    the B=1 prefill on a quantized KVCache (decoding's own int8 write
+    path) and splice payload + scales. Returns (state, first token)."""
     capacity = state.k.shape[3]
-    cache = KVCache.init(cfg, 1, capacity, rolling=rolling)
+    cache = KVCache.init(cfg, 1, capacity, rolling=rolling,
+                         quantized=state.quantized)
     logits, cache = forward_with_cache(cfg, params, prompt, cache,
                                        last_logits_only=True)
     first = _sample(logits[:, -1], temp[None], first_key[None])[0]
-    k = jax.lax.dynamic_update_slice(
-        state.k, cache.k, (0, slot, 0, 0, 0))
-    v = jax.lax.dynamic_update_slice(
-        state.v, cache.v, (0, slot, 0, 0, 0))
-    p = prompt.shape[1]
-    return BatchState(
-        k=k, v=v,
-        pos=state.pos.at[slot].set(p),
-        last=state.last.at[slot].set(first),
-        active=state.active.at[slot].set(True),
-        temp=state.temp.at[slot].set(temp),
-    ), first
+    return splice_slot(state, slot, cache, first, temp), first
 
 
 class ContinuousBatcher:
@@ -317,7 +398,8 @@ class ContinuousBatcher:
     def __init__(self, cfg: LMConfig, params: dict[str, Any],
                  max_batch: int, max_len: int,
                  eos_token: int | None = None,
-                 step_chunk: int = 8):
+                 step_chunk: int = 8,
+                 quantize_cache: bool = False):
         if cfg.moe_experts:
             # Fail at construction, not at the first decode trace
             # after prefill work has already been dispatched.
@@ -330,6 +412,7 @@ class ContinuousBatcher:
         self.cfg, self.params = cfg, params
         self.eos = eos_token
         self.step_chunk = step_chunk
+        self.quantize_cache = quantize_cache
         # Windowed models whose window is smaller than max_len get
         # ROLLING slots: circular per-slot buffers of the window size
         # — memory and per-token reads O(window) however long each
@@ -337,7 +420,8 @@ class ContinuousBatcher:
         self.rolling = (cfg.attn_window is not None
                         and cfg.attn_window < max_len)
         self.state = BatchState.init(cfg, max_batch, max_len,
-                                     rolling=self.rolling)
+                                     rolling=self.rolling,
+                                     quantized=quantize_cache)
         self.capacity = self.state.k.shape[3]
         self.max_len = max_len
         self._queue: deque = deque()
@@ -359,17 +443,15 @@ class ContinuousBatcher:
             donate_argnums=(1,))
         self._dummy_key = jax.random.key(0)
 
-    def submit(self, prompt, max_new_tokens: int = 128,
-               temperature: float = 0.0,
-               rng: jax.Array | None = None) -> int:
-        """Queue a request. ``temperature``/``rng`` follow generate's
-        contract (rng required iff temperature > 0); the key schedule
-        is generate's exactly — split(rng) -> first key + pre-split
-        step keys — so a sampled request reproduces
-        ``generate(..., temperature=t, rng=rng)``."""
-        prompt = list(map(int, prompt))
-        if not prompt:
-            raise ValueError("empty prompt")
+    def _build_request(self, rid: int, prompt, max_new_tokens: int,
+                       temperature: float,
+                       rng: jax.Array | None) -> dict:
+        """Validate + assemble one request dict (shared by ``submit``
+        and the streaming engine, which allocates its own ids under a
+        lock). Pure apart from reading immutable sizing attributes, so
+        it is safe to call from any thread."""
+        prompt = check_request_contract(prompt, max_new_tokens,
+                                        temperature, rng)
         # + step_chunk: a slot finishing mid-chunk keeps stepping (and
         # writing) until the boundary; a LINEAR buffer must absorb
         # that. Rolling slots wrap, so the overshoot is harmless and
@@ -385,11 +467,6 @@ class ContinuousBatcher:
                 + f" exceeds "
                 f"{'max_len' if self.rolling else 'capacity'} {limit}"
             )
-        if temperature > 0.0 and rng is None:
-            raise ValueError(
-                "temperature > 0 samples from the categorical "
-                "distribution; pass rng=jax.random.key(...)"
-            )
         if temperature > 0.0:
             # Accept legacy uint32 PRNGKeys like generate does — the
             # key rows stacked in _chunk_keys must all be typed.
@@ -401,13 +478,23 @@ class ContinuousBatcher:
                 if max_new_tokens > 1 else None)
         else:
             first_key, step_keys = self._dummy_key, None
+        return {"id": rid, "prompt": prompt, "budget": max_new_tokens,
+                "done": False, "temp": float(temperature),
+                "first_key": first_key,
+                "step_keys": step_keys, "kcur": 0}
+
+    def submit(self, prompt, max_new_tokens: int = 128,
+               temperature: float = 0.0,
+               rng: jax.Array | None = None) -> int:
+        """Queue a request. ``temperature``/``rng`` follow generate's
+        contract (rng required iff temperature > 0); the key schedule
+        is generate's exactly — split(rng) -> first key + pre-split
+        step keys — so a sampled request reproduces
+        ``generate(..., temperature=t, rng=rng)``."""
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(
-            {"id": rid, "prompt": prompt, "budget": max_new_tokens,
-             "done": False, "temp": float(temperature),
-             "first_key": first_key,
-             "step_keys": step_keys, "kcur": 0})
+        self._queue.append(self._build_request(
+            rid, prompt, max_new_tokens, temperature, rng))
         return rid
 
     # ---------------------------------------------------- internals

@@ -1,0 +1,30 @@
+"""Inference serving: the data plane behind the InferenceService CRD.
+
+``engine`` turns the continuous batcher (models/serving.py) into a
+streaming, thread-fed engine — bounded admission inbox, capped
+prefill-per-cycle interleaving, prompt prefix-cache reuse, hot model
+swap — with a serialized ``generate()`` fallback for models the
+batcher cannot serve (MoE). ``gateway`` serves it over HTTP:
+``POST /v1/generate`` with SSE token streaming, 429+Retry-After
+shedding, per-request spans, and ``/metrics``.
+"""
+
+from kubeflow_tpu.serving.engine import (
+    GenerateFallbackEngine,
+    PrefixCache,
+    QueueFull,
+    Scheduler,
+    StreamingBatcher,
+    make_engine,
+)
+from kubeflow_tpu.serving.gateway import InferenceGateway
+
+__all__ = [
+    "GenerateFallbackEngine",
+    "InferenceGateway",
+    "PrefixCache",
+    "QueueFull",
+    "Scheduler",
+    "StreamingBatcher",
+    "make_engine",
+]

@@ -1,0 +1,115 @@
+"""Inference-gateway pod entrypoint: ``python -m kubeflow_tpu.serving``.
+
+Env contract (the ``inference-env`` PodDefault injects the KFT_SERVING_*
+variables at admission; ``KFT_SERVING_CONFIG`` comes from the image or
+the CR template):
+
+- ``KFT_SERVING_MODEL_DIR`` — checkpoint directory served from; the
+  newest valid step loads at boot (``restore_latest_valid``) and again
+  on every ``POST /v1/admin/swap`` (hot swap). Empty/absent dir serves
+  the randomly initialised params (dev mode).
+- ``KFT_SERVING_CONFIG`` — JSON object of LMConfig overrides
+  (vocab/layers/dim/heads/...); defaults to a small dev model.
+- ``KFT_SERVING_MAX_BATCH`` / ``KFT_SERVING_MAX_LEN`` — decode slots /
+  slot capacity. ``KFT_SERVING_EOS`` — optional eos token id.
+  ``KFT_SERVING_PORT`` — HTTP port (default 8800).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def build_model(env: dict):
+    """(cfg, params) from the env: config overrides + random init —
+    the restore (when a checkpoint exists) replaces the params with
+    the trained ones of the SAME pytree shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+
+    overrides = json.loads(env.get("KFT_SERVING_CONFIG") or "{}")
+    if "dtype" in overrides:
+        overrides["dtype"] = jnp.dtype(overrides["dtype"]).type
+    cfg = LMConfig(**overrides)
+    model = build_lm(cfg, use_flash=jax.default_backend() == "tpu")
+    state = create_lm_state(model, jax.random.key(0), (1, 16))
+    return cfg, state.params
+
+
+def make_reload_fn(model_dir: str, template):
+    """The hot-swap hook: load the newest digest-valid checkpoint into
+    the template's pytree shape; (None, info) when nothing valid
+    exists (the gateway answers 409, serving continues on the current
+    params)."""
+
+    def reload_fn():
+        from kubeflow_tpu.models.checkpoint import (
+            CheckpointManager,
+            _world_identity,
+        )
+
+        # Live-world identity, not the process_count=1 default: on a
+        # multi-host InferenceService every rank must restore the SAME
+        # agreed step (process 0 validates and broadcasts the pick) —
+        # a per-rank walk could silently serve diverged weights.
+        manager = CheckpointManager(model_dir, **_world_identity())
+        result = manager.restore_latest_valid({"params": template})
+        if result is None:
+            return None, {"dir": model_dir, "step": None}
+        state, step = result
+        return state["params"], {"dir": model_dir, "step": step}
+
+    return reload_fn
+
+
+def main(argv=None) -> None:
+    from kubeflow_tpu.obs import configure_structured_logging
+    from kubeflow_tpu.serving.engine import make_engine
+    from kubeflow_tpu.serving.gateway import InferenceGateway
+
+    configure_structured_logging()
+    env = dict(os.environ)
+    cfg, params = build_model(env)
+    model_dir = env.get("KFT_SERVING_MODEL_DIR", "")
+    reload_fn = None
+    if model_dir:
+        reload_fn = make_reload_fn(model_dir, params)
+        loaded, info = reload_fn()
+        if loaded is not None:
+            params = loaded
+            log.info("serving checkpoint step %s from %s",
+                     info["step"], model_dir)
+        else:
+            log.warning("no valid checkpoint under %s; serving "
+                        "initialised params", model_dir)
+    eos = env.get("KFT_SERVING_EOS")
+    engine = make_engine(
+        cfg, params,
+        max_batch=int(env.get("KFT_SERVING_MAX_BATCH", "8")),
+        max_len=int(env.get("KFT_SERVING_MAX_LEN", "2048")),
+        eos_token=int(eos) if eos else None,
+    )
+    gateway = InferenceGateway(
+        engine,
+        port=int(env.get("KFT_SERVING_PORT", "8800")),
+        reload_fn=reload_fn,
+    ).start()
+    log.info("inference gateway serving on :%d (batched=%s)",
+             gateway.port, engine.batched)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    gateway.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,620 @@
+"""Streaming serving engine over the continuous batcher.
+
+``ContinuousBatcher.run()`` drains a queue and returns a dict — the
+right shape for batch jobs, the wrong one for a gateway that must
+stream tokens to open HTTP connections while new requests keep
+arriving. :class:`StreamingBatcher` keeps the batcher's slots, jitted
+step and parity contract and adds the serving mechanics on top:
+
+- **Thread-fed bounded inbox**: HTTP handler threads submit through
+  :meth:`submit_stream`; past ``max_pending`` waiting requests the
+  engine sheds with :class:`QueueFull` (the gateway turns that into
+  429 + Retry-After). The scheduler thread is the only one touching
+  device state; the lock guards exactly the handoff structures.
+- **Prefill/decode interleaving policy**: at most
+  ``prefill_per_cycle`` prompts are admitted per decode cycle. Each
+  admission is a full-prompt prefill dispatch — admitting the whole
+  queue at once would stall every in-flight stream for the sum of the
+  prefills, so the cap bounds the decode gap any steady stream sees
+  while still retiring time-to-first-token for the queue head.
+- **Prefix cache**: prefills are memoised host-side by prompt tuple.
+  A new request whose prompt extends a cached prompt prefills only
+  the suffix against the cached B=1 KV (mid-sequence chunk path); an
+  exact match skips prefill entirely and samples from the cached
+  last-position logits with its own temperature/key. Entries are
+  invalidated on hot swap (stale KV from old weights must never mix
+  with new weights).
+- **Hot model swap**: :meth:`swap_params` stages a new params pytree;
+  the scheduler applies it between cycles after draining in-flight
+  slots (queued requests wait and are served by the new weights).
+
+:class:`GenerateFallbackEngine` serves the same interface through
+serialized ``generate()`` calls for models the batcher refuses at
+construction (MoE decode) — one request at a time, tokens still
+streamed to the sink and metered, so an InferenceService over an MoE
+checkpoint degrades instead of failing.
+
+Sinks receive ``{"token": t}`` per generated token and a final
+``{"done": True, "reason": ..., "tokens": [...], "cache_hit": ...}``.
+Sink callbacks run on the scheduler thread and must not block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.decoding import KVCache, forward_with_cache
+from kubeflow_tpu.models.serving import (
+    BatchState,
+    ContinuousBatcher,
+    _sample,
+    check_request_contract,
+    splice_slot,
+)
+from kubeflow_tpu.models.transformer import LMConfig
+from kubeflow_tpu.obs.metrics import BucketHistogram
+
+log = logging.getLogger(__name__)
+
+Sink = Callable[[dict], None]
+
+
+class QueueFull(RuntimeError):
+    """Admission inbox is at capacity — shed, don't queue unbounded."""
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One memoised prefill: the B=1 KV cache after running a prompt
+    (slot-capacity layout, spliceable as-is) plus the last-position
+    logits so an exact prompt match can sample its first token without
+    touching the model."""
+
+    cache: KVCache
+    logits: jax.Array  # (1, vocab) f32
+
+
+class PrefixCache:
+    """LRU map prompt-tuple -> :class:`CacheEntry` with longest-prefix
+    lookup. Single-threaded by design: only the scheduler thread reads
+    or writes it. Capacity bounds device memory (each entry pins one
+    B=1 slot-capacity KV cache)."""
+
+    def __init__(self, capacity: int = 32):
+        if capacity < 1:
+            raise ValueError("prefix cache capacity must be >= 1")
+        self.capacity = capacity
+        self._entries: OrderedDict[tuple, CacheEntry] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, prompt: tuple) -> tuple[CacheEntry | None, int]:
+        """(entry, prefix length) for the LONGEST cached prompt that
+        is a prefix of ``prompt`` (possibly all of it); (None, 0) on a
+        miss. Counts one hit or miss per call."""
+        best: tuple | None = None
+        for key in self._entries:
+            if (len(key) <= len(prompt) and prompt[: len(key)] == key
+                    and (best is None or len(key) > len(best))):
+                best = key
+        if best is None:
+            self.misses += 1
+            return None, 0
+        self.hits += 1
+        self._entries.move_to_end(best)
+        return self._entries[best], len(best)
+
+    def put(self, prompt, entry: CacheEntry) -> None:
+        key = tuple(prompt)
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# Jitted prefill variants (linear slots only; rolling slots skip the
+# prefix cache — a circular buffer's layout depends on how far past the
+# window the writer ran, so a cached ring is not spliceable per-prefix).
+# ---------------------------------------------------------------------------
+
+
+def prefill_slot_keep(cfg: LMConfig, params, state: BatchState, slot,
+                      prompt, temp, first_key):
+    """models.serving.prefill_slot, but ALSO returning the B=1 cache
+    and last-position logits so the caller can memoise the prefill.
+    Identical math — the parity contract is inherited, not re-proven."""
+    capacity = state.k.shape[3]
+    cache = KVCache.init(cfg, 1, capacity, quantized=state.quantized)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache,
+                                       last_logits_only=True)
+    first = _sample(logits[:, -1], temp[None], first_key[None])[0]
+    return splice_slot(state, slot, cache, first, temp), first, cache, \
+        logits[:, -1]
+
+
+def extend_slot(cfg: LMConfig, params, state: BatchState, slot,
+                cache: KVCache, suffix, temp, first_key):
+    """Prefill only ``suffix`` on top of a cached prefix KV (the
+    mid-sequence chunk path of forward_with_cache), splice the result
+    into ``slot`` and return the extended cache for re-memoisation."""
+    logits, cache = forward_with_cache(cfg, params, suffix, cache,
+                                       last_logits_only=True)
+    first = _sample(logits[:, -1], temp[None], first_key[None])[0]
+    return splice_slot(state, slot, cache, first, temp), first, cache, \
+        logits[:, -1]
+
+
+def adopt_slot(state: BatchState, slot, cache: KVCache, logits, temp,
+               first_key):
+    """Exact prompt match: no model work at all — sample the first
+    token from the cached last-position logits with THIS request's
+    temperature/key and splice the cached KV into the slot."""
+    first = _sample(logits, temp[None], first_key[None])[0]
+    return splice_slot(state, slot, cache, first, temp), first
+
+
+# ---------------------------------------------------------------------------
+# Engine base: the thread-safe handoff both engines share.
+# ---------------------------------------------------------------------------
+
+
+class _EngineBase:
+    """Bounded inbox + staged-swap plumbing. The lock guards exactly
+    the structures HTTP threads and the scheduler thread hand off
+    through (``_inbox``, ``_pending_count``, ``_pending_params``,
+    ``_rid``); everything else belongs to the scheduler thread alone
+    and is never written under the lock."""
+
+    def __init__(self, max_pending: int = 64):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.max_pending = max_pending
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._inbox: deque = deque()
+        self._pending_count = 0
+        self._pending_params: Any | None = None
+        self._rid = 0
+
+    def _enqueue(self, req: dict) -> int:
+        """Admit ``req`` to the inbox (or shed). Called from HTTP
+        threads after request validation built the dict."""
+        with self._lock:
+            if self._pending_count >= self.max_pending:
+                raise QueueFull(
+                    f"{self._pending_count} requests already waiting "
+                    f"(max_pending={self.max_pending})"
+                )
+            rid = self._rid
+            self._rid += 1
+            self._pending_count += 1
+            req["id"] = rid
+            self._inbox.append(req)
+        self._wake.set()
+        return rid
+
+    def _take_inbox(self) -> list[dict]:
+        with self._lock:
+            taken = list(self._inbox)
+            self._inbox.clear()
+        return taken
+
+    def _note_admitted(self) -> None:
+        with self._lock:
+            self._pending_count -= 1
+
+    def _staged_params(self):
+        with self._lock:
+            return self._pending_params
+
+    def _consume_staged(self, staged) -> None:
+        """Clear the stage only if it still holds ``staged`` — a newer
+        swap racing in must not be dropped (latest wins)."""
+        with self._lock:
+            if self._pending_params is staged:
+                self._pending_params = None
+
+    def pending(self) -> int:
+        """Requests submitted but not yet admitted to compute — the
+        admission queue depth the gateway meters and sheds on."""
+        with self._lock:
+            return self._pending_count
+
+    def swap_params(self, new_params) -> None:
+        """Stage a new params pytree; the scheduler re-points between
+        cycles after draining in-flight slots. Latest stage wins."""
+        with self._lock:
+            self._pending_params = new_params
+        self._wake.set()
+
+    def wait_for_work(self, timeout: float) -> None:
+        if self._wake.wait(timeout):
+            self._wake.clear()
+
+    # Shared sink discipline: a dead client must not kill the
+    # scheduler thread that every other stream depends on.
+    def _emit(self, req: dict, event: dict) -> None:
+        sink = req.get("sink")
+        if sink is None:
+            return
+        try:
+            sink(event)
+        except Exception:
+            log.exception("serving sink failed for request %s",
+                          req.get("id"))
+
+
+class StreamingBatcher(_EngineBase, ContinuousBatcher):
+    """The continuous batcher as a gateway engine (module docstring
+    has the full design). Construction raises ``NotImplementedError``
+    for MoE configs exactly like the base class — callers degrade to
+    :class:`GenerateFallbackEngine` (see :func:`make_engine`)."""
+
+    batched = True
+
+    # Each prefix-cache entry pins a full slot-capacity B=1 KV cache
+    # on device — entry cost = 1/max_batch of the whole BatchState's
+    # KV. The default keeps the cache's worst case at ~one extra
+    # batch's worth of KV memory; raise it only with the HBM headroom
+    # to match (a byte-based bound is the roadmap refinement).
+    def __init__(self, cfg: LMConfig, params, max_batch: int,
+                 max_len: int, eos_token: int | None = None,
+                 step_chunk: int = 8, quantize_cache: bool = False,
+                 prefill_per_cycle: int = 2, max_pending: int = 64,
+                 prefix_cache_size: int = 8):
+        ContinuousBatcher.__init__(
+            self, cfg, params, max_batch, max_len, eos_token=eos_token,
+            step_chunk=step_chunk, quantize_cache=quantize_cache)
+        _EngineBase.__init__(self, max_pending=max_pending)
+        if prefill_per_cycle < 1:
+            raise ValueError("prefill_per_cycle must be >= 1")
+        self.prefill_per_cycle = prefill_per_cycle
+        self.swaps_total = 0
+        self.draining = False
+        # Rolling slots: a circular buffer's slot<->position mapping
+        # depends on the writer's history, so cached rings are not
+        # spliceable per-prefix — the cache is simply off.
+        self.prefix_cache = (None if self.rolling
+                             else PrefixCache(prefix_cache_size))
+        self.cycle_seconds = {
+            "prefill": BucketHistogram(),
+            "decode": BucketHistogram(),
+        }
+        if not self.rolling:
+            self._prefill_keep = jax.jit(
+                lambda params, state, slot, prompt, temp, key:
+                prefill_slot_keep(cfg, params, state, slot, prompt,
+                                  temp, key),
+                donate_argnums=(1,))
+            self._extend = jax.jit(
+                lambda params, state, slot, cache, suffix, temp, key:
+                extend_slot(cfg, params, state, slot, cache, suffix,
+                            temp, key),
+                donate_argnums=(1,))
+            self._adopt = jax.jit(adopt_slot, donate_argnums=(0,))
+
+    # ------------------------------------------------------ submission
+    def submit(self, *args, **kwargs):
+        # The inherited batch API is closed off: submit()'s _next_id
+        # would collide with _rid-allocated stream ids (cross-wired
+        # _results) and run() would fight the scheduler thread for
+        # the donated device state.
+        raise RuntimeError(
+            "StreamingBatcher serves streams; use submit_stream()"
+        )
+
+    def run(self):
+        raise RuntimeError(
+            "StreamingBatcher serves streams; the Scheduler drives "
+            "step_cycle() (tests can use drain())"
+        )
+
+    def submit_stream(self, prompt, sink: Sink,
+                      max_new_tokens: int = 128,
+                      temperature: float = 0.0,
+                      rng: jax.Array | None = None) -> int:
+        """Thread-safe streaming submit: validates like the batch
+        ``submit`` (same capacity/rng contract), attaches ``sink`` and
+        queues for the scheduler. Raises :class:`QueueFull` when the
+        admission inbox is at capacity."""
+        req = self._build_request(-1, prompt, max_new_tokens,
+                                  temperature, rng)
+        req["sink"] = sink
+        return self._enqueue(req)
+
+    # ------------------------------------------------------ scheduling
+    def step_cycle(self) -> bool:
+        """One scheduler cycle: move the inbox, apply a staged swap
+        once in-flight slots drained, admit up to
+        ``prefill_per_cycle`` prompts, then one decode chunk for every
+        active slot. Returns False when fully idle (nothing queued,
+        staged or active)."""
+        for req in self._take_inbox():
+            self._queue.append(req)
+        staged = self._staged_params()
+        if staged is not None:
+            self.draining = True
+            if not any(s is not None for s in self._slots):
+                self.params = staged
+                self._consume_staged(staged)
+                if self.prefix_cache is not None:
+                    # Cached KV was computed by the OLD weights; mixing
+                    # it with new weights would serve silent garbage.
+                    self.prefix_cache.clear()
+                self.swaps_total += 1
+                self.draining = False
+        else:
+            started = time.monotonic()
+            if self._admit_capped():
+                self.cycle_seconds["prefill"].observe(
+                    time.monotonic() - started)
+        if not any(s is not None for s in self._slots):
+            with self._lock:
+                busy = (bool(self._queue) or bool(self._inbox)
+                        or self._pending_params is not None)
+            return busy
+        started = time.monotonic()
+        keys = self._chunk_keys()
+        self.state, toks = self._chunk(self.params, self.state, keys)
+        toks = jax.device_get(toks)  # (step_chunk, B)
+        for row in toks:
+            for slot, req in enumerate(self._slots):
+                if req is None or req["done"]:
+                    continue
+                token = int(row[slot])
+                self._results[req["id"]].append(token)
+                self._emit(req, {"token": token})
+                self._check_done(req, token)
+        self.cycle_seconds["decode"].observe(time.monotonic() - started)
+        for slot, req in enumerate(self._slots):
+            if req is not None and req["done"]:
+                self._finish(req)
+                self._free(slot)
+        return True
+
+    def _admit_capped(self) -> int:
+        admitted = 0
+        while self._queue and admitted < self.prefill_per_cycle:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                break
+            req = self._queue.popleft()
+            self._note_admitted()
+            first = self._prefill_into(free, req)
+            admitted += 1
+            self._results[req["id"]] = [first]
+            self._slots[free] = req
+            self._emit(req, {"token": first})
+            self._check_done(req, first)
+            if req["done"]:
+                self._finish(req)
+                self._free(free)
+        return admitted
+
+    def _prefill_into(self, slot: int, req: dict) -> int:
+        prompt = req["prompt"]
+        temp = jnp.float32(req["temp"])
+        key = req["first_key"]
+        if self.prefix_cache is None:
+            self.state, first = self._prefill(
+                self.params, self.state, jnp.int32(slot),
+                jnp.asarray([prompt], jnp.int32), temp, key)
+            return int(first)
+        entry, plen = self.prefix_cache.lookup(tuple(prompt))
+        if entry is None:
+            self.state, first, cache, logits = self._prefill_keep(
+                self.params, self.state, jnp.int32(slot),
+                jnp.asarray([prompt], jnp.int32), temp, key)
+            self.prefix_cache.put(prompt, CacheEntry(cache, logits))
+            req["cache_hit"] = False
+            return int(first)
+        req["cache_hit"] = True
+        if plen == len(prompt):
+            self.state, first = self._adopt(
+                self.state, jnp.int32(slot), entry.cache, entry.logits,
+                temp, key)
+            return int(first)
+        suffix = jnp.asarray([prompt[plen:]], jnp.int32)
+        self.state, first, cache, logits = self._extend(
+            self.params, self.state, jnp.int32(slot), entry.cache,
+            suffix, temp, key)
+        self.prefix_cache.put(prompt, CacheEntry(cache, logits))
+        return int(first)
+
+    def _finish(self, req: dict) -> None:
+        # pop, not get: run() drains once and returns the dict, but the
+        # gateway cycles forever — keeping every finished request's
+        # token list would leak until the pod OOMs.
+        tokens = self._results.pop(req["id"], [])
+        reason = ("eos" if (self.eos is not None and tokens
+                            and tokens[-1] == self.eos) else "length")
+        self._emit(req, {"done": True, "reason": reason,
+                         "tokens": list(tokens),
+                         "cache_hit": bool(req.get("cache_hit"))})
+
+    def drain(self, max_cycles: int = 10_000) -> None:
+        """Run cycles until idle (tests / batch use)."""
+        for _ in range(max_cycles):
+            if not self.step_cycle():
+                return
+        raise RuntimeError("engine did not drain")
+
+
+class GenerateFallbackEngine(_EngineBase):
+    """Serialized ``generate()`` engine for models the batcher refuses
+    (MoE decode). One request at a time on the scheduler thread —
+    no slots, no interleaving — but the gateway-facing surface is
+    identical: bounded inbox, streamed sinks, staged swap, metered
+    cycles. Time-to-first-token degrades to full-generation latency;
+    that is the documented cost of the fallback, not a bug."""
+
+    batched = False
+
+    def __init__(self, cfg: LMConfig, params, max_len: int,
+                 eos_token: int | None = None, max_pending: int = 64):
+        super().__init__(max_pending=max_pending)
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.eos = eos_token
+        self.swaps_total = 0
+        self.draining = False
+        self.prefix_cache = None
+        self._backlog: deque = deque()
+        self.cycle_seconds = {
+            "prefill": BucketHistogram(),
+            "decode": BucketHistogram(),
+        }
+
+    def submit_stream(self, prompt, sink: Sink,
+                      max_new_tokens: int = 128,
+                      temperature: float = 0.0,
+                      rng: jax.Array | None = None) -> int:
+        prompt = check_request_contract(prompt, max_new_tokens,
+                                        temperature, rng)
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.max_len}"
+            )
+        req = {"prompt": prompt, "budget": max_new_tokens,
+               "temp": float(temperature), "rng": rng, "sink": sink}
+        return self._enqueue(req)
+
+    def step_cycle(self) -> bool:
+        for req in self._take_inbox():
+            self._backlog.append(req)
+        staged = self._staged_params()
+        if staged is not None:
+            # No slots to drain: between requests IS drained.
+            self.params = staged
+            self._consume_staged(staged)
+            self.swaps_total += 1
+        if not self._backlog:
+            return False
+        req = self._backlog.popleft()
+        self._note_admitted()
+        started = time.monotonic()
+        from kubeflow_tpu.models.decoding import generate
+
+        out = generate(self.cfg, self.params,
+                       jnp.asarray([req["prompt"]], jnp.int32),
+                       req["budget"], temperature=req["temp"],
+                       rng=req["rng"])
+        tokens = [int(t) for t in jax.device_get(out[0])]
+        if self.eos is not None and self.eos in tokens:
+            tokens = tokens[: tokens.index(self.eos) + 1]
+        self.cycle_seconds["decode"].observe(time.monotonic() - started)
+        for token in tokens:
+            self._emit(req, {"token": token})
+        reason = ("eos" if (self.eos is not None and tokens
+                            and tokens[-1] == self.eos) else "length")
+        self._emit(req, {"done": True, "reason": reason,
+                         "tokens": tokens, "cache_hit": False})
+        return True
+
+    def drain(self, max_cycles: int = 10_000) -> None:
+        for _ in range(max_cycles):
+            if not self.step_cycle():
+                return
+        raise RuntimeError("engine did not drain")
+
+
+def make_engine(cfg: LMConfig, params, max_batch: int = 8,
+                max_len: int = 2048, eos_token: int | None = None,
+                step_chunk: int = 8, quantize_cache: bool = False,
+                prefill_per_cycle: int = 2, max_pending: int = 64,
+                prefix_cache_size: int = 8):
+    """Best engine the model supports: the streaming batcher, or the
+    serialized ``generate()`` fallback when the batcher refuses the
+    config (MoE decode) — the gateway keeps serving either way."""
+    try:
+        return StreamingBatcher(
+            cfg, params, max_batch=max_batch, max_len=max_len,
+            eos_token=eos_token, step_chunk=step_chunk,
+            quantize_cache=quantize_cache,
+            prefill_per_cycle=prefill_per_cycle,
+            max_pending=max_pending,
+            prefix_cache_size=prefix_cache_size)
+    except NotImplementedError as exc:
+        log.warning(
+            "continuous batching unavailable (%s); serving through "
+            "the serialized generate() fallback", exc)
+        return GenerateFallbackEngine(
+            cfg, params, max_len=max_len, eos_token=eos_token,
+            max_pending=max_pending)
+
+
+class Scheduler:
+    """The scheduler thread: drives ``engine.step_cycle()`` and parks
+    on the engine's wake event when idle. One per engine; the engine's
+    device state is only ever touched from this thread."""
+
+    def __init__(self, engine, idle_wait_s: float = 0.02,
+                 max_consecutive_failures: int = 25):
+        self.engine = engine
+        self.idle_wait_s = idle_wait_s
+        # Past this many back-to-back cycle failures the scheduler is
+        # considered WEDGED (a deterministic fault — device OOM, state
+        # poisoned by a failed donated dispatch — not a poisoned
+        # request): `healthy` flips false so the gateway's /readyz
+        # fails and the orchestrator restarts the pod, instead of a
+        # live thread serving nothing forever.
+        self.max_consecutive_failures = max_consecutive_failures
+        self.consecutive_failures = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self.engine.step_cycle()
+            except Exception:
+                # A poisoned request must not take down the serving
+                # loop every other stream depends on. Park a beat
+                # (not wait_for_work — a set wake event would return
+                # immediately and spin the failure hot).
+                log.exception("serving scheduler cycle failed")
+                self.consecutive_failures += 1
+                self._stop.wait(self.idle_wait_s)
+                continue
+            self.consecutive_failures = 0
+            if not worked:
+                self.engine.wait_for_work(self.idle_wait_s)
+
+    def start(self) -> "Scheduler":
+        self._thread = threading.Thread(
+            target=self._run, name="serving-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def healthy(self) -> bool:
+        """Alive AND not wedged — what readiness must gate on."""
+        return (self.alive and self.consecutive_failures
+                < self.max_consecutive_failures)

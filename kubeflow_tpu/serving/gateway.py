@@ -1,0 +1,438 @@
+"""HTTP gateway: SSE token streaming over the serving engine.
+
+The data-plane frontend of an InferenceService pod (stdlib
+``http.server`` threads — the platform's no-new-deps discipline; the
+webhook and manager servers set the pattern):
+
+- ``POST /v1/generate`` — body ``{"prompt": [ints],
+  "max_new_tokens": n, "temperature": t, "seed": s, "stream": bool}``.
+  With ``stream`` (the default) the response is ``text/event-stream``:
+  one ``data: {"token": t, "index": i}`` frame per token as the
+  scheduler produces it, then a terminal ``event: done`` frame
+  carrying the full token list, finish reason and prefix-cache
+  verdict. ``stream: false`` returns one JSON object after the last
+  token. Sampling follows ``generate``'s contract: ``seed`` is
+  required iff ``temperature > 0`` (the server never invents
+  entropy).
+- **Admission control**: the engine's bounded inbox is the admission
+  queue; on :class:`~kubeflow_tpu.serving.engine.QueueFull` the
+  gateway sheds with ``429`` + ``Retry-After`` instead of queueing
+  unboundedly — the load-shedding contract the chaos-tier client
+  already honours.
+- ``POST /v1/admin/swap`` — runs the configured ``reload_fn`` (e.g. a
+  ``CheckpointManager.restore_latest_valid`` closure) and stages the
+  returned params on the engine; the scheduler re-points between
+  cycles after draining in-flight slots.
+- ``GET /metrics`` — Prometheus exposition on the canonical label
+  schema: ``inference_request_duration_seconds{outcome}``,
+  ``inference_ttft_seconds``, ``inference_tokens_total{kind}``,
+  ``inference_queue_depth``, ``inference_prefix_cache_total{outcome}``,
+  ``inference_batch_cycle_seconds{phase}``, ``inference_shed_total``,
+  ``inference_model_swap_total``.
+- Every request runs in a span parented on an incoming
+  ``traceparent`` header, so a request's prefill/decode latency lands
+  in the same trace as whatever upstream created it.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.parse
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client.core import (
+    CounterMetricFamily,
+    HistogramMetricFamily,
+)
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.obs.metrics import LATENCY_BUCKETS
+from kubeflow_tpu.serving.engine import QueueFull, Scheduler
+
+log = logging.getLogger(__name__)
+
+
+class EngineCollector:
+    """Engine-side counters/histograms rendered at scrape time — the
+    engine is prometheus-free (obs.BucketHistogram only), the same
+    split the k8s client uses via ClientResilienceCollector."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        cache = getattr(self.engine, "prefix_cache", None)
+        fam = CounterMetricFamily(
+            "inference_prefix_cache",
+            "Prefill prefix-cache lookups by outcome",
+            labels=["outcome"],
+        )
+        fam.add_metric(["hit"], cache.hits if cache is not None else 0)
+        fam.add_metric(["miss"], cache.misses if cache is not None else 0)
+        yield fam
+        yield CounterMetricFamily(
+            "inference_model_swap",
+            "Hot model swaps applied by the scheduler",
+            value=getattr(self.engine, "swaps_total", 0),
+        )
+        fam = HistogramMetricFamily(
+            "inference_batch_cycle_seconds",
+            "Scheduler cycle wall time by phase (prefill = admissions "
+            "this cycle, decode = one step_chunk dispatch + trim)",
+            labels=["phase"],
+        )
+        for phase, hist in sorted(self.engine.cycle_seconds.items()):
+            snap = hist.snapshot()
+            fam.add_metric([phase], buckets=snap["buckets"],
+                           sum_value=snap["sum"])
+        yield fam
+
+
+class GatewayMetrics:
+    """The gateway-side registry (request-path metrics) + the engine
+    collector. Labels stay inside obs.CANONICAL_LABELS — asserted by
+    the serving gate."""
+
+    def __init__(self, engine):
+        self.registry = CollectorRegistry()
+        self.registry.register(EngineCollector(engine))
+        self.request_duration = Histogram(
+            "inference_request_duration_seconds",
+            "Wall time of one /v1/generate request, arrival to last "
+            "byte (outcome: ok, shed, bad_request, error, timeout, "
+            "disconnect)",
+            ["outcome"],
+            registry=self.registry,
+            buckets=LATENCY_BUCKETS,
+        )
+        self.ttft = Histogram(
+            "inference_ttft_seconds",
+            "Time from request arrival to the first streamed token",
+            registry=self.registry,
+            buckets=LATENCY_BUCKETS,
+        )
+        self.tokens_total = Counter(
+            "inference_tokens",
+            "Tokens through the gateway (kind: prompt = received, "
+            "generated = streamed out)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.shed_total = Counter(
+            "inference_shed",
+            "Requests shed with 429 because the admission queue was "
+            "full",
+            registry=self.registry,
+        )
+        self.queue_depth = Gauge(
+            "inference_queue_depth",
+            "Requests admitted by the gateway but not yet scheduled "
+            "onto compute",
+            registry=self.registry,
+        )
+        self.queue_depth.set_function(engine.pending)
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class InferenceGateway:
+    """Threaded HTTP server + scheduler thread over one engine.
+
+    ``reload_fn`` (optional) powers ``POST /v1/admin/swap``: a
+    zero-arg callable returning ``(params, info_dict)`` — typically a
+    closure over ``CheckpointManager.restore_latest_valid``. The
+    admin route is unauthenticated and must only be exposed
+    pod-locally (the operations doc carries the warning)."""
+
+    def __init__(self, engine, port: int = 0,
+                 retry_after_s: float = 1.0,
+                 reload_fn=None,
+                 stream_timeout_s: float = 120.0):
+        self.engine = engine
+        self.metrics = GatewayMetrics(engine)
+        self.scheduler = Scheduler(engine)
+        self.reload_fn = reload_fn
+        self.retry_after_s = retry_after_s
+        self.stream_timeout_s = stream_timeout_s
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # SSE: every token frame must hit the wire now, not after
+            # Nagle + delayed-ACK (~40ms/frame — k8s/client.py).
+            disable_nagle_algorithm = True
+
+            def log_message(self, fmt, *args):
+                log.debug("gateway: " + fmt, *args)
+
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = urllib.parse.urlsplit(self.path).path
+                if path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                elif path == "/readyz":
+                    # healthy, not alive: a wedged scheduler (cycles
+                    # failing back-to-back) must fail readiness so the
+                    # orchestrator restarts the pod.
+                    ok = outer.scheduler.healthy
+                    self._json(200 if ok else 503,
+                               {"ready": bool(ok)})
+                elif path == "/metrics":
+                    body = outer.metrics.exposition()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/v1/status":
+                    self._json(200, outer.status())
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = urllib.parse.urlsplit(self.path).path
+                if path == "/v1/generate":
+                    outer._handle_generate(self)
+                elif path == "/v1/admin/swap":
+                    outer._handle_swap(self)
+                else:
+                    self._json(404, {"error": "not found"})
+
+        self._server = http.server.ThreadingHTTPServer(("", port),
+                                                       Handler)
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def status(self) -> dict:
+        return {
+            "pending": self.engine.pending(),
+            "batched": bool(getattr(self.engine, "batched", False)),
+            "draining": bool(getattr(self.engine, "draining", False)),
+            "swaps": int(getattr(self.engine, "swaps_total", 0)),
+        }
+
+    def start(self) -> "InferenceGateway":
+        self.scheduler.start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="inference-gateway",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.scheduler.stop()
+
+    # ------------------------------------------------------ handlers
+    def _handle_swap(self, handler) -> None:
+        if self.reload_fn is None:
+            handler._json(404, {"error": "no reload_fn configured"})
+            return
+        try:
+            params, info = self.reload_fn()
+        except Exception as exc:
+            log.exception("model reload failed")
+            handler._json(500, {"error": f"reload failed: {exc}"})
+            return
+        if params is None:
+            handler._json(409, {"error": "no valid checkpoint to load",
+                                "info": info})
+            return
+        self.engine.swap_params(params)
+        handler._json(200, {"staged": True, "info": info})
+
+    def _read_request(self, handler) -> dict | None:
+        length = int(handler.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(handler.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    def _handle_generate(self, handler) -> None:
+        started = time.monotonic()
+        parent = obs.parse_traceparent(
+            handler.headers.get("traceparent"))
+        tracer = obs.get_tracer()
+        with tracer.span(
+            "inference /v1/generate",
+            parent=parent,
+            attributes={"method": "POST", "endpoint": "/v1/generate"},
+        ) as span:
+            outcome = self._generate_into(handler, span, started)
+            if outcome not in ("ok",):
+                span.status = "error"
+            span.set_attribute("outcome", outcome)
+        self.metrics.request_duration.labels(outcome).observe(
+            time.monotonic() - started)
+
+    def _generate_into(self, handler, span, started: float) -> str:
+        """Parse, admit, stream; returns the outcome label. Sends
+        exactly one HTTP response on every path."""
+        body = self._read_request(handler)
+        if body is None:
+            handler._json(400, {"error": "body must be a JSON object"})
+            return "bad_request"
+        prompt = body.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            handler._json(
+                400, {"error": "prompt must be a non-empty list of "
+                               "token ids"})
+            return "bad_request"
+        stream = bool(body.get("stream", True))
+        try:
+            # Scalar coercions are part of request validation: a
+            # non-numeric temperature/seed/max_new_tokens must be a
+            # JSON 400, not a dropped connection.
+            max_new = int(body.get("max_new_tokens", 128))
+            temperature = float(body.get("temperature", 0.0))
+            rng = None
+            if temperature > 0.0:
+                seed = body.get("seed")
+                if seed is None:
+                    handler._json(
+                        400, {"error": "temperature > 0 requires a "
+                                       "client seed (the server never "
+                                       "invents sampling entropy)"})
+                    return "bad_request"
+                import jax
+
+                rng = jax.random.key(int(seed))
+        except (TypeError, ValueError) as exc:
+            handler._json(400, {"error": f"bad request field: {exc}"})
+            return "bad_request"
+        events: queue.Queue = queue.Queue()
+        try:
+            rid = self.engine.submit_stream(
+                prompt, events.put, max_new_tokens=max_new,
+                temperature=temperature, rng=rng)
+        except QueueFull:
+            self.metrics.shed_total.inc()
+            span.add_event("shed", {"pending": self.engine.pending()})
+            handler._json(
+                429, {"error": "admission queue full; retry later"},
+                headers={"Retry-After":
+                         str(max(1, int(self.retry_after_s)))})
+            return "shed"
+        except (TypeError, ValueError) as exc:
+            handler._json(400, {"error": str(exc)})
+            return "bad_request"
+        span.set_attribute("request_id", rid)
+        span.set_attribute("prompt_tokens", len(prompt))
+        self.metrics.tokens_total.labels("prompt").inc(len(prompt))
+        if stream:
+            return self._stream_events(handler, span, events, started)
+        return self._collect_events(handler, span, events, started)
+
+    def _next_event(self, events: queue.Queue) -> dict | None:
+        try:
+            return events.get(timeout=self.stream_timeout_s)
+        except queue.Empty:
+            return None
+
+    def _stream_events(self, handler, span, events: queue.Queue,
+                       started: float) -> str:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-store")
+        handler.end_headers()
+        index = 0
+        try:
+            while True:
+                event = self._next_event(events)
+                if event is None:
+                    # Engine stalled past the stream timeout: the SSE
+                    # headers are gone already, so all we can do is
+                    # close the stream without a done frame.
+                    span.add_event("stream_timeout", {"index": index})
+                    return "timeout"
+                if "token" in event:
+                    if index == 0:
+                        self.metrics.ttft.observe(
+                            time.monotonic() - started)
+                        span.add_event("first_token")
+                    frame = json.dumps(
+                        {"token": event["token"], "index": index})
+                    handler.wfile.write(
+                        f"data: {frame}\n\n".encode())
+                    handler.wfile.flush()
+                    self.metrics.tokens_total.labels("generated").inc()
+                    index += 1
+                if event.get("done"):
+                    payload = json.dumps({
+                        "reason": event.get("reason"),
+                        "tokens": event.get("tokens", []),
+                        "cache_hit": bool(event.get("cache_hit")),
+                    })
+                    handler.wfile.write(
+                        f"event: done\ndata: {payload}\n\n".encode())
+                    handler.wfile.flush()
+                    span.set_attribute("generated_tokens", index)
+                    return "ok"
+        except (BrokenPipeError, ConnectionResetError):
+            # Client hung up mid-stream; the engine finishes the slot
+            # and the remaining tokens land in a queue nobody reads —
+            # bounded by the request budget, then garbage-collected.
+            span.add_event("client_disconnected", {"index": index})
+            return "disconnect"
+
+    def _collect_events(self, handler, span, events: queue.Queue,
+                        started: float) -> str:
+        first_at: float | None = None
+        try:
+            while True:
+                event = self._next_event(events)
+                if event is None:
+                    handler._json(504,
+                                  {"error": "generation timed out"})
+                    return "timeout"
+                if "token" in event and first_at is None:
+                    first_at = time.monotonic()
+                    self.metrics.ttft.observe(first_at - started)
+                if event.get("done"):
+                    tokens = event.get("tokens", [])
+                    self.metrics.tokens_total.labels("generated").inc(
+                        len(tokens))
+                    span.set_attribute("generated_tokens", len(tokens))
+                    handler._json(200, {
+                        "tokens": tokens,
+                        "reason": event.get("reason"),
+                        "cache_hit": bool(event.get("cache_hit")),
+                    })
+                    return "ok"
+        except (BrokenPipeError, ConnectionResetError):
+            # Client closed the socket before the response landed —
+            # same accounting as a mid-SSE hangup.
+            span.add_event("client_disconnected")
+            return "disconnect"

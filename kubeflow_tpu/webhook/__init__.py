@@ -11,6 +11,7 @@ jax.distributed on a slice.
 from kubeflow_tpu.webhook.server import (
     AdmissionHandler,
     WebhookServer,
+    inference_env_poddefault,
     register_with_fake,
     tpu_env_poddefault,
 )
@@ -18,6 +19,7 @@ from kubeflow_tpu.webhook.server import (
 __all__ = [
     "AdmissionHandler",
     "WebhookServer",
+    "inference_env_poddefault",
     "register_with_fake",
     "tpu_env_poddefault",
 ]

@@ -597,3 +597,40 @@ def tpu_env_poddefault(namespace: str) -> dict:
             ],
         },
     }
+
+
+def inference_env_poddefault(
+    namespace: str,
+    model_dir: str = "/home/jovyan/checkpoints",
+    max_batch: int = 8,
+    max_len: int = 2048,
+) -> dict:
+    """The serving-side PodDefault: InferenceService pods (the
+    controller labels them ``inference-env: "true"``) get the
+    namespace-wide gateway env — model directory and batching limits —
+    injected at admission, ALONGSIDE the checkpoint vars from
+    :func:`tpu_env_poddefault` (the controller also stamps
+    ``tpu-env``). The controller deliberately does not set THESE env
+    vars itself (namespace defaults live in one PodDefault, and the
+    conflict-checked merge would reject pods if both sides disagreed);
+    the split runs the other way for ``KFT_SERVING_PORT``, which is
+    per-CR and controller-owned — it must never appear here.
+    ``kubeflow_tpu.serving.__main__`` is the in-pod consumer."""
+    return {
+        "apiVersion": PODDEFAULT_API,
+        "kind": "PodDefault",
+        "metadata": {"name": "inference-env", "namespace": namespace},
+        "spec": {
+            "desc": "Configure the inference gateway environment",
+            "selector": {"matchLabels": {"inference-env": "true"}},
+            "env": [
+                # The checkpoint root the hot-swap reload watches —
+                # same PVC path the training PodDefault checkpoints to,
+                # so a train-then-serve namespace works out of the box.
+                {"name": "KFT_SERVING_MODEL_DIR", "value": model_dir},
+                {"name": "KFT_SERVING_MAX_BATCH",
+                 "value": str(max_batch)},
+                {"name": "KFT_SERVING_MAX_LEN", "value": str(max_len)},
+            ],
+        },
+    }

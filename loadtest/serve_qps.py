@@ -1,0 +1,230 @@
+"""Closed-loop QPS load harness for the inference gateway.
+
+The serving analogue of start_notebooks.py: N closed-loop clients
+(each issues the next request the moment its stream completes) drive
+``POST /v1/generate`` and capture the two serving SLO numbers the
+platform optimises for — time-to-first-token (arrival of the first
+SSE data frame) and end-to-end stream time — plus aggregate
+tokens/sec; the summary prints as one JSON line with p50/p99.
+
+Modes:
+
+- ``--url http://host:port`` — drive an already-running gateway (a
+  deployed InferenceService endpoint).
+- default — start an in-process gateway on a small CPU model and
+  drive it over real HTTP sockets: the full wire path (admission,
+  SSE framing, shedding) with no cluster needed.
+- ``--smoke`` — the tier-1 fast preset of the in-process mode (tiny
+  model, handful of requests); tests/test_inference.py runs it.
+
+429 responses are honoured closed-loop: the client waits the served
+``Retry-After`` and retries the same request (counted in ``shed``).
+
+Usage:
+  python -m loadtest.serve_qps --clients 8 --requests 64
+  python -m loadtest.serve_qps --url http://llm.team-a.svc:8800
+  python -m loadtest.serve_qps --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from loadtest.start_notebooks import percentile  # noqa: E402
+
+
+def stream_one(url: str, prompt: list[int], max_new: int,
+               timeout: float) -> dict:
+    """One greedy /v1/generate stream; returns ttft_s/total_s/tokens/
+    shed counts. Retries through 429 + Retry-After (closed-loop
+    clients honour shedding; that IS the protocol under test)."""
+    data = json.dumps({"prompt": prompt,
+                       "max_new_tokens": max_new}).encode()
+    shed = 0
+    while True:
+        started = time.monotonic()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            response = urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 429:
+                shed += 1
+                time.sleep(float(exc.headers.get("Retry-After", "1")))
+                continue
+            raise
+        ttft = None
+        tokens = 0
+        done = None
+        with response:
+            event = None
+            for raw in response:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    event = line[len("event: "):]
+                elif line.startswith("data: "):
+                    payload = json.loads(line[len("data: "):])
+                    if event == "done":
+                        done = payload
+                        break
+                    if ttft is None:
+                        ttft = time.monotonic() - started
+                    tokens += 1
+                elif not line:
+                    event = None
+        return {
+            "ttft_s": ttft if ttft is not None else float("nan"),
+            "total_s": time.monotonic() - started,
+            "tokens": tokens,
+            "shed": shed,
+            "cache_hit": bool(done and done.get("cache_hit")),
+        }
+
+
+def run_load(url: str, prompts: list[list[int]], clients: int,
+             total_requests: int, max_new: int,
+             timeout: float) -> dict:
+    """Closed loop: ``clients`` threads pull request indices off one
+    counter until ``total_requests`` streams completed."""
+    lock = threading.Lock()
+    state = {"next": 0}
+    results: list[dict] = []
+    errors: list[str] = []
+
+    def worker():
+        while True:
+            with lock:
+                index = state["next"]
+                if index >= total_requests:
+                    return
+                state["next"] = index + 1
+            prompt = prompts[index % len(prompts)]
+            try:
+                out = stream_one(url, prompt, max_new, timeout)
+            # analysis: allow[py-broad-except] — recorded in the summary
+            except Exception as exc:
+                with lock:
+                    errors.append(f"request {index}: {exc}")
+                # Don't hammer a failing endpoint at closed-loop
+                # speed: pause a beat before taking the next index.
+                time.sleep(0.1)
+                continue
+            with lock:
+                results.append(out)
+
+    started = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    wall = time.monotonic() - started
+    ttfts = sorted(r["ttft_s"] for r in results
+                   if r["ttft_s"] == r["ttft_s"])  # NaN-free
+    totals = sorted(r["total_s"] for r in results)
+    tokens = sum(r["tokens"] for r in results)
+    return {
+        "metric": "inference_gateway_load",
+        "count": len(results),
+        "errors": errors,
+        "wall_s": round(wall, 4),
+        "qps": round(len(results) / wall, 3) if wall else 0.0,
+        "tokens_per_s": round(tokens / wall, 2) if wall else 0.0,
+        "ttft_p50_s": round(percentile(ttfts, 0.50), 4),
+        "ttft_p99_s": round(percentile(ttfts, 0.99), 4),
+        "total_p50_s": round(percentile(totals, 0.50), 4),
+        "total_p99_s": round(percentile(totals, 0.99), 4),
+        "shed": sum(r["shed"] for r in results),
+        "cache_hits": sum(1 for r in results if r["cache_hit"]),
+    }
+
+
+def start_local_gateway(vocab: int, prompt_len: int, max_batch: int,
+                        max_pending: int):
+    """In-process tiny-model gateway on a real socket (imports jax
+    lazily so --url mode stays light)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+    from kubeflow_tpu.serving.engine import StreamingBatcher
+    from kubeflow_tpu.serving.gateway import InferenceGateway
+
+    cfg = LMConfig(vocab=vocab, layers=2, dim=64, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16)
+    model = build_lm(cfg, use_flash=False)
+    params = create_lm_state(model, jax.random.key(0),
+                             (1, prompt_len)).params
+    engine = StreamingBatcher(
+        cfg, params, max_batch=max_batch,
+        max_len=max(64, 4 * prompt_len), max_pending=max_pending)
+    return InferenceGateway(engine, port=0).start()
+
+
+def build_prompts(count: int, prompt_len: int, vocab: int,
+                  seed: int) -> list[list[int]]:
+    """Distinct prompts plus one shared-prefix pair so a load run also
+    exercises the prefix cache."""
+    import random
+
+    rng = random.Random(seed)
+    prompts = [
+        [rng.randrange(1, vocab) for _ in range(prompt_len)]
+        for _ in range(count)
+    ]
+    if count >= 2:
+        prompts[1] = prompts[0] + [rng.randrange(1, vocab)]
+    return prompts
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--url", default=None,
+                        help="target gateway (default: in-process)")
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--requests", type=int, default=32)
+    parser.add_argument("--max-new", type=int, default=16)
+    parser.add_argument("--prompt-len", type=int, default=12)
+    parser.add_argument("--prompts", type=int, default=8,
+                        help="distinct prompt count")
+    parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1 preset: tiny everything")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.clients, args.requests = 2, 6
+        args.max_new, args.prompt_len, args.prompts = 6, 8, 3
+    vocab = 128
+    prompts = build_prompts(args.prompts, args.prompt_len, vocab,
+                            args.seed)
+    gateway = None
+    url = args.url
+    if url is None:
+        gateway = start_local_gateway(
+            vocab, args.prompt_len, max_batch=4,
+            max_pending=max(64, args.requests))
+        url = f"http://127.0.0.1:{gateway.port}"
+    try:
+        summary = run_load(url, prompts, args.clients, args.requests,
+                           args.max_new, args.timeout)
+    finally:
+        if gateway is not None:
+            gateway.stop()
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

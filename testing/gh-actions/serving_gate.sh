@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Serving gate for CI (PR 6). Three checks:
+#
+# 1. Fast serving subset: the InferenceService controller + gateway
+#    suite and the int8-KV parity tests (tier-1 members, so the gate
+#    holds even where CI doesn't run).
+#
+# 2. Metrics schema: the gateway registry (request metrics + the
+#    engine collector) must parse cleanly and use only the canonical
+#    label schema (kubeflow_tpu.obs.CANONICAL_LABELS) — checked on a
+#    stub engine so the schema check needs no jax/model.
+#
+# 3. Static analysis: kubeflow_tpu/serving/ must be at ZERO findings
+#    under every pack — including the PR-5 SPMD/concurrency dataflow
+#    packs, with no pragma budget: the gateway's scheduler thread and
+#    swap staging are exactly what conc-unlocked-shared-write exists
+#    for.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== serving gate: serving subset (incl. slow-marked) =="
+# No 'not slow' filter: the gate owns the serving tests tier-1 skips
+# for time (eos/non-stream framing, MoE fallback).
+python -m pytest tests/test_inference.py \
+  "tests/test_serving.py::TestInt8KVCache" \
+  -q -p no:cacheprovider
+
+echo "== serving gate: gateway metrics schema =="
+python - <<'PY'
+from prometheus_client import generate_latest
+from prometheus_client.parser import text_string_to_metric_families
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.obs.metrics import BucketHistogram
+from kubeflow_tpu.serving.gateway import GatewayMetrics
+
+
+class StubEngine:
+    """Just the surface GatewayMetrics reads — no model, no jax."""
+
+    swaps_total = 0
+    prefix_cache = None
+
+    def __init__(self):
+        self.cycle_seconds = {
+            "prefill": BucketHistogram(),
+            "decode": BucketHistogram(),
+        }
+
+    def pending(self):
+        return 0
+
+
+metrics = GatewayMetrics(StubEngine())
+text = generate_latest(metrics.registry).decode()
+failures = []
+families = list(text_string_to_metric_families(text))
+names = [f.name for f in families]
+for name in sorted({n for n in names if names.count(n) > 1}):
+    failures.append(f"duplicate metric family {name!r}")
+for family in families:
+    for sample in family.samples:
+        bad = set(sample.labels) - obs.CANONICAL_LABELS
+        if bad:
+            failures.append(
+                f"{sample.name} uses non-canonical label(s) "
+                f"{sorted(bad)}"
+            )
+expected = {
+    "inference_request_duration_seconds",
+    "inference_ttft_seconds",
+    "inference_tokens",
+    "inference_queue_depth",
+    "inference_prefix_cache",
+    "inference_batch_cycle_seconds",
+    "inference_shed",
+    "inference_model_swap",
+}
+missing = expected - set(names)
+if missing:
+    failures.append(f"metric families missing: {sorted(missing)}")
+if failures:
+    print("\n".join(failures))
+    raise SystemExit(1)
+print(f"  gateway registry: {len(families)} families ok")
+PY
+
+echo "== serving gate: analysis packs at zero findings =="
+python -m kubeflow_tpu.analysis kubeflow_tpu/serving
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/serving"], check_emitted=False,
+))
+# No pragma budget, no baseline, not even warnings: serving must be
+# spotless under the dataflow packs.
+noisy = [f for f in findings if f.rule.startswith(("spmd-", "conc-"))]
+if noisy:
+    print("\n".join(f.render() for f in noisy))
+    raise SystemExit(1)
+print("  kubeflow_tpu/serving: clean under spmd/conc packs")
+PY
+
+echo "serving gate: OK"

@@ -1,0 +1,748 @@
+"""InferenceService control plane + SSE gateway data plane (PR 6).
+
+Controller: fake-apiserver reconcile → StatefulSet shape (TPU topology
+selectors, multi-host env, services), status propagation, observed-mesh
+preemption → all-or-nothing restart, chaos-schedule convergence.
+Gateway: SSE framing + the e2e acceptance contract (overlapping
+requests token-identical to ``generate()``, nonzero TTFT, prefix-cache
+hit for a shared-prefix pair), 429+Retry-After shedding, hot-swap
+drain, MoE fallback, loadtest smoke mode.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.chaos import (
+    ChaosApiServer,
+    FaultSchedule,
+    StatefulSetPodSimulator,
+    run_to_convergence,
+)
+from kubeflow_tpu.controllers.inference import (
+    INFERENCE_API,
+    OBSERVED_MESH_KEY,
+    PREEMPTION_RESTARTS_KEY,
+    RESTART_REASON_KEY,
+    desired_statefulset,
+    make_inference_controller,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+
+NS = "team-a"
+
+
+def make_cr(name="llm", tpu=True, port=None, **spec):
+    cr = {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {"modelDir": "/ckpts", **spec},
+    }
+    if tpu:
+        cr["spec"]["tpu"] = {"accelerator": "v5e", "topology": "4x4"}
+    if port is not None:
+        cr["spec"]["port"] = port
+    return cr
+
+
+class TestInferenceController:
+    def test_reconcile_emits_multihost_statefulset(self):
+        api = FakeApiServer()
+        ctrl = make_inference_controller(api)
+        api.create(make_cr())
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "llm", NS)
+        assert sts["spec"]["replicas"] == 4  # v5e 4x4 = 4 hosts
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        assert sts["spec"]["serviceName"] == "llm-hosts"
+        tpl = sts["spec"]["template"]
+        assert tpl["spec"]["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        container = tpl["spec"]["containers"][0]
+        assert container["resources"]["limits"] == {"google.com/tpu": "4"}
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        # The per-CR port is controller-owned env (the PodDefault must
+        # not set it, or a non-default port would conflict-reject).
+        assert env["KFT_SERVING_PORT"] == "8800"
+        assert env["KFT_NUM_PROCESSES"] == "4"
+        assert env["KFT_COORDINATOR_ADDRESS"] == (
+            "llm-0.llm-hosts.team-a.svc:8476"
+        )
+        assert "llm-3.llm-hosts.team-a.svc" in env["TPU_WORKER_HOSTNAMES"]
+        # PodDefault selectors: serving env + TPU slice env both inject.
+        labels = tpl["metadata"]["labels"]
+        assert labels["inference-env"] == "true"
+        assert labels["tpu-env"] == "true"
+        # Children carry ownerReferences for GC.
+        assert sts["metadata"]["ownerReferences"][0]["kind"] == (
+            "InferenceService"
+        )
+        headless = api.get("v1", "Service", "llm-hosts", NS)
+        assert headless["spec"]["clusterIP"] == "None"
+        assert headless["spec"]["publishNotReadyAddresses"] is True
+        front = api.get("v1", "Service", "llm", NS)
+        assert front["spec"]["ports"][0]["port"] == 8800
+        # The front service fans to every host (no rank-0 pin).
+        assert front["spec"]["selector"] == {"statefulset": "llm"}
+
+    def test_cpu_service_is_single_replica_without_selectors(self):
+        api = FakeApiServer()
+        ctrl = make_inference_controller(api)
+        api.create(make_cr(tpu=False))
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "llm", NS)
+        assert sts["spec"]["replicas"] == 1
+        tpl_spec = sts["spec"]["template"]["spec"]
+        assert "nodeSelector" not in tpl_spec
+        env = {e["name"] for e in tpl_spec["containers"][0]["env"]}
+        assert "KFT_COORDINATOR_ADDRESS" not in env
+
+    def test_status_propagation_to_running(self):
+        api = FakeApiServer()
+        prom = ControllerMetrics(api)
+        ctrl = make_inference_controller(api, prom=prom)
+        api.create(make_cr(port=9000))
+        ctrl.run_once()
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert cr["status"]["phase"] == "Pending"
+        assert cr["status"]["readyReplicas"] == 0
+        assert cr["status"]["endpoint"] == "http://llm.team-a.svc:9000"
+        sim = StatefulSetPodSimulator(api)
+        sim.step()
+        ctrl.run_once()
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert cr["status"]["phase"] == "Running"
+        assert cr["status"]["readyReplicas"] == 4
+        # Status writes are change-gated: a further no-op reconcile
+        # must not rewrite status (resourceVersion stays put).
+        rv = cr["metadata"].get("resourceVersion")
+        ctrl.run_once()
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert cr["metadata"].get("resourceVersion") == rv
+
+    def test_preemption_restarts_whole_slice_and_rebaselines(self):
+        api = FakeApiServer()
+        prom = ControllerMetrics(api)
+        ctrl = make_inference_controller(api, prom=prom)
+        api.create(make_cr())
+        ctrl.run_once()
+        sim = StatefulSetPodSimulator(api)
+        sim.step()
+        ctrl.run_once()  # baseline the observed mesh
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        anns = cr["metadata"]["annotations"]
+        assert set(json.loads(anns[OBSERVED_MESH_KEY])) == {
+            f"llm-{i}" for i in range(4)
+        }
+        # Preempt one worker: the simulator recreates it with a fresh
+        # uid — a replaced member of the observed mesh.
+        api.delete("v1", "Pod", "llm-1", NS)
+        sim.step()
+        ctrl.run_once()
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        anns = cr["metadata"]["annotations"]
+        assert "llm-1" in anns[RESTART_REASON_KEY]
+        assert anns[PREEMPTION_RESTARTS_KEY] == "1"
+        assert cr["status"]["phase"] == "Restarting"
+        assert cr["status"]["restartReason"]
+        # Every present pod was deleted in one pass (all-or-nothing).
+        assert api.list("v1", "Pod", namespace=NS) == []
+        events = api.list("v1", "Event", namespace=NS)
+        assert any(e["reason"] == "TPUWorkerPreempted" for e in events)
+        metric = prom.inference_preemption_restart_total.labels(NS)
+        assert metric._value.get() == 1
+        # The slice re-forms entirely fresh: re-baseline, back to
+        # Running, SliceRestarted recorded, marker cleared.
+        sim.step()
+        ctrl.run_once()
+        ctrl.run_once()
+        cr = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert cr["status"]["phase"] == "Running"
+        assert "restartReason" not in cr["status"]
+        assert RESTART_REASON_KEY not in (
+            cr["metadata"]["annotations"] or {}
+        )
+        events = api.list("v1", "Event", namespace=NS)
+        assert any(e["reason"] == "SliceRestarted" for e in events)
+
+    def test_deleted_cr_reconciles_to_noop(self):
+        api = FakeApiServer()
+        ctrl = make_inference_controller(api)
+        api.create(make_cr())
+        ctrl.run_once()
+        api.delete(INFERENCE_API, "InferenceService", "llm", NS)
+        ctrl.run_once()  # must not raise on the delete event
+
+    def test_drift_repair_restores_owned_fields(self):
+        api = FakeApiServer()
+        ctrl = make_inference_controller(api)
+        api.create(make_cr())
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "llm", NS)
+        sts["spec"]["replicas"] = 1  # drift
+        api.update(sts)
+        ctrl.run_once()
+        sts = api.get("apps/v1", "StatefulSet", "llm", NS)
+        assert sts["spec"]["replicas"] == 4
+
+    def test_converges_under_chaos_schedule(self):
+        """The reconcile path survives a seeded 5xx/conflict/latency
+        storm and still converges to the same desired state."""
+        schedule = (FaultSchedule(seed=23)
+                    .errors(0, 80, rate=0.3)
+                    .conflict_storm(0, 80, rate=0.2)
+                    .not_found_flaps(0, 40, rate=0.1))
+        fake = FakeApiServer()
+        chaos = ChaosApiServer(fake, schedule, sleep=lambda s: None)
+        fake.create(make_cr())
+        ctrl = make_inference_controller(chaos)
+        sim = StatefulSetPodSimulator(fake)
+        run_to_convergence([ctrl], [sim], max_rounds=400)
+        assert sum(chaos.injected.values()) > 0, "schedule never fired"
+        sts = fake.get("apps/v1", "StatefulSet", "llm", NS)
+        assert sts["spec"]["replicas"] == 4
+        cr = fake.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert cr["status"]["phase"] == "Running"
+        assert cr["status"]["readyReplicas"] == 4
+
+    def test_desired_statefulset_rejects_bad_topology(self):
+        from kubeflow_tpu.topology import TopologyError
+
+        cr = make_cr()
+        cr["spec"]["tpu"]["topology"] = "3x5"
+        with pytest.raises(TopologyError):
+            desired_statefulset(cr)
+
+    def test_invalid_spec_surfaces_failed_status_not_hot_loop(self):
+        """A typo'd topology is a permanent error: the CR gets
+        phase=Failed + an InvalidSpec event and the controller
+        settles (no rate-limited requeue, no status churn)."""
+        api = FakeApiServer()
+        ctrl = make_inference_controller(api)
+        cr = make_cr()
+        cr["spec"]["tpu"]["topology"] = "3x5"
+        api.create(cr)
+        ctrl.run_once()
+        got = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert got["status"]["phase"] == "Failed"
+        assert "3x5" in got["status"]["message"]
+        events = api.list("v1", "Event", namespace=NS)
+        assert any(e["reason"] == "InvalidSpec" for e in events)
+        with pytest.raises(NotFound):
+            api.get("apps/v1", "StatefulSet", "llm", NS)
+        # Settled: the status patch's own watch event must not keep
+        # rewriting status (change-gated) nor park a retry.
+        rv = got["metadata"].get("resourceVersion")
+        ctrl.run_once()
+        got = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert got["metadata"].get("resourceVersion") == rv
+        assert len(ctrl.queue) == 0
+        # Fixing the spec heals the CR: the stale error message must
+        # be cleared (merge-patch keeps absent keys otherwise).
+        got["spec"]["tpu"]["topology"] = "4x4"
+        api.update(got)
+        ctrl.run_once()
+        got = api.get(INFERENCE_API, "InferenceService", "llm", NS)
+        assert got["status"]["phase"] == "Pending"
+        assert "message" not in got["status"]
+        assert api.get("apps/v1", "StatefulSet", "llm", NS)
+
+
+class TestInferencePodDefault:
+    def test_webhook_injects_serving_env_alongside_checkpoint_vars(self):
+        from kubeflow_tpu.webhook.server import (
+            inference_env_poddefault,
+            register_with_fake,
+            tpu_env_poddefault,
+        )
+
+        api = FakeApiServer()
+        register_with_fake(api)
+        api.create(tpu_env_poddefault(NS))
+        api.create(inference_env_poddefault(NS, max_batch=16))
+        api.create(make_cr())
+        make_inference_controller(api).run_once()
+        StatefulSetPodSimulator(api).step()
+        pod = api.get("v1", "Pod", "llm-0", NS)
+        env = {
+            e["name"]: e.get("value")
+            for c in pod["spec"]["containers"]
+            for e in c.get("env", [])
+        }
+        # Serving env from inference-env, checkpoint + slice env from
+        # tpu-env — injected together with no conflicts.
+        assert env["KFT_SERVING_MODEL_DIR"] == "/home/jovyan/checkpoints"
+        assert env["KFT_SERVING_MAX_BATCH"] == "16"
+        assert env["KFT_CHECKPOINT_DIR"] == "/home/jovyan/checkpoints"
+        assert env["JAX_PLATFORMS"] == "tpu,cpu"
+        # The port is per-CR and controller-owned (STS template env),
+        # NEVER in the PodDefault — a CR with a non-default port would
+        # otherwise conflict-reject its own pods at admission.
+        from kubeflow_tpu.webhook.server import (
+            inference_env_poddefault as pd_fn,
+        )
+
+        pd_env = {e["name"] for e in pd_fn(NS)["spec"]["env"]}
+        assert "KFT_SERVING_PORT" not in pd_env
+        sts = api.get("apps/v1", "StatefulSet", "llm", NS)
+        sts_env = {
+            e["name"]: e.get("value")
+            for c in sts["spec"]["template"]["spec"]["containers"]
+            for e in c.get("env", [])
+        }
+        assert sts_env["KFT_SERVING_PORT"] == "8800"
+
+
+# ---------------------------------------------------------------------------
+# Data plane: engine + gateway over a tiny CPU model.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+
+    cfg = LMConfig(vocab=128, layers=2, dim=64, heads=4, kv_heads=2,
+                   dtype=jnp.bfloat16)
+    model = build_lm(cfg, use_flash=False)
+    params = create_lm_state(model, jax.random.key(0), (1, 16)).params
+    return cfg, params
+
+
+def reference(cfg, params, prompt, n):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import generate
+
+    out = generate(cfg, params, jnp.asarray([prompt], jnp.int32), n)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def sse_generate(url, prompt, max_new, extra=None, timeout=120):
+    """POST /v1/generate and parse the SSE stream into
+    (tokens, done_payload, content_type)."""
+    body = {"prompt": prompt, "max_new_tokens": max_new}
+    body.update(extra or {})
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, done = [], None
+    with urllib.request.urlopen(req, timeout=timeout) as response:
+        ctype = response.headers["Content-Type"]
+        event = None
+        for raw in response:
+            line = raw.decode().rstrip("\n")
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                payload = json.loads(line[len("data: "):])
+                if event == "done":
+                    done = payload
+                    break
+                tokens.append(payload["token"])
+            elif not line:
+                event = None
+    return tokens, done, ctype
+
+
+def scrape(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def metric_value(text, needle):
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+class TestGatewayEndToEnd:
+    """The acceptance contract: >=3 overlapping HTTP requests,
+    interleaved SSE streams token-identical to generate(), nonzero
+    TTFT observations and a prefix-cache hit for a shared-prefix
+    pair on /metrics."""
+
+    def test_overlapping_streams_match_generate(self, lm):
+        import numpy as np
+
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg, params = lm
+        engine = StreamingBatcher(cfg, params, max_batch=2, max_len=64,
+                                  prefill_per_cycle=1)
+        gateway = InferenceGateway(engine, port=0).start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            rng = np.random.default_rng(11)
+            base = [int(t) for t in rng.integers(0, cfg.vocab, 8)]
+            prompts = [
+                base,
+                base + [3, 5],  # shares base as a prefix
+                [int(t) for t in rng.integers(0, cfg.vocab, 6)],
+            ]
+            results: dict[int, tuple] = {}
+
+            def client(i, prompt):
+                results[i] = sse_generate(url, prompt, 6)
+
+            threads = [
+                threading.Thread(target=client, args=(i, p))
+                for i, p in enumerate(prompts)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, prompt in enumerate(prompts):
+                tokens, done, ctype = results[i]
+                assert ctype == "text/event-stream"
+                assert tokens == reference(cfg, params, prompt, 6), (
+                    f"stream {i} diverged from generate()"
+                )
+                assert done["tokens"] == tokens
+                assert done["reason"] == "length"
+            text = scrape(url)
+            assert metric_value(text,
+                                "inference_ttft_seconds_count") >= 3
+            assert metric_value(
+                text, 'inference_prefix_cache_total{outcome="hit"}'
+            ) >= 1
+            assert metric_value(
+                text, 'inference_tokens_total{kind="generated"}'
+            ) >= 18
+            assert metric_value(
+                text, 'inference_tokens_total{kind="prompt"}'
+            ) >= 22
+            # Scheduler cycle histograms observed both phases.
+            assert metric_value(
+                text,
+                'inference_batch_cycle_seconds_count{phase="prefill"}'
+            ) >= 1
+            assert metric_value(
+                text,
+                'inference_batch_cycle_seconds_count{phase="decode"}'
+            ) >= 1
+        finally:
+            gateway.stop()
+
+    @pytest.mark.slow  # own engine => own jit compiles; gate runs it
+    def test_eos_reason_and_nonstream_mode(self, lm):
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg, params = lm
+        prompt = [7, 3, 11, 19, 4]
+        ref = reference(cfg, params, prompt, 8)
+        eos = ref[3]
+        cut = ref[: ref.index(eos) + 1]
+        engine = StreamingBatcher(cfg, params, max_batch=2, max_len=64,
+                                  eos_token=eos)
+        gateway = InferenceGateway(engine, port=0).start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            tokens, done, _ = sse_generate(url, prompt, 8)
+            assert tokens == cut
+            assert done["reason"] == "eos"
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"prompt": prompt, "max_new_tokens": 8,
+                                 "stream": False}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as response:
+                payload = json.loads(response.read())
+            assert payload["tokens"] == cut
+            assert payload["reason"] == "eos"
+        finally:
+            gateway.stop()
+
+    def test_bad_requests_are_400(self, lm):
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg, params = lm
+        engine = StreamingBatcher(cfg, params, max_batch=1, max_len=64)
+        gateway = InferenceGateway(engine, port=0).start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            for body in (
+                b"not json",
+                json.dumps({"prompt": []}).encode(),
+                json.dumps({"prompt": ["a"]}).encode(),
+                # temperature without a seed: the server never invents
+                # sampling entropy.
+                json.dumps({"prompt": [1, 2],
+                            "temperature": 0.5}).encode(),
+                # over capacity (slots round up to DECODE_BLOCK=256)
+                json.dumps({"prompt": [1] * 220,
+                            "max_new_tokens": 60}).encode(),
+                # non-numeric scalars must be a JSON 400, not a
+                # dropped connection
+                json.dumps({"prompt": [1, 2],
+                            "temperature": "hot"}).encode(),
+                json.dumps({"prompt": [1, 2],
+                            "max_new_tokens": [5]}).encode(),
+                json.dumps({"prompt": [1, 2], "temperature": 0.5,
+                            "seed": "x"}).encode(),
+                json.dumps({"prompt": [1, 2],
+                            "max_new_tokens": 0}).encode(),
+            ):
+                req = urllib.request.Request(
+                    url + "/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+        finally:
+            gateway.stop()
+
+
+class TestQueueShedding:
+    def test_429_with_retry_after_when_inbox_full(self, lm):
+        """Scheduler deliberately not started: submissions pile into
+        the bounded inbox, and the gateway sheds past max_pending with
+        429 + Retry-After (no device work involved)."""
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg, params = lm
+        engine = StreamingBatcher(cfg, params, max_batch=1, max_len=64,
+                                  max_pending=2)
+        # The inherited batch API is closed off on streaming engines.
+        with pytest.raises(RuntimeError):
+            engine.submit([1, 2])
+        with pytest.raises(RuntimeError):
+            engine.run()
+        gateway = InferenceGateway(engine, port=0, retry_after_s=7)
+        # Only the HTTP listener — the scheduler stays parked.
+        server_thread = threading.Thread(
+            target=gateway._server.serve_forever, daemon=True)
+        server_thread.start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            def fire():
+                req = urllib.request.Request(
+                    url + "/v1/generate",
+                    data=json.dumps({"prompt": [1, 2, 3],
+                                     "max_new_tokens": 4,
+                                     "stream": False}).encode(),
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=5)
+
+            def fire_quietly():
+                # These two are parked forever (no scheduler); their
+                # eventual client timeout is expected noise.
+                try:
+                    fire()
+                except (urllib.error.URLError, OSError):
+                    pass
+
+            for _ in range(2):  # fill the inbox asynchronously
+                threading.Thread(target=fire_quietly,
+                                 daemon=True).start()
+            import time as _time
+
+            deadline = _time.monotonic() + 5
+            while engine.pending() < 2 and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert engine.pending() == 2
+            with pytest.raises(urllib.error.HTTPError) as err:
+                fire()
+            assert err.value.code == 429
+            assert err.value.headers["Retry-After"] == "7"
+            text = scrape(url)
+            assert metric_value(text, "inference_shed_total") == 1
+            assert metric_value(text, "inference_queue_depth") == 2
+            assert metric_value(
+                text,
+                'inference_request_duration_seconds_count'
+                '{outcome="shed"}') == 1
+        finally:
+            gateway._server.shutdown()
+            gateway._server.server_close()
+
+
+class TestHotSwap:
+    def test_swap_drains_in_flight_then_repoints(self, lm):
+        """A swap staged mid-request applies only after the in-flight
+        slot drains; queued requests are served by the NEW weights and
+        the prefix cache is invalidated."""
+        import jax
+
+        from kubeflow_tpu.models import build_lm, create_lm_state
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg, params = lm
+        model = build_lm(cfg, use_flash=False)
+        params2 = create_lm_state(model, jax.random.key(9),
+                                  (1, 16)).params
+        engine = StreamingBatcher(cfg, params, max_batch=1, max_len=64,
+                                  step_chunk=2)
+        prompt = [5, 9, 2, 14]
+        events1, events2 = [], []
+        engine.submit_stream(prompt, events1.append, max_new_tokens=12)
+        # Admit + a couple of decode cycles, then stage the swap while
+        # the slot is mid-flight.
+        assert engine.step_cycle()
+        engine.swap_params(params2)
+        assert engine.draining is False  # not yet observed by scheduler
+        engine.submit_stream(prompt, events2.append, max_new_tokens=6)
+        engine.drain()
+        assert engine.swaps_total == 1
+        assert engine.draining is False
+        done1 = [e for e in events1 if e.get("done")][0]
+        done2 = [e for e in events2 if e.get("done")][0]
+        # In-flight request: OLD weights, full budget, uninterrupted.
+        assert done1["tokens"] == reference(cfg, params, prompt, 12)
+        # Queued request: NEW weights (and the old prefix entry for
+        # this very prompt must NOT have been reused).
+        assert done2["tokens"] == reference(cfg, params2, prompt, 6)
+        assert len(engine.prefix_cache) == 1  # only the post-swap entry
+        # Finished requests must not leak their token lists (the
+        # gateway cycles forever; run()-style retention would OOM).
+        assert engine._results == {}
+
+    def test_gateway_swap_endpoint_stages_reload(self, lm):
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg, params = lm
+        engine = StreamingBatcher(cfg, params, max_batch=1, max_len=64)
+        calls = []
+
+        def reload_fn():
+            calls.append(1)
+            return params, {"step": 42}
+
+        gateway = InferenceGateway(engine, port=0,
+                                   reload_fn=reload_fn).start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            req = urllib.request.Request(url + "/v1/admin/swap",
+                                         data=b"{}")
+            with urllib.request.urlopen(req, timeout=30) as response:
+                payload = json.loads(response.read())
+            assert payload == {"staged": True, "info": {"step": 42}}
+            assert calls == [1]
+            deadline = 50
+            while engine.swaps_total == 0 and deadline:
+                import time as _time
+
+                _time.sleep(0.05)
+                deadline -= 1
+            assert engine.swaps_total == 1
+            text = scrape(url)
+            assert metric_value(text,
+                                "inference_model_swap_total") == 1
+        finally:
+            gateway.stop()
+
+
+class TestPrefixCache:
+    def test_longest_prefix_lru_and_clear(self):
+        from kubeflow_tpu.serving.engine import CacheEntry, PrefixCache
+
+        cache = PrefixCache(capacity=2)
+        entry_a = CacheEntry(cache=None, logits=None)
+        entry_ab = CacheEntry(cache=None, logits=None)
+        cache.put([1, 2], entry_a)
+        cache.put([1, 2, 3], entry_ab)
+        found, plen = cache.lookup((1, 2, 3, 4))
+        assert found is entry_ab and plen == 3  # longest wins
+        assert (cache.hits, cache.misses) == (1, 0)
+        found, plen = cache.lookup((9, 9))
+        assert found is None and plen == 0
+        assert cache.misses == 1
+        cache.put([7], CacheEntry(cache=None, logits=None))  # evicts LRU
+        assert len(cache) == 2
+        found, _ = cache.lookup((1, 2))
+        assert found is None or found is not entry_a
+        cache.clear()
+        assert len(cache) == 0
+
+
+class TestMoEFallback:
+    @pytest.mark.slow  # MoE compile is the cost; gate runs it
+    def test_moe_config_degrades_to_serialized_generate(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+        from kubeflow_tpu.serving.engine import (
+            GenerateFallbackEngine,
+            make_engine,
+        )
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        cfg = LMConfig(vocab=64, layers=2, dim=32, heads=2, kv_heads=2,
+                       moe_experts=2, dtype=jnp.float32)
+        model = build_lm(cfg, use_flash=False)
+        params = create_lm_state(model, jax.random.key(0),
+                                 (1, 8)).params
+        engine = make_engine(cfg, params, max_batch=2, max_len=32)
+        assert isinstance(engine, GenerateFallbackEngine)
+        gateway = InferenceGateway(engine, port=0).start()
+        url = f"http://127.0.0.1:{gateway.port}"
+        try:
+            prompt = [3, 1, 4, 1, 5]
+            tokens, done, ctype = sse_generate(url, prompt, 4)
+            assert ctype == "text/event-stream"  # still streamed
+            assert tokens == reference(cfg, params, prompt, 4)
+            assert done["reason"] == "length"
+            text = scrape(url)  # still metered
+            assert metric_value(text,
+                                "inference_ttft_seconds_count") == 1
+            assert metric_value(
+                text,
+                'inference_batch_cycle_seconds_count{phase="decode"}'
+            ) == 1
+        finally:
+            gateway.stop()
+
+
+class TestLoadtestSmoke:
+    def test_serve_qps_smoke_reports_slos(self):
+        from loadtest.serve_qps import main
+
+        summary = main(["--smoke"])
+        assert summary["count"] == 6
+        assert summary["errors"] == []
+        assert summary["ttft_p50_s"] > 0
+        assert summary["ttft_p99_s"] >= summary["ttft_p50_s"]
+        assert summary["tokens_per_s"] > 0
+        assert summary["cache_hits"] >= 1
+
+
+class TestGatewayMetricsSchema:
+    def test_gateway_labels_are_canonical(self, lm):
+        from prometheus_client import generate_latest
+        from prometheus_client.parser import (
+            text_string_to_metric_families,
+        )
+
+        from kubeflow_tpu import obs
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+        from kubeflow_tpu.serving.gateway import GatewayMetrics
+
+        cfg, params = lm
+        engine = StreamingBatcher(cfg, params, max_batch=1, max_len=64)
+        metrics = GatewayMetrics(engine)
+        text = generate_latest(metrics.registry).decode()
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                bad = set(sample.labels) - obs.CANONICAL_LABELS
+                assert not bad, f"{sample.name}: {sorted(bad)}"

@@ -285,3 +285,49 @@ class TestRollingSlots:
         results = batcher.run()
         assert results[rid] == _reference(self.CFG, params, prompt, 14,
                                           temperature=0.7, rng=key)
+
+
+class TestInt8KVCache:
+    """quantize_cache=True threads the int8 KV cache through
+    BatchState/decode_step/prefill_slot (PR 6 satellite): parity
+    against ``generate(..., quantize_cache=True)``. The reference is
+    JITTED — the module contract sides with the jitted path, and the
+    coarser int8 logits make eager-vs-jit near-ties (the documented
+    XLA bf16 rounding property) far more likely than on the float
+    path."""
+
+    def test_state_layout_and_float_path_untouched(self):
+        params, _ = _setup()
+        quantized = ContinuousBatcher(CFG, params, max_batch=2,
+                                      max_len=64, quantize_cache=True)
+        assert quantized.state.quantized
+        assert quantized.state.k.dtype == jnp.int8
+        assert quantized.state.k_scale.shape == \
+            quantized.state.k.shape[:-1] + (1,)
+        floaty = ContinuousBatcher(CFG, params, max_batch=2, max_len=64)
+        assert not floaty.state.quantized
+        assert floaty.state.k_scale is None
+        assert floaty.state.k.dtype == CFG.dtype
+
+    def test_ragged_int8_matches_quantized_generate(self):
+        params, rng = _setup(seed=31)
+        gen_q = jax.jit(
+            lambda p, n: generate(CFG, params, p, n,
+                                  quantize_cache=True),
+            static_argnums=1)
+        reqs = [
+            ([int(t) for t in rng.integers(0, CFG.vocab, plen)], budget)
+            for plen, budget in [(5, 8), (9, 3), (5, 6)]
+        ]
+        batcher = ContinuousBatcher(CFG, params, max_batch=2,
+                                    max_len=64, step_chunk=5,
+                                    quantize_cache=True)
+        rids = [batcher.submit(p, max_new_tokens=b) for p, b in reqs]
+        results = batcher.run()
+        for rid, (prompt, budget) in zip(rids, reqs):
+            ref = [int(t) for t in np.asarray(
+                gen_q(jnp.asarray([prompt], jnp.int32), budget)[0])]
+            assert results[rid] == ref, (
+                f"int8-KV request {rid} diverged from quantized "
+                f"generate()"
+            )
