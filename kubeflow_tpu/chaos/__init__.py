@@ -20,12 +20,17 @@ equals the fault-free one, exactly.
 
 from kubeflow_tpu.chaos.cluster import (  # noqa: F401
     PREEMPTION_TAINT_KEY,
+    TEMPLATE_HASH_ANNOTATION,
     PreemptionInjector,
     StatefulSetPodSimulator,
 )
 from kubeflow_tpu.chaos.harness import run_to_convergence  # noqa: F401
 from kubeflow_tpu.chaos.proxy import ChaosApiServer, ChaosWatchQueue  # noqa: F401
-from kubeflow_tpu.chaos.schedule import Fault, FaultSchedule  # noqa: F401
+from kubeflow_tpu.chaos.schedule import (  # noqa: F401
+    CapacityEvent,
+    Fault,
+    FaultSchedule,
+)
 
 # Data-plane checkpoint faults resolve lazily: chaos.ckpt reaches into
 # models.checkpoint (jax + the training stack), which the control-plane

@@ -22,6 +22,8 @@ the old overlap-free behavior point the injector at the inner
 
 from __future__ import annotations
 
+import hashlib
+import json
 import time
 
 from kubeflow_tpu.k8s.core import ApiError, Conflict, NotFound
@@ -31,20 +33,83 @@ from kubeflow_tpu.k8s.retry import RETRIABLE_STATUS, RetryPolicy
 # (spot/preemptible reclaim and maintenance both surface this way).
 PREEMPTION_TAINT_KEY = "cloud.google.com/impending-node-termination"
 
+# Simulator bookkeeping: which StatefulSet template a pod was built
+# from (the controller-revision-hash stand-in), so the opt-in rolling
+# replacement can tell a re-emitted template from a scale change.
+TEMPLATE_HASH_ANNOTATION = "chaos.kubeflow-tpu.org/template-hash"
+
 
 class StatefulSetPodSimulator:
-    """Materialise StatefulSet pod sets against a fake apiserver."""
+    """Materialise StatefulSet pod sets against a fake apiserver.
 
-    def __init__(self, api, node_prefix: str = "tpu-node"):
+    ``capacity_chips`` bounds the schedulable TPU pool (None =
+    unbounded, the historical behaviour): a pod whose ``google.com/tpu``
+    limit does not fit the remaining capacity is created **Pending**
+    with an Unschedulable ``PodScheduled`` condition and no node —
+    exactly what a notebook sees when a preemption shrank the node pool
+    — and is bound (node + Running + Ready) by a later ``step()`` once
+    capacity regrows. The elastic chaos scenarios drive this through
+    :meth:`PreemptionInjector.apply_capacity`.
+
+    ``recreate_on_template_change=True`` additionally recycles pods
+    whose recorded template hash no longer matches the StatefulSet's
+    template (the rolling replacement a real statefulset controller
+    performs when the controller re-emits new chip limits/env). Off by
+    default: the legacy tests pin scale-only reconciliation where a
+    survivor keeps its identity across a topology edit.
+    """
+
+    def __init__(self, api, node_prefix: str = "tpu-node",
+                 capacity_chips: int | None = None,
+                 recreate_on_template_change: bool = False):
         self.api = api
         self.node_prefix = node_prefix
+        self.capacity_chips = capacity_chips
+        self.recreate_on_template_change = recreate_on_template_change
         self.created_total = 0
         self.deleted_total = 0
+        self.pending_total = 0
+        self.bound_total = 0
 
     def node_name(self, sts_name: str, ordinal: int) -> str:
         return f"{self.node_prefix}-{sts_name}-{ordinal}"
 
-    def _pod_for(self, sts: dict, ordinal: int) -> dict:
+    @staticmethod
+    def _template_hash(sts: dict) -> str:
+        template = ((sts.get("spec") or {}).get("template")) or {}
+        return hashlib.sha256(
+            json.dumps(template, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    @staticmethod
+    def pod_chips(pod: dict) -> int:
+        """google.com/tpu chips one pod demands (its first container's
+        limit — the layout the notebook controller emits)."""
+        for c in (pod.get("spec") or {}).get("containers") or []:
+            limit = ((c.get("resources") or {}).get("limits") or {}).get(
+                "google.com/tpu"
+            )
+            if limit is not None:
+                try:
+                    return int(limit)
+                except (TypeError, ValueError):
+                    return 0
+        return 0
+
+    @staticmethod
+    def _is_bound(pod: dict) -> bool:
+        return bool((pod.get("spec") or {}).get("nodeName")) and not (
+            pod.get("metadata") or {}
+        ).get("deletionTimestamp")
+
+    def _used_chips(self) -> int:
+        return sum(
+            self.pod_chips(p)
+            for p in self.api.list("v1", "Pod")
+            if self._is_bound(p)
+        )
+
+    def _pod_for(self, sts: dict, ordinal: int, bound: bool = True) -> dict:
         meta = sts["metadata"]
         template = ((sts.get("spec") or {}).get("template")) or {}
         labels = dict(
@@ -59,13 +124,16 @@ class StatefulSetPodSimulator:
             }
             for c in tpl_spec.get("containers") or []
         ] or [{"name": "main", "image": ""}]
-        return {
+        pod = {
             "apiVersion": "v1",
             "kind": "Pod",
             "metadata": {
                 "name": f"{meta['name']}-{ordinal}",
                 "namespace": meta.get("namespace", "default"),
                 "labels": labels,
+                "annotations": {
+                    TEMPLATE_HASH_ANNOTATION: self._template_hash(sts),
+                },
                 "ownerReferences": [{
                     "apiVersion": "apps/v1",
                     "kind": "StatefulSet",
@@ -74,10 +142,14 @@ class StatefulSetPodSimulator:
                 }],
             },
             "spec": {
-                "nodeName": self.node_name(meta["name"], ordinal),
                 "containers": containers,
             },
-            "status": {
+        }
+        if bound:
+            pod["spec"]["nodeName"] = self.node_name(
+                meta["name"], ordinal
+            )
+            pod["status"] = {
                 "phase": "Running",
                 "conditions": [{"type": "Ready", "status": "True"}],
                 "containerStatuses": [
@@ -89,27 +161,97 @@ class StatefulSetPodSimulator:
                     }
                     for c in containers
                 ],
-            },
-        }
+            }
+        else:
+            pod["status"] = {
+                "phase": "Pending",
+                "conditions": [{
+                    "type": "PodScheduled",
+                    "status": "False",
+                    "reason": "Unschedulable",
+                    "message": "0/0 nodes have free google.com/tpu "
+                               "(simulated capacity exhausted)",
+                }],
+                "containerStatuses": [],
+            }
+        return pod
+
+    def _fits(self, chips: int, used: int) -> bool:
+        if self.capacity_chips is None or chips <= 0:
+            return True
+        return used + chips <= self.capacity_chips
+
+    def _bind(self, sts: dict, ordinal: int, pod: dict) -> None:
+        """A Pending pod's node arrives: bind + run it, same identity
+        (the real scheduler binds the existing pod object — a regrown
+        pool must NOT look like a pod replacement to the observed-mesh
+        recovery)."""
+        bound = self._pod_for(sts, ordinal, bound=True)
+        self.api.patch_merge(
+            "v1", "Pod", pod["metadata"]["name"],
+            {"spec": {"nodeName": bound["spec"]["nodeName"]},
+             "status": bound["status"]},
+            pod["metadata"].get("namespace", "default"),
+        )
 
     def step(self) -> int:
-        """One control-loop pass: create missing pods, prune pods whose
-        ordinal is past the current replica count. Returns the number
-        of changes made (0 = the pod world is settled)."""
+        """One control-loop pass: create missing pods (Pending when the
+        TPU pool is exhausted), bind Pending pods capacity now covers,
+        prune pods whose ordinal is past the current replica count, and
+        (opt-in) recycle pods built from a stale template. Returns the
+        number of changes made (0 = the pod world is settled)."""
         changed = 0
+        used = self._used_chips()
         for sts in self.api.list("apps/v1", "StatefulSet"):
             meta = sts["metadata"]
             ns = meta.get("namespace", "default")
             replicas = (sts.get("spec") or {}).get("replicas")
             replicas = 1 if replicas is None else int(replicas)
+            tpl_hash = self._template_hash(sts)
             for ordinal in range(replicas):
                 name = f"{meta['name']}-{ordinal}"
                 try:
-                    self.api.get("v1", "Pod", name, ns)
+                    pod = self.api.get("v1", "Pod", name, ns)
                 except NotFound:
-                    self.api.create(self._pod_for(sts, ordinal))
+                    fresh = self._pod_for(sts, ordinal, bound=True)
+                    chips = self.pod_chips(fresh)
+                    if self._fits(chips, used):
+                        self.api.create(fresh)
+                        used += chips
+                    else:
+                        self.api.create(
+                            self._pod_for(sts, ordinal, bound=False)
+                        )
+                        self.pending_total += 1
                     self.created_total += 1
                     changed += 1
+                    continue
+                if (self.recreate_on_template_change
+                        and (pod["metadata"].get("annotations") or {})
+                        .get(TEMPLATE_HASH_ANNOTATION, tpl_hash)
+                        != tpl_hash):
+                    # Rolling replacement: the controller re-emitted the
+                    # template (new chip limits / world-size env); the
+                    # old incarnation is recycled and recreated from
+                    # the new template on the next pass.
+                    try:
+                        self.api.delete("v1", "Pod", name, ns)
+                        if self._is_bound(pod):
+                            used -= self.pod_chips(pod)
+                        self.deleted_total += 1
+                        changed += 1
+                    except NotFound:
+                        pass
+                    continue
+                if not self._is_bound(pod) and not (
+                    pod["metadata"].get("deletionTimestamp")
+                ):
+                    chips = self.pod_chips(pod)
+                    if self._fits(chips, used):
+                        self._bind(sts, ordinal, pod)
+                        used += chips
+                        self.bound_total += 1
+                        changed += 1
             # Scale-down: the statefulset controller removes the
             # highest ordinals first; order is irrelevant to the fake.
             for pod in self.api.list(
@@ -123,6 +265,8 @@ class StatefulSetPodSimulator:
                 if int(suffix) >= replicas:
                     try:
                         self.api.delete("v1", "Pod", pod_name, ns)
+                        if self._is_bound(pod):
+                            used -= self.pod_chips(pod)
                         self.deleted_total += 1
                         changed += 1
                     except NotFound:
@@ -149,6 +293,11 @@ class PreemptionInjector:
         self._sleep = sleep
         self.retries_total = 0
         self.preempted: list[tuple[str, str]] = []  # (namespace, pod)
+        # Capacity-timeline state: the chip bound currently enforced
+        # and the nodes this injector tainted to enforce it (cleared
+        # when the pool regrows).
+        self.capacity_chips: int | None = None
+        self._capacity_tainted: set[str] = set()
 
     def _retrying(self, fn, *args, **kwargs):
         """Run one API call through the retry policy. Same doctrine as
@@ -229,6 +378,58 @@ class PreemptionInjector:
                        ordinal: int) -> str | None:
         """Preempt TPU worker ``ordinal`` of a notebook's slice."""
         return self.preempt_pod(namespace, f"{notebook}-{ordinal}")
+
+    def apply_capacity(self, schedule, now_s: float,
+                       sim: StatefulSetPodSimulator) -> int | None:
+        """Advance cluster capacity to ``schedule.capacity_at(now_s)``
+        (a :class:`~kubeflow_tpu.chaos.schedule.FaultSchedule` with
+        capacity events — the same seeded script every other chaos run
+        follows). On a shrink, bound pods beyond the new budget are
+        preempted GKE-style (taint + delete), highest ordinals first —
+        the cloud reclaiming VMs out from under the workload. On a
+        regrow, this injector's termination taints are cleared (the
+        replacement VMs arriving) and the simulator's next ``step()``
+        binds what now fits. Returns the chip bound now in force."""
+        chips = schedule.capacity_at(now_s)
+        if chips == self.capacity_chips:
+            return chips
+        grew = (chips is None or
+                (self.capacity_chips is not None
+                 and chips > self.capacity_chips))
+        self.capacity_chips = chips
+        sim.capacity_chips = chips
+        if grew:
+            for node in sorted(self._capacity_tainted):
+                self.recover_node(node)
+            self._capacity_tainted.clear()
+            return chips
+        # Shrink: reclaim bound pods until usage fits. Highest ordinal
+        # first within each slice — deterministic, and matches GKE
+        # draining a node pool from its newest VMs. Sort on the PARSED
+        # ordinal: plain name order would put "nb-9" after "nb-15".
+        def reclaim_key(pod):
+            name = pod["metadata"]["name"]
+            prefix, _, suffix = name.rpartition("-")
+            ordinal = int(suffix) if suffix.isdigit() else -1
+            return (prefix, ordinal)
+
+        bound = sorted(
+            (p for p in self._retrying(self.api.list, "v1", "Pod")
+             if sim._is_bound(p) and sim.pod_chips(p) > 0),
+            key=reclaim_key, reverse=True,
+        )
+        used = sum(sim.pod_chips(p) for p in bound)
+        for pod in bound:
+            if chips is None or used <= chips:
+                break
+            node = self.preempt_pod(
+                pod["metadata"].get("namespace", "default"),
+                pod["metadata"]["name"],
+            )
+            if node:
+                self._capacity_tainted.add(node)
+            used -= sim.pod_chips(pod)
+        return chips
 
     def recover_node(self, node_name: str) -> None:
         """Clear the termination taint (the replacement VM arriving).

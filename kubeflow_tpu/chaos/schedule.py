@@ -46,6 +46,16 @@ class Fault:
 
 
 @dataclass(frozen=True)
+class CapacityEvent:
+    """One point on a slice-capacity timeline: at ``at_s`` (scenario
+    seconds, jitter already applied) the schedulable TPU pool becomes
+    ``chips`` chips (None = unbounded)."""
+
+    at_s: float
+    chips: int | None
+
+
+@dataclass(frozen=True)
 class _Window:
     kind: str
     start: int
@@ -86,6 +96,13 @@ class FaultSchedule:
         self._windows: list[_Window] = []
         self._watch_rates: dict[str, float] = {}
         self._watch_budget: dict[str, int | None] = {}
+        # Capacity events draw jitter from their OWN seeded generator:
+        # the draw happens at build time (one per event, in insertion
+        # order), so adding an API-fault window never shifts a capacity
+        # event's instant — the two fault planes stay independently
+        # reproducible.
+        self._capacity_rng = random.Random((seed << 1) ^ 0x5CA1AB1E)
+        self._capacity: list[CapacityEvent] = []
 
     # ---- builders --------------------------------------------------------
     def add(
@@ -148,7 +165,48 @@ class FaultSchedule:
         self._watch_budget[COMPACT] = max_compactions
         return self
 
+    def capacity(self, at_s: float, chips: int | None,
+                 jitter_s: float = 0.0) -> "FaultSchedule":
+        """Add a capacity event: at ``at_s`` (± a uniform draw within
+        ``jitter_s``, taken NOW from the seeded generator) the
+        schedulable TPU pool shrinks or regrows to ``chips`` chips
+        (None = unbounded). The elastic chaos scenarios script whole
+        preempt-then-regrow weather this way::
+
+            FaultSchedule(seed=7).capacity(0, 16)      # full pool
+                .capacity(100, 8, jitter_s=5)          # preemption
+                .capacity(400, 16, jitter_s=5)         # capacity back
+
+        Events keep their insertion order even when jitter would swap
+        two instants — a regrow scripted after a shrink stays after it.
+        """
+        jitter = (
+            self._capacity_rng.uniform(-jitter_s, jitter_s)
+            if jitter_s else 0.0
+        )
+        at = max(0.0, float(at_s) + jitter)
+        if self._capacity and at < self._capacity[-1].at_s:
+            at = self._capacity[-1].at_s
+        self._capacity.append(CapacityEvent(
+            at, None if chips is None else int(chips)
+        ))
+        return self
+
     # ---- queries (proxy side) -------------------------------------------
+    def capacity_at(self, now_s: float) -> int | None:
+        """The chip capacity in force at scenario time ``now_s`` —
+        the latest event at or before it (None before the first event:
+        unbounded)."""
+        chips = None
+        for event in self._capacity:
+            if event.at_s > now_s:
+                break
+            chips = event.chips
+        return chips
+
+    def capacity_events(self) -> list[CapacityEvent]:
+        return list(self._capacity)
+
     def fault_for(self, op: int, verb: str, kind: str) -> Fault | None:
         """The fault (if any) to inject for API call number ``op``.
         First matching window that fires wins; BLACKOUT windows always
@@ -184,4 +242,7 @@ class FaultSchedule:
             parts.append(f"{w.kind}{span}@{w.rate:g}")
         for kind, rate in self._watch_rates.items():
             parts.append(f"watch-{kind}@{rate:g}")
+        for event in self._capacity:
+            chips = "∞" if event.chips is None else event.chips
+            parts.append(f"capacity@{event.at_s:g}s={chips}")
         return " ".join(parts)

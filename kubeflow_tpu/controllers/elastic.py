@@ -1,0 +1,334 @@
+"""Elastic slice topology: degraded-mode policy for TPU notebooks.
+
+PR 4 closed the preempt → all-or-nothing restart → auto-resume loop,
+but only onto the *exact original topology*: when a preemption leaves a
+smaller node pool, the restarted workers sit Pending while valid
+checkpoints age on disk. This module is the platform half of the fix
+(ROADMAP item 5): an opt-in **fallback ladder** of smaller canonical
+shapes the reconciler may re-emit the StatefulSet at, so training
+resumes on what the cluster can actually schedule — and climbs back up
+when capacity regrows.
+
+State machine, driven once per reconcile from observed pods:
+
+- **degrade**: expected workers Unschedulable for longer than the
+  grace period (`elastic-grace-s`, the wait-for-full-shape window) →
+  step one rung down the ladder, re-emit the StatefulSet at the new
+  replica count / chip limits, stamp the new world size and surface
+  ``status.phase=Resharding`` until the new shape is fully running.
+- **promote**: running degraded and the promote interval
+  (`elastic-promote-after-s`) elapsed → optimistically step one rung
+  up (a reconciler cannot see free capacity for nodes that do not
+  exist — it probes). If the bigger shape sits Unschedulable past the
+  grace period, the degrade arm steps back down; the probe interval
+  bounds the flap rate.
+
+The data plane needs no handshake beyond what PR 4 built: the re-
+emitted pods carry the new world-size env, ``run_with_checkpointing``
+auto-resumes, and the checkpoint manager treats the topology-
+fingerprint mismatch as an explicit cross-topology restore
+(``MeshSpec.refactor`` + sharding-aware assembly re-lay params and
+optimizer state onto the new mesh).
+
+Annotations (user-facing):
+
+- ``elastic-ladder``: opt-in; ``"auto"`` derives successive halvings
+  (:func:`kubeflow_tpu.topology.fallback_ladder`) or an explicit
+  ``"v5e-8,v5e-4"`` list.
+- ``elastic-grace-s`` / ``elastic-promote-after-s``: the two timers.
+
+Annotations (controller-owned state): ``elastic-shape`` (current rung,
+absent = spec shape), ``elastic-world-size`` (hosts at the current
+shape), ``elastic-pending-since``, ``elastic-promote-at``,
+``reshard-reason`` (in-flight transition, mirrored to status).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from kubeflow_tpu import topology
+from kubeflow_tpu.controllers.time_utils import parse_rfc3339, rfc3339
+from kubeflow_tpu.topology import TopologyError, TpuSlice
+
+log = logging.getLogger(__name__)
+
+_NS = "notebooks.kubeflow-tpu.org"
+
+ELASTIC_LADDER_KEY = f"{_NS}/elastic-ladder"
+ELASTIC_GRACE_KEY = f"{_NS}/elastic-grace-s"
+ELASTIC_PROMOTE_AFTER_KEY = f"{_NS}/elastic-promote-after-s"
+
+ELASTIC_SHAPE_KEY = f"{_NS}/elastic-shape"
+ELASTIC_WORLD_SIZE_KEY = f"{_NS}/elastic-world-size"
+ELASTIC_PENDING_SINCE_KEY = f"{_NS}/elastic-pending-since"
+ELASTIC_PROMOTE_AT_KEY = f"{_NS}/elastic-promote-at"
+RESHARD_REASON_KEY = f"{_NS}/reshard-reason"
+
+# Controller-owned bookkeeping, cleared when the opt-in goes away.
+STATE_KEYS = (
+    ELASTIC_SHAPE_KEY,
+    ELASTIC_WORLD_SIZE_KEY,
+    ELASTIC_PENDING_SINCE_KEY,
+    ELASTIC_PROMOTE_AT_KEY,
+    RESHARD_REASON_KEY,
+)
+
+DEFAULT_GRACE_S = 120.0
+DEFAULT_PROMOTE_AFTER_S = 300.0
+
+
+@dataclasses.dataclass
+class ElasticDecision:
+    """One reconcile pass's elastic verdict."""
+
+    # The shape the StatefulSet must be emitted at THIS pass (the spec
+    # shape unless a rung is active).
+    effective: TpuSlice
+    # metadata.annotations merge patch (None values delete); empty =
+    # nothing to write.
+    patches: dict
+    # (reason, message, event_type) to record, transition-gated.
+    events: list
+    # Non-None while a shape transition is in flight → status.phase=
+    # Resharding with this message.
+    reshard_reason: str | None
+    # True when ``effective`` IS the spec shape (rung 0) — the single
+    # source of that judgement; callers must not re-derive it from
+    # topology strings.
+    at_spec_shape: bool = True
+
+
+def _unschedulable(pod: dict) -> bool:
+    """Explicitly Unschedulable (the scheduler said so) — a pod that is
+    merely young and still Pending is not capacity evidence."""
+    status = pod.get("status") or {}
+    if status.get("phase") not in (None, "Pending"):
+        return False
+    return any(
+        cond.get("type") == "PodScheduled"
+        and cond.get("status") == "False"
+        and cond.get("reason", "Unschedulable") == "Unschedulable"
+        for cond in status.get("conditions") or []
+    )
+
+
+def _runs_shape(pod: dict, effective: TpuSlice) -> bool:
+    """Is this pod a *running worker of the effective shape*? Phase
+    Running alone is not enough: after a transition, the previous
+    shape's workers are still Running with the OLD template — they are
+    not the new world until the rolling replacement lands. Two
+    template facts identify the shape: the per-host chip limit AND the
+    world-size env (``KFT_NUM_PROCESSES``) — the limit alone cannot
+    tell adjacent multi-host rungs apart (every multi-host shape of a
+    generation shares chips_per_host). Facts that are not visible on
+    the pod count as matching (never block a transition on data we
+    cannot see)."""
+    if (pod.get("status") or {}).get("phase") != "Running":
+        return False
+    for container in (pod.get("spec") or {}).get("containers") or []:
+        limit = ((container.get("resources") or {}).get("limits")
+                 or {}).get("google.com/tpu")
+        try:
+            if limit is not None and \
+                    int(limit) != effective.chips_per_replica:
+                return False
+        except (TypeError, ValueError):
+            pass
+        for env in container.get("env") or []:
+            if env.get("name") == "KFT_NUM_PROCESSES" and \
+                    "value" in env:
+                if str(env["value"]) != str(effective.num_hosts):
+                    return False
+    return True
+
+
+def _seconds(anns: dict, key: str, default: float) -> float:
+    try:
+        value = float(anns[key])
+        return value if value >= 0 else default
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def decide(notebook: dict, pods: list | None, now: float
+           ) -> ElasticDecision | None:
+    """The elastic policy for one reconcile pass. Pure over its inputs
+    (the CR, the already-listed pods, the injected clock) — the caller
+    owns every API write. Returns None for non-TPU notebooks."""
+    spec_tpu = ((notebook.get("spec") or {}).get("tpu")) or {}
+    accelerator = spec_tpu.get("accelerator")
+    if not accelerator:
+        return None
+    try:
+        spec_slice = TpuSlice.parse(
+            accelerator, spec_tpu.get("topology", "1x1")
+        )
+    except TopologyError:
+        return None  # native reconcile surfaces the spec error
+    meta = notebook.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    name = meta.get("name", "")
+
+    raw_ladder = anns.get(ELASTIC_LADDER_KEY)
+    if raw_ladder is None:
+        # Not opted in: run at the spec shape; sweep stale elastic
+        # state so a removed opt-in does not pin a degraded shape.
+        stale = {key: None for key in STATE_KEYS if key in anns}
+        return ElasticDecision(spec_slice, stale, [], None)
+    try:
+        rungs = [spec_slice] + topology.parse_ladder(
+            spec_slice, raw_ladder
+        )
+    except TopologyError as exc:
+        # A typo in the ladder must not trigger a surprise reshape: if
+        # the notebook is currently pinned to a degraded rung, keep
+        # running THAT shape (frozen — no further transitions) until
+        # the annotation is fixed or removed.
+        pinned = spec_slice
+        shape_ann = anns.get(ELASTIC_SHAPE_KEY)
+        if shape_ann:
+            try:
+                candidate = TpuSlice.from_shorthand(shape_ann)
+                if (candidate.accelerator.name
+                        == spec_slice.accelerator.name
+                        and candidate.chips < spec_slice.chips):
+                    pinned = candidate
+            except TopologyError:
+                pass
+        log.warning(
+            "notebook %s: invalid %s annotation (%s); elastic "
+            "transitions disabled, holding shape %s", name,
+            ELASTIC_LADDER_KEY, exc, pinned.shorthand,
+        )
+        return ElasticDecision(pinned, {}, [], None,
+                               at_spec_shape=pinned is spec_slice)
+
+    shorthands = [rung.shorthand for rung in rungs]
+    shape_ann = anns.get(ELASTIC_SHAPE_KEY)
+    rung = shorthands.index(shape_ann) if shape_ann in shorthands else 0
+    effective = rungs[rung]
+    reshard_reason = anns.get(RESHARD_REASON_KEY) or None
+    grace_s = _seconds(anns, ELASTIC_GRACE_KEY, DEFAULT_GRACE_S)
+    promote_after_s = _seconds(
+        anns, ELASTIC_PROMOTE_AFTER_KEY, DEFAULT_PROMOTE_AFTER_S
+    )
+
+    replicas = effective.num_hosts
+    expected = {f"{name}-{i}" for i in range(replicas)}
+    present = {
+        p["metadata"]["name"]: p
+        for p in pods or []
+        if p["metadata"]["name"] in expected
+        and not p["metadata"].get("deletionTimestamp")
+    }
+    stuck = sorted(n for n, p in present.items() if _unschedulable(p))
+    running = {
+        n for n, p in present.items() if _runs_shape(p, effective)
+    }
+
+    patches: dict = {}
+    events: list = []
+    if anns.get(ELASTIC_WORLD_SIZE_KEY) != str(replicas):
+        patches[ELASTIC_WORLD_SIZE_KEY] = str(replicas)
+
+    if stuck:
+        since = parse_rfc3339(anns.get(ELASTIC_PENDING_SINCE_KEY, ""))
+        if since is None:
+            # First sight of capacity starvation at this shape: arm the
+            # wait-for-full-shape grace window.
+            patches[ELASTIC_PENDING_SINCE_KEY] = rfc3339(now)
+        elif now - since >= grace_s and rung + 1 < len(rungs):
+            target = rungs[rung + 1]
+            reshard_reason = (
+                f"degrading {effective.shorthand} -> {target.shorthand}: "
+                f"worker(s) {', '.join(stuck)} unschedulable for "
+                f"{int(now - since)}s (> grace {int(grace_s)}s)"
+            )
+            patches.update({
+                ELASTIC_SHAPE_KEY: target.shorthand,
+                ELASTIC_WORLD_SIZE_KEY: str(target.num_hosts),
+                ELASTIC_PENDING_SINCE_KEY: None,
+                # Probe back up only after the interval — and restart
+                # the clock on every degrade, so a failed promote probe
+                # cannot flap at reconcile frequency.
+                ELASTIC_PROMOTE_AT_KEY: rfc3339(now + promote_after_s),
+                RESHARD_REASON_KEY: reshard_reason,
+            })
+            events.append((
+                "SliceDegraded",
+                f"{reshard_reason}; re-emitting StatefulSet at "
+                f"{target.num_hosts} worker(s) x "
+                f"{target.chips_per_replica} chips, training resumes "
+                "from the last checkpoint on the re-factored mesh",
+                "Warning",
+            ))
+            effective = target
+        elif now - since >= grace_s:
+            log.warning(
+                "notebook %s: %s unschedulable past grace but already "
+                "at the ladder's smallest shape (%s); waiting for "
+                "capacity", name, stuck, effective.shorthand,
+            )
+        return ElasticDecision(
+            effective, patches, events, reshard_reason,
+            at_spec_shape=effective.shorthand == spec_slice.shorthand,
+        )
+
+    if ELASTIC_PENDING_SINCE_KEY in anns:
+        patches[ELASTIC_PENDING_SINCE_KEY] = None
+    full = expected <= running
+    if reshard_reason and full:
+        # The transition landed: every worker of the target shape runs.
+        patches[RESHARD_REASON_KEY] = None
+        reshard_reason = None
+        events.append((
+            "SliceResharded",
+            f"running at {effective.shorthand} "
+            f"({replicas} worker(s) x {effective.chips_per_replica} "
+            "chips)",
+            "Normal",
+        ))
+    if rung == 0:
+        # Nothing to promote at the spec shape; also sweep a stale
+        # shape annotation (a spec/ladder edit can orphan one, and a
+        # leftover value would be reinterpreted as "degraded" the
+        # moment a future ladder contains it again).
+        for key in (ELASTIC_PROMOTE_AT_KEY, ELASTIC_SHAPE_KEY):
+            if key in anns:
+                patches[key] = None
+        return ElasticDecision(effective, patches, events,
+                               reshard_reason)
+    if full and reshard_reason is None:
+        promote_at = parse_rfc3339(anns.get(ELASTIC_PROMOTE_AT_KEY, ""))
+        if promote_at is None:
+            patches[ELASTIC_PROMOTE_AT_KEY] = rfc3339(
+                now + promote_after_s
+            )
+        elif now >= promote_at:
+            target = rungs[rung - 1]
+            reshard_reason = (
+                f"promoting {effective.shorthand} -> "
+                f"{target.shorthand}: probing regrown capacity"
+            )
+            patches.update({
+                ELASTIC_SHAPE_KEY: (
+                    target.shorthand if rung - 1 > 0 else None
+                ),
+                ELASTIC_WORLD_SIZE_KEY: str(target.num_hosts),
+                ELASTIC_PROMOTE_AT_KEY: rfc3339(now + promote_after_s),
+                RESHARD_REASON_KEY: reshard_reason,
+            })
+            events.append((
+                "SlicePromoted",
+                f"{reshard_reason}; re-emitting StatefulSet at "
+                f"{target.num_hosts} worker(s) x "
+                f"{target.chips_per_replica} chips",
+                "Normal",
+            ))
+            effective = target
+    return ElasticDecision(
+        effective, patches, events, reshard_reason,
+        at_spec_shape=effective.shorthand == spec_slice.shorthand,
+    )

@@ -257,6 +257,14 @@ class ControllerMetrics:
             ["namespace"],
             registry=self.registry,
         )
+        self.notebook_reshard_total = Counter(
+            "notebook_reshard",
+            "Elastic topology transitions: the StatefulSet was "
+            "re-emitted at a different slice shape (mode: degrade = "
+            "down the fallback ladder, promote = back up)",
+            ["namespace", "mode"],
+            registry=self.registry,
+        )
         self.inference_preemption_restart_total = Counter(
             "inferenceservice_preemption_restart",
             "Coherent full-slice restarts of an InferenceService "

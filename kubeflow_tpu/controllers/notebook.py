@@ -9,10 +9,13 @@ capability parity with the reference notebook-controller
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
+import time
 
 from kubeflow_tpu import native
+from kubeflow_tpu.controllers import elastic
 from kubeflow_tpu.controllers.runtime import (
     Controller,
     Request,
@@ -106,10 +109,12 @@ class NotebookReconciler:
         api: FakeApiServer,
         options: NotebookOptions | None = None,
         prom=None,  # optional ControllerMetrics (metrics.py)
+        clock=time.time,  # elastic grace/promote timers (injectable)
     ):
         self.api = api
         self.options = options or NotebookOptions()
         self.prom = prom
+        self.clock = clock
 
     def _ensure(self, desired: dict) -> str:
         return ensure_object(self.api, desired)
@@ -123,9 +128,34 @@ class NotebookReconciler:
             # Deleted: children are garbage-collected via ownerReferences.
             return None
 
+        # One pod list shared by the elastic decision, gang restart,
+        # preemption recovery and the status mirror — all on the exact
+        # request path whose retry volume this platform meters. Pods
+        # only change between controller passes (the pod simulator /
+        # kubelet, never this reconciler's own ensures), so listing
+        # before desired-state generation is safe AND lets the elastic
+        # policy steer what gets generated.
+        pods = None
+        if (notebook.get("spec") or {}).get("tpu"):
+            pods = self.api.list(
+                "v1", "Pod", namespace=req.namespace,
+                label_selector=f"notebook-name={req.name}",
+            )
+        reshard_reason, elastic_shape = self._elastic(notebook, req, pods)
+        native_notebook = notebook
+        if elastic_shape is not None:
+            # Degraded-mode override: desired state is generated at the
+            # active rung's topology — the StatefulSet is re-emitted at
+            # the new replica count / per-host chip limits and the pods
+            # get the matching world-size env. The CR's spec is never
+            # touched; the override lives in annotations.
+            native_notebook = copy.deepcopy(notebook)
+            native_notebook["spec"]["tpu"]["topology"] = \
+                elastic_shape.topology
         out = native.invoke(
             "notebook_reconcile",
-            {"notebook": notebook, "options": self.options.to_native()},
+            {"notebook": native_notebook,
+             "options": self.options.to_native()},
         )
         try:
             sts_result = self._ensure(out["statefulset"])
@@ -162,26 +192,64 @@ class NotebookReconciler:
         if out["virtualService"] is not None:
             self._ensure(out["virtualService"])
 
-        # One STS get + one pod list shared by gang restart, preemption
-        # recovery and the status mirror — these run on every reconcile,
-        # on the exact request path whose retry volume this platform
-        # meters, so no step fetches what a sibling already has.
+        # STS re-fetched after the ensure so recovery and the status
+        # mirror see the replica count just emitted (an elastic
+        # transition changes it within this very pass).
         try:
             sts = self.api.get(
                 "apps/v1", "StatefulSet", req.name, req.namespace
             )
         except NotFound:
             sts = None
-        pods = None
-        if (notebook.get("spec") or {}).get("tpu"):
-            pods = self.api.list(
-                "v1", "Pod", namespace=req.namespace,
-                label_selector=f"notebook-name={req.name}",
-            )
         self._gang_restart(notebook, req, pods)
         restart_reason = self._preemption_recovery(notebook, req, sts, pods)
-        self._update_status(notebook, restart_reason, sts, pods)
+        self._update_status(notebook, restart_reason, sts, pods,
+                            reshard_reason=reshard_reason,
+                            elastic_shape=elastic_shape)
         return None
+
+    # ---- elastic topology ------------------------------------------------
+    def _elastic(self, notebook: dict, req: Request, pods: list | None):
+        """Run the degraded-mode policy (controllers/elastic.py) and
+        apply its verdict: annotation patches, transition events, and
+        the effective shape the desired-state generation must use.
+        Returns ``(reshard_reason, effective_slice_or_None)`` — None
+        when the spec shape is in force."""
+        decision = elastic.decide(notebook, pods, self.clock())
+        if decision is None:
+            return None, None
+        if decision.patches:
+            self.api.patch_merge(
+                NOTEBOOK_API, "Notebook", req.name,
+                {"metadata": {"annotations": decision.patches}},
+                req.namespace,
+            )
+            anns = notebook.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )
+            for key, value in decision.patches.items():
+                if value is None:
+                    anns.pop(key, None)
+                else:
+                    anns[key] = value
+        reshard_modes = {"SliceDegraded": "degrade",
+                         "SlicePromoted": "promote"}
+        for reason, message, event_type in decision.events:
+            record_event(
+                self.api, notebook, reason, message,
+                event_type=event_type,
+            )
+            mode = reshard_modes.get(reason)
+            if mode and self.prom is not None and hasattr(
+                self.prom, "notebook_reshard_total"
+            ):
+                self.prom.notebook_reshard_total.labels(
+                    req.namespace, mode
+                ).inc()
+        return (
+            decision.reshard_reason,
+            None if decision.at_spec_shape else decision.effective,
+        )
 
     def _gang_restart(self, notebook: dict, req: Request,
                       pods: list | None) -> None:
@@ -275,7 +343,9 @@ class NotebookReconciler:
     def _update_status(self, notebook: dict,
                        restart_reason: str | None = None,
                        sts: dict | None = None,
-                       pods: list | None = None) -> None:
+                       pods: list | None = None,
+                       reshard_reason: str | None = None,
+                       elastic_shape=None) -> None:
         name = notebook["metadata"]["name"]
         ns = notebook["metadata"]["namespace"]
         sts = sts or {}
@@ -327,12 +397,25 @@ class NotebookReconciler:
             },
         )
         cur_status = notebook.get("status") or {}
-        if restart_reason:
+        if reshard_reason:
+            # An elastic shape transition is in flight: it supersedes a
+            # lingering restart marker (the preemption that *triggered*
+            # the degrade) — Resharding tells the operator what the
+            # platform is actually doing about the lost capacity.
+            status["phase"] = "Resharding"
+            status["reshardReason"] = reshard_reason
+        elif restart_reason:
             # A coherent full-slice restart is in flight (preemption
             # recovery): surface it where the dashboard and kubectl
             # look, on top of the native-derived status.
             status["phase"] = "Restarting"
             status["restartReason"] = restart_reason
+        if elastic_shape is not None:
+            # Running (or converging) degraded: the effective shape and
+            # world size, for kubectl/dashboard — absent when the spec
+            # shape is in force.
+            status["elasticShape"] = elastic_shape.shorthand
+            status["elasticWorldSize"] = elastic_shape.num_hosts
         # Resume visibility: once a SliceRestarted stamped the expected
         # resume step, keep it on status until the next restart
         # rewrites it — "this notebook last resumed from step N".
@@ -349,16 +432,26 @@ class NotebookReconciler:
                 )
         if cur_status != status:
             patch = dict(status)
-            if not restart_reason:
-                # Merge-patch semantics: stale restart markers from a
-                # completed recovery must be removed explicitly (null
-                # deletes), or they would linger forever.
-                for key in ("phase", "restartReason"):
-                    if key in cur_status:
-                        patch[key] = None
-            if "resumedFromStep" not in status and \
-                    "resumedFromStep" in cur_status:
-                patch["resumedFromStep"] = None
+            # Merge-patch semantics: stale markers from a completed
+            # recovery/transition must be removed explicitly (null
+            # deletes), or they would linger forever. "phase" is only
+            # controller-owned while a restart/reshard is in flight.
+            for key in ("phase", "restartReason", "reshardReason",
+                        "resumedFromStep", "elasticShape",
+                        "elasticWorldSize"):
+                if key not in status and key in cur_status:
+                    patch[key] = None
+            # Same discipline one level down: merging an emptier
+            # containerState over {"running": {}} is a no-op (a merge
+            # patch cannot shrink a dict by being smaller), which would
+            # re-patch forever once a worker regresses Running→Pending
+            # (an elastic probe at a too-big shape does exactly that).
+            cur_cs = cur_status.get("containerState")
+            new_cs = status.get("containerState")
+            if isinstance(cur_cs, dict) and isinstance(new_cs, dict):
+                removed = {k: None for k in cur_cs if k not in new_cs}
+                if removed:
+                    patch["containerState"] = {**new_cs, **removed}
             self.api.patch_merge(
                 NOTEBOOK_API, "Notebook", name, {"status": patch}, ns
             )
@@ -368,8 +461,9 @@ def make_notebook_controller(
     api: FakeApiServer,
     options: NotebookOptions | None = None,
     prom=None,
+    clock=time.time,
 ) -> Controller:
-    reconciler = NotebookReconciler(api, options, prom=prom)
+    reconciler = NotebookReconciler(api, options, prom=prom, clock=clock)
     return Controller(
         name="notebook-controller",
         api=api,

@@ -98,7 +98,11 @@ class CheckpointMetrics:
     - ``checkpoint_save_duration_seconds`` (histogram)
     - ``checkpoint_last_committed_step`` (gauge)
     - ``checkpoint_restore_total{outcome}`` (counter; outcomes:
-      ``resumed``, ``skipped_corrupt``, ``none``)
+      ``resumed``, ``resumed_cross_topology`` — the agreed step's
+      manifest fingerprint disagrees with the live world (different
+      process count / device count / mesh shape) and the restore
+      re-assembled state under the new shardings — ``skipped_corrupt``,
+      ``none``)
     """
 
     def __init__(self, registry=None):
@@ -413,6 +417,10 @@ class CheckpointManager:
         # not share barrier/kv identities (write-once store).
         self._ns = hashlib.sha256(self.directory.encode()).hexdigest()[:8]
         self.last_error: BaseException | None = None
+        # Set by restore_latest_valid: {"step", "cross_topology",
+        # "mismatch"} of the restore that fed this run. The train loop
+        # reads it to label the resume downtime restore vs reshard.
+        self.last_restore: dict | None = None
 
     # ---- small internals -------------------------------------------------
     def _emit(self, point: str, **info) -> None:
@@ -758,6 +766,56 @@ class CheckpointManager:
         readable, every listed file present with a matching sha256."""
         return _validate_step_dir(self._step_dir(step))
 
+    def step_fingerprint(self, step: int) -> dict:
+        """The topology fingerprint a committed step was saved under
+        (process count, device count, caller extras such as the mesh
+        shape)."""
+        manifest = _read_manifest(self._step_dir(step))
+        fp = manifest.get("fingerprint")
+        return dict(fp) if isinstance(fp, dict) else {}
+
+    def _note_restored(self, step: int) -> None:
+        """Classify a successful restore: same-topology ``resumed``, or
+        an explicit cross-topology restore when the step's saved
+        fingerprint disagrees with the live world on any shared key
+        (process_count, device_count, mesh extras, backend). A mismatch
+        is NOT an error — sharding-aware assembly just rebuilt the
+        state under the new placements — but it must be visible: the
+        metric outcome, ``last_restore`` (the train loop labels resume
+        downtime reshard vs restore off it) and the log all say so."""
+        try:
+            saved = self.step_fingerprint(step)
+        except CheckpointCorrupt:
+            # The restore itself succeeded; a racing GC of the manifest
+            # only degrades the classification, never the restore.
+            saved = {}
+        # The saved side crossed JSON (tuples became lists); round-trip
+        # the live side too, or a tuple-valued fingerprint extra (e.g.
+        # {"mesh": spec.shape}) would read as a mismatch on the
+        # IDENTICAL topology.
+        current = json.loads(
+            json.dumps(self._fingerprint(), sort_keys=True, default=str)
+        )
+        mismatch = {
+            key: {"saved": saved[key], "current": current[key]}
+            for key in sorted(set(saved) & set(current))
+            if saved[key] != current[key]
+        }
+        self.last_restore = {
+            "step": int(step),
+            "cross_topology": bool(mismatch),
+            "mismatch": mismatch,
+        }
+        if mismatch:
+            self.metrics.observe_restore("resumed_cross_topology")
+            log.info(
+                "cross-topology restore of step %d: checkpoint was "
+                "saved under a different world (%s); state reassembled "
+                "under the current shardings", step, mismatch,
+            )
+        else:
+            self.metrics.observe_restore("resumed")
+
     # ---- restore ---------------------------------------------------------
     def restore(self, step: int, like, placements=None):
         """Restore one committed step into the shape of ``like``.
@@ -790,7 +848,7 @@ class CheckpointManager:
                 self.metrics.observe_restore("none")
                 return None
             state = self.restore(step, like, placements)  # loud on fail
-            self.metrics.observe_restore("resumed")
+            self._note_restored(step)
             return state, step
         for step in sorted(self.steps(), reverse=True):
             # One pass, no pre-validate: the load itself verifies
@@ -799,7 +857,7 @@ class CheckpointManager:
             # restore I/O on multi-GB checkpoints.
             try:
                 state = self.restore(step, like, placements)
-                self.metrics.observe_restore("resumed")
+                self._note_restored(step)
                 return state, step
             except CheckpointCorrupt as exc:
                 self.metrics.observe_restore("skipped_corrupt")

@@ -175,16 +175,21 @@ def run_steps(step, state, batches, telemetry=None):
     return state, metrics
 
 
-def _observe_synced(telemetry, metrics, batch, t0: float) -> None:
-    """Host-synced step timing shared by run_steps and
-    run_with_checkpointing: a scalar ``device_get`` forces the
+def _synced_step_seconds(metrics, t0: float) -> float:
+    """Host-synced step wall time: a scalar ``device_get`` forces the
     dependency chain (async dispatch would report enqueue time, not
     step time) before the wall clock is read."""
     if metrics:
         first = next(iter(metrics.values()))
         float(jax.device_get(first))
+    return time.perf_counter() - t0
+
+
+def _observe_synced(telemetry, metrics, batch, t0: float) -> None:
+    """Host-synced step timing shared by run_steps and
+    run_with_checkpointing."""
     batch_size = len(next(iter(batch.values())))
-    telemetry.observe(batch_size, time.perf_counter() - t0)
+    telemetry.observe(batch_size, _synced_step_seconds(metrics, t0))
 
 
 @dataclasses.dataclass
@@ -197,6 +202,10 @@ class RunReport:
     final_step: int = 0
     saves: int = 0
     preempted: bool = False
+    # True when the resume was a cross-topology restore (the checkpoint
+    # was saved under a different world size / mesh shape) — the run is
+    # continuing on a re-factored mesh, not the one that saved.
+    resharded: bool = False
 
 
 def run_with_checkpointing(
@@ -210,6 +219,7 @@ def run_with_checkpointing(
     mesh: Mesh | None = None,
     tp_rules: dict | None = None,
     telemetry=None,
+    goodput=None,
     install_signal_handler: bool = True,
     clock=time.monotonic,
 ):
@@ -242,6 +252,22 @@ def run_with_checkpointing(
       acted on (a grace save initiated by one rank can never commit —
       saves are collective) and costs at most a cadence of lost work
       when that rank dies.
+    - **elastic topology**: ``state`` (the restore template) lives on
+      the mesh the *current* incarnation runs — after a preemption
+      degraded the slice, that may be a different shape than the one
+      that saved. The restore path treats the fingerprint mismatch as
+      an explicit cross-topology restore (params AND optimizer state
+      are re-assembled under the new shardings, each rank reading only
+      its addressable regions) and the loop resumes at the new mesh;
+      ``report.resharded`` records that it happened. With a ``mesh``,
+      its device-grid shape is stamped into the manager's fingerprint
+      so the saves this run takes carry the topology the next
+      incarnation will compare against.
+    - **goodput**: with a :class:`kubeflow_tpu.obs.GoodputMeter`, every
+      completed step's host-synced seconds accrue as useful time and
+      the resume restore is measured as a ``restore`` (or ``reshard``)
+      downtime span — ``train_goodput_ratio`` then tracks useful-step
+      time vs wall clock across preempt/restore cycles.
 
     Returns ``(state, RunReport)``. ``batches`` yields per-step batch
     dicts; the caller owns data-order alignment with the global step
@@ -256,16 +282,42 @@ def run_with_checkpointing(
     from kubeflow_tpu.models import checkpoint as ckpt
 
     report = RunReport()
+    if mesh is not None and hasattr(manager, "fingerprint"):
+        # Saves from this run record the mesh's device grid; the next
+        # incarnation's restore compares against it, which is how a
+        # dp/fsdp/tp re-layout on the SAME device count still registers
+        # as a cross-topology restore. Assigned, not defaulted: the
+        # live mesh is the truth for THIS run — a manager reused across
+        # an in-process reshard must not keep stamping the old grid.
+        manager.fingerprint["mesh"] = [int(d) for d in mesh.devices.shape]
     placements = ckpt._compute_placements(
         ckpt._arrays_only(state), mesh, tp_rules
     ) if (mesh is not None or hasattr(state, "params")) else None
-    resumed = manager.restore_latest_valid(state, placements)
-    if resumed is not None:
-        state, step = resumed
+
+    def _resume():
+        resumed = manager.restore_latest_valid(state, placements)
+        if resumed is None:
+            return state, _state_step(state)
+        new_state, step = resumed
         report.resumed_from_step = step
-        log.info("resumed from checkpoint step %d", step)
+        info = getattr(manager, "last_restore", None) or {}
+        report.resharded = bool(info.get("cross_topology"))
+        if report.resharded:
+            log.info(
+                "resumed from checkpoint step %d onto a re-factored "
+                "mesh (%s)", step, info.get("mismatch"),
+            )
+        else:
+            log.info("resumed from checkpoint step %d", step)
+        return new_state, step
+
+    if goodput is not None:
+        with goodput.downtime("restore") as span:
+            state, step = _resume()
+            if report.resharded:
+                span.kind = "reshard"
     else:
-        step = _state_step(state)
+        state, step = _resume()
     report.start_step = report.final_step = step
 
     stop = threading.Event()
@@ -343,8 +395,14 @@ def run_with_checkpointing(
             state, metrics = step_fn(state, batch)
             step += 1
             report.final_step = step
-            if telemetry is not None:
-                _observe_synced(telemetry, metrics, batch, t0)
+            if telemetry is not None or goodput is not None:
+                seconds = _synced_step_seconds(metrics, t0)
+                if telemetry is not None:
+                    telemetry.observe(
+                        len(next(iter(batch.values()))), seconds
+                    )
+                if goodput is not None:
+                    goodput.observe_step(seconds)
         if preempted or (stop.is_set() and not agree):
             # Preemption grace window: one last synchronous checkpoint
             # (save() first drains the in-flight background save) so at

@@ -42,7 +42,7 @@ from kubeflow_tpu.obs.logging import (
     configure_structured_logging,
 )
 from kubeflow_tpu.obs.metrics import BucketHistogram, CANONICAL_LABELS
-from kubeflow_tpu.obs.telemetry import StepTelemetry
+from kubeflow_tpu.obs.telemetry import GoodputMeter, StepTelemetry
 from kubeflow_tpu.obs.trace import (
     TRACE_ANNOTATION,
     Span,
@@ -56,6 +56,7 @@ from kubeflow_tpu.obs.trace import (
 __all__ = [
     "BucketHistogram",
     "CANONICAL_LABELS",
+    "GoodputMeter",
     "JsonLogFormatter",
     "JsonlExporter",
     "MultiExporter",

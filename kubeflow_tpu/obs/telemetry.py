@@ -171,3 +171,198 @@ class StepTelemetry:
             ),
             "device": self.device_kind,
         }
+
+
+class _DowntimeSpan:
+    """Handle a :meth:`GoodputMeter.downtime` block mutates: set
+    ``.kind`` before the block exits to re-label the span (a restore
+    that turns out to be cross-topology becomes a ``reshard``)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+
+
+class GoodputMeter:
+    """Useful-step seconds vs wall clock across preempt/restore cycles.
+
+    MFU says how well a *step* used the chips; goodput says how much of
+    the job's *lifetime* was steps at all — the number preemption,
+    restore and resharding downtime actually move. The meter accumulates
+
+    - ``useful_s``  — host-synced seconds spent in completed train steps
+      (:meth:`observe_step`, fed by ``run_with_checkpointing``),
+    - ``downtime_s`` per kind — measured spans of known non-work
+      (``restore``, ``reshard``, caller-defined kinds) via
+      :meth:`downtime`, each also emitted as an obs tracer span,
+
+    against a wall clock running since construction (or since the
+    lineage started, when resumed from a :meth:`snapshot`). The ratio
+    lands on the ``train_goodput_ratio`` gauge; downtime totals on
+    ``train_downtime_seconds{kind}``.
+
+    Cross-incarnation accounting: a preempted pod's successor calls
+    :meth:`from_snapshot` with the predecessor's snapshot — the gap
+    between the snapshot's ``saved_at`` and now (the slice restart,
+    invisible to both processes) is charged as ``downtime["gap"]`` and
+    added to the carried wall clock, so goodput stays honest across
+    restarts instead of resetting with each incarnation.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        epoch_clock: Callable[[], float] = time.time,
+        registry=None,
+        tracer=None,
+    ):
+        self._clock = clock
+        self._epoch_clock = epoch_clock
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._started = clock()
+        self._carried_wall_s = 0.0
+        self.useful_s = 0.0
+        self.steps = 0
+        self.downtime_s: dict[str, float] = {}
+        self._gauges = self._make_gauges(registry)
+
+    def _make_gauges(self, registry):
+        try:
+            from prometheus_client import CollectorRegistry, Gauge
+        except ImportError:  # minimal worker images: in-process only
+            self.registry = None
+            return None
+        self.registry = registry or CollectorRegistry()
+        return {
+            "ratio": Gauge(
+                "train_goodput_ratio",
+                "Useful-step seconds / wall-clock seconds across "
+                "preempt, restore and reshard cycles",
+                registry=self.registry,
+            ),
+            "useful": Gauge(
+                "train_useful_step_seconds",
+                "Cumulative host-synced seconds spent in completed "
+                "training steps",
+                registry=self.registry,
+            ),
+            "downtime": Gauge(
+                "train_downtime_seconds",
+                "Cumulative measured non-work seconds by kind",
+                ["kind"],
+                registry=self.registry,
+            ),
+        }
+
+    # ---- recording -------------------------------------------------------
+    def observe_step(self, seconds: float) -> None:
+        """One completed, host-synced training step."""
+        with self._lock:
+            self.useful_s += max(float(seconds), 0.0)
+            self.steps += 1
+        self._export()
+
+    def record_downtime(self, kind: str, seconds: float) -> None:
+        with self._lock:
+            self.downtime_s[kind] = (
+                self.downtime_s.get(kind, 0.0) + max(float(seconds), 0.0)
+            )
+        self._export()
+
+    @contextlib.contextmanager
+    def downtime(self, kind: str):
+        """``with meter.downtime("restore") as span:`` around a known
+        non-work interval. The block may re-label via ``span.kind``
+        (e.g. "reshard" once the restore proves cross-topology). Also
+        emitted as a ``train downtime`` span on the obs tracer, so the
+        interval shows up in trace timelines next to the checkpoint
+        restore spans it contains."""
+        from kubeflow_tpu import obs
+
+        handle = _DowntimeSpan(kind)
+        tracer = self._tracer if self._tracer is not None \
+            else obs.get_tracer()
+        t0 = self._clock()
+        with tracer.span("train downtime") as span:
+            try:
+                yield handle
+            finally:
+                span.set_attribute("kind", handle.kind)
+                self.record_downtime(handle.kind, self._clock() - t0)
+
+    # ---- reading ---------------------------------------------------------
+    def wall_s(self) -> float:
+        with self._lock:
+            return self._carried_wall_s + (self._clock() - self._started)
+
+    def goodput_ratio(self) -> float:
+        """useful/wall in [0, 1]; 0.0 before any wall time elapsed."""
+        wall = self.wall_s()
+        if wall <= 0:
+            return 0.0
+        with self._lock:
+            return min(self.useful_s / wall, 1.0)
+
+    def summary(self) -> dict:
+        with self._lock:
+            downtime = dict(self.downtime_s)
+            useful = self.useful_s
+            steps = self.steps
+        return {
+            "kind": "goodput",
+            "wall_s": round(self.wall_s(), 6),
+            "useful_step_s": round(useful, 6),
+            "steps": steps,
+            "downtime_s": {k: round(v, 6)
+                           for k, v in sorted(downtime.items())},
+            "goodput_ratio": round(self.goodput_ratio(), 6),
+        }
+
+    def _export(self) -> None:
+        if self._gauges is None:
+            return
+        self._gauges["ratio"].set(self.goodput_ratio())
+        with self._lock:
+            self._gauges["useful"].set(self.useful_s)
+            for kind, total in self.downtime_s.items():
+                self._gauges["downtime"].labels(kind).set(total)
+
+    # ---- lineage (cross-incarnation) -------------------------------------
+    def snapshot(self) -> dict:
+        """Carryable state: wall/useful/downtime so far + the epoch
+        instant it was taken (``from_snapshot`` charges the gap)."""
+        with self._lock:
+            return {
+                "wall_s": self._carried_wall_s
+                + (self._clock() - self._started),
+                "useful_s": self.useful_s,
+                "steps": self.steps,
+                "downtime_s": dict(self.downtime_s),
+                "saved_at": self._epoch_clock(),
+            }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict, **kwargs) -> "GoodputMeter":
+        meter = cls(**kwargs)
+        with meter._lock:
+            meter._carried_wall_s = float(snap.get("wall_s", 0.0))
+            meter.useful_s = float(snap.get("useful_s", 0.0))
+            meter.steps = int(snap.get("steps", 0))
+            meter.downtime_s = {
+                str(k): float(v)
+                for k, v in (snap.get("downtime_s") or {}).items()
+            }
+            saved_at = snap.get("saved_at")
+            if saved_at is not None:
+                gap = max(
+                    float(meter._epoch_clock()) - float(saved_at), 0.0
+                )
+                if gap > 0:
+                    # The restart interval neither process could
+                    # measure: wall time between incarnations.
+                    meter._carried_wall_s += gap
+                    meter.downtime_s["gap"] = (
+                        meter.downtime_s.get("gap", 0.0) + gap
+                    )
+        meter._export()
+        return meter

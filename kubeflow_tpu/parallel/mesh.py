@@ -69,6 +69,69 @@ class MeshSpec:
     def shape(self) -> tuple[int, ...]:
         return (self.dp, self.pp, self.fsdp, self.tp, self.sp, self.ep)
 
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def refactor(self, n_devices: int) -> "MeshSpec":
+        """Deterministically re-factor a *resolved* spec onto a
+        different device count, preserving axis semantics — the data
+        plane's half of elastic topology (a preemption leaves a
+        smaller slice, or the queue frees a bigger one).
+
+        Shrinking divides axes in the order **dp, then fsdp, then tp**:
+        dp absorbs as much of the reduction as it can (re-dividing the
+        batch is semantically free), fsdp next (params re-shard but the
+        math is unchanged), tp last (kept widest the longest — tp width
+        interacts with kernel layouts). Growing multiplies **dp only**:
+        new capacity becomes data parallelism, so fsdp/tp shardings —
+        and therefore every checkpoint leaf's layout rules — survive
+        the transition. ``pp``/``sp``/``ep`` never change: pipeline
+        stages, sequence splits and expert counts are model structure,
+        not capacity, and silently re-factoring them would change the
+        model's numerics contract.
+
+        Raises ``ValueError`` when the spec is unresolved (``dp=-1``),
+        when ``n_devices`` is not an integer multiple/divisor of the
+        current size, or when a shrink cannot be absorbed by dp·fsdp·tp
+        — the caller must refuse the shape, not run a broken mesh.
+        """
+        if self.dp == -1:
+            raise ValueError("refactor() needs a resolved spec; call "
+                             "resolve(n_devices) first")
+        if n_devices < 1:
+            raise ValueError(f"cannot refactor to {n_devices} devices")
+        old = self.n_devices
+        if n_devices == old:
+            return self
+        if n_devices > old:
+            if n_devices % old:
+                raise ValueError(
+                    f"cannot grow {old} -> {n_devices} devices: not an "
+                    "integer multiple"
+                )
+            return dataclasses.replace(self, dp=self.dp * (n_devices // old))
+        if old % n_devices:
+            raise ValueError(
+                f"cannot shrink {old} -> {n_devices} devices: not an "
+                "integer divisor"
+            )
+        factor = old // n_devices
+        axes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp}
+        for name in ("dp", "fsdp", "tp"):
+            g = math.gcd(axes[name], factor)
+            axes[name] //= g
+            factor //= g
+            if factor == 1:
+                break
+        if factor != 1:
+            raise ValueError(
+                f"cannot shrink {self} to {n_devices} devices: "
+                f"dp*fsdp*tp cannot absorb a /{old // n_devices} "
+                "(pp/sp/ep are fixed model structure)"
+            )
+        return dataclasses.replace(self, **axes)
+
 
 def make_mesh(
     spec: MeshSpec | None = None, devices: Sequence[jax.Device] | None = None

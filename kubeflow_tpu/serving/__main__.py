@@ -13,6 +13,10 @@ the CR template):
 - ``KFT_SERVING_MAX_BATCH`` / ``KFT_SERVING_MAX_LEN`` — decode slots /
   slot capacity. ``KFT_SERVING_EOS`` — optional eos token id.
   ``KFT_SERVING_PORT`` — HTTP port (default 8800).
+- ``KFT_SERVING_PREFILL_CHUNK`` — chunked-prefill admission threshold:
+  prompts longer than this many tokens prefill one chunk per cycle so
+  a single 32k prompt cannot monopolise a batch cycle (unset =
+  monolithic prefill).
 """
 
 from __future__ import annotations
@@ -91,11 +95,16 @@ def main(argv=None) -> None:
             log.warning("no valid checkpoint under %s; serving "
                         "initialised params", model_dir)
     eos = env.get("KFT_SERVING_EOS")
+    chunk = env.get("KFT_SERVING_PREFILL_CHUNK")
     engine = make_engine(
         cfg, params,
         max_batch=int(env.get("KFT_SERVING_MAX_BATCH", "8")),
         max_len=int(env.get("KFT_SERVING_MAX_LEN", "2048")),
         eos_token=int(eos) if eos else None,
+        # Chunked-prefill admission: prompts longer than this prefill
+        # in chunks across cycles so one 32k prompt cannot monopolise
+        # a batch cycle. Unset = monolithic prefill.
+        prefill_chunk_tokens=int(chunk) if chunk else None,
     )
     gateway = InferenceGateway(
         engine,

@@ -159,6 +159,18 @@ def extend_slot(cfg: LMConfig, params, state: BatchState, slot,
         logits[:, -1]
 
 
+def advance_cache(cfg: LMConfig, params, tokens, cache: KVCache):
+    """One prefill chunk with NO slot splice: run ``tokens`` on top of
+    ``cache`` (the mid-sequence chunk path of forward_with_cache) and
+    return the advanced cache + last-position logits. The chunked-
+    prefill admission path drives this once per cycle until only the
+    final chunk remains (which goes through :func:`extend_slot` so the
+    first token is sampled and the slot spliced atomically)."""
+    logits, cache = forward_with_cache(cfg, params, tokens, cache,
+                                       last_logits_only=True)
+    return cache, logits[:, -1]
+
+
 def adopt_slot(state: BatchState, slot, cache: KVCache, logits, temp,
                first_key):
     """Exact prompt match: no model work at all — sample the first
@@ -276,14 +288,37 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                  max_len: int, eos_token: int | None = None,
                  step_chunk: int = 8, quantize_cache: bool = False,
                  prefill_per_cycle: int = 2, max_pending: int = 64,
-                 prefix_cache_size: int = 8):
+                 prefix_cache_size: int = 8,
+                 prefill_chunk_tokens: int | None = None):
         ContinuousBatcher.__init__(
             self, cfg, params, max_batch, max_len, eos_token=eos_token,
             step_chunk=step_chunk, quantize_cache=quantize_cache)
         _EngineBase.__init__(self, max_pending=max_pending)
         if prefill_per_cycle < 1:
             raise ValueError("prefill_per_cycle must be >= 1")
+        if prefill_chunk_tokens is not None:
+            if prefill_chunk_tokens < 1:
+                raise ValueError("prefill_chunk_tokens must be >= 1")
+            if self.rolling:
+                # Chunked admission rides the linear-slot splice path
+                # (the final chunk lands through extend_slot); a
+                # rolling ring's slot<->position mapping depends on the
+                # writer's history, so a chunked ring is not
+                # spliceable — same restriction as the prefix cache.
+                raise ValueError(
+                    "chunked prefill requires linear slots "
+                    "(cfg.attn_window makes this engine rolling)"
+                )
         self.prefill_per_cycle = prefill_per_cycle
+        # Chunked-prefill admission: a prompt whose (uncached) length
+        # exceeds this many tokens is prefilled in chunks of this size,
+        # ONE chunk per cycle, instead of one monolithic dispatch — a
+        # 32k prompt can no longer stall every in-flight stream for its
+        # whole prefill. One partial at a time (each pins a B=1
+        # slot-capacity KV cache); short prompts keep flowing past it.
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self._partial: dict | None = None
+        self.chunked_admissions_total = 0
         self.swaps_total = 0
         self.draining = False
         # Rolling slots: a circular buffer's slot<->position mapping
@@ -307,6 +342,13 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                             temp, key),
                 donate_argnums=(1,))
             self._adopt = jax.jit(adopt_slot, donate_argnums=(0,))
+            # Chunk advance donates the partial's private cache (each
+            # chunk consumes its predecessor); a shared prefix-cache
+            # entry is copied before its first donated use.
+            self._advance = jax.jit(
+                lambda params, tokens, cache:
+                advance_cache(cfg, params, tokens, cache),
+                donate_argnums=(2,))
 
     # ------------------------------------------------------ submission
     def submit(self, *args, **kwargs):
@@ -356,6 +398,11 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                     # Cached KV was computed by the OLD weights; mixing
                     # it with new weights would serve silent garbage.
                     self.prefix_cache.clear()
+                if self._partial is not None:
+                    # Same staleness: the partial's chunks ran under
+                    # the old weights — restart its prefill from token
+                    # zero under the new ones.
+                    self._restart_partial()
                 self.swaps_total += 1
                 self.draining = False
         else:
@@ -367,7 +414,7 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
             with self._lock:
                 busy = (bool(self._queue) or bool(self._inbox)
                         or self._pending_params is not None)
-            return busy
+            return busy or self._partial is not None
         started = time.monotonic()
         keys = self._chunk_keys()
         self.state, toks = self._chunk(self.params, self.state, keys)
@@ -389,7 +436,28 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
 
     def _admit_capped(self) -> int:
         admitted = 0
+        # The in-flight chunked prefill advances FIRST (oldest work
+        # wins one unit of the cycle's prefill budget), then fresh
+        # admissions fill the rest.
+        if self._partial is not None:
+            self._advance_partial()
+            admitted += 1
+        deferred = []
         while self._queue and admitted < self.prefill_per_cycle:
+            head = self._queue[0]
+            if (self.prefill_chunk_tokens is not None
+                    and len(head["prompt"]) > self.prefill_chunk_tokens):
+                self._queue.popleft()
+                if self._partial is None:
+                    self._start_partial(head)
+                    admitted += 1
+                else:
+                    # One chunking prompt at a time (each pins a B=1
+                    # slot-capacity KV); later long prompts wait, but
+                    # the short prompts behind them must NOT — skip
+                    # over, preserve relative order.
+                    deferred.append(head)
+                continue
             free = next((i for i, s in enumerate(self._slots)
                          if s is None), None)
             if free is None:
@@ -405,7 +473,115 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
             if req["done"]:
                 self._finish(req)
                 self._free(free)
+        for req in reversed(deferred):
+            self._queue.appendleft(req)
         return admitted
+
+    # ------------------------------------------- chunked prefill path
+    @staticmethod
+    def _copy_cache(cache: KVCache) -> KVCache:
+        """Private copy of a shared prefix-cache KV: the chunk advance
+        donates its cache input, and donating a cached entry would
+        invalidate it for every later request."""
+        return jax.tree_util.tree_map(lambda leaf: leaf.copy(), cache)
+
+    def _fresh_partial_cache(self) -> KVCache:
+        return KVCache.init(self.cfg, 1, self.state.k.shape[3],
+                            quantized=self.state.quantized)
+
+    def _start_partial(self, req: dict) -> None:
+        """Begin chunked admission of a long prompt: resolve the cached
+        prefix (if any), then run the first chunk. The request holds a
+        private B=1 cache until the final chunk splices it into a slot
+        (extend_slot — sample + splice stay atomic)."""
+        self._note_admitted()  # admitted to compute, no longer queued
+        self.chunked_admissions_total += 1
+        prompt = req["prompt"]
+        entry, plen = (None, 0)
+        if self.prefix_cache is not None:
+            entry, plen = self.prefix_cache.lookup(tuple(prompt))
+        if entry is not None:
+            req["cache_hit"] = True
+            # Shared with the prefix cache: copied lazily, only if a
+            # donating chunk advance actually runs — the exact-match
+            # adopt and the single-final-chunk extend never donate the
+            # cache, so they must not pay a full KV copy.
+            req["_cache"] = entry.cache
+            req["_shared_cache"] = True
+            req["_logits"] = entry.logits
+            req["_pos"] = plen
+        else:
+            req["cache_hit"] = False
+            req["_cache"] = self._fresh_partial_cache()
+            req["_shared_cache"] = False
+            req["_logits"] = None
+            req["_pos"] = 0
+        self._partial = req
+        self._advance_partial()
+
+    def _restart_partial(self) -> None:
+        req = self._partial
+        if req is None:
+            return
+        req["cache_hit"] = False
+        req["_cache"] = self._fresh_partial_cache()
+        req["_shared_cache"] = False
+        req["_logits"] = None
+        req["_pos"] = 0
+
+    def _advance_partial(self) -> None:
+        """One cycle's worth of the in-flight chunked prefill: a middle
+        chunk advances the private cache; the final (<= chunk) tokens
+        go through extend_slot into a free slot — or wait for one."""
+        req = self._partial
+        prompt = req["prompt"]
+        chunk = self.prefill_chunk_tokens
+        remaining = len(prompt) - req["_pos"]
+        if remaining > chunk:
+            tokens = jnp.asarray(
+                [prompt[req["_pos"]:req["_pos"] + chunk]], jnp.int32
+            )
+            if req.pop("_shared_cache", False):
+                # _advance donates its cache input; a prefix-cache
+                # entry must survive for later requests — private copy
+                # now, exactly once, only on this (donating) path.
+                req["_cache"] = self._copy_cache(req["_cache"])
+            req["_cache"], req["_logits"] = self._advance(
+                self.params, tokens, req["_cache"]
+            )
+            req["_pos"] += chunk
+            return
+        free = next((i for i, s in enumerate(self._slots)
+                     if s is None), None)
+        if free is None:
+            return  # chunks done; waiting for a slot to splice into
+        temp = jnp.float32(req["temp"])
+        key = req["first_key"]
+        if remaining == 0:
+            # Exact prefix-cache match longer than the chunk threshold:
+            # all tokens were already cached — adopt, like the un-
+            # chunked path would have.
+            self.state, first = self._adopt(
+                self.state, jnp.int32(free), req["_cache"],
+                req["_logits"], temp, key)
+        else:
+            suffix = jnp.asarray([prompt[req["_pos"]:]], jnp.int32)
+            self.state, first, cache, logits = self._extend(
+                self.params, self.state, jnp.int32(free), req["_cache"],
+                suffix, temp, key)
+            if self.prefix_cache is not None:
+                self.prefix_cache.put(prompt, CacheEntry(cache, logits))
+        first = int(first)
+        self._partial = None
+        for scratch in ("_cache", "_logits", "_pos", "_shared_cache"):
+            req.pop(scratch, None)
+        self._results[req["id"]] = [first]
+        self._slots[free] = req
+        self._emit(req, {"token": first})
+        self._check_done(req, first)
+        if req["done"]:
+            self._finish(req)
+            self._free(free)
 
     def _prefill_into(self, slot: int, req: dict) -> int:
         prompt = req["prompt"]
@@ -540,18 +716,36 @@ def make_engine(cfg: LMConfig, params, max_batch: int = 8,
                 max_len: int = 2048, eos_token: int | None = None,
                 step_chunk: int = 8, quantize_cache: bool = False,
                 prefill_per_cycle: int = 2, max_pending: int = 64,
-                prefix_cache_size: int = 8):
+                prefix_cache_size: int = 8,
+                prefill_chunk_tokens: int | None = None):
     """Best engine the model supports: the streaming batcher, or the
     serialized ``generate()`` fallback when the batcher refuses the
-    config (MoE decode) — the gateway keeps serving either way."""
-    try:
+    config (MoE decode) — the gateway keeps serving either way. A
+    chunked-prefill request on a rolling (windowed-attention) model
+    likewise degrades — to monolithic prefill — instead of refusing to
+    serve: a tuning flag must never CrashLoop a pod that served fine
+    without it."""
+    def build(chunk):
         return StreamingBatcher(
             cfg, params, max_batch=max_batch, max_len=max_len,
             eos_token=eos_token, step_chunk=step_chunk,
             quantize_cache=quantize_cache,
             prefill_per_cycle=prefill_per_cycle,
             max_pending=max_pending,
-            prefix_cache_size=prefix_cache_size)
+            prefix_cache_size=prefix_cache_size,
+            prefill_chunk_tokens=chunk)
+
+    try:
+        try:
+            return build(prefill_chunk_tokens)
+        except ValueError as exc:
+            if prefill_chunk_tokens is None or \
+                    "linear slots" not in str(exc):
+                raise
+            log.warning(
+                "chunked prefill unavailable (%s); serving with "
+                "monolithic prefill", exc)
+            return build(None)
     except NotImplementedError as exc:
         log.warning(
             "continuous batching unavailable (%s); serving through "

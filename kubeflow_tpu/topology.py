@@ -185,6 +185,55 @@ class TpuSlice:
         return {TPU_RESOURCE: str(self.chips_per_replica)}
 
 
+def fallback_ladder(slice_: TpuSlice) -> list[TpuSlice]:
+    """Degraded-mode shapes for elastic resume, largest first.
+
+    Successive halvings of the chip count within the same accelerator
+    generation, down to one full host's worth of chips (a fraction of a
+    host is not a schedulable TPU shape): v5e-16 → [v5e-8, v5e-4].
+    Every rung is a canonical GKE topology, so the controller can
+    re-emit the StatefulSet for any of them verbatim. The slice itself
+    is NOT in the ladder — rung 0 is always the spec's own shape.
+    """
+    acc = slice_.accelerator
+    table = _TOPO_2D if acc.ndims == 2 else _TOPO_3D
+    out = []
+    chips = slice_.chips // 2
+    while chips >= acc.chips_per_host:
+        if chips in table:
+            out.append(TpuSlice.parse(acc.name, table[chips]))
+        chips //= 2
+    return out
+
+
+def parse_ladder(slice_: TpuSlice, raw: str) -> list[TpuSlice]:
+    """A fallback ladder from its annotation value: ``"auto"`` derives
+    :func:`fallback_ladder`; otherwise a comma-separated shorthand list
+    ("v5e-8,v5e-4"). Raises :class:`TopologyError` on malformed
+    entries, a different accelerator generation (a slice cannot change
+    generation by being preempted), or a non-decreasing chip sequence
+    (the ladder must be a strict fallback order)."""
+    raw = (raw or "").strip()
+    if not raw or raw.lower() == "auto":
+        return fallback_ladder(slice_)
+    rungs = []
+    prev = slice_.chips
+    for token in raw.split(","):
+        rung = TpuSlice.from_shorthand(token.strip())
+        if rung.accelerator.name != slice_.accelerator.name:
+            raise TopologyError(
+                f"ladder rung {token.strip()!r} is a different "
+                f"accelerator than the slice ({slice_.shorthand})"
+            )
+        if rung.chips >= prev:
+            raise TopologyError(
+                f"ladder must strictly decrease in chips: {raw!r}"
+            )
+        prev = rung.chips
+        rungs.append(rung)
+    return rungs
+
+
 def spawner_presets(accelerators: list[str] | None = None) -> list[dict]:
     """Topology options for the spawner UI config (replaces the reference's
     GPU vendors list, ``spawner_ui_config.yaml:120-143``)."""

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Elastic-topology gate for CI: training must survive capacity changes
+# by resuming on a different slice shape, not by waiting for the exact
+# original topology — and the cost must be measured, not assumed.
+#
+# The fast subset (tier-1 style) runs:
+#   - the seeded capacity-timeline weather + capacity-aware simulator,
+#   - the control-plane ladder scenario (v5e-16 → v5e-8 → v5e-16:
+#     degrade after grace, StatefulSet re-emitted at the new replica
+#     count/chip limits, status.phase=Resharding, promote back up),
+#   - the cross-topology restore matrix (mesh→smaller, mesh→bigger,
+#     dp/fsdp re-layouts, optimizer-state resharding, refusals),
+#   - the data-plane scenario (resume at each shape, ≤ one checkpoint
+#     cadence lost per transition, bit-identical parity against an
+#     uninterrupted run, goodput ≥ the scenario target).
+#
+# RUN_SLOW=1 adds the 2-process jax.distributed cross-topology matrix
+# (real OS processes save under one layout, restore under another).
+#
+# The goodput summary lands as a JSON artifact next to the BENCH files
+# (override with KFT_ELASTIC_GOODPUT_JSON). Everything is seeded: a
+# failure replays exactly. See docs/operations.md
+# "Elastic topology & goodput".
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export KFT_ELASTIC_GOODPUT_JSON="${KFT_ELASTIC_GOODPUT_JSON:-$PWD/GOODPUT_elastic.json}"
+
+# The cross-topology matrix class runs in FULL here regardless of slow
+# markers — the gate is its dedicated home; tier-1 keeps only the
+# shrink row in-cap.
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  python -m pytest tests/test_elastic.py \
+    "tests/test_checkpoint.py::TestCrossTopologyRestore" \
+    "tests/test_checkpoint.py::test_multihost_cross_topology_restore_two_processes" \
+    tests/test_topology.py tests/test_parallel.py -q
+else
+  python -m pytest "tests/test_checkpoint.py::TestCrossTopologyRestore" -q
+  python -m pytest tests/test_elastic.py \
+    tests/test_topology.py tests/test_parallel.py -q -m 'not slow'
+fi
+
+if [[ -f "$KFT_ELASTIC_GOODPUT_JSON" ]]; then
+  echo "goodput summary artifact: $KFT_ELASTIC_GOODPUT_JSON"
+  cat "$KFT_ELASTIC_GOODPUT_JSON"
+else
+  echo "ERROR: goodput summary artifact was not produced" >&2
+  exit 1
+fi

@@ -220,11 +220,93 @@ def main_ckpt():
     print(f"DONE {jax.process_index()}", flush=True)
 
 
+def main_reshard():
+    """KFT_TEST_MODE=reshard: cross-topology restore over a real
+    jax.distributed world — the state is SAVED under a pure-dp layout,
+    then RESTORED under an fsdp layout of the same world (the dp/fsdp
+    re-layout row of the elastic matrix). Every rank assembles only the
+    regions its new shardings make addressable from the mmap'd shard
+    payloads, and the restore is classified cross-topology off the
+    manifest's mesh fingerprint."""
+    denv = initialize_from_env()
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.models.checkpoint import (
+        CheckpointManager,
+        CheckpointMetrics,
+    )
+    from kubeflow_tpu.parallel import MeshSpec, make_mesh
+
+    world = len(jax.devices())
+    spec_a = MeshSpec(dp=-1).resolve(world)
+    mesh_a = make_mesh(spec_a, jax.devices())
+    values = np.arange(world * 4 * 8, dtype=np.float32).reshape(-1, 8)
+    momentum = values * 0.5  # stands in for optimizer state
+    sharding_a = NamedSharding(mesh_a, P("dp"))
+
+    def put(arr, sharding):
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    state = {
+        "w": put(values, sharding_a),
+        "m": put(momentum, sharding_a),
+        "step": put(np.int32(5), NamedSharding(mesh_a, P())),
+    }
+    manager = CheckpointManager(
+        os.environ["KFT_CKPT_DIR"],
+        process_id=jax.process_index(),
+        process_count=denv.num_processes,
+        fingerprint={"mesh": list(spec_a.shape)},
+    )
+    manager.save(5, state)
+    print(f"SAVED {jax.process_index()} steps={manager.steps()}",
+          flush=True)
+
+    # Same world, re-factored layout: everything that was dp becomes
+    # fsdp (the shrink direction of MeshSpec.refactor re-lays exactly
+    # like this when dp cannot absorb the whole change).
+    spec_b = MeshSpec(dp=1, fsdp=world).resolve(world)
+    mesh_b = make_mesh(spec_b, jax.devices())
+    sharding_b = NamedSharding(mesh_b, P(None, "fsdp"))
+    like = {"w": np.zeros_like(values), "m": np.zeros_like(momentum),
+            "step": np.int32(0)}
+    placements = {"w": sharding_b, "m": sharding_b,
+                  "step": NamedSharding(mesh_b, P())}
+    metrics = CheckpointMetrics()
+    manager2 = CheckpointManager(
+        os.environ["KFT_CKPT_DIR"],
+        process_id=jax.process_index(),
+        process_count=denv.num_processes,
+        metrics=metrics,
+        fingerprint={"mesh": list(spec_b.shape)},
+    )
+    restored, step = manager2.restore_latest_valid(like, placements)
+    assert step == 5, step
+    assert manager2.last_restore["cross_topology"], manager2.last_restore
+    assert metrics.restore_total.get("resumed_cross_topology") == 1, (
+        metrics.restore_total
+    )
+    for key, ref in (("w", values), ("m", momentum)):
+        for shard in restored[key].addressable_shards:
+            assert np.array_equal(np.asarray(shard.data), ref[shard.index])
+    assert int(jax.device_get(restored["step"])) == 5
+    print(f"RESHARD {jax.process_index()} step={step} cross=1",
+          flush=True)
+    print(f"DONE {jax.process_index()}", flush=True)
+
+
 if __name__ == "__main__":
     mode = os.environ.get("KFT_TEST_MODE")
     if mode == "ring4":
         main_ring()
     elif mode == "ckpt":
         main_ckpt()
+    elif mode == "reshard":
+        main_reshard()
     else:
         main()

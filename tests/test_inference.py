@@ -746,3 +746,173 @@ class TestGatewayMetricsSchema:
             for sample in family.samples:
                 bad = set(sample.labels) - obs.CANONICAL_LABELS
                 assert not bad, f"{sample.name}: {sorted(bad)}"
+
+
+class TestChunkedPrefill:
+    """Chunked-prefill admission (ROADMAP item 1 follow-up): a prompt
+    longer than ``prefill_chunk_tokens`` prefills one chunk per cycle
+    instead of one monolithic dispatch — a 32k prompt cannot
+    monopolise a batch cycle — while short prompts behind it keep their
+    TTFT and every stream stays token-identical to ``generate()``."""
+
+    def _collect(self, events, rid):
+        def sink(event):
+            events.setdefault(rid, []).append(event)
+        return sink
+
+    def _done(self, events, rid):
+        done = [e for e in events.get(rid, []) if e.get("done")]
+        return done[0] if done else None
+
+    def test_long_prompt_chunks_without_stalling_shorts(self, lm):
+        import numpy as np
+
+        from kubeflow_tpu.models.decoding import generate
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg, params = lm
+        engine = StreamingBatcher(
+            cfg, params, max_batch=4, max_len=160,
+            prefill_per_cycle=2, prefill_chunk_tokens=16,
+        )
+        rng = np.random.default_rng(3)
+        long_prompt = [int(t) for t in rng.integers(0, cfg.vocab, 80)]
+        shorts = [[int(t) for t in rng.integers(0, cfg.vocab, 5)]
+                  for _ in range(2)]
+        events: dict = {}
+        engine.submit_stream(long_prompt, self._collect(events, "long"),
+                             max_new_tokens=6)
+        for i, prompt in enumerate(shorts):
+            engine.submit_stream(prompt, self._collect(events, f"s{i}"),
+                                 max_new_tokens=6)
+        shorts_done_at = None
+        for cycle in range(200):
+            if not engine.step_cycle():
+                break
+            if shorts_done_at is None and all(
+                self._done(events, f"s{i}") for i in range(2)
+            ):
+                shorts_done_at = cycle
+        assert self._done(events, "long"), "long prompt never finished"
+        # Interleaving held: the shorts finished while the 80-token
+        # prompt was still chunking (80/16 = 5 chunk cycles minimum).
+        assert shorts_done_at is not None and shorts_done_at < 4
+        assert engine.chunked_admissions_total == 1
+
+        # Token parity for every stream, chunked or not.
+        for rid, prompt in (("long", long_prompt), ("s0", shorts[0]),
+                            ("s1", shorts[1])):
+            import jax
+            import jax.numpy as jnp
+
+            ref = generate(cfg, params,
+                           jnp.asarray([prompt], jnp.int32), 6)
+            assert self._done(events, rid)["tokens"] == [
+                int(t) for t in jax.device_get(ref[0])
+            ], rid
+
+    def test_chunked_prompt_lands_in_prefix_cache(self, lm):
+        import numpy as np
+
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg, params = lm
+        engine = StreamingBatcher(
+            cfg, params, max_batch=2, max_len=160,
+            prefill_per_cycle=1, prefill_chunk_tokens=16,
+        )
+        rng = np.random.default_rng(4)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab, 40)]
+        events: dict = {}
+        engine.submit_stream(prompt, self._collect(events, "a"),
+                             max_new_tokens=4)
+        engine.drain()
+        first = self._done(events, "a")
+        assert first and first["cache_hit"] is False
+        # Second submission of the same prompt: exact prefix-cache
+        # adoption — chunked admission, zero model prefill work.
+        engine.submit_stream(prompt, self._collect(events, "b"),
+                             max_new_tokens=4)
+        engine.drain()
+        second = self._done(events, "b")
+        assert second and second["cache_hit"] is True
+        assert second["tokens"] == first["tokens"]
+
+    @pytest.mark.slow  # compile-heavy; serving_gate runs it
+    def test_second_long_prompt_defers_without_blocking_shorts(self, lm):
+        import numpy as np
+
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg, params = lm
+        engine = StreamingBatcher(
+            cfg, params, max_batch=4, max_len=160,
+            prefill_per_cycle=2, prefill_chunk_tokens=16,
+        )
+        rng = np.random.default_rng(5)
+        long_a = [int(t) for t in rng.integers(0, cfg.vocab, 64)]
+        long_b = [int(t) for t in rng.integers(0, cfg.vocab, 64)]
+        short = [int(t) for t in rng.integers(0, cfg.vocab, 4)]
+        events: dict = {}
+        engine.submit_stream(long_a, self._collect(events, "a"),
+                             max_new_tokens=4)
+        engine.submit_stream(long_b, self._collect(events, "b"),
+                             max_new_tokens=4)
+        engine.submit_stream(short, self._collect(events, "s"),
+                             max_new_tokens=4)
+        engine.step_cycle()
+        # One partial at a time; the short skipped past the deferred
+        # second long prompt in the very first cycle.
+        assert events.get("s"), "short prompt saw no token in cycle 1"
+        engine.drain()
+        assert self._done(events, "a") and self._done(events, "b")
+        assert engine.chunked_admissions_total == 2
+
+    def test_rolling_slots_reject_chunked_prefill(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg = LMConfig(vocab=64, layers=1, dim=32, heads=2,
+                       attn_window=16)
+        model = build_lm(cfg, use_flash=False)
+        params = create_lm_state(model, jax.random.key(0), (1, 16)).params
+        with pytest.raises(ValueError, match="linear slots"):
+            StreamingBatcher(cfg, params, max_batch=2, max_len=64,
+                             prefill_chunk_tokens=8)
+
+    @pytest.mark.slow  # compile-heavy; serving_gate runs it
+    def test_hot_swap_restarts_inflight_partial(self, lm):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.models.decoding import generate
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        cfg, params = lm
+        engine = StreamingBatcher(
+            cfg, params, max_batch=2, max_len=160,
+            prefill_per_cycle=1, prefill_chunk_tokens=16,
+        )
+        rng = np.random.default_rng(6)
+        prompt = [int(t) for t in rng.integers(0, cfg.vocab, 64)]
+        events: dict = {}
+        engine.submit_stream(prompt, self._collect(events, "x"),
+                             max_new_tokens=4)
+        engine.step_cycle()  # first chunk under the OLD weights
+        new_params = jax.tree.map(lambda p: p * 0 + p, params)
+        engine.swap_params(new_params)
+        engine.drain()
+        done = self._done(events, "x")
+        assert done is not None
+        # The whole prompt was re-prefilled under the NEW weights:
+        # token-identical to generate() with them.
+        import jax.numpy as jnp
+
+        ref = generate(cfg, new_params, jnp.asarray([prompt], jnp.int32),
+                       4)
+        assert done["tokens"] == [int(t)
+                                  for t in jax.device_get(ref[0])]
+        assert engine.swaps_total == 1

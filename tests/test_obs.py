@@ -891,3 +891,93 @@ class TestWebhookSpan:
             assert span["attributes"]["allowed"] is True
         finally:
             server.stop()
+
+
+# ---------------------------------------------------------------------------
+# GoodputMeter (elastic topology, ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+class TestGoodputMeter:
+    """Useful-step time vs wall clock, with measured downtime spans and
+    cross-incarnation carry — all on injected clocks, so every number
+    here is exact."""
+
+    def _meter(self, tracer=None):
+        clock = {"t": 0.0, "epoch": 1000.0}
+        meter = obs.GoodputMeter(
+            clock=lambda: clock["t"],
+            epoch_clock=lambda: clock["epoch"],
+            tracer=tracer,
+        )
+        return meter, clock
+
+    def test_ratio_is_useful_over_wall(self):
+        meter, clock = self._meter()
+        clock["t"] = 100.0
+        for _ in range(8):
+            meter.observe_step(10.0)
+        assert meter.wall_s() == 100.0
+        assert meter.goodput_ratio() == pytest.approx(0.8)
+        summary = meter.summary()
+        assert summary["steps"] == 8
+        assert summary["useful_step_s"] == pytest.approx(80.0)
+        assert summary["goodput_ratio"] == pytest.approx(0.8)
+
+    def test_downtime_spans_accumulate_by_kind_and_trace(self):
+        exporter = obs.RingExporter(capacity=16)
+        tracer = obs.Tracer(exporter=exporter)
+        meter, clock = self._meter(tracer=tracer)
+        with meter.downtime("restore"):
+            clock["t"] += 7.0
+        with meter.downtime("restore") as span:
+            clock["t"] += 5.0
+            span.kind = "reshard"  # restore proved cross-topology
+        assert meter.downtime_s == {"restore": 7.0, "reshard": 5.0}
+        kinds = [s["attributes"]["kind"] for s in exporter.spans()
+                 if s["name"] == "train downtime"]
+        assert sorted(kinds) == ["reshard", "restore"]
+
+    def test_snapshot_carries_lineage_and_charges_the_gap(self):
+        meter, clock = self._meter()
+        clock["t"] = 50.0
+        meter.observe_step(30.0)
+        meter.record_downtime("restore", 4.0)
+        snap = meter.snapshot()
+        assert snap["wall_s"] == 50.0 and snap["saved_at"] == 1000.0
+
+        # The successor starts 25 epoch-seconds later (the slice
+        # restart neither process could measure).
+        clock2 = {"t": 0.0, "epoch": 1025.0}
+        meter2 = obs.GoodputMeter.from_snapshot(
+            snap, clock=lambda: clock2["t"],
+            epoch_clock=lambda: clock2["epoch"],
+        )
+        assert meter2.downtime_s["gap"] == 25.0
+        assert meter2.wall_s() == 75.0  # carried 50 + gap 25
+        clock2["t"] = 25.0
+        meter2.observe_step(30.0)
+        assert meter2.steps == 2
+        assert meter2.wall_s() == 100.0
+        assert meter2.goodput_ratio() == pytest.approx(0.6)
+        assert meter2.downtime_s["restore"] == 4.0
+
+    def test_zero_wall_is_not_a_division_error(self):
+        meter, _clock = self._meter()
+        assert meter.goodput_ratio() == 0.0
+
+    def test_prometheus_gauges_when_available(self):
+        prometheus_client = pytest.importorskip("prometheus_client")
+        meter, clock = self._meter()
+        clock["t"] = 10.0
+        meter.observe_step(5.0)
+        meter.record_downtime("restore", 2.0)
+        sample = {
+            s.name: s.value
+            for metric in meter.registry.collect()
+            for s in metric.samples
+        }
+        assert sample["train_goodput_ratio"] == pytest.approx(0.5)
+        assert sample["train_useful_step_seconds"] == pytest.approx(5.0)
+        got = prometheus_client.generate_latest(meter.registry).decode()
+        assert 'train_downtime_seconds{kind="restore"} 2.0' in got

@@ -161,3 +161,62 @@ class TestDistributedEnv:
         env = slice_env_for_rank("nb", "ns", rank=0, num_replicas=1)
         assert "KFT_COORDINATOR_ADDRESS" not in env
         assert env["TPU_WORKER_ID"] == "0"
+
+
+class TestMeshSpecRefactor:
+    """Elastic-topology re-factoring: deterministic shrink/grow of a
+    resolved spec, preserving axis semantics (dp absorbs first, then
+    fsdp, then tp; pp/sp/ep are model structure and never change)."""
+
+    def test_shrink_halves_dp_first(self):
+        spec = MeshSpec(dp=2, fsdp=4, tp=2).resolve(16)
+        out = spec.refactor(8)
+        assert (out.dp, out.fsdp, out.tp) == (1, 4, 2)
+        assert out.n_devices == 8
+
+    def test_shrink_spills_into_fsdp_then_tp(self):
+        spec = MeshSpec(dp=2, fsdp=4, tp=2).resolve(16)
+        assert (lambda s: (s.dp, s.fsdp, s.tp))(spec.refactor(4)) == (1, 2, 2)
+        assert (lambda s: (s.dp, s.fsdp, s.tp))(spec.refactor(2)) == (1, 1, 2)
+        assert (lambda s: (s.dp, s.fsdp, s.tp))(spec.refactor(1)) == (1, 1, 1)
+
+    def test_grow_multiplies_dp_only(self):
+        spec = MeshSpec(dp=1, fsdp=2, tp=2).resolve(4)
+        out = spec.refactor(16)
+        assert (out.dp, out.fsdp, out.tp) == (4, 2, 2)
+
+    def test_same_size_is_identity(self):
+        spec = MeshSpec(dp=2, fsdp=2, tp=2).resolve(8)
+        assert spec.refactor(8) is spec
+
+    def test_pp_sp_ep_never_change(self):
+        spec = MeshSpec(dp=4, pp=2, sp=1, ep=1).resolve(8)
+        out = spec.refactor(4)
+        assert (out.pp, out.sp, out.ep) == (2, 1, 1)
+        assert out.dp == 2
+
+    def test_refuses_non_divisible_shapes(self):
+        spec = MeshSpec(dp=2, fsdp=2).resolve(4)
+        with pytest.raises(ValueError):
+            spec.refactor(3)   # neither multiple nor divisor
+        with pytest.raises(ValueError):
+            spec.refactor(6)
+        with pytest.raises(ValueError):
+            spec.refactor(0)
+
+    def test_refuses_shrink_past_fixed_axes(self):
+        # pp=2 is model structure: a 2-device mesh that is all pp
+        # cannot shrink to 1.
+        spec = MeshSpec(dp=1, pp=2).resolve(2)
+        with pytest.raises(ValueError):
+            spec.refactor(1)
+
+    def test_refuses_unresolved_spec(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=-1).refactor(4)
+
+    def test_refactored_spec_builds_a_working_mesh(self):
+        spec = MeshSpec(dp=2, fsdp=2, tp=2).resolve(8)
+        small = spec.refactor(4)
+        mesh = make_mesh(small, jax.devices()[:4])
+        assert dict(zip(mesh.axis_names, mesh.devices.shape))["fsdp"] == 2

@@ -4,6 +4,8 @@ from kubeflow_tpu.topology import (
     ACCELERATORS,
     TopologyError,
     TpuSlice,
+    fallback_ladder,
+    parse_ladder,
     spawner_presets,
 )
 
@@ -70,3 +72,55 @@ def test_spawner_presets_cover_v5e():
     by_short = {p["shorthand"]: p for p in presets}
     assert by_short["v5e-16"]["hosts"] == 4
     assert by_short["v5e-16"]["multihost"]
+
+
+class TestFallbackLadder:
+    """The elastic-resume ladder: same generation, successive halvings,
+    every rung a canonical GKE topology down to one full host."""
+
+    def test_v5e_16_ladder(self):
+        ladder = fallback_ladder(TpuSlice.from_shorthand("v5e-16"))
+        assert [s.shorthand for s in ladder] == ["v5e-8", "v5e-4"]
+        # Every rung re-emits as a valid StatefulSet shape.
+        for rung in ladder:
+            assert rung.node_selectors()
+            assert int(rung.container_resources()["google.com/tpu"]) > 0
+
+    def test_v5e_64_ladder_spans_multi_and_single_host(self):
+        ladder = fallback_ladder(TpuSlice.from_shorthand("v5e-64"))
+        assert [s.shorthand for s in ladder] == [
+            "v5e-32", "v5e-16", "v5e-8", "v5e-4"
+        ]
+        assert [s.num_hosts for s in ladder] == [8, 4, 1, 1]
+
+    def test_smallest_shape_has_empty_ladder(self):
+        assert fallback_ladder(TpuSlice.from_shorthand("v5e-4")) == []
+
+    def test_3d_generation_skips_non_canonical_halvings(self):
+        ladder = fallback_ladder(TpuSlice.from_shorthand("v4-64"))
+        assert [s.shorthand for s in ladder] == ["v4-32", "v4-16", "v4-8",
+                                                 "v4-4"]
+
+    def test_parse_auto_derives_halvings(self):
+        spec = TpuSlice.from_shorthand("v5e-16")
+        assert [s.shorthand for s in parse_ladder(spec, "auto")] == \
+            [s.shorthand for s in fallback_ladder(spec)]
+        assert [s.shorthand for s in parse_ladder(spec, "")] == \
+            [s.shorthand for s in fallback_ladder(spec)]
+
+    def test_parse_explicit_list(self):
+        spec = TpuSlice.from_shorthand("v5e-16")
+        rungs = parse_ladder(spec, "v5e-8, v5e-4")
+        assert [s.shorthand for s in rungs] == ["v5e-8", "v5e-4"]
+
+    @pytest.mark.parametrize("bad", [
+        "v5p-8",            # different generation
+        "v5e-16",           # not decreasing (== spec)
+        "v5e-32",           # bigger than spec
+        "v5e-4,v5e-8",      # wrong order
+        "v5e-3",            # not a canonical shape
+        "garbage",
+    ])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(TopologyError):
+            parse_ladder(TpuSlice.from_shorthand("v5e-16"), bad)
