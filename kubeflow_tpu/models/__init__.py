@@ -12,6 +12,7 @@ from kubeflow_tpu.models.train import (
     create_train_state,
     make_train_step,
     make_eval_step,
+    realign_batches,
     run_with_checkpointing,
 )
 
@@ -53,6 +54,9 @@ _GEN_EXPORTS = ("KVCache", "forward_with_cache", "generate",
 # Continuous-batching serving loop — same lazy rule.
 _SERVING_EXPORTS = ("ContinuousBatcher", "BatchState")
 
+# Self-speculative n-gram decoding — same lazy rule.
+_SPEC_EXPORTS = ("speculative_generate", "NGramProposer", "SpecStats")
+
 
 def __getattr__(name):
     if name in _LM_EXPORTS:
@@ -75,6 +79,10 @@ def __getattr__(name):
         from kubeflow_tpu.models import serving
 
         return getattr(serving, name)
+    if name in _SPEC_EXPORTS:
+        from kubeflow_tpu.models import speculative
+
+        return getattr(speculative, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -110,6 +118,10 @@ __all__ = [
     "quantize_decode_params",
     "ContinuousBatcher",
     "BatchState",
+    "speculative_generate",
+    "NGramProposer",
+    "SpecStats",
+    "realign_batches",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

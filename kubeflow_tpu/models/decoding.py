@@ -109,6 +109,38 @@ if DECODE_MM not in ("auto", "dense", "gemv"):
     raise ValueError(
         f"KFT_DECODE_MM={DECODE_MM!r} must be auto|dense|gemv"
     )
+# KFT_DECODE_FUSED: the PR-8 fused decode step. "auto" (default) takes
+# the fused QKV+RoPE kernel (ops/decode_qkv.py — one Pallas program
+# replacing three projections + two rope ops) and the gemv residual
+# epilogue for single-token steps on TPU whenever the shapes fit;
+# "on" forces the fused path everywhere (interpret mode off-TPU —
+# what the parity matrix runs); "off" keeps the round-5 unfused chain.
+DECODE_FUSED = os.environ.get("KFT_DECODE_FUSED", "auto")
+if DECODE_FUSED not in ("auto", "on", "off"):
+    raise ValueError(
+        f"KFT_DECODE_FUSED={DECODE_FUSED!r} must be auto|on|off"
+    )
+# int8 KV caches now ride the flash-decode kernel too (in-kernel
+# dequant from the per-row scales; the HBM read stays int8). The
+# threshold is lower than the bf16 one: the dense XLA read of an int8
+# cache pays the same launch chain PLUS the scale multiplies, which is
+# why decode[b8-p8k-int8] lagged its bf16 twin — the 8k capacities
+# should take the kernel.
+DECODE_KERNEL_MIN_INT8 = int(os.environ.get(
+    "KFT_DECODE_KERNEL_MIN_INT8", "8192"))
+# Rolling (windowed) caches: the ring IS the window, so the dense read
+# is already O(window) — what the kernel buys there is ONE program in
+# place of the XLA score/mask/softmax/PV chain (the decode[b1-p8k-w1k]
+# section). Tiny rings (w=8 class) stay dense: the chain is cheap and
+# the kernel's fixed cost would dominate.
+DECODE_ROLLING_IMPL = os.environ.get("KFT_DECODE_ROLLING_IMPL", "auto")
+if DECODE_ROLLING_IMPL not in ("auto", "dense", "kernel"):
+    raise ValueError(
+        f"KFT_DECODE_ROLLING_IMPL={DECODE_ROLLING_IMPL!r} must be "
+        "auto|dense|kernel"
+    )
+DECODE_ROLLING_MIN = int(os.environ.get("KFT_DECODE_ROLLING_MIN",
+                                        "512"))
 
 
 @dataclasses.dataclass
@@ -141,14 +173,20 @@ def _quantize_linear(w, axis: int) -> Int8Linear:
     return Int8Linear(w8=w8, scale=scale)
 
 
-def _mm(h, kernel, dtype, transpose_w: bool = False):
+def _mm(h, kernel, dtype, transpose_w: bool = False, residual=None):
     """Decode-step projection ``h (B, T, D) @ kernel`` routed per
     DECODE_MM. ``kernel`` is an array (cast to ``dtype`` before the
     dot, like the training path) or an :class:`Int8Linear`.
     ``transpose_w=True`` contracts kernel's LAST axis ((N, K) layout —
     the tied embedding) without a transposed copy. Returns f32 (MXU
     accumulate); callers cast, exactly like a
-    ``preferred_element_type=f32`` dot."""
+    ``preferred_element_type=f32`` dot.
+
+    ``residual`` (B, T, N) compute dtype fuses the projection's
+    residual add into the kernel epilogue (``residual +
+    y.astype(dtype)`` — the exact op order the callers used to spell
+    out), and the return dtype becomes the residual's: the
+    attention-out and FFN-down projections retire in one launch."""
     from kubeflow_tpu.ops.gemv import gemv, gemv_fits
 
     quantized = isinstance(kernel, Int8Linear)
@@ -161,6 +199,13 @@ def _mm(h, kernel, dtype, transpose_w: bool = False):
         or (DECODE_MM == "auto" and jax.default_backend() == "tpu")
     )
     if use:
+        if residual is not None:
+            return gemv(
+                h.reshape(b * t, d), w,
+                scale=kernel.scale if quantized else None,
+                residual=residual.reshape(b * t, n),
+                transpose_w=transpose_w,
+            ).reshape(b, t, n)
         y = gemv(h.reshape(b * t, d), w,
                  transpose_w=transpose_w).reshape(b, t, n)
     else:
@@ -171,7 +216,139 @@ def _mm(h, kernel, dtype, transpose_w: bool = False):
         y = jax.lax.dot_general(h, w.astype(dtype) if quantized else w,
                                 dims,
                                 preferred_element_type=jnp.float32)
-    return y * kernel.scale if quantized else y
+    y = y * kernel.scale if quantized else y
+    if residual is not None:
+        return residual + y.astype(dtype)
+    return y
+
+
+def _fused_step_wanted() -> bool:
+    return DECODE_FUSED == "on" or (
+        DECODE_FUSED == "auto" and jax.default_backend() == "tpu"
+    )
+
+
+def attention_kernel_wanted(capacity: int, quantized: bool,
+                            rolling: bool) -> bool:
+    """THE single-token attention dispatch rule — one helper so
+    ``generate``'s paths and the continuous batcher cannot drift on
+    which caches take the flash-decode kernel. Rolling rings route on
+    ``DECODE_ROLLING_IMPL``/``DECODE_ROLLING_MIN``; linear caches on
+    ``DECODE_IMPL`` with the bf16 or int8 threshold."""
+    if jax.default_backend() != "tpu":
+        return False
+    if rolling:
+        return DECODE_ROLLING_IMPL == "kernel" or (
+            DECODE_ROLLING_IMPL == "auto"
+            and capacity >= DECODE_ROLLING_MIN
+        )
+    kernel_min = DECODE_KERNEL_MIN_INT8 if quantized else DECODE_KERNEL_MIN
+    return DECODE_IMPL == "kernel" or (
+        DECODE_IMPL == "auto" and capacity >= kernel_min
+    )
+
+
+def kernel_attention(cfg, q, ck, cv, pos, rolling=False, ks=None,
+                     vs=None):
+    """THE flash-decode kernel invocation — block sizing and operand
+    plumbing in one place, so the three dispatch sites (the
+    single-stream linear/rolling paths and the batcher) cannot fork
+    on anything but :func:`attention_kernel_wanted`'s answer."""
+    from kubeflow_tpu.ops.decode_attention import decode_attention
+
+    capacity = ck.shape[2]
+    return decode_attention(
+        q, ck, cv, pos, window=cfg.attn_window,
+        block=min(DECODE_KERNEL_BLOCK, capacity), rolling=rolling,
+        k_scale=ks, v_scale=vs,
+    )
+
+
+# Per-block key holding the precomputed concatenated qkv weight (see
+# fuse_qkv_params). Consumers that iterate block entries by NAME
+# (stack_decode_params, _block_step) ignore it by construction.
+FUSED_QKV_KEY = "qkv_fused"
+
+
+def _concat_qkv(cfg, blk):
+    """(w, scale) — the q/k/v kernels concatenated along the output
+    axis in the fused kernel's layout (int8: payload + per-channel
+    scales)."""
+    kq = blk["q_proj"]["kernel"]
+    kk = blk["k_proj"]["kernel"]
+    kv = blk["v_proj"]["kernel"]
+    if isinstance(kq, Int8Linear):
+        return (jnp.concatenate([kq.w8, kk.w8, kv.w8], axis=1),
+                jnp.concatenate([kq.scale, kk.scale, kv.scale]))
+    return (jnp.concatenate([kq, kk, kv], axis=1).astype(cfg.dtype),
+            None)
+
+
+def fuse_qkv_params(cfg, params, rows: int | None = None):
+    """Precompute each block's concatenated qkv weight for the fused
+    decode step. Inside a single jitted generate() the in-graph
+    concat is amortised over the whole token scan, but a serving
+    engine re-dispatches its decode chunk every cycle and would pay a
+    full read+write of every layer's qkv weights per dispatch — the
+    engines call this ONCE per params version (construction and hot
+    swap) instead. Returns a new params dict with a ``qkv_fused``
+    entry per block; pass-through when the fused step can never run
+    (selector off / non-TPU backend, ``rows`` — the engine's batch —
+    past the thin-row kernel bound, shapes the kernel refuses, or
+    stacked/MoE-expert param shapes it won't touch) so engines never
+    carry a dead extra copy of every layer's qkv weights."""
+    from kubeflow_tpu.ops.decode_qkv import qkv_rope_block
+    from kubeflow_tpu.ops.gemv import MAX_ROWS
+
+    if not isinstance(params, dict) or not _fused_step_wanted():
+        return params
+    if rows is not None and rows > MAX_ROWS:
+        return params
+    hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
+    n = (hq + 2 * hkv) * hd
+    if (cfg.dim % 128 or hd % 2
+            or qkv_rope_block(hd, n, 2, k=cfg.dim) is None):
+        return params
+    out = dict(params)
+    for key, blk in params.items():
+        if key.startswith("block_") and "q_proj" in blk:
+            w, scale = _concat_qkv(cfg, blk)
+            new_blk = dict(blk)
+            new_blk[FUSED_QKV_KEY] = {"w": w, "scale": scale}
+            out[key] = new_blk
+    return out
+
+
+def _fused_qkv(cfg, blk, h, pos):
+    """One Pallas program for the decode step's q/k/v projections +
+    rope (ops/decode_qkv.py): the three kernels concatenate into one
+    streamed weight, the rotary embedding lands on the VMEM tile, and
+    the v region passes through. ``h`` (B, 1, D) post-norm hidden,
+    ``pos`` (B,) int32 per-row global positions. Returns q/k/v as
+    (B, H[kv], 1, hd) — post-rope, ready for the cache write. A
+    precomputed ``qkv_fused`` entry (:func:`fuse_qkv_params`) is used
+    when present; otherwise the concat happens in-graph, which is
+    loop-invariant and amortised inside a jitted decode scan. Returns
+    None when the shapes don't fit the kernel (caller keeps the
+    unfused chain)."""
+    from kubeflow_tpu.ops.decode_qkv import qkv_rope, qkv_rope_fits
+
+    b, t, d = h.shape
+    hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
+    n = (hq + 2 * hkv) * hd
+    if t != 1 or not qkv_rope_fits(b, d, n, hd):
+        return None
+    fused = blk.get(FUSED_QKV_KEY)
+    if fused is not None:
+        w, scale = fused["w"], fused["scale"]
+    else:
+        w, scale = _concat_qkv(cfg, blk)
+    out = qkv_rope(h.reshape(b, d), w, pos, scale, head_dim=hd,
+                   rope_heads=hq + hkv)
+    q = out[:, :hq * hd].reshape(b, hq, 1, hd)
+    k = out[:, hq * hd:(hq + hkv) * hd].reshape(b, hkv, 1, hd)
+    v = out[:, (hq + hkv) * hd:].reshape(b, hkv, 1, hd)
+    return q, k, v
 
 
 @dataclasses.dataclass
@@ -365,6 +542,11 @@ def quantize_decode_params(cfg: LMConfig, params: dict[str, Any]
                                                    axis=0)}
                        if name in quant else leaf)
                 for name, leaf in sub.items()
+                # A precomputed fused-qkv entry (fuse_qkv_params) of
+                # the FLOAT weights must not survive quantisation —
+                # the fused step would silently multiply through the
+                # stale fp payload. Quantise first, fuse after.
+                if name != FUSED_QKV_KEY
             }
         elif key == "embed":
             out[key] = {"embedding": _quantize_linear(
@@ -421,24 +603,17 @@ def _decode_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
     ``DECODE_KERNEL_MIN`` — with ``DECODE_KERNEL_BLOCK``-wide cache
     blocks the per-grid-step cost that sank the round-4 256-block
     kernel amortises away and the kernel's O(filled ∧ window) traffic
-    wins at long caches — and the dense read below that.
-    "dense"/"kernel" force one path for A/B.
+    wins at long caches — and the dense read below that. int8 caches
+    (``ks``/``vs`` per-row scales) take the kernel from the lower
+    ``DECODE_KERNEL_MIN_INT8`` threshold: the payload is READ as int8
+    with in-kernel dequant, where the old dense fallback paid the
+    full launch chain plus the scale multiplies (the
+    decode[b8-p8k-int8] regression). "dense"/"kernel" force one path
+    for A/B.
     """
     capacity = ck.shape[2]
-    use_kernel = (
-        ks is None and jax.default_backend() == "tpu"
-        and (DECODE_IMPL == "kernel"
-             or (DECODE_IMPL == "auto" and capacity >= DECODE_KERNEL_MIN))
-    )
-    if use_kernel:
-        # The Pallas kernel reads the bf16 payload only; an int8 cache
-        # always takes the dense path (its rescale fuses there).
-        from kubeflow_tpu.ops.decode_attention import decode_attention
-
-        return decode_attention(
-            q, ck, cv, pos, window=cfg.attn_window,
-            block=min(DECODE_KERNEL_BLOCK, capacity),
-        )
+    if attention_kernel_wanted(capacity, ks is not None, rolling=False):
+        return kernel_attention(cfg, q, ck, cv, pos, ks=ks, vs=vs)
     return _cached_attention(cfg, q, ck, cv, pos, 1, ks, vs)
 
 
@@ -448,7 +623,20 @@ def _rolling_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
     whose mapped position is negative are unwritten. capacity ≤ window,
     so every written slot is in-band by construction. ``ks``/``vs``
     (B, Hkv, capacity, 1) dequantise an int8 cache per row — scales
-    factor out of both matmuls, so the payload is read as int8."""
+    factor out of both matmuls, so the payload is read as int8.
+
+    Dispatch (``DECODE_ROLLING_IMPL``): "auto" routes single-token
+    reads of rings >= ``DECODE_ROLLING_MIN`` slots through the
+    flash-decode kernel's circular mode on TPU — the ring is already
+    O(window), so the kernel's win is ONE program replacing the XLA
+    score/mask/softmax/PV chain (the decode[b1-p8k-w1k] section);
+    tiny rings keep the dense read (the chain is cheap there and the
+    kernel's fixed cost would dominate). "dense"/"kernel" force."""
+    capacity_ = ck.shape[2]
+    if q.shape[2] == 1 and attention_kernel_wanted(
+            capacity_, ks is not None, rolling=True):
+        return kernel_attention(cfg, q, ck, cv, pos, rolling=True,
+                                ks=ks, vs=vs)
     b, h, t, hd = q.shape
     hkv, capacity = ck.shape[1], ck.shape[2]
     group = h // hkv
@@ -690,28 +878,45 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     """One block over a (B, T, D) chunk at global offset ``pos``,
     reading/updating this layer's (B, Hkv, capacity, hd) cache slices
     (plus (B, Hkv, capacity, 1) scale slices for an int8 cache).
-    Mirrors transformer.Block exactly (same param names/shapes)."""
+    Mirrors transformer.Block exactly (same param names/shapes).
+
+    Single-token steps route the q/k/v projections + rope through the
+    fused ops/decode_qkv.py kernel when ``DECODE_FUSED`` allows and
+    the shapes fit (one launch replaces five), and the out/down
+    projections carry their residual adds in the gemv epilogue — the
+    PR-8 launch-count diet. Every fused piece is bit-identical to the
+    chain it replaces (same op/round order; the parity matrix in
+    tests/test_serving.py pins it)."""
     b, t, _ = x.shape
     h = rms_norm(params["RMSNorm_0"]["scale"], x)
-    proj = lambda name: _mm(
-        h, params[name]["kernel"], cfg.dtype
-    ).astype(cfg.dtype)
-    q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+    fused = None
+    if t == 1 and _fused_step_wanted():
+        pos_vec = jnp.broadcast_to(
+            jnp.asarray(pos, jnp.int32).reshape(-1), (b,)
+        )
+        fused = _fused_qkv(cfg, params, h, pos_vec)
+    if fused is not None:
+        q, k, v = fused
+    else:
+        proj = lambda name: _mm(
+            h, params[name]["kernel"], cfg.dtype
+        ).astype(cfg.dtype)
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
 
-    def heads(tensor, n):
-        return tensor.reshape(b, t, n, cfg.head_dim).transpose(0, 2, 1, 3)
+        def heads(tensor, n):
+            return tensor.reshape(
+                b, t, n, cfg.head_dim).transpose(0, 2, 1, 3)
 
-    q = heads(q, cfg.heads)
-    k = heads(k, cfg.num_kv_heads)
-    v = heads(v, cfg.num_kv_heads)
-    q = apply_rope(q, offset=pos)
-    k = apply_rope(k, offset=pos)
+        q = heads(q, cfg.heads)
+        k = heads(k, cfg.num_kv_heads)
+        v = heads(v, cfg.num_kv_heads)
+        q = apply_rope(q, offset=pos)
+        k = apply_rope(k, offset=pos)
     out, ck, cv, ks_buf, vs_buf = _attend_and_cache(
         cfg, q, k, v, ck, cv, pos, empty, rolling, ks_buf, vs_buf
     )
     out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
-    x = x + _mm(out, params["proj"]["kernel"], cfg.dtype
-                ).astype(cfg.dtype)
+    x = _mm(out, params["proj"]["kernel"], cfg.dtype, residual=x)
 
     h = rms_norm(params["RMSNorm_1"]["scale"], x)
     if use_moe:
@@ -725,8 +930,7 @@ def _block_step(cfg, params, x, ck, cv, pos, empty, rolling,
     else:
         h = jax.nn.gelu(
             _mm(h, params["up"]["kernel"], cfg.dtype).astype(cfg.dtype))
-        x = x + _mm(h, params["down"]["kernel"], cfg.dtype
-                    ).astype(cfg.dtype)
+        x = _mm(h, params["down"]["kernel"], cfg.dtype, residual=x)
     return x, ck, cv, ks_buf, vs_buf
 
 

@@ -62,6 +62,8 @@ import jax.numpy as jnp
 from kubeflow_tpu.models.decoding import (
     DECODE_BLOCK,
     KVCache,
+    _fused_qkv,
+    _fused_step_wanted,
     _mm,
     forward_with_cache,
 )
@@ -154,6 +156,26 @@ def check_request_contract(prompt, max_new_tokens: int,
     return prompt
 
 
+def slice_step_keys(keys, cur: int, n: int, dummies):
+    """(window (n,), take) — the next ``n`` of a request's pre-split
+    step keys starting at cursor ``cur``, padded past the end with
+    ``dummies`` (an (n,)-broadcast dummy key array whose draws the
+    caller discards). THE seeded-sampling key-schedule contract,
+    shared by the lockstep chunk (``_chunk_keys``) and the streaming
+    engine's speculative verify — one implementation, or a cursor fix
+    in one path would silently break generate() parity in the other.
+    ``keys`` None (greedy slot) returns all dummies with take 0."""
+    if keys is None:
+        return dummies, 0
+    take = max(0, min(n, keys.shape[0] - cur))
+    if take == n:
+        return jax.lax.dynamic_slice_in_dim(keys, cur, n), take
+    if take == 0:
+        return dummies, 0
+    return jnp.concatenate([keys[cur:cur + take],
+                            dummies[:n - take]]), take
+
+
 def _sample(logits, temp, keys):
     """(B, vocab) logits -> (B,) tokens: per-slot greedy (temp 0) or
     categorical at the slot's temperature with the slot's key —
@@ -217,8 +239,20 @@ def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False,
     every written slot in-band by construction. ``ks``/``vs``
     (B, Hkv, cap, 1) dequantise an int8 cache per row — scales factor
     out of both matmuls, so the payload is read as int8 (the
-    bandwidth win), exactly like decoding._decode_attention."""
+    bandwidth win), exactly like decoding._decode_attention.
+
+    Dispatch mirrors the single-stream path: the flash-decode kernel
+    takes (B,) position vectors natively, so big linear caches, int8
+    caches past their threshold and large rings all ride the same
+    Pallas program the generate() hot path uses (the env selectors in
+    models/decoding.py steer both sites identically)."""
+    from kubeflow_tpu.models import decoding as dec
+
     b, h, _, hd = q.shape
+    capacity = ck.shape[2]
+    if dec.attention_kernel_wanted(capacity, ks is not None, rolling):
+        return dec.kernel_attention(cfg, q, ck, cv, pos,
+                                    rolling=rolling, ks=ks, vs=vs)
     hkv = ck.shape[1]
     group = h // hkv
     qg = q.reshape(b, hkv, group, hd)
@@ -232,7 +266,6 @@ def _batched_pos_attention(cfg, q, ck, cv, pos, rolling=False,
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     rows = pos[:, None, None, None]
     if rolling:
-        capacity = ck.shape[2]
         global_pos = rows - (rows - cols) % capacity
         keep = global_pos >= 0
     else:
@@ -288,14 +321,21 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
     for i in range(cfg.layers):
         blk = params[f"block_{i}"]
         h = rms_norm(blk["RMSNorm_0"]["scale"], x)
-        proj = lambda name: _mm(h, blk[name]["kernel"], cfg.dtype
-                                ).astype(cfg.dtype)
-        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
-        q = q.reshape(b, 1, hq, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
-        q = rope(q, state.pos)
-        k = rope(k, state.pos)
+        fused = (_fused_qkv(cfg, blk, h, state.pos)
+                 if _fused_step_wanted() else None)
+        if fused is not None:
+            # One Pallas program: q/k/v projections + per-slot-position
+            # rope (the kernel takes the (B,) vector natively).
+            q, k, v = fused
+        else:
+            proj = lambda name: _mm(h, blk[name]["kernel"], cfg.dtype
+                                    ).astype(cfg.dtype)
+            q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+            q = q.reshape(b, 1, hq, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(b, 1, hkv, hd).transpose(0, 2, 1, 3)
+            q = rope(q, state.pos)
+            k = rope(k, state.pos)
         capacity = state.k.shape[3]
         wpos = state.pos % capacity if rolling else state.pos
         if quantized:
@@ -317,13 +357,11 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
                                      rolling=rolling,
                                      ks=ks_buf, vs=vs_buf)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.dim)
-        x = x + _mm(out, blk["proj"]["kernel"], cfg.dtype
-                    ).astype(cfg.dtype)
+        x = _mm(out, blk["proj"]["kernel"], cfg.dtype, residual=x)
         h = rms_norm(blk["RMSNorm_1"]["scale"], x)
         h = jax.nn.gelu(_mm(h, blk["up"]["kernel"], cfg.dtype
                             ).astype(cfg.dtype))
-        x = x + _mm(h, blk["down"]["kernel"], cfg.dtype
-                    ).astype(cfg.dtype)
+        x = _mm(h, blk["down"]["kernel"], cfg.dtype, residual=x)
 
     x = rms_norm(params["final_norm"]["scale"], x)
     logits = _mm(x.astype(cfg.dtype), emb, cfg.dtype, transpose_w=True)
@@ -339,6 +377,155 @@ def decode_step(cfg: LMConfig, params: dict[str, Any],
         k_scale=jnp.stack(new_ks) if quantized else None,
         v_scale=jnp.stack(new_vs) if quantized else None,
     ), nxt
+
+
+def _batched_chunk_attention(cfg, q, ck, cv, pos, ks=None, vs=None):
+    """Multi-token masked read with PER-SLOT base positions — the
+    verify-step analogue of decoding._cached_attention: q (B, H, T,
+    hd) holds T consecutive tokens per row starting at global position
+    ``pos[b]``; ck/cv (B, Hkv, cap, hd) already contain the chunk's
+    writes. Row (b, t) attends to cols <= pos[b] + t (within the
+    window). ``ks``/``vs`` dequantise an int8 cache per row."""
+    b, h, t, hd = q.shape
+    hkv = ck.shape[1]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group, t, hd)
+    compute = q.dtype
+    s = jnp.einsum(
+        "bkgtd,bkld->bkgtl", qg, ck.astype(compute),
+        preferred_element_type=jnp.float32,
+    ) * hd ** -0.5
+    if ks is not None:
+        s = s * ks[..., 0][:, :, None, None, :]
+    rows = (pos[:, None, None, None, None]
+            + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3))
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
+    keep = cols <= rows
+    if cfg.attn_window is not None:
+        keep = jnp.logical_and(keep, cols > rows - cfg.attn_window)
+    s = jnp.where(keep, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        w = w * vs[..., 0][:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgtl,bkld->bkgtd", w.astype(compute), cv.astype(compute),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, h, t, hd).astype(q.dtype)
+
+
+def verify_step(cfg: LMConfig, params: dict[str, Any],
+                state: BatchState, tokens: jax.Array,
+                keys: jax.Array | None = None,
+                rolling: bool = False) -> tuple[BatchState, jax.Array]:
+    """Score a (B, T) chunk per slot in ONE dispatch — the speculative
+    serving step. ``tokens[b, 0]`` is the slot's pending feed token
+    (``state.last``) and ``tokens[b, 1:]`` its T-1 drafts; ``keys``
+    (B, T) supplies per-position sampling keys (dummies for greedy
+    slots — their draws are discarded by ``temp == 0``). Returns
+    ``(state', cand (B, T))`` where ``cand[b, i]`` is the token the
+    model emits after ``tokens[b, :i + 1]`` — the SAME value a chain
+    of i+1 single-token ``decode_step``s would sample. The chunk's K/V
+    land in the cache at rows ``pos[b] .. pos[b] + T - 1``;
+    ``state'.pos``/``last`` are NOT advanced — the host decides the
+    accepted prefix and commits it via :func:`commit_verify` (rows
+    past the commit are causally masked and overwritten by the next
+    verify, which always restarts at the committed position).
+
+    Linear slots only: a rolling ring cannot rewind a rejected write
+    (the slot it landed in was already evicted)."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "continuous batching currently serves dense-FFN models "
+            "(MoE decode runs through generate())"
+        )
+    if rolling:
+        # BatchState carries no layout flag, so the caller must say
+        # (decode_step's signature): writing a chunk at an unwrapped
+        # pos into a ring would clamp at the capacity edge and
+        # silently overwrite the newest rows instead of wrapping.
+        raise ValueError(
+            "verify_step requires linear slots (a rolling ring cannot "
+            "rewind a rejected draft's write)"
+        )
+    b, t = tokens.shape
+    emb = params["embed"]["embedding"]
+    from kubeflow_tpu.models.decoding import Int8Linear
+
+    if isinstance(emb, Int8Linear):
+        x = (emb.w8[tokens].astype(cfg.dtype)
+             * emb.scale[tokens][..., None].astype(cfg.dtype))
+    else:
+        x = emb[tokens].astype(cfg.dtype)  # (B, T, D)
+
+    hq, hkv, hd = cfg.heads, cfg.num_kv_heads, cfg.head_dim
+    rope = jax.vmap(lambda tensor, o: apply_rope(tensor, offset=o))
+    quantized = state.quantized
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for i in range(cfg.layers):
+        blk = params[f"block_{i}"]
+        h = rms_norm(blk["RMSNorm_0"]["scale"], x)
+        proj = lambda name: _mm(h, blk[name]["kernel"], cfg.dtype
+                                ).astype(cfg.dtype)
+        q, k, v = proj("q_proj"), proj("k_proj"), proj("v_proj")
+        q = q.reshape(b, t, hq, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, hkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, hkv, hd).transpose(0, 2, 1, 3)
+        q = rope(q, state.pos)
+        k = rope(k, state.pos)
+        if quantized:
+            from kubeflow_tpu.models.decoding import _quantize_rows
+
+            k_store, k_s = _quantize_rows(k)
+            v_store, v_s = _quantize_rows(v)
+            ks_buf = _write_row(state.k_scale[i], k_s, state.pos)
+            vs_buf = _write_row(state.v_scale[i], v_s, state.pos)
+            new_ks.append(ks_buf)
+            new_vs.append(vs_buf)
+        else:
+            k_store, v_store, ks_buf, vs_buf = k, v, None, None
+        ck = _write_row(state.k[i], k_store, state.pos)
+        cv = _write_row(state.v[i], v_store, state.pos)
+        new_k.append(ck)
+        new_v.append(cv)
+        out = _batched_chunk_attention(cfg, q, ck, cv, state.pos,
+                                       ks=ks_buf, vs=vs_buf)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
+        x = _mm(out, blk["proj"]["kernel"], cfg.dtype, residual=x)
+        h = rms_norm(blk["RMSNorm_1"]["scale"], x)
+        h = jax.nn.gelu(_mm(h, blk["up"]["kernel"], cfg.dtype
+                            ).astype(cfg.dtype))
+        x = _mm(h, blk["down"]["kernel"], cfg.dtype, residual=x)
+
+    x = rms_norm(params["final_norm"]["scale"], x)
+    logits = _mm(x.astype(cfg.dtype), emb, cfg.dtype, transpose_w=True)
+    # Per-position sampling at the slot's temperature: flatten (B, T)
+    # so _sample sees one row per draw — generate()'s exact math.
+    flat_logits = logits.reshape(b * t, -1)
+    flat_temp = jnp.repeat(state.temp, t)
+    flat_keys = keys.reshape(b * t) if keys is not None else None
+    cand = _sample(flat_logits, flat_temp, flat_keys).reshape(b, t)
+    return BatchState(
+        k=jnp.stack(new_k), v=jnp.stack(new_v),
+        pos=state.pos, last=state.last, active=state.active,
+        temp=state.temp,
+        k_scale=jnp.stack(new_ks) if quantized else None,
+        v_scale=jnp.stack(new_vs) if quantized else None,
+    ), cand
+
+
+def commit_verify(state: BatchState, accepted: jax.Array,
+                  last: jax.Array) -> BatchState:
+    """Advance per-slot positions by the host-decided accepted counts
+    (``accepted`` (B,) int32, 0 for untouched slots) and point
+    ``last`` at the newest emitted token — the other half of the
+    verify/commit pair."""
+    moved = accepted > 0
+    return dataclasses.replace(
+        state,
+        pos=state.pos + accepted,
+        last=jnp.where(moved, last, state.last),
+    )
 
 
 def decode_chunk(cfg: LMConfig, params: dict[str, Any],
@@ -409,9 +596,20 @@ class ContinuousBatcher:
             )
         if step_chunk < 1:
             raise ValueError("step_chunk must be >= 1")
-        self.cfg, self.params = cfg, params
+        from kubeflow_tpu.models.decoding import fuse_qkv_params
+
+        # Precompute the fused qkv weights once: the decode chunk is
+        # re-dispatched every cycle, and an in-graph concat would
+        # re-read every layer's qkv weights per dispatch. No-op (no
+        # extra weight copy) when the fused step can't run here.
+        self.cfg = cfg
+        self.params = fuse_qkv_params(cfg, params, rows=max_batch)
         self.eos = eos_token
         self.step_chunk = step_chunk
+        # Linear-slot write slack reserved past prompt + budget (see
+        # _build_request); engines running speculative verifies widen
+        # it to their draft length.
+        self.reserve_slack = step_chunk
         self.quantize_cache = quantize_cache
         # Windowed models whose window is smaller than max_len get
         # ROLLING slots: circular per-slot buffers of the window size
@@ -452,18 +650,21 @@ class ContinuousBatcher:
         it is safe to call from any thread."""
         prompt = check_request_contract(prompt, max_new_tokens,
                                         temperature, rng)
-        # + step_chunk: a slot finishing mid-chunk keeps stepping (and
-        # writing) until the boundary; a LINEAR buffer must absorb
-        # that. Rolling slots wrap, so the overshoot is harmless and
-        # their bound is just max_len (the cap the caller sized the
-        # batcher for).
-        slack = 0 if self.rolling else self.step_chunk
+        # + write slack: a slot finishing mid-chunk keeps stepping
+        # (and writing) until the boundary, and a speculative verify
+        # overshoots the accepted prefix by up to the draft length; a
+        # LINEAR buffer must absorb both. Rolling slots wrap, so the
+        # overshoot is harmless and their bound is just max_len (the
+        # cap the caller sized the batcher for). ``reserve_slack``
+        # defaults to step_chunk; the streaming engine raises it when
+        # speculation is on.
+        slack = 0 if self.rolling else self.reserve_slack
         limit = self.max_len if self.rolling else self.capacity
         if len(prompt) + max_new_tokens + slack > limit:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens})"
-                + (f" + step_chunk ({self.step_chunk})" if slack else "")
+                + (f" + write slack ({slack})" if slack else "")
                 + f" exceeds "
                 f"{'max_len' if self.rolling else 'capacity'} {limit}"
             )
@@ -543,17 +744,12 @@ class ContinuousBatcher:
         cols = []
         for req in self._slots:
             keys = req["step_keys"] if req is not None else None
-            if keys is None:
-                cols.append(dummies)
-                continue
-            cur = req["kcur"]
-            take = min(n, keys.shape[0] - cur)
-            req["kcur"] = cur + take
-            if take == n:
-                cols.append(jax.lax.dynamic_slice_in_dim(keys, cur, n))
-            else:
-                seg = keys[cur:cur + take] if take > 0 else dummies[:0]
-                cols.append(jnp.concatenate([seg, dummies[:n - take]]))
+            window, take = slice_step_keys(keys, req["kcur"] if keys
+                                           is not None else 0, n,
+                                           dummies)
+            if keys is not None:
+                req["kcur"] += take
+            cols.append(window)
         return jnp.stack(cols, axis=1)
 
     def run(self) -> dict[int, list[int]]:

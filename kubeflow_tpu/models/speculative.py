@@ -1,0 +1,301 @@
+"""Self-speculative n-gram decoding: verify k drafted tokens per step.
+
+Decode emits one token per model pass because each token depends on
+the last — but the model pass itself is almost free at b1 (the weights
+stream regardless of how many tokens ride along; BASELINE.md's floor
+decomposition). Speculative decoding (Leviathan et al.) breaks the
+serialization: a cheap proposer drafts k tokens, ONE batched forward
+scores all k+1 positions (the mid-sequence chunk path of
+``forward_with_cache`` — the same code chunked prefill runs), and the
+longest prefix of drafts that matches the model's own choices is
+accepted. Every verify emits at least one token (the model's
+correction), so the scheme is rejection-FREE: output is token-identical
+to plain ``generate`` — greedy AND seeded sampling — the draft source
+only changes how many tokens each pass retires.
+
+Identity caveat (the same one models/serving.py documents for
+eager-vs-jitted generate): the verify forward is the multi-token chunk
+path, while generate's steps may take the fused single-token kernels —
+in interpret mode they are bit-identical (the parity suites pin it),
+but on TPU the two program shapes can round near-tie logits
+differently (XLA fusion / Mosaic transcendental lowering), exactly
+like any recompile of the same math. Speculative output is always
+self-consistent (every emitted token came from a real model forward
+under the caller's temperature/keys); "token-identical to generate"
+is exact wherever the two programs round identically.
+
+The proposer here is the model's own output: **n-gram lookup** over
+prompt + generated text (the "prompt lookup decoding" idea). Real
+serving workloads — code, RAG answers quoting retrieved context,
+structured output — repeat their own substrings constantly; a draft is
+the continuation of the most recent earlier occurrence of the trailing
+n-gram. No draft model, no extra weights, no training.
+
+Two implementations share the acceptance semantics:
+
+- :func:`speculative_generate` — the whole loop lives ON DEVICE in a
+  ``lax.while_loop`` (the n-gram search is a vectorised compare over
+  the token buffer), so a full generation is ONE dispatch, exactly
+  like ``generate``'s scan. This is what bench's ``decode[spec-*]``
+  sections run.
+- :class:`NGramProposer` — the host-side proposer the streaming
+  engine uses (kubeflow_tpu/serving/engine.py drives per-slot drafts
+  through ``models.serving.verify_step``); host code can afford n-gram
+  backoff (try long contexts first) for better acceptance.
+
+Rolling (windowed) caches are refused: a rejected draft's cache write
+would already have EVICTED the ring slot it landed in, so the rewind
+cannot restore history. Linear caches rewind by just moving ``length``
+back — stale rows are masked by the causal read and overwritten by the
+next verify (which always starts at the rewound position).
+
+No reference counterpart (the reference platform ships no model code);
+part of the compute stack in the jupyter-jax-tpu images.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.decoding import (
+    KVCache,
+    StackedDecodeParams,
+    forward_with_cache,
+    quantize_decode_params,
+)
+from kubeflow_tpu.models.serving import _sample
+from kubeflow_tpu.models.transformer import LMConfig
+
+
+def ngram_propose(tokens: jax.Array, count: jax.Array, *, n: int,
+                  k: int) -> tuple[jax.Array, jax.Array]:
+    """Device-side n-gram draft. ``tokens`` (L,) int32 with the first
+    ``count`` entries valid (prompt + emitted so far); the trailing
+    ``n`` tokens are the context. Returns (draft (k,), found bool):
+    the ``k`` tokens that followed the most recent EARLIER occurrence
+    of the context, or ``k`` repeats of the last token when there is
+    none (a junk draft is safe — it just gets rejected).
+
+    The search is one vectorised pass: position j matches iff
+    ``tokens[j - i] == tokens[count - 1 - i]`` for all i < n; rolls
+    wrap junk into j < i, which the ``j >= n-1`` bound masks."""
+    length = tokens.shape[0]
+    idx = jnp.arange(length, dtype=jnp.int32)
+    match = jnp.ones((length,), bool)
+    for i in range(n):
+        t_i = jax.lax.dynamic_index_in_dim(tokens, count - 1 - i,
+                                           keepdims=False)
+        match = jnp.logical_and(match, jnp.roll(tokens, i) == t_i)
+    match = jnp.logical_and(match, idx >= n - 1)
+    match = jnp.logical_and(match, idx <= count - 2)
+    j = jnp.max(jnp.where(match, idx, -1))
+    found = j >= 0
+    start = jnp.where(found, j + 1, 0)
+    draft = jax.lax.dynamic_slice(tokens, (start,), (k,))
+    last = jax.lax.dynamic_index_in_dim(tokens, count - 1,
+                                        keepdims=False)
+    return jnp.where(found, draft, jnp.full((k,), last)), found
+
+
+class NGramProposer:
+    """Host-side n-gram lookup for the streaming engine: the same
+    draft rule as :func:`ngram_propose` with backoff — the longest
+    context (``n`` down to 1) that has an earlier occurrence wins.
+    O(history) vectorised numpy per call; the engine calls it once
+    per slot per verify cycle."""
+
+    def __init__(self, n: int = 3, k: int = 8):
+        if n < 1 or k < 1:
+            raise ValueError("ngram n and draft k must be >= 1")
+        self.n = n
+        self.k = k
+
+    def propose(self, tokens) -> list[int]:
+        """``tokens`` — full history (prompt + generated). Returns
+        exactly ``k`` draft tokens (last-token repeats when no
+        context matches)."""
+        arr = np.asarray(tokens, dtype=np.int64)
+        count = arr.shape[0]
+        fill = [int(arr[-1])] * self.k
+        for n in range(min(self.n, count - 1), 0, -1):
+            match = np.ones(count, bool)
+            for i in range(n):
+                match &= np.roll(arr, i) == arr[count - 1 - i]
+            match[:n - 1] = False
+            match[count - 1:] = False
+            hits = np.nonzero(match)[0]
+            if hits.size:
+                j = int(hits[-1])
+                draft = [int(t) for t in arr[j + 1:j + 1 + self.k]]
+                return draft + fill[len(draft):]
+        return fill
+
+
+@dataclasses.dataclass
+class SpecStats:
+    """What a speculative run did — bench reports these so an accept
+    rate of ~0 (adversarial text) is visible next to the tok/s.
+    Fields may be 0-d jax arrays when the producing call was traced
+    (``speculative_generate`` under jit stays one dispatch even with
+    ``return_stats=True``); the properties coerce on the host."""
+
+    verify_calls: int | jax.Array
+    drafted: int | jax.Array
+    accepted: int | jax.Array
+    tokens: int
+
+    @property
+    def accept_rate(self) -> float:
+        drafted = int(self.drafted)
+        return int(self.accepted) / drafted if drafted else 0.0
+
+    @property
+    def tokens_per_verify(self) -> float:
+        verifies = int(self.verify_calls)
+        return int(self.tokens) / verifies if verifies else 0.0
+
+
+jax.tree_util.register_dataclass(
+    SpecStats, data_fields=["verify_calls", "drafted", "accepted"],
+    meta_fields=["tokens"])
+
+
+def speculative_generate(
+    cfg: LMConfig,
+    params: dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    draft: int = 8,
+    ngram: int = 3,
+    quantize_cache: bool = False,
+    quantize_weights: bool = False,
+    return_stats: bool = False,
+):
+    """Drop-in ``generate`` with n-gram speculation. ``prompt`` must
+    be (1, P) — acceptance lengths diverge per sequence, so lockstep
+    batching belongs to the serving engine (verify_step), not here.
+    Returns (1, max_new_tokens) int32, TOKEN-IDENTICAL to
+    ``generate(cfg, params, prompt, max_new_tokens, temperature,
+    rng, ...)``: greedy acceptance compares drafts against argmax;
+    sampled acceptance compares against the categorical draw under
+    generate's exact key schedule (split(rng) -> first + pre-split
+    step keys), so the k-th emitted token always consumed the k-th
+    key. ``return_stats=True`` additionally returns a
+    :class:`SpecStats`.
+
+    The whole draft/verify/accept loop runs on device in ONE dispatch
+    (``lax.while_loop``); each iteration is one mid-sequence chunk
+    forward of ``draft + 1`` tokens plus a vectorised n-gram search
+    over the token buffer.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if isinstance(params, StackedDecodeParams):
+        raise ValueError(
+            "speculative_generate takes the raw training pytree "
+            "(the verify chunk runs the unrolled path)")
+    if cfg.moe_experts and cfg.moe_router == "expert_choice":
+        raise NotImplementedError(
+            "expert-choice routing is not causal - autoregressive "
+            "decode requires topk routing")
+    if temperature > 0.0 and rng is None:
+        raise ValueError(
+            "temperature > 0 samples from the categorical distribution; "
+            "pass rng=jax.random.key(...)")
+    if draft < 1 or ngram < 1:
+        raise ValueError("draft and ngram must be >= 1")
+    b, p = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is per-sequence (got batch {b}); "
+            "batched serving drafts ride models.serving.verify_step")
+    total = p + max_new_tokens
+    if cfg.attn_window is not None and cfg.attn_window < total:
+        raise ValueError(
+            "speculative decoding requires a linear KV cache: a "
+            "rejected draft's write into a rolling ring has already "
+            "evicted the slot it landed in, so the rewind cannot "
+            "restore history (window "
+            f"{cfg.attn_window} < prompt+new {total})")
+    if quantize_weights:
+        params = quantize_decode_params(cfg, params)
+
+    # Verify chunks overshoot the accepted prefix by up to `draft`
+    # rows; the capacity absorbs the overshoot so the clamping
+    # dynamic_update_slice contract is never hit.
+    cache = KVCache.init(cfg, 1, p + max_new_tokens - 1 + draft,
+                         quantized=quantize_cache)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache,
+                                       last_logits_only=True)
+    if rng is None:
+        rng = jax.random.key(0)  # unused on the greedy path
+    first_key, step_key = jax.random.split(rng)
+    temp_vec = jnp.full((draft + 1,), temperature, jnp.float32)
+    first = _sample(logits[:, -1], temp_vec[:1],
+                    first_key[None] if temperature > 0.0 else None)[0]
+    if max_new_tokens == 1:
+        out = first[None, None]
+        if return_stats:
+            return out, SpecStats(0, 0, 0, 1)
+        return out
+
+    # generate()'s key schedule, padded so the dynamic window slice
+    # near the budget end never clamps (padded draws are discarded).
+    step_keys = jax.random.split(step_key, max_new_tokens - 1)
+    dummy = jax.random.key(0)
+    step_keys = jnp.concatenate(
+        [step_keys, jnp.broadcast_to(dummy, (draft + 1,))])
+
+    buf_len = p + max_new_tokens + draft
+    buf = jnp.zeros((buf_len,), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt[0], (0,))
+    buf = buf.at[p].set(first)
+    sampled = temperature > 0.0
+
+    def cond(carry):
+        return carry[3] < max_new_tokens
+
+    def body(carry):
+        cache, buf, count, emitted, verifies, accepted = carry
+        drafted, _ = ngram_propose(buf, count, n=ngram, k=draft)
+        last = jax.lax.dynamic_slice(buf, (count - 1,), (1,))
+        chunk = jnp.concatenate([last, drafted])[None, :]
+        # Rewind: length re-anchors to the accepted prefix; rows past
+        # it are causally masked and overwritten by this chunk.
+        cache_in = dataclasses.replace(cache, length=count - 1)
+        logits, cache = forward_with_cache(cfg, params, chunk, cache_in)
+        keys = (jax.lax.dynamic_slice_in_dim(
+            step_keys, emitted - 1, draft + 1) if sampled else None)
+        cand = _sample(logits[0], temp_vec, keys)
+        matches = cand[:draft] == drafted
+        a = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+        take = jnp.minimum(a + 1, max_new_tokens - emitted)
+        buf = jax.lax.dynamic_update_slice(buf, cand, (count,))
+        return (cache, buf, count + take, emitted + take,
+                verifies + 1, accepted + jnp.minimum(a, take))
+
+    carry = (cache, buf, jnp.int32(p + 1), jnp.int32(1),
+             jnp.int32(0), jnp.int32(0))
+    _, buf, _, _, verifies, accepted = jax.lax.while_loop(
+        cond, body, carry)
+    out = jax.lax.dynamic_slice(buf, (p,), (max_new_tokens,))[None, :]
+    if return_stats:
+        # Array-valued stats: the call stays ONE dispatch under jit
+        # (a host int() here would concretise traced carries); the
+        # SpecStats properties coerce after device_get.
+        stats = SpecStats(
+            verify_calls=verifies,
+            drafted=verifies * draft,
+            accepted=accepted,
+            tokens=max_new_tokens,
+        )
+        return out, stats
+    return out

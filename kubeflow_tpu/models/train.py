@@ -208,6 +208,50 @@ class RunReport:
     resharded: bool = False
 
 
+def realign_batches(batches, start_step, *, strict: bool = True):
+    """Fast-forward a FRESH seeded iterator to the resume point.
+
+    ``run_with_checkpointing``'s contract says the caller owns
+    data-order alignment with the global step; this is the standard
+    way to honour it after a resume — including an elastic reshard,
+    where the new incarnation rebuilds its seeded pipeline from
+    example 0 on a different slice shape and must skip what the
+    previous incarnations already consumed. ``start_step`` is an int
+    or a :class:`RunReport` (its ``start_step`` — which is why resume
+    happens before the first batch is drawn).
+
+    Returns an iterator positioned at the batch for ``start_step``.
+    The skipped prefix is CONSUMED, not indexed, so any seeded
+    generator works; with ``strict`` (default) an iterator that runs
+    dry inside the skip raises instead of silently resuming at the
+    wrong example — a pipeline shorter than the checkpoint step means
+    the seeding itself is wrong.
+
+    >>> state, report = run_with_checkpointing(step, state, [], mgr)
+    >>> batches = realign_batches(make_batches(seed=0), report)
+    >>> state, report = run_with_checkpointing(step, state, batches,
+    ...                                        mgr)
+    """
+    step = (start_step.start_step if isinstance(start_step, RunReport)
+            else int(start_step))
+    if step < 0:
+        raise ValueError(f"start_step must be >= 0, got {step}")
+    iterator = iter(batches)
+    for skipped in range(step):
+        try:
+            next(iterator)
+        except StopIteration:
+            if strict:
+                raise ValueError(
+                    f"batch iterator ran dry after {skipped} of "
+                    f"{step} skipped steps — the pipeline is shorter "
+                    "than the checkpoint step, so the seed/order "
+                    "cannot match the run that saved"
+                ) from None
+            break
+    return iterator
+
+
 def run_with_checkpointing(
     step_fn,
     state,

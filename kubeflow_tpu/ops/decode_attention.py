@@ -24,7 +24,21 @@ grid pipelining. Traffic per token is O(filled ∧ window) + one block.
 
 The kernel reads the current position from a scalar-prefetch operand
 (``PrefetchScalarGridSpec``) — it must be known before the first index
-map runs, which is exactly what scalar prefetch is for.
+map runs, which is exactly what scalar prefetch is for. PR 8 extends
+the same program three ways (the decode-win issue):
+
+- **per-row positions**: ``pos`` may be a (B,) vector — each batch
+  row's live range clamps independently (the continuous batcher's
+  per-slot positions ride the SAME kernel as ``generate``'s scalar).
+- **int8 KV with in-kernel dequant**: ``k_scale``/``v_scale`` per-row
+  absmax scales ride as two extra blocked operands; the payload is
+  READ as int8 (the bandwidth win — quantized caches previously fell
+  back to the dense XLA path) and the scales fold into the score and
+  PV products exactly where the dense path applies them.
+- **rolling (circular) caches**: ``rolling=True`` reinterprets slot j
+  as the newest global position ≡ j (mod capacity) that is <= pos —
+  the windowed decode case, where the ring IS the window and one
+  Pallas program replaces the XLA score/mask/softmax/PV chain.
 
 No reference counterpart (the reference platform ships no model code;
 SURVEY.md §2.3): this is part of the TPU build's inference stack.
@@ -42,16 +56,28 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, block, window,
-                   capacity):
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale, block,
+                   window, capacity, hkv, quantized, rolling):
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        o_ref, m_scr, l_scr, acc_scr = rest[2:]
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+    bi = pl.program_id(0)
     j = pl.program_id(1)
-    pos = pos_ref[0]
-    hi = pos // block
-    lo = (
-        jnp.zeros((), jnp.int32) if window is None
-        else jnp.maximum(pos - window + 1, 0) // block
-    )
+    pos = pos_ref[bi // hkv]
+    if rolling:
+        # Ring: every slot <= pos is live (capacity <= window by the
+        # cache contract); slots past pos in the first lap are not.
+        hi = jnp.minimum(pos, capacity - 1) // block
+        lo = jnp.zeros((), jnp.int32)
+    else:
+        hi = pos // block
+        lo = (
+            jnp.zeros((), jnp.int32) if window is None
+            else jnp.maximum(pos - window + 1, 0) // block
+        )
 
     @pl.when(j == 0)
     def _init():
@@ -63,14 +89,30 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         q = q_ref[0]  # (rows, hd) — q heads of this kv head, padded
         k = k_ref[0]  # (block, hd)
+        if quantized:
+            # The HBM read stays int8 (half the cache traffic); the
+            # upcast happens on the VMEM tile and the per-row scale
+            # multiplies the thin score row, exactly like the dense
+            # path's post-contraction rescale.
+            k = k.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        keep = cols <= pos
-        if window is not None:
-            keep = jnp.logical_and(keep, cols > pos - window)
+        if quantized:
+            s = s * ks_ref[0][:, 0][None, :]
+        slots = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if rolling:
+            # Slot -> newest global position ≡ slot (mod capacity)
+            # that is <= pos; negative means unwritten (first lap).
+            # Ragged tail slots (>= capacity) alias valid residues
+            # through the mod, so they need an explicit mask.
+            global_pos = pos - (pos - slots) % capacity
+            keep = jnp.logical_and(global_pos >= 0, slots < capacity)
+        else:
+            keep = slots <= pos
+            if window is not None:
+                keep = jnp.logical_and(keep, slots > pos - window)
         s = jnp.where(keep, s, NEG_INF)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
@@ -79,6 +121,15 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_cur)
         l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0]
+        if quantized:
+            # vs folds into the (unnormalised) weights: p_j * vs_j / l
+            # == softmax_j * vs_j — the dense path's order, factored
+            # through the online accumulation. Ragged-tail scale lanes
+            # are undefined (NaN in interpret mode) and p is 0 there —
+            # but 0 * NaN = NaN, so mask the product, not just v.
+            p = p * vs_ref[0][:, 0][None, :]
+            if capacity % block:
+                p = jnp.where(slots < capacity, p, 0.0)
         if capacity % block:
             # Ragged tail: out-of-bounds v lanes are undefined (NaN in
             # interpret mode) and 0 * NaN = NaN would poison the PV
@@ -87,9 +138,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
             rows_pos = j * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, 1), 0
             )
-            v = jnp.where(rows_pos < capacity, v, 0.0)
+            v = jnp.where(rows_pos < capacity, v, 0)
+        pv = v.dtype if v.dtype != jnp.int8 else q.dtype
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(pv), v.astype(pv), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
@@ -104,14 +156,24 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def decode_attention(q, k_cache, v_cache, pos, *, window=None,
-                     block=512, interpret=None):
-    """q: (B, H, 1, hd) at global position ``pos`` (scalar int32);
-    k/v_cache: (B, Hkv, capacity, hd) with rows [0, pos] filled.
-    Capacity need not divide ``block``: the grid rounds up and the
-    ragged tail block's out-of-bounds lanes are NEG_INF-masked by the
-    ``col <= pos`` predicate (pos < capacity by the cache contract).
-    Masking: col <= pos, and col > pos - window when ``window`` is
-    set. Returns (B, H, 1, hd).
+                     block=512, k_scale=None, v_scale=None,
+                     rolling=False, interpret=None):
+    """q: (B, H, 1, hd) at global position ``pos`` — a scalar int32,
+    or a (B,) vector of PER-ROW positions (the continuous batcher's
+    slots); k/v_cache: (B, Hkv, capacity, hd) with rows [0, pos[b]]
+    filled. Capacity need not divide ``block``: the grid rounds up and
+    the ragged tail block's out-of-bounds lanes are NEG_INF-masked by
+    the ``col <= pos`` predicate (pos < capacity by the cache
+    contract). Masking: col <= pos, and col > pos - window when
+    ``window`` is set.
+
+    int8 caches pass ``k_scale``/``v_scale`` (B, Hkv, capacity, 1)
+    f32 per-row absmax scales — the payload is read as int8 and
+    dequantised in-kernel. ``rolling=True`` treats the cache as the
+    circular window buffer (slot j holds the newest global position
+    ≡ j (mod capacity) that is <= pos; capacity <= window keeps every
+    written slot in-band by construction, so no extra window mask).
+    Returns (B, H, 1, hd).
     """
     b, h, t, hd = q.shape
     if t != 1:
@@ -119,6 +181,12 @@ def decode_attention(q, k_cache, v_cache, pos, *, window=None,
     hkv, capacity = k_cache.shape[1], k_cache.shape[2]
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale come as a pair")
+    quantized = k_scale is not None
+    if rolling and window is None:
+        raise ValueError("rolling caches come from windowed models; "
+                         "pass the window")
     group = h // hkv
     # Pad the per-kv-head q rows to the 8-sublane tile.
     rows = max(8, -(-group // 8) * 8)
@@ -129,31 +197,48 @@ def decode_attention(q, k_cache, v_cache, pos, *, window=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = hd ** -0.5
+    block = min(block, -(-capacity // 8) * 8)
+    pos_vec = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (b,)
+    )
 
     def kv_index(bi, j, pos_arr):
         # Scalar-prefetch operands arrive AFTER the grid indices in
         # index maps (and before the operand refs in the kernel).
-        hi = pos_arr[0] // block
-        lo = (
-            jnp.zeros((), jnp.int32) if window is None
-            else jnp.maximum(pos_arr[0] - window + 1, 0) // block
-        )
+        row_pos = pos_arr[bi // hkv]
+        if rolling:
+            hi = jnp.minimum(row_pos, capacity - 1) // block
+            lo = jnp.zeros((), jnp.int32)
+        else:
+            hi = row_pos // block
+            lo = (
+                jnp.zeros((), jnp.int32) if window is None
+                else jnp.maximum(row_pos - window + 1, 0) // block
+            )
         return (bi, jnp.clip(j, lo, hi), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, rows, hd), lambda bi, j, pos_arr: (bi, 0, 0)),
+        pl.BlockSpec((1, block, hd), kv_index),
+        pl.BlockSpec((1, block, hd), kv_index),
+    ]
+    args = [pos_vec, qp, kr, vr]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, block, 1), kv_index))
+        in_specs.append(pl.BlockSpec((1, block, 1), kv_index))
+        args.append(k_scale.reshape(b * hkv, capacity, 1))
+        args.append(v_scale.reshape(b * hkv, capacity, 1))
 
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=scale, block=block, window=window,
-            capacity=capacity,
+            capacity=capacity, hkv=hkv, quantized=quantized,
+            rolling=rolling,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * hkv, -(-capacity // block)),
-            in_specs=[
-                pl.BlockSpec((1, rows, hd),
-                             lambda bi, j, pos_arr: (bi, 0, 0)),
-                pl.BlockSpec((1, block, hd), kv_index),
-                pl.BlockSpec((1, block, hd), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, rows, hd), lambda bi, j, pos_arr: (bi, 0, 0)
             ),
@@ -165,5 +250,5 @@ def decode_attention(q, k_cache, v_cache, pos, *, window=None,
         ),
         out_shape=jax.ShapeDtypeStruct((b * hkv, rows, hd), q.dtype),
         interpret=interpret,
-    )(jnp.reshape(pos, (1,)).astype(jnp.int32), qp, kr, vr)
+    )(*args)
     return out[:, :group].reshape(b, h, 1, hd)

@@ -1,0 +1,183 @@
+"""Fused QKV-projection + RoPE kernel for the decode step.
+
+One token costs three thin-row projections (q/k/v), two rotary
+embeddings and two cache writes before attention even starts. Each of
+those is cheap; what is NOT cheap at decode batch sizes is the LAUNCH
+— the round-5 floor decomposition (BASELINE.md) put b1 decode at
+~0.5 ms/step of fixed per-op overhead against ~0.35 ms of actual HBM
+traffic. This kernel collapses the front of the chain into ONE Pallas
+program: the q/k/v kernels are pre-concatenated into a single (K, N)
+weight streamed through VMEM tiles exactly like :mod:`ops.gemv`, and
+the rotary embedding for the q/k column region is applied on the VMEM
+tile while the next weight block's DMA is in flight. The K/V cache
+append stays an XLA ``dynamic_update_slice`` on the donated buffer —
+in-place, fused by XLA into the step program, and (unlike the matmul
+chain) not a separate launch worth saving.
+
+Numerics contract (pinned by the fused-vs-unfused parity matrix in
+tests/test_serving.py): identical op order to the unfused chain —
+f32-accumulated dot (optionally rescaled by the int8 per-channel
+scale), round to the compute dtype, rope in f32 on the rounded values
+(the exact :func:`kubeflow_tpu.ops.apply_rope` formula), round back.
+In interpret mode the fused and unfused paths are bit-identical; on
+TPU the only permissible divergence is the transcendental cos/sin
+lowering inside Mosaic.
+
+Positions ride a scalar-prefetch operand, one per activation row, so
+the SAME kernel serves ``generate``'s broadcast scalar position and
+the continuous batcher's per-slot position vector.
+
+No reference counterpart (the reference platform ships no model code);
+part of the compute stack in the jupyter-jax-tpu images.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubeflow_tpu.ops.gemv import _TILE_BYTES_CAP, MAX_ROWS
+
+
+def _rope_block(yd, pos2d, half: int, base: float):
+    """Rotary embedding over a (R, m, hd) tile of whole heads at
+    per-row positions ``pos2d`` (R, 1) int32 — apply_rope's exact
+    math: upcast to f32, rotate the two halves, round back to the
+    input dtype. Frequencies come from a 2-D+ iota (the TPU iota
+    rule) but evaluate to rope_table's formula bit-for-bit."""
+    f = yd.astype(jnp.float32)
+    f1, f2 = f[..., :half], f[..., half:]
+    lane = jax.lax.broadcasted_iota(jnp.float32, (1, 1, half), 2)
+    freqs = base ** (-lane / half)
+    angles = pos2d.astype(jnp.float32)[:, :, None] * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    rotated = jnp.concatenate(
+        [f1 * cos - f2 * sin, f2 * cos + f1 * sin], axis=-1
+    )
+    return rotated.astype(yd.dtype)
+
+
+def _qkv_kernel(x_ref, w_ref, pos_ref, *rest, scaled: bool, bn: int,
+                head_dim: int, rope_cols: int, base: float):
+    s_ref = rest[0] if scaled else None
+    o_ref = rest[1] if scaled else rest[0]
+    j = pl.program_id(0)
+    w = w_ref[:]
+    if w.dtype == jnp.int8:
+        w = w.astype(x_ref.dtype)
+    y = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+    if scaled:
+        y = y * s_ref[:]
+    yd = y.astype(o_ref.dtype)
+    rows = yd.shape[0]
+    m = bn // head_dim
+    heads = yd.reshape(rows, m, head_dim)
+    roped = _rope_block(heads, pos_ref[:, :1], head_dim // 2, base)
+    cols = j * bn + jax.lax.broadcasted_iota(jnp.int32, (rows, bn), 1)
+    o_ref[:] = jnp.where(
+        cols < rope_cols,
+        roped.reshape(rows, bn),
+        yd,
+    )
+
+
+def qkv_rope_block(head_dim: int, n: int, itemsize: int,
+                   block_n: int = 512, k: int = 4096) -> int | None:
+    """Block width for :func:`qkv_rope`: a multiple of BOTH the head
+    dim (rope pairs stay in-tile) and 128 (Mosaic lanes) that divides
+    ``n`` and fits the VMEM tile budget next to the activation row.
+    None when no such width exists (caller falls back unfused)."""
+    base = math.lcm(head_dim, 128)
+    if n % base:
+        return None
+    # Widest width that is a base-multiple, DIVIDES n (a non-divisor
+    # would leave tail output columns unwritten), respects block_n and
+    # fits the (k, bn) tile budget; the budget is soft at the floor (a
+    # single block must ship regardless) — gemv._pick_block's rule.
+    best = base
+    for bn in range(base, min(block_n, n) + 1, base):
+        if n % bn == 0 and k * bn * itemsize <= _TILE_BYTES_CAP:
+            best = bn
+    return best
+
+
+def qkv_rope_fits(rows: int, k: int, n: int, head_dim: int) -> bool:
+    """True when :func:`qkv_rope` accepts these shapes."""
+    return (rows <= MAX_ROWS and k % 128 == 0 and head_dim % 2 == 0
+            and qkv_rope_block(head_dim, n, 2, k=k) is not None)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("head_dim", "rope_heads", "base", "block_n",
+                     "interpret"))
+def qkv_rope(x: jax.Array, w: jax.Array, pos: jax.Array,
+             scale: jax.Array | None = None, *, head_dim: int,
+             rope_heads: int, base: float = 10000.0,
+             block_n: int = 512,
+             interpret: bool | None = None) -> jax.Array:
+    """(R, K) @ (K, N) with rope fused onto the leading q/k heads.
+
+    ``w`` holds the q, k and v projection kernels concatenated along
+    the output axis — N = (heads + 2 * kv_heads) * head_dim; the first
+    ``rope_heads`` (= heads + kv_heads) head-columns get the rotary
+    embedding at per-row position ``pos`` (R,) int32, the v region
+    passes through. ``scale`` (N,) f32 rescales an int8 ``w`` before
+    the dtype round (the unfused W8A16 order). Returns (R, N) in
+    x.dtype — f32-accumulated, rounded once, exactly like the unfused
+    ``_mm(...).astype`` chain.
+    """
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(
+            f"qkv_rope wants 2-D x and w, got {x.shape} @ {w.shape}")
+    rows, k = x.shape
+    wk, n = w.shape
+    if wk != k:
+        raise ValueError(f"contraction mismatch: x {x.shape}, w {w.shape}")
+    if rows > MAX_ROWS:
+        raise ValueError(
+            f"qkv_rope is a thin-row kernel (rows <= {MAX_ROWS}); got "
+            f"{rows}")
+    if k % 128:
+        raise ValueError(f"K must be 128-aligned for Mosaic tiling; K={k}")
+    if pos.shape != (rows,):
+        raise ValueError(f"pos must be ({rows},), got {pos.shape}")
+    bn = qkv_rope_block(head_dim, n, w.dtype.itemsize, block_n, k=k)
+    if bn is None:
+        raise ValueError(
+            f"no block width is a multiple of head_dim {head_dim} and "
+            f"128 and divides N={n} — use the unfused path"
+        )
+    rope_cols = rope_heads * head_dim
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Positions ride a small VMEM operand (the lanes are broadcast so
+    # the tile is well-formed for every backend) — the index maps do
+    # not depend on them, so scalar prefetch buys nothing here.
+    pos_tile = jnp.broadcast_to(
+        pos.astype(jnp.int32)[:, None], (rows, 128)
+    )
+    in_specs = [
+        pl.BlockSpec((rows, k), lambda j: (0, 0)),
+        pl.BlockSpec((k, bn), lambda j: (0, j)),
+        pl.BlockSpec((rows, 128), lambda j: (0, 0)),
+    ]
+    args = [x, w, pos_tile]
+    if scale is not None:
+        in_specs.append(pl.BlockSpec((1, bn), lambda j: (0, j)))
+        args.append(scale.reshape(1, n).astype(jnp.float32))
+    return pl.pallas_call(
+        functools.partial(
+            _qkv_kernel, scaled=scale is not None, bn=bn,
+            head_dim=head_dim, rope_cols=rope_cols, base=base,
+        ),
+        grid=(n // bn,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rows, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), x.dtype),
+        interpret=interpret,
+    )(*args)

@@ -48,29 +48,49 @@ def _pick_block(k: int, n: int, itemsize: int, block_n: int) -> int:
     return best
 
 
-def _kernel(x_ref, w_ref, o_ref, *, transpose_w: bool):
+def _kernel(x_ref, w_ref, *rest, transpose_w: bool, scaled: bool,
+            fused_residual: bool):
+    # Optional trailing inputs in declaration order: per-output-channel
+    # scale (int8 weights), then the residual tile.
+    idx = 0
+    s_ref = rest[idx] if scaled else None
+    idx += 1 if scaled else 0
+    r_ref = rest[idx] if fused_residual else None
+    idx += 1 if fused_residual else 0
+    o_ref = rest[idx]
     w = w_ref[:]
     if w.dtype == jnp.int8:
         # Weight-only int8: the HBM read is int8 (half the traffic);
         # the upcast happens on the VMEM tile. The per-output-channel
-        # scale is applied by the caller AFTER the dot (equivalent to
-        # scaling the columns, one multiply on a thin row instead of
-        # K x bn).
+        # scale is applied AFTER the dot (equivalent to scaling the
+        # columns, one multiply on a thin row instead of K x bn) —
+        # in-kernel when the epilogue needs it, by the caller else.
         w = w.astype(x_ref.dtype)
     if transpose_w:
         # w tile is (bn, K); contract x's K with w's K.
-        o_ref[:] = jax.lax.dot_general(
+        y = jax.lax.dot_general(
             x_ref[:], w, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
     else:
-        o_ref[:] = jnp.dot(x_ref[:], w,
-                           preferred_element_type=jnp.float32)
+        y = jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+    if scaled:
+        y = y * s_ref[:]
+    if fused_residual:
+        # Same op order as the unfused callers (dot -> f32 -> compute
+        # dtype -> add): residual + y.astype(residual.dtype), so the
+        # fused epilogue is bit-identical to the XLA chain it replaces.
+        y = r_ref[:] + y.astype(r_ref.dtype)
+        o_ref[:] = y
+    else:
+        o_ref[:] = y
 
 
 @functools.partial(
     jax.jit, static_argnames=("transpose_w", "block_n", "interpret"))
-def gemv(x: jax.Array, w: jax.Array, *, transpose_w: bool = False,
+def gemv(x: jax.Array, w: jax.Array, scale: jax.Array | None = None,
+         residual: jax.Array | None = None, *,
+         transpose_w: bool = False,
          block_n: int = 512, interpret: bool | None = None) -> jax.Array:
     """(R, K) @ (K, N) -> (R, N) f32, streaming ``w`` in VMEM tiles.
 
@@ -80,6 +100,19 @@ def gemv(x: jax.Array, w: jax.Array, *, transpose_w: bool = False,
     compute dtype (bf16); accumulation and output are f32 (same MXU
     accumulate-then-round contract as the XLA path, so callers cast
     the result exactly like a ``preferred_element_type=f32`` dot).
+
+    Fused epilogue (PR 8, the decode-step launch-count diet):
+
+    - ``scale`` (N,) f32 — per-output-channel int8 weight scales,
+      multiplied onto the f32 dot in-kernel (required when
+      ``residual`` is given with an int8 ``w``: the rescale must land
+      before the residual add, exactly like the unfused chain).
+    - ``residual`` (R, N) compute dtype — the projection's residual
+      stream. The kernel emits ``residual + y.astype(residual.dtype)``
+      (bit-identical op order to the XLA ``x + mm(...).astype(dt)``
+      chain) and the output dtype becomes the residual's, so the
+      attention-out and FFN-down projections retire in ONE kernel
+      instead of kernel + cast + add launches.
     """
     if x.ndim != 2 or w.ndim != 2:
         raise ValueError(f"gemv wants 2-D x and w, got {x.shape} @ {w.shape}")
@@ -94,19 +127,39 @@ def gemv(x: jax.Array, w: jax.Array, *, transpose_w: bool = False,
     if k % 128 or n % 128:
         raise ValueError(f"K and N must be 128-aligned for Mosaic tiling; "
                          f"got K={k}, N={n}")
+    if w.dtype == jnp.int8 and residual is not None and scale is None:
+        raise ValueError(
+            "int8 w with a fused residual needs the per-channel scale "
+            "in-kernel (the rescale must precede the residual add)"
+        )
+    if residual is not None and residual.shape != (rows, n):
+        raise ValueError(
+            f"residual must be ({rows}, {n}), got {residual.shape}"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     bn = _pick_block(k, n, w.dtype.itemsize, block_n)
     w_spec = (pl.BlockSpec((bn, k), lambda i: (i, 0)) if transpose_w
               else pl.BlockSpec((k, bn), lambda i: (0, i)))
+    in_specs = [pl.BlockSpec((rows, k), lambda i: (0, 0)), w_spec]
+    args = [x, w]
+    if scale is not None:
+        in_specs.append(pl.BlockSpec((1, bn), lambda i: (0, i)))
+        args.append(scale.reshape(1, n).astype(jnp.float32))
+    if residual is not None:
+        in_specs.append(pl.BlockSpec((rows, bn), lambda i: (0, i)))
+        args.append(residual)
+    out_dtype = jnp.float32 if residual is None else residual.dtype
     return pl.pallas_call(
-        functools.partial(_kernel, transpose_w=transpose_w),
+        functools.partial(_kernel, transpose_w=transpose_w,
+                          scaled=scale is not None,
+                          fused_residual=residual is not None),
         grid=(n // bn,),
-        in_specs=[pl.BlockSpec((rows, k), lambda i: (0, 0)), w_spec],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((rows, n), out_dtype),
         interpret=interpret,
-    )(x, w)
+    )(*args)
 
 
 def gemv_fits(rows: int, k: int, n: int) -> bool:

@@ -17,6 +17,13 @@ the CR template):
   prompts longer than this many tokens prefill one chunk per cycle so
   a single 32k prompt cannot monopolise a batch cycle (unset =
   monolithic prefill).
+- ``KFT_SERVING_SPEC_NGRAM`` — "1"/"true" turns on self-speculative
+  n-gram decoding: each cycle drafts ``KFT_SERVING_SPEC_DRAFT``
+  (default 8) tokens per slot from its own prompt/output n-grams
+  (context length ``KFT_SERVING_SPEC_NGRAM_N``, default 3) and
+  verifies them in one batched dispatch — token-identical output,
+  several tokens per dispatch on repetitive text. Ignored (with a
+  warning) on windowed/rolling models.
 """
 
 from __future__ import annotations
@@ -96,6 +103,8 @@ def main(argv=None) -> None:
                         "initialised params", model_dir)
     eos = env.get("KFT_SERVING_EOS")
     chunk = env.get("KFT_SERVING_PREFILL_CHUNK")
+    spec = env.get("KFT_SERVING_SPEC_NGRAM", "").lower() in (
+        "1", "true", "yes")
     engine = make_engine(
         cfg, params,
         max_batch=int(env.get("KFT_SERVING_MAX_BATCH", "8")),
@@ -105,6 +114,9 @@ def main(argv=None) -> None:
         # in chunks across cycles so one 32k prompt cannot monopolise
         # a batch cycle. Unset = monolithic prefill.
         prefill_chunk_tokens=int(chunk) if chunk else None,
+        spec_ngram=spec,
+        spec_draft=int(env.get("KFT_SERVING_SPEC_DRAFT", "8")),
+        spec_ngram_n=int(env.get("KFT_SERVING_SPEC_NGRAM_N", "3")),
     )
     gateway = InferenceGateway(
         engine,

@@ -27,6 +27,14 @@ step and parity contract and adds the serving mechanics on top:
 - **Hot model swap**: :meth:`swap_params` stages a new params pytree;
   the scheduler applies it between cycles after draining in-flight
   slots (queued requests wait and are served by the new weights).
+- **Self-speculative n-gram decoding** (opt-in,
+  ``KFT_SERVING_SPEC_NGRAM``): instead of one lockstep token per
+  dispatch, every active slot drafts ``spec_draft`` tokens from its
+  own prompt/output n-grams (models/speculative.py) and ONE batched
+  ``verify_step`` scores all of them; each slot keeps its longest
+  matching prefix + the model's correction. Token-identical to the
+  plain cycle (greedy and seeded sampling) — repetitive workloads
+  just retire several tokens per dispatch. Linear slots only.
 
 :class:`GenerateFallbackEngine` serves the same interface through
 serialized ``generate()`` calls for models the batcher refuses at
@@ -57,8 +65,11 @@ from kubeflow_tpu.models.serving import (
     ContinuousBatcher,
     _sample,
     check_request_contract,
+    commit_verify,
     splice_slot,
+    verify_step,
 )
+from kubeflow_tpu.models.speculative import NGramProposer
 from kubeflow_tpu.models.transformer import LMConfig
 from kubeflow_tpu.obs.metrics import BucketHistogram
 
@@ -289,13 +300,45 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                  step_chunk: int = 8, quantize_cache: bool = False,
                  prefill_per_cycle: int = 2, max_pending: int = 64,
                  prefix_cache_size: int = 8,
-                 prefill_chunk_tokens: int | None = None):
+                 prefill_chunk_tokens: int | None = None,
+                 spec_ngram: bool = False, spec_draft: int = 8,
+                 spec_ngram_n: int = 3, spec_lookback: int = 4096):
         ContinuousBatcher.__init__(
             self, cfg, params, max_batch, max_len, eos_token=eos_token,
             step_chunk=step_chunk, quantize_cache=quantize_cache)
         _EngineBase.__init__(self, max_pending=max_pending)
         if prefill_per_cycle < 1:
             raise ValueError("prefill_per_cycle must be >= 1")
+        if spec_ngram and self.rolling:
+            # A rejected draft's ring write has already evicted the
+            # slot it landed in — there is nothing to rewind to.
+            raise ValueError(
+                "speculative decoding requires linear slots "
+                "(cfg.attn_window makes this engine rolling)"
+            )
+        self.spec_ngram = spec_ngram
+        self.spec_draft = spec_draft
+        self.spec_ngram_n = spec_ngram_n
+        # The host proposer scans this many trailing history tokens
+        # per slot per cycle — without a cap, per-cycle host work
+        # grows with every emitted token (O(history) numpy passes per
+        # slot) until it competes with the device dispatch. Matches
+        # deeper in a 32k prompt are rare enough not to chase.
+        self.spec_lookback = spec_lookback
+        self.spec_verifies_total = 0
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        if spec_ngram:
+            self._proposer = NGramProposer(n=spec_ngram_n, k=spec_draft)
+            # Verify chunks overwrite up to spec_draft rows past the
+            # accepted prefix; the admission bound must reserve the
+            # overshoot (see ContinuousBatcher._build_request).
+            self.reserve_slack = max(self.step_chunk, spec_draft)
+            self._verify = jax.jit(
+                lambda params, state, tokens, keys:
+                verify_step(cfg, params, state, tokens, keys),
+                donate_argnums=(1,))
+            self._commit = jax.jit(commit_verify, donate_argnums=(0,))
         if prefill_chunk_tokens is not None:
             if prefill_chunk_tokens < 1:
                 raise ValueError("prefill_chunk_tokens must be >= 1")
@@ -392,7 +435,12 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
         if staged is not None:
             self.draining = True
             if not any(s is not None for s in self._slots):
-                self.params = staged
+                from kubeflow_tpu.models.decoding import fuse_qkv_params
+
+                # Same rule as construction: precompute the fused qkv
+                # weights once per params version, not per dispatch.
+                self.params = fuse_qkv_params(
+                    self.cfg, staged, rows=len(self._slots))
                 self._consume_staged(staged)
                 if self.prefix_cache is not None:
                     # Cached KV was computed by the OLD weights; mixing
@@ -416,23 +464,102 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                         or self._pending_params is not None)
             return busy or self._partial is not None
         started = time.monotonic()
-        keys = self._chunk_keys()
-        self.state, toks = self._chunk(self.params, self.state, keys)
-        toks = jax.device_get(toks)  # (step_chunk, B)
-        for row in toks:
-            for slot, req in enumerate(self._slots):
-                if req is None or req["done"]:
-                    continue
-                token = int(row[slot])
-                self._results[req["id"]].append(token)
-                self._emit(req, {"token": token})
-                self._check_done(req, token)
+        if self.spec_ngram:
+            self._spec_decode_cycle()
+        else:
+            keys = self._chunk_keys()
+            self.state, toks = self._chunk(self.params, self.state, keys)
+            toks = jax.device_get(toks)  # (step_chunk, B)
+            for row in toks:
+                for slot, req in enumerate(self._slots):
+                    if req is None or req["done"]:
+                        continue
+                    token = int(row[slot])
+                    self._results[req["id"]].append(token)
+                    self._emit(req, {"token": token})
+                    self._check_done(req, token)
         self.cycle_seconds["decode"].observe(time.monotonic() - started)
         for slot, req in enumerate(self._slots):
             if req is not None and req["done"]:
                 self._finish(req)
                 self._free(slot)
         return True
+
+    # ------------------------------------------- speculative decoding
+    def _spec_decode_cycle(self) -> None:
+        """One speculative verify for every active slot: the host
+        n-gram proposer drafts per-slot continuations from prompt +
+        emitted history, ONE batched ``verify_step`` scores all
+        ``spec_draft + 1`` positions per slot, and each slot keeps its
+        longest matching prefix + the model's correction — token-
+        identical to the lockstep single-token cycle (the drafts only
+        change how many tokens one dispatch retires). Slots with no
+        repetition still emit >= 1 token per cycle (rejection-free)."""
+        from kubeflow_tpu.models.serving import slice_step_keys
+
+        t = self.spec_draft + 1
+        rows, key_cols, drafts = [], [], []
+        dummy_keys = jnp.broadcast_to(self._dummy_key, (t,))
+        for req in self._slots:
+            if req is None or req["done"]:
+                rows.append([0] * t)
+                key_cols.append(dummy_keys)
+                drafts.append(None)
+                continue
+            emitted_toks = self._results[req["id"]]
+            # Bounded lookback: slice the two sources instead of
+            # concatenating full prompt + output every cycle.
+            keep = self.spec_lookback
+            if len(emitted_toks) >= keep:
+                history = emitted_toks[-keep:]
+            else:
+                history = (req["prompt"][len(emitted_toks) - keep:]
+                           + emitted_toks)
+            draft = self._proposer.propose(history)
+            rows.append([history[-1]] + draft)
+            drafts.append(draft)
+            # Cursor NOT advanced here — emitted tokens consume keys,
+            # and acceptance decides how many get emitted.
+            window, _ = slice_step_keys(
+                req["step_keys"], req["kcur"], t, dummy_keys)
+            key_cols.append(window)
+        tokens = jnp.asarray(rows, jnp.int32)
+        keys = jnp.stack(key_cols, axis=0)
+        self.state, cand = self._verify(self.params, self.state,
+                                        tokens, keys)
+        cand = jax.device_get(cand)  # (B, t)
+        accepted = [0] * len(self._slots)
+        lasts = [0] * len(self._slots)
+        self.spec_verifies_total += 1
+        for slot, req in enumerate(self._slots):
+            if req is None or req["done"]:
+                continue
+            draft = drafts[slot]
+            row = [int(c) for c in cand[slot]]
+            match = 0
+            while match < self.spec_draft and row[match] == draft[match]:
+                match += 1
+            self.spec_drafted_total += self.spec_draft
+            emitted = 0
+            for token in row[:match + 1]:
+                self._results[req["id"]].append(token)
+                self._emit(req, {"token": token})
+                emitted += 1
+                self._check_done(req, token)
+                if req["done"]:
+                    break
+            if req["step_keys"] is not None:
+                req["kcur"] += emitted
+            accepted[slot] = emitted
+            lasts[slot] = row[emitted - 1]
+            # Accepted drafts among what was actually emitted: the
+            # correction token is only present when the cycle wasn't
+            # cut short by eos/budget (emitted == match + 1); a
+            # truncated cycle emitted matching drafts only.
+            self.spec_accepted_total += min(emitted, match)
+        self.state = self._commit(
+            self.state, jnp.asarray(accepted, jnp.int32),
+            jnp.asarray(lasts, jnp.int32))
 
     def _admit_capped(self) -> int:
         admitted = 0
@@ -641,6 +768,7 @@ class GenerateFallbackEngine(_EngineBase):
     that is the documented cost of the fallback, not a bug."""
 
     batched = False
+    spec_ngram = False
 
     def __init__(self, cfg: LMConfig, params, max_len: int,
                  eos_token: int | None = None, max_pending: int = 64):
@@ -717,15 +845,18 @@ def make_engine(cfg: LMConfig, params, max_batch: int = 8,
                 step_chunk: int = 8, quantize_cache: bool = False,
                 prefill_per_cycle: int = 2, max_pending: int = 64,
                 prefix_cache_size: int = 8,
-                prefill_chunk_tokens: int | None = None):
+                prefill_chunk_tokens: int | None = None,
+                spec_ngram: bool = False, spec_draft: int = 8,
+                spec_ngram_n: int = 3):
     """Best engine the model supports: the streaming batcher, or the
     serialized ``generate()`` fallback when the batcher refuses the
     config (MoE decode) — the gateway keeps serving either way. A
-    chunked-prefill request on a rolling (windowed-attention) model
-    likewise degrades — to monolithic prefill — instead of refusing to
-    serve: a tuning flag must never CrashLoop a pod that served fine
-    without it."""
-    def build(chunk):
+    chunked-prefill or speculative request on a rolling
+    (windowed-attention) model likewise degrades — to monolithic
+    prefill / plain lockstep decode — instead of refusing to serve: a
+    tuning flag must never CrashLoop a pod that served fine without
+    it."""
+    def build(chunk, spec):
         return StreamingBatcher(
             cfg, params, max_batch=max_batch, max_len=max_len,
             eos_token=eos_token, step_chunk=step_chunk,
@@ -733,19 +864,21 @@ def make_engine(cfg: LMConfig, params, max_batch: int = 8,
             prefill_per_cycle=prefill_per_cycle,
             max_pending=max_pending,
             prefix_cache_size=prefix_cache_size,
-            prefill_chunk_tokens=chunk)
+            prefill_chunk_tokens=chunk,
+            spec_ngram=spec, spec_draft=spec_draft,
+            spec_ngram_n=spec_ngram_n)
 
     try:
         try:
-            return build(prefill_chunk_tokens)
+            return build(prefill_chunk_tokens, spec_ngram)
         except ValueError as exc:
-            if prefill_chunk_tokens is None or \
-                    "linear slots" not in str(exc):
+            if "linear slots" not in str(exc) or not (
+                    prefill_chunk_tokens is not None or spec_ngram):
                 raise
             log.warning(
-                "chunked prefill unavailable (%s); serving with "
-                "monolithic prefill", exc)
-            return build(None)
+                "linear-slot feature unavailable (%s); serving with "
+                "monolithic prefill / lockstep decode", exc)
+            return build(None, False)
     except NotImplementedError as exc:
         log.warning(
             "continuous batching unavailable (%s); serving through "

@@ -2,10 +2,15 @@
 
 The serving analogue of start_notebooks.py: N closed-loop clients
 (each issues the next request the moment its stream completes) drive
-``POST /v1/generate`` and capture the two serving SLO numbers the
+``POST /v1/generate`` and capture the serving SLO numbers the
 platform optimises for — time-to-first-token (arrival of the first
-SSE data frame) and end-to-end stream time — plus aggregate
-tokens/sec; the summary prints as one JSON line with p50/p99.
+SSE data frame), steady-state per-request inter-token latency
+(``itl_p50_s``/``itl_p99_s``: pooled gaps between consecutive data
+frames after each stream's first token — the decode hot path, where
+the fused-kernel/speculative wins land) with the per-stream decode
+rate ``decode_tokens_per_s_per_stream`` (= pooled gap count / pooled
+gap seconds), and end-to-end stream time — plus aggregate tokens/sec;
+the summary prints as one JSON line with p50/p99.
 
 Modes:
 
@@ -66,6 +71,8 @@ def stream_one(url: str, prompt: list[int], max_new: int,
         ttft = None
         tokens = 0
         done = None
+        last_token_at = None
+        gaps: list[float] = []
         with response:
             event = None
             for raw in response:
@@ -77,8 +84,15 @@ def stream_one(url: str, prompt: list[int], max_new: int,
                     if event == "done":
                         done = payload
                         break
+                    now = time.monotonic()
                     if ttft is None:
-                        ttft = time.monotonic() - started
+                        ttft = now - started
+                    else:
+                        # Steady-state inter-token latency: the gap
+                        # between consecutive data frames AFTER the
+                        # first token (prefill lives in TTFT).
+                        gaps.append(now - last_token_at)
+                    last_token_at = now
                     tokens += 1
                 elif not line:
                     event = None
@@ -86,6 +100,7 @@ def stream_one(url: str, prompt: list[int], max_new: int,
             "ttft_s": ttft if ttft is not None else float("nan"),
             "total_s": time.monotonic() - started,
             "tokens": tokens,
+            "itl_s": gaps,
             "shed": shed,
             "cache_hit": bool(done and done.get("cache_hit")),
         }
@@ -134,6 +149,13 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
                    if r["ttft_s"] == r["ttft_s"])  # NaN-free
     totals = sorted(r["total_s"] for r in results)
     tokens = sum(r["tokens"] for r in results)
+    # Steady-state decode numbers: pooled per-request inter-token
+    # gaps (every gap after each stream's first token). The kernel
+    # wins PR 8 chases live exactly here — TTFT is prefill-bound, the
+    # gaps are the decode hot path.
+    gaps = sorted(g for r in results for g in r["itl_s"])
+    decode_tok_s = (round(len(gaps) / sum(gaps), 2)
+                    if gaps and sum(gaps) > 0 else 0.0)
     return {
         "metric": "inference_gateway_load",
         "count": len(results),
@@ -143,6 +165,9 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
         "tokens_per_s": round(tokens / wall, 2) if wall else 0.0,
         "ttft_p50_s": round(percentile(ttfts, 0.50), 4),
         "ttft_p99_s": round(percentile(ttfts, 0.99), 4),
+        "itl_p50_s": round(percentile(gaps, 0.50), 5),
+        "itl_p99_s": round(percentile(gaps, 0.99), 5),
+        "decode_tokens_per_s_per_stream": decode_tok_s,
         "total_p50_s": round(percentile(totals, 0.50), 4),
         "total_p99_s": round(percentile(totals, 0.99), 4),
         "shed": sum(r["shed"] for r in results),

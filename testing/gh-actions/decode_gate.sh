@@ -1,0 +1,133 @@
+#!/usr/bin/env bash
+# Decode gate for CI (PR 8). Three checks:
+#
+# 1. Fast decode parity subset: the fused-kernel parity matrix, the
+#    speculative token-identity suite, the verify-step chain tests and
+#    the kernel-level extension tests (all tier-1 members, so the gate
+#    holds even where CI doesn't run). RUN_SLOW=1 widens to every
+#    slow-marked serving/generate case (compile-heavy gateway paths).
+#
+# 2. Decode bench artifact: a tiny-model timing pass over the plain,
+#    fused-forced and speculative decode paths (CPU interpret — NOT a
+#    perf claim, the flagship numbers come from bench.py on TPU) so
+#    every CI run leaves a decode-bench.json breadcrumb proving the
+#    three paths run end to end and agree token-for-token.
+#
+# 3. Static analysis: the decode stack (ops/ + decoding/speculative/
+#    serving model files + kubeflow_tpu/serving/) must hold EVERY pack
+#    at zero findings with no pragma budget.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== decode gate: fused + speculative parity subset =="
+FAST_TESTS=(
+  tests/test_speculative.py
+  "tests/test_serving.py::TestFusedDecodeParity"
+  "tests/test_serving.py::TestVerifyStep"
+  "tests/test_serving.py::TestSpeculativeEngine"
+  "tests/test_generate.py::TestGemvResidualEpilogue"
+  "tests/test_generate.py::TestQkvRopeKernel"
+  "tests/test_generate.py::TestDecodeKernelExtensions"
+)
+if [ "${RUN_SLOW:-0}" = "1" ]; then
+  # The full compile-heavy matrix: every serving/generate/speculative
+  # test incl. slow-marked gateway paths.
+  python -m pytest tests/test_speculative.py tests/test_serving.py \
+    tests/test_generate.py \
+    "tests/test_inference.py::TestSpeculativeGateway" \
+    -q -p no:cacheprovider
+else
+  python -m pytest "${FAST_TESTS[@]}" -q -p no:cacheprovider \
+    -m 'not slow'
+fi
+
+echo "== decode gate: tiny-model decode bench artifact =="
+python - <<'PY'
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state
+from kubeflow_tpu.models import decoding
+from kubeflow_tpu.models.decoding import generate
+from kubeflow_tpu.models.speculative import speculative_generate
+
+cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4, kv_heads=2,
+               dtype=jnp.bfloat16)
+model = build_lm(cfg, use_flash=False)
+params = create_lm_state(model, jax.random.key(0), (1, 16)).params
+rng = np.random.default_rng(0)
+base = rng.integers(0, cfg.vocab, size=16)
+prompt = jnp.asarray(np.tile(base, 6)[None, :], jnp.int32)
+NEW = 32
+
+
+def timed(fn):
+    out = fn()
+    toks = np.asarray(jax.device_get(out))
+    t0 = time.perf_counter()
+    out = fn()
+    jax.device_get(out)
+    return toks, time.perf_counter() - t0
+
+
+sections = {}
+ref = None
+prev = decoding.DECODE_FUSED
+try:
+    for name, mode, fn in [
+        ("decode[tiny-plain]", "off",
+         lambda: generate(cfg, params, prompt, NEW)),
+        ("decode[tiny-fused]", "on",
+         lambda: generate(cfg, params, prompt, NEW)),
+        ("decode[tiny-spec]", "off",
+         lambda: speculative_generate(cfg, params, prompt, NEW)),
+    ]:
+        decoding.DECODE_FUSED = mode
+        jax.clear_caches()
+        toks, dt = timed(fn)
+        if ref is None:
+            ref = toks
+        assert (toks == ref).all(), f"{name} diverged from plain decode"
+        sections[name] = {"tok_s": round(NEW / dt, 1)}
+finally:
+    decoding.DECODE_FUSED = prev
+    jax.clear_caches()
+
+record = {"metric": "decode_gate_tiny_bench", "backend": "cpu-interpret",
+          "note": "path-agreement breadcrumb, not a perf claim",
+          "sections": sections}
+with open("decode-bench.json", "w") as fh:
+    json.dump(record, fh, indent=1)
+    fh.write("\n")
+print(json.dumps(record))
+PY
+
+echo "== decode gate: analysis packs at zero findings =="
+python -m kubeflow_tpu.analysis kubeflow_tpu/ops \
+  kubeflow_tpu/models/decoding.py kubeflow_tpu/models/speculative.py \
+  kubeflow_tpu/models/serving.py kubeflow_tpu/serving
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/ops", "kubeflow_tpu/models/decoding.py",
+           "kubeflow_tpu/models/speculative.py",
+           "kubeflow_tpu/models/serving.py", "kubeflow_tpu/serving"],
+    check_emitted=False,
+))
+# No pragma budget, no baseline: the decode stack must be spotless
+# under every pack, dataflow included.
+if findings:
+    print("\n".join(f.render() for f in findings))
+    raise SystemExit(1)
+print("  decode stack: clean under all packs")
+PY
+
+echo "decode gate: OK"

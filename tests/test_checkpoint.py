@@ -474,6 +474,63 @@ class TestRunWithCheckpointing:
         assert state["w"][0] == 12.0  # arithmetic continued, not restarted
         assert mgr2.metrics.restore_total["resumed"] == 1
 
+    def test_realign_batches_resumes_at_right_example(self, tmp_path):
+        """PR-8 satellite (ROADMAP item 5 follow-up): a fresh seeded
+        iterator fast-forwarded by report.start_step feeds the resumed
+        run the example the interrupted run would have seen next —
+        incl. after an elastic reshard, where the new incarnation
+        rebuilds its pipeline from scratch."""
+        from kubeflow_tpu.models.train import realign_batches
+
+        import itertools
+
+        from kubeflow_tpu.models.train import RunReport
+
+        def seeded_batches(n=20):
+            # A deterministic "pipeline": batch i carries value i+1.
+            for i in range(n):
+                yield {"x": np.full(4, float(i + 1), np.float32)}
+
+        mgr = CheckpointManager(tmp_path, keep=10)
+        _state, report = run_with_checkpointing(
+            counting_step, fresh_state(),
+            itertools.islice(seeded_batches(), 7), mgr,
+            save_every_steps=5, install_signal_handler=False,
+        )
+        assert mgr.steps() == [5]
+
+        mgr2 = CheckpointManager(tmp_path, keep=10)
+        seen: list[float] = []
+
+        def spy(batches):
+            for batch in batches:
+                seen.append(float(batch["x"][0]))
+                yield batch
+
+        # The canonical resume shape: RunReport in, iterator
+        # fast-forwarded to its start_step.
+        batches = realign_batches(seeded_batches(),
+                                  RunReport(start_step=5))
+        _state, report2 = run_with_checkpointing(
+            counting_step, fresh_state(), spy(batches), mgr2,
+            install_signal_handler=False,
+        )
+        # The resumed run (from step 5) consumed example 6 first —
+        # exactly what the interrupted run would have drawn next.
+        assert report2.start_step == 5
+        assert seen[0] == 6.0
+
+        # An int works too, and a dry iterator fails loudly instead
+        # of silently restarting the data order.
+        it = realign_batches(seeded_batches(3), 2)
+        assert float(next(it)["x"][0]) == 3.0
+        with pytest.raises(ValueError, match="ran dry"):
+            realign_batches(seeded_batches(3), 5)
+        # Non-strict mode: a short pipeline just drains (caller opted
+        # out of the guard).
+        assert list(realign_batches(seeded_batches(3), 5,
+                                    strict=False)) == []
+
     def test_wall_clock_cadence(self, tmp_path):
         mgr = CheckpointManager(tmp_path, keep=10)
         now = [0.0]

@@ -731,3 +731,305 @@ def test_int8_weights_moe_quantizes_attention_only():
     out = decoding.generate(cfg, qp, tokens, 6)
     assert out.shape == (1, 6)
     assert int(out.max()) < cfg.vocab
+
+
+class TestGemvResidualEpilogue:
+    """gemv's fused residual add (PR 8): bit-identical to the XLA
+    chain it replaces (dot -> f32 -> compute dtype -> add), incl. the
+    in-kernel int8 per-channel rescale that must precede the add."""
+
+    def _case(self, quantized=False):
+        from kubeflow_tpu.ops.gemv import gemv
+
+        rng = np.random.default_rng(5 + quantized)
+        dt = jnp.bfloat16
+        x = jnp.asarray(rng.normal(size=(2, 128)), dt)
+        res = jnp.asarray(rng.normal(size=(2, 256)), dt)
+        if quantized:
+            w = jnp.asarray(rng.integers(-127, 128, size=(128, 256)),
+                            jnp.int8)
+            scale = jnp.asarray(rng.uniform(0.01, 0.1, size=(256,)),
+                                jnp.float32)
+            ref = res + (gemv(x, w) * scale).astype(dt)
+            out = gemv(x, w, scale=scale, residual=res)
+        else:
+            w = jnp.asarray(rng.normal(size=(128, 256)), dt)
+            ref = res + gemv(x, w).astype(dt)
+            out = gemv(x, w, residual=res)
+        assert out.dtype == dt
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32))
+
+    def test_bf16(self):
+        self._case()
+
+    def test_int8_scale_in_kernel(self):
+        self._case(quantized=True)
+
+    def test_validation(self):
+        from kubeflow_tpu.ops.gemv import gemv
+
+        x = jnp.zeros((2, 128), jnp.bfloat16)
+        w8 = jnp.zeros((128, 256), jnp.int8)
+        with pytest.raises(ValueError, match="per-channel scale"):
+            gemv(x, w8, residual=jnp.zeros((2, 256), jnp.bfloat16))
+        w = jnp.zeros((128, 256), jnp.bfloat16)
+        with pytest.raises(ValueError, match="residual must be"):
+            gemv(x, w, residual=jnp.zeros((2, 128), jnp.bfloat16))
+
+
+class TestQkvRopeKernel:
+    """ops/decode_qkv.py: fused qkv projection + rope, bit-identical
+    to the unfused dense chain (dot -> f32 -> dtype -> rope) in
+    interpret mode, with per-row positions and int8 weights."""
+
+    def _refs(self, x, wq, wk, wv, pos, heads, kvh, hd, dt):
+        from kubeflow_tpu.ops import apply_rope
+
+        r, k = x.shape
+
+        def one(w, nheads, rope):
+            y = jax.lax.dot_general(
+                x[:, None, :], w.astype(dt),
+                (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dt).reshape(r, 1, nheads, hd).transpose(0, 2, 1, 3)
+            if rope:
+                y = jnp.stack([
+                    apply_rope(y[i:i + 1], offset=pos[i])[0]
+                    for i in range(r)
+                ])
+            return y
+
+        return one(wq, heads, True), one(wk, kvh, True), \
+            one(wv, kvh, False)
+
+    def test_matches_unfused_chain_per_row_positions(self):
+        from kubeflow_tpu.ops.decode_qkv import qkv_rope, qkv_rope_fits
+
+        rng = np.random.default_rng(7)
+        dt = jnp.bfloat16
+        heads, kvh, hd, d = 4, 2, 32, 128
+        n = (heads + 2 * kvh) * hd
+        x = jnp.asarray(rng.normal(size=(2, d)), dt)
+        wq = jnp.asarray(rng.normal(size=(d, heads * hd)), dt)
+        wk = jnp.asarray(rng.normal(size=(d, kvh * hd)), dt)
+        wv = jnp.asarray(rng.normal(size=(d, kvh * hd)), dt)
+        pos = jnp.asarray([7, 123], jnp.int32)
+        assert qkv_rope_fits(2, d, n, hd)
+        out = qkv_rope(x, jnp.concatenate([wq, wk, wv], axis=1), pos,
+                       head_dim=hd, rope_heads=heads + kvh)
+        q = out[:, :heads * hd].reshape(2, heads, 1, hd)
+        k = out[:, heads * hd:(heads + kvh) * hd].reshape(2, kvh, 1, hd)
+        v = out[:, (heads + kvh) * hd:].reshape(2, kvh, 1, hd)
+        rq, rk, rv = self._refs(x, wq, wk, wv, pos, heads, kvh, hd, dt)
+        for got, ref in ((q, rq), (k, rk), (v, rv)):
+            np.testing.assert_array_equal(
+                np.asarray(got, np.float32), np.asarray(ref, np.float32))
+
+    def test_int8_weights_scale_before_rope(self):
+        from kubeflow_tpu.models.decoding import _quantize_linear
+        from kubeflow_tpu.ops.decode_qkv import qkv_rope
+
+        rng = np.random.default_rng(8)
+        dt = jnp.bfloat16
+        heads, kvh, hd, d = 4, 2, 32, 128
+        ws = [jnp.asarray(rng.normal(size=(d, nh * hd)), jnp.float32)
+              for nh in (heads, kvh, kvh)]
+        qs = [_quantize_linear(w, axis=0) for w in ws]
+        w8 = jnp.concatenate([q.w8 for q in qs], axis=1)
+        scale = jnp.concatenate([q.scale for q in qs])
+        x = jnp.asarray(rng.normal(size=(1, d)), dt)
+        pos = jnp.asarray([42], jnp.int32)
+        out = qkv_rope(x, w8, pos, scale, head_dim=hd,
+                       rope_heads=heads + kvh)
+        # Reference: (dot * scale).astype(dt) -> rope, per region.
+        rq, rk, rv = self._refs(
+            x,
+            (qs[0].w8.astype(jnp.float32) * qs[0].scale).astype(dt),
+            (qs[1].w8.astype(jnp.float32) * qs[1].scale).astype(dt),
+            (qs[2].w8.astype(jnp.float32) * qs[2].scale).astype(dt),
+            pos, heads, kvh, hd, dt)
+        got_q = out[:, :heads * hd].reshape(1, heads, 1, hd)
+        np.testing.assert_allclose(
+            np.asarray(got_q, np.float32), np.asarray(rq, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_fits_predicate(self):
+        from kubeflow_tpu.ops.decode_qkv import qkv_rope_fits
+
+        assert qkv_rope_fits(1, 1024, 1536, 128)     # flagship
+        assert qkv_rope_fits(2, 128, 256, 32)        # lcm(32,128)=128
+        assert not qkv_rope_fits(2, 128, 192, 32)    # 192 % 128 != 0
+        assert not qkv_rope_fits(9, 1024, 1536, 128)  # too many rows
+        assert not qkv_rope_fits(1, 100, 1536, 128)  # K misaligned
+
+    def test_block_always_divides_n(self):
+        """Regression: the VMEM-budget shrink must only pick widths
+        that DIVIDE N — a non-divisor block (n=1920, block_n=2048
+        used to yield 512) left the tail output columns unwritten."""
+        from kubeflow_tpu.ops.decode_qkv import qkv_rope, qkv_rope_block
+
+        for n, bn_req in [(1920, 2048), (1536, 512), (256, 512),
+                          (1920, 512)]:
+            bn = qkv_rope_block(128, n, 2, bn_req)
+            assert bn is not None and n % bn == 0, (n, bn_req, bn)
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(1, 128)), jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(128, 1920)), jnp.bfloat16)
+        out = qkv_rope(x, w, jnp.asarray([3], jnp.int32), head_dim=128,
+                       rope_heads=10, block_n=2048)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def test_prefused_params_match_and_quantize_strips(self):
+        """fuse_qkv_params precomputes the concat the engines reuse:
+        same tokens as the on-the-fly path, and quantize_decode_params
+        refuses to carry a stale float fused entry through."""
+        from kubeflow_tpu.models import decoding
+        from kubeflow_tpu.models.decoding import (
+            FUSED_QKV_KEY,
+            fuse_qkv_params,
+            quantize_decode_params,
+        )
+
+        cfg = LMConfig(vocab=256, layers=2, dim=128, heads=4,
+                       kv_heads=2, dtype=jnp.bfloat16)
+        _, params, tokens = _setup(cfg, seq=10, batch=1, seed=21)
+        prev = decoding.DECODE_FUSED
+        try:
+            # The precompute is gated on the fused step actually being
+            # able to run — off (the CPU default) it must be a no-op
+            # so engines never carry a dead qkv weight copy.
+            assert fuse_qkv_params(cfg, params) is params \
+                or FUSED_QKV_KEY not in fuse_qkv_params(
+                    cfg, params).get("block_0", {})
+            decoding.DECODE_FUSED = "on"
+            jax.clear_caches()
+            fused = fuse_qkv_params(cfg, params)
+            assert FUSED_QKV_KEY in fused["block_0"]
+            # Past the thin-row bound the precompute is a no-op too.
+            assert FUSED_QKV_KEY not in fuse_qkv_params(
+                cfg, params, rows=16)["block_0"]
+            ref = decoding.generate(cfg, params, tokens, 8)
+            out = decoding.generate(cfg, fused, tokens, 8)
+        finally:
+            decoding.DECODE_FUSED = prev
+            jax.clear_caches()
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        qp = quantize_decode_params(cfg, fused)
+        assert FUSED_QKV_KEY not in qp["block_0"]
+
+
+class TestDecodeKernelExtensions:
+    """PR-8 decode_attention extensions: per-row position vectors,
+    int8 KV with in-kernel dequant, and the rolling circular mode —
+    each against its dense reference."""
+
+    def _bufs(self, b=2, hkv=2, hd=128, cap=700, seed=0, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        ck = jnp.asarray(rng.normal(size=(b, hkv, cap, hd)), dtype)
+        cv = jnp.asarray(rng.normal(size=(b, hkv, cap, hd)), dtype)
+        q = jnp.asarray(rng.normal(size=(b, 4, 1, hd)), dtype)
+        return q, ck, cv
+
+    def test_per_row_positions_match_batched_dense(self):
+        from kubeflow_tpu.models.serving import _batched_pos_attention
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        cfg = LMConfig(vocab=8, layers=1, dim=512, heads=4, kv_heads=2)
+        q, ck, cv = self._bufs()
+        pos = jnp.asarray([100, 650], jnp.int32)
+        out = decode_attention(q, ck, cv, pos, block=512,
+                               interpret=True)
+        ref = _batched_pos_attention(cfg, q, ck, cv, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_int8_cache_in_kernel_dequant(self):
+        from kubeflow_tpu.models.decoding import (
+            _cached_attention,
+            _quantize_rows,
+        )
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        cfg = LMConfig(vocab=8, layers=1, dim=512, heads=4, kv_heads=2)
+        q, ck, cv = self._bufs(seed=1)
+        q = q.astype(jnp.bfloat16)
+        k8, ks = _quantize_rows(ck)
+        v8, vs = _quantize_rows(cv)
+        # Ragged tail (700 % 512 != 0) with NaN-prone scale lanes is
+        # exactly the case the in-kernel masking must survive.
+        out = decode_attention(q, k8, v8, jnp.int32(650), block=512,
+                               k_scale=ks, v_scale=vs, interpret=True)
+        ref = _cached_attention(cfg, q, k8, v8, jnp.int32(650), 1,
+                                ks, vs)
+        out = np.asarray(out, np.float32)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, np.asarray(ref, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    @pytest.mark.parametrize("pos", [5, 255, 900])
+    def test_rolling_ring_matches_dense(self, pos):
+        from kubeflow_tpu.models.decoding import _rolling_attention
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        cfg = LMConfig(vocab=8, layers=1, dim=512, heads=4, kv_heads=2,
+                       attn_window=256)
+        q, ck, cv = self._bufs(cap=256, seed=2)
+        out = decode_attention(q, ck, cv, jnp.int32(pos), window=256,
+                               block=128, rolling=True, interpret=True)
+        ref = _rolling_attention(cfg, q, ck, cv, jnp.int32(pos))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rolling_ragged_capacity(self):
+        from kubeflow_tpu.models.decoding import _rolling_attention
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        cfg = LMConfig(vocab=8, layers=1, dim=512, heads=4, kv_heads=2,
+                       attn_window=250)
+        q, ck, cv = self._bufs(cap=250, seed=3)
+        for pos in (5, 800):
+            out = decode_attention(q, ck, cv, jnp.int32(pos),
+                                   window=250, block=128, rolling=True,
+                                   interpret=True)
+            ref = _rolling_attention(cfg, q, ck, cv, jnp.int32(pos))
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_rolling_int8(self):
+        from kubeflow_tpu.models.decoding import (
+            _quantize_rows,
+            _rolling_attention,
+        )
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        cfg = LMConfig(vocab=8, layers=1, dim=512, heads=4, kv_heads=2,
+                       attn_window=256)
+        q, ck, cv = self._bufs(cap=256, seed=4)
+        q = q.astype(jnp.bfloat16)
+        k8, ks = _quantize_rows(ck)
+        v8, vs = _quantize_rows(cv)
+        out = decode_attention(q, k8, v8, jnp.int32(900), window=256,
+                               block=128, rolling=True, k_scale=ks,
+                               v_scale=vs, interpret=True)
+        ref = _rolling_attention(cfg, q, k8, v8, jnp.int32(900),
+                                 ks, vs)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_validation(self):
+        from kubeflow_tpu.ops.decode_attention import decode_attention
+
+        z = jnp.zeros((1, 2, 512, 128))
+        with pytest.raises(ValueError, match="pair"):
+            decode_attention(jnp.zeros((1, 2, 1, 128)), z, z,
+                             jnp.int32(0),
+                             k_scale=jnp.zeros((1, 2, 512, 1)),
+                             interpret=True)
+        with pytest.raises(ValueError, match="pass the window"):
+            decode_attention(jnp.zeros((1, 2, 1, 128)), z, z,
+                             jnp.int32(0), rolling=True,
+                             interpret=True)

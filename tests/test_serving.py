@@ -331,3 +331,337 @@ class TestInt8KVCache:
                 f"int8-KV request {rid} diverged from quantized "
                 f"generate()"
             )
+
+
+class TestFusedDecodeParity:
+    """PR-8 fused decode step (qkv+rope kernel, residual-epilogue
+    gemv): DECODE_FUSED="on" (interpret mode here) must be
+    BIT-IDENTICAL to "off" — same tokens AND same logits — across GQA
+    group sizes, windowed/rolling caches and the int8 KV cache. The
+    fused kernels replicate the unfused op/round order exactly; this
+    matrix is what licenses them as the default TPU path."""
+
+    # dim=128 so the kernels' 128-lane alignment is satisfiable; the
+    # (2, 1) config's qkv width (192) does NOT fit a legal block, so
+    # it exercises the silent unfused fallback inside the fused path.
+    # Tier-1 keeps the flagship-shaped (4, 2) case; the rest of the
+    # matrix is compile-heavy (every case recompiles both modes) and
+    # rides decode_gate.sh RUN_SLOW=1.
+    MATRIX = [
+        (4, 2),
+        pytest.param(4, 4, marks=pytest.mark.slow),
+        pytest.param(2, 1, marks=pytest.mark.slow),
+    ]
+
+    def _both(self, cfg, fn):
+        from kubeflow_tpu.models import decoding
+
+        prev = decoding.DECODE_FUSED
+        out = {}
+        try:
+            for mode in ("off", "on"):
+                decoding.DECODE_FUSED = mode
+                jax.clear_caches()
+                out[mode] = fn()
+        finally:
+            decoding.DECODE_FUSED = prev
+            jax.clear_caches()
+        return out["off"], out["on"]
+
+    def _cfg(self, heads, kv, window=None):
+        return LMConfig(vocab=256, layers=2, dim=128, heads=heads,
+                        kv_heads=kv, dtype=jnp.bfloat16,
+                        attn_window=window)
+
+    @pytest.mark.parametrize("heads,kv", MATRIX)
+    def test_generate_bit_identical(self, heads, kv):
+        cfg = self._cfg(heads, kv)
+        params, rng = _setup(cfg, seed=40 + heads + kv)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, cfg.vocab, 9)]],
+            jnp.int32)
+
+        def run():
+            from kubeflow_tpu.models.decoding import (
+                KVCache,
+                forward_with_cache,
+            )
+
+            toks = generate(cfg, params, prompt, 8)
+            cache = KVCache.init(cfg, 1, 32)
+            logits, cache = forward_with_cache(cfg, params, prompt,
+                                               cache)
+            # One explicit single-token step so the fused path is hit
+            # OUTSIDE the jitted scan too.
+            step_logits, _ = forward_with_cache(
+                cfg, params, toks[:, :1], cache)
+            return toks, logits, step_logits
+
+        (t0, l0, s0), (t1, l1, s1) = self._both(cfg, run)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+        np.testing.assert_array_equal(
+            np.asarray(l0, np.float32), np.asarray(l1, np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(s0, np.float32), np.asarray(s1, np.float32))
+
+    def test_rolling_cache_bit_identical(self):
+        cfg = self._cfg(4, 2, window=8)
+        params, rng = _setup(cfg, seed=50)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, cfg.vocab, 12)]],
+            jnp.int32)
+        run = lambda: generate(cfg, params, prompt, 16)
+        t0, t1 = self._both(cfg, run)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+
+    def test_int8_cache_bit_identical(self):
+        cfg = self._cfg(4, 2)
+        params, rng = _setup(cfg, seed=51)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, cfg.vocab, 7)]],
+            jnp.int32)
+        run = lambda: generate(cfg, params, prompt, 10,
+                               quantize_cache=True)
+        t0, t1 = self._both(cfg, run)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+
+    @pytest.mark.slow  # recompiles both modes; decode gate runs it
+    def test_int8_weights_bit_identical(self):
+        cfg = self._cfg(4, 2)
+        params, rng = _setup(cfg, seed=52)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, cfg.vocab, 7)]],
+            jnp.int32)
+        run = lambda: generate(cfg, params, prompt, 10,
+                               quantize_weights=True)
+        t0, t1 = self._both(cfg, run)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+
+    @pytest.mark.slow  # compiles a whole batcher; decode gate runs it
+    def test_batcher_fused_matches_generate_unfused(self):
+        """Cross-path identity: the continuous batcher with the fused
+        step on equals single-request generate with it off — the
+        serving decode_step and the single-stream path share the
+        fused kernels without drifting."""
+        from kubeflow_tpu.models import decoding
+
+        cfg = self._cfg(4, 2)
+        params, rng = _setup(cfg, seed=53)
+        reqs = [
+            ([int(t) for t in rng.integers(0, cfg.vocab, plen)], budget)
+            for plen, budget in [(5, 8), (11, 3), (7, 6)]
+        ]
+        refs = [
+            [int(t) for t in np.asarray(generate(
+                cfg, params, jnp.asarray([p], jnp.int32), b)[0])]
+            for p, b in reqs
+        ]
+        prev = decoding.DECODE_FUSED
+        try:
+            decoding.DECODE_FUSED = "on"
+            jax.clear_caches()
+            batcher = ContinuousBatcher(cfg, params, max_batch=2,
+                                        max_len=64, step_chunk=3)
+            rids = [batcher.submit(p, max_new_tokens=b)
+                    for p, b in reqs]
+            results = batcher.run()
+        finally:
+            decoding.DECODE_FUSED = prev
+            jax.clear_caches()
+        for rid, ref in zip(rids, refs):
+            assert results[rid] == ref
+
+
+class TestVerifyStep:
+    """models.serving.verify_step — the speculative serving step:
+    cand[b, i] must equal what a chain of single-token decode_steps
+    would sample when force-fed the same draft tokens."""
+
+    def _state_with_slots(self, params, rng, temps=(0.0, 0.0),
+                          quantized=False):
+        from kubeflow_tpu.models.serving import prefill_slot
+
+        state = BatchState.init(CFG, len(temps), 64,
+                                quantized=quantized)
+        keys = []
+        for slot, temp in enumerate(temps):
+            plen = int(rng.integers(3, 10))
+            prompt = jnp.asarray(
+                [[int(t) for t in rng.integers(0, CFG.vocab, plen)]],
+                jnp.int32)
+            key = jax.random.key(100 + slot)
+            state, _ = prefill_slot(
+                CFG, params, state, jnp.int32(slot), prompt,
+                jnp.float32(temp), key)
+            keys.append(key)
+        return state, keys
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_matches_forced_decode_chain(self, quantized):
+        import dataclasses
+
+        from kubeflow_tpu.models.serving import decode_step, verify_step
+
+        params, _ = _setup(seed=60)
+        rng = np.random.default_rng(61)
+        state, _ = self._state_with_slots(params, rng,
+                                          quantized=quantized)
+        t = 4
+        drafts = jnp.asarray(
+            rng.integers(0, CFG.vocab, size=(2, t - 1)), jnp.int32)
+        tokens = jnp.concatenate([state.last[:, None], drafts], axis=1)
+
+        _, cand = verify_step(CFG, params, state, tokens)
+        cand = np.asarray(cand)
+
+        # Reference: force-feed the same tokens one step at a time.
+        chain = state
+        expected = []
+        for i in range(t):
+            chain = dataclasses.replace(chain, last=tokens[:, i])
+            chain, nxt = decode_step(CFG, params, chain)
+            expected.append(np.asarray(nxt))
+        expected = np.stack(expected, axis=1)  # (B, t)
+        np.testing.assert_array_equal(cand, expected)
+
+    def test_sampled_slots_use_per_position_keys(self):
+        import dataclasses
+
+        from kubeflow_tpu.models.serving import decode_step, verify_step
+
+        params, _ = _setup(seed=62)
+        rng = np.random.default_rng(63)
+        state, _ = self._state_with_slots(params, rng,
+                                          temps=(0.9, 0.0))
+        t = 3
+        step_keys = jax.random.split(jax.random.key(7), t)
+        keys = jnp.stack([step_keys,
+                          jnp.broadcast_to(jax.random.key(0), (t,))])
+        drafts = jnp.asarray(
+            rng.integers(0, CFG.vocab, size=(2, t - 1)), jnp.int32)
+        tokens = jnp.concatenate([state.last[:, None], drafts], axis=1)
+        _, cand = verify_step(CFG, params, state, tokens, keys)
+        cand = np.asarray(cand)
+        chain = state
+        expected = []
+        for i in range(t):
+            chain = dataclasses.replace(chain, last=tokens[:, i])
+            chain, nxt = decode_step(CFG, params, chain,
+                                     keys=keys[:, i])
+            expected.append(np.asarray(nxt))
+        np.testing.assert_array_equal(cand,
+                                      np.stack(expected, axis=1))
+
+    def test_commit_advances_only_touched_slots(self):
+        from kubeflow_tpu.models.serving import commit_verify
+
+        params, _ = _setup(seed=64)
+        rng = np.random.default_rng(65)
+        state, _ = self._state_with_slots(params, rng)
+        pos_before = np.asarray(state.pos)
+        last_before = np.asarray(state.last)
+        state2 = commit_verify(state, jnp.asarray([3, 0], jnp.int32),
+                               jnp.asarray([42, 99], jnp.int32))
+        assert np.asarray(state2.pos).tolist() == \
+            [pos_before[0] + 3, pos_before[1]]
+        assert int(np.asarray(state2.last)[0]) == 42
+        assert int(np.asarray(state2.last)[1]) == last_before[1]
+
+
+class TestSpeculativeEngine:
+    """StreamingBatcher spec mode (KFT_SERVING_SPEC_NGRAM): the
+    verify/accept cycle must be token-identical to the plain lockstep
+    engine and to generate — greedy and seeded sampling, mixed in one
+    batch, through eos and budget edges."""
+
+    def _engine(self, params, **kw):
+        from kubeflow_tpu.serving.engine import StreamingBatcher
+
+        kw.setdefault("spec_ngram", True)
+        kw.setdefault("spec_draft", 4)
+        kw.setdefault("spec_ngram_n", 2)
+        return StreamingBatcher(CFG, params, max_batch=2, max_len=96,
+                                **kw)
+
+    def test_mixed_slots_match_generate(self):
+        params, rng = _setup(seed=70)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 5)]
+        reqs = [
+            (base * 3, 12, 0.0, None),
+            ([int(t) for t in rng.integers(0, CFG.vocab, 9)], 8,
+             0.9, 77),
+            (base * 2, 10, 0.0, None),
+        ]
+        engine = self._engine(params)
+        outs: dict[int, list[int]] = {}
+
+        def sink_for(i):
+            outs[i] = []
+            return lambda e: outs[i].append(e["token"]) \
+                if "token" in e else None
+
+        for i, (p, n, temp, seed) in enumerate(reqs):
+            engine.submit_stream(
+                p, sink=sink_for(i), max_new_tokens=n,
+                temperature=temp,
+                rng=jax.random.key(seed) if seed is not None else None)
+        engine.drain()
+        for i, (p, n, temp, seed) in enumerate(reqs):
+            ref = generate(
+                CFG, params, jnp.asarray([p], jnp.int32), n,
+                temperature=temp,
+                rng=jax.random.key(seed) if seed is not None else None)
+            assert outs[i] == [int(t) for t in np.asarray(ref[0])], (
+                f"spec request {i} diverged from generate()"
+            )
+        # Repetitive prompts must retire more than one token per
+        # verify on average, or speculation is not doing anything.
+        emitted = sum(len(v) for v in outs.values())
+        assert engine.spec_verifies_total < emitted
+        assert engine.spec_accepted_total > 0
+
+    def test_eos_mid_draft_cuts_exactly(self):
+        params, rng = _setup(seed=71)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 5)]
+        ref = [int(t) for t in np.asarray(generate(
+            CFG, params, jnp.asarray([base * 3], jnp.int32), 16)[0])]
+        eos = ref[3]
+        cut = ref[:ref.index(eos) + 1]
+        engine = self._engine(params, eos_token=eos)
+        out: list[int] = []
+        done: list[dict] = []
+
+        def sink(event):
+            if "token" in event:
+                out.append(event["token"])
+            if event.get("done"):
+                done.append(event)
+        engine.submit_stream(base * 3, sink=sink, max_new_tokens=16)
+        engine.drain()
+        assert out == cut
+        assert done[0]["reason"] == "eos"
+
+    def test_capacity_reserves_draft_slack(self):
+        params, _ = _setup(seed=72)
+        engine = self._engine(params)
+        # capacity 96 -> 256 (DECODE_BLOCK rounding); slack is
+        # max(step_chunk=8, spec_draft=4) = 8.
+        with pytest.raises(ValueError, match="write slack"):
+            engine.submit_stream(list(range(1, 200)), sink=lambda e: 0,
+                                 max_new_tokens=100)
+
+    def test_rolling_model_refused_and_make_engine_degrades(self):
+        from kubeflow_tpu.serving.engine import (
+            StreamingBatcher,
+            make_engine,
+        )
+
+        cfg_w = LMConfig(vocab=128, layers=2, dim=64, heads=4,
+                         kv_heads=2, dtype=jnp.bfloat16, attn_window=8)
+        params, _ = _setup(cfg_w, seed=73)
+        with pytest.raises(ValueError, match="linear slots"):
+            StreamingBatcher(cfg_w, params, max_batch=1, max_len=64,
+                             spec_ngram=True)
+        engine = make_engine(cfg_w, params, max_batch=1, max_len=64,
+                             spec_ngram=True)
+        assert engine.spec_ngram is False  # degraded, still serving

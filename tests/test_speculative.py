@@ -1,0 +1,230 @@
+"""Self-speculative n-gram decoding (models/speculative.py).
+
+The binding contract: speculative output is TOKEN-IDENTICAL to plain
+``generate`` — greedy and seeded sampling — on every input; the draft
+source only changes how many tokens each verify retires.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import LMConfig, build_lm, create_lm_state, generate
+from kubeflow_tpu.models.speculative import (
+    NGramProposer,
+    ngram_propose,
+    speculative_generate,
+)
+
+CFG = LMConfig(vocab=128, layers=2, dim=64, heads=4, kv_heads=2,
+               dtype=jnp.bfloat16)
+
+
+def _setup(cfg=CFG, seed=0):
+    model = build_lm(cfg, use_flash=False)
+    state = create_lm_state(model, jax.random.key(0), (1, 16))
+    return state.params, np.random.default_rng(seed)
+
+
+def _tokens(x):
+    return [int(t) for t in np.asarray(x[0])]
+
+
+class TestNGramPropose:
+    """Device-side draft: vectorised search over the token buffer."""
+
+    def test_finds_most_recent_occurrence(self):
+        buf = jnp.asarray([1, 2, 3, 9, 1, 2, 3, 7, 8, 1, 2, 3, 0, 0],
+                          jnp.int32)
+        draft, found = ngram_propose(buf, jnp.int32(12), n=3, k=2)
+        assert bool(found)
+        # Context (1,2,3) last occurred ending at index 6 -> draft 7,8.
+        assert [int(t) for t in draft] == [7, 8]
+
+    def test_no_match_falls_back_to_last_token(self):
+        buf = jnp.asarray([5, 6, 7, 8, 0, 0], jnp.int32)
+        draft, found = ngram_propose(buf, jnp.int32(4), n=2, k=3)
+        assert not bool(found)
+        assert [int(t) for t in draft] == [8, 8, 8]
+
+    def test_does_not_match_itself(self):
+        # The context's own occurrence (ending at count-1) must not
+        # count — there is nothing after it to draft.
+        buf = jnp.asarray([4, 5, 6, 0, 0], jnp.int32)
+        draft, found = ngram_propose(buf, jnp.int32(3), n=2, k=2)
+        assert not bool(found)
+
+    def test_stale_buffer_tail_is_ignored(self):
+        # Entries past `count` are rejected-draft garbage; a match
+        # there must not be taken.
+        buf = jnp.asarray([1, 2, 9, 9, 1, 2, 3, 3], jnp.int32)
+        draft, found = ngram_propose(buf, jnp.int32(4), n=2, k=1)
+        assert not bool(found)
+
+
+class TestHostProposer:
+    def test_backoff_prefers_longest_context(self):
+        p = NGramProposer(n=3, k=4)
+        assert p.propose([1, 2, 3, 9, 1, 2, 3, 7, 7, 1, 2, 3]) == \
+            [7, 7, 1, 2]
+
+    def test_backoff_to_shorter_ngram(self):
+        # No 3-gram repeat, but the trailing 1-gram (3) recurs.
+        p = NGramProposer(n=3, k=2)
+        assert p.propose([3, 8, 9, 3]) == [8, 9]
+
+    def test_exactly_k_with_padding(self):
+        p = NGramProposer(n=2, k=5)
+        out = p.propose([1, 2, 7, 1, 2])
+        assert len(out) == 5
+        assert out[0] == 7
+
+    def test_no_context(self):
+        p = NGramProposer(n=3, k=3)
+        assert p.propose([4]) == [4, 4, 4]
+        with pytest.raises(ValueError, match=">= 1"):
+            NGramProposer(n=0)
+
+
+class TestSpeculativeGenerate:
+    def test_greedy_identical_on_repetitive_prompt(self):
+        params, rng = _setup()
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 6)]
+        prompt = jnp.asarray([base * 3], jnp.int32)
+        ref = generate(CFG, params, prompt, 20)
+        out, stats = speculative_generate(CFG, params, prompt, 20,
+                                          return_stats=True)
+        assert _tokens(out) == _tokens(ref)
+        # Repetition must actually pay: fewer verifies than tokens.
+        assert stats.verify_calls < 20
+        assert stats.accepted > 0
+        assert stats.tokens == 20
+
+    def test_greedy_identical_on_random_prompt(self):
+        params, rng = _setup(seed=1)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, CFG.vocab, 11)]],
+            jnp.int32)
+        ref = generate(CFG, params, prompt, 9)
+        out = speculative_generate(CFG, params, prompt, 9)
+        assert _tokens(out) == _tokens(ref)
+
+    # Each draft shape is a fresh while_loop compile; tier-1 keeps
+    # the default-shaped case, decode_gate RUN_SLOW=1 runs the rest.
+    @pytest.mark.parametrize("draft,ngram", [
+        pytest.param(1, 1, marks=pytest.mark.slow),
+        pytest.param(4, 2, marks=pytest.mark.slow),
+        (8, 3),
+    ])
+    def test_draft_shape_never_changes_output(self, draft, ngram):
+        params, rng = _setup(seed=2)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 4)]
+        prompt = jnp.asarray([base * 4], jnp.int32)
+        ref = _tokens(generate(CFG, params, prompt, 13))
+        out = speculative_generate(CFG, params, prompt, 13,
+                                   draft=draft, ngram=ngram)
+        assert _tokens(out) == ref
+
+    def test_seeded_sampling_identical(self):
+        params, rng = _setup(seed=3)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 5)]
+        prompt = jnp.asarray([base * 3], jnp.int32)
+        key = jax.random.key(42)
+        ref = generate(CFG, params, prompt, 16, temperature=0.8,
+                       rng=key)
+        out = speculative_generate(CFG, params, prompt, 16,
+                                   temperature=0.8,
+                                   rng=jax.random.key(42))
+        assert _tokens(out) == _tokens(ref)
+
+    def test_single_token_budget(self):
+        params, rng = _setup(seed=4)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, CFG.vocab, 7)]],
+            jnp.int32)
+        ref = generate(CFG, params, prompt, 1)
+        out = speculative_generate(CFG, params, prompt, 1)
+        assert _tokens(out) == _tokens(ref)
+
+    @pytest.mark.slow  # extra end-to-end compiles; decode gate runs it
+    def test_jitted_caller_identical(self):
+        """The bench shape: the whole call under jax.jit (prefill +
+        while_loop in one program) — including return_stats, whose
+        array-valued SpecStats must not concretise traced carries."""
+        params, rng = _setup(seed=5)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 5)]
+        prompt = jnp.asarray([base * 2], jnp.int32)
+        spec = jax.jit(lambda p, t: speculative_generate(
+            CFG, p, t, 10, draft=4, ngram=2, return_stats=True))
+        ref = jax.jit(lambda p, t: generate(CFG, p, t, 10))
+        out, stats = spec(params, prompt)
+        assert _tokens(out) == _tokens(ref(params, prompt))
+        assert int(stats.verify_calls) >= 1
+        assert 0.0 <= stats.accept_rate <= 1.0
+
+    @pytest.mark.slow  # extra end-to-end compiles; decode gate runs it
+    def test_int8_weights_compose(self):
+        from kubeflow_tpu.models.decoding import quantize_decode_params
+
+        params, rng = _setup(seed=6)
+        qp = quantize_decode_params(CFG, params)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 4)]
+        prompt = jnp.asarray([base * 3], jnp.int32)
+        ref = generate(CFG, qp, prompt, 10)
+        out = speculative_generate(CFG, params, prompt, 10,
+                                   quantize_weights=True)
+        assert _tokens(out) == _tokens(ref)
+
+    @pytest.mark.slow  # extra end-to-end compiles; decode gate runs it
+    def test_int8_cache_composes(self):
+        params, rng = _setup(seed=7)
+        base = [int(t) for t in rng.integers(0, CFG.vocab, 4)]
+        prompt = jnp.asarray([base * 3], jnp.int32)
+        # Jitted reference: the int8 contract sides with the jitted
+        # path (see TestInt8KVCache in test_serving.py); jit the spec
+        # call the same way so both sides round identically.
+        gen_q = jax.jit(lambda p, t: generate(CFG, p, t, 10,
+                                              quantize_cache=True))
+        spec_q = jax.jit(lambda p, t: speculative_generate(
+            CFG, p, t, 10, quantize_cache=True))
+        assert _tokens(spec_q(params, prompt)) == \
+            _tokens(gen_q(params, prompt))
+
+    def test_validation(self):
+        params, rng = _setup(seed=8)
+        prompt = jnp.asarray(
+            [[int(t) for t in rng.integers(0, CFG.vocab, 6)]],
+            jnp.int32)
+        with pytest.raises(ValueError, match="per-sequence"):
+            speculative_generate(
+                CFG, params, jnp.tile(prompt, (2, 1)), 4)
+        with pytest.raises(ValueError, match="categorical"):
+            speculative_generate(CFG, params, prompt, 4,
+                                 temperature=0.5)
+        with pytest.raises(ValueError, match=">= 1"):
+            speculative_generate(CFG, params, prompt, 0)
+        with pytest.raises(ValueError, match="draft and ngram"):
+            speculative_generate(CFG, params, prompt, 4, draft=0)
+        cfg_w = LMConfig(vocab=128, layers=2, dim=64, heads=4,
+                         kv_heads=2, dtype=jnp.bfloat16, attn_window=8)
+        with pytest.raises(ValueError, match="linear KV cache"):
+            speculative_generate(cfg_w, params, prompt, 32)
+
+    def test_windowed_model_with_ample_window_ok(self):
+        """A windowed model whose window covers prompt+new keeps a
+        linear cache, so speculation composes."""
+        cfg_w = LMConfig(vocab=128, layers=2, dim=64, heads=4,
+                         kv_heads=2, dtype=jnp.bfloat16,
+                         attn_window=64)
+        model = build_lm(cfg_w, use_flash=False)
+        params = create_lm_state(model, jax.random.key(0),
+                                 (1, 16)).params
+        rng = np.random.default_rng(9)
+        base = [int(t) for t in rng.integers(0, cfg_w.vocab, 4)]
+        prompt = jnp.asarray([base * 3], jnp.int32)
+        ref = generate(cfg_w, params, prompt, 8)
+        out = speculative_generate(cfg_w, params, prompt, 8)
+        assert _tokens(out) == _tokens(ref)
