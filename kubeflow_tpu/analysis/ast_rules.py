@@ -50,6 +50,19 @@
   explicitly temp-named path (``tmp``/``.part``) is the first half of
   that idiom and is not flagged; deliberate exceptions escape with
   ``# analysis: allow[py-nonatomic-write]``.
+- ``py-unbounded-metric-labels`` (warning): a ``.labels(...)`` call
+  whose label *value* is derived from request/user data — an
+  expression mentioning pods, prompts, exceptions, users or other
+  per-object identity (``pod``/``prompt``/``exc``/``user``/``uid``…),
+  or any f-string (dynamic formatting is per-request by construction).
+  Every distinct label value is a new time series held forever by the
+  registry AND the scraper: labelling by pod name, prompt content or
+  ``str(exc)`` is the classic self-inflicted observability outage
+  (cardinality explosion). Label values must come from small
+  enumerated sets; per-object identity belongs in exemplars, spans or
+  structured logs. Literal string arguments are never flagged;
+  deliberate bounded cases escape with
+  ``# analysis: allow[py-unbounded-metric-labels]``.
 """
 
 from __future__ import annotations
@@ -395,6 +408,65 @@ def _check_nonatomic_writes(
         ))
 
 
+# --- py-unbounded-metric-labels --------------------------------------------
+# Identifier/string fragments that mark a label-value expression as
+# per-request / per-object identity rather than an enumerated dimension.
+# Deliberately narrow: namespace/name object identity and enumerated
+# outcome/verb/phase variables are the platform's sanctioned label
+# vocabulary and must not fire.
+_UNBOUNDED_LABEL_TOKENS = (
+    "pod", "prompt", "exc", "exception", "traceback", "message",
+    "user", "uuid", "uid", "token_text", "stack",
+)
+
+
+def _unbounded_label_reason(arg: ast.AST) -> str | None:
+    """Why this ``.labels()`` argument looks request-derived, or None.
+    Literals are bounded by definition and never flagged."""
+    if isinstance(arg, ast.Constant):
+        return None
+    if isinstance(arg, ast.JoinedStr):
+        if any(isinstance(v, ast.FormattedValue) for v in arg.values):
+            return "an f-string label value (per-request by construction)"
+        return None
+    text = _expr_text(arg)
+    for token in _UNBOUNDED_LABEL_TOKENS:
+        # Token match on whole identifier fragments, not raw substring:
+        # "exc" must hit `exc` / `exc_info` / `str(exc)` soup but not
+        # an unrelated word containing it.
+        if any(
+            token == frag or frag.startswith(token + "_")
+            or frag.endswith("_" + token)
+            for frag in text.replace("-", "_").split()
+        ):
+            return f"mentions {token!r}"
+    return None
+
+
+def _check_metric_labels(call: ast.Call, path: str,
+                         out: list[Finding]) -> None:
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "labels"):
+        return
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    for arg in args:
+        reason = _unbounded_label_reason(arg)
+        if reason is None:
+            continue
+        out.append(Finding(
+            "py-unbounded-metric-labels", Severity.WARNING, path,
+            call.lineno,
+            f"metric label value looks request/user-derived ({reason}): "
+            "every distinct value is a new time series held forever by "
+            "the registry and the scraper — a cardinality explosion is "
+            "the classic self-inflicted observability outage. Label "
+            "with a small enumerated set; put per-object identity in "
+            "exemplars, spans or structured logs (or annotate a "
+            "provably bounded value with "
+            "# analysis: allow[py-unbounded-metric-labels])",
+        ))
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -495,6 +567,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
             _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):
             target = _dotted(node.func, aliases)
+            _check_metric_labels(node, path, out)
             if (
                 not print_exempt
                 and isinstance(node.func, ast.Name)

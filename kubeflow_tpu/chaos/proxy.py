@@ -124,6 +124,13 @@ class ChaosApiServer:
             "watch_reordered": 0, "watch_compacted": 0,
         }
         self.ops_total = 0
+        # Availability as the controllers experience it through this
+        # proxy: one event per gated op, bad when the injected fault is
+        # a 5xx/429/blackout (conflicts, 404 flaps and latency are the
+        # apiserver *working*). Same (good, total) shape as the real
+        # client's availability_counts(), so the apiserver SLO can sit
+        # on either side of the chaos boundary.
+        self._avail_bad = 0
 
     # ---- fault gate ------------------------------------------------------
     def _traced(self, verb: str, kind: str):
@@ -145,7 +152,18 @@ class ChaosApiServer:
         if fault is None:
             return
         self.injected[fault.kind] = self.injected.get(fault.kind, 0) + 1
+        if fault.kind == sched.BLACKOUT or (
+            fault.kind == sched.ERROR
+            and (fault.status >= 500 or fault.status == 429)
+        ):
+            self._avail_bad += 1
         self._raise(fault, verb, kind, op, span)
+
+    def availability_counts(self) -> tuple[int, int]:
+        """Cumulative ``(good, total)`` ops through the fault gate —
+        the apiserver-availability SLO source shape."""
+        total = self.ops_total
+        return total - self._avail_bad, total
 
     def _raise(self, fault: Fault, verb: str, kind: str, op: int,
                span=None) -> None:

@@ -34,6 +34,31 @@ def _env_bool(name: str, default: bool = False) -> bool:
     return val.lower() in ("1", "true", "yes")
 
 
+def make_default_slo_engine(prom: ControllerMetrics, api=None,
+                            clock=None):
+    """The control-plane SLO set every manager ships with
+    (obs.slo defaults; KFT_SLO_* env tunes targets/thresholds):
+    reconcile duration, workqueue queue-wait, and — when the api handle
+    counts availability (real ApiClient, chaos proxy) — apiserver
+    availability."""
+    from kubeflow_tpu import obs
+    from kubeflow_tpu.obs import slo as obs_slo
+
+    kwargs = {"clock": clock} if clock is not None else {}
+    evaluator = obs_slo.BurnRateEvaluator(**kwargs)
+    engine = obs.SloEngine(evaluator=evaluator)
+    engine.register(obs_slo.reconcile_duration_objective(prom))
+    engine.register(obs_slo.queue_wait_objective(prom))
+    if api is not None and hasattr(api, "availability_counts"):
+        engine.register(obs_slo.apiserver_availability_objective(api))
+    return engine
+
+
+# Distinguishes "caller said nothing" (build the default engine) from
+# an explicit slo=None (disable the SLO layer entirely).
+_DEFAULT_SLO = object()
+
+
 def options_from_env() -> tuple[NotebookOptions, CullingOptions]:
     """Env parity with the reference kustomize params.env contract
     (reference notebook-controller/config/manager/params.env:5-7 and
@@ -72,6 +97,7 @@ class Manager:
         identity: str | None = None,
         lease_namespace: str = "kubeflow",
         clock=None,
+        slo=_DEFAULT_SLO,
     ):
         self.api = api
         self.controllers = controllers
@@ -79,6 +105,23 @@ class Manager:
         self._threads: list = []
         self._running = False
         self.server = None
+        # The judging layer over the manager's own telemetry (PR 9):
+        # default burn-rate SLOs registered over the registry's
+        # reconcile/queue histograms and — when the api handle counts
+        # availability (real ApiClient, chaos proxy) — the apiserver
+        # availability objective. Injectable for deterministic tests;
+        # an explicit None disables the layer.
+        if slo is _DEFAULT_SLO:
+            slo = (make_default_slo_engine(prom, api)
+                   if prom is not None else None)
+        self.slo = slo
+        if self.slo is not None:
+            for ctrl in controllers:
+                hooks = getattr(ctrl, "tick_hooks", None)
+                if hooks is not None:
+                    # Self-rate-limited: tens of loop ticks per second
+                    # collapse to one sample per min_interval_s.
+                    hooks.append(self.slo.tick)
         if prom is not None and http_port is not None:
             prom.watch_controllers(controllers)
             from kubeflow_tpu import obs
@@ -93,6 +136,8 @@ class Manager:
                 # listener.
                 enable_debug=_env_bool("KFT_ENABLE_DEBUG_ENDPOINTS"),
                 tracer=obs.get_tracer(),
+                slo=self.slo,
+                fleet_api=api,
             )
         self.elector = None
         if leader_elect:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import http.server
 import logging
 import threading
+import time
 from typing import Callable, Iterable
 
 from prometheus_client import (
@@ -29,14 +30,35 @@ from prometheus_client import (
 )
 from prometheus_client.core import (
     CounterMetricFamily,
+    Exemplar,
     GaugeMetricFamily,
     HistogramMetricFamily,
 )
+from prometheus_client.openmetrics import exposition as om_exposition
 
 from kubeflow_tpu.k8s.fake import FakeApiServer
 from kubeflow_tpu.obs import export as obs_export
 
 log = logging.getLogger(__name__)
+
+
+def bucket_tuples_with_exemplars(snap: dict) -> list:
+    """BucketHistogram snapshot -> ``add_metric`` bucket tuples, with
+    each captured exemplar attached as the OpenMetrics triple
+    ``(le, count, Exemplar)``. The classic text exposition ignores the
+    third element; the OpenMetrics renderer emits it as
+    ``# {trace_id="..."} value timestamp`` on the bucket line."""
+    exemplars = snap.get("exemplars") or {}
+    out = []
+    for le, count in snap["buckets"]:
+        ex = exemplars.get(le)
+        if ex:
+            out.append((le, count, Exemplar(
+                {"trace_id": ex["trace_id"]}, ex["value"], ex["ts"]
+            )))
+        else:
+            out.append((le, count))
+    return out
 
 
 class RunningNotebooksCollector:
@@ -171,7 +193,7 @@ class ClientResilienceCollector:
             for verb, snap in sorted(snapshot().items()):
                 fam.add_metric(
                     [verb],
-                    buckets=[(le, count) for le, count in snap["buckets"]],
+                    buckets=bucket_tuples_with_exemplars(snap),
                     sum_value=snap["sum"],
                 )
             yield fam
@@ -303,7 +325,12 @@ class ControllerMetrics:
     def watch_controllers(self, controllers: Iterable) -> None:
         self.registry.register(QueueDepthCollector(controllers))
 
-    def exposition(self) -> bytes:
+    def exposition(self, openmetrics: bool = False) -> bytes:
+        # OpenMetrics is the format that carries exemplars (bucket ->
+        # trace-id links); the classic 0.0.4 text stays the default so
+        # existing scrapers see byte-compatible output.
+        if openmetrics:
+            return om_exposition.generate_latest(self.registry)
         return generate_latest(self.registry)
 
 
@@ -319,6 +346,8 @@ class ManagerServer:
         ready: Callable[[], bool] | None = None,
         enable_debug: bool = False,
         tracer=None,
+        slo=None,
+        fleet_api=None,
     ):
         self.metrics = metrics
         self.ready = ready or (lambda: True)
@@ -329,6 +358,13 @@ class ManagerServer:
         # sit behind the same gate and read the tracer's in-memory ring.
         self.enable_debug = enable_debug
         self.tracer = tracer
+        # SLO surfaces (PR 9): ``slo`` is an obs.SloEngine; ``fleet_api``
+        # any duck-typed api handle the fleet rollup can LIST through.
+        # /fleet is a health surface like /readyz (NOT debug-gated);
+        # /debug/alerts carries full alert history and sits behind the
+        # debug gate with the other operator-forensics endpoints.
+        self.slo = slo
+        self.fleet_api = fleet_api
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -339,11 +375,49 @@ class ManagerServer:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    body = outer.metrics.exposition()
+                    # A scrape is also a cheap liveness tick for the
+                    # SLO engine (self-rate-limited), so alerts advance
+                    # even when no controller loop is running.
+                    if outer.slo is not None:
+                        outer.slo.tick()
+                    accept = self.headers.get("Accept", "")
+                    openmetrics = "application/openmetrics-text" in accept
+                    body = outer.metrics.exposition(
+                        openmetrics=openmetrics
+                    )
                     self.send_response(200)
                     self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4"
+                        "Content-Type",
+                        om_exposition.CONTENT_TYPE_LATEST if openmetrics
+                        else "text/plain; version=0.0.4",
                     )
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/fleet" and (
+                    outer.fleet_api is not None or outer.slo is not None
+                ):
+                    import json
+
+                    body = json.dumps(
+                        outer.fleet_doc(), indent=1, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (
+                    self.path == "/debug/alerts"
+                    and outer.enable_debug
+                    and outer.slo is not None
+                ):
+                    import json
+
+                    outer.slo.tick()
+                    body = json.dumps(
+                        outer.slo.alerts.to_dict(), indent=1, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/healthz":
@@ -445,6 +519,28 @@ class ManagerServer:
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+
+    def fleet_doc(self) -> dict:
+        """The ``/fleet`` document: per-namespace health cards over the
+        live CRs, overlaid with the SLO engine's alert state. Also
+        callable directly (tests, other surfaces)."""
+        from kubeflow_tpu.obs import fleet as obs_fleet
+
+        alerts = None
+        if self.slo is not None:
+            self.slo.tick()
+            alerts = self.slo.alerts
+        if self.fleet_api is not None:
+            doc = obs_fleet.fleet_cards(self.fleet_api, alerts=alerts)
+        else:
+            # Same schema as fleet_cards, just with nothing to list —
+            # consumers must not need to know which branch served them.
+            doc = {"namespaces": {},
+                   "alerts": alerts.active() if alerts else [],
+                   "generated_at": time.time()}
+        if self.slo is not None:
+            doc["slo"] = self.slo.status()
+        return doc
 
     def start(self) -> None:
         self._thread = threading.Thread(

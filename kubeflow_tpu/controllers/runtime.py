@@ -483,7 +483,18 @@ class Controller:
     def _observe_duration(self, elapsed: float) -> None:
         if self.prom is not None and hasattr(self.prom,
                                              "reconcile_duration"):
-            self.prom.reconcile_duration.labels(self.name).observe(elapsed)
+            # The reconcile span is active here (we are inside the
+            # tracer.span block); stamping its trace id as an
+            # OpenMetrics exemplar links a p99 bucket on /metrics to
+            # the exact trace that produced it. Only sampled spans —
+            # an unsampled id resolves to no exporter.
+            span = obs.current_span()
+            exemplar = None
+            if span is not None and span.context.sampled:
+                exemplar = {"trace_id": span.context.trace_id}
+            self.prom.reconcile_duration.labels(self.name).observe(
+                elapsed, exemplar=exemplar
+            )
 
     # ---- stuck-reconcile watchdog ---------------------------------------
     def _primary_object(self, req: Request) -> dict | None:

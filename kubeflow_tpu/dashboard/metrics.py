@@ -380,7 +380,15 @@ class TpuFleetCollector:
     ``accelerator`` — the canonical schema every platform registry
     shares (obs.metrics.CANONICAL_LABELS); the dashboard previously
     exposed nothing scrape-able here, so BENCH dashboards had to parse
-    the JSON API with ad-hoc names."""
+    the JSON API with ad-hoc names.
+
+    Per-namespace workload cards (PR 9, the ROADMAP item-1/item-5
+    dashboard remainders): notebook and InferenceService phase counts
+    plus the namespace's worst ``train_goodput_ratio`` (published onto
+    the owning CR by the training side's GoodputAnnotationPublisher),
+    all folded by :func:`kubeflow_tpu.obs.fleet.fleet_cards` — the SAME
+    computation the manager's ``/fleet`` endpoint serves, so the
+    scrape-able view and the JSON view cannot drift."""
 
     def __init__(self, api):
         self.api = api
@@ -402,26 +410,69 @@ class TpuFleetCollector:
             log.warning("tpu fleet scrape: list failed (%s); serving "
                         "last-known values", exc)
             fleet = self._last_good
-        if fleet is None:
-            return
-        families = {
-            "allocatable": GaugeMetricFamily(
-                "tpu_fleet_chips_allocatable",
-                "TPU chips allocatable on Ready nodes",
-                labels=["accelerator"],
-            ),
-            "requested": GaugeMetricFamily(
-                "tpu_fleet_chips_requested",
-                "TPU chips requested by non-terminal pods",
-                labels=["accelerator"],
-            ),
-            "nodes": GaugeMetricFamily(
-                "tpu_fleet_nodes",
-                "Ready nodes carrying TPU chips",
-                labels=["accelerator"],
-            ),
-        }
-        for accel, entry in sorted(fleet.items()):
-            for key, fam in families.items():
-                fam.add_metric([accel], entry[key])
-        yield from families.values()
+        if fleet is not None:
+            families = {
+                "allocatable": GaugeMetricFamily(
+                    "tpu_fleet_chips_allocatable",
+                    "TPU chips allocatable on Ready nodes",
+                    labels=["accelerator"],
+                ),
+                "requested": GaugeMetricFamily(
+                    "tpu_fleet_chips_requested",
+                    "TPU chips requested by non-terminal pods",
+                    labels=["accelerator"],
+                ),
+                "nodes": GaugeMetricFamily(
+                    "tpu_fleet_nodes",
+                    "Ready nodes carrying TPU chips",
+                    labels=["accelerator"],
+                ),
+            }
+            for accel, entry in sorted(fleet.items()):
+                for key, fam in families.items():
+                    fam.add_metric([accel], entry[key])
+            yield from families.values()
+        yield from self._workload_cards()
+
+    def _workload_cards(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from kubeflow_tpu.obs import fleet as obs_fleet
+
+        # fleet_cards degrades per-LIST (a failed kind renders as
+        # empty) — no extra last-known-good layer needed here.
+        cards = obs_fleet.fleet_cards(self.api)["namespaces"]
+        notebooks = GaugeMetricFamily(
+            "tpu_fleet_notebooks",
+            "Notebooks per namespace and phase",
+            labels=["namespace", "phase"],
+        )
+        inference = GaugeMetricFamily(
+            "tpu_fleet_inferenceservices",
+            "InferenceServices per namespace and phase",
+            labels=["namespace", "phase"],
+        )
+        goodput = GaugeMetricFamily(
+            "tpu_fleet_train_goodput_ratio",
+            "Worst train_goodput_ratio published in the namespace "
+            "(the job an operator should look at first)",
+            labels=["namespace"],
+        )
+        restarts = GaugeMetricFamily(
+            "tpu_fleet_preemption_restarts",
+            "Cumulative preemption restarts recorded on the "
+            "namespace's CR annotations",
+            labels=["namespace"],
+        )
+        for ns, card in sorted(cards.items()):
+            for phase, count in sorted(card["notebooks"].items()):
+                notebooks.add_metric([ns, phase], count)
+            for phase, count in sorted(card["inferenceservices"].items()):
+                inference.add_metric([ns, phase], count)
+            if card.get("goodput_ratio") is not None:
+                goodput.add_metric([ns], card["goodput_ratio"])
+            restarts.add_metric([ns], card["preemption_restarts"])
+        yield notebooks
+        yield inference
+        yield goodput
+        yield restarts

@@ -329,6 +329,14 @@ class ApiClient:
         # the point.
         self.request_metrics = {"requests": 0, "retries": 0}
         self._metrics_lock = threading.Lock()
+        # Cumulative availability counts for the apiserver SLO
+        # (obs.slo.apiserver_availability_objective): one event per
+        # round-trip *attempt* — good unless the apiserver 5xx'd/429'd,
+        # the connection failed, or the breaker fast-failed. Counted
+        # per attempt, not per logical request, so a blackout the
+        # retry loop is fighting through still burns the error budget
+        # it is actually causing.
+        self._avail = {"good": 0, "bad": 0}
         # Per-verb round-trip latency (each attempt observed, retries
         # included) in dependency-free histograms; rendered on /metrics
         # as apiserver_client_request_duration_seconds by
@@ -464,8 +472,11 @@ class ApiClient:
         with self._metrics_lock:
             hist = self._durations.get(verb)
             if hist is None:
+                # Exemplars on: a round-trip observed inside a traced
+                # reconcile stamps its trace id on the bucket, so a
+                # latency spike on /metrics links to the exact trace.
                 hist = self._durations[verb] = BucketHistogram(
-                    REQUEST_BUCKETS
+                    REQUEST_BUCKETS, exemplars=True
                 )
         hist.observe(seconds)
 
@@ -474,6 +485,17 @@ class ApiClient:
         with self._metrics_lock:
             hists = dict(self._durations)
         return {verb: h.snapshot() for verb, h in hists.items()}
+
+    def _count_avail(self, good: bool) -> None:
+        with self._metrics_lock:
+            self._avail["good" if good else "bad"] += 1
+
+    def availability_counts(self) -> tuple[int, int]:
+        """Cumulative ``(good, total)`` round-trip attempts — the
+        apiserver-availability SLO source shape."""
+        with self._metrics_lock:
+            good = self._avail["good"]
+            return good, good + self._avail["bad"]
 
     def _request(
         self,
@@ -518,6 +540,7 @@ class ApiClient:
         attempt = 0
         while True:
             if not self.breaker.allow():
+                self._count_avail(False)
                 if span is not None:
                     span.add_event("circuit_breaker_fast_fail",
                                    {"verb": method})
@@ -538,6 +561,7 @@ class ApiClient:
                 self._observe_duration(
                     method, time.monotonic() - attempt_started
                 )
+                self._count_avail(False)
                 self._drop_pooled()
                 self._breaker_failure(span, method)
                 if (
@@ -559,6 +583,10 @@ class ApiClient:
             self._observe_duration(
                 method, time.monotonic() - attempt_started
             )
+            # Availability SLO accounting: 5xx and 429 are unavailability
+            # as the caller experiences it (shed or failing); 4xx
+            # semantics (404/409/...) are the apiserver working.
+            self._count_avail(resp.status < 500 and resp.status != 429)
             # The server answered: 5xx counts against the breaker (the
             # apiserver itself is failing); anything else — including
             # 429, which proves it is alive enough to shed load — is

@@ -106,7 +106,10 @@ class CheckpointMetrics:
     """
 
     def __init__(self, registry=None):
-        self.save_duration = obs.BucketHistogram()
+        # Exemplars on: a save observed under its "checkpoint save"
+        # span stamps the trace id on the bucket, so a slow-save spike
+        # links to the exact save's trace.
+        self.save_duration = obs.BucketHistogram(exemplars=True)
         self.last_committed_step: int | None = None
         self.restore_total: dict[str, int] = {}
         self._lock = threading.Lock()

@@ -264,6 +264,7 @@ def run_with_checkpointing(
     tp_rules: dict | None = None,
     telemetry=None,
     goodput=None,
+    goodput_publish=None,
     install_signal_handler: bool = True,
     clock=time.monotonic,
 ):
@@ -311,7 +312,13 @@ def run_with_checkpointing(
       completed step's host-synced seconds accrue as useful time and
       the resume restore is measured as a ``restore`` (or ``reshard``)
       downtime span — ``train_goodput_ratio`` then tracks useful-step
-      time vs wall clock across preempt/restore cycles.
+      time vs wall clock across preempt/restore cycles. With
+      ``goodput_publish`` (a callable taking ``meter.summary()``, e.g.
+      an :class:`~kubeflow_tpu.obs.GoodputAnnotationPublisher`), the
+      summary is additionally pushed at every save cadence and once at
+      exit — the async hop that lands ``train_goodput_ratio`` on the
+      owning CR for the fleet cards. Strictly best-effort: a failing
+      publisher is logged and never fails (or stalls) the loop.
 
     Returns ``(state, RunReport)``. ``batches`` yields per-step batch
     dicts; the caller owns data-order alignment with the global step
@@ -384,6 +391,21 @@ def run_with_checkpointing(
     last_saved = step
     preempted = False
 
+    def publish_goodput(final: bool = False) -> None:
+        if goodput is None or goodput_publish is None:
+            return
+        # The exit publish bypasses a publisher's rate limit (duck-typed
+        # flush attr) — a cadence publish seconds before the end must
+        # not leave the mid-run ratio on the CR forever.
+        publish = (getattr(goodput_publish, "flush", goodput_publish)
+                   if final else goodput_publish)
+        try:
+            publish(goodput.summary())
+        except Exception:
+            # Telemetry must never fail the training loop it
+            # describes (apiserver outage, bad handle).
+            log.debug("goodput publish failed", exc_info=True)
+
     def decide() -> str:
         """One decision per step boundary — pending SIGTERM, wall-clock
         cadence — taken BEFORE the next step is paid for, so a pending
@@ -432,6 +454,7 @@ def run_with_checkpointing(
                 report.saves += 1
                 last_saved = step
                 last_save_at = clock()
+                publish_goodput()
             batch = next(batch_iter, done)
             if batch is done:
                 break
@@ -461,6 +484,7 @@ def run_with_checkpointing(
                 report.saves += 1
         else:
             manager.wait()
+        publish_goodput(final=True)
     finally:
         if previous_handler is not None:
             signal.signal(signal.SIGTERM, previous_handler)

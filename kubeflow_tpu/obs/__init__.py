@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import threading
 
+from kubeflow_tpu.obs.alerts import AlertManager, SloEngine
 from kubeflow_tpu.obs.export import (
     JsonlExporter,
     MultiExporter,
@@ -37,11 +38,13 @@ from kubeflow_tpu.obs.export import (
     timeline,
     trace_summaries,
 )
+from kubeflow_tpu.obs.fleet import GoodputAnnotationPublisher, fleet_cards
 from kubeflow_tpu.obs.logging import (
     JsonLogFormatter,
     configure_structured_logging,
 )
 from kubeflow_tpu.obs.metrics import BucketHistogram, CANONICAL_LABELS
+from kubeflow_tpu.obs.slo import BurnRateEvaluator, Objective
 from kubeflow_tpu.obs.telemetry import GoodputMeter, StepTelemetry
 from kubeflow_tpu.obs.trace import (
     TRACE_ANNOTATION,
@@ -54,13 +57,18 @@ from kubeflow_tpu.obs.trace import (
 )
 
 __all__ = [
+    "AlertManager",
     "BucketHistogram",
+    "BurnRateEvaluator",
     "CANONICAL_LABELS",
+    "GoodputAnnotationPublisher",
     "GoodputMeter",
     "JsonLogFormatter",
     "JsonlExporter",
     "MultiExporter",
+    "Objective",
     "RingExporter",
+    "SloEngine",
     "Span",
     "SpanContext",
     "StepTelemetry",
@@ -68,6 +76,7 @@ __all__ = [
     "Tracer",
     "configure_structured_logging",
     "current_span",
+    "fleet_cards",
     "format_traceparent",
     "get_tracer",
     "parse_traceparent",

@@ -1,0 +1,265 @@
+"""Alert state machine over burn-rate evaluations (stdlib only).
+
+:class:`AlertManager` consumes the rows :meth:`BurnRateEvaluator.tick`
+produces and tracks one alert per (objective, window pair):
+
+    inactive --condition--> pending --held for_s--> firing
+    pending --clear--> inactive
+    firing --clear for clear_s--> resolved (-> inactive)
+
+Hysteresis on both edges is deliberate: ``for_s`` keeps a single bad
+scrape from paging, ``clear_s`` keeps a flapping recovery from
+resolve/refire spam. Every transition is appended to a bounded history,
+emitted as a structured log record, and stamped as a zero-duration span
+on the obs tracer so an alert shows up in the same trace timeline as
+the reconciles and apiserver calls that caused it.
+
+:class:`SloEngine` is the composition the manager and the serving
+gateway embed: evaluator + alert manager + a self-rate-limited ``tick``
+safe to call from hot paths (controller tick hooks, scrape handlers).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from kubeflow_tpu.obs.slo import BurnRateEvaluator
+
+log = logging.getLogger(__name__)
+
+INACTIVE = "inactive"
+PENDING = "pending"
+FIRING = "firing"
+
+STATE_VALUE = {INACTIVE: 0, PENDING: 1, FIRING: 2}
+
+
+class AlertManager:
+    """Pending/firing/resolved tracking for every (slo, speed) pair."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        tracer=None,
+        history_limit: int = 256,
+    ):
+        self.clock = clock
+        self._tracer = tracer
+        # (slo, speed) -> alert record (mutated in place).
+        self._alerts: dict[tuple[str, str], dict] = {}
+        self.history: deque = deque(maxlen=max(1, int(history_limit)))
+        # update() runs on controller tick / scrape threads while
+        # /fleet and /debug/alerts read on HTTP handler threads;
+        # iterating _alerts/history during an insert/append raises
+        # RuntimeError, so writes and read snapshots share this lock.
+        self._lock = threading.Lock()
+
+    # ---- updates ---------------------------------------------------------
+    def update(self, rows: list[dict], now: float | None = None) -> list[dict]:
+        """Advance every alert against one evaluation; returns the
+        transitions that happened (also recorded in ``history``)."""
+        now = self.clock() if now is None else now
+        transitions: list[dict] = []
+        with self._lock:
+            self._update_locked(rows, now, transitions)
+        return transitions
+
+    def _update_locked(self, rows: list[dict], now: float,
+                       transitions: list[dict]) -> None:
+        for row in rows:
+            for speed, win in row.get("windows", {}).items():
+                key = (row["slo"], speed)
+                alert = self._alerts.get(key)
+                if alert is None:
+                    alert = self._alerts[key] = {
+                        "slo": row["slo"],
+                        "speed": speed,
+                        "severity": win.get("severity", "warning"),
+                        "namespace": row.get("namespace"),
+                        "state": INACTIVE,
+                        "since": now,
+                        "pending_since": None,
+                        "clear_since": None,
+                        "burn": 0.0,
+                    }
+                alert["burn"] = win.get("burn", 0.0)
+                alert["factor"] = win.get("factor")
+                alert["namespace"] = row.get("namespace")
+                if win.get("violated"):
+                    alert["clear_since"] = None
+                    if alert["state"] == INACTIVE:
+                        alert["pending_since"] = now
+                        self._move(alert, PENDING, now, transitions)
+                    if (
+                        alert["state"] == PENDING
+                        and now - alert["pending_since"]
+                        >= win.get("for_s", 0.0)
+                    ):
+                        self._move(alert, FIRING, now, transitions)
+                else:
+                    if alert["state"] == PENDING:
+                        alert["pending_since"] = None
+                        self._move(alert, INACTIVE, now, transitions)
+                    elif alert["state"] == FIRING:
+                        if alert["clear_since"] is None:
+                            alert["clear_since"] = now
+                        if (
+                            now - alert["clear_since"]
+                            >= win.get("clear_s", 0.0)
+                        ):
+                            self._move(alert, INACTIVE, now, transitions,
+                                       resolved=True)
+
+    def _move(self, alert: dict, state: str, now: float,
+              transitions: list[dict], resolved: bool = False) -> None:
+        previous = alert["state"]
+        alert["state"] = state
+        alert["since"] = now
+        event = {
+            "kind": "slo_alert",
+            "slo": alert["slo"],
+            "speed": alert["speed"],
+            "severity": alert["severity"],
+            "namespace": alert.get("namespace"),
+            "from": previous,
+            "to": "resolved" if resolved else state,
+            "burn": round(float(alert.get("burn", 0.0)), 3),
+            "at": now,
+        }
+        self.history.append(event)
+        transitions.append(event)
+        level = logging.WARNING if state == FIRING else logging.INFO
+        log.log(
+            level,
+            "slo alert %s: %s/%s (severity=%s burn=%.1fx namespace=%s)",
+            event["to"], alert["slo"], alert["speed"], alert["severity"],
+            event["burn"], alert.get("namespace") or "-",
+        )
+        self._emit_span(event)
+
+    def _emit_span(self, event: dict) -> None:
+        from kubeflow_tpu import obs
+
+        tracer = self._tracer if self._tracer is not None else obs.get_tracer()
+        try:
+            # A zero-duration root span: alert transitions land in the
+            # same ring/JSONL stream as the work that caused them.
+            span = tracer.start_span(
+                "slo alert", parent=None,
+                attributes={
+                    "name": event["slo"],
+                    "mode": event["speed"],
+                    "severity": event["severity"],
+                    "result": event["to"],
+                },
+            )
+            span.end()
+        except Exception:
+            log.debug("slo alert span emit failed", exc_info=True)
+
+    # ---- reads (snapshots under the writer lock) -------------------------
+    def all(self) -> list[dict]:
+        with self._lock:
+            return [dict(a) for a in self._alerts.values()]
+
+    def active(self) -> list[dict]:
+        """Alerts currently pending or firing."""
+        with self._lock:
+            return [dict(a) for a in self._alerts.values()
+                    if a["state"] != INACTIVE]
+
+    def firing(self) -> list[dict]:
+        with self._lock:
+            return [dict(a) for a in self._alerts.values()
+                    if a["state"] == FIRING]
+
+    def state_of(self, slo: str, speed: str) -> str:
+        with self._lock:
+            alert = self._alerts.get((slo, speed))
+            return alert["state"] if alert else INACTIVE
+
+    def to_dict(self) -> dict:
+        """The ``/debug/alerts`` document."""
+        with self._lock:
+            alerts = [dict(a) for a in self._alerts.values()]
+            history = list(self.history)
+        return {
+            "alerts": sorted(alerts, key=lambda a: (a["slo"], a["speed"])),
+            "history": history,
+        }
+
+
+class SloEngine:
+    """Evaluator + alerts behind one self-rate-limited ``tick``.
+
+    ``tick`` is wired into controller tick hooks and scrape handlers —
+    call sites that fire tens of times per second — so it samples at
+    most every ``min_interval_s`` unless forced (tests force with an
+    explicit ``now``)."""
+
+    def __init__(
+        self,
+        evaluator: BurnRateEvaluator | None = None,
+        alerts: AlertManager | None = None,
+        min_interval_s: float = 5.0,
+        clock: Callable[[], float] | None = None,
+    ):
+        self.evaluator = evaluator or BurnRateEvaluator()
+        if clock is None:
+            clock = self.evaluator.clock
+        self.clock = clock
+        self.alerts = alerts or AlertManager(clock=clock)
+        self.min_interval_s = float(min_interval_s)
+        # tick() is called from HTTP handler threads (/fleet, /metrics)
+        # and controller tick hooks concurrently; one lock serializes
+        # the sample→evaluate→alert pipeline and the last_rows publish.
+        self._lock = threading.Lock()
+        self._last_tick: float | None = None
+        self.last_rows: list[dict] = []
+
+    def register(self, objective):
+        return self.evaluator.register(objective)
+
+    def tick(self, now: float | None = None) -> list[dict]:
+        """Sample, evaluate, advance alerts. An explicit ``now`` always
+        runs (deterministic tests drive the clock themselves); without
+        one the call is rate-limited to ``min_interval_s``."""
+        forced = now is not None
+        now = self.clock() if now is None else now
+        with self._lock:
+            if (
+                not forced
+                and self._last_tick is not None
+                and now - self._last_tick < self.min_interval_s
+            ):
+                return self.last_rows
+            self._last_tick = now
+            self.last_rows = self.evaluator.tick(now)
+            self.alerts.update(self.last_rows, now)
+            return self.last_rows
+
+    def status(self) -> dict:
+        """The JSON block ``/fleet`` and the gateway's ``/v1/status``
+        embed: per-objective burn rates + active alerts."""
+        objectives = {}
+        for row in self.last_rows:
+            objectives[row["slo"]] = {
+                "target": row["target"],
+                "threshold_s": row["threshold_s"],
+                "burn": {
+                    speed: round(win["burn"], 3)
+                    for speed, win in row["windows"].items()
+                },
+                "states": {
+                    speed: self.alerts.state_of(row["slo"], speed)
+                    for speed in row["windows"]
+                },
+            }
+        return {
+            "objectives": objectives,
+            "alerts": self.alerts.active(),
+        }

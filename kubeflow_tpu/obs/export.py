@@ -53,10 +53,28 @@ class JsonlExporter:
     are created; writes are serialized so concurrent span ends cannot
     interleave half-lines. The append handle is opened once and flushed
     per line — spans end on every reconcile and training step, and an
-    open/close syscall pair per record would dominate the export cost."""
+    open/close syscall pair per record would dominate the export cost.
 
-    def __init__(self, path: str):
+    Size-capped rotation: with ``max_bytes`` (or ``OBS_JSONL_MAX_BYTES``
+    in the environment) set, a write that would push the file past the
+    cap first atomically rotates it to ``<path>.1`` (``os.replace`` —
+    the previous ``.1`` is dropped), so a long soak or a forever-cycling
+    gateway holds at most ~2x the cap on disk instead of filling it.
+    Unset means unbounded — the pre-existing default, unchanged."""
+
+    def __init__(self, path: str, max_bytes: int | None = None):
         self.path = path
+        if max_bytes is None:
+            raw = os.environ.get("OBS_JSONL_MAX_BYTES")
+            if raw:
+                try:
+                    max_bytes = int(raw)
+                except ValueError:
+                    max_bytes = None
+        self.max_bytes = (
+            int(max_bytes) if max_bytes and int(max_bytes) > 0 else None
+        )
+        self._written = 0  # bytes in the current file (tracked, not statted)
         self._lock = threading.Lock()
         self._fh = None
         try:
@@ -71,11 +89,36 @@ class JsonlExporter:
 
     def export(self, span: dict) -> None:
         line = json.dumps(span, default=str)
+        encoded = line.encode("utf-8") + b"\n"
         with self._lock:
             if self._fh is None:
                 self._fh = open(self.path, "a", encoding="utf-8")
+                try:
+                    self._written = os.fstat(self._fh.fileno()).st_size
+                except OSError:
+                    self._written = 0
+            if (
+                self.max_bytes is not None
+                and self._written > 0
+                and self._written + len(encoded) > self.max_bytes
+            ):
+                # Rotate-before-write: the record that would cross the
+                # cap starts the fresh file, so no line is ever split
+                # across generations.
+                self._fh.close()
+                self._fh = None
+                try:
+                    os.replace(self.path, self.path + ".1")
+                except OSError:
+                    # Rotation denied (e.g. read-only dir): keep
+                    # appending — availability of the trace stream
+                    # beats the size cap.
+                    pass
+                self._fh = open(self.path, "a", encoding="utf-8")
+                self._written = 0
             self._fh.write(line + "\n")
             self._fh.flush()
+            self._written += len(encoded)
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,227 @@
+"""Fleet health rollup: per-namespace cards over the live CR objects.
+
+The manager's ``/fleet`` endpoint, the serving gateway's status block
+and the dashboard's fleet gauges all read the same computation: list
+Notebooks and InferenceServices through any duck-typed api handle,
+fold their phases, recovery counters and goodput annotations into one
+card per namespace, and overlay the SLO alert state so a firing
+burn-rate alert turns the card red instead of hiding in ``/metrics``.
+
+Stdlib-only and duck-typed on the api (FakeApiServer, ApiClient or the
+chaos proxy), like everything else in ``obs`` — the dashboard and the
+manager import *this*, not each other.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+# CR coordinates, mirrored from the controllers (obs must stay
+# importable without them; the values are API contract, not code).
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+INFERENCE_API = "serving.kubeflow.org/v1alpha1"
+
+# Annotation the data plane publishes its goodput ratio through
+# (GoodputAnnotationPublisher below; models/train.py's goodput_publish
+# hook feeds it) — the async hop that carries train_goodput_ratio from
+# the training pod to the fleet cards.
+GOODPUT_ANNOTATION = "obs.kubeflow-tpu.org/goodput-ratio"
+
+# Per-CRD preemption-restart annotation namespaces (slice_recovery).
+_PREEMPTION_KEYS = (
+    "notebooks.kubeflow-tpu.org/preemption-restarts",
+    "inference.kubeflow-tpu.org/preemption-restarts",
+)
+
+# Phases that degrade a card even without an alert: the platform is
+# mid-recovery or failed outright.
+_UNHEALTHY_PHASES = frozenset({"Restarting", "Resharding", "Failed"})
+
+
+def _phase_of(obj: dict) -> str:
+    status = obj.get("status") or {}
+    phase = status.get("phase")
+    if phase:
+        return str(phase)
+    container = status.get("containerState") or {}
+    if "running" in container:
+        return "Running"
+    if "waiting" in container:
+        return "Waiting"
+    if "terminated" in container:
+        return "Stopped"
+    return "Pending"
+
+
+def _annotations(obj: dict) -> dict:
+    return (obj.get("metadata") or {}).get("annotations") or {}
+
+
+def _safe_list(api, api_version: str, kind: str) -> list[dict]:
+    try:
+        return api.list(api_version, kind) or []
+    except Exception as exc:
+        # The rollup is a read-only health surface: during an outage it
+        # must render what it can, not 500 — same posture as the
+        # last-known-good metric collectors.
+        log.warning("fleet rollup: list %s failed (%s)", kind, exc)
+        return []
+
+
+def fleet_cards(
+    api,
+    alerts=None,
+    counters: dict | None = None,
+    clock: Callable[[], float] = time.time,
+) -> dict:
+    """Per-namespace fleet cards.
+
+    ``alerts`` is an :class:`~kubeflow_tpu.obs.alerts.AlertManager` (or
+    anything with ``active()``); a namespace-scoped alert lands on its
+    namespace's card, a cluster-scoped one (namespace None) on every
+    card. ``counters`` optionally carries manager-side per-namespace
+    counter readings, e.g. ``{"reshards": {ns: n}}`` folded from the
+    Prometheus registry — the dashboard process omits them.
+    """
+    cards: dict[str, dict] = {}
+
+    def card(ns: str) -> dict:
+        return cards.setdefault(ns, {
+            "notebooks": {},
+            "inferenceservices": {},
+            "preemption_restarts": 0,
+            "reshards": 0,
+            "goodput_ratio": None,
+            "alerts": [],
+            "health": "ok",
+        })
+
+    for kind_key, api_version, kind in (
+        ("notebooks", NOTEBOOK_API, "Notebook"),
+        ("inferenceservices", INFERENCE_API, "InferenceService"),
+    ):
+        for obj in _safe_list(api, api_version, kind):
+            ns = (obj.get("metadata") or {}).get("namespace", "")
+            entry = card(ns)
+            phase = _phase_of(obj)
+            entry[kind_key][phase] = entry[kind_key].get(phase, 0) + 1
+            anns = _annotations(obj)
+            for key in _PREEMPTION_KEYS:
+                try:
+                    entry["preemption_restarts"] += int(anns.get(key, 0))
+                except (TypeError, ValueError):
+                    pass
+            if phase == "Resharding":
+                entry["reshards"] += 1
+            raw = anns.get(GOODPUT_ANNOTATION)
+            if raw is not None:
+                try:
+                    ratio = float(raw)
+                except (TypeError, ValueError):
+                    pass
+                else:
+                    # The card shows the worst job in the namespace —
+                    # the one an operator should look at first.
+                    cur = entry["goodput_ratio"]
+                    entry["goodput_ratio"] = (
+                        ratio if cur is None else min(cur, ratio)
+                    )
+
+    for counter_name, by_ns in (counters or {}).items():
+        for ns, value in (by_ns or {}).items():
+            card(ns)[counter_name] = card(ns).get(counter_name, 0) + value
+
+    active = list(alerts.active()) if alerts is not None else []
+    for alert in active:
+        targets = (
+            [alert["namespace"]] if alert.get("namespace")
+            else list(cards)
+        )
+        for ns in targets:
+            entry = card(ns)
+            entry["alerts"].append({
+                "slo": alert["slo"],
+                "speed": alert["speed"],
+                "severity": alert["severity"],
+                "state": alert["state"],
+            })
+
+    for entry in cards.values():
+        states = {a["state"] for a in entry["alerts"]}
+        phases = set(entry["notebooks"]) | set(entry["inferenceservices"])
+        if "firing" in states:
+            entry["health"] = "critical"
+        elif "pending" in states or phases & _UNHEALTHY_PHASES:
+            entry["health"] = "degraded"
+
+    return {
+        "namespaces": {ns: cards[ns] for ns in sorted(cards)},
+        "alerts": active,
+        "generated_at": clock(),
+    }
+
+
+class GoodputAnnotationPublisher:
+    """Publishes a GoodputMeter summary onto the owning CR as the
+    :data:`GOODPUT_ANNOTATION` — the data-plane half of the goodput
+    fleet card. Rate-limited and strictly best-effort: telemetry must
+    never fail (or stall) the training loop it describes.
+
+    Shaped for ``run_with_checkpointing(goodput_publish=...)``: called
+    with ``meter.summary()`` at each save cadence and once at exit."""
+
+    def __init__(
+        self,
+        api,
+        namespace: str,
+        name: str,
+        kind: str = "Notebook",
+        api_version: str = NOTEBOOK_API,
+        min_interval_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.kind = kind
+        self.api_version = api_version
+        self.min_interval_s = float(min_interval_s)
+        self._clock = clock
+        self._last_publish: float | None = None
+        self.publishes = 0
+
+    def __call__(self, summary: dict) -> None:
+        now = self._clock()
+        if (
+            self._last_publish is not None
+            and now - self._last_publish < self.min_interval_s
+        ):
+            return
+        self.flush(summary)
+
+    def flush(self, summary: dict) -> None:
+        """Publish regardless of the rate limit — the once-at-exit
+        path, so a run that just published on cadence still lands its
+        FINAL ratio on the CR instead of leaving the mid-run one."""
+        ratio = summary.get("goodput_ratio")
+        if ratio is None:
+            return
+        now = self._clock()
+        try:
+            self.api.patch_merge(
+                self.api_version, self.kind, self.name,
+                {"metadata": {"annotations": {
+                    GOODPUT_ANNOTATION: f"{float(ratio):.4f}",
+                }}},
+                self.namespace,
+            )
+        except Exception as exc:
+            log.debug("goodput publish failed for %s/%s: %s",
+                      self.namespace, self.name, exc)
+            return
+        self._last_publish = now
+        self.publishes += 1

@@ -14,6 +14,7 @@ can join series across components without per-exporter relabeling.
 from __future__ import annotations
 
 import threading
+import time
 
 # The only label names any platform collector may use. Object identity
 # is always spelled namespace/name/controller (never ns/nb/component);
@@ -45,9 +46,20 @@ class BucketHistogram:
 
     The snapshot is exposition-shaped — cumulative counts per upper
     bound, "+Inf" last — so a custom collector can hand it straight to
-    ``HistogramMetricFamily.add_metric``."""
+    ``HistogramMetricFamily.add_metric``.
 
-    def __init__(self, buckets: tuple[float, ...] = LATENCY_BUCKETS):
+    With ``exemplars=True`` each bucket additionally remembers the most
+    recent observation that landed in it together with the trace id
+    active at the time (OpenMetrics exemplars): a p99 spike on the
+    rendered histogram then links straight to the trace that caused it
+    instead of being an anonymous bucket count. Capture is opt-in —
+    most histograms have no span in scope and should not pay the
+    lookup — and records only *sampled* spans (an unsampled trace id
+    resolves to nothing in any exporter, which would send an operator
+    hunting for a trace that never existed)."""
+
+    def __init__(self, buckets: tuple[float, ...] = LATENCY_BUCKETS,
+                 exemplars: bool = False):
         self._bounds = tuple(sorted(float(b) for b in buckets))
         if not self._bounds:
             raise ValueError("at least one bucket bound required")
@@ -55,18 +67,30 @@ class BucketHistogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        self._exemplars_enabled = bool(exemplars)
+        # bucket index -> (trace_id, observed value, unix timestamp)
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
         value = float(value)
         idx = len(self._bounds)
         for i, bound in enumerate(self._bounds):
             if value <= bound:
                 idx = i
                 break
+        if self._exemplars_enabled and trace_id is None:
+            # Lazy sibling import keeps the no-exemplar path free of it.
+            from kubeflow_tpu.obs.trace import current_span
+
+            span = current_span()
+            if span is not None and span.context.sampled:
+                trace_id = span.context.trace_id
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if self._exemplars_enabled and trace_id:
+                self._exemplars[idx] = (trace_id, value, time.time())
 
     @property
     def count(self) -> int:
@@ -79,18 +103,30 @@ class BucketHistogram:
             return self._sum
 
     def snapshot(self) -> dict:
-        """{"count", "sum", "buckets": [("0.005", cum), ..., ("+Inf", n)]}"""
+        """{"count", "sum", "buckets": [("0.005", cum), ..., ("+Inf", n)]}
+        plus, when exemplar capture is on, ``"exemplars"``: bucket
+        upper-bound string -> {"trace_id", "value", "ts"}."""
         with self._lock:
             counts = list(self._counts)
             total = self._count
             acc_sum = self._sum
+            exemplars = (
+                dict(self._exemplars) if self._exemplars_enabled else None
+            )
         buckets: list[tuple[str, int]] = []
         cumulative = 0
         for bound, count in zip(self._bounds, counts):
             cumulative += count
             buckets.append((repr(bound), cumulative))
         buckets.append(("+Inf", total))
-        return {"count": total, "sum": acc_sum, "buckets": buckets}
+        snap = {"count": total, "sum": acc_sum, "buckets": buckets}
+        if exemplars is not None:
+            labels = [repr(b) for b in self._bounds] + ["+Inf"]
+            snap["exemplars"] = {
+                labels[idx]: {"trace_id": tid, "value": val, "ts": ts}
+                for idx, (tid, val, ts) in sorted(exemplars.items())
+            }
+        return snap
 
     def quantile(self, q: float) -> float:
         """Upper bound of the bucket containing the q-quantile (the

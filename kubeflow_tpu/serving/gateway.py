@@ -55,9 +55,11 @@ from prometheus_client.core import (
     CounterMetricFamily,
     HistogramMetricFamily,
 )
+from prometheus_client.openmetrics import exposition as om_exposition
 
 from kubeflow_tpu import obs
-from kubeflow_tpu.obs.metrics import LATENCY_BUCKETS
+from kubeflow_tpu.obs import slo as obs_slo
+from kubeflow_tpu.obs.metrics import LATENCY_BUCKETS, REQUEST_BUCKETS
 from kubeflow_tpu.serving.engine import QueueFull, Scheduler
 
 log = logging.getLogger(__name__)
@@ -125,6 +127,18 @@ class GatewayMetrics:
             registry=self.registry,
             buckets=LATENCY_BUCKETS,
         )
+        # Inter-token gaps, observed per token after the first: the
+        # steady-state decode SLI (the QPS harness derives its
+        # itl_p50/p99 from per-request timelines; this is the live
+        # gateway-side view of the same distribution). Request-bucket
+        # spread: gaps live in the milliseconds, not minutes.
+        self.itl = Histogram(
+            "inference_itl_seconds",
+            "Gap between consecutive streamed tokens of one request "
+            "(inter-token latency)",
+            registry=self.registry,
+            buckets=REQUEST_BUCKETS,
+        )
         self.tokens_total = Counter(
             "inference_tokens",
             "Tokens through the gateway (kind: prompt = received, "
@@ -146,8 +160,38 @@ class GatewayMetrics:
         )
         self.queue_depth.set_function(engine.pending)
 
-    def exposition(self) -> bytes:
+    def exposition(self, openmetrics: bool = False) -> bytes:
+        # OpenMetrics carries the TTFT exemplars (trace-id links);
+        # classic 0.0.4 text stays the default for existing scrapers.
+        if openmetrics:
+            return om_exposition.generate_latest(self.registry)
         return generate_latest(self.registry)
+
+
+def make_gateway_slo_engine(metrics: GatewayMetrics, clock=None):
+    """Serving SLO set (obs.slo defaults; KFT_SLO_* env tunes):
+    first-token latency and inter-token latency over the gateway's own
+    histograms."""
+    kwargs = {"clock": clock} if clock is not None else {}
+    evaluator = obs_slo.BurnRateEvaluator(**kwargs)
+    engine = obs.SloEngine(evaluator=evaluator)
+    engine.register(obs_slo.ttft_objective(metrics.ttft))
+    engine.register(obs_slo.itl_objective(metrics.itl))
+    return engine
+
+
+# Distinguishes "caller said nothing" (build the default engine) from
+# an explicit slo=None (disable the SLO layer entirely).
+_DEFAULT_SLO = object()
+
+
+def _trace_exemplar(span) -> dict | None:
+    """``observe(exemplar=...)`` payload for the active request span,
+    or None when the trace is unsampled (an unsampled id resolves to
+    nothing in any exporter)."""
+    if span is not None and span.context.sampled:
+        return {"trace_id": span.context.trace_id}
+    return None
 
 
 class InferenceGateway:
@@ -162,13 +206,21 @@ class InferenceGateway:
     def __init__(self, engine, port: int = 0,
                  retry_after_s: float = 1.0,
                  reload_fn=None,
-                 stream_timeout_s: float = 120.0):
+                 stream_timeout_s: float = 120.0,
+                 slo=_DEFAULT_SLO):
         self.engine = engine
         self.metrics = GatewayMetrics(engine)
         self.scheduler = Scheduler(engine)
         self.reload_fn = reload_fn
         self.retry_after_s = retry_after_s
         self.stream_timeout_s = stream_timeout_s
+        # Serving-side SLOs (PR 9): burn-rate objectives over the
+        # gateway's own TTFT/ITL histograms, surfaced in /v1/status and
+        # ticked by scrapes/status reads. Injectable for deterministic
+        # tests; an explicit None disables the layer.
+        if slo is _DEFAULT_SLO:
+            slo = make_gateway_slo_engine(self.metrics)
+        self.slo = slo
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -202,10 +254,16 @@ class InferenceGateway:
                     self._json(200 if ok else 503,
                                {"ready": bool(ok)})
                 elif path == "/metrics":
-                    body = outer.metrics.exposition()
+                    if outer.slo is not None:
+                        outer.slo.tick()
+                    accept = self.headers.get("Accept", "")
+                    om = "application/openmetrics-text" in accept
+                    body = outer.metrics.exposition(openmetrics=om)
                     self.send_response(200)
                     self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4")
+                        "Content-Type",
+                        om_exposition.CONTENT_TYPE_LATEST if om
+                        else "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -233,12 +291,16 @@ class InferenceGateway:
         return self._server.server_address[1]
 
     def status(self) -> dict:
-        return {
+        doc = {
             "pending": self.engine.pending(),
             "batched": bool(getattr(self.engine, "batched", False)),
             "draining": bool(getattr(self.engine, "draining", False)),
             "swaps": int(getattr(self.engine, "swaps_total", 0)),
         }
+        if self.slo is not None:
+            self.slo.tick()
+            doc["slo"] = self.slo.status()
+        return doc
 
     def start(self) -> "InferenceGateway":
         self.scheduler.start()
@@ -368,6 +430,7 @@ class InferenceGateway:
         handler.send_header("Cache-Control", "no-store")
         handler.end_headers()
         index = 0
+        last_token_at: float | None = None
         try:
             while True:
                 event = self._next_event(events)
@@ -378,10 +441,17 @@ class InferenceGateway:
                     span.add_event("stream_timeout", {"index": index})
                     return "timeout"
                 if "token" in event:
+                    now = time.monotonic()
                     if index == 0:
                         self.metrics.ttft.observe(
-                            time.monotonic() - started)
+                            now - started,
+                            exemplar=_trace_exemplar(span))
                         span.add_event("first_token")
+                    else:
+                        self.metrics.itl.observe(
+                            now - last_token_at,
+                            exemplar=_trace_exemplar(span))
+                    last_token_at = now
                     frame = json.dumps(
                         {"token": event["token"], "index": index})
                     handler.wfile.write(
@@ -410,6 +480,7 @@ class InferenceGateway:
     def _collect_events(self, handler, span, events: queue.Queue,
                         started: float) -> str:
         first_at: float | None = None
+        last_token_at: float | None = None
         try:
             while True:
                 event = self._next_event(events)
@@ -417,9 +488,18 @@ class InferenceGateway:
                     handler._json(504,
                                   {"error": "generation timed out"})
                     return "timeout"
-                if "token" in event and first_at is None:
-                    first_at = time.monotonic()
-                    self.metrics.ttft.observe(first_at - started)
+                if "token" in event:
+                    now = time.monotonic()
+                    if first_at is None:
+                        first_at = now
+                        self.metrics.ttft.observe(
+                            first_at - started,
+                            exemplar=_trace_exemplar(span))
+                    else:
+                        self.metrics.itl.observe(
+                            now - last_token_at,
+                            exemplar=_trace_exemplar(span))
+                    last_token_at = now
                 if event.get("done"):
                     tokens = event.get("tokens", [])
                     self.metrics.tokens_total.labels("generated").inc(

@@ -10,7 +10,11 @@ frames after each stream's first token — the decode hot path, where
 the fused-kernel/speculative wins land) with the per-stream decode
 rate ``decode_tokens_per_s_per_stream`` (= pooled gap count / pooled
 gap seconds), and end-to-end stream time — plus aggregate tokens/sec;
-the summary prints as one JSON line with p50/p99.
+the summary prints as one JSON line with p50/p99. After the run the
+gateway's own burn-rate verdict is read back from ``/v1/status`` and
+attached as ``slo`` (per-objective fast/slow burn + alert state), so
+a load run that pushed TTFT or inter-token latency past its objective
+reports the judgement next to the numbers that caused it.
 
 Modes:
 
@@ -175,6 +179,30 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
     }
 
 
+def fetch_slo_status(url: str, timeout: float) -> dict | None:
+    """The gateway's SLO block from ``/v1/status``, condensed to one
+    row per objective (fast/slow burn + alert state). Best-effort:
+    an older gateway (no slo block) or a dead endpoint returns None —
+    the load numbers still print."""
+    try:
+        with urllib.request.urlopen(url + "/v1/status",
+                                    timeout=timeout) as response:
+            doc = json.loads(response.read().decode())
+    # analysis: allow[py-broad-except] — optional read-back, None is the answer
+    except Exception:
+        return None
+    slo = doc.get("slo")
+    if not isinstance(slo, dict):
+        return None
+    return {
+        name: {
+            "burn": row.get("burn", {}),
+            "states": row.get("states", {}),
+        }
+        for name, row in (slo.get("objectives") or {}).items()
+    }
+
+
 def start_local_gateway(vocab: int, prompt_len: int, max_batch: int,
                         max_pending: int):
     """In-process tiny-model gateway on a real socket (imports jax
@@ -244,6 +272,10 @@ def main(argv=None) -> dict:
     try:
         summary = run_load(url, prompts, args.clients, args.requests,
                            args.max_new, args.timeout)
+        # Read the burn-rate verdict AFTER the load: the status call
+        # also ticks the gateway's SLO engine, so the run's own TTFT
+        # and inter-token observations are what gets judged.
+        summary["slo"] = fetch_slo_status(url, args.timeout)
     finally:
         if gateway is not None:
             gateway.stop()

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Observability gate for CI (PR 3). Two checks:
+# Observability gate for CI (PR 3; SLO layer PR 9). Four checks:
 #
 # 1. Exposition integrity: every platform registry (controller-manager,
 #    jupyter CRUD app, dashboard) must parse cleanly with
@@ -7,12 +7,24 @@
 #    lines — and use only the canonical label schema
 #    (kubeflow_tpu.obs.CANONICAL_LABELS).
 #
-# 2. Log discipline: the obs/resilience tier-1 subset runs with
-#    testing/obs_log_plugin.py attached; any kubeflow_tpu.* record
-#    that the structured JSON formatter cannot render with the schema
-#    core (ts/level/logger/msg) fails the gate. Pairs with the
-#    analyzer's py-print-in-lib rule: prints never reach loggers, so
-#    the two checks together cover both escape routes.
+# 2. Exemplar exposition: the manager registry rendered as OpenMetrics
+#    (the format that carries exemplars) must parse with the
+#    OpenMetrics parser, with no duplicate families, and a reconcile
+#    observation made under a span must surface its trace id as a
+#    bucket exemplar.
+#
+# 3. Log discipline: the obs/resilience/slo tier-1 subset (including
+#    ALL of tests/test_slo.py — burn-rate math, alert hysteresis,
+#    exemplar round-trips, /fleet + /debug/alerts schemas, the chaos
+#    blackout acceptance arc) runs with testing/obs_log_plugin.py
+#    attached; any kubeflow_tpu.* record that the structured JSON
+#    formatter cannot render with the schema core (ts/level/logger/
+#    msg) fails the gate. Pairs with the analyzer's py-print-in-lib
+#    rule: prints never reach loggers, so the two checks together
+#    cover both escape routes.
+#
+# 4. Analysis: kubeflow_tpu/obs/ holds ZERO findings under every pack
+#    (no pragma budget, no baseline entries for the package).
 set -euo pipefail
 
 cd "$(dirname "$0")/../.."
@@ -61,11 +73,63 @@ if failures:
     raise SystemExit(1)
 PY
 
+echo "== obs gate: OpenMetrics exemplar exposition =="
+python - <<'PY'
+from prometheus_client.openmetrics.exposition import generate_latest
+from prometheus_client.openmetrics.parser import (
+    text_string_to_metric_families,
+)
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.controllers.metrics import ControllerMetrics
+
+prom = ControllerMetrics()
+tracer = obs.Tracer(sample_rate=1.0)
+with tracer.span("reconcile") as span:
+    prom.reconcile_duration.labels("notebook").observe(
+        0.2, exemplar={"trace_id": span.context.trace_id}
+    )
+text = generate_latest(prom.registry).decode()
+families = list(text_string_to_metric_families(text))
+names = [f.name for f in families]
+dupes = sorted({n for n in names if names.count(n) > 1})
+if dupes:
+    raise SystemExit(f"duplicate families in OpenMetrics text: {dupes}")
+exemplars = [
+    s.exemplar
+    for f in families
+    for s in f.samples
+    if s.name == "controller_reconcile_duration_seconds_bucket"
+    and s.exemplar
+]
+if not exemplars:
+    raise SystemExit("reconcile histogram exposed no exemplar")
+if exemplars[0].labels.get("trace_id") != span.context.trace_id:
+    raise SystemExit("exemplar trace id does not match the span")
+print(f"  manager: {len(families)} families ok, exemplar round-trips")
+PY
+
+echo "== obs gate: kubeflow_tpu/obs at zero analysis findings =="
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/obs"], check_emitted=False,
+))
+# No pragma budget, no baseline, not even warnings: the telemetry
+# layer must be spotless under every pack (including its own new
+# py-unbounded-metric-labels rule).
+if findings:
+    print("\n".join(f.render() for f in findings))
+    raise SystemExit(1)
+print("  kubeflow_tpu/obs: 0 findings under all packs")
+PY
+
 echo "== obs gate: structured-log discipline over tier-1 subset =="
 REPORT="$(mktemp)"
 rm -f "$REPORT"
 KFT_OBS_LOG_REPORT="$REPORT" PYTHONPATH="testing${PYTHONPATH:+:$PYTHONPATH}" \
-  python -m pytest tests/test_obs.py tests/test_resilience.py \
+  python -m pytest tests/test_obs.py tests/test_resilience.py tests/test_slo.py \
   -q -m 'not slow' -p obs_log_plugin
 
 if [[ -s "$REPORT" ]]; then

@@ -1,0 +1,31 @@
+"""Seeded violations: metric label values derived from request/user
+data — every distinct pod name / prompt / exception string becomes a
+new time series held forever by the registry and the scraper (the
+classic self-inflicted cardinality explosion)."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def record_pod_restart(metric, pod):
+    # Violation 1: per-pod identity as a label value.
+    metric.labels(pod["metadata"]["name"]).inc()
+
+
+def record_request(metric, namespace, prompt_text):
+    # Violation 2: raw prompt content as a label value.
+    metric.labels(namespace, prompt_text).inc()
+
+
+def record_failure(metric, request):
+    try:
+        request.send()
+    except ValueError as exc:
+        # Violation 3: exception string as a label value.
+        metric.labels(str(exc)).inc()
+
+
+def record_latency(metric, user, seconds):
+    # Violation 4: f-string label — per-request by construction.
+    metric.labels(f"user-{user.id}").observe(seconds)

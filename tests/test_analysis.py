@@ -161,6 +161,19 @@ class TestSeededViolations:
         assert f.path.endswith("print_telemetry.py")
         assert "structured logger" in f.message
 
+    def test_unbounded_metric_labels(self, bad_findings):
+        found = by_rule(bad_findings, "py-unbounded-metric-labels")
+        assert len(found) == 4
+        assert all(f.severity == Severity.WARNING for f in found)
+        assert all(
+            f.path.endswith("metric_cardinality.py") for f in found
+        )
+        reasons = " | ".join(f.message for f in found)
+        assert "'pod'" in reasons         # pod name label
+        assert "'prompt'" in reasons      # prompt content label
+        assert "'exc'" in reasons         # str(exc) label
+        assert "f-string" in reasons      # dynamic formatting
+
 
 class TestPrintRuleExemptions:
     """py-print-in-lib fires on library modules only: scripts own
@@ -295,6 +308,58 @@ class TestNonatomicWriteRule:
         assert len(
             [f for f in findings if f.rule == "py-nonatomic-write"]
         ) == 1
+
+
+class TestUnboundedMetricLabelsRule:
+    """py-unbounded-metric-labels flags request-derived label values
+    only: the platform's sanctioned vocabulary (namespace/name object
+    identity, enumerated outcomes) and literals stay silent."""
+
+    def _findings(self, source):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, "pkg/mod.py")
+            if f.rule == "py-unbounded-metric-labels"
+        ]
+
+    def test_literals_and_enumerated_vars_are_silent(self):
+        src = (
+            "def rec(metric, namespace, outcome, verb):\n"
+            "    metric.labels('prompt').inc()\n"  # literal: bounded
+            "    metric.labels(namespace, outcome).inc()\n"
+            "    metric.labels(verb).inc()\n"
+        )
+        assert self._findings(src) == []
+
+    def test_object_identity_labels_are_silent(self):
+        # namespace/name CR identity is the platform's sanctioned label
+        # pair (culling metrics) — not a per-request value.
+        src = (
+            "def rec(metric, req):\n"
+            "    metric.labels(req.namespace, req.name).inc()\n"
+        )
+        assert self._findings(src) == []
+
+    def test_exception_and_fstring_values_fire(self):
+        src = (
+            "def rec(metric, exc, step):\n"
+            "    metric.labels(str(exc)).inc()\n"
+            "    metric.labels(f'step-{step}').inc()\n"
+        )
+        assert len(self._findings(src)) == 2
+
+    def test_keyword_label_values_checked(self):
+        src = (
+            "def rec(metric, pod_name):\n"
+            "    metric.labels(pod=pod_name).inc()\n"
+        )
+        assert len(self._findings(src)) == 1
+
+    def test_plain_fstring_without_interpolation_is_silent(self):
+        assert self._findings(
+            "def rec(metric):\n    metric.labels(f'static').inc()\n"
+        ) == []
 
 
 class TestCleanFixtures:

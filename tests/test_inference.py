@@ -781,6 +781,13 @@ class TestLoadtestSmoke:
         # line (pooled inter-token gaps + per-stream decode rate).
         assert summary["itl_p99_s"] >= summary["itl_p50_s"] > 0
         assert summary["decode_tokens_per_s_per_stream"] > 0
+        # PR-9 satellite: the gateway's burn-rate verdict rides along,
+        # read back from /v1/status after the load.
+        assert set(summary["slo"]) == {"inference-ttft", "inference-itl"}
+        for row in summary["slo"].values():
+            assert set(row["burn"]) == {"fast", "slow"}
+            assert set(row["states"].values()) <= {
+                "inactive", "pending", "firing"}
 
 
 class TestGatewayMetricsSchema:

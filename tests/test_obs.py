@@ -148,6 +148,79 @@ class TestTracer:
         assert spans[0]["end"] >= spans[0]["start"]
 
 
+class TestJsonlRotation:
+    """OBS_JSONL_MAX_BYTES size cap: atomic rotate-to-.1 so a long soak
+    cannot fill the disk; unset keeps the pre-existing unbounded
+    default."""
+
+    def test_rotates_atomically_at_cap(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "spans.jsonl")
+        exp = obs.JsonlExporter(path, max_bytes=400)
+        for i in range(50):
+            exp.export({"name": f"s{i}", "pad": "x" * 40})
+        exp.close()
+        assert os.path.getsize(path) <= 400
+        assert os.path.getsize(path + ".1") <= 400 + 60
+        # Every line in both generations is intact JSON; the stream is
+        # contiguous (the .1 file ends where the current one begins).
+        old = load_jsonl(path + ".1")
+        new = load_jsonl(path)
+        assert old and new
+        names = [s["name"] for s in old] + [s["name"] for s in new]
+        first = int(names[0][1:])
+        assert names == [f"s{i}" for i in range(first, 50)]
+
+    def test_no_line_is_ever_split_across_generations(self, tmp_path):
+        path = str(tmp_path / "r.jsonl")
+        exp = obs.JsonlExporter(path, max_bytes=120)
+        for i in range(30):
+            exp.export({"i": i, "pad": "y" * 30})
+        exp.close()
+        for p in (path, path + ".1"):
+            with open(p, encoding="utf-8") as fh:
+                for line in fh:
+                    json.loads(line)  # raises on a torn line
+
+    def test_unset_means_unbounded(self, tmp_path, monkeypatch):
+        import os
+
+        monkeypatch.delenv("OBS_JSONL_MAX_BYTES", raising=False)
+        path = str(tmp_path / "u.jsonl")
+        exp = obs.JsonlExporter(path)
+        assert exp.max_bytes is None
+        for i in range(100):
+            exp.export({"i": i, "pad": "z" * 50})
+        exp.close()
+        assert not os.path.exists(path + ".1")
+        assert len(load_jsonl(path)) == 100
+
+    def test_env_cap_applies_and_survives_reopen(self, tmp_path,
+                                                 monkeypatch):
+        import os
+
+        path = str(tmp_path / "e.jsonl")
+        monkeypatch.setenv("OBS_JSONL_MAX_BYTES", "300")
+        exp = obs.JsonlExporter(path)
+        assert exp.max_bytes == 300
+        for i in range(10):
+            exp.export({"i": i, "pad": "w" * 40})
+        exp.close()
+        # A restarted process (fresh exporter over the same file) picks
+        # up the existing size and keeps honoring the cap.
+        exp2 = obs.JsonlExporter(path)
+        for i in range(10, 20):
+            exp2.export({"i": i, "pad": "w" * 40})
+        exp2.close()
+        assert os.path.getsize(path) <= 300
+
+    def test_garbage_env_value_disables_cap(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OBS_JSONL_MAX_BYTES", "a-lot")
+        assert obs.JsonlExporter(str(tmp_path / "g.jsonl")).max_bytes \
+            is None
+
+
 # ---------------------------------------------------------------------------
 # structured JSON logging
 # ---------------------------------------------------------------------------
