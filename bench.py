@@ -95,15 +95,26 @@ def run_timed(step, state, batch_data, warmup: int, steps: int,
         float(jax.device_get(metrics["loss"]))
 
     if telemetry is not None:
+        from kubeflow_tpu.obs.profile import PhaseProfiler
+
+        # Phase attribution rides the telemetry path with zero extra
+        # flags (PR 10): each timed step runs under a profiler
+        # activation split into dispatch (the step call) and sync (the
+        # host fetch that forces the chain), and StepTelemetry stamps
+        # the live digest into its per-step JSONL record.
+        profiler = PhaseProfiler()
         batch_size = len(next(iter(batch_data.values())))
         total = 0.0
         for i in range(steps):
-            t0 = time.perf_counter()
-            state, metrics = step(state, batch_data)
-            final_loss = float(jax.device_get(metrics["loss"]))
-            dt_step = time.perf_counter() - t0
-            total += dt_step
-            telemetry.observe(batch_size, dt_step, step=i)
+            with profiler.activate():
+                t0 = time.perf_counter()
+                with profiler.phase("dispatch"):
+                    state, metrics = step(state, batch_data)
+                with profiler.phase("sync"):
+                    final_loss = float(jax.device_get(metrics["loss"]))
+                dt_step = time.perf_counter() - t0
+                total += dt_step
+                telemetry.observe(batch_size, dt_step, step=i)
         assert np.isfinite(final_loss)
         return state, total
 

@@ -63,6 +63,18 @@
   structured logs. Literal string arguments are never flagged;
   deliberate bounded cases escape with
   ``# analysis: allow[py-unbounded-metric-labels]``.
+- ``py-unbounded-deque`` (warning): a ``deque()`` (no ``maxlen``) or
+  ``[]``/``list()`` attribute created in a class ``__init__`` that
+  some method of the class *appends to* while NO method ever trims it
+  (no ``pop``/``popleft``/``clear``/``remove``, no ``del``/slice
+  reassignment, no reassignment outside ``__init__``). In a
+  long-lived obs/serving/controller object — a flight-recorder ring,
+  an alert history, a telemetry record buffer — that is a memory leak
+  with a fuse measured in uptime: the process that matters most (the
+  one that never restarts) is the one that dies. Bound it by
+  construction (``deque(maxlen=...)``) or trim explicitly; provably
+  drained-elsewhere cases escape with
+  ``# analysis: allow[py-unbounded-deque]``.
 """
 
 from __future__ import annotations
@@ -467,6 +479,143 @@ def _check_metric_labels(call: ast.Call, path: str,
         ))
 
 
+# --- py-unbounded-deque ----------------------------------------------------
+# Method names that GROW a sequence attribute...
+_GROW_METHODS = {"append", "appendleft", "extend", "extendleft", "insert"}
+# ...and the ones that count as trim discipline when applied to it.
+_TRIM_METHODS = {"pop", "popleft", "clear", "remove"}
+
+
+def _self_attr_name(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _unbounded_seq_ctor(value: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Why this ``__init__`` assignment value is an unbounded growable
+    sequence: ``[]`` / ``list()`` / ``deque(...)`` without ``maxlen=``.
+    Returns a short ctor description, or None for anything bounded or
+    not a sequence literal (dicts index, they don't accumulate)."""
+    if isinstance(value, ast.List) and not value.elts:
+        return "[]"
+    if not isinstance(value, ast.Call):
+        return None
+    target = _dotted(value.func, aliases)
+    last = target.rsplit(".", 1)[-1]
+    if last == "list" and not value.args and not value.keywords:
+        return "list()"
+    if last == "deque":
+        if any(kw.arg == "maxlen" for kw in value.keywords):
+            return None
+        if len(value.args) >= 2:  # deque(iterable, maxlen) positional
+            return None
+        return "deque() without maxlen"
+    return None
+
+
+def _check_unbounded_deques(cls: ast.ClassDef, aliases: dict[str, str],
+                            path: str, out: list[Finding]) -> None:
+    """Flag ``self.<attr>`` sequences built unbounded in ``__init__``,
+    grown by some method of the class, and trimmed by none. Scope is
+    the class: the grow and the trim of a disciplined buffer live in
+    the same object, wherever its callers are."""
+    init = next(
+        (n for n in cls.body
+         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+         and n.name == "__init__"),
+        None,
+    )
+    if init is None:
+        return
+    # attr -> (lineno, ctor description) from __init__ assignments.
+    candidates: dict[str, tuple[int, str]] = {}
+    for node in _scope_nodes(init):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        value = node.value
+        if value is None:
+            continue
+        for target in targets:
+            attr = _self_attr_name(target)
+            if attr is None:
+                continue
+            reason = _unbounded_seq_ctor(value, aliases)
+            if reason is not None:
+                candidates[attr] = (node.lineno, reason)
+    if not candidates:
+        return
+    grown: set[str] = set()
+    trimmed: set[str] = set()
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(method):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                attr = _self_attr_name(node.func.value)
+                if attr in candidates:
+                    if node.func.attr in _GROW_METHODS:
+                        grown.add(attr)
+                    elif node.func.attr in _TRIM_METHODS:
+                        trimmed.add(attr)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id == "len" and node.args):
+                # A ``len(self.attr)`` read anywhere in the class is
+                # taken as explicit bounding discipline (the
+                # guard-before-append / trim-past-cap idioms both
+                # start by measuring).
+                attr = _self_attr_name(node.args[0])
+                if attr in candidates:
+                    trimmed.add(attr)
+            elif isinstance(node, ast.Delete):
+                # del self.attr[...] / del self.attr
+                for target in node.targets:
+                    base = (target.value if isinstance(target, ast.Subscript)
+                            else target)
+                    attr = _self_attr_name(base)
+                    if attr in candidates:
+                        trimmed.add(attr)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and method.name != "__init__":
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                # Tuple unpacking counts: ``out, self.buf = self.buf,
+                # []`` is the swap-drain idiom.
+                flat: list[ast.AST] = []
+                for target in targets:
+                    if isinstance(target, (ast.Tuple, ast.List)):
+                        flat.extend(target.elts)
+                    else:
+                        flat.append(target)
+                for target in flat:
+                    # Reassignment or slice assignment resets/shrinks:
+                    # ``self.buf = []`` / ``self.buf[:] = self.buf[-n:]``.
+                    base = (target.value if isinstance(target, ast.Subscript)
+                            else target)
+                    attr = _self_attr_name(base)
+                    if attr in candidates:
+                        trimmed.add(attr)
+    for attr in sorted(grown - trimmed):
+        lineno, ctor = candidates[attr]
+        out.append(Finding(
+            "py-unbounded-deque", Severity.WARNING, path, lineno,
+            f"self.{attr} is created as {ctor} in __init__ and appended "
+            f"to by {cls.name} methods but never trimmed: in a "
+            "long-lived object this grows with uptime until the "
+            "process dies — bound it by construction "
+            "(deque(maxlen=...)) or add explicit trim discipline (or "
+            "annotate a provably drained buffer with "
+            "# analysis: allow[py-unbounded-deque])",
+        ))
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -563,6 +712,8 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
             if node.name == "reconcile" or node.name.endswith("_reconcile"):
                 _check_reconcile_body(node, aliases, path, out)
             _check_nonatomic_writes(node, aliases, path, out)
+        elif isinstance(node, ast.ClassDef):
+            _check_unbounded_deques(node, aliases, path, out)
         elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
             _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):

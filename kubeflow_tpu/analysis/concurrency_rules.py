@@ -122,8 +122,12 @@ class _MethodWalker:
         self.method = method
         self.locks = locks
         self.aliases = aliases
+        # Per-file walker: lives for one analyze() call, bounded by the
+        # file's AST.  # analysis: allow[py-unbounded-deque]
         self.writes: list[_Write] = []
+        # analysis: allow[py-unbounded-deque]
         self.order_edges: list[tuple[str, str, int]] = []  # held, taken
+        # analysis: allow[py-unbounded-deque]
         self.blocking: list[tuple[int, str]] = []
         self._held: list[str] = []
 

@@ -292,6 +292,8 @@ class PreemptionInjector:
         )
         self._sleep = sleep
         self.retries_total = 0
+        # Injection record the scenario asserts on: bounded by the
+        # schedule's event count.  # analysis: allow[py-unbounded-deque]
         self.preempted: list[tuple[str, str]] = []  # (namespace, pod)
         # Capacity-timeline state: the chip bound currently enforced
         # and the nodes this injector tainted to enforce it (cleared

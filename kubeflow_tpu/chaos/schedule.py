@@ -93,6 +93,8 @@ class FaultSchedule:
     def __init__(self, seed: int = 0):
         self.seed = seed
         self._rng = random.Random(seed)
+        # Builder-filled at scenario construction; bounded by the
+        # author's fault list.  # analysis: allow[py-unbounded-deque]
         self._windows: list[_Window] = []
         self._watch_rates: dict[str, float] = {}
         self._watch_budget: dict[str, int | None] = {}
@@ -102,6 +104,8 @@ class FaultSchedule:
         # event's instant — the two fault planes stay independently
         # reproducible.
         self._capacity_rng = random.Random((seed << 1) ^ 0x5CA1AB1E)
+        # Same builder discipline as _windows.
+        # analysis: allow[py-unbounded-deque]
         self._capacity: list[CapacityEvent] = []
 
     # ---- builders --------------------------------------------------------

@@ -25,28 +25,24 @@ from kubeflow_tpu.controllers.notebook import (
     make_notebook_controller,
 )
 from kubeflow_tpu.k8s.fake import FakeApiServer
-
-
-def _env_bool(name: str, default: bool = False) -> bool:
-    val = os.environ.get(name)
-    if val is None:
-        return default
-    return val.lower() in ("1", "true", "yes")
+from kubeflow_tpu.obs.envknob import env_bool as _env_bool
 
 
 def make_default_slo_engine(prom: ControllerMetrics, api=None,
-                            clock=None):
+                            clock=None, recorder=None):
     """The control-plane SLO set every manager ships with
     (obs.slo defaults; KFT_SLO_* env tunes targets/thresholds):
     reconcile duration, workqueue queue-wait, and — when the api handle
     counts availability (real ApiClient, chaos proxy) — apiserver
-    availability."""
+    availability. With a ``recorder`` (the manager-shared
+    FlightRecorder), any alert going firing dumps the reconcile
+    snapshot ring — the black-box window leading up to the burn."""
     from kubeflow_tpu import obs
     from kubeflow_tpu.obs import slo as obs_slo
 
     kwargs = {"clock": clock} if clock is not None else {}
     evaluator = obs_slo.BurnRateEvaluator(**kwargs)
-    engine = obs.SloEngine(evaluator=evaluator)
+    engine = obs.SloEngine(evaluator=evaluator, recorder=recorder)
     engine.register(obs_slo.reconcile_duration_objective(prom))
     engine.register(obs_slo.queue_wait_objective(prom))
     if api is not None and hasattr(api, "availability_counts"):
@@ -98,6 +94,7 @@ class Manager:
         lease_namespace: str = "kubeflow",
         clock=None,
         slo=_DEFAULT_SLO,
+        recorder=None,
     ):
         self.api = api
         self.controllers = controllers
@@ -105,6 +102,19 @@ class Manager:
         self._threads: list = []
         self._running = False
         self.server = None
+        # Black-box capture (PR 10): ONE flight recorder shared by
+        # every controller in this manager — each reconcile leaves one
+        # bounded-ring snapshot (phase split, queue depth, trace id) —
+        # and by the SLO engine, which dumps the ring to a JSONL
+        # artifact on any pending→firing transition. Controllers built
+        # with their own recorder keep it (explicit beats shared).
+        from kubeflow_tpu.obs.recorder import FlightRecorder
+
+        self.recorder = (recorder if recorder is not None
+                         else FlightRecorder())
+        for ctrl in controllers:
+            if getattr(ctrl, "recorder", None) is None:
+                ctrl.recorder = self.recorder
         # The judging layer over the manager's own telemetry (PR 9):
         # default burn-rate SLOs registered over the registry's
         # reconcile/queue histograms and — when the api handle counts
@@ -112,7 +122,8 @@ class Manager:
         # availability objective. Injectable for deterministic tests;
         # an explicit None disables the layer.
         if slo is _DEFAULT_SLO:
-            slo = (make_default_slo_engine(prom, api)
+            slo = (make_default_slo_engine(prom, api,
+                                           recorder=self.recorder)
                    if prom is not None else None)
         self.slo = slo
         if self.slo is not None:
@@ -138,6 +149,15 @@ class Manager:
                 tracer=obs.get_tracer(),
                 slo=self.slo,
                 fleet_api=api,
+                # Reconcile phase digests (/debug/profile) + the shared
+                # snapshot ring (/debug/flightrecord), debug-gated like
+                # the pprof-role endpoints.
+                profilers={
+                    ctrl.name: ctrl.profiler
+                    for ctrl in controllers
+                    if getattr(ctrl, "profiler", None) is not None
+                },
+                recorder=self.recorder,
             )
         self.elector = None
         if leader_elect:

@@ -348,6 +348,8 @@ class ManagerServer:
         tracer=None,
         slo=None,
         fleet_api=None,
+        profilers: dict | None = None,
+        recorder=None,
     ):
         self.metrics = metrics
         self.ready = ready or (lambda: True)
@@ -365,6 +367,13 @@ class ManagerServer:
         # debug gate with the other operator-forensics endpoints.
         self.slo = slo
         self.fleet_api = fleet_api
+        # Continuous-profiling surfaces (PR 10): ``profilers`` maps
+        # controller name -> PhaseProfiler (reconcile phase digests);
+        # ``recorder`` is the manager-shared FlightRecorder whose ring
+        # /debug/flightrecord serves live. Both sit behind the same
+        # debug gate as the pprof-role endpoints.
+        self.profilers = profilers or {}
+        self.recorder = recorder
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -415,6 +424,41 @@ class ManagerServer:
                     outer.slo.tick()
                     body = json.dumps(
                         outer.slo.alerts.to_dict(), indent=1, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/debug/profile" and outer.enable_debug:
+                    # Per-controller reconcile phase digests (list /
+                    # desired-state / patch / status / total) plus the
+                    # process-wide device-memory watermark when the
+                    # backend exposes one (None on CPU control planes).
+                    import json
+
+                    from kubeflow_tpu.obs import profile as obs_profile
+
+                    body = json.dumps({
+                        "controllers": {
+                            name: prof.snapshot()
+                            for name, prof in sorted(
+                                outer.profilers.items())
+                        },
+                        "memory": obs_profile.process_watermark(),
+                    }, indent=1, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (
+                    self.path == "/debug/flightrecord"
+                    and outer.enable_debug
+                    and outer.recorder is not None
+                ):
+                    import json
+
+                    body = json.dumps(
+                        outer.recorder.to_dict(), indent=1, default=str
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -355,6 +355,8 @@ class ApiClient:
         # exec plugin must run once per expiry, not once per thread.
         self._token_lock = threading.Lock()
         self._local = threading.local()
+        # One entry per caller-opened watch; bounded by the consumers
+        # the process starts.  # analysis: allow[py-unbounded-deque]
         self._watches: list[_WatchState] = []
         self._closed = False
         # kind -> (resource, namespaced), seeded statically, extended by

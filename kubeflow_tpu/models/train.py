@@ -10,6 +10,7 @@ collectives — no hand-written communication.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import signal
@@ -265,6 +266,8 @@ def run_with_checkpointing(
     telemetry=None,
     goodput=None,
     goodput_publish=None,
+    profiler=None,
+    recorder=None,
     install_signal_handler: bool = True,
     clock=time.monotonic,
 ):
@@ -319,6 +322,25 @@ def run_with_checkpointing(
       exit — the async hop that lands ``train_goodput_ratio`` on the
       owning CR for the fleet cards. Strictly best-effort: a failing
       publisher is logged and never fails (or stalls) the loop.
+    - **phase attribution**: with a
+      :class:`kubeflow_tpu.obs.PhaseProfiler`, every loop iteration is
+      split into ``fetch`` (pulling the next batch — a stalled data
+      pipeline becomes visible as fetch p99, not mystery step time),
+      ``step`` (dispatch + host sync), ``save`` (cadence save issue)
+      and ``publish`` (the goodput hop), plus ``restore`` for the
+      resume restore — the same interval the GoodputMeter charges as
+      restore/reshard downtime, so the two meters compose instead of
+      double-counting. The profiler is *activated* around each
+      iteration, so a :class:`~kubeflow_tpu.obs.StepTelemetry` plugged
+      into the same run stamps the live per-phase digest into its
+      per-step JSONL records with no extra flags. With a profiler AND
+      no telemetry/goodput, steps are still host-synced (honest phase
+      attribution requires it — the profiler is opt-in precisely
+      because of that sync). With a
+      :class:`~kubeflow_tpu.obs.FlightRecorder`, each completed step
+      lands one black-box snapshot (step, phase seconds, device-memory
+      watermark, active trace id) in the bounded ring the SLO engine
+      dumps when an alert fires.
 
     Returns ``(state, RunReport)``. ``batches`` yields per-step batch
     dicts; the caller owns data-order alignment with the global step
@@ -362,13 +384,25 @@ def run_with_checkpointing(
             log.info("resumed from checkpoint step %d", step)
         return new_state, step
 
-    if goodput is not None:
-        with goodput.downtime("restore") as span:
+    def _phase(name: str):
+        """Time a block into the profiler's named digest, or do
+        nothing when no profiler is plugged in — the hook costs zero
+        unless asked for, like telemetry/goodput."""
+        return (profiler.phase(name) if profiler is not None
+                else contextlib.nullcontext())
+
+    with _phase("restore"):
+        # The restore phase and the GoodputMeter's restore/reshard
+        # downtime span measure the SAME interval from two angles:
+        # goodput charges it against the job's lifetime, the profiler
+        # makes it comparable against fetch/step/save percentiles.
+        if goodput is not None:
+            with goodput.downtime("restore") as span:
+                state, step = _resume()
+                if report.resharded:
+                    span.kind = "reshard"
+        else:
             state, step = _resume()
-            if report.resharded:
-                span.kind = "reshard"
-    else:
-        state, step = _resume()
     report.start_step = report.final_step = step
 
     stop = threading.Event()
@@ -431,60 +465,100 @@ def run_with_checkpointing(
             or token == "save"
         )
 
+    def snapshot_step(phases: dict | None) -> None:
+        """One black-box snapshot per completed step: this iteration's
+        phase split + the device-memory watermark, into the bounded
+        ring an alert dump captures. ``step`` and ``report`` are read
+        at call time (closure), so the snapshot carries the step just
+        finished."""
+        if recorder is None:
+            return
+        recorder.record(
+            "train_step",
+            step=step,
+            phases={k: round(v, 6) for k, v in (phases or {}).items()},
+            saves=report.saves,
+            memory=(profiler.watermark() if profiler is not None
+                    else None),
+        )
+
     batch_iter = iter(batches)
     done = object()
     try:
         while True:
-            # Boundary decision BEFORE the next batch is even pulled: a
-            # stalled data pipeline must not sit between a pending
-            # SIGTERM and the grace-window save, and the previous
-            # step's cadence save must not wait on the fetch either.
-            token = decide()
-            if token == "stop":
-                preempted = True
-                break  # final sync save below covers the last step
-            if cadence_due(token):
-                # With process_count > 1, `token` is the broadcast
-                # agreement from process 0 (sanitized in decide());
-                # the host-local view only survives when agree is
-                # False, i.e. single-process, where divergence is
-                # impossible.
-                # analysis: allow[spmd-divergent-collective]
-                manager.save_async(step, state)
-                report.saves += 1
-                last_saved = step
-                last_save_at = clock()
-                publish_goodput()
-            batch = next(batch_iter, done)
-            if batch is done:
-                break
-            t0 = time.perf_counter()
-            state, metrics = step_fn(state, batch)
-            step += 1
-            report.final_step = step
-            if telemetry is not None or goodput is not None:
-                seconds = _synced_step_seconds(metrics, t0)
-                if telemetry is not None:
-                    telemetry.observe(
-                        len(next(iter(batch.values()))), seconds
-                    )
-                if goodput is not None:
-                    goodput.observe_step(seconds)
+            # Each iteration runs under a profiler activation so the
+            # per-unit scope collects this step's phase seconds (and
+            # StepTelemetry, observed inside the activation, stamps
+            # the live digest into its record).
+            activation = (profiler.activate() if profiler is not None
+                          else contextlib.nullcontext(None))
+            with activation as phases:
+                # Boundary decision BEFORE the next batch is even
+                # pulled: a stalled data pipeline must not sit between
+                # a pending SIGTERM and the grace-window save, and the
+                # previous step's cadence save must not wait on the
+                # fetch either.
+                token = decide()
+                if token == "stop":
+                    preempted = True
+                    break  # final sync save below covers the last step
+                if cadence_due(token):
+                    with _phase("save"):
+                        # With process_count > 1, `token` is the
+                        # broadcast agreement from process 0 (sanitized
+                        # in decide()); the host-local view only
+                        # survives when agree is False, i.e.
+                        # single-process, where divergence is
+                        # impossible.
+                        # analysis: allow[spmd-divergent-collective]
+                        manager.save_async(step, state)
+                    report.saves += 1
+                    last_saved = step
+                    last_save_at = clock()
+                    with _phase("publish"):
+                        publish_goodput()
+                with _phase("fetch"):
+                    batch = next(batch_iter, done)
+                if batch is done:
+                    break
+                seconds = None
+                with _phase("step"):
+                    t0 = time.perf_counter()
+                    state, metrics = step_fn(state, batch)
+                    step += 1
+                    report.final_step = step
+                    if (telemetry is not None or goodput is not None
+                            or profiler is not None):
+                        # A plugged-in profiler forces the host sync
+                        # too: "step" must mean the step, not its
+                        # async enqueue.
+                        seconds = _synced_step_seconds(metrics, t0)
+                if seconds is not None:
+                    if telemetry is not None:
+                        telemetry.observe(
+                            len(next(iter(batch.values()))), seconds
+                        )
+                    if goodput is not None:
+                        goodput.observe_step(seconds)
+                snapshot_step(phases)
         if preempted or (stop.is_set() and not agree):
             # Preemption grace window: one last synchronous checkpoint
             # (save() first drains the in-flight background save) so at
             # most the in-flight step is lost, not a whole cadence.
             report.preempted = True
             if step > 0 or report.resumed_from_step is not None:
-                # Multi-host, this path is only entered on the agreed
-                # "stop" token from process 0; the raw stop.is_set()
-                # arm is explicitly single-process (`not agree`).
-                # analysis: allow[spmd-divergent-collective]
-                manager.save(step, state)
+                with _phase("save"):
+                    # Multi-host, this path is only entered on the
+                    # agreed "stop" token from process 0; the raw
+                    # stop.is_set() arm is explicitly single-process
+                    # (`not agree`).
+                    # analysis: allow[spmd-divergent-collective]
+                    manager.save(step, state)
                 report.saves += 1
         else:
             manager.wait()
-        publish_goodput(final=True)
+        with _phase("publish"):
+            publish_goodput(final=True)
     finally:
         if previous_handler is not None:
             signal.signal(signal.SIGTERM, previous_handler)

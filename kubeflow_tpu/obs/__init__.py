@@ -44,6 +44,12 @@ from kubeflow_tpu.obs.logging import (
     configure_structured_logging,
 )
 from kubeflow_tpu.obs.metrics import BucketHistogram, CANONICAL_LABELS
+from kubeflow_tpu.obs.profile import (
+    PhaseDigest,
+    PhaseProfiler,
+    memory_watermark,
+)
+from kubeflow_tpu.obs.recorder import FlightRecorder
 from kubeflow_tpu.obs.slo import BurnRateEvaluator, Objective
 from kubeflow_tpu.obs.telemetry import GoodputMeter, StepTelemetry
 from kubeflow_tpu.obs.trace import (
@@ -61,8 +67,11 @@ __all__ = [
     "BucketHistogram",
     "BurnRateEvaluator",
     "CANONICAL_LABELS",
+    "FlightRecorder",
     "GoodputAnnotationPublisher",
     "GoodputMeter",
+    "PhaseDigest",
+    "PhaseProfiler",
     "JsonLogFormatter",
     "JsonlExporter",
     "MultiExporter",
@@ -79,6 +88,7 @@ __all__ = [
     "fleet_cards",
     "format_traceparent",
     "get_tracer",
+    "memory_watermark",
     "parse_traceparent",
     "set_tracer",
     "span_tree",

@@ -207,6 +207,7 @@ class SloEngine:
         alerts: AlertManager | None = None,
         min_interval_s: float = 5.0,
         clock: Callable[[], float] | None = None,
+        recorder=None,
     ):
         self.evaluator = evaluator or BurnRateEvaluator()
         if clock is None:
@@ -214,6 +215,12 @@ class SloEngine:
         self.clock = clock
         self.alerts = alerts or AlertManager(clock=clock)
         self.min_interval_s = float(min_interval_s)
+        # Black-box capture (obs.recorder.FlightRecorder): a pending→
+        # firing transition dumps the recorder's ring as a JSONL
+        # artifact — the window leading up to the alert, captured
+        # before anyone asks. The recorder rate-limits itself; a
+        # failed/suppressed dump never fails the tick.
+        self.recorder = recorder
         # tick() is called from HTTP handler threads (/fleet, /metrics)
         # and controller tick hooks concurrently; one lock serializes
         # the sample→evaluate→alert pipeline and the last_rows publish.
@@ -239,8 +246,21 @@ class SloEngine:
                 return self.last_rows
             self._last_tick = now
             self.last_rows = self.evaluator.tick(now)
-            self.alerts.update(self.last_rows, now)
-            return self.last_rows
+            transitions = self.alerts.update(self.last_rows, now)
+            rows = self.last_rows
+        # The dump (open + write + fsync) happens OUTSIDE the engine
+        # lock: a slow disk during an incident must not stall every
+        # concurrent /v1/status, /fleet and scrape tick behind it.
+        # The recorder's own rate limit serializes double-fires.
+        if self.recorder is not None:
+            fired = [t for t in transitions if t["to"] == FIRING]
+            if fired:
+                t = fired[0]
+                self.recorder.dump(
+                    f"slo {t['slo']}/{t['speed']} firing "
+                    f"(burn {t['burn']}x)"
+                )
+        return rows
 
     def status(self) -> dict:
         """The JSON block ``/fleet`` and the gateway's ``/v1/status``

@@ -1,0 +1,43 @@
+"""Shared numeric env-knob parsing for the obs package.
+
+Every obs env knob follows one contract: missing, malformed, or (where
+a floor applies) out-of-range values fall back to the default — a bad
+knob must never crash an import or a hot loop. One implementation,
+imported by the leaf modules (this module imports nothing from obs, so
+it is cycle-safe under ``obs/__init__``'s re-export graph).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Read env var ``name`` as a truthy flag (``1``/``true``/``yes``,
+    case-insensitive); ``default`` when unset."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() in ("1", "true", "yes")
+
+
+def env_number(
+    name: str,
+    default: float | int,
+    cast: Callable = float,
+    minimum: float | int | None = None,
+):
+    """Read env var ``name`` through ``cast`` (``float``/``int``),
+    returning ``default`` when unset, unparsable, or below
+    ``minimum``."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = cast(raw)
+    except ValueError:
+        return default
+    if minimum is not None and value < minimum:
+        return default
+    return value

@@ -1,0 +1,353 @@
+"""Continuous phase-level profiling for the platform's hot loops.
+
+PR 9's burn-rate alerts can say *that* an SLO burned and PR 3's traces
+can say *which* request was slow; nothing attributed *where the time
+went* inside one unit of hot-loop work — a training step (fetch /
+step / save / publish), a batcher cycle (admit / prefill / decode /
+verify / commit), a reconcile (list / desired-state / patch / status).
+:class:`PhaseProfiler` is that attribution layer: always-on, cheap
+(one ``perf_counter`` pair + a lock-guarded deque append per phase,
+single-digit microseconds), with rolling per-phase percentile digests
+readable live at ``/debug/profile`` and stamped into
+``StepTelemetry`` records and flight-recorder snapshots.
+
+Propagation is ``contextvars``-based, like the tracer: a driver (the
+training loop, the scheduler thread, the controller runtime) activates
+its profiler around one unit of work, and any code underneath —
+however deep — attributes a phase with the module-level :func:`phase`
+helper without plumbing a handle. Outside an activation the helper is
+a no-op, so library code can be instrumented unconditionally.
+
+Device-memory watermarks ride along where the runtime exposes them
+(``jax.local_devices()[i].memory_stats()`` on TPU/GPU backends); on
+CPU — and in processes that never import jax — :func:`memory_watermark`
+degrades to ``None`` after one cached probe, so the control plane pays
+nothing for a data-plane feature.
+
+Environment:
+
+- ``KFT_PROFILE_WINDOW`` — rolling digest window per phase (default
+  512 most-recent durations; percentiles are exact over the window).
+- ``KFT_PROFILE_MEMORY`` — "0" disables watermark sampling entirely
+  (default on; unavailable backends cost one probe then nothing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from kubeflow_tpu.obs.envknob import env_bool, env_number
+
+# The profiler whose digests module-level phase() records into, plus
+# the per-activation accumulator dict (one unit of work's phase
+# seconds) — both carried on contextvars so instrumentation points
+# need no handle and threads/contexts never share an activation.
+_ACTIVE: contextvars.ContextVar["PhaseProfiler | None"] = \
+    contextvars.ContextVar("kubeflow_tpu_obs_active_profiler", default=None)
+_SCOPE: contextvars.ContextVar[dict | None] = \
+    contextvars.ContextVar("kubeflow_tpu_obs_profile_scope", default=None)
+
+
+class PhaseDigest:
+    """Rolling-window duration digest for one named phase.
+
+    Keeps the last ``window`` observations (deque, oldest evicted) plus
+    cumulative count/total, and answers nearest-rank percentiles exactly
+    over the window: for ``n`` retained values sorted ascending,
+    ``percentile(q)`` is the value at rank ``max(1, ceil(q * n))`` —
+    hand-computable, no interpolation. Not thread-safe on its own; the
+    owning :class:`PhaseProfiler` serializes access."""
+
+    __slots__ = ("_window", "count", "total_s", "max_s", "last_s")
+
+    def __init__(self, window: int = 512):
+        self._window: deque = deque(maxlen=max(1, int(window)))
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.last_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(float(seconds), 0.0)
+        self._window.append(seconds)
+        self.count += 1
+        self.total_s += seconds
+        self.last_s = seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the rolling window; 0.0 when
+        empty."""
+        if not self._window:
+            return 0.0
+        values = sorted(self._window)
+        q = min(max(float(q), 0.0), 1.0)
+        # ceil(q * n) without floats drifting: -(-a // b) idiom over
+        # a scaled integer would be overkill; guard the edges instead.
+        rank = int(q * len(values))
+        if rank < q * len(values):
+            rank += 1
+        rank = min(max(rank, 1), len(values))
+        return values[rank - 1]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "window": len(self._window),
+            "total_s": round(self.total_s, 6),
+            "last_s": round(self.last_s, 6),
+            "max_s": round(self.max_s, 6),
+            "p50_s": round(self.percentile(0.50), 6),
+            "p90_s": round(self.percentile(0.90), 6),
+            "p99_s": round(self.percentile(0.99), 6),
+        }
+
+
+class PhaseProfiler:
+    """Per-phase wall-time attribution with rolling percentile digests.
+
+    One profiler per hot loop (one per controller, one per serving
+    engine, one per training run). The loop either calls
+    :meth:`phase` directly (it holds the handle) or activates the
+    profiler around one unit of work (:meth:`activate`) so deeper code
+    reports through the module-level :func:`phase` helper. The
+    activation scope also accumulates this unit's per-phase seconds —
+    the dict the flight recorder snapshots.
+
+    Thread-safe: digests mutate under one lock, and ``snapshot()`` /
+    ``compact()`` read under the same lock, so ``/debug/profile``
+    handler threads can read while the hot loop writes."""
+
+    def __init__(
+        self,
+        window: int | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+        memory: bool | None = None,
+    ):
+        self.window = (window if window is not None
+                       else env_number("KFT_PROFILE_WINDOW", 512, cast=int))
+        self._clock = clock
+        if memory is None:
+            memory = env_bool("KFT_PROFILE_MEMORY", True)
+        self.memory = bool(memory)
+        self._lock = threading.Lock()
+        self._digests: dict[str, PhaseDigest] = {}
+
+    # ---- recording -------------------------------------------------------
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one phase duration. Also accumulates into the current
+        activation scope when THIS profiler is the active one (a
+        foreign activation on the same thread must not absorb another
+        loop's phases)."""
+        with self._lock:
+            digest = self._digests.get(name)
+            if digest is None:
+                digest = self._digests[name] = PhaseDigest(self.window)
+            digest.observe(seconds)
+        if _ACTIVE.get() is self:
+            scope = _SCOPE.get()
+            if scope is not None:
+                scope[name] = scope.get(name, 0.0) + max(
+                    float(seconds), 0.0
+                )
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """``with profiler.phase("decode"):`` — time the block into the
+        named digest (and the active scope)."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.observe(name, self._clock() - t0)
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Install this profiler as the contextvar-active one and open
+        a fresh per-unit scope; yields the scope dict (phase name →
+        accumulated seconds for this unit of work)."""
+        scope: dict[str, float] = {}
+        token = _ACTIVE.set(self)
+        scope_token = _SCOPE.set(scope)
+        try:
+            yield scope
+        finally:
+            _SCOPE.reset(scope_token)
+            _ACTIVE.reset(token)
+
+    # ---- reading ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """{phase: full digest snapshot} — the ``/debug/profile``
+        document body."""
+        with self._lock:
+            return {
+                name: digest.snapshot()
+                for name, digest in sorted(self._digests.items())
+            }
+
+    def compact(self) -> dict:
+        """{phase: {p50_s, p99_s, n}} — the small form stamped into
+        ``/v1/status`` and StepTelemetry records."""
+        with self._lock:
+            return {
+                name: {
+                    "p50_s": round(digest.percentile(0.50), 6),
+                    "p99_s": round(digest.percentile(0.99), 6),
+                    "n": digest.count,
+                }
+                for name, digest in sorted(self._digests.items())
+            }
+
+    def watermark(self) -> dict | None:
+        """Device-memory watermark when sampling is enabled and the
+        backend exposes it; None otherwise (CPU-safe no-op)."""
+        if not self.memory:
+            return None
+        return memory_watermark()
+
+
+# ---------------------------------------------------------------------------
+# module-level context helpers
+# ---------------------------------------------------------------------------
+
+
+def active_profiler() -> PhaseProfiler | None:
+    """The profiler activated on this thread/context, or None."""
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Attribute the block to ``name`` on the contextvar-active
+    profiler; a cheap no-op when none is active — library code
+    (reconcilers, checkpoint helpers) instruments unconditionally."""
+    prof = _ACTIVE.get()
+    if prof is None:
+        yield
+        return
+    with prof.phase(name):
+        yield
+
+
+def active_digest() -> dict | None:
+    """Compact digest of the active profiler (for StepTelemetry's
+    per-step stamp), or None outside an activation / before any
+    phase landed."""
+    prof = _ACTIVE.get()
+    if prof is None:
+        return None
+    digest = prof.compact()
+    return digest or None
+
+
+# ---------------------------------------------------------------------------
+# device-memory watermarks
+# ---------------------------------------------------------------------------
+
+# One probe decides availability for the process lifetime: CPU
+# backends (and processes without jax) must not re-pay an import or an
+# exception per hot-loop snapshot.
+_MEM_PROBE_LOCK = threading.Lock()
+_MEM_DEVICES: list | None = None
+_MEM_PROBED = False
+
+
+def _probe_devices() -> list | None:
+    global _MEM_DEVICES, _MEM_PROBED
+    with _MEM_PROBE_LOCK:
+        if _MEM_PROBED:
+            return _MEM_DEVICES
+        _MEM_PROBED = True
+        _MEM_DEVICES = None
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # analysis: allow[py-broad-except]
+            # No jax (control-plane process) or no initialized backend:
+            # the watermark is simply unavailable here.
+            return None
+        for device in devices:
+            stats_fn = getattr(device, "memory_stats", None)
+            if stats_fn is None:
+                return None
+            try:
+                if not stats_fn():
+                    return None  # CPU: None or {} — no watermark story
+            except Exception:  # analysis: allow[py-broad-except]
+                return None
+        _MEM_DEVICES = list(devices)
+        return _MEM_DEVICES
+
+
+def memory_watermark(devices: list | None = None) -> dict | None:
+    """Summed HBM usage across local devices via ``memory_stats()``:
+    ``{"devices", "bytes_in_use", "peak_bytes_in_use", "bytes_limit"}``
+    (keys omitted when the backend doesn't report them). Returns None
+    where stats are unavailable (CPU, no jax) — the documented no-op
+    fallback. ``devices`` is injectable for tests."""
+    if devices is None:
+        devices = _probe_devices()
+    if not devices:
+        return None
+    # One memory_stats() runtime call per device (not per key): this
+    # runs on hot-path snapshots, and per-device stats should be read
+    # from ONE consistent snapshot anyway.
+    per_device: list[dict] = []
+    for device in devices:
+        try:
+            per_device.append(device.memory_stats() or {})
+        except Exception:  # analysis: allow[py-broad-except]
+            return None  # a device went away: no partial answers
+    out: dict = {"devices": len(devices)}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        values = [int(s[key]) for s in per_device if key in s]
+        if values:
+            out[key] = sum(values)
+    return out if len(out) > 1 else None
+
+
+def process_watermark() -> dict | None:
+    """:func:`memory_watermark` gated on ``KFT_PROFILE_MEMORY`` — the
+    same kill switch :meth:`PhaseProfiler.watermark` honors, for
+    handlers (the manager's ``/debug/profile``) that hold no profiler
+    with the flag baked in."""
+    if not env_bool("KFT_PROFILE_MEMORY", True):
+        return None
+    return memory_watermark()
+
+
+def reset_memory_probe() -> None:
+    """Forget the cached availability verdict (tests re-probe with
+    injected devices; a real process never needs this)."""
+    global _MEM_DEVICES, _MEM_PROBED
+    with _MEM_PROBE_LOCK:
+        _MEM_DEVICES = None
+        _MEM_PROBED = False
+
+
+# ---------------------------------------------------------------------------
+# overhead measurement
+# ---------------------------------------------------------------------------
+
+
+def measure_overhead_s(iterations: int = 2000) -> float:
+    """Mean seconds one ``phase()`` record costs on this host (enter +
+    clock pair + locked digest append + scope accumulate). The bench
+    smoke compares this against the measured decode-phase p50 to hold
+    the <2% hot-path overhead budget."""
+    iterations = max(1, int(iterations))
+    profiler = PhaseProfiler(window=64, memory=False)
+    with profiler.activate():
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            with profiler.phase("overhead-probe"):
+                pass
+        elapsed = time.perf_counter() - t0
+    return elapsed / iterations

@@ -35,10 +35,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import time
 from collections import deque
 from typing import Callable
+
+from kubeflow_tpu.obs.envknob import env_number
 
 log = logging.getLogger(__name__)
 
@@ -84,22 +85,12 @@ class Objective:
         return max(1.0 - float(self.target), 1e-9)
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
-
-
 def tunable(slug: str, knob: str, default: float) -> float:
     """Env override for a default objective's knob:
     ``KFT_SLO_<SLUG>_<KNOB>`` (slug upper-cased, ``-`` -> ``_``) —
     e.g. ``KFT_SLO_RECONCILE_DURATION_TARGET=0.999``."""
     env = f"KFT_SLO_{slug.upper().replace('-', '_')}_{knob.upper()}"
-    return _env_float(env, default)
+    return env_number(env, default)
 
 
 # ---------------------------------------------------------------------------

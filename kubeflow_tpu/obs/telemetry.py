@@ -24,7 +24,23 @@ import contextlib
 import os
 import threading
 import time
+from collections import deque
 from typing import Callable
+
+from kubeflow_tpu.obs.envknob import env_number
+from kubeflow_tpu.obs.profile import active_digest
+
+# In-memory record retention: a forever-running trainer must not grow
+# the list without bound (the py-unbounded-deque discipline); 4096
+# steps is far past what any aggregation here reads.
+_RECORDS_MAX = 4096
+
+
+def _records_max_from_env() -> int:
+    """OBS_STEP_RECORDS_MAX, defaulting (not crashing) on malformed
+    or non-positive values — the shared obs env-parser contract."""
+    return env_number("OBS_STEP_RECORDS_MAX", _RECORDS_MAX,
+                      cast=int, minimum=1)
 
 
 class StepTelemetry:
@@ -52,7 +68,10 @@ class StepTelemetry:
         self._clock = clock
         self._lock = threading.Lock()
         self._step = 0
-        self.records: list[dict] = []
+        self.observed = 0
+        self.records: deque = deque(
+            maxlen=_records_max_from_env()
+        )
         self._gauges = self._make_gauges(registry)
         # One JSONL discipline for the whole obs package: the sink IS
         # a JsonlExporter (guarded makedirs, locked appends); only the
@@ -124,7 +143,16 @@ class StepTelemetry:
             "device": self.device_kind,
             **extra,
         }
+        if "phases" not in record:
+            # Zero-flag phase attribution: when this observe runs
+            # inside a PhaseProfiler activation (run_with_checkpointing
+            # with a profiler plugged in), the live per-phase digest
+            # rides the same per-step JSONL record bench already reads.
+            digest = active_digest()
+            if digest is not None:
+                record["phases"] = digest
         with self._lock:
+            self.observed += 1
             self.records.append(record)
         if self._gauges is not None:
             self._gauges["step_time"].set(step_time_s)
@@ -152,9 +180,12 @@ class StepTelemetry:
     # ---- aggregation -----------------------------------------------------
     def summary(self) -> dict:
         """Median-of-steps aggregate (first step excluded when there is
-        more than one — it carries compile/dispatch warmup)."""
+        more than one — it carries compile/dispatch warmup). ``steps``
+        counts every observed step; the percentile window is the
+        retained ring (bounded, OBS_STEP_RECORDS_MAX)."""
         with self._lock:
             records = list(self.records)
+            observed = self.observed
         if not records:
             return {"steps": 0}
         steady = records[1:] if len(records) > 1 else records
@@ -163,7 +194,7 @@ class StepTelemetry:
         batch = steady[-1]["batch_size"]
         examples = batch / mid
         return {
-            "steps": len(records),
+            "steps": observed,
             "median_step_time_s": round(mid, 6),
             "examples_per_sec": round(examples, 3),
             "mfu": round(

@@ -28,6 +28,7 @@ import random
 import re
 import threading
 import time
+from collections import deque
 from typing import Callable
 
 # Metadata annotation carrying a traceparent value across the async
@@ -101,6 +102,13 @@ class Span:
     """One named interval. Mutate only before :meth:`end` (the tracer's
     context manager ends it); ``to_dict`` is the export form."""
 
+    # OTel's default span-event cap: a span held open across a long
+    # incident (a watch loop, a stuck reconcile) must not accumulate
+    # events without bound. The OLDEST events are evicted (and
+    # counted) — the tail leading into a failure is the forensic
+    # window worth keeping.
+    MAX_EVENTS = 128
+
     def __init__(
         self,
         name: str,
@@ -114,7 +122,8 @@ class Span:
         self.context = context
         self.parent_id = parent_id
         self.attributes: dict = dict(attributes or {})
-        self.events: list[dict] = []
+        self.events: deque = deque(maxlen=self.MAX_EVENTS)
+        self.dropped_events = 0
         self.status = "ok"
         self.start_time = clock()
         self.end_time: float | None = None
@@ -128,6 +137,8 @@ class Span:
         return self
 
     def add_event(self, name: str, attributes: dict | None = None) -> "Span":
+        if len(self.events) == self.MAX_EVENTS:
+            self.dropped_events += 1  # the append below evicts the oldest
         self.events.append({
             "name": name,
             "time": self._clock(),
@@ -163,6 +174,8 @@ class Span:
             "status": self.status,
             "attributes": dict(self.attributes),
             "events": list(self.events),
+            **({"dropped_events": self.dropped_events}
+               if self.dropped_events else {}),
         }
 
 

@@ -72,6 +72,8 @@ from kubeflow_tpu.models.serving import (
 from kubeflow_tpu.models.speculative import NGramProposer
 from kubeflow_tpu.models.transformer import LMConfig
 from kubeflow_tpu.obs.metrics import BucketHistogram
+from kubeflow_tpu.obs.profile import PhaseProfiler
+from kubeflow_tpu.obs.recorder import FlightRecorder
 
 log = logging.getLogger(__name__)
 
@@ -203,7 +205,8 @@ class _EngineBase:
     ``_rid``); everything else belongs to the scheduler thread alone
     and is never written under the lock."""
 
-    def __init__(self, max_pending: int = 64):
+    def __init__(self, max_pending: int = 64, profiler=None,
+                 recorder=None):
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
         self.max_pending = max_pending
@@ -213,6 +216,62 @@ class _EngineBase:
         self._pending_count = 0
         self._pending_params: Any | None = None
         self._rid = 0
+        # Continuous profiling + black-box capture (PR 10): per-phase
+        # digests behind /v1/status + /debug/profile, and a bounded
+        # snapshot ring the SLO engine dumps when a burn-rate alert
+        # fires. Both are scheduler-thread writers with handler-thread
+        # readers — each is internally locked for exactly that.
+        self.profiler = profiler if profiler is not None else \
+            PhaseProfiler()
+        self.recorder = recorder if recorder is not None else \
+            FlightRecorder()
+        # Exposition-side histograms (inference_batch_cycle_seconds
+        # {phase}). The FULL phase set is pre-created: the collector
+        # iterates this dict from scrape-handler threads while the
+        # scheduler observes, so the dict must never resize after
+        # construction (verify/commit simply stay at zero outside
+        # speculative mode).
+        self.cycle_seconds = {
+            "admit": BucketHistogram(),
+            "prefill": BucketHistogram(),
+            "decode": BucketHistogram(),
+            "verify": BucketHistogram(),
+            "commit": BucketHistogram(),
+        }
+        # Live gauges the collector renders: slots occupied after the
+        # last cycle / total decode slots (the fallback engine reports
+        # 0-or-1 of 1).
+        self.occupancy = 0
+        self.slots_total = 0
+        self.cycles_total = 0
+
+    def _observe_phase(self, name: str, seconds: float) -> None:
+        """One cycle phase into both views of the distribution: the
+        Prometheus-rendered BucketHistogram family and the profiler's
+        rolling percentile digest (plus the active cycle scope). An
+        unknown phase name skips the histogram rather than resizing
+        the dict under a concurrently-iterating collector."""
+        hist = self.cycle_seconds.get(name)
+        if hist is not None:
+            hist.observe(seconds)
+        self.profiler.observe(name, seconds)
+
+    def _record_cycle(self, phases: dict, queue_depth: int) -> None:
+        """One flight-recorder snapshot per working cycle: this cycle's
+        phase split, batch occupancy, queue depth and — when the
+        backend exposes it — the device-memory watermark."""
+        if self.recorder is None or not phases:
+            return
+        self.cycles_total += 1
+        self.recorder.record(
+            "serve_cycle",
+            cycle=self.cycles_total,
+            phases={k: round(v, 6) for k, v in phases.items()},
+            occupancy=self.occupancy,
+            slots=self.slots_total,
+            queue_depth=queue_depth,
+            memory=self.profiler.watermark(),
+        )
 
     def _enqueue(self, req: dict) -> int:
         """Admit ``req`` to the inbox (or shed). Called from HTTP
@@ -302,11 +361,14 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                  prefix_cache_size: int = 8,
                  prefill_chunk_tokens: int | None = None,
                  spec_ngram: bool = False, spec_draft: int = 8,
-                 spec_ngram_n: int = 3, spec_lookback: int = 4096):
+                 spec_ngram_n: int = 3, spec_lookback: int = 4096,
+                 profiler=None, recorder=None):
         ContinuousBatcher.__init__(
             self, cfg, params, max_batch, max_len, eos_token=eos_token,
             step_chunk=step_chunk, quantize_cache=quantize_cache)
-        _EngineBase.__init__(self, max_pending=max_pending)
+        _EngineBase.__init__(self, max_pending=max_pending,
+                             profiler=profiler, recorder=recorder)
+        self.slots_total = max_batch
         if prefill_per_cycle < 1:
             raise ValueError("prefill_per_cycle must be >= 1")
         if spec_ngram and self.rolling:
@@ -369,10 +431,6 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
         # spliceable per-prefix — the cache is simply off.
         self.prefix_cache = (None if self.rolling
                              else PrefixCache(prefix_cache_size))
-        self.cycle_seconds = {
-            "prefill": BucketHistogram(),
-            "decode": BucketHistogram(),
-        }
         if not self.rolling:
             self._prefill_keep = jax.jit(
                 lambda params, state, slot, prompt, temp, key:
@@ -428,36 +486,58 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
         once in-flight slots drained, admit up to
         ``prefill_per_cycle`` prompts, then one decode chunk for every
         active slot. Returns False when fully idle (nothing queued,
-        staged or active)."""
+        staged or active). Each working cycle lands one flight-recorder
+        snapshot with its phase split, occupancy and queue depth."""
+        with self.profiler.activate() as phases:
+            worked = self._cycle()
+        self.occupancy = sum(1 for s in self._slots if s is not None)
+        if worked:
+            self._record_cycle(phases, self.pending())
+        return worked
+
+    def _cycle(self) -> bool:
+        # admit = actual inbox-drain work. Only observed when requests
+        # moved: the idle scheduler polls ~50x/s, and microsecond
+        # no-op drains would otherwise drown the digest window and the
+        # {phase="admit"} histogram in idle noise.
+        admit_started = time.monotonic()
+        admitted = False
         for req in self._take_inbox():
             self._queue.append(req)
+            admitted = True
+        if admitted:
+            self._observe_phase("admit",
+                                time.monotonic() - admit_started)
         staged = self._staged_params()
         if staged is not None:
             self.draining = True
             if not any(s is not None for s in self._slots):
                 from kubeflow_tpu.models.decoding import fuse_qkv_params
 
-                # Same rule as construction: precompute the fused qkv
-                # weights once per params version, not per dispatch.
-                self.params = fuse_qkv_params(
-                    self.cfg, staged, rows=len(self._slots))
-                self._consume_staged(staged)
-                if self.prefix_cache is not None:
-                    # Cached KV was computed by the OLD weights; mixing
-                    # it with new weights would serve silent garbage.
-                    self.prefix_cache.clear()
-                if self._partial is not None:
-                    # Same staleness: the partial's chunks ran under
-                    # the old weights — restart its prefill from token
-                    # zero under the new ones.
-                    self._restart_partial()
-                self.swaps_total += 1
-                self.draining = False
+                with self.profiler.phase("swap"):
+                    # Same rule as construction: precompute the fused
+                    # qkv weights once per params version, not per
+                    # dispatch.
+                    self.params = fuse_qkv_params(
+                        self.cfg, staged, rows=len(self._slots))
+                    self._consume_staged(staged)
+                    if self.prefix_cache is not None:
+                        # Cached KV was computed by the OLD weights;
+                        # mixing it with new weights would serve silent
+                        # garbage.
+                        self.prefix_cache.clear()
+                    if self._partial is not None:
+                        # Same staleness: the partial's chunks ran under
+                        # the old weights — restart its prefill from
+                        # token zero under the new ones.
+                        self._restart_partial()
+                    self.swaps_total += 1
+                    self.draining = False
         else:
             started = time.monotonic()
             if self._admit_capped():
-                self.cycle_seconds["prefill"].observe(
-                    time.monotonic() - started)
+                self._observe_phase("prefill",
+                                    time.monotonic() - started)
         if not any(s is not None for s in self._slots):
             with self._lock:
                 busy = (bool(self._queue) or bool(self._inbox)
@@ -478,7 +558,7 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
                     self._results[req["id"]].append(token)
                     self._emit(req, {"token": token})
                     self._check_done(req, token)
-        self.cycle_seconds["decode"].observe(time.monotonic() - started)
+        self._observe_phase("decode", time.monotonic() - started)
         for slot, req in enumerate(self._slots):
             if req is not None and req["done"]:
                 self._finish(req)
@@ -497,6 +577,7 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
         repetition still emit >= 1 token per cycle (rejection-free)."""
         from kubeflow_tpu.models.serving import slice_step_keys
 
+        verify_started = time.monotonic()
         t = self.spec_draft + 1
         rows, key_cols, drafts = [], [], []
         dummy_keys = jnp.broadcast_to(self._dummy_key, (t,))
@@ -528,6 +609,9 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
         self.state, cand = self._verify(self.params, self.state,
                                         tokens, keys)
         cand = jax.device_get(cand)  # (B, t)
+        # verify = draft build + the batched scoring dispatch (host-
+        # synced); the accept/emit loop below rides the decode total.
+        self._observe_phase("verify", time.monotonic() - verify_started)
         accepted = [0] * len(self._slots)
         lasts = [0] * len(self._slots)
         self.spec_verifies_total += 1
@@ -557,9 +641,11 @@ class StreamingBatcher(_EngineBase, ContinuousBatcher):
             # cut short by eos/budget (emitted == match + 1); a
             # truncated cycle emitted matching drafts only.
             self.spec_accepted_total += min(emitted, match)
+        commit_started = time.monotonic()
         self.state = self._commit(
             self.state, jnp.asarray(accepted, jnp.int32),
             jnp.asarray(lasts, jnp.int32))
+        self._observe_phase("commit", time.monotonic() - commit_started)
 
     def _admit_capped(self) -> int:
         admitted = 0
@@ -771,8 +857,10 @@ class GenerateFallbackEngine(_EngineBase):
     spec_ngram = False
 
     def __init__(self, cfg: LMConfig, params, max_len: int,
-                 eos_token: int | None = None, max_pending: int = 64):
-        super().__init__(max_pending=max_pending)
+                 eos_token: int | None = None, max_pending: int = 64,
+                 profiler=None, recorder=None):
+        super().__init__(max_pending=max_pending, profiler=profiler,
+                         recorder=recorder)
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
@@ -781,10 +869,7 @@ class GenerateFallbackEngine(_EngineBase):
         self.draining = False
         self.prefix_cache = None
         self._backlog: deque = deque()
-        self.cycle_seconds = {
-            "prefill": BucketHistogram(),
-            "decode": BucketHistogram(),
-        }
+        self.slots_total = 1  # serialized: one request "slot" at a time
 
     def submit_stream(self, prompt, sink: Sink,
                       max_new_tokens: int = 128,
@@ -802,8 +887,23 @@ class GenerateFallbackEngine(_EngineBase):
         return self._enqueue(req)
 
     def step_cycle(self) -> bool:
+        with self.profiler.activate() as phases:
+            worked = self._cycle()
+        if worked:
+            self._record_cycle(phases, self.pending())
+        return worked
+
+    def _cycle(self) -> bool:
+        # Same idle-noise rule as the batcher: admit observed only
+        # when the drain moved requests.
+        admit_started = time.monotonic()
+        admitted = False
         for req in self._take_inbox():
             self._backlog.append(req)
+            admitted = True
+        if admitted:
+            self._observe_phase("admit",
+                                time.monotonic() - admit_started)
         staged = self._staged_params()
         if staged is not None:
             # No slots to drain: between requests IS drained.
@@ -811,9 +911,11 @@ class GenerateFallbackEngine(_EngineBase):
             self._consume_staged(staged)
             self.swaps_total += 1
         if not self._backlog:
+            self.occupancy = 0
             return False
         req = self._backlog.popleft()
         self._note_admitted()
+        self.occupancy = 1
         started = time.monotonic()
         from kubeflow_tpu.models.decoding import generate
 
@@ -824,13 +926,14 @@ class GenerateFallbackEngine(_EngineBase):
         tokens = [int(t) for t in jax.device_get(out[0])]
         if self.eos is not None and self.eos in tokens:
             tokens = tokens[: tokens.index(self.eos) + 1]
-        self.cycle_seconds["decode"].observe(time.monotonic() - started)
+        self._observe_phase("decode", time.monotonic() - started)
         for token in tokens:
             self._emit(req, {"token": token})
         reason = ("eos" if (self.eos is not None and tokens
                             and tokens[-1] == self.eos) else "length")
         self._emit(req, {"done": True, "reason": reason,
                          "tokens": tokens, "cache_hit": False})
+        self.occupancy = 0
         return True
 
     def drain(self, max_cycles: int = 10_000) -> None:

@@ -53,12 +53,14 @@ from prometheus_client import (
 )
 from prometheus_client.core import (
     CounterMetricFamily,
+    GaugeMetricFamily,
     HistogramMetricFamily,
 )
 from prometheus_client.openmetrics import exposition as om_exposition
 
 from kubeflow_tpu import obs
 from kubeflow_tpu.obs import slo as obs_slo
+from kubeflow_tpu.obs.envknob import env_bool
 from kubeflow_tpu.obs.metrics import LATENCY_BUCKETS, REQUEST_BUCKETS
 from kubeflow_tpu.serving.engine import QueueFull, Scheduler
 
@@ -93,14 +95,32 @@ class EngineCollector:
         )
         fam = HistogramMetricFamily(
             "inference_batch_cycle_seconds",
-            "Scheduler cycle wall time by phase (prefill = admissions "
-            "this cycle, decode = one step_chunk dispatch + trim)",
+            "Scheduler cycle wall time by phase (admit = inbox drain, "
+            "prefill = admissions this cycle, decode = one step_chunk "
+            "dispatch + trim, verify/commit = speculative sub-steps)",
             labels=["phase"],
         )
         for phase, hist in sorted(self.engine.cycle_seconds.items()):
             snap = hist.snapshot()
             fam.add_metric([phase], buckets=snap["buckets"],
                            sum_value=snap["sum"])
+        yield fam
+        # Batch occupancy: how full the decode batch ran after the
+        # last cycle — the denominator pair for "is the fleet
+        # under-batched or queue-bound" next to inference_queue_depth.
+        fam = GaugeMetricFamily(
+            "inference_slots_active",
+            "Decode slots occupied after the most recent scheduler "
+            "cycle",
+        )
+        fam.add_metric([], getattr(self.engine, "occupancy", 0))
+        yield fam
+        fam = GaugeMetricFamily(
+            "inference_slots_total",
+            "Decode slots this engine batches over (1 for the "
+            "serialized fallback)",
+        )
+        fam.add_metric([], getattr(self.engine, "slots_total", 0))
         yield fam
 
 
@@ -168,13 +188,16 @@ class GatewayMetrics:
         return generate_latest(self.registry)
 
 
-def make_gateway_slo_engine(metrics: GatewayMetrics, clock=None):
+def make_gateway_slo_engine(metrics: GatewayMetrics, clock=None,
+                            recorder=None):
     """Serving SLO set (obs.slo defaults; KFT_SLO_* env tunes):
     first-token latency and inter-token latency over the gateway's own
-    histograms."""
+    histograms. With a ``recorder`` (the engine's FlightRecorder), any
+    alert going firing dumps the cycle-snapshot ring — the black-box
+    window leading up to the burn."""
     kwargs = {"clock": clock} if clock is not None else {}
     evaluator = obs_slo.BurnRateEvaluator(**kwargs)
-    engine = obs.SloEngine(evaluator=evaluator)
+    engine = obs.SloEngine(evaluator=evaluator, recorder=recorder)
     engine.register(obs_slo.ttft_objective(metrics.ttft))
     engine.register(obs_slo.itl_objective(metrics.itl))
     return engine
@@ -207,7 +230,8 @@ class InferenceGateway:
                  retry_after_s: float = 1.0,
                  reload_fn=None,
                  stream_timeout_s: float = 120.0,
-                 slo=_DEFAULT_SLO):
+                 slo=_DEFAULT_SLO,
+                 enable_debug: bool | None = None):
         self.engine = engine
         self.metrics = GatewayMetrics(engine)
         self.scheduler = Scheduler(engine)
@@ -217,10 +241,20 @@ class InferenceGateway:
         # Serving-side SLOs (PR 9): burn-rate objectives over the
         # gateway's own TTFT/ITL histograms, surfaced in /v1/status and
         # ticked by scrapes/status reads. Injectable for deterministic
-        # tests; an explicit None disables the layer.
+        # tests; an explicit None disables the layer. The engine's
+        # flight recorder rides along (PR 10) so a TTFT/ITL alert going
+        # firing dumps the cycle ring automatically.
         if slo is _DEFAULT_SLO:
-            slo = make_gateway_slo_engine(self.metrics)
+            slo = make_gateway_slo_engine(
+                self.metrics,
+                recorder=getattr(engine, "recorder", None))
         self.slo = slo
+        # /debug/profile + /debug/flightrecord expose live phase
+        # digests and the snapshot ring; like the manager's pprof-role
+        # endpoints they are strictly opt-in (same env gate).
+        if enable_debug is None:
+            enable_debug = env_bool("KFT_ENABLE_DEBUG_ENDPOINTS")
+        self.enable_debug = bool(enable_debug)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -269,6 +303,24 @@ class InferenceGateway:
                     self.wfile.write(body)
                 elif path == "/v1/status":
                     self._json(200, outer.status())
+                elif path == "/debug/profile" and outer.enable_debug:
+                    # Full per-phase digests (window percentiles, max,
+                    # totals) of the engine's scheduler cycles.
+                    profiler = getattr(outer.engine, "profiler", None)
+                    if profiler is None:
+                        self._json(404, {"error": "no profiler"})
+                    else:
+                        self._json(200, {
+                            "engine": profiler.snapshot(),
+                            "memory": profiler.watermark(),
+                        })
+                elif (path == "/debug/flightrecord"
+                      and outer.enable_debug):
+                    recorder = getattr(outer.engine, "recorder", None)
+                    if recorder is None:
+                        self._json(404, {"error": "no flight recorder"})
+                    else:
+                        self._json(200, recorder.to_dict())
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -296,10 +348,31 @@ class InferenceGateway:
             "batched": bool(getattr(self.engine, "batched", False)),
             "draining": bool(getattr(self.engine, "draining", False)),
             "swaps": int(getattr(self.engine, "swaps_total", 0)),
+            "slots": {
+                "active": int(getattr(self.engine, "occupancy", 0)),
+                "total": int(getattr(self.engine, "slots_total", 0)),
+            },
         }
+        # Tick the SLO engine BEFORE snapshotting the flightrecord
+        # block: this very read can flip an alert to firing and dump
+        # the ring, and the response that triggered the dump must
+        # report it (the QPS harness reads /v1/status exactly once).
         if self.slo is not None:
             self.slo.tick()
             doc["slo"] = self.slo.status()
+        # Compact cycle-phase digest (admit/prefill/decode/...):
+        # p50/p99/n per phase — the block the QPS harness folds into
+        # its summary line so bench trajectory sees phase regressions.
+        profiler = getattr(self.engine, "profiler", None)
+        if profiler is not None:
+            doc["profile"] = profiler.compact()
+        recorder = getattr(self.engine, "recorder", None)
+        if recorder is not None:
+            doc["flightrecord"] = {
+                "ring": len(recorder),
+                "dumps": recorder.dumps_total,
+                "last_dump_path": recorder.last_dump_path,
+            }
         return doc
 
     def start(self) -> "InferenceGateway":

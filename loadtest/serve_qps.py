@@ -179,19 +179,24 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
     }
 
 
-def fetch_slo_status(url: str, timeout: float) -> dict | None:
-    """The gateway's SLO block from ``/v1/status``, condensed to one
-    row per objective (fast/slow burn + alert state). Best-effort:
-    an older gateway (no slo block) or a dead endpoint returns None —
-    the load numbers still print."""
+def fetch_status(url: str, timeout: float) -> dict | None:
+    """One ``/v1/status`` read after the load (the call also ticks the
+    gateway's SLO engine, so the run's own observations are what gets
+    judged). Best-effort: a dead endpoint returns None — the load
+    numbers still print."""
     try:
         with urllib.request.urlopen(url + "/v1/status",
                                     timeout=timeout) as response:
-            doc = json.loads(response.read().decode())
+            return json.loads(response.read().decode())
     # analysis: allow[py-broad-except] — optional read-back, None is the answer
     except Exception:
         return None
-    slo = doc.get("slo")
+
+
+def condense_slo(doc: dict | None) -> dict | None:
+    """The status doc's SLO block condensed to one row per objective
+    (fast/slow burn + alert state); None on an older gateway."""
+    slo = (doc or {}).get("slo")
     if not isinstance(slo, dict):
         return None
     return {
@@ -200,6 +205,50 @@ def fetch_slo_status(url: str, timeout: float) -> dict | None:
             "states": row.get("states", {}),
         }
         for name, row in (slo.get("objectives") or {}).items()
+    }
+
+
+def fetch_slo_status(url: str, timeout: float) -> dict | None:
+    """Back-compat shim: condensed SLO block straight off the wire."""
+    return condense_slo(fetch_status(url, timeout))
+
+
+def cycle_profile(doc: dict | None) -> dict | None:
+    """The engine's cycle-phase digest from the status doc (PR 10):
+    ``{phase: {p50_s, p99_s, n}}`` for admit / prefill / decode (+
+    verify / commit in speculative mode) — bench trajectory captures
+    *which phase* regressed, not just end-to-end TTFT/ITL."""
+    profile = (doc or {}).get("profile")
+    if isinstance(profile, dict) and profile:
+        return profile
+    return None
+
+
+def profiler_overhead(profile: dict | None) -> dict | None:
+    """Measured profiler cost against the decode hot path: the mean
+    seconds ONE phase record costs on this host (clock pair + locked
+    digest append + scope accumulate, measured with the profiler both
+    on and exercising — :func:`obs.profile.measure_overhead_s`) times
+    the records a working cycle makes (one per phase + the activation
+    scope), as a fraction of the measured decode-phase p50. The
+    acceptance budget is <2%; the smoke test asserts it. Valid only
+    when the gateway runs on this host (the in-process mode) — the
+    caller skips it for remote ``--url`` targets."""
+    if not profile or "decode" not in profile:
+        return None
+    decode_p50 = float(profile["decode"].get("p50_s") or 0.0)
+    if decode_p50 <= 0:
+        return None
+    from kubeflow_tpu.obs.profile import measure_overhead_s
+
+    per_record = measure_overhead_s()
+    records_per_cycle = len(profile) + 1  # + the activation scope
+    return {
+        "per_record_s": round(per_record, 9),
+        "records_per_cycle": records_per_cycle,
+        "decode_p50_s": decode_p50,
+        "frac_of_decode": round(
+            per_record * records_per_cycle / decode_p50, 6),
     }
 
 
@@ -272,10 +321,20 @@ def main(argv=None) -> dict:
     try:
         summary = run_load(url, prompts, args.clients, args.requests,
                            args.max_new, args.timeout)
-        # Read the burn-rate verdict AFTER the load: the status call
-        # also ticks the gateway's SLO engine, so the run's own TTFT
-        # and inter-token observations are what gets judged.
-        summary["slo"] = fetch_slo_status(url, args.timeout)
+        # Read the burn-rate verdict AND the cycle-phase digest AFTER
+        # the load: the status call also ticks the gateway's SLO
+        # engine, so the run's own TTFT and inter-token observations
+        # are what gets judged.
+        status_doc = fetch_status(url, args.timeout)
+        summary["slo"] = condense_slo(status_doc)
+        summary["cycle_profile"] = cycle_profile(status_doc)
+        # Only meaningful for the in-process gateway: measure_overhead_s
+        # runs on THIS host, so against a remote --url target the
+        # fraction would mix client-side record cost with server-side
+        # decode time — a number describing neither machine.
+        summary["profiler_overhead"] = (
+            profiler_overhead(summary["cycle_profile"])
+            if gateway is not None else None)
     finally:
         if gateway is not None:
             gateway.stop()

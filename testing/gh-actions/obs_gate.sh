@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# Observability gate for CI (PR 3; SLO layer PR 9). Four checks:
+# Observability gate for CI (PR 3; SLO layer PR 9; profiling +
+# flight recorder PR 10). Five checks:
 #
 # 1. Exposition integrity: every platform registry (controller-manager,
 #    jupyter CRUD app, dashboard) must parse cleanly with
@@ -13,18 +14,27 @@
 #    observation made under a span must surface its trace id as a
 #    bucket exemplar.
 #
-# 3. Log discipline: the obs/resilience/slo tier-1 subset (including
-#    ALL of tests/test_slo.py — burn-rate math, alert hysteresis,
-#    exemplar round-trips, /fleet + /debug/alerts schemas, the chaos
-#    blackout acceptance arc) runs with testing/obs_log_plugin.py
-#    attached; any kubeflow_tpu.* record that the structured JSON
-#    formatter cannot render with the schema core (ts/level/logger/
-#    msg) fails the gate. Pairs with the analyzer's py-print-in-lib
-#    rule: prints never reach loggers, so the two checks together
-#    cover both escape routes.
+# 3. Alert-triggered black-box dump: a seeded chaos blackout must
+#    deterministically produce a firing burn-rate alert AND a
+#    flight-recorder JSONL artifact whose snapshots carry per-phase
+#    durations, queue depth, and a trace id that resolves in the
+#    tracer ring.
 #
-# 4. Analysis: kubeflow_tpu/obs/ holds ZERO findings under every pack
-#    (no pragma budget, no baseline entries for the package).
+# 4. Log discipline: the obs/resilience/slo/profile tier-1 subset
+#    (including ALL of tests/test_slo.py — burn-rate math, alert
+#    hysteresis, exemplar round-trips, /fleet + /debug/alerts schemas,
+#    the chaos blackout acceptance arc — and ALL of
+#    tests/test_profile.py — digest math, recorder ring + dumps,
+#    /debug/profile + /debug/flightrecord, the alert-dump acceptance)
+#    runs with testing/obs_log_plugin.py attached; any kubeflow_tpu.*
+#    record that the structured JSON formatter cannot render with the
+#    schema core (ts/level/logger/msg) fails the gate. Pairs with the
+#    analyzer's py-print-in-lib rule: prints never reach loggers, so
+#    the two checks together cover both escape routes.
+#
+# 5. Analysis: kubeflow_tpu/obs/ holds ZERO findings under every pack
+#    (no pragma budget, no baseline entries for the package —
+#    including PR 10's py-unbounded-deque rule).
 set -euo pipefail
 
 cd "$(dirname "$0")/../.."
@@ -109,6 +119,82 @@ if exemplars[0].labels.get("trace_id") != span.context.trace_id:
 print(f"  manager: {len(families)} families ok, exemplar round-trips")
 PY
 
+echo "== obs gate: alert-triggered flight-recorder dump =="
+python - <<'PY'
+import json
+import os
+import tempfile
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule
+from kubeflow_tpu.controllers.manager import make_default_slo_engine
+from kubeflow_tpu.controllers.metrics import ControllerMetrics
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.k8s.core import ApiError
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.obs.recorder import FlightRecorder
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+        return self.t
+
+
+tracer = obs.Tracer(sample_rate=1.0)
+obs.set_tracer(tracer)
+fake = FakeApiServer()
+fake.create({
+    "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+    "metadata": {"name": "victim", "namespace": "chaos-ns"},
+    "spec": {"template": {"spec": {"containers": [
+        {"name": "victim", "image": "jupyter-jax-tpu"}]}}},
+})
+clk = Clock()
+proxy = ChaosApiServer(fake, FaultSchedule(seed=5).blackout(50, 120),
+                       sleep=lambda s: None)
+workdir = tempfile.mkdtemp(prefix="obs-gate-flight-")
+recorder = FlightRecorder(capacity=64, dump_dir=workdir, clock=clk)
+prom = ControllerMetrics()
+engine = make_default_slo_engine(prom, proxy, clock=clk,
+                                 recorder=recorder)
+ctrl = make_notebook_controller(fake, prom=prom)
+ctrl.recorder = recorder
+ctrl.run_once()
+for _ in range(24):
+    for _ in range(5):
+        try:
+            proxy.list("kubeflow.org/v1beta1", "Notebook")
+        except ApiError:
+            pass
+    engine.tick(clk.advance(30.0))
+assert engine.alerts.state_of("apiserver-availability", "fast") \
+    == "firing", "blackout never fired the fast-burn alert"
+assert recorder.dumps_total == 1, "firing transition did not dump"
+path = recorder.last_dump_path
+lines = [json.loads(line) for line in open(path, encoding="utf-8")]
+header, *snaps = lines
+assert header["kind"] == "flight_dump"
+assert "apiserver-availability" in header["reason"]
+reconciles = [s for s in snaps if s["kind"] == "reconcile"]
+assert reconciles, "dump carries no reconcile snapshots"
+ring_ids = {s["trace_id"] for s in tracer.ring.spans()}
+victim = next(s for s in reconciles if s["name"] == "victim")
+assert {"list", "desired-state", "patch", "status"} <= set(
+    victim["phases"]), victim["phases"]
+assert victim["queue_depth"] >= 0
+assert victim["trace_id"] in ring_ids, "trace id not in the ring"
+obs.set_tracer(None)
+print(f"  blackout -> firing -> {os.path.basename(path)}: "
+      f"{len(snaps)} snapshot(s), trace id resolves")
+PY
+
 echo "== obs gate: kubeflow_tpu/obs at zero analysis findings =="
 python - <<'PY'
 from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
@@ -130,6 +216,7 @@ REPORT="$(mktemp)"
 rm -f "$REPORT"
 KFT_OBS_LOG_REPORT="$REPORT" PYTHONPATH="testing${PYTHONPATH:+:$PYTHONPATH}" \
   python -m pytest tests/test_obs.py tests/test_resilience.py tests/test_slo.py \
+  tests/test_profile.py \
   -q -m 'not slow' -p obs_log_plugin
 
 if [[ -s "$REPORT" ]]; then

@@ -1,0 +1,33 @@
+"""Seeded violations: long-lived classes accumulating into sequences
+built unbounded in ``__init__`` — the flight-recorder-regression shape
+py-unbounded-deque exists for. Each buffer is appended to by a method
+and trimmed by none; in a process measured in uptime that is a leak."""
+
+from collections import deque
+
+
+class LeakyRecorder:
+    """A ring that isn't one: deque without maxlen."""
+
+    def __init__(self):
+        # Violation 1: deque() without maxlen, appended forever.
+        self.snapshots = deque()
+        self.count = 0
+
+    def record(self, snap):
+        self.snapshots.append(snap)
+        self.count += 1
+
+
+class LeakyTelemetry:
+    """Per-step records kept as a bare list."""
+
+    def __init__(self):
+        # Violation 2: [] accumulated per observe(), never trimmed.
+        self.records = []
+        # Violation 3: list() is the same leak spelled differently.
+        self.events = list()
+
+    def observe(self, record):
+        self.records.append(record)
+        self.events.extend(record.get("events", ()))

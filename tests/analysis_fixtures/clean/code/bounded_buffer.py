@@ -1,0 +1,59 @@
+"""Clean counterpart of unbounded_buffer.py: every accumulated buffer
+is bounded by construction (maxlen), guarded by an explicit length
+check, trimmed on append, or drained by a consumer method — and the
+one deliberately unbounded builder carries the allow-pragma."""
+
+from collections import deque
+
+
+class RingRecorder:
+    """The real flight-recorder shape: bounded by construction."""
+
+    def __init__(self, capacity: int = 256):
+        self.snapshots = deque(maxlen=capacity)
+
+    def record(self, snap):
+        self.snapshots.append(snap)
+
+
+class GuardedSpan:
+    """Length-guarded append: excess observations counted, not kept."""
+
+    MAX_EVENTS = 128
+
+    def __init__(self):
+        self.events = []
+        self.dropped = 0
+
+    def add_event(self, event):
+        if len(self.events) >= self.MAX_EVENTS:
+            self.dropped += 1
+            return
+        self.events.append(event)
+
+
+class DrainedInbox:
+    """Producer/consumer pair in one object: the drain IS the trim."""
+
+    def __init__(self):
+        self.inbox = []
+
+    def put(self, item):
+        self.inbox.append(item)
+
+    def take(self):
+        taken, self.inbox = self.inbox, []
+        return taken
+
+
+class BuilderSchedule:
+    """Builder-filled at construction time, bounded by the author's
+    scenario; the pragma documents the reasoning."""
+
+    def __init__(self):
+        # analysis: allow[py-unbounded-deque]
+        self.windows = []
+
+    def add(self, window):
+        self.windows.append(window)
+        return self

@@ -4,6 +4,7 @@ shared write is under its one lock, and the ``*_locked`` helper
 contract."""
 
 import threading
+from collections import deque
 
 
 class SingleThreaded:
@@ -11,7 +12,7 @@ class SingleThreaded:
 
     def __init__(self):
         self.cursor = 0
-        self.rows = []
+        self.rows = deque(maxlen=64)
 
     def advance(self):
         self.cursor += 1

@@ -310,6 +310,127 @@ class TestNonatomicWriteRule:
         ) == 1
 
 
+class TestUnboundedDequeRule:
+    """py-unbounded-deque: __init__-built sequences that only ever
+    grow gate; maxlen construction, length guards, trims, swap-drains
+    and pragma'd builders stay quiet (PR 10 — the flight-recorder ring
+    must never regress into a leak)."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-unbounded-deque",
+                  "unbounded_buffer.py")
+        assert sorted(f.line for f in hits) == [14, 27, 29]
+        assert all(f.severity == Severity.WARNING for f in hits)
+        messages = " | ".join(f.message for f in hits)
+        assert "deque() without maxlen" in messages
+        assert "maxlen" in hits[0].message
+
+    def _findings(self, source, path="kubeflow_tpu/obs/buffer.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-unbounded-deque"
+        ]
+
+    def test_maxlen_deque_is_clean(self):
+        src = (
+            "from collections import deque\n"
+            "class Ring:\n"
+            "    def __init__(self):\n"
+            "        self.ring = deque(maxlen=256)\n"
+            "    def record(self, s):\n"
+            "        self.ring.append(s)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_append_without_trim_fires(self):
+        src = (
+            "class Buf:\n"
+            "    def __init__(self):\n"
+            "        self.items = []\n"
+            "    def add(self, x):\n"
+            "        self.items.append(x)\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 3
+
+    def test_never_appended_is_clean(self):
+        # A list that only __init__ touches is a plain field, not an
+        # accumulator.
+        src = (
+            "class Cfg:\n"
+            "    def __init__(self):\n"
+            "        self.paths = []\n"
+        )
+        assert self._findings(src) == []
+
+    def test_pop_discipline_is_clean(self):
+        src = (
+            "class Queue:\n"
+            "    def __init__(self):\n"
+            "        self.items = []\n"
+            "    def add(self, x):\n"
+            "        self.items.append(x)\n"
+            "    def take(self):\n"
+            "        return self.items.pop(0)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_len_guard_is_clean(self):
+        # The Span.add_event idiom: measure, then drop past the cap.
+        src = (
+            "class Span:\n"
+            "    def __init__(self):\n"
+            "        self.events = []\n"
+            "    def add_event(self, e):\n"
+            "        if len(self.events) >= 128:\n"
+            "            return\n"
+            "        self.events.append(e)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_swap_drain_is_clean(self):
+        src = (
+            "class Inbox:\n"
+            "    def __init__(self):\n"
+            "        self.inbox = []\n"
+            "    def put(self, x):\n"
+            "        self.inbox.append(x)\n"
+            "    def take(self):\n"
+            "        out, self.inbox = self.inbox, []\n"
+            "        return out\n"
+        )
+        assert self._findings(src) == []
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "class Builder:\n"
+            "    def __init__(self):\n"
+            "        # analysis: allow[py-unbounded-deque]\n"
+            "        self.windows = []\n"
+            "    def add(self, w):\n"
+            "        self.windows.append(w)\n"
+        )
+        target = tmp_path / "pragma_deque.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-unbounded-deque"] == []
+        # Same file minus the pragma gates.
+        target.write_text(src.replace(
+            "        # analysis: allow[py-unbounded-deque]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len(
+            [f for f in findings if f.rule == "py-unbounded-deque"]
+        ) == 1
+
+
 class TestUnboundedMetricLabelsRule:
     """py-unbounded-metric-labels flags request-derived label values
     only: the platform's sanctioned vocabulary (namespace/name object

@@ -788,6 +788,22 @@ class TestLoadtestSmoke:
             assert set(row["burn"]) == {"fast", "slow"}
             assert set(row["states"].values()) <= {
                 "inactive", "pending", "firing"}
+        # PR-10 satellite: the cycle-phase digest rides the same JSON
+        # line — bench trajectory sees which phase regressed, not just
+        # end-to-end TTFT/ITL.
+        profile = summary["cycle_profile"]
+        assert {"admit", "prefill", "decode"} <= set(profile)
+        for row in profile.values():
+            assert set(row) == {"p50_s", "p99_s", "n"}
+            assert row["p99_s"] >= row["p50_s"] >= 0
+            assert row["n"] >= 1
+        assert profile["decode"]["p50_s"] > 0
+        # Acceptance: measured profiler overhead on the decode hot
+        # path stays under the 2% budget (per-record cost x records
+        # per cycle vs the decode-phase p50 this very run measured).
+        overhead = summary["profiler_overhead"]
+        assert overhead is not None
+        assert overhead["frac_of_decode"] < 0.02
 
 
 class TestGatewayMetricsSchema:

@@ -130,6 +130,19 @@ class TestTracer:
                 assert child.context.trace_id == root.context.trace_id
         assert t.ring.spans() == []
 
+    def test_span_event_cap_keeps_newest(self, tracer):
+        """A span held open across an incident keeps the TAIL of its
+        events (oldest evicted + counted) — the window leading into
+        the failure is the forensic payload."""
+        with tracer.span("long") as sp:
+            for i in range(sp.MAX_EVENTS + 10):
+                sp.add_event(f"e{i}")
+        (span,) = tracer.ring.spans()
+        assert len(span["events"]) == sp.MAX_EVENTS
+        assert span["dropped_events"] == 10
+        assert span["events"][0]["name"] == "e10"
+        assert span["events"][-1]["name"] == f"e{sp.MAX_EVENTS + 9}"
+
     def test_ring_buffer_is_bounded_and_keeps_newest(self):
         t = obs.Tracer(ring_capacity=8)
         for i in range(50):

@@ -1,0 +1,741 @@
+"""Continuous profiling + flight recorder (PR 10): digest math against
+hand-computed nearest-rank percentiles, contextvar activation scoping,
+device-memory watermark fallbacks, the bounded snapshot ring and its
+rate-limited atomic JSONL dumps, the debug surfaces on the manager and
+the gateway, a read-vs-write thread hammer — and the acceptance arc: a
+seeded chaos blackout fires a burn-rate alert whose pending→firing
+transition dumps reconcile snapshots carrying per-phase durations,
+queue depth, and the trace id of an in-window span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule
+from kubeflow_tpu.controllers.manager import (
+    Manager,
+    make_default_slo_engine,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.k8s.core import ApiError
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.obs import profile as obs_profile
+from kubeflow_tpu.obs.profile import (
+    PhaseDigest,
+    PhaseProfiler,
+    active_digest,
+    memory_watermark,
+    phase as module_phase,
+    reset_memory_probe,
+)
+from kubeflow_tpu.obs.recorder import FlightRecorder
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+@pytest.fixture()
+def tracer(tmp_path):
+    t = obs.Tracer(
+        exporter=obs.JsonlExporter(str(tmp_path / "spans.jsonl")),
+        ring_capacity=4096,
+        sample_rate=1.0,
+    )
+    obs.set_tracer(t)
+    yield t
+    obs.set_tracer(None)
+
+
+def nb(name, namespace):
+    return {
+        "apiVersion": NOTEBOOK_API, "kind": "Notebook",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "jupyter-jax-tpu"},
+        ]}}},
+    }
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as response:
+        return response.status, json.loads(response.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# digest math
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseDigest:
+    def test_nearest_rank_percentiles_hand_computed(self):
+        """1..10 seconds: nearest-rank says p50 = rank ceil(.5*10) = 5
+        -> 5.0, p90 = rank 9 -> 9.0, p99 = rank 10 -> 10.0."""
+        d = PhaseDigest(window=32)
+        for v in range(1, 11):
+            d.observe(float(v))
+        assert d.percentile(0.50) == 5.0
+        assert d.percentile(0.90) == 9.0
+        assert d.percentile(0.99) == 10.0
+        assert d.percentile(0.0) == 1.0   # rank clamps to 1
+        assert d.percentile(1.0) == 10.0
+
+    def test_window_evicts_oldest_but_counts_everything(self):
+        d = PhaseDigest(window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+            d.observe(v)
+        # Window holds 3,4,5,6; cumulative count/total keep all six.
+        assert d.percentile(0.50) == 4.0
+        assert d.count == 6
+        assert d.total_s == pytest.approx(21.0)
+        assert d.max_s == 6.0 and d.last_s == 6.0
+
+    def test_empty_and_negative(self):
+        d = PhaseDigest()
+        assert d.percentile(0.5) == 0.0
+        d.observe(-1.0)  # clock skew clamps to zero, never negative
+        assert d.last_s == 0.0
+
+    def test_snapshot_schema(self):
+        d = PhaseDigest(window=8)
+        d.observe(0.25)
+        snap = d.snapshot()
+        assert set(snap) == {"count", "window", "total_s", "last_s",
+                             "max_s", "p50_s", "p90_s", "p99_s"}
+        assert snap["count"] == snap["window"] == 1
+        assert snap["p50_s"] == 0.25
+
+
+class TestPhaseProfiler:
+    def test_phase_times_with_injected_clock(self):
+        ticks = iter([10.0, 11.5, 20.0, 20.25])
+        prof = PhaseProfiler(window=16, clock=lambda: next(ticks),
+                             memory=False)
+        with prof.phase("step"):
+            pass
+        with prof.phase("step"):
+            pass
+        snap = prof.snapshot()["step"]
+        assert snap["count"] == 2
+        assert snap["max_s"] == 1.5
+        assert snap["last_s"] == 0.25
+
+    def test_activation_scope_accumulates_per_unit(self):
+        prof = PhaseProfiler(memory=False)
+        with prof.activate() as phases:
+            prof.observe("fetch", 0.1)
+            prof.observe("step", 0.5)
+            prof.observe("step", 0.5)
+        assert phases == {"fetch": pytest.approx(0.1),
+                          "step": pytest.approx(1.0)}
+        # A fresh activation starts a fresh scope.
+        with prof.activate() as phases2:
+            prof.observe("step", 0.2)
+        assert phases2 == {"step": pytest.approx(0.2)}
+
+    def test_module_phase_is_noop_outside_activation(self):
+        # Library code instruments unconditionally; without an active
+        # profiler nothing records and nothing breaks.
+        with module_phase("orphan"):
+            pass
+        assert active_digest() is None
+
+    def test_module_phase_reports_to_active_profiler(self):
+        prof = PhaseProfiler(memory=False)
+        with prof.activate():
+            with module_phase("list"):
+                pass
+            digest = active_digest()
+        assert digest is not None and "list" in digest
+        assert set(digest["list"]) == {"p50_s", "p99_s", "n"}
+
+    def test_foreign_profiler_does_not_pollute_scope(self):
+        """A library holding its OWN profiler handle must not leak its
+        phases into another loop's activation scope."""
+        mine, foreign = PhaseProfiler(memory=False), PhaseProfiler(
+            memory=False)
+        with mine.activate() as phases:
+            foreign.observe("alien", 1.0)
+        assert phases == {}
+        assert "alien" in foreign.snapshot()
+
+    def test_compact_form(self):
+        prof = PhaseProfiler(memory=False)
+        prof.observe("decode", 0.2)
+        compact = prof.compact()
+        assert compact == {"decode": {"p50_s": 0.2, "p99_s": 0.2,
+                                      "n": 1}}
+
+    def test_overhead_probe_runs(self):
+        per_record = obs_profile.measure_overhead_s(iterations=200)
+        # Sanity, not a benchmark: a record costs real time but far
+        # under a millisecond even on a noisy container.
+        assert 0.0 < per_record < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# device-memory watermarks
+# ---------------------------------------------------------------------------
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+class TestMemoryWatermark:
+    def test_sums_across_devices(self):
+        devices = [
+            FakeDevice({"bytes_in_use": 100, "peak_bytes_in_use": 150,
+                        "bytes_limit": 1000}),
+            FakeDevice({"bytes_in_use": 200, "peak_bytes_in_use": 250,
+                        "bytes_limit": 1000}),
+        ]
+        mark = memory_watermark(devices)
+        assert mark == {"devices": 2, "bytes_in_use": 300,
+                        "peak_bytes_in_use": 400, "bytes_limit": 2000}
+
+    def test_missing_keys_are_omitted(self):
+        mark = memory_watermark([FakeDevice({"bytes_in_use": 7})])
+        assert mark == {"devices": 1, "bytes_in_use": 7}
+
+    def test_device_failure_returns_none(self):
+        devices = [FakeDevice({"bytes_in_use": 1}),
+                   FakeDevice(RuntimeError("device gone"))]
+        assert memory_watermark(devices) is None
+
+    def test_no_reported_keys_is_none(self):
+        assert memory_watermark([FakeDevice({})]) is None
+
+    def test_cpu_probe_is_noop(self):
+        """On this (CPU) container the real probe must land on the
+        documented no-op: None, cached after one probe."""
+        reset_memory_probe()
+        try:
+            assert memory_watermark() is None
+            assert memory_watermark() is None  # cached verdict
+        finally:
+            reset_memory_probe()
+
+    def test_profiler_memory_off_switch(self):
+        prof = PhaseProfiler(memory=False)
+        assert prof.watermark() is None
+
+    def test_env_disables_memory(self, monkeypatch):
+        monkeypatch.setenv("KFT_PROFILE_MEMORY", "0")
+        assert PhaseProfiler().memory is False
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, schema, dumps
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_sequence(self, tmp_path):
+        rec = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+        for i in range(10):
+            rec.record("train_step", step=i)
+        assert len(rec) == 4
+        snaps = rec.snapshots()
+        assert [s["step"] for s in snaps] == [6, 7, 8, 9]
+        assert [s["seq"] for s in snaps] == [7, 8, 9, 10]
+
+    def test_snapshot_schema_and_trace_capture(self, tmp_path, tracer):
+        rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+        rec.record("serve_cycle", phases={"decode": 0.01},
+                   queue_depth=3)
+        with tracer.span("cycle") as span:
+            rec.record("serve_cycle", phases={"decode": 0.02},
+                       queue_depth=0)
+        outside, inside = rec.snapshots()
+        assert outside["trace_id"] is None
+        assert inside["trace_id"] == span.context.trace_id
+        for snap in (outside, inside):
+            assert snap["kind"] == "serve_cycle"
+            assert {"seq", "ts", "phases", "queue_depth"} <= set(snap)
+
+    def test_explicit_trace_id_wins(self, tmp_path, tracer):
+        rec = FlightRecorder(capacity=2, dump_dir=str(tmp_path))
+        with tracer.span("cycle"):
+            rec.record("x", trace_id="feedface")
+        assert rec.snapshots()[0]["trace_id"] == "feedface"
+
+    def test_dump_writes_valid_jsonl_atomically(self, tmp_path):
+        clk = Clock(100.0)
+        rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path),
+                             clock=clk, min_dump_interval_s=60.0)
+        for i in range(3):
+            rec.record("train_step", step=i, phases={"step": 0.1})
+        path = rec.dump("test trigger")
+        assert path is not None and os.path.exists(path)
+        lines = [json.loads(line)
+                 for line in open(path, encoding="utf-8")]
+        header, *snaps = lines
+        assert header["kind"] == "flight_dump"
+        assert header["reason"] == "test trigger"
+        assert header["snapshots"] == 3 and len(snaps) == 3
+        assert [s["step"] for s in snaps] == [0, 1, 2]
+        # Atomic: no tmp litter next to the artifact.
+        assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+        assert rec.last_dump_path == path
+
+    def test_dump_rate_limited_and_forced(self, tmp_path):
+        clk = Clock(0.0)
+        rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path),
+                             clock=clk, min_dump_interval_s=60.0)
+        rec.record("x")
+        assert rec.dump("first") is not None
+        clk.advance(10.0)
+        assert rec.dump("storm") is None       # suppressed
+        assert rec.dumps_suppressed == 1
+        assert rec.dump("forced", force=True) is not None
+        clk.advance(120.0)
+        assert rec.dump("later") is not None   # interval elapsed
+        assert rec.dumps_total == 3
+
+    def test_dump_failure_never_raises(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file, not dir")
+        rec = FlightRecorder(capacity=2, dump_dir=str(blocker),
+                             min_dump_interval_s=60.0)
+        rec.record("x")
+        assert rec.dump("doomed") is None
+        # A lost artifact must not read as written, and must not
+        # consume the rate-limit slot: the very next firing transition
+        # retries instead of sitting out the interval.
+        assert rec.dumps_total == 0
+        assert rec.last_dump_path is None
+        rec.dump_dir = str(tmp_path)
+        path = rec.dump("retry")
+        assert path is not None and os.path.exists(path)
+        assert rec.dumps_total == 1
+
+    def test_to_dict_schema(self, tmp_path):
+        rec = FlightRecorder(capacity=2, dump_dir=str(tmp_path))
+        rec.record("x")
+        doc = rec.to_dict()
+        assert set(doc) == {"capacity", "recorded", "dumps",
+                            "dumps_suppressed", "last_dump_path",
+                            "snapshots"}
+        assert doc["capacity"] == 2 and doc["recorded"] == 1
+        assert len(doc["snapshots"]) == 1
+
+    def test_env_knobs(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("OBS_FLIGHT_CAPACITY", "17")
+        monkeypatch.setenv("OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("OBS_FLIGHT_MIN_INTERVAL_S", "5")
+        rec = FlightRecorder()
+        assert rec.capacity == 17
+        assert rec.dump_dir == str(tmp_path)
+        assert rec.min_dump_interval_s == 5.0
+
+
+# ---------------------------------------------------------------------------
+# thread-safety hammer
+# ---------------------------------------------------------------------------
+
+
+class TestThreadHammer:
+    def test_handler_reads_vs_hot_loop_writes(self, tmp_path):
+        """Two hot-loop writer threads vs two handler-shaped readers:
+        no RuntimeError from mutation-during-iteration, no torn reads,
+        and every write lands in the digests."""
+        prof = PhaseProfiler(window=64, memory=False)
+        rec = FlightRecorder(capacity=64, dump_dir=str(tmp_path),
+                             min_dump_interval_s=0.0)
+        writes_per_thread = 500
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def writer(name):
+            try:
+                for i in range(writes_per_thread):
+                    with prof.activate() as phases:
+                        prof.observe(name, 0.001)
+                        prof.observe("shared", 0.002)
+                    rec.record("unit", phases=dict(phases), i=i)
+            except BaseException as exc:  # pragma: no cover - fail loud
+                errors.append(exc)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    prof.snapshot()
+                    prof.compact()
+                    rec.to_dict()
+                    len(rec)
+            except BaseException as exc:  # pragma: no cover - fail loud
+                errors.append(exc)
+
+        writers = [threading.Thread(target=writer, args=(f"w{i}",))
+                   for i in range(2)]
+        readers = [threading.Thread(target=reader) for _ in range(2)]
+        for t in readers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in readers:
+            t.join()
+        assert errors == []
+        snap = prof.snapshot()
+        assert snap["shared"]["count"] == 2 * writes_per_thread
+        assert snap["w0"]["count"] == writes_per_thread
+        assert rec.to_dict()["recorded"] == 2 * writes_per_thread
+
+
+# ---------------------------------------------------------------------------
+# train-loop + telemetry integration
+# ---------------------------------------------------------------------------
+
+
+def counting_step(state, batch):
+    return (
+        {"w": state["w"] + batch["x"], "step": state["step"] + 1},
+        {"loss": np.float32(0.0)},
+    )
+
+
+class TestTrainLoopIntegration:
+    def test_phases_digests_snapshots_and_telemetry_stamp(self, tmp_path):
+        from kubeflow_tpu.models.checkpoint import CheckpointManager
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        prof = PhaseProfiler(memory=False)
+        rec = FlightRecorder(capacity=32, dump_dir=str(tmp_path))
+        telemetry = obs.StepTelemetry(flops_per_example=1e6,
+                                      device_kind="cpu")
+        mgr = CheckpointManager(tmp_path / "ckpt", keep=5)
+        batches = [{"x": np.ones(4, np.float32)} for _ in range(6)]
+        _state, report = run_with_checkpointing(
+            counting_step,
+            {"w": np.zeros(4, np.float32), "step": np.int32(0)},
+            batches, mgr, save_every_steps=4,
+            telemetry=telemetry, profiler=prof, recorder=rec,
+            install_signal_handler=False,
+        )
+        assert report.final_step == 6
+        digest = prof.snapshot()
+        # fetch/step on every iteration; save at the cadence boundary;
+        # publish is a no-op phase but still timed on save boundaries.
+        assert {"fetch", "step"} <= set(digest)
+        assert digest["step"]["count"] == 6
+        assert digest["fetch"]["count"] >= 6
+        assert digest["save"]["count"] >= 1
+        # One black-box snapshot per completed step, phases attached.
+        steps = [s for s in rec.snapshots() if s["kind"] == "train_step"]
+        assert len(steps) == 6
+        assert all("step" in s["phases"] for s in steps)
+        assert all(s["memory"] is None for s in steps)  # CPU no-op
+        # Zero-flag telemetry stamp: records carry the live digest.
+        stamped = [r for r in telemetry.records if "phases" in r]
+        assert len(stamped) == 6
+        assert "step" in stamped[-1]["phases"]
+
+    def test_step_telemetry_stamp_requires_activation(self):
+        t = obs.StepTelemetry(flops_per_example=1e6, device_kind="cpu")
+        t.observe(4, 0.1)
+        assert "phases" not in t.records[-1]
+        prof = PhaseProfiler(memory=False)
+        with prof.activate():
+            prof.observe("step", 0.1)
+            t.observe(4, 0.1)
+        assert t.records[-1]["phases"]["step"]["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# debug surfaces: manager + gateway
+# ---------------------------------------------------------------------------
+
+
+class TestManagerDebugSurfaces:
+    def test_debug_profile_and_flightrecord(self, tmp_path):
+        prom = ControllerMetrics()
+        prof = PhaseProfiler(memory=False)
+        prof.observe("list", 0.01)
+        prof.observe("total", 0.02)
+        rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+        rec.record("reconcile", phases={"list": 0.01}, queue_depth=0)
+        server = ManagerServer(
+            prom, enable_debug=True,
+            profilers={"notebook-controller": prof}, recorder=rec,
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, doc = get_json(base + "/debug/profile")
+            assert status == 200
+            digest = doc["controllers"]["notebook-controller"]
+            assert digest["list"]["count"] == 1
+            assert "memory" in doc  # None on CPU, key always present
+            status, doc = get_json(base + "/debug/flightrecord")
+            assert status == 200
+            assert doc["capacity"] == 8
+            assert doc["snapshots"][0]["kind"] == "reconcile"
+        finally:
+            server.stop()
+
+    def test_debug_gate_holds(self, tmp_path):
+        server = ManagerServer(
+            ControllerMetrics(), enable_debug=False,
+            profilers={"x": PhaseProfiler(memory=False)},
+            recorder=FlightRecorder(dump_dir=str(tmp_path)),
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            for path in ("/debug/profile", "/debug/flightrecord"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(base + path, timeout=10)
+                assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_manager_shares_one_recorder(self):
+        """The Manager hands every controller (and the SLO engine) the
+        same ring, so one dump carries every loop's snapshots."""
+        api = FakeApiServer()
+        prom = ControllerMetrics(api)
+        ctrl = make_notebook_controller(api, prom=prom)
+        manager = Manager(api, [ctrl], prom=prom, http_port=None)
+        assert ctrl.recorder is manager.recorder
+        assert manager.slo.recorder is manager.recorder
+        # An explicitly-built recorder is kept, not overwritten.
+        own = FlightRecorder(capacity=2)
+        ctrl2 = make_notebook_controller(api, prom=ControllerMetrics(api))
+        ctrl2.recorder = own
+        manager2 = Manager(api, [ctrl2], prom=None, http_port=None)
+        assert ctrl2.recorder is own
+        assert manager2.recorder is not own
+
+
+class StubServingEngine:
+    """Duck-typed engine for gateway surface tests: idle scheduler,
+    live profiler/recorder."""
+
+    batched = False
+    draining = False
+    swaps_total = 0
+    eos = None
+    cycle_seconds: dict = {}
+
+    def __init__(self, tmp_path):
+        self.profiler = PhaseProfiler(memory=False)
+        self.recorder = FlightRecorder(capacity=8,
+                                       dump_dir=str(tmp_path))
+        self.occupancy = 1
+        self.slots_total = 4
+
+    def pending(self):
+        return 2
+
+    def step_cycle(self):
+        return False
+
+    def wait_for_work(self, timeout_s):
+        pass
+
+    def drain(self):
+        pass
+
+
+class TestGatewayDebugSurfaces:
+    def _gateway(self, tmp_path, **kwargs):
+        from kubeflow_tpu.serving.gateway import InferenceGateway
+
+        engine = StubServingEngine(tmp_path)
+        engine.profiler.observe("decode", 0.005)
+        engine.profiler.observe("admit", 0.0005)
+        engine.recorder.record(
+            "serve_cycle", phases={"decode": 0.005}, occupancy=1,
+            slots=4, queue_depth=2, memory=None)
+        return engine, InferenceGateway(engine, port=0, **kwargs)
+
+    def test_debug_profile_and_flightrecord_schema(self, tmp_path):
+        engine, gateway = self._gateway(tmp_path, enable_debug=True)
+        gateway.start()
+        try:
+            base = f"http://127.0.0.1:{gateway.port}"
+            status, doc = get_json(base + "/debug/profile")
+            assert status == 200
+            assert doc["engine"]["decode"]["count"] == 1
+            assert "memory" in doc
+            status, doc = get_json(base + "/debug/flightrecord")
+            assert status == 200
+            snap = doc["snapshots"][0]
+            assert snap["kind"] == "serve_cycle"
+            assert snap["queue_depth"] == 2
+        finally:
+            gateway.stop()
+
+    def test_status_carries_profile_and_ring_counters(self, tmp_path):
+        engine, gateway = self._gateway(tmp_path, enable_debug=False)
+        gateway.start()
+        try:
+            base = f"http://127.0.0.1:{gateway.port}"
+            status, doc = get_json(base + "/v1/status")
+            assert status == 200
+            assert doc["profile"]["decode"]["n"] == 1
+            assert doc["flightrecord"] == {
+                "ring": 1, "dumps": 0, "last_dump_path": None}
+            assert doc["slots"] == {"active": 1, "total": 4}
+            # The debug gate still holds on the gateway.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(base + "/debug/profile",
+                                       timeout=10)
+            assert err.value.code == 404
+        finally:
+            gateway.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chaos blackout -> firing alert -> black-box dump
+# ---------------------------------------------------------------------------
+
+
+class TestAlertTriggeredDump:
+    OPS_PER_TICK = 5
+    TICK_S = 30.0
+
+    def _tick_ops(self, proxy):
+        for _ in range(self.OPS_PER_TICK):
+            try:
+                proxy.list(NOTEBOOK_API, "Notebook")
+            except ApiError:
+                pass  # the blackout the scenario is about
+
+    def test_blackout_dump_carries_phases_queue_and_trace(
+            self, tmp_path, tracer):
+        """The PR 9 blackout arc, extended one layer down: when the
+        apiserver-availability fast-burn alert goes firing, the SLO
+        engine dumps the manager-shared flight ring — and the artifact
+        already holds the reconcile snapshots from before the incident,
+        each with its phase split, queue depth and trace id."""
+        fake = FakeApiServer()
+        fake.create(nb("victim", "chaos-ns"))
+
+        clk = Clock(0.0)
+        pre_ticks, blackout_ticks = 10, 14
+        b0 = pre_ticks * self.OPS_PER_TICK
+        b1 = b0 + blackout_ticks * self.OPS_PER_TICK
+        schedule = FaultSchedule(seed=5).blackout(b0, b1)
+        proxy = ChaosApiServer(fake, schedule, sleep=lambda s: None)
+
+        recorder = FlightRecorder(
+            capacity=64, dump_dir=str(tmp_path), clock=clk,
+            min_dump_interval_s=10_000.0,  # provoke storm suppression
+            name="mgr-flightrecord",
+        )
+        prom = ControllerMetrics()
+        engine = make_default_slo_engine(prom, proxy, clock=clk,
+                                         recorder=recorder)
+        # A real controller fills the ring with reconcile snapshots
+        # (phases via the notebook reconciler's profile_phase calls).
+        ctrl = make_notebook_controller(fake, prom=prom)
+        ctrl.recorder = recorder
+        ctrl.run_once()
+        snaps = [s for s in recorder.snapshots()
+                 if s["kind"] == "reconcile"]
+        assert snaps, "reconcile left no black-box snapshot"
+
+        def state(speed="fast"):
+            return engine.alerts.state_of("apiserver-availability",
+                                          speed)
+
+        for _ in range(pre_ticks):
+            self._tick_ops(proxy)
+            engine.tick(clk.advance(self.TICK_S))
+        assert state() == "inactive"
+        assert recorder.dumps_total == 0  # healthy: nothing dumped
+
+        for _ in range(blackout_ticks):
+            self._tick_ops(proxy)
+            engine.tick(clk.advance(self.TICK_S))
+        assert state() == "firing"
+        # Deterministic: the firing transition dumped exactly once.
+        assert recorder.dumps_total == 1
+        path = recorder.last_dump_path
+        assert path is not None and os.path.exists(path)
+
+        lines = [json.loads(line)
+                 for line in open(path, encoding="utf-8")]
+        header, *snapshots = lines
+        assert header["kind"] == "flight_dump"
+        assert "apiserver-availability" in header["reason"]
+        assert len(snapshots) == header["snapshots"] > 0
+        reconciles = [s for s in snapshots if s["kind"] == "reconcile"]
+        assert reconciles, "dump carries no reconcile snapshots"
+        ring_trace_ids = {s["trace_id"]
+                          for s in tracer.ring.spans()}
+        victim = next(s for s in reconciles if s["name"] == "victim")
+        # Per-phase durations: the reconciler's four costs + the
+        # runtime's own total, all non-negative seconds.
+        assert {"list", "desired-state", "patch", "status"} <= set(
+            victim["phases"])
+        assert all(v >= 0.0 for v in victim["phases"].values())
+        assert victim["queue_depth"] >= 0
+        assert victim["outcome"] == "ok"
+        # ...and the trace id of an in-window span: the snapshot links
+        # to the exact reconcile trace in the tracer's ring.
+        assert victim["trace_id"] in ring_trace_ids
+
+        # Rate-limiting: keep burning until the slow pair fires too —
+        # inside min_dump_interval_s the second dump is suppressed.
+        for _ in range(60):
+            if state("slow") == "firing":
+                break
+            self._tick_ops(proxy)
+            engine.tick(clk.advance(self.TICK_S))
+        assert state("slow") == "firing"
+        assert recorder.dumps_total == 1
+        assert recorder.dumps_suppressed >= 1
+
+    def test_replay_is_deterministic(self, tmp_path, tracer):
+        """Same seed + op script + clock script -> byte-identical dump
+        artifacts (modulo the artifact's own path)."""
+
+        def run(subdir):
+            fake = FakeApiServer()
+            fake.create(nb("victim", "chaos-ns"))
+            clk = Clock(0.0)
+            schedule = FaultSchedule(seed=5).blackout(50, 120)
+            proxy = ChaosApiServer(fake, schedule, sleep=lambda s: None)
+            rec = FlightRecorder(capacity=64,
+                                 dump_dir=str(tmp_path / subdir),
+                                 clock=clk)
+            prom = ControllerMetrics()
+            engine = make_default_slo_engine(prom, proxy, clock=clk,
+                                             recorder=rec)
+            for _ in range(24):
+                self._tick_ops(proxy)
+                engine.tick(clk.advance(self.TICK_S))
+            assert rec.dumps_total == 1
+            lines = open(rec.last_dump_path, encoding="utf-8").read()
+            return lines
+
+        assert run("a") == run("b")
