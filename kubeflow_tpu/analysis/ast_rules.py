@@ -75,6 +75,21 @@
   construction (``deque(maxlen=...)``) or trim explicitly; provably
   drained-elsewhere cases escape with
   ``# analysis: allow[py-unbounded-deque]``.
+- ``py-unbounded-actuation`` (warning): a function registered as an
+  alert/transition callback — passed to a ``.subscribe(...)`` call, or
+  implementing the actuator protocol (``on_transition``/``on_tick``) —
+  that performs API writes (create/update/patch/delete/scale on an
+  api/client handle) or scaling-knob assignments
+  (``max_pending``/``prefill_per_cycle``/``replicas``) with no
+  rate-limit/hysteresis guard in scope (no ``ActuationGuard``/
+  ``.allow()`` check, no hold-window/cooldown/min-interval
+  discipline anywhere in the enclosing class, or the function itself
+  for module-level callbacks). An unguarded actuator turns a flapping
+  SLI into an actuation storm: every alert edge becomes an apiserver
+  write or a live-engine mutation at alert-evaluation frequency — the
+  autopilot amplifying the incident it was built to absorb. Bounded
+  authority is the contract (autopilot/core.py); deliberate
+  exceptions escape with ``# analysis: allow[py-unbounded-actuation]``.
 """
 
 from __future__ import annotations
@@ -616,6 +631,152 @@ def _check_unbounded_deques(cls: ast.ClassDef, aliases: dict[str, str],
         ))
 
 
+# --- py-unbounded-actuation -------------------------------------------------
+# Write verbs that count as actuation when called on an api/client
+# handle (the receiver's dotted chain mentions "api" or "client" — a
+# dict.update() or set.update() must not false-positive).
+_ACTUATION_WRITE_VERBS = {"create", "update", "patch", "patch_merge",
+                          "delete", "scale", "apply"}
+# Attribute assignments that mutate a live engine's admission/scale
+# knobs — actuation without an apiserver in sight.
+_ACTUATION_SCALING_ATTRS = {"max_pending", "prefill_per_cycle",
+                            "replicas", "max_batch"}
+# Identifier fragments accepted as rate-limit/hysteresis discipline.
+_GUARD_FRAGMENTS = ("guard", "rate_limit", "ratelimit", "hysteresis",
+                    "min_interval", "hold_s", "cooldown", "backoff")
+# The actuator-protocol method names the Autopilot drives.
+_ACTUATION_CALLBACK_NAMES = {"on_transition", "on_tick"}
+
+
+def _subscribed_names(tree: ast.AST) -> set[str]:
+    """Function/method names passed to a ``.subscribe(...)`` call —
+    the explicit registration path (``alerts.subscribe(fn)`` /
+    ``alerts.subscribe(self.on_x)``)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "subscribe" and node.args):
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                names.add(arg.attr)
+    return names
+
+
+def _actuation_write_line(fns) -> int | None:
+    """First line in any of ``fns`` performing an API write or a
+    scaling-knob assignment; None when none do."""
+    for fn in fns:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ACTUATION_WRITE_VERBS):
+                receiver = _dotted(node.func.value, {}).lower()
+                if "api" in receiver or "client" in receiver:
+                    return node.lineno
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for target in targets:
+                    if (isinstance(target, ast.Attribute)
+                            and target.attr in _ACTUATION_SCALING_ATTRS):
+                        return node.lineno
+    return None
+
+
+def _has_guard_evidence(scope: ast.AST) -> bool:
+    """Rate-limit/hysteresis discipline anywhere in ``scope``: a
+    ``.allow(...)`` check, or any identifier mentioning one of the
+    guard fragments (ActuationGuard handles, hold windows, cooldowns,
+    min-interval bookkeeping)."""
+    for node in ast.walk(scope):
+        idents: list[str] = []
+        if isinstance(node, ast.Name):
+            idents.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            idents.append(node.attr)
+        elif isinstance(node, ast.arg):
+            idents.append(node.arg)
+        elif isinstance(node, ast.keyword) and node.arg:
+            idents.append(node.arg)
+        for ident in idents:
+            low = ident.lower()
+            if any(frag in low for frag in _GUARD_FRAGMENTS):
+                return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "allow"):
+            return True
+    return False
+
+
+def _actuation_finding(fn, scope_desc: str, path: str,
+                       line: int) -> Finding:
+    return Finding(
+        "py-unbounded-actuation", Severity.WARNING, path, fn.lineno,
+        f"{fn.name} is an alert/transition callback that performs API "
+        f"writes or scaling (line {line}) with no rate-limit/"
+        f"hysteresis guard in {scope_desc}: an unguarded actuator "
+        "turns a flapping SLI into an actuation storm at alert-"
+        "evaluation frequency. Hold an ActuationGuard (autopilot/"
+        "core.py) or equivalent hold-window/cooldown discipline (or "
+        "annotate a provably bounded callback with "
+        "# analysis: allow[py-unbounded-actuation])",
+    )
+
+
+def _check_unbounded_actuation(tree: ast.AST, path: str,
+                               out: list[Finding]) -> None:
+    """Flag registered actuation callbacks with no guard in scope.
+    Methods: the callback body plus any same-class helper it calls via
+    ``self.<m>()`` count as the write surface; the whole class is the
+    guard scope (discipline may live in a helper). Module functions:
+    the function is both."""
+    subscribed = _subscribed_names(tree)
+
+    def is_callback(name: str) -> bool:
+        return name in _ACTUATION_CALLBACK_NAMES or name in subscribed
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = {
+                m.name: m for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            class_guarded = _has_guard_evidence(node)
+            for name, method in methods.items():
+                if not is_callback(name):
+                    continue
+                # One-level self-call expansion: on_transition often
+                # delegates the write to a _do_scale helper.
+                fns = [method]
+                for call in ast.walk(method):
+                    if (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)):
+                        attr = _self_attr_name(call.func)
+                        if attr in methods and methods[attr] not in fns:
+                            fns.append(methods[attr])
+                line = _actuation_write_line(fns)
+                if line is not None and not class_guarded:
+                    out.append(_actuation_finding(
+                        method, f"class {node.name}", path, line,
+                    ))
+        elif isinstance(node, ast.Module):
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if not is_callback(fn.name):
+                    continue
+                line = _actuation_write_line([fn])
+                if line is not None and not _has_guard_evidence(fn):
+                    out.append(_actuation_finding(
+                        fn, "the function", path, line,
+                    ))
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -702,6 +863,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
     print_exempt = _print_rule_exempt(path, tree)
 
     _check_nonatomic_writes(tree, aliases, path, out)  # module scope
+    _check_unbounded_actuation(tree, path, out)
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             is_traced = node.name in traced_names or any(

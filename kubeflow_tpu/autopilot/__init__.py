@@ -1,0 +1,42 @@
+"""SLO autopilot: alert-driven actuation with bounded authority.
+
+The closing of the observability loop (ROADMAP item 5): the burn-rate
+alerts, goodput meters and capacity timelines PRs 9–10 built become
+*inputs* — admission tightens while TTFT/ITL burn is critical,
+InferenceServices scale off slot-occupancy/queue-depth, checkpoint
+cadence tightens when a degrade looks imminent, and elastic promotion
+is gated on real capacity instead of probe-and-pray. Every actuator is
+rate-limited and hysteresis-held; every actuation is a first-class
+observable event (counter + log + span + flight-recorder snapshot);
+``KFT_AUTOPILOT=0`` disables the whole layer. See
+:mod:`kubeflow_tpu.autopilot.core` for the design contract and
+``docs/operations.md`` ("Autopilot") for the operator view.
+"""
+
+from kubeflow_tpu.autopilot.checkpoint import CheckpointCadenceActuator
+from kubeflow_tpu.autopilot.core import (
+    ActuationGuard,
+    Actuator,
+    Autopilot,
+    AutopilotCollector,
+    autopilot_enabled,
+)
+from kubeflow_tpu.autopilot.elastic import ElasticPromotionGate
+from kubeflow_tpu.autopilot.serving import (
+    DESIRED_REPLICAS_ANNOTATION,
+    GatewayAdmissionActuator,
+    InferenceScaleActuator,
+)
+
+__all__ = [
+    "ActuationGuard",
+    "Actuator",
+    "Autopilot",
+    "AutopilotCollector",
+    "CheckpointCadenceActuator",
+    "DESIRED_REPLICAS_ANNOTATION",
+    "ElasticPromotionGate",
+    "GatewayAdmissionActuator",
+    "InferenceScaleActuator",
+    "autopilot_enabled",
+]

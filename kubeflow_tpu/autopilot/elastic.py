@@ -1,0 +1,155 @@
+"""Goodput- and capacity-aware elastic promotion gate.
+
+``controllers/elastic.py`` promotes by probing: a reconciler cannot see
+free capacity for nodes that do not exist, so after the promote
+interval it optimistically re-emits the bigger shape and lets an
+Unschedulable probe degrade back. That is correct when the controller
+knows nothing — but the platform often *does* know: the chaos/cluster
+capacity timeline says how many chips are schedulable, and the
+GoodputMeter says whether the job is even making progress. Probing a
+16-chip shape into an 8-chip pool is a guaranteed failed probe: a
+reshard down, a reshard up, two cross-topology restores, and a goodput
+hole — pure churn.
+
+:class:`ElasticPromotionGate` is the ``promotion_gate`` hook
+``controllers.elastic.decide`` consults before the promote arm fires:
+a veto defers the probe one promote interval (the probe clock re-arms;
+nothing else changes). Vetoes are recorded as autopilot actions
+(``deferred``), guard-rate-limited; the first allow after a veto run
+is recorded too (``allowed``), so a game-day log shows the gate
+opening when capacity returns.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.autopilot.core import ActuationGuard, Actuator
+
+log = logging.getLogger(__name__)
+
+
+class ElasticPromotionGate(Actuator):
+    """Veto elastic promotion into known-shrinking capacity.
+
+    ``capacity_fn`` returns the currently schedulable TPU chips (None
+    = unbounded/unknown — e.g. ``lambda: injector.capacity_chips`` in
+    the chaos harness, or a node-pool reading in production);
+    ``goodput`` is an optional :class:`~kubeflow_tpu.obs.GoodputMeter`
+    whose ratio must stay at or above ``min_goodput`` for a probe to be
+    worth its churn. Verdicts:
+
+    - capacity known and below the target shape's chip need → veto;
+    - capacity trend shrinking (last reading lower than the one
+      before) → veto — do not probe INTO the weather;
+    - goodput ratio below the floor → veto (the job is paying for
+      downtime already; a probe adds two more restores).
+
+    A gate that cannot decide (no signals, broken reads) allows — the
+    probe-by-emitting default remains the fallback, enforced on the
+    caller side too (``decide`` treats a raising gate as allow)."""
+
+    name = "elastic-promotion"
+
+    def __init__(self,
+                 capacity_fn: Callable[[], int | None] | None = None,
+                 goodput=None, min_goodput: float = 0.5,
+                 guard: ActuationGuard | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(guard=guard)
+        self.capacity_fn = capacity_fn
+        self.goodput = goodput
+        self.min_goodput = float(min_goodput)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_capacity: int | None = None
+        self._shrinking = False
+        self._sampled = False
+        self._vetoed_since_allow = False
+        self.vetoes = 0
+        self.allows = 0
+
+    # ---- capacity trend sampling -----------------------------------------
+    def on_tick(self, now: float | None = None) -> None:
+        if self.capacity_fn is None:
+            return
+        try:
+            chips = self.capacity_fn()
+        except Exception:
+            log.debug("elastic-promotion: capacity read failed",
+                      exc_info=True)
+            return
+        with self._lock:
+            if chips is None:
+                self._shrinking = False
+            elif (self._last_capacity is not None
+                  and chips < self._last_capacity):
+                self._shrinking = True
+            else:
+                self._shrinking = False
+            self._last_capacity = chips
+            self._sampled = True
+
+    # ---- the gate ---------------------------------------------------------
+    def allow_promotion(self, target) -> bool:
+        """The hook ``controllers.elastic.decide`` calls with the
+        target rung's :class:`~kubeflow_tpu.topology.TpuSlice`."""
+        with self._lock:
+            chips = self._last_capacity
+            shrinking = self._shrinking
+            sampled = self._sampled
+        if not sampled and self.capacity_fn is not None:
+            # Never ticked (no autopilot loop driving it): read once so
+            # a bare gate still sees the pool.
+            try:
+                chips = self.capacity_fn()
+            except Exception:
+                log.debug("elastic-promotion: capacity read failed",
+                          exc_info=True)
+                chips = None
+        reasons = []
+        if shrinking:
+            reasons.append("capacity shrinking")
+        need = getattr(target, "chips", None)
+        if chips is not None and need is not None and chips < need:
+            reasons.append(
+                f"capacity {chips} chips < target "
+                f"{getattr(target, 'shorthand', target)} needs {need}"
+            )
+        if self.goodput is not None:
+            try:
+                ratio = self.goodput.goodput_ratio()
+            except Exception:
+                log.debug("elastic-promotion: goodput read failed",
+                          exc_info=True)
+                ratio = None
+            if ratio is not None and ratio < self.min_goodput:
+                reasons.append(
+                    f"goodput {ratio:.2f} < floor {self.min_goodput:g}"
+                )
+        if not reasons:
+            self.allows += 1
+            with self._lock:
+                opened = self._vetoed_since_allow
+                self._vetoed_since_allow = False
+            if opened:
+                # The gate opening after a veto run is itself a state
+                # change worth a log line on the timeline.
+                self.record(
+                    "allowed",
+                    target=str(getattr(target, "shorthand", target)),
+                )
+            return True
+        self.vetoes += 1
+        with self._lock:
+            self._vetoed_since_allow = True
+        if self.guard.allow("veto"):
+            self.record(
+                "deferred",
+                target=str(getattr(target, "shorthand", target)),
+                reason="; ".join(reasons),
+            )
+        return False

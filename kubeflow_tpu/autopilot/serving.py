@@ -1,0 +1,273 @@
+"""Serving-tier actuators: admission shedding and horizontal scale.
+
+Two halves of the InferenceService autoscale/shed loop:
+
+- :class:`GatewayAdmissionActuator` lives in the gateway process and
+  rides the TTFT/ITL burn-rate edges: while the burn is critical the
+  engine's admission tightens (``max_pending`` cut → earlier 429s,
+  ``prefill_per_cycle`` narrowed → decode cycles stop paying for extra
+  prefills mid-incident); when the last watched alert clears, the
+  configured values are restored. Shedding earlier when the SLO is
+  already burning is the counterintuitive-but-right move: every
+  admitted request a melting gateway cannot serve in time both misses
+  its own SLO and drags every in-flight stream further past theirs.
+- :class:`InferenceScaleActuator` lives controller-side and consumes
+  the signals ``/v1/status`` already exposes (slot occupancy, queue
+  depth): a sustained-full batch with a backlog scales ``spec.replicas``
+  up, a sustained-idle one scales it down — change-gated, bounded to
+  ``[min_replicas, max_replicas]``, and held behind a window mirroring
+  ``BurnRateEvaluator``'s pairs (the condition must hold ``hold_s``
+  continuously; one healthy reading re-arms the window).
+
+Both carry an :class:`~kubeflow_tpu.autopilot.core.ActuationGuard` —
+the bounded-authority floor the ``py-unbounded-actuation`` analysis
+rule enforces.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.autopilot.core import ActuationGuard, Actuator
+from kubeflow_tpu.obs.alerts import FIRING
+from kubeflow_tpu.obs.fleet import INFERENCE_API
+
+log = logging.getLogger(__name__)
+
+# Where the scale actuator records its intent, alongside the
+# change-gated spec.replicas patch — on TPU slices (where the
+# StatefulSet replica count is pinned to the slice's host gang) the
+# annotation IS the actuation surface.
+DESIRED_REPLICAS_ANNOTATION = "autopilot.kubeflow-tpu.org/desired-replicas"
+
+
+class GatewayAdmissionActuator(Actuator):
+    """Tighten gateway admission while TTFT/ITL burn is critical.
+
+    Edge-driven off the alert state machine, so the hysteresis is the
+    alert's own ``for_s``/``clear_s`` — a flapping SLI is debounced
+    before this actuator ever sees an edge, and the guard bounds the
+    tighten rate on top. Restores are deliberately NOT rate-limited:
+    returning the engine to its configured state must never be blocked
+    behind a guard interval (a suppressed restore would strand the
+    gateway shedding after the incident cleared)."""
+
+    name = "gateway-admission"
+
+    def __init__(self, engine,
+                 objectives=("inference-ttft", "inference-itl"),
+                 shed_factor: int = 4,
+                 guard: ActuationGuard | None = None):
+        super().__init__(guard=guard)
+        self.engine = engine
+        self.objectives = frozenset(objectives)
+        self.shed_factor = max(2, int(shed_factor))
+        self._lock = threading.Lock()
+        self._firing: set[tuple[str, str]] = set()
+        # None = running at configured values; else the values to
+        # restore when the last watched alert clears.
+        self._saved: dict | None = None
+
+    @property
+    def tightened(self) -> bool:
+        with self._lock:
+            return self._saved is not None
+
+    def on_transition(self, transition: dict) -> None:
+        if transition.get("slo") not in self.objectives:
+            return
+        key = (transition["slo"], transition["speed"])
+        with self._lock:
+            if (transition.get("to") == FIRING
+                    and transition.get("severity") == "critical"):
+                self._firing.add(key)
+            elif transition.get("to") in ("resolved", "inactive"):
+                self._firing.discard(key)
+                if not self._firing and self._saved is not None:
+                    self._restore_locked(transition)
+                    return
+            # The guard key is per alert: a suppressed tighten for one
+            # flapping alert must not discard tightening for a LATER
+            # incident on a different objective/speed. A still-firing
+            # edge (e.g. the slow pair joining) also retries here.
+            if (self._firing and self._saved is None
+                    and self.guard.allow(f"tighten:{key[0]}/{key[1]}")):
+                self._tighten_locked(transition)
+
+    def on_tick(self, now: float | None = None) -> None:
+        """Retry path: if a firing incident's tighten edge was guard-
+        suppressed (or the actuator was registered mid-incident), the
+        next tick picks it up — the guard bounds the rate, it must
+        never drop the action for the incident's lifetime."""
+        with self._lock:
+            if not self._firing or self._saved is not None:
+                return
+            slo, speed = next(iter(self._firing))
+            if self.guard.allow(f"tighten:{slo}/{speed}"):
+                self._tighten_locked({"slo": slo, "speed": speed})
+
+    def _tighten_locked(self, transition: dict) -> None:
+        engine = self.engine
+        saved = {
+            "max_pending": engine.max_pending,
+            "prefill_per_cycle": getattr(
+                engine, "prefill_per_cycle", None),
+        }
+        # Earlier 429s: the admission inbox shrinks, so the shed
+        # threshold the gateway already honours trips sooner.
+        engine.max_pending = max(1,
+                                 engine.max_pending // self.shed_factor)
+        if saved["prefill_per_cycle"] is not None:
+            # Narrower interleaving: one prefill per cycle keeps the
+            # decode gap each in-flight stream sees minimal while the
+            # ITL budget is burning.
+            engine.prefill_per_cycle = 1
+        self._saved = saved
+        self.record(
+            "tightened", slo=transition["slo"],
+            speed=transition["speed"],
+            max_pending=engine.max_pending,
+            prefill_per_cycle=getattr(engine, "prefill_per_cycle",
+                                      None),
+        )
+
+    def _restore_locked(self, transition: dict) -> None:
+        engine = self.engine
+        saved = self._saved
+        engine.max_pending = saved["max_pending"]
+        if saved["prefill_per_cycle"] is not None:
+            engine.prefill_per_cycle = saved["prefill_per_cycle"]
+        self._saved = None
+        self.record(
+            "restored", slo=transition["slo"],
+            speed=transition["speed"],
+            max_pending=engine.max_pending,
+        )
+
+
+class InferenceScaleActuator(Actuator):
+    """Horizontal scale for one InferenceService from its gateway's
+    ``/v1/status`` signals.
+
+    ``status_fn`` is a zero-arg callable returning the status document
+    (an HTTP GET against the front Service in production; the live
+    gateway object or a scripted doc in tests). The hold window is the
+    hysteresis: occupancy/queue conditions must hold for ``hold_s`` of
+    continuous observations before one replica step is taken, and any
+    healthy reading re-arms the window — mirroring the evaluator's
+    both-windows-must-burn rule. The patch is change-gated (no write
+    when already at the bound or the value) and guard-rate-limited."""
+
+    name = "inference-scale"
+
+    def __init__(self, api, namespace: str, name: str,
+                 status_fn: Callable[[], dict],
+                 guard: ActuationGuard | None = None,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 scale_up_occupancy: float = 0.85,
+                 scale_down_occupancy: float = 0.25,
+                 hold_s: float = 120.0,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(guard=guard)
+        self.api = api
+        self.namespace = namespace
+        self.service = name
+        self.status_fn = status_fn
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.scale_up_occupancy = float(scale_up_occupancy)
+        self.scale_down_occupancy = float(scale_down_occupancy)
+        self.hold_s = float(hold_s)
+        self._clock = clock
+        self._up_since: float | None = None
+        self._down_since: float | None = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    def on_tick(self, now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        try:
+            doc = self.status_fn() or {}
+        except Exception:
+            # A dark gateway is not evidence in either direction; the
+            # hold windows re-arm so a recovering service is not
+            # scaled off stale pressure.
+            log.debug("inference-scale: status read failed",
+                      exc_info=True)
+            self._up_since = self._down_since = None
+            return
+        slots = doc.get("slots") or {}
+        total = max(1, int(slots.get("total") or 1))
+        occupancy = int(slots.get("active") or 0) / total
+        pending = int(doc.get("pending") or 0)
+        up = occupancy >= self.scale_up_occupancy and pending > 0
+        down = occupancy <= self.scale_down_occupancy and pending == 0
+        self._up_since = (self._up_since if self._up_since is not None
+                          else now) if up else None
+        self._down_since = (self._down_since
+                            if self._down_since is not None
+                            else now) if down else None
+        delta = 0
+        if (self._up_since is not None
+                and now - self._up_since >= self.hold_s):
+            delta = 1
+        elif (self._down_since is not None
+              and now - self._down_since >= self.hold_s):
+            delta = -1
+        if delta:
+            self._scale(delta, occupancy, pending)
+
+    def _scale(self, delta: int, occupancy: float, pending: int) -> None:
+        try:
+            svc = self.api.get(INFERENCE_API, "InferenceService",
+                               self.service, self.namespace)
+        except Exception:
+            log.debug("inference-scale: could not read %s/%s",
+                      self.namespace, self.service, exc_info=True)
+            return
+        try:
+            current = max(1, int(
+                (svc.get("spec") or {}).get("replicas") or 1))
+        except (TypeError, ValueError):
+            current = 1
+        desired = min(self.max_replicas,
+                      max(self.min_replicas, current + delta))
+        if desired == current:
+            # Already at the bound (or the value): change-gated —
+            # nothing to write. Re-arm so a persistent at-bound
+            # condition does not re-fire every tick.
+            self._up_since = self._down_since = None
+            return
+        if not self.guard.allow("scale"):
+            return
+        try:
+            self.api.patch_merge(
+                INFERENCE_API, "InferenceService", self.service,
+                {
+                    "spec": {"replicas": desired},
+                    "metadata": {"annotations": {
+                        DESIRED_REPLICAS_ANNOTATION: str(desired),
+                    }},
+                },
+                self.namespace,
+            )
+        except Exception:
+            # A failed write re-arms: the next sustained window
+            # retries through the same guard.
+            log.warning("inference-scale: patch failed for %s/%s",
+                        self.namespace, self.service, exc_info=True)
+            self._up_since = self._down_since = None
+            return
+        if delta > 0:
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        self._up_since = self._down_since = None
+        self.record(
+            "scaled", namespace=self.namespace, name=self.service,
+            replicas=desired, previous=current,
+            occupancy=round(occupancy, 3), pending=pending,
+        )

@@ -152,11 +152,35 @@ def _seconds(anns: dict, key: str, default: float) -> float:
         return default
 
 
-def decide(notebook: dict, pods: list | None, now: float
-           ) -> ElasticDecision | None:
+def _promotion_allowed(gate, target: TpuSlice) -> bool:
+    """Consult a promotion gate (``allow_promotion(target)`` duck
+    type, or a plain callable). A broken gate must never wedge a
+    degraded notebook at the small shape forever — on any failure the
+    probe-by-emitting default stands and the probe is allowed."""
+    try:
+        if hasattr(gate, "allow_promotion"):
+            return bool(gate.allow_promotion(target))
+        return bool(gate(target))
+    except Exception:
+        log.warning(
+            "elastic promotion gate failed; allowing the probe",
+            exc_info=True,
+        )
+        return True
+
+
+def decide(notebook: dict, pods: list | None, now: float,
+           promotion_gate=None) -> ElasticDecision | None:
     """The elastic policy for one reconcile pass. Pure over its inputs
     (the CR, the already-listed pods, the injected clock) — the caller
-    owns every API write. Returns None for non-TPU notebooks."""
+    owns every API write. Returns None for non-TPU notebooks.
+
+    ``promotion_gate`` (optional) is consulted before the promote arm
+    fires — e.g. :class:`kubeflow_tpu.autopilot.ElasticPromotionGate`,
+    which vetoes probing a bigger shape into known-shrinking capacity
+    or through a goodput hole. A veto defers the probe one promote
+    interval (the probe clock re-arms); without a gate — or with a
+    broken one — the historical probe-by-emitting behaviour stands."""
     spec_tpu = ((notebook.get("spec") or {}).get("tpu")) or {}
     accelerator = spec_tpu.get("accelerator")
     if not accelerator:
@@ -308,6 +332,21 @@ def decide(notebook: dict, pods: list | None, now: float
             )
         elif now >= promote_at:
             target = rungs[rung - 1]
+            if promotion_gate is not None and not _promotion_allowed(
+                    promotion_gate, target):
+                # Deferred: the gate says the bigger shape would land
+                # in known-shrinking capacity (or the job cannot
+                # afford the probe's churn) — re-arm the probe clock
+                # and stay at the current rung. The gate records its
+                # own veto as an autopilot action.
+                patches[ELASTIC_PROMOTE_AT_KEY] = rfc3339(
+                    now + promote_after_s
+                )
+                return ElasticDecision(
+                    effective, patches, events, reshard_reason,
+                    at_spec_shape=(effective.shorthand
+                                   == spec_slice.shorthand),
+                )
             reshard_reason = (
                 f"promoting {effective.shorthand} -> "
                 f"{target.shorthand}: probing regrown capacity"

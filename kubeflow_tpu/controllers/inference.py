@@ -62,6 +62,19 @@ def gateway_port(svc: dict) -> int:
                or DEFAULT_GATEWAY_PORT)
 
 
+def spec_replicas(svc: dict) -> int:
+    """``spec.replicas`` (>= 1; junk coerces to 1) — the horizontal
+    gateway count the autopilot's scale actuator patches. Honoured by
+    the StatefulSet only for non-TPU services: on a TPU slice the
+    replica count IS the slice's host gang (jax.distributed needs every
+    host), so there the field and the desired-replicas annotation
+    record capacity intent for the fleet-router tier instead."""
+    try:
+        return max(1, int((svc.get("spec") or {}).get("replicas") or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
 def _slice_for(svc: dict) -> TpuSlice | None:
     tpu = (svc.get("spec") or {}).get("tpu") or {}
     if not tpu.get("accelerator"):
@@ -98,7 +111,8 @@ def desired_statefulset(svc: dict) -> dict:
     ns = svc["metadata"]["namespace"]
     spec = svc.get("spec") or {}
     tpu_slice = _slice_for(svc)
-    replicas = tpu_slice.num_hosts if tpu_slice else 1
+    replicas = (tpu_slice.num_hosts if tpu_slice
+                else spec_replicas(svc))
     port = gateway_port(svc)
     container: dict = {
         "name": "gateway",

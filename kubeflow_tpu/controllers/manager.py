@@ -95,6 +95,7 @@ class Manager:
         clock=None,
         slo=_DEFAULT_SLO,
         recorder=None,
+        autopilot=None,
     ):
         self.api = api
         self.controllers = controllers
@@ -133,6 +134,23 @@ class Manager:
                     # Self-rate-limited: tens of loop ticks per second
                     # collapse to one sample per min_interval_s.
                     hooks.append(self.slo.tick)
+        # Actuation (PR 11): an Autopilot subscribes to the manager's
+        # alert transitions and rides the controller tick hooks for its
+        # sustained-signal actuators (both self-rate-limited). Its
+        # actions render on /metrics as autopilot_actions_total.
+        self.autopilot = autopilot
+        if autopilot is not None:
+            autopilot.attach(self.slo)
+            if autopilot.recorder is None:
+                autopilot.recorder = self.recorder
+            if prom is not None and hasattr(prom, "registry"):
+                from kubeflow_tpu.autopilot import AutopilotCollector
+
+                prom.registry.register(AutopilotCollector(autopilot))
+            for ctrl in controllers:
+                hooks = getattr(ctrl, "tick_hooks", None)
+                if hooks is not None:
+                    hooks.append(autopilot.tick)
         if prom is not None and http_port is not None:
             prom.watch_controllers(controllers)
             from kubeflow_tpu import obs

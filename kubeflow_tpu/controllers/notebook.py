@@ -111,11 +111,13 @@ class NotebookReconciler:
         options: NotebookOptions | None = None,
         prom=None,  # optional ControllerMetrics (metrics.py)
         clock=time.time,  # elastic grace/promote timers (injectable)
+        promotion_gate=None,  # autopilot.ElasticPromotionGate (or None)
     ):
         self.api = api
         self.options = options or NotebookOptions()
         self.prom = prom
         self.clock = clock
+        self.promotion_gate = promotion_gate
 
     def _ensure(self, desired: dict) -> str:
         return ensure_object(self.api, desired)
@@ -234,7 +236,8 @@ class NotebookReconciler:
         the effective shape the desired-state generation must use.
         Returns ``(reshard_reason, effective_slice_or_None)`` — None
         when the spec shape is in force."""
-        decision = elastic.decide(notebook, pods, self.clock())
+        decision = elastic.decide(notebook, pods, self.clock(),
+                                  promotion_gate=self.promotion_gate)
         if decision is None:
             return None, None
         if decision.patches:
@@ -481,8 +484,10 @@ def make_notebook_controller(
     options: NotebookOptions | None = None,
     prom=None,
     clock=time.time,
+    promotion_gate=None,
 ) -> Controller:
-    reconciler = NotebookReconciler(api, options, prom=prom, clock=clock)
+    reconciler = NotebookReconciler(api, options, prom=prom, clock=clock,
+                                    promotion_gate=promotion_gate)
     return Controller(
         name="notebook-controller",
         api=api,

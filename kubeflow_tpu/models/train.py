@@ -268,6 +268,7 @@ def run_with_checkpointing(
     goodput_publish=None,
     profiler=None,
     recorder=None,
+    cadence_signal=None,
     install_signal_handler: bool = True,
     clock=time.monotonic,
 ):
@@ -341,6 +342,18 @@ def run_with_checkpointing(
       lands one black-box snapshot (step, phase seconds, device-memory
       watermark, active trace id) in the bounded ring the SLO engine
       dumps when an alert fires.
+    - **alert-aware cadence**: ``cadence_signal`` is a zero-arg
+      callable returning a save-interval multiplier in ``(0, 1]``
+      (e.g. :meth:`kubeflow_tpu.autopilot.CheckpointCadenceActuator.
+      factor`): 1.0 in fair weather; < 1 while a degrade looks
+      imminent (a critical alert firing, capacity shrinking), so the
+      wall-clock cadence fires ``factor`` times sooner and the step
+      cadence tightens to ``save_every_steps * factor``. Consulted
+      only when building process 0's view of the step-boundary
+      decision and then broadcast with the agreed token, so SPMD
+      discipline holds — ranks never act on divergent local readings.
+      A raising/misbehaving signal reads as 1.0: telemetry-adjacent
+      hooks must never break the training loop.
 
     Returns ``(state, RunReport)``. ``batches`` yields per-step batch
     dicts; the caller owns data-order alignment with the global step
@@ -415,10 +428,13 @@ def run_with_checkpointing(
         except ValueError:
             previous_handler = None  # not the main thread: caller's job
 
-    # Wall-clock and SIGTERM triggers are per-host observations; in a
-    # multi-host world the agreed token from process 0 replaces them.
+    # Wall-clock, SIGTERM and alert-signal triggers are per-host
+    # observations; in a multi-host world the agreed token from
+    # process 0 replaces them (the cadence signal reads per-host alert
+    # state, so it MUST ride the broadcast like the others).
     agree = getattr(manager, "process_count", 1) > 1 and (
         bool(save_every_s) or install_signal_handler
+        or cadence_signal is not None
     )
 
     last_save_at = clock()
@@ -440,18 +456,44 @@ def run_with_checkpointing(
             # describes (apiserver outage, bad handle).
             log.debug("goodput publish failed", exc_info=True)
 
+    def cadence_factor() -> float:
+        """The alert-aware save-interval multiplier, clamped to
+        (0, 1]; anything unusable reads as 1.0 (normal cadence)."""
+        if cadence_signal is None:
+            return 1.0
+        try:
+            factor = float(cadence_signal())
+        except Exception:
+            log.debug("checkpoint cadence signal failed", exc_info=True)
+            return 1.0
+        if not factor > 0.0:
+            return 1.0
+        return min(factor, 1.0)
+
     def decide() -> str:
         """One decision per step boundary — pending SIGTERM, wall-clock
-        cadence — taken BEFORE the next step is paid for, so a pending
-        preemption never buys one more step (or a first-step jit
-        compile) out of the grace window. In a multi-host world the
-        token is process 0's view, broadcast to every rank."""
+        cadence, alert-tightened cadence — taken BEFORE the next step
+        is paid for, so a pending preemption never buys one more step
+        (or a first-step jit compile) out of the grace window. In a
+        multi-host world the token is process 0's view, broadcast to
+        every rank."""
+        factor = cadence_factor()
         due_clock = (
             bool(save_every_s)
-            and clock() - last_save_at >= save_every_s
+            and clock() - last_save_at >= save_every_s * factor
+        )
+        # A tightened step cadence fires between the regular modulo
+        # points; issued as a "save" token so multi-host ranks obey
+        # process 0's view of the signal, not their own.
+        due_steps_tight = (
+            factor < 1.0
+            and bool(save_every_steps)
+            and step != last_saved
+            and step - last_saved
+            >= max(1, int(round(save_every_steps * factor)))
         )
         token = "stop" if stop.is_set() else (
-            "save" if due_clock else "run"
+            "save" if (due_clock or due_steps_tight) else "run"
         )
         if agree:
             token = manager.broadcast_from_zero(f"cadence-{step}", token)

@@ -17,6 +17,13 @@ the reconciles and apiserver calls that caused it.
 :class:`SloEngine` is the composition the manager and the serving
 gateway embed: evaluator + alert manager + a self-rate-limited ``tick``
 safe to call from hot paths (controller tick hooks, scrape handlers).
+
+Actuation (PR 11): :meth:`AlertManager.subscribe` registers callbacks
+invoked once per transition, OUTSIDE the manager lock (the same
+discipline the flight-recorder dump follows) — the autopilot's
+actuators ride the exact pending→firing edges that trigger black-box
+dumps. A failing subscriber is logged and isolated: it can never block
+alert evaluation or the other subscribers.
 """
 
 from __future__ import annotations
@@ -51,6 +58,9 @@ class AlertManager:
         self._tracer = tracer
         # (slo, speed) -> alert record (mutated in place).
         self._alerts: dict[tuple[str, str], dict] = {}
+        # Transition subscribers: one entry per registered actuator,
+        # fixed at wiring time.  # analysis: allow[py-unbounded-deque]
+        self._subscribers: list[Callable[[dict], None]] = []
         self.history: deque = deque(maxlen=max(1, int(history_limit)))
         # update() runs on controller tick / scrape threads while
         # /fleet and /debug/alerts read on HTTP handler threads;
@@ -58,15 +68,60 @@ class AlertManager:
         # RuntimeError, so writes and read snapshots share this lock.
         self._lock = threading.Lock()
 
+    # ---- subscriptions ---------------------------------------------------
+    def subscribe(self, callback: Callable[[dict], None]):
+        """Register ``callback(transition_event)`` for every state
+        transition this manager records. Callbacks run on whatever
+        thread called :meth:`update` (controller tick hooks, scrape
+        handlers), OUTSIDE the manager lock — a callback may read the
+        alert state back (``state_of``/``active``) without deadlock,
+        and a slow actuator never stalls evaluation. Exceptions are
+        logged and isolated per callback. Returns ``callback`` so the
+        method composes as a decorator."""
+        with self._lock:
+            self._subscribers.append(callback)
+        return callback
+
     # ---- updates ---------------------------------------------------------
-    def update(self, rows: list[dict], now: float | None = None) -> list[dict]:
+    def update(self, rows: list[dict], now: float | None = None,
+               notify: bool = True) -> list[dict]:
         """Advance every alert against one evaluation; returns the
-        transitions that happened (also recorded in ``history``)."""
+        transitions that happened (also recorded in ``history``).
+        With ``notify`` (the default) subscribers are dispatched here,
+        outside this manager's lock; a caller holding its OWN lock
+        around ``update`` (``SloEngine.tick``) passes ``notify=False``
+        and calls :meth:`notify` after releasing it — subscriber code
+        must never run under ANY evaluation lock."""
         now = self.clock() if now is None else now
         transitions: list[dict] = []
         with self._lock:
             self._update_locked(rows, now, transitions)
+        if notify:
+            self.notify(transitions)
         return transitions
+
+    def notify(self, transitions: list[dict]) -> None:
+        """Dispatch ``transitions`` to every subscriber (the dump
+        discipline: no lock held — actuators routinely read alert
+        state back, tick the owning engine, and perform their own
+        locked bookkeeping). Exceptions are logged and isolated per
+        callback."""
+        if not transitions:
+            return
+        with self._lock:
+            subscribers = list(self._subscribers)
+        for transition in transitions:
+            for callback in subscribers:
+                try:
+                    callback(transition)
+                except Exception:
+                    # One failing actuator must never block alerting or
+                    # the other actuators.
+                    log.exception(
+                        "alert subscriber %r failed on %s/%s -> %s",
+                        callback, transition["slo"],
+                        transition["speed"], transition["to"],
+                    )
 
     def _update_locked(self, rows: list[dict], now: float,
                        transitions: list[dict]) -> None:
@@ -246,12 +301,20 @@ class SloEngine:
                 return self.last_rows
             self._last_tick = now
             self.last_rows = self.evaluator.tick(now)
-            transitions = self.alerts.update(self.last_rows, now)
+            # notify=False: subscriber dispatch must not run under
+            # THIS engine's lock either — an actuator reading
+            # signal()/status() back would deadlock, and a slow one
+            # would stall every concurrent /v1/status, /fleet and
+            # scrape tick. Dispatched below, after release.
+            transitions = self.alerts.update(self.last_rows, now,
+                                             notify=False)
             rows = self.last_rows
-        # The dump (open + write + fsync) happens OUTSIDE the engine
-        # lock: a slow disk during an incident must not stall every
-        # concurrent /v1/status, /fleet and scrape tick behind it.
-        # The recorder's own rate limit serializes double-fires.
+        # Subscribers first (their actions land in the flight ring),
+        # then the dump — a black box captured for this very edge
+        # carries the actuations it triggered. Both run OUTSIDE the
+        # engine lock: a slow disk or actuator during an incident must
+        # not stall every concurrent status read behind it.
+        self.alerts.notify(transitions)
         if self.recorder is not None:
             fired = [t for t in transitions if t["to"] == FIRING]
             if fired:
@@ -262,11 +325,18 @@ class SloEngine:
                 )
         return rows
 
-    def status(self) -> dict:
-        """The JSON block ``/fleet`` and the gateway's ``/v1/status``
-        embed: per-objective burn rates + active alerts."""
+    def signal(self) -> dict:
+        """ONE coherent snapshot of the judging layer as a plain dict:
+        per-objective burn rates + alert states, read once (one locked
+        rows read + one alerts snapshot) instead of re-derived per
+        caller. Actuators, ``/v1/status`` and ``/fleet`` all consume
+        this view — an actuator and the status page can never disagree
+        about which alerts were firing at the same instant."""
+        with self._lock:
+            rows = list(self.last_rows)
+        alerts = {(a["slo"], a["speed"]): a for a in self.alerts.all()}
         objectives = {}
-        for row in self.last_rows:
+        for row in rows:
             objectives[row["slo"]] = {
                 "target": row["target"],
                 "threshold_s": row["threshold_s"],
@@ -275,11 +345,24 @@ class SloEngine:
                     for speed, win in row["windows"].items()
                 },
                 "states": {
-                    speed: self.alerts.state_of(row["slo"], speed)
+                    speed: alerts.get(
+                        (row["slo"], speed), {}
+                    ).get("state", INACTIVE)
                     for speed in row["windows"]
                 },
             }
+        active = [a for a in alerts.values() if a["state"] != INACTIVE]
         return {
             "objectives": objectives,
-            "alerts": self.alerts.active(),
+            "alerts": active,
+            "firing": sum(1 for a in active if a["state"] == FIRING),
+        }
+
+    def status(self) -> dict:
+        """The JSON block ``/fleet`` and the gateway's ``/v1/status``
+        embed — a thin view of :meth:`signal` (same coherent read)."""
+        sig = self.signal()
+        return {
+            "objectives": sig["objectives"],
+            "alerts": sig["alerts"],
         }

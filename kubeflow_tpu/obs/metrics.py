@@ -19,12 +19,13 @@ import time
 # The only label names any platform collector may use. Object identity
 # is always spelled namespace/name/controller (never ns/nb/component);
 # the rest are enumerated per-metric dimensions ("phase" is the
-# serving scheduler's prefill/decode split — PR 6). "le"/"quantile"
+# serving scheduler's prefill/decode split — PR 6; "actuator" is the
+# autopilot's bounded actuator-name set — PR 11). "le"/"quantile"
 # are the exposition-format internals histograms/summaries emit.
 CANONICAL_LABELS = frozenset({
     "namespace", "name", "controller",
     "accelerator", "verb", "kind", "result", "mode", "severity",
-    "method", "endpoint", "code", "outcome", "phase",
+    "method", "endpoint", "code", "outcome", "phase", "actuator",
     "le", "quantile",
 })
 

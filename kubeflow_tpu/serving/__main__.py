@@ -24,6 +24,12 @@ the CR template):
   verifies them in one batched dispatch — token-identical output,
   several tokens per dispatch on repetitive text. Ignored (with a
   warning) on windowed/rolling models.
+- ``KFT_AUTOPILOT`` — "0" disables the SLO autopilot (default on:
+  the gateway admission actuator tightens max_pending /
+  prefill_per_cycle while TTFT/ITL burn is critical and restores them
+  on resolve). ``KFT_AUTOPILOT_SHED_FACTOR`` (default 4) sets how
+  hard admission tightens; ``KFT_AUTOPILOT_MIN_INTERVAL_S`` (default
+  60) rate-limits actuations.
 """
 
 from __future__ import annotations
@@ -118,10 +124,27 @@ def main(argv=None) -> None:
         spec_draft=int(env.get("KFT_SERVING_SPEC_DRAFT", "8")),
         spec_ngram_n=int(env.get("KFT_SERVING_SPEC_NGRAM_N", "3")),
     )
+    autopilot = None
+    from kubeflow_tpu.autopilot import (
+        Autopilot,
+        GatewayAdmissionActuator,
+        autopilot_enabled,
+    )
+
+    if autopilot_enabled():
+        from kubeflow_tpu.obs.envknob import env_number
+
+        autopilot = Autopilot(recorder=engine.recorder)
+        autopilot.register(GatewayAdmissionActuator(
+            engine,
+            shed_factor=env_number("KFT_AUTOPILOT_SHED_FACTOR", 4,
+                                   cast=int, minimum=2),
+        ))
     gateway = InferenceGateway(
         engine,
         port=int(env.get("KFT_SERVING_PORT", "8800")),
         reload_fn=reload_fn,
+        autopilot=autopilot,
     ).start()
     log.info("inference gateway serving on :%d (batched=%s)",
              gateway.port, engine.batched)

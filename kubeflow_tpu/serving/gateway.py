@@ -231,6 +231,7 @@ class InferenceGateway:
                  reload_fn=None,
                  stream_timeout_s: float = 120.0,
                  slo=_DEFAULT_SLO,
+                 autopilot=None,
                  enable_debug: bool | None = None):
         self.engine = engine
         self.metrics = GatewayMetrics(engine)
@@ -249,6 +250,17 @@ class InferenceGateway:
                 self.metrics,
                 recorder=getattr(engine, "recorder", None))
         self.slo = slo
+        # Actuation (PR 11): an Autopilot rides the same pending→firing
+        # edges that dump the flight recorder — the admission actuator
+        # tightens max_pending/prefill_per_cycle while TTFT/ITL burn is
+        # critical. Its actions are exposed on /metrics
+        # (autopilot_actions_total) and in the /v1/status block.
+        self.autopilot = autopilot
+        if autopilot is not None:
+            from kubeflow_tpu.autopilot import AutopilotCollector
+
+            self.metrics.registry.register(AutopilotCollector(autopilot))
+            autopilot.attach(self.slo)
         # /debug/profile + /debug/flightrecord expose live phase
         # digests and the snapshot ring; like the manager's pprof-role
         # endpoints they are strictly opt-in (same env gate).
@@ -373,6 +385,8 @@ class InferenceGateway:
                 "dumps": recorder.dumps_total,
                 "last_dump_path": recorder.last_dump_path,
             }
+        if self.autopilot is not None:
+            doc["autopilot"] = self.autopilot.to_dict()
         return doc
 
     def start(self) -> "InferenceGateway":

@@ -1,0 +1,562 @@
+"""Game day: a seeded, replay-deterministic compressed fleet timeline.
+
+The scenario-diversity proof that the five instrument layers compose
+into a self-regulating system (ROADMAP item 5): one compressed "day"
+of fleet weather — traffic waves, queue pressure, a capacity
+shrink/regrow, an apiserver blackout — is driven through the chaos
+harness and the fake apiserver on an injected clock, and every
+autopilot actuator must close its loop:
+
+- the **gateway admission** actuator tightens ``max_pending`` /
+  ``prefill_per_cycle`` while the TTFT burn is critical and restores
+  them on resolve;
+- the **inference scale** actuator walks ``spec.replicas`` up under
+  sustained occupancy + backlog and back down when idle (the
+  StatefulSet follows, via the real inference controller);
+- the **checkpoint cadence** actuator tightens the save interval
+  through ``run_with_checkpointing``'s agreed-token consult while the
+  blackout alert fires (the scenario's training loop takes visibly
+  denser saves during the incident);
+- the **elastic promotion** gate defers the notebook's probe while the
+  capacity timeline says the spec shape cannot fit, then opens when
+  capacity regrows (the slice degrades v5e-16 → v5e-8 and climbs
+  back).
+
+Every actuation lands as a structured event + the
+``autopilot_actions_total`` counter + a span + a flight-recorder
+snapshot; every alert that fires during the timeline must reach
+``resolved`` by the end; and the whole run is a pure function of
+(seed, parameters) — ``replay_digest`` is byte-identical across
+replays (asserted by tests/test_autopilot.py).
+
+Determinism notes: controllers talk to the PLAIN fake apiserver (a
+chaos proxy in the reconcile path would park keys on real-time
+backoff, coupling the scenario to wall clock); the chaos proxy carries
+the *availability plane* — a fixed number of probe ops per tick, so
+the blackout window in op counts maps exactly onto scenario time, the
+same construction the PR-9 acceptance scenario uses. Capacity weather
+is scenario-time native (``FaultSchedule.capacity``).
+
+Usage::
+
+  python -m loadtest.game_day --seed 7 --hours 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.autopilot import (  # noqa: E402
+    ActuationGuard,
+    Autopilot,
+    CheckpointCadenceActuator,
+    ElasticPromotionGate,
+    GatewayAdmissionActuator,
+    InferenceScaleActuator,
+)
+from kubeflow_tpu.chaos import (  # noqa: E402
+    ChaosApiServer,
+    FaultSchedule,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+)
+from kubeflow_tpu.controllers.inference import (  # noqa: E402
+    INFERENCE_API,
+    make_inference_controller,
+)
+from kubeflow_tpu.controllers.manager import (  # noqa: E402
+    make_default_slo_engine,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics  # noqa: E402
+from kubeflow_tpu.controllers.notebook import (  # noqa: E402
+    NOTEBOOK_API,
+    make_notebook_controller,
+)
+from kubeflow_tpu.k8s.core import ApiError  # noqa: E402
+from kubeflow_tpu.k8s.fake import FakeApiServer  # noqa: E402
+from kubeflow_tpu.obs.recorder import FlightRecorder  # noqa: E402
+from kubeflow_tpu.obs.trace import Tracer  # noqa: E402
+
+# Elastic annotation keys (the ladder opt-in the scenario notebook
+# carries).
+from kubeflow_tpu.controllers.elastic import (  # noqa: E402
+    ELASTIC_GRACE_KEY,
+    ELASTIC_LADDER_KEY,
+    ELASTIC_PROMOTE_AFTER_KEY,
+    ELASTIC_SHAPE_KEY,
+)
+
+
+class Clock:
+    """The injected scenario clock every component shares."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+class StubServingEngine:
+    """The gateway engine's autopilot-facing surface, scripted by the
+    timeline: admission knobs the actuator mutates, occupancy/queue
+    signals the scale actuator reads. The control loop under test is
+    alert → actuator → knob/CR — decode itself is PR 6–8's proven
+    territory and stays out of the scenario's inner loop."""
+
+    def __init__(self, max_pending: int = 64,
+                 prefill_per_cycle: int = 4, slots_total: int = 8):
+        self.max_pending = max_pending
+        self.prefill_per_cycle = prefill_per_cycle
+        self.slots_total = slots_total
+        self.occupancy = 0
+        self.queue_depth = 0
+
+    def pending(self) -> int:
+        return self.queue_depth
+
+
+class GameDayCheckpointManager:
+    """Minimal manager for the scenario's training loop: counts saves
+    with their scenario timestamps (the cadence assertion's raw data).
+    Single-process — the SPMD discipline is pinned by the train-loop
+    unit tests, not re-proven here."""
+
+    process_count = 1
+
+    def __init__(self, clock):
+        self._clock = clock
+        self.fingerprint: dict = {}
+        self.saves: list[tuple[int, float]] = []
+
+    def restore_latest_valid(self, state, placements=None):
+        return None
+
+    def save_async(self, step, state):
+        self.saves.append((int(step), self._clock()))
+
+    def save(self, step, state):
+        self.saves.append((int(step), self._clock()))
+
+    def wait(self):
+        pass
+
+
+def _notebook(ns: str, name: str) -> dict:
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "annotations": {
+                ELASTIC_LADDER_KEY: "auto",
+                ELASTIC_GRACE_KEY: "300",
+                ELASTIC_PROMOTE_AFTER_KEY: "1800",
+            },
+        },
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "4x4"},
+            "template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter-jax-tpu"},
+            ]}},
+        },
+    }
+
+
+def _inference_service(ns: str, name: str) -> dict:
+    # No spec.tpu: a CPU gateway pool, so spec.replicas drives the
+    # StatefulSet directly and the scale actuation is visible end to
+    # end (on a TPU slice the annotation records the intent instead).
+    return {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"modelDir": "/models/dev", "replicas": 1},
+    }
+
+
+class GameDay:
+    """One scripted day. All phase boundaries are fractions of the run
+    so ``hours`` compresses the same arc; the SLO windows (5m/1h fast,
+    30m/6h slow) are real, so every phase is sized to let its alerts
+    fire AND resolve inside the timeline."""
+
+    OPS_PER_TICK = 4
+
+    # Phase boundaries as fractions of the total tick count.
+    WAVE = (0.05, 0.08)          # TTFT melts: admission must tighten
+    PRESSURE = (0.15, 0.24)      # full slots + backlog: scale up
+    IDLE = (0.24, 0.38)          # empty: scale back down
+    SHRINK_AT = 0.40             # capacity 16 -> 8: degrade + gate
+    REGROW_AT = 0.55             # capacity back: gate opens, promote
+    BLACKOUT = (0.60, 0.65)      # apiserver dark: cadence tightens
+
+    def __init__(self, seed: int = 7, hours: float = 24.0,
+                 tick_s: float = 60.0, dump_dir: str = "."):
+        self.seed = int(seed)
+        self.hours = float(hours)
+        self.tick_s = float(tick_s)
+        self.total_ticks = int(round(self.hours * 3600.0 / self.tick_s))
+        self.clk = Clock(0.0)
+        self.namespace = "fleet"
+
+        # --- chaos planes -------------------------------------------------
+        day_s = self.hours * 3600.0
+        b0 = int(self.BLACKOUT[0] * self.total_ticks) * self.OPS_PER_TICK
+        b1 = int(self.BLACKOUT[1] * self.total_ticks) * self.OPS_PER_TICK
+        self.schedule = (
+            FaultSchedule(seed=self.seed)
+            .blackout(b0, b1)
+            .capacity(0.0, 16)
+            .capacity(self.SHRINK_AT * day_s, 8, jitter_s=30.0)
+            .capacity(self.REGROW_AT * day_s, 16, jitter_s=30.0)
+        )
+        self.api = FakeApiServer()
+        self.proxy = ChaosApiServer(self.api, self.schedule,
+                                    sleep=lambda s: None)
+        self.sim = StatefulSetPodSimulator(
+            self.api, recreate_on_template_change=True)
+        self.injector = PreemptionInjector(self.api,
+                                           sleep=lambda s: None)
+
+        # --- observability ------------------------------------------------
+        self.tracer = Tracer(
+            sample_rate=1.0,
+            ring_capacity=max(4096, self.total_ticks),
+            clock=self.clk)
+        # Ring sized to the scenario: span/flight consistency checks
+        # compare against the action counter, so nothing may evict.
+        self.recorder = FlightRecorder(
+            capacity=max(4096, self.total_ticks),
+            dump_dir=dump_dir, min_dump_interval_s=300.0,
+            clock=self.clk, name=f"gameday-{self.seed}")
+        self.prom = ControllerMetrics()
+        self.manager_slo = make_default_slo_engine(
+            self.prom, self.proxy, clock=self.clk,
+            recorder=self.recorder)
+
+        from kubeflow_tpu.serving.gateway import (
+            GatewayMetrics,
+            make_gateway_slo_engine,
+        )
+
+        self.engine = StubServingEngine()
+        self.gw_metrics = GatewayMetrics(self.engine)
+        self.gateway_slo = make_gateway_slo_engine(
+            self.gw_metrics, clock=self.clk, recorder=self.recorder)
+
+        # --- the autopilot ------------------------------------------------
+        # history_limit sized to the scenario so the event log the
+        # digest covers never silently drops (events_emitted is the
+        # unbounded consistency counter regardless).
+        self.autopilot = Autopilot(
+            clock=self.clk, tracer=self.tracer,
+            recorder=self.recorder, enabled=True,
+            history_limit=max(4096, self.total_ticks))
+        self.admission = self.autopilot.register(GatewayAdmissionActuator(
+            self.engine,
+            guard=ActuationGuard(min_interval_s=300.0, clock=self.clk),
+        ))
+        self.scale = self.autopilot.register(InferenceScaleActuator(
+            self.api, self.namespace, "gateway",
+            status_fn=self._gateway_status,
+            guard=ActuationGuard(min_interval_s=900.0, clock=self.clk),
+            min_replicas=1, max_replicas=3, hold_s=600.0,
+            clock=self.clk,
+        ))
+        self.cadence = self.autopilot.register(CheckpointCadenceActuator(
+            capacity_fn=lambda: self.injector.capacity_chips,
+            guard=ActuationGuard(min_interval_s=300.0, clock=self.clk),
+        ))
+        self.gate = self.autopilot.register(ElasticPromotionGate(
+            capacity_fn=lambda: self.injector.capacity_chips,
+            guard=ActuationGuard(min_interval_s=1200.0, clock=self.clk),
+            clock=self.clk,
+        ))
+        self.autopilot.attach(self.manager_slo)
+        self.autopilot.attach(self.gateway_slo)
+
+        # --- control plane ------------------------------------------------
+        self.nb_ctrl = make_notebook_controller(
+            self.api, prom=self.prom, clock=self.clk,
+            promotion_gate=self.gate)
+        self.inf_ctrl = make_inference_controller(self.api,
+                                                  prom=self.prom)
+        self.api.create(_notebook(self.namespace, "trainer"))
+        self.api.create(_inference_service(self.namespace, "gateway"))
+
+        # --- data plane (training sim) ------------------------------------
+        self.ckpt = GameDayCheckpointManager(self.clk)
+        self.max_replicas_seen = 1
+        self.min_max_pending_seen = self.engine.max_pending
+        self.shapes_seen: list[str | None] = []
+
+    # ------------------------------------------------------------------
+    def _gateway_status(self) -> dict:
+        return {
+            "pending": self.engine.pending(),
+            "slots": {"active": self.engine.occupancy,
+                      "total": self.engine.slots_total},
+        }
+
+    def _in(self, tick: int, phase: tuple[float, float]) -> bool:
+        return (int(phase[0] * self.total_ticks) <= tick
+                < int(phase[1] * self.total_ticks))
+
+    def _traffic(self, tick: int) -> None:
+        """Scripted request weather onto the gateway's live metrics —
+        the same histograms the TTFT/ITL objectives judge."""
+        wave = self._in(tick, self.WAVE)
+        for _ in range(10):
+            self.gw_metrics.ttft.observe(30.0 if wave else 0.08)
+            self.gw_metrics.itl.observe(0.02)
+        if self._in(tick, self.PRESSURE):
+            self.engine.occupancy = self.engine.slots_total
+            self.engine.queue_depth = 6
+        else:
+            self.engine.occupancy = 1
+            self.engine.queue_depth = 0
+
+    def _availability_ops(self, tick: int) -> None:
+        """A fixed probe-op budget per tick through the chaos proxy:
+        the availability plane the apiserver objective judges. Op
+        counts advance deterministically, so the blackout window in
+        ops maps exactly onto scenario ticks."""
+        for _ in range(self.OPS_PER_TICK):
+            try:
+                self.proxy.list(NOTEBOOK_API, "Notebook")
+            except ApiError:
+                pass  # the blackout the scenario is about
+
+    def _sample(self) -> None:
+        self.min_max_pending_seen = min(self.min_max_pending_seen,
+                                        self.engine.max_pending)
+        try:
+            svc = self.api.get(INFERENCE_API, "InferenceService",
+                               "gateway", self.namespace)
+            replicas = int((svc.get("spec") or {}).get("replicas") or 1)
+            self.max_replicas_seen = max(self.max_replicas_seen,
+                                         replicas)
+        except Exception:
+            pass  # mid-delete read; next tick samples again
+        try:
+            nb = self.api.get(NOTEBOOK_API, "Notebook", "trainer",
+                              self.namespace)
+            shape = (nb["metadata"].get("annotations") or {}).get(
+                ELASTIC_SHAPE_KEY)
+            if not self.shapes_seen or self.shapes_seen[-1] != shape:
+                self.shapes_seen.append(shape)
+        except Exception:
+            pass
+
+    def _ticks(self):
+        """The world IS the batch iterator: each ``next()`` advances
+        one scenario tick — chaos weather, controllers, SLO engines,
+        autopilot — then yields one training batch, so the real
+        ``run_with_checkpointing`` drives the whole scenario and its
+        cadence consult sees the live alert state."""
+        for tick in range(self.total_ticks):
+            now = self.clk.advance(self.tick_s)
+            self._traffic(tick)
+            self._availability_ops(tick)
+            self.injector.apply_capacity(self.schedule, now, self.sim)
+            self.sim.step()
+            for ctrl in (self.nb_ctrl, self.inf_ctrl):
+                # Periodic resync: elastic timers (grace/promote) and
+                # the scale actuator's patches must be observed even
+                # when no watch event fires this tick.
+                ctrl.resync()
+                ctrl.run_once()
+            self.manager_slo.tick(now)
+            self.gateway_slo.tick(now)
+            self.autopilot.tick(now)
+            self._sample()
+            yield {"x": [0.0]}
+
+    # ------------------------------------------------------------------
+    def run(self) -> dict:
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        state = {"step": 0}
+
+        def step_fn(state, batch):
+            return dict(state, step=state["step"] + 1), {}
+
+        state, report = run_with_checkpointing(
+            step_fn, state, self._ticks(), self.ckpt,
+            save_every_s=3600.0,
+            cadence_signal=self.cadence.factor,
+            install_signal_handler=False,
+            clock=self.clk,
+        )
+        return self._summarize(report)
+
+    # ------------------------------------------------------------------
+    def _alert_ledger(self) -> tuple[list, list]:
+        """(transition history, unresolved) across both engines. An
+        alert counts as resolved when its firing has a later
+        ``resolved`` transition AND it is not active at the end."""
+        transitions = []
+        unresolved = []
+        for engine_name, engine in (("manager", self.manager_slo),
+                                    ("gateway", self.gateway_slo)):
+            history = list(engine.alerts.history)
+            for t in history:
+                transitions.append({
+                    "engine": engine_name, "slo": t["slo"],
+                    "speed": t["speed"], "from": t["from"],
+                    "to": t["to"], "at": t["at"],
+                })
+            fired = {(t["slo"], t["speed"]) for t in history
+                     if t["to"] == "firing"}
+            resolved = {(t["slo"], t["speed"]) for t in history
+                        if t["to"] == "resolved"}
+            still_active = {(a["slo"], a["speed"])
+                            for a in engine.alerts.active()}
+            for key in sorted((fired - resolved) | still_active):
+                unresolved.append(
+                    {"engine": engine_name, "slo": key[0],
+                     "speed": key[1]})
+        return transitions, unresolved
+
+    def _save_intervals(self) -> dict:
+        times = [at for _step, at in self.ckpt.saves]
+        intervals = [b - a for a, b in zip(times, times[1:])]
+        b0 = self.BLACKOUT[0] * self.total_ticks * self.tick_s
+        b1 = (self.BLACKOUT[1] * self.total_ticks * self.tick_s
+              + 3600.0)
+        incident = [b - a for a, b in zip(times, times[1:])
+                    if b0 <= b <= b1]
+        return {
+            "total": len(times),
+            "min_interval_s": round(min(intervals), 3) if intervals
+            else None,
+            "min_incident_interval_s": round(min(incident), 3)
+            if incident else None,
+        }
+
+    def _summarize(self, report) -> dict:
+        transitions, unresolved = self._alert_ledger()
+        events = list(self.autopilot.events)
+        counts = self.autopilot.counts()
+        fired_actuators = sorted({
+            e["actuator"] for e in events if e["outcome"] != "error"
+        })
+        # Metric ↔ event-log consistency: the counter is derived from
+        # the same emit pipeline, so the sums must match exactly.
+        counter_total = sum(self.autopilot.actions_total.values())
+        spans = sum(1 for s in self.tracer.ring.spans()
+                    if s.get("name") == "autopilot action")
+        flight_actions = sum(
+            1 for s in self.recorder.snapshots()
+            if s.get("kind") == "autopilot_action")
+        digest_payload = {
+            "events": [{k: v for k, v in e.items()} for e in events],
+            "transitions": transitions,
+            "counts": counts,
+            "saves": [[s, round(at, 3)]
+                      for s, at in self.ckpt.saves],
+            "shapes": self.shapes_seen,
+        }
+        digest = hashlib.sha256(
+            json.dumps(digest_payload, sort_keys=True).encode()
+        ).hexdigest()
+        try:
+            svc = self.api.get(INFERENCE_API, "InferenceService",
+                               "gateway", self.namespace)
+            final_replicas = int(
+                (svc.get("spec") or {}).get("replicas") or 1)
+        except Exception:
+            final_replicas = None
+        return {
+            "kind": "game_day",
+            "seed": self.seed,
+            "hours": self.hours,
+            "tick_s": self.tick_s,
+            "ticks": self.total_ticks,
+            "final_step": report.final_step,
+            "actuators_fired": fired_actuators,
+            "actions": counts,
+            "actions_total": counter_total,
+            # Counter-to-counter (the bounded deque is only a view).
+            "events_total": self.autopilot.events_emitted,
+            "events_logged": len(events),
+            "spans_total": spans,
+            "flight_actions": flight_actions,
+            "flight_dumps": self.recorder.dumps_total,
+            "alerts_fired": sorted({
+                f"{t['engine']}:{t['slo']}/{t['speed']}"
+                for t in transitions if t["to"] == "firing"
+            }),
+            "alerts_unresolved": unresolved,
+            "transitions": transitions,
+            "events": events,
+            "saves": self._save_intervals(),
+            "admission": {
+                "initial_max_pending": 64,
+                "min_max_pending": self.min_max_pending_seen,
+                "final_max_pending": self.engine.max_pending,
+            },
+            "scale": {
+                "max_replicas_seen": self.max_replicas_seen,
+                "final_replicas": final_replicas,
+            },
+            "elastic": {
+                "shapes": self.shapes_seen,
+                "gate_vetoes": self.gate.vetoes,
+                "gate_allows": self.gate.allows,
+            },
+            "replay_digest": digest,
+        }
+
+
+def run_game_day(seed: int = 7, hours: float = 24.0,
+                 tick_s: float = 60.0, dump_dir: str = ".") -> dict:
+    return GameDay(seed=seed, hours=hours, tick_s=tick_s,
+                   dump_dir=dump_dir).run()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay-deterministic game-day fleet timeline "
+        "asserting the autopilot closes every loop.")
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--hours", type=float, default=24.0)
+    parser.add_argument("--tick-s", type=float, default=60.0)
+    parser.add_argument("--dump-dir", default=".")
+    args = parser.parse_args(argv)
+    summary = run_game_day(seed=args.seed, hours=args.hours,
+                           tick_s=args.tick_s, dump_dir=args.dump_dir)
+    compact = {k: v for k, v in summary.items()
+               if k not in ("events", "transitions")}
+    print(json.dumps(compact))
+    problems = []
+    expected = {"gateway-admission", "inference-scale",
+                "checkpoint-cadence", "elastic-promotion"}
+    missing = expected - set(summary["actuators_fired"])
+    if missing:
+        problems.append(f"actuators never fired: {sorted(missing)}")
+    if summary["alerts_unresolved"]:
+        problems.append(
+            f"alerts unresolved: {summary['alerts_unresolved']}")
+    if summary["actions_total"] != summary["events_total"]:
+        problems.append("counter/event-log mismatch")
+    if problems:
+        print("GAME DAY FAILED: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

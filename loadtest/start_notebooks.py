@@ -109,6 +109,68 @@ def summarize(latencies: dict[str, float], mode: str) -> dict:
     }
 
 
+def _histogram_p99(families, name: str) -> float | None:
+    """p99 upper bound from a parsed Prometheus histogram: the
+    smallest ``le`` whose cumulative count covers 99% of the total,
+    summed across label sets (the ROADMAP item-3 soak gates on this
+    exact read-back, so the harness computes it the way a scraper
+    would — from the exposition, not in-process state)."""
+    buckets: dict[float, float] = {}
+    total = 0.0
+    for family in families:
+        if family.name != name:
+            continue
+        for sample in family.samples:
+            if sample.name.endswith("_bucket"):
+                try:
+                    le = float(sample.labels.get("le", "inf"))
+                except ValueError:
+                    continue
+                buckets[le] = buckets.get(le, 0.0) + sample.value
+            elif sample.name.endswith("_count"):
+                total += sample.value
+    if total <= 0:
+        return None
+    for le in sorted(buckets):
+        if buckets[le] >= 0.99 * total:
+            return le
+    return None
+
+
+def control_plane_summary(server, slo_engine, mode: str) -> dict:
+    """The churn-measurability line (the bridge to the ROADMAP item-3
+    soak): reconcile p99 and queue-wait p99 read back from the
+    manager's ``/metrics`` exposition, and the firing/active alert
+    counts from ``/fleet`` — one JSON object per run, so a soak
+    trajectory is a grep away."""
+    import urllib.request
+
+    from prometheus_client.parser import text_string_to_metric_families
+
+    if slo_engine is not None:
+        slo_engine.tick()
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+        families = list(
+            text_string_to_metric_families(resp.read().decode())
+        )
+    with urllib.request.urlopen(f"{base}/fleet", timeout=10) as resp:
+        fleet = json.loads(resp.read())
+    alerts = fleet.get("alerts") or []
+    return {
+        "metric": "control_plane_churn",
+        "mode": mode,
+        "reconcile_p99_s": _histogram_p99(
+            families, "controller_reconcile_duration_seconds"),
+        "queue_wait_p99_s": _histogram_p99(
+            families, "workqueue_queue_duration_seconds"),
+        "alerts_firing": sum(
+            1 for a in alerts if a.get("state") == "firing"),
+        "alerts_active": len(alerts),
+        "namespaces": len(fleet.get("namespaces") or {}),
+    }
+
+
 # ---------------------------------------------------------------------------
 # kubectl mode (real cluster)
 # ---------------------------------------------------------------------------
@@ -388,11 +450,28 @@ def run_simulate(
     pod_latency: float = 0.0,
     timeout: float = 60.0,
 ) -> dict:
+    """Simulate-mode run, instrumented: the controller runs with the
+    manager's metrics registry + default SLO engine behind a live
+    ManagerServer, and the summary carries a ``control_plane`` block
+    (reconcile p99 / queue-wait p99 / alert counts) read back from
+    ``/metrics`` + ``/fleet`` — the measurability bridge to the
+    ROADMAP item-3 churn soak."""
+    from kubeflow_tpu.controllers.manager import make_default_slo_engine
+    from kubeflow_tpu.controllers.metrics import (
+        ControllerMetrics,
+        ManagerServer,
+    )
     from kubeflow_tpu.controllers.notebook import make_notebook_controller
     from kubeflow_tpu.k8s import FakeApiServer
 
     api = FakeApiServer()
-    controller = make_notebook_controller(api)
+    prom = ControllerMetrics(api)
+    controller = make_notebook_controller(api, prom=prom)
+    slo_engine = make_default_slo_engine(prom, api)
+    controller.tick_hooks.append(slo_engine.tick)
+    prom.watch_controllers([controller])
+    server = ManagerServer(prom, slo=slo_engine, fleet_api=api)
+    server.start()
     kubelet = FakeKubelet(api, pod_latency=pod_latency)
     controller_thread = controller.start()
     try:
@@ -400,10 +479,15 @@ def run_simulate(
             api, kubelet, num_notebooks, namespace, timeout,
             poll_sleep=0.002,
         )
+        control_plane = control_plane_summary(server, slo_engine,
+                                              "simulate")
     finally:
         controller.stop()
         controller_thread.join(timeout=1)
-    return summarize(latencies, "simulate")
+        server.stop()
+    summary = summarize(latencies, "simulate")
+    summary["control_plane"] = control_plane
+    return summary
 
 
 def run_processes(
@@ -548,7 +632,14 @@ def main(argv: list[str] | None = None) -> int:
     else:
         summary = run_kubectl(args)
     if summary is not None:
+        # The control-plane block prints as its OWN JSON line so soak
+        # tooling greps one metric per line (same discipline as
+        # serve_qps's summary line).
+        control_plane = summary.pop("control_plane", None)
         print(json.dumps(summary))
+        if control_plane is not None:
+            print(json.dumps(control_plane))
+            summary["control_plane"] = control_plane
         if summary["count"] < args.num_notebooks:
             print(
                 f"WARNING: only {summary['count']}/{args.num_notebooks} "

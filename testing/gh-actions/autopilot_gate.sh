@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# Autopilot gate for CI (PR 11). Four checks:
+#
+# 1. Actuator tier-1 subset: the full tests/test_autopilot.py fast
+#    set — subscription plumbing (outside-lock dispatch, exception
+#    isolation), SloEngine.signal() coherence, every actuator's
+#    hysteresis under flap input, the disabled==instrument-only pin,
+#    and the compressed game-day arc with byte-identical replay —
+#    plus the py-unbounded-actuation rule fixtures in
+#    tests/test_analysis.py.
+#
+# 2. Disabled-switch smoke: KFT_AUTOPILOT=0 must make Autopilot()
+#    report disabled and install nothing (the Python-level half of the
+#    PR-10 behaviour pin; the full equality pin lives in the test
+#    suite).
+#
+# 3. Analysis: kubeflow_tpu/autopilot/ holds ZERO findings under
+#    every pack — including the new py-unbounded-actuation rule — with
+#    no pragma budget; the full kubeflow_tpu package stays clean too.
+#
+# 4. RUN_SLOW=1: the full 24h game-day timeline via the CLI (its own
+#    exit code gates: all four actuators fired, counter == event log,
+#    every fired alert resolved) and the summary artifact is asserted
+#    (parses as JSON, replay digest present, no unresolved alerts).
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== autopilot gate: actuator tier-1 subset =="
+python -m pytest -q -p no:cacheprovider -m 'not slow' \
+  tests/test_autopilot.py \
+  "tests/test_analysis.py::TestUnboundedActuationRule"
+
+echo "== autopilot gate: disabled switch =="
+KFT_AUTOPILOT=0 python - <<'PY'
+from kubeflow_tpu.autopilot import (
+    Autopilot,
+    GatewayAdmissionActuator,
+    autopilot_enabled,
+)
+from kubeflow_tpu.obs.alerts import SloEngine
+
+assert not autopilot_enabled(), "KFT_AUTOPILOT=0 must disable"
+pilot = Autopilot()
+assert not pilot.enabled
+engine = SloEngine()
+stub = type("E", (), {"max_pending": 64, "prefill_per_cycle": 2})()
+pilot.register(GatewayAdmissionActuator(stub))
+pilot.attach(engine)
+assert engine.alerts._subscribers == [], \
+    "disabled autopilot must install no subscription"
+assert pilot.actuators() == [], \
+    "disabled autopilot must drive no actuators"
+print("  KFT_AUTOPILOT=0: layer fully inert")
+PY
+
+echo "== autopilot gate: zero analysis findings (all packs) =="
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/autopilot"], check_emitted=False,
+))
+if findings:
+    for f in findings:
+        print(f.render())
+    raise SystemExit(
+        f"{len(findings)} finding(s) in kubeflow_tpu/autopilot/ — "
+        "the actuation layer carries no pragma budget"
+    )
+whole = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu"], check_emitted=False,
+))
+if whole:
+    for f in whole:
+        print(f.render())
+    raise SystemExit(
+        f"{len(whole)} finding(s) in kubeflow_tpu/ under the full "
+        "pack set (incl. py-unbounded-actuation)"
+    )
+print("  kubeflow_tpu/ (incl. autopilot/): zero findings, all packs")
+PY
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "== autopilot gate: full 24h game-day timeline =="
+  artifact="${AUTOPILOT_GAMEDAY_JSON:-game-day-summary.json}"
+  tmpdir="$(mktemp -d)"
+  python -m loadtest.game_day --seed 7 --hours 24 \
+    --dump-dir "$tmpdir" | tee "$artifact"
+  python - "$artifact" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    doc = json.loads(fh.read().strip().splitlines()[-1])
+assert doc["kind"] == "game_day", doc
+expected = {"gateway-admission", "inference-scale",
+            "checkpoint-cadence", "elastic-promotion"}
+assert set(doc["actuators_fired"]) == expected, doc["actuators_fired"]
+assert doc["alerts_unresolved"] == [], doc["alerts_unresolved"]
+assert doc["actions_total"] == doc["events_total"]
+assert doc["flight_dumps"] >= 1
+assert doc["replay_digest"]
+print(f"  game-day artifact ok: {doc['actions_total']} actions, "
+      f"{len(doc['alerts_fired'])} alerts fired+resolved, "
+      f"digest {doc['replay_digest'][:12]}…")
+PY
+  echo "== autopilot gate: slow suite (full game-day tests) =="
+  python -m pytest -q -p no:cacheprovider -m slow tests/test_autopilot.py
+fi
+
+echo "autopilot gate OK"

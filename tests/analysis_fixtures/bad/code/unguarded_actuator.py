@@ -1,0 +1,35 @@
+"""Seeded py-unbounded-actuation violations: alert callbacks that
+write or scale with no rate-limit/hysteresis guard in scope."""
+
+
+class NaiveScaler:
+    """Scales on every transition edge — an alert flapping at
+    evaluation frequency becomes an apiserver write storm."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def on_transition(self, transition):  # seeded: unguarded API write
+        self.api.patch_merge(
+            "serving.kubeflow.org/v1alpha1", "InferenceService", "svc",
+            {"spec": {"replicas": 5}}, "ns",
+        )
+
+
+class NaiveShedder:
+    """Mutates the live engine's admission knob on every edge."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def on_transition(self, transition):  # seeded: unguarded scaling
+        self.engine.max_pending = 1
+
+
+def _react(transition, api=None):  # seeded: subscribed, unguarded
+    api.create({"apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": "acted"}})
+
+
+def wire(alerts, api):
+    alerts.subscribe(_react)

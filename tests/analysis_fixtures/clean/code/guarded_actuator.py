@@ -1,0 +1,69 @@
+"""Clean counterparts for py-unbounded-actuation: guarded writes, hold
+windows, read-only callbacks, and the pragma escape."""
+
+
+class GuardedScaler:
+    """The sanctioned shape: every write sits behind a guard check."""
+
+    def __init__(self, api, guard):
+        self.api = api
+        self.guard = guard
+
+    def on_transition(self, transition):
+        if transition.get("to") != "firing":
+            return
+        if not self.guard.allow("scale"):
+            return
+        self.api.patch_merge(
+            "serving.kubeflow.org/v1alpha1", "InferenceService", "svc",
+            {"spec": {"replicas": 2}}, "ns",
+        )
+
+
+class HeldScaler:
+    """Hold-window hysteresis: the condition must persist hold_s
+    before one action is taken — discipline without a guard object."""
+
+    hold_s = 120.0
+
+    def __init__(self, api, clock):
+        self.api = api
+        self.clock = clock
+        self.pressure_since = None
+
+    def on_tick(self, now=None):
+        now = self.clock() if now is None else now
+        if self.pressure_since is None:
+            self.pressure_since = now
+            return
+        if now - self.pressure_since < self.hold_s:
+            return
+        self.pressure_since = None
+        self.api.patch_merge(
+            "serving.kubeflow.org/v1alpha1", "InferenceService", "svc",
+            {"spec": {"replicas": 3}}, "ns",
+        )
+
+
+class ReadOnlyObserver:
+    """A callback that only reads/records is not actuation."""
+
+    def __init__(self):
+        self.seen = 0
+
+    def on_transition(self, transition):
+        self.seen += 1
+
+
+class PragmaActuator:
+    """Deliberately unguarded (e.g. idempotent, change-gated upstream):
+    the pragma documents the judgement."""
+
+    def __init__(self, api):
+        self.api = api
+
+    # analysis: allow[py-unbounded-actuation]
+    def on_transition(self, transition):
+        self.api.patch_merge(
+            "v1", "ConfigMap", "flags", {"data": {"seen": "1"}}, "ns",
+        )

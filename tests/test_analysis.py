@@ -431,6 +431,158 @@ class TestUnboundedDequeRule:
         ) == 1
 
 
+class TestUnboundedActuationRule:
+    """py-unbounded-actuation: registered alert callbacks performing
+    API writes or scaling must keep a rate-limit/hysteresis guard in
+    scope (PR 11 — the autopilot's bounded-authority contract)."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-unbounded-actuation",
+                  "unguarded_actuator.py")
+        assert sorted(f.line for f in hits) == [12, 25, 29]
+        assert all(f.severity == Severity.WARNING for f in hits)
+        messages = " | ".join(f.message for f in hits)
+        assert "actuation storm" in messages
+        assert "ActuationGuard" in messages
+
+    def _findings(self, source, path="kubeflow_tpu/autopilot/x.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-unbounded-actuation"
+        ]
+
+    def test_guarded_write_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, guard):\n"
+            "        self.api = api\n"
+            "        self.guard = guard\n"
+            "    def on_transition(self, t):\n"
+            "        if self.guard.allow('scale'):\n"
+            "            self.api.patch_merge('v1', 'X', 'n', {}, 'ns')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_unguarded_write_fires(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "    def on_transition(self, t):\n"
+            "        self.api.patch_merge('v1', 'X', 'n', {}, 'ns')\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 4
+
+    def test_write_in_self_helper_is_attributed(self):
+        # One-level self-call expansion: the callback delegates the
+        # write to a helper; the finding anchors on the callback.
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "    def on_transition(self, t):\n"
+            "        self._act()\n"
+            "    def _act(self):\n"
+            "        self.api.delete('v1', 'Pod', 'p', 'ns')\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 4
+
+    def test_scaling_attr_write_fires(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, engine):\n"
+            "        self.engine = engine\n"
+            "    def on_transition(self, t):\n"
+            "        self.engine.max_pending = 1\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 4
+
+    def test_hold_window_discipline_is_clean(self):
+        # Hysteresis without a guard object: hold_s window bookkeeping
+        # counts as discipline.
+        src = (
+            "class A:\n"
+            "    hold_s = 60.0\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "        self.since = None\n"
+            "    def on_tick(self, now):\n"
+            "        if self.since and now - self.since >= self.hold_s:\n"
+            "            self.api.patch_merge('v1', 'X', 'n', {}, 'ns')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_read_only_callback_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def on_transition(self, t):\n"
+            "        print_nothing = t['slo']\n"
+        )
+        assert self._findings(src) == []
+
+    def test_dict_update_is_not_an_api_write(self):
+        # update() on a non-api receiver must not false-positive.
+        src = (
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.state = {}\n"
+            "    def on_transition(self, t):\n"
+            "        self.state.update({t['slo']: t['to']})\n"
+        )
+        assert self._findings(src) == []
+
+    def test_subscribed_module_function_fires(self):
+        src = (
+            "def react(t, api=None):\n"
+            "    api.create({'kind': 'Event'})\n"
+            "def wire(alerts):\n"
+            "    alerts.subscribe(react)\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 1
+        assert "react" in f.message
+
+    def test_unregistered_module_function_is_silent(self):
+        # Same body, never subscribed, not protocol-named: not a
+        # callback, not this rule's business.
+        src = (
+            "def helper(api):\n"
+            "    api.create({'kind': 'Event'})\n"
+        )
+        assert self._findings(src) == []
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "    # analysis: allow[py-unbounded-actuation]\n"
+            "    def on_transition(self, t):\n"
+            "        self.api.patch_merge('v1', 'X', 'n', {}, 'ns')\n"
+        )
+        target = tmp_path / "pragma_actuation.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-unbounded-actuation"] == []
+        target.write_text(src.replace(
+            "    # analysis: allow[py-unbounded-actuation]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len(
+            [f for f in findings if f.rule == "py-unbounded-actuation"]
+        ) == 1
+
+
 class TestUnboundedMetricLabelsRule:
     """py-unbounded-metric-labels flags request-derived label values
     only: the platform's sanctioned vocabulary (namespace/name object

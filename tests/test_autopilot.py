@@ -1,0 +1,890 @@
+"""SLO autopilot (ISSUE 11): alert-driven actuation with bounded
+authority.
+
+Four tiers:
+
+- **subscription plumbing**: AlertManager.subscribe delivers every
+  transition outside the manager lock (re-entrant reads work), one
+  failing subscriber never blocks alerting or its peers, and
+  SloEngine.signal() is the one coherent snapshot status() derives
+  from.
+- **actuator units**: each actuator's hysteresis under flap input
+  (bounded actions), change gating, guard rate limits, and
+  fail-safe behaviour on broken signals.
+- **disabled == instrument-only**: KFT_AUTOPILOT=0 / enabled=False
+  installs nothing — alert behaviour is byte-identical to the
+  pre-autopilot platform (the PR-10 pin).
+- **the game day**: the compressed fleet timeline on the chaos clock —
+  all four actuators fire, every actuation lands in every view
+  (counter == event log == spans == flight ring), every alert that
+  fires resolves by the end, and the replay digest is byte-identical
+  across runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kubeflow_tpu.autopilot import (
+    ActuationGuard,
+    Autopilot,
+    AutopilotCollector,
+    CheckpointCadenceActuator,
+    ElasticPromotionGate,
+    GatewayAdmissionActuator,
+    InferenceScaleActuator,
+    autopilot_enabled,
+)
+from kubeflow_tpu.autopilot.serving import DESIRED_REPLICAS_ANNOTATION
+from kubeflow_tpu.controllers.elastic import (
+    ELASTIC_GRACE_KEY,
+    ELASTIC_LADDER_KEY,
+    ELASTIC_PROMOTE_AFTER_KEY,
+    ELASTIC_PROMOTE_AT_KEY,
+    ELASTIC_SHAPE_KEY,
+    decide,
+)
+from kubeflow_tpu.controllers.inference import (
+    INFERENCE_API,
+    desired_statefulset,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.obs import alerts as obs_alerts
+from kubeflow_tpu.obs import slo as obs_slo
+from kubeflow_tpu.obs.alerts import AlertManager, SloEngine
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+def transition(slo="inference-ttft", speed="fast", to="firing",
+               severity="critical", at=0.0, frm="pending"):
+    return {"kind": "slo_alert", "slo": slo, "speed": speed,
+            "severity": severity, "from": frm, "to": to,
+            "burn": 20.0, "at": at, "namespace": None}
+
+
+def violated_rows(slo="inference-ttft", violated=True):
+    win = {"burn": 20.0, "factor": 14.4, "severity": "critical",
+           "for_s": 0.0, "clear_s": 0.0, "violated": violated}
+    return [{"slo": slo, "target": 0.99, "threshold_s": 1.0,
+             "namespace": None, "windows": {"fast": dict(win)}}]
+
+
+# ---------------------------------------------------------------------------
+# subscription plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSubscription:
+    def test_subscribers_see_every_transition(self):
+        clk = Clock()
+        manager = AlertManager(clock=clk)
+        seen = []
+        manager.subscribe(seen.append)
+        manager.update(violated_rows(), now=0.0)    # -> pending+firing
+        manager.update(violated_rows(violated=False), now=10.0)
+        tos = [t["to"] for t in seen]
+        assert tos == ["pending", "firing", "resolved"]
+        # The callback stream IS the history stream.
+        assert list(manager.history) == seen
+
+    def test_callbacks_run_outside_the_lock(self):
+        # A subscriber that reads alert state back would deadlock if
+        # dispatch held the manager lock.
+        manager = AlertManager(clock=Clock())
+        states = []
+        manager.subscribe(lambda t: states.append(
+            manager.state_of(t["slo"], t["speed"])))
+        manager.update(violated_rows(), now=0.0)
+        assert states  # read-back succeeded mid-dispatch
+
+    def test_failing_subscriber_never_blocks_others_or_alerting(self):
+        manager = AlertManager(clock=Clock())
+        seen = []
+
+        def boom(t):
+            raise RuntimeError("actuator crashed")
+
+        manager.subscribe(boom)
+        manager.subscribe(seen.append)
+        transitions = manager.update(violated_rows(), now=0.0)
+        assert len(transitions) == 2          # alerting unaffected
+        assert len(seen) == 2                 # peer still delivered
+        assert manager.state_of("inference-ttft", "fast") == "firing"
+
+    def test_subscribe_returns_callback(self):
+        manager = AlertManager()
+
+        @manager.subscribe
+        def cb(t):
+            pass
+
+        assert cb in manager._subscribers
+
+    def test_engine_driven_callbacks_may_read_the_engine_back(self):
+        """The documented contract end to end: a subscriber invoked by
+        SloEngine.tick reads engine.signal()/status() back — this
+        deadlocks unless dispatch escapes the ENGINE lock too, not
+        just the AlertManager lock."""
+        clk = Clock()
+        engine = SloEngine(
+            evaluator=obs_slo.BurnRateEvaluator(clock=clk))
+        counts = {"good": 0.0, "total": 0.0}
+        engine.register(obs_slo.Objective(
+            name="demo", target=0.99,
+            source=lambda: (counts["good"], counts["total"])))
+        snapshots = []
+        engine.alerts.subscribe(
+            lambda t: snapshots.append(engine.signal()))
+        for _ in range(10):
+            counts["total"] += 10.0          # all bad: fires fast
+            engine.tick(clk.advance(30.0))
+        assert snapshots, "scenario produced no transitions"
+        # The snapshot taken ON the firing edge already shows it.
+        assert any(s["firing"] for s in snapshots)
+
+
+class TestSignal:
+    def _engine(self):
+        clk = Clock()
+        engine = SloEngine(
+            evaluator=obs_slo.BurnRateEvaluator(clock=clk))
+        good = {"n": 0}
+        engine.register(obs_slo.Objective(
+            name="demo", target=0.99,
+            source=lambda: (good["n"], good["n"])))
+        return engine, clk
+
+    def test_signal_is_one_coherent_dict(self):
+        engine, clk = self._engine()
+        engine.tick(clk.advance(30.0))
+        sig = engine.signal()
+        assert set(sig) == {"objectives", "alerts", "firing"}
+        assert set(sig["objectives"]) == {"demo"}
+        demo = sig["objectives"]["demo"]
+        assert set(demo) == {"target", "threshold_s", "burn", "states"}
+        assert demo["states"]["fast"] == "inactive"
+        assert sig["firing"] == 0
+
+    def test_status_derives_from_signal(self):
+        engine, clk = self._engine()
+        engine.tick(clk.advance(30.0))
+        sig, status = engine.signal(), engine.status()
+        assert status == {"objectives": sig["objectives"],
+                          "alerts": sig["alerts"]}
+
+
+# ---------------------------------------------------------------------------
+# the core: guard, registry, emit pipeline
+# ---------------------------------------------------------------------------
+
+
+class TestActuationGuard:
+    def test_rate_limits_per_key(self):
+        clk = Clock()
+        guard = ActuationGuard(min_interval_s=60.0, clock=clk)
+        assert guard.allow("a")
+        assert not guard.allow("a")
+        assert guard.allow("b")      # independent key
+        clk.advance(61.0)
+        assert guard.allow("a")
+        assert guard.allowed == 3 and guard.suppressed == 1
+
+
+class TestAutopilotCore:
+    def test_emit_lands_in_every_view(self, tmp_path):
+        from kubeflow_tpu.obs.recorder import FlightRecorder
+        from kubeflow_tpu.obs.trace import Tracer
+
+        clk = Clock()
+        tracer = Tracer(sample_rate=1.0, clock=clk)
+        recorder = FlightRecorder(dump_dir=str(tmp_path), clock=clk)
+        pilot = Autopilot(clock=clk, tracer=tracer, recorder=recorder,
+                          enabled=True)
+        pilot.emit("demo", "acted", detail_key=1)
+        assert pilot.counts() == {"demo/acted": 1}
+        assert pilot.events[-1]["actuator"] == "demo"
+        assert any(s["name"] == "autopilot action"
+                   for s in tracer.ring.spans())
+        assert any(s["kind"] == "autopilot_action"
+                   for s in recorder.snapshots())
+        # Prometheus rendering matches the counter dict.
+        fams = list(AutopilotCollector(pilot).collect())
+        actions = next(f for f in fams if f.name == "autopilot_actions")
+        assert [(s.labels, s.value) for s in actions.samples] == [
+            ({"actuator": "demo", "outcome": "acted"}, 1.0)]
+
+    def test_actuator_exception_isolated_per_tick_and_transition(self):
+        pilot = Autopilot(clock=Clock(), enabled=True)
+
+        class Bad(GatewayAdmissionActuator):
+            name = "bad"
+
+            def on_transition(self, t):
+                raise RuntimeError("boom")
+
+            def on_tick(self, now=None):
+                raise RuntimeError("boom")
+
+        seen = []
+
+        class Good(GatewayAdmissionActuator):
+            name = "good"
+
+            def on_transition(self, t):
+                seen.append(t)
+
+            def on_tick(self, now=None):
+                seen.append(now)
+
+        engine = type("E", (), {"max_pending": 8,
+                                "prefill_per_cycle": 2})()
+        pilot.register(Bad(engine))
+        pilot.register(Good(engine))
+        pilot.on_transition(transition())
+        pilot.tick(now=1.0)
+        assert len(seen) == 2                  # peer always driven
+        assert pilot.counts()["bad/error"] == 2
+
+
+# ---------------------------------------------------------------------------
+# gateway admission actuator
+# ---------------------------------------------------------------------------
+
+
+class StubEngine:
+    def __init__(self, max_pending=64, prefill_per_cycle=4):
+        self.max_pending = max_pending
+        self.prefill_per_cycle = prefill_per_cycle
+
+
+class TestGatewayAdmission:
+    def _actuator(self, engine=None, clk=None):
+        clk = clk or Clock()
+        engine = engine or StubEngine()
+        return GatewayAdmissionActuator(
+            engine, guard=ActuationGuard(min_interval_s=60.0,
+                                         clock=clk)), engine, clk
+
+    def test_tighten_on_critical_firing_restore_on_resolve(self):
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(to="firing"))
+        assert engine.max_pending == 16
+        assert engine.prefill_per_cycle == 1
+        assert act.tightened
+        act.on_transition(transition(to="resolved", frm="firing"))
+        assert engine.max_pending == 64
+        assert engine.prefill_per_cycle == 4
+        assert not act.tightened
+
+    def test_warning_severity_is_ignored(self):
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(speed="slow", severity="warning"))
+        assert engine.max_pending == 64
+
+    def test_unwatched_objective_is_ignored(self):
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(slo="apiserver-availability"))
+        assert engine.max_pending == 64
+
+    def test_restore_waits_for_the_last_firing_alert(self):
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(slo="inference-ttft"))
+        act.on_transition(transition(slo="inference-itl"))
+        act.on_transition(transition(slo="inference-ttft",
+                                     to="resolved", frm="firing"))
+        assert engine.max_pending == 16    # itl still firing
+        act.on_transition(transition(slo="inference-itl",
+                                     to="resolved", frm="firing"))
+        assert engine.max_pending == 64
+
+    def test_flap_input_produces_bounded_actions(self):
+        actions = []
+        act, engine, clk = self._actuator()
+        act._emit = lambda outcome, **d: actions.append(outcome)
+        # 50 fire/resolve flaps inside one guard interval: at most one
+        # tighten lands; every restore returns to configured state.
+        for i in range(50):
+            act.on_transition(transition(to="firing", at=float(i)))
+            act.on_transition(transition(to="resolved", frm="firing",
+                                         at=float(i)))
+        assert actions.count("tightened") == 1
+        assert engine.max_pending == 64        # never stuck tightened
+        assert engine.prefill_per_cycle == 4
+
+    def test_second_incident_is_not_dropped_by_the_guard(self):
+        # One incident per objective, back to back inside the guard
+        # interval: the guard key is per alert, so the second
+        # incident's tighten must land, not be discarded for its
+        # lifetime.
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(slo="inference-ttft"))
+        act.on_transition(transition(slo="inference-ttft",
+                                     to="resolved", frm="firing"))
+        assert engine.max_pending == 64
+        act.on_transition(transition(slo="inference-itl", at=1.0))
+        assert engine.max_pending == 16       # second incident shed
+
+    def test_suppressed_tighten_is_retried_on_tick(self):
+        # Same alert re-fires inside the guard interval: the edge is
+        # suppressed, but once the interval passes the tick retry
+        # tightens — rate-limited, never lifetime-dropped.
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(to="firing"))
+        act.on_transition(transition(to="resolved", frm="firing"))
+        act.on_transition(transition(to="firing"))   # guard-suppressed
+        assert engine.max_pending == 64
+        act.on_tick()
+        assert engine.max_pending == 64       # still inside interval
+        clk.advance(61.0)
+        act.on_tick()
+        assert engine.max_pending == 16       # retry landed
+
+    def test_double_tighten_never_compounds(self):
+        act, engine, clk = self._actuator()
+        act.on_transition(transition(slo="inference-ttft"))
+        clk.advance(120.0)
+        act.on_transition(transition(slo="inference-itl"))
+        assert engine.max_pending == 16        # once, not 64//4//4
+        act.on_transition(transition(slo="inference-ttft",
+                                     to="resolved", frm="firing"))
+        act.on_transition(transition(slo="inference-itl",
+                                     to="resolved", frm="firing"))
+        assert engine.max_pending == 64
+
+
+# ---------------------------------------------------------------------------
+# inference scale actuator
+# ---------------------------------------------------------------------------
+
+
+def inference_service(name="svc", ns="team", replicas=1, tpu=None):
+    spec: dict = {"replicas": replicas}
+    if tpu:
+        spec["tpu"] = tpu
+    return {"apiVersion": INFERENCE_API, "kind": "InferenceService",
+            "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+class TestInferenceScale:
+    def _setup(self, status, replicas=1, **kwargs):
+        api = FakeApiServer()
+        api.create(inference_service(replicas=replicas))
+        clk = Clock()
+        doc = dict(status)
+        act = InferenceScaleActuator(
+            api, "team", "svc", status_fn=lambda: doc,
+            guard=ActuationGuard(min_interval_s=0.0, clock=clk),
+            hold_s=120.0, clock=clk, max_replicas=3, **kwargs)
+        return api, act, clk, doc
+
+    def _replicas(self, api):
+        svc = api.get(INFERENCE_API, "InferenceService", "svc", "team")
+        return svc["spec"]["replicas"]
+
+    def test_sustained_pressure_scales_up_with_annotation(self):
+        api, act, clk, doc = self._setup(
+            {"pending": 5, "slots": {"active": 8, "total": 8}})
+        act.on_tick(clk.advance(30.0))
+        assert self._replicas(api) == 1       # window still arming
+        act.on_tick(clk.advance(60.0))
+        assert self._replicas(api) == 1
+        act.on_tick(clk.advance(60.0))        # 150s held >= 120s
+        assert self._replicas(api) == 2
+        svc = api.get(INFERENCE_API, "InferenceService", "svc", "team")
+        assert svc["metadata"]["annotations"][
+            DESIRED_REPLICAS_ANNOTATION] == "2"
+        assert act.scale_ups == 1
+
+    def test_one_healthy_reading_rearms_the_window(self):
+        api, act, clk, doc = self._setup(
+            {"pending": 5, "slots": {"active": 8, "total": 8}})
+        act.on_tick(clk.advance(100.0))
+        doc.update({"pending": 0,
+                    "slots": {"active": 4, "total": 8}})  # neither up nor down
+        act.on_tick(clk.advance(30.0))
+        doc.update({"pending": 5, "slots": {"active": 8, "total": 8}})
+        act.on_tick(clk.advance(100.0))       # fresh window, not 230s
+        assert self._replicas(api) == 1
+        act.on_tick(clk.advance(130.0))
+        assert self._replicas(api) == 2
+
+    def test_sustained_idle_scales_down_to_floor_change_gated(self):
+        api, act, clk, doc = self._setup(
+            {"pending": 0, "slots": {"active": 0, "total": 8}},
+            replicas=2)
+        act.on_tick(clk.advance(60.0))
+        act.on_tick(clk.advance(130.0))
+        assert self._replicas(api) == 1
+        rv_before = api.get(INFERENCE_API, "InferenceService", "svc",
+                            "team")["metadata"]["resourceVersion"]
+        # Already at the floor: sustained idle writes NOTHING.
+        act.on_tick(clk.advance(200.0))
+        act.on_tick(clk.advance(200.0))
+        act.on_tick(clk.advance(200.0))
+        assert api.get(INFERENCE_API, "InferenceService", "svc",
+                       "team")["metadata"]["resourceVersion"] == rv_before
+
+    def test_guard_bounds_scale_rate_under_constant_pressure(self):
+        api = FakeApiServer()
+        api.create(inference_service())
+        clk = Clock()
+        act = InferenceScaleActuator(
+            api, "team", "svc",
+            status_fn=lambda: {"pending": 9,
+                               "slots": {"active": 8, "total": 8}},
+            guard=ActuationGuard(min_interval_s=600.0, clock=clk),
+            hold_s=60.0, clock=clk, max_replicas=8)
+        for _ in range(40):                    # 20 min of pressure
+            act.on_tick(clk.advance(30.0))
+        # hold 60s arms quickly, but the guard caps actions at one per
+        # 600s: 1200s of pressure buys at most 2-3 steps, not 20.
+        assert 1 <= self._replicas(api) - 1 <= 3
+
+    def test_broken_status_fn_is_safe_and_rearms(self):
+        api = FakeApiServer()
+        api.create(inference_service())
+        clk = Clock()
+
+        def broken():
+            raise OSError("gateway dark")
+
+        act = InferenceScaleActuator(
+            api, "team", "svc", status_fn=broken,
+            guard=ActuationGuard(min_interval_s=0.0, clock=clk),
+            hold_s=60.0, clock=clk)
+        act.on_tick(clk.advance(300.0))        # never raises
+        assert self._replicas(api) == 1
+
+    def test_spec_replicas_drives_non_tpu_statefulset_only(self):
+        sts = desired_statefulset(inference_service(replicas=3))
+        assert sts["spec"]["replicas"] == 3
+        # TPU slice: replicas = the slice host gang, not spec.replicas.
+        sts = desired_statefulset(inference_service(
+            replicas=3, tpu={"accelerator": "v5e", "topology": "4x4"}))
+        assert sts["spec"]["replicas"] == 4
+        # Junk coerces instead of crashing the reconciler.
+        sts = desired_statefulset(inference_service(replicas="bogus"))
+        assert sts["spec"]["replicas"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint cadence actuator + train-loop consult
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointCadence:
+    def test_critical_firing_tightens_until_resolved(self):
+        act = CheckpointCadenceActuator(
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        assert act.factor() == 1.0
+        act.on_transition(transition(slo="apiserver-availability"))
+        assert act.factor() == 0.25
+        act.on_transition(transition(slo="apiserver-availability",
+                                     to="resolved", frm="firing"))
+        assert act.factor() == 1.0
+
+    def test_warning_alerts_do_not_tighten_by_default(self):
+        act = CheckpointCadenceActuator(
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        act.on_transition(transition(severity="warning", speed="slow"))
+        assert act.factor() == 1.0
+
+    def test_objective_filter_overrides_severity(self):
+        act = CheckpointCadenceActuator(
+            objectives=("train-goodput",),
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        act.on_transition(transition(slo="apiserver-availability"))
+        assert act.factor() == 1.0             # filtered out
+        act.on_transition(transition(slo="train-goodput",
+                                     severity="warning"))
+        assert act.factor() == 0.25
+
+    def test_capacity_shrink_tightens_until_regrow(self):
+        readings = {"chips": 16}
+        act = CheckpointCadenceActuator(
+            capacity_fn=lambda: readings["chips"],
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        act.on_tick()
+        assert act.factor() == 1.0
+        readings["chips"] = 8
+        act.on_tick()
+        assert act.factor() == 0.25            # shrinking
+        act.on_tick()
+        assert act.factor() == 1.0             # held, not shrinking
+        readings["chips"] = 16
+        act.on_tick()
+        assert act.factor() == 1.0
+
+    def test_flap_emits_bounded_tighten_actions(self):
+        clk = Clock()
+        outcomes = []
+        act = CheckpointCadenceActuator(
+            guard=ActuationGuard(min_interval_s=600.0, clock=clk))
+        act._emit = lambda outcome, **d: outcomes.append(outcome)
+        for i in range(20):
+            act.on_transition(transition(at=float(i)))
+            act.on_transition(transition(to="resolved", frm="firing",
+                                         at=float(i)))
+        assert outcomes.count("tightened") == 1   # guard-bounded
+        assert act.factor() == 1.0                # state still correct
+
+    def _run_loop(self, signal):
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        clk = Clock()
+        saves = []
+
+        class Manager:
+            process_count = 1
+            fingerprint: dict = {}
+
+            def restore_latest_valid(self, state, placements=None):
+                return None
+
+            def save_async(self, step, state):
+                saves.append((step, clk()))
+
+            def save(self, step, state):
+                saves.append((step, clk()))
+
+            def wait(self):
+                pass
+
+        def step_fn(state, batch):
+            clk.advance(100.0)
+            return dict(state, step=state["step"] + 1), {}
+
+        batches = [{"x": [1]} for _ in range(20)]
+        _, report = run_with_checkpointing(
+            step_fn, {"step": 0}, batches, Manager(),
+            save_every_s=1000.0, cadence_signal=signal,
+            install_signal_handler=False, clock=clk)
+        return saves, report
+
+    def test_tightened_signal_makes_the_loop_save_sooner(self):
+        base_saves, _ = self._run_loop(lambda: 1.0)
+        tight_saves, _ = self._run_loop(lambda: 0.25)
+        # 2000s of steps: baseline cadence 1000s vs tightened 250s.
+        assert len(tight_saves) > len(base_saves)
+        base_gap = min(b - a for (_, a), (_, b)
+                       in zip(base_saves, base_saves[1:]))
+        tight_gap = min(b - a for (_, a), (_, b)
+                        in zip(tight_saves, tight_saves[1:]))
+        assert tight_gap < base_gap
+
+    def test_broken_signal_reads_as_normal_cadence(self):
+        def boom():
+            raise RuntimeError("signal source gone")
+
+        saves, report = self._run_loop(boom)
+        normal, _ = self._run_loop(lambda: 1.0)
+        assert report.final_step == 20
+        assert len(saves) == len(normal)
+
+    def test_step_cadence_tightens_through_the_factor(self):
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        saves = []
+
+        class Manager:
+            process_count = 1
+            fingerprint: dict = {}
+
+            def restore_latest_valid(self, state, placements=None):
+                return None
+
+            def save_async(self, step, state):
+                saves.append(step)
+
+            def save(self, step, state):
+                saves.append(step)
+
+            def wait(self):
+                pass
+
+        def step_fn(state, batch):
+            return dict(state, step=state["step"] + 1), {}
+
+        run_with_checkpointing(
+            step_fn, {"step": 0}, [{"x": [1]}] * 16, Manager(),
+            save_every_steps=8, cadence_signal=lambda: 0.25,
+            install_signal_handler=False, clock=Clock())
+        # 8-step cadence tightened x0.25 -> every 2 steps.
+        assert saves == [2, 4, 6, 8, 10, 12, 14, 16]
+
+
+# ---------------------------------------------------------------------------
+# elastic promotion gate
+# ---------------------------------------------------------------------------
+
+
+def elastic_notebook(shape="v5e-8", promote_at="1970-01-01T00:00:00Z"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {
+            "name": "mesh", "namespace": "user",
+            "annotations": {
+                ELASTIC_LADDER_KEY: "auto",
+                ELASTIC_GRACE_KEY: "30",
+                ELASTIC_PROMOTE_AFTER_KEY: "60",
+                ELASTIC_SHAPE_KEY: shape,
+                ELASTIC_PROMOTE_AT_KEY: promote_at,
+            },
+        },
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "4x4"}},
+    }
+
+
+def running_pod(name, chips=8, world="1"):
+    return {
+        "metadata": {"name": name, "uid": f"u-{name}"},
+        "status": {"phase": "Running"},
+        "spec": {"containers": [{
+            "resources": {"limits": {"google.com/tpu": str(chips)}},
+            "env": [{"name": "KFT_NUM_PROCESSES", "value": world}],
+        }]},
+    }
+
+
+class TestElasticPromotionGate:
+    def test_vetoes_when_capacity_below_target(self):
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: 8,
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        gate.on_tick()
+
+        class Target:
+            chips = 16
+            shorthand = "v5e-16"
+
+        assert not gate.allow_promotion(Target())
+        assert gate.vetoes == 1
+
+    def test_vetoes_while_shrinking_allows_after_regrow(self):
+        readings = {"chips": 32}
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: readings["chips"],
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        gate.on_tick()
+
+        class Target:
+            chips = 16
+            shorthand = "v5e-16"
+
+        readings["chips"] = 24           # shrinking, though 24 >= 16
+        gate.on_tick()
+        assert not gate.allow_promotion(Target())
+        gate.on_tick()                   # stable at 24: not shrinking
+        assert gate.allow_promotion(Target())
+        assert gate.allows == 1
+
+    def test_goodput_floor_vetoes(self):
+        class Meter:
+            def goodput_ratio(self):
+                return 0.2
+
+        gate = ElasticPromotionGate(
+            goodput=Meter(), min_goodput=0.5,
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+
+        class Target:
+            chips = 4
+            shorthand = "v5e-4"
+
+        assert not gate.allow_promotion(Target())
+
+    def test_no_signals_allows(self):
+        gate = ElasticPromotionGate()
+
+        class Target:
+            chips = 16
+            shorthand = "v5e-16"
+
+        assert gate.allow_promotion(Target())
+
+    def test_decide_defers_promotion_on_veto_and_rearms_probe(self):
+        nb = elastic_notebook()
+        pods = [running_pod("mesh-0")]
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: 8,
+            guard=ActuationGuard(min_interval_s=0.0, clock=Clock()))
+        decision = decide(nb, pods, now=1000.0, promotion_gate=gate)
+        # Vetoed: still at the degraded rung, probe clock re-armed.
+        assert decision.effective.shorthand == "v5e-8"
+        assert ELASTIC_PROMOTE_AT_KEY in decision.patches
+        assert decision.reshard_reason is None
+        assert not decision.events
+        assert gate.vetoes == 1
+        # Without the gate (or with capacity back) the probe fires.
+        open_gate = ElasticPromotionGate(capacity_fn=lambda: 16)
+        promoted = decide(nb, pods, now=1000.0,
+                          promotion_gate=open_gate)
+        assert promoted.effective.shorthand == "v5e-16"
+        assert any(e[0] == "SlicePromoted" for e in promoted.events)
+
+    def test_broken_gate_falls_back_to_probe_by_emitting(self):
+        nb = elastic_notebook()
+        pods = [running_pod("mesh-0")]
+
+        class Broken:
+            def allow_promotion(self, target):
+                raise RuntimeError("signal source gone")
+
+        decision = decide(nb, pods, now=1000.0,
+                          promotion_gate=Broken())
+        assert decision.effective.shorthand == "v5e-16"
+
+
+# ---------------------------------------------------------------------------
+# disabled == instrument-only (the PR-10 pin)
+# ---------------------------------------------------------------------------
+
+
+class TestDisabled:
+    def _scripted_history(self, pilot=None):
+        clk = Clock()
+        engine = SloEngine(
+            evaluator=obs_slo.BurnRateEvaluator(clock=clk))
+        counts = {"good": 0.0, "total": 0.0}
+        engine.register(obs_slo.Objective(
+            name="demo", target=0.99,
+            source=lambda: (counts["good"], counts["total"])))
+        stub = StubEngine()
+        if pilot is not None:
+            pilot.register(GatewayAdmissionActuator(
+                stub, objectives=("demo",)))
+            pilot.attach(engine)
+        for i in range(40):
+            bad = 10 <= i < 20
+            counts["total"] += 10.0
+            counts["good"] += 0.0 if bad else 10.0
+            engine.tick(clk.advance(30.0))
+        return [
+            (t["slo"], t["from"], t["to"], t["at"])
+            for t in engine.alerts.history
+        ], stub, engine
+
+    def test_env_switch_parses(self, monkeypatch):
+        monkeypatch.setenv("KFT_AUTOPILOT", "0")
+        assert not autopilot_enabled()
+        assert not Autopilot().enabled
+        monkeypatch.delenv("KFT_AUTOPILOT")
+        assert autopilot_enabled()
+
+    def test_disabled_is_behavior_identical_to_no_autopilot(self):
+        baseline, _, engine_a = self._scripted_history(pilot=None)
+        disabled = Autopilot(enabled=False)
+        with_disabled, stub, engine_b = self._scripted_history(
+            pilot=disabled)
+        assert baseline == with_disabled      # alert layer untouched
+        assert with_disabled                  # scenario produced edges
+        assert stub.max_pending == 64         # actuator never ran
+        assert disabled.counts() == {}
+        # attach() installed NO subscription at all.
+        assert engine_b.alerts._subscribers == []
+
+    def test_enabled_acts_on_the_same_scenario(self):
+        pilot = Autopilot(clock=Clock(), enabled=True)
+        _, stub, engine = self._scripted_history(pilot=pilot)
+        assert "gateway-admission/tightened" in pilot.counts()
+
+
+# ---------------------------------------------------------------------------
+# the game day
+# ---------------------------------------------------------------------------
+
+
+EXPECTED_ACTUATORS = {"gateway-admission", "inference-scale",
+                      "checkpoint-cadence", "elastic-promotion"}
+
+
+def assert_game_day_closed_loops(summary):
+    assert set(summary["actuators_fired"]) == EXPECTED_ACTUATORS
+    # Every actuation landed in EVERY view: the counter, the event
+    # log, the span stream and the flight-recorder ring agree exactly.
+    assert summary["actions_total"] == summary["events_total"]
+    assert summary["spans_total"] == summary["actions_total"]
+    assert summary["flight_actions"] == summary["actions_total"]
+    # Every alert that fired during the timeline resolved by the end,
+    # and the incidents dumped the black box.
+    assert summary["alerts_fired"]
+    assert summary["alerts_unresolved"] == []
+    assert summary["flight_dumps"] >= 1
+    # Each loop visibly closed and returned to steady state.
+    adm = summary["admission"]
+    assert adm["min_max_pending"] < adm["initial_max_pending"]
+    assert adm["final_max_pending"] == adm["initial_max_pending"]
+    assert summary["scale"]["max_replicas_seen"] >= 2
+    assert summary["scale"]["final_replicas"] == 1
+    assert summary["elastic"]["shapes"] == [None, "v5e-8", None]
+    assert summary["elastic"]["gate_vetoes"] >= 1
+    assert summary["elastic"]["gate_allows"] >= 1
+    saves = summary["saves"]
+    assert saves["min_incident_interval_s"] is not None
+    assert saves["min_incident_interval_s"] < 3600.0
+
+
+class TestGameDay:
+    def test_compressed_arc_closes_every_loop(self, tmp_path):
+        from loadtest.game_day import run_game_day
+
+        summary = run_game_day(seed=7, hours=5.0,
+                               dump_dir=str(tmp_path))
+        assert_game_day_closed_loops(summary)
+
+    def test_byte_identical_replay(self, tmp_path):
+        from loadtest.game_day import run_game_day
+
+        first = run_game_day(seed=7, hours=5.0,
+                             dump_dir=str(tmp_path / "a"))
+        second = run_game_day(seed=7, hours=5.0,
+                              dump_dir=str(tmp_path / "b"))
+        assert first["replay_digest"] == second["replay_digest"]
+        assert first["events"] == second["events"]
+        assert first["transitions"] == second["transitions"]
+
+    @pytest.mark.slow
+    def test_full_day_timeline(self, tmp_path):
+        from loadtest.game_day import run_game_day
+
+        summary = run_game_day(seed=7, hours=24.0,
+                               dump_dir=str(tmp_path))
+        assert_game_day_closed_loops(summary)
+        # The full day leaves room for the slowest (30m-window) pairs:
+        # nothing may still be active hours after the last incident.
+        assert summary["final_step"] == summary["ticks"] == 1440
+
+    @pytest.mark.slow
+    def test_cli_gates_on_its_own_assertions(self, tmp_path):
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "loadtest.game_day",
+             "--hours", "5", "--dump-dir", str(tmp_path)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        import json
+
+        line = proc.stdout.strip().splitlines()[-1]
+        doc = json.loads(line)
+        assert doc["kind"] == "game_day"
+        assert set(doc["actuators_fired"]) == EXPECTED_ACTUATORS

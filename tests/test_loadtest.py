@@ -74,6 +74,25 @@ class TestSimulate:
         assert summary["p50"] >= 0.05
         assert summary["mode"] == "simulate"
 
+    def test_simulate_emits_control_plane_summary(self):
+        """The churn-measurability bridge (ISSUE 11 satellite):
+        reconcile p99 + queue-wait p99 read back from the manager's
+        /metrics exposition and alert counts from /fleet — the numbers
+        the ROADMAP item-3 soak will gate on."""
+        summary = run_simulate(3, timeout=30.0)
+        cp = summary["control_plane"]
+        assert cp["metric"] == "control_plane_churn"
+        assert cp["mode"] == "simulate"
+        # Real reconciles happened, so the histograms carry samples
+        # and the p99 read-back resolves to a bucket bound.
+        assert cp["reconcile_p99_s"] is not None
+        assert 0 < cp["reconcile_p99_s"] <= 60.0
+        assert cp["queue_wait_p99_s"] is not None
+        # A healthy 3-notebook run fires nothing.
+        assert cp["alerts_firing"] == 0
+        assert cp["alerts_active"] >= 0
+        assert cp["namespaces"] >= 1
+
 
 class TestProcesses:
     @pytest.mark.slow
