@@ -90,6 +90,22 @@
   autopilot amplifying the incident it was built to absorb. Bounded
   authority is the contract (autopilot/core.py); deliberate
   exceptions escape with ``# analysis: allow[py-unbounded-actuation]``.
+- ``py-unbounded-queue-admission`` (warning): an admission/scheduling
+  loop — a function whose name mentions admit/admission/schedul with a
+  loop that removes work from a queue-ish collection (an identifier
+  mentioning queue/pending/backlog/waiting) — missing either half of
+  the admission discipline: **ordering** (an order-destroying removal
+  — bare ``pop()``, ``popitem()``, ``next(iter(queue))`` — with no
+  sort/heap call and no priority/FIFO/seq/age identifier in scope;
+  ``popleft``/``get``/``pop(0)`` are FIFO by construction and never
+  flag) or a **quota/capacity check** (no quota/capacity/fits/budget/
+  free/limit/slot identifier anywhere in scope). An admission loop
+  without an ordering key admits in arbitrary order (starvation by
+  accident); one without a capacity check oversubscribes the pool the
+  moment demand exceeds it. The slice-pool scheduler
+  (kubeflow_tpu/scheduler/) is the reference discipline; deliberate
+  exceptions escape with
+  ``# analysis: allow[py-unbounded-queue-admission]``.
 """
 
 from __future__ import annotations
@@ -777,6 +793,184 @@ def _check_unbounded_actuation(tree: ast.AST, path: str,
                     ))
 
 
+# --- py-unbounded-queue-admission -------------------------------------------
+# Receivers that read as a work queue / waiting set.
+_QUEUEISH_FRAGMENTS = ("queue", "pending", "backlog", "waiting")
+# Function-name fragments that mark an admission/scheduling loop.
+_ADMITISH_NAME_FRAGMENTS = ("admit", "admission", "schedul")
+# Calls that count as explicit ordering discipline.
+_ORDER_CALLS = {"sorted", "sort", "heappop", "heappush", "nsmallest",
+                "nlargest", "min", "max"}
+# Identifier fragments accepted as ordering-key discipline.
+_ORDER_IDENT_FRAGMENTS = ("priority", "fifo", "seq", "order", "arrival",
+                          "oldest", "rank", "aging")
+# Identifier fragments accepted as quota/capacity discipline.
+_CAPACITY_IDENT_FRAGMENTS = ("quota", "capacity", "fit", "budget",
+                             "free", "avail", "limit", "room", "slot")
+
+
+def _is_test_tree(path: str) -> bool:
+    """tests/ and testing/ trees build deliberate minimal loops (the
+    concurrency pack's exemption); fixture trees are scanned relative
+    to their own root, so they stay in scope."""
+    parts = path.replace("\\", "/").split("/")
+    if parts and parts[-1].startswith("test_"):
+        return True
+    return any(part in ("tests", "testing") for part in parts[:-1])
+
+
+def _queueish(node: ast.AST) -> bool:
+    name = _dotted(node, {}).lower()
+    return any(frag in name for frag in _QUEUEISH_FRAGMENTS)
+
+
+def _queue_removals(loop: ast.AST) -> tuple[list[int], list[int]]:
+    """(interaction lines, order-destroying lines) for queue-ish
+    removals inside one loop. ``popleft``/``get``/``get_nowait``/
+    ``pop(0)`` preserve arrival order; bare ``pop()`` (LIFO),
+    ``popitem()`` and ``next(iter(q))`` (arbitrary element) do not.
+    Plain ``for`` iteration over the queue is an interaction (the
+    capacity arm applies) but is order-preserving."""
+    interactions: list[int] = []
+    unordered: list[int] = []
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            if not _queueish(node.func.value):
+                continue
+            attr = node.func.attr
+            if attr in ("popleft", "get", "get_nowait"):
+                interactions.append(node.lineno)
+            elif attr == "pop":
+                interactions.append(node.lineno)
+                if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == 0
+                ):
+                    unordered.append(node.lineno)
+            elif attr == "popitem":
+                interactions.append(node.lineno)
+                unordered.append(node.lineno)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Name)
+              and node.func.id == "next" and node.args):
+            inner = node.args[0]
+            if (isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Name)
+                    and inner.func.id == "iter" and inner.args
+                    and _queueish(inner.args[0])):
+                interactions.append(node.lineno)
+                unordered.append(node.lineno)
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        it = loop.iter
+        # Direct iteration (for w in self.queue), method iteration
+        # (queue.values()), and wrapped iteration (sorted(queue, ...))
+        # all interact with the queue.
+        candidates = [it]
+        if isinstance(it, ast.Call):
+            candidates = [it.func, *it.args]
+        if any(_queueish(c) for c in candidates):
+            interactions.append(loop.lineno)
+    return interactions, unordered
+
+
+def _scope_idents(scope: ast.AST):
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name):
+            yield node.id
+        elif isinstance(node, ast.Attribute):
+            yield node.attr
+        elif isinstance(node, ast.arg):
+            yield node.arg
+        elif isinstance(node, ast.keyword) and node.arg:
+            yield node.arg
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name
+
+
+def _ordering_evidence(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if name in _ORDER_CALLS:
+                return True
+    return any(
+        any(frag in ident.lower() for frag in _ORDER_IDENT_FRAGMENTS)
+        for ident in _scope_idents(scope)
+    )
+
+
+def _capacity_evidence(scope: ast.AST) -> bool:
+    return any(
+        any(frag in ident.lower() for frag in _CAPACITY_IDENT_FRAGMENTS)
+        for ident in _scope_idents(scope)
+    )
+
+
+def _check_queue_admission(tree: ast.AST, path: str,
+                           out: list[Finding]) -> None:
+    """Flag admission/scheduling loops missing ordering or capacity
+    discipline. Scope for evidence is the function plus its enclosing
+    class (the py-unbounded-actuation convention: discipline may live
+    in a helper)."""
+    if _is_test_tree(path):
+        return
+
+    def scan(fn, scopes: list[ast.AST]) -> None:
+        if not any(frag in fn.name.lower()
+                   for frag in _ADMITISH_NAME_FRAGMENTS):
+            return
+        interactions: list[int] = []
+        unordered: list[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                got, disorder = _queue_removals(node)
+                interactions += got
+                unordered += disorder
+        if not interactions:
+            return
+        missing = []
+        if unordered and not any(_ordering_evidence(s) for s in scopes):
+            missing.append(
+                "no priority/FIFO ordering key (order-destroying pop "
+                f"at line {min(unordered)})"
+            )
+        if not any(_capacity_evidence(s) for s in scopes):
+            missing.append("no quota/capacity check")
+        if not missing:
+            return
+        out.append(Finding(
+            "py-unbounded-queue-admission", Severity.WARNING, path,
+            fn.lineno,
+            f"{fn.name} is an admission/scheduling loop over a work "
+            f"queue (line {min(interactions)}) with "
+            f"{' and '.join(missing)} in scope: admitting in "
+            "arbitrary order starves workloads by accident, and "
+            "admitting without a capacity/quota check oversubscribes "
+            "the pool the moment demand exceeds it — order the queue "
+            "(sorted key / FIFO pops) and check the pool before "
+            "admitting (kubeflow_tpu/scheduler/ is the reference "
+            "discipline), or annotate a deliberate case with "
+            "# analysis: allow[py-unbounded-queue-admission]",
+        ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scan(item, [item, node])
+        elif isinstance(node, ast.Module):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scan(item, [item])
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -864,6 +1058,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
 
     _check_nonatomic_writes(tree, aliases, path, out)  # module scope
     _check_unbounded_actuation(tree, path, out)
+    _check_queue_admission(tree, path, out)
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             is_traced = node.name in traced_names or any(

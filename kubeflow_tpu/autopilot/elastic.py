@@ -50,7 +50,15 @@ class ElasticPromotionGate(Actuator):
 
     A gate that cannot decide (no signals, broken reads) allows — the
     probe-by-emitting default remains the fallback, enforced on the
-    caller side too (``decide`` treats a raising gate as allow)."""
+    caller side too (``decide`` treats a raising gate as allow).
+
+    The **demotion arm** (PR 12) is the mirror image:
+    :meth:`should_demote` advises stepping the ladder DOWN while the
+    shape still runs full, the moment the pool view (e.g. the
+    slice-pool scheduler's capacity source) says the current shape's
+    chips are no longer there — a planned checkpointed reshard beats
+    the unplanned preemption that is otherwise coming. Opposite
+    fail-safe: a gate that cannot decide holds the shape."""
 
     name = "elastic-promotion"
 
@@ -58,9 +66,17 @@ class ElasticPromotionGate(Actuator):
                  capacity_fn: Callable[[], int | None] | None = None,
                  goodput=None, min_goodput: float = 0.5,
                  guard: ActuationGuard | None = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 pool_used_fn: Callable[[], int | None] | None = None):
         super().__init__(guard=guard)
         self.capacity_fn = capacity_fn
+        # The scheduler's pool view for the demotion arm: chips
+        # currently held by admitted workloads, e.g.
+        # ``lambda: scheduler.pool_snapshot()["used_chips"]``. In a
+        # shared pool the imminent-preemption signal is capacity <
+        # USED (someone will be evicted), not capacity < one
+        # workload's own shape.
+        self.pool_used_fn = pool_used_fn
         self.goodput = goodput
         self.min_goodput = float(min_goodput)
         self._clock = clock
@@ -71,6 +87,7 @@ class ElasticPromotionGate(Actuator):
         self._vetoed_since_allow = False
         self.vetoes = 0
         self.allows = 0
+        self.demotions = 0
 
     # ---- capacity trend sampling -----------------------------------------
     def on_tick(self, now: float | None = None) -> None:
@@ -98,18 +115,8 @@ class ElasticPromotionGate(Actuator):
         """The hook ``controllers.elastic.decide`` calls with the
         target rung's :class:`~kubeflow_tpu.topology.TpuSlice`."""
         with self._lock:
-            chips = self._last_capacity
             shrinking = self._shrinking
-            sampled = self._sampled
-        if not sampled and self.capacity_fn is not None:
-            # Never ticked (no autopilot loop driving it): read once so
-            # a bare gate still sees the pool.
-            try:
-                chips = self.capacity_fn()
-            except Exception:
-                log.debug("elastic-promotion: capacity read failed",
-                          exc_info=True)
-                chips = None
+        chips = self._pool_chips()
         reasons = []
         if shrinking:
             reasons.append("capacity shrinking")
@@ -153,3 +160,60 @@ class ElasticPromotionGate(Actuator):
                 reason="; ".join(reasons),
             )
         return False
+
+    # ---- the demotion arm (PR 12) ----------------------------------------
+    def _pool_chips(self) -> int | None:
+        """The latest capacity reading, sampling once when no autopilot
+        loop has ticked this gate yet (the allow_promotion fallback)."""
+        with self._lock:
+            chips = self._last_capacity
+            sampled = self._sampled
+        if not sampled and self.capacity_fn is not None:
+            try:
+                chips = self.capacity_fn()
+            except Exception:
+                log.debug("elastic-promotion: capacity read failed",
+                          exc_info=True)
+                chips = None
+        return chips
+
+    def should_demote(self, current) -> bool:
+        """The proactive arm ``controllers.elastic.decide`` consults
+        while a shape is running FULL: when the pool view says the
+        capacity is no longer there — below this workload's own shape,
+        or (with ``pool_used_fn``, the shared-pool signal) below the
+        chips admitted workloads collectively hold, meaning a
+        preemption is imminent for SOMEONE — step the ladder DOWN now,
+        a planned reshard through the checkpoint path, instead of
+        waiting for the preemption to tear the slice (an unplanned
+        restart plus a grace-window degrade). Unknown capacity never
+        demotes; a raising gate reads as "hold" on the caller side."""
+        chips = self._pool_chips()
+        need = getattr(current, "chips", None)
+        if chips is None or need is None:
+            return False
+        reason = None
+        if chips < need:
+            reason = (f"capacity {chips} chips < current shape "
+                      f"needs {need}")
+        elif self.pool_used_fn is not None:
+            try:
+                used = self.pool_used_fn()
+            except Exception:
+                log.debug("elastic-promotion: pool-used read failed",
+                          exc_info=True)
+                used = None
+            if used is not None and chips < int(used):
+                reason = (f"pool oversubscribed: capacity {chips} < "
+                          f"{int(used)} chips admitted — a preemption "
+                          "is imminent")
+        if reason is None:
+            return False
+        self.demotions += 1
+        if self.guard.allow("demote"):
+            self.record(
+                "demote-advised",
+                target=str(getattr(current, "shorthand", current)),
+                reason=reason,
+            )
+        return True

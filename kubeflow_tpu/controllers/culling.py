@@ -168,6 +168,7 @@ class CullingReconciler:
         tpu_busy_probe: Callable[[str, str], bool] | None = None,
         clock: Callable[[], float] = time.time,
         prom=None,  # optional ControllerMetrics (metrics.py)
+        scheduler=None,  # scheduler.SlicePoolScheduler (or None)
     ):
         self.api = api
         self.kernel_probe = kernel_probe
@@ -175,6 +176,7 @@ class CullingReconciler:
         self.tpu_busy_probe = tpu_busy_probe
         self.clock = clock
         self.prom = prom
+        self.scheduler = scheduler
 
     def reconcile(self, req: Request) -> float | None:
         if not self.options.enabled:
@@ -232,6 +234,55 @@ class CullingReconciler:
             if self.tpu_busy_probe(req.namespace, req.name):
                 config["tpuBusy"] = True
                 decision = decide()
+        reclaim = (
+            decision["action"] == "stop"
+            and self.scheduler is not None
+            and bool((notebook.get("spec") or {}).get("tpu"))
+            and self.scheduler.tracks("Notebook", req.namespace,
+                                      req.name)
+        )
+        if reclaim:
+            # Scheduler-managed slice: the idle verdict feeds the pool
+            # instead of the hard stop — the scheduler drains through
+            # the checkpoint grace path, scales to zero
+            # (status.phase=Suspended) and returns the chips; first
+            # touch resurrects via the resume handshake. The idleness
+            # bookkeeping is still written, but NOT the stop
+            # annotation (a kubeflow-resource-stopped slice would need
+            # a manual start; a Suspended one comes back by itself).
+            # A slice the scheduler does NOT track (e.g. an
+            # invalid-topology spec the gate skipped) instead falls
+            # through to the normal stop below: idle chips must never
+            # be held by a workload no scheduler can reclaim. For a
+            # tracked one, mark_reclaimable is idempotent — False when
+            # already draining/suspended, which stays on this branch
+            # so the hard stop never races an in-flight reclaim.
+            annotations = {
+                k: v for k, v in decision["annotations"].items()
+                if k != "kubeflow-resource-stopped"
+            }
+            if annotations:
+                self.api.patch_merge(
+                    NOTEBOOK_API, "Notebook", req.name,
+                    {"metadata": {"annotations": annotations}},
+                    req.namespace,
+                )
+            if self.scheduler.mark_reclaimable(
+                "Notebook", req.namespace, req.name,
+                now=self.clock(),
+            ):
+                log.info("marked idle notebook %s/%s reclaimable",
+                         req.namespace, req.name)
+                record_event(
+                    self.api, notebook, "SliceReclaimable",
+                    f"Notebook {req.name} idle past the threshold; "
+                    "checkpointing, then scaling to zero (chips "
+                    "return to the slice pool; first touch "
+                    "resurrects)",
+                    component="notebook-culler",
+                    clock=self.clock,
+                )
+            return float(decision["requeueAfterSec"])
         if decision["action"] in ("update-annotations", "stop"):
             self.api.patch_merge(
                 NOTEBOOK_API,
@@ -268,6 +319,7 @@ def make_culling_controller(
     tpu_busy_probe: Callable[[str, str], bool] | None = None,
     clock: Callable[[], float] = time.time,
     prom=None,
+    scheduler=None,
 ) -> Controller:
     reconciler = CullingReconciler(
         api,
@@ -276,6 +328,7 @@ def make_culling_controller(
         tpu_busy_probe,
         clock,
         prom=prom,
+        scheduler=scheduler,
     )
     return Controller(
         name="culling-controller",

@@ -152,6 +152,24 @@ def _seconds(anns: dict, key: str, default: float) -> float:
         return default
 
 
+def _demotion_advised(gate, current: TpuSlice) -> bool:
+    """Consult the gate's proactive demotion arm (``should_demote``
+    duck type — :class:`kubeflow_tpu.autopilot.ElasticPromotionGate`
+    wired to the scheduler's pool view). Opposite fail-safe to the
+    promote arm: a broken or absent gate must never reshape a healthy
+    running slice, so any failure reads as "hold the shape"."""
+    if not hasattr(gate, "should_demote"):
+        return False
+    try:
+        return bool(gate.should_demote(current))
+    except Exception:
+        log.warning(
+            "elastic demotion gate failed; holding the current shape",
+            exc_info=True,
+        )
+        return False
+
+
 def _promotion_allowed(gate, target: TpuSlice) -> bool:
     """Consult a promotion gate (``allow_promotion(target)`` duck
     type, or a plain callable). A broken gate must never wedge a
@@ -314,6 +332,40 @@ def decide(notebook: dict, pods: list | None, now: float,
             "chips)",
             "Normal",
         ))
+    if (full and reshard_reason is None and promotion_gate is not None
+            and rung + 1 < len(rungs)
+            and _demotion_advised(promotion_gate, effective)):
+        # Proactive demotion (ROADMAP item-5 follow-up): the pool view
+        # says the current shape is about to lose nodes — step DOWN
+        # through the normal checkpointed reshard NOW, while every
+        # worker still runs, instead of eating the preemption (an
+        # unplanned all-or-nothing restart) and only then degrading
+        # after the grace window.
+        target = rungs[rung + 1]
+        reshard_reason = (
+            f"demoting {effective.shorthand} -> {target.shorthand}: "
+            "capacity below the current shape (proactive step-down "
+            "ahead of the preemption)"
+        )
+        patches.update({
+            ELASTIC_SHAPE_KEY: target.shorthand,
+            ELASTIC_WORLD_SIZE_KEY: str(target.num_hosts),
+            ELASTIC_PENDING_SINCE_KEY: None,
+            ELASTIC_PROMOTE_AT_KEY: rfc3339(now + promote_after_s),
+            RESHARD_REASON_KEY: reshard_reason,
+        })
+        events.append((
+            "SliceDegraded",
+            f"{reshard_reason}; re-emitting StatefulSet at "
+            f"{target.num_hosts} worker(s) x "
+            f"{target.chips_per_replica} chips, training resumes "
+            "from the last checkpoint on the re-factored mesh",
+            "Warning",
+        ))
+        return ElasticDecision(
+            target, patches, events, reshard_reason,
+            at_spec_shape=False,
+        )
     if rung == 0:
         # Nothing to promote at the spec shape; also sweep a stale
         # shape annotation (a spec/ladder edit can orphan one, and a

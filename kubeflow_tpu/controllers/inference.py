@@ -25,6 +25,7 @@ controller's template cannot conflict with them.
 from __future__ import annotations
 
 import logging
+import time
 
 from kubeflow_tpu.controllers.runtime import (
     Controller,
@@ -32,6 +33,12 @@ from kubeflow_tpu.controllers.runtime import (
     WatchSpec,
     ensure_object,
     record_event,
+)
+from kubeflow_tpu.controllers.scheduling import (
+    apply_verdict,
+)
+from kubeflow_tpu.controllers.scheduling import (
+    observed_running as sched_observed_running,
 )
 from kubeflow_tpu.controllers.slice_recovery import (
     SliceAnnotations,
@@ -50,6 +57,11 @@ INFERENCE_API = "serving.kubeflow.org/v1alpha1"
 OBSERVED_MESH_KEY = "inference.kubeflow-tpu.org/observed-mesh"
 RESTART_REASON_KEY = "inference.kubeflow-tpu.org/restart-reason"
 PREEMPTION_RESTARTS_KEY = "inference.kubeflow-tpu.org/preemption-restarts"
+# Scheduler resurrect handshake (the notebook CRD's resume-expected
+# contract, in this CRD's namespace): the step a resurrected gateway
+# is expected to restore from — durable BEFORE the scheduler's
+# re-deliver-until-acked handshake is acked.
+RESUME_EXPECTED_KEY = "inference.kubeflow-tpu.org/resume-expected-step"
 
 DEFAULT_GATEWAY_PORT = 8800
 DEFAULT_IMAGE = "kubeflow-tpu/inference-gateway:latest"
@@ -235,9 +247,12 @@ def pod_to_inference_requests(obj: dict) -> list[Request]:
 
 
 class InferenceReconciler:
-    def __init__(self, api: FakeApiServer, prom=None):
+    def __init__(self, api: FakeApiServer, prom=None, scheduler=None,
+                 clock=time.time):
         self.api = api
         self.prom = prom
+        self.scheduler = scheduler
+        self.clock = clock
 
     def reconcile(self, req: Request) -> float | None:
         try:
@@ -246,7 +261,12 @@ class InferenceReconciler:
                 req.namespace,
             )
         except NotFound:
-            # Deleted: children garbage-collect via ownerReferences.
+            # Deleted: children garbage-collect via ownerReferences;
+            # the pool admission is released.
+            if self.scheduler is not None:
+                self.scheduler.release(
+                    "InferenceService", req.namespace, req.name
+                )
             return None
         try:
             desired = desired_statefulset(svc)
@@ -271,6 +291,12 @@ class InferenceReconciler:
                     req.namespace,
                 )
             return None
+        # Slice-pool gate: serving schedules out of the same chip pool
+        # as notebooks/training — an unadmitted gang runs at zero
+        # replicas and the CR says why (status.phase=Queued/Suspended).
+        sched_verdict = self._schedule(svc, req)
+        if sched_verdict is not None and not sched_verdict.admitted:
+            desired["spec"]["replicas"] = 0
         try:
             sts_result = ensure_object(self.api, desired)
         except Exception as exc:
@@ -302,8 +328,39 @@ class InferenceReconciler:
             label_selector=f"inferenceservice-name={req.name}",
         )
         restart_reason = self._preemption_recovery(svc, req, sts, pods)
-        self._update_status(svc, restart_reason, sts, pods)
+        self._update_status(svc, restart_reason, sts, pods,
+                            sched_verdict=sched_verdict)
         return None
+
+    def _schedule(self, svc: dict, req: Request):
+        """Consult the slice-pool scheduler with the TPU gang demand
+        (non-TPU gateway pools are not pool-scheduled — their replica
+        count is the autopilot's horizontal-scale territory)."""
+        if self.scheduler is None:
+            return None
+        try:
+            tpu_slice = _slice_for(svc)
+        except TopologyError:
+            return None  # the InvalidSpec branch surfaces it
+        if tpu_slice is None:
+            return None
+        anns = svc.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )
+        verdict = self.scheduler.decide(
+            "InferenceService", req.namespace, req.name,
+            tpu_slice.chips, anns, now=self.clock(),
+            observed_running=sched_observed_running(self.api, req),
+        )
+        apply_verdict(
+            self.api, INFERENCE_API, "InferenceService", svc, req,
+            verdict, self.scheduler, self.clock,
+            resume_key=RESUME_EXPECTED_KEY,
+            resume_message="admitted from Suspended; the gateway "
+                           "resumes serving from checkpoint step "
+                           "{step}",
+        )
+        return verdict
 
     def _preemption_recovery(
         self, svc: dict, req: Request,
@@ -336,7 +393,8 @@ class InferenceReconciler:
         )
 
     def _update_status(self, svc: dict, restart_reason: str | None,
-                       sts: dict | None, pods: list) -> None:
+                       sts: dict | None, pods: list,
+                       sched_verdict=None) -> None:
         name = svc["metadata"]["name"]
         ns = svc["metadata"]["namespace"]
         replicas = ((sts or {}).get("spec") or {}).get("replicas") or 0
@@ -349,7 +407,12 @@ class InferenceReconciler:
             if any(c.get("type") == "Ready"
                    and c.get("status") == "True" for c in conditions):
                 ready += 1
-        if restart_reason:
+        if sched_verdict is not None and sched_verdict.phase:
+            # The scheduler's view wins: a Queued/Suspended slice holds
+            # zero replicas on purpose — "Stopped" would misreport a
+            # deliberate pool decision.
+            phase = sched_verdict.phase
+        elif restart_reason:
             phase = "Restarting"
         elif sts is None or replicas == 0:
             phase = "Stopped" if sts is not None else "Pending"
@@ -365,16 +428,28 @@ class InferenceReconciler:
         }
         if restart_reason:
             status["restartReason"] = restart_reason
+        if sched_verdict is not None and sched_verdict.phase:
+            if sched_verdict.reason:
+                status["schedulingReason"] = sched_verdict.reason
+            if sched_verdict.queue_position is not None:
+                status["queuePosition"] = sched_verdict.queue_position
         cur = svc.get("status") or {}
         own = {k: cur.get(k) for k in status}
-        if own == status and ("restartReason" in cur) == (
-                "restartReason" in status):
+        if own == status and all(
+            (key in cur) == (key in status)
+            for key in ("restartReason", "schedulingReason",
+                        "queuePosition")
+        ):
             return
         patch = dict(status)
         if not restart_reason and "restartReason" in cur:
             # Merge-patch semantics: a completed recovery's marker must
             # be deleted explicitly or it lingers forever.
             patch["restartReason"] = None
+        for key in ("schedulingReason", "queuePosition"):
+            # Same rule for the scheduler's markers once re-admitted.
+            if key not in status and key in cur:
+                patch[key] = None
         if "message" in cur:
             # Same rule for a healed InvalidSpec failure's message — a
             # recovered CR must not read Running + stale error text.
@@ -388,8 +463,11 @@ class InferenceReconciler:
 def make_inference_controller(
     api: FakeApiServer,
     prom=None,
+    scheduler=None,
+    clock=time.time,
 ) -> Controller:
-    reconciler = InferenceReconciler(api, prom=prom)
+    reconciler = InferenceReconciler(api, prom=prom, scheduler=scheduler,
+                                     clock=clock)
     return Controller(
         name="inference-controller",
         api=api,

@@ -29,14 +29,17 @@ from kubeflow_tpu.obs.envknob import env_bool as _env_bool
 
 
 def make_default_slo_engine(prom: ControllerMetrics, api=None,
-                            clock=None, recorder=None):
+                            clock=None, recorder=None, scheduler=None):
     """The control-plane SLO set every manager ships with
     (obs.slo defaults; KFT_SLO_* env tunes targets/thresholds):
     reconcile duration, workqueue queue-wait, and — when the api handle
     counts availability (real ApiClient, chaos proxy) — apiserver
-    availability. With a ``recorder`` (the manager-shared
-    FlightRecorder), any alert going firing dumps the reconcile
-    snapshot ring — the black-box window leading up to the burn."""
+    availability; with a slice-pool ``scheduler``, the gang-admission
+    queue-wait objective rides along so the scheduler's cost is judged
+    by the same burn-rate machinery. With a ``recorder`` (the
+    manager-shared FlightRecorder), any alert going firing dumps the
+    reconcile snapshot ring — the black-box window leading up to the
+    burn."""
     from kubeflow_tpu import obs
     from kubeflow_tpu.obs import slo as obs_slo
 
@@ -47,6 +50,10 @@ def make_default_slo_engine(prom: ControllerMetrics, api=None,
     engine.register(obs_slo.queue_wait_objective(prom))
     if api is not None and hasattr(api, "availability_counts"):
         engine.register(obs_slo.apiserver_availability_objective(api))
+    if scheduler is not None and getattr(scheduler, "enabled", True):
+        from kubeflow_tpu.scheduler import scheduler_queue_wait_objective
+
+        engine.register(scheduler_queue_wait_objective(scheduler))
     return engine
 
 
@@ -96,10 +103,19 @@ class Manager:
         slo=_DEFAULT_SLO,
         recorder=None,
         autopilot=None,
+        scheduler=None,
     ):
         self.api = api
         self.controllers = controllers
         self.prom = prom
+        # Slice-pool scheduler (PR 12): a disabled one (KFT_SCHEDULER=0)
+        # is treated exactly like none at all — no collector, no SLO
+        # objective, no debug surface, no tick hook; behaviour stays
+        # byte-identical to the scheduler-less manager.
+        if scheduler is not None and not getattr(
+                scheduler, "enabled", True):
+            scheduler = None
+        self.scheduler = scheduler
         self._threads: list = []
         self._running = False
         self.server = None
@@ -124,9 +140,21 @@ class Manager:
         # an explicit None disables the layer.
         if slo is _DEFAULT_SLO:
             slo = (make_default_slo_engine(prom, api,
-                                           recorder=self.recorder)
+                                           recorder=self.recorder,
+                                           scheduler=scheduler)
                    if prom is not None else None)
         self.slo = slo
+        if scheduler is not None:
+            if prom is not None and hasattr(prom, "registry"):
+                from kubeflow_tpu.scheduler import SchedulerCollector
+
+                prom.registry.register(SchedulerCollector(scheduler))
+            for ctrl in controllers:
+                hooks = getattr(ctrl, "tick_hooks", None)
+                if hooks is not None:
+                    # Drain grace deadlines must expire even when no
+                    # watch event fires (the elastic-timer discipline).
+                    hooks.append(scheduler.tick)
         if self.slo is not None:
             for ctrl in controllers:
                 hooks = getattr(ctrl, "tick_hooks", None)
@@ -176,6 +204,7 @@ class Manager:
                     if getattr(ctrl, "profiler", None) is not None
                 },
                 recorder=self.recorder,
+                scheduler=scheduler,
             )
         self.elector = None
         if leader_elect:

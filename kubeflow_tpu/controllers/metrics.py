@@ -350,6 +350,7 @@ class ManagerServer:
         fleet_api=None,
         profilers: dict | None = None,
         recorder=None,
+        scheduler=None,
     ):
         self.metrics = metrics
         self.ready = ready or (lambda: True)
@@ -374,6 +375,10 @@ class ManagerServer:
         # debug gate as the pprof-role endpoints.
         self.profilers = profilers or {}
         self.recorder = recorder
+        # Slice-pool scheduler (PR 12): /debug/scheduler serves its
+        # queue/pool document behind the same debug gate; the /fleet
+        # rollup carries its pool-utilisation block.
+        self.scheduler = scheduler
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -424,6 +429,20 @@ class ManagerServer:
                     outer.slo.tick()
                     body = json.dumps(
                         outer.slo.alerts.to_dict(), indent=1, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (
+                    self.path == "/debug/scheduler"
+                    and outer.enable_debug
+                    and outer.scheduler is not None
+                ):
+                    import json
+
+                    body = json.dumps(
+                        outer.scheduler.to_dict(), indent=1, default=str
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -575,7 +594,8 @@ class ManagerServer:
             self.slo.tick()
             alerts = self.slo.alerts
         if self.fleet_api is not None:
-            doc = obs_fleet.fleet_cards(self.fleet_api, alerts=alerts)
+            doc = obs_fleet.fleet_cards(self.fleet_api, alerts=alerts,
+                                        scheduler=self.scheduler)
         else:
             # Same schema as fleet_cards, just with nothing to list —
             # consumers must not need to know which branch served them.

@@ -23,12 +23,19 @@ from kubeflow_tpu.controllers.runtime import (
     ensure_object,
     record_event,
 )
+from kubeflow_tpu.controllers.scheduling import (
+    apply_verdict,
+)
+from kubeflow_tpu.controllers.scheduling import (
+    observed_running as sched_observed_running,
+)
 from kubeflow_tpu.controllers.slice_recovery import (
     SliceAnnotations,
     recover_slice,
 )
 from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
 from kubeflow_tpu.obs.profile import phase as profile_phase
+from kubeflow_tpu.topology import TopologyError, TpuSlice
 
 log = logging.getLogger(__name__)
 
@@ -112,12 +119,14 @@ class NotebookReconciler:
         prom=None,  # optional ControllerMetrics (metrics.py)
         clock=time.time,  # elastic grace/promote timers (injectable)
         promotion_gate=None,  # autopilot.ElasticPromotionGate (or None)
+        scheduler=None,  # scheduler.SlicePoolScheduler (or None)
     ):
         self.api = api
         self.options = options or NotebookOptions()
         self.prom = prom
         self.clock = clock
         self.promotion_gate = promotion_gate
+        self.scheduler = scheduler
 
     def _ensure(self, desired: dict) -> str:
         return ensure_object(self.api, desired)
@@ -135,7 +144,11 @@ class NotebookReconciler:
                 )
             except NotFound:
                 # Deleted: children are garbage-collected via
-                # ownerReferences.
+                # ownerReferences; its pool admission is released.
+                if self.scheduler is not None:
+                    self.scheduler.release(
+                        "Notebook", req.namespace, req.name
+                    )
                 return None
 
             # One pod list shared by the elastic decision, gang
@@ -155,6 +168,11 @@ class NotebookReconciler:
         with profile_phase("desired-state"):
             reshard_reason, elastic_shape = self._elastic(
                 notebook, req, pods)
+            # Slice-pool gate: the scheduler is consulted BEFORE the
+            # StatefulSet is emitted (the elastic.py steering
+            # discipline) — an unadmitted gang runs at zero replicas,
+            # its chips stay in the pool, and status says why.
+            sched_verdict = self._schedule(notebook, req, elastic_shape)
             native_notebook = notebook
             if elastic_shape is not None:
                 # Degraded-mode override: desired state is generated at
@@ -171,6 +189,12 @@ class NotebookReconciler:
                 {"notebook": native_notebook,
                  "options": self.options.to_native()},
             )
+            if sched_verdict is not None and not sched_verdict.admitted:
+                # Gang all-or-nothing: a Queued/Suspended slice holds
+                # zero replicas (never a partial gang), so the pod
+                # simulator / statefulset controller prunes its pods
+                # and the chips return to the pool.
+                out["statefulset"]["spec"]["replicas"] = 0
         # One "patch" observation per reconcile: STS, events and
         # services are all "write the difference" — two separate
         # profile_phase("patch") blocks would double the digest's n
@@ -226,8 +250,48 @@ class NotebookReconciler:
                 notebook, req, sts, pods)
             self._update_status(notebook, restart_reason, sts, pods,
                                 reshard_reason=reshard_reason,
-                                elastic_shape=elastic_shape)
+                                elastic_shape=elastic_shape,
+                                sched_verdict=sched_verdict)
         return None
+
+    # ---- slice-pool scheduling -------------------------------------------
+    def _schedule(self, notebook: dict, req: Request, elastic_shape):
+        """Consult the slice-pool scheduler with the gang demand of the
+        EFFECTIVE shape (the elastic rung when one is active — a
+        degraded slice demands only what it will actually run).
+        Applies the verdict's annotation patches and the resume
+        handshake; returns the verdict, or None when no scheduler is
+        wired / the notebook holds no TPU slice."""
+        if self.scheduler is None:
+            return None
+        tpu = ((notebook.get("spec") or {}).get("tpu")) or {}
+        if not tpu.get("accelerator"):
+            return None
+        try:
+            slice_ = elastic_shape or TpuSlice.parse(
+                tpu["accelerator"], tpu.get("topology", "1x1")
+            )
+        except TopologyError:
+            return None  # native reconcile surfaces the spec error
+        anns = notebook.setdefault("metadata", {}).setdefault(
+            "annotations", {}
+        )
+        verdict = self.scheduler.decide(
+            "Notebook", req.namespace, req.name, slice_.chips, anns,
+            now=self.clock(),
+            observed_running=sched_observed_running(self.api, req),
+        )
+        # Resurrect handshake: same contract as SliceRestarted — the
+        # fresh slice is expected to pick up from the step the
+        # suspension parked at.
+        apply_verdict(
+            self.api, NOTEBOOK_API, "Notebook", notebook, req,
+            verdict, self.scheduler, self.clock,
+            resume_key=RESUME_EXPECTED_KEY,
+            resume_message="admitted from Suspended; training resumes "
+                           "from checkpoint step {step}",
+        )
+        return verdict
 
     # ---- elastic topology ------------------------------------------------
     def _elastic(self, notebook: dict, req: Request, pods: list | None):
@@ -367,7 +431,8 @@ class NotebookReconciler:
                        sts: dict | None = None,
                        pods: list | None = None,
                        reshard_reason: str | None = None,
-                       elastic_shape=None) -> None:
+                       elastic_shape=None,
+                       sched_verdict=None) -> None:
         name = notebook["metadata"]["name"]
         ns = notebook["metadata"]["namespace"]
         sts = sts or {}
@@ -432,6 +497,16 @@ class NotebookReconciler:
             # look, on top of the native-derived status.
             status["phase"] = "Restarting"
             status["restartReason"] = restart_reason
+        if sched_verdict is not None and sched_verdict.phase:
+            # The scheduler's view wins over restart/reshard markers: a
+            # Queued/Suspended slice has no pods, so "Restarting" would
+            # describe machinery that is deliberately parked; while
+            # Preempting, the drain is what the operator must see.
+            status["phase"] = sched_verdict.phase
+            if sched_verdict.reason:
+                status["schedulingReason"] = sched_verdict.reason
+            if sched_verdict.queue_position is not None:
+                status["queuePosition"] = sched_verdict.queue_position
         if elastic_shape is not None:
             # Running (or converging) degraded: the effective shape and
             # world size, for kubectl/dashboard — absent when the spec
@@ -460,7 +535,8 @@ class NotebookReconciler:
             # controller-owned while a restart/reshard is in flight.
             for key in ("phase", "restartReason", "reshardReason",
                         "resumedFromStep", "elasticShape",
-                        "elasticWorldSize"):
+                        "elasticWorldSize", "schedulingReason",
+                        "queuePosition"):
                 if key not in status and key in cur_status:
                     patch[key] = None
             # Same discipline one level down: merging an emptier
@@ -485,9 +561,11 @@ def make_notebook_controller(
     prom=None,
     clock=time.time,
     promotion_gate=None,
+    scheduler=None,
 ) -> Controller:
     reconciler = NotebookReconciler(api, options, prom=prom, clock=clock,
-                                    promotion_gate=promotion_gate)
+                                    promotion_gate=promotion_gate,
+                                    scheduler=scheduler)
     return Controller(
         name="notebook-controller",
         api=api,

@@ -1,0 +1,85 @@
+"""Scheduler-verdict plumbing shared by the workload reconcilers.
+
+The notebook and InferenceService reconcilers consult the slice-pool
+scheduler the same way: read whether the gang's world is already
+materialised (restart adoption), apply the verdict's annotation
+patches, stamp the resume handshake, record the transition events,
+and ack the handshake once it is durable. One implementation keeps
+the handshake semantics — patch BEFORE event BEFORE ack, so a crashed
+reconcile retries level-based — from drifting between CRDs.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.controllers.runtime import Request, record_event
+from kubeflow_tpu.k8s.fake import NotFound
+
+
+def observed_running(api, req: Request) -> bool:
+    """Is the workload's StatefulSet already holding replicas? The
+    restart-adoption signal: a scheduler whose in-memory state died
+    with the previous manager must grandfather a running gang as
+    ADMITTED instead of re-queueing it (and scaling a live slice to
+    zero without the checkpoint drain)."""
+    try:
+        sts = api.get("apps/v1", "StatefulSet", req.name,
+                      req.namespace)
+    except NotFound:
+        return False
+    try:
+        return int((sts.get("spec") or {}).get("replicas") or 0) > 0
+    except (TypeError, ValueError):
+        return False
+
+
+def apply_verdict(
+    api,
+    api_version: str,
+    kind: str,
+    obj: dict,
+    req: Request,
+    verdict,
+    scheduler,
+    clock,
+    resume_key: str | None,
+    resume_message: str,
+) -> None:
+    """Apply one :class:`~kubeflow_tpu.scheduler.SchedulingVerdict` to
+    the CR: annotation merge patch (+ local mirror, the elastic
+    discipline), the durable resume stamp (``resume_key``), the
+    change-gated transition events, and the handshake ack. The ack
+    only happens after the patch landed — the scheduler re-delivers
+    ``resume_from`` until then."""
+    anns = obj.setdefault("metadata", {}).setdefault("annotations", {})
+    patches = dict(verdict.annotations or {})
+    if verdict.resume_from is not None and resume_key is not None:
+        patches[resume_key] = verdict.resume_from
+    if patches:
+        api.patch_merge(
+            api_version, kind, req.name,
+            {"metadata": {"annotations": patches}},
+            req.namespace,
+        )
+        for key, value in patches.items():
+            if value is None:
+                anns.pop(key, None)
+            else:
+                anns[key] = value
+    cur_phase = (obj.get("status") or {}).get("phase")
+    if verdict.resume_from is not None:
+        record_event(
+            api, obj, "SliceResumed",
+            resume_message.format(step=verdict.resume_from),
+            clock=clock,
+        )
+        # Handshake durable (the patch above would have raised
+        # otherwise): stop the scheduler re-delivering it.
+        scheduler.ack_resume(kind, req.namespace, req.name)
+    elif verdict.phase and verdict.phase != cur_phase:
+        record_event(
+            api, obj, f"Slice{verdict.phase}",
+            verdict.reason or f"scheduler: {verdict.phase}",
+            event_type=("Warning" if verdict.phase == "Preempting"
+                        else "Normal"),
+            clock=clock,
+        )

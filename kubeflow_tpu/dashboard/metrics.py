@@ -390,8 +390,12 @@ class TpuFleetCollector:
     computation the manager's ``/fleet`` endpoint serves, so the
     scrape-able view and the JSON view cannot drift."""
 
-    def __init__(self, api):
+    def __init__(self, api, scheduler=None):
         self.api = api
+        # Optional slice-pool scheduler (PR 12): when the embedding
+        # process holds one, the pool-utilisation gauges render next
+        # to the inventory (the same pool_snapshot() /fleet serves).
+        self.scheduler = scheduler
         self._last_good: dict | None = None
 
     def describe(self):
@@ -433,6 +437,30 @@ class TpuFleetCollector:
                     fam.add_metric([accel], entry[key])
             yield from families.values()
         yield from self._workload_cards()
+        yield from self._pool_gauges()
+
+    def _pool_gauges(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        if self.scheduler is None:
+            return
+        try:
+            pool = self.scheduler.pool_snapshot()
+        except Exception as exc:
+            log.warning("scheduler pool scrape failed (%s)", exc)
+            return
+        fam = GaugeMetricFamily(
+            "tpu_fleet_pool_chips",
+            "Slice-pool scheduler chip accounting (capacity omitted "
+            "while unbounded)",
+            labels=["result"],
+        )
+        if pool["capacity_chips"] is not None:
+            fam.add_metric(["capacity"], pool["capacity_chips"])
+            fam.add_metric(["free"], pool["free_chips"])
+        fam.add_metric(["used"], pool["used_chips"])
+        fam.add_metric(["queued"], pool["queued_chips"])
+        yield fam
 
     def _workload_cards(self):
         from prometheus_client.core import GaugeMetricFamily
@@ -464,6 +492,18 @@ class TpuFleetCollector:
             "namespace's CR annotations",
             labels=["namespace"],
         )
+        queued = GaugeMetricFamily(
+            "tpu_fleet_queued",
+            "Workloads waiting for gang admission in the namespace "
+            "(status.phase=Queued)",
+            labels=["namespace"],
+        )
+        suspended = GaugeMetricFamily(
+            "tpu_fleet_suspended",
+            "Workloads reclaimed to zero replicas in the namespace "
+            "(status.phase=Suspended)",
+            labels=["namespace"],
+        )
         for ns, card in sorted(cards.items()):
             for phase, count in sorted(card["notebooks"].items()):
                 notebooks.add_metric([ns, phase], count)
@@ -472,7 +512,11 @@ class TpuFleetCollector:
             if card.get("goodput_ratio") is not None:
                 goodput.add_metric([ns], card["goodput_ratio"])
             restarts.add_metric([ns], card["preemption_restarts"])
+            queued.add_metric([ns], card.get("queued", 0))
+            suspended.add_metric([ns], card.get("suspended", 0))
         yield notebooks
         yield inference
         yield goodput
         yield restarts
+        yield queued
+        yield suspended

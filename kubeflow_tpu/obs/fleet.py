@@ -77,6 +77,7 @@ def fleet_cards(
     alerts=None,
     counters: dict | None = None,
     clock: Callable[[], float] = time.time,
+    scheduler=None,
 ) -> dict:
     """Per-namespace fleet cards.
 
@@ -86,6 +87,11 @@ def fleet_cards(
     card. ``counters`` optionally carries manager-side per-namespace
     counter readings, e.g. ``{"reshards": {ns: n}}`` folded from the
     Prometheus registry — the dashboard process omits them.
+    ``scheduler`` (a duck-typed ``pool_snapshot()`` holder — the
+    slice-pool scheduler) adds the top-level ``pool`` utilisation
+    block; the per-card ``queued``/``suspended`` counts come from the
+    CR phases themselves, so the rollup reflects the scheduler's
+    states instead of lumping them into NotReady.
     """
     cards: dict[str, dict] = {}
 
@@ -95,6 +101,8 @@ def fleet_cards(
             "inferenceservices": {},
             "preemption_restarts": 0,
             "reshards": 0,
+            "queued": 0,
+            "suspended": 0,
             "goodput_ratio": None,
             "alerts": [],
             "health": "ok",
@@ -117,6 +125,10 @@ def fleet_cards(
                     pass
             if phase == "Resharding":
                 entry["reshards"] += 1
+            elif phase == "Queued":
+                entry["queued"] += 1
+            elif phase == "Suspended":
+                entry["suspended"] += 1
             raw = anns.get(GOODPUT_ANNOTATION)
             if raw is not None:
                 try:
@@ -158,11 +170,19 @@ def fleet_cards(
         elif "pending" in states or phases & _UNHEALTHY_PHASES:
             entry["health"] = "degraded"
 
-    return {
+    doc = {
         "namespaces": {ns: cards[ns] for ns in sorted(cards)},
         "alerts": active,
         "generated_at": clock(),
     }
+    if scheduler is not None:
+        try:
+            doc["pool"] = scheduler.pool_snapshot()
+        except Exception as exc:
+            # Same read-only posture as the LISTs above: a broken
+            # capacity source degrades the pool block, never the cards.
+            log.warning("fleet rollup: pool snapshot failed (%s)", exc)
+    return doc
 
 
 class GoodputAnnotationPublisher:

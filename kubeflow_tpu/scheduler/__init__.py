@@ -1,0 +1,40 @@
+"""Slice-pool scheduler: TPU capacity as one schedulable pool.
+
+Gang admission (whole slices, never partial), per-namespace quota from
+Profile ResourceQuotas, FIFO+priority queueing with aging, priority
+preemption through the checkpoint-then-scale-down drain, and
+checkpoint-backed scale-to-zero for idle slices (ROADMAP item 4).
+``KFT_SCHEDULER=0`` makes the layer admit-everything inert.
+"""
+
+from kubeflow_tpu.scheduler.core import (
+    CHECKPOINT_STEP_KEYS,
+    PREEMPT_REQUESTED_KEY,
+    PRIORITY_KEY,
+    SUSPEND_STEP_KEY,
+    SchedulingVerdict,
+    SlicePoolScheduler,
+    node_inventory_capacity,
+    resource_quota_chips,
+    scheduler_enabled,
+)
+from kubeflow_tpu.scheduler.metrics import (
+    SchedulerCollector,
+    SchedulerMetrics,
+    scheduler_queue_wait_objective,
+)
+
+__all__ = [
+    "CHECKPOINT_STEP_KEYS",
+    "PREEMPT_REQUESTED_KEY",
+    "PRIORITY_KEY",
+    "SUSPEND_STEP_KEY",
+    "SchedulerCollector",
+    "SchedulerMetrics",
+    "SchedulingVerdict",
+    "SlicePoolScheduler",
+    "node_inventory_capacity",
+    "resource_quota_chips",
+    "scheduler_enabled",
+    "scheduler_queue_wait_objective",
+]

@@ -1,0 +1,808 @@
+"""The slice-pool scheduler: gang admission over one TPU chip pool.
+
+Today a Notebook or InferenceService either gets its whole slice or
+sits Pending forever — no queue, no quota accounting, no reclamation.
+:class:`SlicePoolScheduler` composes the pieces the platform already
+owns into a Kueue-flavoured scheduler:
+
+- **Gang admission.** A workload demands its whole slice's chip count
+  (:class:`~kubeflow_tpu.topology.TpuSlice` math — never partial). The
+  reconcilers consult :meth:`SlicePoolScheduler.decide` while
+  generating desired state: an unadmitted CR's StatefulSet is emitted
+  at ``replicas: 0`` and the CR surfaces ``status.phase=Queued`` with
+  the reason and queue position.
+- **Quota.** Per-namespace chip budgets resolve from the namespace's
+  ResourceQuota (``google.com/tpu`` — the object
+  ``controllers/profile.py`` already materialises per Profile). A
+  quota-blocked entry is skipped, not head-blocking: its block is
+  namespace-local and must not starve other tenants.
+- **FIFO + priority + aging.** Queue order is
+  ``(-effective_priority, arrival_seq)`` where the base priority comes
+  from the ``scheduling.kubeflow-tpu.org/priority`` annotation and the
+  effective priority grows by one per ``aging_s`` waited — an aged
+  low-priority entry eventually outranks any finite-priority newcomer
+  IN QUEUE ORDER, so it holds the head and takes the next chips that
+  free (the starvation-freedom bound the acceptance test pins). Aging
+  never arms eviction: preemption eligibility is strictly-higher BASE
+  priority (the Kueue rule) — an aged equal-priority entry evicting a
+  resident would just be evicted back after the resident re-ages,
+  checkpoint-thrashing both forever. Capacity admission is
+  head-blocking past the first entry that does not fit (no leapfrog
+  by smaller later jobs).
+- **Preemption via the checkpoint drain.** A high-priority arrival
+  that cannot fit may evict the lowest-priority running slice(s) —
+  all-or-nothing: victims are only drained when the freed chips
+  actually fit the arrival. A victim enters the DRAINING state: the
+  reconciler stamps ``scheduling.kubeflow-tpu.org/preempt-requested``
+  (the forewarning of the SIGTERM the scale-down will deliver —
+  ``run_with_checkpointing``'s existing grace path takes the final
+  synchronous checkpoint), and the drain completes when the CR's
+  checkpoint-step annotation advances or the grace deadline passes.
+  Only then is the victim scaled to zero and re-queued at its base
+  priority.
+- **Idle reclamation / scale-to-zero.** The culler's duty-cycle idle
+  signal calls :meth:`mark_reclaimable`; the slice drains through the
+  same checkpoint path, then parks as ``status.phase=Suspended`` with
+  the checkpoint step recorded in an annotation and its chips back in
+  the pool. :meth:`touch` (first HTTP touch, or any resurrect trigger)
+  re-enqueues it; on re-admission the verdict carries ``resume_from``
+  so the reconciler stamps the existing resume handshake and
+  ``restore_latest_valid`` picks the run back up.
+- **Cost is measured, not assumed.** Queue wait lands in the
+  ``scheduler_admission_wait_seconds`` histogram (and the queue-wait
+  SLO objective); with a ``charge_downtime`` hook, queue wait and
+  suspension are charged to the workload's
+  :class:`~kubeflow_tpu.obs.GoodputMeter` as ``kind="queued"`` /
+  ``kind="suspended"`` downtime.
+
+``KFT_SCHEDULER=0`` (or ``enabled=False``) makes :meth:`decide` an
+unconditional admit with zero state: behaviour is byte-identical to
+the scheduler-less platform (pinned by test). Everything takes an
+injectable clock; nothing here sleeps or threads beyond one lock, so
+a scenario's admission sequence is a pure function of its scripted
+(call, clock) sequence — the contention scenario replays
+byte-identically like ``loadtest/game_day.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.controllers.time_utils import rfc3339
+from kubeflow_tpu.obs.envknob import env_bool, env_number
+from kubeflow_tpu.scheduler.metrics import SchedulerMetrics
+
+log = logging.getLogger(__name__)
+
+_NS = "scheduling.kubeflow-tpu.org"
+
+# User-facing: integer priority (higher preempts lower; default 0).
+PRIORITY_KEY = f"{_NS}/priority"
+# Scheduler-owned: stamped on a DRAINING victim with the drain's
+# RFC3339 deadline — the data plane's forewarning of the SIGTERM the
+# scale-down delivers (the in-image agent or an alert-aware cadence
+# signal reacts by saving promptly).
+PREEMPT_REQUESTED_KEY = f"{_NS}/preempt-requested"
+# Scheduler-owned: the checkpoint step a Suspended slice parked at.
+SUSPEND_STEP_KEY = f"{_NS}/suspend-checkpoint-step"
+
+# The data plane's checkpoint-step mirrors (stamped by the in-image
+# reporter / the training loop's publisher). Contract values mirrored
+# from the controllers, like obs/fleet.py does — the scheduler must
+# stay importable without them.
+CHECKPOINT_STEP_KEYS = (
+    "notebooks.kubeflow-tpu.org/checkpoint-last-step",
+    "inference.kubeflow-tpu.org/checkpoint-last-step",
+)
+
+# Workload states.
+ADMITTED = "admitted"
+QUEUED = "queued"
+DRAINING = "draining"
+SUSPENDED = "suspended"
+
+
+def scheduler_enabled() -> bool:
+    """``KFT_SCHEDULER=0`` turns the whole layer off (admit-everything,
+    byte-identical to the scheduler-less platform)."""
+    return env_bool("KFT_SCHEDULER", True)
+
+
+def default_aging_s() -> float:
+    return env_number("KFT_SCHEDULER_AGING_S", 600.0, minimum=0.0)
+
+
+def default_drain_grace_s() -> float:
+    return env_number("KFT_SCHEDULER_DRAIN_GRACE_S", 60.0, minimum=0.0)
+
+
+def resource_quota_chips(api, namespace: str) -> int | None:
+    """The namespace's TPU chip budget: the tightest ``google.com/tpu``
+    hard limit across its ResourceQuotas (the object the Profile
+    controller materialises), or None when no quota constrains TPU.
+    Read-only and failure-tolerant: an unreadable apiserver means "no
+    quota known", never a scheduling crash."""
+    try:
+        quotas = api.list("v1", "ResourceQuota", namespace=namespace)
+    except Exception as exc:
+        log.debug("quota read failed for %s: %s", namespace, exc)
+        return None
+    best: int | None = None
+    for quota in quotas or []:
+        hard = ((quota.get("spec") or {}).get("hard")) or {}
+        for key in ("google.com/tpu", "requests.google.com/tpu",
+                    "limits.google.com/tpu"):
+            if key not in hard:
+                continue
+            try:
+                value = int(hard[key])
+            except (TypeError, ValueError):
+                continue
+            best = value if best is None else min(best, value)
+    return best
+
+
+def node_inventory_capacity(api) -> int:
+    """Schedulable TPU chips from the live Node inventory: allocatable
+    ``google.com/tpu`` summed over Ready, untainted-for-termination
+    nodes — the same inventory the chaos capacity timeline manipulates
+    (``PreemptionInjector`` taints nodes it reclaims). A failed LIST
+    raises: the scheduler's ``_capacity`` turns that into
+    serve-last-known (or fail-closed on a cold start) — returning None
+    here would read as an UNBOUNDED pool and admit everything."""
+    nodes = api.list("v1", "Node")
+    total = 0
+    for node in nodes or []:
+        taints = ((node.get("spec") or {}).get("taints")) or []
+        if any(t.get("key") == "cloud.google.com/impending-node-termination"
+               for t in taints):
+            continue
+        ready = True
+        for cond in (node.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready":
+                ready = cond.get("status") == "True"
+        if not ready:
+            continue
+        alloc = ((node.get("status") or {}).get("allocatable")) or {}
+        try:
+            total += int(alloc.get("google.com/tpu", 0))
+        except (TypeError, ValueError):
+            pass
+    return total
+
+
+@dataclasses.dataclass
+class SchedulingVerdict:
+    """One reconcile pass's scheduling verdict for one workload.
+
+    ``admitted`` says whether desired state may carry the full replica
+    count this pass (a DRAINING victim is still admitted — its pods
+    keep running through the checkpoint grace). ``phase`` overrides
+    ``status.phase`` when set (Queued / Preempting / Suspended);
+    ``annotations`` is a metadata.annotations merge patch the caller
+    must write (None values delete); ``resume_from`` is delivered once
+    on the first admitted verdict after a resurrect — the caller
+    stamps its CRD's resume-expected handshake with it."""
+
+    admitted: bool = True
+    phase: str | None = None
+    reason: str | None = None
+    queue_position: int | None = None
+    annotations: dict = dataclasses.field(default_factory=dict)
+    resume_from: str | None = None
+
+
+class _Workload:
+    __slots__ = (
+        "kind", "namespace", "name", "chips", "priority", "seq",
+        "state", "enqueued_at", "admitted_at", "reason",
+        "drain_deadline", "drain_ckpt0", "drain_target", "drain_reason",
+        "suspended_at", "suspend_step", "resume_pending", "resurrecting",
+    )
+
+    def __init__(self, kind: str, namespace: str, name: str,
+                 chips: int, priority: int, seq: int, now: float):
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.chips = chips
+        self.priority = priority
+        self.seq = seq
+        self.state = QUEUED
+        self.enqueued_at = now
+        self.admitted_at: float | None = None
+        self.reason: str | None = None
+        self.drain_deadline: float | None = None
+        self.drain_ckpt0: str | None = None
+        self.drain_target: str | None = None
+        self.drain_reason: str | None = None
+        self.suspended_at: float | None = None
+        self.suspend_step: str | None = None
+        self.resume_pending: str | None = None
+        self.resurrecting = False
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.namespace, self.name)
+
+    @property
+    def label(self) -> str:
+        return f"{self.kind}/{self.namespace}/{self.name}"
+
+
+class SlicePoolScheduler:
+    """See the module docstring. ``capacity_fn`` returns the
+    schedulable chip pool (None = unbounded — e.g.
+    ``lambda: injector.capacity_chips`` in the chaos harness,
+    ``lambda: node_inventory_capacity(api)`` in production);
+    ``quota_fn(namespace)`` the namespace budget (defaults to
+    :func:`resource_quota_chips` over ``api`` when one is given);
+    ``charge_downtime(kind, namespace, name, downtime_kind, seconds)``
+    is the GoodputMeter hop (best-effort, never raises out)."""
+
+    def __init__(
+        self,
+        capacity_fn: Callable[[], int | None] | None = None,
+        quota_fn: Callable[[str], int | None] | None = None,
+        api=None,
+        # ONE timebase rule: the scheduler and every reconciler
+        # consulting it must share a clock. The default is time.time
+        # because the consulting controllers default to it (their
+        # elastic/culling timers) — a monotonic default here would mix
+        # timebases the moment a reconciler passes now=self.clock()
+        # while Manager drives tick() on this clock, collapsing (or
+        # never expiring) drain deadlines.
+        clock: Callable[[], float] = time.time,
+        aging_s: float | None = None,
+        drain_grace_s: float | None = None,
+        enabled: bool | None = None,
+        charge_downtime=None,
+        metrics: SchedulerMetrics | None = None,
+        signal_cache_ttl_s: float | None = None,
+    ):
+        self.enabled = (scheduler_enabled() if enabled is None
+                        else bool(enabled))
+        self.capacity_fn = capacity_fn
+        if quota_fn is None and api is not None:
+            quota_fn = lambda ns: resource_quota_chips(api, ns)  # noqa: E731
+        self.quota_fn = quota_fn
+        self.clock = clock
+        self.aging_s = (default_aging_s() if aging_s is None
+                        else max(0.0, float(aging_s)))
+        self.drain_grace_s = (default_drain_grace_s()
+                              if drain_grace_s is None
+                              else max(0.0, float(drain_grace_s)))
+        self.charge_downtime = charge_downtime
+        self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        # Capacity/quota sources may be networked (Node/ResourceQuota
+        # LISTs) and the admission pass runs under the scheduler lock
+        # on every decide AND every controller tick: a short TTL cache
+        # bounds the read rate so a slow apiserver cannot turn the
+        # lock into a fleet-wide reconcile convoy.
+        self.signal_cache_ttl_s = (
+            env_number("KFT_SCHEDULER_CACHE_TTL_S", 5.0, minimum=0.0)
+            if signal_cache_ttl_s is None
+            else max(0.0, float(signal_cache_ttl_s))
+        )
+        self._capacity_cache: tuple[float, int | None] | None = None
+        self._quota_cache: dict[str, tuple[float, int | None]] = {}
+        self._lock = threading.Lock()
+        self._workloads: dict[tuple[str, str, str], _Workload] = {}
+        self._seq = itertools.count()
+
+    # ---- clock / signal helpers ------------------------------------------
+    def _now(self, now: float | None) -> float:
+        return self.clock() if now is None else now
+
+    def _capacity(self, now: float | None = None) -> int | None:
+        if self.capacity_fn is None:
+            return None
+        now = self._now(now)
+        cached = self._capacity_cache
+        if cached is not None and now - cached[0] < self.signal_cache_ttl_s:
+            return cached[1]
+        try:
+            chips = self.capacity_fn()
+        except Exception:
+            # Serve the last good reading (the collector's last-known
+            # posture) WITHOUT refreshing its timestamp, so the next
+            # call retries the source. Returning None here would read
+            # as "unbounded" and one blip would admit the whole queue
+            # with no rollback path; on a COLD start (no cache yet) the
+            # same logic says fail CLOSED — 0 pauses new admissions
+            # (and can never size a preemption set) until the first
+            # good read, where None would admit everything.
+            log.debug("scheduler capacity read failed", exc_info=True)
+            return cached[1] if cached is not None else 0
+        chips = None if chips is None else int(chips)
+        self._capacity_cache = (now, chips)
+        return chips
+
+    def _quota(self, namespace: str, now: float | None = None) -> int | None:
+        if self.quota_fn is None:
+            return None
+        now = self._now(now)
+        cached = self._quota_cache.get(namespace)
+        if cached is not None and now - cached[0] < self.signal_cache_ttl_s:
+            return cached[1]
+        try:
+            quota = self.quota_fn(namespace)
+        except Exception:
+            # Same posture as _capacity: a blip must not read as "no
+            # quota" and admit a namespace past its budget (sticky —
+            # admitted workloads are never quota-rechecked). Cold
+            # start with no cache stays None: quotas are optional per
+            # namespace, and failing closed here would wedge every
+            # unquotaed tenant.
+            log.debug("scheduler quota read failed for %s", namespace,
+                      exc_info=True)
+            return cached[1] if cached is not None else None
+        quota = None if quota is None else int(quota)
+        if len(self._quota_cache) >= 1024 and \
+                namespace not in self._quota_cache:
+            # Namespace churn must not grow the cache forever.
+            self._quota_cache.pop(next(iter(self._quota_cache)))
+        self._quota_cache[namespace] = (now, quota)
+        return quota
+
+    def _charge(self, w: _Workload, kind: str, seconds: float) -> None:
+        if self.charge_downtime is None or seconds <= 0:
+            return
+        try:
+            self.charge_downtime(w.kind, w.namespace, w.name, kind,
+                                 seconds)
+        except Exception:
+            # Goodput accounting is telemetry; it must never fail the
+            # admission pass it describes.
+            log.debug("scheduler downtime charge failed for %s",
+                      w.label, exc_info=True)
+
+    @staticmethod
+    def _ckpt_step(annotations: dict) -> str | None:
+        for key in CHECKPOINT_STEP_KEYS:
+            value = annotations.get(key)
+            if value is not None:
+                return str(value)
+        return None
+
+    @staticmethod
+    def _parse_priority(annotations: dict) -> int:
+        try:
+            return int(annotations.get(PRIORITY_KEY, 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _effective_priority(self, w: _Workload, now: float) -> int:
+        """Base priority plus one rank per ``aging_s`` waited — the
+        queue-ORDER starvation lever: a finite-priority stream of
+        newcomers cannot hold the head against an aged entry forever.
+        Never used for preemption eligibility (see
+        :meth:`_preemption_set`)."""
+        if w.state != QUEUED or self.aging_s <= 0:
+            return w.priority
+        return w.priority + int(max(0.0, now - w.enqueued_at)
+                                / self.aging_s)
+
+    # ---- public surface ---------------------------------------------------
+    def decide(self, kind: str, namespace: str, name: str, chips: int,
+               annotations: dict | None = None,
+               now: float | None = None,
+               observed_running: bool = False) -> SchedulingVerdict:
+        """The reconciler consult: register/update the workload, run
+        one admission pass, and return this workload's verdict.
+        Disabled (or a chip-less workload) admits unconditionally with
+        zero bookkeeping.
+
+        ``observed_running`` is the restart-adoption signal: scheduler
+        state is in-memory, so after a manager restart an UNKNOWN
+        workload whose StatefulSet is already holding replicas is
+        grandfathered as ADMITTED — never re-queued (which would scale
+        a live slice to zero with no checkpoint drain, in
+        reconcile-arrival order no less). Oversubscription inherited
+        this way resolves through the normal preemption/reclaim paths.
+        """
+        if not self.enabled or chips <= 0:
+            return SchedulingVerdict(admitted=True)
+        now = self._now(now)
+        anns = annotations or {}
+        with self._lock:
+            w = self._workloads.get((kind, namespace, name))
+            if w is None:
+                w = _Workload(kind, namespace, name, int(chips),
+                              self._parse_priority(anns),
+                              next(self._seq), now)
+                self._workloads[w.key] = w
+                if observed_running:
+                    w.state = ADMITTED
+                    w.admitted_at = now
+                    log.info("scheduler adopted running %s (%d chips)",
+                             w.label, w.chips)
+            else:
+                w.priority = self._parse_priority(anns)
+                if w.chips != int(chips):
+                    # Elastic reshape: the gang demand follows the
+                    # effective shape (an admitted slice that degraded
+                    # frees the difference back to the pool).
+                    w.chips = int(chips)
+            if w.state == DRAINING:
+                step = self._ckpt_step(anns)
+                if w.drain_ckpt0 is None:
+                    # First drain pass with the CR in hand: the ack is
+                    # a checkpoint taken AFTER the drain started, so
+                    # baseline whatever step is already recorded.
+                    w.drain_ckpt0 = step if step is not None else ""
+                elif step is not None and step != w.drain_ckpt0:
+                    self._complete_drain(w, now, step)
+            self._admission_pass(now)
+            return self._verdict_locked(w, now, anns)
+
+    def release(self, kind: str, namespace: str, name: str) -> None:
+        """The CR is gone: free its admission/queue slot."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._workloads.pop((kind, namespace, name), None)
+
+    def mark_reclaimable(self, kind: str, namespace: str, name: str,
+                         now: float | None = None) -> bool:
+        """The culler's idle signal: begin the checkpoint-then-
+        scale-to-zero drain for an admitted slice. Returns True when a
+        drain actually started."""
+        if not self.enabled:
+            return False
+        now = self._now(now)
+        with self._lock:
+            w = self._workloads.get((kind, namespace, name))
+            if w is None or w.state != ADMITTED:
+                return False
+            self._start_drain(
+                w, SUSPENDED, now,
+                reason="idle past the duty-cycle threshold; "
+                       "checkpointing, then scaling to zero",
+            )
+            return True
+
+    def touch(self, kind: str, namespace: str, name: str,
+              now: float | None = None) -> bool:
+        """First HTTP touch of a Suspended slice: charge the
+        suspension to goodput and re-enqueue for admission (the
+        resurrect path). Returns True when the workload left
+        SUSPENDED."""
+        if not self.enabled:
+            return False
+        now = self._now(now)
+        with self._lock:
+            w = self._workloads.get((kind, namespace, name))
+            if w is None or w.state != SUSPENDED:
+                return False
+            if w.suspended_at is not None:
+                self._charge(w, "suspended", now - w.suspended_at)
+            w.state = QUEUED
+            w.seq = next(self._seq)
+            w.enqueued_at = now
+            w.resurrecting = True
+            w.reason = "resurrecting from Suspended"
+            self.metrics.resurrects_total += 1
+            self._admission_pass(now)
+            return True
+
+    def tracks(self, kind: str, namespace: str, name: str) -> bool:
+        """Whether this scheduler owns a pool decision for the
+        workload. The culler consults this before routing an idle
+        verdict: a tracked slice is reclaimed through the pool (even
+        when already draining/suspended — idempotently), an untracked
+        one falls back to the plain stop path."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            return (kind, namespace, name) in self._workloads
+
+    def ack_resume(self, kind: str, namespace: str, name: str) -> None:
+        """The reconciler stamped the resume handshake: stop delivering
+        ``resume_from``. Until this ack, every admitted verdict after a
+        resurrect re-delivers it — a reconcile that crashed between
+        decide() and its annotation patch retries level-based instead
+        of silently losing the handshake."""
+        if not self.enabled:
+            return
+        with self._lock:
+            w = self._workloads.get((kind, namespace, name))
+            if w is not None:
+                w.resume_pending = None
+
+    def tick(self, now: float | None = None) -> None:
+        """Advance drains/admissions without a CR in hand (wired into
+        controller tick hooks so grace deadlines expire even when no
+        watch event fires)."""
+        if not self.enabled:
+            return
+        now = self._now(now)
+        with self._lock:
+            self._admission_pass(now)
+
+    # ---- the admission pass (lock held) ----------------------------------
+    def _queued_sorted(self, now: float) -> list[_Workload]:
+        """THE queue order — `(-effective_priority, arrival_seq)` — in
+        one place: admission, status positions and the debug doc must
+        never disagree about it."""
+        return sorted(
+            (w for w in self._workloads.values() if w.state == QUEUED),
+            key=lambda w: (-self._effective_priority(w, now), w.seq),
+        )
+
+    def _admission_pass(self, now: float) -> None:
+        # Deadline-expired drains complete first: their chips fund the
+        # admissions below.
+        for w in list(self._workloads.values()):
+            if (w.state == DRAINING and w.drain_deadline is not None
+                    and now >= w.drain_deadline):
+                self._complete_drain(w, now, None)
+
+        capacity = self._capacity(now)
+        used = 0
+        draining_chips = 0
+        ns_used: dict[str, int] = {}
+        for w in self._workloads.values():
+            if w.state in (ADMITTED, DRAINING):
+                used += w.chips
+                ns_used[w.namespace] = ns_used.get(w.namespace, 0) + w.chips
+                if w.state == DRAINING:
+                    draining_chips += w.chips
+
+        queued = self._queued_sorted(now)
+        ns_quota = {w.namespace: self._quota(w.namespace, now)
+                    for w in queued}
+        capacity_blocked = False
+        for w in queued:
+            quota = ns_quota.get(w.namespace)
+            if quota is not None and \
+                    ns_used.get(w.namespace, 0) + w.chips > quota:
+                # Namespace-local block: skip, never head-block other
+                # tenants behind one namespace's quota.
+                w.reason = (
+                    f"namespace quota: {ns_used.get(w.namespace, 0)} "
+                    f"used + {w.chips} needed > {quota} chips "
+                    f"(google.com/tpu ResourceQuota)"
+                )
+                continue
+            if capacity_blocked:
+                # FIFO+priority holds: no capacity leapfrog by smaller
+                # later jobs once the head is waiting on chips.
+                w.reason = "waiting behind the queue head"
+                continue
+            if capacity is None or used + w.chips <= capacity:
+                self._admit(w, now)
+                used += w.chips
+                ns_used[w.namespace] = (
+                    ns_used.get(w.namespace, 0) + w.chips
+                )
+                continue
+            if used - draining_chips + w.chips <= capacity:
+                # An in-flight drain already frees enough: do NOT pile
+                # more victims onto the same arrival — the first pass's
+                # plan stands until the checkpointed scale-down lands.
+                w.reason = ("waiting for in-flight checkpointed "
+                            "scale-down")
+                capacity_blocked = True
+                continue
+            # Victim sizing credits in-flight drains (their chips free
+            # regardless): sizing against raw `used` would evict more
+            # slices than the arrival actually needs.
+            victims = self._preemption_set(
+                w, used - draining_chips, capacity, now
+            )
+            if victims:
+                names = ", ".join(v.label for v in victims)
+                for v in victims:
+                    self._start_drain(
+                        v, QUEUED, now,
+                        reason=(
+                            f"preempted by {w.label} "
+                            f"(priority {w.priority} > {v.priority})"
+                        ),
+                    )
+                    self.metrics.preemptions_total += 1
+                    draining_chips += v.chips
+                w.reason = (
+                    f"preempting {names}: waiting for checkpointed "
+                    "scale-down"
+                )
+            else:
+                free = max(0, (capacity or 0) - used)
+                w.reason = (
+                    f"insufficient capacity: whole-slice gang needs "
+                    f"{w.chips} chips, {free} free"
+                )
+            capacity_blocked = True
+
+    def _preemption_set(self, arrival: _Workload, used: int,
+                        capacity: int, now: float) -> list[_Workload]:
+        """The minimal lowest-priority victim set whose eviction fits
+        the arrival — or [] when no all-or-nothing plan exists (gang
+        discipline: never drain a victim whose chips would not
+        actually place the arrival). ``used`` is steady-state usage:
+        the caller has already subtracted in-flight draining chips.
+
+        Eligibility is STRICTLY-HIGHER BASE priority (the Kueue rule)
+        — aging orders the queue but never arms eviction: an aged
+        equal-priority arrival preempting a resident would re-queue
+        the resident, which ages and preempts back, checkpoint-
+        thrashing both forever."""
+        candidates = sorted(
+            (v for v in self._workloads.values()
+             if v.state == ADMITTED and v.priority < arrival.priority),
+            key=lambda v: (v.priority, -v.seq),  # lowest prio, newest 1st
+        )
+        picked: list[_Workload] = []
+        freed = 0
+        for v in candidates:
+            if used - freed + arrival.chips <= capacity:
+                break
+            picked.append(v)
+            freed += v.chips
+        if used - freed + arrival.chips <= capacity:
+            return picked
+        return []
+
+    def _admit(self, w: _Workload, now: float) -> None:
+        wait = max(0.0, now - w.enqueued_at)
+        self.metrics.admission_wait.observe(wait)
+        self._charge(w, "queued", wait)
+        w.state = ADMITTED
+        w.admitted_at = now
+        w.reason = None
+        self.metrics.admissions_total += 1
+        if w.resurrecting:
+            w.resume_pending = w.suspend_step
+            w.resurrecting = False
+        w.suspended_at = None
+        log.info("scheduler admitted %s (%d chips, waited %.1fs)",
+                 w.label, w.chips, wait)
+
+    def _start_drain(self, w: _Workload, target: str, now: float,
+                     reason: str) -> None:
+        w.state = DRAINING
+        w.drain_target = target
+        w.drain_deadline = now + self.drain_grace_s
+        w.drain_ckpt0 = None  # captured from the next decide()'s anns
+        w.drain_reason = reason
+        log.info("scheduler draining %s -> %s: %s", w.label, target,
+                 reason)
+
+    def _complete_drain(self, w: _Workload, now: float,
+                        step: str | None) -> None:
+        target = w.drain_target or QUEUED
+        w.drain_deadline = None
+        w.drain_target = None
+        if target == SUSPENDED:
+            w.state = SUSPENDED
+            w.suspended_at = now
+            # "" means "no checkpoint ever observed" (the drain
+            # baseline of an annotation-less CR) — normalize to None
+            # so an unknown step never flows out as resume_from="".
+            w.suspend_step = (step or None) or (w.drain_ckpt0 or None)
+            self.metrics.reclaims_total += 1
+            log.info("scheduler suspended %s at checkpoint step %s",
+                     w.label, w.suspend_step or "<unknown>")
+        else:
+            w.state = QUEUED
+            w.seq = next(self._seq)
+            w.enqueued_at = now
+            w.reason = w.drain_reason
+            log.info("scheduler re-queued preempted %s", w.label)
+
+    # ---- verdicts (lock held) --------------------------------------------
+    def _queue_position(self, w: _Workload, now: float) -> int:
+        return self._queued_sorted(now).index(w) + 1
+
+    def _verdict_locked(self, w: _Workload, now: float,
+                        anns: dict) -> SchedulingVerdict:
+        patches: dict = {}
+        if w.state == ADMITTED:
+            for key in (PREEMPT_REQUESTED_KEY, SUSPEND_STEP_KEY):
+                if key in anns:
+                    patches[key] = None
+            # Delivered on EVERY admitted verdict until the caller
+            # acks (ack_resume) — a crashed reconcile retries the
+            # handshake instead of losing it.
+            return SchedulingVerdict(admitted=True, annotations=patches,
+                                     resume_from=w.resume_pending)
+        if w.state == DRAINING:
+            deadline = rfc3339(w.drain_deadline or now)
+            if anns.get(PREEMPT_REQUESTED_KEY) != deadline:
+                patches[PREEMPT_REQUESTED_KEY] = deadline
+            return SchedulingVerdict(
+                admitted=True, phase="Preempting",
+                reason=w.drain_reason, annotations=patches,
+            )
+        if w.state == SUSPENDED:
+            if PREEMPT_REQUESTED_KEY in anns:
+                patches[PREEMPT_REQUESTED_KEY] = None
+            if w.suspend_step is not None and \
+                    anns.get(SUSPEND_STEP_KEY) != w.suspend_step:
+                patches[SUSPEND_STEP_KEY] = w.suspend_step
+            return SchedulingVerdict(
+                admitted=False, phase="Suspended",
+                reason="idle slice reclaimed; chips returned to the "
+                       "pool (first touch resurrects)",
+                annotations=patches,
+            )
+        # QUEUED
+        if PREEMPT_REQUESTED_KEY in anns:
+            patches[PREEMPT_REQUESTED_KEY] = None
+        return SchedulingVerdict(
+            admitted=False, phase="Queued", reason=w.reason,
+            queue_position=self._queue_position(w, now),
+            annotations=patches,
+        )
+
+    # ---- read surfaces ----------------------------------------------------
+    def pool_snapshot(self) -> dict:
+        """The pool-utilisation block ``/fleet`` and the fleet gauges
+        surface: capacity, chips in use (admitted + draining), queue
+        and suspension counts."""
+        with self._lock:
+            capacity = self._capacity()
+            used = sum(w.chips for w in self._workloads.values()
+                       if w.state in (ADMITTED, DRAINING))
+            by_state: dict[str, int] = {}
+            queued_chips = 0
+            for w in self._workloads.values():
+                by_state[w.state] = by_state.get(w.state, 0) + 1
+                if w.state == QUEUED:
+                    queued_chips += w.chips
+        return {
+            "capacity_chips": capacity,
+            "used_chips": used,
+            "free_chips": (None if capacity is None
+                           else max(0, capacity - used)),
+            "queued": by_state.get(QUEUED, 0),
+            "queued_chips": queued_chips,
+            "admitted": by_state.get(ADMITTED, 0),
+            "draining": by_state.get(DRAINING, 0),
+            "suspended": by_state.get(SUSPENDED, 0),
+        }
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workloads.values()
+                       if w.state == QUEUED)
+
+    def to_dict(self) -> dict:
+        """The ``/debug/scheduler`` document: pool, ordered queue with
+        effective priorities and waits, every workload's state, and
+        the scheduler counters."""
+        now = self.clock()
+        with self._lock:
+            queued = self._queued_sorted(now)
+            queue_doc = [{
+                "workload": w.label,
+                "chips": w.chips,
+                "priority": w.priority,
+                "effective_priority": self._effective_priority(w, now),
+                "waited_s": round(max(0.0, now - w.enqueued_at), 3),
+                "reason": w.reason,
+            } for w in queued]
+            workloads = {
+                w.label: {
+                    "state": w.state,
+                    "chips": w.chips,
+                    "priority": w.priority,
+                    "suspend_step": w.suspend_step,
+                }
+                for w in sorted(self._workloads.values(),
+                                key=lambda w: w.label)
+            }
+        return {
+            "enabled": self.enabled,
+            "pool": self.pool_snapshot(),
+            "queue": queue_doc,
+            "workloads": workloads,
+            "counters": self.metrics.counters(),
+            "admission_wait": self.metrics.admission_wait.snapshot(),
+        }

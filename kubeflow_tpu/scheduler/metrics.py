@@ -1,0 +1,162 @@
+"""Scheduler observability: wait histogram, counters, collector, SLO.
+
+The scheduler itself stays prometheus-free (like the workqueue and the
+autopilot): it accumulates into a
+:class:`~kubeflow_tpu.obs.metrics.BucketHistogram` plus plain
+counters, and :class:`SchedulerCollector` renders them into whichever
+registry the embedding manager serves —
+``scheduler_queue_depth``, ``scheduler_pool_chips{result}`` (the
+canonical label schema has no "state" dimension),
+``scheduler_admission_wait_seconds``, ``scheduler_preemptions_total``,
+``scheduler_reclaims_total``, ``scheduler_resurrects_total``.
+
+:func:`scheduler_queue_wait_objective` is the judging layer's view:
+the fraction of admissions that waited under the threshold, registered
+into ``make_default_slo_engine`` when a manager carries a scheduler —
+the scheduler's cost is measured by the same burn-rate machinery as
+every other platform promise.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.obs.metrics import BucketHistogram
+
+log = logging.getLogger(__name__)
+
+# Queue waits run from instant (free pool) to hours (quota-starved);
+# the reconcile-latency bounds top out at 60s and would fold every
+# real wait into +Inf.
+ADMISSION_WAIT_BUCKETS = (
+    1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+    1800.0, 3600.0, 7200.0, 21600.0,
+)
+
+
+class SchedulerMetrics:
+    """The in-process meters the collector and the SLO objective read."""
+
+    def __init__(self):
+        self.admission_wait = BucketHistogram(
+            buckets=ADMISSION_WAIT_BUCKETS
+        )
+        self.admissions_total = 0
+        self.preemptions_total = 0
+        self.reclaims_total = 0
+        self.resurrects_total = 0
+
+    def counters(self) -> dict:
+        return {
+            "admissions_total": self.admissions_total,
+            "preemptions_total": self.preemptions_total,
+            "reclaims_total": self.reclaims_total,
+            "resurrects_total": self.resurrects_total,
+        }
+
+
+def scheduler_queue_wait_objective(scheduler, namespace: str | None = None):
+    """Queue-wait SLO over the scheduler's admission-wait histogram:
+    the promise that admissions clear the queue within the threshold.
+    ``KFT_SLO_SCHEDULER_QUEUE_WAIT_{TARGET,THRESHOLD_S}`` tune it like
+    every other default objective."""
+    from kubeflow_tpu.obs.slo import (
+        Objective,
+        bucket_histogram_source,
+        tunable,
+    )
+
+    thr = tunable("scheduler-queue-wait", "threshold_s", 300.0)
+    return Objective(
+        name="scheduler-queue-wait",
+        description=f"gang admissions clear the queue within {thr:g}s",
+        target=tunable("scheduler-queue-wait", "target", 0.95),
+        threshold_s=thr,
+        namespace=namespace,
+        source=bucket_histogram_source(
+            scheduler.metrics.admission_wait, thr
+        ),
+    )
+
+
+class SchedulerCollector:
+    """Prometheus view of one :class:`SlicePoolScheduler` — registered
+    into the manager's registry by the embedding process, rendered
+    from the live pool snapshot at scrape time (the
+    RunningNotebooksCollector discipline)."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._last_pool: dict | None = None
+
+    def describe(self):
+        return []
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        try:
+            pool = self.scheduler.pool_snapshot()
+            self._last_pool = pool
+        except Exception as exc:
+            # The scrape outlives a broken capacity source: serve the
+            # last good pool numbers (the collectors' shared posture).
+            log.warning("scheduler pool scrape failed (%s); serving "
+                        "last-known values", exc)
+            pool = self._last_pool
+        if pool is not None:
+            depth = GaugeMetricFamily(
+                "scheduler_queue_depth",
+                "Workloads waiting for gang admission",
+            )
+            depth.add_metric([], pool["queued"])
+            yield depth
+            chips = GaugeMetricFamily(
+                "scheduler_pool_chips",
+                "TPU chip pool by state (capacity omitted while "
+                "unbounded)",
+                labels=["result"],
+            )
+            if pool["capacity_chips"] is not None:
+                chips.add_metric(["capacity"], pool["capacity_chips"])
+                chips.add_metric(["free"], pool["free_chips"])
+            chips.add_metric(["used"], pool["used_chips"])
+            chips.add_metric(["queued"], pool["queued_chips"])
+            yield chips
+            suspended = GaugeMetricFamily(
+                "scheduler_suspended",
+                "Slices parked at zero replicas with a checkpoint "
+                "recorded",
+            )
+            suspended.add_metric([], pool["suspended"])
+            yield suspended
+        metrics = self.scheduler.metrics
+        for name, help_text, value in (
+            ("scheduler_preemptions",
+             "Priority preemptions started (victim drained via the "
+             "checkpoint grace path)", metrics.preemptions_total),
+            ("scheduler_reclaims",
+             "Idle slices reclaimed to zero replicas",
+             metrics.reclaims_total),
+            ("scheduler_resurrects",
+             "Suspended slices re-enqueued by first touch",
+             metrics.resurrects_total),
+            ("scheduler_admissions",
+             "Gang admissions granted", metrics.admissions_total),
+        ):
+            fam = CounterMetricFamily(name, help_text)
+            fam.add_metric([], value)
+            yield fam
+        snap = metrics.admission_wait.snapshot()
+        wait = HistogramMetricFamily(
+            "scheduler_admission_wait_seconds",
+            "Seconds a workload waited in the admission queue "
+            "(observed once per admission)",
+        )
+        wait.add_metric([], buckets=snap["buckets"],
+                        sum_value=snap["sum"])
+        yield wait

@@ -1,0 +1,552 @@
+"""Two-tenant slice-pool contention: a seeded, replay-deterministic
+scheduler scenario (the PR-12 acceptance arc, game_day.py's sibling).
+
+One compressed timeline on an injected clock drives the real notebook,
+inference and culling controllers, the capacity-aware pod simulator
+and the ``PreemptionInjector`` capacity timeline against the
+:class:`~kubeflow_tpu.scheduler.SlicePoolScheduler`, and proves every
+scheduler promise end to end:
+
+- **gang admission**: ``team-a/train-lo`` (v5e-16, priority 0) and
+  ``team-a/idle-nb`` (v5e-4, priority 5) admit whole-slice into a
+  24-chip pool; the REAL ``run_with_checkpointing`` drives train-lo's
+  training loop (the world IS the batch iterator, the game-day
+  construction).
+- **priority preemption through the SIGTERM grace path**:
+  ``team-b/serve-hi`` (v5e-8 InferenceService, priority 10) arrives
+  into a full pool; the scheduler drains train-lo — the reconciler
+  stamps ``preempt-requested``, the scenario delivers the actual
+  SIGTERM (``signal.raise_signal``), the loop's final synchronous
+  checkpoint stamps the checkpoint-step annotation, the drain acks on
+  that advance, the StatefulSet scales to zero and serve-hi admits.
+  At most one cadence of steps is lost and the later resume is
+  bit-identical to an uninterrupted run (asserted).
+- **quota refusal**: ``team-b/greedy`` (second v5e-8) is refused by
+  team-b's 8-chip ``google.com/tpu`` ResourceQuota — Queued with the
+  quota reason, never blocking other tenants.
+- **idle reclamation + scale-to-zero + first-touch resurrect**: the
+  culling controller's idle verdict (kernel probe empty, duty-cycle
+  probe not busy) marks idle-nb reclaimable; it drains, parks as
+  ``Suspended`` with its checkpoint step recorded, its chips fund the
+  pool, and a scripted first touch resurrects it through the resume
+  handshake.
+- **cost is charged**: queue wait and suspension land on per-workload
+  GoodputMeters as ``queued``/``suspended`` downtime and in the
+  ``scheduler_admission_wait_seconds`` histogram.
+
+``replay_digest`` is byte-identical across runs of the same (seed,
+parameters): every clock is the scenario clock, the capacity timeline
+is the seeded ``FaultSchedule``, and controllers talk to the plain
+fake apiserver (the game-day determinism constraints).
+
+Usage::
+
+  python -m loadtest.contention --seed 11 --ticks 240
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import hashlib
+import json
+import signal
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.chaos import (  # noqa: E402
+    FaultSchedule,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+)
+from kubeflow_tpu.controllers.culling import (  # noqa: E402
+    CullingOptions,
+    make_culling_controller,
+)
+from kubeflow_tpu.controllers.inference import (  # noqa: E402
+    INFERENCE_API,
+    make_inference_controller,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics  # noqa: E402
+from kubeflow_tpu.controllers.notebook import (  # noqa: E402
+    CHECKPOINT_STEP_KEY,
+    NOTEBOOK_API,
+    RESUME_EXPECTED_KEY,
+    make_notebook_controller,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound  # noqa: E402
+from kubeflow_tpu.obs import GoodputMeter  # noqa: E402
+from kubeflow_tpu.scheduler import (  # noqa: E402
+    PRIORITY_KEY,
+    SlicePoolScheduler,
+)
+
+
+class Clock:
+    """The injected scenario clock every component shares."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+class InMemoryCheckpointManager:
+    """Deterministic manager for the scenario's training loop: commits
+    are deep copies keyed by step (restore is bit-exact by
+    construction, so the scenario's bit-identity assertion tests the
+    SCHEDULER's resume path, not serialization), and every commit
+    stamps the checkpoint-step annotation on the owning CR — the
+    in-image reporter's hop, and the drain-ack signal."""
+
+    process_count = 1
+
+    def __init__(self, api, namespace: str, name: str, clock):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self._clock = clock
+        self.fingerprint: dict = {}
+        self.store: dict[int, dict] = {}
+        # Bounded by the scenario's step budget (the digest's raw
+        # data).  # analysis: allow[py-unbounded-deque]
+        self.saves: list[tuple[int, float]] = []
+
+    def _commit(self, step, state) -> None:
+        step = int(step)
+        self.store[step] = copy.deepcopy(state)
+        self.saves.append((step, self._clock()))
+        try:
+            self.api.patch_merge(
+                NOTEBOOK_API, "Notebook", self.name,
+                {"metadata": {"annotations": {
+                    CHECKPOINT_STEP_KEY: str(step),
+                }}},
+                self.namespace,
+            )
+        except NotFound:
+            pass  # CR deleted mid-save: nothing to stamp
+
+    def save_async(self, step, state) -> None:
+        self._commit(step, state)
+
+    def save(self, step, state) -> None:
+        self._commit(step, state)
+
+    def wait(self) -> None:
+        pass
+
+    def restore_latest_valid(self, like, placements=None):
+        if not self.store:
+            return None
+        step = max(self.store)
+        return copy.deepcopy(self.store[step]), step
+
+
+def train_step(state, batch):
+    """Deterministic integer-arithmetic step: resume divergence of any
+    kind shows up as an exact mismatch against the uninterrupted
+    reference run."""
+    step = state["step"] + 1
+    return {"step": step, "acc": state["acc"] + step * step}, {}
+
+
+def reference_state(steps: int) -> dict:
+    state = {"step": 0, "acc": 0}
+    for _ in range(steps):
+        state, _ = train_step(state, None)
+    return state
+
+
+def _notebook(ns: str, name: str, topology: str, priority: int,
+              extra_annotations: dict | None = None) -> dict:
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "annotations": {
+                PRIORITY_KEY: str(priority),
+                **(extra_annotations or {}),
+            },
+        },
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": topology},
+            "template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter-jax-tpu"},
+            ]}},
+        },
+    }
+
+
+def _inference(ns: str, name: str, topology: str, priority: int) -> dict:
+    return {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "annotations": {PRIORITY_KEY: str(priority)},
+        },
+        "spec": {
+            "modelDir": "/models/prod",
+            "tpu": {"accelerator": "v5e", "topology": topology},
+        },
+    }
+
+
+class Contention:
+    """One scripted contention day. Tick fractions script the arc so
+    ``ticks`` compresses the same story."""
+
+    SERVE_ARRIVES = 0.10   # serve-hi lands: preemption of train-lo
+    GREEDY_ARRIVES = 0.20  # second team-b slice: quota refusal
+    REGROW_AT = 0.40       # capacity 24 -> 32: train-lo re-admits
+    TOUCH_AT = 0.80        # first touch resurrects idle-nb
+
+    def __init__(self, seed: int = 11, ticks: int = 240,
+                 tick_s: float = 30.0):
+        self.seed = int(seed)
+        self.total_ticks = int(ticks)
+        self.tick_s = float(tick_s)
+        self.clk = Clock(0.0)
+        self.tick_index = 0
+        day_s = self.total_ticks * self.tick_s
+
+        self.schedule = (
+            FaultSchedule(seed=self.seed)
+            .capacity(0.0, 24)
+            .capacity(self.REGROW_AT * day_s, 32, jitter_s=self.tick_s)
+        )
+        self.api = FakeApiServer()
+        self.sim = StatefulSetPodSimulator(
+            self.api, recreate_on_template_change=True)
+        self.injector = PreemptionInjector(self.api,
+                                           sleep=lambda s: None)
+
+        self.meters: dict[tuple[str, str, str], GoodputMeter] = {}
+        self.scheduler = SlicePoolScheduler(
+            capacity_fn=lambda: self.schedule.capacity_at(self.clk()),
+            api=self.api,
+            clock=self.clk,
+            aging_s=3600.0,
+            drain_grace_s=4 * self.tick_s,
+            enabled=True,
+            charge_downtime=self._charge,
+        )
+
+        self.prom = ControllerMetrics()
+        self.nb_ctrl = make_notebook_controller(
+            self.api, prom=self.prom, clock=self.clk,
+            scheduler=self.scheduler)
+        self.inf_ctrl = make_inference_controller(
+            self.api, prom=self.prom, scheduler=self.scheduler,
+            clock=self.clk)
+        self.touched = False
+        self.cull_ctrl = make_culling_controller(
+            self.api,
+            # Every notebook's kernels read idle; train-lo is protected
+            # by the duty-cycle busy veto (it is training), and a
+            # touched idle-nb reads busy again (the user attached) —
+            # exactly the reclaim discipline.
+            kernel_probe=lambda ns, name: [],
+            options=CullingOptions(
+                enabled=True,
+                cull_idle_time_min=max(
+                    1, int(0.5 * self.total_ticks * self.tick_s / 60)),
+                idleness_check_period_min=1,
+            ),
+            tpu_busy_probe=lambda ns, name: (
+                name == "train-lo"
+                or (name == "idle-nb" and self.touched)
+            ),
+            clock=self.clk,
+            prom=self.prom,
+            scheduler=self.scheduler,
+        )
+
+        # Tenants: team-b holds an 8-chip TPU quota (the Profile
+        # controller's ResourceQuota shape).
+        self.api.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "kf-resource-quota",
+                         "namespace": "team-b"},
+            "spec": {"hard": {"google.com/tpu": "8"}},
+        })
+        self.api.create(_notebook("team-a", "train-lo", "4x4", 0))
+        self.api.create(_notebook(
+            "team-a", "idle-nb", "2x2", 5,
+            extra_annotations={CHECKPOINT_STEP_KEY: "7"},
+        ))
+        self.ckpt = InMemoryCheckpointManager(
+            self.api, "team-a", "train-lo", self.clk)
+        self.sigterm_sent = False
+        # Change-gated, bounded by the scenario's tick count.
+        # analysis: allow[py-unbounded-deque]
+        self.phase_timeline: list[list] = []
+        self._last_phases: tuple | None = None
+
+    # ------------------------------------------------------------------
+    def _charge(self, kind: str, namespace: str, name: str,
+                downtime_kind: str, seconds: float) -> None:
+        meter = self.meters.setdefault(
+            (kind, namespace, name), GoodputMeter(clock=self.clk,
+                                                  epoch_clock=self.clk))
+        meter.record_downtime(downtime_kind, seconds)
+
+    def _phase_of(self, api_version: str, kind: str, ns: str,
+                  name: str) -> str | None:
+        try:
+            obj = self.api.get(api_version, kind, name, ns)
+        except NotFound:
+            return None
+        return (obj.get("status") or {}).get("phase")
+
+    def _annotations(self, ns: str, name: str) -> dict:
+        try:
+            obj = self.api.get(NOTEBOOK_API, "Notebook", name, ns)
+        except NotFound:
+            return {}
+        return (obj.get("metadata") or {}).get("annotations") or {}
+
+    def _sample(self) -> None:
+        phases = (
+            self.tick_index,
+            self._phase_of(NOTEBOOK_API, "Notebook", "team-a",
+                           "train-lo"),
+            self._phase_of(NOTEBOOK_API, "Notebook", "team-a",
+                           "idle-nb"),
+            self._phase_of(INFERENCE_API, "InferenceService", "team-b",
+                           "serve-hi"),
+            self._phase_of(INFERENCE_API, "InferenceService", "team-b",
+                           "greedy"),
+            self.scheduler.pool_snapshot()["used_chips"],
+        )
+        if self._last_phases is None or phases[1:] != self._last_phases:
+            self._last_phases = phases[1:]
+            self.phase_timeline.append(list(phases))
+
+    def _tick(self) -> None:
+        now = self.clk.advance(self.tick_s)
+        if self.tick_index == int(self.SERVE_ARRIVES
+                                  * self.total_ticks):
+            self.api.create(_inference("team-b", "serve-hi", "2x4", 10))
+        if self.tick_index == int(self.GREEDY_ARRIVES
+                                  * self.total_ticks):
+            self.api.create(_inference("team-b", "greedy", "2x4", 10))
+        if self.tick_index == int(self.TOUCH_AT * self.total_ticks):
+            self.touched = True
+            self.scheduler.touch("Notebook", "team-a", "idle-nb",
+                                 now=now)
+        self.injector.apply_capacity(self.schedule, now, self.sim)
+        self.sim.step()
+        for ctrl in (self.nb_ctrl, self.inf_ctrl, self.cull_ctrl):
+            ctrl.resync()
+            ctrl.run_once()
+        self._sample()
+        self.tick_index += 1
+
+    def _ticks_until(self, fraction: float):
+        limit = int(fraction * self.total_ticks)
+        while self.tick_index < limit:
+            self._tick()
+
+    # ------------------------------------------------------------------
+    def _segment1_batches(self):
+        """The world up to (and through) the preemption: each batch
+        advances one scenario tick; the preempt-requested annotation
+        becomes the real SIGTERM the grace path is built for."""
+        from kubeflow_tpu.scheduler import PREEMPT_REQUESTED_KEY
+
+        while self.tick_index < self.total_ticks:
+            self._tick()
+            anns = self._annotations("team-a", "train-lo")
+            if (PREEMPT_REQUESTED_KEY in anns
+                    and not self.sigterm_sent):
+                self.sigterm_sent = True
+                signal.raise_signal(signal.SIGTERM)
+            yield {"x": [1.0]}
+
+    def _segment2_batches(self, count: int):
+        for _ in range(count):
+            if self.tick_index < self.total_ticks:
+                self._tick()
+            yield {"x": [1.0]}
+
+    def run(self) -> dict:
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        cadence = 5
+        state1, report1 = run_with_checkpointing(
+            train_step, {"step": 0, "acc": 0},
+            self._segment1_batches(), self.ckpt,
+            save_every_steps=cadence,
+            install_signal_handler=True,
+            clock=self.clk,
+        )
+        # Drain ack -> scale to zero -> serve-hi admits; then capacity
+        # regrows and train-lo re-admits.
+        self._ticks_until(self.REGROW_AT + 0.05)
+        segment2_steps = max(10, int(0.2 * self.total_ticks))
+        state2, report2 = run_with_checkpointing(
+            train_step, {"step": 0, "acc": 0},
+            self._segment2_batches(segment2_steps), self.ckpt,
+            save_every_steps=cadence,
+            install_signal_handler=False,
+            clock=self.clk,
+        )
+        while self.tick_index < self.total_ticks:
+            self._tick()
+        return self._summarize(cadence, report1, report2, state2)
+
+    # ------------------------------------------------------------------
+    def _summarize(self, cadence, report1, report2, state2) -> dict:
+        steps_lost = report1.final_step - (report2.resumed_from_step
+                                           or 0)
+        reference = reference_state(report2.final_step)
+        goodput = {
+            f"{k[0]}/{k[1]}/{k[2]}": {
+                "downtime_s": {
+                    kind: round(s, 3)
+                    for kind, s in sorted(
+                        meter.summary()["downtime_s"].items())
+                },
+            }
+            for k, meter in sorted(self.meters.items())
+        }
+        wait_snap = self.scheduler.metrics.admission_wait.snapshot()
+        resume_expected = self._annotations(
+            "team-a", "idle-nb").get(RESUME_EXPECTED_KEY)
+        digest_payload = {
+            "phases": self.phase_timeline,
+            "saves": [[s, round(at, 3)] for s, at in self.ckpt.saves],
+            "counters": self.scheduler.metrics.counters(),
+            "goodput": goodput,
+            "wait": {"count": wait_snap["count"],
+                     "sum": round(wait_snap["sum"], 3)},
+            "resume": [report1.final_step, report2.resumed_from_step,
+                       report2.final_step],
+        }
+        digest = hashlib.sha256(
+            json.dumps(digest_payload, sort_keys=True).encode()
+        ).hexdigest()
+        return {
+            "kind": "contention",
+            "seed": self.seed,
+            "ticks": self.total_ticks,
+            "tick_s": self.tick_s,
+            "counters": self.scheduler.metrics.counters(),
+            "preemption": {
+                "victim_final_step": report1.final_step,
+                "victim_preempted": report1.preempted,
+                "resumed_from_step": report2.resumed_from_step,
+                "steps_lost": steps_lost,
+                "cadence": cadence,
+                "bit_identical": state2 == reference,
+            },
+            "reclaim": {
+                "idle_suspended": any(
+                    row[2] == "Suspended" for row in self.phase_timeline
+                ),
+                "idle_resurrected": self._phase_of(
+                    NOTEBOOK_API, "Notebook", "team-a", "idle-nb"
+                ) not in ("Suspended", "Queued"),
+                "resume_expected_step": resume_expected,
+            },
+            "quota": {
+                "greedy_phase": self._phase_of(
+                    INFERENCE_API, "InferenceService", "team-b",
+                    "greedy"),
+                "greedy_reason": (
+                    (self.api.get(INFERENCE_API, "InferenceService",
+                                  "greedy", "team-b")
+                     .get("status") or {}).get("schedulingReason")
+                ),
+            },
+            "goodput": goodput,
+            "queue_wait": {
+                "count": wait_snap["count"],
+                "p99_s": self.scheduler.metrics.admission_wait
+                             .quantile(0.99),
+            },
+            "pool": self.scheduler.pool_snapshot(),
+            "phases": self.phase_timeline,
+            "replay_digest": digest,
+        }
+
+
+def run_contention(seed: int = 11, ticks: int = 240,
+                   tick_s: float = 30.0) -> dict:
+    return Contention(seed=seed, ticks=ticks, tick_s=tick_s).run()
+
+
+def problems_in(summary: dict) -> list[str]:
+    """The acceptance checklist the CLI gates on (shared with the test
+    suite so both judge one contract)."""
+    problems = []
+    pre = summary["preemption"]
+    if not pre["victim_preempted"]:
+        problems.append("victim never took the SIGTERM grace path")
+    if pre["resumed_from_step"] is None:
+        problems.append("victim never resumed from a checkpoint")
+    elif pre["steps_lost"] > pre["cadence"]:
+        problems.append(
+            f"lost {pre['steps_lost']} steps > cadence "
+            f"{pre['cadence']}")
+    if not pre["bit_identical"]:
+        problems.append("resumed run diverged from the uninterrupted "
+                        "reference")
+    if summary["counters"]["preemptions_total"] < 1:
+        problems.append("no preemption recorded")
+    if summary["counters"]["reclaims_total"] < 1:
+        problems.append("idle slice never reclaimed")
+    if not summary["reclaim"]["idle_suspended"]:
+        problems.append("idle-nb never surfaced Suspended")
+    if not summary["reclaim"]["idle_resurrected"]:
+        problems.append("idle-nb never resurrected after touch")
+    if summary["quota"]["greedy_phase"] != "Queued":
+        problems.append("quota refusal did not queue the greedy slice")
+    if "quota" not in (summary["quota"]["greedy_reason"] or ""):
+        problems.append("quota reason missing from status")
+    meters = summary["goodput"]
+    queued_kinds = [m for m in meters.values()
+                    if "queued" in m["downtime_s"]]
+    suspended_kinds = [m for m in meters.values()
+                       if "suspended" in m["downtime_s"]]
+    if not queued_kinds:
+        problems.append("no queued downtime charged to goodput")
+    if not suspended_kinds:
+        problems.append("no suspended downtime charged to goodput")
+    if summary["queue_wait"]["count"] < 1:
+        problems.append("admission wait histogram is empty")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay-deterministic two-tenant slice-pool "
+        "contention scenario asserting the scheduler's promises.")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--ticks", type=int, default=240)
+    parser.add_argument("--tick-s", type=float, default=30.0)
+    args = parser.parse_args(argv)
+    summary = run_contention(seed=args.seed, ticks=args.ticks,
+                             tick_s=args.tick_s)
+    compact = {k: v for k, v in summary.items() if k != "phases"}
+    print(json.dumps(compact))
+    problems = problems_in(summary)
+    if problems:
+        print("CONTENTION FAILED: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

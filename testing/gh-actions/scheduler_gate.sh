@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Slice-pool scheduler gate for CI (PR 12). Four checks:
+#
+# 1. Scheduler tier-1 subset: the full tests/test_scheduler.py fast
+#    set — gang all-or-nothing admission, quota refusal, priority
+#    preemption through the checkpoint drain (≤ cadence steps lost,
+#    bit-identical resume), idle→suspend→first-touch-resurrect,
+#    starvation freedom under aging, KFT_SCHEDULER=0 inertness, the
+#    observability surfaces, the elastic demotion arm, and the fast
+#    contention scenario with byte-identical replay — plus the
+#    py-unbounded-queue-admission rule fixtures in
+#    tests/test_analysis.py.
+#
+# 2. Disabled-switch smoke: KFT_SCHEDULER=0 must make
+#    SlicePoolScheduler() report disabled and admit everything with
+#    zero bookkeeping (the KFT_AUTOPILOT discipline; the full
+#    byte-identical reconcile pin lives in the test suite).
+#
+# 3. Analysis: kubeflow_tpu/scheduler/ holds ZERO findings under
+#    every pack — including the new py-unbounded-queue-admission rule
+#    — with no pragma budget; the full kubeflow_tpu package stays
+#    clean too.
+#
+# 4. RUN_SLOW=1: the full-size contention scenario via the CLI (its
+#    own exit code gates the acceptance checklist) and the
+#    goodput/queue-wait JSON artifact is asserted.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== scheduler gate: tier-1 subset =="
+python -m pytest -q -p no:cacheprovider -m 'not slow' \
+  tests/test_scheduler.py \
+  "tests/test_analysis.py::TestUnboundedQueueAdmissionRule"
+
+echo "== scheduler gate: disabled switch =="
+KFT_SCHEDULER=0 python - <<'PY'
+from kubeflow_tpu.scheduler import SlicePoolScheduler, scheduler_enabled
+
+assert not scheduler_enabled(), "KFT_SCHEDULER=0 must disable"
+sched = SlicePoolScheduler(capacity_fn=lambda: 0)
+assert not sched.enabled
+verdict = sched.decide("Notebook", "ns", "nb", 16, {})
+assert verdict.admitted and verdict.phase is None, \
+    "disabled scheduler must admit everything"
+assert sched.pool_snapshot()["admitted"] == 0, \
+    "disabled scheduler must keep zero state"
+print("  KFT_SCHEDULER=0: layer fully inert")
+PY
+
+echo "== scheduler gate: zero analysis findings (all packs) =="
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/scheduler"], check_emitted=False,
+))
+if findings:
+    for f in findings:
+        print(f.render())
+    raise SystemExit(
+        f"{len(findings)} finding(s) in kubeflow_tpu/scheduler/ — "
+        "the scheduler carries no pragma budget"
+    )
+whole = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu"], check_emitted=False,
+))
+if whole:
+    for f in whole:
+        print(f.render())
+    raise SystemExit(
+        f"{len(whole)} finding(s) in kubeflow_tpu/ under the full "
+        "pack set (incl. py-unbounded-queue-admission)"
+    )
+print("  kubeflow_tpu/ (incl. scheduler/): zero findings, all packs")
+PY
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "== scheduler gate: full contention scenario =="
+  artifact="${SCHEDULER_CONTENTION_JSON:-contention-summary.json}"
+  python -m loadtest.contention --seed 11 --ticks 240 \
+    | tee "$artifact"
+  python - "$artifact" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    doc = json.loads(fh.read().strip().splitlines()[-1])
+assert doc["kind"] == "contention", doc
+assert doc["counters"]["preemptions_total"] >= 1
+assert doc["counters"]["reclaims_total"] >= 1
+assert doc["counters"]["resurrects_total"] >= 1
+pre = doc["preemption"]
+assert pre["victim_preempted"] and pre["bit_identical"]
+assert pre["steps_lost"] <= pre["cadence"]
+meters = doc["goodput"]
+assert any("queued" in m["downtime_s"] for m in meters.values())
+assert any("suspended" in m["downtime_s"] for m in meters.values())
+assert doc["queue_wait"]["count"] >= 1
+assert doc["replay_digest"]
+print(f"  contention artifact ok: {doc['counters']}, "
+      f"queue-wait p99 {doc['queue_wait']['p99_s']}s, "
+      f"digest {doc['replay_digest'][:12]}…")
+PY
+fi
+
+echo "scheduler gate OK"

@@ -1,0 +1,48 @@
+"""Seeded py-unbounded-queue-admission violations: admission loops
+missing the ordering key, the capacity check, or both."""
+
+
+class GreedyAdmitter:
+    """Admits whatever pop() hands back — LIFO, unbounded."""
+
+    def __init__(self, api):
+        self.api = api
+        self.pending = []
+
+    def admit_all(self):  # seeded: no ordering key, no capacity check
+        while self.pending:
+            workload = self.pending.pop()
+            self.api.create(workload)
+
+
+class SortedButUnbounded:
+    """Orders by priority but never asks whether the pool has room."""
+
+    def __init__(self, api):
+        self.api = api
+        self.pending = []
+
+    def admission_pass(self):  # seeded: no quota/capacity check
+        batch = sorted(self.pending, key=lambda w: -w["priority"])
+        while self.pending:
+            self.pending.pop()
+        for workload in batch:
+            self.api.create(workload)
+
+
+class BoundedButUnordered:
+    """Checks capacity but admits an arbitrary queue element."""
+
+    def __init__(self, api, capacity):
+        self.api = api
+        self.capacity = capacity
+        self.used = 0
+        self.waiting = {}
+
+    def admit_next(self):  # seeded: no priority/FIFO ordering key
+        while self.waiting:
+            name, workload = self.waiting.popitem()
+            if self.used + workload["chips"] > self.capacity:
+                break
+            self.used += workload["chips"]
+            self.api.create(workload)

@@ -1,0 +1,69 @@
+"""Clean counterparts for py-unbounded-queue-admission: the ordering
+and capacity disciplines, the FIFO-by-construction pops, the pragma
+escape, and a non-admission queue drain that must not match."""
+
+from collections import deque
+
+
+class DisciplinedAdmitter:
+    """Priority order + capacity check: the reference discipline."""
+
+    def __init__(self, api, capacity):
+        self.api = api
+        self.capacity = capacity
+        self.used = 0
+        self.pending = []
+
+    def admission_pass(self):
+        for workload in sorted(self.pending,
+                               key=lambda w: (-w["priority"], w["seq"])):
+            if self.used + workload["chips"] > self.capacity:
+                break
+            self.used += workload["chips"]
+            self.api.create(workload)
+
+
+class FifoAdmitter:
+    """popleft() preserves arrival order — FIFO by construction; the
+    free-slot scan is the capacity check."""
+
+    def __init__(self, api, slots):
+        self.api = api
+        self.slots = slots
+        self.queue = deque()
+
+    def admit_capped(self):
+        while self.queue:
+            free = next((i for i, s in enumerate(self.slots)
+                         if s is None), None)
+            if free is None:
+                return
+            workload = self.queue.popleft()
+            self.slots[free] = workload
+            self.api.create(workload)
+
+
+class DeliberateDrainer:
+    """A deliberately unordered admission drain, annotated."""
+
+    def __init__(self, api):
+        self.api = api
+        self.pending = []
+
+    def admit_remaining(self):  # analysis: allow[py-unbounded-queue-admission]
+        while self.pending:
+            self.api.create(self.pending.pop())
+
+
+class ResultCollector:
+    """Pops from a queue-ish buffer but is not an admission loop —
+    the rule must not match on the receiver fragment alone."""
+
+    def __init__(self):
+        self.result_queue = []
+
+    def drain_results(self):
+        out = []
+        while self.result_queue:
+            out.append(self.result_queue.pop())
+        return out

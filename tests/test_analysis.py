@@ -583,6 +583,168 @@ class TestUnboundedActuationRule:
         ) == 1
 
 
+class TestUnboundedQueueAdmissionRule:
+    """py-unbounded-queue-admission: admission/scheduling loops over a
+    work queue must carry an ordering key and a quota/capacity check
+    (PR 12 — the slice-pool scheduler's admission discipline)."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-unbounded-queue-admission",
+                  "unordered_admission.py")
+        assert sorted(f.line for f in hits) == [12, 25, 42]
+        assert all(f.severity == Severity.WARNING for f in hits)
+        messages = {f.line: f.message for f in hits}
+        assert "no priority/FIFO ordering key" in messages[12]
+        assert "no quota/capacity check" in messages[12]
+        assert "no quota/capacity check" in messages[25]
+        assert "no priority/FIFO ordering key" not in messages[25]
+        assert "no priority/FIFO ordering key" in messages[42]
+        assert "no quota/capacity check" not in messages[42]
+
+    def _findings(self, source, path="kubeflow_tpu/scheduler/x.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-unbounded-queue-admission"
+        ]
+
+    def test_clean_fixture_is_silent(self):
+        clean = os.path.join(CLEAN, "code", "ordered_admission.py")
+        findings = analyze_paths(
+            AnalysisConfig(paths=[clean], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-unbounded-queue-admission"] == []
+
+    def test_fifo_pop_with_capacity_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, capacity):\n"
+            "        self.api = api\n"
+            "        self.capacity = capacity\n"
+            "        self.queue = []\n"
+            "    def admit(self):\n"
+            "        while self.queue and self.capacity > 0:\n"
+            "            self.api.create(self.queue.pop(0))\n"
+        )
+        assert self._findings(src) == []
+
+    def test_lifo_pop_without_ordering_fires(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, capacity):\n"
+            "        self.api = api\n"
+            "        self.capacity = capacity\n"
+            "        self.queue = []\n"
+            "    def admit(self):\n"
+            "        while self.queue and self.capacity > 0:\n"
+            "            self.api.create(self.queue.pop())\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 6
+        assert "no priority/FIFO ordering key" in f.message
+
+    def test_missing_capacity_fires(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "        self.pending = []\n"
+            "    def admission_pass(self):\n"
+            "        for w in sorted(self.pending,\n"
+            "                        key=lambda w: w['priority']):\n"
+            "            self.api.create(w)\n"
+        )
+        (f,) = self._findings(src)
+        assert "no quota/capacity check" in f.message
+
+    def test_non_admission_name_is_silent(self):
+        # Popping a queue-ish buffer outside an admission/scheduling
+        # loop is not this rule's business.
+        src = (
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.result_queue = []\n"
+            "    def drain(self):\n"
+            "        while self.result_queue:\n"
+            "            self.result_queue.pop()\n"
+        )
+        assert self._findings(src) == []
+
+    def test_admission_without_queue_is_silent(self):
+        src = (
+            "def admit_request(req, capacity):\n"
+            "    return req['chips'] <= capacity\n"
+        )
+        assert self._findings(src) == []
+
+    def test_class_scope_evidence_counts(self):
+        # Discipline may live in a helper: the quota check sits in a
+        # sibling method of the same class.
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "        self.queue = []\n"
+            "    def _fits(self, w):\n"
+            "        return self.quota_for(w) >= w['chips']\n"
+            "    def admit(self):\n"
+            "        while self.queue:\n"
+            "            w = self.queue.pop(0)\n"
+            "            if self._fits(w):\n"
+            "                self.api.create(w)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_test_trees_are_exempt(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.pending = []\n"
+            "    def admit(self):\n"
+            "        while self.pending:\n"
+            "            self.pending.pop()\n"
+        )
+        assert self._findings(src, path="tests/test_x.py") == []
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "        self.pending = []\n"
+            "    # analysis: allow[py-unbounded-queue-admission]\n"
+            "    def admit(self):\n"
+            "        while self.pending:\n"
+            "            self.api.create(self.pending.pop())\n"
+        )
+        target = tmp_path / "pragma_admission.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-unbounded-queue-admission"] == []
+        target.write_text(src.replace(
+            "    # analysis: allow[py-unbounded-queue-admission]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len([
+            f for f in findings
+            if f.rule == "py-unbounded-queue-admission"
+        ]) == 1
+
+    def test_scheduler_package_is_clean(self):
+        pkg = os.path.join(REPO, "kubeflow_tpu", "scheduler")
+        findings = analyze_paths(
+            AnalysisConfig(paths=[pkg], check_emitted=False)
+        )
+        assert findings == []
+
+
 class TestUnboundedMetricLabelsRule:
     """py-unbounded-metric-labels flags request-derived label values
     only: the platform's sanctioned vocabulary (namespace/name object

@@ -1,0 +1,853 @@
+"""Slice-pool scheduler tests (PR 12): gang admission, quota, priority
+preemption through the checkpoint drain, idle reclamation +
+first-touch resurrect, starvation freedom, KFT_SCHEDULER=0 inertness,
+the observability surfaces, the elastic demotion arm, and the seeded
+two-tenant contention scenario with byte-identical replay."""
+
+import copy
+
+import pytest
+
+from kubeflow_tpu.autopilot import ActuationGuard, ElasticPromotionGate
+from kubeflow_tpu.controllers import elastic
+from kubeflow_tpu.controllers.elastic import (
+    ELASTIC_GRACE_KEY,
+    ELASTIC_LADDER_KEY,
+    ELASTIC_SHAPE_KEY,
+)
+from kubeflow_tpu.controllers.notebook import (
+    CHECKPOINT_STEP_KEY,
+    NOTEBOOK_API,
+    RESUME_EXPECTED_KEY,
+    NotebookReconciler,
+)
+from kubeflow_tpu.controllers.runtime import Request
+from kubeflow_tpu.k8s.fake import FakeApiServer
+from kubeflow_tpu.scheduler import (
+    PREEMPT_REQUESTED_KEY,
+    PRIORITY_KEY,
+    SUSPEND_STEP_KEY,
+    SchedulerCollector,
+    SlicePoolScheduler,
+    resource_quota_chips,
+    scheduler_queue_wait_objective,
+)
+from kubeflow_tpu.topology import TpuSlice
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+        return self.t
+
+
+def make_scheduler(capacity, clock=None, **kwargs):
+    """Scheduler over a mutable capacity box: tests shrink/regrow the
+    pool by assigning ``box[0]``."""
+    box = capacity if isinstance(capacity, list) else [capacity]
+    kwargs.setdefault("aging_s", 600.0)
+    kwargs.setdefault("drain_grace_s", 60.0)
+    kwargs.setdefault("enabled", True)
+    sched = SlicePoolScheduler(
+        capacity_fn=lambda: box[0],
+        clock=clock or Clock(),
+        **kwargs,
+    )
+    return sched, box
+
+
+class TestGangAdmission:
+    def test_whole_slice_or_nothing(self):
+        clk = Clock()
+        sched, box = make_scheduler(12, clock=clk)
+        v = sched.decide("Notebook", "a", "big", 16, {})
+        assert not v.admitted
+        assert v.phase == "Queued"
+        assert "gang needs 16" in v.reason
+        assert v.queue_position == 1
+        # Capacity regrows: the whole gang admits in one verdict.
+        box[0] = 16
+        clk.advance(30)
+        v = sched.decide("Notebook", "a", "big", 16, {})
+        assert v.admitted and v.phase is None
+
+    def test_admitted_gang_holds_all_chips(self):
+        sched, _ = make_scheduler(16)
+        assert sched.decide("Notebook", "a", "one", 16, {}).admitted
+        v = sched.decide("Notebook", "a", "two", 8, {})
+        assert not v.admitted
+        assert "0 free" in v.reason
+
+    def test_elastic_reshape_updates_demand(self):
+        # A degraded slice demands only the effective shape: the freed
+        # half funds another admission.
+        sched, _ = make_scheduler(16)
+        assert sched.decide("Notebook", "a", "one", 16, {}).admitted
+        assert not sched.decide("Notebook", "a", "two", 8, {}).admitted
+        assert sched.decide("Notebook", "a", "one", 8, {}).admitted
+        assert sched.decide("Notebook", "a", "two", 8, {}).admitted
+
+    def test_unbounded_pool_admits_everything(self):
+        sched = SlicePoolScheduler(clock=Clock(), enabled=True)
+        for i in range(5):
+            assert sched.decide("Notebook", "a", f"nb{i}", 256,
+                                {}).admitted
+
+    def test_release_frees_the_gang(self):
+        sched, _ = make_scheduler(16)
+        assert sched.decide("Notebook", "a", "one", 16, {}).admitted
+        assert not sched.decide("Notebook", "a", "two", 16, {}).admitted
+        sched.release("Notebook", "a", "one")
+        assert sched.decide("Notebook", "a", "two", 16, {}).admitted
+
+
+class TestQuota:
+    def test_quota_refusal_names_the_budget(self):
+        sched, _ = make_scheduler(
+            32, quota_fn=lambda ns: 8 if ns == "team-b" else None)
+        assert sched.decide("InferenceService", "team-b", "one", 8,
+                            {}).admitted
+        v = sched.decide("InferenceService", "team-b", "two", 8, {})
+        assert not v.admitted
+        assert "quota" in v.reason
+
+    def test_quota_block_is_namespace_local(self):
+        # A quota-starved tenant never head-blocks another namespace.
+        clk = Clock()
+        sched, _ = make_scheduler(
+            32, clock=clk,
+            quota_fn=lambda ns: 8 if ns == "team-b" else None)
+        assert sched.decide("InferenceService", "team-b", "one", 8,
+                            {}).admitted
+        assert not sched.decide("InferenceService", "team-b", "two", 8,
+                                {}).admitted
+        clk.advance(1)
+        assert sched.decide("Notebook", "team-a", "nb", 16,
+                            {}).admitted
+
+    def test_resource_quota_chips_reads_the_tightest_hard_limit(self):
+        api = FakeApiServer()
+        api.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "rq1", "namespace": "team"},
+            "spec": {"hard": {"google.com/tpu": "16", "cpu": "64"}},
+        })
+        api.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "rq2", "namespace": "team"},
+            "spec": {"hard": {"requests.google.com/tpu": "8"}},
+        })
+        assert resource_quota_chips(api, "team") == 8
+        assert resource_quota_chips(api, "unquotaed") is None
+
+
+class TestPriorityPreemption:
+    def _drained(self, sched, clk):
+        """Drive the victim's drain to completion via the checkpoint
+        annotation ack, returning its post-drain verdict."""
+        v = sched.decide("Notebook", "a", "low", 16, {})
+        assert v.phase == "Preempting"
+        assert PREEMPT_REQUESTED_KEY in v.annotations
+        clk.advance(10)
+        # The grace save landed: the checkpoint-step annotation
+        # advanced past the drain baseline.
+        return sched.decide("Notebook", "a", "low", 16,
+                            {CHECKPOINT_STEP_KEY: "42"})
+
+    def test_high_priority_arrival_evicts_lowest(self):
+        clk = Clock()
+        sched, _ = make_scheduler(16, clock=clk)
+        assert sched.decide("Notebook", "a", "low", 16, {}).admitted
+        v = sched.decide("InferenceService", "b", "high", 8,
+                         {PRIORITY_KEY: "10"})
+        assert not v.admitted
+        assert "preempting" in v.reason.lower()
+        assert sched.metrics.preemptions_total == 1
+        # Victim keeps running through the grace window (admitted
+        # verdict, Preempting phase), then re-queues on the ack.
+        after = self._drained(sched, clk)
+        assert not after.admitted
+        assert after.phase == "Queued"
+        clk.advance(10)
+        assert sched.decide("InferenceService", "b", "high", 8,
+                            {PRIORITY_KEY: "10"}).admitted
+
+    def test_gang_all_or_nothing_preemption(self):
+        # Draining every victim would still not fit the arrival (32
+        # chips can never fit a 16-chip pool): nobody is evicted for
+        # nothing.
+        sched, _ = make_scheduler(16)
+        assert sched.decide("Notebook", "a", "small", 4, {}).admitted
+        v = sched.decide("Notebook", "b", "big", 32,
+                         {PRIORITY_KEY: "10"})
+        assert not v.admitted
+        assert "insufficient capacity" in v.reason
+        assert sched.metrics.preemptions_total == 0
+
+    def test_equal_priority_never_preempts(self):
+        sched, _ = make_scheduler(16)
+        assert sched.decide("Notebook", "a", "first", 16, {}).admitted
+        v = sched.decide("Notebook", "b", "second", 16, {})
+        assert not v.admitted
+        assert sched.metrics.preemptions_total == 0
+
+    def test_in_flight_drain_is_not_duplicated(self):
+        # While the first victim drains, repeat consults must not pile
+        # more victims onto the same arrival.
+        clk = Clock()
+        sched, _ = make_scheduler(24, clock=clk)
+        assert sched.decide("Notebook", "a", "low", 16, {}).admitted
+        assert sched.decide("Notebook", "a", "mid", 4,
+                            {PRIORITY_KEY: "5"}).admitted
+        sched.decide("InferenceService", "b", "high", 8,
+                     {PRIORITY_KEY: "10"})
+        assert sched.metrics.preemptions_total == 1
+        clk.advance(5)
+        v = sched.decide("InferenceService", "b", "high", 8,
+                         {PRIORITY_KEY: "10"})
+        assert "in-flight" in v.reason
+        assert sched.metrics.preemptions_total == 1
+
+    def test_victim_sizing_credits_inflight_drains(self):
+        # capacity 24: A(8) already draining for reclaim, B(8)+C(8)
+        # admitted at priority 0; a 16-chip arrival must evict ONE of
+        # B/C, not both — A's chips free regardless.
+        clk = Clock()
+        sched, _ = make_scheduler(24, clock=clk, drain_grace_s=600.0)
+        assert sched.decide("Notebook", "a", "A", 8, {}).admitted
+        assert sched.decide("Notebook", "a", "B", 8, {}).admitted
+        assert sched.decide("Notebook", "a", "C", 8, {}).admitted
+        assert sched.mark_reclaimable("Notebook", "a", "A", now=clk())
+        clk.advance(1)
+        sched.decide("Notebook", "b", "X", 16, {PRIORITY_KEY: "10"})
+        assert sched.metrics.preemptions_total == 1
+
+    def test_cold_start_capacity_failure_fails_closed(self):
+        # No cached reading yet + a broken source: admit NOTHING (and
+        # evict nothing) until the first good read — never unbounded.
+        clk = Clock()
+        state = {"fail": True}
+
+        def capacity():
+            if state["fail"]:
+                raise RuntimeError("cold-start outage")
+            return 16
+
+        sched = SlicePoolScheduler(
+            capacity_fn=capacity, clock=clk, aging_s=600.0,
+            drain_grace_s=60.0, enabled=True, signal_cache_ttl_s=0.0)
+        v = sched.decide("Notebook", "a", "one", 16, {})
+        assert not v.admitted and v.phase == "Queued"
+        assert sched.metrics.preemptions_total == 0
+        state["fail"] = False
+        clk.advance(30)
+        assert sched.decide("Notebook", "a", "one", 16, {}).admitted
+
+    def test_quota_blip_serves_last_known_budget(self):
+        # A transient quota read failure must not read as "no quota"
+        # and admit a namespace past its budget (over-admission is
+        # sticky — admitted workloads are never quota-rechecked).
+        clk = Clock()
+        state = {"fail": False}
+
+        def quota(ns):
+            if state["fail"]:
+                raise RuntimeError("apiserver blip")
+            return 8
+
+        sched = SlicePoolScheduler(
+            capacity_fn=lambda: 32, quota_fn=quota, clock=clk,
+            aging_s=600.0, drain_grace_s=60.0, enabled=True,
+            signal_cache_ttl_s=0.0)
+        assert sched.decide("Notebook", "b", "one", 8, {}).admitted
+        assert not sched.decide("Notebook", "b", "two", 8,
+                                {}).admitted
+        state["fail"] = True
+        clk.advance(30)
+        v = sched.decide("Notebook", "b", "two", 8, {})
+        assert not v.admitted
+        assert "quota" in v.reason
+
+    def test_capacity_blip_serves_last_known_reading(self):
+        # A transient capacity_fn failure must NOT read as unbounded
+        # (one blip would admit the whole queue with no rollback).
+        clk = Clock()
+        state = {"fail": False}
+
+        def capacity():
+            if state["fail"]:
+                raise RuntimeError("apiserver blip")
+            return 16
+
+        sched = SlicePoolScheduler(
+            capacity_fn=capacity, clock=clk, aging_s=600.0,
+            drain_grace_s=60.0, enabled=True, signal_cache_ttl_s=0.0)
+        assert sched.decide("Notebook", "a", "one", 16, {}).admitted
+        state["fail"] = True
+        clk.advance(30)
+        v = sched.decide("Notebook", "a", "two", 16, {})
+        assert not v.admitted and v.phase == "Queued"
+
+    def test_drain_deadline_fallback(self):
+        # No checkpoint ack ever arrives: the grace deadline completes
+        # the drain so a wedged data plane cannot hold the pool.
+        clk = Clock()
+        sched, _ = make_scheduler(16, clock=clk, drain_grace_s=60.0)
+        assert sched.decide("Notebook", "a", "low", 16, {}).admitted
+        sched.decide("Notebook", "b", "high", 16, {PRIORITY_KEY: "9"})
+        sched.decide("Notebook", "a", "low", 16, {})  # drain stamped
+        clk.advance(61)
+        sched.tick()
+        v = sched.decide("Notebook", "a", "low", 16, {})
+        assert v.phase == "Queued"
+        assert sched.decide("Notebook", "b", "high", 16,
+                            {PRIORITY_KEY: "9"}).admitted
+
+
+class TestStarvationFreedom:
+    def test_aged_low_priority_outranks_newcomers(self):
+        # FIFO+priority with aging: the old low-priority entry's
+        # effective priority grows past a newcomer's static priority,
+        # so it sits at the queue head when capacity frees.
+        clk = Clock()
+        sched, box = make_scheduler(16, clock=clk, aging_s=60.0)
+        assert sched.decide("Notebook", "a", "holder", 16,
+                            {}).admitted
+        sched.decide("Notebook", "a", "old-low", 16, {})
+        clk.advance(300)  # old-low ages +5
+        sched.decide("Notebook", "b", "young-mid", 16,
+                     {PRIORITY_KEY: "3"})
+        doc = sched.to_dict()
+        assert [row["workload"] for row in doc["queue"]] == [
+            "Notebook/a/old-low", "Notebook/b/young-mid",
+        ]
+        sched.release("Notebook", "a", "holder")
+        clk.advance(1)
+        assert sched.decide("Notebook", "a", "old-low", 16,
+                            {}).admitted
+        assert not sched.decide("Notebook", "b", "young-mid", 16,
+                                {}).admitted
+
+    def test_aging_orders_but_never_arms_eviction(self):
+        # Aging is a queue-ORDER lever only: however long an equal- or
+        # lower-base-priority entry waits, it never evicts a resident
+        # (no checkpoint ping-pong) — it takes the next chips to free.
+        clk = Clock()
+        sched, _ = make_scheduler(16, clock=clk, aging_s=60.0,
+                                  drain_grace_s=10.0)
+        assert sched.decide("Notebook", "b", "vip", 16,
+                            {PRIORITY_KEY: "5"}).admitted
+        sched.decide("Notebook", "a", "patient", 16, {})
+        clk.advance(50 * 60.0)  # effective priority far above 5
+        sched.decide("Notebook", "a", "patient", 16, {})
+        assert sched.metrics.preemptions_total == 0
+        sched.release("Notebook", "b", "vip")  # capacity frees
+        clk.advance(1)
+        assert sched.decide("Notebook", "a", "patient", 16,
+                            {}).admitted
+
+    def test_equal_priority_never_ping_pongs(self):
+        # Two base-0 workloads contending for one slot: the queued one
+        # ages but never preempts the resident — the pathological
+        # alternating drain/restart loop is impossible by construction.
+        clk = Clock()
+        sched, _ = make_scheduler(16, clock=clk, aging_s=60.0,
+                                  drain_grace_s=10.0)
+        assert sched.decide("Notebook", "a", "A", 16, {}).admitted
+        sched.decide("Notebook", "a", "B", 16, {})
+        for _ in range(20):  # 20 aging periods
+            clk.advance(60.0)
+            sched.decide("Notebook", "a", "B", 16, {})
+            sched.decide("Notebook", "a", "A", 16, {})
+        assert sched.metrics.preemptions_total == 0
+        doc = sched.to_dict()
+        assert doc["workloads"]["Notebook/a/A"]["state"] == "admitted"
+
+
+class TestDisabledScheduler:
+    def test_env_switch_makes_decide_inert(self, monkeypatch):
+        monkeypatch.setenv("KFT_SCHEDULER", "0")
+        sched = SlicePoolScheduler(capacity_fn=lambda: 0)
+        assert not sched.enabled
+        v = sched.decide("Notebook", "a", "nb", 16, {})
+        assert v.admitted and v.phase is None and v.annotations == {}
+        assert sched.pool_snapshot()["admitted"] == 0  # zero state
+        assert not sched.mark_reclaimable("Notebook", "a", "nb")
+        assert not sched.touch("Notebook", "a", "nb")
+
+    def test_disabled_reconcile_is_byte_identical(self):
+        # The reconciler with a disabled scheduler produces exactly
+        # the world a scheduler-less reconciler produces.
+        def scrub(obj):
+            # The fake apiserver mints a random uid per create; it is
+            # identity, not behaviour.
+            out = copy.deepcopy(obj)
+            out["metadata"].pop("uid", None)
+            out["metadata"].pop("creationTimestamp", None)
+            for ref in out["metadata"].get("ownerReferences") or []:
+                ref.pop("uid", None)
+            return out
+
+        def run(scheduler):
+            api = FakeApiServer()
+            api.create(_tpu_notebook("team", "nb", "4x4"))
+            rec = NotebookReconciler(api, clock=lambda: 1000.0,
+                                     scheduler=scheduler)
+            rec.reconcile(Request("team", "nb"))
+            return (
+                scrub(api.get(NOTEBOOK_API, "Notebook", "nb", "team")),
+                scrub(api.get("apps/v1", "StatefulSet", "nb", "team")),
+            )
+
+        disabled = SlicePoolScheduler(capacity_fn=lambda: 0,
+                                      enabled=False)
+        nb_none, sts_none = run(None)
+        nb_off, sts_off = run(disabled)
+        assert nb_none == nb_off
+        assert sts_none == sts_off
+        assert sts_off["spec"]["replicas"] == 4
+
+
+def _tpu_notebook(ns, name, topology, annotations=None):
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": dict(annotations or {})},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": topology},
+            "template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter-jax-tpu"},
+            ]}},
+        },
+    }
+
+
+class TestReconcilerIntegration:
+    def _world(self, capacity, annotations=None):
+        clk = Clock(1000.0)
+        api = FakeApiServer()
+        api.create(_tpu_notebook("team", "nb", "4x4",
+                                 annotations=annotations))
+        sched, box = make_scheduler(capacity, clock=clk)
+        rec = NotebookReconciler(api, clock=clk, scheduler=sched)
+        return api, sched, box, rec, clk
+
+    def test_queued_notebook_holds_zero_replicas(self):
+        api, sched, box, rec, clk = self._world(0)
+        rec.reconcile(Request("team", "nb"))
+        sts = api.get("apps/v1", "StatefulSet", "nb", "team")
+        assert sts["spec"]["replicas"] == 0
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "team")
+        assert nb["status"]["phase"] == "Queued"
+        assert nb["status"]["queuePosition"] == 1
+        assert "gang needs 16" in nb["status"]["schedulingReason"]
+        events = api.list("v1", "Event", namespace="team")
+        assert any(e["reason"] == "SliceQueued" for e in events)
+
+    def test_admission_restores_replicas_and_clears_status(self):
+        api, sched, box, rec, clk = self._world(0)
+        rec.reconcile(Request("team", "nb"))
+        box[0] = 16
+        clk.advance(120)
+        rec.reconcile(Request("team", "nb"))
+        sts = api.get("apps/v1", "StatefulSet", "nb", "team")
+        assert sts["spec"]["replicas"] == 4
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "team")
+        status = nb.get("status") or {}
+        assert status.get("phase") != "Queued"
+        assert "schedulingReason" not in status
+        assert "queuePosition" not in status
+
+    def test_suspend_and_first_touch_resurrect(self):
+        api, sched, box, rec, clk = self._world(
+            16, annotations={CHECKPOINT_STEP_KEY: "7"})
+        rec.reconcile(Request("team", "nb"))
+        assert sched.mark_reclaimable("Notebook", "team", "nb",
+                                      now=clk())
+        rec.reconcile(Request("team", "nb"))
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "team")
+        assert nb["status"]["phase"] == "Preempting"
+        assert PREEMPT_REQUESTED_KEY in nb["metadata"]["annotations"]
+        clk.advance(61)  # past the drain grace: suspended
+        rec.reconcile(Request("team", "nb"))
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "team")
+        sts = api.get("apps/v1", "StatefulSet", "nb", "team")
+        assert nb["status"]["phase"] == "Suspended"
+        assert nb["metadata"]["annotations"][SUSPEND_STEP_KEY] == "7"
+        assert sts["spec"]["replicas"] == 0
+        # First touch: re-enqueue, admit, resume handshake stamped.
+        clk.advance(600)
+        assert sched.touch("Notebook", "team", "nb", now=clk())
+        rec.reconcile(Request("team", "nb"))
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "team")
+        sts = api.get("apps/v1", "StatefulSet", "nb", "team")
+        assert sts["spec"]["replicas"] == 4
+        assert nb["metadata"]["annotations"][RESUME_EXPECTED_KEY] == "7"
+        assert (nb.get("status") or {}).get("phase") != "Suspended"
+        events = api.list("v1", "Event", namespace="team")
+        assert any(e["reason"] == "SliceResumed" for e in events)
+        assert sched.metrics.reclaims_total == 1
+        assert sched.metrics.resurrects_total == 1
+
+
+class TestRestartAdoption:
+    def test_running_gang_is_grandfathered_admitted(self):
+        # Manager restart: scheduler state is gone, but a gang whose
+        # StatefulSet already holds replicas must be adopted ADMITTED,
+        # never re-queued (that would scale a live slice to zero with
+        # no checkpoint drain).
+        clk = Clock()
+        sched, _ = make_scheduler(16, clock=clk)
+        v = sched.decide("Notebook", "a", "survivor", 16, {},
+                         observed_running=True)
+        assert v.admitted and v.phase is None
+        assert sched.pool_snapshot()["used_chips"] == 16
+        # The adopted gang holds its chips against later arrivals.
+        assert not sched.decide("Notebook", "a", "newcomer", 16,
+                                {}).admitted
+
+    def test_adoption_survives_cold_start_capacity_failure(self):
+        # Fail-closed capacity (cold start, broken source) pauses NEW
+        # admissions but must never evict adopted running slices.
+        clk = Clock()
+
+        def capacity():
+            raise RuntimeError("startup outage")
+
+        sched = SlicePoolScheduler(
+            capacity_fn=capacity, clock=clk, aging_s=600.0,
+            drain_grace_s=60.0, enabled=True, signal_cache_ttl_s=0.0)
+        v = sched.decide("Notebook", "a", "survivor", 16, {},
+                         observed_running=True)
+        assert v.admitted
+        assert not sched.decide("Notebook", "a", "fresh", 16,
+                                {}).admitted
+
+    def test_reconciler_passes_the_adoption_signal(self):
+        # End to end: reconcile once (admitted, STS up), then rebuild
+        # the scheduler as a restarted manager would — the first
+        # reconcile against the fresh scheduler keeps the replicas.
+        clk = Clock(1000.0)
+        api = FakeApiServer()
+        api.create(_tpu_notebook("team", "nb", "4x4"))
+        sched1, _ = make_scheduler(16, clock=clk)
+        NotebookReconciler(api, clock=clk, scheduler=sched1).reconcile(
+            Request("team", "nb"))
+        assert api.get("apps/v1", "StatefulSet", "nb",
+                       "team")["spec"]["replicas"] == 4
+        sched2, _ = make_scheduler(16, clock=clk)  # fresh state
+        NotebookReconciler(api, clock=clk, scheduler=sched2).reconcile(
+            Request("team", "nb"))
+        assert api.get("apps/v1", "StatefulSet", "nb",
+                       "team")["spec"]["replicas"] == 4
+        assert sched2.pool_snapshot()["used_chips"] == 16
+
+
+class TestResumeHandshake:
+    def _suspended(self, clk, annotations=None):
+        sched, box = make_scheduler(16, clock=clk)
+        assert sched.decide("Notebook", "a", "nb", 16,
+                            annotations or {}).admitted
+        sched.mark_reclaimable("Notebook", "a", "nb", now=clk())
+        sched.decide("Notebook", "a", "nb", 16, annotations or {})
+        clk.advance(61)
+        sched.tick()
+        return sched
+
+    def test_resume_from_redelivered_until_acked(self):
+        # A reconcile that crashes between decide() and its annotation
+        # patch must be able to retry the handshake level-based.
+        clk = Clock()
+        anns = {CHECKPOINT_STEP_KEY: "9"}
+        sched = self._suspended(clk, anns)
+        sched.touch("Notebook", "a", "nb", now=clk.advance(10))
+        v1 = sched.decide("Notebook", "a", "nb", 16, anns)
+        v2 = sched.decide("Notebook", "a", "nb", 16, anns)
+        assert v1.resume_from == "9" and v2.resume_from == "9"
+        sched.ack_resume("Notebook", "a", "nb")
+        v3 = sched.decide("Notebook", "a", "nb", 16, anns)
+        assert v3.resume_from is None
+
+    def test_unknown_checkpoint_never_delivers_empty_resume(self):
+        # An annotation-less CR drains on the deadline: suspend_step
+        # must read None, never "" (which would stamp a non-numeric
+        # resume-expected annotation downstream).
+        clk = Clock()
+        sched = self._suspended(clk, annotations={})
+        v = sched.decide("Notebook", "a", "nb", 16, {})
+        assert v.phase == "Suspended"
+        assert SUSPEND_STEP_KEY not in v.annotations
+        sched.touch("Notebook", "a", "nb", now=clk.advance(10))
+        assert sched.decide("Notebook", "a", "nb", 16,
+                            {}).resume_from is None
+
+    def test_touch_reports_leaving_suspended_even_when_queued(self):
+        # A full pool at touch time: the workload leaves SUSPENDED
+        # (queued, charged) and touch says so — a caller retrying on
+        # False would otherwise misread a working resurrect.
+        clk = Clock()
+        sched = self._suspended(clk)
+        assert sched.decide("Notebook", "a", "other", 16,
+                            {}).admitted  # pool refilled by a rival
+        assert sched.touch("Notebook", "a", "nb", now=clk.advance(10))
+        v = sched.decide("Notebook", "a", "nb", 16, {})
+        assert v.phase == "Queued"
+
+    def test_tracks_reflects_registration(self):
+        sched, _ = make_scheduler(16)
+        assert not sched.tracks("Notebook", "a", "nb")
+        sched.decide("Notebook", "a", "nb", 16, {})
+        assert sched.tracks("Notebook", "a", "nb")
+        sched.release("Notebook", "a", "nb")
+        assert not sched.tracks("Notebook", "a", "nb")
+
+
+class TestObservability:
+    def test_pool_snapshot_and_debug_doc(self):
+        clk = Clock()
+        sched, _ = make_scheduler(24, clock=clk)
+        sched.decide("Notebook", "a", "one", 16, {})
+        sched.decide("Notebook", "a", "two", 16, {})
+        pool = sched.pool_snapshot()
+        assert pool["capacity_chips"] == 24
+        assert pool["used_chips"] == 16
+        assert pool["free_chips"] == 8
+        assert pool["queued"] == 1 and pool["queued_chips"] == 16
+        doc = sched.to_dict()
+        assert doc["enabled"] is True
+        assert doc["queue"][0]["workload"] == "Notebook/a/two"
+        assert doc["workloads"]["Notebook/a/one"]["state"] == "admitted"
+        assert doc["counters"]["admissions_total"] == 1
+        assert doc["admission_wait"]["count"] == 1
+
+    def test_collector_renders_the_families(self):
+        sched, _ = make_scheduler(16)
+        sched.decide("Notebook", "a", "one", 16, {})
+        sched.decide("Notebook", "a", "two", 16, {})
+        families = {f.name: f for f in SchedulerCollector(sched)
+                    .collect()}
+        assert families["scheduler_queue_depth"].samples[0].value == 1
+        chips = {s.labels["result"]: s.value
+                 for s in families["scheduler_pool_chips"].samples}
+        assert chips["capacity"] == 16
+        assert chips["used"] == 16
+        assert chips["queued"] == 16
+        assert "scheduler_preemptions" in families
+        assert "scheduler_admission_wait_seconds" in families
+
+    def test_queue_wait_objective_counts_slow_admissions(self):
+        clk = Clock()
+        sched, box = make_scheduler(0, clock=clk)
+        sched.decide("Notebook", "a", "nb", 16, {})
+        box[0] = 16
+        clk.advance(500)  # beyond the 300s default threshold
+        sched.decide("Notebook", "a", "nb", 16, {})
+        objective = scheduler_queue_wait_objective(sched)
+        good, total = objective.source()
+        assert total == 1.0 and good == 0.0
+        assert objective.name == "scheduler-queue-wait"
+
+    def test_fleet_cards_surface_queued_suspended_and_pool(self):
+        from kubeflow_tpu.obs import fleet as obs_fleet
+
+        api = FakeApiServer()
+        nb = _tpu_notebook("team", "q-nb", "4x4")
+        nb["status"] = {"phase": "Queued"}
+        api.create(nb)
+        nb2 = _tpu_notebook("team", "s-nb", "2x2")
+        nb2["status"] = {"phase": "Suspended"}
+        api.create(nb2)
+        sched, _ = make_scheduler(16)
+        doc = obs_fleet.fleet_cards(api, scheduler=sched)
+        card = doc["namespaces"]["team"]
+        assert card["queued"] == 1
+        assert card["suspended"] == 1
+        assert card["health"] == "ok"  # scheduler states ≠ NotReady
+        assert doc["pool"]["capacity_chips"] == 16
+
+    def test_dashboard_collector_grows_the_gauges(self):
+        from kubeflow_tpu.dashboard.metrics import TpuFleetCollector
+
+        api = FakeApiServer()
+        nb = _tpu_notebook("team", "q-nb", "4x4")
+        nb["status"] = {"phase": "Queued"}
+        api.create(nb)
+        sched, _ = make_scheduler(16)
+        names = {f.name for f in TpuFleetCollector(
+            api, scheduler=sched).collect()}
+        assert {"tpu_fleet_queued", "tpu_fleet_suspended",
+                "tpu_fleet_pool_chips"} <= names
+
+
+class TestDemotionArm:
+    def _running_pods(self, name, count):
+        return [{
+            "metadata": {"name": f"{name}-{i}", "uid": f"u{i}"},
+            "status": {"phase": "Running"},
+        } for i in range(count)]
+
+    def _elastic_notebook(self):
+        return _tpu_notebook("team", "mesh", "4x4", annotations={
+            ELASTIC_LADDER_KEY: "auto",
+            ELASTIC_GRACE_KEY: "60",
+        })
+
+    def test_gate_advises_demotion_below_current_need(self):
+        box = [8]
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: box[0],
+            guard=ActuationGuard(min_interval_s=0.0))
+        gate.on_tick(0.0)
+        current = TpuSlice.from_shorthand("v5e-16")
+        assert gate.should_demote(current)
+        assert gate.demotions == 1
+        box[0] = 16
+        gate.on_tick(1.0)
+        assert not gate.should_demote(current)
+
+    def test_decide_steps_down_ahead_of_the_preemption(self):
+        box = [8]
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: box[0],
+            guard=ActuationGuard(min_interval_s=0.0))
+        gate.on_tick(0.0)
+        nb = self._elastic_notebook()
+        decision = elastic.decide(nb, self._running_pods("mesh", 4),
+                                  now=0.0, promotion_gate=gate)
+        assert decision.effective.shorthand == "v5e-8"
+        assert decision.patches[ELASTIC_SHAPE_KEY] == "v5e-8"
+        assert "proactive step-down" in decision.reshard_reason
+        assert any(reason == "SliceDegraded"
+                   for reason, _msg, _t in decision.events)
+        assert not decision.at_spec_shape
+
+    def test_shared_pool_shortage_advises_demotion(self):
+        # Two 16-chip tenants in a pool that shrank 48 -> 24: each
+        # shape still fits ALONE, but the pool is oversubscribed — a
+        # preemption is imminent for someone, so the gate (wired to
+        # the scheduler's used-chips view) advises the planned
+        # step-down.
+        cap = [48]
+        used = [32]
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: cap[0],
+            pool_used_fn=lambda: used[0],
+            guard=ActuationGuard(min_interval_s=0.0))
+        gate.on_tick(0.0)
+        current = TpuSlice.from_shorthand("v5e-16")
+        assert not gate.should_demote(current)
+        cap[0] = 24
+        gate.on_tick(1.0)
+        assert gate.should_demote(current)
+        used[0] = 16  # the other tenant left: no more shortage
+        assert not gate.should_demote(current)
+
+    def test_ample_capacity_holds_the_shape(self):
+        box = [16]
+        gate = ElasticPromotionGate(
+            capacity_fn=lambda: box[0],
+            guard=ActuationGuard(min_interval_s=0.0))
+        gate.on_tick(0.0)
+        nb = self._elastic_notebook()
+        decision = elastic.decide(nb, self._running_pods("mesh", 4),
+                                  now=0.0, promotion_gate=gate)
+        assert decision.effective.shorthand == "v5e-16"
+        assert decision.reshard_reason is None
+
+    def test_broken_gate_never_reshapes(self):
+        class Broken:
+            def should_demote(self, current):
+                raise RuntimeError("pool view down")
+
+        nb = self._elastic_notebook()
+        decision = elastic.decide(nb, self._running_pods("mesh", 4),
+                                  now=0.0, promotion_gate=Broken())
+        assert decision.effective.shorthand == "v5e-16"
+        assert decision.reshard_reason is None
+
+
+class TestContentionScenario:
+    """The seeded two-tenant acceptance arc (fast parameters here; the
+    CI gate's RUN_SLOW tier runs the full-size scenario via the CLI)."""
+
+    @pytest.fixture(scope="class")
+    def summary(self):
+        from loadtest.contention import run_contention
+
+        return run_contention(seed=3, ticks=96)
+
+    def test_acceptance_checklist_holds(self, summary):
+        from loadtest.contention import problems_in
+
+        assert problems_in(summary) == []
+
+    def test_preemption_bounds_lost_work(self, summary):
+        pre = summary["preemption"]
+        assert pre["victim_preempted"]
+        assert pre["steps_lost"] <= pre["cadence"]
+        assert pre["bit_identical"]
+
+    def test_queue_and_suspend_time_land_in_goodput(self, summary):
+        meters = summary["goodput"]
+        assert any("queued" in m["downtime_s"] for m in meters.values())
+        assert any("suspended" in m["downtime_s"]
+                   for m in meters.values())
+
+    def test_replay_digest_is_byte_identical(self, summary):
+        from loadtest.contention import run_contention
+
+        replay = run_contention(seed=3, ticks=96)
+        assert replay["replay_digest"] == summary["replay_digest"]
+        # Different seed/params = a different history: the digest is
+        # not a constant.
+        other = run_contention(seed=4, ticks=96)
+        assert other["replay_digest"] != summary["replay_digest"]
+
+
+class TestManagerWiring:
+    def test_manager_registers_collector_and_objective(self):
+        from kubeflow_tpu.controllers.manager import Manager
+        from kubeflow_tpu.controllers.metrics import ControllerMetrics
+        from kubeflow_tpu.controllers.notebook import (
+            make_notebook_controller,
+        )
+
+        api = FakeApiServer()
+        prom = ControllerMetrics(api)
+        sched, _ = make_scheduler(16)
+        ctrl = make_notebook_controller(api, prom=prom,
+                                        scheduler=sched)
+        manager = Manager(api, [ctrl], prom=prom, http_port=None,
+                          scheduler=sched)
+        names = {obj.name for obj in manager.slo.evaluator.objectives()}
+        assert "scheduler-queue-wait" in names
+        exposition = prom.exposition().decode()
+        assert "scheduler_queue_depth" in exposition
+        assert sched.tick in ctrl.tick_hooks
+
+    def test_disabled_scheduler_is_ignored_by_the_manager(self):
+        from kubeflow_tpu.controllers.manager import Manager
+        from kubeflow_tpu.controllers.metrics import ControllerMetrics
+        from kubeflow_tpu.controllers.notebook import (
+            make_notebook_controller,
+        )
+
+        api = FakeApiServer()
+        prom = ControllerMetrics(api)
+        disabled = SlicePoolScheduler(capacity_fn=lambda: 16,
+                                      enabled=False)
+        ctrl = make_notebook_controller(api, prom=prom)
+        manager = Manager(api, [ctrl], prom=prom, http_port=None,
+                          scheduler=disabled)
+        assert manager.scheduler is None
+        names = {obj.name for obj in manager.slo.evaluator.objectives()}
+        assert "scheduler-queue-wait" not in names
+        assert "scheduler_queue_depth" not in \
+            prom.exposition().decode()

@@ -764,7 +764,8 @@ class TestEndpoints:
         card = doc["namespaces"]["team"]
         assert set(card) == {
             "notebooks", "inferenceservices", "preemption_restarts",
-            "reshards", "goodput_ratio", "alerts", "health",
+            "reshards", "queued", "suspended", "goodput_ratio",
+            "alerts", "health",
         }
         assert set(doc["slo"]) == {"objectives", "alerts"}
         assert set(doc["slo"]["objectives"]) == {
