@@ -90,6 +90,19 @@
   autopilot amplifying the incident it was built to absorb. Bounded
   authority is the contract (autopilot/core.py); deliberate
   exceptions escape with ``# analysis: allow[py-unbounded-actuation]``.
+- ``py-list-in-reconcile`` (warning): a LIST-shaped client call — a
+  ``.list(...)`` / ``.list_*(...)`` on an api/client handle — inside a
+  reconcile-path function (``reconcile`` / ``*_reconcile``) of a class
+  that holds an informer/cache identifier (an ``__init__`` attribute
+  or parameter mentioning ``cache``/``informer``). A per-reconcile
+  LIST re-reads every object of the kind on the hottest control-plane
+  path: at fleet cardinality that is O(cluster) per reconcile and the
+  10k-CR soak's first casualty. The class already carries the fix —
+  read through the informer's indexes
+  (``controllers/runtime.py InformerCache``); reads off the reconcile
+  path (helpers, resync) and point ``get``\\ s are not flagged, and a
+  deliberate strong read escapes with
+  ``# analysis: allow[py-list-in-reconcile]``.
 - ``py-unbounded-queue-admission`` (warning): an admission/scheduling
   loop — a function whose name mentions admit/admission/schedul with a
   loop that removes work from a queue-ish collection (an identifier
@@ -246,6 +259,71 @@ def _check_reconcile_body(
                 node.lineno,
                 f"direct HTTP call ({target}) in {fn.name!r}: move network "
                 "probes behind an injected callable with a timeout",
+            ))
+
+
+# --- py-list-in-reconcile --------------------------------------------------
+# Identifier fragments that mark a class as informer-equipped, and the
+# receiver fragments that mark a call target as an apiserver handle.
+_CACHE_TOKENS = ("cache", "informer")
+_API_RECEIVER_TOKENS = ("api", "client", "k8s")
+
+
+def _class_cache_idents(cls: ast.ClassDef) -> list[str]:
+    """Informer/cache identifiers in scope of the class: ``self.X``
+    attributes assigned in ``__init__`` plus ``__init__`` parameters
+    whose name mentions cache/informer."""
+    idents: list[str] = []
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name != "__init__":
+            continue
+        for arg in node.args.args + node.args.kwonlyargs:
+            if any(t in arg.arg.lower() for t in _CACHE_TOKENS):
+                idents.append(arg.arg)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for target in sub.targets:
+                    name = _self_attr_name(target)
+                    if name and any(t in name.lower()
+                                    for t in _CACHE_TOKENS):
+                        idents.append(f"self.{name}")
+    return idents
+
+
+def _check_list_in_reconcile(
+    cls: ast.ClassDef, path: str, out: list[Finding]
+) -> None:
+    cache_idents = _class_cache_idents(cls)
+    if not cache_idents:
+        return  # no informer in scope: a LIST is this class's only read
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (fn.name == "reconcile" or fn.name.endswith("_reconcile")):
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if not (attr == "list" or attr.startswith("list_")):
+                continue
+            receiver = _expr_text(node.func.value)
+            if any(t in receiver for t in _CACHE_TOKENS):
+                continue  # reading the informer IS the fix
+            if not any(t in receiver for t in _API_RECEIVER_TOKENS):
+                continue  # not an apiserver handle (list.append etc.)
+            out.append(Finding(
+                "py-list-in-reconcile", Severity.WARNING, path,
+                node.lineno,
+                f"LIST ({attr}) inside reconcile-path {fn.name!r} while "
+                f"{cache_idents[0]!r} is in scope: a per-reconcile LIST "
+                "re-reads every object of the kind on the hottest "
+                "control-plane path — read the informer's indexes "
+                "instead, or annotate a deliberate strong read with "
+                "# analysis: allow[py-list-in-reconcile]",
             ))
 
 
@@ -1071,6 +1149,7 @@ def analyze_python_source(source: str, path: str) -> list[Finding]:
             _check_nonatomic_writes(node, aliases, path, out)
         elif isinstance(node, ast.ClassDef):
             _check_unbounded_deques(node, aliases, path, out)
+            _check_list_in_reconcile(node, path, out)
         elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
             _check_retry_loop(node, aliases, path, out)
         elif isinstance(node, ast.Call):

@@ -53,11 +53,19 @@ def run_to_convergence(
     settle_rounds: int = 2,
     resync_every: int = 5,
     sleep=time.sleep,
+    run_once_iterations: int = 100,
 ) -> int:
     """Drive controllers (+ pod simulators) until the world is provably
     settled for ``settle_rounds`` consecutive rounds. Returns the number
     of rounds taken — callers assert it against their bound, making
-    reconcile cost under chaos a regression-checked number."""
+    reconcile cost under chaos a regression-checked number.
+
+    ``run_once_iterations`` is each round's per-controller reconcile
+    budget. At fleet cardinality it must exceed the primary-object
+    count: every resync re-enqueues the whole keyspace, and a budget
+    below it can never drain the queue the resync just refilled — the
+    loop would burn ``max_rounds`` without ever reaching a quiet
+    round (the 10k-CR soak's finding)."""
     quiet = 0
     rounds = 0
     while quiet < settle_rounds:
@@ -79,7 +87,7 @@ def run_to_convergence(
             for ctrl in controllers:
                 resync_ok = (ctrl.resync() is not None) and resync_ok
         for ctrl in controllers:
-            ctrl.run_once()
+            ctrl.run_once(max_iterations=run_once_iterations)
         parked = [
             d for d in (c.queue.next_deadline() for c in controllers)
             if d is not None

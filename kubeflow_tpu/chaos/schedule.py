@@ -169,6 +169,13 @@ class FaultSchedule:
         self._watch_budget[COMPACT] = max_compactions
         return self
 
+    def clear_watch_faults(self) -> "FaultSchedule":
+        """Disarm the watch-damage plane (the soak's storm-then-repair
+        arc: damage the streams, then prove informer recovery against
+        clean delivery). API-call windows are untouched."""
+        self._watch_rates.clear()
+        return self
+
     def capacity(self, at_s: float, chips: int | None,
                  jitter_s: float = 0.0) -> "FaultSchedule":
         """Add a capacity event: at ``at_s`` (± a uniform draw within

@@ -169,6 +169,7 @@ class CullingReconciler:
         clock: Callable[[], float] = time.time,
         prom=None,  # optional ControllerMetrics (metrics.py)
         scheduler=None,  # scheduler.SlicePoolScheduler (or None)
+        cache=None,  # runtime.InformerCache (or None: plain gets)
     ):
         self.api = api
         self.kernel_probe = kernel_probe
@@ -177,6 +178,7 @@ class CullingReconciler:
         self.clock = clock
         self.prom = prom
         self.scheduler = scheduler
+        self.cache = cache
 
     def reconcile(self, req: Request) -> float | None:
         if not self.options.enabled:
@@ -206,9 +208,12 @@ class CullingReconciler:
             return period_sec - (now - last_check)
 
         # Pod must exist before idleness accounting starts (reference
-        # culling_controller.go:107-118).
+        # culling_controller.go:107-118). Through the informer when
+        # one is wired: the culler's periodic sweep across N notebooks
+        # is N point reads — the cache makes them store lookups.
+        pod_source = self.cache if self.cache is not None else self.api
         try:
-            self.api.get("v1", "Pod", f"{req.name}-0", req.namespace)
+            pod_source.get("v1", "Pod", f"{req.name}-0", req.namespace)
         except NotFound:
             return period_sec
 
@@ -320,6 +325,8 @@ def make_culling_controller(
     clock: Callable[[], float] = time.time,
     prom=None,
     scheduler=None,
+    cache=None,
+    shard_gate=None,
 ) -> Controller:
     reconciler = CullingReconciler(
         api,
@@ -329,6 +336,7 @@ def make_culling_controller(
         clock,
         prom=prom,
         scheduler=scheduler,
+        cache=cache,
     )
     return Controller(
         name="culling-controller",
@@ -337,4 +345,6 @@ def make_culling_controller(
         watches=[WatchSpec(NOTEBOOK_API, "Notebook")],
         resync_period=60.0,
         prom=prom,
+        shard_gate=shard_gate,
+        cache=cache,
     )

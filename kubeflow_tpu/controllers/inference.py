@@ -248,11 +248,23 @@ def pod_to_inference_requests(obj: dict) -> list[Request]:
 
 class InferenceReconciler:
     def __init__(self, api: FakeApiServer, prom=None, scheduler=None,
-                 clock=time.time):
+                 clock=time.time, cache=None, status_writer=None):
         self.api = api
         self.prom = prom
         self.scheduler = scheduler
         self.clock = clock
+        self.cache = cache
+        self.status_writer = status_writer
+
+    def _list_pods(self, req: Request) -> list:
+        """The slice's pods via the informer's namespace index when a
+        cache is wired (the notebook reconciler's discipline), else
+        the plain LIST."""
+        source = self.cache if self.cache is not None else self.api
+        return source.list(
+            "v1", "Pod", namespace=req.namespace,
+            label_selector=f"inferenceservice-name={req.name}",
+        )
 
     def reconcile(self, req: Request) -> float | None:
         try:
@@ -323,10 +335,7 @@ class InferenceReconciler:
             )
         except NotFound:
             sts = None
-        pods = self.api.list(
-            "v1", "Pod", namespace=req.namespace,
-            label_selector=f"inferenceservice-name={req.name}",
-        )
+        pods = self._list_pods(req)
         restart_reason = self._preemption_recovery(svc, req, sts, pods)
         self._update_status(svc, restart_reason, sts, pods,
                             sched_verdict=sched_verdict)
@@ -454,10 +463,16 @@ class InferenceReconciler:
             # Same rule for a healed InvalidSpec failure's message — a
             # recovered CR must not read Running + stale error text.
             patch["message"] = None
-        self.api.patch_merge(
-            INFERENCE_API, "InferenceService", name,
-            {"status": patch}, ns,
-        )
+        if self.status_writer is not None:
+            self.status_writer.submit(
+                INFERENCE_API, "InferenceService", name,
+                {"status": patch}, ns,
+            )
+        else:
+            self.api.patch_merge(
+                INFERENCE_API, "InferenceService", name,
+                {"status": patch}, ns,
+            )
 
 
 def make_inference_controller(
@@ -465,9 +480,13 @@ def make_inference_controller(
     prom=None,
     scheduler=None,
     clock=time.time,
+    cache=None,
+    status_batcher=None,
+    shard_gate=None,
 ) -> Controller:
     reconciler = InferenceReconciler(api, prom=prom, scheduler=scheduler,
-                                     clock=clock)
+                                     clock=clock, cache=cache,
+                                     status_writer=status_batcher)
     return Controller(
         name="inference-controller",
         api=api,
@@ -479,4 +498,7 @@ def make_inference_controller(
             WatchSpec("v1", "Pod", pod_to_inference_requests),
         ],
         prom=prom,
+        shard_gate=shard_gate,
+        status_batcher=status_batcher,
+        cache=cache,
     )

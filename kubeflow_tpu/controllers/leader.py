@@ -13,10 +13,29 @@ optimistic concurrency: acquire/renew is a read-modify-update on one
 Lease object; a Conflict means another candidate won the race and the
 loser backs off. ``clock`` is injectable so expiry is testable without
 sleeping.
+
+Fleet scale adds the horizontal layer (:class:`ShardedElector`): the
+reconcile keyspace hashes into ``KFT_SHARDS`` shards
+(:func:`shard_of` over ``namespace/name``), each shard guarded by its
+own Lease. A manager replica acquires a *subset* of the shard leases —
+its fair share, ``ceil(shards / live_replicas)``, where the live
+replica count is read off the lease holders themselves — so N replicas
+split the fleet with no external membership service, and membership
+changes rebalance by the same quota rule: a replica holding more than
+its share voluntarily releases surplus shards for the newcomer.
+Handoff is disciplined through a :class:`ShardGate` (see
+``controllers/runtime.py``): a released shard stops popping, drains
+its in-flight reconcile, and only then frees the lease; the successor
+resyncs the shard before reconciling it. One shard (``KFT_SHARDS=1``)
+degenerates to exactly the single :class:`LeaderElector` above —
+lease name, election rounds and callbacks byte-identical.
 """
 
 from __future__ import annotations
 
+import hashlib
+import math
+import os
 import threading
 import time
 from typing import Callable
@@ -25,6 +44,27 @@ from kubeflow_tpu.controllers.time_utils import parse_rfc3339, rfc3339
 from kubeflow_tpu.k8s.fake import ApiError, FakeApiServer, NotFound
 
 LEASE_API = "coordination.k8s.io/v1"
+
+
+def shard_count(default: int = 1) -> int:
+    """``KFT_SHARDS``: how many per-shard leases the control plane
+    runs behind (1 / unset = the classic single-leader manager)."""
+    raw = os.environ.get("KFT_SHARDS", "")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return max(1, int(default))
+
+
+def shard_of(namespace: str, name: str, shards: int) -> int:
+    """Stable shard for a reconcile key. sha1 over ``namespace/name``
+    (NOT Python ``hash()``, which is per-process salted — every
+    replica must agree on the mapping or two leaders would both own a
+    key)."""
+    if shards <= 1:
+        return 0
+    digest = hashlib.sha1(f"{namespace}/{name}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % shards
 
 
 class LeaderElector:
@@ -189,6 +229,269 @@ class LeaderElector:
         thread = threading.Thread(
             target=self.run_forever,
             name=f"leader-elect-{self.lease_name}",
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ShardedElector:
+    """N per-shard leases, one :class:`LeaderElector` each.
+
+    Lease names are ``<lease_name>-shard-<i>``; with ``shards == 1``
+    the single lease keeps the bare ``lease_name`` so the one-shard
+    configuration is indistinguishable from the classic single-leader
+    manager on the wire. ``on_acquired(shard)`` / ``on_lost(shard)``
+    fire on ownership transitions (the manager points them at a
+    :class:`~kubeflow_tpu.controllers.runtime.ShardGate`).
+
+    Rebalance rule: each round counts the distinct *live* lease
+    holders (non-expired, by this candidate's local observation — the
+    same skew-tolerant clock discipline the single elector uses) plus
+    itself, takes ``quota = ceil(shards / replicas)``, acquires
+    free/expired shards only while below quota, and releases its
+    highest-numbered surplus shards when membership grew. Released and
+    lost shards hand off through ``gate``: new pops stop first, the
+    in-flight reconcile drains, and only then is the lease freed — so
+    a voluntary handoff can never dual-reconcile a key. (Involuntary
+    expiry of a wedged leader keeps the classic mitigation: the lease
+    duration must exceed the reconcile deadline.)
+    """
+
+    def __init__(
+        self,
+        api: FakeApiServer,
+        lease_name: str,
+        identity: str,
+        shards: int,
+        namespace: str = "kubeflow",
+        lease_duration_s: float = 15.0,
+        retry_period_s: float = 2.0,
+        clock: Callable[[], float] = time.time,
+        gate=None,
+        on_acquired: Callable[[int], None] | None = None,
+        on_lost: Callable[[int], None] | None = None,
+        drain_timeout_s: float = 10.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.api = api
+        self.identity = identity
+        self.lease_name = lease_name
+        self.shards = max(1, int(shards))
+        self.namespace = namespace
+        self.lease_duration_s = lease_duration_s
+        self.retry_period_s = retry_period_s
+        self.clock = clock
+        self.gate = gate
+        self.on_acquired = on_acquired
+        self.on_lost = on_lost
+        self.drain_timeout_s = drain_timeout_s
+        self._sleep = sleep
+        self._stop = threading.Event()
+        # One elector per shard, fixed at construction.
+        # analysis: allow[py-unbounded-deque]
+        self.electors: list[LeaderElector] = []
+        for i in range(self.shards):
+            name = (lease_name if self.shards == 1
+                    else f"{lease_name}-shard-{i}")
+            self.electors.append(LeaderElector(
+                api, name, identity,
+                namespace=namespace,
+                lease_duration_s=lease_duration_s,
+                retry_period_s=retry_period_s,
+                clock=clock,
+                on_started_leading=self._started_cb(i),
+                on_stopped_leading=self._stopped_cb(i),
+            ))
+
+    def _started_cb(self, shard: int):
+        def cb():
+            if self.gate is not None:
+                self.gate.on_acquired(shard)
+            if self.on_acquired is not None:
+                self.on_acquired(shard)
+        return cb
+
+    def _stopped_cb(self, shard: int):
+        def cb():
+            if self.gate is not None:
+                self.gate.on_lost(shard)
+            if self.on_lost is not None:
+                self.on_lost(shard)
+        return cb
+
+    def owned(self) -> frozenset[int]:
+        return frozenset(
+            i for i, e in enumerate(self.electors) if e.is_leader
+        )
+
+    @property
+    def is_leader(self) -> bool:
+        """Leads *something* — the manager readiness notion."""
+        return any(e.is_leader for e in self.electors)
+
+    # ---- membership heartbeat --------------------------------------------
+    @property
+    def _member_prefix(self) -> str:
+        return f"{self.lease_name}-member-"
+
+    def _heartbeat(self) -> None:
+        """Renew this replica's member lease. Shard leases alone can't
+        discover a standby holding NOTHING — without the heartbeat a
+        saturated incumbent would never see the newcomer and never
+        release its surplus shards. The member lease carries no
+        authority (exclusion is the shard leases' job); it only feeds
+        the fair-share quota."""
+        name = f"{self._member_prefix}{self.identity}"
+        now = rfc3339(int(self.clock()))
+        desired = {
+            "apiVersion": LEASE_API,
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration_s),
+                "renewTime": now,
+            },
+        }
+        try:
+            try:
+                cur = self.api.get(LEASE_API, "Lease", name,
+                                   self.namespace)
+                desired["metadata"]["resourceVersion"] = (
+                    cur["metadata"]["resourceVersion"]
+                )
+                self.api.update(desired)
+            except NotFound:
+                self.api.create(desired)
+        except ApiError:
+            pass  # missed heartbeat: tolerated within the expiry window
+
+    def _live_members(self) -> set[str]:
+        """Identities with a fresh member lease. Expiry is judged
+        renewTime vs our clock with a 2x duration allowance — a wrong
+        count only skews the balance quota, never shard exclusion."""
+        members: set[str] = set()
+        try:
+            leases = self.api.list(LEASE_API, "Lease",
+                                   namespace=self.namespace)
+        except ApiError:
+            return members
+        for lease in leases:
+            name = (lease.get("metadata") or {}).get("name", "")
+            if not name.startswith(self._member_prefix):
+                continue
+            spec = lease.get("spec") or {}
+            renew = parse_rfc3339(spec.get("renewTime", ""))
+            if renew is None:
+                continue
+            if self.clock() - renew <= 2 * self.lease_duration_s:
+                holder = spec.get("holderIdentity")
+                if holder:
+                    members.add(holder)
+        return members
+
+    # ---- one election round ---------------------------------------------
+    def _observe_membership(self) -> tuple[set[str], list[int]]:
+        """Read every shard lease once: the set of live holder
+        identities (self included) and the shards with no live holder
+        (free or expired — acquirable this round)."""
+        holders = {self.identity}
+        acquirable: list[int] = []
+        for i, elector in enumerate(self.electors):
+            if elector.is_leader:
+                continue
+            try:
+                lease = self.api.get(
+                    LEASE_API, "Lease", elector.lease_name, self.namespace
+                )
+            except NotFound:
+                acquirable.append(i)
+                continue
+            except ApiError:
+                continue  # unreadable this round: neither count nor take
+            elector._observe(lease)
+            holder = (lease.get("spec") or {}).get("holderIdentity")
+            if holder and not elector._expired(lease):
+                holders.add(holder)
+            else:
+                acquirable.append(i)
+        return holders, acquirable
+
+    def try_acquire_or_renew(self) -> frozenset[int]:
+        """One sharded round: heartbeat membership, renew held leases,
+        then acquire up to the fair-share quota, then release surplus
+        (membership grew). Returns the shards owned after the round."""
+        self._heartbeat()
+        for elector in self.electors:
+            if elector.is_leader:
+                elector.try_acquire_or_renew()  # renew (may step down)
+        holders, acquirable = self._observe_membership()
+        holders |= self._live_members()
+        quota = max(1, math.ceil(self.shards / max(1, len(holders))))
+        owned = sorted(i for i, e in enumerate(self.electors)
+                       if e.is_leader)
+        for i in acquirable:
+            if len(owned) >= quota:
+                break
+            if self.electors[i].try_acquire_or_renew():
+                owned.append(i)
+        # Rebalance on membership change: release highest-numbered
+        # surplus shards so the newcomer's acquirable scan finds them.
+        while len(owned) > quota:
+            self.release_shard(owned.pop())
+        return self.owned()
+
+    def release_shard(self, shard: int) -> None:
+        """Disciplined voluntary handoff of one shard: stop new pops,
+        drain the in-flight reconcile, then free the lease. Without
+        the drain, a successor could acquire and reconcile a key the
+        old owner is still mid-reconcile on."""
+        elector = self.electors[shard]
+        if not elector.is_leader:
+            return
+        if self.gate is not None:
+            self.gate.begin_drain(shard)
+            # Iteration-bounded, not wall-clock-bounded: with an
+            # injected no-op sleep (the simulated-time pattern) a
+            # wall deadline would busy-spin for real seconds; a poll
+            # budget stays bounded under any sleep implementation.
+            polls = max(1, int(self.drain_timeout_s / 0.005))
+            for _ in range(polls):
+                if self.gate.in_flight(shard) == 0:
+                    break
+                self._sleep(0.005)
+        elector.release()
+
+    def release(self) -> None:
+        for shard in sorted(self.owned()):
+            self.release_shard(shard)
+        # Clean shutdown deregisters the member heartbeat: survivors'
+        # fair-share quota grows immediately instead of waiting out
+        # the membership expiry window (a crash-stop still expires).
+        try:
+            self.api.delete(
+                LEASE_API, "Lease",
+                f"{self._member_prefix}{self.identity}",
+                self.namespace,
+            )
+        except (NotFound, ApiError):
+            pass
+
+    # ---- thread driver ----------------------------------------------------
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            self.try_acquire_or_renew()
+            self._stop.wait(self.retry_period_s)
+        self.release()
+
+    def start(self) -> threading.Thread:
+        thread = threading.Thread(
+            target=self.run_forever,
+            name=f"shard-elect-{self.identity}",
             daemon=True,
         )
         thread.start()

@@ -18,12 +18,17 @@ from kubeflow_tpu.controllers.culling import (
     CullingOptions,
     make_culling_controller,
 )
-from kubeflow_tpu.controllers.leader import LeaderElector
+from kubeflow_tpu.controllers.leader import (
+    LeaderElector,
+    ShardedElector,
+    shard_count,
+)
 from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
 from kubeflow_tpu.controllers.notebook import (
     NotebookOptions,
     make_notebook_controller,
 )
+from kubeflow_tpu.controllers.runtime import InformerCache, ShardGate
 from kubeflow_tpu.k8s.fake import FakeApiServer
 from kubeflow_tpu.obs.envknob import env_bool as _env_bool
 
@@ -104,10 +109,20 @@ class Manager:
         recorder=None,
         autopilot=None,
         scheduler=None,
+        shards: int | None = None,
     ):
         self.api = api
         self.controllers = controllers
         self.prom = prom
+        # Horizontal sharding (KFT_SHARDS): with more than one shard
+        # and leader election on, this replica runs a ShardedElector
+        # over per-shard leases and every controller pops only the
+        # keys of shards it owns (ShardGate). One shard keeps the
+        # classic single-leader manager byte-identical — same lease
+        # name, same start/stop-on-transition controller lifecycle.
+        self.shards = (shard_count() if shards is None
+                       else max(1, int(shards)))
+        self.shard_gate = None
         # Slice-pool scheduler (PR 12): a disabled one (KFT_SCHEDULER=0)
         # is treated exactly like none at all — no collector, no SLO
         # objective, no debug surface, no tick hook; behaviour stays
@@ -211,20 +226,36 @@ class Manager:
             kwargs = {}
             if clock is not None:
                 kwargs["clock"] = clock
-            self.elector = LeaderElector(
-                api,
-                lease_name,
-                # Downward-API convention: with POD_NAME injected (the
-                # controller deployments do), the lease holder is the
-                # pod name — legible in kubectl. Applies to EVERY
-                # manager, not just the notebook controller.
-                identity or os.environ.get("POD_NAME")
-                or f"manager-{uuid.uuid4().hex[:8]}",
-                namespace=lease_namespace,
-                on_started_leading=self._start_controllers,
-                on_stopped_leading=self._stop_controllers,
-                **kwargs,
-            )
+            # Downward-API convention: with POD_NAME injected (the
+            # controller deployments do), the lease holder is the
+            # pod name — legible in kubectl. Applies to EVERY
+            # manager, not just the notebook controller.
+            me = (identity or os.environ.get("POD_NAME")
+                  or f"manager-{uuid.uuid4().hex[:8]}")
+            if self.shards > 1:
+                # Sharded mode: controllers run on every replica from
+                # start() on — ownership is per-key through the gate,
+                # not per-process through start/stop.
+                self.shard_gate = ShardGate(self.shards)
+                for ctrl in controllers:
+                    if getattr(ctrl, "shard_gate", None) is None:
+                        ctrl.shard_gate = self.shard_gate
+                self.elector = ShardedElector(
+                    api, lease_name, me, self.shards,
+                    namespace=lease_namespace,
+                    gate=self.shard_gate,
+                    **kwargs,
+                )
+            else:
+                self.elector = LeaderElector(
+                    api,
+                    lease_name,
+                    me,
+                    namespace=lease_namespace,
+                    on_started_leading=self._start_controllers,
+                    on_stopped_leading=self._stop_controllers,
+                    **kwargs,
+                )
 
     def ready(self) -> bool:
         """Readiness = serving; standbys are ready without leading (they
@@ -253,6 +284,11 @@ class Manager:
         if self.server is not None:
             self.server.start()
         if self.elector is not None:
+            if self.shard_gate is not None:
+                # Sharded replicas run their controllers immediately;
+                # the gate keeps them idle until shards are owned AND
+                # resynced, so a standby burns no reconciles.
+                self._start_controllers()
             self.elector.start()
         else:
             self._start_controllers()
@@ -275,10 +311,21 @@ def make_notebook_manager(
     tpu_busy_probe=None,
 ) -> Manager:
     """The notebook-controller binary: notebook reconciler + culler (+
-    metrics), configured from env exactly like the reference manager."""
+    metrics), configured from env exactly like the reference manager.
+    ``KFT_INFORMER=0`` opts out of the shared informer cache (plain
+    per-reconcile LISTs); with ``KFT_SHARDS>1`` the notebook
+    controller's status writes also batch through a StatusBatcher."""
+    from kubeflow_tpu.controllers.runtime import StatusBatcher
+
     nb_opts, cull_opts = options_from_env()
     prom = ControllerMetrics(api)
-    controllers = [make_notebook_controller(api, nb_opts, prom=prom)]
+    cache = (InformerCache(api) if _env_bool("KFT_INFORMER", True)
+             else None)
+    shards = shard_count()
+    batcher = StatusBatcher(api) if shards > 1 else None
+    controllers = [make_notebook_controller(
+        api, nb_opts, prom=prom, cache=cache, status_batcher=batcher,
+    )]
     controllers.append(
         make_culling_controller(
             api,
@@ -286,6 +333,7 @@ def make_notebook_manager(
             options=cull_opts,
             tpu_busy_probe=tpu_busy_probe,
             prom=prom,
+            cache=cache,
         )
     )
     if leader_elect is None:
@@ -298,6 +346,7 @@ def make_notebook_manager(
         leader_elect=leader_elect,
         lease_name="notebook-controller",
         identity=identity,
+        shards=shards,
     )
 
 

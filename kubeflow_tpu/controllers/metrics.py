@@ -579,6 +579,51 @@ class ManagerServer:
                     self.send_response(404)
                     self.end_headers()
 
+            def do_POST(self):
+                # First-HTTP-touch resurrect (the scheduler's touch()
+                # contract): JWA details pages / gateway front doors
+                # POST /touch/<namespace>/<name>[?kind=InferenceService]
+                # when a user first hits a Suspended workload — the
+                # scheduler re-enqueues it and the reconciler's resume
+                # handshake brings it back from its parked checkpoint.
+                # Debug-gated like the other operator surfaces: the
+                # production front door sits inside the mesh.
+                import json
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                parts = parsed.path.split("/")
+                if (
+                    len(parts) == 4
+                    and parts[1] == "touch"
+                    and parts[2] and parts[3]
+                    and outer.enable_debug
+                    and outer.scheduler is not None
+                ):
+                    kind = (parse_qs(parsed.query).get("kind")
+                            or ["Notebook"])[0]
+                    if kind not in ("Notebook", "InferenceService"):
+                        self.send_response(400)
+                        self.end_headers()
+                        self.wfile.write(b"unknown kind\n")
+                        return
+                    resurrected = outer.scheduler.touch(
+                        kind, parts[2], parts[3]
+                    )
+                    body = json.dumps({
+                        "kind": kind,
+                        "namespace": parts[2],
+                        "name": parts[3],
+                        "resurrected": bool(resurrected),
+                    }).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None

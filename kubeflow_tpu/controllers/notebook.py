@@ -120,6 +120,8 @@ class NotebookReconciler:
         clock=time.time,  # elastic grace/promote timers (injectable)
         promotion_gate=None,  # autopilot.ElasticPromotionGate (or None)
         scheduler=None,  # scheduler.SlicePoolScheduler (or None)
+        cache=None,  # runtime.InformerCache (or None: plain LISTs)
+        status_writer=None,  # runtime.StatusBatcher (or None: direct)
     ):
         self.api = api
         self.options = options or NotebookOptions()
@@ -127,9 +129,34 @@ class NotebookReconciler:
         self.clock = clock
         self.promotion_gate = promotion_gate
         self.scheduler = scheduler
+        self.cache = cache
+        self.status_writer = status_writer
 
     def _ensure(self, desired: dict) -> str:
         return ensure_object(self.api, desired)
+
+    def _list_pods(self, req: Request) -> list:
+        """The slice's pods — through the informer's namespace index
+        when a cache is wired (at fleet cardinality a per-reconcile
+        LIST scans every pod in the cluster), else the plain LIST."""
+        source = self.cache if self.cache is not None else self.api
+        return source.list(
+            "v1", "Pod", namespace=req.namespace,
+            label_selector=f"notebook-name={req.name}",
+        )
+
+    def _patch_status(self, name: str, ns: str, patch: dict) -> None:
+        """Status writes coalesce through the controller's batcher
+        when one is wired (one PATCH per key per loop iteration under
+        churn), else write directly — same merge-patch either way."""
+        if self.status_writer is not None:
+            self.status_writer.submit(
+                NOTEBOOK_API, "Notebook", name, {"status": patch}, ns
+            )
+        else:
+            self.api.patch_merge(
+                NOTEBOOK_API, "Notebook", name, {"status": patch}, ns
+            )
 
     def reconcile(self, req: Request) -> float | None:
         # Phase attribution (PR 10): the four classic reconcile costs
@@ -161,10 +188,7 @@ class NotebookReconciler:
             # policy steer what gets generated.
             pods = None
             if (notebook.get("spec") or {}).get("tpu"):
-                pods = self.api.list(
-                    "v1", "Pod", namespace=req.namespace,
-                    label_selector=f"notebook-name={req.name}",
-                )
+                pods = self._list_pods(req)
         with profile_phase("desired-state"):
             reshard_reason, elastic_shape = self._elastic(
                 notebook, req, pods)
@@ -465,10 +489,11 @@ class NotebookReconciler:
             .get("replicas", 1), 1,
         )
         events = []
+        event_source = self.cache if self.cache is not None else self.api
         for involved in [name] + [f"{name}-{i}" for i in range(replicas)]:
             events.extend(
                 e
-                for e in self.api.list(
+                for e in event_source.list(
                     "v1", "Event", namespace=ns,
                     field_selector=f"involvedObject.name={involved}",
                 )
@@ -550,9 +575,7 @@ class NotebookReconciler:
                 removed = {k: None for k in cur_cs if k not in new_cs}
                 if removed:
                     patch["containerState"] = {**new_cs, **removed}
-            self.api.patch_merge(
-                NOTEBOOK_API, "Notebook", name, {"status": patch}, ns
-            )
+            self._patch_status(name, ns, patch)
 
 
 def make_notebook_controller(
@@ -562,10 +585,14 @@ def make_notebook_controller(
     clock=time.time,
     promotion_gate=None,
     scheduler=None,
+    cache=None,
+    status_batcher=None,
+    shard_gate=None,
 ) -> Controller:
     reconciler = NotebookReconciler(api, options, prom=prom, clock=clock,
                                     promotion_gate=promotion_gate,
-                                    scheduler=scheduler)
+                                    scheduler=scheduler, cache=cache,
+                                    status_writer=status_batcher)
     return Controller(
         name="notebook-controller",
         api=api,
@@ -576,4 +603,7 @@ def make_notebook_controller(
             WatchSpec("v1", "Pod", pod_to_notebook_requests),
         ],
         prom=prom,
+        shard_gate=shard_gate,
+        status_batcher=status_batcher,
+        cache=cache,
     )

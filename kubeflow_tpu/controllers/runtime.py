@@ -17,13 +17,22 @@ from __future__ import annotations
 import heapq
 import itertools
 import logging
+import queue
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Protocol
 
 from kubeflow_tpu import obs
-from kubeflow_tpu.k8s.fake import FakeApiServer, WatchEvent
+from kubeflow_tpu.controllers.leader import shard_of
+from kubeflow_tpu.k8s.core import (
+    CLUSTER_SCOPED,
+    GVK,
+    NotFound,
+    match_field_selector,
+    match_label_selector,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, WatchEvent, _jcopy
 from kubeflow_tpu.obs.metrics import BucketHistogram
 from kubeflow_tpu.obs.profile import PhaseProfiler
 
@@ -47,22 +56,53 @@ class _QueueEntry:
     not_before: float = 0.0
 
 
+# Workqueue priority lanes. A delete or a preemption drain changes what
+# the fleet is RUNNING; a status-only ripple changes what it SAYS — so
+# under churn backlog the fast lane (deletes, deletionTimestamps,
+# preempt-requested drains) pops ahead of the default lane. Ordering
+# within a lane is unchanged (earliest due, then arrival), and a key
+# re-added on a faster lane keeps its earliest due-time.
+LANE_FAST = "fast"
+LANE_DEFAULT = "default"
+_LANES = (LANE_FAST, LANE_DEFAULT)
+_LANE_RANK = {lane: i for i, lane in enumerate(_LANES)}
+
+
+def lane_for_event(event_type: str, obj: dict) -> str:
+    """Classify a watch event into a workqueue lane: deletes and
+    preemption drains jump the status-churn line."""
+    if event_type == "DELETED":
+        return LANE_FAST
+    meta = obj.get("metadata") or {}
+    if meta.get("deletionTimestamp"):
+        return LANE_FAST
+    anns = meta.get("annotations") or {}
+    if any(k.endswith("/preempt-requested") for k in anns):
+        return LANE_FAST
+    return LANE_DEFAULT
+
+
 class WorkQueue:
     """Deduplicating rate-limited queue (the controller-runtime shape:
-    per-item exponential backoff, reset on success)."""
+    per-item exponential backoff, reset on success) with keyed
+    priority lanes (``LANE_FAST`` ahead of ``LANE_DEFAULT``)."""
 
     def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
         self._base = base_delay
         self._max = max_delay
         self._lock = threading.Lock()
         self._pending: dict[Request, float] = {}  # req -> not_before
+        self._lane: dict[Request, str] = {}      # req -> current lane
         self._failures: dict[Request, int] = {}
-        # Min-heap of (not_before, seq, req) mirroring _pending. Entries
-        # superseded by an earlier re-add stay in the heap and are
-        # skipped lazily in pop_ready (their not_before no longer
-        # matches _pending) — pop is O(log n) amortised instead of the
-        # former O(n log n) full sort per pop.
-        self._heap: list[tuple[float, int, Request]] = []
+        # Per-lane min-heaps of (not_before, seq, req) mirroring
+        # _pending. Entries superseded by an earlier re-add (or a lane
+        # upgrade) stay in their heap and are skipped lazily in
+        # pop_ready (their not_before/lane no longer matches) — pop is
+        # O(log n) amortised instead of the former O(n log n) full
+        # sort per pop.
+        self._heaps: dict[str, list[tuple[float, int, Request]]] = {
+            lane: [] for lane in _LANES
+        }
         self._seq = itertools.count()
         # Queue-duration stamp per pending key: the moment the key
         # becomes DUE (its earliest not_before), NOT when it was
@@ -77,15 +117,24 @@ class WorkQueue:
         # histogram here); called OUTSIDE the queue lock.
         self.latency_observer = None
 
-    def _schedule_locked(self, req: Request, not_before: float) -> None:
+    def _schedule_locked(self, req: Request, not_before: float,
+                         lane: str = LANE_DEFAULT) -> None:
         # Caller holds self._lock (the _locked contract the
         # concurrency analysis pack enforces). Keep the earliest
         # scheduled time for duplicates: an item that is already due
-        # must never be pushed back.
+        # must never be pushed back. Lanes only upgrade (fast wins
+        # until popped) — a delete followed by status churn must not
+        # demote the key back behind the churn.
         cur = self._pending.get(req)
-        if cur is None or not_before < cur:
-            self._pending[req] = not_before
-            heapq.heappush(self._heap, (not_before, next(self._seq), req))
+        cur_lane = self._lane.get(req, LANE_DEFAULT)
+        if cur is not None and _LANE_RANK[lane] > _LANE_RANK[cur_lane]:
+            lane = cur_lane
+        if cur is None or not_before < cur or lane != cur_lane:
+            due = not_before if cur is None else min(not_before, cur)
+            self._pending[req] = due
+            self._lane[req] = lane
+            heapq.heappush(self._heaps[lane],
+                           (due, next(self._seq), req))
         # Duration stamp: fresh stay takes this due-time; an earlier
         # re-add of a pending key pulls it forward (the key became due
         # sooner), a later one never pushes it back.
@@ -93,9 +142,10 @@ class WorkQueue:
         if cur is None or stamp is None or not_before < stamp:
             self._enqueued_at[req] = not_before
 
-    def add(self, req: Request, delay: float = 0.0) -> None:
+    def add(self, req: Request, delay: float = 0.0,
+            lane: str = LANE_DEFAULT) -> None:
         with self._lock:
-            self._schedule_locked(req, time.monotonic() + delay)
+            self._schedule_locked(req, time.monotonic() + delay, lane)
 
     def add_rate_limited(self, req: Request) -> None:
         with self._lock:
@@ -111,26 +161,69 @@ class WorkQueue:
         with self._lock:
             self._failures.pop(req, None)
 
-    def pop_ready(self) -> Request | None:
+    def drop(self, predicate) -> int:
+        """Remove pending keys matching ``predicate`` (shard handoff:
+        a lost shard's keys must not sit in this replica's queue —
+        the successor re-derives them from its own resync). Heap
+        entries go stale and are skipped lazily."""
+        with self._lock:
+            victims = [r for r in self._pending if predicate(r)]
+            for req in victims:
+                self._pending.pop(req, None)
+                self._lane.pop(req, None)
+                self._enqueued_at.pop(req, None)
+                self._failures.pop(req, None)
+            return len(victims)
+
+    def pop_ready(self, accept=None, discard=None) -> Request | None:
+        """Earliest due key from the fastest non-empty lane. With
+        ``accept`` (shard gating), due-but-not-yet-poppable keys are
+        skipped in place — they stay pending (and their due-stamp
+        keeps aging) until ownership or a drop() decides their fate.
+        ``accept`` runs under the queue lock and its True verdict is
+        final (the key IS popped): a gate can count the reconcile
+        in-flight inside it, atomically with the pop, so a handoff
+        drain can never release between accept and begin. ``discard``
+        removes matching keys outright (a shard lost before it was
+        ever synced: the successor re-derives its keys, holding them
+        here would leak)."""
         wait: float | None = None
         popped: Request | None = None
         with self._lock:
             now = time.monotonic()
-            while self._heap:
-                not_before, _, req = self._heap[0]
-                cur = self._pending.get(req)
-                if cur is None or cur != not_before:
-                    heapq.heappop(self._heap)  # stale/superseded entry
-                    continue
-                if not_before > now:
-                    return None  # heap min not due: nothing is
-                heapq.heappop(self._heap)
-                del self._pending[req]
-                due_at = self._enqueued_at.pop(req, None)
-                if due_at is not None:
-                    wait = max(0.0, time.monotonic() - due_at)
-                popped = req
-                break
+            for lane in _LANES:
+                heap = self._heaps[lane]
+                deferred: list[tuple[float, int, Request]] = []
+                while heap:
+                    not_before, seq, req = heap[0]
+                    cur = self._pending.get(req)
+                    if (cur is None or cur != not_before
+                            or self._lane.get(req) != lane):
+                        heapq.heappop(heap)  # stale/superseded entry
+                        continue
+                    if not_before > now:
+                        break  # lane min not due: lane exhausted
+                    heapq.heappop(heap)
+                    if discard is not None and discard(req):
+                        del self._pending[req]
+                        self._lane.pop(req, None)
+                        self._enqueued_at.pop(req, None)
+                        self._failures.pop(req, None)
+                        continue
+                    if accept is not None and not accept(req):
+                        deferred.append((not_before, seq, req))
+                        continue
+                    del self._pending[req]
+                    self._lane.pop(req, None)
+                    due_at = self._enqueued_at.pop(req, None)
+                    if due_at is not None:
+                        wait = max(0.0, time.monotonic() - due_at)
+                    popped = req
+                    break
+                for entry in deferred:
+                    heapq.heappush(heap, entry)
+                if popped is not None:
+                    break
         if popped is None:
             return None
         if wait is not None:
@@ -158,6 +251,464 @@ class WorkQueue:
             if not self._pending:
                 return None
             return min(self._pending.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class ShardGate:
+    """Shared shard-ownership state for one manager replica.
+
+    The :class:`~kubeflow_tpu.controllers.leader.ShardedElector` flips
+    ownership (``on_acquired``/``on_lost``) and initiates drains
+    (``begin_drain``); every controller in the replica consults
+    ``owns()`` before enqueuing or popping a key and brackets each
+    reconcile with ``begin``/``end`` so a voluntary handoff can wait
+    out the in-flight reconcile. The successor-resync discipline
+    (a freshly acquired shard is re-LISTed before its keys pop) lives
+    in the Controller — per controller, since each has its own queue.
+    """
+
+    def __init__(self, shards: int):
+        self.shards = max(1, int(shards))
+        self._lock = threading.Lock()
+        self._owned: set[int] = set()
+        self._draining: set[int] = set()
+        self._in_flight: dict[int, int] = {}
+
+    def shard(self, req: Request) -> int:
+        return shard_of(req.namespace, req.name, self.shards)
+
+    def on_acquired(self, shard: int) -> None:
+        with self._lock:
+            self._owned.add(shard)
+            self._draining.discard(shard)
+
+    def on_lost(self, shard: int) -> None:
+        with self._lock:
+            self._owned.discard(shard)
+            self._draining.discard(shard)
+
+    def begin_drain(self, shard: int) -> None:
+        """Stop new pops of this shard's keys; ownership (and the
+        lease) is surrendered only after the in-flight count hits 0."""
+        with self._lock:
+            self._draining.add(shard)
+
+    def owned(self) -> frozenset[int]:
+        with self._lock:
+            return frozenset(self._owned)
+
+    def owns(self, req: Request) -> bool:
+        shard = self.shard(req)
+        with self._lock:
+            return shard in self._owned and shard not in self._draining
+
+    def in_flight(self, shard: int) -> int:
+        with self._lock:
+            return self._in_flight.get(shard, 0)
+
+    def begin(self, req: Request) -> int:
+        shard = self.shard(req)
+        with self._lock:
+            self._in_flight[shard] = self._in_flight.get(shard, 0) + 1
+        return shard
+
+    def try_begin(self, req: Request) -> bool:
+        """Ownership check + in-flight increment in ONE critical
+        section: a drain (begin_drain, then wait for in_flight 0)
+        serialises against this — it either sees the increment or the
+        draining flag refuses the pop. Two separate owns()/begin()
+        calls would leave a window where the drain observes zero
+        in-flight between them and releases the lease under a
+        reconcile that is about to start."""
+        shard = self.shard(req)
+        with self._lock:
+            if shard not in self._owned or shard in self._draining:
+                return False
+            self._in_flight[shard] = self._in_flight.get(shard, 0) + 1
+            return True
+
+    def end(self, shard: int) -> None:
+        with self._lock:
+            count = self._in_flight.get(shard, 0) - 1
+            if count <= 0:
+                self._in_flight.pop(shard, None)
+            else:
+                self._in_flight[shard] = count
+
+
+class Informer:
+    """Watch-fed indexed store for one ``(apiVersion, kind)`` — the
+    controller-runtime informer shape over the platform's apiserver
+    duck type.
+
+    Reads (``get``/``list``/``for_owner``) first drain the watch queue
+    (O(delta) maintenance), then serve from the indexed store — so on
+    the synchronous fake a cached read observes everything a LIST
+    would, while costing O(selected) instead of O(every object of the
+    kind) per call. Maintained indexes: ``(namespace, name)`` primary,
+    per-namespace buckets, owner-uid (ownerReferences), and on-demand
+    equality field indexes (e.g. ``involvedObject.name`` for the
+    status mirror's Event joins — the per-reconcile scan that goes
+    quadratic at fleet cardinality without one).
+
+    Event application is resourceVersion-disciplined: a delivery older
+    than the stored object is ignored, so duplicated or reordered
+    watch deliveries (the chaos matrix's stream damage) cannot regress
+    the store. Lost deliveries (drops, watch-cache compaction) are
+    healed by :meth:`recover` — catch up through the store's retained
+    event log, or on a compacted horizon (the 410 Gone case) count a
+    relist and rebuild from a full LIST, exactly a real informer's
+    ListAndWatch restart."""
+
+    def __init__(self, api, api_version: str, kind: str):
+        self.api = api
+        self.api_version = api_version
+        self.kind = kind
+        self.gvk = GVK.from_obj({"apiVersion": api_version, "kind": kind})
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str], dict] = {}
+        self._by_namespace: dict[str, set[tuple[str, str]]] = {}
+        self._by_owner: dict[str, set[tuple[str, str]]] = {}
+        self._field_idx: dict[str, dict[str, set[tuple[str, str]]]] = {}
+        self._rv = 0
+        self.relists = 0      # full re-lists taken (410 recovery)
+        self.applied = 0      # watch events applied
+        # Subscribe FIRST, then seed from a full list: an event landing
+        # between the two is absorbed by the rv discipline.
+        self._queue = api.watch(api_version, kind)
+        self._relist()
+
+    # ---- maintenance -----------------------------------------------------
+    def _key(self, obj: dict) -> tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        ns = ("" if self.kind in CLUSTER_SCOPED
+              else meta.get("namespace") or "default")
+        return (ns, meta.get("name", ""))
+
+    @staticmethod
+    def _obj_rv(obj: dict) -> int:
+        try:
+            return int((obj.get("metadata") or {})
+                       .get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _index_locked(self, key: tuple[str, str], obj: dict) -> None:
+        self._unindex_locked(key)
+        self._objects[key] = obj
+        self._by_namespace.setdefault(key[0], set()).add(key)
+        meta = obj.get("metadata") or {}
+        for ref in meta.get("ownerReferences") or []:
+            uid = ref.get("uid")
+            if uid:
+                self._by_owner.setdefault(uid, set()).add(key)
+        for path, idx in self._field_idx.items():
+            idx.setdefault(self._field_value(obj, path), set()).add(key)
+
+    def _unindex_locked(self, key: tuple[str, str]) -> None:
+        old = self._objects.pop(key, None)
+        if old is None:
+            return
+        bucket = self._by_namespace.get(key[0])
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del self._by_namespace[key[0]]
+        for ref in (old.get("metadata") or {}).get("ownerReferences") or []:
+            uid = ref.get("uid")
+            refs = self._by_owner.get(uid)
+            if refs is not None:
+                refs.discard(key)
+                if not refs:
+                    del self._by_owner[uid]
+        for path, idx in self._field_idx.items():
+            value = self._field_value(old, path)
+            keys = idx.get(value)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del idx[value]
+
+    @staticmethod
+    def _field_value(obj: dict, path: str) -> str:
+        cur = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict):
+                return ""
+            cur = cur.get(part)
+        return "" if cur is None else str(cur)
+
+    def _apply_locked(self, event: WatchEvent) -> None:
+        obj = event.object
+        key = self._key(obj)
+        rv = self._obj_rv(obj)
+        cur = self._objects.get(key)
+        if cur is not None and rv < self._obj_rv(cur):
+            return  # duplicated/reordered delivery: older than stored
+        if event.type == "DELETED":
+            self._unindex_locked(key)
+        else:
+            self._index_locked(key, obj)
+        self.applied += 1
+        self._rv = max(self._rv, rv)
+
+    def sync(self) -> int:
+        """Drain the watch queue into the store; returns events
+        applied. Cheap enough to call before every read."""
+        moved = 0
+        with self._lock:
+            while not self._queue.empty():
+                try:
+                    event = self._queue.get_nowait()
+                except queue.Empty:
+                    break  # raced another sync's drain
+                self._apply_locked(event)
+                moved += 1
+        return moved
+
+    def _relist(self) -> None:
+        with self._lock:
+            objs = self.api.list(self.api_version, self.kind)
+            self._objects.clear()
+            self._by_namespace.clear()
+            self._by_owner.clear()
+            for idx in self._field_idx.values():
+                idx.clear()
+            for obj in objs:
+                self._index_locked(self._key(obj), obj)
+                self._rv = max(self._rv, self._obj_rv(obj))
+            last_rv = getattr(self.api, "last_resource_version", None)
+            if last_rv is not None:
+                self._rv = max(self._rv, int(last_rv))
+
+    def recover(self) -> bool:
+        """Watch-resume repair after suspected stream damage: replay
+        the store's retained change log from our resourceVersion, or —
+        when the horizon was compacted past us (410 Gone) — drop the
+        queue backlog and rebuild from a full LIST. Returns whether a
+        full relist was taken."""
+        self.sync()
+        events_since = getattr(self.api, "events_since", None)
+        if events_since is None:
+            with self._lock:
+                self.relists += 1
+                self._relist()
+            return True
+        with self._lock:
+            backlog = events_since(self.gvk, self._rv)
+            if backlog is None:
+                # 410 Gone: our horizon is compacted away. The queued
+                # deliveries predate the relist and would be skipped by
+                # the rv discipline anyway; drain them now for bound.
+                while not self._queue.empty():
+                    try:
+                        self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                self.relists += 1
+                self._relist()
+                return True
+            for event in backlog:
+                self._apply_locked(event)
+        return False
+
+    # ---- reads -----------------------------------------------------------
+    def ensure_field_index(self, path: str) -> None:
+        with self._lock:
+            if path in self._field_idx:
+                return
+            idx: dict[str, set[tuple[str, str]]] = {}
+            for key, obj in self._objects.items():
+                idx.setdefault(self._field_value(obj, path), set()).add(key)
+            self._field_idx[path] = idx
+
+    def get(self, name: str, namespace: str | None = None) -> dict:
+        self.sync()
+        ns = ("" if self.kind in CLUSTER_SCOPED
+              else namespace or "default")
+        with self._lock:
+            obj = self._objects.get((ns, name))
+            if obj is None:
+                raise NotFound(
+                    f"{self.kind} {namespace}/{name} not found (cache)"
+                )
+            return _jcopy(obj)
+
+    def _candidates_locked(self, namespace, field_selector):
+        # One equality field-selector term with an index beats the
+        # namespace bucket; build the index on first use.
+        if field_selector and "," not in field_selector \
+                and "!=" not in field_selector:
+            sep = "==" if "==" in field_selector else "="
+            if sep in field_selector:
+                path, value = field_selector.split(sep, 1)
+                path = path.strip()
+                if path not in self._field_idx:
+                    self.ensure_field_index(path)
+                keys = self._field_idx[path].get(value.strip(), set())
+                if namespace and self.kind not in CLUSTER_SCOPED:
+                    keys = {k for k in keys if k[0] == namespace}
+                return keys
+        if namespace and self.kind not in CLUSTER_SCOPED:
+            return self._by_namespace.get(namespace, set())
+        return self._objects.keys()
+
+    def list(self, namespace: str | None = None,
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
+        self.sync()
+        with self._lock:
+            out = []
+            for key in self._candidates_locked(namespace, field_selector):
+                obj = self._objects.get(key)
+                if obj is None:
+                    continue
+                if label_selector and not match_label_selector(
+                    (obj.get("metadata") or {}).get("labels") or {},
+                    label_selector,
+                ):
+                    continue
+                if field_selector and not match_field_selector(
+                    obj, field_selector
+                ):
+                    continue
+                out.append(_jcopy(obj))
+        return sorted(
+            out, key=lambda o: (o["metadata"].get("namespace", ""),
+                                o["metadata"]["name"])
+        )
+
+    def for_owner(self, uid: str) -> list[dict]:
+        self.sync()
+        with self._lock:
+            keys = sorted(self._by_owner.get(uid, set()))
+            return [_jcopy(self._objects[k]) for k in keys
+                    if k in self._objects]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class InformerCache:
+    """Lazily-built :class:`Informer` per kind, sharing one api handle
+    — the manager-wide read path that replaces per-reconcile LISTs.
+    Duck-types the apiserver's ``get``/``list`` so call sites (and
+    ``node_inventory_capacity``) switch by handle swap."""
+
+    def __init__(self, api):
+        self.api = api
+        self._lock = threading.Lock()
+        self._informers: dict[tuple[str, str], Informer] = {}
+
+    def informer(self, api_version: str, kind: str) -> Informer:
+        key = (api_version, kind)
+        with self._lock:
+            inf = self._informers.get(key)
+            if inf is None:
+                inf = Informer(self.api, api_version, kind)
+                self._informers[key] = inf
+            return inf
+
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: str | None = None) -> dict:
+        return self.informer(api_version, kind).get(name, namespace)
+
+    def list(self, api_version: str, kind: str,
+             namespace: str | None = None,
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
+        return self.informer(api_version, kind).list(
+            namespace=namespace, label_selector=label_selector,
+            field_selector=field_selector,
+        )
+
+    def sync(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.sync()
+
+    def recover(self) -> int:
+        """Run every informer's watch-resume repair; returns how many
+        took the full-relist (410) path."""
+        with self._lock:
+            informers = list(self._informers.values())
+        return sum(1 for inf in informers if inf.recover())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                f"{av}/{kind}": {
+                    "objects": len(inf), "applied": inf.applied,
+                    "relists": inf.relists,
+                }
+                for (av, kind), inf in sorted(self._informers.items())
+            }
+
+
+class StatusBatcher:
+    """Coalesced status writes: reconcilers submit merge patches;
+    patches to the same object coalesce (deep merge, later wins —
+    None, the merge-patch delete, survives) and one flush per
+    controller loop iteration writes each key at most once. The
+    reconcilers' own change gates (compare-before-write) stay the
+    correctness layer; this bounds the write RATE under churn, where
+    the same key reconciles many times per second and each pass would
+    otherwise pay its own PATCH round-trip."""
+
+    def __init__(self, api):
+        self.api = api
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, tuple[str, str, str, str, dict]] = {}
+        self.submitted = 0
+        self.coalesced = 0
+        self.flushed = 0
+
+    @staticmethod
+    def _merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                StatusBatcher._merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    def submit(self, api_version: str, kind: str, name: str,
+               patch: dict, namespace: str | None = None) -> None:
+        key = (api_version, kind, namespace or "", name)
+        with self._lock:
+            self.submitted += 1
+            cur = self._pending.get(key)
+            if cur is None:
+                self._pending[key] = (
+                    api_version, kind, name, namespace, _jcopy(patch)
+                )
+            else:
+                self.coalesced += 1
+                self._merge(cur[4], patch)
+
+    def flush(self) -> int:
+        with self._lock:
+            batch = list(self._pending.values())
+            self._pending.clear()
+        wrote = 0
+        for api_version, kind, name, namespace, patch in batch:
+            try:
+                self.api.patch_merge(api_version, kind, name, patch,
+                                     namespace)
+                wrote += 1
+            except NotFound:
+                pass  # object deleted since the reconcile: moot
+            except Exception:
+                # Level-based repair owns correctness: the next
+                # reconcile of the key recomputes and resubmits.
+                log.debug("status flush failed for %s/%s %s",
+                          namespace, name, kind, exc_info=True)
+        self.flushed += wrote
+        return wrote
 
     def __len__(self) -> int:
         with self._lock:
@@ -314,6 +865,9 @@ class Controller:
         clock: Callable[[], float] = time.monotonic,
         profiler: PhaseProfiler | None = None,
         recorder=None,
+        shard_gate: ShardGate | None = None,
+        status_batcher: StatusBatcher | None = None,
+        cache: "InformerCache | None" = None,
     ):
         self.name = name
         self.api = api
@@ -321,6 +875,21 @@ class Controller:
         self.queue = WorkQueue()
         self.resync_period = resync_period
         self.prom = prom
+        # The reconciler's informer cache (when wired): every periodic
+        # resync also runs the caches' watch-resume repair, so stream
+        # damage heals at the same cadence as the level-based LIST.
+        self.cache = cache
+        # Horizontal sharding (fleet scale): with a gate, this replica
+        # only enqueues/pops keys of shards it owns, and a freshly
+        # acquired shard is resynced (re-LISTed) before its keys pop —
+        # the successor-resync half of the handoff discipline. None =
+        # the classic own-everything controller, byte-identical.
+        self.shard_gate = shard_gate
+        self._shard_synced: set[int] = set()
+        # Coalesced status writes (fleet scale): reconcilers that take
+        # a status_writer submit here; the run loop flushes once per
+        # iteration so churn on one key costs one PATCH per cycle.
+        self.status_batcher = status_batcher
         # Continuous profiling + black-box capture (PR 10): every
         # reconcile runs under this profiler's activation, so an
         # instrumented reconciler's phase splits (list / desired-state
@@ -404,21 +973,88 @@ class Controller:
 
     def _drain_watches(self) -> int:
         moved = 0
+        gate = self.shard_gate
         for spec, q in self._watch_queues:
             while not q.empty():
                 event: WatchEvent = q.get_nowait()
                 mapper = spec.mapper or self._default_request
+                lane = lane_for_event(event.type, event.object)
                 for req in mapper(event.object):
                     if req.name:
+                        if gate is not None and not gate.owns(req):
+                            # Another replica's shard: its own watch
+                            # stream (or its acquire-time resync)
+                            # carries this key; holding it here would
+                            # grow a standby's queue without bound.
+                            continue
                         self._remember_trace_parent(event.object, req)
-                        self.queue.add(req)
+                        self.queue.add(req, lane=lane)
                         moved += 1
         return moved
 
+    # ---- shard handoff ---------------------------------------------------
+    def _accept_and_begin(self, req: Request) -> bool:
+        """Pop filter under sharding: only keys of shards this replica
+        owns AND has resynced since acquiring (the successor must
+        re-derive the shard's level state before reconciling it). On
+        acceptance the reconcile is counted in-flight inside the
+        gate's own critical section (``try_begin``) — a voluntary
+        handoff's drain check can never observe zero between the
+        ownership check and the reconcile starting (the
+        dual-reconcile TOCTOU window). The synced set is
+        controller-thread-local, so reading it outside the gate lock
+        is safe."""
+        gate = self.shard_gate
+        if gate.shard(req) not in self._shard_synced:
+            return False
+        return gate.try_begin(req)
+
+    def _discard_unowned(self, req: Request) -> bool:
+        """Queue-eviction filter under sharding: a pending key whose
+        shard this replica no longer owns at all (e.g. acquired and
+        lost between two loop iterations, before it was ever synced)
+        is dead weight — the next owner re-derives it from its own
+        acquire-time resync."""
+        return self.shard_gate.shard(req) not in self.shard_gate.owned()
+
+    def _sync_owned_shards(self) -> None:
+        """Reconcile this controller's view of shard ownership with
+        the gate: lost shards drop their queued keys (the successor
+        re-derives them), newly acquired shards are resynced before
+        their keys become poppable."""
+        gate = self.shard_gate
+        if gate is None:
+            return
+        owned = gate.owned()
+        lost = self._shard_synced - owned
+        if lost:
+            self._shard_synced -= lost
+            self.queue.drop(lambda req: gate.shard(req) in lost)
+        fresh = owned - self._shard_synced
+        if fresh:
+            self.resync(shards=fresh)
+            self._shard_synced |= fresh
+
     def _process_one(self) -> bool:
-        req = self.queue.pop_ready()
+        gate = self.shard_gate
+        if gate is None:
+            req = self.queue.pop_ready()
+        else:
+            # accept counts the reconcile in-flight atomically with
+            # the pop (see _accept_and_begin).
+            req = self.queue.pop_ready(
+                accept=self._accept_and_begin,
+                discard=self._discard_unowned,
+            )
         if req is None:
             return False
+        try:
+            return self._reconcile_one(req)
+        finally:
+            if gate is not None:
+                gate.end(gate.shard(req))
+
+    def _reconcile_one(self, req: Request) -> bool:
         self.metrics["reconciles"] += 1
         # The reconcile span joins the trace that created the object
         # when its CR carries the trace annotation (spawner POST → CR →
@@ -641,10 +1277,18 @@ class Controller:
         if not self._initial_synced:
             # Informer-style initial LIST: objects that predate the
             # controller get reconciled without waiting for an event.
-            self.resync()
+            # Under sharding the acquire-time resync inside
+            # _sync_owned_shards IS the initial sync for everything
+            # this replica owns — a second full LIST would double the
+            # O(n) startup cost for no behavioural gain.
+            if self.shard_gate is None:
+                self.resync()
+            else:
+                self._sync_owned_shards()
             self._initial_synced = True
         processed = 0
         self._run_tick_hooks()
+        self._sync_owned_shards()
         for _ in range(max_iterations):
             self._drain_watches()
             if not self._process_one():
@@ -652,40 +1296,72 @@ class Controller:
                     break
             else:
                 processed += 1
+        # ONE flush per drain cycle: flushing per item would pay the
+        # same PATCH rate as the direct write path and coalesce
+        # nothing.
+        if self.status_batcher is not None:
+            self.status_batcher.flush()
         return processed
 
     def run_forever(self, poll_interval: float = 0.05):
         if not self._initial_synced:
-            self.resync()
+            if self.shard_gate is None:
+                self.resync()
+            else:
+                self._sync_owned_shards()
             self._initial_synced = True
         last_resync = time.monotonic()
         while not self._stop.is_set():
             self._run_tick_hooks()
+            self._sync_owned_shards()
             self._drain_watches()
             worked = self._process_one()
+            if self.status_batcher is not None and (
+                not worked or len(self.status_batcher) >= 64
+            ):
+                # Coalesce across the burst, flush on idle (or at a
+                # size bound so a busy loop can't defer status
+                # visibility unboundedly).
+                self.status_batcher.flush()
             if time.monotonic() - last_resync > self.resync_period:
                 last_resync = time.monotonic()
                 self.resync()
             if not worked:
                 self._stop.wait(poll_interval)
 
-    def resync(self) -> int | None:
+    def resync(self, shards: set[int] | frozenset[int] | None = None
+               ) -> int | None:
         """Re-enqueue every primary object (level-based safety net).
         A failed LIST (apiserver outage) must not kill the run loop —
         the next periodic resync retries; until then the watch stream
         and the queue's own retries keep the controller alive. Returns
         the number of objects enqueued, or None when the list failed —
         the chaos harness needs to distinguish "provably nothing to do"
-        from "could not even ask"."""
+        from "could not even ask". With a shard gate, only owned keys
+        enqueue; ``shards`` narrows further to a freshly acquired
+        subset (the successor-resync half of the handoff)."""
         spec = self._watch_queues[0][0] if self._watch_queues else None
         if spec is None:
             return 0
+        if self.cache is not None:
+            # Informer watch-resume repair rides the resync cadence: a
+            # compacted/damaged stream re-lists here, so the cache can
+            # never stay stale longer than one resync period. Failures
+            # (the apiserver may be the thing that's down) retry next
+            # cycle like the LIST below.
+            try:
+                self.cache.recover()
+            except Exception as exc:
+                log.warning("%s: informer recovery failed (%s); "
+                            "retrying on the next cycle",
+                            self.name, exc)
         try:
             objs = self.api.list(spec.api_version, spec.kind)
         except Exception as exc:
             log.warning("%s: resync list failed (%s); retrying on the "
                         "next cycle", self.name, exc)
             return None
+        gate = self.shard_gate
         count = 0
         for obj in objs:
             # Restart amnesia repair: the failure streak behind a
@@ -700,6 +1376,12 @@ class Controller:
                 for c in (obj.get("status") or {}).get("conditions") or []
             )
             for req in (spec.mapper or self._default_request)(obj):
+                if gate is not None:
+                    shard = gate.shard(req)
+                    if shards is not None and shard not in shards:
+                        continue
+                    if shards is None and not gate.owns(req):
+                        continue
                 self._remember_trace_parent(obj, req)
                 self.queue.add(req)
                 count += 1

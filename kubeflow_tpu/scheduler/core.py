@@ -66,6 +66,7 @@ byte-identically like ``loadtest/game_day.py``.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import itertools
 import logging
@@ -147,15 +148,21 @@ def resource_quota_chips(api, namespace: str) -> int | None:
     return best
 
 
-def node_inventory_capacity(api) -> int:
+def node_inventory_capacity(api, cache=None) -> int:
     """Schedulable TPU chips from the live Node inventory: allocatable
     ``google.com/tpu`` summed over Ready, untainted-for-termination
     nodes — the same inventory the chaos capacity timeline manipulates
-    (``PreemptionInjector`` taints nodes it reclaims). A failed LIST
-    raises: the scheduler's ``_capacity`` turns that into
-    serve-last-known (or fail-closed on a cold start) — returning None
-    here would read as an UNBOUNDED pool and admit everything."""
-    nodes = api.list("v1", "Node")
+    (``PreemptionInjector`` taints nodes it reclaims). With ``cache``
+    (a :class:`~kubeflow_tpu.controllers.runtime.InformerCache`), the
+    read comes from the watch-fed Node informer instead of a per-call
+    LIST — the production wiring, since ``_capacity`` consults this
+    under the scheduler lock on every admission pass (the TTL cache
+    there stays as the rate bound either way). A failed read raises:
+    the scheduler's ``_capacity`` turns that into serve-last-known (or
+    fail-closed on a cold start) — returning None here would read as
+    an UNBOUNDED pool and admit everything."""
+    source = cache if cache is not None else api
+    nodes = source.list("v1", "Node")
     total = 0
     for node in nodes or []:
         taints = ((node.get("spec") or {}).get("taints")) or []
@@ -294,6 +301,100 @@ class SlicePoolScheduler:
         self._lock = threading.Lock()
         self._workloads: dict[tuple[str, str, str], _Workload] = {}
         self._seq = itertools.count()
+        # Fleet-cardinality bookkeeping (the 10k-CR soak's finding):
+        # the admission pass used to recompute usage by scanning every
+        # workload and re-sorting the whole queue on EVERY decide —
+        # O(n + q log q) per reconcile goes quadratic across a flood.
+        # All aggregates are now maintained incrementally on state
+        # transitions, the queue is a bisect-maintained sorted list
+        # re-keyed once per distinct clock reading (aging only moves
+        # effective priorities when the clock moves), and the pass
+        # itself is memoized: clean state + same instant + TTL-cached
+        # signals ⇒ provably the same result, skip it.
+        self._used_chips = 0
+        self._draining_chips = 0
+        self._queued_chips = 0
+        self._ns_used: dict[str, int] = {}
+        self._state_counts: dict[str, int] = {}
+        self._admitted_set: set[_Workload] = set()
+        self._draining_set: set[_Workload] = set()
+        self._queue_keys: list[tuple[int, int]] = []
+        self._queue_items: list[_Workload] = []
+        self._queue_now: float | None = None
+        self._dirty = True
+        self._pass_now: float | None = None
+        # Last (head-seq, used, draining, capacity) for which the
+        # victim search provably found no plan — new arrivals that
+        # change none of those cannot change the answer.
+        self._preempt_memo: tuple | None = None
+
+    # ---- incremental queue/usage bookkeeping (lock held) ------------------
+    def _queue_key(self, w: _Workload, now: float) -> tuple[int, int]:
+        return (-self._effective_priority(w, now), w.seq)
+
+    def _rekey_queue_locked(self, now: float) -> None:
+        """Effective priorities age with the clock: re-key + re-sort
+        the queue once per distinct clock reading (timsort over the
+        nearly-sorted list is ~linear), so every bisect below works
+        against keys consistent with ``now``."""
+        if self._queue_now == now:
+            return
+        pairs = sorted(
+            ((self._queue_key(w, now), w) for w in self._queue_items),
+            key=lambda p: p[0],
+        )
+        self._queue_keys = [k for k, _ in pairs]
+        self._queue_items = [w for _, w in pairs]
+        self._queue_now = now
+
+    def _enqueue_locked(self, w: _Workload, now: float) -> None:
+        self._rekey_queue_locked(now)
+        key = self._queue_key(w, now)
+        i = bisect.bisect_left(self._queue_keys, key)
+        self._queue_keys.insert(i, key)
+        self._queue_items.insert(i, w)
+        self._queued_chips += w.chips
+        self._dirty = True
+
+    def _dequeue_locked(self, w: _Workload, now: float) -> None:
+        self._rekey_queue_locked(now)
+        key = self._queue_key(w, now)
+        i = bisect.bisect_left(self._queue_keys, key)
+        if i < len(self._queue_items) and self._queue_items[i] is w:
+            del self._queue_keys[i]
+            del self._queue_items[i]
+        else:
+            # Key drifted (priority changed without a requeue): the
+            # linear fallback keeps correctness over speed.
+            i = self._queue_items.index(w)
+            del self._queue_keys[i]
+            del self._queue_items[i]
+        self._queued_chips -= w.chips
+        self._dirty = True
+
+    def _count_state_down_locked(self, state: str) -> None:
+        cur = self._state_counts.get(state, 0) - 1
+        if cur <= 0:
+            self._state_counts.pop(state, None)
+        else:
+            self._state_counts[state] = cur
+
+    def _set_state_locked(self, w: _Workload, state: str) -> None:
+        self._count_state_down_locked(w.state)
+        w.state = state
+        self._state_counts[state] = self._state_counts.get(state, 0) + 1
+        self._dirty = True
+
+    def _usage_delta_locked(self, namespace: str, delta: int) -> None:
+        self._used_chips += delta
+        ns = self._ns_used.get(namespace, 0) + delta
+        if ns <= 0:
+            self._ns_used.pop(namespace, None)
+        else:
+            self._ns_used[namespace] = ns
+
+    def _usage_add_locked(self, w: _Workload, sign: int) -> None:
+        self._usage_delta_locked(w.namespace, sign * w.chips)
 
     # ---- clock / signal helpers ------------------------------------------
     def _now(self, now: float | None) -> float:
@@ -382,7 +483,7 @@ class SlicePoolScheduler:
         queue-ORDER starvation lever: a finite-priority stream of
         newcomers cannot hold the head against an aged entry forever.
         Never used for preemption eligibility (see
-        :meth:`_preemption_set`)."""
+        :meth:`_preemption_set_locked`)."""
         if w.state != QUEUED or self.aging_s <= 0:
             return w.priority
         return w.priority + int(max(0.0, now - w.enqueued_at)
@@ -420,15 +521,52 @@ class SlicePoolScheduler:
                 if observed_running:
                     w.state = ADMITTED
                     w.admitted_at = now
+                    self._state_counts[ADMITTED] = (
+                        self._state_counts.get(ADMITTED, 0) + 1
+                    )
+                    self._admitted_set.add(w)
+                    self._usage_add_locked(w, +1)
                     log.info("scheduler adopted running %s (%d chips)",
                              w.label, w.chips)
+                else:
+                    self._state_counts[QUEUED] = (
+                        self._state_counts.get(QUEUED, 0) + 1
+                    )
+                    self._enqueue_locked(w, now)
+                self._dirty = True
             else:
-                w.priority = self._parse_priority(anns)
+                new_priority = self._parse_priority(anns)
+                if new_priority != w.priority:
+                    if w.state == QUEUED:
+                        # Re-key under the OLD priority, re-insert
+                        # under the new one.
+                        self._dequeue_locked(w, now)
+                        w.priority = new_priority
+                        self._enqueue_locked(w, now)
+                    else:
+                        w.priority = new_priority
+                    # Either side of a victim plan moved (a raised
+                    # arrival or a lowered resident): a previously
+                    # impossible plan may exist now.
+                    self._preempt_memo = None
+                    self._dirty = True
                 if w.chips != int(chips):
                     # Elastic reshape: the gang demand follows the
                     # effective shape (an admitted slice that degraded
                     # frees the difference back to the pool).
+                    delta = int(chips) - w.chips
+                    if w.state in (ADMITTED, DRAINING):
+                        self._usage_delta_locked(w.namespace, delta)
+                        if w.state == DRAINING:
+                            self._draining_chips += delta
+                    elif w.state == QUEUED:
+                        self._queued_chips += delta
                     w.chips = int(chips)
+                    # The arrival's demand is not part of the memo
+                    # key: a shrunk gang may fit a plan that read as
+                    # impossible.
+                    self._preempt_memo = None
+                    self._dirty = True
             if w.state == DRAINING:
                 step = self._ckpt_step(anns)
                 if w.drain_ckpt0 is None:
@@ -437,8 +575,8 @@ class SlicePoolScheduler:
                     # baseline whatever step is already recorded.
                     w.drain_ckpt0 = step if step is not None else ""
                 elif step is not None and step != w.drain_ckpt0:
-                    self._complete_drain(w, now, step)
-            self._admission_pass(now)
+                    self._complete_drain_locked(w, now, step)
+            self._admission_pass_locked(now)
             return self._verdict_locked(w, now, anns)
 
     def release(self, kind: str, namespace: str, name: str) -> None:
@@ -446,7 +584,21 @@ class SlicePoolScheduler:
         if not self.enabled:
             return
         with self._lock:
-            self._workloads.pop((kind, namespace, name), None)
+            w = self._workloads.pop((kind, namespace, name), None)
+            if w is None:
+                return
+            if w.state == QUEUED:
+                self._dequeue_locked(w, self._queue_now
+                              if self._queue_now is not None
+                              else self.clock())
+            elif w.state in (ADMITTED, DRAINING):
+                self._usage_add_locked(w, -1)
+                self._admitted_set.discard(w)
+                if w.state == DRAINING:
+                    self._draining_set.discard(w)
+                    self._draining_chips -= w.chips
+            self._count_state_down_locked(w.state)
+            self._dirty = True
 
     def mark_reclaimable(self, kind: str, namespace: str, name: str,
                          now: float | None = None) -> bool:
@@ -460,7 +612,7 @@ class SlicePoolScheduler:
             w = self._workloads.get((kind, namespace, name))
             if w is None or w.state != ADMITTED:
                 return False
-            self._start_drain(
+            self._start_drain_locked(
                 w, SUSPENDED, now,
                 reason="idle past the duty-cycle threshold; "
                        "checkpointing, then scaling to zero",
@@ -482,13 +634,14 @@ class SlicePoolScheduler:
                 return False
             if w.suspended_at is not None:
                 self._charge(w, "suspended", now - w.suspended_at)
-            w.state = QUEUED
+            self._set_state_locked(w, QUEUED)
             w.seq = next(self._seq)
             w.enqueued_at = now
             w.resurrecting = True
             w.reason = "resurrecting from Suspended"
+            self._enqueue_locked(w, now)
             self.metrics.resurrects_total += 1
-            self._admission_pass(now)
+            self._admission_pass_locked(now)
             return True
 
     def tracks(self, kind: str, namespace: str, name: str) -> bool:
@@ -523,49 +676,55 @@ class SlicePoolScheduler:
             return
         now = self._now(now)
         with self._lock:
-            self._admission_pass(now)
+            self._admission_pass_locked(now)
 
     # ---- the admission pass (lock held) ----------------------------------
-    def _queued_sorted(self, now: float) -> list[_Workload]:
+    def _queued_sorted_locked(self, now: float) -> list[_Workload]:
         """THE queue order — `(-effective_priority, arrival_seq)` — in
         one place: admission, status positions and the debug doc must
-        never disagree about it."""
-        return sorted(
-            (w for w in self._workloads.values() if w.state == QUEUED),
-            key=lambda w: (-self._effective_priority(w, now), w.seq),
-        )
+        never disagree about it. Served from the bisect-maintained
+        sorted list, re-keyed once per distinct clock reading."""
+        self._rekey_queue_locked(now)
+        return list(self._queue_items)
 
-    def _admission_pass(self, now: float) -> None:
+    def _admission_pass_locked(self, now: float) -> None:
+        if (not self._dirty and self._pass_now == now
+                and self.signal_cache_ttl_s > 0):
+            # Memoized: no state transition since the last pass at
+            # this very instant, and capacity/quota reads are
+            # TTL-cached (same instant ⇒ same reading) — the pass is
+            # provably a no-op. With caching disabled (ttl=0, the
+            # scripted-signal tests), every decide re-reads and so
+            # every decide re-passes, the old behaviour.
+            return
+        self._dirty = False
+        self._pass_now = now
         # Deadline-expired drains complete first: their chips fund the
-        # admissions below.
-        for w in list(self._workloads.values()):
-            if (w.state == DRAINING and w.drain_deadline is not None
+        # admissions below. Seq-ordered iteration, NOT raw set order:
+        # two drains expiring in the same pass re-enqueue with fresh
+        # arrival seqs, and id()-ordered completion would make queue
+        # order differ across replays of the same scenario.
+        for w in sorted(self._draining_set, key=lambda w: w.seq):
+            if (w.drain_deadline is not None
                     and now >= w.drain_deadline):
-                self._complete_drain(w, now, None)
+                self._complete_drain_locked(w, now, None)
 
         capacity = self._capacity(now)
-        used = 0
-        draining_chips = 0
-        ns_used: dict[str, int] = {}
-        for w in self._workloads.values():
-            if w.state in (ADMITTED, DRAINING):
-                used += w.chips
-                ns_used[w.namespace] = ns_used.get(w.namespace, 0) + w.chips
-                if w.state == DRAINING:
-                    draining_chips += w.chips
-
-        queued = self._queued_sorted(now)
-        ns_quota = {w.namespace: self._quota(w.namespace, now)
-                    for w in queued}
+        queued = self._queued_sorted_locked(now)
+        ns_quota: dict[str, int | None] = {}
+        for w in queued:
+            if w.namespace not in ns_quota:
+                ns_quota[w.namespace] = self._quota(w.namespace, now)
         capacity_blocked = False
         for w in queued:
             quota = ns_quota.get(w.namespace)
             if quota is not None and \
-                    ns_used.get(w.namespace, 0) + w.chips > quota:
+                    self._ns_used.get(w.namespace, 0) + w.chips > quota:
                 # Namespace-local block: skip, never head-block other
                 # tenants behind one namespace's quota.
                 w.reason = (
-                    f"namespace quota: {ns_used.get(w.namespace, 0)} "
+                    f"namespace quota: "
+                    f"{self._ns_used.get(w.namespace, 0)} "
                     f"used + {w.chips} needed > {quota} chips "
                     f"(google.com/tpu ResourceQuota)"
                 )
@@ -575,14 +734,12 @@ class SlicePoolScheduler:
                 # later jobs once the head is waiting on chips.
                 w.reason = "waiting behind the queue head"
                 continue
-            if capacity is None or used + w.chips <= capacity:
-                self._admit(w, now)
-                used += w.chips
-                ns_used[w.namespace] = (
-                    ns_used.get(w.namespace, 0) + w.chips
-                )
+            if capacity is None or \
+                    self._used_chips + w.chips <= capacity:
+                self._admit_locked(w, now)
                 continue
-            if used - draining_chips + w.chips <= capacity:
+            if self._used_chips - self._draining_chips + w.chips \
+                    <= capacity:
                 # An in-flight drain already frees enough: do NOT pile
                 # more victims onto the same arrival — the first pass's
                 # plan stands until the checkpointed scale-down lands.
@@ -593,13 +750,14 @@ class SlicePoolScheduler:
             # Victim sizing credits in-flight drains (their chips free
             # regardless): sizing against raw `used` would evict more
             # slices than the arrival actually needs.
-            victims = self._preemption_set(
-                w, used - draining_chips, capacity, now
+            victims = self._preemption_set_locked(
+                w, self._used_chips - self._draining_chips, capacity,
+                now,
             )
             if victims:
                 names = ", ".join(v.label for v in victims)
                 for v in victims:
-                    self._start_drain(
+                    self._start_drain_locked(
                         v, QUEUED, now,
                         reason=(
                             f"preempted by {w.label} "
@@ -607,20 +765,19 @@ class SlicePoolScheduler:
                         ),
                     )
                     self.metrics.preemptions_total += 1
-                    draining_chips += v.chips
                 w.reason = (
                     f"preempting {names}: waiting for checkpointed "
                     "scale-down"
                 )
             else:
-                free = max(0, (capacity or 0) - used)
+                free = max(0, (capacity or 0) - self._used_chips)
                 w.reason = (
                     f"insufficient capacity: whole-slice gang needs "
                     f"{w.chips} chips, {free} free"
                 )
             capacity_blocked = True
 
-    def _preemption_set(self, arrival: _Workload, used: int,
+    def _preemption_set_locked(self, arrival: _Workload, used: int,
                         capacity: int, now: float) -> list[_Workload]:
         """The minimal lowest-priority victim set whose eviction fits
         the arrival — or [] when no all-or-nothing plan exists (gang
@@ -632,10 +789,19 @@ class SlicePoolScheduler:
         — aging orders the queue but never arms eviction: an aged
         equal-priority arrival preempting a resident would re-queue
         the resident, which ages and preempts back, checkpoint-
-        thrashing both forever."""
+        thrashing both forever.
+
+        The scan walks the admitted SET (not every workload) and a
+        provably-empty result is memoized against (arrival, usage,
+        capacity) — at fleet cardinality the flood would otherwise
+        re-scan thousands of residents once per new arrival that
+        cannot change the answer."""
+        memo_key = (arrival.seq, used, self._draining_chips, capacity)
+        if self._preempt_memo == memo_key:
+            return []
         candidates = sorted(
-            (v for v in self._workloads.values()
-             if v.state == ADMITTED and v.priority < arrival.priority),
+            (v for v in self._admitted_set
+             if v.priority < arrival.priority),
             key=lambda v: (v.priority, -v.seq),  # lowest prio, newest 1st
         )
         picked: list[_Workload] = []
@@ -646,14 +812,19 @@ class SlicePoolScheduler:
             picked.append(v)
             freed += v.chips
         if used - freed + arrival.chips <= capacity:
+            self._preempt_memo = None
             return picked
+        self._preempt_memo = memo_key
         return []
 
-    def _admit(self, w: _Workload, now: float) -> None:
+    def _admit_locked(self, w: _Workload, now: float) -> None:
         wait = max(0.0, now - w.enqueued_at)
         self.metrics.admission_wait.observe(wait)
         self._charge(w, "queued", wait)
-        w.state = ADMITTED
+        self._dequeue_locked(w, now)
+        self._set_state_locked(w, ADMITTED)
+        self._admitted_set.add(w)
+        self._usage_add_locked(w, +1)
         w.admitted_at = now
         w.reason = None
         self.metrics.admissions_total += 1
@@ -664,9 +835,12 @@ class SlicePoolScheduler:
         log.info("scheduler admitted %s (%d chips, waited %.1fs)",
                  w.label, w.chips, wait)
 
-    def _start_drain(self, w: _Workload, target: str, now: float,
+    def _start_drain_locked(self, w: _Workload, target: str, now: float,
                      reason: str) -> None:
-        w.state = DRAINING
+        self._admitted_set.discard(w)
+        self._draining_set.add(w)
+        self._draining_chips += w.chips
+        self._set_state_locked(w, DRAINING)
         w.drain_target = target
         w.drain_deadline = now + self.drain_grace_s
         w.drain_ckpt0 = None  # captured from the next decide()'s anns
@@ -674,13 +848,16 @@ class SlicePoolScheduler:
         log.info("scheduler draining %s -> %s: %s", w.label, target,
                  reason)
 
-    def _complete_drain(self, w: _Workload, now: float,
+    def _complete_drain_locked(self, w: _Workload, now: float,
                         step: str | None) -> None:
         target = w.drain_target or QUEUED
         w.drain_deadline = None
         w.drain_target = None
+        self._draining_set.discard(w)
+        self._draining_chips -= w.chips
+        self._usage_add_locked(w, -1)
         if target == SUSPENDED:
-            w.state = SUSPENDED
+            self._set_state_locked(w, SUSPENDED)
             w.suspended_at = now
             # "" means "no checkpoint ever observed" (the drain
             # baseline of an annotation-less CR) — normalize to None
@@ -690,15 +867,21 @@ class SlicePoolScheduler:
             log.info("scheduler suspended %s at checkpoint step %s",
                      w.label, w.suspend_step or "<unknown>")
         else:
-            w.state = QUEUED
+            self._set_state_locked(w, QUEUED)
             w.seq = next(self._seq)
             w.enqueued_at = now
             w.reason = w.drain_reason
+            self._enqueue_locked(w, now)
             log.info("scheduler re-queued preempted %s", w.label)
 
     # ---- verdicts (lock held) --------------------------------------------
-    def _queue_position(self, w: _Workload, now: float) -> int:
-        return self._queued_sorted(now).index(w) + 1
+    def _queue_position_locked(self, w: _Workload, now: float) -> int:
+        self._rekey_queue_locked(now)
+        key = self._queue_key(w, now)
+        i = bisect.bisect_left(self._queue_keys, key)
+        if i < len(self._queue_items) and self._queue_items[i] is w:
+            return i + 1
+        return self._queue_items.index(w) + 1
 
     def _verdict_locked(self, w: _Workload, now: float,
                         anns: dict) -> SchedulingVerdict:
@@ -737,7 +920,7 @@ class SlicePoolScheduler:
             patches[PREEMPT_REQUESTED_KEY] = None
         return SchedulingVerdict(
             admitted=False, phase="Queued", reason=w.reason,
-            queue_position=self._queue_position(w, now),
+            queue_position=self._queue_position_locked(w, now),
             annotations=patches,
         )
 
@@ -748,14 +931,9 @@ class SlicePoolScheduler:
         and suspension counts."""
         with self._lock:
             capacity = self._capacity()
-            used = sum(w.chips for w in self._workloads.values()
-                       if w.state in (ADMITTED, DRAINING))
-            by_state: dict[str, int] = {}
-            queued_chips = 0
-            for w in self._workloads.values():
-                by_state[w.state] = by_state.get(w.state, 0) + 1
-                if w.state == QUEUED:
-                    queued_chips += w.chips
+            used = self._used_chips
+            by_state = dict(self._state_counts)
+            queued_chips = self._queued_chips
         return {
             "capacity_chips": capacity,
             "used_chips": used,
@@ -770,8 +948,49 @@ class SlicePoolScheduler:
 
     def queue_depth(self) -> int:
         with self._lock:
-            return sum(1 for w in self._workloads.values()
-                       if w.state == QUEUED)
+            return self._state_counts.get(QUEUED, 0)
+
+    def audit(self) -> dict:
+        """Recompute every incremental aggregate from scratch and
+        compare — the soak's consistency net over the fleet-scale
+        bookkeeping. Returns {} when coherent, else the mismatches."""
+        with self._lock:
+            used = sum(w.chips for w in self._workloads.values()
+                       if w.state in (ADMITTED, DRAINING))
+            draining = sum(w.chips for w in self._workloads.values()
+                           if w.state == DRAINING)
+            queued_chips = sum(w.chips for w in self._workloads.values()
+                               if w.state == QUEUED)
+            counts: dict[str, int] = {}
+            for w in self._workloads.values():
+                counts[w.state] = counts.get(w.state, 0) + 1
+            ns_used: dict[str, int] = {}
+            for w in self._workloads.values():
+                if w.state in (ADMITTED, DRAINING):
+                    ns_used[w.namespace] = (
+                        ns_used.get(w.namespace, 0) + w.chips
+                    )
+            queue_members = {w.key for w in self._queue_items}
+            queued_keys = {w.key for w in self._workloads.values()
+                           if w.state == QUEUED}
+            problems = {}
+            if used != self._used_chips:
+                problems["used_chips"] = (self._used_chips, used)
+            if draining != self._draining_chips:
+                problems["draining_chips"] = (
+                    self._draining_chips, draining)
+            if queued_chips != self._queued_chips:
+                problems["queued_chips"] = (
+                    self._queued_chips, queued_chips)
+            if counts != self._state_counts:
+                problems["state_counts"] = (
+                    dict(self._state_counts), counts)
+            if ns_used != self._ns_used:
+                problems["ns_used"] = (dict(self._ns_used), ns_used)
+            if queue_members != queued_keys:
+                problems["queue_membership"] = (
+                    sorted(queue_members ^ queued_keys))
+            return problems
 
     def to_dict(self) -> dict:
         """The ``/debug/scheduler`` document: pool, ordered queue with
@@ -779,7 +998,7 @@ class SlicePoolScheduler:
         the scheduler counters."""
         now = self.clock()
         with self._lock:
-            queued = self._queued_sorted(now)
+            queued = self._queued_sorted_locked(now)
             queue_doc = [{
                 "workload": w.label,
                 "chips": w.chips,

@@ -69,7 +69,10 @@ from kubeflow_tpu.controllers.inference import (  # noqa: E402
     INFERENCE_API,
     make_inference_controller,
 )
-from kubeflow_tpu.controllers.metrics import ControllerMetrics  # noqa: E402
+from kubeflow_tpu.controllers.metrics import (  # noqa: E402
+    ControllerMetrics,
+    ManagerServer,
+)
 from kubeflow_tpu.controllers.notebook import (  # noqa: E402
     CHECKPOINT_STEP_KEY,
     NOTEBOOK_API,
@@ -284,6 +287,16 @@ class Contention:
             "team-a", "idle-nb", "2x2", 5,
             extra_annotations={CHECKPOINT_STEP_KEY: "7"},
         ))
+        # The scheduler's first-HTTP-touch surface: the scenario's
+        # resurrect goes through the real ManagerServer POST /touch
+        # route (what a JWA details page or gateway front door hits),
+        # not a scripted scheduler call. The hop is synchronous and
+        # the scheduler runs on the scenario clock, so the digest
+        # stays replay-deterministic.
+        self.server = ManagerServer(
+            self.prom, enable_debug=True, scheduler=self.scheduler,
+        )
+        self.server.start()
         self.ckpt = InMemoryCheckpointManager(
             self.api, "team-a", "train-lo", self.clk)
         self.sigterm_sent = False
@@ -293,6 +306,20 @@ class Contention:
         self._last_phases: tuple | None = None
 
     # ------------------------------------------------------------------
+    def _http_touch(self, namespace: str, name: str) -> dict:
+        """The first user touch, over the wire: POST /touch on the
+        live manager server (debug-gated route; the scheduler side is
+        :meth:`SlicePoolScheduler.touch`)."""
+        import urllib.request
+
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{self.server.port}"
+            f"/touch/{namespace}/{name}",
+            data=b"", method="POST",
+        )
+        with urllib.request.urlopen(request, timeout=10) as resp:
+            return json.loads(resp.read())
+
     def _charge(self, kind: str, namespace: str, name: str,
                 downtime_kind: str, seconds: float) -> None:
         meter = self.meters.setdefault(
@@ -342,8 +369,7 @@ class Contention:
             self.api.create(_inference("team-b", "greedy", "2x4", 10))
         if self.tick_index == int(self.TOUCH_AT * self.total_ticks):
             self.touched = True
-            self.scheduler.touch("Notebook", "team-a", "idle-nb",
-                                 now=now)
+            self._http_touch("team-a", "idle-nb")
         self.injector.apply_capacity(self.schedule, now, self.sim)
         self.sim.step()
         for ctrl in (self.nb_ctrl, self.inf_ctrl, self.cull_ctrl):
@@ -382,28 +408,31 @@ class Contention:
     def run(self) -> dict:
         from kubeflow_tpu.models.train import run_with_checkpointing
 
-        cadence = 5
-        state1, report1 = run_with_checkpointing(
-            train_step, {"step": 0, "acc": 0},
-            self._segment1_batches(), self.ckpt,
-            save_every_steps=cadence,
-            install_signal_handler=True,
-            clock=self.clk,
-        )
-        # Drain ack -> scale to zero -> serve-hi admits; then capacity
-        # regrows and train-lo re-admits.
-        self._ticks_until(self.REGROW_AT + 0.05)
-        segment2_steps = max(10, int(0.2 * self.total_ticks))
-        state2, report2 = run_with_checkpointing(
-            train_step, {"step": 0, "acc": 0},
-            self._segment2_batches(segment2_steps), self.ckpt,
-            save_every_steps=cadence,
-            install_signal_handler=False,
-            clock=self.clk,
-        )
-        while self.tick_index < self.total_ticks:
-            self._tick()
-        return self._summarize(cadence, report1, report2, state2)
+        try:
+            cadence = 5
+            state1, report1 = run_with_checkpointing(
+                train_step, {"step": 0, "acc": 0},
+                self._segment1_batches(), self.ckpt,
+                save_every_steps=cadence,
+                install_signal_handler=True,
+                clock=self.clk,
+            )
+            # Drain ack -> scale to zero -> serve-hi admits; then
+            # capacity regrows and train-lo re-admits.
+            self._ticks_until(self.REGROW_AT + 0.05)
+            segment2_steps = max(10, int(0.2 * self.total_ticks))
+            state2, report2 = run_with_checkpointing(
+                train_step, {"step": 0, "acc": 0},
+                self._segment2_batches(segment2_steps), self.ckpt,
+                save_every_steps=cadence,
+                install_signal_handler=False,
+                clock=self.clk,
+            )
+            while self.tick_index < self.total_ticks:
+                self._tick()
+            return self._summarize(cadence, report1, report2, state2)
+        finally:
+            self.server.stop()
 
     # ------------------------------------------------------------------
     def _summarize(self, cadence, report1, report2, state2) -> dict:

@@ -1,0 +1,769 @@
+"""Fleet-scale control-plane soak: 10k CRs of churn against the
+sharded control plane, chaos-gated (the ROADMAP item-3 acceptance arc).
+
+One seeded, replay-deterministic timeline drives the REAL notebook and
+inference controllers — two manager replicas behind per-shard leases
+(:class:`~kubeflow_tpu.controllers.leader.ShardedElector` +
+``ShardGate``), informer caches, priority-laned workqueues, batched
+status writes, and the slice-pool scheduler — through four phases:
+
+1. **Flood**: ``--crs`` Notebooks/InferenceServices (mixed slice
+   shapes, priorities, namespaces; one namespace TPU-quota'd) arrive
+   over the first 30% of ticks into a pool sized to ~60% of demand,
+   so the scheduler's gang-admission scan runs at fleet cardinality
+   with a deep queue.
+2. **Churn**: seeded create/update/delete/suspend/touch/preempt ops
+   every tick, plus a capacity dip-and-regrow. Deletes ride the
+   workqueue's fast lane; preempt arrivals (priority 100) drive the
+   checkpoint drain; suspends/touches drive scale-to-zero and
+   resurrect at scale.
+3. **Mid-soak lease revocation**: a shard lease is forcibly rewritten
+   to a foreign holder — the owner must step down (stop popping, drain
+   in-flight), and after expiry a replica with spare quota re-acquires
+   and resyncs the shard before reconciling it.
+4. **Chaos matrix** (the PR-2 schedule against the SHARDED
+   configuration): conflict storm, 5xx burst, full blackout, and
+   watch drop/dup/reorder/compaction — then informer ``recover()``
+   (the 410 re-list path) and ``run_to_convergence``.
+
+Gates: reconcile-duration and queue-wait burn-rate SLOs (PR 9) judged
+per replica — the flight recorder dumps on any breach — must be green
+in steady state; ZERO dual-leader reconciles (every reconcile is
+checked against the live shard-lease holder); zero orphaned CRs after
+convergence; scheduler incremental bookkeeping audits clean; and
+``replay_digest`` is byte-identical across runs.
+
+Determinism (the game-day constraints): every clock is the scenario
+clock; controllers and caches talk through a chaos proxy whose fault
+windows are op-indexed and EMPTY until the chaos phase; scenario ops
+(the "user" plane) and lease reads go to the plain store. Real-time
+quantities — reconcile durations, queue waits, SLO burn — are
+measured and gated but deliberately EXCLUDED from the digest, as are
+chaos-phase injection counts (retry interleaving shifts which call a
+fault hits, never the converged state the digest covers).
+
+Usage::
+
+  python -m loadtest.soak --crs 10000 --ticks 240 --shards 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule  # noqa: E402
+from kubeflow_tpu.chaos.harness import (  # noqa: E402
+    clamp_backoff,
+    run_to_convergence,
+)
+from kubeflow_tpu.controllers.inference import (  # noqa: E402
+    INFERENCE_API,
+    make_inference_controller,
+)
+from kubeflow_tpu.controllers.leader import (  # noqa: E402
+    LEASE_API,
+    ShardedElector,
+    shard_of,
+)
+from kubeflow_tpu.controllers.manager import (  # noqa: E402
+    make_default_slo_engine,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics  # noqa: E402
+from kubeflow_tpu.controllers.notebook import (  # noqa: E402
+    NOTEBOOK_API,
+    make_notebook_controller,
+)
+from kubeflow_tpu.controllers.runtime import (  # noqa: E402
+    InformerCache,
+    ShardGate,
+    StatusBatcher,
+)
+from kubeflow_tpu.controllers.time_utils import rfc3339  # noqa: E402
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound  # noqa: E402
+from kubeflow_tpu.obs.recorder import FlightRecorder  # noqa: E402
+from kubeflow_tpu.scheduler import (  # noqa: E402
+    PRIORITY_KEY,
+    SlicePoolScheduler,
+)
+
+LEASE_NAME = "soak"
+REVOKER = "chaos-revoker"
+
+# (topology, chips) mix: mostly single-host slices with a tail of
+# bigger gangs, so admission mixes trivial and chunky demands.
+TOPOLOGIES = [("1x1", 1)] * 6 + [("2x2", 4)] * 3 + [("2x4", 8)]
+PRIORITIES = (0, 0, 0, 0, 0, 0, 5, 5, 10, 10)
+
+
+class Clock:
+    """The injected scenario clock every component shares."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+def _notebook(ns: str, name: str, topology: str, priority: int) -> dict:
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "annotations": {PRIORITY_KEY: str(priority)},
+        },
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": topology},
+            "template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter-jax-tpu"},
+            ]}},
+        },
+    }
+
+
+def _inference(ns: str, name: str, topology: str, priority: int) -> dict:
+    return {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "metadata": {
+            "name": name, "namespace": ns,
+            "annotations": {PRIORITY_KEY: str(priority)},
+        },
+        "spec": {
+            "modelDir": "/models/prod",
+            "tpu": {"accelerator": "v5e", "topology": topology},
+        },
+    }
+
+
+class _RecordingReconciler:
+    """Wraps a reconciler to assert the dual-leader exclusion
+    invariant on EVERY reconcile: the replica performing it must be
+    the live holder of the key's shard lease."""
+
+    def __init__(self, inner, soak, identity: str):
+        self.inner = inner
+        self.soak = soak
+        self.identity = identity
+
+    def reconcile(self, req):
+        self.soak.record_reconcile(self.identity, req)
+        return self.inner.reconcile(req)
+
+
+class _Replica:
+    """One manager replica: its shard elector/gate, informer cache,
+    status batcher, metrics registry, SLO engine + flight recorder,
+    and the two workload controllers."""
+
+    def __init__(self, soak: "Soak", index: int):
+        self.identity = f"manager-{index}"
+        self.gate = ShardGate(soak.shards)
+        self.prom = ControllerMetrics()
+        self.cache = InformerCache(soak.handle)
+        self.batcher = StatusBatcher(soak.handle)
+        self.recorder = FlightRecorder(
+            capacity=4096, dump_dir=soak.dump_dir,
+            min_dump_interval_s=600.0, clock=soak.clk,
+            name=f"soak-{soak.seed}-{self.identity}",
+        )
+        nb = make_notebook_controller(
+            soak.handle, prom=self.prom, clock=soak.clk,
+            scheduler=soak.scheduler, cache=self.cache,
+            status_batcher=self.batcher, shard_gate=self.gate,
+        )
+        inf = make_inference_controller(
+            soak.handle, prom=self.prom, scheduler=soak.scheduler,
+            clock=soak.clk, cache=self.cache,
+            status_batcher=self.batcher, shard_gate=self.gate,
+        )
+        self.controllers = [nb, inf]
+        for ctrl in self.controllers:
+            ctrl.recorder = self.recorder
+            ctrl.reconciler = _RecordingReconciler(
+                ctrl.reconciler, soak, self.identity
+            )
+        self.slo = make_default_slo_engine(
+            self.prom, soak.handle, clock=soak.clk,
+            recorder=self.recorder,
+        )
+        # Leases live on the PLAIN store: the chaos matrix targets the
+        # controller plane; a blacked-out lease plane would dethrone
+        # every replica at once, which is a different experiment.
+        self.elector = ShardedElector(
+            soak.api, LEASE_NAME, self.identity, soak.shards,
+            lease_duration_s=2.0 * soak.tick_s,
+            clock=soak.clk, gate=self.gate,
+        )
+
+
+class Soak:
+    FLOOD_END = 0.30     # arrivals stop; pure churn begins
+    DIP_AT = 0.45        # capacity dips to 80%...
+    REGROW_AT = 0.65     # ...and returns
+    REVOKE_AT = 0.55     # a shard lease is forcibly rewritten
+
+    def __init__(self, seed: int = 11, crs: int = 10000,
+                 ticks: int = 240, tick_s: float = 30.0,
+                 shards: int = 4, replicas: int = 2,
+                 namespaces: int = 8, chaos: bool = True,
+                 dump_dir: str = "."):
+        self.seed = int(seed)
+        self.crs = int(crs)
+        self.ticks = int(ticks)
+        self.tick_s = float(tick_s)
+        self.shards = max(1, int(shards))
+        self.replica_count = max(1, int(replicas))
+        self.namespaces = max(1, int(namespaces))
+        self.chaos_enabled = bool(chaos)
+        self.dump_dir = dump_dir
+        self.clk = Clock(0.0)
+        self.rng = random.Random(self.seed)
+
+        # Pool sized to ~60% of expected demand (avg 2.6 chips/CR), so
+        # a deep queue forms; the quota'd namespace binds sooner.
+        avg_chips = sum(c for _, c in TOPOLOGIES) / len(TOPOLOGIES)
+        self.capacity = max(32, int(self.crs * avg_chips * 0.6))
+        day_s = self.ticks * self.tick_s
+        self.schedule = (
+            FaultSchedule(seed=self.seed)
+            .capacity(0.0, self.capacity)
+            .capacity(self.DIP_AT * day_s, int(self.capacity * 0.8),
+                      jitter_s=self.tick_s)
+            .capacity(self.REGROW_AT * day_s, self.capacity,
+                      jitter_s=self.tick_s)
+        )
+        self.api = FakeApiServer()
+        # Controllers/caches reach the store through the chaos proxy;
+        # its schedule holds NO fault windows until the chaos phase,
+        # so the deterministic phases see a clean passthrough while op
+        # counts accrue for the later window placement.
+        self.handle = ChaosApiServer(self.api, self.schedule,
+                                     sleep=lambda s: None)
+        self.scheduler = SlicePoolScheduler(
+            capacity_fn=lambda: self.schedule.capacity_at(self.clk()),
+            api=self.handle,
+            clock=self.clk,
+            aging_s=3600.0,
+            drain_grace_s=4.0 * self.tick_s,
+            enabled=True,
+        )
+        self.api.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "kf-resource-quota",
+                         "namespace": "ns-0"},
+            "spec": {"hard": {
+                "google.com/tpu": str(max(8, self.capacity // 10)),
+            }},
+        })
+        self.replicas = [_Replica(self, i)
+                         for i in range(self.replica_count)]
+
+        self.flood_end = max(1, int(self.FLOOD_END * self.ticks))
+        self.revoke_tick = int(self.REVOKE_AT * self.ticks)
+        self.ops_per_tick = max(1, self.crs // 200)
+        self.per_flood_tick = -(-self.crs // self.flood_end)  # ceil
+        self.tick_budget = max(500, (5 * self.crs) // max(1, self.ticks))
+
+        self.nb_counter = 0
+        self.inf_counter = 0
+        self.created = 0
+        self.deleted = 0
+        self.alive_nb: list[tuple[str, str]] = []
+        # Bounded by the seeded script's create budget.
+        # analysis: allow[py-unbounded-deque]
+        self.alive_inf: list[tuple[str, str]] = []
+        self.suspend_targets: list[tuple[str, str]] = []
+        # Seeded-script artifacts, all replay-covered by the digest.
+        # analysis: allow[py-unbounded-deque]
+        self.op_log: list[list] = []
+        # analysis: allow[py-unbounded-deque]
+        self.timeline: list[list] = []
+        # analysis: allow[py-unbounded-deque]
+        self.dual_violations: list[tuple] = []
+        self.reconcile_counts = {r.identity: 0 for r in self.replicas}
+
+    # ---- invariants ------------------------------------------------------
+    def _shard_lease_name(self, shard: int) -> str:
+        return (LEASE_NAME if self.shards == 1
+                else f"{LEASE_NAME}-shard-{shard}")
+
+    def lease_holder(self, shard: int) -> str | None:
+        try:
+            lease = self.api.get(LEASE_API, "Lease",
+                                 self._shard_lease_name(shard),
+                                 "kubeflow")
+        except NotFound:
+            return None
+        return (lease.get("spec") or {}).get("holderIdentity") or None
+
+    def record_reconcile(self, identity: str, req) -> None:
+        self.reconcile_counts[identity] += 1
+        shard = shard_of(req.namespace, req.name, self.shards)
+        holder = self.lease_holder(shard)
+        if holder != identity:
+            self.dual_violations.append(
+                (identity, holder, shard,
+                 f"{req.namespace}/{req.name}")
+            )
+
+    # ---- the scripted world ---------------------------------------------
+    def _create(self, tick: int) -> None:
+        ns = f"ns-{self.rng.randrange(self.namespaces)}"
+        topology, _chips = TOPOLOGIES[
+            self.rng.randrange(len(TOPOLOGIES))]
+        priority = PRIORITIES[self.rng.randrange(len(PRIORITIES))]
+        self.created += 1
+        if self.created % 40 == 0:
+            name = f"inf-{self.inf_counter:05d}"
+            self.inf_counter += 1
+            self.api.create(_inference(ns, name, topology, priority))
+            self.alive_inf.append((ns, name))
+            self.op_log.append([tick, "create-inf", ns, name,
+                                topology, priority])
+        else:
+            name = f"nb-{self.nb_counter:05d}"
+            self.nb_counter += 1
+            self.api.create(_notebook(ns, name, topology, priority))
+            self.alive_nb.append((ns, name))
+            self.op_log.append([tick, "create-nb", ns, name,
+                                topology, priority])
+
+    def _churn(self, tick: int) -> None:
+        for _ in range(self.ops_per_tick):
+            roll = self.rng.random()
+            if roll < 0.15:
+                self._create(tick)
+            elif roll < 0.28 and self.alive_nb:
+                i = self.rng.randrange(len(self.alive_nb))
+                ns, name = self.alive_nb[i]
+                self.alive_nb[i] = self.alive_nb[-1]
+                self.alive_nb.pop()
+                try:
+                    self.api.delete(NOTEBOOK_API, "Notebook", name, ns)
+                except NotFound:
+                    pass
+                self.deleted += 1
+                self.op_log.append([tick, "delete-nb", ns, name])
+            elif roll < 0.38 and self.alive_nb:
+                ns, name = self.alive_nb[
+                    self.rng.randrange(len(self.alive_nb))]
+                started = self.scheduler.mark_reclaimable(
+                    "Notebook", ns, name, now=self.clk())
+                if started:
+                    self.suspend_targets.append((ns, name))
+                self.op_log.append(
+                    [tick, "suspend", ns, name, int(started)])
+            elif roll < 0.44 and self.suspend_targets:
+                i = self.rng.randrange(len(self.suspend_targets))
+                ns, name = self.suspend_targets[i]
+                woke = self.scheduler.touch("Notebook", ns, name,
+                                            now=self.clk())
+                if woke:
+                    self.suspend_targets.pop(i)
+                self.op_log.append([tick, "touch", ns, name, int(woke)])
+            elif roll < 0.50:
+                # Priority-100 arrival: preempts through the drain.
+                ns = f"ns-{self.rng.randrange(self.namespaces)}"
+                name = f"nb-{self.nb_counter:05d}"
+                self.nb_counter += 1
+                self.api.create(_notebook(ns, name, "2x4", 100))
+                self.alive_nb.append((ns, name))
+                self.op_log.append([tick, "preempt-arrival", ns, name])
+            elif self.alive_nb:
+                ns, name = self.alive_nb[
+                    self.rng.randrange(len(self.alive_nb))]
+                try:
+                    self.api.patch_merge(
+                        NOTEBOOK_API, "Notebook", name,
+                        {"metadata": {"annotations": {
+                            "soak.kubeflow-tpu.org/gen": str(tick),
+                        }}},
+                        ns,
+                    )
+                except NotFound:
+                    pass
+                self.op_log.append([tick, "update", ns, name])
+
+    def _revoke(self, tick: int) -> None:
+        """Forcibly rewrite the highest shard's lease to a foreign
+        holder: the owner must step down on observation; a replica
+        with spare quota re-acquires after expiry and resyncs."""
+        shard = self.shards - 1
+        name = self._shard_lease_name(shard)
+        try:
+            lease = self.api.get(LEASE_API, "Lease", name, "kubeflow")
+        except NotFound:
+            return
+        victim = (lease.get("spec") or {}).get("holderIdentity")
+        lease["spec"]["holderIdentity"] = REVOKER
+        lease["spec"]["renewTime"] = rfc3339(int(self.clk()))
+        self.api.update(lease)
+        self.op_log.append([tick, "revoke-lease", shard, victim])
+
+    # ---- drive -----------------------------------------------------------
+    def _run_controllers(self, budget: int | None = None) -> int:
+        worked = 0
+        for replica in self.replicas:
+            for ctrl in replica.controllers:
+                worked += ctrl.run_once(
+                    max_iterations=budget or self.tick_budget)
+        return worked
+
+    def _elector_rounds(self) -> None:
+        for replica in self.replicas:
+            replica.elector.try_acquire_or_renew()
+
+    def _sample(self, tick: int) -> None:
+        pool = self.scheduler.pool_snapshot()
+        self.timeline.append([
+            tick,
+            self.created,
+            self.deleted,
+            pool["used_chips"],
+            pool["queued"],
+            pool["suspended"],
+            [sorted(r.elector.owned()) for r in self.replicas],
+            [sum(len(c.queue) for c in r.controllers)
+             for r in self.replicas],
+        ])
+
+    def _tick(self, tick: int) -> None:
+        now = self.clk.advance(self.tick_s)
+        if tick < self.flood_end:
+            for _ in range(self.per_flood_tick):
+                if self.created < self.crs:
+                    self._create(tick)
+        else:
+            self._churn(tick)
+        if tick == self.revoke_tick:
+            self._revoke(tick)
+        self._elector_rounds()
+        self._run_controllers()
+        self.scheduler.tick(now)
+        for replica in self.replicas:
+            replica.slo.tick(now)
+        if tick % 5 == 0 or tick == self.ticks - 1:
+            self._sample(tick)
+
+    def _cooldown(self) -> None:
+        """Fast-forward the scenario clock past the slowest burn
+        window plus its clear hysteresis (6h + 30m), SLO-ticking along
+        the way: "steady state" then means any flood-era burn has had
+        every chance to resolve — an alert still firing afterwards is
+        a genuine steady-state breach, not leftover history."""
+        horizon_s = 21600.0 + 1800.0
+        for _ in range(int(horizon_s / self.tick_s) + 1):
+            now = self.clk.advance(self.tick_s)
+            self._elector_rounds()  # leases stay fresh while we wait
+            for replica in self.replicas:
+                replica.slo.tick(now)
+        self.scheduler.tick(self.clk())
+
+    def _drain(self, max_rounds: int = 300) -> int:
+        """Post-churn settle: advance ticks (drain deadlines must be
+        able to expire) until no controller has work left."""
+        for round_no in range(max_rounds):
+            self.clk.advance(self.tick_s)
+            self._elector_rounds()
+            worked = self._run_controllers(budget=self.tick_budget * 4)
+            self.scheduler.tick(self.clk())
+            pending = sum(
+                len(ctrl.queue)
+                for replica in self.replicas
+                for ctrl in replica.controllers
+            )
+            if worked == 0 and pending == 0:
+                return round_no + 1
+        raise AssertionError(
+            f"soak did not settle within {max_rounds} drain rounds"
+        )
+
+    # ---- chaos matrix (sharded configuration) ----------------------------
+    def _chaos(self) -> dict:
+        base = self.handle.ops_total
+        storm = 800
+        self.schedule.conflict_storm(base, base + storm, rate=0.25)
+        self.schedule.errors(base + storm, base + storm + 400,
+                             rate=0.3, status=503)
+        self.schedule.blackout(base + storm + 400, base + storm + 520)
+        self.schedule.watch_faults(drop=0.05, dup=0.05, reorder=0.05,
+                                   compact=0.3, max_compactions=2)
+        all_ctrls = [ctrl for replica in self.replicas
+                     for ctrl in replica.controllers]
+        for ctrl in all_ctrls:
+            clamp_backoff(ctrl)
+        # Push the op counter through the storm windows with bounded
+        # rounds; retries inside shift which CALL a fault hits, never
+        # the converged state asserted below.
+        for _ in range(30):
+            for ctrl in all_ctrls:
+                ctrl.resync()
+                ctrl.run_once(max_iterations=500)
+            if self.handle.ops_total >= base + storm + 520:
+                break
+        # Stream damage off, informer watch-resume repair (the 410 /
+        # compaction re-list path), then provable convergence.
+        self.schedule.clear_watch_faults()
+        relists = sum(r.cache.recover() for r in self.replicas)
+        rounds = run_to_convergence(
+            all_ctrls, max_rounds=600,
+            # Every resync re-enqueues the whole keyspace: the
+            # per-round budget must cover it or the queue never
+            # drains at fleet cardinality.
+            run_once_iterations=self.crs + 200,
+        )
+        return {
+            "injected": dict(self.handle.injected),
+            "cache_relists": relists,
+            "convergence_rounds": rounds,
+        }
+
+    # ---- asserts / summary ----------------------------------------------
+    def _orphans(self) -> dict:
+        """Zero-orphan audit: every CR has its same-name StatefulSet
+        owned by its uid; every owned child has a live owner."""
+        problems: list[str] = []
+        live_uids = {}
+        for api_version, kind, pairs in (
+            (NOTEBOOK_API, "Notebook", self.alive_nb),
+            (INFERENCE_API, "InferenceService", self.alive_inf),
+        ):
+            for obj in self.api.list(api_version, kind):
+                meta = obj["metadata"]
+                live_uids[meta["uid"]] = (
+                    f"{kind}/{meta.get('namespace')}/{meta['name']}"
+                )
+            for ns, name in pairs:
+                try:
+                    cr = self.api.get(api_version, kind, name, ns)
+                except NotFound:
+                    problems.append(f"{kind} {ns}/{name} vanished")
+                    continue
+                try:
+                    sts = self.api.get("apps/v1", "StatefulSet", name,
+                                       ns)
+                except NotFound:
+                    problems.append(
+                        f"{kind} {ns}/{name} has no StatefulSet")
+                    continue
+                refs = (sts["metadata"].get("ownerReferences")) or []
+                if not any(r.get("uid") == cr["metadata"]["uid"]
+                           for r in refs):
+                    problems.append(
+                        f"StatefulSet {ns}/{name} not owned by its CR")
+        for child_kind in ("StatefulSet",):
+            for sts in self.api.list("apps/v1", child_kind):
+                refs = (sts["metadata"].get("ownerReferences")) or []
+                for ref in refs:
+                    if ref.get("uid") and ref["uid"] not in live_uids:
+                        problems.append(
+                            f"{child_kind} "
+                            f"{sts['metadata'].get('namespace')}/"
+                            f"{sts['metadata']['name']} orphaned"
+                        )
+        return {"count": len(problems), "sample": problems[:10]}
+
+    # Server-assigned identity, wall-clock stamps, and event-mirror
+    # blocks (status.warningEvents embeds Events — whose CreateFailed
+    # membership depends on which exact call a chaos fault hit).
+    _SCRUB_KEYS = frozenset((
+        "uid", "resourceVersion", "creationTimestamp",
+        "warningEvents", "firstTimestamp", "lastTimestamp",
+    ))
+
+    def _scrub(self, obj):
+        if isinstance(obj, dict):
+            return {
+                k: self._scrub(v) for k, v in obj.items()
+                if k not in self._SCRUB_KEYS
+            }
+        if isinstance(obj, list):
+            return [self._scrub(v) for v in obj]
+        return obj
+
+    def _store_fingerprint(self) -> str:
+        """Digest of the converged world: every stored object except
+        Events (fault-retry dependent counts) and Leases (election
+        timing), scrubbed of server-assigned identity."""
+        doc = {}
+        for api_version, kind in (
+            (NOTEBOOK_API, "Notebook"),
+            (INFERENCE_API, "InferenceService"),
+            ("apps/v1", "StatefulSet"),
+            ("v1", "Service"),
+        ):
+            doc[kind] = [self._scrub(o)
+                         for o in self.api.list(api_version, kind)]
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()
+        ).hexdigest()
+
+    def _slo_block(self) -> dict:
+        gating = {"reconcile-duration", "queue-wait"}
+        per_replica = {}
+        green = True
+        for replica in self.replicas:
+            replica.slo.tick(self.clk())
+            firing = sorted(
+                f"{a['slo']}/{a['speed']}"
+                for a in replica.slo.alerts.active()
+                if a.get("state") == "firing"
+            )
+            if any(f.split("/")[0] in gating for f in firing):
+                green = False
+            queue_wait = None
+            for ctrl in replica.controllers:
+                snap = ctrl.queue.latency_snapshot()
+                if queue_wait is None or (snap["p99"] or 0) > queue_wait:
+                    queue_wait = snap["p99"]
+            per_replica[replica.identity] = {
+                "firing": firing,
+                "queue_wait_p99_s": queue_wait,
+                "reconciles": self.reconcile_counts[replica.identity],
+                "flight_dumps": replica.recorder.dumps_total,
+            }
+        return {"steady_state_green": green, "replicas": per_replica}
+
+    def run(self) -> dict:
+        for tick in range(self.ticks):
+            self._tick(tick)
+        drain_rounds = self._drain()
+        self._cooldown()
+        slo = self._slo_block()  # judged BEFORE chaos: steady state
+        chaos = self._chaos() if self.chaos_enabled else None
+        orphans = self._orphans()
+        audit = self.scheduler.audit()
+        fingerprint = self._store_fingerprint()
+        ownership = [sorted(r.elector.owned()) for r in self.replicas]
+        cache_stats = {r.identity: r.cache.stats()
+                       for r in self.replicas}
+        digest_payload = {
+            "ops": self.op_log,
+            "timeline": self.timeline,
+            "counters": self.scheduler.metrics.counters(),
+            "pool": self.scheduler.pool_snapshot(),
+            "fingerprint": fingerprint,
+            "ownership": ownership,
+            "violations": len(self.dual_violations),
+            "orphans": orphans["count"],
+        }
+        digest = hashlib.sha256(
+            json.dumps(digest_payload, sort_keys=True).encode()
+        ).hexdigest()
+        return {
+            "kind": "soak",
+            "seed": self.seed,
+            "crs": self.crs,
+            "ticks": self.ticks,
+            "tick_s": self.tick_s,
+            "shards": self.shards,
+            "replicas": self.replica_count,
+            "capacity_chips": self.capacity,
+            "created": self.created,
+            "deleted": self.deleted,
+            "drain_rounds": drain_rounds,
+            "counters": self.scheduler.metrics.counters(),
+            "pool": self.scheduler.pool_snapshot(),
+            "slo": slo,
+            "chaos": chaos,
+            "dual_leader_reconciles": len(self.dual_violations),
+            "dual_leader_sample": self.dual_violations[:5],
+            "lease_revocations": sum(
+                1 for op in self.op_log if op[1] == "revoke-lease"),
+            "orphans": orphans,
+            "scheduler_audit": audit,
+            "ownership": ownership,
+            "reconciles": dict(self.reconcile_counts),
+            "cache": cache_stats,
+            "store_fingerprint": fingerprint,
+            "replay_digest": digest,
+        }
+
+
+def run_soak(**kwargs) -> dict:
+    return Soak(**kwargs).run()
+
+
+def problems_in(summary: dict) -> list[str]:
+    """The acceptance checklist the CLI gates on (shared with the
+    test suite so both judge one contract)."""
+    problems = []
+    if summary["dual_leader_reconciles"]:
+        problems.append(
+            f"dual-leader reconciles: {summary['dual_leader_sample']}")
+    if summary["orphans"]["count"]:
+        problems.append(f"orphaned CRs: {summary['orphans']['sample']}")
+    if summary["scheduler_audit"]:
+        problems.append(
+            f"scheduler bookkeeping drift: {summary['scheduler_audit']}")
+    if not summary["slo"]["steady_state_green"]:
+        problems.append("reconcile/queue-wait SLO firing in steady state")
+    if summary["created"] < summary["crs"]:
+        problems.append("flood never reached the CR target")
+    if summary["counters"]["admissions_total"] < 1:
+        problems.append("nothing ever admitted")
+    if summary["counters"]["preemptions_total"] < 1 \
+            and summary["crs"] >= 50:
+        problems.append("no preemption recorded")
+    if summary["lease_revocations"] < 1:
+        problems.append("the mid-soak lease revocation never fired")
+    if summary["chaos"] is not None:
+        injected = summary["chaos"]["injected"]
+        for kind in ("conflict", "error", "blackout"):
+            if injected.get(kind, 0) < 1:
+                problems.append(f"chaos {kind} never fired")
+        if injected.get("watch_compacted", 0) < 1:
+            problems.append("watch compaction never fired")
+    shards_owned = {s for owned in summary["ownership"] for s in owned}
+    if len(shards_owned) != summary["shards"]:
+        problems.append(
+            f"not every shard owned at end: {summary['ownership']}")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay-deterministic fleet-scale control-plane "
+        "soak: sharded managers, informer caches, scheduler-gated "
+        "churn, chaos matrix.")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--crs", type=int, default=10000)
+    parser.add_argument("--ticks", type=int, default=240)
+    parser.add_argument("--tick-s", type=float, default=30.0)
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--namespaces", type=int, default=8)
+    parser.add_argument("--no-chaos", action="store_true")
+    parser.add_argument("--dump-dir", default=".")
+    args = parser.parse_args(argv)
+    summary = run_soak(
+        seed=args.seed, crs=args.crs, ticks=args.ticks,
+        tick_s=args.tick_s, shards=args.shards,
+        replicas=args.replicas, namespaces=args.namespaces,
+        chaos=not args.no_chaos, dump_dir=args.dump_dir,
+    )
+    compact = {k: v for k, v in summary.items()
+               if k not in ("cache",)}
+    print(json.dumps(compact))
+    problems = problems_in(summary)
+    if problems:
+        print("SOAK FAILED: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+#!/usr/bin/env bash
+# Fleet-scale sharded control-plane gate for CI (PR 13). Four checks:
+#
+# 1. Sharding/informer tier-1 subset: tests/test_shard.py fast set —
+#    per-shard lease quota + rebalance on membership change, the
+#    drain-before-release handoff, the revoked-lease step-down, the
+#    shard-gated controller (enqueue/pop filters + successor resync),
+#    the informer cache (indexes, rv discipline, 410 re-list
+#    recovery), workqueue priority lanes, batched status writes, the
+#    KFT_SHARDS=1 byte-identity pin, the POST /touch resurrect
+#    surface, the informer-backed capacity_fn, and the small soak
+#    acceptance arc with byte-identical replay — plus the
+#    py-list-in-reconcile rule fixtures in tests/test_analysis.py.
+#
+# 2. One-shard smoke: KFT_SHARDS unset/1 must resolve to the classic
+#    single-leader manager (plain LeaderElector, no gate).
+#
+# 3. Analysis: the controllers package holds ZERO findings under
+#    every pack — including the new py-list-in-reconcile rule — and
+#    the full kubeflow_tpu package stays clean.
+#
+# 4. RUN_SLOW=1: loadtest/soak.py --crs 10000 via the CLI (its exit
+#    code gates the acceptance checklist: SLOs green in steady state,
+#    zero dual-leader reconciles, zero orphans, chaos matrix + lease
+#    revocation survived, byte-identical replay digest) and the
+#    SLO/churn JSON artifact is asserted — including the sharded
+#    chaos subset counters.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== soak gate: sharding/informer tier-1 subset =="
+python -m pytest -q -p no:cacheprovider -m 'not slow' \
+  tests/test_shard.py \
+  "tests/test_analysis.py::TestListInReconcileRule"
+
+echo "== soak gate: one-shard smoke =="
+python - <<'PY'
+import os
+
+os.environ.pop("KFT_SHARDS", None)
+from kubeflow_tpu.controllers.leader import ShardedElector, shard_count
+from kubeflow_tpu.controllers.manager import Manager
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+assert shard_count() == 1, "unset KFT_SHARDS must mean one shard"
+api = FakeApiServer()
+manager = Manager(api, [make_notebook_controller(api)],
+                  leader_elect=True, identity="m1", http_port=None)
+assert not isinstance(manager.elector, ShardedElector)
+assert manager.shard_gate is None
+print("  KFT_SHARDS=1: classic single-leader manager")
+PY
+
+echo "== soak gate: zero analysis findings (all packs) =="
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu/controllers"], check_emitted=False,
+))
+if findings:
+    for f in findings:
+        print(f.render())
+    raise SystemExit(
+        f"{len(findings)} finding(s) in kubeflow_tpu/controllers/"
+    )
+whole = analyze_paths(AnalysisConfig(
+    paths=["kubeflow_tpu"], check_emitted=False,
+))
+if whole:
+    for f in whole:
+        print(f.render())
+    raise SystemExit(
+        f"{len(whole)} finding(s) in kubeflow_tpu/ under the full "
+        "pack set (incl. py-list-in-reconcile)"
+    )
+print("  kubeflow_tpu/ (incl. controllers/): zero findings, all packs")
+PY
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "== soak gate: 10k-CR soak (sharded, chaos-gated) =="
+  artifact="${SOAK_SUMMARY_JSON:-soak-summary.json}"
+  python -m loadtest.soak --crs 10000 --ticks 240 --shards 4 \
+    --replicas 2 --dump-dir . | tee "$artifact"
+  python - "$artifact" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    doc = json.loads(fh.read().strip().splitlines()[-1])
+assert doc["kind"] == "soak", doc
+assert doc["created"] >= 10000
+assert doc["dual_leader_reconciles"] == 0
+assert doc["orphans"]["count"] == 0
+assert doc["scheduler_audit"] == {}
+assert doc["slo"]["steady_state_green"] is True
+assert doc["lease_revocations"] >= 1
+chaos = doc["chaos"]
+assert chaos["injected"]["conflict"] >= 1
+assert chaos["injected"]["blackout"] >= 1
+assert chaos["injected"]["watch_compacted"] >= 1
+assert doc["replay_digest"]
+print(f"  soak artifact ok: {doc['counters']}, "
+      f"convergence {chaos['convergence_rounds']} rounds, "
+      f"digest {doc['replay_digest'][:12]}…")
+PY
+fi
+
+echo "soak gate OK"

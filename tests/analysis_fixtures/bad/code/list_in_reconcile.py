@@ -1,0 +1,24 @@
+"""Seeded py-list-in-reconcile violations: per-reconcile LISTs while
+an informer cache sits unused in class scope (3 hits: lines 12, 13,
+24)."""
+
+
+class PodListingReconciler:
+    def __init__(self, api, cache):
+        self.api = api
+        self.cache = cache
+
+    def reconcile(self, req):
+        pods = self.api.list("v1", "Pod", namespace=req.namespace)
+        stss, rv, _ = self.api.list_with_rv("apps/v1", "StatefulSet")
+        return pods, stss, rv
+
+
+class NodeScanReconciler:
+    def __init__(self, client, node_informer):
+        self.client = client
+        self.node_informer = node_informer
+
+    def node_reconcile(self, req):
+        # The informer holds the Node inventory; this re-LISTs it.
+        return self.client.list("v1", "Node")

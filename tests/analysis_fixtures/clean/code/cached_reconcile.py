@@ -1,0 +1,43 @@
+"""Clean counterparts for py-list-in-reconcile: informer reads on the
+reconcile path, LIST fallbacks in off-path helpers, classes with no
+cache in scope, and the pragma escape."""
+
+
+class CachedReconciler:
+    def __init__(self, api, cache):
+        self.api = api
+        self.cache = cache
+
+    def reconcile(self, req):
+        # Reading the informer's indexes IS the discipline; point gets
+        # are O(1) and never flagged.
+        pods = self.cache.list("v1", "Pod", namespace=req.namespace)
+        self.api.get("v1", "Pod", f"{req.name}-0", req.namespace)
+        return pods
+
+    def _list_pods(self, req):
+        # Cache-or-LIST fallback helper: off the reconcile path.
+        source = self.cache if self.cache is not None else self.api
+        return source.list("v1", "Pod", namespace=req.namespace)
+
+
+class PlainReconciler:
+    def __init__(self, api):
+        self.api = api
+
+    def reconcile(self, req):
+        # No informer/cache in scope: the LIST is this class's only
+        # read path — not this rule's business.
+        return self.api.list("v1", "Pod", namespace=req.namespace)
+
+
+class QuorumReconciler:
+    def __init__(self, api, cache):
+        self.api = api
+        self.cache = cache
+
+    def reconcile(self, req):
+        # Deliberate strong read (quorum LIST before a destructive
+        # decision), documented and annotated.
+        # analysis: allow[py-list-in-reconcile]
+        return self.api.list("v1", "Pod", namespace=req.namespace)

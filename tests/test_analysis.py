@@ -583,6 +583,116 @@ class TestUnboundedActuationRule:
         ) == 1
 
 
+class TestListInReconcileRule:
+    """py-list-in-reconcile: LIST-shaped client calls on the reconcile
+    path of a class that holds an informer/cache (PR 13 — the informer
+    discipline the 10k-CR soak depends on)."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-list-in-reconcile",
+                  "list_in_reconcile.py")
+        assert sorted(f.line for f in hits) == [12, 13, 24]
+        assert all(f.severity == Severity.WARNING for f in hits)
+        by_line = {f.line: f.message for f in hits}
+        assert "'cache'" in by_line[12]
+        assert "list_with_rv" in by_line[13]
+        assert "'node_informer'" in by_line[24]
+
+    def test_clean_fixture_is_silent(self):
+        clean = os.path.join(CLEAN, "code", "cached_reconcile.py")
+        findings = analyze_paths(
+            AnalysisConfig(paths=[clean], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-list-in-reconcile"] == []
+
+    def _findings(self, source, path="kubeflow_tpu/controllers/x.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-list-in-reconcile"
+        ]
+
+    def test_cache_read_on_reconcile_path_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, cache):\n"
+            "        self.api = api\n"
+            "        self.cache = cache\n"
+            "    def reconcile(self, req):\n"
+            "        return self.cache.list('v1', 'Pod')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_no_cache_in_scope_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api):\n"
+            "        self.api = api\n"
+            "    def reconcile(self, req):\n"
+            "        return self.api.list('v1', 'Pod')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_helper_off_reconcile_path_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, cache):\n"
+            "        self.api = api\n"
+            "        self.cache = cache\n"
+            "    def _list_pods(self, req):\n"
+            "        return self.api.list('v1', 'Pod')\n"
+        )
+        assert self._findings(src) == []
+
+    def test_init_param_alone_marks_scope(self):
+        # An informer handed to __init__ but stored under another name
+        # still marks the class as informer-equipped.
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, pod_informer):\n"
+            "        self.api = api\n"
+            "        self.reads = pod_informer\n"
+            "    def reconcile(self, req):\n"
+            "        return self.api.list('v1', 'Pod')\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 6
+        assert "'pod_informer'" in f.message
+
+    def test_plain_list_builtin_is_clean(self):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, cache):\n"
+            "        self.api = api\n"
+            "        self.cache = cache\n"
+            "    def reconcile(self, req):\n"
+            "        out = []\n"
+            "        out.append(1)\n"
+            "        return list(out)\n"
+        )
+        assert self._findings(src) == []
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "class A:\n"
+            "    def __init__(self, api, cache):\n"
+            "        self.api = api\n"
+            "        self.cache = cache\n"
+            "    def reconcile(self, req):\n"
+            "        # analysis: allow[py-list-in-reconcile]\n"
+            "        return self.api.list('v1', 'Pod')\n"
+        )
+        target = tmp_path / "pragma_list.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-list-in-reconcile"] == []
+
+
 class TestUnboundedQueueAdmissionRule:
     """py-unbounded-queue-admission: admission/scheduling loops over a
     work queue must carry an ordering key and a quota/capacity check

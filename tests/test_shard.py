@@ -1,0 +1,751 @@
+"""Sharded control plane: per-shard leases with disciplined handoff,
+informer caches with 410 re-list recovery, workqueue priority lanes,
+batched status writes, and the fleet soak's acceptance arc (PR 13).
+
+The invariants pinned here: a lost/released lease drains the in-flight
+reconcile BEFORE the successor can take over; a successor resyncs a
+freshly acquired shard before reconciling it; no key is ever
+reconciled by a replica that does not hold its shard lease — even
+under a chaos conflict storm with a mid-soak lease revocation; and
+``KFT_SHARDS=1`` (cache + batcher on, sharding off) produces a store
+byte-identical to the pre-shard control plane."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule
+from kubeflow_tpu.controllers.leader import (
+    LEASE_API,
+    ShardedElector,
+    shard_count,
+    shard_of,
+)
+from kubeflow_tpu.controllers.manager import Manager
+from kubeflow_tpu.controllers.metrics import ControllerMetrics, ManagerServer
+from kubeflow_tpu.controllers.notebook import (
+    NOTEBOOK_API,
+    make_notebook_controller,
+)
+from kubeflow_tpu.controllers.runtime import (
+    LANE_DEFAULT,
+    LANE_FAST,
+    InformerCache,
+    Request,
+    ShardGate,
+    StatusBatcher,
+    WorkQueue,
+    lane_for_event,
+)
+from kubeflow_tpu.k8s.fake import FakeApiServer, NotFound
+from kubeflow_tpu.scheduler import (
+    SlicePoolScheduler,
+    node_inventory_capacity,
+)
+
+
+class Clock:
+    def __init__(self, t=1_800_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+        return self.t
+
+
+def notebook_cr(name, ns="user", topology=None):
+    spec = {
+        "template": {"spec": {"containers": [
+            {"name": "notebook", "image": "jupyter-jax-tpu"},
+        ]}},
+    }
+    if topology:
+        spec["tpu"] = {"accelerator": "v5e", "topology": topology}
+    return {
+        "apiVersion": NOTEBOOK_API,
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+# ---------------------------------------------------------------------------
+# shard hashing
+# ---------------------------------------------------------------------------
+
+
+class TestShardOf:
+    def test_stable_across_processes(self):
+        # sha1-derived, NOT salted hash(): every replica must agree.
+        assert shard_of("user", "nb-1", 4) == shard_of("user", "nb-1", 4)
+        assert shard_of("user", "nb-1", 1) == 0
+
+    def test_all_shards_reachable(self):
+        shards = {shard_of("ns", f"nb-{i}", 4) for i in range(64)}
+        assert shards == {0, 1, 2, 3}
+
+    def test_env_shard_count(self, monkeypatch):
+        monkeypatch.delenv("KFT_SHARDS", raising=False)
+        assert shard_count() == 1
+        monkeypatch.setenv("KFT_SHARDS", "8")
+        assert shard_count() == 8
+        monkeypatch.setenv("KFT_SHARDS", "junk")
+        assert shard_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# workqueue priority lanes
+# ---------------------------------------------------------------------------
+
+
+class TestWorkQueueLanes:
+    def test_fast_lane_pops_first(self):
+        q = WorkQueue()
+        q.add(Request("ns", "slow"))
+        q.add(Request("ns", "urgent"), lane=LANE_FAST)
+        assert q.pop_ready() == Request("ns", "urgent")
+        assert q.pop_ready() == Request("ns", "slow")
+
+    def test_lane_upgrade_never_demotes(self):
+        q = WorkQueue()
+        q.add(Request("ns", "a"))
+        q.add(Request("ns", "a"), lane=LANE_FAST)  # upgrade
+        q.add(Request("ns", "a"))                  # no demote
+        q.add(Request("ns", "b"), lane=LANE_FAST)
+        assert q.pop_ready() == Request("ns", "a")
+        assert q.pop_ready() == Request("ns", "b")
+        assert q.pop_ready() is None
+        assert len(q) == 0
+
+    def test_accept_defers_without_losing(self):
+        q = WorkQueue()
+        mine = Request("ns", "mine")
+        theirs = Request("ns", "theirs")
+        q.add(theirs)
+        q.add(mine)
+        popped = q.pop_ready(accept=lambda r: r is mine)
+        assert popped == mine
+        assert len(q) == 1  # theirs still pending
+        assert q.pop_ready() == theirs
+
+    def test_drop_removes_pending(self):
+        q = WorkQueue()
+        q.add(Request("ns", "a"))
+        q.add(Request("ns", "b"), lane=LANE_FAST)
+        assert q.drop(lambda r: r.name == "b") == 1
+        assert q.pop_ready() == Request("ns", "a")
+        assert q.pop_ready() is None
+
+    def test_lane_classification(self):
+        assert lane_for_event("DELETED", {}) == LANE_FAST
+        assert lane_for_event("MODIFIED", {"metadata": {
+            "deletionTimestamp": "2026-01-01T00:00:00Z"}}) == LANE_FAST
+        assert lane_for_event("MODIFIED", {"metadata": {"annotations": {
+            "scheduling.kubeflow-tpu.org/preempt-requested": "x",
+        }}}) == LANE_FAST
+        assert lane_for_event("ADDED", {"metadata": {}}) == LANE_DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# sharded elector: quota, rebalance, revocation, drain-before-release
+# ---------------------------------------------------------------------------
+
+
+class TestShardedElector:
+    def test_single_replica_owns_everything(self):
+        api = FakeApiServer()
+        clk = Clock()
+        e = ShardedElector(api, "nbc", "m1", 4, clock=clk)
+        assert e.try_acquire_or_renew() == frozenset({0, 1, 2, 3})
+        assert e.is_leader
+
+    def test_membership_growth_rebalances(self):
+        api = FakeApiServer()
+        clk = Clock()
+        e1 = ShardedElector(api, "nbc", "m1", 4, clock=clk)
+        e2 = ShardedElector(api, "nbc", "m2", 4, clock=clk)
+        assert e1.try_acquire_or_renew() == frozenset({0, 1, 2, 3})
+        # m2 heartbeats and sees nothing free yet.
+        assert e2.try_acquire_or_renew() == frozenset()
+        # m1 sees the new member, shrinks to its fair share (highest
+        # shards released first), m2 picks up the released pair.
+        assert e1.try_acquire_or_renew() == frozenset({0, 1})
+        assert e2.try_acquire_or_renew() == frozenset({2, 3})
+        # Steady state holds.
+        assert e1.try_acquire_or_renew() == frozenset({0, 1})
+        assert e2.try_acquire_or_renew() == frozenset({2, 3})
+
+    def test_one_shard_uses_bare_lease_name(self):
+        api = FakeApiServer()
+        e = ShardedElector(api, "nbc", "m1", 1, clock=Clock())
+        e.try_acquire_or_renew()
+        lease = api.get(LEASE_API, "Lease", "nbc", "kubeflow")
+        assert lease["spec"]["holderIdentity"] == "m1"
+
+    def test_revoked_lease_steps_down_then_reacquired(self):
+        api = FakeApiServer()
+        clk = Clock()
+        e1 = ShardedElector(api, "nbc", "m1", 2, clock=clk,
+                            lease_duration_s=15.0)
+        e2 = ShardedElector(api, "nbc", "m2", 2, clock=clk,
+                            lease_duration_s=15.0)
+        e1.try_acquire_or_renew()
+        e2.try_acquire_or_renew()
+        e1.try_acquire_or_renew()
+        e2.try_acquire_or_renew()
+        assert e1.owned() and e2.owned()
+        victim_shard = sorted(e2.owned())[0]
+        lease = api.get(LEASE_API, "Lease",
+                        f"nbc-shard-{victim_shard}", "kubeflow")
+        lease["spec"]["holderIdentity"] = "chaos-revoker"
+        api.update(lease)
+        # The owner observes the foreign holder and steps down.
+        assert victim_shard not in e2.try_acquire_or_renew()
+        # Nobody can take it until the revoker's lease expires...
+        assert victim_shard not in e1.try_acquire_or_renew()
+        clk.advance(20)
+        e1.try_acquire_or_renew()
+        e2.try_acquire_or_renew()
+        owned_now = e1.owned() | e2.owned()
+        assert victim_shard in owned_now
+
+    def test_clean_release_deregisters_membership(self):
+        # A cleanly stopped replica deletes its member heartbeat: the
+        # survivor's fair-share quota grows IMMEDIATELY — no waiting
+        # out the membership expiry window (only a crash-stop does).
+        api = FakeApiServer()
+        clk = Clock()
+        e1 = ShardedElector(api, "nbc", "m1", 4, clock=clk)
+        e2 = ShardedElector(api, "nbc", "m2", 4, clock=clk)
+        for _ in range(2):
+            e1.try_acquire_or_renew()
+            e2.try_acquire_or_renew()
+        assert len(e1.owned()) == 2 and len(e2.owned()) == 2
+        e2.release()
+        assert e2.owned() == frozenset()
+        assert e1.try_acquire_or_renew() == frozenset({0, 1, 2, 3})
+
+    def test_release_drains_in_flight_reconcile_first(self):
+        api = FakeApiServer()
+        clk = Clock()
+        gate = ShardGate(2)
+        observed = []
+
+        e = ShardedElector(api, "nbc", "m1", 2, clock=clk, gate=gate)
+        e.try_acquire_or_renew()
+        req = Request("user", "nb-drain")
+        shard = gate.begin(req)  # reconcile in flight
+
+        def sleep(_dt):
+            # While the reconcile is in flight, the lease MUST still
+            # be held — the successor must not be able to acquire.
+            lease = api.get(LEASE_API, "Lease", f"nbc-shard-{shard}",
+                            "kubeflow")
+            observed.append(lease["spec"]["holderIdentity"])
+            gate.end(shard)  # the reconcile completes
+
+        e._sleep = sleep
+        e.release_shard(shard)
+        assert observed == ["m1"]
+        lease = api.get(LEASE_API, "Lease", f"nbc-shard-{shard}",
+                        "kubeflow")
+        assert lease["spec"]["holderIdentity"] == ""
+        assert shard not in e.owned()
+        # The successor acquires the voluntarily released lease at
+        # once (no expiry wait) and may now reconcile.
+        e2 = ShardedElector(api, "nbc", "m2", 2, clock=clk)
+        assert shard in e2.try_acquire_or_renew()
+
+
+# ---------------------------------------------------------------------------
+# shard-gated controller: enqueue/pop filters, successor resync
+# ---------------------------------------------------------------------------
+
+
+class TestShardGatedController:
+    def _names_by_shard(self, shards=2, ns="user", want=3):
+        out = {s: [] for s in range(shards)}
+        i = 0
+        while any(len(v) < want for v in out.values()):
+            name = f"nb-{i}"
+            out[shard_of(ns, name, shards)].append(name)
+            i += 1
+        return out
+
+    def test_only_owned_shards_reconcile_and_resync_on_acquire(self):
+        api = FakeApiServer()
+        gate = ShardGate(2)
+        ctrl = make_notebook_controller(api, shard_gate=gate)
+        names = self._names_by_shard()
+        for shard_names in names.values():
+            for name in shard_names[:2]:
+                api.create(notebook_cr(name))
+        gate.on_acquired(0)
+        ctrl.run_once()
+        for name in names[0][:2]:
+            api.get("apps/v1", "StatefulSet", name, "user")
+        for name in names[1][:2]:
+            with pytest.raises(NotFound):
+                api.get("apps/v1", "StatefulSet", name, "user")
+        # Successor-resync discipline: acquiring shard 1 re-LISTs and
+        # reconciles its pre-existing keys without any fresh event.
+        gate.on_acquired(1)
+        ctrl.run_once()
+        for name in names[1][:2]:
+            api.get("apps/v1", "StatefulSet", name, "user")
+
+    def test_lost_shard_stops_enqueuing_and_drops_keys(self):
+        api = FakeApiServer()
+        gate = ShardGate(2)
+        ctrl = make_notebook_controller(api, shard_gate=gate)
+        names = self._names_by_shard()
+        gate.on_acquired(0)
+        gate.on_acquired(1)
+        ctrl.run_once()
+        gate.on_lost(0)
+        api.create(notebook_cr(names[0][0]))
+        api.create(notebook_cr(names[1][0]))
+        ctrl.run_once()
+        with pytest.raises(NotFound):
+            api.get("apps/v1", "StatefulSet", names[0][0], "user")
+        api.get("apps/v1", "StatefulSet", names[1][0], "user")
+        assert len(ctrl.queue) == 0  # nothing parked for the lost shard
+
+
+# ---------------------------------------------------------------------------
+# informer cache
+# ---------------------------------------------------------------------------
+
+
+class TestInformer:
+    def test_list_matches_apiserver_views(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        api.create({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p1", "namespace": "a",
+                                 "labels": {"app": "x"}}})
+        api.create({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p2", "namespace": "b",
+                                 "labels": {"app": "y"}}})
+        assert cache.list("v1", "Pod") == api.list("v1", "Pod")
+        assert cache.list("v1", "Pod", namespace="a") == \
+            api.list("v1", "Pod", namespace="a")
+        assert cache.list("v1", "Pod", label_selector="app=y") == \
+            api.list("v1", "Pod", label_selector="app=y")
+        api.delete("v1", "Pod", "p1", "a")
+        assert cache.list("v1", "Pod", namespace="a") == []
+
+    def test_get_copies_and_not_found(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "a"},
+                    "data": {"k": "v"}})
+        got = cache.get("v1", "ConfigMap", "cm", "a")
+        got["data"]["k"] = "mutated"
+        assert cache.get("v1", "ConfigMap", "cm", "a")["data"]["k"] == "v"
+        with pytest.raises(NotFound):
+            cache.get("v1", "ConfigMap", "absent", "a")
+
+    def test_field_index_serves_event_joins(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        for i in range(5):
+            api.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"ev-{i}", "namespace": "a"},
+                "involvedObject": {"name": f"nb-{i % 2}"},
+            })
+        got = cache.list("v1", "Event", namespace="a",
+                         field_selector="involvedObject.name=nb-0")
+        assert [e["metadata"]["name"] for e in got] == \
+            ["ev-0", "ev-2", "ev-4"]
+        informer = cache.informer("v1", "Event")
+        assert "involvedObject.name" in informer._field_idx
+
+    def test_owner_uid_index(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        owner = api.create(notebook_cr("own"))
+        uid = owner["metadata"]["uid"]
+        api.create({"apiVersion": "apps/v1", "kind": "StatefulSet",
+                    "metadata": {"name": "own", "namespace": "user",
+                                 "ownerReferences": [{"uid": uid}]},
+                    "spec": {}})
+        informer = cache.informer("apps/v1", "StatefulSet")
+        assert [o["metadata"]["name"]
+                for o in informer.for_owner(uid)] == ["own"]
+        assert informer.for_owner("nope") == []
+
+    def test_stale_duplicate_delivery_never_regresses(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "a"},
+                    "data": {"v": "1"}})
+        informer = cache.informer("v1", "ConfigMap")
+        old = api.get("v1", "ConfigMap", "cm", "a")
+        api.patch_merge("v1", "ConfigMap", "cm", {"data": {"v": "2"}},
+                        "a")
+        informer.sync()
+        # Replay the stale object as a late duplicate delivery.
+        from kubeflow_tpu.k8s.core import WatchEvent
+
+        informer._queue.put(WatchEvent("MODIFIED", old))
+        informer.sync()
+        assert cache.get("v1", "ConfigMap", "cm", "a")["data"]["v"] == "2"
+
+    def test_compaction_410_relist_restores_cache(self):
+        api = FakeApiServer()
+        schedule = FaultSchedule(seed=3).watch_faults(
+            compact=1.0, max_compactions=1)
+        handle = ChaosApiServer(api, schedule, sleep=lambda s: None)
+        cache = InformerCache(handle)
+        informer = cache.informer("v1", "ConfigMap")
+        api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": "cm-lost", "namespace": "a"}})
+        # The compaction destroys the pending delivery: the cache
+        # misses the object and its resourceVersion never advances.
+        informer.sync()
+        assert cache.list("v1", "ConfigMap", namespace="a") == []
+        # The store's change log rolls past the informer's horizon.
+        for i in range(1100):
+            api.create({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"p-{i}",
+                                     "namespace": "noise"}})
+        assert informer.recover() is True  # 410 Gone -> full re-list
+        assert informer.relists == 1
+        names = [o["metadata"]["name"]
+                 for o in cache.list("v1", "ConfigMap", namespace="a")]
+        assert names == ["cm-lost"]
+
+    def test_recover_replays_retained_backlog_without_relist(self):
+        api = FakeApiServer()
+        cache = InformerCache(api)
+        informer = cache.informer("v1", "ConfigMap")
+        # Simulate dropped deliveries by draining the queue unseen.
+        api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": "cm-a", "namespace": "a"}})
+        while not informer._queue.empty():
+            informer._queue.get_nowait()
+        assert cache.list("v1", "ConfigMap", namespace="a") == []
+        assert informer.recover() is False  # log retained: replayed
+        assert informer.relists == 0
+        assert [o["metadata"]["name"]
+                for o in cache.list("v1", "ConfigMap", namespace="a")] \
+            == ["cm-a"]
+
+
+# ---------------------------------------------------------------------------
+# batched status writes
+# ---------------------------------------------------------------------------
+
+
+class TestStatusBatcher:
+    def test_coalesces_and_flushes_once(self):
+        api = FakeApiServer()
+        api.create(notebook_cr("nb"))
+        batcher = StatusBatcher(api)
+        batcher.submit(NOTEBOOK_API, "Notebook", "nb",
+                       {"status": {"phase": "Queued",
+                                   "queuePosition": 3}}, "user")
+        batcher.submit(NOTEBOOK_API, "Notebook", "nb",
+                       {"status": {"queuePosition": 2}}, "user")
+        rv_before = api.get(NOTEBOOK_API, "Notebook", "nb",
+                            "user")["metadata"]["resourceVersion"]
+        assert batcher.flush() == 1
+        nb = api.get(NOTEBOOK_API, "Notebook", "nb", "user")
+        assert nb["status"] == {"phase": "Queued", "queuePosition": 2}
+        assert int(nb["metadata"]["resourceVersion"]) == \
+            int(rv_before) + 1  # ONE write for two submits
+        assert batcher.coalesced == 1
+        assert len(batcher) == 0
+
+    def test_none_deletes_survive_coalescing(self):
+        api = FakeApiServer()
+        nb = notebook_cr("nb")
+        nb["status"] = {"phase": "Queued", "queuePosition": 5}
+        api.create(nb)
+        batcher = StatusBatcher(api)
+        batcher.submit(NOTEBOOK_API, "Notebook", "nb",
+                       {"status": {"phase": "Running"}}, "user")
+        batcher.submit(NOTEBOOK_API, "Notebook", "nb",
+                       {"status": {"queuePosition": None}}, "user")
+        batcher.flush()
+        status = api.get(NOTEBOOK_API, "Notebook", "nb",
+                         "user")["status"]
+        assert status == {"phase": "Running"}
+
+    def test_deleted_object_is_moot(self):
+        api = FakeApiServer()
+        batcher = StatusBatcher(api)
+        batcher.submit(NOTEBOOK_API, "Notebook", "gone",
+                       {"status": {"phase": "X"}}, "user")
+        assert batcher.flush() == 0  # swallowed, not raised
+
+
+# ---------------------------------------------------------------------------
+# KFT_SHARDS=1: byte-identical to the pre-shard control plane
+# ---------------------------------------------------------------------------
+
+
+_SCRUB = ("uid", "resourceVersion", "creationTimestamp",
+          "firstTimestamp", "lastTimestamp")
+
+
+def _scrub(obj):
+    if isinstance(obj, dict):
+        return {k: _scrub(v) for k, v in obj.items()
+                if k not in _SCRUB}
+    if isinstance(obj, list):
+        return [_scrub(v) for v in obj]
+    return obj
+
+
+def _world(api):
+    doc = {}
+    for api_version, kind in ((NOTEBOOK_API, "Notebook"),
+                              ("apps/v1", "StatefulSet"),
+                              ("v1", "Service"),
+                              ("v1", "Event")):
+        doc[kind] = [_scrub(o) for o in api.list(api_version, kind)]
+    return json.dumps(doc, sort_keys=True)
+
+
+class TestShardsOneByteIdentical:
+    def _script(self, api, ctrl):
+        for i in range(4):
+            api.create(notebook_cr(f"nb-{i}",
+                                   topology="2x2" if i % 2 else None))
+        ctrl.run_once()
+        api.patch_merge(NOTEBOOK_API, "Notebook", "nb-1",
+                        {"metadata": {"annotations": {"gen": "2"}}},
+                        "user")
+        api.delete(NOTEBOOK_API, "Notebook", "nb-2", "user")
+        ctrl.run_once()
+        ctrl.resync()
+        ctrl.run_once()
+
+    def test_cache_and_batcher_change_nothing(self):
+        # Pre-PR shape: plain controller, direct LISTs and writes.
+        api_a = FakeApiServer()
+        ctrl_a = make_notebook_controller(api_a)
+        self._script(api_a, ctrl_a)
+        # KFT_SHARDS=1 shape: informer cache + status batcher wired
+        # (sharding itself off — no gate).
+        api_b = FakeApiServer()
+        ctrl_b = make_notebook_controller(
+            api_b, cache=InformerCache(api_b),
+            status_batcher=StatusBatcher(api_b),
+        )
+        self._script(api_b, ctrl_b)
+        assert _world(api_a) == _world(api_b)
+
+
+# ---------------------------------------------------------------------------
+# manager wiring, /touch, informer-backed capacity
+# ---------------------------------------------------------------------------
+
+
+class TestManagerSharding:
+    def test_sharded_manager_uses_sharded_elector(self):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api)
+        m = Manager(api, [ctrl], leader_elect=True, identity="m1",
+                    http_port=None, shards=4)
+        assert isinstance(m.elector, ShardedElector)
+        assert ctrl.shard_gate is m.shard_gate
+        m.elector.try_acquire_or_renew()
+        assert m.is_leader
+        assert m.shard_gate.owned() == frozenset({0, 1, 2, 3})
+
+    def test_one_shard_keeps_classic_single_leader(self):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api)
+        m = Manager(api, [ctrl], leader_elect=True, identity="m1",
+                    http_port=None, shards=1)
+        assert not isinstance(m.elector, ShardedElector)
+        assert m.shard_gate is None and ctrl.shard_gate is None
+        m.elector.try_acquire_or_renew()
+        lease = api.get(LEASE_API, "Lease", "controller-manager",
+                        "kubeflow")
+        assert lease["spec"]["holderIdentity"] == "m1"
+
+
+class TestTouchEndpoint:
+    def _suspended_scheduler(self, clk):
+        sched = SlicePoolScheduler(capacity_fn=lambda: 16, clock=clk,
+                                   aging_s=600.0, drain_grace_s=10.0,
+                                   enabled=True)
+        sched.decide("Notebook", "team", "idle", 8, {}, now=clk())
+        assert sched.mark_reclaimable("Notebook", "team", "idle",
+                                      now=clk())
+        clk.advance(20)
+        sched.tick(clk())  # drain deadline passes -> Suspended
+        assert sched.pool_snapshot()["suspended"] == 1
+        return sched
+
+    def test_post_touch_resurrects(self):
+        import urllib.request
+
+        clk = Clock()
+        sched = self._suspended_scheduler(clk)
+        server = ManagerServer(ControllerMetrics(), enable_debug=True,
+                               scheduler=sched)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/touch/team/idle",
+                data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                doc = json.loads(resp.read())
+            assert doc == {"kind": "Notebook", "namespace": "team",
+                           "name": "idle", "resurrected": True}
+            assert sched.pool_snapshot()["suspended"] == 0
+            # Second touch: nothing suspended -> resurrected false.
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["resurrected"] is False
+        finally:
+            server.stop()
+
+    def test_touch_is_debug_gated_and_validates_kind(self):
+        import urllib.error
+        import urllib.request
+
+        clk = Clock()
+        sched = self._suspended_scheduler(clk)
+        gated = ManagerServer(ControllerMetrics(), enable_debug=False,
+                              scheduler=sched)
+        gated.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gated.port}/touch/team/idle",
+                data=b"", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 404
+        finally:
+            gated.stop()
+        server = ManagerServer(ControllerMetrics(), enable_debug=True,
+                               scheduler=sched)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}"
+                "/touch/team/idle?kind=Gibberish",
+                data=b"", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+
+class TestInformerCapacity:
+    def _node(self, name, chips=8, ready=True):
+        return {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name},
+            "status": {
+                "allocatable": {"google.com/tpu": str(chips)},
+                "conditions": [{"type": "Ready",
+                                "status": "True" if ready else "False"}],
+            },
+        }
+
+    def test_capacity_reads_come_from_the_informer(self):
+        api = FakeApiServer()
+        api.create(self._node("n1"))
+        api.create(self._node("n2"))
+        api.create(self._node("n3", ready=False))
+        cache = InformerCache(api)
+        assert node_inventory_capacity(api, cache=cache) == 16
+
+        lists = []
+        real_list = api.list
+
+        def counting_list(*args, **kwargs):
+            lists.append(args)
+            return real_list(*args, **kwargs)
+
+        api.list = counting_list
+        # Node churn lands through the watch, NOT a fresh LIST.
+        api.create(self._node("n4", chips=4))
+        assert node_inventory_capacity(api, cache=cache) == 20
+        assert lists == []  # zero apiserver LISTs on the read path
+
+
+# ---------------------------------------------------------------------------
+# the soak acceptance arc (small tier-1 scale; RUN_SLOW runs 10k)
+# ---------------------------------------------------------------------------
+
+
+class TestSoak:
+    @pytest.fixture(scope="class")
+    def summary(self, tmp_path_factory):
+        from loadtest.soak import run_soak
+
+        return run_soak(crs=80, ticks=50, shards=4, replicas=2,
+                        dump_dir=str(tmp_path_factory.mktemp("dumps")))
+
+    def test_acceptance_checklist(self, summary):
+        from loadtest.soak import problems_in
+
+        assert problems_in(summary) == [], summary
+
+    def test_dual_leader_exclusion_under_conflict_storm(self, summary):
+        # The chaos phase runs a conflict storm + blackout against the
+        # sharded configuration AFTER a mid-soak lease revocation;
+        # every reconcile was checked against the live lease holder.
+        assert summary["dual_leader_reconciles"] == 0
+        assert summary["chaos"]["injected"]["conflict"] >= 1
+        assert summary["lease_revocations"] == 1
+        assert summary["counters"]["preemptions_total"] >= 1
+
+    def test_shards_split_the_work(self, summary):
+        counts = summary["reconciles"]
+        assert len(counts) == 2
+        assert all(v > 0 for v in counts.values())
+        assert summary["ownership"][0] and summary["ownership"][1]
+
+    def test_zero_orphans_and_scheduler_audit(self, summary):
+        assert summary["orphans"]["count"] == 0
+        assert summary["scheduler_audit"] == {}
+
+    def test_cache_absorbed_the_read_path(self, summary):
+        stats = summary["cache"]
+        for replica_stats in stats.values():
+            assert any(v["objects"] >= 0 and v["applied"] > 0
+                       for v in replica_stats.values())
+
+    def test_replay_is_byte_identical(self, summary, tmp_path):
+        from loadtest.soak import run_soak
+
+        again = run_soak(crs=80, ticks=50, shards=4, replicas=2,
+                         dump_dir=str(tmp_path))
+        assert again["replay_digest"] == summary["replay_digest"]
+        assert again["store_fingerprint"] == \
+            summary["store_fingerprint"]
+
+    def test_different_seed_differs(self, summary, tmp_path):
+        from loadtest.soak import run_soak
+
+        other = run_soak(crs=80, ticks=50, shards=4, replicas=2,
+                         seed=99, dump_dir=str(tmp_path))
+        assert other["replay_digest"] != summary["replay_digest"]
+
+
+@pytest.mark.slow
+class TestSoakAtScale:
+    def test_ten_thousand_crs(self, tmp_path):
+        from loadtest.soak import problems_in, run_soak
+
+        summary = run_soak(crs=10000, ticks=240, shards=4, replicas=2,
+                           dump_dir=str(tmp_path))
+        assert problems_in(summary) == [], {
+            k: summary[k] for k in ("slo", "dual_leader_reconciles",
+                                    "orphans", "scheduler_audit")
+        }
