@@ -41,6 +41,7 @@ def check_profile(api, docs) -> tuple[str, bool, str]:
         api.get("v1", "ServiceAccount", "default-editor", ns)
         api.get("rbac.authorization.k8s.io/v1", "RoleBinding", "namespaceAdmin", ns)
         quota = api.get("v1", "ResourceQuota", "kf-resource-quota", ns)
+    # analysis: allow[py-broad-except] — conformance runner: a probe failure IS the recorded result
     except Exception as e:  # NotFound
         return ("profile-conformance", False, str(e))
     hard = quota["spec"]["hard"]
@@ -270,6 +271,7 @@ def processes_main() -> int:
                 f"namespace {ns} materialised by the controller process"
                 if ok else f"TPU quota missing: {hard}",
             ))
+        # analysis: allow[py-broad-except] — conformance runner: a probe failure IS the recorded result
         except Exception as exc:
             results.append(("profile-conformance", False, str(exc)))
 
@@ -283,6 +285,7 @@ def processes_main() -> int:
             while not kubelet_stop.is_set():
                 try:
                     kubelet.step(time.monotonic())
+                # analysis: allow[py-broad-except] — conformance runner: a probe failure IS the recorded result
                 except Exception:
                     # Keep ticking, but a broken kubelet must be
                     # diagnosable (first traceback per distinct error).
@@ -338,6 +341,7 @@ def processes_main() -> int:
                 "v5e-16 notebook spawned to ready across processes"
                 if not failed else f"failed: {failed}",
             ))
+        # analysis: allow[py-broad-except] — conformance runner: a probe failure IS the recorded result
         except Exception as exc:
             results.append(("notebook-conformance", False, str(exc)))
 
@@ -368,6 +372,7 @@ def processes_main() -> int:
                 "process" if ok else
                 f"injection incomplete: env={env_map}",
             ))
+        # analysis: allow[py-broad-except] — conformance runner: a probe failure IS the recorded result
         except Exception as exc:
             results.append(("poddefault-conformance", False, str(exc)))
     finally:

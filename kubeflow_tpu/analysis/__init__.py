@@ -1,7 +1,9 @@
 """Platform-wide static analysis.
 
-Five rule packs over the repo tree, sharing one findings model and one
-CLI (``python -m kubeflow_tpu.analysis``):
+Six rule packs over the repo tree, sharing one findings model, one
+per-scan parse cache (each file is ``ast.parse``d once, for every
+pack), one interprocedural summary engine, and one CLI
+(``python -m kubeflow_tpu.analysis``):
 
 - :mod:`manifest_rules` — YAML manifests and controller-emitted desired
   state: TPU limits x replicas vs GKE topology selectors (the math in
@@ -15,15 +17,23 @@ CLI (``python -m kubeflow_tpu.analysis``):
   traced (jit/pallas) functions, blocking calls in controller reconcile
   paths, HTTP requests without an explicit timeout, broad excepts that
   swallow silently, non-atomic state-file writes.
-- :mod:`spmd_rules` — SPMD coherence via intraprocedural dataflow
-  (:mod:`cfg` + :mod:`dataflow` + one-level :mod:`callgraph`
-  summaries): collectives control-dependent on rank/host-local values,
-  barrier ids/kv keys derived from tainted or per-process-counter
-  values, collectives inside except handlers.
-  ``broadcast_from_zero`` is the registered sanitizer.
+- :mod:`spmd_rules` — SPMD coherence via interprocedural dataflow
+  (:mod:`cfg` + :mod:`dataflow` + SCC-fixpoint :mod:`callgraph`
+  summaries, cross-module through :mod:`project`): collectives
+  control-dependent on rank/host-local values, barrier ids/kv keys
+  derived from tainted or per-process-counter values, collectives
+  inside except handlers. ``broadcast_from_zero`` is the registered
+  sanitizer.
 - :mod:`concurrency_rules` — control-plane lock discipline: attributes
   written both inside and outside a lock scope, ABBA lock-order
   inversions, blocking calls held under a lock.
+- :mod:`determinism_rules` — replay determinism (Pack C, the static
+  twin of the soak/game-day ``replay_digest`` gates): wall clocks or
+  salted ``hash()`` reaching digests/RNG seeds, unordered set
+  iteration or thread completion order reaching digests or event
+  emission (errors in replay-gated trees), unseeded module-level RNG
+  draws; taint crosses helper and module boundaries via the
+  ``param→sink`` halves of the same summaries.
 
 Findings carry (rule, severity, file:line, message). Two suppression
 mechanisms keep the gate green without hiding regressions: an inline

@@ -62,15 +62,61 @@ def main(argv: list[str] | None = None) -> int:
         "the same scan (the CI gate prints text AND uploads SARIF "
         "without paying for two analysis runs)",
     )
+    parser.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="scan only files changed vs REF (default HEAD) plus their "
+        "reverse import-dependency closure — the sub-second pre-commit "
+        "mode. Implies --no-emitted; attribution and baseline keys "
+        "match a full scan. Falls back to a full scan when git cannot "
+        "answer",
+    )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="report scan statistics (files, parses, wall time) on "
+        "stderr after the findings",
+    )
     args = parser.parse_args(argv)
 
     paths = args.paths or ["."]
     baseline_path = args.baseline or _default_baseline(paths)
+    file_filter = None
+    parse_cache = None
+    check_emitted = not args.no_emitted
+    if args.changed_only is not None:
+        from kubeflow_tpu.analysis.incremental import changed_only_files
+        from kubeflow_tpu.analysis.project import ParseCache
+
+        # One cache for the closure's import graph AND the scan — the
+        # files the closure parsed are not parsed again.
+        parse_cache = ParseCache()
+        file_filter = changed_only_files(
+            paths, args.changed_only, cache=parse_cache
+        )
+        if file_filter is None:
+            print(
+                "--changed-only: git unavailable; running a full scan",
+                file=sys.stderr,
+            )
+        else:
+            # The emitted-state probe spins whole controllers — not a
+            # pre-commit cost; the full CI scan still runs it.
+            check_emitted = False
     config = AnalysisConfig(
         paths=paths,
-        check_emitted=not args.no_emitted,
+        check_emitted=check_emitted,
+        file_filter=file_filter,
+        parse_cache=parse_cache,
     )
     findings = analyze_paths(config)
+    if args.stats and config.stats is not None:
+        scope = ""
+        if file_filter is not None:
+            scope = (
+                f" (--changed-only: {len(file_filter)} candidate "
+                "file(s) in the dependency closure)"
+            )
+        print(config.stats.render() + scope, file=sys.stderr)
     if args.write_baseline:
         write_baseline(baseline_path, findings)
         print(

@@ -1119,16 +1119,23 @@ def _handler_swallows(handler: ast.ExceptHandler) -> bool:
     return True
 
 
-def analyze_python_source(source: str, path: str) -> list[Finding]:
+def analyze_python_source(source: str, path: str,
+                          context=None) -> list[Finding]:
     """All AST rules over one Python file. ``path`` is only used for
-    finding attribution (repo-relative)."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as exc:
-        return [Finding(
-            "py-syntax", Severity.ERROR, path, exc.lineno or 0,
-            f"file does not parse: {exc.msg}",
-        )]
+    finding attribution (repo-relative); ``context`` (optional)
+    supplies the engine's pre-parsed tree."""
+    if context is not None:
+        tree = context.tree
+    else:
+        tree = None
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            return [Finding(
+                "py-syntax", Severity.ERROR, path, exc.lineno or 0,
+                f"file does not parse: {exc.msg}",
+            )]
     aliases = _import_aliases(tree)
     traced_names = _traced_function_names(tree, aliases)
     out: list[Finding] = []

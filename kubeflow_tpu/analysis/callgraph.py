@@ -1,36 +1,51 @@
-"""Module-level symbol table + one-level call summaries.
+"""Module symbol table + interprocedural taint summaries.
 
 Intraprocedural dataflow alone would lose taint at every helper
 boundary — ``token = decide()`` in a train loop, where ``decide()``
 reads the host-local wall clock, is precisely the shape PR 4's bug
-took. This module gives the dataflow pass just enough interprocedural
-reach to follow that: every function defined in the module (methods
-and nested functions included) gets a *summary* computed by seeding its
-parameters with placeholder labels and collecting the taint of its
-return expressions:
+took; PR 13's replay-digest break (unordered set iteration whose
+element reached the event log through TWO helper levels) is the same
+class one hop deeper. This module computes a *summary* per function
+(methods and nested functions included) describing its taint behavior
+at any call site:
 
 - ``base``: source labels that reach the return regardless of inputs
   ("decide() reads time.monotonic()").
-- ``deps``: parameter positions whose taint flows through to the
-  return ("identity-ish helpers keep their argument's taint").
+- ``deps``: parameter names whose taint flows through to the return
+  ("identity-ish helpers keep their argument's taint").
+- ``param_sinks``: parameters that reach a registry *sink*
+  (``_record(event)`` appends its argument to the event log), so the
+  caller's tainted argument fires at the call site even though the
+  sink itself lives arbitrarily deep in callees.
 - a summary of a function whose returns all pass through a sanitizer
   is naturally clean (empty base, no deps).
 
-Call sites then resolve one level deep: plain names resolve lexically
+Summaries are computed **bottom-up over the SCC condensation** of the
+module call graph: Tarjan's algorithm emits strongly connected
+components callees-first, every function starts at the bottom summary,
+and each SCC iterates its members to a fixpoint (monotone transfer
+over a finite label lattice, so recursive and mutually recursive
+helpers converge; a small iteration cap backstops pathological
+shapes). Call sites inside a summary consult the *current* summaries
+of their callees — so taint crosses any number of helper levels, not
+the single level the previous engine resolved. ``mode="one-level"``
+preserves that old engine (leaf-style summaries, no ``param_sinks``)
+for regression pinning: tests prove the two-hop flows it misses.
+
+Resolution order at a call site: plain names resolve lexically
 (nearest enclosing scope, then module level), ``self.m(...)`` resolves
-to the enclosing class's method. Summaries are themselves computed
-leaf-style (calls inside a summarized function fall back to the
-conservative union), so the precision is exactly "one direct call
-deep", as advertised — deeper chains stay conservative, never silent.
+to the enclosing class's method, and anything else is handed to the
+optional ``fallback`` — the cross-module hook
+(:mod:`kubeflow_tpu.analysis.project`) that resolves
+``pkg.mod.helper`` through the import-alias map into the *other*
+module's summaries.
 
 Thread-entry detection also lives here: functions handed to
 ``threading.Thread(target=...)`` / ``executor.submit(fn, ...)`` and
 the conventional loop entry points (``run``, ``run_forever``) are
-roots. The concurrency pack names these roots in its unlocked-write
-messages (lock *presence* is its detection signal — the spawn site
-usually lives in another module); ``reachable_from`` computes the
-transitive closure over the same resolved call edges for packs and
-tests that need full reachability.
+roots. ``reachable_from`` computes the transitive closure over the
+same resolved call edges for packs and tests that need full
+reachability.
 """
 
 from __future__ import annotations
@@ -40,32 +55,102 @@ import dataclasses
 
 from kubeflow_tpu.analysis import cfg as cfg_mod
 from kubeflow_tpu.analysis.dataflow import (
+    ORDERED_PARAM_PREFIX,
+    PARAM_PREFIX,
     FunctionDataflow,
     TaintRegistry,
     VarInfo,
+    calls_in,
     dotted_name,
 )
 
-_PARAM_PREFIX = "param:"
+_PARAM_PREFIX = PARAM_PREFIX
+
+# Fixpoint backstop per SCC. Summaries only grow and the label lattice
+# is finite (source labels present in the component's bodies plus its
+# parameter placeholders), so real code converges in two or three
+# rounds; the cap turns a hypothetical non-monotone surprise into a
+# conservative (largest-iterate) summary instead of a hang.
+_SCC_ITER_CAP = 16
+
+
+def _drop_order(taint: frozenset, order_labels) -> frozenset:
+    if not order_labels:
+        return taint
+    return frozenset(
+        t for t in taint
+        if not any(t.startswith(p) for p in order_labels)
+    )
 
 
 @dataclasses.dataclass(frozen=True)
 class Summary:
-    """Taint behavior of one function's return value."""
+    """Taint behavior of one function, as seen from a call site.
+
+    ``deps``/``param_sinks`` are raw pass-through flows; their
+    ``ordered_*`` twins record flows that crossed an order-scrubbing
+    partial sanitizer (``sorted(x)``, ``min(x)``) inside the function —
+    value taint (wall clocks, salted hashes) still propagates through
+    them, order labels (set markers, iteration order) do not. The
+    ``order_labels`` the caller passes come from its registry."""
 
     base: frozenset
     deps: frozenset  # parameter names whose taint flows to the return
     param_names: tuple[str, ...] = ()
+    # (parameter name, sink kind) pairs: the parameter's value reaches
+    # a registry sink inside this function or any of its callees.
+    param_sinks: frozenset = frozenset()
+    ordered_deps: frozenset = frozenset()
+    ordered_param_sinks: frozenset = frozenset()
 
-    def apply(self, arg_taints, kwarg_taints) -> frozenset:
+    def apply(self, arg_taints, kwarg_taints,
+              order_labels=()) -> frozenset:
         out = frozenset(self.base)
-        for idx, taint in enumerate(arg_taints):
-            if idx < len(self.param_names) and \
-                    self.param_names[idx] in self.deps:
-                out |= taint
-        for name, taint in (kwarg_taints or {}).items():
+
+        def feed(name: str, taint: frozenset) -> None:
+            nonlocal out
             if name in self.deps:
                 out |= taint
+            elif name in self.ordered_deps:
+                out |= _drop_order(taint, order_labels)
+
+        for idx, taint in enumerate(arg_taints):
+            if taint and idx < len(self.param_names):
+                feed(self.param_names[idx], taint)
+        for name, taint in (kwarg_taints or {}).items():
+            if taint and name is not None:
+                feed(name, taint)
+        return out
+
+    def sink_flows(self, arg_taints, kwarg_taints,
+                   order_labels=()) -> dict:
+        """``sink kind -> caller-side taint`` flowing into that sink
+        through this call's arguments (empty when no parameter of this
+        function reaches a sink)."""
+        if not self.param_sinks and not self.ordered_param_sinks:
+            return {}
+        kinds_by_param: dict[str, list[str]] = {}
+        ordered_by_param: dict[str, list[str]] = {}
+        for param, kind in self.param_sinks:
+            kinds_by_param.setdefault(param, []).append(kind)
+        for param, kind in self.ordered_param_sinks:
+            ordered_by_param.setdefault(param, []).append(kind)
+        out: dict[str, frozenset] = {}
+
+        def feed(name: str, taint: frozenset) -> None:
+            for kind in kinds_by_param.get(name, ()):
+                out[kind] = out.get(kind, frozenset()) | taint
+            filtered = _drop_order(taint, order_labels)
+            if filtered:
+                for kind in ordered_by_param.get(name, ()):
+                    out[kind] = out.get(kind, frozenset()) | filtered
+
+        for idx, taint in enumerate(arg_taints):
+            if taint and idx < len(self.param_names):
+                feed(self.param_names[idx], taint)
+        for name, taint in (kwarg_taints or {}).items():
+            if taint and name is not None:
+                feed(name, taint)
         return out
 
 
@@ -87,18 +172,40 @@ def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
     return names
 
 
+def _own_calls(fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """Call nodes in ``fn``'s own body, nested defs excluded."""
+    for stmt in fn.body:
+        yield from calls_in(stmt)
+
+
 class CallGraph:
-    """Symbol table + summaries for one module tree."""
+    """Symbol table + interprocedural summaries for one module tree.
+
+    ``mode`` selects the summary engine: ``"fixpoint"`` (default) is
+    the bottom-up SCC engine described in the module docstring;
+    ``"one-level"`` reproduces the pre-interprocedural behavior
+    (summaries computed leaf-style with conservative call fallback) and
+    exists so tests can pin exactly what the old engine missed.
+    ``fallback(dotted, call) -> Summary | None`` resolves call targets
+    no local lookup matches — the cross-module hook.
+    """
 
     def __init__(self, tree: ast.AST, registry: TaintRegistry,
-                 aliases: dict[str, str]) -> None:
+                 aliases: dict[str, str], mode: str = "fixpoint",
+                 fallback=None) -> None:
         self.registry = registry
         self.aliases = aliases
+        self.fallback = fallback
         self.functions: dict[str, FunctionInfo] = {}
         self._methods: dict[tuple[str, str], FunctionInfo] = {}
         self._collect(tree, scope=(), cls=None)
-        for info in self.functions.values():
-            info.summary = self._summarize(info)
+        if mode == "one-level":
+            for info in self.functions.values():
+                info.summary = self._summarize(info, resolve=None)
+            return
+        edges = self._call_edges()
+        for scc in _condense(sorted(self.functions), edges):
+            self._solve_scc(scc, edges)
 
     # -- symbol table ----------------------------------------------------
     def _collect(self, node: ast.AST, scope: tuple[str, ...],
@@ -142,8 +249,50 @@ class CallGraph:
                 return info
         return None
 
+    # -- call edges + SCC solve ------------------------------------------
+    def _call_edges(self) -> dict[str, tuple[str, ...]]:
+        """qualname -> resolved local callee qualnames (sorted, deduped
+        — deterministic iteration keeps summaries replay-stable)."""
+        edges: dict[str, tuple[str, ...]] = {}
+        for qual in sorted(self.functions):
+            info = self.functions[qual]
+            targets: set[str] = set()
+            for call in _own_calls(info.node):
+                dotted = dotted_name(call.func, self.aliases)
+                target = self.lookup(
+                    dotted, info.scope + (info.qualname,), info.cls
+                )
+                if target is not None:
+                    targets.add(target.qualname)
+            edges[qual] = tuple(sorted(targets))
+        return edges
+
+    def _solve_scc(self, scc: tuple[str, ...],
+                   edges: dict[str, tuple[str, ...]]) -> None:
+        for qual in scc:
+            info = self.functions[qual]
+            info.summary = Summary(
+                base=frozenset(), deps=frozenset(),
+                param_names=tuple(_param_names(info.node)),
+            )
+        recursive = len(scc) > 1 or scc[0] in edges.get(scc[0], ())
+        rounds = _SCC_ITER_CAP if recursive else 1
+        for _ in range(rounds):
+            changed = False
+            for qual in scc:
+                info = self.functions[qual]
+                resolve = self.resolver(
+                    info.scope + (info.qualname,), info.cls
+                )
+                new = self._summarize(info, resolve)
+                if new != info.summary:
+                    info.summary = new
+                    changed = True
+            if not changed:
+                break
+
     # -- summaries -------------------------------------------------------
-    def _summarize(self, info: FunctionInfo) -> Summary:
+    def _summarize(self, info: FunctionInfo, resolve) -> Summary:
         params = _param_names(info.node)
         initial = {
             name: VarInfo(labels=frozenset([f"{_PARAM_PREFIX}{name}"]))
@@ -154,26 +303,144 @@ class CallGraph:
             self.registry,
             self.aliases,
             initial=initial,
+            resolver=resolve,
         )
         base = frozenset(
             label for label in flow.return_taint
-            if not label.startswith(_PARAM_PREFIX)
+            if not label.startswith((_PARAM_PREFIX,
+                                     ORDERED_PARAM_PREFIX))
         )
         deps = frozenset(
             label[len(_PARAM_PREFIX):] for label in flow.return_taint
             if label.startswith(_PARAM_PREFIX)
         )
-        return Summary(base=base, deps=deps, param_names=tuple(params))
+        ordered_deps = frozenset(
+            label[len(ORDERED_PARAM_PREFIX):]
+            for label in flow.return_taint
+            if label.startswith(ORDERED_PARAM_PREFIX)
+        ) - deps  # a raw flow dominates an order-scrubbed one
+        param_sinks: set[tuple[str, str]] = set()
+        ordered_param_sinks: set[tuple[str, str]] = set()
+
+        def record(label: str, kind: str) -> None:
+            if label.startswith(_PARAM_PREFIX):
+                param_sinks.add((label[len(_PARAM_PREFIX):], kind))
+            elif label.startswith(ORDERED_PARAM_PREFIX):
+                ordered_param_sinks.add(
+                    (label[len(ORDERED_PARAM_PREFIX):], kind)
+                )
+
+        # param→sink facts only exist for registries that declare
+        # sinks; packs without them (SPMD) skip both walks entirely.
+        if resolve is not None and self.registry.sinks:
+            # Direct sink hits whose taint includes a parameter
+            # placeholder: that parameter reaches the sink here.
+            for spec, _call, _state, taint in flow.sink_hits():
+                for label in taint:
+                    record(label, spec.kind)
+            # Transitive hits: an argument built from a parameter is
+            # handed to a callee whose own summary says that position
+            # reaches a sink.
+            for _block, stmt, state in flow.iter_statement_states():
+                for call, call_state in flow.calls_with_states(
+                    stmt, state
+                ):
+                    dotted = dotted_name(call.func, self.aliases)
+                    summary = resolve(dotted, call)
+                    if summary is None or not (
+                        summary.param_sinks
+                        or summary.ordered_param_sinks
+                    ):
+                        continue
+                    arg_taints = [
+                        flow.expr_taint(a, call_state)
+                        for a in call.args
+                    ]
+                    kwarg_taints = {
+                        kw.arg: flow.expr_taint(kw.value, call_state)
+                        for kw in call.keywords if kw.arg
+                    }
+                    flows = summary.sink_flows(
+                        arg_taints, kwarg_taints,
+                        self.registry.order_labels,
+                    )
+                    for kind, labels in flows.items():
+                        for label in labels:
+                            record(label, kind)
+        return Summary(
+            base=base, deps=deps, param_names=tuple(params),
+            param_sinks=frozenset(param_sinks),
+            ordered_deps=ordered_deps,
+            ordered_param_sinks=frozenset(ordered_param_sinks)
+            - frozenset(param_sinks),
+        )
 
     def resolver(self, scope: tuple[str, ...], cls: str | None):
         """A ``resolver(dotted, call)`` closure for
-        :class:`FunctionDataflow`, bound to the caller's scope."""
+        :class:`FunctionDataflow`, bound to the caller's scope; local
+        lookup first, then the cross-module fallback."""
 
         def resolve(dotted: str, call: ast.Call):
             info = self.lookup(dotted, scope, cls)
-            return info.summary if info is not None else None
+            if info is not None:
+                return info.summary
+            if self.fallback is not None:
+                return self.fallback(dotted, call)
+            return None
 
         return resolve
+
+
+def _condense(nodes: list[str],
+              edges: dict[str, tuple[str, ...]]):
+    """Tarjan SCC over the call graph, iterative (deep recursion-free).
+    Components are emitted callees-first — exactly the bottom-up order
+    the summary solve needs."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = 0
+    out: list[tuple[str, ...]] = []
+    for root in nodes:
+        if root in index:
+            continue
+        work: list[list] = [[root, 0]]
+        while work:
+            frame = work[-1]
+            node, child_idx = frame
+            if child_idx == 0:
+                index[node] = low[node] = counter
+                counter += 1
+                stack.append(node)
+                on_stack.add(node)
+            succs = edges.get(node, ())
+            descended = False
+            while frame[1] < len(succs):
+                succ = succs[frame[1]]
+                frame[1] += 1
+                if succ not in index:
+                    work.append([succ, 0])
+                    descended = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if descended:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                component: list[str] = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    component.append(member)
+                    if member == node:
+                        break
+                out.append(tuple(sorted(component)))
+    return out
 
 
 # -- thread entry points -------------------------------------------------

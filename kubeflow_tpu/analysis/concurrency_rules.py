@@ -279,14 +279,20 @@ class _MethodWalker:
             self._held.reverse()
 
 
-def analyze_python_concurrency(source: str, path: str) -> list[Finding]:
-    """Pack B over one Python file."""
+def analyze_python_concurrency(source: str, path: str,
+                               context=None) -> list[Finding]:
+    """Pack B over one Python file. ``context`` (optional) supplies the
+    engine's pre-parsed tree — lock discipline itself stays per-class,
+    so the pack has no use for cross-module summaries."""
     if is_test_path(path):
         return []
-    try:
-        tree = ast.parse(source)
-    except SyntaxError:
-        return []
+    if context is not None:
+        tree = context.tree
+    else:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []
     aliases = import_aliases(tree)
     # Methods handed to Thread(target=...)/submit() or matching the
     # conventional loop names: named in unlocked-write messages so the

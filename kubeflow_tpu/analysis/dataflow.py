@@ -90,12 +90,20 @@ def is_test_path(path: str) -> bool:
 class CallPattern:
     """Matches dotted call targets: exact names, trailing suffixes
     (``.is_set`` matches any receiver), or dotted prefixes
-    (``random.`` matches the whole module)."""
+    (``random.`` matches the whole module).
+
+    When registered as a sanitizer, ``clears`` names the label
+    *prefixes* the call scrubs; ``None`` (the default) scrubs
+    everything — the original all-or-nothing contract
+    (``broadcast_from_zero`` returns the same value on every rank).
+    A partial sanitizer like ``sorted()`` clears ordering labels but
+    lets a wall-clock value ride through untouched."""
 
     label: str
     exact: tuple[str, ...] = ()
     suffixes: tuple[str, ...] = ()
     prefixes: tuple[str, ...] = ()
+    clears: tuple[str, ...] | None = None
 
     def matches(self, dotted: str) -> bool:
         if not dotted:
@@ -107,22 +115,57 @@ class CallPattern:
         return any(dotted.startswith(p) for p in self.prefixes)
 
 
+@dataclasses.dataclass(frozen=True)
+class SinkSpec:
+    """A call whose listed arguments feed an order/value-sensitive
+    consumer (digest updates, ordered event emission, RNG seeding).
+    ``args=None`` means every positional argument is sink-feeding.
+    ``receiver_label`` restricts the match to receivers whose taint
+    carries that label prefix — how ``h.update(...)`` is recognized as
+    a *digest* update only when ``h`` provably came from ``hashlib``
+    (a bare ``.update`` suffix would swallow every dict)."""
+
+    kind: str
+    pattern: CallPattern
+    args: tuple[int, ...] | None = None
+    keywords: tuple[str, ...] = ()
+    receiver_label: str | None = None
+
+
 @dataclasses.dataclass
 class TaintRegistry:
     """What taints, what cleans, and what must stay coherent.
 
     ``sources`` label call results; ``subscript_sources`` label
     subscript reads of the named dotted bases (``os.environ[...]``);
-    ``sanitizers`` clear taint from a call result; ``seed`` pre-taints
-    variables at function entry (per-process counter attributes).
-    Sinks live in the rule packs — the registry only drives
-    propagation.
+    ``sanitizers`` clear taint from a call result (all labels, or only
+    the per-pattern ``clears`` prefixes); ``seed`` pre-taints
+    variables at function entry (per-process counter attributes,
+    set-valued container attributes).
+
+    ``sinks`` name the order/value-sensitive consumers so the
+    interprocedural summaries (:mod:`callgraph`) can record which
+    *parameters* of a function reach a sink — findings themselves stay
+    in the rule packs. ``iter_sources`` converts a container-type
+    marker into a real taint label at iteration: a value whose taint
+    carries the marker prefix, when used as a ``for``/comprehension
+    iterable, binds its loop target with the mapped label instead
+    ("iterating THIS is where the nondeterminism enters").
+    ``set_literal_label`` marks set displays/comprehensions with the
+    container marker so locals built inline participate too.
     """
 
     sources: tuple[CallPattern, ...] = ()
     subscript_sources: tuple[str, ...] = ()
     sanitizers: tuple[CallPattern, ...] = ()
     seed: dict = dataclasses.field(default_factory=dict)
+    sinks: tuple[SinkSpec, ...] = ()
+    iter_sources: dict = dataclasses.field(default_factory=dict)
+    set_literal_label: str | None = None
+    # Label prefixes that describe *order* rather than value — what an
+    # order-scrubbed parameter flow (see ORDERED_PARAM_PREFIX) filters
+    # out of the caller's argument taint at apply time.
+    order_labels: tuple[str, ...] = ()
 
     def source_label(self, dotted: str) -> str | None:
         for pattern in self.sources:
@@ -130,8 +173,25 @@ class TaintRegistry:
                 return pattern.label
         return None
 
+    @property
+    def container_markers(self) -> tuple[str, ...]:
+        """Label prefixes that mark a *container type* rather than a
+        tainted value — dropped where only contents (not order) are
+        observed: membership tests, constructor arguments."""
+        markers = tuple(self.iter_sources)
+        if self.set_literal_label is not None and \
+                self.set_literal_label not in markers:
+            markers += (self.set_literal_label,)
+        return markers
+
     def is_sanitizer(self, dotted: str) -> bool:
         return any(p.matches(dotted) for p in self.sanitizers)
+
+    def sanitizer_for(self, dotted: str) -> CallPattern | None:
+        for pattern in self.sanitizers:
+            if pattern.matches(dotted):
+                return pattern
+        return None
 
 
 # A variable's lattice value.
@@ -146,6 +206,14 @@ class VarInfo:
 
 
 _BOTTOM = VarInfo()
+
+# Parameter placeholders used by callgraph summaries. A raw
+# ``param:x`` label means x's taint flows through unchanged; the
+# ordered variant means it passed an order-scrubbing partial sanitizer
+# (``sorted(x)``, ``min(x)``) on the way — callers keep value taint
+# (wall clock, salted hash) through it but drop order labels.
+PARAM_PREFIX = "param:"
+ORDERED_PARAM_PREFIX = "param~o:"
 
 State = dict  # var name -> VarInfo
 
@@ -163,7 +231,8 @@ class FunctionDataflow:
 
     ``resolver(dotted, call) -> summary | None`` supplies local-function
     summaries; a summary is any object with
-    ``apply(arg_taints, kwarg_taints) -> frozenset``.
+    ``apply(arg_taints, kwarg_taints, order_labels) -> frozenset``
+    (see :class:`kubeflow_tpu.analysis.callgraph.Summary`).
     """
 
     def __init__(
@@ -242,7 +311,9 @@ class FunctionDataflow:
         if isinstance(stmt, _CondEval):
             self.expr_taint(stmt.test, state)
         elif isinstance(stmt, _IterEval):
-            taint = self.expr_taint(stmt.iter, state)
+            taint = self._iterated_taint(
+                self.expr_taint(stmt.iter, state), stmt.lineno
+            )
             self._bind(stmt.target, VarInfo(taint,
                                             frozenset([stmt.lineno])), state)
         elif isinstance(stmt, _WithEval):
@@ -311,6 +382,31 @@ class FunctionDataflow:
         if key is not None:
             state[key] = info
 
+    def _drop_markers(self, taint: frozenset) -> frozenset:
+        markers = self.registry.container_markers
+        if not markers:
+            return taint
+        return frozenset(
+            t for t in taint
+            if not any(t.startswith(m) for m in markers)
+        )
+
+    def _iterated_taint(self, taint: frozenset, lineno: int) -> frozenset:
+        """Taint of a loop/comprehension target bound from an iterable
+        with ``taint``. Container-type markers convert to their mapped
+        iteration label here — the iteration is where element *order*
+        becomes observable — and the marker itself is dropped (a set's
+        elements are not themselves sets)."""
+        if not self.registry.iter_sources:
+            return taint
+        out = set(taint)
+        for marker, label in self.registry.iter_sources.items():
+            hit = [t for t in taint if t.startswith(marker)]
+            if hit:
+                out.difference_update(hit)
+                out.add(f"{label} (line {lineno})")
+        return frozenset(out)
+
     # -- expressions -----------------------------------------------------
     def expr_taint(self, expr: ast.AST, state: State) -> frozenset:
         if isinstance(expr, ast.Name):
@@ -352,13 +448,19 @@ class FunctionDataflow:
             out = self.expr_taint(expr.left, state)
             for comp in expr.comparators:
                 out |= self.expr_taint(comp, state)
-            return out
+            # A comparison observes contents, never iteration order —
+            # ``x in some_set`` is deterministic even though iterating
+            # the set is not. Container-type markers don't survive.
+            return self._drop_markers(out)
         if isinstance(expr, (ast.JoinedStr, ast.Tuple, ast.List, ast.Set)):
             out = frozenset()
             for value in getattr(expr, "values", None) or getattr(
                 expr, "elts", ()
             ):
                 out |= self.expr_taint(value, state)
+            if isinstance(expr, ast.Set) and \
+                    self.registry.set_literal_label is not None:
+                out |= frozenset([self.registry.set_literal_label])
             return out
         if isinstance(expr, ast.FormattedValue):
             return self.expr_taint(expr.value, state)
@@ -374,13 +476,31 @@ class FunctionDataflow:
             return self.expr_taint(expr.value, state)
         if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
                              ast.DictComp)):
+            # Comprehension targets live in their own scope: bind them
+            # locally (with container markers converted to iteration
+            # labels, exactly as a ``for`` statement's would be) so the
+            # element expression sees the comprehension's value — not a
+            # stale same-named variable from the enclosing function.
+            local = self.comp_state(expr, state)
             out = frozenset()
-            for gen in expr.generators:
-                out |= self.expr_taint(gen.iter, state)
             for field in ("elt", "key", "value"):
                 sub = getattr(expr, field, None)
                 if sub is not None:
-                    out |= self.expr_taint(sub, state)
+                    out |= self.expr_taint(sub, local)
+            if isinstance(expr, ast.SetComp):
+                # A set is unordered: its CONTENTS are the same
+                # whatever order the generators ran in, so order
+                # labels don't survive — value labels (clocks, hashes)
+                # do, and the container marker marks it as a set.
+                out = frozenset(
+                    t for t in out
+                    if not any(t.startswith(p)
+                               for p in self.registry.order_labels)
+                )
+                if self.registry.set_literal_label is not None:
+                    out |= frozenset(
+                        [self.registry.set_literal_label]
+                    )
             return out
         if isinstance(expr, ast.Await):
             return self.expr_taint(expr.value, state)
@@ -388,10 +508,33 @@ class FunctionDataflow:
 
     def _call_taint(self, call: ast.Call, state: State) -> frozenset:
         dotted = dotted_name(call.func, self.aliases)
-        if self.registry.is_sanitizer(dotted):
-            # Sanitizer result is rank-coherent regardless of inputs —
-            # that is the sanitizer's whole contract.
-            return frozenset()
+        sanitizer = self.registry.sanitizer_for(dotted)
+        if sanitizer is not None:
+            if sanitizer.clears is None:
+                # Full sanitizer: result is coherent regardless of
+                # inputs — that is the sanitizer's whole contract.
+                return frozenset()
+            # Partial sanitizer: scrub only the named label prefixes
+            # from the pass-through taint (``sorted()`` stabilizes
+            # order but a wall-clock VALUE rides through untouched).
+            # Parameter placeholders survive as their ORDERED variant:
+            # the summary records that this flow is order-scrubbed, so
+            # callers keep value taint through it but not order taint.
+            out = frozenset()
+            if isinstance(call.func, ast.Attribute):
+                out |= self.expr_taint(call.func.value, state)
+            for arg in call.args:
+                out |= self.expr_taint(arg, state)
+            for kw in call.keywords:
+                out |= self.expr_taint(kw.value, state)
+            kept = set()
+            for t in out:
+                if any(t.startswith(p) for p in sanitizer.clears):
+                    continue
+                if t.startswith(PARAM_PREFIX):
+                    t = ORDERED_PARAM_PREFIX + t[len(PARAM_PREFIX):]
+                kept.add(t)
+            return frozenset(kept)
         label = self.registry.source_label(dotted)
         if label is not None:
             return frozenset([f"{label} (line {call.lineno})"])
@@ -403,7 +546,8 @@ class FunctionDataflow:
         if self.resolver is not None:
             summary = self.resolver(dotted, call)
             if summary is not None:
-                return summary.apply(arg_taints, kwarg_taints)
+                return summary.apply(arg_taints, kwarg_taints,
+                                     self.registry.order_labels)
         # Unknown callable: conservatively pass taint through from the
         # receiver and every argument.
         out = frozenset()
@@ -413,4 +557,147 @@ class FunctionDataflow:
             out |= taint
         for taint in kwarg_taints.values():
             out |= taint
+        # A CamelCase call is, by convention, a constructor: the new
+        # object *holds* a set argument, it isn't one — its own module
+        # scan seeds its set-valued attributes directly. Value taint
+        # (clocks, hashes, iteration-order labels) still passes.
+        last = dotted.rsplit(".", 1)[-1].lstrip("_")
+        if last[:1].isupper():
+            out = self._drop_markers(out)
         return out
+
+    def comp_state(self, expr, state: State) -> State:
+        """State inside a comprehension: the enclosing state plus the
+        generator targets bound from their (iteration-converted)
+        iterables, in order."""
+        local = dict(state)
+        for gen in expr.generators:
+            taint = self._iterated_taint(
+                self.expr_taint(gen.iter, local), expr.lineno
+            )
+            self._bind(gen.target,
+                       VarInfo(taint, frozenset([expr.lineno])), local)
+        return local
+
+    def calls_with_states(self, stmt: ast.stmt, state: State):
+        """Yield ``(call, state)`` for every call in ``stmt`` (nested
+        defs excluded), with comprehension-internal calls paired with
+        the comprehension-local state — so a sink argument reading the
+        comprehension target sees the comprehension's binding, not a
+        stale outer variable of the same name."""
+        comps = (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                 ast.DictComp)
+
+        def walk(node, st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(node, comps):
+                # Targets bind progressively: generator N's iterable
+                # (and its own calls) may read generators 0..N-1's
+                # targets, so each iter is walked with the state built
+                # so far — not the outer state.
+                local = dict(st)
+                for gen in node.generators:
+                    yield from walk(gen.iter, dict(local))
+                    taint = self._iterated_taint(
+                        self.expr_taint(gen.iter, local), node.lineno
+                    )
+                    self._bind(gen.target,
+                               VarInfo(taint,
+                                       frozenset([node.lineno])),
+                               local)
+                    for cond in gen.ifs:
+                        yield from walk(cond, local)
+                for field in ("elt", "key", "value"):
+                    sub = getattr(node, field, None)
+                    if sub is not None:
+                        yield from walk(sub, local)
+                return
+            if isinstance(node, ast.Call):
+                yield node, st
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, st)
+
+        yield from walk(stmt, state)
+
+    # -- sinks -----------------------------------------------------------
+    def sink_taint(self, spec: SinkSpec, call: ast.Call,
+                   state: State) -> frozenset:
+        """Union taint of the arguments ``spec`` marks sink-feeding at
+        this call site (receiver-label gating already assumed checked)."""
+        out = frozenset()
+        if spec.args is None:
+            for arg in call.args:
+                out |= self.expr_taint(arg, state)
+        else:
+            for idx in spec.args:
+                if idx < len(call.args):
+                    out |= self.expr_taint(call.args[idx], state)
+        for kw in call.keywords:
+            if spec.keywords and kw.arg in spec.keywords:
+                out |= self.expr_taint(kw.value, state)
+            elif spec.args is None and kw.arg is None:
+                out |= self.expr_taint(kw.value, state)  # **kwargs splat
+        return out
+
+    def sink_hits(self, aliases: dict[str, str] | None = None):
+        """Yield ``(spec, call, state, taint)`` for every registry-sink
+        call in this CFG, post-fixpoint, in program order — the raw
+        material for both pack findings and the ``param→sink`` half of
+        a function's interprocedural summary. ``state`` is the
+        (comprehension-aware) variable state the call's arguments were
+        evaluated in."""
+        aliases = self.aliases if aliases is None else aliases
+        for _block, stmt, state in self.iter_statement_states():
+            for call, call_state in self.calls_with_states(stmt, state):
+                dotted = dotted_name(call.func, aliases)
+                if not dotted:
+                    continue
+                for spec in self.registry.sinks:
+                    if not spec.pattern.matches(dotted):
+                        continue
+                    if spec.receiver_label is not None:
+                        if not isinstance(call.func, ast.Attribute):
+                            continue
+                        recv = self.expr_taint(
+                            call.func.value, call_state
+                        )
+                        if not any(t.startswith(spec.receiver_label)
+                                   for t in recv):
+                            continue
+                    yield spec, call, call_state, self.sink_taint(
+                        spec, call, call_state
+                    )
+
+
+def calls_in(node: ast.AST):
+    """Call nodes inside ``node`` — the node itself included — without
+    descending into nested function/class definitions (those bodies are
+    analyzed as their own CFGs, under their own guards)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    if isinstance(node, ast.Call):
+        yield node
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        if isinstance(child, ast.Call):
+            yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def source_desc(labels) -> str:
+    """Human form of a taint set: line anchors stripped (so baseline
+    keys survive unrelated edits), internal ``<...>`` type markers
+    rendered as their bare container name."""
+    names = sorted({
+        label.split(" (line")[0].strip("<>")
+        for label in labels
+        if not label.startswith((PARAM_PREFIX, ORDERED_PARAM_PREFIX))
+    })
+    return ", ".join(names)

@@ -1,0 +1,445 @@
+"""Pack C — replay determinism over the interprocedural dataflow engine.
+
+The platform's correctness story rests on byte-identical replay: the
+soak, game-day, contention and chaos suites all gate on
+``replay_digest`` equality. The bug class that breaks it is always the
+same — a nondeterministic value or *order* leaks into the digest or the
+event stream — and PR 13 paid for it in blood when unordered ``set``
+iteration in the scheduler's drain expiry changed completion order
+across replays and had to be found by a 10k-CR soak. These rules catch
+that class in milliseconds, across helper boundaries, before any soak
+runs:
+
+- ``det-unstable-iteration-order`` (error in replay-gated trees —
+  ``loadtest/``, ``chaos/``, ``scheduler/``, ``controllers/`` — warning
+  elsewhere): a value bound by iterating a ``set`` (or a set serialized
+  whole, or a ``concurrent.futures.as_completed`` completion stream)
+  reaches an ordered-emission sink (``.append``/``.write``/queue puts
+  feeding JSONL/event logs) or a digest. Set iteration order is
+  arbitrary per process; the PR 13 fix — iterate
+  ``sorted(s, key=lambda w: w.seq)`` — is clean by construction because
+  ``sorted()`` is a registered sanitizer.
+- ``det-wallclock-in-replay`` (error): a host wall-clock reading
+  (``time.time``/``monotonic``/``perf_counter``, ``datetime.now``)
+  reaches a digest update or seeds an RNG. Durations *measured* and
+  reported are fine — the sink set is deliberately digest/seed only,
+  mirroring the soak's own rule that latency stats are measured and
+  gated but excluded from the digest.
+- ``det-salted-hash-coordination`` (error): builtin ``hash()`` —
+  PYTHONHASHSEED-salted per process, the rule ``shard_of``'s docstring
+  already codifies — reaches a digest, an ordered emission, or an RNG
+  seed. Replicas cannot agree on a salted hash; ``shard_of``-style
+  stable digests are the sanctioned (and sanitized) idiom.
+- ``det-unseeded-rng`` (warning): a draw on the process-global
+  ``random``/``numpy.random`` module state. Replay needs every draw
+  accountable to a scenario seed: use a threaded ``random.Random(seed)``
+  instance (constructing one, even unseeded-injectable, does not warn —
+  draws on instances are attributable; ``jax.random`` is keyed and
+  never warns).
+
+Taint crosses function and module boundaries through the
+SCC-condensed bottom-up summaries (:mod:`callgraph` ``param_sinks``):
+the PR 13 shape — iteration in ``expire()``, the ``.append`` two
+helpers down in ``_record()`` — fires at the ``expire()`` call site.
+Known limits, by design: plain dict iteration is insertion-ordered in
+every supported Python and therefore deterministic (not flagged); a
+digest *object* handed into a helper is not tracked through the
+parameter (feed digests where you build them, or hash a composed
+payload — the constructor-argument sink covers that idiom).
+
+Sanitizers: ``sorted()``, order-insensitive reductions
+(``len``/``sum``/``min``/``max``/``any``/``all``), and
+``shard_of``-style stable digests. Injectable clocks (a ``now``
+parameter) are clean by construction — parameters carry no source
+taint. Test trees are exempt; the fixture suite seeds every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.callgraph import CallGraph
+from kubeflow_tpu.analysis.dataflow import (
+    CallPattern,
+    FunctionDataflow,
+    SinkSpec,
+    TaintRegistry,
+    dotted_name,
+    import_aliases,
+    is_test_path,
+    source_desc,
+)
+from kubeflow_tpu.analysis.findings import Finding, Severity
+
+# Internal type markers: never rendered as findings, only consumed by
+# sink gating (digest receivers) and iteration conversion (sets).
+_SET_MARKER = "<set-valued>"
+_DIGEST_MARKER = "<digest-object>"
+
+_WALLCLOCK = "host wall clock"
+_SALTED_HASH = "salted hash()"
+_THREAD_ORDER = "thread completion order"
+_SET_ITERATION = "unordered set iteration"
+
+DET_SOURCES = (
+    CallPattern(
+        _WALLCLOCK,
+        exact=(
+            "time.time", "time.time_ns", "time.monotonic",
+            "time.monotonic_ns", "time.perf_counter",
+            "time.perf_counter_ns",
+            "datetime.datetime.now", "datetime.datetime.utcnow",
+            "datetime.datetime.today", "datetime.now", "datetime.utcnow",
+            "datetime.date.today", "date.today",
+        ),
+    ),
+    CallPattern(_SALTED_HASH, exact=("hash",)),
+    CallPattern(
+        _THREAD_ORDER,
+        exact=("concurrent.futures.as_completed", "as_completed"),
+        suffixes=(".as_completed", ".imap_unordered"),
+    ),
+    CallPattern(_SET_MARKER, exact=("set", "frozenset")),
+    CallPattern(_DIGEST_MARKER, prefixes=("hashlib.",)),
+)
+
+# Labels that describe *order*, not value — what an order-insensitive
+# operation scrubs. Parameter placeholders deliberately SURVIVE: a
+# helper like ``def stable(xs): return sorted(xs)`` keeps ``xs`` in
+# its return deps, so a wall-clock value refactored behind it still
+# reaches the digest finding — ``sorted([time.time()])`` is stably
+# ordered and still nondeterministic, helper or no helper. The cost is
+# that an order label can ride a sorting helper's dep back out to the
+# caller; that shape is rarer than the clock-through-helper one, and a
+# pragma on the (sorted, provably order-free) call site is honest.
+_ORDER_CLEARS = (_SET_MARKER, _SET_ITERATION, _THREAD_ORDER)
+
+DET_SANITIZERS = (
+    # Partial sanitizers: impose/ignore order, pass values through.
+    CallPattern(
+        "order-insensitive",
+        exact=("sorted", "sum", "min", "max", "any", "all"),
+        clears=_ORDER_CLEARS,
+    ),
+    # Full sanitizers: the result carries no input value at all (a
+    # count), or is the platform's stable-digest idiom (sha1 over a
+    # canonical encoding — never salted hash()).
+    CallPattern("cardinality", exact=("len",)),
+    CallPattern(
+        "stable shard digest",
+        exact=("shard_of",),
+        suffixes=(".shard_of",),
+    ),
+)
+
+DET_SINKS = (
+    # h.update(x) where h provably came from hashlib.*
+    SinkSpec("digest", CallPattern(
+        "digest update", suffixes=(".update",),
+    ), receiver_label=_DIGEST_MARKER),
+    # hashlib.sha256(payload) — digest input at construction.
+    SinkSpec("digest", CallPattern(
+        "digest input", prefixes=("hashlib.",),
+    )),
+    # Conventionally named replay-digest feeding helpers.
+    SinkSpec("digest", CallPattern(
+        "replay digest helper",
+        exact=("replay_digest",),
+        suffixes=(".replay_digest", "_replay_digest"),
+    )),
+    SinkSpec("emission", CallPattern(
+        "ordered emission",
+        suffixes=(".append", ".appendleft", ".extend", ".write",
+                  ".writelines", ".put", ".put_nowait"),
+    )),
+    SinkSpec("rng-seed", CallPattern(
+        "RNG seed",
+        exact=("random.Random", "random.seed",
+               "np.random.seed", "numpy.random.seed",
+               "np.random.default_rng", "numpy.random.default_rng"),
+    )),
+)
+
+# (label prefixes, sink kinds, rule) — which taint reaching which sink
+# fires what. Wall clocks deliberately exclude the emission kind:
+# measured latencies belong in reports, just never in the digest.
+_SINK_RULES = (
+    ((_WALLCLOCK,), ("digest", "rng-seed"), "det-wallclock-in-replay"),
+    ((_SALTED_HASH,), ("digest", "emission", "rng-seed"),
+     "det-salted-hash-coordination"),
+    ((_SET_ITERATION, _SET_MARKER, _THREAD_ORDER),
+     ("digest", "emission"), "det-unstable-iteration-order"),
+)
+
+# Trees whose modules feed a replay_digest gate: ordering slips are
+# errors here, warnings elsewhere.
+_REPLAY_GATED = frozenset({"loadtest", "chaos", "scheduler", "controllers"})
+
+_REMEDY = {
+    "det-wallclock-in-replay": (
+        "replay re-runs the scenario at a different wall time, so the "
+        "digest can never match — thread the scenario clock (an "
+        "injectable now/now_fn) instead, or keep measured timings out "
+        "of the digest"
+    ),
+    "det-salted-hash-coordination": (
+        "builtin hash() is PYTHONHASHSEED-salted per process, so no "
+        "two replicas or replays agree on it — use a stable digest "
+        "(shard_of, hashlib over a canonical encoding)"
+    ),
+    "det-unstable-iteration-order": (
+        "set iteration order is arbitrary per process, so replayed "
+        "runs emit in different orders and the digest tears — iterate "
+        "a sorted()/seq-keyed view (the PR 13 drain-expiry fix), or "
+        "serialize sorted(s)"
+    ),
+}
+
+_KIND_DESC = {
+    "digest": "a replay digest",
+    "emission": "an ordered event emission",
+    "rng-seed": "an RNG seed",
+}
+
+
+def _module_rng_draws() -> frozenset:
+    draws = (
+        "random", "randint", "randrange", "getrandbits", "randbytes",
+        "choice", "choices", "shuffle", "sample", "uniform",
+        "triangular", "betavariate", "expovariate", "gammavariate",
+        "gauss", "lognormvariate", "normalvariate", "vonmisesvariate",
+        "paretovariate", "weibullvariate",
+    )
+    np_draws = (
+        "rand", "randn", "randint", "random", "random_sample", "ranf",
+        "sample", "choice", "shuffle", "permutation", "bytes", "normal",
+        "uniform", "standard_normal", "poisson", "exponential", "beta",
+        "binomial", "gamma",
+    )
+    out = {f"random.{name}" for name in draws}
+    for prefix in ("np.random", "numpy.random"):
+        out.update(f"{prefix}.{name}" for name in np_draws)
+    return frozenset(out)
+
+
+_RNG_DRAWS = _module_rng_draws()
+
+
+def _set_valued_attrs(tree: ast.AST) -> dict:
+    """Attribute names only ever assigned set-typed values (set
+    displays/comprehensions, ``set()``/``frozenset()`` calls, or a
+    bare ``: set[...]`` annotation; ``None`` deferred-init allowed) —
+    seeded with the container marker so iterating them anywhere in the
+    module converts to the iteration-order label. An attribute also
+    assigned some other computed value is NOT seeded: the author
+    rebinds it to an ordered form somewhere, and guessing would flood
+    the pack with false positives."""
+
+    def is_set_typed(value: ast.AST | None) -> bool:
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(value, ast.Call):
+            return dotted_name(value.func, {}).rsplit(".", 1)[-1] in (
+                "set", "frozenset"
+            )
+        return False
+
+    set_assigned: set[str] = set()
+    other_assigned: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+            ann = ast.unparse(node.annotation) if node.annotation else ""
+            if value is None and ann.split("[")[0].strip() in (
+                "set", "Set", "frozenset", "FrozenSet"
+            ):
+                for target in targets:
+                    if isinstance(target, ast.Attribute):
+                        key = dotted_name(target, {})
+                        if key:
+                            set_assigned.add(key)
+                continue
+        else:
+            continue
+        is_none = isinstance(value, ast.Constant) and value.value is None
+        for target in targets:
+            if not isinstance(target, ast.Attribute):
+                continue
+            key = dotted_name(target, {})
+            if not key:
+                continue
+            if is_set_typed(value):
+                set_assigned.add(key)
+            elif not is_none:
+                other_assigned.add(key)
+    return {
+        key: [_SET_MARKER]
+        for key in sorted(set_assigned - other_assigned)
+    }
+
+
+def build_registry(tree: ast.AST) -> TaintRegistry:
+    return TaintRegistry(
+        sources=DET_SOURCES,
+        sanitizers=DET_SANITIZERS,
+        seed=_set_valued_attrs(tree),
+        sinks=DET_SINKS,
+        iter_sources={_SET_MARKER: _SET_ITERATION},
+        set_literal_label=_SET_MARKER,
+        order_labels=_ORDER_CLEARS,
+    )
+
+
+def _is_replay_gated(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(part in _REPLAY_GATED for part in parts)
+
+
+class _FunctionScan:
+    def __init__(self, graph: CallGraph, registry: TaintRegistry,
+                 aliases: dict[str, str], path: str,
+                 out: list[Finding]) -> None:
+        self.graph = graph
+        self.registry = registry
+        self.aliases = aliases
+        self.path = path
+        self.out = out
+        self._seen: set[tuple[str, int]] = set()
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if (rule, line) in self._seen:
+            return
+        self._seen.add((rule, line))
+        if rule == "det-unseeded-rng":
+            severity = Severity.WARNING
+        elif rule == "det-unstable-iteration-order" and \
+                not _is_replay_gated(self.path):
+            severity = Severity.WARNING
+        else:
+            severity = Severity.ERROR
+        self.out.append(Finding(rule, severity, self.path, line, message))
+
+    def _sink_findings(self, kind: str, line: int, taint: frozenset,
+                       via: str) -> None:
+        for prefixes, kinds, rule in _SINK_RULES:
+            if kind not in kinds:
+                continue
+            hit = frozenset(
+                t for t in taint
+                if any(t.startswith(p) for p in prefixes)
+            )
+            if not hit:
+                continue
+            self._emit(rule, line, (
+                f"value derived from {source_desc(hit)} reaches "
+                f"{_KIND_DESC[kind]} via {via}: {_REMEDY[rule]} (or "
+                f"annotate a provably replay-stable path with "
+                f"# analysis: allow[{rule}])"
+            ))
+
+    def scan(self, body: list[ast.stmt], scope: tuple[str, ...],
+             cls: str | None) -> None:
+        resolve = self.graph.resolver(scope, cls)
+        flow = FunctionDataflow(
+            cfg_mod.build_cfg(body), self.registry, self.aliases,
+            resolver=resolve,
+        )
+        # Direct sink hits in this body.
+        for spec, call, _state, taint in flow.sink_hits():
+            display = dotted_name(
+                call.func, self.aliases
+            ).rsplit(".", 1)[-1]
+            self._sink_findings(
+                spec.kind, call.lineno, taint, f"{display}()"
+            )
+        # Call sites whose callee summaries route an argument into a
+        # sink (the interprocedural half), plus the RNG presence rule.
+        for _block, stmt, state in flow.iter_statement_states():
+            for call, call_state in flow.calls_with_states(stmt, state):
+                dotted = dotted_name(call.func, self.aliases)
+                if not dotted:
+                    continue
+                if dotted in _RNG_DRAWS:
+                    display = dotted.rsplit(".", 1)[-1]
+                    self._emit("det-unseeded-rng", call.lineno, (
+                        f"{dotted}() draws from the process-global RNG: "
+                        "replay cannot account this draw to a scenario "
+                        "seed — thread a seeded random.Random(seed) / "
+                        "np.random.default_rng(seed) instance (or "
+                        "annotate a non-replayed path with # analysis: "
+                        "allow[det-unseeded-rng])"
+                    ))
+                    continue
+                summary = resolve(dotted, call)
+                if summary is None or not (
+                    summary.param_sinks or summary.ordered_param_sinks
+                ):
+                    continue
+                arg_taints = [
+                    flow.expr_taint(a, call_state) for a in call.args
+                ]
+                kwarg_taints = {
+                    kw.arg: flow.expr_taint(kw.value, call_state)
+                    for kw in call.keywords if kw.arg
+                }
+                display = dotted.rsplit(".", 1)[-1]
+                flows = summary.sink_flows(
+                    arg_taints, kwarg_taints, self.registry.order_labels
+                )
+                for kind in sorted(flows):
+                    self._sink_findings(
+                        kind, call.lineno, flows[kind],
+                        f"{display}() (which feeds it into "
+                        f"{_KIND_DESC[kind]} internally)",
+                    )
+
+
+def analyze_python_determinism(
+    source: str, path: str, context=None, mode: str = "fixpoint",
+) -> list[Finding]:
+    """Pack C over one Python file. ``context`` supplies the shared
+    parse + cross-module project index; ``mode="one-level"`` runs the
+    pre-interprocedural summary engine (regression pinning only)."""
+    if is_test_path(path):
+        return []
+    if context is not None:
+        tree = context.tree
+    else:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # ast_rules already reports py-syntax
+    aliases = import_aliases(tree)
+    graph = None
+    if context is not None and context.project is not None and \
+            mode == "fixpoint":
+        # Shared with cross-module resolution: if another module's
+        # scan already pulled this file in, the SCC fixpoint is free.
+        graph = context.project.pack_graph(
+            context.abspath, "determinism", build_registry
+        )
+    if graph is None:
+        registry = build_registry(tree)
+        fallback = None
+        if context is not None and context.project is not None:
+            fallback = context.project.fallback(
+                "determinism", build_registry, from_path=context.abspath
+            )
+        graph = CallGraph(tree, registry, aliases, mode=mode,
+                          fallback=fallback)
+    registry = graph.registry
+    out: list[Finding] = []
+    scan = _FunctionScan(graph, registry, aliases, path, out)
+    scan.scan(list(tree.body), scope=(), cls=None)
+    for qual in sorted(graph.functions):
+        info = graph.functions[qual]
+        scan.scan(
+            info.node.body,
+            scope=info.scope + (info.qualname,),
+            cls=info.cls,
+        )
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out

@@ -4,8 +4,15 @@ The engine owns everything rule packs shouldn't: which files are
 scanned, how findings are suppressed, and how the result is rendered
 and gated (text, json, or SARIF for CI diff annotation). Rule packs
 stay pure functions from file content to findings — including the
-dataflow-backed SPMD and concurrency packs, whose CFG/taint machinery
-lives behind the same per-file interface.
+dataflow-backed SPMD, concurrency and determinism packs, whose
+CFG/taint machinery lives behind the same per-file interface.
+
+Each scan parses every Python file exactly once into a shared
+:class:`~kubeflow_tpu.analysis.project.ParseCache` and hands the tree
+to all packs through an :class:`AnalysisContext`, which also carries
+the :class:`~kubeflow_tpu.analysis.project.ProjectIndex` the
+dataflow packs use for cross-module summaries. ``ScanStats`` reports
+how much work that saved (files, parses, wall time) for ``--stats``.
 """
 
 from __future__ import annotations
@@ -13,10 +20,12 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 
 from kubeflow_tpu.analysis import (
     ast_rules,
     concurrency_rules,
+    determinism_rules,
     manifest_rules,
     mesh_rules,
     spmd_rules,
@@ -26,6 +35,11 @@ from kubeflow_tpu.analysis.findings import (
     Severity,
     is_suppressed,
     load_baseline,
+)
+from kubeflow_tpu.analysis.project import (
+    AnalysisContext,
+    ParseCache,
+    ProjectIndex,
 )
 
 # Directories never scanned: VCS/caches, vendored frontends, and the
@@ -47,6 +61,42 @@ class AnalysisConfig:
     exclude_dirs: set[str] = dataclasses.field(
         default_factory=lambda: set(DEFAULT_EXCLUDE_DIRS)
     )
+    # --changed-only narrows the scan to these absolute paths WITHOUT
+    # changing the roots, so finding attribution (and therefore
+    # baseline/pragma keys) is identical to a full scan.
+    file_filter: set[str] | None = None
+    # Share a parse cache with whoever prepared the scan (the
+    # --changed-only closure builder parses the tree for its import
+    # graph — the scan must not parse those files again).
+    parse_cache: ParseCache | None = None
+    # Filled in by analyze_paths for --stats reporting.
+    stats: "ScanStats | None" = None
+
+
+@dataclasses.dataclass
+class ScanStats:
+    """What one scan cost — surfaced by the CLI ``--stats`` flag."""
+
+    files_scanned: int = 0
+    python_files: int = 0
+    parses: int = 0  # ast.parse calls incl. lazy cross-module loads
+    findings: int = 0
+    wall_s: float = 0.0
+
+    def render(self) -> str:
+        # parses counts UNIQUE files parsed (at most once each, shared
+        # by all packs): the scanned python files plus any module the
+        # project index or --changed-only closure loaded beyond them.
+        lazy = max(0, self.parses - self.python_files)
+        lazy_note = f" + {lazy} beyond the scan (lazy cross-module/" \
+            f"closure loads)" if lazy else ""
+        return (
+            f"scanned {self.files_scanned} file(s) "
+            f"({self.python_files} python; {self.parses} parse(s): "
+            f"one per scanned file{lazy_note}, shared by all packs) "
+            f"in {self.wall_s * 1000.0:.0f} ms; "
+            f"{self.findings} finding(s) pre-baseline"
+        )
 
 
 def _iter_files(config: AnalysisConfig):
@@ -88,28 +138,62 @@ def analyze_paths(config: AnalysisConfig) -> list[Finding]:
     """Run every rule pack over the configured paths; returns findings
     with pragma-suppressed occurrences removed (baseline filtering is
     the caller's policy — see :func:`partition_baseline`)."""
+    started = time.monotonic()
+    stats = ScanStats()
+    config.stats = stats
     findings: list[Finding] = []
     manifest_state: dict = {}
+    # `is None`, not `or`: an empty ParseCache is falsy (__len__).
+    cache = config.parse_cache if config.parse_cache is not None \
+        else ParseCache()
+    project = ProjectIndex(config.paths, cache)
     # Source lines of scanned YAML files, for pragma checks on the
     # cross-file findings finalized after the walk.
     yaml_lines: dict[str, list[str]] = {}
     for path in _iter_files(config):
         if not path.endswith((".py", ".yaml", ".yml", ".md")):
             continue  # no rule pack handles it: don't even read it
+        if config.file_filter is not None and \
+                os.path.abspath(path) not in config.file_filter:
+            continue
         rel = _rel(path, config.paths)
         try:
             with open(path, encoding="utf-8", errors="replace") as fh:
                 text = fh.read()
         except OSError:
             continue
+        stats.files_scanned += 1
         file_findings: list[Finding] = []
         if path.endswith(".py"):
-            file_findings += ast_rules.analyze_python_source(text, rel)
-            file_findings += mesh_rules.analyze_python_mesh(text, rel)
-            file_findings += spmd_rules.analyze_python_spmd(text, rel)
-            file_findings += concurrency_rules.analyze_python_concurrency(
-                text, rel
+            stats.python_files += 1
+            context = None
+            # At most one parse per file — a cache hit (a lazy
+            # cross-module load got there first) is reused; None on
+            # syntax errors (ast_rules re-parses to emit py-syntax).
+            tree = cache.get_from_source(path, text)
+            if tree is not None:
+                context = AnalysisContext(
+                    tree=tree, abspath=os.path.abspath(path),
+                    project=project,
+                )
+            file_findings += ast_rules.analyze_python_source(
+                text, rel, context
             )
+            if context is not None:
+                file_findings += mesh_rules.analyze_python_mesh(
+                    text, rel, context
+                )
+                file_findings += spmd_rules.analyze_python_spmd(
+                    text, rel, context
+                )
+                file_findings += \
+                    concurrency_rules.analyze_python_concurrency(
+                        text, rel, context
+                    )
+                file_findings += \
+                    determinism_rules.analyze_python_determinism(
+                        text, rel, context
+                    )
         elif path.endswith((".yaml", ".yml")):
             # Kustomize reference checks resolve against the real
             # directory, so the manifest pack gets absolute paths and
@@ -139,6 +223,11 @@ def analyze_paths(config: AnalysisConfig) -> list[Finding]:
     if config.check_emitted:
         findings += manifest_rules.emitted_state_findings()
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # Parses = every cache entry: one per scanned python file plus any
+    # lazy cross-module load the project index pulled in.
+    stats.parses = len(cache)
+    stats.findings = len(findings)
+    stats.wall_s = time.monotonic() - started
     return findings
 
 

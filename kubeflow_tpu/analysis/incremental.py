@@ -1,0 +1,205 @@
+"""``--changed-only`` scoping: git-diff seed + reverse-dependency
+closure.
+
+A pre-commit scan doesn't need the whole tree: it needs the files the
+commit touches *and every file whose analysis could change because of
+them* — with interprocedural summaries, editing a helper can surface a
+finding in an unchanged caller. The closure is computed over the
+module import graph (parsed from the same shared cache the scan
+uses): seed = ``git diff --name-only <ref>`` plus untracked files,
+then every transitive importer of a seeded module is re-scanned too.
+Non-Python changed files (manifests, docs) ride along directly.
+
+The result feeds ``AnalysisConfig.file_filter``: the walk, the roots
+and therefore finding attribution and baseline keys are IDENTICAL to a
+full scan — only files outside the closure are skipped. When git is
+unavailable (no repo, no binary) the caller falls back to a full scan
+rather than silently scanning nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+
+from kubeflow_tpu.analysis.engine import DEFAULT_EXCLUDE_DIRS
+from kubeflow_tpu.analysis.project import ParseCache, package_search_roots
+
+
+def _git_root(path: str) -> str | None:
+    base = path if os.path.isdir(path) else os.path.dirname(path)
+    try:
+        proc = subprocess.run(
+            ["git", "-C", base, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    top = proc.stdout.strip()
+    return top or None
+
+
+def _git_changed(repo_root: str, ref: str) -> set[str] | None:
+    """Worktree-vs-ref changed files plus untracked, absolute paths;
+    None when git can't answer (caller falls back to a full scan)."""
+    names: set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "-C", repo_root, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        names.update(diff.stdout.splitlines())
+        untracked = subprocess.run(
+            ["git", "-C", repo_root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if untracked.returncode == 0:
+            names.update(untracked.stdout.splitlines())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {
+        os.path.abspath(os.path.join(repo_root, name))
+        for name in names if name.strip()
+    }
+
+
+def _python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    seen: set[str] = set()
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            if path.endswith(".py") and path not in seen:
+                seen.add(path)
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if d not in DEFAULT_EXCLUDE_DIRS
+            )
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                if full not in seen:
+                    seen.add(full)
+                    out.append(full)
+    return out
+
+
+def _module_names(path: str, roots: list[str]) -> list[str]:
+    """Dotted module names this file is importable as, one per root
+    that contains it (``pkg/mod.py`` → ``pkg.mod``; a package
+    ``__init__.py`` is the package itself)."""
+    out: list[str] = []
+    for root in roots:
+        root = os.path.abspath(root)
+        base = root if os.path.isdir(root) else os.path.dirname(root)
+        try:
+            rel = os.path.relpath(path, base)
+        except ValueError:
+            continue
+        if rel.startswith(".."):
+            continue
+        rel = rel[:-3]  # strip .py
+        parts = rel.replace("\\", "/").split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        if parts and all(p.isidentifier() for p in parts):
+            out.append(".".join(parts))
+    return out
+
+
+def _imported_modules(tree: ast.AST, own_package: str) -> set[str]:
+    """Dotted modules this tree imports. ``from pkg import name``
+    contributes both ``pkg`` and ``pkg.name`` (name may be a module);
+    relative imports resolve against ``own_package``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = own_package.split(".") if own_package else []
+                parts = parts[:len(parts) - (node.level - 1)] \
+                    if node.level > 1 else parts
+                base = ".".join(
+                    parts + ([node.module] if node.module else [])
+                )
+            else:
+                base = node.module or ""
+            if base:
+                out.add(base)
+            for alias in node.names:
+                if base and alias.name != "*":
+                    out.add(f"{base}.{alias.name}")
+    return out
+
+
+def changed_only_files(
+    paths: list[str], ref: str, cache: ParseCache | None = None,
+) -> set[str] | None:
+    """Absolute paths ``--changed-only`` should scan: the git-changed
+    set plus the reverse import closure over the scanned tree. None
+    when git can't answer (full scan is the safe fallback)."""
+    first = os.path.abspath(paths[0])
+    repo_root = _git_root(first)
+    if repo_root is None:
+        return None
+    changed = _git_changed(repo_root, ref)
+    if changed is None:
+        return None
+    if not any(p.endswith(".py") for p in changed):
+        # No Python changed ⇒ no import closure to compute: don't
+        # parse the tree just to discover an empty importer graph (the
+        # CI smoke runs exactly this clean-checkout case).
+        return changed
+    # `is None`, not `or`: an empty ParseCache is falsy (__len__).
+    cache = cache if cache is not None else ParseCache()
+    files = _python_files(paths)
+    # Module names resolve against the same package-aware roots as
+    # cross-module summaries: a scan rooted inside a package still
+    # maps its absolute "pkg.mod" imports.
+    name_roots = package_search_roots([
+        p if os.path.isdir(p) else os.path.dirname(p)
+        for p in (os.path.abspath(p) for p in paths)
+    ])
+    by_module: dict[str, str] = {}
+    for path in files:
+        for module in _module_names(path, name_roots):
+            by_module.setdefault(module, path)
+    # Reverse edges: imported file -> importers.
+    importers: dict[str, set[str]] = {}
+    for path in files:
+        tree = cache.get(path)
+        if tree is None:
+            continue
+        # A package __init__.py IS its package (its module name), so
+        # its level-1 relative imports resolve against itself; a plain
+        # module's resolve against its parent package.
+        is_init = os.path.basename(path) == "__init__.py"
+        own_packages = [
+            m if is_init else (m.rsplit(".", 1)[0] if "." in m else "")
+            for m in _module_names(path, name_roots)
+        ]
+        own_package = own_packages[0] if own_packages else ""
+        for module in _imported_modules(tree, own_package):
+            target = by_module.get(module)
+            if target is not None and target != path:
+                importers.setdefault(target, set()).add(path)
+    out = set(changed)
+    work = [p for p in changed if p.endswith(".py")]
+    while work:
+        path = work.pop()
+        for importer in sorted(importers.get(path, ())):
+            if importer not in out:
+                out.add(importer)
+                work.append(importer)
+    return out

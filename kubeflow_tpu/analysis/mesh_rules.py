@@ -131,7 +131,7 @@ def _check_meshspec(
     dp = axes.get("dp", -1)
     if len(chips) != 1:
         return  # no (or ambiguous) slice declaration in scope
-    n = next(iter(chips))
+    n = min(chips)  # singleton: order-insensitive extraction
     if dp > 0:
         if dp * fixed != n:
             out.append(Finding(
@@ -200,11 +200,15 @@ def _expected_failure_nodes(tree: ast.AST) -> set[int]:
     return out
 
 
-def analyze_python_mesh(source: str, path: str) -> list[Finding]:
-    try:
-        tree = ast.parse(source)
-    except SyntaxError:
-        return []  # ast_rules already reports the parse failure
+def analyze_python_mesh(source: str, path: str,
+                        context=None) -> list[Finding]:
+    if context is not None:
+        tree = context.tree
+    else:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # ast_rules already reports the parse failure
     out: list[Finding] = []
     expected_failures = _expected_failure_nodes(tree)
 

@@ -1,0 +1,233 @@
+"""Cross-module summary resolution + the shared per-scan parse cache.
+
+Two jobs, one lifetime (a single ``analyze_paths`` run):
+
+- :class:`ParseCache` — every rule pack used to ``ast.parse`` every
+  file itself, so a four-pack scan parsed the tree four times. The
+  engine now parses once per file and hands the tree to each pack via
+  :class:`AnalysisContext`; the cache also backs lazy loads of modules
+  the scan didn't walk (a ``--changed-only`` run still resolving a
+  helper in an unchanged module).
+- :class:`ProjectIndex` — the ``fallback`` hook for
+  :class:`~kubeflow_tpu.analysis.callgraph.CallGraph`: a call whose
+  dotted target no local lookup matches (``leader.shard_of(...)``
+  resolved through the import-alias map to
+  ``kubeflow_tpu.controllers.leader.shard_of``) is mapped to a file on
+  disk, that module's call graph is built lazily under the *calling
+  pack's* registry (each pack seeds per-module state, so graphs are
+  cached per ``(file, pack)``), and the named function's summary is
+  returned. Import cycles are broken by an in-progress guard that
+  answers ``None`` (conservative, never wrong, never loops).
+
+Module files are searched relative to the importing file's own
+directory first (sibling modules, the fixture-tree shape) and then
+each scan root (absolute ``kubeflow_tpu.*`` imports from the repo
+root). Methods other than ``Module.Class.method`` two-level names are
+not resolved — ``self.x`` dispatch never leaves the local graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+
+def package_search_roots(dirs: list[str]) -> list[str]:
+    """``dirs`` plus every ancestor reached by walking up past package
+    ``__init__.py`` markers — a scan rooted INSIDE a package ("scan
+    kubeflow_tpu/") must still map that package's absolute module
+    names (``kubeflow_tpu.x.y``) from the package's parent, exactly as
+    the interpreter would. Shared by cross-module summary resolution
+    and the --changed-only import graph (one mapping, one drift
+    surface)."""
+    extra = []
+    for root in dirs:
+        probe = root
+        while os.path.isfile(os.path.join(probe, "__init__.py")):
+            probe = os.path.dirname(probe)
+            extra.append(probe)
+    return list(dict.fromkeys(list(dirs) + extra))
+
+
+class ParseCache:
+    """abspath -> parsed tree (or None for unreadable/unparsable),
+    parsing each file at most once per scan."""
+
+    def __init__(self) -> None:
+        self._trees: dict[str, ast.AST | None] = {}
+
+    def get(self, path: str) -> ast.AST | None:
+        path = os.path.abspath(path)
+        if path in self._trees:
+            return self._trees[path]
+        tree: ast.AST | None = None
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            tree = None
+        self._trees[path] = tree
+        return tree
+
+    def get_from_source(self, path: str, text: str) -> ast.AST | None:
+        """Like :meth:`get`, but parse ``text`` the caller already
+        read instead of re-reading disk — still at most one parse per
+        path, even when a lazy cross-module load got there first."""
+        path = os.path.abspath(path)
+        if path in self._trees:
+            return self._trees[path]
+        try:
+            tree: ast.AST | None = ast.parse(text)
+        except SyntaxError:
+            tree = None
+        self._trees[path] = tree
+        return tree
+
+    def put(self, path: str, tree: ast.AST | None) -> None:
+        self._trees[os.path.abspath(path)] = tree
+
+    def __len__(self) -> int:
+        return len(self._trees)
+
+
+class ProjectIndex:
+    """Lazy per-pack call-graph index over the scanned tree."""
+
+    def __init__(self, roots: list[str],
+                 cache: ParseCache | None = None) -> None:
+        absolute = [os.path.abspath(root) for root in roots]
+        self.roots = package_search_roots([
+            root if os.path.isdir(root) else os.path.dirname(root)
+            for root in absolute
+        ])
+        # `is None`, not `or`: an EMPTY ParseCache is falsy (__len__),
+        # and replacing the shared cache with a fresh one silently
+        # doubles every parse.
+        self.cache = cache if cache is not None else ParseCache()
+        self._graphs: dict[tuple[str, str], object] = {}
+        self._building: set[tuple[str, str]] = set()
+        # (pack, from_dir, dotted) -> Summary | None: the same
+        # unresolved dotted names recur at every call site of a file.
+        self._resolved: dict[tuple[str, str | None, str], object] = {}
+
+    # -- module file resolution ------------------------------------------
+    def _module_file(self, module: str, from_dir: str | None) -> str | None:
+        rel = module.replace(".", os.sep)
+        search = ([from_dir] if from_dir else []) + self.roots
+        for base in search:
+            for candidate in (
+                os.path.join(base, rel + ".py"),
+                os.path.join(base, rel, "__init__.py"),
+            ):
+                if os.path.isfile(candidate):
+                    return os.path.abspath(candidate)
+        return None
+
+    def _graph_for(self, path: str, pack_key: str, registry_factory,
+                   make_graph):
+        key = (path, pack_key)
+        if key in self._graphs:
+            return self._graphs[key]
+        if key in self._building:
+            return None  # import cycle: answer conservatively
+        tree = self.cache.get(path)
+        if tree is None:
+            self._graphs[key] = None
+            return None
+        self._building.add(key)
+        try:
+            graph = make_graph(tree, path)
+        finally:
+            self._building.discard(key)
+        self._graphs[key] = graph
+        return graph
+
+    def _make_graph(self, pack_key: str, registry_factory):
+        from kubeflow_tpu.analysis.callgraph import CallGraph
+        from kubeflow_tpu.analysis.dataflow import import_aliases
+
+        def make_graph(tree: ast.AST, path: str):
+            return CallGraph(
+                tree, registry_factory(tree), import_aliases(tree),
+                fallback=self.fallback(pack_key, registry_factory,
+                                       from_path=path),
+            )
+
+        return make_graph
+
+    def pack_graph(self, path: str | None, pack_key: str,
+                   registry_factory):
+        """The call graph for a file the engine is scanning, cached
+        per ``(file, pack)`` and SHARED with cross-module resolution —
+        a module both scanned and referenced from elsewhere pays for
+        its SCC fixpoint once, not twice. None when the file can't be
+        parsed or is mid-cycle (caller falls back to a local build)."""
+        if path is None:
+            return None
+        return self._graph_for(
+            os.path.abspath(path), pack_key, registry_factory,
+            self._make_graph(pack_key, registry_factory),
+        )
+
+    # -- the CallGraph fallback hook -------------------------------------
+    def fallback(self, pack_key: str, registry_factory,
+                 from_path: str | None = None):
+        """A ``fallback(dotted, call) -> Summary | None`` closure for
+        :class:`CallGraph`. ``registry_factory(tree)`` builds the
+        pack's per-module registry for any module loaded on demand."""
+        from_dir = os.path.dirname(os.path.abspath(from_path)) \
+            if from_path else None
+        make_graph = self._make_graph(pack_key, registry_factory)
+
+        def resolve(dotted: str, call):
+            if "." not in dotted:
+                return None
+            key = (pack_key, from_dir, dotted)
+            if key in self._resolved:
+                return self._resolved[key]
+            summary = _resolve_uncached(dotted)
+            # Mid-cycle misses are provisional (the graph under
+            # construction may resolve later) — only settled answers
+            # are memoized.
+            if not self._building:
+                self._resolved[key] = summary
+            return summary
+
+        def _resolve_uncached(dotted: str):
+            parts = dotted.split(".")
+            # Try the longest module prefix first: "pkg.mod.fn" before
+            # "pkg.mod.Cls.fn" — the attr is 1 or 2 trailing parts.
+            for split in (len(parts) - 1, len(parts) - 2):
+                if split < 1:
+                    continue
+                module = ".".join(parts[:split])
+                attr = ".".join(parts[split:])
+                path = self._module_file(module, from_dir)
+                if path is None:
+                    continue
+                graph = self._graph_for(
+                    path, pack_key, registry_factory, make_graph
+                )
+                if graph is None:
+                    return None
+                info = graph.functions.get(attr)
+                if info is not None:
+                    return info.summary
+                return None
+            return None
+
+        return resolve
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Per-file context the engine hands to each Python rule pack: the
+    pre-parsed tree (one ``ast.parse`` per file per scan, shared by
+    every pack) and the project index for cross-module summaries.
+    ``None`` context keeps every pack entry point usable standalone —
+    it parses for itself and stays intra-module, as before."""
+
+    tree: ast.AST
+    abspath: str | None = None
+    project: ProjectIndex | None = None

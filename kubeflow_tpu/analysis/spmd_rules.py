@@ -353,17 +353,37 @@ class _FunctionScan:
             break
 
 
-def analyze_python_spmd(source: str, path: str) -> list[Finding]:
-    """Pack A over one Python file."""
+def analyze_python_spmd(source: str, path: str,
+                        context=None) -> list[Finding]:
+    """Pack A over one Python file. ``context`` (optional) supplies the
+    engine's pre-parsed tree and the cross-module project index."""
     if is_test_path(path):
         return []
-    try:
-        tree = ast.parse(source)
-    except SyntaxError:
-        return []  # ast_rules already reports py-syntax
+    if context is not None:
+        tree = context.tree
+    else:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # ast_rules already reports py-syntax
     aliases = import_aliases(tree)
-    registry = build_registry(tree)
-    graph = CallGraph(tree, registry, aliases)
+    graph = None
+    if context is not None and context.project is not None:
+        # Shared with cross-module resolution: if another module's
+        # scan already pulled this file in, the summary fixpoint is
+        # free.
+        graph = context.project.pack_graph(
+            context.abspath, "spmd", build_registry
+        )
+    if graph is None:
+        registry = build_registry(tree)
+        fallback = None
+        if context is not None and context.project is not None:
+            fallback = context.project.fallback(
+                "spmd", build_registry, from_path=context.abspath
+            )
+        graph = CallGraph(tree, registry, aliases, fallback=fallback)
+    registry = graph.registry
     out: list[Finding] = []
     scan = _FunctionScan(graph, registry, aliases, path, out)
     # Module-level statements.

@@ -434,7 +434,10 @@ class ShardedElector:
         quota = max(1, math.ceil(self.shards / max(1, len(holders))))
         owned = sorted(i for i, e in enumerate(self.electors)
                        if e.is_leader)
-        for i in acquirable:
+        # Sorted, not raw set order: which shards a replica grabs when
+        # quota-limited must not depend on per-process set ordering, or
+        # two replays of the same membership timeline diverge.
+        for i in sorted(acquirable):
             if len(owned) >= quota:
                 break
             if self.electors[i].try_acquire_or_renew():

@@ -575,6 +575,9 @@ class Informer:
                     obj, field_selector
                 ):
                     continue
+                # Candidate keys come from an index SET, but the
+                # return below imposes ns/name order — append order is
+                # unobservable.  # analysis: allow[det-unstable-iteration-order]
                 out.append(_jcopy(obj))
         return sorted(
             out, key=lambda o: (o["metadata"].get("namespace", ""),

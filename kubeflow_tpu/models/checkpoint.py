@@ -688,7 +688,7 @@ class CheckpointManager:
             os.unlink(os.path.join(tmp, name))
         files = {
             name: _sha256_file(os.path.join(tmp, name))
-            for name in expected
+            for name in sorted(expected)
         }
         manifest = {
             "format": MANIFEST_FORMAT,

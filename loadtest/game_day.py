@@ -137,6 +137,7 @@ class GameDayCheckpointManager:
     def __init__(self, clock):
         self._clock = clock
         self.fingerprint: dict = {}
+        # analysis: allow[py-unbounded-deque] — bounded by the scenario's save count
         self.saves: list[tuple[int, float]] = []
 
     def restore_latest_valid(self, state, placements=None):
@@ -299,6 +300,7 @@ class GameDay:
         self.ckpt = GameDayCheckpointManager(self.clk)
         self.max_replicas_seen = 1
         self.min_max_pending_seen = self.engine.max_pending
+        # analysis: allow[py-unbounded-deque] — bounded by the scenario's reshape count
         self.shapes_seen: list[str | None] = []
 
     # ------------------------------------------------------------------
@@ -347,6 +349,7 @@ class GameDay:
             replicas = int((svc.get("spec") or {}).get("replicas") or 1)
             self.max_replicas_seen = max(self.max_replicas_seen,
                                          replicas)
+        # analysis: allow[py-broad-except] — game-day harness: actuator faults are the scenario, recorded not raised
         except Exception:
             pass  # mid-delete read; next tick samples again
         try:
@@ -356,6 +359,7 @@ class GameDay:
                 ELASTIC_SHAPE_KEY)
             if not self.shapes_seen or self.shapes_seen[-1] != shape:
                 self.shapes_seen.append(shape)
+        # analysis: allow[py-broad-except] — game-day harness: actuator faults are the scenario, recorded not raised
         except Exception:
             pass
 
@@ -476,6 +480,7 @@ class GameDay:
                                "gateway", self.namespace)
             final_replicas = int(
                 (svc.get("spec") or {}).get("replicas") or 1)
+        # analysis: allow[py-broad-except] — game-day harness: best-effort teardown
         except Exception:
             final_replicas = None
         return {

@@ -63,6 +63,7 @@ def main():
                 tok_s, step_ms = measure(impl, **kw)
                 row[impl] = {"tokens_s": round(tok_s, 1),
                              "step_ms": round(step_ms, 2)}
+            # analysis: allow[py-broad-except] — A/B harness: a candidate crash is a recorded verdict
             except Exception as exc:  # OOM at 32k dense is plausible
                 row[impl] = {"error": str(exc)[:200]}
         if "tokens_s" in row.get("dense", {}) and \

@@ -1,12 +1,13 @@
 #!/usr/bin/env bash
 # Static-analysis gate for CI: fail the build on any new error-severity
 # finding (manifest/topology agreement, PodDefault conflicts, traced-code
-# and controller hazards, SPMD coherence, concurrency discipline).
-# Pre-existing accepted findings live in .analysis-baseline.json;
-# intentional occurrences carry an inline `# analysis: allow[rule-id]`
-# pragma. The same gate runs inside tier-1 pytest as
-# tests/test_analysis_self.py, so environments without CI still
-# enforce it.
+# and controller hazards, SPMD coherence, concurrency discipline, and
+# Pack C replay determinism — the static twin of the replay_digest
+# gates). Intentional occurrences carry an inline
+# `# analysis: allow[rule-id]` pragma; the accepted-findings baseline
+# (.analysis-baseline.json) is EMPTY since the PR 15 audit and must
+# stay empty — tests/test_analysis_self.py pins the whole tree at zero
+# findings, so environments without CI enforce the same gate.
 #
 # A SARIF 2.1.0 document is always written (even when the gate fails)
 # so CI can upload it for PR diff annotation:
@@ -19,14 +20,23 @@ cd "$(dirname "$0")/../.."
 
 SARIF_OUT="${ANALYSIS_SARIF:-analysis-results.sarif}"
 
-# One scan: text report for the build log, SARIF artifact on the side.
+# One scan: text report for the build log, SARIF artifact on the side,
+# wall-time/parse stats on stderr.
 rc=0
 rm -f "$SARIF_OUT"
-python -m kubeflow_tpu.analysis . --sarif-out "$SARIF_OUT" || rc=$?
+python -m kubeflow_tpu.analysis . --sarif-out "$SARIF_OUT" --stats || rc=$?
 if [ -f "$SARIF_OUT" ]; then
     echo "SARIF written to $SARIF_OUT"
 else
     echo "no SARIF produced (analysis aborted before reporting)" >&2
+fi
+
+# --changed-only smoke: the sub-second pre-commit mode must keep
+# working (diff seed + reverse import closure; falls back to a full
+# scan when git can't answer). Scoped to vs-HEAD, so on a clean CI
+# checkout it scans the empty closure and exits 0 fast.
+if [ "$rc" -eq 0 ]; then
+    python -m kubeflow_tpu.analysis . --changed-only --stats || rc=$?
 fi
 
 exit "$rc"

@@ -93,6 +93,7 @@ class Soak:
         for port in self.ports.values():
             wait_http(f"http://127.0.0.1:{port}/healthz")
         self.failed_cycles = 0
+        # analysis: allow[py-unbounded-deque] — one sample per soak tick, bounded by soak duration
         self.rss_history: list[tuple[int, int]] = []
 
     def _spawn_controller(self, name: str):
@@ -292,6 +293,7 @@ class Soak:
             if i % 5 == 2:
                 self.kill_leader()
                 record["leader_kill"] = True
+        # analysis: allow[py-broad-except] — soak harness: best-effort teardown
         except Exception as exc:  # log + count, keep soaking
             self.failed_cycles += 1
             record["ok"] = False

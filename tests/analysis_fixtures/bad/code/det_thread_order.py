@@ -1,0 +1,15 @@
+"""SEEDED VIOLATION — thread completion order reaching an ordered
+log: ``as_completed`` yields futures in finish order, which depends on
+scheduler timing, so the appended results differ run to run.
+``det-unstable-iteration-order`` must fire (a warning here — this
+tree is not replay-gated).
+"""
+
+from concurrent.futures import as_completed
+
+
+def collect(futures):
+    results = []
+    for fut in as_completed(futures):
+        results.append(fut.result())
+    return results

@@ -1,0 +1,23 @@
+"""SEEDED VIOLATIONS (2) — draws on the process-global RNG state:
+``random.random()`` and ``np.random.choice`` both consume shared,
+unseeded module-level state, so no replay can account the draws to a
+scenario seed. ``det-unseeded-rng`` (warning) must fire on each draw;
+the seeded-instance idiom next to them must not.
+"""
+
+import random
+
+import numpy as np
+
+
+def jittered_backoff(base_s):
+    return base_s * (1.0 + random.random())
+
+
+def pick_victim(candidates):
+    return np.random.choice(candidates)
+
+
+def seeded_jitter(base_s, seed):
+    rng = random.Random(seed)
+    return base_s * (1.0 + rng.random())

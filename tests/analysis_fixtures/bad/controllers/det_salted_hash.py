@@ -1,0 +1,22 @@
+"""SEEDED VIOLATION — builtin ``hash()`` used for coordination:
+PYTHONHASHSEED salts it per process, so no two replicas (or replays)
+agree on the shard a key lands in, and the emitted assignment order
+differs run to run. ``det-salted-hash-coordination`` must fire at the
+event append; the sanctioned idiom is a stable digest (``shard_of``).
+"""
+
+
+class ShardAssigner:
+    def __init__(self, shards):
+        self.shards = shards
+        self.assignments = []
+
+    def drain(self):
+        out = list(self.assignments)
+        self.assignments.clear()
+        return out
+
+    def assign(self, namespace, name):
+        shard = hash(f"{namespace}/{name}") % self.shards
+        self.assignments.append({"key": name, "shard": shard})
+        return shard

@@ -1,0 +1,16 @@
+"""SEEDED VIOLATION — cross-module wall-clock flow: ``stamp()`` lives
+in ``det_helpers`` (itself one helper deep over ``time.monotonic``),
+so ``det-wallclock-in-replay`` at the digest update here requires
+import-alias resolution into the sibling module's summaries.
+"""
+
+import hashlib
+
+from det_helpers import stamp
+
+
+def fingerprint(state):
+    digest = hashlib.sha256()
+    digest.update(repr(state).encode())
+    digest.update(str(stamp()).encode())
+    return digest.hexdigest()

@@ -1,0 +1,24 @@
+"""SEEDED VIOLATION — wall clock reaching the replay digest through
+two helper levels: ``record`` → ``_stamp`` → ``_now``. The digest of
+a replayed run can never match the original because the wall time
+differs; ``det-wallclock-in-replay`` must fire at the ``update`` call
+via the interprocedural summary chain (base taint two hops deep).
+"""
+
+import hashlib
+import time
+
+
+def _now():
+    return time.time()
+
+
+def _stamp():
+    return {"at": _now()}
+
+
+def record(payload):
+    digest = hashlib.sha256()
+    digest.update(str(payload).encode())
+    digest.update(str(_stamp()).encode())
+    return digest.hexdigest()

@@ -1,0 +1,12 @@
+"""SEEDED VIOLATION — an RNG seeded from the wall clock: every draw
+downstream is untraceable to the scenario seed, so the run can never
+be replayed. ``det-wallclock-in-replay`` must fire at the
+``random.Random(...)`` construction (the rng-seed sink).
+"""
+
+import random
+import time
+
+
+def make_rng():
+    return random.Random(time.time())

@@ -1,0 +1,17 @@
+"""SEEDED VIOLATION — a set serialized whole into the replay digest:
+``list(members)``/``str`` ordering is the set's arbitrary per-process
+order, so the canonical-encoding discipline (``sort_keys=True``) is
+defeated by an unsorted VALUE. ``det-unstable-iteration-order`` must
+fire at the digest input (an error here — loadtest is replay-gated).
+"""
+
+import hashlib
+import json
+
+
+def membership_digest(names):
+    members = set(names)
+    payload = {"members": list(members)}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()

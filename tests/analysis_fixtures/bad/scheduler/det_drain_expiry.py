@@ -1,0 +1,38 @@
+"""SEEDED VIOLATION — the PR 13 drain-expiry replay bug, minimized.
+
+Deadline-expired drains complete in raw ``set`` iteration order, and
+each completion is recorded to the event log TWO helper levels down
+(``expire`` → ``_complete`` → ``_record``). Two drains expiring in the
+same pass therefore land in the log in id()-dependent order, and the
+soak's replay digest tears — exactly the bug the 10k-CR soak had to
+find at runtime. ``det-unstable-iteration-order`` must fire at the
+``_complete`` call site inside the loop, which requires the
+interprocedural param→sink summary chain: the one-level engine
+provably misses this (pinned by tests).
+"""
+
+
+class DrainQueue:
+    def __init__(self):
+        self._draining = set()
+        self._events = []
+
+    def admit(self, workload):
+        self._draining.add(workload)
+
+    def drain_events(self):
+        out = list(self._events)
+        self._events.clear()
+        return out
+
+    def _record(self, event):
+        self._events.append(event)
+
+    def _complete(self, workload, now):
+        self._draining.discard(workload)
+        self._record({"completed": workload.name, "at": now})
+
+    def expire(self, now):
+        for workload in list(self._draining):
+            if workload.deadline <= now:
+                self._complete(workload, now)

@@ -1,0 +1,39 @@
+"""CLEAN determinism idioms, one per bad seed: injectable clock
+threaded as a parameter (parameters carry no source taint), sets
+serialized sorted, a stable digest instead of salted ``hash()``, and
+every RNG draw accountable to an explicit scenario seed. Pack C must
+be silent on all of them.
+"""
+
+import hashlib
+import json
+import random
+
+
+def membership_digest(names):
+    members = set(names)
+    payload = {"members": sorted(members)}
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def record(payload, now):
+    # The scenario clock is injected: replay passes the same readings.
+    digest = hashlib.sha256()
+    digest.update(str(payload).encode())
+    digest.update(str({"at": now}).encode())
+    return digest.hexdigest()
+
+
+def stable_shard(namespace, name, shards):
+    digest = hashlib.sha1(f"{namespace}/{name}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % shards
+
+
+def seeded_rng(seed):
+    return random.Random(seed)
+
+
+def seeded_pick(candidates, seed):
+    return seeded_rng(seed).choice(sorted(candidates))

@@ -1,0 +1,34 @@
+"""CLEAN counterpart of the PR 13 drain-expiry bug — the shipped fix:
+deadline-expired drains complete in **seq order**, not raw set order
+(``sorted(..., key=lambda w: w.seq)``), so two drains expiring in the
+same pass always re-enqueue and log identically across replays.
+``sorted()`` is a registered order sanitizer: Pack C must be silent.
+"""
+
+
+class DrainQueue:
+    def __init__(self):
+        self._draining = set()
+        self._events = []
+
+    def admit(self, workload):
+        self._draining.add(workload)
+
+    def drain_events(self):
+        out = list(self._events)
+        self._events.clear()
+        return out
+
+    def _record(self, event):
+        self._events.append(event)
+
+    def _complete(self, workload, now):
+        self._draining.discard(workload)
+        self._record({"completed": workload.name, "at": now})
+
+    def expire(self, now):
+        # Seq-ordered iteration, NOT raw set order: two drains expiring
+        # in the same pass must complete identically across replays.
+        for workload in sorted(self._draining, key=lambda w: w.seq):
+            if workload.deadline <= now:
+                self._complete(workload, now)

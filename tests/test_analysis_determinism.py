@@ -1,0 +1,589 @@
+"""Pack C (replay determinism) + interprocedural engine tests: SCC
+condensation and summary fixpoints (recursion, mutual recursion,
+param→sink chains), cross-module resolution, the one-level-vs-fixpoint
+regression that pins what the old engine missed, the minimized PR 13
+drain-expiry replay bug, the shared parse cache, and --changed-only."""
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.analysis import AnalysisConfig, Severity, analyze_paths
+from kubeflow_tpu.analysis.callgraph import CallGraph
+from kubeflow_tpu.analysis.dataflow import CallPattern, TaintRegistry
+from kubeflow_tpu.analysis.determinism_rules import (
+    analyze_python_determinism,
+    build_registry,
+)
+from kubeflow_tpu.analysis.incremental import changed_only_files
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CLOCK_REG = TaintRegistry(
+    sources=(
+        CallPattern("clock", exact=("time.monotonic", "time.time")),
+        CallPattern("salted hash()", exact=("hash",)),
+    ),
+)
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+@pytest.fixture(scope="module")
+def bad_findings():
+    return analyze_paths(AnalysisConfig(paths=[BAD], check_emitted=False))
+
+
+class TestInterproceduralSummaries:
+    def test_two_hop_base_taint(self):
+        # The shape the one-level engine loses: a source two helper
+        # levels down (the leaf call resolves to nothing, so its
+        # conservative fallback — union of zero arguments — is clean).
+        src = (
+            "def _now():\n"
+            "    return time.monotonic()\n"
+            "def stamp():\n"
+            "    return _now()\n"
+        )
+        graph = CallGraph(ast.parse(src), _CLOCK_REG, {})
+        assert any("clock" in label
+                   for label in graph.functions["stamp"].summary.base)
+        old = CallGraph(ast.parse(src), _CLOCK_REG, {}, mode="one-level")
+        assert old.functions["stamp"].summary.base == frozenset()
+
+    def test_self_recursion_converges(self):
+        src = (
+            "def walk(n):\n"
+            "    if n <= 0:\n"
+            "        return time.monotonic()\n"
+            "    return walk(n - 1)\n"
+        )
+        graph = CallGraph(ast.parse(src), _CLOCK_REG, {})
+        summary = graph.functions["walk"].summary
+        assert any("clock" in label for label in summary.base)
+
+    def test_mutual_recursion_converges(self):
+        src = (
+            "def ping(n):\n"
+            "    if n <= 0:\n"
+            "        return time.monotonic()\n"
+            "    return pong(n - 1)\n"
+            "def pong(n):\n"
+            "    return ping(n - 1)\n"
+        )
+        graph = CallGraph(ast.parse(src), _CLOCK_REG, {})
+        for name in ("ping", "pong"):
+            assert any(
+                "clock" in label
+                for label in graph.functions[name].summary.base
+            ), name
+
+    def test_recursive_param_dep_converges(self):
+        src = (
+            "def fold(acc, xs):\n"
+            "    if not xs:\n"
+            "        return acc\n"
+            "    return fold(acc + xs[0], xs[1:])\n"
+        )
+        graph = CallGraph(ast.parse(src), _CLOCK_REG, {})
+        summary = graph.functions["fold"].summary
+        assert {"acc", "xs"} <= set(summary.deps)
+        assert summary.base == frozenset()
+
+    def test_param_sink_chain(self):
+        # x reaches the emission sink two levels down: both helpers'
+        # summaries must carry the param→sink fact.
+        registry = build_registry(ast.parse(""))
+        src = (
+            "def _record(log, event):\n"
+            "    log.append(event)\n"
+            "def via(log, x):\n"
+            "    _record(log, x)\n"
+        )
+        graph = CallGraph(ast.parse(src), registry, {})
+        assert ("event", "emission") in \
+            graph.functions["_record"].summary.param_sinks
+        assert ("x", "emission") in \
+            graph.functions["via"].summary.param_sinks
+        old = CallGraph(ast.parse(src), registry, {}, mode="one-level")
+        assert old.functions["via"].summary.param_sinks == frozenset()
+
+    def test_sorting_helper_summary_is_order_scrubbed_not_clean(self):
+        # ``stable(xs)`` keeps xs as an ORDERED dep: value taint (a
+        # wall clock refactored behind the helper) still flows to
+        # callers; order taint (set markers) is scrubbed at apply.
+        registry = build_registry(ast.parse(""))
+        src = "def stable(xs):\n    return sorted(xs)\n"
+        graph = CallGraph(ast.parse(src), registry, {})
+        summary = graph.functions["stable"].summary
+        assert summary.deps == frozenset()
+        assert summary.ordered_deps == frozenset({"xs"})
+        assert summary.base == frozenset()
+        clock = frozenset({"host wall clock (line 9)"})
+        marker = frozenset({"<set-valued>"})
+        assert summary.apply(
+            [clock | marker], {}, registry.order_labels
+        ) == clock
+
+
+class TestCrossModule:
+    def test_cross_module_wallclock_fires_via_project_index(
+        self, bad_findings
+    ):
+        found = _by_rule(bad_findings, "det-wallclock-in-replay")
+        assert ("loadtest/det_cross_module.py", 15) in [
+            (f.path, f.line) for f in found
+        ]
+
+    def test_standalone_scan_stays_intra_module(self):
+        # Without a project context the import cannot resolve — the
+        # conservative fallback keeps the scan silent, not wrong.
+        src = open(os.path.join(
+            BAD, "loadtest", "det_cross_module.py"
+        )).read()
+        found = analyze_python_determinism(src, "loadtest/x.py")
+        assert _by_rule(found, "det-wallclock-in-replay") == []
+
+    def test_import_cycle_answers_conservatively(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            "import b\n"
+            "def fa(x):\n"
+            "    return b.fb(x)\n"
+        )
+        (tmp_path / "b.py").write_text(
+            "import a\n"
+            "def fb(x):\n"
+            "    return a.fa(x)\n"
+        )
+        findings = analyze_paths(AnalysisConfig(
+            paths=[str(tmp_path)], check_emitted=False,
+        ))
+        assert [f for f in findings if f.rule.startswith("det-")] == []
+
+
+class TestDeterminismPackOnFixtures:
+    def test_pr13_drain_expiry_seed(self, bad_findings):
+        found = [
+            f for f in _by_rule(bad_findings,
+                                "det-unstable-iteration-order")
+            if f.path == "scheduler/det_drain_expiry.py"
+        ]
+        assert [(f.line, f.severity) for f in found] == [
+            (38, Severity.ERROR)
+        ]
+        assert "unordered set iteration" in found[0].message
+
+    def test_wallclock_seeds(self, bad_findings):
+        found = _by_rule(bad_findings, "det-wallclock-in-replay")
+        assert [(f.path, f.line) for f in found] == [
+            ("loadtest/det_cross_module.py", 15),
+            ("loadtest/det_digest_wallclock.py", 23),
+            ("loadtest/det_rng_seed_wallclock.py", 12),
+        ]
+        assert all(f.severity == Severity.ERROR for f in found)
+
+    def test_salted_hash_seed(self, bad_findings):
+        (f,) = _by_rule(bad_findings, "det-salted-hash-coordination")
+        assert (f.path, f.line) == ("controllers/det_salted_hash.py", 21)
+        assert f.severity == Severity.ERROR
+
+    def test_set_serialized_seed(self, bad_findings):
+        found = [
+            f for f in _by_rule(bad_findings,
+                                "det-unstable-iteration-order")
+            if f.path == "loadtest/det_set_serialized.py"
+        ]
+        assert [(f.line, f.severity) for f in found] == [
+            (15, Severity.ERROR)
+        ]
+
+    def test_thread_order_seed_warns_outside_replay_gated_trees(
+        self, bad_findings
+    ):
+        found = [
+            f for f in _by_rule(bad_findings,
+                                "det-unstable-iteration-order")
+            if f.path == "code/det_thread_order.py"
+        ]
+        assert [(f.line, f.severity) for f in found] == [
+            (14, Severity.WARNING)
+        ]
+        assert "thread completion order" in found[0].message
+
+    def test_unseeded_rng_seeds(self, bad_findings):
+        found = [
+            f for f in _by_rule(bad_findings, "det-unseeded-rng")
+            if f.path == "code/det_unseeded_rng.py"
+        ]
+        assert [(f.line, f.severity) for f in found] == [
+            (14, Severity.WARNING), (18, Severity.WARNING),
+        ]
+
+    def test_clean_counterparts_silent(self):
+        findings = analyze_paths(
+            AnalysisConfig(paths=[CLEAN], check_emitted=False)
+        )
+        assert [f for f in findings if f.rule.startswith("det-")] == []
+
+    def test_pragma_suppresses_det_finding(self, tmp_path):
+        src = (
+            "import hashlib\n"
+            "import time\n"
+            "def f(payload):\n"
+            "    h = hashlib.sha256()\n"
+            "    # analysis: allow[det-wallclock-in-replay] — report ts\n"
+            "    h.update(str(time.time()).encode())\n"
+            "    return h.hexdigest()\n"
+        )
+        target = tmp_path / "mod.py"
+        target.write_text(src)
+        found = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert _by_rule(found, "det-wallclock-in-replay") == []
+
+
+class TestRegressionOneLevelVsFixpoint:
+    """Acceptance: the minimized PR 13 bug fires through a ≥2-hop
+    cross-function flow the pre-PR one-level engine provably misses,
+    and the shipped seq-ordered fix is clean under both engines."""
+
+    def test_buggy_shape_fires_only_interprocedurally(self):
+        src = open(os.path.join(
+            BAD, "scheduler", "det_drain_expiry.py"
+        )).read()
+        new = analyze_python_determinism(
+            src, "scheduler/det_drain_expiry.py"
+        )
+        assert [
+            (f.rule, f.line) for f in new
+        ] == [("det-unstable-iteration-order", 38)]
+        old = analyze_python_determinism(
+            src, "scheduler/det_drain_expiry.py", mode="one-level"
+        )
+        assert old == []
+
+    def test_shipped_fix_is_clean_under_both_engines(self):
+        src = open(os.path.join(
+            CLEAN, "scheduler", "det_drain_seq.py"
+        )).read()
+        for mode in ("fixpoint", "one-level"):
+            assert analyze_python_determinism(
+                src, "scheduler/det_drain_seq.py", mode=mode
+            ) == [], mode
+
+    def test_two_hop_wallclock_digest_misses_one_level(self):
+        src = open(os.path.join(
+            BAD, "loadtest", "det_digest_wallclock.py"
+        )).read()
+        new = analyze_python_determinism(src, "loadtest/m.py")
+        assert [f.rule for f in new] == ["det-wallclock-in-replay"]
+        assert analyze_python_determinism(
+            src, "loadtest/m.py", mode="one-level"
+        ) == []
+
+
+class TestSanitizerPrecision:
+    def test_sorted_clears_order_but_not_wallclock(self):
+        src = (
+            "import hashlib\n"
+            "import time\n"
+            "def f(items):\n"
+            "    ts = sorted([time.time() for _ in items])\n"
+            "    h = hashlib.sha256()\n"
+            "    h.update(str(ts).encode())\n"
+            "    return h.hexdigest()\n"
+        )
+        found = analyze_python_determinism(src, "loadtest/m.py")
+        assert [f.rule for f in found] == ["det-wallclock-in-replay"]
+
+    def test_membership_test_is_order_free(self):
+        src = (
+            "def f(log, names, key):\n"
+            "    seen = set(names)\n"
+            "    log.append(key in seen)\n"
+        )
+        assert analyze_python_determinism(src, "loadtest/m.py") == []
+
+    def test_len_is_fully_clean(self):
+        src = (
+            "import hashlib\n"
+            "def f(names):\n"
+            "    h = hashlib.sha256()\n"
+            "    h.update(str(len(set(names))).encode())\n"
+            "    return h.hexdigest()\n"
+        )
+        assert analyze_python_determinism(src, "loadtest/m.py") == []
+
+    def test_sink_call_in_later_generator_sees_earlier_target(self):
+        # Generator N's iterable may read generator N-1's target: a
+        # sink call there must be evaluated with the progressive
+        # comprehension state, not the outer state (else the element's
+        # iteration-order taint is invisible — false negative).
+        src = (
+            "def f(names, log):\n"
+            "    s = set(names)\n"
+            "    out = [y for x in s for y in (log.append(x) or [])]\n"
+        )
+        found = analyze_python_determinism(src, "loadtest/m.py")
+        assert [f.rule for f in found] == ["det-unstable-iteration-order"]
+
+    def test_comprehension_target_shadowing_is_scoped(self):
+        # The checkpoint-manifest shape: a loop variable named like a
+        # later comprehension target must not leak its taint into the
+        # comprehension's element expression.
+        src = (
+            "import hashlib\n"
+            "def f(present, expected, blobs):\n"
+            "    for name in set(present) - set(expected):\n"
+            "        blobs.pop(name, None)\n"
+            "    return {\n"
+            "        name: hashlib.sha256(blobs[name]).hexdigest()\n"
+            "        for name in sorted(expected)\n"
+            "    }\n"
+        )
+        assert analyze_python_determinism(src, "loadtest/m.py") == []
+
+    def test_set_comprehension_result_is_order_free(self):
+        # A set built by iterating a set has the same CONTENTS in any
+        # iteration order: the result keeps the container marker (it
+        # IS a set) but not the iteration-order label, so storing it
+        # in a config object and walking it later is clean.
+        from kubeflow_tpu.analysis.cfg import build_cfg
+
+        registry = build_registry(ast.parse(""))
+        src = (
+            "def f(s):\n"
+            "    t = {x for x in set(s)}\n"
+            "    return t\n"
+        )
+        from kubeflow_tpu.analysis.dataflow import FunctionDataflow
+
+        fn = ast.parse(src).body[0]
+        flow = FunctionDataflow(build_cfg(fn.body), registry, {})
+        assert any(t.startswith("<set-valued>")
+                   for t in flow.return_taint)
+        assert not any("unordered set iteration" in t
+                       for t in flow.return_taint)
+
+    def test_seeded_instance_draws_do_not_warn(self):
+        src = (
+            "import random\n"
+            "def f(seed):\n"
+            "    rng = random.Random(seed)\n"
+            "    return rng.random()\n"
+        )
+        assert analyze_python_determinism(src, "kubeflow_tpu/m.py") == []
+
+    def test_jax_random_never_warns(self):
+        src = (
+            "import jax\n"
+            "def f(key):\n"
+            "    return jax.random.uniform(key)\n"
+        )
+        assert analyze_python_determinism(src, "kubeflow_tpu/m.py") == []
+
+
+class TestSharedParseCache:
+    def test_single_parse_per_file_across_all_packs(
+        self, tmp_path, monkeypatch
+    ):
+        # b cross-references a, so the project index lazily resolves
+        # a.py — possibly BEFORE the walk reaches it. Still one parse
+        # per file: the walk and the index share one cache.
+        (tmp_path / "a.py").write_text(
+            "import hashlib\n"
+            "def helper(x):\n"
+            "    return hashlib.sha256(x).hexdigest()\n"
+        )
+        (tmp_path / "b.py").write_text(
+            "from a import helper\n"
+            "def use(x):\n"
+            "    return helper(x)\n"
+        )
+        (tmp_path / "c.py").write_text(
+            "def alone(x):\n"
+            "    return x\n"
+        )
+        real_parse = ast.parse
+        counted = []
+
+        def counting_parse(source, *args, **kwargs):
+            counted.append(1)
+            return real_parse(source, *args, **kwargs)
+
+        monkeypatch.setattr(ast, "parse", counting_parse)
+        analyze_paths(AnalysisConfig(
+            paths=[str(tmp_path)], check_emitted=False,
+        ))
+        assert len(counted) == 3  # one ast.parse per file, all packs
+
+    def test_stats_reported(self, tmp_path):
+        (tmp_path / "a.py").write_text("def f():\n    return 1\n")
+        config = AnalysisConfig(
+            paths=[str(tmp_path)], check_emitted=False,
+        )
+        analyze_paths(config)
+        assert config.stats is not None
+        assert config.stats.python_files == 1
+        assert config.stats.parses == 1
+        assert config.stats.wall_s >= 0.0
+        assert "parse(s)" in config.stats.render()
+
+    def test_cli_stats_flag(self, tmp_path):
+        (tmp_path / "a.py").write_text("def f():\n    return 1\n")
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis",
+             str(tmp_path / "a.py"), "--no-emitted",
+             "--baseline", str(empty), "--stats"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 0
+        assert "parse(s)" in proc.stderr
+
+
+class TestChangedOnly:
+    def _init_repo(self, path):
+        git = shutil.which("git")
+        if git is None:
+            pytest.skip("git unavailable")
+
+        def run(*args):
+            proc = subprocess.run(
+                ["git", "-C", str(path), "-c", "user.email=t@t",
+                 "-c", "user.name=t", *args],
+                capture_output=True, text=True, timeout=30,
+            )
+            assert proc.returncode == 0, proc.stderr
+            return proc
+
+        run("init", "-q")
+        return run
+
+    def test_reverse_dependency_closure(self, tmp_path):
+        run = self._init_repo(tmp_path)
+        (tmp_path / "helper.py").write_text(
+            "def stamp():\n    return 1\n"
+        )
+        (tmp_path / "caller.py").write_text(
+            "from helper import stamp\n"
+            "def use():\n    return stamp()\n"
+        )
+        (tmp_path / "unrelated.py").write_text(
+            "def other():\n    return 2\n"
+        )
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        (tmp_path / "helper.py").write_text(
+            "def stamp():\n    return 3\n"
+        )
+        files = changed_only_files([str(tmp_path)], "HEAD")
+        assert files is not None
+        names = {os.path.basename(p) for p in files}
+        # The changed helper AND its importer, not the unrelated module.
+        assert names == {"helper.py", "caller.py"}
+
+    def test_package_init_relative_import_closure(self, tmp_path):
+        # pkg/__init__.py's level-1 relative import resolves against
+        # pkg ITSELF (an __init__ module name IS its package), so
+        # editing pkg/mod.py must pull the __init__ into the rescan.
+        run = self._init_repo(tmp_path)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("from . import mod\n")
+        (pkg / "mod.py").write_text("def f():\n    return 1\n")
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        (pkg / "mod.py").write_text("def f():\n    return 2\n")
+        files = changed_only_files([str(tmp_path)], "HEAD")
+        assert files is not None
+        assert {os.path.basename(p) for p in files} == {
+            "__init__.py", "mod.py"
+        }
+
+    def test_no_python_changes_skips_the_graph_build(
+        self, tmp_path, monkeypatch
+    ):
+        run = self._init_repo(tmp_path)
+        (tmp_path / "a.py").write_text("def f():\n    return 1\n")
+        (tmp_path / "conf.yaml").write_text("k: v\n")
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        (tmp_path / "conf.yaml").write_text("k: w\n")
+        parsed = []
+        real_parse = ast.parse
+
+        def counting_parse(source, *args, **kwargs):
+            parsed.append(1)
+            return real_parse(source, *args, **kwargs)
+
+        monkeypatch.setattr(ast, "parse", counting_parse)
+        files = changed_only_files([str(tmp_path)], "HEAD")
+        assert files is not None
+        assert {os.path.basename(p) for p in files} == {"conf.yaml"}
+        assert parsed == []  # no import graph needed, none built
+
+    def test_untracked_files_are_included(self, tmp_path):
+        run = self._init_repo(tmp_path)
+        (tmp_path / "a.py").write_text("def f():\n    return 1\n")
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        (tmp_path / "fresh.py").write_text("def g():\n    return 2\n")
+        files = changed_only_files([str(tmp_path)], "HEAD")
+        assert files is not None
+        assert {os.path.basename(p) for p in files} == {"fresh.py"}
+
+    def test_file_filter_preserves_attribution(self, tmp_path):
+        # The filter narrows the walk, never the roots: findings keep
+        # full repo-relative paths so pragma/baseline keys match.
+        sub = tmp_path / "loadtest"
+        sub.mkdir()
+        target = sub / "m.py"
+        target.write_text(
+            "import hashlib\n"
+            "import time\n"
+            "def f():\n"
+            "    h = hashlib.sha256()\n"
+            "    h.update(str(time.time()).encode())\n"
+            "    return h.hexdigest()\n"
+        )
+        (sub / "skipped.py").write_text(
+            "import time\n"
+            "import hashlib\n"
+            "def g():\n"
+            "    return hashlib.sha256(\n"
+            "        str(time.time()).encode()).hexdigest()\n"
+        )
+        findings = analyze_paths(AnalysisConfig(
+            paths=[str(tmp_path)], check_emitted=False,
+            file_filter={str(target)},
+        ))
+        det = [f for f in findings if f.rule.startswith("det-")]
+        assert [f.path for f in det] == ["loadtest/m.py"]
+
+    def test_cli_changed_only_smoke(self, tmp_path):
+        run = self._init_repo(tmp_path)
+        (tmp_path / "clean.py").write_text("def f():\n    return 1\n")
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        empty = tmp_path / "empty-baseline.json"
+        empty.write_text('{"findings": []}')
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis",
+             str(tmp_path), "--changed-only", "--stats",
+             "--baseline", str(empty)],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 0
+        assert "0 error(s)" in proc.stdout

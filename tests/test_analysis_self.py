@@ -1,9 +1,15 @@
 """Self-analysis gate: the analyzer runs over this repository and must
-report zero non-baselined error-severity findings — the tier-1 stand-in
-for the CI analysis gate (testing/gh-actions/analysis_gate.sh), so the
-gate holds even where CI doesn't run."""
+report zero findings — the tier-1 stand-in for the CI analysis gate
+(testing/gh-actions/analysis_gate.sh), so the gate holds even where CI
+doesn't run. Scans are shared module-scoped fixtures: three scans
+total (full repo, the package subtree, the replay-gated trees — the
+subtree scans exercise path-dependent cross-module resolution the
+full-repo scan would mask)."""
 
+import json
 import os
+
+import pytest
 
 from kubeflow_tpu.analysis import AnalysisConfig, Severity, analyze_paths
 from kubeflow_tpu.analysis.engine import BASELINE_FILENAME, partition_baseline
@@ -11,38 +17,91 @@ from kubeflow_tpu.analysis.engine import BASELINE_FILENAME, partition_baseline
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_repo_has_no_new_error_findings():
+@pytest.fixture(scope="module")
+def repo_findings():
+    return analyze_paths(AnalysisConfig(paths=[REPO]))
+
+
+@pytest.fixture(scope="module")
+def package_findings():
+    return analyze_paths(AnalysisConfig(
+        paths=[os.path.join(REPO, "kubeflow_tpu")], check_emitted=False,
+    ))
+
+
+@pytest.fixture(scope="module")
+def replay_gated_findings():
+    return analyze_paths(AnalysisConfig(
+        paths=[
+            os.path.join(REPO, "kubeflow_tpu"),
+            os.path.join(REPO, "loadtest"),
+        ],
+        check_emitted=False,
+    ))
+
+
+def test_repo_has_no_new_error_findings(repo_findings):
     baseline = os.path.join(REPO, BASELINE_FILENAME)
-    findings = analyze_paths(AnalysisConfig(paths=[REPO]))
-    new, _ = partition_baseline(findings, baseline)
+    new, _ = partition_baseline(repo_findings, baseline)
     errors = [f for f in new if f.severity == Severity.ERROR]
     assert errors == [], "\n".join(f.render() for f in errors)
 
 
-def test_repo_package_is_clean_under_spmd_and_concurrency_packs():
+def test_repo_is_zero_findings_with_no_baseline_budget(repo_findings):
+    """The PR 15 audit retired the baseline: EVERY pack reports
+    nothing on the whole tree — errors, warnings, infos — with no
+    budget absorbing any of it. Every former entry was either fixed
+    (sorted set iterations in leader/runtime/checkpoint) or carries an
+    inline pragma whose comment justifies it. New debt must be fixed
+    or justified in the diff that introduces it, never banked."""
+    assert repo_findings == [], "\n".join(
+        f.render() for f in repo_findings
+    )
+
+
+def test_baseline_file_is_empty():
+    """The no-budget rule above only holds while the baseline stays
+    empty — pin it so a regenerated baseline can't quietly bank new
+    findings past the gate."""
+    new, baselined = partition_baseline(
+        [], os.path.join(REPO, BASELINE_FILENAME)
+    )
+    assert (new, baselined) == ([], [])
+    with open(os.path.join(REPO, BASELINE_FILENAME)) as fh:
+        assert json.load(fh)["findings"] == []
+
+
+def test_repo_package_is_clean_under_dataflow_packs(package_findings):
     """The flagship dataflow packs report NOTHING on kubeflow_tpu/ —
     not even baselined findings: every hit was either fixed (lock-scope
-    corrections, the _locked helper contract) or carries an inline
-    pragma whose comment justifies why the path is coherent (train.py's
-    agreed-token saves). Catching the next PR 4-shaped bug depends on
-    this staying at zero, so no baseline budget is allowed to absorb
-    one."""
-    findings = analyze_paths(AnalysisConfig(
-        paths=[os.path.join(REPO, "kubeflow_tpu")], check_emitted=False,
-    ))
+    corrections, the _locked helper contract, the PR 15 sorted-set
+    audit) or carries an inline pragma whose comment justifies why the
+    path is coherent. Catching the next PR 4- or PR 13-shaped bug
+    depends on this staying at zero, so no baseline budget is allowed
+    to absorb one."""
     noisy = [
-        f for f in findings
-        if f.rule.startswith(("spmd-", "conc-"))
+        f for f in package_findings
+        if f.rule.startswith(("spmd-", "conc-", "det-"))
     ]
     assert noisy == [], "\n".join(f.render() for f in noisy)
 
 
-def test_repo_package_has_no_silent_broad_excepts():
+def test_repo_package_has_no_silent_broad_excepts(package_findings):
     """The satellite audit holds: inside kubeflow_tpu/ every broad
     except either logs, re-raises, was narrowed, or carries an explicit
     allow-pragma — so the rule reports nothing, baselined or not."""
-    findings = analyze_paths(AnalysisConfig(
-        paths=[os.path.join(REPO, "kubeflow_tpu")], check_emitted=False,
-    ))
-    noisy = [f for f in findings if f.rule == "py-broad-except"]
+    noisy = [f for f in package_findings if f.rule == "py-broad-except"]
+    assert noisy == [], "\n".join(f.render() for f in noisy)
+
+
+def test_replay_gated_trees_are_clean_under_determinism_pack(
+    replay_gated_findings,
+):
+    """Pack C is the static twin of the replay_digest gates: the trees
+    those gates cover (scheduler, controllers, chaos, loadtest) hold at
+    zero det-* findings — the PR 13 drain-expiry bug class cannot land
+    again without failing tier-1 in milliseconds, long before a soak."""
+    noisy = [
+        f for f in replay_gated_findings if f.rule.startswith("det-")
+    ]
     assert noisy == [], "\n".join(f.render() for f in noisy)

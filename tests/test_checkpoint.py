@@ -665,6 +665,7 @@ class RecordingClient:
     are write-once."""
 
     def __init__(self):
+        # analysis: allow[py-unbounded-deque] — test double, bounded by the test's save count
         self.barriers = []
         self.kv = {}
 

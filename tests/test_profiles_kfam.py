@@ -205,6 +205,7 @@ def trust_policy(subjects):
 class FakeIamClient:
     def __init__(self, policy):
         self.policies = dict(policy)
+        # analysis: allow[py-unbounded-deque] — test double, bounded by the test's update count
         self.updates = []
 
     def get_assume_role_policy(self, role):
