@@ -41,6 +41,71 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.obs import perfwatch
+
+
+def _preset() -> str:
+    return os.environ.get("KFT_BENCH_PRESET", "")
+
+
+def _mini(full: int, mini: int) -> int:
+    """Section-size knob honoring ``KFT_BENCH_PRESET=cpu-mini``: the
+    same sections table (identical names, identical code paths modulo
+    the TPU-only kernels) at CPU-tractable sizes, so a round can be
+    recorded through the full protocol on a host without a chip. The
+    preset rides in provenance, so perfwatch verdicts will read
+    cpu-mini-vs-TPU comparisons as ``incomparable``, never as a
+    regression."""
+    return mini if _preset() == "cpu-mini" else full
+
+
+def _lm_dims(**overrides) -> dict:
+    """LM model dims for the active preset (the 8x1024 GQA bench
+    config, or a 2x128 miniature under cpu-mini)."""
+    dims = (dict(vocab=2048, layers=2, dim=128, heads=4)
+            if _preset() == "cpu-mini"
+            else dict(vocab=32768, layers=8, dim=1024, heads=8))
+    dims.update(overrides)
+    return dims
+
+
+_ROUND_CONTEXT: dict | None = None
+
+
+def round_context() -> dict:
+    """Host-noise sentinel + provenance, measured ONCE per bench
+    process and stamped into every section record (and, via the resnet
+    primary record, the round header): which kernel-dispatch
+    configuration was measured, under how noisy a host."""
+    global _ROUND_CONTEXT
+    if _ROUND_CONTEXT is None:
+        _ROUND_CONTEXT = {
+            "noise": perfwatch.host_noise_sentinel(),
+            "provenance": perfwatch.provenance(),
+        }
+    return _ROUND_CONTEXT
+
+
+def _protocol_fields(rate: "perfwatch.Measurement") -> dict:
+    """The perfwatch-schema fields every section record carries:
+    per-trial values + MAD band (in the record's own unit) and the
+    round's noise/provenance context."""
+    ctx = round_context()
+    return {
+        "schema": perfwatch.SCHEMA,
+        **rate.to_dict(ndigits=1),
+        "noise": ctx["noise"],
+        "provenance": ctx["provenance"],
+    }
+
+
+def _section_key(metric_name: str) -> str:
+    """Compact section key ("lm_decode_tokens_per_sec_per_chip[b1]"
+    -> "decode[b1]") — also the anchor-registry / trajectory-ledger
+    key, so the artifacts join across rounds."""
+    return (metric_name.replace("lm_", "", 1)
+                       .replace("_tokens_per_sec_per_chip", ""))
+
 
 def device_peak_flops(device) -> float:
     """bf16 peak FLOP/s for the benched chip, from the per-topology
@@ -75,19 +140,36 @@ def make_step_telemetry(flops_per_example: float):
 
 
 def run_timed(step, state, batch_data, warmup: int, steps: int,
-              telemetry=None):
-    """Shared measurement harness. Sync via host fetch, not
-    block_until_ready: on the axon remote-TPU relay block_until_ready
-    returns before execution finishes (measured 1.6ms/step "throughput"
-    = 19x chip peak, physically impossible), while device_get forces the
-    full dependency chain to materialise. Returns (state, seconds).
+              telemetry=None, trials: int | None = None):
+    """Shared measurement harness, routed through the perfwatch
+    protocol. Sync via host fetch, not block_until_ready: on the axon
+    remote-TPU relay block_until_ready returns before execution
+    finishes (measured 1.6ms/step "throughput" = 19x chip peak,
+    physically impossible), while device_get forces the full dependency
+    chain to materialise.
+
+    Returns ``(state, perfwatch.Measurement)`` whose per-trial values
+    are seconds for one ``steps``-step pass; ``trials`` passes are
+    timed (default KFT_BENCH_TIMING_REPS, the decode sections' knob)
+    after the single warmup, so every section — not just decode — gets
+    a median + MAD band instead of the single-shot number bench.py:323
+    documents going 15% under / 25% over on the same commit.
 
     With ``telemetry`` (obs.StepTelemetry), every timed step is synced
-    and recorded individually — step_time, examples/sec, MFU — and the
-    returned wall time is the sum of per-step times (the per-step syncs
-    would otherwise pollute the aggregate with dispatch stalls)."""
+    and recorded individually — step_time, examples/sec, MFU — and each
+    trial's wall time is the sum of its per-step times (the per-step
+    syncs would otherwise pollute the aggregate with dispatch stalls).
+    Phase attribution rides this path with zero extra flags (PR 10):
+    each timed step runs under a profiler activation split into
+    dispatch (the step call) and sync (the host fetch that forces the
+    chain), StepTelemetry stamps the live digest into its per-step
+    JSONL record, and the returned measurement carries the compact
+    dispatch/sync digests in ``.phases``."""
     if steps <= 0:
         raise SystemExit("KFT_BENCH_STEPS must be >= 1")
+    if trials is None:
+        trials = _env_int("KFT_BENCH_TIMING_REPS", 3)
+    trials = max(1, int(trials))
     metrics = None
     for _ in range(warmup):
         state, metrics = step(state, batch_data)
@@ -97,34 +179,38 @@ def run_timed(step, state, batch_data, warmup: int, steps: int,
     if telemetry is not None:
         from kubeflow_tpu.obs.profile import PhaseProfiler
 
-        # Phase attribution rides the telemetry path with zero extra
-        # flags (PR 10): each timed step runs under a profiler
-        # activation split into dispatch (the step call) and sync (the
-        # host fetch that forces the chain), and StepTelemetry stamps
-        # the live digest into its per-step JSONL record.
         profiler = PhaseProfiler()
         batch_size = len(next(iter(batch_data.values())))
-        total = 0.0
-        for i in range(steps):
-            with profiler.activate():
-                t0 = time.perf_counter()
-                with profiler.phase("dispatch"):
-                    state, metrics = step(state, batch_data)
-                with profiler.phase("sync"):
-                    final_loss = float(jax.device_get(metrics["loss"]))
-                dt_step = time.perf_counter() - t0
-                total += dt_step
-                telemetry.observe(batch_size, dt_step, step=i)
+        trial_secs = []
+        step_index = 0
+        for _trial in range(trials):
+            total = 0.0
+            for _ in range(steps):
+                with profiler.activate():
+                    t0 = time.perf_counter()
+                    with profiler.phase("dispatch"):
+                        state, metrics = step(state, batch_data)
+                    with profiler.phase("sync"):
+                        final_loss = float(jax.device_get(metrics["loss"]))
+                    dt_step = time.perf_counter() - t0
+                    total += dt_step
+                    telemetry.observe(batch_size, dt_step, step=step_index)
+                    step_index += 1
+            trial_secs.append(total)
         assert np.isfinite(final_loss)
-        return state, total
+        measurement = perfwatch.Measurement.from_values(trial_secs)
+        measurement.phases = profiler.compact()
+        return state, measurement
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch_data)
-    final_loss = float(jax.device_get(metrics["loss"]))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-    return state, dt
+    trial_secs = []
+    for _trial in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch_data)
+        final_loss = float(jax.device_get(metrics["loss"]))
+        trial_secs.append(time.perf_counter() - t0)
+        assert np.isfinite(final_loss)
+    return state, perfwatch.Measurement.from_values(trial_secs)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -151,7 +237,7 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
     )
 
     cfg = LMConfig(
-        vocab=32768, layers=8, dim=1024, heads=8, dtype=jnp.bfloat16,
+        **_lm_dims(), dtype=jnp.bfloat16,
         attn_window=window, moe_experts=moe_experts,
         **({"moe_every": 2, "moe_router": moe_router}
            if moe_experts else {}),
@@ -163,8 +249,9 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
     tokens = jnp.asarray(
         rng.integers(0, cfg.vocab, size=(batch, seq)), jnp.int32
     )
-    state, dt = run_timed(step, state, {"tokens": tokens}, warmup, steps)
-    tokens_s = batch * seq * steps / dt
+    state, meas = run_timed(step, state, {"tokens": tokens}, warmup, steps)
+    rate = meas.as_rate(batch * seq * steps)
+    tokens_s = rate.median
     return {
         "metric": metric,
         "value": round(tokens_s, 1),
@@ -177,7 +264,8 @@ def bench_lm(seq: int, batch: int, steps: int, warmup: int,
         **({"window": window} if window is not None else {}),
         **({"moe_experts": moe_experts, "moe_router": moe_router}
            if moe_experts else {}),
-        "step_ms": round(1000 * dt / steps, 2),
+        "step_ms": round(1000 * meas.median / steps, 2),
+        **_protocol_fields(rate),
         "device": str(jax.devices()[0].device_kind),
     }
 
@@ -206,7 +294,7 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
     )
 
     cfg = LMConfig(
-        vocab=32768, layers=8, dim=1024, heads=8, kv_heads=2,
+        **_lm_dims(), kv_heads=2,
         dtype=jnp.bfloat16, attn_window=window,
     )
     rolling = window is not None
@@ -240,7 +328,7 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
     # tunnel) out of both numbers: prefill is timed as a scan over
     # PREFILL_REPS independent prompts inside ONE dispatch, decode as
     # one scan of new_tokens single-token steps.
-    prefill_reps = _env_int("KFT_BENCH_PREFILL_REPS", 8)
+    prefill_reps = _env_int("KFT_BENCH_PREFILL_REPS", _mini(8, 2))
 
     if prefill_chunk is not None:
         if not rolling or prompt_len % prefill_chunk:
@@ -318,32 +406,33 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
         rng.integers(0, cfg.vocab, size=(prefill_reps, batch, prompt_len)),
         jnp.int32,
     )
-    # Warmup (compile all shapes), then timed passes. Median-of-k on the
-    # timed pass: the round-3 record caught batch-1 prefill 21% under its
-    # anchor while a local rerun was 25% over — single-shot timing on the
-    # relay is too noisy to regression-gate on (BENCH_r03.json).
+    # Warmup (compile all shapes), then the perfwatch multi-trial
+    # protocol on the timed pass: the round-3 record caught batch-1
+    # prefill 21% under its anchor while a local rerun was 25% over —
+    # single-shot timing on the relay is too noisy to regression-gate
+    # on (BENCH_r03.json); the MAD band makes the noise visible.
     reps = _env_int("KFT_BENCH_TIMING_REPS", 3)
     first, cache = prefill(params, prompt)
     int(jax.device_get(first)[0])
     int(jax.device_get(prefill_many(params, prompts)))
-    prefill_dts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        int(jax.device_get(prefill_many(params, prompts)))
-        prefill_dts.append(time.perf_counter() - t0)
-    prefill_dt = float(np.median(prefill_dts))
-    prefill_tok_s = prefill_reps * batch * prompt_len / prefill_dt
+    prefill_meas = perfwatch.timed_trials(
+        lambda: int(jax.device_get(prefill_many(params, prompts))),
+        trials=reps,
+    )
+    prefill_rate = prefill_meas.as_rate(prefill_reps * batch * prompt_len)
+    prefill_tok_s = prefill_rate.median
 
-    last, cache2, _ = decode_chunk(params, first, cache)
+    last, _cache_warm, _ = decode_chunk(params, first, cache)
     int(jax.device_get(last)[0])
-    decode_dts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        last, _, toks = decode_chunk(params, first, cache)
-        int(jax.device_get(last)[0])
-        decode_dts.append(time.perf_counter() - t0)
-    decode_dt = float(np.median(decode_dts))
-    decode_tok_s = batch * new_tokens / decode_dt
+
+    def _decode_pass():
+        out, _, _toks = decode_chunk(params, first, cache)
+        int(jax.device_get(out)[0])
+
+    decode_meas = perfwatch.timed_trials(_decode_pass, trials=reps)
+    decode_rate = decode_meas.as_rate(batch * new_tokens)
+    decode_dt = decode_meas.median
+    decode_tok_s = decode_rate.median
 
     # Diagnostic only (headline methodology unchanged): the per-dispatch
     # relay round-trip rides INSIDE every timed pass, amortised over
@@ -357,12 +446,10 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
 
     zero = jnp.zeros((), jnp.int32)
     int(jax.device_get(_null(zero)))
-    floor_dts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        int(jax.device_get(_null(zero)))
-        floor_dts.append(time.perf_counter() - t0)
-    relay_floor = float(np.median(floor_dts))
+    floor_meas = perfwatch.timed_trials(
+        lambda: int(jax.device_get(_null(zero))), trials=5,
+    )
+    relay_floor = floor_meas.median
 
     return {
         "metric": "lm_decode_tokens_per_sec_per_chip",
@@ -389,6 +476,8 @@ def bench_decode(batch: int, prompt_len: int, new_tokens: int,
             round(prefill_tok_s / prefill_anchor, 4) if prefill_anchor
             else None
         ),
+        "prefill_band": prefill_rate.band,
+        **_protocol_fields(decode_rate),
         "device": str(jax.devices()[0].device_kind),
     }
 
@@ -413,7 +502,7 @@ def bench_decode_spec(prompt_len: int, new_tokens: int,
     from kubeflow_tpu.models.speculative import speculative_generate
 
     cfg = LMConfig(
-        vocab=32768, layers=8, dim=1024, heads=8, kv_heads=2,
+        **_lm_dims(), kv_heads=2,
         dtype=jnp.bfloat16,
     )
     model = build_lm(cfg)
@@ -431,15 +520,16 @@ def bench_decode_spec(prompt_len: int, new_tokens: int,
         return_stats=True))
     out, stats = spec(params, prompt)
     int(jax.device_get(out)[0, -1])
-    reps = _env_int("KFT_BENCH_TIMING_REPS", 3)
-    dts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out, stats = spec(params, prompt)
+
+    def _spec_pass():
+        out, _stats = spec(params, prompt)
         int(jax.device_get(out)[0, -1])
-        dts.append(time.perf_counter() - t0)
-    dt = float(np.median(dts))
-    tok_s = new_tokens / dt
+
+    reps = _env_int("KFT_BENCH_TIMING_REPS", 3)
+    meas = perfwatch.timed_trials(_spec_pass, trials=reps)
+    rate = meas.as_rate(new_tokens)
+    dt = meas.median
+    tok_s = rate.median
     return {
         "metric": "lm_decode_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
@@ -456,6 +546,7 @@ def bench_decode_spec(prompt_len: int, new_tokens: int,
         "tokens_per_verify": round(stats.tokens_per_verify, 2),
         "verify_calls": int(stats.verify_calls),
         "decode_step_ms": round(1000 * dt / new_tokens, 3),
+        **_protocol_fields(rate),
         "device": str(jax.devices()[0].device_kind),
     }
 
@@ -512,17 +603,17 @@ def _measure_plain_reference(image_size: int, batch: int,
         "label": jnp.asarray(rng.integers(0, 1000, size=(batch,))),
     }
     carry = (params, batch_stats, momentum)
-    carry, dt = run_timed(jit_step, carry, batch_data, warmup, steps)
-    return batch * steps / dt
+    carry, meas = run_timed(jit_step, carry, batch_data, warmup, steps)
+    return batch * steps / meas.median
 
 
 def bench_resnet():
-    batch = _env_int("KFT_BENCH_BATCH", 256)
-    image_size = _env_int("KFT_BENCH_IMAGE_SIZE", 224)
-    steps = _env_int("KFT_BENCH_STEPS", 20)
+    batch = _env_int("KFT_BENCH_BATCH", _mini(256, 8))
+    image_size = _env_int("KFT_BENCH_IMAGE_SIZE", _mini(224, 32))
+    steps = _env_int("KFT_BENCH_STEPS", _mini(20, 3))
     # Generous warmup: the remote-relay first execution has multi-second
     # stragglers well past compile (measured on the axon tunnel).
-    warmup = _env_int("KFT_BENCH_WARMUP", 8)
+    warmup = _env_int("KFT_BENCH_WARMUP", _mini(8, 1))
 
     from kubeflow_tpu.models import create_train_state, make_train_step, resnet50
     from kubeflow_tpu.models.resnet import resnet_flops_per_image
@@ -546,10 +637,11 @@ def bench_resnet():
 
     train_flops_per_img = 3.0 * resnet_flops_per_image("resnet50", image_size)
     telemetry = make_step_telemetry(train_flops_per_img)
-    state, dt = run_timed(step, state, batch_data, warmup, steps,
-                          telemetry=telemetry)
+    state, meas = run_timed(step, state, batch_data, warmup, steps,
+                            telemetry=telemetry)
+    rate = meas.as_rate(batch * steps)
 
-    img_s = batch * steps / dt
+    img_s = rate.median
     peak = device_peak_flops(jax.devices()[0])
     mfu = img_s * train_flops_per_img / peak
 
@@ -558,13 +650,18 @@ def bench_resnet():
 
     record = {
         "metric": "resnet50_train_images_per_sec_per_chip",
+        "section": "resnet",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_s / target, 4),
+        # The target is a v5e MFU fraction — a different experiment
+        # under the CPU preset (same rule as the LM env anchors).
+        "vs_baseline": (None if _preset() == "cpu-mini"
+                        else round(img_s / target, 4)),
         "mfu": round(mfu, 4),
         "batch": batch,
         "steps": steps,
-        "step_ms": round(1000 * dt / steps, 2),
+        "step_ms": round(1000 * meas.median / steps, 2),
+        **_protocol_fields(rate),
         "device": str(jax.devices()[0].device_kind),
     }
     if telemetry is not None:
@@ -597,11 +694,19 @@ def compact_record(record: dict, section_names: list[str],
         if k in record
     }
     compact["full_record"] = full_path
+    # Round header: the host-noise grade + git rev the round was taken
+    # under (full provenance lives in the full record; the compact line
+    # carries just enough to read a surprising ratio in context).
+    grade = (record.get("noise") or {}).get("grade")
+    if grade:
+        compact["noise"] = grade
+    rev = (record.get("provenance") or {}).get("git_rev")
+    if rev:
+        compact["rev"] = rev[:10]
     sections: dict[str, dict] = {}
     extras = record.get("extra_metrics", [])
     for name, entry in zip(section_names, extras):
-        key = (name.replace("lm_", "", 1)
-                   .replace("_tokens_per_sec_per_chip", ""))
+        key = _section_key(name)
         if entry.get("metric") == "bench_extra_error":
             sections[key] = {"err": str(entry.get("error", ""))[:60]}
             continue
@@ -623,16 +728,23 @@ def main():
     # LM_-prefixed ones so each section is tunable independently.
     lm = "" if mode == "lm" else "LM_"
     lm_defaults = dict(
-        batch=_env_int(f"KFT_BENCH_{lm}BATCH", 4),
-        seq=_env_int(f"KFT_BENCH_{lm}SEQ", 2048),
-        steps=_env_int(f"KFT_BENCH_{lm}STEPS", 10),
-        warmup=_env_int(f"KFT_BENCH_{lm}WARMUP", 4),
+        batch=_env_int(f"KFT_BENCH_{lm}BATCH", _mini(4, 2)),
+        seq=_env_int(f"KFT_BENCH_{lm}SEQ", _mini(2048, 128)),
+        steps=_env_int(f"KFT_BENCH_{lm}STEPS", _mini(10, 3)),
+        warmup=_env_int(f"KFT_BENCH_{lm}WARMUP", _mini(4, 1)),
     )
     # Fixed cross-round anchors: each is the value measured the round
     # its config was first benched (BASELINE.md). vs_baseline = value /
     # anchor, so every section regression-tracks — no null baselines.
     # Setting any anchor env var to 0 disables that ratio (null).
     def _env_anchor(name: str, default: float) -> float | None:
+        if _preset() == "cpu-mini" and name not in os.environ:
+            # The pinned defaults are TPU numbers — a different
+            # experiment. Under the CPU preset vs_baseline is omitted
+            # (None) unless the anchor is explicitly set; cross-round
+            # comparison runs through PERF_ANCHORS.json, whose
+            # provenance makes the platform mismatch explicit.
+            return None
         return float(os.environ.get(name, str(default)) or 0) or None
 
     lm_anchor = _env_anchor("KFT_BENCH_LM_ANCHOR", 111600)
@@ -645,30 +757,37 @@ def main():
     prefill_b8_anchor = _env_anchor("KFT_BENCH_PREFILL_B8_ANCHOR", 275859)
 
     if mode == "lm":
-        print(json.dumps(bench_lm(
+        rec = bench_lm(
             metric="lm_train_tokens_per_sec_per_chip",
             anchor_tokens_s=lm_anchor, **lm_defaults,
-        )))
+        )
+        rec.setdefault("section", "train")
+        print(json.dumps(rec))
         return
     if mode == "long":
-        print(json.dumps(bench_lm(
+        rec = bench_lm(
             metric="lm_long_context_tokens_per_sec_per_chip",
             anchor_tokens_s=None,
             batch=_env_int("KFT_BENCH_BATCH", 1),
-            seq=_env_int("KFT_BENCH_SEQ", 8192),
-            steps=_env_int("KFT_BENCH_STEPS", 5),
-            warmup=_env_int("KFT_BENCH_WARMUP", 2),
+            seq=_env_int("KFT_BENCH_SEQ", _mini(8192, 256)),
+            steps=_env_int("KFT_BENCH_STEPS", _mini(5, 2)),
+            warmup=_env_int("KFT_BENCH_WARMUP", _mini(2, 1)),
             window=_env_int("KFT_BENCH_WINDOW", 0) or None,
-        )))
+        )
+        rec.setdefault("section", "long_context")
+        print(json.dumps(rec))
         return
     if mode == "decode":
-        print(json.dumps(bench_decode(
-            batch=_env_int("KFT_BENCH_BATCH", 1),
-            prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
-            new_tokens=_env_int("KFT_BENCH_NEW_TOKENS", 256),
+        batch = _env_int("KFT_BENCH_BATCH", 1)
+        rec = bench_decode(
+            batch=batch,
+            prompt_len=_env_int("KFT_BENCH_PROMPT", _mini(1024, 128)),
+            new_tokens=_env_int("KFT_BENCH_NEW_TOKENS", _mini(256, 32)),
             prefill_anchor=prefill_anchor,
             decode_anchor=decode_anchor,
-        )))
+        )
+        rec.setdefault("section", f"decode[b{batch}]")
+        print(json.dumps(rec))
         return
     if mode == "resnet":
         print(json.dumps(bench_resnet()))
@@ -685,10 +804,10 @@ def main():
     # attributable in BENCH_r*.json.
     record = bench_resnet()
     extras = []
-    long_seq = _env_int("KFT_BENCH_LONG_SEQ", 8192)
-    long_steps = _env_int("KFT_BENCH_LONG_STEPS", 5)
-    long_warmup = _env_int("KFT_BENCH_LONG_WARMUP", 2)
-    new_tokens = _env_int("KFT_BENCH_NEW_TOKENS", 256)
+    long_seq = _env_int("KFT_BENCH_LONG_SEQ", _mini(8192, 256))
+    long_steps = _env_int("KFT_BENCH_LONG_STEPS", _mini(5, 2))
+    long_warmup = _env_int("KFT_BENCH_LONG_WARMUP", _mini(2, 1))
+    new_tokens = _env_int("KFT_BENCH_NEW_TOKENS", _mini(256, 32))
     sections = [
         # (metric-name, mandatory, thunk)
         ("lm_train_tokens_per_sec_per_chip", True, lambda: bench_lm(
@@ -706,8 +825,8 @@ def main():
             metric="lm_long_context_32k_tokens_per_sec_per_chip",
             anchor_tokens_s=long32k_anchor,
             batch=1,
-            seq=_env_int("KFT_BENCH_LONG32K_SEQ", 32768),
-            steps=_env_int("KFT_BENCH_LONG32K_STEPS", 3),
+            seq=_env_int("KFT_BENCH_LONG32K_SEQ", _mini(32768, 512)),
+            steps=_env_int("KFT_BENCH_LONG32K_STEPS", _mini(3, 2)),
             warmup=_env_int("KFT_BENCH_LONG32K_WARMUP", 1),
         )),
         ("lm_sliding_window_tokens_per_sec_per_chip", False,
@@ -716,17 +835,19 @@ def main():
             anchor_tokens_s=window_anchor,
             batch=_env_int("KFT_BENCH_LONG_BATCH", 1),
             seq=long_seq, steps=long_steps, warmup=long_warmup,
-            window=_env_int("KFT_BENCH_WINDOW", 1024),
+            window=_env_int("KFT_BENCH_WINDOW", _mini(1024, 64)),
         )),
         ("lm_decode_tokens_per_sec_per_chip[b1]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            batch=1,
+            prompt_len=_env_int("KFT_BENCH_PROMPT", _mini(1024, 128)),
             new_tokens=new_tokens,
             prefill_anchor=prefill_anchor, decode_anchor=decode_anchor,
         )),
         ("lm_decode_tokens_per_sec_per_chip[b8]", False,
          lambda: bench_decode(
-            batch=8, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            batch=8,
+            prompt_len=_env_int("KFT_BENCH_PROMPT", _mini(1024, 128)),
             new_tokens=new_tokens,
             prefill_anchor=prefill_b8_anchor,
             decode_anchor=decode_b8_anchor,
@@ -750,7 +871,7 @@ def main():
         # dense-read design used to degrade linearly with max_len.
         ("lm_decode_tokens_per_sec_per_chip[b1-p8k]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=8192, new_tokens=128,
+            batch=1, prompt_len=_mini(8192, 256), new_tokens=_mini(128, 32),
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P8K_ANCHOR",
                                        238360),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P8K_ANCHOR",
@@ -758,7 +879,7 @@ def main():
         )),
         ("lm_decode_tokens_per_sec_per_chip[b1-p32k]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=32768, new_tokens=64,
+            batch=1, prompt_len=_mini(32768, 512), new_tokens=_mini(64, 16),
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_P32K_ANCHOR",
                                        165938),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P32K_ANCHOR",
@@ -768,7 +889,7 @@ def main():
         # long prompt): payload reads halve vs the bf16 rows above.
         ("lm_decode_tokens_per_sec_per_chip[b8-p8k]", False,
          lambda: bench_decode(
-            batch=8, prompt_len=8192, new_tokens=64,
+            batch=8, prompt_len=_mini(8192, 256), new_tokens=_mini(64, 16),
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_B8P8K_ANCHOR",
                                        375115),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_B8P8K_ANCHOR",
@@ -776,7 +897,8 @@ def main():
         )),
         ("lm_decode_tokens_per_sec_per_chip[b8-p8k-int8]", False,
          lambda: bench_decode(
-            batch=8, prompt_len=8192, new_tokens=64, quantized=True,
+            batch=8, prompt_len=_mini(8192, 256), new_tokens=_mini(64, 16),
+            quantized=True,
             prefill_anchor=_env_anchor(
                 "KFT_BENCH_PREFILL_B8P8K_INT8_ANCHOR", 371590),
             decode_anchor=_env_anchor(
@@ -786,7 +908,8 @@ def main():
         # cache: per-token cost must not grow with the prompt.
         ("lm_decode_tokens_per_sec_per_chip[b1-p8k-w1k]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=8192, new_tokens=128, window=1024,
+            batch=1, prompt_len=_mini(8192, 256), new_tokens=_mini(128, 32),
+            window=_mini(1024, 64),
             prefill_anchor=_env_anchor("KFT_BENCH_PREFILL_W1K_ANCHOR",
                                        274507),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_W1K_ANCHOR",
@@ -801,8 +924,9 @@ def main():
         # decode 878 tok/s (1.14 ms/step), prefill 134.1k tok/s.
         ("lm_decode_tokens_per_sec_per_chip[b1-p32k-w1k]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=32768, new_tokens=128, window=1024,
-            prefill_chunk=2048,
+            batch=1, prompt_len=_mini(32768, 512),
+            new_tokens=_mini(128, 32), window=_mini(1024, 64),
+            prefill_chunk=_mini(2048, 128),
             prefill_anchor=_env_anchor(
                 "KFT_BENCH_PREFILL_P32KW1K_ANCHOR", 134100),
             decode_anchor=_env_anchor(
@@ -819,7 +943,8 @@ def main():
         # dequant fallback, tracked by the bf16 rows' prefill anchors)
         ("lm_decode_tokens_per_sec_per_chip[b1-w8]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            batch=1,
+            prompt_len=_env_int("KFT_BENCH_PROMPT", _mini(1024, 128)),
             new_tokens=new_tokens, weight_int8=True,
             prefill_anchor=None,
             decode_anchor=_env_anchor(
@@ -827,7 +952,8 @@ def main():
         )),
         ("lm_decode_tokens_per_sec_per_chip[b1-p8k-w8]", False,
          lambda: bench_decode(
-            batch=1, prompt_len=8192, new_tokens=128, weight_int8=True,
+            batch=1, prompt_len=_mini(8192, 256), new_tokens=_mini(128, 32),
+            weight_int8=True,
             prefill_anchor=None,
             decode_anchor=_env_anchor(
                 "KFT_BENCH_DECODE_P8KW8_ANCHOR", 800),
@@ -840,13 +966,13 @@ def main():
         # contributed.
         ("lm_decode_tokens_per_sec_per_chip[spec-b1]", False,
          lambda: bench_decode_spec(
-            prompt_len=_env_int("KFT_BENCH_PROMPT", 1024),
+            prompt_len=_env_int("KFT_BENCH_PROMPT", _mini(1024, 128)),
             new_tokens=new_tokens,
             decode_anchor=decode_anchor,
         )),
         ("lm_decode_tokens_per_sec_per_chip[spec-b1-p8k]", False,
          lambda: bench_decode_spec(
-            prompt_len=8192, new_tokens=128,
+            prompt_len=_mini(8192, 256), new_tokens=_mini(128, 32),
             decode_anchor=_env_anchor("KFT_BENCH_DECODE_P8K_ANCHOR",
                                       789),
         )),
@@ -859,7 +985,11 @@ def main():
         last_exc = None
         for attempt in range(attempts):
             try:
-                extras.append(section())
+                result = section()
+                # The anchor-registry / ledger key (satellite: every
+                # record names the section it measured).
+                result.setdefault("section", _section_key(name))
+                extras.append(result)
                 last_exc = None
                 break
             # analysis: allow[py-broad-except] — bench harness: any shape failure is recorded as a skipped section, never a crash

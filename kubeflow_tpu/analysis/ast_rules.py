@@ -119,6 +119,20 @@
   (kubeflow_tpu/scheduler/) is the reference discipline; deliberate
   exceptions escape with
   ``# analysis: allow[py-unbounded-queue-admission]``.
+- ``py-single-shot-bench`` (warning): a ``time.perf_counter()`` pair
+  wrapping a loop — ``t0 = time.perf_counter()``, a sibling
+  ``for``/``while``, then ``time.perf_counter() - t0`` — in a bench or
+  loadtest tree with no trial-repetition identifier in the enclosing
+  scope (no ``trial``/``reps``/``repeat``/``attempts``/... component
+  in any local name). One wall-clock sample has no error bar: a single
+  noisy scheduler tick reads as a regression and a lucky quiet window
+  hides one (the bug class bench.py's ``run_timed`` docstring
+  documents — the r01–r05 numbers carried exactly this blindness until
+  the perfwatch protocol re-pinned them with noise bands). Repeat the
+  measurement (``kubeflow_tpu.obs.perfwatch.timed_trials`` /
+  ``Measurement.from_values``) or name the repetition loop for what it
+  is; a deliberate one-shot escapes with
+  ``# analysis: allow[py-single-shot-bench]``.
 """
 
 from __future__ import annotations
@@ -1049,6 +1063,128 @@ def _check_queue_admission(tree: ast.AST, path: str,
                     scan(item, [item])
 
 
+# Identifier components (underscore-split) that signal a measurement is
+# repeated: `for _trial in range(trials)` or `reps = ...` in scope means
+# the perf_counter pair is one sample of many, not the whole verdict.
+# "round"/"rounds" is deliberately absent — round() the builtin appears
+# in every bench formatter and would exempt everything.
+_TRIAL_COMPONENTS = {
+    "trial", "trials", "rep", "reps", "repeat", "repeats",
+    "attempt", "attempts", "iters", "passes",
+}
+
+
+def _single_shot_bench_applies(path: str) -> bool:
+    """Bench/loadtest trees only: bench.py-style drivers (basename) and
+    anything under a bench/ or loadtest/ directory. Library timing
+    (telemetry, profilers) legitimately takes one sample per event."""
+    parts = [p for p in path.replace("\\", "/").split("/") if p]
+    if not parts:
+        return False
+    if any(part in ("bench", "loadtest") for part in parts[:-1]):
+        return True
+    return parts[-1].startswith("bench")
+
+
+def _is_perf_counter(node: ast.AST, aliases: dict[str, str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func, aliases) == "time.perf_counter")
+
+
+def _scope_trial_components(scope: ast.AST) -> bool:
+    """True when any identifier in the scope (own region only — nested
+    defs carry their own repetition story) splits to a trial-repetition
+    component."""
+    names: list[str] = []
+    for node in _scope_nodes(scope):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.arg):
+            names.append(node.arg)
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        names.append(scope.name)
+        names.extend(a.arg for a in scope.args.args)
+    return any(
+        comp in _TRIAL_COMPONENTS
+        for name in names
+        for comp in name.lower().split("_")
+    )
+
+
+def _delta_line(stmt: ast.AST, name: str,
+                aliases: dict[str, str]) -> int | None:
+    """Line of a ``time.perf_counter() - <name>`` inside ``stmt``."""
+    for node in ast.walk(stmt):
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+                and node.right.id == name
+                and _is_perf_counter(node.left, aliases)):
+            return node.lineno
+    return None
+
+
+def _scan_single_shot_body(stmts: list[ast.stmt],
+                           aliases: dict[str, str], path: str,
+                           out: list[Finding]) -> None:
+    """One sibling sequence: a perf_counter assign, a later loop
+    sibling, then the closing ``perf_counter() - t0``. The delta check
+    runs before the current statement updates state, so a subtraction
+    INSIDE the loop (per-iteration timing) never pairs with it."""
+    pending: dict[str, bool] = {}  # t0 name -> loop sibling seen
+    for stmt in stmts:
+        for name in list(pending):
+            if not pending[name]:
+                continue
+            line = _delta_line(stmt, name, aliases)
+            if line is None:
+                continue
+            del pending[name]
+            out.append(Finding(
+                "py-single-shot-bench", Severity.WARNING, path, line,
+                f"perf_counter pair around '{name}' times the loop "
+                "exactly once: a single wall-clock sample has no noise "
+                "band, so one scheduler tick reads as a regression and "
+                "a quiet window hides one — repeat the measurement "
+                "(kubeflow_tpu.obs.perfwatch.timed_trials or a "
+                "trial/reps loop feeding Measurement.from_values), or "
+                "annotate a deliberate one-shot with "
+                "# analysis: allow[py-single-shot-bench]",
+            ))
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            for name in pending:
+                pending[name] = True
+            continue
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and _is_perf_counter(stmt.value, aliases)):
+            pending[stmt.targets[0].id] = False
+
+
+def _check_single_shot_bench(tree: ast.AST, aliases: dict[str, str],
+                             path: str, out: list[Finding]) -> None:
+    """Flag single-shot loop timings in bench/loadtest trees. Scope is
+    per function (or the module's own region): a trial-repetition
+    identifier anywhere in the scope exempts every pair in it — the
+    sample is one of many by construction."""
+    if not _single_shot_bench_applies(path) or _is_test_tree(path):
+        return
+    scopes: list[ast.AST] = [tree]
+    scopes += [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        if _scope_trial_components(scope):
+            continue
+        for node in [scope, *_scope_nodes(scope)]:
+            if node is not scope and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+                continue  # their bodies get their own scope pass
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if isinstance(stmts, list) and stmts:
+                    _scan_single_shot_body(stmts, aliases, path, out)
+
+
 # File shapes where print() is the intended output channel, not stray
 # telemetry: named script entrypoints and test/doc trees.
 _PRINT_EXEMPT_BASENAMES = {"__main__.py", "conftest.py", "setup.py"}
@@ -1144,6 +1280,7 @@ def analyze_python_source(source: str, path: str,
     _check_nonatomic_writes(tree, aliases, path, out)  # module scope
     _check_unbounded_actuation(tree, path, out)
     _check_queue_admission(tree, path, out)
+    _check_single_shot_bench(tree, aliases, path, out)
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             is_traced = node.name in traced_names or any(

@@ -74,6 +74,7 @@ __all__ = [
     "PhaseProfiler",
     "JsonLogFormatter",
     "JsonlExporter",
+    "Measurement",
     "MultiExporter",
     "Objective",
     "RingExporter",
@@ -83,18 +84,37 @@ __all__ = [
     "StepTelemetry",
     "TRACE_ANNOTATION",
     "Tracer",
+    "Verdict",
     "configure_structured_logging",
     "current_span",
     "fleet_cards",
     "format_traceparent",
     "get_tracer",
+    "host_noise_sentinel",
     "memory_watermark",
+    "timed_trials",
     "parse_traceparent",
     "set_tracer",
     "span_tree",
     "timeline",
     "trace_summaries",
 ]
+
+_PERFWATCH_EXPORTS = {
+    "Measurement", "Verdict", "host_noise_sentinel", "timed_trials",
+}
+
+
+def __getattr__(name: str):
+    """Perfwatch symbols resolve lazily so ``python -m
+    kubeflow_tpu.obs.perfwatch`` (the gate CLI) doesn't import the
+    module twice through the package (runpy's double-import warning)."""
+    if name in _PERFWATCH_EXPORTS:
+        from kubeflow_tpu.obs import perfwatch
+
+        return getattr(perfwatch, name)
+    raise AttributeError(name)
+
 
 _tracer: Tracer | None = None
 _tracer_lock = threading.Lock()

@@ -48,6 +48,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from kubeflow_tpu.obs import perfwatch  # noqa: E402
 from loadtest.start_notebooks import percentile  # noqa: E402
 
 
@@ -160,7 +161,7 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
     gaps = sorted(g for r in results for g in r["itl_s"])
     decode_tok_s = (round(len(gaps) / sum(gaps), 2)
                     if gaps and sum(gaps) > 0 else 0.0)
-    return {
+    summary = {
         "metric": "inference_gateway_load",
         "count": len(results),
         "errors": errors,
@@ -177,6 +178,29 @@ def run_load(url: str, prompts: list[list[int]], clients: int,
         "shed": sum(r["shed"] for r in results),
         "cache_hits": sum(1 for r in results if r["cache_hit"]),
     }
+    # Gateway SLOs join the perf trajectory through the SAME schema
+    # kernel sections use: each completed stream's steady-state decode
+    # rate is one trial, banded by the multi-trial protocol, so the
+    # ledger/verdict engine reads `serve[decode]` exactly like a
+    # `decode[*]` bench section.
+    stream_rates = [
+        len(r["itl_s"]) / sum(r["itl_s"])
+        for r in results
+        if r["itl_s"] and sum(r["itl_s"]) > 0
+    ]
+    if stream_rates:
+        summary["perfwatch_record"] = perfwatch.make_record(
+            "serve[decode]",
+            "gateway_decode_tokens_per_s_per_stream",
+            "tokens/sec/stream",
+            perfwatch.Measurement.from_values(stream_rates),
+            extra={key: summary[key] for key in (
+                "qps", "ttft_p50_s", "ttft_p99_s", "itl_p50_s",
+                "itl_p99_s", "decode_tokens_per_s_per_stream",
+                "shed", "cache_hits",
+            )},
+        )
+    return summary
 
 
 def fetch_status(url: str, timeout: float) -> dict | None:

@@ -50,9 +50,10 @@ def measure(loss_impl, batch, seq, steps, warmup):
     tokens = jnp.asarray(
         rng.integers(0, cfg.vocab, size=(batch, seq)), jnp.int32
     )
-    state, dt = bench.run_timed(step, state, {"tokens": tokens},
-                                warmup, steps)
-    return batch * seq * steps / dt, 1000 * dt / steps
+    state, meas = bench.run_timed(step, state, {"tokens": tokens},
+                                  warmup, steps)
+    return (batch * seq * steps / meas.median,
+            1000 * meas.median / steps)
 
 
 def main():

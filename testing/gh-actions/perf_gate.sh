@@ -1,0 +1,259 @@
+#!/usr/bin/env bash
+# Perf gate for CI (PR 18). The perf observatory's contract, smoke-
+# tested on CPU:
+#
+# 1. Protocol tests: the hand-computed band math, the seeded 20%
+#    regression caught (exit 1), the within-band wobble forgiven, the
+#    provenance-mismatch -> incomparable rule, ledger atomicity.
+#
+# 2. Tiny end-to-end round: a real (tiny) jitted workload through
+#    timed_trials -> make_record -> pin -> verdict -> report, schema
+#    validated at every step, plus a planted 20% slowdown that MUST
+#    flip the verdict exit code. NOT a perf claim — the protocol's
+#    plumbing proven end to end on every CI run.
+#
+# 3. Committed artifacts: BENCH_r06.json parses, every decode[*] and
+#    spec section is pinned in PERF_ANCHORS.json with a band and
+#    provenance, and the trajectory ledger renders with an r06 column.
+#
+# 4. RUN_SLOW=1 only: a real cpu-mini bench mini-round (train +
+#    decode[b1]) diffed against the committed anchors — each mode runs
+#    THREE times and the three per-process medians are banded as one
+#    measurement (in-process trial bands are blind to cross-process
+#    wobble: CPU frequency, cache layout, container neighbors).
+#    Because the committed anchors are single-process pins, the live
+#    diff adds a flat cross-process allowance on top of the banded
+#    tolerance; regressions past the allowance exit nonzero,
+#    incomparable (different host provenance) reports loudly but does
+#    not gate.
+#
+# 5. Static analysis: the perf trees (perfwatch, bench.py, loadtest/)
+#    hold every pack at zero findings, and the new
+#    py-single-shot-bench rule holds with NO pragma escapes.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== perf gate: protocol tests (bands, verdicts, atomicity) =="
+python -m pytest tests/test_perfwatch.py -q -p no:cacheprovider \
+  -m 'not slow'
+
+echo "== perf gate: tiny round through the full protocol =="
+python - <<'PY'
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.obs import perfwatch
+
+x = jnp.ones((256, 256), jnp.float32)
+mul = jax.jit(lambda a: a @ a)
+
+
+def thunk():
+    # Long enough (~10-20 ms) that scheduler jitter averages out even
+    # on a loud single-core CI box; device_get forces the chain.
+    for _rep in range(50):
+        out = mul(x)
+    jax.device_get(out)
+
+
+meas = perfwatch.timed_trials(thunk, trials=7, warmup=2)
+noise = perfwatch.host_noise_sentinel(spin_samples=500, sleeps=3)
+record = perfwatch.make_record(
+    "gate[tiny-matmul]", "gate_tiny_matmul_s", "seconds", meas,
+    noise=noise,
+)
+problems = perfwatch.validate_record(record)
+assert problems == [], f"tiny record failed schema: {problems}"
+assert record["band"]["lo"] <= record["value"] <= record["band"]["hi"]
+
+with tempfile.TemporaryDirectory() as tmp:
+    rec_path = os.path.join(tmp, "full.json")
+    anchors = os.path.join(tmp, "anchors.json")
+    ledger = os.path.join(tmp, "ledger.jsonl")
+    with open(rec_path, "w") as fh:
+        json.dump(record, fh)
+    assert perfwatch.main(["pin", "--record", rec_path, "--round",
+                           "gate", "--anchors", anchors]) == 0
+    # The round judged against its own pins: within noise, exit 0.
+    assert perfwatch.main(["verdict", "--record", rec_path,
+                           "--anchors", anchors]) == 0
+    # A planted slowdown MUST flip the gate: at least 20%, deeper if
+    # this host's honest tolerance is wider (the deterministic 20%
+    # proof is tests/test_perfwatch.py; here the protocol runs live).
+    (verdict,) = perfwatch.judge_records(
+        [record], perfwatch.load_anchors(anchors)
+    )
+    assert verdict.tolerance < 0.5, (
+        f"host too noisy for the gate to mean anything "
+        f"(tolerance {verdict.tolerance})"
+    )
+    factor = min(0.8, 1.0 - verdict.tolerance - 0.05)
+    slow = dict(record)
+    slow["value"] = round(record["value"] * factor, 6)
+    with open(rec_path, "w") as fh:
+        json.dump(slow, fh)
+    rc = perfwatch.main(["verdict", "--record", rec_path,
+                        "--anchors", anchors])
+    assert rc == 1, (
+        f"planted {100 * (1 - factor):.0f}% regression escaped the "
+        f"gate (rc={rc})"
+    )
+    assert perfwatch.main(["ingest", "--record", rec_path, "--round",
+                           "gate", "--ledger", ledger]) == 0
+    assert perfwatch.main(["report", "--ledger", ledger]) == 0
+print("  tiny round: protocol plumbing OK (regression gate flips)")
+PY
+
+echo "== perf gate: committed r06 artifacts =="
+python - <<'PY'
+import json
+
+from kubeflow_tpu.obs import perfwatch
+
+with open("BENCH_r06.json") as fh:
+    driver = json.load(fh)
+assert driver["rc"] == 0, "committed r06 round did not exit 0"
+sections = driver["parsed"]["sections"]
+anchors = perfwatch.load_anchors("PERF_ANCHORS.json")["anchors"]
+perf_sections = sorted(
+    s for s in sections if s.startswith("decode[") or "spec" in s
+)
+assert perf_sections, "r06 recorded no decode/spec sections"
+missing = [s for s in perf_sections if s not in anchors]
+assert not missing, f"sections missing from PERF_ANCHORS.json: {missing}"
+for name, anchor in anchors.items():
+    assert anchor.get("value"), f"anchor {name} has no value"
+    assert anchor.get("band_rel") is not None, f"{name} has no band"
+    prov = anchor.get("provenance") or {}
+    for key in ("git_rev", "platform", "env"):
+        assert key in prov, f"{name} provenance missing {key}"
+entries = perfwatch.read_ledger("PERF_TRAJECTORY.jsonl")
+rounds = {e.get("round") for e in entries}
+assert "r06" in rounds, f"trajectory ledger has no r06 column: {rounds}"
+table = perfwatch.render_trend(entries)
+assert "r06" in table.splitlines()[0]
+print(f"  {len(perf_sections)} decode/spec sections pinned, "
+      f"ledger rounds: {sorted(r for r in rounds if r)}")
+PY
+
+if [ "${RUN_SLOW:-0}" = "1" ]; then
+  echo "== perf gate: real cpu-mini round vs committed anchors =="
+  GATE_TMP="$(mktemp -d)"
+  trap 'rm -rf "$GATE_TMP"' EXIT
+  # Three full processes per mode: measured on this class of box,
+  # cpu-mini medians wobble ~20% BETWEEN processes while in-process
+  # trial bands read 4-6% — one process's band under-states the real
+  # variance, so the gate bands the three per-process medians instead.
+  for i in 1 2 3; do
+    KFT_BENCH_PRESET=cpu-mini KFT_BENCH_MODE=lm \
+      python bench.py > "$GATE_TMP/train_$i.json"
+    KFT_BENCH_PRESET=cpu-mini KFT_BENCH_MODE=decode \
+      python bench.py > "$GATE_TMP/decode_$i.json"
+  done
+  python - "$GATE_TMP" <<'PY'
+import json
+import sys
+
+from kubeflow_tpu.obs import perfwatch
+
+tmp = sys.argv[1]
+runs = []
+for i in (1, 2, 3):
+    with open(f"{tmp}/train_{i}.json") as fh:
+        doc = json.load(fh)
+    with open(f"{tmp}/decode_{i}.json") as fh:
+        doc["extra_metrics"] = [json.load(fh)]
+    by_section = {}
+    for record in perfwatch.records_from_full(doc):
+        problems = perfwatch.validate_record(record)
+        assert problems == [], f"{record['section']}: {problems}"
+        by_section[record["section"]] = record
+    runs.append(by_section)
+
+# One combined record per gated section: the three per-process medians
+# banded as a fresh Measurement, stamped with the run's provenance and
+# the WORST noise grade any process saw.
+combined = []
+for section in ("train", "decode[b1]"):
+    per_run = [run[section] for run in runs if section in run]
+    assert len(per_run) == len(runs), f"{section}: missing from a run"
+    meas = perfwatch.Measurement.from_values(
+        [r["value"] for r in per_run]
+    )
+    noise = max(
+        (r.get("noise") or {} for r in per_run),
+        key=lambda n: perfwatch.GRADES.index(n.get("grade", "loud")),
+    )
+    combined.append(perfwatch.make_record(
+        section, per_run[0]["metric"], per_run[0]["unit"], meas,
+        noise=noise, prov=per_run[0].get("provenance"),
+    ))
+verdicts = perfwatch.judge_records(
+    combined, perfwatch.load_anchors("PERF_ANCHORS.json"),
+    sections=["train", "decode[b1]"],
+)
+# The committed anchors are SINGLE-process pins; the live diff crosses
+# a process boundary the banded tolerance never sampled. Measured on
+# this box: back-to-back cpu-mini rounds land 20-30% apart (lm medians
+# cluster at ~5.5k AND ~7.3k tok/s) with 4-6% in-process bands. The
+# live tier therefore grants a flat cross-process allowance on top of
+# the verdict tolerance and gates on what's left — a halving still
+# fails loudly, a process-placement wobble does not. The tight gate is
+# the smoke tier above (same process, planted slowdown MUST flip it).
+ALLOWANCE = 0.30
+failed = []
+for verdict in verdicts:
+    print("  " + verdict.render())
+    if verdict.status != "regressed":
+        continue
+    if verdict.ratio < 1.0 - (verdict.tolerance + ALLOWANCE):
+        failed.append(verdict.section)
+    else:
+        print(f"    ^ within the ±{ALLOWANCE:.0%} cross-process "
+              "allowance — reported, not gated")
+if failed:
+    print(f"  GATING regression past allowance: {failed}")
+raise SystemExit(1 if failed else 0)
+PY
+fi
+
+echo "== perf gate: analysis packs at zero findings, no new pragmas =="
+python -m kubeflow_tpu.analysis kubeflow_tpu/obs/perfwatch.py \
+  bench.py loadtest
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+from kubeflow_tpu.analysis.findings import pragma_rules
+
+paths = ["kubeflow_tpu/obs/perfwatch.py", "bench.py", "loadtest"]
+findings = analyze_paths(AnalysisConfig(paths=paths,
+                                        check_emitted=False))
+if findings:
+    print("\n".join(f.render() for f in findings))
+    raise SystemExit(1)
+# The single-shot rule holds WITHOUT escapes: the perf trees repeat
+# their measurements, they don't pragma their way past the protocol.
+import glob
+import os
+
+files = [p for p in paths if os.path.isfile(p)]
+files += [p for pattern in ("loadtest/*.py",)
+          for p in sorted(glob.glob(pattern))]
+for path in files:
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            for rule in pragma_rules(line):
+                assert rule != "py-single-shot-bench", (
+                    f"{path}:{lineno} pragmas py-single-shot-bench — "
+                    "repeat the measurement instead"
+                )
+print("  perf trees: clean under all packs, no single-shot pragmas")
+PY
+
+echo "perf gate: OK"

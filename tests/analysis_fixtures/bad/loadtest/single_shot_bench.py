@@ -1,0 +1,23 @@
+"""Seeded py-single-shot-bench violations: perf_counter pairs that
+time a loop exactly once, with no trial-repetition loop in scope."""
+
+import time
+
+
+def bench_decode(step, steps):
+    # VIOLATION: one wall-clock sample around the whole loop.
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    return time.perf_counter() - t0
+
+
+def bench_prefill(step, steps):
+    # VIOLATION: same shape through an intermediate statement and a
+    # different clock variable name.
+    start = time.perf_counter()
+    while steps > 0:
+        step()
+        steps -= 1
+    elapsed = time.perf_counter() - start
+    return elapsed / max(steps, 1)

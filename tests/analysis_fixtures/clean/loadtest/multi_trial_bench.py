@@ -1,0 +1,46 @@
+"""Clean counterpart to bad/loadtest/single_shot_bench.py: the same
+perf_counter pair shapes, made legitimate by trial repetition (or by
+not wrapping a loop at all)."""
+
+import time
+
+
+def bench_decode(step, steps, trials):
+    # Clean: the pair sits inside a trial loop — one sample of many.
+    secs = []
+    for _trial in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        secs.append(time.perf_counter() - t0)
+    return secs
+
+
+def bench_prefill(step, reps):
+    # Clean: repetition identifier in scope even though the pair and
+    # the loop are siblings of it.
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        step()
+    return time.perf_counter() - t0
+
+
+def bench_startup(boot):
+    # Clean: no loop between the pair — a one-shot latency probe of a
+    # single event, not a loop aggregate.
+    t0 = time.perf_counter()
+    boot()
+    return time.perf_counter() - t0
+
+
+def bench_per_step(step, steps):
+    # Clean: the subtraction happens INSIDE the loop (per-iteration
+    # samples), which is repetition by construction.
+    samples = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+        now = time.perf_counter()
+        samples.append(now - t0)
+        t0 = now
+    return samples

@@ -855,6 +855,155 @@ class TestUnboundedQueueAdmissionRule:
         assert findings == []
 
 
+class TestSingleShotBenchRule:
+    """py-single-shot-bench: a perf_counter pair wrapping a loop with
+    no trial repetition in scope — one wall-clock sample posing as a
+    benchmark (PR 18, the bug class perfwatch's protocol retires)."""
+
+    def test_seeded_violations_found(self, bad_findings):
+        hits = at(bad_findings, "py-single-shot-bench",
+                  "single_shot_bench.py")
+        assert sorted(f.line for f in hits) == [12, 22]
+        assert all(f.severity == Severity.WARNING for f in hits)
+        assert all("timed_trials" in f.message for f in hits)
+
+    def test_clean_fixture_is_silent(self):
+        clean = os.path.join(CLEAN, "loadtest", "multi_trial_bench.py")
+        findings = analyze_paths(
+            AnalysisConfig(paths=[clean], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-single-shot-bench"] == []
+
+    def _findings(self, source, path="loadtest/qps.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-single-shot-bench"
+        ]
+
+    SINGLE_SHOT = (
+        "import time\n"
+        "def run(step, steps):\n"
+        "    t0 = time.perf_counter()\n"
+        "    for _ in range(steps):\n"
+        "        step()\n"
+        "    return time.perf_counter() - t0\n"
+    )
+
+    def test_pair_around_loop_fires(self):
+        (f,) = self._findings(self.SINGLE_SHOT)
+        assert f.line == 6
+
+    def test_only_bench_and_loadtest_trees_gate(self):
+        # The identical shape in library code is a latency probe, not
+        # a benchmark: telemetry wrappers time one event per call.
+        lib = self._findings(self.SINGLE_SHOT,
+                             path="kubeflow_tpu/obs/telemetry.py")
+        assert lib == []
+        # bench.py-style drivers gate by basename even at the root.
+        assert len(self._findings(self.SINGLE_SHOT, path="bench.py")) == 1
+
+    def test_trial_identifier_in_scope_exempts(self):
+        src = (
+            "import time\n"
+            "def run(step, steps, trials):\n"
+            "    out = []\n"
+            "    for _trial in range(trials):\n"
+            "        t0 = time.perf_counter()\n"
+            "        for _ in range(steps):\n"
+            "            step()\n"
+            "        out.append(time.perf_counter() - t0)\n"
+            "    return out\n"
+        )
+        assert self._findings(src) == []
+
+    def test_repetition_param_alone_exempts(self):
+        # `reps` in the signature marks the scope even when the pair
+        # itself is single-shot at this level (the caller repeats).
+        src = self.SINGLE_SHOT.replace("def run(step, steps):",
+                                       "def run(step, steps, reps):")
+        assert self._findings(src) == []
+
+    def test_no_loop_between_pair_is_clean(self):
+        src = (
+            "import time\n"
+            "def boot_latency(boot):\n"
+            "    t0 = time.perf_counter()\n"
+            "    boot()\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert self._findings(src) == []
+
+    def test_delta_inside_loop_is_clean(self):
+        # Per-iteration sampling is repetition by construction.
+        src = (
+            "import time\n"
+            "def run(step, steps):\n"
+            "    out = []\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(steps):\n"
+            "        step()\n"
+            "        out.append(time.perf_counter() - t0)\n"
+            "    return out\n"
+        )
+        assert self._findings(src) == []
+
+    def test_nested_scope_does_not_leak_exemption(self):
+        # A trial loop in a SIBLING function must not absolve this one.
+        src = (
+            "import time\n"
+            "def good(step, trials):\n"
+            "    for _trial in range(trials):\n"
+            "        step()\n"
+            "def bad(step, steps):\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(steps):\n"
+            "        step()\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 9
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "import time\n"
+            "def run(step, steps):\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(steps):\n"
+            "        step()\n"
+            "    # analysis: allow[py-single-shot-bench]\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        target = tmp_path / "bench_pragma.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-single-shot-bench"] == []
+        target.write_text(src.replace(
+            "    # analysis: allow[py-single-shot-bench]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len([
+            f for f in findings if f.rule == "py-single-shot-bench"
+        ]) == 1
+
+    def test_bench_and_loadtest_trees_stay_clean(self):
+        # The refactored drivers all route through perfwatch trials.
+        paths = [os.path.join(REPO, "bench.py"),
+                 os.path.join(REPO, "loadtest")]
+        findings = analyze_paths(
+            AnalysisConfig(paths=paths, check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-single-shot-bench"] == []
+
+
 class TestUnboundedMetricLabelsRule:
     """py-unbounded-metric-labels flags request-derived label values
     only: the platform's sanctioned vocabulary (namespace/name object

@@ -804,6 +804,20 @@ class TestLoadtestSmoke:
         overhead = summary["profiler_overhead"]
         assert overhead is not None
         assert overhead["frac_of_decode"] < 0.02
+        # PR-18 satellite: the gateway summary joins the perf
+        # trajectory as a schema-valid perfwatch record — per-stream
+        # decode rates as trials, MAD band, noise grade, provenance —
+        # so `serve[decode]` reads like any `decode[*]` bench section.
+        from kubeflow_tpu.obs.perfwatch import validate_record
+
+        record = summary["perfwatch_record"]
+        assert validate_record(record) == []
+        assert record["section"] == "serve[decode]"
+        assert record["unit"] == "tokens/sec/stream"
+        assert record["value"] > 0
+        assert record["band"]["n"] == len(record["trials"])
+        assert record["shed"] == summary["shed"]
+        assert record["provenance"]["platform"] == "cpu"
 
 
 class TestGatewayMetricsSchema:

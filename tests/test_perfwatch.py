@@ -1,0 +1,506 @@
+"""Perf observatory tests (PR 18): the noise-band math is
+hand-computable (nearest-rank + MAD, the PhaseDigest arithmetic), the
+verdict engine catches a planted 20% regression and forgives a
+within-band wobble, provenance mismatches read incomparable (never
+regressed), and both registries write atomically."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.obs import perfwatch
+
+
+def make_clock(step):
+    """Deterministic perf_counter stand-in: advances ``step`` per call."""
+    state = {"t": 0.0}
+
+    def clock():
+        state["t"] += step
+        return state["t"]
+
+    return clock
+
+
+def _noise(grade="quiet"):
+    return {"grade": grade}
+
+
+def _prov(**over):
+    prov = {
+        "git_rev": "abc123", "python": "3.11.0",
+        "jax": "0.4.37", "jaxlib": "0.4.36",
+        "platform": "cpu", "device": "TFRT_CPU", "env": {},
+    }
+    prov.update(over)
+    return prov
+
+
+def _record(section, values, *, grade="quiet", prov=None, unit="tok/s"):
+    return perfwatch.make_record(
+        section, f"{section}_metric", unit,
+        perfwatch.Measurement.from_values(values),
+        noise=_noise(grade), prov=prov or _prov(),
+    )
+
+
+class TestBandMath:
+    """Hand-computed nearest-rank medians and MAD bands."""
+
+    def test_nearest_rank_median_odd(self):
+        # n=5, q=0.5: rank ceil(2.5)=3 -> third sorted value.
+        assert perfwatch.nearest_rank([5, 1, 4, 2, 3], 0.5) == 3
+
+    def test_nearest_rank_median_even_is_lower_of_pair(self):
+        # n=4, q=0.5: rank 2 exactly -> second sorted value (the
+        # PhaseDigest convention; no interpolation anywhere).
+        assert perfwatch.nearest_rank([1, 2, 3, 10], 0.5) == 2
+
+    def test_nearest_rank_extremes(self):
+        values = [7, 3, 9, 1]
+        assert perfwatch.nearest_rank(values, 0.0) == 1
+        assert perfwatch.nearest_rank(values, 1.0) == 9
+        assert perfwatch.nearest_rank([], 0.5) == 0.0
+
+    def test_median_mad_by_hand(self):
+        # sorted [10,11,12] -> med 11; |dev| [1,0,1] -> mad 1.
+        med, mad = perfwatch.median_mad([10, 12, 11])
+        assert (med, mad) == (11, 1)
+
+    def test_noise_band_by_hand(self):
+        band = perfwatch.noise_band([10, 12, 11])
+        rel = perfwatch.MAD_SIGMA * 1 / 11
+        assert band["n"] == 3
+        assert band["median"] == 11
+        assert band["mad"] == 1
+        assert band["rel"] == round(rel, 6)
+        assert band["lo"] == round(11 * (1 - rel), 6)
+        assert band["hi"] == round(11 * (1 + rel), 6)
+
+    def test_identical_trials_have_zero_band(self):
+        band = perfwatch.noise_band([100.0, 100.0, 100.0])
+        assert band["mad"] == 0.0
+        assert band["rel"] == 0.0
+        assert band["lo"] == band["hi"] == 100.0
+
+    def test_floor_widens_a_too_tight_band(self):
+        band = perfwatch.noise_band([100.0, 100.0, 100.0], floor=0.05)
+        assert band["rel"] == 0.05
+        assert band["lo"] == 95.0
+        assert band["hi"] == 105.0
+
+    def test_band_floor_for_grades(self):
+        assert perfwatch.band_floor_for("quiet") == 0.02
+        assert perfwatch.band_floor_for("noisy") == 0.05
+        assert perfwatch.band_floor_for("loud") == 0.10
+        # No grade / unknown grade earns no benefit of the doubt.
+        assert perfwatch.band_floor_for(None) == 0.10
+        assert perfwatch.band_floor_for("bogus") == 0.10
+
+
+class TestMeasurement:
+    def test_outlier_trial_is_rejected(self):
+        # med 1.0, mad 0.01 -> threshold 4*1.4826*0.01 ~= 0.059; the
+        # 5.0 straggler (one GC pause) is dropped, the band survives.
+        meas = perfwatch.Measurement.from_values([1.0, 1.01, 0.99, 5.0])
+        assert meas.rejected == [5.0]
+        assert sorted(meas.values) == [0.99, 1.0, 1.01]
+        assert meas.median == 1.0
+
+    def test_below_four_trials_every_value_counts(self):
+        meas = perfwatch.Measurement.from_values([1.0, 1.0, 10.0])
+        assert meas.rejected == []
+        assert len(meas.values) == 3
+
+    def test_identical_trials_reject_nothing(self):
+        meas = perfwatch.Measurement.from_values([2.0] * 6)
+        assert meas.rejected == []
+        assert meas.median == 2.0
+
+    def test_empty_trials_raise(self):
+        with pytest.raises(ValueError):
+            perfwatch.Measurement.from_values([])
+
+    def test_as_rate_inverts_work_over_seconds(self):
+        meas = perfwatch.Measurement.from_values([2.0, 2.0, 2.5])
+        rate = meas.as_rate(10.0)
+        assert rate.median == 5.0
+        assert sorted(rate.values) == [4.0, 5.0, 5.0]
+
+    def test_to_dict_carries_rejections_and_phases(self):
+        meas = perfwatch.Measurement.from_values([1.0, 1.01, 0.99, 5.0])
+        meas.phases = {"dispatch": {"p50_s": 0.9, "p99_s": 1.0, "n": 4}}
+        doc = meas.to_dict()
+        assert doc["rejected_trials"] == [5.0]
+        assert doc["phases"]["dispatch"]["n"] == 4
+        clean = perfwatch.Measurement.from_values([1.0, 1.0])
+        assert "rejected_trials" not in clean.to_dict()
+        assert "phases" not in clean.to_dict()
+
+    def test_timed_trials_protocol(self):
+        calls = []
+        meas = perfwatch.timed_trials(
+            lambda: calls.append(1), trials=3, warmup=2,
+            clock=make_clock(0.5),
+        )
+        # 2 warmup (untimed) + 3 timed trials.
+        assert len(calls) == 5
+        assert meas.values == [0.5, 0.5, 0.5]
+        assert meas.median == 0.5
+
+
+class TestHostNoiseSentinel:
+    """Injected clock/sleep/loadavg make the grade deterministic."""
+
+    def _sentinel(self, *, step=1e-6, load=0.1, cpus=8, **kw):
+        return perfwatch.host_noise_sentinel(
+            spin_samples=10, sleeps=3, sleep_s=0.001,
+            clock=make_clock(step), sleep=lambda s: None,
+            loadavg=lambda: (load, 0.0, 0.0), cpu_count=lambda: cpus,
+            **kw,
+        )
+
+    def test_quiet_host(self):
+        doc = self._sentinel()
+        assert doc["grade"] == "quiet"
+        assert doc["sched_overshoot_p90_s"] == 0.0
+        assert doc["load_ratio"] == round(0.1 / 8, 4)
+
+    def test_busy_host_is_noisy(self):
+        assert self._sentinel(load=4.0)["grade"] == "noisy"
+
+    def test_saturated_host_is_loud(self):
+        assert self._sentinel(load=9.0)["grade"] == "loud"
+
+    def test_sleep_overshoot_alone_grades_loud(self):
+        # clock advances 25 ms per call: each 1 ms sleep reads as a
+        # 24 ms overshoot -> loud regardless of load.
+        assert self._sentinel(step=0.025, load=0.0)["grade"] == "loud"
+
+    def test_no_loadavg_platform_degrades_gracefully(self):
+        def no_loadavg():
+            raise OSError("not supported")
+
+        doc = perfwatch.host_noise_sentinel(
+            spin_samples=10, sleeps=3, sleep_s=0.001,
+            clock=make_clock(1e-6), sleep=lambda s: None,
+            loadavg=no_loadavg, cpu_count=lambda: 8,
+        )
+        assert doc["load1"] is None
+        assert doc["load_ratio"] is None
+        assert doc["grade"] == "quiet"
+
+
+class TestRecordsAndProvenance:
+    def test_make_record_validates(self):
+        record = _record("decode[b1]", [100.0, 101.0, 99.0])
+        assert perfwatch.validate_record(record) == []
+        assert record["value"] == 100.0
+        assert record["band"]["n"] == 3
+
+    def test_validate_catches_broken_records(self):
+        assert perfwatch.validate_record("nope") \
+            == ["record is not an object"]
+        record = _record("decode[b1]", [100.0])
+        record["schema"] = "wrong"
+        record.pop("trials")
+        record["noise"] = {"grade": "deafening"}
+        problems = " | ".join(perfwatch.validate_record(record))
+        assert "schema" in problems
+        assert "trials" in problems
+        assert "noise.grade" in problems
+
+    def test_extra_keys_are_fine(self):
+        record = _record("serve[decode]", [10.0, 11.0])
+        record["qps"] = 4.0
+        assert perfwatch.validate_record(record) == []
+
+    def test_provenance_env_filtering(self):
+        prov = perfwatch.provenance(env={
+            "KFT_DECODE_IMPL": "fused",
+            "KFT_BENCH_PRESET": "cpu-mini",
+            "HOME": "/root",
+        })
+        assert prov["env"] == {"KFT_DECODE_IMPL": "fused",
+                               "KFT_BENCH_PRESET": "cpu-mini"}
+        for key in ("git_rev", "python", "platform", "env"):
+            assert key in prov
+
+    def test_provenance_mismatch_fields(self):
+        a = _prov(env={"KFT_DECODE_IMPL": "fused"})
+        b = _prov(platform="tpu", env={"KFT_DECODE_IMPL": "unrolled"})
+        assert perfwatch.provenance_mismatches(a, b) \
+            == ["platform", "env:KFT_DECODE_IMPL"]
+        # The git rev never makes rounds incomparable: judging code
+        # changes is the whole point.
+        assert perfwatch.provenance_mismatches(
+            _prov(git_rev="aaa"), _prov(git_rev="bbb")
+        ) == []
+
+    def test_records_from_full_skips_error_entries(self):
+        doc = _record("train", [100.0])
+        doc["extra_metrics"] = [
+            _record("decode[b1]", [50.0]),
+            {"metric": "bench_extra_error", "error": "boom",
+             "section": "spec", "value": 0},
+            {"metric": "pre_protocol_extra", "value": 1.0},  # no section
+        ]
+        sections = [r["section"] for r in perfwatch.records_from_full(doc)]
+        assert sections == ["train", "decode[b1]"]
+
+
+class TestVerdicts:
+    """The gate contract: a planted 20% regression exits nonzero, a
+    within-band wobble does not, and a provenance mismatch is
+    incomparable — never regressed."""
+
+    def _anchor(self, value=100.0, band_rel=0.01, grade="quiet",
+                prov=None):
+        return {"value": value, "unit": "tok/s", "band_rel": band_rel,
+                "noise_grade": grade, "pinned_round": "r05",
+                "provenance": prov or _prov()}
+
+    def test_planted_20pct_regression_is_caught(self):
+        # tolerance = 0.01 (anchor band) + 0 (identical trials with no
+        # floor on the record band) + 0.02 (quiet floor) = 0.03;
+        # ratio 0.80 is far below 0.97.
+        record = _record("decode[b1]", [80.0, 80.0, 80.0])
+        verdict = perfwatch.classify(record, self._anchor())
+        assert verdict.status == perfwatch.REGRESSED
+        assert verdict.ratio == 0.8
+        assert perfwatch.verdict_exit_code([verdict]) == 1
+        assert "regressed" in verdict.render()
+
+    def test_within_band_wobble_passes(self):
+        record = _record("decode[b1]", [98.0, 98.0, 98.0])
+        verdict = perfwatch.classify(record, self._anchor())
+        assert verdict.status == perfwatch.WITHIN_NOISE
+        assert perfwatch.verdict_exit_code([verdict]) == 0
+
+    def test_real_improvement_reads_improved(self):
+        record = _record("decode[b1]", [110.0, 110.0, 110.0])
+        verdict = perfwatch.classify(record, self._anchor())
+        assert verdict.status == perfwatch.IMPROVED
+        assert perfwatch.verdict_exit_code([verdict]) == 0
+
+    def test_louder_round_widens_tolerance(self):
+        # Same 8% dip: regressed on a quiet host, within-noise once
+        # the measuring round is loud (floor 0.10).
+        record = _record("decode[b1]", [92.0, 92.0, 92.0])
+        assert perfwatch.classify(
+            record, self._anchor()
+        ).status == perfwatch.REGRESSED
+        loud = _record("decode[b1]", [92.0, 92.0, 92.0], grade="loud")
+        assert perfwatch.classify(
+            loud, self._anchor()
+        ).status == perfwatch.WITHIN_NOISE
+
+    def test_provenance_mismatch_is_incomparable_not_regressed(self):
+        # A 50% "regression" measured on a different platform is a
+        # different experiment, and must not gate.
+        record = _record("decode[b1]", [50.0, 50.0, 50.0],
+                         prov=_prov(platform="cpu"))
+        verdict = perfwatch.classify(
+            record, self._anchor(prov=_prov(platform="tpu",
+                                            device="TPU v5e"))
+        )
+        assert verdict.status == perfwatch.INCOMPARABLE
+        assert "platform" in verdict.notes
+        assert perfwatch.verdict_exit_code([verdict]) == 0
+
+    def test_env_knob_flip_is_incomparable(self):
+        record = _record(
+            "decode[b1]", [50.0] * 3,
+            prov=_prov(env={"KFT_DECODE_IMPL": "fused"}),
+        )
+        verdict = perfwatch.classify(record, self._anchor())
+        assert verdict.status == perfwatch.INCOMPARABLE
+        assert "env:KFT_DECODE_IMPL" in verdict.notes
+
+    def test_unanchored_section_is_new(self):
+        verdict = perfwatch.classify(_record("spec", [10.0]), None)
+        assert verdict.status == perfwatch.NEW_SECTION
+
+    def test_judge_flags_missing_sections(self):
+        anchors_doc = {"schema": perfwatch.ANCHORS_SCHEMA,
+                       "round": "r05",
+                       "anchors": {"decode[b1]": self._anchor(),
+                                   "spec": self._anchor(value=50.0)}}
+        verdicts = perfwatch.judge_records(
+            [_record("decode[b1]", [99.0] * 3)], anchors_doc
+        )
+        by_section = {v.section: v.status for v in verdicts}
+        assert by_section["decode[b1]"] == perfwatch.WITHIN_NOISE
+        assert by_section["spec"] == perfwatch.MISSING_SECTION
+        # A vanished section informs but does not gate.
+        assert perfwatch.verdict_exit_code(verdicts) == 0
+
+
+class TestAnchorsAndLedger:
+    def test_pin_round_trip(self, tmp_path):
+        path = str(tmp_path / "anchors.json")
+        records = [_record("decode[b1]", [100.0, 101.0, 99.0]),
+                   _record("spec", [50.0] * 3)]
+        doc = perfwatch.pin_anchors(records, "r06", path=path)
+        assert set(doc["anchors"]) == {"decode[b1]", "spec"}
+        loaded = perfwatch.load_anchors(path)
+        anchor = loaded["anchors"]["decode[b1]"]
+        assert loaded["round"] == "r06"
+        assert anchor["value"] == 100.0
+        assert anchor["pinned_round"] == "r06"
+        assert anchor["noise_grade"] == "quiet"
+        assert anchor["provenance"]["platform"] == "cpu"
+
+    def test_pin_missing_section_raises(self, tmp_path):
+        path = str(tmp_path / "anchors.json")
+        with pytest.raises(ValueError, match="spec"):
+            perfwatch.pin_anchors(
+                [_record("decode[b1]", [1.0])], "r06", path=path,
+                sections=["decode[b1]", "spec"],
+            )
+
+    def test_repin_keeps_untouched_sections(self, tmp_path):
+        path = str(tmp_path / "anchors.json")
+        perfwatch.pin_anchors([_record("spec", [50.0] * 3)], "r05",
+                              path=path)
+        perfwatch.pin_anchors([_record("decode[b1]", [100.0] * 3)],
+                              "r06", path=path)
+        doc = perfwatch.load_anchors(path)
+        assert doc["anchors"]["spec"]["pinned_round"] == "r05"
+        assert doc["anchors"]["decode[b1]"]["pinned_round"] == "r06"
+
+    def test_missing_registry_is_empty_not_fatal(self, tmp_path):
+        doc = perfwatch.load_anchors(str(tmp_path / "absent.json"))
+        assert doc["anchors"] == {}
+
+    def test_ledger_append_and_dedupe(self, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        entries = [perfwatch.ledger_entry("r05", "decode[b1]", 100.0),
+                   perfwatch.ledger_entry("r06", "decode[b1]", 101.0)]
+        assert perfwatch.append_ledger(path, entries) == 2
+        # Same (round, section, source) identity: a re-run is a no-op.
+        assert perfwatch.append_ledger(path, entries) == 0
+        assert len(perfwatch.read_ledger(path)) == 2
+
+    def test_ledger_append_is_atomic(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ledger.jsonl")
+        perfwatch.append_ledger(
+            path, [perfwatch.ledger_entry("r05", "spec", 50.0)]
+        )
+        with open(path) as fh:
+            before = fh.read()
+
+        def torn_replace(src, dst):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(perfwatch.os, "replace", torn_replace)
+        with pytest.raises(OSError):
+            perfwatch.append_ledger(
+                path, [perfwatch.ledger_entry("r06", "spec", 51.0)]
+            )
+        # The commit point is the rename: a failed append leaves the
+        # ledger byte-identical, never half-written.
+        with open(path) as fh:
+            assert fh.read() == before
+
+    def test_read_ledger_skips_torn_lines(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        path.write_text(
+            json.dumps({"round": "r05", "section": "spec",
+                        "value": 50.0}) + "\n"
+            + '{"round": "r06", "sec\n'
+        )
+        entries = perfwatch.read_ledger(str(path))
+        assert len(entries) == 1
+
+    def test_entries_from_driver_round(self):
+        doc = {"parsed": {"value": 331.6, "unit": "img/s",
+                          "vs_baseline": 1.01,
+                          "sections": {"decode[b1]": {"v": 1345.0,
+                                                      "vs": 0.99},
+                                       "broken": {"v": None}}}}
+        entries = perfwatch.entries_from_driver_round(doc, "r05",
+                                                      source="BENCH")
+        assert [(e["round"], e["section"], e["value"])
+                for e in entries] \
+            == [("r05", "resnet", 331.6), ("r05", "decode[b1]", 1345.0)]
+
+    def test_render_trend_table(self):
+        entries = [
+            perfwatch.ledger_entry("r05", "decode[b1]", 1345.0, vs=0.99),
+            perfwatch.ledger_entry("r06", "decode[b1]", 1400.0),
+            perfwatch.ledger_entry("r06", "spec", 50.0),
+        ]
+        table = perfwatch.render_trend(entries)
+        lines = table.splitlines()
+        assert "r05" in lines[0] and "r06" in lines[0]
+        assert any("1345 (0.99x)" in line for line in lines)
+        # A section absent from a round renders as '-'.
+        spec_row = next(line for line in lines if "spec" in line)
+        assert "-" in spec_row
+        assert perfwatch.render_trend([]) == "(empty trajectory ledger)"
+
+
+class TestCli:
+    """The pin -> verdict -> ingest -> report loop through main() —
+    exactly what perf_gate.sh drives."""
+
+    def _full_doc(self, values):
+        doc = _record("train", [1000.0] * 3)
+        doc["extra_metrics"] = [_record("decode[b1]", values),
+                                _record("spec", [50.0] * 3)]
+        return doc
+
+    def test_gate_loop(self, tmp_path, capsys):
+        record = tmp_path / "full.json"
+        anchors = str(tmp_path / "anchors.json")
+        ledger = str(tmp_path / "ledger.jsonl")
+        record.write_text(json.dumps(self._full_doc([100.0] * 3)))
+
+        rc = perfwatch.main(["pin", "--record", str(record),
+                             "--round", "r06", "--anchors", anchors])
+        assert rc == 0
+        assert "pinned 3 anchor(s)" in capsys.readouterr().out
+
+        # Same record vs its own pins: everything within noise, exit 0.
+        rc = perfwatch.main(["verdict", "--record", str(record),
+                             "--anchors", anchors])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "3 within-noise" in out
+
+        # A 20% decode regression flips the exit code.
+        record.write_text(json.dumps(self._full_doc([80.0] * 3)))
+        rc = perfwatch.main(["verdict", "--record", str(record),
+                             "--anchors", anchors, "--json"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        verdicts = {v["section"]: v["status"] for v in json.loads(out)}
+        assert verdicts["decode[b1]"] == perfwatch.REGRESSED
+        assert verdicts["train"] == perfwatch.WITHIN_NOISE
+
+        rc = perfwatch.main(["ingest", "--record", str(record),
+                             "--round", "r06", "--ledger", ledger,
+                             "--source", "full"])
+        assert rc == 0
+        assert "appended 3" in capsys.readouterr().out
+
+        rc = perfwatch.main(["report", "--ledger", ledger])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "decode[b1]" in out and "r06" in out
+
+    def test_backfill_round_id_from_filename(self, tmp_path, capsys):
+        assert perfwatch._round_id_for("BENCH_r04.json") == "r04"
+        driver = tmp_path / "BENCH_r04.json"
+        driver.write_text(json.dumps(
+            {"parsed": {"value": 331.6, "unit": "img/s"}}
+        ))
+        ledger = str(tmp_path / "ledger.jsonl")
+        rc = perfwatch.main(["backfill", str(driver),
+                             "--ledger", ledger])
+        assert rc == 0
+        (entry,) = perfwatch.read_ledger(ledger)
+        assert entry["round"] == "r04"
+        assert entry["source"] == "BENCH_r04.json"
