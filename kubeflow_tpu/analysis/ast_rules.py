@@ -133,6 +133,21 @@
   ``Measurement.from_values``) or name the repetition loop for what it
   is; a deliberate one-shot escapes with
   ``# analysis: allow[py-single-shot-bench]``.
+- ``py-shared-rng-stream`` (warning): a ``random.Random`` attribute
+  created in a class ``__init__`` that two or more *fluent builder
+  methods* (methods that ``return self``) draw from. A fluent method
+  chain is a composition surface: when each ``.traffic(...)``
+  /``.capacity(...)`` call jitters its instants off one shared stream,
+  the draws interleave in call order, so adding or reordering one
+  track silently shifts every other track's timeline — the
+  replay-digest poison the scenario-world DSL exists to prevent.
+  Derive one private stream per track instead
+  (``kubeflow_tpu.chaos.world.derive_stream`` hashes seed + track
+  name). Non-fluent query methods sharing a draw stream (the
+  ``FaultSchedule.fault_for``/``next_watch_action`` op-indexed pair)
+  are not composition surfaces and are not flagged; a deliberately
+  shared stream escapes with
+  ``# analysis: allow[py-shared-rng-stream]``.
 """
 
 from __future__ import annotations
@@ -739,6 +754,94 @@ def _check_unbounded_deques(cls: ast.ClassDef, aliases: dict[str, str],
         ))
 
 
+# --- py-shared-rng-stream ---------------------------------------------------
+# The method names that consume entropy from a random.Random. Drawing
+# is what couples two tracks to one stream; merely passing the Random
+# around or seeding it does not.
+_RNG_DRAW_METHODS = frozenset((
+    "random", "uniform", "randint", "randrange", "getrandbits",
+    "choice", "choices", "sample", "shuffle", "gauss", "normalvariate",
+    "lognormvariate", "expovariate", "triangular", "betavariate",
+    "vonmisesvariate", "paretovariate", "weibullvariate",
+))
+
+
+def _returns_self(method: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    """True when the method is fluent: some ``return self``."""
+    return any(
+        isinstance(node, ast.Return)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        for node in ast.walk(method)
+    )
+
+
+def _check_shared_rng_stream(cls: ast.ClassDef, aliases: dict[str, str],
+                             path: str, out: list[Finding]) -> None:
+    """Flag a ``random.Random`` built in ``__init__`` that two or more
+    distinct fluent (``return self``) methods draw from. Fluent methods
+    are the composition surface of a builder: interleaved draws on one
+    stream make every track's jitter depend on which *other* tracks
+    were composed, and in what order. One drawer is a private stream;
+    non-fluent readers are queries, not composition."""
+    init = next(
+        (n for n in cls.body
+         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+         and n.name == "__init__"),
+        None,
+    )
+    if init is None:
+        return
+    # attr -> lineno of ``self.<attr> = random.Random(...)``.
+    candidates: dict[str, int] = {}
+    for node in _scope_nodes(init):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        if _dotted(value.func, aliases) not in ("random.Random", "Random"):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for target in targets:
+            attr = _self_attr_name(target)
+            if attr is not None:
+                candidates[attr] = node.lineno
+    if not candidates:
+        return
+    drawers: dict[str, set[str]] = {attr: set() for attr in candidates}
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if method.name == "__init__" or not _returns_self(method):
+            continue
+        for node in ast.walk(method):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RNG_DRAW_METHODS):
+                attr = _self_attr_name(node.func.value)
+                if attr in drawers:
+                    drawers[attr].add(method.name)
+    for attr, methods in sorted(drawers.items()):
+        if len(methods) < 2:
+            continue
+        shared = ", ".join(sorted(methods))
+        out.append(Finding(
+            "py-shared-rng-stream", Severity.WARNING, path,
+            candidates[attr],
+            f"self.{attr} is one random.Random drawn from by "
+            f"{len(methods)} fluent builder methods of {cls.name} "
+            f"({shared}): their draws interleave in call order, so "
+            "composing or reordering one track shifts every other "
+            "track's instants and breaks byte-identical replay — "
+            "derive a private per-track stream instead "
+            "(kubeflow_tpu.chaos.world.derive_stream), or annotate a "
+            "deliberately shared stream with "
+            "# analysis: allow[py-shared-rng-stream]",
+        ))
+
+
 # --- py-unbounded-actuation -------------------------------------------------
 # Write verbs that count as actuation when called on an api/client
 # handle (the receiver's dotted chain mentions "api" or "client" — a
@@ -1293,6 +1396,7 @@ def analyze_python_source(source: str, path: str,
             _check_nonatomic_writes(node, aliases, path, out)
         elif isinstance(node, ast.ClassDef):
             _check_unbounded_deques(node, aliases, path, out)
+            _check_shared_rng_stream(node, aliases, path, out)
             _check_list_in_reconcile(node, path, out)
         elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
             _check_retry_loop(node, aliases, path, out)

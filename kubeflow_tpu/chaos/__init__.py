@@ -31,6 +31,16 @@ from kubeflow_tpu.chaos.schedule import (  # noqa: F401
     Fault,
     FaultSchedule,
 )
+from kubeflow_tpu.chaos.world import (  # noqa: F401
+    Arrival,
+    Clock,
+    DomainEvent,
+    ScenarioWorld,
+    TenantMix,
+    TrafficPhase,
+    WorldBuilder,
+    derive_stream,
+)
 
 # Data-plane checkpoint faults resolve lazily: chaos.ckpt reaches into
 # models.checkpoint (jax + the training stack), which the control-plane

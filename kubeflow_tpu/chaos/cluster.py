@@ -61,11 +61,21 @@ class StatefulSetPodSimulator:
 
     def __init__(self, api, node_prefix: str = "tpu-node",
                  capacity_chips: int | None = None,
-                 recreate_on_template_change: bool = False):
+                 recreate_on_template_change: bool = False,
+                 gc_orphans: bool = False):
         self.api = api
         self.node_prefix = node_prefix
         self.capacity_chips = capacity_chips
         self.recreate_on_template_change = recreate_on_template_change
+        # Fleet-scale opt-in: prune pods whose owning StatefulSet is
+        # gone (the garbage collector's role). Off by default — legacy
+        # chaos tests pin that a bare pod outlives its StatefulSet.
+        self.gc_orphans = gc_orphans
+        # Correlated-domain weather (chaos.world): nodes whose domain
+        # is in ``lost_domains`` (per ``domain_of``) take no bindings;
+        # their pods are created/kept Pending until the rack repairs.
+        self.domain_of = None
+        self.lost_domains: set[int] = set()
         self.created_total = 0
         self.deleted_total = 0
         self.pending_total = 0
@@ -194,28 +204,54 @@ class StatefulSetPodSimulator:
             pod["metadata"].get("namespace", "default"),
         )
 
+    def _node_lost(self, sts_name: str, ordinal: int) -> bool:
+        if not self.lost_domains or self.domain_of is None:
+            return False
+        return (self.domain_of(self.node_name(sts_name, ordinal))
+                in self.lost_domains)
+
     def step(self) -> int:
         """One control-loop pass: create missing pods (Pending when the
-        TPU pool is exhausted), bind Pending pods capacity now covers,
-        prune pods whose ordinal is past the current replica count, and
-        (opt-in) recycle pods built from a stale template. Returns the
-        number of changes made (0 = the pod world is settled)."""
+        TPU pool is exhausted or the node's failure domain is lost),
+        bind Pending pods capacity now covers, prune pods whose ordinal
+        is past the current replica count, and (opt-in) recycle pods
+        built from a stale template / GC pods whose StatefulSet is
+        gone. Returns the number of changes made (0 = settled).
+
+        One ``Pod`` list per pass, indexed by ``(namespace, name
+        prefix) -> {ordinal: pod}`` — the fleet-scale soak rides this
+        tick at 10k-CR cardinality, where the per-StatefulSet re-list
+        it replaces was O(all pods) per StatefulSet."""
         changed = 0
-        used = self._used_chips()
-        for sts in self.api.list("apps/v1", "StatefulSet"):
+        pods = list(self.api.list("v1", "Pod"))
+        used = sum(self.pod_chips(p) for p in pods
+                   if self._is_bound(p))
+        by_owner: dict[tuple[str, str], dict[int, dict]] = {}
+        for pod in pods:
+            pod_ns = pod["metadata"].get("namespace", "default")
+            prefix, _, suffix = pod["metadata"]["name"].rpartition("-")
+            if suffix.isdigit():
+                by_owner.setdefault((pod_ns, prefix), {})[
+                    int(suffix)] = pod
+        statefulsets = list(self.api.list("apps/v1", "StatefulSet"))
+        live_sts = {(s["metadata"].get("namespace", "default"),
+                     s["metadata"]["name"]) for s in statefulsets}
+        for sts in statefulsets:
             meta = sts["metadata"]
             ns = meta.get("namespace", "default")
             replicas = (sts.get("spec") or {}).get("replicas")
             replicas = 1 if replicas is None else int(replicas)
             tpl_hash = self._template_hash(sts)
+            owned = by_owner.get((ns, meta["name"]), {})
             for ordinal in range(replicas):
                 name = f"{meta['name']}-{ordinal}"
-                try:
-                    pod = self.api.get("v1", "Pod", name, ns)
-                except NotFound:
+                pod = owned.get(ordinal)
+                if pod is None:
                     fresh = self._pod_for(sts, ordinal, bound=True)
                     chips = self.pod_chips(fresh)
-                    if self._fits(chips, used):
+                    if (self._fits(chips, used)
+                            and not self._node_lost(meta["name"],
+                                                    ordinal)):
                         self.api.create(fresh)
                         used += chips
                     else:
@@ -247,30 +283,45 @@ class StatefulSetPodSimulator:
                     pod["metadata"].get("deletionTimestamp")
                 ):
                     chips = self.pod_chips(pod)
-                    if self._fits(chips, used):
+                    if (self._fits(chips, used)
+                            and not self._node_lost(meta["name"],
+                                                    ordinal)):
                         self._bind(sts, ordinal, pod)
                         used += chips
                         self.bound_total += 1
                         changed += 1
             # Scale-down: the statefulset controller removes the
             # highest ordinals first; order is irrelevant to the fake.
-            for pod in self.api.list(
-                "v1", "Pod", namespace=ns,
-                label_selector=None,
-            ):
-                pod_name = pod["metadata"]["name"]
-                prefix, _, suffix = pod_name.rpartition("-")
-                if prefix != meta["name"] or not suffix.isdigit():
+            for ordinal in sorted(owned):
+                if ordinal < replicas:
                     continue
-                if int(suffix) >= replicas:
-                    try:
-                        self.api.delete("v1", "Pod", pod_name, ns)
-                        if self._is_bound(pod):
-                            used -= self.pod_chips(pod)
-                        self.deleted_total += 1
-                        changed += 1
-                    except NotFound:
-                        pass
+                pod = owned[ordinal]
+                try:
+                    self.api.delete("v1", "Pod",
+                                    pod["metadata"]["name"], ns)
+                    if self._is_bound(pod):
+                        used -= self.pod_chips(pod)
+                    self.deleted_total += 1
+                    changed += 1
+                except NotFound:
+                    pass
+        if self.gc_orphans:
+            for pod in pods:
+                refs = (pod["metadata"].get("ownerReferences")) or []
+                owner = next((r for r in refs
+                              if r.get("kind") == "StatefulSet"), None)
+                if owner is None:
+                    continue
+                pod_ns = pod["metadata"].get("namespace", "default")
+                if (pod_ns, owner.get("name")) in live_sts:
+                    continue
+                try:
+                    self.api.delete("v1", "Pod",
+                                    pod["metadata"]["name"], pod_ns)
+                    self.deleted_total += 1
+                    changed += 1
+                except NotFound:
+                    pass
         return changed
 
 

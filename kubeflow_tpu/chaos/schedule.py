@@ -176,6 +176,43 @@ class FaultSchedule:
         self._watch_rates.clear()
         return self
 
+    def clear_api_faults(self, at_op: int | None = None) -> "FaultSchedule":
+        """Repair the API-fault plane, symmetric with
+        :meth:`clear_watch_faults`: with no argument every window is
+        dropped; with ``at_op`` the repair lands at that op — windows
+        still open are closed there, windows not yet started are
+        dropped, and fully-past windows are kept so the storm's
+        history stays queryable. Watch damage and the capacity
+        timeline are untouched (per-track repair composes)."""
+        if at_op is None:
+            self._windows.clear()
+            return self
+        kept = []
+        for w in self._windows:
+            if w.start >= at_op:
+                continue
+            if w.end is None or w.end > at_op:
+                w = _Window(
+                    kind=w.kind, start=w.start, end=at_op, rate=w.rate,
+                    verbs=w.verbs, kinds=w.kinds, status=w.status,
+                    retry_after=w.retry_after, latency_s=w.latency_s,
+                )
+            kept.append(w)
+        self._windows[:] = kept
+        return self
+
+    def restore_capacity(self, at_s: float,
+                         jitter_s: float = 0.0) -> "FaultSchedule":
+        """Capacity-track repair, symmetric with the fault-plane
+        clears: re-emit the pool's baseline — the FIRST scripted
+        capacity, i.e. the pre-weather pool (None when nothing was
+        scripted: unbounded) — at ``at_s``. Draws jitter exactly like
+        :meth:`capacity`, from the capacity plane's own generator, so
+        a storm-then-repair arc composes without shifting any other
+        track's instants."""
+        baseline = self._capacity[0].chips if self._capacity else None
+        return self.capacity(at_s, baseline, jitter_s=jitter_s)
+
     def capacity(self, at_s: float, chips: int | None,
                  jitter_s: float = 0.0) -> "FaultSchedule":
         """Add a capacity event: at ``at_s`` (± a uniform draw within

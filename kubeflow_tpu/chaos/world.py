@@ -1,0 +1,490 @@
+"""Composable scenario worlds: one seeded timeline DSL for every
+replay-deterministic harness.
+
+The three scenario harnesses (game day, contention, soak) each grew
+their own scripting idiom — tick-fraction phases, ad-hoc
+``FaultSchedule`` capacity chains, a harness-global ``random.Random``
+for churn. Composing them (ROADMAP item 5: autopilot actuation UNDER
+10k churn, not next to it) needs one builder where adding a track can
+never shift another track's instants. That property is the whole
+design:
+
+- **Typed tracks.** ``traffic`` (request weather phases), ``capacity``
+  (chip-pool weather), ``api`` (op-indexed fault windows on a probe
+  plane), ``tenants`` (arrival/churn mixes + scripted arrivals), and
+  ``domains`` (correlated failure: racks). A harness reads its script
+  from the built :class:`ScenarioWorld` instead of hardcoding it.
+- **Per-track derived RNG streams.** Every track that draws randomness
+  draws from its own generator, derived as a pure function of
+  ``(seed, track name)`` via :func:`derive_stream` — the same
+  construction ``FaultSchedule`` already uses to keep capacity jitter
+  independent of fault-window rate draws. Composing a new track onto a
+  world never consumes another track's draws, so every existing
+  instant stays put (``tests/test_world.py`` pins this).
+- **Correlated failure domains.** ``domains(n)`` assigns every
+  simulator node to a rack by ordinal; a ``domain_loss`` event
+  taints + deletes every worker bound in that domain in one instant —
+  multi-host slices spanning the rack partial-fail simultaneously —
+  and subtracts the rack's chips from :meth:`ScenarioWorld.capacity_at`
+  until the matching ``domain_repair``. The world duck-types the
+  ``capacity_at`` surface, so :meth:`PreemptionInjector.apply_capacity`
+  and the slice-pool scheduler read base weather and rack losses as
+  one merged timeline.
+
+One world instance drives one run: replays build a fresh world from
+the same ``(seed, parameters)`` and every ``replay_digest`` gate built
+on top stays byte-identical (and Pack C lint-clean — no wall clocks,
+no unseeded RNG, no salted hashes anywhere on the digest path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass
+
+from kubeflow_tpu.chaos.schedule import FaultSchedule
+
+
+class Clock:
+    """The injected scenario clock every component of a world run
+    shares (the game-day determinism constraint: no component may see
+    wall time)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> float:
+        self.t += s
+        return self.t
+
+
+def derive_stream(seed: int, track: str) -> random.Random:
+    """A track's private generator: a pure function of (seed, track
+    name), so two tracks of one world — or the same track across
+    replays — can never interleave draws. sha256 keys the derivation
+    (stable across processes; the salted builtin ``hash`` would not
+    be)."""
+    digest = hashlib.sha256(f"{int(seed)}:{track}".encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+@dataclass(frozen=True)
+class TrafficPhase:
+    """One request-weather phase, bounded by tick fractions so the
+    same arc compresses with the run length. Knob fields are the
+    autopilot-facing signals a harness applies to its serving stub:
+    latency observations (``ttft_s``/``itl_s``, ``observations`` per
+    tick), slot pressure (``occupancy`` "full"/"idle" +
+    ``queue_depth``), and the adversarial ``prompt_len`` (prompt-length
+    abuse against chunked-prefill admission)."""
+
+    name: str
+    start: float
+    end: float
+    ttft_s: float | None = None
+    itl_s: float | None = None
+    observations: int = 10
+    occupancy: str | None = None
+    queue_depth: int = 0
+    prompt_len: int | None = None
+
+
+@dataclass(frozen=True)
+class TenantMix:
+    """One tenant population: the namespaces it lands in, its
+    (topology, chips) and priority distributions, and op-kind weights
+    for seeded churn. The harness draws from the world's per-track
+    stream; the mix is only the declarative shape."""
+
+    name: str
+    namespaces: tuple[str, ...]
+    topologies: tuple[tuple[str, int], ...]
+    priorities: tuple[int, ...]
+    weights: tuple[tuple[str, float], ...] = ()
+
+    def thresholds(self) -> tuple[tuple[str, float], ...]:
+        """Cumulative roll thresholds in declaration order (the churn
+        idiom: one uniform draw selects the op kind)."""
+        acc, out = 0.0, []
+        for op, weight in self.weights:
+            acc += weight
+            out.append((op, acc))
+        return tuple(out)
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scripted tenant event at a tick fraction: a named CR
+    arriving (``notebook`` / ``inference``) or a first-touch
+    (``touch``) resurrecting a suspended slice."""
+
+    at: float
+    kind: str
+    namespace: str
+    name: str
+    topology: str | None = None
+    priority: int = 0
+
+
+@dataclass(frozen=True)
+class DomainEvent:
+    """One correlated-domain instant (jitter already applied):
+    ``loss`` removes ``chips`` from the schedulable pool and kills
+    every worker bound in the domain; ``repair`` returns them."""
+
+    at_s: float
+    kind: str
+    domain: int
+    chips: int
+
+
+class WorldBuilder:
+    """Fluent track-by-track scenario author. All instants are tick
+    fractions of ``ticks * tick_s`` scenario seconds, so one timeline
+    compresses or stretches without re-authoring."""
+
+    def __init__(self, seed: int, ticks: int, tick_s: float = 30.0):
+        self.seed = int(seed)
+        self.ticks = int(ticks)
+        self.tick_s = float(tick_s)
+        # Track declarations, bounded by the scenario author's script.
+        # analysis: allow[py-unbounded-deque]
+        self._traffic: list[TrafficPhase] = []
+        # analysis: allow[py-unbounded-deque]
+        self._capacity: list[tuple[float, int | None, float, bool]] = []
+        # analysis: allow[py-unbounded-deque]
+        self._api: list[tuple[str, float, float, int]] = []
+        self._tenants: dict[str, TenantMix] = {}
+        # analysis: allow[py-unbounded-deque]
+        self._arrivals: list[Arrival] = []
+        self._domains = 0
+        # analysis: allow[py-unbounded-deque]
+        self._domain_events: list[tuple[float, str, int, int, float]] = []
+
+    # ---- traffic track ---------------------------------------------------
+    def traffic(self, name: str, start: float, end: float, *,
+                ttft_s: float | None = None, itl_s: float | None = None,
+                observations: int = 10, occupancy: str | None = None,
+                queue_depth: int = 0,
+                prompt_len: int | None = None) -> "WorldBuilder":
+        self._traffic.append(TrafficPhase(
+            name=name, start=float(start), end=float(end),
+            ttft_s=ttft_s, itl_s=itl_s, observations=int(observations),
+            occupancy=occupancy, queue_depth=int(queue_depth),
+            prompt_len=prompt_len,
+        ))
+        return self
+
+    # ---- capacity track --------------------------------------------------
+    def capacity(self, at: float, chips: int | None,
+                 jitter_s: float = 0.0) -> "WorldBuilder":
+        """Chip-pool weather at tick fraction ``at``. Jitter draws come
+        from the FaultSchedule's own capacity generator at build time,
+        in declaration order — the stream the pre-world harnesses
+        already used, so their pinned digests survive the refactor."""
+        self._capacity.append((float(at), chips, float(jitter_s), False))
+        return self
+
+    def capacity_restore(self, at: float,
+                         jitter_s: float = 0.0) -> "WorldBuilder":
+        """The symmetric repair arc: re-emit the pool's baseline (the
+        first scripted capacity) at ``at`` via
+        :meth:`FaultSchedule.restore_capacity`."""
+        self._capacity.append((float(at), None, float(jitter_s), True))
+        return self
+
+    # ---- API-fault track (probe plane) -----------------------------------
+    def api_blackout(self, start: float, end: float,
+                     ops_per_tick: int) -> "WorldBuilder":
+        """An apiserver blackout over tick fractions, mapped onto op
+        counts through a fixed probe-op budget per tick (the game-day
+        availability-plane construction). Windows land on the world's
+        ``probe_schedule`` so controller-plane traffic never parks on
+        real-time backoff."""
+        self._api.append(("blackout", float(start), float(end),
+                          int(ops_per_tick)))
+        return self
+
+    # ---- tenant track ----------------------------------------------------
+    def tenants(self, name: str, *, namespaces, topologies, priorities,
+                weights=None) -> "WorldBuilder":
+        self._tenants[name] = TenantMix(
+            name=name,
+            namespaces=tuple(namespaces),
+            topologies=tuple((t, int(c)) for t, c in topologies),
+            priorities=tuple(int(p) for p in priorities),
+            weights=tuple((op, float(w))
+                          for op, w in (weights or {}).items()),
+        )
+        return self
+
+    def arrival(self, at: float, kind: str, namespace: str, name: str,
+                topology: str | None = None,
+                priority: int = 0) -> "WorldBuilder":
+        self._arrivals.append(Arrival(
+            at=float(at), kind=kind, namespace=namespace, name=name,
+            topology=topology, priority=int(priority),
+        ))
+        return self
+
+    # ---- correlated-domain track -----------------------------------------
+    def domains(self, count: int) -> "WorldBuilder":
+        """Rack assignment for the pod simulator's nodes: ordinal
+        modulo ``count`` (one worker per rack per slice, the layout
+        where a rack loss partial-fails every multi-host slice)."""
+        self._domains = max(0, int(count))
+        return self
+
+    def domain_loss(self, at: float, domain: int, chips: int,
+                    jitter_s: float = 0.0) -> "WorldBuilder":
+        self._domain_events.append(
+            (float(at), "loss", int(domain), int(chips),
+             float(jitter_s)))
+        return self
+
+    def domain_repair(self, at: float, domain: int,
+                      jitter_s: float = 0.0) -> "WorldBuilder":
+        self._domain_events.append(
+            (float(at), "repair", int(domain), 0, float(jitter_s)))
+        return self
+
+    # ---- materialise -----------------------------------------------------
+    def build(self) -> "ScenarioWorld":
+        duration_s = self.ticks * self.tick_s
+        schedule = FaultSchedule(seed=self.seed)
+        for at, chips, jitter_s, restore in self._capacity:
+            if restore:
+                schedule.restore_capacity(at * duration_s,
+                                          jitter_s=jitter_s)
+            else:
+                schedule.capacity(at * duration_s, chips,
+                                  jitter_s=jitter_s)
+        probe_schedule = FaultSchedule(
+            seed=derive_stream(self.seed, "api-faults").randrange(2**31))
+        api_instants = []
+        for kind, start, end, ops_per_tick in self._api:
+            b0 = int(start * self.ticks) * ops_per_tick
+            b1 = int(end * self.ticks) * ops_per_tick
+            probe_schedule.blackout(b0, b1)
+            api_instants.append([kind, b0, b1])
+        domain_rng = derive_stream(self.seed, "domains")
+        events = []
+        for at, kind, domain, chips, jitter_s in self._domain_events:
+            jitter = (domain_rng.uniform(-jitter_s, jitter_s)
+                      if jitter_s else 0.0)
+            events.append(DomainEvent(
+                at_s=max(0.0, at * duration_s + jitter),
+                kind=kind, domain=domain, chips=chips,
+            ))
+        events.sort(key=lambda e: e.at_s)
+        return ScenarioWorld(
+            seed=self.seed, ticks=self.ticks, tick_s=self.tick_s,
+            schedule=schedule, probe_schedule=probe_schedule,
+            traffic=tuple(self._traffic),
+            tenant_mixes=dict(self._tenants),
+            arrivals=tuple(self._arrivals),
+            domains=self._domains,
+            domain_events=tuple(events),
+            api_instants=api_instants,
+        )
+
+
+class ScenarioWorld:
+    """One built timeline: the declarative script a harness replays.
+
+    Runtime state (per-track streams, fired domain events, taints to
+    undo) lives here too — one world instance drives ONE run; replays
+    construct a fresh world from the same (seed, parameters)."""
+
+    def __init__(self, *, seed, ticks, tick_s, schedule, probe_schedule,
+                 traffic, tenant_mixes, arrivals, domains,
+                 domain_events, api_instants):
+        self.seed = seed
+        self.ticks = ticks
+        self.tick_s = tick_s
+        self.duration_s = ticks * tick_s
+        self.schedule = schedule
+        self.probe_schedule = probe_schedule
+        self.traffic = traffic
+        self.tenant_mixes = tenant_mixes
+        self.arrivals = arrivals
+        self.domains = domains
+        self.domain_events = domain_events
+        self._api_instants = api_instants
+        self._streams: dict[str, random.Random] = {}
+        self._domain_cursor = 0
+        self._lost: dict[int, int] = {}
+        self._domain_tainted: dict[int, set[str]] = {}
+        # Fired-event record the composed scenarios digest; bounded by
+        # the scripted event count.  # analysis: allow[py-unbounded-deque]
+        self.domain_log: list[dict] = []
+
+    # ---- streams ---------------------------------------------------------
+    def stream(self, track: str) -> random.Random:
+        """The track's private generator (created on first use; stable
+        per (seed, track))."""
+        rng = self._streams.get(track)
+        if rng is None:
+            rng = derive_stream(self.seed, track)
+            self._streams[track] = rng
+        return rng
+
+    # ---- tick geometry ---------------------------------------------------
+    def tick_of(self, fraction: float) -> int:
+        return int(fraction * self.ticks)
+
+    def traffic_active(self, tick: int) -> tuple[TrafficPhase, ...]:
+        return tuple(
+            p for p in self.traffic
+            if self.tick_of(p.start) <= tick < self.tick_of(p.end)
+        )
+
+    def arrivals_at(self, tick: int) -> tuple[Arrival, ...]:
+        return tuple(a for a in self.arrivals
+                     if self.tick_of(a.at) == tick)
+
+    # ---- merged capacity view --------------------------------------------
+    def capacity_at(self, now_s: float) -> int | None:
+        """Base capacity weather minus every currently-lost domain's
+        chips — the one pool view schedulers, injectors and promotion
+        gates share (duck-types ``FaultSchedule.capacity_at``)."""
+        chips = self.schedule.capacity_at(now_s)
+        if chips is None or not self._lost:
+            return chips
+        return max(0, chips - sum(self._lost.values()))
+
+    def lost_domains(self) -> frozenset[int]:
+        return frozenset(self._lost)
+
+    def domain_of(self, node_name: str) -> int | None:
+        """Rack assignment by trailing node ordinal (simulator nodes
+        are ``<prefix>-<sts>-<ordinal>``: worker k of every slice
+        shares rack ``k % domains``)."""
+        if not self.domains:
+            return None
+        _prefix, _, suffix = node_name.rpartition("-")
+        if not suffix.isdigit():
+            return None
+        return int(suffix) % self.domains
+
+    def slice_capacity(self, chips: int, hosts: int) -> int:
+        """One slice's reachable chips under the current domain
+        weather: workers on lost racks are unreachable even when the
+        fleet pool still has headroom — the per-slice capacity view an
+        elastic promotion gate should consult."""
+        if not self._lost or not self.domains or hosts <= 0:
+            return chips
+        per_host = chips // max(1, hosts)
+        lost_hosts = sum(
+            1 for ordinal in range(hosts)
+            if ordinal % self.domains in self._lost
+        )
+        return max(0, chips - per_host * lost_hosts)
+
+    # ---- domain applier --------------------------------------------------
+    def apply_domains(self, now_s: float, injector, sim) -> list[dict]:
+        """Fire every domain event due by ``now_s``: a loss taints +
+        deletes every bound worker in the rack in one instant (the
+        correlated failure) and starts subtracting its chips from
+        :meth:`capacity_at`; a repair clears this world's taints and
+        stops the subtraction. The simulator is marked so nothing
+        rebinds onto a lost rack until repair. Fired events land in
+        ``domain_log`` (replay-deterministic: scripted instants,
+        sorted victims)."""
+        fired = []
+        while self._domain_cursor < len(self.domain_events):
+            event = self.domain_events[self._domain_cursor]
+            if event.at_s > now_s:
+                break
+            self._domain_cursor += 1
+            if event.kind == "loss":
+                self._lost[event.domain] = event.chips
+                sim.lost_domains.add(event.domain)
+                sim.domain_of = self.domain_of
+                victims = sorted(
+                    (p["metadata"].get("namespace", "default"),
+                     p["metadata"]["name"])
+                    for p in injector.api.list("v1", "Pod")
+                    if sim._is_bound(p)
+                    and self.domain_of(
+                        (p.get("spec") or {}).get("nodeName") or ""
+                    ) == event.domain
+                )
+                tainted = self._domain_tainted.setdefault(
+                    event.domain, set())
+                for ns, name in victims:
+                    node = injector.preempt_pod(ns, name)
+                    if node:
+                        tainted.add(node)
+                fired.append({
+                    "kind": "domain_loss", "domain": event.domain,
+                    "at_s": round(event.at_s, 3),
+                    "chips": event.chips, "pods": len(victims),
+                })
+            else:
+                self._lost.pop(event.domain, None)
+                sim.lost_domains.discard(event.domain)
+                for node in sorted(
+                        self._domain_tainted.pop(event.domain, ())):
+                    injector.recover_node(node)
+                fired.append({
+                    "kind": "domain_repair", "domain": event.domain,
+                    "at_s": round(event.at_s, 3),
+                })
+        if fired:
+            # Push the merged capacity view into the injector/sim so
+            # the rack's chips leave (or rejoin) the bindable pool in
+            # the same instant as the pod deletions.
+            injector.apply_capacity(self, now_s, sim)
+            self.domain_log.extend(fired)
+        return fired
+
+    # ---- introspection ---------------------------------------------------
+    def instants(self) -> dict:
+        """Every track's materialised instants — the isolation
+        contract's observable: composing a new track must leave every
+        other track's entry here byte-identical."""
+        return {
+            "traffic": [
+                [p.name, self.tick_of(p.start), self.tick_of(p.end)]
+                for p in self.traffic
+            ],
+            "capacity": [
+                [round(e.at_s, 6), e.chips]
+                for e in self.schedule.capacity_events()
+            ],
+            "api": [list(row) for row in self._api_instants],
+            "tenants": sorted(self.tenant_mixes) + [
+                [a.kind, self.tick_of(a.at), a.namespace, a.name]
+                for a in self.arrivals
+            ],
+            "domains": [
+                [e.kind, e.domain, round(e.at_s, 6), e.chips]
+                for e in self.domain_events
+            ],
+        }
+
+    def manifest(self) -> dict:
+        """The world's deterministic self-description, safe to fold
+        into a ``replay_digest`` payload."""
+        return {
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "tick_s": self.tick_s,
+            "domains": self.domains,
+            "instants": self.instants(),
+        }
+
+    def describe(self) -> str:
+        parts = [f"world seed={self.seed} ticks={self.ticks}"
+                 f" tick_s={self.tick_s:g}"]
+        parts.append(self.schedule.describe())
+        for p in self.traffic:
+            parts.append(f"traffic:{p.name}[{p.start:g},{p.end:g})")
+        for e in self.domain_events:
+            parts.append(f"domain-{e.kind}:{e.domain}@{e.at_s:g}s")
+        return " ".join(parts)

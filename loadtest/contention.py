@@ -57,9 +57,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from kubeflow_tpu.chaos import (  # noqa: E402
-    FaultSchedule,
+    Clock,
     PreemptionInjector,
     StatefulSetPodSimulator,
+    WorldBuilder,
 )
 from kubeflow_tpu.controllers.culling import (  # noqa: E402
     CullingOptions,
@@ -85,20 +86,6 @@ from kubeflow_tpu.scheduler import (  # noqa: E402
     PRIORITY_KEY,
     SlicePoolScheduler,
 )
-
-
-class Clock:
-    """The injected scenario clock every component shares."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = float(t)
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> float:
-        self.t += s
-        return self.t
 
 
 class InMemoryCheckpointManager:
@@ -220,13 +207,21 @@ class Contention:
         self.tick_s = float(tick_s)
         self.clk = Clock(0.0)
         self.tick_index = 0
-        day_s = self.total_ticks * self.tick_s
 
-        self.schedule = (
-            FaultSchedule(seed=self.seed)
+        # Declarative timeline on the shared builder: capacity weather
+        # plus the scripted tenant arrivals/touch (the tenant track).
+        self.world = (
+            WorldBuilder(self.seed, self.total_ticks, self.tick_s)
             .capacity(0.0, 24)
-            .capacity(self.REGROW_AT * day_s, 32, jitter_s=self.tick_s)
+            .capacity(self.REGROW_AT, 32, jitter_s=self.tick_s)
+            .arrival(self.SERVE_ARRIVES, "inference", "team-b",
+                     "serve-hi", topology="2x4", priority=10)
+            .arrival(self.GREEDY_ARRIVES, "inference", "team-b",
+                     "greedy", topology="2x4", priority=10)
+            .arrival(self.TOUCH_AT, "touch", "team-a", "idle-nb")
+            .build()
         )
+        self.schedule = self.world.schedule
         self.api = FakeApiServer()
         self.sim = StatefulSetPodSimulator(
             self.api, recreate_on_template_change=True)
@@ -235,7 +230,7 @@ class Contention:
 
         self.meters: dict[tuple[str, str, str], GoodputMeter] = {}
         self.scheduler = SlicePoolScheduler(
-            capacity_fn=lambda: self.schedule.capacity_at(self.clk()),
+            capacity_fn=lambda: self.world.capacity_at(self.clk()),
             api=self.api,
             clock=self.clk,
             aging_s=3600.0,
@@ -361,16 +356,15 @@ class Contention:
 
     def _tick(self) -> None:
         now = self.clk.advance(self.tick_s)
-        if self.tick_index == int(self.SERVE_ARRIVES
-                                  * self.total_ticks):
-            self.api.create(_inference("team-b", "serve-hi", "2x4", 10))
-        if self.tick_index == int(self.GREEDY_ARRIVES
-                                  * self.total_ticks):
-            self.api.create(_inference("team-b", "greedy", "2x4", 10))
-        if self.tick_index == int(self.TOUCH_AT * self.total_ticks):
-            self.touched = True
-            self._http_touch("team-a", "idle-nb")
-        self.injector.apply_capacity(self.schedule, now, self.sim)
+        for arrival in self.world.arrivals_at(self.tick_index):
+            if arrival.kind == "inference":
+                self.api.create(_inference(
+                    arrival.namespace, arrival.name, arrival.topology,
+                    arrival.priority))
+            elif arrival.kind == "touch":
+                self.touched = True
+                self._http_touch(arrival.namespace, arrival.name)
+        self.injector.apply_capacity(self.world, now, self.sim)
         self.sim.step()
         for ctrl in (self.nb_ctrl, self.inf_ctrl, self.cull_ctrl):
             ctrl.resync()

@@ -1,0 +1,562 @@
+"""Fleet storm: the game-day actuation timeline UNDER the sharded
+10k-CR soak — one world, every plane at once (ROADMAP item 5's "under,
+not next to" composition).
+
+The :class:`~loadtest.soak.Soak` provides the substrate: sharded
+manager replicas behind per-shard leases, informer caches, batched
+status writes, the slice-pool scheduler, seeded flood + churn, a
+mid-soak lease revocation, capacity dip-and-restore. This harness
+composes the game day's weather ON TOP via the shared
+:class:`~kubeflow_tpu.chaos.world.WorldBuilder` — each track on its
+own derived stream, so none of the soak's instants move (the
+`tests/test_world.py` isolation contract):
+
+- **traffic**: a prompt-length-abuse TTFT wave (64k-token prompts
+  against chunked-prefill admission — the gateway actuator must
+  tighten ``max_pending``/``prefill_per_cycle`` and restore on
+  resolve) and a full-slots backlog phase (the scale actuator walks
+  ``spec.replicas`` up and back down through the REAL sharded
+  inference controller).
+- **correlated domains**: mid-storm whole-rack loss — every worker
+  bound in the rack taints + dies in one instant, multi-host slices
+  partial-fail together, the rack's chips leave the merged capacity
+  view until the scripted repair. The elastic trainer degrades its
+  slice and climbs back only when the promotion gate's per-slice
+  capacity view says the rack is back.
+- **api faults**: an apiserver blackout on the probe plane (fixed
+  probe-op budget per tick, the game-day construction) driving the
+  availability burn that tightens checkpoint cadence.
+- **adversarial tenants**: a quota-gaming mix hammering the quota'd
+  namespace with gang arrivals that must be *refused with a quota
+  reason*, not admitted and not wedged.
+
+Gates are the union of both parents plus the composition's own: the
+soak checklist (zero dual-leader reconciles, zero orphans, clean
+scheduler audit, steady-state burn SLOs green), all four autopilot
+actuators fired, every fired alert resolved, admission tightened AND
+restored, the rack loss observed with pod casualties, at least one
+quota refusal standing, and ``replay_digest`` byte-identical across
+runs of the same (seed, parameters).
+
+Usage::
+
+  python -m loadtest.fleet_storm --crs 10000 --ticks 300 --tick-s 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.autopilot import (  # noqa: E402
+    ActuationGuard,
+    Autopilot,
+    CheckpointCadenceActuator,
+    ElasticPromotionGate,
+    GatewayAdmissionActuator,
+    InferenceScaleActuator,
+)
+from kubeflow_tpu.chaos import (  # noqa: E402
+    ChaosApiServer,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+)
+from kubeflow_tpu.controllers.elastic import (  # noqa: E402
+    ELASTIC_GRACE_KEY,
+    ELASTIC_LADDER_KEY,
+    ELASTIC_PROMOTE_AFTER_KEY,
+    ELASTIC_SHAPE_KEY,
+)
+from kubeflow_tpu.controllers.inference import INFERENCE_API  # noqa: E402
+from kubeflow_tpu.controllers.manager import (  # noqa: E402
+    make_default_slo_engine,
+)
+from kubeflow_tpu.controllers.metrics import ControllerMetrics  # noqa: E402
+from kubeflow_tpu.controllers.notebook import NOTEBOOK_API  # noqa: E402
+from kubeflow_tpu.k8s.core import ApiError  # noqa: E402
+from kubeflow_tpu.obs.recorder import FlightRecorder  # noqa: E402
+from kubeflow_tpu.obs.trace import Tracer  # noqa: E402
+from kubeflow_tpu.scheduler import PRIORITY_KEY  # noqa: E402
+
+from loadtest.game_day import (  # noqa: E402
+    GameDayCheckpointManager,
+    StubServingEngine,
+)
+from loadtest.soak import Soak, _notebook, problems_in  # noqa: E402
+
+TRAINER_NS = "fleet"
+
+
+def _trainer(ns: str, name: str) -> dict:
+    nb = _notebook(ns, name, "4x4", 1000)
+    nb["metadata"]["annotations"].update({
+        ELASTIC_LADDER_KEY: "auto",
+        ELASTIC_GRACE_KEY: "300",
+        ELASTIC_PROMOTE_AFTER_KEY: "1200",
+    })
+    return nb
+
+
+def _gateway(ns: str, name: str) -> dict:
+    # CPU gateway pool (no spec.tpu): spec.replicas drives the
+    # StatefulSet directly, so the scale actuation is visible end to
+    # end through the sharded inference controller.
+    return {
+        "apiVersion": INFERENCE_API,
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": {PRIORITY_KEY: "1000"}},
+        "spec": {"modelDir": "/models/prod", "replicas": 1},
+    }
+
+
+class FleetStorm(Soak):
+    """The composed scenario. Phase fractions interleave with the
+    soak's own (FLOOD_END 0.30, DIP 0.45, REVOKE 0.55, REGROW 0.65)
+    so every weather system is live while churn runs."""
+
+    OPS_PER_TICK = 4     # availability-probe budget per tick
+    DOMAINS = 4          # racks; worker k of every slice on rack k%4
+
+    WAVE = (0.05, 0.08)          # prompt-abuse TTFT melt
+    PRESSURE = (0.15, 0.24)      # full slots + backlog: scale up
+    RACK_LOSS_AT = 0.40          # whole-rack correlated failure
+    RACK_REPAIR_AT = 0.60        # the rack returns
+    BLACKOUT = (0.62, 0.66)      # probe-plane apiserver outage
+
+    _gate = None  # created lazily: replicas build during super().__init__
+
+    def __init__(self, seed: int = 11, crs: int = 10000,
+                 ticks: int = 300, tick_s: float = 60.0,
+                 shards: int = 4, replicas: int = 2,
+                 namespaces: int = 8, pod_plane: bool = True,
+                 dump_dir: str = "."):
+        super().__init__(seed=seed, crs=crs, ticks=ticks, tick_s=tick_s,
+                         shards=shards, replicas=replicas,
+                         namespaces=namespaces, chaos=False,
+                         pod_plane=pod_plane, dump_dir=dump_dir)
+
+        # --- observability + autopilot (the game-day plane) --------------
+        self.tracer = Tracer(sample_rate=1.0,
+                             ring_capacity=max(4096, self.ticks),
+                             clock=self.clk)
+        self.storm_recorder = FlightRecorder(
+            capacity=max(4096, self.ticks), dump_dir=dump_dir,
+            min_dump_interval_s=600.0, clock=self.clk,
+            name=f"storm-{self.seed}")
+        from kubeflow_tpu.serving.gateway import (
+            GatewayMetrics,
+            make_gateway_slo_engine,
+        )
+        self.engine = StubServingEngine()
+        self.gw_metrics = GatewayMetrics(self.engine)
+        self.gateway_slo = make_gateway_slo_engine(
+            self.gw_metrics, clock=self.clk,
+            recorder=self.storm_recorder)
+        # The availability plane: a fixed probe-op budget per tick
+        # through the world's probe schedule — op-indexed blackout
+        # windows map exactly onto scenario ticks, and the controller
+        # plane (self.handle) never parks on its backoff.
+        self.avail_proxy = ChaosApiServer(
+            self.api, self.world.probe_schedule, sleep=lambda s: None)
+        self.avail_slo = make_default_slo_engine(
+            ControllerMetrics(), self.avail_proxy, clock=self.clk,
+            recorder=self.storm_recorder)
+
+        self.autopilot = Autopilot(
+            clock=self.clk, tracer=self.tracer,
+            recorder=self.storm_recorder, enabled=True,
+            history_limit=max(4096, self.ticks))
+        self.admission = self.autopilot.register(GatewayAdmissionActuator(
+            self.engine,
+            guard=ActuationGuard(min_interval_s=300.0, clock=self.clk),
+        ))
+        self.scale = self.autopilot.register(InferenceScaleActuator(
+            self.api, TRAINER_NS, "gateway",
+            status_fn=self._gateway_status,
+            guard=ActuationGuard(min_interval_s=900.0, clock=self.clk),
+            min_replicas=1, max_replicas=3, hold_s=600.0,
+            clock=self.clk,
+        ))
+        self.cadence = self.autopilot.register(CheckpointCadenceActuator(
+            capacity_fn=lambda: self.world.capacity_at(self.clk()),
+            guard=ActuationGuard(min_interval_s=300.0, clock=self.clk),
+        ))
+        self.autopilot.register(self._ensure_gate())
+        self.autopilot.attach(self.gateway_slo)
+        self.autopilot.attach(self.avail_slo)
+        for replica in self.replicas:
+            self.autopilot.attach(replica.slo)
+
+        # --- the composed workloads ---------------------------------------
+        self.api.create(_trainer(TRAINER_NS, "trainer"))
+        self.api.create(_gateway(TRAINER_NS, "gateway"))
+        self.ckpt = GameDayCheckpointManager(self.clk)
+        self.train_report = None
+
+        self.gamer_counter = 0
+        # Bounded by the seeded arrival script.
+        # analysis: allow[py-unbounded-deque]
+        self.gamers: list[tuple[str, str]] = []
+        self.max_replicas_seen = 1
+        self.min_max_pending_seen = self.engine.max_pending
+        # analysis: allow[py-unbounded-deque] — bounded by reshape count
+        self.shapes_seen: list[str | None] = []
+        self._settle_round = 0
+
+    # ---- world (the soak's tracks + the storm's) -------------------------
+    def _build_world(self):
+        builder = (
+            super()._build_world_builder()
+            .traffic("prompt-abuse", *self.WAVE, ttft_s=30.0,
+                     itl_s=0.02, prompt_len=65536)
+            .traffic("pressure", *self.PRESSURE,
+                     occupancy="full", queue_depth=6)
+            .api_blackout(*self.BLACKOUT,
+                          ops_per_tick=self.OPS_PER_TICK)
+            .tenants(
+                "quota-gamer",
+                namespaces=("ns-0",),
+                topologies=(("2x4", 8),),
+                priorities=(10,),
+                weights={"create": 1.0},
+            )
+            # Rack 3: the trainer's 4x4 loses worker-3 (the slice
+            # partial-fails) while the v5e-8 rung's hosts 0-1 stay
+            # reachable — so the degraded shape RUNS, its promote
+            # probe lands inside the outage, and the gate must veto
+            # promotion back into the missing rack.
+            .domains(self.DOMAINS)
+            .domain_loss(self.RACK_LOSS_AT, domain=3,
+                         chips=max(8, self.capacity // 4),
+                         jitter_s=self.tick_s)
+            .domain_repair(self.RACK_REPAIR_AT, domain=3,
+                           jitter_s=self.tick_s)
+        )
+        return builder.build()
+
+    def _ensure_gate(self):
+        if self._gate is None:
+            # Per-slice capacity view: the trainer's 4x4 slice (16
+            # chips on 4 hosts) partial-fails under a rack loss even
+            # while the fleet pool has headroom.
+            self._gate = ElasticPromotionGate(
+                capacity_fn=lambda: self.world.slice_capacity(16, 4),
+                guard=ActuationGuard(min_interval_s=1200.0,
+                                     clock=self.clk),
+                clock=self.clk,
+            )
+        return self._gate
+
+    def notebook_kwargs(self) -> dict:
+        return {"promotion_gate": self._ensure_gate()}
+
+    # ---- per-tick planes -------------------------------------------------
+    def _gateway_status(self) -> dict:
+        return {
+            "pending": self.engine.pending(),
+            "slots": {"active": self.engine.occupancy,
+                      "total": self.engine.slots_total},
+        }
+
+    def _traffic(self, tick: int) -> None:
+        active = self.world.traffic_active(tick)
+        wave = next((p for p in active if p.ttft_s is not None), None)
+        for _ in range(wave.observations if wave else 10):
+            self.gw_metrics.ttft.observe(wave.ttft_s if wave else 0.08)
+            self.gw_metrics.itl.observe(
+                wave.itl_s if wave and wave.itl_s else 0.02)
+        pressure = next(
+            (p for p in active if p.occupancy == "full"), None)
+        if pressure is not None:
+            self.engine.occupancy = self.engine.slots_total
+            self.engine.queue_depth = pressure.queue_depth
+        else:
+            self.engine.occupancy = 1
+            self.engine.queue_depth = 0
+
+    def _availability_ops(self, tick: int) -> None:
+        for _ in range(self.OPS_PER_TICK):
+            try:
+                self.avail_proxy.list(NOTEBOOK_API, "Notebook")
+            except ApiError:
+                pass  # the blackout the availability SLO judges
+
+    def _quota_gamers(self, tick: int) -> None:
+        """The adversarial mix: gang arrivals into the quota'd
+        namespace, ~one per five churn ticks, from the track's own
+        stream (composing it shifted no churn instant)."""
+        if tick < self.flood_end:
+            return
+        rng = self.world.stream("quota-gamer")
+        if rng.random() >= 0.2:
+            return
+        mix = self.world.tenant_mixes["quota-gamer"]
+        ns = mix.namespaces[0]
+        topology, _chips = mix.topologies[0]
+        name = f"gamer-{self.gamer_counter:04d}"
+        self.gamer_counter += 1
+        self.api.create(_notebook(ns, name, topology,
+                                  mix.priorities[0]))
+        self.gamers.append((ns, name))
+        self.op_log.append([tick, "quota-gamer", ns, name])
+
+    def _world_ops(self, tick: int, now: float) -> None:
+        super()._world_ops(tick, now)
+        self._quota_gamers(tick)
+        self._traffic(tick)
+        self._availability_ops(tick)
+        if tick % 5 == 0:
+            # Periodic resync: elastic timers (grace/promote) and the
+            # scale actuator's patches must be observed even when no
+            # watch event fires this tick.
+            for replica in self.replicas:
+                for ctrl in replica.controllers:
+                    ctrl.resync()
+
+    def _post_slo(self, tick: int, now: float) -> None:
+        self.gateway_slo.tick(now)
+        self.avail_slo.tick(now)
+        self.autopilot.tick(now)
+        self._storm_sample()
+
+    def _storm_sample(self) -> None:
+        self.min_max_pending_seen = min(self.min_max_pending_seen,
+                                        self.engine.max_pending)
+        try:
+            svc = self.api.get(INFERENCE_API, "InferenceService",
+                               "gateway", TRAINER_NS)
+            replicas = int((svc.get("spec") or {}).get("replicas") or 1)
+            self.max_replicas_seen = max(self.max_replicas_seen,
+                                         replicas)
+            nb = self.api.get(NOTEBOOK_API, "Notebook", "trainer",
+                              TRAINER_NS)
+            shape = (nb["metadata"].get("annotations") or {}).get(
+                ELASTIC_SHAPE_KEY)
+            if not self.shapes_seen or self.shapes_seen[-1] != shape:
+                self.shapes_seen.append(shape)
+        # analysis: allow[py-broad-except] — storm harness: mid-delete reads resample next tick
+        except Exception:
+            pass
+
+    def _settle_tick(self, now: float) -> None:
+        """Shared drain/cooldown plane: the storm's SLO engines and
+        autopilot keep ticking (restores and scale-downs land), and
+        every few rounds the controllers resync so elastic promote
+        timers are observed."""
+        self.gateway_slo.tick(now)
+        self.avail_slo.tick(now)
+        self.autopilot.tick(now)
+        if self.sim is not None:
+            self.world.apply_domains(now, self.injector, self.sim)
+            self.sim.step()
+        self._settle_round += 1
+        if self._settle_round % 5 == 0:
+            for replica in self.replicas:
+                for ctrl in replica.controllers:
+                    ctrl.resync()
+                    ctrl.run_once(max_iterations=self.tick_budget)
+
+    def _drain_tick(self, now: float) -> None:
+        self._settle_tick(now)
+
+    def _cooldown_tick(self, now: float) -> None:
+        self._settle_tick(now)
+
+    # ---- drive: the world IS the batch iterator --------------------------
+    def _batches(self):
+        for tick in range(self.ticks):
+            self._tick(tick)
+            yield {"x": [0.0]}
+
+    def _drive(self) -> None:
+        from kubeflow_tpu.models.train import run_with_checkpointing
+
+        def step_fn(state, batch):
+            return dict(state, step=state["step"] + 1), {}
+
+        _state, self.train_report = run_with_checkpointing(
+            step_fn, {"step": 0}, self._batches(), self.ckpt,
+            save_every_s=3600.0,
+            cadence_signal=self.cadence.factor,
+            install_signal_handler=False,
+            clock=self.clk,
+        )
+
+    # ---- alert ledger across every engine --------------------------------
+    def _engines(self):
+        yield "gateway", self.gateway_slo
+        yield "availability", self.avail_slo
+        for replica in self.replicas:
+            yield replica.identity, replica.slo
+
+    def _alert_ledger(self) -> tuple[list, list]:
+        transitions = []
+        unresolved = []
+        for engine_name, engine in self._engines():
+            history = list(engine.alerts.history)
+            for t in history:
+                transitions.append({
+                    "engine": engine_name, "slo": t["slo"],
+                    "speed": t["speed"], "from": t["from"],
+                    "to": t["to"], "at": t["at"],
+                })
+            fired = {(t["slo"], t["speed"]) for t in history
+                     if t["to"] == "firing"}
+            resolved = {(t["slo"], t["speed"]) for t in history
+                        if t["to"] == "resolved"}
+            still_active = {(a["slo"], a["speed"])
+                            for a in engine.alerts.active()}
+            for key in sorted((fired - resolved) | still_active):
+                unresolved.append({"engine": engine_name,
+                                   "slo": key[0], "speed": key[1]})
+        return transitions, unresolved
+
+    def _quota_refusals(self) -> int:
+        refused = 0
+        for ns, name in self.gamers:
+            try:
+                nb = self.api.get(NOTEBOOK_API, "Notebook", name, ns)
+            except Exception:  # analysis: allow[py-broad-except] — churn may have raced a delete
+                continue
+            reason = ((nb.get("status") or {})
+                      .get("schedulingReason") or "")
+            if "quota" in reason.lower():
+                refused += 1
+        return refused
+
+    # ---- summary / digest extras -----------------------------------------
+    def _digest_extras(self) -> dict:
+        transitions, _ = self._alert_ledger()
+        return {
+            "world": self.world.manifest(),
+            "autopilot_events": [dict(e) for e in self.autopilot.events],
+            "autopilot_counts": self.autopilot.counts(),
+            "alert_transitions": transitions,
+            "saves": [[s, round(at, 3)] for s, at in self.ckpt.saves],
+            "shapes": self.shapes_seen,
+            "domain_log": self.world.domain_log,
+        }
+
+    def _summary_extras(self) -> dict:
+        transitions, unresolved = self._alert_ledger()
+        events = list(self.autopilot.events)
+        fired_actuators = sorted({
+            e["actuator"] for e in events if e["outcome"] != "error"
+        })
+        return {
+            "kind": "fleet_storm",
+            "final_step": (self.train_report.final_step
+                           if self.train_report else 0),
+            "actuators_fired": fired_actuators,
+            "actions_total": sum(
+                self.autopilot.actions_total.values()),
+            "events_total": self.autopilot.events_emitted,
+            "alerts_fired": sorted({
+                f"{t['engine']}:{t['slo']}/{t['speed']}"
+                for t in transitions if t["to"] == "firing"
+            }),
+            "alerts_unresolved": unresolved,
+            "saves": {"total": len(self.ckpt.saves)},
+            "admission": {
+                "initial_max_pending": 64,
+                "min_max_pending": self.min_max_pending_seen,
+                "final_max_pending": self.engine.max_pending,
+            },
+            "scale": {"max_replicas_seen": self.max_replicas_seen},
+            "elastic": {
+                "shapes": self.shapes_seen,
+                "gate_vetoes": self._gate.vetoes,
+                "gate_allows": self._gate.allows,
+            },
+            "domain_log": self.world.domain_log,
+            "pod_plane": self.pod_plane,
+            "pods": ({"created": self.sim.created_total,
+                      "deleted": self.sim.deleted_total,
+                      "pending": self.sim.pending_total}
+                     if self.sim is not None else None),
+            "quota": {"gamers": len(self.gamers),
+                      "refused": self._quota_refusals()},
+        }
+
+
+def run_fleet_storm(**kwargs) -> dict:
+    return FleetStorm(**kwargs).run()
+
+
+def storm_problems_in(summary: dict) -> list[str]:
+    """The composed acceptance checklist: the soak's own gates plus
+    the actuation/weather gates."""
+    problems = problems_in(summary)
+    expected = {"gateway-admission", "inference-scale",
+                "checkpoint-cadence", "elastic-promotion"}
+    missing = expected - set(summary["actuators_fired"])
+    if missing:
+        problems.append(f"actuators never fired: {sorted(missing)}")
+    if summary["alerts_unresolved"]:
+        problems.append(
+            f"alerts unresolved: {summary['alerts_unresolved']}")
+    if summary["actions_total"] != summary["events_total"]:
+        problems.append("autopilot counter/event-log mismatch")
+    admission = summary["admission"]
+    if admission["min_max_pending"] >= admission["initial_max_pending"]:
+        problems.append("gateway admission never tightened")
+    if admission["final_max_pending"] != admission["initial_max_pending"]:
+        problems.append("gateway admission never restored")
+    kinds = [d["kind"] for d in summary["domain_log"]]
+    if "domain_loss" not in kinds or "domain_repair" not in kinds:
+        problems.append("the rack loss/repair arc never fired")
+    if summary["pod_plane"]:
+        losses = [d for d in summary["domain_log"]
+                  if d["kind"] == "domain_loss"]
+        if not any(d["pods"] for d in losses):
+            problems.append("rack loss killed no pods")
+        shapes = summary["elastic"]["shapes"]
+        if not any(s for s in shapes):
+            problems.append("the trainer never degraded its slice")
+        if shapes and shapes[-1] is not None:
+            problems.append(
+                f"the trainer never promoted back: {shapes}")
+    if summary["quota"]["refused"] < 1:
+        problems.append("no quota-gaming arrival was refused")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Game-day actuation under the sharded fleet soak: "
+        "one composed world — traffic, rack loss, blackout, "
+        "adversarial tenants — every gate at once.")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--crs", type=int, default=10000)
+    parser.add_argument("--ticks", type=int, default=300)
+    parser.add_argument("--tick-s", type=float, default=60.0)
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--namespaces", type=int, default=8)
+    parser.add_argument("--no-pod-plane", action="store_true")
+    parser.add_argument("--dump-dir", default=".")
+    args = parser.parse_args(argv)
+    summary = run_fleet_storm(
+        seed=args.seed, crs=args.crs, ticks=args.ticks,
+        tick_s=args.tick_s, shards=args.shards,
+        replicas=args.replicas, namespaces=args.namespaces,
+        pod_plane=not args.no_pod_plane, dump_dir=args.dump_dir,
+    )
+    compact = {k: v for k, v in summary.items()
+               if k not in ("cache", "ops", "timeline")}
+    print(json.dumps(compact, default=str))
+    problems = storm_problems_in(summary)
+    if problems:
+        print("FLEET STORM FAILED: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,9 +62,10 @@ from kubeflow_tpu.autopilot import (  # noqa: E402
 )
 from kubeflow_tpu.chaos import (  # noqa: E402
     ChaosApiServer,
-    FaultSchedule,
+    Clock,
     PreemptionInjector,
     StatefulSetPodSimulator,
+    WorldBuilder,
 )
 from kubeflow_tpu.controllers.inference import (  # noqa: E402
     INFERENCE_API,
@@ -91,20 +92,6 @@ from kubeflow_tpu.controllers.elastic import (  # noqa: E402
     ELASTIC_PROMOTE_AFTER_KEY,
     ELASTIC_SHAPE_KEY,
 )
-
-
-class Clock:
-    """The injected scenario clock every component shares."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = float(t)
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> float:
-        self.t += s
-        return self.t
 
 
 class StubServingEngine:
@@ -211,19 +198,26 @@ class GameDay:
         self.clk = Clock(0.0)
         self.namespace = "fleet"
 
-        # --- chaos planes -------------------------------------------------
-        day_s = self.hours * 3600.0
-        b0 = int(self.BLACKOUT[0] * self.total_ticks) * self.OPS_PER_TICK
-        b1 = int(self.BLACKOUT[1] * self.total_ticks) * self.OPS_PER_TICK
-        self.schedule = (
-            FaultSchedule(seed=self.seed)
-            .blackout(b0, b1)
+        # --- the world ----------------------------------------------------
+        # One declarative timeline on the shared builder: traffic,
+        # availability (probe-plane blackout) and capacity weather are
+        # separate tracks, so composing more weather onto this arc can
+        # never shift these instants (chaos/world.py's contract).
+        self.world = (
+            WorldBuilder(self.seed, self.total_ticks, self.tick_s)
+            .traffic("wave", *self.WAVE, ttft_s=30.0, itl_s=0.02)
+            .traffic("pressure", *self.PRESSURE,
+                     occupancy="full", queue_depth=6)
+            .api_blackout(*self.BLACKOUT,
+                          ops_per_tick=self.OPS_PER_TICK)
             .capacity(0.0, 16)
-            .capacity(self.SHRINK_AT * day_s, 8, jitter_s=30.0)
-            .capacity(self.REGROW_AT * day_s, 16, jitter_s=30.0)
+            .capacity(self.SHRINK_AT, 8, jitter_s=30.0)
+            .capacity(self.REGROW_AT, 16, jitter_s=30.0)
+            .build()
         )
+        self.schedule = self.world.schedule
         self.api = FakeApiServer()
-        self.proxy = ChaosApiServer(self.api, self.schedule,
+        self.proxy = ChaosApiServer(self.api, self.world.probe_schedule,
                                     sleep=lambda s: None)
         self.sim = StatefulSetPodSimulator(
             self.api, recreate_on_template_change=True)
@@ -311,20 +305,20 @@ class GameDay:
                       "total": self.engine.slots_total},
         }
 
-    def _in(self, tick: int, phase: tuple[float, float]) -> bool:
-        return (int(phase[0] * self.total_ticks) <= tick
-                < int(phase[1] * self.total_ticks))
-
     def _traffic(self, tick: int) -> None:
-        """Scripted request weather onto the gateway's live metrics —
+        """The world's traffic track onto the gateway's live metrics —
         the same histograms the TTFT/ITL objectives judge."""
-        wave = self._in(tick, self.WAVE)
-        for _ in range(10):
-            self.gw_metrics.ttft.observe(30.0 if wave else 0.08)
-            self.gw_metrics.itl.observe(0.02)
-        if self._in(tick, self.PRESSURE):
+        active = self.world.traffic_active(tick)
+        wave = next((p for p in active if p.ttft_s is not None), None)
+        for _ in range(wave.observations if wave else 10):
+            self.gw_metrics.ttft.observe(wave.ttft_s if wave else 0.08)
+            self.gw_metrics.itl.observe(
+                wave.itl_s if wave and wave.itl_s else 0.02)
+        pressure = next(
+            (p for p in active if p.occupancy == "full"), None)
+        if pressure is not None:
             self.engine.occupancy = self.engine.slots_total
-            self.engine.queue_depth = 6
+            self.engine.queue_depth = pressure.queue_depth
         else:
             self.engine.occupancy = 1
             self.engine.queue_depth = 0
@@ -373,7 +367,7 @@ class GameDay:
             now = self.clk.advance(self.tick_s)
             self._traffic(tick)
             self._availability_ops(tick)
-            self.injector.apply_capacity(self.schedule, now, self.sim)
+            self.injector.apply_capacity(self.world, now, self.sim)
             self.sim.step()
             for ctrl in (self.nb_ctrl, self.inf_ctrl):
                 # Periodic resync: elastic timers (grace/promote) and

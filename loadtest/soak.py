@@ -52,13 +52,18 @@ from __future__ import annotations
 import argparse
 import hashlib
 import json
-import random
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from kubeflow_tpu.chaos import ChaosApiServer, FaultSchedule  # noqa: E402
+from kubeflow_tpu.chaos import (  # noqa: E402
+    ChaosApiServer,
+    Clock,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+    WorldBuilder,
+)
 from kubeflow_tpu.chaos.harness import (  # noqa: E402
     clamp_backoff,
     run_to_convergence,
@@ -100,20 +105,6 @@ REVOKER = "chaos-revoker"
 # bigger gangs, so admission mixes trivial and chunky demands.
 TOPOLOGIES = [("1x1", 1)] * 6 + [("2x2", 4)] * 3 + [("2x4", 8)]
 PRIORITIES = (0, 0, 0, 0, 0, 0, 5, 5, 10, 10)
-
-
-class Clock:
-    """The injected scenario clock every component shares."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = float(t)
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, s: float) -> float:
-        self.t += s
-        return self.t
 
 
 def _notebook(ns: str, name: str, topology: str, priority: int) -> dict:
@@ -183,11 +174,13 @@ class _Replica:
             soak.handle, prom=self.prom, clock=soak.clk,
             scheduler=soak.scheduler, cache=self.cache,
             status_batcher=self.batcher, shard_gate=self.gate,
+            **soak.notebook_kwargs(),
         )
         inf = make_inference_controller(
             soak.handle, prom=self.prom, scheduler=soak.scheduler,
             clock=soak.clk, cache=self.cache,
             status_batcher=self.batcher, shard_gate=self.gate,
+            **soak.inference_kwargs(),
         )
         self.controllers = [nb, inf]
         for ctrl in self.controllers:
@@ -219,7 +212,7 @@ class Soak:
                  ticks: int = 240, tick_s: float = 30.0,
                  shards: int = 4, replicas: int = 2,
                  namespaces: int = 8, chaos: bool = True,
-                 dump_dir: str = "."):
+                 pod_plane: bool = False, dump_dir: str = "."):
         self.seed = int(seed)
         self.crs = int(crs)
         self.ticks = int(ticks)
@@ -230,21 +223,20 @@ class Soak:
         self.chaos_enabled = bool(chaos)
         self.dump_dir = dump_dir
         self.clk = Clock(0.0)
-        self.rng = random.Random(self.seed)
 
         # Pool sized to ~60% of expected demand (avg 2.6 chips/CR), so
         # a deep queue forms; the quota'd namespace binds sooner.
         avg_chips = sum(c for _, c in TOPOLOGIES) / len(TOPOLOGIES)
         self.capacity = max(32, int(self.crs * avg_chips * 0.6))
-        day_s = self.ticks * self.tick_s
-        self.schedule = (
-            FaultSchedule(seed=self.seed)
-            .capacity(0.0, self.capacity)
-            .capacity(self.DIP_AT * day_s, int(self.capacity * 0.8),
-                      jitter_s=self.tick_s)
-            .capacity(self.REGROW_AT * day_s, self.capacity,
-                      jitter_s=self.tick_s)
-        )
+        self.world = self._build_world()
+        self.schedule = self.world.schedule
+        # Tenant churn draws from the world's own derived stream, so
+        # composing more tracks (the fleet storm) never shifts a churn
+        # instant. (This moved the draws off random.Random(seed) — the
+        # soak digest was re-baselined for it; see tests/test_world.py.)
+        self.rng = self.world.stream("tenants")
+        self._mix = self.world.tenant_mixes["churn"]
+        self._thresholds = self._mix.thresholds()
         self.api = FakeApiServer()
         # Controllers/caches reach the store through the chaos proxy;
         # its schedule holds NO fault windows until the chaos phase,
@@ -252,8 +244,22 @@ class Soak:
         # counts accrue for the later window placement.
         self.handle = ChaosApiServer(self.api, self.schedule,
                                      sleep=lambda s: None)
+        # Opt-in pod plane: the statefulset/kubelet simulator rides
+        # the soak tick (its indexed scan keeps the pass O(pods), not
+        # O(pods x statefulsets)), and correlated-domain weather gets
+        # real pod casualties. Off by default — the base soak judges
+        # the CR plane only, and its digest predates the pod plane.
+        self.pod_plane = bool(pod_plane)
+        self.sim = None
+        self.injector = None
+        if self.pod_plane:
+            self.sim = StatefulSetPodSimulator(
+                self.api, recreate_on_template_change=True,
+                gc_orphans=True)
+            self.injector = PreemptionInjector(self.api,
+                                               sleep=lambda s: None)
         self.scheduler = SlicePoolScheduler(
-            capacity_fn=lambda: self.schedule.capacity_at(self.clk()),
+            capacity_fn=lambda: self.world.capacity_at(self.clk()),
             api=self.handle,
             clock=self.clk,
             aging_s=3600.0,
@@ -295,6 +301,40 @@ class Soak:
         self.dual_violations: list[tuple] = []
         self.reconcile_counts = {r.identity: 0 for r in self.replicas}
 
+    # ---- composition hooks (FleetStorm overrides) ------------------------
+    def _build_world(self):
+        return self._build_world_builder().build()
+
+    def _build_world_builder(self) -> WorldBuilder:
+        """The soak's declarative timeline: capacity weather (dip +
+        symmetric restore) and the churn tenant mix. Subclasses
+        compose more tracks onto the returned builder — per-track
+        streams guarantee these instants never shift."""
+        return (
+            WorldBuilder(self.seed, self.ticks, self.tick_s)
+            .capacity(0.0, self.capacity)
+            .capacity(self.DIP_AT, int(self.capacity * 0.8),
+                      jitter_s=self.tick_s)
+            .capacity_restore(self.REGROW_AT, jitter_s=self.tick_s)
+            .tenants(
+                "churn",
+                namespaces=tuple(f"ns-{i}"
+                                 for i in range(self.namespaces)),
+                topologies=TOPOLOGIES,
+                priorities=PRIORITIES,
+                weights={"create": 0.15, "delete": 0.13,
+                         "suspend": 0.10, "touch": 0.06,
+                         "preempt": 0.06},
+            )
+        )
+
+    def notebook_kwargs(self) -> dict:
+        """Extra kwargs for every replica's notebook controller."""
+        return {}
+
+    def inference_kwargs(self) -> dict:
+        return {}
+
     # ---- invariants ------------------------------------------------------
     def _shard_lease_name(self, shard: int) -> str:
         return (LEASE_NAME if self.shards == 1
@@ -321,10 +361,12 @@ class Soak:
 
     # ---- the scripted world ---------------------------------------------
     def _create(self, tick: int) -> None:
-        ns = f"ns-{self.rng.randrange(self.namespaces)}"
-        topology, _chips = TOPOLOGIES[
-            self.rng.randrange(len(TOPOLOGIES))]
-        priority = PRIORITIES[self.rng.randrange(len(PRIORITIES))]
+        mix = self._mix
+        ns = mix.namespaces[self.rng.randrange(len(mix.namespaces))]
+        topology, _chips = mix.topologies[
+            self.rng.randrange(len(mix.topologies))]
+        priority = mix.priorities[
+            self.rng.randrange(len(mix.priorities))]
         self.created += 1
         if self.created % 40 == 0:
             name = f"inf-{self.inf_counter:05d}"
@@ -344,9 +386,14 @@ class Soak:
     def _churn(self, tick: int) -> None:
         for _ in range(self.ops_per_tick):
             roll = self.rng.random()
-            if roll < 0.15:
+            op = "update"
+            for kind, threshold in self._thresholds:
+                if roll < threshold:
+                    op = kind
+                    break
+            if op == "create":
                 self._create(tick)
-            elif roll < 0.28 and self.alive_nb:
+            elif op == "delete" and self.alive_nb:
                 i = self.rng.randrange(len(self.alive_nb))
                 ns, name = self.alive_nb[i]
                 self.alive_nb[i] = self.alive_nb[-1]
@@ -357,7 +404,7 @@ class Soak:
                     pass
                 self.deleted += 1
                 self.op_log.append([tick, "delete-nb", ns, name])
-            elif roll < 0.38 and self.alive_nb:
+            elif op == "suspend" and self.alive_nb:
                 ns, name = self.alive_nb[
                     self.rng.randrange(len(self.alive_nb))]
                 started = self.scheduler.mark_reclaimable(
@@ -366,7 +413,7 @@ class Soak:
                     self.suspend_targets.append((ns, name))
                 self.op_log.append(
                     [tick, "suspend", ns, name, int(started)])
-            elif roll < 0.44 and self.suspend_targets:
+            elif op == "touch" and self.suspend_targets:
                 i = self.rng.randrange(len(self.suspend_targets))
                 ns, name = self.suspend_targets[i]
                 woke = self.scheduler.touch("Notebook", ns, name,
@@ -374,15 +421,17 @@ class Soak:
                 if woke:
                     self.suspend_targets.pop(i)
                 self.op_log.append([tick, "touch", ns, name, int(woke)])
-            elif roll < 0.50:
+            elif op == "preempt":
                 # Priority-100 arrival: preempts through the drain.
-                ns = f"ns-{self.rng.randrange(self.namespaces)}"
+                mix = self._mix
+                ns = mix.namespaces[
+                    self.rng.randrange(len(mix.namespaces))]
                 name = f"nb-{self.nb_counter:05d}"
                 self.nb_counter += 1
                 self.api.create(_notebook(ns, name, "2x4", 100))
                 self.alive_nb.append((ns, name))
                 self.op_log.append([tick, "preempt-arrival", ns, name])
-            elif self.alive_nb:
+            elif op == "update" and self.alive_nb:
                 ns, name = self.alive_nb[
                     self.rng.randrange(len(self.alive_nb))]
                 try:
@@ -440,8 +489,10 @@ class Soak:
              for r in self.replicas],
         ])
 
-    def _tick(self, tick: int) -> None:
-        now = self.clk.advance(self.tick_s)
+    def _world_ops(self, tick: int, now: float) -> None:
+        """The user-plane script for one tick: flood, then churn, plus
+        the one-shot lease revocation. Subclasses layer extra arrival
+        tracks here (each on its own world stream)."""
         if tick < self.flood_end:
             for _ in range(self.per_flood_tick):
                 if self.created < self.crs:
@@ -450,11 +501,24 @@ class Soak:
             self._churn(tick)
         if tick == self.revoke_tick:
             self._revoke(tick)
+
+    def _post_slo(self, tick: int, now: float) -> None:
+        """Hook after the per-replica SLO tick (the fleet storm's
+        autopilot/observability plane rides here)."""
+
+    def _tick(self, tick: int) -> None:
+        now = self.clk.advance(self.tick_s)
+        self._world_ops(tick, now)
+        if self.sim is not None:
+            self.world.apply_domains(now, self.injector, self.sim)
+            self.injector.apply_capacity(self.world, now, self.sim)
+            self.sim.step()
         self._elector_rounds()
         self._run_controllers()
         self.scheduler.tick(now)
         for replica in self.replicas:
             replica.slo.tick(now)
+        self._post_slo(tick, now)
         if tick % 5 == 0 or tick == self.ticks - 1:
             self._sample(tick)
 
@@ -470,7 +534,16 @@ class Soak:
             self._elector_rounds()  # leases stay fresh while we wait
             for replica in self.replicas:
                 replica.slo.tick(now)
+            self._cooldown_tick(now)
         self.scheduler.tick(self.clk())
+
+    def _cooldown_tick(self, now: float) -> None:
+        """Hook per cooldown round: extra SLO planes (the storm's
+        gateway / availability engines) tick here so THEIR burn
+        windows also get the full resolve horizon."""
+
+    def _drain_tick(self, now: float) -> None:
+        """Hook per drain round (same purpose as _cooldown_tick)."""
 
     def _drain(self, max_rounds: int = 300) -> int:
         """Post-churn settle: advance ticks (drain deadlines must be
@@ -480,6 +553,7 @@ class Soak:
             self._elector_rounds()
             worked = self._run_controllers(budget=self.tick_budget * 4)
             self.scheduler.tick(self.clk())
+            self._drain_tick(self.clk())
             pending = sum(
                 len(ctrl.queue)
                 for replica in self.replicas
@@ -514,9 +588,12 @@ class Soak:
                 ctrl.run_once(max_iterations=500)
             if self.handle.ops_total >= base + storm + 520:
                 break
-        # Stream damage off, informer watch-resume repair (the 410 /
-        # compaction re-list path), then provable convergence.
+        # Symmetric repair on both fault planes: stream damage off,
+        # API windows closed at the current op (history kept), then
+        # informer watch-resume repair (the 410 / compaction re-list
+        # path) and provable convergence.
         self.schedule.clear_watch_faults()
+        self.schedule.clear_api_faults(at_op=self.handle.ops_total)
         relists = sum(r.cache.recover() for r in self.replicas)
         rounds = run_to_convergence(
             all_ctrls, max_rounds=600,
@@ -583,12 +660,18 @@ class Soak:
         "uid", "resourceVersion", "creationTimestamp",
         "warningEvents", "firstTimestamp", "lastTimestamp",
     ))
+    # Annotation keys whose *values* embed server-assigned identity the
+    # recursive key scrub cannot see: observed-mesh is a JSON string of
+    # pod-name -> pod uid, so with the pod plane on it would smuggle
+    # uuid4 output past _SCRUB_KEYS and break byte-identical replay.
+    _SCRUB_KEY_SUFFIXES = ("/observed-mesh",)
 
     def _scrub(self, obj):
         if isinstance(obj, dict):
             return {
                 k: self._scrub(v) for k, v in obj.items()
                 if k not in self._SCRUB_KEYS
+                and not k.endswith(self._SCRUB_KEY_SUFFIXES)
             }
         if isinstance(obj, list):
             return [self._scrub(v) for v in obj]
@@ -637,9 +720,23 @@ class Soak:
             }
         return {"steady_state_green": green, "replicas": per_replica}
 
-    def run(self) -> dict:
+    def _drive(self) -> None:
+        """The main loop (a hook: the fleet storm wraps these ticks in
+        the real ``run_with_checkpointing`` so its cadence consult
+        sees the live alert state)."""
         for tick in range(self.ticks):
             self._tick(tick)
+
+    def _digest_extras(self) -> dict:
+        """Extra replay-covered payload keys (subclass hook)."""
+        return {}
+
+    def _summary_extras(self) -> dict:
+        """Extra summary keys, merged last (subclass hook)."""
+        return {}
+
+    def run(self) -> dict:
+        self._drive()
         drain_rounds = self._drain()
         self._cooldown()
         slo = self._slo_block()  # judged BEFORE chaos: steady state
@@ -660,10 +757,11 @@ class Soak:
             "violations": len(self.dual_violations),
             "orphans": orphans["count"],
         }
+        digest_payload.update(self._digest_extras())
         digest = hashlib.sha256(
             json.dumps(digest_payload, sort_keys=True).encode()
         ).hexdigest()
-        return {
+        summary = {
             "kind": "soak",
             "seed": self.seed,
             "crs": self.crs,
@@ -691,6 +789,8 @@ class Soak:
             "store_fingerprint": fingerprint,
             "replay_digest": digest,
         }
+        summary.update(self._summary_extras())
+        return summary
 
 
 def run_soak(**kwargs) -> dict:

@@ -1,0 +1,152 @@
+#!/usr/bin/env bash
+# Scenario-world gate for CI (PR 19). Four checks:
+#
+# 1. World tier-1 subset: tests/test_world.py fast set — derived
+#    per-track streams (cross-process constants), the track-isolation
+#    contract (composing a track leaves every other track's jittered
+#    instants byte-identical), correlated-domain loss/repair against a
+#    live pod plane (merged capacity_at, slice_capacity, rebind
+#    refusal until repair), and the game-day + contention digest pins
+#    proving the builder refactor replayed their exact draw order —
+#    plus the py-shared-rng-stream rule fixtures in
+#    tests/test_analysis.py.
+#
+# 2. Composition smoke: a tiny composed world must fire its domain
+#    pair, merge the pool view, and leave the bare world's instants
+#    untouched.
+#
+# 3. Analysis: chaos/ + loadtest/ hold ZERO findings under every pack
+#    — including the new py-shared-rng-stream rule — and the full
+#    kubeflow_tpu package stays clean.
+#
+# 4. RUN_SLOW=1: loadtest/fleet_storm.py --crs 10000 via the CLI (its
+#    exit code gates storm_problems_in: all four actuator families
+#    fired incl. the rack-veto/allow elastic arc, alerts resolved,
+#    domain loss+repair with pod casualties, quota-gamers refused by
+#    quota, byte-identical replay digest) and the JSON artifact is
+#    asserted.
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== world gate: scenario-world tier-1 subset =="
+python -m pytest -q -p no:cacheprovider -m 'not slow' \
+  tests/test_world.py \
+  "tests/test_analysis.py::TestSharedRngStreamRule"
+
+echo "== world gate: composition smoke =="
+python - <<'PY'
+from kubeflow_tpu.chaos import WorldBuilder
+
+def base():
+    return (WorldBuilder(seed=4, ticks=20, tick_s=30.0)
+            .capacity(0.0, 32)
+            .domains(4)
+            .domain_loss(0.3, domain=1, chips=8, jitter_s=15.0)
+            .domain_repair(0.7, domain=1, jitter_s=15.0))
+
+bare = base().build()
+composed = (base()
+            .traffic("wave", 0.1, 0.5, ttft_s=10.0)
+            .api_blackout(0.4, 0.6, ops_per_tick=2)
+            .build())
+assert composed.instants()["domains"] == bare.instants()["domains"], \
+    "composing traffic/api tracks moved the domain instants"
+assert composed.instants()["capacity"] == bare.instants()["capacity"]
+
+class _Sim:
+    def __init__(self):
+        self.lost_domains = set()
+        self.domain_of = None
+    def _is_bound(self, pod):
+        return False
+
+class _Injector:
+    class api:
+        @staticmethod
+        def list(*a, **k):
+            return []
+    @staticmethod
+    def preempt_pod(ns, name):
+        return None
+    @staticmethod
+    def recover_node(node):
+        pass
+    @staticmethod
+    def apply_capacity(schedule, now_s, sim):
+        pass
+
+sim = _Sim()
+world = composed
+assert world.capacity_at(0.0) == 32
+fired = world.apply_domains(world.duration_s, _Injector, sim)
+assert [f["kind"] for f in fired] == ["domain_loss", "domain_repair"]
+assert world.capacity_at(world.duration_s) == 32
+assert world.lost_domains() == frozenset()
+print("  composed world: domain pair fired, pool view merged, "
+      "instants isolated")
+PY
+
+echo "== world gate: zero analysis findings (all packs) =="
+python - <<'PY'
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+
+for scope in (["kubeflow_tpu/chaos", "loadtest"], ["kubeflow_tpu"]):
+    findings = analyze_paths(AnalysisConfig(
+        paths=scope, check_emitted=False,
+    ))
+    if findings:
+        for f in findings:
+            print(f.render())
+        raise SystemExit(
+            f"{len(findings)} finding(s) in {scope} under the full "
+            "pack set (incl. py-shared-rng-stream)"
+        )
+print("  chaos/ + loadtest/ + kubeflow_tpu/: zero findings, all packs")
+PY
+
+echo "== world gate: no new Pack C pragma budget =="
+if grep -rn "analysis: allow\[det-" kubeflow_tpu/chaos loadtest; then
+  echo "Pack C pragmas are not allowed in chaos/ or loadtest/ — fix" \
+    "the determinism hazard instead of annotating it" >&2
+  exit 1
+fi
+echo "  zero det-* pragmas in chaos/ + loadtest/"
+
+if [[ "${RUN_SLOW:-0}" == "1" ]]; then
+  echo "== world gate: composed fleet storm (10k CRs, rack loss) =="
+  artifact="${STORM_SUMMARY_JSON:-storm-summary.json}"
+  python -m loadtest.fleet_storm --crs 10000 --ticks 300 \
+    --dump-dir . | tee "$artifact"
+  python - "$artifact" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    doc = json.loads(fh.read().strip().splitlines()[-1])
+assert doc["kind"] == "fleet_storm", doc
+assert doc["created"] >= 10000
+assert doc["dual_leader_reconciles"] == 0
+assert doc["orphans"]["count"] == 0
+assert doc["slo"]["steady_state_green"] is True
+assert doc["actuators_fired"] == [
+    "checkpoint-cadence", "elastic-promotion",
+    "gateway-admission", "inference-scale",
+]
+assert doc["alerts_unresolved"] == []
+assert [e["kind"] for e in doc["domain_log"]] \
+    == ["domain_loss", "domain_repair"]
+assert doc["domain_log"][0]["pods"] >= 1
+assert doc["elastic"]["gate_vetoes"] >= 1
+assert doc["elastic"]["gate_allows"] >= 1
+assert doc["quota"]["refused"] == doc["quota"]["gamers"] >= 1
+assert doc["replay_digest"]
+print(f"  storm artifact ok: {doc['counters']}, "
+      f"elastic {doc['elastic']['shapes']}, "
+      f"digest {doc['replay_digest'][:12]}…")
+PY
+fi
+
+echo "world gate OK"

@@ -1,0 +1,33 @@
+"""Seeded violation: a timeline builder whose fluent track methods all
+jitter off ONE ``random.Random`` — the shared-stream hazard
+py-shared-rng-stream exists for. Because the draws interleave in call
+order, adding a capacity dip shifts every traffic wave's instants: the
+composition surface leaks entropy between tracks and byte-identical
+replay dies the moment a scenario gains a track."""
+
+import random
+
+
+class CoupledTimeline:
+    """Every track draws its jitter from the same stream."""
+
+    def __init__(self, seed: int):
+        # Violation: one stream, many fluent drawers.
+        self._rng = random.Random(seed)
+        self.instants = {"traffic": [], "capacity": [], "faults": []}
+
+    def traffic(self, at_s: float, jitter_s: float):
+        self.instants["traffic"].append(
+            at_s + self._rng.uniform(-jitter_s, jitter_s)
+        )
+        return self
+
+    def capacity(self, at_s: float, jitter_s: float):
+        self.instants["capacity"].append(
+            at_s + self._rng.uniform(-jitter_s, jitter_s)
+        )
+        return self
+
+    def fault(self, at_s: float, spread_s: float):
+        self.instants["faults"].append(at_s + self._rng.random() * spread_s)
+        return self

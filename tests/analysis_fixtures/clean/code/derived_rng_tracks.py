@@ -1,0 +1,69 @@
+"""Clean counterpart of shared_rng_tracks.py: each track derives its
+own private stream from (seed, track-name) — the
+``chaos.world.derive_stream`` discipline — so composing tracks never
+moves another track's instants. Also the two shapes the rule must stay
+quiet on: a single fluent drawer (one stream, one track) and the
+FaultSchedule shape (one stream shared by *query* methods that are
+draw-indexed by construction, not a composition surface)."""
+
+import hashlib
+import random
+
+
+def _stream(seed: int, track: str) -> random.Random:
+    digest = hashlib.sha256(f"{seed}:{track}".encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+class DerivedTimeline:
+    """Per-track private streams: track order cannot leak entropy."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.instants = {"traffic": [], "capacity": []}
+
+    def traffic(self, at_s: float, jitter_s: float):
+        rng = _stream(self.seed, "traffic")
+        self.instants["traffic"].append(
+            at_s + rng.uniform(-jitter_s, jitter_s)
+        )
+        return self
+
+    def capacity(self, at_s: float, jitter_s: float):
+        rng = _stream(self.seed, "capacity")
+        self.instants["capacity"].append(
+            at_s + rng.uniform(-jitter_s, jitter_s)
+        )
+        return self
+
+
+class SingleTrackTimeline:
+    """One fluent drawer is a private stream, not a shared one."""
+
+    def __init__(self, seed: int):
+        self._rng = random.Random(seed)
+        self.marks = {"points": []}
+
+    def mark(self, at_s: float, jitter_s: float):
+        self.marks["points"].append(
+            at_s + self._rng.uniform(-jitter_s, jitter_s)
+        )
+        return self
+
+    def describe(self) -> dict:
+        return {"points": list(self.marks["points"])}
+
+
+class QueryFaults:
+    """The FaultSchedule shape: non-fluent op-indexed queries may share
+    one stream — every caller advances it the same way on replay."""
+
+    def __init__(self, seed: int, rate: float):
+        self._rng = random.Random(seed)
+        self.rate = rate
+
+    def fault_for(self, op: int) -> bool:
+        return self._rng.random() < self.rate
+
+    def next_watch_action(self) -> bool:
+        return self._rng.random() < self.rate

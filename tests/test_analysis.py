@@ -431,6 +431,140 @@ class TestUnboundedDequeRule:
         ) == 1
 
 
+class TestSharedRngStreamRule:
+    """py-shared-rng-stream: one __init__-built random.Random drawn
+    from by two or more fluent builder methods gates; private
+    per-track streams, single drawers, non-fluent query pairs and
+    pragma'd deliberate sharing stay quiet (PR 19 — the scenario-world
+    DSL's per-track stream discipline)."""
+
+    def test_seeded_violation_found(self, bad_findings):
+        (f,) = at(bad_findings, "py-shared-rng-stream",
+                  "shared_rng_tracks.py")
+        assert f.line == 16
+        assert f.severity == Severity.WARNING
+        assert "derive_stream" in f.message
+        assert "capacity, fault, traffic" in f.message
+
+    def _findings(self, source, path="kubeflow_tpu/chaos/timeline.py"):
+        from kubeflow_tpu.analysis.ast_rules import analyze_python_source
+
+        return [
+            f for f in analyze_python_source(source, path)
+            if f.rule == "py-shared-rng-stream"
+        ]
+
+    def test_two_fluent_drawers_fire(self):
+        src = (
+            "import random\n"
+            "class B:\n"
+            "    def __init__(self, seed):\n"
+            "        self.rng = random.Random(seed)\n"
+            "    def a(self, j):\n"
+            "        self.x = self.rng.uniform(-j, j)\n"
+            "        return self\n"
+            "    def b(self, j):\n"
+            "        self.y = self.rng.random() * j\n"
+            "        return self\n"
+        )
+        (f,) = self._findings(src)
+        assert f.line == 4
+        assert "2 fluent builder methods" in f.message
+
+    def test_from_import_alias_fires(self):
+        src = (
+            "from random import Random\n"
+            "class B:\n"
+            "    def __init__(self, seed):\n"
+            "        self.rng = Random(seed)\n"
+            "    def a(self):\n"
+            "        self.x = self.rng.random()\n"
+            "        return self\n"
+            "    def b(self):\n"
+            "        self.y = self.rng.random()\n"
+            "        return self\n"
+        )
+        assert len(self._findings(src)) == 1
+
+    def test_single_fluent_drawer_is_clean(self):
+        # One drawer IS a private stream; nothing else can interleave.
+        src = (
+            "import random\n"
+            "class B:\n"
+            "    def __init__(self, seed):\n"
+            "        self.rng = random.Random(seed)\n"
+            "    def a(self, j):\n"
+            "        self.x = self.rng.uniform(-j, j)\n"
+            "        return self\n"
+            "    def describe(self):\n"
+            "        return {'x': self.x}\n"
+        )
+        assert self._findings(src) == []
+
+    def test_non_fluent_query_pair_is_clean(self):
+        # The FaultSchedule shape: op-indexed queries, not builders.
+        src = (
+            "import random\n"
+            "class Sched:\n"
+            "    def __init__(self, seed):\n"
+            "        self._rng = random.Random(seed)\n"
+            "    def fault_for(self, op):\n"
+            "        return self._rng.random() < 0.5\n"
+            "    def next_watch_action(self):\n"
+            "        return self._rng.random() < 0.5\n"
+        )
+        assert self._findings(src) == []
+
+    def test_derived_per_call_streams_are_clean(self):
+        # No __init__-built Random at all: nothing to share.
+        src = (
+            "import random\n"
+            "class B:\n"
+            "    def __init__(self, seed):\n"
+            "        self.seed = seed\n"
+            "    def a(self, j):\n"
+            "        rng = random.Random(self.seed ^ 1)\n"
+            "        self.x = rng.uniform(-j, j)\n"
+            "        return self\n"
+            "    def b(self, j):\n"
+            "        rng = random.Random(self.seed ^ 2)\n"
+            "        self.y = rng.uniform(-j, j)\n"
+            "        return self\n"
+        )
+        assert self._findings(src) == []
+
+    def test_pragma_escape_hatch(self, tmp_path):
+        src = (
+            "import random\n"
+            "class B:\n"
+            "    def __init__(self, seed):\n"
+            "        # analysis: allow[py-shared-rng-stream]\n"
+            "        self.rng = random.Random(seed)\n"
+            "    def a(self):\n"
+            "        self.x = self.rng.random()\n"
+            "        return self\n"
+            "    def b(self):\n"
+            "        self.y = self.rng.random()\n"
+            "        return self\n"
+        )
+        target = tmp_path / "pragma_rng.py"
+        target.write_text(src)
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert [f for f in findings
+                if f.rule == "py-shared-rng-stream"] == []
+        target.write_text(src.replace(
+            "        # analysis: allow[py-shared-rng-stream]\n", ""
+        ))
+        findings = analyze_paths(
+            AnalysisConfig(paths=[str(target)], check_emitted=False)
+        )
+        assert len(
+            [f for f in findings if f.rule == "py-shared-rng-stream"]
+        ) == 1
+
+
 class TestUnboundedActuationRule:
     """py-unbounded-actuation: registered alert callbacks performing
     API writes or scaling must keep a rate-limit/hysteresis guard in

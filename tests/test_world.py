@@ -1,0 +1,356 @@
+"""The scenario-world contract (PR 19): per-track derived streams, the
+track-isolation property (composing a track never moves another
+track's instants), the merged capacity/correlated-domain view, and the
+pinned replay digests of every harness on the builder — game day,
+contention, soak, and the composed fleet storm.
+
+Digest pins here are HARDCODED hex, not run-twice comparisons: a
+second in-process run shares the interpreter's hash seed, so only a
+cross-process constant catches PYTHONHASHSEED-dependent iteration or
+entropy (uuid4 in an annotation value) leaking into a digest — the
+exact regression class the fleet storm's pod plane hit first.
+"""
+
+import pytest
+
+from kubeflow_tpu.chaos import (
+    Clock,
+    PreemptionInjector,
+    StatefulSetPodSimulator,
+    TenantMix,
+    WorldBuilder,
+    derive_stream,
+)
+from kubeflow_tpu.chaos.harness import clamp_backoff, run_to_convergence
+from kubeflow_tpu.controllers.notebook import make_notebook_controller
+from kubeflow_tpu.k8s.fake import FakeApiServer
+
+from tests.test_chaos import chaos_notebook
+
+# The pinned digests. Each is (parameters) -> sha256 over the sorted
+# JSON digest payload; wall-clock measurements are excluded by
+# construction, so these must survive any machine and any hash seed.
+#
+# game_day/contention: unchanged by the world refactor — the builder
+# replays the exact draw order their pre-world scripts made.
+GAME_DAY_DIGEST = (
+    "6b3823cc8dfa0db2e985e1f0c578e5fb198a64109f23908c0d3be043c08bb7ff"
+)
+CONTENTION_DIGEST = (
+    "4d824840cbba4b1535b18b9b1d5901b23af2bd5815ef1c633bfcb50602e1d52f"
+)
+# soak: RE-BASELINED in PR 19. The churn stream moved from the
+# harness-global random.Random(seed) to the world's derived
+# "tenants" track (derive_stream hashes seed+track, so the sequence
+# differs from random.Random(11) by design); op-mix selection moved to
+# declaration-ordered cumulative thresholds. Same contract, new bytes.
+SOAK_DIGEST = (
+    "13062e9b7bf5c3b3f0e9ad4f4e45c56d864182185f39cd95aac7ca6c8ad10da8"
+)
+# fleet storm: first pin (harness is new in PR 19).
+STORM_DIGEST = (
+    "270ceb22ae6828c3a96527eb926d0521f50dbde8952fb452d200b87050ccb6a4"
+)
+
+
+# ---------------------------------------------------------------------------
+# derived streams
+# ---------------------------------------------------------------------------
+
+
+class TestDeriveStream:
+    def test_pure_function_of_seed_and_track(self):
+        a = [derive_stream(7, "traffic").random() for _ in range(3)]
+        b = [derive_stream(7, "traffic").random() for _ in range(3)]
+        assert a == b
+
+    def test_tracks_are_independent_streams(self):
+        t = derive_stream(7, "traffic").random()
+        c = derive_stream(7, "capacity").random()
+        assert t != c
+
+    def test_seed_matters(self):
+        assert (derive_stream(1, "traffic").random()
+                != derive_stream(2, "traffic").random())
+
+    def test_cross_process_constant(self):
+        # sha256-keyed derivation: stable across interpreters and hash
+        # seeds (the salted builtin hash would make this flaky).
+        assert round(derive_stream(0, "traffic").random(), 12) \
+            == 0.046401910495
+        assert round(derive_stream(0, "capacity").random(), 12) \
+            == 0.076085486917
+
+    def test_world_stream_is_stable_per_track(self):
+        world = WorldBuilder(seed=5, ticks=10).build()
+        rng = world.stream("tenants")
+        assert world.stream("tenants") is rng  # one stream per run
+        fresh = WorldBuilder(seed=5, ticks=10).build()
+        assert fresh.stream("tenants").random() == \
+            derive_stream(5, "tenants").random()
+
+
+# ---------------------------------------------------------------------------
+# track isolation — the composition contract
+# ---------------------------------------------------------------------------
+
+
+def _base_builder(seed=9):
+    return (
+        WorldBuilder(seed=seed, ticks=100, tick_s=30.0)
+        .capacity(0.0, 64)
+        .capacity(0.4, 48, jitter_s=45.0)
+        .capacity_restore(0.8, jitter_s=45.0)
+        .domains(4)
+        .domain_loss(0.5, domain=1, chips=16, jitter_s=45.0)
+        .domain_repair(0.7, domain=1, jitter_s=45.0)
+    )
+
+
+class TestTrackIsolation:
+    def test_composing_tracks_leaves_other_instants_byte_identical(self):
+        bare = _base_builder().build().instants()
+        composed = (
+            _base_builder()
+            .traffic("wave", 0.1, 0.3, ttft_s=20.0, itl_s=0.05)
+            .api_blackout(0.55, 0.65, ops_per_tick=4)
+            .tenants("churn", namespaces=("ns-0",),
+                     topologies=(("2x2", 4),), priorities=(100,),
+                     weights={"create": 0.2})
+            .arrival(0.2, "notebook", "ns-0", "scripted", "2x2")
+            .build()
+            .instants()
+        )
+        # The new tracks appear...
+        assert composed["traffic"] == [["wave", 10, 30]]
+        assert composed["api"] == [["blackout", 220, 260]]
+        # ...and every pre-existing track's jittered instants stay put.
+        assert composed["capacity"] == bare["capacity"]
+        assert composed["domains"] == bare["domains"]
+
+    def test_same_track_draws_are_declaration_ordered(self):
+        # Within ONE track, adding an event may shift later draws of
+        # that same track — that is the documented stream discipline,
+        # not a violation. Other tracks still must not move.
+        one = _base_builder().build().instants()
+        two = (_base_builder()
+               .domain_loss(0.9, domain=2, chips=16, jitter_s=45.0)
+               .build().instants())
+        assert two["domains"][:2] == one["domains"][:2]
+        assert len(two["domains"]) == 3
+        assert two["capacity"] == one["capacity"]
+
+    def test_seed_moves_every_jittered_instant(self):
+        a = _base_builder(seed=9).build().instants()
+        b = _base_builder(seed=10).build().instants()
+        assert a["capacity"] != b["capacity"]
+        assert a["domains"] != b["domains"]
+
+    def test_manifest_is_replay_stable(self):
+        assert _base_builder().build().manifest() == \
+            _base_builder().build().manifest()
+
+    def test_traffic_window_is_half_open_in_ticks(self):
+        world = (WorldBuilder(seed=1, ticks=10, tick_s=30.0)
+                 .traffic("wave", 0.2, 0.5).build())
+        assert world.traffic_active(1) == ()
+        assert [p.name for p in world.traffic_active(2)] == ["wave"]
+        assert [p.name for p in world.traffic_active(4)] == ["wave"]
+        assert world.traffic_active(5) == ()
+
+    def test_tenant_thresholds_are_cumulative_in_declaration_order(self):
+        mix = TenantMix(
+            name="m", namespaces=("a",), topologies=(("2x2", 4),),
+            priorities=(0,),
+            weights=(("create", 0.15), ("delete", 0.13), ("touch", 0.1)),
+        )
+        assert mix.thresholds() == (
+            ("create", 0.15), ("delete", 0.28),
+            ("touch", pytest.approx(0.38)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# correlated domains against a live pod plane
+# ---------------------------------------------------------------------------
+
+
+class TestCorrelatedDomains:
+    def _world(self):
+        return (
+            WorldBuilder(seed=3, ticks=100, tick_s=30.0)
+            .capacity(0.0, 64)
+            .domains(4)
+            .domain_loss(0.25, domain=1, chips=16)
+            .domain_repair(0.75, domain=1)
+            .build()
+        )
+
+    def _setup(self):
+        api = FakeApiServer()
+        ctrl = make_notebook_controller(api)
+        clamp_backoff(ctrl)
+        sim = StatefulSetPodSimulator(api)
+        injector = PreemptionInjector(api, sleep=lambda s: None)
+        api.create(chaos_notebook(
+            "mesh", tpu={"accelerator": "v5e", "topology": "4x4"}
+        ))
+        run_to_convergence([ctrl], [sim])
+        return api, ctrl, sim, injector
+
+    def test_loss_kills_exactly_the_rack_and_capacity_merges(self):
+        api, ctrl, sim, injector = self._setup()
+        world = self._world()
+        assert world.capacity_at(0.0) == 64
+
+        fired = world.apply_domains(0.25 * world.duration_s + 1.0,
+                                    injector, sim)
+        assert [f["kind"] for f in fired] == ["domain_loss"]
+        assert fired[0]["pods"] == 1  # worker-1 of the one 4-host slice
+        assert world.lost_domains() == frozenset({1})
+        # Merged pool view: base weather minus the lost rack.
+        assert world.capacity_at(0.3 * world.duration_s) == 48
+        # Per-slice view: the 4-host slice lost one 4-chip worker.
+        assert world.slice_capacity(16, 4) == 12
+        # Single-host slices never touch rack 1's ordinal.
+        assert world.slice_capacity(4, 1) == 4
+
+        # The simulator refuses to rebind onto the lost rack: the
+        # controller recreates the pod set but worker-1 stays Pending.
+        run_to_convergence([ctrl], [sim])
+        pods = {
+            p["metadata"]["name"]: p
+            for p in api.list("v1", "Pod", namespace="user")
+        }
+        pending = [
+            name for name, p in pods.items()
+            if (p.get("status") or {}).get("phase") == "Pending"
+        ]
+        assert any(name.endswith("-1") for name in pending)
+
+    def test_repair_restores_pool_and_rebinds(self):
+        api, ctrl, sim, injector = self._setup()
+        world = self._world()
+        world.apply_domains(0.25 * world.duration_s + 1.0, injector, sim)
+        fired = world.apply_domains(0.75 * world.duration_s + 1.0,
+                                    injector, sim)
+        assert [f["kind"] for f in fired] == ["domain_repair"]
+        assert world.lost_domains() == frozenset()
+        assert world.capacity_at(0.8 * world.duration_s) == 64
+        assert world.slice_capacity(16, 4) == 16
+        run_to_convergence([ctrl], [sim])
+        phases = [
+            (p.get("status") or {}).get("phase")
+            for p in api.list("v1", "Pod", namespace="user")
+        ]
+        assert phases == ["Running"] * 4
+        # The fired record is the digestable log, in order.
+        assert [e["kind"] for e in world.domain_log] == \
+            ["domain_loss", "domain_repair"]
+
+    def test_domain_of_parses_trailing_ordinal(self):
+        world = self._world()
+        assert world.domain_of("tpu-node-mesh-0") == 0
+        assert world.domain_of("tpu-node-mesh-5") == 1
+        assert world.domain_of("not-a-node") is None
+
+
+# ---------------------------------------------------------------------------
+# pinned harness digests
+# ---------------------------------------------------------------------------
+
+
+class TestPinnedDigests:
+    def test_game_day_digest_unchanged_by_world_refactor(self, tmp_path):
+        from loadtest.game_day import run_game_day
+
+        summary = run_game_day(seed=7, hours=5.0,
+                               dump_dir=str(tmp_path))
+        assert summary["alerts_unresolved"] == []
+        assert summary["replay_digest"] == GAME_DAY_DIGEST
+
+    def test_contention_digest_unchanged_by_world_refactor(self):
+        from loadtest.contention import problems_in, run_contention
+
+        summary = run_contention(seed=3, ticks=96)
+        assert problems_in(summary) == []
+        assert summary["replay_digest"] == CONTENTION_DIGEST
+
+    @pytest.mark.slow
+    def test_soak_digest_rebaselined_on_derived_streams(self, tmp_path):
+        from loadtest.soak import Soak, problems_in
+
+        summary = Soak(crs=80, ticks=50, shards=4, replicas=2,
+                       dump_dir=str(tmp_path)).run()
+        assert problems_in(summary) == []
+        assert summary["replay_digest"] == SOAK_DIGEST
+
+
+# ---------------------------------------------------------------------------
+# the composed storm
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def storm_summary(tmp_path_factory):
+    from loadtest.fleet_storm import FleetStorm
+
+    return FleetStorm(
+        crs=80, ticks=300, tick_s=60.0,
+        dump_dir=str(tmp_path_factory.mktemp("storm")),
+    ).run()
+
+
+@pytest.mark.slow
+class TestFleetStorm:
+    def test_replay_digest_pinned(self, storm_summary):
+        assert storm_summary["replay_digest"] == STORM_DIGEST
+
+    def test_acceptance_gate_is_green(self, storm_summary):
+        from loadtest.fleet_storm import storm_problems_in
+
+        assert storm_problems_in(storm_summary) == []
+
+    def test_all_four_actuator_families_fired(self, storm_summary):
+        assert storm_summary["actuators_fired"] == [
+            "checkpoint-cadence", "elastic-promotion",
+            "gateway-admission", "inference-scale",
+        ]
+
+    def test_admission_tightened_and_restored(self, storm_summary):
+        admission = storm_summary["admission"]
+        assert admission["min_max_pending"] \
+            < admission["initial_max_pending"]
+        assert admission["final_max_pending"] \
+            == admission["initial_max_pending"]
+
+    def test_rack_loss_and_repair_both_fired_with_casualties(
+            self, storm_summary):
+        kinds = [e["kind"] for e in storm_summary["domain_log"]]
+        assert kinds == ["domain_loss", "domain_repair"]
+        assert storm_summary["domain_log"][0]["pods"] >= 1
+
+    def test_elastic_arc_degrades_probes_and_recovers(
+            self, storm_summary):
+        elastic = storm_summary["elastic"]
+        shapes = elastic["shapes"]
+        assert shapes[0] is None and shapes[-1] is None
+        assert any(s is not None for s in shapes)
+        # The rack outage must have forced at least one gate veto AND
+        # the recovery at least one allow — the gate as an actuator,
+        # not a rubber stamp.
+        assert elastic["gate_vetoes"] >= 1
+        assert elastic["gate_allows"] >= 1
+
+    def test_adversarial_tenants_hit_quota_not_capacity(
+            self, storm_summary):
+        quota = storm_summary["quota"]
+        assert quota["gamers"] >= 1
+        assert quota["refused"] == quota["gamers"]
+
+    def test_seed_moves_the_digest(self, storm_summary, tmp_path):
+        from loadtest.fleet_storm import FleetStorm
+
+        other = FleetStorm(seed=12, crs=80, ticks=300, tick_s=60.0,
+                           dump_dir=str(tmp_path)).run()
+        assert other["replay_digest"] != storm_summary["replay_digest"]
