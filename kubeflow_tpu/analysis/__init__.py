@@ -1,6 +1,6 @@
 """Platform-wide static analysis.
 
-Six rule packs over the repo tree, sharing one findings model, one
+Seven rule packs over the repo tree, sharing one findings model, one
 per-scan parse cache (each file is ``ast.parse``d once, for every
 pack), one interprocedural summary engine, and one CLI
 (``python -m kubeflow_tpu.analysis``):
@@ -34,6 +34,17 @@ pack), one interprocedural summary engine, and one CLI
   emission (errors in replay-gated trees), unseeded module-level RNG
   draws; taint crosses helper and module boundaries via the
   ``param→sink`` halves of the same summaries.
+- :mod:`kernel_rules` — accelerator hazards (Pack D): Pallas launch
+  contracts against statically-known dims (non-divisor blocks whose
+  tail is never written or never masked, index-map arity vs grid rank
+  incl. scalar prefetch, operand counts, double-buffered VMEM budget
+  vs :func:`kubeflow_tpu.topology.min_vmem_bytes` with real call-site
+  dims threaded through the summaries — an unknowable dim reports
+  ``krn-vmem-proxy-dim`` instead of silently passing), buffer-donation
+  aliasing (reads after a ``donate_argnums`` call on any CFG path;
+  background threads capturing a zero-copy view of a caller argument,
+  join-aware), and int8 scale flow (scale skipped before the dtype
+  round, unmasked ragged-tail reductions over scaled operands).
 
 Findings carry (rule, severity, file:line, message). Two suppression
 mechanisms keep the gate green without hiding regressions: an inline

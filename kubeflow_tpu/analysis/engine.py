@@ -26,6 +26,7 @@ from kubeflow_tpu.analysis import (
     ast_rules,
     concurrency_rules,
     determinism_rules,
+    kernel_rules,
     manifest_rules,
     mesh_rules,
     spmd_rules,
@@ -194,6 +195,9 @@ def analyze_paths(config: AnalysisConfig) -> list[Finding]:
                     determinism_rules.analyze_python_determinism(
                         text, rel, context
                     )
+                file_findings += kernel_rules.analyze_python_kernels(
+                    text, rel, context
+                )
         elif path.endswith((".yaml", ".yml")):
             # Kustomize reference checks resolve against the real
             # directory, so the manifest pack gets absolute paths and

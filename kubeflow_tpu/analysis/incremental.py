@@ -116,15 +116,40 @@ def _module_names(path: str, roots: list[str]) -> list[str]:
     return out
 
 
+def _dotted_chain(node: ast.AST) -> list[str] | None:
+    """``pkg.kernels.launch`` → ["pkg", "kernels", "launch"]; None for
+    anything that isn't a pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
 def _imported_modules(tree: ast.AST, own_package: str) -> set[str]:
-    """Dotted modules this tree imports. ``from pkg import name``
-    contributes both ``pkg`` and ``pkg.name`` (name may be a module);
-    relative imports resolve against ``own_package``."""
+    """Dotted modules this tree imports OR reaches by attribute walk.
+    ``from pkg import name`` contributes both ``pkg`` and ``pkg.name``
+    (name may be a module); relative imports resolve against
+    ``own_package``. Deep dotted use — ``import pkg`` (or ``as p``)
+    followed by ``pkg.kernels.launch(...)`` — reaches ``pkg.kernels``
+    with no import statement naming it, yet interprocedural summaries
+    thread this file's analysis through that module: every dotted
+    prefix under a plain-imported root counts as a dependency (bogus
+    prefixes are harmless — they resolve to no file)."""
     out: set[str] = set()
+    import_roots: dict[str, str] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
                 out.add(alias.name)
+                bound = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                import_roots[bound] = target
         elif isinstance(node, ast.ImportFrom):
             if node.level:
                 parts = own_package.split(".") if own_package else []
@@ -140,6 +165,18 @@ def _imported_modules(tree: ast.AST, own_package: str) -> set[str]:
             for alias in node.names:
                 if base and alias.name != "*":
                     out.add(f"{base}.{alias.name}")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        parts = _dotted_chain(node)
+        if parts is None or len(parts) < 2:
+            continue
+        target = import_roots.get(parts[0])
+        if target is None:
+            continue
+        parts = target.split(".") + parts[1:]
+        for end in range(2, len(parts) + 1):
+            out.add(".".join(parts[:end]))
     return out
 
 

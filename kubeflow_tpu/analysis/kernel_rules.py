@@ -1,0 +1,1829 @@
+"""Pack D — accelerator hazards: Pallas kernel contracts, buffer
+donation aliasing, and int8 scale flow.
+
+Every accelerator-side bug this repo has shipped was statically
+visible at the call site. PR 8's ``qkv_rope_block`` picked non-divisor
+block widths that left tail output columns unwritten and budgeted VMEM
+from a ``k=4096`` proxy instead of the real tile; its ragged-tail
+scale lanes needed NaN×0 masking. PR 4's ``save_async`` serialized a
+donated buffer the next train step was overwriting. These rules pin
+that whole class before the paged-KV work stresses it:
+
+- ``krn-index-map-arity`` (error): a BlockSpec index map whose
+  parameter count does not match the grid rank (plus the
+  scalar-prefetch operands under ``PrefetchScalarGridSpec`` — they
+  arrive AFTER the grid indices).
+- ``krn-operand-arity`` (error): the kernel function's positional ref
+  count disagrees with prefetch + in_specs + outputs + scratch, or the
+  ``pallas_call(...)(...)`` argument count disagrees with the specs.
+  Only checked when both sides are statically exact (no ``*rest``
+  varargs, no conditionally-appended spec lists).
+- ``krn-block-nondivisor`` (error): a block dim that does not divide
+  the statically-known output dim. A floor-div grid never visits the
+  tail (columns stay unwritten — the PR 8 bug — no mask can fix that);
+  a ceil-div grid's ragged tail block needs an in-kernel
+  ``pl.when``/``jnp.where`` mask or an explicit pragma.
+- ``krn-vmem-budget`` (error): resident block bytes (double-buffered
+  in/out blocks + scratch) exceed the per-core VMEM cap from
+  :mod:`kubeflow_tpu.topology` (``min_vmem_bytes()``). Dims are
+  evaluated from real values only — module constants, straight-line
+  locals, and actual call-site arguments threaded through the
+  per-module kernel summaries. Parameter DEFAULTS never bind at the
+  definition site: a default is exactly the ``k=4096`` proxy that
+  hid the PR 8 budget bug.
+- ``krn-vmem-proxy-dim`` (warning): the budget is unknowable at the
+  definition site (a dim never resolves) AND no dynamic budget guard
+  is in scope — a comparison of a tile-size product against a byte
+  cap, the ``gemv._pick_block`` idiom, either in the calling function
+  or in the helper that produced the block width. Unknowable dims must
+  be guarded at trace time or pragma'd, never silently passed.
+- ``don-read-after-donate`` (error): an argument passed at a
+  ``jax.jit(..., donate_argnums=/donate_argnames=)`` call site is
+  read again on a path after the call without rebinding. Donation
+  hands the buffer to XLA; the old binding may alias freed or
+  overwritten device memory. Donating callables are indexed per module
+  (direct ``jit`` bindings, ``self._step``-style attributes, and
+  factories whose return is a donating ``jit``).
+- ``don-thread-capture`` (error): a background thread/closure (the
+  Pack B thread-entry shapes) captures a zero-copy view of an
+  enclosing function's array parameter — the ``save_async`` bug: the
+  caller's contract lets it donate or mutate the buffer the moment the
+  function returns, while the worker still reads it. A forced copy
+  (``np.array(..., copy=True)``, ``.copy()``, ``deepcopy``) breaks
+  the alias chain and is the sanctioned fix (checkpoint ``_snapshot``).
+- ``qnt-scale-skipped`` (error): an int8 payload (a
+  ``_quantize_rows``/``quantize_decode_params``-shaped producer, or a
+  direct ``.astype(int8)``) reaches an accumulation (``dot``/
+  ``dot_general``/``@``/``sum``) and the result hits the dtype round
+  (``.astype``) without the per-row/per-channel scale multiplying in
+  between. W8A16's contract is accumulate f32 → rescale → round.
+- ``qnt-ragged-unmasked`` (warning): inside a Pallas kernel, a value
+  multiplied by a scale operand (``*s_ref``/``*scale*`` refs) feeds a
+  reduction and the kernel contains no ``jnp.where`` mask at all —
+  ragged-tail scale lanes are undefined and ``0 × NaN = NaN`` poisons
+  the accumulation (the decode-attention masking lesson).
+
+Known limits, by design: operand dims resolve only when a shape is
+statically constructible (fixtures, literal call sites) — runtime
+array shapes never resolve, so real wrappers are checked through their
+budget guards instead; donation through a function *parameter* is not
+tracked (the callable's identity is gone); ``req["key"]``-style
+subscript bindings are not donation-tracked. Test trees are exempt;
+the fixture suite under ``tests/analysis_fixtures/*/kernels/`` seeds
+every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+import re
+
+from kubeflow_tpu.analysis.callgraph import thread_entry_names
+from kubeflow_tpu.analysis.dataflow import (
+    dotted_name,
+    import_aliases,
+    is_test_path,
+)
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.topology import min_vmem_bytes
+
+# Per-core cap from topology.py — the single source of truth; a kernel
+# must fit the smallest generation it could be scheduled on.
+VMEM_CAP_BYTES = min_vmem_bytes()
+
+# The Pallas pipeline keeps two revolving buffers per blocked operand.
+_DOUBLE_BUFFER = 2
+
+# Conservative element width when a dtype cannot be resolved (f32).
+_DEFAULT_ITEMSIZE = 4
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+# Names whose value is accepted as a byte cap in a budget-guard
+# comparison even when the constant itself lives in another module.
+_CAP_NAME = re.compile(r"(CAP|BYTES|BUDGET|LIMIT)", re.IGNORECASE)
+
+_QUANT_PRODUCER_SUFFIXES = ("quantize_rows", "quantize_cache")
+_QUANT_PRODUCER_EXACT = ("quantize_decode_params",)
+
+_COPY_CALLS = {
+    "copy", "deepcopy", "copy.copy", "copy.deepcopy",
+    "np.copy", "numpy.copy", "np.array", "numpy.array",
+    "jax.device_get", "pickle.dumps",
+}
+_VIEW_CALLS = {
+    "np.asarray", "numpy.asarray", "jnp.asarray", "jax.numpy.asarray",
+    "np.frombuffer", "numpy.frombuffer", "memoryview",
+}
+_VIEW_METHOD_SUFFIXES = (".view", ".reshape", ".ravel", ".asarray")
+_CONTAINER_CALLS = {"list", "tuple", "sorted", "reversed", "dict"}
+
+_ACCUM_CALLS = {
+    "jnp.dot", "jax.numpy.dot", "np.dot", "numpy.dot",
+    "jnp.matmul", "jax.numpy.matmul",
+    "jax.lax.dot_general", "lax.dot_general", "jnp.einsum",
+    "jnp.sum", "jax.numpy.sum",
+}
+_PASS_CALLS = {
+    "jnp.transpose", "jnp.reshape", "jnp.asarray", "jnp.ravel",
+    "jnp.negative", "jnp.abs", "abs",
+}
+
+# qnt label atoms.
+_PAYLOAD = "payload"
+_SCALE = "scale"
+_UNSCALED = "unscaled"
+_SCALED_OP = "scaled-operand"
+
+
+# ---------------------------------------------------------------------------
+# constant / dim evaluation
+
+
+def _const_eval(node: ast.AST, env: dict):
+    """Evaluate an expression to an int/float/bool using ``env``
+    (name -> value); None when not statically known. Deliberately
+    small: the arithmetic that appears in block/grid computations."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, (int, float, bool)):
+            return node.value
+        return None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp):
+        val = _const_eval(node.operand, env)
+        if val is None:
+            return None
+        if isinstance(node.op, ast.USub):
+            return -val
+        if isinstance(node.op, ast.UAdd):
+            return +val
+        if isinstance(node.op, ast.Not):
+            return not val
+        return None
+    if isinstance(node, ast.BinOp):
+        left = _const_eval(node.left, env)
+        right = _const_eval(node.right, env)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.Mod):
+                return left % right
+            if isinstance(node.op, ast.Pow):
+                if abs(right) > 64:
+                    return None
+                return left ** right
+        except (ZeroDivisionError, TypeError, ValueError):
+            return None
+        return None
+    if isinstance(node, ast.IfExp):
+        test = _const_eval(node.test, env)
+        if test is not None:
+            branch = node.body if test else node.orelse
+            return _const_eval(branch, env)
+        then = _const_eval(node.body, env)
+        other = _const_eval(node.orelse, env)
+        return then if then is not None and then == other else None
+    if isinstance(node, ast.Compare) and len(node.ops) == 1:
+        left = _const_eval(node.left, env)
+        right = _const_eval(node.comparators[0], env)
+        if left is None or right is None:
+            return None
+        op = node.ops[0]
+        table = {
+            ast.Eq: left == right, ast.NotEq: left != right,
+            ast.Lt: left < right, ast.LtE: left <= right,
+            ast.Gt: left > right, ast.GtE: left >= right,
+        }
+        return table.get(type(op))
+    if isinstance(node, ast.BoolOp):
+        vals = [_const_eval(v, env) for v in node.values]
+        if any(v is None for v in vals):
+            return None
+        return all(vals) if isinstance(node.op, ast.And) else any(vals)
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func, {})
+        args = [_const_eval(a, env) for a in node.args]
+        if any(a is None for a in args) or node.keywords:
+            return None
+        try:
+            if fn in ("min", "max") and args:
+                return (min if fn == "min" else max)(args)
+            if fn in ("math.lcm", "lcm") and args:
+                return math.lcm(*[int(a) for a in args])
+            if fn in ("math.gcd", "gcd") and args:
+                return math.gcd(*[int(a) for a in args])
+            if fn == "len" and len(node.args) == 1 and isinstance(
+                node.args[0], (ast.Tuple, ast.List)
+            ):
+                return len(node.args[0].elts)
+            if fn == "int" and len(args) == 1:
+                return int(args[0])
+        except (TypeError, ValueError):
+            return None
+        return None
+    return None
+
+
+def _function_env(fn: ast.FunctionDef | None, base: dict) -> dict:
+    """Straight-line constant environment for a function body over
+    ``base`` (module consts + any param bindings). Loop targets and
+    conditionally-assigned names go unknown (None poisons); provenance
+    of call-produced names is kept for budget-guard detection."""
+    env = dict(base)
+    calls: dict[str, ast.Call] = {}
+    if fn is None:
+        return env
+
+    def assign(target: ast.expr, value: ast.expr | None,
+               known: bool) -> None:
+        if isinstance(target, ast.Name):
+            if not known or value is None:
+                env[target.id] = None
+                return
+            val = _const_eval(value, env)
+            env[target.id] = val
+            if val is None and isinstance(value, ast.Call):
+                calls[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if known and isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(value.elts) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    assign(t, v, True)
+            else:
+                for t in target.elts:
+                    assign(t, None, False)
+
+    def poison(stmts: list[ast.stmt]) -> None:
+        for node in stmts:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = getattr(sub, "targets", None) or \
+                        [sub.target]
+                    for t in targets:
+                        assign(t, None, False)
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    assign(sub.target, None, False)
+
+    def walk(stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    assign(target, stmt.value, True)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                assign(stmt.target, stmt.value, True)
+            elif isinstance(stmt, ast.AugAssign):
+                assign(stmt.target, None, False)
+            elif isinstance(stmt, ast.If):
+                test = _const_eval(stmt.test, env)
+                if test is True:
+                    walk(stmt.body)
+                elif test is False:
+                    walk(stmt.orelse)
+                else:
+                    poison(stmt.body)
+                    poison(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor,
+                                   ast.While)):
+                poison([stmt])
+            elif isinstance(stmt, (ast.With, ast.AsyncWith,
+                                   ast.Try)):
+                poison([stmt])
+    walk(fn.body)
+    env["__calls__"] = calls
+    return env
+
+
+def _module_consts(tree: ast.AST) -> dict:
+    env: dict = {}
+    for stmt in getattr(tree, "body", []):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            env[stmt.targets[0].id] = _const_eval(stmt.value, env)
+    return env
+
+
+def _dtype_bytes(node: ast.AST | None, aliases: dict) -> int | None:
+    """Element width of a dtype expression (``jnp.float32``,
+    ``np.int8``, ``"bfloat16"``); None when unresolvable."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_BYTES.get(node.value)
+    dotted = dotted_name(node, aliases)
+    if dotted:
+        return _DTYPE_BYTES.get(dotted.rsplit(".", 1)[-1])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-module kernel / donation index
+
+
+@dataclasses.dataclass
+class _Spec:
+    """One BlockSpec as written: block-dim expressions (None when the
+    spec carries no shape, e.g. memory_space-only) and the index map."""
+
+    block: list[ast.expr] | None
+    index_arity: int | None
+    index_returns: list[ast.expr] | None  # tuple elts of the map body
+    index_params: list[str]
+    line: int
+
+
+@dataclasses.dataclass
+class _Site:
+    """One ``pl.pallas_call`` site plus everything needed to re-check
+    it under a different parameter binding (a real call site)."""
+
+    call: ast.Call
+    fn: ast.FunctionDef | None     # enclosing function
+    params: list[str]
+    kernel: ast.FunctionDef | None
+    kernel_fixed_args: int | None  # positional params before *varargs
+    kernel_has_vararg: bool
+    kernel_has_mask: bool
+    grid: list[ast.expr] | None
+    prefetch: int
+    in_specs: list[_Spec]
+    in_specs_exact: bool
+    out_specs: list[_Spec]
+    out_shapes: list[tuple[list[ast.expr], int | None]]
+    scratch: list[tuple[list[ast.expr], int | None]]
+    call_arg_count: int | None
+    guarded: bool
+
+
+@dataclasses.dataclass
+class _Donating:
+    argnums: frozenset[int]
+    argnames: frozenset[str]
+    positions_of_names: frozenset[int]
+
+
+@dataclasses.dataclass
+class _ModuleInfo:
+    path: str
+    aliases: dict[str, str]
+    consts: dict
+    functions: dict[str, ast.FunctionDef]
+    sites: list[_Site]
+    sites_by_fn: dict[str, list[_Site]]
+    donating: dict[str, _Donating]      # binding key -> spec
+    factories: dict[str, _Donating]     # local fn name -> returned jit
+    kernel_fns: set[str]
+    thread_entries: set[str]
+
+
+def _is_pallas_call(call: ast.Call, aliases: dict) -> bool:
+    dotted = dotted_name(call.func, aliases)
+    return dotted.rsplit(".", 1)[-1] == "pallas_call"
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _has_cap_guard(fn: ast.FunctionDef | None, consts: dict) -> bool:
+    """True when ``fn`` compares a tile-size expression against a byte
+    cap — the dynamic budget idiom, in both its inline form
+    (``k * bn * itemsize <= CAP``, gemv's ``_pick_block``) and its
+    named form (``tile = 2 * bq * d * item + scratch;
+    if tile > _VMEM_BYTES_CAP``). A tile expression is a +/× tree with
+    a Name leaf; a compared Name resolves one level through its local
+    single assignment."""
+    if fn is None:
+        return False
+
+    assigns: dict[str, ast.expr] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            assigns.setdefault(node.targets[0].id, node.value)
+
+    def is_product_of_names(node: ast.AST) -> bool:
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Mult, ast.Add)):
+            return (is_product_of_names(node.left)
+                    or is_product_of_names(node.right))
+        return isinstance(node, ast.Name)
+
+    def is_tile_expr(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in assigns and \
+                isinstance(assigns[node.id], ast.BinOp):
+            node = assigns[node.id]
+        return isinstance(node, ast.BinOp) and is_product_of_names(node)
+
+    def is_cap(node: ast.AST) -> bool:
+        val = _const_eval(node, consts)
+        if isinstance(val, (int, float)) and val >= 1024:
+            return True
+        if isinstance(node, ast.Name) and _CAP_NAME.search(node.id):
+            return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.Lt, ast.LtE, ast.Gt,
+                                         ast.GtE)):
+            left, right = node.left, node.comparators[0]
+            # Either direction: `tile <= CAP` (select-a-block loop)
+            # and `tile > CAP` (raise-on-over-budget) both guard.
+            if (is_tile_expr(left) and is_cap(right)) or \
+                    (is_cap(left) and is_tile_expr(right)):
+                return True
+    return False
+
+
+def _kernel_has_mask(fn: ast.FunctionDef | None, aliases: dict) -> bool:
+    if fn is None:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func, aliases)
+            tail = dotted.rsplit(".", 1)[-1]
+            if tail in ("where", "when"):
+                return True
+    return False
+
+
+def _lambda_info(node: ast.AST | None,
+                 functions: dict[str, ast.FunctionDef]):
+    """(arity, return-tuple elts, param names) of an index map —
+    a lambda, or a Name resolving to a local def."""
+    if node is None:
+        return None, None, []
+    if isinstance(node, ast.Lambda):
+        params = [a.arg for a in node.args.args]
+        body = node.body
+        elts = list(body.elts) if isinstance(body, ast.Tuple) else [body]
+        return len(params), elts, params
+    if isinstance(node, ast.Name):
+        fn = functions.get(node.id)
+        if fn is not None:
+            params = [a.arg for a in fn.args.args]
+            returns = [s for s in ast.walk(fn)
+                       if isinstance(s, ast.Return) and s.value]
+            elts = None
+            if len(returns) == 1:
+                body = returns[0].value
+                elts = (list(body.elts)
+                        if isinstance(body, ast.Tuple) else [body])
+            return len(params), elts, params
+    return None, None, []
+
+
+def _parse_spec(node: ast.AST,
+                functions: dict[str, ast.FunctionDef]) -> _Spec | None:
+    """A ``pl.BlockSpec(...)`` expression → :class:`_Spec`; None when
+    the node is not a recognizable BlockSpec call."""
+    if isinstance(node, ast.IfExp):
+        # Both arms are specs (gemv's transpose_w selection); arity
+        # checks apply to each — callers expand IfExp before us.
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = dotted_name(node.func, {})
+    if dotted.rsplit(".", 1)[-1] != "BlockSpec":
+        return None
+    block_node = node.args[0] if node.args else _kw(node, "block_shape")
+    index_node = (node.args[1] if len(node.args) > 1
+                  else _kw(node, "index_map"))
+    block = None
+    if isinstance(block_node, (ast.Tuple, ast.List)):
+        block = list(block_node.elts)
+    arity, rets, params = _lambda_info(index_node, functions)
+    return _Spec(block=block, index_arity=arity, index_returns=rets,
+                 index_params=params, line=node.lineno)
+
+
+def _expand_spec_exprs(node: ast.AST) -> list[ast.AST]:
+    """A spec-position expression → the BlockSpec call nodes it can
+    evaluate to (IfExp arms expand; anything else is itself)."""
+    if isinstance(node, ast.IfExp):
+        return _expand_spec_exprs(node.body) + \
+            _expand_spec_exprs(node.orelse)
+    return [node]
+
+
+def _collect_spec_list(node: ast.AST | None, fn: ast.FunctionDef | None,
+                       functions: dict[str, ast.FunctionDef],
+                       ) -> tuple[list[_Spec], bool]:
+    """Resolve an ``in_specs=`` expression to its BlockSpecs. A literal
+    list is exact; a Name resolving to a single list-literal assignment
+    picks up ``.append(...)`` entries too, but any append makes the
+    count inexact (appends are usually conditional)."""
+    specs: list[_Spec] = []
+    exact = True
+    if node is None:
+        return specs, False
+    if isinstance(node, ast.Name) and fn is not None:
+        assigned = None
+        appended: list[ast.AST] = []
+        n_assigns = 0
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                for target in sub.targets:
+                    if isinstance(target, ast.Name) and \
+                            target.id == node.id:
+                        assigned = sub.value
+                        n_assigns += 1
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "append" and \
+                    isinstance(sub.func.value, ast.Name) and \
+                    sub.func.value.id == node.id and sub.args:
+                appended.append(sub.args[0])
+        if n_assigns != 1 or not isinstance(assigned,
+                                            (ast.List, ast.Tuple)):
+            return [], False
+        elts = list(assigned.elts) + appended
+        exact = not appended
+        node = ast.List(elts=elts, ctx=ast.Load())
+    if isinstance(node, (ast.List, ast.Tuple)):
+        for elt in node.elts:
+            for expr in _expand_spec_exprs(elt):
+                spec = _parse_spec(expr, functions)
+                if spec is not None:
+                    specs.append(spec)
+                else:
+                    exact = False
+        return specs, exact
+    return [], False
+
+
+def _parse_out_shape(node: ast.AST | None, aliases: dict,
+                     ) -> list[tuple[list[ast.expr], int | None]]:
+    """``out_shape=`` → [(dim exprs, itemsize|None)] per output."""
+    out: list[tuple[list[ast.expr], int | None]] = []
+    if node is None:
+        return out
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_parse_out_shape(elt, aliases))
+        return out
+    if isinstance(node, ast.Call):
+        dotted = dotted_name(node.func, aliases)
+        if dotted.rsplit(".", 1)[-1] == "ShapeDtypeStruct":
+            shape = node.args[0] if node.args else _kw(node, "shape")
+            dtype = (node.args[1] if len(node.args) > 1
+                     else _kw(node, "dtype"))
+            if isinstance(shape, (ast.Tuple, ast.List)):
+                out.append((list(shape.elts),
+                            _dtype_bytes(dtype, aliases)))
+    return out
+
+
+def _parse_scratch(node: ast.AST | None, aliases: dict,
+                   ) -> list[tuple[list[ast.expr], int | None]]:
+    out: list[tuple[list[ast.expr], int | None]] = []
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return out
+    for elt in node.elts:
+        if isinstance(elt, ast.Call) and elt.args:
+            shape = elt.args[0]
+            dtype = elt.args[1] if len(elt.args) > 1 else None
+            if isinstance(shape, (ast.Tuple, ast.List)):
+                out.append((list(shape.elts),
+                            _dtype_bytes(dtype, aliases)))
+    return out
+
+
+def _kernel_ref(node: ast.AST, aliases: dict,
+                functions: dict[str, ast.FunctionDef],
+                ) -> ast.FunctionDef | None:
+    """Resolve the pallas_call's first argument to a local kernel def
+    (bare name or ``functools.partial(name, **config)``)."""
+    if isinstance(node, ast.Name):
+        return functions.get(node.id)
+    if isinstance(node, ast.Call):
+        dotted = dotted_name(node.func, aliases)
+        if dotted.rsplit(".", 1)[-1] == "partial" and node.args and \
+                isinstance(node.args[0], ast.Name):
+            return functions.get(node.args[0].id)
+    return None
+
+
+def _parse_donate_spec(call: ast.Call) -> tuple | None:
+    """``jax.jit(fn, donate_argnums=..., donate_argnames=...)`` →
+    (argnums, argnames, positions) or None when nothing is donated."""
+    argnums: set[int] = set()
+    argnames: set[str] = set()
+    nums = _kw(call, "donate_argnums")
+    names = _kw(call, "donate_argnames")
+    if nums is not None:
+        if isinstance(nums, ast.Constant) and isinstance(nums.value, int):
+            argnums.add(nums.value)
+        elif isinstance(nums, (ast.Tuple, ast.List)):
+            for elt in nums.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    argnums.add(elt.value)
+    if names is not None:
+        if isinstance(names, ast.Constant) and \
+                isinstance(names.value, str):
+            argnames.add(names.value)
+        elif isinstance(names, (ast.Tuple, ast.List)):
+            for elt in names.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str):
+                    argnames.add(elt.value)
+    if not argnums and not argnames:
+        return None
+    positions: set[int] = set()
+    target = call.args[0] if call.args else None
+    params: list[str] = []
+    if isinstance(target, ast.Lambda):
+        params = [a.arg for a in target.args.args]
+    return argnums, argnames, positions, params
+
+
+def _build_module_info(tree: ast.AST, path: str) -> _ModuleInfo:
+    aliases = import_aliases(tree)
+    consts = _module_consts(tree)
+    functions: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions.setdefault(node.name, node)
+
+    # -- pallas_call sites -------------------------------------------------
+    sites: list[_Site] = []
+    sites_by_fn: dict[str, list[_Site]] = {}
+    kernel_fns: set[str] = set()
+
+    def walk_fn(fn: ast.FunctionDef | None, body) -> None:
+        for node in ast.walk(body) if fn is None else ast.walk(fn):
+            if not isinstance(node, ast.Call) or \
+                    not _is_pallas_call(node, aliases):
+                continue
+            site = _parse_site(node, fn, aliases, consts, functions)
+            sites.append(site)
+            if fn is not None:
+                sites_by_fn.setdefault(fn.name, []).append(site)
+            if site.kernel is not None:
+                kernel_fns.add(site.kernel.name)
+
+    seen_calls: set[int] = set()
+    for name, fn in functions.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _is_pallas_call(node, aliases) and \
+                    id(node) not in seen_calls:
+                seen_calls.add(id(node))
+                site = _parse_site(node, fn, aliases, consts, functions)
+                sites.append(site)
+                sites_by_fn.setdefault(name, []).append(site)
+                if site.kernel is not None:
+                    kernel_fns.add(site.kernel.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _is_pallas_call(node, aliases) and \
+                id(node) not in seen_calls:
+            seen_calls.add(id(node))
+            site = _parse_site(node, None, aliases, consts, functions)
+            sites.append(site)
+            if site.kernel is not None:
+                kernel_fns.add(site.kernel.name)
+
+    # -- donation index ----------------------------------------------------
+    donating: dict[str, _Donating] = {}
+    factories: dict[str, _Donating] = {}
+
+    def jit_spec(value: ast.AST) -> _Donating | None:
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = dotted_name(value.func, aliases)
+        if dotted.rsplit(".", 1)[-1] != "jit":
+            return None
+        parsed = _parse_donate_spec(value)
+        if parsed is None:
+            return None
+        argnums, argnames, _positions, params = parsed
+        positions = {params.index(n) for n in argnames if n in params}
+        target = value.args[0] if value.args else None
+        if argnames and isinstance(target, ast.Name):
+            callee = functions.get(target.id)
+            if callee is not None:
+                callee_params = [a.arg for a in callee.args.args]
+                positions |= {callee_params.index(n) for n in argnames
+                              if n in callee_params}
+        return _Donating(frozenset(argnums), frozenset(argnames),
+                         frozenset(positions))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            spec = jit_spec(node.value)
+            if spec is None:
+                continue
+            for target in node.targets:
+                key = dotted_name(target, {})
+                if key:
+                    donating[key] = spec
+    for name, fn in functions.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                spec = jit_spec(node.value)
+                if spec is not None:
+                    factories[name] = spec
+
+    return _ModuleInfo(
+        path=path, aliases=aliases, consts=consts, functions=functions,
+        sites=sites, sites_by_fn=sites_by_fn, donating=donating,
+        factories=factories, kernel_fns=kernel_fns,
+        thread_entries=thread_entry_names(tree, aliases),
+    )
+
+
+def _parse_site(call: ast.Call, fn: ast.FunctionDef | None,
+                aliases: dict, consts: dict,
+                functions: dict[str, ast.FunctionDef]) -> _Site:
+    grid_node = _kw(call, "grid")
+    prefetch = 0
+    in_specs_node = _kw(call, "in_specs")
+    out_specs_node = _kw(call, "out_specs")
+    scratch_node = _kw(call, "scratch_shapes")
+    grid_spec = _kw(call, "grid_spec")
+    if grid_spec is not None and isinstance(grid_spec, ast.Call):
+        grid_node = _kw(grid_spec, "grid")
+        in_specs_node = _kw(grid_spec, "in_specs")
+        out_specs_node = _kw(grid_spec, "out_specs")
+        scratch_node = _kw(grid_spec, "scratch_shapes")
+        pref = _kw(grid_spec, "num_scalar_prefetch")
+        val = _const_eval(pref, consts) if pref is not None else None
+        prefetch = int(val) if isinstance(val, int) else 0
+    grid: list[ast.expr] | None = None
+    if isinstance(grid_node, (ast.Tuple, ast.List)):
+        grid = list(grid_node.elts)
+    elif grid_node is not None and not isinstance(grid_node, ast.Name):
+        grid = [grid_node]
+
+    in_specs, in_exact = _collect_spec_list(in_specs_node, fn, functions)
+    out_specs, out_exact = _collect_spec_list(
+        out_specs_node, fn, functions
+    )
+    if not out_specs:
+        one = _parse_spec(out_specs_node, functions) \
+            if out_specs_node is not None else None
+        if one is not None:
+            out_specs, out_exact = [one], True
+
+    kernel = _kernel_ref(call.args[0], aliases, functions) \
+        if call.args else None
+    fixed = None
+    has_vararg = False
+    if kernel is not None:
+        has_vararg = kernel.args.vararg is not None
+        fixed = len(kernel.args.args)
+
+    call_arg_count = None
+    parent = getattr(call, "_kft_outer", None)
+    if isinstance(parent, ast.Call) and not any(
+        isinstance(a, ast.Starred) for a in parent.args
+    ):
+        call_arg_count = len(parent.args)
+
+    params = [a.arg for a in fn.args.args] if fn is not None else []
+    env = _function_env(fn, dict(consts))
+    guarded = _has_cap_guard(fn, consts)
+    if not guarded:
+        produced = env.get("__calls__", {})
+        for spec in (in_specs + out_specs):
+            for dim in (spec.block or []):
+                if isinstance(dim, ast.Name) and \
+                        env.get(dim.id) is None and \
+                        dim.id in produced:
+                    producer = dotted_name(produced[dim.id].func,
+                                           aliases)
+                    producer_fn = functions.get(
+                        producer.rsplit(".", 1)[-1]
+                    )
+                    if _has_cap_guard(producer_fn, consts):
+                        guarded = True
+
+    return _Site(
+        call=call, fn=fn, params=params, kernel=kernel,
+        kernel_fixed_args=fixed, kernel_has_vararg=has_vararg,
+        kernel_has_mask=_kernel_has_mask(kernel, aliases),
+        grid=grid, prefetch=prefetch,
+        in_specs=in_specs, in_specs_exact=in_exact,
+        out_specs=out_specs,
+        out_shapes=_parse_out_shape(_kw(call, "out_shape"), aliases),
+        scratch=_parse_scratch(scratch_node, aliases),
+        call_arg_count=call_arg_count, guarded=guarded,
+    )
+
+
+def _mark_outer_calls(tree: ast.AST) -> None:
+    """Tag each pallas_call node with the call that invokes its result
+    (``pl.pallas_call(...)(x, w)``) for operand counting."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Call):
+            node.func._kft_outer = node
+
+
+# ---------------------------------------------------------------------------
+# site checks
+
+
+class _Emitter:
+    def __init__(self, path: str, out: list[Finding]) -> None:
+        self.path = path
+        self.out = out
+        self._seen: set[tuple[str, int]] = set()
+
+    def emit(self, rule: str, line: int, message: str,
+             severity: Severity = Severity.ERROR) -> None:
+        if (rule, line) in self._seen:
+            return
+        self._seen.add((rule, line))
+        self.out.append(Finding(rule, severity, self.path, line, message))
+
+
+def _check_site_structure(site: _Site, emit: _Emitter) -> None:
+    """Environment-independent contracts: arity of index maps vs the
+    grid, and ref/operand counts vs the kernel signature."""
+    grid_rank = len(site.grid) if site.grid is not None else None
+    if grid_rank is not None:
+        expected = grid_rank + site.prefetch
+        for spec in site.in_specs + site.out_specs:
+            if spec.index_arity is not None and \
+                    spec.index_arity != expected:
+                emit.emit("krn-index-map-arity", spec.line, (
+                    f"BlockSpec index map takes {spec.index_arity} "
+                    f"parameter(s) but the grid has {grid_rank} "
+                    f"axis/axes"
+                    + (f" plus {site.prefetch} scalar-prefetch "
+                       f"operand(s) (they arrive AFTER the grid "
+                       f"indices)" if site.prefetch else "")
+                    + f" — the map must take {expected}; Mosaic would "
+                    f"mis-slice every block (or annotate with "
+                    f"# analysis: allow[krn-index-map-arity])"
+                ))
+    if site.kernel is not None and not site.kernel_has_vararg and \
+            site.in_specs_exact and site.kernel_fixed_args is not None:
+        n_out = max(1, len(site.out_shapes)) if (
+            site.out_shapes or site.out_specs
+        ) else 1
+        expected_refs = (site.prefetch + len(site.in_specs) + n_out
+                         + len(site.scratch))
+        if site.kernel_fixed_args != expected_refs:
+            emit.emit("krn-operand-arity", site.call.lineno, (
+                f"kernel `{site.kernel.name}` declares "
+                f"{site.kernel_fixed_args} ref parameter(s) but the "
+                f"call wires {expected_refs} "
+                f"({site.prefetch} scalar-prefetch + "
+                f"{len(site.in_specs)} in_specs + {n_out} output(s) + "
+                f"{len(site.scratch)} scratch): refs would bind to the "
+                f"wrong operands (or annotate with "
+                f"# analysis: allow[krn-operand-arity])"
+            ))
+    if site.call_arg_count is not None and site.in_specs_exact and \
+            site.in_specs:
+        expected_args = site.prefetch + len(site.in_specs)
+        if site.call_arg_count != expected_args:
+            emit.emit("krn-operand-arity", site.call.lineno, (
+                f"pallas_call is invoked with {site.call_arg_count} "
+                f"operand(s) but declares {expected_args} "
+                f"({site.prefetch} scalar-prefetch + "
+                f"{len(site.in_specs)} in_specs) — operand/spec "
+                f"mismatch (or annotate with "
+                f"# analysis: allow[krn-operand-arity])"
+            ))
+
+
+def _axis_for_dim(spec: _Spec, dim_index: int,
+                  grid_rank: int) -> int | str | None:
+    """Which grid axis drives block index ``dim_index``: an axis
+    number, ``"const"`` for a fixed block index, or None (opaque)."""
+    if spec.index_returns is None or \
+            dim_index >= len(spec.index_returns):
+        return None
+    expr = spec.index_returns[dim_index]
+    if isinstance(expr, ast.Constant):
+        return "const"
+    if isinstance(expr, ast.Name):
+        grid_params = spec.index_params[:grid_rank]
+        if expr.id in grid_params:
+            return grid_params.index(expr.id)
+    return None
+
+
+def _check_site_dims(site: _Site, env: dict, emit: _Emitter,
+                     line: int | None = None,
+                     via: str = "") -> None:
+    """Dim-dependent contracts under ``env`` (name → int): output
+    coverage/divisibility against the grid, and the VMEM budget.
+    ``line`` re-attributes findings to a call site that supplied the
+    dims; ``via`` names it in the message."""
+
+    def ev(expr: ast.AST):
+        val = _const_eval(expr, env)
+        return val if isinstance(val, int) and not isinstance(
+            val, bool
+        ) else None
+
+    grid_rank = len(site.grid) if site.grid is not None else 0
+    grid_vals = [ev(g) for g in (site.grid or [])]
+
+    # -- coverage / divisibility over outputs ------------------------------
+    for spec, (dims, _item) in zip(site.out_specs, site.out_shapes):
+        if spec.block is None or len(spec.block) != len(dims):
+            continue
+        for i, (b_expr, d_expr) in enumerate(zip(spec.block, dims)):
+            b, d = ev(b_expr), ev(d_expr)
+            if not b or not d or b <= 0 or d <= 0:
+                continue
+            axis = _axis_for_dim(spec, i, grid_rank)
+            if axis == "const":
+                blocks = 1
+            elif isinstance(axis, int) and axis < len(grid_vals) and \
+                    grid_vals[axis] is not None:
+                blocks = grid_vals[axis]
+            else:
+                continue
+            where = line if line is not None else spec.line
+            covered = blocks * b
+            if covered < d:
+                emit.emit("krn-block-nondivisor", where, (
+                    f"output dim {i} is {d} but the grid writes only "
+                    f"{blocks} block(s) × {b} = {covered}{via}: the "
+                    f"tail columns are NEVER written (the PR-8 "
+                    f"qkv_rope_block bug) — pick a divisor block or a "
+                    f"ceil-div grid with an in-kernel mask (or "
+                    f"annotate with # analysis: allow["
+                    f"krn-block-nondivisor])"
+                ))
+            elif d % b and not site.kernel_has_mask:
+                emit.emit("krn-block-nondivisor", where, (
+                    f"block dim {b} does not divide output dim {d}"
+                    f"{via} and the kernel has no pl.when/jnp.where "
+                    f"mask: the ragged tail block reads/writes "
+                    f"out-of-bounds lanes — mask the tail in-kernel "
+                    f"(decode_attention's slots < capacity idiom) or "
+                    f"annotate with # analysis: allow["
+                    f"krn-block-nondivisor]"
+                ))
+
+    # -- VMEM budget -------------------------------------------------------
+    total = 0
+    unresolved = False
+    for spec in site.in_specs + site.out_specs:
+        if spec.block is None:
+            continue
+        elems = 1
+        for b_expr in spec.block:
+            b = ev(b_expr)
+            if b is None or b <= 0:
+                unresolved = True
+                break
+            elems *= b
+        else:
+            total += _DOUBLE_BUFFER * elems * _DEFAULT_ITEMSIZE
+            continue
+        break
+    if not unresolved:
+        for dims, item in site.scratch:
+            elems = 1
+            for d_expr in dims:
+                d = ev(d_expr)
+                if d is None or d <= 0:
+                    unresolved = True
+                    break
+                elems *= d
+            else:
+                total += elems * (item or _DEFAULT_ITEMSIZE)
+                continue
+            break
+    if not unresolved and (site.in_specs or site.out_specs):
+        # Use resolved out dtypes where we have them: recompute outs.
+        adjust = 0
+        for spec, (dims, item) in zip(site.out_specs, site.out_shapes):
+            if spec.block is None or item is None:
+                continue
+            elems = 1
+            ok = True
+            for b_expr in spec.block:
+                b = ev(b_expr)
+                if b is None or b <= 0:
+                    ok = False
+                    break
+                elems *= b
+            if ok:
+                adjust += _DOUBLE_BUFFER * elems * (
+                    item - _DEFAULT_ITEMSIZE
+                )
+        total += adjust
+        if total > VMEM_CAP_BYTES:
+            where = line if line is not None else site.call.lineno
+            emit.emit("krn-vmem-budget", where, (
+                f"resident blocks need ~{total // 1024} KiB of VMEM"
+                f"{via} (double-buffered in/out blocks + scratch, "
+                f"4-byte elements where the dtype is unknown) but the "
+                f"smallest fleet generation has "
+                f"{VMEM_CAP_BYTES // 1024} KiB per core "
+                f"(topology.min_vmem_bytes()) — shrink the block or "
+                f"gate it behind a byte-cap check (gemv._pick_block), "
+                f"or annotate with # analysis: allow[krn-vmem-budget]"
+            ))
+    elif unresolved and line is None and not site.guarded and \
+            (site.in_specs or site.out_specs):
+        emit.emit("krn-vmem-proxy-dim", site.call.lineno, (
+            "the VMEM budget of this pallas_call cannot be resolved "
+            "statically (a block dim never evaluates) and no dynamic "
+            "tile-budget guard is in scope — budgeting from an "
+            "assumed dim is the PR-8 k=4096 proxy bug: compare the "
+            "real tile bytes against a cap at trace time "
+            "(gemv._pick_block) or annotate with "
+            "# analysis: allow[krn-vmem-proxy-dim]"
+        ), Severity.WARNING)
+
+
+# ---------------------------------------------------------------------------
+# donation: read-after-donate (CFG fixpoint over reaching donations)
+
+from kubeflow_tpu.analysis import cfg as cfg_mod  # noqa: E402
+
+
+def _stmt_loads(stmt: ast.stmt) -> set[str]:
+    """Dotted names read by a statement (assignment targets and nested
+    function bodies excluded — closures are the thread rule's job)."""
+    skip: set[int] = set()
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            for sub in ast.walk(target):
+                skip.add(id(sub))
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        for sub in ast.walk(stmt.target):
+            skip.add(id(sub))
+    loads: set[str] = set()
+    for node in ast.walk(stmt):
+        if id(node) in skip:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+            continue
+        if isinstance(node, (ast.Name, ast.Attribute)) and \
+                isinstance(getattr(node, "ctx", None), ast.Load):
+            dotted = dotted_name(node, {})
+            if dotted:
+                loads.add(dotted)
+    return loads
+
+
+def _stmt_stores(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, cfg_mod._IterEval):
+        targets = [stmt.target]
+    elif isinstance(stmt, cfg_mod._WithEval):
+        targets = [item.optional_vars for item in stmt.items
+                   if item.optional_vars is not None]
+    for target in targets:
+        stack = [target]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Tuple, ast.List)):
+                stack.extend(node.elts)
+            elif isinstance(node, ast.Starred):
+                stack.append(node.value)
+            else:
+                dotted = dotted_name(node, {})
+                if dotted:
+                    out.add(dotted)
+    return out
+
+
+def _donated_args(stmt: ast.stmt, donating: dict[str, _Donating],
+                  ) -> list[tuple[str, int]]:
+    """(binding key, line) for every Name/Attribute argument donated by
+    a call inside ``stmt``."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        key = dotted_name(node.func, {})
+        spec = donating.get(key)
+        if spec is None:
+            continue
+        donated: list[ast.expr] = []
+        for i, arg in enumerate(node.args):
+            if i in spec.argnums or i in spec.positions_of_names:
+                donated.append(arg)
+        for kw in node.keywords:
+            if kw.arg in spec.argnames:
+                donated.append(kw.value)
+        for arg in donated:
+            dotted = dotted_name(arg, {})
+            if dotted:
+                out.append((dotted, node.lineno))
+    return out
+
+
+def _scan_donation(fn_body: list[ast.stmt], donating: dict,
+                   emit: _Emitter) -> None:
+    if not donating:
+        return
+    graph = cfg_mod.build_cfg(fn_body)
+    n = len(graph.blocks)
+    inn: list[dict[str, int]] = [{} for _ in range(n)]
+    out: list[dict[str, int]] = [{} for _ in range(n)]
+
+    def transfer(block, state: dict[str, int],
+                 report: _Emitter | None) -> dict[str, int]:
+        state = dict(state)
+        for stmt in block.stmts:
+            if report is not None and state:
+                for load in sorted(_stmt_loads(stmt)):
+                    for key, dline in sorted(state.items()):
+                        if load == key or load.startswith(key + "."):
+                            report.emit(
+                                "don-read-after-donate",
+                                getattr(stmt, "lineno", dline), (
+                                    f"`{key}` was donated at line "
+                                    f"{dline} (jit donate_argnums/"
+                                    f"argnames) and is read again "
+                                    f"here without rebinding: the "
+                                    f"binding may alias freed or "
+                                    f"overwritten device memory — "
+                                    f"rebind it from the call's "
+                                    f"result, or copy before "
+                                    f"donating (or annotate with "
+                                    f"# analysis: allow["
+                                    f"don-read-after-donate])"
+                                ))
+            for key, dline in _donated_args(stmt, donating):
+                state[key] = dline
+            for key in _stmt_stores(stmt):
+                state.pop(key, None)
+        return state
+
+    changed = True
+    while changed:
+        changed = False
+        for block in graph.blocks:
+            merged: dict[str, int] = {}
+            for pred in block.preds:
+                for key, dline in out[pred].items():
+                    prev = merged.get(key)
+                    merged[key] = dline if prev is None \
+                        else min(prev, dline)
+            inn[block.id] = merged
+            new_out = transfer(block, merged, None)
+            if new_out != out[block.id]:
+                out[block.id] = new_out
+                changed = True
+    for block in graph.blocks:
+        transfer(block, inn[block.id], emit)
+
+
+# ---------------------------------------------------------------------------
+# donation: thread-captured views
+
+
+@dataclasses.dataclass
+class _Alias:
+    root: str          # the parameter the value aliases
+    via_view: bool     # the chain passed an explicit view construction
+
+
+def _call_tail(node: ast.Call, aliases: dict) -> str:
+    return dotted_name(node.func, aliases)
+
+
+def _alias_of(expr: ast.AST, env: dict, aliases: dict) -> _Alias | None:
+    """Does ``expr`` alias (share a buffer with) a parameter? Unknown
+    calls BREAK the chain — aliasing, unlike value taint, dies through
+    ``str()``/``tuple()``/helper calls; only explicit views, container
+    displays and attribute/subscript walks preserve it."""
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if isinstance(expr, (ast.Attribute, ast.Subscript)):
+        base = _alias_of(
+            expr.value if isinstance(expr, ast.Attribute)
+            else expr.value, env, aliases
+        )
+        if base is not None:
+            return _Alias(base.root, True)
+        return None
+    if isinstance(expr, ast.Starred):
+        return _alias_of(expr.value, env, aliases)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for elt in expr.elts:
+            sub = _alias_of(elt, env, aliases)
+            if sub is not None:
+                return sub
+        return None
+    if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        inner = dict(env)
+        carried = None
+        for gen in expr.generators:
+            src = _alias_of(gen.iter, inner, aliases)
+            if src is not None:
+                carried = _Alias(src.root, True)
+                for sub in ast.walk(gen.target):
+                    if isinstance(sub, ast.Name):
+                        inner[sub.id] = carried
+        return _alias_of(expr.elt, inner, aliases)
+    if isinstance(expr, ast.IfExp):
+        return (_alias_of(expr.body, env, aliases)
+                or _alias_of(expr.orelse, env, aliases))
+    if isinstance(expr, ast.Call):
+        dotted = _call_tail(expr, aliases)
+        tail = dotted.rsplit(".", 1)[-1]
+        arg0 = expr.args[0] if expr.args else None
+        if dotted in _COPY_CALLS or tail in ("copy", "deepcopy",
+                                             "tobytes", "tolist"):
+            # np.array copies by default — unless copy=False.
+            cf = _kw(expr, "copy")
+            if dotted in ("np.array", "numpy.array") and \
+                    isinstance(cf, ast.Constant) and cf.value is False:
+                base = _alias_of(arg0, env, aliases) if arg0 else None
+                return _Alias(base.root, True) if base else None
+            return None
+        if dotted in _VIEW_CALLS or \
+                any(dotted.endswith(s) for s in _VIEW_METHOD_SUFFIXES):
+            base = None
+            if isinstance(expr.func, ast.Attribute) and \
+                    dotted not in _VIEW_CALLS:
+                base = _alias_of(expr.func.value, env, aliases)
+            elif arg0 is not None:
+                base = _alias_of(arg0, env, aliases)
+            return _Alias(base.root, True) if base else None
+        if tail in _CONTAINER_CALLS and arg0 is not None:
+            base = _alias_of(arg0, env, aliases)
+            return _Alias(base.root, base.via_view) if base else None
+        return None
+    return None
+
+
+def _closure_views_var(g: ast.FunctionDef, name: str) -> bool:
+    """True when the closure walks into ``name`` (attribute/subscript
+    access or iteration) — the uses that dereference a shared buffer,
+    as opposed to passing a scalar along."""
+    for node in ast.walk(g):
+        if isinstance(node, (ast.Attribute, ast.Subscript)) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == name:
+            return True
+        if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.iter, ast.Name) and \
+                node.iter.id == name:
+            return True
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func, {})
+            if dotted.rsplit(".", 1)[-1] in ("asarray", "frombuffer",
+                                             "memoryview"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id == name:
+                        return True
+    return False
+
+
+def _free_reads(g: ast.FunctionDef) -> set[str]:
+    bound: set[str] = {a.arg for a in g.args.args}
+    bound |= {a.arg for a in g.args.kwonlyargs}
+    if g.args.vararg:
+        bound.add(g.args.vararg.arg)
+    if g.args.kwarg:
+        bound.add(g.args.kwarg.arg)
+    loads: set[str] = set()
+    for node in ast.walk(g):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Store):
+                bound.add(node.id)
+            elif isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not g:
+            bound.add(node.name)
+    return loads - bound - {"self"}
+
+
+def _joined_entries(fn: ast.FunctionDef) -> set[str]:
+    """Thread-entry names whose threads are ``.join()``-ed inside
+    ``fn`` — structured concurrency: the worker is dead before the
+    function returns, so a captured view cannot outlive the buffer and
+    the donation hazard does not apply. A zero-positional-arg ``join``
+    is a thread join (``str.join`` always takes the iterable)."""
+    var_entries: dict[str, set[str]] = {}
+
+    def entry_targets(call: ast.AST) -> set[str]:
+        out: set[str] = set()
+        if isinstance(call, ast.Call) and \
+                dotted_name(call.func, {}).rsplit(".", 1)[-1] == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    out.add(dotted_name(kw.value, {}).rsplit(".", 1)[-1])
+        return out
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = node.value
+            ents = entry_targets(val)
+            if isinstance(val, (ast.List, ast.Tuple)):
+                for elt in val.elts:
+                    ents |= entry_targets(elt)
+            elif isinstance(val, ast.ListComp):
+                ents |= entry_targets(val.elt)
+            if ents:
+                var_entries[node.targets[0].id] = ents
+        elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.iter, ast.Name) and \
+                isinstance(node.target, ast.Name):
+            ents = var_entries.get(node.iter.id)
+            if ents:
+                var_entries[node.target.id] = set(ents)
+    joined: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and not node.args and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join" and \
+                isinstance(node.func.value, ast.Name):
+            joined |= var_entries.get(node.func.value.id, set())
+    return joined
+
+
+def _scan_thread_capture(fn: ast.FunctionDef, info: _ModuleInfo,
+                         emit: _Emitter) -> None:
+    joined = _joined_entries(fn)
+    nested = [node for node in ast.walk(fn)
+              if isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+              and node is not fn and node.name in info.thread_entries
+              and node.name not in joined]
+    if not nested:
+        return
+    env: dict[str, _Alias] = {
+        a.arg: _Alias(a.arg, False) for a in fn.args.args
+        if a.arg != "self"
+    }
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            alias = _alias_of(stmt.value, env, info.aliases)
+            if alias is not None:
+                env[stmt.targets[0].id] = alias
+            else:
+                env.pop(stmt.targets[0].id, None)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            src = _alias_of(stmt.iter, env, info.aliases)
+            for sub in ast.walk(stmt.target):
+                if isinstance(sub, ast.Name):
+                    if src is not None:
+                        env[sub.id] = _Alias(src.root, True)
+                    else:
+                        env.pop(sub.id, None)
+    for g in nested:
+        spawn_line = g.lineno
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "target" and \
+                            dotted_name(kw.value, {}).endswith(g.name):
+                        spawn_line = node.lineno
+        for name in sorted(_free_reads(g)):
+            alias = env.get(name)
+            if alias is None:
+                continue
+            if not (alias.via_view or _closure_views_var(g, name)):
+                continue
+            emit.emit("don-thread-capture", spawn_line, (
+                f"background thread `{g.name}` captures `{name}`, a "
+                f"zero-copy view of parameter `{alias.root}` — the "
+                f"caller may donate or overwrite that buffer the "
+                f"moment `{fn.name}` returns while the worker still "
+                f"reads it (the PR-4 save_async bug): snapshot with a "
+                f"forced copy on the caller thread "
+                f"(np.array(..., copy=True), checkpoint._snapshot) "
+                f"before handing it to the thread (or annotate with "
+                f"# analysis: allow[don-thread-capture])"
+            ))
+
+
+# ---------------------------------------------------------------------------
+# int8 scale flow
+
+
+def _is_quant_producer(dotted: str) -> bool:
+    tail = dotted.rsplit(".", 1)[-1]
+    return tail in _QUANT_PRODUCER_EXACT or any(
+        tail.endswith(s) for s in _QUANT_PRODUCER_SUFFIXES
+    )
+
+
+class _QuantScan:
+    """Linear label propagation for the qnt-* rules over one function
+    (or the module body). Labels: int8 payload, its scale, an
+    unscaled accumulation, and (in kernels) a scale-multiplied
+    operand."""
+
+    def __init__(self, aliases: dict, emit: _Emitter,
+                 in_kernel: bool, kernel_has_where: bool) -> None:
+        self.aliases = aliases
+        self.emit = emit
+        self.in_kernel = in_kernel
+        self.kernel_has_where = kernel_has_where
+        self.env: dict[str, frozenset] = {}
+
+    def run(self, fn: ast.FunctionDef | None,
+            body: list[ast.stmt]) -> None:
+        if fn is not None and self.in_kernel:
+            for arg in fn.args.args:
+                name = arg.arg
+                if name.endswith("s_ref") or "scale" in name:
+                    self.env[name] = frozenset({_SCALE})
+        self._stmts(body)
+
+    # -- statements --------------------------------------------------------
+    def _stmts(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                labels = self._assign_value(stmt.value)
+                for target in stmt.targets:
+                    self._bind(target, stmt.value, labels)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                labels = self._assign_value(stmt.value)
+                self._bind(stmt.target, stmt.value, labels)
+            elif isinstance(stmt, ast.AugAssign):
+                self._eval(stmt.value)
+            elif isinstance(stmt, (ast.Expr, ast.Return)):
+                if stmt.value is not None:
+                    self._eval(stmt.value)
+            elif isinstance(stmt, ast.If):
+                self._eval(stmt.test)
+                self._stmts(stmt.body)
+                self._stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._eval(stmt.iter)
+                self._stmts(stmt.body)
+            elif isinstance(stmt, ast.While):
+                self._eval(stmt.test)
+                self._stmts(stmt.body)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self._stmts(stmt.body)
+                for handler in stmt.handlers:
+                    self._stmts(handler.body)
+                self._stmts(stmt.orelse)
+                self._stmts(stmt.finalbody)
+
+    def _assign_value(self, value: ast.expr) -> frozenset:
+        if isinstance(value, ast.Call):
+            dotted = dotted_name(value.func, self.aliases)
+            if _is_quant_producer(dotted):
+                return frozenset({"__producer__"})
+        return self._eval(value)
+
+    def _bind(self, target: ast.expr, value: ast.expr,
+              labels: frozenset) -> None:
+        if "__producer__" in labels and isinstance(
+            target, (ast.Tuple, ast.List)
+        ) and len(target.elts) == 2:
+            first, second = target.elts
+            if isinstance(first, ast.Name):
+                self.env[first.id] = frozenset({_PAYLOAD})
+            if isinstance(second, ast.Name):
+                self.env[second.id] = frozenset({_SCALE})
+            return
+        if "__producer__" in labels:
+            labels = frozenset({_PAYLOAD})
+        if isinstance(target, ast.Name):
+            if labels:
+                self.env[target.id] = labels
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, value, labels)
+
+    # -- expressions -------------------------------------------------------
+    def _eval(self, expr: ast.expr) -> frozenset:
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute):
+            return self._eval(expr.value)
+        if isinstance(expr, ast.Subscript):
+            self._eval(expr.slice)
+            return self._eval(expr.value)
+        if isinstance(expr, ast.BinOp):
+            left = self._eval(expr.left)
+            right = self._eval(expr.right)
+            if isinstance(expr.op, ast.MatMult):
+                return self._accumulate(left | right, expr.lineno)
+            if isinstance(expr.op, ast.Mult):
+                both = left | right
+                if _SCALE in both and _UNSCALED in both:
+                    return both - {_UNSCALED, _SCALE, _PAYLOAD}
+                if _SCALE in both and _PAYLOAD in both:
+                    return both - {_SCALE, _PAYLOAD}  # dequantized
+                if self.in_kernel and _SCALE in both:
+                    return (both - {_SCALE}) | {_SCALED_OP}
+            return left | right
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test)
+            return self._eval(expr.body) | self._eval(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out: frozenset = frozenset()
+            for elt in expr.elts:
+                out |= self._eval(elt)
+            return out
+        if isinstance(expr, ast.Compare):
+            self._eval(expr.left)
+            for comp in expr.comparators:
+                self._eval(comp)
+            return frozenset()
+        if isinstance(expr, ast.Call):
+            return self._call(expr)
+        return frozenset()
+
+    def _call(self, call: ast.Call) -> frozenset:
+        dotted = dotted_name(call.func, self.aliases)
+        tail = dotted.rsplit(".", 1)[-1]
+        arg_labels = [self._eval(a) for a in call.args]
+        for kw in call.keywords:
+            self._eval(kw.value)
+        merged: frozenset = frozenset()
+        for labels in arg_labels:
+            merged |= labels
+        if tail == "astype":
+            recv = frozenset()
+            if isinstance(call.func, ast.Attribute):
+                recv = self._eval(call.func.value)
+            width = _dtype_bytes(call.args[0], self.aliases) \
+                if call.args else None
+            if _UNSCALED in recv:
+                self.emit.emit("qnt-scale-skipped", call.lineno, (
+                    "an int8-quantized operand was accumulated and the "
+                    "result is rounded to its output dtype here "
+                    "without the per-row/per-channel scale multiplying "
+                    "in between — W8A16's contract is accumulate f32 "
+                    "→ rescale → round (gemv's in-kernel `y * s_ref` "
+                    "order); apply the scale before .astype (or "
+                    "annotate with # analysis: allow[qnt-scale-"
+                    "skipped])"
+                ))
+                return recv - {_UNSCALED}
+            if width == 1 and call.args and _dtype_bytes(
+                call.args[0], self.aliases
+            ) == 1:
+                dotted_dtype = dotted_name(call.args[0], self.aliases)
+                if dotted_dtype.rsplit(".", 1)[-1] in ("int8", "uint8"):
+                    return frozenset({_PAYLOAD})
+            return recv
+        if dotted in _ACCUM_CALLS or tail in ("dot", "dot_general"):
+            return self._accumulate(merged, call.lineno)
+        if tail == "where":
+            # A mask in the chain: drop the scaled-operand worry.
+            return merged - {_SCALED_OP}
+        if dotted in _PASS_CALLS or tail in ("transpose", "reshape",
+                                             "broadcast_to", "clip",
+                                             "round", "exp"):
+            return merged
+        if isinstance(call.func, ast.Attribute) and tail in (
+            "T", "sum"
+        ):
+            return self._eval(call.func.value)
+        return frozenset()
+
+    def _accumulate(self, labels: frozenset, line: int) -> frozenset:
+        if self.in_kernel and _SCALED_OP in labels and \
+                not self.kernel_has_where:
+            self.emit.emit("qnt-ragged-unmasked", line, (
+                "a scale-multiplied operand feeds this reduction and "
+                "the kernel contains no jnp.where mask: ragged-tail "
+                "scale lanes are undefined and 0 × NaN = NaN poisons "
+                "the accumulation (the decode-attention masking "
+                "lesson) — mask the tail (slots < capacity) before "
+                "reducing (or annotate with # analysis: allow["
+                "qnt-ragged-unmasked])"
+            ), Severity.WARNING)
+        if _PAYLOAD in labels:
+            return frozenset({_UNSCALED})
+        return frozenset()
+
+
+# ---------------------------------------------------------------------------
+# cross-module threading
+
+
+def _module_info_for(path: str, tree: ast.AST | None,
+                     context) -> _ModuleInfo | None:
+    if tree is None:
+        return None
+    store: dict[str, _ModuleInfo]
+    if context is not None and context.project is not None:
+        store = context.project.pack_state.setdefault("kernels", {})
+    else:
+        store = {}
+    info = store.get(path)
+    if info is None:
+        _mark_outer_calls(tree)
+        info = _build_module_info(tree, path)
+        store[path] = info
+    return info
+
+
+def _resolve_callee(dotted: str, info: _ModuleInfo, context,
+                    ) -> tuple[_ModuleInfo, str] | None:
+    """A called dotted name → (module info, function name) when it
+    names a function in this or an imported module."""
+    tail = dotted.rsplit(".", 1)[-1]
+    if "." not in dotted:
+        if tail in info.functions:
+            return info, tail
+        return None
+    if context is None or context.project is None:
+        return None
+    module = dotted.rsplit(".", 1)[0]
+    from_dir = None
+    if context.abspath:
+        import os
+        from_dir = os.path.dirname(context.abspath)
+    path = context.project.module_file(module, from_dir)
+    if path is None:
+        return None
+    tree = context.project.cache.get(path)
+    callee_info = _module_info_for(path, tree, context)
+    if callee_info is None or tail not in callee_info.functions:
+        return None
+    return callee_info, tail
+
+
+def _thread_call_sites(tree: ast.AST, info: _ModuleInfo, context,
+                       emit: _Emitter) -> None:
+    """Re-check callee pallas sites under the dims a call actually
+    passes: ``launch(x, n=384, bn=128)`` evaluates the callee's block
+    contracts with those values, attributed at this call line."""
+    functions: list[tuple[ast.FunctionDef | None, ast.AST]] = \
+        [(None, tree)] + [(fn, fn) for fn in info.functions.values()]
+    for fn, scope in functions:
+        caller_env = _function_env(fn, dict(info.consts))
+        for node in (ast.walk(scope) if fn is None else ast.walk(fn)):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func, info.aliases)
+            if not dotted or _is_pallas_call(node, info.aliases):
+                continue
+            resolved = _resolve_callee(dotted, info, context)
+            if resolved is None:
+                continue
+            callee_info, name = resolved
+            sites = callee_info.sites_by_fn.get(name)
+            if not sites:
+                continue
+            callee_fn = callee_info.functions[name]
+            params = [a.arg for a in callee_fn.args.args]
+            bindings: dict = {}
+            for i, arg in enumerate(node.args):
+                if i < len(params):
+                    val = _const_eval(arg, caller_env)
+                    if isinstance(val, int):
+                        bindings[params[i]] = val
+            for kw in node.keywords:
+                if kw.arg:
+                    val = _const_eval(kw.value, caller_env)
+                    if isinstance(val, int):
+                        bindings[kw.arg] = val
+            # Defaults bind only at a real call site (Python
+            # semantics) — never at the definition, where they would
+            # be exactly the k=4096 proxy.
+            pos_args = callee_fn.args
+            defaults = pos_args.defaults
+            offset = len(pos_args.args) - len(defaults)
+            for i, default in enumerate(defaults):
+                pname = pos_args.args[offset + i].arg
+                if pname not in bindings:
+                    val = _const_eval(default, callee_info.consts)
+                    if isinstance(val, int):
+                        bindings[pname] = val
+            for kwarg, kwdef in zip(pos_args.kwonlyargs,
+                                    pos_args.kw_defaults):
+                if kwdef is not None and kwarg.arg not in bindings:
+                    val = _const_eval(kwdef, callee_info.consts)
+                    if isinstance(val, int):
+                        bindings[kwarg.arg] = val
+            if not bindings:
+                continue
+            for site in sites:
+                base = dict(callee_info.consts)
+                base.update(bindings)
+                env = _function_env(site.fn, base)
+                # A param the caller pinned must stay pinned even if
+                # the callee reassigns it unknowably — no: respect
+                # the callee's own flow; _function_env already does.
+                _check_site_dims(
+                    site, env, emit, line=node.lineno,
+                    via=f" (dims threaded through this call to "
+                        f"{name}())",
+                )
+
+
+# ---------------------------------------------------------------------------
+# entry point
+
+
+def analyze_python_kernels(source: str, path: str,
+                           context=None) -> list[Finding]:
+    """Pack D over one Python file. ``context`` supplies the shared
+    parse tree and the cross-module project index (kernel summaries of
+    imported modules resolve through it)."""
+    if is_test_path(path):
+        return []
+    if context is not None:
+        tree = context.tree
+        abspath = context.abspath or path
+    else:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return []  # ast_rules already reports py-syntax
+        abspath = path
+    info = _module_info_for(abspath, tree, context)
+    if info is None:
+        return []
+    out: list[Finding] = []
+    emit = _Emitter(path, out)
+
+    # (1) Pallas contracts: structure at every site, dims at the
+    # definition (module consts + straight-line locals; params and
+    # their defaults deliberately unbound)...
+    for site in info.sites:
+        _check_site_structure(site, emit)
+        env = _function_env(site.fn, dict(info.consts))
+        _check_site_dims(site, env, emit)
+    # ...and again under real dims at every resolvable call site.
+    _thread_call_sites(tree, info, context, emit)
+
+    # (2) Donation aliasing. Factory-produced donating callables bind
+    # where they are assigned: `step = make_train_step(...)`.
+    donating = dict(info.donating)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            dotted = dotted_name(node.value.func, info.aliases)
+            if not dotted:
+                continue
+            resolved = _resolve_callee(dotted, info, context)
+            if resolved is None:
+                continue
+            callee_info, name = resolved
+            spec = callee_info.factories.get(name)
+            if spec is None:
+                continue
+            for target in node.targets:
+                key = dotted_name(target, {})
+                if key:
+                    donating[key] = spec
+    _scan_donation(list(tree.body), donating, emit)
+    for fn in info.functions.values():
+        _scan_donation(fn.body, donating, emit)
+        _scan_thread_capture(fn, info, emit)
+
+    # (3) int8 scale flow — module body, plain functions, and kernel
+    # bodies (which additionally seed scale-ref params).
+    module_scan = _QuantScan(info.aliases, emit, False, False)
+    module_scan._stmts(list(tree.body))
+    for name, fn in info.functions.items():
+        in_kernel = name in info.kernel_fns
+        scan = _QuantScan(
+            info.aliases, emit, in_kernel,
+            kernel_has_where=_kernel_has_mask(fn, info.aliases),
+        )
+        scan.run(fn, fn.body)
+
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out

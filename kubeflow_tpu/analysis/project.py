@@ -110,6 +110,10 @@ class ProjectIndex:
         # (pack, from_dir, dotted) -> Summary | None: the same
         # unresolved dotted names recur at every call site of a file.
         self._resolved: dict[tuple[str, str | None, str], object] = {}
+        # Free-form per-scan scratch for packs that keep their own
+        # cross-module state beyond CallGraph summaries (Pack D caches
+        # per-module kernel/donation indexes here, keyed by pack name).
+        self.pack_state: dict[str, dict] = {}
 
     # -- module file resolution ------------------------------------------
     def _module_file(self, module: str, from_dir: str | None) -> str | None:
@@ -123,6 +127,13 @@ class ProjectIndex:
                 if os.path.isfile(candidate):
                     return os.path.abspath(candidate)
         return None
+
+    def module_file(self, module: str,
+                    from_dir: str | None = None) -> str | None:
+        """Public module→file resolution for packs that index modules
+        themselves (same search order as summary resolution: the
+        importing file's directory, then the package-aware roots)."""
+        return self._module_file(module, from_dir)
 
     def _graph_for(self, path: str, pack_key: str, registry_factory,
                    make_graph):

@@ -25,6 +25,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kubeflow_tpu.topology import min_vmem_bytes
+
+# Per-core VMEM every resident tile must fit (smallest fleet
+# generation). Checked at trace time so an oversized block pair fails
+# with a sizing error here, not a Mosaic allocation failure mid-run.
+_VMEM_BYTES_CAP = min_vmem_bytes()
+
 # Eager-path segment-id sortedness validation (costs one device
 # round-trip per un-jitted call). Read once at import.
 _CHECK_SORTED = os.environ.get(
@@ -241,6 +248,20 @@ def _flash_forward(q, k, v, segment_ids, causal, window, scale, block_q,
             f"sequence lengths ({s_q}, {s_k}) must be multiples of the "
             f"block sizes ({block_q}, {block_k})"
         )
+    # Resident tile: double-buffered q/k/v/o blocks + f32 softmax
+    # scratch (m, l on the 128-lane pad, and the output accumulator).
+    itemsize = q.dtype.itemsize
+    tile_bytes = (
+        2 * (2 * block_q * d + 2 * block_k * d) * itemsize
+        + (2 * block_q * 128 + block_q * d) * 4
+    )
+    if tile_bytes > _VMEM_BYTES_CAP:
+        raise ValueError(
+            f"flash-attention blocks ({block_q}, {block_k}) at head "
+            f"dim {d} need {tile_bytes} bytes of VMEM, over the "
+            f"{_VMEM_BYTES_CAP}-byte per-core budget; shrink "
+            f"block_q/block_k"
+        )
     bh = batch * heads
     # GQA: with fewer kv heads, flat q index b = bi*H + hi maps to kv
     # index b // group = bi*Hkv + hi // group — one index-map division,
@@ -417,6 +438,20 @@ def _flash_backward(q, k, v, segment_ids, out, lse, g, causal, window,
     like the fwd — never materialises the S x S score matrix in HBM."""
     batch, heads, s_q, d = q.shape
     s_k = k.shape[2]
+    # Same trace-time budget as the forward; the dkv sweep is the
+    # widest resident set (q/k/v/do blocks + two f32 accumulators).
+    itemsize = q.dtype.itemsize
+    tile_bytes = (
+        2 * (2 * block_q * d + 2 * block_k * d) * itemsize
+        + (block_q * d + 2 * block_k * d) * 4
+    )
+    if tile_bytes > _VMEM_BYTES_CAP:
+        raise ValueError(
+            f"flash-attention backward blocks ({block_q}, {block_k}) "
+            f"at head dim {d} need {tile_bytes} bytes of VMEM, over "
+            f"the {_VMEM_BYTES_CAP}-byte per-core budget; shrink "
+            f"block_q/block_k"
+        )
     bh = batch * heads
     kv_heads = k.shape[1]
     group = heads // kv_heads

@@ -53,6 +53,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kubeflow_tpu.topology import min_vmem_bytes
+
+# Per-core VMEM the resident decode tile must fit (smallest fleet
+# generation) — checked at trace time, not left to a Mosaic failure.
+_VMEM_BYTES_CAP = min_vmem_bytes()
+
 NEG_INF = -1e30
 
 
@@ -198,6 +204,23 @@ def decode_attention(q, k_cache, v_cache, pos, *, window=None,
         interpret = jax.default_backend() != "tpu"
     scale = hd ** -0.5
     block = min(block, -(-capacity // 8) * 8)
+    # Trace-time VMEM budget: double-buffered q/k/v blocks (+ scale
+    # columns when quantized) and the f32 softmax scratch must fit the
+    # smallest fleet core; a huge block × head-dim pair fails here
+    # with a sizing error instead of a Mosaic allocation failure.
+    kv_item = k_cache.dtype.itemsize
+    tile_bytes = (
+        2 * (rows * hd * q.dtype.itemsize + 2 * block * hd * kv_item
+             + 2 * block * 4)
+        + (2 * rows * 128 + rows * hd) * 4
+    )
+    if tile_bytes > _VMEM_BYTES_CAP:
+        raise ValueError(
+            f"decode_attention block {block} at head dim {hd} needs "
+            f"{tile_bytes} bytes of VMEM, over the "
+            f"{_VMEM_BYTES_CAP}-byte per-core budget; pass a smaller "
+            f"block"
+        )
     pos_vec = jnp.broadcast_to(
         jnp.asarray(pos, jnp.int32).reshape(-1), (b,)
     )

@@ -30,14 +30,31 @@ class Accelerator:
     chips_per_host: int       # chips per VM in multi-host slices
     max_single_host_chips: int  # largest slice that fits one host
     peak_bf16_flops: float    # per-chip dense bf16 peak, FLOP/s
+    vmem_bytes: int           # per-core VMEM a Pallas program can hold
 
+
+# ~16 MiB of VMEM per TensorCore on every shipped generation — the
+# budget every Pallas kernel's resident blocks (double-buffered) plus
+# scratch must fit. Single source of truth for the kernel lint's
+# krn-vmem-budget cap and any runtime tile-size selection.
+_VMEM_PER_CORE = 16 * 1024 * 1024
 
 ACCELERATORS: dict[str, Accelerator] = {
-    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 275e12),
-    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 197e12),
-    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 459e12),
-    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 918e12),
+    "v4": Accelerator("v4", "tpu-v4-podslice", 3, 4, 4, 275e12,
+                      _VMEM_PER_CORE),
+    "v5e": Accelerator("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 197e12,
+                       _VMEM_PER_CORE),
+    "v5p": Accelerator("v5p", "tpu-v5p-slice", 3, 4, 4, 459e12,
+                       _VMEM_PER_CORE),
+    "v6e": Accelerator("v6e", "tpu-v6e-slice", 2, 4, 8, 918e12,
+                       _VMEM_PER_CORE),
 }
+
+
+def min_vmem_bytes() -> int:
+    """Smallest per-core VMEM across the fleet's generations — the cap
+    a kernel must fit to run on any shipped slice."""
+    return min(acc.vmem_bytes for acc in ACCELERATORS.values())
 
 # jax ``device.device_kind`` substrings → accelerator short name.
 # Longest match wins ("v5 lite" must beat "v5"); the spellings are the

@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Static-analysis gate for CI: fail the build on any new error-severity
 # finding (manifest/topology agreement, PodDefault conflicts, traced-code
-# and controller hazards, SPMD coherence, concurrency discipline, and
+# and controller hazards, SPMD coherence, concurrency discipline,
 # Pack C replay determinism — the static twin of the replay_digest
-# gates). Intentional occurrences carry an inline
+# gates — and Pack D accelerator hazards: Pallas launch contracts,
+# buffer-donation aliasing, int8 scale flow). Intentional occurrences
+# carry an inline
 # `# analysis: allow[rule-id]` pragma; the accepted-findings baseline
 # (.analysis-baseline.json) is EMPTY since the PR 15 audit and must
 # stay empty — tests/test_analysis_self.py pins the whole tree at zero
@@ -37,6 +39,38 @@ fi
 # checkout it scans the empty closure and exits 0 fast.
 if [ "$rc" -eq 0 ]; then
     python -m kubeflow_tpu.analysis . --changed-only --stats || rc=$?
+fi
+
+# Pack D liveness probe: a clean tree produces an empty SARIF rule
+# inventory, so the zero-findings gate above can't distinguish "the
+# kernels are clean" from "the pack was dropped from the dispatch".
+# Scan the seeded kernel fixtures and require all nine
+# accelerator-hazard rules to fire AND to land in the SARIF rules
+# array the annotation tooling reads.
+if [ "$rc" -eq 0 ]; then
+    python - <<'PY' || rc=$?
+import json
+
+from kubeflow_tpu.analysis import AnalysisConfig, analyze_paths
+from kubeflow_tpu.analysis.sarif import sarif_document
+
+findings = analyze_paths(AnalysisConfig(
+    paths=["tests/analysis_fixtures/bad/kernels"], check_emitted=False,
+))
+doc = sarif_document(findings, [])
+fired = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+want = {
+    "krn-block-nondivisor", "krn-index-map-arity", "krn-operand-arity",
+    "krn-vmem-budget", "krn-vmem-proxy-dim", "don-read-after-donate",
+    "don-thread-capture", "qnt-scale-skipped", "qnt-ragged-unmasked",
+}
+missing = want - fired
+if missing:
+    print(f"Pack D probe: rules missing from SARIF: {sorted(missing)}")
+    raise SystemExit(1)
+print(f"Pack D probe: all {len(want)} rules fire and reach SARIF "
+      f"({json.dumps(sorted(fired))})")
+PY
 fi
 
 exit "$rc"

@@ -15,7 +15,11 @@
 #
 # 3. Static analysis: the decode stack (ops/ + decoding/speculative/
 #    serving model files + kubeflow_tpu/serving/) must hold EVERY pack
-#    at zero findings with no pragma budget.
+#    at zero findings with no pragma budget — since Pack D that
+#    includes the kernel launch contracts, VMEM budgets, donation
+#    aliasing and int8 scale flow of the very kernels checked in
+#    step 1, so a fused-path edit that breaks a contract fails here
+#    even when the CPU-interpret parity subset can't see it.
 set -euo pipefail
 
 cd "$(dirname "$0")/../.."
@@ -123,11 +127,20 @@ findings = analyze_paths(AnalysisConfig(
     check_emitted=False,
 ))
 # No pragma budget, no baseline: the decode stack must be spotless
-# under every pack, dataflow included.
+# under every pack, dataflow and Pack D kernel hazards included.
 if findings:
     print("\n".join(f.render() for f in findings))
     raise SystemExit(1)
-print("  decode stack: clean under all packs")
+# Prove the kernel pack actually ran over this tree rather than
+# being silently dropped from the engine dispatch: the engine source
+# must dispatch kernel_rules (the fixture-firing probe lives in
+# analysis_gate.sh).
+import inspect
+
+from kubeflow_tpu.analysis import engine
+assert "kernel_rules.analyze" in inspect.getsource(engine), \
+    "kernel pack missing from engine dispatch"
+print("  decode stack: clean under all packs (Pack D live)")
 PY
 
 echo "decode gate: OK"

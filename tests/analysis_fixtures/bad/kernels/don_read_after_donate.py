@@ -1,0 +1,21 @@
+"""SEEDED VIOLATION (1) — reading a donated buffer after the jit call:
+``step`` donates its first argument, so after ``step(state, tokens)``
+the ``state`` binding may alias freed or overwritten device memory;
+the telemetry read on the next line is the bug.
+``don-read-after-donate`` (error) must fire exactly once, at the read.
+"""
+
+import jax
+
+
+def _advance(state, tokens):
+    return state + tokens, tokens.sum()
+
+
+step = jax.jit(_advance, donate_argnums=(0,))
+
+
+def drive(state, tokens, log):
+    new_state, total = step(state, tokens)
+    log.append(float(state.mean()))
+    return new_state, total

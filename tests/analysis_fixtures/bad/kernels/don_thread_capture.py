@@ -1,0 +1,26 @@
+"""SEEDED VIOLATION (1) — the PR-4 ``save_async`` bug, minimized: the
+checkpoint worker thread captures ``host``, a ZERO-COPY view of the
+``state`` parameter (``np.asarray`` does not copy), while the caller's
+contract lets it donate/overwrite that buffer as soon as ``save_async``
+returns — the worker then serializes torn bytes from the next step.
+``don-thread-capture`` (error) must fire exactly once, at the thread
+spawn.
+"""
+
+import threading
+
+import numpy as np
+
+
+class Saver:
+    def __init__(self, writer):
+        self._writer = writer
+
+    def save_async(self, state, step):
+        host = np.asarray(state)
+
+        def _run():
+            blob = host.tobytes()
+            self._writer.put(int(step), blob)
+
+        threading.Thread(target=_run, daemon=True).start()

@@ -1,0 +1,23 @@
+"""SEEDED VIOLATION (1) — a BlockSpec index map written for a 1-D grid
+after the grid grew to 2-D: the map takes one parameter where the grid
+has two axes, so Mosaic would mis-slice every input block.
+``krn-index-map-arity`` (error) must fire exactly once, at the stale
+BlockSpec.
+"""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def scale_tiles(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((512, 512), jnp.float32),
+    )(x)

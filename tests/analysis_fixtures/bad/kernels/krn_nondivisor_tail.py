@@ -1,0 +1,31 @@
+"""SEEDED VIOLATION (1) — the PR-8 ``qkv_rope_block`` bug, minimized:
+a floor-div grid over a non-divisor block width. n=384 columns at
+bn=256 gives ``grid=(384 // 256,) = (1,)``, so the kernel writes one
+256-wide block and columns 256..383 of the output are NEVER written —
+garbage, not even a masked tail. ``krn-block-nondivisor`` (error) must
+fire exactly once, at the pallas_call.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = x_ref[...] @ w_ref[...]
+
+
+def project(x, w):
+    rows = 8
+    k = 512
+    n = 384
+    bn = 256  # does not divide n; the floor grid drops the tail
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), x.dtype),
+    )(x, w)

@@ -1,0 +1,26 @@
+"""SEEDED VIOLATION (1) — the kernel signature lost an operand: two
+in_specs plus the output wire three refs, but the kernel declares two,
+so ``w``'s block would bind to the output ref and the real output ref
+would not exist. ``krn-operand-arity`` (error) must fire exactly once,
+at the pallas_call.
+"""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def scale_by(x, w):
+    return pl.pallas_call(
+        _scale_kernel,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (0, i)),
+            pl.BlockSpec((8, 128), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+    )(x, w)

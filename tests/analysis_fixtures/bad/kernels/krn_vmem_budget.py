@@ -1,0 +1,29 @@
+"""SEEDED VIOLATION (1) — resident blocks that cannot fit a TensorCore:
+the (4096, 1024) f32 weight block alone is 16 MiB before double
+buffering, over the per-core VMEM cap from ``topology.py``.
+``krn-vmem-budget`` (error) must fire exactly once, at the pallas_call.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = x_ref[...] @ w_ref[...]
+
+
+def big_tile(x, w):
+    bm = 256
+    bn = 1024
+    k = 4096
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((bm, 4096), jnp.float32),
+    )(x, w)

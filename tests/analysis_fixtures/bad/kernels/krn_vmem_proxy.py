@@ -1,0 +1,30 @@
+"""SEEDED VIOLATION (1) — the PR-8 proxy-budget bug, minimized: block
+dims come from runtime shapes, the static budget is unknowable, and
+NOTHING compares the real tile bytes against a cap at trace time. A
+reviewer reading this sees no budget at all — it was "budgeted" by
+assuming k stays small. ``krn-vmem-proxy-dim`` (warning) must fire
+exactly once, at the pallas_call.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = x_ref[...] @ w_ref[...]
+
+
+def launch(x, w, bn):
+    rows = 8
+    k = x.shape[-1]
+    n = w.shape[-1]
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), x.dtype),
+    )(x, w)

@@ -1,0 +1,34 @@
+"""SEEDED VIOLATION (1) — an unmasked ragged-tail reduction over a
+scaled operand: the kernel dequantizes with ``s_ref`` and reduces, but
+contains NO ``jnp.where`` mask — on the ragged tail block the scale
+lanes beyond the live columns are undefined, and 0 × NaN = NaN poisons
+the whole accumulation (the decode-attention masking lesson).
+``qnt-ragged-unmasked`` (warning) must fire exactly once, at the dot.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _dequant_kernel(x_ref, w_ref, s_ref, o_ref):
+    w = w_ref[...].astype(jnp.float32) * s_ref[...]
+    o_ref[...] = jnp.dot(x_ref[...], w)
+
+
+def matmul(x, w, s):
+    rows = 8
+    k = 128
+    n = 256
+    bn = 128
+    return pl.pallas_call(
+        _dequant_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+    )(x, w, s)

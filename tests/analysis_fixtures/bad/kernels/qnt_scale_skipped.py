@@ -1,0 +1,21 @@
+"""SEEDED VIOLATION (1) — int8 payload accumulated and rounded without
+its scale: ``_quantize_rows`` returns (payload, per-row scale); the
+matmul accumulates the RAW int8 payload and the result is cast to the
+output dtype with the scale never multiplying in — numerically the
+output is 127/absmax too large. ``qnt-scale-skipped`` (error) must fire
+exactly once, at the ``.astype``.
+"""
+
+import jax.numpy as jnp
+
+
+def _quantize_rows(x):
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    q = jnp.round(x / scale).astype(jnp.int8)
+    return q, scale
+
+
+def cache_matmul(x, w):
+    q, s = _quantize_rows(w)
+    acc = jnp.dot(x, q.astype(jnp.float32))
+    return acc.astype(jnp.bfloat16)

@@ -1,0 +1,36 @@
+"""Clean counterpart — the SHIPPED post-PR-4 save_async shapes: the
+snapshot is forced to a copy ON THE CALLER THREAD before the worker is
+spawned — either the explicit ``np.array(..., copy=True)`` or a
+snapshot helper (whose return is a fresh buffer, not a view of the
+parameter). The worker owns its bytes; donation of ``state`` after
+return is safe. No finding."""
+
+import threading
+
+import numpy as np
+
+
+class Saver:
+    def __init__(self, writer):
+        self._writer = writer
+
+    def save_async(self, state, step):
+        host = np.array(state, copy=True)
+
+        def _run():
+            blob = host.tobytes()
+            self._writer.put(int(step), blob)
+
+        threading.Thread(target=_run, daemon=True).start()
+
+    def save_async_snapshot(self, state, step):
+        host = self._snapshot(state)
+
+        def _run():
+            blob = host.tobytes()
+            self._writer.put(int(step), blob)
+
+        threading.Thread(target=_run, daemon=True).start()
+
+    def _snapshot(self, state):
+        return np.array(state, copy=True)

@@ -1,0 +1,27 @@
+"""Clean counterpart — the same matmul with a tile that fits: resident
+blocks total ~2.5 MiB double-buffered, comfortably under the per-core
+cap. No finding."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _matmul_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = x_ref[...] @ w_ref[...]
+
+
+def big_tile(x, w):
+    bm = 256
+    bn = 256
+    k = 512
+    return pl.pallas_call(
+        _matmul_kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((bm, 1024), jnp.float32),
+    )(x, w)

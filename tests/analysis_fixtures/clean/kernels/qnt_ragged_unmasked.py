@@ -1,0 +1,31 @@
+"""Clean counterpart — the decode-attention masking idiom: the scaled
+operand is ``jnp.where``-masked before the reduction, so ragged-tail
+lanes contribute exact zeros instead of 0 x NaN. No finding."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _dequant_kernel(x_ref, w_ref, s_ref, o_ref):
+    w = w_ref[...].astype(jnp.float32) * s_ref[...]
+    w = jnp.where(s_ref[...] > 0.0, w, 0.0)
+    o_ref[...] = jnp.dot(x_ref[...], w)
+
+
+def matmul(x, w, s):
+    rows = 8
+    k = 128
+    n = 256
+    bn = 128
+    return pl.pallas_call(
+        _dequant_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), jnp.float32),
+    )(x, w, s)

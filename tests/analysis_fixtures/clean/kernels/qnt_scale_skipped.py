@@ -1,0 +1,17 @@
+"""Clean counterpart — the W8A16 contract honored: accumulate in f32,
+multiply the per-row scale back in, THEN round to the output dtype
+(gemv's ``y * s_ref`` order). No finding."""
+
+import jax.numpy as jnp
+
+
+def _quantize_rows(x):
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    q = jnp.round(x / scale).astype(jnp.int8)
+    return q, scale
+
+
+def cache_matmul(x, w):
+    q, s = _quantize_rows(w)
+    acc = jnp.dot(x, q.astype(jnp.float32))
+    return (acc * s.T).astype(jnp.bfloat16)

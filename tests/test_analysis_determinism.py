@@ -494,6 +494,45 @@ class TestChangedOnly:
         # The changed helper AND its importer, not the unrelated module.
         assert names == {"helper.py", "caller.py"}
 
+    def test_deep_dotted_attribute_reference_closure(self, tmp_path):
+        # `import pkg` + `pkg.kernels.launch(...)` reaches pkg/kernels
+        # with NO import statement naming pkg.kernels — yet the
+        # interprocedural packs thread the caller's dims through that
+        # call, so editing pkg/kernels.py changes caller.py's analysis.
+        # Before the fix the closure stopped at pkg/__init__.py and
+        # served a stale verdict for the caller.
+        run = self._init_repo(tmp_path)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "kernels.py").write_text(
+            "def launch(x, w, bn):\n    return x\n"
+        )
+        (tmp_path / "caller.py").write_text(
+            "import pkg\n"
+            "def use(x, w):\n"
+            "    return pkg.kernels.launch(x, w, 256)\n"
+        )
+        (tmp_path / "aliased.py").write_text(
+            "import pkg as p\n"
+            "def use(x, w):\n"
+            "    return p.kernels.launch(x, w, 128)\n"
+        )
+        (tmp_path / "unrelated.py").write_text(
+            "def other():\n    return 2\n"
+        )
+        run("add", "-A")
+        run("commit", "-q", "-m", "seed")
+        (pkg / "kernels.py").write_text(
+            "def launch(x, w, bn):\n    return w\n"
+        )
+        files = changed_only_files([str(tmp_path)], "HEAD")
+        assert files is not None
+        names = {os.path.basename(p) for p in files}
+        assert "caller.py" in names
+        assert "aliased.py" in names
+        assert "unrelated.py" not in names
+
     def test_package_init_relative_import_closure(self, tmp_path):
         # pkg/__init__.py's level-1 relative import resolves against
         # pkg ITSELF (an __init__ module name IS its package), so

@@ -1,0 +1,468 @@
+"""Pack D (accelerator hazards) tests: every seeded kernel fixture
+fires its rule exactly once and every clean counterpart is silent; the
+PR-8 non-divisor-block and PR-4 donation-aliasing shapes are pinned as
+regression fixtures (buggy copy fires, shipped copy clean); call-site
+dim threading, the PrefetchScalarGridSpec arity contract, the donation
+index (direct / argnames / self-attribute / factory), and pragma
+suppression each get a focused unit test; the repo's real kernels are
+pinned clean file-by-file."""
+
+import os
+
+import pytest
+
+from kubeflow_tpu.analysis import AnalysisConfig, Severity, analyze_paths
+from kubeflow_tpu.analysis.kernel_rules import (
+    VMEM_CAP_BYTES,
+    analyze_python_kernels,
+)
+from kubeflow_tpu.topology import min_vmem_bytes
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pack_d(findings):
+    return [f for f in findings
+            if f.rule.startswith(("krn-", "don-", "qnt-"))]
+
+
+@pytest.fixture(scope="module")
+def bad_kernel_findings():
+    found = analyze_paths(AnalysisConfig(
+        paths=[os.path.join(BAD, "kernels")], check_emitted=False,
+    ))
+    return _pack_d(found)
+
+
+class TestSeededFixtures:
+    def test_each_bad_fixture_fires_exactly_once(
+        self, bad_kernel_findings
+    ):
+        got = sorted(
+            (f.path, f.rule, f.severity) for f in bad_kernel_findings
+        )
+        assert got == [
+            ("don_read_after_donate.py", "don-read-after-donate",
+             Severity.ERROR),
+            ("don_thread_capture.py", "don-thread-capture",
+             Severity.ERROR),
+            ("krn_index_arity.py", "krn-index-map-arity",
+             Severity.ERROR),
+            ("krn_nondivisor_tail.py", "krn-block-nondivisor",
+             Severity.ERROR),
+            ("krn_operand_arity.py", "krn-operand-arity",
+             Severity.ERROR),
+            ("krn_vmem_budget.py", "krn-vmem-budget", Severity.ERROR),
+            ("krn_vmem_proxy.py", "krn-vmem-proxy-dim",
+             Severity.WARNING),
+            ("qnt_ragged_unmasked.py", "qnt-ragged-unmasked",
+             Severity.WARNING),
+            ("qnt_scale_skipped.py", "qnt-scale-skipped",
+             Severity.ERROR),
+        ], "\n".join(f.render() for f in bad_kernel_findings)
+
+    def test_clean_counterparts_fully_silent(self):
+        found = analyze_paths(AnalysisConfig(
+            paths=[os.path.join(CLEAN, "kernels")], check_emitted=False,
+        ))
+        assert found == [], "\n".join(f.render() for f in found)
+
+
+class TestRegressionPins:
+    """Acceptance pins: the PR-8 and PR-4 bug shapes fire on the buggy
+    copy and stay silent on the shipped shape — standalone (no project
+    context), so the pin holds in a single-file pre-commit scan too."""
+
+    def _one(self, name, root=BAD):
+        with open(os.path.join(root, "kernels", name)) as fh:
+            return analyze_python_kernels(fh.read(), name)
+
+    def test_pr8_nondivisor_buggy_copy_fires(self):
+        found = self._one("krn_nondivisor_tail.py")
+        assert [f.rule for f in found] == ["krn-block-nondivisor"]
+        assert "NEVER written" in found[0].message
+
+    def test_pr8_shipped_divisor_shape_clean(self):
+        assert self._one("krn_nondivisor_tail.py", CLEAN) == []
+
+    def test_pr4_thread_capture_buggy_copy_fires(self):
+        found = self._one("don_thread_capture.py")
+        assert [f.rule for f in found] == ["don-thread-capture"]
+        assert "save_async" in found[0].message
+
+    def test_pr4_shipped_snapshot_shape_clean(self):
+        assert self._one("don_thread_capture.py", CLEAN) == []
+
+
+class TestKernelContracts:
+    def test_call_site_dim_threading(self):
+        # The callee's dims are unknowable (and cap-guarded, so the
+        # definition site is silent); the BAD call site binds bn=256
+        # against n=384 and must fire AT THE CALLER; the good call
+        # (bn=128) is silent.
+        src = (
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n"
+            "_CAP_BYTES = 4 * 1024 * 1024\n"
+            "def _kern(x_ref, w_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] @ w_ref[...]\n"
+            "def launch(x, w, k, n, bn):\n"
+            "    rows = 8\n"
+            "    if k * bn * 4 > _CAP_BYTES:\n"
+            "        raise ValueError('tile too big')\n"
+            "    return pl.pallas_call(\n"
+            "        _kern,\n"
+            "        grid=(n // bn,),\n"
+            "        in_specs=[\n"
+            "            pl.BlockSpec((rows, k), lambda i: (0, 0)),\n"
+            "            pl.BlockSpec((k, bn), lambda i: (0, i)),\n"
+            "        ],\n"
+            "        out_specs=pl.BlockSpec((rows, bn),"
+            " lambda i: (0, i)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((rows, n),"
+            " x.dtype),\n"
+            "    )(x, w)\n"
+            "def use_bad(x, w):\n"
+            "    return launch(x, w, 512, 384, 256)\n"
+            "def use_ok(x, w):\n"
+            "    return launch(x, w, 512, 384, 128)\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("krn-block-nondivisor", 21)
+        ]
+
+    def test_cross_module_dim_threading(self, tmp_path):
+        # Same contract across an import edge: kernels.py exposes the
+        # wrapper, caller.py binds the bad dims — the finding lands in
+        # caller.py via the project index's module summaries.
+        (tmp_path / "kernels.py").write_text(
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n"
+            "_CAP_BYTES = 4 * 1024 * 1024\n"
+            "def _kern(x_ref, w_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] @ w_ref[...]\n"
+            "def launch(x, w, k, n, bn):\n"
+            "    rows = 8\n"
+            "    if k * bn * 4 > _CAP_BYTES:\n"
+            "        raise ValueError('too big')\n"
+            "    return pl.pallas_call(\n"
+            "        _kern,\n"
+            "        grid=(n // bn,),\n"
+            "        in_specs=[\n"
+            "            pl.BlockSpec((rows, k), lambda i: (0, 0)),\n"
+            "            pl.BlockSpec((k, bn), lambda i: (0, i)),\n"
+            "        ],\n"
+            "        out_specs=pl.BlockSpec((rows, bn),"
+            " lambda i: (0, i)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((rows, n),"
+            " x.dtype),\n"
+            "    )(x, w)\n"
+        )
+        (tmp_path / "caller.py").write_text(
+            "from kernels import launch\n"
+            "def use(x, w):\n"
+            "    return launch(x, w, 512, 384, 256)\n"
+        )
+        found = _pack_d(analyze_paths(AnalysisConfig(
+            paths=[str(tmp_path)], check_emitted=False,
+        )))
+        assert [(f.path, f.rule, f.line) for f in found] == [
+            ("caller.py", "krn-block-nondivisor", 3)
+        ]
+
+    def test_prefetch_index_maps_take_grid_plus_scalar_params(self):
+        # The decode_attention contract: under PrefetchScalarGridSpec
+        # the scalar operands arrive AFTER the grid indices, so a
+        # 2-D-grid + 1-prefetch map takes 3 params; a stale 2-param
+        # map (written before the prefetch was added) must fire.
+        def site(map_params):
+            return (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "from jax.experimental import pallas as pl\n"
+                "from jax.experimental.pallas import tpu as pltpu\n"
+                "def _kern(pos_ref, q_ref, o_ref):\n"
+                "    o_ref[...] = q_ref[...]\n"
+                "def attend(q, pos):\n"
+                "    return pl.pallas_call(\n"
+                "        _kern,\n"
+                "        grid_spec=pltpu.PrefetchScalarGridSpec(\n"
+                "            num_scalar_prefetch=1,\n"
+                "            grid=(4, 2),\n"
+                "            in_specs=[pl.BlockSpec((1, 8, 128),\n"
+                f"                lambda {map_params}: (bi, 0, 0))],\n"
+                "            out_specs=pl.BlockSpec((1, 8, 128),\n"
+                f"                lambda {map_params}: (bi, 0, 0)),\n"
+                "        ),\n"
+                "        out_shape=jax.ShapeDtypeStruct((4, 8, 128),"
+                " jnp.float32),\n"
+                "    )(pos, q)\n"
+            )
+        stale = analyze_python_kernels(
+            site("bi, j"), "kubeflow_tpu/m.py"
+        )
+        assert [f.rule for f in stale] == [
+            "krn-index-map-arity", "krn-index-map-arity"
+        ]
+        assert "AFTER the grid indices" in stale[0].message
+        good = analyze_python_kernels(
+            site("bi, j, pos_arr"), "kubeflow_tpu/m.py"
+        )
+        assert good == []
+
+    def test_vmem_cap_comes_from_topology(self):
+        assert VMEM_CAP_BYTES == min_vmem_bytes()
+
+    def test_varargs_kernel_skips_operand_arity(self):
+        # gemv/_decode_kernel shape: `*rest` makes the ref count
+        # statically inexact — the arity rule must stay silent.
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _kern(x_ref, *rest):\n"
+            "    rest[-1][...] = x_ref[...]\n"
+            "def run(x):\n"
+            "    return pl.pallas_call(\n"
+            "        _kern,\n"
+            "        grid=(2,),\n"
+            "        in_specs=[pl.BlockSpec((8, 128),"
+            " lambda i: (0, i))],\n"
+            "        out_specs=pl.BlockSpec((8, 128),"
+            " lambda i: (0, i)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, 256),"
+            " jnp.float32),\n"
+            "    )(x)\n"
+        )
+        assert analyze_python_kernels(src, "kubeflow_tpu/m.py") == []
+
+    def test_nondivisor_with_in_kernel_mask_is_clean(self):
+        # Ceil-div grid + ragged tail + jnp.where mask: the
+        # decode_attention shape — covered tail, masked lanes, clean.
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _kern(x_ref, o_ref):\n"
+            "    cols = jax.lax.broadcasted_iota("
+            "jnp.int32, x_ref.shape, 1)\n"
+            "    o_ref[...] = jnp.where(cols < 384, x_ref[...], 0.0)\n"
+            "def run(x):\n"
+            "    n = 384\n"
+            "    bn = 256\n"
+            "    return pl.pallas_call(\n"
+            "        _kern,\n"
+            "        grid=(-(-n // bn),)," "\n"
+            "        in_specs=[pl.BlockSpec((8, bn),"
+            " lambda i: (0, i))],\n"
+            "        out_specs=pl.BlockSpec((8, bn),"
+            " lambda i: (0, i)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, n),"
+            " jnp.float32),\n"
+            "    )(x)\n"
+        )
+        assert analyze_python_kernels(src, "kubeflow_tpu/m.py") == []
+
+    def test_nondivisor_without_mask_fires_even_with_ceil_grid(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * 2.0\n"
+            "def run(x):\n"
+            "    n = 384\n"
+            "    bn = 256\n"
+            "    return pl.pallas_call(\n"
+            "        _kern,\n"
+            "        grid=(-(-n // bn),)," "\n"
+            "        in_specs=[pl.BlockSpec((8, bn),"
+            " lambda i: (0, i))],\n"
+            "        out_specs=pl.BlockSpec((8, bn),"
+            " lambda i: (0, i)),\n"
+            "        out_shape=jax.ShapeDtypeStruct((8, n),"
+            " jnp.float32),\n"
+            "    )(x)\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [f.rule for f in found] == ["krn-block-nondivisor"]
+        assert "ragged tail" in found[0].message
+
+
+class TestDonationIndex:
+    def test_donate_argnames_resolved_through_callee_signature(self):
+        src = (
+            "import jax\n"
+            "def _verify(params, state, tokens):\n"
+            "    return state, tokens\n"
+            "verify = jax.jit(_verify, donate_argnames=('state',))\n"
+            "def drive(params, state, tokens):\n"
+            "    new_state, out = verify(params, state, tokens)\n"
+            "    return state.mean(), out\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("don-read-after-donate", 7)
+        ]
+
+    def test_self_attribute_donating_binding(self):
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def __init__(self, fn):\n"
+            "        self._advance = jax.jit(fn,"
+            " donate_argnums=(2,))\n"
+            "    def run(self, tokens, cache):\n"
+            "        out, cache2 = self._advance("
+            "self.params, tokens, cache)\n"
+            "        return out, cache.sum()\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("don-read-after-donate", 7)
+        ]
+
+    def test_factory_returned_jit_donates_at_binding(self):
+        src = (
+            "import jax\n"
+            "def make_step(update):\n"
+            "    def step(state, batch):\n"
+            "        return update(state, batch)\n"
+            "    return jax.jit(step, donate_argnums=0)\n"
+            "def train_once(params, batch, log, update):\n"
+            "    step = make_step(update)\n"
+            "    new = step(params, batch)\n"
+            "    log.append(params.mean())\n"
+            "    return new\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("don-read-after-donate", 9)
+        ]
+
+    def test_joined_worker_pool_is_clean(self):
+        # The serve_qps closed-loop shape: workers capture (and index)
+        # the parameter, but every thread is joined before the function
+        # returns — structured concurrency, no donation hazard.
+        src = (
+            "import threading\n"
+            "def run_load(prompts, clients, results):\n"
+            "    def worker():\n"
+            "        results.append(prompts[0])\n"
+            "    threads = [threading.Thread(target=worker,"
+            " daemon=True)\n"
+            "               for _ in range(clients)]\n"
+            "    for thread in threads:\n"
+            "        thread.start()\n"
+            "    for thread in threads:\n"
+            "        thread.join()\n"
+            "    return results\n"
+        )
+        assert analyze_python_kernels(src, "kubeflow_tpu/m.py") == []
+
+    def test_timeout_join_of_named_thread_is_clean(self):
+        # Joined-with-timeout single thread (start_notebooks shape):
+        # a zero-positional-arg .join() is a thread join, so the
+        # capture never outlives the call.
+        src = (
+            "import threading\n"
+            "def measure(kubelet, log):\n"
+            "    def kubelet_loop():\n"
+            "        log.append(kubelet.read())\n"
+            "    t = threading.Thread(target=kubelet_loop,"
+            " daemon=True)\n"
+            "    t.start()\n"
+            "    t.join(timeout=1)\n"
+            "    return log\n"
+        )
+        assert analyze_python_kernels(src, "kubeflow_tpu/m.py") == []
+
+    def test_loop_rebind_is_clean(self):
+        # The train-loop idiom: state = step(state, batch) rebinds in
+        # the same statement, so the donated binding never survives.
+        src = (
+            "import jax\n"
+            "def _adv(state, batch):\n"
+            "    return state\n"
+            "step = jax.jit(_adv, donate_argnums=(0,))\n"
+            "def train(state, batches, log):\n"
+            "    for batch in batches:\n"
+            "        state = step(state, batch)\n"
+            "    log.append(state)\n"
+            "    return state\n"
+        )
+        assert analyze_python_kernels(src, "kubeflow_tpu/m.py") == []
+
+    def test_branch_read_after_donate_fires(self):
+        # The CFG carries donation through a branch join: only one
+        # path reads the stale binding — still a bug, still fires.
+        src = (
+            "import jax\n"
+            "def _adv(state, batch):\n"
+            "    return state\n"
+            "step = jax.jit(_adv, donate_argnums=(0,))\n"
+            "def train(state, batch, log, verbose):\n"
+            "    new = step(state, batch)\n"
+            "    if verbose:\n"
+            "        log.append(state.mean())\n"
+            "    return new\n"
+        )
+        found = analyze_python_kernels(src, "kubeflow_tpu/m.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("don-read-after-donate", 8)
+        ]
+
+
+class TestPragmaAndTestExemption:
+    def test_pragma_suppresses_kernel_finding(self, tmp_path):
+        with open(os.path.join(
+            BAD, "kernels", "krn_nondivisor_tail.py"
+        )) as fh:
+            src = fh.read()
+        src = src.replace(
+            "        out_specs=pl.BlockSpec(",
+            "        # analysis: allow[krn-block-nondivisor] — proto\n"
+            "        out_specs=pl.BlockSpec(",
+        )
+        target = tmp_path / "mod.py"
+        target.write_text(src)
+        found = _pack_d(analyze_paths(AnalysisConfig(
+            paths=[str(target)], check_emitted=False,
+        )))
+        assert found == [], "\n".join(f.render() for f in found)
+
+    def test_test_trees_exempt(self):
+        with open(os.path.join(
+            BAD, "kernels", "krn_nondivisor_tail.py"
+        )) as fh:
+            src = fh.read()
+        assert analyze_python_kernels(
+            src, "tests/test_something.py"
+        ) == []
+
+
+class TestRealKernelsPinnedClean:
+    """The shipped Pallas/donation/quant code scans clean standalone —
+    file-by-file, so a pre-commit single-file scan stays quiet too
+    (the package-level zero-findings gate lives in
+    test_analysis_self.py)."""
+
+    @pytest.mark.parametrize("rel", [
+        "kubeflow_tpu/ops/gemv.py",
+        "kubeflow_tpu/ops/decode_qkv.py",
+        "kubeflow_tpu/ops/decode_attention.py",
+        "kubeflow_tpu/ops/attention.py",
+        "kubeflow_tpu/ops/cross_entropy.py",
+        "kubeflow_tpu/models/checkpoint.py",
+        "kubeflow_tpu/models/decoding.py",
+        "kubeflow_tpu/serving/engine.py",
+    ])
+    def test_file_clean(self, rel):
+        with open(os.path.join(REPO, rel)) as fh:
+            src = fh.read()
+        found = analyze_python_kernels(src, rel)
+        assert found == [], "\n".join(f.render() for f in found)
